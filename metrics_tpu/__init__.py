@@ -1,0 +1,18 @@
+"""metrics_tpu: TPU-native machine-learning metrics for JAX.
+
+Stateful metric accumulation with a pure-functional core (init/update/compute/
+merge as jit-safe pure functions over pytree states), synchronized across TPU
+meshes with XLA collectives. Capability parity target: TorchMetrics v0.2.1
+(reference mounted at /root/reference).
+"""
+import logging
+
+_logger = logging.getLogger("metrics_tpu")
+_logger.addHandler(logging.StreamHandler())
+_logger.setLevel(logging.INFO)
+
+from metrics_tpu.info import __version__  # noqa: E402
+from metrics_tpu.core.collections import MetricCollection  # noqa: E402
+from metrics_tpu.core.metric import CompositionalMetric, Metric, PureMetric  # noqa: E402
+from metrics_tpu.classification import Accuracy, StatScores  # noqa: E402
+from metrics_tpu import functional  # noqa: E402

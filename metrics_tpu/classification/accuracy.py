@@ -1,0 +1,64 @@
+"""Accuracy module.
+
+Parity target: reference ``torchmetrics/classification/accuracy.py:23`` —
+``correct``/``total`` "sum" states (:121-122), update via ``_accuracy_update``.
+"""
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.classification.accuracy import _accuracy_compute, _accuracy_update
+from metrics_tpu.utils.data import accum_int_dtype
+
+
+class Accuracy(Metric):
+    r"""Fraction of correctly classified samples, accumulated over batches.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([0, 1, 2, 3])
+        >>> preds = jnp.array([0, 2, 1, 3])
+        >>> accuracy = Accuracy()
+        >>> float(accuracy(preds, target))
+        0.5
+    """
+
+    def __init__(
+        self,
+        threshold: float = 0.5,
+        top_k: Optional[int] = None,
+        subset_accuracy: bool = False,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+
+        self.add_state("correct", default=jnp.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
+
+        if not 0 < threshold < 1:
+            raise ValueError(f"The `threshold` should be a float in the (0,1) interval, got {threshold}")
+
+        if top_k is not None and (not isinstance(top_k, int) or top_k <= 0):
+            raise ValueError(f"The `top_k` should be an integer larger than 0, got {top_k}")
+
+        self.threshold = threshold
+        self.top_k = top_k
+        self.subset_accuracy = subset_accuracy
+
+    def update(self, preds: Array, target: Array) -> None:
+        correct, total = _accuracy_update(preds, target, self.threshold, self.top_k, self.subset_accuracy)
+        self.correct = self.correct + correct
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _accuracy_compute(self.correct, self.total)

@@ -1,0 +1,2 @@
+from metrics_tpu.core.collections import MetricCollection
+from metrics_tpu.core.metric import CompositionalMetric, Metric, PureMetric

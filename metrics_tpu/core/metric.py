@@ -1,0 +1,658 @@
+"""Core metric runtime: stateful wrapper over a pure functional core.
+
+Parity target: reference ``torchmetrics/metric.py`` — ``Metric`` (metric.py:29),
+``add_state`` (:88-148), ``forward`` (:150-177), ``_sync_dist`` (:179-197),
+update/compute wrapping (:199-239), ``reset/clone/persistent/state_dict``
+(:256-319), ``_filter_kwargs`` (:321-336), ``__hash__`` (:338-350), operator
+overloads (:352-450) and ``CompositionalMetric`` (:457-536).
+
+TPU-native redesign (not a port):
+
+* **The state is a pytree, the update is a pure function.** Every metric also
+  exposes ``init_state / update_state / compute_from_state / merge_states /
+  sync_state`` — pure functions over a ``{name: array|PaddedBuffer}`` dict that
+  can be ``jit``-ed, ``scan``-ned, donated, checkpointed with orbax, and used
+  directly inside a ``pjit``-ed training step (see ``Metric.pure()``).
+* **One fused update per ``forward``.** The reference runs ``update()`` twice
+  per ``forward`` (once into the accumulator, once on a fresh state for the
+  batch value — reference metric.py:156-177). Here ``forward`` computes the
+  batch-delta state once and *merges* it into the accumulator with the same
+  per-state reduction that powers distributed sync; the batch value is computed
+  from the delta. Metrics whose reductions have no pairwise merge fall back to
+  the reference's double-update path automatically.
+* **XLA collectives instead of NCCL.** Host-plane sync mirrors the reference's
+  gather-then-reduce exactly (over ``process_allgather`` when multi-host); the
+  in-jit plane syncs with ``psum``/``pmin``/``pmax``/``all_gather`` over a
+  named mesh axis (see ``metrics_tpu/parallel/sync.py``).
+* **Allocation-free hot loop.** When every state is a fixed-shape array the
+  fused step is compiled once with buffer donation on TPU, so per-step metric
+  update costs one fused XLA kernel and no host sync.
+"""
+import functools
+import inspect
+from abc import ABC, abstractmethod
+from copy import deepcopy
+from typing import Any, Callable, Dict, NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.parallel.buffer import PaddedBuffer, buffer_append, buffer_init
+from metrics_tpu.utils.exceptions import TracingUnsupportedError
+from metrics_tpu.parallel.sync import (
+    ReduceFx,
+    canonicalize_reduce_fx,
+    gather_all_arrays,
+    host_gather,
+    is_mergeable,
+    merge_values,
+    sync_state as _sync_state_pure,
+)
+
+State = Dict[str, Any]
+
+
+class _BufferSpec(NamedTuple):
+    capacity: int
+    item_shape: tuple
+    dtype: Any
+
+
+class PureMetric(NamedTuple):
+    """Bound pure-functional view of a metric, for use inside jit/pjit/shard_map."""
+
+    init: Callable[[], State]
+    update: Callable[..., State]  # (state, *args, **kwargs) -> state
+    compute: Callable[[State], Any]
+    merge: Callable[[State, State], State]
+    sync: Callable[[State, str], State]  # (state, axis_name) -> state
+
+
+class Metric(ABC):
+    """Base class of all metrics: stateful accumulation + device-mesh sync.
+
+    Args:
+        compute_on_step: ``forward`` returns the batch-local value if True.
+        dist_sync_on_step: sync state across processes inside every ``forward``.
+        process_group: accepted for API parity; scoping in JAX is done by
+            choosing the mesh axis passed to ``sync_state``.
+        dist_sync_fn: custom host-plane gather, ``fn(array) -> List[array]``
+            (one entry per process). Defaults to ``process_allgather`` when
+            running multi-host.
+        capacity: optional fixed capacity for list ("cat") states; when set,
+            states declared with an ``item_shape`` become jit-safe
+            :class:`PaddedBuffer` s instead of Python lists.
+        jit: compile the fused per-step update. ``None`` (default) auto-enables
+            when all states are fixed-shape arrays/buffers and falls back to
+            eager on metrics that need data-dependent Python (e.g. class-count
+            inference from values).
+    """
+
+    def __init__(
+        self,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+        capacity: Optional[int] = None,
+        jit: Optional[bool] = None,
+    ):
+        self.dist_sync_on_step = dist_sync_on_step
+        self.compute_on_step = compute_on_step
+        self.process_group = process_group
+        self.dist_sync_fn = dist_sync_fn
+        self.capacity = capacity
+        self._jit = jit
+        self._to_sync = True
+
+        self._update_signature = inspect.signature(self.update)
+        self._update_impl = self.update  # unwrapped bound method (pure w.r.t. registered states)
+        self._compute_impl = self.compute
+        self.update = self._wrap_update(self.update)
+        self.compute = self._wrap_compute(self.compute)
+        self._computed = None
+        self._forward_cache = None
+
+        self._defaults: Dict[str, Any] = {}  # numpy templates / [] / _BufferSpec
+        self._persistent: Dict[str, bool] = {}
+        self._reductions: Dict[str, ReduceFx] = {}
+        self._jitted_step = None
+        self._jit_failed = False
+        self._placement = None  # last device/sharding passed to device_put; re-applied on reset
+        self._state_dtype = None  # last float dtype passed to astype; re-applied on reset
+
+    # ------------------------------------------------------------------ state
+    def add_state(
+        self,
+        name: str,
+        default: Any,
+        dist_reduce_fx: Optional[ReduceFx] = None,
+        persistent: bool = False,
+        item_shape: Optional[tuple] = None,
+        item_dtype: Any = None,
+    ) -> None:
+        """Register a state variable (reference ``add_state``, metric.py:88-148).
+
+        ``default`` is an array (fixed-shape state) or an empty list (cat
+        state). Extension over the reference: ``dist_reduce_fx`` additionally
+        accepts ``'min'``/``'max'`` (the reference passes ``torch.min/max``
+        callables for PSNR), and list states may declare ``item_shape`` /
+        ``item_dtype`` so that, when the metric was built with a ``capacity``,
+        they become jit-safe PaddedBuffers.
+        """
+        is_list = isinstance(default, list) and len(default) == 0
+        is_arraylike = isinstance(default, (int, float, np.ndarray, jnp.ndarray, Array)) and not isinstance(
+            default, bool
+        )
+        if not (is_list or is_arraylike):
+            raise ValueError("state variable must be a tensor or any empty list (where you can append tensors)")
+        dist_reduce_fx = canonicalize_reduce_fx(dist_reduce_fx)
+
+        if is_list and self.capacity is not None and item_shape is not None:
+            default_spec: Any = _BufferSpec(self.capacity, tuple(item_shape), item_dtype or jnp.float32)
+        elif is_list:
+            default_spec = []
+        else:
+            default_spec = np.asarray(default)  # host-side template; materialized per reset
+
+        self._defaults[name] = default_spec
+        self._persistent[name] = persistent
+        self._reductions[name] = dist_reduce_fx
+        setattr(self, name, self._materialize_default(default_spec))
+
+    @staticmethod
+    def _materialize_default(spec: Any) -> Any:
+        if isinstance(spec, _BufferSpec):
+            return buffer_init(spec.capacity, spec.item_shape, spec.dtype)
+        if isinstance(spec, list):
+            return []
+        return jnp.asarray(spec)
+
+    def _append(self, name: str, value: Array) -> None:
+        """Append to a cat state — list (eager) or PaddedBuffer (jit-safe)."""
+        current = getattr(self, name)
+        if isinstance(current, PaddedBuffer):
+            setattr(self, name, buffer_append(current, value))
+        else:
+            current.append(value)
+
+    # ------------------------------------------------------------- pure core
+    def init_state(self) -> State:
+        """Fresh default state pytree."""
+        return {name: self._materialize_default(spec) for name, spec in self._defaults.items()}
+
+    def _current_state(self) -> State:
+        return {name: getattr(self, name) for name in self._defaults}
+
+    def _set_state(self, state: State) -> None:
+        for name, value in state.items():
+            setattr(self, name, value)
+
+    def _run_update_on_state(self, state: State, *args: Any, **kwargs: Any) -> State:
+        """Run the subclass ``update`` as a pure function of ``state``."""
+        saved = self._current_state()
+        self._set_state(state)
+        try:
+            self._update_impl(*args, **kwargs)
+            return self._current_state()
+        finally:
+            self._set_state(saved)
+
+    def update_state(self, state: State, *args: Any, **kwargs: Any) -> State:
+        """Pure update: returns the new state. Jit-safe for array/buffer states."""
+        return self._run_update_on_state(state, *args, **kwargs)
+
+    def compute_from_state(self, state: State) -> Any:
+        """Pure compute on an explicit state pytree."""
+        saved = self._current_state()
+        self._set_state(state)
+        try:
+            return self._compute_impl()
+        finally:
+            self._set_state(saved)
+
+    def merge_states(self, a: State, b: State) -> State:
+        """Pairwise-associative merge (powers fused forward, tree-reduction, shard merging)."""
+        return {name: merge_values(self._reductions[name], a[name], b[name]) for name in self._defaults}
+
+    def sync_state(self, state: State, axis_name: str) -> State:
+        """In-jit cross-device sync over a named mesh axis (use inside shard_map/pmap)."""
+        return _sync_state_pure(state, self._reductions, axis_name)
+
+    def pure(self) -> PureMetric:
+        """The pure-functional view: use inside jit/pjit-ed training steps."""
+        return PureMetric(
+            init=self.init_state,
+            update=self.update_state,
+            compute=self.compute_from_state,
+            merge=self.merge_states,
+            sync=self.sync_state,
+        )
+
+    # --------------------------------------------------------------- forward
+    @property
+    def _fusable(self) -> bool:
+        return all(
+            is_mergeable(self._reductions[name], getattr(self, name, self._defaults[name]))
+            for name in self._defaults
+        )
+
+    @property
+    def _jittable(self) -> bool:
+        if self._jit is False or self._jit_failed:
+            return False
+        # eager python-list states change pytree structure every step -> no jit
+        return not any(isinstance(self._defaults[n], list) for n in self._defaults)
+
+    def _build_jitted_step(self) -> Callable:
+        donate = (0,) if jax.default_backend() == "tpu" else ()
+
+        def step(acc: State, *args: Any, **kwargs: Any):
+            delta = self._run_update_on_state(self.init_state(), *args, **kwargs)
+            return self.merge_states(acc, delta), delta
+
+        return jax.jit(step, donate_argnums=donate)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Accumulate this batch and (if ``compute_on_step``) return its batch-local value."""
+        if self._fusable:
+            return self._forward_fused(*args, **kwargs)
+        return self._forward_reference(*args, **kwargs)
+
+    def _forward_fused(self, *args: Any, **kwargs: Any) -> Any:
+        self._computed = None
+        self._forward_cache = None
+        delta = None
+        if self._jittable:
+            if self._jitted_step is None:
+                self._jitted_step = self._build_jitted_step()
+            try:
+                new_acc, delta = self._jitted_step(self._current_state(), *args, **kwargs)
+                self._set_state(new_acc)
+            except (
+                jax.errors.TracerArrayConversionError,
+                jax.errors.ConcretizationTypeError,
+                jax.errors.TracerBoolConversionError,
+                TypeError,
+                TracingUnsupportedError,
+            ):
+                # update needs concrete values (e.g. class inference) -> permanent eager fallback
+                self._jit_failed = True
+                delta = None
+        if delta is None:
+            delta = self._run_update_on_state(self.init_state(), *args, **kwargs)
+            self._set_state(self.merge_states(self._current_state(), delta))
+
+        if not self.compute_on_step:
+            return None
+
+        self._to_sync = self.dist_sync_on_step
+        acc = self._current_state()
+        self._set_state(delta)
+        self._forward_cache = self.compute()
+        self._set_state(acc)
+        self._to_sync = True
+        self._computed = None
+        return self._forward_cache
+
+    def _forward_reference(self, *args: Any, **kwargs: Any) -> Any:
+        """Reference-exact double-update path (reference metric.py:150-177)."""
+        self.update(*args, **kwargs)
+        self._forward_cache = None
+        if self.compute_on_step:
+            self._to_sync = self.dist_sync_on_step
+            cache = self._current_state()
+            self.reset()
+            self.update(*args, **kwargs)
+            self._forward_cache = self.compute()
+            self._set_state(cache)
+            self._to_sync = True
+            self._computed = None
+            return self._forward_cache
+        return None
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.forward(*args, **kwargs)
+
+    # ------------------------------------------------------------------ sync
+    def _sync_dist(self, dist_sync_fn: Callable = gather_all_arrays) -> None:
+        """Host-plane sync: gather + stack/flatten + per-state reduction
+        (reference metric.py:179-197)."""
+        synced = host_gather(self._current_state(), self._reductions, gather_fn=dist_sync_fn)
+        self._set_state(synced)
+
+    def _wrap_update(self, update: Callable) -> Callable:
+        @functools.wraps(update)
+        def wrapped_func(*args: Any, **kwargs: Any) -> Any:
+            self._computed = None
+            return update(*args, **kwargs)
+
+        return wrapped_func
+
+    def _wrap_compute(self, compute: Callable) -> Callable:
+        @functools.wraps(compute)
+        def wrapped_func(*args: Any, **kwargs: Any) -> Any:
+            if self._computed is not None:
+                return self._computed
+
+            dist_sync_fn = self.dist_sync_fn
+            if dist_sync_fn is None and jax.process_count() > 1:
+                dist_sync_fn = gather_all_arrays
+
+            synced = False
+            cache = {}
+            if self._to_sync and dist_sync_fn is not None:
+                cache = self._current_state()
+                self._sync_dist(dist_sync_fn)
+                synced = True
+
+            self._computed = compute(*args, **kwargs)
+            if synced:
+                self._set_state(cache)
+            return self._computed
+
+        return wrapped_func
+
+    @abstractmethod
+    def update(self) -> None:  # pylint: disable=E0202
+        """Override to update registered state from a batch."""
+
+    @abstractmethod
+    def compute(self) -> Any:  # pylint: disable=E0202
+        """Override to compute the final value from (synced) state."""
+
+    # ------------------------------------------------------------- lifecycle
+    def reset(self) -> None:
+        """Reset all states to defaults, preserving device placement and dtype
+        (the reference re-creates defaults on the *current* device,
+        metric.py:256-265; here the last ``device_put``/``astype`` target is
+        re-applied so mesh placement survives epoch resets)."""
+        self._computed = None
+        state = self.init_state()
+        self._set_state(state)
+        if self._state_dtype is not None:
+            self.astype(self._state_dtype)
+        if self._placement is not None:
+            self.device_put(self._placement)
+
+    def clone(self) -> "Metric":
+        return deepcopy(self)
+
+    def __getstate__(self) -> dict:
+        skip = ("update", "compute", "_update_impl", "_compute_impl", "_jitted_step")
+        return {k: v for k, v in self.__dict__.items() if k not in skip}
+
+    def __setstate__(self, state: dict) -> None:
+        self.__dict__.update(state)
+        self._update_impl = self.__class__.update.__get__(self)
+        self._compute_impl = self.__class__.compute.__get__(self)
+        self.update = self._wrap_update(self._update_impl)
+        self.compute = self._wrap_compute(self._compute_impl)
+        self._jitted_step = None
+
+    def __deepcopy__(self, memo: dict) -> "Metric":
+        cls = self.__class__
+        new = cls.__new__(cls)
+        memo[id(self)] = new
+        skip = ("update", "compute", "_update_impl", "_compute_impl", "_jitted_step")
+        for k, v in self.__dict__.items():
+            if k in skip:
+                continue
+            if isinstance(v, (jnp.ndarray, Array)) or isinstance(v, PaddedBuffer):
+                new.__dict__[k] = v  # immutable device arrays are safe to share
+            else:
+                new.__dict__[k] = deepcopy(v, memo)
+        new._update_impl = cls.update.__get__(new)
+        new._compute_impl = cls.compute.__get__(new)
+        new.update = new._wrap_update(new._update_impl)
+        new.compute = new._wrap_compute(new._compute_impl)
+        new._jitted_step = None
+        return new
+
+    # ------------------------------------------------------- device / shards
+    def device_put(self, device_or_sharding: Any) -> "Metric":
+        """Place all states on a device or ``jax.sharding.Sharding`` (the
+        TPU-native analogue of the reference's ``_apply`` device movement,
+        metric.py:281-298)."""
+        self._placement = device_or_sharding
+        for name in self._defaults:
+            value = getattr(self, name)
+            if isinstance(value, list):
+                setattr(self, name, [jax.device_put(v, device_or_sharding) for v in value])
+            else:
+                setattr(self, name, jax.device_put(value, device_or_sharding))
+        return self
+
+    def astype(self, dtype: Any) -> "Metric":
+        """Cast floating-point states (analogue of ``.half()/.float()`` movement)."""
+        self._state_dtype = dtype
+        for name in self._defaults:
+            value = getattr(self, name)
+
+            def _cast(v: Array) -> Array:
+                return v.astype(dtype) if jnp.issubdtype(v.dtype, jnp.floating) else v
+
+            if isinstance(value, list):
+                setattr(self, name, [_cast(v) for v in value])
+            elif isinstance(value, PaddedBuffer):
+                setattr(self, name, PaddedBuffer(_cast(value.data), value.count))
+            else:
+                setattr(self, name, _cast(value))
+        return self
+
+    # ------------------------------------------------------------ checkpoint
+    def persistent(self, mode: bool = False) -> None:
+        for key in self._persistent:
+            self._persistent[key] = mode
+
+    def state_dict(self, destination: Optional[dict] = None, prefix: str = "") -> dict:
+        """Persistent states as host numpy (orbax/pickle friendly)."""
+        destination = {} if destination is None else destination
+        for key in self._defaults:
+            if self._persistent[key]:
+                value = getattr(self, key)
+                if isinstance(value, list):
+                    destination[prefix + key] = [np.asarray(v) for v in value]
+                elif isinstance(value, PaddedBuffer):
+                    destination[prefix + key] = {"data": np.asarray(value.data), "count": np.asarray(value.count)}
+                else:
+                    destination[prefix + key] = np.asarray(value)
+        return destination
+
+    def load_state_dict(self, state_dict: dict, prefix: str = "") -> None:
+        for key in self._defaults:
+            if prefix + key in state_dict:
+                value = state_dict[prefix + key]
+                if isinstance(value, dict) and set(value) == {"data", "count"}:
+                    setattr(self, key, PaddedBuffer(jnp.asarray(value["data"]), jnp.asarray(value["count"])))
+                elif isinstance(value, list):
+                    setattr(self, key, [jnp.asarray(v) for v in value])
+                else:
+                    setattr(self, key, jnp.asarray(value))
+
+    def state_pytree(self) -> State:
+        """All current states as a pytree (for orbax checkpointing of the full metric)."""
+        return self._current_state()
+
+    # -------------------------------------------------------------- plumbing
+    def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
+        """Keep only kwargs accepted by this metric's ``update`` (reference metric.py:321-336)."""
+        _params = (inspect.Parameter.VAR_POSITIONAL, inspect.Parameter.VAR_KEYWORD)
+        _sign_params = self._update_signature.parameters
+        filtered_kwargs = {
+            k: v for k, v in kwargs.items() if (k in _sign_params and _sign_params[k].kind not in _params)
+        }
+        return filtered_kwargs or kwargs
+
+    def __hash__(self) -> int:
+        hash_vals = [self.__class__.__name__]
+        for key in self._defaults:
+            value = getattr(self, key)
+            if isinstance(value, list):
+                hash_vals.extend(id(v) for v in value)
+            else:
+                hash_vals.append(id(value))
+        return hash(tuple(hash_vals))
+
+    def __repr__(self) -> str:
+        return f"{self.__class__.__name__}()"
+
+    # ------------------------------------------------------------- operators
+    def __add__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.add, self, other)
+
+    def __and__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_and, self, other)
+
+    def __eq__(self, other: Any) -> "CompositionalMetric":  # type: ignore[override]
+        return CompositionalMetric(jnp.equal, self, other)
+
+    def __floordiv__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.floor_divide, self, other)
+
+    def __ge__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.greater_equal, self, other)
+
+    def __gt__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.greater, self, other)
+
+    def __le__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.less_equal, self, other)
+
+    def __lt__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.less, self, other)
+
+    def __matmul__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.matmul, self, other)
+
+    def __mod__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.fmod, self, other)
+
+    def __mul__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.multiply, self, other)
+
+    def __ne__(self, other: Any) -> "CompositionalMetric":  # type: ignore[override]
+        return CompositionalMetric(jnp.not_equal, self, other)
+
+    def __or__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_or, self, other)
+
+    def __pow__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.power, self, other)
+
+    def __radd__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.add, other, self)
+
+    def __rand__(self, other: Any) -> "CompositionalMetric":
+        # bitwise_and is commutative
+        return CompositionalMetric(jnp.bitwise_and, self, other)
+
+    def __rfloordiv__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.floor_divide, other, self)
+
+    def __rmatmul__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.matmul, other, self)
+
+    def __rmod__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.fmod, other, self)
+
+    def __rmul__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.multiply, other, self)
+
+    def __ror__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_or, other, self)
+
+    def __rpow__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.power, other, self)
+
+    def __rsub__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.subtract, other, self)
+
+    def __rtruediv__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.true_divide, other, self)
+
+    def __rxor__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_xor, other, self)
+
+    def __sub__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.subtract, self, other)
+
+    def __truediv__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.true_divide, self, other)
+
+    def __xor__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_xor, self, other)
+
+    def __abs__(self) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.abs, self, None)
+
+    def __inv__(self) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_not, self, None)
+
+    def __invert__(self) -> "CompositionalMetric":
+        return self.__inv__()
+
+    def __neg__(self) -> "CompositionalMetric":
+        return CompositionalMetric(_neg, self, None)
+
+    def __pos__(self) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.abs, self, None)
+
+
+def _neg(tensor: Array) -> Array:
+    return -jnp.abs(tensor)
+
+
+class CompositionalMetric(Metric):
+    """Lazy composition of two metrics under an operator (reference metric.py:457-536)."""
+
+    def __init__(
+        self,
+        operator: Callable,
+        metric_a: Union[Metric, int, float, Array],
+        metric_b: Union[Metric, int, float, Array, None],
+    ):
+        super().__init__()
+        self.op = operator
+        self.metric_a = jnp.asarray(metric_a) if isinstance(metric_a, (jnp.ndarray, np.ndarray)) else metric_a
+        self.metric_b = jnp.asarray(metric_b) if isinstance(metric_b, (jnp.ndarray, np.ndarray)) else metric_b
+
+    def _sync_dist(self, dist_sync_fn: Optional[Callable] = None) -> None:
+        # syncing is done by the child metrics themselves (reference metric.py:489-491)
+        pass
+
+    @property
+    def _fusable(self) -> bool:
+        # children manage their own accumulation; use the reference forward path
+        return False
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.update(*args, **self.metric_a._filter_kwargs(**kwargs))
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.update(*args, **self.metric_b._filter_kwargs(**kwargs))
+
+    def compute(self) -> Any:
+        val_a = self.metric_a.compute() if isinstance(self.metric_a, Metric) else self.metric_a
+        val_b = self.metric_b.compute() if isinstance(self.metric_b, Metric) else self.metric_b
+        if val_b is None:
+            return self.op(val_a)
+        return self.op(val_a, val_b)
+
+    def reset(self) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.reset()
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.reset()
+
+    def persistent(self, mode: bool = False) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.persistent(mode=mode)
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.persistent(mode=mode)
+
+    def __repr__(self) -> str:
+        _op_metrics = f"(\n  {self.op.__name__}(\n    {repr(self.metric_a)},\n    {repr(self.metric_b)}\n  )\n)"
+        return self.__class__.__name__ + _op_metrics

@@ -1,0 +1,74 @@
+"""Accuracy (incl. top-k and subset variants).
+
+Parity target: reference ``torchmetrics/functional/classification/accuracy.py``
+(``_accuracy_update`` at :23-51, ``_accuracy_compute`` at :54-55). The
+multiclass path is the one-hot dot product ``(preds * target).sum()`` — on TPU
+this lowers to a fused elementwise+reduce kernel.
+"""
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.enums import DataType
+
+
+def _accuracy_update(
+    preds: Array,
+    target: Array,
+    threshold: float,
+    top_k: Optional[int],
+    subset_accuracy: bool,
+) -> Tuple[Array, Array]:
+    preds, target, mode = _input_format_classification(preds, target, threshold=threshold, top_k=top_k)
+
+    if mode == DataType.MULTILABEL and top_k:
+        raise ValueError("You can not use the `top_k` parameter to calculate accuracy for multi-label inputs.")
+
+    if mode == DataType.BINARY or (mode == DataType.MULTILABEL and subset_accuracy):
+        correct = jnp.sum(jnp.all(preds == target, axis=1))
+        total = jnp.asarray(target.shape[0])
+    elif mode == DataType.MULTILABEL and not subset_accuracy:
+        correct = jnp.sum(preds == target)
+        total = jnp.asarray(target.size)
+    elif mode == DataType.MULTICLASS or (mode == DataType.MULTIDIM_MULTICLASS and not subset_accuracy):
+        correct = jnp.sum(preds * target)
+        total = jnp.sum(target)
+    elif mode == DataType.MULTIDIM_MULTICLASS and subset_accuracy:
+        sample_correct = jnp.sum(preds * target, axis=(1, 2))
+        correct = jnp.sum(sample_correct == target.shape[2])
+        total = jnp.asarray(target.shape[0])
+
+    return correct.astype(jnp.int32), total.astype(jnp.int32)
+
+
+def _accuracy_compute(correct: Array, total: Array) -> Array:
+    return correct.astype(jnp.float32) / total
+
+
+def accuracy(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    subset_accuracy: bool = False,
+) -> Array:
+    r"""Fraction of correctly classified samples.
+
+    Accepts every input type of the taxonomy (see reference ``accuracy``
+    :58-130 for ``top_k``/``subset_accuracy`` semantics).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([0, 1, 2, 3])
+        >>> preds = jnp.array([0, 2, 1, 3])
+        >>> float(accuracy(preds, target))
+        0.5
+        >>> target = jnp.array([0, 1, 2])
+        >>> preds = jnp.array([[0.1, 0.9, 0], [0.3, 0.1, 0.6], [0.2, 0.5, 0.3]])
+        >>> round(float(accuracy(preds, target, top_k=2)), 4)
+        0.6667
+    """
+    correct, total = _accuracy_update(preds, target, threshold, top_k, subset_accuracy)
+    return _accuracy_compute(correct, total)

@@ -1,0 +1,145 @@
+"""True/false positive/negative counts — the base kernel of the classification family.
+
+Parity target: reference ``torchmetrics/functional/classification/stat_scores.py``
+(``_stat_scores`` at :28-74, ``_stat_scores_update`` at :77-122,
+``_stat_scores_compute`` at :125-137). The counting itself is boolean-mask
+elementwise algebra + reductions — XLA fuses the whole thing into one kernel.
+"""
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _input_format_classification
+
+
+def _drop_column(x: Array, index: int) -> Array:
+    """Remove class column ``index`` (static) from an ``(N, C[, X])`` array."""
+    return jnp.concatenate([x[:, :index], x[:, index + 1:]], axis=1)
+
+
+def _stat_scores(preds: Array, target: Array, reduce: str = "micro") -> Tuple[Array, Array, Array, Array]:
+    """tp/fp/tn/fn from binary ``(N, C)`` or ``(N, C, X)`` arrays.
+
+    Output shapes per ``reduce`` mirror reference :48-56: micro -> scalar (or
+    ``(N,)`` for 3d), macro -> ``(C,)`` (or ``(N, C)``), samples -> ``(N,)``
+    (or ``(N, X)``).
+    """
+    if reduce == "micro":
+        axis: Tuple[int, ...] = (0, 1) if preds.ndim == 2 else (1, 2)
+    elif reduce == "macro":
+        axis = (0,) if preds.ndim == 2 else (2,)
+    elif reduce == "samples":
+        axis = (1,)
+    else:
+        raise ValueError(f"The `reduce` {reduce} is not valid.")
+
+    correct = target == preds
+    pos = preds == 1
+
+    tp = jnp.sum(correct & pos, axis=axis)
+    fp = jnp.sum(~correct & pos, axis=axis)
+    tn = jnp.sum(correct & ~pos, axis=axis)
+    fn = jnp.sum(~correct & ~pos, axis=axis)
+    return tp.astype(jnp.int32), fp.astype(jnp.int32), tn.astype(jnp.int32), fn.astype(jnp.int32)
+
+
+def _stat_scores_update(
+    preds: Array,
+    target: Array,
+    reduce: str = "micro",
+    mdmc_reduce: Optional[str] = None,
+    num_classes: Optional[int] = None,
+    top_k: Optional[int] = None,
+    threshold: float = 0.5,
+    is_multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array, Array]:
+    preds, target, _ = _input_format_classification(
+        preds, target, threshold=threshold, num_classes=num_classes, is_multiclass=is_multiclass, top_k=top_k
+    )
+
+    if ignore_index is not None and not 0 <= ignore_index < preds.shape[1]:
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {preds.shape[0]} classes")
+    if ignore_index is not None and preds.shape[1] == 1:
+        raise ValueError("You can not use `ignore_index` with binary data.")
+
+    if preds.ndim == 3:
+        if not mdmc_reduce:
+            raise ValueError(
+                "When your inputs are multi-dimensional multi-class, you have to set the `mdmc_reduce` parameter"
+            )
+        if mdmc_reduce == "global":
+            preds = jnp.swapaxes(preds, 1, 2).reshape(-1, preds.shape[1])
+            target = jnp.swapaxes(target, 1, 2).reshape(-1, target.shape[1])
+
+    if ignore_index is not None and reduce != "macro":
+        preds = _drop_column(preds, ignore_index)
+        target = _drop_column(target, ignore_index)
+
+    tp, fp, tn, fn = _stat_scores(preds, target, reduce=reduce)
+
+    if ignore_index is not None and reduce == "macro":
+        # ignored class statistics are reported as -1 (reference :116-120)
+        tp = tp.at[..., ignore_index].set(-1)
+        fp = fp.at[..., ignore_index].set(-1)
+        tn = tn.at[..., ignore_index].set(-1)
+        fn = fn.at[..., ignore_index].set(-1)
+
+    return tp, fp, tn, fn
+
+
+def _stat_scores_compute(tp: Array, fp: Array, tn: Array, fn: Array) -> Array:
+    outputs = jnp.stack([tp, fp, tn, fn, tp + fn], axis=-1)
+    return jnp.where(outputs < 0, -1, outputs)
+
+
+def stat_scores(
+    preds: Array,
+    target: Array,
+    reduce: str = "micro",
+    mdmc_reduce: Optional[str] = None,
+    num_classes: Optional[int] = None,
+    top_k: Optional[int] = None,
+    threshold: float = 0.5,
+    is_multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+) -> Array:
+    """Count tp/fp/tn/fn(+support) under micro/macro/samples reduction.
+
+    See reference ``stat_scores`` (:140-298) for the full semantics of
+    ``reduce``/``mdmc_reduce``/``ignore_index``; output is ``(..., 5)`` with
+    the last axis ``[tp, fp, tn, fn, support]``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([1, 0, 2, 1])
+        >>> target = jnp.array([1, 1, 2, 0])
+        >>> stat_scores(preds, target, reduce='macro', num_classes=3)
+        Array([[0, 1, 2, 1, 1],
+               [1, 1, 1, 1, 2],
+               [1, 0, 3, 0, 1]], dtype=int32)
+        >>> stat_scores(preds, target, reduce='micro')
+        Array([2, 2, 6, 2, 4], dtype=int32)
+    """
+    if reduce not in ["micro", "macro", "samples"]:
+        raise ValueError(f"The `reduce` {reduce} is not valid.")
+    if mdmc_reduce not in [None, "samplewise", "global"]:
+        raise ValueError(f"The `mdmc_reduce` {mdmc_reduce} is not valid.")
+    if reduce == "macro" and (not num_classes or num_classes < 1):
+        raise ValueError("When you set `reduce` as 'macro', you have to provide the number of classes.")
+    if num_classes and ignore_index is not None and (not 0 <= ignore_index < num_classes or num_classes == 1):
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+
+    tp, fp, tn, fn = _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_reduce,
+        top_k=top_k,
+        threshold=threshold,
+        num_classes=num_classes,
+        is_multiclass=is_multiclass,
+        ignore_index=ignore_index,
+    )
+    return _stat_scores_compute(tp, fp, tn, fn)

@@ -1,0 +1,16 @@
+from metrics_tpu.parallel.buffer import (
+    PaddedBuffer,
+    buffer_all_gather,
+    buffer_append,
+    buffer_init,
+    buffer_mask,
+    buffer_merge,
+    buffer_values,
+)
+from metrics_tpu.parallel.sync import (
+    gather_all_arrays,
+    host_gather,
+    merge_values,
+    sync_state,
+    sync_value,
+)

@@ -1,0 +1,119 @@
+"""Fixed-capacity padded buffers: the jit-safe representation of "cat" states.
+
+The reference stores curve/retrieval metric states as unbounded Python lists of
+tensors (e.g. AUROC cat-states, reference torchmetrics/classification/auroc.py:142-143)
+that are gathered with ``all_gather`` and flattened at compute
+(reference torchmetrics/metric.py:188-197). XLA requires static shapes, so the
+TPU-native equivalent is a pre-allocated ``(capacity, *item)`` buffer plus a
+scalar ``count`` — a pytree that can live inside ``jit``/``scan``/``shard_map``,
+be donated, and be all-gathered over a mesh axis with one collective.
+
+Overflow policy: ``count`` keeps the true number of appended rows; rows beyond
+``capacity`` are dropped on device. Host-side consumers (``values``) raise if
+``count > capacity`` so silent truncation can't corrupt a metric.
+"""
+from typing import NamedTuple, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+
+class PaddedBuffer(NamedTuple):
+    """A fixed-capacity append buffer. ``data``: (capacity, *item), ``count``: int32 scalar."""
+
+    data: Array
+    count: Array
+
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+
+def buffer_init(capacity: int, item_shape: Sequence[int] = (), dtype=jnp.float32) -> PaddedBuffer:
+    """Create an empty buffer with room for ``capacity`` rows of ``item_shape``."""
+    return PaddedBuffer(
+        data=jnp.zeros((capacity, *item_shape), dtype=dtype),
+        count=jnp.zeros((), dtype=jnp.int32),
+    )
+
+
+def buffer_append(buf: PaddedBuffer, batch: Array) -> PaddedBuffer:
+    """Append a ``(B, *item)`` batch. Jit-safe: B is static, offset is dynamic.
+
+    Rows that would land past ``capacity`` are dropped (scatter mode='drop');
+    ``count`` still advances so overflow is detectable at compute time.
+    """
+    batch = jnp.atleast_1d(batch)
+    n = batch.shape[0]
+    idx = buf.count + jnp.arange(n)
+    data = buf.data.at[idx].set(batch.astype(buf.data.dtype), mode="drop")
+    return PaddedBuffer(data=data, count=buf.count + n)
+
+
+def buffer_merge(a: PaddedBuffer, b: PaddedBuffer) -> PaddedBuffer:
+    """Concatenate ``b``'s valid rows after ``a``'s. Both keep ``a``'s capacity."""
+    arange = jnp.arange(b.data.shape[0])
+    valid = arange < b.count
+    # invalid rows are routed out-of-bounds and dropped by the scatter
+    idx = jnp.where(valid, a.count + arange, a.data.shape[0])
+    data = a.data.at[idx].set(b.data, mode="drop")
+    return PaddedBuffer(data=data, count=a.count + b.count)
+
+
+def buffer_all_gather(buf: PaddedBuffer, axis_name: str) -> PaddedBuffer:
+    """Gather per-device buffers over a mesh axis into one compacted buffer.
+
+    Jit-safe equivalent of the reference's gather+flatten of list states
+    (reference torchmetrics/metric.py:188-193). Result capacity = W * capacity;
+    valid rows of every device are compacted to the front in axis order.
+    """
+    data = jax.lax.all_gather(buf.data, axis_name)  # (W, cap, *item)
+    counts = jax.lax.all_gather(buf.count, axis_name)  # (W,)
+    world, cap = data.shape[0], data.shape[1]
+    clamped = jnp.minimum(counts, cap)
+    offsets = jnp.cumsum(clamped) - clamped  # exclusive prefix sum
+    row = jnp.arange(cap)
+    valid = row[None, :] < clamped[:, None]  # (W, cap)
+    dest = jnp.where(valid, offsets[:, None] + row[None, :], world * cap)
+    out = jnp.zeros((world * cap, *data.shape[2:]), dtype=data.dtype)
+    out = out.at[dest.reshape(-1)].set(data.reshape(world * cap, *data.shape[2:]), mode="drop")
+    return PaddedBuffer(data=out, count=jnp.sum(counts))
+
+
+def buffer_values(buf: PaddedBuffer) -> Array:
+    """Host-side: the valid rows as a dense array. Raises on overflow."""
+    count = int(buf.count)
+    if count > buf.capacity:
+        raise RuntimeError(
+            f"PaddedBuffer overflow: {count} rows appended into capacity {buf.capacity}. "
+            "Increase the metric's `capacity` argument."
+        )
+    return buf.data[:count]
+
+
+def buffer_mask(buf: PaddedBuffer) -> Array:
+    """Jit-safe validity mask of shape ``(capacity,)``."""
+    return jnp.arange(buf.data.shape[0]) < buf.count
+
+
+BufferOrList = Union[PaddedBuffer, list]
+
+
+def as_values(state_value: BufferOrList) -> Array:
+    """Dense values from either a PaddedBuffer or an eager list of arrays (host-side)."""
+    if isinstance(state_value, PaddedBuffer):
+        return buffer_values(state_value)
+    if isinstance(state_value, (list, tuple)):
+        from metrics_tpu.utils.data import dim_zero_cat
+
+        return dim_zero_cat(list(state_value))
+    return state_value
+
+
+def masked_values(state_value: BufferOrList) -> Tuple[Array, Array]:
+    """Jit-safe (data, mask) from a PaddedBuffer; eager lists become fully-valid."""
+    if isinstance(state_value, PaddedBuffer):
+        return state_value.data, buffer_mask(state_value)
+    vals = as_values(state_value)
+    return vals, jnp.ones(vals.shape[0], dtype=bool)

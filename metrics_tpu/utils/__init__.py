@@ -1,0 +1,16 @@
+from metrics_tpu.utils.data import (
+    apply_to_collection,
+    dim_zero_cat,
+    dim_zero_max,
+    dim_zero_mean,
+    dim_zero_min,
+    dim_zero_sum,
+    get_group_indexes,
+    get_num_classes,
+    select_topk,
+    to_categorical,
+    to_onehot,
+)
+from metrics_tpu.utils.enums import AverageMethod, DataType, MDMCAverageMethod
+from metrics_tpu.utils.prints import rank_zero_debug, rank_zero_info, rank_zero_warn
+from metrics_tpu.utils.reductions import class_reduce, reduce

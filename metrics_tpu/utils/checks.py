@@ -1,0 +1,315 @@
+"""Classification input normalization and validation.
+
+Behavioral parity target: reference ``torchmetrics/utilities/checks.py`` —
+``_input_format_classification`` (checks.py:306-445, full input-type taxonomy in
+its docstring) and ``_check_classification_inputs`` (checks.py:207-303).
+
+TPU-native split: the reference interleaves *shape/dtype* logic (static) with
+*value* logic (data-dependent raises, class-count inference from ``max()``).
+XLA traces once with abstract values, so here:
+
+* ``_resolve_case`` — the ``DataType`` taxonomy — depends only on ndim/dtype
+  and is evaluated at trace time (a direct consequence of the reference's own
+  rules at checks.py:87-112, which never look at values).
+* value validation (non-negative targets, probabilities in [0,1], label bounds,
+  rows-sum-to-1 — checks.py:29-57, 274-288) runs only on concrete arrays: on
+  by default in the eager API, automatically skipped under ``jit`` tracing.
+* class-count inference from data values (checks.py:426) is eager-only; under
+  tracing, ``num_classes`` must be passed statically.
+"""
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.data import is_concrete, select_topk, to_onehot
+from metrics_tpu.utils.enums import DataType
+from metrics_tpu.utils.exceptions import TracingUnsupportedError
+
+
+def _check_same_shape(preds: Array, target: Array) -> None:
+    if preds.shape != target.shape:
+        raise RuntimeError("Predictions and targets are expected to have the same shape")
+
+
+def _is_float(x: Array) -> bool:
+    return jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def _squeeze_excess_dims(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Drop all size-1 dims except a size-1 leading batch dim (checks.py:394-398)."""
+    if preds.shape and preds.shape[0] == 1:
+        return jnp.expand_dims(jnp.squeeze(preds), 0), jnp.expand_dims(jnp.squeeze(target), 0)
+    return jnp.squeeze(preds), jnp.squeeze(target)
+
+
+def _resolve_case(preds: Array, target: Array) -> Tuple[DataType, int]:
+    """Static (shape/dtype-only) resolution of the input case + implied classes.
+
+    Mirrors the decision table of reference checks.py:60-119.
+    """
+    preds_float = _is_float(preds)
+    if _is_float(target):
+        raise ValueError("The `target` has to be an integer tensor.")
+
+    if preds.ndim == target.ndim:
+        if preds.shape != target.shape:
+            raise ValueError(
+                "The `preds` and `target` should have the same shape,"
+                f" got `preds` with shape={preds.shape} and `target` with shape={target.shape}."
+            )
+        if preds.ndim == 1 and preds_float:
+            case = DataType.BINARY
+        elif preds.ndim == 1 and not preds_float:
+            case = DataType.MULTICLASS
+        elif preds.ndim > 1 and preds_float:
+            case = DataType.MULTILABEL
+        else:
+            case = DataType.MULTIDIM_MULTICLASS
+        implied_classes = int(jnp.prod(jnp.asarray(preds.shape[1:]))) if preds.ndim > 1 else 1
+    elif preds.ndim == target.ndim + 1:
+        if not preds_float:
+            raise ValueError("If `preds` have one dimension more than `target`, `preds` should be a float tensor.")
+        if preds.shape[2:] != target.shape[1:]:
+            raise ValueError(
+                "If `preds` have one dimension more than `target`, the shape of `preds` should be"
+                " (N, C, ...), and the shape of `target` should be (N, ...)."
+            )
+        implied_classes = preds.shape[1]
+        case = DataType.MULTICLASS if preds.ndim == 2 else DataType.MULTIDIM_MULTICLASS
+    else:
+        raise ValueError(
+            "Either `preds` and `target` both should have the (same) shape (N, ...), or `target` should be (N, ...)"
+            " and `preds` should be (N, C, ...)."
+        )
+    return case, implied_classes
+
+
+def _validate_values(
+    preds: Array,
+    target: Array,
+    case: DataType,
+    implied_classes: int,
+    threshold: float,
+    num_classes: Optional[int],
+    is_multiclass: Optional[bool],
+) -> None:
+    """Value-dependent validation — concrete arrays only (reference checks.py:29-57, 81-84, 274-288)."""
+    preds_float = _is_float(preds)
+    if int(jnp.min(target)) < 0:
+        raise ValueError("The `target` has to be a non-negative tensor.")
+    if not preds_float and int(jnp.min(preds)) < 0:
+        raise ValueError("If `preds` are integers, they have to be non-negative.")
+    if preds_float and (float(jnp.min(preds)) < 0 or float(jnp.max(preds)) > 1):
+        raise ValueError("The `preds` should be probabilities, but values were detected outside of [0,1] range.")
+    if is_multiclass is False and int(jnp.max(target)) > 1:
+        raise ValueError("If you set `is_multiclass=False`, then `target` should not exceed 1.")
+    if is_multiclass is False and not preds_float and int(jnp.max(preds)) > 1:
+        raise ValueError("If you set `is_multiclass=False` and `preds` are integers, then `preds` should not exceed 1.")
+    if preds.ndim == target.ndim and preds_float and int(jnp.max(target)) > 1:
+        raise ValueError(
+            "If `preds` and `target` are of shape (N, ...) and `preds` are floats, `target` should be binary."
+        )
+    if case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) and preds_float:
+        if not bool(jnp.all(jnp.isclose(jnp.sum(preds, axis=1), 1.0))):
+            raise ValueError("Probabilities in `preds` must sum up to 1 across the `C` dimension.")
+    if preds.shape != target.shape:
+        if int(jnp.max(target)) >= implied_classes:
+            raise ValueError(
+                "The highest label in `target` should be smaller than the size of the `C` dimension of `preds`."
+            )
+    if num_classes and num_classes > 1 and case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS):
+        if num_classes <= int(jnp.max(target)):
+            raise ValueError("The highest label in `target` should be smaller than `num_classes`.")
+        if not preds_float and num_classes <= int(jnp.max(preds)):
+            raise ValueError("The highest label in `preds` should be smaller than `num_classes`.")
+
+
+def _validate_static(
+    case: DataType,
+    implied_classes: int,
+    preds_float: bool,
+    threshold: float,
+    num_classes: Optional[int],
+    is_multiclass: Optional[bool],
+    top_k: Optional[int],
+) -> None:
+    """Shape/arg consistency checks that need no data values
+    (reference checks.py:122-204, 280-301)."""
+    if not 0 < threshold < 1:
+        raise ValueError(f"The `threshold` should be a float in the (0,1) interval, got {threshold}")
+
+    if num_classes:
+        if case == DataType.BINARY:
+            if num_classes > 2:
+                raise ValueError("Your data is binary, but `num_classes` is larger than 2.")
+            if num_classes == 2 and not is_multiclass:
+                raise ValueError(
+                    "Your data is binary and `num_classes=2`, but `is_multiclass` is not True."
+                    " Set it to True if you want to transform binary data to multi-class format."
+                )
+            if num_classes == 1 and is_multiclass:
+                raise ValueError(
+                    "You have binary data and have set `is_multiclass=True`, but `num_classes` is 1."
+                    " Either set `is_multiclass=None`(default) or set `num_classes=2`"
+                    " to transform binary data to multi-class format."
+                )
+        elif case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS):
+            if num_classes == 1 and is_multiclass is not False:
+                raise ValueError(
+                    "You have set `num_classes=1`, but predictions are integers."
+                    " If you want to convert (multi-dimensional) multi-class data with 2 classes"
+                    " to binary/multi-label, set `is_multiclass=False`."
+                )
+            if num_classes > 1:
+                if is_multiclass is False and implied_classes != num_classes:
+                    raise ValueError(
+                        "You have set `is_multiclass=False`, but the implied number of classes "
+                        " (from shape of inputs) does not match `num_classes`."
+                    )
+                if preds_float and implied_classes > 1 and num_classes != implied_classes:
+                    raise ValueError("The size of C dimension of `preds` does not match `num_classes`.")
+        elif case == DataType.MULTILABEL:
+            if is_multiclass and num_classes != 2:
+                raise ValueError(
+                    "Your have set `is_multiclass=True`, but `num_classes` is not equal to 2."
+                    " If you are trying to transform multi-label data to 2 class multi-dimensional"
+                    " multi-class, you should set `num_classes` to either 2 or None."
+                )
+            if not is_multiclass and num_classes != implied_classes:
+                raise ValueError("The implied number of classes (from shape of inputs) does not match num_classes.")
+
+    if top_k is not None:
+        if case == DataType.BINARY:
+            raise ValueError("You can not use `top_k` parameter with binary data.")
+        if not isinstance(top_k, int) or top_k <= 0:
+            raise ValueError("The `top_k` has to be an integer larger than 0.")
+        if not preds_float:
+            raise ValueError("You have set `top_k`, but you do not have probability predictions.")
+        if is_multiclass is False:
+            raise ValueError("If you set `is_multiclass=False`, you can not set `top_k`.")
+        if case == DataType.MULTILABEL and is_multiclass:
+            raise ValueError(
+                "If you want to transform multi-label data to 2 class multi-dimensional"
+                "multi-class data using `is_multiclass=True`, you can not use `top_k`."
+            )
+        if top_k >= implied_classes:
+            raise ValueError("The `top_k` has to be strictly smaller than the `C` dimension of `preds`.")
+
+
+def _check_classification_inputs(
+    preds: Array,
+    target: Array,
+    threshold: float,
+    num_classes: Optional[int],
+    is_multiclass: Optional[bool],
+    top_k: Optional[int],
+) -> DataType:
+    """Full validation; returns the resolved case. Value checks run only on
+    concrete (non-traced) inputs — reference ``_check_classification_inputs``
+    (checks.py:207-303)."""
+    if preds.shape[:1] != target.shape[:1]:
+        raise ValueError("The `preds` and `target` should have the same first dimension.")
+    case, implied_classes = _resolve_case(preds, target)
+    _validate_static(case, implied_classes, _is_float(preds), threshold, num_classes, is_multiclass, top_k)
+    if is_concrete(preds) and is_concrete(target):
+        _validate_values(preds, target, case, implied_classes, threshold, num_classes, is_multiclass)
+    return case
+
+
+def _input_format_classification(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    is_multiclass: Optional[bool] = None,
+    validate: bool = True,
+) -> Tuple[Array, Array, DataType]:
+    """Normalize any (preds, target) pair into binary int arrays ``(N, C)`` or
+    ``(N, C, X)`` plus the resolved :class:`DataType` case.
+
+    Behavioral contract identical to reference checks.py:306-445 (see its
+    docstring for the full taxonomy). Jit-safe whenever ``num_classes`` is
+    given or implied by a ``C`` dim; value validation auto-skips under tracing.
+    """
+    preds, target = _squeeze_excess_dims(jnp.asarray(preds), jnp.asarray(target))
+
+    # accumulate/compare in fp32 (reference upcasts fp16, checks.py:402-403; we also upcast bf16)
+    if preds.dtype in (jnp.float16, jnp.bfloat16):
+        preds = preds.astype(jnp.float32)
+
+    if validate:
+        case = _check_classification_inputs(
+            preds, target, threshold=threshold, num_classes=num_classes, is_multiclass=is_multiclass, top_k=top_k
+        )
+    else:
+        case, _ = _resolve_case(preds, target)
+
+    preds_float = _is_float(preds)
+
+    if case in (DataType.BINARY, DataType.MULTILABEL) and not top_k:
+        preds = (preds >= threshold).astype(jnp.int32) if preds_float else preds.astype(jnp.int32)
+        num_classes = num_classes if not is_multiclass else 2
+
+    if case == DataType.MULTILABEL and top_k:
+        preds = select_topk(preds, top_k)
+
+    if case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) or is_multiclass:
+        if _is_float(preds):
+            num_classes = preds.shape[1]
+            preds = select_topk(preds, top_k or 1)
+        else:
+            if num_classes is None:
+                if not (is_concrete(preds) and is_concrete(target)):
+                    raise TracingUnsupportedError(
+                        "Inferring `num_classes` from data values is not possible under jit "
+                        "tracing — pass `num_classes` explicitly."
+                    )
+                num_classes = int(max(jnp.max(preds), jnp.max(target))) + 1
+            preds = to_onehot(preds, max(2, num_classes))
+
+        target = to_onehot(target, max(2, num_classes))
+
+        if is_multiclass is False:
+            preds, target = preds[:, 1, ...], target[:, 1, ...]
+
+    if (case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) and is_multiclass is not False) or is_multiclass:
+        target = target.reshape(target.shape[0], target.shape[1], -1)
+        preds = preds.reshape(preds.shape[0], preds.shape[1], -1)
+    else:
+        target = target.reshape(target.shape[0], -1)
+        preds = preds.reshape(preds.shape[0], -1)
+
+    # undo the trailing singleton the (N, C, -1) reshape adds for non-multidim data
+    if preds.ndim > 2 and preds.shape[-1] == 1:
+        preds, target = preds.squeeze(-1), target.squeeze(-1)
+
+    return preds.astype(jnp.int32), target.astype(jnp.int32), case
+
+
+def _input_format_classification_one_hot(
+    num_classes: int,
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    multilabel: bool = False,
+) -> Tuple[Array, Array]:
+    """Convert inputs to one-hot ``(C, N*...)`` layout (reference checks.py:448-494)."""
+    if not (preds.ndim == target.ndim or preds.ndim == target.ndim + 1):
+        raise ValueError("preds and target must have same number of dimensions, or one additional dimension for preds")
+
+    if preds.ndim == target.ndim + 1:
+        preds = jnp.argmax(preds, axis=1)
+
+    if preds.ndim == target.ndim and jnp.issubdtype(preds.dtype, jnp.integer) and num_classes > 1 and not multilabel:
+        preds = to_onehot(preds, num_classes=num_classes)
+        target = to_onehot(target, num_classes=num_classes)
+    elif preds.ndim == target.ndim and _is_float(preds):
+        preds = (preds >= threshold).astype(jnp.int32)
+
+    if preds.ndim > 1:
+        preds = jnp.swapaxes(preds, 1, 0)
+        target = jnp.swapaxes(target, 1, 0)
+
+    return preds.reshape(num_classes, -1), target.reshape(num_classes, -1)

@@ -1,0 +1,191 @@
+"""Tensor/data transforms shared by the functional kernels.
+
+Parity targets (behavior, not code) in reference ``torchmetrics/utilities/data.py``:
+``dim_zero_cat/sum/mean`` (data.py:24-38), ``to_onehot`` (:41-74),
+``select_topk`` (:77-98), ``to_categorical`` (:101-118), ``get_num_classes``
+(:121-150), ``apply_to_collection`` (:182-230), ``get_group_indexes`` (:233-259).
+
+TPU-native differences:
+ - one-hot / top-k are built from ``jax.nn.one_hot`` / ``jax.lax.top_k``
+   (gather/scatter-free, MXU/VPU friendly) instead of ``Tensor.scatter_``.
+ - ``_stable_1d_sort`` (reference data.py:153-179) is intentionally absent:
+   XLA's sort is stable, so callers just use ``jnp.sort``/``jnp.argsort``.
+ - class-count inference from data values is an eager-only convenience; under
+   ``jax.jit`` tracing callers must pass ``num_classes`` statically.
+"""
+from typing import Any, Callable, List, Mapping, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+def dim_zero_cat(x: Union[Array, List[Array]]) -> Array:
+    """Concatenate a (list of) array(s) along dim 0."""
+    x = x if isinstance(x, (list, tuple)) else [x]
+    x = [jnp.atleast_1d(v) for v in x]
+    return jnp.concatenate(x, axis=0)
+
+
+def dim_zero_sum(x: Array) -> Array:
+    return jnp.sum(x, axis=0)
+
+
+def dim_zero_mean(x: Array) -> Array:
+    return jnp.mean(x, axis=0)
+
+
+def dim_zero_min(x: Array) -> Array:
+    return jnp.min(x, axis=0)
+
+
+def dim_zero_max(x: Array) -> Array:
+    return jnp.max(x, axis=0)
+
+
+def _flatten(x: Sequence) -> list:
+    return [item for sublist in x for item in sublist]
+
+
+def is_concrete(x: Any) -> bool:
+    """True when ``x`` is a concrete (non-traced) array whose values are readable."""
+    return not isinstance(x, jax.core.Tracer)
+
+
+def accum_int_dtype():
+    """Dtype for count-accumulator states: int64 when x64 is enabled, else int32.
+
+    The reference accumulates counts in int64 (torch ``.long()``); JAX
+    canonicalizes int64 away unless ``jax_enable_x64`` is set. Pod-scale
+    element counts (>2^31) therefore need ``jax.config.update("jax_enable_x64",
+    True)`` — with it on, all accumulator states get full int64 parity.
+    """
+    return jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+
+
+def to_onehot(label_tensor: Array, num_classes: Optional[int] = None) -> Array:
+    """Convert an ``(N, ...)`` integer label array to a one-hot ``(N, C, ...)`` array.
+
+    Mirrors reference ``to_onehot`` (data.py:41-74) incl. inferring ``C`` from
+    ``label_tensor.max()+1`` when unset — that inference is eager-only.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> to_onehot(jnp.array([0, 1, 2]), num_classes=3)
+        Array([[1, 0, 0],
+               [0, 1, 0],
+               [0, 0, 1]], dtype=int32)
+    """
+    if num_classes is None:
+        if not is_concrete(label_tensor):
+            raise ValueError(
+                "`num_classes` must be given explicitly when tracing under jit; "
+                "inference from data values requires concrete arrays."
+            )
+        num_classes = int(jnp.max(label_tensor)) + 1
+    onehot = jax.nn.one_hot(label_tensor, num_classes, dtype=jnp.int32)
+    # (N, ..., C) -> (N, C, ...)
+    return jnp.moveaxis(onehot, -1, 1)
+
+
+def select_topk(prob_tensor: Array, topk: int = 1, dim: int = 1) -> Array:
+    """Binary array with 1s at the ``topk`` largest entries along ``dim``.
+
+    Mirrors reference ``select_topk`` (data.py:77-98).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> x = jnp.array([[1.1, 2.0, 3.0], [2.0, 1.0, 0.5]])
+        >>> select_topk(x, topk=2)
+        Array([[0, 1, 1],
+               [1, 1, 0]], dtype=int32)
+    """
+    moved = jnp.moveaxis(prob_tensor, dim, -1)
+    _, idx = jax.lax.top_k(moved, topk)
+    onehot = jax.nn.one_hot(idx, moved.shape[-1], dtype=jnp.int32).sum(axis=-2)
+    return jnp.moveaxis(onehot, -1, dim).astype(jnp.int32)
+
+
+def to_categorical(tensor: Array, argmax_dim: int = 1) -> Array:
+    """Argmax along ``argmax_dim`` (reference data.py:101-118).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> to_categorical(jnp.array([[0.2, 0.5], [0.9, 0.1]]))
+        Array([1, 0], dtype=int32)
+    """
+    return jnp.argmax(tensor, axis=argmax_dim).astype(jnp.int32)
+
+
+def get_num_classes(preds: Array, target: Array, num_classes: Optional[int] = None) -> int:
+    """Infer/validate the number of classes (reference data.py:121-150). Eager-only inference."""
+    if num_classes is None and not (is_concrete(preds) and is_concrete(target)):
+        raise ValueError("`num_classes` must be given explicitly when tracing under jit.")
+    if num_classes is None:
+        num_pred_classes = int(jnp.max(preds)) + 1
+        num_target_classes = int(jnp.max(target)) + 1
+        num_classes = max(num_pred_classes, num_target_classes)
+    elif is_concrete(preds) and is_concrete(target):
+        num_target_classes = int(jnp.max(target)) + 1
+        num_pred_classes = int(jnp.max(preds)) + 1 if jnp.issubdtype(preds.dtype, jnp.integer) else num_classes
+        if num_classes != max(num_pred_classes, num_target_classes):
+            rank_zero_warn(
+                f"You have set {num_classes} number of classes which is"
+                f" different from predicted ({num_pred_classes}) and"
+                f" target ({num_target_classes}) number of classes",
+                RuntimeWarning,
+            )
+    return num_classes
+
+
+def apply_to_collection(
+    data: Any,
+    dtype: Union[type, tuple],
+    function: Callable,
+    *args: Any,
+    **kwargs: Any,
+) -> Any:
+    """Recursively apply ``function`` to all elements of type ``dtype`` in a collection.
+
+    Mirrors reference ``apply_to_collection`` (data.py:182-230).
+
+    Example:
+        >>> apply_to_collection({"a": 2, "b": [1, 2]}, int, lambda x: x * 2)
+        {'a': 4, 'b': [2, 4]}
+    """
+    elem_type = type(data)
+
+    if isinstance(data, dtype):
+        return function(data, *args, **kwargs)
+
+    if isinstance(data, Mapping):
+        return elem_type({k: apply_to_collection(v, dtype, function, *args, **kwargs) for k, v in data.items()})
+
+    if isinstance(data, tuple) and hasattr(data, "_fields"):  # namedtuple
+        return elem_type(*(apply_to_collection(d, dtype, function, *args, **kwargs) for d in data))
+
+    if isinstance(data, Sequence) and not isinstance(data, str):
+        return elem_type([apply_to_collection(d, dtype, function, *args, **kwargs) for d in data])
+
+    return data
+
+
+def get_group_indexes(idx: Array) -> List[Array]:
+    """Group positions by the value of ``idx`` (reference data.py:233-259).
+
+    Eager/host-side for API parity; the TPU retrieval path avoids this entirely
+    by using sorted segment ops (see ``metrics_tpu/functional/retrieval``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> [g.tolist() for g in get_group_indexes(jnp.array([0, 0, 1, 1, 1]))]
+        [[0, 1], [2, 3, 4]]
+    """
+    idx_np = np.asarray(idx)
+    res: dict = {}
+    for i, v in enumerate(idx_np.tolist()):
+        res.setdefault(v, []).append(i)
+    return [jnp.asarray(g, dtype=jnp.int32) for g in res.values()]

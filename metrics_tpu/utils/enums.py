@@ -1,0 +1,53 @@
+"""String-valued enums shared across the library.
+
+Behavioral parity target: reference ``torchmetrics/utilities/enums.py``
+(``EnumStr`` at enums.py:18, ``DataType`` at :48, ``AverageMethod`` at :61,
+``MDMCAverageMethod`` at :79) — re-designed, not copied: these are plain
+``str`` subclass enums with case/space/dash-insensitive lookup.
+"""
+from enum import Enum
+from typing import Optional, Union
+
+
+class EnumStr(str, Enum):
+    """String enum with forgiving lookup: case-insensitive, '-'/' ' treated as '_'."""
+
+    @classmethod
+    def from_str(cls, value: str) -> Optional["EnumStr"]:
+        try:
+            return cls[value.replace("-", "_").replace(" ", "_").upper()]
+        except KeyError:
+            return None
+
+    def __eq__(self, other: Union[str, Enum, None]) -> bool:
+        other = other.value if isinstance(other, Enum) else str(other)
+        return self.value.lower() == other.lower()
+
+    def __hash__(self) -> int:
+        return hash(self.value.lower())
+
+
+class DataType(EnumStr):
+    """Classification input-type taxonomy (reference enums.py:48-58)."""
+
+    BINARY = "binary"
+    MULTILABEL = "multi-label"
+    MULTICLASS = "multi-class"
+    MULTIDIM_MULTICLASS = "multi-dim multi-class"
+
+
+class AverageMethod(EnumStr):
+    """Averaging strategies for per-class scores (reference enums.py:61-76)."""
+
+    MICRO = "micro"
+    MACRO = "macro"
+    WEIGHTED = "weighted"
+    NONE = "none"
+    SAMPLES = "samples"
+
+
+class MDMCAverageMethod(EnumStr):
+    """Multi-dim multi-class handling (reference enums.py:79-83)."""
+
+    GLOBAL = "global"
+    SAMPLEWISE = "samplewise"

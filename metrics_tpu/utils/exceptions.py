@@ -1,0 +1,9 @@
+"""Exception types for metrics_tpu."""
+
+
+class MetricsTPUError(Exception):
+    """Base class for library errors."""
+
+
+class TracingUnsupportedError(MetricsTPUError):
+    """Raised when a value-dependent operation is attempted under jit tracing."""

@@ -1,0 +1,51 @@
+"""Score reduction helpers.
+
+Parity targets: ``reduce`` (reference torchmetrics/utilities/distributed.py:20-40)
+and ``class_reduce`` (:43-88). They live in ``utils`` here — in the TPU build the
+``parallel`` package is reserved for actual cross-device communication.
+"""
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.enums import AverageMethod
+
+
+def reduce(to_reduce: Array, reduction: str) -> Array:
+    """Reduce a tensor: ``'elementwise_mean'`` | ``'sum'`` | ``'none'``/None."""
+    if reduction == "elementwise_mean":
+        return jnp.mean(to_reduce)
+    if reduction == "none" or reduction is None:
+        return to_reduce
+    if reduction == "sum":
+        return jnp.sum(to_reduce)
+    raise ValueError("Reduction parameter unknown.")
+
+
+def class_reduce(num: Array, denom: Array, weights: Array, class_reduction: str = "none") -> Array:
+    """Reduce per-class scores ``num/denom``: micro | macro | weighted | none.
+
+    NaN-free by construction: 0/0 entries become 0, exactly as the reference's
+    ``fraction[fraction != fraction] = 0`` guard does for every reduction mode.
+    """
+    valid_reduction = ("micro", "macro", "weighted", "none", None)
+    fraction = jnp.sum(num) / jnp.sum(denom) if class_reduction == "micro" else num / denom
+
+    # nan-guard: 0/0 becomes 0 (applies to micro as well, reference distributed.py:74)
+    fraction = jnp.where(jnp.isnan(fraction), jnp.zeros_like(fraction), fraction)
+
+    if class_reduction == "micro":
+        return fraction
+    if class_reduction == "macro":
+        return jnp.mean(fraction)
+    if class_reduction == "weighted":
+        return jnp.sum(fraction * (weights / jnp.sum(weights)))
+    if class_reduction == "none" or class_reduction is None:
+        return fraction
+
+    raise ValueError(f"Reduction parameter {class_reduction} unknown. Choose between one of these: {valid_reduction}")
+
+
+# re-export averaging enum for convenience
+__all__ = ["reduce", "class_reduce", "AverageMethod"]

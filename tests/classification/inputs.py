@@ -1,0 +1,79 @@
+"""Deterministic classification fixtures covering the full input taxonomy
+(mirrors reference tests/classification/inputs.py:22-79, numpy instead of torch)."""
+from collections import namedtuple
+
+import numpy as np
+
+from tests.helpers.testers import BATCH_SIZE, EXTRA_DIM, NUM_BATCHES, NUM_CLASSES
+
+Input = namedtuple("Input", ["preds", "target"])
+
+_rng = np.random.RandomState(42)
+
+
+def _rand(*shape):
+    return _rng.rand(*shape).astype(np.float32)
+
+
+def _randint(high, shape):
+    return _rng.randint(0, high, size=shape).astype(np.int32)
+
+
+_input_binary_prob = Input(
+    preds=_rand(NUM_BATCHES, BATCH_SIZE), target=_randint(2, (NUM_BATCHES, BATCH_SIZE))
+)
+
+_input_binary = Input(
+    preds=_randint(2, (NUM_BATCHES, BATCH_SIZE)),
+    target=_randint(2, (NUM_BATCHES, BATCH_SIZE)),
+)
+
+_input_multilabel_prob = Input(
+    preds=_rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES),
+    target=_randint(2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+)
+
+_input_multilabel_multidim_prob = Input(
+    preds=_rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM),
+    target=_randint(2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)),
+)
+
+_input_multilabel = Input(
+    preds=_randint(2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+    target=_randint(2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+)
+
+_input_multilabel_multidim = Input(
+    preds=_randint(2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)),
+    target=_randint(2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)),
+)
+
+# multilabel edge case where nothing matches (scores are undefined)
+__temp_preds = _randint(2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES))
+__temp_target = np.abs(__temp_preds - 1)
+
+_input_multilabel_no_match = Input(preds=__temp_preds, target=__temp_target)
+
+__mc_prob_preds = _rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)
+__mc_prob_preds = __mc_prob_preds / __mc_prob_preds.sum(axis=2, keepdims=True)
+
+_input_multiclass_prob = Input(
+    preds=__mc_prob_preds, target=_randint(NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+)
+
+_input_multiclass = Input(
+    preds=_randint(NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+    target=_randint(NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+)
+
+__mdmc_prob_preds = _rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)
+__mdmc_prob_preds = __mdmc_prob_preds / __mdmc_prob_preds.sum(axis=2, keepdims=True)
+
+_input_multidim_multiclass_prob = Input(
+    preds=__mdmc_prob_preds, target=_randint(NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM))
+)
+
+_input_multidim_multiclass = Input(
+    preds=_randint(NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)),
+    target=_randint(NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)),
+)

@@ -1,0 +1,135 @@
+"""Accuracy vs sklearn oracle (mirrors reference tests/classification/test_accuracy.py)."""
+import numpy as np
+import pytest
+from sklearn.metrics import accuracy_score as sk_accuracy
+
+from metrics_tpu import Accuracy
+from metrics_tpu.functional import accuracy
+from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.enums import DataType
+from tests.classification.inputs import (
+    _input_binary,
+    _input_binary_prob,
+    _input_multiclass,
+    _input_multiclass_prob,
+    _input_multidim_multiclass,
+    _input_multidim_multiclass_prob,
+    _input_multilabel,
+    _input_multilabel_multidim,
+    _input_multilabel_multidim_prob,
+    _input_multilabel_prob,
+)
+from tests.helpers.testers import THRESHOLD, MetricTester
+
+
+def _sk_accuracy(preds, target, subset_accuracy):
+    # shape inputs for sklearn with the library's own formatting (reference test_accuracy.py:40-52)
+    sk_preds, sk_target, mode = _input_format_classification(preds, target, threshold=THRESHOLD)
+    sk_preds, sk_target = np.asarray(sk_preds), np.asarray(sk_target)
+
+    if mode == DataType.MULTIDIM_MULTICLASS and not subset_accuracy:
+        sk_preds, sk_target = np.moveaxis(sk_preds, 1, -1).reshape(-1, sk_preds.shape[1]), np.moveaxis(
+            sk_target, 1, -1
+        ).reshape(-1, sk_target.shape[1])
+    elif mode == DataType.MULTIDIM_MULTICLASS and subset_accuracy:
+        return np.mean((sk_preds == sk_target).all(axis=(1, 2)))
+    elif mode == DataType.MULTILABEL and not subset_accuracy:
+        sk_preds, sk_target = sk_preds.reshape(-1), sk_target.reshape(-1)
+
+    return sk_accuracy(y_true=sk_target, y_pred=sk_preds)
+
+
+@pytest.mark.parametrize(
+    "preds, target, subset_accuracy",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target, False),
+        (_input_binary.preds, _input_binary.target, False),
+        (_input_multilabel_prob.preds, _input_multilabel_prob.target, True),
+        (_input_multilabel_prob.preds, _input_multilabel_prob.target, False),
+        (_input_multilabel.preds, _input_multilabel.target, True),
+        (_input_multilabel.preds, _input_multilabel.target, False),
+        (_input_multiclass_prob.preds, _input_multiclass_prob.target, False),
+        (_input_multiclass.preds, _input_multiclass.target, False),
+        (_input_multidim_multiclass_prob.preds, _input_multidim_multiclass_prob.target, False),
+        (_input_multidim_multiclass_prob.preds, _input_multidim_multiclass_prob.target, True),
+        (_input_multidim_multiclass.preds, _input_multidim_multiclass.target, False),
+        (_input_multidim_multiclass.preds, _input_multidim_multiclass.target, True),
+        (_input_multilabel_multidim_prob.preds, _input_multilabel_multidim_prob.target, False),
+        (_input_multilabel_multidim.preds, _input_multilabel_multidim.target, False),
+    ],
+)
+class TestAccuracies(MetricTester):
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("dist_sync_on_step", [False, True])
+    def test_accuracy_class(self, ddp, dist_sync_on_step, preds, target, subset_accuracy):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=Accuracy,
+            sk_metric=lambda p, t: _sk_accuracy(p, t, subset_accuracy),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={"threshold": THRESHOLD, "subset_accuracy": subset_accuracy},
+        )
+
+    def test_accuracy_fn(self, preds, target, subset_accuracy):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=accuracy,
+            sk_metric=lambda p, t: _sk_accuracy(p, t, subset_accuracy),
+            metric_args={"threshold": THRESHOLD, "subset_accuracy": subset_accuracy},
+        )
+
+
+_l1to4 = [0.1, 0.2, 0.3, 0.4]
+_l1to4t3 = np.array([_l1to4, _l1to4, _l1to4])
+_l1to4t3_mcls = [_l1to4t3.T, _l1to4t3.T, _l1to4t3.T]
+
+# preds always rank classes 3 > 2 > 1 > 0 (reference test_accuracy.py:107-118)
+_topk_preds_mcls = np.array([_l1to4t3, _l1to4t3], dtype=np.float32)
+_topk_target_mcls = np.array([[1, 2, 3], [2, 1, 0]])
+
+_topk_preds_mdmc = np.array([_l1to4t3_mcls, _l1to4t3_mcls], dtype=np.float32)
+_topk_target_mdmc = np.array([[[1, 1, 0], [2, 2, 2], [3, 3, 3]], [[2, 2, 0], [1, 1, 1], [0, 0, 0]]])
+
+
+@pytest.mark.parametrize(
+    "preds, target, exp_result, k, subset_accuracy",
+    [
+        (_topk_preds_mcls, _topk_target_mcls, 1 / 6, 1, False),
+        (_topk_preds_mcls, _topk_target_mcls, 3 / 6, 2, False),
+        (_topk_preds_mcls, _topk_target_mcls, 5 / 6, 3, False),
+        (_topk_preds_mcls, _topk_target_mcls, 1 / 6, 1, True),
+        (_topk_preds_mcls, _topk_target_mcls, 3 / 6, 2, True),
+        (_topk_preds_mcls, _topk_target_mcls, 5 / 6, 3, True),
+        (_topk_preds_mdmc, _topk_target_mdmc, 1 / 6, 1, False),
+        (_topk_preds_mdmc, _topk_target_mdmc, 8 / 18, 2, False),
+        (_topk_preds_mdmc, _topk_target_mdmc, 13 / 18, 3, False),
+        (_topk_preds_mdmc, _topk_target_mdmc, 1 / 6, 1, True),
+        (_topk_preds_mdmc, _topk_target_mdmc, 2 / 6, 2, True),
+        (_topk_preds_mdmc, _topk_target_mdmc, 3 / 6, 3, True),
+    ],
+)
+def test_topk_accuracy(preds, target, exp_result, k, subset_accuracy):
+    """top-k accuracy on crafted examples (reference test_accuracy.py:121-155)."""
+    import jax.numpy as jnp
+
+    topk = Accuracy(top_k=k, subset_accuracy=subset_accuracy)
+
+    for batch in range(preds.shape[0]):
+        topk(jnp.asarray(preds[batch]), jnp.asarray(target[batch]))
+
+    assert np.isclose(float(topk.compute()), exp_result)
+
+    total_samples = target.shape[0] * target.shape[1]
+    preds_flat = jnp.asarray(preds.reshape(total_samples, 4, -1))
+    target_flat = jnp.asarray(target.reshape(total_samples, -1))
+    assert np.isclose(float(accuracy(preds_flat, target_flat, top_k=k, subset_accuracy=subset_accuracy)), exp_result)
+
+
+@pytest.mark.parametrize("threshold", [0.0, 1.5])
+def test_wrong_threshold(threshold):
+    with pytest.raises(ValueError):
+        Accuracy(threshold=threshold)

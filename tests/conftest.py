@@ -1,0 +1,29 @@
+"""Test harness configuration: 8 fake CPU devices.
+
+The reference tests multi-node without a cluster via a 2-process Gloo group
+(reference tests/helpers/testers.py:41-47). The TPU build's analogue is an
+8-device virtual CPU mesh: collectives run through the same XLA code paths as
+on a real TPU slice, just on host devices.
+
+NOTE: the axon TPU plugin ignores the JAX_PLATFORMS env var, so we force the
+CPU platform through jax.config before any backend is initialized.
+"""
+import os
+
+# must be set before the CPU client is created
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devices = jax.devices()
+    assert len(devices) == 8, f"expected 8 fake CPU devices, got {len(devices)}"
+    return devices

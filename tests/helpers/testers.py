@@ -1,0 +1,239 @@
+"""Metric test harness.
+
+Port of the reference harness semantics (reference tests/helpers/testers.py:76-291)
+to the TPU build:
+
+* "Distributed" testing runs a **simulated N-rank world in one process**: each
+  rank is a metric instance (fed rank-strided batches) driven by its own
+  thread, and the host-plane gather (``dist_sync_fn``) is a barrier +
+  read-all-ranks — semantically the reference's barrier + all_gather
+  (reference torchmetrics/utilities/distributed.py:115-116), which its tests
+  exercised with a 2-process Gloo group (testers.py:41-47). Real-collective
+  coverage of the in-jit plane lives in ``tests/parallel/`` via ``shard_map``
+  over 8 fake CPU devices.
+* sklearn remains the numerical oracle; default ``atol=1e-8``
+  (reference testers.py:185).
+* Metrics are pickled and restored before use (reference testers.py:117-118).
+"""
+import pickle
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.parallel.buffer import PaddedBuffer
+
+NUM_PROCESSES = 2
+NUM_BATCHES = 10
+BATCH_SIZE = 32
+NUM_CLASSES = 5
+EXTRA_DIM = 3
+THRESHOLD = 0.5
+
+_BARRIER_TIMEOUT = 60.0
+
+
+def _assert_allclose(jax_result: Any, sk_result: Any, atol: float = 1e-8) -> None:
+    if isinstance(jax_result, (list, tuple)):
+        assert len(jax_result) == len(sk_result)
+        for j, s in zip(jax_result, sk_result):
+            _assert_allclose(j, s, atol=atol)
+        return
+    if isinstance(jax_result, dict):
+        for key in jax_result:
+            _assert_allclose(jax_result[key], sk_result[key], atol=atol)
+        return
+    np.testing.assert_allclose(np.asarray(jax_result), np.asarray(sk_result), atol=atol)
+
+
+class BarrierGather:
+    """Host-plane gather for a simulated world: barrier, read every rank's
+    matching state (identity-matched on the calling rank), barrier."""
+
+    def __init__(self, world: Sequence[Metric]):
+        self.world = world
+        self.barrier = threading.Barrier(len(world))
+
+    def for_rank(self, rank: int) -> Callable:
+        def gather(arr: Any, **kwargs: Any) -> List[Any]:
+            self.barrier.wait(timeout=_BARRIER_TIMEOUT)
+            locate = self._locate(self.world[rank], arr)
+            vals = [self._read(other, *locate) for other in self.world]
+            self.barrier.wait(timeout=_BARRIER_TIMEOUT)
+            return vals
+
+        return gather
+
+    @staticmethod
+    def _locate(me: Metric, arr: Any):
+        for name in me._defaults:
+            val = getattr(me, name)
+            if val is arr:
+                return (name, None, "array")
+            if isinstance(val, PaddedBuffer):
+                if val.data is arr:
+                    return (name, None, "buffer_data")
+                if val.count is arr:
+                    return (name, None, "buffer_count")
+            if isinstance(val, list):
+                for j, v in enumerate(val):
+                    if v is arr:
+                        return (name, j, "list")
+        raise RuntimeError("gathered array does not correspond to any metric state")
+
+    @staticmethod
+    def _read(metric: Metric, name: str, j: Optional[int], kind: str) -> Any:
+        val = getattr(metric, name)
+        if kind == "array":
+            return val
+        if kind == "buffer_data":
+            return val.data
+        if kind == "buffer_count":
+            return val.count
+        return val[j]
+
+
+def _run_in_threads(fns: Sequence[Callable]) -> List[Any]:
+    """Run one callable per rank concurrently; re-raise the first exception."""
+    results: List[Any] = [None] * len(fns)
+    errors: List[BaseException] = []
+
+    def runner(i: int) -> None:
+        try:
+            results[i] = fns[i]()
+        except BaseException as e:  # noqa: BLE001 - propagate test assertion errors
+            errors.append(e)
+
+    threads = [threading.Thread(target=runner, args=(i,)) for i in range(len(fns))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=2 * _BARRIER_TIMEOUT)
+    if errors:
+        raise errors[0]
+    return results
+
+
+class MetricTester:
+    """Test a metric class/functional against an sklearn oracle over batched fixtures."""
+
+    atol: float = 1e-8
+
+    def run_functional_metric_test(
+        self,
+        preds: np.ndarray,
+        target: np.ndarray,
+        metric_functional: Callable,
+        sk_metric: Callable,
+        metric_args: Optional[dict] = None,
+        **kwargs_update: Any,
+    ) -> None:
+        """Per-batch functional-vs-oracle comparison (reference testers.py:145-172)."""
+        metric_args = metric_args or {}
+        for i in range(NUM_BATCHES):
+            jax_result = metric_functional(
+                jnp.asarray(preds[i]), jnp.asarray(target[i]), **metric_args, **kwargs_update
+            )
+            sk_result = sk_metric(preds[i], target[i], **kwargs_update)
+            _assert_allclose(jax_result, sk_result, atol=self.atol)
+
+    def run_class_metric_test(
+        self,
+        ddp: bool,
+        preds: np.ndarray,
+        target: np.ndarray,
+        metric_class: type,
+        sk_metric: Callable,
+        dist_sync_on_step: bool,
+        metric_args: Optional[dict] = None,
+        check_dist_sync_on_step: bool = True,
+        check_batch: bool = True,
+    ) -> None:
+        """Stateful accumulate/sync/compute test (reference testers.py:76-142, 228-291)."""
+        metric_args = metric_args or {}
+        world_size = NUM_PROCESSES if ddp else 1
+
+        world: List[Metric] = []
+        for _ in range(world_size):
+            metric = metric_class(dist_sync_on_step=dist_sync_on_step, **metric_args)
+            metric = pickle.loads(pickle.dumps(metric))
+            world.append(metric)
+        if world_size > 1:
+            sync = BarrierGather(world)
+            for rank, metric in enumerate(world):
+                metric.dist_sync_fn = sync.for_rank(rank)
+
+        for step in range(NUM_BATCHES // world_size):
+            idxs = [r + step * world_size for r in range(world_size)]
+            fns = [
+                (lambda r=r, i=i: world[r](jnp.asarray(preds[i]), jnp.asarray(target[i])))
+                for r, i in enumerate(idxs)
+            ]
+            batch_results = _run_in_threads(fns) if (world_size > 1 and dist_sync_on_step) else [f() for f in fns]
+
+            for rank in range(world_size):
+                i = idxs[rank]
+                if dist_sync_on_step and check_dist_sync_on_step and rank == 0:
+                    # batch value was synced: compare against the union of this step's batches
+                    union_preds = np.concatenate([preds[j] for j in idxs])
+                    union_target = np.concatenate([target[j] for j in idxs])
+                    _assert_allclose(batch_results[rank], sk_metric(union_preds, union_target), atol=self.atol)
+                elif check_batch and not dist_sync_on_step:
+                    _assert_allclose(batch_results[rank], sk_metric(preds[i], target[i]), atol=self.atol)
+
+        # final compute must equal the oracle on ALL batches on every rank
+        total_preds = np.concatenate([preds[i] for i in range(NUM_BATCHES)])
+        total_target = np.concatenate([target[i] for i in range(NUM_BATCHES)])
+        sk_result = sk_metric(total_preds, total_target)
+        computes = [(lambda m=m: m.compute()) for m in world]
+        final = _run_in_threads(computes) if world_size > 1 else [computes[0]()]
+        for result in final:
+            _assert_allclose(result, sk_result, atol=self.atol)
+
+
+class DummyMetric(Metric):
+    name = "Dummy"
+
+    def __init__(self, **kwargs: Any):
+        super().__init__(**kwargs)
+        self.add_state("x", jnp.asarray(0.0), dist_reduce_fx=None)
+
+    def update(self):
+        pass
+
+    def compute(self):
+        pass
+
+
+class DummyListMetric(Metric):
+    name = "DummyList"
+
+    def __init__(self, **kwargs: Any):
+        super().__init__(**kwargs)
+        self.add_state("x", [], dist_reduce_fx=None)
+
+    def update(self):
+        pass
+
+    def compute(self):
+        pass
+
+
+class DummyMetricSum(DummyMetric):
+
+    def update(self, x):
+        self.x = self.x + x
+
+    def compute(self):
+        return self.x
+
+
+class DummyMetricDiff(DummyMetric):
+
+    def update(self, y):
+        self.x = self.x - y
+
+    def compute(self):
+        return self.x
