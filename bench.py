@@ -1,0 +1,108 @@
+"""Benchmark of record (BASELINE.md #3): per-step update+sync wall-clock of
+``MetricCollection(Accuracy, F1, Precision, Recall)``.
+
+Ours: one fused jitted step (single update pass, donated states) on the
+default JAX backend (TPU chip under the driver). Baseline: the actual
+reference torchmetrics (mounted at /root/reference, imported in-place, torch
+CPU — the only reference runtime available in this image) driving the same
+collection with the same data.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where value
+is our ms/step and vs_baseline = reference_ms / our_ms (>1 means faster than
+the reference).
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+N_STEPS = 50
+WARMUP = 5
+BATCH = 4096
+NUM_CLASSES = 32
+
+
+def bench_ours() -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, F1, MetricCollection, Precision, Recall
+
+    collection = MetricCollection([
+        Accuracy(),
+        F1(num_classes=NUM_CLASSES, average="macro"),
+        Precision(num_classes=NUM_CLASSES, average="macro"),
+        Recall(num_classes=NUM_CLASSES, average="macro"),
+    ])
+    pure = collection.pure()
+
+    rng = np.random.RandomState(0)
+    logits = rng.rand(BATCH, NUM_CLASSES).astype(np.float32)
+    preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+    target = jnp.asarray(rng.randint(0, NUM_CLASSES, BATCH).astype(np.int32))
+
+    donate = (0,) if jax.default_backend() == "tpu" else ()
+    step = jax.jit(lambda state, p, t: pure.update(state, p, t), donate_argnums=donate)
+
+    state = pure.init()
+    for _ in range(WARMUP):
+        state = step(state, preds, target)
+    jax.block_until_ready(state)
+
+    start = time.perf_counter()
+    for _ in range(N_STEPS):
+        state = step(state, preds, target)
+    jax.block_until_ready(state)
+    return (time.perf_counter() - start) / N_STEPS * 1e3  # ms/step
+
+
+def bench_reference() -> float:
+    sys.path.insert(0, "/root/reference")
+    import torch
+    from torchmetrics import Accuracy, F1, MetricCollection, Precision, Recall
+
+    collection = MetricCollection([
+        Accuracy(),
+        F1(num_classes=NUM_CLASSES, average="macro"),
+        Precision(num_classes=NUM_CLASSES, average="macro"),
+        Recall(num_classes=NUM_CLASSES, average="macro"),
+    ])
+
+    rng = np.random.RandomState(0)
+    logits = rng.rand(BATCH, NUM_CLASSES).astype(np.float32)
+    preds = torch.from_numpy(logits / logits.sum(-1, keepdims=True))
+    target = torch.from_numpy(rng.randint(0, NUM_CLASSES, BATCH).astype(np.int64))
+
+    for _ in range(WARMUP):
+        collection.update(preds, target)
+
+    start = time.perf_counter()
+    for _ in range(N_STEPS):
+        collection.update(preds, target)
+    return (time.perf_counter() - start) / N_STEPS * 1e3
+
+
+def main() -> None:
+    ours_ms = bench_ours()
+    try:
+        ref_ms = bench_reference()
+        vs_baseline = ref_ms / ours_ms
+    except Exception:
+        vs_baseline = float("nan")
+
+    print(
+        json.dumps(
+            {
+                "metric": "MetricCollection(Accuracy,F1,Precision,Recall) fused update wall-clock/step "
+                          f"(batch {BATCH}x{NUM_CLASSES}) vs reference torchmetrics (torch CPU)",
+                "value": round(ours_ms, 4),
+                "unit": "ms/step",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

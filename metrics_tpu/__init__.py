@@ -14,5 +14,36 @@ _logger.setLevel(logging.INFO)
 from metrics_tpu.info import __version__  # noqa: E402
 from metrics_tpu.core.collections import MetricCollection  # noqa: E402
 from metrics_tpu.core.metric import CompositionalMetric, Metric, PureMetric  # noqa: E402
-from metrics_tpu.classification import Accuracy, StatScores  # noqa: E402
+from metrics_tpu.classification import (  # noqa: E402
+    AUC,
+    AUROC,
+    F1,
+    Accuracy,
+    AveragePrecision,
+    BinnedAUROC,
+    BinnedAveragePrecision,
+    BinnedPrecisionRecallCurve,
+    BinnedROC,
+    CohenKappa,
+    ConfusionMatrix,
+    FBeta,
+    HammingDistance,
+    IoU,
+    MatthewsCorrcoef,
+    Precision,
+    PrecisionRecallCurve,
+    Recall,
+    ROC,
+    StatScores,
+)
+from metrics_tpu.regression import (  # noqa: E402
+    PSNR,
+    SSIM,
+    ExplainedVariance,
+    MeanAbsoluteError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    R2Score,
+)
+from metrics_tpu.retrieval import RetrievalMAP, RetrievalMetric, RetrievalNormalizedDCG  # noqa: E402
 from metrics_tpu import functional  # noqa: E402

@@ -1,2 +1,20 @@
 from metrics_tpu.classification.accuracy import Accuracy
+from metrics_tpu.classification.auc import AUC
+from metrics_tpu.classification.auroc import AUROC
+from metrics_tpu.classification.average_precision import AveragePrecision
+from metrics_tpu.classification.binned import (
+    BinnedAUROC,
+    BinnedAveragePrecision,
+    BinnedPrecisionRecallCurve,
+    BinnedROC,
+)
+from metrics_tpu.classification.cohen_kappa import CohenKappa
+from metrics_tpu.classification.confusion_matrix import ConfusionMatrix
+from metrics_tpu.classification.f_beta import F1, FBeta
+from metrics_tpu.classification.hamming_distance import HammingDistance
+from metrics_tpu.classification.iou import IoU
+from metrics_tpu.classification.matthews_corrcoef import MatthewsCorrcoef
+from metrics_tpu.classification.precision_recall import Precision, Recall
+from metrics_tpu.classification.precision_recall_curve import PrecisionRecallCurve
+from metrics_tpu.classification.roc import ROC
 from metrics_tpu.classification.stat_scores import StatScores

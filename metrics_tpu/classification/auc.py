@@ -1,0 +1,48 @@
+"""AUC module (reference torchmetrics/classification/auc.py:24, cat-states :64-65)."""
+from typing import Any, Callable, Optional
+
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.classification.auc import _auc_compute, _auc_update
+from metrics_tpu.parallel.buffer import as_values
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+class AUC(Metric):
+    """Area under an accumulated (x, y) curve."""
+
+    def __init__(
+        self,
+        reorder: bool = False,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+
+        self.reorder = reorder
+
+        self.add_state("x", default=[], dist_reduce_fx=None)
+        self.add_state("y", default=[], dist_reduce_fx=None)
+
+        rank_zero_warn(
+            "Metric `AUC` will save all targets and predictions in buffer."
+            " For large datasets this may lead to large memory footprint."
+        )
+
+    def update(self, x: Array, y: Array) -> None:
+        x, y = _auc_update(x, y)
+        self._append("x", x)
+        self._append("y", y)
+
+    def compute(self) -> Array:
+        x = as_values(self.x)
+        y = as_values(self.y)
+        return _auc_compute(x, y, reorder=self.reorder)

@@ -1,0 +1,90 @@
+"""AUROC module (reference torchmetrics/classification/auroc.py:25, cat-states :142-143)."""
+from typing import Any, Callable, Optional
+
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.classification.auroc import _auroc_compute, _auroc_update
+from metrics_tpu.parallel.buffer import as_values
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+class AUROC(Metric):
+    """Area under the ROC curve, over all data seen.
+
+    Example (binary):
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([0.13, 0.26, 0.08, 0.19, 0.34])
+        >>> target = jnp.array([0, 0, 1, 1, 1])
+        >>> auroc = AUROC(pos_label=1)
+        >>> float(auroc(preds, target))
+        0.5
+    """
+
+    def __init__(
+        self,
+        num_classes: Optional[int] = None,
+        pos_label: Optional[int] = None,
+        average: Optional[str] = "macro",
+        max_fpr: Optional[float] = None,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+
+        self.num_classes = num_classes
+        self.pos_label = pos_label
+        self.average = average
+        self.max_fpr = max_fpr
+
+        allowed_average = (None, "macro", "weighted", "micro")
+        if self.average not in allowed_average:
+            raise ValueError(
+                f"Argument `average` expected to be one of the following: {allowed_average} but got {average}"
+            )
+
+        if self.max_fpr is not None:
+            if not isinstance(max_fpr, float) or not 0 < max_fpr <= 1:
+                raise ValueError(f"`max_fpr` should be a float in range (0, 1], got: {max_fpr}")
+
+        self.mode = None
+        self.add_state("preds", default=[], dist_reduce_fx=None)
+        self.add_state("target", default=[], dist_reduce_fx=None)
+
+        rank_zero_warn(
+            "Metric `AUROC` will save all targets and predictions in buffer."
+            " For large datasets this may lead to large memory footprint."
+        )
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target, mode = _auroc_update(preds, target)
+
+        self._append("preds", preds)
+        self._append("target", target)
+
+        if self.mode is not None and self.mode != mode:
+            raise ValueError(
+                "The mode of data (binary, multi-label, multi-class) should be constant, but changed"
+                f" between batches from {self.mode} to {mode}"
+            )
+        self.mode = mode
+
+    def compute(self) -> Array:
+        preds = as_values(self.preds)
+        target = as_values(self.target)
+        return _auroc_compute(
+            preds,
+            target,
+            self.mode,
+            self.num_classes,
+            self.pos_label,
+            self.average,
+            self.max_fpr,
+        )

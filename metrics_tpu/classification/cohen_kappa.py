@@ -1,0 +1,62 @@
+"""CohenKappa module.
+
+Parity target: reference ``torchmetrics/classification/cohen_kappa.py:23``
+(``confmat`` "sum" state at :102).
+"""
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.classification.cohen_kappa import _cohen_kappa_compute, _cohen_kappa_update
+from metrics_tpu.utils.data import accum_int_dtype
+
+
+class CohenKappa(Metric):
+    r"""Cohen's kappa, accumulated over batches via the confusion matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([1, 1, 0, 0])
+        >>> preds = jnp.array([0, 1, 0, 0])
+        >>> cohenkappa = CohenKappa(num_classes=2)
+        >>> float(cohenkappa(preds, target))
+        0.5
+    """
+
+    def __init__(
+        self,
+        num_classes: int,
+        weights: Optional[str] = None,
+        threshold: float = 0.5,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.num_classes = num_classes
+        self.weights = weights
+        self.threshold = threshold
+
+        allowed_weights = ("linear", "quadratic", "none", None)
+        if self.weights not in allowed_weights:
+            raise ValueError(f"Argument weights needs to one of the following: {allowed_weights}")
+
+        self.add_state(
+            "confmat", default=jnp.zeros((num_classes, num_classes), dtype=accum_int_dtype()), dist_reduce_fx="sum"
+        )
+
+    def update(self, preds: Array, target: Array) -> None:
+        confmat = _cohen_kappa_update(preds, target, self.num_classes, self.threshold)
+        self.confmat = self.confmat + confmat
+
+    def compute(self) -> Array:
+        weights = None if self.weights == "none" else self.weights
+        return _cohen_kappa_compute(self.confmat, weights)

@@ -1,0 +1,59 @@
+"""HammingDistance module.
+
+Parity target: reference ``torchmetrics/classification/hamming_distance.py:23``
+(``correct``/``total`` "sum" states at :86-87).
+"""
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.classification.hamming_distance import (
+    _hamming_distance_compute,
+    _hamming_distance_update,
+)
+from metrics_tpu.utils.data import accum_int_dtype
+
+
+class HammingDistance(Metric):
+    r"""Average Hamming loss, accumulated over batches.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([[0, 1], [1, 1]])
+        >>> preds = jnp.array([[0, 1], [0, 1]])
+        >>> hamming_distance = HammingDistance()
+        >>> float(hamming_distance(preds, target))
+        0.25
+    """
+
+    def __init__(
+        self,
+        threshold: float = 0.5,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+
+        self.add_state("correct", default=jnp.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
+
+        if not 0 < threshold < 1:
+            raise ValueError(f"The `threshold` should be a float in the (0,1) interval, got {threshold}")
+        self.threshold = threshold
+
+    def update(self, preds: Array, target: Array) -> None:
+        correct, total = _hamming_distance_update(preds, target, self.threshold)
+        self.correct = self.correct + correct
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _hamming_distance_compute(self.correct, self.total)

@@ -1,0 +1,49 @@
+"""IoU module (subclass of ConfusionMatrix).
+
+Parity target: reference ``torchmetrics/classification/iou.py:23``.
+"""
+from typing import Any, Optional
+
+from jax import Array
+
+from metrics_tpu.classification.confusion_matrix import ConfusionMatrix
+from metrics_tpu.functional.classification.iou import _iou_from_confmat
+
+
+class IoU(ConfusionMatrix):
+    r"""Jaccard index accumulated over batches via the confusion matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([1, 1, 0, 0])
+        >>> preds = jnp.array([0, 1, 0, 0])
+        >>> iou = IoU(num_classes=2)
+        >>> round(float(iou(preds, target)), 4)
+        0.5833
+    """
+
+    def __init__(
+        self,
+        num_classes: int,
+        ignore_index: Optional[int] = None,
+        absent_score: float = 0.0,
+        threshold: float = 0.5,
+        reduction: str = "elementwise_mean",
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+    ):
+        super().__init__(
+            num_classes=num_classes,
+            normalize=None,
+            threshold=threshold,
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+        )
+        self.reduction = reduction
+        self.ignore_index = ignore_index
+        self.absent_score = absent_score
+
+    def compute(self) -> Array:
+        return _iou_from_confmat(self.confmat, self.num_classes, self.ignore_index, self.absent_score, self.reduction)

@@ -1,0 +1,58 @@
+"""MatthewsCorrcoef module.
+
+Parity target: reference ``torchmetrics/classification/matthews_corrcoef.py:26``
+(``confmat`` "sum" state at :97).
+"""
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.classification.matthews_corrcoef import (
+    _matthews_corrcoef_compute,
+    _matthews_corrcoef_update,
+)
+from metrics_tpu.utils.data import accum_int_dtype
+
+
+class MatthewsCorrcoef(Metric):
+    r"""Matthews correlation coefficient, accumulated via the confusion matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([1, 1, 0, 0])
+        >>> preds = jnp.array([0, 1, 0, 0])
+        >>> matthews_corrcoef = MatthewsCorrcoef(num_classes=2)
+        >>> round(float(matthews_corrcoef(preds, target)), 4)
+        0.5774
+    """
+
+    def __init__(
+        self,
+        num_classes: int,
+        threshold: float = 0.5,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.num_classes = num_classes
+        self.threshold = threshold
+
+        self.add_state(
+            "confmat", default=jnp.zeros((num_classes, num_classes), dtype=accum_int_dtype()), dist_reduce_fx="sum"
+        )
+
+    def update(self, preds: Array, target: Array) -> None:
+        confmat = _matthews_corrcoef_update(preds, target, self.num_classes, self.threshold)
+        self.confmat = self.confmat + confmat
+
+    def compute(self) -> Array:
+        return _matthews_corrcoef_compute(self.confmat)

@@ -1,0 +1,119 @@
+"""Precision / Recall modules (subclasses of StatScores).
+
+Parity target: reference ``torchmetrics/classification/precision_recall.py``
+(``Precision`` :23-170, ``Recall`` :173-321).
+"""
+from typing import Any, Callable, Optional
+
+from jax import Array
+
+from metrics_tpu.classification.stat_scores import StatScores
+from metrics_tpu.functional.classification.precision_recall import (
+    _ALLOWED_AVERAGE,
+    _precision_compute,
+    _recall_compute,
+)
+
+
+class Precision(StatScores):
+    r"""Precision = TP / (TP + FP), accumulated over batches.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([2, 0, 2, 1])
+        >>> target = jnp.array([1, 1, 2, 0])
+        >>> precision = Precision(average='macro', num_classes=3)
+        >>> round(float(precision(preds, target)), 4)
+        0.1667
+        >>> precision = Precision(average='micro')
+        >>> float(precision(preds, target))
+        0.25
+    """
+
+    def __init__(
+        self,
+        num_classes: Optional[int] = None,
+        threshold: float = 0.5,
+        average: str = "micro",
+        mdmc_average: Optional[str] = None,
+        ignore_index: Optional[int] = None,
+        top_k: Optional[int] = None,
+        is_multiclass: Optional[bool] = None,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        if average not in _ALLOWED_AVERAGE:
+            raise ValueError(f"The `average` has to be one of {_ALLOWED_AVERAGE}, got {average}.")
+
+        super().__init__(
+            reduce="macro" if average in ["weighted", "none", None] else average,
+            mdmc_reduce=mdmc_average,
+            threshold=threshold,
+            top_k=top_k,
+            num_classes=num_classes,
+            is_multiclass=is_multiclass,
+            ignore_index=ignore_index,
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.average = average
+
+    def compute(self) -> Array:
+        tp, fp, tn, fn = self._get_final_stats()
+        return _precision_compute(tp, fp, tn, fn, self.average, self.mdmc_reduce)
+
+
+class Recall(StatScores):
+    r"""Recall = TP / (TP + FN), accumulated over batches.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([2, 0, 2, 1])
+        >>> target = jnp.array([1, 1, 2, 0])
+        >>> recall = Recall(average='macro', num_classes=3)
+        >>> round(float(recall(preds, target)), 4)
+        0.3333
+        >>> recall = Recall(average='micro')
+        >>> float(recall(preds, target))
+        0.25
+    """
+
+    def __init__(
+        self,
+        num_classes: Optional[int] = None,
+        threshold: float = 0.5,
+        average: str = "micro",
+        mdmc_average: Optional[str] = None,
+        ignore_index: Optional[int] = None,
+        top_k: Optional[int] = None,
+        is_multiclass: Optional[bool] = None,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        if average not in _ALLOWED_AVERAGE:
+            raise ValueError(f"The `average` has to be one of {_ALLOWED_AVERAGE}, got {average}.")
+
+        super().__init__(
+            reduce="macro" if average in ["weighted", "none", None] else average,
+            mdmc_reduce=mdmc_average,
+            threshold=threshold,
+            top_k=top_k,
+            num_classes=num_classes,
+            is_multiclass=is_multiclass,
+            ignore_index=ignore_index,
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.average = average
+
+    def compute(self) -> Array:
+        tp, fp, tn, fn = self._get_final_stats()
+        return _recall_compute(tp, fp, tn, fn, self.average, self.mdmc_reduce)

@@ -76,6 +76,22 @@ class MetricCollection(OrderedDict):
         mc.prefix = self._check_prefix_arg(prefix)
         return mc
 
+    def __deepcopy__(self, memo: dict) -> "MetricCollection":
+        # dict-subclass default reduce would re-invoke __init__ with an items
+        # iterator; rebuild explicitly (type(self) keeps subclasses intact)
+        new = type(self)({k: deepcopy(m, memo) for k, m in self.items()}, prefix=self.prefix)
+        memo[id(self)] = new
+        for key, value in self.__dict__.items():
+            if key not in new.__dict__:
+                new.__dict__[key] = deepcopy(value, memo)
+        return new
+
+    def __reduce__(self):
+        return (type(self), (dict(self), self.prefix), self.__dict__.copy())
+
+    def __setstate__(self, state: dict) -> None:
+        self.__dict__.update(state)
+
     def persistent(self, mode: bool = True) -> None:
         for _, m in self.items():
             m.persistent(mode)

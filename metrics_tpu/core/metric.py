@@ -487,7 +487,10 @@ class Metric(ABC):
         return filtered_kwargs or kwargs
 
     def __hash__(self) -> int:
-        hash_vals = [self.__class__.__name__]
+        # identity-based like the reference (torch tensors hash by id); the
+        # instance id is included because XLA interns equal small constants,
+        # so state-array ids alone cannot distinguish two fresh instances
+        hash_vals = [self.__class__.__name__, id(self)]
         for key in self._defaults:
             value = getattr(self, key)
             if isinstance(value, list):

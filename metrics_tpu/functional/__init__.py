@@ -1,2 +1,34 @@
 from metrics_tpu.functional.classification.accuracy import accuracy
+from metrics_tpu.functional.classification.auc import auc
+from metrics_tpu.functional.classification.auroc import auroc
+from metrics_tpu.functional.classification.average_precision import average_precision
+from metrics_tpu.functional.classification.binned_curves import (
+    binned_auroc,
+    binned_average_precision,
+    binned_precision_recall_curve,
+    binned_roc,
+)
+from metrics_tpu.functional.classification.cohen_kappa import cohen_kappa
+from metrics_tpu.functional.classification.confusion_matrix import confusion_matrix
+from metrics_tpu.functional.classification.dice import dice_score
+from metrics_tpu.functional.classification.f_beta import f1, fbeta
+from metrics_tpu.functional.classification.hamming_distance import hamming_distance
+from metrics_tpu.functional.classification.iou import iou
+from metrics_tpu.functional.classification.matthews_corrcoef import matthews_corrcoef
+from metrics_tpu.functional.classification.precision_recall import precision, precision_recall, recall
+from metrics_tpu.functional.classification.precision_recall_curve import precision_recall_curve
+from metrics_tpu.functional.classification.roc import roc
 from metrics_tpu.functional.classification.stat_scores import stat_scores
+from metrics_tpu.functional.regression.explained_variance import explained_variance
+from metrics_tpu.functional.regression.mean_absolute_error import mean_absolute_error
+from metrics_tpu.functional.regression.mean_relative_error import mean_relative_error
+from metrics_tpu.functional.regression.mean_squared_error import mean_squared_error
+from metrics_tpu.functional.regression.mean_squared_log_error import mean_squared_log_error
+from metrics_tpu.functional.regression.psnr import psnr
+from metrics_tpu.functional.regression.r2score import r2score
+from metrics_tpu.functional.regression.ssim import ssim
+from metrics_tpu.functional.image_gradients import image_gradients
+from metrics_tpu.functional.nlp import bleu_score
+from metrics_tpu.functional.self_supervised import embedding_similarity
+from metrics_tpu.functional.retrieval.average_precision import retrieval_average_precision
+from metrics_tpu.functional.retrieval.ndcg import retrieval_normalized_dcg

@@ -1,0 +1,61 @@
+"""Generic area under a curve (trapezoidal rule).
+
+Parity target: reference ``torchmetrics/functional/classification/auc.py``
+(``_auc_compute`` :36-52 — monotonicity check + ``torch.trapz``; the
+reference's ``_stable_1d_sort`` workaround is unnecessary since XLA's sort is
+stable).
+"""
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.data import is_concrete
+
+
+def _auc_update(x: Array, y: Array) -> Tuple[Array, Array]:
+    if x.ndim > 1 or y.ndim > 1:
+        raise ValueError(
+            f"Expected both `x` and `y` tensor to be 1d, but got tensors with dimention {x.ndim} and {y.ndim}"
+        )
+    if x.size != y.size:
+        raise ValueError(f"Expected the same number of elements in `x` and `y` tensor but received {x.size} and {y.size}")
+    return x, y
+
+
+def _auc_compute(x: Array, y: Array, reorder: bool = False) -> Array:
+    if reorder:
+        idx = jnp.argsort(x)  # stable in XLA
+        x, y = x[idx], y[idx]
+
+    dx = x[1:] - x[:-1]
+    if is_concrete(dx):
+        if bool(jnp.any(dx < 0)):
+            if bool(jnp.all(dx <= 0)):
+                direction = -1.0
+            else:
+                raise ValueError(
+                    "The `x` tensor is neither increasing or decreasing. Try setting the reorder argument to `True`."
+                )
+        else:
+            direction = 1.0
+    else:
+        # jit-safe: sign of the net sweep decides direction, mixed direction unchecked
+        direction = jnp.where(jnp.all(dx <= 0), -1.0, 1.0)
+    return direction * jnp.trapezoid(y, x)
+
+
+def auc(x: Array, y: Array, reorder: bool = False) -> Array:
+    """Area under the (x, y) curve via the trapezoidal rule.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> x = jnp.array([0, 1, 2, 3])
+        >>> y = jnp.array([0, 1, 2, 2])
+        >>> float(auc(x, y))
+        4.0
+        >>> float(auc(x[::-1], y, reorder=True))
+        4.0
+    """
+    x, y = _auc_update(x, y)
+    return _auc_compute(x, y, reorder=reorder)

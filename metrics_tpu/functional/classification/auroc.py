@@ -1,0 +1,142 @@
+"""Area under the ROC curve.
+
+Parity target: reference ``torchmetrics/functional/classification/auroc.py``
+(``_auroc_update`` :26-40, ``_auroc_compute`` :42-133 — per-class ROC+trapezoid
+with macro/weighted/micro averaging and partial AUC via max_fpr + McClish
+correction). The reference's torch-version gate on ``bucketize``
+(auroc.py:61-65) has no analogue here — ``jnp.searchsorted`` is always
+available.
+"""
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.auc import auc
+from metrics_tpu.functional.classification.roc import roc
+from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.enums import AverageMethod, DataType
+
+
+def _auroc_update(preds: Array, target: Array):
+    # validate input and resolve the data mode
+    _, _, mode = _input_format_classification(preds, target)
+
+    if mode == DataType.MULTIDIM_MULTICLASS:
+        n_classes = preds.shape[1]
+        preds = jnp.swapaxes(preds, 0, 1).reshape(n_classes, -1).swapaxes(0, 1)
+        target = target.reshape(-1)
+    if mode == DataType.MULTILABEL and preds.ndim > 2:
+        n_classes = preds.shape[1]
+        preds = jnp.swapaxes(preds, 0, 1).reshape(n_classes, -1).swapaxes(0, 1)
+        target = jnp.swapaxes(target, 0, 1).reshape(n_classes, -1).swapaxes(0, 1)
+
+    return preds, target, mode
+
+
+def _auroc_compute(
+    preds: Array,
+    target: Array,
+    mode: DataType,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    average: Optional[str] = "macro",
+    max_fpr: Optional[float] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Array:
+    if mode == DataType.BINARY:
+        num_classes = 1
+
+    if max_fpr is not None:
+        if not isinstance(max_fpr, float) or not 0 < max_fpr <= 1:
+            raise ValueError(f"`max_fpr` should be a float in range (0, 1], got: {max_fpr}")
+        if mode != DataType.BINARY:
+            raise ValueError(
+                "Partial AUC computation not available in"
+                " multilabel/multiclass setting, 'max_fpr' must be"
+                f" set to `None`, received `{max_fpr}`."
+            )
+
+    if mode == DataType.MULTILABEL:
+        if average == AverageMethod.MICRO:
+            fpr, tpr, _ = roc(preds.reshape(-1), target.reshape(-1), 1, pos_label, sample_weights)
+        else:
+            output = [
+                roc(preds[:, i], target[:, i], num_classes=1, pos_label=1, sample_weights=sample_weights)
+                for i in range(num_classes)
+            ]
+            fpr = [o[0] for o in output]
+            tpr = [o[1] for o in output]
+    else:
+        fpr, tpr, _ = roc(preds, target, num_classes, pos_label, sample_weights)
+
+    if max_fpr is None or max_fpr == 1:
+        if mode == DataType.MULTILABEL and average == AverageMethod.MICRO:
+            pass
+        elif num_classes != 1:
+            auc_scores = [auc(x, y) for x, y in zip(fpr, tpr)]
+
+            if average == AverageMethod.NONE:
+                return auc_scores
+            if average == AverageMethod.MACRO:
+                return jnp.mean(jnp.stack(auc_scores))
+            if average == AverageMethod.WEIGHTED:
+                if mode == DataType.MULTILABEL:
+                    support = jnp.sum(target, axis=0)
+                else:
+                    support = jnp.bincount(target.reshape(-1), length=num_classes)
+                return jnp.sum(jnp.stack(auc_scores) * support / jnp.sum(support))
+
+            allowed_average = (AverageMethod.NONE.value, AverageMethod.MACRO.value, AverageMethod.WEIGHTED.value)
+            raise ValueError(
+                f"Argument `average` expected to be one of the following: {allowed_average} but got {average}"
+            )
+
+        return auc(fpr, tpr)
+
+    # partial AUC: interpolate the curve at max_fpr, then McClish-correct
+    max_fpr_t = jnp.asarray(max_fpr)
+    stop = int(jnp.searchsorted(fpr, max_fpr_t, side="right"))
+    weight = (max_fpr_t - fpr[stop - 1]) / (fpr[stop] - fpr[stop - 1])
+    interp_tpr = tpr[stop - 1] + weight * (tpr[stop] - tpr[stop - 1])
+    tpr = jnp.concatenate([tpr[:stop], interp_tpr.reshape(1)])
+    fpr = jnp.concatenate([fpr[:stop], max_fpr_t.reshape(1)])
+
+    partial_auc = auc(fpr, tpr)
+
+    # McClish correction: 0.5 if non-discriminant, 1 if maximal
+    min_area = 0.5 * max_fpr**2
+    max_area = max_fpr
+    return 0.5 * (1 + (partial_auc - min_area) / (max_area - min_area))
+
+
+def auroc(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    average: Optional[str] = "macro",
+    max_fpr: Optional[float] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Array:
+    """Area under the receiver operating characteristic curve.
+
+    Example (binary):
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([0.13, 0.26, 0.08, 0.19, 0.34])
+        >>> target = jnp.array([0, 0, 1, 1, 1])
+        >>> float(auroc(preds, target, pos_label=1))
+        0.5
+
+    Example (multiclass):
+        >>> preds = jnp.array([[0.90, 0.05, 0.05],
+        ...                    [0.05, 0.90, 0.05],
+        ...                    [0.05, 0.05, 0.90],
+        ...                    [0.85, 0.05, 0.10],
+        ...                    [0.10, 0.10, 0.80]])
+        >>> target = jnp.array([0, 1, 1, 2, 2])
+        >>> round(float(auroc(preds, target, num_classes=3)), 4)
+        0.7778
+    """
+    preds, target, mode = _auroc_update(preds, target)
+    return _auroc_compute(preds, target, mode, num_classes, pos_label, average, max_fpr, sample_weights)

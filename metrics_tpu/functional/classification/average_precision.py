@@ -1,0 +1,68 @@
+"""Average precision (area under the PR curve as a step function).
+
+Parity target: reference
+``torchmetrics/functional/classification/average_precision.py`` (:34-52 —
+``-sum((r[1:] - r[:-1]) * p[:-1])`` over the PR curve).
+"""
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    _precision_recall_curve_compute,
+    _precision_recall_curve_update,
+)
+
+
+def _average_precision_update(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+) -> Tuple[Array, Array, int, int]:
+    return _precision_recall_curve_update(preds, target, num_classes, pos_label)
+
+
+def _average_precision_compute(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    pos_label: int,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[List[Array], Array]:
+    precision, recall, _ = _precision_recall_curve_compute(preds, target, num_classes, pos_label)
+    # step-function integral; the last precision entry is guaranteed to be 1
+    if num_classes == 1:
+        return -jnp.sum((recall[1:] - recall[:-1]) * precision[:-1])
+
+    return [-jnp.sum((r[1:] - r[:-1]) * p[:-1]) for p, r in zip(precision, recall)]
+
+
+def average_precision(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[List[Array], Array]:
+    """Average precision score.
+
+    Example (binary):
+        >>> import jax.numpy as jnp
+        >>> pred = jnp.array([0, 1, 2, 3])
+        >>> target = jnp.array([0, 1, 1, 1])
+        >>> float(average_precision(pred, target, pos_label=1))
+        1.0
+
+    Example (multiclass):
+        >>> pred = jnp.array([[0.75, 0.05, 0.05, 0.05, 0.05],
+        ...                   [0.05, 0.75, 0.05, 0.05, 0.05],
+        ...                   [0.05, 0.05, 0.75, 0.05, 0.05],
+        ...                   [0.05, 0.05, 0.05, 0.75, 0.05]])
+        >>> target = jnp.array([0, 1, 3, 2])
+        >>> [float(x) for x in average_precision(pred, target, num_classes=5)]
+        [1.0, 1.0, 0.25, 0.25, nan]
+    """
+    preds, target, num_classes, pos_label = _average_precision_update(preds, target, num_classes, pos_label)
+    return _average_precision_compute(preds, target, num_classes, pos_label, sample_weights)

@@ -1,0 +1,127 @@
+"""Binned (fixed-threshold-grid) curve metrics — the TPU-native curve mode.
+
+The exact curve kernels (``precision_recall_curve.py``, ``roc.py``) have
+data-dependent output shapes and unbounded cat-state memory — the reference
+accepts both (reference torchmetrics/classification/auroc.py:142-143 stores
+every prediction ever seen). XLA wants static shapes and O(1) state, so this
+module provides the idiomatic alternative: evaluate the curve on a fixed
+threshold grid. Counts per threshold are
+
+* exact for every threshold on the grid (not an approximation of those points),
+* additive — states are ``(T,)``/``(C, T)`` "sum" states, so they accumulate
+  over batches, donate cleanly under jit, and sync with one ``psum``,
+* MXU-friendly: the (T, N) comparison matrix contracts against targets as a
+  matmul.
+
+There is no reference counterpart (binned metrics only landed in later
+torchmetrics releases); the API mirrors the exact functions with a
+``thresholds`` argument.
+"""
+from typing import Optional, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def default_thresholds(num_thresholds: int = 100, dtype=jnp.float32) -> Array:
+    """Evenly spaced thresholds in [0, 1]."""
+    return jnp.linspace(0.0, 1.0, num_thresholds, dtype=dtype)
+
+
+def _as_thresholds(thresholds: Union[int, Array, None]) -> Array:
+    if thresholds is None:
+        return default_thresholds()
+    if isinstance(thresholds, int):
+        return default_thresholds(thresholds)
+    return jnp.asarray(thresholds)
+
+
+def binned_stat_curve_update(preds: Array, target: Array, thresholds: Array) -> Tuple[Array, Array, Array, Array]:
+    """Per-threshold TP/FP/TN/FN counts for binary ``(N,)`` or per-class ``(N, C)`` inputs.
+
+    Returns arrays of shape ``(T,)`` (binary) or ``(C, T)``. Pure and jit-safe;
+    "sum"-reducible across batches and mesh axes.
+    """
+    if preds.ndim == 1:
+        preds_c = preds[:, None]  # (N, 1)
+        target_c = target[:, None]
+    else:
+        preds_c, target_c = preds, target
+
+    pos = (target_c > 0).astype(preds_c.dtype)  # (N, C)
+    neg = 1.0 - pos
+    ge = (preds_c[None, :, :] >= thresholds[:, None, None]).astype(preds_c.dtype)  # (T, N, C)
+
+    # contract over N: (T, N, C) x (N, C) -> (T, C); einsum lowers to batched matmul
+    tp = jnp.einsum("tnc,nc->tc", ge, pos).T  # (C, T)
+    fp = jnp.einsum("tnc,nc->tc", ge, neg).T
+    n_pos = jnp.sum(pos, axis=0)[:, None]  # (C, 1)
+    n_neg = jnp.sum(neg, axis=0)[:, None]
+    fn = n_pos - tp
+    tn = n_neg - fp
+
+    if preds.ndim == 1:
+        return tp[0], fp[0], tn[0], fn[0]
+    return tp, fp, tn, fn
+
+
+def binned_precision_recall_curve(
+    preds: Array,
+    target: Array,
+    thresholds: Union[int, Array, None] = None,
+) -> Tuple[Array, Array, Array]:
+    """Precision/recall evaluated on a fixed threshold grid (jit-safe).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([0.1, 0.4, 0.6, 0.8])
+        >>> target = jnp.array([0, 1, 1, 1])
+        >>> p, r, t = binned_precision_recall_curve(preds, target, thresholds=jnp.array([0.0, 0.5, 1.0]))
+        >>> p.tolist(), r.tolist()
+        ([0.75, 1.0, 0.0], [1.0, 0.6666666865348816, 0.0])
+    """
+    thresholds = _as_thresholds(thresholds)
+    tp, fp, tn, fn = binned_stat_curve_update(preds.astype(jnp.float32), target, thresholds)
+    precision = jnp.where(tp + fp == 0, 0.0, tp / jnp.where(tp + fp == 0, 1.0, tp + fp))
+    recall = jnp.where(tp + fn == 0, 0.0, tp / jnp.where(tp + fn == 0, 1.0, tp + fn))
+    return precision, recall, thresholds
+
+
+def binned_roc(
+    preds: Array,
+    target: Array,
+    thresholds: Union[int, Array, None] = None,
+) -> Tuple[Array, Array, Array]:
+    """FPR/TPR evaluated on a fixed threshold grid (jit-safe)."""
+    thresholds = _as_thresholds(thresholds)
+    tp, fp, tn, fn = binned_stat_curve_update(preds.astype(jnp.float32), target, thresholds)
+    tpr = tp / jnp.maximum(tp + fn, 1.0)
+    fpr = fp / jnp.maximum(fp + tn, 1.0)
+    return fpr, tpr, thresholds
+
+
+def binned_auroc(
+    preds: Array,
+    target: Array,
+    thresholds: Union[int, Array, None] = None,
+) -> Array:
+    """AUROC from the binned ROC via the trapezoidal rule (jit-safe scalar).
+
+    Converges to the exact AUROC as the grid refines; with the default
+    100-point grid it is typically within ~1e-2 of exact on smooth score
+    distributions.
+    """
+    fpr, tpr, _ = binned_roc(preds, target, thresholds)
+    # thresholds ascend -> fpr descends; integrate in ascending-fpr order
+    return -jnp.trapezoid(tpr, fpr, axis=-1)
+
+
+def binned_average_precision(
+    preds: Array,
+    target: Array,
+    thresholds: Union[int, Array, None] = None,
+) -> Array:
+    """Average precision from the binned PR curve (jit-safe scalar)."""
+    precision, recall, _ = binned_precision_recall_curve(preds, target, thresholds)
+    # step-function integral over descending recall
+    return -jnp.sum((recall[..., 1:] - recall[..., :-1]) * precision[..., :-1], axis=-1)

@@ -1,0 +1,58 @@
+"""Cohen's kappa.
+
+Parity target: reference ``torchmetrics/functional/classification/cohen_kappa.py``
+(``_cohen_kappa_compute`` :25-49 with none/linear/quadratic weighting).
+"""
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.confusion_matrix import (
+    _confusion_matrix_compute,
+    _confusion_matrix_update,
+)
+
+_cohen_kappa_update = _confusion_matrix_update
+
+
+def _cohen_kappa_compute(confmat: Array, weights: Optional[str] = None) -> Array:
+    confmat = _confusion_matrix_compute(confmat)
+    n_classes = confmat.shape[0]
+    sum0 = jnp.sum(confmat, axis=0, keepdims=True)
+    sum1 = jnp.sum(confmat, axis=1, keepdims=True)
+    expected = sum1 @ sum0 / jnp.sum(sum0)
+
+    if weights is None:
+        w_mat = 1.0 - jnp.eye(n_classes, dtype=confmat.dtype)
+    elif weights in ("linear", "quadratic"):
+        grid = jnp.arange(n_classes, dtype=confmat.dtype)
+        diff = grid[None, :] - grid[:, None]
+        w_mat = jnp.abs(diff) if weights == "linear" else diff**2
+    else:
+        raise ValueError(
+            f"Received {weights} for argument ``weights`` but should be either None, 'linear' or 'quadratic'"
+        )
+
+    k = jnp.sum(w_mat * confmat) / jnp.sum(w_mat * expected)
+    return 1 - k
+
+
+def cohen_kappa(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    weights: Optional[str] = None,
+    threshold: float = 0.5,
+) -> Array:
+    r"""Cohen's kappa: agreement corrected for chance.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([1, 1, 0, 0])
+        >>> preds = jnp.array([0, 1, 0, 0])
+        >>> float(cohen_kappa(preds, target, num_classes=2))
+        0.5
+    """
+    confmat = _cohen_kappa_update(preds, target, num_classes, threshold)
+    return _cohen_kappa_compute(confmat, weights)

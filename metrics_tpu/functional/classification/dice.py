@@ -1,0 +1,57 @@
+"""Dice score.
+
+Parity target: reference ``torchmetrics/functional/classification/dice.py``
+(``dice_score`` :63-116 with ``bg`` skip, ``no_fg_score`` and ``nan_score``
+substitution; the reference's per-class ``_stat_scores`` helper :23-60 is
+subsumed by the vectorized mask computation below).
+
+TPU-native difference: the reference loops over classes in Python with
+value-dependent branches; here all classes are computed at once with
+vectorized masks (one fused XLA kernel, no host sync).
+"""
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.data import to_categorical
+from metrics_tpu.utils.reductions import reduce
+
+
+def dice_score(
+    pred: Array,
+    target: Array,
+    bg: bool = False,
+    nan_score: float = 0.0,
+    no_fg_score: float = 0.0,
+    reduction: str = "elementwise_mean",
+) -> Array:
+    """Dice = 2·TP / (2·TP + FP + FN) per class, vectorized over classes.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> pred = jnp.array([[0.85, 0.05, 0.05, 0.05],
+        ...                   [0.05, 0.85, 0.05, 0.05],
+        ...                   [0.05, 0.05, 0.85, 0.05],
+        ...                   [0.05, 0.05, 0.05, 0.85]])
+        >>> target = jnp.array([0, 1, 3, 2])
+        >>> round(float(dice_score(pred, target)), 4)
+        0.3333
+    """
+    num_classes = pred.shape[1]
+    start = 0 if bg else 1
+
+    labels = to_categorical(pred) if pred.ndim == target.ndim + 1 else pred
+    classes = jnp.arange(start, num_classes)
+
+    pred_hits = labels.reshape(-1)[None, :] == classes[:, None]  # (C', M)
+    target_hits = target.reshape(-1)[None, :] == classes[:, None]
+
+    tp = jnp.sum(pred_hits & target_hits, axis=1).astype(jnp.float32)
+    fp = jnp.sum(pred_hits & ~target_hits, axis=1).astype(jnp.float32)
+    fn = jnp.sum(~pred_hits & target_hits, axis=1).astype(jnp.float32)
+    support = jnp.sum(target_hits, axis=1)
+
+    denom = 2 * tp + fp + fn
+    scores = jnp.where(denom == 0, nan_score, 2 * tp / jnp.where(denom == 0, 1.0, denom))
+    scores = jnp.where(support == 0, no_fg_score, scores)  # no foreground pixels
+
+    return reduce(scores, reduction=reduction)

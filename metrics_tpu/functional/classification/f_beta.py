@@ -1,0 +1,127 @@
+"""F-beta / F1 functional kernels.
+
+Parity target: reference ``torchmetrics/functional/classification/f_beta.py``
+(``_safe_divide`` :24-27, ``_fbeta_compute`` :30-67, ``fbeta`` :70-202,
+``f1`` :205-309).
+"""
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.classification.stat_scores import _reduce_stat_scores
+from metrics_tpu.functional.classification.precision_recall import _check_prf_args
+from metrics_tpu.functional.classification.stat_scores import _stat_scores_update
+from metrics_tpu.utils.enums import AverageMethod, MDMCAverageMethod
+
+
+def _safe_divide(num: Array, denom: Array) -> Array:
+    """num / denom with 0-denominators treated as 1 (reference :24-27)."""
+    return num / jnp.where(denom == 0, 1, denom)
+
+
+def _fbeta_compute(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    beta: float,
+    ignore_index: Optional[int],
+    average: str,
+    mdmc_average: Optional[str],
+) -> Array:
+    tp_f, fp_f, fn_f = tp.astype(jnp.float32), fp.astype(jnp.float32), fn.astype(jnp.float32)
+
+    if average == "micro" and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        # ignored classes carry -1 sentinels; mask them out of the global sums
+        mask = tp >= 0
+        msum = lambda x: jnp.sum(jnp.where(mask, x, 0.0))  # noqa: E731
+        precision = _safe_divide(msum(tp_f), msum(tp_f) + msum(fp_f))
+        recall = _safe_divide(msum(tp_f), msum(tp_f) + msum(fn_f))
+    else:
+        precision = _safe_divide(tp_f, tp_f + fp_f)
+        recall = _safe_divide(tp_f, tp_f + fn_f)
+
+    num = (1 + beta**2) * precision * recall
+    denom = beta**2 * precision + recall
+    denom = jnp.where(denom == 0.0, 1.0, denom)
+
+    if ignore_index is not None:
+        if (
+            average not in (AverageMethod.MICRO, AverageMethod.SAMPLES)
+            and mdmc_average == MDMCAverageMethod.SAMPLEWISE
+        ):
+            num = num.at[..., ignore_index].set(-1)
+            denom = denom.at[..., ignore_index].set(-1)
+        elif average not in (AverageMethod.MICRO, AverageMethod.SAMPLES):
+            num = num.at[ignore_index, ...].set(-1)
+            denom = denom.at[ignore_index, ...].set(-1)
+
+    return _reduce_stat_scores(
+        numerator=num,
+        denominator=denom,
+        weights=None if average != "weighted" else tp + fn,
+        average=average,
+        mdmc_average=mdmc_average,
+    )
+
+
+def fbeta(
+    preds: Array,
+    target: Array,
+    beta: float = 1.0,
+    average: str = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    is_multiclass: Optional[bool] = None,
+) -> Array:
+    r"""F-beta: ``(1 + beta^2) * P * R / (beta^2 * P + R)``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([0, 1, 2, 0, 1, 2])
+        >>> preds = jnp.array([0, 2, 1, 0, 0, 1])
+        >>> round(float(fbeta(preds, target, num_classes=3, beta=0.5)), 4)
+        0.3333
+    """
+    _check_prf_args(average, mdmc_average, num_classes, ignore_index)
+    reduce = "macro" if average in ["weighted", "none", None] else average
+    tp, fp, tn, fn = _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_average,
+        threshold=threshold,
+        num_classes=num_classes,
+        top_k=top_k,
+        is_multiclass=is_multiclass,
+        ignore_index=ignore_index,
+    )
+    return _fbeta_compute(tp, fp, tn, fn, beta, ignore_index, average, mdmc_average)
+
+
+def f1(
+    preds: Array,
+    target: Array,
+    beta: float = 1.0,
+    average: str = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    is_multiclass: Optional[bool] = None,
+) -> Array:
+    """F1 = harmonic mean of precision and recall (fbeta with beta=1).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([0, 1, 2, 0, 1, 2])
+        >>> preds = jnp.array([0, 2, 1, 0, 0, 1])
+        >>> round(float(f1(preds, target, num_classes=3)), 4)
+        0.3333
+    """
+    return fbeta(preds, target, 1.0, average, mdmc_average, ignore_index, num_classes, threshold, top_k, is_multiclass)

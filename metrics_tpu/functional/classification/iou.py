@@ -1,0 +1,56 @@
+"""Intersection over union (Jaccard) from the confusion matrix.
+
+Parity target: reference ``torchmetrics/functional/classification/iou.py``
+(``_iou_from_confmat`` :24-44 — diag/union algebra, absent_score substitution,
+ignore_index slice-out).
+"""
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.confusion_matrix import _confusion_matrix_update
+from metrics_tpu.utils.data import get_num_classes
+from metrics_tpu.utils.reductions import reduce
+
+
+def _iou_from_confmat(
+    confmat: Array,
+    num_classes: int,
+    ignore_index: Optional[int] = None,
+    absent_score: float = 0.0,
+    reduction: str = "elementwise_mean",
+) -> Array:
+    intersection = jnp.diag(confmat)
+    union = jnp.sum(confmat, axis=0) + jnp.sum(confmat, axis=1) - intersection
+
+    # class absent in both target and pred (union == 0) -> absent_score
+    scores = intersection.astype(jnp.float32) / union.astype(jnp.float32)
+    scores = jnp.where(union == 0, absent_score, scores)
+
+    if ignore_index is not None and 0 <= ignore_index < num_classes:
+        scores = jnp.concatenate([scores[:ignore_index], scores[ignore_index + 1:]])
+    return reduce(scores, reduction=reduction)
+
+
+def iou(
+    preds: Array,
+    target: Array,
+    ignore_index: Optional[int] = None,
+    absent_score: float = 0.0,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    reduction: str = "elementwise_mean",
+) -> Array:
+    r"""Jaccard index: |A ∩ B| / |A ∪ B| per class.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([1, 1, 0, 0])
+        >>> preds = jnp.array([0, 1, 0, 0])
+        >>> round(float(iou(preds, target, num_classes=2)), 4)
+        0.5833
+    """
+    num_classes = get_num_classes(preds=preds, target=target, num_classes=num_classes)
+    confmat = _confusion_matrix_update(preds, target, num_classes, threshold)
+    return _iou_from_confmat(confmat, num_classes, ignore_index, absent_score, reduction)

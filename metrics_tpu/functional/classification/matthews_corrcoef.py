@@ -1,0 +1,39 @@
+"""Matthews correlation coefficient from confusion-matrix marginals.
+
+Parity target: reference
+``torchmetrics/functional/classification/matthews_corrcoef.py`` (:22-27).
+"""
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.confusion_matrix import _confusion_matrix_update
+
+_matthews_corrcoef_update = _confusion_matrix_update
+
+
+def _matthews_corrcoef_compute(confmat: Array) -> Array:
+    confmat = confmat.astype(jnp.float32)
+    tk = jnp.sum(confmat, axis=0)
+    pk = jnp.sum(confmat, axis=1)
+    c = jnp.trace(confmat)
+    s = jnp.sum(confmat)
+    return (c * s - jnp.sum(tk * pk)) / (jnp.sqrt(s**2 - jnp.sum(pk * pk)) * jnp.sqrt(s**2 - jnp.sum(tk * tk)))
+
+
+def matthews_corrcoef(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    threshold: float = 0.5,
+) -> Array:
+    r"""MCC: correlation between prediction and target assignment.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([1, 1, 0, 0])
+        >>> preds = jnp.array([0, 1, 0, 0])
+        >>> round(float(matthews_corrcoef(preds, target, num_classes=2)), 4)
+        0.5774
+    """
+    confmat = _matthews_corrcoef_update(preds, target, num_classes, threshold)
+    return _matthews_corrcoef_compute(confmat)

@@ -1,0 +1,185 @@
+"""Precision-recall curve.
+
+Parity target: reference
+``torchmetrics/functional/classification/precision_recall_curve.py``
+(``_binary_clf_curve`` :23-63 — the sklearn-adapted sort+cumsum sweep —
+``_precision_recall_curve_update`` :66-111, ``_precision_recall_curve_compute``
+:114-160).
+
+Shape note (TPU design): curve outputs have *data-dependent length* (number of
+distinct thresholds), so these exact kernels are **eager/epoch-end** code —
+they run on device but extract dynamic shapes on the host. This matches where
+the reference runs them (after the cross-rank gather at ``compute()``). The
+jit-safe O(1)-state alternative for in-loop use is the binned family in
+``metrics_tpu/functional/classification/binned_curves.py``.
+"""
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+def _binary_clf_curve(
+    preds: Array,
+    target: Array,
+    sample_weights: Optional[Sequence] = None,
+    pos_label: float = 1.0,
+) -> Tuple[Array, Array, Array]:
+    """fps/tps/thresholds at each distinct prediction value, descending.
+
+    Same contract as the reference (:23-63) / sklearn's ``_binary_clf_curve``.
+    """
+    if sample_weights is not None and not isinstance(sample_weights, Array):
+        sample_weights = jnp.asarray(sample_weights, dtype=jnp.float32)
+
+    # remove class dimension if necessary
+    if preds.ndim > target.ndim:
+        preds = preds[:, 0]
+    desc_score_indices = jnp.argsort(preds, descending=True)
+
+    preds = preds[desc_score_indices]
+    target = target[desc_score_indices]
+
+    weight = sample_weights[desc_score_indices] if sample_weights is not None else 1.0
+
+    # indices of distinct prediction values; append the curve end
+    distinct_value_indices = jnp.nonzero(preds[1:] - preds[:-1])[0]
+    threshold_idxs = jnp.concatenate([distinct_value_indices, jnp.array([target.shape[0] - 1])])
+    target = (target == pos_label).astype(jnp.int32)
+    tps = jnp.cumsum(target * weight, axis=0)[threshold_idxs]
+
+    if sample_weights is not None:
+        # cumsum keeps fps monotone under fp rounding (reference :57-59)
+        fps = jnp.cumsum((1 - target) * weight, axis=0)[threshold_idxs]
+    else:
+        fps = 1 + threshold_idxs - tps
+
+    return fps, tps, preds[threshold_idxs]
+
+
+def _precision_recall_curve_update(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+) -> Tuple[Array, Array, int, int]:
+    if not (preds.ndim == target.ndim or preds.ndim == target.ndim + 1):
+        raise ValueError("preds and target must have same number of dimensions, or one additional dimension for preds")
+
+    if preds.ndim == target.ndim:
+        if pos_label is None:
+            rank_zero_warn("`pos_label` automatically set 1.")
+            pos_label = 1
+        if num_classes is not None and num_classes != 1:
+            # multilabel problem
+            if num_classes != preds.shape[1]:
+                raise ValueError(
+                    f"Argument `num_classes` was set to {num_classes} in"
+                    f" metric `precision_recall_curve` but detected {preds.shape[1]}"
+                    " number of classes from predictions"
+                )
+            preds = jnp.swapaxes(preds, 0, 1).reshape(num_classes, -1).swapaxes(0, 1)
+            target = jnp.swapaxes(target, 0, 1).reshape(num_classes, -1).swapaxes(0, 1)
+        else:
+            # binary problem
+            preds = preds.reshape(-1)
+            target = target.reshape(-1)
+            num_classes = 1
+
+    if preds.ndim == target.ndim + 1:
+        # multi class problem
+        if pos_label is not None:
+            rank_zero_warn(
+                "Argument `pos_label` should be `None` when running"
+                f" multiclass precision recall curve. Got {pos_label}"
+            )
+        if num_classes != preds.shape[1]:
+            raise ValueError(
+                f"Argument `num_classes` was set to {num_classes} in"
+                f" metric `precision_recall_curve` but detected {preds.shape[1]}"
+                " number of classes from predictions"
+            )
+        preds = jnp.swapaxes(preds, 0, 1).reshape(num_classes, -1).swapaxes(0, 1)
+        target = target.reshape(-1)
+
+    return preds, target, num_classes, pos_label
+
+
+def _precision_recall_curve_compute(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    pos_label: int,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    if num_classes == 1:
+        fps, tps, thresholds = _binary_clf_curve(
+            preds=preds, target=target, sample_weights=sample_weights, pos_label=pos_label
+        )
+
+        precision = tps / (tps + fps)
+        recall = tps / tps[-1]
+
+        # stop once full recall is attained; reverse so recall is decreasing
+        last_ind = int(jnp.nonzero(tps == tps[-1])[0][0])
+        sl = slice(0, last_ind + 1)
+
+        precision = jnp.concatenate([precision[sl][::-1], jnp.ones(1, dtype=precision.dtype)])
+        recall = jnp.concatenate([recall[sl][::-1], jnp.zeros(1, dtype=recall.dtype)])
+        thresholds = thresholds[sl][::-1]
+
+        return precision, recall, thresholds
+
+    # per-class sweep
+    precision, recall, thresholds = [], [], []
+    for c in range(num_classes):
+        preds_c = preds[:, c]
+        res = precision_recall_curve(
+            preds=preds_c,
+            target=target,
+            num_classes=1,
+            pos_label=c,
+            sample_weights=sample_weights,
+        )
+        precision.append(res[0])
+        recall.append(res[1])
+        thresholds.append(res[2])
+
+    return precision, recall, thresholds
+
+
+def precision_recall_curve(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Precision/recall pairs at every distinct threshold.
+
+    Example (binary):
+        >>> import jax.numpy as jnp
+        >>> pred = jnp.array([0, 1, 2, 3])
+        >>> target = jnp.array([0, 1, 1, 0])
+        >>> precision, recall, thresholds = precision_recall_curve(pred, target, pos_label=1)
+        >>> precision
+        Array([0.6666667, 0.5      , 0.       , 1.       ], dtype=float32)
+        >>> recall
+        Array([1. , 0.5, 0. , 0. ], dtype=float32)
+        >>> thresholds
+        Array([1, 2, 3], dtype=int32)
+
+    Example (multiclass):
+        >>> pred = jnp.array([[0.75, 0.05, 0.05, 0.05],
+        ...                   [0.05, 0.75, 0.05, 0.05],
+        ...                   [0.05, 0.05, 0.75, 0.05],
+        ...                   [0.05, 0.05, 0.05, 0.75]])
+        >>> target = jnp.array([0, 1, 3, 2])
+        >>> precision, recall, thresholds = precision_recall_curve(pred, target, num_classes=4)
+        >>> [p.tolist() for p in precision]  # doctest: +NORMALIZE_WHITESPACE
+        [[1.0, 1.0], [1.0, 1.0], [0.25, 0.0, 1.0], [0.25, 0.0, 1.0]]
+    """
+    preds, target, num_classes, pos_label = _precision_recall_curve_update(preds, target, num_classes, pos_label)
+    return _precision_recall_curve_compute(preds, target, num_classes, pos_label, sample_weights)

@@ -1,0 +1,97 @@
+"""ROC curve.
+
+Parity target: reference ``torchmetrics/functional/classification/roc.py``
+(``_roc_compute`` :35-85 — prepend (0,0), error on all-pos/all-neg, per-class
+sweep incl. multilabel). Eager/epoch-end code (data-dependent output length);
+the jit-safe alternative is the binned family.
+"""
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_clf_curve,
+    _precision_recall_curve_update,
+)
+
+
+def _roc_update(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+) -> Tuple[Array, Array, int, int]:
+    return _precision_recall_curve_update(preds, target, num_classes, pos_label)
+
+
+def _roc_compute(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    pos_label: int,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    if num_classes == 1 and preds.ndim == 1:  # binary
+        fps, tps, thresholds = _binary_clf_curve(
+            preds=preds, target=target, sample_weights=sample_weights, pos_label=pos_label
+        )
+        # extra threshold so the curve starts at (0, 0)
+        tps = jnp.concatenate([jnp.zeros(1, dtype=tps.dtype), tps])
+        fps = jnp.concatenate([jnp.zeros(1, dtype=fps.dtype), fps])
+        thresholds = jnp.concatenate([thresholds[0][None] + 1, thresholds])
+
+        if float(fps[-1]) <= 0:
+            raise ValueError("No negative samples in targets, false positive value should be meaningless")
+        fpr = fps / fps[-1]
+
+        if float(tps[-1]) <= 0:
+            raise ValueError("No positive samples in targets, true positive value should be meaningless")
+        tpr = tps / tps[-1]
+
+        return fpr, tpr, thresholds
+
+    # per-class sweep (multiclass: one-vs-rest on labels; multilabel: per column)
+    fpr, tpr, thresholds = [], [], []
+    for c in range(num_classes):
+        if preds.shape == target.shape:
+            preds_c, target_c, pos_label_c = preds[:, c], target[:, c], 1
+        else:
+            preds_c, target_c, pos_label_c = preds[:, c], target, c
+        res = roc(
+            preds=preds_c,
+            target=target_c,
+            num_classes=1,
+            pos_label=pos_label_c,
+            sample_weights=sample_weights,
+        )
+        fpr.append(res[0])
+        tpr.append(res[1])
+        thresholds.append(res[2])
+
+    return fpr, tpr, thresholds
+
+
+def roc(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Receiver operating characteristic for binary/multiclass/multilabel input.
+
+    Example (binary):
+        >>> import jax.numpy as jnp
+        >>> pred = jnp.array([0, 1, 2, 3])
+        >>> target = jnp.array([0, 1, 1, 1])
+        >>> fpr, tpr, thresholds = roc(pred, target, pos_label=1)
+        >>> fpr
+        Array([0., 0., 0., 0., 1.], dtype=float32)
+        >>> tpr.tolist()  # doctest: +ELLIPSIS
+        [0.0, 0.333..., 0.666..., 1.0, 1.0]
+        >>> thresholds
+        Array([4, 3, 2, 1, 0], dtype=int32)
+    """
+    preds, target, num_classes, pos_label = _roc_update(preds, target, num_classes, pos_label)
+    return _roc_compute(preds, target, num_classes, pos_label, sample_weights)

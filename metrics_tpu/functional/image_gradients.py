@@ -1,0 +1,39 @@
+"""Image gradients via 1-step finite differences.
+
+Parity target: reference ``torchmetrics/functional/image_gradients.py:20-82``.
+"""
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def _image_gradients_validate(img: Array) -> None:
+    if not hasattr(img, "ndim"):
+        raise TypeError(f"The `img` expects an array type but got {type(img)}")
+    if img.ndim != 4:
+        raise RuntimeError(f"The `img` expects a 4D tensor but got {img.ndim}D tensor")
+
+
+def _compute_image_gradients(img: Array) -> Tuple[Array, Array]:
+    dy = jnp.pad(img[..., 1:, :] - img[..., :-1, :], ((0, 0), (0, 0), (0, 1), (0, 0)))
+    dx = jnp.pad(img[..., :, 1:] - img[..., :, :-1], ((0, 0), (0, 0), (0, 0), (0, 1)))
+    return dy, dx
+
+
+def image_gradients(img: Array) -> Tuple[Array, Array]:
+    """(dy, dx) finite-difference gradients of an ``(N, C, H, W)`` image batch.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> image = jnp.arange(0, 1*1*5*5, dtype=jnp.float32).reshape(1, 1, 5, 5)
+        >>> dy, dx = image_gradients(image)
+        >>> dy[0, 0, :, :]
+        Array([[5., 5., 5., 5., 5.],
+               [5., 5., 5., 5., 5.],
+               [5., 5., 5., 5., 5.],
+               [5., 5., 5., 5., 5.],
+               [0., 0., 0., 0., 0.]], dtype=float32)
+    """
+    _image_gradients_validate(img)
+    return _compute_image_gradients(img)

@@ -1,0 +1,77 @@
+"""BLEU score.
+
+Parity target: reference ``torchmetrics/functional/nlp.py`` (``_count_ngram``
+:26-45, ``bleu_score`` :48-112). Host-side by design — the inputs are Python
+token sequences, not arrays; the result is returned as a jnp scalar so it
+composes with the rest of the library.
+"""
+from collections import Counter
+from typing import List, Sequence
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def _count_ngram(ngram_input_list: List[str], n_gram: int) -> Counter:
+    """Counts of all 1..n grams in a token list."""
+    ngram_counter: Counter = Counter()
+    for i in range(1, n_gram + 1):
+        for j in range(len(ngram_input_list) - i + 1):
+            ngram_key = tuple(ngram_input_list[j:(i + j)])
+            ngram_counter[ngram_key] += 1
+    return ngram_counter
+
+
+def bleu_score(
+    translate_corpus: Sequence[Sequence[str]],
+    reference_corpus: Sequence[Sequence[Sequence[str]]],
+    n_gram: int = 4,
+    smooth: bool = False,
+) -> Array:
+    """BLEU of machine-translated text against one or more references.
+
+    Clipped n-gram precisions per order, brevity penalty, geometric mean;
+    optional Lin et al. 2004 smoothing.
+
+    Example:
+        >>> translate_corpus = ['the cat is on the mat'.split()]
+        >>> reference_corpus = [['there is a cat on the mat'.split(), 'a cat is on the mat'.split()]]
+        >>> round(float(bleu_score(translate_corpus, reference_corpus)), 4)
+        0.7598
+    """
+    assert len(translate_corpus) == len(reference_corpus)
+    numerator = [0.0] * n_gram
+    denominator = [0.0] * n_gram
+    c = 0.0
+    r = 0.0
+
+    for translation, references in zip(translate_corpus, reference_corpus):
+        c += len(translation)
+        ref_len_list = [len(ref) for ref in references]
+        ref_len_diff = [abs(len(translation) - x) for x in ref_len_list]
+        r += ref_len_list[ref_len_diff.index(min(ref_len_diff))]
+        translation_counter = _count_ngram(list(translation), n_gram)
+        reference_counter: Counter = Counter()
+        for ref in references:
+            reference_counter |= _count_ngram(list(ref), n_gram)
+
+        ngram_counter_clip = translation_counter & reference_counter
+        for counter_clip in ngram_counter_clip:
+            numerator[len(counter_clip) - 1] += ngram_counter_clip[counter_clip]
+        for counter in translation_counter:
+            denominator[len(counter) - 1] += translation_counter[counter]
+
+    if min(numerator) == 0.0:
+        return jnp.asarray(0.0)
+
+    num = jnp.asarray(numerator)
+    denom = jnp.asarray(denominator)
+    if smooth:
+        precision_scores = (num + 1.0) / (denom + 1.0)
+    else:
+        precision_scores = num / denom
+
+    log_precision_scores = (1.0 / n_gram) * jnp.log(precision_scores)
+    geometric_mean = jnp.exp(jnp.sum(log_precision_scores))
+    brevity_penalty = jnp.asarray(1.0) if c > r else jnp.exp(1 - (r / c))
+    return brevity_penalty * geometric_mean

@@ -1,0 +1,78 @@
+"""Explained variance from accumulated sufficient statistics.
+
+Parity: reference functional/regression/explained_variance.py:22-65 — variance
+from 5 moments so the metric is "sum"-reducible across batches and devices.
+"""
+from typing import Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def _explained_variance_update(preds: Array, target: Array) -> Tuple[int, Array, Array, Array, Array]:
+    _check_same_shape(preds, target)
+    n_obs = preds.shape[0]
+    sum_error = jnp.sum(target - preds, axis=0)
+    sum_squared_error = jnp.sum((target - preds) ** 2, axis=0)
+    sum_target = jnp.sum(target, axis=0)
+    sum_squared_target = jnp.sum(target**2, axis=0)
+    return n_obs, sum_error, sum_squared_error, sum_target, sum_squared_target
+
+
+def _explained_variance_compute(
+    n_obs: Union[int, Array],
+    sum_error: Array,
+    sum_squared_error: Array,
+    sum_target: Array,
+    sum_squared_target: Array,
+    multioutput: str = "uniform_average",
+) -> Union[Array, Sequence[Array]]:
+    diff_avg = sum_error / n_obs
+    numerator = sum_squared_error / n_obs - diff_avg**2
+
+    target_avg = sum_target / n_obs
+    denominator = sum_squared_target / n_obs - target_avg**2
+
+    # division-by-zero policy mirrors sklearn/reference: 1.0 when both zero,
+    # 0.0 when only the denominator is zero
+    nonzero_numerator = numerator != 0
+    nonzero_denominator = denominator != 0
+    output_scores = jnp.ones_like(diff_avg)
+    safe_denom = jnp.where(nonzero_denominator, denominator, 1.0)
+    output_scores = jnp.where(nonzero_numerator & nonzero_denominator, 1.0 - numerator / safe_denom, output_scores)
+    output_scores = jnp.where(nonzero_numerator & ~nonzero_denominator, 0.0, output_scores)
+
+    if multioutput == "raw_values":
+        return output_scores
+    if multioutput == "uniform_average":
+        return jnp.mean(output_scores)
+    if multioutput == "variance_weighted":
+        denom_sum = jnp.sum(denominator)
+        return jnp.sum(denominator / denom_sum * output_scores)
+    raise ValueError(f"Invalid input to multioutput: {multioutput}")
+
+
+def explained_variance(
+    preds: Array,
+    target: Array,
+    multioutput: str = "uniform_average",
+) -> Union[Array, Sequence[Array]]:
+    """Explained variance: 1 - Var(target - preds) / Var(target).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([3, -0.5, 2, 7])
+        >>> preds = jnp.array([2.5, 0.0, 2, 8])
+        >>> round(float(explained_variance(preds, target)), 4)
+        0.9572
+        >>> target = jnp.array([[0.5, 1], [-1, 1], [7, -6]])
+        >>> preds = jnp.array([[0, 2], [-1, 2], [8, -5]])
+        >>> [round(float(v), 4) for v in explained_variance(preds, target, multioutput='raw_values')]
+        [0.9677, 1.0]
+    """
+    n_obs, sum_error, sum_squared_error, sum_target, sum_squared_target = _explained_variance_update(preds, target)
+    return _explained_variance_compute(
+        n_obs, sum_error, sum_squared_error, sum_target, sum_squared_target, multioutput
+    )

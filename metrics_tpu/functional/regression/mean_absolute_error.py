@@ -1,0 +1,31 @@
+"""MAE. Parity: reference functional/regression/mean_absolute_error.py:22-30."""
+from typing import Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def _mean_absolute_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    sum_abs_error = jnp.sum(jnp.abs(preds - target))
+    return sum_abs_error, target.size
+
+
+def _mean_absolute_error_compute(sum_abs_error: Array, n_obs: Union[int, Array]) -> Array:
+    return sum_abs_error / n_obs
+
+
+def mean_absolute_error(preds: Array, target: Array) -> Array:
+    """Mean absolute error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> x = jnp.array([0., 1, 2, 3])
+        >>> y = jnp.array([0., 1, 2, 2])
+        >>> float(mean_absolute_error(x, y))
+        0.25
+    """
+    sum_abs_error, n_obs = _mean_absolute_error_update(preds, target)
+    return _mean_absolute_error_compute(sum_abs_error, n_obs)

@@ -1,0 +1,32 @@
+"""Mean relative error. Parity: reference functional/regression/mean_relative_error.py:22-55."""
+from typing import Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def _mean_relative_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    target_nz = jnp.where(target == 0, 1, target)
+    sum_rltv_error = jnp.sum(jnp.abs((preds - target) / target_nz))
+    return sum_rltv_error, target.size
+
+
+def _mean_relative_error_compute(sum_rltv_error: Array, n_obs: Union[int, Array]) -> Array:
+    return sum_rltv_error / n_obs
+
+
+def mean_relative_error(preds: Array, target: Array) -> Array:
+    """Mean relative error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> x = jnp.array([0., 1, 2, 3])
+        >>> y = jnp.array([0., 1, 2, 2])
+        >>> float(mean_relative_error(x, y))
+        0.125
+    """
+    sum_rltv_error, n_obs = _mean_relative_error_update(preds, target)
+    return _mean_relative_error_compute(sum_rltv_error, n_obs)

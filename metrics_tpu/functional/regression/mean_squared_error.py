@@ -1,0 +1,31 @@
+"""MSE. Parity: reference functional/regression/mean_squared_error.py:22-30."""
+from typing import Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def _mean_squared_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    sum_squared_error = jnp.sum((preds - target) ** 2)
+    return sum_squared_error, target.size
+
+
+def _mean_squared_error_compute(sum_squared_error: Array, n_obs: Union[int, Array]) -> Array:
+    return sum_squared_error / n_obs
+
+
+def mean_squared_error(preds: Array, target: Array) -> Array:
+    """Mean squared error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> x = jnp.array([0., 1, 2, 3])
+        >>> y = jnp.array([0., 1, 2, 2])
+        >>> float(mean_squared_error(x, y))
+        0.25
+    """
+    sum_squared_error, n_obs = _mean_squared_error_update(preds, target)
+    return _mean_squared_error_compute(sum_squared_error, n_obs)

@@ -1,0 +1,77 @@
+"""Peak signal-to-noise ratio.
+
+Parity: reference functional/regression/psnr.py (``_psnr_compute`` :22-31,
+``_psnr_update`` :34-57 incl. the per-``dim`` variant).
+"""
+from typing import Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.utils.prints import rank_zero_warn
+from metrics_tpu.utils.reductions import reduce
+
+
+def _psnr_compute(
+    sum_squared_error: Array,
+    n_obs: Array,
+    data_range: Array,
+    base: float = 10.0,
+    reduction: str = "elementwise_mean",
+) -> Array:
+    psnr_base_e = 2 * jnp.log(data_range) - jnp.log(sum_squared_error / n_obs)
+    psnr = psnr_base_e * (10 / jnp.log(jnp.asarray(base)))
+    return reduce(psnr, reduction=reduction)
+
+
+def _psnr_update(
+    preds: Array,
+    target: Array,
+    dim: Optional[Union[int, Tuple[int, ...]]] = None,
+) -> Tuple[Array, Array]:
+    if dim is None:
+        sum_squared_error = jnp.sum((preds - target) ** 2)
+        n_obs = jnp.asarray(target.size)
+        return sum_squared_error, n_obs
+
+    sum_squared_error = jnp.sum((preds - target) ** 2, axis=dim)
+    dim_list = [dim] if isinstance(dim, int) else list(dim)
+    if not dim_list:
+        n_obs = jnp.asarray(target.size)
+    else:
+        n_obs = jnp.asarray(int(np.prod([target.shape[d] for d in dim_list])))
+        n_obs = jnp.broadcast_to(n_obs, sum_squared_error.shape)
+    return sum_squared_error, n_obs
+
+
+def psnr(
+    preds: Array,
+    target: Array,
+    data_range: Optional[float] = None,
+    base: float = 10.0,
+    reduction: str = "elementwise_mean",
+    dim: Optional[Union[int, Tuple[int, ...]]] = None,
+) -> Array:
+    """PSNR = 10·log_b(range² · n / SSE).
+
+    ``data_range=None`` infers the range from the target (requires ``dim=None``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> pred = jnp.array([[0.0, 1.0], [2.0, 3.0]])
+        >>> target = jnp.array([[3.0, 2.0], [1.0, 0.0]])
+        >>> round(float(psnr(pred, target)), 4)
+        2.5527
+    """
+    if dim is None and reduction != "elementwise_mean":
+        rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
+
+    if data_range is None:
+        if dim is not None:
+            raise ValueError("The `data_range` must be given when `dim` is not None.")
+        data_range = jnp.max(target) - jnp.min(target)
+    else:
+        data_range = jnp.asarray(float(data_range))
+    sum_squared_error, n_obs = _psnr_update(preds, target, dim=dim)
+    return _psnr_compute(sum_squared_error, n_obs, data_range, base=base, reduction=reduction)

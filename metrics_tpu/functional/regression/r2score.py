@@ -1,0 +1,95 @@
+"""R² from accumulated moments.
+
+Parity: reference functional/regression/r2score.py:23-79 (1 - SSres/SStot with
+``adjusted`` df correction and raw/uniform/variance-weighted multioutput).
+"""
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+def _r2score_update(preds: Array, target: Array) -> Tuple[Array, Array, Array, int]:
+    _check_same_shape(preds, target)
+    if preds.ndim > 2:
+        raise ValueError(
+            "Expected both prediction and target to be 1D or 2D tensors,"
+            f" but received tensors with dimension {preds.shape}"
+        )
+    if preds.shape[0] < 2:
+        raise ValueError("Needs at least two samples to calculate r2 score.")
+
+    sum_error = jnp.sum(target, axis=0)
+    sum_squared_error = jnp.sum(target**2, axis=0)
+    residual = jnp.sum((target - preds) ** 2, axis=0)
+    total = target.shape[0]
+    return sum_squared_error, sum_error, residual, total
+
+
+def _r2score_compute(
+    sum_squared_error: Array,
+    sum_error: Array,
+    residual: Array,
+    total: Array,
+    adjusted: int = 0,
+    multioutput: str = "uniform_average",
+) -> Array:
+    mean_error = sum_error / total
+    diff = sum_squared_error - sum_error * mean_error
+    raw_scores = 1 - (residual / diff)
+
+    if multioutput == "raw_values":
+        r2score = raw_scores
+    elif multioutput == "uniform_average":
+        r2score = jnp.mean(raw_scores)
+    elif multioutput == "variance_weighted":
+        diff_sum = jnp.sum(diff)
+        r2score = jnp.sum(diff / diff_sum * raw_scores)
+    else:
+        raise ValueError(
+            "Argument `multioutput` must be either `raw_values`,"
+            f" `uniform_average` or `variance_weighted`. Received {multioutput}."
+        )
+
+    if adjusted < 0 or not isinstance(adjusted, int):
+        raise ValueError("`adjusted` parameter should be an integer larger or equal to 0.")
+
+    if adjusted != 0:
+        total_i = int(total)
+        if adjusted > total_i - 1:
+            rank_zero_warn(
+                "More independent regressions than data points in"
+                " adjusted r2 score. Falls back to standard r2 score.",
+                UserWarning,
+            )
+        elif adjusted == total_i - 1:
+            rank_zero_warn("Division by zero in adjusted r2 score. Falls back to standard r2 score.", UserWarning)
+        else:
+            r2score = 1 - (1 - r2score) * (total_i - 1) / (total_i - adjusted - 1)
+    return r2score
+
+
+def r2score(
+    preds: Array,
+    target: Array,
+    adjusted: int = 0,
+    multioutput: str = "uniform_average",
+) -> Array:
+    r"""R² (coefficient of determination): ``1 - SS_res / SS_tot``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([3, -0.5, 2, 7])
+        >>> preds = jnp.array([2.5, 0.0, 2, 8])
+        >>> round(float(r2score(preds, target)), 4)
+        0.9486
+        >>> target = jnp.array([[0.5, 1], [-1, 1], [7, -6]])
+        >>> preds = jnp.array([[0, 2], [-1, 2], [8, -5]])
+        >>> [round(float(v), 4) for v in r2score(preds, target, multioutput='raw_values')]
+        [0.9654, 0.9082]
+    """
+    sum_squared_error, sum_error, residual, total = _r2score_update(preds, target)
+    return _r2score_compute(sum_squared_error, sum_error, residual, total, adjusted, multioutput)

@@ -1,0 +1,9 @@
+from metrics_tpu.functional.retrieval.average_precision import retrieval_average_precision
+from metrics_tpu.functional.retrieval.ndcg import retrieval_normalized_dcg
+from metrics_tpu.functional.retrieval.segments import (
+    grouped_average_precision,
+    grouped_ndcg,
+    segment_positions,
+    sort_by_query_then_score,
+    within_segment_cumsum,
+)

@@ -1,0 +1,32 @@
+"""Single-query retrieval average precision.
+
+Parity: reference ``torchmetrics/functional/retrieval/average_precision.py:18-55``
+(sort targets by descending preds, mean of hit-rank / position).
+"""
+import jax.numpy as jnp
+from jax import Array
+
+
+def retrieval_average_precision(preds: Array, target: Array) -> Array:
+    """AP of one query's predictions against binary relevance labels.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([0.2, 0.3, 0.5])
+        >>> target = jnp.array([True, False, True])
+        >>> round(float(retrieval_average_precision(preds, target)), 4)
+        0.8333
+    """
+    if preds.shape != target.shape:
+        raise ValueError("`preds` and `target` must have the same shape and live on the same device")
+    if not (target.dtype == jnp.bool_ or jnp.issubdtype(target.dtype, jnp.integer)):
+        raise ValueError("`target` must be a tensor of booleans or integers")
+
+    target = target.astype(bool)
+    if int(jnp.sum(target)) == 0:
+        return jnp.asarray(0.0)
+
+    order = jnp.argsort(-preds.astype(jnp.float32), stable=True)
+    target = target[order]
+    positions = jnp.arange(1, target.shape[0] + 1, dtype=jnp.float32)[target]
+    return jnp.mean((jnp.arange(positions.shape[0], dtype=jnp.float32) + 1) / positions)

@@ -1,0 +1,37 @@
+"""Single-query normalized discounted cumulative gain.
+
+New metric requested by BASELINE.json (the reference snapshot ships only
+RetrievalMAP; NDCG follows the same ``RetrievalMetric`` contract). Linear gain,
+matching sklearn's ``ndcg_score`` default.
+"""
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def retrieval_normalized_dcg(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """NDCG of one query: DCG(preds order) / DCG(ideal order), linear gain.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([0.1, 0.9, 0.5])
+        >>> target = jnp.array([0, 1, 1])
+        >>> round(float(retrieval_normalized_dcg(preds, target)), 4)
+        1.0
+    """
+    if preds.shape != target.shape:
+        raise ValueError("`preds` and `target` must have the same shape")
+    k = target.shape[-1] if k is None else k
+    if not isinstance(k, int) or k <= 0:
+        raise ValueError("`k` has to be a positive integer or None")
+
+    target = target.astype(jnp.float32)
+    order = jnp.argsort(-preds.astype(jnp.float32), stable=True)
+    gains = target[order][:k]
+    discounts = 1.0 / jnp.log2(jnp.arange(gains.shape[0], dtype=jnp.float32) + 2.0)
+    dcg = jnp.sum(gains * discounts)
+
+    ideal_gains = jnp.sort(target)[::-1][:k]
+    idcg = jnp.sum(ideal_gains * discounts)
+    return jnp.where(idcg == 0, 0.0, dcg / jnp.where(idcg == 0, 1.0, idcg))

@@ -1,0 +1,38 @@
+"""Embedding similarity.
+
+Parity target: reference ``torchmetrics/functional/self_supervised.py:18-57``
+(cosine/dot ``batch @ batch.T``, zero diagonal, row mean/sum). The square
+similarity matmul runs on the MXU.
+"""
+import jax.numpy as jnp
+from jax import Array
+
+
+def embedding_similarity(
+    batch: Array, similarity: str = "cosine", reduction: str = "none", zero_diagonal: bool = True
+) -> Array:
+    """Pairwise representation similarity for a ``(batch, dim)`` array.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> embeddings = jnp.array([[1., 2., 3., 4.], [1., 2., 3., 4.], [4., 5., 6., 7.]])
+        >>> embedding_similarity(embeddings)
+        Array([[0.        , 1.        , 0.97589964],
+               [1.        , 0.        , 0.97589964],
+               [0.97589964, 0.97589964, 0.        ]], dtype=float32)
+    """
+    if similarity == "cosine":
+        norm = jnp.linalg.norm(batch, ord=2, axis=1)
+        batch = batch / norm[:, None]
+
+    sqr_mtx = jnp.matmul(batch, batch.T)
+
+    if zero_diagonal:
+        sqr_mtx = sqr_mtx * (1 - jnp.eye(sqr_mtx.shape[0], dtype=sqr_mtx.dtype))
+
+    if reduction == "mean":
+        sqr_mtx = jnp.mean(sqr_mtx, axis=-1)
+    if reduction == "sum":
+        sqr_mtx = jnp.sum(sqr_mtx, axis=-1)
+
+    return sqr_mtx

@@ -1,0 +1,73 @@
+"""ExplainedVariance module.
+
+Parity: reference torchmetrics/regression/explained_variance.py:26 — 5 "sum"
+sufficient statistics (:101-105, changed from cat-state per reference
+CHANGELOG "#68") so state is O(num_outputs) regardless of dataset size.
+"""
+from typing import Any, Callable, Optional, Sequence, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.regression.explained_variance import (
+    _explained_variance_compute,
+    _explained_variance_update,
+)
+
+
+class ExplainedVariance(Metric):
+    """Accumulated explained variance.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([3, -0.5, 2, 7])
+        >>> preds = jnp.array([2.5, 0.0, 2, 8])
+        >>> explained_variance = ExplainedVariance()
+        >>> round(float(explained_variance(preds, target)), 4)
+        0.9572
+    """
+
+    def __init__(
+        self,
+        multioutput: str = "uniform_average",
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        allowed_multioutput = ("raw_values", "uniform_average", "variance_weighted")
+        if multioutput not in allowed_multioutput:
+            raise ValueError(
+                f"Invalid input to argument `multioutput`. Choose one of the following: {allowed_multioutput}"
+            )
+        self.multioutput = multioutput
+        self.add_state("sum_error", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_squared_error", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_target", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_squared_target", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("n_obs", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        n_obs, sum_error, sum_squared_error, sum_target, sum_squared_target = _explained_variance_update(preds, target)
+        self.n_obs = self.n_obs + n_obs
+        self.sum_error = self.sum_error + sum_error
+        self.sum_squared_error = self.sum_squared_error + sum_squared_error
+        self.sum_target = self.sum_target + sum_target
+        self.sum_squared_target = self.sum_squared_target + sum_squared_target
+
+    def compute(self) -> Union[Array, Sequence[Array]]:
+        return _explained_variance_compute(
+            self.n_obs,
+            self.sum_error,
+            self.sum_squared_error,
+            self.sum_target,
+            self.sum_squared_target,
+            self.multioutput,
+        )

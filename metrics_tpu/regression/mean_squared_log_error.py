@@ -1,0 +1,49 @@
+"""MeanSquaredLogError module (reference torchmetrics/regression/mean_squared_log_error.py:26)."""
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.regression.mean_squared_log_error import (
+    _mean_squared_log_error_compute,
+    _mean_squared_log_error_update,
+)
+from metrics_tpu.utils.data import accum_int_dtype
+
+
+class MeanSquaredLogError(Metric):
+    """Accumulated mean squared log error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([2.5, 5, 4, 8])
+        >>> preds = jnp.array([3, 5, 2.5, 7])
+        >>> mean_squared_log_error = MeanSquaredLogError()
+        >>> round(float(mean_squared_log_error(preds, target)), 4)
+        0.0397
+    """
+
+    def __init__(
+        self,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.add_state("sum_squared_log_error", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        sum_squared_log_error, n_obs = _mean_squared_log_error_update(preds, target)
+        self.sum_squared_log_error = self.sum_squared_log_error + sum_squared_log_error
+        self.total = self.total + n_obs
+
+    def compute(self) -> Array:
+        return _mean_squared_log_error_compute(self.sum_squared_log_error, self.total)

@@ -1,0 +1,74 @@
+"""R2Score module (reference torchmetrics/regression/r2score.py:23, states :121-124)."""
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.regression.r2score import _r2score_compute, _r2score_update
+from metrics_tpu.utils.data import accum_int_dtype
+
+
+class R2Score(Metric):
+    r"""Accumulated R² (coefficient of determination).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([3, -0.5, 2, 7])
+        >>> preds = jnp.array([2.5, 0.0, 2, 8])
+        >>> r2score = R2Score()
+        >>> round(float(r2score(preds, target)), 4)
+        0.9486
+        >>> target = jnp.array([[0.5, 1], [-1, 1], [7, -6]])
+        >>> preds = jnp.array([[0, 2], [-1, 2], [8, -5]])
+        >>> r2score = R2Score(num_outputs=2, multioutput='raw_values')
+        >>> [round(float(v), 4) for v in r2score(preds, target)]
+        [0.9654, 0.9082]
+    """
+
+    def __init__(
+        self,
+        num_outputs: int = 1,
+        adjusted: int = 0,
+        multioutput: str = "uniform_average",
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+
+        self.num_outputs = num_outputs
+
+        if adjusted < 0 or not isinstance(adjusted, int):
+            raise ValueError("`adjusted` parameter should be an integer larger or equal to 0.")
+        self.adjusted = adjusted
+
+        allowed_multioutput = ("raw_values", "uniform_average", "variance_weighted")
+        if multioutput not in allowed_multioutput:
+            raise ValueError(
+                f"Invalid input to argument `multioutput`. Choose one of the following: {allowed_multioutput}"
+            )
+        self.multioutput = multioutput
+
+        self.add_state("sum_squared_error", default=jnp.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("sum_error", default=jnp.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("residual", default=jnp.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        sum_squared_error, sum_error, residual, total = _r2score_update(preds, target)
+        self.sum_squared_error = self.sum_squared_error + sum_squared_error
+        self.sum_error = self.sum_error + sum_error
+        self.residual = self.residual + residual
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _r2score_compute(
+            self.sum_squared_error, self.sum_error, self.residual, self.total, self.adjusted, self.multioutput
+        )

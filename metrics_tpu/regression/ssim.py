@@ -1,0 +1,76 @@
+"""SSIM module.
+
+Parity: reference torchmetrics/regression/ssim.py:24 — cat-states holding all
+raw images (:77-78), so memory grows with the dataset. To bound memory with
+jit-safe PaddedBuffer states instead, pass both ``capacity`` (max number of
+images) and ``image_shape`` (C, H, W).
+"""
+from typing import Any, Optional, Sequence, Tuple
+
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.regression.ssim import _ssim_compute, _ssim_update
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+class SSIM(Metric):
+    """Accumulated structural similarity (stores all images; memory grows with data).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.arange(0, 100 * 2, 2, dtype=jnp.float32).reshape(1, 1, 10, 10) / 200
+        >>> target = jnp.arange(0, 100, dtype=jnp.float32).reshape(1, 1, 10, 10) / 100
+        >>> ssim = SSIM()
+        >>> round(float(ssim(preds, target)), 4)
+        0.9219
+    """
+
+    def __init__(
+        self,
+        kernel_size: Sequence[int] = (11, 11),
+        sigma: Sequence[float] = (1.5, 1.5),
+        reduction: str = "elementwise_mean",
+        data_range: Optional[float] = None,
+        k1: float = 0.01,
+        k2: float = 0.03,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        capacity: Optional[int] = None,
+        image_shape: Optional[Tuple[int, int, int]] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            capacity=capacity,
+        )
+        rank_zero_warn(
+            "Metric `SSIM` will save all targets and"
+            " predictions in buffer. For large datasets this may lead"
+            " to large memory footprint."
+        )
+
+        self.add_state("y", default=[], dist_reduce_fx=None, item_shape=image_shape)
+        self.add_state("y_pred", default=[], dist_reduce_fx=None, item_shape=image_shape)
+        self.kernel_size = kernel_size
+        self.sigma = sigma
+        self.data_range = data_range
+        self.k1 = k1
+        self.k2 = k2
+        self.reduction = reduction
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _ssim_update(preds, target)
+        self._append("y_pred", preds)
+        self._append("y", target)
+
+    def compute(self) -> Array:
+        from metrics_tpu.parallel.buffer import as_values
+
+        preds = as_values(self.y_pred)
+        target = as_values(self.y)
+        return _ssim_compute(
+            preds, target, self.kernel_size, self.sigma, self.reduction, self.data_range, self.k1, self.k2
+        )

@@ -1,0 +1,25 @@
+"""RetrievalMAP (reference torchmetrics/retrieval/mean_average_precision.py:21)."""
+from jax import Array
+
+from metrics_tpu.functional.retrieval.segments import grouped_average_precision
+from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
+
+
+class RetrievalMAP(RetrievalMetric):
+    r"""Mean average precision over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.array([False, False, True, False, True, False, False])
+        >>> map = RetrievalMAP()
+        >>> float(map(indexes, preds, target))
+        0.75
+        >>> float(map.compute())
+        0.75
+    """
+
+    def _grouped_metric(self, dense_idx: Array, preds: Array, target: Array, num_queries: int) -> Array:
+        ap, _ = grouped_average_precision(dense_idx, preds, target.astype(bool), num_queries)
+        return ap

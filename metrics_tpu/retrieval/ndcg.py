@@ -1,0 +1,55 @@
+"""RetrievalNormalizedDCG — new metric on the RetrievalMetric base pattern.
+
+Not in the reference snapshot (it ships only RetrievalMAP,
+reference torchmetrics/retrieval/__init__.py); required by BASELINE.json's
+config list. Linear gain, matching sklearn's ``ndcg_score`` default.
+"""
+from typing import Any, Callable, Optional
+
+from jax import Array
+
+from metrics_tpu.functional.retrieval.segments import grouped_ndcg
+from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
+
+
+class RetrievalNormalizedDCG(RetrievalMetric):
+    r"""Mean NDCG over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.array([False, False, True, False, True, False, True])
+        >>> ndcg = RetrievalNormalizedDCG()
+        >>> round(float(ndcg(indexes, preds, target)), 4)
+        0.8467
+    """
+
+    def __init__(
+        self,
+        query_without_relevant_docs: str = "skip",
+        exclude: int = -100,
+        k: Optional[int] = None,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            query_without_relevant_docs=query_without_relevant_docs,
+            exclude=exclude,
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        if k is not None and (not isinstance(k, int) or k <= 0):
+            raise ValueError("`k` has to be a positive integer or None")
+        self.k = k
+
+    def _grouped_metric(self, dense_idx: Array, preds: Array, target: Array, num_queries: int) -> Array:
+        if self.k is not None:
+            raise NotImplementedError(
+                "top-k NDCG over ragged queries is not yet vectorized; use k=None"
+            )
+        return grouped_ndcg(dense_idx, preds, target, num_queries)

@@ -8,7 +8,7 @@ var still honored as an override for externally-launched process groups.
 import logging
 import os
 import warnings
-from functools import partial, wraps
+from functools import wraps
 from typing import Any, Callable
 
 log = logging.getLogger("metrics_tpu")
@@ -62,4 +62,4 @@ def _debug(*args: Any, **kwargs: Any) -> None:
 
 rank_zero_debug = rank_zero_only(_debug)
 rank_zero_info = rank_zero_only(_info)
-rank_zero_warn = rank_zero_only(partial(_warn, category=UserWarning))
+rank_zero_warn = rank_zero_only(_warn)

@@ -1,0 +1,134 @@
+"""MetricCollection semantics (mirrors reference tests/bases/test_collections.py:25-156)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import MetricCollection
+from tests.helpers.testers import DummyMetricDiff, DummyMetricSum
+
+
+def test_metric_collection():
+    m1 = DummyMetricSum()
+    m2 = DummyMetricDiff()
+
+    metric_collection = MetricCollection([m1, m2])
+
+    # by default, the keys are the class names
+    assert "DummyMetricSum" in metric_collection
+    assert "DummyMetricDiff" in metric_collection
+
+    # test correct initialization
+    for name, metric in metric_collection.items():
+        assert float(metric.x) == 0
+
+    # argument filtering: each metric sees only its own kwargs
+    metric_collection.update(x=jnp.asarray(10.0), y=jnp.asarray(20.0))
+    assert float(metric_collection["DummyMetricSum"].x) == 10
+    assert float(metric_collection["DummyMetricDiff"].x) == -20
+
+    results = metric_collection.compute()
+    assert float(results["DummyMetricSum"]) == 10
+    assert float(results["DummyMetricDiff"]) == -20
+
+    metric_collection.reset()
+    for name, metric in metric_collection.items():
+        assert float(metric.x) == 0
+
+
+def test_device_put():
+    import jax
+
+    metric_collection = MetricCollection([DummyMetricSum(), DummyMetricDiff()])
+    metric_collection.device_put(jax.devices()[0])
+    for _, metric in metric_collection.items():
+        assert metric.x.devices() == {jax.devices()[0]}
+
+
+def test_metric_collection_wrong_input():
+    m1 = DummyMetricSum()
+
+    # not a Metric
+    with pytest.raises(ValueError, match="is not an instance of"):
+        MetricCollection({"metric": 5})
+
+    with pytest.raises(ValueError, match="is not a instance of"):
+        MetricCollection([5])
+
+    # same name twice
+    with pytest.raises(ValueError, match="Encountered two metrics both named"):
+        MetricCollection([m1, m1.clone()])
+
+    with pytest.raises(ValueError, match="Unknown input to MetricCollection."):
+        MetricCollection(m1)
+
+
+def test_metric_collection_args_kwargs():
+    m1 = DummyMetricSum()
+    m2 = DummyMetricDiff()
+
+    metric_collection = MetricCollection([m1, m2])
+
+    # kwargs are filtered per update signature
+    metric_collection.update(x=jnp.asarray(10.0), y=jnp.asarray(20.0))
+    assert float(metric_collection["DummyMetricSum"].x) == 10
+    assert float(metric_collection["DummyMetricDiff"].x) == -20
+
+    metric_collection.reset()
+    results = metric_collection(x=jnp.asarray(10.0), y=jnp.asarray(20.0))
+    assert float(results["DummyMetricSum"]) == 10
+    assert float(results["DummyMetricDiff"]) == -20
+
+
+def test_metric_collection_prefix():
+    prefix = "prefix_"
+    metric_collection = MetricCollection([DummyMetricSum(), DummyMetricDiff()], prefix=prefix)
+
+    results = metric_collection(x=jnp.asarray(10.0), y=jnp.asarray(20.0))
+    for name in results:
+        assert name.startswith(prefix)
+
+    results = metric_collection.compute()
+    for name in results:
+        assert name.startswith(prefix)
+
+    # clone with new prefix
+    new_clone = metric_collection.clone(prefix="new_prefix_")
+    results = new_clone.compute()
+    for name in results:
+        assert name.startswith("new_prefix_")
+
+    with pytest.raises(ValueError, match="Expected input `prefix` to be a string"):
+        MetricCollection([DummyMetricSum()], prefix=5)
+
+
+def test_metric_collection_clone_independent():
+    collection = MetricCollection([DummyMetricSum()])
+    clone = collection.clone()
+    collection.update(x=jnp.asarray(5.0))
+    assert float(collection["DummyMetricSum"].x) == 5
+    assert float(clone["DummyMetricSum"].x) == 0
+
+
+def test_metric_collection_persistent():
+    collection = MetricCollection([DummyMetricSum()])
+    collection.persistent(True)
+    assert collection["DummyMetricSum"]._persistent["x"]
+
+
+def test_collection_pure_joint_update():
+    """The whole collection updates as one pure jitted step."""
+    import jax
+
+    collection = MetricCollection([DummyMetricSum(), DummyMetricDiff()])
+    pure = collection.pure()
+
+    @jax.jit
+    def step(state, x, y):
+        return pure.update(state, x=x, y=y)
+
+    state = pure.init()
+    state = step(state, jnp.asarray(4.0), jnp.asarray(1.0))
+    state = step(state, jnp.asarray(6.0), jnp.asarray(2.0))
+    out = pure.compute(state)
+    assert float(out["DummyMetricSum"]) == 10
+    assert float(out["DummyMetricDiff"]) == -3

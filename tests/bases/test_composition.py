@@ -1,0 +1,160 @@
+"""CompositionalMetric operator semantics (mirrors reference tests/bases/test_composition.py:51-500,
+one test per overloaded operator)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.core.metric import CompositionalMetric, Metric
+
+
+class DummyMetric(Metric):
+
+    def __init__(self, val_to_return):
+        super().__init__()
+        self.add_state("_num_updates", jnp.asarray(0), dist_reduce_fx="sum")
+        self._val_to_return = val_to_return
+
+    def update(self, *args, **kwargs) -> None:
+        self._num_updates = self._num_updates + 1
+
+    def compute(self):
+        return jnp.asarray(self._val_to_return)
+
+
+@pytest.mark.parametrize(["second_operand", "expected_result"], [(2, 4), (2.0, 4.0), (jnp.asarray(2), 4)])
+def test_metrics_add(second_operand, expected_result):
+    first_metric = DummyMetric(2)
+    final_add = first_metric + second_operand
+    final_radd = second_operand + first_metric
+    assert isinstance(final_add, CompositionalMetric)
+    assert isinstance(final_radd, CompositionalMetric)
+    final_add.update()
+    final_radd.update()
+    assert float(final_add.compute()) == expected_result
+    assert float(final_radd.compute()) == expected_result
+
+
+@pytest.mark.parametrize(["second_operand", "expected_result"], [(3, 2), (3.0, 2.0), (jnp.asarray(3), 2)])
+def test_metrics_div(second_operand, expected_result):
+    first_metric = DummyMetric(6)
+    final_div = first_metric / second_operand
+    assert isinstance(final_div, CompositionalMetric)
+    final_div.update()
+    assert float(final_div.compute()) == expected_result
+
+
+@pytest.mark.parametrize(["second_operand", "expected_result"], [(2, 4), (2.0, 4.0)])
+def test_metrics_mul(second_operand, expected_result):
+    first_metric = DummyMetric(2)
+    final_mul = first_metric * second_operand
+    final_rmul = second_operand * first_metric
+    final_mul.update()
+    final_rmul.update()
+    assert float(final_mul.compute()) == expected_result
+    assert float(final_rmul.compute()) == expected_result
+
+
+@pytest.mark.parametrize(["second_operand", "expected_result"], [(2, 1), (2.0, 1.0)])
+def test_metrics_sub(second_operand, expected_result):
+    first_metric = DummyMetric(3)
+    final_sub = first_metric - second_operand
+    final_rsub = second_operand - first_metric
+    final_sub.update()
+    final_rsub.update()
+    assert float(final_sub.compute()) == expected_result
+    assert float(final_rsub.compute()) == -expected_result
+
+
+@pytest.mark.parametrize(["second_operand", "expected_result"], [(2, 9), (2.0, 9.0)])
+def test_metrics_pow(second_operand, expected_result):
+    first_metric = DummyMetric(3)
+    final_pow = first_metric**second_operand
+    final_pow.update()
+    assert float(final_pow.compute()) == expected_result
+
+
+@pytest.mark.parametrize(["second_operand", "expected_result"], [(5, 1), (5.0, 1.0)])
+def test_metrics_mod(second_operand, expected_result):
+    first_metric = DummyMetric(11)
+    final_mod = first_metric % second_operand
+    final_mod.update()
+    assert float(final_mod.compute()) == expected_result
+
+
+@pytest.mark.parametrize(["second_operand", "expected_result"], [(2, 2), (2.0, 2.0)])
+def test_metrics_floordiv(second_operand, expected_result):
+    first_metric = DummyMetric(5)
+    final_floordiv = first_metric // second_operand
+    final_floordiv.update()
+    assert float(final_floordiv.compute()) == expected_result
+
+
+def test_metrics_matmul():
+    first_metric = DummyMetric([2, 2, 2])
+    second_operand = jnp.asarray([2, 2, 2])
+    final_matmul = first_metric @ second_operand
+    final_matmul.update()
+    assert float(final_matmul.compute()) == 12
+
+
+@pytest.mark.parametrize("op,expected", [("and", 2), ("or", 6), ("xor", 4)])
+def test_metrics_bitwise(op, expected):
+    first_metric = DummyMetric(2)
+    second_operand = jnp.asarray(6)
+    if op == "and":
+        composed = first_metric & second_operand
+    elif op == "or":
+        composed = first_metric | second_operand
+    else:
+        composed = first_metric ^ second_operand
+    composed.update()
+    assert int(composed.compute()) == expected
+
+
+@pytest.mark.parametrize(
+    "op,expected",
+    [("lt", False), ("le", False), ("gt", True), ("ge", True), ("eq", False), ("ne", True)],
+)
+def test_metrics_comparisons(op, expected):
+    first_metric = DummyMetric(3)
+    second_operand = 2
+    composed = {
+        "lt": first_metric < second_operand,
+        "le": first_metric <= second_operand,
+        "gt": first_metric > second_operand,
+        "ge": first_metric >= second_operand,
+        "eq": first_metric == second_operand,
+        "ne": first_metric != second_operand,
+    }[op]
+    composed.update()
+    assert bool(composed.compute()) is expected
+
+
+def test_metrics_abs_neg_pos_invert():
+    m = DummyMetric(-2)
+    assert float(abs(m).compute()) == 2
+    # reference quirk: __neg__ is -abs(x) (reference metric.py:453-454)
+    assert float((-m).compute()) == -2
+    assert float((-DummyMetric(2)).compute()) == -2
+    assert float((+m).compute()) == 2
+    assert int((~DummyMetric(1)).compute()) == -2
+
+
+def test_compositional_update_broadcast():
+    """update() on the composition updates both children with filtered kwargs."""
+    m1 = DummyMetric(2)
+    m2 = DummyMetric(3)
+    composed = m1 + m2
+    composed.update()
+    assert int(m1._num_updates) == 1
+    assert int(m2._num_updates) == 1
+    composed.reset()
+    assert int(m1._num_updates) == 0
+
+
+def test_metrics_chained_operations():
+    first = DummyMetric(2)
+    second = DummyMetric(3)
+    composed = (first + second) * 2 - 4
+    composed.update()
+    assert float(composed.compute()) == 6

@@ -1,0 +1,254 @@
+"""Core Metric runtime semantics (mirrors reference tests/bases/test_metric.py:29-239)."""
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Metric
+from tests.helpers.testers import DummyListMetric, DummyMetric, DummyMetricSum
+
+
+def test_inherit():
+    DummyMetric()
+
+
+def test_add_state():
+    a = DummyMetric()
+
+    a.add_state("a", jnp.asarray(0.0), "sum")
+    assert np.asarray(a._defaults["a"]) == 0
+
+    a.add_state("b", jnp.asarray(0.0), "mean")
+    a.add_state("c", jnp.asarray(0.0), "cat")
+    a.add_state("d", [], None)
+
+    with pytest.raises(ValueError):
+        a.add_state("e", jnp.asarray(0.0), "xyz")
+
+    with pytest.raises(ValueError):
+        a.add_state("e", jnp.asarray(0.0), 42)
+
+    with pytest.raises(ValueError):
+        a.add_state("e", "abc", "sum")
+
+    with pytest.raises(ValueError):
+        a.add_state("e", [jnp.asarray(0.0)], "sum")
+
+    # custom reduce functions are accepted
+    a.add_state("e", jnp.asarray(0.0), lambda x: jnp.sum(x, axis=0))
+
+
+def test_add_state_persistent():
+    a = DummyMetric()
+    a.add_state("a", jnp.asarray(0.0), "sum", persistent=True)
+    assert "a" in a.state_dict()
+
+    a.add_state("b", jnp.asarray(0.0), "sum", persistent=False)
+    assert "b" not in a.state_dict()
+
+
+def test_reset():
+    class A(DummyMetric):
+        pass
+
+    class B(DummyListMetric):
+        pass
+
+    a = A()
+    assert float(a.x) == 0
+    a.x = jnp.asarray(5.0)
+    a.reset()
+    assert float(a.x) == 0
+
+    b = B()
+    assert isinstance(b.x, list) and len(b.x) == 0
+    b.x = jnp.asarray(5.0)
+    b.reset()
+    assert isinstance(b.x, list) and len(b.x) == 0
+
+
+def test_update():
+    class A(DummyMetric):
+
+        def update(self, x):
+            self.x = self.x + x
+
+    a = A()
+    assert float(a.x) == 0
+    assert a._computed is None
+    a.update(1)
+    assert a._computed is None
+    assert float(a.x) == 1
+    a.update(2)
+    assert float(a.x) == 3
+    assert a._computed is None
+
+
+def test_compute():
+    class A(DummyMetric):
+
+        def update(self, x):
+            self.x = self.x + x
+
+        def compute(self):
+            return self.x
+
+    a = A()
+    assert float(a.compute()) == 0
+    assert float(a.x) == 0
+    a.update(1)
+    assert a._computed is None
+    assert float(a.compute()) == 1
+    assert float(a._computed) == 1
+    a.update(2)
+    assert a._computed is None
+    assert float(a.compute()) == 3
+    assert float(a._computed) == 3
+
+    # called without update, the cached result is returned
+    _ = a.compute()
+    assert float(a._computed) == 3
+
+
+def test_hash():
+    metric_1 = DummyMetric()
+    metric_2 = DummyMetric()
+    assert hash(metric_1) != hash(metric_2)
+
+    metric_1 = DummyListMetric()
+    metric_2 = DummyListMetric()
+    assert hash(metric_1) != hash(metric_2)
+    assert isinstance(metric_1.x, list) and len(metric_1.x) == 0
+    metric_1.x.append(jnp.asarray(5.0))
+    hash_1 = hash(metric_1)
+    metric_1.x.append(jnp.asarray(10.0))
+    hash_2 = hash(metric_1)
+    assert hash_1 != hash_2
+
+
+def test_forward():
+    class A(DummyMetric):
+
+        def update(self, x):
+            self.x = self.x + x
+
+        def compute(self):
+            return self.x
+
+    a = A()
+    assert float(a(5)) == 5
+    assert float(a._forward_cache) == 5
+
+    assert float(a(8)) == 8
+    assert float(a._forward_cache) == 8
+
+    assert float(a.compute()) == 13
+
+
+def test_forward_compute_on_step_false():
+    class A(DummyMetric):
+
+        def update(self, x):
+            self.x = self.x + x
+
+        def compute(self):
+            return self.x
+
+    a = A(compute_on_step=False)
+    assert a(5) is None
+    assert a(8) is None
+    assert float(a.compute()) == 13
+
+
+def test_pickle():
+    a = DummyMetricSum()
+    a.update(1)
+
+    metric_pickled = pickle.dumps(a)
+    metric_loaded = pickle.loads(metric_pickled)
+    assert float(metric_loaded.compute()) == 1
+
+    metric_loaded.update(5)
+    assert float(metric_loaded.compute()) == 6
+
+
+def test_state_dict():
+    """Persistent states round-trip through state_dict/load_state_dict."""
+
+    class A(DummyMetric):
+
+        def __init__(self):
+            super().__init__()
+            self.add_state("persistent_state", jnp.asarray(0.0), "sum", persistent=True)
+
+        def update(self, x):
+            self.persistent_state = self.persistent_state + x
+
+        def compute(self):
+            return self.persistent_state
+
+    a = A()
+    a.update(10.0)
+    sd = a.state_dict()
+    assert float(sd["persistent_state"]) == 10
+
+    b = A()
+    b.load_state_dict(sd)
+    assert float(b.compute()) == 10
+
+
+def test_clone_is_independent():
+    a = DummyMetricSum()
+    a.update(5)
+    b = a.clone()
+    b.update(3)
+    assert float(a.compute()) == 5
+    assert float(b.compute()) == 8
+
+
+def test_device_and_dtype():
+    """States can be placed on devices/shardings and cast; reset preserves both."""
+    import jax
+
+    a = DummyMetricSum()
+    a.update(3.0)
+    a.device_put(jax.devices()[0])
+    assert a.x.devices() == {jax.devices()[0]}
+
+    a.astype(jnp.bfloat16)
+    assert a.x.dtype == jnp.bfloat16
+    a.reset()
+    assert a.x.dtype == jnp.bfloat16
+    assert a.x.devices() == {jax.devices()[0]}
+
+
+def test_pure_api_roundtrip():
+    """init/update/compute/merge pure functions agree with the stateful API."""
+
+    class SumMetric(Metric):
+
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("x", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.x = self.x + x
+
+        def compute(self):
+            return self.x
+
+    a = SumMetric()
+    pure = a.pure()
+    state = pure.init()
+    state = pure.update(state, 2.0)
+    state = pure.update(state, 3.0)
+    assert float(pure.compute(state)) == 5.0
+
+    s1 = pure.update(pure.init(), 2.0)
+    s2 = pure.update(pure.init(), 3.0)
+    merged = pure.merge(s1, s2)
+    assert float(pure.compute(merged)) == 5.0
+
+    # the stateful instance was untouched by the pure calls
+    assert float(a.x) == 0.0

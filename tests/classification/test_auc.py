@@ -1,0 +1,70 @@
+"""AUC vs sklearn auc (mirrors reference tests/classification/test_auc.py)."""
+from collections import namedtuple
+from functools import partial
+
+import numpy as np
+import pytest
+from sklearn.metrics import auc as sk_auc
+
+from metrics_tpu import AUC
+from metrics_tpu.functional import auc
+from tests.helpers.testers import NUM_BATCHES, MetricTester
+
+
+def sk_auc_wrapper(x, y):
+    return sk_auc(x, y)
+
+
+Input = namedtuple("Input", ["x", "y"])
+
+_examples = []
+# generate already ordered samples, sorted in both directions
+_rng = np.random.RandomState(314159)
+for i in range(4):
+    x = _rng.rand(NUM_BATCHES * 8)
+    y = _rng.rand(NUM_BATCHES * 8)
+    idx = np.argsort(x, kind="stable")
+    x = x[idx] if i % 2 == 0 else x[idx[::-1]]
+    y = y[idx] if i % 2 == 0 else x[idx[::-1]]
+    x = x.reshape(NUM_BATCHES, 8).astype(np.float32)
+    y = y.reshape(NUM_BATCHES, 8).astype(np.float32)
+    _examples.append(Input(x=x, y=y))
+
+
+@pytest.mark.parametrize("x, y", _examples)
+class TestAUC(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [False])
+    @pytest.mark.parametrize("dist_sync_on_step", [False])
+    def test_auc(self, x, y, ddp, dist_sync_on_step):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=x,
+            target=y,
+            metric_class=AUC,
+            sk_metric=sk_auc_wrapper,
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={"reorder": True},
+            check_batch=False,
+            check_dist_sync_on_step=False,
+        )
+
+    def test_auc_fn(self, x, y):
+        import jax.numpy as jnp
+
+        full_x = x.reshape(-1)
+        full_y = y.reshape(-1)
+        result = auc(jnp.asarray(full_x), jnp.asarray(full_y), reorder=True)
+        idx = np.argsort(full_x, kind="stable")
+        np.testing.assert_allclose(float(result), sk_auc(full_x[idx], full_y[idx]), atol=1e-4)
+
+
+@pytest.mark.parametrize(["x", "y", "expected"], [([0, 1], [0, 1], 0.5), ([1, 0], [0, 1], 0.5),
+                                                  ([1, 0, 0], [0, 1, 1], 0.5), ([0, 1], [1, 1], 1),
+                                                  ([0, 0.5, 1], [0, 0.5, 1], 0.5)])
+def test_auc_basic(x, y, expected):
+    import jax.numpy as jnp
+
+    # Test Area Under Curve (AUC) computation
+    assert float(auc(jnp.asarray(x, dtype=jnp.float32), jnp.asarray(y, dtype=jnp.float32), reorder=True)) == expected

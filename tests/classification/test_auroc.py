@@ -1,0 +1,121 @@
+"""AUROC vs sklearn roc_auc_score (mirrors reference tests/classification/test_auroc.py)."""
+from functools import partial
+
+import numpy as np
+import pytest
+from sklearn.metrics import roc_auc_score as sk_roc_auc_score
+
+from metrics_tpu import AUROC
+from metrics_tpu.functional import auroc
+from tests.classification.inputs import (
+    _input_binary_prob,
+    _input_multiclass_prob,
+    _input_multidim_multiclass_prob,
+    _input_multilabel_multidim_prob,
+    _input_multilabel_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, MetricTester
+
+
+def _sk_auroc_binary_prob(preds, target, num_classes, average="macro", max_fpr=None, multi_class="raise"):
+    return sk_roc_auc_score(y_true=target, y_score=preds, average=average, max_fpr=max_fpr)
+
+
+def _sk_auroc_multiclass_prob(preds, target, num_classes, average="macro", max_fpr=None):
+    return sk_roc_auc_score(
+        y_true=target,
+        y_score=preds,
+        average=average,
+        max_fpr=max_fpr,
+        multi_class="ovr",
+        labels=list(range(num_classes)),
+    )
+
+
+def _sk_auroc_multidim_multiclass_prob(preds, target, num_classes, average="macro", max_fpr=None):
+    preds = np.swapaxes(preds, 1, 2).reshape(-1, num_classes)
+    target = target.reshape(-1)
+    return _sk_auroc_multiclass_prob(preds, target, num_classes, average, max_fpr)
+
+
+def _sk_auroc_multilabel_prob(preds, target, num_classes, average="macro", max_fpr=None):
+    return sk_roc_auc_score(y_true=target, y_score=preds, average=average, max_fpr=max_fpr)
+
+
+def _sk_auroc_multilabel_multidim_prob(preds, target, num_classes, average="macro", max_fpr=None):
+    preds = np.swapaxes(preds, 1, 2).reshape(-1, num_classes)
+    target = np.swapaxes(target, 1, 2).reshape(-1, num_classes)
+    return sk_roc_auc_score(y_true=target, y_score=preds, average=average, max_fpr=max_fpr)
+
+
+@pytest.mark.parametrize(
+    "preds, target, sk_metric, num_classes",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target, _sk_auroc_binary_prob, 1),
+        (_input_multiclass_prob.preds, _input_multiclass_prob.target, _sk_auroc_multiclass_prob, NUM_CLASSES),
+        (
+            _input_multidim_multiclass_prob.preds, _input_multidim_multiclass_prob.target,
+            _sk_auroc_multidim_multiclass_prob, NUM_CLASSES
+        ),
+        (_input_multilabel_prob.preds, _input_multilabel_prob.target, _sk_auroc_multilabel_prob, NUM_CLASSES),
+        (
+            _input_multilabel_multidim_prob.preds, _input_multilabel_multidim_prob.target,
+            _sk_auroc_multilabel_multidim_prob, NUM_CLASSES
+        ),
+    ],
+)
+@pytest.mark.parametrize("average", ["macro", "weighted", "micro"])
+@pytest.mark.parametrize("max_fpr", [None, 0.8, 0.5])
+class TestAUROC(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("ddp", [False])
+    @pytest.mark.parametrize("dist_sync_on_step", [False])
+    def test_auroc(self, preds, target, sk_metric, num_classes, average, max_fpr, ddp, dist_sync_on_step):
+        # max_fpr only supported for binary; micro only for multilabel (sklearn limitation for ovr)
+        if max_fpr is not None and num_classes != 1:
+            pytest.skip("max_fpr only supported for binary problems")
+        if average == "micro" and (num_classes == 1 or sk_metric in (_sk_auroc_multiclass_prob,
+                                                                    _sk_auroc_multidim_multiclass_prob)):
+            pytest.skip("micro average only tested for multilabel")
+
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=AUROC,
+            sk_metric=partial(sk_metric, num_classes=num_classes, average=average, max_fpr=max_fpr),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={"num_classes": num_classes if num_classes > 1 else None, "average": average,
+                         "max_fpr": max_fpr},
+            check_batch=False,
+            check_dist_sync_on_step=False,
+        )
+
+    def test_auroc_fn(self, preds, target, sk_metric, num_classes, average, max_fpr):
+        if max_fpr is not None and num_classes != 1:
+            pytest.skip("max_fpr only supported for binary problems")
+        if average == "micro" and (num_classes == 1 or sk_metric in (_sk_auroc_multiclass_prob,
+                                                                    _sk_auroc_multidim_multiclass_prob)):
+            pytest.skip("micro average only tested for multilabel")
+
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=auroc,
+            sk_metric=partial(sk_metric, num_classes=num_classes, average=average, max_fpr=max_fpr),
+            metric_args={"num_classes": num_classes if num_classes > 1 else None, "average": average,
+                         "max_fpr": max_fpr},
+        )
+
+
+def test_error_on_different_mode():
+    import jax.numpy as jnp
+
+    metric = AUROC()
+    metric(jnp.asarray(np.random.rand(20)), jnp.asarray(np.random.randint(0, 2, 20)))
+    with pytest.raises(ValueError, match=r"The mode of data.* should be constant"):
+        rng = np.random.RandomState(0)
+        probs = rng.rand(20, 4).astype(np.float32)
+        probs = probs / probs.sum(-1, keepdims=True)
+        metric(jnp.asarray(probs), jnp.asarray(rng.randint(0, 4, 20)))

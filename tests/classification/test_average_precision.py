@@ -1,0 +1,93 @@
+"""AveragePrecision vs sklearn (mirrors reference tests/classification/test_average_precision.py)."""
+from functools import partial
+
+import numpy as np
+import pytest
+from sklearn.metrics import average_precision_score as sk_average_precision_score
+
+from metrics_tpu import AveragePrecision
+from metrics_tpu.functional import average_precision
+from tests.classification.inputs import (
+    _input_binary_prob,
+    _input_multiclass_prob,
+    _input_multidim_multiclass_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, MetricTester
+
+
+def _sk_average_precision_binary_prob(preds, target, num_classes=1):
+    return sk_average_precision_score(y_true=target, y_score=preds)
+
+
+def _sk_average_precision_multiclass_prob(preds, target, num_classes=1):
+    res = []
+    for i in range(num_classes):
+        target_temp = np.zeros_like(target)
+        target_temp[target == i] = 1
+        res.append(sk_average_precision_score(target_temp, preds[:, i]))
+    return res
+
+
+def _sk_average_precision_multidim_multiclass_prob(preds, target, num_classes=1):
+    preds = np.swapaxes(preds, 1, 2).reshape(-1, num_classes)
+    target = target.reshape(-1)
+    return _sk_average_precision_multiclass_prob(preds, target, num_classes)
+
+
+@pytest.mark.parametrize(
+    "preds, target, sk_metric, num_classes",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target, _sk_average_precision_binary_prob, 1),
+        (
+            _input_multiclass_prob.preds, _input_multiclass_prob.target, _sk_average_precision_multiclass_prob,
+            NUM_CLASSES
+        ),
+        (
+            _input_multidim_multiclass_prob.preds, _input_multidim_multiclass_prob.target,
+            _sk_average_precision_multidim_multiclass_prob, NUM_CLASSES
+        ),
+    ],
+)
+class TestAveragePrecision(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("dist_sync_on_step", [False])
+    def test_average_precision(self, preds, target, sk_metric, num_classes, ddp, dist_sync_on_step):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=AveragePrecision,
+            sk_metric=partial(sk_metric, num_classes=num_classes),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={"num_classes": num_classes},
+            check_batch=False,
+            check_dist_sync_on_step=False,
+        )
+
+    def test_average_precision_fn(self, preds, target, sk_metric, num_classes):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=average_precision,
+            sk_metric=partial(sk_metric, num_classes=num_classes),
+            metric_args={"num_classes": num_classes},
+        )
+
+
+@pytest.mark.parametrize(
+    ["scores", "target", "expected_score"],
+    [
+        # constant predictor: AP == fraction of positives (single threshold)
+        # (reference test_average_precision.py:95-107)
+        ([1, 1, 1, 1], [0, 0, 0, 1], 0.25),
+        # with threshold 0.8 : 1 TP and 2 TN and one FN
+        ([0.6, 0.7, 0.8, 9], [1, 0, 0, 1], 0.75),
+    ],
+)
+def test_average_precision_score(scores, target, expected_score):
+    import jax.numpy as jnp
+
+    result = average_precision(jnp.asarray(scores, dtype=jnp.float32), jnp.asarray(target))
+    assert np.isclose(float(result), expected_score)

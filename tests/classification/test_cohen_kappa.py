@@ -1,0 +1,112 @@
+"""CohenKappa vs sklearn (mirrors reference tests/classification/test_cohen_kappa.py)."""
+from functools import partial
+
+import numpy as np
+import pytest
+from sklearn.metrics import cohen_kappa_score as sk_cohen_kappa
+
+from metrics_tpu import CohenKappa
+from metrics_tpu.functional import cohen_kappa
+from tests.classification.inputs import (
+    _input_binary,
+    _input_binary_prob,
+    _input_multiclass,
+    _input_multiclass_prob,
+    _input_multidim_multiclass,
+    _input_multidim_multiclass_prob,
+    _input_multilabel,
+    _input_multilabel_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _sk_cohen_kappa_binary_prob(preds, target, weights=None):
+    sk_preds = (preds >= THRESHOLD).astype(np.uint8)
+    return sk_cohen_kappa(y1=target, y2=sk_preds, weights=weights)
+
+
+def _sk_cohen_kappa_binary(preds, target, weights=None):
+    return sk_cohen_kappa(y1=target, y2=preds, weights=weights)
+
+
+def _sk_cohen_kappa_multilabel_prob(preds, target, weights=None):
+    sk_preds = (preds >= THRESHOLD).astype(np.uint8)
+    return sk_cohen_kappa(y1=target.reshape(-1), y2=sk_preds.reshape(-1), weights=weights)
+
+
+def _sk_cohen_kappa_multilabel(preds, target, weights=None):
+    return sk_cohen_kappa(y1=target.reshape(-1), y2=preds.reshape(-1), weights=weights)
+
+
+def _sk_cohen_kappa_multiclass_prob(preds, target, weights=None):
+    sk_preds = np.argmax(preds, axis=len(preds.shape) - 1)
+    return sk_cohen_kappa(y1=target, y2=sk_preds, weights=weights)
+
+
+def _sk_cohen_kappa_multiclass(preds, target, weights=None):
+    return sk_cohen_kappa(y1=target, y2=preds, weights=weights)
+
+
+def _sk_cohen_kappa_multidim_multiclass_prob(preds, target, weights=None):
+    sk_preds = np.argmax(preds, axis=1).reshape(-1)
+    return sk_cohen_kappa(y1=target.reshape(-1), y2=sk_preds, weights=weights)
+
+
+def _sk_cohen_kappa_multidim_multiclass(preds, target, weights=None):
+    return sk_cohen_kappa(y1=target.reshape(-1), y2=preds.reshape(-1), weights=weights)
+
+
+@pytest.mark.parametrize("weights", ["linear", "quadratic", None])
+@pytest.mark.parametrize(
+    "preds, target, sk_metric, num_classes",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target, _sk_cohen_kappa_binary_prob, 2),
+        (_input_binary.preds, _input_binary.target, _sk_cohen_kappa_binary, 2),
+        (_input_multilabel_prob.preds, _input_multilabel_prob.target, _sk_cohen_kappa_multilabel_prob, 2),
+        (_input_multilabel.preds, _input_multilabel.target, _sk_cohen_kappa_multilabel, 2),
+        (_input_multiclass_prob.preds, _input_multiclass_prob.target, _sk_cohen_kappa_multiclass_prob, NUM_CLASSES),
+        (_input_multiclass.preds, _input_multiclass.target, _sk_cohen_kappa_multiclass, NUM_CLASSES),
+        (
+            _input_multidim_multiclass_prob.preds, _input_multidim_multiclass_prob.target,
+            _sk_cohen_kappa_multidim_multiclass_prob, NUM_CLASSES
+        ),
+        (
+            _input_multidim_multiclass.preds, _input_multidim_multiclass.target,
+            _sk_cohen_kappa_multidim_multiclass, NUM_CLASSES
+        ),
+    ],
+)
+class TestCohenKappa(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("ddp", [False])
+    @pytest.mark.parametrize("dist_sync_on_step", [False])
+    def test_cohen_kappa_class(self, weights, preds, target, sk_metric, num_classes, ddp, dist_sync_on_step):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=CohenKappa,
+            sk_metric=partial(sk_metric, weights=weights),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={"num_classes": num_classes, "threshold": THRESHOLD, "weights": weights},
+        )
+
+    def test_cohen_kappa_fn(self, weights, preds, target, sk_metric, num_classes):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=cohen_kappa,
+            sk_metric=partial(sk_metric, weights=weights),
+            metric_args={"num_classes": num_classes, "threshold": THRESHOLD, "weights": weights},
+        )
+
+
+def test_warning_on_wrong_weights():
+    import jax.numpy as jnp
+
+    preds = jnp.asarray(np.random.randint(3, size=20))
+    target = jnp.asarray(np.random.randint(3, size=20))
+
+    with pytest.raises(ValueError, match=".* ``weights`` but should be either None, 'linear' or 'quadratic'"):
+        cohen_kappa(preds, target, num_classes=3, weights="unknown_arg")

@@ -1,0 +1,112 @@
+"""ConfusionMatrix vs sklearn (mirrors reference tests/classification/test_confusion_matrix.py)."""
+from functools import partial
+
+import numpy as np
+import pytest
+from sklearn.metrics import confusion_matrix as sk_confusion_matrix
+
+from metrics_tpu import ConfusionMatrix
+from metrics_tpu.functional import confusion_matrix
+from tests.classification.inputs import (
+    _input_binary,
+    _input_binary_prob,
+    _input_multiclass,
+    _input_multiclass_prob,
+    _input_multidim_multiclass,
+    _input_multidim_multiclass_prob,
+    _input_multilabel,
+    _input_multilabel_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _sk_cm_binary_prob(preds, target, normalize=None):
+    sk_preds = (preds >= THRESHOLD).astype(np.uint8)
+    return sk_confusion_matrix(y_true=target, y_pred=sk_preds, normalize=normalize)
+
+
+def _sk_cm_binary(preds, target, normalize=None):
+    return sk_confusion_matrix(y_true=target, y_pred=preds, normalize=normalize)
+
+
+def _sk_cm_multilabel_prob(preds, target, normalize=None):
+    sk_preds = (preds >= THRESHOLD).astype(np.uint8)
+    return sk_confusion_matrix(y_true=target.reshape(-1), y_pred=sk_preds.reshape(-1), normalize=normalize)
+
+
+def _sk_cm_multilabel(preds, target, normalize=None):
+    return sk_confusion_matrix(y_true=target.reshape(-1), y_pred=preds.reshape(-1), normalize=normalize)
+
+
+def _sk_cm_multiclass_prob(preds, target, normalize=None):
+    sk_preds = np.argmax(preds, axis=len(preds.shape) - 1)
+    return sk_confusion_matrix(y_true=target, y_pred=sk_preds, normalize=normalize)
+
+
+def _sk_cm_multiclass(preds, target, normalize=None):
+    return sk_confusion_matrix(y_true=target, y_pred=preds, normalize=normalize)
+
+
+def _sk_cm_multidim_multiclass_prob(preds, target, normalize=None):
+    sk_preds = np.argmax(preds, axis=1).reshape(-1)
+    return sk_confusion_matrix(y_true=target.reshape(-1), y_pred=sk_preds, normalize=normalize)
+
+
+def _sk_cm_multidim_multiclass(preds, target, normalize=None):
+    return sk_confusion_matrix(y_true=target.reshape(-1), y_pred=preds.reshape(-1), normalize=normalize)
+
+
+@pytest.mark.parametrize("normalize", ["true", "pred", "all", None])
+@pytest.mark.parametrize(
+    "preds, target, sk_metric, num_classes",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target, _sk_cm_binary_prob, 2),
+        (_input_binary.preds, _input_binary.target, _sk_cm_binary, 2),
+        (_input_multilabel_prob.preds, _input_multilabel_prob.target, _sk_cm_multilabel_prob, 2),
+        (_input_multilabel.preds, _input_multilabel.target, _sk_cm_multilabel, 2),
+        (_input_multiclass_prob.preds, _input_multiclass_prob.target, _sk_cm_multiclass_prob, NUM_CLASSES),
+        (_input_multiclass.preds, _input_multiclass.target, _sk_cm_multiclass, NUM_CLASSES),
+        (
+            _input_multidim_multiclass_prob.preds, _input_multidim_multiclass_prob.target,
+            _sk_cm_multidim_multiclass_prob, NUM_CLASSES
+        ),
+        (
+            _input_multidim_multiclass.preds, _input_multidim_multiclass.target, _sk_cm_multidim_multiclass,
+            NUM_CLASSES
+        ),
+    ],
+)
+class TestConfusionMatrix(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("dist_sync_on_step", [False])
+    def test_confusion_matrix_class(self, normalize, preds, target, sk_metric, num_classes, ddp, dist_sync_on_step):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=ConfusionMatrix,
+            sk_metric=partial(sk_metric, normalize=normalize),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={"num_classes": num_classes, "threshold": THRESHOLD, "normalize": normalize},
+        )
+
+    def test_confusion_matrix_fn(self, normalize, preds, target, sk_metric, num_classes):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=confusion_matrix,
+            sk_metric=partial(sk_metric, normalize=normalize),
+            metric_args={"num_classes": num_classes, "threshold": THRESHOLD, "normalize": normalize},
+        )
+
+
+def test_warning_on_nan():
+    import jax.numpy as jnp
+
+    preds = jnp.asarray(np.random.randint(3, size=20))
+    target = jnp.asarray(np.random.randint(3, size=20))
+
+    with pytest.warns(UserWarning, match=".* nan values found in confusion matrix have been replaced with zeros."):
+        confusion_matrix(preds, target, num_classes=5, normalize="true")

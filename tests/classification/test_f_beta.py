@@ -1,0 +1,188 @@
+"""FBeta/F1 vs sklearn (mirrors reference tests/classification/test_f_beta.py)."""
+from functools import partial
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import f1_score, fbeta_score
+
+from metrics_tpu import F1, FBeta
+from metrics_tpu.functional import f1, fbeta
+from metrics_tpu.utils.checks import _input_format_classification
+from tests.classification.inputs import (
+    _input_binary,
+    _input_binary_prob,
+    _input_multiclass as _input_mcls,
+    _input_multiclass_prob as _input_mcls_prob,
+    _input_multidim_multiclass as _input_mdmc,
+    _input_multidim_multiclass_prob as _input_mdmc_prob,
+    _input_multilabel as _input_mlb,
+    _input_multilabel_prob as _input_mlb_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _sk_fbeta_f1(preds, target, sk_fn, num_classes, average, is_multiclass, ignore_index, mdmc_average=None):
+    if average == "none":
+        average = None
+    if num_classes == 1:
+        average = "binary"
+
+    labels = list(range(num_classes))
+    try:
+        labels.remove(ignore_index)
+    except ValueError:
+        pass
+
+    sk_preds, sk_target, _ = _input_format_classification(
+        preds, target, THRESHOLD, num_classes=num_classes, is_multiclass=is_multiclass
+    )
+    sk_preds, sk_target = np.asarray(sk_preds), np.asarray(sk_target)
+
+    sk_scores = sk_fn(sk_target, sk_preds, average=average, zero_division=0, labels=labels)
+
+    if len(labels) != num_classes and not average:
+        sk_scores = np.insert(sk_scores, ignore_index, np.nan)
+
+    return sk_scores
+
+
+def _sk_fbeta_f1_mdim_mcls(preds, target, sk_fn, num_classes, average, is_multiclass, ignore_index, mdmc_average):
+    preds, target, _ = _input_format_classification(
+        preds, target, threshold=THRESHOLD, num_classes=num_classes, is_multiclass=is_multiclass
+    )
+    preds, target = np.asarray(preds), np.asarray(target)
+
+    if mdmc_average == "global":
+        preds = np.swapaxes(preds, 1, 2).reshape(-1, preds.shape[1])
+        target = np.swapaxes(target, 1, 2).reshape(-1, target.shape[1])
+        return _sk_fbeta_f1(preds, target, sk_fn, num_classes, average, False, ignore_index)
+    if mdmc_average == "samplewise":
+        scores = []
+        for i in range(preds.shape[0]):
+            scores_i = _sk_fbeta_f1(preds[i].T, target[i].T, sk_fn, num_classes, average, False, ignore_index)
+            scores.append(np.expand_dims(scores_i, 0))
+        return np.concatenate(scores).mean(axis=0)
+
+
+@pytest.mark.parametrize(
+    "metric_class, metric_fn, sk_fn",
+    [
+        (partial(FBeta, beta=2.0), partial(fbeta, beta=2.0), partial(fbeta_score, beta=2.0)),
+        (F1, f1, f1_score),
+    ],
+)
+@pytest.mark.parametrize("average", ["micro", "macro", None, "weighted", "samples"])
+@pytest.mark.parametrize("ignore_index", [None, 0])
+@pytest.mark.parametrize(
+    "preds, target, num_classes, is_multiclass, mdmc_average, sk_wrapper",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target, 1, None, None, _sk_fbeta_f1),
+        (_input_binary.preds, _input_binary.target, 1, False, None, _sk_fbeta_f1),
+        (_input_mlb_prob.preds, _input_mlb_prob.target, NUM_CLASSES, None, None, _sk_fbeta_f1),
+        (_input_mlb.preds, _input_mlb.target, NUM_CLASSES, False, None, _sk_fbeta_f1),
+        (_input_mcls_prob.preds, _input_mcls_prob.target, NUM_CLASSES, None, None, _sk_fbeta_f1),
+        (_input_mcls.preds, _input_mcls.target, NUM_CLASSES, None, None, _sk_fbeta_f1),
+        (_input_mdmc.preds, _input_mdmc.target, NUM_CLASSES, None, "global", _sk_fbeta_f1_mdim_mcls),
+        (_input_mdmc_prob.preds, _input_mdmc_prob.target, NUM_CLASSES, None, "global", _sk_fbeta_f1_mdim_mcls),
+        (_input_mdmc.preds, _input_mdmc.target, NUM_CLASSES, None, "samplewise", _sk_fbeta_f1_mdim_mcls),
+        (_input_mdmc_prob.preds, _input_mdmc_prob.target, NUM_CLASSES, None, "samplewise", _sk_fbeta_f1_mdim_mcls),
+    ],
+)
+class TestFBeta(MetricTester):
+    atol = 1e-5  # fp32 fbeta algebra vs sklearn's fp64
+
+    @pytest.mark.parametrize("ddp", [False])
+    @pytest.mark.parametrize("dist_sync_on_step", [False])
+    def test_fbeta_f1_class(
+        self,
+        ddp: bool,
+        dist_sync_on_step: bool,
+        preds,
+        target,
+        sk_wrapper: Callable,
+        metric_class,
+        metric_fn: Callable,
+        sk_fn: Callable,
+        is_multiclass: Optional[bool],
+        num_classes: Optional[int],
+        average: str,
+        mdmc_average: Optional[str],
+        ignore_index: Optional[int],
+    ):
+        if num_classes == 1 and average != "micro":
+            pytest.skip("Only test binary data for 'micro' avg (equivalent of 'binary' in sklearn)")
+        if ignore_index is not None and preds.ndim == 2:
+            pytest.skip("Skipping ignore_index test with binary inputs.")
+        if average == "weighted" and ignore_index is not None and mdmc_average is not None:
+            pytest.skip("Ignore special case where we are ignoring entire sample for 'weighted' average")
+
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=metric_class,
+            sk_metric=partial(
+                sk_wrapper,
+                sk_fn=sk_fn,
+                average=average,
+                num_classes=num_classes,
+                is_multiclass=is_multiclass,
+                ignore_index=ignore_index,
+                mdmc_average=mdmc_average,
+            ),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={
+                "num_classes": num_classes,
+                "average": average,
+                "threshold": THRESHOLD,
+                "is_multiclass": is_multiclass,
+                "ignore_index": ignore_index,
+                "mdmc_average": mdmc_average,
+            },
+        )
+
+    def test_fbeta_f1_fn(
+        self,
+        preds,
+        target,
+        sk_wrapper: Callable,
+        metric_class,
+        metric_fn: Callable,
+        sk_fn: Callable,
+        is_multiclass: Optional[bool],
+        num_classes: Optional[int],
+        average: str,
+        mdmc_average: Optional[str],
+        ignore_index: Optional[int],
+    ):
+        if num_classes == 1 and average != "micro":
+            pytest.skip("Only test binary data for 'micro' avg (equivalent of 'binary' in sklearn)")
+        if ignore_index is not None and preds.ndim == 2:
+            pytest.skip("Skipping ignore_index test with binary inputs.")
+        if average == "weighted" and ignore_index is not None and mdmc_average is not None:
+            pytest.skip("Ignore special case where we are ignoring entire sample for 'weighted' average")
+
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=metric_fn,
+            sk_metric=partial(
+                sk_wrapper,
+                sk_fn=sk_fn,
+                average=average,
+                num_classes=num_classes,
+                is_multiclass=is_multiclass,
+                ignore_index=ignore_index,
+                mdmc_average=mdmc_average,
+            ),
+            metric_args={
+                "num_classes": num_classes,
+                "average": average,
+                "threshold": THRESHOLD,
+                "is_multiclass": is_multiclass,
+                "ignore_index": ignore_index,
+                "mdmc_average": mdmc_average,
+            },
+        )

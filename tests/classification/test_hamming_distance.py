@@ -1,0 +1,85 @@
+"""HammingDistance vs sklearn hamming_loss
+(mirrors reference tests/classification/test_hamming_distance.py)."""
+import numpy as np
+import pytest
+from sklearn.metrics import hamming_loss as sk_hamming_loss
+
+from metrics_tpu import HammingDistance
+from metrics_tpu.functional import hamming_distance
+from metrics_tpu.utils.checks import _input_format_classification
+from tests.classification.inputs import (
+    _input_binary,
+    _input_binary_prob,
+    _input_multiclass,
+    _input_multiclass_prob,
+    _input_multidim_multiclass,
+    _input_multidim_multiclass_prob,
+    _input_multilabel,
+    _input_multilabel_multidim,
+    _input_multilabel_multidim_prob,
+    _input_multilabel_prob,
+)
+from tests.helpers.testers import THRESHOLD, MetricTester
+
+
+def _sk_hamming_loss(preds, target):
+    sk_preds, sk_target, _ = _input_format_classification(preds, target, threshold=THRESHOLD)
+    sk_preds, sk_target = np.asarray(sk_preds), np.asarray(sk_target)
+    sk_preds, sk_target = sk_preds.reshape(sk_preds.shape[0], -1), sk_target.reshape(sk_target.shape[0], -1)
+
+    return sk_hamming_loss(y_true=sk_target, y_pred=sk_preds)
+
+
+@pytest.mark.parametrize(
+    "preds, target",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target),
+        (_input_binary.preds, _input_binary.target),
+        (_input_multilabel_prob.preds, _input_multilabel_prob.target),
+        (_input_multilabel.preds, _input_multilabel.target),
+        (_input_multiclass_prob.preds, _input_multiclass_prob.target),
+        (_input_multiclass.preds, _input_multiclass.target),
+        (_input_multidim_multiclass_prob.preds, _input_multidim_multiclass_prob.target),
+        (_input_multidim_multiclass.preds, _input_multidim_multiclass.target),
+        (_input_multilabel_multidim_prob.preds, _input_multilabel_multidim_prob.target),
+        (_input_multilabel_multidim.preds, _input_multilabel_multidim.target),
+    ],
+)
+class TestHammingDistance(MetricTester):
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("dist_sync_on_step", [False])
+    def test_hamming_distance_class(self, ddp, dist_sync_on_step, preds, target):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=HammingDistance,
+            sk_metric=_sk_hamming_loss,
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={"threshold": THRESHOLD},
+        )
+
+    def test_hamming_distance_fn(self, preds, target):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=hamming_distance,
+            sk_metric=_sk_hamming_loss,
+            metric_args={"threshold": THRESHOLD},
+        )
+
+
+@pytest.mark.parametrize("threshold", [1.5])
+def test_wrong_params(threshold):
+    import jax.numpy as jnp
+
+    preds, target = _input_multiclass_prob.preds[0], _input_multiclass_prob.target[0]
+
+    with pytest.raises(ValueError):
+        ham_dist = HammingDistance(threshold=threshold)
+        ham_dist(jnp.asarray(preds), jnp.asarray(target))
+        ham_dist.compute()
+
+    with pytest.raises(ValueError):
+        hamming_distance(jnp.asarray(preds), jnp.asarray(target), threshold=threshold)

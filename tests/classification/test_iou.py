@@ -1,0 +1,172 @@
+"""IoU vs sklearn jaccard_score (mirrors reference tests/classification/test_iou.py)."""
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import jaccard_score as sk_jaccard_score
+
+from metrics_tpu import IoU
+from metrics_tpu.functional import iou
+from tests.classification.inputs import (
+    _input_binary,
+    _input_binary_prob,
+    _input_multiclass,
+    _input_multiclass_prob,
+    _input_multidim_multiclass,
+    _input_multidim_multiclass_prob,
+    _input_multilabel,
+    _input_multilabel_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _sk_iou_binary_prob(preds, target, average=None):
+    sk_preds = (preds >= THRESHOLD).astype(np.uint8)
+    return sk_jaccard_score(y_true=target, y_pred=sk_preds, average=average)
+
+
+def _sk_iou_binary(preds, target, average=None):
+    return sk_jaccard_score(y_true=target, y_pred=preds, average=average)
+
+
+def _sk_iou_multilabel_prob(preds, target, average=None):
+    sk_preds = (preds >= THRESHOLD).astype(np.uint8)
+    return sk_jaccard_score(y_true=target.reshape(-1), y_pred=sk_preds.reshape(-1), average=average)
+
+
+def _sk_iou_multilabel(preds, target, average=None):
+    return sk_jaccard_score(y_true=target.reshape(-1), y_pred=preds.reshape(-1), average=average)
+
+
+def _sk_iou_multiclass_prob(preds, target, average=None):
+    sk_preds = np.argmax(preds, axis=len(preds.shape) - 1)
+    return sk_jaccard_score(y_true=target, y_pred=sk_preds, average=average)
+
+
+def _sk_iou_multiclass(preds, target, average=None):
+    return sk_jaccard_score(y_true=target, y_pred=preds, average=average)
+
+
+def _sk_iou_multidim_multiclass_prob(preds, target, average=None):
+    sk_preds = np.argmax(preds, axis=1).reshape(-1)
+    return sk_jaccard_score(y_true=target.reshape(-1), y_pred=sk_preds, average=average)
+
+
+def _sk_iou_multidim_multiclass(preds, target, average=None):
+    return sk_jaccard_score(y_true=target.reshape(-1), y_pred=preds.reshape(-1), average=average)
+
+
+@pytest.mark.parametrize("average", ["macro"])
+@pytest.mark.parametrize(
+    "preds, target, sk_metric, num_classes",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target, _sk_iou_binary_prob, 2),
+        (_input_binary.preds, _input_binary.target, _sk_iou_binary, 2),
+        (_input_multilabel_prob.preds, _input_multilabel_prob.target, _sk_iou_multilabel_prob, 2),
+        (_input_multilabel.preds, _input_multilabel.target, _sk_iou_multilabel, 2),
+        (_input_multiclass_prob.preds, _input_multiclass_prob.target, _sk_iou_multiclass_prob, NUM_CLASSES),
+        (_input_multiclass.preds, _input_multiclass.target, _sk_iou_multiclass, NUM_CLASSES),
+        (
+            _input_multidim_multiclass_prob.preds, _input_multidim_multiclass_prob.target,
+            _sk_iou_multidim_multiclass_prob, NUM_CLASSES
+        ),
+        (_input_multidim_multiclass.preds, _input_multidim_multiclass.target, _sk_iou_multidim_multiclass, NUM_CLASSES),
+    ],
+)
+class TestIoU(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("ddp", [False])
+    @pytest.mark.parametrize("dist_sync_on_step", [False])
+    def test_iou_class(self, average, preds, target, sk_metric, num_classes, ddp, dist_sync_on_step):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=IoU,
+            sk_metric=partial(sk_metric, average=average),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={"num_classes": num_classes, "threshold": THRESHOLD},
+        )
+
+    def test_iou_fn(self, average, preds, target, sk_metric, num_classes):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=iou,
+            sk_metric=partial(sk_metric, average=average),
+            metric_args={"num_classes": num_classes, "threshold": THRESHOLD},
+        )
+
+
+# reference test_iou.py edge-case tables
+@pytest.mark.parametrize(
+    ["half_ones", "reduction", "ignore_index", "expected"],
+    [
+        (False, "none", None, [1, 1, 1]),
+        (False, "elementwise_mean", None, 1),
+        (False, "none", 0, [1, 1]),
+        (True, "none", None, [0.5, 0.5, 0.5]),
+        (True, "elementwise_mean", None, 0.5),
+        (True, "none", 0, [0.5, 0.5]),
+    ],
+)
+def test_iou_edge_cases(half_ones, reduction, ignore_index, expected):
+    preds = (jnp.arange(120) % 3).reshape(8, 15)
+    target = (jnp.arange(120) % 3).reshape(8, 15)
+    if half_ones:
+        preds = preds.at[:4].set(1)
+
+    iou_val = iou(preds, target, ignore_index=ignore_index, num_classes=3, reduction=reduction)
+    np.testing.assert_allclose(np.asarray(iou_val), np.asarray(expected), atol=1e-6)
+
+
+@pytest.mark.parametrize(
+    ["preds", "target", "ignore_index", "absent_score", "num_classes", "expected"],
+    [
+        # note that -1 is used as sentinel for the absent score to become visible
+        ([0], [0], None, -1.0, 2, [1.0, -1.0]),
+        ([0, 2], [0, 2], None, -1.0, 3, [1.0, -1.0, 1.0]),
+        ([0, 2], [0, 2], 0, -1.0, 3, [-1.0, 1.0]),
+        ([1], [1], 0, -1.0, 3, [1.0, -1.0]),
+        ([0, 1], [0, 1], 0, -1.0, 3, [1.0, -1.0]),
+    ],
+)
+def test_iou_absent_score(preds, target, ignore_index, absent_score, num_classes, expected):
+    iou_val = iou(
+        jnp.asarray(preds),
+        jnp.asarray(target),
+        ignore_index=ignore_index,
+        absent_score=absent_score,
+        num_classes=num_classes,
+        reduction="none",
+    )
+    np.testing.assert_allclose(np.asarray(iou_val), np.asarray(expected), atol=1e-6)
+
+
+@pytest.mark.parametrize(
+    ["preds", "target", "ignore_index", "num_classes", "reduction", "expected"],
+    [
+        # ignoring an index outside [0, num_classes-1] has no effect
+        ([0, 1, 1, 2, 2], [0, 1, 2, 2, 2], None, 3, "none", [1, 1 / 2, 2 / 3]),
+        ([0, 1, 1, 2, 2], [0, 1, 2, 2, 2], -1, 3, "none", [1, 1 / 2, 2 / 3]),
+        ([0, 1, 1, 2, 2], [0, 1, 2, 2, 2], 255, 3, "none", [1, 1 / 2, 2 / 3]),
+        # ignoring a valid index drops only that index from the result
+        ([0, 1, 1, 2, 2], [0, 1, 2, 2, 2], 0, 3, "none", [1 / 2, 2 / 3]),
+        ([0, 1, 1, 2, 2], [0, 1, 2, 2, 2], 1, 3, "none", [1, 2 / 3]),
+        ([0, 1, 1, 2, 2], [0, 1, 2, 2, 2], 2, 3, "none", [1, 1 / 2]),
+        # mean/sum reductions exclude the ignored index
+        ([0, 1, 1, 2, 2], [0, 1, 2, 2, 2], 0, 3, "elementwise_mean", [7 / 12]),
+        ([0, 1, 1, 2, 2], [0, 1, 2, 2, 2], 0, 3, "sum", [7 / 6]),
+    ],
+)
+def test_iou_ignore_index(preds, target, ignore_index, num_classes, reduction, expected):
+    iou_val = iou(
+        jnp.asarray(preds),
+        jnp.asarray(target),
+        ignore_index=ignore_index,
+        num_classes=num_classes,
+        reduction=reduction,
+    )
+    np.testing.assert_allclose(np.asarray(iou_val).reshape(-1), np.asarray(expected), atol=1e-6)

@@ -1,0 +1,102 @@
+"""MatthewsCorrcoef vs sklearn (mirrors reference tests/classification/test_matthews_corrcoef.py)."""
+import numpy as np
+import pytest
+from sklearn.metrics import matthews_corrcoef as sk_matthews_corrcoef
+
+from metrics_tpu import MatthewsCorrcoef
+from metrics_tpu.functional import matthews_corrcoef
+from tests.classification.inputs import (
+    _input_binary,
+    _input_binary_prob,
+    _input_multiclass,
+    _input_multiclass_prob,
+    _input_multidim_multiclass,
+    _input_multidim_multiclass_prob,
+    _input_multilabel,
+    _input_multilabel_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _sk_matthews_corrcoef_binary_prob(preds, target):
+    sk_preds = (preds >= THRESHOLD).astype(np.uint8)
+    return sk_matthews_corrcoef(y_true=target, y_pred=sk_preds)
+
+
+def _sk_matthews_corrcoef_binary(preds, target):
+    return sk_matthews_corrcoef(y_true=target, y_pred=preds)
+
+
+def _sk_matthews_corrcoef_multilabel_prob(preds, target):
+    sk_preds = (preds >= THRESHOLD).astype(np.uint8)
+    return sk_matthews_corrcoef(y_true=target.reshape(-1), y_pred=sk_preds.reshape(-1))
+
+
+def _sk_matthews_corrcoef_multilabel(preds, target):
+    return sk_matthews_corrcoef(y_true=target.reshape(-1), y_pred=preds.reshape(-1))
+
+
+def _sk_matthews_corrcoef_multiclass_prob(preds, target):
+    sk_preds = np.argmax(preds, axis=len(preds.shape) - 1)
+    return sk_matthews_corrcoef(y_true=target, y_pred=sk_preds)
+
+
+def _sk_matthews_corrcoef_multiclass(preds, target):
+    return sk_matthews_corrcoef(y_true=target, y_pred=preds)
+
+
+def _sk_matthews_corrcoef_multidim_multiclass_prob(preds, target):
+    sk_preds = np.argmax(preds, axis=1).reshape(-1)
+    return sk_matthews_corrcoef(y_true=target.reshape(-1), y_pred=sk_preds)
+
+
+def _sk_matthews_corrcoef_multidim_multiclass(preds, target):
+    return sk_matthews_corrcoef(y_true=target.reshape(-1), y_pred=preds.reshape(-1))
+
+
+@pytest.mark.parametrize(
+    "preds, target, sk_metric, num_classes",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target, _sk_matthews_corrcoef_binary_prob, 2),
+        (_input_binary.preds, _input_binary.target, _sk_matthews_corrcoef_binary, 2),
+        (_input_multilabel_prob.preds, _input_multilabel_prob.target, _sk_matthews_corrcoef_multilabel_prob, 2),
+        (_input_multilabel.preds, _input_multilabel.target, _sk_matthews_corrcoef_multilabel, 2),
+        (
+            _input_multiclass_prob.preds, _input_multiclass_prob.target, _sk_matthews_corrcoef_multiclass_prob,
+            NUM_CLASSES
+        ),
+        (_input_multiclass.preds, _input_multiclass.target, _sk_matthews_corrcoef_multiclass, NUM_CLASSES),
+        (
+            _input_multidim_multiclass_prob.preds, _input_multidim_multiclass_prob.target,
+            _sk_matthews_corrcoef_multidim_multiclass_prob, NUM_CLASSES
+        ),
+        (
+            _input_multidim_multiclass.preds, _input_multidim_multiclass.target,
+            _sk_matthews_corrcoef_multidim_multiclass, NUM_CLASSES
+        ),
+    ],
+)
+class TestMatthewsCorrCoef(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("ddp", [False])
+    @pytest.mark.parametrize("dist_sync_on_step", [False])
+    def test_matthews_corrcoef_class(self, preds, target, sk_metric, num_classes, ddp, dist_sync_on_step):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=MatthewsCorrcoef,
+            sk_metric=sk_metric,
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={"num_classes": num_classes, "threshold": THRESHOLD},
+        )
+
+    def test_matthews_corrcoef_fn(self, preds, target, sk_metric, num_classes):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=matthews_corrcoef,
+            sk_metric=sk_metric,
+            metric_args={"num_classes": num_classes, "threshold": THRESHOLD},
+        )

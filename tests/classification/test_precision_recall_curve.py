@@ -1,0 +1,100 @@
+"""PrecisionRecallCurve vs sklearn (mirrors reference tests/classification/test_precision_recall_curve.py)."""
+from functools import partial
+
+import numpy as np
+import pytest
+from sklearn.metrics import precision_recall_curve as sk_precision_recall_curve
+
+from metrics_tpu import PrecisionRecallCurve
+from metrics_tpu.functional import precision_recall_curve
+from tests.classification.inputs import (
+    _input_binary_prob,
+    _input_multiclass_prob,
+    _input_multidim_multiclass_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, MetricTester
+
+
+def _legacy_truncate(precision, recall, thresholds):
+    """Reproduce the 2021-era sklearn/reference truncation: the curve starts at
+    the highest threshold attaining full recall (reference
+    precision_recall_curve.py:132-141). sklearn >= 1.x keeps all leading
+    full-recall points; drop the duplicates."""
+    m = 0
+    while m + 1 < len(recall) and recall[m + 1] == recall[0]:
+        m += 1
+    return [precision[m:], recall[m:], thresholds[m:]]
+
+
+def _sk_prc_binary_prob(preds, target, num_classes=1):
+    return _legacy_truncate(*sk_precision_recall_curve(y_true=target, y_score=preds))
+
+
+def _sk_prc_multiclass_prob(preds, target, num_classes=1):
+    precision, recall, thresholds = [], [], []
+    for i in range(num_classes):
+        target_temp = np.zeros_like(target)
+        target_temp[target == i] = 1
+        res = _legacy_truncate(*sk_precision_recall_curve(target_temp, preds[:, i]))
+        precision.append(res[0])
+        recall.append(res[1])
+        thresholds.append(res[2])
+    return [precision, recall, thresholds]
+
+
+def _sk_prc_multidim_multiclass_prob(preds, target, num_classes=1):
+    preds = np.swapaxes(preds, 1, 2).reshape(-1, num_classes)
+    target = target.reshape(-1)
+    return _sk_prc_multiclass_prob(preds, target, num_classes)
+
+
+@pytest.mark.parametrize(
+    "preds, target, sk_metric, num_classes",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target, _sk_prc_binary_prob, 1),
+        (_input_multiclass_prob.preds, _input_multiclass_prob.target, _sk_prc_multiclass_prob, NUM_CLASSES),
+        (
+            _input_multidim_multiclass_prob.preds, _input_multidim_multiclass_prob.target,
+            _sk_prc_multidim_multiclass_prob, NUM_CLASSES
+        ),
+    ],
+)
+class TestPrecisionRecallCurve(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("dist_sync_on_step", [False])
+    def test_precision_recall_curve(self, preds, target, sk_metric, num_classes, ddp, dist_sync_on_step):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=PrecisionRecallCurve,
+            sk_metric=partial(sk_metric, num_classes=num_classes),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={"num_classes": num_classes},
+            check_batch=False,
+            check_dist_sync_on_step=False,
+        )
+
+    def test_precision_recall_curve_fn(self, preds, target, sk_metric, num_classes):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=precision_recall_curve,
+            sk_metric=partial(sk_metric, num_classes=num_classes),
+            metric_args={"num_classes": num_classes},
+        )
+
+
+@pytest.mark.parametrize(
+    ["pred", "target", "expected_p", "expected_r", "expected_t"],
+    [([1, 2, 3, 4], [1, 0, 0, 1], [0.5, 1 / 3, 0.5, 1.0, 1.0], [1, 0.5, 0.5, 0.5, 0.0], [1, 2, 3, 4])],
+)
+def test_pr_curve(pred, target, expected_p, expected_r, expected_t):
+    import jax.numpy as jnp
+
+    p, r, t = precision_recall_curve(jnp.asarray(pred, dtype=jnp.float32), jnp.asarray(target))
+    np.testing.assert_allclose(np.asarray(p), expected_p, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(r), expected_r, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(t), expected_t, atol=1e-6)

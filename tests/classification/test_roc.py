@@ -1,0 +1,106 @@
+"""ROC vs sklearn roc_curve (mirrors reference tests/classification/test_roc.py)."""
+from functools import partial
+
+import numpy as np
+import pytest
+from sklearn.metrics import roc_curve as sk_roc_curve
+
+from metrics_tpu import ROC
+from metrics_tpu.functional import roc
+from tests.classification.inputs import (
+    _input_binary_prob,
+    _input_multiclass_prob,
+    _input_multidim_multiclass_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, MetricTester
+
+
+def _sk_roc_binary_prob(preds, target, num_classes=1):
+    fpr, tpr, thresholds = sk_roc_curve(y_true=target, y_score=preds, drop_intermediate=False)
+    # 2021-era sklearn (and the reference) used thresholds[0]+1 instead of inf
+    # as the synthetic leading threshold (sklearn changed in 1.x)
+    thresholds = thresholds.copy()
+    if np.isinf(thresholds[0]):
+        thresholds[0] = thresholds[1] + 1
+    return [fpr, tpr, thresholds]
+
+
+def _sk_roc_multiclass_prob(preds, target, num_classes=1):
+    fpr, tpr, thresholds = [], [], []
+    for i in range(num_classes):
+        target_temp = np.zeros_like(target)
+        target_temp[target == i] = 1
+        res = sk_roc_curve(target_temp, preds[:, i], drop_intermediate=False)
+        t = res[2].copy()
+        if np.isinf(t[0]):
+            t[0] = t[1] + 1
+        fpr.append(res[0])
+        tpr.append(res[1])
+        thresholds.append(t)
+    return [fpr, tpr, thresholds]
+
+
+def _sk_roc_multidim_multiclass_prob(preds, target, num_classes=1):
+    preds = np.swapaxes(preds, 1, 2).reshape(-1, num_classes)
+    target = target.reshape(-1)
+    return _sk_roc_multiclass_prob(preds, target, num_classes)
+
+
+@pytest.mark.parametrize(
+    "preds, target, sk_metric, num_classes",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target, _sk_roc_binary_prob, 1),
+        (_input_multiclass_prob.preds, _input_multiclass_prob.target, _sk_roc_multiclass_prob, NUM_CLASSES),
+        (
+            _input_multidim_multiclass_prob.preds, _input_multidim_multiclass_prob.target,
+            _sk_roc_multidim_multiclass_prob, NUM_CLASSES
+        ),
+    ],
+)
+class TestROC(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("dist_sync_on_step", [False])
+    def test_roc_class(self, preds, target, sk_metric, num_classes, ddp, dist_sync_on_step):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=ROC,
+            sk_metric=partial(sk_metric, num_classes=num_classes),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={"num_classes": num_classes},
+            check_batch=False,  # curve outputs have data-dependent per-batch shapes
+            check_dist_sync_on_step=False,
+        )
+
+    def test_roc_fn(self, preds, target, sk_metric, num_classes):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=roc,
+            sk_metric=partial(sk_metric, num_classes=num_classes),
+            metric_args={"num_classes": num_classes},
+        )
+
+
+@pytest.mark.parametrize(
+    ["pred", "target", "expected_tpr", "expected_fpr"],
+    [
+        # reference tests/classification/test_roc.py:134-139
+        ([0, 1], [0, 1], [0, 1, 1], [0, 0, 1]),
+        ([1, 0], [0, 1], [0, 0, 1], [0, 1, 1]),
+        ([1, 1], [1, 0], [0, 1], [0, 1]),
+        ([1, 0], [1, 0], [0, 1, 1], [0, 0, 1]),
+        ([0.5, 0.5], [0, 1], [0, 1], [0, 1]),
+    ],
+)
+def test_roc_curve(pred, target, expected_tpr, expected_fpr):
+    import jax.numpy as jnp
+
+    fpr, tpr, thresh = roc(jnp.asarray(pred, dtype=jnp.float32), jnp.asarray(target))
+    assert fpr.shape == tpr.shape
+    assert fpr.shape[0] == thresh.shape[0]
+    np.testing.assert_allclose(np.asarray(fpr), expected_fpr, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(tpr), expected_tpr, atol=1e-6)

@@ -1,0 +1,221 @@
+"""StatScores vs sklearn multilabel_confusion_matrix
+(mirrors reference tests/classification/test_stat_scores.py)."""
+from functools import partial
+from typing import Callable, Optional
+
+import numpy as np
+import pytest
+from sklearn.metrics import multilabel_confusion_matrix
+
+from metrics_tpu import StatScores
+from metrics_tpu.functional import stat_scores
+from metrics_tpu.utils.checks import _input_format_classification
+from tests.classification.inputs import (
+    _input_binary,
+    _input_binary_prob,
+    _input_multiclass,
+    _input_multiclass_prob as _input_mccls_prob,
+    _input_multidim_multiclass as _input_mdmc,
+    _input_multidim_multiclass_prob as _input_mdmc_prob,
+    _input_multilabel as _input_mlb,
+    _input_multilabel_prob as _input_mlb_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _sk_stat_scores(preds, target, reduce, num_classes, is_multiclass, ignore_index, top_k, mdmc_reduce=None):
+    preds, target, _ = _input_format_classification(
+        preds, target, threshold=THRESHOLD, num_classes=num_classes, is_multiclass=is_multiclass, top_k=top_k
+    )
+    sk_preds, sk_target = np.asarray(preds), np.asarray(target)
+    width = sk_preds.shape[1]  # pre-transpose C dim, as the reference adapter uses
+
+    if reduce != "macro" and ignore_index is not None and width > 1:
+        sk_preds = np.delete(sk_preds, ignore_index, 1)
+        sk_target = np.delete(sk_target, ignore_index, 1)
+
+    if width == 1 and reduce == "samples":
+        sk_target = sk_target.T
+        sk_preds = sk_preds.T
+
+    sk_stats = multilabel_confusion_matrix(
+        sk_target, sk_preds, samplewise=(reduce == "samples") and width != 1
+    )
+
+    if width == 1 and reduce != "samples":
+        sk_stats = sk_stats[[1]].reshape(-1, 4)[:, [3, 1, 0, 2]]
+    else:
+        sk_stats = sk_stats.reshape(-1, 4)[:, [3, 1, 0, 2]]
+
+    if reduce == "micro":
+        sk_stats = sk_stats.sum(axis=0, keepdims=True)
+
+    sk_stats = np.concatenate([sk_stats, sk_stats[:, [3]] + sk_stats[:, [0]]], 1)
+
+    if reduce == "micro":
+        sk_stats = sk_stats[0]
+
+    if reduce == "macro" and ignore_index is not None and width:
+        sk_stats[ignore_index, :] = -1
+
+    return sk_stats
+
+
+def _sk_stat_scores_mdim_mcls(preds, target, reduce, mdmc_reduce, num_classes, is_multiclass, ignore_index, top_k):
+    preds, target, _ = _input_format_classification(
+        preds, target, threshold=THRESHOLD, num_classes=num_classes, is_multiclass=is_multiclass, top_k=top_k
+    )
+    preds, target = np.asarray(preds), np.asarray(target)
+
+    if mdmc_reduce == "global":
+        preds = np.swapaxes(preds, 1, 2).reshape(-1, preds.shape[1])
+        target = np.swapaxes(target, 1, 2).reshape(-1, target.shape[1])
+        return _sk_stat_scores(preds, target, reduce, None, False, ignore_index, top_k)
+    if mdmc_reduce == "samplewise":
+        scores = []
+        for i in range(preds.shape[0]):
+            scores_i = _sk_stat_scores(preds[i].T, target[i].T, reduce, None, False, ignore_index, top_k)
+            scores.append(np.expand_dims(scores_i, 0))
+        return np.concatenate(scores)
+
+
+@pytest.mark.parametrize(
+    "reduce, mdmc_reduce, num_classes, inputs, ignore_index",
+    [
+        ["unknown", None, None, _input_binary, None],
+        ["micro", "unknown", None, _input_binary, None],
+        ["macro", None, None, _input_binary, None],
+        ["micro", None, None, _input_mdmc_prob, None],
+        ["micro", None, None, _input_binary_prob, 0],
+        ["micro", None, None, _input_mccls_prob, NUM_CLASSES],
+        ["micro", None, NUM_CLASSES, _input_mccls_prob, NUM_CLASSES],
+    ],
+)
+def test_wrong_params(reduce, mdmc_reduce, num_classes, inputs, ignore_index):
+    import jax.numpy as jnp
+
+    with pytest.raises(ValueError):
+        stat_scores(
+            jnp.asarray(inputs.preds[0]),
+            jnp.asarray(inputs.target[0]),
+            reduce,
+            mdmc_reduce,
+            num_classes=num_classes,
+            ignore_index=ignore_index,
+        )
+    with pytest.raises(ValueError):
+        sts = StatScores(reduce=reduce, mdmc_reduce=mdmc_reduce, num_classes=num_classes, ignore_index=ignore_index)
+        sts(jnp.asarray(inputs.preds[0]), jnp.asarray(inputs.target[0]))
+
+
+def test_wrong_threshold():
+    with pytest.raises(ValueError):
+        StatScores(threshold=1.5)
+
+
+@pytest.mark.parametrize("ignore_index", [None, 0])
+@pytest.mark.parametrize("reduce", ["micro", "macro", "samples"])
+@pytest.mark.parametrize(
+    "preds, target, sk_fn, mdmc_reduce, num_classes, is_multiclass, top_k",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target, _sk_stat_scores, None, 1, None, None),
+        (_input_binary.preds, _input_binary.target, _sk_stat_scores, None, 1, False, None),
+        (_input_mlb_prob.preds, _input_mlb_prob.target, _sk_stat_scores, None, NUM_CLASSES, None, None),
+        (_input_mlb.preds, _input_mlb.target, _sk_stat_scores, None, NUM_CLASSES, False, None),
+        (_input_mccls_prob.preds, _input_mccls_prob.target, _sk_stat_scores, None, NUM_CLASSES, None, None),
+        (_input_mccls_prob.preds, _input_mccls_prob.target, _sk_stat_scores, None, NUM_CLASSES, None, 2),
+        (_input_multiclass.preds, _input_multiclass.target, _sk_stat_scores, None, NUM_CLASSES, None, None),
+        (_input_mdmc.preds, _input_mdmc.target, _sk_stat_scores_mdim_mcls, "samplewise", NUM_CLASSES, None, None),
+        (
+            _input_mdmc_prob.preds, _input_mdmc_prob.target, _sk_stat_scores_mdim_mcls, "samplewise", NUM_CLASSES,
+            None, None
+        ),
+        (_input_mdmc.preds, _input_mdmc.target, _sk_stat_scores_mdim_mcls, "global", NUM_CLASSES, None, None),
+        (_input_mdmc_prob.preds, _input_mdmc_prob.target, _sk_stat_scores_mdim_mcls, "global", NUM_CLASSES, None, None),
+    ],
+)
+class TestStatScores(MetricTester):
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("dist_sync_on_step", [False])
+    def test_stat_scores_class(
+        self,
+        ddp: bool,
+        dist_sync_on_step: bool,
+        sk_fn: Callable,
+        preds,
+        target,
+        reduce: str,
+        mdmc_reduce: Optional[str],
+        num_classes: Optional[int],
+        is_multiclass: Optional[bool],
+        ignore_index: Optional[int],
+        top_k: Optional[int],
+    ):
+        if ignore_index is not None and preds.ndim == 2:
+            pytest.skip("Skipping ignore_index test with binary inputs.")
+
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=StatScores,
+            sk_metric=partial(
+                sk_fn,
+                reduce=reduce,
+                mdmc_reduce=mdmc_reduce,
+                num_classes=num_classes,
+                is_multiclass=is_multiclass,
+                ignore_index=ignore_index,
+                top_k=top_k,
+            ),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={
+                "num_classes": num_classes,
+                "reduce": reduce,
+                "mdmc_reduce": mdmc_reduce,
+                "threshold": THRESHOLD,
+                "is_multiclass": is_multiclass,
+                "ignore_index": ignore_index,
+                "top_k": top_k,
+            },
+        )
+
+    def test_stat_scores_fn(
+        self,
+        sk_fn: Callable,
+        preds,
+        target,
+        reduce: str,
+        mdmc_reduce: Optional[str],
+        num_classes: Optional[int],
+        is_multiclass: Optional[bool],
+        ignore_index: Optional[int],
+        top_k: Optional[int],
+    ):
+        if ignore_index is not None and preds.ndim == 2:
+            pytest.skip("Skipping ignore_index test with binary inputs.")
+
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=stat_scores,
+            sk_metric=partial(
+                sk_fn,
+                reduce=reduce,
+                mdmc_reduce=mdmc_reduce,
+                num_classes=num_classes,
+                is_multiclass=is_multiclass,
+                ignore_index=ignore_index,
+                top_k=top_k,
+            ),
+            metric_args={
+                "num_classes": num_classes,
+                "reduce": reduce,
+                "mdmc_reduce": mdmc_reduce,
+                "threshold": THRESHOLD,
+                "is_multiclass": is_multiclass,
+                "ignore_index": ignore_index,
+                "top_k": top_k,
+            },
+        )

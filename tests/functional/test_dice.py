@@ -1,0 +1,20 @@
+"""dice_score edge cases (mirrors reference tests/functional/test_classification.py dice tests)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.functional import dice_score
+
+
+@pytest.mark.parametrize(
+    ["pred", "target", "expected"],
+    [
+        ([[0, 0], [1, 1]], [[0, 0], [1, 1]], 1.0),
+        ([[1, 1], [0, 0]], [[0, 0], [1, 1]], 0.0),
+        ([[1, 1], [1, 1]], [[1, 1], [0, 0]], 2 / 3),
+        ([[1, 1], [0, 0]], [[1, 1], [0, 0]], 1.0),
+    ],
+)
+def test_dice_score(pred, target, expected):
+    score = dice_score(jnp.asarray(pred), jnp.asarray(target))
+    np.testing.assert_allclose(float(score), expected, atol=1e-6)

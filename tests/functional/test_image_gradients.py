@@ -1,0 +1,46 @@
+"""image_gradients (mirrors reference tests/functional/test_image_gradients.py)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.functional import image_gradients
+
+
+def test_invalid_input_ndims():
+    image = jnp.arange(0, 25, dtype=jnp.float32).reshape(5, 5)
+    with pytest.raises(RuntimeError):
+        image_gradients(image)
+
+
+def test_image_gradients_shapes():
+    image = jnp.zeros((2, 3, 5, 8))
+    dy, dx = image_gradients(image)
+    assert dy.shape == image.shape
+    assert dx.shape == image.shape
+
+
+def test_image_gradients_values():
+    """1-step finite differences, TF-style layout (reference test asserts the same grid)."""
+    image = jnp.arange(0, 25, dtype=jnp.float32).reshape(1, 1, 5, 5)
+    dy, dx = image_gradients(image)
+
+    true_dy = np.array(
+        [
+            [5.0, 5.0, 5.0, 5.0, 5.0],
+            [5.0, 5.0, 5.0, 5.0, 5.0],
+            [5.0, 5.0, 5.0, 5.0, 5.0],
+            [5.0, 5.0, 5.0, 5.0, 5.0],
+            [0.0, 0.0, 0.0, 0.0, 0.0],
+        ]
+    )
+    true_dx = np.array(
+        [
+            [1.0, 1.0, 1.0, 1.0, 0.0],
+            [1.0, 1.0, 1.0, 1.0, 0.0],
+            [1.0, 1.0, 1.0, 1.0, 0.0],
+            [1.0, 1.0, 1.0, 1.0, 0.0],
+            [1.0, 1.0, 1.0, 1.0, 0.0],
+        ]
+    )
+    np.testing.assert_allclose(np.asarray(dy[0, 0]), true_dy)
+    np.testing.assert_allclose(np.asarray(dx[0, 0]), true_dx)

@@ -1,0 +1,69 @@
+"""BLEU vs nltk oracle (mirrors reference tests/functional/test_nlp.py)."""
+from functools import partial
+
+import numpy as np
+import pytest
+from nltk.translate.bleu_score import SmoothingFunction, corpus_bleu
+
+from metrics_tpu.functional import bleu_score
+
+# example taken from https://www.nltk.org/api/nltk.translate.html?highlight=bleu%20score#nltk.translate.bleu_score.sentence_bleu
+HYPOTHESIS1 = tuple(
+    "It is a guide to action which ensures that the military always obeys the commands of the party".split()
+)
+REFERENCE1 = tuple("It is a guide to action that ensures that the military will forever heed Party commands".split())
+REFERENCE2 = tuple(
+    "It is a guiding principle which makes the military forces always being under the command of the Party".split()
+)
+REFERENCE3 = tuple("It is the practical guide for the army always to heed the directions of the party".split())
+
+# example taken from https://www.nltk.org/api/nltk.translate.html?highlight=bleu%20score#nltk.translate.bleu_score.corpus_bleu
+HYP1A = ["It", "is", "a", "guide", "to", "action", "which", "ensures", "that", "the", "military", "always", "obeys",
+         "the", "commands", "of", "the", "party"]
+HYP2A = ["he", "read", "the", "book", "because", "he", "was", "interested", "in", "world", "history"]
+
+REF1A = ["It", "is", "a", "guide", "to", "action", "that", "ensures", "that", "the", "military", "will", "forever",
+         "heed", "Party", "commands"]
+REF1B = ["It", "is", "a", "guiding", "principle", "which", "makes", "the", "military", "forces", "always", "being",
+         "under", "the", "command", "of", "the", "Party"]
+REF1C = ["It", "is", "the", "practical", "guide", "for", "the", "army", "always", "to", "heed", "the", "directions",
+         "of", "the", "party"]
+REF2A = ["he", "was", "interested", "in", "world", "history", "because", "he", "read", "the", "book"]
+
+TUPLE_OF_REFERENCES = ((REF1A, REF1B, REF1C), (REF2A, ))
+TUPLE_OF_HYPOTHESES = (HYP1A, HYP2A)
+
+smooth_func = SmoothingFunction().method2
+
+
+@pytest.mark.parametrize(
+    ["weights", "n_gram", "smooth_func", "smooth"],
+    [
+        ([1], 1, None, False),
+        ([0.5, 0.5], 2, smooth_func, True),
+        ([0.333333, 0.333333, 0.333333], 3, None, False),
+        ([0.25, 0.25, 0.25, 0.25], 4, smooth_func, True),
+    ],
+)
+def test_bleu_score(weights, n_gram, smooth_func, smooth):
+    nltk_output = corpus_bleu(
+        TUPLE_OF_REFERENCES, TUPLE_OF_HYPOTHESES, weights=weights, smoothing_function=smooth_func
+    )
+    our_output = bleu_score(TUPLE_OF_HYPOTHESES, TUPLE_OF_REFERENCES, n_gram=n_gram, smooth=smooth)
+    # smooth path: nltk >= 3.6 fixed method2 to not smooth unigrams; the
+    # reference (and this port) add-1 smooths every order like 2021-era nltk,
+    # so allow the small systematic difference there
+    atol = 1e-3 if smooth else 1e-4
+    np.testing.assert_allclose(float(our_output), nltk_output, atol=atol)
+
+
+def test_bleu_empty():
+    hyp = [[]]
+    ref = [[[]]]
+    assert float(bleu_score(hyp, ref)) == 0.0
+
+
+def test_no_4_gram():
+    hyps = [["My", "full", "pytorch-lightning"]]
+    refs = [[["My", "full", "pytorch-lightning", "test"], ["Completely", "Different"]]]
+    assert float(bleu_score(hyps, refs)) == 0.0

@@ -1,0 +1,31 @@
+"""embedding_similarity vs sklearn pairwise kernels
+(mirrors reference tests/functional/test_self_supervised.py)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics.pairwise import cosine_similarity, linear_kernel
+
+from metrics_tpu.functional import embedding_similarity
+
+
+@pytest.mark.parametrize("similarity", ["cosine", "dot"])
+@pytest.mark.parametrize("reduction", ["none", "mean", "sum"])
+def test_against_sklearn(similarity, reduction):
+    rng = np.random.RandomState(0)
+    batch = rng.rand(10, 5).astype(np.float32)
+
+    result = embedding_similarity(jnp.asarray(batch), similarity=similarity, reduction=reduction, zero_diagonal=False)
+
+    sk = cosine_similarity(batch) if similarity == "cosine" else linear_kernel(batch)
+    if reduction == "mean":
+        sk = sk.mean(axis=-1)
+    elif reduction == "sum":
+        sk = sk.sum(axis=-1)
+    np.testing.assert_allclose(np.asarray(result), sk, rtol=1e-4, atol=1e-5)
+
+
+def test_zero_diagonal():
+    rng = np.random.RandomState(1)
+    batch = rng.rand(6, 4).astype(np.float32)
+    result = embedding_similarity(jnp.asarray(batch), zero_diagonal=True)
+    np.testing.assert_allclose(np.diag(np.asarray(result)), np.zeros(6), atol=1e-7)

@@ -1,0 +1,81 @@
+"""ExplainedVariance vs sklearn (mirrors reference tests/regression/test_explained_variance.py)."""
+from collections import namedtuple
+from functools import partial
+
+import numpy as np
+import pytest
+from sklearn.metrics import explained_variance_score
+
+from metrics_tpu import ExplainedVariance
+from metrics_tpu.functional import explained_variance
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES, MetricTester
+
+Input = namedtuple("Input", ["preds", "target"])
+
+_rng = np.random.RandomState(17)
+
+_single_target_inputs = Input(
+    preds=_rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32),
+    target=_rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32),
+)
+
+_multi_target_inputs = Input(
+    preds=_rng.rand(NUM_BATCHES, BATCH_SIZE, 5).astype(np.float32),
+    target=_rng.rand(NUM_BATCHES, BATCH_SIZE, 5).astype(np.float32),
+)
+
+
+def _single_target_sk_metric(preds, target, sk_fn=explained_variance_score):
+    return sk_fn(target, preds)
+
+
+def _multi_target_sk_metric(preds, target, multioutput, sk_fn=explained_variance_score):
+    return sk_fn(target, preds, multioutput=multioutput)
+
+
+@pytest.mark.parametrize("multioutput", ["raw_values", "uniform_average", "variance_weighted"])
+@pytest.mark.parametrize(
+    "preds, target, sk_metric",
+    [
+        (_single_target_inputs.preds, _single_target_inputs.target, _single_target_sk_metric),
+        (_multi_target_inputs.preds, _multi_target_inputs.target, _multi_target_sk_metric),
+    ],
+)
+class TestExplainedVariance(MetricTester):
+    atol = 1e-4  # fp32 moment accumulation vs sklearn's two-pass fp64
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("dist_sync_on_step", [False, True])
+    def test_explained_variance_class(self, multioutput, preds, target, sk_metric, ddp, dist_sync_on_step):
+        if sk_metric is _single_target_sk_metric and multioutput != "uniform_average":
+            pytest.skip("single target only tests uniform_average")
+        sk = sk_metric if sk_metric is _single_target_sk_metric else partial(sk_metric, multioutput=multioutput)
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=ExplainedVariance,
+            sk_metric=sk,
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={"multioutput": multioutput},
+        )
+
+    def test_explained_variance_functional(self, multioutput, preds, target, sk_metric):
+        if sk_metric is _single_target_sk_metric and multioutput != "uniform_average":
+            pytest.skip("single target only tests uniform_average")
+        sk = sk_metric if sk_metric is _single_target_sk_metric else partial(sk_metric, multioutput=multioutput)
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=explained_variance,
+            sk_metric=sk,
+            metric_args={"multioutput": multioutput},
+        )
+
+
+def test_error_on_different_shape():
+    import jax.numpy as jnp
+
+    metric = ExplainedVariance()
+    with pytest.raises(RuntimeError, match="Predictions and targets are expected to have the same shape"):
+        metric(jnp.asarray(np.random.randn(100)), jnp.asarray(np.random.randn(50)))

@@ -1,0 +1,103 @@
+"""MSE/MAE/MSLE/MRE vs sklearn (mirrors reference tests/regression/test_mean_error.py)."""
+from collections import namedtuple
+from functools import partial
+
+import numpy as np
+import pytest
+from sklearn.metrics import mean_absolute_error as sk_mean_absolute_error
+from sklearn.metrics import mean_squared_error as sk_mean_squared_error
+from sklearn.metrics import mean_squared_log_error as sk_mean_squared_log_error
+
+from metrics_tpu import MeanAbsoluteError, MeanSquaredError, MeanSquaredLogError
+from metrics_tpu.functional import (
+    mean_absolute_error,
+    mean_relative_error,
+    mean_squared_error,
+    mean_squared_log_error,
+)
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES, MetricTester
+
+Input = namedtuple("Input", ["preds", "target"])
+
+_rng = np.random.RandomState(7)
+
+_single_target_inputs = Input(
+    preds=_rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32),
+    target=_rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32),
+)
+
+_multi_target_inputs = Input(
+    preds=_rng.rand(NUM_BATCHES, BATCH_SIZE, 5).astype(np.float32),
+    target=_rng.rand(NUM_BATCHES, BATCH_SIZE, 5).astype(np.float32),
+)
+
+
+def _single_target_sk_metric(preds, target, sk_fn):
+    return sk_fn(target.reshape(-1), preds.reshape(-1))
+
+
+def _multi_target_sk_metric(preds, target, sk_fn):
+    return sk_fn(target.reshape(-1), preds.reshape(-1))
+
+
+def _sk_mean_relative_error(target, preds):
+    target_nz = np.where(target == 0, 1, target)
+    return np.mean(np.abs((preds - target) / target_nz))
+
+
+@pytest.mark.parametrize(
+    "preds, target, sk_metric",
+    [
+        (_single_target_inputs.preds, _single_target_inputs.target, _single_target_sk_metric),
+        (_multi_target_inputs.preds, _multi_target_inputs.target, _multi_target_sk_metric),
+    ],
+)
+@pytest.mark.parametrize(
+    "metric_class, metric_functional, sk_fn",
+    [
+        (MeanSquaredError, mean_squared_error, sk_mean_squared_error),
+        (MeanAbsoluteError, mean_absolute_error, sk_mean_absolute_error),
+        (MeanSquaredLogError, mean_squared_log_error, sk_mean_squared_log_error),
+    ],
+)
+class TestMeanError(MetricTester):
+    atol = 1e-5  # fp32 accumulation vs sklearn fp64
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("dist_sync_on_step", [False, True])
+    def test_mean_error_class(
+        self, preds, target, sk_metric, metric_class, metric_functional, sk_fn, ddp, dist_sync_on_step
+    ):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=metric_class,
+            sk_metric=partial(sk_metric, sk_fn=sk_fn),
+            dist_sync_on_step=dist_sync_on_step,
+        )
+
+    def test_mean_error_functional(self, preds, target, sk_metric, metric_class, metric_functional, sk_fn):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=metric_functional,
+            sk_metric=partial(sk_metric, sk_fn=sk_fn),
+        )
+
+
+def test_mean_relative_error():
+    import jax.numpy as jnp
+
+    preds, target = _single_target_inputs.preds[0], _single_target_inputs.target[0]
+    result = mean_relative_error(jnp.asarray(preds), jnp.asarray(target))
+    np.testing.assert_allclose(float(result), _sk_mean_relative_error(target, preds), atol=1e-5)
+
+
+@pytest.mark.parametrize("metric_class", [MeanSquaredError, MeanAbsoluteError, MeanSquaredLogError])
+def test_error_on_different_shape(metric_class):
+    import jax.numpy as jnp
+
+    metric = metric_class()
+    with pytest.raises(RuntimeError, match="Predictions and targets are expected to have the same shape"):
+        metric(jnp.asarray(np.random.randn(100)), jnp.asarray(np.random.randn(50)))

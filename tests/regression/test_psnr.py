@@ -1,0 +1,120 @@
+"""PSNR vs skimage-style reference (mirrors reference tests/regression/test_psnr.py)."""
+from collections import namedtuple
+from functools import partial
+
+import numpy as np
+import pytest
+
+from metrics_tpu import PSNR
+from metrics_tpu.functional import psnr
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES, MetricTester
+
+Input = namedtuple("Input", ["preds", "target"])
+
+_rng = np.random.RandomState(31)
+
+_input_size = (NUM_BATCHES, BATCH_SIZE, 32, 32)
+_inputs = [
+    Input(
+        preds=_rng.randint(n_cls_pred, size=_input_size).astype(np.float32),
+        target=_rng.randint(n_cls_target, size=_input_size).astype(np.float32),
+    )
+    for n_cls_pred, n_cls_target in [(10, 10), (5, 10), (10, 5)]
+]
+
+
+def _to_sk_peak_signal_noise_ratio_inputs(value, dim):
+    value = value.astype(np.float32)
+    if dim is None:
+        return [(value, )]
+
+    inputs = []
+    for i in range(value.shape[0]):
+        inputs.append((value[i], ))
+    return inputs
+
+
+def _sk_psnr(preds, target, data_range, base, dim, reduction="elementwise_mean"):
+    """Reference computation: 10*log10(range^2 / mse) over the given dims."""
+    if dim is None:
+        groups = [(preds, target)]
+    else:
+        groups = [(preds[i], target[i]) for i in range(preds.shape[0])]
+    results = []
+    for p, t in groups:
+        mse = np.mean((p.astype(np.float64) - t.astype(np.float64)) ** 2)
+        value = 10 * np.log10(data_range**2 / mse)
+        if base != 10.0:
+            value = value / np.log10(base)
+        results.append(value)
+    results = np.array(results)
+    if dim is None:
+        return results[0]
+    if reduction == "elementwise_mean":
+        return results.mean()
+    return results
+
+
+@pytest.mark.parametrize(
+    "preds, target, data_range",
+    [
+        (_inputs[0].preds, _inputs[0].target, 10),
+        (_inputs[1].preds, _inputs[1].target, 10),
+        (_inputs[2].preds, _inputs[2].target, 5),
+    ],
+)
+@pytest.mark.parametrize("base", [10.0, 2.718281828459045])
+@pytest.mark.parametrize(
+    "dim, reduction",
+    [(None, "elementwise_mean"), ((1, 2), "elementwise_mean")],
+)
+class TestPSNR(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [False])
+    @pytest.mark.parametrize("dist_sync_on_step", [False])
+    def test_psnr(self, preds, target, data_range, base, dim, reduction, ddp, dist_sync_on_step):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=PSNR,
+            sk_metric=partial(_sk_psnr, data_range=data_range, base=base, dim=dim, reduction=reduction),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={"data_range": data_range, "base": base, "dim": dim, "reduction": reduction},
+        )
+
+    def test_psnr_functional(self, preds, target, data_range, base, dim, reduction):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=psnr,
+            sk_metric=partial(_sk_psnr, data_range=data_range, base=base, dim=dim, reduction=reduction),
+            metric_args={"data_range": data_range, "base": base, "dim": dim, "reduction": reduction},
+        )
+
+
+def test_psnr_infer_data_range():
+    """data_range=None tracks running target min/max (reference psnr.py:102-103, 121-123)."""
+    import jax.numpy as jnp
+
+    metric = PSNR()
+    preds = jnp.asarray(_inputs[0].preds[0])
+    target = jnp.asarray(_inputs[0].target[0])
+    metric(preds, target)
+    result = metric.compute()
+    expected = _sk_psnr(
+        np.asarray(preds), np.asarray(target), data_range=float(np.max(target) - min(np.min(target), 0)),
+        base=10.0, dim=None,
+    )
+    np.testing.assert_allclose(float(result), expected, atol=1e-4)
+
+
+def test_missing_data_range():
+    import jax.numpy as jnp
+
+    with pytest.raises(ValueError):
+        PSNR(data_range=None, dim=0)
+
+    with pytest.raises(ValueError):
+        psnr(jnp.asarray(_inputs[0].preds[0]), jnp.asarray(_inputs[0].target[0]), data_range=None, dim=0)

@@ -1,0 +1,102 @@
+"""R2Score vs sklearn (mirrors reference tests/regression/test_r2score.py)."""
+from collections import namedtuple
+from functools import partial
+
+import numpy as np
+import pytest
+from sklearn.metrics import r2_score as sk_r2score
+
+from metrics_tpu import R2Score
+from metrics_tpu.functional import r2score
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES, MetricTester
+
+Input = namedtuple("Input", ["preds", "target"])
+
+_rng = np.random.RandomState(23)
+
+_single_target_inputs = Input(
+    preds=_rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32),
+    target=_rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32),
+)
+
+_multi_target_inputs = Input(
+    preds=_rng.rand(NUM_BATCHES, BATCH_SIZE, 2).astype(np.float32),
+    target=_rng.rand(NUM_BATCHES, BATCH_SIZE, 2).astype(np.float32),
+)
+
+
+def _single_target_sk_metric(preds, target, adjusted, multioutput):
+    sk_preds = preds.reshape(-1)
+    sk_target = target.reshape(-1)
+    r2_score = sk_r2score(sk_target, sk_preds, multioutput=multioutput)
+    if adjusted != 0:
+        r2_score = 1 - (1 - r2_score) * (sk_preds.shape[0] - 1) / (sk_preds.shape[0] - adjusted - 1)
+    return r2_score
+
+
+def _multi_target_sk_metric(preds, target, adjusted, multioutput):
+    sk_preds = preds.reshape(-1, 2)
+    sk_target = target.reshape(-1, 2)
+    r2_score = sk_r2score(sk_target, sk_preds, multioutput=multioutput)
+    if adjusted != 0:
+        r2_score = 1 - (1 - r2_score) * (sk_preds.shape[0] - 1) / (sk_preds.shape[0] - adjusted - 1)
+    return r2_score
+
+
+@pytest.mark.parametrize("adjusted", [0, 5, 10])
+@pytest.mark.parametrize("multioutput", ["raw_values", "uniform_average", "variance_weighted"])
+@pytest.mark.parametrize(
+    "preds, target, sk_metric, num_outputs",
+    [
+        (_single_target_inputs.preds, _single_target_inputs.target, _single_target_sk_metric, 1),
+        (_multi_target_inputs.preds, _multi_target_inputs.target, _multi_target_sk_metric, 2),
+    ],
+)
+class TestR2Score(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("dist_sync_on_step", [False])
+    def test_r2(self, adjusted, multioutput, preds, target, sk_metric, num_outputs, ddp, dist_sync_on_step):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=R2Score,
+            sk_metric=partial(sk_metric, adjusted=adjusted, multioutput=multioutput),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={"adjusted": adjusted, "multioutput": multioutput, "num_outputs": num_outputs},
+        )
+
+    def test_r2_functional(self, adjusted, multioutput, preds, target, sk_metric, num_outputs):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=r2score,
+            sk_metric=partial(sk_metric, adjusted=adjusted, multioutput=multioutput),
+            metric_args={"adjusted": adjusted, "multioutput": multioutput},
+        )
+
+
+def test_error_on_different_shape():
+    import jax.numpy as jnp
+
+    metric = R2Score()
+    with pytest.raises(RuntimeError, match="Predictions and targets are expected to have the same shape"):
+        metric(jnp.asarray(np.random.randn(100)), jnp.asarray(np.random.randn(50)))
+
+
+def test_error_on_multidim_tensors():
+    import jax.numpy as jnp
+
+    metric = R2Score()
+    with pytest.raises(ValueError, match=r"Expected both prediction and target to be 1D or 2D tensors"):
+        metric(jnp.asarray(np.random.randn(10, 25, 5)), jnp.asarray(np.random.randn(10, 25, 5)))
+
+
+def test_error_on_too_few_samples():
+    import jax.numpy as jnp
+
+    metric = R2Score()
+    with pytest.raises(ValueError, match="Needs at least two samples to calculate r2 score."):
+        metric(jnp.asarray(np.random.randn(1)), jnp.asarray(np.random.randn(1)))

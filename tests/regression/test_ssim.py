@@ -1,0 +1,125 @@
+"""SSIM vs an independent float64 numpy/scipy oracle.
+
+The reference compared against ``skimage.metrics.structural_similarity``
+(reference tests/regression/test_ssim.py); skimage is not in this image, so the
+oracle here is a direct float64 re-computation of windowed SSIM with the same
+gaussian window, written against numpy/scipy only.
+"""
+from collections import namedtuple
+from functools import partial
+
+import numpy as np
+import pytest
+from scipy.signal import convolve2d
+
+from metrics_tpu import SSIM
+from metrics_tpu.functional import ssim
+from tests.helpers.testers import MetricTester
+
+Input = namedtuple("Input", ["preds", "target"])
+
+_rng = np.random.RandomState(41)
+
+NUM_BATCHES, BATCH_SIZE = 4, 2  # smaller than usual: SSIM stores all images
+
+_inputs = [
+    Input(
+        preds=_rng.rand(NUM_BATCHES, BATCH_SIZE, channels, 32, 32).astype(np.float32),
+        target=_rng.rand(NUM_BATCHES, BATCH_SIZE, channels, 32, 32).astype(np.float32),
+    )
+    for channels in [1, 3]
+]
+
+
+def _np_gaussian(kernel_size, sigma):
+    dist = np.arange((1 - kernel_size) / 2, (1 + kernel_size) / 2, 1, dtype=np.float64)
+    gauss = np.exp(-((dist / sigma) ** 2) / 2)
+    return gauss / gauss.sum()
+
+
+def _np_ssim(preds, target, kernel_size=(11, 11), sigma=(1.5, 1.5), data_range=None, k1=0.01, k2=0.03):
+    preds = preds.astype(np.float64)
+    target = target.astype(np.float64)
+    if data_range is None:
+        data_range = max(preds.max() - preds.min(), target.max() - target.min())
+    c1 = (k1 * data_range) ** 2
+    c2 = (k2 * data_range) ** 2
+    kernel = np.outer(_np_gaussian(kernel_size[0], sigma[0]), _np_gaussian(kernel_size[1], sigma[1]))
+    pad_h = (kernel_size[0] - 1) // 2
+    pad_w = (kernel_size[1] - 1) // 2
+
+    def win_mean(x):
+        # reflect-pad then valid conv == the reference's padded conv
+        out = np.empty_like(x)
+        for n in range(x.shape[0]):
+            for c in range(x.shape[1]):
+                padded = np.pad(x[n, c], ((pad_h, pad_h), (pad_w, pad_w)), mode="reflect")
+                out[n, c] = convolve2d(padded, kernel[::-1, ::-1], mode="valid")
+        return out
+
+    mu_p, mu_t = win_mean(preds), win_mean(target)
+    sigma_p = win_mean(preds * preds) - mu_p**2
+    sigma_t = win_mean(target * target) - mu_t**2
+    sigma_pt = win_mean(preds * target) - mu_p * mu_t
+
+    ssim_idx = ((2 * mu_p * mu_t + c1) * (2 * sigma_pt + c2)) / ((mu_p**2 + mu_t**2 + c1) * (sigma_p + sigma_t + c2))
+    ssim_idx = ssim_idx[..., pad_h:-pad_h, pad_w:-pad_w]
+    return ssim_idx.mean()
+
+
+@pytest.mark.parametrize(
+    "preds, target",
+    [(i.preds, i.target) for i in _inputs],
+)
+class TestSSIM(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("dist_sync_on_step", [False])
+    def test_ssim(self, preds, target, ddp, dist_sync_on_step):
+        # NUM_BATCHES/BATCH_SIZE overridden locally: patch module constants scope
+        import tests.helpers.testers as T
+
+        old = (T.NUM_BATCHES,)
+        T.NUM_BATCHES = NUM_BATCHES
+        try:
+            self.run_class_metric_test(
+                ddp=ddp,
+                preds=preds,
+                target=target,
+                metric_class=SSIM,
+                sk_metric=partial(_np_ssim, data_range=1.0),
+                dist_sync_on_step=dist_sync_on_step,
+                metric_args={"data_range": 1.0},
+            )
+        finally:
+            T.NUM_BATCHES = old[0]
+
+    def test_ssim_functional(self, preds, target):
+        import tests.helpers.testers as T
+
+        old = (T.NUM_BATCHES,)
+        T.NUM_BATCHES = NUM_BATCHES
+        try:
+            self.run_functional_metric_test(
+                preds,
+                target,
+                metric_functional=ssim,
+                sk_metric=partial(_np_ssim, data_range=1.0),
+                metric_args={"data_range": 1.0},
+            )
+        finally:
+            T.NUM_BATCHES = old[0]
+
+
+def test_ssim_invalid_inputs():
+    import jax.numpy as jnp
+
+    with pytest.raises(TypeError):
+        ssim(jnp.zeros((1, 1, 16, 16), dtype=jnp.float32), jnp.zeros((1, 1, 16, 16), dtype=jnp.int32))
+
+    with pytest.raises(ValueError):
+        ssim(jnp.zeros((1, 16, 16)), jnp.zeros((1, 16, 16)))
+
+    with pytest.raises(ValueError):
+        ssim(jnp.zeros((1, 1, 16, 16)), jnp.zeros((1, 1, 16, 16)), kernel_size=(11, 10))

@@ -1,0 +1,114 @@
+"""RetrievalMAP vs a sklearn-based oracle
+(mirrors reference tests/retrieval/test_map.py, which groups with numpy and
+scores each group with sklearn's average_precision_score)."""
+import math
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import average_precision_score as sk_average_precision
+
+from metrics_tpu.functional.retrieval import retrieval_average_precision
+from metrics_tpu.retrieval import RetrievalMAP
+
+
+def _compute_sklearn_metric(metric, target, preds, behaviour):
+    """Reference oracle (reference tests/retrieval/test_map.py:12-41)."""
+    sk_results = []
+    kwargs = {}
+
+    for b, a in zip(target, preds):
+        if b.sum() == 0:
+            if behaviour == "skip":
+                pass
+            elif behaviour == "pos":
+                sk_results.append(1.0)
+            else:
+                sk_results.append(0.0)
+        else:
+            res = metric(b, a, **kwargs)
+            sk_results.append(res)
+
+    if len(sk_results) > 0:
+        return np.mean(sk_results)
+    return np.array(0.0)
+
+
+@pytest.mark.parametrize("size", [1, 4, 10])
+@pytest.mark.parametrize("n_documents", [1, 5])
+@pytest.mark.parametrize("query_without_relevant_docs_options", ["skip", "pos", "neg"])
+def test_results(size, n_documents, query_without_relevant_docs_options):
+    """Test metrics are computed correctly wrt the sklearn baseline
+    (reference tests/retrieval/test_map.py:44-75)."""
+    _seed = size + n_documents * 10
+    np.random.seed(_seed)
+    random.seed(_seed)
+
+    target = [np.random.randint(0, 2, size=(size,)) for _ in range(n_documents)]
+    preds = [np.random.randn(size) for _ in range(n_documents)]
+
+    sk_results = _compute_sklearn_metric(
+        sk_average_precision, target, preds, query_without_relevant_docs_options
+    )
+
+    indexes = [np.full(size, fill_value=i) for i in range(n_documents)]
+    metric = RetrievalMAP(query_without_relevant_docs=query_without_relevant_docs_options)
+
+    for i, p, t in zip(indexes, preds, target):
+        metric.update(jnp.asarray(i), jnp.asarray(p.astype(np.float32)), jnp.asarray(t))
+
+    result = metric.compute()
+    np.testing.assert_allclose(float(result), sk_results, atol=1e-6)
+
+
+def test_dtypes_and_shapes():
+    metric = RetrievalMAP()
+    with pytest.raises(ValueError, match="must be of the same shape"):
+        metric.update(jnp.array([0, 0]), jnp.array([0.1, 0.2, 0.3]), jnp.array([1, 0]))
+
+
+def test_error_on_empty_queries():
+    metric = RetrievalMAP(query_without_relevant_docs="error")
+    metric.update(jnp.array([0, 0]), jnp.array([0.1, 0.2]), jnp.array([0, 0]))
+    with pytest.raises(ValueError, match="without positive targets"):
+        metric.compute()
+
+
+def test_wrong_policy():
+    with pytest.raises(ValueError, match="received a wrong value"):
+        RetrievalMAP(query_without_relevant_docs="fancy")
+
+
+def test_functional_average_precision():
+    """reference tests check AP of single queries against sklearn."""
+    rng = np.random.RandomState(42)
+    for _ in range(10):
+        preds = rng.rand(20).astype(np.float32)
+        target = rng.randint(0, 2, 20)
+        if target.sum() == 0:
+            continue
+        mine = float(retrieval_average_precision(jnp.asarray(preds), jnp.asarray(target)))
+        np.testing.assert_allclose(mine, sk_average_precision(target, preds), atol=1e-6)
+
+
+def test_exclude_sentinel_rows():
+    """Rows with target == exclude are dropped before ranking; the
+    empty-query check uses raw sums (reference retrieval_metric.py:121 quirk)."""
+    metric = RetrievalMAP()
+    idx = jnp.array([0, 0, 0, 1, 1])
+    preds = jnp.array([0.9, 0.5, 0.1, 0.8, 0.2])
+    target = jnp.array([-100, 1, 0, 1, 0])
+    # query 0: exclude top row -> remaining [0.5->1, 0.1->0] -> AP = 1.0
+    # query 1: [0.8->1, 0.2->0] -> AP = 1.0
+    result = metric(idx, preds, target)
+    np.testing.assert_allclose(float(result), 1.0, atol=1e-6)
+
+
+def test_interleaved_query_rows():
+    """Rows of the same query arriving in different updates are regrouped."""
+    metric = RetrievalMAP()
+    metric.update(jnp.array([0, 1]), jnp.array([0.5, 0.3]), jnp.array([1, 0]))
+    metric.update(jnp.array([1, 0]), jnp.array([0.6, 0.1]), jnp.array([1, 0]))
+    # query 0: preds [.5(1), .1(0)] -> AP 1.0 ; query 1: preds [.3(0), .6(1)] -> AP 1.0
+    np.testing.assert_allclose(float(metric.compute()), 1.0, atol=1e-6)

@@ -1,0 +1,59 @@
+"""RetrievalNormalizedDCG vs sklearn ndcg_score."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import ndcg_score
+
+from metrics_tpu.functional.retrieval import retrieval_normalized_dcg
+from metrics_tpu.retrieval import RetrievalNormalizedDCG
+
+
+def test_functional_vs_sklearn():
+    rng = np.random.RandomState(7)
+    for _ in range(10):
+        preds = rng.rand(16).astype(np.float32)
+        target = rng.randint(0, 4, 16)  # graded relevance
+        if target.sum() == 0:
+            continue
+        mine = float(retrieval_normalized_dcg(jnp.asarray(preds), jnp.asarray(target)))
+        sk = ndcg_score(target[None], preds[None])
+        np.testing.assert_allclose(mine, sk, atol=1e-5)
+
+
+def test_module_multi_query_vs_sklearn():
+    rng = np.random.RandomState(11)
+    n_queries, size = 6, 12
+    metric = RetrievalNormalizedDCG()
+    per_query = []
+    for q in range(n_queries):
+        preds = rng.rand(size).astype(np.float32)
+        target = rng.randint(0, 3, size)
+        if target.sum() == 0:
+            target[0] = 1
+        per_query.append(ndcg_score(target[None], preds[None]))
+        metric.update(jnp.full(size, q), jnp.asarray(preds), jnp.asarray(target))
+    np.testing.assert_allclose(float(metric.compute()), np.mean(per_query), atol=1e-5)
+
+
+def test_vectorized_matches_single_query():
+    rng = np.random.RandomState(3)
+    preds = rng.rand(24).astype(np.float32)
+    target = rng.randint(0, 2, 24)
+    target[:2] = 1
+    idx = np.repeat(np.arange(3), 8)
+    metric = RetrievalNormalizedDCG()
+    metric.update(jnp.asarray(idx), jnp.asarray(preds), jnp.asarray(target))
+    grouped = float(metric.compute())
+
+    singles = []
+    for q in range(3):
+        m = target[idx == q]
+        if m.sum() == 0:
+            continue
+        singles.append(float(retrieval_normalized_dcg(jnp.asarray(preds[idx == q]), jnp.asarray(m))))
+    np.testing.assert_allclose(grouped, np.mean(singles), atol=1e-6)
+
+
+def test_invalid_k():
+    with pytest.raises(ValueError, match="positive integer"):
+        RetrievalNormalizedDCG(k=-1)
