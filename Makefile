@@ -1,0 +1,12 @@
+.PHONY: test clean bench
+
+# run the full suite on 8 fake CPU devices (the conftest forces the platform)
+test:
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" python -m pytest tests/ -q
+
+bench:
+	python bench.py
+
+clean:
+	rm -rf .pytest_cache build dist *.egg-info
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -1,0 +1,14 @@
+"""Deprecated import location (parity with reference
+``torchmetrics/classification/checks.py:1-9``, which re-exports the input
+checks from ``utilities.checks`` with a deprecation warning)."""
+from metrics_tpu.utils.checks import (  # noqa: F401
+    _check_classification_inputs,
+    _input_format_classification,
+    _input_format_classification_one_hot,
+)
+from metrics_tpu.utils.prints import rank_zero_warn
+
+rank_zero_warn(
+    "`metrics_tpu.classification.checks` is deprecated; import from `metrics_tpu.utils.checks` instead.",
+    DeprecationWarning,
+)

@@ -211,6 +211,11 @@ def _check_classification_inputs(
     if preds.shape[:1] != target.shape[:1]:
         raise ValueError("The `preds` and `target` should have the same first dimension.")
     case, implied_classes = _resolve_case(preds, target)
+    if preds.ndim == target.ndim + 1 and is_multiclass is False and implied_classes != 2:
+        raise ValueError(
+            "You have set `is_multiclass=False`, but have more than 2 classes in your data,"
+            " based on the C dimension of `preds`."
+        )
     _validate_static(case, implied_classes, _is_float(preds), threshold, num_classes, is_multiclass, top_k)
     if is_concrete(preds) and is_concrete(target):
         _validate_values(preds, target, case, implied_classes, threshold, num_classes, is_multiclass)

@@ -86,6 +86,8 @@ def to_onehot(label_tensor: Array, num_classes: Optional[int] = None) -> Array:
                 "inference from data values requires concrete arrays."
             )
         num_classes = int(jnp.max(label_tensor)) + 1
+    if label_tensor.dtype == jnp.bool_:
+        label_tensor = label_tensor.astype(jnp.int32)
     onehot = jax.nn.one_hot(label_tensor, num_classes, dtype=jnp.int32)
     # (N, ..., C) -> (N, C, ...)
     return jnp.moveaxis(onehot, -1, 1)

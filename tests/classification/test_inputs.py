@@ -1,0 +1,275 @@
+"""Exhaustive input-formatting tests
+(mirrors reference tests/classification/test_inputs.py, test_usual_cases at :171)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.data import select_topk, to_onehot
+from metrics_tpu.utils.enums import DataType
+from tests.classification.inputs import (
+    Input,
+    _input_binary as _bin,
+    _input_binary_prob as _bin_prob,
+    _input_multiclass as _mc,
+    _input_multiclass_prob as _mc_prob,
+    _input_multidim_multiclass as _mdmc,
+    _input_multidim_multiclass_prob as _mdmc_prob,
+    _input_multilabel as _ml,
+    _input_multilabel_multidim as _mlmd,
+    _input_multilabel_multidim_prob as _mlmd_prob,
+    _input_multilabel_prob as _ml_prob,
+)
+from tests.helpers.testers import BATCH_SIZE, EXTRA_DIM, NUM_BATCHES, NUM_CLASSES, THRESHOLD
+
+_rng = np.random.RandomState(13)
+
+# additional inputs
+_ml_prob_half = Input(_ml_prob.preds.astype(np.float16), _ml_prob.target)
+
+_mc_prob_2cls_preds = _rng.rand(NUM_BATCHES, BATCH_SIZE, 2).astype(np.float32)
+_mc_prob_2cls_preds /= _mc_prob_2cls_preds.sum(axis=2, keepdims=True)
+_mc_prob_2cls = Input(_mc_prob_2cls_preds, _rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE)))
+
+_mdmc_prob_many_dims_preds = _rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM, EXTRA_DIM).astype(np.float32)
+_mdmc_prob_many_dims_preds /= _mdmc_prob_many_dims_preds.sum(axis=2, keepdims=True)
+_mdmc_prob_many_dims = Input(
+    _mdmc_prob_many_dims_preds,
+    _rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM, EXTRA_DIM)),
+)
+
+_mdmc_prob_2cls_preds = _rng.rand(NUM_BATCHES, BATCH_SIZE, 2, EXTRA_DIM).astype(np.float32)
+_mdmc_prob_2cls_preds /= _mdmc_prob_2cls_preds.sum(axis=2, keepdims=True)
+_mdmc_prob_2cls = Input(_mdmc_prob_2cls_preds, _rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)))
+
+
+def _idn(x):
+    return jnp.asarray(x)
+
+
+def _usq(x):
+    return jnp.expand_dims(jnp.asarray(x), -1)
+
+
+def _thrs(x):
+    return jnp.asarray(x) >= THRESHOLD
+
+
+def _rshp1(x):
+    x = jnp.asarray(x)
+    return x.reshape(x.shape[0], -1)
+
+
+def _rshp2(x):
+    x = jnp.asarray(x)
+    return x.reshape(x.shape[0], x.shape[1], -1)
+
+
+def _onehot(x):
+    return to_onehot(jnp.asarray(x), NUM_CLASSES)
+
+
+def _onehot2(x):
+    return to_onehot(jnp.asarray(x), 2)
+
+
+def _top1(x):
+    return select_topk(jnp.asarray(x), 1)
+
+
+def _top2(x):
+    return select_topk(jnp.asarray(x), 2)
+
+
+def _ml_preds_tr(x):
+    return _rshp1(_thrs(x))
+
+
+def _onehot_rshp1(x):
+    return _onehot(_rshp1(x))
+
+
+def _onehot2_rshp1(x):
+    return _onehot2(_rshp1(x))
+
+
+def _top1_rshp2(x):
+    return _top1(_rshp2(x))
+
+
+def _top2_rshp2(x):
+    return _top2(_rshp2(x))
+
+
+def _probs_to_mc_preds_tr(x):
+    return _onehot2(_thrs(x))
+
+
+def _mlmd_prob_to_mc_preds_tr(x):
+    return _onehot2(_rshp1(_thrs(x)))
+
+
+@pytest.mark.parametrize(
+    "inputs, num_classes, is_multiclass, top_k, exp_mode, post_preds, post_target",
+    [
+        # usual expected cases (reference :130-146)
+        (_bin, None, False, None, "multi-class", _usq, _usq),
+        (_bin, 1, False, None, "multi-class", _usq, _usq),
+        (_bin_prob, None, None, None, "binary", lambda x: _usq(_thrs(x)), _usq),
+        (_ml_prob, None, None, None, "multi-label", _thrs, _idn),
+        (_ml, None, False, None, "multi-dim multi-class", _idn, _idn),
+        (_ml_prob, None, None, None, "multi-label", _ml_preds_tr, _rshp1),
+        (_ml_prob, None, None, 2, "multi-label", _top2, _rshp1),
+        (_mlmd, None, False, None, "multi-dim multi-class", _rshp1, _rshp1),
+        (_mc, NUM_CLASSES, None, None, "multi-class", _onehot, _onehot),
+        (_mc_prob, None, None, None, "multi-class", _top1, _onehot),
+        (_mc_prob, None, None, 2, "multi-class", _top2, _onehot),
+        (_mdmc, NUM_CLASSES, None, None, "multi-dim multi-class", _onehot, _onehot),
+        (_mdmc_prob, None, None, None, "multi-dim multi-class", _top1_rshp2, _onehot),
+        (_mdmc_prob, None, None, 2, "multi-dim multi-class", _top2_rshp2, _onehot),
+        (_mdmc_prob_many_dims, None, None, None, "multi-dim multi-class", _top1_rshp2, _onehot_rshp1),
+        (_mdmc_prob_many_dims, None, None, 2, "multi-dim multi-class", _top2_rshp2, _onehot_rshp1),
+        # special cases (reference :148-168)
+        (_ml_prob_half, None, None, None, "multi-label", lambda x: _ml_preds_tr(np.asarray(x, np.float32)), _rshp1),
+        (_bin, None, None, None, "multi-class", _onehot2, _onehot2),
+        (_bin_prob, None, True, None, "binary", _probs_to_mc_preds_tr, _onehot2),
+        (_ml, None, True, None, "multi-dim multi-class", _onehot2, _onehot2),
+        (_ml_prob, None, True, None, "multi-label", _probs_to_mc_preds_tr, _onehot2),
+        (_mlmd, None, True, None, "multi-dim multi-class", _onehot2_rshp1, _onehot2_rshp1),
+        (_mlmd_prob, None, True, None, "multi-label", _mlmd_prob_to_mc_preds_tr, _onehot2_rshp1),
+        (_mc_prob_2cls, None, False, None, "multi-class", lambda x: _top1(x)[:, [1]], _usq),
+        (_mdmc_prob_2cls, None, False, None, "multi-dim multi-class", lambda x: _top1(x)[:, 1], _idn),
+    ],
+)
+def test_usual_cases(inputs, num_classes, is_multiclass, top_k, exp_mode, post_preds, post_target):
+    preds_out, target_out, mode = _input_format_classification(
+        preds=jnp.asarray(inputs.preds[0]),
+        target=jnp.asarray(inputs.target[0]),
+        threshold=THRESHOLD,
+        num_classes=num_classes,
+        is_multiclass=is_multiclass,
+        top_k=top_k,
+    )
+
+    assert mode == exp_mode
+    np.testing.assert_array_equal(np.asarray(preds_out), np.asarray(post_preds(inputs.preds[0])).astype(np.int32))
+    np.testing.assert_array_equal(np.asarray(target_out), np.asarray(post_target(inputs.target[0])).astype(np.int32))
+
+    # batch_size = 1 keeps the leading dim
+    preds_out, target_out, mode = _input_format_classification(
+        preds=jnp.asarray(inputs.preds[0][[0], ...]),
+        target=jnp.asarray(inputs.target[0][[0], ...]),
+        threshold=THRESHOLD,
+        num_classes=num_classes,
+        is_multiclass=is_multiclass,
+        top_k=top_k,
+    )
+
+    assert mode == exp_mode
+    np.testing.assert_array_equal(
+        np.asarray(preds_out), np.asarray(post_preds(inputs.preds[0][[0], ...])).astype(np.int32)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(target_out), np.asarray(post_target(inputs.target[0][[0], ...])).astype(np.int32)
+    )
+
+
+def test_threshold():
+    target = jnp.asarray([1, 1, 1], dtype=jnp.int32)
+    preds_probs = jnp.asarray([0.5 - 1e-5, 0.5, 0.5 + 1e-5])
+    preds_probs_out, _, _ = _input_format_classification(preds_probs, target, threshold=0.5)
+    assert np.asarray(preds_probs_out).squeeze().tolist() == [0, 1, 1]
+
+
+@pytest.mark.parametrize("threshold", [-0.5, 0.0, 1.0, 1.5])
+def test_incorrect_threshold(threshold):
+    preds = jnp.asarray(_rng.rand(7).astype(np.float32))
+    target = jnp.asarray(_rng.randint(0, 2, 7))
+    with pytest.raises(ValueError):
+        _input_format_classification(preds, target, threshold=threshold)
+
+
+@pytest.mark.parametrize(
+    "preds, target, num_classes, is_multiclass",
+    [
+        # target not integer
+        (_rng.randint(0, 2, 7), _rng.randint(0, 2, 7).astype(np.float32), None, None),
+        # target negative
+        (_rng.randint(0, 2, 7), -_rng.randint(1, 2, 7), None, None),
+        # preds negative integers
+        (-_rng.randint(1, 2, 7), _rng.randint(0, 2, 7), None, None),
+        # negative probabilities
+        (-_rng.rand(7).astype(np.float32), _rng.randint(0, 2, 7), None, None),
+        # is_multiclass=False and target > 1
+        (_rng.rand(7).astype(np.float32), _rng.randint(2, 4, 7), None, False),
+        # is_multiclass=False and preds integers with > 1
+        (_rng.randint(2, 4, 7), _rng.randint(0, 2, 7), None, False),
+        # wrong batch size
+        (_rng.randint(0, 2, 8), _rng.randint(0, 2, 7), None, None),
+        # completely wrong shape
+        (_rng.randint(0, 2, 7), _rng.randint(0, 2, (7, 4)), None, None),
+        # same #dims, different shape
+        (_rng.randint(0, 2, (7, 3)), _rng.randint(0, 2, (7, 4)), None, None),
+        # same shape and preds floats, target not binary
+        (_rng.rand(7, 3).astype(np.float32), _rng.randint(2, 4, (7, 3)), None, None),
+        # #dims in preds = 1 + #dims in target, C shape not second
+        (_rng.rand(7, 3, 4, 3).astype(np.float32), _rng.randint(0, 4, (7, 3, 3)), None, None),
+        # #dims in preds = 1 + #dims in target, preds not float
+        (_rng.randint(0, 2, (7, 3, 3, 4)), _rng.randint(0, 4, (7, 3, 3)), None, None),
+        # is_multiclass=False, with C dimension > 2
+        (_mc_prob.preds[0], _rng.randint(0, 2, BATCH_SIZE), None, False),
+        # probs of multiclass preds do not sum up to 1
+        (_rng.rand(7, 3, 5).astype(np.float32), _rng.randint(0, 2, (7, 5)), None, None),
+        # max target larger or equal to C dimension
+        (_mc_prob.preds[0], _rng.randint(NUM_CLASSES + 1, 100, BATCH_SIZE), None, None),
+        # C dimension not equal to num_classes
+        (_mc_prob.preds[0], _rng.randint(0, NUM_CLASSES, BATCH_SIZE), NUM_CLASSES + 1, None),
+        # max target larger than num_classes (with #dims preds = 1 + #dims target)
+        (_mc_prob.preds[0], _rng.randint(NUM_CLASSES + 1, 100, BATCH_SIZE), NUM_CLASSES, None),
+        # max target larger than num_classes (with #dims preds = #dims target)
+        (_rng.randint(0, 2, 7), _rng.randint(NUM_CLASSES + 1, 100, 7), NUM_CLASSES, None),
+        # num_classes=1 with is_multiclass not false
+        (_rng.randint(0, 2, 7), _rng.randint(0, 2, 7), 1, True),
+        # binary input and num_classes > 2
+        (_rng.rand(7).astype(np.float32), _rng.randint(0, 2, 7), 4, None),
+        # binary input, num_classes == 2 and is_multiclass not True
+        (_rng.rand(7).astype(np.float32), _rng.randint(0, 2, 7), 2, None),
+        (_rng.rand(7).astype(np.float32), _rng.randint(0, 2, 7), 2, False),
+        # binary input, num_classes == 1 and is_multiclass=True
+        (_rng.rand(7).astype(np.float32), _rng.randint(0, 2, 7), 1, True),
+    ],
+)
+def test_incorrect_inputs(preds, target, num_classes, is_multiclass):
+    with pytest.raises(ValueError):
+        _input_format_classification(
+            preds=jnp.asarray(preds),
+            target=jnp.asarray(target),
+            threshold=THRESHOLD,
+            num_classes=num_classes,
+            is_multiclass=is_multiclass,
+        )
+
+
+@pytest.mark.parametrize(
+    "preds, target, num_classes, is_multiclass, top_k",
+    [
+        # top_k with binary data
+        (_rng.rand(7).astype(np.float32), _rng.randint(0, 2, 7), None, None, 2),
+        # top_k with label preds
+        (_rng.randint(0, 4, 7), _rng.randint(0, 4, 7), 4, None, 2),
+        # top_k with is_multiclass=False
+        (_mc_prob.preds[0], _rng.randint(0, 2, BATCH_SIZE), None, False, 2),
+        # top_k >= C
+        (_mc_prob.preds[0], _rng.randint(0, NUM_CLASSES, BATCH_SIZE), None, None, NUM_CLASSES),
+    ],
+)
+def test_incorrect_top_k(preds, target, num_classes, is_multiclass, top_k):
+    with pytest.raises(ValueError):
+        _input_format_classification(
+            preds=jnp.asarray(preds),
+            target=jnp.asarray(target),
+            threshold=THRESHOLD,
+            num_classes=num_classes,
+            is_multiclass=is_multiclass,
+            top_k=top_k,
+        )

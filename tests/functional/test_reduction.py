@@ -1,0 +1,48 @@
+"""reduce/class_reduce tests (mirrors reference tests/functional/test_reduction.py:20-31)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.utils.reductions import class_reduce, reduce
+
+
+def test_reduce():
+    start_tensor = jnp.arange(50, dtype=jnp.float32).reshape(5, 10)
+
+    np.testing.assert_allclose(np.asarray(reduce(start_tensor, "elementwise_mean")), np.asarray(jnp.mean(start_tensor)))
+    np.testing.assert_allclose(np.asarray(reduce(start_tensor, "sum")), np.asarray(jnp.sum(start_tensor)))
+    np.testing.assert_allclose(np.asarray(reduce(start_tensor, "none")), np.asarray(start_tensor))
+
+    with pytest.raises(ValueError):
+        reduce(start_tensor, "error_reduction")
+
+
+def test_class_reduce():
+    num = jnp.asarray(np.random.randint(1, 10, 100).astype(np.float32))
+    denom = jnp.asarray(np.random.rand(100).astype(np.float32) + num)
+    weights = jnp.asarray(np.random.randint(1, 100, 100).astype(np.float32))
+
+    for class_reduction in ["micro", "macro", "weighted", "none"]:
+        result = class_reduce(num, denom, weights, class_reduction=class_reduction)
+        if class_reduction == "micro":
+            expected = float(jnp.sum(num) / jnp.sum(denom))
+            np.testing.assert_allclose(float(result), expected, rtol=1e-6)
+        elif class_reduction == "macro":
+            expected = float(jnp.mean(num / denom))
+            np.testing.assert_allclose(float(result), expected, rtol=1e-6)
+        elif class_reduction == "weighted":
+            expected = float(jnp.sum(num / denom * (weights / jnp.sum(weights))))
+            np.testing.assert_allclose(float(result), expected, rtol=1e-6)
+        else:
+            expected = np.asarray(num / denom)
+            np.testing.assert_allclose(np.asarray(result), expected, rtol=1e-6)
+
+
+def test_class_reduce_nan_guard():
+    """0/0 entries become 0 in every mode (incl. micro, reference distributed.py:74)."""
+    num = jnp.zeros(3)
+    denom = jnp.zeros(3)
+    weights = jnp.ones(3)
+    for mode in ["micro", "macro", "weighted", "none"]:
+        result = class_reduce(num, denom, weights, class_reduction=mode)
+        assert not bool(jnp.any(jnp.isnan(jnp.atleast_1d(result)))), mode

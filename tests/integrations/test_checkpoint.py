@@ -1,0 +1,63 @@
+"""Checkpoint/resume integration (SURVEY §5): metric states are pytrees, so
+they checkpoint with orbax and with plain numpy state_dicts; shard-merging via
+``merge_states`` reconstructs a full run from partial checkpoints."""
+import os
+import pickle
+import tempfile
+
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu import Accuracy, ConfusionMatrix, MetricCollection
+
+
+def test_orbax_checkpoint_roundtrip():
+    import orbax.checkpoint as ocp
+
+    metric = Accuracy()
+    metric(jnp.asarray([0.9, 0.2, 0.8]), jnp.asarray([1, 0, 1]))
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "ckpt")
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(path, metric.state_pytree())
+
+        restored = ckptr.restore(path)
+        fresh = Accuracy()
+        fresh._set_state({k: jnp.asarray(v) for k, v in restored.items()})
+        assert float(fresh.compute()) == float(metric.compute())
+
+        # resume accumulating after restore
+        fresh(jnp.asarray([0.1]), jnp.asarray([1]))
+        assert int(fresh.total) == 4
+
+
+def test_state_dict_pickle_roundtrip_collection():
+    coll = MetricCollection([Accuracy(), ConfusionMatrix(num_classes=3)])
+    coll.persistent(True)
+    coll(jnp.asarray([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1]]), jnp.asarray([0, 2]))
+
+    blobs = {name: m.state_dict() for name, m in coll.items()}
+    blob = pickle.dumps(blobs)
+
+    coll2 = MetricCollection([Accuracy(), ConfusionMatrix(num_classes=3)])
+    for name, m in coll2.items():
+        m.load_state_dict(pickle.loads(blob)[name])
+    for key, value in coll.compute().items():
+        np.testing.assert_allclose(np.asarray(coll2.compute()[key]), np.asarray(value))
+
+
+def test_merge_states_reconstructs_full_run():
+    """Checkpoint-shard merging: two half-run states merge into the full run."""
+    full = Accuracy()
+    a, b = Accuracy(), Accuracy()
+
+    p1, t1 = jnp.asarray([0.9, 0.3]), jnp.asarray([1, 1])
+    p2, t2 = jnp.asarray([0.7, 0.1]), jnp.asarray([1, 0])
+    full(p1, t1)
+    full(p2, t2)
+    a(p1, t1)
+    b(p2, t2)
+
+    merged = a.merge_states(a.state_pytree(), b.state_pytree())
+    assert float(a.compute_from_state(merged)) == float(full.compute())
