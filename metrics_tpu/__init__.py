@@ -13,7 +13,7 @@ _logger.setLevel(logging.INFO)
 
 from metrics_tpu.info import __version__  # noqa: E402
 from metrics_tpu.core.collections import MetricCollection  # noqa: E402
-from metrics_tpu.core.metric import CompositionalMetric, Metric, PureMetric  # noqa: E402
+from metrics_tpu.core.metric import CompositionalMetric, Metric, PureMetric, set_default_jit  # noqa: E402
 from metrics_tpu.classification import (  # noqa: E402
     AUC,
     AUROC,

@@ -40,7 +40,9 @@ import numpy as np
 from jax import Array
 
 from metrics_tpu.parallel.buffer import PaddedBuffer, buffer_append, buffer_init
+from metrics_tpu.utils.data import is_concrete
 from metrics_tpu.utils.exceptions import TracingUnsupportedError
+from metrics_tpu.utils.prints import rank_zero_warn
 from metrics_tpu.parallel.sync import (
     ReduceFx,
     canonicalize_reduce_fx,
@@ -52,6 +54,20 @@ from metrics_tpu.parallel.sync import (
 )
 
 State = Dict[str, Any]
+
+# Session-wide default for Metric(jit=None): None = auto (jit the fused step
+# when all states are fixed-shape). Test harnesses that build thousands of
+# short-lived metric instances can set this to False to avoid paying an XLA
+# compile per instance; explicit per-metric `jit=` always wins.
+_DEFAULT_JIT: Optional[bool] = None
+
+
+def set_default_jit(value: Optional[bool]) -> Optional[bool]:
+    """Set the process-wide default for ``Metric(jit=None)``; returns the old value."""
+    global _DEFAULT_JIT
+    old = _DEFAULT_JIT
+    _DEFAULT_JIT = value
+    return old
 
 
 class _BufferSpec(NamedTuple):
@@ -104,8 +120,9 @@ class Metric(ABC):
         self.process_group = process_group
         self.dist_sync_fn = dist_sync_fn
         self.capacity = capacity
-        self._jit = jit
+        self._jit = jit if jit is not None else _DEFAULT_JIT
         self._to_sync = True
+        self._in_forward = False
 
         self._update_signature = inspect.signature(self.update)
         self._update_impl = self.update  # unwrapped bound method (pure w.r.t. registered states)
@@ -275,10 +292,16 @@ class Metric(ABC):
                 jax.errors.TracerArrayConversionError,
                 jax.errors.ConcretizationTypeError,
                 jax.errors.TracerBoolConversionError,
-                TypeError,
                 TracingUnsupportedError,
-            ):
-                # update needs concrete values (e.g. class inference) -> permanent eager fallback
+            ) as err:
+                # update needs concrete values (e.g. class inference) -> permanent eager
+                # fallback. Any other exception (a genuine bug in `update`) propagates.
+                rank_zero_warn(
+                    f"{self.__class__.__name__}.update cannot be jit-compiled"
+                    f" ({type(err).__name__}); falling back to the eager per-step path."
+                    " Pass static args (e.g. num_classes) to enable the fused step.",
+                    UserWarning,
+                )
                 self._jit_failed = True
                 delta = None
         if delta is None:
@@ -289,11 +312,15 @@ class Metric(ABC):
             return None
 
         self._to_sync = self.dist_sync_on_step
+        self._in_forward = True
         acc = self._current_state()
         self._set_state(delta)
-        self._forward_cache = self.compute()
-        self._set_state(acc)
-        self._to_sync = True
+        try:
+            self._forward_cache = self.compute()
+        finally:
+            self._set_state(acc)
+            self._to_sync = True
+            self._in_forward = False
         self._computed = None
         return self._forward_cache
 
@@ -303,12 +330,16 @@ class Metric(ABC):
         self._forward_cache = None
         if self.compute_on_step:
             self._to_sync = self.dist_sync_on_step
+            self._in_forward = True
             cache = self._current_state()
             self.reset()
-            self.update(*args, **kwargs)
-            self._forward_cache = self.compute()
-            self._set_state(cache)
-            self._to_sync = True
+            try:
+                self.update(*args, **kwargs)
+                self._forward_cache = self.compute()
+            finally:
+                self._set_state(cache)
+                self._to_sync = True
+                self._in_forward = False
             self._computed = None
             return self._forward_cache
         return None
@@ -331,11 +362,44 @@ class Metric(ABC):
 
         return wrapped_func
 
+    # warn at half the int32 range: headroom for a few more epochs of updates
+    _OVERFLOW_WARN_THRESHOLD = 2**30
+
+    def _check_accumulator_overflow(self) -> None:
+        """Warn loudly when an int32 count accumulator nears wraparound.
+
+        Without x64 enabled, count states accumulate in int32 (see
+        ``utils.data.accum_int_dtype``); a pod-scale epoch can silently wrap at
+        2^31. Host-side check on concrete states only — it is skipped under
+        tracing and inside per-step ``forward`` (the hot path checks the small
+        batch delta, which is pointless).
+        """
+        if jax.config.jax_enable_x64:
+            return
+        for name in self._defaults:
+            value = getattr(self, name)
+            if (
+                isinstance(value, (jnp.ndarray, Array))
+                and jnp.issubdtype(value.dtype, jnp.integer)
+                and is_concrete(value)
+                and value.size
+                and int(jnp.max(jnp.abs(value))) >= self._OVERFLOW_WARN_THRESHOLD
+            ):
+                rank_zero_warn(
+                    f"{self.__class__.__name__} state '{name}' has reached"
+                    f" {int(jnp.max(jnp.abs(value)))} (>= 2^30) in int32; it will"
+                    " silently wrap at 2^31. Enable jax_enable_x64 to accumulate"
+                    " counts in int64.",
+                    UserWarning,
+                )
+
     def _wrap_compute(self, compute: Callable) -> Callable:
         @functools.wraps(compute)
         def wrapped_func(*args: Any, **kwargs: Any) -> Any:
             if self._computed is not None:
                 return self._computed
+            if not self._in_forward:  # epoch-level compute, not the per-step batch value
+                self._check_accumulator_overflow()
 
             dist_sync_fn = self.dist_sync_fn
             if dist_sync_fn is None and jax.process_count() > 1:

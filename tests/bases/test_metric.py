@@ -252,3 +252,99 @@ def test_pure_api_roundtrip():
 
     # the stateful instance was untouched by the pure calls
     assert float(a.x) == 0.0
+
+
+def test_int32_accumulator_overflow_warns():
+    """Counts near 2^31 must warn at compute time instead of silently wrapping."""
+    import warnings
+
+    class CountMetric(Metric):
+
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("total", jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
+
+        def update(self, n):
+            self.total = self.total + n
+
+        def compute(self):
+            return self.total
+
+    m = CountMetric()
+    m.update(jnp.asarray(2**30 + 1, dtype=jnp.int32))
+    with pytest.warns(UserWarning, match="wrap at 2\\^31"):
+        m.compute()
+
+    # below the threshold: no warning
+    m2 = CountMetric()
+    m2.update(jnp.asarray(7, dtype=jnp.int32))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert int(m2.compute()) == 7
+
+
+def test_forward_does_not_swallow_genuine_update_bugs():
+    """A real TypeError inside update must propagate, not demote to eager."""
+
+    class BuggyMetric(Metric):
+
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("x", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.x = self.x + x
+            len(3)  # TypeError: object of type 'int' has no len()
+
+        def compute(self):
+            return self.x
+
+    m = BuggyMetric()
+    with pytest.raises(TypeError):
+        m(jnp.asarray(1.0))
+    assert not m._jit_failed
+
+
+def test_forward_tracing_fallback_warns():
+    """A value-dependent update falls back to eager with a loud warning."""
+
+    class EagerOnlyMetric(Metric):
+
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("x", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.x = self.x + float(x)  # forces concretization under tracing
+
+        def compute(self):
+            return self.x
+
+    m = EagerOnlyMetric(jit=True)
+    with pytest.warns(UserWarning, match="cannot be jit-compiled"):
+        out = m(jnp.asarray(2.0))
+    assert m._jit_failed
+    assert float(out) == 2.0
+    assert float(m.compute()) == 2.0
+
+
+def test_fused_jit_step_compiles_and_accumulates():
+    """Explicit jit=True coverage: the fused step compiles once and matches eager."""
+
+    class SumMetric(Metric):
+
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("x", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.x = self.x + x
+
+        def compute(self):
+            return self.x
+
+    m = SumMetric(jit=True)
+    assert float(m(jnp.asarray(2.0))) == 2.0
+    assert m._jitted_step is not None and not m._jit_failed
+    assert float(m(jnp.asarray(3.0))) == 3.0
+    assert float(m.compute()) == 5.0
