@@ -1,25 +1,176 @@
-"""BLEU score.
+"""BLEU score, TPU-native.
 
-Parity target: reference ``torchmetrics/functional/nlp.py`` (``_count_ngram``
-:26-45, ``bleu_score`` :48-112). Host-side by design — the inputs are Python
-token sequences, not arrays; the result is returned as a jnp scalar so it
-composes with the rest of the library.
+Behavior parity with reference ``torchmetrics/functional/nlp.py`` (clipped
+n-gram precision per order, max-over-references clipping, brevity penalty from
+the closest reference length, optional add-1 smoothing) — but built the array
+way rather than with host-side ``Counter`` loops:
+
+* tokens are interned to integer ids once on the host (strings cannot live on
+  device), padded into fixed-shape ``(B, L)`` / ``(B, R, L)`` arrays;
+* every n-gram statistic is computed on device from **window-equality
+  matrices**: ``E_n[i, j]`` says whether the length-``n`` windows starting at
+  ``i`` and ``j`` are equal, built incrementally from the token-equality
+  matrix (``E_n = E_{n-1} & shifted token equality``) — no hashing, so counts
+  are exact, and no data-dependent shapes, so the whole kernel jits;
+* the clipped-count sum over *distinct* n-grams is re-expressed as a sum over
+  *positions*: a distinct gram with multiplicity ``c`` contributes
+  ``min(c, m)`` once, i.e. each of its ``c`` windows contributes
+  ``min(c, m)/c``.
+
+The sufficient statistics (per-order numerator/denominator, translation and
+reference lengths) are all ``"sum"``-reducible, so BLEU can accumulate across
+batches and sync with a single ``psum`` — an upgrade over the reference, where
+BLEU is a host-only one-shot function.
 """
-from collections import Counter
-from typing import List, Sequence
+from typing import List, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 from jax import Array
 
+_PAD = -1  # never equal to a real token id (ids start at 0)
 
-def _count_ngram(ngram_input_list: List[str], n_gram: int) -> Counter:
-    """Counts of all 1..n grams in a token list."""
-    ngram_counter: Counter = Counter()
-    for i in range(1, n_gram + 1):
-        for j in range(len(ngram_input_list) - i + 1):
-            ngram_key = tuple(ngram_input_list[j:(i + j)])
-            ngram_counter[ngram_key] += 1
-    return ngram_counter
+
+def _intern_corpus(
+    translate_corpus: Sequence[Sequence[str]],
+    reference_corpus: Sequence[Sequence[Sequence[str]]],
+) -> Tuple[List[List[int]], List[List[List[int]]]]:
+    """Map every distinct token to a dense integer id (host-side, one pass)."""
+    vocab: dict = {}
+
+    def ids(seq: Sequence[str]) -> List[int]:
+        return [vocab.setdefault(tok, len(vocab)) for tok in seq]
+
+    hyp_ids = [ids(t) for t in translate_corpus]
+    ref_ids = [[ids(r) for r in refs] for refs in reference_corpus]
+    return hyp_ids, ref_ids
+
+
+def _pad_corpus(
+    hyp_ids: List[List[int]], ref_ids: List[List[List[int]]]
+) -> Tuple[Array, Array, Array, Array, Array]:
+    """Pack ragged id lists into fixed-shape padded arrays + lengths/masks."""
+    batch = len(hyp_ids)
+    max_refs = max((len(r) for r in ref_ids), default=1) or 1
+    max_len = max(
+        [len(h) for h in hyp_ids] + [len(r) for refs in ref_ids for r in refs] + [1]
+    )
+
+    # pack on the host (one device transfer at the end, not one per sentence)
+    import numpy as np
+
+    hyp = np.full((batch, max_len), _PAD, dtype=np.int32)
+    refs = np.full((batch, max_refs, max_len), _PAD, dtype=np.int32)
+    hyp_len = np.asarray([len(h) for h in hyp_ids], dtype=np.int32)
+    ref_len = np.zeros((batch, max_refs), dtype=np.int32)
+    ref_mask = np.zeros((batch, max_refs), dtype=bool)
+
+    for b, h in enumerate(hyp_ids):
+        hyp[b, : len(h)] = h
+    for b, rs in enumerate(ref_ids):
+        for j, r in enumerate(rs):
+            refs[b, j, : len(r)] = r
+            ref_len[b, j] = len(r)
+            ref_mask[b, j] = True
+    return (
+        jnp.asarray(hyp),
+        jnp.asarray(hyp_len),
+        jnp.asarray(refs),
+        jnp.asarray(ref_len),
+        jnp.asarray(ref_mask),
+    )
+
+
+def _shift_diag(mat: Array, k: int, axes: Tuple[int, int]) -> Array:
+    """``out[.., i, .., j] = mat[.., i+k, .., j+k]`` with False padding."""
+    if k == 0:
+        return mat
+    sl = [slice(None)] * mat.ndim
+    sl[axes[0]] = slice(k, None)
+    sl[axes[1]] = slice(k, None)
+    sliced = mat[tuple(sl)]
+    pad = [(0, 0)] * mat.ndim
+    pad[axes[0]] = (0, mat.shape[axes[0]] - sliced.shape[axes[0]])
+    pad[axes[1]] = (0, mat.shape[axes[1]] - sliced.shape[axes[1]])
+    return jnp.pad(sliced, pad, constant_values=False)
+
+
+def bleu_counts(
+    hyp: Array,
+    hyp_len: Array,
+    refs: Array,
+    ref_len: Array,
+    ref_mask: Array,
+    n_gram: int = 4,
+) -> Tuple[Array, Array, Array, Array]:
+    """Device-evaluable BLEU sufficient statistics (all ``"sum"``-reducible).
+
+    Args:
+        hyp: ``(B, L)`` int32 token ids, padded with a negative sentinel.
+        hyp_len: ``(B,)`` true hypothesis lengths.
+        refs: ``(B, R, L)`` padded reference token ids.
+        ref_len: ``(B, R)`` true reference lengths.
+        ref_mask: ``(B, R)`` True where a reference actually exists.
+        n_gram: max n-gram order (static).
+
+    Returns:
+        ``(numerator (n_gram,), denominator (n_gram,), c, r)`` — clipped match
+        counts and total hyp n-gram counts per order, total translation length
+        ``c`` and closest-reference length ``r`` (reference nlp.py:48-62
+        semantics: ties on closeness go to the first reference in list order).
+    """
+    length = hyp.shape[-1]
+    pos = jnp.arange(length)
+
+    def one_example(hyp_b, hyp_len_b, refs_b, ref_len_b, ref_mask_b):
+        # token-level equality, the n=1 window equality
+        eq_hh = hyp_b[:, None] == hyp_b[None, :]  # (L, L)
+        eq_hr = hyp_b[:, None, None] == refs_b[None, :, :]  # (L, R, L)
+
+        e_hh, e_hr = eq_hh, eq_hr
+        nums, dens = [], []
+        for n in range(1, n_gram + 1):
+            if n > 1:
+                e_hh = e_hh & _shift_diag(eq_hh, n - 1, (0, 1))
+                e_hr = e_hr & _shift_diag(eq_hr, n - 1, (0, 2))
+            valid_h = pos <= hyp_len_b - n  # (L,) full windows only
+            valid_r = (pos[None, :] <= ref_len_b[:, None] - n) & ref_mask_b[:, None]
+
+            # multiplicity of window i among hyp windows / per reference
+            c_hyp = (e_hh & valid_h[None, :]).sum(-1)  # (L,)
+            m_ref = (e_hr & valid_r[None, :, :]).sum(-1).max(-1)  # (L,) max over refs
+
+            # sum over distinct grams of min(c, m) == sum over windows of min(c, m)/c
+            clipped = jnp.where(
+                valid_h, jnp.minimum(c_hyp, m_ref) / jnp.maximum(c_hyp, 1), 0.0
+            )
+            nums.append(clipped.sum())
+            dens.append(valid_h.sum().astype(jnp.float32))
+
+        # brevity: reference length closest to the hyp length (first wins ties)
+        diff = jnp.where(ref_mask_b, jnp.abs(ref_len_b - hyp_len_b), jnp.iinfo(jnp.int32).max)
+        r_b = ref_len_b[jnp.argmin(diff)]
+        return jnp.stack(nums), jnp.stack(dens), hyp_len_b.astype(jnp.float32), r_b.astype(jnp.float32)
+
+    nums, dens, c, r = jax.vmap(one_example)(hyp, hyp_len, refs, ref_len, ref_mask)
+    return nums.sum(0), dens.sum(0), c.sum(), r.sum()
+
+
+def bleu_from_counts(
+    numerator: Array, denominator: Array, c: Array, r: Array, smooth: bool = False
+) -> Array:
+    """Final BLEU from accumulated sufficient statistics (device-evaluable)."""
+    n_gram = numerator.shape[0]
+    if smooth:
+        precision = (numerator + 1.0) / (denominator + 1.0)
+    else:
+        # guard 0/0 and log(0); the min(numerator)==0 gate below zeroes the result
+        precision = jnp.where(numerator > 0, numerator, 1.0) / jnp.maximum(denominator, 1.0)
+
+    geometric_mean = jnp.exp(jnp.sum(jnp.log(precision) / n_gram))
+    brevity_penalty = jnp.where(c > r, 1.0, jnp.exp(1.0 - r / jnp.maximum(c, 1e-9)))
+    score = brevity_penalty * geometric_mean
+    return jnp.where(jnp.min(numerator) == 0, 0.0, score)
 
 
 def bleu_score(
@@ -31,7 +182,8 @@ def bleu_score(
     """BLEU of machine-translated text against one or more references.
 
     Clipped n-gram precisions per order, brevity penalty, geometric mean;
-    optional Lin et al. 2004 smoothing.
+    optional Lin et al. 2004 add-1 smoothing. Tokens are interned on the host;
+    all counting runs on device (see :func:`bleu_counts`).
 
     Example:
         >>> translate_corpus = ['the cat is on the mat'.split()]
@@ -40,38 +192,7 @@ def bleu_score(
         0.7598
     """
     assert len(translate_corpus) == len(reference_corpus)
-    numerator = [0.0] * n_gram
-    denominator = [0.0] * n_gram
-    c = 0.0
-    r = 0.0
-
-    for translation, references in zip(translate_corpus, reference_corpus):
-        c += len(translation)
-        ref_len_list = [len(ref) for ref in references]
-        ref_len_diff = [abs(len(translation) - x) for x in ref_len_list]
-        r += ref_len_list[ref_len_diff.index(min(ref_len_diff))]
-        translation_counter = _count_ngram(list(translation), n_gram)
-        reference_counter: Counter = Counter()
-        for ref in references:
-            reference_counter |= _count_ngram(list(ref), n_gram)
-
-        ngram_counter_clip = translation_counter & reference_counter
-        for counter_clip in ngram_counter_clip:
-            numerator[len(counter_clip) - 1] += ngram_counter_clip[counter_clip]
-        for counter in translation_counter:
-            denominator[len(counter) - 1] += translation_counter[counter]
-
-    if min(numerator) == 0.0:
-        return jnp.asarray(0.0)
-
-    num = jnp.asarray(numerator)
-    denom = jnp.asarray(denominator)
-    if smooth:
-        precision_scores = (num + 1.0) / (denom + 1.0)
-    else:
-        precision_scores = num / denom
-
-    log_precision_scores = (1.0 / n_gram) * jnp.log(precision_scores)
-    geometric_mean = jnp.exp(jnp.sum(log_precision_scores))
-    brevity_penalty = jnp.asarray(1.0) if c > r else jnp.exp(1 - (r / c))
-    return brevity_penalty * geometric_mean
+    hyp_ids, ref_ids = _intern_corpus(translate_corpus, reference_corpus)
+    hyp, hyp_len, refs, ref_len, ref_mask = _pad_corpus(hyp_ids, ref_ids)
+    numerator, denominator, c, r = bleu_counts(hyp, hyp_len, refs, ref_len, ref_mask, n_gram)
+    return bleu_from_counts(numerator, denominator, c, r, smooth=smooth)

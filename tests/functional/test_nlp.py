@@ -67,3 +67,35 @@ def test_no_4_gram():
     hyps = [["My", "full", "pytorch-lightning"]]
     refs = [[["My", "full", "pytorch-lightning", "test"], ["Completely", "Different"]]]
     assert float(bleu_score(hyps, refs)) == 0.0
+
+
+def test_bleu_counts_device_accumulation():
+    """The sufficient statistics jit, and summing them across batches equals
+    one-shot BLEU over the concatenated corpus (sum-reducible states)."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.functional.nlp import (
+        _intern_corpus,
+        _pad_corpus,
+        bleu_counts,
+        bleu_from_counts,
+    )
+
+    hyp_ids, ref_ids = _intern_corpus(TUPLE_OF_HYPOTHESES, TUPLE_OF_REFERENCES)
+    padded = _pad_corpus(hyp_ids, ref_ids)
+
+    jitted = jax.jit(bleu_counts, static_argnames="n_gram")
+    num, den, c, r = jitted(*padded, n_gram=4)
+    one_shot = bleu_from_counts(num, den, c, r)
+    np.testing.assert_allclose(
+        float(one_shot), float(bleu_score(TUPLE_OF_HYPOTHESES, TUPLE_OF_REFERENCES)), rtol=1e-6
+    )
+
+    # accumulate per-sentence counts, then merge by summation
+    totals = None
+    for b in range(len(TUPLE_OF_HYPOTHESES)):
+        h, rs = _intern_corpus([TUPLE_OF_HYPOTHESES[b]], [TUPLE_OF_REFERENCES[b]])
+        counts = bleu_counts(*_pad_corpus(h, rs), n_gram=4)
+        totals = counts if totals is None else tuple(t + x for t, x in zip(totals, counts))
+    np.testing.assert_allclose(float(bleu_from_counts(*totals)), float(one_shot), rtol=1e-6)
