@@ -1,54 +1,173 @@
-"""Benchmark of record (BASELINE.md #3): per-step metric update+sync overhead
-of ``MetricCollection(Accuracy, F1, Precision, Recall)``.
+"""Benchmark of record (BASELINE.md #3): per-step sync wall-clock of
+``MetricCollection(Accuracy, F1, Precision, Recall)`` over 8 devices, with
+``dist_sync_on_step`` semantics — every step updates, cross-device syncs, and
+computes the collection.
 
-Ours: the **marginal** wall-clock of folding the fused pure-state collection
-update into an already-jitted training step (the idiomatic TPU deployment:
-the metric update compiles into the step, so the dispatch cost is shared) —
-measured as t(train+metrics) - t(train) on the default backend.
+Ours: one jitted ``shard_map`` step over an 8-device mesh (virtual CPU devices
+— multi-chip TPU hardware is not available in this image; the XLA collective
+code paths are the same): per-shard fused update, ``psum`` sync of every
+state, replicated compute. Measured in a subprocess so the parent process can
+keep the default (TPU) backend for the single-chip number.
 
 Baseline: the actual reference torchmetrics (mounted at /root/reference,
-imported in-place, torch CPU — the only reference runtime in this image)
-driving the same collection's ``update`` per step; eager torch has no
-dispatch to amortize, so its per-step update time is its marginal cost.
+imported in-place) on an 8-process Gloo group — its own distributed story
+(reference tests/helpers/testers.py:41-47) — driving the same collection's
+``forward`` with ``dist_sync_on_step=True`` per step.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where value
-is our marginal ms/step and vs_baseline = reference_ms / our_ms (>1 means
-faster than the reference).
+Also reported (extra keys): the single-chip marginal cost of folding the fused
+collection update into an already-jitted train step on the default backend
+(TPU when available), vs the reference's eager per-step ``update`` on torch
+CPU — the single-device deployment number.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...} where
+value is our 8-device sync-in-the-loop ms/step and vs_baseline =
+reference_ms / our_ms (>1 means we are faster than the reference).
 """
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
-N_STEPS = 200
+N_STEPS = 100
 WARMUP = 10
-BATCH = 4096
+BATCH_PER_DEVICE = 512
+N_DEVICES = 8
 NUM_CLASSES = 32
 FEATURES = 256
 
 
-def bench_ours() -> float:
-    import jax
-    import jax.numpy as jnp
-
+def _collection_ours():
     from metrics_tpu import Accuracy, F1, MetricCollection, Precision, Recall
 
-    collection = MetricCollection([
+    return MetricCollection([
         Accuracy(),
         F1(num_classes=NUM_CLASSES, average="macro"),
         Precision(num_classes=NUM_CLASSES, average="macro"),
         Recall(num_classes=NUM_CLASSES, average="macro"),
     ])
-    pure = collection.pure()
+
+
+def bench_ours_sync8() -> float:
+    """Per-step update + psum-sync + compute of the collection over an
+    8-device mesh (the metric of record). Runs on virtual CPU devices."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    pure = _collection_ours().pure()
+    mesh = Mesh(np.array(jax.devices("cpu")[:N_DEVICES]), ("dp",))
+
+    def step(state, preds, target):
+        # local shard delta -> one collective sync -> replicated accumulate
+        delta = pure.update(pure.init(), preds, target)
+        delta = pure.sync(delta, "dp")
+        state = pure.merge(state, delta)
+        return state, pure.compute(state)
+
+    sharded_step = jax.jit(
+        jax.shard_map(
+            step, mesh=mesh, in_specs=(P(), P("dp"), P("dp")), out_specs=(P(), P())
+        )
+    )
 
     rng = np.random.RandomState(0)
-    target = jnp.asarray(rng.randint(0, NUM_CLASSES, BATCH).astype(np.int32))
-    x = jnp.asarray(rng.rand(BATCH, FEATURES).astype(np.float32))
+    batch = BATCH_PER_DEVICE * N_DEVICES
+    logits = rng.rand(batch, NUM_CLASSES).astype(np.float32)
+    preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+    target = jnp.asarray(rng.randint(0, NUM_CLASSES, batch).astype(np.int32))
+
+    state = pure.init()
+    out = None
+    for _ in range(WARMUP):
+        state, out = sharded_step(state, preds, target)
+    jax.block_until_ready(out)
+    start = time.perf_counter()
+    for _ in range(N_STEPS):
+        state, out = sharded_step(state, preds, target)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - start) / N_STEPS * 1e3
+
+
+def _ref_sync8_worker(rank: int, world_size: int, steps: int, out_q) -> None:
+    import torch
+    import torch.distributed as dist
+
+    sys.path.insert(0, "/root/reference")
+    from torchmetrics import Accuracy, F1, MetricCollection, Precision, Recall
+
+    dist.init_process_group(
+        "gloo", init_method="tcp://127.0.0.1:29511", rank=rank, world_size=world_size
+    )
+    collection = MetricCollection([
+        Accuracy(dist_sync_on_step=True),
+        F1(num_classes=NUM_CLASSES, average="macro", dist_sync_on_step=True),
+        Precision(num_classes=NUM_CLASSES, average="macro", dist_sync_on_step=True),
+        Recall(num_classes=NUM_CLASSES, average="macro", dist_sync_on_step=True),
+    ])
+
+    rng = np.random.RandomState(rank)
+    logits = rng.rand(BATCH_PER_DEVICE, NUM_CLASSES).astype(np.float32)
+    preds = torch.from_numpy(logits / logits.sum(-1, keepdims=True))
+    target = torch.from_numpy(rng.randint(0, NUM_CLASSES, BATCH_PER_DEVICE).astype(np.int64))
+
+    for _ in range(WARMUP):
+        collection(preds, target)
+    dist.barrier()
+    start = time.perf_counter()
+    for _ in range(steps):
+        collection(preds, target)
+    dist.barrier()
+    elapsed_ms = (time.perf_counter() - start) / steps * 1e3
+    if rank == 0:
+        out_q.put(elapsed_ms)
+    dist.destroy_process_group()
+
+
+def bench_reference_sync8() -> float:
+    """Reference collection forward with dist_sync_on_step=True on an
+    8-process Gloo group (the reference's own distributed mechanism)."""
+    import torch.multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    out_q = ctx.Queue()
+    procs = [
+        ctx.Process(target=_ref_sync8_worker, args=(r, N_DEVICES, N_STEPS // 2, out_q))
+        for r in range(N_DEVICES)
+    ]
+    for p in procs:
+        p.start()
+    try:
+        # a dead/hung worker (port clash, init failure) must not hang the bench
+        result = out_q.get(timeout=240)
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+    return result
+
+
+def bench_ours_fused_singlechip() -> float:
+    """Marginal cost of folding the fused collection update into a jitted
+    train step on the default backend (TPU when available)."""
+    import jax
+    import jax.numpy as jnp
+
+    pure = _collection_ours().pure()
+    batch = BATCH_PER_DEVICE * N_DEVICES
+
+    rng = np.random.RandomState(0)
+    target = jnp.asarray(rng.randint(0, NUM_CLASSES, batch).astype(np.int32))
+    x = jnp.asarray(rng.rand(batch, FEATURES).astype(np.float32))
     w = jnp.asarray(rng.rand(FEATURES, NUM_CLASSES).astype(np.float32))
 
     def loss(w):
-        return -jnp.mean(jax.nn.log_softmax(x @ w)[jnp.arange(BATCH), target])
+        return -jnp.mean(jax.nn.log_softmax(x @ w)[jnp.arange(batch), target])
 
     @jax.jit
     def train_only(w):
@@ -77,7 +196,8 @@ def bench_ours() -> float:
     return max(t_with - t_plain, 1e-6)
 
 
-def bench_reference() -> float:
+def bench_reference_eager_update() -> float:
+    """Reference eager per-step collection update, torch CPU (single-device)."""
     sys.path.insert(0, "/root/reference")
     import torch
     from torchmetrics import Accuracy, F1, MetricCollection, Precision, Recall
@@ -89,14 +209,14 @@ def bench_reference() -> float:
         Recall(num_classes=NUM_CLASSES, average="macro"),
     ])
 
+    batch = BATCH_PER_DEVICE * N_DEVICES
     rng = np.random.RandomState(0)
-    logits = rng.rand(BATCH, NUM_CLASSES).astype(np.float32)
+    logits = rng.rand(batch, NUM_CLASSES).astype(np.float32)
     preds = torch.from_numpy(logits / logits.sum(-1, keepdims=True))
-    target = torch.from_numpy(rng.randint(0, NUM_CLASSES, BATCH).astype(np.int64))
+    target = torch.from_numpy(rng.randint(0, NUM_CLASSES, batch).astype(np.int64))
 
     for _ in range(WARMUP):
         collection.update(preds, target)
-
     start = time.perf_counter()
     for _ in range(N_STEPS):
         collection.update(preds, target)
@@ -104,22 +224,56 @@ def bench_reference() -> float:
 
 
 def main() -> None:
-    ours_ms = bench_ours()
+    if len(sys.argv) > 1 and sys.argv[1] == "--sync8":
+        # child process: CPU platform must be forced before backend init
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={N_DEVICES}"
+        ).strip()
+        print(json.dumps({"ours_sync8_ms": bench_ours_sync8()}))
+        return
+
+    here = os.path.dirname(os.path.abspath(__file__))
+
+    child = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--sync8"],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "PYTHONPATH": here},
+    )
+    if child.returncode != 0 or not child.stdout.strip():
+        raise RuntimeError(
+            f"--sync8 child failed (rc={child.returncode}):\n{child.stderr[-2000:]}"
+        )
+    ours_sync8_ms = json.loads(child.stdout.strip().splitlines()[-1])["ours_sync8_ms"]
+
     try:
-        ref_ms = bench_reference()
-        vs_baseline = ref_ms / ours_ms
+        ref_sync8_ms = bench_reference_sync8()
+        vs_baseline = ref_sync8_ms / ours_sync8_ms
     except Exception:
+        ref_sync8_ms = float("nan")
         vs_baseline = float("nan")
+
+    try:
+        ours_fused_ms = bench_ours_fused_singlechip()
+        ref_eager_ms = bench_reference_eager_update()
+        fused_vs_ref = ref_eager_ms / ours_fused_ms
+    except Exception:
+        ours_fused_ms = ref_eager_ms = fused_vs_ref = float("nan")
 
     print(
         json.dumps(
             {
-                "metric": "marginal per-step update+sync overhead of MetricCollection(Accuracy,F1,Precision,"
-                          f"Recall) fused into a jitted train step (batch {BATCH}x{NUM_CLASSES}) "
-                          "vs reference torchmetrics eager update (torch CPU)",
-                "value": round(ours_ms, 4),
+                "metric": "per-step update+psum-sync+compute of MetricCollection(Accuracy,F1,"
+                          f"Precision,Recall), dist_sync_on_step, 8 devices ({BATCH_PER_DEVICE}"
+                          f"x{NUM_CLASSES} per device; ours: shard_map on 8 virtual CPU devices,"
+                          " reference: torchmetrics forward on 8-process Gloo)",
+                "value": round(ours_sync8_ms, 4),
                 "unit": "ms/step",
                 "vs_baseline": round(vs_baseline, 3),
+                "reference_sync8_ms": round(ref_sync8_ms, 4),
+                "singlechip_fused_update_ms": round(ours_fused_ms, 4),
+                "singlechip_reference_eager_update_ms": round(ref_eager_ms, 4),
+                "singlechip_vs_reference": round(fused_vs_ref, 3),
             }
         )
     )
