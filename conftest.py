@@ -1,0 +1,22 @@
+"""Root test configuration: force the 8-device virtual CPU platform.
+
+Applies to the whole pytest rootdir so that both `tests/` and
+`--doctest-modules metrics_tpu` run on fake CPU devices (the axon TPU plugin
+ignores JAX_PLATFORMS, so the platform must be forced through jax.config
+before any backend is initialized).
+"""
+import os
+
+# escape hatch for validation runs on real hardware:
+#   METRICS_TPU_TEST_PLATFORM=tpu python -m pytest tests/ ...
+_platform = os.environ.get("METRICS_TPU_TEST_PLATFORM", "cpu")
+
+if _platform == "cpu":
+    if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
