@@ -9,10 +9,12 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.data import upcast_accum
 
 
 def _explained_variance_update(preds: Array, target: Array) -> Tuple[int, Array, Array, Array, Array]:
     _check_same_shape(preds, target)
+    preds, target = upcast_accum(preds), upcast_accum(target)
     n_obs = preds.shape[0]
     sum_error = jnp.sum(target - preds, axis=0)
     sum_squared_error = jnp.sum((target - preds) ** 2, axis=0)

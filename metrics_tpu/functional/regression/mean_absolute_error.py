@@ -5,10 +5,12 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.data import upcast_accum
 
 
 def _mean_absolute_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
     _check_same_shape(preds, target)
+    preds, target = upcast_accum(preds), upcast_accum(target)
     sum_abs_error = jnp.sum(jnp.abs(preds - target))
     return sum_abs_error, target.size
 

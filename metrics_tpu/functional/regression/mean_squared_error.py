@@ -5,10 +5,12 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.data import upcast_accum
 
 
 def _mean_squared_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
     _check_same_shape(preds, target)
+    preds, target = upcast_accum(preds), upcast_accum(target)
     sum_squared_error = jnp.sum((preds - target) ** 2)
     return sum_squared_error, target.size
 

@@ -9,6 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
+from metrics_tpu.utils.data import upcast_accum
 from metrics_tpu.utils.prints import rank_zero_warn
 from metrics_tpu.utils.reductions import reduce
 
@@ -30,6 +31,7 @@ def _psnr_update(
     target: Array,
     dim: Optional[Union[int, Tuple[int, ...]]] = None,
 ) -> Tuple[Array, Array]:
+    preds, target = upcast_accum(preds), upcast_accum(target)
     if dim is None:
         sum_squared_error = jnp.sum((preds - target) ** 2)
         n_obs = jnp.asarray(target.size)

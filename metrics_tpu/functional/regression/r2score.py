@@ -9,6 +9,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.data import upcast_accum
 from metrics_tpu.utils.prints import rank_zero_warn
 
 
@@ -22,6 +23,7 @@ def _r2score_update(preds: Array, target: Array) -> Tuple[Array, Array, Array, i
     if preds.shape[0] < 2:
         raise ValueError("Needs at least two samples to calculate r2 score.")
 
+    preds, target = upcast_accum(preds), upcast_accum(target)
     sum_error = jnp.sum(target, axis=0)
     sum_squared_error = jnp.sum(target**2, axis=0)
     residual = jnp.sum((target - preds) ** 2, axis=0)

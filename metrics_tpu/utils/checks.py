@@ -93,6 +93,7 @@ def _validate_values(
     threshold: float,
     num_classes: Optional[int],
     is_multiclass: Optional[bool],
+    sum_atol: float = 1e-8,
 ) -> None:
     """Value-dependent validation — concrete arrays only (reference checks.py:29-57, 81-84, 274-288)."""
     preds_float = _is_float(preds)
@@ -111,7 +112,7 @@ def _validate_values(
             "If `preds` and `target` are of shape (N, ...) and `preds` are floats, `target` should be binary."
         )
     if case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) and preds_float:
-        if not bool(jnp.all(jnp.isclose(jnp.sum(preds, axis=1), 1.0))):
+        if not bool(jnp.all(jnp.isclose(jnp.sum(preds, axis=1), 1.0, atol=sum_atol))):
             raise ValueError("Probabilities in `preds` must sum up to 1 across the `C` dimension.")
     if preds.shape != target.shape:
         if int(jnp.max(target)) >= implied_classes:
@@ -204,6 +205,7 @@ def _check_classification_inputs(
     num_classes: Optional[int],
     is_multiclass: Optional[bool],
     top_k: Optional[int],
+    sum_atol: float = 1e-8,
 ) -> DataType:
     """Full validation; returns the resolved case. Value checks run only on
     concrete (non-traced) inputs — reference ``_check_classification_inputs``
@@ -218,7 +220,9 @@ def _check_classification_inputs(
         )
     _validate_static(case, implied_classes, _is_float(preds), threshold, num_classes, is_multiclass, top_k)
     if is_concrete(preds) and is_concrete(target):
-        _validate_values(preds, target, case, implied_classes, threshold, num_classes, is_multiclass)
+        _validate_values(
+            preds, target, case, implied_classes, threshold, num_classes, is_multiclass, sum_atol=sum_atol
+        )
     return case
 
 
@@ -240,13 +244,17 @@ def _input_format_classification(
     """
     preds, target = _squeeze_excess_dims(jnp.asarray(preds), jnp.asarray(target))
 
-    # accumulate/compare in fp32 (reference upcasts fp16, checks.py:402-403; we also upcast bf16)
+    # accumulate/compare in fp32 (reference upcasts fp16, checks.py:402-403; we also upcast bf16);
+    # probability-sum validation tolerance scales with the *original* precision
+    sum_atol = 1e-8
     if preds.dtype in (jnp.float16, jnp.bfloat16):
+        sum_atol = float(jnp.finfo(preds.dtype).eps) * max(preds.shape[1] if preds.ndim > 1 else 2, 2)
         preds = preds.astype(jnp.float32)
 
     if validate:
         case = _check_classification_inputs(
-            preds, target, threshold=threshold, num_classes=num_classes, is_multiclass=is_multiclass, top_k=top_k
+            preds, target, threshold=threshold, num_classes=num_classes, is_multiclass=is_multiclass,
+            top_k=top_k, sum_atol=sum_atol,
         )
     else:
         case, _ = _resolve_case(preds, target)

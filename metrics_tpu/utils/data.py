@@ -55,6 +55,19 @@ def is_concrete(x: Any) -> bool:
     return not isinstance(x, jax.core.Tracer)
 
 
+def upcast_accum(x: Array) -> Array:
+    """Upcast low-precision floats to fp32 before accumulation.
+
+    The dtype policy (SURVEY §7 hard part 6): inputs may be bf16/fp16 (the
+    TPU-native activation dtype; the reference upcasts fp16 in its
+    classification formatting, checks.py:402-403) but sums of errors/moments
+    accumulate in fp32 so epoch-scale reductions don't lose precision.
+    """
+    if x.dtype in (jnp.bfloat16, jnp.float16):
+        return x.astype(jnp.float32)
+    return x
+
+
 def accum_int_dtype():
     """Dtype for count-accumulator states: int64 when x64 is enabled, else int32.
 

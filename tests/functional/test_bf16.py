@@ -1,0 +1,106 @@
+"""bf16-input parity (SURVEY §7 hard part 6).
+
+bf16 is the TPU-native activation dtype: metrics must accept bf16 inputs,
+upcast before accumulation (classification formatting upcasts like the
+reference does for fp16, checks.py:402-403; regression kernels upcast via
+``upcast_accum``), and agree with the fp32 sklearn oracle at relaxed
+tolerance (bf16 has ~3 significant decimal digits).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import (
+    accuracy_score as sk_accuracy,
+    f1_score as sk_f1,
+    mean_absolute_error as sk_mae,
+    mean_squared_error as sk_mse,
+    precision_score as sk_precision,
+    r2_score as sk_r2,
+    roc_auc_score as sk_auroc,
+)
+
+from metrics_tpu import Accuracy, F1, MeanAbsoluteError, MeanSquaredError, Precision, R2Score
+from metrics_tpu.functional import auroc
+
+NUM_CLASSES = 5
+BATCHES = 4
+N = 64
+
+# bf16 rounding of inputs can flip argmax/threshold decisions near ties and
+# shifts every value at the 3rd decimal; tolerance reflects the input error,
+# not the accumulation (which runs in fp32)
+ATOL = 2e-2
+
+
+def _bf16_probs(rng, n, c):
+    logits = rng.rand(n, c).astype(np.float32)
+    probs = logits / logits.sum(-1, keepdims=True)
+    return jnp.asarray(probs, dtype=jnp.bfloat16), np.asarray(
+        jnp.asarray(probs, dtype=jnp.bfloat16), dtype=np.float32
+    )
+
+
+@pytest.mark.parametrize(
+    "metric_cls, metric_args, sk_fn",
+    [
+        (Accuracy, {}, lambda p, t: sk_accuracy(t, p.argmax(-1))),
+        (
+            Precision,
+            {"num_classes": NUM_CLASSES, "average": "macro"},
+            lambda p, t: sk_precision(t, p.argmax(-1), average="macro", zero_division=0),
+        ),
+        (
+            F1,
+            {"num_classes": NUM_CLASSES, "average": "macro"},
+            lambda p, t: sk_f1(t, p.argmax(-1), average="macro", zero_division=0),
+        ),
+    ],
+)
+def test_classification_bf16_inputs(metric_cls, metric_args, sk_fn):
+    rng = np.random.RandomState(42)
+    metric = metric_cls(**metric_args)
+    all_p, all_t = [], []
+    for _ in range(BATCHES):
+        preds_bf16, preds_as_f32 = _bf16_probs(rng, N, NUM_CLASSES)
+        target = rng.randint(0, NUM_CLASSES, N)
+        metric.update(preds_bf16, jnp.asarray(target))
+        all_p.append(preds_as_f32)
+        all_t.append(target)
+    expected = sk_fn(np.concatenate(all_p), np.concatenate(all_t))
+    np.testing.assert_allclose(float(metric.compute()), expected, atol=ATOL)
+
+
+@pytest.mark.parametrize(
+    "metric_cls, sk_fn",
+    [
+        (MeanSquaredError, sk_mse),
+        (MeanAbsoluteError, sk_mae),
+        (R2Score, sk_r2),
+    ],
+)
+def test_regression_bf16_inputs(metric_cls, sk_fn):
+    rng = np.random.RandomState(7)
+    metric = metric_cls()
+    all_p, all_t = [], []
+    for _ in range(BATCHES):
+        p = jnp.asarray(rng.rand(N).astype(np.float32), dtype=jnp.bfloat16)
+        t = jnp.asarray(rng.rand(N).astype(np.float32), dtype=jnp.bfloat16)
+        metric.update(p, t)
+        all_p.append(np.asarray(p, dtype=np.float32))
+        all_t.append(np.asarray(t, dtype=np.float32))
+    # accumulator states must be fp32 regardless of the bf16 inputs
+    for name in metric._defaults:
+        state = getattr(metric, name)
+        if jnp.issubdtype(state.dtype, jnp.floating):
+            assert state.dtype == jnp.float32, name
+    expected = sk_fn(np.concatenate(all_t), np.concatenate(all_p))
+    np.testing.assert_allclose(float(metric.compute()), expected, atol=ATOL)
+
+
+def test_auroc_bf16_inputs():
+    rng = np.random.RandomState(3)
+    scores = jnp.asarray(rng.rand(256).astype(np.float32), dtype=jnp.bfloat16)
+    target = (rng.rand(256) > 0.5).astype(np.int64)
+    ours = auroc(scores, jnp.asarray(target), pos_label=1)
+    expected = sk_auroc(target, np.asarray(scores, dtype=np.float32))
+    np.testing.assert_allclose(float(ours), expected, atol=ATOL)
