@@ -1,8 +1,16 @@
-.PHONY: test clean bench
+.PHONY: test test-tpu doctest clean bench
 
-# run the full suite on 8 fake CPU devices (the conftest forces the platform)
+# full suite + package doctests on 8 fake CPU devices (root conftest forces
+# the platform; see conftest.py)
 test:
-	XLA_FLAGS="--xla_force_host_platform_device_count=8" python -m pytest tests/ -q
+	python -m pytest --doctest-modules metrics_tpu/ tests/ -q
+
+# validation run on the real default backend (TPU when available)
+test-tpu:
+	METRICS_TPU_TEST_PLATFORM=tpu python -m pytest tests/ -q
+
+doctest:
+	python -m pytest --doctest-modules metrics_tpu/ -q
 
 bench:
 	python bench.py

@@ -16,10 +16,11 @@ def embedding_similarity(
     Example:
         >>> import jax.numpy as jnp
         >>> embeddings = jnp.array([[1., 2., 3., 4.], [1., 2., 3., 4.], [4., 5., 6., 7.]])
-        >>> embedding_similarity(embeddings)
-        Array([[0.        , 1.        , 0.97589964],
-               [1.        , 0.        , 0.97589964],
-               [0.97589964, 0.97589964, 0.        ]], dtype=float32)
+        >>> import numpy as np
+        >>> np.round(np.asarray(embedding_similarity(embeddings)), 4)  # platform-stable print
+        array([[0.    , 1.    , 0.9759],
+               [1.    , 0.    , 0.9759],
+               [0.9759, 0.9759, 0.    ]], dtype=float32)
     """
     if similarity == "cosine":
         norm = jnp.linalg.norm(batch, ord=2, axis=1)

@@ -27,7 +27,7 @@ class PSNR(Metric):
         >>> preds = jnp.array([[0.0, 1.0], [2.0, 3.0]])
         >>> target = jnp.array([[3.0, 2.0], [1.0, 0.0]])
         >>> round(float(psnr(preds, target)), 4)
-        7.2472
+        11.0721
     """
 
     def __init__(

@@ -19,11 +19,11 @@ class SSIM(Metric):
 
     Example:
         >>> import jax.numpy as jnp
-        >>> preds = jnp.arange(0, 100 * 2, 2, dtype=jnp.float32).reshape(1, 1, 10, 10) / 200
-        >>> target = jnp.arange(0, 100, dtype=jnp.float32).reshape(1, 1, 10, 10) / 100
+        >>> target = jnp.arange(0, 16 * 16, dtype=jnp.float32).reshape(1, 1, 16, 16) / 256
+        >>> preds = target * 0.75
         >>> ssim = SSIM()
         >>> round(float(ssim(preds, target)), 4)
-        0.9219
+        0.924
     """
 
     def __init__(
