@@ -348,3 +348,29 @@ def test_fused_jit_step_compiles_and_accumulates():
     assert m._jitted_step is not None and not m._jit_failed
     assert float(m(jnp.asarray(3.0))) == 3.0
     assert float(m.compute()) == 5.0
+
+
+def test_set_default_jit():
+    """The process-wide default applies to jit=None metrics; explicit wins."""
+    from metrics_tpu import set_default_jit
+
+    class SumMetric(Metric):
+
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("x", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.x = self.x + x
+
+        def compute(self):
+            return self.x
+
+    old = set_default_jit(False)
+    try:
+        assert not SumMetric()._jittable
+        assert SumMetric(jit=True)._jittable  # explicit overrides the default
+        set_default_jit(None)
+        assert SumMetric()._jittable  # auto: fixed-shape states -> jittable
+    finally:
+        set_default_jit(old)
