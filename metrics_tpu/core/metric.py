@@ -40,6 +40,7 @@ import numpy as np
 from jax import Array
 
 from metrics_tpu.parallel.buffer import PaddedBuffer, buffer_append, buffer_init
+from metrics_tpu.utils import debug
 from metrics_tpu.utils.data import is_concrete
 from metrics_tpu.utils.exceptions import TracingUnsupportedError
 from metrics_tpu.utils.prints import rank_zero_warn
@@ -123,6 +124,7 @@ class Metric(ABC):
         self._jit = jit if jit is not None else _DEFAULT_JIT
         self._to_sync = True
         self._in_forward = False
+        self._sync_count = 0
 
         self._update_signature = inspect.signature(self.update)
         self._update_impl = self.update  # unwrapped bound method (pure w.r.t. registered states)
@@ -408,6 +410,16 @@ class Metric(ABC):
             synced = False
             cache = {}
             if self._to_sync and dist_sync_fn is not None:
+                if debug.sync_count_check_enabled():
+                    counts = [int(c) for c in dist_sync_fn(jnp.asarray(self._sync_count, dtype=jnp.int32))]
+                    if len(set(counts)) > 1:
+                        raise RuntimeError(
+                            f"{self.__class__.__name__}: processes disagree on the synced-compute"
+                            f" sequence number ({counts}). Some rank called a synced compute() a"
+                            " different number of times — this pairs collectives wrongly and"
+                            " eventually deadlocks."
+                        )
+                self._sync_count += 1
                 cache = self._current_state()
                 self._sync_dist(dist_sync_fn)
                 synced = True

@@ -1,0 +1,29 @@
+"""Debug-mode race detection (SURVEY §5).
+
+The reference's only race guard is the barrier before all_gather; a rank that
+calls ``compute()`` a different number of times deadlocks silently. The TPU
+build is deterministic by construction inside jit, but the *host-plane* sync
+has the same hazard. With the check enabled, every synced ``compute()`` first
+gathers a per-metric sync sequence number and raises if the ranks disagree —
+turning a silent desync (wrong pairing of collectives, eventual deadlock)
+into an immediate error. Off by default: it costs one extra tiny collective
+per synced compute, and every rank must enable it the same way.
+"""
+
+_SYNC_COUNT_CHECK = False
+
+
+def enable_sync_count_check(value: bool = True) -> bool:
+    """Toggle the cross-rank sync-sequence check; returns the previous value.
+
+    Must be enabled (or disabled) identically on every process — the check
+    itself is a collective.
+    """
+    global _SYNC_COUNT_CHECK
+    old = _SYNC_COUNT_CHECK
+    _SYNC_COUNT_CHECK = value
+    return old
+
+
+def sync_count_check_enabled() -> bool:
+    return _SYNC_COUNT_CHECK

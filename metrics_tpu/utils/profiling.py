@@ -1,0 +1,49 @@
+"""Timing helpers for the baseline harness (SURVEY §5: the reference ships no
+in-library tracing; its CI only records pytest durations. The TPU build adds
+an explicit ``block_until_ready`` timer so per-metric costs are measurable
+without a profiler attached; for deep traces use ``jax.profiler``.)"""
+import time
+from typing import Any, Callable, Dict
+
+import jax
+
+
+def time_fn(fn: Callable, *args: Any, iters: int = 50, warmup: int = 5, **kwargs: Any) -> float:
+    """Wall-clock ms per call of ``fn(*args, **kwargs)``, device-synchronized.
+
+    Warms up (compilation + caches), blocks on the last output, then times
+    ``iters`` calls ending with ``jax.block_until_ready`` — the only correct
+    way to time dispatch-asynchronous JAX code.
+    """
+    out = None
+    for _ in range(warmup):
+        out = fn(*args, **kwargs)
+    if out is not None:
+        jax.block_until_ready(out)
+    start = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args, **kwargs)
+    if out is not None:
+        jax.block_until_ready(out)
+    return (time.perf_counter() - start) / iters * 1e3
+
+
+def profile_metric(metric: Any, *args: Any, iters: int = 50, **kwargs: Any) -> Dict[str, float]:
+    """ms/call of a metric's pure ``update`` and ``compute`` on the given batch.
+
+    Uses the pure view so repeated updates see identical shapes (no state
+    growth) and nothing mutates the caller's metric.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> times = profile_metric(Accuracy(), jnp.array([1, 0]), jnp.array([1, 1]), iters=2)
+        >>> sorted(times)
+        ['compute_ms', 'update_ms']
+    """
+    pure = metric.pure()
+    init = pure.init()
+    update_ms = time_fn(lambda: pure.update(init, *args, **kwargs), iters=iters)
+    state = pure.update(init, *args, **kwargs)
+    compute_ms = time_fn(lambda: pure.compute(state), iters=iters)
+    return {"update_ms": update_ms, "compute_ms": compute_ms}

@@ -374,3 +374,11 @@ def test_set_default_jit():
         assert SumMetric()._jittable  # auto: fixed-shape states -> jittable
     finally:
         set_default_jit(old)
+
+
+def test_profile_metric_helper():
+    from metrics_tpu import Accuracy, profile_metric
+
+    times = profile_metric(Accuracy(), jnp.array([1, 0, 1]), jnp.array([1, 1, 0]), iters=3, )
+    assert set(times) == {"update_ms", "compute_ms"}
+    assert all(v > 0 for v in times.values())

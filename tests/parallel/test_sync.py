@@ -219,3 +219,43 @@ def test_cat_state_metric_with_capacity_in_jit():
     state = step(state, jnp.asarray([3.0]))
     m._set_state(state)
     assert float(m.compute()) == 6.0
+
+
+def test_sync_count_check_detects_desync():
+    """With the debug check on, mismatched sync sequence numbers raise."""
+    from metrics_tpu import enable_sync_count_check
+
+    m = _SumMetric()
+    m.update(1.0)
+
+    # a gather that reports another rank one synced-compute ahead
+    def skewed_gather(arr, **kw):
+        return [arr, arr + 1]
+
+    m.dist_sync_fn = skewed_gather
+    old = enable_sync_count_check(True)
+    try:
+        m.update(1.0)  # invalidate the compute cache
+        with pytest.raises(RuntimeError, match="sequence number"):
+            m.compute()
+    finally:
+        enable_sync_count_check(old)
+
+    # with the check off, the same gather syncs fine (counts never compared)
+    m2 = _SumMetric()
+    m2.dist_sync_fn = lambda arr, **kw: [arr, arr]
+    m2.update(2.0)
+    assert float(m2.compute()) == 4.0
+
+
+def test_sync_count_check_passes_when_aligned():
+    from metrics_tpu import enable_sync_count_check
+
+    m = _SumMetric()
+    m.update(3.0)
+    m.dist_sync_fn = lambda arr, **kw: [arr, arr]
+    old = enable_sync_count_check(True)
+    try:
+        assert float(m.compute()) == 6.0
+    finally:
+        enable_sync_count_check(old)
