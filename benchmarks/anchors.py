@@ -1,0 +1,201 @@
+"""Measure the BASELINE.md config anchors (rows 1, 2, 4, 5) ours-vs-reference.
+
+Run:  python benchmarks/anchors.py [--json]
+
+Each anchor times the reference (torchmetrics at /root/reference, torch CPU —
+the only reference runtime available in this image) against this framework on
+the default backend. Results are recorded in BASELINE.md.
+
+Anchors (from BASELINE.json "configs"):
+  1. README Accuracy example: 10 batches of (10, 5) softmax preds — per-step
+     forward + final compute.
+  2. functional confusion_matrix / stat_scores multiclass kernels.
+  4. AUROC + AveragePrecision exact compute on accumulated data.
+  5. RetrievalMAP + RetrievalNormalizedDCG over grouped queries.
+"""
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/reference")
+
+
+def _timeit(fn, iters=20, warmup=3, sync=None):
+    out = None
+    for _ in range(warmup):
+        out = fn()
+    if sync is not None:
+        sync(out)
+    start = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    if sync is not None:
+        sync(out)
+    return (time.perf_counter() - start) / iters * 1e3
+
+
+def _jax_sync(out):
+    import jax
+
+    jax.block_until_ready(out)
+
+
+def anchor1_readme_accuracy():
+    """README example: 10 batches of (10, 5) probs, per-step value + compute."""
+    rng = np.random.RandomState(0)
+    logits = rng.rand(10, 10, 5).astype(np.float32)
+    probs = logits / logits.sum(-1, keepdims=True)
+    target = rng.randint(0, 5, (10, 10))
+
+    import torch
+    from torchmetrics import Accuracy as TorchAccuracy
+
+    def ref():
+        m = TorchAccuracy()
+        for i in range(10):
+            m(torch.from_numpy(probs[i]), torch.from_numpy(target[i]))
+        return m.compute()
+
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy
+
+    jp = [jnp.asarray(probs[i]) for i in range(10)]
+    jt = [jnp.asarray(target[i]) for i in range(10)]
+
+    def ours():
+        m = Accuracy()
+        for i in range(10):
+            m(jp[i], jt[i])
+        return m.compute()
+
+    return _timeit(ref), _timeit(ours, sync=_jax_sync)
+
+
+def anchor2_functional_kernels():
+    """confusion_matrix + stat_scores multiclass kernel wall-clock (N=8192, C=64)."""
+    rng = np.random.RandomState(1)
+    n, c = 8192, 64
+    preds = rng.randint(0, c, n)
+    target = rng.randint(0, c, n)
+
+    import torch
+    from torchmetrics.functional import confusion_matrix as t_cm
+    from torchmetrics.functional import stat_scores as t_ss
+
+    tp_, tt_ = torch.from_numpy(preds), torch.from_numpy(target)
+
+    def ref():
+        return t_cm(tp_, tt_, num_classes=c), t_ss(tp_, tt_, num_classes=c, reduce="macro")
+
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.functional import confusion_matrix as j_cm
+    from metrics_tpu.functional import stat_scores as j_ss
+
+    jp_, jt_ = jnp.asarray(preds), jnp.asarray(target)
+
+    @jax.jit
+    def ours_fn():
+        return j_cm(jp_, jt_, num_classes=c), j_ss(jp_, jt_, num_classes=c, reduce="macro")
+
+    return _timeit(ref), _timeit(ours_fn, sync=_jax_sync)
+
+
+def anchor4_curve_metrics():
+    """Exact AUROC + AveragePrecision compute on accumulated scores (N=65536)."""
+    rng = np.random.RandomState(2)
+    n = 65536
+    scores = rng.rand(n).astype(np.float32)
+    target = (rng.rand(n) > 0.5).astype(np.int64)
+
+    import torch
+    from torchmetrics.functional import auroc as t_auroc
+    from torchmetrics.functional import average_precision as t_ap
+
+    ts, tt = torch.from_numpy(scores), torch.from_numpy(target)
+
+    def ref():
+        return t_auroc(ts, tt, pos_label=1), t_ap(ts, tt, pos_label=1)
+
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.functional import auroc as j_auroc
+    from metrics_tpu.functional import average_precision as j_ap
+
+    js, jt = jnp.asarray(scores), jnp.asarray(target)
+
+    @jax.jit
+    def ours_fn():
+        return j_auroc(js, jt, pos_label=1), j_ap(js, jt, pos_label=1)
+
+    return _timeit(ref), _timeit(ours_fn, sync=_jax_sync)
+
+
+def anchor5_retrieval():
+    """RetrievalMAP + NDCG over 512 queries x 128 docs."""
+    rng = np.random.RandomState(3)
+    q, d = 512, 128
+    idx = np.repeat(np.arange(q), d)
+    preds = rng.rand(q * d).astype(np.float32)
+    target = (rng.rand(q * d) > 0.9).astype(np.int64)
+
+    import torch
+    from torchmetrics import RetrievalMAP as TorchMAP
+
+    ti, tp_, tt_ = torch.from_numpy(idx), torch.from_numpy(preds), torch.from_numpy(target)
+
+    def ref():
+        m = TorchMAP()
+        m.update(ti, tp_, tt_)
+        return m.compute()
+
+    import jax.numpy as jnp
+
+    from metrics_tpu import RetrievalMAP, RetrievalNormalizedDCG
+
+    ji, jp_, jt_ = jnp.asarray(idx), jnp.asarray(preds), jnp.asarray(target)
+
+    def ours():
+        m = RetrievalMAP()
+        m.update(ji, jp_, jt_)
+        ndcg = RetrievalNormalizedDCG()
+        ndcg.update(ji, jp_, jt_)
+        return m.compute(), ndcg.compute()
+
+    # reference has no NDCG (BASELINE.json asks for it anyway); ours times both
+    return _timeit(ref, iters=5), _timeit(ours, iters=5, sync=_jax_sync)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--json", action="store_true")
+    args = parser.parse_args()
+
+    anchors = {
+        "1 README Accuracy loop (10x(10,5))": anchor1_readme_accuracy,
+        "2 confusion_matrix+stat_scores (8192x64)": anchor2_functional_kernels,
+        "4 AUROC+AP exact compute (65536)": anchor4_curve_metrics,
+        "5 RetrievalMAP(+NDCG ours) (512qx128d)": anchor5_retrieval,
+    }
+    results = {}
+    for name, fn in anchors.items():
+        ref_ms, ours_ms = fn()
+        results[name] = {
+            "reference_ms": round(ref_ms, 3),
+            "ours_ms": round(ours_ms, 3),
+            "speedup": round(ref_ms / ours_ms, 2),
+        }
+        if not args.json:
+            print(f"{name}: ref {ref_ms:.2f} ms | ours {ours_ms:.2f} ms | {ref_ms / ours_ms:.1f}x")
+    if args.json:
+        print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

@@ -4,15 +4,20 @@ Platform forcing (8 fake CPU devices, or real hardware via
 METRICS_TPU_TEST_PLATFORM=tpu) lives in the root ``conftest.py`` so it also
 covers ``--doctest-modules metrics_tpu``.
 """
+import os
+
 import jax
 import pytest
 
 import metrics_tpu
 
-# The oracle grid builds thousands of short-lived metric instances; auto-jit
-# would pay an XLA compile per instance on the suite's single CPU core. The
-# fused jit path keeps dedicated coverage via explicit `jit=True` tests.
-metrics_tpu.set_default_jit(False)
+if os.environ.get("METRICS_TPU_TEST_PLATFORM", "cpu") == "cpu":
+    # The oracle grid builds thousands of short-lived metric instances; auto-jit
+    # would pay an XLA compile per instance on the suite's single CPU core. The
+    # fused jit path keeps dedicated coverage via explicit `jit=True` tests.
+    metrics_tpu.set_default_jit(False)
+# On real hardware the tradeoff inverts: eager dispatch pays a tunnel RTT per
+# op, so the auto-jit fused step (one dispatch per batch) stays enabled.
 
 
 @pytest.fixture(scope="session")
