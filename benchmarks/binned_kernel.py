@@ -1,0 +1,64 @@
+"""Pallas vs XLA timing for the binned-curve threshold contraction.
+
+Run on the real TPU:  python benchmarks/binned_kernel.py
+
+Times ``binned_stat_counts`` (``metrics_tpu/ops/binned.py``) under both
+implementations across representative sizes; the dispatch default
+(``impl="auto"`` -> Pallas on TPU) should win or tie everywhere it is used.
+"""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.ops.binned import binned_stat_counts
+
+
+def timeit(fn, *args, iters=50, warmup=5):
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    start = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - start) / iters * 1e3
+
+
+def main():
+    print(f"backend: {jax.default_backend()}")
+    rng = np.random.RandomState(0)
+    for n, c, t in [
+        (4096, 1, 100),
+        (65536, 1, 100),
+        (4096, 32, 100),
+        (65536, 32, 100),
+        (16384, 128, 100),
+        (4096, 512, 100),
+    ]:
+        preds = jnp.asarray(rng.rand(n, c).astype(np.float32))
+        pos = jnp.asarray((rng.rand(n, c) > 0.5).astype(np.float32))
+        neg = 1.0 - pos
+        thr = jnp.asarray(np.linspace(0, 1, t, dtype=np.float32))
+
+        xla = jax.jit(lambda p, po, ne, th: binned_stat_counts(p, po, ne, th, impl="xla"))
+        pallas = jax.jit(lambda p, po, ne, th: binned_stat_counts(p, po, ne, th, impl="pallas"))
+
+        t_xla = timeit(xla, preds, pos, neg, thr)
+        try:
+            t_pal = timeit(pallas, preds, pos, neg, thr)
+            a, b = pallas(preds, pos, neg, thr), xla(preds, pos, neg, thr)
+            exact = all(np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(a, b))
+        except Exception as err:  # noqa: BLE001 - report, keep measuring other sizes
+            print(f"N={n:6d} C={c:4d} T={t}: xla {t_xla:8.3f} ms | pallas FAILED: {err}")
+            continue
+        print(
+            f"N={n:6d} C={c:4d} T={t}: xla {t_xla:8.3f} ms | pallas {t_pal:8.3f} ms"
+            f" | {t_xla / t_pal:5.2f}x | exact={exact}"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -5,6 +5,7 @@ Parity target: reference ``torchmetrics/classification/accuracy.py:23`` —
 """
 from typing import Any, Callable, Optional
 
+import numpy as np
 import jax.numpy as jnp
 from jax import Array
 
@@ -42,8 +43,8 @@ class Accuracy(Metric):
             dist_sync_fn=dist_sync_fn,
         )
 
-        self.add_state("correct", default=jnp.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
-        self.add_state("total", default=jnp.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
+        self.add_state("correct", default=np.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
+        self.add_state("total", default=np.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
 
         if not 0 < threshold < 1:
             raise ValueError(f"The `threshold` should be a float in the (0,1) interval, got {threshold}")

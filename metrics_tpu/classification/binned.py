@@ -9,10 +9,12 @@ they work inside jitted/pjit-ed training loops and sync with one ``psum``.
 """
 from typing import Any, Callable, Optional, Tuple, Union
 
+import numpy as np
 import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.data import accum_int_dtype
 from metrics_tpu.functional.classification.binned_curves import (
     _as_thresholds,
     binned_stat_curve_update,
@@ -41,8 +43,11 @@ class _BinnedCurveMetric(Metric):
         self.thresholds = _as_thresholds(thresholds)
         num_t = self.thresholds.shape[0]
         shape = (num_t,) if num_classes is None else (num_classes, num_t)
+        # int32 state: per-batch float32 counts are exact below 2**24 and the
+        # integer accumulator then holds exact totals to 2**31 (the core
+        # warns on approach — see Metric._check_accumulator_overflow)
         for name in ("tp", "fp", "tn", "fn"):
-            self.add_state(name, default=jnp.zeros(shape), dist_reduce_fx="sum")
+            self.add_state(name, default=np.zeros(shape, dtype=accum_int_dtype()), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         if self.num_classes is not None and preds.ndim == 1:
@@ -53,10 +58,11 @@ class _BinnedCurveMetric(Metric):
                 "construct the metric with num_classes=C for multiclass/multilabel input."
             )
         tp, fp, tn, fn = binned_stat_curve_update(preds.astype(jnp.float32), target, self.thresholds)
-        self.tp = self.tp + tp
-        self.fp = self.fp + fp
-        self.tn = self.tn + tn
-        self.fn = self.fn + fn
+        dt = self.tp.dtype
+        self.tp = self.tp + tp.astype(dt)
+        self.fp = self.fp + fp.astype(dt)
+        self.tn = self.tn + tn.astype(dt)
+        self.fn = self.fn + fn.astype(dt)
 
 
 class BinnedPrecisionRecallCurve(_BinnedCurveMetric):
@@ -75,7 +81,8 @@ class BinnedPrecisionRecallCurve(_BinnedCurveMetric):
         denom_r = self.tp + self.fn
         precision = jnp.where(denom_p == 0, 0.0, self.tp / jnp.where(denom_p == 0, 1.0, denom_p))
         recall = jnp.where(denom_r == 0, 0.0, self.tp / jnp.where(denom_r == 0, 1.0, denom_r))
-        return precision, recall, self.thresholds
+        # thresholds are stored host-side (config); the public API returns arrays
+        return precision, recall, jnp.asarray(self.thresholds)
 
 
 class BinnedROC(_BinnedCurveMetric):
@@ -84,7 +91,7 @@ class BinnedROC(_BinnedCurveMetric):
     def compute(self) -> Tuple[Array, Array, Array]:
         tpr = self.tp / jnp.maximum(self.tp + self.fn, 1.0)
         fpr = self.fp / jnp.maximum(self.fp + self.tn, 1.0)
-        return fpr, tpr, self.thresholds
+        return fpr, tpr, jnp.asarray(self.thresholds)
 
 
 class BinnedAUROC(_BinnedCurveMetric):

@@ -5,6 +5,7 @@ Parity target: reference ``torchmetrics/classification/cohen_kappa.py:23``
 """
 from typing import Any, Callable, Optional
 
+import numpy as np
 import jax.numpy as jnp
 from jax import Array
 
@@ -50,7 +51,7 @@ class CohenKappa(Metric):
             raise ValueError(f"Argument weights needs to one of the following: {allowed_weights}")
 
         self.add_state(
-            "confmat", default=jnp.zeros((num_classes, num_classes), dtype=accum_int_dtype()), dist_reduce_fx="sum"
+            "confmat", default=np.zeros((num_classes, num_classes), dtype=accum_int_dtype()), dist_reduce_fx="sum"
         )
 
     def update(self, preds: Array, target: Array) -> None:

@@ -5,6 +5,7 @@ Parity target: reference ``torchmetrics/classification/confusion_matrix.py:23``
 """
 from typing import Any, Callable, Optional
 
+import numpy as np
 import jax.numpy as jnp
 from jax import Array
 
@@ -56,7 +57,7 @@ class ConfusionMatrix(Metric):
         # integer accumulator: keeps pair counts exact past float32's 2^24
         # (the per-batch kernel is exact bf16-matmul, counts accumulate in int)
         self.add_state(
-            "confmat", default=jnp.zeros((num_classes, num_classes), dtype=accum_int_dtype()), dist_reduce_fx="sum"
+            "confmat", default=np.zeros((num_classes, num_classes), dtype=accum_int_dtype()), dist_reduce_fx="sum"
         )
 
     def update(self, preds: Array, target: Array) -> None:

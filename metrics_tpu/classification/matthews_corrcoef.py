@@ -5,6 +5,7 @@ Parity target: reference ``torchmetrics/classification/matthews_corrcoef.py:26``
 """
 from typing import Any, Callable, Optional
 
+import numpy as np
 import jax.numpy as jnp
 from jax import Array
 
@@ -47,7 +48,7 @@ class MatthewsCorrcoef(Metric):
         self.threshold = threshold
 
         self.add_state(
-            "confmat", default=jnp.zeros((num_classes, num_classes), dtype=accum_int_dtype()), dist_reduce_fx="sum"
+            "confmat", default=np.zeros((num_classes, num_classes), dtype=accum_int_dtype()), dist_reduce_fx="sum"
         )
 
     def update(self, preds: Array, target: Array) -> None:

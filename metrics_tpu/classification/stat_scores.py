@@ -6,6 +6,7 @@ at :277-340).
 """
 from typing import Any, Callable, Optional, Tuple
 
+import numpy as np
 import jax.numpy as jnp
 from jax import Array
 
@@ -77,7 +78,7 @@ class StatScores(Metric):
         if mdmc_reduce != "samplewise" and reduce != "samples":
             zeros_shape = () if reduce == "micro" else (num_classes,)
             for s in ("tp", "fp", "tn", "fn"):
-                self.add_state(s, default=jnp.zeros(zeros_shape, dtype=accum_int_dtype()), dist_reduce_fx="sum")
+                self.add_state(s, default=np.zeros(zeros_shape, dtype=accum_int_dtype()), dist_reduce_fx="sum")
         else:
             for s in ("tp", "fp", "tn", "fn"):
                 self.add_state(s, default=[], dist_reduce_fx=None)

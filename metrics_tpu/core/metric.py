@@ -30,6 +30,7 @@ TPU-native redesign (not a port):
 """
 import functools
 import inspect
+import threading
 from abc import ABC, abstractmethod
 from copy import deepcopy
 from typing import Any, Callable, Dict, NamedTuple, Optional, Union
@@ -69,6 +70,124 @@ def set_default_jit(value: Optional[bool]) -> Optional[bool]:
     old = _DEFAULT_JIT
     _DEFAULT_JIT = value
     return old
+
+
+# ------------------------------------------------------- jitted-step sharing
+# Two config-identical instances trace to the same XLA program, so compiled
+# steps are shared process-wide: workloads that construct metrics repeatedly
+# (fresh metric per eval epoch, per-fold loops) pay the trace once. Keys pin
+# the first instance so id()-based parts stay allocated (each entry pins its
+# own referents, so evicting one entry cannot invalidate another's key).
+# Instances whose config cannot be fingerprinted exactly get a private step
+# (never a wrong cache hit). Both caches are FIFO-bounded so a process
+# sweeping many distinct configs cannot grow memory without bound.
+_JITTED_STEP_CACHE: Dict[Any, tuple] = {}
+_JITTED_STEP_CACHE_MAX = 256
+_JITTED_STEP_CACHE_LOCK = threading.Lock()
+
+# default-state device constants shared across instances (immutable arrays)
+_DEFAULT_CONSTANT_CACHE: Dict[Any, Any] = {}
+_DEFAULT_CONSTANT_CACHE_MAX = 1024
+
+
+def _bounded_insert(cache: Dict[Any, Any], key: Any, value: Any, max_size: int) -> None:
+    if len(cache) >= max_size:
+        cache.pop(next(iter(cache)))  # dicts iterate in insertion order: FIFO
+    cache[key] = value
+
+# attrs that do not influence the traced computation (or are per-instance
+# caches); state attrs are excluded by name via self._defaults
+_NON_TRACE_ATTRS = frozenset({
+    "update", "compute", "_update_signature", "_update_impl", "_compute_impl",
+    "_computed", "_forward_cache", "_jitted_step", "_jitted_step_fc",
+    "_jit_failed", "_fc_failed", "_overflow_probe",
+    "_to_sync", "_in_forward", "_sync_count", "dist_sync_fn",
+    "_placement", "_state_dtype", "compute_on_step", "dist_sync_on_step",
+    "process_group",
+})
+
+
+class _Unfingerprintable(Exception):
+    pass
+
+
+@functools.lru_cache(maxsize=None)
+def _traced_attr_writes(cls: type) -> Optional[frozenset]:
+    """Names the traced step may assign on ``self``, or None when undeterminable.
+
+    Sharing a compiled step across instances is only sound when tracing it
+    writes registered states exclusively — side writes (e.g. a curve metric
+    caching ``self.mode`` on first update) would land on the instance that
+    traced the step, not the one calling it. The scan covers ``update`` and
+    ``compute`` (both run during a with-compute trace) and recurses into
+    ``self.<method>()`` calls they make; dynamic ``setattr`` or unreadable
+    source makes the class unshareable (fail safe).
+    """
+    import ast
+    import textwrap
+
+    writes: set = set()
+    scanned: set = set()
+
+    def scan(method_name: str) -> bool:
+        if method_name in scanned:
+            return True
+        scanned.add(method_name)
+        fn = None
+        for klass in cls.__mro__:
+            fn = vars(klass).get(method_name)
+            if fn is not None:
+                break
+        if fn is None or not callable(fn):
+            return False  # unresolvable self-call -> unshareable
+        try:
+            tree = ast.parse(textwrap.dedent(inspect.getsource(fn)))
+        except (OSError, TypeError, SyntaxError):
+            return False
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.ctx, ast.Store)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+            ):
+                writes.add(node.attr)
+            if isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Name) and node.func.id == "setattr":
+                    return False
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self"
+                ):
+                    if not scan(node.func.attr):
+                        return False
+        return True
+
+    if not (scan("update") and scan("compute")):
+        return None
+    return frozenset(writes)
+
+
+def _fingerprint_value(v: Any) -> Any:
+    if v is None or isinstance(v, (bool, int, float, str, bytes)):
+        return v
+    if isinstance(v, (np.ndarray, jnp.ndarray, Array)):
+        arr = np.asarray(v)
+        return ("arr", arr.shape, str(arr.dtype), arr.tobytes())
+    if isinstance(v, (list, tuple)):
+        return (type(v).__name__, tuple(_fingerprint_value(x) for x in v))
+    if isinstance(v, dict):
+        return ("dict", tuple((k, _fingerprint_value(x)) for k, x in sorted(v.items())))
+    if isinstance(v, _BufferSpec):
+        return ("bufspec", v.capacity, v.item_shape, str(v.dtype))
+    if callable(v) or isinstance(v, type):
+        return ("fn", id(v))  # cache entries pin the instance -> id stays live
+    try:
+        hash(v)
+    except TypeError:
+        raise _Unfingerprintable(type(v).__name__)
+    return ("obj", type(v).__name__, v)
 
 
 class _BufferSpec(NamedTuple):
@@ -138,7 +257,10 @@ class Metric(ABC):
         self._persistent: Dict[str, bool] = {}
         self._reductions: Dict[str, ReduceFx] = {}
         self._jitted_step = None
+        self._jitted_step_fc = None  # step that also computes the batch value
         self._jit_failed = False
+        self._fc_failed = False  # compute cannot trace -> keep compute eager
+        self._overflow_probe = None  # async int32-overflow check (see below)
         self._placement = None  # last device/sharding passed to device_put; re-applied on reset
         self._state_dtype = None  # last float dtype passed to astype; re-applied on reset
 
@@ -187,7 +309,17 @@ class Metric(ABC):
             return buffer_init(spec.capacity, spec.item_shape, spec.dtype)
         if isinstance(spec, list):
             return []
-        return jnp.asarray(spec)
+        # identical templates share one transferred device constant, and each
+        # instance gets a device-side copy of it: construction/reset cost no
+        # host->device transfer after the first, and the private copy keeps
+        # the cached buffer safe from the fused step's donation (TPU path
+        # donates the accumulator argument)
+        key = (spec.shape, str(spec.dtype), spec.tobytes())
+        cached = _DEFAULT_CONSTANT_CACHE.get(key)
+        if cached is None:
+            cached = jnp.asarray(spec)
+            _bounded_insert(_DEFAULT_CONSTANT_CACHE, key, cached, _DEFAULT_CONSTANT_CACHE_MAX)
+        return jnp.array(cached, copy=True)
 
     def _append(self, name: str, value: Array) -> None:
         """Append to a cat state — list (eager) or PaddedBuffer (jit-safe)."""
@@ -265,14 +397,52 @@ class Metric(ABC):
         # eager python-list states change pytree structure every step -> no jit
         return not any(isinstance(self._defaults[n], list) for n in self._defaults)
 
-    def _build_jitted_step(self) -> Callable:
+    def _build_jitted_step(self, with_compute: bool = False) -> Callable:
         donate = (0,) if jax.default_backend() == "tpu" else ()
+        # retraces run update/compute against self's attrs (saved/restored);
+        # the lock serializes concurrent retraces through a shared step.
+        # Compiled-call replays never enter the traced body, so steady state
+        # is lock-free.
+        lock = threading.Lock()
 
         def step(acc: State, *args: Any, **kwargs: Any):
-            delta = self._run_update_on_state(self.init_state(), *args, **kwargs)
-            return self.merge_states(acc, delta), delta
+            with lock:
+                delta = self._run_update_on_state(self.init_state(), *args, **kwargs)
+            merged = self.merge_states(acc, delta)
+            if with_compute:
+                with lock:
+                    value = self.compute_from_state(delta)
+                return merged, delta, value
+            return merged, delta
 
         return jax.jit(step, donate_argnums=donate)
+
+    def _config_fingerprint(self) -> Optional[tuple]:
+        """Exact trace-relevant config key, or None when it cannot be keyed."""
+        writes = _traced_attr_writes(type(self))
+        if writes is None or not writes <= set(self._defaults):
+            return None  # update has side writes -> step must stay private
+        try:
+            items = tuple(
+                (k, _fingerprint_value(v))
+                for k, v in sorted(vars(self).items())
+                if k not in _NON_TRACE_ATTRS and k not in self._defaults
+            )
+        except _Unfingerprintable:
+            return None
+        return (type(self), items)
+
+    def _lookup_or_build_jitted_step(self, with_compute: bool = False) -> Callable:
+        key = self._config_fingerprint()
+        if key is None:
+            return self._build_jitted_step(with_compute)
+        key = (key, with_compute)
+        with _JITTED_STEP_CACHE_LOCK:
+            hit = _JITTED_STEP_CACHE.get(key)
+            if hit is None:
+                hit = (self, self._build_jitted_step(with_compute))
+                _bounded_insert(_JITTED_STEP_CACHE, key, hit, _JITTED_STEP_CACHE_MAX)
+        return hit[1]
 
     def forward(self, *args: Any, **kwargs: Any) -> Any:
         """Accumulate this batch and (if ``compute_on_step``) return its batch-local value."""
@@ -280,38 +450,62 @@ class Metric(ABC):
             return self._forward_fused(*args, **kwargs)
         return self._forward_reference(*args, **kwargs)
 
+    _TRACER_ERRORS = (
+        jax.errors.TracerArrayConversionError,
+        jax.errors.ConcretizationTypeError,
+        jax.errors.TracerBoolConversionError,
+        TracingUnsupportedError,
+    )
+    _NO_VALUE = object()  # sentinel: fused step did not produce the batch value
+
     def _forward_fused(self, *args: Any, **kwargs: Any) -> Any:
         self._computed = None
         self._forward_cache = None
         delta = None
+        value = self._NO_VALUE
         if self._jittable:
-            if self._jitted_step is None:
-                self._jitted_step = self._build_jitted_step()
-            try:
-                new_acc, delta = self._jitted_step(self._current_state(), *args, **kwargs)
-                self._set_state(new_acc)
-            except (
-                jax.errors.TracerArrayConversionError,
-                jax.errors.ConcretizationTypeError,
-                jax.errors.TracerBoolConversionError,
-                TracingUnsupportedError,
-            ) as err:
-                # update needs concrete values (e.g. class inference) -> permanent eager
-                # fallback. Any other exception (a genuine bug in `update`) propagates.
-                rank_zero_warn(
-                    f"{self.__class__.__name__}.update cannot be jit-compiled"
-                    f" ({type(err).__name__}); falling back to the eager per-step path."
-                    " Pass static args (e.g. num_classes) to enable the fused step.",
-                    UserWarning,
-                )
-                self._jit_failed = True
-                delta = None
+            # fully fused step: update + merge + batch-value compute in ONE
+            # dispatch — the hot-loop shape (per-step value, no cross-process
+            # sync inside forward)
+            if self.compute_on_step and not self.dist_sync_on_step and not self._fc_failed:
+                if self._jitted_step_fc is None:
+                    self._jitted_step_fc = self._lookup_or_build_jitted_step(with_compute=True)
+                try:
+                    new_acc, delta, value = self._jitted_step_fc(self._current_state(), *args, **kwargs)
+                    self._set_state(new_acc)
+                except self._TRACER_ERRORS:
+                    # compute (or update) needs concrete values; retry below
+                    # with the compute left eager — same results, extra dispatch
+                    self._fc_failed = True
+                    delta, value = None, self._NO_VALUE
+            if delta is None:
+                if self._jitted_step is None:
+                    self._jitted_step = self._lookup_or_build_jitted_step()
+                try:
+                    new_acc, delta = self._jitted_step(self._current_state(), *args, **kwargs)
+                    self._set_state(new_acc)
+                except self._TRACER_ERRORS as err:
+                    # update needs concrete values (e.g. class inference) -> permanent eager
+                    # fallback. Any other exception (a genuine bug in `update`) propagates.
+                    rank_zero_warn(
+                        f"{self.__class__.__name__}.update cannot be jit-compiled"
+                        f" ({type(err).__name__}); falling back to the eager per-step path."
+                        " Pass static args (e.g. num_classes) to enable the fused step.",
+                        UserWarning,
+                    )
+                    self._jit_failed = True
+                    delta = None
         if delta is None:
             delta = self._run_update_on_state(self.init_state(), *args, **kwargs)
             self._set_state(self.merge_states(self._current_state(), delta))
 
         if not self.compute_on_step:
             return None
+
+        if value is not self._NO_VALUE:
+            self._forward_cache = value
+            self._computed = None
+            return value
 
         self._to_sync = self.dist_sync_on_step
         self._in_forward = True
@@ -371,29 +565,44 @@ class Metric(ABC):
         """Warn loudly when an int32 count accumulator nears wraparound.
 
         Without x64 enabled, count states accumulate in int32 (see
-        ``utils.data.accum_int_dtype``); a pod-scale epoch can silently wrap at
-        2^31. Host-side check on concrete states only — it is skipped under
-        tracing and inside per-step ``forward`` (the hot path checks the small
-        batch delta, which is pointless).
+        ``utils.data.accum_int_dtype``); a pod-scale epoch can silently wrap
+        at 2^31. The check is **asynchronous**: each epoch-level ``compute``
+        schedules a tiny on-device max-reduction plus a non-blocking
+        device-to-host copy, and *consumes the previous compute's probe* —
+        so the host never stalls on a device round trip (a ~100 ms latency
+        through remote-device tunnels). The warning therefore lands one
+        epoch after the threshold is crossed; the 2^30 threshold leaves a
+        full half-range of headroom for that epoch. Skipped under tracing.
         """
         if jax.config.jax_enable_x64:
             return
-        for name in self._defaults:
-            value = getattr(self, name)
-            if (
-                isinstance(value, (jnp.ndarray, Array))
-                and jnp.issubdtype(value.dtype, jnp.integer)
-                and is_concrete(value)
-                and value.size
-                and int(jnp.max(jnp.abs(value))) >= self._OVERFLOW_WARN_THRESHOLD
-            ):
+        pending = self._overflow_probe
+        self._overflow_probe = None
+        if pending is not None and is_concrete(pending):
+            worst = int(pending)  # copy was started last compute; ~always ready
+            if worst >= self._OVERFLOW_WARN_THRESHOLD:
                 rank_zero_warn(
-                    f"{self.__class__.__name__} state '{name}' has reached"
-                    f" {int(jnp.max(jnp.abs(value)))} (>= 2^30) in int32; it will"
-                    " silently wrap at 2^31. Enable jax_enable_x64 to accumulate"
-                    " counts in int64.",
+                    f"an int32 count state of {self.__class__.__name__} has"
+                    f" reached {worst} (>= 2^30); it will silently wrap at"
+                    " 2^31. Enable jax_enable_x64 to accumulate counts in"
+                    " int64.",
                     UserWarning,
                 )
+        maxes = [
+            jnp.max(jnp.abs(value))
+            for value in (getattr(self, name) for name in self._defaults)
+            if isinstance(value, (jnp.ndarray, Array))
+            and jnp.issubdtype(value.dtype, jnp.integer)
+            and is_concrete(value)
+            and value.size
+        ]
+        if maxes:
+            probe = jnp.max(jnp.stack(maxes))
+            try:
+                probe.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass  # async copy is an optimization; int() above still works
+            self._overflow_probe = probe
 
     def _wrap_compute(self, compute: Callable) -> Callable:
         @functools.wraps(compute)
@@ -446,6 +655,7 @@ class Metric(ABC):
         metric.py:256-265; here the last ``device_put``/``astype`` target is
         re-applied so mesh placement survives epoch resets)."""
         self._computed = None
+        self._overflow_probe = None  # probe of pre-reset values is stale
         state = self.init_state()
         self._set_state(state)
         if self._state_dtype is not None:
@@ -457,22 +667,27 @@ class Metric(ABC):
         return deepcopy(self)
 
     def __getstate__(self) -> dict:
-        skip = ("update", "compute", "_update_impl", "_compute_impl", "_jitted_step")
+        skip = ("update", "compute", "_update_impl", "_compute_impl", "_jitted_step", "_jitted_step_fc",
+                "_overflow_probe")
         return {k: v for k, v in self.__dict__.items() if k not in skip}
 
     def __setstate__(self, state: dict) -> None:
         self.__dict__.update(state)
+        self.__dict__.setdefault("_jitted_step_fc", None)
+        self.__dict__.setdefault("_fc_failed", False)
+        self.__dict__["_overflow_probe"] = None
         self._update_impl = self.__class__.update.__get__(self)
         self._compute_impl = self.__class__.compute.__get__(self)
         self.update = self._wrap_update(self._update_impl)
         self.compute = self._wrap_compute(self._compute_impl)
         self._jitted_step = None
+        self._jitted_step_fc = None
 
     def __deepcopy__(self, memo: dict) -> "Metric":
         cls = self.__class__
         new = cls.__new__(cls)
         memo[id(self)] = new
-        skip = ("update", "compute", "_update_impl", "_compute_impl", "_jitted_step")
+        skip = ("update", "compute", "_update_impl", "_compute_impl", "_jitted_step", "_jitted_step_fc")
         for k, v in self.__dict__.items():
             if k in skip:
                 continue

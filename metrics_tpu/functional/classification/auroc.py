@@ -9,18 +9,83 @@ available.
 """
 from typing import Optional, Sequence
 
+import numpy as np
+
 import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.functional.classification.auc import auc
+from metrics_tpu.functional.classification.curve_static import binary_auroc_static
 from metrics_tpu.functional.classification.roc import roc
-from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.checks import _input_format_classification, defer_or_run_value_check, deferred_value_checks
+from metrics_tpu.utils.data import in_tracing_context
 from metrics_tpu.utils.enums import AverageMethod, DataType
+from metrics_tpu.utils.prints import rank_zero_warn
 
 
-def _auroc_update(preds: Array, target: Array):
+def _check_pos_neg_eager(y: Array) -> None:
+    """The reference ROC error paths (roc.py:45-50).
+
+    Only possible eagerly; under a trace the static kernel yields nan
+    instead. Both conditions reduce on device, read back in one transfer,
+    deferrable into a ``deferred_value_checks`` window.
+    """
+    flags_dev = jnp.stack([jnp.all(y > 0), jnp.any(y > 0)])
+    try:
+        flags_dev.copy_to_host_async()
+    except (AttributeError, RuntimeError):
+        pass
+
+    def finalize() -> None:
+        flags = np.asarray(flags_dev)
+        if flags[0]:
+            raise ValueError("No negative samples in targets, false positive value should be meaningless")
+        if not flags[1]:
+            raise ValueError("No positive samples in targets, true positive value should be meaningless")
+
+    defer_or_run_value_check(finalize)
+
+
+def _auroc_class_scores(
+    preds: Array, target: Array, columns: str, pos_label: int, sample_weights: Optional[Sequence],
+    validate: bool = True,
+) -> Array:
+    """(C,) one-vs-rest AUROCs via the static kernel (single fused dispatch).
+
+    ``columns`` selects how per-class binary targets are derived: ``"labels"``
+    (multiclass: class c vs rest) or ``"multilabel"`` (target column c).
+    """
+    weights = None if sample_weights is None else jnp.asarray(sample_weights, dtype=jnp.float32)
+    num_classes = preds.shape[1]
+    if columns == "labels":
+        onehot = (target[:, None] == jnp.arange(num_classes)).astype(jnp.int32)
+    else:
+        onehot = (target == pos_label).astype(jnp.int32)
+    if validate and not in_tracing_context():
+        # per-class all/any flags reduce on device; one readback for all classes
+        flags_dev = jnp.stack([jnp.all(onehot > 0, axis=0), jnp.any(onehot > 0, axis=0)])
+        try:
+            flags_dev.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+
+        def finalize() -> None:
+            flags = np.asarray(flags_dev)
+            for c in range(num_classes):
+                if flags[0, c]:
+                    raise ValueError("No negative samples in targets, false positive value should be meaningless")
+                if not flags[1, c]:
+                    raise ValueError("No positive samples in targets, true positive value should be meaningless")
+
+        defer_or_run_value_check(finalize)
+    import jax
+
+    return jax.vmap(binary_auroc_static, in_axes=(1, 1, None))(preds, onehot, weights)
+
+
+def _auroc_update(preds: Array, target: Array, validate: bool = True):
     # validate input and resolve the data mode
-    _, _, mode = _input_format_classification(preds, target)
+    _, _, mode = _input_format_classification(preds, target, validate=validate)
 
     if mode == DataType.MULTIDIM_MULTICLASS:
         n_classes = preds.shape[1]
@@ -43,6 +108,7 @@ def _auroc_compute(
     average: Optional[str] = "macro",
     max_fpr: Optional[float] = None,
     sample_weights: Optional[Sequence] = None,
+    validate: bool = True,
 ) -> Array:
     if mode == DataType.BINARY:
         num_classes = 1
@@ -57,42 +123,61 @@ def _auroc_compute(
                 f" set to `None`, received `{max_fpr}`."
             )
 
-    if mode == DataType.MULTILABEL:
-        if average == AverageMethod.MICRO:
-            fpr, tpr, _ = roc(preds.reshape(-1), target.reshape(-1), 1, pos_label, sample_weights)
-        else:
-            output = [
-                roc(preds[:, i], target[:, i], num_classes=1, pos_label=1, sample_weights=sample_weights)
-                for i in range(num_classes)
-            ]
-            fpr = [o[0] for o in output]
-            tpr = [o[1] for o in output]
-    else:
-        fpr, tpr, _ = roc(preds, target, num_classes, pos_label, sample_weights)
-
     if max_fpr is None or max_fpr == 1:
+        # full AUC: static-shape kernels (jit/vmap-safe, one fused dispatch)
+        # instead of the eager per-class dynamic-curve sweep
+        weights = None if sample_weights is None else jnp.asarray(sample_weights, dtype=jnp.float32)
+
         if mode == DataType.MULTILABEL and average == AverageMethod.MICRO:
-            pass
-        elif num_classes != 1:
-            auc_scores = [auc(x, y) for x, y in zip(fpr, tpr)]
+            if pos_label is None:
+                rank_zero_warn("`pos_label` automatically set 1.")
+                pos_label = 1
+            y = (target.reshape(-1) == pos_label).astype(jnp.int32)
+            if validate and not in_tracing_context():
+                _check_pos_neg_eager(y)
+            return binary_auroc_static(preds.reshape(-1), y, weights)
+
+        if num_classes != 1:
+            if mode == DataType.MULTILABEL:
+                # per-column curves are always against positives == 1
+                # (reference auroc.py per-class sweep hardcodes pos_label=1)
+                auc_scores = _auroc_class_scores(preds, target, "multilabel", 1, sample_weights, validate)
+            else:
+                if pos_label is not None:
+                    rank_zero_warn(
+                        "Argument `pos_label` should be `None` when running"
+                        f" multiclass AUROC. Got {pos_label}"
+                    )
+                auc_scores = _auroc_class_scores(preds, target, "labels", 1, sample_weights, validate)
 
             if average == AverageMethod.NONE:
-                return auc_scores
+                return list(auc_scores)
             if average == AverageMethod.MACRO:
-                return jnp.mean(jnp.stack(auc_scores))
+                return jnp.mean(auc_scores)
             if average == AverageMethod.WEIGHTED:
                 if mode == DataType.MULTILABEL:
                     support = jnp.sum(target, axis=0)
                 else:
                     support = jnp.bincount(target.reshape(-1), length=num_classes)
-                return jnp.sum(jnp.stack(auc_scores) * support / jnp.sum(support))
+                return jnp.sum(auc_scores * support / jnp.sum(support))
 
             allowed_average = (AverageMethod.NONE.value, AverageMethod.MACRO.value, AverageMethod.WEIGHTED.value)
             raise ValueError(
                 f"Argument `average` expected to be one of the following: {allowed_average} but got {average}"
             )
 
-        return auc(fpr, tpr)
+        if pos_label is None:
+            rank_zero_warn("`pos_label` automatically set 1.")
+            pos_label = 1
+        if preds.ndim > target.ndim:
+            preds = preds[:, 0]
+        y = (target == pos_label).astype(jnp.int32)
+        if validate and not in_tracing_context():
+            _check_pos_neg_eager(y)
+        return binary_auroc_static(preds, y, weights)
+
+    # partial AUC keeps the dynamic-curve path (eager; data-dependent shapes)
+    fpr, tpr, _ = roc(preds, target, num_classes, pos_label, sample_weights)
 
     # partial AUC: interpolate the curve at max_fpr, then McClish-correct
     max_fpr_t = jnp.asarray(max_fpr)
@@ -118,6 +203,7 @@ def auroc(
     average: Optional[str] = "macro",
     max_fpr: Optional[float] = None,
     sample_weights: Optional[Sequence] = None,
+    validate: bool = True,
 ) -> Array:
     """Area under the receiver operating characteristic curve.
 
@@ -138,5 +224,15 @@ def auroc(
         >>> round(float(auroc(preds, target, num_classes=3)), 4)
         0.7778
     """
-    preds, target, mode = _auroc_update(preds, target)
-    return _auroc_compute(preds, target, mode, num_classes, pos_label, average, max_fpr, sample_weights)
+    # one deferred-readback window: input-value validation, the pos/neg
+    # checks, and the result all go into flight together, so high-latency
+    # links pay one device round trip instead of one per check.
+    # ``validate=False`` (an extension over the reference) skips the
+    # value-dependent checks entirely — zero device round trips; invalid
+    # inputs then produce nan instead of raising.
+    with deferred_value_checks():
+        preds, target, mode = _auroc_update(preds, target, validate=validate)
+        result = _auroc_compute(
+            preds, target, mode, num_classes, pos_label, average, max_fpr, sample_weights, validate=validate
+        )
+    return result

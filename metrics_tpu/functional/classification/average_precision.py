@@ -31,11 +31,31 @@ def _average_precision_compute(
     pos_label: int,
     sample_weights: Optional[Sequence] = None,
 ) -> Union[List[Array], Array]:
-    precision, recall, _ = _precision_recall_curve_compute(preds, target, num_classes, pos_label)
-    # step-function integral; the last precision entry is guaranteed to be 1
-    if num_classes == 1:
-        return -jnp.sum((recall[1:] - recall[:-1]) * precision[:-1])
+    """Step-function integral over the PR curve.
 
+    Computed with the static-shape kernel (``curve_static.py``) — jit/vmap
+    safe, one fused dispatch — except the multilabel layout, which keeps the
+    reference's dynamic-curve sweep. Absent classes yield ``nan`` (reference
+    parity: recall divides by zero positives).
+    """
+    import jax
+
+    from metrics_tpu.functional.classification.curve_static import binary_average_precision_static
+
+    weights = None if sample_weights is None else jnp.asarray(sample_weights, dtype=jnp.float32)
+
+    if num_classes == 1:
+        p = preds[:, 0] if preds.ndim > target.ndim else preds
+        y = (target == pos_label).astype(jnp.int32)
+        return binary_average_precision_static(p, y, weights)
+
+    if preds.shape != target.shape:
+        # multiclass one-vs-rest: vectorized over classes
+        onehot = (target[:, None] == jnp.arange(num_classes)).astype(jnp.int32)
+        scores = jax.vmap(binary_average_precision_static, in_axes=(1, 1, None))(preds, onehot, weights)
+        return list(scores)
+
+    precision, recall, _ = _precision_recall_curve_compute(preds, target, num_classes, pos_label)
     return [-jnp.sum((r[1:] - r[:-1]) * p[:-1]) for p, r in zip(precision, recall)]
 
 
@@ -64,5 +84,9 @@ def average_precision(
         >>> [float(x) for x in average_precision(pred, target, num_classes=5)]
         [1.0, 1.0, 0.25, 0.25, nan]
     """
-    preds, target, num_classes, pos_label = _average_precision_update(preds, target, num_classes, pos_label)
-    return _average_precision_compute(preds, target, num_classes, pos_label, sample_weights)
+    from metrics_tpu.utils.checks import deferred_value_checks
+
+    with deferred_value_checks():  # overlap validation readbacks with compute
+        preds, target, num_classes, pos_label = _average_precision_update(preds, target, num_classes, pos_label)
+        result = _average_precision_compute(preds, target, num_classes, pos_label, sample_weights)
+    return result

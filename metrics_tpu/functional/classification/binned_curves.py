@@ -23,25 +23,44 @@ import jax.numpy as jnp
 from jax import Array
 
 
-def default_thresholds(num_thresholds: int = 100, dtype=jnp.float32) -> Array:
-    """Evenly spaced thresholds in [0, 1]."""
-    return jnp.linspace(0.0, 1.0, num_thresholds, dtype=dtype)
+def default_thresholds(num_thresholds: int = 100, dtype=None):
+    """Evenly spaced thresholds in [0, 1].
+
+    Built host-side (numpy): threshold grids are metric *config*, and keeping
+    them off-device avoids a device round trip per metric construction (jnp
+    ops consume numpy operands directly; under jit they become constants).
+    """
+    import numpy as _np
+
+    return _np.linspace(0.0, 1.0, num_thresholds, dtype=dtype or _np.float32)
 
 
-def _as_thresholds(thresholds: Union[int, Array, None]) -> Array:
+def _as_thresholds(thresholds: Union[int, Array, None]):
     if thresholds is None:
         return default_thresholds()
     if isinstance(thresholds, int):
         return default_thresholds(thresholds)
-    return jnp.asarray(thresholds)
+    if isinstance(thresholds, jnp.ndarray):
+        return thresholds  # an explicit device array stays on device
+    import numpy as _np
+
+    return _np.asarray(thresholds)  # lists/np stay host-side
 
 
-def binned_stat_curve_update(preds: Array, target: Array, thresholds: Array) -> Tuple[Array, Array, Array, Array]:
+def binned_stat_curve_update(
+    preds: Array, target: Array, thresholds: Array, impl: str = "auto"
+) -> Tuple[Array, Array, Array, Array]:
     """Per-threshold TP/FP/TN/FN counts for binary ``(N,)`` or per-class ``(N, C)`` inputs.
 
     Returns arrays of shape ``(T,)`` (binary) or ``(C, T)``. Pure and jit-safe;
-    "sum"-reducible across batches and mesh axes.
+    "sum"-reducible across batches and mesh axes. The threshold contraction is
+    the curve family's hot op: large binary batches on TPU run a Pallas MXU
+    kernel that streams the ``(tile, T)`` comparison through VMEM
+    (``metrics_tpu/ops/binned.py``); per-class inputs and other backends use
+    an XLA einsum (``impl`` forwards to ``binned_stat_counts``).
     """
+    from metrics_tpu.ops.binned import binned_stat_counts
+
     if preds.ndim == 1:
         preds_c = preds[:, None]  # (N, 1)
         target_c = target[:, None]
@@ -50,11 +69,7 @@ def binned_stat_curve_update(preds: Array, target: Array, thresholds: Array) -> 
 
     pos = (target_c > 0).astype(preds_c.dtype)  # (N, C)
     neg = 1.0 - pos
-    ge = (preds_c[None, :, :] >= thresholds[:, None, None]).astype(preds_c.dtype)  # (T, N, C)
-
-    # contract over N: (T, N, C) x (N, C) -> (T, C); einsum lowers to batched matmul
-    tp = jnp.einsum("tnc,nc->tc", ge, pos).T  # (C, T)
-    fp = jnp.einsum("tnc,nc->tc", ge, neg).T
+    tp, fp = binned_stat_counts(preds_c, pos, neg, thresholds, impl=impl)  # (C, T)
     n_pos = jnp.sum(pos, axis=0)[:, None]  # (C, 1)
     n_neg = jnp.sum(neg, axis=0)[:, None]
     fn = n_pos - tp
@@ -84,7 +99,7 @@ def binned_precision_recall_curve(
     tp, fp, tn, fn = binned_stat_curve_update(preds.astype(jnp.float32), target, thresholds)
     precision = jnp.where(tp + fp == 0, 0.0, tp / jnp.where(tp + fp == 0, 1.0, tp + fp))
     recall = jnp.where(tp + fn == 0, 0.0, tp / jnp.where(tp + fn == 0, 1.0, tp + fn))
-    return precision, recall, thresholds
+    return precision, recall, jnp.asarray(thresholds)
 
 
 def binned_roc(
@@ -97,7 +112,7 @@ def binned_roc(
     tp, fp, tn, fn = binned_stat_curve_update(preds.astype(jnp.float32), target, thresholds)
     tpr = tp / jnp.maximum(tp + fn, 1.0)
     fpr = fp / jnp.maximum(fp + tn, 1.0)
-    return fpr, tpr, thresholds
+    return fpr, tpr, jnp.asarray(thresholds)
 
 
 def binned_auroc(

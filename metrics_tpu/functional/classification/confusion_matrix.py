@@ -30,11 +30,38 @@ def _bincount_2d(target_labels: Array, preds_labels: Array, num_classes: int) ->
 
 
 def _confusion_matrix_update(preds: Array, target: Array, num_classes: int, threshold: float = 0.5) -> Array:
-    preds, target, mode = _input_format_classification(preds, target, threshold)
-    if mode not in (DataType.BINARY, DataType.MULTILABEL):
-        preds = jnp.argmax(preds, axis=1)
-        target = jnp.argmax(target, axis=1)
-    return _bincount_2d(target, preds, num_classes)
+    from metrics_tpu.utils.data import in_tracing_context
+
+    if in_tracing_context() and not jnp.issubdtype(preds.dtype, jnp.floating):
+        # integer-label inputs under a trace: class inference from values is
+        # impossible, but num_classes is static — forward it so the formatter
+        # resolves the case from shapes alone and the kernel stays jittable
+        preds, target, mode = _input_format_classification(preds, target, threshold, num_classes=num_classes)
+    else:
+        # reference semantics exactly (reference confusion_matrix.py:24-32
+        # formats without num_classes, letting binary data stay binary);
+        # float inputs resolve their case statically, so this branch is also
+        # the jit path for prob inputs
+        preds, target, mode = _input_format_classification(preds, target, threshold)
+    if mode in (DataType.BINARY, DataType.MULTILABEL):
+        return _bincount_2d(target, preds, num_classes)
+    # multiclass: contract the formatter's one-hot outputs directly on the
+    # MXU. All-zero rows (labels outside [0, C), which value validation can
+    # only reject eagerly) drop out of the counts instead of being
+    # misattributed — matching the eager path's drop semantics under jit.
+    c_fmt = preds.shape[1]
+    if preds.ndim == 3:  # (N, C, X) -> (N*X, C)
+        preds = jnp.moveaxis(preds, 1, -1).reshape(-1, c_fmt)
+        target = jnp.moveaxis(target, 1, -1).reshape(-1, c_fmt)
+    counts = jnp.matmul(
+        target.astype(jnp.bfloat16).T, preds.astype(jnp.bfloat16), preferred_element_type=jnp.float32
+    )
+    counts = jnp.round(counts).astype(jnp.int32)
+    if c_fmt > num_classes:
+        counts = counts[:num_classes, :num_classes]
+    elif c_fmt < num_classes:
+        counts = jnp.pad(counts, ((0, num_classes - c_fmt), (0, num_classes - c_fmt)))
+    return counts
 
 
 def _confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -> Array:

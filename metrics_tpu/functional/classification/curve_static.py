@@ -1,0 +1,88 @@
+"""Static-shape exact binary-curve kernels (jit-safe AUROC / AveragePrecision).
+
+The reference's ``_binary_clf_curve`` (reference
+functional/classification/precision_recall_curve.py:23-63) extracts the
+distinct-threshold points with ``where(diff != 0)`` — a data-dependent output
+shape XLA cannot stage, which forces the exact curve metrics onto the eager
+path (one host dispatch per op; catastrophic over a device tunnel).
+
+For the *scalar* curve summaries (AUROC, average precision) the variable
+length is avoidable: keep all N points with static shape, and snap every
+point inside a tie-run to the run's final cumulative counts. Consecutive
+points then either coincide (zero-length segment, contributes nothing to any
+integral) or are exactly the distinct-threshold points, so trapezoidal /
+step integrals equal sklearn's on the deduplicated curve — including the
+50/50 tie-handling the trapezoid implies (a tie-run becomes one diagonal
+segment, not a staircase).
+
+Run-end snapping is a reversed cumulative minimum: counts are nondecreasing
+along the sorted order, so the value at the next valid (run-final) index is
+``min`` over the suffix of run-final values.
+
+Everything here is shape-static: safe under jit/vmap, one device dispatch.
+"""
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def _run_end(values: Array, valid: Array) -> Array:
+    """Snap each position to ``values`` at the next valid index (suffix min).
+
+    ``values`` must be nondecreasing; the last position must be valid.
+    """
+    masked = jnp.where(valid, values, jnp.inf)
+    return jnp.flip(jnp.minimum.accumulate(jnp.flip(masked, -1), axis=-1), -1)
+
+
+def _sorted_counts(preds: Array, target: Array, weights: Array = None) -> Tuple[Array, Array, Array]:
+    """Descending-score cumulative (tps, fps) snapped to tie-run ends.
+
+    Returns ``(tps, fps, valid)`` of shape ``(N,)`` — every index holds its
+    run-final counts; ``valid`` marks the run-final (distinct-threshold)
+    points for callers that need them.
+    """
+    order = jnp.argsort(-preds)
+    scores = preds[order]
+    y = target[order].astype(jnp.float32)
+    w = jnp.ones_like(y) if weights is None else weights[order].astype(jnp.float32)
+
+    tps = jnp.cumsum(y * w)
+    fps = jnp.cumsum((1.0 - y) * w)
+    # run-final = last index of a tie-run (next score differs; sentinel: last)
+    valid = jnp.concatenate([scores[1:] != scores[:-1], jnp.ones((1,), dtype=bool)])
+    return _run_end(tps, valid), _run_end(fps, valid), valid
+
+
+def binary_auroc_static(preds: Array, target: Array, sample_weights: Array = None) -> Array:
+    """Exact binary AUROC with static shapes (jit/vmap-safe scalar).
+
+    Matches ``sklearn.metrics.roc_auc_score`` (trapezoidal rule over the
+    distinct-threshold ROC with an implicit (0, 0) start). All-positive or
+    all-negative targets give ``nan`` (the eager exact path raises instead —
+    value checks cannot run under jit).
+    """
+    tps, fps, _ = _sorted_counts(preds, target, sample_weights)
+    pos = tps[-1]
+    neg = fps[-1]
+    tpr = jnp.concatenate([jnp.zeros((1,)), tps]) / jnp.where(pos == 0, jnp.nan, pos)
+    fpr = jnp.concatenate([jnp.zeros((1,)), fps]) / jnp.where(neg == 0, jnp.nan, neg)
+    return jnp.trapezoid(tpr, fpr)
+
+
+def binary_average_precision_static(preds: Array, target: Array, sample_weights: Array = None) -> Array:
+    """Exact binary average precision with static shapes (jit/vmap-safe).
+
+    Matches the reference's step integral over the PR curve
+    (reference functional/classification/average_precision.py:46-52):
+    ``AP = sum_n (R_n - R_{n-1}) * P_n`` over distinct-threshold points.
+    Zero positives gives ``nan``.
+    """
+    tps, fps, _ = _sorted_counts(preds, target, sample_weights)
+    pos = tps[-1]
+    precision = tps / jnp.maximum(tps + fps, 1e-38)
+    recall = tps / jnp.where(pos == 0, jnp.nan, pos)
+    # duplicated (snapped) points have zero recall-diff -> contribute nothing
+    prev_recall = jnp.concatenate([jnp.zeros((1,)), recall[:-1]])
+    return jnp.sum((recall - prev_recall) * precision)

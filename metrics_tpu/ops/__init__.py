@@ -1,0 +1,9 @@
+"""TPU Pallas kernels for the hot ops.
+
+Each kernel ships with an XLA fallback (used on non-TPU backends and as the
+numerical oracle in tests); dispatch is by ``jax.default_backend()`` with an
+explicit ``impl=`` override.
+"""
+from metrics_tpu.ops.binned import binned_stat_counts
+
+__all__ = ["binned_stat_counts"]

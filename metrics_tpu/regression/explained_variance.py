@@ -6,6 +6,7 @@ CHANGELOG "#68") so state is O(num_outputs) regardless of dataset size.
 """
 from typing import Any, Callable, Optional, Sequence, Union
 
+import numpy as np
 import jax.numpy as jnp
 from jax import Array
 
@@ -48,11 +49,11 @@ class ExplainedVariance(Metric):
                 f"Invalid input to argument `multioutput`. Choose one of the following: {allowed_multioutput}"
             )
         self.multioutput = multioutput
-        self.add_state("sum_error", default=jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("sum_squared_error", default=jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("sum_target", default=jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("sum_squared_target", default=jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("n_obs", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_error", default=np.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_squared_error", default=np.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_target", default=np.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_squared_target", default=np.zeros(()), dist_reduce_fx="sum")
+        self.add_state("n_obs", default=np.zeros(()), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         n_obs, sum_error, sum_squared_error, sum_target, sum_squared_target = _explained_variance_update(preds, target)

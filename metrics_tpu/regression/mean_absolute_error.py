@@ -1,6 +1,7 @@
 """MeanAbsoluteError module (reference torchmetrics/regression/mean_absolute_error.py:26)."""
 from typing import Any, Callable, Optional
 
+import numpy as np
 import jax.numpy as jnp
 from jax import Array
 
@@ -37,8 +38,8 @@ class MeanAbsoluteError(Metric):
             process_group=process_group,
             dist_sync_fn=dist_sync_fn,
         )
-        self.add_state("sum_abs_error", default=jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("total", default=jnp.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
+        self.add_state("sum_abs_error", default=np.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=np.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         sum_abs_error, n_obs = _mean_absolute_error_update(preds, target)

@@ -9,6 +9,7 @@ the mesh).
 """
 from typing import Any, Callable, Optional, Sequence, Tuple, Union
 
+import numpy as np
 import jax.numpy as jnp
 from jax import Array
 
@@ -50,8 +51,8 @@ class PSNR(Metric):
             rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
 
         if dim is None:
-            self.add_state("sum_squared_error", default=jnp.zeros(()), dist_reduce_fx="sum")
-            self.add_state("total", default=jnp.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
+            self.add_state("sum_squared_error", default=np.zeros(()), dist_reduce_fx="sum")
+            self.add_state("total", default=np.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
         else:
             self.add_state("sum_squared_error", default=[])
             self.add_state("total", default=[])
@@ -60,8 +61,8 @@ class PSNR(Metric):
             if dim is not None:
                 raise ValueError("The `data_range` must be given when `dim` is not None.")
             self.data_range = None
-            self.add_state("min_target", default=jnp.zeros(()), dist_reduce_fx="min")
-            self.add_state("max_target", default=jnp.zeros(()), dist_reduce_fx="max")
+            self.add_state("min_target", default=np.zeros(()), dist_reduce_fx="min")
+            self.add_state("max_target", default=np.zeros(()), dist_reduce_fx="max")
         else:
             self.data_range = jnp.asarray(float(data_range))
         self.base = base

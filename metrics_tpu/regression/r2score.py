@@ -1,6 +1,7 @@
 """R2Score module (reference torchmetrics/regression/r2score.py:23, states :121-124)."""
 from typing import Any, Callable, Optional
 
+import numpy as np
 import jax.numpy as jnp
 from jax import Array
 
@@ -56,10 +57,10 @@ class R2Score(Metric):
             )
         self.multioutput = multioutput
 
-        self.add_state("sum_squared_error", default=jnp.zeros(num_outputs), dist_reduce_fx="sum")
-        self.add_state("sum_error", default=jnp.zeros(num_outputs), dist_reduce_fx="sum")
-        self.add_state("residual", default=jnp.zeros(num_outputs), dist_reduce_fx="sum")
-        self.add_state("total", default=jnp.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
+        self.add_state("sum_squared_error", default=np.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("sum_error", default=np.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("residual", default=np.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("total", default=np.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         sum_squared_error, sum_error, residual, total = _r2score_update(preds, target)

@@ -19,6 +19,11 @@ XLA traces once with abstract values, so here:
 """
 from typing import Optional, Tuple
 
+import threading
+from contextlib import contextmanager
+
+import numpy as np
+
 import jax.numpy as jnp
 from jax import Array
 
@@ -95,35 +100,103 @@ def _validate_values(
     is_multiclass: Optional[bool],
     sum_atol: float = 1e-8,
 ) -> None:
-    """Value-dependent validation — concrete arrays only (reference checks.py:29-57, 81-84, 274-288)."""
+    """Value-dependent validation — concrete arrays only (reference checks.py:29-57, 81-84, 274-288).
+
+    All checks are evaluated as on-device boolean flags and read back in ONE
+    device-to-host transfer: through a remote-device tunnel each scalar
+    readback costs a full round trip (~100 ms), so the reference's
+    one-``.item()``-per-check structure is the single dominant cost of the
+    eager API. Error precedence matches the reference's check order.
+    """
     preds_float = _is_float(preds)
-    if int(jnp.min(target)) < 0:
-        raise ValueError("The `target` has to be a non-negative tensor.")
-    if not preds_float and int(jnp.min(preds)) < 0:
-        raise ValueError("If `preds` are integers, they have to be non-negative.")
-    if preds_float and (float(jnp.min(preds)) < 0 or float(jnp.max(preds)) > 1):
-        raise ValueError("The `preds` should be probabilities, but values were detected outside of [0,1] range.")
-    if is_multiclass is False and int(jnp.max(target)) > 1:
-        raise ValueError("If you set `is_multiclass=False`, then `target` should not exceed 1.")
-    if is_multiclass is False and not preds_float and int(jnp.max(preds)) > 1:
-        raise ValueError("If you set `is_multiclass=False` and `preds` are integers, then `preds` should not exceed 1.")
-    if preds.ndim == target.ndim and preds_float and int(jnp.max(target)) > 1:
-        raise ValueError(
-            "If `preds` and `target` are of shape (N, ...) and `preds` are floats, `target` should be binary."
-        )
-    if case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) and preds_float:
-        if not bool(jnp.all(jnp.isclose(jnp.sum(preds, axis=1), 1.0, atol=sum_atol))):
-            raise ValueError("Probabilities in `preds` must sum up to 1 across the `C` dimension.")
+    multiclass_case = case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS)
+
+    # (condition-expression, message) in reference order; conditions are traced
+    # lazily so inapplicable checks cost nothing
+    checks = [(jnp.min(target) < 0, "The `target` has to be a non-negative tensor.")]
+    if not preds_float:
+        checks.append((jnp.min(preds) < 0, "If `preds` are integers, they have to be non-negative."))
+    if preds_float:
+        checks.append((
+            (jnp.min(preds) < 0) | (jnp.max(preds) > 1),
+            "The `preds` should be probabilities, but values were detected outside of [0,1] range.",
+        ))
+    if is_multiclass is False:
+        checks.append((jnp.max(target) > 1, "If you set `is_multiclass=False`, then `target` should not exceed 1."))
+        if not preds_float:
+            checks.append((
+                jnp.max(preds) > 1,
+                "If you set `is_multiclass=False` and `preds` are integers, then `preds` should not exceed 1.",
+            ))
+    if preds.ndim == target.ndim and preds_float:
+        checks.append((
+            jnp.max(target) > 1,
+            "If `preds` and `target` are of shape (N, ...) and `preds` are floats, `target` should be binary.",
+        ))
+    if multiclass_case and preds_float:
+        checks.append((
+            ~jnp.all(jnp.isclose(jnp.sum(preds, axis=1), 1.0, atol=sum_atol)),
+            "Probabilities in `preds` must sum up to 1 across the `C` dimension.",
+        ))
     if preds.shape != target.shape:
-        if int(jnp.max(target)) >= implied_classes:
-            raise ValueError(
-                "The highest label in `target` should be smaller than the size of the `C` dimension of `preds`."
-            )
-    if num_classes and num_classes > 1 and case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS):
-        if num_classes <= int(jnp.max(target)):
-            raise ValueError("The highest label in `target` should be smaller than `num_classes`.")
-        if not preds_float and num_classes <= int(jnp.max(preds)):
-            raise ValueError("The highest label in `preds` should be smaller than `num_classes`.")
+        checks.append((
+            jnp.max(target) >= implied_classes,
+            "The highest label in `target` should be smaller than the size of the `C` dimension of `preds`.",
+        ))
+    if num_classes and num_classes > 1 and multiclass_case:
+        checks.append((
+            jnp.max(target) >= num_classes,
+            "The highest label in `target` should be smaller than `num_classes`.",
+        ))
+        if not preds_float:
+            checks.append((
+                jnp.max(preds) >= num_classes,
+                "The highest label in `preds` should be smaller than `num_classes`.",
+            ))
+
+    flags_dev = jnp.stack([c for c, _ in checks])
+    try:
+        flags_dev.copy_to_host_async()  # overlap the readback with other work
+    except (AttributeError, RuntimeError):
+        pass
+
+    def finalize() -> None:
+        flags = np.asarray(flags_dev)  # ONE readback
+        for flag, (_, message) in zip(flags, checks):
+            if flag:
+                raise ValueError(message)
+
+    defer_or_run_value_check(finalize)
+
+
+# ------------------------------------------------- deferred value-check window
+# Device-to-host readbacks have ~100 ms latency through remote-device tunnels.
+# Value checks need a readback before they can raise; inside a
+# ``deferred_value_checks()`` window the raise is postponed (finalizers are
+# collected, their async copies all in flight together) so one wait covers
+# every check plus the result computation. Checks still raise in their
+# original order. Thread-local: concurrent metric threads don't share windows.
+_DEFERRED_CHECKS = threading.local()
+
+
+@contextmanager
+def deferred_value_checks():
+    prev = getattr(_DEFERRED_CHECKS, "pending", None)
+    _DEFERRED_CHECKS.pending = pending = []
+    try:
+        yield
+    finally:
+        _DEFERRED_CHECKS.pending = prev
+    for finalize in pending:  # raises propagate only on clean exit
+        finalize()
+
+
+def defer_or_run_value_check(finalize) -> None:
+    pending = getattr(_DEFERRED_CHECKS, "pending", None)
+    if pending is None:
+        finalize()
+    else:
+        pending.append(finalize)
 
 
 def _validate_static(

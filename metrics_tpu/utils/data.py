@@ -55,6 +55,22 @@ def is_concrete(x: Any) -> bool:
     return not isinstance(x, jax.core.Tracer)
 
 
+def in_tracing_context() -> bool:
+    """True when called under an active trace (jit staging, vmap, grad, ...).
+
+    Closure constants stay concrete at function entry even under jit, so
+    ``is_concrete(arg)`` cannot tell whether downstream ops will produce
+    tracers; the dynamic trace state answers that without dispatching any
+    device computation.
+    """
+    try:
+        from jax._src.core import trace_state_clean
+
+        return not trace_state_clean()
+    except ImportError:  # future jax moved it: fall back to a zero-dim op probe
+        return isinstance(jnp.zeros((), jnp.int32) + 0, jax.core.Tracer)
+
+
 def upcast_accum(x: Array) -> Array:
     """Upcast low-precision floats to fp32 before accumulation.
 

@@ -119,3 +119,19 @@ def test_error_on_different_mode():
         probs = rng.rand(20, 4).astype(np.float32)
         probs = probs / probs.sum(-1, keepdims=True)
         metric(jnp.asarray(probs), jnp.asarray(rng.randint(0, 4, 20)))
+
+
+def test_multilabel_pos_label_is_per_column_one():
+    """Per-column multilabel curves binarize against 1 regardless of the
+    pos_label argument (reference hardcodes pos_label=1 in the per-class
+    sweep); only the micro average uses pos_label on the flattened labels."""
+    import jax.numpy as jnp
+    from sklearn.metrics import roc_auc_score
+
+    rng = np.random.RandomState(11)
+    preds = rng.rand(64, 4).astype(np.float32)
+    target = (rng.rand(64, 4) > 0.5).astype(np.int64)
+    want = roc_auc_score(target, preds, average="macro")
+    for pos_label in (0, 1, None):
+        got = float(auroc(jnp.asarray(preds), jnp.asarray(target), num_classes=4, average="macro", pos_label=pos_label))
+        assert abs(got - want) < 1e-6, (pos_label, got, want)

@@ -110,3 +110,49 @@ def test_warning_on_nan():
 
     with pytest.warns(UserWarning, match=".* nan values found in confusion matrix have been replaced with zeros."):
         confusion_matrix(preds, target, num_classes=5, normalize="true")
+
+
+def test_jittable_with_static_num_classes():
+    """confusion_matrix compiles for every input kind when num_classes is
+    given: int labels forward the static num_classes to the formatter under a
+    trace (value inference is impossible there), float inputs resolve their
+    case from shapes alone."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(7)
+
+    # multiclass int labels
+    p = jnp.asarray(rng.randint(0, 5, 64))
+    t = jnp.asarray(rng.randint(0, 5, 64))
+    jitted = jax.jit(lambda a, b: confusion_matrix(a, b, num_classes=5))(p, t)
+    np.testing.assert_allclose(np.asarray(jitted), np.asarray(confusion_matrix(p, t, num_classes=5)))
+
+    # binary probabilities
+    p = jnp.asarray(rng.rand(64).astype(np.float32))
+    t = jnp.asarray(rng.randint(0, 2, 64))
+    jitted = jax.jit(lambda a, b: confusion_matrix(a, b, num_classes=2))(p, t)
+    np.testing.assert_allclose(np.asarray(jitted), np.asarray(confusion_matrix(p, t, num_classes=2)))
+
+    # binary int labels
+    p = jnp.asarray(rng.randint(0, 2, 64))
+    t = jnp.asarray(rng.randint(0, 2, 64))
+    jitted = jax.jit(lambda a, b: confusion_matrix(a, b, num_classes=2))(p, t)
+    np.testing.assert_allclose(np.asarray(jitted), np.asarray(confusion_matrix(p, t, num_classes=2)))
+
+    # vmap over batched label inputs
+    p = jnp.asarray(rng.randint(0, 3, (4, 32)))
+    t = jnp.asarray(rng.randint(0, 3, (4, 32)))
+    batched = jax.vmap(lambda a, b: confusion_matrix(a, b, num_classes=3))(p, t)
+    assert batched.shape == (4, 3, 3)
+    for i in range(4):
+        np.testing.assert_allclose(np.asarray(batched[i]), np.asarray(confusion_matrix(p[i], t[i], num_classes=3)))
+
+    # out-of-range labels (value validation cannot run under jit): the pair
+    # is dropped from the counts, identically in eager and jit
+    p = jnp.asarray([0, 1, 7, 2])
+    t = jnp.asarray([0, 1, 2, 2])
+    eager = confusion_matrix(p, t, num_classes=5)
+    jitted = jax.jit(lambda a, b: confusion_matrix(a, b, num_classes=5))(p, t)
+    np.testing.assert_allclose(np.asarray(eager), np.asarray(jitted))
+    assert float(np.asarray(eager).sum()) == 3.0  # the (2, 7) pair dropped

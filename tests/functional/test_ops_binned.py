@@ -1,0 +1,77 @@
+"""Pallas binned-count kernel vs the XLA contraction (its numerical oracle).
+
+Runs the kernel in interpret mode on the CPU harness (same kernel logic the
+TPU executes compiled); real-hardware execution and timing are covered by
+``benchmarks/binned_kernel.py`` on the TPU validation run. Binary inputs
+(C == 1) exercise the MXU kernel; per-class inputs verify that the dispatch
+routes to the XLA path unchanged.
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu.functional.classification.binned_curves import binned_stat_curve_update
+from metrics_tpu.ops.binned import binned_stat_counts
+
+
+@pytest.mark.parametrize(
+    "n,t",
+    [
+        (37, 5),  # everything unaligned, single partial tile
+        (256, 100),  # T not lane-aligned
+        (2048, 128),  # exactly one aligned tile
+        (2049, 64),  # tile boundary + 1
+        (5000, 129),  # multiple tiles, T crosses a lane boundary
+    ],
+)
+def test_binary_kernel_matches_xla(n, t):
+    rng = np.random.RandomState(42)
+    preds = jnp.asarray(rng.rand(n, 1).astype(np.float32))
+    pos = jnp.asarray((rng.rand(n, 1) > 0.5).astype(np.float32))
+    neg = 1.0 - pos
+    thr = jnp.asarray(np.sort(rng.rand(t)).astype(np.float32))
+
+    tp_x, fp_x = binned_stat_counts(preds, pos, neg, thr, impl="xla")
+    tp_p, fp_p = binned_stat_counts(preds, pos, neg, thr, impl="pallas_interpret")
+    np.testing.assert_allclose(np.asarray(tp_p), np.asarray(tp_x), atol=0)
+    np.testing.assert_allclose(np.asarray(fp_p), np.asarray(fp_x), atol=0)
+
+
+@pytest.mark.parametrize("n,c,t", [(100, 3, 7), (513, 32, 100), (0, 3, 5)])
+def test_multiclass_and_empty_dispatch_to_xla(n, c, t):
+    """C>1 and N=0 take the XLA path under every impl (same results)."""
+    rng = np.random.RandomState(1)
+    preds = jnp.asarray(rng.rand(n, c).astype(np.float32))
+    pos = jnp.asarray((rng.rand(n, c) > 0.5).astype(np.float32))
+    neg = 1.0 - pos
+    thr = jnp.asarray(np.sort(rng.rand(t)).astype(np.float32))
+    ref = binned_stat_counts(preds, pos, neg, thr, impl="xla")
+    out = binned_stat_counts(preds, pos, neg, thr, impl="pallas_interpret")
+    for a, b in zip(out, ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=0)
+        assert a.shape == (c, t)
+
+
+def test_threshold_boundary_equality():
+    """Samples exactly on a threshold count as >= (inclusive), both impls."""
+    preds = jnp.asarray([[0.5], [0.25], [0.75]], dtype=jnp.float32)
+    pos = jnp.asarray([[1.0], [1.0], [0.0]], dtype=jnp.float32)
+    neg = 1.0 - pos
+    thr = jnp.asarray([0.25, 0.5, 0.75], dtype=jnp.float32)
+    for impl in ("xla", "pallas_interpret"):
+        tp, fp = binned_stat_counts(preds, pos, neg, thr, impl=impl)
+        np.testing.assert_allclose(np.asarray(tp[0]), [2.0, 1.0, 0.0])
+        np.testing.assert_allclose(np.asarray(fp[0]), [1.0, 1.0, 1.0])
+
+
+@pytest.mark.parametrize("shape", [(64,), (64, 4)])
+def test_curve_update_impl_parity(shape):
+    """binned_stat_curve_update produces identical 4-tuples under both impls."""
+    rng = np.random.RandomState(7)
+    preds = jnp.asarray(rng.rand(*shape).astype(np.float32))
+    target = jnp.asarray((rng.rand(*shape) > 0.5).astype(np.int32))
+    thr = jnp.asarray(np.linspace(0.0, 1.0, 50, dtype=np.float32))
+    ref = binned_stat_curve_update(preds, target, thr, impl="xla")
+    out = binned_stat_curve_update(preds, target, thr, impl="pallas_interpret")
+    for a, b in zip(out, ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=0)
