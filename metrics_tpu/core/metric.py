@@ -90,17 +90,21 @@ _DEFAULT_CONSTANT_CACHE: Dict[Any, Any] = {}
 _DEFAULT_CONSTANT_CACHE_MAX = 1024
 
 
+_CACHE_LOCK = threading.Lock()
+
+
 def _bounded_insert(cache: Dict[Any, Any], key: Any, value: Any, max_size: int) -> None:
-    if len(cache) >= max_size:
-        cache.pop(next(iter(cache)))  # dicts iterate in insertion order: FIFO
-    cache[key] = value
+    with _CACHE_LOCK:
+        if len(cache) >= max_size:
+            cache.pop(next(iter(cache)), None)  # insertion order: FIFO
+        cache[key] = value
 
 # attrs that do not influence the traced computation (or are per-instance
 # caches); state attrs are excluded by name via self._defaults
 _NON_TRACE_ATTRS = frozenset({
     "update", "compute", "_update_signature", "_update_impl", "_compute_impl",
     "_computed", "_forward_cache", "_jitted_step", "_jitted_step_fc",
-    "_jit_failed", "_fc_failed", "_overflow_probe",
+    "_jit_failed", "_fc_failed", "_overflow_probe", "_default_keys",
     "_to_sync", "_in_forward", "_sync_count", "dist_sync_fn",
     "_placement", "_state_dtype", "compute_on_step", "dist_sync_on_step",
     "process_group",
@@ -169,20 +173,21 @@ def _traced_attr_writes(cls: type) -> Optional[frozenset]:
     return frozenset(writes)
 
 
-def _fingerprint_value(v: Any) -> Any:
+def _fingerprint_value(v: Any, pins: list) -> Any:
     if v is None or isinstance(v, (bool, int, float, str, bytes)):
         return v
     if isinstance(v, (np.ndarray, jnp.ndarray, Array)):
         arr = np.asarray(v)
         return ("arr", arr.shape, str(arr.dtype), arr.tobytes())
     if isinstance(v, (list, tuple)):
-        return (type(v).__name__, tuple(_fingerprint_value(x) for x in v))
+        return (type(v).__name__, tuple(_fingerprint_value(x, pins) for x in v))
     if isinstance(v, dict):
-        return ("dict", tuple((k, _fingerprint_value(x)) for k, x in sorted(v.items())))
+        return ("dict", tuple((k, _fingerprint_value(x, pins)) for k, x in sorted(v.items())))
     if isinstance(v, _BufferSpec):
         return ("bufspec", v.capacity, v.item_shape, str(v.dtype))
     if callable(v) or isinstance(v, type):
-        return ("fn", id(v))  # cache entries pin the instance -> id stays live
+        pins.append(v)  # the cache entry pins this object -> id stays live
+        return ("fn", id(v))
     try:
         hash(v)
     except TypeError:
@@ -254,6 +259,7 @@ class Metric(ABC):
         self._forward_cache = None
 
         self._defaults: Dict[str, Any] = {}  # numpy templates / [] / _BufferSpec
+        self._default_keys: Dict[str, Any] = {}  # precomputed constant-cache keys
         self._persistent: Dict[str, bool] = {}
         self._reductions: Dict[str, ReduceFx] = {}
         self._jitted_step = None
@@ -301,10 +307,12 @@ class Metric(ABC):
         self._defaults[name] = default_spec
         self._persistent[name] = persistent
         self._reductions[name] = dist_reduce_fx
-        setattr(self, name, self._materialize_default(default_spec))
+        if isinstance(default_spec, np.ndarray):
+            self._default_keys[name] = (default_spec.shape, str(default_spec.dtype), default_spec.tobytes())
+        setattr(self, name, self._materialize_default(default_spec, self._default_keys.get(name)))
 
     @staticmethod
-    def _materialize_default(spec: Any) -> Any:
+    def _materialize_default(spec: Any, key: Any = None) -> Any:
         if isinstance(spec, _BufferSpec):
             return buffer_init(spec.capacity, spec.item_shape, spec.dtype)
         if isinstance(spec, list):
@@ -313,8 +321,10 @@ class Metric(ABC):
         # instance gets a device-side copy of it: construction/reset cost no
         # host->device transfer after the first, and the private copy keeps
         # the cached buffer safe from the fused step's donation (TPU path
-        # donates the accumulator argument)
-        key = (spec.shape, str(spec.dtype), spec.tobytes())
+        # donates the accumulator argument). ``key`` is precomputed in
+        # add_state so big templates are not re-hashed per reset.
+        if key is None:
+            key = (spec.shape, str(spec.dtype), spec.tobytes())
         cached = _DEFAULT_CONSTANT_CACHE.get(key)
         if cached is None:
             cached = jnp.asarray(spec)
@@ -332,7 +342,10 @@ class Metric(ABC):
     # ------------------------------------------------------------- pure core
     def init_state(self) -> State:
         """Fresh default state pytree."""
-        return {name: self._materialize_default(spec) for name, spec in self._defaults.items()}
+        return {
+            name: self._materialize_default(spec, self._default_keys.get(name))
+            for name, spec in self._defaults.items()
+        }
 
     def _current_state(self) -> State:
         return {name: getattr(self, name) for name in self._defaults}
@@ -397,50 +410,64 @@ class Metric(ABC):
         # eager python-list states change pytree structure every step -> no jit
         return not any(isinstance(self._defaults[n], list) for n in self._defaults)
 
-    def _build_jitted_step(self, with_compute: bool = False) -> Callable:
+    def _build_jitted_step(self, with_compute: bool = False, isolate: bool = False) -> Callable:
         donate = (0,) if jax.default_backend() == "tpu" else ()
-        # retraces run update/compute against self's attrs (saved/restored);
-        # the lock serializes concurrent retraces through a shared step.
+        # Retraces run update/compute against the carrier's attrs
+        # (saved/restored); the lock serializes concurrent retraces.
         # Compiled-call replays never enter the traced body, so steady state
-        # is lock-free.
+        # is lock-free. Shared steps (isolate=True) close over a detached
+        # reset copy instead of a live instance: a retrace can never plant
+        # tracers on (or read accumulated state of) any user-visible metric,
+        # and the cache pins only default-sized state buffers.
+        carrier = self
+        if isolate:
+            carrier = deepcopy(self)
+            carrier.reset()
         lock = threading.Lock()
 
         def step(acc: State, *args: Any, **kwargs: Any):
             with lock:
-                delta = self._run_update_on_state(self.init_state(), *args, **kwargs)
-            merged = self.merge_states(acc, delta)
+                delta = carrier._run_update_on_state(carrier.init_state(), *args, **kwargs)
+            merged = carrier.merge_states(acc, delta)
             if with_compute:
                 with lock:
-                    value = self.compute_from_state(delta)
+                    value = carrier.compute_from_state(delta)
                 return merged, delta, value
             return merged, delta
 
         return jax.jit(step, donate_argnums=donate)
 
     def _config_fingerprint(self) -> Optional[tuple]:
-        """Exact trace-relevant config key, or None when it cannot be keyed."""
+        """(key, pinned-referents) for the trace-relevant config, or None.
+
+        ``pins`` are the objects whose ``id()`` appears in the key; the cache
+        entry keeps them alive (via these pins and the detached carrier the
+        step closes over) so ids are never reused while the entry lives.
+        """
         writes = _traced_attr_writes(type(self))
         if writes is None or not writes <= set(self._defaults):
             return None  # update has side writes -> step must stay private
+        pins: list = [type(self)]
         try:
             items = tuple(
-                (k, _fingerprint_value(v))
+                (k, _fingerprint_value(v, pins))
                 for k, v in sorted(vars(self).items())
                 if k not in _NON_TRACE_ATTRS and k not in self._defaults
             )
         except _Unfingerprintable:
             return None
-        return (type(self), items)
+        return ((type(self), items), pins)
 
     def _lookup_or_build_jitted_step(self, with_compute: bool = False) -> Callable:
-        key = self._config_fingerprint()
-        if key is None:
+        fp = self._config_fingerprint()
+        if fp is None:
             return self._build_jitted_step(with_compute)
-        key = (key, with_compute)
+        key_body, pins = fp
+        key = (key_body, with_compute)
         with _JITTED_STEP_CACHE_LOCK:
             hit = _JITTED_STEP_CACHE.get(key)
             if hit is None:
-                hit = (self, self._build_jitted_step(with_compute))
+                hit = (pins, self._build_jitted_step(with_compute, isolate=True))
                 _bounded_insert(_JITTED_STEP_CACHE, key, hit, _JITTED_STEP_CACHE_MAX)
         return hit[1]
 
@@ -674,6 +701,7 @@ class Metric(ABC):
     def __setstate__(self, state: dict) -> None:
         self.__dict__.update(state)
         self.__dict__.setdefault("_jitted_step_fc", None)
+        self.__dict__.setdefault("_default_keys", {})
         self.__dict__.setdefault("_fc_failed", False)
         self.__dict__["_overflow_probe"] = None
         self._update_impl = self.__class__.update.__get__(self)

@@ -80,38 +80,60 @@ class RetrievalMetric(Metric, ABC):
         if idx.shape[0] == 0:
             return jnp.asarray(0.0)
 
-        # densify query ids (eager: compute runs at epoch end)
-        unique_ids, dense = jnp.unique(idx, return_inverse=True)
-        num_queries = int(unique_ids.shape[0])
-        dense = dense.astype(jnp.int32)
-
-        # empty-query policy uses RAW target sums (reference :121 quirk)
+        # Everything below is static-shape: query ids densify via sort+cumsum
+        # (no jnp.unique host sync), the segment count is the row count N (an
+        # upper bound — absent segments are masked), and sentinel rows are
+        # neutralized by masking instead of boolean filtering. One fused
+        # device program; the only readback is the deferred 'error' check.
         import jax
 
-        raw_sums = jax.ops.segment_sum(target.astype(jnp.float32), dense, num_queries)
-        empty = raw_sums == 0
+        n = int(idx.shape[0])
+        order = jnp.argsort(idx, stable=True)
+        sorted_ids = idx[order]
+        new_segment = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), (sorted_ids[1:] != sorted_ids[:-1]).astype(jnp.int32)]
+        )
+        dense = jnp.zeros((n,), jnp.int32).at[order].set(jnp.cumsum(new_segment))
 
-        if self.query_without_relevant_docs == "error" and bool(jnp.any(empty)):
+        counts = jax.ops.segment_sum(jnp.ones((n,), jnp.float32), dense, n)
+        exists = counts > 0
+
+        # empty-query policy uses RAW target sums (reference :121 quirk)
+        raw_sums = jax.ops.segment_sum(target.astype(jnp.float32), dense, n)
+        empty = (raw_sums == 0) & exists
+
+        if self.query_without_relevant_docs == "error":
+            flag = jnp.any(empty)
+            try:
+                flag.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass
+
+        # sentinel rows must not rank, hit, or grade: -inf scores sink them
+        # below every real row of their query, zero targets null their gain
+        # (reference filters them out per query, retrieval_metric.py:126-142)
+        excluded = target == self.exclude
+        preds_m = jnp.where(excluded, -jnp.inf, preds)
+        target_m = jnp.where(excluded, 0, target)
+        scores = self._grouped_metric(dense, preds_m, target_m, n)
+
+        if self.query_without_relevant_docs == "error" and bool(flag):
             raise ValueError(
                 f"`{self.__class__.__name__}.compute()` was provided with a query without positive targets"
             )
-
-        # rows excluded by sentinel drop out before ranking (reference _metric
-        # filters); target grading is preserved — subclasses binarize if needed
-        valid = target != self.exclude
-        scores = self._grouped_metric(dense[valid], preds[valid], target[valid], num_queries)
 
         if self.query_without_relevant_docs == "pos":
             scores = jnp.where(empty, 1.0, scores)
         elif self.query_without_relevant_docs == "neg":
             scores = jnp.where(empty, 0.0, scores)
         elif self.query_without_relevant_docs == "skip":
-            kept = ~empty
-            if int(jnp.sum(kept)) == 0:
-                return jnp.asarray(0.0)
-            return jnp.sum(jnp.where(kept, scores, 0.0)) / jnp.sum(kept)
+            kept = exists & ~empty
+            total = jnp.sum(jnp.where(kept, scores, 0.0))
+            n_kept = jnp.sum(kept)
+            return jnp.where(n_kept == 0, 0.0, total / jnp.maximum(n_kept, 1))
 
-        return jnp.mean(scores)
+        present = jnp.sum(jnp.where(exists, scores, 0.0))
+        return present / jnp.maximum(jnp.sum(exists), 1)
 
     @abstractmethod
     def _grouped_metric(self, dense_idx: Array, preds: Array, target: Array, num_queries: int) -> Array:

@@ -391,3 +391,52 @@ def test_profile_metric_helper():
     times = profile_metric(Accuracy(), jnp.array([1, 0, 1]), jnp.array([1, 1, 0]), iters=3, )
     assert set(times) == {"update_ms", "compute_ms"}
     assert all(v > 0 for v in times.values())
+
+
+def test_jitted_step_sharing_rules():
+    """Config-identical instances share one compiled step; different config or
+    side-writing update/compute methods get private steps."""
+    import metrics_tpu
+    from metrics_tpu.core.metric import _traced_attr_writes
+
+    old = metrics_tpu.set_default_jit(True)
+    try:
+
+        class CleanMetric(Metric):
+
+            def __init__(self, scale=1.0, **kw):
+                super().__init__(**kw)
+                self.scale = scale
+                self.add_state("x", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+            def update(self, v):
+                self.x = self.x + v * self.scale
+
+            def compute(self):
+                return self.x
+
+        a, b = CleanMetric(), CleanMetric()
+        a(jnp.asarray(1.0)); b(jnp.asarray(2.0))
+        assert a._jitted_step_fc is b._jitted_step_fc and a._jitted_step_fc is not None
+        assert float(a.compute()) == 1.0 and float(b.compute()) == 2.0  # no state bleed
+
+        c = CleanMetric(scale=3.0)
+        c(jnp.asarray(1.0))
+        assert c._jitted_step_fc is not a._jitted_step_fc  # different config
+        assert float(c.compute()) == 3.0
+
+        class SideWriting(CleanMetric):
+
+            def update(self, v):
+                self.seen = True  # non-state write -> must not share
+                self.x = self.x + v
+
+        assert _traced_attr_writes(SideWriting) is None or not (
+            _traced_attr_writes(SideWriting) <= {"x"}
+        )
+        d, e = SideWriting(), SideWriting()
+        d(jnp.asarray(1.0)); e(jnp.asarray(1.0))
+        assert d._jitted_step_fc is not e._jitted_step_fc
+        assert d.seen and e.seen  # the side write lands on each instance
+    finally:
+        metrics_tpu.set_default_jit(old)

@@ -23,7 +23,9 @@ from tests.classification.inputs import (
 from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
 
 
-def _sk_fbeta_f1(preds, target, sk_fn, num_classes, average, is_multiclass, ignore_index, mdmc_average=None):
+def _sk_fbeta_f1(
+    preds, target, sk_fn, num_classes, average, is_multiclass, ignore_index, mdmc_average=None, preformatted=False
+):
     if average == "none":
         average = None
     if num_classes == 1:
@@ -35,10 +37,13 @@ def _sk_fbeta_f1(preds, target, sk_fn, num_classes, average, is_multiclass, igno
     except ValueError:
         pass
 
-    sk_preds, sk_target, _ = _input_format_classification(
-        preds, target, THRESHOLD, num_classes=num_classes, is_multiclass=is_multiclass
-    )
-    sk_preds, sk_target = np.asarray(sk_preds), np.asarray(sk_target)
+    if preformatted:  # already binary (N, C) from the caller's formatting pass
+        sk_preds, sk_target = np.asarray(preds), np.asarray(target)
+    else:
+        sk_preds, sk_target, _ = _input_format_classification(
+            preds, target, THRESHOLD, num_classes=num_classes, is_multiclass=is_multiclass
+        )
+        sk_preds, sk_target = np.asarray(sk_preds), np.asarray(sk_target)
 
     sk_scores = sk_fn(sk_target, sk_preds, average=average, zero_division=0, labels=labels)
 
@@ -61,7 +66,9 @@ def _sk_fbeta_f1_mdim_mcls(preds, target, sk_fn, num_classes, average, is_multic
     if mdmc_average == "samplewise":
         scores = []
         for i in range(preds.shape[0]):
-            scores_i = _sk_fbeta_f1(preds[i].T, target[i].T, sk_fn, num_classes, average, False, ignore_index)
+            scores_i = _sk_fbeta_f1(
+                preds[i].T, target[i].T, sk_fn, num_classes, average, False, ignore_index, preformatted=True
+            )
             scores.append(np.expand_dims(scores_i, 0))
         return np.concatenate(scores).mean(axis=0)
 

@@ -23,11 +23,16 @@ from tests.classification.inputs import (
 from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
 
 
-def _sk_stat_scores(preds, target, reduce, num_classes, is_multiclass, ignore_index, top_k, mdmc_reduce=None):
-    preds, target, _ = _input_format_classification(
-        preds, target, threshold=THRESHOLD, num_classes=num_classes, is_multiclass=is_multiclass, top_k=top_k
-    )
-    sk_preds, sk_target = np.asarray(preds), np.asarray(target)
+def _sk_stat_scores(
+    preds, target, reduce, num_classes, is_multiclass, ignore_index, top_k, mdmc_reduce=None, preformatted=False
+):
+    if preformatted:  # already binary (N, C) from the caller's formatting pass
+        sk_preds, sk_target = np.asarray(preds), np.asarray(target)
+    else:
+        preds, target, _ = _input_format_classification(
+            preds, target, threshold=THRESHOLD, num_classes=num_classes, is_multiclass=is_multiclass, top_k=top_k
+        )
+        sk_preds, sk_target = np.asarray(preds), np.asarray(target)
     width = sk_preds.shape[1]  # pre-transpose C dim, as the reference adapter uses
 
     if reduce != "macro" and ignore_index is not None and width > 1:
@@ -74,7 +79,9 @@ def _sk_stat_scores_mdim_mcls(preds, target, reduce, mdmc_reduce, num_classes, i
     if mdmc_reduce == "samplewise":
         scores = []
         for i in range(preds.shape[0]):
-            scores_i = _sk_stat_scores(preds[i].T, target[i].T, reduce, None, False, ignore_index, top_k)
+            scores_i = _sk_stat_scores(
+                preds[i].T, target[i].T, reduce, None, False, ignore_index, top_k, preformatted=True
+            )
             scores.append(np.expand_dims(scores_i, 0))
         return np.concatenate(scores)
 
