@@ -54,8 +54,81 @@ class MetricCollection(OrderedDict):
         self.prefix = self._check_prefix_arg(prefix)
 
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
-        """Call forward on every metric; kwargs are filtered per metric signature."""
+        """Call forward on every metric; kwargs are filtered per metric signature.
+
+        When every child has fixed-shape states and per-step cross-process
+        sync is off, the whole collection runs as ONE jitted program —
+        every update, accumulator merge, and batch value in a single
+        dispatch (the reference pays N forwards; a naive port would pay N
+        dispatches)."""
+        fused = self._forward_fused_collection(*args, **kwargs)
+        if fused is not None:
+            return fused
         return {self._set_prefix(k): m(*args, **m._filter_kwargs(**kwargs)) for k, m in self.items()}
+
+    def _collection_fusable(self) -> bool:
+        return all(
+            m._fusable
+            and m._jittable
+            and m.compute_on_step
+            and not m.dist_sync_on_step
+            and m._config_fingerprint() is not None  # update/compute write states only
+            for m in self.values()
+        )
+
+    def _forward_fused_collection(self, *args: Any, **kwargs: Any) -> Optional[Dict[str, Any]]:
+        if self.__dict__.get("_col_fuse_failed"):
+            return None
+        step = self.__dict__.get("_col_step")
+        if step is not None and self.__dict__.get("_col_step_keys") != tuple(self.keys()):
+            step = None  # membership changed: the cached step is stale
+        if step is None:
+            # the full fusability/fingerprint gate runs only at (re)build time;
+            # steady-state forwards skip straight to the cached step
+            if not self._collection_fusable():
+                return None
+            self.__dict__["_col_step"] = step = self._build_collection_step()
+            self.__dict__["_col_step_keys"] = tuple(self.keys())
+        states = {k: m._current_state() for k, m in self.items()}
+        try:
+            new_states, values = step(states, *args, **kwargs)
+        except Metric._TRACER_ERRORS:
+            # some update/compute needs concrete values: per-metric forwards
+            # handle their own fallbacks from here on
+            self.__dict__["_col_fuse_failed"] = True
+            self.__dict__["_col_step"] = None
+            return None
+        for k, m in self.items():
+            m._computed = None
+            m._set_state(new_states[k])
+            m._forward_cache = values[k]
+        return {self._set_prefix(k): values[k] for k in self.keys()}
+
+    def _build_collection_step(self):
+        import threading
+
+        import jax
+
+        # detached reset copies: retraces never touch the live children
+        # (children passed the write-only-states fingerprint gate)
+        carriers = {k: deepcopy(m) for k, m in self.items()}
+        for c in carriers.values():
+            c.reset()
+        donate = (0,) if jax.default_backend() == "tpu" else ()
+        lock = threading.Lock()
+
+        def step(states, *args, **kwargs):
+            new_states, values = {}, {}
+            for k, c in carriers.items():
+                kw = c._filter_kwargs(**kwargs)
+                with lock:
+                    delta = c._run_update_on_state(c.init_state(), *args, **kw)
+                new_states[k] = c.merge_states(states[k], delta)
+                with lock:
+                    values[k] = c.compute_from_state(delta)
+            return new_states, values
+
+        return jax.jit(step, donate_argnums=donate)
 
     def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         return self.forward(*args, **kwargs)
@@ -82,12 +155,13 @@ class MetricCollection(OrderedDict):
         new = type(self)({k: deepcopy(m, memo) for k, m in self.items()}, prefix=self.prefix)
         memo[id(self)] = new
         for key, value in self.__dict__.items():
-            if key not in new.__dict__:
+            if key not in new.__dict__ and key != "_col_step":
                 new.__dict__[key] = deepcopy(value, memo)
         return new
 
     def __reduce__(self):
-        return (type(self), (dict(self), self.prefix), self.__dict__.copy())
+        state = {k: v for k, v in self.__dict__.items() if k != "_col_step"}
+        return (type(self), (dict(self), self.prefix), state)
 
     def __setstate__(self, state: dict) -> None:
         self.__dict__.update(state)

@@ -164,6 +164,19 @@ def _traced_attr_writes(cls: type) -> Optional[frozenset]:
                     and isinstance(node.func.value, ast.Name)
                     and node.func.value.id == "self"
                 ):
+                    # self._append("name", v) writes exactly the named state
+                    # (its internal setattr would otherwise fail the scan) —
+                    # trusted only for the base implementation; an override
+                    # could side-write, so it goes through the normal scan
+                    if (
+                        node.func.attr == "_append"
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)
+                        and getattr(cls, "_append", None) is Metric._append
+                    ):
+                        writes.add(node.args[0].value)
+                        continue
                     if not scan(node.func.attr):
                         return False
         return True
@@ -728,6 +741,7 @@ class Metric(ABC):
         new.update = new._wrap_update(new._update_impl)
         new.compute = new._wrap_compute(new._compute_impl)
         new._jitted_step = None
+        new._jitted_step_fc = None
         return new
 
     # ------------------------------------------------------- device / shards

@@ -30,8 +30,12 @@ def _auc_compute(x: Array, y: Array, reorder: bool = False) -> Array:
 
     dx = x[1:] - x[:-1]
     if is_concrete(dx):
-        if bool(jnp.any(dx < 0)):
-            if bool(jnp.all(dx <= 0)):
+        # both direction conditions in ONE device readback
+        import numpy as np
+
+        any_neg, all_nonpos = np.asarray(jnp.stack([jnp.any(dx < 0), jnp.all(dx <= 0)]))
+        if any_neg:
+            if all_nonpos:
                 direction = -1.0
             else:
                 raise ValueError(

@@ -71,7 +71,8 @@ def _resolve_case(preds: Array, target: Array) -> Tuple[DataType, int]:
             case = DataType.MULTILABEL
         else:
             case = DataType.MULTIDIM_MULTICLASS
-        implied_classes = int(jnp.prod(jnp.asarray(preds.shape[1:]))) if preds.ndim > 1 else 1
+        # shapes are host ints — no device op for a static product
+        implied_classes = int(np.prod(preds.shape[1:])) if preds.ndim > 1 else 1
     elif preds.ndim == target.ndim + 1:
         if not preds_float:
             raise ValueError("If `preds` have one dimension more than `target`, `preds` should be a float tensor.")

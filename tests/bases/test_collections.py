@@ -132,3 +132,77 @@ def test_collection_pure_joint_update():
     out = pure.compute(state)
     assert float(out["DummyMetricSum"]) == 10
     assert float(out["DummyMetricDiff"]) == -3
+
+
+def test_collection_fused_single_dispatch():
+    """With jit on, the whole collection's forward runs as one jitted step
+    (update + merge + batch values), matching the per-metric path exactly."""
+    import numpy as np
+    import metrics_tpu
+    from metrics_tpu import Accuracy, F1, Precision, Recall
+
+    old = metrics_tpu.set_default_jit(True)
+    try:
+        rng = np.random.RandomState(0)
+        logits = rng.rand(10, 32, 5).astype(np.float32)
+        probs = logits / logits.sum(-1, keepdims=True)
+        target = rng.randint(0, 5, (10, 32))
+
+        def build():
+            return MetricCollection([
+                Accuracy(),
+                F1(num_classes=5, average="macro"),
+                Precision(num_classes=5, average="macro"),
+                Recall(num_classes=5, average="macro"),
+            ])
+
+        fused = build()
+        assert fused._collection_fusable()
+        step_values = [fused(jnp.asarray(probs[i]), jnp.asarray(target[i])) for i in range(10)]
+        assert fused.__dict__.get("_col_step") is not None  # the fused path ran
+
+        metrics_tpu.set_default_jit(False)
+        eager = build()
+        for i in range(10):
+            want = eager(jnp.asarray(probs[i]), jnp.asarray(target[i]))
+            for k in want:
+                np.testing.assert_allclose(
+                    np.asarray(step_values[i][k]), np.asarray(want[k]), atol=1e-6
+                )
+        for k, v in fused.compute().items():
+            np.testing.assert_allclose(np.asarray(v), np.asarray(eager.compute()[k]), atol=1e-6)
+    finally:
+        metrics_tpu.set_default_jit(old)
+
+
+def test_collection_fused_membership_change_and_clone():
+    """The fused step is rebuilt when membership changes, and cloned
+    metrics/collections forward correctly."""
+    import numpy as np
+    import metrics_tpu
+    from metrics_tpu import Accuracy, Precision
+
+    old = metrics_tpu.set_default_jit(True)
+    try:
+        rng = np.random.RandomState(0)
+        probs = jnp.asarray(rng.rand(32, 5).astype(np.float32))
+        target = jnp.asarray(rng.randint(0, 5, 32))
+
+        mc = MetricCollection([Accuracy()])
+        mc(probs, target)
+        mc["Precision"] = Precision(num_classes=5, average="macro")
+        out = mc(probs, target)  # must rebuild, not crash on the stale step
+        assert set(out) == {"Accuracy", "Precision"}
+
+        # cloned metric forwards (regression: deepcopy must reset the fused step)
+        m = Accuracy()
+        m(probs, target)
+        c = m.clone()
+        c(probs, target)
+        assert abs(float(c.compute()) - float(m.compute())) < 1e-6
+
+        mc2 = mc.clone()
+        out2 = mc2(probs, target)
+        assert set(out2) == {"Accuracy", "Precision"}
+    finally:
+        metrics_tpu.set_default_jit(old)
