@@ -47,5 +47,12 @@ from metrics_tpu.regression import (  # noqa: E402
     MeanSquaredLogError,
     R2Score,
 )
-from metrics_tpu.retrieval import RetrievalMAP, RetrievalMetric, RetrievalNormalizedDCG  # noqa: E402
+from metrics_tpu.retrieval import (  # noqa: E402
+    RetrievalMAP,
+    RetrievalMetric,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRecall,
+)
 from metrics_tpu import functional  # noqa: E402

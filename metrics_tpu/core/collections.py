@@ -53,6 +53,17 @@ class MetricCollection(OrderedDict):
 
         self.prefix = self._check_prefix_arg(prefix)
 
+    def __setitem__(self, key: str, value: Metric) -> None:
+        # generation guards the fused-step cache against id() reuse: a freed
+        # child's address can be recycled by its replacement, which would make
+        # the (key, id) membership tuple compare equal across a swap
+        self.__dict__["_col_generation"] = self.__dict__.get("_col_generation", 0) + 1
+        super().__setitem__(key, value)
+
+    def __delitem__(self, key: str) -> None:
+        self.__dict__["_col_generation"] = self.__dict__.get("_col_generation", 0) + 1
+        super().__delitem__(key)
+
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """Call forward on every metric; kwargs are filtered per metric signature.
 
@@ -77,18 +88,27 @@ class MetricCollection(OrderedDict):
         )
 
     def _forward_fused_collection(self, *args: Any, **kwargs: Any) -> Optional[Dict[str, Any]]:
-        if self.__dict__.get("_col_fuse_failed"):
+        # cheap per-forward staleness key: child identity, not just names —
+        # replacing a child under the same key must drop the cached step AND
+        # any cached negative verdict (unfusable / fuse-failed)
+        membership = (self.__dict__.get("_col_generation", 0),) + tuple(
+            (k, id(m)) for k, m in self.items()
+        )
+        if self.__dict__.get("_col_membership") != membership:
+            self.__dict__["_col_membership"] = membership
+            self.__dict__["_col_step"] = None
+            self.__dict__["_col_fuse_failed"] = False
+            self.__dict__["_col_unfusable"] = False
+        if self.__dict__.get("_col_fuse_failed") or self.__dict__.get("_col_unfusable"):
             return None
         step = self.__dict__.get("_col_step")
-        if step is not None and self.__dict__.get("_col_step_keys") != tuple(self.keys()):
-            step = None  # membership changed: the cached step is stale
         if step is None:
             # the full fusability/fingerprint gate runs only at (re)build time;
-            # steady-state forwards skip straight to the cached step
+            # steady-state forwards (fused or not) never re-run it
             if not self._collection_fusable():
+                self.__dict__["_col_unfusable"] = True
                 return None
             self.__dict__["_col_step"] = step = self._build_collection_step()
-            self.__dict__["_col_step_keys"] = tuple(self.keys())
         states = {k: m._current_state() for k, m in self.items()}
         try:
             new_states, values = step(states, *args, **kwargs)
@@ -149,18 +169,22 @@ class MetricCollection(OrderedDict):
         mc.prefix = self._check_prefix_arg(prefix)
         return mc
 
+    # fused-step cache attrs never travel to copies/pickles: the copy's
+    # membership key differs, so it re-derives its own verdict lazily
+    _COL_CACHE_ATTRS = ("_col_step", "_col_membership", "_col_fuse_failed", "_col_unfusable")
+
     def __deepcopy__(self, memo: dict) -> "MetricCollection":
         # dict-subclass default reduce would re-invoke __init__ with an items
         # iterator; rebuild explicitly (type(self) keeps subclasses intact)
         new = type(self)({k: deepcopy(m, memo) for k, m in self.items()}, prefix=self.prefix)
         memo[id(self)] = new
         for key, value in self.__dict__.items():
-            if key not in new.__dict__ and key != "_col_step":
+            if key not in new.__dict__ and key not in self._COL_CACHE_ATTRS:
                 new.__dict__[key] = deepcopy(value, memo)
         return new
 
     def __reduce__(self):
-        state = {k: v for k, v in self.__dict__.items() if k != "_col_step"}
+        state = {k: v for k, v in self.__dict__.items() if k not in self._COL_CACHE_ATTRS}
         return (type(self), (dict(self), self.prefix), state)
 
     def __setstate__(self, state: dict) -> None:

@@ -32,3 +32,6 @@ from metrics_tpu.functional.nlp import bleu_score
 from metrics_tpu.functional.self_supervised import embedding_similarity
 from metrics_tpu.functional.retrieval.average_precision import retrieval_average_precision
 from metrics_tpu.functional.retrieval.ndcg import retrieval_normalized_dcg
+from metrics_tpu.functional.retrieval.precision import retrieval_precision
+from metrics_tpu.functional.retrieval.recall import retrieval_recall
+from metrics_tpu.functional.retrieval.reciprocal_rank import retrieval_reciprocal_rank

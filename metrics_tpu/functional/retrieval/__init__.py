@@ -1,8 +1,13 @@
 from metrics_tpu.functional.retrieval.average_precision import retrieval_average_precision
 from metrics_tpu.functional.retrieval.ndcg import retrieval_normalized_dcg
+from metrics_tpu.functional.retrieval.precision import retrieval_precision
+from metrics_tpu.functional.retrieval.recall import retrieval_recall
+from metrics_tpu.functional.retrieval.reciprocal_rank import retrieval_reciprocal_rank
 from metrics_tpu.functional.retrieval.segments import (
     grouped_average_precision,
     grouped_ndcg,
+    grouped_reciprocal_rank,
+    grouped_topk_hits,
     segment_positions,
     sort_by_query_then_score,
     within_segment_cumsum,

@@ -1,0 +1,27 @@
+"""Single-query retrieval precision (at k).
+
+Extension beyond the reference snapshot; semantics match the later
+torchmetrics ``retrieval_precision``: hits within the top-k ranked documents
+divided by ``k`` (``k=None`` means the whole query).
+"""
+from typing import Optional
+
+from jax import Array
+
+from metrics_tpu.functional.retrieval.utils import check_retrieval_inputs, check_topk, topk_hits
+
+
+def retrieval_precision(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """Fraction of the top-k ranked documents that are relevant.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([0.2, 0.3, 0.5])
+        >>> target = jnp.array([True, False, True])
+        >>> float(retrieval_precision(preds, target, k=2))
+        0.5
+    """
+    check_retrieval_inputs(preds, target)
+    check_topk(k)
+    hits, _, k_eff = topk_hits(preds, target, k)
+    return hits / k_eff

@@ -1,0 +1,28 @@
+"""Single-query retrieval recall (at k).
+
+Extension beyond the reference snapshot; semantics match the later
+torchmetrics ``retrieval_recall``: hits within the top-k ranked documents
+divided by the total number of relevant documents.
+"""
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.retrieval.utils import check_retrieval_inputs, check_topk, topk_hits
+
+
+def retrieval_recall(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """Fraction of all relevant documents found in the top-k ranking.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([0.2, 0.3, 0.5])
+        >>> target = jnp.array([True, False, True])
+        >>> float(retrieval_recall(preds, target, k=1))
+        0.5
+    """
+    check_retrieval_inputs(preds, target)
+    check_topk(k)
+    hits, total, _ = topk_hits(preds, target, k)
+    return jnp.where(total == 0, 0.0, hits / jnp.maximum(total, 1.0))

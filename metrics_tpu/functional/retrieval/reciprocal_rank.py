@@ -1,0 +1,29 @@
+"""Single-query mean reciprocal rank.
+
+Extension beyond the reference snapshot (it ships only RetrievalMAP,
+reference torchmetrics/retrieval/__init__.py); follows the same single-query
+functional contract as ``retrieval_average_precision``.
+"""
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.retrieval.utils import check_retrieval_inputs
+
+
+def retrieval_reciprocal_rank(preds: Array, target: Array) -> Array:
+    """Reciprocal rank of the first relevant document (0 if none).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([0.2, 0.3, 0.5])
+        >>> target = jnp.array([False, True, False])
+        >>> float(retrieval_reciprocal_rank(preds, target))
+        0.5
+    """
+    check_retrieval_inputs(preds, target)
+    t = target > 0  # binarize like the grouped kernels (graded = one hit)
+    order = jnp.argsort(-preds.astype(jnp.float32), stable=True)
+    sorted_t = t[order]
+    ranks = jnp.arange(1, t.shape[0] + 1, dtype=jnp.float32)
+    first = jnp.min(jnp.where(sorted_t, ranks, jnp.inf))
+    return jnp.where(jnp.isfinite(first), 1.0 / jnp.maximum(first, 1.0), 0.0)

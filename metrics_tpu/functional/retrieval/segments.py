@@ -65,18 +65,60 @@ def grouped_average_precision(dense_idx: Array, preds: Array, target: Array, num
     return ap, rel_counts
 
 
-def grouped_ndcg(dense_idx: Array, preds: Array, target: Array, num_segments: int) -> Array:
-    """Per-query NDCG (linear gain) for all queries at once."""
+def grouped_reciprocal_rank(dense_idx: Array, preds: Array, target: Array, num_segments: int) -> Array:
+    """Per-query reciprocal rank of the first relevant row (0 if none)."""
+    d, _, t = sort_by_query_then_score(dense_idx, preds, target.astype(jnp.float32))
+    ranks, _ = segment_positions(d, num_segments)
+    hit_ranks = jnp.where(t > 0, ranks.astype(jnp.float32), jnp.inf)
+    first = jax.ops.segment_min(hit_ranks, d, num_segments)
+    return jnp.where(jnp.isfinite(first), 1.0 / jnp.maximum(first, 1.0), 0.0)
+
+
+def grouped_topk_hits(
+    dense_idx: Array,
+    preds: Array,
+    target: Array,
+    num_segments: int,
+    k: "int | None",
+    valid: "Array | None" = None,
+) -> Tuple[Array, Array, Array]:
+    """Per-query (hits within top-k, total relevant, valid row count).
+
+    ``k=None`` counts hits over the whole query. ``valid`` masks rows that
+    must not count toward the per-query document count (exclude sentinels);
+    such rows are assumed already neutralized (score -inf, target 0) so they
+    rank last and contribute no hits.
+    """
+    valid_f = jnp.ones_like(preds, dtype=jnp.float32) if valid is None else valid.astype(jnp.float32)
+    # binarize: graded relevance counts as a single hit (like grouped_average_precision)
+    rel = (target > 0).astype(jnp.float32)
+    d, _, t, v = sort_by_query_then_score(dense_idx, preds, rel, valid_f)
+    ranks, _ = segment_positions(d, num_segments)
+    in_topk = jnp.ones_like(t) if k is None else (ranks <= k).astype(jnp.float32)
+    hits = jax.ops.segment_sum(t * in_topk, d, num_segments)
+    rel_total = jax.ops.segment_sum(t, d, num_segments)
+    n_valid = jax.ops.segment_sum(v, d, num_segments)
+    return hits, rel_total, n_valid
+
+
+def grouped_ndcg(dense_idx: Array, preds: Array, target: Array, num_segments: int, k: "int | None" = None) -> Array:
+    """Per-query NDCG (linear gain) for all queries at once.
+
+    ``k`` truncates both the actual and the ideal ranking at the top-k rows
+    of each query (per-query ranks, so ragged query sizes are fine).
+    """
     target_f = target.astype(jnp.float32)
     d, _, t = sort_by_query_then_score(dense_idx, preds, target_f)
     ranks, _ = segment_positions(d, num_segments)
-    discounts = 1.0 / jnp.log2(ranks.astype(jnp.float32) + 1.0)
+    in_topk = 1.0 if k is None else (ranks <= k).astype(jnp.float32)
+    discounts = in_topk / jnp.log2(ranks.astype(jnp.float32) + 1.0)
     dcg = jax.ops.segment_sum(t * discounts, d, num_segments)
 
     # ideal ordering: sort by (query, target desc) and apply the same discounts
     d_i, _, t_i = sort_by_query_then_score(dense_idx, target_f, target_f)
     ranks_i, _ = segment_positions(d_i, num_segments)
-    discounts_i = 1.0 / jnp.log2(ranks_i.astype(jnp.float32) + 1.0)
+    in_topk_i = 1.0 if k is None else (ranks_i <= k).astype(jnp.float32)
+    discounts_i = in_topk_i / jnp.log2(ranks_i.astype(jnp.float32) + 1.0)
     idcg = jax.ops.segment_sum(t_i * discounts_i, d_i, num_segments)
 
     return jnp.where(idcg == 0, 0.0, dcg / jnp.where(idcg == 0, 1.0, idcg))

@@ -1,0 +1,34 @@
+"""Shared input validation + ranking helpers for single-query retrieval
+functionals (one source of truth; the module layer validates via
+``RetrievalMetric`` / ``_validate_k`` instead)."""
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def check_retrieval_inputs(preds: Array, target: Array) -> None:
+    """Common (preds, target) validation for single-query functionals."""
+    if preds.shape != target.shape:
+        raise ValueError("`preds` and `target` must have the same shape")
+    if not (target.dtype == jnp.bool_ or jnp.issubdtype(target.dtype, jnp.integer)):
+        raise ValueError("`target` must be a tensor of booleans or integers")
+
+
+def check_topk(k: Optional[int]) -> None:
+    if k is not None and (not isinstance(k, int) or k <= 0):
+        raise ValueError("`k` has to be a positive integer or None")
+
+
+def topk_hits(preds: Array, target: Array, k: Optional[int]) -> Tuple[Array, Array, int]:
+    """(hits within top-k, total relevant, effective k) for one query.
+
+    Relevance is binarized (graded targets count as single hits); ranking is
+    by descending score, stable on ties — matching the grouped kernels.
+    """
+    n = target.shape[0]
+    k_eff = n if k is None else k
+    order = jnp.argsort(-preds.astype(jnp.float32), stable=True)
+    rel = (target > 0).astype(jnp.float32)
+    hits = jnp.sum(rel[order][: min(k_eff, n)])
+    return hits, jnp.sum(rel), k_eff

@@ -20,6 +20,6 @@ class RetrievalMAP(RetrievalMetric):
         0.75
     """
 
-    def _grouped_metric(self, dense_idx: Array, preds: Array, target: Array, num_queries: int) -> Array:
+    def _grouped_metric(self, dense_idx: Array, preds: Array, target: Array, num_queries: int, valid=None) -> Array:
         ap, _ = grouped_average_precision(dense_idx, preds, target.astype(bool), num_queries)
         return ap

@@ -1,28 +1,31 @@
-"""RetrievalNormalizedDCG — new metric on the RetrievalMetric base pattern.
+"""RetrievalPrecision — precision@k on the RetrievalMetric base pattern.
 
-Not in the reference snapshot (it ships only RetrievalMAP,
-reference torchmetrics/retrieval/__init__.py); required by BASELINE.json's
-config list. Linear gain, matching sklearn's ``ndcg_score`` default.
+Extension beyond the reference snapshot; per-query semantics match the later
+torchmetrics ``RetrievalPrecision`` (hits in top-k / k).
 """
 from typing import Any, Callable, Optional
 
+import jax.numpy as jnp
 from jax import Array
 
-from metrics_tpu.functional.retrieval.segments import grouped_ndcg
+from metrics_tpu.functional.retrieval.segments import grouped_topk_hits
 from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric, _validate_k
 
 
-class RetrievalNormalizedDCG(RetrievalMetric):
-    r"""Mean NDCG over queries.
+class RetrievalPrecision(RetrievalMetric):
+    r"""Mean precision@k over queries.
+
+    With ``k=None`` each query uses its own document count as k (i.e. plain
+    precision of the whole ranking).
 
     Example:
         >>> import jax.numpy as jnp
         >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])
         >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
         >>> target = jnp.array([False, False, True, False, True, False, True])
-        >>> ndcg = RetrievalNormalizedDCG()
-        >>> round(float(ndcg(indexes, preds, target)), 4)
-        0.8467
+        >>> p2 = RetrievalPrecision(k=2)
+        >>> float(p2(indexes, preds, target))
+        0.5
     """
 
     def __init__(
@@ -46,4 +49,6 @@ class RetrievalNormalizedDCG(RetrievalMetric):
         self.k = _validate_k(k)
 
     def _grouped_metric(self, dense_idx: Array, preds: Array, target: Array, num_queries: int, valid=None) -> Array:
-        return grouped_ndcg(dense_idx, preds, target, num_queries, k=self.k)
+        hits, _, n_valid = grouped_topk_hits(dense_idx, preds, target, num_queries, self.k, valid)
+        denom = n_valid if self.k is None else jnp.full_like(n_valid, float(self.k))
+        return hits / jnp.maximum(denom, 1.0)

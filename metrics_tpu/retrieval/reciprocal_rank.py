@@ -1,0 +1,27 @@
+"""RetrievalMRR — mean reciprocal rank on the RetrievalMetric base pattern.
+
+Extension beyond the reference snapshot (it ships only RetrievalMAP,
+reference torchmetrics/retrieval/__init__.py); evaluated with the same
+vectorized sort + segment-op kernel as the other retrieval metrics.
+"""
+from jax import Array
+
+from metrics_tpu.functional.retrieval.segments import grouped_reciprocal_rank
+from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
+
+
+class RetrievalMRR(RetrievalMetric):
+    r"""Mean reciprocal rank over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.array([False, False, True, False, True, False, False])
+        >>> mrr = RetrievalMRR()
+        >>> float(mrr(indexes, preds, target))
+        0.75
+    """
+
+    def _grouped_metric(self, dense_idx: Array, preds: Array, target: Array, num_queries: int, valid=None) -> Array:
+        return grouped_reciprocal_rank(dense_idx, preds, target, num_queries)

@@ -24,6 +24,14 @@ from metrics_tpu.parallel.buffer import as_values
 IGNORE_IDX = -100
 
 
+def _validate_k(k: Optional[int]) -> Optional[int]:
+    """Shared constructor check for the top-k retrieval modules."""
+    from metrics_tpu.functional.retrieval.utils import check_topk
+
+    check_topk(k)
+    return k
+
+
 class RetrievalMetric(Metric, ABC):
     r"""Accumulate (indexes, preds, target) rows; compute the mean of a
     per-query metric over all queries.
@@ -115,7 +123,7 @@ class RetrievalMetric(Metric, ABC):
         excluded = target == self.exclude
         preds_m = jnp.where(excluded, -jnp.inf, preds)
         target_m = jnp.where(excluded, 0, target)
-        scores = self._grouped_metric(dense, preds_m, target_m, n)
+        scores = self._grouped_metric(dense, preds_m, target_m, n, valid=~excluded)
 
         if self.query_without_relevant_docs == "error" and bool(flag):
             raise ValueError(
@@ -136,5 +144,16 @@ class RetrievalMetric(Metric, ABC):
         return present / jnp.maximum(jnp.sum(exists), 1)
 
     @abstractmethod
-    def _grouped_metric(self, dense_idx: Array, preds: Array, target: Array, num_queries: int) -> Array:
-        """Vectorized per-query scores, shape (num_queries,)."""
+    def _grouped_metric(
+        self,
+        dense_idx: Array,
+        preds: Array,
+        target: Array,
+        num_queries: int,
+        valid: Optional[Array] = None,
+    ) -> Array:
+        """Vectorized per-query scores, shape (num_queries,).
+
+        ``valid`` marks rows that are real documents (False = exclude
+        sentinel rows, already neutralized to score -inf / target 0).
+        """

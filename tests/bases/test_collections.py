@@ -206,3 +206,62 @@ def test_collection_fused_membership_change_and_clone():
         assert set(out2) == {"Accuracy", "Precision"}
     finally:
         metrics_tpu.set_default_jit(old)
+
+
+def test_collection_fused_same_key_replacement():
+    """Replacing a child under the SAME key must drop the cached fused step —
+    the new config's values must be returned, not the old carrier's."""
+    import numpy as np
+    import metrics_tpu
+    from metrics_tpu import Precision
+
+    old = metrics_tpu.set_default_jit(True)
+    try:
+        rng = np.random.RandomState(0)
+        logits = rng.rand(32, 5).astype(np.float32)
+        probs = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+        target = jnp.asarray(rng.randint(0, 5, 32))
+
+        mc = MetricCollection({"p": Precision(num_classes=5, average="macro")})
+        macro = float(mc(probs, target)["p"])
+
+        mc["p"] = Precision(num_classes=5, average="micro")
+        micro = float(mc(probs, target)["p"])
+
+        want_micro = float(Precision(num_classes=5, average="micro")(probs, target))
+        want_macro = float(Precision(num_classes=5, average="macro")(probs, target))
+        np.testing.assert_allclose(micro, want_micro, atol=1e-6)
+        assert abs(want_micro - want_macro) > 1e-4  # the configs genuinely differ
+        np.testing.assert_allclose(macro, want_macro, atol=1e-6)
+        # and the replacement's own accumulator holds exactly one batch
+        np.testing.assert_allclose(float(mc["p"].compute()), want_micro, atol=1e-6)
+    finally:
+        metrics_tpu.set_default_jit(old)
+
+
+def test_collection_unfusable_verdict_cached_and_cleared():
+    """A non-fusable collection caches the negative verdict (no per-forward
+    gate re-runs), and replacing the offending child re-enables fusion."""
+    import metrics_tpu
+    from metrics_tpu import Accuracy
+
+    old = metrics_tpu.set_default_jit(True)
+    try:
+        probs = jnp.asarray(np.random.RandomState(0).rand(8, 5).astype(np.float32))
+        target = jnp.asarray(np.random.RandomState(1).randint(0, 5, 8))
+
+        mc = MetricCollection({"a": Accuracy(), "b": Accuracy(dist_sync_on_step=True)})
+        mc(probs, target)
+        assert mc.__dict__.get("_col_unfusable") is True
+        assert mc.__dict__.get("_col_step") is None
+        # gate must not re-run per forward: poison it to prove it is skipped
+        mc._collection_fusable = lambda: (_ for _ in ()).throw(AssertionError("gate re-ran"))
+        mc(probs, target)
+        del mc._collection_fusable
+
+        # replacing the offending child clears the verdict and fuses
+        mc["b"] = Accuracy()
+        mc(probs, target)
+        assert mc.__dict__.get("_col_step") is not None
+    finally:
+        metrics_tpu.set_default_jit(old)

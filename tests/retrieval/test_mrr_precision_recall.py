@@ -1,0 +1,139 @@
+"""RetrievalMRR / RetrievalPrecision / RetrievalRecall vs numpy oracles
+(same harness shape as tests/retrieval/test_map.py; oracles are direct
+per-query numpy rankings)."""
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.functional.retrieval import (
+    retrieval_precision,
+    retrieval_recall,
+    retrieval_reciprocal_rank,
+)
+from metrics_tpu.retrieval import RetrievalMRR, RetrievalPrecision, RetrievalRecall
+
+
+def _np_rank_order(preds):
+    # descending score, stable on ties — matches the device kernels
+    return np.argsort(-preds, kind="stable")
+
+
+def _np_mrr(target, preds):
+    t = target[_np_rank_order(preds)]
+    hits = np.flatnonzero(t)
+    return 0.0 if hits.size == 0 else 1.0 / (hits[0] + 1)
+
+
+def _np_precision(target, preds, k=None):
+    n = len(target)
+    k_eff = n if k is None else k
+    t = target[_np_rank_order(preds)]
+    return t[: min(k_eff, n)].sum() / k_eff
+
+
+def _np_recall(target, preds, k=None):
+    n = len(target)
+    k_eff = n if k is None else k
+    t = target[_np_rank_order(preds)]
+    total = target.sum()
+    return 0.0 if total == 0 else t[: min(k_eff, n)].sum() / total
+
+
+def _mean_over_queries(oracle, target, preds, behaviour, **kw):
+    out = []
+    for t, p in zip(target, preds):
+        if t.sum() == 0:
+            if behaviour == "skip":
+                continue
+            out.append(1.0 if behaviour == "pos" else 0.0)
+        else:
+            out.append(oracle(t, p, **kw))
+    return np.mean(out) if out else np.array(0.0)
+
+
+@pytest.mark.parametrize("size", [1, 4, 10])
+@pytest.mark.parametrize("n_queries", [1, 5])
+@pytest.mark.parametrize("behaviour", ["skip", "pos", "neg"])
+@pytest.mark.parametrize(
+    "metric_cls,oracle,kw",
+    [
+        (RetrievalMRR, _np_mrr, {}),
+        (RetrievalPrecision, _np_precision, {}),
+        (RetrievalPrecision, _np_precision, {"k": 2}),
+        (RetrievalRecall, _np_recall, {}),
+        (RetrievalRecall, _np_recall, {"k": 2}),
+    ],
+)
+def test_results_vs_numpy_oracle(size, n_queries, behaviour, metric_cls, oracle, kw):
+    seed = size + n_queries * 10
+    np.random.seed(seed)
+    random.seed(seed)
+
+    target = [np.random.randint(0, 2, size=(size,)) for _ in range(n_queries)]
+    preds = [np.random.randn(size) for _ in range(n_queries)]
+    expected = _mean_over_queries(oracle, target, preds, behaviour, **kw)
+
+    metric = metric_cls(query_without_relevant_docs=behaviour, **kw)
+    for i, (p, t) in enumerate(zip(preds, target)):
+        metric.update(
+            jnp.asarray(np.full(size, i)), jnp.asarray(p.astype(np.float32)), jnp.asarray(t)
+        )
+    np.testing.assert_allclose(float(metric.compute()), expected, atol=1e-6)
+
+
+@pytest.mark.parametrize(
+    "fn,oracle",
+    [
+        (retrieval_reciprocal_rank, _np_mrr),
+        (retrieval_precision, _np_precision),
+        (retrieval_recall, _np_recall),
+    ],
+)
+def test_functional_vs_numpy_oracle(fn, oracle):
+    np.random.seed(7)
+    for _ in range(5):
+        t = np.random.randint(0, 2, size=(12,))
+        p = np.random.randn(12)
+        if t.sum() == 0:
+            t[3] = 1
+        np.testing.assert_allclose(
+            float(fn(jnp.asarray(p.astype(np.float32)), jnp.asarray(t))),
+            oracle(t, p),
+            atol=1e-6,
+        )
+
+
+@pytest.mark.parametrize("k", [1, 3, 12, 20])
+def test_functional_topk_vs_numpy_oracle(k):
+    np.random.seed(11)
+    t = np.random.randint(0, 2, size=(12,))
+    p = np.random.randn(12)
+    np.testing.assert_allclose(
+        float(retrieval_precision(jnp.asarray(p.astype(np.float32)), jnp.asarray(t), k=k)),
+        _np_precision(t, p, k=k),
+        atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        float(retrieval_recall(jnp.asarray(p.astype(np.float32)), jnp.asarray(t), k=k)),
+        _np_recall(t, p, k=k),
+        atol=1e-6,
+    )
+
+
+def test_exclude_sentinel_rows_do_not_count():
+    # precision with k=None divides by the count of REAL rows only
+    metric = RetrievalPrecision()
+    idx = jnp.array([0, 0, 0, 0])
+    preds = jnp.array([0.9, 0.8, 0.7, 0.6])
+    target = jnp.array([1, 0, -100, -100])
+    np.testing.assert_allclose(float(metric(idx, preds, target)), 0.5, atol=1e-6)
+
+
+def test_bad_k_raises():
+    for cls in (RetrievalPrecision, RetrievalRecall):
+        with pytest.raises(ValueError, match="positive integer"):
+            cls(k=0)
+    with pytest.raises(ValueError, match="positive integer"):
+        retrieval_precision(jnp.array([0.1]), jnp.array([1]), k=-1)

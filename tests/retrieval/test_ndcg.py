@@ -54,6 +54,22 @@ def test_vectorized_matches_single_query():
     np.testing.assert_allclose(grouped, np.mean(singles), atol=1e-6)
 
 
+@pytest.mark.parametrize("k", [1, 3, 8, 20])
+def test_topk_module_vs_sklearn(k):
+    rng = np.random.RandomState(5)
+    n_queries, size = 4, 12
+    metric = RetrievalNormalizedDCG(k=k)
+    per_query = []
+    for q in range(n_queries):
+        preds = rng.rand(size).astype(np.float32)
+        target = rng.randint(0, 3, size)
+        if target.sum() == 0:
+            target[0] = 1
+        per_query.append(ndcg_score(target[None], preds[None], k=k))
+        metric.update(jnp.full(size, q), jnp.asarray(preds), jnp.asarray(target))
+    np.testing.assert_allclose(float(metric.compute()), np.mean(per_query), atol=1e-5)
+
+
 def test_invalid_k():
     with pytest.raises(ValueError, match="positive integer"):
         RetrievalNormalizedDCG(k=-1)
