@@ -21,7 +21,9 @@ def _cohen_kappa_compute(confmat: Array, weights: Optional[str] = None) -> Array
     n_classes = confmat.shape[0]
     sum0 = jnp.sum(confmat, axis=0, keepdims=True)
     sum1 = jnp.sum(confmat, axis=1, keepdims=True)
-    expected = sum1 @ sum0 / jnp.sum(sum0)
+    # broadcast outer product, not a (C,1)@(1,C) matmul: the MXU's bf16 input
+    # truncation rounds marginal counts above 2^8, skewing expected freqs
+    expected = sum1 * sum0 / jnp.sum(sum0)
 
     if weights is None:
         w_mat = 1.0 - jnp.eye(n_classes, dtype=confmat.dtype)

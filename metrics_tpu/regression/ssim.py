@@ -1,21 +1,39 @@
 """SSIM module.
 
 Parity: reference torchmetrics/regression/ssim.py:24 — cat-states holding all
-raw images (:77-78), so memory grows with the dataset. To bound memory with
-jit-safe PaddedBuffer states instead, pass both ``capacity`` (max number of
-images) and ``image_shape`` (C, H, W).
+raw images (:77-78), so memory grows with the dataset. Two TPU-native
+alternatives bound that memory:
+
+- **streaming** (automatic when ``data_range`` is given and ``reduction`` is
+  ``elementwise_mean``/``sum``): the per-pixel SSIM map is reduced at every
+  ``update`` into two scalar sum-states — O(1) memory, jit-fusable, and
+  cross-device sync is a single ``psum``. Numerically identical to the
+  stored-image compute (the global mean of concatenated maps is the ratio of
+  accumulated sum and count).
+- **bounded buffers**: pass ``capacity`` (max number of images) and
+  ``image_shape`` (C, H, W) to keep reference semantics (e.g. inferred
+  ``data_range``) with a fixed-size jit-safe PaddedBuffer.
 """
 from typing import Any, Optional, Sequence, Tuple
 
+import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.core.metric import Metric
-from metrics_tpu.functional.regression.ssim import _ssim_compute, _ssim_update
+from metrics_tpu.functional.regression.ssim import (
+    _check_ssim_params,
+    _ssim_compute,
+    _ssim_map,
+    _ssim_update,
+)
 from metrics_tpu.utils.prints import rank_zero_warn
 
 
 class SSIM(Metric):
-    """Accumulated structural similarity (stores all images; memory grows with data).
+    """Accumulated structural similarity.
+
+    With a static ``data_range`` and a mean/sum reduction the metric streams
+    (O(1) sum-states); otherwise it stores images like the reference.
 
     Example:
         >>> import jax.numpy as jnp
@@ -39,6 +57,7 @@ class SSIM(Metric):
         process_group: Optional[Any] = None,
         capacity: Optional[int] = None,
         image_shape: Optional[Tuple[int, int, int]] = None,
+        streaming: Optional[bool] = None,
     ):
         super().__init__(
             compute_on_step=compute_on_step,
@@ -46,14 +65,39 @@ class SSIM(Metric):
             process_group=process_group,
             capacity=capacity,
         )
-        rank_zero_warn(
-            "Metric `SSIM` will save all targets and"
-            " predictions in buffer. For large datasets this may lead"
-            " to large memory footprint."
-        )
+        _check_ssim_params(kernel_size, sigma)
 
-        self.add_state("y", default=[], dist_reduce_fx=None, item_shape=image_shape)
-        self.add_state("y_pred", default=[], dist_reduce_fx=None, item_shape=image_shape)
+        can_stream = data_range is not None and reduction in ("elementwise_mean", "sum")
+        if streaming and not can_stream:
+            raise ValueError(
+                "`streaming=True` needs a static `data_range` and reduction"
+                " 'elementwise_mean' or 'sum' (the per-update map reduction is"
+                " exact only for those)."
+            )
+        if streaming is None:
+            # an explicit bounded-buffer request wins over auto-streaming:
+            # the caller asked for stored-image states
+            streaming = can_stream and capacity is None and image_shape is None
+        self.streaming = streaming
+
+        if self.streaming:
+            import numpy as np
+
+            from metrics_tpu.utils.data import accum_int_dtype
+
+            self.add_state("similarity", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+            # pixel counter in the package-wide accumulator dtype (int64 under
+            # x64): int32 wraps at ~15k RGB 224x224 images, exactly the scale
+            # streaming exists for; the shared overflow probe warns before that
+            self.add_state("total", default=np.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
+        else:
+            rank_zero_warn(
+                "Metric `SSIM` will save all targets and"
+                " predictions in buffer. For large datasets this may lead"
+                " to large memory footprint."
+            )
+            self.add_state("y", default=[], dist_reduce_fx=None, item_shape=image_shape)
+            self.add_state("y_pred", default=[], dist_reduce_fx=None, item_shape=image_shape)
         self.kernel_size = kernel_size
         self.sigma = sigma
         self.data_range = data_range
@@ -63,10 +107,21 @@ class SSIM(Metric):
 
     def update(self, preds: Array, target: Array) -> None:
         preds, target = _ssim_update(preds, target)
-        self._append("y_pred", preds)
-        self._append("y", target)
+        if self.streaming:
+            idx = _ssim_map(
+                preds, target, self.kernel_size, self.sigma, self.data_range, self.k1, self.k2
+            )
+            self.similarity = self.similarity + jnp.sum(idx)
+            self.total = self.total + idx.size
+        else:
+            self._append("y_pred", preds)
+            self._append("y", target)
 
     def compute(self) -> Array:
+        if self.streaming:
+            if self.reduction == "sum":
+                return self.similarity
+            return self.similarity / jnp.maximum(self.total, 1)
         from metrics_tpu.parallel.buffer import as_values
 
         preds = as_values(self.y_pred)

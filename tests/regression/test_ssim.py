@@ -76,7 +76,8 @@ class TestSSIM(MetricTester):
 
     @pytest.mark.parametrize("ddp", [False, True])
     @pytest.mark.parametrize("dist_sync_on_step", [False])
-    def test_ssim(self, preds, target, ddp, dist_sync_on_step):
+    @pytest.mark.parametrize("streaming", [False, True])
+    def test_ssim(self, preds, target, ddp, dist_sync_on_step, streaming):
         # NUM_BATCHES/BATCH_SIZE overridden locally: patch module constants scope
         import tests.helpers.testers as T
 
@@ -90,7 +91,7 @@ class TestSSIM(MetricTester):
                 metric_class=SSIM,
                 sk_metric=partial(_np_ssim, data_range=1.0),
                 dist_sync_on_step=dist_sync_on_step,
-                metric_args={"data_range": 1.0},
+                metric_args={"data_range": 1.0, "streaming": streaming},
             )
         finally:
             T.NUM_BATCHES = old[0]
@@ -123,3 +124,50 @@ def test_ssim_invalid_inputs():
 
     with pytest.raises(ValueError):
         ssim(jnp.zeros((1, 1, 16, 16)), jnp.zeros((1, 1, 16, 16)), kernel_size=(11, 10))
+
+
+def test_ssim_streaming_matches_stored_and_bounds_state():
+    """Streaming (O(1)-state) SSIM equals the stored-image compute, keeps
+    scalar states, and auto-enables only when exact."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(3)
+    batches = [
+        (rng.rand(2, 1, 24, 24).astype(np.float32), rng.rand(2, 1, 24, 24).astype(np.float32))
+        for _ in range(3)
+    ]
+
+    stream = SSIM(data_range=1.0)  # auto-streams
+    stored = SSIM(data_range=1.0, streaming=False)
+    assert stream.streaming and not stored.streaming
+    for p, t in batches:
+        stream.update(jnp.asarray(p), jnp.asarray(t))
+        stored.update(jnp.asarray(p), jnp.asarray(t))
+    np.testing.assert_allclose(float(stream.compute()), float(stored.compute()), atol=1e-5)
+    assert stream.similarity.shape == () and stream.total.shape == ()
+
+    # inferred data_range cannot stream (needs the global min/max)
+    assert not SSIM().streaming
+    with pytest.raises(ValueError, match="streaming"):
+        SSIM(streaming=True)
+    with pytest.raises(ValueError, match="streaming"):
+        SSIM(data_range=1.0, reduction="none", streaming=True)
+
+    # sum reduction streams too
+    s_sum = SSIM(data_range=1.0, reduction="sum")
+    assert s_sum.streaming
+    p, t = batches[0]
+    s_sum.update(jnp.asarray(p), jnp.asarray(t))
+    want = float(SSIM(data_range=1.0, reduction="sum", streaming=False)(jnp.asarray(p), jnp.asarray(t)))
+    np.testing.assert_allclose(float(s_sum.compute()), want, rtol=1e-5)
+
+    # an explicit bounded-buffer request (capacity/image_shape) wins over
+    # auto-streaming: the caller asked for stored-image states
+    bounded = SSIM(data_range=1.0, capacity=8, image_shape=(1, 24, 24))
+    assert not bounded.streaming
+    bounded.update(jnp.asarray(p), jnp.asarray(t))
+    np.testing.assert_allclose(
+        float(bounded.compute()),
+        float(SSIM(data_range=1.0, streaming=False)(jnp.asarray(p), jnp.asarray(t))),
+        atol=1e-6,
+    )
