@@ -30,6 +30,26 @@ if _platform == "cpu":
 # JAX_COMPILATION_CACHE_DIR in the env wins if set.
 import jax
 
+
+def _host_tag() -> str:
+    # XLA:CPU AOT executables bake in host ISA features and reloading them on
+    # a different machine can SIGILL; key the cache dir by a fingerprint of
+    # the host so a workspace reused across machines never cross-loads
+    import hashlib
+    import platform
+
+    raw = platform.machine() + platform.processor()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    raw += line
+                    break
+    except OSError:
+        pass
+    return hashlib.sha256(raw.encode()).hexdigest()[:12]
+
+
 jax.config.update(
     "jax_compilation_cache_dir",
     os.environ.get(
@@ -37,6 +57,7 @@ jax.config.update(
         os.path.join(
             os.path.dirname(os.path.abspath(__file__)),
             ".jax_cache" if _platform == "cpu" else ".jax_cache_tpu",
+            _host_tag(),
         ),
     ),
 )

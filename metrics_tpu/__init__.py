@@ -36,6 +36,7 @@ from metrics_tpu.classification import (  # noqa: E402
     PrecisionRecallCurve,
     Recall,
     ROC,
+    Specificity,
     StatScores,
 )
 from metrics_tpu.regression import (  # noqa: E402
@@ -45,6 +46,7 @@ from metrics_tpu.regression import (  # noqa: E402
     MeanAbsoluteError,
     MeanSquaredError,
     MeanSquaredLogError,
+    PearsonCorrcoef,
     R2Score,
 )
 from metrics_tpu.retrieval import (  # noqa: E402

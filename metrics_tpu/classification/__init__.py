@@ -13,6 +13,7 @@ from metrics_tpu.classification.confusion_matrix import ConfusionMatrix
 from metrics_tpu.classification.f_beta import F1, FBeta
 from metrics_tpu.classification.hamming_distance import HammingDistance
 from metrics_tpu.classification.iou import IoU
+from metrics_tpu.classification.specificity import Specificity
 from metrics_tpu.classification.matthews_corrcoef import MatthewsCorrcoef
 from metrics_tpu.classification.precision_recall import Precision, Recall
 from metrics_tpu.classification.precision_recall_curve import PrecisionRecallCurve

@@ -1,0 +1,64 @@
+"""Specificity module (subclass of StatScores).
+
+Extension beyond the reference snapshot (later torchmetrics ships it);
+mirrors the Precision/Recall pattern in classification/precision_recall.py.
+"""
+from typing import Any, Callable, Optional
+
+from jax import Array
+
+from metrics_tpu.classification.stat_scores import StatScores
+from metrics_tpu.functional.classification.precision_recall import _ALLOWED_AVERAGE
+from metrics_tpu.functional.classification.specificity import _specificity_compute
+
+
+class Specificity(StatScores):
+    r"""Specificity = TN / (TN + FP), accumulated over batches.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([2, 0, 2, 1])
+        >>> target = jnp.array([1, 1, 2, 0])
+        >>> spec = Specificity(average='macro', num_classes=3)
+        >>> round(float(spec(preds, target)), 4)
+        0.6111
+        >>> spec = Specificity(average='micro')
+        >>> float(spec(preds, target))
+        0.625
+    """
+
+    def __init__(
+        self,
+        num_classes: Optional[int] = None,
+        threshold: float = 0.5,
+        average: str = "micro",
+        mdmc_average: Optional[str] = None,
+        ignore_index: Optional[int] = None,
+        top_k: Optional[int] = None,
+        is_multiclass: Optional[bool] = None,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        if average not in _ALLOWED_AVERAGE:
+            raise ValueError(f"The `average` has to be one of {_ALLOWED_AVERAGE}, got {average}.")
+
+        super().__init__(
+            reduce="macro" if average in ["weighted", "none", None] else average,
+            mdmc_reduce=mdmc_average,
+            threshold=threshold,
+            top_k=top_k,
+            num_classes=num_classes,
+            is_multiclass=is_multiclass,
+            ignore_index=ignore_index,
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.average = average
+
+    def compute(self) -> Array:
+        tp, fp, tn, fn = self._get_final_stats()
+        return _specificity_compute(tp, fp, tn, fn, self.average, self.mdmc_reduce)

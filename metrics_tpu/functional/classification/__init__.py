@@ -16,6 +16,7 @@ from metrics_tpu.functional.classification.hamming_distance import hamming_dista
 from metrics_tpu.functional.classification.iou import iou
 from metrics_tpu.functional.classification.matthews_corrcoef import matthews_corrcoef
 from metrics_tpu.functional.classification.precision_recall import precision, precision_recall, recall
+from metrics_tpu.functional.classification.specificity import specificity
 from metrics_tpu.functional.classification.precision_recall_curve import precision_recall_curve
 from metrics_tpu.functional.classification.roc import roc
 from metrics_tpu.functional.classification.stat_scores import stat_scores

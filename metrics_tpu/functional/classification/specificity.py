@@ -1,0 +1,62 @@
+"""Specificity functional kernel.
+
+Extension beyond the reference snapshot (later torchmetrics ships it); built
+on the same stat-scores reduction machinery as precision/recall
+(``_reduce_stat_scores``, classification/stat_scores.py).
+"""
+from typing import Optional
+
+from jax import Array
+
+from metrics_tpu.classification.stat_scores import _reduce_stat_scores
+from metrics_tpu.functional.classification.precision_recall import _check_prf_args
+from metrics_tpu.functional.classification.stat_scores import _stat_scores_update
+
+
+def _specificity_compute(tp: Array, fp: Array, tn: Array, fn: Array, average: str, mdmc_average: Optional[str]) -> Array:
+    return _reduce_stat_scores(
+        numerator=tn,
+        denominator=tn + fp,
+        weights=None if average != "weighted" else tn + fp,
+        average=average,
+        mdmc_average=mdmc_average,
+    )
+
+
+def specificity(
+    preds: Array,
+    target: Array,
+    average: str = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    is_multiclass: Optional[bool] = None,
+) -> Array:
+    r"""Specificity = TN / (TN + FP), with micro/macro/weighted/none/samples averaging.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([2, 0, 2, 1])
+        >>> target = jnp.array([1, 1, 2, 0])
+        >>> round(float(specificity(preds, target, average='macro', num_classes=3)), 4)
+        0.6111
+        >>> float(specificity(preds, target, average='micro'))
+        0.625
+    """
+    _check_prf_args(average, mdmc_average, num_classes, ignore_index)
+
+    reduce = "macro" if average in ["weighted", "none", None] else average
+    tp, fp, tn, fn = _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_average,
+        threshold=threshold,
+        num_classes=num_classes,
+        top_k=top_k,
+        is_multiclass=is_multiclass,
+        ignore_index=ignore_index,
+    )
+    return _specificity_compute(tp, fp, tn, fn, average, mdmc_average)

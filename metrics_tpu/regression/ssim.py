@@ -7,9 +7,9 @@ alternatives bound that memory:
 - **streaming** (automatic when ``data_range`` is given and ``reduction`` is
   ``elementwise_mean``/``sum``): the per-pixel SSIM map is reduced at every
   ``update`` into two scalar sum-states — O(1) memory, jit-fusable, and
-  cross-device sync is a single ``psum``. Numerically identical to the
-  stored-image compute (the global mean of concatenated maps is the ratio of
-  accumulated sum and count).
+  cross-device sync is a single ``psum``. Equal to the stored-image compute
+  up to float32 summation order (the global mean of concatenated maps is the
+  ratio of accumulated sum and count).
 - **bounded buffers**: pass ``capacity`` (max number of images) and
   ``image_shape`` (C, H, W) to keep reference semantics (e.g. inferred
   ``data_range``) with a fixed-size jit-safe PaddedBuffer.

@@ -1,0 +1,108 @@
+"""Specificity vs a sklearn multilabel_confusion_matrix oracle.
+
+Extension metric (not in the reference snapshot); the oracle derives
+TN / (TN + FP) per class from sklearn's confusion matrices on the library's
+own formatted binary (N, C) inputs — the same adapter pattern the
+precision/recall tests use.
+"""
+from functools import partial
+
+import numpy as np
+import pytest
+from sklearn.metrics import multilabel_confusion_matrix
+
+from metrics_tpu import Specificity
+from metrics_tpu.functional import specificity
+from metrics_tpu.utils.checks import _input_format_classification
+from tests.classification.inputs import (
+    _input_binary,
+    _input_binary_prob,
+    _input_multiclass as _input_mcls,
+    _input_multiclass_prob as _input_mcls_prob,
+    _input_multilabel as _input_mlb,
+    _input_multilabel_prob as _input_mlb_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _sk_specificity(preds, target, num_classes, average, is_multiclass):
+    sk_preds, sk_target, _ = _input_format_classification(
+        preds, target, THRESHOLD, num_classes=num_classes, is_multiclass=is_multiclass
+    )
+    sk_preds, sk_target = np.asarray(sk_preds), np.asarray(sk_target)
+    if num_classes == 1:
+        # one formatted column = one positive class (label 1); sklearn would
+        # otherwise reinterpret the vector as a {0,1} multiclass problem
+        mcm = multilabel_confusion_matrix(sk_target.reshape(-1), sk_preds.reshape(-1), labels=[1])
+    else:
+        mcm = multilabel_confusion_matrix(sk_target, sk_preds)
+    tn, fp = mcm[:, 0, 0].astype(np.float64), mcm[:, 0, 1].astype(np.float64)
+
+    if average == "micro":
+        denom = tn.sum() + fp.sum()
+        return tn.sum() / denom if denom > 0 else 0.0
+    denom = tn + fp
+    per_class = np.where(denom > 0, tn / np.where(denom > 0, denom, 1.0), 0.0)
+    if average == "macro":
+        return per_class.mean()
+    if average == "weighted":
+        return (per_class * denom).sum() / denom.sum() if denom.sum() > 0 else 0.0
+    return per_class  # 'none'
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted", "none"])
+@pytest.mark.parametrize(
+    "preds, target, num_classes, is_multiclass",
+    [
+        (_input_binary.preds, _input_binary.target, 1, False),
+        (_input_binary_prob.preds, _input_binary_prob.target, 1, None),
+        (_input_mcls.preds, _input_mcls.target, NUM_CLASSES, None),
+        (_input_mcls_prob.preds, _input_mcls_prob.target, NUM_CLASSES, None),
+        (_input_mlb.preds, _input_mlb.target, NUM_CLASSES, False),
+        (_input_mlb_prob.preds, _input_mlb_prob.target, NUM_CLASSES, None),
+    ],
+)
+class TestSpecificity(MetricTester):
+    atol = 1e-6  # f32 kernel vs f64 oracle
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_specificity_class(self, preds, target, num_classes, is_multiclass, average, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=Specificity,
+            sk_metric=partial(
+                _sk_specificity, num_classes=num_classes, average=average, is_multiclass=is_multiclass
+            ),
+            dist_sync_on_step=False,
+            metric_args={
+                "num_classes": num_classes,
+                "average": average,
+                "threshold": THRESHOLD,
+                "is_multiclass": is_multiclass,
+            },
+        )
+
+    def test_specificity_fn(self, preds, target, num_classes, is_multiclass, average):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=specificity,
+            sk_metric=partial(
+                _sk_specificity, num_classes=num_classes, average=average, is_multiclass=is_multiclass
+            ),
+            metric_args={
+                "num_classes": num_classes,
+                "average": average,
+                "threshold": THRESHOLD,
+                "is_multiclass": is_multiclass,
+            },
+        )
+
+
+def test_specificity_wrong_average():
+    with pytest.raises(ValueError, match="`average`"):
+        Specificity(average="wrong")
+    with pytest.raises(ValueError, match="`average`"):
+        specificity(np.zeros(4), np.zeros(4), average="wrong")

@@ -1,0 +1,60 @@
+"""PearsonCorrcoef vs scipy.stats.pearsonr."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.stats import pearsonr
+
+from metrics_tpu import PearsonCorrcoef
+from metrics_tpu.functional import pearson_corrcoef
+from tests.helpers.testers import MetricTester
+
+_rng = np.random.RandomState(17)
+NUM_BATCHES, BATCH_SIZE = 10, 32
+
+_preds = _rng.randn(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+# correlated target so r is far from 0
+_target = (0.6 * _preds + 0.4 * _rng.randn(NUM_BATCHES, BATCH_SIZE)).astype(np.float32)
+
+
+def _sk_pearson(preds, target):
+    return pearsonr(np.asarray(target).reshape(-1), np.asarray(preds).reshape(-1))[0]
+
+
+class TestPearson(MetricTester):
+    atol = 1e-4  # f32 raw-moment accumulation vs f64 scipy
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("dist_sync_on_step", [False, True])
+    def test_pearson_class(self, ddp, dist_sync_on_step):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_preds,
+            target=_target,
+            metric_class=PearsonCorrcoef,
+            sk_metric=_sk_pearson,
+            dist_sync_on_step=dist_sync_on_step,
+        )
+
+    def test_pearson_functional(self):
+        self.run_functional_metric_test(
+            _preds, _target, metric_functional=pearson_corrcoef, sk_metric=_sk_pearson
+        )
+
+
+def test_pearson_accumulation_matches_global():
+    m = PearsonCorrcoef()
+    for i in range(NUM_BATCHES):
+        m.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+    want = _sk_pearson(_preds, _target)
+    np.testing.assert_allclose(float(m.compute()), want, atol=1e-4)
+
+
+def test_pearson_errors_and_edge_cases():
+    m = PearsonCorrcoef()
+    with pytest.raises(ValueError, match="1D"):
+        m.update(jnp.zeros((4, 2)), jnp.zeros((4, 2)))
+    with pytest.raises(RuntimeError, match="same shape"):
+        pearson_corrcoef(jnp.zeros(3), jnp.zeros(4))
+    # constant input: zero variance -> r defined as 0, not nan/inf
+    r = pearson_corrcoef(jnp.ones(8), jnp.arange(8.0))
+    assert float(r) == 0.0
