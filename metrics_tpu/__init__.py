@@ -50,6 +50,8 @@ from metrics_tpu.regression import (  # noqa: E402
     R2Score,
 )
 from metrics_tpu.retrieval import (  # noqa: E402
+    RetrievalFallOut,
+    RetrievalHitRate,
     RetrievalMAP,
     RetrievalMetric,
     RetrievalMRR,

@@ -33,6 +33,8 @@ from metrics_tpu.functional.image_gradients import image_gradients
 from metrics_tpu.functional.nlp import bleu_score
 from metrics_tpu.functional.self_supervised import embedding_similarity
 from metrics_tpu.functional.retrieval.average_precision import retrieval_average_precision
+from metrics_tpu.functional.retrieval.fall_out import retrieval_fall_out
+from metrics_tpu.functional.retrieval.hit_rate import retrieval_hit_rate
 from metrics_tpu.functional.retrieval.ndcg import retrieval_normalized_dcg
 from metrics_tpu.functional.retrieval.precision import retrieval_precision
 from metrics_tpu.functional.retrieval.recall import retrieval_recall

@@ -13,7 +13,9 @@ from metrics_tpu.functional.classification.precision_recall import _check_prf_ar
 from metrics_tpu.functional.classification.stat_scores import _stat_scores_update
 
 
-def _specificity_compute(tp: Array, fp: Array, tn: Array, fn: Array, average: str, mdmc_average: Optional[str]) -> Array:
+def _specificity_compute(
+    tp: Array, fp: Array, tn: Array, fn: Array, average: str, mdmc_average: Optional[str]
+) -> Array:
     return _reduce_stat_scores(
         numerator=tn,
         denominator=tn + fp,

@@ -19,7 +19,7 @@ from metrics_tpu.utils.checks import _check_same_shape
 
 def _pearson_update(preds: Array, target: Array) -> Tuple[Array, Array, Array, Array, Array, Array]:
     _check_same_shape(preds, target)
-    if preds.ndim > 1:
+    if preds.ndim != 1:
         raise ValueError("Expected both `preds` and `target` to be 1D arrays of scalar predictions")
     x = preds.astype(jnp.float32)
     y = target.astype(jnp.float32)

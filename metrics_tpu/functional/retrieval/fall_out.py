@@ -1,0 +1,28 @@
+"""Single-query fall-out (at k). Extension beyond the reference snapshot."""
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.retrieval.utils import check_retrieval_inputs, check_topk
+
+
+def retrieval_fall_out(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """Fraction of NON-relevant documents that rank in the top-k (0 if none).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([0.2, 0.3, 0.5])
+        >>> target = jnp.array([True, False, False])
+        >>> float(retrieval_fall_out(preds, target, k=1))
+        0.5
+    """
+    check_retrieval_inputs(preds, target)
+    check_topk(k)
+    n = target.shape[0]
+    k_eff = n if k is None else k
+    order = jnp.argsort(-preds.astype(jnp.float32), stable=True)
+    neg = (target <= 0).astype(jnp.float32)
+    false_topk = jnp.sum(neg[order][: min(k_eff, n)])
+    total_neg = jnp.sum(neg)
+    return jnp.where(total_neg == 0, 0.0, false_topk / jnp.maximum(total_neg, 1.0))

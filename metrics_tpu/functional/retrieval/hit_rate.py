@@ -1,0 +1,23 @@
+"""Single-query hit rate (at k). Extension beyond the reference snapshot."""
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.retrieval.utils import check_retrieval_inputs, check_topk, topk_hits
+
+
+def retrieval_hit_rate(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """1.0 if any relevant document ranks in the top-k, else 0.0.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([0.2, 0.3, 0.5])
+        >>> target = jnp.array([True, False, False])
+        >>> float(retrieval_hit_rate(preds, target, k=1))
+        0.0
+    """
+    check_retrieval_inputs(preds, target)
+    check_topk(k)
+    hits, _, _ = topk_hits(preds, target, k)
+    return (hits > 0).astype(jnp.float32)

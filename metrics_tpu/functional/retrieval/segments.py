@@ -101,6 +101,28 @@ def grouped_topk_hits(
     return hits, rel_total, n_valid
 
 
+def grouped_hit_rate(
+    dense_idx: Array, preds: Array, target: Array, num_segments: int, k: "int | None", valid: "Array | None" = None
+) -> Array:
+    """Per-query hit rate: 1.0 if any relevant row ranks in the top-k."""
+    hits, _, _ = grouped_topk_hits(dense_idx, preds, target, num_segments, k, valid)
+    return (hits > 0).astype(jnp.float32)
+
+
+def grouped_fall_out(
+    dense_idx: Array, preds: Array, target: Array, num_segments: int, k: "int | None", valid: "Array | None" = None
+) -> Array:
+    """Per-query fall-out: fraction of NON-relevant docs ranked in the top-k."""
+    valid_f = jnp.ones_like(preds, dtype=jnp.float32) if valid is None else valid.astype(jnp.float32)
+    neg = (target <= 0).astype(jnp.float32) * valid_f
+    d, _, n = sort_by_query_then_score(dense_idx, preds, neg)
+    ranks, _ = segment_positions(d, num_segments)
+    in_topk = jnp.ones_like(n) if k is None else (ranks <= k).astype(jnp.float32)
+    false_topk = jax.ops.segment_sum(n * in_topk, d, num_segments)
+    neg_total = jax.ops.segment_sum(n, d, num_segments)
+    return jnp.where(neg_total == 0, 0.0, false_topk / jnp.maximum(neg_total, 1.0))
+
+
 def grouped_ndcg(dense_idx: Array, preds: Array, target: Array, num_segments: int, k: "int | None" = None) -> Array:
     """Per-query NDCG (linear gain) for all queries at once.
 

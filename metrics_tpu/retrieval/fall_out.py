@@ -1,0 +1,61 @@
+"""RetrievalFallOut — extension beyond the reference snapshot.
+
+Fall-out is the false-positive analogue of recall: the fraction of
+NON-relevant documents that rank in the top-k. The empty-query policy
+(``query_without_relevant_docs``) therefore applies to queries with no
+non-relevant documents — the inverse of the other retrieval metrics.
+"""
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.retrieval.segments import grouped_fall_out
+from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric, _validate_k
+
+
+class RetrievalFallOut(RetrievalMetric):
+    r"""Mean fall-out@k (non-relevant docs in the top-k / total non-relevant).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.array([False, False, True, False, True, False, True])
+        >>> fo1 = RetrievalFallOut(k=1)
+        >>> float(fo1(indexes, preds, target))
+        0.25
+    """
+
+    def __init__(
+        self,
+        query_without_relevant_docs: str = "skip",
+        exclude: int = -100,
+        k: Optional[int] = None,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            query_without_relevant_docs=query_without_relevant_docs,
+            exclude=exclude,
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.k = _validate_k(k)
+
+    _EMPTY_QUERY_ERROR = "without non-relevant targets"
+
+    def _empty_query_mask(self, dense_idx: Array, target: Array, exists: Array, num_queries: int) -> Array:
+        # fall-out is undefined for queries with no NON-relevant valid rows
+        import jax
+
+        valid_neg = ((target <= 0) & (target != self.exclude)).astype(jnp.float32)
+        neg_counts = jax.ops.segment_sum(valid_neg, dense_idx, num_queries)
+        return (neg_counts == 0) & exists
+
+    def _grouped_metric(self, dense_idx: Array, preds: Array, target: Array, num_queries: int, valid=None) -> Array:
+        return grouped_fall_out(dense_idx, preds, target, num_queries, self.k, valid)

@@ -1,0 +1,44 @@
+"""RetrievalHitRate — extension beyond the reference snapshot."""
+from typing import Any, Callable, Optional
+
+from jax import Array
+
+from metrics_tpu.functional.retrieval.segments import grouped_hit_rate
+from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric, _validate_k
+
+
+class RetrievalHitRate(RetrievalMetric):
+    r"""Fraction of queries with at least one relevant document in the top-k.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.array([False, False, True, False, True, False, True])
+        >>> hr1 = RetrievalHitRate(k=1)
+        >>> float(hr1(indexes, preds, target))
+        0.5
+    """
+
+    def __init__(
+        self,
+        query_without_relevant_docs: str = "skip",
+        exclude: int = -100,
+        k: Optional[int] = None,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            query_without_relevant_docs=query_without_relevant_docs,
+            exclude=exclude,
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.k = _validate_k(k)
+
+    def _grouped_metric(self, dense_idx: Array, preds: Array, target: Array, num_queries: int, valid=None) -> Array:
+        return grouped_hit_rate(dense_idx, preds, target, num_queries, self.k, valid)

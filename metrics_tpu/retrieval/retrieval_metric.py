@@ -106,9 +106,7 @@ class RetrievalMetric(Metric, ABC):
         counts = jax.ops.segment_sum(jnp.ones((n,), jnp.float32), dense, n)
         exists = counts > 0
 
-        # empty-query policy uses RAW target sums (reference :121 quirk)
-        raw_sums = jax.ops.segment_sum(target.astype(jnp.float32), dense, n)
-        empty = (raw_sums == 0) & exists
+        empty = self._empty_query_mask(dense, target, exists, n)
 
         if self.query_without_relevant_docs == "error":
             flag = jnp.any(empty)
@@ -127,7 +125,7 @@ class RetrievalMetric(Metric, ABC):
 
         if self.query_without_relevant_docs == "error" and bool(flag):
             raise ValueError(
-                f"`{self.__class__.__name__}.compute()` was provided with a query without positive targets"
+                f"`{self.__class__.__name__}.compute()` was provided with a query {self._EMPTY_QUERY_ERROR}"
             )
 
         if self.query_without_relevant_docs == "pos":
@@ -142,6 +140,23 @@ class RetrievalMetric(Metric, ABC):
 
         present = jnp.sum(jnp.where(exists, scores, 0.0))
         return present / jnp.maximum(jnp.sum(exists), 1)
+
+    # what the 'error' policy reports; subclasses overriding _empty_query_mask
+    # override this to match their condition
+    _EMPTY_QUERY_ERROR = "without positive targets"
+
+    def _empty_query_mask(self, dense_idx: Array, target: Array, exists: Array, num_queries: int) -> Array:
+        """Queries the ``query_without_relevant_docs`` policy applies to.
+
+        Default: no positive rows, judged on RAW target sums (reference :121
+        quirk — exclude sentinels make a query count as non-empty). Metrics
+        whose per-query score is undefined for a different reason (e.g.
+        fall-out needs non-relevant rows) override this.
+        """
+        import jax
+
+        raw_sums = jax.ops.segment_sum(target.astype(jnp.float32), dense_idx, num_queries)
+        return (raw_sums == 0) & exists
 
     @abstractmethod
     def _grouped_metric(

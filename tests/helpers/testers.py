@@ -103,17 +103,17 @@ def _oracle(sk_metric: Callable, preds: np.ndarray, target: np.ndarray, **kwargs
     return _ORACLE_CACHE[key][1]
 
 
-def _assert_allclose(jax_result: Any, sk_result: Any, atol: float = 1e-8) -> None:
+def _assert_allclose(jax_result: Any, sk_result: Any, atol: float = 1e-8, rtol: float = 1e-7) -> None:
     if isinstance(jax_result, (list, tuple)):
         assert len(jax_result) == len(sk_result)
         for j, s in zip(jax_result, sk_result):
-            _assert_allclose(j, s, atol=atol)
+            _assert_allclose(j, s, atol=atol, rtol=rtol)
         return
     if isinstance(jax_result, dict):
         for key in jax_result:
-            _assert_allclose(jax_result[key], sk_result[key], atol=atol)
+            _assert_allclose(jax_result[key], sk_result[key], atol=atol, rtol=rtol)
         return
-    np.testing.assert_allclose(np.asarray(jax_result), np.asarray(sk_result), atol=atol)
+    np.testing.assert_allclose(np.asarray(jax_result), np.asarray(sk_result), atol=atol, rtol=rtol)
 
 
 class BarrierGather:
@@ -188,6 +188,7 @@ class MetricTester:
     """Test a metric class/functional against an sklearn oracle over batched fixtures."""
 
     atol: float = 1e-8
+    rtol: float = 1e-7
 
     def run_functional_metric_test(
         self,
@@ -205,7 +206,7 @@ class MetricTester:
                 jnp.asarray(preds[i]), jnp.asarray(target[i]), **metric_args, **kwargs_update
             )
             sk_result = _oracle(sk_metric, preds[i], target[i], **kwargs_update)
-            _assert_allclose(jax_result, sk_result, atol=self.atol)
+            _assert_allclose(jax_result, sk_result, atol=self.atol, rtol=self.rtol)
 
     def run_class_metric_test(
         self,
@@ -247,9 +248,19 @@ class MetricTester:
                     # batch value was synced: compare against the union of this step's batches
                     union_preds = np.concatenate([preds[j] for j in idxs])
                     union_target = np.concatenate([target[j] for j in idxs])
-                    _assert_allclose(batch_results[rank], _oracle(sk_metric, union_preds, union_target), atol=self.atol)
+                    _assert_allclose(
+                        batch_results[rank],
+                        _oracle(sk_metric, union_preds, union_target),
+                        atol=self.atol,
+                        rtol=self.rtol,
+                    )
                 elif check_batch and not dist_sync_on_step:
-                    _assert_allclose(batch_results[rank], _oracle(sk_metric, preds[i], target[i]), atol=self.atol)
+                    _assert_allclose(
+                        batch_results[rank],
+                        _oracle(sk_metric, preds[i], target[i]),
+                        atol=self.atol,
+                        rtol=self.rtol,
+                    )
 
         # final compute must equal the oracle on ALL batches on every rank
         total_preds = np.concatenate([preds[i] for i in range(NUM_BATCHES)])
@@ -258,7 +269,7 @@ class MetricTester:
         computes = [(lambda m=m: m.compute()) for m in world]
         final = _run_in_threads(computes) if world_size > 1 else [computes[0]()]
         for result in final:
-            _assert_allclose(result, sk_result, atol=self.atol)
+            _assert_allclose(result, sk_result, atol=self.atol, rtol=self.rtol)
 
 
 class DummyMetric(Metric):

@@ -69,7 +69,10 @@ def _sk_psnr(preds, target, data_range, base, dim, reduction="elementwise_mean")
     [(None, "elementwise_mean"), ((1, 2), "elementwise_mean")],
 )
 class TestPSNR(MetricTester):
+    # TPU transcendental (log) rounding differs from CPU at the ~4e-5
+    # relative level; PSNR spans 1.8..30+ dB, so the bound is relative
     atol = 1e-4
+    rtol = 1e-4
 
     @pytest.mark.parametrize("ddp", [False])
     @pytest.mark.parametrize("dist_sync_on_step", [False])

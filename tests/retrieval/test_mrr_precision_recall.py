@@ -131,6 +131,106 @@ def test_exclude_sentinel_rows_do_not_count():
     np.testing.assert_allclose(float(metric(idx, preds, target)), 0.5, atol=1e-6)
 
 
+def _np_hit_rate(target, preds, k=None):
+    n = len(target)
+    k_eff = n if k is None else k
+    t = target[_np_rank_order(preds)]
+    return 1.0 if t[: min(k_eff, n)].sum() > 0 else 0.0
+
+
+def _np_fall_out(target, preds, k=None):
+    n = len(target)
+    k_eff = n if k is None else k
+    neg = 1 - target
+    order = _np_rank_order(preds)
+    total_neg = neg.sum()
+    return 0.0 if total_neg == 0 else neg[order][: min(k_eff, n)].sum() / total_neg
+
+
+@pytest.mark.parametrize("size", [1, 4, 10])
+@pytest.mark.parametrize("n_queries", [1, 5])
+@pytest.mark.parametrize("k", [None, 2])
+def test_hit_rate_vs_numpy_oracle(size, n_queries, k):
+    from metrics_tpu.retrieval import RetrievalHitRate
+
+    np.random.seed(size + n_queries)
+    target = [np.random.randint(0, 2, size=(size,)) for _ in range(n_queries)]
+    preds = [np.random.randn(size) for _ in range(n_queries)]
+    expected = _mean_over_queries(_np_hit_rate, target, preds, "skip", k=k)
+
+    metric = RetrievalHitRate(k=k)
+    for i, (p, t) in enumerate(zip(preds, target)):
+        metric.update(jnp.asarray(np.full(size, i)), jnp.asarray(p.astype(np.float32)), jnp.asarray(t))
+    np.testing.assert_allclose(float(metric.compute()), expected, atol=1e-6)
+
+
+@pytest.mark.parametrize("size", [1, 4, 10])
+@pytest.mark.parametrize("n_queries", [1, 5])
+@pytest.mark.parametrize("k", [None, 2])
+def test_fall_out_vs_numpy_oracle(size, n_queries, k):
+    from metrics_tpu.retrieval import RetrievalFallOut
+
+    np.random.seed(size * 3 + n_queries)
+    target = [np.random.randint(0, 2, size=(size,)) for _ in range(n_queries)]
+    preds = [np.random.randn(size) for _ in range(n_queries)]
+
+    # fall-out's policy applies to queries with no NON-relevant docs
+    out = []
+    for t, p in zip(target, preds):
+        if (1 - t).sum() == 0:
+            continue  # 'skip'
+        out.append(_np_fall_out(t, p, k=k))
+    expected = np.mean(out) if out else 0.0
+
+    metric = RetrievalFallOut(k=k)
+    for i, (p, t) in enumerate(zip(preds, target)):
+        metric.update(jnp.asarray(np.full(size, i)), jnp.asarray(p.astype(np.float32)), jnp.asarray(t))
+    np.testing.assert_allclose(float(metric.compute()), expected, atol=1e-6)
+
+
+@pytest.mark.parametrize("k", [None, 1, 2, 20])
+def test_functional_hit_rate_fall_out_vs_numpy(k):
+    from metrics_tpu.functional.retrieval import retrieval_fall_out, retrieval_hit_rate
+
+    np.random.seed(23)
+    for _ in range(4):
+        t = np.random.randint(0, 2, size=(9,))
+        p = np.random.randn(9)
+        if t.sum() == 0:
+            t[0] = 1
+        if (1 - t).sum() == 0:
+            t[1] = 0
+        np.testing.assert_allclose(
+            float(retrieval_hit_rate(jnp.asarray(p.astype(np.float32)), jnp.asarray(t), k=k)),
+            _np_hit_rate(t, p, k=k),
+            atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            float(retrieval_fall_out(jnp.asarray(p.astype(np.float32)), jnp.asarray(t), k=k)),
+            _np_fall_out(t, p, k=k),
+            atol=1e-6,
+        )
+
+
+def test_fall_out_error_policy_message():
+    from metrics_tpu.retrieval import RetrievalFallOut
+
+    metric = RetrievalFallOut(query_without_relevant_docs="error")
+    metric.update(jnp.array([0, 0]), jnp.array([0.1, 0.2]), jnp.array([1, 1]))  # all relevant
+    with pytest.raises(ValueError, match="without non-relevant targets"):
+        metric.compute()
+
+
+def test_fall_out_exclude_sentinels_ignored():
+    from metrics_tpu.retrieval import RetrievalFallOut
+
+    metric = RetrievalFallOut(k=1)
+    idx = jnp.array([0, 0, 0, 0])
+    preds = jnp.array([0.9, 0.8, 0.7, 0.6])
+    target = jnp.array([0, 1, -100, -100])  # one real negative, ranked first
+    np.testing.assert_allclose(float(metric(idx, preds, target)), 1.0, atol=1e-6)
+
+
 def test_bad_k_raises():
     for cls in (RetrievalPrecision, RetrievalRecall):
         with pytest.raises(ValueError, match="positive integer"):
