@@ -40,6 +40,7 @@ from metrics_tpu.classification import (  # noqa: E402
     StatScores,
 )
 from metrics_tpu.regression import (  # noqa: E402
+    CosineSimilarity,
     PSNR,
     SSIM,
     ExplainedVariance,

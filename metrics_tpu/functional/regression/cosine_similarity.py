@@ -1,0 +1,41 @@
+"""Per-sample cosine similarity. Extension beyond the reference snapshot."""
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def _cosine_similarity_rows(preds: Array, target: Array) -> Array:
+    _check_same_shape(preds, target)
+    if preds.ndim != 2:
+        raise ValueError("Expected `preds` and `target` to be 2D arrays of shape (N, D)")
+    x = preds.astype(jnp.float32)
+    y = target.astype(jnp.float32)
+    dot = jnp.sum(x * y, axis=1)
+    norm = jnp.linalg.norm(x, axis=1) * jnp.linalg.norm(y, axis=1)
+    return jnp.where(norm == 0, 0.0, dot / jnp.where(norm == 0, 1.0, norm))
+
+
+def cosine_similarity(preds: Array, target: Array, reduction: str = "mean") -> Array:
+    """Cosine similarity of each (pred, target) row pair, reduced over rows.
+
+    Args:
+        preds: (N, D) predictions.
+        target: (N, D) ground truth.
+        reduction: 'mean' | 'sum' | 'none'.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([[1.0, 0.0], [1.0, 1.0]])
+        >>> target = jnp.array([[1.0, 0.0], [0.0, 1.0]])
+        >>> round(float(cosine_similarity(preds, target)), 4)
+        0.8536
+    """
+    if reduction not in ("mean", "sum", "none", None):
+        raise ValueError(f"Expected reduction to be one of 'mean', 'sum', 'none', got {reduction}")
+    sim = _cosine_similarity_rows(preds, target)
+    if reduction == "mean":
+        return jnp.mean(sim)
+    if reduction == "sum":
+        return jnp.sum(sim)
+    return sim

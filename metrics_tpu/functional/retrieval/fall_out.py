@@ -4,7 +4,7 @@ from typing import Optional
 import jax.numpy as jnp
 from jax import Array
 
-from metrics_tpu.functional.retrieval.utils import check_retrieval_inputs, check_topk
+from metrics_tpu.functional.retrieval.utils import check_retrieval_inputs, check_topk, topk_mask_count
 
 
 def retrieval_fall_out(preds: Array, target: Array, k: Optional[int] = None) -> Array:
@@ -19,10 +19,5 @@ def retrieval_fall_out(preds: Array, target: Array, k: Optional[int] = None) -> 
     """
     check_retrieval_inputs(preds, target)
     check_topk(k)
-    n = target.shape[0]
-    k_eff = n if k is None else k
-    order = jnp.argsort(-preds.astype(jnp.float32), stable=True)
-    neg = (target <= 0).astype(jnp.float32)
-    false_topk = jnp.sum(neg[order][: min(k_eff, n)])
-    total_neg = jnp.sum(neg)
+    false_topk, total_neg, _ = topk_mask_count(preds, (target <= 0).astype(jnp.float32), k)
     return jnp.where(total_neg == 0, 0.0, false_topk / jnp.maximum(total_neg, 1.0))

@@ -20,15 +20,23 @@ def check_topk(k: Optional[int]) -> None:
         raise ValueError("`k` has to be a positive integer or None")
 
 
+def topk_mask_count(preds: Array, mask: Array, k: Optional[int]) -> Tuple[Array, Array, int]:
+    """(mask rows within the top-k, total mask rows, effective k).
+
+    The single source of the single-query ranking rule: descending score,
+    stable on ties, top-k truncated at the query size — matching the grouped
+    kernels.
+    """
+    n = mask.shape[0]
+    k_eff = n if k is None else k
+    order = jnp.argsort(-preds.astype(jnp.float32), stable=True)
+    in_topk = jnp.sum(mask[order][: min(k_eff, n)])
+    return in_topk, jnp.sum(mask), k_eff
+
+
 def topk_hits(preds: Array, target: Array, k: Optional[int]) -> Tuple[Array, Array, int]:
     """(hits within top-k, total relevant, effective k) for one query.
 
-    Relevance is binarized (graded targets count as single hits); ranking is
-    by descending score, stable on ties — matching the grouped kernels.
+    Relevance is binarized (graded targets count as single hits).
     """
-    n = target.shape[0]
-    k_eff = n if k is None else k
-    order = jnp.argsort(-preds.astype(jnp.float32), stable=True)
-    rel = (target > 0).astype(jnp.float32)
-    hits = jnp.sum(rel[order][: min(k_eff, n)])
-    return hits, jnp.sum(rel), k_eff
+    return topk_mask_count(preds, (target > 0).astype(jnp.float32), k)

@@ -1,0 +1,70 @@
+"""CosineSimilarity module. Extension beyond the reference snapshot.
+
+With 'mean'/'sum' reduction the metric streams (two scalar sum-states, one
+fused psum to sync); 'none' keeps a cat-state of per-sample similarities.
+"""
+from typing import Any, Callable, Optional
+
+import numpy as np
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.regression.cosine_similarity import _cosine_similarity_rows
+from metrics_tpu.utils.data import accum_int_dtype
+
+
+class CosineSimilarity(Metric):
+    r"""Accumulated per-sample cosine similarity.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([[1.0, 0.0], [1.0, 1.0]])
+        >>> target = jnp.array([[1.0, 0.0], [0.0, 1.0]])
+        >>> cos = CosineSimilarity()
+        >>> round(float(cos(preds, target)), 4)
+        0.8536
+    """
+
+    def __init__(
+        self,
+        reduction: str = "mean",
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+        capacity: Optional[int] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+            capacity=capacity,
+        )
+        if reduction not in ("mean", "sum", "none", None):
+            raise ValueError(f"Expected reduction to be one of 'mean', 'sum', 'none', got {reduction}")
+        self.reduction = reduction
+
+        if reduction in ("mean", "sum"):
+            self.add_state("sim_sum", default=np.zeros((), dtype=np.float32), dist_reduce_fx="sum")
+            self.add_state("n_total", default=np.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
+        else:
+            self.add_state("sims", default=[], dist_reduce_fx=None)
+
+    def update(self, preds: Array, target: Array) -> None:
+        sim = _cosine_similarity_rows(preds, target)
+        if self.reduction in ("mean", "sum"):
+            self.sim_sum = self.sim_sum + jnp.sum(sim)
+            self.n_total = self.n_total + sim.shape[0]
+        else:
+            self._append("sims", sim)
+
+    def compute(self) -> Array:
+        if self.reduction == "sum":
+            return self.sim_sum
+        if self.reduction == "mean":
+            return self.sim_sum / jnp.maximum(self.n_total.astype(jnp.float32), 1.0)
+        from metrics_tpu.parallel.buffer import as_values
+
+        return as_values(self.sims)

@@ -7,6 +7,7 @@ non-relevant documents — the inverse of the other retrieval metrics.
 """
 from typing import Any, Callable, Optional
 
+import jax
 import jax.numpy as jnp
 from jax import Array
 
@@ -51,8 +52,6 @@ class RetrievalFallOut(RetrievalMetric):
 
     def _empty_query_mask(self, dense_idx: Array, target: Array, exists: Array, num_queries: int) -> Array:
         # fall-out is undefined for queries with no NON-relevant valid rows
-        import jax
-
         valid_neg = ((target <= 0) & (target != self.exclude)).astype(jnp.float32)
         neg_counts = jax.ops.segment_sum(valid_neg, dense_idx, num_queries)
         return (neg_counts == 0) & exists

@@ -15,6 +15,7 @@ exclude sentinels make a query count as non-empty, reference :121).
 from abc import ABC, abstractmethod
 from typing import Any, Callable, Optional
 
+import jax
 import jax.numpy as jnp
 from jax import Array
 
@@ -93,8 +94,6 @@ class RetrievalMetric(Metric, ABC):
         # upper bound — absent segments are masked), and sentinel rows are
         # neutralized by masking instead of boolean filtering. One fused
         # device program; the only readback is the deferred 'error' check.
-        import jax
-
         n = int(idx.shape[0])
         order = jnp.argsort(idx, stable=True)
         sorted_ids = idx[order]
@@ -153,8 +152,6 @@ class RetrievalMetric(Metric, ABC):
         whose per-query score is undefined for a different reason (e.g.
         fall-out needs non-relevant rows) override this.
         """
-        import jax
-
         raw_sums = jax.ops.segment_sum(target.astype(jnp.float32), dense_idx, num_queries)
         return (raw_sums == 0) & exists
 

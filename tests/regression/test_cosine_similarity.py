@@ -1,0 +1,64 @@
+"""CosineSimilarity vs a numpy/sklearn oracle."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics.pairwise import cosine_similarity as sk_cosine
+
+from metrics_tpu import CosineSimilarity
+from metrics_tpu.functional import cosine_similarity
+from tests.helpers.testers import MetricTester
+
+_rng = np.random.RandomState(29)
+NUM_BATCHES, BATCH_SIZE, DIM = 10, 32, 8
+
+_preds = _rng.randn(NUM_BATCHES, BATCH_SIZE, DIM).astype(np.float32)
+_target = _rng.randn(NUM_BATCHES, BATCH_SIZE, DIM).astype(np.float32)
+
+
+def _sk_mean_cosine(preds, target):
+    p = np.asarray(preds).reshape(-1, DIM)
+    t = np.asarray(target).reshape(-1, DIM)
+    return np.mean([sk_cosine(p[i:i + 1], t[i:i + 1])[0, 0] for i in range(p.shape[0])])
+
+
+class TestCosineSimilarity(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_cosine_class(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_preds,
+            target=_target,
+            metric_class=CosineSimilarity,
+            sk_metric=_sk_mean_cosine,
+            dist_sync_on_step=False,
+        )
+
+    def test_cosine_functional(self):
+        self.run_functional_metric_test(
+            _preds, _target, metric_functional=cosine_similarity, sk_metric=_sk_mean_cosine
+        )
+
+
+def test_cosine_reductions():
+    p, t = jnp.asarray(_preds[0]), jnp.asarray(_target[0])
+    rows = cosine_similarity(p, t, reduction="none")
+    assert rows.shape == (BATCH_SIZE,)
+    np.testing.assert_allclose(float(jnp.sum(rows)), float(cosine_similarity(p, t, reduction="sum")), atol=1e-5)
+    np.testing.assert_allclose(float(jnp.mean(rows)), float(cosine_similarity(p, t, reduction="mean")), atol=1e-5)
+
+    m = CosineSimilarity(reduction="none")
+    m.update(p, t)
+    m.update(p, t)
+    assert m.compute().shape == (2 * BATCH_SIZE,)
+
+
+def test_cosine_errors_and_zero_norm():
+    with pytest.raises(ValueError, match="2D"):
+        cosine_similarity(jnp.zeros(4), jnp.zeros(4))
+    with pytest.raises(ValueError, match="reduction"):
+        CosineSimilarity(reduction="max")
+    # zero-norm rows give 0, not nan
+    out = cosine_similarity(jnp.zeros((2, 3)), jnp.ones((2, 3)), reduction="none")
+    assert not np.any(np.isnan(np.asarray(out)))
