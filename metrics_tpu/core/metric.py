@@ -733,7 +733,13 @@ class Metric(ABC):
             if k in skip:
                 continue
             if isinstance(v, (jnp.ndarray, Array)) or isinstance(v, PaddedBuffer):
-                new.__dict__[k] = v  # immutable device arrays are safe to share
+                if k in self._defaults:
+                    # registered states are DONATED by the fused jitted step on
+                    # TPU: clone and original must not alias the same buffer,
+                    # or the first donated step invalidates the other's state
+                    new.__dict__[k] = jax.tree_util.tree_map(lambda a: jnp.array(a, copy=True), v)
+                else:
+                    new.__dict__[k] = v  # non-state device arrays are never donated
             else:
                 new.__dict__[k] = deepcopy(v, memo)
         new._update_impl = cls.update.__get__(new)

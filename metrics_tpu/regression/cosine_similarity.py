@@ -50,7 +50,9 @@ class CosineSimilarity(Metric):
             self.add_state("sim_sum", default=np.zeros((), dtype=np.float32), dist_reduce_fx="sum")
             self.add_state("n_total", default=np.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
         else:
-            self.add_state("sims", default=[], dist_reduce_fx=None)
+            # per-row scalars: item_shape=() lets `capacity` build the
+            # jit-safe PaddedBuffer instead of an eager list
+            self.add_state("sims", default=[], dist_reduce_fx=None, item_shape=())
 
     def update(self, preds: Array, target: Array) -> None:
         sim = _cosine_similarity_rows(preds, target)

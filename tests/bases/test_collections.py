@@ -208,6 +208,33 @@ def test_collection_fused_membership_change_and_clone():
         metrics_tpu.set_default_jit(old)
 
 
+def test_clone_states_do_not_alias():
+    """Clone and original must own distinct state buffers: the TPU fused step
+    DONATES the state argument, so a shared buffer would be invalidated for
+    whichever object steps second (reproduced on real TPU as INVALID_ARGUMENT
+    reads after clone-then-forward)."""
+    import metrics_tpu
+    from metrics_tpu import Accuracy
+
+    old = metrics_tpu.set_default_jit(True)
+    try:
+        probs = jnp.asarray(np.random.RandomState(0).rand(8, 5).astype(np.float32))
+        target = jnp.asarray(np.random.RandomState(1).randint(0, 5, 8))
+        m = Accuracy()
+        m(probs, target)
+        c = m.clone()
+        for name in m._defaults:
+            a, b = getattr(m, name), getattr(c, name)
+            assert a is not b, name
+        # both sides keep working independently after each other's steps
+        c(probs, target)
+        m(probs, target)
+        assert abs(float(m.compute()) - float(Accuracy()(probs, target))) < 1e-6
+        assert abs(float(c.compute()) - float(m.compute())) < 1e-6
+    finally:
+        metrics_tpu.set_default_jit(old)
+
+
 def test_collection_fused_same_key_replacement():
     """Replacing a child under the SAME key must drop the cached fused step —
     the new config's values must be returned, not the old carrier's."""

@@ -46,6 +46,7 @@ def _sk_hamming_loss(preds, target):
     ],
 )
 class TestHammingDistance(MetricTester):
+    atol = 1e-6  # f32 division on TPU differs from the f64 oracle in the last ulp
 
     @pytest.mark.parametrize("ddp", [False, True])
     @pytest.mark.parametrize("dist_sync_on_step", [False])
