@@ -13,7 +13,7 @@ the reference quirk that the empty-query check sums *raw* targets (so ``-100``
 exclude sentinels make a query count as non-empty, reference :121).
 """
 from abc import ABC, abstractmethod
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -23,6 +23,11 @@ from metrics_tpu.core.metric import Metric
 from metrics_tpu.parallel.buffer import as_values
 
 IGNORE_IDX = -100
+
+# jitted epoch-compute shared across config-identical instances (fresh metric
+# per eval epoch must not retrace); bounded FIFO like the core step cache
+_COMPUTE_JIT_CACHE: Dict[Any, Callable] = {}
+_COMPUTE_JIT_CACHE_MAX = 64
 
 
 def _validate_k(k: Optional[int]) -> Optional[int]:
@@ -89,11 +94,45 @@ class RetrievalMetric(Metric, ABC):
         if idx.shape[0] == 0:
             return jnp.asarray(0.0)
 
-        # Everything below is static-shape: query ids densify via sort+cumsum
-        # (no jnp.unique host sync), the segment count is the row count N (an
-        # upper bound — absent segments are masked), and sentinel rows are
-        # neutralized by masking instead of boolean filtering. One fused
-        # device program; the only readback is the deferred 'error' check.
+        # Eager dispatch pays per-op latency through the device tunnel
+        # (~25ms/op under load), so when jit is enabled the whole epoch
+        # compute runs as ONE dispatch. Gate on the jit *setting*, not
+        # _jittable: list cat-states make the UPDATE un-jittable, but compute
+        # receives concatenated fixed-shape arrays and is always jit-safe.
+        # The jitted callable is shared across config-identical instances
+        # (fresh metric per eval epoch must not pay a retrace).
+        fn = self._device_compute
+        if self._jit is not False and not self._jit_failed:
+            from metrics_tpu.core.metric import _bounded_insert
+
+            key = self._compute_cache_key()
+            fn = _COMPUTE_JIT_CACHE.get(key)
+            if fn is None:
+                # close over a detached reset copy, not the live instance:
+                # the cache must pin only empty default states, never an
+                # epoch's worth of accumulated cat-state buffers
+                from copy import deepcopy
+
+                carrier = deepcopy(self)
+                carrier.reset()
+                fn = jax.jit(carrier._device_compute)
+                _bounded_insert(_COMPUTE_JIT_CACHE, key, fn, _COMPUTE_JIT_CACHE_MAX)
+        result, flag = fn(idx, preds, target)
+
+        if self.query_without_relevant_docs == "error" and bool(flag):
+            raise ValueError(
+                f"`{self.__class__.__name__}.compute()` was provided with a query {self._EMPTY_QUERY_ERROR}"
+            )
+        return result
+
+    def _device_compute(self, idx: Array, preds: Array, target: Array):
+        """(result, empty-query flag) as one static-shape device program.
+
+        Query ids densify via sort+cumsum (no jnp.unique host sync), the
+        segment count is the row count N (an upper bound — absent segments
+        are masked), and sentinel rows are neutralized by masking instead of
+        boolean filtering, so the whole body is jit-safe.
+        """
         n = int(idx.shape[0])
         order = jnp.argsort(idx, stable=True)
         sorted_ids = idx[order]
@@ -106,13 +145,7 @@ class RetrievalMetric(Metric, ABC):
         exists = counts > 0
 
         empty = self._empty_query_mask(dense, target, exists, n)
-
-        if self.query_without_relevant_docs == "error":
-            flag = jnp.any(empty)
-            try:
-                flag.copy_to_host_async()
-            except (AttributeError, RuntimeError):
-                pass
+        flag = jnp.any(empty)
 
         # sentinel rows must not rank, hit, or grade: -inf scores sink them
         # below every real row of their query, zero targets null their gain
@@ -122,11 +155,6 @@ class RetrievalMetric(Metric, ABC):
         target_m = jnp.where(excluded, 0, target)
         scores = self._grouped_metric(dense, preds_m, target_m, n, valid=~excluded)
 
-        if self.query_without_relevant_docs == "error" and bool(flag):
-            raise ValueError(
-                f"`{self.__class__.__name__}.compute()` was provided with a query {self._EMPTY_QUERY_ERROR}"
-            )
-
         if self.query_without_relevant_docs == "pos":
             scores = jnp.where(empty, 1.0, scores)
         elif self.query_without_relevant_docs == "neg":
@@ -135,10 +163,19 @@ class RetrievalMetric(Metric, ABC):
             kept = exists & ~empty
             total = jnp.sum(jnp.where(kept, scores, 0.0))
             n_kept = jnp.sum(kept)
-            return jnp.where(n_kept == 0, 0.0, total / jnp.maximum(n_kept, 1))
+            return jnp.where(n_kept == 0, 0.0, total / jnp.maximum(n_kept, 1)), flag
 
         present = jnp.sum(jnp.where(exists, scores, 0.0))
-        return present / jnp.maximum(jnp.sum(exists), 1)
+        return present / jnp.maximum(jnp.sum(exists), 1), flag
+
+    def _compute_cache_key(self) -> tuple:
+        """Key for sharing the jitted compute across instances.
+
+        Covers every attribute the traced ``_device_compute`` reads; a
+        subclass that adds trace-affecting config beyond ``k`` MUST extend
+        this, or config-identical-looking instances would share one trace.
+        """
+        return (type(self), self.query_without_relevant_docs, self.exclude, getattr(self, "k", None))
 
     # what the 'error' policy reports; subclasses overriding _empty_query_mask
     # override this to match their condition
