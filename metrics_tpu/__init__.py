@@ -49,6 +49,7 @@ from metrics_tpu.regression import (  # noqa: E402
     MeanSquaredLogError,
     PearsonCorrcoef,
     R2Score,
+    SpearmanCorrcoef,
 )
 from metrics_tpu.retrieval import (  # noqa: E402
     RetrievalFallOut,

@@ -29,6 +29,7 @@ from metrics_tpu.functional.regression.mean_squared_log_error import mean_square
 from metrics_tpu.functional.regression.pearson import pearson_corrcoef
 from metrics_tpu.functional.regression.psnr import psnr
 from metrics_tpu.functional.regression.r2score import r2score
+from metrics_tpu.functional.regression.spearman import spearman_corrcoef
 from metrics_tpu.functional.regression.ssim import ssim
 from metrics_tpu.functional.image_gradients import image_gradients
 from metrics_tpu.functional.nlp import bleu_score

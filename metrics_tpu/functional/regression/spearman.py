@@ -1,0 +1,58 @@
+"""Spearman rank correlation. Extension beyond the reference snapshot.
+
+The whole computation (tie-averaged ranking of both arrays + Pearson on the
+ranks) is a pure static-shape device program — one dispatch under jit.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def _rank_data(x: Array) -> Array:
+    """1-based ranks with ties assigned their average rank (scipy default)."""
+    n = x.shape[0]
+    order = jnp.argsort(x, stable=True)
+    sorted_x = x[order]
+    base = jnp.arange(1, n + 1, dtype=jnp.float32)
+    new_run = jnp.concatenate([jnp.ones((1,), bool), sorted_x[1:] != sorted_x[:-1]])
+    run_id = jnp.cumsum(new_run) - 1
+    rank_sum = jax.ops.segment_sum(base, run_id, n)
+    run_len = jax.ops.segment_sum(jnp.ones((n,), jnp.float32), run_id, n)
+    avg = rank_sum / jnp.maximum(run_len, 1.0)
+    return jnp.zeros((n,), jnp.float32).at[order].set(avg[run_id])
+
+
+def _spearman_kernel(preds: Array, target: Array) -> Array:
+    rx = _rank_data(preds.astype(jnp.float32))
+    ry = _rank_data(target.astype(jnp.float32))
+    n = rx.shape[0]
+    cov = n * jnp.sum(rx * ry) - jnp.sum(rx) * jnp.sum(ry)
+    var_x = n * jnp.sum(rx * rx) - jnp.sum(rx) ** 2
+    var_y = n * jnp.sum(ry * ry) - jnp.sum(ry) ** 2
+    denom = jnp.sqrt(jnp.maximum(var_x, 0.0) * jnp.maximum(var_y, 0.0))
+    return jnp.where(denom == 0, 0.0, cov / jnp.where(denom == 0, 1.0, denom))
+
+
+@functools.lru_cache(maxsize=1)
+def _spearman_jitted():
+    return jax.jit(_spearman_kernel)
+
+
+def spearman_corrcoef(preds: Array, target: Array) -> Array:
+    """Spearman rank correlation between two 1D arrays.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([3.0, -0.5, 2.0, 1.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 1.5])
+        >>> float(spearman_corrcoef(preds, target))
+        1.0
+    """
+    _check_same_shape(preds, target)
+    if preds.ndim != 1:
+        raise ValueError("Expected both `preds` and `target` to be 1D arrays of scalar predictions")
+    return _spearman_kernel(preds, target)

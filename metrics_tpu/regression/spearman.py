@@ -1,0 +1,62 @@
+"""SpearmanCorrcoef module. Extension beyond the reference snapshot.
+
+Ranks are global over the accumulated data, so the metric keeps cat-states
+(bounded via ``capacity``); the epoch compute (ranking + correlation) runs as
+one jitted device program shared across instances.
+"""
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.regression.spearman import _spearman_jitted, _spearman_kernel
+from metrics_tpu.parallel.buffer import as_values
+
+
+class SpearmanCorrcoef(Metric):
+    r"""Accumulated Spearman rank correlation.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([3.0, -0.5, 2.0, 1.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 1.5])
+        >>> spearman = SpearmanCorrcoef()
+        >>> float(spearman(preds, target))
+        1.0
+    """
+
+    def __init__(
+        self,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+        capacity: Optional[int] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+            capacity=capacity,
+        )
+        self.add_state("preds_all", default=[], dist_reduce_fx=None, item_shape=())
+        self.add_state("target_all", default=[], dist_reduce_fx=None, item_shape=())
+
+    def update(self, preds: Array, target: Array) -> None:
+        if preds.shape != target.shape:
+            raise RuntimeError("Predictions and targets are expected to have the same shape")
+        if preds.ndim != 1:
+            raise ValueError("Expected both `preds` and `target` to be 1D arrays of scalar predictions")
+        self._append("preds_all", jnp.asarray(preds, dtype=jnp.float32))
+        self._append("target_all", jnp.asarray(target, dtype=jnp.float32))
+
+    def compute(self) -> Array:
+        preds = as_values(self.preds_all)
+        target = as_values(self.target_all)
+        if preds.shape[0] == 0:
+            return jnp.asarray(0.0)
+        fn = _spearman_jitted() if (self._jit is not False and not self._jit_failed) else _spearman_kernel
+        return fn(preds, target)

@@ -21,6 +21,7 @@ from jax import Array
 
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.parallel.buffer import as_values
+from metrics_tpu.utils.data import is_concrete
 
 IGNORE_IDX = -100
 
@@ -110,14 +111,28 @@ class RetrievalMetric(Metric, ABC):
             if fn is None:
                 # close over a detached reset copy, not the live instance:
                 # the cache must pin only empty default states, never an
-                # epoch's worth of accumulated cat-state buffers
+                # epoch's worth of accumulated cat-state buffers. The live
+                # states are swapped out around the deepcopy so the copy
+                # never clones accumulated buffers either.
                 from copy import deepcopy
 
-                carrier = deepcopy(self)
-                carrier.reset()
+                saved = self._current_state()
+                self._set_state(self.init_state())
+                try:
+                    carrier = deepcopy(self)
+                finally:
+                    self._set_state(saved)
                 fn = jax.jit(carrier._device_compute)
                 _bounded_insert(_COMPUTE_JIT_CACHE, key, fn, _COMPUTE_JIT_CACHE_MAX)
-        result, flag = fn(idx, preds, target)
+            try:
+                result, flag = fn(idx, preds, target)
+            except self._TRACER_ERRORS:
+                # a subclass with value-dependent control flow keeps the
+                # previous eager-compute semantics
+                self._jit_failed = True
+                result, flag = self._device_compute(idx, preds, target)
+        else:
+            result, flag = fn(idx, preds, target)
 
         if self.query_without_relevant_docs == "error" and bool(flag):
             raise ValueError(
@@ -146,6 +161,13 @@ class RetrievalMetric(Metric, ABC):
 
         empty = self._empty_query_mask(dense, target, exists, n)
         flag = jnp.any(empty)
+        if self.query_without_relevant_docs == "error" and is_concrete(flag):
+            # eager path: start the readback now so it overlaps with the
+            # grouped-metric computation below (one ~200ms tunnel round)
+            try:
+                flag.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass
 
         # sentinel rows must not rank, hit, or grade: -inf scores sink them
         # below every real row of their query, zero targets null their gain

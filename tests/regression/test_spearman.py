@@ -1,0 +1,68 @@
+"""SpearmanCorrcoef vs scipy.stats.spearmanr."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.stats import spearmanr
+
+from metrics_tpu import SpearmanCorrcoef
+from metrics_tpu.functional import spearman_corrcoef
+from tests.helpers.testers import MetricTester
+
+_rng = np.random.RandomState(31)
+NUM_BATCHES, BATCH_SIZE = 10, 32
+
+_preds = _rng.randn(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+_target = (0.5 * _preds + 0.5 * _rng.randn(NUM_BATCHES, BATCH_SIZE)).astype(np.float32)
+# quantized variant: many ties exercises the average-rank path
+_preds_ties = np.round(_preds * 2) / 2
+_target_ties = np.round(_target * 2) / 2
+
+
+def _sk_spearman(preds, target):
+    return spearmanr(np.asarray(preds).reshape(-1), np.asarray(target).reshape(-1))[0]
+
+
+@pytest.mark.parametrize(
+    "preds, target", [(_preds, _target), (_preds_ties, _target_ties)]
+)
+class TestSpearman(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_spearman_class(self, preds, target, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=SpearmanCorrcoef,
+            sk_metric=_sk_spearman,
+            dist_sync_on_step=False,
+        )
+
+    def test_spearman_functional(self, preds, target):
+        self.run_functional_metric_test(
+            preds, target, metric_functional=spearman_corrcoef, sk_metric=_sk_spearman
+        )
+
+
+def test_spearman_accumulation_matches_global():
+    m = SpearmanCorrcoef()
+    for i in range(NUM_BATCHES):
+        m.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+    np.testing.assert_allclose(float(m.compute()), _sk_spearman(_preds, _target), atol=1e-5)
+
+
+def test_spearman_capacity_buffer():
+    m = SpearmanCorrcoef(capacity=NUM_BATCHES * BATCH_SIZE)
+    for i in range(NUM_BATCHES):
+        m.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+    np.testing.assert_allclose(float(m.compute()), _sk_spearman(_preds, _target), atol=1e-5)
+
+
+def test_spearman_errors():
+    with pytest.raises(RuntimeError, match="same shape"):
+        spearman_corrcoef(jnp.zeros(3), jnp.zeros(4))
+    with pytest.raises(ValueError, match="1D"):
+        SpearmanCorrcoef().update(jnp.zeros((4, 2)), jnp.zeros((4, 2)))
+    # constant input: zero rank variance -> 0, not nan
+    assert float(spearman_corrcoef(jnp.ones(6), jnp.arange(6.0))) == 0.0
