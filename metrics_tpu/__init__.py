@@ -44,6 +44,7 @@ from metrics_tpu.regression import (  # noqa: E402
     PSNR,
     SSIM,
     ExplainedVariance,
+    KLDivergence,
     MeanAbsoluteError,
     MeanSquaredError,
     MeanSquaredLogError,

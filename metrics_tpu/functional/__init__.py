@@ -22,6 +22,7 @@ from metrics_tpu.functional.classification.roc import roc
 from metrics_tpu.functional.classification.stat_scores import stat_scores
 from metrics_tpu.functional.regression.cosine_similarity import cosine_similarity
 from metrics_tpu.functional.regression.explained_variance import explained_variance
+from metrics_tpu.functional.regression.kl_divergence import kl_divergence
 from metrics_tpu.functional.regression.mean_absolute_error import mean_absolute_error
 from metrics_tpu.functional.regression.mean_relative_error import mean_relative_error
 from metrics_tpu.functional.regression.mean_squared_error import mean_squared_error

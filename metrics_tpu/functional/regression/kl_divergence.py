@@ -1,0 +1,48 @@
+"""KL divergence between distribution pairs. Extension beyond the reference
+snapshot (later torchmetrics ships it as ``KLDivergence``)."""
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+_EPS = 1e-10
+
+
+def _kld_update(p: Array, q: Array, log_prob: bool) -> Tuple[Array, Array]:
+    _check_same_shape(p, q)
+    if p.ndim != 2:
+        raise ValueError("Expected both `p` and `q` distributions to be 2D of shape (N, d)")
+    p = p.astype(jnp.float32)
+    q = q.astype(jnp.float32)
+    if log_prob:
+        measures = jnp.sum(jnp.exp(p) * (p - q), axis=-1)
+    else:
+        p = p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), _EPS)
+        q = q / jnp.maximum(jnp.sum(q, axis=-1, keepdims=True), _EPS)
+        q = jnp.clip(q, _EPS, None)
+        measures = jnp.sum(p * jnp.log(jnp.clip(p, _EPS, None) / q), axis=-1)
+    return jnp.sum(measures), jnp.asarray(p.shape[0])
+
+
+def kl_divergence(p: Array, q: Array, log_prob: bool = False, reduction: str = "mean") -> Array:
+    """KL(p || q) per row pair of distributions, reduced over rows.
+
+    Args:
+        p: (N, d) first distributions (rows normalized if not ``log_prob``).
+        q: (N, d) second distributions.
+        log_prob: inputs are log-probabilities (no renormalization applied).
+        reduction: 'mean' | 'sum'.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> p = jnp.array([[0.36, 0.48, 0.16]])
+        >>> q = jnp.array([[1/3, 1/3, 1/3]])
+        >>> round(float(kl_divergence(p, q)), 4)
+        0.0853
+    """
+    if reduction not in ("mean", "sum"):
+        raise ValueError(f"Expected reduction to be 'mean' or 'sum', got {reduction}")
+    total, n = _kld_update(p, q, log_prob)
+    return total / jnp.maximum(n, 1) if reduction == "mean" else total

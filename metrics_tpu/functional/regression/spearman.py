@@ -3,8 +3,6 @@
 The whole computation (tie-averaged ranking of both arrays + Pearson on the
 ranks) is a pure static-shape device program — one dispatch under jit.
 """
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax import Array
@@ -37,9 +35,8 @@ def _spearman_kernel(preds: Array, target: Array) -> Array:
     return jnp.where(denom == 0, 0.0, cov / jnp.where(denom == 0, 1.0, denom))
 
 
-@functools.lru_cache(maxsize=1)
-def _spearman_jitted():
-    return jax.jit(_spearman_kernel)
+# jax.jit is lazy, so the module-level wrapper costs nothing until first use
+_spearman_jitted = jax.jit(_spearman_kernel)
 
 
 def spearman_corrcoef(preds: Array, target: Array) -> Array:
@@ -55,4 +52,6 @@ def spearman_corrcoef(preds: Array, target: Array) -> Array:
     _check_same_shape(preds, target)
     if preds.ndim != 1:
         raise ValueError("Expected both `preds` and `target` to be 1D arrays of scalar predictions")
+    if preds.shape[0] == 0:
+        return jnp.asarray(jnp.nan)  # scipy parity for empty input
     return _spearman_kernel(preds, target)

@@ -1,0 +1,58 @@
+"""KLDivergence module. Extension beyond the reference snapshot.
+
+Streams through two scalar sum-states (one fused psum to sync).
+"""
+from typing import Any, Callable, Optional
+
+import numpy as np
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.regression.kl_divergence import _kld_update
+from metrics_tpu.utils.data import accum_int_dtype
+
+
+class KLDivergence(Metric):
+    r"""Accumulated KL(p || q) over pairs of distributions.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> p = jnp.array([[0.36, 0.48, 0.16]])
+        >>> q = jnp.array([[1/3, 1/3, 1/3]])
+        >>> kld = KLDivergence()
+        >>> round(float(kld(p, q)), 4)
+        0.0853
+    """
+
+    def __init__(
+        self,
+        log_prob: bool = False,
+        reduction: str = "mean",
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        if reduction not in ("mean", "sum"):
+            raise ValueError(f"Expected reduction to be 'mean' or 'sum', got {reduction}")
+        self.log_prob = log_prob
+        self.reduction = reduction
+        self.add_state("measure_sum", default=np.zeros((), dtype=np.float32), dist_reduce_fx="sum")
+        self.add_state("total", default=np.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
+
+    def update(self, p: Array, q: Array) -> None:
+        total, n = _kld_update(p, q, self.log_prob)
+        self.measure_sum = self.measure_sum + total
+        self.total = self.total + n
+
+    def compute(self) -> Array:
+        if self.reduction == "sum":
+            return self.measure_sum
+        return self.measure_sum / jnp.maximum(self.total.astype(jnp.float32), 1.0)

@@ -13,6 +13,7 @@ from jax import Array
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.functional.regression.spearman import _spearman_jitted, _spearman_kernel
 from metrics_tpu.parallel.buffer import as_values
+from metrics_tpu.utils.checks import _check_same_shape
 
 
 class SpearmanCorrcoef(Metric):
@@ -46,8 +47,7 @@ class SpearmanCorrcoef(Metric):
         self.add_state("target_all", default=[], dist_reduce_fx=None, item_shape=())
 
     def update(self, preds: Array, target: Array) -> None:
-        if preds.shape != target.shape:
-            raise RuntimeError("Predictions and targets are expected to have the same shape")
+        _check_same_shape(preds, target)
         if preds.ndim != 1:
             raise ValueError("Expected both `preds` and `target` to be 1D arrays of scalar predictions")
         self._append("preds_all", jnp.asarray(preds, dtype=jnp.float32))
@@ -58,5 +58,5 @@ class SpearmanCorrcoef(Metric):
         target = as_values(self.target_all)
         if preds.shape[0] == 0:
             return jnp.asarray(0.0)
-        fn = _spearman_jitted() if (self._jit is not False and not self._jit_failed) else _spearman_kernel
+        fn = _spearman_jitted if (self._jit is not False and not self._jit_failed) else _spearman_kernel
         return fn(preds, target)

@@ -29,6 +29,7 @@ IGNORE_IDX = -100
 # per eval epoch must not retrace); bounded FIFO like the core step cache
 _COMPUTE_JIT_CACHE: Dict[Any, Callable] = {}
 _COMPUTE_JIT_CACHE_MAX = 64
+_EAGER_ONLY = object()  # cache sentinel: this config's compute cannot trace
 
 
 def _validate_k(k: Optional[int]) -> Optional[int]:
@@ -103,12 +104,16 @@ class RetrievalMetric(Metric, ABC):
         # The jitted callable is shared across config-identical instances
         # (fresh metric per eval epoch must not pay a retrace).
         fn = self._device_compute
-        if self._jit is not False and not self._jit_failed:
+        if self._jit is not False and not self.__dict__.get("_compute_jit_failed"):
             from metrics_tpu.core.metric import _bounded_insert
 
             key = self._compute_cache_key()
             fn = _COMPUTE_JIT_CACHE.get(key)
-            if fn is None:
+            if fn is _EAGER_ONLY:
+                # a previous instance of this config failed to trace
+                self.__dict__["_compute_jit_failed"] = True
+                fn = self._device_compute
+            elif fn is None:
                 # close over a detached reset copy, not the live instance:
                 # the cache must pin only empty default states, never an
                 # epoch's worth of accumulated cat-state buffers. The live
@@ -128,8 +133,13 @@ class RetrievalMetric(Metric, ABC):
                 result, flag = fn(idx, preds, target)
             except self._TRACER_ERRORS:
                 # a subclass with value-dependent control flow keeps the
-                # previous eager-compute semantics
-                self._jit_failed = True
+                # previous eager-compute semantics. The flag is COMPUTE-only
+                # (not _jit_failed, which would also demote the fused
+                # forward/update of capacity-buffer metrics), and the broken
+                # entry is replaced by a sentinel so config-identical fresh
+                # instances skip straight to eager instead of re-tracing.
+                self.__dict__["_compute_jit_failed"] = True
+                _COMPUTE_JIT_CACHE[key] = _EAGER_ONLY
                 result, flag = self._device_compute(idx, preds, target)
         else:
             result, flag = fn(idx, preds, target)

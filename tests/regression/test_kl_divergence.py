@@ -1,0 +1,65 @@
+"""KLDivergence vs scipy.stats.entropy oracle."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.stats import entropy
+
+from metrics_tpu import KLDivergence
+from metrics_tpu.functional import kl_divergence
+from tests.helpers.testers import MetricTester
+
+_rng = np.random.RandomState(37)
+NUM_BATCHES, BATCH_SIZE, DIM = 10, 32, 5
+
+
+def _dists(shape):
+    x = _rng.rand(*shape).astype(np.float32) + 0.05
+    return x / x.sum(-1, keepdims=True)
+
+
+_p = _dists((NUM_BATCHES, BATCH_SIZE, DIM))
+_q = _dists((NUM_BATCHES, BATCH_SIZE, DIM))
+
+
+def _sk_kld(p, q):
+    p = np.asarray(p, dtype=np.float64).reshape(-1, DIM)
+    q = np.asarray(q, dtype=np.float64).reshape(-1, DIM)
+    return np.mean([entropy(p[i], q[i]) for i in range(p.shape[0])])
+
+
+class TestKLDivergence(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_kld_class(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_p,
+            target=_q,
+            metric_class=KLDivergence,
+            sk_metric=_sk_kld,
+            dist_sync_on_step=False,
+        )
+
+    def test_kld_functional(self):
+        self.run_functional_metric_test(_p, _q, metric_functional=kl_divergence, sk_metric=_sk_kld)
+
+
+def test_kld_log_prob_matches_prob():
+    p, q = jnp.asarray(_p[0]), jnp.asarray(_q[0])
+    want = float(kl_divergence(p, q))
+    got = float(kl_divergence(jnp.log(p), jnp.log(q), log_prob=True))
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_kld_sum_reduction_and_errors():
+    p, q = jnp.asarray(_p[0]), jnp.asarray(_q[0])
+    np.testing.assert_allclose(
+        float(kl_divergence(p, q, reduction="sum")),
+        float(kl_divergence(p, q)) * BATCH_SIZE,
+        rtol=1e-5,
+    )
+    with pytest.raises(ValueError, match="2D"):
+        kl_divergence(jnp.zeros(4), jnp.zeros(4))
+    with pytest.raises(ValueError, match="reduction"):
+        KLDivergence(reduction="max")
