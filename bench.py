@@ -193,7 +193,9 @@ def bench_ours_fused_singlechip() -> float:
 
     t_plain = timeit(train_only, w)
     t_with = timeit(train_with_metrics, w, pure.init())
-    return max(t_with - t_plain, 1e-6)
+    # floor at ~timing resolution: XLA often fuses the metric update into the
+    # step for free, making the true marginal indistinguishable from noise
+    return max(t_with - t_plain, 0.01)
 
 
 def bench_reference_eager_update() -> float:

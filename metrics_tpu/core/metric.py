@@ -104,7 +104,7 @@ def _bounded_insert(cache: Dict[Any, Any], key: Any, value: Any, max_size: int) 
 _NON_TRACE_ATTRS = frozenset({
     "update", "compute", "_update_signature", "_update_impl", "_compute_impl",
     "_computed", "_forward_cache", "_jitted_step", "_jitted_step_fc",
-    "_jit_failed", "_fc_failed", "_overflow_probe", "_default_keys",
+    "_jit_failed", "_fc_failed", "_compute_jit_failed", "_overflow_probe", "_default_keys",
     "_to_sync", "_in_forward", "_sync_count", "dist_sync_fn",
     "_placement", "_state_dtype", "compute_on_step", "dist_sync_on_step",
     "process_group",

@@ -57,6 +57,6 @@ class SpearmanCorrcoef(Metric):
         preds = as_values(self.preds_all)
         target = as_values(self.target_all)
         if preds.shape[0] == 0:
-            return jnp.asarray(0.0)
+            return jnp.asarray(jnp.nan)  # no data: nan, matching the functional
         fn = _spearman_jitted if (self._jit is not False and not self._jit_failed) else _spearman_kernel
         return fn(preds, target)
