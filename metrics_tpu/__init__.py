@@ -62,4 +62,5 @@ from metrics_tpu.retrieval import (  # noqa: E402
     RetrievalPrecision,
     RetrievalRecall,
 )
+from metrics_tpu.text import WER  # noqa: E402
 from metrics_tpu import functional  # noqa: E402

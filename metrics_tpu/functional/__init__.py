@@ -34,6 +34,7 @@ from metrics_tpu.functional.regression.spearman import spearman_corrcoef
 from metrics_tpu.functional.regression.ssim import ssim
 from metrics_tpu.functional.image_gradients import image_gradients
 from metrics_tpu.functional.nlp import bleu_score
+from metrics_tpu.functional.text import edit_distance_padded, wer
 from metrics_tpu.functional.self_supervised import embedding_similarity
 from metrics_tpu.functional.retrieval.average_precision import retrieval_average_precision
 from metrics_tpu.functional.retrieval.fall_out import retrieval_fall_out

@@ -1,0 +1,125 @@
+"""Word error rate. Extension beyond the reference snapshot (later
+torchmetrics ships ``WER``).
+
+Two evaluation paths:
+
+- ``wer(preds, target)``: host API over strings / token lists (tokenization
+  is host work regardless), numpy DP.
+- ``edit_distance_padded(pred_ids, target_ids, pred_len, target_len)``: a
+  device-evaluable batched Levenshtein kernel — the DP recurrence runs as a
+  ``lax.scan`` over the padded target axis with the row as carry, so a whole
+  batch of sequences evaluates in one fused XLA program (vmap over the batch).
+"""
+from typing import List, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+TokenSeq = Union[str, Sequence[str]]
+
+
+def _tokens(x: TokenSeq) -> List[str]:
+    return x.split() if isinstance(x, str) else list(x)
+
+
+def _np_edit_distance(a: List[str], b: List[str]) -> int:
+    """Host DP (numpy row recurrence)."""
+    if not a:
+        return len(b)
+    b_arr = np.array(b)
+    prev = np.arange(len(b) + 1)
+    for i, tok in enumerate(a, 1):
+        cur = np.empty(len(b) + 1, dtype=np.int64)
+        cur[0] = i
+        sub = prev[:-1] + (b_arr != tok)
+        for j in range(1, len(b) + 1):
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1, sub[j - 1])
+        prev = cur
+    return int(prev[-1])
+
+
+def _wer_update(preds: Union[str, Sequence[TokenSeq]], target: Union[str, Sequence[TokenSeq]]) -> Tuple[int, int]:
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    if len(preds) != len(target):
+        raise ValueError("`preds` and `target` must have the same number of sequences")
+    errors = total = 0
+    for p, t in zip(preds, target):
+        pt, tt = _tokens(p), _tokens(t)
+        errors += _np_edit_distance(pt, tt)
+        total += len(tt)
+    return errors, total
+
+
+def wer(preds: Union[str, Sequence[TokenSeq]], target: Union[str, Sequence[TokenSeq]]) -> float:
+    """Word error rate: edit distance / reference length, over all pairs.
+
+    ``preds``/``target`` are a single sentence string or a sequence of
+    sentences, where each sentence is a string (whitespace-tokenized) or a
+    pre-tokenized token list — i.e. pre-tokenized input nests one level:
+    ``wer([["the", "cat"]], [["the", "cat", "sat"]])``. A flat list is
+    always read as a BATCH of sentences, never as one token list.
+
+    With no reference words the result is 0.0 for a perfect empty match and
+    ``inf`` when there are errors.
+
+    Example:
+        >>> wer("the cat sat", "the cat sat on the mat")
+        0.5
+    """
+    errors, total = _wer_update(preds, target)
+    if total == 0:
+        return 0.0 if errors == 0 else float("inf")
+    return errors / total
+
+
+def _edit_distance_single(pred: Array, target: Array, pred_len: Array, target_len: Array) -> Array:
+    """Levenshtein distance of one padded id sequence pair (device)."""
+    m = target.shape[0]
+    cols = jnp.arange(1, m + 1)
+    init_row = jnp.arange(m + 1, dtype=jnp.int32)
+
+    def step(row, inp):
+        i, tok = inp
+        active = i < pred_len
+        sub_cost = row[:-1] + (target != tok).astype(jnp.int32)
+        del_cost = row[1:] + 1
+
+        def inner(carry, triple):
+            sub, dele, col = triple
+            best = jnp.minimum(jnp.minimum(sub, dele), carry + 1)
+            return best, best
+
+        _, rest = jax.lax.scan(inner, i + 1, (sub_cost, del_cost, cols))
+        new_row = jnp.concatenate([jnp.array([i + 1]), rest])
+        return jnp.where(active, new_row, row), None
+
+    n = pred.shape[0]
+    final, _ = jax.lax.scan(step, init_row, (jnp.arange(n, dtype=jnp.int32), pred))
+    return final[target_len]
+
+
+def edit_distance_padded(pred_ids: Array, target_ids: Array, pred_len: Array, target_len: Array) -> Array:
+    """Batched Levenshtein over padded token-id arrays, fully on device.
+
+    Args:
+        pred_ids: (B, N) int token ids, padded.
+        target_ids: (B, M) int token ids, padded.
+        pred_len: (B,) true lengths of ``pred_ids`` rows.
+        target_len: (B,) true lengths of ``target_ids`` rows.
+
+    Returns:
+        (B,) int32 edit distances.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> p = jnp.array([[1, 2, 3, 0]])
+        >>> t = jnp.array([[1, 9, 3, 4]])
+        >>> int(edit_distance_padded(p, t, jnp.array([3]), jnp.array([4]))[0])
+        2
+    """
+    return jax.vmap(_edit_distance_single)(pred_ids, target_ids, pred_len, target_len)

@@ -1,0 +1,1 @@
+from metrics_tpu.text.wer import WER

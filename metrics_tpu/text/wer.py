@@ -1,0 +1,66 @@
+"""WER module. Extension beyond the reference snapshot.
+
+Streams through two scalar sum-states (edit errors / reference words), so
+accumulation is O(1) and cross-process sync is one summed reduction.
+"""
+from typing import Any, Callable, Optional, Sequence, Union
+
+import numpy as np
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.text import TokenSeq, _wer_update
+from metrics_tpu.utils.data import accum_int_dtype
+
+
+class WER(Metric):
+    r"""Accumulated word error rate over sequence pairs.
+
+    Accepts strings (whitespace-tokenized) or pre-tokenized sequences, and
+    also pre-computed device results via ``update_counts`` for pipelines that
+    run the batched on-device edit-distance kernel
+    (``functional.edit_distance_padded``).
+
+    Example:
+        >>> metric = WER()
+        >>> float(metric(["the cat sat"], ["the cat sat on the mat"]))
+        0.5
+    """
+
+    def __init__(
+        self,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+            jit=False,  # update consumes host strings; the fused jit step cannot trace them
+        )
+        self.add_state("errors", default=np.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
+        self.add_state("total", default=np.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[TokenSeq, Sequence[TokenSeq]], target: Union[TokenSeq, Sequence[TokenSeq]]) -> None:
+        errors, total = _wer_update(preds, target)
+        self.errors = self.errors + errors
+        self.total = self.total + total
+
+    def update_counts(self, errors: Array, ref_words: Array) -> None:
+        """Accumulate pre-computed device counts (e.g. from
+        ``edit_distance_padded`` distances and target lengths)."""
+        self._computed = None  # bypasses the wrapped update, so drop its cache here
+        self.errors = self.errors + jnp.sum(errors)
+        self.total = self.total + jnp.sum(ref_words)
+
+    def compute(self) -> Array:
+        # empty reference: 0.0 for a perfect empty match, inf when there are
+        # errors (matching the functional)
+        rate = self.errors.astype(jnp.float32) / jnp.maximum(self.total, 1).astype(jnp.float32)
+        return jnp.where(
+            self.total == 0, jnp.where(self.errors == 0, 0.0, jnp.inf), rate
+        )

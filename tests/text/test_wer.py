@@ -1,0 +1,121 @@
+"""WER vs a reference-free dynamic-programming oracle (full 2D DP matrix,
+independent of the package's row-recurrence implementation)."""
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import WER
+from metrics_tpu.functional import edit_distance_padded, wer
+
+
+def _oracle_edit_distance(a, b):
+    dp = [[0] * (len(b) + 1) for _ in range(len(a) + 1)]
+    for i in range(len(a) + 1):
+        dp[i][0] = i
+    for j in range(len(b) + 1):
+        dp[0][j] = j
+    for i in range(1, len(a) + 1):
+        for j in range(1, len(b) + 1):
+            dp[i][j] = min(
+                dp[i - 1][j] + 1,
+                dp[i][j - 1] + 1,
+                dp[i - 1][j - 1] + (a[i - 1] != b[j - 1]),
+            )
+    return dp[len(a)][len(b)]
+
+
+WORDS = ["the", "cat", "sat", "on", "mat", "dog", "ran", "fast", "slow", "big"]
+
+
+def _random_sentence(rng, lo=0, hi=12):
+    return " ".join(rng.choice(WORDS) for _ in range(rng.randint(lo, hi)))
+
+
+def test_wer_known_values():
+    assert wer("the cat sat", "the cat sat") == 0.0
+    assert wer("the cat sat", "the cat sat on the mat") == 0.5
+    assert wer("", "a b") == 1.0
+    assert wer("a b", "a b c d") == 0.5
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_wer_vs_oracle_random(seed):
+    rng = random.Random(seed)
+    preds = [_random_sentence(rng) for _ in range(20)]
+    target = [_random_sentence(rng, lo=1) for _ in range(20)]
+    errors = sum(_oracle_edit_distance(p.split(), t.split()) for p, t in zip(preds, target))
+    total = sum(len(t.split()) for t in target)
+    np.testing.assert_allclose(wer(preds, target), errors / total, atol=1e-9)
+
+
+def test_wer_module_accumulates():
+    rng = random.Random(5)
+    m = WER()
+    errors = total = 0
+    for _ in range(4):
+        p = [_random_sentence(rng) for _ in range(5)]
+        t = [_random_sentence(rng, lo=1) for _ in range(5)]
+        m.update(p, t)
+        errors += sum(_oracle_edit_distance(a.split(), b.split()) for a, b in zip(p, t))
+        total += sum(len(b.split()) for b in t)
+    np.testing.assert_allclose(float(m.compute()), errors / total, atol=1e-7)
+
+
+def test_wer_mismatched_lengths():
+    with pytest.raises(ValueError, match="same number"):
+        wer(["a"], ["a", "b"])
+
+
+def test_wer_empty_reference_and_counts_cache():
+    # empty reference: perfect empty match is 0.0, errors are inf — same for
+    # the functional and the module
+    assert wer("", "") == 0.0
+    assert wer("a b", "") == float("inf")
+    m = WER()
+    m.update("a b", "")
+    assert float(m.compute()) == float("inf")
+
+    # pre-tokenized input nests one level (a flat list is a batch)
+    np.testing.assert_allclose(wer([["the", "cat"]], [["the", "cat", "sat"]]), 1 / 3)
+
+    # update_counts invalidates the compute cache
+    m2 = WER()
+    m2.update_counts(jnp.array([2]), jnp.array([4]))
+    assert float(m2.compute()) == 0.5
+    m2.update_counts(jnp.array([10]), jnp.array([4]))
+    np.testing.assert_allclose(float(m2.compute()), 12 / 8)
+
+
+@pytest.mark.parametrize("seed", [3, 4])
+def test_device_edit_distance_vs_oracle(seed):
+    rng = np.random.RandomState(seed)
+    B, N, M, V = 8, 10, 12, 6
+    pred_len = rng.randint(0, N + 1, B)
+    target_len = rng.randint(1, M + 1, B)
+    pred = rng.randint(1, V, (B, N))
+    target = rng.randint(1, V, (B, M))
+
+    got = np.asarray(
+        edit_distance_padded(
+            jnp.asarray(pred), jnp.asarray(target), jnp.asarray(pred_len), jnp.asarray(target_len)
+        )
+    )
+    for b in range(B):
+        want = _oracle_edit_distance(list(pred[b, :pred_len[b]]), list(target[b, :target_len[b]]))
+        assert got[b] == want, (b, got[b], want)
+
+
+def test_device_edit_distance_jit_and_counts_path():
+    import jax
+
+    p = jnp.array([[1, 2, 3, 0], [4, 4, 4, 4]])
+    t = jnp.array([[1, 9, 3, 4], [4, 4, 0, 0]])
+    pl, tl = jnp.array([3, 4]), jnp.array([4, 2])
+    dists = jax.jit(edit_distance_padded)(p, t, pl, tl)
+    assert list(np.asarray(dists)) == [2, 2]
+
+    m = WER()
+    m.update_counts(dists, tl)
+    np.testing.assert_allclose(float(m.compute()), 4 / 6, atol=1e-7)
