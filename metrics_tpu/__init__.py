@@ -60,6 +60,7 @@ from metrics_tpu.retrieval import (  # noqa: E402
     RetrievalMRR,
     RetrievalNormalizedDCG,
     RetrievalPrecision,
+    RetrievalRPrecision,
     RetrievalRecall,
 )
 from metrics_tpu.text import WER  # noqa: E402

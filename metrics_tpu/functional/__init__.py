@@ -41,5 +41,6 @@ from metrics_tpu.functional.retrieval.fall_out import retrieval_fall_out
 from metrics_tpu.functional.retrieval.hit_rate import retrieval_hit_rate
 from metrics_tpu.functional.retrieval.ndcg import retrieval_normalized_dcg
 from metrics_tpu.functional.retrieval.precision import retrieval_precision
+from metrics_tpu.functional.retrieval.r_precision import retrieval_r_precision
 from metrics_tpu.functional.retrieval.recall import retrieval_recall
 from metrics_tpu.functional.retrieval.reciprocal_rank import retrieval_reciprocal_rank

@@ -3,6 +3,7 @@ from metrics_tpu.functional.retrieval.fall_out import retrieval_fall_out
 from metrics_tpu.functional.retrieval.hit_rate import retrieval_hit_rate
 from metrics_tpu.functional.retrieval.ndcg import retrieval_normalized_dcg
 from metrics_tpu.functional.retrieval.precision import retrieval_precision
+from metrics_tpu.functional.retrieval.r_precision import retrieval_r_precision
 from metrics_tpu.functional.retrieval.recall import retrieval_recall
 from metrics_tpu.functional.retrieval.reciprocal_rank import retrieval_reciprocal_rank
 from metrics_tpu.functional.retrieval.segments import (
@@ -10,6 +11,7 @@ from metrics_tpu.functional.retrieval.segments import (
     grouped_fall_out,
     grouped_hit_rate,
     grouped_ndcg,
+    grouped_r_precision,
     grouped_reciprocal_rank,
     grouped_topk_hits,
     segment_positions,

@@ -1,0 +1,24 @@
+"""Single-query R-precision. Extension beyond the reference snapshot."""
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.retrieval.utils import check_retrieval_inputs, topk_mask_count
+
+
+def retrieval_r_precision(preds: Array, target: Array) -> Array:
+    """Precision at R, where R is the query's own relevant count.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1])
+        >>> target = jnp.array([True, False, True, False])
+        >>> float(retrieval_r_precision(preds, target))
+        0.5
+    """
+    check_retrieval_inputs(preds, target)
+    rel = (target > 0).astype(jnp.float32)
+    r = int(jnp.sum(rel))
+    if r == 0:
+        return jnp.asarray(0.0)
+    hits, _, _ = topk_mask_count(preds, rel, r)
+    return hits / r

@@ -123,6 +123,18 @@ def grouped_fall_out(
     return jnp.where(neg_total == 0, 0.0, false_topk / jnp.maximum(neg_total, 1.0))
 
 
+def grouped_r_precision(dense_idx: Array, preds: Array, target: Array, num_segments: int) -> Array:
+    """Per-query R-precision: hits within the top-R ranks, R = that query's
+    relevant count (the natural cutoff where precision == recall)."""
+    rel = (target > 0).astype(jnp.float32)
+    d, _, t = sort_by_query_then_score(dense_idx, preds, rel)
+    ranks, _ = segment_positions(d, num_segments)
+    r_per_query = jax.ops.segment_sum(t, d, num_segments)
+    in_top_r = (ranks.astype(jnp.float32) <= r_per_query[d]).astype(jnp.float32)
+    hits = jax.ops.segment_sum(t * in_top_r, d, num_segments)
+    return jnp.where(r_per_query == 0, 0.0, hits / jnp.maximum(r_per_query, 1.0))
+
+
 def grouped_ndcg(dense_idx: Array, preds: Array, target: Array, num_segments: int, k: "int | None" = None) -> Array:
     """Per-query NDCG (linear gain) for all queries at once.
 

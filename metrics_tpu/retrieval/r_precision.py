@@ -1,0 +1,23 @@
+"""RetrievalRPrecision — extension beyond the reference snapshot."""
+from jax import Array
+
+from metrics_tpu.functional.retrieval.segments import grouped_r_precision
+from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
+
+
+class RetrievalRPrecision(RetrievalMetric):
+    r"""Mean R-precision over queries (precision at each query's own relevant
+    count R — the cutoff where precision equals recall).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.array([False, False, True, False, True, False, True])
+        >>> rp = RetrievalRPrecision()
+        >>> float(rp(indexes, preds, target))
+        0.75
+    """
+
+    def _grouped_metric(self, dense_idx: Array, preds: Array, target: Array, num_queries: int, valid=None) -> Array:
+        return grouped_r_precision(dense_idx, preds, target, num_queries)

@@ -212,6 +212,47 @@ def test_functional_hit_rate_fall_out_vs_numpy(k):
         )
 
 
+def _np_r_precision(target, preds, k=None):
+    r = int(target.sum())
+    if r == 0:
+        return 0.0
+    t = target[_np_rank_order(preds)]
+    return t[:r].sum() / r
+
+
+@pytest.mark.parametrize("size", [1, 4, 10])
+@pytest.mark.parametrize("n_queries", [1, 5])
+@pytest.mark.parametrize("behaviour", ["skip", "pos", "neg"])
+def test_r_precision_vs_numpy_oracle(size, n_queries, behaviour):
+    from metrics_tpu.retrieval import RetrievalRPrecision
+
+    np.random.seed(size * 7 + n_queries)
+    target = [np.random.randint(0, 2, size=(size,)) for _ in range(n_queries)]
+    preds = [np.random.randn(size) for _ in range(n_queries)]
+    expected = _mean_over_queries(_np_r_precision, target, preds, behaviour)
+
+    metric = RetrievalRPrecision(query_without_relevant_docs=behaviour)
+    for i, (p, t) in enumerate(zip(preds, target)):
+        metric.update(jnp.asarray(np.full(size, i)), jnp.asarray(p.astype(np.float32)), jnp.asarray(t))
+    np.testing.assert_allclose(float(metric.compute()), expected, atol=1e-6)
+
+
+def test_functional_r_precision_vs_numpy():
+    from metrics_tpu.functional.retrieval import retrieval_r_precision
+
+    np.random.seed(41)
+    for _ in range(5):
+        t = np.random.randint(0, 2, size=(10,))
+        p = np.random.randn(10)
+        if t.sum() == 0:
+            t[2] = 1
+        np.testing.assert_allclose(
+            float(retrieval_r_precision(jnp.asarray(p.astype(np.float32)), jnp.asarray(t))),
+            _np_r_precision(t, p),
+            atol=1e-6,
+        )
+
+
 def test_fall_out_error_policy_message():
     from metrics_tpu.retrieval import RetrievalFallOut
 
