@@ -1,48 +1,116 @@
 """Pearson correlation coefficient.
 
 Extension beyond the reference snapshot (later torchmetrics ships it). The
-streaming form is six raw-moment sums — every state is a plain ``"sum"``
-reduction, so accumulation is O(1) memory, jit-fusable, and cross-device sync
-is a single fused ``psum`` (no rank buffers, no gather).
+streaming state is a single ``(6,)`` co-moment vector
+``[n, mean_x, mean_y, M2x, M2y, Cxy]`` accumulated with the Chan et al.
+parallel-merge recurrence: per-batch moments are centered on the batch's own
+mean, and batches/devices/shards combine through ``chan_merge`` — an
+associative fold, so cross-device sync is a single gather + fold through the
+standard reduction registry (``metrics_tpu.parallel.sync.associative``).
 
-Accumulation is float32; as with any raw-moment formulation, r degrades when
-``|mean| >> std`` (catastrophic cancellation). Center the inputs if your data
-has a large offset.
+Centered accumulation is the whole point: the raw-moment form
+``n*sxy - sx*sy`` cancels catastrophically in float32 once ``|mean| >> std``
+(e.g. mean 1000, std 1 silently returns r≈0.78 instead of 0.70). The centered
+moments ``M2``/``Cxy`` never carry the ``mean^2`` magnitude, so the compute
+``Cxy / sqrt(M2x * M2y)`` has no cancellation; accuracy holds for any offset.
+
+``n`` is carried as float32 inside the vector (the merge needs it jointly with
+the means). float32 integers saturate at 2^24: past ~16.7M accumulated samples
+the carried count stops growing, which degrades the merge weights ``nb/n``
+from a true running mean into a ~2^24-window moving average. The
+``PearsonCorrcoef`` module tracks the exact count in an integer state and
+warns when accumulation crosses that regime.
 """
 from typing import Tuple
 
 import jax.numpy as jnp
 from jax import Array
 
+from metrics_tpu.parallel.sync import associative
 from metrics_tpu.utils.checks import _check_same_shape
 
+# comoment vector layout
+_N, _MX, _MY, _M2X, _M2Y, _CXY = range(6)
 
-def _pearson_update(preds: Array, target: Array) -> Tuple[Array, Array, Array, Array, Array, Array]:
+
+def zero_comoments() -> Array:
+    return jnp.zeros((6,), dtype=jnp.float32)
+
+
+def batch_comoments(preds: Array, target: Array) -> Array:
+    """Co-moment vector of one batch, centered on the batch's own mean."""
     _check_same_shape(preds, target)
     if preds.ndim != 1:
         raise ValueError("Expected both `preds` and `target` to be 1D arrays of scalar predictions")
     x = preds.astype(jnp.float32)
     y = target.astype(jnp.float32)
-    return (
-        jnp.sum(x),
-        jnp.sum(y),
-        jnp.sum(x * x),
-        jnp.sum(y * y),
-        jnp.sum(x * y),
-        jnp.asarray(x.shape[0], dtype=jnp.float32),
-    )
+    n = x.shape[0]
+    if n == 0:
+        return zero_comoments()
+    mx = jnp.mean(x)
+    my = jnp.mean(y)
+    dx = x - mx
+    dy = y - my
+    return jnp.stack([
+        jnp.asarray(n, jnp.float32),
+        mx,
+        my,
+        jnp.sum(dx * dx),
+        jnp.sum(dy * dy),
+        jnp.sum(dx * dy),
+    ])
 
 
-def _pearson_compute(sx: Array, sy: Array, sxx: Array, syy: Array, sxy: Array, n: Array) -> Array:
-    cov = n * sxy - sx * sy
-    var_x = n * sxx - sx * sx
-    var_y = n * syy - sy * sy
-    denom = jnp.sqrt(jnp.maximum(var_x, 0.0) * jnp.maximum(var_y, 0.0))
-    return jnp.where(denom == 0, 0.0, cov / jnp.where(denom == 0, 1.0, denom))
+def chan_merge(a: Array, b: Array) -> Array:
+    """Pairwise merge of two co-moment vectors (Chan et al. parallel update).
+
+    Exact for either side empty: ``n_a == 0`` reduces to ``b`` and vice versa.
+    """
+    na, nb = a[_N], b[_N]
+    n = na + nb
+    nsafe = jnp.where(n == 0, 1.0, n)
+    dx = b[_MX] - a[_MX]
+    dy = b[_MY] - a[_MY]
+    f = nb / nsafe
+    w = na * nb / nsafe
+    return jnp.stack([
+        n,
+        a[_MX] + dx * f,
+        a[_MY] + dy * f,
+        a[_M2X] + b[_M2X] + dx * dx * w,
+        a[_M2Y] + b[_M2Y] + dy * dy * w,
+        a[_CXY] + b[_CXY] + dx * dy * w,
+    ])
+
+
+@associative
+def chan_fold(stacked: Array) -> Array:
+    """Fold a ``(world, 6)`` stack of co-moment vectors into one (associative)."""
+    out = stacked[0]
+    for i in range(1, stacked.shape[0]):
+        out = chan_merge(out, stacked[i])
+    return out
+
+
+def comoments_corrcoef(c: Array) -> Array:
+    """r from a co-moment vector; ``nan`` when either variance is zero (scipy
+    convention — degenerate input is undefined, not "uncorrelated")."""
+    denom = jnp.sqrt(jnp.maximum(c[_M2X], 0.0) * jnp.maximum(c[_M2Y], 0.0))
+    return jnp.where(denom == 0, jnp.nan, c[_CXY] / jnp.where(denom == 0, 1.0, denom))
+
+
+def _pearson_update(preds: Array, target: Array) -> Tuple[Array]:
+    return (batch_comoments(preds, target),)
+
+
+def _pearson_compute(comoments: Array) -> Array:
+    return comoments_corrcoef(comoments)
 
 
 def pearson_corrcoef(preds: Array, target: Array) -> Array:
     """Pearson correlation between two 1D arrays.
+
+    Returns ``nan`` when either input has zero variance (scipy parity).
 
     Example:
         >>> import jax.numpy as jnp
@@ -51,4 +119,4 @@ def pearson_corrcoef(preds: Array, target: Array) -> Array:
         >>> round(float(pearson_corrcoef(preds, target)), 4)
         0.9849
     """
-    return _pearson_compute(*_pearson_update(preds, target))
+    return comoments_corrcoef(batch_comoments(preds, target))

@@ -32,7 +32,9 @@ def _spearman_kernel(preds: Array, target: Array) -> Array:
     var_x = n * jnp.sum(rx * rx) - jnp.sum(rx) ** 2
     var_y = n * jnp.sum(ry * ry) - jnp.sum(ry) ** 2
     denom = jnp.sqrt(jnp.maximum(var_x, 0.0) * jnp.maximum(var_y, 0.0))
-    return jnp.where(denom == 0, 0.0, cov / jnp.where(denom == 0, 1.0, denom))
+    # nan on zero rank variance (constant input): scipy convention —
+    # degenerate input is undefined, not "uncorrelated"
+    return jnp.where(denom == 0, jnp.nan, cov / jnp.where(denom == 0, 1.0, denom))
 
 
 # jax.jit is lazy, so the module-level wrapper costs nothing until first use

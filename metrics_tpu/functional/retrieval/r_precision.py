@@ -1,12 +1,19 @@
-"""Single-query R-precision. Extension beyond the reference snapshot."""
+"""Single-query R-precision. Extension beyond the reference snapshot.
+
+Fully trace-safe: R (the query's own relevant count) is computed on device and
+used as a traced rank threshold, so the functional composes under ``jax.jit``
+and ``vmap`` like every sibling retrieval functional — no host readback.
+"""
 import jax.numpy as jnp
 from jax import Array
 
-from metrics_tpu.functional.retrieval.utils import check_retrieval_inputs, topk_mask_count
+from metrics_tpu.functional.retrieval.utils import check_retrieval_inputs, mask_within_rank
 
 
 def retrieval_r_precision(preds: Array, target: Array) -> Array:
     """Precision at R, where R is the query's own relevant count.
+
+    Returns 0.0 when the query has no relevant documents.
 
     Example:
         >>> import jax.numpy as jnp
@@ -17,8 +24,6 @@ def retrieval_r_precision(preds: Array, target: Array) -> Array:
     """
     check_retrieval_inputs(preds, target)
     rel = (target > 0).astype(jnp.float32)
-    r = int(jnp.sum(rel))
-    if r == 0:
-        return jnp.asarray(0.0)
-    hits, _, _ = topk_mask_count(preds, rel, r)
-    return hits / r
+    r = jnp.sum(rel)
+    hits = mask_within_rank(preds, rel, r)
+    return jnp.where(r == 0, 0.0, hits / jnp.maximum(r, 1.0))

@@ -20,17 +20,27 @@ def check_topk(k: Optional[int]) -> None:
         raise ValueError("`k` has to be a positive integer or None")
 
 
+def mask_within_rank(preds: Array, mask: Array, r) -> Array:
+    """Sum of ``mask`` rows ranked in the top ``r`` by descending score.
+
+    The single source of the single-query ranking rule: descending score,
+    stable on ties — matching the grouped kernels. ``r`` may be a static int
+    or a traced scalar (e.g. R-precision's per-query relevant count).
+    """
+    order = jnp.argsort(-preds.astype(jnp.float32), stable=True)
+    ranks = jnp.arange(mask.shape[0], dtype=jnp.float32)
+    return jnp.sum(jnp.where(ranks < r, mask[order], 0.0))
+
+
 def topk_mask_count(preds: Array, mask: Array, k: Optional[int]) -> Tuple[Array, Array, int]:
     """(mask rows within the top-k, total mask rows, effective k).
 
-    The single source of the single-query ranking rule: descending score,
-    stable on ties, top-k truncated at the query size — matching the grouped
-    kernels.
+    Top-k is truncated at the query size; ranking rule from
+    ``mask_within_rank``.
     """
     n = mask.shape[0]
     k_eff = n if k is None else k
-    order = jnp.argsort(-preds.astype(jnp.float32), stable=True)
-    in_topk = jnp.sum(mask[order][: min(k_eff, n)])
+    in_topk = mask_within_rank(preds, mask, min(k_eff, n))
     return in_topk, jnp.sum(mask), k_eff
 
 

@@ -115,6 +115,12 @@ def edit_distance_padded(pred_ids: Array, target_ids: Array, pred_len: Array, ta
     Returns:
         (B,) int32 edit distances.
 
+    Lengths must satisfy ``0 <= pred_len[i] <= N`` and
+    ``0 <= target_len[i] <= M``. Concrete out-of-range lengths raise a
+    ``ValueError``; under tracing (where values are unknown) they are clamped
+    into range, so a traced out-of-range length yields the distance at the
+    clamp boundary rather than an error.
+
     Example:
         >>> import jax.numpy as jnp
         >>> p = jnp.array([[1, 2, 3, 0]])
@@ -122,4 +128,17 @@ def edit_distance_padded(pred_ids: Array, target_ids: Array, pred_len: Array, ta
         >>> int(edit_distance_padded(p, t, jnp.array([3]), jnp.array([4]))[0])
         2
     """
+    from metrics_tpu.utils.data import is_concrete
+
+    n, m = pred_ids.shape[1], target_ids.shape[1]
+    for name, lens, hi in (("pred_len", pred_len, n), ("target_len", target_len, m)):
+        if is_concrete(lens):
+            vals = np.asarray(lens)
+            if vals.size and (vals.min() < 0 or vals.max() > hi):
+                raise ValueError(
+                    f"`{name}` must lie in [0, {hi}] (the padded axis length); "
+                    f"got range [{vals.min()}, {vals.max()}]"
+                )
+    pred_len = jnp.clip(pred_len, 0, n)
+    target_len = jnp.clip(target_len, 0, m)
     return jax.vmap(_edit_distance_single)(pred_ids, target_ids, pred_len, target_len)

@@ -35,6 +35,24 @@ ReduceFx = Union[str, Callable, None]
 _STR_REDUCTIONS = ("sum", "mean", "cat", "min", "max")
 
 
+def associative(fn: Callable) -> Callable:
+    """Mark a callable ``dist_reduce_fx`` as an associative fold over axis 0.
+
+    A plain callable reduction (reference metric.py:135-142 semantics) is
+    applied once to the ``(world, ...)`` stack and nothing more can be assumed
+    about it. A callable marked associative promises ``fn(stack([a, b]))`` is a
+    valid pairwise merge — which lets the fused forward merge a batch delta
+    into the accumulator (``merge_values``) and checkpoint shards fold
+    pairwise, exactly like the built-in ``sum``/``min``/``max`` strings.
+    """
+    fn._mtpu_associative = True
+    return fn
+
+
+def is_associative(fx: ReduceFx) -> bool:
+    return callable(fx) and getattr(fx, "_mtpu_associative", False)
+
+
 def canonicalize_reduce_fx(fx: ReduceFx) -> ReduceFx:
     """Validate and canonicalize a ``dist_reduce_fx`` argument."""
     if fx is None or callable(fx):
@@ -86,6 +104,8 @@ def merge_values(fx: ReduceFx, acc: Any, delta: Any) -> Any:
         return jnp.minimum(acc, delta)
     if fx == "max":
         return jnp.maximum(acc, delta)
+    if is_associative(fx):
+        return fx(jnp.stack([acc, delta]))
     raise ValueError(f"Reduction {fx!r} has no pairwise merge; metric must use the unfused update path.")
 
 
@@ -93,7 +113,7 @@ def is_mergeable(fx: ReduceFx, default: Any) -> bool:
     """Whether a state with this reduction supports pairwise merge (fused forward)."""
     if isinstance(default, (list, PaddedBuffer)) or fx == "cat":
         return True
-    return fx in ("sum", "min", "max")
+    return fx in ("sum", "min", "max") or is_associative(fx)
 
 
 def sync_value(fx: ReduceFx, value: Any, axis_name: str) -> Any:

@@ -1,8 +1,11 @@
 """PearsonCorrcoef module.
 
-Extension beyond the reference snapshot (later torchmetrics ships it);
-streaming raw-moment sum-states, so the whole metric accumulates and syncs
-like the other regression moments (one fused psum, no sample buffers).
+Extension beyond the reference snapshot (later torchmetrics ships it). The
+whole metric is one ``(6,)`` co-moment state ``[n, mean_x, mean_y, M2x, M2y,
+Cxy]`` with a Chan parallel-merge fold as its distributed reduction — centered
+accumulation (no raw-moment cancellation), O(1) memory, and the same
+associative merge powers the fused forward, cross-device sync, and checkpoint
+shard merging. See ``metrics_tpu.functional.regression.pearson``.
 """
 from typing import Any, Callable, Optional
 
@@ -10,11 +13,14 @@ import numpy as np
 from jax import Array
 
 from metrics_tpu.core.metric import Metric
-from metrics_tpu.functional.regression.pearson import _pearson_compute, _pearson_update
+from metrics_tpu.functional.regression.pearson import batch_comoments, chan_fold, chan_merge, comoments_corrcoef
 
 
 class PearsonCorrcoef(Metric):
     r"""Accumulated Pearson correlation coefficient.
+
+    Returns ``nan`` when either accumulated input has zero variance
+    (scipy convention).
 
     Example:
         >>> import jax.numpy as jnp
@@ -40,30 +46,31 @@ class PearsonCorrcoef(Metric):
         )
         from metrics_tpu.utils.data import accum_int_dtype
 
-        for name in ("sum_x", "sum_y", "sum_xx", "sum_yy", "sum_xy"):
-            self.add_state(name, default=np.zeros((), dtype=np.float32), dist_reduce_fx="sum")
-        # integer count in the package accumulator dtype: float32 counts stop
-        # incrementing near 2^28 samples, and the int path gets the shared
-        # overflow probe warning
+        self.add_state("comoments", default=np.zeros((6,), dtype=np.float32), dist_reduce_fx=chan_fold)
+        # exact integer sample count alongside the float32 n carried in the
+        # comoment vector: float32 counts saturate at 2^24 (the merge weights
+        # then degrade to a moving window), and int states get the shared
+        # async overflow probe
         self.add_state("n_total", default=np.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
 
+    # float32 integers stop incrementing at 2^24; past this the comoment
+    # merge weights nb/n are computed against a frozen n
+    _F32_COUNT_SATURATION = 2**24
+
     def update(self, preds: Array, target: Array) -> None:
-        sx, sy, sxx, syy, sxy, _ = _pearson_update(preds, target)
-        self.sum_x = self.sum_x + sx
-        self.sum_y = self.sum_y + sy
-        self.sum_xx = self.sum_xx + sxx
-        self.sum_yy = self.sum_yy + syy
-        self.sum_xy = self.sum_xy + sxy
+        self.comoments = chan_merge(self.comoments, batch_comoments(preds, target))
         self.n_total = self.n_total + preds.shape[0]
 
     def compute(self) -> Array:
-        import jax.numpy as jnp
+        from metrics_tpu.utils.data import is_concrete
+        from metrics_tpu.utils.prints import rank_zero_warn
 
-        return _pearson_compute(
-            self.sum_x,
-            self.sum_y,
-            self.sum_xx,
-            self.sum_yy,
-            self.sum_xy,
-            self.n_total.astype(jnp.float32),
-        )
+        if is_concrete(self.n_total) and int(self.n_total) >= self._F32_COUNT_SATURATION:
+            rank_zero_warn(
+                f"PearsonCorrcoef has accumulated {int(self.n_total)} samples; the float32"
+                " sample count carried in the co-moment state saturates at 2^24, so further"
+                " accumulation behaves as a ~16.7M-sample moving window rather than a true"
+                " running mean.",
+                UserWarning,
+            )
+        return comoments_corrcoef(self.comoments)

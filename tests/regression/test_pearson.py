@@ -55,6 +55,20 @@ def test_pearson_errors_and_edge_cases():
         m.update(jnp.zeros((4, 2)), jnp.zeros((4, 2)))
     with pytest.raises(RuntimeError, match="same shape"):
         pearson_corrcoef(jnp.zeros(3), jnp.zeros(4))
-    # constant input: zero variance -> r defined as 0, not nan/inf
+    # constant input: zero variance -> nan (scipy convention)
     r = pearson_corrcoef(jnp.ones(8), jnp.arange(8.0))
-    assert float(r) == 0.0
+    assert np.isnan(float(r))
+
+
+def test_pearson_large_offset_no_cancellation():
+    # raw-moment accumulation fails catastrophically here (|mean| >> std);
+    # the centered Chan-merge states must stay accurate
+    rng = np.random.RandomState(3)
+    x = (1000.0 + rng.randn(10_000)).astype(np.float32)
+    y = (0.7 * (x - 1000.0) + 0.3 * rng.randn(10_000) + 5000.0).astype(np.float32)
+    want = _sk_pearson(x, y)
+    np.testing.assert_allclose(float(pearson_corrcoef(jnp.asarray(x), jnp.asarray(y))), want, atol=1e-4)
+    m = PearsonCorrcoef()
+    for i in range(0, 10_000, 500):
+        m.update(jnp.asarray(x[i : i + 500]), jnp.asarray(y[i : i + 500]))
+    np.testing.assert_allclose(float(m.compute()), want, atol=1e-4)

@@ -64,5 +64,5 @@ def test_spearman_errors():
         spearman_corrcoef(jnp.zeros(3), jnp.zeros(4))
     with pytest.raises(ValueError, match="1D"):
         SpearmanCorrcoef().update(jnp.zeros((4, 2)), jnp.zeros((4, 2)))
-    # constant input: zero rank variance -> 0, not nan
-    assert float(spearman_corrcoef(jnp.ones(6), jnp.arange(6.0))) == 0.0
+    # constant input: zero rank variance -> nan (scipy convention)
+    assert np.isnan(float(spearman_corrcoef(jnp.ones(6), jnp.arange(6.0))))

@@ -278,3 +278,20 @@ def test_bad_k_raises():
             cls(k=0)
     with pytest.raises(ValueError, match="positive integer"):
         retrieval_precision(jnp.array([0.1]), jnp.array([1]), k=-1)
+
+
+def test_functional_r_precision_trace_safe():
+    """R is computed on device: the functional must compose under jit/vmap."""
+    import jax
+    from metrics_tpu.functional.retrieval import retrieval_r_precision
+
+    np.random.seed(42)
+    t = np.random.randint(0, 2, size=(4, 12))
+    t[t.sum(1) == 0, 0] = 1
+    p = np.random.randn(4, 12).astype(np.float32)
+    batched = jax.jit(jax.vmap(retrieval_r_precision))(jnp.asarray(p), jnp.asarray(t))
+    for i in range(4):
+        np.testing.assert_allclose(float(batched[i]), _np_r_precision(t[i], p[i]), atol=1e-6)
+    # no-relevant query under vmap (the r==0 branch must be trace-safe too)
+    z = jax.jit(retrieval_r_precision)(jnp.asarray(p[0]), jnp.zeros(12, dtype=jnp.int32))
+    assert float(z) == 0.0

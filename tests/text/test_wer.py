@@ -119,3 +119,19 @@ def test_device_edit_distance_jit_and_counts_path():
     m = WER()
     m.update_counts(dists, tl)
     np.testing.assert_allclose(float(m.compute()), 4 / 6, atol=1e-7)
+
+
+def test_edit_distance_length_validation():
+    import jax
+
+    p = jnp.array([[1, 2, 3, 0]])
+    t = jnp.array([[1, 9, 3, 4]])
+    with pytest.raises(ValueError, match="target_len"):
+        edit_distance_padded(p, t, jnp.array([3]), jnp.array([5]))
+    with pytest.raises(ValueError, match="pred_len"):
+        edit_distance_padded(p, t, jnp.array([-1]), jnp.array([4]))
+    # under tracing values are unknown: out-of-range lengths clamp to the
+    # boundary instead of erroring (documented contract)
+    out = jax.jit(edit_distance_padded)(p, t, jnp.array([3]), jnp.array([9]))
+    want = edit_distance_padded(p, t, jnp.array([3]), jnp.array([4]))
+    assert int(out[0]) == int(want[0])
