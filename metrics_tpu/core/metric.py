@@ -754,14 +754,21 @@ class Metric(ABC):
     def device_put(self, device_or_sharding: Any) -> "Metric":
         """Place all states on a device or ``jax.sharding.Sharding`` (the
         TPU-native analogue of the reference's ``_apply`` device movement,
-        metric.py:281-298)."""
+        metric.py:281-298).
+
+        Accepts a callable ``(state_name, value) -> device | Sharding`` for
+        per-state placement — e.g. class-axis states sharded over a model
+        axis of a 2-D mesh while scalar counters stay replicated (see
+        ``metrics_tpu.parallel.placement.class_sharded``).
+        """
         self._placement = device_or_sharding
+        resolve = device_or_sharding if callable(device_or_sharding) else (lambda _n, _v: device_or_sharding)
         for name in self._defaults:
             value = getattr(self, name)
             if isinstance(value, list):
-                setattr(self, name, [jax.device_put(v, device_or_sharding) for v in value])
+                setattr(self, name, [jax.device_put(v, resolve(name, v)) for v in value])
             else:
-                setattr(self, name, jax.device_put(value, device_or_sharding))
+                setattr(self, name, jax.device_put(value, resolve(name, value)))
         return self
 
     def astype(self, dtype: Any) -> "Metric":

@@ -21,7 +21,7 @@ from a true running mean into a ~2^24-window moving average. The
 ``PearsonCorrcoef`` module tracks the exact count in an integer state and
 warns when accumulation crosses that regime.
 """
-from typing import Tuple
+
 
 import jax.numpy as jnp
 from jax import Array
@@ -97,14 +97,6 @@ def comoments_corrcoef(c: Array) -> Array:
     convention — degenerate input is undefined, not "uncorrelated")."""
     denom = jnp.sqrt(jnp.maximum(c[_M2X], 0.0) * jnp.maximum(c[_M2Y], 0.0))
     return jnp.where(denom == 0, jnp.nan, c[_CXY] / jnp.where(denom == 0, 1.0, denom))
-
-
-def _pearson_update(preds: Array, target: Array) -> Tuple[Array]:
-    return (batch_comoments(preds, target),)
-
-
-def _pearson_compute(comoments: Array) -> Array:
-    return comoments_corrcoef(comoments)
 
 
 def pearson_corrcoef(preds: Array, target: Array) -> Array:

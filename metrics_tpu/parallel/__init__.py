@@ -7,6 +7,7 @@ from metrics_tpu.parallel.buffer import (
     buffer_merge,
     buffer_values,
 )
+from metrics_tpu.parallel.placement import batch_sharded, class_sharded
 from metrics_tpu.parallel.sync import (
     gather_all_arrays,
     host_gather,

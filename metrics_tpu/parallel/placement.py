@@ -1,0 +1,66 @@
+"""Per-state mesh placement policies for 2-D (data x model) deployments.
+
+The deployment story the north star asks for: per-class metric states live
+*sharded* over a model axis of the device mesh while every step's update syncs
+data-parallel shards over the data axis — all inside one jitted program. With
+``NamedSharding``-annotated states and data, XLA's SPMD partitioner splits the
+per-class compute over the model axis and inserts the cross-``dp`` reduction
+automatically (the scaling-book recipe: annotate shardings, let XLA place the
+collectives; no reference counterpart — reference sync is a flat NCCL
+all-gather per state, torchmetrics/utilities/distributed.py:91-118).
+"""
+from typing import Any, Callable, Collection, Optional
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def class_sharded(
+    mesh: Mesh, axis: str = "mp", names: Optional[Collection[str]] = None
+) -> Callable[[str, Any], NamedSharding]:
+    """Placement callable for ``Metric.device_put``: shard the leading
+    (class) axis of array states over mesh axis ``axis``; replicate
+    everything else.
+
+    A state is sharded only when its leading dimension is divisible by the
+    ``axis`` size (``NamedSharding`` does not pad); scalars, non-array states
+    (PaddedBuffers, lists), and non-divisible states stay replicated, so one
+    policy can cover a whole heterogeneous collection. Pass ``names`` to
+    restrict sharding to specific state names (e.g. ``{"tp", "fp", "fn",
+    "tn", "confmat"}``) when a metric carries a rank>=1 state whose leading
+    axis is *not* the class axis.
+
+    Example — states sharded over ``mp`` while updates arrive sharded over
+    ``dp``::
+
+        mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("dp", "mp"))
+        collection.device_put(class_sharded(mesh, "mp"))
+    """
+    axis_size = mesh.shape[axis]
+
+    def resolve(name: str, value: Any) -> NamedSharding:
+        ndim = getattr(value, "ndim", None)
+        if not ndim:  # scalars, PaddedBuffers, lists: replicate
+            return NamedSharding(mesh, P())
+        if names is not None and name not in names:
+            return NamedSharding(mesh, P())
+        if value.shape[0] % axis_size:
+            return NamedSharding(mesh, P())
+        return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+
+    return resolve
+
+
+def batch_sharded(mesh: Mesh, axis: str = "dp") -> Callable[[Any], Any]:
+    """Shard a batch pytree's leading axis over mesh axis ``axis`` (helper for
+    placing input data on the same mesh as the states)."""
+    import jax
+
+    def place(batch: Any) -> Any:
+        def leaf(x):
+            nd = getattr(x, "ndim", 0)
+            spec = P(axis, *([None] * (nd - 1))) if nd else P()
+            return jax.device_put(x, NamedSharding(mesh, spec))
+
+        return jax.tree_util.tree_map(leaf, batch)
+
+    return place

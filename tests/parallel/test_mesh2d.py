@@ -1,0 +1,163 @@
+"""The real pod shape: a 2-D (dp x mp) mesh.
+
+Data-parallel sync over ``dp`` *while* per-class states live sharded over
+``mp`` — in one jitted program, asserted numerically against sklearn. Two
+idiomatic forms:
+
+- GSPMD: states carry ``NamedSharding`` over ``mp``, inputs arrive sharded
+  over ``dp``; XLA's partitioner splits the per-class compute and inserts the
+  cross-``dp`` reduction (no manual collectives).
+- Manual SPMD (``shard_map`` over both axes): each (dp, mp) shard computes
+  stats from its local data shard, ``psum`` over ``dp``, then keeps only its
+  ``mp`` class block; ``out_specs`` reassemble the sharded states.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from sklearn.metrics import accuracy_score as sk_accuracy_score
+from sklearn.metrics import confusion_matrix as sk_confusion_matrix
+from sklearn.metrics import f1_score as sk_f1_score
+from sklearn.metrics import precision_score as sk_precision_score
+
+from metrics_tpu import Accuracy, ConfusionMatrix, F1, MetricCollection, Precision
+from metrics_tpu.parallel import batch_sharded, class_sharded
+
+NUM_CLASSES = 8
+
+
+@pytest.fixture()
+def mesh2d(eight_devices):
+    return Mesh(np.array(eight_devices).reshape(4, 2), ("dp", "mp"))
+
+
+def _random_labels(rng, n):
+    p = rng.randint(0, NUM_CLASSES, n).astype(np.int32)
+    t = rng.randint(0, NUM_CLASSES, n).astype(np.int32)
+    return p, t
+
+
+def test_collection_2d_mesh_gspmd(mesh2d):
+    """North-star flow: class states sharded over mp, batches sharded over dp,
+    scalar states replicated — full collection, sklearn-exact."""
+    collection = MetricCollection([
+        Accuracy(),  # scalar states: stay replicated on the 2-D mesh
+        Precision(num_classes=NUM_CLASSES, average="macro"),
+        F1(num_classes=NUM_CLASSES, average="macro"),
+        ConfusionMatrix(num_classes=NUM_CLASSES),
+    ])
+    collection.device_put(class_sharded(mesh2d, "mp"))
+    place = batch_sharded(mesh2d, "dp")
+
+    rng = np.random.RandomState(23)
+    all_p, all_t = [], []
+    for _ in range(3):
+        p, t = _random_labels(rng, 256)
+        sp, st = place((jnp.asarray(p), jnp.asarray(t)))
+        assert sp.sharding.spec == P("dp")
+        collection.update(sp, st)
+        all_p.append(p)
+        all_t.append(t)
+
+    p_all, t_all = np.concatenate(all_p), np.concatenate(all_t)
+
+    # states really live sharded over mp / replicated for scalars
+    prec = collection["Precision"]
+    assert prec.tp.sharding == NamedSharding(mesh2d, P("mp"))
+    cm = collection["ConfusionMatrix"]
+    assert cm.confmat.sharding == NamedSharding(mesh2d, P("mp", None))
+    acc = collection["Accuracy"]
+    assert acc.correct.sharding.is_fully_replicated
+
+    out = collection.compute()
+    np.testing.assert_allclose(float(out["Accuracy"]), sk_accuracy_score(t_all, p_all), atol=1e-6)
+    np.testing.assert_allclose(
+        float(out["Precision"]), sk_precision_score(t_all, p_all, average="macro", zero_division=0), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        float(out["F1"]), sk_f1_score(t_all, p_all, average="macro", zero_division=0), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(out["ConfusionMatrix"]),
+        sk_confusion_matrix(t_all, p_all, labels=list(range(NUM_CLASSES))),
+    )
+
+    # reset preserves the 2-D placement (epoch boundary on the pod)
+    collection.reset()
+    assert prec.tp.sharding == NamedSharding(mesh2d, P("mp"))
+    p2, t2 = _random_labels(rng, 128)
+    collection.update(*place((jnp.asarray(p2), jnp.asarray(t2))))
+    np.testing.assert_allclose(
+        float(collection.compute()["Accuracy"]), sk_accuracy_score(t2, p2), atol=1e-6
+    )
+
+
+def test_pure_step_2d_mesh_shard_map(mesh2d):
+    """Manual-SPMD form of the same deployment: one jitted shard_map step over
+    BOTH axes — per-shard update from the local dp data block, psum over dp,
+    then each device keeps its mp class block; states come back sharded."""
+    metric = Precision(num_classes=NUM_CLASSES, average="macro")
+    pure = metric.pure()
+    n_mp = mesh2d.shape["mp"]
+    block = NUM_CLASSES // n_mp
+
+    def step(preds, target):
+        state = pure.update(pure.init(), preds, target)
+        state = pure.sync(state, "dp")  # data-parallel reduction (psum)
+        mp_idx = jax.lax.axis_index("mp")
+        # keep only this device's class block -> states stay sharded over mp
+        return {k: jax.lax.dynamic_slice_in_dim(v, mp_idx * block, block) for k, v in state.items()}
+
+    state_spec = {k: P("mp") for k in pure.init()}
+    sharded_step = jax.jit(
+        jax.shard_map(
+            step,
+            mesh=mesh2d,
+            in_specs=(P("dp"), P("dp")),
+            out_specs=state_spec,
+            check_vma=False,  # psum over dp replicates; slicing by mp index re-shards
+        )
+    )
+
+    rng = np.random.RandomState(29)
+    p, t = _random_labels(rng, 512)
+    state = sharded_step(jnp.asarray(p), jnp.asarray(t))
+
+    # the returned state is genuinely sharded over mp on the 2-D mesh
+    assert state["tp"].shape == (NUM_CLASSES,)
+    assert state["tp"].sharding.spec == P("mp")
+
+    result = pure.compute(state)
+    expected = sk_precision_score(t, p, average="macro", zero_division=0)
+    np.testing.assert_allclose(float(result), expected, atol=1e-6)
+
+    # second step merges into the first via the metric's own associative merge
+    p2, t2 = _random_labels(rng, 512)
+    state = pure.merge(state, sharded_step(jnp.asarray(p2), jnp.asarray(t2)))
+    expected2 = sk_precision_score(
+        np.concatenate([t, t2]), np.concatenate([p, p2]), average="macro", zero_division=0
+    )
+    np.testing.assert_allclose(float(pure.compute(state)), expected2, atol=1e-6)
+
+
+def test_class_sharded_policy_heterogeneous(mesh2d):
+    """Non-divisible and non-class states replicate instead of crashing; the
+    names filter restricts sharding to declared class-axis states."""
+    from metrics_tpu import PearsonCorrcoef
+
+    policy = class_sharded(mesh2d, "mp")
+    m = Precision(num_classes=7, average="macro")  # 7 % 2 != 0 -> replicate
+    m.device_put(policy)
+    assert m.tp.sharding.is_fully_replicated
+
+    pc = PearsonCorrcoef()
+    pc.device_put(class_sharded(mesh2d, "mp", names={"tp"}))
+    assert pc.comoments.sharding.is_fully_replicated
+
+    rng = np.random.RandomState(31)
+    p, t = rng.randint(0, 7, 128).astype(np.int32), rng.randint(0, 7, 128).astype(np.int32)
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    np.testing.assert_allclose(
+        float(m.compute()), sk_precision_score(t, p, average="macro", zero_division=0), atol=1e-6
+    )
