@@ -12,6 +12,10 @@ from metrics_tpu.utils.prints import rank_zero_warn
 class AUROC(Metric):
     """Area under the ROC curve, over all data seen.
 
+    At pod scale, keep the epoch sharded instead of gathered:
+    ``metrics_tpu.parallel.sharded_auroc`` computes the same exact value
+    inside ``shard_map`` with O(N/n) per-device memory (ring pass).
+
     Example (binary):
         >>> import jax.numpy as jnp
         >>> preds = jnp.array([0.13, 0.26, 0.08, 0.19, 0.34])

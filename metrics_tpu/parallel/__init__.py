@@ -8,6 +8,12 @@ from metrics_tpu.parallel.buffer import (
     buffer_values,
 )
 from metrics_tpu.parallel.placement import batch_sharded, class_sharded
+from metrics_tpu.parallel.sharded_epoch import (
+    regroup_by_query,
+    sharded_auroc,
+    sharded_average_precision,
+    sharded_retrieval_sums,
+)
 from metrics_tpu.parallel.sync import (
     gather_all_arrays,
     host_gather,

@@ -1,0 +1,217 @@
+"""Exact epoch compute over *sharded* epoch data — O(N/n) per-device memory.
+
+The reference computes epoch metrics on gathered data: every rank materializes
+the full epoch before compute (reference torchmetrics/metric.py:188-197), and
+round-2's in-jit plane kept that shape (``buffer_all_gather`` replicates the
+union). At pod scale that is O(dataset) per device. This module keeps the
+epoch sharded *through* compute:
+
+- **Curve scalars (AUROC / average precision)**: a ring pass. Each device
+  sorts its local shard once, then the sorted pack circulates over the mesh
+  axis via ``lax.ppermute`` (n-1 hops riding ICI, ring-attention style). At
+  each hop a device accumulates, for every local element, the visiting
+  shard's weight-below / tie / weight-≥ statistics via ``searchsorted`` on
+  the sorted pack. After the ring:
+
+  * AUROC is the Mann-Whitney U statistic — per positive item, the global
+    negative weight strictly below its score plus half the tied weight;
+    ``U / (P·N)`` equals sklearn's trapezoidal ROC area exactly (a tie-run's
+    diagonal segment is exactly half credit).
+  * AP is the per-item form of the step integral: each positive contributes
+    ``w · TP≥/(TP≥+FP≥)`` at its score's tie-run end; summed and divided by
+    total positive weight this is exactly ``Σ (R_n−R_{n−1})·P_n`` (reference
+    functional/classification/average_precision.py:46-52), because every
+    positive in a tie-run sees the run-final cumulative counts — the same
+    run-end snapping as ``curve_static.py``, distributed.
+
+  Per-device memory stays O(N/n); compute is O((N/n)·log(N/n)·n).
+
+- **Retrieval (grouped per-query) metrics**: an ``all_to_all`` regroup. Rows
+  route to shard ``query_id mod n`` through static-capacity buckets (overflow
+  is counted, never silent), so each query lands wholly on one shard; each
+  shard then runs the SAME vectorized grouped engine the single-device path
+  uses (``RetrievalMetric._device_sums``) on its local queries, and one
+  ``psum`` of (score-total, query-count) yields the exact global mean.
+
+Use inside ``shard_map`` over the data axis. All functions are jit-safe,
+static-shape, and collective-only (no host round trips).
+"""
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+# pad query id for regroup ghost rows; real query ids must not use it
+PAD_QUERY_ID = jnp.iinfo(jnp.int32).max
+
+
+class _SortedPack(NamedTuple):
+    """One shard's sorted scores + cumulative class weights (the ring payload)."""
+
+    scores: Array  # (m,) ascending
+    cum_wp: Array  # (m,) cumulative positive weight
+    cum_wn: Array  # (m,) cumulative negative weight
+
+
+def _pack(preds: Array, target: Array, weights: Optional[Array]) -> _SortedPack:
+    order = jnp.argsort(preds)
+    s = preds[order]
+    y = target[order].astype(jnp.float32)
+    w = jnp.ones_like(y) if weights is None else weights[order].astype(jnp.float32)
+    return _SortedPack(s, jnp.cumsum(w * y), jnp.cumsum(w * (1.0 - y)))
+
+
+def _below_tie_ge(pack: _SortedPack, q: Array) -> Tuple[Array, Array, Array, Array]:
+    """Per query score: visiting-shard weight sums (neg-below, neg-tied,
+    pos-≥, neg-≥) — the four statistics AUROC/AP need."""
+    left = jnp.searchsorted(pack.scores, q, side="left")
+    right = jnp.searchsorted(pack.scores, q, side="right")
+
+    def at(cum: Array, i: Array) -> Array:
+        return jnp.where(i > 0, cum[jnp.maximum(i - 1, 0)], 0.0)
+
+    wn_below = at(pack.cum_wn, left)
+    wn_tie = at(pack.cum_wn, right) - wn_below
+    wp_ge = pack.cum_wp[-1] - at(pack.cum_wp, left)
+    wn_ge = pack.cum_wn[-1] - wn_below
+    return wn_below, wn_tie, wp_ge, wn_ge
+
+
+def _ring_stats(
+    preds: Array, target: Array, weights: Optional[Array], axis_name: str
+) -> Tuple[Array, Array, Array, Array]:
+    """Accumulate the four global statistics for every local element by
+    circulating each shard's sorted pack around the mesh axis ring."""
+    n = jax.lax.axis_size(axis_name)
+    pack = _pack(preds, target, weights)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def body(_, carry):
+        acc, visiting = carry
+        visiting = jax.lax.ppermute(visiting, axis_name, perm)
+        acc = tuple(a + b for a, b in zip(acc, _below_tie_ge(visiting, preds)))
+        return acc, visiting
+
+    # local contribution first, then n-1 ring hops (no dead final collective)
+    acc = _below_tie_ge(pack, preds)
+    (acc, _) = jax.lax.fori_loop(0, n - 1, body, (acc, pack))
+    return acc
+
+
+def sharded_auroc(
+    preds: Array, target: Array, axis_name: str, sample_weights: Optional[Array] = None
+) -> Array:
+    """Exact binary AUROC over epoch data sharded along ``axis_name``.
+
+    Call inside ``shard_map``; ``preds``/``target`` are the LOCAL shard.
+    Matches ``sklearn.metrics.roc_auc_score`` on the concatenated epoch,
+    including cross-shard score ties. ``nan`` when a class is absent
+    globally. Rows can be neutralized with ``sample_weights=0`` (padding).
+    """
+    wn_below, wn_tie, _, _ = _ring_stats(preds, target, sample_weights, axis_name)
+    y = target.astype(jnp.float32)
+    w = jnp.ones_like(y) if sample_weights is None else sample_weights.astype(jnp.float32)
+    wp = w * y
+    u_local = jnp.sum(wp * (wn_below + 0.5 * wn_tie))
+    pos = jax.lax.psum(jnp.sum(wp), axis_name)
+    neg = jax.lax.psum(jnp.sum(w * (1.0 - y)), axis_name)
+    u = jax.lax.psum(u_local, axis_name)
+    denom = pos * neg
+    return jnp.where(denom == 0, jnp.nan, u / jnp.where(denom == 0, 1.0, denom))
+
+
+def sharded_average_precision(
+    preds: Array, target: Array, axis_name: str, sample_weights: Optional[Array] = None
+) -> Array:
+    """Exact binary average precision over epoch data sharded along
+    ``axis_name`` (see module docstring for the per-item identity).
+
+    Matches the reference step integral / ``sklearn.average_precision_score``
+    on the concatenated epoch. ``nan`` with zero positive weight.
+    """
+    _, _, wp_ge, wn_ge = _ring_stats(preds, target, sample_weights, axis_name)
+    y = target.astype(jnp.float32)
+    w = jnp.ones_like(y) if sample_weights is None else sample_weights.astype(jnp.float32)
+    wp = w * y
+    contrib = jnp.sum(wp * wp_ge / jnp.maximum(wp_ge + wn_ge, 1e-38))
+    pos = jax.lax.psum(jnp.sum(wp), axis_name)
+    total = jax.lax.psum(contrib, axis_name)
+    return jnp.where(pos == 0, jnp.nan, total / jnp.where(pos == 0, 1.0, pos))
+
+
+def regroup_by_query(
+    idx: Array,
+    preds: Array,
+    target: Array,
+    axis_name: str,
+    capacity: Optional[int] = None,
+) -> Tuple[Array, Array, Array, Array, Array]:
+    """Route rows to shard ``query_id mod n`` so each query lands wholly on
+    one shard (static-shape ``all_to_all`` through per-destination buckets).
+
+    Returns ``(idx, preds, target, pad, dropped)`` where the first four have
+    shape ``(n * capacity,)``, ``pad`` marks ghost rows, and ``dropped`` is
+    the GLOBAL count of rows that overflowed their destination bucket —
+    assert it is zero outside jit (never silently wrong). ``capacity``
+    defaults to ``2 * ceil(local_rows / n)``; raise it for skewed query-id
+    distributions.
+    """
+    n = jax.lax.axis_size(axis_name)
+    rows = idx.shape[0]
+    if capacity is None:
+        capacity = max(2 * -(-rows // n), 1)
+
+    dest = idx % n  # floor-mod: negative ids still land in [0, n)
+    order = jnp.argsort(dest, stable=True)
+    sorted_dest = dest[order]
+    counts = jax.ops.segment_sum(jnp.ones((rows,), jnp.int32), sorted_dest, n)
+    starts = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]])
+    slot = jnp.arange(rows, dtype=jnp.int32) - starts[sorted_dest]
+
+    in_range = slot < capacity
+    flat = jnp.where(in_range, sorted_dest * capacity + slot, n * capacity)  # OOB -> drop
+
+    def scatter(values: Array, fill) -> Array:
+        out = jnp.full((n * capacity,), fill, dtype=values.dtype)
+        return out.at[flat].set(values[order], mode="drop")
+
+    bucket_idx = scatter(idx, PAD_QUERY_ID).reshape(n, capacity)
+    bucket_preds = scatter(preds, jnp.float32(-jnp.inf)).reshape(n, capacity)
+    bucket_target = scatter(target, jnp.zeros((), target.dtype)).reshape(n, capacity)
+    bucket_real = scatter(jnp.ones((rows,), jnp.bool_), False).reshape(n, capacity)
+
+    ex = partial(jax.lax.all_to_all, axis_name=axis_name, split_axis=0, concat_axis=0, tiled=True)
+    my_idx = ex(bucket_idx).reshape(-1)
+    my_preds = ex(bucket_preds).reshape(-1)
+    my_target = ex(bucket_target).reshape(-1)
+    my_real = ex(bucket_real).reshape(-1)
+
+    dropped = jax.lax.psum(jnp.sum(jnp.maximum(counts - capacity, 0)), axis_name)
+    return my_idx, my_preds, my_target, ~my_real, dropped
+
+
+def sharded_retrieval_sums(
+    metric,
+    idx: Array,
+    preds: Array,
+    target: Array,
+    axis_name: str,
+    capacity: Optional[int] = None,
+) -> Tuple[Array, Array, Array]:
+    """Exact global (mean, empty-query flag, dropped-row count) for a
+    ``RetrievalMetric`` over epoch rows sharded along ``axis_name``.
+
+    ``metric`` provides config (grouped kernel, policy, ``exclude``); its
+    accumulated state is NOT read. Each shard scores only the queries routed
+    to it, then one psum combines the partial sums — per-device memory is
+    O(local rows), never O(dataset).
+    """
+    g_idx, g_preds, g_target, pad, dropped = regroup_by_query(idx, preds, target, axis_name, capacity)
+    total, count, flag = metric._device_sums(g_idx, g_preds, g_target, pad=pad)
+    total = jax.lax.psum(total, axis_name)
+    count = jax.lax.psum(count, axis_name)
+    flag = jax.lax.psum(flag.astype(jnp.int32), axis_name) > 0
+    mean = jnp.where(count == 0, 0.0, total / jnp.maximum(count, 1))
+    return mean, flag, dropped

@@ -158,6 +158,18 @@ class RetrievalMetric(Metric, ABC):
         are masked), and sentinel rows are neutralized by masking instead of
         boolean filtering, so the whole body is jit-safe.
         """
+        total, n_kept, flag = self._device_sums(idx, preds, target)
+        return jnp.where(n_kept == 0, 0.0, total / jnp.maximum(n_kept, 1)), flag
+
+    def _device_sums(self, idx: Array, preds: Array, target: Array, pad: Optional[Array] = None):
+        """(score total, query count, empty-query flag) — the pre-division
+        epoch sums, so distributed callers can psum partials across shards
+        before the final mean (``metrics_tpu.parallel.sharded_epoch``).
+
+        ``pad`` marks ghost rows (sharded-regroup padding): unlike user
+        ``exclude`` sentinels — which keep their query visible by reference
+        parity (:121) — pad rows must not make a query exist at all.
+        """
         n = int(idx.shape[0])
         order = jnp.argsort(idx, stable=True)
         sorted_ids = idx[order]
@@ -166,9 +178,12 @@ class RetrievalMetric(Metric, ABC):
         )
         dense = jnp.zeros((n,), jnp.int32).at[order].set(jnp.cumsum(new_segment))
 
-        counts = jax.ops.segment_sum(jnp.ones((n,), jnp.float32), dense, n)
+        real = jnp.ones((n,), jnp.float32) if pad is None else (~pad).astype(jnp.float32)
+        counts = jax.ops.segment_sum(real, dense, n)
         exists = counts > 0
 
+        if pad is not None:
+            target = jnp.where(pad, 0, target)
         empty = self._empty_query_mask(dense, target, exists, n)
         flag = jnp.any(empty)
         if self.query_without_relevant_docs == "error" and is_concrete(flag):
@@ -183,6 +198,8 @@ class RetrievalMetric(Metric, ABC):
         # below every real row of their query, zero targets null their gain
         # (reference filters them out per query, retrieval_metric.py:126-142)
         excluded = target == self.exclude
+        if pad is not None:
+            excluded = excluded | pad
         preds_m = jnp.where(excluded, -jnp.inf, preds)
         target_m = jnp.where(excluded, 0, target)
         scores = self._grouped_metric(dense, preds_m, target_m, n, valid=~excluded)
@@ -193,12 +210,10 @@ class RetrievalMetric(Metric, ABC):
             scores = jnp.where(empty, 0.0, scores)
         elif self.query_without_relevant_docs == "skip":
             kept = exists & ~empty
-            total = jnp.sum(jnp.where(kept, scores, 0.0))
-            n_kept = jnp.sum(kept)
-            return jnp.where(n_kept == 0, 0.0, total / jnp.maximum(n_kept, 1)), flag
+            return jnp.sum(jnp.where(kept, scores, 0.0)), jnp.sum(kept), flag
 
         present = jnp.sum(jnp.where(exists, scores, 0.0))
-        return present / jnp.maximum(jnp.sum(exists), 1), flag
+        return present, jnp.sum(exists), flag
 
     def _compute_cache_key(self) -> tuple:
         """Key for sharing the jitted compute across instances.
