@@ -51,7 +51,9 @@ from metrics_tpu.parallel.sync import (
     gather_all_arrays,
     host_gather,
     is_mergeable,
+    is_stack_mergeable,
     merge_values,
+    merge_values_stacked,
     sync_state as _sync_state_pure,
 )
 
@@ -104,7 +106,9 @@ def _bounded_insert(cache: Dict[Any, Any], key: Any, value: Any, max_size: int) 
 _NON_TRACE_ATTRS = frozenset({
     "update", "compute", "_update_signature", "_update_impl", "_compute_impl",
     "_computed", "_forward_cache", "_jitted_step", "_jitted_step_fc",
-    "_jit_failed", "_fc_failed", "_compute_jit_failed", "_overflow_probe", "_default_keys",
+    "_jitted_scan", "_scan_failed",
+    "_jit_failed", "_fc_failed", "_compute_jit_failed", "_count_bound", "_overflow_warned",
+    "_default_keys",
     "_to_sync", "_in_forward", "_sync_count", "dist_sync_fn",
     "_placement", "_state_dtype", "compute_on_step", "dist_sync_on_step",
     "process_group",
@@ -277,9 +281,12 @@ class Metric(ABC):
         self._reductions: Dict[str, ReduceFx] = {}
         self._jitted_step = None
         self._jitted_step_fc = None  # step that also computes the batch value
+        self._jitted_scan = None  # multi-batch scan step (forward_batched)
         self._jit_failed = False
         self._fc_failed = False  # compute cannot trace -> keep compute eager
-        self._overflow_probe = None  # async int32-overflow check (see below)
+        self._scan_failed = False  # scan step cannot trace -> per-step fallback
+        self._count_bound = 0  # host-side elements-processed bound (overflow warning)
+        self._overflow_warned = False
         self._placement = None  # last device/sharding passed to device_put; re-applied on reset
         self._state_dtype = None  # last float dtype passed to astype; re-applied on reset
 
@@ -353,12 +360,43 @@ class Metric(ABC):
             current.append(value)
 
     # ------------------------------------------------------------- pure core
+    @staticmethod
+    def _under_trace() -> bool:
+        try:
+            import jax.core as _core
+
+            return type(_core.trace_ctx.trace).__name__ != "EvalTrace"
+        except AttributeError:  # jax moved the API; be conservative
+            return False
+
     def init_state(self) -> State:
-        """Fresh default state pytree."""
+        """Fresh default state pytree.
+
+        Under tracing (inside jit/vmap — the step builders and the pure API
+        call this from traced code) array defaults come from the HOST numpy
+        specs, NOT the eager device-constant cache: a traced-over device
+        array must be read back to the host at lowering time to be embedded
+        as a compile-time constant, and through a remote-device tunnel a
+        single device-to-host readback permanently degrades every subsequent
+        dispatch in the process (~100 ms per block). Host-backed specs embed
+        for free. Eager callers keep the shared-transfer + private-copy path.
+        """
+        if self._under_trace():
+            return {
+                name: self._materialize_default_traced(spec) for name, spec in self._defaults.items()
+            }
         return {
             name: self._materialize_default(spec, self._default_keys.get(name))
             for name, spec in self._defaults.items()
         }
+
+    @staticmethod
+    def _materialize_default_traced(spec: Any) -> Any:
+        if isinstance(spec, _BufferSpec):
+            return buffer_init(spec.capacity, spec.item_shape, spec.dtype)
+        if isinstance(spec, list):
+            return []
+        return jnp.asarray(spec)  # numpy spec -> host-backed staged constant
 
     def _current_state(self) -> State:
         return {name: getattr(self, name) for name in self._defaults}
@@ -501,6 +539,7 @@ class Metric(ABC):
     def _forward_fused(self, *args: Any, **kwargs: Any) -> Any:
         self._computed = None
         self._forward_cache = None
+        self._note_rows(args, kwargs)
         delta = None
         value = self._NO_VALUE
         if self._jittable:
@@ -568,12 +607,14 @@ class Metric(ABC):
             self._to_sync = self.dist_sync_on_step
             self._in_forward = True
             cache = self._current_state()
+            bound = self._count_bound
             self.reset()
             try:
                 self.update(*args, **kwargs)
                 self._forward_cache = self.compute()
             finally:
                 self._set_state(cache)
+                self._count_bound = bound  # the temp reset must not lose the epoch bound
                 self._to_sync = True
                 self._in_forward = False
             self._computed = None
@@ -582,6 +623,139 @@ class Metric(ABC):
 
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
         return self.forward(*args, **kwargs)
+
+    # ------------------------------------------------------- batched forward
+    @property
+    def _stack_mergeable(self) -> bool:
+        """All states support the one-op stacked merge (vmap-batched forward)."""
+        return all(
+            is_stack_mergeable(self._reductions[name], self._defaults[name]) for name in self._defaults
+        )
+
+    def _build_scan_step(self, with_compute: bool, isolate: bool = False) -> Callable:
+        """One jitted program for a whole STACK of batches.
+
+        When every state supports a stacked merge, the per-batch deltas come
+        from a ``vmap``-ed update and the whole stack folds into the
+        accumulator with one reduction op per state — a fully parallel XLA
+        program (a serial ``lax.scan`` pays ~10 ms *per iteration* through a
+        remote-device tunnel, and serializes work the MXU could batch).
+        Cat-state metrics (lists/buffers) fall back to ``lax.scan``, which
+        preserves append order.
+        """
+        donate = (0,) if jax.default_backend() == "tpu" else ()
+        carrier = self
+        if isolate:
+            carrier = deepcopy(self)
+            carrier.reset()
+        lock = threading.Lock()
+        parallel = self._stack_mergeable
+
+        def step(acc: State, *stacked: Any):
+            if parallel:
+                def one(*batch):
+                    with lock:
+                        return carrier._run_update_on_state(carrier.init_state(), *batch)
+
+                deltas = jax.vmap(one)(*stacked)
+                merged = {
+                    name: merge_values_stacked(carrier._reductions[name], acc[name], deltas[name])
+                    for name in carrier._defaults
+                }
+                if with_compute:
+                    with lock:
+                        values = jax.vmap(carrier.compute_from_state)(deltas)
+                else:
+                    values = jnp.zeros(())
+            else:
+                def body(carry, batch):
+                    with lock:
+                        delta = carrier._run_update_on_state(carrier.init_state(), *batch)
+                    merged = carrier.merge_states(carry, delta)
+                    if with_compute:
+                        with lock:
+                            value = carrier.compute_from_state(delta)
+                        return merged, value
+                    return merged, jnp.zeros(())
+
+                merged, values = jax.lax.scan(body, acc, stacked)
+            if with_compute:
+                with lock:
+                    epoch_value = carrier.compute_from_state(merged)
+            else:
+                epoch_value = jnp.zeros(())
+            return merged, values, epoch_value
+
+        return jax.jit(step, donate_argnums=donate)
+
+    def _lookup_or_build_scan_step(self, with_compute: bool) -> Callable:
+        fp = self._config_fingerprint()
+        if fp is None:
+            return self._build_scan_step(with_compute)
+        key_body, pins = fp
+        key = (key_body, ("scan", with_compute))
+        with _JITTED_STEP_CACHE_LOCK:
+            hit = _JITTED_STEP_CACHE.get(key)
+            if hit is None:
+                hit = (pins, self._build_scan_step(with_compute, isolate=True))
+                _bounded_insert(_JITTED_STEP_CACHE, key, hit, _JITTED_STEP_CACHE_MAX)
+        return hit[1]
+
+    def forward_batched(self, *args: Any, **kwargs: Any) -> Any:
+        """Accumulate a whole stack of batches (leading axis = steps) in one
+        device dispatch; returns the per-step batch values stacked (or
+        ``None`` when ``compute_on_step=False``).
+
+        Semantically identical to calling ``forward`` once per slice —
+        including per-batch values computed on the batch alone — but the
+        loop, the merges, the per-batch values, AND the epoch value of the
+        accumulated state run as a single ``lax.scan`` program. The epoch
+        value is cached so a following ``compute()`` returns without another
+        dispatch (unless a cross-process sync is configured). Falls back to
+        the per-step path for metrics whose update cannot trace, for
+        keyword arguments, and for ``dist_sync_on_step``.
+        """
+        usable = (
+            not kwargs
+            and not self.dist_sync_on_step
+            and not self._scan_failed
+            and self._fusable
+            and self._jittable
+            and args
+        )
+        if usable:
+            with_compute = self.compute_on_step and not self._fc_failed
+            # the slot is keyed by mode: toggling compute_on_step between
+            # calls must not reuse a scan built for the other mode
+            if self._jitted_scan is None or self._jitted_scan[0] != with_compute:
+                self._jitted_scan = (with_compute, self._lookup_or_build_scan_step(with_compute))
+            try:
+                new_acc, values, epoch_value = self._jitted_scan[1](self._current_state(), *args)
+            except self._TRACER_ERRORS:
+                self._scan_failed = True
+                self._jitted_scan = None
+            else:
+                self._note_rows(args, {})
+                self._set_state(new_acc)
+                if with_compute:
+                    self._forward_cache = jax.tree_util.tree_map(lambda v: v[-1], values)
+                    # pre-seed the compute cache only when compute() would not
+                    # need a cross-process sync of fresh state
+                    if jax.process_count() == 1 and self.dist_sync_fn is None:
+                        self._computed = epoch_value
+                    else:
+                        self._computed = None
+                    return values
+                self._computed = None
+                return None
+
+        # eager fallback: one forward per leading-axis slice
+        index = (lambda i: tuple(a[i] for a in args), lambda i: {k: v[i] for k, v in kwargs.items()})
+        steps = (args[0] if args else next(iter(kwargs.values()))).shape[0]
+        values = [self.forward(*index[0](i), **index[1](i)) for i in range(steps)]
+        if not self.compute_on_step:
+            return None
+        return jax.tree_util.tree_map(lambda *vs: jnp.stack(vs), *values)
 
     # ------------------------------------------------------------------ sync
     def _sync_dist(self, dist_sync_fn: Callable = gather_all_arrays) -> None:
@@ -594,6 +768,7 @@ class Metric(ABC):
         @functools.wraps(update)
         def wrapped_func(*args: Any, **kwargs: Any) -> Any:
             self._computed = None
+            self._note_rows(args, kwargs)
             return update(*args, **kwargs)
 
         return wrapped_func
@@ -601,56 +776,72 @@ class Metric(ABC):
     # warn at half the int32 range: headroom for a few more epochs of updates
     _OVERFLOW_WARN_THRESHOLD = 2**30
 
+    @property
+    def _has_int_states(self) -> bool:
+        return any(
+            hasattr(d, "dtype") and jnp.issubdtype(d.dtype, jnp.integer) for d in self._defaults.values()
+        )
+
+    def note_count(self, amount: int) -> None:
+        """Advance the host-side count bound behind the int32-overflow warning.
+
+        The library tracks an upper bound on every int count state WITHOUT
+        touching the device: each processed element can contribute at most 1
+        to a count, so the bound advances by the largest argument size per
+        update. A custom metric whose update adds MORE than one per element
+        to an integer state should call this with the amount added, or the
+        overflow warning may come late. (Device-side probing is deliberately
+        avoided: a single device-to-host readback per step is the dominant
+        cost on remote-attached accelerators.)
+        """
+        self._count_bound += int(amount)
+
+    def _note_rows(self, args: tuple, kwargs: dict) -> None:
+        # min over argument sizes ~ the number of labeled samples: for
+        # (B, C) preds + (B,) target this is B, for multidim (B, C, X) +
+        # (B, X) it is B*X — matching what count states actually accrue
+        sizes = [getattr(a, "size", None) for a in (*args, *kwargs.values())]
+        sizes = [s for s in sizes if isinstance(s, int)]
+        if sizes:
+            self._count_bound += min(sizes)
+
+    def _host_warnings(self) -> None:
+        """Host-side health warnings at epoch-compute time (no device work).
+
+        Runs even when the compute cache is pre-seeded (``forward_batched``).
+        Subclasses with their own host-bound warnings extend this.
+        """
+        self._check_accumulator_overflow()
+
     def _check_accumulator_overflow(self) -> None:
         """Warn loudly when an int32 count accumulator nears wraparound.
 
         Without x64 enabled, count states accumulate in int32 (see
         ``utils.data.accum_int_dtype``); a pod-scale epoch can silently wrap
-        at 2^31. The check is **asynchronous**: each epoch-level ``compute``
-        schedules a tiny on-device max-reduction plus a non-blocking
-        device-to-host copy, and *consumes the previous compute's probe* —
-        so the host never stalls on a device round trip (a ~100 ms latency
-        through remote-device tunnels). The warning therefore lands one
-        epoch after the threshold is crossed; the 2^30 threshold leaves a
-        full half-range of headroom for that epoch. Skipped under tracing.
+        at 2^31. The check compares a host-maintained upper bound (elements
+        processed, see ``note_count``) against the threshold — no device
+        work, no readback, sync-free.
         """
-        if jax.config.jax_enable_x64:
+        if jax.config.jax_enable_x64 or self._overflow_warned:
             return
-        pending = self._overflow_probe
-        self._overflow_probe = None
-        if pending is not None and is_concrete(pending):
-            worst = int(pending)  # copy was started last compute; ~always ready
-            if worst >= self._OVERFLOW_WARN_THRESHOLD:
-                rank_zero_warn(
-                    f"an int32 count state of {self.__class__.__name__} has"
-                    f" reached {worst} (>= 2^30); it will silently wrap at"
-                    " 2^31. Enable jax_enable_x64 to accumulate counts in"
-                    " int64.",
-                    UserWarning,
-                )
-        maxes = [
-            jnp.max(jnp.abs(value))
-            for value in (getattr(self, name) for name in self._defaults)
-            if isinstance(value, (jnp.ndarray, Array))
-            and jnp.issubdtype(value.dtype, jnp.integer)
-            and is_concrete(value)
-            and value.size
-        ]
-        if maxes:
-            probe = jnp.max(jnp.stack(maxes))
-            try:
-                probe.copy_to_host_async()
-            except (AttributeError, RuntimeError):
-                pass  # async copy is an optimization; int() above still works
-            self._overflow_probe = probe
+        if self._count_bound >= self._OVERFLOW_WARN_THRESHOLD and self._has_int_states:
+            self._overflow_warned = True
+            rank_zero_warn(
+                f"{self.__class__.__name__} has processed ~{self._count_bound} elements; its"
+                " int32 count states may be nearing 2^31, where they silently wrap. Enable"
+                " jax_enable_x64 to accumulate counts in int64.",
+                UserWarning,
+            )
 
     def _wrap_compute(self, compute: Callable) -> Callable:
         @functools.wraps(compute)
         def wrapped_func(*args: Any, **kwargs: Any) -> Any:
+            if not self._in_forward:  # epoch-level compute, not the per-step batch value
+                # before the cache early-return: a forward_batched-seeded
+                # cache must not suppress the overflow warning
+                self._host_warnings()
             if self._computed is not None:
                 return self._computed
-            if not self._in_forward:  # epoch-level compute, not the per-step batch value
-                self._check_accumulator_overflow()
 
             dist_sync_fn = self.dist_sync_fn
             if dist_sync_fn is None and jax.process_count() > 1:
@@ -695,7 +886,8 @@ class Metric(ABC):
         metric.py:256-265; here the last ``device_put``/``astype`` target is
         re-applied so mesh placement survives epoch resets)."""
         self._computed = None
-        self._overflow_probe = None  # probe of pre-reset values is stale
+        self._count_bound = 0
+        self._overflow_warned = False
         state = self.init_state()
         self._set_state(state)
         if self._state_dtype is not None:
@@ -708,7 +900,7 @@ class Metric(ABC):
 
     def __getstate__(self) -> dict:
         skip = ("update", "compute", "_update_impl", "_compute_impl", "_jitted_step", "_jitted_step_fc",
-                "_overflow_probe")
+                "_jitted_scan")
         return {k: v for k, v in self.__dict__.items() if k not in skip}
 
     def __setstate__(self, state: dict) -> None:
@@ -716,19 +908,23 @@ class Metric(ABC):
         self.__dict__.setdefault("_jitted_step_fc", None)
         self.__dict__.setdefault("_default_keys", {})
         self.__dict__.setdefault("_fc_failed", False)
-        self.__dict__["_overflow_probe"] = None
+        self.__dict__.setdefault("_scan_failed", False)
+        self.__dict__.setdefault("_count_bound", 0)
+        self.__dict__.setdefault("_overflow_warned", False)
         self._update_impl = self.__class__.update.__get__(self)
         self._compute_impl = self.__class__.compute.__get__(self)
         self.update = self._wrap_update(self._update_impl)
         self.compute = self._wrap_compute(self._compute_impl)
         self._jitted_step = None
         self._jitted_step_fc = None
+        self._jitted_scan = None
 
     def __deepcopy__(self, memo: dict) -> "Metric":
         cls = self.__class__
         new = cls.__new__(cls)
         memo[id(self)] = new
-        skip = ("update", "compute", "_update_impl", "_compute_impl", "_jitted_step", "_jitted_step_fc")
+        skip = ("update", "compute", "_update_impl", "_compute_impl", "_jitted_step", "_jitted_step_fc",
+                "_jitted_scan")
         for k, v in self.__dict__.items():
             if k in skip:
                 continue
@@ -748,6 +944,7 @@ class Metric(ABC):
         new.compute = new._wrap_compute(new._compute_impl)
         new._jitted_step = None
         new._jitted_step_fc = None
+        new._jitted_scan = None
         return new
 
     # ------------------------------------------------------- device / shards

@@ -109,6 +109,29 @@ def merge_values(fx: ReduceFx, acc: Any, delta: Any) -> Any:
     raise ValueError(f"Reduction {fx!r} has no pairwise merge; metric must use the unfused update path.")
 
 
+def merge_values_stacked(fx: ReduceFx, acc: Any, stacked: Any) -> Any:
+    """Merge a ``(steps, ...)`` stack of state deltas into the accumulator in
+    ONE reduction op (the batched-forward plane: per-step deltas come from a
+    ``vmap``-ed update, and the whole stack folds at once — no serial scan,
+    which pays per-iteration overhead on remote-attached devices)."""
+    if fx == "sum":
+        return acc + jnp.sum(stacked, axis=0)
+    if fx == "min":
+        return jnp.minimum(acc, jnp.min(stacked, axis=0))
+    if fx == "max":
+        return jnp.maximum(acc, jnp.max(stacked, axis=0))
+    if is_associative(fx):
+        return fx(jnp.concatenate([acc[None], stacked], axis=0))
+    raise ValueError(f"Reduction {fx!r} has no stacked merge; use the per-step path.")
+
+
+def is_stack_mergeable(fx: ReduceFx, default: Any) -> bool:
+    """Whether a state supports the one-op stacked merge (no lists/buffers)."""
+    if isinstance(default, (list, PaddedBuffer)):
+        return False
+    return fx in ("sum", "min", "max") or is_associative(fx)
+
+
 def is_mergeable(fx: ReduceFx, default: Any) -> bool:
     """Whether a state with this reduction supports pairwise merge (fused forward)."""
     if isinstance(default, (list, PaddedBuffer)) or fx == "cat":

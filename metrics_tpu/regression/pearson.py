@@ -61,16 +61,22 @@ class PearsonCorrcoef(Metric):
         self.comoments = chan_merge(self.comoments, batch_comoments(preds, target))
         self.n_total = self.n_total + preds.shape[0]
 
-    def compute(self) -> Array:
-        from metrics_tpu.utils.data import is_concrete
+    def _host_warnings(self) -> None:
+        # host-side bound (elements processed), NOT a device readback — a
+        # single device->host readback per compute dominates wall-clock on
+        # remote-attached accelerators. Runs from _wrap_compute even when the
+        # compute cache is pre-seeded by forward_batched.
+        super()._host_warnings()
         from metrics_tpu.utils.prints import rank_zero_warn
 
-        if is_concrete(self.n_total) and int(self.n_total) >= self._F32_COUNT_SATURATION:
+        if self._count_bound >= self._F32_COUNT_SATURATION:
             rank_zero_warn(
-                f"PearsonCorrcoef has accumulated {int(self.n_total)} samples; the float32"
+                f"PearsonCorrcoef has processed ~{self._count_bound} samples; the float32"
                 " sample count carried in the co-moment state saturates at 2^24, so further"
                 " accumulation behaves as a ~16.7M-sample moving window rather than a true"
                 " running mean.",
                 UserWarning,
             )
+
+    def compute(self) -> Array:
         return comoments_corrcoef(self.comoments)

@@ -270,15 +270,34 @@ def test_int32_accumulator_overflow_warns():
         def compute(self):
             return self.total
 
+    # the check is a host-side bound on elements processed (never a device
+    # readback — those dominate wall-clock through remote-device tunnels);
+    # custom metrics that add more than 1 per element use note_count
     m = CountMetric()
-    m.update(jnp.asarray(2**30 + 1, dtype=jnp.int32))
-    # the check is asynchronous (non-blocking device probe): the first
-    # compute schedules it, the next consumes it — one epoch of delay, with
-    # a half-range of int32 headroom behind the 2^30 threshold
-    m.compute()
-    m.update(jnp.asarray(0, dtype=jnp.int32))
+    m.update(jnp.asarray(1, dtype=jnp.int32))
+    m.note_count(2**30)
     with pytest.warns(UserWarning, match="silently wrap"):
         m.compute()
+
+    # library-style per-row counting warns via argument sizes alone
+    class SmallThreshold(CountMetric):
+        _OVERFLOW_WARN_THRESHOLD = 64
+
+        def update(self, n):
+            self.total = self.total + jnp.sum(n)
+
+    m3 = SmallThreshold()
+    m3.update(jnp.ones((65,), jnp.int32))
+    with pytest.warns(UserWarning, match="silently wrap"):
+        m3.compute()
+    # reset clears the bound: no further warning
+    m3.reset()
+    m3.update(jnp.ones((3,), jnp.int32))
+    import warnings as _w
+
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        m3.compute()
 
     # below the threshold: no warning on any compute
     m2 = CountMetric()
@@ -440,3 +459,80 @@ def test_jitted_step_sharing_rules():
         assert d.seen and e.seen  # the side write lands on each instance
     finally:
         metrics_tpu.set_default_jit(old)
+
+
+# ------------------------------------------------------------ forward_batched
+
+
+def test_forward_batched_matches_per_step_loop():
+    """One-dispatch scan over stacked batches == the per-step forward loop,
+    including per-batch values, the accumulated state, and the epoch value."""
+    import metrics_tpu
+    from metrics_tpu import Accuracy
+
+    rng = np.random.RandomState(5)
+    logits = rng.rand(10, 10, 5).astype(np.float32)
+    probs = logits / logits.sum(-1, keepdims=True)
+    target = rng.randint(0, 5, (10, 10)).astype(np.int32)
+
+    old = metrics_tpu.set_default_jit(True)
+    try:
+        loop = Accuracy()
+        loop_vals = [float(loop(jnp.asarray(probs[i]), jnp.asarray(target[i]))) for i in range(10)]
+
+        batched = Accuracy()
+        vals = batched.forward_batched(jnp.asarray(probs), jnp.asarray(target))
+    finally:
+        metrics_tpu.set_default_jit(old)
+    assert vals.shape == (10,)
+    np.testing.assert_allclose(np.asarray(vals), loop_vals, atol=1e-6)
+    np.testing.assert_allclose(float(batched.compute()), float(loop.compute()), atol=1e-6)
+
+    # epoch value was pre-seeded by the scan: compute() returned the cache
+    assert batched._computed is not None
+
+    # further updates invalidate the cache and keep accumulating correctly
+    batched.update(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    loop.update(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+    np.testing.assert_allclose(float(batched.compute()), float(loop.compute()), atol=1e-6)
+
+    # a pre-seeded compute cache must NOT suppress the overflow warning
+    batched.forward_batched(jnp.asarray(probs), jnp.asarray(target))
+    batched.note_count(2**30)
+    with pytest.warns(UserWarning, match="silently wrap"):
+        batched.compute()
+
+    # toggling compute_on_step between calls rebuilds the scan for the mode
+    toggled = Accuracy()
+    toggled.compute_on_step = False
+    assert toggled.forward_batched(jnp.asarray(probs), jnp.asarray(target)) is None
+    toggled.compute_on_step = True
+    vals2 = toggled.forward_batched(jnp.asarray(probs), jnp.asarray(target))
+    assert vals2.shape == (10,)
+    np.testing.assert_allclose(np.asarray(vals2), loop_vals, atol=1e-6)
+
+
+def test_forward_batched_compute_on_step_false_and_fallback():
+    from metrics_tpu import Accuracy
+
+    rng = np.random.RandomState(6)
+    probs = rng.rand(4, 8, 3).astype(np.float32)
+    probs /= probs.sum(-1, keepdims=True)
+    target = rng.randint(0, 3, (4, 8)).astype(np.int32)
+
+    import metrics_tpu
+
+    old = metrics_tpu.set_default_jit(True)
+    try:
+        m = Accuracy(compute_on_step=False)
+        assert m.forward_batched(jnp.asarray(probs), jnp.asarray(target)) is None
+        expected = (probs.reshape(-1, 3).argmax(-1) == target.reshape(-1)).mean()
+        np.testing.assert_allclose(float(m.compute()), expected, atol=1e-6)
+    finally:
+        metrics_tpu.set_default_jit(old)
+
+    # eager fallback (jit disabled) produces the same stacked values
+    m2 = Accuracy()
+    vals = m2.forward_batched(jnp.asarray(probs), jnp.asarray(target))
+    assert np.asarray(vals).shape == (4,)
+    np.testing.assert_allclose(float(m2.compute()), expected, atol=1e-6)
