@@ -3,8 +3,13 @@
 Run on the real TPU:  python benchmarks/binned_kernel.py
 
 Times ``binned_stat_counts`` (``metrics_tpu/ops/binned.py``) under both
-implementations across representative sizes; the dispatch default
-(``impl="auto"`` -> Pallas on TPU) should win or tie everywhere it is used.
+implementations across representative sizes. Round-3 decision (recorded in
+BASELINE.md): the two paths measure equal at every size — XLA fuses the
+threshold comparison into the contraction — so ``impl="auto"`` dispatches
+to XLA and the Pallas kernel is opt-in. This sweep exists to re-check that
+decision on new hardware or XLA versions. Time all sizes BEFORE any
+device->host readback: one readback degrades every later block in the
+process through the axon tunnel.
 """
 import time
 

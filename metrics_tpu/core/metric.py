@@ -722,9 +722,12 @@ class Metric(ABC):
             and self._fusable
             and self._jittable
             and args
+            # compute cannot trace + per-step values wanted -> the scan
+            # cannot honor the contract; use the per-step fallback below
+            and not (self.compute_on_step and self._fc_failed)
         )
         if usable:
-            with_compute = self.compute_on_step and not self._fc_failed
+            with_compute = self.compute_on_step
             # the slot is keyed by mode: toggling compute_on_step between
             # calls must not reuse a scan built for the other mode
             if self._jitted_scan is None or self._jitted_scan[0] != with_compute:
@@ -1002,6 +1005,10 @@ class Metric(ABC):
                     destination[prefix + key] = {"data": np.asarray(value.data), "count": np.asarray(value.count)}
                 else:
                     destination[prefix + key] = np.asarray(value)
+        # the host-side overflow bound must survive checkpoint/resume, or a
+        # restored metric would never warn (the bound is host metadata, not
+        # a device state)
+        destination[prefix + "_count_bound"] = np.asarray(self._count_bound, dtype=np.int64)
         return destination
 
     def load_state_dict(self, state_dict: dict, prefix: str = "") -> None:
@@ -1014,6 +1021,8 @@ class Metric(ABC):
                     setattr(self, key, [jnp.asarray(v) for v in value])
                 else:
                     setattr(self, key, jnp.asarray(value))
+        if prefix + "_count_bound" in state_dict:
+            self._count_bound = int(state_dict[prefix + "_count_bound"])
 
     def state_pytree(self) -> State:
         """All current states as a pytree (for orbax checkpointing of the full metric)."""

@@ -6,23 +6,27 @@ reduces every batch to per-threshold TP/FP counts:
     tp[t] = sum_n pos[n] * (preds[n] >= thr[t])
     fp[t] = sum_n neg[n] * (preds[n] >= thr[t])
 
-For binary scores (the dominant case: one score per sample) the XLA
-contraction materializes the ``(T, N)`` comparison matrix in HBM — a ~T-fold
-blowup of the batch, written and read back every step. This kernel streams N
-through VMEM in tiles and contracts on the MXU:
+This kernel streams N through VMEM in tiles and contracts on the MXU:
 
     [pos; neg] (8 x TILE_N)  @  (preds_tile >= thr) (TILE_N x T)  ->  (8, T)
 
 accumulated across tiles on-chip, so HBM traffic is just the batch plus the
-tiny output. Measured on v5e (``benchmarks/binned_kernel.py``): steady-state
-parity to ~1.3x over the XLA einsum across N=4k..256k (both are fast; the
-kernel's value is the bounded VMEM footprint — no ``(T, N)`` HBM
-intermediate — which matters as N and T grow).
+tiny output.
 
-Per-class (multiclass/multilabel) inputs stay on the XLA einsum path: the
-comparison there is ``(T, N, C)`` with C a batch dimension, which XLA already
-handles well (measured faster than a VPU pallas formulation at every size
-tried), so the kernel would be complexity without a win.
+**Round-3 verdict (v5e sweep, N ∈ {64k..4M} × T ∈ {512, 2048}, recorded in
+BASELINE.md): the kernel is RETIRED from the default dispatch.** XLA does
+not in fact materialize the ``(T, N)`` comparison in HBM — it fuses the
+comparison into the contraction — so the hypothesized bandwidth win never
+appears: both paths measure equal within noise (~±30%) at every size, with
+identical outputs bit-for-bit. Per SURVEY §2's own rule ("Pallas only where
+profiling justifies it"), ``impl="auto"`` now always takes the XLA path;
+the kernel remains available via ``impl="pallas"`` (and
+``"pallas_interpret"`` for CPU tests) as the packaged example of the
+tile/grid/MXU pattern for ops XLA handles less well.
+
+Per-class (multiclass/multilabel) inputs always took the XLA einsum path:
+the comparison there is ``(T, N, C)`` with C a batch dimension, which XLA
+already handles well.
 
 Counts accumulate in float32: exact up to 2**24 per call, and the callers
 accumulate across batches in integer state (same contract as the one-hot
@@ -38,8 +42,6 @@ from jax import Array
 _SUBLANE = 8  # float32 min sublane count
 _LANE = 128  # lane width
 _TILE_N = 2048  # N elements streamed per grid step (8 KiB of scores)
-# below this the tiny problem is free either way; keep XLA's fully fused code
-_PALLAS_MIN_N = 1024
 
 
 def _pad_to(x: Array, size: int, axis: int, value: float) -> Array:
@@ -104,7 +106,7 @@ def _binned_counts_pallas_binary(
 
 
 def _binned_counts_xla(preds_c: Array, pos: Array, neg: Array, thresholds: Array) -> Tuple[Array, Array]:
-    """XLA path: einsum contraction (materializes (T, N, C) in HBM)."""
+    """XLA path: einsum contraction (XLA fuses the comparison into it)."""
     ge = (preds_c[None, :, :] >= thresholds[:, None, None]).astype(preds_c.dtype)  # (T, N, C)
     tp = jnp.einsum("tnc,nc->tc", ge, pos).T  # (C, T)
     fp = jnp.einsum("tnc,nc->tc", ge, neg).T
@@ -120,9 +122,9 @@ def binned_stat_counts(
         preds_c: ``(N, C)`` scores (float32).
         pos / neg: ``(N, C)`` float32 weights of positive / negative samples.
         thresholds: ``(T,)`` ascending thresholds.
-        impl: ``"auto"`` (Pallas for large binary batches on TPU, einsum
-            otherwise), ``"pallas"``, ``"pallas_interpret"`` (for tests on
-            CPU), or ``"xla"``.
+        impl: ``"auto"`` (the XLA einsum — measured equal to the kernel at
+            every size, see module docstring), ``"pallas"``,
+            ``"pallas_interpret"`` (for tests on CPU), or ``"xla"``.
 
     Returns:
         ``(tp, fp)`` of shape ``(C, T)``, same count dtype as ``preds_c``.
@@ -131,8 +133,9 @@ def binned_stat_counts(
         raise ValueError(f"impl must be 'auto', 'pallas', 'pallas_interpret' or 'xla', got {impl!r}")
     n, c = preds_c.shape
     if impl == "auto":
-        use_pallas = jax.default_backend() == "tpu" and c == 1 and n >= _PALLAS_MIN_N
-        impl = "pallas" if use_pallas else "xla"
+        # measured equal to the XLA fusion at every size (see module
+        # docstring); default to the simpler compiler path
+        impl = "xla"
     if impl == "xla" or n == 0 or c > 1:
         # multiclass and empty batches take the XLA path (see module docstring)
         return _binned_counts_xla(preds_c, pos, neg, thresholds)

@@ -47,13 +47,23 @@ class WER(Metric):
 
     def update(self, preds: Union[TokenSeq, Sequence[TokenSeq]], target: Union[TokenSeq, Sequence[TokenSeq]]) -> None:
         errors, total = _wer_update(preds, target)
+        # host inputs (strings/token lists) carry no .size for the automatic
+        # bound; the counts are host ints here, so advance it exactly
+        self.note_count(max(int(errors), int(total)))
         self.errors = self.errors + errors
         self.total = self.total + total
 
     def update_counts(self, errors: Array, ref_words: Array) -> None:
         """Accumulate pre-computed device counts (e.g. from
-        ``edit_distance_padded`` distances and target lengths)."""
+        ``edit_distance_padded`` distances and target lengths).
+
+        The counts live on device, so the int32-overflow bound can only be
+        advanced by the sequence count here; when you know the padded
+        sequence length ``M``, call ``self.note_count(B * M)`` yourself for
+        a tight bound (reference words per sequence are ≤ M).
+        """
         self._computed = None  # bypasses the wrapped update, so drop its cache here
+        self.note_count(int(ref_words.size))
         self.errors = self.errors + jnp.sum(errors)
         self.total = self.total + jnp.sum(ref_words)
 
