@@ -47,6 +47,7 @@ from metrics_tpu.utils.exceptions import TracingUnsupportedError
 from metrics_tpu.utils.prints import rank_zero_warn
 from metrics_tpu.parallel.sync import (
     ReduceFx,
+    canonicalize_group,
     canonicalize_reduce_fx,
     gather_all_arrays,
     host_gather,
@@ -234,8 +235,13 @@ class Metric(ABC):
     Args:
         compute_on_step: ``forward`` returns the batch-local value if True.
         dist_sync_on_step: sync state across processes inside every ``forward``.
-        process_group: accepted for API parity; scoping in JAX is done by
-            choosing the mesh axis passed to ``sync_state``.
+        process_group: iterable of process indices to scope the host-plane
+            sync to (must include the local process; reference
+            metric.py:66,185 semantics). Every process still enters one
+            world collective, but each reduces over its group only.
+            Construct metrics after ``jax.distributed.initialize`` so the
+            group validates against the real world size. For the in-jit
+            plane, scope by the mesh axis passed to ``sync_state`` instead.
         dist_sync_fn: custom host-plane gather, ``fn(array) -> List[array]``
             (one entry per process). Defaults to ``process_allgather`` when
             running multi-host.
@@ -259,7 +265,9 @@ class Metric(ABC):
     ):
         self.dist_sync_on_step = dist_sync_on_step
         self.compute_on_step = compute_on_step
-        self.process_group = process_group
+        # loud validation, never a silent no-op; store the canonical tuple so
+        # one-shot iterables cannot pass validation exhausted
+        self.process_group = canonicalize_group(process_group)
         self.dist_sync_fn = dist_sync_fn
         self.capacity = capacity
         self._jit = jit if jit is not None else _DEFAULT_JIT
@@ -761,10 +769,18 @@ class Metric(ABC):
         return jax.tree_util.tree_map(lambda *vs: jnp.stack(vs), *values)
 
     # ------------------------------------------------------------------ sync
-    def _sync_dist(self, dist_sync_fn: Callable = gather_all_arrays) -> None:
+    def _default_gather(self) -> Callable:
+        """World gather, scoped to ``process_group`` when one was given
+        (reference metric.py:185 passes the group into gather_all_tensors)."""
+        if self.process_group is None:
+            return gather_all_arrays
+        return functools.partial(gather_all_arrays, group=self.process_group)
+
+    def _sync_dist(self, dist_sync_fn: Optional[Callable] = None) -> None:
         """Host-plane sync: gather + stack/flatten + per-state reduction
         (reference metric.py:179-197)."""
-        synced = host_gather(self._current_state(), self._reductions, gather_fn=dist_sync_fn)
+        gather = dist_sync_fn if dist_sync_fn is not None else self._default_gather()
+        synced = host_gather(self._current_state(), self._reductions, gather_fn=gather)
         self._set_state(synced)
 
     def _wrap_update(self, update: Callable) -> Callable:
@@ -848,7 +864,7 @@ class Metric(ABC):
 
             dist_sync_fn = self.dist_sync_fn
             if dist_sync_fn is None and jax.process_count() > 1:
-                dist_sync_fn = gather_all_arrays
+                dist_sync_fn = self._default_gather()
 
             synced = False
             cache = {}
@@ -1180,8 +1196,36 @@ class CompositionalMetric(Metric):
 
     @property
     def _fusable(self) -> bool:
-        # children manage their own accumulation; use the reference forward path
+        # forward() is overridden below; the base dispatch never runs
         return False
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Fused composed forward: ONE forward per child per step.
+
+        Each Metric child runs its own (fused, single-dispatch) ``forward``
+        — accumulating the batch once and yielding its batch-local value —
+        and the composed batch value is the operator over those values. The
+        reference instead routes through its double-update forward
+        (reference metric.py:150-177), paying two updates per child per
+        step; this halves the dispatch count and leaves children's
+        accumulated state intact.
+        """
+        self._computed = None  # children advanced: any cached epoch value is stale
+
+        def _child(child):
+            if isinstance(child, Metric):
+                return child.forward(*args, **child._filter_kwargs(**kwargs))
+            return child
+
+        val_a = _child(self.metric_a)
+        val_b = _child(self.metric_b)
+        if not self.compute_on_step:
+            return None
+        # a child with compute_on_step=False yields no batch value to compose
+        if val_a is None or (isinstance(self.metric_b, Metric) and val_b is None):
+            return None
+        self._forward_cache = self.op(val_a) if val_b is None else self.op(val_a, val_b)
+        return self._forward_cache
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         if isinstance(self.metric_a, Metric):
@@ -1197,6 +1241,7 @@ class CompositionalMetric(Metric):
         return self.op(val_a, val_b)
 
     def reset(self) -> None:
+        self._computed = None
         if isinstance(self.metric_a, Metric):
             self.metric_a.reset()
         if isinstance(self.metric_b, Metric):

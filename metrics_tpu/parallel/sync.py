@@ -169,20 +169,60 @@ def sync_state(state: Dict[str, Any], reductions: Dict[str, ReduceFx], axis_name
     return {name: sync_value(reductions[name], value, axis_name) for name, value in state.items()}
 
 
+def canonicalize_group(group: Any) -> Optional[tuple]:
+    """Validate a ``process_group`` (reference metric.py:66,185 semantics).
+
+    A group is an iterable of distinct process indices that includes the
+    local process. ``None`` means the whole world. Anything else raises —
+    never a silent no-op.
+    """
+    if group is None:
+        return None
+    if isinstance(group, (str, bytes)):
+        raise TypeError(f"`process_group` must be None or an iterable of process indices, got {group!r}")
+    try:
+        members = tuple(int(i) for i in group)
+    except (TypeError, ValueError):
+        raise TypeError(
+            f"`process_group` must be None or an iterable of process indices, got {group!r}"
+        ) from None
+    if len(set(members)) != len(members):
+        raise ValueError(f"`process_group` has duplicate members: {members}")
+    world = jax.process_count()
+    if any(i < 0 or i >= world for i in members):
+        raise ValueError(f"`process_group` members must be in [0, {world}); got {members}")
+    if jax.process_index() not in members:
+        raise ValueError(
+            f"process {jax.process_index()} is not a member of its own `process_group` {members};"
+            " a rank may only sync through a group it belongs to"
+        )
+    return members
+
+
 def gather_all_arrays(value: Array, group: Any = None) -> List[Array]:
-    """Host-plane all-gather: returns a world-size list of per-process arrays.
+    """Host-plane all-gather: a list of per-process arrays, in rank order.
 
     The TPU-native analogue of reference ``gather_all_tensors``
     (distributed.py:91-118). On a single process this is ``[value]``; on
-    multi-host it uses ``process_allgather`` over DCN. ``group`` is accepted
-    for API parity; JAX has one world — pass an axis-subset mesh for scoping.
+    multi-host it uses ``process_allgather`` over DCN.
+
+    ``group`` scopes the result to a process subset (reference
+    ``group`` semantics, distributed.py:96-116): every process still enters
+    the ONE world collective — concurrent disjoint groups therefore cannot
+    deadlock, unlike real sub-communicators — but each process keeps only
+    its group members' slices, so the downstream reduction spans exactly the
+    group. For the in-jit plane, scope by choosing the mesh axis passed to
+    ``sync_state`` (a 2-D mesh's ``dp`` axis is a process subset by
+    construction).
     """
+    members = canonicalize_group(group)
     if jax.process_count() == 1:
         return [value]
     from jax.experimental import multihost_utils
 
     gathered = multihost_utils.process_allgather(value, tiled=False)
-    return [gathered[i] for i in range(gathered.shape[0])]
+    indices = range(gathered.shape[0]) if members is None else members
+    return [gathered[i] for i in indices]
 
 
 def host_gather(

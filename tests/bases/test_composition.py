@@ -159,3 +159,63 @@ def test_metrics_chained_operations():
     composed = (first + second) * 2 - 4
     composed.update()
     assert float(composed.compute()) == 6
+
+
+def test_compositional_forward_fused_single_update():
+    """Composed forward runs ONE update per child per step, returns the op of
+    the children's batch values, and leaves accumulation intact."""
+
+    class Mean(Metric):
+
+        def __init__(self):
+            super().__init__()
+            self.add_state("s", jnp.asarray(0.0), dist_reduce_fx="sum")
+            self.add_state("n", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.s = self.s + jnp.sum(x)
+            self.n = self.n + x.shape[0]
+
+        def compute(self):
+            return self.s / jnp.maximum(self.n, 1.0)
+
+    a, b = Mean(), Mean()
+    composed = a + b
+    v1 = composed(jnp.asarray([1.0, 3.0]))  # batch means 2 + 2
+    assert float(v1) == 4.0
+    v2 = composed(jnp.asarray([5.0, 7.0]))  # batch means 6 + 6
+    assert float(v2) == 12.0
+    # each child accumulated each batch exactly once
+    assert float(a.n) == 4.0 and float(a.s) == 16.0
+    # epoch compute composes the children's accumulated computes
+    assert float(composed.compute()) == 8.0
+
+    # a constant operand composes against the child's batch value
+    shifted = a + 10.0
+    assert float(shifted(jnp.asarray([4.0, 4.0]))) == 14.0
+
+    # compute_on_step=False child -> no batch value to compose
+    c = Mean()
+    c.compute_on_step = False
+    silent = c + b
+    assert silent(jnp.asarray([1.0])) is None
+    assert float(c.n) == 1.0  # still accumulated
+
+
+def test_compositional_cache_invalidation():
+    """forward and reset must invalidate the composed compute cache."""
+    a, b = DummyMetric(2), DummyMetric(3)
+    c = a + b
+    c.update()
+    assert float(c.compute()) == 5
+    c.reset()
+    a._val_to_return, b._val_to_return = 10, 20
+    assert float(c.compute()) == 30  # not the cached 5
+    # forward on a compute_on_step=False composition also drops the cache
+    c2 = DummyMetric(1) + DummyMetric(1)
+    c2.update()
+    assert float(c2.compute()) == 2
+    c2.compute_on_step = False
+    assert c2() is None
+    c2.metric_a._val_to_return = 7
+    assert float(c2.compute()) == 8

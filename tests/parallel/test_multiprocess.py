@@ -90,12 +90,91 @@ st.update(float(10 + rank))
 st._sync_dist()
 stacked = [float(v) for v in st.x]
 
+
+class MinMax(Metric):
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("mn", jnp.asarray(jnp.inf), dist_reduce_fx="min")
+        self.add_state("mx", jnp.asarray(-jnp.inf), dist_reduce_fx="max")
+
+    def update(self, x):
+        self.mn = jnp.minimum(self.mn, x)
+        self.mx = jnp.maximum(self.mx, x)
+
+    def compute(self):
+        return self.mn, self.mx
+
+
+# min/max host reductions across the real 2-process world
+mm = MinMax()
+mm.update(float(5 - rank))   # ranks hold 5.0 and 4.0
+mm.update(float(rank))       # and 0.0 / 1.0
+mn, mx = mm.compute()
+minmax = [float(mn), float(mx)]
+
+
+class Buffered(Metric):
+    # PaddedBuffer cat-state -> the host-plane buffer gather branch
+
+    def __init__(self, **kw):
+        super().__init__(capacity=4, **kw)
+        self.add_state("vals", [], dist_reduce_fx=None, item_shape=())
+
+    def update(self, x):
+        self._append("vals", x)
+
+    def compute(self):
+        from metrics_tpu.parallel.buffer import as_values
+
+        return as_values(self.vals)
+
+
+# each rank appends 3 of its 4-capacity rows -> union of 6, no overflow
+b = Buffered()
+b.update(jnp.asarray([10.0 * rank, 10.0 * rank + 1.0, 10.0 * rank + 2.0]))
+buf_vals = sorted(float(v) for v in b.compute())
+buf_local_count = int(b.vals.count)  # local state restored after sync
+
+# overflow on ONE rank must raise on EVERY rank (counts are gathered first)
+b2 = Buffered()
+b2.update(jnp.zeros((4,)))
+if rank == 1:
+    b2.vals = b2.vals._replace(count=jnp.asarray(5, dtype=b2.vals.count.dtype))
+try:
+    b2.compute()
+    overflow = "no-error"
+except RuntimeError as err:
+    overflow = "overflow" if "overflow" in str(err) else f"wrong: {err}"
+
+# process_group scoping: a group of 1 syncs only itself...
+g1 = Sum(process_group=[rank])
+g1.update(float(rank + 1))
+group_self = float(g1.compute())
+# ...and the full group equals the world sync
+g2 = Sum(process_group=[0, 1])
+g2.update(float(rank + 1))
+group_world = float(g2.compute())
+# a group not containing this rank raises loudly
+try:
+    Sum(process_group=[1 - rank])
+    group_error = "no-error"
+except ValueError as err:
+    group_error = "member" if "member" in str(err) else f"wrong: {err}"
+
 print("RESULT " + json.dumps({
     "rank": rank,
     "sum": total,
     "local_after": local_after,
     "cat": cat_vals,
     "stacked": stacked,
+    "minmax": minmax,
+    "buf": buf_vals,
+    "buf_local_count": buf_local_count,
+    "overflow": overflow,
+    "group_self": group_self,
+    "group_world": group_world,
+    "group_error": group_error,
 }), flush=True)
 """
 
@@ -138,3 +217,17 @@ def test_two_process_host_plane_sync(tmp_path):
         assert r["cat"] == [0.0, 1.0, 2.0, 3.0]
         # None-reduction stacks per-rank states in rank order
         assert r["stacked"] == [10.0, 11.0]
+        # min/max reduce across the world: min(0,1)=0, max(5,4)=5
+        assert r["minmax"] == [0.0, 5.0]
+        # PaddedBuffer branch: union of both ranks' valid rows, no padding rows
+        assert r["buf"] == [0.0, 1.0, 2.0, 10.0, 11.0, 12.0]
+        # local buffer state restored after the synced compute
+        assert r["buf_local_count"] == 3
+        # rank-1's overflowed buffer raises on BOTH ranks
+        assert r["overflow"] == "overflow"
+        # a group of one syncs only itself: rank r keeps its own r+1
+        assert r["group_self"] == float(rank + 1)
+        # the full group behaves like the world sync
+        assert r["group_world"] == 3.0
+        # a group excluding the local rank is a loud error
+        assert r["group_error"] == "member"

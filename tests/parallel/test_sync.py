@@ -259,3 +259,27 @@ def test_sync_count_check_passes_when_aligned():
         assert float(m.compute()) == 6.0
     finally:
         enable_sync_count_check(old)
+
+
+def test_canonicalize_group_validation():
+    """process_group is validated loudly — silent-ignore is gone."""
+    from metrics_tpu.parallel.sync import canonicalize_group
+    from metrics_tpu import Accuracy
+
+    assert canonicalize_group(None) is None
+    assert canonicalize_group([0]) == (0,)  # single-process world, own group
+    with pytest.raises(ValueError, match="duplicate"):
+        canonicalize_group([0, 0])
+    with pytest.raises(ValueError, match=r"in \[0"):
+        canonicalize_group([0, 7])
+    with pytest.raises(TypeError, match="iterable"):
+        canonicalize_group(42)
+    # constructor validates too
+    with pytest.raises(ValueError):
+        Accuracy(process_group=[3])
+    m = Accuracy(process_group=[0])
+    assert m.process_group == (0,)  # stored canonicalized (one-shot iterables safe)
+    with pytest.raises(TypeError, match="iterable"):
+        canonicalize_group("01")
+    with pytest.raises(TypeError, match="iterable"):
+        canonicalize_group(["a"])
