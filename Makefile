@@ -1,4 +1,8 @@
-.PHONY: test test-tpu doctest clean bench
+.PHONY: test test-tpu doctest clean bench docs
+
+# generate the API reference from live docstrings (stdlib-only generator)
+docs:
+	python docs/gen_api.py docs/api.md
 
 # full suite + package doctests on 8 fake CPU devices (root conftest forces
 # the platform; see conftest.py)

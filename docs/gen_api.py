@@ -1,0 +1,115 @@
+"""Generate the API reference from live docstrings (stdlib-only).
+
+The image ships no sphinx/mkdocs/pdoc, so the reference is generated with
+``inspect``: every public symbol of ``metrics_tpu`` (modules, metric classes,
+functionals, parallel plane) is emitted as markdown with its signature and
+docstring — the same docstrings the test suite executes as doctests, so the
+examples shown here are verified on every CI run.
+
+Usage:  python docs/gen_api.py [output.md]     (default: docs/api.md)
+"""
+import importlib
+import inspect
+import os
+import sys
+from pathlib import Path
+
+# run from anywhere: the repo root on sys.path, not via PYTHONPATH (which
+# breaks the axon TPU plugin registration in this image — see benchmarks/)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SECTIONS = [
+    ("Core", "metrics_tpu", ["Metric", "MetricCollection", "CompositionalMetric", "PureMetric",
+                             "set_default_jit", "enable_sync_count_check"]),
+    ("Classification", "metrics_tpu.classification", None),
+    ("Regression", "metrics_tpu.regression", None),
+    ("Retrieval", "metrics_tpu.retrieval", None),
+    ("Text", "metrics_tpu.text", None),
+    ("Functional", "metrics_tpu.functional", None),
+    ("Parallel (mesh sync, placement, sharded epoch)", "metrics_tpu.parallel", None),
+    ("Ops (kernels)", "metrics_tpu.ops.binned", ["binned_stat_counts"]),
+    ("Utilities", "metrics_tpu.utils.data", None),
+]
+
+
+def _public_names(mod):
+    if hasattr(mod, "__all__"):
+        return list(mod.__all__)
+    return [
+        n for n, obj in vars(mod).items()
+        if not n.startswith("_") and (inspect.isclass(obj) or inspect.isfunction(obj))
+        and getattr(obj, "__module__", "").startswith("metrics_tpu")
+    ]
+
+
+def _signature(obj, drop_self: bool = False):
+    try:
+        sig = inspect.signature(obj)
+    except (TypeError, ValueError):
+        return "(...)"
+    if drop_self:
+        params = list(sig.parameters.values())
+        if params and params[0].name == "self":
+            sig = sig.replace(parameters=params[1:])
+    return str(sig)
+
+
+def _doc(obj):
+    doc = inspect.getdoc(obj)
+    return doc if doc else "*(undocumented)*"
+
+
+def _emit_symbol(out, name, obj, level="###"):
+    if inspect.isclass(obj):
+        out.append(f"{level} `{name}{_signature(obj.__init__, drop_self=True)}`\n")
+        out.append(_doc(obj) + "\n")
+        for meth_name in ("update", "compute", "forward_batched", "pure", "device_put", "note_count"):
+            meth = obj.__dict__.get(meth_name)
+            if meth is None or not callable(meth):
+                continue
+            doc = inspect.getdoc(meth)
+            if not doc:
+                continue
+            out.append(f"**`.{meth_name}{_signature(meth, drop_self=True)}`** — {doc.splitlines()[0]}\n")
+    else:
+        out.append(f"{level} `{name}{_signature(obj)}`\n")
+        out.append(_doc(obj) + "\n")
+
+
+def generate() -> str:
+    out = [
+        "# metrics_tpu API reference\n",
+        "*Generated from live docstrings by `docs/gen_api.py`; the examples",
+        "below run as doctests in CI (`make test`). Regenerate with",
+        "`make docs`.*\n",
+    ]
+    seen = set()
+    for title, modname, names in SECTIONS:
+        mod = importlib.import_module(modname)
+        out.append(f"\n## {title}\n")
+        mod_doc = inspect.getdoc(mod)
+        if mod_doc and names is None:
+            out.append(mod_doc.splitlines()[0] + "\n")
+        for name in names or sorted(_public_names(mod)):
+            obj = getattr(mod, name, None)
+            if obj is None or id(obj) in seen:
+                continue
+            seen.add(id(obj))
+            _emit_symbol(out, name, obj)
+    return "\n".join(out)
+
+
+def main() -> int:
+    target = Path(sys.argv[1]) if len(sys.argv) > 1 else Path(__file__).parent / "api.md"
+    text = generate()
+    n_symbols = text.count("\n### ")
+    if n_symbols < 60:
+        print(f"ERROR: only {n_symbols} symbols documented — generator or package broken", file=sys.stderr)
+        return 1
+    target.write_text(text)
+    print(f"wrote {target} ({n_symbols} symbols, {len(text)} bytes)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

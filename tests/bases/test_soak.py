@@ -1,0 +1,104 @@
+"""Compile + memory soak guards.
+
+Protect the step-sharing machinery (core/metric.py) against regressions that
+would silently re-introduce per-step retraces or per-step buffer leaks: the
+fused step must compile ONCE, then replay for every subsequent step and for
+every config-identical instance, with a flat live-buffer population.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu
+from metrics_tpu import Accuracy, F1, MetricCollection, Precision
+
+
+@pytest.fixture()
+def jit_on():
+    old = metrics_tpu.set_default_jit(True)
+    yield
+    metrics_tpu.set_default_jit(old)
+
+
+def _batch(rng, n=32, c=5):
+    p = rng.rand(n, c).astype(np.float32)
+    p /= p.sum(-1, keepdims=True)
+    return jnp.asarray(p), jnp.asarray(rng.randint(0, c, n).astype(np.int32))
+
+
+def test_fused_step_zero_retraces_and_flat_buffers(jit_on):
+    rng = np.random.RandomState(0)
+    preds, target = _batch(rng)
+
+    m = Accuracy()
+    jax.block_until_ready(m(preds, target))  # step 1: trace + compile
+    step = m._jitted_step_fc
+    assert step is not None
+    traces = step._cache_size()
+
+    jax.block_until_ready(m.compute())
+    n_live = len(jax.live_arrays())
+    for _ in range(50):
+        m(preds, target)
+    jax.block_until_ready(m.compute())
+
+    # zero retraces after step 1
+    assert step._cache_size() == traces
+    # flat device-buffer population: steady state allocates nothing beyond
+    # the rotating state/value buffers (slack for the last step's outputs)
+    assert len(jax.live_arrays()) <= n_live + 8
+
+
+def test_shared_step_across_instances_no_recompile(jit_on):
+    rng = np.random.RandomState(1)
+    preds, target = _batch(rng)
+
+    first = Accuracy()
+    jax.block_until_ready(first(preds, target))
+    step = first._jitted_step_fc
+    traces = step._cache_size()
+
+    for _ in range(10):
+        m = Accuracy()  # config-identical: must share the SAME jitted step
+        m(preds, target)
+        assert m._jitted_step_fc is step
+    assert step._cache_size() == traces
+
+
+def test_collection_fused_step_soak(jit_on):
+    rng = np.random.RandomState(2)
+    preds, target = _batch(rng, c=8)
+
+    coll = MetricCollection([
+        Accuracy(),
+        Precision(num_classes=8, average="macro"),
+        F1(num_classes=8, average="macro"),
+    ])
+    jax.block_until_ready(jax.tree_util.tree_leaves(coll(preds, target)))
+    n_live = len(jax.live_arrays())
+    for _ in range(30):
+        coll(preds, target)
+    out = coll.compute()
+    jax.block_until_ready(jax.tree_util.tree_leaves(out))
+    assert len(jax.live_arrays()) <= n_live + 12
+
+
+def test_forward_batched_scan_step_soak(jit_on):
+    rng = np.random.RandomState(3)
+    p = rng.rand(8, 16, 5).astype(np.float32)
+    p /= p.sum(-1, keepdims=True)
+    stacked_p = jnp.asarray(p)
+    stacked_t = jnp.asarray(rng.randint(0, 5, (8, 16)).astype(np.int32))
+
+    m = Accuracy()
+    jax.block_until_ready(m.forward_batched(stacked_p, stacked_t))
+    step = m._jitted_scan[1]
+    traces = step._cache_size()
+    n_live = len(jax.live_arrays())
+    for _ in range(20):
+        m2 = Accuracy()
+        m2.forward_batched(stacked_p, stacked_t)
+        jax.block_until_ready(m2.compute())
+    assert step._cache_size() == traces
+    assert len(jax.live_arrays()) <= n_live + 8
