@@ -26,6 +26,7 @@ from metrics_tpu.classification import (  # noqa: E402
     BinnedAveragePrecision,
     BinnedPrecisionRecallCurve,
     BinnedROC,
+    CalibrationError,
     CohenKappa,
     ConfusionMatrix,
     FBeta,
@@ -46,11 +47,14 @@ from metrics_tpu.regression import (  # noqa: E402
     ExplainedVariance,
     KLDivergence,
     MeanAbsoluteError,
+    MeanAbsolutePercentageError,
     MeanSquaredError,
     MeanSquaredLogError,
     PearsonCorrcoef,
     R2Score,
     SpearmanCorrcoef,
+    SymmetricMeanAbsolutePercentageError,
+    WeightedMeanAbsolutePercentageError,
 )
 from metrics_tpu.retrieval import (  # noqa: E402
     RetrievalFallOut,
@@ -64,4 +68,5 @@ from metrics_tpu.retrieval import (  # noqa: E402
     RetrievalRecall,
 )
 from metrics_tpu.text import WER  # noqa: E402
+from metrics_tpu.audio import SI_SDR, SI_SNR, SNR  # noqa: E402
 from metrics_tpu import functional  # noqa: E402

@@ -1,0 +1,6 @@
+"""Audio metrics. Extension family beyond the reference snapshot (later
+torchmetrics ships an audio package: SNR, SI_SDR, SI_SNR)."""
+from metrics_tpu.audio.snr import SNR
+from metrics_tpu.audio.si_sdr import SI_SDR, SI_SNR
+
+__all__ = ["SNR", "SI_SDR", "SI_SNR"]

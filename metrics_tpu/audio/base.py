@@ -1,0 +1,45 @@
+"""Shared base for per-example dB audio metrics.
+
+Every metric in this family reduces each example's trailing (time) axis to a
+scalar in dB and reports the mean over all examples seen — two scalar
+``"sum"`` states, so accumulation is O(1) memory and cross-device sync is one
+fused ``psum``.
+"""
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.data import accum_int_dtype
+
+
+class _PerExampleDbMetric(Metric):
+
+    def __init__(
+        self,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.add_state("sum_db", default=np.zeros(()), dist_reduce_fx="sum")
+        self.add_state("n_examples", default=np.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
+
+    def _per_example(self, preds: Array, target: Array) -> Array:
+        raise NotImplementedError  # pragma: no cover - subclasses define the kernel
+
+    def update(self, preds: Array, target: Array) -> None:
+        values = self._per_example(preds, target)
+        self.sum_db = self.sum_db + jnp.sum(values)
+        self.n_examples = self.n_examples + values.size
+
+    def compute(self) -> Array:
+        return self.sum_db / jnp.maximum(self.n_examples, 1).astype(jnp.float32)

@@ -1,0 +1,61 @@
+"""SI-SDR / SI-SNR modules. Extension beyond the reference snapshot (later
+torchmetrics ``torchmetrics/audio/si_sdr.py`` / ``si_snr.py``)."""
+from typing import Any, Callable, Optional
+
+from jax import Array
+
+from metrics_tpu.audio.base import _PerExampleDbMetric
+from metrics_tpu.functional.audio.si_sdr import _si_sdr_per_example
+
+
+class SI_SDR(_PerExampleDbMetric):
+    r"""Accumulated scale-invariant signal-to-distortion ratio (mean, dB).
+
+    Args:
+        zero_mean: mean-center both signals over time before scaling.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> si_sdr = SI_SDR()
+        >>> round(float(si_sdr(preds, target)), 4)
+        18.403
+    """
+
+    def __init__(
+        self,
+        zero_mean: bool = False,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.zero_mean = zero_mean
+
+    def _per_example(self, preds: Array, target: Array) -> Array:
+        return _si_sdr_per_example(preds, target, self.zero_mean)
+
+
+class SI_SNR(_PerExampleDbMetric):
+    r"""Accumulated scale-invariant signal-to-noise ratio (mean, dB).
+
+    Equivalent to SI-SDR with both signals mean-centered over time.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> si_snr = SI_SNR()
+        >>> round(float(si_snr(preds, target)), 4)
+        15.0918
+    """
+
+    def _per_example(self, preds: Array, target: Array) -> Array:
+        return _si_sdr_per_example(preds, target, zero_mean=True)

@@ -19,3 +19,4 @@ from metrics_tpu.classification.precision_recall import Precision, Recall
 from metrics_tpu.classification.precision_recall_curve import PrecisionRecallCurve
 from metrics_tpu.classification.roc import ROC
 from metrics_tpu.classification.stat_scores import StatScores
+from metrics_tpu.classification.calibration_error import CalibrationError

@@ -44,3 +44,14 @@ from metrics_tpu.functional.retrieval.precision import retrieval_precision
 from metrics_tpu.functional.retrieval.r_precision import retrieval_r_precision
 from metrics_tpu.functional.retrieval.recall import retrieval_recall
 from metrics_tpu.functional.retrieval.reciprocal_rank import retrieval_reciprocal_rank
+from metrics_tpu.functional.audio.snr import signal_noise_ratio
+from metrics_tpu.functional.audio.si_sdr import (
+    scale_invariant_signal_distortion_ratio,
+    scale_invariant_signal_noise_ratio,
+)
+from metrics_tpu.functional.regression.mape import (
+    mean_absolute_percentage_error,
+    symmetric_mean_absolute_percentage_error,
+    weighted_mean_absolute_percentage_error,
+)
+from metrics_tpu.functional.classification.calibration_error import calibration_error

@@ -1,0 +1,8 @@
+from metrics_tpu.functional.audio.snr import signal_noise_ratio
+from metrics_tpu.functional.audio.si_sdr import scale_invariant_signal_distortion_ratio, scale_invariant_signal_noise_ratio
+
+__all__ = [
+    "signal_noise_ratio",
+    "scale_invariant_signal_distortion_ratio",
+    "scale_invariant_signal_noise_ratio",
+]

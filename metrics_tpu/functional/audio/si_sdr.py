@@ -1,0 +1,62 @@
+"""Scale-invariant SDR / SNR.
+
+Extension beyond the reference snapshot (later torchmetrics ships ``SI_SDR``
+and ``SI_SNR`` in its audio package; Le Roux et al. 2019, "SDR — half-baked
+or well done?"). Pure reductions over the trailing time axis — one fused XLA
+program, vmap/jit-safe, batched over any leading axes.
+"""
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+_EPS = 1e-8
+
+
+def scale_invariant_signal_distortion_ratio(
+    preds: Array, target: Array, zero_mean: bool = False
+) -> Array:
+    """SI-SDR in dB, per example over the trailing axis, batch-averaged.
+
+    The target is rescaled by ``alpha = <preds, target> / ||target||^2`` so
+    the measure ignores overall gain:
+    ``SI-SDR = 10 log10( ||alpha target||^2 / ||preds - alpha target||^2 )``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> round(float(scale_invariant_signal_distortion_ratio(preds, target)), 4)
+        18.403
+    """
+    return jnp.mean(_si_sdr_per_example(preds, target, zero_mean))
+
+
+def _si_sdr_per_example(preds: Array, target: Array, zero_mean: bool) -> Array:
+    """Per-example SI-SDR in dB over the trailing axis."""
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    if zero_mean:
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+    alpha = jnp.sum(preds * target, axis=-1, keepdims=True) / jnp.maximum(
+        jnp.sum(target**2, axis=-1, keepdims=True), _EPS
+    )
+    scaled = alpha * target
+    signal = jnp.sum(scaled**2, axis=-1)
+    noise = jnp.sum((preds - scaled) ** 2, axis=-1)
+    return 10.0 * jnp.log10(jnp.maximum(signal, _EPS) / jnp.maximum(noise, _EPS))
+
+
+def scale_invariant_signal_noise_ratio(preds: Array, target: Array) -> Array:
+    """SI-SNR in dB: SI-SDR with both signals mean-centered over time.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> round(float(scale_invariant_signal_noise_ratio(preds, target)), 4)
+        15.0918
+    """
+    return scale_invariant_signal_distortion_ratio(preds, target, zero_mean=True)

@@ -20,3 +20,4 @@ from metrics_tpu.functional.classification.specificity import specificity
 from metrics_tpu.functional.classification.precision_recall_curve import precision_recall_curve
 from metrics_tpu.functional.classification.roc import roc
 from metrics_tpu.functional.classification.stat_scores import stat_scores
+from metrics_tpu.functional.classification.calibration_error import calibration_error

@@ -1,0 +1,93 @@
+"""Calibration error (ECE / MCE / RMSCE).
+
+Extension beyond the reference snapshot (later torchmetrics ships
+``CalibrationError``). TPU-native by construction: the statistic is already
+binned, so the streaming state is three ``(n_bins,)`` ``"sum"`` vectors
+(confidence sum, accuracy sum, count per bin) — O(bins) memory, exact, one
+fused ``psum`` to sync, and the whole update is a segment-sum (no host work).
+
+Binning follows the standard uniform partition of [0, 1] with the top-1
+confidence: bin ``b`` holds samples with ``conf in (b/B, (b+1)/B]`` (samples
+at exactly 0 land in bin 0).
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+_NORMS = ("l1", "l2", "max")
+
+
+def _top1_conf_acc(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """(confidence, correctness) per sample from probs.
+
+    ``preds``: (N, C) class probabilities, or (N,) binary positive-class
+    probabilities (confidence is then the probability of the predicted
+    class, i.e. ``max(p, 1-p)``).
+    """
+    if preds.ndim == 1:
+        conf = jnp.maximum(preds, 1.0 - preds)
+        pred_label = (preds >= 0.5).astype(jnp.int32)
+    elif preds.ndim == 2:
+        conf = jnp.max(preds, axis=-1)
+        pred_label = jnp.argmax(preds, axis=-1).astype(jnp.int32)
+    else:
+        raise ValueError(f"`preds` must be (N,) binary probs or (N, C) probs, got ndim={preds.ndim}")
+    if target.shape != pred_label.shape:
+        raise ValueError("`target` must have shape (N,) matching `preds`' leading dimension")
+    acc = (pred_label == target.astype(jnp.int32)).astype(jnp.float32)
+    return conf.astype(jnp.float32), acc
+
+
+def _calibration_update(preds: Array, target: Array, n_bins: int) -> Tuple[Array, Array, Array]:
+    """Per-bin (confidence sum, accuracy sum, count) — plain sum states.
+
+    Counts are integers in the package accumulator dtype (float32 counts
+    stop incrementing at 2^24 — same policy as every other count state).
+    """
+    from metrics_tpu.utils.data import accum_int_dtype
+
+    conf, acc = _top1_conf_acc(preds, target)
+    # right-closed uniform bins; ceil(conf * B) - 1, with conf == 0 in bin 0
+    bins = jnp.clip(jnp.ceil(conf * n_bins).astype(jnp.int32) - 1, 0, n_bins - 1)
+    conf_sum = jax.ops.segment_sum(conf, bins, n_bins)
+    acc_sum = jax.ops.segment_sum(acc, bins, n_bins)
+    count = jax.ops.segment_sum(jnp.ones_like(conf, dtype=accum_int_dtype()), bins, n_bins)
+    return conf_sum, acc_sum, count
+
+
+def _calibration_compute(conf_sum: Array, acc_sum: Array, count: Array, norm: str) -> Array:
+    count = count.astype(jnp.float32)
+    total = jnp.sum(count)
+    safe_count = jnp.maximum(count, 1.0)
+    gap = jnp.abs(acc_sum / safe_count - conf_sum / safe_count)
+    weight = count / jnp.maximum(total, 1.0)
+    if norm == "l1":
+        return jnp.sum(weight * gap)
+    if norm == "max":
+        return jnp.max(jnp.where(count > 0, gap, 0.0))
+    return jnp.sqrt(jnp.sum(weight * gap**2))  # l2 (RMS calibration error)
+
+
+def calibration_error(preds: Array, target: Array, n_bins: int = 15, norm: str = "l1") -> Array:
+    """Top-1 calibration error over uniform confidence bins.
+
+    Args:
+        preds: (N, C) probabilities or (N,) binary positive-class probs.
+        target: (N,) integer labels.
+        n_bins: number of uniform bins over [0, 1].
+        norm: "l1" (ECE, default), "l2" (RMS), or "max" (MCE).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([[0.9, 0.1], [0.6, 0.4], [0.2, 0.8]])
+        >>> target = jnp.array([0, 1, 1])
+        >>> round(float(calibration_error(preds, target, n_bins=4)), 4)
+        0.3
+    """
+    if norm not in _NORMS:
+        raise ValueError(f"`norm` must be one of {_NORMS}, got {norm!r}")
+    if not isinstance(n_bins, int) or n_bins <= 0:
+        raise ValueError(f"`n_bins` must be a positive integer, got {n_bins!r}")
+    return _calibration_compute(*_calibration_update(preds, target, n_bins), norm)

@@ -10,3 +10,8 @@ from metrics_tpu.functional.regression.psnr import psnr
 from metrics_tpu.functional.regression.r2score import r2score
 from metrics_tpu.functional.regression.spearman import spearman_corrcoef
 from metrics_tpu.functional.regression.ssim import ssim
+from metrics_tpu.functional.regression.mape import (
+    mean_absolute_percentage_error,
+    symmetric_mean_absolute_percentage_error,
+    weighted_mean_absolute_percentage_error,
+)

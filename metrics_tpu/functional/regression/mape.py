@@ -1,0 +1,84 @@
+"""Mean absolute percentage error family (MAPE / SMAPE / WMAPE).
+
+Extension beyond the reference snapshot (later torchmetrics ships
+``MeanAbsolutePercentageError``, ``SymmetricMeanAbsolutePercentageError``,
+``WeightedMeanAbsolutePercentageError``). Each is a pair of plain ``"sum"``
+states — O(1) memory, jit-fusable, one psum to sync.
+"""
+from typing import Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+# epsilon matching later torchmetrics' clamp on the denominator
+_EPS = 1.17e-6
+
+
+def _mape_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    ratio = jnp.abs(preds - target) / jnp.maximum(jnp.abs(target), _EPS)
+    return jnp.sum(ratio), target.size
+
+
+def _mape_compute(sum_ratio: Array, n_obs: Union[int, Array]) -> Array:
+    return sum_ratio / n_obs
+
+
+def mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """MAPE: mean of ``|preds - target| / max(|target|, eps)``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([1.0, 10.0, 1e6])
+        >>> preds = jnp.array([0.9, 15.0, 1.2e6])
+        >>> round(float(mean_absolute_percentage_error(preds, target)), 4)
+        0.2667
+    """
+    return _mape_compute(*_mape_update(preds, target))
+
+
+def _smape_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    ratio = 2.0 * jnp.abs(preds - target) / jnp.maximum(jnp.abs(preds) + jnp.abs(target), _EPS)
+    return jnp.sum(ratio), target.size
+
+
+def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """SMAPE: mean of ``2 |preds - target| / max(|preds| + |target|, eps)``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([1.0, 10.0, 1e6])
+        >>> preds = jnp.array([0.9, 15.0, 1.2e6])
+        >>> round(float(symmetric_mean_absolute_percentage_error(preds, target)), 4)
+        0.229
+    """
+    sum_ratio, n_obs = _smape_update(preds, target)
+    return sum_ratio / n_obs
+
+
+def _wmape_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    return jnp.sum(jnp.abs(preds - target)), jnp.sum(jnp.abs(target))
+
+
+def weighted_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """WMAPE: ``sum |preds - target| / sum |target|``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([1.0, 10.0, 100.0])
+        >>> preds = jnp.array([0.9, 15.0, 110.0])
+        >>> round(float(weighted_mean_absolute_percentage_error(preds, target)), 4)
+        0.136
+    """
+    abs_error, abs_target = _wmape_update(preds, target)
+    return abs_error / jnp.maximum(abs_target, _EPS)

@@ -9,3 +9,8 @@ from metrics_tpu.regression.psnr import PSNR
 from metrics_tpu.regression.r2score import R2Score
 from metrics_tpu.regression.spearman import SpearmanCorrcoef
 from metrics_tpu.regression.ssim import SSIM
+from metrics_tpu.regression.mape import (
+    MeanAbsolutePercentageError,
+    SymmetricMeanAbsolutePercentageError,
+    WeightedMeanAbsolutePercentageError,
+)

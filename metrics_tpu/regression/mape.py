@@ -1,0 +1,107 @@
+"""MAPE / SMAPE / WMAPE modules. Extension beyond the reference snapshot
+(later torchmetrics regression package). All are two-sum streaming states."""
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.regression.mape import _EPS, _mape_update, _smape_update, _wmape_update
+from metrics_tpu.utils.data import accum_int_dtype
+
+
+class _RatioSumMetric(Metric):
+    """sum-of-ratios / count accumulation shared by MAPE and SMAPE."""
+
+    def __init__(
+        self,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.add_state("sum_ratio", default=np.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=np.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
+
+    def compute(self) -> Array:
+        return self.sum_ratio / jnp.maximum(self.total, 1).astype(jnp.float32)
+
+
+class MeanAbsolutePercentageError(_RatioSumMetric):
+    r"""Accumulated MAPE: mean of ``|preds - target| / max(|target|, eps)``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([1.0, 10.0, 1e6])
+        >>> preds = jnp.array([0.9, 15.0, 1.2e6])
+        >>> mape = MeanAbsolutePercentageError()
+        >>> round(float(mape(preds, target)), 4)
+        0.2667
+    """
+
+    def update(self, preds: Array, target: Array) -> None:
+        sum_ratio, n_obs = _mape_update(preds, target)
+        self.sum_ratio = self.sum_ratio + sum_ratio
+        self.total = self.total + n_obs
+
+
+class SymmetricMeanAbsolutePercentageError(_RatioSumMetric):
+    r"""Accumulated SMAPE: mean of ``2 |p - t| / max(|p| + |t|, eps)``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([1.0, 10.0, 1e6])
+        >>> preds = jnp.array([0.9, 15.0, 1.2e6])
+        >>> smape = SymmetricMeanAbsolutePercentageError()
+        >>> round(float(smape(preds, target)), 4)
+        0.229
+    """
+
+    def update(self, preds: Array, target: Array) -> None:
+        sum_ratio, n_obs = _smape_update(preds, target)
+        self.sum_ratio = self.sum_ratio + sum_ratio
+        self.total = self.total + n_obs
+
+
+class WeightedMeanAbsolutePercentageError(Metric):
+    r"""Accumulated WMAPE: ``sum |preds - target| / sum |target|``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([1.0, 10.0, 100.0])
+        >>> preds = jnp.array([0.9, 15.0, 110.0])
+        >>> wmape = WeightedMeanAbsolutePercentageError()
+        >>> round(float(wmape(preds, target)), 4)
+        0.136
+    """
+
+    def __init__(
+        self,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.add_state("sum_abs_error", default=np.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_abs_target", default=np.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        abs_error, abs_target = _wmape_update(preds, target)
+        self.sum_abs_error = self.sum_abs_error + abs_error
+        self.sum_abs_target = self.sum_abs_target + abs_target
+
+    def compute(self) -> Array:
+        return self.sum_abs_error / jnp.maximum(self.sum_abs_target, _EPS)

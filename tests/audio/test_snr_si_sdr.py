@@ -1,0 +1,130 @@
+"""SNR / SI-SDR / SI-SNR vs an independent numpy oracle."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import SI_SDR, SI_SNR, SNR
+from metrics_tpu.functional import (
+    scale_invariant_signal_distortion_ratio,
+    scale_invariant_signal_noise_ratio,
+    signal_noise_ratio,
+)
+from tests.helpers.testers import NUM_BATCHES, MetricTester
+
+_rng = np.random.RandomState(7)
+BATCH_SIZE, TIME = 8, 128
+
+_target = _rng.randn(NUM_BATCHES, BATCH_SIZE, TIME).astype(np.float32)
+_preds = (_target + 0.3 * _rng.randn(NUM_BATCHES, BATCH_SIZE, TIME)).astype(np.float32)
+
+
+def _np_snr(preds, target, zero_mean=False):
+    preds = preds.reshape(-1, TIME).astype(np.float64)
+    target = target.reshape(-1, TIME).astype(np.float64)
+    if zero_mean:
+        preds = preds - preds.mean(-1, keepdims=True)
+        target = target - target.mean(-1, keepdims=True)
+    vals = 10 * np.log10((target**2).sum(-1) / ((preds - target) ** 2).sum(-1))
+    return vals.mean()
+
+
+def _np_si_sdr(preds, target, zero_mean=False):
+    preds = preds.reshape(-1, TIME).astype(np.float64)
+    target = target.reshape(-1, TIME).astype(np.float64)
+    if zero_mean:
+        preds = preds - preds.mean(-1, keepdims=True)
+        target = target - target.mean(-1, keepdims=True)
+    alpha = (preds * target).sum(-1, keepdims=True) / (target**2).sum(-1, keepdims=True)
+    scaled = alpha * target
+    vals = 10 * np.log10((scaled**2).sum(-1) / ((preds - scaled) ** 2).sum(-1))
+    return vals.mean()
+
+
+class TestSNR(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("dist_sync_on_step", [False, True])
+    @pytest.mark.parametrize("zero_mean", [False, True])
+    def test_snr_class(self, ddp, dist_sync_on_step, zero_mean):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_preds,
+            target=_target,
+            metric_class=SNR,
+            sk_metric=lambda p, t: _np_snr(p, t, zero_mean),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={"zero_mean": zero_mean},
+        )
+
+    def test_snr_functional(self):
+        self.run_functional_metric_test(
+            _preds, _target, metric_functional=signal_noise_ratio,
+            sk_metric=lambda p, t: _np_snr(p, t),
+        )
+
+
+class TestSISDR(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("dist_sync_on_step", [False, True])
+    @pytest.mark.parametrize("zero_mean", [False, True])
+    def test_si_sdr_class(self, ddp, dist_sync_on_step, zero_mean):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_preds,
+            target=_target,
+            metric_class=SI_SDR,
+            sk_metric=lambda p, t: _np_si_sdr(p, t, zero_mean),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={"zero_mean": zero_mean},
+        )
+
+    def test_si_sdr_functional(self):
+        self.run_functional_metric_test(
+            _preds, _target, metric_functional=scale_invariant_signal_distortion_ratio,
+            sk_metric=lambda p, t: _np_si_sdr(p, t),
+        )
+
+
+class TestSISNR(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("dist_sync_on_step", [False, True])
+    def test_si_snr_class(self, ddp, dist_sync_on_step):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_preds,
+            target=_target,
+            metric_class=SI_SNR,
+            sk_metric=lambda p, t: _np_si_sdr(p, t, zero_mean=True),
+            dist_sync_on_step=dist_sync_on_step,
+        )
+
+    def test_si_snr_functional(self):
+        self.run_functional_metric_test(
+            _preds, _target, metric_functional=scale_invariant_signal_noise_ratio,
+            sk_metric=lambda p, t: _np_si_sdr(p, t, zero_mean=True),
+        )
+
+
+def test_audio_metrics_jit_and_accumulation():
+    """Fused forward under jit; accumulation equals the global mean."""
+    import metrics_tpu
+
+    old = metrics_tpu.set_default_jit(True)
+    try:
+        m = SI_SDR()
+        for i in range(NUM_BATCHES):
+            m(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+        np.testing.assert_allclose(float(m.compute()), _np_si_sdr(_preds, _target), atol=1e-4)
+    finally:
+        metrics_tpu.set_default_jit(old)
+
+
+def test_snr_shape_mismatch_raises():
+    with pytest.raises(RuntimeError, match="same shape"):
+        signal_noise_ratio(jnp.zeros((2, 8)), jnp.zeros((2, 9)))

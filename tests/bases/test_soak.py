@@ -5,6 +5,8 @@ would silently re-introduce per-step retraces or per-step buffer leaks: the
 fused step must compile ONCE, then replay for every subsequent step and for
 every config-identical instance, with a flat live-buffer population.
 """
+import gc
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -38,6 +40,7 @@ def test_fused_step_zero_retraces_and_flat_buffers(jit_on):
     traces = step._cache_size()
 
     jax.block_until_ready(m.compute())
+    gc.collect()
     n_live = len(jax.live_arrays())
     for _ in range(50):
         m(preds, target)
@@ -47,6 +50,7 @@ def test_fused_step_zero_retraces_and_flat_buffers(jit_on):
     assert step._cache_size() == traces
     # flat device-buffer population: steady state allocates nothing beyond
     # the rotating state/value buffers (slack for the last step's outputs)
+    gc.collect()
     assert len(jax.live_arrays()) <= n_live + 8
 
 
@@ -76,11 +80,13 @@ def test_collection_fused_step_soak(jit_on):
         F1(num_classes=8, average="macro"),
     ])
     jax.block_until_ready(jax.tree_util.tree_leaves(coll(preds, target)))
+    gc.collect()
     n_live = len(jax.live_arrays())
     for _ in range(30):
         coll(preds, target)
     out = coll.compute()
     jax.block_until_ready(jax.tree_util.tree_leaves(out))
+    gc.collect()
     assert len(jax.live_arrays()) <= n_live + 12
 
 
@@ -95,10 +101,12 @@ def test_forward_batched_scan_step_soak(jit_on):
     jax.block_until_ready(m.forward_batched(stacked_p, stacked_t))
     step = m._jitted_scan[1]
     traces = step._cache_size()
+    gc.collect()
     n_live = len(jax.live_arrays())
     for _ in range(20):
         m2 = Accuracy()
         m2.forward_batched(stacked_p, stacked_t)
         jax.block_until_ready(m2.compute())
     assert step._cache_size() == traces
+    gc.collect()
     assert len(jax.live_arrays()) <= n_live + 8

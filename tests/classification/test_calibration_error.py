@@ -1,0 +1,118 @@
+"""CalibrationError vs an independent numpy binning oracle."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import CalibrationError
+from metrics_tpu.functional import calibration_error
+from tests.helpers.testers import NUM_BATCHES, MetricTester
+
+_rng = np.random.RandomState(13)
+BATCH_SIZE, C = 64, 5
+
+_logits = _rng.rand(NUM_BATCHES, BATCH_SIZE, C).astype(np.float32)
+_preds = _logits / _logits.sum(-1, keepdims=True)
+_target = _rng.randint(0, C, (NUM_BATCHES, BATCH_SIZE))
+
+_binary_preds = _rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+_binary_target = (_rng.rand(NUM_BATCHES, BATCH_SIZE) > 0.5).astype(np.int64)
+
+
+def _np_calibration(preds, target, n_bins=15, norm="l1"):
+    preds = np.asarray(preds, np.float64)
+    if preds.ndim == 3:
+        preds = preds.reshape(-1, preds.shape[-1])
+    target = np.asarray(target).reshape(-1)
+    if preds.ndim == 2:
+        conf = preds.max(-1)
+        acc = (preds.argmax(-1) == target).astype(np.float64)
+    else:
+        pr = preds.reshape(-1)
+        conf = np.maximum(pr, 1 - pr)
+        acc = ((pr >= 0.5).astype(np.int64) == target).astype(np.float64)
+    bins = np.clip(np.ceil(conf * n_bins).astype(int) - 1, 0, n_bins - 1)
+    total = conf.size
+    gaps, weights = [], []
+    for b in range(n_bins):
+        m = bins == b
+        if not m.any():
+            continue
+        gaps.append(abs(acc[m].mean() - conf[m].mean()))
+        weights.append(m.sum() / total)
+    gaps, weights = np.asarray(gaps), np.asarray(weights)
+    if norm == "l1":
+        return float((weights * gaps).sum())
+    if norm == "max":
+        return float(gaps.max())
+    return float(np.sqrt((weights * gaps**2).sum()))
+
+
+def _flatten_preds(preds):
+    return preds.reshape(-1, preds.shape[-1]) if preds.ndim == 3 else preds.reshape(-1)
+
+
+class TestCalibrationError(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+    def test_multiclass_class(self, ddp, norm):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_preds,
+            target=_target,
+            metric_class=CalibrationError,
+            sk_metric=lambda p, t: _np_calibration(_flatten_batches(p), np.asarray(t).reshape(-1), 15, norm),
+            dist_sync_on_step=False,
+            metric_args={"norm": norm},
+        )
+
+    def test_multiclass_functional(self):
+        self.run_functional_metric_test(
+            _preds, _target,
+            metric_functional=calibration_error,
+            sk_metric=lambda p, t: _np_calibration(np.asarray(p), np.asarray(t), 15, "l1"),
+        )
+
+
+def _flatten_batches(p):
+    p = np.asarray(p)
+    return p.reshape(-1, p.shape[-1]) if p.ndim >= 2 else p
+
+
+def test_binary_probs():
+    got = float(calibration_error(jnp.asarray(_binary_preds[0]), jnp.asarray(_binary_target[0]), n_bins=10))
+    conf = np.maximum(_binary_preds[0], 1 - _binary_preds[0])
+    acc = ((_binary_preds[0] >= 0.5).astype(np.int64) == _binary_target[0]).astype(np.float64)
+    bins = np.clip(np.ceil(conf * 10).astype(int) - 1, 0, 9)
+    ece = sum((bins == b).mean() * abs(acc[bins == b].mean() - conf[bins == b].mean())
+              for b in range(10) if (bins == b).any())
+    np.testing.assert_allclose(got, ece, atol=1e-6)
+
+
+def test_accumulation_matches_global():
+    m = CalibrationError(n_bins=10, norm="l2")
+    for i in range(NUM_BATCHES):
+        m.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+    want = _np_calibration(_preds.reshape(-1, C), _target.reshape(-1), 10, "l2")
+    np.testing.assert_allclose(float(m.compute()), want, atol=1e-6)
+
+
+def test_jit_safe():
+    import jax
+
+    f = jax.jit(lambda p, t: calibration_error(p, t, n_bins=10, norm="max"))
+    got = float(f(jnp.asarray(_preds[0]), jnp.asarray(_target[0])))
+    want = _np_calibration(_preds[0], _target[0], 10, "max")
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_validation_errors():
+    with pytest.raises(ValueError, match="norm"):
+        CalibrationError(norm="bogus")
+    with pytest.raises(ValueError, match="n_bins"):
+        CalibrationError(n_bins=0)
+    with pytest.raises(ValueError, match="norm"):
+        calibration_error(jnp.zeros((4, 2)), jnp.zeros(4, dtype=jnp.int32), norm="huber")
+    with pytest.raises(ValueError, match="ndim"):
+        calibration_error(jnp.zeros((4, 2, 2)), jnp.zeros(4, dtype=jnp.int32))

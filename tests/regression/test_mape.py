@@ -1,0 +1,83 @@
+"""MAPE / SMAPE / WMAPE vs numpy oracles (sklearn's MAPE uses the same
+clamped-denominator definition; checked directly against the formulas)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    MeanAbsolutePercentageError,
+    SymmetricMeanAbsolutePercentageError,
+    WeightedMeanAbsolutePercentageError,
+)
+from metrics_tpu.functional import (
+    mean_absolute_percentage_error,
+    symmetric_mean_absolute_percentage_error,
+    weighted_mean_absolute_percentage_error,
+)
+from tests.helpers.testers import NUM_BATCHES, MetricTester
+
+_rng = np.random.RandomState(11)
+BATCH_SIZE = 64
+
+_target = (_rng.randn(NUM_BATCHES, BATCH_SIZE) * 10 + 20).astype(np.float32)
+_preds = (_target + _rng.randn(NUM_BATCHES, BATCH_SIZE) * 3).astype(np.float32)
+
+
+def _np_mape(preds, target):
+    p, t = np.asarray(preds, np.float64).ravel(), np.asarray(target, np.float64).ravel()
+    return (np.abs(p - t) / np.maximum(np.abs(t), 1.17e-6)).mean()
+
+
+def _np_smape(preds, target):
+    p, t = np.asarray(preds, np.float64).ravel(), np.asarray(target, np.float64).ravel()
+    return (2 * np.abs(p - t) / np.maximum(np.abs(p) + np.abs(t), 1.17e-6)).mean()
+
+
+def _np_wmape(preds, target):
+    p, t = np.asarray(preds, np.float64).ravel(), np.asarray(target, np.float64).ravel()
+    return np.abs(p - t).sum() / np.abs(t).sum()
+
+
+_CASES = [
+    (MeanAbsolutePercentageError, mean_absolute_percentage_error, _np_mape),
+    (SymmetricMeanAbsolutePercentageError, symmetric_mean_absolute_percentage_error, _np_smape),
+    (WeightedMeanAbsolutePercentageError, weighted_mean_absolute_percentage_error, _np_wmape),
+]
+
+
+@pytest.mark.parametrize("metric_class,functional,oracle", _CASES)
+class TestMAPEFamily(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("dist_sync_on_step", [False, True])
+    def test_class(self, metric_class, functional, oracle, ddp, dist_sync_on_step):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_preds,
+            target=_target,
+            metric_class=metric_class,
+            sk_metric=oracle,
+            dist_sync_on_step=dist_sync_on_step,
+        )
+
+    def test_functional(self, metric_class, functional, oracle):
+        self.run_functional_metric_test(_preds, _target, metric_functional=functional, sk_metric=oracle)
+
+
+def test_mape_matches_sklearn():
+    sklearn = pytest.importorskip("sklearn.metrics")
+    got = float(mean_absolute_percentage_error(jnp.asarray(_preds[0]), jnp.asarray(_target[0])))
+    want = sklearn.mean_absolute_percentage_error(_target[0], _preds[0])
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_mape_zero_target_clamped():
+    # zero targets hit the epsilon clamp instead of dividing by zero
+    v = float(mean_absolute_percentage_error(jnp.asarray([1.0]), jnp.asarray([0.0])))
+    assert np.isfinite(v) and v > 1e5
+
+
+def test_shape_mismatch_raises():
+    with pytest.raises(RuntimeError, match="same shape"):
+        weighted_mean_absolute_percentage_error(jnp.zeros(3), jnp.zeros(4))
