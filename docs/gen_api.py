@@ -26,6 +26,7 @@ SECTIONS = [
     ("Retrieval", "metrics_tpu.retrieval", None),
     ("Text", "metrics_tpu.text", None),
     ("Audio", "metrics_tpu.audio", None),
+    ("Wrappers", "metrics_tpu.wrappers", None),
     ("Functional", "metrics_tpu.functional", None),
     ("Parallel (mesh sync, placement, sharded epoch)", "metrics_tpu.parallel", None),
     ("Ops (kernels)", "metrics_tpu.ops.binned", ["binned_stat_counts"]),

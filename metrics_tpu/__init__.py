@@ -69,4 +69,5 @@ from metrics_tpu.retrieval import (  # noqa: E402
 )
 from metrics_tpu.text import WER  # noqa: E402
 from metrics_tpu.audio import SI_SDR, SI_SNR, SNR  # noqa: E402
+from metrics_tpu.wrappers import BootStrapper, ClasswiseWrapper, MetricTracker, MinMaxMetric  # noqa: E402
 from metrics_tpu import functional  # noqa: E402

@@ -1,5 +1,7 @@
-"""Audio metrics. Extension family beyond the reference snapshot (later
-torchmetrics ships an audio package: SNR, SI_SDR, SI_SNR)."""
+"""Audio metrics: SNR, SI_SDR, SI_SNR.
+
+Extension family beyond the reference snapshot (later torchmetrics ships
+these in its audio package)."""
 from metrics_tpu.audio.snr import SNR
 from metrics_tpu.audio.si_sdr import SI_SDR, SI_SNR
 

@@ -824,6 +824,14 @@ class Metric(ABC):
         if sizes:
             self._count_bound += min(sizes)
 
+    def _after_compute(self, result: Any) -> None:
+        """Hook run by the wrapped ``compute`` after the sync cache/restore.
+
+        State written inside ``compute`` itself is discarded when a
+        cross-process sync restores the local state; writes from this hook
+        persist. Default: nothing.
+        """
+
     def _host_warnings(self) -> None:
         """Host-side health warnings at epoch-compute time (no device work).
 
@@ -886,6 +894,9 @@ class Metric(ABC):
             self._computed = compute(*args, **kwargs)
             if synced:
                 self._set_state(cache)
+            # post-compute hook AFTER the sync restore: state written here
+            # persists (wrappers use it to track computed values as state)
+            self._after_compute(self._computed)
             return self._computed
 
         return wrapped_func

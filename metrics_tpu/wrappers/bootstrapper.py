@@ -1,0 +1,122 @@
+"""BootStrapper. Extension beyond the reference snapshot (later torchmetrics
+``wrappers/bootstrapping.py``).
+
+Each of ``num_bootstraps`` copies of the base metric sees a with-replacement
+resample of every batch. Resample indices come from a host-side seeded
+generator (cheap host ints; the gathers run on device), so runs are
+reproducible via ``seed`` and no device randomness threads through the
+metric API.
+"""
+from typing import Any, Callable, Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+from copy import deepcopy
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+
+
+class BootStrapper(Metric):
+    r"""Bootstrap-resampled uncertainty for any metric.
+
+    ``compute()`` returns ``{"mean": ..., "std": ...}`` over the bootstrap
+    copies' values (plus ``"raw"`` of shape ``(num_bootstraps,)`` when
+    ``raw=True``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> m = BootStrapper(Accuracy(), num_bootstraps=4, seed=7)
+        >>> m.update(jnp.array([1, 1, 0, 0]), jnp.array([1, 0, 0, 0]))
+        >>> out = m.compute()
+        >>> sorted(out)
+        ['mean', 'std']
+    """
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_bootstraps: int = 10,
+        raw: bool = False,
+        seed: int = 0,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        if not isinstance(base_metric, Metric):
+            raise ValueError(f"`base_metric` must be a Metric, got {type(base_metric).__name__}")
+        if not isinstance(num_bootstraps, int) or num_bootstraps < 2:
+            raise ValueError(
+                f"`num_bootstraps` must be an integer >= 2 (the std needs two samples), got {num_bootstraps!r}"
+            )
+        self.metrics = [deepcopy(base_metric) for _ in range(num_bootstraps)]
+        self.num_bootstraps = num_bootstraps
+        self.raw = raw
+        self._resample_rng = np.random.RandomState(seed)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update every copy with an independent with-replacement resample.
+
+        Resampling indexes the leading axis of every array argument and
+        kwarg (so preds/target stay paired)."""
+        arrays = [a for a in (*args, *kwargs.values()) if hasattr(a, "shape") and a.ndim >= 1]
+        n = arrays[0].shape[0] if arrays else None
+
+        def resample(value: Any, idx: Array) -> Any:
+            if hasattr(value, "shape") and value.ndim >= 1 and value.shape[0] == n:
+                return value[idx]
+            return value
+
+        for metric in self.metrics:
+            if n is None:
+                metric.update(*args, **kwargs)
+                continue
+            idx = jnp.asarray(self._resample_rng.randint(0, n, n))
+            metric.update(
+                *(resample(a, idx) for a in args),
+                **{k: resample(v, idx) for k, v in kwargs.items()},
+            )
+
+    def forward(self, *args: Any, **kwargs: Any) -> Optional[Dict[str, Array]]:
+        """Accumulate the batch into every copy; with ``compute_on_step``
+        return the batch-local mean/std (the base fused forward cannot be
+        used here: the bootstrap copies are child metrics, not registered
+        states). The batch-local pass replays the same resample draws the
+        accumulation consumed, so both see identical resamples."""
+        self._computed = None
+        rng_state = self._resample_rng.get_state()
+        self.update(*args, **kwargs)
+        if not self.compute_on_step:
+            return None
+        caches = [m._current_state() for m in self.metrics]
+        for m in self.metrics:
+            m.reset()
+        self._resample_rng.set_state(rng_state)
+        self.update(*args, **kwargs)
+        value = self.compute()
+        for m, cache in zip(self.metrics, caches):
+            m._set_state(cache)
+            m._computed = None  # the batch-local compute cached batch values
+        self._computed = None
+        self._forward_cache = value
+        return value
+
+    def compute(self) -> Dict[str, Array]:
+        values = jnp.stack([jnp.asarray(m.compute(), dtype=jnp.float32) for m in self.metrics])
+        out = {"mean": jnp.mean(values, axis=0), "std": jnp.std(values, axis=0, ddof=1)}
+        if self.raw:
+            out["raw"] = values
+        return out
+
+    def reset(self) -> None:
+        super().reset()
+        for metric in self.metrics:
+            metric.reset()

@@ -1,0 +1,83 @@
+"""MinMaxMetric wrapper. Extension beyond the reference snapshot (later
+torchmetrics ``wrappers/minmax.py``)."""
+from typing import Any, Callable, Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+
+
+class MinMaxMetric(Metric):
+    r"""Track a scalar metric together with the min/max of its epoch values.
+
+    ``compute()`` returns ``{"raw": current, "min": lowest-yet, "max":
+    highest-yet}``; the extrema update at each compute (torchmetrics
+    semantics) and carry ``min``/``max`` reductions for cross-device sync.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> m = MinMaxMetric(Accuracy())
+        >>> _ = m(jnp.array([1, 1, 0, 0]), jnp.array([1, 0, 0, 0]))
+        >>> sorted(m.compute().items())  # doctest: +ELLIPSIS
+        [('max', Array(0.75, ...)), ('min', Array(0.75, ...)), ('raw', Array(0.75, ...))]
+    """
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        if not isinstance(base_metric, Metric):
+            raise ValueError(f"`base_metric` must be a Metric, got {type(base_metric).__name__}")
+        self.base_metric = base_metric
+        self.add_state("min_val", default=np.asarray(np.inf, dtype=np.float32), dist_reduce_fx="min")
+        self.add_state("max_val", default=np.asarray(-np.inf, dtype=np.float32), dist_reduce_fx="max")
+
+    def _extrema(self, raw: Array):
+        # a nan raw value (e.g. compute with no data) must not poison the extrema
+        lo = jnp.where(jnp.isnan(raw), self.min_val, jnp.minimum(self.min_val, raw))
+        hi = jnp.where(jnp.isnan(raw), self.max_val, jnp.maximum(self.max_val, raw))
+        return lo, hi
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self.base_metric.update(*args, **kwargs)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Optional[Dict[str, Array]]:
+        """Accumulate and fold the batch-local value into the extrema (the
+        base fused forward cannot run here: the wrapped metric is a child,
+        not registered state)."""
+        self._computed = None
+        value = self.base_metric.forward(*args, **kwargs)
+        if value is None:
+            return None
+        raw = jnp.asarray(value, dtype=jnp.float32)
+        self.min_val, self.max_val = self._extrema(raw)
+        self._forward_cache = {"raw": raw, "min": self.min_val, "max": self.max_val}
+        return self._forward_cache
+
+    def compute(self) -> Dict[str, Array]:
+        raw = jnp.asarray(self.base_metric.compute(), dtype=jnp.float32)
+        lo, hi = self._extrema(raw)
+        return {"raw": raw, "min": lo, "max": hi}
+
+    def _after_compute(self, result: Dict[str, Array]) -> None:
+        # persist the extrema AFTER the wrapped compute's sync restore (state
+        # written inside compute itself would be discarded under ddp sync)
+        self.min_val = result["min"]
+        self.max_val = result["max"]
+
+    def reset(self) -> None:
+        super().reset()
+        self.base_metric.reset()

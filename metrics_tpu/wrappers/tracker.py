@@ -1,0 +1,90 @@
+"""MetricTracker. Extension beyond the reference snapshot (later torchmetrics
+``wrappers/tracker.py``)."""
+from copy import deepcopy
+from typing import Any, List, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+
+
+class MetricTracker(Metric):
+    r"""Track a metric (or collection) over multiple epochs/increments.
+
+    Call ``increment()`` at each epoch boundary; update/forward route to the
+    newest copy. ``compute_all()`` stacks every increment's value and
+    ``best_metric()`` returns the best (optionally with its step index).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> tracker = MetricTracker(Accuracy())
+        >>> for epoch in range(2):
+        ...     tracker.increment()
+        ...     _ = tracker(jnp.array([1, 1, 0, 0]), jnp.array([1, epoch, 0, 0]))
+        >>> float(tracker.best_metric())
+        1.0
+    """
+
+    def __init__(self, base_metric: Metric, maximize: bool = True):
+        super().__init__(compute_on_step=base_metric.compute_on_step)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(f"`base_metric` must be a Metric, got {type(base_metric).__name__}")
+        self._base = base_metric
+        self.maximize = maximize
+        self._increments: List[Metric] = []
+
+    @property
+    def n_steps(self) -> int:
+        return len(self._increments)
+
+    def _current(self) -> Metric:
+        if not self._increments:
+            raise RuntimeError("call `tracker.increment()` before updating the tracker")
+        return self._increments[-1]
+
+    def increment(self) -> None:
+        """Start tracking a fresh copy of the base metric."""
+        self._computed = None
+        fresh = deepcopy(self._base)
+        fresh.reset()
+        self._increments.append(fresh)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._current().update(*args, **kwargs)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        self._computed = None  # bypasses the wrapped update: clear the cache here
+        return self._current().forward(*args, **kwargs)
+
+    def compute(self) -> Any:
+        return self._current().compute()
+
+    def compute_all(self) -> Array:
+        """Values of every increment, stacked along a leading step axis."""
+        return jnp.stack([jnp.asarray(m.compute(), dtype=jnp.float32) for m in self._increments])
+
+    def best_metric(self, return_step: bool = False) -> Union[Array, Tuple[Array, int]]:
+        """The best scalar value across increments (and its step index)."""
+        values = np.asarray(self.compute_all())
+        if values.ndim != 1:
+            raise ValueError(
+                "best_metric is defined for scalar metrics; use compute_all() for"
+                f" higher-rank values (got shape {values.shape})"
+            )
+        step = int(np.argmax(values) if self.maximize else np.argmin(values))
+        best = jnp.asarray(values[step])
+        return (best, step) if return_step else best
+
+    def reset(self) -> None:
+        """Reset the CURRENT increment (keeps history)."""
+        self._computed = None
+        if self._increments:
+            self._current().reset()
+
+    def reset_all(self) -> None:
+        """Drop all history."""
+        self._computed = None
+        self._increments = []

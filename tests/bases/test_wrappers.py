@@ -1,0 +1,183 @@
+"""Wrapper family: MinMaxMetric, ClasswiseWrapper, BootStrapper, MetricTracker."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import precision_score as sk_precision_score
+
+from metrics_tpu import (
+    Accuracy,
+    BootStrapper,
+    ClasswiseWrapper,
+    MeanAbsoluteError,
+    MetricTracker,
+    MinMaxMetric,
+    Precision,
+)
+
+_rng = np.random.RandomState(19)
+
+
+# ------------------------------------------------------------- MinMaxMetric
+def test_minmax_tracks_extrema_across_epochs():
+    m = MinMaxMetric(Accuracy())
+    m.update(jnp.array([1, 1, 0, 0]), jnp.array([1, 0, 0, 0]))  # acc 0.75
+    out = m.compute()
+    assert float(out["raw"]) == float(out["min"]) == float(out["max"]) == 0.75
+
+    m.base_metric.reset()
+    m.update(jnp.array([1, 1, 0, 0]), jnp.array([1, 1, 0, 0]))  # acc 1.0
+    out = m.compute()
+    assert float(out["raw"]) == 1.0 and float(out["min"]) == 0.75 and float(out["max"]) == 1.0
+
+    m.reset()
+    out_after = m.compute()  # nan raw (no data), +-inf extrema untouched yet
+    assert np.isinf(float(out_after["min"]))
+
+
+def test_minmax_rejects_non_metric():
+    with pytest.raises(ValueError, match="Metric"):
+        MinMaxMetric(lambda: None)
+
+
+# --------------------------------------------------------- ClasswiseWrapper
+def test_classwise_wrapper_labels_and_values():
+    p = _rng.randint(0, 3, 64).astype(np.int32)
+    t = _rng.randint(0, 3, 64).astype(np.int32)
+    m = ClasswiseWrapper(Precision(num_classes=3, average=None), labels=["a", "b", "c"])
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    out = m.compute()
+    want = sk_precision_score(t, p, average=None, zero_division=0)
+    for i, lab in enumerate(["a", "b", "c"]):
+        np.testing.assert_allclose(float(out[f"precision_{lab}"]), want[i], atol=1e-6)
+
+    # default labels + prefix
+    m2 = ClasswiseWrapper(Precision(num_classes=3, average=None), prefix="p_")
+    m2.update(jnp.asarray(p), jnp.asarray(t))
+    assert sorted(m2.compute()) == ["p_0", "p_1", "p_2"]
+
+
+def test_classwise_wrapper_validation():
+    with pytest.raises(ValueError, match="labels"):
+        ClasswiseWrapper(Precision(num_classes=3, average=None), labels=[1, 2, 3])
+    m = ClasswiseWrapper(Precision(num_classes=3, average=None), labels=["a", "b"])
+    m.update(jnp.array([0, 1, 2]), jnp.array([0, 1, 2]))
+    with pytest.raises(ValueError, match="labels for"):
+        m.compute()
+    scalar = ClasswiseWrapper(Accuracy())
+    scalar.update(jnp.array([0, 1]), jnp.array([0, 1]))
+    with pytest.raises(ValueError, match="1-D"):
+        scalar.compute()
+
+
+# ------------------------------------------------------------- BootStrapper
+def test_bootstrapper_mean_std_and_determinism():
+    p = _rng.rand(256).astype(np.float32) * 10
+    t = p + _rng.randn(256).astype(np.float32)
+
+    m1 = BootStrapper(MeanAbsoluteError(), num_bootstraps=20, seed=3, raw=True)
+    m1.update(jnp.asarray(p), jnp.asarray(t))
+    out1 = m1.compute()
+    assert out1["raw"].shape == (20,)
+    # bootstrap mean is near the full-sample value, std is small but nonzero
+    full = float(np.abs(p - t).mean())
+    assert abs(float(out1["mean"]) - full) < 0.2
+    assert 0 < float(out1["std"]) < 0.5
+
+    # same seed -> identical resamples; different seed -> different
+    m2 = BootStrapper(MeanAbsoluteError(), num_bootstraps=20, seed=3, raw=True)
+    m2.update(jnp.asarray(p), jnp.asarray(t))
+    np.testing.assert_array_equal(np.asarray(out1["raw"]), np.asarray(m2.compute()["raw"]))
+    m3 = BootStrapper(MeanAbsoluteError(), num_bootstraps=20, seed=4, raw=True)
+    m3.update(jnp.asarray(p), jnp.asarray(t))
+    assert not np.array_equal(np.asarray(out1["raw"]), np.asarray(m3.compute()["raw"]))
+
+
+def test_bootstrapper_validation_and_reset():
+    with pytest.raises(ValueError, match="num_bootstraps"):
+        BootStrapper(Accuracy(), num_bootstraps=0)
+    m = BootStrapper(MeanAbsoluteError(), num_bootstraps=3)
+    m.update(jnp.arange(8.0), jnp.arange(8.0) + 1)
+    m.reset()
+    m.update(jnp.arange(4.0), jnp.arange(4.0))
+    assert float(m.compute()["mean"]) == 0.0
+
+
+# ------------------------------------------------------------- MetricTracker
+def test_tracker_epochs_best_and_history():
+    tracker = MetricTracker(Accuracy(), maximize=True)
+    accs = []
+    for epoch in range(3):
+        tracker.increment()
+        p = jnp.asarray([1, 1, 0, 0])
+        t = jnp.asarray([1, epoch % 2, 0, 0])
+        tracker(p, t)
+        accs.append(float(tracker.compute()))
+    all_vals = np.asarray(tracker.compute_all())
+    np.testing.assert_allclose(all_vals, accs, atol=1e-6)
+    best, step = tracker.best_metric(return_step=True)
+    assert float(best) == max(accs) and step == int(np.argmax(accs))
+
+    # minimize mode
+    mt = MetricTracker(MeanAbsoluteError(), maximize=False)
+    for err in (2.0, 0.5, 1.0):
+        mt.increment()
+        mt.update(jnp.zeros(4), jnp.full((4,), err))
+    assert float(mt.best_metric()) == 0.5
+
+    # reset clears only the current increment; reset_all clears history
+    assert tracker.n_steps == 3
+    tracker.reset_all()
+    assert tracker.n_steps == 0
+    with pytest.raises(RuntimeError, match="increment"):
+        tracker.update(jnp.array([1]), jnp.array([1]))
+
+
+# --------------------------------------------- forward paths (fused bypass)
+def test_wrappers_forward_accumulates_under_default_jit():
+    """Wrappers hold child metrics (not registered states): their forward
+    must bypass the fused jitted path and still accumulate."""
+    import metrics_tpu
+
+    old = metrics_tpu.set_default_jit(True)
+    try:
+        bs = BootStrapper(MeanAbsoluteError(), num_bootstraps=4, seed=5)
+        p = jnp.arange(32.0)
+        t = p + 1.0
+        out = bs(p, t)  # forward: batch value AND accumulation
+        assert abs(float(out["mean"]) - 1.0) < 1e-6
+        after = bs.compute()
+        assert abs(float(after["mean"]) - 1.0) < 1e-6  # children really accumulated
+
+        mm = MinMaxMetric(Accuracy())
+        v1 = mm(jnp.array([1, 1, 0, 0]), jnp.array([1, 0, 0, 0]))  # 0.75
+        v2 = mm(jnp.array([1, 1, 0, 0]), jnp.array([1, 1, 0, 0]))  # 1.0
+        assert float(v1["raw"]) == 0.75 and float(v2["raw"]) == 1.0
+        # the first step's extrema write persisted through the second forward
+        assert float(v2["min"]) == 0.75 and float(v2["max"]) == 1.0
+        out = mm.compute()
+        assert float(out["min"]) == 0.75 and float(out["max"]) == 1.0
+    finally:
+        metrics_tpu.set_default_jit(old)
+
+
+def test_bootstrapper_kwargs_resampled_consistently():
+    """preds/target must stay paired when passed as kwargs."""
+    p = jnp.asarray(_rng.rand(128).astype(np.float32))
+    m = BootStrapper(MeanAbsoluteError(), num_bootstraps=6, seed=2)
+    m.update(p, target=p)  # identical pairs: MAE must be exactly 0 in every copy
+    out = m.compute()
+    assert float(out["mean"]) == 0.0 and float(out["std"]) == 0.0
+
+
+def test_tracker_reset_clears_cache():
+    t = MetricTracker(Accuracy())
+    t.increment()
+    t.update(jnp.array([1, 1]), jnp.array([1, 1]))
+    assert float(t.compute()) == 1.0
+    t.reset()
+    assert np.isnan(float(t.compute()))  # empty state, not the stale cache
+
+
+def test_bootstrapper_requires_two_copies():
+    with pytest.raises(ValueError, match=">= 2"):
+        BootStrapper(Accuracy(), num_bootstraps=1)
