@@ -55,3 +55,9 @@ from metrics_tpu.functional.regression.mape import (
     weighted_mean_absolute_percentage_error,
 )
 from metrics_tpu.functional.classification.calibration_error import calibration_error
+from metrics_tpu.functional.text import (
+    cer,
+    match_error_rate,
+    word_information_lost,
+    word_information_preserved,
+)

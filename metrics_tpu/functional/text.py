@@ -10,7 +10,7 @@ Two evaluation paths:
   ``lax.scan`` over the padded target axis with the row as carry, so a whole
   batch of sequences evaluates in one fused XLA program (vmap over the batch).
 """
-from typing import List, Sequence, Tuple, Union
+from typing import Callable, List, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -38,6 +38,131 @@ def _np_edit_distance(a: List[str], b: List[str]) -> int:
             cur[j] = min(prev[j] + 1, cur[j - 1] + 1, sub[j - 1])
         prev = cur
     return int(prev[-1])
+
+
+def _np_edit_distance_hits(a: List[str], b: List[str]) -> Tuple[int, int]:
+    """(edit distance, aligned matches) via a host DP.
+
+    ``hits`` is the number of matched tokens in a minimum-edit alignment,
+    maximized over all minimum-distance alignments (a deterministic
+    definition; jiwer-style MER/WIP derive from exactly these two numbers:
+    ``S + D = len(b) - hits``, ``I = dist - S - D``).
+    """
+    if not a:
+        return len(b), 0
+    if not b:
+        return len(a), 0
+    # lexicographic DP over (distance, -hits)
+    prev = [(j, 0) for j in range(len(b) + 1)]
+    for i, tok in enumerate(a, 1):
+        cur = [(i, 0)] + [None] * len(b)
+        for j in range(1, len(b) + 1):
+            d_diag, h_diag = prev[j - 1]
+            if tok == b[j - 1]:
+                best = (d_diag, h_diag + 1)
+            else:
+                best = (d_diag + 1, h_diag)
+            d_up, h_up = prev[j]
+            d_left, h_left = cur[j - 1]
+            for cand in ((d_up + 1, h_up), (d_left + 1, h_left)):
+                if cand[0] < best[0] or (cand[0] == best[0] and cand[1] > best[1]):
+                    best = cand
+            cur[j] = best
+        prev = cur
+    return prev[-1]
+
+
+def _chars(x: TokenSeq) -> List[str]:
+    return list(x) if isinstance(x, str) else [c for tok in x for c in tok]
+
+
+def _sequence_stats(
+    preds: Union[str, Sequence[TokenSeq]],
+    target: Union[str, Sequence[TokenSeq]],
+    tokenize: Callable[[TokenSeq], List[str]],
+    need_hits: bool = True,
+) -> Tuple[int, int, int, int]:
+    """(edit errors, hits, target length, pred length) summed over pairs.
+
+    ``need_hits=False`` (CER: distance only) takes the faster vectorized DP
+    and reports hits as 0 — character-level tables are large, and the tuple
+    DP costs a Python allocation per cell.
+    """
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    if len(preds) != len(target):
+        raise ValueError("`preds` and `target` must have the same number of sequences")
+    errors = hits = total_t = total_p = 0
+    for p, t in zip(preds, target):
+        pt, tt = tokenize(p), tokenize(t)
+        if need_hits:
+            d, h = _np_edit_distance_hits(pt, tt)
+            hits += h
+        else:
+            d = _np_edit_distance(pt, tt)
+        errors += d
+        total_t += len(tt)
+        total_p += len(pt)
+    return errors, hits, total_t, total_p
+
+
+def cer(preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> float:
+    """Character error rate: character-level edit distance / reference chars.
+
+    Characters are taken from the strings as-is (spaces included);
+    pre-tokenized input concatenates its tokens' characters.
+
+    Example:
+        >>> cer("abcd", "abce")
+        0.25
+    """
+    errors, _, total, _ = _sequence_stats(preds, target, _chars, need_hits=False)
+    if total == 0:
+        return 0.0 if errors == 0 else float("inf")
+    return errors / total
+
+
+def match_error_rate(preds: Union[str, Sequence[TokenSeq]], target: Union[str, Sequence[TokenSeq]]) -> float:
+    """MER: ``(S + D + I) / (H + S + D + I)`` over all word pairs.
+
+    Example:
+        >>> round(match_error_rate("the cat sat", "the cat sat on the mat"), 4)
+        0.5
+    """
+    errors, hits, _, _ = _sequence_stats(preds, target, _tokens)
+    denom = errors + hits
+    if denom == 0:
+        return 0.0
+    return errors / denom
+
+
+def word_information_preserved(
+    preds: Union[str, Sequence[TokenSeq]], target: Union[str, Sequence[TokenSeq]]
+) -> float:
+    """WIP: ``(H / N_target) * (H / N_pred)``.
+
+    Example:
+        >>> round(word_information_preserved("the cat sat", "the cat sat on the mat"), 4)
+        0.5
+    """
+    _, hits, total_t, total_p = _sequence_stats(preds, target, _tokens)
+    if total_t == 0 or total_p == 0:
+        return 0.0
+    return (hits / total_t) * (hits / total_p)
+
+
+def word_information_lost(
+    preds: Union[str, Sequence[TokenSeq]], target: Union[str, Sequence[TokenSeq]]
+) -> float:
+    """WIL: ``1 - WIP``.
+
+    Example:
+        >>> round(word_information_lost("the cat sat", "the cat sat on the mat"), 4)
+        0.5
+    """
+    return 1.0 - word_information_preserved(preds, target)
 
 
 def _wer_update(preds: Union[str, Sequence[TokenSeq]], target: Union[str, Sequence[TokenSeq]]) -> Tuple[int, int]:

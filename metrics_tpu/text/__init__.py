@@ -1,1 +1,7 @@
 from metrics_tpu.text.wer import WER
+from metrics_tpu.text.error_rates import (
+    CharErrorRate,
+    MatchErrorRate,
+    WordInfoLost,
+    WordInfoPreserved,
+)

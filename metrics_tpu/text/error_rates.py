@@ -1,0 +1,112 @@
+"""CharErrorRate / MatchErrorRate / WordInfoPreserved / WordInfoLost modules.
+
+Extension beyond the reference snapshot (later torchmetrics ships these in
+its text package). All stream through integer sum-states of the per-pair
+alignment statistics (edit errors, aligned hits, reference/prediction
+lengths), so accumulation is O(1) and sync is one summed reduction — the
+global value equals the value over the concatenated corpus.
+"""
+from typing import Any, Callable, Optional, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.text import TokenSeq, _chars, _sequence_stats, _tokens
+from metrics_tpu.utils.data import accum_int_dtype
+
+
+class _AlignmentStatsMetric(Metric):
+    """Accumulates (errors, hits, target len, pred len) over sequence pairs."""
+
+    _tokenize = staticmethod(_tokens)
+    _need_hits = True
+
+    def __init__(
+        self,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+            jit=False,  # update consumes host strings; the fused step cannot trace them
+        )
+        for name in ("errors", "hits", "total_target", "total_pred"):
+            self.add_state(name, default=np.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[TokenSeq, Sequence[TokenSeq]], target: Union[TokenSeq, Sequence[TokenSeq]]) -> None:
+        errors, hits, total_t, total_p = _sequence_stats(preds, target, self._tokenize, self._need_hits)
+        self.note_count(max(errors, hits, total_t, total_p))
+        self.errors = self.errors + errors
+        self.hits = self.hits + hits
+        self.total_target = self.total_target + total_t
+        self.total_pred = self.total_pred + total_p
+
+
+class CharErrorRate(_AlignmentStatsMetric):
+    r"""Accumulated character error rate (edit distance over characters /
+    reference characters; spaces count as characters).
+
+    Example:
+        >>> metric = CharErrorRate()
+        >>> float(metric(["abcd"], ["abce"]))
+        0.25
+    """
+
+    _tokenize = staticmethod(_chars)
+    _need_hits = False  # CER needs only the distance; skip the tuple DP
+
+    def compute(self) -> Array:
+        rate = self.errors.astype(jnp.float32) / jnp.maximum(self.total_target, 1).astype(jnp.float32)
+        return jnp.where(
+            self.total_target == 0, jnp.where(self.errors == 0, 0.0, jnp.inf), rate
+        )
+
+
+class MatchErrorRate(_AlignmentStatsMetric):
+    r"""Accumulated match error rate: ``(S+D+I) / (H+S+D+I)`` over all pairs.
+
+    Example:
+        >>> metric = MatchErrorRate()
+        >>> float(metric(["the cat sat"], ["the cat sat on the mat"]))
+        0.5
+    """
+
+    def compute(self) -> Array:
+        denom = (self.errors + self.hits).astype(jnp.float32)
+        return jnp.where(denom == 0, 0.0, self.errors.astype(jnp.float32) / jnp.maximum(denom, 1.0))
+
+
+class WordInfoPreserved(_AlignmentStatsMetric):
+    r"""Accumulated word information preserved: ``(H/N_target) * (H/N_pred)``.
+
+    Example:
+        >>> metric = WordInfoPreserved()
+        >>> float(metric(["the cat sat"], ["the cat sat on the mat"]))
+        0.5
+    """
+
+    def compute(self) -> Array:
+        h = self.hits.astype(jnp.float32)
+        nt = jnp.maximum(self.total_target, 1).astype(jnp.float32)
+        np_ = jnp.maximum(self.total_pred, 1).astype(jnp.float32)
+        return jnp.where((self.total_target == 0) | (self.total_pred == 0), 0.0, (h / nt) * (h / np_))
+
+
+class WordInfoLost(WordInfoPreserved):
+    r"""Accumulated word information lost: ``1 - WIP``.
+
+    Example:
+        >>> metric = WordInfoLost()
+        >>> float(metric(["the cat sat"], ["the cat sat on the mat"]))
+        0.5
+    """
+
+    def compute(self) -> Array:
+        return 1.0 - super().compute()

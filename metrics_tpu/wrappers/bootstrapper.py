@@ -96,16 +96,27 @@ class BootStrapper(Metric):
         self.update(*args, **kwargs)
         if not self.compute_on_step:
             return None
-        caches = [m._current_state() for m in self.metrics]
+        # batch-local pass under the reference forward discipline: no
+        # cross-process sync (unless dist_sync_on_step) and the overflow
+        # bound survives the temp reset (core/metric.py _forward_reference)
+        caches = [(m._current_state(), m._count_bound) for m in self.metrics]
+        saved_sync = [(m._to_sync, m._in_forward) for m in self.metrics]
+        self._to_sync, self._in_forward = self.dist_sync_on_step, True
         for m in self.metrics:
+            m._to_sync, m._in_forward = self.dist_sync_on_step, True
             m.reset()
         self._resample_rng.set_state(rng_state)
-        self.update(*args, **kwargs)
-        value = self.compute()
-        for m, cache in zip(self.metrics, caches):
-            m._set_state(cache)
-            m._computed = None  # the batch-local compute cached batch values
-        self._computed = None
+        try:
+            self.update(*args, **kwargs)
+            value = self.compute()
+        finally:
+            for m, (cache, bound), (to_sync, in_fwd) in zip(self.metrics, caches, saved_sync):
+                m._set_state(cache)
+                m._count_bound = bound
+                m._computed = None  # the batch-local compute cached batch values
+                m._to_sync, m._in_forward = to_sync, in_fwd
+            self._to_sync, self._in_forward = True, False
+            self._computed = None
         self._forward_cache = value
         return value
 

@@ -10,11 +10,14 @@ from metrics_tpu.core.metric import Metric
 
 
 class MinMaxMetric(Metric):
-    r"""Track a scalar metric together with the min/max of its epoch values.
+    r"""Track a scalar metric together with the min/max of its computed values.
 
     ``compute()`` returns ``{"raw": current, "min": lowest-yet, "max":
-    highest-yet}``; the extrema update at each compute (torchmetrics
-    semantics) and carry ``min``/``max`` reductions for cross-device sync.
+    highest-yet}``. The extrema fold in EVERY computed value — the
+    batch-local values each ``forward`` yields as well as epoch-level
+    ``compute()`` results — and carry ``min``/``max`` reductions for
+    cross-device sync. (Call only ``update`` + ``compute`` if you want
+    extrema over epoch values alone.)
 
     Example:
         >>> import jax.numpy as jnp
