@@ -43,6 +43,7 @@ def _np_si_sdr(preds, target, zero_mean=False):
 
 class TestSNR(MetricTester):
     atol = 1e-4
+    rtol = 1e-4  # TPU log10 differs ~1e-5 relative; dB magnitudes need rtol
 
     @pytest.mark.parametrize("ddp", [False, True])
     @pytest.mark.parametrize("dist_sync_on_step", [False, True])
@@ -67,6 +68,7 @@ class TestSNR(MetricTester):
 
 class TestSISDR(MetricTester):
     atol = 1e-4
+    rtol = 1e-4
 
     @pytest.mark.parametrize("ddp", [False, True])
     @pytest.mark.parametrize("dist_sync_on_step", [False, True])
@@ -91,6 +93,7 @@ class TestSISDR(MetricTester):
 
 class TestSISNR(MetricTester):
     atol = 1e-4
+    rtol = 1e-4
 
     @pytest.mark.parametrize("ddp", [False, True])
     @pytest.mark.parametrize("dist_sync_on_step", [False, True])
