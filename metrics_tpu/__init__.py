@@ -31,6 +31,7 @@ from metrics_tpu.classification import (  # noqa: E402
     ConfusionMatrix,
     FBeta,
     HammingDistance,
+    HingeLoss,
     IoU,
     MatthewsCorrcoef,
     Precision,
@@ -54,6 +55,7 @@ from metrics_tpu.regression import (  # noqa: E402
     R2Score,
     SpearmanCorrcoef,
     SymmetricMeanAbsolutePercentageError,
+    TweedieDevianceScore,
     WeightedMeanAbsolutePercentageError,
 )
 from metrics_tpu.retrieval import (  # noqa: E402
@@ -67,7 +69,7 @@ from metrics_tpu.retrieval import (  # noqa: E402
     RetrievalRPrecision,
     RetrievalRecall,
 )
-from metrics_tpu.text import WER, CharErrorRate, MatchErrorRate, WordInfoLost, WordInfoPreserved  # noqa: E402
+from metrics_tpu.text import WER, CharErrorRate, MatchErrorRate, Perplexity, WordInfoLost, WordInfoPreserved  # noqa: E402
 from metrics_tpu.audio import SI_SDR, SI_SNR, SNR  # noqa: E402
 from metrics_tpu.wrappers import BootStrapper, ClasswiseWrapper, MetricTracker, MinMaxMetric  # noqa: E402
 from metrics_tpu import functional  # noqa: E402

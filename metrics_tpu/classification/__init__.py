@@ -20,3 +20,4 @@ from metrics_tpu.classification.precision_recall_curve import PrecisionRecallCur
 from metrics_tpu.classification.roc import ROC
 from metrics_tpu.classification.stat_scores import StatScores
 from metrics_tpu.classification.calibration_error import CalibrationError
+from metrics_tpu.classification.hinge import HingeLoss

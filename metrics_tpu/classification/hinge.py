@@ -1,0 +1,42 @@
+"""HingeLoss module. Extension beyond the reference snapshot (later
+torchmetrics ``classification/hinge.py``)."""
+from typing import Any, Callable, Optional, Tuple
+
+from jax import Array
+
+from metrics_tpu.core.streaming import SumCountMetric
+from metrics_tpu.functional.classification.hinge import _hinge_update
+
+
+class HingeLoss(SumCountMetric):
+    r"""Accumulated mean (squared) hinge loss, sklearn-compatible.
+
+    Binary inputs are ``(N,)`` decision values with ``{0, 1}`` (or
+    ``{-1, +1}``) targets; multiclass ``(N, C)`` scores use the
+    Crammer-Singer margin.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> metric = HingeLoss()
+        >>> round(float(metric(jnp.array([0.5, -1.5, 2.0]), jnp.array([1, 0, 1]))), 4)
+        0.1667
+    """
+
+    def __init__(
+        self,
+        squared: bool = False,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.squared = squared
+
+    def _update_stats(self, preds: Array, target: Array) -> Tuple[Array, Any]:
+        return _hinge_update(preds, target, self.squared)

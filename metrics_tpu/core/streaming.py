@@ -1,0 +1,55 @@
+"""Shared base for sum/count streaming metrics.
+
+Many metrics reduce to "sum of per-sample statistics divided by a count":
+two states, both plain ``"sum"`` reductions — O(1) memory, one fused psum to
+sync, counts in the package integer accumulator dtype (float32 counts stop
+incrementing at 2^24; int states get the overflow warning and widen to int64
+under ``jax_enable_x64``).
+"""
+from typing import Any, Callable, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.data import accum_int_dtype
+
+
+class SumCountMetric(Metric):
+    """``compute() = f(total / count)`` over streaming sum states.
+
+    Subclasses implement ``_update_stats(*args, **kwargs) -> (sum, count)``
+    (count may be a static int or a traced integer array) and optionally
+    ``_finalize(mean) -> value``.
+    """
+
+    def __init__(
+        self,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.add_state("total", default=np.zeros(()), dist_reduce_fx="sum")
+        self.add_state("count", default=np.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
+
+    def _update_stats(self, *args: Any, **kwargs: Any) -> Tuple[Array, Any]:
+        raise NotImplementedError  # pragma: no cover - subclasses define the kernel
+
+    def _finalize(self, mean: Array) -> Array:
+        return mean
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        total, count = self._update_stats(*args, **kwargs)
+        self.total = self.total + total
+        self.count = self.count + count
+
+    def compute(self) -> Array:
+        return self._finalize(self.total / jnp.maximum(self.count, 1).astype(jnp.float32))

@@ -61,3 +61,6 @@ from metrics_tpu.functional.text import (
     word_information_lost,
     word_information_preserved,
 )
+from metrics_tpu.functional.classification.hinge import hinge_loss
+from metrics_tpu.functional.regression.tweedie import tweedie_deviance_score
+from metrics_tpu.functional.text_perplexity import perplexity

@@ -21,3 +21,4 @@ from metrics_tpu.functional.classification.precision_recall_curve import precisi
 from metrics_tpu.functional.classification.roc import roc
 from metrics_tpu.functional.classification.stat_scores import stat_scores
 from metrics_tpu.functional.classification.calibration_error import calibration_error
+from metrics_tpu.functional.classification.hinge import hinge_loss

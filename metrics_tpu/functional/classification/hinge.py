@@ -1,0 +1,55 @@
+"""Hinge loss.
+
+Extension beyond the reference snapshot (later torchmetrics ships
+``HingeLoss``). Streaming sum-of-losses + count; matches
+``sklearn.metrics.hinge_loss`` for both the binary margin form and the
+multiclass Crammer-Singer form.
+"""
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def _hinge_update(preds: Array, target: Array, squared: bool = False) -> Tuple[Array, Array]:
+    """(sum of per-sample hinge losses, sample count).
+
+    ``preds``: (N,) binary decision values, or (N, C) multiclass scores.
+    ``target``: (N,) labels in {0, 1} (binary) or [0, C) (multiclass).
+    """
+    if preds.ndim == 1:
+        # accept both label conventions: {0,1} and sklearn's native {-1,+1}
+        # (anything <= 0 is the negative class)
+        y = jnp.where(target.astype(jnp.float32) <= 0.0, -1.0, 1.0)
+        margin = y * preds.astype(jnp.float32)
+    elif preds.ndim == 2:
+        scores = preds.astype(jnp.float32)
+        idx = target.astype(jnp.int32)[:, None]
+        true_score = jnp.take_along_axis(scores, idx, axis=1)[:, 0]
+        # Crammer-Singer: margin against the best WRONG class
+        masked = jnp.where(
+            jnp.arange(scores.shape[1])[None, :] == idx, -jnp.inf, scores
+        )
+        margin = true_score - jnp.max(masked, axis=1)
+    else:
+        raise ValueError(f"`preds` must be (N,) decisions or (N, C) scores, got ndim={preds.ndim}")
+    if target.shape != preds.shape[:1]:
+        raise ValueError("`target` must be (N,) matching `preds`' leading dimension")
+    losses = jnp.maximum(0.0, 1.0 - margin)
+    if squared:
+        losses = losses**2
+    return jnp.sum(losses), losses.shape[0]
+
+
+def hinge_loss(preds: Array, target: Array, squared: bool = False) -> Array:
+    """Mean (squared) hinge loss; sklearn-compatible.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([0.5, -1.5, 2.0])
+        >>> target = jnp.array([1, 0, 1])
+        >>> round(float(hinge_loss(preds, target)), 4)
+        0.1667
+    """
+    total, count = _hinge_update(preds, target, squared)
+    return total / jnp.maximum(count, 1.0)

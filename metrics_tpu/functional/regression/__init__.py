@@ -15,3 +15,4 @@ from metrics_tpu.functional.regression.mape import (
     symmetric_mean_absolute_percentage_error,
     weighted_mean_absolute_percentage_error,
 )
+from metrics_tpu.functional.regression.tweedie import tweedie_deviance_score

@@ -1,0 +1,56 @@
+"""Perplexity over next-token logits.
+
+Extension beyond the reference snapshot (later torchmetrics ships
+``Perplexity``). Streaming form: total negative log-likelihood + token count
+— two scalar ``"sum"`` states, exact, one ``psum`` to sync. The whole update
+is a fused ``log_softmax`` + gather, jit/vmap-safe.
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+
+def _perplexity_update(
+    preds: Array, target: Array, ignore_index: Optional[int] = None
+) -> Tuple[Array, Array]:
+    """(sum of token NLLs, token count) for logits ``(..., T, V)`` and ids
+    ``(..., T)``; ``ignore_index`` rows contribute nothing."""
+    if preds.ndim < 2:
+        raise ValueError(f"`preds` must be (..., seq, vocab) logits, got shape {preds.shape}")
+    if target.shape != preds.shape[:-1]:
+        raise ValueError(
+            f"`target` shape {target.shape} must equal `preds` shape without the vocab axis {preds.shape[:-1]}"
+        )
+    logits = preds.reshape(-1, preds.shape[-1]).astype(jnp.float32)
+    ids = target.reshape(-1).astype(jnp.int32)
+    mask = jnp.ones_like(ids, dtype=jnp.float32)
+    if ignore_index is not None:
+        mask = (ids != ignore_index).astype(jnp.float32)
+        ids = jnp.where(ids == ignore_index, 0, ids)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, ids[:, None], axis=-1)[:, 0]
+    # integer token count (package accumulator dtype): float32 counts stop
+    # incrementing at 2^24 tokens
+    from metrics_tpu.utils.data import accum_int_dtype
+
+    return jnp.sum(nll * mask), jnp.sum(mask.astype(accum_int_dtype()))
+
+
+def perplexity(preds: Array, target: Array, ignore_index: Optional[int] = None) -> Array:
+    """``exp`` of the mean per-token negative log-likelihood.
+
+    Args:
+        preds: ``(..., seq, vocab)`` UNNORMALIZED logits.
+        target: ``(..., seq)`` integer token ids.
+        ignore_index: target id to mask out (e.g. padding).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> logits = jnp.log(jnp.array([[[0.25, 0.75], [0.5, 0.5]]]))
+        >>> round(float(perplexity(logits, jnp.array([[1, 0]]))), 4)
+        1.633
+    """
+    total, count = _perplexity_update(preds, target, ignore_index)
+    return jnp.exp(total / jnp.maximum(count, 1.0))

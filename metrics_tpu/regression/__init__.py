@@ -14,3 +14,4 @@ from metrics_tpu.regression.mape import (
     SymmetricMeanAbsolutePercentageError,
     WeightedMeanAbsolutePercentageError,
 )
+from metrics_tpu.regression.tweedie import TweedieDevianceScore

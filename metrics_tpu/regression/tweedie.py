@@ -1,0 +1,42 @@
+"""TweedieDevianceScore module. Extension beyond the reference snapshot
+(later torchmetrics ``regression/tweedie_deviance.py``)."""
+from typing import Any, Callable, Optional, Tuple
+
+from jax import Array
+
+from metrics_tpu.core.streaming import SumCountMetric
+from metrics_tpu.functional.regression.tweedie import _tweedie_update
+
+
+class TweedieDevianceScore(SumCountMetric):
+    r"""Accumulated mean Tweedie deviance (``power`` 0 / 1 / 2 / (1, 2)).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> metric = TweedieDevianceScore(power=1)
+        >>> round(float(metric(jnp.array([2.0, 0.5, 1.0]), jnp.array([1.5, 1.0, 1.0]))), 4)
+        0.1744
+    """
+
+    def __init__(
+        self,
+        power: float = 0.0,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        if not (power in (0, 1, 2) or 1 < power < 2):
+            raise ValueError(
+                f"`power` must be 0, 1, 2, or in (1, 2) (compound Poisson-Gamma), got {power!r}"
+            )
+        self.power = power
+
+    def _update_stats(self, preds: Array, target: Array) -> Tuple[Array, Any]:
+        return _tweedie_update(preds, target, self.power)

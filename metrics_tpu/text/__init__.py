@@ -5,3 +5,4 @@ from metrics_tpu.text.error_rates import (
     WordInfoLost,
     WordInfoPreserved,
 )
+from metrics_tpu.text.perplexity import Perplexity

@@ -1,0 +1,49 @@
+"""Perplexity module. Extension beyond the reference snapshot (later
+torchmetrics ``text/perplexity.py``)."""
+from typing import Any, Callable, Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.streaming import SumCountMetric
+from metrics_tpu.functional.text_perplexity import _perplexity_update
+
+
+class Perplexity(SumCountMetric):
+    r"""Accumulated perplexity: ``exp`` of the mean token NLL over all
+    tokens seen (two scalar sum-states; one psum to sync).
+
+    Args:
+        ignore_index: target id excluded from the likelihood (padding).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> logits = jnp.log(jnp.array([[[0.25, 0.75], [0.5, 0.5]]]))
+        >>> metric = Perplexity()
+        >>> round(float(metric(logits, jnp.array([[1, 0]]))), 4)
+        1.633
+    """
+
+    def __init__(
+        self,
+        ignore_index: Optional[int] = None,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError(f"`ignore_index` must be an int or None, got {ignore_index!r}")
+        self.ignore_index = ignore_index
+
+    def _update_stats(self, preds: Array, target: Array) -> Tuple[Array, Any]:
+        return _perplexity_update(preds, target, self.ignore_index)
+
+    def _finalize(self, mean: Array) -> Array:
+        return jnp.exp(mean)

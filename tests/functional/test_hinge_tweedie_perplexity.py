@@ -1,0 +1,148 @@
+"""HingeLoss vs sklearn, TweedieDevianceScore vs sklearn, Perplexity vs numpy."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import hinge_loss as sk_hinge
+from sklearn.metrics import mean_tweedie_deviance as sk_tweedie
+
+from metrics_tpu import HingeLoss, Perplexity, TweedieDevianceScore
+from metrics_tpu.functional import hinge_loss, perplexity, tweedie_deviance_score
+from tests.helpers.testers import NUM_BATCHES, MetricTester
+
+_rng = np.random.RandomState(23)
+BATCH_SIZE = 48
+
+
+# ------------------------------------------------------------------- hinge
+_bin_preds = _rng.randn(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+_bin_target = (_rng.rand(NUM_BATCHES, BATCH_SIZE) > 0.5).astype(np.int64)
+_mc_preds = _rng.randn(NUM_BATCHES, BATCH_SIZE, 4).astype(np.float32)
+_mc_target = _rng.randint(0, 4, (NUM_BATCHES, BATCH_SIZE))
+
+
+def _sk_hinge_binary(preds, target):
+    return sk_hinge(np.asarray(target).reshape(-1), np.asarray(preds).reshape(-1))
+
+
+def _sk_hinge_mc(preds, target):
+    p = np.asarray(preds).reshape(-1, 4)
+    return sk_hinge(np.asarray(target).reshape(-1), p, labels=list(range(4)))
+
+
+class TestHinge(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_binary_class(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp, preds=_bin_preds, target=_bin_target, metric_class=HingeLoss,
+            sk_metric=_sk_hinge_binary, dist_sync_on_step=False,
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_multiclass_class(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp, preds=_mc_preds, target=_mc_target, metric_class=HingeLoss,
+            sk_metric=_sk_hinge_mc, dist_sync_on_step=False,
+        )
+
+    def test_functional(self):
+        self.run_functional_metric_test(
+            _bin_preds, _bin_target, metric_functional=hinge_loss, sk_metric=_sk_hinge_binary
+        )
+
+
+def test_hinge_squared_and_errors():
+    got = float(hinge_loss(jnp.asarray(_bin_preds[0]), jnp.asarray(_bin_target[0]), squared=True))
+    y = 2.0 * _bin_target[0] - 1.0
+    want = (np.maximum(0, 1 - y * _bin_preds[0]) ** 2).mean()
+    np.testing.assert_allclose(got, want, atol=1e-5)
+    with pytest.raises(ValueError, match="ndim"):
+        hinge_loss(jnp.zeros((2, 2, 2)), jnp.zeros(2))
+
+
+# ----------------------------------------------------------------- tweedie
+_tw_target = (_rng.rand(NUM_BATCHES, BATCH_SIZE) * 3).astype(np.float32)
+_tw_preds = (_tw_target + 0.5 + _rng.rand(NUM_BATCHES, BATCH_SIZE)).astype(np.float32)
+
+
+@pytest.mark.parametrize("power", [0, 1, 2, 1.5])
+def test_tweedie_vs_sklearn(power):
+    got = float(tweedie_deviance_score(jnp.asarray(_tw_preds[0]), jnp.asarray(_tw_target[0] + 0.1), power=power))
+    want = sk_tweedie(_tw_target[0] + 0.1, _tw_preds[0], power=power)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+class TestTweedie(MetricTester):
+    atol = 1e-5
+    rtol = 1e-5
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("power", [0, 1])
+    def test_class(self, ddp, power):
+        self.run_class_metric_test(
+            ddp=ddp, preds=_tw_preds, target=_tw_target + 0.1, metric_class=TweedieDevianceScore,
+            sk_metric=lambda p, t: sk_tweedie(np.asarray(t).reshape(-1), np.asarray(p).reshape(-1), power=power),
+            dist_sync_on_step=False, metric_args={"power": power},
+        )
+
+
+def test_tweedie_power_validation():
+    with pytest.raises(ValueError, match="power"):
+        TweedieDevianceScore(power=3)
+    with pytest.raises(ValueError, match="power"):
+        tweedie_deviance_score(jnp.ones(2), jnp.ones(2), power=0.5)
+
+
+# -------------------------------------------------------------- perplexity
+def _np_perplexity(logits, ids, ignore=None):
+    logits = np.asarray(logits, np.float64).reshape(-1, logits.shape[-1])
+    ids = np.asarray(ids).reshape(-1)
+    logp = logits - np.log(np.exp(logits - logits.max(-1, keepdims=True)).sum(-1, keepdims=True)) - logits.max(-1, keepdims=True)
+    mask = np.ones_like(ids, dtype=bool) if ignore is None else ids != ignore
+    nll = -logp[np.arange(ids.size), np.where(mask, ids, 0)]
+    return float(np.exp(nll[mask].mean()))
+
+
+def test_perplexity_vs_numpy():
+    logits = _rng.randn(4, 12, 7).astype(np.float32)
+    ids = _rng.randint(0, 7, (4, 12))
+    got = float(perplexity(jnp.asarray(logits), jnp.asarray(ids)))
+    np.testing.assert_allclose(got, _np_perplexity(logits, ids), rtol=1e-5)
+
+    # ignore_index masks padding tokens
+    ids_pad = ids.copy()
+    ids_pad[:, -3:] = -100
+    got = float(perplexity(jnp.asarray(logits), jnp.asarray(ids_pad), ignore_index=-100))
+    np.testing.assert_allclose(got, _np_perplexity(logits, ids_pad, ignore=-100), rtol=1e-5)
+
+
+def test_perplexity_module_accumulates_and_jits():
+    import metrics_tpu
+
+    logits = _rng.randn(6, 4, 10, 5).astype(np.float32)
+    ids = _rng.randint(0, 5, (6, 4, 10))
+    old = metrics_tpu.set_default_jit(True)
+    try:
+        m = Perplexity()
+        for i in range(6):
+            m(jnp.asarray(logits[i]), jnp.asarray(ids[i]))
+        np.testing.assert_allclose(float(m.compute()), _np_perplexity(logits, ids), rtol=1e-5)
+    finally:
+        metrics_tpu.set_default_jit(old)
+
+
+def test_perplexity_shape_errors():
+    with pytest.raises(ValueError, match="vocab"):
+        perplexity(jnp.zeros(3), jnp.zeros(3))
+    with pytest.raises(ValueError, match="target"):
+        perplexity(jnp.zeros((2, 3, 4)), jnp.zeros((2, 4)))
+
+
+def test_hinge_accepts_plus_minus_one_labels():
+    """sklearn's native {-1,+1} convention gives sklearn's answer too."""
+    p = np.array([0.5, -0.2, 0.3, 2.0], dtype=np.float32)
+    t = np.array([-1, -1, 1, 1])
+    got = float(hinge_loss(jnp.asarray(p), jnp.asarray(t)))
+    np.testing.assert_allclose(got, sk_hinge(t, p), atol=1e-6)
