@@ -13,6 +13,30 @@ from typing import Any, Dict, List, Optional, Tuple, Union
 
 from metrics_tpu.core.metric import Metric, PureMetric
 
+# process-wide fused-step sharing for config-identical collections (same
+# shape as the per-metric _JITTED_STEP_CACHE): a fresh collection per eval
+# epoch must replay the compiled step, not retrace it
+import threading as _threading
+
+_COL_STEP_CACHE: Dict[Any, Any] = {}
+_COL_STEP_CACHE_MAX = 64
+_COL_STEP_CACHE_LOCK = _threading.Lock()
+_COL_STEP_FAILED = object()  # shared negative verdict: this config cannot trace
+
+
+def _col_cache_key(collection: "MetricCollection", kind: str) -> Optional[Tuple[Any, list]]:
+    """(cache key, pinned referents) from the children's config fingerprints."""
+    parts = []
+    pins: list = []
+    for name, metric in collection.items():
+        fp = metric._config_fingerprint()
+        if fp is None:
+            return None
+        key_body, child_pins = fp
+        parts.append((name, key_body))
+        pins.extend(child_pins)
+    return (kind, tuple(parts)), pins
+
 
 class MetricCollection(OrderedDict):
     """Chain metrics with the same call pattern into a single object.
@@ -87,9 +111,9 @@ class MetricCollection(OrderedDict):
             for m in self.values()
         )
 
-    def _forward_fused_collection(self, *args: Any, **kwargs: Any) -> Optional[Dict[str, Any]]:
+    def _refresh_col_cache(self) -> None:
         # cheap per-forward staleness key: child identity, not just names —
-        # replacing a child under the same key must drop the cached step AND
+        # replacing a child under the same key must drop the cached steps AND
         # any cached negative verdict (unfusable / fuse-failed)
         membership = (self.__dict__.get("_col_generation", 0),) + tuple(
             (k, id(m)) for k, m in self.items()
@@ -97,8 +121,13 @@ class MetricCollection(OrderedDict):
         if self.__dict__.get("_col_membership") != membership:
             self.__dict__["_col_membership"] = membership
             self.__dict__["_col_step"] = None
+            self.__dict__["_col_batched_step"] = None
             self.__dict__["_col_fuse_failed"] = False
+            self.__dict__["_col_batched_failed"] = False
             self.__dict__["_col_unfusable"] = False
+
+    def _forward_fused_collection(self, *args: Any, **kwargs: Any) -> Optional[Dict[str, Any]]:
+        self._refresh_col_cache()
         if self.__dict__.get("_col_fuse_failed") or self.__dict__.get("_col_unfusable"):
             return None
         step = self.__dict__.get("_col_step")
@@ -108,21 +137,56 @@ class MetricCollection(OrderedDict):
             if not self._collection_fusable():
                 self.__dict__["_col_unfusable"] = True
                 return None
-            self.__dict__["_col_step"] = step = self._build_collection_step()
+            step = self._lookup_or_build_col_step("fused", self._build_collection_step)
+            self.__dict__["_col_step"] = step
+            if step is None:  # shared negative verdict from an identical config
+                return None
         states = {k: m._current_state() for k, m in self.items()}
         try:
             new_states, values = step(states, *args, **kwargs)
         except Metric._TRACER_ERRORS:
             # some update/compute needs concrete values: per-metric forwards
-            # handle their own fallbacks from here on
+            # handle their own fallbacks from here on. Share the negative
+            # verdict so fresh config-identical collections skip the
+            # (expensive, failing) trace instead of re-paying it per epoch.
             self.__dict__["_col_fuse_failed"] = True
             self.__dict__["_col_step"] = None
+            self._mark_col_step_failed("fused")
             return None
         for k, m in self.items():
             m._computed = None
             m._set_state(new_states[k])
             m._forward_cache = values[k]
         return {self._set_prefix(k): values[k] for k in self.keys()}
+
+    def _lookup_or_build_col_step(self, kind: str, build):
+        """Share the compiled collection step across config-identical
+        collections (the collection analogue of the per-metric jitted-step
+        cache): a fresh collection per eval epoch replays, never retraces.
+
+        Returns ``None`` when a config-identical collection already proved
+        this step cannot trace (shared negative verdict)."""
+        keyed = _col_cache_key(self, kind)
+        if keyed is None:
+            return build()
+        key, pins = keyed
+        with _COL_STEP_CACHE_LOCK:
+            hit = _COL_STEP_CACHE.get(key)
+            if hit is _COL_STEP_FAILED:
+                self.__dict__["_col_batched_failed" if kind == "batched" else "_col_fuse_failed"] = True
+                return None
+            if hit is None:
+                from metrics_tpu.core.metric import _bounded_insert
+
+                hit = (pins, build())
+                _bounded_insert(_COL_STEP_CACHE, key, hit, _COL_STEP_CACHE_MAX)
+        return hit[1]
+
+    def _mark_col_step_failed(self, kind: str) -> None:
+        keyed = _col_cache_key(self, kind)
+        if keyed is not None:
+            with _COL_STEP_CACHE_LOCK:
+                _COL_STEP_CACHE[keyed[0]] = _COL_STEP_FAILED
 
     def _build_collection_step(self):
         import threading
@@ -153,6 +217,91 @@ class MetricCollection(OrderedDict):
     def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         return self.forward(*args, **kwargs)
 
+    def forward_batched(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Accumulate a whole STACK of batches (leading axis = steps) for the
+        entire collection in ONE device dispatch.
+
+        The batched analogue of the fused collection forward: per-batch
+        deltas come from a vmap-ed update per child, the stack folds into
+        each accumulator with one reduction per state, per-step values come
+        back stacked, and each child's epoch value is pre-seeded so a
+        following ``compute()`` is free. Falls back to per-child
+        ``forward_batched`` (which itself falls back to per-step forwards)
+        when a child cannot take the vmap path.
+        """
+        import jax
+
+        self._refresh_col_cache()
+        step = self.__dict__.get("_col_batched_step")
+        if step is None and not (
+            self.__dict__.get("_col_batched_failed") or self.__dict__.get("_col_unfusable")
+        ):
+            # the full fusability/fingerprint gate runs only at (re)build
+            # time, mirroring the fused per-step path
+            if self._collection_fusable() and all(m._stack_mergeable for m in self.values()):
+                step = self._lookup_or_build_col_step("batched", self._build_collection_batched_step)
+                self.__dict__["_col_batched_step"] = step
+            else:
+                self.__dict__["_col_batched_failed"] = True
+        if step is not None:
+            states = {k: m._current_state() for k, m in self.items()}
+            try:
+                new_states, values, epochs = step(states, *args, **kwargs)
+            except Metric._TRACER_ERRORS:
+                # batched-path verdict only: the fused per-step program is a
+                # DIFFERENT trace and may still work
+                self.__dict__["_col_batched_failed"] = True
+                self.__dict__["_col_batched_step"] = None
+                self._mark_col_step_failed("batched")
+            else:
+                seed_epoch = jax.process_count() == 1
+                for k, m in self.items():
+                    m._note_rows(args, kwargs)
+                    m._set_state(new_states[k])
+                    m._forward_cache = jax.tree_util.tree_map(lambda v: v[-1], values[k])
+                    m._computed = epochs[k] if seed_epoch and m.dist_sync_fn is None else None
+                return {self._set_prefix(k): values[k] for k in self.keys()}
+        return {
+            self._set_prefix(k): m.forward_batched(*args, **m._filter_kwargs(**kwargs))
+            for k, m in self.items()
+        }
+
+    def _build_collection_batched_step(self):
+        import threading
+
+        import jax
+
+        from metrics_tpu.parallel.sync import merge_values_stacked
+
+        carriers = {k: deepcopy(m) for k, m in self.items()}
+        for c in carriers.values():
+            c.reset()
+        donate = (0,) if jax.default_backend() == "tpu" else ()
+        lock = threading.Lock()
+
+        def step(states, *args, **kwargs):
+            new_states, values, epochs = {}, {}, {}
+            for k, c in carriers.items():
+                kw = c._filter_kwargs(**kwargs)
+
+                def one(*batch, _c=c, _kw_keys=tuple(kw)):
+                    batch_args = batch[: len(args)]
+                    batch_kw = dict(zip(_kw_keys, batch[len(args):]))
+                    with lock:
+                        return _c._run_update_on_state(_c.init_state(), *batch_args, **batch_kw)
+
+                deltas = jax.vmap(one)(*args, *kw.values())
+                new_states[k] = {
+                    name: merge_values_stacked(c._reductions[name], states[k][name], deltas[name])
+                    for name in c._defaults
+                }
+                with lock:
+                    values[k] = jax.vmap(c.compute_from_state)(deltas)
+                    epochs[k] = c.compute_from_state(new_states[k])
+            return new_states, values, epochs
+
+        return jax.jit(step, donate_argnums=donate)
+
     def update(self, *args: Any, **kwargs: Any) -> None:
         for _, m in self.items():
             m.update(*args, **m._filter_kwargs(**kwargs))
@@ -171,7 +320,10 @@ class MetricCollection(OrderedDict):
 
     # fused-step cache attrs never travel to copies/pickles: the copy's
     # membership key differs, so it re-derives its own verdict lazily
-    _COL_CACHE_ATTRS = ("_col_step", "_col_membership", "_col_fuse_failed", "_col_unfusable")
+    _COL_CACHE_ATTRS = (
+        "_col_step", "_col_batched_step", "_col_membership", "_col_fuse_failed",
+        "_col_batched_failed", "_col_unfusable",
+    )
 
     def __deepcopy__(self, memo: dict) -> "MetricCollection":
         # dict-subclass default reduce would re-invoke __init__ with an items

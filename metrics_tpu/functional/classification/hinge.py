@@ -17,12 +17,16 @@ def _hinge_update(preds: Array, target: Array, squared: bool = False) -> Tuple[A
     ``preds``: (N,) binary decision values, or (N, C) multiclass scores.
     ``target``: (N,) labels in {0, 1} (binary) or [0, C) (multiclass).
     """
+    if preds.ndim not in (1, 2):
+        raise ValueError(f"`preds` must be (N,) decisions or (N, C) scores, got ndim={preds.ndim}")
+    if target.shape != preds.shape[:1]:
+        raise ValueError("`target` must be (N,) matching `preds`' leading dimension")
     if preds.ndim == 1:
         # accept both label conventions: {0,1} and sklearn's native {-1,+1}
         # (anything <= 0 is the negative class)
         y = jnp.where(target.astype(jnp.float32) <= 0.0, -1.0, 1.0)
         margin = y * preds.astype(jnp.float32)
-    elif preds.ndim == 2:
+    else:
         scores = preds.astype(jnp.float32)
         idx = target.astype(jnp.int32)[:, None]
         true_score = jnp.take_along_axis(scores, idx, axis=1)[:, 0]
@@ -31,10 +35,6 @@ def _hinge_update(preds: Array, target: Array, squared: bool = False) -> Tuple[A
             jnp.arange(scores.shape[1])[None, :] == idx, -jnp.inf, scores
         )
         margin = true_score - jnp.max(masked, axis=1)
-    else:
-        raise ValueError(f"`preds` must be (N,) decisions or (N, C) scores, got ndim={preds.ndim}")
-    if target.shape != preds.shape[:1]:
-        raise ValueError("`target` must be (N,) matching `preds`' leading dimension")
     losses = jnp.maximum(0.0, 1.0 - margin)
     if squared:
         losses = losses**2

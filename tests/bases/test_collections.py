@@ -292,3 +292,81 @@ def test_collection_unfusable_verdict_cached_and_cleared():
         assert mc.__dict__.get("_col_step") is not None
     finally:
         metrics_tpu.set_default_jit(old)
+
+
+def test_collection_forward_batched_matches_per_step():
+    """One-dispatch batched collection == the per-step fused loop."""
+    import metrics_tpu
+    from metrics_tpu import Accuracy, F1, Precision
+
+    rng = np.random.RandomState(31)
+    logits = rng.rand(8, 16, 4).astype(np.float32)
+    probs = logits / logits.sum(-1, keepdims=True)
+    target = rng.randint(0, 4, (8, 16)).astype(np.int32)
+
+    old = metrics_tpu.set_default_jit(True)
+    try:
+        loop = MetricCollection([Accuracy(), Precision(num_classes=4, average="macro"),
+                                 F1(num_classes=4, average="macro")])
+        loop_vals = [loop(jnp.asarray(probs[i]), jnp.asarray(target[i])) for i in range(8)]
+
+        batched = MetricCollection([Accuracy(), Precision(num_classes=4, average="macro"),
+                                    F1(num_classes=4, average="macro")])
+        vals = batched.forward_batched(jnp.asarray(probs), jnp.asarray(target))
+
+        for key in loop_vals[0]:
+            assert vals[key].shape == (8,)
+            np.testing.assert_allclose(
+                np.asarray(vals[key]), [float(v[key]) for v in loop_vals], atol=1e-6
+            )
+        loop_epoch = loop.compute()
+        batched_epoch = batched.compute()  # pre-seeded, no dispatch
+        for key in loop_epoch:
+            np.testing.assert_allclose(float(batched_epoch[key]), float(loop_epoch[key]), atol=1e-6)
+
+        # further updates invalidate the seed and keep accumulating
+        batched.update(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+        loop.update(jnp.asarray(probs[0]), jnp.asarray(target[0]))
+        for key in loop_epoch:
+            np.testing.assert_allclose(
+                float(batched.compute()[key]), float(loop.compute()[key]), atol=1e-6
+            )
+    finally:
+        metrics_tpu.set_default_jit(old)
+
+
+def test_collection_forward_batched_fallback_unjittable():
+    """A collection with an unfusable child falls back per child, same values."""
+    from metrics_tpu import Accuracy
+
+    rng = np.random.RandomState(33)
+    probs = rng.rand(4, 8, 3).astype(np.float32)
+    probs /= probs.sum(-1, keepdims=True)
+    target = rng.randint(0, 3, (4, 8)).astype(np.int32)
+
+    coll = MetricCollection([Accuracy()])  # jit disabled by conftest -> fallback path
+    vals = coll.forward_batched(jnp.asarray(probs), jnp.asarray(target))
+    expected = (probs.reshape(-1, 3).argmax(-1) == target.reshape(-1)).mean()
+    assert np.asarray(vals["Accuracy"]).shape == (4,)
+    np.testing.assert_allclose(float(coll.compute()["Accuracy"]), expected, atol=1e-6)
+
+
+def test_collection_batched_failure_does_not_disable_fused_forward():
+    """A vmap-path failure poisons only the batched plane; the per-step fused
+    forward keeps working (and vice versa the flags stay separate)."""
+    import metrics_tpu
+    from metrics_tpu import Accuracy
+
+    old = metrics_tpu.set_default_jit(True)
+    try:
+        coll = MetricCollection([Accuracy()])
+        coll.__dict__["_col_membership"] = None  # force cache refresh
+        probs = jnp.asarray(np.eye(3, dtype=np.float32)[None].repeat(2, 0))
+        target = jnp.asarray(np.arange(3, dtype=np.int32)[None].repeat(2, 0))
+        coll.forward_batched(probs, target)
+        # simulate a batched-plane failure verdict
+        coll.__dict__["_col_batched_failed"] = True
+        out = coll(probs[0], target[0])  # fused per-step path unaffected
+        assert float(out["Accuracy"]) == 1.0
+    finally:
+        metrics_tpu.set_default_jit(old)
