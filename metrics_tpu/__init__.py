@@ -51,6 +51,7 @@ from metrics_tpu.regression import (  # noqa: E402
     MeanAbsolutePercentageError,
     MeanSquaredError,
     MeanSquaredLogError,
+    MultiScaleSSIM,
     PearsonCorrcoef,
     R2Score,
     SpearmanCorrcoef,

@@ -21,7 +21,6 @@ import threading as _threading
 _COL_STEP_CACHE: Dict[Any, Any] = {}
 _COL_STEP_CACHE_MAX = 64
 _COL_STEP_CACHE_LOCK = _threading.Lock()
-_COL_STEP_FAILED = object()  # shared negative verdict: this config cannot trace
 
 
 def _col_cache_key(collection: "MetricCollection", kind: str) -> Optional[Tuple[Any, list]]:
@@ -139,21 +138,20 @@ class MetricCollection(OrderedDict):
                 return None
             step = self._lookup_or_build_col_step("fused", self._build_collection_step)
             self.__dict__["_col_step"] = step
-            if step is None:  # shared negative verdict from an identical config
-                return None
         states = {k: m._current_state() for k, m in self.items()}
         try:
             new_states, values = step(states, *args, **kwargs)
         except Metric._TRACER_ERRORS:
             # some update/compute needs concrete values: per-metric forwards
-            # handle their own fallbacks from here on. Share the negative
-            # verdict so fresh config-identical collections skip the
-            # (expensive, failing) trace instead of re-paying it per epoch.
+            # handle their own fallbacks from here on. The verdict stays
+            # INSTANCE-local: tracer failures are input-signature-specific,
+            # so a global negative verdict could clobber a compiled step that
+            # works for other callers of the same config.
             self.__dict__["_col_fuse_failed"] = True
             self.__dict__["_col_step"] = None
-            self._mark_col_step_failed("fused")
             return None
         for k, m in self.items():
+            m._note_rows(args, m._filter_kwargs(**kwargs))
             m._computed = None
             m._set_state(new_states[k])
             m._forward_cache = values[k]
@@ -163,30 +161,20 @@ class MetricCollection(OrderedDict):
         """Share the compiled collection step across config-identical
         collections (the collection analogue of the per-metric jitted-step
         cache): a fresh collection per eval epoch replays, never retraces.
-
-        Returns ``None`` when a config-identical collection already proved
-        this step cannot trace (shared negative verdict)."""
+        Only successful builds are cached — tracer failures are
+        input-signature-specific, so negative verdicts stay instance-local."""
         keyed = _col_cache_key(self, kind)
         if keyed is None:
             return build()
         key, pins = keyed
         with _COL_STEP_CACHE_LOCK:
             hit = _COL_STEP_CACHE.get(key)
-            if hit is _COL_STEP_FAILED:
-                self.__dict__["_col_batched_failed" if kind == "batched" else "_col_fuse_failed"] = True
-                return None
             if hit is None:
                 from metrics_tpu.core.metric import _bounded_insert
 
                 hit = (pins, build())
                 _bounded_insert(_COL_STEP_CACHE, key, hit, _COL_STEP_CACHE_MAX)
         return hit[1]
-
-    def _mark_col_step_failed(self, kind: str) -> None:
-        keyed = _col_cache_key(self, kind)
-        if keyed is not None:
-            with _COL_STEP_CACHE_LOCK:
-                _COL_STEP_CACHE[keyed[0]] = _COL_STEP_FAILED
 
     def _build_collection_step(self):
         import threading
@@ -248,15 +236,15 @@ class MetricCollection(OrderedDict):
             try:
                 new_states, values, epochs = step(states, *args, **kwargs)
             except Metric._TRACER_ERRORS:
-                # batched-path verdict only: the fused per-step program is a
-                # DIFFERENT trace and may still work
+                # batched-path verdict only (and instance-local, see above):
+                # the fused per-step program is a DIFFERENT trace and may
+                # still work
                 self.__dict__["_col_batched_failed"] = True
                 self.__dict__["_col_batched_step"] = None
-                self._mark_col_step_failed("batched")
             else:
                 seed_epoch = jax.process_count() == 1
                 for k, m in self.items():
-                    m._note_rows(args, kwargs)
+                    m._note_rows(args, m._filter_kwargs(**kwargs))
                     m._set_state(new_states[k])
                     m._forward_cache = jax.tree_util.tree_map(lambda v: v[-1], values[k])
                     m._computed = epochs[k] if seed_epoch and m.dist_sync_fn is None else None

@@ -16,3 +16,4 @@ from metrics_tpu.functional.regression.mape import (
     weighted_mean_absolute_percentage_error,
 )
 from metrics_tpu.functional.regression.tweedie import tweedie_deviance_score
+from metrics_tpu.functional.regression.ms_ssim import multiscale_ssim

@@ -82,9 +82,10 @@ def _ssim_map(
     data_range,
     k1: float,
     k2: float,
-) -> Array:
-    """Border-cropped per-pixel SSIM index map (``data_range`` must be concrete
-    or a traced scalar — callers resolve the None case)."""
+) -> Tuple[Array, Array]:
+    """Border-cropped per-pixel (SSIM, contrast-sensitivity) index maps
+    (``data_range`` must be concrete or a traced scalar — callers resolve the
+    None case)."""
     c1 = (k1 * data_range) ** 2
     c2 = (k2 * data_range) ** 2
 
@@ -115,9 +116,14 @@ def _ssim_map(
     upper = 2 * sigma_pred_target + c2
     lower = sigma_pred_sq + sigma_target_sq + c2
 
-    ssim_idx = ((2 * mu_pred_target + c1) * upper) / ((mu_pred_sq + mu_target_sq + c1) * lower)
+    cs_idx = upper / lower  # contrast-sensitivity term (MS-SSIM per-scale)
+    ssim_idx = ((2 * mu_pred_target + c1) / (mu_pred_sq + mu_target_sq + c1)) * cs_idx
+
     # drop the reflect-contaminated border ring (reference's final crop, :109)
-    return ssim_idx[..., pad_h:ssim_idx.shape[-2] - pad_h, pad_w:ssim_idx.shape[-1] - pad_w]
+    def crop(x):
+        return x[..., pad_h:x.shape[-2] - pad_h, pad_w:x.shape[-1] - pad_w]
+
+    return crop(ssim_idx), crop(cs_idx)
 
 
 def _ssim_compute(
@@ -133,7 +139,7 @@ def _ssim_compute(
     _check_ssim_params(kernel_size, sigma)
     if data_range is None:
         data_range = jnp.maximum(jnp.max(preds) - jnp.min(preds), jnp.max(target) - jnp.min(target))
-    ssim_idx = _ssim_map(preds, target, kernel_size, sigma, data_range, k1, k2)
+    ssim_idx, _ = _ssim_map(preds, target, kernel_size, sigma, data_range, k1, k2)
     return reduce(ssim_idx, reduction)
 
 

@@ -15,3 +15,4 @@ from metrics_tpu.regression.mape import (
     WeightedMeanAbsolutePercentageError,
 )
 from metrics_tpu.regression.tweedie import TweedieDevianceScore
+from metrics_tpu.regression.ms_ssim import MultiScaleSSIM

@@ -108,7 +108,7 @@ class SSIM(Metric):
     def update(self, preds: Array, target: Array) -> None:
         preds, target = _ssim_update(preds, target)
         if self.streaming:
-            idx = _ssim_map(
+            idx, _ = _ssim_map(
                 preds, target, self.kernel_size, self.sigma, self.data_range, self.k1, self.k2
             )
             self.similarity = self.similarity + jnp.sum(idx)

@@ -1,0 +1,101 @@
+"""MS-SSIM vs an independent numpy implementation (full 2-D window conv,
+no shared code with the package's separable-conv kernel)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import MultiScaleSSIM
+from metrics_tpu.functional import multiscale_ssim
+
+_BETAS = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333)
+
+
+def _np_gauss2d(k, sigma):
+    d = np.arange((1 - k) / 2, (1 + k) / 2)
+    g = np.exp(-((d / sigma) ** 2) / 2)
+    g /= g.sum()
+    return np.outer(g, g)
+
+
+def _np_valid_conv(img, win):
+    k = win.shape[0]
+    h, w = img.shape
+    out = np.empty((h - k + 1, w - k + 1))
+    for i in range(out.shape[0]):
+        for j in range(out.shape[1]):
+            out[i, j] = (img[i:i + k, j:j + k] * win).sum()
+    return out
+
+
+def _np_ssim_cs(p, t, k, sigma, data_range, k1=0.01, k2=0.03):
+    win = _np_gauss2d(k, sigma)
+    c1, c2 = (k1 * data_range) ** 2, (k2 * data_range) ** 2
+    mp, mt = _np_valid_conv(p, win), _np_valid_conv(t, win)
+    ep, et, ept = _np_valid_conv(p * p, win), _np_valid_conv(t * t, win), _np_valid_conv(p * t, win)
+    sp, st, spt = ep - mp**2, et - mt**2, ept - mp * mt
+    cs = (2 * spt + c2) / (sp + st + c2)
+    ssim = ((2 * mp * mt + c1) / (mp**2 + mt**2 + c1)) * cs
+    return ssim.mean(), cs.mean()
+
+
+def _np_msssim(p, t, k=5, sigma=1.5, data_range=1.0, betas=_BETAS):
+    out = 1.0
+    for scale, beta in enumerate(betas):
+        ssim_m, cs_m = _np_ssim_cs(p, t, k, sigma, data_range)
+        term = ssim_m if scale == len(betas) - 1 else cs_m
+        out *= max(term, 0.0) ** beta
+        if scale < len(betas) - 1:
+            h, w = p.shape[0] // 2 * 2, p.shape[1] // 2 * 2
+            p = p[:h, :w].reshape(h // 2, 2, w // 2, 2).mean(axis=(1, 3))
+            t = t[:h, :w].reshape(h // 2, 2, w // 2, 2).mean(axis=(1, 3))
+    return out
+
+
+_rng = np.random.RandomState(37)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_msssim_vs_numpy_oracle(seed):
+    rng = np.random.RandomState(seed)
+    base = rng.rand(96, 96).astype(np.float32)
+    noisy = np.clip(base + 0.1 * rng.randn(96, 96), 0, 1).astype(np.float32)
+    got = float(
+        multiscale_ssim(
+            jnp.asarray(noisy[None, None]), jnp.asarray(base[None, None]),
+            kernel_size=(5, 5), data_range=1.0,
+        )
+    )
+    want = _np_msssim(noisy.astype(np.float64), base.astype(np.float64))
+    np.testing.assert_allclose(got, want, atol=2e-4)
+
+
+def test_msssim_batch_and_identical():
+    imgs = _rng.rand(3, 2, 96, 96).astype(np.float32)
+    # identical images: every scale term is ~1
+    v = float(multiscale_ssim(jnp.asarray(imgs), jnp.asarray(imgs), kernel_size=(5, 5), data_range=1.0))
+    np.testing.assert_allclose(v, 1.0, atol=1e-5)
+    # per-image reduction shape
+    per = multiscale_ssim(
+        jnp.asarray(imgs), jnp.asarray(imgs * 0.5), kernel_size=(5, 5), data_range=1.0, reduction="none"
+    )
+    assert per.shape == (3,)
+
+
+def test_msssim_module_streams():
+    base = _rng.rand(4, 1, 96, 96).astype(np.float32)
+    noisy = np.clip(base + 0.05 * _rng.randn(4, 1, 96, 96), 0, 1).astype(np.float32)
+    m = MultiScaleSSIM(data_range=1.0, kernel_size=(5, 5))
+    for i in range(4):
+        m.update(jnp.asarray(noisy[i:i + 1]), jnp.asarray(base[i:i + 1]))
+    batch = float(
+        multiscale_ssim(jnp.asarray(noisy), jnp.asarray(base), kernel_size=(5, 5), data_range=1.0)
+    )
+    np.testing.assert_allclose(float(m.compute()), batch, atol=1e-6)
+
+
+def test_msssim_too_small_raises():
+    small = jnp.zeros((1, 1, 32, 32))
+    with pytest.raises(ValueError, match="too small"):
+        multiscale_ssim(small, small, kernel_size=(11, 11))
+    with pytest.raises(ValueError, match="data_range"):
+        MultiScaleSSIM(data_range=None)
