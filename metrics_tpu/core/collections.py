@@ -206,8 +206,9 @@ class MetricCollection(OrderedDict):
         return self.forward(*args, **kwargs)
 
     def forward_batched(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
-        """Accumulate a whole STACK of batches (leading axis = steps) for the
-        entire collection in ONE device dispatch.
+        """Accumulate a whole stack of batches in one collection dispatch.
+
+        The leading axis of every argument is the step axis.
 
         The batched analogue of the fused collection forward: per-batch
         deltas come from a vmap-ed update per child, the stack folds into

@@ -1,0 +1,117 @@
+"""ROUGE score (rouge-1 / rouge-2 / rouge-L).
+
+Extension beyond the reference snapshot (later torchmetrics ships
+``ROUGEScore``). Host-side text metric (tokenization and per-pair n-gram /
+LCS counting are host work); the accumulated form streams per-pair
+precision/recall/F1 sums, so the module metric is O(1) memory and the
+aggregate is the MEAN of per-sentence scores (the rouge_score convention).
+
+Tokenization follows the standard rouge_score default: lowercase,
+non-alphanumeric characters become separators.
+"""
+import re
+from collections import Counter
+from typing import Dict, List, Sequence, Tuple, Union
+
+_TOKEN_RE = re.compile(r"[^a-z0-9]+")
+
+ROUGE_KEYS = ("rouge1", "rouge2", "rougeL")
+
+
+def _rouge_tokens(text: str) -> List[str]:
+    return [t for t in _TOKEN_RE.split(text.lower()) if t]
+
+
+def _ngrams(tokens: List[str], n: int) -> Counter:
+    return Counter(tuple(tokens[i:i + n]) for i in range(len(tokens) - n + 1))
+
+
+def _prf(overlap: int, pred_total: int, target_total: int) -> Tuple[float, float, float]:
+    precision = overlap / pred_total if pred_total else 0.0
+    recall = overlap / target_total if target_total else 0.0
+    f1 = 2 * precision * recall / (precision + recall) if precision + recall else 0.0
+    return precision, recall, f1
+
+
+def _lcs_len(a: List[str], b: List[str]) -> int:
+    if not a or not b:
+        return 0
+    prev = [0] * (len(b) + 1)
+    for tok in a:
+        cur = [0] * (len(b) + 1)
+        for j, other in enumerate(b, 1):
+            cur[j] = prev[j - 1] + 1 if tok == other else max(prev[j], cur[j - 1])
+        prev = cur
+    return prev[-1]
+
+
+def _pair_scores(pred: str, target: str, keys: Sequence[str]) -> Dict[str, Tuple[float, float, float]]:
+    p_tok = _rouge_tokens(pred)
+    t_tok = _rouge_tokens(target)
+    out = {}
+    for key in keys:
+        if key == "rougeL":
+            out[key] = _prf(_lcs_len(p_tok, t_tok), len(p_tok), len(t_tok))
+            continue
+        n = int(key[5:])
+        p_ngrams, t_ngrams = _ngrams(p_tok, n), _ngrams(t_tok, n)
+        overlap = sum((p_ngrams & t_ngrams).values())
+        out[key] = _prf(overlap, sum(p_ngrams.values()), sum(t_ngrams.values()))
+    return out
+
+
+def _check_rouge_keys(rouge_keys: Sequence[str]) -> Tuple[str, ...]:
+    keys = tuple(rouge_keys)
+    for key in keys:
+        if key == "rougeL" or (key.startswith("rouge") and key[5:].isdigit() and int(key[5:]) >= 1):
+            continue
+        raise ValueError(f"rouge key must be 'rougeN' (N >= 1) or 'rougeL', got {key!r}")
+    return keys
+
+
+def _batch_sums(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str]],
+    keys: Sequence[str],
+) -> Tuple[Dict[str, List[float]], int]:
+    """Per-key [P, R, F] sums over the pairs plus the pair count (shared by
+    the functional one-shot and the streaming module)."""
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    if len(preds) != len(target):
+        raise ValueError("`preds` and `target` must have the same number of sentences")
+    sums = {k: [0.0, 0.0, 0.0] for k in keys}
+    for p, t in zip(preds, target):
+        for k, prf in _pair_scores(p, t, keys).items():
+            for i in range(3):
+                sums[k][i] += prf[i]
+    return sums, len(preds)
+
+
+def rouge_score(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str]],
+    rouge_keys: Sequence[str] = ROUGE_KEYS,
+) -> Dict[str, float]:
+    """Mean per-sentence ROUGE precision/recall/F1 over the pairs.
+
+    Returns ``{f"{key}_precision" | f"{key}_recall" | f"{key}_fmeasure": value}``.
+
+    Example:
+        >>> out = rouge_score("the cat sat on the mat", "the cat was on the mat")
+        >>> round(out["rouge1_fmeasure"], 4)
+        0.8333
+        >>> round(out["rougeL_fmeasure"], 4)
+        0.8333
+    """
+    keys = _check_rouge_keys(rouge_keys)
+    sums, n = _batch_sums(preds, target, keys)
+    if n == 0:
+        return {f"{k}_{stat}": 0.0 for k in keys for stat in ("precision", "recall", "fmeasure")}
+    return {
+        f"{k}_{stat}": sums[k][i] / n
+        for k in keys
+        for i, stat in enumerate(("precision", "recall", "fmeasure"))
+    }

@@ -6,3 +6,4 @@ from metrics_tpu.text.error_rates import (
     WordInfoPreserved,
 )
 from metrics_tpu.text.perplexity import Perplexity
+from metrics_tpu.text.rouge import ROUGEScore

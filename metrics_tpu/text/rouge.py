@@ -1,0 +1,68 @@
+"""ROUGEScore module. Extension beyond the reference snapshot (later
+torchmetrics ``text/rouge.py``).
+
+Streams the per-sentence precision/recall/F1 sums per rouge key plus a pair
+count — nine-plus-one scalar ``"sum"`` states, so the accumulated value is
+the mean of per-sentence scores over everything seen (the rouge_score
+aggregation convention) and sync is one summed reduction.
+"""
+from typing import Any, Callable, Dict, Optional, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.text_rouge import ROUGE_KEYS, _batch_sums, _check_rouge_keys
+from metrics_tpu.utils.data import accum_int_dtype
+
+_STATS = ("precision", "recall", "fmeasure")
+
+
+class ROUGEScore(Metric):
+    r"""Accumulated ROUGE-N / ROUGE-L scores (mean of per-sentence values).
+
+    Example:
+        >>> metric = ROUGEScore(rouge_keys=("rouge1",))
+        >>> out = metric(["the cat sat on the mat"], ["the cat was on the mat"])
+        >>> round(float(out["rouge1_fmeasure"]), 4)
+        0.8333
+    """
+
+    def __init__(
+        self,
+        rouge_keys: Sequence[str] = ROUGE_KEYS,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+            jit=False,  # update consumes host strings; the fused step cannot trace them
+        )
+        self.rouge_keys = _check_rouge_keys(rouge_keys)
+        for key in self.rouge_keys:
+            for stat in _STATS:
+                self.add_state(f"{key}_{stat}_sum", default=np.zeros(()), dist_reduce_fx="sum")
+        self.add_state("pairs", default=np.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> None:
+        sums, n = _batch_sums(preds, target, self.rouge_keys)
+        self.note_count(n)
+        for key, values in sums.items():
+            for stat, value in zip(_STATS, values):
+                name = f"{key}_{stat}_sum"
+                setattr(self, name, getattr(self, name) + value)
+        self.pairs = self.pairs + n
+
+    def compute(self) -> Dict[str, Array]:
+        n = jnp.maximum(self.pairs, 1).astype(jnp.float32)
+        return {
+            f"{key}_{stat}": getattr(self, f"{key}_{stat}_sum") / n
+            for key in self.rouge_keys
+            for stat in _STATS
+        }

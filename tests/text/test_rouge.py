@@ -1,0 +1,73 @@
+"""ROUGE vs hand-computed values and an independent per-pair oracle."""
+import numpy as np
+import pytest
+
+from metrics_tpu import ROUGEScore
+from metrics_tpu.functional import rouge_score
+
+
+def test_known_values():
+    out = rouge_score("the cat sat on the mat", "the cat was on the mat")
+    # unigram overlap 5 of 6/6; bigrams: (the,cat),(on,the),(the,mat) = 3 of 5/5; LCS 5
+    assert round(out["rouge1_fmeasure"], 4) == 0.8333
+    assert round(out["rouge2_fmeasure"], 4) == 0.6
+    assert round(out["rougeL_fmeasure"], 4) == 0.8333
+    # perfect and disjoint
+    perfect = rouge_score("a b c", "a b c")
+    assert perfect["rouge1_fmeasure"] == 1.0 and perfect["rougeL_fmeasure"] == 1.0
+    none = rouge_score("x y", "a b")
+    assert none["rouge1_fmeasure"] == 0.0 and none["rougeL_fmeasure"] == 0.0
+
+
+def test_clipped_counts_and_tokenization():
+    # repeated pred tokens clip to the target multiset; punctuation/case strip
+    out = rouge_score("The the the!", "the cat")
+    # pred unigrams: the x3; target: the, cat -> overlap clipped to 1
+    assert round(out["rouge1_precision"], 4) == round(1 / 3, 4)
+    assert round(out["rouge1_recall"], 4) == 0.5
+
+
+def test_lcs_vs_bruteforce():
+    from functools import lru_cache
+
+    from metrics_tpu.functional.text_rouge import _lcs_len
+
+    rng = np.random.RandomState(3)
+    for _ in range(10):
+        a = [str(x) for x in rng.randint(0, 4, rng.randint(0, 8))]
+        b = [str(x) for x in rng.randint(0, 4, rng.randint(0, 8))]
+
+        @lru_cache(maxsize=None)
+        def lcs(i, j):
+            if i == len(a) or j == len(b):
+                return 0
+            if a[i] == b[j]:
+                return 1 + lcs(i + 1, j + 1)
+            return max(lcs(i + 1, j), lcs(i, j + 1))
+
+        assert _lcs_len(a, b) == lcs(0, 0)
+        lcs.cache_clear()
+
+
+def test_module_accumulates_as_mean_of_sentences():
+    pairs = [
+        ("the cat sat on the mat", "the cat was on the mat"),
+        ("hello world", "hello there world"),
+        ("exact match here", "exact match here"),
+    ]
+    m = ROUGEScore()
+    for p, t in pairs:
+        m.update([p], [t])
+    want = rouge_score([p for p, _ in pairs], [t for _, t in pairs])
+    got = m.compute()
+    for k, v in want.items():
+        np.testing.assert_allclose(float(got[k]), v, atol=1e-6)
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="rouge key"):
+        rouge_score("a", "a", rouge_keys=("rougeX",))
+    with pytest.raises(ValueError, match="same number"):
+        rouge_score(["a"], ["a", "b"])
+    with pytest.raises(ValueError, match="rouge key"):
+        ROUGEScore(rouge_keys=("bogus",))
