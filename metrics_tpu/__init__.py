@@ -42,6 +42,7 @@ from metrics_tpu.classification import (  # noqa: E402
     StatScores,
 )
 from metrics_tpu.regression import (  # noqa: E402
+    ConcordanceCorrCoef,
     CosineSimilarity,
     PSNR,
     SSIM,
@@ -70,7 +71,7 @@ from metrics_tpu.retrieval import (  # noqa: E402
     RetrievalRPrecision,
     RetrievalRecall,
 )
-from metrics_tpu.text import WER, CharErrorRate, MatchErrorRate, Perplexity, ROUGEScore, WordInfoLost, WordInfoPreserved  # noqa: E402
+from metrics_tpu.text import WER, CharErrorRate, MatchErrorRate, Perplexity, ROUGEScore, SQuAD, WordInfoLost, WordInfoPreserved  # noqa: E402
 from metrics_tpu.audio import SI_SDR, SI_SNR, SNR  # noqa: E402
 from metrics_tpu.wrappers import BootStrapper, ClasswiseWrapper, MetricTracker, MinMaxMetric  # noqa: E402
 from metrics_tpu import functional  # noqa: E402

@@ -1,0 +1,41 @@
+"""Concordance correlation coefficient (Lin 1989).
+
+Extension beyond the reference snapshot (later torchmetrics ships
+``ConcordanceCorrCoef``). Reuses the Pearson Chan-merge co-moment vector —
+the CCC is a different read of the SAME sufficient statistics:
+
+    CCC = 2 cov / (var_p + var_t + (mean_p - mean_t)^2)
+
+so the streaming module shares the ``(6,)`` co-moment state and its
+associative fold verbatim.
+"""
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.regression.pearson import _CXY, _M2X, _M2Y, _MX, _MY, _N, batch_comoments
+
+
+def comoments_concordance(c: Array) -> Array:
+    """CCC from a co-moment vector; ``nan`` when the denominator is zero
+    (both variances zero AND coincident means — constant-but-different
+    inputs keep the mean-gap term positive and score 0).
+
+    Uses biased (population) variances/covariance — the convention of the
+    original Lin estimator; the n factors cancel, so co-moments feed in
+    directly.
+    """
+    denom = c[_M2X] + c[_M2Y] + c[_N] * (c[_MX] - c[_MY]) ** 2
+    return jnp.where(denom == 0, jnp.nan, 2.0 * c[_CXY] / jnp.where(denom == 0, 1.0, denom))
+
+
+def concordance_corrcoef(preds: Array, target: Array) -> Array:
+    """Lin's concordance correlation between two 1D arrays.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> round(float(concordance_corrcoef(preds, target)), 4)
+        0.9768
+    """
+    return comoments_concordance(batch_comoments(preds, target))

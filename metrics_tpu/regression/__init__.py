@@ -16,3 +16,4 @@ from metrics_tpu.regression.mape import (
 )
 from metrics_tpu.regression.tweedie import TweedieDevianceScore
 from metrics_tpu.regression.ms_ssim import MultiScaleSSIM
+from metrics_tpu.regression.concordance import ConcordanceCorrCoef

@@ -1,0 +1,23 @@
+"""ConcordanceCorrCoef module. Extension beyond the reference snapshot
+(later torchmetrics ``regression/concordance.py``). Shares the Pearson
+Chan-merge co-moment state verbatim — only the compute differs."""
+from jax import Array
+
+from metrics_tpu.functional.regression.concordance import comoments_concordance
+from metrics_tpu.regression.pearson import PearsonCorrcoef
+
+
+class ConcordanceCorrCoef(PearsonCorrcoef):
+    r"""Accumulated Lin concordance correlation coefficient.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> ccc = ConcordanceCorrCoef()
+        >>> round(float(ccc(preds, target)), 4)
+        0.9768
+    """
+
+    def compute(self) -> Array:
+        return comoments_concordance(self.comoments)

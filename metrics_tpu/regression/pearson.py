@@ -71,7 +71,7 @@ class PearsonCorrcoef(Metric):
 
         if self._count_bound >= self._F32_COUNT_SATURATION:
             rank_zero_warn(
-                f"PearsonCorrcoef has processed ~{self._count_bound} samples; the float32"
+                f"{self.__class__.__name__} has processed ~{self._count_bound} samples; the float32"
                 " sample count carried in the co-moment state saturates at 2^24, so further"
                 " accumulation behaves as a ~16.7M-sample moving window rather than a true"
                 " running mean.",

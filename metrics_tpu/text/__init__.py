@@ -7,3 +7,4 @@ from metrics_tpu.text.error_rates import (
 )
 from metrics_tpu.text.perplexity import Perplexity
 from metrics_tpu.text.rouge import ROUGEScore
+from metrics_tpu.text.squad import SQuAD

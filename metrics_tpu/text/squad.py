@@ -1,0 +1,57 @@
+"""SQuAD module. Extension beyond the reference snapshot (later torchmetrics
+``text/squad.py``). Streams best-over-references EM and F1 sums plus a
+question count — the accumulated value equals the official script over the
+concatenated dataset."""
+from typing import Any, Callable, Dict, Optional, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.text_squad import _squad_batch_sums
+from metrics_tpu.utils.data import accum_int_dtype
+
+
+class SQuAD(Metric):
+    r"""Accumulated SQuAD exact-match / F1 (percentages, official semantics).
+
+    Example:
+        >>> metric = SQuAD()
+        >>> out = metric(["the cat"], [["The cat!", "a dog"]])
+        >>> (float(out["exact_match"]), float(out["f1"]))
+        (100.0, 100.0)
+    """
+
+    def __init__(
+        self,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+            jit=False,  # update consumes host strings; the fused step cannot trace them
+        )
+        self.add_state("em_sum", default=np.zeros(()), dist_reduce_fx="sum")
+        self.add_state("f1_sum", default=np.zeros(()), dist_reduce_fx="sum")
+        self.add_state("questions", default=np.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
+
+    def update(
+        self,
+        preds: Union[str, Sequence[str]],
+        target: Union[str, Sequence[str], Sequence[Sequence[str]]],
+    ) -> None:
+        em_sum, f1_sum, n = _squad_batch_sums(preds, target)
+        self.note_count(n)
+        self.em_sum = self.em_sum + em_sum
+        self.f1_sum = self.f1_sum + f1_sum
+        self.questions = self.questions + n
+
+    def compute(self) -> Dict[str, Array]:
+        n = jnp.maximum(self.questions, 1).astype(jnp.float32)
+        return {"exact_match": 100.0 * self.em_sum / n, "f1": 100.0 * self.f1_sum / n}

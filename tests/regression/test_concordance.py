@@ -1,0 +1,53 @@
+"""ConcordanceCorrCoef vs a direct numpy implementation of Lin's estimator."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import ConcordanceCorrCoef
+from metrics_tpu.functional import concordance_corrcoef
+from tests.helpers.testers import NUM_BATCHES, MetricTester
+
+_rng = np.random.RandomState(41)
+BATCH_SIZE = 48
+
+_preds = _rng.randn(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+_target = (0.7 * _preds + 0.3 * _rng.randn(NUM_BATCHES, BATCH_SIZE) + 0.5).astype(np.float32)
+
+
+def _np_ccc(preds, target):
+    p = np.asarray(preds, np.float64).ravel()
+    t = np.asarray(target, np.float64).ravel()
+    cov = ((p - p.mean()) * (t - t.mean())).mean()
+    return 2 * cov / (p.var() + t.var() + (p.mean() - t.mean()) ** 2)
+
+
+class TestConcordance(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("dist_sync_on_step", [False, True])
+    def test_class(self, ddp, dist_sync_on_step):
+        self.run_class_metric_test(
+            ddp=ddp, preds=_preds, target=_target, metric_class=ConcordanceCorrCoef,
+            sk_metric=_np_ccc, dist_sync_on_step=dist_sync_on_step,
+        )
+
+    def test_functional(self):
+        self.run_functional_metric_test(_preds, _target, metric_functional=concordance_corrcoef, sk_metric=_np_ccc)
+
+
+def test_ccc_large_offset_stable():
+    """Inherits the centered Chan-merge accumulation: stable for |mean|>>std."""
+    rng = np.random.RandomState(5)
+    x = (1000.0 + rng.randn(10_000)).astype(np.float32)
+    y = (0.8 * (x - 1000.0) + 0.2 * rng.randn(10_000) + 1000.5).astype(np.float32)
+    m = ConcordanceCorrCoef()
+    for i in range(0, 10_000, 500):
+        m.update(jnp.asarray(x[i:i + 500]), jnp.asarray(y[i:i + 500]))
+    np.testing.assert_allclose(float(m.compute()), _np_ccc(x, y), atol=1e-4)
+
+
+def test_ccc_degenerate():
+    assert np.isnan(float(concordance_corrcoef(jnp.ones(4), jnp.ones(4))))
+    # constant-but-different inputs: denom = (mean gap)^2 > 0 -> ccc 0
+    assert float(concordance_corrcoef(jnp.ones(4), jnp.zeros(4))) == 0.0

@@ -1,0 +1,45 @@
+"""SQuAD EM/F1 vs hand-computed official-semantics values."""
+import numpy as np
+import pytest
+
+from metrics_tpu import SQuAD
+from metrics_tpu.functional import squad
+
+
+def test_known_values():
+    out = squad(["the cat"], [["The cat!", "a dog"]])
+    assert out == {"exact_match": 100.0, "f1": 100.0}
+    # articles and punctuation strip: "the" is removed before comparison
+    out = squad(["cat"], ["the cat"])
+    assert out == {"exact_match": 100.0, "f1": 100.0}
+    # partial overlap: pred {brown, dog} vs ref {brown, cat}: P=R=1/2 -> F1 0.5
+    out = squad(["brown dog"], ["brown cat"])
+    assert out["exact_match"] == 0.0
+    np.testing.assert_allclose(out["f1"], 50.0)
+    # best over multiple references
+    out = squad(["brown dog"], [["white fox", "brown dog here"]])
+    np.testing.assert_allclose(out["f1"], 100.0 * 2 * (1.0 * (2 / 3)) / (1.0 + 2 / 3))
+
+
+def test_empty_answers_v11_semantics():
+    # official v1.1 script: both normalize to empty -> EM 100 but F1 0
+    assert squad([""], [""]) == {"exact_match": 100.0, "f1": 0.0}
+    assert squad(["the"], ["the"]) == {"exact_match": 100.0, "f1": 0.0}
+    assert squad(["something"], [""]) == {"exact_match": 0.0, "f1": 0.0}
+
+
+def test_single_question_flat_references():
+    # a str pred with a flat list target = one question, many references
+    out = squad("the cat", ["the cat", "a dog"])
+    assert out == {"exact_match": 100.0, "f1": 100.0}
+
+
+def test_module_accumulates():
+    m = SQuAD()
+    m.update(["the cat"], ["cat"])
+    m.update(["wrong"], ["right answer"])
+    out = m.compute()
+    np.testing.assert_allclose(float(out["exact_match"]), 50.0)
+    np.testing.assert_allclose(float(out["f1"]), 50.0)
+    with pytest.raises(ValueError, match="same number"):
+        m.update(["a"], ["a", "b"])
