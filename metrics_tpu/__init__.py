@@ -72,6 +72,6 @@ from metrics_tpu.retrieval import (  # noqa: E402
     RetrievalRecall,
 )
 from metrics_tpu.text import WER, CharErrorRate, MatchErrorRate, Perplexity, ROUGEScore, SQuAD, WordInfoLost, WordInfoPreserved  # noqa: E402
-from metrics_tpu.audio import SI_SDR, SI_SNR, SNR  # noqa: E402
+from metrics_tpu.audio import PIT, SI_SDR, SI_SNR, SNR  # noqa: E402
 from metrics_tpu.wrappers import BootStrapper, ClasswiseWrapper, MetricTracker, MinMaxMetric  # noqa: E402
 from metrics_tpu import functional  # noqa: E402

@@ -1,8 +1,9 @@
-"""Audio metrics: SNR, SI_SDR, SI_SNR.
+"""Audio metrics: SNR, SI_SDR, SI_SNR, PIT.
 
 Extension family beyond the reference snapshot (later torchmetrics ships
 these in its audio package)."""
 from metrics_tpu.audio.snr import SNR
 from metrics_tpu.audio.si_sdr import SI_SDR, SI_SNR
+from metrics_tpu.audio.pit import PIT
 
-__all__ = ["SNR", "SI_SDR", "SI_SNR"]
+__all__ = ["SNR", "SI_SDR", "SI_SNR", "PIT"]

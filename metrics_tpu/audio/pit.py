@@ -1,0 +1,54 @@
+"""PIT module. Extension beyond the reference snapshot (later torchmetrics
+``audio/pit.py``). Streams the per-example best-permutation values through
+the sum/count base."""
+from typing import Any, Callable, Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.streaming import SumCountMetric
+from metrics_tpu.functional.audio.pit import permutation_invariant_training
+
+
+class PIT(SumCountMetric):
+    r"""Accumulated permutation-invariant metric (mean of per-example best
+    values over source permutations).
+
+    Args:
+        metric_func: per-example kernel reducing the trailing time axis
+            (e.g. ``lambda p, t: _si_sdr_per_example(p, t, False)``).
+        eval_func: "max" (higher is better) or "min".
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional.audio.si_sdr import _si_sdr_per_example
+        >>> pit = PIT(lambda p, t: _si_sdr_per_example(p, t, False))
+        >>> target = jnp.stack([jnp.ones((2, 16)), jnp.zeros((2, 16)) + 0.5], axis=1)
+        >>> _ = pit(target[:, ::-1, :], target)  # swapped sources: perfect after matching
+        >>> float(pit.compute()) > 40  # ~inf dB capped by eps
+        True
+    """
+
+    def __init__(
+        self,
+        metric_func: Callable,
+        eval_func: str = "max",
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        if eval_func not in ("max", "min"):
+            raise ValueError(f"`eval_func` must be 'max' or 'min', got {eval_func!r}")
+        self.metric_func = metric_func
+        self.eval_func = eval_func
+
+    def _update_stats(self, preds: Array, target: Array) -> Tuple[Array, Any]:
+        best, _ = permutation_invariant_training(preds, target, self.metric_func, self.eval_func)
+        return jnp.sum(best), best.shape[0]

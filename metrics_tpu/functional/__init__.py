@@ -68,3 +68,4 @@ from metrics_tpu.functional.regression.ms_ssim import multiscale_ssim
 from metrics_tpu.functional.text_rouge import rouge_score
 from metrics_tpu.functional.regression.concordance import concordance_corrcoef
 from metrics_tpu.functional.text_squad import squad
+from metrics_tpu.functional.audio.pit import permutation_invariant_training, pit_permutate

@@ -1,7 +1,11 @@
 from metrics_tpu.functional.audio.snr import signal_noise_ratio
 from metrics_tpu.functional.audio.si_sdr import scale_invariant_signal_distortion_ratio, scale_invariant_signal_noise_ratio
 
+from metrics_tpu.functional.audio.pit import permutation_invariant_training, pit_permutate
+
 __all__ = [
+    "permutation_invariant_training",
+    "pit_permutate",
     "signal_noise_ratio",
     "scale_invariant_signal_distortion_ratio",
     "scale_invariant_signal_noise_ratio",

@@ -47,9 +47,10 @@ def _squad_batch_sums(
     and the streaming module."""
     if isinstance(preds, str):
         preds = [preds]
-        # a single question: a flat string sequence can only mean its
-        # acceptable reference answers
-        if not isinstance(target, str):
+        # a single question: a FLAT string sequence can only mean its
+        # acceptable reference answers; an already-nested sequence is the
+        # 1-question batch form and needs no wrapping
+        if not isinstance(target, str) and all(isinstance(x, str) for x in target):
             target = [target]
     if isinstance(target, str):
         target = [target]

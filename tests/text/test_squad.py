@@ -43,3 +43,8 @@ def test_module_accumulates():
     np.testing.assert_allclose(float(out["f1"]), 50.0)
     with pytest.raises(ValueError, match="same number"):
         m.update(["a"], ["a", "b"])
+
+
+def test_single_question_nested_references():
+    # str pred + already-nested 1-question batch form also works
+    assert squad("the cat", [["the cat", "a dog"]]) == {"exact_match": 100.0, "f1": 100.0}
