@@ -58,6 +58,7 @@ from metrics_tpu.regression import (  # noqa: E402
     SpearmanCorrcoef,
     SymmetricMeanAbsolutePercentageError,
     TweedieDevianceScore,
+    UniversalImageQualityIndex,
     WeightedMeanAbsolutePercentageError,
 )
 from metrics_tpu.retrieval import (  # noqa: E402

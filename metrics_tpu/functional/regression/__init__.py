@@ -18,3 +18,4 @@ from metrics_tpu.functional.regression.mape import (
 from metrics_tpu.functional.regression.tweedie import tweedie_deviance_score
 from metrics_tpu.functional.regression.ms_ssim import multiscale_ssim
 from metrics_tpu.functional.regression.concordance import concordance_corrcoef
+from metrics_tpu.functional.regression.uqi import universal_image_quality_index

@@ -74,6 +74,43 @@ def _check_ssim_params(kernel_size: Sequence[int], sigma: Sequence[float]) -> No
         raise ValueError(f"Expected `sigma` to have positive number. Got {sigma}.")
 
 
+def _moment_maps(
+    preds: Array,
+    target: Array,
+    kernel_size: Sequence[int],
+    sigma: Sequence[float],
+) -> Tuple[Array, Array, Array, Array, Array]:
+    """Border-cropped windowed moments ``(mu_p, mu_t, var_p, var_t, cov)``.
+
+    ``kernel_size[0]``/``sigma[0]`` act along H, ``[1]`` along W (matching
+    ``_depthwise_conv_separable``'s kernel orientation); the reflect padding
+    and final crop use the same per-axis extents, so non-square kernels stay
+    centred. Shared by SSIM, MS-SSIM, and UQI.
+    """
+    dtype = preds.dtype
+    kern_h = _gaussian(kernel_size[0], sigma[0], dtype)
+    kern_w = _gaussian(kernel_size[1], sigma[1], dtype)
+    pad_h = (kernel_size[0] - 1) // 2
+    pad_w = (kernel_size[1] - 1) // 2
+
+    pad_spec = ((0, 0), (0, 0), (pad_h, pad_h), (pad_w, pad_w))
+    preds_p = jnp.pad(preds, pad_spec, mode="reflect")
+    target_p = jnp.pad(target, pad_spec, mode="reflect")
+
+    # one batched conv over the 5-stack of moment maps (reference :95-97)
+    stacked = jnp.concatenate((preds_p, target_p, preds_p * preds_p, target_p * target_p, preds_p * target_p))
+    outputs = _depthwise_conv_separable(stacked, kern_h, kern_w)
+    n = preds.shape[0]
+    mu_p, mu_t, e_pp, e_tt, e_pt = (outputs[i * n:(i + 1) * n] for i in range(5))
+
+    # drop the reflect-contaminated border ring (reference's final crop, :109)
+    def crop(x):
+        return x[..., pad_h:x.shape[-2] - pad_h, pad_w:x.shape[-1] - pad_w]
+
+    mu_p, mu_t, e_pp, e_tt, e_pt = (crop(x) for x in (mu_p, mu_t, e_pp, e_tt, e_pt))
+    return mu_p, mu_t, e_pp - mu_p**2, e_tt - mu_t**2, e_pt - mu_p * mu_t
+
+
 def _ssim_map(
     preds: Array,
     target: Array,
@@ -89,41 +126,11 @@ def _ssim_map(
     c1 = (k1 * data_range) ** 2
     c2 = (k2 * data_range) ** 2
 
-    dtype = preds.dtype
-    kern_x = _gaussian(kernel_size[0], sigma[0], dtype)
-    kern_y = _gaussian(kernel_size[1], sigma[1], dtype)
-    pad_w = (kernel_size[0] - 1) // 2
-    pad_h = (kernel_size[1] - 1) // 2
+    mu_p, mu_t, var_p, var_t, cov = _moment_maps(preds, target, kernel_size, sigma)
 
-    pad_spec = ((0, 0), (0, 0), (pad_h, pad_h), (pad_w, pad_w))
-    preds_p = jnp.pad(preds, pad_spec, mode="reflect")
-    target_p = jnp.pad(target, pad_spec, mode="reflect")
-
-    # one batched conv over the 5-stack of moment maps (reference :95-97)
-    input_list = jnp.concatenate((preds_p, target_p, preds_p * preds_p, target_p * target_p, preds_p * target_p))
-    outputs = _depthwise_conv_separable(input_list, kern_x, kern_y)
-    n = preds.shape[0]
-    mu_pred, mu_target, e_pred_sq, e_target_sq, e_pred_target = (outputs[i * n:(i + 1) * n] for i in range(5))
-
-    mu_pred_sq = mu_pred**2
-    mu_target_sq = mu_target**2
-    mu_pred_target = mu_pred * mu_target
-
-    sigma_pred_sq = e_pred_sq - mu_pred_sq
-    sigma_target_sq = e_target_sq - mu_target_sq
-    sigma_pred_target = e_pred_target - mu_pred_target
-
-    upper = 2 * sigma_pred_target + c2
-    lower = sigma_pred_sq + sigma_target_sq + c2
-
-    cs_idx = upper / lower  # contrast-sensitivity term (MS-SSIM per-scale)
-    ssim_idx = ((2 * mu_pred_target + c1) / (mu_pred_sq + mu_target_sq + c1)) * cs_idx
-
-    # drop the reflect-contaminated border ring (reference's final crop, :109)
-    def crop(x):
-        return x[..., pad_h:x.shape[-2] - pad_h, pad_w:x.shape[-1] - pad_w]
-
-    return crop(ssim_idx), crop(cs_idx)
+    cs_idx = (2 * cov + c2) / (var_p + var_t + c2)  # contrast-sensitivity (MS-SSIM per-scale)
+    ssim_idx = ((2 * mu_p * mu_t + c1) / (mu_p**2 + mu_t**2 + c1)) * cs_idx
+    return ssim_idx, cs_idx
 
 
 def _ssim_compute(

@@ -1,0 +1,62 @@
+"""Universal Image Quality Index (Wang & Bovik 2002).
+
+Extension beyond the reference snapshot (later torchmetrics ships
+``UniversalImageQualityIndex``). UQI is the stabilizer-free special case of
+SSIM (``C1 = C2 = 0``) and reuses the shared windowed-moment maps. The 0/0
+limits resolve through the product decomposition
+``Q = contrast * luminance``: two flat windows have unit contrast agreement
+(the luminance term then scores their levels), and both-zero-mean flat
+windows score 1 — so an all-black prediction of an all-white target scores
+0, not a spurious 1.
+"""
+from typing import Sequence
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.regression.ssim import _check_ssim_params, _moment_maps, _ssim_update
+from metrics_tpu.utils.reductions import reduce
+
+_TINY = 1e-30  # guards the unused where-branch division only
+
+
+def universal_image_quality_index(
+    preds: Array,
+    target: Array,
+    kernel_size: Sequence[int] = (11, 11),
+    sigma: Sequence[float] = (1.5, 1.5),
+    reduction: str = "elementwise_mean",
+) -> Array:
+    """UQI between two batches of images (NCHW).
+
+    ``Q = (2 cov / (var_p + var_t)) * (2 mu_p mu_t / (mu_p^2 + mu_t^2))``
+    per window, reduced over the map.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.arange(0, 16 * 16, dtype=jnp.float32).reshape(1, 1, 16, 16) / 256
+        >>> preds = target * 0.75
+        >>> round(float(universal_image_quality_index(preds, target)), 4)
+        0.9216
+    """
+    preds, target = _ssim_update(preds, target)
+    _check_ssim_params(kernel_size, sigma)
+    # center both signals on a shared global mean before the moment maps:
+    # var/cov are shift-invariant, but computing them as E[x^2]-mu^2 on raw
+    # intensities cancels at ~eps*E[x^2] — at luminance 128 that floor
+    # swamps genuine low-amplitude structure. Centered, the cancellation
+    # scales with the true signal variance, so a tight ulp-based flat
+    # threshold stays valid at any luminance scale.
+    shift = jnp.mean((preds + target) * 0.5)
+    mu_pc, mu_tc, var_p, var_t, cov = _moment_maps(preds - shift, target - shift, kernel_size, sigma)
+    mu_p = mu_pc + shift
+    mu_t = mu_tc + shift
+
+    denom_v = var_p + var_t
+    denom_m = mu_p**2 + mu_t**2
+    second_c = var_p + mu_pc**2 + var_t + mu_tc**2  # centered second moments
+    eps = jnp.finfo(preds.dtype).eps
+    flat = denom_v <= 64.0 * eps * second_c + _TINY
+    contrast = jnp.where(flat, 1.0, 2.0 * cov / jnp.maximum(denom_v, _TINY))
+    luminance = jnp.where(denom_m <= _TINY, 1.0, 2.0 * mu_p * mu_t / jnp.maximum(denom_m, _TINY))
+    return reduce(contrast * luminance, reduction)

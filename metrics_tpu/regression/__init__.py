@@ -17,3 +17,4 @@ from metrics_tpu.regression.mape import (
 from metrics_tpu.regression.tweedie import TweedieDevianceScore
 from metrics_tpu.regression.ms_ssim import MultiScaleSSIM
 from metrics_tpu.regression.concordance import ConcordanceCorrCoef
+from metrics_tpu.regression.uqi import UniversalImageQualityIndex

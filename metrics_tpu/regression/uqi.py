@@ -1,0 +1,45 @@
+"""UniversalImageQualityIndex module. Extension beyond the reference
+snapshot (later torchmetrics ``image/uqi.py``). Streams the per-window map
+mean through the sum/count base (exact for the default mean reduction)."""
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.streaming import SumCountMetric
+from metrics_tpu.functional.regression.uqi import universal_image_quality_index
+
+
+class UniversalImageQualityIndex(SumCountMetric):
+    r"""Accumulated UQI (mean over all windows of all images seen).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.arange(0, 16 * 16, dtype=jnp.float32).reshape(1, 1, 16, 16) / 256
+        >>> preds = target * 0.75
+        >>> uqi = UniversalImageQualityIndex()
+        >>> round(float(uqi(preds, target)), 4)
+        0.9216
+    """
+
+    def __init__(
+        self,
+        kernel_size: Sequence[int] = (11, 11),
+        sigma: Sequence[float] = (1.5, 1.5),
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.kernel_size = tuple(kernel_size)
+        self.sigma = tuple(sigma)
+
+    def _update_stats(self, preds: Array, target: Array) -> Tuple[Array, Any]:
+        q_map = universal_image_quality_index(preds, target, self.kernel_size, self.sigma, "none")
+        return jnp.sum(q_map), q_map.size

@@ -171,3 +171,22 @@ def test_ssim_streaming_matches_stored_and_bounds_state():
         float(SSIM(data_range=1.0, streaming=False)(jnp.asarray(p), jnp.asarray(t))),
         atol=1e-6,
     )
+
+
+def test_ssim_non_square_kernel_alignment():
+    """kernel_size[0] acts along H: pads/crops must follow the same axes."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.functional import ssim
+
+    rng = np.random.RandomState(51)
+    t = rng.rand(1, 1, 40, 24).astype(np.float32)
+    p = np.clip(t + 0.1 * rng.randn(1, 1, 40, 24), 0, 1).astype(np.float32)
+    out_map = ssim(jnp.asarray(p), jnp.asarray(t), kernel_size=(11, 5), sigma=(1.5, 1.5),
+                   reduction="none", data_range=1.0)
+    # symmetric crop: H loses 2*(11-1)//2, W loses 2*(5-1)//2
+    assert out_map.shape == (1, 1, 40 - 10, 24 - 4)
+    # identical images stay exactly 1 under a non-square window
+    exact = ssim(jnp.asarray(t), jnp.asarray(t), kernel_size=(11, 5), sigma=(1.5, 1.5),
+                 data_range=1.0)
+    np.testing.assert_allclose(float(exact), 1.0, atol=1e-5)

@@ -1,0 +1,95 @@
+"""UQI vs an independent numpy full-window implementation."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import UniversalImageQualityIndex
+from metrics_tpu.functional import universal_image_quality_index
+
+_rng = np.random.RandomState(47)
+
+
+def _np_gauss2d(k, sigma):
+    d = np.arange((1 - k) / 2, (1 + k) / 2)
+    g = np.exp(-((d / sigma) ** 2) / 2)
+    g /= g.sum()
+    return np.outer(g, g)
+
+
+def _np_uqi_map(p, t, k=5, sigma=1.5):
+    win = _np_gauss2d(k, sigma)
+    pad = (k - 1) // 2
+    pp = np.pad(p, pad, mode="reflect")
+    tp = np.pad(t, pad, mode="reflect")
+
+    def conv(img):
+        h, w = img.shape
+        out = np.empty((h - k + 1, w - k + 1))
+        for i in range(out.shape[0]):
+            for j in range(out.shape[1]):
+                out[i, j] = (img[i:i + k, j:j + k] * win).sum()
+        return out
+
+    mp, mt = conv(pp), conv(tp)
+    var_p = conv(pp * pp) - mp**2
+    var_t = conv(tp * tp) - mt**2
+    cov = conv(pp * tp) - mp * mt
+    q = (4 * cov * mp * mt + 1e-8) / ((var_p + var_t) * (mp**2 + mt**2) + 1e-8)
+    return q[pad:q.shape[0] - pad, pad:q.shape[1] - pad]
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_uqi_vs_numpy(seed):
+    rng = np.random.RandomState(seed)
+    t = rng.rand(24, 24).astype(np.float32)
+    p = np.clip(t + 0.1 * rng.randn(24, 24), 0, 1).astype(np.float32)
+    got = float(
+        universal_image_quality_index(
+            jnp.asarray(p[None, None]), jnp.asarray(t[None, None]), kernel_size=(5, 5)
+        )
+    )
+    want = _np_uqi_map(p.astype(np.float64), t.astype(np.float64)).mean()
+    np.testing.assert_allclose(got, want, atol=2e-4)
+
+
+def test_uqi_identical_and_module():
+    imgs = _rng.rand(3, 2, 24, 24).astype(np.float32)
+    v = float(universal_image_quality_index(jnp.asarray(imgs), jnp.asarray(imgs), kernel_size=(5, 5)))
+    np.testing.assert_allclose(v, 1.0, atol=1e-4)
+
+    noisy = np.clip(imgs + 0.05 * _rng.randn(*imgs.shape), 0, 1).astype(np.float32)
+    m = UniversalImageQualityIndex(kernel_size=(5, 5))
+    for i in range(3):
+        m.update(jnp.asarray(noisy[i:i + 1]), jnp.asarray(imgs[i:i + 1]))
+    batch = float(
+        universal_image_quality_index(jnp.asarray(noisy), jnp.asarray(imgs), kernel_size=(5, 5))
+    )
+    np.testing.assert_allclose(float(m.compute()), batch, atol=1e-6)
+
+
+def test_uqi_flat_window_limits():
+    """Flat-but-different images must NOT score 1 (luminance penalizes)."""
+    black = jnp.zeros((1, 1, 24, 24))
+    white = jnp.ones((1, 1, 24, 24))
+    np.testing.assert_allclose(
+        float(universal_image_quality_index(black, white, kernel_size=(5, 5))), 0.0, atol=1e-6
+    )
+    # identical flats (incl. all-zero) are perfect
+    assert float(universal_image_quality_index(white, white, kernel_size=(5, 5))) == 1.0
+    assert float(universal_image_quality_index(black, black, kernel_size=(5, 5))) == 1.0
+    # flat at 0.5 vs flat at 1.0: pure luminance term 2*0.5/(0.25+1)
+    v = float(universal_image_quality_index(white * 0.5, white, kernel_size=(5, 5)))
+    np.testing.assert_allclose(v, 2 * 0.5 / 1.25, atol=1e-6)
+
+
+def test_uqi_scale_invariance_and_noise_floor():
+    """Centered moments: tiny amplitudes stay exact, flat+noise scores ~0."""
+    rng = np.random.RandomState(0)
+    t = (rng.rand(1, 1, 24, 24) * 1e-4).astype(np.float32)
+    assert float(universal_image_quality_index(jnp.asarray(t), jnp.asarray(t), kernel_size=(5, 5))) == 1.0
+    # 0-255 luminance scale: genuine noise against a flat target must not
+    # classify as flat (the old mu^2-relative threshold failed here)
+    tt = np.full((1, 1, 48, 48), 128.0, np.float32)
+    pp = (tt + 0.15 * rng.randn(1, 1, 48, 48)).astype(np.float32)
+    v = float(universal_image_quality_index(jnp.asarray(pp), jnp.asarray(tt), kernel_size=(5, 5)))
+    np.testing.assert_allclose(v, 0.0, atol=1e-6)
