@@ -44,6 +44,7 @@ from metrics_tpu.classification import (  # noqa: E402
 from metrics_tpu.regression import (  # noqa: E402
     ConcordanceCorrCoef,
     CosineSimilarity,
+    ErrorRelativeGlobalDimensionlessSynthesis,
     PSNR,
     SSIM,
     ExplainedVariance,
@@ -56,6 +57,7 @@ from metrics_tpu.regression import (  # noqa: E402
     PearsonCorrcoef,
     R2Score,
     SpearmanCorrcoef,
+    SpectralAngleMapper,
     SymmetricMeanAbsolutePercentageError,
     TweedieDevianceScore,
     UniversalImageQualityIndex,

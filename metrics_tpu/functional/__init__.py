@@ -70,3 +70,7 @@ from metrics_tpu.functional.regression.concordance import concordance_corrcoef
 from metrics_tpu.functional.text_squad import squad
 from metrics_tpu.functional.audio.pit import permutation_invariant_training, pit_permutate
 from metrics_tpu.functional.regression.uqi import universal_image_quality_index
+from metrics_tpu.functional.regression.spectral import (
+    error_relative_global_dimensionless_synthesis,
+    spectral_angle_mapper,
+)

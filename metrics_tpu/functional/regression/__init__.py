@@ -19,3 +19,7 @@ from metrics_tpu.functional.regression.tweedie import tweedie_deviance_score
 from metrics_tpu.functional.regression.ms_ssim import multiscale_ssim
 from metrics_tpu.functional.regression.concordance import concordance_corrcoef
 from metrics_tpu.functional.regression.uqi import universal_image_quality_index
+from metrics_tpu.functional.regression.spectral import (
+    error_relative_global_dimensionless_synthesis,
+    spectral_angle_mapper,
+)

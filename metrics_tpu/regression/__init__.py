@@ -18,3 +18,7 @@ from metrics_tpu.regression.tweedie import TweedieDevianceScore
 from metrics_tpu.regression.ms_ssim import MultiScaleSSIM
 from metrics_tpu.regression.concordance import ConcordanceCorrCoef
 from metrics_tpu.regression.uqi import UniversalImageQualityIndex
+from metrics_tpu.regression.spectral import (
+    ErrorRelativeGlobalDimensionlessSynthesis,
+    SpectralAngleMapper,
+)

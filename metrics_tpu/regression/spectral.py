@@ -1,0 +1,64 @@
+"""SpectralAngleMapper / ERGAS modules. Extensions beyond the reference
+snapshot (later torchmetrics image package). Both stream per-image values
+through the sum/count base."""
+from typing import Any, Callable, Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.streaming import SumCountMetric
+from metrics_tpu.functional.regression.spectral import (
+    error_relative_global_dimensionless_synthesis,
+    spectral_angle_mapper,
+)
+
+
+class SpectralAngleMapper(SumCountMetric):
+    r"""Accumulated mean spectral angle (radians) over images seen.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.stack([jnp.ones((1, 8, 8)), jnp.zeros((1, 8, 8))], axis=1)
+        >>> preds = jnp.stack([jnp.ones((1, 8, 8)), jnp.ones((1, 8, 8))], axis=1)
+        >>> sam = SpectralAngleMapper()
+        >>> round(float(sam(preds, target)), 4)
+        0.7854
+    """
+
+    def _update_stats(self, preds: Array, target: Array) -> Tuple[Array, Any]:
+        values = spectral_angle_mapper(preds, target, reduction="none")
+        return jnp.sum(values), values.shape[0]
+
+
+class ErrorRelativeGlobalDimensionlessSynthesis(SumCountMetric):
+    r"""Accumulated ERGAS (mean of per-image values; lower is better).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.ones((1, 2, 8, 8))
+        >>> ergas = ErrorRelativeGlobalDimensionlessSynthesis()
+        >>> round(float(ergas(target * 0.9, target)), 4)
+        40.0
+    """
+
+    def __init__(
+        self,
+        ratio: float = 4.0,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        if ratio <= 0:
+            raise ValueError(f"`ratio` must be positive, got {ratio!r}")
+        self.ratio = float(ratio)
+
+    def _update_stats(self, preds: Array, target: Array) -> Tuple[Array, Any]:
+        values = error_relative_global_dimensionless_synthesis(preds, target, self.ratio, reduction="none")
+        return jnp.sum(values), values.shape[0]

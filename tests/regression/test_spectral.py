@@ -1,0 +1,90 @@
+"""SAM / ERGAS vs independent numpy implementations."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import ErrorRelativeGlobalDimensionlessSynthesis, SpectralAngleMapper
+from metrics_tpu.functional import (
+    error_relative_global_dimensionless_synthesis,
+    spectral_angle_mapper,
+)
+
+_rng = np.random.RandomState(53)
+
+
+def _np_sam(p, t):
+    # p, t: (B, C, H, W)
+    dot = (p * t).sum(1)
+    denom = np.linalg.norm(p, axis=1) * np.linalg.norm(t, axis=1)
+    cos = np.clip(dot / denom, -1, 1)
+    return np.arccos(cos).mean(axis=(-2, -1))
+
+
+def _np_ergas(p, t, ratio=4.0):
+    rmse_sq = ((p - t) ** 2).mean(axis=(-2, -1))
+    mean_sq = t.mean(axis=(-2, -1)) ** 2
+    return 100 * ratio * np.sqrt((rmse_sq / mean_sq).mean(-1))
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_sam_ergas_vs_numpy(seed):
+    rng = np.random.RandomState(seed)
+    t = (rng.rand(3, 4, 16, 16) + 0.1).astype(np.float32)
+    p = (t + 0.1 * rng.randn(3, 4, 16, 16)).astype(np.float32)
+    np.testing.assert_allclose(
+        float(spectral_angle_mapper(jnp.asarray(p), jnp.asarray(t))),
+        _np_sam(p.astype(np.float64), t.astype(np.float64)).mean(), atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        float(error_relative_global_dimensionless_synthesis(jnp.asarray(p), jnp.asarray(t), ratio=2.0)),
+        _np_ergas(p.astype(np.float64), t.astype(np.float64), 2.0).mean(), rtol=1e-5,
+    )
+
+
+def test_modules_accumulate():
+    t = (_rng.rand(4, 3, 16, 16) + 0.1).astype(np.float32)
+    p = (t + 0.05 * _rng.randn(4, 3, 16, 16)).astype(np.float32)
+    sam = SpectralAngleMapper()
+    ergas = ErrorRelativeGlobalDimensionlessSynthesis()
+    for i in range(4):
+        sam.update(jnp.asarray(p[i:i + 1]), jnp.asarray(t[i:i + 1]))
+        ergas.update(jnp.asarray(p[i:i + 1]), jnp.asarray(t[i:i + 1]))
+    np.testing.assert_allclose(
+        float(sam.compute()), float(spectral_angle_mapper(jnp.asarray(p), jnp.asarray(t))), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        float(ergas.compute()),
+        float(error_relative_global_dimensionless_synthesis(jnp.asarray(p), jnp.asarray(t))),
+        rtol=1e-6,
+    )
+
+
+def test_validation():
+    one_band = jnp.ones((1, 1, 8, 8))
+    with pytest.raises(ValueError, match="bands"):
+        spectral_angle_mapper(one_band, one_band)
+    with pytest.raises(ValueError, match="ratio"):
+        error_relative_global_dimensionless_synthesis(jnp.ones((1, 2, 8, 8)), jnp.ones((1, 2, 8, 8)), ratio=0)
+    with pytest.raises(ValueError, match="ratio"):
+        ErrorRelativeGlobalDimensionlessSynthesis(ratio=-1)
+    # identical images: SAM 0
+    t = jnp.asarray((_rng.rand(1, 3, 8, 8) + 0.1).astype(np.float32))
+    np.testing.assert_allclose(float(spectral_angle_mapper(t, t)), 0.0, atol=1e-3)
+
+
+def test_sam_zero_spectrum_pixels():
+    """Masked/background (zero-spectrum) pixels: both-zero agrees (0), one
+    zero is maximally wrong (pi/2)."""
+    z = jnp.zeros((1, 3, 8, 8))
+    np.testing.assert_allclose(float(spectral_angle_mapper(z, z)), 0.0, atol=1e-7)
+    # half the pixels zero in BOTH images, identical elsewhere -> still 0
+    t = np.zeros((1, 3, 8, 8), np.float32)
+    t[..., :4, :] = _rng.rand(1, 3, 4, 8) + 0.1
+    np.testing.assert_allclose(
+        float(spectral_angle_mapper(jnp.asarray(t), jnp.asarray(t))), 0.0, atol=1e-3
+    )
+    # pred zero where target nonzero -> pi/2 on those pixels
+    p = t.copy()
+    p[..., :2, :] = 0.0
+    v = float(spectral_angle_mapper(jnp.asarray(p), jnp.asarray(t)))
+    np.testing.assert_allclose(v, (np.pi / 2) * (16 / 64), atol=1e-3)
