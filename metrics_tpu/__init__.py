@@ -29,6 +29,7 @@ from metrics_tpu.classification import (  # noqa: E402
     CalibrationError,
     CohenKappa,
     ConfusionMatrix,
+    Dice,
     FBeta,
     HammingDistance,
     HingeLoss,

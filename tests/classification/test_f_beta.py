@@ -193,3 +193,20 @@ class TestFBeta(MetricTester):
                 "mdmc_average": mdmc_average,
             },
         )
+
+
+def test_dice_class_equals_f1_and_sklearn():
+    """Dice (the segmentation name) is numerically F1 on the same states."""
+    from metrics_tpu import Dice
+
+    rng = np.random.RandomState(71)
+    p = rng.randint(0, 4, 256).astype(np.int32)
+    t = rng.randint(0, 4, 256).astype(np.int32)
+    dice = Dice(num_classes=4, average="macro")
+    f1 = F1(num_classes=4, average="macro")
+    dice.update(jnp.asarray(p), jnp.asarray(t))
+    f1.update(jnp.asarray(p), jnp.asarray(t))
+    np.testing.assert_allclose(float(dice.compute()), float(f1.compute()), atol=1e-7)
+    np.testing.assert_allclose(
+        float(dice.compute()), f1_score(t, p, average="macro", zero_division=0), atol=1e-6
+    )
