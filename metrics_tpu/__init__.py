@@ -34,6 +34,7 @@ from metrics_tpu.classification import (  # noqa: E402
     HammingDistance,
     HingeLoss,
     IoU,
+    JaccardIndex,
     MatthewsCorrcoef,
     Precision,
     PrecisionRecallCurve,
@@ -50,10 +51,12 @@ from metrics_tpu.regression import (  # noqa: E402
     SSIM,
     ExplainedVariance,
     KLDivergence,
+    LogCoshError,
     MeanAbsoluteError,
     MeanAbsolutePercentageError,
     MeanSquaredError,
     MeanSquaredLogError,
+    MinkowskiDistance,
     MultiScaleSSIM,
     PearsonCorrcoef,
     R2Score,

@@ -12,7 +12,7 @@ from metrics_tpu.classification.cohen_kappa import CohenKappa
 from metrics_tpu.classification.confusion_matrix import ConfusionMatrix
 from metrics_tpu.classification.f_beta import Dice, F1, FBeta
 from metrics_tpu.classification.hamming_distance import HammingDistance
-from metrics_tpu.classification.iou import IoU
+from metrics_tpu.classification.iou import IoU, JaccardIndex
 from metrics_tpu.classification.specificity import Specificity
 from metrics_tpu.classification.matthews_corrcoef import MatthewsCorrcoef
 from metrics_tpu.classification.precision_recall import Precision, Recall

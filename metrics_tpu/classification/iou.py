@@ -47,3 +47,15 @@ class IoU(ConfusionMatrix):
 
     def compute(self) -> Array:
         return _iou_from_confmat(self.confmat, self.num_classes, self.ignore_index, self.absent_score, self.reduction)
+
+
+class JaccardIndex(IoU):
+    r"""Alias of :class:`IoU` under its set-theory name (later torchmetrics
+    renamed ``IoU`` to ``JaccardIndex``; both names resolve here).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> jaccard = JaccardIndex(num_classes=2)
+        >>> round(float(jaccard(jnp.array([0, 1, 0, 0]), jnp.array([1, 1, 0, 0]))), 4)
+        0.5833
+    """

@@ -74,3 +74,4 @@ from metrics_tpu.functional.regression.spectral import (
     error_relative_global_dimensionless_synthesis,
     spectral_angle_mapper,
 )
+from metrics_tpu.functional.regression.minkowski import log_cosh_error, minkowski_distance

@@ -1,0 +1,55 @@
+"""Log-cosh error and Minkowski distance.
+
+Extensions beyond the reference snapshot (later torchmetrics ships
+``LogCoshError`` and ``MinkowskiDistance``). Streaming sum states.
+"""
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def _log_cosh_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    diff = preds.astype(jnp.float32) - target.astype(jnp.float32)
+    # logcosh via the overflow-safe identity |x| + log1p(exp(-2|x|)) - log 2
+    a = jnp.abs(diff)
+    vals = a + jnp.log1p(jnp.exp(-2.0 * a)) - jnp.log(2.0)
+    return jnp.sum(vals), target.size
+
+
+def log_cosh_error(preds: Array, target: Array) -> Array:
+    """Mean log-cosh of the errors (a smooth, outlier-tempered L1/L2 blend).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([0.0, 1.0, 2.0])
+        >>> preds = jnp.array([0.5, 1.0, 2.5])
+        >>> round(float(log_cosh_error(preds, target)), 4)
+        0.0801
+    """
+    total, n = _log_cosh_update(preds, target)
+    return total / jnp.maximum(n, 1)
+
+
+def _minkowski_update(preds: Array, target: Array, p: float) -> Array:
+    if not p >= 1:
+        raise ValueError(f"`p` must be >= 1, got {p!r}")
+    _check_same_shape(preds, target)
+    diff = jnp.abs(preds.astype(jnp.float32) - target.astype(jnp.float32))
+    return jnp.sum(diff**p)
+
+
+def minkowski_distance(preds: Array, target: Array, p: float = 2.0) -> Array:
+    """Minkowski distance ``(sum |preds - target|^p)^(1/p)``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([0.0, 1.0, 2.0])
+        >>> preds = jnp.array([0.5, 1.0, 2.5])
+        >>> round(float(minkowski_distance(preds, target, p=2)), 4)
+        0.7071
+    """
+    return _minkowski_update(preds, target, p) ** (1.0 / p)

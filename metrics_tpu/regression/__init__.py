@@ -22,3 +22,4 @@ from metrics_tpu.regression.spectral import (
     ErrorRelativeGlobalDimensionlessSynthesis,
     SpectralAngleMapper,
 )
+from metrics_tpu.regression.minkowski import LogCoshError, MinkowskiDistance

@@ -1,0 +1,63 @@
+"""LogCoshError / MinkowskiDistance modules. Extensions beyond the reference
+snapshot (later torchmetrics regression package)."""
+from typing import Any, Callable, Optional, Tuple
+
+
+import numpy as np
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.core.streaming import SumCountMetric
+from metrics_tpu.functional.regression.minkowski import _log_cosh_update, _minkowski_update
+
+
+class LogCoshError(SumCountMetric):
+    r"""Accumulated mean log-cosh error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> metric = LogCoshError()
+        >>> round(float(metric(jnp.array([0.5, 1.0, 2.5]), jnp.array([0.0, 1.0, 2.0]))), 4)
+        0.0801
+    """
+
+    def _update_stats(self, preds: Array, target: Array) -> Tuple[Array, Any]:
+        return _log_cosh_update(preds, target)
+
+
+class MinkowskiDistance(Metric):
+    r"""Accumulated Minkowski distance ``(sum |p - t|^p)^(1/p)`` over all
+    data seen (the p-th powers are the sum state, so accumulation order and
+    sharding do not change the result).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> metric = MinkowskiDistance(p=2)
+        >>> round(float(metric(jnp.array([0.5, 1.0, 2.5]), jnp.array([0.0, 1.0, 2.0]))), 4)
+        0.7071
+    """
+
+    def __init__(
+        self,
+        p: float = 2.0,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        if not p >= 1:
+            raise ValueError(f"`p` must be >= 1, got {p!r}")
+        self.p = float(p)
+        self.add_state("sum_pow", default=np.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        self.sum_pow = self.sum_pow + _minkowski_update(preds, target, self.p)
+
+    def compute(self) -> Array:
+        return self.sum_pow ** (1.0 / self.p)

@@ -1,0 +1,66 @@
+"""LogCoshError / MinkowskiDistance vs numpy; JaccardIndex alias check."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import IoU, JaccardIndex, LogCoshError, MinkowskiDistance
+from metrics_tpu.functional import log_cosh_error, minkowski_distance
+from tests.helpers.testers import NUM_BATCHES, MetricTester
+
+_rng = np.random.RandomState(61)
+BATCH_SIZE = 48
+
+_target = _rng.randn(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+_preds = (_target + 0.5 * _rng.randn(NUM_BATCHES, BATCH_SIZE)).astype(np.float32)
+
+
+def _np_logcosh(preds, target):
+    d = np.asarray(preds, np.float64).ravel() - np.asarray(target, np.float64).ravel()
+    return np.log(np.cosh(d)).mean()
+
+
+class TestLogCosh(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp, preds=_preds, target=_target, metric_class=LogCoshError,
+            sk_metric=_np_logcosh, dist_sync_on_step=False,
+        )
+
+    def test_functional(self):
+        self.run_functional_metric_test(_preds, _target, metric_functional=log_cosh_error, sk_metric=_np_logcosh)
+
+
+def test_logcosh_large_errors_stable():
+    # the naive log(cosh(x)) overflows at |x| ~ 90; the identity must not
+    v = float(log_cosh_error(jnp.asarray([200.0]), jnp.asarray([0.0])))
+    np.testing.assert_allclose(v, 200.0 - np.log(2.0), rtol=1e-6)
+
+
+@pytest.mark.parametrize("p", [1.0, 2.0, 3.0])
+def test_minkowski_vs_numpy(p):
+    d = np.abs(_preds - _target).astype(np.float64).ravel()
+    want = (d**p).sum() ** (1 / p)
+    got = float(minkowski_distance(jnp.asarray(_preds), jnp.asarray(_target), p=p))
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    m = MinkowskiDistance(p=p)
+    for i in range(NUM_BATCHES):
+        m.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+    np.testing.assert_allclose(float(m.compute()), want, rtol=1e-4)
+
+
+def test_minkowski_validation():
+    with pytest.raises(ValueError, match=">= 1"):
+        minkowski_distance(jnp.zeros(2), jnp.zeros(2), p=0.5)
+    with pytest.raises(ValueError, match=">= 1"):
+        MinkowskiDistance(p=0)
+
+
+def test_jaccard_alias():
+    p = jnp.asarray(_rng.randint(0, 3, 64)); t = jnp.asarray(_rng.randint(0, 3, 64))
+    a = JaccardIndex(num_classes=3); a.update(p, t)
+    b = IoU(num_classes=3); b.update(p, t)
+    assert float(a.compute()) == float(b.compute())
