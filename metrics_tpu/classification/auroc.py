@@ -12,9 +12,13 @@ from metrics_tpu.utils.prints import rank_zero_warn
 class AUROC(Metric):
     """Area under the ROC curve, over all data seen.
 
-    At pod scale, keep the epoch sharded instead of gathered:
-    ``metrics_tpu.parallel.sharded_auroc`` computes the same exact value
-    inside ``shard_map`` with O(N/n) per-device memory (ring pass).
+    At pod scale, keep the epoch sharded instead of gathered: construct with
+    a ``capacity`` and place the states with
+    ``metrics_tpu.parallel.row_sharded(mesh)`` — ``compute()`` then
+    dispatches the exact ring engine (``parallel/sharded_epoch.py``) with
+    O(capacity/n) per-device memory, through this same interface. (The
+    raw in-``shard_map`` form remains available as
+    ``metrics_tpu.parallel.sharded_auroc``.)
 
     Example (binary):
         >>> import jax.numpy as jnp
@@ -35,12 +39,16 @@ class AUROC(Metric):
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
         dist_sync_fn: Optional[Callable] = None,
+        capacity: Optional[int] = None,
+        jit: Optional[bool] = None,
     ):
         super().__init__(
             compute_on_step=compute_on_step,
             dist_sync_on_step=dist_sync_on_step,
             process_group=process_group,
             dist_sync_fn=dist_sync_fn,
+            capacity=capacity,
+            jit=jit,
         )
 
         self.num_classes = num_classes
@@ -80,7 +88,17 @@ class AUROC(Metric):
             )
         self.mode = mode
 
+    def _states_own_sync(self) -> bool:
+        from metrics_tpu.parallel.sharded_dispatch import auroc_applicable
+
+        return auroc_applicable(self) is not None
+
     def compute(self) -> Array:
+        from metrics_tpu.parallel.sharded_dispatch import auroc_sharded
+
+        sharded = auroc_sharded(self)  # row-sharded epoch states: exact ring
+        if sharded is not None:
+            return sharded
         preds = as_values(self.preds)
         target = as_values(self.target)
         return _auroc_compute(

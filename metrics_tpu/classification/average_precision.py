@@ -16,6 +16,10 @@ from metrics_tpu.utils.prints import rank_zero_warn
 class AveragePrecision(Metric):
     """Average precision over all data seen.
 
+    At pod scale, construct with a ``capacity`` and place the states with
+    ``metrics_tpu.parallel.row_sharded(mesh)``: ``compute()`` then runs the
+    exact sharded ring engine with O(capacity/n) per-device memory.
+
     Example (binary):
         >>> import jax.numpy as jnp
         >>> pred = jnp.array([0, 1, 2, 3])
@@ -33,12 +37,16 @@ class AveragePrecision(Metric):
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
         dist_sync_fn: Optional[Callable] = None,
+        capacity: Optional[int] = None,
+        jit: Optional[bool] = None,
     ):
         super().__init__(
             compute_on_step=compute_on_step,
             dist_sync_on_step=dist_sync_on_step,
             process_group=process_group,
             dist_sync_fn=dist_sync_fn,
+            capacity=capacity,
+            jit=jit,
         )
 
         self.num_classes = num_classes
@@ -61,7 +69,17 @@ class AveragePrecision(Metric):
         self.num_classes = num_classes
         self.pos_label = pos_label
 
+    def _states_own_sync(self) -> bool:
+        from metrics_tpu.parallel.sharded_dispatch import average_precision_applicable
+
+        return average_precision_applicable(self) is not None
+
     def compute(self) -> Union[List[Array], Array]:
+        from metrics_tpu.parallel.sharded_dispatch import average_precision_sharded
+
+        sharded = average_precision_sharded(self)  # row-sharded epoch states
+        if sharded is not None:
+            return sharded
         preds = as_values(self.preds)
         target = as_values(self.target)
         return _average_precision_compute(preds, target, self.num_classes, self.pos_label)

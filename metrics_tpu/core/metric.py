@@ -360,12 +360,50 @@ class Metric(ABC):
         return jnp.array(cached, copy=True)
 
     def _append(self, name: str, value: Array) -> None:
-        """Append to a cat state — list (eager) or PaddedBuffer (jit-safe)."""
+        """Append to a cat state — list (eager) or PaddedBuffer (jit-safe).
+
+        When the metric was built with a ``capacity`` but the cat state has
+        no declared ``item_shape`` (curve/retrieval metrics infer their item
+        layout from the data mode at the first update), the FIRST eager
+        append promotes the state to a PaddedBuffer with the observed item
+        shape/dtype. From then on the metric is buffer-backed: jit-safe
+        fused steps, in-jit sync, and mesh placement (``device_put`` targets
+        recorded before promotion are applied to the new buffer).
+        """
         current = getattr(self, name)
         if isinstance(current, PaddedBuffer):
             setattr(self, name, buffer_append(current, value))
-        else:
-            current.append(value)
+            return
+        if (
+            self.capacity is not None
+            and isinstance(self._defaults.get(name), list)
+            and not current
+        ):
+            if self._under_trace():
+                # a tracer must not leak into the eager list state — fail
+                # loudly (caught by the fused-step fallback machinery; a
+                # user-level jit surfaces this at the update call, not as an
+                # opaque UnexpectedTracerError at compute)
+                raise TracingUnsupportedError(
+                    f"{type(self).__name__} with `capacity` infers its buffer layout from"
+                    " the first update, which cannot happen under jit tracing. Run one"
+                    " eager update first, or declare the state with `item_shape`."
+                )
+            value = jnp.atleast_1d(jnp.asarray(value))
+            spec = _BufferSpec(self.capacity, tuple(value.shape[1:]), value.dtype)
+            buf = buffer_init(spec.capacity, spec.item_shape, spec.dtype)
+            if self._placement is not None:
+                # placement may reject the buffer (e.g. row_sharded
+                # divisibility) — it must raise BEFORE the spec is committed,
+                # or a retried update would half-promote the cat states
+                resolve = (
+                    self._placement if callable(self._placement) else (lambda _n, _v: self._placement)
+                )
+                buf = jax.device_put(buf, resolve(name, buf))
+            self._defaults[name] = spec
+            setattr(self, name, buffer_append(buf, value))
+            return
+        current.append(value)
 
     # ------------------------------------------------------------- pure core
     @staticmethod
@@ -776,6 +814,15 @@ class Metric(ABC):
             return gather_all_arrays
         return functools.partial(gather_all_arrays, group=self.process_group)
 
+    def _states_own_sync(self) -> bool:
+        """Whether this compute will dispatch to the sharded epoch engine
+        (whose collectives combine states across devices AND processes),
+        making the host-plane gather redundant. Overridden by the metrics
+        that own a sharded dispatch; must mirror the dispatch's own
+        applicability test exactly, or a declined dispatch would run the
+        gather path with sync silently disabled."""
+        return False
+
     def _sync_dist(self, dist_sync_fn: Optional[Callable] = None) -> None:
         """Host-plane sync: gather + stack/flatten + per-state reduction
         (reference metric.py:179-197)."""
@@ -873,6 +920,12 @@ class Metric(ABC):
             dist_sync_fn = self.dist_sync_fn
             if dist_sync_fn is None and jax.process_count() > 1:
                 dist_sync_fn = self._default_gather()
+            if dist_sync_fn is not None and self._states_own_sync():
+                # mesh-row-sharded global states span processes already; their
+                # combination happens via XLA collectives inside the jitted
+                # sharded compute — a host gather would re-materialize the
+                # epoch the sharded placement exists to avoid
+                dist_sync_fn = None
 
             synced = False
             cache = {}

@@ -7,11 +7,13 @@ from metrics_tpu.parallel.buffer import (
     buffer_merge,
     buffer_values,
 )
-from metrics_tpu.parallel.placement import batch_sharded, class_sharded
+from metrics_tpu.parallel.placement import batch_sharded, class_sharded, row_sharded
 from metrics_tpu.parallel.sharded_epoch import (
     regroup_by_query,
     sharded_auroc,
+    sharded_auroc_matrix,
     sharded_average_precision,
+    sharded_average_precision_matrix,
     sharded_retrieval_sums,
 )
 from metrics_tpu.parallel.sync import (

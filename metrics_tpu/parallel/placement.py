@@ -13,6 +13,8 @@ from typing import Any, Callable, Collection, Optional
 
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from metrics_tpu.parallel.buffer import PaddedBuffer
+
 
 def class_sharded(
     mesh: Mesh, axis: str = "mp", names: Optional[Collection[str]] = None
@@ -46,6 +48,55 @@ def class_sharded(
         if value.shape[0] % axis_size:
             return NamedSharding(mesh, P())
         return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+
+    return resolve
+
+
+def row_sharded(
+    mesh: Mesh, axis: str = "dp", names: Optional[Collection[str]] = None
+) -> Callable[[str, Any], Any]:
+    """Placement callable for ``Metric.device_put``: keep cat-state
+    (PaddedBuffer) epoch rows SHARDED over mesh axis ``axis`` — the front
+    door to sharded epoch compute.
+
+    A curve/retrieval metric built with a ``capacity`` stores its epoch rows
+    in fixed-shape PaddedBuffers; placing them with this policy spreads the
+    rows over the data axis (O(capacity / axis_size) per device), appends
+    land on the device owning the destination rows, and ``compute()``
+    detects the sharded placement and dispatches the exact ring /
+    ``all_to_all`` engine (``parallel/sharded_epoch.py``) instead of
+    gathering the epoch — no reference counterpart (the reference always
+    materializes the full epoch per rank, torchmetrics/metric.py:188-197).
+
+    ``capacity`` must be divisible by the ``axis`` size (loud error, never a
+    silent replicate — the caller explicitly asked for sharded rows).
+    Non-buffer states (scalars, counters) replicate. Pass ``names`` to
+    restrict which cat states shard.
+
+    Example::
+
+        mesh = Mesh(np.array(jax.devices()), ("dp",))
+        auroc = AUROC(pos_label=1, capacity=1_000_000)
+        auroc.device_put(row_sharded(mesh, "dp"))
+        for preds, target in loader:
+            auroc.update(preds, target)   # rows appended sharded
+        auroc.compute()                   # exact ring, O(capacity/n)/device
+    """
+    axis_size = mesh.shape[axis]
+
+    def resolve(name: str, value: Any) -> Any:
+        if isinstance(value, PaddedBuffer) and (names is None or name in names):
+            if value.data.shape[0] % axis_size:
+                raise ValueError(
+                    f"row_sharded: state '{name}' capacity {value.data.shape[0]} is not"
+                    f" divisible by mesh axis '{axis}' size {axis_size}; pick a divisible"
+                    " `capacity` so every device holds an equal row block."
+                )
+            spec = P(axis, *([None] * (value.data.ndim - 1)))
+            return PaddedBuffer(
+                data=NamedSharding(mesh, spec), count=NamedSharding(mesh, P())
+            )
+        return NamedSharding(mesh, P())
 
     return resolve
 

@@ -55,11 +55,11 @@ class _SortedPack(NamedTuple):
     cum_wn: Array  # (m,) cumulative negative weight
 
 
-def _pack(preds: Array, target: Array, weights: Optional[Array]) -> _SortedPack:
+def _pack(preds: Array, target: Array, weights: Array) -> _SortedPack:
     order = jnp.argsort(preds)
     s = preds[order]
     y = target[order].astype(jnp.float32)
-    w = jnp.ones_like(y) if weights is None else weights[order].astype(jnp.float32)
+    w = weights[order].astype(jnp.float32)
     return _SortedPack(s, jnp.cumsum(w * y), jnp.cumsum(w * (1.0 - y)))
 
 
@@ -79,25 +79,80 @@ def _below_tie_ge(pack: _SortedPack, q: Array) -> Tuple[Array, Array, Array, Arr
     return wn_below, wn_tie, wp_ge, wn_ge
 
 
-def _ring_stats(
-    preds: Array, target: Array, weights: Optional[Array], axis_name: str
+def _ring_stats_cols(
+    preds_cm: Array, target_cm: Array, weights_cm: Array, axis_name: str
 ) -> Tuple[Array, Array, Array, Array]:
-    """Accumulate the four global statistics for every local element by
-    circulating each shard's sorted pack around the mesh axis ring."""
+    """Per-class ring statistics for ``(C, m)`` column-major shards.
+
+    One ``ppermute`` of the STACKED pack per hop (a single (C, m)-sized ICI
+    transfer, not C small ones); the searchsorted accumulation vmaps over the
+    class axis. Returns four ``(C, m)`` arrays.
+    """
     n = jax.lax.axis_size(axis_name)
-    pack = _pack(preds, target, weights)
+    pack = jax.vmap(_pack)(preds_cm, target_cm, weights_cm)
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     def body(_, carry):
         acc, visiting = carry
         visiting = jax.lax.ppermute(visiting, axis_name, perm)
-        acc = tuple(a + b for a, b in zip(acc, _below_tie_ge(visiting, preds)))
+        acc = tuple(a + b for a, b in zip(acc, jax.vmap(_below_tie_ge)(visiting, preds_cm)))
         return acc, visiting
 
     # local contribution first, then n-1 ring hops (no dead final collective)
-    acc = _below_tie_ge(pack, preds)
+    acc = jax.vmap(_below_tie_ge)(pack, preds_cm)
     (acc, _) = jax.lax.fori_loop(0, n - 1, body, (acc, pack))
     return acc
+
+
+def _cols(preds: Array, target: Array, weights: Optional[Array]) -> Tuple[Array, Array, Array]:
+    """Broadcast ``(m, C)`` inputs (+ per-row or per-row-per-class weights)
+    to the ``(C, m)`` column-major layout the ring engine runs on."""
+    preds_cm = preds.T
+    target_cm = target.T.astype(jnp.float32)
+    if weights is None:
+        w_cm = jnp.ones_like(preds_cm)
+    else:
+        w = weights.astype(jnp.float32)
+        w_cm = jnp.broadcast_to(w[:, None], preds.shape).T if w.ndim == 1 else w.T
+    return preds_cm, target_cm, w_cm
+
+
+def sharded_auroc_matrix(
+    preds: Array, target: Array, axis_name: str, sample_weights: Optional[Array] = None
+) -> Array:
+    """Exact per-class AUROCs over epoch data sharded along ``axis_name``.
+
+    ``preds``/``target`` are the LOCAL ``(m, C)`` shard (one-vs-rest binary
+    targets per column); returns the ``(C,)`` class scores, each matching
+    ``sklearn.metrics.roc_auc_score`` on that column of the concatenated
+    epoch — cross-shard ties included. ``nan`` where a column is
+    single-class globally. ``sample_weights`` is per-row ``(m,)`` or
+    per-row-per-class ``(m, C)``; zero weight neutralizes a row (padding).
+    """
+    preds_cm, y, w = _cols(preds, target, sample_weights)
+    wn_below, wn_tie, _, _ = _ring_stats_cols(preds_cm, y, w, axis_name)
+    wp = w * y
+    u_local = jnp.sum(wp * (wn_below + 0.5 * wn_tie), axis=-1)
+    pos = jax.lax.psum(jnp.sum(wp, axis=-1), axis_name)
+    neg = jax.lax.psum(jnp.sum(w * (1.0 - y), axis=-1), axis_name)
+    u = jax.lax.psum(u_local, axis_name)
+    denom = pos * neg
+    return jnp.where(denom == 0, jnp.nan, u / jnp.where(denom == 0, 1.0, denom))
+
+
+def sharded_average_precision_matrix(
+    preds: Array, target: Array, axis_name: str, sample_weights: Optional[Array] = None
+) -> Array:
+    """Exact per-class average precision over sharded ``(m, C)`` epoch data
+    (see module docstring for the per-item identity). ``(C,)`` scores; ``nan``
+    where a column has zero positive weight globally."""
+    preds_cm, y, w = _cols(preds, target, sample_weights)
+    _, _, wp_ge, wn_ge = _ring_stats_cols(preds_cm, y, w, axis_name)
+    wp = w * y
+    contrib = jnp.sum(wp * wp_ge / jnp.maximum(wp_ge + wn_ge, 1e-38), axis=-1)
+    pos = jax.lax.psum(jnp.sum(wp, axis=-1), axis_name)
+    total = jax.lax.psum(contrib, axis_name)
+    return jnp.where(pos == 0, jnp.nan, total / jnp.where(pos == 0, 1.0, pos))
 
 
 def sharded_auroc(
@@ -110,16 +165,8 @@ def sharded_auroc(
     including cross-shard score ties. ``nan`` when a class is absent
     globally. Rows can be neutralized with ``sample_weights=0`` (padding).
     """
-    wn_below, wn_tie, _, _ = _ring_stats(preds, target, sample_weights, axis_name)
-    y = target.astype(jnp.float32)
-    w = jnp.ones_like(y) if sample_weights is None else sample_weights.astype(jnp.float32)
-    wp = w * y
-    u_local = jnp.sum(wp * (wn_below + 0.5 * wn_tie))
-    pos = jax.lax.psum(jnp.sum(wp), axis_name)
-    neg = jax.lax.psum(jnp.sum(w * (1.0 - y)), axis_name)
-    u = jax.lax.psum(u_local, axis_name)
-    denom = pos * neg
-    return jnp.where(denom == 0, jnp.nan, u / jnp.where(denom == 0, 1.0, denom))
+    w = None if sample_weights is None else sample_weights[:, None]
+    return sharded_auroc_matrix(preds[:, None], target[:, None], axis_name, w)[0]
 
 
 def sharded_average_precision(
@@ -131,14 +178,8 @@ def sharded_average_precision(
     Matches the reference step integral / ``sklearn.average_precision_score``
     on the concatenated epoch. ``nan`` with zero positive weight.
     """
-    _, _, wp_ge, wn_ge = _ring_stats(preds, target, sample_weights, axis_name)
-    y = target.astype(jnp.float32)
-    w = jnp.ones_like(y) if sample_weights is None else sample_weights.astype(jnp.float32)
-    wp = w * y
-    contrib = jnp.sum(wp * wp_ge / jnp.maximum(wp_ge + wn_ge, 1e-38))
-    pos = jax.lax.psum(jnp.sum(wp), axis_name)
-    total = jax.lax.psum(contrib, axis_name)
-    return jnp.where(pos == 0, jnp.nan, total / jnp.where(pos == 0, 1.0, pos))
+    w = None if sample_weights is None else sample_weights[:, None]
+    return sharded_average_precision_matrix(preds[:, None], target[:, None], axis_name, w)[0]
 
 
 def regroup_by_query(
@@ -147,6 +188,7 @@ def regroup_by_query(
     target: Array,
     axis_name: str,
     capacity: Optional[int] = None,
+    valid: Optional[Array] = None,
 ) -> Tuple[Array, Array, Array, Array, Array]:
     """Route rows to shard ``query_id mod n`` so each query lands wholly on
     one shard (static-shape ``all_to_all`` through per-destination buckets).
@@ -156,7 +198,9 @@ def regroup_by_query(
     the GLOBAL count of rows that overflowed their destination bucket —
     assert it is zero outside jit (never silently wrong). ``capacity``
     defaults to ``2 * ceil(local_rows / n)``; raise it for skewed query-id
-    distributions.
+    distributions. ``valid`` (bool, per row) excludes rows entirely: they
+    take no bucket slot, never count as dropped, and arrive as pad rows
+    (the padded-buffer epoch-state story, ``parallel/sharded_dispatch.py``).
     """
     n = jax.lax.axis_size(axis_name)
     rows = idx.shape[0]
@@ -164,13 +208,15 @@ def regroup_by_query(
         capacity = max(2 * -(-rows // n), 1)
 
     dest = idx % n  # floor-mod: negative ids still land in [0, n)
+    if valid is not None:
+        dest = jnp.where(valid, dest, n)  # ghost bucket: sorts last, never scatters
     order = jnp.argsort(dest, stable=True)
     sorted_dest = dest[order]
-    counts = jax.ops.segment_sum(jnp.ones((rows,), jnp.int32), sorted_dest, n)
+    counts = jax.ops.segment_sum(jnp.ones((rows,), jnp.int32), sorted_dest, n + 1)[:n]
     starts = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]])
-    slot = jnp.arange(rows, dtype=jnp.int32) - starts[sorted_dest]
+    slot = jnp.arange(rows, dtype=jnp.int32) - starts[jnp.minimum(sorted_dest, n - 1)]
 
-    in_range = slot < capacity
+    in_range = (slot < capacity) & (sorted_dest < n)
     flat = jnp.where(in_range, sorted_dest * capacity + slot, n * capacity)  # OOB -> drop
 
     def scatter(values: Array, fill) -> Array:
@@ -199,6 +245,7 @@ def sharded_retrieval_sums(
     target: Array,
     axis_name: str,
     capacity: Optional[int] = None,
+    valid: Optional[Array] = None,
 ) -> Tuple[Array, Array, Array]:
     """Exact global (mean, empty-query flag, dropped-row count) for a
     ``RetrievalMetric`` over epoch rows sharded along ``axis_name``.
@@ -206,9 +253,12 @@ def sharded_retrieval_sums(
     ``metric`` provides config (grouped kernel, policy, ``exclude``); its
     accumulated state is NOT read. Each shard scores only the queries routed
     to it, then one psum combines the partial sums — per-device memory is
-    O(local rows), never O(dataset).
+    O(local rows), never O(dataset). ``valid`` excludes rows before routing
+    (padded-buffer ghost rows).
     """
-    g_idx, g_preds, g_target, pad, dropped = regroup_by_query(idx, preds, target, axis_name, capacity)
+    g_idx, g_preds, g_target, pad, dropped = regroup_by_query(
+        idx, preds, target, axis_name, capacity, valid=valid
+    )
     total, count, flag = metric._device_sums(g_idx, g_preds, g_target, pad=pad)
     total = jax.lax.psum(total, axis_name)
     count = jax.lax.psum(count, axis_name)

@@ -97,6 +97,15 @@ def merge_values(fx: ReduceFx, acc: Any, delta: Any) -> Any:
 
         return buffer_merge(acc, delta)
     if isinstance(acc, list):
+        if isinstance(delta, PaddedBuffer):
+            # the delta update lazily promoted this cat state to a buffer
+            # (capacity metric, first batch); an empty list accumulator is
+            # absorbed, a non-empty one cannot merge into fixed capacity
+            if acc:
+                raise ValueError(
+                    "Cannot merge a PaddedBuffer delta into a non-empty eager list state."
+                )
+            return delta
         return acc + list(delta)
     if fx == "sum":
         return acc + delta

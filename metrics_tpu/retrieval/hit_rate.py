@@ -29,6 +29,8 @@ class RetrievalHitRate(RetrievalMetric):
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
         dist_sync_fn: Optional[Callable] = None,
+        capacity: Optional[int] = None,
+        jit: Optional[bool] = None,
     ):
         super().__init__(
             query_without_relevant_docs=query_without_relevant_docs,
@@ -37,6 +39,8 @@ class RetrievalHitRate(RetrievalMetric):
             dist_sync_on_step=dist_sync_on_step,
             process_group=process_group,
             dist_sync_fn=dist_sync_fn,
+            capacity=capacity,
+            jit=jit,
         )
         self.k = _validate_k(k)
 

@@ -34,6 +34,8 @@ class RetrievalRecall(RetrievalMetric):
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
         dist_sync_fn: Optional[Callable] = None,
+        capacity: Optional[int] = None,
+        jit: Optional[bool] = None,
     ):
         super().__init__(
             query_without_relevant_docs=query_without_relevant_docs,
@@ -42,6 +44,8 @@ class RetrievalRecall(RetrievalMetric):
             dist_sync_on_step=dist_sync_on_step,
             process_group=process_group,
             dist_sync_fn=dist_sync_fn,
+            capacity=capacity,
+            jit=jit,
         )
         self.k = _validate_k(k)
 

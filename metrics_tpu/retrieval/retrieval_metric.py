@@ -48,6 +48,14 @@ class RetrievalMetric(Metric, ABC):
         query_without_relevant_docs: policy for queries with no positive
             target: 'skip' (default) | 'error' | 'pos' (count 1.0) | 'neg' (0.0).
         exclude: target value marking rows to ignore (default -100).
+        capacity: fixed row capacity for the epoch cat-states; makes them
+            jit-safe PaddedBuffers. Place the states with
+            ``metrics_tpu.parallel.row_sharded(mesh)`` and ``compute()``
+            dispatches the exact sharded ``all_to_all`` engine
+            (``parallel/sharded_epoch.py``) — O(capacity/n) per-device
+            memory. ``regroup_capacity`` (settable attribute, default
+            auto) bounds the per-destination routing buckets; a skewed
+            query-id distribution that overflows them raises loudly.
     """
 
     def __init__(
@@ -58,13 +66,18 @@ class RetrievalMetric(Metric, ABC):
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
         dist_sync_fn: Optional[Callable] = None,
+        capacity: Optional[int] = None,
+        jit: Optional[bool] = None,
     ):
         super().__init__(
             compute_on_step=compute_on_step,
             dist_sync_on_step=dist_sync_on_step,
             process_group=process_group,
             dist_sync_fn=dist_sync_fn,
+            capacity=capacity,
+            jit=jit,
         )
+        self.regroup_capacity: Optional[int] = None
 
         query_without_relevant_docs_options = ("error", "skip", "pos", "neg")
         if query_without_relevant_docs not in query_without_relevant_docs_options:
@@ -88,7 +101,17 @@ class RetrievalMetric(Metric, ABC):
         self._append("preds", jnp.asarray(preds, dtype=jnp.float32).reshape(-1))
         self._append("target", jnp.asarray(target, dtype=jnp.int32).reshape(-1))
 
+    def _states_own_sync(self) -> bool:
+        from metrics_tpu.parallel.sharded_dispatch import retrieval_applicable
+
+        return retrieval_applicable(self) is not None
+
     def compute(self) -> Array:
+        from metrics_tpu.parallel.sharded_dispatch import retrieval_sharded
+
+        sharded = retrieval_sharded(self)  # row-sharded epoch states
+        if sharded is not None:
+            return sharded
         idx = as_values(self.idx)
         preds = as_values(self.preds)
         target = as_values(self.target)

@@ -16,7 +16,9 @@ from sklearn.metrics import roc_auc_score as sk_roc_auc_score
 from metrics_tpu.parallel import (
     regroup_by_query,
     sharded_auroc,
+    sharded_auroc_matrix,
     sharded_average_precision,
+    sharded_average_precision_matrix,
     sharded_retrieval_sums,
 )
 
@@ -29,10 +31,10 @@ def mesh(eight_devices):
 
 
 def _shard_map(mesh, fn, n_in, out_specs=P()):
+    # check_vma deliberately LEFT ON (the default): the ring/regroup
+    # collectives satisfy JAX's varying-manual-axes verification
     return jax.jit(
-        jax.shard_map(
-            fn, mesh=mesh, in_specs=(P("dp"),) * n_in, out_specs=out_specs, check_vma=False
-        )
+        jax.shard_map(fn, mesh=mesh, in_specs=(P("dp"),) * n_in, out_specs=out_specs)
     )
 
 
@@ -93,6 +95,56 @@ def test_sharded_average_precision_exact(mesh, ties):
     np.testing.assert_allclose(
         got, float(binary_average_precision_static(jnp.asarray(preds), jnp.asarray(target))), atol=1e-6
     )
+
+
+@pytest.mark.parametrize("ties", [False, True])
+def test_sharded_auroc_matrix_per_class_exact(mesh, ties):
+    """Matrix mode: per-class one-vs-rest scores vs sklearn on 8 shards."""
+    rng = np.random.RandomState(67)
+    C = 6
+    preds = rng.rand(N, C).astype(np.float32)
+    if ties:
+        preds = np.round(preds, 1)
+    labels = rng.randint(0, C, N)
+    onehot = (labels[:, None] == np.arange(C)).astype(np.int32)
+
+    f = _shard_map(mesh, lambda p, t: sharded_auroc_matrix(p, t, "dp"), 2)
+    got = np.asarray(f(jnp.asarray(preds), jnp.asarray(onehot)))
+    want = [sk_roc_auc_score(onehot[:, c], preds[:, c]) for c in range(C)]
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+    # per-row weights broadcast over classes; zero weight neutralizes a row
+    w = rng.rand(N).astype(np.float32)
+    w[::5] = 0.0
+    fw = _shard_map(mesh, lambda p, t, ww: sharded_auroc_matrix(p, t, "dp", ww), 3)
+    gotw = np.asarray(fw(jnp.asarray(preds), jnp.asarray(onehot), jnp.asarray(w)))
+    keep = w > 0
+    wantw = [
+        sk_roc_auc_score(onehot[keep, c], preds[keep, c], sample_weight=w[keep]) for c in range(C)
+    ]
+    np.testing.assert_allclose(gotw, wantw, rtol=1e-5)
+
+
+def test_sharded_auroc_matrix_absent_class_nan(mesh):
+    rng = np.random.RandomState(71)
+    preds = rng.rand(N, 3).astype(np.float32)
+    onehot = np.zeros((N, 3), dtype=np.int32)
+    onehot[:, 0] = (rng.rand(N) > 0.5).astype(np.int32)  # class 1, 2 absent
+    f = _shard_map(mesh, lambda p, t: sharded_auroc_matrix(p, t, "dp"), 2)
+    got = np.asarray(f(jnp.asarray(preds), jnp.asarray(onehot)))
+    assert not np.isnan(got[0]) and np.isnan(got[1]) and np.isnan(got[2])
+
+
+def test_sharded_average_precision_matrix_exact(mesh):
+    rng = np.random.RandomState(73)
+    C = 4
+    preds = np.round(rng.rand(N, C), 1).astype(np.float32)
+    labels = rng.randint(0, C, N)
+    onehot = (labels[:, None] == np.arange(C)).astype(np.int32)
+    f = _shard_map(mesh, lambda p, t: sharded_average_precision_matrix(p, t, "dp"), 2)
+    got = np.asarray(f(jnp.asarray(preds), jnp.asarray(onehot)))
+    want = [sk_average_precision(onehot[:, c], preds[:, c]) for c in range(C)]
+    np.testing.assert_allclose(got, want, atol=1e-5)
 
 
 def test_regroup_by_query_routes_and_pads(mesh):

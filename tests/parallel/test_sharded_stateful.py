@@ -1,0 +1,259 @@
+"""Sharded epoch compute through the STATEFUL API (the front door).
+
+The reference gives every metric one distributed interface — ``compute()``
+syncs transparently (reference torchmetrics/metric.py:179-197) but always by
+materializing the gathered epoch per rank. Here the same interface, with the
+states row-sharded over a mesh axis (``parallel.row_sharded``), dispatches the
+exact ring / ``all_to_all`` engine instead: every test drives plain
+``update()/compute()`` — no user ``shard_map`` — and asserts sklearn-exact
+results while the gather path is POISONED (any epoch materialization fails
+the test).
+"""
+import contextlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+from sklearn.metrics import average_precision_score as sk_ap
+from sklearn.metrics import roc_auc_score as sk_auroc
+
+import metrics_tpu.parallel.buffer as buffer_mod
+from metrics_tpu import AUROC, AveragePrecision
+from metrics_tpu.parallel import row_sharded
+from metrics_tpu.retrieval import RetrievalMAP, RetrievalMRR
+
+
+@pytest.fixture()
+def mesh(eight_devices):
+    return Mesh(np.array(eight_devices), ("dp",))
+
+
+@contextlib.contextmanager
+def no_materialization(monkeypatch):
+    """Fail the test if compute() touches any full-epoch gather primitive."""
+
+    def boom(*_a, **_k):
+        raise AssertionError("sharded compute materialized the epoch")
+
+    monkeypatch.setattr(buffer_mod, "buffer_values", boom)
+    monkeypatch.setattr(buffer_mod, "buffer_all_gather", boom)
+    yield
+
+
+def _batches(rng, steps, batch, ties=True):
+    for _ in range(steps):
+        p = rng.rand(batch).astype(np.float32)
+        if ties:
+            p = np.round(p, 1)  # heavy cross-shard ties
+        t = (rng.rand(batch) > 0.5).astype(np.int32)
+        yield p, t
+
+
+def test_stateful_sharded_binary_auroc(mesh, monkeypatch):
+    rng = np.random.RandomState(11)
+    metric = AUROC(pos_label=1, capacity=1024)
+    metric.device_put(row_sharded(mesh, "dp"))
+
+    all_p, all_t = [], []
+    for p, t in _batches(rng, steps=8, batch=96):
+        all_p.append(p)
+        all_t.append(t)
+        metric.update(jnp.asarray(p), jnp.asarray(t))
+
+    # the epoch rows live sharded over dp (O(capacity/8) per device)
+    assert metric.preds.data.sharding.spec[0] == "dp"
+    with no_materialization(monkeypatch):
+        got = float(metric.compute())
+    want = sk_auroc(np.concatenate(all_t), np.concatenate(all_p))
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+    # reset preserves the sharded placement; a second epoch works
+    metric.reset()
+    p, t = next(_batches(rng, 1, 512))
+    metric.update(jnp.asarray(p), jnp.asarray(t))
+    assert metric.preds.data.sharding.spec[0] == "dp"
+    with no_materialization(monkeypatch):
+        np.testing.assert_allclose(float(metric.compute()), sk_auroc(t, p), atol=1e-6)
+
+
+@pytest.mark.parametrize("average", ["macro", "weighted", None])
+def test_stateful_sharded_multiclass_auroc(mesh, monkeypatch, average):
+    rng = np.random.RandomState(13)
+    num_classes = 5
+    metric = AUROC(num_classes=num_classes, average=average, capacity=1024)
+    metric.device_put(row_sharded(mesh, "dp"))
+
+    ps, ts = [], []
+    for _ in range(4):
+        logits = rng.rand(128, num_classes).astype(np.float32)
+        p = logits / logits.sum(-1, keepdims=True)
+        t = rng.randint(0, num_classes, 128).astype(np.int32)
+        ps.append(p)
+        ts.append(t)
+        metric.update(jnp.asarray(p), jnp.asarray(t))
+
+    with no_materialization(monkeypatch):
+        got = metric.compute()
+    p, t = np.concatenate(ps), np.concatenate(ts)
+    if average is None:
+        want = [sk_auroc((t == c).astype(int), p[:, c]) for c in range(num_classes)]
+        np.testing.assert_allclose([float(x) for x in got], want, atol=1e-5)
+    else:
+        want = sk_auroc(t, p, multi_class="ovr", average=average, labels=np.arange(num_classes))
+        np.testing.assert_allclose(float(got), want, atol=1e-5)
+
+
+@pytest.mark.parametrize("average", ["macro", "weighted", "micro"])
+def test_stateful_sharded_multilabel_auroc(mesh, monkeypatch, average):
+    rng = np.random.RandomState(17)
+    metric = AUROC(num_classes=3, average=average, capacity=512)
+    metric.device_put(row_sharded(mesh, "dp"))
+    p = rng.rand(384, 3).astype(np.float32)
+    t = (rng.rand(384, 3) > 0.5).astype(np.int32)
+    metric.update(jnp.asarray(p), jnp.asarray(t))
+    with no_materialization(monkeypatch):
+        got = float(metric.compute())
+    np.testing.assert_allclose(got, sk_auroc(t, p, average=average), atol=1e-5)
+
+
+def test_stateful_sharded_average_precision(mesh, monkeypatch):
+    rng = np.random.RandomState(19)
+    metric = AveragePrecision(pos_label=1, capacity=1024)
+    metric.device_put(row_sharded(mesh, "dp"))
+    all_p, all_t = [], []
+    for p, t in _batches(rng, steps=6, batch=128):
+        all_p.append(p)
+        all_t.append(t)
+        metric.update(jnp.asarray(p), jnp.asarray(t))
+    with no_materialization(monkeypatch):
+        got = float(metric.compute())
+    want = sk_ap(np.concatenate(all_t), np.concatenate(all_p))
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_stateful_sharded_multiclass_average_precision(mesh, monkeypatch):
+    rng = np.random.RandomState(23)
+    num_classes = 4
+    metric = AveragePrecision(num_classes=num_classes, capacity=512)
+    metric.device_put(row_sharded(mesh, "dp"))
+    logits = rng.rand(256, num_classes).astype(np.float32)
+    p = logits / logits.sum(-1, keepdims=True)
+    t = rng.randint(0, num_classes, 256).astype(np.int32)
+    metric.update(jnp.asarray(p), jnp.asarray(t))
+    with no_materialization(monkeypatch):
+        got = [float(x) for x in metric.compute()]
+    want = [sk_ap((t == c).astype(int), p[:, c]) for c in range(num_classes)]
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_stateful_sharded_retrieval_map(mesh, monkeypatch):
+    rng = np.random.RandomState(29)
+    metric = RetrievalMAP(capacity=1024)
+    metric.device_put(row_sharded(mesh, "dp"))
+    oracle = RetrievalMAP()
+    for _ in range(4):
+        i = rng.randint(0, 41, 128).astype(np.int32)
+        p = rng.rand(128).astype(np.float32)
+        t = (rng.rand(128) > 0.6).astype(np.int32)
+        metric.update(jnp.asarray(i), jnp.asarray(p), jnp.asarray(t))
+        oracle.update(jnp.asarray(i), jnp.asarray(p), jnp.asarray(t))
+    with no_materialization(monkeypatch):
+        got = float(metric.compute())
+    np.testing.assert_allclose(got, float(oracle.compute()), atol=1e-6)
+
+
+def test_stateful_sharded_retrieval_policies(mesh, monkeypatch):
+    rng = np.random.RandomState(31)
+    for policy in ("skip", "neg", "pos"):
+        metric = RetrievalMRR(query_without_relevant_docs=policy, capacity=512)
+        metric.device_put(row_sharded(mesh, "dp"))
+        oracle = RetrievalMRR(query_without_relevant_docs=policy)
+        i = rng.randint(0, 29, 512).astype(np.int32)
+        p = rng.rand(512).astype(np.float32)
+        t = (rng.rand(512) > 0.5).astype(np.int32)
+        t[i % 7 == 0] = 0  # some all-negative queries
+        metric.update(jnp.asarray(i), jnp.asarray(p), jnp.asarray(t))
+        oracle.update(jnp.asarray(i), jnp.asarray(p), jnp.asarray(t))
+        with no_materialization(monkeypatch):
+            got = float(metric.compute())
+        np.testing.assert_allclose(got, float(oracle.compute()), atol=1e-6, err_msg=policy)
+
+
+def test_stateful_sharded_matches_unsharded(mesh):
+    """The same capacity metric computes the same value sharded or not."""
+    rng = np.random.RandomState(37)
+    p = np.round(rng.rand(768), 1).astype(np.float32)
+    t = (rng.rand(768) > 0.5).astype(np.int32)
+
+    plain = AUROC(pos_label=1, capacity=1024)
+    plain.update(jnp.asarray(p), jnp.asarray(t))
+
+    sharded = AUROC(pos_label=1, capacity=1024)
+    sharded.device_put(row_sharded(mesh, "dp"))
+    sharded.update(jnp.asarray(p), jnp.asarray(t))
+
+    np.testing.assert_allclose(float(plain.compute()), float(sharded.compute()), atol=1e-6)
+
+
+def test_stateful_sharded_overflow_raises(mesh):
+    metric = AUROC(pos_label=1, capacity=64)
+    metric.device_put(row_sharded(mesh, "dp"))
+    rng = np.random.RandomState(41)
+    metric.update(jnp.asarray(rng.rand(96).astype(np.float32)),
+                  jnp.asarray((rng.rand(96) > 0.5).astype(np.int32)))
+    with pytest.raises(RuntimeError, match="overflow"):
+        metric.compute()
+
+
+def test_stateful_sharded_binary_column_preds(mesh, monkeypatch):
+    """Binary preds stored as (rows, 1) — the layout the gather path squeezes
+    (functional/classification/auroc.py:172-173) — works sharded too."""
+    rng = np.random.RandomState(43)
+    metric = AUROC(pos_label=1, capacity=512)
+    metric.device_put(row_sharded(mesh, "dp"))
+    p = np.round(rng.rand(256, 1), 1).astype(np.float32)
+    t = (rng.rand(256) > 0.5).astype(np.int32)
+    metric.update(jnp.asarray(p), jnp.asarray(t))
+    with no_materialization(monkeypatch):
+        got = float(metric.compute())
+    np.testing.assert_allclose(got, sk_auroc(t, p[:, 0]), atol=1e-6)
+
+
+def test_failed_placement_does_not_half_promote(mesh):
+    """A placement error during lazy promotion must leave the cat states
+    un-promoted and in lockstep (retrying with a fixed placement works)."""
+    metric = AUROC(pos_label=1, capacity=100)  # 100 % 8 != 0
+    metric.device_put(row_sharded(mesh, "dp"))  # states still lists: no error yet
+    p = jnp.asarray(np.random.RandomState(0).rand(8).astype(np.float32))
+    t = jnp.asarray(np.array([0, 1] * 4, dtype=np.int32))
+    with pytest.raises(ValueError, match="divisible"):
+        metric.update(p, t)
+    assert isinstance(metric._defaults["preds"], list)  # promotion not committed
+    assert metric.preds == [] and metric.target == []   # lockstep preserved
+    # a corrected (replicated) placement lets the same metric proceed
+    metric.device_put(jax.devices()[0])
+    metric.update(p, t)
+    np.testing.assert_allclose(float(metric.compute()), sk_auroc(np.asarray(t), np.asarray(p)), atol=1e-6)
+
+
+def test_row_sharded_requires_divisible_capacity(mesh):
+    metric = AUROC(pos_label=1, capacity=100)  # 100 % 8 != 0
+    metric.update(jnp.asarray(np.random.rand(8).astype(np.float32)),
+                  jnp.asarray(np.array([0, 1] * 4, dtype=np.int32)))
+    with pytest.raises(ValueError, match="divisible"):
+        metric.device_put(row_sharded(mesh, "dp"))
+
+
+def test_regroup_overflow_raises_with_knob(mesh):
+    """Skewed query ids overflowing the routing buckets raise with the fix."""
+    metric = RetrievalMRR(capacity=512)
+    metric.regroup_capacity = 4
+    metric.device_put(row_sharded(mesh, "dp"))
+    i = np.zeros(512, dtype=np.int32)  # every row routes to shard 0
+    p = np.linspace(0, 1, 512, dtype=np.float32)
+    t = np.ones(512, dtype=np.int32)
+    metric.update(jnp.asarray(i), jnp.asarray(p), jnp.asarray(t))
+    with pytest.raises(RuntimeError, match="regroup_capacity"):
+        metric.compute()
