@@ -1,0 +1,49 @@
+"""Capacity-backed curve-vector compute for ``ROC`` / ``PrecisionRecallCurve``.
+
+The reference computes curve vectors with data-dependent output shapes
+(reference functional/classification/precision_recall_curve.py:114-160 /
+roc.py:35-85) — host-bound extraction that XLA cannot stage, and through a
+remote-device tunnel a single readback degrades every later dispatch. When
+the metric was built with a ``capacity`` (PaddedBuffer states), compute
+routes here instead: the static-shape padded kernels
+(``functional/classification/curve_static.py``) run as ONE jitted dispatch
+with zero readbacks, returning capacity-length curves plus a valid count.
+"""
+from typing import Any, Dict, Optional
+
+import jax
+
+from metrics_tpu.functional.classification.curve_static import (
+    precision_recall_curve_padded,
+    roc_padded,
+)
+from metrics_tpu.parallel.buffer import PaddedBuffer, buffer_mask
+
+_KERNELS = {"roc": roc_padded, "prc": precision_recall_curve_padded}
+# one jitted callable per kernel, shared across instances (jax.jit caches
+# by abstract signature, so shapes/pos_label variants coexist under it)
+_JITTED: Dict[str, Any] = {}
+
+
+def padded_curve_compute(metric: Any, kind: str) -> Optional[tuple]:
+    """Static-shape curve compute when the epoch states are PaddedBuffers;
+    ``None`` -> caller keeps the reference-shaped dynamic path."""
+    if not isinstance(metric.preds, PaddedBuffer):
+        return None
+    from metrics_tpu.parallel.sharded_dispatch import _check_counts
+
+    _check_counts(metric, metric.preds, metric.target)
+
+    fn = _JITTED.get(kind)
+    if fn is None:
+        fn = jax.jit(_KERNELS[kind], static_argnames=("pos_label",))
+        _JITTED[kind] = fn
+
+    pos_label = metric.pos_label if metric.pos_label is not None else 1
+    return fn(
+        metric.preds.data,
+        metric.target.data,
+        None,
+        pos_label=int(pos_label),
+        row_mask=buffer_mask(metric.preds),
+    )

@@ -16,6 +16,18 @@ from metrics_tpu.utils.prints import rank_zero_warn
 class PrecisionRecallCurve(Metric):
     """Precision-recall pairs at every distinct threshold, over all data seen.
 
+    With a ``capacity``, the epoch states are jit-safe PaddedBuffers and
+    ``compute()`` returns STATIC-shape padded curves — ``(precision, recall,
+    thresholds, count)`` where ``count`` thresholds are valid,
+    ``precision``/``recall`` hold ``count + 1`` points (endpoint included),
+    and tails repeat the final entries (multiclass/multilabel: leading class
+    axis, per-class counts). The curve extraction is ONE fused device
+    dispatch with no data readbacks (only the epoch-end scalar overflow
+    check reads the row counts); the underlying kernels
+    (``functional.classification.curve_static``) are fully jit/vmap-safe
+    for in-jit use. Without ``capacity`` the reference-shaped dynamic
+    3-tuple is returned unchanged.
+
     Example (binary):
         >>> import jax.numpy as jnp
         >>> pred = jnp.array([0, 1, 2, 3])
@@ -38,12 +50,16 @@ class PrecisionRecallCurve(Metric):
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
         dist_sync_fn: Optional[Callable] = None,
+        capacity: Optional[int] = None,
+        jit: Optional[bool] = None,
     ):
         super().__init__(
             compute_on_step=compute_on_step,
             dist_sync_on_step=dist_sync_on_step,
             process_group=process_group,
             dist_sync_fn=dist_sync_fn,
+            capacity=capacity,
+            jit=jit,
         )
 
         self.num_classes = num_classes
@@ -67,6 +83,11 @@ class PrecisionRecallCurve(Metric):
         self.pos_label = pos_label
 
     def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+        from metrics_tpu.classification._padded_curves import padded_curve_compute
+
+        padded = padded_curve_compute(self, "prc")  # capacity-backed: static shapes
+        if padded is not None:
+            return padded
         preds = as_values(self.preds)
         target = as_values(self.target)
         return _precision_recall_curve_compute(preds, target, self.num_classes, self.pos_label)

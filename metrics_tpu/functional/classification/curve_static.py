@@ -30,29 +30,44 @@ from jax import Array
 def _run_end(values: Array, valid: Array) -> Array:
     """Snap each position to ``values`` at the next valid index (suffix min).
 
-    ``values`` must be nondecreasing; the last position must be valid.
+    ``values`` must be nondecreasing. Positions after the last valid index
+    snap to the global total (``values[-1]``), which is also the correct
+    run-end when trailing positions are masked-out ghost rows (their zero
+    weight leaves the cumulative sum at the total).
     """
     masked = jnp.where(valid, values, jnp.inf)
-    return jnp.flip(jnp.minimum.accumulate(jnp.flip(masked, -1), axis=-1), -1)
+    snapped = jnp.flip(jnp.minimum.accumulate(jnp.flip(masked, -1), axis=-1), -1)
+    return jnp.minimum(snapped, values[-1])
 
 
-def _sorted_counts(preds: Array, target: Array, weights: Array = None) -> Tuple[Array, Array, Array]:
+def _sorted_counts(
+    preds: Array, target: Array, weights: Array = None, row_mask: Array = None
+) -> Tuple[Array, Array, Array, Array]:
     """Descending-score cumulative (tps, fps) snapped to tie-run ends.
 
-    Returns ``(tps, fps, valid)`` of shape ``(N,)`` — every index holds its
-    run-final counts; ``valid`` marks the run-final (distinct-threshold)
-    points for callers that need them.
+    Returns ``(tps, fps, scores, valid)`` of shape ``(N,)`` — every index
+    holds its run-final counts; ``valid`` marks the run-final
+    (distinct-threshold) points for callers that need them. ``row_mask``
+    excludes ghost rows entirely (capacity-padded buffers): they sort last
+    at ``-inf`` with zero weight and are never run-final. (A real row
+    scoring exactly ``-inf`` would merge into the ghost run — don't.)
     """
+    if row_mask is not None:
+        preds = jnp.where(row_mask, preds, -jnp.inf)
     order = jnp.argsort(-preds)
     scores = preds[order]
     y = target[order].astype(jnp.float32)
     w = jnp.ones_like(y) if weights is None else weights[order].astype(jnp.float32)
+    if row_mask is not None:
+        w = w * row_mask[order].astype(jnp.float32)
 
     tps = jnp.cumsum(y * w)
     fps = jnp.cumsum((1.0 - y) * w)
     # run-final = last index of a tie-run (next score differs; sentinel: last)
     valid = jnp.concatenate([scores[1:] != scores[:-1], jnp.ones((1,), dtype=bool)])
-    return _run_end(tps, valid), _run_end(fps, valid), valid
+    if row_mask is not None:
+        valid = valid & (scores != -jnp.inf)
+    return _run_end(tps, valid), _run_end(fps, valid), scores, valid
 
 
 def binary_auroc_static(preds: Array, target: Array, sample_weights: Array = None) -> Array:
@@ -63,7 +78,7 @@ def binary_auroc_static(preds: Array, target: Array, sample_weights: Array = Non
     all-negative targets give ``nan`` (the eager exact path raises instead —
     value checks cannot run under jit).
     """
-    tps, fps, _ = _sorted_counts(preds, target, sample_weights)
+    tps, fps, _, _ = _sorted_counts(preds, target, sample_weights)
     pos = tps[-1]
     neg = fps[-1]
     tpr = jnp.concatenate([jnp.zeros((1,)), tps]) / jnp.where(pos == 0, jnp.nan, pos)
@@ -79,10 +94,157 @@ def binary_average_precision_static(preds: Array, target: Array, sample_weights:
     ``AP = sum_n (R_n - R_{n-1}) * P_n`` over distinct-threshold points.
     Zero positives gives ``nan``.
     """
-    tps, fps, _ = _sorted_counts(preds, target, sample_weights)
+    tps, fps, _, _ = _sorted_counts(preds, target, sample_weights)
     pos = tps[-1]
     precision = tps / jnp.maximum(tps + fps, 1e-38)
     recall = tps / jnp.where(pos == 0, jnp.nan, pos)
     # duplicated (snapped) points have zero recall-diff -> contribute nothing
     prev_recall = jnp.concatenate([jnp.zeros((1,)), recall[:-1]])
     return jnp.sum((recall - prev_recall) * precision)
+
+
+# ----------------------------------------------------- padded curve VECTORS
+# The same run-end-snapping trick, extended from scalar summaries to the
+# curve vectors themselves: outputs keep a STATIC capacity-length shape with
+# the distinct-threshold points compacted to the front and a valid ``count``
+# alongside (tail entries repeat the final point, so integrals and plots of
+# the full padded arrays are unchanged). This is what makes
+# ``ROC.compute()`` / ``PrecisionRecallCurve.compute()`` jit-safe with zero
+# readbacks — the reference's dynamic-shape extraction
+# (reference functional/classification/precision_recall_curve.py:114-160)
+# cannot be staged by XLA at all.
+
+
+def _compact(values: Array, valid: Array, count: Array) -> Array:
+    """Scatter the ``valid`` entries to the front (stable); the tail repeats
+    the last valid entry."""
+    n = values.shape[0]
+    pos = jnp.where(valid, jnp.cumsum(valid) - 1, n)
+    out = jnp.zeros_like(values).at[pos].set(values, mode="drop")
+    last = out[jnp.maximum(count - 1, 0)]
+    return jnp.where(jnp.arange(n) < count, out, last)
+
+
+def binary_clf_curve_padded(
+    preds: Array,
+    target: Array,
+    sample_weights: Array = None,
+    pos_label=1.0,
+    row_mask: Array = None,
+) -> Tuple[Array, Array, Array, Array]:
+    """The reference ``_binary_clf_curve`` contract with static shapes.
+
+    Returns ``(fps, tps, thresholds, count)``: arrays of fixed length N with
+    the distinct-threshold points (descending score) in the first ``count``
+    positions and the final point repeated after; ``count`` is a traced
+    int32 scalar. ``row_mask`` excludes capacity-padding ghost rows.
+    """
+    y = (target == pos_label).astype(jnp.int32)
+    tps, fps, scores, valid = _sorted_counts(preds, y, sample_weights, row_mask)
+    count = jnp.sum(valid.astype(jnp.int32))
+    return (
+        _compact(fps, valid, count),
+        _compact(tps, valid, count),
+        _compact(scores, valid, count),
+        count,
+    )
+
+
+def binary_roc_padded(
+    preds: Array,
+    target: Array,
+    sample_weights: Array = None,
+    pos_label=1.0,
+    row_mask: Array = None,
+) -> Tuple[Array, Array, Array, Array]:
+    """Static-shape exact ROC curve (jit/vmap-safe).
+
+    Returns ``(fpr, tpr, thresholds, count)`` of fixed length N+1 — the
+    reference binary ``_roc_compute`` (roc.py:35-52) including the prepended
+    (0, 0) start point; the first ``count`` positions are the curve, the
+    tail repeats (1, 1). Degenerate targets yield ``nan`` rates instead of
+    raising (value checks cannot run under jit).
+    """
+    fps, tps, thresholds, count = binary_clf_curve_padded(
+        preds, target, sample_weights, pos_label, row_mask
+    )
+    pos = tps[-1]
+    neg = fps[-1]
+    tpr = jnp.concatenate([jnp.zeros((1,)), tps]) / jnp.where(pos == 0, jnp.nan, pos)
+    fpr = jnp.concatenate([jnp.zeros((1,)), fps]) / jnp.where(neg == 0, jnp.nan, neg)
+    thresholds = jnp.concatenate([thresholds[:1] + 1, thresholds])
+    return fpr, tpr, thresholds, count + 1
+
+
+def binary_precision_recall_curve_padded(
+    preds: Array,
+    target: Array,
+    sample_weights: Array = None,
+    pos_label=1.0,
+    row_mask: Array = None,
+) -> Tuple[Array, Array, Array, Array]:
+    """Static-shape exact precision-recall curve (jit/vmap-safe).
+
+    Returns ``(precision, recall, thresholds, count)`` matching the
+    reference binary ``_precision_recall_curve_compute``
+    (precision_recall_curve.py:114-133): reversed (recall decreasing),
+    truncated at full recall, with the (1, 0) endpoint appended. ``count``
+    is the number of thresholds kept; ``precision``/``recall`` (length N+1)
+    hold ``count + 1`` valid points, ``thresholds`` (length N) holds
+    ``count``; tails repeat the final entries.
+    """
+    fps, tps, th_fw, n_distinct = binary_clf_curve_padded(
+        preds, target, sample_weights, pos_label, row_mask
+    )
+    total = tps[-1]
+    precision_fw = tps / jnp.maximum(tps + fps, 1e-38)
+    recall_fw = tps / jnp.where(total == 0, jnp.nan, total)
+
+    # stop once full recall is attained (first index reaching the total)
+    last_ind = jnp.argmax(tps >= total)
+    n_th = jnp.minimum(last_ind + 1, n_distinct).astype(jnp.int32)
+
+    n = tps.shape[0]
+    j = n_th - 1 - jnp.arange(n + 1)  # reversal; j < 0 -> appended endpoint/pad
+    jc = jnp.clip(j, 0, n - 1)
+    precision = jnp.where(j >= 0, precision_fw[jc], 1.0)
+    recall = jnp.where(j >= 0, recall_fw[jc], 0.0)
+    thresholds = th_fw[jnp.clip(n_th - 1 - jnp.arange(n), 0, n - 1)]
+    return precision, recall, thresholds, n_th
+
+
+def _per_class_padded(kernel, preds, target, sample_weights=None, row_mask=None):
+    """vmap a padded binary curve kernel over classes.
+
+    Multiclass layout (labels target): class c vs rest via ``pos_label=c``;
+    multilabel layout (same-shape target): per column against positives == 1.
+    Outputs gain a leading class axis; counts are per class.
+    """
+    import jax
+
+    num_classes = preds.shape[1]
+    if preds.shape == target.shape:  # multilabel
+        return jax.vmap(
+            lambda p, t: kernel(p, t, sample_weights, 1.0, row_mask), in_axes=(1, 1)
+        )(preds, target)
+    return jax.vmap(
+        lambda p, c: kernel(p, target, sample_weights, c, row_mask), in_axes=(1, 0)
+    )(preds, jnp.arange(num_classes))
+
+
+def roc_padded(preds, target, sample_weights=None, pos_label=1.0, row_mask=None):
+    """Static-shape exact ROC: binary for 1-D preds, per-class stacked
+    ``(C, N+1)`` curves (+ ``(C,)`` counts) for 2-D preds."""
+    if preds.ndim == 1:
+        return binary_roc_padded(preds, target, sample_weights, pos_label, row_mask)
+    return _per_class_padded(binary_roc_padded, preds, target, sample_weights, row_mask)
+
+
+def precision_recall_curve_padded(preds, target, sample_weights=None, pos_label=1.0, row_mask=None):
+    """Static-shape exact PR curve: binary for 1-D preds, per-class stacked
+    for 2-D preds (see ``binary_precision_recall_curve_padded``)."""
+    if preds.ndim == 1:
+        return binary_precision_recall_curve_padded(preds, target, sample_weights, pos_label, row_mask)
+    return _per_class_padded(
+        binary_precision_recall_curve_padded, preds, target, sample_weights, row_mask
+    )

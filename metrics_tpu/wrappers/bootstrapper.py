@@ -6,12 +6,25 @@ resample of every batch. Resample indices come from a host-side seeded
 generator (cheap host ints; the gathers run on device), so runs are
 reproducible via ``seed`` and no device randomness threads through the
 metric API.
-"""
-from typing import Any, Callable, Dict, Optional
 
+TPU-native design: the copies are not ``num_bootstraps`` stateful child
+metrics but ONE stacked state pytree with a leading bootstrap axis. All
+resample index matrices are drawn at once (``(K, n)``) and a single jitted
+program vmaps the base update over the bootstrap axis, merges the stacked
+delta into the stacked accumulator, and (under ``compute_on_step``) vmaps
+the batch value — one device dispatch per step regardless of ``K``, where a
+per-copy loop pays K dispatches (10-20 per step through a device tunnel at
+the default K=10). Base metrics whose update cannot trace (data-dependent
+mode inference) and multi-process host-plane deployments fall back to real
+per-copy child metrics with identical seeded draws.
+"""
+import threading
+from copy import deepcopy
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
 import jax.numpy as jnp
 import numpy as np
-from copy import deepcopy
 from jax import Array
 
 from metrics_tpu.core.metric import Metric
@@ -57,77 +70,236 @@ class BootStrapper(Metric):
             raise ValueError(
                 f"`num_bootstraps` must be an integer >= 2 (the std needs two samples), got {num_bootstraps!r}"
             )
-        self.metrics = [deepcopy(base_metric) for _ in range(num_bootstraps)]
+        self._template = deepcopy(base_metric)  # detached config carrier
+        self._template.reset()
         self.num_bootstraps = num_bootstraps
         self.raw = raw
         self._resample_rng = np.random.RandomState(seed)
+        self._stacked = None  # (K, ...) state pytree, lazily initialized
+        self.metrics = None  # per-copy children, built only on the loop fallback
+        self._mode = None  # 'vmapped' | 'loop', decided at the first update
+        self._vsteps: Dict[Any, Callable] = {}
+        self._vcompute = None
+        self._step_lock = threading.Lock()
 
-    def update(self, *args: Any, **kwargs: Any) -> None:
-        """Update every copy with an independent with-replacement resample.
-
-        Resampling indexes the leading axis of every array argument and
-        kwarg (so preds/target stay paired)."""
-        arrays = [a for a in (*args, *kwargs.values()) if hasattr(a, "shape") and a.ndim >= 1]
+    # ----------------------------------------------------------- vmapped path
+    def _resample_plan(self, args: tuple, kwargs: dict) -> Tuple[Optional[int], tuple, tuple]:
+        """(n, per-arg resample flags, per-kwarg flags) — the OLD loop rule:
+        arrays whose leading axis matches the first array's are resampled."""
+        arrays = [a for a in (*args, *kwargs.values()) if hasattr(a, "shape") and getattr(a, "ndim", 0) >= 1]
         n = arrays[0].shape[0] if arrays else None
 
-        def resample(value: Any, idx: Array) -> Any:
-            if hasattr(value, "shape") and value.ndim >= 1 and value.shape[0] == n:
-                return value[idx]
-            return value
+        def flag(v: Any) -> bool:
+            return hasattr(v, "shape") and getattr(v, "ndim", 0) >= 1 and v.shape[0] == n
 
-        for metric in self.metrics:
-            if n is None:
+        return n, tuple(flag(a) for a in args), tuple((k, flag(v)) for k, v in sorted(kwargs.items()))
+
+    def _build_vstep(self, with_compute: bool, aflags: tuple, kwflags: tuple) -> Callable:
+        template = self._template
+        lock = self._step_lock
+        donate = (0,) if jax.default_backend() == "tpu" else ()
+
+        def step(stacked, idx_mat, args, kwargs):
+            def one(idx):
+                rs_args = tuple(a[idx] if f else a for a, f in zip(args, aflags))
+                rs_kw = {k: (kwargs[k][idx] if f else kwargs[k]) for k, f in kwflags}
+                with lock:
+                    return template._run_update_on_state(template.init_state(), *rs_args, **rs_kw)
+
+            deltas = jax.vmap(one)(idx_mat)
+            merged = jax.vmap(template.merge_states)(stacked, deltas)
+            if not with_compute:
+                return merged, ()
+            with lock:
+                values = jax.vmap(
+                    lambda s: jnp.asarray(template.compute_from_state(s), dtype=jnp.float32)
+                )(deltas)
+            return merged, self._stats(values)
+
+        return jax.jit(step, donate_argnums=donate)
+
+    def _stats(self, values: Array) -> Dict[str, Array]:
+        out = {"mean": jnp.mean(values, axis=0), "std": jnp.std(values, axis=0, ddof=1)}
+        if self.raw:
+            out["raw"] = values
+        return out
+
+    def _init_stacked(self):
+        base = self._template.init_state()
+        return jax.tree_util.tree_map(
+            lambda x: jnp.broadcast_to(x[None], (self.num_bootstraps, *x.shape)).copy()
+            if hasattr(x, "shape")
+            else x,
+            base,
+        )
+
+    def _run_vmapped(self, args: tuple, kwargs: dict, idx_mat: Array, with_compute: bool):
+        n, aflags, kwflags = self._resample_plan(args, kwargs)
+        key = (with_compute, aflags, kwflags)
+        fn = self._vsteps.get(key)
+        if fn is None:
+            fn = self._build_vstep(with_compute, aflags, kwflags)
+            self._vsteps[key] = fn
+        if self._stacked is None:
+            self._stacked = self._init_stacked()
+        merged, stats = fn(self._stacked, idx_mat, args, kwargs)
+        self._stacked = merged
+        return stats
+
+    # ------------------------------------------------------------- loop path
+    def _ensure_children(self) -> None:
+        if self.metrics is None:
+            self.metrics = [deepcopy(self._template) for _ in range(self.num_bootstraps)]
+
+    def _loop_update(self, args: tuple, kwargs: dict, idx_mat: Optional[Array]) -> None:
+        self._ensure_children()
+        n, aflags, kwflags = self._resample_plan(args, kwargs)
+        kwflag_map = dict(kwflags)
+        for k, metric in enumerate(self.metrics):
+            if idx_mat is None:
                 metric.update(*args, **kwargs)
                 continue
-            idx = jnp.asarray(self._resample_rng.randint(0, n, n))
+            idx = idx_mat[k]
             metric.update(
-                *(resample(a, idx) for a in args),
-                **{k: resample(v, idx) for k, v in kwargs.items()},
+                *(a[idx] if f else a for a, f in zip(args, aflags)),
+                **{key: (v[idx] if kwflag_map[key] else v) for key, v in kwargs.items()},
             )
 
-    def forward(self, *args: Any, **kwargs: Any) -> Optional[Dict[str, Array]]:
-        """Accumulate the batch into every copy; with ``compute_on_step``
-        return the batch-local mean/std (the base fused forward cannot be
-        used here: the bootstrap copies are child metrics, not registered
-        states). The batch-local pass replays the same resample draws the
-        accumulation consumed, so both see identical resamples."""
-        self._computed = None
-        rng_state = self._resample_rng.get_state()
-        self.update(*args, **kwargs)
-        if not self.compute_on_step:
+    # -------------------------------------------------------------- dispatch
+    def _draw(self, args: tuple, kwargs: dict) -> Optional[Array]:
+        n, _, _ = self._resample_plan(args, kwargs)
+        if n is None:
             return None
-        # batch-local pass under the reference forward discipline: no
-        # cross-process sync (unless dist_sync_on_step) and the overflow
-        # bound survives the temp reset (core/metric.py _forward_reference)
+        # one (K, n) draw == K sequential (n,) draws from the same stream:
+        # the loop fallback and the vmapped path see identical resamples
+        return jnp.asarray(self._resample_rng.randint(0, n, (self.num_bootstraps, n)))
+
+    def _decide_mode(self) -> None:
+        if self._mode is not None:
+            return
+        # multi-process host-plane deployments need per-copy children whose
+        # compute() syncs individually (the reference interface discipline);
+        # eager-list cat states cannot carry a bootstrap axis
+        if (
+            jax.process_count() > 1
+            or self.dist_sync_fn is not None
+            or any(isinstance(d, list) for d in self._template._defaults.values())
+        ):
+            self._mode = "loop"
+        else:
+            self._mode = "vmapped"
+
+    def _accumulate(self, args: tuple, kwargs: dict, with_compute: bool):
+        self._decide_mode()
+        idx_mat = self._draw(args, kwargs)
+        if self._mode == "vmapped":
+            safe_idx = idx_mat if idx_mat is not None else jnp.zeros((self.num_bootstraps, 0), jnp.int32)
+            try:
+                return self._run_vmapped(args, kwargs, safe_idx, with_compute)
+            except self._TRACER_ERRORS:
+                # base update needs concrete values -> permanent per-copy
+                # fallback, replaying the SAME drawn resamples. State already
+                # accumulated on the stacked path transfers to the children
+                # (copy k inherits stacked[name][k]) so no batch is lost.
+                self._mode = "loop"
+                if self._stacked is not None:
+                    self._ensure_children()
+                    for k, child in enumerate(self.metrics):
+                        child._set_state(
+                            {name: value[k] for name, value in self._stacked.items()}
+                        )
+                self._stacked = None
+                self._vsteps.clear()
+        self._loop_update(args, kwargs, idx_mat)
+        if not with_compute:
+            return ()
+        return self._loop_batch_value(args, kwargs, idx_mat)
+
+    def _loop_batch_value(self, args: tuple, kwargs: dict, idx_mat: Optional[Array]):
+        """Batch-local mean/std under the reference forward discipline: the
+        children's accumulated state is cached/restored around a replayed
+        batch-only pass (core/metric.py _forward_reference semantics)."""
         caches = [(m._current_state(), m._count_bound) for m in self.metrics]
         saved_sync = [(m._to_sync, m._in_forward) for m in self.metrics]
-        self._to_sync, self._in_forward = self.dist_sync_on_step, True
         for m in self.metrics:
             m._to_sync, m._in_forward = self.dist_sync_on_step, True
             m.reset()
-        self._resample_rng.set_state(rng_state)
         try:
-            self.update(*args, **kwargs)
-            value = self.compute()
+            self._loop_update(args, kwargs, idx_mat)
+            values = jnp.stack([jnp.asarray(m.compute(), dtype=jnp.float32) for m in self.metrics])
         finally:
             for m, (cache, bound), (to_sync, in_fwd) in zip(self.metrics, caches, saved_sync):
                 m._set_state(cache)
                 m._count_bound = bound
                 m._computed = None  # the batch-local compute cached batch values
                 m._to_sync, m._in_forward = to_sync, in_fwd
-            self._to_sync, self._in_forward = True, False
-            self._computed = None
-        self._forward_cache = value
-        return value
+        return self._stats(values)
+
+    # ------------------------------------------------------------ public API
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Accumulate an independent with-replacement resample per copy —
+        ONE device dispatch for all copies on the vmapped path."""
+        self._computed = None
+        self._accumulate(args, kwargs, with_compute=False)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Optional[Dict[str, Array]]:
+        """Accumulate the batch into every copy; with ``compute_on_step``
+        return the batch-local mean/std — update, merge, AND the per-copy
+        batch values in one jitted dispatch on the vmapped path."""
+        self._computed = None
+        stats = self._accumulate(args, kwargs, with_compute=self.compute_on_step)
+        if not self.compute_on_step:
+            return None
+        self._forward_cache = stats
+        return stats
 
     def compute(self) -> Dict[str, Array]:
-        values = jnp.stack([jnp.asarray(m.compute(), dtype=jnp.float32) for m in self.metrics])
-        out = {"mean": jnp.mean(values, axis=0), "std": jnp.std(values, axis=0, ddof=1)}
-        if self.raw:
-            out["raw"] = values
-        return out
+        if self._mode == "loop":
+            self._ensure_children()
+            values = jnp.stack([jnp.asarray(m.compute(), dtype=jnp.float32) for m in self.metrics])
+            return self._stats(values)
+        stacked = self._stacked if self._stacked is not None else self._init_stacked()
+        if self._vcompute is None:
+            template = self._template
+            lock = self._step_lock
+
+            def epoch_values(st):
+                with lock:
+                    return jax.vmap(
+                        lambda s: jnp.asarray(template.compute_from_state(s), dtype=jnp.float32)
+                    )(st)
+
+            self._vcompute = jax.jit(epoch_values)
+        return self._stats(self._vcompute(stacked))
 
     def reset(self) -> None:
         super().reset()
-        for metric in self.metrics:
-            metric.reset()
+        self._stacked = None
+        if self.metrics is not None:
+            for metric in self.metrics:
+                metric.reset()
+
+    # jitted closures are neither picklable nor deep-copyable; rebuilt lazily
+    def __getstate__(self) -> dict:
+        state = super().__getstate__()
+        for key in ("_vsteps", "_vcompute", "_step_lock"):
+            state.pop(key, None)
+        return state
+
+    def __setstate__(self, state: dict) -> None:
+        super().__setstate__(state)
+        self._vsteps = {}
+        self._vcompute = None
+        self._step_lock = threading.Lock()
+
+    def __deepcopy__(self, memo: dict) -> "BootStrapper":
+        skip = {"_vsteps", "_vcompute", "_step_lock"}
+        saved = {k: self.__dict__.pop(k) for k in skip}
+        try:
+            new = super().__deepcopy__(memo)
+        finally:
+            self.__dict__.update(saved)
+        new._vsteps = {}
+        new._vcompute = None
+        new._step_lock = threading.Lock()
+        return new

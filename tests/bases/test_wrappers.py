@@ -102,6 +102,94 @@ def test_bootstrapper_validation_and_reset():
     assert float(m.compute()["mean"]) == 0.0
 
 
+def test_bootstrapper_vmapped_single_program():
+    """The TPU-first path: stacked states + one vmapped program per step —
+    no per-copy child metrics are ever built, and the loop fallback (forced)
+    reproduces bit-identical values from the same seed."""
+    p = _rng.rand(128).astype(np.float32) * 5
+    t = p + _rng.randn(128).astype(np.float32)
+
+    fast = BootStrapper(MeanAbsoluteError(), num_bootstraps=8, seed=11, raw=True)
+    for s in range(3):
+        fast.update(jnp.asarray(p + s), jnp.asarray(t))
+    assert fast._mode == "vmapped" and fast.metrics is None  # no K-dispatch loop
+    assert {n for n in fast._stacked} == set(fast._template._defaults)
+    assert all(v.shape[0] == 8 for v in fast._stacked.values())
+
+    slow = BootStrapper(MeanAbsoluteError(), num_bootstraps=8, seed=11, raw=True)
+    slow._mode = "loop"  # force the per-copy fallback
+    for s in range(3):
+        slow.update(jnp.asarray(p + s), jnp.asarray(t))
+    np.testing.assert_allclose(
+        np.asarray(fast.compute()["raw"]), np.asarray(slow.compute()["raw"]), rtol=1e-6
+    )
+
+
+def test_bootstrapper_forward_one_dispatch_value():
+    """forward on the vmapped path returns batch-local mean/std and still
+    accumulates (epoch compute sees all batches)."""
+    m = BootStrapper(MeanAbsoluteError(), num_bootstraps=4, seed=9)
+    p = jnp.arange(64.0)
+    out1 = m(p, p + 2.0)
+    out2 = m(p, p + 4.0)
+    assert abs(float(out1["mean"]) - 2.0) < 1e-6
+    assert abs(float(out2["mean"]) - 4.0) < 1e-6
+    assert m.metrics is None
+    assert abs(float(m.compute()["mean"]) - 3.0) < 1e-6  # both batches accumulated
+
+
+def test_bootstrapper_untraceable_base_falls_back():
+    """A base whose update needs concrete values (mode inference) silently
+    takes the per-copy loop with the same drawn resamples."""
+    m = BootStrapper(Accuracy(), num_bootstraps=3, seed=1, raw=True)
+    p = jnp.asarray((_rng.rand(64) > 0.5).astype(np.int32))
+    t = jnp.asarray((_rng.rand(64) > 0.5).astype(np.int32))
+    m.update(p, t)
+    assert m._mode == "loop" and m.metrics is not None
+    out = m.compute()
+    assert out["raw"].shape == (3,)
+    # same seed, forced loop from the start: identical draws either way
+    m2 = BootStrapper(Accuracy(), num_bootstraps=3, seed=1, raw=True)
+    m2._mode = "loop"
+    m2.update(p, t)
+    np.testing.assert_array_equal(np.asarray(out["raw"]), np.asarray(m2.compute()["raw"]))
+
+
+def test_bootstrapper_mid_epoch_fallback_keeps_state():
+    """A vmapped->loop fallback after batches were already accumulated must
+    transfer the stacked state to the children — no batch silently lost."""
+    from metrics_tpu.utils.exceptions import TracingUnsupportedError
+
+    m = BootStrapper(MeanAbsoluteError(), num_bootstraps=4, seed=13)
+    p = jnp.arange(32.0)
+    m.update(p, p + 2.0)
+    m.update(p, p + 2.0)
+    assert m._mode == "vmapped"
+
+    def boom(*_a, **_k):
+        raise TracingUnsupportedError("injected")
+
+    m._build_vstep = boom  # next new-signature step build fails mid-epoch
+    m.update(p, target=p + 8.0)  # kwargs: a new step signature
+    assert m._mode == "loop" and m.metrics is not None
+    # epoch mean over 3 batches with MAE 2, 2, 8 (resampling preserves
+    # constant offsets exactly): (2 + 2 + 8) / 3 per copy
+    np.testing.assert_allclose(float(m.compute()["mean"]), 4.0, atol=1e-6)
+
+
+def test_bootstrapper_pickle_and_clone_mid_accumulation():
+    import pickle
+
+    m = BootStrapper(MeanAbsoluteError(), num_bootstraps=4, seed=3)
+    m.update(jnp.arange(16.0), jnp.arange(16.0) + 1.5)
+    c = m.clone()
+    r = pickle.loads(pickle.dumps(m))
+    for other in (c, r):
+        other.update(jnp.arange(16.0), jnp.arange(16.0) + 1.5)
+        assert abs(float(other.compute()["mean"]) - 1.5) < 1e-6
+    assert abs(float(m.compute()["mean"]) - 1.5) < 1e-6  # original untouched
+
+
 # ------------------------------------------------------------- MetricTracker
 def test_tracker_epochs_best_and_history():
     tracker = MetricTracker(Accuracy(), maximize=True)

@@ -1,0 +1,165 @@
+"""Static-shape exact curve VECTORS (capacity-padded ROC / PR curves).
+
+The run-end-snapping trick extended from scalar summaries (AUROC/AP) to the
+curve vectors: fixed capacity-length outputs + a valid count, jit/vmap-safe,
+zero readbacks. Oracles: sklearn (``drop_intermediate=False`` for ROC — the
+reference keeps every distinct threshold) and the package's own eager
+reference-parity path (the reference's full-recall cut differs from
+sklearn's by one point on some data, and the reference is the parity
+target).
+"""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import precision_recall_curve as sk_prc
+from sklearn.metrics import roc_curve as _sk_roc
+
+from metrics_tpu import ROC, PrecisionRecallCurve
+from metrics_tpu.functional.classification.curve_static import (
+    binary_precision_recall_curve_padded,
+    binary_roc_padded,
+    precision_recall_curve_padded,
+    roc_padded,
+)
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    precision_recall_curve as eager_prc,
+)
+from metrics_tpu.functional.classification.roc import roc as eager_roc
+
+sk_roc = partial(_sk_roc, drop_intermediate=False)
+_rng = np.random.RandomState(77)
+
+
+def _binary(n=256, ties=True):
+    p = _rng.rand(n).astype(np.float32)
+    if ties:
+        p = np.round(p, 1)
+    t = (_rng.rand(n) > 0.5).astype(np.int32)
+    return p, t
+
+
+@pytest.mark.parametrize("ties", [False, True])
+def test_binary_roc_padded_vs_sklearn_through_jit(ties):
+    p, t = _binary(ties=ties)
+    fpr, tpr, th, cnt = jax.jit(binary_roc_padded)(jnp.asarray(p), jnp.asarray(t))
+    c = int(cnt)
+    skf, skt, skth = sk_roc(t, p)
+    assert c == len(skf)
+    np.testing.assert_allclose(np.asarray(fpr)[:c], skf, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(tpr)[:c], skt, atol=1e-6)
+    # first threshold is max+1 (reference convention); sklearn uses inf
+    np.testing.assert_allclose(np.asarray(th)[1:c], skth[1:], atol=1e-6)
+    # the tail repeats the final point: integrals over the FULL padded
+    # arrays equal integrals over the valid prefix
+    np.testing.assert_allclose(
+        float(jnp.trapezoid(tpr, fpr)), float(np.trapezoid(skt, skf)), atol=1e-6
+    )
+
+
+@pytest.mark.parametrize("ties", [False, True])
+def test_binary_prc_padded_vs_reference_through_jit(ties):
+    p, t = _binary(ties=ties)
+    pr, rc, th, cnt = jax.jit(binary_precision_recall_curve_padded)(jnp.asarray(p), jnp.asarray(t))
+    c = int(cnt)
+    ep, er, eth = eager_prc(jnp.asarray(p), jnp.asarray(t), pos_label=1)
+    assert c == np.asarray(eth).shape[0]
+    np.testing.assert_allclose(np.asarray(pr)[: c + 1], np.asarray(ep), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(rc)[: c + 1], np.asarray(er), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(th)[:c], np.asarray(eth), atol=1e-5)
+    if not ties:
+        # on tie-free data the sklearn and reference cuts coincide
+        skp, skr, skth = sk_prc(t, p)
+        np.testing.assert_allclose(np.asarray(pr)[: c + 1], skp, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(rc)[: c + 1], skr, atol=1e-6)
+
+
+def test_padded_row_mask_equals_sliced():
+    """Ghost rows (capacity padding) are fully neutral."""
+    p, t = _binary(n=300)
+    mask = np.arange(300) < 210
+    got = jax.jit(binary_roc_padded)(
+        jnp.asarray(p), jnp.asarray(t), None, 1.0, jnp.asarray(mask)
+    )
+    want = binary_roc_padded(jnp.asarray(p[:210]), jnp.asarray(t[:210]))
+    c = int(want[3])
+    assert int(got[3]) == c
+    for g, w in zip(got[:3], want[:3]):
+        np.testing.assert_allclose(np.asarray(g)[:c], np.asarray(w)[:c], atol=1e-6)
+
+
+def test_multiclass_padded_vs_sklearn():
+    num_classes = 4
+    logits = _rng.rand(200, num_classes).astype(np.float32)
+    p = logits / logits.sum(-1, keepdims=True)
+    t = _rng.randint(0, num_classes, 200).astype(np.int32)
+
+    fprs, tprs, _, cnts = jax.jit(roc_padded)(jnp.asarray(p), jnp.asarray(t))
+    prs, rcs, _, cnts2 = jax.jit(precision_recall_curve_padded)(jnp.asarray(p), jnp.asarray(t))
+    for c_idx in range(num_classes):
+        y = (t == c_idx).astype(int)
+        skf, skt, _ = sk_roc(y, p[:, c_idx])
+        c = int(cnts[c_idx])
+        np.testing.assert_allclose(np.asarray(fprs)[c_idx][:c], skf, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(tprs)[c_idx][:c], skt, atol=1e-6)
+        ep, er, eth = eager_prc(jnp.asarray(p[:, c_idx]), jnp.asarray(t), pos_label=c_idx)
+        c2 = int(cnts2[c_idx])
+        np.testing.assert_allclose(np.asarray(prs)[c_idx][: c2 + 1], np.asarray(ep), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(rcs)[c_idx][: c2 + 1], np.asarray(er), atol=1e-6)
+
+
+def test_multilabel_padded_per_column():
+    p = _rng.rand(180, 3).astype(np.float32)
+    t = (_rng.rand(180, 3) > 0.5).astype(np.int32)
+    prs, rcs, _, cnts = jax.jit(precision_recall_curve_padded)(jnp.asarray(p), jnp.asarray(t))
+    for c_idx in range(3):
+        ep, er, _ = eager_prc(jnp.asarray(p[:, c_idx]), jnp.asarray(t[:, c_idx]), pos_label=1)
+        c = int(cnts[c_idx])
+        np.testing.assert_allclose(np.asarray(prs)[c_idx][: c + 1], np.asarray(ep), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(rcs)[c_idx][: c + 1], np.asarray(er), atol=1e-6)
+
+
+# ------------------------------------------------- capacity-backed metrics
+def test_roc_metric_capacity_static_compute():
+    p, t = _binary(n=300)
+    m = ROC(pos_label=1, capacity=512)
+    m.update(jnp.asarray(p[:150]), jnp.asarray(t[:150]))
+    m.update(jnp.asarray(p[150:]), jnp.asarray(t[150:]))
+    fpr, tpr, th, cnt = m.compute()
+    assert fpr.shape == (513,)  # static capacity-derived length
+    c = int(cnt)
+    e = ROC(pos_label=1)
+    e.update(jnp.asarray(p), jnp.asarray(t))
+    ef, et, eth = e.compute()
+    assert c == np.asarray(ef).shape[0]
+    np.testing.assert_allclose(np.asarray(fpr)[:c], np.asarray(ef), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(tpr)[:c], np.asarray(et), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(th)[:c], np.asarray(eth), atol=1e-5)
+
+
+def test_prc_metric_capacity_static_compute_multiclass():
+    num_classes = 3
+    logits = _rng.rand(240, num_classes).astype(np.float32)
+    p = logits / logits.sum(-1, keepdims=True)
+    t = _rng.randint(0, num_classes, 240).astype(np.int32)
+    m = PrecisionRecallCurve(num_classes=num_classes, capacity=256)
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    prs, rcs, ths, cnts = m.compute()
+    assert prs.shape[0] == num_classes
+    e = PrecisionRecallCurve(num_classes=num_classes)
+    e.update(jnp.asarray(p), jnp.asarray(t))
+    eps, ers, eths = e.compute()
+    for c_idx in range(num_classes):
+        c = int(cnts[c_idx])
+        np.testing.assert_allclose(np.asarray(prs)[c_idx][: c + 1], np.asarray(eps[c_idx]), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(rcs)[c_idx][: c + 1], np.asarray(ers[c_idx]), atol=1e-6)
+
+
+def test_curve_metric_capacity_overflow_raises():
+    m = ROC(pos_label=1, capacity=16)
+    p, t = _binary(n=32)
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    with pytest.raises(RuntimeError, match="overflow"):
+        m.compute()
