@@ -73,7 +73,13 @@ class ROC(Metric):
         self.num_classes = num_classes
         self.pos_label = pos_label
 
-    def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    def compute(
+        self,
+    ) -> Union[
+        Tuple[Array, Array, Array],
+        Tuple[List[Array], List[Array], List[Array]],
+        Tuple[Array, Array, Array, Array],  # capacity path: padded curves + count
+    ]:
         from metrics_tpu.classification._padded_curves import padded_curve_compute
 
         padded = padded_curve_compute(self, "roc")  # capacity-backed: static shapes

@@ -57,6 +57,7 @@ from metrics_tpu.functional.regression.mape import (
 from metrics_tpu.functional.classification.calibration_error import calibration_error
 from metrics_tpu.functional.text import (
     cer,
+    lcs_length_padded,
     match_error_rate,
     word_information_lost,
     word_information_preserved,

@@ -267,3 +267,70 @@ def edit_distance_padded(pred_ids: Array, target_ids: Array, pred_len: Array, ta
     pred_len = jnp.clip(pred_len, 0, n)
     target_len = jnp.clip(target_len, 0, m)
     return jax.vmap(_edit_distance_single)(pred_ids, target_ids, pred_len, target_len)
+
+
+def _lcs_single(pred: Array, target: Array, pred_len: Array, target_len: Array) -> Array:
+    """LCS length of one padded id sequence pair (device).
+
+    Unlike Levenshtein (whose left-dependency forces a serial inner scan),
+    the LCS recurrence admits the identity ``L(i,j) = max(L(i-1,j),
+    L(i,j-1), L(i-1,j-1) + match)`` — taking the extra maxes is always
+    valid because skipping characters never decreases an LCS. The
+    ``L(i,j-1)`` running max is then one ``cummax`` per row: the whole row
+    update is vectorized, O(rows) scan steps of O(cols) vector work.
+    """
+    m = target.shape[0]
+    init_row = jnp.zeros(m + 1, dtype=jnp.int32)
+    valid_t = jnp.arange(m) < target_len  # padded target slots never match
+
+    def step(row, inp):
+        i, tok = inp
+        active = i < pred_len
+        match = ((target == tok) & valid_t).astype(jnp.int32)
+        candidate = jnp.maximum(row[1:], row[:-1] + match)
+        new_row = jnp.concatenate([jnp.zeros(1, jnp.int32), jax.lax.cummax(candidate)])
+        return jnp.where(active, new_row, row), None
+
+    n = pred.shape[0]
+    final, _ = jax.lax.scan(step, init_row, (jnp.arange(n, dtype=jnp.int32), pred))
+    return final[target_len]
+
+
+def lcs_length_padded(pred_ids: Array, target_ids: Array, pred_len: Array, target_len: Array) -> Array:
+    """Batched longest-common-subsequence length over padded token-id
+    arrays, fully on device (the ROUGE-L kernel; mirrors
+    ``edit_distance_padded``'s contract).
+
+    Args:
+        pred_ids: (B, N) int token ids, padded.
+        target_ids: (B, M) int token ids, padded.
+        pred_len: (B,) true lengths of ``pred_ids`` rows.
+        target_len: (B,) true lengths of ``target_ids`` rows.
+
+    Returns:
+        (B,) int32 LCS lengths.
+
+    Concrete out-of-range lengths raise a ``ValueError``; under tracing
+    they are clamped into range (same policy as ``edit_distance_padded``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> p = jnp.array([[1, 2, 3, 4, 0]])
+        >>> t = jnp.array([[1, 9, 3, 4]])
+        >>> int(lcs_length_padded(p, t, jnp.array([4]), jnp.array([4]))[0])
+        3
+    """
+    from metrics_tpu.utils.data import is_concrete
+
+    n, m = pred_ids.shape[1], target_ids.shape[1]
+    for name, lens, hi in (("pred_len", pred_len, n), ("target_len", target_len, m)):
+        if is_concrete(lens):
+            vals = np.asarray(lens)
+            if vals.size and (vals.min() < 0 or vals.max() > hi):
+                raise ValueError(
+                    f"`{name}` must lie in [0, {hi}] (the padded axis length); "
+                    f"got range [{vals.min()}, {vals.max()}]"
+                )
+    pred_len = jnp.clip(pred_len, 0, n)
+    target_len = jnp.clip(target_len, 0, m)
+    return jax.vmap(_lcs_single)(pred_ids, target_ids, pred_len, target_len)

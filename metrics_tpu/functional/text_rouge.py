@@ -34,6 +34,7 @@ def _prf(overlap: int, pred_total: int, target_total: int) -> Tuple[float, float
 
 
 def _lcs_len(a: List[str], b: List[str]) -> int:
+    """Host DP oracle (small pairs; the device kernel covers corpus scale)."""
     if not a or not b:
         return 0
     prev = [0] * (len(b) + 1)
@@ -45,19 +46,42 @@ def _lcs_len(a: List[str], b: List[str]) -> int:
     return prev[-1]
 
 
-def _pair_scores(pred: str, target: str, keys: Sequence[str]) -> Dict[str, Tuple[float, float, float]]:
-    p_tok = _rouge_tokens(pred)
-    t_tok = _rouge_tokens(target)
-    out = {}
-    for key in keys:
-        if key == "rougeL":
-            out[key] = _prf(_lcs_len(p_tok, t_tok), len(p_tok), len(t_tok))
-            continue
-        n = int(key[5:])
-        p_ngrams, t_ngrams = _ngrams(p_tok, n), _ngrams(t_tok, n)
-        overlap = sum((p_ngrams & t_ngrams).values())
-        out[key] = _prf(overlap, sum(p_ngrams.values()), sum(t_ngrams.values()))
-    return out
+# batches whose total DP cell count clears this run the LCS on device (one
+# fused batched kernel, functional/text.py lcs_length_padded); below it the
+# host loop wins — a device dispatch costs ~ms through a remote tunnel while
+# small-string host DP is microseconds
+_DEVICE_LCS_MIN_CELLS = 50_000
+
+
+def _lcs_lens(pairs: List[Tuple[List[str], List[str]]]) -> List[int]:
+    """LCS length per tokenized pair — host DP for small batches, the
+    batched device kernel at corpus scale (the WER posture, applied to
+    ROUGE-L: tokenization stays host work, the O(N*M) counting doesn't)."""
+    cells = sum(len(a) * len(b) for a, b in pairs)
+    if cells < _DEVICE_LCS_MIN_CELLS:
+        return [_lcs_len(a, b) for a, b in pairs]
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from metrics_tpu.functional.text import lcs_length_padded
+
+    batch = len(pairs)
+    n = max(max((len(a) for a, _ in pairs), default=0), 1)
+    m = max(max((len(b) for _, b in pairs), default=0), 1)
+    pred_ids = np.zeros((batch, n), dtype=np.int32)
+    target_ids = np.full((batch, m), -1, dtype=np.int32)  # distinct pads never match
+    for k, (a, b) in enumerate(pairs):
+        vocab: Dict[str, int] = {}
+        pred_ids[k, : len(a)] = [vocab.setdefault(t, len(vocab) + 1) for t in a]
+        target_ids[k, : len(b)] = [vocab.setdefault(t, len(vocab) + 1) for t in b]
+    out = lcs_length_padded(
+        jnp.asarray(pred_ids),
+        jnp.asarray(target_ids),
+        jnp.asarray(np.array([len(a) for a, _ in pairs], dtype=np.int32)),
+        jnp.asarray(np.array([len(b) for _, b in pairs], dtype=np.int32)),
+    )
+    return [int(x) for x in np.asarray(out)]
 
 
 def _check_rouge_keys(rouge_keys: Sequence[str]) -> Tuple[str, ...]:
@@ -83,10 +107,22 @@ def _batch_sums(
     if len(preds) != len(target):
         raise ValueError("`preds` and `target` must have the same number of sentences")
     sums = {k: [0.0, 0.0, 0.0] for k in keys}
-    for p, t in zip(preds, target):
-        for k, prf in _pair_scores(p, t, keys).items():
+    tok_pairs = [(_rouge_tokens(p), _rouge_tokens(t)) for p, t in zip(preds, target)]
+    ngram_keys = [k for k in keys if k != "rougeL"]
+    for p_tok, t_tok in tok_pairs:
+        for k in ngram_keys:
+            n = int(k[5:])
+            p_ngrams, t_ngrams = _ngrams(p_tok, n), _ngrams(t_tok, n)
+            overlap = sum((p_ngrams & t_ngrams).values())
+            prf = _prf(overlap, sum(p_ngrams.values()), sum(t_ngrams.values()))
             for i in range(3):
                 sums[k][i] += prf[i]
+    if "rougeL" in keys:
+        # all pairs' LCS in one pass: batched device kernel at corpus scale
+        for (p_tok, t_tok), lcs in zip(tok_pairs, _lcs_lens(tok_pairs)):
+            prf = _prf(lcs, len(p_tok), len(t_tok))
+            for i in range(3):
+                sums["rougeL"][i] += prf[i]
     return sums, len(preds)
 
 

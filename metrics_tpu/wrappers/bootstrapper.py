@@ -94,7 +94,10 @@ class BootStrapper(Metric):
 
         return n, tuple(flag(a) for a in args), tuple((k, flag(v)) for k, v in sorted(kwargs.items()))
 
-    def _build_vstep(self, with_compute: bool, aflags: tuple, kwflags: tuple) -> Callable:
+    def _build_vstep(self, kind: str, aflags: tuple, kwflags: tuple) -> Callable:
+        """One jitted program per step. ``kind``: 'none' -> merged only;
+        'stats' -> merged + fused batch mean/std; 'deltas' -> merged + the
+        stacked per-copy delta states (the compute-left-eager retry tier)."""
         template = self._template
         lock = self._step_lock
         donate = (0,) if jax.default_backend() == "tpu" else ()
@@ -108,8 +111,10 @@ class BootStrapper(Metric):
 
             deltas = jax.vmap(one)(idx_mat)
             merged = jax.vmap(template.merge_states)(stacked, deltas)
-            if not with_compute:
+            if kind == "none":
                 return merged, ()
+            if kind == "deltas":
+                return merged, deltas
             with lock:
                 values = jax.vmap(
                     lambda s: jnp.asarray(template.compute_from_state(s), dtype=jnp.float32)
@@ -133,18 +138,30 @@ class BootStrapper(Metric):
             base,
         )
 
-    def _run_vmapped(self, args: tuple, kwargs: dict, idx_mat: Array, with_compute: bool):
+    def _run_vmapped(self, args: tuple, kwargs: dict, idx_mat: Array, kind: str):
         n, aflags, kwflags = self._resample_plan(args, kwargs)
-        key = (with_compute, aflags, kwflags)
+        key = (kind, aflags, kwflags)
         fn = self._vsteps.get(key)
         if fn is None:
-            fn = self._build_vstep(with_compute, aflags, kwflags)
+            fn = self._build_vstep(kind, aflags, kwflags)
             self._vsteps[key] = fn
         if self._stacked is None:
             self._stacked = self._init_stacked()
-        merged, stats = fn(self._stacked, idx_mat, args, kwargs)
+        merged, extra = fn(self._stacked, idx_mat, args, kwargs)
         self._stacked = merged
-        return stats
+        return extra
+
+    def _eager_copy_values(self, stacked_states) -> Array:
+        """Per-copy values computed EAGERLY from a stacked state pytree (for
+        base computes that need concrete values — the base Metric's
+        _fc_failed tier, one eager compute per copy, jitted update kept)."""
+        template = self._template
+        values = []
+        for k in range(self.num_bootstraps):
+            state_k = {name: value[k] for name, value in stacked_states.items()}
+            with self._step_lock:
+                values.append(jnp.asarray(template.compute_from_state(state_k), dtype=jnp.float32))
+        return jnp.stack(values)
 
     # ------------------------------------------------------------- loop path
     def _ensure_children(self) -> None:
@@ -195,12 +212,25 @@ class BootStrapper(Metric):
         if self._mode == "vmapped":
             safe_idx = idx_mat if idx_mat is not None else jnp.zeros((self.num_bootstraps, 0), jnp.int32)
             try:
-                return self._run_vmapped(args, kwargs, safe_idx, with_compute)
+                if with_compute and not self._fc_failed:
+                    try:
+                        return self._run_vmapped(args, kwargs, safe_idx, "stats")
+                    except self._TRACER_ERRORS:
+                        # only the COMPUTE half may be untraceable: keep the
+                        # vmapped update and leave the batch value eager (the
+                        # base Metric's _fc_failed tier), instead of demoting
+                        # to K dispatches per step forever
+                        self._fc_failed = True
+                if with_compute:
+                    deltas = self._run_vmapped(args, kwargs, safe_idx, "deltas")
+                    return self._stats(self._eager_copy_values(deltas))
+                return self._run_vmapped(args, kwargs, safe_idx, "none")
             except self._TRACER_ERRORS:
-                # base update needs concrete values -> permanent per-copy
-                # fallback, replaying the SAME drawn resamples. State already
-                # accumulated on the stacked path transfers to the children
-                # (copy k inherits stacked[name][k]) so no batch is lost.
+                # the UPDATE itself needs concrete values -> permanent
+                # per-copy fallback, replaying the SAME drawn resamples.
+                # State already accumulated on the stacked path transfers to
+                # the children (copy k inherits stacked[name][k]) so no
+                # batch is lost.
                 self._mode = "loop"
                 if self._stacked is not None:
                     self._ensure_children()
@@ -259,18 +289,25 @@ class BootStrapper(Metric):
             values = jnp.stack([jnp.asarray(m.compute(), dtype=jnp.float32) for m in self.metrics])
             return self._stats(values)
         stacked = self._stacked if self._stacked is not None else self._init_stacked()
-        if self._vcompute is None:
-            template = self._template
-            lock = self._step_lock
+        if not self._fc_failed:
+            if self._vcompute is None:
+                template = self._template
+                lock = self._step_lock
 
-            def epoch_values(st):
-                with lock:
-                    return jax.vmap(
-                        lambda s: jnp.asarray(template.compute_from_state(s), dtype=jnp.float32)
-                    )(st)
+                def epoch_values(st):
+                    with lock:
+                        return jax.vmap(
+                            lambda s: jnp.asarray(template.compute_from_state(s), dtype=jnp.float32)
+                        )(st)
 
-            self._vcompute = jax.jit(epoch_values)
-        return self._stats(self._vcompute(stacked))
+                self._vcompute = jax.jit(epoch_values)
+            try:
+                return self._stats(self._vcompute(stacked))
+            except self._TRACER_ERRORS:
+                # compute needs concrete values: per-copy eager from the
+                # SAME stacked accumulator (updates stay vmapped)
+                self._fc_failed = True
+        return self._stats(self._eager_copy_values(stacked))
 
     def reset(self) -> None:
         super().reset()

@@ -155,6 +155,46 @@ def test_bootstrapper_untraceable_base_falls_back():
     np.testing.assert_array_equal(np.asarray(out["raw"]), np.asarray(m2.compute()["raw"]))
 
 
+def test_bootstrapper_concrete_compute_keeps_vmapped_update():
+    """A base whose update traces but whose compute needs concrete values
+    keeps the one-dispatch vmapped update; only the value goes eager
+    per-copy (the base Metric's _fc_failed tier) — and epoch compute()
+    works instead of crashing."""
+    import jax.numpy as jnp2
+    from metrics_tpu import Metric
+
+    class ConcreteCompute(Metric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("s", jnp2.asarray(0.0), dist_reduce_fx="sum")
+            self.add_state("n", jnp2.asarray(0.0), dist_reduce_fx="sum")
+
+        def update(self, p, t):
+            self.s = self.s + jnp2.sum(jnp2.abs(p - t))
+            self.n = self.n + p.shape[0]
+
+        def compute(self):
+            if float(self.n) == 0:  # concrete branch: cannot trace
+                return jnp2.asarray(0.0)
+            return self.s / self.n
+
+    m = BootStrapper(ConcreteCompute(), num_bootstraps=4, seed=21)
+    p = jnp.arange(32.0)
+    out = m(p, p + 3.0)  # forward: stats tier fails, deltas tier succeeds
+    assert m._mode == "vmapped" and m._fc_failed and m.metrics is None
+    np.testing.assert_allclose(float(out["mean"]), 3.0, atol=1e-6)
+    m.update(p, p + 3.0)  # update stays on the vmapped path
+    assert m._mode == "vmapped"
+    np.testing.assert_allclose(float(m.compute()["mean"]), 3.0, atol=1e-6)
+
+    # update()+compute() alone (never forward) also survives
+    m2 = BootStrapper(ConcreteCompute(), num_bootstraps=3, seed=22)
+    m2.update(p, p + 5.0)
+    assert m2._mode == "vmapped"
+    np.testing.assert_allclose(float(m2.compute()["mean"]), 5.0, atol=1e-6)
+    assert m2._mode == "vmapped"  # epoch compute fell back eagerly, updates stay fused
+
+
 def test_bootstrapper_mid_epoch_fallback_keeps_state():
     """A vmapped->loop fallback after batches were already accumulated must
     transfer the stacked state to the children — no batch silently lost."""

@@ -179,6 +179,112 @@ print("RESULT " + json.dumps({
 """
 
 
+_RING_WORKER = r"""
+import json
+import os
+import sys
+
+rank = int(sys.argv[1])
+port = sys.argv[2]
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ.pop("METRICS_TPU_TEST_PLATFORM", None)
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"localhost:{port}", num_processes=2, process_id=rank
+)
+assert jax.process_count() == 2 and len(jax.devices()) == 8
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from metrics_tpu import AUROC
+from metrics_tpu.parallel import row_sharded, sharded_auroc
+
+# a GLOBAL mesh: 8 devices spanning both processes (4 local each). The ring's
+# ppermute hops cross the process boundary — the DCN plane of a real pod.
+mesh = Mesh(np.array(jax.devices()), ("dp",))
+
+N = 512
+rng = np.random.RandomState(97)  # same stream on both ranks
+scores = np.round(rng.rand(N), 1).astype(np.float32)  # cross-shard ties
+labels = (rng.rand(N) > 0.5).astype(np.int32)
+
+# ---- raw ring engine over the multi-process mesh
+sharding = NamedSharding(mesh, P("dp"))
+half = N // 2
+arr_s = jax.make_array_from_process_local_data(sharding, scores[rank * half:(rank + 1) * half], (N,))
+arr_l = jax.make_array_from_process_local_data(sharding, labels[rank * half:(rank + 1) * half], (N,))
+ring = jax.jit(jax.shard_map(
+    lambda s, t: sharded_auroc(s, t, "dp"), mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P()
+))
+ring_auroc = float(ring(arr_s, arr_l))
+
+# ---- the STATEFUL front door across processes: row-sharded buffer states,
+# replicated batch inputs, compute() dispatches the ring (host-plane gather
+# suppressed because the mesh spans every process)
+metric = AUROC(pos_label=1, capacity=N)
+metric.device_put(row_sharded(mesh, "dp"))
+replicated = NamedSharding(mesh, P())
+for start in (0, half):
+    batch_s = jax.make_array_from_process_local_data(replicated, scores[start:start + half], (half,))
+    batch_l = jax.make_array_from_process_local_data(replicated, labels[start:start + half], (half,))
+    metric.update(batch_s, batch_l)
+assert metric.preds.data.sharding.spec[0] == "dp"
+stateful_auroc = float(metric.compute())
+
+from sklearn.metrics import roc_auc_score
+
+want = float(roc_auc_score(labels, scores))
+print("RESULT " + json.dumps({
+    "rank": rank, "ring": ring_auroc, "stateful": stateful_auroc, "want": want,
+}), flush=True)
+"""
+
+
+def test_two_process_sharded_epoch_ring(tmp_path):
+    """The ring engine (raw AND through the stateful API) over a mesh whose
+    collectives cross a real process boundary — the DCN plane, beyond
+    single-process virtual devices."""
+    results = _run_workers(tmp_path, _RING_WORKER, port="19741")
+    for rank, r in results.items():
+        assert abs(r["ring"] - r["want"]) < 1e-6, r
+        assert abs(r["stateful"] - r["want"]) < 1e-6, r
+
+
+def _run_workers(tmp_path, source, port):
+    worker = tmp_path / "worker.py"
+    worker.write_text(source)
+
+    env = {**os.environ}
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = os.getcwd()
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(rank), port],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+        )
+        for rank in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=150)
+        assert p.returncode == 0, f"worker failed:\nstdout={out}\nstderr={err}"
+        outs.append(out)
+
+    results = {}
+    for out in outs:
+        line = [ln for ln in out.splitlines() if ln.startswith("RESULT ")][-1]
+        r = json.loads(line[len("RESULT "):])
+        results[r["rank"]] = r
+    assert set(results) == {0, 1}
+    return results
+
+
 def test_two_process_host_plane_sync(tmp_path):
     worker = tmp_path / "worker.py"
     worker.write_text(_WORKER)

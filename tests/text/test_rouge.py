@@ -49,6 +49,58 @@ def test_lcs_vs_bruteforce():
         lcs.cache_clear()
 
 
+def test_device_lcs_kernel_vs_host_oracle():
+    """The batched device LCS (lcs_length_padded) matches the host DP on
+    random padded id batches, including empty and full-pad rows."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.functional.text import lcs_length_padded
+    from metrics_tpu.functional.text_rouge import _lcs_len
+
+    rng = np.random.RandomState(11)
+    B, N, M = 16, 12, 9
+    pred_ids = rng.randint(1, 5, (B, N)).astype(np.int32)
+    target_ids = rng.randint(1, 5, (B, M)).astype(np.int32)
+    pred_len = rng.randint(0, N + 1, B).astype(np.int32)
+    target_len = rng.randint(0, M + 1, B).astype(np.int32)
+    got = np.asarray(
+        lcs_length_padded(
+            jnp.asarray(pred_ids), jnp.asarray(target_ids),
+            jnp.asarray(pred_len), jnp.asarray(target_len),
+        )
+    )
+    for k in range(B):
+        a = [str(x) for x in pred_ids[k, : pred_len[k]]]
+        b = [str(x) for x in target_ids[k, : target_len[k]]]
+        assert got[k] == _lcs_len(a, b), (k, a, b)
+
+    with pytest.raises(ValueError, match="pred_len"):
+        lcs_length_padded(
+            jnp.asarray(pred_ids), jnp.asarray(target_ids),
+            jnp.asarray(pred_len + N), jnp.asarray(target_len),
+        )
+
+
+def test_rouge_l_device_path_matches_host():
+    """Corpus-scale ROUGE-L (device LCS batch) == the host path exactly."""
+    from metrics_tpu.functional import text_rouge
+
+    rng = np.random.RandomState(13)
+    vocab = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta"]
+    preds = [" ".join(rng.choice(vocab, rng.randint(5, 40))) for _ in range(24)]
+    targets = [" ".join(rng.choice(vocab, rng.randint(5, 40))) for _ in range(24)]
+
+    host = rouge_score(preds, targets, rouge_keys=("rougeL",))
+    old = text_rouge._DEVICE_LCS_MIN_CELLS
+    text_rouge._DEVICE_LCS_MIN_CELLS = 0  # force the device kernel
+    try:
+        dev = rouge_score(preds, targets, rouge_keys=("rougeL",))
+    finally:
+        text_rouge._DEVICE_LCS_MIN_CELLS = old
+    for key, val in host.items():
+        assert abs(dev[key] - val) < 1e-12, key
+
+
 def test_module_accumulates_as_mean_of_sentences():
     pairs = [
         ("the cat sat on the mat", "the cat was on the mat"),
