@@ -1,8 +1,10 @@
 .PHONY: test test-tpu doctest clean bench docs
 
-# generate the API reference from live docstrings (stdlib-only generator)
+# generate the API reference from live docstrings, then render the whole
+# docs tree (README + guides + API) into a browsable static HTML site
 docs:
 	python docs/gen_api.py docs/api.md
+	python docs/build_html.py docs/site
 
 # full suite + package doctests on 8 fake CPU devices (root conftest forces
 # the platform; see conftest.py)

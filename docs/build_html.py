@@ -1,0 +1,131 @@
+"""Render the markdown docs tree into a browsable static HTML site.
+
+Usage:  python docs/build_html.py [outdir]      (default: docs/site)
+
+Uses the image's python-markdown (the only doc tool shipped — no
+sphinx/mkdocs) with tables + fenced-code + toc extensions. Every
+``docs/*.md`` page plus the repo ``README.md`` becomes one HTML page with a
+shared navigation sidebar; internal ``.md`` links are rewritten to ``.html``.
+Built by ``make docs`` after the API reference is regenerated, and by CI.
+"""
+import os
+import re
+import sys
+from pathlib import Path
+
+import markdown
+
+DOCS = Path(__file__).resolve().parent
+REPO = DOCS.parent
+
+# (source file, page title) in sidebar order
+PAGES = [
+    (REPO / "README.md", "Home"),
+    (DOCS / "quickstart.md", "Quickstart"),
+    (DOCS / "overview.md", "Architecture overview"),
+    (DOCS / "training_integration.md", "Training integration (flax/optax)"),
+    (DOCS / "implement.md", "Implementing a metric"),
+    (DOCS / "api.md", "API reference"),
+]
+
+TEMPLATE = """<!DOCTYPE html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<title>{title} — metrics_tpu</title>
+<style>
+:root {{ --fg: #1a1a1a; --muted: #666; --line: #e2e2e2; --accent: #0b57d0;
+         --code-bg: #f6f8fa; --sidebar-bg: #fafafa; }}
+* {{ box-sizing: border-box; }}
+body {{ margin: 0; font: 16px/1.6 system-ui, -apple-system, "Segoe UI", sans-serif;
+        color: var(--fg); display: flex; min-height: 100vh; }}
+nav {{ width: 248px; flex-shrink: 0; border-right: 1px solid var(--line);
+       background: var(--sidebar-bg); padding: 1.5rem 1rem; position: sticky;
+       top: 0; height: 100vh; overflow-y: auto; }}
+nav .brand {{ font-weight: 700; font-size: 1.1rem; margin-bottom: 1rem; }}
+nav a {{ display: block; padding: .3rem .5rem; border-radius: 6px;
+         color: var(--fg); text-decoration: none; font-size: .95rem; }}
+nav a:hover {{ background: #eee; }}
+nav a.active {{ background: var(--accent); color: #fff; }}
+main {{ flex: 1; min-width: 0; padding: 2rem 3rem 4rem; max-width: 60rem; }}
+h1, h2, h3 {{ line-height: 1.25; }}
+h2 {{ border-bottom: 1px solid var(--line); padding-bottom: .3rem; margin-top: 2rem; }}
+a {{ color: var(--accent); }}
+code {{ background: var(--code-bg); padding: .12em .35em; border-radius: 4px;
+        font: .875em/1.5 ui-monospace, "SF Mono", Menlo, Consolas, monospace; }}
+pre {{ background: var(--code-bg); padding: .9rem 1.1rem; border-radius: 8px;
+       overflow-x: auto; }}
+pre code {{ background: none; padding: 0; }}
+table {{ border-collapse: collapse; display: block; overflow-x: auto;
+         font-size: .92rem; }}
+th, td {{ border: 1px solid var(--line); padding: .35rem .7rem; text-align: left; }}
+th {{ background: var(--sidebar-bg); }}
+blockquote {{ border-left: 3px solid var(--line); margin-left: 0;
+              padding-left: 1rem; color: var(--muted); }}
+@media (max-width: 760px) {{ body {{ flex-direction: column; }}
+  nav {{ width: 100%; height: auto; position: static; }} main {{ padding: 1rem; }} }}
+</style>
+</head>
+<body>
+<nav>
+<div class="brand">metrics_tpu</div>
+{nav}
+</nav>
+<main>
+{body}
+</main>
+</body>
+</html>
+"""
+
+
+def _out_name(src: Path) -> str:
+    if src.name == "README.md":
+        return "index.html"
+    return src.stem + ".html"
+
+
+def _rewrite_links(html: str) -> str:
+    # internal .md links -> the rendered .html page
+    def sub(m):
+        href = m.group(1)
+        if href.startswith(("http://", "https://", "#")):
+            return m.group(0)
+        path, _, fragment = href.partition("#")
+        root, ext = os.path.splitext(os.path.basename(path))
+        if ext == ".md":
+            target = "index.html" if root == "README" else root + ".html"
+            if fragment:
+                target += "#" + fragment
+            return f'href="{target}"'
+        return m.group(0)
+
+    return re.sub(r'href="([^"]+)"', sub, html)
+
+
+def build(outdir: Path) -> int:
+    outdir.mkdir(parents=True, exist_ok=True)
+    md = markdown.Markdown(extensions=["tables", "fenced_code", "toc", "sane_lists"])
+    built = 0
+    for src, title in PAGES:
+        if not src.exists():
+            print(f"skip (missing): {src}", file=sys.stderr)
+            continue
+        nav = "\n".join(
+            f'<a href="{_out_name(s)}"{" class=\"active\"" if s == src else ""}>{t}</a>'
+            for s, t in PAGES if s.exists()
+        )
+        md.reset()
+        body = _rewrite_links(md.convert(src.read_text()))
+        (outdir / _out_name(src)).write_text(
+            TEMPLATE.format(title=title, nav=nav, body=body)
+        )
+        built += 1
+    return built
+
+
+if __name__ == "__main__":
+    out = Path(sys.argv[1]) if len(sys.argv) > 1 else DOCS / "site"
+    n = build(out)
+    print(f"built {n} pages -> {out}")

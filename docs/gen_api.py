@@ -56,9 +56,68 @@ def _signature(obj, drop_self: bool = False):
     return str(sig)
 
 
+def _markdownize(doc: str) -> str:
+    """Make a Google-style docstring render as markdown.
+
+    Doctest blocks (contiguous runs containing a ``>>>`` line) become fenced
+    python code blocks; ``Args:``-style section headers become bold.
+    """
+    lines = doc.splitlines()
+    out, block, in_code, in_args = [], [], False, False
+
+    def flush():
+        nonlocal in_code
+        if in_code and block:
+            # dedent the whole example by the `>>>` line's indent so the
+            # expected-output lines stay aligned with their statements
+            indent = len(block[0]) - len(block[0].lstrip())
+            out.append("```python")
+            out.extend(ln[indent:] if ln[:indent].isspace() or not ln[:indent] else ln
+                       for ln in block)
+            out.append("```")
+        else:
+            out.extend(block)
+        block.clear()
+        in_code = False
+
+    for ln in lines:
+        if not ln.strip():
+            flush()
+            in_args = False
+            out.append(ln)
+            continue
+        if ln.lstrip().startswith(">>>"):
+            if not in_code:
+                flush()
+            in_code = True
+        if not in_code and ln.rstrip().endswith(":") and ln.strip() in (
+            "Args:", "Returns:", "Raises:", "Example:", "Examples:", "Note:", "Yields:"
+        ):
+            flush()
+            in_args = ln.strip() in ("Args:", "Raises:")
+            out.append(f"**{ln.strip()[:-1]}**\n")
+            continue
+        if in_args and not in_code:
+            # "name: description" entries -> list items; deeper-indented
+            # continuation lines fold into the same item
+            stripped = ln.strip()
+            indent = len(ln) - len(ln.lstrip())
+            if indent <= 4 and ":" in stripped:
+                name, _, rest = stripped.partition(":")
+                block.append(f"- `{name.strip()}`:{rest}")
+            elif block:
+                block[-1] += " " + stripped
+            else:
+                block.append(stripped)
+            continue
+        block.append(ln)
+    flush()
+    return "\n".join(out)
+
+
 def _doc(obj):
     doc = inspect.getdoc(obj)
-    return doc if doc else "*(undocumented)*"
+    return _markdownize(doc) if doc else "*(undocumented)*"
 
 
 def _emit_symbol(out, name, obj, level="###"):

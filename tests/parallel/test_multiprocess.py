@@ -286,34 +286,7 @@ def _run_workers(tmp_path, source, port):
 
 
 def test_two_process_host_plane_sync(tmp_path):
-    worker = tmp_path / "worker.py"
-    worker.write_text(_WORKER)
-    port = "19733"
-
-    env = {**os.environ}
-    env.pop("XLA_FLAGS", None)
-    env["PYTHONPATH"] = os.getcwd()
-
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(worker), str(rank), port],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
-        )
-        for rank in range(2)
-    ]
-    outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=150)
-        assert p.returncode == 0, f"worker failed:\nstdout={out}\nstderr={err}"
-        outs.append(out)
-
-    results = {}
-    for out in outs:
-        line = [ln for ln in out.splitlines() if ln.startswith("RESULT ")][-1]
-        r = json.loads(line[len("RESULT "):])
-        results[r["rank"]] = r
-
-    assert set(results) == {0, 1}
+    results = _run_workers(tmp_path, _WORKER, port="19733")
     for rank, r in results.items():
         # sum state reduced across both processes (reference test_ddp.py:26-42)
         assert r["sum"] == 3.0
