@@ -24,7 +24,7 @@ Everything here is shape-static: safe under jit/vmap, one device dispatch.
 from typing import Tuple
 
 import jax.numpy as jnp
-from jax import Array
+from jax import Array, lax
 
 
 def _run_end(values: Array, valid: Array) -> Array:
@@ -34,10 +34,15 @@ def _run_end(values: Array, valid: Array) -> Array:
     snap to the global total (``values[-1]``), which is also the correct
     run-end when trailing positions are masked-out ghost rows (their zero
     weight leaves the cumulative sum at the total).
+
+    Implemented with ``lax.cummin(reverse=True)`` — the parallel cumulative
+    scan. (NOT ``jnp.minimum.accumulate``, whose ufunc path lowers to a
+    sequential ``lax.scan``: ~16 s for 4M elements on a v5e, ~1600x the cost
+    of the sort this kernel is built around.)
     """
     masked = jnp.where(valid, values, jnp.inf)
-    snapped = jnp.flip(jnp.minimum.accumulate(jnp.flip(masked, -1), axis=-1), -1)
-    return jnp.minimum(snapped, values[-1])
+    snapped = lax.cummin(masked, axis=values.ndim - 1, reverse=True)
+    return jnp.minimum(snapped, values[..., -1:])
 
 
 def _sorted_counts(
@@ -54,12 +59,14 @@ def _sorted_counts(
     """
     if row_mask is not None:
         preds = jnp.where(row_mask, preds, -jnp.inf)
-    order = jnp.argsort(-preds)
-    scores = preds[order]
-    y = target[order].astype(jnp.float32)
-    w = jnp.ones_like(y) if weights is None else weights[order].astype(jnp.float32)
+    # multi-operand lax.sort carries the values along with the key in one
+    # pass — on TPU this is much cheaper than argsort + O(N) gathers
+    y_in = target.astype(jnp.float32)
+    w_in = jnp.ones_like(y_in) if weights is None else weights.astype(jnp.float32)
     if row_mask is not None:
-        w = w * row_mask[order].astype(jnp.float32)
+        w_in = w_in * row_mask.astype(jnp.float32)
+    neg_scores, y, w = lax.sort((-preds, y_in, w_in), num_keys=1)
+    scores = -neg_scores
 
     tps = jnp.cumsum(y * w)
     fps = jnp.cumsum((1.0 - y) * w)
