@@ -19,7 +19,6 @@ from metrics_tpu.utils.profiling import profile_metric, time_fn  # noqa: E402
 from metrics_tpu.classification import (  # noqa: E402
     AUC,
     AUROC,
-    F1,
     Accuracy,
     AveragePrecision,
     BinnedAUROC,
@@ -29,17 +28,21 @@ from metrics_tpu.classification import (  # noqa: E402
     CalibrationError,
     CohenKappa,
     ConfusionMatrix,
+    CoverageError,
     Dice,
+    F1,
     FBeta,
     HammingDistance,
     HingeLoss,
     IoU,
     JaccardIndex,
+    LabelRankingAveragePrecision,
+    LabelRankingLoss,
     MatthewsCorrcoef,
     Precision,
     PrecisionRecallCurve,
-    Recall,
     ROC,
+    Recall,
     Specificity,
     StatScores,
 )

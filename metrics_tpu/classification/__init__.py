@@ -21,3 +21,8 @@ from metrics_tpu.classification.roc import ROC
 from metrics_tpu.classification.stat_scores import StatScores
 from metrics_tpu.classification.calibration_error import CalibrationError
 from metrics_tpu.classification.hinge import HingeLoss
+from metrics_tpu.classification.ranking import (
+    CoverageError,
+    LabelRankingAveragePrecision,
+    LabelRankingLoss,
+)

@@ -1,0 +1,96 @@
+"""Stateful multilabel ranking metrics. Extension beyond the reference snapshot.
+
+All three stream two scalar sum-states (per-sample total + count), so the
+distributed story is a single fused psum — no cat-state growth with dataset
+size. Semantics (ties, degenerate rows) match sklearn; see
+``functional/classification/ranking.py``.
+"""
+from typing import Any, Callable, Optional, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.classification.ranking import (
+    _coverage_error_update,
+    _label_ranking_ap_update,
+    _label_ranking_loss_update,
+)
+from metrics_tpu.utils.data import accum_int_dtype
+
+
+class _RankingMetric(Metric):
+    """Shared streaming base: accumulate (per-sample total, sample count)."""
+
+    _update_fn: Optional[Callable[[Array, Array], Tuple[Array, Array]]] = None
+
+    def __init__(
+        self,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.add_state("measure", default=np.zeros((), dtype=np.float32), dist_reduce_fx="sum")
+        self.add_state("total", default=np.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        measure, n = type(self)._update_fn(preds, target)
+        self.measure = self.measure + measure
+        self.total = self.total + n
+
+    def compute(self) -> Array:
+        return self.measure / jnp.maximum(self.total.astype(jnp.float32), 1.0)
+
+
+class CoverageError(_RankingMetric):
+    """Multilabel coverage error (sklearn ``coverage_error``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> metric = CoverageError()
+        >>> _ = metric(jnp.array([[0.9, 0.1, 0.5]]), jnp.array([[1, 0, 1]]))
+        >>> _ = metric(jnp.array([[0.2, 0.8, 0.6]]), jnp.array([[0, 1, 0]]))
+        >>> float(metric.compute())
+        1.5
+    """
+
+    _update_fn = staticmethod(_coverage_error_update)
+
+
+class LabelRankingAveragePrecision(_RankingMetric):
+    """Label-ranking average precision
+    (sklearn ``label_ranking_average_precision_score``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> metric = LabelRankingAveragePrecision()
+        >>> _ = metric(jnp.array([[0.75, 0.5, 1.0]]), jnp.array([[1, 0, 0]]))
+        >>> _ = metric(jnp.array([[1.0, 0.2, 0.1]]), jnp.array([[0, 0, 1]]))
+        >>> round(float(metric.compute()), 4)
+        0.4167
+    """
+
+    _update_fn = staticmethod(_label_ranking_ap_update)
+
+
+class LabelRankingLoss(_RankingMetric):
+    """Label ranking loss (sklearn ``label_ranking_loss``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> metric = LabelRankingLoss()
+        >>> _ = metric(jnp.array([[0.2, 0.8, 0.6]]), jnp.array([[0, 1, 0]]))
+        >>> _ = metric(jnp.array([[0.9, 0.6, 0.5]]), jnp.array([[1, 0, 1]]))
+        >>> float(metric.compute())
+        0.25
+    """
+
+    _update_fn = staticmethod(_label_ranking_loss_update)

@@ -20,6 +20,11 @@ from metrics_tpu.functional.classification.specificity import specificity
 from metrics_tpu.functional.classification.precision_recall_curve import precision_recall_curve
 from metrics_tpu.functional.classification.roc import roc
 from metrics_tpu.functional.classification.stat_scores import stat_scores
+from metrics_tpu.functional.classification.ranking import (
+    coverage_error,
+    label_ranking_average_precision,
+    label_ranking_loss,
+)
 from metrics_tpu.functional.regression.cosine_similarity import cosine_similarity
 from metrics_tpu.functional.regression.explained_variance import explained_variance
 from metrics_tpu.functional.regression.kl_divergence import kl_divergence

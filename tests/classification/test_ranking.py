@@ -1,0 +1,102 @@
+"""Multilabel ranking metrics vs sklearn oracles."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import (
+    coverage_error as sk_coverage,
+    label_ranking_average_precision_score as sk_lrap,
+    label_ranking_loss as sk_rloss,
+)
+
+from metrics_tpu import CoverageError, LabelRankingAveragePrecision, LabelRankingLoss
+from metrics_tpu.functional import (
+    coverage_error,
+    label_ranking_average_precision,
+    label_ranking_loss,
+)
+from tests.helpers.testers import MetricTester
+
+_rng = np.random.RandomState(41)
+NUM_BATCHES, BATCH_SIZE, NUM_LABELS = 10, 32, 7
+
+_preds = _rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_LABELS).astype(np.float32)
+_target = (_rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_LABELS) > 0.6).astype(np.int32)
+# guarantee the fixtures exercise the degenerate rows too
+_target[0, 0] = 0
+_target[1, 1] = 1
+
+
+def _flat(fn):
+    def wrapped(preds, target):
+        p = np.asarray(preds).reshape(-1, NUM_LABELS)
+        t = np.asarray(target).reshape(-1, NUM_LABELS)
+        return fn(t, p)
+
+    return wrapped
+
+
+_CASES = [
+    (CoverageError, coverage_error, _flat(sk_coverage)),
+    (LabelRankingAveragePrecision, label_ranking_average_precision, _flat(sk_lrap)),
+    (LabelRankingLoss, label_ranking_loss, _flat(sk_rloss)),
+]
+
+
+@pytest.mark.parametrize("metric_class, functional, sk_metric", _CASES)
+class TestRanking(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_ranking_class(self, metric_class, functional, sk_metric, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_preds,
+            target=_target,
+            metric_class=metric_class,
+            sk_metric=sk_metric,
+            dist_sync_on_step=False,
+        )
+
+    def test_ranking_functional(self, metric_class, functional, sk_metric):
+        self.run_functional_metric_test(
+            _preds, _target, metric_functional=functional, sk_metric=sk_metric
+        )
+
+
+def test_ranking_ties_match_sklearn():
+    """Tied scores across (true, false) pairs follow sklearn exactly."""
+    preds = np.array([[0.5, 0.5, 0.3, 0.3]], dtype=np.float32)
+    target = np.array([[1, 0, 1, 0]])
+    jp, jt = jnp.asarray(preds), jnp.asarray(target)
+    assert float(coverage_error(jp, jt)) == sk_coverage(target, preds)
+    assert abs(float(label_ranking_average_precision(jp, jt)) - sk_lrap(target, preds)) < 1e-7
+    assert float(label_ranking_loss(jp, jt)) == sk_rloss(target, preds)
+
+
+def test_ranking_degenerate_rows():
+    """No-true and all-true rows: coverage 0, LRAP 1, loss 0 (sklearn)."""
+    preds = jnp.asarray(np.array([[0.1, 0.9], [0.4, 0.2]], dtype=np.float32))
+    none_true = jnp.asarray(np.zeros((2, 2), dtype=np.int32))
+    all_true = jnp.asarray(np.ones((2, 2), dtype=np.int32))
+    assert float(coverage_error(preds, none_true)) == 0.0
+    assert float(label_ranking_average_precision(preds, none_true)) == 1.0
+    assert float(label_ranking_average_precision(preds, all_true)) == 1.0
+    assert float(label_ranking_loss(preds, none_true)) == 0.0
+    assert float(label_ranking_loss(preds, all_true)) == 0.0
+
+
+def test_ranking_shape_validation():
+    with pytest.raises(ValueError, match="identical shape"):
+        coverage_error(jnp.zeros((4, 3)), jnp.zeros((4, 2)))
+    with pytest.raises(ValueError, match="identical shape"):
+        label_ranking_loss(jnp.zeros((4,)), jnp.zeros((4,)))
+
+
+def test_ranking_jit_safe():
+    import jax
+
+    p = jnp.asarray(_preds[0])
+    t = jnp.asarray(_target[0])
+    got = jax.jit(label_ranking_average_precision)(p, t)
+    want = sk_lrap(np.asarray(t), np.asarray(p))
+    np.testing.assert_allclose(float(got), want, atol=1e-6)
