@@ -6,6 +6,12 @@ Each anchor times the reference (torchmetrics at /root/reference, torch CPU —
 the only reference runtime available in this image) against this framework on
 the default backend. Results are recorded in BASELINE.md.
 
+JAX-side timings use forced-execution protocols ONLY (chained device loops /
+host-level chains ending in a value readback, differenced over two K) —
+`jax.block_until_ready` does not await execution through the axon TPU tunnel
+and must never be the sync for a measurement. See the protocol block below
+and benchmarks/roofline.py.
+
 Anchors (from BASELINE.json "configs"):
   1. README Accuracy example: 10 batches of (10, 5) softmax preds — per-step
      forward + final compute.
@@ -30,6 +36,8 @@ sys.path.insert(1, "/root/reference")
 
 
 def _timeit(fn, iters=20, warmup=3, sync=None):
+    """Direct loop timing — valid for synchronous execution only (torch CPU,
+    or JAX paths that end in a forcing value readback every call)."""
     out = None
     for _ in range(warmup):
         out = fn()
@@ -47,6 +55,38 @@ def _jax_sync(out):
     import jax
 
     jax.block_until_ready(out)
+
+
+# ---------------------------------------------------------------------------
+# Tunnel-proof timing for the JAX side. Through the axon TPU tunnel,
+# `jax.block_until_ready` does NOT await device execution (measured: ~0.1 ms
+# for a 64M sort that takes ~300 ms; only a VALUE readback forces it), so
+# any `_timeit(..., sync=_jax_sync)` on the TPU backend under-reports.
+# Two forced-execution protocols replace it (see benchmarks/roofline.py):
+#   * device plane: K data-chained kernel calls inside one jitted fori_loop
+#     (`roofline._chained_loop_time`), timed by scalar readback at two K —
+#     the ~99 ms readback floor cancels in the difference;
+#   * host plane (stateful API): K epochs of real API calls whose state
+#     chains on device, ONE forcing readback at the end (`_host_delta_time`)
+#     — same two-K differencing.
+# ---------------------------------------------------------------------------
+
+
+def _host_delta_time(run_epochs, k1, k2, repeats=3):
+    """Per-epoch ms of a host-driven loop ending in a forcing readback.
+
+    `run_epochs(k)` must execute k epochs through the REAL user API (every
+    epoch's work data-chained through accumulated device state) and finish
+    with a value readback. Per-epoch = (T(k2) - T(k1)) / (k2 - k1); the
+    readback floor and constant host overhead cancel
+    (`benchmarks.timing.two_k_delta`).
+    """
+    from benchmarks.timing import best_of, two_k_delta
+
+    run_epochs(k1)  # warm every compile path
+    return two_k_delta(
+        lambda k: best_of(lambda: run_epochs(k), repeats=repeats), k1, k2
+    ) * 1e3
 
 
 def anchor1_readme_accuracy():
@@ -74,22 +114,28 @@ def anchor1_readme_accuracy():
     jp_stacked = jnp.asarray(probs)
     jt_stacked = jnp.asarray(target)
 
-    def ours():
+    def run_batched(k):
         # the idiomatic TPU form of the same workload: all 10 per-step values
         # + the epoch value in ONE lax.scan dispatch (forward_batched);
-        # per-step semantics identical to the eager loop
+        # per-step semantics identical to the eager loop. k epochs chain
+        # through the accumulated state; the final compute readback forces
+        # every dispatch.
         m = Accuracy()
-        vals = m.forward_batched(jp_stacked, jt_stacked)
-        return vals, m.compute()
+        for _ in range(k):
+            m.forward_batched(jp_stacked, jt_stacked)
+        return float(m.compute())
 
-    def ours_eager_loop():
+    def run_eager(k):
         m = Accuracy()
-        for i in range(10):
-            m(jp[i], jt[i])
-        return m.compute()
+        for _ in range(k):
+            for i in range(10):
+                m(jp[i], jt[i])
+        return float(m.compute())
 
-    extra = {"ours_eager_loop_ms": round(_timeit(ours_eager_loop, sync=_jax_sync), 3)}
-    return _timeit(ref), _timeit(ours, sync=_jax_sync), extra
+    batched_ms = _host_delta_time(run_batched, k1=1, k2=11)
+    eager_ms = _host_delta_time(run_eager, k1=1, k2=6)
+    extra = {"ours_eager_loop_ms": round(eager_ms, 3)}
+    return _timeit(ref), batched_ms, extra
 
 
 def anchor2_functional_kernels():
@@ -108,19 +154,24 @@ def anchor2_functional_kernels():
     def ref():
         return t_cm(tp_, tt_, num_classes=c), t_ss(tp_, tt_, num_classes=c, reduce="macro")
 
-    import jax
     import jax.numpy as jnp
 
+    from benchmarks.roofline import _chained_loop_time
     from metrics_tpu.functional import confusion_matrix as j_cm
     from metrics_tpu.functional import stat_scores as j_ss
 
     jp_, jt_ = jnp.asarray(preds), jnp.asarray(target)
 
-    @jax.jit
-    def ours_fn():
-        return j_cm(jp_, jt_, num_classes=c), j_ss(jp_, jt_, num_classes=c, reduce="macro")
+    def both_scalar(p, t):
+        cm = j_cm(p, t, num_classes=c)
+        ss = j_ss(p, t, num_classes=c, reduce="macro")
+        return cm[0, 0].astype(jnp.float32) + ss[0, 0].astype(jnp.float32)
 
-    return _timeit(ref), _timeit(ours_fn, sync=_jax_sync)
+    def perturb(p, s):
+        return p.at[0].set((p[0] + s.astype(jnp.int32)) % c)
+
+    ours_ms = _chained_loop_time(both_scalar, perturb, jp_, (jt_,), k1=2, k2=52) * 1e3
+    return _timeit(ref), ours_ms
 
 
 def anchor4_curve_metrics():
@@ -139,35 +190,43 @@ def anchor4_curve_metrics():
     def ref():
         return t_auroc(ts, tt, pos_label=1), t_ap(ts, tt, pos_label=1)
 
-    import jax
     import jax.numpy as jnp
 
+    from benchmarks.roofline import _chained_loop_time
     from metrics_tpu.functional import auroc as j_auroc
     from metrics_tpu.functional import average_precision as j_ap
 
     js, jt = jnp.asarray(scores), jnp.asarray(target)
 
-    def ours_fn():
-        # static-shape exact kernels (curve_static.py); reference-parity
-        # eager value validation included — each validated call pays one
-        # device->host readback (~200 ms through the axon tunnel, ~us on
-        # locally attached TPU)
+    # the idiomatic TPU deployment: the whole exact-curve compute is jittable
+    # and collapses to ONE program — device-chained loop timing
+    def both_scalar(s, t):
+        return j_auroc(s, t, pos_label=1, validate=False) + j_ap(s, t, pos_label=1)
+
+    def perturb(s, v):
+        return s.at[0].set(jnp.abs(v - jnp.floor(v)) % 1.0)
+
+    jitted_ms = _chained_loop_time(both_scalar, perturb, js, (jt,), k1=2, k2=22) * 1e3
+
+    # eager validate-off: per-op dispatch, chained at host level through a
+    # result-dependent input perturbation; final readback forces the chain
+    def run_eager_noval(k):
+        s = js
+        for _ in range(k):
+            a = j_auroc(s, jt, pos_label=1, validate=False)
+            ap = j_ap(s, jt, pos_label=1)
+            s = s.at[0].set(jnp.abs(a + ap) % 1.0)
+        return float(s[0])
+
+    validate_off_ms = _host_delta_time(run_eager_noval, k1=1, k2=6)
+
+    # validated eager (reference-parity value checks): each call already ends
+    # in forcing readbacks inside the validators, so direct timing is honest;
+    # measured LAST — through the tunnel its readbacks degrade later dispatch
+    def ours_validated():
         return j_auroc(js, jt, pos_label=1), j_ap(js, jt, pos_label=1)
 
-    def ours_no_validate():
-        return j_auroc(js, jt, pos_label=1, validate=False), j_ap(js, jt, pos_label=1)
-
-    # the idiomatic TPU deployment: the whole exact-curve compute is jittable
-    # and collapses to ONE dispatch, immune to per-op tunnel latency
-    jitted = jax.jit(lambda s, t: (j_auroc(s, t, pos_label=1, validate=False), j_ap(s, t, pos_label=1)))
-    jax.block_until_ready(jitted(js, jt))
-
-    # measurement order matters through the tunnel: the validated path does a
-    # device->host readback per call, which permanently degrades later
-    # dispatch in this process — so the clean jitted/eager numbers come first
-    jitted_ms = _timeit(lambda: jitted(js, jt), sync=_jax_sync)
-    validate_off_ms = _timeit(ours_no_validate, sync=_jax_sync)
-    validated_ms = _timeit(ours_fn, sync=_jax_sync)
+    validated_ms = _timeit(ours_validated, iters=5, sync=_jax_sync)
     extra = {
         "ours_validate_off_ms": round(validate_off_ms, 3),
         "ours_jitted_ms": round(jitted_ms, 3),
@@ -200,20 +259,26 @@ def anchor5_retrieval():
 
     ji, jp_, jt_ = jnp.asarray(idx), jnp.asarray(preds), jnp.asarray(target)
 
-    def ours():
-        # MAP only — like-for-like with the reference (which ships no NDCG);
-        # NDCG is timed separately and reported without a reference ratio
-        m = RetrievalMAP()
-        m.update(ji, jp_, jt_)
-        return m.compute()
+    def _run_rounds(cls, k):
+        # the real user path — fresh metric per round (constant epoch size,
+        # like-for-like with the reference closure), update() appends,
+        # compute() runs the shared jitted whole-epoch program. Rounds chain
+        # through a result-dependent perturbation of the scores; the final
+        # float() forces every round's execution.
+        p = jp_
+        for _ in range(k):
+            m = cls()
+            m.update(ji, p, jt_)
+            out = m.compute()
+            p = p.at[0].set(jnp.abs(out) % 1.0)
+        return float(p[0])
 
-    def ours_ndcg():
-        m = RetrievalNormalizedDCG()
-        m.update(ji, jp_, jt_)
-        return m.compute()
-
-    extra = {"ndcg_ours_ms": round(_timeit(ours_ndcg, iters=5, sync=_jax_sync), 3)}
-    return _timeit(ref, iters=5), _timeit(ours, iters=5, sync=_jax_sync), extra
+    # MAP only in the headline — like-for-like with the reference (no NDCG)
+    extra = {"ndcg_ours_ms": round(
+        _host_delta_time(lambda k: _run_rounds(RetrievalNormalizedDCG, k), k1=1, k2=4), 3)}
+    return (_timeit(ref, iters=5),
+            _host_delta_time(lambda k: _run_rounds(RetrievalMAP, k), k1=1, k2=4),
+            extra)
 
 
 ANCHORS = {

@@ -1,0 +1,395 @@
+"""Throughput / roofline sweep for the hot reduction kernels on the real chip.
+
+Run:  python benchmarks/roofline.py [--json] [--with-reference]
+
+Answers the question BASELINE.md's anchor table cannot: the anchors measure
+small-workload *dispatch latency* (a few ms through the axon tunnel), not
+sustained *throughput*. A reductions library is fast at scale iff its kernels
+are HBM-bandwidth-bound at saturation sizes — this sweep measures achieved
+HBM GB/s at N in {4M, 16M, 64M} against the v5e roofline (819 GB/s peak HBM
+bandwidth per chip) and records the result in BASELINE.md.
+
+Methodology (the axon-tunnel-proof protocol — both naive protocols FAIL):
+  * Through this image's axon tunnel, `jax.block_until_ready` is a NO-OP:
+    it returns in ~0.1 ms for a 64M-element sort whose real execution takes
+    ~300 ms; only a device->host VALUE readback (e.g. `float(out)`) forces
+    and awaits execution. Any timing built on `block_until_ready` (async
+    K-dispatch or otherwise) reports impossible numbers (40+ TB/s, AUROC
+    "faster" than a bare sort) — measured and discarded here.
+  * A readback costs a ~99 ms tunnel round-trip floor, so per-call time is
+    measured differentially: one jitted program runs the kernel K times in
+    a `lax.fori_loop` whose input is CHAINED on the previous iteration's
+    result (a one-element, result-dependent in-place write on the loop
+    carry — XLA cannot hoist, fuse away, or elide iterations), the program
+    is timed via scalar readback at two different K, and
+    per-call = (T(K2) - T(K1)) / (K2 - K1). The floor, dispatch, and
+    compile-independent constants cancel exactly.
+  * Bytes model per kernel counts the MINIMUM traffic the algorithm must
+    move (each input array read once + outputs written once). Achieved
+    GB/s = min_bytes / time is therefore a LOWER bound on the bandwidth the
+    chip actually sustained; fractions >100% of a multi-pass kernel's
+    single-pass model are impossible, so numbers near the roofline mean the
+    kernel is bandwidth-bound with no wasted traffic.
+
+Kernels (the stat-reduction hot path, per VERDICT r3 item 3):
+  * stat_scores   — binary micro: threshold + compare + 4 masked sums.
+                    min bytes = 5N (f32 preds + int8 target).
+  * confusion_matrix — C=64 labels: bincount(target*C+preds) scatter.
+                    min bytes = 8N (two int32 label arrays) + 4*C^2.
+  * binned_stat_counts — binary, T=512 thresholds: the einsum contraction.
+                    min bytes = 12N (preds/pos/neg f32). Compute is O(N*T)
+                    comparisons+MACs, so at T=512 this kernel can also be
+                    MXU-bound; both limits are reported.
+  * binary_auroc_static — sort-dominated exact curve. A radix/bitonic sort
+                    is inherently multi-pass (O(log N) sweeps), so the
+                    single-pass model (12N: f32 preds + f32 target read,
+                    cumsum writes) far understates real traffic; the honest
+                    framing is elements/s against XLA's own jnp.sort as the
+                    platform primitive baseline, also measured.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# NOTE: do NOT run with PYTHONPATH set (breaks axon plugin registration);
+# insert the repo root here instead.
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# persistent XLA compile cache: the sort-in-loop programs take ~1 min to
+# compile; cached, a full re-run of the sweep is minutes, not an hour
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(_REPO, ".jax_cache_tpu"))
+
+V5E_HBM_GBPS = 819.0  # TPU v5e (lite) peak HBM bandwidth per chip
+V5E_F32_TFLOPS = 98.3  # v5e peak fp32-accumulate MXU throughput (bf16 in)
+V5E_BF16_TFLOPS = 197.0  # v5e peak bf16 MXU throughput
+
+SIZES = [4 * 2**20, 16 * 2**20, 64 * 2**20]  # 4M, 16M, 64M
+T_BINS = 512
+C_CLASSES = 64
+
+
+def _chained_loop_time(kernel_scalar_fn, perturb_fn, first_arg, rest_args, k1, k2):
+    """Differential chained-loop timing; return true seconds per kernel call.
+
+    `kernel_scalar_fn(first_arg, *rest_args) -> f32 scalar` reduces the
+    kernel's output; `perturb_fn(first_arg, scalar) -> first_arg` writes a
+    result-dependent one-element perturbation into the input so iteration
+    i+1 data-depends on iteration i (no hoisting / overlap / elision). The
+    loop body's extra cost is one one-element in-place update on the loop
+    carry — negligible against an N-element kernel. Each program is timed
+    via a forcing scalar readback (`float(out)`); the tunnel's ~99 ms
+    readback floor cancels in the (K2 - K1) difference.
+    """
+    import functools
+
+    import jax
+    from jax import lax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def run(iters, p0, *rest):
+        def body(_, state):
+            p, acc = state
+            s = kernel_scalar_fn(p, *rest)
+            return perturb_fn(p, s), acc + s
+
+        return lax.fori_loop(0, iters, body, (p0, jnp.float32(0.0)))[1]
+
+    from benchmarks.timing import best_of, two_k_delta
+
+    def timed(iters):
+        float(run(iters, first_arg, *rest_args))  # compile + warmup execution
+        return best_of(lambda: float(run(iters, first_arg, *rest_args)))
+
+    # adaptive K: a fast kernel's delta must clear the ~ms readback-floor
+    # jitter, so k2 grows until the measured difference is >= 40 ms
+    return two_k_delta(timed, k1, k2, adaptive=True)
+
+
+def _host_chained_time(step_fn, first_arg, rest_args, k1, k2):
+    """Host-level chained timing for kernels whose fori_loop form crashes the
+    TPU compiler (the sort-based ones). `step_fn(x, *rest) -> x'` is ONE
+    jitted program whose output array data-depends on the kernel's result;
+    iterating it host-side chains k dispatches (async submission, ~0.1 ms,
+    negligible against the >=10 ms sort kernels this is used for), and one
+    final readback forces the whole chain. Same two-K differencing.
+    """
+    import jax
+
+    from benchmarks.timing import best_of, two_k_delta
+
+    step = jax.jit(step_fn)
+
+    def one_run(iters):
+        x = first_arg
+        for _ in range(iters):
+            x = step(x, *rest_args)
+        float(x.ravel()[0])
+
+    def timed(iters):
+        one_run(1)  # compile + warmup
+        return best_of(lambda: one_run(iters))
+
+    return two_k_delta(timed, k1, k2)
+
+
+KERNELS = ["stat_scores", "confusion_matrix", "confusion_matrix_scatter",
+           "binned_stat_counts", "auroc", "sort"]
+
+
+def measure_row(kernel, n):
+    """Measure one (kernel, n) cell; runs in its own subprocess."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.functional.classification.curve_static import binary_auroc_static
+    from metrics_tpu.functional.classification.stat_scores import _stat_scores
+    from metrics_tpu.ops.binned import binned_stat_counts
+
+    rng = np.random.RandomState(0)
+
+    def perturb_f32(p, s):
+        # result-dependent, value-bounded (stays in [0, 1)) one-element write
+        return p.at[0].set(jnp.abs(s - jnp.floor(s)) % 1.0)
+
+    if kernel == "stat_scores":
+        preds_f = jnp.asarray(rng.rand(n).astype(np.float32))
+        target_i8 = jnp.asarray((rng.rand(n) > 0.5).astype(np.int8))
+
+        def ss_scalar(p, t):
+            b = (p >= 0.5).astype(jnp.int8)[:, None]
+            tp, fp, tn, fn = _stat_scores(b, t[:, None], reduce="micro")
+            return (tp + fp + tn + fn).astype(jnp.float32)
+
+        sec = _chained_loop_time(ss_scalar, perturb_f32, preds_f, (target_i8,), k1=2, k2=22)
+        bytes_ = 5 * n  # f32 preds + int8 target
+        return {
+            "kernel": "stat_scores[binary,micro]", "n": n, "ms": sec * 1e3,
+            "model_bytes": bytes_, "gbps": bytes_ / sec / 1e9,
+            "roofline_frac": bytes_ / sec / 1e9 / V5E_HBM_GBPS,
+        }
+
+    if kernel in ("confusion_matrix", "confusion_matrix_scatter"):
+        labels_p = jnp.asarray(rng.randint(0, C_CLASSES, n).astype(np.int32))
+        labels_t = jnp.asarray(rng.randint(0, C_CLASSES, n).astype(np.int32))
+
+        if kernel == "confusion_matrix":
+            # the PRODUCT kernel: one-hot MXU contraction (confusion_matrix.py)
+            from metrics_tpu.functional.classification.confusion_matrix import _bincount_2d
+
+            def cm_scalar(p, t):
+                return _bincount_2d(t, p, C_CLASSES)[0, 0].astype(jnp.float32)
+
+            label = f"confusion_matrix[C={C_CLASSES},MXU one-hot]"
+        else:
+            # CONTRAST row: the reference's bincount algorithm as-is on TPU —
+            # a scatter, which serializes; the reason the product kernel is a
+            # matmul instead
+            def cm_scalar(p, t):
+                flat = t * C_CLASSES + p
+                cm = jnp.bincount(flat, length=C_CLASSES * C_CLASSES)
+                return cm[0].astype(jnp.float32)
+
+            label = f"confusion_matrix[C={C_CLASSES},scatter-bincount]"
+
+        def perturb_i32(p, s):
+            return p.at[0].set((p[0] + s.astype(jnp.int32)) % C_CLASSES)
+
+        k1, k2 = (2, 22) if kernel == "confusion_matrix" else (1, 3)
+        sec = _chained_loop_time(cm_scalar, perturb_i32, labels_p, (labels_t,), k1=k1, k2=k2)
+        bytes_ = 8 * n + 4 * C_CLASSES * C_CLASSES
+        flops = 2.0 * n * C_CLASSES * C_CLASSES  # one-hot contraction MACs
+        row = {
+            "kernel": label, "n": n, "ms": sec * 1e3,
+            "model_bytes": bytes_, "gbps": bytes_ / sec / 1e9,
+            "roofline_frac": bytes_ / sec / 1e9 / V5E_HBM_GBPS,
+        }
+        if kernel == "confusion_matrix":
+            row["tflops"] = flops / sec / 1e12
+            row["mxu_frac"] = flops / sec / 1e12 / V5E_BF16_TFLOPS
+        return row
+
+    if kernel == "binned_stat_counts":
+        preds_f = jnp.asarray(rng.rand(n).astype(np.float32))
+        target_i8 = jnp.asarray((rng.rand(n) > 0.5).astype(np.int8))
+        thresholds = jnp.linspace(0.0, 1.0, T_BINS)
+        pos = target_i8.astype(jnp.float32)[:, None]
+        neg = 1.0 - pos
+        pc = preds_f[:, None]
+
+        def bc_scalar(p, po, ne, th):
+            tp, fp = binned_stat_counts(p, po, ne, th)
+            return tp[0, 0] + fp[0, -1]
+
+        def perturb_col(p, s):
+            return p.at[0, 0].set(jnp.abs(s - jnp.floor(s)) % 1.0)
+
+        sec = _chained_loop_time(bc_scalar, perturb_col, pc, (pos, neg, thresholds), k1=2, k2=12)
+        bytes_ = 12 * n
+        flops = 2.0 * n * T_BINS * 2  # tp and fp contractions: compare+MAC each
+        return {
+            "kernel": f"binned_stat_counts[T={T_BINS}]", "n": n, "ms": sec * 1e3,
+            "model_bytes": bytes_, "gbps": bytes_ / sec / 1e9,
+            "roofline_frac": bytes_ / sec / 1e9 / V5E_HBM_GBPS,
+            "tflops": flops / sec / 1e12,
+            "mxu_frac": flops / sec / 1e12 / V5E_F32_TFLOPS,
+        }
+
+    if kernel == "auroc":
+        preds_f = jnp.asarray(rng.rand(n).astype(np.float32))
+        target_f = jnp.asarray((rng.rand(n) > 0.5).astype(np.float32))
+
+        def auroc_step(p, t):
+            v = binary_auroc_static(p, t)
+            return p.at[0].set(jnp.abs(v - jnp.floor(v)) % 1.0)
+
+        sec = _host_chained_time(auroc_step, preds_f, (target_f,), k1=1, k2=4)
+        bytes_ = 12 * n  # single-pass model; real sort traffic is O(N log N)
+        return {
+            "kernel": "binary_auroc_static", "n": n, "ms": sec * 1e3,
+            "model_bytes": bytes_, "gbps": bytes_ / sec / 1e9,
+            "roofline_frac": bytes_ / sec / 1e9 / V5E_HBM_GBPS,
+            "melem_per_s": n / sec / 1e6,
+        }
+
+    if kernel == "sort":
+        preds_f = jnp.asarray(rng.rand(n).astype(np.float32))
+
+        def sort_step(p):
+            v = jnp.sort(p)[-1]
+            return p.at[0].set(jnp.abs(v - jnp.floor(v)) % 1.0)
+
+        sec = _host_chained_time(sort_step, preds_f, (), k1=1, k2=4)
+        return {
+            "kernel": "jnp.sort (platform primitive)", "n": n, "ms": sec * 1e3,
+            "melem_per_s": n / sec / 1e6,
+        }
+
+    raise ValueError(f"unknown kernel {kernel!r}")
+
+
+def reference_numbers():
+    """torch-CPU reference timings of the equivalent ops (context column)."""
+    import torch
+
+    rng = np.random.RandomState(0)
+    out = []
+    for n in SIZES:
+        preds_f = torch.from_numpy(rng.rand(n).astype(np.float32))
+        target = torch.from_numpy((rng.rand(n) > 0.5).astype(np.int64))
+        labels_p = torch.from_numpy(rng.randint(0, C_CLASSES, n))
+        labels_t = torch.from_numpy(rng.randint(0, C_CLASSES, n))
+
+        def t_ss():
+            b = (preds_f >= 0.5).long()
+            correct = b == target
+            pos = b == 1
+            return ((correct & pos).sum(), (~correct & pos).sum(),
+                    (correct & ~pos).sum(), (~correct & ~pos).sum())
+
+        def t_cm():
+            return torch.bincount(labels_t * C_CLASSES + labels_p,
+                                  minlength=C_CLASSES * C_CLASSES)
+
+        def t_sort():
+            return torch.sort(preds_f)
+
+        iters = 3
+        for name, fn in [("stat_scores[binary,micro]", t_ss),
+                         (f"confusion_matrix[C={C_CLASSES}]", t_cm),
+                         ("sort", t_sort)]:
+            fn()
+            start = time.perf_counter()
+            for _ in range(iters):
+                fn()
+            out.append({"kernel": name, "n": n,
+                        "ms": (time.perf_counter() - start) / iters * 1e3})
+    return out
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--json", action="store_true")
+    parser.add_argument("--with-reference", action="store_true",
+                        help="also time torch-CPU equivalents (separate process recommended)")
+    parser.add_argument("--reference-only", action="store_true")
+    parser.add_argument("--row", default=None, help="measure one kernel:n cell (internal)")
+    args = parser.parse_args()
+
+    if args.reference_only:
+        print(json.dumps(reference_numbers()))
+        return
+
+    if args.row is not None:
+        kernel, n = args.row.rsplit(":", 1)
+        print(json.dumps(measure_row(kernel, int(n))))
+        return
+
+    # one subprocess per row: a TPU-worker crash (seen once under whole-sweep
+    # memory pressure) then loses one cell, not the sweep
+    import subprocess
+
+    rows = []
+    for n in SIZES:
+        for kernel in KERNELS:
+            try:
+                proc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__), "--row", f"{kernel}:{n}"],
+                    capture_output=True, text=True, timeout=1200,
+                )
+            except subprocess.TimeoutExpired:
+                rows.append({"kernel": kernel, "n": n, "error": "timeout after 1200s"})
+                continue
+            lines = [ln for ln in proc.stdout.strip().splitlines() if ln.startswith("{")]
+            if proc.returncode != 0 or not lines:
+                rows.append({"kernel": kernel, "n": n,
+                             "error": (proc.stderr or proc.stdout)[-300:]})
+                continue
+            rows.append(json.loads(lines[-1]))
+
+    result = {"device": None, "rows": rows}
+    import jax
+
+    result["device"] = str(jax.devices()[0])
+
+    if args.with_reference:
+        import subprocess
+
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--reference-only"],
+            capture_output=True, text=True, timeout=1800,
+        )
+        lines = [ln for ln in proc.stdout.strip().splitlines() if ln.startswith("[")]
+        if lines:
+            result["reference"] = json.loads(lines[-1])
+
+    if args.json:
+        print(json.dumps(result))
+        return
+
+    print(f"device: {result['device']}")
+    print(f"{'kernel':<32} {'N':>8} {'ms':>9} {'GB/s':>8} {'%roof':>6}  extra")
+    for r in rows:
+        if "error" in r:
+            print(f"{r['kernel']:<32} {r['n']//2**20:>6}M  ERROR: {r['error'][:80]}")
+            continue
+        extra = ""
+        if "tflops" in r:
+            extra = f"{r['tflops']:.1f} TF/s ({r['mxu_frac']*100:.0f}% MXU)"
+        if "melem_per_s" in r:
+            extra = f"{r['melem_per_s']:.0f} Melem/s"
+        gbps = f"{r['gbps']:>8.1f}" if "gbps" in r else " " * 8
+        roof = f"{r['roofline_frac']*100:>5.0f}%" if "roofline_frac" in r else " " * 6
+        print(f"{r['kernel']:<32} {r['n']//2**20:>6}M {r['ms']:>9.3f} {gbps} {roof}  {extra}")
+    if "reference" in result:
+        print("\ntorch-CPU reference:")
+        for r in result["reference"]:
+            print(f"{r['kernel']:<32} {r['n']//2**20:>6}M {r['ms']:>9.1f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""The shared tunnel-proof timing protocol for every JAX-side benchmark.
+
+Through this image's axon TPU tunnel, ``jax.block_until_ready`` does NOT
+await device execution (measured: ~0.1 ms for a 64M sort that takes ~300 ms;
+only a device->host VALUE readback forces and awaits it), and a readback
+costs a ~99 ms round-trip floor. Every benchmark therefore measures
+differentially: run K chained repetitions ending in a forcing readback, time
+at two different K, and report (T(k2) - T(k1)) / (k2 - k1) — the floor and
+all K-independent constants cancel. See benchmarks/roofline.py for the
+chaining constructions (device fori_loop / host-level jitted step).
+"""
+import time
+
+
+def best_of(fn, repeats=3):
+    """Minimum wall-clock seconds of ``fn()`` over ``repeats`` runs."""
+    best = float("inf")
+    for _ in range(repeats):
+        start = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - start)
+    return best
+
+
+def two_k_delta(timed, k1, k2, adaptive=False, min_delta=0.04, k_cap=4096):
+    """Per-repetition seconds from the two-K differential protocol.
+
+    ``timed(k)`` must return best-of-N wall seconds for k chained,
+    readback-forced repetitions. With ``adaptive=True``, k2 grows 4x until
+    the measured difference clears ``min_delta`` (so fast kernels aren't
+    drowned by readback-floor jitter) or hits ``k_cap``.
+    """
+    while True:
+        t1, t2 = timed(k1), timed(k2)
+        if not adaptive or t2 - t1 >= min_delta or k2 >= k_cap:
+            break
+        k2 = min(k2 * 4, k_cap)
+    return max(t2 - t1, 1e-9) / (k2 - k1)
