@@ -54,6 +54,7 @@ from metrics_tpu.regression import (  # noqa: E402
     SSIM,
     ExplainedVariance,
     KLDivergence,
+    KendallRankCorrCoef,
     LogCoshError,
     MeanAbsoluteError,
     MeanAbsolutePercentageError,
@@ -64,6 +65,7 @@ from metrics_tpu.regression import (  # noqa: E402
     PearsonCorrcoef,
     R2Score,
     SpearmanCorrcoef,
+    TotalVariation,
     SpectralAngleMapper,
     SymmetricMeanAbsolutePercentageError,
     TweedieDevianceScore,
@@ -83,5 +85,12 @@ from metrics_tpu.retrieval import (  # noqa: E402
 )
 from metrics_tpu.text import WER, CharErrorRate, MatchErrorRate, Perplexity, ROUGEScore, SQuAD, WordInfoLost, WordInfoPreserved  # noqa: E402
 from metrics_tpu.audio import PIT, SI_SDR, SI_SNR, SNR  # noqa: E402
-from metrics_tpu.wrappers import BootStrapper, ClasswiseWrapper, MetricTracker, MinMaxMetric  # noqa: E402
+from metrics_tpu.wrappers import (  # noqa: E402
+    BootStrapper,
+    ClasswiseWrapper,
+    MetricTracker,
+    MinMaxMetric,
+    MultioutputWrapper,
+    Running,
+)
 from metrics_tpu import functional  # noqa: E402

@@ -26,6 +26,8 @@ from metrics_tpu.functional.classification.ranking import (
     label_ranking_loss,
 )
 from metrics_tpu.functional.regression.cosine_similarity import cosine_similarity
+from metrics_tpu.functional.regression.kendall import kendall_rank_corrcoef
+from metrics_tpu.functional.regression.total_variation import total_variation
 from metrics_tpu.functional.regression.explained_variance import explained_variance
 from metrics_tpu.functional.regression.kl_divergence import kl_divergence
 from metrics_tpu.functional.regression.mean_absolute_error import mean_absolute_error

@@ -7,7 +7,9 @@ from metrics_tpu.regression.mean_squared_log_error import MeanSquaredLogError
 from metrics_tpu.regression.pearson import PearsonCorrcoef
 from metrics_tpu.regression.psnr import PSNR
 from metrics_tpu.regression.r2score import R2Score
+from metrics_tpu.regression.kendall import KendallRankCorrCoef
 from metrics_tpu.regression.spearman import SpearmanCorrcoef
+from metrics_tpu.regression.total_variation import TotalVariation
 from metrics_tpu.regression.ssim import SSIM
 from metrics_tpu.regression.mape import (
     MeanAbsolutePercentageError,

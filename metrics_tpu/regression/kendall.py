@@ -1,0 +1,65 @@
+"""KendallRankCorrCoef module. Extension beyond the reference snapshot.
+
+Ranks are global over the accumulated data, so the metric keeps cat-states
+(bounded via ``capacity``), like [[SpearmanCorrcoef]]; the epoch compute is
+the O(N^2) pairwise sign contraction in one jitted device program (see
+``functional/regression/kendall.py``).
+"""
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.regression.kendall import _kendall_kernel
+from metrics_tpu.parallel.buffer import as_values
+from metrics_tpu.utils.checks import _check_same_shape
+
+_kendall_jitted = jax.jit(_kendall_kernel)
+
+
+class KendallRankCorrCoef(Metric):
+    r"""Accumulated Kendall rank correlation (tau-b, tie-corrected).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([1.0, 2.0, 3.0, 4.0])
+        >>> target = jnp.array([1.0, 3.0, 2.0, 4.0])
+        >>> kendall = KendallRankCorrCoef()
+        >>> round(float(kendall(preds, target)), 4)
+        0.6667
+    """
+
+    def __init__(
+        self,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+        capacity: Optional[int] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+            capacity=capacity,
+        )
+        self.add_state("preds_all", default=[], dist_reduce_fx=None, item_shape=())
+        self.add_state("target_all", default=[], dist_reduce_fx=None, item_shape=())
+
+    def update(self, preds: Array, target: Array) -> None:
+        _check_same_shape(preds, target)
+        if preds.ndim != 1:
+            raise ValueError("Expected both `preds` and `target` to be 1D arrays of scalar scores")
+        self._append("preds_all", jnp.asarray(preds, dtype=jnp.float32))
+        self._append("target_all", jnp.asarray(target, dtype=jnp.float32))
+
+    def compute(self) -> Array:
+        preds = as_values(self.preds_all)
+        target = as_values(self.target_all)
+        if preds.shape[0] < 2:
+            return jnp.asarray(jnp.nan)
+        fn = _kendall_jitted if (self._jit is not False and not self._jit_failed) else _kendall_kernel
+        return fn(preds, target)

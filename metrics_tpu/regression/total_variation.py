@@ -1,0 +1,60 @@
+"""TotalVariation module. Extension beyond the reference snapshot.
+
+Streams two scalar sum-states (TV total + image count) — one fused psum to
+sync, no cat-state growth.
+"""
+from typing import Any, Callable, Optional
+
+import numpy as np
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.regression.total_variation import _total_variation_update
+from metrics_tpu.utils.data import accum_int_dtype
+
+
+class TotalVariation(Metric):
+    r"""Accumulated anisotropic total variation over image batches.
+
+    Args:
+        reduction: ``'sum'`` (total TV over all images) or ``'mean'``
+            (average per-image TV).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> tv = TotalVariation()
+        >>> img = jnp.arange(16.0).reshape(1, 1, 4, 4)
+        >>> float(tv(img))
+        60.0
+    """
+
+    def __init__(
+        self,
+        reduction: str = "sum",
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        if reduction not in ("sum", "mean"):
+            raise ValueError(f"Expected reduction to be 'sum' or 'mean', got {reduction}")
+        self.reduction = reduction
+        self.add_state("score", default=np.zeros((), dtype=np.float32), dist_reduce_fx="sum")
+        self.add_state("num_images", default=np.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
+
+    def update(self, img: Array) -> None:
+        score, n = _total_variation_update(img)
+        self.score = self.score + score
+        self.num_images = self.num_images + n
+
+    def compute(self) -> Array:
+        if self.reduction == "mean":
+            return self.score / jnp.maximum(self.num_images.astype(jnp.float32), 1.0)
+        return self.score

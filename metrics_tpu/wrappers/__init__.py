@@ -1,10 +1,13 @@
-"""Metric wrappers: BootStrapper, ClasswiseWrapper, MinMaxMetric, MetricTracker.
+"""Metric wrappers: BootStrapper, ClasswiseWrapper, MinMaxMetric,
+MetricTracker, MultioutputWrapper, Running.
 
 Extension family beyond the reference snapshot (later torchmetrics ships
 these under ``wrappers/``)."""
 from metrics_tpu.wrappers.bootstrapper import BootStrapper
 from metrics_tpu.wrappers.classwise import ClasswiseWrapper
 from metrics_tpu.wrappers.minmax import MinMaxMetric
+from metrics_tpu.wrappers.multioutput import MultioutputWrapper
+from metrics_tpu.wrappers.running import Running
 from metrics_tpu.wrappers.tracker import MetricTracker
 
-__all__ = ["BootStrapper", "ClasswiseWrapper", "MinMaxMetric", "MetricTracker"]
+__all__ = ["BootStrapper", "ClasswiseWrapper", "MinMaxMetric", "MetricTracker", "MultioutputWrapper", "Running"]

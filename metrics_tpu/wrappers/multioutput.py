@@ -1,0 +1,110 @@
+"""MultioutputWrapper. Extension beyond the reference snapshot (later
+torchmetrics ``wrappers/multioutput.py``)."""
+from typing import Any, List, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+
+
+class MultioutputWrapper(Metric):
+    r"""Apply a base metric independently to each output column.
+
+    Wraps ``num_outputs`` clones of ``base_metric``; every ``update`` /
+    ``forward`` slices column ``i`` of the (..., ``num_outputs``) preds and
+    target into clone ``i``, and ``compute()`` stacks the per-column results
+    into a ``(num_outputs,)`` vector. The clones are ordinary child metrics,
+    so sync/reset/pickling follow the normal rules.
+
+    Args:
+        base_metric: the metric to replicate per output column.
+        num_outputs: number of trailing-axis output columns.
+        output_dim: axis holding the outputs (default ``-1``).
+        remove_nans: drop rows where either preds or target is NaN in a
+            column before updating that column's clone (matching the
+            torchmetrics wrapper's default).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanSquaredError
+        >>> m = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+        >>> preds = jnp.array([[1.0, 10.0], [2.0, 20.0]])
+        >>> target = jnp.array([[1.0, 14.0], [3.0, 22.0]])
+        >>> [round(float(v), 2) for v in m(preds, target)]
+        [0.5, 10.0]
+    """
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_outputs: int,
+        output_dim: int = -1,
+        remove_nans: bool = True,
+    ):
+        if not isinstance(base_metric, Metric):
+            raise ValueError(f"`base_metric` must be a Metric, got {type(base_metric).__name__}")
+        if not isinstance(num_outputs, int) or num_outputs < 1:
+            raise ValueError(f"`num_outputs` must be a positive int, got {num_outputs!r}")
+        super().__init__(compute_on_step=base_metric.compute_on_step)
+        self.metrics: List[Metric] = [base_metric.clone() for _ in range(num_outputs)]
+        self.num_outputs = num_outputs
+        self.output_dim = output_dim
+        self.remove_nans = remove_nans
+
+    def _columns(self, value: Array, i: int) -> Array:
+        return jnp.take(value, i, axis=self.output_dim)
+
+    def _any_nans(self, preds: Array, target: Array) -> bool:
+        """At most ONE device readback per update, and none for int dtypes.
+
+        The per-column boolean compression is data-dependent (eager-only,
+        like the torchmetrics wrapper), so the NaN probe is a forced host
+        sync; doing it once on the full arrays instead of per column keeps
+        a clean-data K-output update readback-free except this single check.
+        """
+        if not self.remove_nans:
+            return False
+        checks = [x for x in (preds, target) if jnp.issubdtype(x.dtype, jnp.floating)]
+        if not checks:
+            return False
+        return bool(jnp.any(jnp.stack([jnp.isnan(x).any() for x in checks])))
+
+    def _pair(self, preds: Array, target: Array, i: int, filter_nans: bool):
+        p = self._columns(preds, i)
+        t = self._columns(target, i)
+        if filter_nans:
+            keep = ~(jnp.isnan(p.astype(jnp.float32)) | jnp.isnan(t.astype(jnp.float32)))
+            p, t = p[keep], t[keep]
+        return p, t
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = jnp.asarray(preds), jnp.asarray(target)
+        filter_nans = self._any_nans(preds, target)
+        for i, m in enumerate(self.metrics):
+            p, t = self._pair(preds, target, i, filter_nans)
+            m.update(p, t)
+
+    def forward(self, preds: Array, target: Array) -> Optional[Array]:
+        preds, target = jnp.asarray(preds), jnp.asarray(target)
+        filter_nans = self._any_nans(preds, target)
+        values = []
+        for i, m in enumerate(self.metrics):
+            p, t = self._pair(preds, target, i, filter_nans)
+            values.append(m.forward(p, t))
+        self._computed = None
+        if any(v is None for v in values):
+            return None
+        return jnp.stack(values)
+
+    def compute(self) -> Array:
+        return jnp.stack([m.compute() for m in self.metrics])
+
+    def reset(self) -> None:
+        super().reset()
+        for m in self.metrics:
+            m.reset()
+
+    def persistent(self, mode: bool = False) -> None:
+        for m in self.metrics:
+            m.persistent(mode)

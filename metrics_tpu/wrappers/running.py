@@ -1,0 +1,64 @@
+"""Running-window wrapper. Extension beyond the reference snapshot (later
+torchmetrics ``wrappers/running.py``)."""
+from typing import Any, List, Optional
+
+from metrics_tpu.core.metric import Metric
+
+
+class Running(Metric):
+    r"""A sliding-window view of any metric: the value over the last
+    ``window`` updates.
+
+    Each ``update`` stages the batch as an independent state delta via the
+    base metric's pure functions (``init -> update``); ``compute()`` merges
+    the last ``window`` deltas and computes on the result. Nothing is
+    recomputed per step beyond the one new delta, and every stored delta is
+    a device pytree, so the window costs ``window x state_size`` memory.
+
+    The window is process-local by design (like the torchmetrics wrapper):
+    cross-process sync of a sliding window is ill-defined, so the wrapper
+    never syncs.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanSquaredError
+        >>> running = Running(MeanSquaredError(), window=2)
+        >>> for step in range(4):
+        ...     _ = running(jnp.array([float(step)]), jnp.array([0.0]))
+        >>> float(running.compute())  # last two steps: (2^2 + 3^2) / 2
+        6.5
+    """
+
+    def __init__(self, base_metric: Metric, window: int = 5):
+        if not isinstance(base_metric, Metric):
+            raise ValueError(f"`base_metric` must be a Metric, got {type(base_metric).__name__}")
+        if not isinstance(window, int) or window < 1:
+            raise ValueError(f"`window` must be a positive int, got {window!r}")
+        super().__init__(compute_on_step=base_metric.compute_on_step)
+        self.base_metric = base_metric
+        self.window = window
+        self._pure = base_metric.pure()
+        self._deltas: List[Any] = []
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        delta = self._pure.update(self._pure.init(), *args, **kwargs)
+        self._deltas.append(delta)
+        if len(self._deltas) > self.window:
+            self._deltas.pop(0)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Optional[Any]:
+        self.update(*args, **kwargs)
+        self._computed = None
+        if not self.compute_on_step:
+            return None
+        return self.compute()
+
+    def compute(self) -> Any:
+        state = self._pure.init()
+        for delta in self._deltas:
+            state = self._pure.merge(state, delta)
+        return self._pure.compute(state)
+
+    def reset(self) -> None:
+        super().reset()
+        self._deltas = []

@@ -309,3 +309,107 @@ def test_tracker_reset_clears_cache():
 def test_bootstrapper_requires_two_copies():
     with pytest.raises(ValueError, match=">= 2"):
         BootStrapper(Accuracy(), num_bootstraps=1)
+
+
+def test_multioutput_matches_per_column_metrics():
+    from metrics_tpu import MeanSquaredError, MultioutputWrapper, R2Score
+
+    rng = np.random.RandomState(11)
+    preds = rng.randn(6, 32, 3).astype(np.float32)
+    target = (preds + 0.3 * rng.randn(6, 32, 3)).astype(np.float32)
+
+    wrapper = MultioutputWrapper(MeanSquaredError(), num_outputs=3)
+    singles = [MeanSquaredError() for _ in range(3)]
+    for b in range(6):
+        step_vec = wrapper(jnp.asarray(preds[b]), jnp.asarray(target[b]))
+        step_single = [m(jnp.asarray(preds[b, :, i]), jnp.asarray(target[b, :, i]))
+                       for i, m in enumerate(singles)]
+        np.testing.assert_allclose(np.asarray(step_vec), [float(v) for v in step_single], rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(wrapper.compute()), [float(m.compute()) for m in singles], rtol=1e-6
+    )
+    # r2 over columns too (different state structure)
+    w2 = MultioutputWrapper(R2Score(), num_outputs=3)
+    for b in range(6):
+        w2.update(jnp.asarray(preds[b]), jnp.asarray(target[b]))
+    from sklearn.metrics import r2_score
+
+    want = r2_score(target.reshape(-1, 3), preds.reshape(-1, 3), multioutput="raw_values")
+    np.testing.assert_allclose(np.asarray(w2.compute()), want, atol=1e-4)
+
+
+def test_multioutput_remove_nans():
+    from metrics_tpu import MeanSquaredError, MultioutputWrapper
+
+    preds = jnp.asarray(np.array([[1.0, np.nan], [2.0, 5.0], [3.0, 6.0]], dtype=np.float32))
+    target = jnp.asarray(np.array([[1.0, 4.0], [np.nan, 5.0], [3.0, 8.0]], dtype=np.float32))
+    m = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+    m.update(preds, target)
+    # col0 keeps rows {0, 2} -> mse 0; col1 keeps rows {1, 2} -> mse (0+4)/2
+    np.testing.assert_allclose(np.asarray(m.compute()), [0.0, 2.0], atol=1e-6)
+
+    keep = MultioutputWrapper(MeanSquaredError(), num_outputs=2, remove_nans=False)
+    keep.update(preds, target)
+    assert np.isnan(np.asarray(keep.compute())).all()
+
+
+def test_multioutput_reset_and_validation():
+    from metrics_tpu import MeanSquaredError, MultioutputWrapper
+
+    m = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+    m.update(jnp.ones((4, 2)), jnp.zeros((4, 2)))
+    m.reset()
+    for child in m.metrics:
+        assert float(child.total) == 0
+    with pytest.raises(ValueError, match="positive int"):
+        MultioutputWrapper(MeanSquaredError(), num_outputs=0)
+    with pytest.raises(ValueError, match="must be a Metric"):
+        MultioutputWrapper(lambda: None, num_outputs=2)
+
+
+def test_multioutput_pickle_mid_accumulation():
+    import pickle
+
+    from metrics_tpu import MeanSquaredError, MultioutputWrapper
+
+    m = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+    m.update(jnp.ones((4, 2)), jnp.zeros((4, 2)))
+    m2 = pickle.loads(pickle.dumps(m))
+    m2.update(jnp.zeros((4, 2)), jnp.zeros((4, 2)))
+    np.testing.assert_allclose(np.asarray(m2.compute()), [0.5, 0.5], atol=1e-6)
+
+
+def test_running_window_matches_fresh_metric():
+    from metrics_tpu import Accuracy, MeanSquaredError, Running
+
+    rng = np.random.RandomState(13)
+    preds = rng.rand(8, 16).astype(np.float32)
+    target = rng.randint(0, 2, (8, 16))
+
+    running = Running(Accuracy(), window=3)
+    for b in range(8):
+        running.update(jnp.asarray(preds[b]), jnp.asarray(target[b]))
+        fresh = Accuracy()
+        for w in range(max(0, b - 2), b + 1):
+            fresh.update(jnp.asarray(preds[w]), jnp.asarray(target[w]))
+        np.testing.assert_allclose(float(running.compute()), float(fresh.compute()), atol=1e-6)
+
+    # window=1 == per-batch value
+    r1 = Running(MeanSquaredError(), window=1)
+    for b in range(4):
+        step = r1(jnp.asarray(preds[b]), jnp.asarray(preds[b] * 0.5))
+        single = MeanSquaredError()(jnp.asarray(preds[b]), jnp.asarray(preds[b] * 0.5))
+        np.testing.assert_allclose(float(step), float(single), atol=1e-6)
+
+
+def test_running_reset_and_validation():
+    from metrics_tpu import MeanSquaredError, Running
+
+    r = Running(MeanSquaredError(), window=2)
+    r.update(jnp.ones(4), jnp.zeros(4))
+    r.reset()
+    assert np.isnan(float(r.compute()))  # empty: 0/0
+    with pytest.raises(ValueError, match="positive int"):
+        Running(MeanSquaredError(), window=0)
+    with pytest.raises(ValueError, match="must be a Metric"):
+        Running(object(), window=2)

@@ -1,0 +1,56 @@
+"""KendallRankCorrCoef vs the scipy oracle (tau-b)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.stats import kendalltau
+
+from metrics_tpu import KendallRankCorrCoef
+from metrics_tpu.functional import kendall_rank_corrcoef
+from tests.helpers.testers import MetricTester
+
+_rng = np.random.RandomState(43)
+NUM_BATCHES, BATCH_SIZE = 10, 32
+
+_preds = _rng.randn(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+_target = (0.4 * _preds + _rng.randn(NUM_BATCHES, BATCH_SIZE)).astype(np.float32)
+# tied values in both sequences (tau-b tie corrections must fire)
+_preds_ties = np.round(_preds, 1)
+_target_ties = np.round(_target, 1)
+
+
+def _sk_kendall(preds, target):
+    return kendalltau(np.asarray(preds).reshape(-1), np.asarray(target).reshape(-1)).statistic
+
+
+@pytest.mark.parametrize(
+    "preds, target", [(_preds, _target), (_preds_ties, _target_ties)], ids=["floats", "ties"]
+)
+class TestKendall(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_kendall_class(self, preds, target, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=KendallRankCorrCoef,
+            sk_metric=_sk_kendall,
+            dist_sync_on_step=False,
+        )
+
+    def test_kendall_functional(self, preds, target):
+        self.run_functional_metric_test(
+            preds, target, metric_functional=kendall_rank_corrcoef, sk_metric=_sk_kendall
+        )
+
+
+def test_kendall_degenerate():
+    assert np.isnan(float(kendall_rank_corrcoef(jnp.array([1.0]), jnp.array([2.0]))))
+    # constant sequence: zero tie-corrected denominator
+    assert np.isnan(float(kendall_rank_corrcoef(jnp.array([1.0, 1.0, 1.0]), jnp.array([1.0, 2.0, 3.0]))))
+
+
+def test_kendall_validation():
+    with pytest.raises(ValueError, match="1D"):
+        kendall_rank_corrcoef(jnp.zeros((3, 2)), jnp.zeros((3, 2)))

@@ -1,0 +1,77 @@
+"""TotalVariation vs a numpy oracle."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import TotalVariation
+from metrics_tpu.functional import total_variation
+from tests.helpers.testers import MetricTester
+
+_rng = np.random.RandomState(47)
+NUM_BATCHES, BATCH_SIZE, C, H, W = 10, 4, 3, 16, 16
+
+_imgs = _rng.rand(NUM_BATCHES, BATCH_SIZE, C, H, W).astype(np.float32)
+
+
+def _np_tv(imgs):
+    x = np.asarray(imgs, dtype=np.float64).reshape(-1, C, H, W)
+    dh = np.abs(x[:, :, 1:, :] - x[:, :, :-1, :]).sum()
+    dw = np.abs(x[:, :, :, 1:] - x[:, :, :, :-1]).sum()
+    return dh + dw
+
+
+def _np_tv_mean(imgs):
+    x = np.asarray(imgs).reshape(-1, C, H, W)
+    return _np_tv(imgs) / x.shape[0]
+
+
+class TestTotalVariation(MetricTester):
+    atol = 1e-2  # f32 accumulation over ~24k terms vs f64 oracle
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_tv_class(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_imgs,
+            target=_imgs,  # harness passes (preds, target); metric uses preds only
+            metric_class=_TVOnPreds,
+            sk_metric=lambda preds, target: _np_tv(preds),
+            dist_sync_on_step=False,
+        )
+
+    def test_tv_functional(self):
+        self.run_functional_metric_test(
+            _imgs, _imgs,
+            metric_functional=lambda preds, target: total_variation(preds),
+            sk_metric=lambda preds, target: _np_tv(preds),
+        )
+
+
+class _TVOnPreds(TotalVariation):
+    """Adapter: MetricTester drives (preds, target) pairs."""
+
+    def update(self, preds, target):  # noqa: D102
+        super().update(preds)
+
+
+def test_tv_mean_reduction():
+    m = TotalVariation(reduction="mean")
+    for i in range(NUM_BATCHES):
+        m(jnp.asarray(_imgs[i]))
+    np.testing.assert_allclose(float(m.compute()), _np_tv_mean(_imgs), rtol=1e-5)
+
+
+def test_tv_validation():
+    with pytest.raises(ValueError, match=r"\(N, C, H, W\)"):
+        total_variation(jnp.zeros((4, 4)))
+    with pytest.raises(ValueError, match="reduction"):
+        total_variation(jnp.zeros((1, 1, 4, 4)), reduction="max")
+    with pytest.raises(ValueError, match="reduction"):
+        TotalVariation(reduction="max")
+
+
+def test_tv_jit():
+    import jax
+
+    got = jax.jit(total_variation)(jnp.asarray(_imgs[0]))
+    np.testing.assert_allclose(float(got), _np_tv(_imgs[0]), rtol=1e-5)
