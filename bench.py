@@ -33,7 +33,12 @@ import numpy as np
 
 # resolve `benchmarks.timing` regardless of the caller's cwd; do NOT use
 # PYTHONPATH for this (it breaks the axon TPU plugin registration)
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+
+# persistent XLA compile cache: the chained-loop train-step programs are the
+# slow part of this benchmark; cached, a re-run is seconds
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(_HERE, ".jax_cache_tpu"))
 
 N_STEPS = 100
 WARMUP = 10
@@ -299,8 +304,13 @@ def main() -> None:
         ours_fused_ms = bench_ours_fused_singlechip()
         ref_eager_ms = bench_reference_eager_update()
         fused_vs_ref = ref_eager_ms / ours_fused_ms
+        # 0.01 ms is the floor bench_ours_fused_singlechip clamps to when
+        # XLA fuses the metric update into the train step below timing
+        # resolution; the ratio is then a lower bound, not a point value
+        marginal_at_floor = ours_fused_ms <= 0.01
     except Exception:
         ours_fused_ms = ref_eager_ms = fused_vs_ref = float("nan")
+        marginal_at_floor = False
 
     print(
         json.dumps(
@@ -316,6 +326,7 @@ def main() -> None:
                 "singlechip_fused_update_ms": round(ours_fused_ms, 4),
                 "singlechip_reference_eager_update_ms": round(ref_eager_ms, 4),
                 "singlechip_vs_reference": round(fused_vs_ref, 3),
+                "singlechip_marginal_at_floor": marginal_at_floor,
             }
         )
     )

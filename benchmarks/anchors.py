@@ -156,7 +156,7 @@ def anchor2_functional_kernels():
 
     import jax.numpy as jnp
 
-    from benchmarks.roofline import _chained_loop_time
+    from benchmarks.timing import chained_loop_time as _chained_loop_time
     from metrics_tpu.functional import confusion_matrix as j_cm
     from metrics_tpu.functional import stat_scores as j_ss
 
@@ -192,7 +192,7 @@ def anchor4_curve_metrics():
 
     import jax.numpy as jnp
 
-    from benchmarks.roofline import _chained_loop_time
+    from benchmarks.timing import chained_loop_time as _chained_loop_time
     from metrics_tpu.functional import auroc as j_auroc
     from metrics_tpu.functional import average_precision as j_ap
 

@@ -73,69 +73,10 @@ T_BINS = 512
 C_CLASSES = 64
 
 
-def _chained_loop_time(kernel_scalar_fn, perturb_fn, first_arg, rest_args, k1, k2):
-    """Differential chained-loop timing; return true seconds per kernel call.
-
-    `kernel_scalar_fn(first_arg, *rest_args) -> f32 scalar` reduces the
-    kernel's output; `perturb_fn(first_arg, scalar) -> first_arg` writes a
-    result-dependent one-element perturbation into the input so iteration
-    i+1 data-depends on iteration i (no hoisting / overlap / elision). The
-    loop body's extra cost is one one-element in-place update on the loop
-    carry — negligible against an N-element kernel. Each program is timed
-    via a forcing scalar readback (`float(out)`); the tunnel's ~99 ms
-    readback floor cancels in the (K2 - K1) difference.
-    """
-    import functools
-
-    import jax
-    from jax import lax
-    import jax.numpy as jnp
-
-    @functools.partial(jax.jit, static_argnums=0)
-    def run(iters, p0, *rest):
-        def body(_, state):
-            p, acc = state
-            s = kernel_scalar_fn(p, *rest)
-            return perturb_fn(p, s), acc + s
-
-        return lax.fori_loop(0, iters, body, (p0, jnp.float32(0.0)))[1]
-
-    from benchmarks.timing import best_of, two_k_delta
-
-    def timed(iters):
-        float(run(iters, first_arg, *rest_args))  # compile + warmup execution
-        return best_of(lambda: float(run(iters, first_arg, *rest_args)))
-
-    # adaptive K: a fast kernel's delta must clear the ~ms readback-floor
-    # jitter, so k2 grows until the measured difference is >= 40 ms
-    return two_k_delta(timed, k1, k2, adaptive=True)
-
-
-def _host_chained_time(step_fn, first_arg, rest_args, k1, k2):
-    """Host-level chained timing for kernels whose fori_loop form crashes the
-    TPU compiler (the sort-based ones). `step_fn(x, *rest) -> x'` is ONE
-    jitted program whose output array data-depends on the kernel's result;
-    iterating it host-side chains k dispatches (async submission, ~0.1 ms,
-    negligible against the >=10 ms sort kernels this is used for), and one
-    final readback forces the whole chain. Same two-K differencing.
-    """
-    import jax
-
-    from benchmarks.timing import best_of, two_k_delta
-
-    step = jax.jit(step_fn)
-
-    def one_run(iters):
-        x = first_arg
-        for _ in range(iters):
-            x = step(x, *rest_args)
-        float(x.ravel()[0])
-
-    def timed(iters):
-        one_run(1)  # compile + warmup
-        return best_of(lambda: one_run(iters))
-
-    return two_k_delta(timed, k1, k2)
+from benchmarks.timing import (  # noqa: E402
+    chained_loop_time as _chained_loop_time,
+    host_chained_time as _host_chained_time,
+)
 
 
 KERNELS = ["stat_scores", "confusion_matrix", "confusion_matrix_scatter",

@@ -30,9 +30,67 @@ def two_k_delta(timed, k1, k2, adaptive=False, min_delta=0.04, k_cap=4096):
     the measured difference clears ``min_delta`` (so fast kernels aren't
     drowned by readback-floor jitter) or hits ``k_cap``.
     """
+    t1 = timed(k1)  # k1 never changes; measure once
     while True:
-        t1, t2 = timed(k1), timed(k2)
+        t2 = timed(k2)
         if not adaptive or t2 - t1 >= min_delta or k2 >= k_cap:
             break
         k2 = min(k2 * 4, k_cap)
     return max(t2 - t1, 1e-9) / (k2 - k1)
+
+
+def chained_loop_time(kernel_scalar_fn, perturb_fn, first_arg, rest_args, k1, k2, adaptive=True):
+    """Device-plane chained timing; returns true seconds per kernel call.
+
+    Builds ONE jitted program that runs the kernel ``iters`` times inside a
+    ``lax.fori_loop`` whose carry is perturbed by each iteration's result
+    (``kernel_scalar_fn(first_arg, *rest_args) -> f32 scalar``;
+    ``perturb_fn(first_arg, scalar) -> first_arg`` writes a one-element,
+    result-dependent update), so XLA cannot hoist, fuse away, or elide
+    iterations. Timed by forcing scalar readback at two K.
+    """
+    import functools
+
+    import jax
+    from jax import lax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def run(iters, p0, *rest):
+        def body(_, state):
+            p, acc = state
+            s = kernel_scalar_fn(p, *rest)
+            return perturb_fn(p, s), acc + s
+
+        return lax.fori_loop(0, iters, body, (p0, jnp.float32(0.0)))[1]
+
+    def timed(iters):
+        float(run(iters, first_arg, *rest_args))  # compile + warmup execution
+        return best_of(lambda: float(run(iters, first_arg, *rest_args)))
+
+    return two_k_delta(timed, k1, k2, adaptive=adaptive)
+
+
+def host_chained_time(step_fn, first_arg, rest_args, k1, k2):
+    """Host-plane chained timing for kernels whose fori_loop form the TPU
+    compiler rejects (the sort-based ones). ``step_fn(x, *rest) -> x'`` is
+    ONE jitted program whose output array data-depends on the kernel's
+    result; iterating it host-side chains k dispatches (async submission,
+    ~0.1 ms, negligible against the >=10 ms kernels this is used for), and
+    one final readback forces the whole chain.
+    """
+    import jax
+
+    step = jax.jit(step_fn)
+
+    def one_run(iters):
+        x = first_arg
+        for _ in range(iters):
+            x = step(x, *rest_args)
+        float(x.ravel()[0])
+
+    def timed(iters):
+        one_run(1)  # compile + warmup
+        return best_of(lambda: one_run(iters))
+
+    return two_k_delta(timed, k1, k2)
