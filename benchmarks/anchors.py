@@ -10,7 +10,7 @@ JAX-side timings use forced-execution protocols ONLY (chained device loops /
 host-level chains ending in a value readback, differenced over two K) —
 `jax.block_until_ready` does not await execution through the axon TPU tunnel
 and must never be the sync for a measurement. See the protocol block below
-and benchmarks/roofline.py.
+and benchmarks/timing.py.
 
 Anchors (from BASELINE.json "configs"):
   1. README Accuracy example: 10 batches of (10, 5) softmax preds — per-step
@@ -62,9 +62,9 @@ def _jax_sync(out):
 # `jax.block_until_ready` does NOT await device execution (measured: ~0.1 ms
 # for a 64M sort that takes ~300 ms; only a VALUE readback forces it), so
 # any `_timeit(..., sync=_jax_sync)` on the TPU backend under-reports.
-# Two forced-execution protocols replace it (see benchmarks/roofline.py):
+# Two forced-execution protocols replace it (see benchmarks/timing.py):
 #   * device plane: K data-chained kernel calls inside one jitted fori_loop
-#     (`roofline._chained_loop_time`), timed by scalar readback at two K —
+#     (`timing.chained_loop_time`), timed by scalar readback at two K —
 #     the ~99 ms readback floor cancels in the difference;
 #   * host plane (stateful API): K epochs of real API calls whose state
 #     chains on device, ONE forcing readback at the end (`_host_delta_time`)

@@ -6,8 +6,9 @@ only a device->host VALUE readback forces and awaits it), and a readback
 costs a ~99 ms round-trip floor. Every benchmark therefore measures
 differentially: run K chained repetitions ending in a forcing readback, time
 at two different K, and report (T(k2) - T(k1)) / (k2 - k1) — the floor and
-all K-independent constants cancel. See benchmarks/roofline.py for the
-chaining constructions (device fori_loop / host-level jitted step).
+all K-independent constants cancel. The chaining constructions (device
+fori_loop / host-level jitted step) are `chained_loop_time` and
+`host_chained_time` below.
 """
 import time
 

@@ -85,6 +85,16 @@ from metrics_tpu.retrieval import (  # noqa: E402
 )
 from metrics_tpu.text import WER, CharErrorRate, MatchErrorRate, Perplexity, ROUGEScore, SQuAD, WordInfoLost, WordInfoPreserved  # noqa: E402
 from metrics_tpu.audio import PIT, SI_SDR, SI_SNR, SNR  # noqa: E402
+from metrics_tpu.clustering import (  # noqa: E402
+    AdjustedRandScore,
+    CompletenessScore,
+    FowlkesMallowsScore,
+    HomogeneityScore,
+    MutualInfoScore,
+    NormalizedMutualInfoScore,
+    RandScore,
+    VMeasureScore,
+)
 from metrics_tpu.wrappers import (  # noqa: E402
     BootStrapper,
     ClasswiseWrapper,

@@ -1,0 +1,30 @@
+"""Stateful clustering metrics. Extension family beyond the reference
+snapshot (later torchmetrics ships a ``clustering/`` package).
+
+Every metric streams ONE ``(num_clusters, num_classes)`` contingency-matrix
+state — accumulated per batch with the same one-hot MXU contraction the
+confusion matrix uses, ``"sum"``-reducible across devices — and applies its
+closed-form compute at the end. sklearn-exact; see
+``metrics_tpu/functional/clustering.py``.
+"""
+from metrics_tpu.clustering.scores import (
+    AdjustedRandScore,
+    CompletenessScore,
+    FowlkesMallowsScore,
+    HomogeneityScore,
+    MutualInfoScore,
+    NormalizedMutualInfoScore,
+    RandScore,
+    VMeasureScore,
+)
+
+__all__ = [
+    "AdjustedRandScore",
+    "CompletenessScore",
+    "FowlkesMallowsScore",
+    "HomogeneityScore",
+    "MutualInfoScore",
+    "NormalizedMutualInfoScore",
+    "RandScore",
+    "VMeasureScore",
+]

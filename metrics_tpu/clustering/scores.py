@@ -1,0 +1,192 @@
+"""Contingency-matrix clustering metrics (stateful layer).
+
+One shared base streams the ``(num_clusters, num_classes)`` contingency
+count matrix; each subclass applies its closed-form compute. The pair-count
+reductions use float32, exact for counts below 2^24 per cell — far beyond
+any realistic epoch for label data.
+"""
+from typing import Any, Callable, Optional
+
+import numpy as np
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.clustering import (
+    _adjusted_rand_compute,
+    _contingency,
+    _fowlkes_mallows_compute,
+    _homogeneity_completeness,
+    _mutual_info_compute,
+    _normalized_mutual_info_compute,
+    _rand_compute,
+    _v_measure_compute,
+)
+
+
+class _ContingencyMetric(Metric):
+    """Shared base: stream the contingency matrix, compute a closed form."""
+
+    def __init__(
+        self,
+        num_clusters: int,
+        num_classes: int,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        if not isinstance(num_clusters, int) or num_clusters < 1:
+            raise ValueError(f"`num_clusters` must be a positive int, got {num_clusters!r}")
+        if not isinstance(num_classes, int) or num_classes < 1:
+            raise ValueError(f"`num_classes` must be a positive int, got {num_classes!r}")
+        self.num_clusters = num_clusters
+        self.num_classes = num_classes
+        self.add_state(
+            "contingency",
+            default=np.zeros((num_clusters, num_classes), dtype=np.int32),
+            dist_reduce_fx="sum",
+        )
+
+    def update(self, preds: Array, target: Array) -> None:
+        self.contingency = self.contingency + _contingency(
+            preds, target, self.num_clusters, self.num_classes
+        )
+
+    def _score(self, cont: Array) -> Array:
+        raise NotImplementedError
+
+    def compute(self) -> Array:
+        return self._score(self.contingency)
+
+
+class RandScore(_ContingencyMetric):
+    """Accumulated Rand index (``sklearn.metrics.rand_score``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> metric = RandScore(num_clusters=2, num_classes=2)
+        >>> float(metric(jnp.array([0, 0, 1, 1]), jnp.array([1, 1, 0, 0])))
+        1.0
+    """
+
+    def _score(self, cont: Array) -> Array:
+        return _rand_compute(cont)
+
+
+class AdjustedRandScore(_ContingencyMetric):
+    """Accumulated adjusted Rand index (``sklearn.metrics.adjusted_rand_score``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> metric = AdjustedRandScore(num_clusters=2, num_classes=2)
+        >>> float(metric(jnp.array([0, 0, 1, 1]), jnp.array([0, 0, 1, 1])))
+        1.0
+    """
+
+    def _score(self, cont: Array) -> Array:
+        return _adjusted_rand_compute(cont)
+
+
+class MutualInfoScore(_ContingencyMetric):
+    """Accumulated mutual information (``sklearn.metrics.mutual_info_score``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> metric = MutualInfoScore(num_clusters=2, num_classes=2)
+        >>> round(float(metric(jnp.array([0, 0, 1, 1]), jnp.array([0, 0, 1, 1]))), 4)
+        0.6931
+    """
+
+    def _score(self, cont: Array) -> Array:
+        return _mutual_info_compute(cont)
+
+
+class NormalizedMutualInfoScore(_ContingencyMetric):
+    """Accumulated NMI (``sklearn.metrics.normalized_mutual_info_score``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> metric = NormalizedMutualInfoScore(num_clusters=2, num_classes=2)
+        >>> float(metric(jnp.array([0, 0, 1, 1]), jnp.array([1, 1, 0, 0])))
+        1.0
+    """
+
+    def __init__(self, num_clusters: int, num_classes: int, average_method: str = "arithmetic", **kwargs: Any):
+        super().__init__(num_clusters, num_classes, **kwargs)
+        if average_method not in ("arithmetic", "geometric", "min", "max"):
+            raise ValueError(
+                f"average_method must be 'arithmetic', 'geometric', 'min' or 'max', got {average_method!r}"
+            )
+        self.average_method = average_method
+
+    def _score(self, cont: Array) -> Array:
+        return _normalized_mutual_info_compute(cont, self.average_method)
+
+
+class HomogeneityScore(_ContingencyMetric):
+    """Accumulated homogeneity (``sklearn.metrics.homogeneity_score``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> metric = HomogeneityScore(num_clusters=4, num_classes=2)
+        >>> float(metric(jnp.array([0, 1, 2, 3]), jnp.array([0, 0, 1, 1])))
+        1.0
+    """
+
+    def _score(self, cont: Array) -> Array:
+        return _homogeneity_completeness(cont)[0]
+
+
+class CompletenessScore(_ContingencyMetric):
+    """Accumulated completeness (``sklearn.metrics.completeness_score``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> metric = CompletenessScore(num_clusters=1, num_classes=2)
+        >>> float(metric(jnp.array([0, 0, 0, 0]), jnp.array([0, 0, 1, 1])))
+        1.0
+    """
+
+    def _score(self, cont: Array) -> Array:
+        return _homogeneity_completeness(cont)[1]
+
+
+class VMeasureScore(_ContingencyMetric):
+    """Accumulated V-measure (``sklearn.metrics.v_measure_score``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> metric = VMeasureScore(num_clusters=2, num_classes=2)
+        >>> float(metric(jnp.array([0, 0, 1, 1]), jnp.array([0, 0, 1, 1])))
+        1.0
+    """
+
+    def __init__(self, num_clusters: int, num_classes: int, beta: float = 1.0, **kwargs: Any):
+        super().__init__(num_clusters, num_classes, **kwargs)
+        if beta < 0:
+            raise ValueError(f"`beta` must be non-negative, got {beta!r}")
+        self.beta = beta
+
+    def _score(self, cont: Array) -> Array:
+        return _v_measure_compute(cont, self.beta)
+
+
+class FowlkesMallowsScore(_ContingencyMetric):
+    """Accumulated Fowlkes-Mallows index (``sklearn.metrics.fowlkes_mallows_score``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> metric = FowlkesMallowsScore(num_clusters=2, num_classes=2)
+        >>> round(float(metric(jnp.array([0, 0, 1, 1]), jnp.array([0, 0, 1, 1]))), 4)
+        1.0
+    """
+
+    def _score(self, cont: Array) -> Array:
+        return _fowlkes_mallows_compute(cont)

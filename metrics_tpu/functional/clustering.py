@@ -1,0 +1,229 @@
+"""Clustering metrics over a streamed contingency matrix.
+
+Extension family beyond the reference snapshot (later torchmetrics ships a
+``clustering/`` package). Every metric here is a closed-form function of the
+(C_pred, C_true) contingency matrix, which streams exactly like a confusion
+matrix: a one-hot MXU contraction per batch, ``"sum"``-reducible across
+batches/devices. Semantics match sklearn
+(``rand_score``, ``adjusted_rand_score``, ``mutual_info_score``,
+``normalized_mutual_info_score``, ``homogeneity/completeness/v_measure``,
+``fowlkes_mallows_score``).
+
+AdjustedMutualInfoScore is deliberately absent: its expected-MI term is an
+O(C^2 N) hypergeometric summation with no closed device form (sklearn uses
+a dedicated cython loop) — the normalized variants here cover the
+practical cases.
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+
+def _contingency(preds: Array, target: Array, num_clusters: int, num_classes: int) -> Array:
+    """(num_clusters, num_classes) pair-count matrix via one-hot matmul."""
+    if preds.ndim != 1 or target.ndim != 1 or preds.shape != target.shape:
+        raise ValueError(
+            f"Expected 1-D label arrays of identical shape, got {preds.shape} and {target.shape}"
+        )
+    p = jax.nn.one_hot(preds, num_clusters, dtype=jnp.bfloat16)
+    t = jax.nn.one_hot(target, num_classes, dtype=jnp.bfloat16)
+    counts = jnp.matmul(p.T, t, preferred_element_type=jnp.float32)
+    return jnp.round(counts).astype(jnp.int32)
+
+
+def _comb2(x: Array) -> Array:
+    x = x.astype(jnp.float64) if jax.config.jax_enable_x64 else x.astype(jnp.float32)
+    return x * (x - 1.0) / 2.0
+
+
+def _pair_counts(cont: Array) -> Tuple[Array, Array, Array, Array]:
+    """(sum C(nij,2), sum C(ai,2), sum C(bj,2), C(n,2)) from a contingency."""
+    a = cont.sum(axis=1)
+    b = cont.sum(axis=0)
+    n = cont.sum()
+    return _comb2(cont).sum(), _comb2(a).sum(), _comb2(b).sum(), _comb2(n)
+
+
+def _rand_compute(cont: Array) -> Array:
+    nij2, a2, b2, n2 = _pair_counts(cont)
+    # agreements: concordant pairs = n2 + 2*nij2 - a2 - b2
+    return jnp.where(n2 > 0, (n2 + 2.0 * nij2 - a2 - b2) / jnp.where(n2 > 0, n2, 1.0), 1.0)
+
+
+def _adjusted_rand_compute(cont: Array) -> Array:
+    nij2, a2, b2, n2 = _pair_counts(cont)
+    expected = jnp.where(n2 > 0, a2 * b2 / jnp.where(n2 > 0, n2, 1.0), 0.0)
+    max_index = (a2 + b2) / 2.0
+    denom = max_index - expected
+    # degenerate (single cluster both sides, or n<2): sklearn returns 1.0
+    return jnp.where(jnp.abs(denom) > 1e-12, (nij2 - expected) / jnp.where(jnp.abs(denom) > 1e-12, denom, 1.0), 1.0)
+
+
+def _entropy(counts: Array) -> Array:
+    """Shannon entropy (nats) of a 1-D count vector."""
+    n = counts.sum()
+    p = counts / jnp.maximum(n, 1)
+    return -jnp.sum(jnp.where(p > 0, p * jnp.log(jnp.where(p > 0, p, 1.0)), 0.0))
+
+
+def _mutual_info_compute(cont: Array) -> Array:
+    cont = cont.astype(jnp.float32)
+    n = cont.sum()
+    a = cont.sum(axis=1, keepdims=True)
+    b = cont.sum(axis=0, keepdims=True)
+    pij = cont / jnp.maximum(n, 1.0)
+    log_term = jnp.log(jnp.maximum(n, 1.0) * cont / jnp.maximum(a * b, 1.0))
+    return jnp.sum(jnp.where(cont > 0, pij * log_term, 0.0))
+
+
+def _homogeneity_completeness(cont: Array) -> Tuple[Array, Array]:
+    mi = _mutual_info_compute(cont)
+    h_true = _entropy(cont.sum(axis=0).astype(jnp.float32))
+    h_pred = _entropy(cont.sum(axis=1).astype(jnp.float32))
+    hom = jnp.where(h_true > 0, mi / jnp.where(h_true > 0, h_true, 1.0), 1.0)
+    com = jnp.where(h_pred > 0, mi / jnp.where(h_pred > 0, h_pred, 1.0), 1.0)
+    return hom, com
+
+
+def _v_measure_compute(cont: Array, beta: float = 1.0) -> Array:
+    hom, com = _homogeneity_completeness(cont)
+    denom = beta * hom + com
+    return jnp.where(denom > 0, (1.0 + beta) * hom * com / jnp.where(denom > 0, denom, 1.0), 0.0)
+
+
+def _normalized_mutual_info_compute(cont: Array, average_method: str = "arithmetic") -> Array:
+    mi = _mutual_info_compute(cont)
+    h_pred = _entropy(cont.sum(axis=1).astype(jnp.float32))
+    h_true = _entropy(cont.sum(axis=0).astype(jnp.float32))
+    if average_method == "arithmetic":
+        norm = (h_pred + h_true) / 2.0
+    elif average_method == "geometric":
+        norm = jnp.sqrt(h_pred * h_true)
+    elif average_method == "min":
+        norm = jnp.minimum(h_pred, h_true)
+    elif average_method == "max":
+        norm = jnp.maximum(h_pred, h_true)
+    else:
+        raise ValueError(
+            f"average_method must be 'arithmetic', 'geometric', 'min' or 'max', got {average_method!r}"
+        )
+    # both clusterings trivial -> NMI defined as 1 (sklearn: 1.0 when MI==0
+    # because both entropies are 0), else 0 when only the norm vanishes
+    return jnp.where(norm > 1e-12, mi / jnp.where(norm > 1e-12, norm, 1.0), 1.0)
+
+
+def _fowlkes_mallows_compute(cont: Array) -> Array:
+    nij2, a2, b2, _ = _pair_counts(cont)
+    denom = jnp.sqrt(a2) * jnp.sqrt(b2)
+    return jnp.where(denom > 0, nij2 / jnp.where(denom > 0, denom, 1.0), 0.0)
+
+
+def rand_score(preds: Array, target: Array, num_clusters: int, num_classes: int) -> Array:
+    """Rand index between predicted cluster labels and true labels.
+
+    Matches ``sklearn.metrics.rand_score``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> float(rand_score(jnp.array([0, 0, 1, 1]), jnp.array([1, 1, 0, 0]),
+        ...                  num_clusters=2, num_classes=2))
+        1.0
+    """
+    return _rand_compute(_contingency(preds, target, num_clusters, num_classes))
+
+
+def adjusted_rand_score(preds: Array, target: Array, num_clusters: int, num_classes: int) -> Array:
+    """Chance-adjusted Rand index (``sklearn.metrics.adjusted_rand_score``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> float(adjusted_rand_score(jnp.array([0, 0, 1, 1]), jnp.array([0, 0, 1, 1]),
+        ...                           num_clusters=2, num_classes=2))
+        1.0
+    """
+    return _adjusted_rand_compute(_contingency(preds, target, num_clusters, num_classes))
+
+
+def mutual_info_score(preds: Array, target: Array, num_clusters: int, num_classes: int) -> Array:
+    """Mutual information (nats) between two labelings
+    (``sklearn.metrics.mutual_info_score``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> round(float(mutual_info_score(jnp.array([0, 0, 1, 1]), jnp.array([0, 0, 1, 1]),
+        ...                               num_clusters=2, num_classes=2)), 4)
+        0.6931
+    """
+    return _mutual_info_compute(_contingency(preds, target, num_clusters, num_classes))
+
+
+def normalized_mutual_info_score(
+    preds: Array, target: Array, num_clusters: int, num_classes: int,
+    average_method: str = "arithmetic",
+) -> Array:
+    """NMI with arithmetic/geometric/min/max normalization
+    (``sklearn.metrics.normalized_mutual_info_score``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> float(normalized_mutual_info_score(jnp.array([0, 0, 1, 1]), jnp.array([1, 1, 0, 0]),
+        ...                                    num_clusters=2, num_classes=2))
+        1.0
+    """
+    return _normalized_mutual_info_compute(
+        _contingency(preds, target, num_clusters, num_classes), average_method
+    )
+
+
+def homogeneity_score(preds: Array, target: Array, num_clusters: int, num_classes: int) -> Array:
+    """Each cluster contains only one class (``sklearn.metrics.homogeneity_score``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> float(homogeneity_score(jnp.array([0, 1, 2, 3]), jnp.array([0, 0, 1, 1]),
+        ...                         num_clusters=4, num_classes=2))
+        1.0
+    """
+    return _homogeneity_completeness(_contingency(preds, target, num_clusters, num_classes))[0]
+
+
+def completeness_score(preds: Array, target: Array, num_clusters: int, num_classes: int) -> Array:
+    """Each class lands in one cluster (``sklearn.metrics.completeness_score``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> float(completeness_score(jnp.array([0, 0, 0, 0]), jnp.array([0, 0, 1, 1]),
+        ...                          num_clusters=1, num_classes=2))
+        1.0
+    """
+    return _homogeneity_completeness(_contingency(preds, target, num_clusters, num_classes))[1]
+
+
+def v_measure_score(
+    preds: Array, target: Array, num_clusters: int, num_classes: int, beta: float = 1.0
+) -> Array:
+    """Harmonic mean of homogeneity and completeness
+    (``sklearn.metrics.v_measure_score``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> float(v_measure_score(jnp.array([0, 0, 1, 1]), jnp.array([0, 0, 1, 1]),
+        ...                       num_clusters=2, num_classes=2))
+        1.0
+    """
+    return _v_measure_compute(_contingency(preds, target, num_clusters, num_classes), beta)
+
+
+def fowlkes_mallows_score(preds: Array, target: Array, num_clusters: int, num_classes: int) -> Array:
+    """Geometric mean of pairwise precision and recall
+    (``sklearn.metrics.fowlkes_mallows_score``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> round(float(fowlkes_mallows_score(jnp.array([0, 0, 1, 1]), jnp.array([0, 0, 1, 1]),
+        ...                                   num_clusters=2, num_classes=2)), 4)
+        1.0
+    """
+    return _fowlkes_mallows_compute(_contingency(preds, target, num_clusters, num_classes))

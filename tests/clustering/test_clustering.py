@@ -1,0 +1,132 @@
+"""Clustering family vs sklearn oracles (contingency-matrix streaming)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import sklearn.metrics as sk
+
+from metrics_tpu import (
+    AdjustedRandScore,
+    CompletenessScore,
+    FowlkesMallowsScore,
+    HomogeneityScore,
+    MutualInfoScore,
+    NormalizedMutualInfoScore,
+    RandScore,
+    VMeasureScore,
+)
+from metrics_tpu.functional import (
+    adjusted_rand_score,
+    completeness_score,
+    fowlkes_mallows_score,
+    homogeneity_score,
+    mutual_info_score,
+    normalized_mutual_info_score,
+    rand_score,
+    v_measure_score,
+)
+from tests.helpers.testers import MetricTester
+
+_rng = np.random.RandomState(53)
+NUM_BATCHES, BATCH_SIZE = 10, 32
+NUM_CLUSTERS, NUM_CLASSES = 7, 5
+
+_target = _rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+# correlated predicted clusters so the scores are non-trivial
+_preds = (_target + (_rng.rand(NUM_BATCHES, BATCH_SIZE) < 0.3) * _rng.randint(
+    0, NUM_CLUSTERS, (NUM_BATCHES, BATCH_SIZE))) % NUM_CLUSTERS
+
+_ARGS = {"num_clusters": NUM_CLUSTERS, "num_classes": NUM_CLASSES}
+
+
+def _sk(fn):
+    def wrapped(preds, target):
+        return fn(np.asarray(target).reshape(-1), np.asarray(preds).reshape(-1))
+
+    return wrapped
+
+
+_CASES = [
+    (RandScore, rand_score, _sk(sk.rand_score)),
+    (AdjustedRandScore, adjusted_rand_score, _sk(sk.adjusted_rand_score)),
+    (MutualInfoScore, mutual_info_score, _sk(sk.mutual_info_score)),
+    (NormalizedMutualInfoScore, normalized_mutual_info_score, _sk(sk.normalized_mutual_info_score)),
+    (HomogeneityScore, homogeneity_score, _sk(sk.homogeneity_score)),
+    (CompletenessScore, completeness_score, _sk(sk.completeness_score)),
+    (VMeasureScore, v_measure_score, _sk(sk.v_measure_score)),
+    (FowlkesMallowsScore, fowlkes_mallows_score, _sk(sk.fowlkes_mallows_score)),
+]
+
+
+@pytest.mark.parametrize("metric_class, functional, sk_metric", _CASES)
+class TestClustering(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_clustering_class(self, metric_class, functional, sk_metric, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_preds,
+            target=_target,
+            metric_class=metric_class,
+            sk_metric=sk_metric,
+            dist_sync_on_step=False,
+            metric_args=_ARGS,
+        )
+
+    def test_clustering_functional(self, metric_class, functional, sk_metric):
+        self.run_functional_metric_test(
+            _preds, _target, metric_functional=functional, sk_metric=sk_metric,
+            metric_args=_ARGS,
+        )
+
+
+@pytest.mark.parametrize("avg", ["arithmetic", "geometric", "min", "max"])
+def test_nmi_average_methods(avg):
+    p, t = jnp.asarray(_preds[0]), jnp.asarray(_target[0])
+    got = float(normalized_mutual_info_score(p, t, NUM_CLUSTERS, NUM_CLASSES, average_method=avg))
+    want = sk.normalized_mutual_info_score(np.asarray(t), np.asarray(p), average_method=avg)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_clustering_degenerate():
+    """Single-cluster / single-class / perfect labelings match sklearn."""
+    t = _rng.randint(0, 3, 50)
+    one_cluster = np.zeros(50, int)
+    for ours, theirs in [
+        (lambda p, y: rand_score(p, y, 1, 3), sk.rand_score),
+        (lambda p, y: adjusted_rand_score(p, y, 1, 3), sk.adjusted_rand_score),
+        (lambda p, y: completeness_score(p, y, 1, 3), sk.completeness_score),
+        (lambda p, y: v_measure_score(p, y, 1, 3), sk.v_measure_score),
+    ]:
+        got = float(ours(jnp.asarray(one_cluster), jnp.asarray(t)))
+        want = float(theirs(t, one_cluster))
+        np.testing.assert_allclose(got, want, atol=1e-6)
+    perfect = np.arange(20) % 4
+    assert float(adjusted_rand_score(jnp.asarray(perfect), jnp.asarray(perfect), 4, 4)) == 1.0
+
+
+def test_clustering_streaming_equals_one_shot():
+    """Batch-streamed contingency equals single-shot on the concatenation."""
+    m = MutualInfoScore(**_ARGS)
+    for b in range(NUM_BATCHES):
+        m.update(jnp.asarray(_preds[b]), jnp.asarray(_target[b]))
+    want = sk.mutual_info_score(_target.reshape(-1), _preds.reshape(-1))
+    np.testing.assert_allclose(float(m.compute()), want, atol=1e-5)
+
+
+def test_clustering_validation():
+    with pytest.raises(ValueError, match="positive int"):
+        RandScore(num_clusters=0, num_classes=3)
+    with pytest.raises(ValueError, match="average_method"):
+        NormalizedMutualInfoScore(num_clusters=2, num_classes=2, average_method="median")
+    with pytest.raises(ValueError, match="identical shape"):
+        rand_score(jnp.zeros(3, dtype=jnp.int32), jnp.zeros(4, dtype=jnp.int32), 2, 2)
+
+
+def test_clustering_jit():
+    import jax
+
+    p, t = jnp.asarray(_preds[0]), jnp.asarray(_target[0])
+    got = jax.jit(lambda a, b: v_measure_score(a, b, NUM_CLUSTERS, NUM_CLASSES))(p, t)
+    want = sk.v_measure_score(np.asarray(t), np.asarray(p))
+    np.testing.assert_allclose(float(got), want, atol=1e-5)
