@@ -85,9 +85,17 @@ from metrics_tpu.retrieval import (  # noqa: E402
 )
 from metrics_tpu.text import WER, CharErrorRate, MatchErrorRate, Perplexity, ROUGEScore, SQuAD, WordInfoLost, WordInfoPreserved  # noqa: E402
 from metrics_tpu.audio import PIT, SI_SDR, SI_SNR, SNR  # noqa: E402
+from metrics_tpu.nominal import (  # noqa: E402
+    CramersV,
+    PearsonsContingencyCoefficient,
+    TheilsU,
+    TschuprowsT,
+)
 from metrics_tpu.clustering import (  # noqa: E402
     AdjustedRandScore,
+    CalinskiHarabaszScore,
     CompletenessScore,
+    DaviesBouldinScore,
     FowlkesMallowsScore,
     HomogeneityScore,
     MutualInfoScore,

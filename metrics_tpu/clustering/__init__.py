@@ -7,6 +7,7 @@ confusion matrix uses, ``"sum"``-reducible across devices — and applies its
 closed-form compute at the end. sklearn-exact; see
 ``metrics_tpu/functional/clustering.py``.
 """
+from metrics_tpu.clustering.intrinsic import CalinskiHarabaszScore, DaviesBouldinScore
 from metrics_tpu.clustering.scores import (
     AdjustedRandScore,
     CompletenessScore,
@@ -20,7 +21,9 @@ from metrics_tpu.clustering.scores import (
 
 __all__ = [
     "AdjustedRandScore",
+    "CalinskiHarabaszScore",
     "CompletenessScore",
+    "DaviesBouldinScore",
     "FowlkesMallowsScore",
     "HomogeneityScore",
     "MutualInfoScore",

@@ -1,0 +1,133 @@
+"""Stateful intrinsic clustering scores (no ground-truth labels needed).
+
+``CalinskiHarabaszScore`` streams ONE per-cluster ``[n, M2, mean]`` moment
+block whose distributed reduction is a per-cluster Chan parallel merge
+(the ``PearsonCorrcoef`` comoments pattern): each batch's moments are
+computed exactly in two passes (the batch is in hand), and blocks combine
+associatively across batches / devices / checkpoint shards without the
+large-offset cancellation of raw sum-of-squares moments.
+``DaviesBouldinScore`` needs mean Euclidean (not squared) distances — a
+two-pass-over-everything quantity — so it keeps cat-states (bounded via
+``capacity``) and runs one jitted epoch compute, like the curve metrics.
+"""
+from typing import Any, Callable, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.clustering_intrinsic import (
+    _ch_from_cluster_moments,
+    _check_data_labels,
+    _cluster_moments_batch,
+    cluster_chan_fold,
+    cluster_chan_merge,
+    davies_bouldin_score,
+)
+from metrics_tpu.parallel.buffer import as_values
+from metrics_tpu.parallel.sync import associative
+
+_ch_fold = associative(cluster_chan_fold)
+
+
+class CalinskiHarabaszScore(Metric):
+    """Streaming variance-ratio criterion
+    (``sklearn.metrics.calinski_harabasz_score``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> metric = CalinskiHarabaszScore(num_clusters=2, num_features=2)
+        >>> data = jnp.array([[0.0, 0.0], [0.1, 0.0], [5.0, 5.0], [5.1, 5.0]])
+        >>> labels = jnp.array([0, 0, 1, 1])
+        >>> round(float(metric(data, labels)), 1)
+        10000.0
+    """
+
+    def __init__(
+        self,
+        num_clusters: int,
+        num_features: int,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        if not isinstance(num_clusters, int) or num_clusters < 1:
+            raise ValueError(f"`num_clusters` must be a positive int, got {num_clusters!r}")
+        if not isinstance(num_features, int) or num_features < 1:
+            raise ValueError(f"`num_features` must be a positive int, got {num_features!r}")
+        self.num_clusters = num_clusters
+        self.num_features = num_features
+        self.add_state(
+            "moments",
+            default=np.zeros((num_clusters, 2 + num_features), dtype=np.float32),
+            dist_reduce_fx=_ch_fold,
+        )
+
+    def update(self, data: Array, labels: Array) -> None:
+        batch = _cluster_moments_batch(jnp.asarray(data), labels, self.num_clusters)
+        self.moments = cluster_chan_merge(self.moments, batch)
+
+    def compute(self) -> Array:
+        return _ch_from_cluster_moments(self.moments)
+
+
+class DaviesBouldinScore(Metric):
+    """Accumulated Davies-Bouldin index
+    (``sklearn.metrics.davies_bouldin_score``; lower is better).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> metric = DaviesBouldinScore(num_clusters=2)
+        >>> data = jnp.array([[0.0, 0.0], [0.1, 0.0], [5.0, 5.0], [5.1, 5.0]])
+        >>> labels = jnp.array([0, 0, 1, 1])
+        >>> round(float(metric(data, labels)), 4)
+        0.0141
+    """
+
+    def __init__(
+        self,
+        num_clusters: int,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+        capacity: Optional[int] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+            capacity=capacity,
+        )
+        if not isinstance(num_clusters, int) or num_clusters < 1:
+            raise ValueError(f"`num_clusters` must be a positive int, got {num_clusters!r}")
+        self.num_clusters = num_clusters
+        self.add_state("data_all", default=[], dist_reduce_fx=None)
+        self.add_state("labels_all", default=[], dist_reduce_fx=None, item_shape=(), item_dtype=jnp.int32)
+
+    def update(self, data: Array, labels: Array) -> None:
+        _check_data_labels(data, labels)
+        self._append("data_all", jnp.asarray(data, dtype=jnp.float32))
+        self._append("labels_all", jnp.asarray(labels, dtype=jnp.int32))
+
+    def compute(self) -> Array:
+        data = as_values(self.data_all)
+        labels = as_values(self.labels_all)
+        if data.shape[0] == 0:
+            return jnp.asarray(jnp.nan)
+        fn = (
+            jax.jit(davies_bouldin_score, static_argnums=2)
+            if (self._jit is not False and not self._jit_failed)
+            else davies_bouldin_score
+        )
+        return fn(data, labels, self.num_clusters)

@@ -20,6 +20,22 @@ from metrics_tpu.functional.classification.specificity import specificity
 from metrics_tpu.functional.classification.precision_recall_curve import precision_recall_curve
 from metrics_tpu.functional.classification.roc import roc
 from metrics_tpu.functional.classification.stat_scores import stat_scores
+from metrics_tpu.functional.nominal import (
+    cramers_v,
+    pearsons_contingency_coefficient,
+    theils_u,
+    tschuprows_t,
+)
+from metrics_tpu.functional.pairwise import (
+    pairwise_cosine_similarity,
+    pairwise_euclidean_distance,
+    pairwise_linear_similarity,
+    pairwise_manhattan_distance,
+)
+from metrics_tpu.functional.clustering_intrinsic import (
+    calinski_harabasz_score,
+    davies_bouldin_score,
+)
 from metrics_tpu.functional.clustering import (
     adjusted_rand_score,
     completeness_score,

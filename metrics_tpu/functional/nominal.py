@@ -1,0 +1,147 @@
+"""Nominal (categorical-categorical) association metrics.
+
+Extension family beyond the reference snapshot (later torchmetrics ships
+``nominal/``). All four are closed forms of the same streamed contingency
+matrix the clustering family uses (one-hot MXU contraction,
+``"sum"``-reducible):
+
+* ``cramers_v`` — chi-squared based, optional bias correction
+  (Bergsma 2013), matching ``scipy.stats.contingency.association
+  ('cramer')`` / torchmetrics' corrected variant.
+* ``pearsons_contingency_coefficient`` — ``sqrt(chi2 / (chi2 + n))``
+  (scipy ``'pearson'``).
+* ``tschuprows_t`` — chi-squared normalized by ``sqrt((r-1)(c-1))``
+  (scipy ``'tschuprow'``).
+* ``theils_u`` — the asymmetric uncertainty coefficient
+  ``U(target|preds) = (H(target) - H(target|preds)) / H(target)``.
+"""
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.clustering import _contingency, _entropy
+
+
+def _chi2(cont: Array) -> Array:
+    cont = cont.astype(jnp.float32)
+    n = cont.sum()
+    expected = cont.sum(1, keepdims=True) * cont.sum(0, keepdims=True) / jnp.maximum(n, 1.0)
+    return jnp.sum(jnp.where(expected > 0, (cont - expected) ** 2 / jnp.maximum(expected, 1e-30), 0.0))
+
+
+def _effective_dims(cont: Array) -> tuple:
+    """Populated row/column counts (empty rows/cols excluded, matching the
+    unique-label semantics of the scipy/pandas implementations)."""
+    r = (cont.sum(1) > 0).sum().astype(jnp.float32)
+    c = (cont.sum(0) > 0).sum().astype(jnp.float32)
+    return r, c
+
+
+def _cramers_v_compute(cont: Array, bias_correction: bool = False) -> Array:
+    chi2 = _chi2(cont)
+    n = cont.sum().astype(jnp.float32)
+    r, c = _effective_dims(cont)
+    if bias_correction:
+        phi2 = chi2 / jnp.maximum(n, 1.0)
+        phi2c = jnp.maximum(0.0, phi2 - (r - 1.0) * (c - 1.0) / jnp.maximum(n - 1.0, 1.0))
+        rc = r - (r - 1.0) ** 2 / jnp.maximum(n - 1.0, 1.0)
+        cc = c - (c - 1.0) ** 2 / jnp.maximum(n - 1.0, 1.0)
+        denom = jnp.minimum(rc, cc) - 1.0
+        return jnp.where(denom > 0, jnp.sqrt(phi2c / jnp.where(denom > 0, denom, 1.0)), jnp.nan)
+    denom = n * (jnp.minimum(r, c) - 1.0)
+    return jnp.where(denom > 0, jnp.sqrt(chi2 / jnp.where(denom > 0, denom, 1.0)), jnp.nan)
+
+
+def _pearson_cc_compute(cont: Array) -> Array:
+    chi2 = _chi2(cont)
+    n = cont.sum().astype(jnp.float32)
+    return jnp.sqrt(chi2 / jnp.maximum(chi2 + n, 1e-30))
+
+
+def _tschuprows_t_compute(cont: Array) -> Array:
+    chi2 = _chi2(cont)
+    n = cont.sum().astype(jnp.float32)
+    r, c = _effective_dims(cont)
+    denom = n * jnp.sqrt(jnp.maximum((r - 1.0) * (c - 1.0), 0.0))
+    return jnp.where(denom > 0, jnp.sqrt(chi2 / jnp.where(denom > 0, denom, 1.0)), jnp.nan)
+
+
+def _theils_u_compute(cont: Array) -> Array:
+    """U(target | preds): how much knowing preds reduces target entropy."""
+    cont = cont.astype(jnp.float32)
+    n = cont.sum()
+    h_target = _entropy(cont.sum(0))
+    # conditional entropy H(target | preds) = sum_rows p_row * H(row)
+    row_tot = cont.sum(1)
+    p_rows = cont / jnp.maximum(row_tot[:, None], 1.0)
+    h_rows = -jnp.sum(jnp.where(p_rows > 0, p_rows * jnp.log(jnp.where(p_rows > 0, p_rows, 1.0)), 0.0), axis=1)
+    h_cond = jnp.sum(jnp.where(row_tot > 0, (row_tot / jnp.maximum(n, 1.0)) * h_rows, 0.0))
+    return jnp.where(h_target > 0, (h_target - h_cond) / jnp.where(h_target > 0, h_target, 1.0), 1.0)
+
+
+def cramers_v(
+    preds: Array, target: Array, num_classes_preds: int, num_classes_target: int,
+    bias_correction: bool = False,
+) -> Array:
+    """Cramer's V association between two categorical variables.
+
+    Matches ``scipy.stats.contingency.association(..., method='cramer')``;
+    ``bias_correction=True`` applies the Bergsma small-sample correction.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([0, 0, 1, 1, 2, 2])
+        >>> target = jnp.array([0, 0, 1, 1, 2, 2])
+        >>> round(float(cramers_v(preds, target, 3, 3)), 4)
+        1.0
+    """
+    return _cramers_v_compute(
+        _contingency(preds, target, num_classes_preds, num_classes_target), bias_correction
+    )
+
+
+def pearsons_contingency_coefficient(
+    preds: Array, target: Array, num_classes_preds: int, num_classes_target: int
+) -> Array:
+    """Pearson's contingency coefficient
+    (``scipy.stats.contingency.association(..., method='pearson')``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([0, 0, 1, 1])
+        >>> target = jnp.array([0, 0, 1, 1])
+        >>> round(float(pearsons_contingency_coefficient(preds, target, 2, 2)), 4)
+        0.7071
+    """
+    return _pearson_cc_compute(_contingency(preds, target, num_classes_preds, num_classes_target))
+
+
+def tschuprows_t(
+    preds: Array, target: Array, num_classes_preds: int, num_classes_target: int
+) -> Array:
+    """Tschuprow's T association
+    (``scipy.stats.contingency.association(..., method='tschuprow')``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([0, 0, 1, 1, 2, 2])
+        >>> target = jnp.array([0, 0, 1, 1, 2, 2])
+        >>> round(float(tschuprows_t(preds, target, 3, 3)), 4)
+        1.0
+    """
+    return _tschuprows_t_compute(_contingency(preds, target, num_classes_preds, num_classes_target))
+
+
+def theils_u(
+    preds: Array, target: Array, num_classes_preds: int, num_classes_target: int
+) -> Array:
+    """Theil's U (uncertainty coefficient), asymmetric: how much knowing
+    ``preds`` reduces the entropy of ``target``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([0, 0, 1, 1])
+        >>> target = jnp.array([0, 0, 1, 1])
+        >>> round(float(theils_u(preds, target, 2, 2)), 4)
+        1.0
+    """
+    return _theils_u_compute(_contingency(preds, target, num_classes_preds, num_classes_target))

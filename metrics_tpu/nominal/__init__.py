@@ -1,0 +1,15 @@
+"""Stateful nominal-association metrics (an extension family; later torchmetrics ships ``nominal/``).
+
+All four stream the same ``(num_classes_preds, num_classes_target)``
+contingency matrix (one-hot MXU contraction, one sum-reducible int32
+state); see ``metrics_tpu/functional/nominal.py`` for the formulas and
+oracles.
+"""
+from metrics_tpu.nominal.association import (
+    CramersV,
+    PearsonsContingencyCoefficient,
+    TheilsU,
+    TschuprowsT,
+)
+
+__all__ = ["CramersV", "PearsonsContingencyCoefficient", "TheilsU", "TschuprowsT"]

@@ -1,0 +1,123 @@
+"""Nominal association metrics on the streamed contingency matrix."""
+from typing import Any, Callable, Optional
+
+import numpy as np
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.clustering import _contingency
+from metrics_tpu.functional.nominal import (
+    _cramers_v_compute,
+    _pearson_cc_compute,
+    _theils_u_compute,
+    _tschuprows_t_compute,
+)
+
+
+class _AssociationMetric(Metric):
+    """Shared base: stream the (preds-classes, target-classes) contingency."""
+
+    def __init__(
+        self,
+        num_classes_preds: int,
+        num_classes_target: Optional[int] = None,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        if num_classes_target is None:
+            num_classes_target = num_classes_preds
+        for name, v in (("num_classes_preds", num_classes_preds), ("num_classes_target", num_classes_target)):
+            if not isinstance(v, int) or v < 1:
+                raise ValueError(f"`{name}` must be a positive int, got {v!r}")
+        self.num_classes_preds = num_classes_preds
+        self.num_classes_target = num_classes_target
+        self.add_state(
+            "contingency",
+            default=np.zeros((num_classes_preds, num_classes_target), dtype=np.int32),
+            dist_reduce_fx="sum",
+        )
+
+    def update(self, preds: Array, target: Array) -> None:
+        self.contingency = self.contingency + _contingency(
+            preds, target, self.num_classes_preds, self.num_classes_target
+        )
+
+    def _score(self, cont: Array) -> Array:
+        raise NotImplementedError
+
+    def compute(self) -> Array:
+        return self._score(self.contingency)
+
+
+class CramersV(_AssociationMetric):
+    """Accumulated Cramer's V (``scipy.stats.contingency.association``,
+    ``method='cramer'``; optional Bergsma bias correction).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> metric = CramersV(num_classes_preds=3)
+        >>> round(float(metric(jnp.array([0, 0, 1, 1, 2, 2]), jnp.array([0, 0, 1, 1, 2, 2]))), 4)
+        1.0
+    """
+
+    def __init__(self, num_classes_preds: int, num_classes_target: Optional[int] = None,
+                 bias_correction: bool = False, **kwargs: Any):
+        super().__init__(num_classes_preds, num_classes_target, **kwargs)
+        self.bias_correction = bias_correction
+
+    def _score(self, cont: Array) -> Array:
+        return _cramers_v_compute(cont, self.bias_correction)
+
+
+class PearsonsContingencyCoefficient(_AssociationMetric):
+    """Accumulated Pearson's contingency coefficient
+    (``scipy.stats.contingency.association``, ``method='pearson'``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> metric = PearsonsContingencyCoefficient(num_classes_preds=2)
+        >>> round(float(metric(jnp.array([0, 0, 1, 1]), jnp.array([0, 0, 1, 1]))), 4)
+        0.7071
+    """
+
+    def _score(self, cont: Array) -> Array:
+        return _pearson_cc_compute(cont)
+
+
+class TschuprowsT(_AssociationMetric):
+    """Accumulated Tschuprow's T
+    (``scipy.stats.contingency.association``, ``method='tschuprow'``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> metric = TschuprowsT(num_classes_preds=3)
+        >>> round(float(metric(jnp.array([0, 0, 1, 1, 2, 2]), jnp.array([0, 0, 1, 1, 2, 2]))), 4)
+        1.0
+    """
+
+    def _score(self, cont: Array) -> Array:
+        return _tschuprows_t_compute(cont)
+
+
+class TheilsU(_AssociationMetric):
+    """Accumulated Theil's U — asymmetric: how much knowing ``preds``
+    reduces the entropy of ``target``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> metric = TheilsU(num_classes_preds=2)
+        >>> round(float(metric(jnp.array([0, 0, 1, 1]), jnp.array([0, 0, 1, 1]))), 4)
+        1.0
+    """
+
+    def _score(self, cont: Array) -> Array:
+        return _theils_u_compute(cont)

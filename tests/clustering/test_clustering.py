@@ -60,6 +60,10 @@ _CASES = [
 @pytest.mark.parametrize("metric_class, functional, sk_metric", _CASES)
 class TestClustering(MetricTester):
     atol = 1e-5
+    # the information-theoretic scores (MI/NMI/homogeneity/completeness/V)
+    # run p*log terms in f32; TPU log differs ~2e-5 relative from the f64
+    # sklearn oracle (same precision class as PSNR's rtol policy)
+    rtol = 1e-4
 
     @pytest.mark.parametrize("ddp", [False, True])
     def test_clustering_class(self, metric_class, functional, sk_metric, ddp):

@@ -1,0 +1,128 @@
+"""Intrinsic clustering scores (CH / DB) vs sklearn oracles."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import (
+    calinski_harabasz_score as sk_ch,
+    davies_bouldin_score as sk_db,
+)
+
+from metrics_tpu import CalinskiHarabaszScore, DaviesBouldinScore
+from metrics_tpu.functional import calinski_harabasz_score, davies_bouldin_score
+from tests.helpers.testers import MetricTester
+
+_rng = np.random.RandomState(61)
+NUM_BATCHES, BATCH_SIZE, NUM_CLUSTERS, DIM = 10, 32, 4, 6
+
+_centers = _rng.randn(NUM_CLUSTERS, DIM) * 8
+_labels = _rng.randint(0, NUM_CLUSTERS, (NUM_BATCHES, BATCH_SIZE))
+_data = (_centers[_labels] + _rng.randn(NUM_BATCHES, BATCH_SIZE, DIM)).astype(np.float32)
+
+
+def _sk_wrap(fn):
+    def wrapped(preds, target):
+        X = np.asarray(preds).reshape(-1, DIM)
+        lab = np.asarray(target).reshape(-1)
+        return fn(X, lab)
+
+    return wrapped
+
+
+class TestCalinskiHarabasz(MetricTester):
+    atol = 1e-5
+    rtol = 1e-4  # f32 moments vs f64 sklearn
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_ch_class(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_data,
+            target=_labels,
+            metric_class=CalinskiHarabaszScore,
+            sk_metric=_sk_wrap(sk_ch),
+            dist_sync_on_step=False,
+            metric_args={"num_clusters": NUM_CLUSTERS, "num_features": DIM},
+        )
+
+    def test_ch_functional(self):
+        self.run_functional_metric_test(
+            _data, _labels, metric_functional=calinski_harabasz_score,
+            sk_metric=_sk_wrap(sk_ch), metric_args={"num_clusters": NUM_CLUSTERS},
+        )
+
+
+class TestDaviesBouldin(MetricTester):
+    atol = 1e-5
+    rtol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_db_class(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_data,
+            target=_labels,
+            metric_class=DaviesBouldinScore,
+            sk_metric=_sk_wrap(sk_db),
+            dist_sync_on_step=False,
+            metric_args={"num_clusters": NUM_CLUSTERS},
+        )
+
+    def test_db_functional(self):
+        self.run_functional_metric_test(
+            _data, _labels, metric_functional=davies_bouldin_score,
+            sk_metric=_sk_wrap(sk_db), metric_args={"num_clusters": NUM_CLUSTERS},
+        )
+
+
+def test_ch_streaming_cancellation_stress():
+    """Huge cluster offsets: the Chan moment design stays ~f32-exact where
+    raw sum-of-squares moments lose several digits."""
+    rng = np.random.RandomState(3)
+    k, d, n = 5, 8, 1000
+    centers = rng.randn(k, d) * 100
+    labels = rng.randint(0, k, n)
+    X = (centers[labels] + rng.randn(n, d)).astype(np.float32)
+    m = CalinskiHarabaszScore(num_clusters=k, num_features=d)
+    for b in range(10):
+        m.update(jnp.asarray(X[b * 100:(b + 1) * 100]), jnp.asarray(labels[b * 100:(b + 1) * 100]))
+    want = sk_ch(X, labels)
+    np.testing.assert_allclose(float(m.compute()), want, rtol=1e-5)
+
+
+def test_intrinsic_empty_cluster_semantics():
+    """Static num_clusters larger than the labels actually used: populated
+    clusters only, matching sklearn's unique-label semantics."""
+    X = np.asarray(_data[0])
+    lab = np.asarray(_labels[0]) % 2  # only clusters {0, 1} of 4
+    got_ch = float(calinski_harabasz_score(jnp.asarray(X), jnp.asarray(lab), NUM_CLUSTERS))
+    got_db = float(davies_bouldin_score(jnp.asarray(X), jnp.asarray(lab), NUM_CLUSTERS))
+    np.testing.assert_allclose(got_ch, sk_ch(X, lab), rtol=1e-4)
+    np.testing.assert_allclose(got_db, sk_db(X, lab), rtol=1e-4)
+
+
+def test_intrinsic_validation():
+    with pytest.raises(ValueError, match="positive int"):
+        CalinskiHarabaszScore(num_clusters=0, num_features=2)
+    with pytest.raises(ValueError, match="positive int"):
+        DaviesBouldinScore(num_clusters=-1)
+    with pytest.raises(ValueError, match=r"data \(N, d\)"):
+        calinski_harabasz_score(jnp.zeros(5), jnp.zeros(5, dtype=jnp.int32), 2)
+
+
+def test_intrinsic_jit():
+    import jax
+
+    X, lab = jnp.asarray(_data[0]), jnp.asarray(_labels[0])
+    got = jax.jit(lambda a, b: calinski_harabasz_score(a, b, NUM_CLUSTERS))(X, lab)
+    np.testing.assert_allclose(float(got), sk_ch(np.asarray(X), np.asarray(lab)), rtol=1e-4)
+
+
+def test_db_with_capacity_buffer():
+    """capacity promotes the cat-states to PaddedBuffers; labels must stay
+    integer through the buffer (regression: float32 buffer default broke
+    centroid indexing)."""
+    m = DaviesBouldinScore(num_clusters=NUM_CLUSTERS, capacity=NUM_BATCHES * BATCH_SIZE)
+    for b in range(3):
+        m.update(jnp.asarray(_data[b]), jnp.asarray(_labels[b]))
+    want = sk_db(_data[:3].reshape(-1, DIM), _labels[:3].reshape(-1))
+    np.testing.assert_allclose(float(m.compute()), want, rtol=1e-4)

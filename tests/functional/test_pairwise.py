@@ -1,0 +1,62 @@
+"""Pairwise similarity/distance functionals vs sklearn.metrics.pairwise."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import pairwise as skp
+
+from metrics_tpu.functional import (
+    pairwise_cosine_similarity,
+    pairwise_euclidean_distance,
+    pairwise_linear_similarity,
+    pairwise_manhattan_distance,
+)
+
+_rng = np.random.RandomState(59)
+X = _rng.randn(24, 6).astype(np.float32)
+Y = _rng.randn(17, 6).astype(np.float32)
+
+_CASES = [
+    (pairwise_cosine_similarity, skp.cosine_similarity),
+    (pairwise_euclidean_distance, skp.euclidean_distances),
+    (pairwise_manhattan_distance, skp.manhattan_distances),
+    (pairwise_linear_similarity, skp.linear_kernel),
+]
+
+
+@pytest.mark.parametrize("ours, theirs", _CASES)
+def test_pairwise_two_inputs(ours, theirs):
+    got = np.asarray(ours(jnp.asarray(X), jnp.asarray(Y)))
+    np.testing.assert_allclose(got, theirs(X, Y), atol=1e-4)
+
+
+@pytest.mark.parametrize("ours, theirs", _CASES)
+def test_pairwise_self_zero_diagonal(ours, theirs):
+    got = np.asarray(ours(jnp.asarray(X)))
+    want = theirs(X, X)
+    np.fill_diagonal(want, 0.0)
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_pairwise_reductions_and_validation():
+    full = np.asarray(pairwise_euclidean_distance(jnp.asarray(X), jnp.asarray(Y)))
+    np.testing.assert_allclose(
+        np.asarray(pairwise_euclidean_distance(jnp.asarray(X), jnp.asarray(Y), reduction="mean")),
+        full.mean(-1), atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(pairwise_euclidean_distance(jnp.asarray(X), jnp.asarray(Y), reduction="sum")),
+        full.sum(-1), atol=1e-4)
+    with pytest.raises(ValueError, match="reduction"):
+        pairwise_euclidean_distance(jnp.asarray(X), reduction="max")
+    with pytest.raises(ValueError, match="2-D"):
+        pairwise_cosine_similarity(jnp.zeros(3))
+    with pytest.raises(ValueError, match="Expected y of shape"):
+        pairwise_cosine_similarity(jnp.zeros((3, 2)), jnp.zeros((3, 4)))
+
+
+def test_pairwise_jit():
+    import jax
+
+    got = jax.jit(pairwise_cosine_similarity)(jnp.asarray(X))
+    want = skp.cosine_similarity(X, X)
+    np.fill_diagonal(want, 0.0)
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-4)

@@ -1,0 +1,122 @@
+"""Nominal association metrics vs scipy / f64-numpy oracles."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.stats.contingency import association, crosstab
+
+from metrics_tpu import CramersV, PearsonsContingencyCoefficient, TheilsU, TschuprowsT
+from metrics_tpu.functional import (
+    cramers_v,
+    pearsons_contingency_coefficient,
+    theils_u,
+    tschuprows_t,
+)
+from tests.helpers.testers import MetricTester
+
+_rng = np.random.RandomState(67)
+NUM_BATCHES, BATCH_SIZE = 10, 32
+NP, NT = 4, 5
+
+_preds = _rng.randint(0, NP, (NUM_BATCHES, BATCH_SIZE))
+_target = (_preds + (_rng.rand(NUM_BATCHES, BATCH_SIZE) < 0.4) * _rng.randint(
+    0, NT, (NUM_BATCHES, BATCH_SIZE))) % NT
+
+_ARGS = {"num_classes_preds": NP, "num_classes_target": NT}
+
+
+def _sk_association(method):
+    def wrapped(preds, target):
+        cont = crosstab(np.asarray(preds).reshape(-1), np.asarray(target).reshape(-1)).count
+        return association(cont, method=method)
+
+    return wrapped
+
+
+def _np_theils_u(preds, target):
+    p = np.asarray(preds).reshape(-1)
+    t = np.asarray(target).reshape(-1)
+    n = len(p)
+    pt = np.bincount(t, minlength=NT) / n
+    pt = pt[pt > 0]
+    h_t = -(pt * np.log(pt)).sum()
+    h_cond = 0.0
+    for v in range(NP):
+        mask = p == v
+        if mask.sum() == 0:
+            continue
+        sub = np.bincount(t[mask], minlength=NT) / mask.sum()
+        sub = sub[sub > 0]
+        h_cond += (mask.sum() / n) * (-(sub * np.log(sub)).sum())
+    return (h_t - h_cond) / h_t
+
+
+_CASES = [
+    (CramersV, cramers_v, _sk_association("cramer")),
+    (PearsonsContingencyCoefficient, pearsons_contingency_coefficient, _sk_association("pearson")),
+    (TschuprowsT, tschuprows_t, _sk_association("tschuprow")),
+    (TheilsU, theils_u, _np_theils_u),
+]
+
+
+@pytest.mark.parametrize("metric_class, functional, sk_metric", _CASES)
+class TestNominal(MetricTester):
+    atol = 1e-5
+    rtol = 1e-4  # f32 chi2/entropy vs f64 oracles
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_nominal_class(self, metric_class, functional, sk_metric, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_preds,
+            target=_target,
+            metric_class=metric_class,
+            sk_metric=sk_metric,
+            dist_sync_on_step=False,
+            metric_args=_ARGS,
+        )
+
+    def test_nominal_functional(self, metric_class, functional, sk_metric):
+        self.run_functional_metric_test(
+            _preds, _target, metric_functional=functional, sk_metric=sk_metric,
+            metric_args=_ARGS,
+        )
+
+
+def test_cramers_bias_correction():
+    """Bergsma-corrected V: smaller than raw V, 0 when chi2 is at chance."""
+    p, t = jnp.asarray(_preds[0]), jnp.asarray(_target[0])
+    raw = float(cramers_v(p, t, NP, NT))
+    corr = float(cramers_v(p, t, NP, NT, bias_correction=True))
+    assert corr < raw
+    m = CramersV(num_classes_preds=NP, num_classes_target=NT, bias_correction=True)
+    m.update(p, t)
+    np.testing.assert_allclose(float(m.compute()), corr, atol=1e-6)
+
+
+def test_theils_u_asymmetry():
+    """U(target|preds) != U(preds|target) in general."""
+    rng = np.random.RandomState(2)
+    p = rng.randint(0, 2, 200)
+    t = (p * 2 + rng.randint(0, 2, 200))  # target refines preds
+    u_pt = float(theils_u(jnp.asarray(p), jnp.asarray(t), 2, 4))
+    u_tp = float(theils_u(jnp.asarray(t), jnp.asarray(p), 4, 2))
+    assert abs(u_pt - u_tp) > 0.1
+    assert u_tp == pytest.approx(1.0, abs=1e-5)  # knowing target determines preds
+
+
+def test_nominal_validation_and_defaults():
+    m = CramersV(num_classes_preds=3)  # target classes default to preds classes
+    assert m.num_classes_target == 3
+    with pytest.raises(ValueError, match="positive int"):
+        TheilsU(num_classes_preds=0)
+    with pytest.raises(ValueError, match="identical shape"):
+        cramers_v(jnp.zeros(3, dtype=jnp.int32), jnp.zeros(4, dtype=jnp.int32), 2, 2)
+
+
+def test_nominal_jit():
+    import jax
+
+    p, t = jnp.asarray(_preds[0]), jnp.asarray(_target[0])
+    got = jax.jit(lambda a, b: tschuprows_t(a, b, NP, NT))(p, t)
+    want = _sk_association("tschuprow")(p, t)
+    np.testing.assert_allclose(float(got), want, rtol=1e-4)
