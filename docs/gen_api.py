@@ -29,6 +29,7 @@ SECTIONS = [
     ("Wrappers", "metrics_tpu.wrappers", None),
     ("Clustering", "metrics_tpu.clustering", None),
     ("Nominal association", "metrics_tpu.nominal", None),
+    ("Detection", "metrics_tpu.detection", None),
     ("Functional", "metrics_tpu.functional", None),
     ("Parallel (mesh sync, placement, sharded epoch)", "metrics_tpu.parallel", None),
     ("Ops (kernels)", "metrics_tpu.ops.binned", ["binned_stat_counts"]),

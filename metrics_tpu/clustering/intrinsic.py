@@ -73,7 +73,13 @@ class CalinskiHarabaszScore(Metric):
         )
 
     def update(self, data: Array, labels: Array) -> None:
-        batch = _cluster_moments_batch(jnp.asarray(data), labels, self.num_clusters)
+        data = jnp.asarray(data)
+        if data.ndim == 2 and data.shape[1] != self.num_features:
+            raise ValueError(
+                f"data has {data.shape[1]} features, metric was built with "
+                f"num_features={self.num_features}"
+            )
+        batch = _cluster_moments_batch(data, labels, self.num_clusters)
         self.moments = cluster_chan_merge(self.moments, batch)
 
     def compute(self) -> Array:

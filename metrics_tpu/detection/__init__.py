@@ -1,0 +1,9 @@
+"""Object-detection metrics (an extension family; later torchmetrics ships ``detection/``).
+
+``MeanAveragePrecision`` accumulates per-image padded box sets and runs the
+COCO evaluation as one static-shape jittable program — see
+``metrics_tpu/functional/detection/map.py`` for the engine.
+"""
+from metrics_tpu.detection.mean_ap import MeanAveragePrecision
+
+__all__ = ["MeanAveragePrecision"]

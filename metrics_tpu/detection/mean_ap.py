@@ -1,0 +1,166 @@
+"""MeanAveragePrecision module (COCO semantics, TPU-native engine)."""
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.detection.map import COCO_IOU_THRESHOLDS, coco_map_padded
+from metrics_tpu.parallel.buffer import as_values
+
+
+class MeanAveragePrecision(Metric):
+    """COCO-style mean average precision for object detection.
+
+    ``update`` takes the torchmetrics-style per-image dict lists::
+
+        preds  = [{"boxes": (N, 4) xyxy, "scores": (N,), "labels": (N,)}, ...]
+        target = [{"boxes": (M, 4) xyxy, "labels": (M,)}, ...]
+
+    Every image is padded to static ``max_detections`` / ``max_gt`` slots
+    (detections beyond the cap keep the top scores — the COCO ``maxDets``
+    semantics); the states are per-image stacks (cat-states, so they shard
+    and gather like every other epoch metric), and ``compute()`` runs the
+    whole COCO evaluation as one static-shape jitted program: greedy
+    matching scanned over detection slots, vmapped over
+    images x classes x IoU thresholds.
+
+    Args:
+        num_classes: static class count (labels in ``[0, num_classes)``).
+        iou_thresholds: tuple of IoU thresholds (default COCO
+            0.50:0.05:0.95).
+        max_detections: per-image detection cap (COCO ``maxDets``,
+            default 100).
+        max_gt: per-image ground-truth cap (exceeding it raises).
+        class_metrics: include per-class AP in the result dict.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> metric = MeanAveragePrecision(num_classes=2)
+        >>> preds = [{"boxes": jnp.array([[0.0, 0.0, 10.0, 10.0]]),
+        ...           "scores": jnp.array([0.9]), "labels": jnp.array([0])}]
+        >>> target = [{"boxes": jnp.array([[0.0, 0.0, 10.0, 10.0]]),
+        ...            "labels": jnp.array([0])}]
+        >>> out = metric(preds, target)
+        >>> float(out["map"])
+        1.0
+    """
+
+    def __init__(
+        self,
+        num_classes: int,
+        iou_thresholds: Sequence[float] = COCO_IOU_THRESHOLDS,
+        max_detections: int = 100,
+        max_gt: int = 100,
+        class_metrics: bool = False,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+        capacity: Optional[int] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+            capacity=capacity,
+        )
+        if not isinstance(num_classes, int) or num_classes < 1:
+            raise ValueError(f"`num_classes` must be a positive int, got {num_classes!r}")
+        if max_detections < 1 or max_gt < 1:
+            raise ValueError("`max_detections` and `max_gt` must be positive")
+        self.num_classes = num_classes
+        self.iou_thresholds = tuple(float(t) for t in iou_thresholds)
+        self.max_detections = max_detections
+        self.max_gt = max_gt
+        self.class_metrics = class_metrics
+        d, g = max_detections, max_gt
+        self.add_state("det_boxes", default=[], dist_reduce_fx=None, item_shape=(d, 4))
+        self.add_state("det_scores", default=[], dist_reduce_fx=None, item_shape=(d,))
+        self.add_state("det_labels", default=[], dist_reduce_fx=None, item_shape=(d,), item_dtype=jnp.int32)
+        self.add_state("det_valid", default=[], dist_reduce_fx=None, item_shape=(d,), item_dtype=jnp.bool_)
+        self.add_state("gt_boxes", default=[], dist_reduce_fx=None, item_shape=(g, 4))
+        self.add_state("gt_labels", default=[], dist_reduce_fx=None, item_shape=(g,), item_dtype=jnp.int32)
+        self.add_state("gt_valid", default=[], dist_reduce_fx=None, item_shape=(g,), item_dtype=jnp.bool_)
+
+    def _pad_det(self, entry: Dict[str, Array]) -> Tuple[Array, Array, Array, Array]:
+        boxes = jnp.asarray(entry["boxes"], dtype=jnp.float32).reshape(-1, 4)
+        scores = jnp.asarray(entry["scores"], dtype=jnp.float32).reshape(-1)
+        labels = jnp.asarray(entry["labels"], dtype=jnp.int32).reshape(-1)
+        if not (boxes.shape[0] == scores.shape[0] == labels.shape[0]):
+            raise ValueError(
+                f"boxes/scores/labels disagree: {boxes.shape[0]}/{scores.shape[0]}/{labels.shape[0]}"
+            )
+        n, cap = boxes.shape[0], self.max_detections
+        if n > cap:
+            # COCO maxDets: keep the top-scoring `cap` detections
+            keep = jnp.argsort(-scores)[:cap]
+            boxes, scores, labels, n = boxes[keep], scores[keep], labels[keep], cap
+        pad = cap - n
+        return (
+            jnp.pad(boxes, ((0, pad), (0, 0))),
+            jnp.pad(scores, (0, pad)),
+            jnp.pad(labels, (0, pad)),
+            jnp.pad(jnp.ones(n, dtype=bool), (0, pad)),
+        )
+
+    def _pad_gt(self, entry: Dict[str, Array]) -> Tuple[Array, Array, Array]:
+        boxes = jnp.asarray(entry["boxes"], dtype=jnp.float32).reshape(-1, 4)
+        labels = jnp.asarray(entry["labels"], dtype=jnp.int32).reshape(-1)
+        if boxes.shape[0] != labels.shape[0]:
+            raise ValueError(f"gt boxes/labels disagree: {boxes.shape[0]}/{labels.shape[0]}")
+        n, cap = boxes.shape[0], self.max_gt
+        if n > cap:
+            raise ValueError(f"image has {n} ground-truth boxes > max_gt={cap}")
+        pad = cap - n
+        return (
+            jnp.pad(boxes, ((0, pad), (0, 0))),
+            jnp.pad(labels, (0, pad)),
+            jnp.pad(jnp.ones(n, dtype=bool), (0, pad)),
+        )
+
+    def update(self, preds: List[Dict[str, Array]], target: List[Dict[str, Array]]) -> None:
+        if len(preds) != len(target):
+            raise ValueError(f"preds has {len(preds)} images, target {len(target)}")
+        for det_entry, gt_entry in zip(preds, target):
+            db, ds, dl, dv = self._pad_det(det_entry)
+            gb, gl, gv = self._pad_gt(gt_entry)
+            self._append("det_boxes", db[None])
+            self._append("det_scores", ds[None])
+            self._append("det_labels", dl[None])
+            self._append("det_valid", dv[None])
+            self._append("gt_boxes", gb[None])
+            self._append("gt_labels", gl[None])
+            self._append("gt_valid", gv[None])
+
+    def compute(self) -> Dict[str, Array]:
+        raw = self.det_boxes
+        empty = isinstance(raw, (list, tuple)) and len(raw) == 0
+        det_boxes = None if empty else as_values(raw)
+        if empty or det_boxes.shape[0] == 0:
+            nan = jnp.asarray(jnp.nan)
+            out = {"map": nan, "map_50": nan, "map_75": nan, "mar": nan}
+            if self.class_metrics:
+                out["map_per_class"] = jnp.full((self.num_classes,), jnp.nan)
+            return out
+        args = (
+            det_boxes,
+            as_values(self.det_scores),
+            as_values(self.det_labels),
+            as_values(self.det_valid),
+            as_values(self.gt_boxes),
+            as_values(self.gt_labels),
+            as_values(self.gt_valid),
+        )
+        fn = coco_map_padded
+        if self._jit is not False and not self._jit_failed:
+            fn = jax.jit(
+                coco_map_padded, static_argnames=("num_classes", "iou_thresholds")
+            )
+        out = fn(*args, num_classes=self.num_classes, iou_thresholds=self.iou_thresholds)
+        if not self.class_metrics:
+            out = {k: v for k, v in out.items() if k != "map_per_class"}
+        return out

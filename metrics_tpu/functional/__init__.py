@@ -20,6 +20,8 @@ from metrics_tpu.functional.classification.specificity import specificity
 from metrics_tpu.functional.classification.precision_recall_curve import precision_recall_curve
 from metrics_tpu.functional.classification.roc import roc
 from metrics_tpu.functional.classification.stat_scores import stat_scores
+from metrics_tpu.functional.detection.iou import box_iou, generalized_box_iou
+from metrics_tpu.functional.detection.map import coco_map_padded
 from metrics_tpu.functional.nominal import (
     cramers_v,
     pearsons_contingency_coefficient,

@@ -1,0 +1,69 @@
+"""Box IoU kernels (xyxy format). Extension beyond the reference snapshot.
+
+Pairwise box overlap is pure broadcast algebra — one fused XLA program,
+vmap-safe, the primitive under ``MeanAveragePrecision``'s matching stage.
+"""
+import jax.numpy as jnp
+from jax import Array
+
+
+def _check_boxes(name: str, boxes: Array) -> None:
+    if boxes.ndim != 2 or boxes.shape[-1] != 4:
+        raise ValueError(f"Expected {name} of shape (N, 4) xyxy, got {boxes.shape}")
+
+
+def _areas(boxes: Array) -> Array:
+    return jnp.clip(boxes[:, 2] - boxes[:, 0], 0) * jnp.clip(boxes[:, 3] - boxes[:, 1], 0)
+
+
+def _intersection(boxes1: Array, boxes2: Array) -> Array:
+    lt = jnp.maximum(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = jnp.minimum(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    return wh[..., 0] * wh[..., 1]
+
+
+def box_iou(boxes1: Array, boxes2: Array) -> Array:
+    """Pairwise IoU of two xyxy box sets: ``(N, 4) x (M, 4) -> (N, M)``.
+
+    Degenerate (zero-area) pairs give 0, not NaN.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> a = jnp.array([[0.0, 0.0, 2.0, 2.0]])
+        >>> b = jnp.array([[1.0, 1.0, 3.0, 3.0], [0.0, 0.0, 2.0, 2.0]])
+        >>> [round(float(v), 4) for v in box_iou(a, b)[0]]
+        [0.1429, 1.0]
+    """
+    _check_boxes("boxes1", boxes1)
+    _check_boxes("boxes2", boxes2)
+    boxes1 = boxes1.astype(jnp.float32)
+    boxes2 = boxes2.astype(jnp.float32)
+    inter = _intersection(boxes1, boxes2)
+    union = _areas(boxes1)[:, None] + _areas(boxes2)[None, :] - inter
+    return jnp.where(union > 0, inter / jnp.where(union > 0, union, 1.0), 0.0)
+
+
+def generalized_box_iou(boxes1: Array, boxes2: Array) -> Array:
+    """Pairwise GIoU (Rezatofighi et al. 2019): IoU minus the normalized
+    empty area of the smallest enclosing box; range ``[-1, 1]``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> a = jnp.array([[0.0, 0.0, 1.0, 1.0]])
+        >>> b = jnp.array([[2.0, 2.0, 3.0, 3.0]])
+        >>> round(float(generalized_box_iou(a, b)[0, 0]), 4)
+        -0.7778
+    """
+    _check_boxes("boxes1", boxes1)
+    _check_boxes("boxes2", boxes2)
+    boxes1 = boxes1.astype(jnp.float32)
+    boxes2 = boxes2.astype(jnp.float32)
+    inter = _intersection(boxes1, boxes2)
+    union = _areas(boxes1)[:, None] + _areas(boxes2)[None, :] - inter
+    iou = jnp.where(union > 0, inter / jnp.where(union > 0, union, 1.0), 0.0)
+    lt = jnp.minimum(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = jnp.maximum(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    enclose = wh[..., 0] * wh[..., 1]
+    return iou - jnp.where(enclose > 0, (enclose - union) / jnp.where(enclose > 0, enclose, 1.0), 0.0)

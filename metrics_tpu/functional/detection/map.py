@@ -1,0 +1,152 @@
+"""COCO-style mean average precision on padded box sets.
+
+Extension beyond the reference snapshot (later torchmetrics ships
+``detection/mean_ap.py`` on top of pycocotools / torch loops). This is a
+TPU-native re-design: everything is static-shape — images padded to
+``(I, D, ...)`` detections and ``(I, G, ...)`` ground truths with validity
+masks — and the whole evaluation is ONE jittable program:
+
+* greedy COCO matching (each detection, in descending score order, takes
+  the not-yet-used same-class ground truth with the highest IoU that
+  clears the threshold) as a ``lax.scan`` over detection slots, vmapped
+  over images x classes x IoU thresholds;
+* per-class cross-image ranking as a masked global sort;
+* AP as the standard 101-point interpolated precision envelope.
+
+Semantics follow pycocotools for the supported configuration (no crowd
+annotations, single area range, one max-detections cap = the static D).
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array, lax
+
+from metrics_tpu.functional.detection.iou import box_iou
+
+COCO_IOU_THRESHOLDS = tuple(round(0.5 + 0.05 * i, 2) for i in range(10))
+_RECALL_GRID = 101
+
+
+def _match_one(iou_dg: Array, det_ok: Array, gt_ok: Array, thr: Array) -> Array:
+    """Greedy COCO matching for one (image, class, threshold) cell.
+
+    ``iou_dg``: (D, G) IoU, detections already in descending-score order.
+    ``det_ok`` / ``gt_ok``: validity-and-class masks. Returns (D,) bool TP
+    flags.
+    """
+
+    def step(unused, inputs):
+        iou_row, ok = inputs
+        cand = jnp.where(gt_ok & (unused > 0), iou_row, -1.0)
+        best = jnp.argmax(cand)
+        matched = ok & (cand[best] >= thr)
+        unused = unused.at[best].set(jnp.where(matched, 0.0, unused[best]))
+        return unused, matched
+
+    _, tp = lax.scan(step, jnp.ones(iou_dg.shape[1]), (iou_dg, det_ok))
+    return tp
+
+
+def _interp_ap(tp_sorted: Array, fp_sorted: Array, n_gt: Array) -> Array:
+    """101-point interpolated AP from score-ranked TP/FP flags (one class,
+    one threshold). ``nan`` when the class has no ground truth."""
+    tps = jnp.cumsum(tp_sorted)
+    fps = jnp.cumsum(fp_sorted)
+    recall = tps / jnp.maximum(n_gt, 1.0)
+    precision = tps / jnp.maximum(tps + fps, 1e-30)
+    # precision envelope: best precision at-or-after each rank
+    envelope = lax.cummax(precision[::-1])[::-1]
+    grid = jnp.linspace(0.0, 1.0, _RECALL_GRID)
+    # first rank reaching each recall level (searchsorted on nondecreasing recall)
+    idx = jnp.searchsorted(recall, grid, side="left")
+    valid = idx < recall.shape[0]
+    p_at = jnp.where(valid, envelope[jnp.clip(idx, 0, recall.shape[0] - 1)], 0.0)
+    ap = p_at.mean()
+    return jnp.where(n_gt > 0, ap, jnp.nan)
+
+
+def coco_map_padded(
+    det_boxes: Array, det_scores: Array, det_labels: Array, det_valid: Array,
+    gt_boxes: Array, gt_labels: Array, gt_valid: Array,
+    num_classes: int,
+    iou_thresholds: Tuple[float, ...] = COCO_IOU_THRESHOLDS,
+) -> dict:
+    """COCO mAP over padded per-image box sets (all shapes static).
+
+    Args:
+        det_boxes: ``(I, D, 4)`` xyxy detections per image (padded).
+        det_scores / det_labels / det_valid: ``(I, D)`` confidence, integer
+            class, and validity of each detection slot.
+        gt_boxes: ``(I, G, 4)``; gt_labels / gt_valid: ``(I, G)``.
+        num_classes: static class count (labels in ``[0, num_classes)``).
+        iou_thresholds: static tuple (default COCO 0.50:0.05:0.95).
+
+    Returns:
+        dict with ``map`` (mean over classes and thresholds), ``map_50``,
+        ``map_75``, ``mar`` (mean max recall), and ``map_per_class``
+        ``(num_classes,)`` (nan for classes without ground truth).
+    """
+    n_img, n_det = det_scores.shape
+    thrs = jnp.asarray(iou_thresholds, dtype=jnp.float32)
+
+    # rank detections inside each image once (descending score; ghosts last)
+    order = jnp.argsort(-jnp.where(det_valid, det_scores, -jnp.inf), axis=1)
+    take = jax.vmap(lambda a, o: a[o])
+    det_boxes = take(det_boxes, order)
+    det_scores = take(det_scores, order)
+    det_labels = take(det_labels, order)
+    det_valid = take(det_valid, order)
+
+    iou = jax.vmap(box_iou)(det_boxes, gt_boxes)  # (I, D, G)
+
+    classes = jnp.arange(num_classes)
+
+    def per_cell(img_iou, d_lab, d_ok, g_lab, g_ok, cls, thr):
+        det_ok = d_ok & (d_lab == cls)
+        gt_ok = g_ok & (g_lab == cls)
+        # ghost/other-class gt columns must never match
+        masked = jnp.where(gt_ok[None, :], img_iou, -1.0)
+        return _match_one(masked, det_ok, gt_ok, thr)
+
+    # vmap over thresholds <- classes <- images
+    per_img = jax.vmap(per_cell, in_axes=(0, 0, 0, 0, 0, None, None))
+    per_class = jax.vmap(per_img, in_axes=(None, None, None, None, None, 0, None))
+    per_thr = jax.vmap(per_class, in_axes=(None, None, None, None, None, None, 0))
+    tp = per_thr(iou, det_labels, det_valid, gt_labels, gt_valid, classes, thrs)
+    # tp: (T, C, I, D) bool
+
+    det_cls_ok = det_valid[None, :, :] & (det_labels[None, :, :] == classes[:, None, None])  # (C, I, D)
+    n_gt = jnp.sum(gt_valid[None, :, :] & (gt_labels[None, :, :] == classes[:, None, None]),
+                   axis=(1, 2)).astype(jnp.float32)  # (C,)
+
+    # per-class global ranking across images (threshold-independent)
+    flat_scores = jnp.broadcast_to(det_scores[None], det_cls_ok.shape).reshape(num_classes, -1)
+    flat_ok = det_cls_ok.reshape(num_classes, -1)
+    cls_order = jnp.argsort(-jnp.where(flat_ok, flat_scores, -jnp.inf), axis=1)  # (C, I*D)
+
+    tp_flat = tp.reshape(len(iou_thresholds), num_classes, -1)  # (T, C, I*D)
+    ok_sorted = jnp.take_along_axis(flat_ok, cls_order, axis=1)  # (C, I*D)
+
+    def ap_cell(tp_c, ok_s, order_c, n):
+        tp_s = tp_c[order_c].astype(jnp.float32)
+        fp_s = (ok_s & ~tp_c[order_c]).astype(jnp.float32)
+        return _interp_ap(tp_s, fp_s, n)
+
+    ap_class = jax.vmap(jax.vmap(ap_cell, in_axes=(0, 0, 0, 0)),
+                        in_axes=(0, None, None, None))(tp_flat, ok_sorted, cls_order, n_gt)
+    # ap_class: (T, C)
+
+    recall_ct = tp.sum(axis=(2, 3)).astype(jnp.float32) / jnp.maximum(n_gt[None, :], 1.0)  # (T, C)
+    recall_ct = jnp.where(n_gt[None, :] > 0, recall_ct, jnp.nan)
+
+    t50 = iou_thresholds.index(0.5) if 0.5 in iou_thresholds else None
+    t75 = iou_thresholds.index(0.75) if 0.75 in iou_thresholds else None
+    out = {
+        "map": jnp.nanmean(ap_class),
+        "map_per_class": jnp.nanmean(ap_class, axis=0),
+        "mar": jnp.nanmean(recall_ct),
+    }
+    out["map_50"] = jnp.nanmean(ap_class[t50]) if t50 is not None else jnp.asarray(jnp.nan)
+    out["map_75"] = jnp.nanmean(ap_class[t75]) if t75 is not None else jnp.asarray(jnp.nan)
+    return out

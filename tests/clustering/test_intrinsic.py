@@ -126,3 +126,11 @@ def test_db_with_capacity_buffer():
         m.update(jnp.asarray(_data[b]), jnp.asarray(_labels[b]))
     want = sk_db(_data[:3].reshape(-1, DIM), _labels[:3].reshape(-1))
     np.testing.assert_allclose(float(m.compute()), want, rtol=1e-4)
+
+
+def test_ch_feature_dim_validation():
+    """Mismatched feature dimension must raise, not silently broadcast
+    (regression: (N, 1) data against num_features=2 returned a wrong score)."""
+    m = CalinskiHarabaszScore(num_clusters=2, num_features=2)
+    with pytest.raises(ValueError, match="num_features=2"):
+        m.update(jnp.zeros((8, 1)), jnp.zeros(8, dtype=jnp.int32))

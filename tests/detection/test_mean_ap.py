@@ -1,0 +1,201 @@
+"""COCO mAP engine vs an independent numpy implementation + hand cases.
+
+The numpy oracle below follows the pycocotools algorithm structure
+(per-image/per-class greedy matching loops, 101-point interpolation) and is
+deliberately written loop-wise — a second, independent derivation of the
+same semantics, since pycocotools itself is not in the image.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.functional.detection.iou import box_iou
+from metrics_tpu.functional.detection.map import COCO_IOU_THRESHOLDS, coco_map_padded
+
+
+def _np_iou(a, b):
+    inter_lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    inter_rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.clip(inter_rb - inter_lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = np.clip(a[:, 2] - a[:, 0], 0, None) * np.clip(a[:, 3] - a[:, 1], 0, None)
+    area_b = np.clip(b[:, 2] - b[:, 0], 0, None) * np.clip(b[:, 3] - b[:, 1], 0, None)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return np.where(union > 0, inter / np.where(union > 0, union, 1), 0.0)
+
+
+def _np_coco_map(images, num_classes, thresholds=COCO_IOU_THRESHOLDS):
+    """images: list of (det_boxes, det_scores, det_labels, gt_boxes, gt_labels)."""
+    aps = np.full((len(thresholds), num_classes), np.nan)
+    recalls = np.full((len(thresholds), num_classes), np.nan)
+    for ci in range(num_classes):
+        n_gt = sum(int((g_lab == ci).sum()) for *_, g_lab in
+                   [(im[3], im[4]) for im in images])
+        n_gt = sum(int((im[4] == ci).sum()) for im in images)
+        for ti, thr in enumerate(thresholds):
+            records = []  # (score, is_tp)
+            for det_boxes, det_scores, det_labels, gt_boxes, gt_labels in images:
+                d_idx = np.where(det_labels == ci)[0]
+                g_idx = np.where(gt_labels == ci)[0]
+                d_idx = d_idx[np.argsort(-det_scores[d_idx], kind="stable")]
+                ious = _np_iou(det_boxes[d_idx], gt_boxes[g_idx]) if len(d_idx) and len(g_idx) \
+                    else np.zeros((len(d_idx), len(g_idx)))
+                used = np.zeros(len(g_idx), dtype=bool)
+                for row, d in enumerate(d_idx):
+                    best, best_iou = -1, float(thr)
+                    for col in range(len(g_idx)):
+                        if used[col] or ious[row, col] < best_iou:
+                            continue
+                        best, best_iou = col, ious[row, col]
+                    if best >= 0:
+                        used[best] = True
+                        records.append((det_scores[d], True))
+                    else:
+                        records.append((det_scores[d], False))
+            if n_gt == 0:
+                continue
+            records.sort(key=lambda r: -r[0])
+            tp = np.cumsum([r[1] for r in records]) if records else np.zeros(0)
+            fp = np.cumsum([not r[1] for r in records]) if records else np.zeros(0)
+            recall = tp / n_gt if len(tp) else np.zeros(0)
+            precision = tp / np.maximum(tp + fp, 1e-30) if len(tp) else np.zeros(0)
+            # envelope + 101-point sampling (pycocotools accumulate())
+            for i in range(len(precision) - 1, 0, -1):
+                precision[i - 1] = max(precision[i - 1], precision[i])
+            q = np.zeros(101)
+            inds = np.searchsorted(recall, np.linspace(0, 1, 101), side="left")
+            for k, pi in enumerate(inds):
+                if pi < len(precision):
+                    q[k] = precision[pi]
+            aps[ti, ci] = q.mean()
+            recalls[ti, ci] = recall[-1] if len(recall) else 0.0
+    return {
+        "map": np.nanmean(aps),
+        "map_50": np.nanmean(aps[thresholds.index(0.5)]),
+        "map_75": np.nanmean(aps[thresholds.index(0.75)]),
+        "mar": np.nanmean(recalls),
+        "map_per_class": np.nanmean(aps, axis=0),
+    }
+
+
+def _pad_images(images, num_classes, d_cap, g_cap):
+    I = len(images)
+    db = np.zeros((I, d_cap, 4), np.float32); ds = np.zeros((I, d_cap), np.float32)
+    dl = np.zeros((I, d_cap), np.int32); dv = np.zeros((I, d_cap), bool)
+    gb = np.zeros((I, g_cap, 4), np.float32); gl = np.zeros((I, g_cap), np.int32)
+    gv = np.zeros((I, g_cap), bool)
+    for i, (dbx, dsc, dlb, gbx, glb) in enumerate(images):
+        nd, ng = len(dsc), len(glb)
+        db[i, :nd] = dbx; ds[i, :nd] = dsc; dl[i, :nd] = dlb; dv[i, :nd] = True
+        gb[i, :ng] = gbx; gl[i, :ng] = glb; gv[i, :ng] = True
+    return (jnp.asarray(db), jnp.asarray(ds), jnp.asarray(dl), jnp.asarray(dv),
+            jnp.asarray(gb), jnp.asarray(gl), jnp.asarray(gv))
+
+
+def _run(images, num_classes, d_cap=12, g_cap=10):
+    args = _pad_images(images, num_classes, d_cap, g_cap)
+    return {k: np.asarray(v) for k, v in
+            coco_map_padded(*args, num_classes=num_classes).items()}
+
+
+def test_perfect_predictions():
+    box = np.array([[0, 0, 10, 10], [20, 20, 30, 30]], np.float32)
+    images = [(box, np.array([0.9, 0.8], np.float32), np.array([0, 1]), box, np.array([0, 1]))]
+    out = _run(images, num_classes=2)
+    assert out["map"] == pytest.approx(1.0)
+    assert out["map_50"] == pytest.approx(1.0)
+    assert out["mar"] == pytest.approx(1.0)
+
+
+def test_iou_threshold_cutoff():
+    """A detection overlapping its GT at IoU=0.62 counts only for thresholds
+    <= 0.6: AP 1.0 at {0.5, 0.55, 0.6}, 0 above -> map = 0.3. (0.62 keeps a
+    safe f32 margin from the 0.60/0.65 threshold boundaries — exact-boundary
+    IoUs are float-sensitive on every backend, as in pycocotools.)"""
+    gt = np.array([[0, 0, 10, 10]], np.float32)
+    det = np.array([[0, 0, 10, 6.2]], np.float32)  # IoU = 0.62
+    images = [(det, np.array([0.9], np.float32), np.array([0]), gt, np.array([0]))]
+    out = _run(images, num_classes=1)
+    assert out["map"] == pytest.approx(0.3, abs=1e-6)
+    assert out["map_50"] == pytest.approx(1.0)
+    assert out["map_75"] == pytest.approx(0.0)
+
+
+def test_high_scoring_false_positive_halves_ap():
+    """FP ranked above the TP: interpolated precision is 0.5 at every recall
+    level -> AP 0.5."""
+    gt = np.array([[0, 0, 10, 10]], np.float32)
+    det = np.array([[50, 50, 60, 60], [0, 0, 10, 10]], np.float32)
+    images = [(det, np.array([0.9, 0.8], np.float32), np.array([0, 0]), gt, np.array([0]))]
+    out = _run(images, num_classes=1)
+    assert out["map"] == pytest.approx(0.5, abs=1e-6)
+
+
+def test_missed_gt_caps_recall():
+    gt = np.array([[0, 0, 10, 10], [20, 20, 30, 30]], np.float32)
+    det = np.array([[0, 0, 10, 10]], np.float32)
+    images = [(det, np.array([0.9], np.float32), np.array([0]), gt, np.array([0, 0]))]
+    out = _run(images, num_classes=1)
+    assert out["mar"] == pytest.approx(0.5)
+    # precision 1 up to recall 0.5, then nothing: 51 of 101 points at 1.0
+    assert out["map"] == pytest.approx(51 / 101, abs=1e-6)
+
+
+def test_double_detection_is_fp():
+    """Second detection of an already-matched GT is a false positive."""
+    gt = np.array([[0, 0, 10, 10]], np.float32)
+    det = np.array([[0, 0, 10, 10], [0, 0, 10, 10]], np.float32)
+    images = [(det, np.array([0.9, 0.8], np.float32), np.array([0, 0]), gt, np.array([0]))]
+    out = _run(images, num_classes=1)
+    assert out["map"] == pytest.approx(1.0)  # TP first; trailing FP doesn't dent the envelope
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_random_vs_numpy_oracle(seed):
+    rng = np.random.RandomState(seed)
+    num_classes, n_images = 3, 6
+    images = []
+    for _ in range(n_images):
+        ng = rng.randint(1, 6)
+        gt = np.sort(rng.rand(ng, 2, 2) * 50, axis=1).reshape(ng, 4).astype(np.float32)
+        gt[:, 2:] += 2.0  # non-degenerate
+        glab = rng.randint(0, num_classes, ng)
+        nd = rng.randint(0, 9)
+        # half jittered copies of gts, half random
+        det, dlab = [], []
+        for j in range(nd):
+            if j < ng and rng.rand() < 0.6:
+                det.append(gt[j] + rng.randn(4) * rng.choice([0.5, 3.0]))
+                dlab.append(glab[j] if rng.rand() < 0.8 else rng.randint(0, num_classes))
+            else:
+                b = np.sort(rng.rand(2, 2) * 50, axis=0).reshape(4); b[2:] += 2
+                det.append(b); dlab.append(rng.randint(0, num_classes))
+        det = np.asarray(det, np.float32).reshape(nd, 4)
+        scores = rng.rand(nd).astype(np.float32)  # distinct w.p. 1
+        images.append((det, scores, np.asarray(dlab, np.int64), gt, glab))
+    got = _run(images, num_classes)
+    want = _np_coco_map(images, num_classes)
+    for key in ("map", "map_50", "map_75", "mar"):
+        np.testing.assert_allclose(got[key], want[key], atol=1e-5, err_msg=key)
+    np.testing.assert_allclose(got["map_per_class"], want["map_per_class"],
+                               atol=1e-5, equal_nan=True)
+
+
+def test_iou_kernels():
+    a = np.array([[0, 0, 2, 2], [1, 1, 4, 4]], np.float32)
+    b = np.array([[1, 1, 3, 3], [5, 5, 6, 6]], np.float32)
+    np.testing.assert_allclose(np.asarray(box_iou(jnp.asarray(a), jnp.asarray(b))),
+                               _np_iou(a, b), atol=1e-6)
+    with pytest.raises(ValueError, match="xyxy"):
+        box_iou(jnp.zeros((3, 3)), jnp.zeros((2, 4)))
+
+
+def test_map_jit():
+    import jax
+
+    gt = np.array([[0, 0, 10, 10]], np.float32)
+    det = np.array([[0, 0, 10, 10]], np.float32)
+    images = [(det, np.array([0.9], np.float32), np.array([0]), gt, np.array([0]))]
+    args = _pad_images(images, 1, 4, 4)
+    out = jax.jit(lambda *a: coco_map_padded(*a, num_classes=1))(*args)
+    assert float(out["map"]) == pytest.approx(1.0)

@@ -1,0 +1,116 @@
+"""Stateful MeanAveragePrecision: streaming, caps, pickling, edge cases."""
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import MeanAveragePrecision
+from tests.detection.test_mean_ap import _np_coco_map
+
+
+def _random_images(rng, n_images, num_classes, max_gt=5, max_det=8):
+    images = []
+    for _ in range(n_images):
+        ng = rng.randint(1, max_gt + 1)
+        gt = np.sort(rng.rand(ng, 2, 2) * 50, axis=1).reshape(ng, 4).astype(np.float32)
+        gt[:, 2:] += 2.0
+        glab = rng.randint(0, num_classes, ng)
+        nd = rng.randint(0, max_det + 1)
+        det, dlab = [], []
+        for j in range(nd):
+            if j < ng and rng.rand() < 0.6:
+                det.append(gt[j] + rng.randn(4) * rng.choice([0.5, 3.0]))
+                dlab.append(glab[j])
+            else:
+                b = np.sort(rng.rand(2, 2) * 50, axis=0).reshape(4)
+                b[2:] += 2
+                det.append(b)
+                dlab.append(rng.randint(0, num_classes))
+        det = np.asarray(det, np.float32).reshape(nd, 4)
+        images.append((det, rng.rand(nd).astype(np.float32), np.asarray(dlab), gt, glab))
+    return images
+
+
+def _feed(metric, images):
+    preds = [{"boxes": jnp.asarray(d), "scores": jnp.asarray(s), "labels": jnp.asarray(l)}
+             for d, s, l, _, _ in images]
+    target = [{"boxes": jnp.asarray(g), "labels": jnp.asarray(gl)}
+              for _, _, _, g, gl in images]
+    metric.update(preds, target)
+
+
+def test_streaming_matches_oracle():
+    rng = np.random.RandomState(7)
+    images = _random_images(rng, 8, num_classes=3)
+    m = MeanAveragePrecision(num_classes=3, max_detections=10, max_gt=6, class_metrics=True)
+    _feed(m, images[:3])  # multiple update calls stream per-image stacks
+    _feed(m, images[3:])
+    got = {k: np.asarray(v) for k, v in m.compute().items()}
+    want = _np_coco_map(images, 3)
+    for key in ("map", "map_50", "map_75", "mar"):
+        np.testing.assert_allclose(got[key], want[key], atol=1e-5, err_msg=key)
+    np.testing.assert_allclose(got["map_per_class"], want["map_per_class"],
+                               atol=1e-5, equal_nan=True)
+
+
+def test_max_detections_truncates_by_score():
+    """Over-cap detections keep the top scores (COCO maxDets)."""
+    gt = np.array([[0, 0, 10, 10]], np.float32)
+    det = np.array([[50, 50, 60, 60], [0, 0, 10, 10]], np.float32)  # FP scored higher
+    m = MeanAveragePrecision(num_classes=1, max_detections=1, max_gt=4)
+    m.update(
+        [{"boxes": jnp.asarray(det), "scores": jnp.asarray([0.9, 0.8]), "labels": jnp.asarray([0, 0])}],
+        [{"boxes": jnp.asarray(gt), "labels": jnp.asarray([0])}],
+    )
+    out = m.compute()
+    # only the (higher-scoring) FP survives the cap -> no TP at all
+    assert float(out["map"]) == pytest.approx(0.0)
+
+
+def test_pickle_and_reset():
+    rng = np.random.RandomState(9)
+    images = _random_images(rng, 4, num_classes=2)
+    m = MeanAveragePrecision(num_classes=2, max_detections=10, max_gt=6)
+    _feed(m, images[:2])
+    m2 = pickle.loads(pickle.dumps(m))
+    _feed(m2, images[2:])
+    want = _np_coco_map(images, 2)
+    np.testing.assert_allclose(float(m2.compute()["map"]), want["map"], atol=1e-5)
+    m2.reset()
+    assert np.isnan(float(m2.compute()["map"]))
+
+
+def test_empty_and_validation():
+    m = MeanAveragePrecision(num_classes=2)
+    assert np.isnan(float(m.compute()["map"]))
+    with pytest.raises(ValueError, match="positive int"):
+        MeanAveragePrecision(num_classes=0)
+    with pytest.raises(ValueError, match="images"):
+        m.update([], [{"boxes": jnp.zeros((0, 4)), "labels": jnp.zeros(0, jnp.int32)}])
+    with pytest.raises(ValueError, match="max_gt"):
+        mm = MeanAveragePrecision(num_classes=1, max_gt=1)
+        mm.update(
+            [{"boxes": jnp.zeros((0, 4)), "scores": jnp.zeros(0), "labels": jnp.zeros(0, jnp.int32)}],
+            [{"boxes": jnp.zeros((2, 4)), "labels": jnp.zeros(2, jnp.int32)}],
+        )
+
+
+def test_image_without_detections_or_gts():
+    """Images with zero dets (missed recall) and zero gts (pure FPs) both count."""
+    gt = np.array([[0, 0, 10, 10]], np.float32)
+    m = MeanAveragePrecision(num_classes=1, max_detections=4, max_gt=4)
+    m.update(
+        [
+            {"boxes": jnp.zeros((0, 4)), "scores": jnp.zeros(0), "labels": jnp.zeros(0, jnp.int32)},
+            {"boxes": jnp.asarray(gt), "scores": jnp.asarray([0.9]), "labels": jnp.asarray([0])},
+        ],
+        [
+            {"boxes": jnp.asarray(gt), "labels": jnp.asarray([0])},
+            {"boxes": jnp.zeros((0, 4)), "labels": jnp.zeros(0, jnp.int32)},
+        ],
+    )
+    out = m.compute()
+    # one GT total; its image had no dets; the other image's det is a FP
+    assert float(out["mar"]) == pytest.approx(0.0)
+    assert float(out["map"]) == pytest.approx(0.0)
