@@ -1,9 +1,20 @@
 """Contingency-matrix clustering metrics (stateful layer).
 
 One shared base streams the ``(num_clusters, num_classes)`` contingency
-count matrix; each subclass applies its closed-form compute. The pair-count
-reductions use float32, exact for counts below 2^24 per cell — far beyond
-any realistic epoch for label data.
+count matrix; each subclass applies its closed-form compute.
+
+Precision: the contingency *cells* are exact below 2^24 per cell, but the
+pair-counting scores (Rand/ARI/Fowlkes-Mallows) compute ``C(n,2)`` of the
+marginals *and of the grand total*, so float32 integer exactness is lost
+once the TOTAL accumulated epoch passes n = 5793 (``n(n-1)/2 > 2^24``),
+after which the ``nij2 - expected`` cancellation accumulates relative noise
+of order ``n^2 / 2^25``. For epochs beyond ~5k total samples, enable
+``jax.config.update("jax_enable_x64", True)`` (the kernels then accumulate
+in float64 automatically), which keeps the pair counts exact to epochs of
+~9e7 samples.
+
+Out-of-range labels (outside ``[0, num_clusters)`` / ``[0, num_classes)``)
+are silently dropped by the one-hot contraction; see ``_contingency``.
 """
 from typing import Any, Callable, Optional
 

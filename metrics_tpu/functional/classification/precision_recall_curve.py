@@ -30,6 +30,13 @@ def _binary_clf_curve(
     """fps/tps/thresholds at each distinct prediction value, descending.
 
     Same contract as the reference (:23-63) / sklearn's ``_binary_clf_curve``.
+
+    Algorithm lineage: this sort+cumsum sweep originates in scikit-learn's
+    ``sklearn.metrics._ranking._binary_clf_curve`` (BSD-3-Clause), which the
+    reference itself adapts; the eager path here deliberately preserves that
+    canonical algorithm (and its error/warning strings) as the exact-parity
+    surface, while ``curve_static.py`` / ``binned_curves.py`` are the original
+    TPU-first formulations used at scale.
     """
     if sample_weights is not None and not isinstance(sample_weights, Array):
         sample_weights = jnp.asarray(sample_weights, dtype=jnp.float32)

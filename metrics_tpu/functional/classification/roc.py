@@ -4,6 +4,11 @@ Parity target: reference ``torchmetrics/functional/classification/roc.py``
 (``_roc_compute`` :35-85 — prepend (0,0), error on all-pos/all-neg, per-class
 sweep incl. multilabel). Eager/epoch-end code (data-dependent output length);
 the jit-safe alternative is the binned family.
+
+Algorithm lineage: the underlying fps/tps sweep is scikit-learn's
+``roc_curve`` formulation (BSD-3-Clause), which the reference adapts; this
+eager path keeps that canonical algorithm as the exact-parity surface, while
+``curve_static.py`` holds the original TPU-first static-shape kernel.
 """
 from typing import List, Optional, Sequence, Tuple, Union
 

@@ -22,7 +22,13 @@ from jax import Array
 
 
 def _contingency(preds: Array, target: Array, num_clusters: int, num_classes: int) -> Array:
-    """(num_clusters, num_classes) pair-count matrix via one-hot matmul."""
+    """(num_clusters, num_classes) pair-count matrix via one-hot matmul.
+
+    Labels outside ``[0, num_clusters)`` / ``[0, num_classes)`` one-hot to the
+    zero vector and are silently dropped from the counts (jit-compatible
+    clipping semantics); validate label ranges on the host if out-of-range
+    values are possible.
+    """
     if preds.ndim != 1 or target.ndim != 1 or preds.shape != target.shape:
         raise ValueError(
             f"Expected 1-D label arrays of identical shape, got {preds.shape} and {target.shape}"
@@ -34,6 +40,10 @@ def _contingency(preds: Array, target: Array, num_clusters: int, num_classes: in
 
 
 def _comb2(x: Array) -> Array:
+    # float32 C(n,2) is exact only to n ~ 5.8k (n(n-1)/2 <= 2^24 holds up to
+    # n = 5793); with x64 enabled the whole pair-count pipeline runs in
+    # float64 and stays exact to n ~ 9e7 (n(n-1) <= 2^53). Applies to the
+    # grand total, not just per-cluster marginals. See clustering/scores.py.
     x = x.astype(jnp.float64) if jax.config.jax_enable_x64 else x.astype(jnp.float32)
     return x * (x - 1.0) / 2.0
 
@@ -109,9 +119,13 @@ def _normalized_mutual_info_compute(cont: Array, average_method: str = "arithmet
         raise ValueError(
             f"average_method must be 'arithmetic', 'geometric', 'min' or 'max', got {average_method!r}"
         )
-    # both clusterings trivial -> NMI defined as 1 (sklearn: 1.0 when MI==0
-    # because both entropies are 0), else 0 when only the norm vanishes
-    return jnp.where(norm > 1e-12, mi / jnp.where(norm > 1e-12, norm, 1.0), 1.0)
+    # sklearn returns 1.0 only when BOTH labelings are trivial (both entropies
+    # 0); if just the normalizer vanishes (min/geometric with exactly one
+    # trivial labeling) the score is 0.0
+    eps = 1e-12
+    both_trivial = (h_pred <= eps) & (h_true <= eps)
+    degenerate = jnp.where(both_trivial, 1.0, 0.0)
+    return jnp.where(norm > eps, mi / jnp.where(norm > eps, norm, 1.0), degenerate)
 
 
 def _fowlkes_mallows_compute(cont: Array) -> Array:

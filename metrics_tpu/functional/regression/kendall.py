@@ -11,6 +11,21 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.prints import rank_zero_warn
+
+# Beyond this length the O(N^2) pairwise contraction costs >10^10 flops and
+# the fused sign-product loops run for seconds-to-minutes; warn rather than
+# silently hang.
+_QUADRATIC_WARN_LEN = 100_000
+
+
+def _warn_if_quadratic(n: int) -> None:
+    if n > _QUADRATIC_WARN_LEN:
+        rank_zero_warn(
+            f"Kendall tau over {n} samples runs an O(N^2) pairwise contraction "
+            f"(~{(n / 1e5) ** 2 * 10:.0f}e9 flops); expect long device times "
+            "beyond ~100k accumulated samples."
+        )
 
 
 def _kendall_kernel(preds: Array, target: Array) -> Array:
@@ -46,4 +61,5 @@ def kendall_rank_corrcoef(preds: Array, target: Array) -> Array:
         raise ValueError("Expected both `preds` and `target` to be 1D arrays of scalar scores")
     if preds.shape[0] < 2:
         return jnp.asarray(jnp.nan)
+    _warn_if_quadratic(preds.shape[0])
     return _kendall_kernel(preds.astype(jnp.float32), target.astype(jnp.float32))

@@ -12,7 +12,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.core.metric import Metric
-from metrics_tpu.functional.regression.kendall import _kendall_kernel
+from metrics_tpu.functional.regression.kendall import _kendall_kernel, _warn_if_quadratic
 from metrics_tpu.parallel.buffer import as_values
 from metrics_tpu.utils.checks import _check_same_shape
 
@@ -21,6 +21,11 @@ _kendall_jitted = jax.jit(_kendall_kernel)
 
 class KendallRankCorrCoef(Metric):
     r"""Accumulated Kendall rank correlation (tau-b, tie-corrected).
+
+    Practical bound: the epoch compute is O(N^2) in the accumulated length,
+    so pair it with ``capacity`` and keep the accumulated epoch below ~100k
+    samples (the functional kernel warns beyond that); 1M rows would be
+    ~10^12 pairwise ops.
 
     Example:
         >>> import jax.numpy as jnp
@@ -61,5 +66,6 @@ class KendallRankCorrCoef(Metric):
         target = as_values(self.target_all)
         if preds.shape[0] < 2:
             return jnp.asarray(jnp.nan)
+        _warn_if_quadratic(preds.shape[0])
         fn = _kendall_jitted if (self._jit is not False and not self._jit_failed) else _kendall_kernel
         return fn(preds, target)

@@ -111,6 +111,23 @@ def test_clustering_degenerate():
     assert float(adjusted_rand_score(jnp.asarray(perfect), jnp.asarray(perfect), 4, 4)) == 1.0
 
 
+@pytest.mark.parametrize("avg", ["arithmetic", "geometric", "min", "max"])
+def test_nmi_one_trivial_labeling(avg):
+    """Exactly one trivial labeling: sklearn gives 0.0 under min/geometric
+    (vanishing normalizer), not the both-trivial 1.0 fallback."""
+    t = _rng.randint(0, 3, 60)
+    one_cluster = np.zeros(60, int)
+    got = float(normalized_mutual_info_score(
+        jnp.asarray(one_cluster), jnp.asarray(t), 1, 3, average_method=avg))
+    want = sk.normalized_mutual_info_score(t, one_cluster, average_method=avg)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+    # both trivial -> 1.0 regardless of average method
+    got_both = float(normalized_mutual_info_score(
+        jnp.asarray(one_cluster), jnp.asarray(one_cluster), 1, 1, average_method=avg))
+    want_both = sk.normalized_mutual_info_score(one_cluster, one_cluster, average_method=avg)
+    np.testing.assert_allclose(got_both, want_both, atol=1e-6)
+
+
 def test_clustering_streaming_equals_one_shot():
     """Batch-streamed contingency equals single-shot on the concatenation."""
     m = MutualInfoScore(**_ARGS)
