@@ -14,7 +14,10 @@ from metrics_tpu.parallel.sharded_epoch import (
     sharded_auroc_matrix,
     sharded_average_precision,
     sharded_average_precision_matrix,
+    sharded_kendall,
+    sharded_rank,
     sharded_retrieval_sums,
+    sharded_spearman,
 )
 from metrics_tpu.parallel.sync import (
     gather_all_arrays,

@@ -31,7 +31,9 @@ from metrics_tpu.parallel.buffer import PaddedBuffer
 from metrics_tpu.parallel.sharded_epoch import (
     sharded_auroc_matrix,
     sharded_average_precision_matrix,
+    sharded_kendall,
     sharded_retrieval_sums,
+    sharded_spearman,
 )
 
 # jitted shard_map launchers shared across config-identical instances
@@ -316,6 +318,51 @@ def _average(scores: Array, support: Array, average: Any) -> Any:
     if average == AverageMethod.WEIGHTED:
         return jnp.sum(scores * support / jnp.sum(support))
     return list(scores)
+
+
+# ----------------------------------------------------------- rank correlation
+def rank_corr_applicable(metric: Any) -> Optional[Tuple[Mesh, str]]:
+    """(mesh, axis) when a rank-correlation metric (Spearman / Kendall)
+    will compute over its row-sharded cat-states, else None."""
+    return _shared_info(metric.preds_all, metric.target_all)
+
+
+def _rank_corr_sharded(metric: Any, kind: str) -> Optional[Array]:
+    """Shared runner: exact ring rank statistics over the sharded epoch.
+
+    Spearman: global midranks via the sorted-pack ring, psum Pearson.
+    Kendall: the O(N^2) pairwise contraction split evenly over the ring.
+    Empty epoch yields ``nan`` (the gather-path convention) without a
+    host-side early exit, so the launcher stays one cached program.
+    """
+    info = rank_corr_applicable(metric)
+    if info is None:
+        return None
+    mesh, axis = info
+    _check_counts(metric, metric.preds_all, metric.target_all)
+    engine = sharded_spearman if kind == "spearman" else sharded_kendall
+
+    def factory():
+        def body(blocks, valid):
+            p, t = blocks
+            return engine(p, t, axis, valid.astype(jnp.float32))
+
+        return body
+
+    key = (type(metric), kind)
+    return _launch(
+        key, mesh, axis, (metric.preds_all.data, metric.target_all.data), metric.preds_all.count, factory
+    )
+
+
+def spearman_sharded(metric: Any) -> Optional[Array]:
+    """Sharded-state ``SpearmanCorrcoef.compute()``; ``None`` -> gather path."""
+    return _rank_corr_sharded(metric, "spearman")
+
+
+def kendall_sharded(metric: Any) -> Optional[Array]:
+    """Sharded-state ``KendallRankCorrCoef.compute()``; ``None`` -> gather path."""
+    return _rank_corr_sharded(metric, "kendall")
 
 
 # ---------------------------------------------------------------- retrieval

@@ -47,6 +47,20 @@ from jax import Array
 PAD_QUERY_ID = jnp.iinfo(jnp.int32).max
 
 
+def _ensure_varying(x: Array, axis_name: str) -> Array:
+    """Mark ``x`` varying over ``axis_name`` if it isn't already.
+
+    Constants built inside a ``shard_map`` body (None-weight fallbacks,
+    all-zero targets) are invariant-typed; feeding them into a ``ppermute``
+    ring makes the loop carry's manual-axes type flip mid-loop. ``pvary``
+    itself rejects already-varying input, hence the check.
+    """
+    vma = getattr(jax.typeof(x), "vma", frozenset())
+    if axis_name in vma:
+        return x
+    return jax.lax.pvary(x, (axis_name,))
+
+
 class _SortedPack(NamedTuple):
     """One shard's sorted scores + cumulative class weights (the ring payload)."""
 
@@ -180,6 +194,140 @@ def sharded_average_precision(
     """
     w = None if sample_weights is None else sample_weights[:, None]
     return sharded_average_precision_matrix(preds[:, None], target[:, None], axis_name, w)[0]
+
+
+def sharded_rank(
+    scores: Array, axis_name: str, sample_weights: Optional[Array] = None
+) -> Array:
+    """Global 1-based midranks (ties → average rank, scipy ``rankdata``
+    semantics) of epoch rows sharded along ``axis_name``.
+
+    Rank of a row = global weight strictly below its score plus half the
+    global tied weight (self included) plus one half — for unit weights this
+    is exactly ``below + (ties + 1) / 2``. ``sample_weights`` is a 0/1
+    validity mask (ghost capacity rows get garbage ranks and must be masked
+    by the caller); the same sorted-pack ring as AUROC, one extra use.
+    """
+    w = jnp.ones_like(scores, jnp.float32) if sample_weights is None else sample_weights
+    w = _ensure_varying(w, axis_name)
+    y = _ensure_varying(jnp.zeros_like(scores, jnp.float32), axis_name)
+    below, tie, _, _ = _ring_stats_cols(scores[None, :], y[None, :], w[None, :], axis_name)
+    return below[0] + (tie[0] + 1.0) / 2.0
+
+
+def sharded_spearman(
+    preds: Array, target: Array, axis_name: str, sample_weights: Optional[Array] = None
+) -> Array:
+    """Exact Spearman rho over epoch rows sharded along ``axis_name``.
+
+    Global midranks of both arrays via the sorted-pack ring, then one
+    psum-reduced Pearson over the ranks — matches
+    ``scipy.stats.spearmanr`` (Pearson of midranks, tie-corrected) on the
+    concatenated epoch, cross-shard ties included. ``sample_weights`` is a
+    0/1 validity mask. ``nan`` on zero rank variance (constant input) or an
+    empty epoch, the scipy convention.
+    """
+    w = jnp.ones_like(preds, jnp.float32) if sample_weights is None else sample_weights
+    w = _ensure_varying(w, axis_name)
+    # one stacked (2, m) ring for both arrays: a single ppermute payload per
+    # hop instead of two back-to-back rings (ring latency dominates at scale)
+    stacked = jnp.stack([preds.astype(jnp.float32), target.astype(jnp.float32)])
+    y2 = _ensure_varying(jnp.zeros_like(stacked), axis_name)
+    w2 = jnp.broadcast_to(w, stacked.shape)
+    below, tie, _, _ = _ring_stats_cols(stacked, y2, w2, axis_name)
+    ranks = below + (tie + 1.0) / 2.0
+    rx, ry = ranks[0], ranks[1]
+    total = jax.lax.psum(jnp.sum(w), axis_name)
+    # scale ranks to O(1) before the moment sums: correlation is affine-
+    # invariant and raw ranks would push f32 accumulations to O(N^3)
+    scale = 1.0 / jnp.maximum(total, 1.0)
+    rx, ry = rx * scale, ry * scale
+    sx = jax.lax.psum(jnp.sum(w * rx), axis_name)
+    sy = jax.lax.psum(jnp.sum(w * ry), axis_name)
+    sxx = jax.lax.psum(jnp.sum(w * rx * rx), axis_name)
+    syy = jax.lax.psum(jnp.sum(w * ry * ry), axis_name)
+    sxy = jax.lax.psum(jnp.sum(w * rx * ry), axis_name)
+    cov = total * sxy - sx * sy
+    var_x = total * sxx - sx * sx
+    var_y = total * syy - sy * sy
+    denom = jnp.sqrt(jnp.maximum(var_x, 0.0) * jnp.maximum(var_y, 0.0))
+    bad = (denom == 0) | (total == 0)
+    return jnp.where(bad, jnp.nan, cov / jnp.where(bad, 1.0, denom))
+
+
+def sharded_kendall(
+    preds: Array,
+    target: Array,
+    axis_name: str,
+    sample_weights: Optional[Array] = None,
+    chunk: int = 1024,
+) -> Array:
+    """Exact global Kendall tau-b over epoch rows sharded along ``axis_name``.
+
+    The O(N^2) pairwise sign contraction distributed ring-attention style:
+    raw ``(x, y, w)`` rows circulate over the mesh axis; at each hop every
+    device contracts its local queries against the visiting shard in
+    ``chunk``-row blocks (peak intermediate ``chunk x m``, never m x N).
+    Per-device compute is O(N^2 / n) — the quadratic total cost split evenly.
+    Matches ``scipy.stats.kendalltau`` (tau-b, tie-corrected) on the
+    concatenated epoch. ``sample_weights`` is a 0/1 validity mask. ``nan``
+    when either array is globally constant or the epoch is empty.
+    """
+    n = jax.lax.axis_size(axis_name)
+    m = preds.shape[0]
+    x = preds.astype(jnp.float32)
+    y = target.astype(jnp.float32)
+    w = jnp.ones((m,), jnp.float32) if sample_weights is None else sample_weights.astype(jnp.float32)
+    w = _ensure_varying(w, axis_name)
+
+    chunk = min(chunk, m)
+    n_chunks = -(-m // chunk)
+    padded = n_chunks * chunk
+    # pad queries to a chunk multiple so blocks are disjoint (ghost queries
+    # compute garbage sums that the w-mask drops at the end)
+    xq = jnp.pad(x, (0, padded - m))
+    yq = jnp.pad(y, (0, padded - m))
+
+    def contract(visiting, acc):
+        xv, yv, wv = visiting
+
+        def block(c, acc):
+            s, tx, ty = acc
+            start = c * chunk
+            xc = jax.lax.dynamic_slice(xq, (start,), (chunk,))
+            yc = jax.lax.dynamic_slice(yq, (start,), (chunk,))
+            dx = jnp.sign(xc[:, None] - xv[None, :])
+            dy = jnp.sign(yc[:, None] - yv[None, :])
+            s_b = jnp.sum(dx * dy * wv, axis=-1)
+            tx_b = jnp.sum((dx == 0) * wv, axis=-1)
+            ty_b = jnp.sum((dy == 0) * wv, axis=-1)
+            upd = lambda a, b: jax.lax.dynamic_update_slice(a, jax.lax.dynamic_slice(a, (start,), (chunk,)) + b, (start,))
+            return upd(s, s_b), upd(tx, tx_b), upd(ty, ty_b)
+
+        return jax.lax.fori_loop(0, n_chunks, block, acc)
+
+    zeros = jnp.zeros_like(xq)  # derived from the shard: varying-axis typed
+    acc = contract((x, y, w), (zeros, zeros, zeros))
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def hop(_, carry):
+        acc, visiting = carry
+        visiting = jax.lax.ppermute(visiting, axis_name, perm)
+        return contract(visiting, acc), visiting
+
+    (s_all, tx_all, ty_all), _ = jax.lax.fori_loop(0, n - 1, hop, (acc, (x, y, w)))
+    s_all, tx_all, ty_all = s_all[:m], tx_all[:m], ty_all[:m]
+
+    s = jax.lax.psum(jnp.sum(w * s_all), axis_name) / 2.0
+    t_x = jax.lax.psum(jnp.sum(w * tx_all), axis_name)
+    t_y = jax.lax.psum(jnp.sum(w * ty_all), axis_name)
+    w_tot = jax.lax.psum(jnp.sum(w), axis_name)
+    w_sq = jax.lax.psum(jnp.sum(w * w), axis_name)
+    n1 = (t_x - w_sq) / 2.0  # pairs tied in x (diagonal removed)
+    n2 = (t_y - w_sq) / 2.0
+    n0 = (w_tot * w_tot - w_sq) / 2.0
+    denom = jnp.sqrt(jnp.maximum(n0 - n1, 0.0) * jnp.maximum(n0 - n2, 0.0))
+    return jnp.where(denom > 0, s / jnp.where(denom > 0, denom, 1.0), jnp.nan)
 
 
 def regroup_by_query(

@@ -4,6 +4,12 @@ Ranks are global over the accumulated data, so the metric keeps cat-states
 (bounded via ``capacity``), like [[SpearmanCorrcoef]]; the epoch compute is
 the O(N^2) pairwise sign contraction in one jitted device program (see
 ``functional/regression/kendall.py``).
+
+At pod scale, place the states with
+``metrics_tpu.parallel.row_sharded(mesh)`` — ``compute()`` then runs the
+same contraction ring-attention style (``sharded_epoch.py::sharded_kendall``)
+with the quadratic cost split evenly across devices and O(capacity / n)
+per-device memory.
 """
 from typing import Any, Callable, Optional
 
@@ -61,7 +67,17 @@ class KendallRankCorrCoef(Metric):
         self._append("preds_all", jnp.asarray(preds, dtype=jnp.float32))
         self._append("target_all", jnp.asarray(target, dtype=jnp.float32))
 
+    def _states_own_sync(self) -> bool:
+        from metrics_tpu.parallel.sharded_dispatch import rank_corr_applicable
+
+        return rank_corr_applicable(self) is not None
+
     def compute(self) -> Array:
+        from metrics_tpu.parallel.sharded_dispatch import kendall_sharded
+
+        sharded = kendall_sharded(self)  # row-sharded epoch states: split O(N^2) ring
+        if sharded is not None:
+            return sharded
         preds = as_values(self.preds_all)
         target = as_values(self.target_all)
         if preds.shape[0] < 2:

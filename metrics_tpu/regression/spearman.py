@@ -3,6 +3,12 @@
 Ranks are global over the accumulated data, so the metric keeps cat-states
 (bounded via ``capacity``); the epoch compute (ranking + correlation) runs as
 one jitted device program shared across instances.
+
+At pod scale, keep the epoch sharded instead of gathered: construct with
+``capacity`` and place with ``metrics_tpu.parallel.row_sharded(mesh)`` —
+``compute()`` then dispatches the exact sorted-pack ring
+(``parallel/sharded_epoch.py::sharded_spearman``) with O(capacity / n)
+per-device memory and no epoch materialization.
 """
 from typing import Any, Callable, Optional
 
@@ -53,7 +59,17 @@ class SpearmanCorrcoef(Metric):
         self._append("preds_all", jnp.asarray(preds, dtype=jnp.float32))
         self._append("target_all", jnp.asarray(target, dtype=jnp.float32))
 
+    def _states_own_sync(self) -> bool:
+        from metrics_tpu.parallel.sharded_dispatch import rank_corr_applicable
+
+        return rank_corr_applicable(self) is not None
+
     def compute(self) -> Array:
+        from metrics_tpu.parallel.sharded_dispatch import spearman_sharded
+
+        sharded = spearman_sharded(self)  # row-sharded epoch states: exact ring
+        if sharded is not None:
+            return sharded
         preds = as_values(self.preds_all)
         target = as_values(self.target_all)
         if preds.shape[0] == 0:

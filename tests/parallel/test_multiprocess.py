@@ -236,11 +236,33 @@ for start in (0, half):
 assert metric.preds.data.sharding.spec[0] == "dp"
 stateful_auroc = float(metric.compute())
 
+# ---- rank correlation through the same front door: row-sharded cat states,
+# compute() dispatches the rank-statistics ring (Spearman) and the split
+# O(N^2) contraction ring (Kendall) across the process boundary
+from metrics_tpu import KendallRankCorrCoef, SpearmanCorrcoef
+
+targets = np.round(scores + 0.3 * rng.randn(N), 1).astype(np.float32)
+rank_corr = {}
+for name, cls in (("spearman", SpearmanCorrcoef), ("kendall", KendallRankCorrCoef)):
+    m = cls(capacity=N)
+    m.device_put(row_sharded(mesh, "dp"))
+    for start in (0, half):
+        bp = jax.make_array_from_process_local_data(replicated, scores[start:start + half], (half,))
+        bt = jax.make_array_from_process_local_data(replicated, targets[start:start + half], (half,))
+        m.update(bp, bt)
+    assert m.preds_all.data.sharding.spec[0] == "dp"
+    rank_corr[name] = float(m.compute())
+
+import scipy.stats as st
 from sklearn.metrics import roc_auc_score
 
 want = float(roc_auc_score(labels, scores))
 print("RESULT " + json.dumps({
     "rank": rank, "ring": ring_auroc, "stateful": stateful_auroc, "want": want,
+    "spearman": rank_corr["spearman"],
+    "want_spearman": float(st.spearmanr(scores, targets).statistic),
+    "kendall": rank_corr["kendall"],
+    "want_kendall": float(st.kendalltau(scores, targets).statistic),
 }), flush=True)
 """
 
@@ -253,6 +275,9 @@ def test_two_process_sharded_epoch_ring(tmp_path):
     for rank, r in results.items():
         assert abs(r["ring"] - r["want"]) < 1e-6, r
         assert abs(r["stateful"] - r["want"]) < 1e-6, r
+        # rank-correlation rings across the same process boundary
+        assert abs(r["spearman"] - r["want_spearman"]) < 1e-5, r
+        assert abs(r["kendall"] - r["want_kendall"]) < 1e-5, r
 
 
 def _run_workers(tmp_path, source, port):
