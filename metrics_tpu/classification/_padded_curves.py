@@ -30,8 +30,11 @@ def padded_curve_compute(metric: Any, kind: str) -> Optional[tuple]:
     ``None`` -> caller keeps the reference-shaped dynamic path."""
     if not isinstance(metric.preds, PaddedBuffer):
         return None
-    from metrics_tpu.parallel.sharded_dispatch import _check_counts
+    from metrics_tpu.parallel.sharded_dispatch import _check_counts, curve_sharded
 
+    sharded = curve_sharded(metric, kind)  # row-sharded states: ring + key-sort
+    if sharded is not None:
+        return sharded
     _check_counts(metric, metric.preds, metric.target)
 
     fn = _JITTED.get(kind)

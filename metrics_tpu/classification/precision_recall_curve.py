@@ -82,6 +82,11 @@ class PrecisionRecallCurve(Metric):
         self.num_classes = num_classes
         self.pos_label = pos_label
 
+    def _states_own_sync(self) -> bool:
+        from metrics_tpu.parallel.sharded_dispatch import curve_applicable
+
+        return curve_applicable(self) is not None
+
     def compute(
         self,
     ) -> Union[

@@ -157,6 +157,20 @@ def binary_clf_curve_padded(
     )
 
 
+def roc_from_clf_curve(
+    fps: Array, tps: Array, thresholds: Array, count: Array
+) -> Tuple[Array, Array, Array, Array]:
+    """ROC transform of a compacted padded clf-curve (1-D inputs; vmap for a
+    class axis). Shared by the local kernel and the sharded-epoch engine —
+    the clf-curve tuple is the layout-independent meeting point."""
+    pos = tps[-1]
+    neg = fps[-1]
+    tpr = jnp.concatenate([jnp.zeros((1,)), tps]) / jnp.where(pos == 0, jnp.nan, pos)
+    fpr = jnp.concatenate([jnp.zeros((1,)), fps]) / jnp.where(neg == 0, jnp.nan, neg)
+    thresholds = jnp.concatenate([thresholds[:1] + 1, thresholds])
+    return fpr, tpr, thresholds, count + 1
+
+
 def binary_roc_padded(
     preds: Array,
     target: Array,
@@ -172,15 +186,31 @@ def binary_roc_padded(
     tail repeats (1, 1). Degenerate targets yield ``nan`` rates instead of
     raising (value checks cannot run under jit).
     """
-    fps, tps, thresholds, count = binary_clf_curve_padded(
-        preds, target, sample_weights, pos_label, row_mask
+    return roc_from_clf_curve(
+        *binary_clf_curve_padded(preds, target, sample_weights, pos_label, row_mask)
     )
-    pos = tps[-1]
-    neg = fps[-1]
-    tpr = jnp.concatenate([jnp.zeros((1,)), tps]) / jnp.where(pos == 0, jnp.nan, pos)
-    fpr = jnp.concatenate([jnp.zeros((1,)), fps]) / jnp.where(neg == 0, jnp.nan, neg)
-    thresholds = jnp.concatenate([thresholds[:1] + 1, thresholds])
-    return fpr, tpr, thresholds, count + 1
+
+
+def precision_recall_from_clf_curve(
+    fps: Array, tps: Array, th_fw: Array, n_distinct: Array
+) -> Tuple[Array, Array, Array, Array]:
+    """PR transform of a compacted padded clf-curve (1-D inputs; vmap for a
+    class axis). Shared by the local kernel and the sharded-epoch engine."""
+    total = tps[-1]
+    precision_fw = tps / jnp.maximum(tps + fps, 1e-38)
+    recall_fw = tps / jnp.where(total == 0, jnp.nan, total)
+
+    # stop once full recall is attained (first index reaching the total)
+    last_ind = jnp.argmax(tps >= total)
+    n_th = jnp.minimum(last_ind + 1, n_distinct).astype(jnp.int32)
+
+    n = tps.shape[0]
+    j = n_th - 1 - jnp.arange(n + 1)  # reversal; j < 0 -> appended endpoint/pad
+    jc = jnp.clip(j, 0, n - 1)
+    precision = jnp.where(j >= 0, precision_fw[jc], 1.0)
+    recall = jnp.where(j >= 0, recall_fw[jc], 0.0)
+    thresholds = th_fw[jnp.clip(n_th - 1 - jnp.arange(n), 0, n - 1)]
+    return precision, recall, thresholds, n_th
 
 
 def binary_precision_recall_curve_padded(
@@ -200,24 +230,9 @@ def binary_precision_recall_curve_padded(
     hold ``count + 1`` valid points, ``thresholds`` (length N) holds
     ``count``; tails repeat the final entries.
     """
-    fps, tps, th_fw, n_distinct = binary_clf_curve_padded(
-        preds, target, sample_weights, pos_label, row_mask
+    return precision_recall_from_clf_curve(
+        *binary_clf_curve_padded(preds, target, sample_weights, pos_label, row_mask)
     )
-    total = tps[-1]
-    precision_fw = tps / jnp.maximum(tps + fps, 1e-38)
-    recall_fw = tps / jnp.where(total == 0, jnp.nan, total)
-
-    # stop once full recall is attained (first index reaching the total)
-    last_ind = jnp.argmax(tps >= total)
-    n_th = jnp.minimum(last_ind + 1, n_distinct).astype(jnp.int32)
-
-    n = tps.shape[0]
-    j = n_th - 1 - jnp.arange(n + 1)  # reversal; j < 0 -> appended endpoint/pad
-    jc = jnp.clip(j, 0, n - 1)
-    precision = jnp.where(j >= 0, precision_fw[jc], 1.0)
-    recall = jnp.where(j >= 0, recall_fw[jc], 0.0)
-    thresholds = th_fw[jnp.clip(n_th - 1 - jnp.arange(n), 0, n - 1)]
-    return precision, recall, thresholds, n_th
 
 
 def _per_class_padded(kernel, preds, target, sample_weights=None, row_mask=None):

@@ -14,6 +14,7 @@ from metrics_tpu.parallel.sharded_epoch import (
     sharded_auroc_matrix,
     sharded_average_precision,
     sharded_average_precision_matrix,
+    sharded_clf_curve_matrix,
     sharded_kendall,
     sharded_rank,
     sharded_retrieval_sums,

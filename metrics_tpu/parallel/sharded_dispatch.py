@@ -31,6 +31,7 @@ from metrics_tpu.parallel.buffer import PaddedBuffer
 from metrics_tpu.parallel.sharded_epoch import (
     sharded_auroc_matrix,
     sharded_average_precision_matrix,
+    sharded_clf_curve_matrix,
     sharded_kendall,
     sharded_retrieval_sums,
     sharded_spearman,
@@ -105,6 +106,7 @@ def _launch(
     count: Array,
     body_factory: Callable[[], Callable],
     out_specs: Any = P(),
+    check_vma: bool = True,
 ):
     """Run ``body(local_blocks, valid_mask) -> outputs`` as ONE jitted
     ``shard_map`` over the row-sharded epoch states.
@@ -130,7 +132,11 @@ def _launch(
             return body(blocks, rows < cnt)
 
         in_specs = (P(),) + tuple(P(axis, *([None] * (d.ndim - 1))) for d in datas)
-        fn = jax.jit(jax.shard_map(shard_fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs))
+        fn = jax.jit(
+            jax.shard_map(
+                shard_fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
+            )
+        )
         from metrics_tpu.core.metric import _bounded_insert
 
         _bounded_insert(_LAUNCH_CACHE, full_key, fn, _LAUNCH_CACHE_MAX)
@@ -320,6 +326,75 @@ def _average(scores: Array, support: Array, average: Any) -> Any:
     return list(scores)
 
 
+# ------------------------------------------------------------- curve vectors
+def curve_applicable(metric: Any) -> Optional[Tuple[Mesh, str]]:
+    """(mesh, axis) when ``ROC`` / ``PrecisionRecallCurve`` compute their
+    padded curve VECTORS over row-sharded states, else None."""
+    return _shared_info(metric.preds, metric.target)
+
+
+def curve_sharded(metric: Any, kind: str) -> Optional[tuple]:
+    """Sharded-state curve-vector compute for ``ROC`` (``kind='roc'``) /
+    ``PrecisionRecallCurve`` (``kind='prc'``); ``None`` -> padded gather path.
+
+    Same output contract as ``padded_curve_compute``: capacity-length
+    compacted curves + valid counts (class axis for 2-D preds), REPLICATED —
+    the counting runs distributed (ring + key-sort of finished points); only
+    the O(N) finished curve is assembled per device, which a replicated
+    curve output costs by definition.
+    """
+    from metrics_tpu.functional.classification.curve_static import (
+        precision_recall_from_clf_curve,
+        roc_from_clf_curve,
+    )
+
+    info = curve_applicable(metric)
+    if info is None:
+        return None
+    mesh, axis = info
+    _check_counts(metric, metric.preds, metric.target)
+
+    pos_label = metric.pos_label if metric.pos_label is not None else 1
+    p_data, t_data = metric.preds.data, metric.target.data
+    multilabel = p_data.ndim == 2 and t_data.ndim == 2
+    num_classes = p_data.shape[1] if p_data.ndim == 2 else 1
+    transform = roc_from_clf_curve if kind == "roc" else precision_recall_from_clf_curve
+
+    def factory():
+        def body(blocks, valid):
+            p, t = blocks
+            w = valid.astype(jnp.float32)
+            if p.ndim == 1:
+                p_cm = p[None, :]
+                y_cm = (t == pos_label).astype(jnp.float32)[None, :]
+                w_cm = w[None, :]
+            elif multilabel:
+                p_cm = p.T
+                y_cm = (t == 1).T.astype(jnp.float32)
+                w_cm = jnp.broadcast_to(w[:, None], p.shape).T
+            else:  # multiclass one-vs-rest against the label column
+                p_cm = p.T
+                y_cm = (t[None, :] == jnp.arange(num_classes)[:, None]).astype(jnp.float32)
+                w_cm = jnp.broadcast_to(w[:, None], p.shape).T
+            clf = sharded_clf_curve_matrix(p_cm, y_cm, w_cm, axis)
+            out = jax.vmap(transform)(*clf)
+            if p.ndim == 1:
+                return tuple(o[0] for o in out)
+            return out
+
+        return body
+
+    key = (type(metric), f"curve-{kind}", pos_label, num_classes, multilabel)
+    # check_vma off: the curve outputs come from all_gather + a deterministic
+    # sort/compact, so every device holds the identical replicated value, but
+    # the varying-axis type system cannot demote gathered (varying) values to
+    # invariant — there is no varying->invariant pcast
+    return _launch(
+        key, mesh, axis, (p_data, t_data), metric.preds.count, factory,
+        out_specs=(P(), P(), P(), P()), check_vma=False,
+    )
+
+
 # ----------------------------------------------------------- rank correlation
 def rank_corr_applicable(metric: Any) -> Optional[Tuple[Mesh, str]]:
     """(mesh, axis) when a rank-correlation metric (Spearman / Kendall)
@@ -339,7 +414,13 @@ def _rank_corr_sharded(metric: Any, kind: str) -> Optional[Array]:
     if info is None:
         return None
     mesh, axis = info
-    _check_counts(metric, metric.preds_all, metric.target_all)
+    count = _check_counts(metric, metric.preds_all, metric.target_all)
+    if kind == "kendall":
+        # the ring splits the O(N^2) contraction n ways but total work stays
+        # quadratic — same loud warning as the gather path
+        from metrics_tpu.functional.regression.kendall import _warn_if_quadratic
+
+        _warn_if_quadratic(count)
     engine = sharded_spearman if kind == "spearman" else sharded_kendall
 
     def factory():

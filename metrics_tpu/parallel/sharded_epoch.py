@@ -58,7 +58,7 @@ def _ensure_varying(x: Array, axis_name: str) -> Array:
     vma = getattr(jax.typeof(x), "vma", frozenset())
     if axis_name in vma:
         return x
-    return jax.lax.pvary(x, (axis_name,))
+    return jax.lax.pcast(x, (axis_name,), to="varying")
 
 
 class _SortedPack(NamedTuple):
@@ -196,6 +196,54 @@ def sharded_average_precision(
     return sharded_average_precision_matrix(preds[:, None], target[:, None], axis_name, w)[0]
 
 
+def sharded_clf_curve_matrix(
+    preds_cm: Array, target_cm: Array, weights_cm: Array, axis_name: str
+) -> Tuple[Array, Array, Array, Array]:
+    """Replicated compacted global clf-curves from ``(C, m)`` column-major
+    sharded epoch rows — the distributed route to curve VECTORS.
+
+    The counting stays sharded: the ring computes, for every LOCAL row, the
+    GLOBAL positive/negative weight at-or-above its score (tie-run-end
+    semantics built in — every member of a cross-shard tie sees the full
+    tied weight). Only the finished per-row curve points ``(score, tps,
+    fps)`` are then ``all_gather``-ed and key-sorted — O(N) per device for
+    the OUTPUT itself, which any replicated capacity-length curve costs by
+    definition; the epoch never materializes for counting, and per-device
+    transient compute stays O((N/n)·log + N·log N) with the heavy
+    ``searchsorted`` accumulation distributed.
+
+    Targets are already 0/1 per class; zero weight marks ghost rows (they
+    sort last at ``-inf`` and are never run-final — real rows must not
+    score ``-inf``, the ``curve_static`` contract). Returns
+    ``(fps, tps, thresholds, counts)``: ``(C, N)`` replicated arrays with
+    each class's distinct-threshold points (descending score) compacted to
+    the front, tails repeating the final point, plus ``(C,)`` counts —
+    exactly the ``binary_clf_curve_padded`` contract, per class.
+    """
+    from metrics_tpu.functional.classification.curve_static import _compact
+
+    w = _ensure_varying(weights_cm, axis_name)
+    p = jnp.where(w > 0, preds_cm, -jnp.inf)
+    _, _, wp_ge, wn_ge = _ring_stats_cols(p, target_cm, w, axis_name)
+
+    gather = partial(jax.lax.all_gather, axis_name=axis_name, axis=1, tiled=True)
+    neg_s, tps, fps, wv = jax.lax.sort(
+        (gather(-p), gather(wp_ge), gather(wn_ge), gather(w)), num_keys=1
+    )
+    scores = -neg_s
+    run_end = jnp.concatenate(
+        [scores[:, 1:] != scores[:, :-1], jnp.ones((scores.shape[0], 1), bool)], axis=1
+    ) & (wv > 0)
+    counts = jnp.sum(run_end.astype(jnp.int32), axis=1)
+    compact = jax.vmap(_compact)
+    return (
+        compact(fps, run_end, counts),
+        compact(tps, run_end, counts),
+        compact(scores, run_end, counts),
+        counts,
+    )
+
+
 def sharded_rank(
     scores: Array, axis_name: str, sample_weights: Optional[Array] = None
 ) -> Array:
@@ -212,7 +260,13 @@ def sharded_rank(
     w = _ensure_varying(w, axis_name)
     y = _ensure_varying(jnp.zeros_like(scores, jnp.float32), axis_name)
     below, tie, _, _ = _ring_stats_cols(scores[None, :], y[None, :], w[None, :], axis_name)
-    return below[0] + (tie[0] + 1.0) / 2.0
+    return _midrank(below[0], tie[0])
+
+
+def _midrank(below: Array, tie: Array) -> Array:
+    """1-based average-of-ties rank from (weight strictly below, tied weight
+    incl. self) — shared by ``sharded_rank`` and the stacked Spearman ring."""
+    return below + (tie + 1.0) / 2.0
 
 
 def sharded_spearman(
@@ -235,7 +289,7 @@ def sharded_spearman(
     y2 = _ensure_varying(jnp.zeros_like(stacked), axis_name)
     w2 = jnp.broadcast_to(w, stacked.shape)
     below, tie, _, _ = _ring_stats_cols(stacked, y2, w2, axis_name)
-    ranks = below + (tie + 1.0) / 2.0
+    ranks = _midrank(below, tie)
     rx, ry = ranks[0], ranks[1]
     total = jax.lax.psum(jnp.sum(w), axis_name)
     # scale ranks to O(1) before the moment sums: correlation is affine-

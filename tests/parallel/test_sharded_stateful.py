@@ -20,7 +20,14 @@ from sklearn.metrics import average_precision_score as sk_ap
 from sklearn.metrics import roc_auc_score as sk_auroc
 
 import metrics_tpu.parallel.buffer as buffer_mod
-from metrics_tpu import AUROC, AveragePrecision, KendallRankCorrCoef, SpearmanCorrcoef
+from metrics_tpu import (
+    AUROC,
+    ROC,
+    AveragePrecision,
+    KendallRankCorrCoef,
+    PrecisionRecallCurve,
+    SpearmanCorrcoef,
+)
 from metrics_tpu.parallel import row_sharded
 from metrics_tpu.retrieval import RetrievalMAP, RetrievalMRR
 
@@ -179,6 +186,121 @@ def test_stateful_sharded_retrieval_policies(mesh, monkeypatch):
         with no_materialization(monkeypatch):
             got = float(metric.compute())
         np.testing.assert_allclose(got, float(oracle.compute()), atol=1e-6, err_msg=policy)
+
+
+def test_stateful_sharded_roc_curve(mesh, monkeypatch):
+    """Row-sharded ROC returns capacity-length curve VECTORS sklearn-exact
+    (distinct points compacted to the front), gather path poisoned."""
+    from sklearn.metrics import roc_curve as sk_roc
+
+    rng = np.random.RandomState(61)
+    metric = ROC(pos_label=1, capacity=1024)
+    metric.device_put(row_sharded(mesh, "dp"))
+    all_p, all_t = [], []
+    for p, t in _batches(rng, steps=6, batch=96):
+        all_p.append(p)
+        all_t.append(t)
+        metric.update(jnp.asarray(p), jnp.asarray(t))
+    with no_materialization(monkeypatch):
+        fpr, tpr, th, count = metric.compute()
+    c = int(count)
+    sk_fpr, sk_tpr, sk_th = sk_roc(
+        np.concatenate(all_t), np.concatenate(all_p), drop_intermediate=False
+    )
+    np.testing.assert_allclose(np.asarray(fpr)[:c], sk_fpr, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(tpr)[:c], sk_tpr, atol=1e-6)
+    # first threshold: reference convention max+1 where sklearn uses inf
+    np.testing.assert_allclose(np.asarray(th)[1:c], sk_th[1:], atol=1e-6)
+    np.testing.assert_allclose(float(th[0]), sk_th[1] + 1, atol=1e-6)
+    # tail repeats the final point: integrals over the padded arrays agree
+    np.testing.assert_allclose(
+        np.trapezoid(np.asarray(tpr), np.asarray(fpr)),
+        np.trapezoid(sk_tpr, sk_fpr), atol=1e-6)
+
+
+def test_stateful_sharded_prc_curve(mesh, monkeypatch):
+    """Row-sharded PrecisionRecallCurve: sklearn-exact padded curve vectors."""
+    from sklearn.metrics import precision_recall_curve as sk_prc
+
+    rng = np.random.RandomState(67)
+    metric = PrecisionRecallCurve(pos_label=1, capacity=512)
+    metric.device_put(row_sharded(mesh, "dp"))
+    p = np.round(rng.rand(384), 1).astype(np.float32)
+    t = (rng.rand(384) > 0.5).astype(np.int32)
+    metric.update(jnp.asarray(p), jnp.asarray(t))
+    with no_materialization(monkeypatch):
+        precision, recall, th, count = metric.compute()
+    c = int(count)
+    sk_p, sk_r, sk_t = sk_prc(t, p)
+    # reference orientation == sklearn's: increasing threshold, (1, 0) end
+    np.testing.assert_allclose(np.asarray(precision)[:c + 1], sk_p, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(recall)[:c + 1], sk_r, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(th)[:c], sk_t, atol=1e-6)
+
+
+def test_stateful_sharded_multiclass_roc_curves(mesh, monkeypatch):
+    """Per-class sharded ROC curves (leading class axis, per-class counts)."""
+    from sklearn.metrics import roc_curve as sk_roc
+
+    rng = np.random.RandomState(71)
+    num_classes = 4
+    metric = ROC(num_classes=num_classes, capacity=512)
+    metric.device_put(row_sharded(mesh, "dp"))
+    logits = rng.rand(256, num_classes).astype(np.float32)
+    p = np.round(logits / logits.sum(-1, keepdims=True), 2)
+    t = rng.randint(0, num_classes, 256).astype(np.int32)
+    metric.update(jnp.asarray(p), jnp.asarray(t))
+    with no_materialization(monkeypatch):
+        fpr, tpr, th, counts = metric.compute()
+    for c in range(num_classes):
+        k = int(counts[c])
+        sk_fpr, sk_tpr, _ = sk_roc((t == c).astype(int), p[:, c], drop_intermediate=False)
+        np.testing.assert_allclose(np.asarray(fpr)[c, :k], sk_fpr, atol=1e-6, err_msg=f"class {c}")
+        np.testing.assert_allclose(np.asarray(tpr)[c, :k], sk_tpr, atol=1e-6, err_msg=f"class {c}")
+
+
+def test_stateful_sharded_multilabel_prc_curves(mesh, monkeypatch):
+    """The multilabel layout (2-D preds AND 2-D targets) through the sharded
+    curve engine: per-column curves sklearn-exact."""
+    from sklearn.metrics import precision_recall_curve as sk_prc
+
+    rng = np.random.RandomState(73)
+    num_labels = 3
+    metric = PrecisionRecallCurve(num_classes=num_labels, capacity=512)
+    metric.device_put(row_sharded(mesh, "dp"))
+    p = np.round(rng.rand(256, num_labels), 1).astype(np.float32)
+    t = (rng.rand(256, num_labels) > 0.5).astype(np.int32)
+    metric.update(jnp.asarray(p), jnp.asarray(t))
+    with no_materialization(monkeypatch):
+        precision, recall, th, counts = metric.compute()
+    for c in range(num_labels):
+        k = int(counts[c])
+        sk_p, sk_r, sk_t = sk_prc(t[:, c], p[:, c])
+        np.testing.assert_allclose(np.asarray(precision)[c, :k + 1], sk_p, atol=1e-6, err_msg=f"label {c}")
+        np.testing.assert_allclose(np.asarray(recall)[c, :k + 1], sk_r, atol=1e-6, err_msg=f"label {c}")
+        np.testing.assert_allclose(np.asarray(th)[c, :k], sk_t, atol=1e-6, err_msg=f"label {c}")
+
+
+def test_sharded_rank_engine(mesh):
+    """The public sharded_rank primitive: global scipy midranks, cross-shard
+    ties included, ghost rows excluded by the weight mask."""
+    import scipy.stats as st
+    from jax.sharding import PartitionSpec as P
+
+    from metrics_tpu.parallel import sharded_rank
+
+    rng = np.random.RandomState(79)
+    x = np.round(rng.rand(512), 1).astype(np.float32)
+    w = np.ones(512, np.float32)
+    w[448:] = 0.0  # ghost tail
+
+    fn = jax.jit(jax.shard_map(
+        lambda a, b: sharded_rank(a, "dp", b),
+        mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P("dp"),
+    ))
+    got = np.asarray(fn(jnp.asarray(x), jnp.asarray(w)))[:448]
+    want = st.rankdata(x[:448])
+    np.testing.assert_allclose(got, want, atol=1e-5)
 
 
 def test_stateful_sharded_spearman(mesh, monkeypatch):
