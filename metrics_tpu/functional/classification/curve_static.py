@@ -93,6 +93,34 @@ def binary_auroc_static(preds: Array, target: Array, sample_weights: Array = Non
     return jnp.trapezoid(tpr, fpr)
 
 
+def partial_auroc_from_roc(fpr: Array, tpr: Array, max_fpr: float) -> Array:
+    """McClish-corrected partial AUC from a (padded) ROC curve, static shape.
+
+    Segment-wise clipping of the trapezoid at ``fpr = max_fpr`` — equal to
+    the reference's interpolate-at-``max_fpr`` truncation
+    (reference functional/classification/auroc.py:110-121): segments fully
+    below contribute their trapezoid, the crossing segment is interpolated,
+    segments beyond (and the padded tail's zero-width repeats) contribute
+    nothing. Safe under jit; nan rates propagate (degenerate targets).
+    """
+    mf = jnp.asarray(max_fpr, dtype=fpr.dtype)
+    f0, f1 = fpr[:-1], fpr[1:]
+    t0, t1 = tpr[:-1], tpr[1:]
+    df = f1 - f0
+    w = jnp.clip(jnp.where(df > 0, (mf - f0) / jnp.where(df > 0, df, 1.0), 0.0), 0.0, 1.0)
+    t_hi = jnp.where(f1 <= mf, t1, t0 + w * (t1 - t0))
+    f_hi = jnp.minimum(f1, mf)
+    partial = jnp.sum(jnp.where(f0 < f_hi, (f_hi - f0) * (t0 + t_hi) / 2.0, 0.0))
+    # McClish correction: 0.5 if non-discriminant, 1 if maximal
+    min_area = 0.5 * mf * mf
+    max_area = mf
+    corrected = 0.5 * (1 + (partial - min_area) / (max_area - min_area))
+    # nan rates (degenerate all-pos/all-neg targets) must propagate: the
+    # nan<nan segment guard would otherwise mask an all-nan fpr to partial=0
+    degenerate = jnp.isnan(fpr[-1]) | jnp.isnan(tpr[-1])
+    return jnp.where(degenerate, jnp.nan, corrected)
+
+
 def binary_average_precision_static(preds: Array, target: Array, sample_weights: Array = None) -> Array:
     """Exact binary average precision with static shapes (jit/vmap-safe).
 

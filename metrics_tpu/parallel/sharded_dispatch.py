@@ -149,16 +149,31 @@ class _CurvePlan(NamedTuple):
 
     mesh: Mesh
     axis: str
-    form: str  # 'binary' | 'micro' | 'classes'
+    form: str  # 'binary' | 'binary-partial' | 'micro' | 'classes' | 'multilabel'
+
+
+def _warn_gather_fallback(metric: Any, reason: str, *states: Any) -> None:
+    """Loud degradation notice: the metric's epoch states ARE row-sharded but
+    this configuration has no sharded engine, so compute() will gather —
+    the O(dataset)-per-device behavior the placement opted out of."""
+    if _shared_info(*states) is None:
+        return
+    from metrics_tpu.utils.prints import rank_zero_warn
+
+    rank_zero_warn(
+        f"{type(metric).__name__}: row-sharded epoch states fall back to the"
+        f" gathered compute path ({reason}); every device will materialize the"
+        " full epoch."
+    )
 
 
 def auroc_applicable(metric: Any) -> Optional[_CurvePlan]:
     """The dispatch plan when ``AUROC.compute()`` will run sharded, else None.
 
-    Covers binary, multiclass (macro/weighted/none), and multilabel
-    (micro/macro/weighted/none) — the reference's full-AUC surface
-    (reference functional/classification/auroc.py:91-114). Partial AUC
-    (``max_fpr``) keeps the dynamic-curve gather path.
+    Covers binary (full AND partial AUC via ``max_fpr`` — the reference's
+    whole binary surface, functional/classification/auroc.py:91-133),
+    multiclass (macro/weighted/none), and multilabel
+    (micro/macro/weighted/none).
     """
     from metrics_tpu.utils.enums import AverageMethod, DataType
 
@@ -166,7 +181,9 @@ def auroc_applicable(metric: Any) -> Optional[_CurvePlan]:
     if info is None or metric.mode is None:
         return None
     if metric.max_fpr is not None and metric.max_fpr != 1:
-        return None  # partial AUC: dynamic-curve path only
+        if metric.mode == DataType.BINARY:
+            return _CurvePlan(*info, "binary-partial")
+        return None  # let the gather path raise the max_fpr/mode error
     if metric.mode == DataType.BINARY:
         return _CurvePlan(*info, "binary")
     if metric.mode == DataType.MULTILABEL and metric.average == AverageMethod.MICRO:
@@ -179,9 +196,9 @@ def auroc_applicable(metric: Any) -> Optional[_CurvePlan]:
 def average_precision_applicable(metric: Any) -> Optional[_CurvePlan]:
     """The dispatch plan when ``AveragePrecision.compute()`` runs sharded.
 
-    Binary and multiclass one-vs-rest (the layouts the static kernels cover,
-    ``functional/classification/average_precision.py``); the multilabel
-    dynamic-curve layout falls back."""
+    Binary, multiclass one-vs-rest, AND the multilabel layout (per-column
+    step integrals against positives == 1) — the reference's full AP surface
+    (``functional/classification/average_precision.py``)."""
     info = _shared_info(metric.preds, metric.target)
     if info is None or metric.num_classes is None:
         return None
@@ -189,7 +206,9 @@ def average_precision_applicable(metric: Any) -> Optional[_CurvePlan]:
         return _CurvePlan(*info, "binary")
     if metric.preds.data.ndim == 2 and metric.target.data.ndim == 1:
         return _CurvePlan(*info, "classes")
-    return None  # multilabel layout: dynamic-curve gather path
+    if metric.preds.data.ndim == 2 and metric.target.data.ndim == 2:
+        return _CurvePlan(*info, "multilabel")
+    return None
 
 
 def _class_scores_sharded(
@@ -269,8 +288,44 @@ def auroc_sharded(metric: Any) -> Optional[Array]:
 
     plan = auroc_applicable(metric)
     if plan is None:
+        _warn_gather_fallback(
+            metric, "no sharded engine for this mode/average configuration",
+            metric.preds, metric.target,
+        )
         return None
     _check_counts(metric, metric.preds, metric.target)
+
+    if plan.form == "binary-partial":
+        from metrics_tpu.functional.classification.curve_static import (
+            partial_auroc_from_roc,
+            roc_from_clf_curve,
+        )
+
+        pos_label = metric.pos_label
+        if pos_label is None:
+            rank_zero_warn("`pos_label` automatically set 1.")
+            pos_label = 1
+        max_fpr = float(metric.max_fpr)
+
+        def partial_factory():
+            def body(blocks, valid):
+                p, t = blocks
+                if p.ndim > t.ndim:
+                    p = p[:, 0]  # (rows, 1) binary layout
+                y = (t == pos_label).astype(jnp.float32)
+                fps, tps, th, counts = sharded_clf_curve_matrix(
+                    p[None, :], y[None, :], valid.astype(jnp.float32)[None, :], plan.axis
+                )
+                fpr, tpr, _, _ = roc_from_clf_curve(fps[0], tps[0], th[0], counts[0])
+                return partial_auroc_from_roc(fpr, tpr, max_fpr)
+
+            return body
+
+        key = (type(metric), "auroc-binary-partial", pos_label, max_fpr)
+        return _launch(
+            key, plan.mesh, plan.axis, (metric.preds.data, metric.target.data),
+            metric.preds.count, partial_factory, check_vma=False,
+        )
 
     if plan.form in ("binary", "micro"):
         pos_label = metric.pos_label
@@ -300,6 +355,9 @@ def average_precision_sharded(metric: Any) -> Optional[Any]:
     """Sharded-state ``AveragePrecision.compute()``; ``None`` -> gather path."""
     plan = average_precision_applicable(metric)
     if plan is None:
+        _warn_gather_fallback(
+            metric, "no sharded engine for this layout", metric.preds, metric.target
+        )
         return None
     _check_counts(metric, metric.preds, metric.target)
 
@@ -308,10 +366,13 @@ def average_precision_sharded(metric: Any) -> Optional[Any]:
         key = (type(metric), "ap-binary", pos_label)
         return _binary_scalar_sharded("ap", plan, metric.preds, metric.target, pos_label, key)
 
+    # multiclass: one-vs-rest against the label column; multilabel: per
+    # column against positives == 1 (the reference per-class sweep)
+    columns = "multilabel" if plan.form == "multilabel" else "labels"
     num_classes = metric.preds.data.shape[1]
-    key = (type(metric), "ap-classes", num_classes)
+    key = (type(metric), "ap-classes", columns, num_classes)
     scores, _ = _class_scores_sharded(
-        "ap", plan, metric.preds, metric.target, "labels", num_classes, key
+        "ap", plan, metric.preds, metric.target, columns, num_classes, key
     )
     return list(scores)
 

@@ -259,6 +259,66 @@ def test_stateful_sharded_multiclass_roc_curves(mesh, monkeypatch):
         np.testing.assert_allclose(np.asarray(tpr)[c, :k], sk_tpr, atol=1e-6, err_msg=f"class {c}")
 
 
+@pytest.mark.parametrize("max_fpr", [0.25, 0.5, 0.9])
+def test_stateful_sharded_partial_auroc(mesh, monkeypatch, max_fpr):
+    """Binary partial AUC (max_fpr + McClish) through the sharded engine
+    matches sklearn's standardized partial AUC, cross-shard ties included."""
+    rng = np.random.RandomState(83)
+    metric = AUROC(pos_label=1, max_fpr=max_fpr, capacity=1024)
+    metric.device_put(row_sharded(mesh, "dp"))
+    all_p, all_t = [], []
+    for p, t in _batches(rng, steps=6, batch=96):
+        all_p.append(p)
+        all_t.append(t)
+        metric.update(jnp.asarray(p), jnp.asarray(t))
+    with no_materialization(monkeypatch):
+        got = float(metric.compute())
+    want = sk_auroc(np.concatenate(all_t), np.concatenate(all_p), max_fpr=max_fpr)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_stateful_sharded_partial_auroc_degenerate(mesh, monkeypatch):
+    """All-positive targets give nan (the static-kernel convention), never a
+    finite-but-meaningless partial AUC."""
+    metric = AUROC(pos_label=1, max_fpr=0.5, capacity=256)
+    metric.device_put(row_sharded(mesh, "dp"))
+    rng = np.random.RandomState(101)
+    metric.update(jnp.asarray(rng.rand(64).astype(np.float32)),
+                  jnp.ones(64, dtype=jnp.int32))
+    with no_materialization(monkeypatch):
+        assert np.isnan(float(metric.compute()))
+
+
+def test_stateful_sharded_multilabel_average_precision(mesh, monkeypatch):
+    """The multilabel AP layout through the sharded per-column engine."""
+    rng = np.random.RandomState(89)
+    num_labels = 3
+    metric = AveragePrecision(num_classes=num_labels, capacity=512)
+    metric.device_put(row_sharded(mesh, "dp"))
+    p = np.round(rng.rand(256, num_labels), 1).astype(np.float32)
+    t = (rng.rand(256, num_labels) > 0.5).astype(np.int32)
+    metric.update(jnp.asarray(p), jnp.asarray(t))
+    with no_materialization(monkeypatch):
+        got = [float(x) for x in metric.compute()]
+    want = [sk_ap(t[:, c], p[:, c]) for c in range(num_labels)]
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_sharded_fallback_warns_loudly(mesh):
+    """A row-sharded metric whose config has no sharded engine must announce
+    the gather fallback before computing (or raising)."""
+    rng = np.random.RandomState(97)
+    metric = AUROC(num_classes=3, average="micro", capacity=512)
+    metric.device_put(row_sharded(mesh, "dp"))
+    logits = rng.rand(128, 3).astype(np.float32)
+    p = logits / logits.sum(-1, keepdims=True)
+    t = rng.randint(0, 3, 128).astype(np.int32)
+    metric.update(jnp.asarray(p), jnp.asarray(t))
+    with pytest.warns(UserWarning, match="fall back to the gathered"):
+        with pytest.raises(ValueError, match="average"):
+            metric.compute()
+
+
 def test_stateful_sharded_multilabel_prc_curves(mesh, monkeypatch):
     """The multilabel layout (2-D preds AND 2-D targets) through the sharded
     curve engine: per-column curves sklearn-exact."""
