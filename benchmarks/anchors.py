@@ -281,11 +281,46 @@ def anchor5_retrieval():
             extra)
 
 
+def anchor6_class_readbacks():
+    """average=None per-class results, C=64: iterating float(s) over the
+    result list (C readbacks — the reference's list-of-scalars contract) vs
+    one ``scores.array`` transfer (the ClassScores O(1)-readback path).
+
+    'reference_ms' here is the per-element iteration of OUR OWN result —
+    the hazard being eliminated — not a torch run; both closures recompute
+    the scores so each iteration reads back fresh (uncached) arrays.
+    """
+    rng = np.random.RandomState(5)
+    n, c = 8192, 64
+    logits = rng.rand(n, c).astype(np.float32)
+    preds = logits / logits.sum(-1, keepdims=True)
+    target = rng.randint(0, c, n)
+
+    import jax.numpy as jnp
+
+    from metrics_tpu.functional import auroc as j_auroc
+
+    jp, jt = jnp.asarray(preds), jnp.asarray(target)
+
+    def per_element():
+        s = j_auroc(jp, jt, num_classes=c, average=None, validate=False)
+        return [float(v) for v in s]
+
+    def one_array():
+        s = j_auroc(jp, jt, num_classes=c, average=None, validate=False)
+        return np.asarray(s.array)
+
+    per_ms = _timeit(per_element, iters=3, warmup=1)
+    one_ms = _timeit(one_array, iters=3, warmup=1)
+    return per_ms, one_ms, {"classes": c}
+
+
 ANCHORS = {
     "1 README Accuracy loop (10x(10,5))": anchor1_readme_accuracy,
     "2 confusion_matrix+stat_scores (8192x64)": anchor2_functional_kernels,
     "4 AUROC+AP exact compute (65536)": anchor4_curve_metrics,
     "5 RetrievalMAP (512qx128d)": anchor5_retrieval,
+    "6 per-class readbacks: float(s) loop vs .array (C=64)": anchor6_class_readbacks,
 }
 
 

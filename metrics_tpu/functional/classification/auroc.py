@@ -14,9 +14,7 @@ import numpy as np
 import jax.numpy as jnp
 from jax import Array
 
-from metrics_tpu.functional.classification.auc import auc
 from metrics_tpu.functional.classification.curve_static import binary_auroc_static
-from metrics_tpu.functional.classification.roc import roc
 from metrics_tpu.utils.checks import _input_format_classification, defer_or_run_value_check, deferred_value_checks
 from metrics_tpu.utils.data import in_tracing_context
 from metrics_tpu.utils.enums import AverageMethod, DataType
@@ -83,6 +81,19 @@ def _auroc_class_scores(
     return jax.vmap(binary_auroc_static, in_axes=(1, 1, None))(preds, onehot, weights)
 
 
+def _binary_setup(preds: Array, target: Array, pos_label, validate: bool):
+    """The shared binary preamble: pos_label default (+warn), (rows, 1)
+    squeeze, 0/1 target, eager reference value checks."""
+    if pos_label is None:
+        rank_zero_warn("`pos_label` automatically set 1.")
+        pos_label = 1
+    p = preds[:, 0] if preds.ndim > target.ndim else preds
+    y = (target == pos_label).astype(jnp.int32)
+    if validate and not in_tracing_context():
+        _check_pos_neg_eager(y)  # reference ROC error paths (eager only)
+    return p, y
+
+
 def _auroc_update(preds: Array, target: Array, validate: bool = True):
     # validate input and resolve the data mode
     _, _, mode = _input_format_classification(preds, target, validate=validate)
@@ -129,13 +140,8 @@ def _auroc_compute(
         weights = None if sample_weights is None else jnp.asarray(sample_weights, dtype=jnp.float32)
 
         if mode == DataType.MULTILABEL and average == AverageMethod.MICRO:
-            if pos_label is None:
-                rank_zero_warn("`pos_label` automatically set 1.")
-                pos_label = 1
-            y = (target.reshape(-1) == pos_label).astype(jnp.int32)
-            if validate and not in_tracing_context():
-                _check_pos_neg_eager(y)
-            return binary_auroc_static(preds.reshape(-1), y, weights)
+            p, y = _binary_setup(preds.reshape(-1), target.reshape(-1), pos_label, validate)
+            return binary_auroc_static(p, y, weights)
 
         if num_classes != 1:
             if mode == DataType.MULTILABEL:
@@ -151,7 +157,9 @@ def _auroc_compute(
                 auc_scores = _auroc_class_scores(preds, target, "labels", 1, sample_weights, validate)
 
             if average == AverageMethod.NONE:
-                return list(auc_scores)
+                from metrics_tpu.utils.data import ClassScores
+
+                return ClassScores(auc_scores)
             if average == AverageMethod.MACRO:
                 return jnp.mean(auc_scores)
             if average == AverageMethod.WEIGHTED:
@@ -166,33 +174,21 @@ def _auroc_compute(
                 f"Argument `average` expected to be one of the following: {allowed_average} but got {average}"
             )
 
-        if pos_label is None:
-            rank_zero_warn("`pos_label` automatically set 1.")
-            pos_label = 1
-        if preds.ndim > target.ndim:
-            preds = preds[:, 0]
-        y = (target == pos_label).astype(jnp.int32)
-        if validate and not in_tracing_context():
-            _check_pos_neg_eager(y)
-        return binary_auroc_static(preds, y, weights)
+        p, y = _binary_setup(preds, target, pos_label, validate)
+        return binary_auroc_static(p, y, weights)
 
-    # partial AUC keeps the dynamic-curve path (eager; data-dependent shapes)
-    fpr, tpr, _ = roc(preds, target, num_classes, pos_label, sample_weights)
+    # partial AUC: the same static-shape route as full AUC — padded ROC +
+    # the segment-clipped McClish transform (one fused jit-safe program, no
+    # data-dependent shapes or readbacks). Shared with the sharded dispatch.
+    from metrics_tpu.functional.classification.curve_static import (
+        binary_roc_padded,
+        partial_auroc_from_roc,
+    )
 
-    # partial AUC: interpolate the curve at max_fpr, then McClish-correct
-    max_fpr_t = jnp.asarray(max_fpr)
-    stop = int(jnp.searchsorted(fpr, max_fpr_t, side="right"))
-    weight = (max_fpr_t - fpr[stop - 1]) / (fpr[stop] - fpr[stop - 1])
-    interp_tpr = tpr[stop - 1] + weight * (tpr[stop] - tpr[stop - 1])
-    tpr = jnp.concatenate([tpr[:stop], interp_tpr.reshape(1)])
-    fpr = jnp.concatenate([fpr[:stop], max_fpr_t.reshape(1)])
-
-    partial_auc = auc(fpr, tpr)
-
-    # McClish correction: 0.5 if non-discriminant, 1 if maximal
-    min_area = 0.5 * max_fpr**2
-    max_area = max_fpr
-    return 0.5 * (1 + (partial_auc - min_area) / (max_area - min_area))
+    p, y = _binary_setup(preds, target, pos_label, validate)
+    weights = None if sample_weights is None else jnp.asarray(sample_weights, dtype=jnp.float32)
+    fpr, tpr, _, _ = binary_roc_padded(p, y, weights)
+    return partial_auroc_from_roc(fpr, tpr, max_fpr)
 
 
 def auroc(

@@ -53,10 +53,16 @@ def _average_precision_compute(
         # multiclass one-vs-rest: vectorized over classes
         onehot = (target[:, None] == jnp.arange(num_classes)).astype(jnp.int32)
         scores = jax.vmap(binary_average_precision_static, in_axes=(1, 1, None))(preds, onehot, weights)
-        return list(scores)
+        from metrics_tpu.utils.data import ClassScores
+
+        return ClassScores(scores)
 
     precision, recall, _ = _precision_recall_curve_compute(preds, target, num_classes, pos_label)
-    return [-jnp.sum((r[1:] - r[:-1]) * p[:-1]) for p, r in zip(precision, recall)]
+    from metrics_tpu.utils.data import ClassScores
+
+    return ClassScores(
+        jnp.stack([-jnp.sum((r[1:] - r[:-1]) * p[:-1]) for p, r in zip(precision, recall)])
+    )
 
 
 def average_precision(

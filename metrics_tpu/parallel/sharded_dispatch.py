@@ -245,6 +245,22 @@ def _class_scores_sharded(
     )
 
 
+def _default_pos_label(metric: Any) -> int:
+    """The gather path's binary pos_label defaulting (warn + 1)."""
+    pos_label = metric.pos_label
+    if pos_label is None:
+        from metrics_tpu.utils.prints import rank_zero_warn
+
+        rank_zero_warn("`pos_label` automatically set 1.")
+        pos_label = 1
+    return pos_label
+
+
+def _squeeze_binary(p: Array, t: Array) -> Array:
+    """Drop the (rows, 1) binary column layout (gather path: auroc.py:172-173)."""
+    return p[:, 0] if p.ndim > t.ndim else p
+
+
 def _binary_scalar_sharded(
     kind: str,
     plan: _CurvePlan,
@@ -262,8 +278,8 @@ def _binary_scalar_sharded(
     def factory():
         def body(blocks, valid):
             p, t = blocks
-            if not flatten and p.ndim > t.ndim:
-                p = p[:, 0]  # (rows, 1) binary layout (gather path: auroc.py:172-173)
+            if not flatten:
+                p = _squeeze_binary(p, t)
             y = (t == pos_label).astype(jnp.int32)
             if flatten:
                 w = jnp.repeat(valid.astype(jnp.float32), p.shape[1])
@@ -301,17 +317,13 @@ def auroc_sharded(metric: Any) -> Optional[Array]:
             roc_from_clf_curve,
         )
 
-        pos_label = metric.pos_label
-        if pos_label is None:
-            rank_zero_warn("`pos_label` automatically set 1.")
-            pos_label = 1
+        pos_label = _default_pos_label(metric)
         max_fpr = float(metric.max_fpr)
 
         def partial_factory():
             def body(blocks, valid):
                 p, t = blocks
-                if p.ndim > t.ndim:
-                    p = p[:, 0]  # (rows, 1) binary layout
+                p = _squeeze_binary(p, t)
                 y = (t == pos_label).astype(jnp.float32)
                 fps, tps, th, counts = sharded_clf_curve_matrix(
                     p[None, :], y[None, :], valid.astype(jnp.float32)[None, :], plan.axis
@@ -328,10 +340,7 @@ def auroc_sharded(metric: Any) -> Optional[Array]:
         )
 
     if plan.form in ("binary", "micro"):
-        pos_label = metric.pos_label
-        if pos_label is None:
-            rank_zero_warn("`pos_label` automatically set 1.")
-            pos_label = 1
+        pos_label = _default_pos_label(metric)
         key = (type(metric), f"auroc-{plan.form}", pos_label)
         return _binary_scalar_sharded(
             "auroc", plan, metric.preds, metric.target, pos_label, key, flatten=plan.form == "micro"
@@ -374,17 +383,20 @@ def average_precision_sharded(metric: Any) -> Optional[Any]:
     scores, _ = _class_scores_sharded(
         "ap", plan, metric.preds, metric.target, columns, num_classes, key
     )
-    return list(scores)
+    from metrics_tpu.utils.data import ClassScores
+
+    return ClassScores(scores)
 
 
 def _average(scores: Array, support: Array, average: Any) -> Any:
+    from metrics_tpu.utils.data import ClassScores
     from metrics_tpu.utils.enums import AverageMethod
 
     if average == AverageMethod.MACRO:
         return jnp.mean(scores)
     if average == AverageMethod.WEIGHTED:
         return jnp.sum(scores * support / jnp.sum(support))
-    return list(scores)
+    return ClassScores(scores)
 
 
 # ------------------------------------------------------------- curve vectors

@@ -1,4 +1,5 @@
 from metrics_tpu.utils.data import (
+    ClassScores,
     apply_to_collection,
     dim_zero_cat,
     dim_zero_max,

@@ -30,6 +30,61 @@ def dim_zero_cat(x: Union[Array, List[Array]]) -> Array:
     return jnp.concatenate(x, axis=0)
 
 
+class ClassScores(list):
+    """Per-class results: a list (reference shape parity) with the backing
+    device array attached.
+
+    The reference returns ``average=None`` / multiclass curve summaries as a
+    LIST of per-class scalars (reference functional/classification/auroc.py:100);
+    iterating ``float(s)`` over such a list costs one device readback per
+    class — ~100 ms each through a remote-device tunnel, C round trips for a
+    C-class metric. The scores here are views of ONE ``(C,)`` device array,
+    exposed as ``.array``: ``np.asarray(scores.array)`` reads every class
+    back in a single transfer. Iteration / indexing / equality behave exactly
+    like the reference's list, and the type is a registered pytree node whose
+    children are the per-class elements, so ``tree_map`` / ``vmap`` / the
+    batched-forward scan recurse into it exactly as they would a plain list
+    (rebuilding re-stacks the backing array).
+    """
+
+    __slots__ = ("array",)
+
+    def __init__(self, values):
+        if isinstance(values, Array):  # incl. tracers: jax.Array is the ABC
+            arr = values
+            items = arr
+        else:  # per-class elements (pytree unflatten, apply_to_collection)
+            items = list(values)
+            try:
+                if items and all(isinstance(x, np.ndarray) for x in items):
+                    # host elements (e.g. jax.device_get) must NOT round-trip
+                    # back through the device — stack on the host
+                    arr = np.stack(items)
+                elif items:
+                    arr = jnp.stack(items)
+                else:
+                    arr = jnp.zeros((0,), jnp.float32)
+            except TypeError:
+                # structure-only leaves (eval_shape ShapeDtypeStructs,
+                # tree_map to None, ...): stay a plain list; the .array
+                # contract only holds for array elements
+                arr = None
+        super().__init__(items if arr is None else arr)
+        self.array = arr
+
+    def __reduce__(self):
+        if self.array is None:
+            return (list, (list(self),))
+        return (ClassScores, (self.array,))
+
+
+jax.tree_util.register_pytree_node(
+    ClassScores,
+    lambda s: (tuple(s), None),
+    lambda _, children: ClassScores(children),
+)
+
+
 def dim_zero_sum(x: Array) -> Array:
     return jnp.sum(x, axis=0)
 

@@ -1,0 +1,171 @@
+"""ClassScores: per-class results as a list (reference parity) with the
+single backing device array attached (the O(1)-readback path).
+
+The reference returns ``average=None`` / multiclass results as a LIST of
+per-class scalars (reference functional/classification/auroc.py:100);
+iterating ``float(s)`` costs one device readback per class. ``.array``
+exposes the one ``(C,)`` array all the scalars are views of.
+"""
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import AUROC, AveragePrecision
+from metrics_tpu.functional import auroc, average_precision
+from metrics_tpu.utils import ClassScores
+
+NUM_CLASSES = 5
+_rng = np.random.RandomState(3)
+_logits = _rng.rand(128, NUM_CLASSES).astype(np.float32)
+_preds = _logits / _logits.sum(-1, keepdims=True)
+_target = _rng.randint(0, NUM_CLASSES, 128).astype(np.int32)
+
+
+def test_class_scores_is_a_list():
+    s = ClassScores(jnp.arange(3.0))
+    assert isinstance(s, list)
+    assert len(s) == 3
+    assert [float(v) for v in s] == [0.0, 1.0, 2.0]
+    assert float(s[1]) == 1.0
+
+
+def test_class_scores_single_backing_array():
+    arr = jnp.arange(4.0)
+    s = ClassScores(arr)
+    assert s.array is arr  # no per-class stacking / copies
+    np.testing.assert_allclose(np.asarray(s.array), [float(v) for v in s])
+
+
+def test_class_scores_pickle_round_trip():
+    s = ClassScores(jnp.arange(3.0))
+    s2 = pickle.loads(pickle.dumps(s))
+    assert isinstance(s2, ClassScores)
+    np.testing.assert_allclose(np.asarray(s2.array), np.asarray(s.array))
+
+
+@pytest.mark.parametrize(
+    "fn",
+    [
+        lambda: auroc(jnp.asarray(_preds), jnp.asarray(_target), num_classes=NUM_CLASSES, average=None),
+        lambda: average_precision(jnp.asarray(_preds), jnp.asarray(_target), num_classes=NUM_CLASSES),
+    ],
+    ids=["auroc", "average_precision"],
+)
+def test_functional_class_results_carry_array(fn):
+    scores = fn()
+    assert isinstance(scores, ClassScores)
+    assert scores.array.shape == (NUM_CLASSES,)
+    np.testing.assert_allclose(np.asarray(scores.array), [float(v) for v in scores])
+
+
+@pytest.mark.parametrize(
+    "metric",
+    [AUROC(num_classes=NUM_CLASSES, average=None), AveragePrecision(num_classes=NUM_CLASSES)],
+    ids=["AUROC", "AveragePrecision"],
+)
+def test_stateful_class_results_carry_array(metric):
+    metric.update(jnp.asarray(_preds), jnp.asarray(_target))
+    scores = metric.compute()
+    assert isinstance(scores, ClassScores)
+    assert scores.array.shape == (NUM_CLASSES,)
+
+
+def test_class_scores_is_pytree_with_per_class_children():
+    """tree ops recurse into ClassScores like a plain list (the batched
+    forward scan stacks per-class results across steps)."""
+    import jax
+
+    s = ClassScores(jnp.arange(3.0))
+    leaves = jax.tree_util.tree_leaves(s)
+    assert len(leaves) == 3
+    doubled = jax.tree_util.tree_map(lambda x: x * 2, s)
+    assert isinstance(doubled, ClassScores)
+    np.testing.assert_allclose(np.asarray(doubled.array), [0.0, 2.0, 4.0])
+
+
+def test_forward_batched_with_class_results():
+    """AUROC(average=None).forward_batched must scan-stack per-class results
+    (regression: a pytree-leaf ClassScores broke the stacking)."""
+    metric = AUROC(num_classes=NUM_CLASSES, average=None)
+    out = metric.forward_batched(
+        jnp.asarray(_preds.reshape(2, 64, NUM_CLASSES)),
+        jnp.asarray(_target.reshape(2, 64)),
+    )
+    assert len(out) == NUM_CLASSES  # per-class, stacked over the 2 steps
+
+
+def test_class_scores_abstract_tree_ops():
+    """eval_shape and structure-only tree_map must not run device compute
+    through the unflatten (regression: jnp.stack on ShapeDtypeStructs)."""
+    import jax
+
+    def fn(x):
+        return ClassScores(x)
+
+    shape = jax.eval_shape(fn, jnp.zeros(3))
+    assert len(jax.tree_util.tree_leaves(shape)) == 3
+    nones = jax.tree_util.tree_map(lambda x: None, ClassScores(jnp.arange(3.0)),
+                                   is_leaf=lambda x: x is None)
+    assert len(nones) == 3 and nones.array is None
+
+
+def test_class_scores_device_get_stays_on_host():
+    """jax.device_get must yield host-side elements AND a host-side .array —
+    not re-upload through the tunnel (regression)."""
+    import jax
+
+    s = ClassScores(jnp.arange(3.0))
+    host = jax.device_get(s)
+    assert isinstance(host.array, np.ndarray)
+    assert all(isinstance(v, (np.ndarray, np.generic)) for v in host)  # host-side scalars
+    np.testing.assert_allclose(host.array, [0.0, 1.0, 2.0])
+
+
+def test_binned_int8_gate_is_bool_only():
+    """Integer weights above int8 range must NOT be wrapped through the int8
+    fast path (regression: dtype-only gate)."""
+    from metrics_tpu.ops.binned import binned_stat_counts
+
+    preds = jnp.asarray([[0.9], [0.5], [0.1]])
+    pos = jnp.asarray([[200], [0], [0]], dtype=jnp.int32)  # > int8 max
+    neg = jnp.asarray([[0], [300], [1]], dtype=jnp.int32)
+    tp, fp = binned_stat_counts(preds, pos, neg, jnp.asarray([0.0]))
+    assert float(tp[0, 0]) == 200.0
+    assert float(fp[0, 0]) == 301.0
+    # bool masks take the int8 path and stay exact
+    tp_b, fp_b = binned_stat_counts(
+        preds, jnp.asarray([[True], [False], [False]]), jnp.asarray([[False], [True], [True]]),
+        jnp.asarray([0.0]))
+    assert float(tp_b[0, 0]) == 1.0 and float(fp_b[0, 0]) == 2.0
+
+
+def test_apply_to_collection_preserves_backing_array():
+    from metrics_tpu.utils import apply_to_collection
+    from jax import Array
+
+    s = ClassScores(jnp.arange(3.0))
+    out = apply_to_collection(s, Array, lambda x: x * 2)
+    assert isinstance(out, ClassScores)
+    assert hasattr(out.array, "shape") and out.array.shape == (3,)
+    np.testing.assert_allclose(np.asarray(out.array), [0.0, 2.0, 4.0])
+
+
+def test_sharded_class_results_carry_array(eight_devices):
+    from jax.sharding import Mesh
+
+    from metrics_tpu.parallel import row_sharded
+
+    mesh = Mesh(np.array(eight_devices), ("dp",))
+    metric = AUROC(num_classes=NUM_CLASSES, average=None, capacity=256)
+    metric.device_put(row_sharded(mesh, "dp"))
+    metric.update(jnp.asarray(_preds), jnp.asarray(_target))
+    scores = metric.compute()
+    assert isinstance(scores, ClassScores)
+    assert scores.array.shape == (NUM_CLASSES,)
+    plain = AUROC(num_classes=NUM_CLASSES, average=None)
+    plain.update(jnp.asarray(_preds), jnp.asarray(_target))
+    np.testing.assert_allclose(
+        np.asarray(scores.array), np.asarray(plain.compute().array), atol=1e-5
+    )
