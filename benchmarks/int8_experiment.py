@@ -1,0 +1,127 @@
+"""Int8-MXU experiment for the 0/1 one-hot contractions (VERDICT r4 item 6).
+
+Run:  python benchmarks/int8_experiment.py [--json]
+
+The confusion-matrix / binned count kernels contract 0/1 one-hot operands —
+exact in int8, and the v5e MXU's int8 path has 2x the bf16 MAC rate
+(~394 TOPS vs ~197 TFLOP/s). This experiment measures, under the
+forced-execution protocol (benchmarks/timing.py — `block_until_ready` is a
+no-op through the axon tunnel), whether routing these contractions through
+int8 beats the shipped bf16 path at saturation sizes.
+
+Kernels, each timed at N in {16M, 64M} with C=64 / T=512:
+  * confusion_matrix contraction: one_hot(t)^T @ one_hot(p) —
+    bf16->f32 accum (shipped) vs int8->int32 accum (candidate).
+  * binned_stat_counts matmul form: (T, N) 0/1 comparison matrix @ (N, 2)
+    pos/neg columns — same dtype pair.
+
+The decision (ship or reject) is recorded in BASELINE.md either way, the
+Pallas-sweep discipline.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+C = 64
+T = 512
+
+
+def _cm_kernels():
+    import jax
+    import jax.numpy as jnp
+
+    def bf16(p, t):
+        th = jax.nn.one_hot(t, C, dtype=jnp.bfloat16)
+        ph = jax.nn.one_hot(p, C, dtype=jnp.bfloat16)
+        cm = jnp.matmul(th.T, ph, preferred_element_type=jnp.float32)
+        return cm[0, 0]
+
+    def int8(p, t):
+        th = jax.nn.one_hot(t, C, dtype=jnp.int8)
+        ph = jax.nn.one_hot(p, C, dtype=jnp.int8)
+        cm = jnp.matmul(th.T, ph, preferred_element_type=jnp.int32)
+        return cm[0, 0].astype(jnp.float32)
+
+    def perturb(p, s):
+        return p.at[0].set((p[0] + s.astype(jnp.int32)) % C)
+
+    return {"cm_bf16": bf16, "cm_int8": int8}, perturb
+
+
+def _binned_kernels():
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    # eager constant (a lazily-cached device array would leak a tracer into
+    # later traces when first created under jit)
+    edges = jnp.asarray(np.linspace(0.0, 1.0, T, dtype=np.float32))
+
+    def bf16(preds, target):
+        ge = (preds[None, :] >= edges[:, None]).astype(jnp.bfloat16)  # (T, N)
+        cols = jnp.stack([target, 1.0 - target], axis=1).astype(jnp.bfloat16)  # (N, 2)
+        counts = jnp.matmul(ge, cols, preferred_element_type=jnp.float32)
+        return counts[0, 0]
+
+    def int8(preds, target):
+        ge = (preds[None, :] >= edges[:, None]).astype(jnp.int8)
+        cols = jnp.stack([target, 1.0 - target], axis=1).astype(jnp.int8)
+        counts = jnp.matmul(ge, cols, preferred_element_type=jnp.int32)
+        return counts[0, 0].astype(jnp.float32)
+
+    def perturb(p, s):
+        return p.at[0].set(jnp.abs(s) % 1.0)
+
+    return {"binned_bf16": bf16, "binned_int8": int8}, perturb
+
+
+def run(ns=(16_000_000, 64_000_000)):
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from benchmarks.timing import chained_loop_time
+
+    rng = np.random.RandomState(7)
+    results = {}
+
+    cm_kernels, cm_perturb = _cm_kernels()
+    binned_kernels, binned_perturb = _binned_kernels()
+
+    for n in ns:
+        labels_p = jnp.asarray(rng.randint(0, C, n, dtype=np.int32))
+        labels_t = jnp.asarray(rng.randint(0, C, n, dtype=np.int32))
+        for name, kernel in cm_kernels.items():
+            ms = chained_loop_time(kernel, cm_perturb, labels_p, (labels_t,), k1=2, k2=12) * 1e3
+            # (C, N) @ (N, C): 2*N*C^2 MACs
+            tflops = 2.0 * n * C * C / (ms * 1e-3) / 1e12
+            results[f"{name}_N{n // 1_000_000}M"] = {"ms": round(ms, 3), "tflops": round(tflops, 1)}
+
+        scores = jnp.asarray(rng.rand(n).astype(np.float32))
+        target = jnp.asarray((rng.rand(n) > 0.5).astype(np.float32))
+        for name, kernel in binned_kernels.items():
+            ms = chained_loop_time(kernel, binned_perturb, scores, (target,), k1=2, k2=12) * 1e3
+            # (T, N) @ (N, 2): 2*N*T*2 MACs (the T x N comparison is extra VPU work)
+            tflops = 4.0 * n * T / (ms * 1e-3) / 1e12
+            results[f"{name}_N{n // 1_000_000}M"] = {"ms": round(ms, 3), "tflops": round(tflops, 1)}
+
+    return results
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--json", action="store_true")
+    args = parser.parse_args()
+    results = run()
+    if args.json:
+        print(json.dumps(results))
+    else:
+        for k, v in results.items():
+            print(f"{k}: {v['ms']:.2f} ms  ({v['tflops']:.1f} TFLOP/s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -3,7 +3,8 @@
 One shared base streams the ``(num_clusters, num_classes)`` contingency
 count matrix; each subclass applies its closed-form compute.
 
-Precision: the contingency *cells* are exact below 2^24 per cell, but the
+Precision: the contingency *cells* are int32-exact below 2^31 per cell
+(the one-hot contraction accumulates in int32), but the
 pair-counting scores (Rand/ARI/Fowlkes-Mallows) compute ``C(n,2)`` of the
 marginals *and of the grand total*, so float32 integer exactness is lost
 once the TOTAL accumulated epoch passes n = 5793 (``n(n-1)/2 > 2^24``),

@@ -67,11 +67,12 @@ def binned_stat_curve_update(
     else:
         preds_c, target_c = preds, target
 
-    pos = (target_c > 0).astype(preds_c.dtype)  # (N, C)
-    neg = 1.0 - pos
+    # bool 0/1 columns engage the int8 MXU route in binned_stat_counts
+    pos = target_c > 0  # (N, C)
+    neg = ~pos
     tp, fp = binned_stat_counts(preds_c, pos, neg, thresholds, impl=impl)  # (C, T)
-    n_pos = jnp.sum(pos, axis=0)[:, None]  # (C, 1)
-    n_neg = jnp.sum(neg, axis=0)[:, None]
+    n_pos = jnp.sum(pos, axis=0, dtype=preds_c.dtype)[:, None]  # (C, 1)
+    n_neg = jnp.sum(neg, axis=0, dtype=preds_c.dtype)[:, None]
     fn = n_pos - tp
     tn = n_neg - fp
 

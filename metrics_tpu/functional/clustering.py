@@ -33,10 +33,11 @@ def _contingency(preds: Array, target: Array, num_clusters: int, num_classes: in
         raise ValueError(
             f"Expected 1-D label arrays of identical shape, got {preds.shape} and {target.shape}"
         )
-    p = jax.nn.one_hot(preds, num_clusters, dtype=jnp.bfloat16)
-    t = jax.nn.one_hot(target, num_classes, dtype=jnp.bfloat16)
-    counts = jnp.matmul(p.T, t, preferred_element_type=jnp.float32)
-    return jnp.round(counts).astype(jnp.int32)
+    # 0/1 one-hot operands: int8 MXU contraction with int32 accumulation —
+    # faster than bf16 (2x MAC rate) and exact to 2^31 per cell
+    p = jax.nn.one_hot(preds, num_clusters, dtype=jnp.int8)
+    t = jax.nn.one_hot(target, num_classes, dtype=jnp.int8)
+    return jnp.matmul(p.T, t, preferred_element_type=jnp.int32)
 
 
 def _comb2(x: Array) -> Array:

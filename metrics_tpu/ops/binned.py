@@ -116,11 +116,30 @@ def _binned_counts_xla(preds_c: Array, pos: Array, neg: Array, thresholds: Array
     the MXU better).
     """
     n, c = preds_c.shape
+    # BOOL weight columns (the unweighted curve family) route through the
+    # int8 MXU path: 2x the bf16/f32 MAC rate, int32 accumulation exact to
+    # 2^31 (measured 1.5-1.9x at 16M-64M on v5e, BASELINE.md round-5 int8
+    # experiment). The gate is bool-only on purpose: integer weights could
+    # exceed int8 range and astype(int8) would silently wrap — numeric
+    # (float/int) weights keep the f32 matmul.
+    exact01 = jnp.issubdtype(pos.dtype, jnp.bool_) and jnp.issubdtype(neg.dtype, jnp.bool_)
     if c == 1:
-        ge = (preds_c[:, 0][None, :] >= thresholds[:, None]).astype(preds_c.dtype)  # (T, N)
-        w = jnp.concatenate([pos, neg], axis=1)  # (N, 2)
-        out = ge @ w  # (T, 2)
+        if exact01:
+            ge = (preds_c[:, 0][None, :] >= thresholds[:, None]).astype(jnp.int8)
+            w = jnp.concatenate([pos, neg], axis=1).astype(jnp.int8)  # (N, 2)
+            out = jnp.matmul(ge, w, preferred_element_type=jnp.int32).astype(jnp.float32)
+        else:
+            ge = (preds_c[:, 0][None, :] >= thresholds[:, None]).astype(preds_c.dtype)  # (T, N)
+            w = jnp.concatenate([pos, neg], axis=1)  # (N, 2)
+            out = ge @ w  # (T, 2)
         return out[:, :1].T, out[:, 1:].T
+    if exact01:
+        ge = (preds_c[None, :, :] >= thresholds[:, None, None]).astype(jnp.int8)
+        tp = jnp.einsum("tnc,nc->tc", ge, pos.astype(jnp.int8),
+                        preferred_element_type=jnp.int32).T.astype(jnp.float32)
+        fp = jnp.einsum("tnc,nc->tc", ge, neg.astype(jnp.int8),
+                        preferred_element_type=jnp.int32).T.astype(jnp.float32)
+        return tp, fp
     ge = (preds_c[None, :, :] >= thresholds[:, None, None]).astype(preds_c.dtype)  # (T, N, C)
     tp = jnp.einsum("tnc,nc->tc", ge, pos).T  # (C, T)
     fp = jnp.einsum("tnc,nc->tc", ge, neg).T
@@ -134,7 +153,9 @@ def binned_stat_counts(
 
     Args:
         preds_c: ``(N, C)`` scores (float32).
-        pos / neg: ``(N, C)`` float32 weights of positive / negative samples.
+        pos / neg: ``(N, C)`` weights of positive / negative samples —
+            float32 for weighted counts, or BOOL 0/1 masks, which engage
+            the exact int8 MXU fast path (see ``_binned_counts_xla``).
         thresholds: ``(T,)`` ascending thresholds.
         impl: ``"auto"`` (the XLA einsum — measured equal to the kernel at
             every size, see module docstring), ``"pallas"``,
