@@ -1,4 +1,11 @@
-"""MeanAveragePrecision module (COCO semantics, TPU-native engine)."""
+"""MeanAveragePrecision module (full COCO semantics, TPU-native engine).
+
+Result-dict key parity with later torchmetrics ``detection/mean_ap.py``:
+``map``, ``map_50``, ``map_75``, ``map_small/medium/large``,
+``mar_1/10/100``, ``mar_small/medium/large``, plus per-class vectors under
+``class_metrics``. Missing classes are ``nan`` (pycocotools' ``-1``
+sentinel translated to the library-wide nan convention).
+"""
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -17,7 +24,12 @@ class MeanAveragePrecision(Metric):
     ``update`` takes the torchmetrics-style per-image dict lists::
 
         preds  = [{"boxes": (N, 4) xyxy, "scores": (N,), "labels": (N,)}, ...]
-        target = [{"boxes": (M, 4) xyxy, "labels": (M,)}, ...]
+        target = [{"boxes": (M, 4) xyxy, "labels": (M,),
+                   "iscrowd": (M,) optional}, ...]
+
+    Crowd ground truths use intersection-over-detection-area overlap, may
+    match any number of detections, and are ignore-flagged (detections
+    matched to them count neither as TP nor FP) — pycocotools semantics.
 
     Every image is padded to static ``max_detections`` / ``max_gt`` slots
     (detections beyond the cap keep the top scores — the COCO ``maxDets``
@@ -54,6 +66,7 @@ class MeanAveragePrecision(Metric):
         iou_thresholds: Sequence[float] = COCO_IOU_THRESHOLDS,
         max_detections: int = 100,
         max_gt: int = 100,
+        max_detection_thresholds: Sequence[int] = (1, 10, 100),
         class_metrics: bool = False,
         compute_on_step: bool = True,
         dist_sync_on_step: bool = False,
@@ -72,10 +85,13 @@ class MeanAveragePrecision(Metric):
             raise ValueError(f"`num_classes` must be a positive int, got {num_classes!r}")
         if max_detections < 1 or max_gt < 1:
             raise ValueError("`max_detections` and `max_gt` must be positive")
+        if not max_detection_thresholds or any(int(k) < 1 for k in max_detection_thresholds):
+            raise ValueError("`max_detection_thresholds` must be positive ints")
         self.num_classes = num_classes
         self.iou_thresholds = tuple(float(t) for t in iou_thresholds)
         self.max_detections = max_detections
         self.max_gt = max_gt
+        self.max_detection_thresholds = tuple(int(k) for k in max_detection_thresholds)
         self.class_metrics = class_metrics
         d, g = max_detections, max_gt
         self.add_state("det_boxes", default=[], dist_reduce_fx=None, item_shape=(d, 4))
@@ -85,6 +101,7 @@ class MeanAveragePrecision(Metric):
         self.add_state("gt_boxes", default=[], dist_reduce_fx=None, item_shape=(g, 4))
         self.add_state("gt_labels", default=[], dist_reduce_fx=None, item_shape=(g,), item_dtype=jnp.int32)
         self.add_state("gt_valid", default=[], dist_reduce_fx=None, item_shape=(g,), item_dtype=jnp.bool_)
+        self.add_state("gt_crowd", default=[], dist_reduce_fx=None, item_shape=(g,), item_dtype=jnp.bool_)
 
     def _pad_det(self, entry: Dict[str, Array]) -> Tuple[Array, Array, Array, Array]:
         boxes = jnp.asarray(entry["boxes"], dtype=jnp.float32).reshape(-1, 4)
@@ -107,11 +124,19 @@ class MeanAveragePrecision(Metric):
             jnp.pad(jnp.ones(n, dtype=bool), (0, pad)),
         )
 
-    def _pad_gt(self, entry: Dict[str, Array]) -> Tuple[Array, Array, Array]:
+    def _pad_gt(self, entry: Dict[str, Array]) -> Tuple[Array, Array, Array, Array]:
         boxes = jnp.asarray(entry["boxes"], dtype=jnp.float32).reshape(-1, 4)
         labels = jnp.asarray(entry["labels"], dtype=jnp.int32).reshape(-1)
         if boxes.shape[0] != labels.shape[0]:
             raise ValueError(f"gt boxes/labels disagree: {boxes.shape[0]}/{labels.shape[0]}")
+        crowd = entry.get("iscrowd")
+        crowd = (
+            jnp.zeros(labels.shape[0], dtype=bool)
+            if crowd is None
+            else jnp.asarray(crowd).reshape(-1).astype(bool)
+        )
+        if crowd.shape[0] != labels.shape[0]:
+            raise ValueError(f"gt iscrowd/labels disagree: {crowd.shape[0]}/{labels.shape[0]}")
         n, cap = boxes.shape[0], self.max_gt
         if n > cap:
             raise ValueError(f"image has {n} ground-truth boxes > max_gt={cap}")
@@ -120,6 +145,7 @@ class MeanAveragePrecision(Metric):
             jnp.pad(boxes, ((0, pad), (0, 0))),
             jnp.pad(labels, (0, pad)),
             jnp.pad(jnp.ones(n, dtype=bool), (0, pad)),
+            jnp.pad(crowd, (0, pad)),
         )
 
     def update(self, preds: List[Dict[str, Array]], target: List[Dict[str, Array]]) -> None:
@@ -127,7 +153,7 @@ class MeanAveragePrecision(Metric):
             raise ValueError(f"preds has {len(preds)} images, target {len(target)}")
         for det_entry, gt_entry in zip(preds, target):
             db, ds, dl, dv = self._pad_det(det_entry)
-            gb, gl, gv = self._pad_gt(gt_entry)
+            gb, gl, gv, gc = self._pad_gt(gt_entry)
             self._append("det_boxes", db[None])
             self._append("det_scores", ds[None])
             self._append("det_labels", dl[None])
@@ -135,16 +161,27 @@ class MeanAveragePrecision(Metric):
             self._append("gt_boxes", gb[None])
             self._append("gt_labels", gl[None])
             self._append("gt_valid", gv[None])
+            self._append("gt_crowd", gc[None])
 
     def compute(self) -> Dict[str, Array]:
+        from metrics_tpu.functional.detection.map import COCO_AREA_RANGES
+
+        k_largest = max(self.max_detection_thresholds)
+        per_class_keys = ("map_per_class", f"mar_{k_largest}_per_class")
         raw = self.det_boxes
         empty = isinstance(raw, (list, tuple)) and len(raw) == 0
         det_boxes = None if empty else as_values(raw)
         if empty or det_boxes.shape[0] == 0:
             nan = jnp.asarray(jnp.nan)
-            out = {"map": nan, "map_50": nan, "map_75": nan, "mar": nan}
+            out = {"map": nan, "map_50": nan, "map_75": nan}
+            for k in self.max_detection_thresholds:
+                out[f"mar_{k}"] = nan
+            for name, _, _ in COCO_AREA_RANGES[1:]:
+                out[f"map_{name}"] = nan
+                out[f"mar_{name}"] = nan
             if self.class_metrics:
-                out["map_per_class"] = jnp.full((self.num_classes,), jnp.nan)
+                for key in per_class_keys:
+                    out[key] = jnp.full((self.num_classes,), jnp.nan)
             return out
         args = (
             det_boxes,
@@ -158,9 +195,16 @@ class MeanAveragePrecision(Metric):
         fn = coco_map_padded
         if self._jit is not False and not self._jit_failed:
             fn = jax.jit(
-                coco_map_padded, static_argnames=("num_classes", "iou_thresholds")
+                coco_map_padded,
+                static_argnames=("num_classes", "iou_thresholds", "max_detection_thresholds"),
             )
-        out = fn(*args, num_classes=self.num_classes, iou_thresholds=self.iou_thresholds)
+        out = fn(
+            *args,
+            num_classes=self.num_classes,
+            iou_thresholds=self.iou_thresholds,
+            gt_crowd=as_values(self.gt_crowd),
+            max_detection_thresholds=self.max_detection_thresholds,
+        )
         if not self.class_metrics:
-            out = {k: v for k, v in out.items() if k != "map_per_class"}
+            out = {k: v for k, v in out.items() if k not in per_class_keys}
         return out

@@ -7,14 +7,22 @@ TPU-native re-design: everything is static-shape — images padded to
 masks — and the whole evaluation is ONE jittable program:
 
 * greedy COCO matching (each detection, in descending score order, takes
-  the not-yet-used same-class ground truth with the highest IoU that
-  clears the threshold) as a ``lax.scan`` over detection slots, vmapped
-  over images x classes x IoU thresholds;
+  the best-IoU available same-class ground truth clearing the threshold,
+  preferring un-ignored gts; crowd gts use intersection-over-detection-area
+  and are never consumed) as a ``lax.scan`` over detection slots, vmapped
+  over images x classes x IoU thresholds x area ranges;
 * per-class cross-image ranking as a masked global sort;
 * AP as the standard 101-point interpolated precision envelope.
 
-Semantics follow pycocotools for the supported configuration (no crowd
-annotations, single area range, one max-detections cap = the static D).
+Full pycocotools semantics: crowd annotations (``iscrowd``), the four COCO
+area ranges (all/small/medium/large — ground truths outside a range are
+ignore-flagged; detections matched to ignored gts, or unmatched with
+out-of-range area, count neither as TP nor FP), and the maxDets recall caps
+{1, 10, 100}, applied per (image, class) as pycocotools does. Matching runs
+once per area range at the largest cap; smaller caps select in-class rank
+< k (equivalent to truncating before matching, because greedy matching is
+sequential in score rank — the same slicing pycocotools' ``accumulate``
+does).
 """
 from typing import Optional, Tuple
 
@@ -25,27 +33,60 @@ from jax import Array, lax
 from metrics_tpu.functional.detection.iou import box_iou
 
 COCO_IOU_THRESHOLDS = tuple(round(0.5 + 0.05 * i, 2) for i in range(10))
+COCO_AREA_RANGES = (
+    ("all", 0.0, 1e10),
+    ("small", 0.0, 32.0**2),
+    ("medium", 32.0**2, 96.0**2),
+    ("large", 96.0**2, 1e10),
+)
+COCO_MAX_DETS = (1, 10, 100)
 _RECALL_GRID = 101
 
 
-def _match_one(iou_dg: Array, det_ok: Array, gt_ok: Array, thr: Array) -> Array:
-    """Greedy COCO matching for one (image, class, threshold) cell.
+def _box_area(boxes: Array) -> Array:
+    return jnp.clip(boxes[..., 2] - boxes[..., 0], 0) * jnp.clip(boxes[..., 3] - boxes[..., 1], 0)
 
-    ``iou_dg``: (D, G) IoU, detections already in descending-score order.
-    ``det_ok`` / ``gt_ok``: validity-and-class masks. Returns (D,) bool TP
-    flags.
+
+def _crowd_iou(det_boxes: Array, gt_boxes: Array) -> Array:
+    """(D, G) intersection over DETECTION area — pycocotools' crowd overlap."""
+    lt = jnp.maximum(det_boxes[:, None, :2], gt_boxes[None, :, :2])
+    rb = jnp.minimum(det_boxes[:, None, 2:], gt_boxes[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    d_area = _box_area(det_boxes)[:, None]
+    return jnp.where(d_area > 0, inter / jnp.where(d_area > 0, d_area, 1.0), 0.0)
+
+
+def _match_one(
+    iou_dg: Array, det_ok: Array, gt_ok: Array, gt_ignore: Array, gt_crowd: Array, thr: Array
+) -> Tuple[Array, Array]:
+    """Greedy COCO matching for one (area, threshold, class, image) cell.
+
+    ``iou_dg``: (D, G) overlap (crowd semantics pre-applied per column),
+    detections already in descending-score order. ``det_ok``/``gt_ok``:
+    validity-and-class masks; ``gt_ignore``: ignore-flagged subset (crowd or
+    out-of-area); ``gt_crowd``: never-consumed columns. Preference follows
+    pycocotools: best IoU >= thr among available un-ignored gts, else among
+    available ignored gts. Returns ``(matched_unignored, matched_ignored)``
+    (D,) bool flags.
     """
 
     def step(unused, inputs):
         iou_row, ok = inputs
-        cand = jnp.where(gt_ok & (unused > 0), iou_row, -1.0)
-        best = jnp.argmax(cand)
-        matched = ok & (cand[best] >= thr)
-        unused = unused.at[best].set(jnp.where(matched, 0.0, unused[best]))
-        return unused, matched
+        avail = gt_ok & ((unused > 0) | gt_crowd)
+        cand_u = jnp.where(avail & ~gt_ignore, iou_row, -1.0)
+        cand_i = jnp.where(avail & gt_ignore, iou_row, -1.0)
+        best_u = jnp.argmax(cand_u)
+        best_i = jnp.argmax(cand_i)
+        mu = ok & (cand_u[best_u] >= thr)
+        mi = ok & ~mu & (cand_i[best_i] >= thr)
+        chosen = jnp.where(mu, best_u, best_i)
+        consume = (mu | mi) & ~gt_crowd[chosen]
+        unused = unused.at[chosen].set(jnp.where(consume, 0.0, unused[chosen]))
+        return unused, (mu, mi)
 
-    _, tp = lax.scan(step, jnp.ones(iou_dg.shape[1]), (iou_dg, det_ok))
-    return tp
+    _, (mu, mi) = lax.scan(step, jnp.ones(iou_dg.shape[1]), (iou_dg, det_ok))
+    return mu, mi
 
 
 def _interp_ap(tp_sorted: Array, fp_sorted: Array, n_gt: Array) -> Array:
@@ -71,8 +112,11 @@ def coco_map_padded(
     gt_boxes: Array, gt_labels: Array, gt_valid: Array,
     num_classes: int,
     iou_thresholds: Tuple[float, ...] = COCO_IOU_THRESHOLDS,
+    gt_crowd: Optional[Array] = None,
+    max_detection_thresholds: Tuple[int, ...] = COCO_MAX_DETS,
+    area_ranges: Tuple[Tuple[str, float, float], ...] = COCO_AREA_RANGES,
 ) -> dict:
-    """COCO mAP over padded per-image box sets (all shapes static).
+    """COCO mAP/mAR over padded per-image box sets (all shapes static).
 
     Args:
         det_boxes: ``(I, D, 4)`` xyxy detections per image (padded).
@@ -81,14 +125,23 @@ def coco_map_padded(
         gt_boxes: ``(I, G, 4)``; gt_labels / gt_valid: ``(I, G)``.
         num_classes: static class count (labels in ``[0, num_classes)``).
         iou_thresholds: static tuple (default COCO 0.50:0.05:0.95).
+        gt_crowd: ``(I, G)`` bool ``iscrowd`` flags (None -> no crowds).
+        max_detection_thresholds: recall caps (default COCO {1, 10, 100});
+            the largest also caps the AP ranking (clipped to D).
+        area_ranges: named (lo, hi) box-area ranges; ``area_ranges[0]``
+            ("all") feeds the headline map/mar keys.
 
     Returns:
-        dict with ``map`` (mean over classes and thresholds), ``map_50``,
-        ``map_75``, ``mar`` (mean max recall), and ``map_per_class``
-        ``(num_classes,)`` (nan for classes without ground truth).
+        dict with ``map``, ``map_50``, ``map_75``, per-size
+        ``map_<name>``, ``mar_<k>`` per cap, per-size ``mar_<name>`` (at
+        the largest cap), and per-class ``map_per_class`` /
+        ``mar_<kmax>_per_class`` ``(num_classes,)`` vectors (nan for
+        classes without ground truth).
     """
     n_img, n_det = det_scores.shape
     thrs = jnp.asarray(iou_thresholds, dtype=jnp.float32)
+    if gt_crowd is None:
+        gt_crowd = jnp.zeros(gt_valid.shape, dtype=bool)
 
     # rank detections inside each image once (descending score; ghosts last)
     order = jnp.argsort(-jnp.where(det_valid, det_scores, -jnp.inf), axis=1)
@@ -99,54 +152,100 @@ def coco_map_padded(
     det_valid = take(det_valid, order)
 
     iou = jax.vmap(box_iou)(det_boxes, gt_boxes)  # (I, D, G)
+    iou_cr = jax.vmap(_crowd_iou)(det_boxes, gt_boxes)
+    iou_eff = jnp.where(gt_crowd[:, None, :], iou_cr, iou)
+
+    det_area = _box_area(det_boxes)  # (I, D)
+    gt_area = _box_area(gt_boxes)  # (I, G)
+    lo = jnp.asarray([r[1] for r in area_ranges], jnp.float32)
+    hi = jnp.asarray([r[2] for r in area_ranges], jnp.float32)
+    # (A, I, G): ignore-flagged gts per range (crowd or out-of-range area)
+    gt_ig = gt_crowd[None] | (gt_area[None] < lo[:, None, None]) | (gt_area[None] > hi[:, None, None])
+    # (A, I, D): detections outside the range (ignored only when unmatched)
+    det_out = (det_area[None] < lo[:, None, None]) | (det_area[None] > hi[:, None, None])
 
     classes = jnp.arange(num_classes)
 
-    def per_cell(img_iou, d_lab, d_ok, g_lab, g_ok, cls, thr):
-        det_ok = d_ok & (d_lab == cls)
-        gt_ok = g_ok & (g_lab == cls)
+    # COCO's maxDets caps detections per (image, CLASS): rank each det among
+    # same-class dets of its image (dets are score-sorted within the image,
+    # so within-class order is descending too) and drop ranks >= maxDets[-1].
+    # Smaller caps select rank < k below — equivalent to truncating before
+    # matching, because greedy matching is sequential in rank.
+    k_max = max(max_detection_thresholds)
+    det_cls_raw = det_valid[None, :, :] & (det_labels[None, :, :] == classes[:, None, None])  # (C, I, D)
+    rank_ic = jnp.cumsum(det_cls_raw, axis=-1) - 1  # (C, I, D) rank within (image, class)
+    det_cls_ok = det_cls_raw & (rank_ic < k_max)
+
+    def per_cell(img_iou, d_ok_c, g_lab, g_ok, g_ig, g_crowd, cls, thr):
+        gt_cls = g_ok & (g_lab == cls)
         # ghost/other-class gt columns must never match
-        masked = jnp.where(gt_ok[None, :], img_iou, -1.0)
-        return _match_one(masked, det_ok, gt_ok, thr)
+        masked = jnp.where(gt_cls[None, :], img_iou, -1.0)
+        return _match_one(masked, d_ok_c, gt_cls, g_ig, g_crowd, thr)
 
-    # vmap over thresholds <- classes <- images
-    per_img = jax.vmap(per_cell, in_axes=(0, 0, 0, 0, 0, None, None))
-    per_class = jax.vmap(per_img, in_axes=(None, None, None, None, None, 0, None))
-    per_thr = jax.vmap(per_class, in_axes=(None, None, None, None, None, None, 0))
-    tp = per_thr(iou, det_labels, det_valid, gt_labels, gt_valid, classes, thrs)
-    # tp: (T, C, I, D) bool
+    # vmap over area ranges <- thresholds <- classes <- images
+    per_img = jax.vmap(per_cell, in_axes=(0, 0, 0, 0, 0, 0, None, None))
+    per_class = jax.vmap(per_img, in_axes=(None, 0, None, None, None, None, 0, None))
+    per_thr = jax.vmap(per_class, in_axes=(None, None, None, None, None, None, None, 0))
+    per_area = jax.vmap(per_thr, in_axes=(None, None, None, None, 0, None, None, None))
+    mu, mi = per_area(iou_eff, det_cls_ok, gt_labels, gt_valid, gt_ig, gt_crowd, classes, thrs)
+    # mu/mi: (A, T, C, I, D) bool — matched to unignored / ignored gt
 
-    det_cls_ok = det_valid[None, :, :] & (det_labels[None, :, :] == classes[:, None, None])  # (C, I, D)
-    n_gt = jnp.sum(gt_valid[None, :, :] & (gt_labels[None, :, :] == classes[:, None, None]),
-                   axis=(1, 2)).astype(jnp.float32)  # (C,)
+    n_area = len(area_ranges)
+    n_thr = len(iou_thresholds)
+    m = n_img * n_det
+    # (A, C): un-ignored ground truths per range
+    gt_cls = gt_valid[None, None] & (gt_labels[None, None] == classes[None, :, None, None])  # (1, C, I, G)
+    n_gt = jnp.sum(gt_cls & ~gt_ig[:, None], axis=(2, 3)).astype(jnp.float32)  # (A, C)
 
-    # per-class global ranking across images (threshold-independent)
+    # per-class global ranking across images (threshold/area-independent)
     flat_scores = jnp.broadcast_to(det_scores[None], det_cls_ok.shape).reshape(num_classes, -1)
     flat_ok = det_cls_ok.reshape(num_classes, -1)
-    cls_order = jnp.argsort(-jnp.where(flat_ok, flat_scores, -jnp.inf), axis=1)  # (C, I*D)
+    cls_order = jnp.argsort(-jnp.where(flat_ok, flat_scores, -jnp.inf), axis=1)  # (C, M)
 
-    tp_flat = tp.reshape(len(iou_thresholds), num_classes, -1)  # (T, C, I*D)
-    ok_sorted = jnp.take_along_axis(flat_ok, cls_order, axis=1)  # (C, I*D)
+    ok_sorted = jnp.take_along_axis(flat_ok, cls_order, axis=1)  # (C, M)
+    mu_sorted = jnp.take_along_axis(mu.reshape(n_area, n_thr, num_classes, m), cls_order[None, None], axis=-1)
+    mi_sorted = jnp.take_along_axis(mi.reshape(n_area, n_thr, num_classes, m), cls_order[None, None], axis=-1)
+    out_flat = jnp.broadcast_to(det_out[:, None], (n_area, num_classes, n_img, n_det)).reshape(n_area, num_classes, m)
+    out_sorted = jnp.take_along_axis(out_flat, cls_order[None], axis=-1)  # (A, C, M)
 
-    def ap_cell(tp_c, ok_s, order_c, n):
-        tp_s = tp_c[order_c].astype(jnp.float32)
-        fp_s = (ok_s & ~tp_c[order_c]).astype(jnp.float32)
-        return _interp_ap(tp_s, fp_s, n)
+    tp_sorted = mu_sorted.astype(jnp.float32)
+    # FP = participating, unmatched, and not ignored (matched-to-ignored and
+    # unmatched-out-of-range detections count neither way)
+    fp_sorted = (
+        ok_sorted[None, None] & ~mu_sorted & ~mi_sorted & ~out_sorted[:, None]
+    ).astype(jnp.float32)
 
-    ap_class = jax.vmap(jax.vmap(ap_cell, in_axes=(0, 0, 0, 0)),
-                        in_axes=(0, None, None, None))(tp_flat, ok_sorted, cls_order, n_gt)
-    # ap_class: (T, C)
+    ap_cell = jax.vmap(_interp_ap, in_axes=(0, 0, 0))  # over classes
+    ap_thr = jax.vmap(ap_cell, in_axes=(0, 0, None))  # over thresholds
+    ap_area = jax.vmap(ap_thr, in_axes=(0, 0, 0))  # over area ranges
+    ap = ap_area(tp_sorted, fp_sorted, n_gt)  # (A, T, C)
 
-    recall_ct = tp.sum(axis=(2, 3)).astype(jnp.float32) / jnp.maximum(n_gt[None, :], 1.0)  # (T, C)
-    recall_ct = jnp.where(n_gt[None, :] > 0, recall_ct, jnp.nan)
+    def recall_at(k: int) -> Array:
+        """(A, T, C) recall with at most k same-class detections per image."""
+        within = rank_ic < k  # (C, I, D)
+        r = (mu & within[None, None]).sum(axis=(3, 4)).astype(jnp.float32) / jnp.maximum(
+            n_gt[:, None], 1.0
+        )
+        return jnp.where(n_gt[:, None] > 0, r, jnp.nan)
+
+    recalls = {k: recall_at(k) for k in max_detection_thresholds}
+    k_largest = max(max_detection_thresholds)
+    rec_max = recalls[k_largest]
 
     t50 = iou_thresholds.index(0.5) if 0.5 in iou_thresholds else None
     t75 = iou_thresholds.index(0.75) if 0.75 in iou_thresholds else None
     out = {
-        "map": jnp.nanmean(ap_class),
-        "map_per_class": jnp.nanmean(ap_class, axis=0),
-        "mar": jnp.nanmean(recall_ct),
+        "map": jnp.nanmean(ap[0]),
+        "map_50": jnp.nanmean(ap[0, t50]) if t50 is not None else jnp.asarray(jnp.nan),
+        "map_75": jnp.nanmean(ap[0, t75]) if t75 is not None else jnp.asarray(jnp.nan),
+        "map_per_class": jnp.nanmean(ap[0], axis=0),
+        f"mar_{k_largest}_per_class": jnp.nanmean(rec_max[0], axis=0),
     }
-    out["map_50"] = jnp.nanmean(ap_class[t50]) if t50 is not None else jnp.asarray(jnp.nan)
-    out["map_75"] = jnp.nanmean(ap_class[t75]) if t75 is not None else jnp.asarray(jnp.nan)
+    for k, rec in recalls.items():
+        out[f"mar_{k}"] = jnp.nanmean(rec[0])
+    for a, (name, _, _) in enumerate(area_ranges):
+        if name == "all":
+            continue
+        out[f"map_{name}"] = jnp.nanmean(ap[a])
+        out[f"mar_{name}"] = jnp.nanmean(rec_max[a])
     return out

@@ -1,101 +1,179 @@
 """COCO mAP engine vs an independent numpy implementation + hand cases.
 
 The numpy oracle below follows the pycocotools algorithm structure
-(per-image/per-class greedy matching loops, 101-point interpolation) and is
-deliberately written loop-wise — a second, independent derivation of the
-same semantics, since pycocotools itself is not in the image.
+(per-image/per-class greedy matching loops with crowd/area-ignore handling,
+maxDets slicing, 101-point interpolation) and is deliberately written
+loop-wise — a second, independent derivation of the same semantics, since
+pycocotools itself is not in the image. Because oracle and kernel share an
+author, the hand-fixture tests below pin expected values derived on paper
+(crowd, area-range, and maxDets cases each have a hand-computed constant).
 """
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from metrics_tpu.functional.detection.iou import box_iou
-from metrics_tpu.functional.detection.map import COCO_IOU_THRESHOLDS, coco_map_padded
+from metrics_tpu.functional.detection.map import (
+    COCO_AREA_RANGES,
+    COCO_IOU_THRESHOLDS,
+    COCO_MAX_DETS,
+    coco_map_padded,
+)
 
 
-def _np_iou(a, b):
+def _np_area(boxes):
+    return np.clip(boxes[:, 2] - boxes[:, 0], 0, None) * np.clip(boxes[:, 3] - boxes[:, 1], 0, None)
+
+
+def _np_iou(a, b, crowd=None):
+    """(N, M) IoU; columns flagged in ``crowd`` use intersection/det-area."""
     inter_lt = np.maximum(a[:, None, :2], b[None, :, :2])
     inter_rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
     wh = np.clip(inter_rb - inter_lt, 0, None)
     inter = wh[..., 0] * wh[..., 1]
-    area_a = np.clip(a[:, 2] - a[:, 0], 0, None) * np.clip(a[:, 3] - a[:, 1], 0, None)
-    area_b = np.clip(b[:, 2] - b[:, 0], 0, None) * np.clip(b[:, 3] - b[:, 1], 0, None)
+    area_a = _np_area(a)
+    area_b = _np_area(b)
     union = area_a[:, None] + area_b[None, :] - inter
-    return np.where(union > 0, inter / np.where(union > 0, union, 1), 0.0)
+    iou = np.where(union > 0, inter / np.where(union > 0, union, 1), 0.0)
+    if crowd is not None and crowd.any():
+        da = np.where(area_a > 0, area_a, 1.0)[:, None]
+        iou_cr = np.where(area_a[:, None] > 0, inter / da, 0.0)
+        iou = np.where(crowd[None, :], iou_cr, iou)
+    return iou
 
 
-def _np_coco_map(images, num_classes, thresholds=COCO_IOU_THRESHOLDS):
-    """images: list of (det_boxes, det_scores, det_labels, gt_boxes, gt_labels)."""
-    aps = np.full((len(thresholds), num_classes), np.nan)
-    recalls = np.full((len(thresholds), num_classes), np.nan)
-    for ci in range(num_classes):
-        n_gt = sum(int((g_lab == ci).sum()) for *_, g_lab in
-                   [(im[3], im[4]) for im in images])
-        n_gt = sum(int((im[4] == ci).sum()) for im in images)
-        for ti, thr in enumerate(thresholds):
-            records = []  # (score, is_tp)
-            for det_boxes, det_scores, det_labels, gt_boxes, gt_labels in images:
+def _with_crowd(images):
+    """Normalize 5-tuples (no crowd) to 6-tuples."""
+    out = []
+    for im in images:
+        if len(im) == 5:
+            im = (*im, np.zeros(len(im[4]), dtype=bool))
+        out.append(im)
+    return out
+
+
+def _np_coco_map(images, num_classes, thresholds=COCO_IOU_THRESHOLDS,
+                 max_dets=COCO_MAX_DETS, area_ranges=COCO_AREA_RANGES):
+    """Full pycocotools-semantics oracle over
+    ``(det_boxes, det_scores, det_labels, gt_boxes, gt_labels[, gt_crowd])``."""
+    images = _with_crowd(images)
+    n_area = len(area_ranges)
+    n_thr = len(thresholds)
+    k_max = max(max_dets)
+    aps = np.full((n_area, n_thr, num_classes), np.nan)
+    recs = {k: np.full((n_area, n_thr, num_classes), np.nan) for k in max_dets}
+
+    for ai, (_, lo, hi) in enumerate(area_ranges):
+        for ci in range(num_classes):
+            n_gt = 0
+            per_img = []  # (scores, tp(T, nd), ig(T, nd)) in per-image rank order
+            for det_boxes, det_scores, det_labels, gt_boxes, gt_labels, gt_crowd in images:
                 d_idx = np.where(det_labels == ci)[0]
+                d_idx = d_idx[np.argsort(-det_scores[d_idx], kind="stable")][:k_max]
                 g_idx = np.where(gt_labels == ci)[0]
-                d_idx = d_idx[np.argsort(-det_scores[d_idx], kind="stable")]
-                ious = _np_iou(det_boxes[d_idx], gt_boxes[g_idx]) if len(d_idx) and len(g_idx) \
-                    else np.zeros((len(d_idx), len(g_idx)))
-                used = np.zeros(len(g_idx), dtype=bool)
-                for row, d in enumerate(d_idx):
-                    best, best_iou = -1, float(thr)
-                    for col in range(len(g_idx)):
-                        if used[col] or ious[row, col] < best_iou:
-                            continue
-                        best, best_iou = col, ious[row, col]
-                    if best >= 0:
-                        used[best] = True
-                        records.append((det_scores[d], True))
-                    else:
-                        records.append((det_scores[d], False))
+                g_crowd = gt_crowd[g_idx].astype(bool)
+                g_area = _np_area(gt_boxes[g_idx])
+                g_ig = g_crowd | (g_area < lo) | (g_area > hi)
+                # pycocotools sorts gts unignored-first before matching
+                g_order = np.argsort(g_ig, kind="stable")
+                g_idx, g_ig, g_crowd = g_idx[g_order], g_ig[g_order], g_crowd[g_order]
+                n_gt += int((~g_ig).sum())
+
+                ious = (_np_iou(det_boxes[d_idx], gt_boxes[g_idx], g_crowd)
+                        if len(d_idx) and len(g_idx) else np.zeros((len(d_idx), len(g_idx))))
+                d_area = _np_area(det_boxes[d_idx])
+                d_out = (d_area < lo) | (d_area > hi)
+                tp = np.zeros((n_thr, len(d_idx)), bool)
+                ig = np.zeros((n_thr, len(d_idx)), bool)
+                for ti, thr in enumerate(thresholds):
+                    used = np.zeros(len(g_idx), bool)
+                    for r in range(len(d_idx)):
+                        best, best_iou = -1, float(thr)
+                        for c in range(len(g_idx)):
+                            if used[c] and not g_crowd[c]:
+                                continue
+                            # unignored match found and rest are ignored: stop
+                            if best >= 0 and not g_ig[best] and g_ig[c]:
+                                break
+                            if ious[r, c] < best_iou:
+                                continue
+                            best, best_iou = c, ious[r, c]
+                        if best >= 0:
+                            used[best] = True
+                            (ig if g_ig[best] else tp)[ti, r] = True
+                        elif d_out[r]:
+                            ig[ti, r] = True
+                per_img.append((det_scores[d_idx], tp, ig))
+
             if n_gt == 0:
                 continue
-            records.sort(key=lambda r: -r[0])
-            tp = np.cumsum([r[1] for r in records]) if records else np.zeros(0)
-            fp = np.cumsum([not r[1] for r in records]) if records else np.zeros(0)
-            recall = tp / n_gt if len(tp) else np.zeros(0)
-            precision = tp / np.maximum(tp + fp, 1e-30) if len(tp) else np.zeros(0)
-            # envelope + 101-point sampling (pycocotools accumulate())
-            for i in range(len(precision) - 1, 0, -1):
-                precision[i - 1] = max(precision[i - 1], precision[i])
-            q = np.zeros(101)
-            inds = np.searchsorted(recall, np.linspace(0, 1, 101), side="left")
-            for k, pi in enumerate(inds):
-                if pi < len(precision):
-                    q[k] = precision[pi]
-            aps[ti, ci] = q.mean()
-            recalls[ti, ci] = recall[-1] if len(recall) else 0.0
-    return {
-        "map": np.nanmean(aps),
-        "map_50": np.nanmean(aps[thresholds.index(0.5)]),
-        "map_75": np.nanmean(aps[thresholds.index(0.75)]),
-        "mar": np.nanmean(recalls),
-        "map_per_class": np.nanmean(aps, axis=0),
+            for k in max_dets:
+                for ti in range(n_thr):
+                    total_tp = sum(tp[ti, :k].sum() for _, tp, _ in per_img)
+                    recs[k][ai, ti, ci] = total_tp / n_gt
+            # global ranking for AP (ignored dets contribute neither way)
+            scores = np.concatenate([s for s, _, _ in per_img]) if per_img else np.zeros(0)
+            order = np.argsort(-scores, kind="stable")
+            for ti in range(n_thr):
+                tp_flat = np.concatenate([tp[ti] for _, tp, _ in per_img])[order]
+                ig_flat = np.concatenate([ig[ti] for _, _, ig in per_img])[order]
+                keep = ~ig_flat
+                tps = np.cumsum(tp_flat[keep])
+                fps = np.cumsum(~tp_flat[keep])
+                recall = tps / n_gt if len(tps) else np.zeros(0)
+                precision = tps / np.maximum(tps + fps, 1e-30) if len(tps) else np.zeros(0)
+                for i in range(len(precision) - 1, 0, -1):
+                    precision[i - 1] = max(precision[i - 1], precision[i])
+                q = np.zeros(101)
+                inds = np.searchsorted(recall, np.linspace(0, 1, 101), side="left")
+                for kk, pi in enumerate(inds):
+                    if pi < len(precision):
+                        q[kk] = precision[pi]
+                aps[ai, ti, ci] = q.mean()
+
+    k_largest = max(max_dets)
+    out = {
+        "map": np.nanmean(aps[0]),
+        "map_50": np.nanmean(aps[0, thresholds.index(0.5)]),
+        "map_75": np.nanmean(aps[0, thresholds.index(0.75)]),
+        "map_per_class": np.nanmean(aps[0], axis=0),
+        f"mar_{k_largest}_per_class": np.nanmean(recs[k_largest][0], axis=0),
     }
+    for k in max_dets:
+        out[f"mar_{k}"] = np.nanmean(recs[k][0])
+    for ai, (name, _, _) in enumerate(area_ranges):
+        if name == "all":
+            continue
+        out[f"map_{name}"] = np.nanmean(aps[ai])
+        out[f"mar_{name}"] = np.nanmean(recs[k_largest][ai])
+    return out
+
+
+_FULL_KEYS = (
+    "map", "map_50", "map_75", "map_small", "map_medium", "map_large",
+    "mar_1", "mar_10", "mar_100", "mar_small", "mar_medium", "mar_large",
+)
 
 
 def _pad_images(images, num_classes, d_cap, g_cap):
+    images = _with_crowd(images)
     I = len(images)
     db = np.zeros((I, d_cap, 4), np.float32); ds = np.zeros((I, d_cap), np.float32)
     dl = np.zeros((I, d_cap), np.int32); dv = np.zeros((I, d_cap), bool)
     gb = np.zeros((I, g_cap, 4), np.float32); gl = np.zeros((I, g_cap), np.int32)
-    gv = np.zeros((I, g_cap), bool)
-    for i, (dbx, dsc, dlb, gbx, glb) in enumerate(images):
+    gv = np.zeros((I, g_cap), bool); gc = np.zeros((I, g_cap), bool)
+    for i, (dbx, dsc, dlb, gbx, glb, gcr) in enumerate(images):
         nd, ng = len(dsc), len(glb)
         db[i, :nd] = dbx; ds[i, :nd] = dsc; dl[i, :nd] = dlb; dv[i, :nd] = True
-        gb[i, :ng] = gbx; gl[i, :ng] = glb; gv[i, :ng] = True
+        gb[i, :ng] = gbx; gl[i, :ng] = glb; gv[i, :ng] = True; gc[i, :ng] = gcr
     return (jnp.asarray(db), jnp.asarray(ds), jnp.asarray(dl), jnp.asarray(dv),
-            jnp.asarray(gb), jnp.asarray(gl), jnp.asarray(gv))
+            jnp.asarray(gb), jnp.asarray(gl), jnp.asarray(gv), jnp.asarray(gc))
 
 
 def _run(images, num_classes, d_cap=12, g_cap=10):
     args = _pad_images(images, num_classes, d_cap, g_cap)
-    return {k: np.asarray(v) for k, v in
-            coco_map_padded(*args, num_classes=num_classes).items()}
+    out = coco_map_padded(*args[:7], num_classes=num_classes, gt_crowd=args[7])
+    return {k: np.asarray(v) for k, v in out.items()}
 
 
 def test_perfect_predictions():
@@ -104,7 +182,11 @@ def test_perfect_predictions():
     out = _run(images, num_classes=2)
     assert out["map"] == pytest.approx(1.0)
     assert out["map_50"] == pytest.approx(1.0)
-    assert out["mar"] == pytest.approx(1.0)
+    assert out["mar_100"] == pytest.approx(1.0)
+    assert out["mar_1"] == pytest.approx(1.0)  # one det per image per class
+    # both boxes are "small" (area 100): the small slice carries everything
+    assert out["map_small"] == pytest.approx(1.0)
+    assert np.isnan(out["map_medium"]) and np.isnan(out["map_large"])
 
 
 def test_iou_threshold_cutoff():
@@ -136,7 +218,7 @@ def test_missed_gt_caps_recall():
     det = np.array([[0, 0, 10, 10]], np.float32)
     images = [(det, np.array([0.9], np.float32), np.array([0]), gt, np.array([0, 0]))]
     out = _run(images, num_classes=1)
-    assert out["mar"] == pytest.approx(0.5)
+    assert out["mar_100"] == pytest.approx(0.5)
     # precision 1 up to recall 0.5, then nothing: 51 of 101 points at 1.0
     assert out["map"] == pytest.approx(51 / 101, abs=1e-6)
 
@@ -150,6 +232,81 @@ def test_double_detection_is_fp():
     assert out["map"] == pytest.approx(1.0)  # TP first; trailing FP doesn't dent the envelope
 
 
+def test_crowd_gt_absorbs_would_be_fp():
+    """Hand case: a high-scoring detection inside a crowd region is IGNORED
+    (neither TP nor FP), so the real TP keeps AP at 1.0; without crowd
+    semantics the leading FP would halve it to 0.5. The crowd gt does not
+    count toward n_gt (mar over the one real gt = 1.0)."""
+    gt = np.array([[0, 0, 10, 10], [20, 20, 60, 60]], np.float32)
+    crowd = np.array([False, True])
+    det = np.array([[25, 25, 35, 35],   # fully inside the crowd box
+                    [0, 0, 10, 10]], np.float32)
+    images = [(det, np.array([0.95, 0.9], np.float32), np.array([0, 0]),
+               gt, np.array([0, 0]), crowd)]
+    out = _run(images, num_classes=1)
+    assert out["map"] == pytest.approx(1.0)
+    assert out["mar_100"] == pytest.approx(1.0)
+    # the same detections WITHOUT the crowd flag: the region box becomes a
+    # real gt (n_gt=2), the 0.95 det is a leading FP (IoU 100/1600 = 0.0625),
+    # recall caps at 0.5 with precision 1/2 -> AP = 51 * 0.5 / 101
+    images_nc = [(det, np.array([0.95, 0.9], np.float32), np.array([0, 0]),
+                  gt, np.array([0, 0]))]
+    out_nc = _run(images_nc, num_classes=1)
+    assert out_nc["map"] == pytest.approx(51 * 0.5 / 101, abs=1e-6)
+
+
+def test_crowd_matches_many_detections():
+    """Hand case: two detections inside one crowd gt are BOTH ignored (a
+    crowd is never consumed); the class has no real gt -> all-nan map."""
+    gt = np.array([[0, 0, 100, 100]], np.float32)
+    det = np.array([[10, 10, 20, 20], [30, 30, 40, 40]], np.float32)
+    images = [(det, np.array([0.9, 0.8], np.float32), np.array([0, 0]),
+               gt, np.array([0]), np.array([True]))]
+    out = _run(images, num_classes=1)
+    assert np.isnan(out["map"])  # no un-ignored ground truth anywhere
+
+
+def test_area_ranges_split():
+    """Hand case: one small (10x10=100) and one large (200x200=40000) gt,
+    each matched exactly. Every per-size slice that has gts scores 1.0; the
+    out-of-range pair is ignore-flagged away, never an FP."""
+    gt = np.array([[0, 0, 10, 10], [300, 300, 500, 500]], np.float32)
+    det = gt.copy()
+    images = [(det, np.array([0.9, 0.8], np.float32), np.array([0, 0]),
+               gt, np.array([0, 0]))]
+    out = _run(images, num_classes=1)
+    assert out["map"] == pytest.approx(1.0)
+    assert out["map_small"] == pytest.approx(1.0)
+    assert out["map_large"] == pytest.approx(1.0)
+    assert np.isnan(out["map_medium"])  # no gt with area in [32^2, 96^2]
+    assert out["mar_small"] == pytest.approx(1.0)
+    assert out["mar_large"] == pytest.approx(1.0)
+    assert np.isnan(out["mar_medium"])
+
+
+def test_max_dets_recall_caps():
+    """Hand case: top-1 detection is an FP, the TP ranks second -> mar_1 is
+    0 (only the FP survives the cap) while mar_10/mar_100 recover the gt."""
+    gt = np.array([[0, 0, 10, 10]], np.float32)
+    det = np.array([[50, 50, 60, 60], [0, 0, 10, 10]], np.float32)
+    images = [(det, np.array([0.9, 0.8], np.float32), np.array([0, 0]),
+               gt, np.array([0]))]
+    out = _run(images, num_classes=1)
+    assert out["mar_1"] == pytest.approx(0.0)
+    assert out["mar_10"] == pytest.approx(1.0)
+    assert out["mar_100"] == pytest.approx(1.0)
+
+
+def test_result_keys_full_coco_surface():
+    gt = np.array([[0, 0, 10, 10]], np.float32)
+    images = [(gt, np.array([0.9], np.float32), np.array([0]), gt, np.array([0]))]
+    out = _run(images, num_classes=1)
+    for key in _FULL_KEYS:
+        assert key in out, key
+    assert out["map_per_class"].shape == (1,)
+    assert out["mar_100_per_class"].shape == (1,)
+
+
 @pytest.mark.parametrize("seed", [0, 1, 2, 3])
 def test_random_vs_numpy_oracle(seed):
     rng = np.random.RandomState(seed)
@@ -160,6 +317,7 @@ def test_random_vs_numpy_oracle(seed):
         gt = np.sort(rng.rand(ng, 2, 2) * 50, axis=1).reshape(ng, 4).astype(np.float32)
         gt[:, 2:] += 2.0  # non-degenerate
         glab = rng.randint(0, num_classes, ng)
+        crowd = rng.rand(ng) < 0.2
         nd = rng.randint(0, 9)
         # half jittered copies of gts, half random
         det, dlab = [], []
@@ -172,13 +330,13 @@ def test_random_vs_numpy_oracle(seed):
                 det.append(b); dlab.append(rng.randint(0, num_classes))
         det = np.asarray(det, np.float32).reshape(nd, 4)
         scores = rng.rand(nd).astype(np.float32)  # distinct w.p. 1
-        images.append((det, scores, np.asarray(dlab, np.int64), gt, glab))
+        images.append((det, scores, np.asarray(dlab, np.int64), gt, glab, crowd))
     got = _run(images, num_classes)
     want = _np_coco_map(images, num_classes)
-    for key in ("map", "map_50", "map_75", "mar"):
-        np.testing.assert_allclose(got[key], want[key], atol=1e-5, err_msg=key)
-    np.testing.assert_allclose(got["map_per_class"], want["map_per_class"],
-                               atol=1e-5, equal_nan=True)
+    for key in _FULL_KEYS:
+        np.testing.assert_allclose(got[key], want[key], atol=1e-5, err_msg=key, equal_nan=True)
+    for key in ("map_per_class", "mar_100_per_class"):
+        np.testing.assert_allclose(got[key], want[key], atol=1e-5, equal_nan=True, err_msg=key)
 
 
 def test_iou_kernels():
@@ -197,5 +355,5 @@ def test_map_jit():
     det = np.array([[0, 0, 10, 10]], np.float32)
     images = [(det, np.array([0.9], np.float32), np.array([0]), gt, np.array([0]))]
     args = _pad_images(images, 1, 4, 4)
-    out = jax.jit(lambda *a: coco_map_padded(*a, num_classes=1))(*args)
+    out = jax.jit(lambda *a: coco_map_padded(*a[:7], num_classes=1, gt_crowd=a[7]))(*args)
     assert float(out["map"]) == pytest.approx(1.0)

@@ -48,10 +48,46 @@ def test_streaming_matches_oracle():
     _feed(m, images[3:])
     got = {k: np.asarray(v) for k, v in m.compute().items()}
     want = _np_coco_map(images, 3)
-    for key in ("map", "map_50", "map_75", "mar"):
-        np.testing.assert_allclose(got[key], want[key], atol=1e-5, err_msg=key)
+    for key in ("map", "map_50", "map_75", "mar_1", "mar_10", "mar_100",
+                "map_small", "map_medium", "map_large", "mar_small", "mar_medium", "mar_large"):
+        np.testing.assert_allclose(got[key], want[key], atol=1e-5, err_msg=key, equal_nan=True)
     np.testing.assert_allclose(got["map_per_class"], want["map_per_class"],
                                atol=1e-5, equal_nan=True)
+
+
+def test_crowd_through_update_dicts():
+    """`iscrowd` in a target dict flows into the engine: a detection inside
+    the crowd region is ignored instead of counting as a leading FP."""
+    gt = np.array([[0, 0, 10, 10], [20, 20, 60, 60]], np.float32)
+    det = np.array([[25, 25, 35, 35], [0, 0, 10, 10]], np.float32)
+    m = MeanAveragePrecision(num_classes=1, max_detections=4, max_gt=4)
+    m.update(
+        [{"boxes": jnp.asarray(det), "scores": jnp.asarray([0.95, 0.9]),
+          "labels": jnp.asarray([0, 0])}],
+        [{"boxes": jnp.asarray(gt), "labels": jnp.asarray([0, 0]),
+          "iscrowd": jnp.asarray([False, True])}],
+    )
+    out = m.compute()
+    assert float(out["map"]) == pytest.approx(1.0)
+    assert float(out["mar_100"]) == pytest.approx(1.0)
+
+
+def test_max_detection_thresholds_knob():
+    """Custom maxDets thresholds produce matching mar_<k> keys."""
+    gt = np.array([[0, 0, 10, 10]], np.float32)
+    det = np.array([[50, 50, 60, 60], [0, 0, 10, 10]], np.float32)
+    m = MeanAveragePrecision(num_classes=1, max_detections=4, max_gt=4,
+                             max_detection_thresholds=(1, 2))
+    m.update(
+        [{"boxes": jnp.asarray(det), "scores": jnp.asarray([0.9, 0.8]),
+          "labels": jnp.asarray([0, 0])}],
+        [{"boxes": jnp.asarray(gt), "labels": jnp.asarray([0])}],
+    )
+    out = m.compute()
+    assert float(out["mar_1"]) == pytest.approx(0.0)  # top-1 is the FP
+    assert float(out["mar_2"]) == pytest.approx(1.0)
+    with pytest.raises(ValueError, match="max_detection_thresholds"):
+        MeanAveragePrecision(num_classes=1, max_detection_thresholds=())
 
 
 def test_max_detections_truncates_by_score():
@@ -112,5 +148,5 @@ def test_image_without_detections_or_gts():
     )
     out = m.compute()
     # one GT total; its image had no dets; the other image's det is a FP
-    assert float(out["mar"]) == pytest.approx(0.0)
+    assert float(out["mar_100"]) == pytest.approx(0.0)
     assert float(out["map"]) == pytest.approx(0.0)
