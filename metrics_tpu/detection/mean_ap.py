@@ -14,8 +14,14 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.core.metric import Metric
-from metrics_tpu.functional.detection.map import COCO_IOU_THRESHOLDS, coco_map_padded
+from metrics_tpu.functional.detection.map import (
+    COCO_AREA_RANGES,
+    COCO_IOU_THRESHOLDS,
+    COCO_MAX_DETS,
+    coco_map_padded,
+)
 from metrics_tpu.parallel.buffer import as_values
+from metrics_tpu.utils.prints import rank_zero_warn
 
 
 class MeanAveragePrecision(Metric):
@@ -31,22 +37,29 @@ class MeanAveragePrecision(Metric):
     match any number of detections, and are ignore-flagged (detections
     matched to them count neither as TP nor FP) — pycocotools semantics.
 
-    Every image is padded to static ``max_detections`` / ``max_gt`` slots
-    (detections beyond the cap keep the top scores — the COCO ``maxDets``
-    semantics); the states are per-image stacks (cat-states, so they shard
-    and gather like every other epoch metric), and ``compute()`` runs the
-    whole COCO evaluation as one static-shape jitted program: greedy
-    matching scanned over detection slots, vmapped over
-    images x classes x IoU thresholds.
+    Every image is padded to static ``max_detections`` / ``max_gt`` slots.
+    ``max_detections`` is the static per-image CAPACITY (all classes
+    together), not the COCO maxDets cap — the COCO caps are
+    ``max_detection_thresholds``, applied per (image, class) inside the
+    engine. An image exceeding the capacity keeps its top-scoring
+    detections and a warning names the truncation; size ``max_detections``
+    so that real images fit (pycocotools evaluates every detection). The
+    states are per-image stacks (cat-states, so they shard and gather like
+    every other epoch metric), and ``compute()`` runs the whole COCO
+    evaluation as one static-shape jitted program: greedy matching scanned
+    over detection slots, vmapped over images x classes x IoU thresholds x
+    area ranges.
 
     Args:
         num_classes: static class count (labels in ``[0, num_classes)``).
         iou_thresholds: tuple of IoU thresholds (default COCO
             0.50:0.05:0.95).
-        max_detections: per-image detection cap (COCO ``maxDets``,
-            default 100).
+        max_detections: static per-image detection CAPACITY across classes
+            (default 100); overflow keeps the top scores and warns.
         max_gt: per-image ground-truth cap (exceeding it raises).
-        class_metrics: include per-class AP in the result dict.
+        max_detection_thresholds: the COCO ``maxDets`` recall caps, applied
+            per (image, class) (default ``(1, 10, 100)``; keys ``mar_<k>``).
+        class_metrics: include the per-class vectors in the result dict.
 
     Example:
         >>> import jax.numpy as jnp
@@ -66,7 +79,7 @@ class MeanAveragePrecision(Metric):
         iou_thresholds: Sequence[float] = COCO_IOU_THRESHOLDS,
         max_detections: int = 100,
         max_gt: int = 100,
-        max_detection_thresholds: Sequence[int] = (1, 10, 100),
+        max_detection_thresholds: Sequence[int] = COCO_MAX_DETS,
         class_metrics: bool = False,
         compute_on_step: bool = True,
         dist_sync_on_step: bool = False,
@@ -113,7 +126,14 @@ class MeanAveragePrecision(Metric):
             )
         n, cap = boxes.shape[0], self.max_detections
         if n > cap:
-            # COCO maxDets: keep the top-scoring `cap` detections
+            # static-capacity overflow: keep the top-scoring `cap` detections
+            # ACROSS classes. This can drop detections pycocotools (whose
+            # maxDets caps are per class) would keep — hence the loud notice.
+            rank_zero_warn(
+                f"MeanAveragePrecision: image with {n} detections truncated to"
+                f" max_detections={cap} (top scores across classes); raise"
+                " `max_detections` to evaluate every detection as pycocotools does."
+            )
             keep = jnp.argsort(-scores)[:cap]
             boxes, scores, labels, n = boxes[keep], scores[keep], labels[keep], cap
         pad = cap - n
@@ -164,8 +184,6 @@ class MeanAveragePrecision(Metric):
             self._append("gt_crowd", gc[None])
 
     def compute(self) -> Dict[str, Array]:
-        from metrics_tpu.functional.detection.map import COCO_AREA_RANGES
-
         k_largest = max(self.max_detection_thresholds)
         per_class_keys = ("map_per_class", f"mar_{k_largest}_per_class")
         raw = self.det_boxes

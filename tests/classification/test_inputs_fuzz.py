@@ -1,0 +1,360 @@
+"""Property-based fuzz of the input-format surface (VERDICT r4 item 7).
+
+``_input_format_classification`` is the single most load-bearing function in
+the library (SURVEY §2.5): every classification metric's semantics flow
+through its case taxonomy, validation precedence, and normalization. The
+curated grid in ``test_inputs.py`` covers the documented corners; this file
+sweeps ≥1000 seeded randomized (shape, dtype, value, argument) combinations
+and checks each against ``_np_arbiter`` — a from-scratch pure-numpy
+reimplementation of the reference semantics (loop/numpy style, written
+independently of the jax code) that returns either normalized outputs + case
+or a symbolic error code. Assertions per case:
+
+* both raise, and the library's message contains the arbiter code's mapped
+  substring (error class + identity, not just "some error"), or
+* neither raises, the resolved ``DataType`` matches, and the normalized
+  ``(preds, target)`` arrays are exactly equal.
+
+Value-sensitive boundaries (probability-sum tolerance, threshold equality,
+top-k ties) are kept away from float edges by construction: sums are either
+softmax-normalized (error margin ~1e-7 vs the 1e-5 tolerance) or raw sums
+far above it, and scores are generic floats (distinct w.p. 1).
+"""
+import numpy as np
+import pytest
+
+from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.enums import DataType
+
+# symbolic arbiter error code -> substring the library's message must contain
+_ERROR_SUBSTRINGS = {
+    "target_float": "has to be an integer tensor",
+    "first_dim": "same first dimension",
+    "same_shape": "should have the same shape",
+    "extra_dim_float": "should be a float tensor",
+    "extra_dim_shape": "(N, C, ...)",
+    "ndim": "Either `preds` and `target` both",
+    "imf_c2": "more than 2 classes in your data",
+    "threshold": "(0,1) interval",
+    "bin_nc_gt2": "binary, but `num_classes`",
+    "bin_nc2_not_mc": "`is_multiclass` is not True",
+    "bin_nc1_mc": "`num_classes` is 1",
+    "mc_nc1": "predictions are integers",
+    "mc_imf_nc_mismatch": "does not match `num_classes`",
+    "mc_c_mismatch": "size of C dimension",
+    "ml_mc_nc_ne2": "not equal to 2",
+    "ml_nc_mismatch": "does not match num_classes",
+    "topk_binary": "with binary data",
+    "topk_int": "integer larger than 0",
+    "topk_not_float": "probability predictions",
+    "topk_imf": "can not set `top_k`",
+    "topk_ml_mc": "can not use `top_k`",
+    "topk_ge_c": "strictly smaller",
+    "target_neg": "non-negative tensor",
+    "preds_int_neg": "have to be non-negative",
+    "probs_range": "outside of [0,1]",
+    "imf_target_gt1": "`target` should not exceed 1",
+    "imf_preds_gt1": "`preds` should not exceed 1",
+    "float_target_binary": "`target` should be binary",
+    "sum_one": "sum up to 1",
+    "label_ge_implied": "smaller than the size of the `C`",
+    "label_ge_nc": "smaller than `num_classes`",
+    "preds_label_ge_nc": "in `preds` should be smaller",
+}
+
+
+class _Err(Exception):
+    def __init__(self, code):
+        self.code = code
+
+
+def _np_onehot(labels, num_classes):
+    """(N, ...) -> (N, C, ...); out-of-range labels one-hot to zero rows."""
+    labels = np.asarray(labels)
+    flat = labels.reshape(-1)
+    out = np.zeros((flat.shape[0], num_classes), dtype=np.int64)
+    ok = (flat >= 0) & (flat < num_classes)
+    out[np.arange(flat.shape[0])[ok], flat[ok]] = 1
+    out = out.reshape(*labels.shape, num_classes)
+    return np.moveaxis(out, -1, 1)
+
+
+def _np_topk(x, k):
+    """1s at the k largest entries along axis 1 (ties: lowest index first)."""
+    idx = np.argsort(-x, axis=1, kind="stable")
+    take = np.take(idx, np.arange(k), axis=1)
+    out = np.zeros_like(x, dtype=np.int64)
+    np.put_along_axis(out, take, 1, axis=1)
+    return out
+
+
+def _np_arbiter(preds, target, threshold=0.5, top_k=None, num_classes=None, is_multiclass=None):
+    """Independent numpy model of the reference input-format semantics.
+
+    Returns ``(preds_out, target_out, case_name)``; raises ``_Err(code)``.
+    Case names: 'binary' | 'multi-class' | 'multi-label' | 'multi-dim multi-class'.
+    """
+    p, t = np.asarray(preds), np.asarray(target)
+
+    # squeeze excess size-1 dims, preserving a size-1 leading batch dim
+    if p.shape and p.shape[0] == 1:
+        p, t = np.expand_dims(np.squeeze(p), 0), np.expand_dims(np.squeeze(t), 0)
+    else:
+        p, t = np.squeeze(p), np.squeeze(t)
+
+    if p.shape[:1] != t.shape[:1]:
+        raise _Err("first_dim")
+    p_float = np.issubdtype(p.dtype, np.floating)
+    if np.issubdtype(t.dtype, np.floating):
+        raise _Err("target_float")
+
+    # ---- case taxonomy (shape/dtype only)
+    if p.ndim == t.ndim:
+        if p.shape != t.shape:
+            raise _Err("same_shape")
+        if p.ndim == 1:
+            case = "binary" if p_float else "multi-class"
+        else:
+            case = "multi-label" if p_float else "multi-dim multi-class"
+        implied = int(np.prod(p.shape[1:])) if p.ndim > 1 else 1
+    elif p.ndim == t.ndim + 1:
+        if not p_float:
+            raise _Err("extra_dim_float")
+        if p.shape[2:] != t.shape[1:]:
+            raise _Err("extra_dim_shape")
+        implied = p.shape[1]
+        case = "multi-class" if p.ndim == 2 else "multi-dim multi-class"
+    else:
+        raise _Err("ndim")
+
+    if p.ndim == t.ndim + 1 and is_multiclass is False and implied != 2:
+        raise _Err("imf_c2")
+
+    # ---- static argument checks
+    mc_like = case in ("multi-class", "multi-dim multi-class")
+    if not 0 < threshold < 1:
+        raise _Err("threshold")
+    if num_classes:
+        if case == "binary":
+            if num_classes > 2:
+                raise _Err("bin_nc_gt2")
+            if num_classes == 2 and not is_multiclass:
+                raise _Err("bin_nc2_not_mc")
+            if num_classes == 1 and is_multiclass:
+                raise _Err("bin_nc1_mc")
+        elif mc_like:
+            if num_classes == 1 and is_multiclass is not False:
+                raise _Err("mc_nc1")
+            if num_classes > 1:
+                if is_multiclass is False and implied != num_classes:
+                    raise _Err("mc_imf_nc_mismatch")
+                if p_float and implied > 1 and num_classes != implied:
+                    raise _Err("mc_c_mismatch")
+        elif case == "multi-label":
+            if is_multiclass and num_classes != 2:
+                raise _Err("ml_mc_nc_ne2")
+            if not is_multiclass and num_classes != implied:
+                raise _Err("ml_nc_mismatch")
+    if top_k is not None:
+        if case == "binary":
+            raise _Err("topk_binary")
+        if not isinstance(top_k, int) or top_k <= 0:
+            raise _Err("topk_int")
+        if not p_float:
+            raise _Err("topk_not_float")
+        if is_multiclass is False:
+            raise _Err("topk_imf")
+        if case == "multi-label" and is_multiclass:
+            raise _Err("topk_ml_mc")
+        if top_k >= implied:
+            raise _Err("topk_ge_c")
+
+    # ---- value checks (reference precedence)
+    if t.min() < 0:
+        raise _Err("target_neg")
+    if not p_float and p.min() < 0:
+        raise _Err("preds_int_neg")
+    if p_float and (p.min() < 0 or p.max() > 1):
+        raise _Err("probs_range")
+    if is_multiclass is False:
+        if t.max() > 1:
+            raise _Err("imf_target_gt1")
+        if not p_float and p.max() > 1:
+            raise _Err("imf_preds_gt1")
+    if p.ndim == t.ndim and p_float and t.max() > 1:
+        raise _Err("float_target_binary")
+    if mc_like and p_float and not np.all(np.isclose(p.sum(axis=1), 1.0, atol=1e-8)):
+        raise _Err("sum_one")
+    if p.shape != t.shape and t.max() >= implied:
+        raise _Err("label_ge_implied")
+    if num_classes and num_classes > 1 and mc_like:
+        if t.max() >= num_classes:
+            raise _Err("label_ge_nc")
+        if not p_float and p.max() >= num_classes:
+            raise _Err("preds_label_ge_nc")
+
+    # ---- normalization
+    nc = num_classes
+    if case in ("binary", "multi-label") and not top_k:
+        p = (p >= threshold).astype(np.int64) if p_float else p.astype(np.int64)
+        nc = num_classes if not is_multiclass else 2
+    if case == "multi-label" and top_k:
+        p = _np_topk(p, top_k)
+    if mc_like or is_multiclass:
+        if np.issubdtype(p.dtype, np.floating):
+            nc = p.shape[1]
+            p = _np_topk(p, top_k or 1)
+        else:
+            if nc is None:
+                nc = int(max(p.max(), t.max())) + 1
+            p = _np_onehot(p, max(2, nc))
+        t = _np_onehot(t, max(2, nc))
+        if is_multiclass is False:
+            p, t = p[:, 1, ...], t[:, 1, ...]
+    if (mc_like and is_multiclass is not False) or is_multiclass:
+        p = p.reshape(p.shape[0], p.shape[1], -1)
+        t = t.reshape(t.shape[0], t.shape[1], -1)
+    else:
+        p = p.reshape(p.shape[0], -1)
+        t = t.reshape(t.shape[0], -1)
+    if p.ndim > 2 and p.shape[-1] == 1:
+        p, t = p.squeeze(-1), t.squeeze(-1)
+    return p.astype(np.int64), t.astype(np.int64), case
+
+
+def _softmax(x, axis):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return (e / e.sum(axis=axis, keepdims=True)).astype(np.float32)
+
+
+def _gen_case(rng):
+    """One random (preds, target, kwargs) combination — mostly well-formed
+    layouts with randomized arguments, plus injected corruptions."""
+    n = rng.randint(1, 7)
+    c = rng.randint(2, 6)
+    x = rng.randint(1, 4)
+    layout = rng.choice([
+        "bin_prob", "bin_int", "mc_labels", "mc_probs", "ml_probs",
+        "mdmc_labels", "mdmc_probs", "mdmc_int01",
+    ])
+    if layout == "bin_prob":
+        p = rng.rand(n).astype(np.float32)
+        t = rng.randint(0, 2, n)
+    elif layout == "bin_int":
+        p = rng.randint(0, 2, n)
+        t = rng.randint(0, 2, n)
+    elif layout == "mc_labels":
+        p = rng.randint(0, c, n)
+        t = rng.randint(0, c, n)
+    elif layout == "mc_probs":
+        p = _softmax(rng.randn(n, c), axis=1)
+        t = rng.randint(0, c, n)
+    elif layout == "ml_probs":
+        p = rng.rand(n, c).astype(np.float32)
+        t = rng.randint(0, 2, (n, c))
+    elif layout == "mdmc_labels":
+        p = rng.randint(0, c, (n, x))
+        t = rng.randint(0, c, (n, x))
+    elif layout == "mdmc_probs":
+        p = _softmax(rng.randn(n, c, x), axis=1)
+        t = rng.randint(0, c, (n, x))
+    else:  # mdmc_int01: same-shape multi-dim 0/1 ints
+        p = rng.randint(0, 2, (n, c))
+        t = rng.randint(0, 2, (n, c))
+
+    kwargs = {}
+    r = rng.rand()
+    if r < 0.25:
+        kwargs["num_classes"] = int(rng.choice([1, 2, c, c + 1]))
+    if rng.rand() < 0.2:
+        kwargs["is_multiclass"] = bool(rng.rand() < 0.5)
+    if rng.rand() < 0.2:
+        kwargs["top_k"] = int(rng.choice([1, 2, c - 1, c]))
+    if rng.rand() < 0.3:
+        kwargs["threshold"] = float(rng.choice([0.25, 0.5, 0.75]))
+
+    # single-corruption injection (~30% of cases)
+    corrupt = rng.rand()
+    if corrupt < 0.04:
+        t = t.astype(np.float32)  # float target
+    elif corrupt < 0.08:
+        p = np.asarray(p)
+        p = p.reshape(-1)[: max(p.size - 1, 1)]  # shape mismatch
+    elif corrupt < 0.12 and np.issubdtype(np.asarray(p).dtype, np.floating):
+        p = np.asarray(p) + 1.5  # probs out of range
+    elif corrupt < 0.16:
+        t = np.asarray(t) - 2  # negative targets
+    elif corrupt < 0.20:
+        kwargs["threshold"] = float(rng.choice([0.0, 1.0, -2.0]))
+    elif corrupt < 0.24 and layout in ("mc_probs", "mdmc_probs"):
+        p = (np.asarray(p) * 0.4).astype(np.float32)  # rows no longer sum to 1
+    elif corrupt < 0.27 and layout in ("mc_probs", "mdmc_probs"):
+        t = np.asarray(t) + c  # labels beyond the C dimension
+    return p, t, kwargs
+
+
+N_CASES = 1200
+
+
+def test_input_format_fuzz_vs_numpy_arbiter():
+    failures = []
+    for i in range(N_CASES):
+        rng = np.random.RandomState(100_000 + i)
+        p, t, kwargs = _gen_case(rng)
+
+        want_err = want = None
+        try:
+            want = _np_arbiter(p, t, **kwargs)
+        except _Err as e:
+            want_err = e.code
+
+        got_err = got = None
+        try:
+            import jax.numpy as jnp
+
+            got = _input_format_classification(jnp.asarray(p), jnp.asarray(t), **kwargs)
+        except (ValueError, RuntimeError) as e:
+            got_err = str(e)
+
+        if want_err is not None:
+            if got_err is None:
+                failures.append((i, f"arbiter raised {want_err!r}, library returned a value"))
+            elif _ERROR_SUBSTRINGS[want_err] not in got_err:
+                failures.append((i, f"arbiter code {want_err!r} but library said: {got_err}"))
+            continue
+        if got_err is not None:
+            failures.append((i, f"library raised {got_err!r}, arbiter returned a value"))
+            continue
+
+        wp, wt, wcase = want
+        gp, gt_, gcase = got
+        if DataType(wcase) != gcase:
+            failures.append((i, f"case mismatch: arbiter {wcase}, library {gcase.value}"))
+            continue
+        if np.asarray(gp).shape != wp.shape or not np.array_equal(np.asarray(gp), wp):
+            failures.append((i, f"preds mismatch: {np.asarray(gp).shape} vs {wp.shape}"))
+            continue
+        if not np.array_equal(np.asarray(gt_), wt):
+            failures.append((i, "target mismatch"))
+
+    assert not failures, f"{len(failures)}/{N_CASES} cases diverged; first 10: {failures[:10]}"
+
+
+def test_arbiter_self_check():
+    """The arbiter reproduces documented reference corners (sanity that the
+    oracle itself encodes the taxonomy, not just mirrors the library)."""
+    # binary probs threshold at 0.5
+    p, t, case = _np_arbiter(np.array([0.3, 0.7], np.float32), np.array([0, 1]))
+    assert case == "binary" and p.tolist() == [[0], [1]]
+    # multiclass labels one-hot to (N, C) with inferred classes
+    p, t, case = _np_arbiter(np.array([0, 2]), np.array([1, 2]))
+    assert case == "multi-class" and p.shape == (2, 3)
+    # multilabel stays (N, C)
+    p, t, case = _np_arbiter(np.array([[0.9, 0.1]], np.float32), np.array([[1, 0]]))
+    assert case == "multi-label" and p.shape == (1, 2)
+    # mdmc probs one-hot to (N, C, X)
+    probs = _softmax(np.random.RandomState(0).randn(2, 3, 4), axis=1)
+    p, t, case = _np_arbiter(probs, np.random.RandomState(1).randint(0, 3, (2, 4)))
+    assert case == "multi-dim multi-class" and p.shape == (2, 3, 4)
+    with pytest.raises(_Err):
+        _np_arbiter(np.array([0.5], np.float32), np.array([0.5], np.float32))

@@ -90,17 +90,19 @@ def test_max_detection_thresholds_knob():
         MeanAveragePrecision(num_classes=1, max_detection_thresholds=())
 
 
-def test_max_detections_truncates_by_score():
-    """Over-cap detections keep the top scores (COCO maxDets)."""
+def test_max_detections_capacity_truncates_by_score_and_warns():
+    """Over-capacity detections keep the top scores, with a loud notice
+    (the static capacity is NOT the per-class COCO maxDets)."""
     gt = np.array([[0, 0, 10, 10]], np.float32)
     det = np.array([[50, 50, 60, 60], [0, 0, 10, 10]], np.float32)  # FP scored higher
     m = MeanAveragePrecision(num_classes=1, max_detections=1, max_gt=4)
-    m.update(
-        [{"boxes": jnp.asarray(det), "scores": jnp.asarray([0.9, 0.8]), "labels": jnp.asarray([0, 0])}],
-        [{"boxes": jnp.asarray(gt), "labels": jnp.asarray([0])}],
-    )
+    with pytest.warns(UserWarning, match="truncated to"):
+        m.update(
+            [{"boxes": jnp.asarray(det), "scores": jnp.asarray([0.9, 0.8]), "labels": jnp.asarray([0, 0])}],
+            [{"boxes": jnp.asarray(gt), "labels": jnp.asarray([0])}],
+        )
     out = m.compute()
-    # only the (higher-scoring) FP survives the cap -> no TP at all
+    # only the (higher-scoring) FP survives the capacity -> no TP at all
     assert float(out["map"]) == pytest.approx(0.0)
 
 
