@@ -30,6 +30,7 @@ from metrics_tpu.classification import (  # noqa: E402
     ConfusionMatrix,
     CoverageError,
     Dice,
+    ExactMatch,
     F1,
     FBeta,
     HammingDistance,
@@ -93,6 +94,7 @@ from metrics_tpu.nominal import (  # noqa: E402
     TschuprowsT,
 )
 from metrics_tpu.clustering import (  # noqa: E402
+    AdjustedMutualInfoScore,
     AdjustedRandScore,
     CalinskiHarabaszScore,
     CompletenessScore,

@@ -1,0 +1,60 @@
+"""ExactMatch module (subset accuracy). Extension beyond the reference
+snapshot (later torchmetrics ``classification/exact_match.py``)."""
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.classification.exact_match import (
+    _exact_match_compute,
+    _exact_match_update,
+)
+
+
+class ExactMatch(Metric):
+    """Accumulated exact-match ratio: a sample is correct only when every
+    position (all labels of a multilabel row, all elements of a multidim
+    multiclass sample) agrees with the target.
+
+    Two scalar sum-states — streams, shards, and psum-syncs like every
+    sum-state metric.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> metric = ExactMatch(num_classes=3)
+        >>> preds = jnp.array([[0, 1], [2, 1]])
+        >>> target = jnp.array([[0, 1], [1, 1]])
+        >>> float(metric(preds, target))
+        0.5
+    """
+
+    def __init__(
+        self,
+        threshold: float = 0.5,
+        num_classes: Optional[int] = None,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+        jit: Optional[bool] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+            jit=jit,
+        )
+        self.threshold = threshold
+        self.num_classes = num_classes
+        self.add_state("correct", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        correct, total = _exact_match_update(preds, target, self.threshold, self.num_classes)
+        self.correct = self.correct + correct
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _exact_match_compute(self.correct, self.total)

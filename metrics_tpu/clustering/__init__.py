@@ -9,6 +9,7 @@ closed-form compute at the end. sklearn-exact; see
 """
 from metrics_tpu.clustering.intrinsic import CalinskiHarabaszScore, DaviesBouldinScore
 from metrics_tpu.clustering.scores import (
+    AdjustedMutualInfoScore,
     AdjustedRandScore,
     CompletenessScore,
     FowlkesMallowsScore,
@@ -20,6 +21,7 @@ from metrics_tpu.clustering.scores import (
 )
 
 __all__ = [
+    "AdjustedMutualInfoScore",
     "AdjustedRandScore",
     "CalinskiHarabaszScore",
     "CompletenessScore",

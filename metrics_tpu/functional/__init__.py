@@ -1,4 +1,5 @@
 from metrics_tpu.functional.classification.accuracy import accuracy
+from metrics_tpu.functional.classification.exact_match import exact_match
 from metrics_tpu.functional.classification.auc import auc
 from metrics_tpu.functional.classification.auroc import auroc
 from metrics_tpu.functional.classification.average_precision import average_precision
@@ -39,6 +40,7 @@ from metrics_tpu.functional.clustering_intrinsic import (
     davies_bouldin_score,
 )
 from metrics_tpu.functional.clustering import (
+    adjusted_mutual_info_score,
     adjusted_rand_score,
     completeness_score,
     fowlkes_mallows_score,

@@ -1,0 +1,57 @@
+"""Exact match (subset accuracy). Extension beyond the reference snapshot
+(later torchmetrics ships ``ExactMatch`` for multilabel / multidim
+multiclass).
+
+A sample counts as correct only when EVERY position agrees — all labels of
+a multilabel row, all elements of a multidim multiclass sample. The
+statistics are two scalars (correct count, total count), so the metric
+streams and psum-syncs like every sum-state metric; the normalization
+reuses ``_input_format_classification``, giving the full input taxonomy
+(probabilities, logits-thresholded multilabel, label arrays) for free.
+"""
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _input_format_classification
+
+
+def _exact_match_update(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    validate: bool = True,
+) -> Tuple[Array, Array]:
+    """(correct, total) sample counts — "sum"-reducible across batches/devices."""
+    p, t, _ = _input_format_classification(
+        preds, target, threshold=threshold, num_classes=num_classes, validate=validate
+    )
+    axes = tuple(range(1, p.ndim))
+    correct = jnp.sum(jnp.all(p == t, axis=axes)) if axes else jnp.sum(p == t)
+    return correct.astype(jnp.float32), jnp.asarray(float(p.shape[0]))
+
+
+def _exact_match_compute(correct: Array, total: Array) -> Array:
+    return jnp.where(total == 0, jnp.nan, correct / jnp.maximum(total, 1.0))
+
+
+def exact_match(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    validate: bool = True,
+) -> Array:
+    """Fraction of samples whose prediction matches the target EXACTLY.
+
+    Example (multilabel — every label of a row must agree):
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([[0.9, 0.1], [0.8, 0.7]])
+        >>> target = jnp.array([[1, 0], [1, 0]])
+        >>> float(exact_match(preds, target))
+        0.5
+    """
+    correct, total = _exact_match_update(preds, target, threshold, num_classes, validate)
+    return _exact_match_compute(correct, total)

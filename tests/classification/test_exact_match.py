@@ -1,0 +1,93 @@
+"""ExactMatch (subset accuracy) vs a per-sample numpy oracle."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import ExactMatch
+from metrics_tpu.functional import exact_match
+
+_rng = np.random.RandomState(17)
+
+
+def test_multilabel_probs():
+    p = _rng.rand(64, 5).astype(np.float32)
+    t = _rng.randint(0, 2, (64, 5))
+    want = np.all((p >= 0.5) == t, axis=1).mean()
+    np.testing.assert_allclose(float(exact_match(jnp.asarray(p), jnp.asarray(t))), want, atol=1e-6)
+
+
+def test_multidim_multiclass_labels():
+    p = _rng.randint(0, 4, (32, 6))
+    t = _rng.randint(0, 4, (32, 6))
+    t[:16] = p[:16]  # force some exact rows
+    want = np.all(p == t, axis=1).mean()
+    got = float(exact_match(jnp.asarray(p), jnp.asarray(t), num_classes=4))
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_multidim_multiclass_probs():
+    logits = _rng.rand(24, 3, 5).astype(np.float32)
+    p = logits / logits.sum(1, keepdims=True)
+    t = _rng.randint(0, 3, (24, 5))
+    want = np.all(p.argmax(1) == t, axis=1).mean()
+    got = float(exact_match(jnp.asarray(p), jnp.asarray(t), num_classes=3))
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_binary_reduces_to_accuracy():
+    p = _rng.rand(100).astype(np.float32)
+    t = _rng.randint(0, 2, 100)
+    want = ((p >= 0.5) == t).mean()
+    np.testing.assert_allclose(float(exact_match(jnp.asarray(p), jnp.asarray(t))), want, atol=1e-6)
+
+
+def test_streaming_and_reset():
+    m = ExactMatch(num_classes=3)
+    ps = _rng.randint(0, 3, (4, 16, 2))
+    ts = _rng.randint(0, 3, (4, 16, 2))
+    for b in range(4):
+        m.update(jnp.asarray(ps[b]), jnp.asarray(ts[b]))
+    want = np.all(ps.reshape(-1, 2) == ts.reshape(-1, 2), axis=1).mean()
+    np.testing.assert_allclose(float(m.compute()), want, atol=1e-6)
+    m.reset()
+    assert np.isnan(float(m.compute()))
+
+
+def test_threshold():
+    p = jnp.asarray([[0.6, 0.6], [0.4, 0.4]])
+    t = jnp.asarray([[1, 1], [1, 1]])
+    assert float(exact_match(p, t, threshold=0.5)) == 0.5
+    assert float(exact_match(p, t, threshold=0.3)) == 1.0
+
+
+def test_validation_errors():
+    with pytest.raises(ValueError, match="integer tensor"):
+        exact_match(jnp.asarray([0.5]), jnp.asarray([0.5]))
+
+
+@pytest.mark.parametrize("ddp", [False, True])
+def test_exact_match_ddp_sum_states(ddp, eight_devices):
+    """Sum-states psum across a mesh like every scalar-state metric."""
+    if not ddp:
+        pytest.skip("covered eagerly above")
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(eight_devices), ("dp",))
+    p = _rng.randint(0, 2, (8, 4, 3))
+    t = _rng.randint(0, 2, (8, 4, 3))
+
+    pure = ExactMatch(num_classes=2, jit=False).pure()
+
+    def shard_fn(pp, tt):
+        state = pure.init()
+        state = pure.update(state, pp, tt)
+        state = pure.sync(state, "dp")
+        return pure.compute(state)
+
+    fn = jax.jit(jax.shard_map(shard_fn, mesh=mesh,
+                               in_specs=(P("dp"), P("dp")), out_specs=P()))
+    got = float(fn(jnp.asarray(p), jnp.asarray(t)))
+    # sample = leading index: every one of its (4, 3) positions must agree
+    want = np.all(p.reshape(8, -1) == t.reshape(8, -1), axis=1).mean()
+    np.testing.assert_allclose(got, want, atol=1e-6)

@@ -137,6 +137,41 @@ def test_clustering_streaming_equals_one_shot():
     np.testing.assert_allclose(float(m.compute()), want, atol=1e-5)
 
 
+@pytest.mark.parametrize("avg", ["arithmetic", "geometric", "min", "max"])
+def test_adjusted_mutual_info(avg):
+    """AMI (the vectorized hypergeometric EMI) vs sklearn, all average
+    methods. f32 gammaln bounds the tolerance at these epoch sizes."""
+    from metrics_tpu import AdjustedMutualInfoScore
+    from metrics_tpu.functional import adjusted_mutual_info_score
+
+    for n in (50, 320):
+        t = _rng.randint(0, NUM_CLASSES, n)
+        p = (t + (_rng.rand(n) < 0.3) * _rng.randint(0, NUM_CLUSTERS, n)) % NUM_CLUSTERS
+        got = float(adjusted_mutual_info_score(
+            jnp.asarray(p), jnp.asarray(t), NUM_CLUSTERS, NUM_CLASSES, average_method=avg))
+        want = sk.adjusted_mutual_info_score(t, p, average_method=avg)
+        np.testing.assert_allclose(got, want, atol=2e-3)
+
+    # stateful streaming equals one-shot
+    m = AdjustedMutualInfoScore(NUM_CLUSTERS, NUM_CLASSES, average_method=avg)
+    for b in range(NUM_BATCHES):
+        m.update(jnp.asarray(_preds[b]), jnp.asarray(_target[b]))
+    want = sk.adjusted_mutual_info_score(_target.reshape(-1), _preds.reshape(-1), average_method=avg)
+    np.testing.assert_allclose(float(m.compute()), want, atol=2e-3)
+
+
+def test_adjusted_mutual_info_degenerate():
+    one = np.zeros(40, int)
+    from metrics_tpu.functional import adjusted_mutual_info_score
+
+    # both labelings trivial -> 1.0 (sklearn short-circuit)
+    assert float(adjusted_mutual_info_score(jnp.asarray(one), jnp.asarray(one), 1, 1)) == 1.0
+    # exactly one trivial -> ~0.0
+    t = _rng.randint(0, 3, 40)
+    got = float(adjusted_mutual_info_score(jnp.asarray(one), jnp.asarray(t), 1, 3))
+    np.testing.assert_allclose(got, sk.adjusted_mutual_info_score(t, one), atol=1e-3)
+
+
 def test_clustering_validation():
     with pytest.raises(ValueError, match="positive int"):
         RandScore(num_clusters=0, num_classes=3)
