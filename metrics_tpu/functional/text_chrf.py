@@ -1,0 +1,103 @@
+"""chrF score (character n-gram F-score, Popović 2015). Extension beyond
+the reference snapshot (later torchmetrics ``text/chrf.py`` wraps the
+sacrebleu chrF2 conventions, which this follows: char order 6, beta=2,
+whitespace stripped before n-gram extraction, corpus scores from SUMMED
+per-order statistics, per-order F averaged over the orders where both
+hypothesis and reference produced n-grams).
+
+The statistics are ``(3, order)`` integer sums (matches, hypothesis
+n-grams, reference n-grams) — "sum"-reducible across batches, processes,
+and mesh axes, so the stateful metric streams like every sum-state metric.
+N-gram extraction is host-side string work (as for BLEU/ROUGE); the
+arithmetic is trivial either side.
+"""
+from collections import Counter
+from typing import Sequence, Tuple, Union
+
+import numpy as np
+
+CHRF_CHAR_ORDER = 6
+
+
+def _char_ngram_counts(text: str, n: int, lowercase: bool, whitespace: bool) -> Counter:
+    if lowercase:
+        text = text.lower()
+    if not whitespace:
+        text = "".join(text.split())
+    return Counter(text[i : i + n] for i in range(len(text) - n + 1))
+
+
+def _as_list(x: Union[str, Sequence[str]]) -> Sequence[str]:
+    return [x] if isinstance(x, str) else list(x)
+
+
+def chrf_stats(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str]],
+    n_char_order: int = CHRF_CHAR_ORDER,
+    lowercase: bool = False,
+    whitespace: bool = False,
+) -> np.ndarray:
+    """``(3, n_char_order)`` summed (matches, hyp n-grams, ref n-grams)."""
+    preds, target = _as_list(preds), _as_list(target)
+    if len(preds) != len(target):
+        raise ValueError(f"preds has {len(preds)} sentences, target {len(target)}")
+    stats = np.zeros((3, n_char_order), dtype=np.int64)
+    for hyp, ref in zip(preds, target):
+        for i, n in enumerate(range(1, n_char_order + 1)):
+            h = _char_ngram_counts(hyp, n, lowercase, whitespace)
+            r = _char_ngram_counts(ref, n, lowercase, whitespace)
+            stats[0, i] += sum((h & r).values())
+            stats[1, i] += sum(h.values())
+            stats[2, i] += sum(r.values())
+    return stats
+
+
+def chrf_from_stats(stats: np.ndarray, beta: float = 2.0) -> float:
+    """Corpus chrF from summed statistics.
+
+    Effective-order rule (sacrebleu semantics): an order counts toward the
+    average when EITHER side produced n-grams of that length; the side with
+    none contributes an ~0 precision/recall via eps smoothing, so a short
+    hypothesis against a long reference is penalized for the orders it
+    cannot cover (not silently excused from them). 0.0 when no order
+    qualifies."""
+    stats = np.asarray(stats, dtype=np.float64)
+    matches, hyp_total, ref_total = stats
+    score = 0.0
+    effective = 0
+    b2 = beta * beta
+    eps = 1e-16
+    for m, h, r in zip(matches, hyp_total, ref_total):
+        if h > 0 or r > 0:
+            effective += 1
+            prec = m / h if h > 0 else eps
+            rec = m / r if r > 0 else eps
+            denom = b2 * prec + rec
+            if denom > 0:
+                score += (1 + b2) * prec * rec / denom
+    return score / effective if effective else 0.0
+
+
+def chrf_score(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str]],
+    n_char_order: int = CHRF_CHAR_ORDER,
+    beta: float = 2.0,
+    lowercase: bool = False,
+    whitespace: bool = False,
+) -> float:
+    """Corpus chrF between hypothesis and reference sentences, in [0, 1]
+    (sacrebleu reports the same value scaled by 100).
+
+    Example:
+        >>> round(chrf_score(["the cat sat"], ["the cat sat"]), 4)
+        1.0
+        >>> 0.0 < chrf_score(["the cat sat"], ["the cat was sitting"]) < 1.0
+        True
+    """
+    if not isinstance(n_char_order, int) or n_char_order < 1:
+        raise ValueError(f"`n_char_order` must be a positive int, got {n_char_order!r}")
+    if beta <= 0:
+        raise ValueError(f"`beta` must be positive, got {beta!r}")
+    return chrf_from_stats(chrf_stats(preds, target, n_char_order, lowercase, whitespace), beta)

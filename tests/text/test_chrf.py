@@ -1,0 +1,114 @@
+"""chrF vs an independent per-order reimplementation + hand-derived cases.
+
+sacrebleu is not in the image; the oracle below recomputes per-order
+precision/recall/F from scratch (dict loops, no shared helpers) following
+the published chrF2 definition, and the hand cases pin values computed on
+paper.
+"""
+import numpy as np
+import pytest
+
+from metrics_tpu import CHRFScore
+from metrics_tpu.functional import chrf_score
+
+
+def _oracle(preds, target, order=6, beta=2.0):
+    total = {"m": [0] * order, "h": [0] * order, "r": [0] * order}
+    for hyp, ref in zip(preds, target):
+        hyp = hyp.replace(" ", "").replace("\t", "").replace("\n", "")
+        ref = ref.replace(" ", "").replace("\t", "").replace("\n", "")
+        for n in range(1, order + 1):
+            hg, rg = {}, {}
+            for i in range(len(hyp) - n + 1):
+                g = hyp[i:i + n]
+                hg[g] = hg.get(g, 0) + 1
+            for i in range(len(ref) - n + 1):
+                g = ref[i:i + n]
+                rg[g] = rg.get(g, 0) + 1
+            total["m"][n - 1] += sum(min(c, rg.get(g, 0)) for g, c in hg.items())
+            total["h"][n - 1] += sum(hg.values())
+            total["r"][n - 1] += sum(rg.values())
+    score, eff = 0.0, 0
+    for m, h, r in zip(total["m"], total["h"], total["r"]):
+        if h > 0 or r > 0:  # either-side effective order; missing side ~0
+            eff += 1
+            p = m / h if h > 0 else 1e-16
+            rc = m / r if r > 0 else 1e-16
+            d = beta * beta * p + rc
+            if d > 0:
+                score += (1 + beta * beta) * p * rc / d
+    return score / eff if eff else 0.0
+
+
+def test_identical_sentences():
+    assert chrf_score(["the cat sat"], ["the cat sat"]) == pytest.approx(1.0)
+
+
+def test_disjoint_sentences():
+    assert chrf_score(["aaaa"], ["bbbb"]) == pytest.approx(0.0)
+
+
+def test_hand_case_single_order():
+    """order=1, beta=1: hyp 'ab' vs ref 'abc' (whitespace-free): matches=2,
+    hyp=2, ref=3 -> P=1, R=2/3, F1=0.8 — computed on paper."""
+    assert chrf_score(["ab"], ["abc"], n_char_order=1, beta=1.0) == pytest.approx(0.8)
+
+
+def test_hand_case_beta_weighting():
+    """beta=2 weights recall: same stats give F = 5*P*R/(4P+R) = 5*(2/3)/(4+2/3)."""
+    want = 5 * (2 / 3) / (4 + 2 / 3)
+    assert chrf_score(["ab"], ["abc"], n_char_order=1, beta=2.0) == pytest.approx(want)
+
+
+def test_short_hypothesis_penalized_for_uncoverable_orders():
+    """'ab' vs 'abcdef': the hypothesis has n-grams only for orders 1-2, but
+    orders 3-6 still count (either-side rule) with ~0 contribution — a short
+    hypothesis must not be excused from the orders it cannot cover."""
+    got = chrf_score(["ab"], ["abcdef"])
+    # order 1: P=1, R=2/6; order 2: P=1, R=1/5; orders 3-6: ~0 — averaged /6
+    f1 = 5 * 1 * (2 / 6) / (4 * 1 + 2 / 6)
+    f2 = 5 * 1 * (1 / 5) / (4 * 1 + 1 / 5)
+    np.testing.assert_allclose(got, (f1 + f2) / 6, atol=1e-9)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_random_corpora_vs_oracle(seed):
+    rng = np.random.RandomState(seed)
+    vocab = list("abcdefg ")
+    preds = ["".join(rng.choice(vocab, rng.randint(3, 30))) for _ in range(12)]
+    target = ["".join(rng.choice(vocab, rng.randint(3, 30))) for _ in range(12)]
+    got = chrf_score(preds, target)
+    np.testing.assert_allclose(got, _oracle(preds, target), atol=1e-9)
+
+
+def test_streaming_equals_corpus():
+    """Batch-streamed statistics equal the one-shot corpus score (the
+    sacrebleu sum-then-score aggregation, not a mean of batch scores)."""
+    rng = np.random.RandomState(7)
+    vocab = list("abcde ")
+    preds = ["".join(rng.choice(vocab, rng.randint(4, 20))) for _ in range(9)]
+    target = ["".join(rng.choice(vocab, rng.randint(4, 20))) for _ in range(9)]
+    m = CHRFScore()
+    for i in range(3):
+        m.update(preds[i * 3:(i + 1) * 3], target[i * 3:(i + 1) * 3])
+    np.testing.assert_allclose(float(m.compute()), _oracle(preds, target), atol=1e-6)
+    m.reset()
+    assert float(m.compute()) == 0.0
+
+
+def test_whitespace_and_lowercase_options():
+    # with whitespace kept, 'a b' vs 'ab' shares only the chars, not the bigram
+    strict = chrf_score(["a b"], ["ab"], n_char_order=2, whitespace=True)
+    loose = chrf_score(["a b"], ["ab"], n_char_order=2, whitespace=False)
+    assert loose == pytest.approx(1.0) and strict < loose
+    assert chrf_score(["AB"], ["ab"], lowercase=True) == pytest.approx(1.0)
+    assert chrf_score(["AB"], ["ab"], lowercase=False) == pytest.approx(0.0)
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="sentences"):
+        chrf_score(["a", "b"], ["a"])
+    with pytest.raises(ValueError, match="positive"):
+        CHRFScore(n_char_order=0)
+    with pytest.raises(ValueError, match="beta"):
+        CHRFScore(beta=-1.0)
