@@ -4,7 +4,7 @@ A TPU-native (JAX/XLA) metrics framework with the capabilities of
 TorchMetrics v0.2.1 (reference: /root/reference/torchmetrics/info.py:1).
 """
 
-__version__ = "0.3.0"
+__version__ = "0.5.0"
 __author__ = "metrics_tpu authors"
 __license__ = "Apache-2.0"
 __docs__ = "TPU-native machine-learning metrics for JAX: stateful accumulation, XLA-collective sync, pure-functional core."
