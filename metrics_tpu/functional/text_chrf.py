@@ -48,35 +48,47 @@ def chrf_stats(
             h = _char_ngram_counts(hyp, n, lowercase, whitespace)
             r = _char_ngram_counts(ref, n, lowercase, whitespace)
             stats[0, i] += sum((h & r).values())
-            stats[1, i] += sum(h.values())
+            # sacrebleu: a segment's hypothesis n-grams do not count at
+            # orders where its reference produced none ("don't count hits
+            # if no reference exists for that n-gram" — helpers.py parity)
+            stats[1, i] += sum(h.values()) if r else 0
             stats[2, i] += sum(r.values())
     return stats
 
 
-def chrf_from_stats(stats: np.ndarray, beta: float = 2.0) -> float:
-    """Corpus chrF from summed statistics.
+def chrf_from_stats(stats: np.ndarray, beta: float = 2.0, eps_smoothing: bool = False) -> float:
+    """Corpus chrF from summed statistics — sacrebleu 2.x semantics exactly
+    (verified against the library, tests/text/test_chrf.py).
 
-    Effective-order rule (sacrebleu semantics): an order counts toward the
-    average when EITHER side produced n-grams of that length; the side with
-    none contributes an ~0 precision/recall via eps smoothing, so a short
-    hypothesis against a long reference is penalized for the orders it
-    cannot cover (not silently excused from them). 0.0 when no order
-    qualifies."""
+    Default: per-order precision/recall averaged over the EFFECTIVE orders
+    (both sides produced n-grams), then one F_beta of the averages.
+    ``eps_smoothing=True``: the chrF++.py / NLTK / Moses variant — per-order
+    F_beta with eps-smoothed missing sides, averaged over ALL orders.
+    """
     stats = np.asarray(stats, dtype=np.float64)
     matches, hyp_total, ref_total = stats
-    score = 0.0
-    effective = 0
     b2 = beta * beta
     eps = 1e-16
+    eps_score = 0.0
+    avg_prec = avg_rec = 0.0
+    effective = 0
     for m, h, r in zip(matches, hyp_total, ref_total):
-        if h > 0 or r > 0:
+        prec = m / h if h > 0 else eps
+        rec = m / r if r > 0 else eps
+        denom = b2 * prec + rec
+        eps_score += (1 + b2) * prec * rec / denom if denom > 0 else eps
+        if h > 0 and r > 0:
+            avg_prec += prec
+            avg_rec += rec
             effective += 1
-            prec = m / h if h > 0 else eps
-            rec = m / r if r > 0 else eps
-            denom = b2 * prec + rec
-            if denom > 0:
-                score += (1 + b2) * prec * rec / denom
-    return score / effective if effective else 0.0
+    if eps_smoothing:
+        return eps_score / stats.shape[1]
+    if effective:
+        avg_prec /= effective
+        avg_rec /= effective
+    if avg_prec + avg_rec:
+        return (1 + b2) * avg_prec * avg_rec / (b2 * avg_prec + avg_rec)
+    return 0.0
 
 
 def chrf_score(
@@ -86,18 +98,21 @@ def chrf_score(
     beta: float = 2.0,
     lowercase: bool = False,
     whitespace: bool = False,
+    eps_smoothing: bool = False,
 ) -> float:
     """Corpus chrF between hypothesis and reference sentences, in [0, 1]
     (sacrebleu reports the same value scaled by 100).
 
     Example:
-        >>> round(chrf_score(["the cat sat"], ["the cat sat"]), 4)
+        >>> round(float(chrf_score(["the cat sat"], ["the cat sat"])), 4)
         1.0
-        >>> 0.0 < chrf_score(["the cat sat"], ["the cat was sitting"]) < 1.0
+        >>> bool(0.0 < chrf_score(["the cat sat"], ["the cat was sitting"]) < 1.0)
         True
     """
     if not isinstance(n_char_order, int) or n_char_order < 1:
         raise ValueError(f"`n_char_order` must be a positive int, got {n_char_order!r}")
     if beta <= 0:
         raise ValueError(f"`beta` must be positive, got {beta!r}")
-    return chrf_from_stats(chrf_stats(preds, target, n_char_order, lowercase, whitespace), beta)
+    return chrf_from_stats(
+        chrf_stats(preds, target, n_char_order, lowercase, whitespace), beta, eps_smoothing
+    )

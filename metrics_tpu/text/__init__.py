@@ -6,6 +6,7 @@ from metrics_tpu.text.error_rates import (
     WordInfoPreserved,
 )
 from metrics_tpu.text.perplexity import Perplexity
+from metrics_tpu.text.bleu import BLEUScore, SacreBLEUScore
 from metrics_tpu.text.chrf import CHRFScore
 from metrics_tpu.text.rouge import ROUGEScore
 from metrics_tpu.text.squad import SQuAD

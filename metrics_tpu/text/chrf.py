@@ -28,6 +28,7 @@ class CHRFScore(Metric):
         beta: float = 2.0,
         lowercase: bool = False,
         whitespace: bool = False,
+        eps_smoothing: bool = False,
         compute_on_step: bool = True,
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
@@ -48,6 +49,7 @@ class CHRFScore(Metric):
         self.beta = float(beta)
         self.lowercase = lowercase
         self.whitespace = whitespace
+        self.eps_smoothing = eps_smoothing
         self.add_state(
             "stats", default=np.zeros((3, n_char_order), dtype=accum_int_dtype()), dist_reduce_fx="sum"
         )
@@ -62,4 +64,7 @@ class CHRFScore(Metric):
     def compute(self) -> Array:
         import jax.numpy as jnp
 
-        return jnp.asarray(chrf_from_stats(np.asarray(self.stats), self.beta), dtype=jnp.float32)
+        return jnp.asarray(
+            chrf_from_stats(np.asarray(self.stats), self.beta, self.eps_smoothing),
+            dtype=jnp.float32,
+        )

@@ -1,0 +1,114 @@
+"""BLEUScore / SacreBLEUScore classes + sacrebleu 13a tokenization."""
+import numpy as np
+import pytest
+
+from metrics_tpu import BLEUScore, SacreBLEUScore
+from metrics_tpu.functional import bleu_score, sacre_bleu_score
+from metrics_tpu.functional.text_sacrebleu import tokenize_sacrebleu
+
+PREDS = ["the cat is on the mat", "a dog sleeps"]
+TARGET = [
+    ["there is a cat on the mat", "a cat is on the mat"],
+    ["the dog is sleeping", "a dog sleeps soundly"],
+]
+
+
+def test_class_matches_functional():
+    m = BLEUScore()
+    m.update(PREDS, TARGET)
+    want = float(bleu_score([p.split() for p in PREDS],
+                            [[r.split() for r in rs] for rs in TARGET]))
+    np.testing.assert_allclose(float(m.compute()), want, atol=1e-6)
+
+
+def test_streaming_is_corpus_aggregation():
+    """Summed statistics across updates == one-shot corpus score (NOT a mean
+    of per-batch scores)."""
+    m = BLEUScore()
+    m.update(PREDS[:1], TARGET[:1])
+    m.update(PREDS[1:], TARGET[1:])
+    one_shot = BLEUScore()
+    one_shot.update(PREDS, TARGET)
+    np.testing.assert_allclose(float(m.compute()), float(one_shot.compute()), atol=1e-6)
+    m.reset()
+    assert float(m.compute()) == 0.0
+
+
+def test_smooth_and_ngram_options():
+    m = BLEUScore(n_gram=2, smooth=True)
+    m.update(["the cat"], [["the cat sat"]])
+    assert 0.0 < float(m.compute()) <= 1.0
+    with pytest.raises(ValueError, match="n_gram"):
+        BLEUScore(n_gram=0)
+    with pytest.raises(ValueError, match="sentences"):
+        BLEUScore().update(["a"], [])
+
+
+def test_13a_tokenization_rules():
+    # punctuation splits off; periods split unless between digits
+    assert tokenize_sacrebleu("Hello, world!") == ["Hello", ",", "world", "!"]
+    assert tokenize_sacrebleu("It costs 3.50 dollars.") == \
+        ["It", "costs", "3.50", "dollars", "."]
+    assert tokenize_sacrebleu("A&amp;B") == ["A", "&", "B"]
+    assert tokenize_sacrebleu("pre 1990-2000 post") == ["pre", "1990", "-", "2000", "post"]
+    assert tokenize_sacrebleu("Hello World", lowercase=True) == ["hello", "world"]
+    # char drops whitespace entirely (sacrebleu parity)
+    assert tokenize_sacrebleu("ab c", tokenize="char") == ["a", "b", "c"]
+    assert tokenize_sacrebleu("Hello, world!", tokenize="none") == ["Hello,", "world!"]
+    with pytest.raises(ValueError, match="tokenize"):
+        tokenize_sacrebleu("x", tokenize="13b")
+
+
+def test_13a_matches_installed_sacrebleu_tokenizer():
+    from sacrebleu.tokenizers.tokenizer_13a import Tokenizer13a
+
+    tok = Tokenizer13a()
+    probes = [
+        "Hello, world!", "It costs 3.50 dollars.", "A&amp;B", "pre 1990-2000 post",
+        "quo“ted” text", "semi;colon:and/slash", "(parens) [brackets] {braces}",
+        "ends with period.", "12,345.67 numbers", "dash-between-words",
+    ]
+    for s in probes:
+        assert tokenize_sacrebleu(s) == tok(s).split(), s
+
+
+@pytest.mark.parametrize("tokenize", ["13a", "none", "char"])
+def test_corpus_vs_installed_sacrebleu(tokenize):
+    import sacrebleu
+
+    preds = ["The cat is on the mat.", "A dog sleeps soundly!"]
+    target = [["There is a cat on the mat.", "A cat is on the mat."],
+              ["The dog is sleeping.", "A dog sleeps."]]
+    got = float(sacre_bleu_score(preds, target, tokenize=tokenize))
+    # sacrebleu wants references transposed: one list per reference position
+    refs_t = [[target[i][j] for i in range(len(preds))] for j in range(2)]
+    want = sacrebleu.corpus_bleu(
+        preds, refs_t, smooth_method="none", tokenize=tokenize, force=True
+    ).score / 100.0
+    np.testing.assert_allclose(got, want, atol=1e-6, err_msg=tokenize)
+
+
+def test_sacre_bleu_vs_manual_tokenization():
+    """SacreBLEU == plain BLEU over 13a-pre-tokenized text."""
+    preds = ["The cat, it sat."]
+    target = [["The cat sat.", "A cat, it sat down."]]
+    got = float(sacre_bleu_score(preds, target))
+    want = float(bleu_score([tokenize_sacrebleu(preds[0])],
+                            [[tokenize_sacrebleu(r) for r in target[0]]]))
+    np.testing.assert_allclose(got, want, atol=1e-6)
+    m = SacreBLEUScore()
+    m.update(preds, target)
+    np.testing.assert_allclose(float(m.compute()), want, atol=1e-6)
+
+
+def test_sacre_bleu_punctuation_matters():
+    """13a separates punctuation, so 'mat.' matches 'mat .' n-grams."""
+    with_13a = float(sacre_bleu_score(["the mat."], [["the mat ."]], n_gram=2))
+    plain = float(BLEUScore(n_gram=2)(["the mat."], [["the mat ."]]))
+    assert with_13a == pytest.approx(1.0)
+    assert plain < 1.0  # whitespace split keeps 'mat.' != 'mat', '.'
+
+
+def test_sacre_bleu_validation():
+    with pytest.raises(ValueError, match="tokenize"):
+        SacreBLEUScore(tokenize="13b")

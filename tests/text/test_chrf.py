@@ -1,43 +1,18 @@
-"""chrF vs an independent per-order reimplementation + hand-derived cases.
-
-sacrebleu is not in the image; the oracle below recomputes per-order
-precision/recall/F from scratch (dict loops, no shared helpers) following
-the published chrF2 definition, and the hand cases pin values computed on
-paper.
-"""
+"""chrF vs the REAL sacrebleu library (installed in the image) plus
+hand-derived cases for the scoring conventions."""
 import numpy as np
 import pytest
+import sacrebleu
 
 from metrics_tpu import CHRFScore
 from metrics_tpu.functional import chrf_score
 
 
-def _oracle(preds, target, order=6, beta=2.0):
-    total = {"m": [0] * order, "h": [0] * order, "r": [0] * order}
-    for hyp, ref in zip(preds, target):
-        hyp = hyp.replace(" ", "").replace("\t", "").replace("\n", "")
-        ref = ref.replace(" ", "").replace("\t", "").replace("\n", "")
-        for n in range(1, order + 1):
-            hg, rg = {}, {}
-            for i in range(len(hyp) - n + 1):
-                g = hyp[i:i + n]
-                hg[g] = hg.get(g, 0) + 1
-            for i in range(len(ref) - n + 1):
-                g = ref[i:i + n]
-                rg[g] = rg.get(g, 0) + 1
-            total["m"][n - 1] += sum(min(c, rg.get(g, 0)) for g, c in hg.items())
-            total["h"][n - 1] += sum(hg.values())
-            total["r"][n - 1] += sum(rg.values())
-    score, eff = 0.0, 0
-    for m, h, r in zip(total["m"], total["h"], total["r"]):
-        if h > 0 or r > 0:  # either-side effective order; missing side ~0
-            eff += 1
-            p = m / h if h > 0 else 1e-16
-            rc = m / r if r > 0 else 1e-16
-            d = beta * beta * p + rc
-            if d > 0:
-                score += (1 + beta * beta) * p * rc / d
-    return score / eff if eff else 0.0
+def _oracle(preds, target, order=6, beta=2.0, eps_smoothing=False):
+    """sacrebleu itself — the genuinely independent implementation."""
+    chrf = sacrebleu.CHRF(char_order=order, word_order=0, beta=int(beta),
+                          eps_smoothing=eps_smoothing)
+    return chrf.corpus_score(list(preds), [list(target)]).score / 100.0
 
 
 def test_identical_sentences():
@@ -60,34 +35,39 @@ def test_hand_case_beta_weighting():
     assert chrf_score(["ab"], ["abc"], n_char_order=1, beta=2.0) == pytest.approx(want)
 
 
-def test_short_hypothesis_penalized_for_uncoverable_orders():
-    """'ab' vs 'abcdef': the hypothesis has n-grams only for orders 1-2, but
-    orders 3-6 still count (either-side rule) with ~0 contribution — a short
-    hypothesis must not be excused from the orders it cannot cover."""
+def test_short_hypothesis_vs_sacrebleu():
+    """'ab' vs 'abcdef' exercises the effective-order averaging exactly as
+    sacrebleu does (avg P/R over both-sides orders, one F of the averages)."""
     got = chrf_score(["ab"], ["abcdef"])
-    # order 1: P=1, R=2/6; order 2: P=1, R=1/5; orders 3-6: ~0 — averaged /6
-    f1 = 5 * 1 * (2 / 6) / (4 * 1 + 2 / 6)
-    f2 = 5 * 1 * (1 / 5) / (4 * 1 + 1 / 5)
-    np.testing.assert_allclose(got, (f1 + f2) / 6, atol=1e-9)
+    np.testing.assert_allclose(got, _oracle(["ab"], ["abcdef"]), atol=1e-9)
 
 
+@pytest.mark.parametrize("eps_smoothing", [False, True])
 @pytest.mark.parametrize("seed", [0, 1, 2])
-def test_random_corpora_vs_oracle(seed):
+def test_random_corpora_vs_sacrebleu(seed, eps_smoothing):
     rng = np.random.RandomState(seed)
     vocab = list("abcdefg ")
-    preds = ["".join(rng.choice(vocab, rng.randint(3, 30))) for _ in range(12)]
-    target = ["".join(rng.choice(vocab, rng.randint(3, 30))) for _ in range(12)]
-    got = chrf_score(preds, target)
-    np.testing.assert_allclose(got, _oracle(preds, target), atol=1e-9)
+    preds = ["".join(rng.choice(vocab, rng.randint(3, 30))).strip() or "a" for _ in range(12)]
+    target = ["".join(rng.choice(vocab, rng.randint(3, 30))).strip() or "b" for _ in range(12)]
+    got = chrf_score(preds, target, eps_smoothing=eps_smoothing)
+    np.testing.assert_allclose(
+        got, _oracle(preds, target, eps_smoothing=eps_smoothing), atol=1e-7
+    )
+
+
+def test_mixed_length_corpus_vs_sacrebleu():
+    preds = ["the cat is on the mat", "ab", "x"]
+    target = ["the cat sat on the mat", "abcdefgh", "xyz"]
+    np.testing.assert_allclose(chrf_score(preds, target), _oracle(preds, target), atol=1e-9)
 
 
 def test_streaming_equals_corpus():
-    """Batch-streamed statistics equal the one-shot corpus score (the
-    sacrebleu sum-then-score aggregation, not a mean of batch scores)."""
+    """Batch-streamed statistics equal sacrebleu's one-shot corpus score
+    (the sum-then-score aggregation, not a mean of batch scores)."""
     rng = np.random.RandomState(7)
     vocab = list("abcde ")
-    preds = ["".join(rng.choice(vocab, rng.randint(4, 20))) for _ in range(9)]
-    target = ["".join(rng.choice(vocab, rng.randint(4, 20))) for _ in range(9)]
+    preds = ["".join(rng.choice(vocab, rng.randint(4, 20))).strip() or "a" for _ in range(9)]
+    target = ["".join(rng.choice(vocab, rng.randint(4, 20))).strip() or "b" for _ in range(9)]
     m = CHRFScore()
     for i in range(3):
         m.update(preds[i * 3:(i + 1) * 3], target[i * 3:(i + 1) * 3])
