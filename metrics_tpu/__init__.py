@@ -84,7 +84,7 @@ from metrics_tpu.retrieval import (  # noqa: E402
     RetrievalRPrecision,
     RetrievalRecall,
 )
-from metrics_tpu.text import BLEUScore, CHRFScore, CharErrorRate, MatchErrorRate, Perplexity, ROUGEScore, SQuAD, SacreBLEUScore, WER, WordInfoLost, WordInfoPreserved  # noqa: E402
+from metrics_tpu.text import BLEUScore, CHRFScore, CharErrorRate, MatchErrorRate, Perplexity, ROUGEScore, SQuAD, SacreBLEUScore, TranslationEditRate, WER, WordInfoLost, WordInfoPreserved  # noqa: E402
 from metrics_tpu.audio import PIT, SI_SDR, SI_SNR, SNR  # noqa: E402
 from metrics_tpu.detection import MeanAveragePrecision  # noqa: E402
 from metrics_tpu.nominal import (  # noqa: E402

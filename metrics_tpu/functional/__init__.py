@@ -105,6 +105,7 @@ from metrics_tpu.functional.text_perplexity import perplexity
 from metrics_tpu.functional.regression.ms_ssim import multiscale_ssim
 from metrics_tpu.functional.text_chrf import chrf_score
 from metrics_tpu.functional.text_sacrebleu import sacre_bleu_score
+from metrics_tpu.functional.text_ter import translation_edit_rate
 from metrics_tpu.functional.text_rouge import rouge_score
 from metrics_tpu.functional.regression.concordance import concordance_corrcoef
 from metrics_tpu.functional.text_squad import squad

@@ -10,3 +10,4 @@ from metrics_tpu.text.bleu import BLEUScore, SacreBLEUScore
 from metrics_tpu.text.chrf import CHRFScore
 from metrics_tpu.text.rouge import ROUGEScore
 from metrics_tpu.text.squad import SQuAD
+from metrics_tpu.text.ter import TranslationEditRate

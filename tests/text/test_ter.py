@@ -1,0 +1,104 @@
+"""TER vs the installed sacrebleu (exact Tercom-semantics parity)."""
+import numpy as np
+import pytest
+import sacrebleu
+
+from metrics_tpu import TranslationEditRate
+from metrics_tpu.functional import translation_edit_rate
+
+_TER = sacrebleu.metrics.ter.TER()
+
+
+def test_hand_cases():
+    # one deletion against a 6-word reference
+    assert translation_edit_rate(
+        ["the cat sat on mat"], [["the cat sat on the mat"]]
+    ) == pytest.approx(1 / 6)
+    # one block shift = one edit
+    assert translation_edit_rate(["b a c d"], [["a b c d"]]) == pytest.approx(0.25)
+    assert translation_edit_rate(["a b c d"], [["a b c d"]]) == 0.0
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_random_pairs_vs_sacrebleu(seed):
+    rng = np.random.RandomState(seed)
+    vocab = ["the", "cat", "dog", "sat", "on", "mat", "a", "ran", "big", "red"]
+    for _ in range(60):
+        hyp = " ".join(rng.choice(vocab, rng.randint(1, 14)))
+        ref = " ".join(rng.choice(vocab, rng.randint(1, 14)))
+        got = translation_edit_rate([hyp], [[ref]])
+        want = _TER.corpus_score([hyp], [[ref]]).score / 100
+        np.testing.assert_allclose(got, want, atol=1e-9, err_msg=f"{hyp!r} vs {ref!r}")
+
+
+def test_corpus_and_multiref_vs_sacrebleu():
+    preds = ["the cat is on the mat", "a big red dog ran", "mat the on cat"]
+    target = [
+        ["the cat sat on the mat", "a cat is on the mat"],
+        ["the big red dog ran fast", "a big dog ran"],
+        ["the cat on the mat"],
+    ]
+    got = translation_edit_rate(preds, target)
+    refs_t = [
+        [target[i][j] if j < len(target[i]) else target[i][-1] for i in range(len(preds))]
+        for j in range(2)
+    ]
+    want = _TER.corpus_score(preds, refs_t).score / 100
+    np.testing.assert_allclose(got, want, atol=1e-9)
+
+
+@pytest.mark.parametrize("seed", [42, 43])
+def test_long_length_mismatched_pairs_vs_sacrebleu(seed):
+    """Long and severely length-mismatched pairs exercise the beam-pruned
+    edit-distance regime (sacrebleu's pseudo-diagonal beam, width 25)."""
+    rng = np.random.RandomState(seed)
+    vocab = ["the", "cat", "dog", "sat", "on", "mat", "a", "ran"]
+    for trial in range(15):
+        n_h = rng.randint(1, 100)
+        n_r = rng.randint(1, 100)
+        hyp = " ".join(rng.choice(vocab, n_h))
+        ref = " ".join(rng.choice(vocab, n_r))
+        got = translation_edit_rate([hyp], [[ref]])
+        want = _TER.corpus_score([hyp], [[ref]]).score / 100
+        np.testing.assert_allclose(got, want, atol=1e-9, err_msg=f"{n_h} vs {n_r} words")
+
+
+def test_flat_string_target_raises():
+    with pytest.raises(ValueError, match="wrap it"):
+        translation_edit_rate(["the cat"], ["the cat"])
+
+
+def test_case_sensitivity():
+    insensitive = translation_edit_rate(["The Cat"], [["the cat"]])
+    sensitive = translation_edit_rate(["The Cat"], [["the cat"]], case_sensitive=True)
+    assert insensitive == 0.0 and sensitive > 0.0
+    want = sacrebleu.metrics.ter.TER(case_sensitive=True).corpus_score(
+        ["The Cat"], [["the cat"]]).score / 100
+    np.testing.assert_allclose(sensitive, want, atol=1e-9)
+
+
+def test_streaming_equals_corpus():
+    preds = ["the cat is on the mat", "a big red dog ran"]
+    target = [["the cat sat on the mat"], ["a big dog ran fast"]]
+    m = TranslationEditRate()
+    m.update(preds[:1], target[:1])
+    m.update(preds[1:], target[1:])
+    np.testing.assert_allclose(
+        float(m.compute()), translation_edit_rate(preds, target), atol=1e-6
+    )
+    m.reset()
+    assert float(m.compute()) == 0.0
+
+
+def test_empty_reference_conventions():
+    # empty ref, non-empty hyp: every hyp word is an edit, rate 1.0
+    assert translation_edit_rate(["a b"], [[""]]) == 1.0
+    # both empty: 0.0
+    assert translation_edit_rate([""], [[""]]) == 0.0
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="sentences"):
+        translation_edit_rate(["a", "b"], [["a"]])
+    with pytest.raises(ValueError, match="reference"):
+        translation_edit_rate(["a"], [[]])
