@@ -29,6 +29,7 @@ from metrics_tpu.classification import (  # noqa: E402
     CohenKappa,
     ConfusionMatrix,
     CoverageError,
+    CriticalSuccessIndex,
     Dice,
     ExactMatch,
     F1,
@@ -49,6 +50,7 @@ from metrics_tpu.classification import (  # noqa: E402
 )
 from metrics_tpu.regression import (  # noqa: E402
     ConcordanceCorrCoef,
+    RelativeSquaredError,
     CosineSimilarity,
     ErrorRelativeGlobalDimensionlessSynthesis,
     PSNR,
@@ -84,7 +86,7 @@ from metrics_tpu.retrieval import (  # noqa: E402
     RetrievalRPrecision,
     RetrievalRecall,
 )
-from metrics_tpu.text import BLEUScore, CHRFScore, CharErrorRate, MatchErrorRate, Perplexity, ROUGEScore, SQuAD, SacreBLEUScore, TranslationEditRate, WER, WordInfoLost, WordInfoPreserved  # noqa: E402
+from metrics_tpu.text import BLEUScore, CHRFScore, CharErrorRate, MatchErrorRate, EditDistance, Perplexity, ROUGEScore, SQuAD, SacreBLEUScore, TranslationEditRate, WER, WordInfoLost, WordInfoPreserved  # noqa: E402
 from metrics_tpu.audio import PIT, SI_SDR, SI_SNR, SNR  # noqa: E402
 from metrics_tpu.detection import MeanAveragePrecision  # noqa: E402
 from metrics_tpu.nominal import (  # noqa: E402

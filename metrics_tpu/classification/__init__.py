@@ -1,4 +1,5 @@
 from metrics_tpu.classification.accuracy import Accuracy
+from metrics_tpu.classification.csi import CriticalSuccessIndex
 from metrics_tpu.classification.exact_match import ExactMatch
 from metrics_tpu.classification.auc import AUC
 from metrics_tpu.classification.auroc import AUROC

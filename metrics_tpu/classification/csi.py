@@ -1,0 +1,53 @@
+"""CriticalSuccessIndex module. Extension beyond the reference snapshot
+(later torchmetrics ``regression/csi.py``): the threat score
+TP / (TP + FN + FP) used in forecast verification — predictions and
+targets are thresholded to events, correct negatives are ignored."""
+from typing import Any, Callable, Optional
+
+import numpy as np
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.classification.csi import _csi_compute, _csi_update
+from metrics_tpu.utils.data import accum_int_dtype
+
+
+class CriticalSuccessIndex(Metric):
+    """Accumulated CSI: integer TP and (FP + FN) sums stream across batches
+    and psum-sync; nan when no event is predicted or observed.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> metric = CriticalSuccessIndex(threshold=0.5)
+        >>> float(metric(jnp.array([0.9, 0.4]), jnp.array([1.0, 0.0])))
+        1.0
+    """
+
+    def __init__(
+        self,
+        threshold: float = 0.5,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+        jit: Optional[bool] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+            jit=jit,
+        )
+        self.threshold = float(threshold)
+        self.add_state("tp", default=np.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
+        self.add_state("fp_fn", default=np.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        tp, fp_fn = _csi_update(preds, target, self.threshold)
+        self.tp = self.tp + tp
+        self.fp_fn = self.fp_fn + fp_fn
+
+    def compute(self) -> Array:
+        return _csi_compute(jnp.asarray(self.tp), jnp.asarray(self.fp_fn))

@@ -106,6 +106,8 @@ from metrics_tpu.functional.regression.ms_ssim import multiscale_ssim
 from metrics_tpu.functional.text_chrf import chrf_score
 from metrics_tpu.functional.text_sacrebleu import sacre_bleu_score
 from metrics_tpu.functional.text_ter import translation_edit_rate
+from metrics_tpu.functional.text_edit import edit_distance
+from metrics_tpu.functional.classification.csi import critical_success_index
 from metrics_tpu.functional.text_rouge import rouge_score
 from metrics_tpu.functional.regression.concordance import concordance_corrcoef
 from metrics_tpu.functional.text_squad import squad

@@ -1,0 +1,39 @@
+"""Critical success index (threat score) functional. Extension beyond the
+reference snapshot (later torchmetrics ships ``CriticalSuccessIndex``)."""
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.data import accum_int_dtype
+
+
+def _csi_update(preds: Array, target: Array, threshold: float) -> Tuple[Array, Array]:
+    """(TP, FP + FN) event counts — integer, "sum"-reducible."""
+    _check_same_shape(preds, target)
+    p = preds >= threshold
+    t = target >= threshold
+    dtype = accum_int_dtype()
+    return jnp.sum(p & t, dtype=dtype), jnp.sum(p != t, dtype=dtype)
+
+
+def _csi_compute(tp: Array, fp_fn: Array) -> Array:
+    tp = tp.astype(jnp.float32)
+    denom = tp + fp_fn.astype(jnp.float32)
+    return jnp.where(denom > 0, tp / jnp.where(denom > 0, denom, 1.0), jnp.nan)
+
+
+def critical_success_index(preds: Array, target: Array, threshold: float) -> Array:
+    """One-shot CSI (threat score) at ``threshold``: TP / (TP + FN + FP);
+    correct negatives are ignored. ``nan`` when no event is predicted or
+    observed.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([0.9, 0.4, 0.8, 0.1])
+        >>> target = jnp.array([1.0, 0.0, 0.0, 1.0])
+        >>> round(float(critical_success_index(preds, target, threshold=0.5)), 4)
+        0.3333
+    """
+    return _csi_compute(*_csi_update(preds, target, threshold))

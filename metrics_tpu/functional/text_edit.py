@@ -1,0 +1,41 @@
+"""Character-level edit distance functional. Extension beyond the reference
+snapshot (later torchmetrics ``text/edit.py``): raw Levenshtein distance,
+unnormalized — unlike CER, which divides by the reference length."""
+from typing import Optional, Sequence, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.text import _np_edit_distance
+
+
+def edit_distance(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str]],
+    reduction: Optional[str] = "mean",
+) -> Array:
+    """Character-level Levenshtein distance between sentence pairs.
+
+    ``reduction``: ``"mean"`` (average distance per pair), ``"sum"``, or
+    ``None`` (per-pair vector).
+
+    Example:
+        >>> float(edit_distance(["abcd"], ["abce"]))
+        1.0
+        >>> [float(v) for v in edit_distance(["ab", "xyz"], ["ac", "xyz"], reduction=None)]
+        [1.0, 0.0]
+    """
+    if reduction not in ("mean", "sum", None):
+        raise ValueError(f"`reduction` must be 'mean', 'sum' or None, got {reduction!r}")
+    preds = [preds] if isinstance(preds, str) else list(preds)
+    target = [target] if isinstance(target, str) else list(target)
+    if len(preds) != len(target):
+        raise ValueError(f"preds has {len(preds)} sentences, target {len(target)}")
+    dists = jnp.asarray(
+        [_np_edit_distance(list(p), list(t)) for p, t in zip(preds, target)], dtype=jnp.float32
+    )
+    if reduction == "mean":
+        return jnp.mean(dists) if dists.shape[0] else jnp.asarray(jnp.nan)
+    if reduction == "sum":
+        return jnp.sum(dists)
+    return dists

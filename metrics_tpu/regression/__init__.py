@@ -7,6 +7,7 @@ from metrics_tpu.regression.mean_squared_log_error import MeanSquaredLogError
 from metrics_tpu.regression.pearson import PearsonCorrcoef
 from metrics_tpu.regression.psnr import PSNR
 from metrics_tpu.regression.r2score import R2Score
+from metrics_tpu.regression.relative_squared import RelativeSquaredError
 from metrics_tpu.regression.kendall import KendallRankCorrCoef
 from metrics_tpu.regression.spearman import SpearmanCorrcoef
 from metrics_tpu.regression.total_variation import TotalVariation

@@ -1,0 +1,89 @@
+"""RelativeSquaredError module. Extension beyond the reference snapshot
+(later torchmetrics ``regression/rse.py``).
+
+RSE = sum((t - p)^2) / sum((t - mean(t))^2) over the WHOLE epoch — the
+denominator needs the global target mean, so the streamed statistics are
+the raw moments (sum of squared errors, sum t, sum t^2, count), all
+"sum"-reducible; the denominator expands to ``sum t^2 - n * mean^2`` at
+compute. ``num_outputs`` keeps per-column moments; ``squared=False``
+returns the root.
+"""
+from typing import Any, Callable, Optional
+
+import numpy as np
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.data import upcast_accum
+
+
+class RelativeSquaredError(Metric):
+    r"""Accumulated relative squared error (optionally rooted).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> metric = RelativeSquaredError()
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> round(float(metric(preds, target)), 4)
+        0.0514
+    """
+
+    def __init__(
+        self,
+        num_outputs: int = 1,
+        squared: bool = True,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        if not isinstance(num_outputs, int) or num_outputs < 1:
+            raise ValueError(f"`num_outputs` must be a positive int, got {num_outputs!r}")
+        self.num_outputs = num_outputs
+        self.squared = squared
+        shape = (num_outputs,)
+        self.add_state("sum_sq_error", default=np.zeros(shape), dist_reduce_fx="sum")
+        self.add_state("sum_target", default=np.zeros(shape), dist_reduce_fx="sum")
+        self.add_state("sum_sq_target", default=np.zeros(shape), dist_reduce_fx="sum")
+        self.add_state("total", default=np.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        _check_same_shape(preds, target)
+        preds = upcast_accum(jnp.asarray(preds))
+        target = upcast_accum(jnp.asarray(target))
+        if self.num_outputs == 1:
+            if preds.ndim == 2 and preds.shape[1] == 1:
+                preds, target = preds[:, 0], target[:, 0]
+            if preds.ndim != 1:
+                raise ValueError(
+                    f"Expected 1-D inputs (or (N, 1)) with num_outputs=1, got {preds.shape}"
+                )
+            preds, target = preds[:, None], target[:, None]
+        else:
+            if preds.ndim != 2 or preds.shape[1] != self.num_outputs:
+                raise ValueError(
+                    f"Expected (N, {self.num_outputs}) inputs, got {preds.shape}"
+                )
+        self.sum_sq_error = self.sum_sq_error + jnp.sum((target - preds) ** 2, axis=0)
+        self.sum_target = self.sum_target + jnp.sum(target, axis=0)
+        self.sum_sq_target = self.sum_sq_target + jnp.sum(target**2, axis=0)
+        self.total = self.total + target.shape[0]
+
+    def compute(self) -> Array:
+        n = jnp.maximum(self.total, 1.0)
+        denom = self.sum_sq_target - self.sum_target**2 / n
+        rse = jnp.where(denom > 0, self.sum_sq_error / jnp.where(denom > 0, denom, 1.0), jnp.nan)
+        if not self.squared:
+            rse = jnp.sqrt(rse)
+        # reference parity (later torchmetrics regression/rse.py): one scalar,
+        # the mean over outputs
+        return jnp.mean(rse)

@@ -8,6 +8,7 @@ from metrics_tpu.text.error_rates import (
 from metrics_tpu.text.perplexity import Perplexity
 from metrics_tpu.text.bleu import BLEUScore, SacreBLEUScore
 from metrics_tpu.text.chrf import CHRFScore
+from metrics_tpu.text.edit import EditDistance
 from metrics_tpu.text.rouge import ROUGEScore
 from metrics_tpu.text.squad import SQuAD
 from metrics_tpu.text.ter import TranslationEditRate

@@ -1,0 +1,64 @@
+"""EditDistance module. Extension beyond the reference snapshot (later
+torchmetrics ``text/edit.py``); the functional form is
+``metrics_tpu.functional.edit_distance``."""
+from typing import Any, Callable, Optional, Sequence, Union
+
+import numpy as np
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.text import _np_edit_distance
+from metrics_tpu.utils.data import accum_int_dtype
+
+
+class EditDistance(Metric):
+    """Accumulated character-level edit distance over all sentence pairs
+    seen (``reduction="mean"``: total distance / total pairs; ``"sum"``:
+    total distance). Two scalar sum-states — streams and sum-syncs.
+
+    Example:
+        >>> metric = EditDistance()
+        >>> float(metric(["abcd"], ["abce"]))
+        1.0
+    """
+
+    def __init__(
+        self,
+        reduction: str = "mean",
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+            jit=False,  # update consumes host strings; the fused step cannot trace them
+        )
+        if reduction not in ("mean", "sum"):
+            raise ValueError(f"`reduction` must be 'mean' or 'sum', got {reduction!r}")
+        self.reduction = reduction
+        self.add_state("total_distance", default=np.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
+        self.add_state("pairs", default=np.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> None:
+        preds = [preds] if isinstance(preds, str) else list(preds)
+        target = [target] if isinstance(target, str) else list(target)
+        if len(preds) != len(target):
+            raise ValueError(f"preds has {len(preds)} sentences, target {len(target)}")
+        batch = sum(_np_edit_distance(list(p), list(t)) for p, t in zip(preds, target))
+        # bound on what this update ADDS to the int states: distance per pair
+        # is at most max(len(p), len(t)), summed over the batch
+        self.note_count(sum(max(len(p), len(t)) for p, t in zip(preds, target)))
+        self.total_distance = self.total_distance + batch
+        self.pairs = self.pairs + len(preds)
+
+    def compute(self) -> Array:
+        total = jnp.asarray(self.total_distance, dtype=jnp.float32)
+        if self.reduction == "sum":
+            return total
+        pairs = jnp.asarray(self.pairs, dtype=jnp.float32)
+        return jnp.where(pairs == 0, jnp.nan, total / jnp.maximum(pairs, 1.0))

@@ -1,0 +1,96 @@
+"""EditDistance, RelativeSquaredError, CriticalSuccessIndex vs oracles."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import CriticalSuccessIndex, EditDistance, RelativeSquaredError
+from metrics_tpu.functional import critical_success_index, edit_distance
+
+_rng = np.random.RandomState(23)
+
+
+# ------------------------------------------------------------- EditDistance
+def test_edit_distance_functional():
+    assert float(edit_distance(["abcd"], ["abce"])) == 1.0
+    assert float(edit_distance(["ab", "xyz"], ["ac", "xyz"], reduction="sum")) == 1.0
+    out = edit_distance(["kitten"], ["sitting"], reduction=None)
+    assert [float(v) for v in out] == [3.0]
+    with pytest.raises(ValueError, match="reduction"):
+        edit_distance(["a"], ["a"], reduction="max")
+    with pytest.raises(ValueError, match="sentences"):
+        edit_distance(["a", "b"], ["a"])
+
+
+def test_edit_distance_streaming():
+    m = EditDistance()
+    m.update(["kitten"], ["sitting"])  # 3
+    m.update(["abc", "abc"], ["abc", "axc"])  # 0 + 1
+    np.testing.assert_allclose(float(m.compute()), 4 / 3, atol=1e-6)
+    s = EditDistance(reduction="sum")
+    s.update(["kitten"], ["sitting"])
+    assert float(s.compute()) == 3.0
+    m.reset()
+    assert np.isnan(float(m.compute()))
+    with pytest.raises(ValueError, match="reduction"):
+        EditDistance(reduction="none")
+
+
+# ------------------------------------------------------ RelativeSquaredError
+def test_rse_matches_numpy():
+    p = _rng.randn(64).astype(np.float32)
+    t = _rng.randn(64).astype(np.float32)
+    want = np.sum((t - p) ** 2) / np.sum((t - t.mean()) ** 2)
+    m = RelativeSquaredError()
+    m.update(jnp.asarray(p[:32]), jnp.asarray(t[:32]))
+    m.update(jnp.asarray(p[32:]), jnp.asarray(t[32:]))
+    np.testing.assert_allclose(float(m.compute()), want, rtol=1e-5)
+    r = RelativeSquaredError(squared=False)
+    r.update(jnp.asarray(p), jnp.asarray(t))
+    np.testing.assert_allclose(float(r.compute()), np.sqrt(want), rtol=1e-5)
+
+
+def test_rse_multioutput():
+    p = _rng.randn(40, 3).astype(np.float32)
+    t = _rng.randn(40, 3).astype(np.float32)
+    # reference parity: one scalar, the mean over per-output RSEs
+    want = np.mean(np.sum((t - p) ** 2, axis=0) / np.sum((t - t.mean(0)) ** 2, axis=0))
+    m = RelativeSquaredError(num_outputs=3)
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    np.testing.assert_allclose(float(m.compute()), want, rtol=1e-4)
+
+
+def test_rse_shape_validation():
+    with pytest.raises(ValueError, match="num_outputs=1"):
+        m = RelativeSquaredError()
+        m.update(jnp.zeros((4, 3)), jnp.zeros((4, 3)))
+    with pytest.raises(ValueError, match="Expected \\(N, 2\\)"):
+        m = RelativeSquaredError(num_outputs=2)
+        m.update(jnp.zeros((4, 3)), jnp.zeros((4, 3)))
+
+
+def test_rse_constant_target_is_nan():
+    m = RelativeSquaredError()
+    m.update(jnp.asarray([1.0, 2.0]), jnp.asarray([3.0, 3.0]))
+    assert np.isnan(float(m.compute()))
+    with pytest.raises(ValueError, match="num_outputs"):
+        RelativeSquaredError(num_outputs=0)
+
+
+# ---------------------------------------------------- CriticalSuccessIndex
+def test_csi_hand_case():
+    preds = jnp.asarray([0.9, 0.4, 0.8, 0.1])
+    target = jnp.asarray([1.0, 0.0, 0.0, 1.0])
+    # TP=1 (first), FP=1 (third), FN=1 (fourth) -> 1/3... recompute: events
+    # pred: [T, F, T, F]; obs: [T, F, F, T] -> TP=1, mismatches=2 -> 1/3
+    np.testing.assert_allclose(float(critical_success_index(preds, target, 0.5)), 1 / 3)
+
+
+def test_csi_streaming_and_nan():
+    m = CriticalSuccessIndex(threshold=0.5)
+    m.update(jnp.asarray([0.9, 0.4]), jnp.asarray([1.0, 0.0]))
+    assert float(m.compute()) == 1.0
+    m.update(jnp.asarray([0.9]), jnp.asarray([0.0]))  # one FP: TP=1, FP=1
+    np.testing.assert_allclose(float(m.compute()), 0.5)
+    empty = CriticalSuccessIndex()
+    empty.update(jnp.asarray([0.1]), jnp.asarray([0.0]))  # no events at all
+    assert np.isnan(float(empty.compute()))
