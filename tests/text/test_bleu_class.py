@@ -85,7 +85,9 @@ def test_corpus_vs_installed_sacrebleu(tokenize):
     want = sacrebleu.corpus_bleu(
         preds, refs_t, smooth_method="none", tokenize=tokenize, force=True
     ).score / 100.0
-    np.testing.assert_allclose(got, want, atol=1e-6, err_msg=tokenize)
+    # device f32 exp/log in the geometric mean differ ~2e-5 from sacrebleu's
+    # f64 on TPU (the PSNR/dB tolerance policy); statistics are exact
+    np.testing.assert_allclose(got, want, atol=1e-4, err_msg=tokenize)
 
 
 def test_sacre_bleu_vs_manual_tokenization():
