@@ -85,9 +85,12 @@ def test_corpus_vs_installed_sacrebleu(tokenize):
     want = sacrebleu.corpus_bleu(
         preds, refs_t, smooth_method="none", tokenize=tokenize, force=True
     ).score / 100.0
-    # device f32 exp/log in the geometric mean differ ~2e-5 from sacrebleu's
-    # f64 on TPU (the PSNR/dB tolerance policy); statistics are exact
-    np.testing.assert_allclose(got, want, atol=1e-4, err_msg=tokenize)
+    # TPU f32 exp/log in the geometric mean differ ~2e-5 from sacrebleu's
+    # f64 (statistics are exact); CPU keeps the tight differential guard
+    import os
+
+    atol = 1e-4 if os.environ.get("METRICS_TPU_TEST_PLATFORM") == "tpu" else 1e-6
+    np.testing.assert_allclose(got, want, atol=atol, err_msg=tokenize)
 
 
 def test_sacre_bleu_vs_manual_tokenization():
