@@ -21,7 +21,11 @@ CPU — the single-device deployment number.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...} where
 value is our 8-device sync-in-the-loop ms/step and vs_baseline =
-reference_ms / our_ms (>1 means we are faster than the reference).
+reference_ms / our_ms (>1 means we are faster than the reference). The line
+also carries the compute-groups A/B ("grouped_sync8_ms" vs
+"ungrouped_sync8_ms", with "states_synced" counts) so BENCH_r* tracks the
+group/coalescing gain. ``--smoke`` runs a 2-step, no-reference version with
+the same headline schema for CI (tests/integrations/test_bench_smoke.py).
 """
 import json
 import os
@@ -48,7 +52,7 @@ NUM_CLASSES = 32
 FEATURES = 256
 
 
-def _collection_ours():
+def _collection_ours(compute_groups: bool = True):
     from metrics_tpu import Accuracy, F1, MetricCollection, Precision, Recall
 
     return MetricCollection([
@@ -56,19 +60,33 @@ def _collection_ours():
         F1(num_classes=NUM_CLASSES, average="macro"),
         Precision(num_classes=NUM_CLASSES, average="macro"),
         Recall(num_classes=NUM_CLASSES, average="macro"),
-    ])
+    ], compute_groups=compute_groups)
 
 
-def bench_ours_sync8() -> float:
-    """Per-step update + psum-sync + compute of the collection over an
-    8-device mesh (the metric of record). Runs on virtual CPU devices."""
+def _shard_map(fn, mesh, in_specs, out_specs):
+    """jax.shard_map on current jax; the experimental module on older jax."""
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+def _build_sync8_runner(compute_groups: bool):
+    """(timed_run(steps) -> ms/step, states_synced) for one A/B variant.
+
+    ``states_synced`` counts the state leaves entering the per-step
+    collective sync — compute groups shrink it (one state pytree per
+    group), coalesced sync then buckets what remains.
+    """
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     from jax.sharding import Mesh, PartitionSpec as P
 
-    pure = _collection_ours().pure()
+    pure = _collection_ours(compute_groups).pure()
     mesh = Mesh(np.array(jax.devices("cpu")[:N_DEVICES]), ("dp",))
 
     def step(state, preds, target):
@@ -79,9 +97,7 @@ def bench_ours_sync8() -> float:
         return state, pure.compute(state)
 
     sharded_step = jax.jit(
-        jax.shard_map(
-            step, mesh=mesh, in_specs=(P(), P("dp"), P("dp")), out_specs=(P(), P())
-        )
+        _shard_map(step, mesh, in_specs=(P(), P("dp"), P("dp")), out_specs=(P(), P()))
     )
 
     rng = np.random.RandomState(0)
@@ -90,16 +106,51 @@ def bench_ours_sync8() -> float:
     preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
     target = jnp.asarray(rng.randint(0, NUM_CLASSES, batch).astype(np.int32))
 
-    state = pure.init()
-    out = None
-    for _ in range(WARMUP):
-        state, out = sharded_step(state, preds, target)
-    jax.block_until_ready(out)
-    start = time.perf_counter()
-    for _ in range(N_STEPS):
-        state, out = sharded_step(state, preds, target)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - start) / N_STEPS * 1e3
+    states_synced = len(jax.tree_util.tree_leaves(pure.init()))
+
+    def run(steps: int) -> float:
+        state = pure.init()
+        out = None
+        start = time.perf_counter()
+        for _ in range(steps):
+            state, out = sharded_step(state, preds, target)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - start) / steps * 1e3
+
+    return run, states_synced
+
+
+def bench_ours_sync8(compute_groups: bool = True, steps: int = N_STEPS, warmup: int = WARMUP):
+    """Per-step update + psum-sync + compute of the collection over an
+    8-device mesh (the metric of record). Runs on virtual CPU devices."""
+    run, states_synced = _build_sync8_runner(compute_groups)
+    run(warmup)
+    return run(steps), states_synced
+
+
+def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3) -> dict:
+    """Compute-groups on/off A/B over the same 8-device mesh program.
+
+    The two variants are timed in INTERLEAVED rounds and reported as the
+    best-of — a monotonic load drift would otherwise bias whichever variant
+    ran second (the A/B is a difference of two absolute measurements).
+    """
+    run_grouped, states_grouped = _build_sync8_runner(True)
+    run_ungrouped, states_ungrouped = _build_sync8_runner(False)
+    run_grouped(warmup)
+    run_ungrouped(warmup)
+    grouped_times, ungrouped_times = [], []
+    for _ in range(repeats):
+        grouped_times.append(run_grouped(steps))
+        ungrouped_times.append(run_ungrouped(steps))
+    grouped_ms = min(grouped_times)
+    ungrouped_ms = min(ungrouped_times)
+    return {
+        "grouped_sync8_ms": grouped_ms,
+        "ungrouped_sync8_ms": ungrouped_ms,
+        "states_synced": states_grouped,
+        "states_synced_ungrouped": states_ungrouped,
+    }
 
 
 def _ref_sync8_worker(rank: int, world_size: int, steps: int, out_q) -> None:
@@ -270,6 +321,16 @@ def bench_reference_eager_update() -> float:
     return (time.perf_counter() - start) / N_STEPS * 1e3
 
 
+def _metric_description() -> str:
+    return (
+        "per-step update+psum-sync+compute of MetricCollection(Accuracy,F1,"
+        f"Precision,Recall), dist_sync_on_step, 8 devices ({BATCH_PER_DEVICE}"
+        f"x{NUM_CLASSES} per device; ours: shard_map on 8 virtual CPU devices,"
+        " compute groups + coalesced collectives, reference: torchmetrics"
+        " forward on 8-process Gloo)"
+    )
+
+
 def main() -> None:
     if len(sys.argv) > 1 and sys.argv[1] == "--sync8":
         # child process: CPU platform must be forced before backend init
@@ -277,7 +338,33 @@ def main() -> None:
             os.environ.get("XLA_FLAGS", "")
             + f" --xla_force_host_platform_device_count={N_DEVICES}"
         ).strip()
-        print(json.dumps({"ours_sync8_ms": bench_ours_sync8()}))
+        print(json.dumps(_sync8_ab()))
+        return
+
+    if len(sys.argv) > 1 and sys.argv[1] == "--smoke":
+        # CI smoke: 2 timed steps, no subprocess reference, same JSON schema
+        # for the headline keys (tests/integrations/test_bench_smoke.py
+        # validates it) — jax is not yet imported here, so the virtual-device
+        # flag can be set in-process
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={N_DEVICES}"
+        ).strip()
+        ab = _sync8_ab(steps=2, warmup=1)
+        print(
+            json.dumps(
+                {
+                    "metric": _metric_description(),
+                    "value": round(ab["grouped_sync8_ms"], 4),
+                    "unit": "ms/step",
+                    "grouped_sync8_ms": round(ab["grouped_sync8_ms"], 4),
+                    "ungrouped_sync8_ms": round(ab["ungrouped_sync8_ms"], 4),
+                    "states_synced": ab["states_synced"],
+                    "states_synced_ungrouped": ab["states_synced_ungrouped"],
+                    "smoke": True,
+                }
+            )
+        )
         return
 
     here = os.path.dirname(os.path.abspath(__file__))
@@ -291,7 +378,8 @@ def main() -> None:
         raise RuntimeError(
             f"--sync8 child failed (rc={child.returncode}):\n{child.stderr[-2000:]}"
         )
-    ours_sync8_ms = json.loads(child.stdout.strip().splitlines()[-1])["ours_sync8_ms"]
+    ab = json.loads(child.stdout.strip().splitlines()[-1])
+    ours_sync8_ms = ab["grouped_sync8_ms"]
 
     try:
         ref_sync8_ms = bench_reference_sync8()
@@ -315,18 +403,20 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "per-step update+psum-sync+compute of MetricCollection(Accuracy,F1,"
-                          f"Precision,Recall), dist_sync_on_step, 8 devices ({BATCH_PER_DEVICE}"
-                          f"x{NUM_CLASSES} per device; ours: shard_map on 8 virtual CPU devices,"
-                          " reference: torchmetrics forward on 8-process Gloo)",
+                "metric": _metric_description(),
                 "value": round(ours_sync8_ms, 4),
                 "unit": "ms/step",
                 "vs_baseline": round(vs_baseline, 3),
                 "reference_sync8_ms": round(ref_sync8_ms, 4),
+                "grouped_sync8_ms": round(ab["grouped_sync8_ms"], 4),
+                "ungrouped_sync8_ms": round(ab["ungrouped_sync8_ms"], 4),
+                "states_synced": ab["states_synced"],
+                "states_synced_ungrouped": ab["states_synced_ungrouped"],
                 "singlechip_fused_update_ms": round(ours_fused_ms, 4),
                 "singlechip_reference_eager_update_ms": round(ref_eager_ms, 4),
                 "singlechip_vs_reference": round(fused_vs_ref, 3),
                 "singlechip_marginal_at_floor": marginal_at_floor,
+                "smoke": False,
             }
         )
     )

@@ -24,6 +24,7 @@ PAGES = [
     (DOCS / "quickstart.md", "Quickstart"),
     (DOCS / "overview.md", "Architecture overview"),
     (DOCS / "training_integration.md", "Training integration (flax/optax)"),
+    (DOCS / "collection_performance.md", "MetricCollection performance"),
     (DOCS / "implement.md", "Implementing a metric"),
     (DOCS / "api.md", "API reference"),
 ]
@@ -112,8 +113,9 @@ def build(outdir: Path) -> int:
         if not src.exists():
             print(f"skip (missing): {src}", file=sys.stderr)
             continue
+        active = ' class="active"'
         nav = "\n".join(
-            f'<a href="{_out_name(s)}"{" class=\"active\"" if s == src else ""}>{t}</a>'
+            f'<a href="{_out_name(s)}"{active if s == src else ""}>{t}</a>'
             for s, t in PAGES if s.exists()
         )
         md.reset()

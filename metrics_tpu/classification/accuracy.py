@@ -26,6 +26,10 @@ class Accuracy(Metric):
         0.5
     """
 
+    # compute-group key: two Accuracy instances with the same thresholding
+    # config share one update delta inside a MetricCollection
+    _GROUP_UPDATE_ATTRS = ("threshold", "top_k", "subset_accuracy")
+
     def __init__(
         self,
         threshold: float = 0.5,

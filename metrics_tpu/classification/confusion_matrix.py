@@ -30,6 +30,10 @@ class ConfusionMatrix(Metric):
                [1., 1.]], dtype=float32)
     """
 
+    # compute-group key: ``normalize`` is compute-only, so e.g. a raw and a
+    # row-normalized ConfusionMatrix over the same classes share one update
+    _GROUP_UPDATE_ATTRS = ("num_classes", "threshold")
+
     def __init__(
         self,
         num_classes: int,

@@ -29,6 +29,8 @@ class HammingDistance(Metric):
         0.25
     """
 
+    _GROUP_UPDATE_ATTRS = ("threshold",)
+
     def __init__(
         self,
         threshold: float = 0.5,

@@ -33,6 +33,18 @@ class StatScores(Metric):
                [1, 0, 3, 0, 1]], dtype=int32)
     """
 
+    # MetricCollection compute groups: every StatScores-family metric
+    # (Precision, Recall, F1/FBeta, Specificity, ...) runs the SAME
+    # ``_stat_scores_update`` over tp/fp/tn/fn; only compute differs. These
+    # are the update-relevant config attrs — matching values (and matching
+    # state schema) let a whole collection share one update per step.
+    # Compute-only config (FBeta.beta, the subclasses' ``average``) is
+    # deliberately absent.
+    _GROUP_UPDATE_ATTRS = (
+        "reduce", "mdmc_reduce", "num_classes", "threshold", "is_multiclass",
+        "ignore_index", "top_k",
+    )
+
     def __init__(
         self,
         threshold: float = 0.5,

@@ -24,7 +24,14 @@ _COL_STEP_CACHE_LOCK = _threading.Lock()
 
 
 def _col_cache_key(collection: "MetricCollection", kind: str) -> Optional[Tuple[Any, list]]:
-    """(cache key, pinned referents) from the children's config fingerprints."""
+    """(cache key, pinned referents) from the children's config fingerprints.
+
+    The compute-groups flag is part of the key: a grouped and an ungrouped
+    collection over identical children trace DIFFERENT programs (one vs N
+    updates per group) and must never share a compiled step. The group
+    structure itself needs no extra key material — it is a pure function of
+    the child classes and config fingerprints already in the key.
+    """
     parts = []
     pins: list = []
     for name, metric in collection.items():
@@ -34,7 +41,7 @@ def _col_cache_key(collection: "MetricCollection", kind: str) -> Optional[Tuple[
         key_body, child_pins = fp
         parts.append((name, key_body))
         pins.extend(child_pins)
-    return (kind, tuple(parts)), pins
+    return (kind, getattr(collection, "_enable_compute_groups", True), tuple(parts)), pins
 
 
 class MetricCollection(OrderedDict):
@@ -56,8 +63,15 @@ class MetricCollection(OrderedDict):
         self,
         metrics: Union[List[Metric], Tuple[Metric, ...], Dict[str, Metric]],
         prefix: Optional[str] = None,
+        compute_groups: bool = True,
     ):
         super().__init__()
+        # compute groups: children whose update+state plane is identical
+        # (same update impl, state schema, update-relevant config — see
+        # Metric._group_fingerprint) share ONE update delta per step and ONE
+        # state entry in the pure/sync plane. ``compute_groups=False`` is the
+        # escape hatch restoring fully independent per-child execution.
+        self._enable_compute_groups = bool(compute_groups)
         if isinstance(metrics, dict):
             for name, metric in metrics.items():
                 if not isinstance(metric, Metric):
@@ -124,6 +138,39 @@ class MetricCollection(OrderedDict):
             self.__dict__["_col_fuse_failed"] = False
             self.__dict__["_col_batched_failed"] = False
             self.__dict__["_col_unfusable"] = False
+            # group assignment is membership-derived: any child swap (including
+            # same-key replacement, caught by the generation counter) rebuilds it
+            self.__dict__["_col_groups"] = None
+
+    # ---------------------------------------------------------- compute groups
+    def _group_map(self) -> Dict[str, str]:
+        """member name -> group representative name (identity map when off).
+
+        The representative is the group's first member in collection order;
+        cached under the same membership/generation guard as the fused steps,
+        so ``__setitem__``/``__delitem__`` rebuild it and clones re-derive it.
+        """
+        self._refresh_col_cache()
+        groups = self.__dict__.get("_col_groups")
+        if groups is None:
+            groups = {}
+            if getattr(self, "_enable_compute_groups", True):
+                reps: Dict[Any, str] = {}
+                for name, metric in self.items():
+                    key = metric._group_fingerprint()
+                    groups[name] = name if key is None else reps.setdefault(key, name)
+            else:
+                groups = {name: name for name in self.keys()}
+            self.__dict__["_col_groups"] = groups
+        return groups
+
+    @property
+    def compute_groups(self) -> Dict[str, Tuple[str, ...]]:
+        """The resolved groups: representative name -> member names."""
+        by_rep: "OrderedDict[str, list]" = OrderedDict()
+        for name, rep in self._group_map().items():
+            by_rep.setdefault(rep, []).append(name)
+        return {rep: tuple(members) for rep, members in by_rep.items()}
 
     def _forward_fused_collection(self, *args: Any, **kwargs: Any) -> Optional[Dict[str, Any]]:
         self._refresh_col_cache()
@@ -186,18 +233,27 @@ class MetricCollection(OrderedDict):
         carriers = {k: deepcopy(m) for k, m in self.items()}
         for c in carriers.values():
             c.reset()
+        group_of = dict(self._group_map())
         donate = (0,) if jax.default_backend() == "tpu" else ()
         lock = threading.Lock()
 
         def step(states, *args, **kwargs):
+            # one update per compute group; the shared delta merges into each
+            # member's OWN accumulator (members stay individually correct even
+            # if one was also updated outside the collection) and each member
+            # computes its batch value from the shared delta
+            deltas: Dict[str, Any] = {}
             new_states, values = {}, {}
             for k, c in carriers.items():
-                kw = c._filter_kwargs(**kwargs)
+                rep = group_of[k]
+                if rep not in deltas:
+                    rc = carriers[rep]
+                    kw = rc._filter_kwargs(**kwargs)
+                    with lock:
+                        deltas[rep] = rc._run_update_on_state(rc.init_state(), *args, **kw)
+                new_states[k] = c.merge_states(states[k], deltas[rep])
                 with lock:
-                    delta = c._run_update_on_state(c.init_state(), *args, **kw)
-                new_states[k] = c.merge_states(states[k], delta)
-                with lock:
-                    values[k] = c.compute_from_state(delta)
+                    values[k] = c.compute_from_state(deltas[rep])
             return new_states, values
 
         return jax.jit(step, donate_argnums=donate)
@@ -265,21 +321,30 @@ class MetricCollection(OrderedDict):
         carriers = {k: deepcopy(m) for k, m in self.items()}
         for c in carriers.values():
             c.reset()
+        group_of = dict(self._group_map())
         donate = (0,) if jax.default_backend() == "tpu" else ()
         lock = threading.Lock()
 
         def step(states, *args, **kwargs):
+            # the batched analogue of the grouped per-step program: ONE
+            # vmap-ed update per compute group, its stacked deltas shared by
+            # every member for the fold, the per-step values, and the epoch
+            group_deltas: Dict[str, Any] = {}
             new_states, values, epochs = {}, {}, {}
             for k, c in carriers.items():
-                kw = c._filter_kwargs(**kwargs)
+                rep = group_of[k]
+                if rep not in group_deltas:
+                    rc = carriers[rep]
+                    kw = rc._filter_kwargs(**kwargs)
 
-                def one(*batch, _c=c, _kw_keys=tuple(kw)):
-                    batch_args = batch[: len(args)]
-                    batch_kw = dict(zip(_kw_keys, batch[len(args):]))
-                    with lock:
-                        return _c._run_update_on_state(_c.init_state(), *batch_args, **batch_kw)
+                    def one(*batch, _c=rc, _kw_keys=tuple(kw)):
+                        batch_args = batch[: len(args)]
+                        batch_kw = dict(zip(_kw_keys, batch[len(args):]))
+                        with lock:
+                            return _c._run_update_on_state(_c.init_state(), *batch_args, **batch_kw)
 
-                deltas = jax.vmap(one)(*args, *kw.values())
+                    group_deltas[rep] = jax.vmap(one)(*args, *kw.values())
+                deltas = group_deltas[rep]
                 new_states[k] = {
                     name: merge_values_stacked(c._reductions[name], states[k][name], deltas[name])
                     for name in c._defaults
@@ -309,15 +374,23 @@ class MetricCollection(OrderedDict):
 
     # fused-step cache attrs never travel to copies/pickles: the copy's
     # membership key differs, so it re-derives its own verdict lazily
+    # (group assignment included — it is membership-derived state)
     _COL_CACHE_ATTRS = (
         "_col_step", "_col_batched_step", "_col_membership", "_col_fuse_failed",
-        "_col_batched_failed", "_col_unfusable",
+        "_col_batched_failed", "_col_unfusable", "_col_groups",
     )
 
     def __deepcopy__(self, memo: dict) -> "MetricCollection":
         # dict-subclass default reduce would re-invoke __init__ with an items
-        # iterator; rebuild explicitly (type(self) keeps subclasses intact)
-        new = type(self)({k: deepcopy(m, memo) for k, m in self.items()}, prefix=self.prefix)
+        # iterator; rebuild explicitly (type(self) keeps subclasses intact).
+        # The compute-groups flag must ride the constructor: __init__ writes
+        # its default into new.__dict__, which the not-in-new.__dict__ guard
+        # below would then never overwrite.
+        new = type(self)(
+            {k: deepcopy(m, memo) for k, m in self.items()},
+            prefix=self.prefix,
+            compute_groups=getattr(self, "_enable_compute_groups", True),
+        )
         memo[id(self)] = new
         for key, value in self.__dict__.items():
             if key not in new.__dict__ and key not in self._COL_CACHE_ATTRS:
@@ -330,6 +403,7 @@ class MetricCollection(OrderedDict):
 
     def __setstate__(self, state: dict) -> None:
         self.__dict__.update(state)
+        self.__dict__.setdefault("_enable_compute_groups", True)
 
     def persistent(self, mode: bool = True) -> None:
         for _, m in self.items():
@@ -352,24 +426,47 @@ class MetricCollection(OrderedDict):
         return self
 
     def init_state(self) -> Dict[str, Dict[str, Any]]:
-        """Joint state pytree of the whole collection (for in-jit training loops)."""
-        return {k: m.init_state() for k, m in self.items()}
+        """Joint state pytree of the collection (for in-jit training loops).
+
+        With compute groups active, the pytree is DEDUPLICATED: one entry per
+        group representative, since every member of a group accrues an
+        identical state. ``update_state`` / ``merge_states`` / ``sync_state``
+        operate on whatever entries the given pytree has (so full per-member
+        pytrees from older callers still work), and ``compute_from_state``
+        computes every member from its group's entry — the collection's whole
+        pure plane (and its sync payload) shrinks to one state per group.
+        """
+        gm = self._group_map()
+        return {k: m.init_state() for k, m in self.items() if gm[k] == k}
 
     def update_state(self, state: Dict[str, Dict[str, Any]], *args: Any, **kwargs: Any) -> Dict[str, Dict[str, Any]]:
-        """Pure joint update: one call updates every metric — jit this once so the
-        whole collection's update fuses into a single XLA computation."""
-        return {k: m.update_state(state[k], *args, **m._filter_kwargs(**kwargs)) for k, m in self.items()}
+        """Pure joint update: one call updates every state entry — jit this once
+        so the whole collection's update fuses into a single XLA computation
+        (with compute groups, one update per group)."""
+        return {k: self[k].update_state(state[k], *args, **self[k]._filter_kwargs(**kwargs)) for k in state}
 
     def compute_from_state(self, state: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
-        return {self._set_prefix(k): m.compute_from_state(state[k]) for k, m in self.items()}
+        gm = self._group_map()
+        return {
+            self._set_prefix(k): m.compute_from_state(state[k] if k in state else state[gm[k]])
+            for k, m in self.items()
+        }
 
     def merge_states(self, a: Dict[str, Dict[str, Any]], b: Dict[str, Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
-        return {k: m.merge_states(a[k], b[k]) for k, m in self.items()}
+        return {k: self[k].merge_states(a[k], b[k]) for k in a}
 
     def sync_state(self, state: Dict[str, Dict[str, Any]], axis_name: str) -> Dict[str, Dict[str, Any]]:
-        """In-jit sync of the joint state over a mesh axis — one fused collective
-        program instead of the reference's per-metric NCCL calls."""
-        return {k: m.sync_state(state[k], axis_name) for k, m in self.items()}
+        """In-jit sync of the joint state over a mesh axis — sum/min/max leaves
+        across ALL entries coalesce into per-dtype bucketed collectives (one
+        ``psum`` per bucket for the whole collection), instead of one
+        collective per state leaf per metric; gather/cat/mean leaves keep
+        their own plane (see ``parallel.sync.coalesced_sync_state``)."""
+        from metrics_tpu.parallel.sync import coalesced_sync_state
+
+        flat = {(k, n): v for k, s in state.items() for n, v in s.items()}
+        reductions = {(k, n): self[k]._reductions[n] for k, s in state.items() for n in s}
+        synced = coalesced_sync_state(flat, reductions, axis_name)
+        return {k: {n: synced[(k, n)] for n in s} for k, s in state.items()}
 
     def pure(self) -> PureMetric:
         return PureMetric(

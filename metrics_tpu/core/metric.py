@@ -49,13 +49,13 @@ from metrics_tpu.parallel.sync import (
     ReduceFx,
     canonicalize_group,
     canonicalize_reduce_fx,
+    coalesced_sync_state,
     gather_all_arrays,
     host_gather,
     is_mergeable,
     is_stack_mergeable,
     merge_values,
     merge_values_stacked,
-    sync_state as _sync_state_pure,
 )
 
 State = Dict[str, Any]
@@ -479,8 +479,12 @@ class Metric(ABC):
         return {name: merge_values(self._reductions[name], a[name], b[name]) for name in self._defaults}
 
     def sync_state(self, state: State, axis_name: str) -> State:
-        """In-jit cross-device sync over a named mesh axis (use inside shard_map/pmap)."""
-        return _sync_state_pure(state, self._reductions, axis_name)
+        """In-jit cross-device sync over a named mesh axis (use inside shard_map/pmap).
+
+        Sum/min/max leaves of a common dtype sync through ONE bucketed
+        collective (``parallel.sync.coalesced_sync_state``) — a multi-state
+        metric like StatScores pays one ``psum``, not four."""
+        return coalesced_sync_state(state, self._reductions, axis_name)
 
     def pure(self) -> PureMetric:
         """The pure-functional view: use inside jit/pjit-ed training steps."""
@@ -554,6 +558,49 @@ class Metric(ABC):
         except _Unfingerprintable:
             return None
         return ((type(self), items), pins)
+
+    # Attr names (beyond base ``capacity``) that feed ``update``; a subclass
+    # declares them to opt its instances into MetricCollection compute groups.
+    # None (the default) means "never grouped": without the declaration the
+    # library cannot know which config attrs are update-relevant, and a wrong
+    # guess would silently share deltas between metrics that update
+    # differently. Compute-only config (e.g. FBeta's ``beta``/``average``)
+    # must stay OFF this list — that is the whole point of grouping.
+    _GROUP_UPDATE_ATTRS: Optional[tuple] = None
+
+    def _group_fingerprint(self) -> Optional[Any]:
+        """Hashable identity of this metric's update+state plane, or None.
+
+        Two metrics with equal group fingerprints run the SAME ``update``
+        (the identical function object found on the MRO) over the SAME state
+        schema with the SAME update-relevant config — so inside a
+        ``MetricCollection`` one shared update delta serves them all, and
+        each member only needs its own ``compute``. ``F1``, ``Precision``,
+        ``Recall`` and ``Specificity`` with matching config all reduce to
+        one ``StatScores`` group this way.
+        """
+        attrs = type(self)._GROUP_UPDATE_ATTRS
+        if attrs is None:
+            return None
+        update_fn = next(
+            (vars(klass)["update"] for klass in type(self).__mro__ if "update" in vars(klass)), None
+        )
+        if update_fn is None:
+            return None
+        pins: list = []  # keys are compared between live siblings only; no pinning needed
+        try:
+            config = tuple(
+                (a, _fingerprint_value(getattr(self, a, None), pins))
+                for a in (*attrs, "capacity")
+            )
+            schema = tuple(
+                (name, _fingerprint_value(self._defaults[name], pins),
+                 _fingerprint_value(self._reductions[name], pins))
+                for name in sorted(self._defaults)
+            )
+        except _Unfingerprintable:
+            return None
+        return (update_fn, config, schema)
 
     def _lookup_or_build_jitted_step(self, with_compute: bool = False) -> Callable:
         fp = self._config_fingerprint()

@@ -21,6 +21,7 @@ from metrics_tpu.parallel.sharded_epoch import (
     sharded_spearman,
 )
 from metrics_tpu.parallel.sync import (
+    coalesced_sync_state,
     gather_all_arrays,
     host_gather,
     merge_values,

@@ -232,11 +232,10 @@ def _class_scores_sharded(
             else:  # multilabel columns: positives are 1 (reference per-class sweep)
                 onehot = (t == 1).astype(jnp.int32)
             w = valid.astype(jnp.float32)
-            scores = engine(p, onehot, axis, w)
-            support = jax.lax.psum(
-                jnp.sum(onehot * valid[:, None], axis=0).astype(jnp.float32), axis
-            )
-            return scores, support
+            # the engine's per-class positive weight IS the valid-row support
+            # (w * onehot summed globally), so the support rides the engine's
+            # own coalesced collective — no separate psum
+            return engine(p, onehot, axis, w, with_support=True)
 
         return body
 

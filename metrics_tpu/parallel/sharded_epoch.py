@@ -132,7 +132,8 @@ def _cols(preds: Array, target: Array, weights: Optional[Array]) -> Tuple[Array,
 
 
 def sharded_auroc_matrix(
-    preds: Array, target: Array, axis_name: str, sample_weights: Optional[Array] = None
+    preds: Array, target: Array, axis_name: str, sample_weights: Optional[Array] = None,
+    with_support: bool = False,
 ) -> Array:
     """Exact per-class AUROCs over epoch data sharded along ``axis_name``.
 
@@ -142,31 +143,39 @@ def sharded_auroc_matrix(
     epoch — cross-shard ties included. ``nan`` where a column is
     single-class globally. ``sample_weights`` is per-row ``(m,)`` or
     per-row-per-class ``(m, C)``; zero weight neutralizes a row (padding).
+    ``with_support=True`` additionally returns the ``(C,)`` global positive
+    weight — it rides the engine's own coalesced collective for free.
     """
     preds_cm, y, w = _cols(preds, target, sample_weights)
     wn_below, wn_tie, _, _ = _ring_stats_cols(preds_cm, y, w, axis_name)
     wp = w * y
     u_local = jnp.sum(wp * (wn_below + 0.5 * wn_tie), axis=-1)
-    pos = jax.lax.psum(jnp.sum(wp, axis=-1), axis_name)
-    neg = jax.lax.psum(jnp.sum(w * (1.0 - y), axis=-1), axis_name)
-    u = jax.lax.psum(u_local, axis_name)
+    # one coalesced collective for all three reductions (collectives are
+    # latency-bound at these sizes; see parallel.sync.coalesced_sync_state)
+    u, pos, neg = jax.lax.psum(
+        jnp.stack([u_local, jnp.sum(wp, axis=-1), jnp.sum(w * (1.0 - y), axis=-1)]), axis_name
+    )
     denom = pos * neg
-    return jnp.where(denom == 0, jnp.nan, u / jnp.where(denom == 0, 1.0, denom))
+    scores = jnp.where(denom == 0, jnp.nan, u / jnp.where(denom == 0, 1.0, denom))
+    return (scores, pos) if with_support else scores
 
 
 def sharded_average_precision_matrix(
-    preds: Array, target: Array, axis_name: str, sample_weights: Optional[Array] = None
+    preds: Array, target: Array, axis_name: str, sample_weights: Optional[Array] = None,
+    with_support: bool = False,
 ) -> Array:
     """Exact per-class average precision over sharded ``(m, C)`` epoch data
     (see module docstring for the per-item identity). ``(C,)`` scores; ``nan``
-    where a column has zero positive weight globally."""
+    where a column has zero positive weight globally. ``with_support=True``
+    additionally returns the ``(C,)`` global positive weight from the same
+    coalesced collective."""
     preds_cm, y, w = _cols(preds, target, sample_weights)
     _, _, wp_ge, wn_ge = _ring_stats_cols(preds_cm, y, w, axis_name)
     wp = w * y
     contrib = jnp.sum(wp * wp_ge / jnp.maximum(wp_ge + wn_ge, 1e-38), axis=-1)
-    pos = jax.lax.psum(jnp.sum(wp, axis=-1), axis_name)
-    total = jax.lax.psum(contrib, axis_name)
-    return jnp.where(pos == 0, jnp.nan, total / jnp.where(pos == 0, 1.0, pos))
+    total, pos = jax.lax.psum(jnp.stack([contrib, jnp.sum(wp, axis=-1)]), axis_name)
+    scores = jnp.where(pos == 0, jnp.nan, total / jnp.where(pos == 0, 1.0, pos))
+    return (scores, pos) if with_support else scores
 
 
 def sharded_auroc(
@@ -296,11 +305,14 @@ def sharded_spearman(
     # invariant and raw ranks would push f32 accumulations to O(N^3)
     scale = 1.0 / jnp.maximum(total, 1.0)
     rx, ry = rx * scale, ry * scale
-    sx = jax.lax.psum(jnp.sum(w * rx), axis_name)
-    sy = jax.lax.psum(jnp.sum(w * ry), axis_name)
-    sxx = jax.lax.psum(jnp.sum(w * rx * rx), axis_name)
-    syy = jax.lax.psum(jnp.sum(w * ry * ry), axis_name)
-    sxy = jax.lax.psum(jnp.sum(w * rx * ry), axis_name)
+    # all five moment reductions ride ONE coalesced collective
+    sx, sy, sxx, syy, sxy = jax.lax.psum(
+        jnp.stack([
+            jnp.sum(w * rx), jnp.sum(w * ry),
+            jnp.sum(w * rx * rx), jnp.sum(w * ry * ry), jnp.sum(w * rx * ry),
+        ]),
+        axis_name,
+    )
     cov = total * sxy - sx * sy
     var_x = total * sxx - sx * sx
     var_y = total * syy - sy * sy
@@ -372,11 +384,15 @@ def sharded_kendall(
     (s_all, tx_all, ty_all), _ = jax.lax.fori_loop(0, n - 1, hop, (acc, (x, y, w)))
     s_all, tx_all, ty_all = s_all[:m], tx_all[:m], ty_all[:m]
 
-    s = jax.lax.psum(jnp.sum(w * s_all), axis_name) / 2.0
-    t_x = jax.lax.psum(jnp.sum(w * tx_all), axis_name)
-    t_y = jax.lax.psum(jnp.sum(w * ty_all), axis_name)
-    w_tot = jax.lax.psum(jnp.sum(w), axis_name)
-    w_sq = jax.lax.psum(jnp.sum(w * w), axis_name)
+    # one coalesced collective for all five epoch sums
+    s, t_x, t_y, w_tot, w_sq = jax.lax.psum(
+        jnp.stack([
+            jnp.sum(w * s_all), jnp.sum(w * tx_all), jnp.sum(w * ty_all),
+            jnp.sum(w), jnp.sum(w * w),
+        ]),
+        axis_name,
+    )
+    s = s / 2.0
     n1 = (t_x - w_sq) / 2.0  # pairs tied in x (diagonal removed)
     n2 = (t_y - w_sq) / 2.0
     n0 = (w_tot * w_tot - w_sq) / 2.0
@@ -463,7 +479,11 @@ def sharded_retrieval_sums(
     )
     total, count, flag = metric._device_sums(g_idx, g_preds, g_target, pad=pad)
     total = jax.lax.psum(total, axis_name)
-    count = jax.lax.psum(count, axis_name)
-    flag = jax.lax.psum(flag.astype(jnp.int32), axis_name) > 0
+    # count/flag coalesce into one integer collective (total keeps its own
+    # float plane: folding counts into f32 would lose exactness past 2^24)
+    count, flag_sum = jax.lax.psum(
+        jnp.stack([jnp.asarray(count, jnp.int32), flag.astype(jnp.int32)]), axis_name
+    )
+    flag = flag_sum > 0
     mean = jnp.where(count == 0, 0.0, total / jnp.maximum(count, 1))
     return mean, flag, dropped

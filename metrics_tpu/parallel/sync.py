@@ -178,6 +178,45 @@ def sync_state(state: Dict[str, Any], reductions: Dict[str, ReduceFx], axis_name
     return {name: sync_value(reductions[name], value, axis_name) for name, value in state.items()}
 
 
+def coalesced_sync_state(
+    state: Dict[Any, Any], reductions: Dict[Any, ReduceFx], axis_name: str
+) -> Dict[Any, Any]:
+    """In-jit sync with COALESCED collectives: one ``psum``/``pmin``/``pmax``
+    per (op, dtype) bucket instead of one per state leaf.
+
+    ``sum``-reducible array leaves of the same dtype are flattened into one
+    contiguous buffer, synced with a single collective, and sliced back to
+    their original shapes; likewise for ``min``/``max``. Element values are
+    unchanged — cross-device reduction is elementwise, so concatenation
+    cannot alter any element's result — but a collection's whole sync plane
+    collapses from one collective per leaf per metric to a handful of
+    bucketed collectives (latency-bound on ICI/DCN at small state sizes).
+    ``mean``, ``cat``, gather (``None``) and callable reductions, lists and
+    :class:`PaddedBuffer` leaves keep their own per-leaf plane.
+    """
+    out: Dict[Any, Any] = {}
+    buckets: Dict[tuple, list] = {}  # (op, dtype str) -> [leaf name]
+    for name, value in state.items():
+        fx = reductions[name]
+        if fx in ("sum", "min", "max") and not isinstance(value, (PaddedBuffer, list)):
+            buckets.setdefault((fx, str(value.dtype)), []).append(name)
+        else:
+            out[name] = sync_value(fx, value, axis_name)
+    ops = {"sum": jax.lax.psum, "min": jax.lax.pmin, "max": jax.lax.pmax}
+    for (op, _dtype), names in buckets.items():
+        if len(names) == 1:
+            out[names[0]] = sync_value(op, state[names[0]], axis_name)
+            continue
+        flat = jnp.concatenate([jnp.ravel(state[n]) for n in names])
+        synced = ops[op](flat, axis_name)
+        offset = 0
+        for n in names:
+            value = state[n]
+            out[n] = synced[offset: offset + value.size].reshape(value.shape)
+            offset += value.size
+    return out
+
+
 def canonicalize_group(group: Any) -> Optional[tuple]:
     """Validate a ``process_group`` (reference metric.py:66,185 semantics).
 

@@ -1,0 +1,289 @@
+"""Compute-group semantics of MetricCollection.
+
+Grouped and ungrouped collections must be BIT-identical on every plane —
+``forward``, ``forward_batched``, ``compute``, and the pure/sync plane —
+because a compute group changes only how many times the shared update runs,
+never what it computes. ``compute_groups=False`` is the escape hatch that
+restores fully independent per-child execution.
+"""
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu
+from metrics_tpu import (
+    Accuracy,
+    F1,
+    MetricCollection,
+    Precision,
+    Recall,
+    Specificity,
+)
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+@pytest.fixture()
+def jit_on():
+    old = metrics_tpu.set_default_jit(True)
+    yield
+    metrics_tpu.set_default_jit(old)
+
+
+# (name, metric builders, preds/target generator) — binary / multiclass
+# macro / multiclass micro / multilabel, per the classification input modes
+def _multiclass_data(rng, n=32, c=5):
+    logits = rng.rand(n, c).astype(np.float32)
+    probs = logits / logits.sum(-1, keepdims=True)
+    return jnp.asarray(probs), jnp.asarray(rng.randint(0, c, n).astype(np.int32))
+
+
+def _binary_data(rng, n=32):
+    return (
+        jnp.asarray(rng.rand(n).astype(np.float32)),
+        jnp.asarray(rng.randint(0, 2, n).astype(np.int32)),
+    )
+
+
+def _multilabel_data(rng, n=32, c=4):
+    return (
+        jnp.asarray(rng.rand(n, c).astype(np.float32)),
+        jnp.asarray(rng.randint(0, 2, (n, c)).astype(np.int32)),
+    )
+
+
+CONFIGS = {
+    "binary-micro": (
+        lambda: [Accuracy(), F1(), Precision(), Recall()],
+        _binary_data,
+    ),
+    "multiclass-macro": (
+        lambda: [
+            Accuracy(),
+            F1(num_classes=5, average="macro"),
+            Precision(num_classes=5, average="macro"),
+            Recall(num_classes=5, average="macro"),
+            Specificity(num_classes=5, average="macro"),
+        ],
+        _multiclass_data,
+    ),
+    "multiclass-micro": (
+        lambda: [F1(num_classes=5), Precision(num_classes=5), Recall(num_classes=5)],
+        _multiclass_data,
+    ),
+    "multilabel-micro": (
+        lambda: [F1(is_multiclass=False), Precision(is_multiclass=False)],
+        _multilabel_data,
+    ),
+}
+
+
+def _pair(name):
+    build, gen = CONFIGS[name]
+    return (
+        MetricCollection(build()),
+        MetricCollection(build(), compute_groups=False),
+        gen,
+    )
+
+
+def _assert_same(a: dict, b: dict) -> None:
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]), err_msg=k)
+
+
+def test_stat_family_reduces_to_one_group():
+    mc = MetricCollection([
+        Accuracy(),
+        F1(num_classes=3, average="macro"),
+        Precision(num_classes=3, average="macro"),
+        Recall(num_classes=3, average="macro"),
+        Specificity(num_classes=3, average="macro"),
+    ])
+    groups = mc.compute_groups
+    assert groups["Accuracy"] == ("Accuracy",)
+    assert groups["F1"] == ("F1", "Precision", "Recall", "Specificity")
+    # the pure plane syncs one state pytree per group, not per member
+    assert len(mc.init_state()) == 2
+
+
+def test_differing_configs_never_group():
+    # num_classes mismatch
+    mc = MetricCollection([F1(num_classes=5, average="macro"), Precision(num_classes=3, average="macro")])
+    assert all(len(m) == 1 for m in mc.compute_groups.values())
+    # threshold mismatch
+    mc = MetricCollection([F1(threshold=0.5), Precision(threshold=0.3)])
+    assert all(len(m) == 1 for m in mc.compute_groups.values())
+    # top_k mismatch
+    mc = MetricCollection([
+        Precision(num_classes=5, average="macro"),
+        Recall(num_classes=5, average="macro", top_k=2),
+    ])
+    assert all(len(m) == 1 for m in mc.compute_groups.values())
+
+
+def test_compute_groups_false_escape_hatch():
+    mc = MetricCollection(
+        [F1(num_classes=3, average="macro"), Precision(num_classes=3, average="macro")],
+        compute_groups=False,
+    )
+    assert all(len(m) == 1 for m in mc.compute_groups.values())
+    assert len(mc.init_state()) == 2
+
+
+@pytest.mark.parametrize("config", list(CONFIGS))
+def test_grouped_matches_ungrouped_forward_and_compute(config, jit_on):
+    grouped, ungrouped, gen = _pair(config)
+    rng = np.random.RandomState(7)
+    batches = [gen(rng) for _ in range(4)]
+    for preds, target in batches:
+        _assert_same(grouped(preds, target), ungrouped(preds, target))
+    _assert_same(grouped.compute(), ungrouped.compute())
+
+    # ... and again after reset(): group state must restart from defaults
+    grouped.reset()
+    ungrouped.reset()
+    for preds, target in batches[:2]:
+        _assert_same(grouped(preds, target), ungrouped(preds, target))
+    _assert_same(grouped.compute(), ungrouped.compute())
+
+
+@pytest.mark.parametrize("config", ["multiclass-macro", "binary-micro"])
+def test_grouped_matches_ungrouped_forward_batched(config, jit_on):
+    grouped, ungrouped, gen = _pair(config)
+    rng = np.random.RandomState(11)
+    stack = [gen(rng) for _ in range(6)]
+    preds = jnp.stack([p for p, _ in stack])
+    target = jnp.stack([t for _, t in stack])
+    _assert_same(grouped.forward_batched(preds, target), ungrouped.forward_batched(preds, target))
+    _assert_same(grouped.compute(), ungrouped.compute())
+
+
+def test_grouped_parity_survives_clone_and_pickle(jit_on):
+    grouped, ungrouped, gen = _pair("multiclass-macro")
+    rng = np.random.RandomState(3)
+    preds, target = gen(rng)
+    grouped(preds, target)
+    ungrouped(preds, target)
+
+    g2 = grouped.clone(prefix="c_")
+    u2 = ungrouped.clone(prefix="c_")
+    assert g2.compute_groups["F1"] == ("F1", "Precision", "Recall", "Specificity")
+    # the escape hatch survives cloning
+    assert all(len(m) == 1 for m in u2.compute_groups.values())
+    preds2, target2 = gen(rng)
+    _assert_same(g2(preds2, target2), u2(preds2, target2))
+    _assert_same(g2.compute(), u2.compute())
+
+    g3 = pickle.loads(pickle.dumps(grouped))
+    u3 = pickle.loads(pickle.dumps(ungrouped))
+    assert g3.compute_groups["F1"] == ("F1", "Precision", "Recall", "Specificity")
+    assert all(len(m) == 1 for m in u3.compute_groups.values())
+    _assert_same(g3(preds2, target2), u3(preds2, target2))
+    _assert_same(g3.compute(), u3.compute())
+
+
+def test_group_rebuilt_on_setitem_and_delitem(jit_on):
+    mc = MetricCollection([
+        F1(num_classes=4, average="macro"),
+        Precision(num_classes=4, average="macro"),
+    ])
+    rng = np.random.RandomState(5)
+    preds, target = _multiclass_data(rng, c=4)
+    mc(preds, target)
+    assert mc.compute_groups["F1"] == ("F1", "Precision")
+
+    # adding a compatible member joins the existing group (fused step and
+    # group map both rebuild under the generation guard)
+    mc["Recall"] = Recall(num_classes=4, average="macro")
+    assert mc.compute_groups["F1"] == ("F1", "Precision", "Recall")
+    out = mc(preds, target)
+    want = float(Recall(num_classes=4, average="macro")(preds, target))
+    np.testing.assert_array_equal(np.asarray(out["Recall"]), want)
+
+    # removing the representative reassigns the group to the next member
+    del mc["F1"]
+    assert mc.compute_groups["Precision"] == ("Precision", "Recall")
+    mc(preds, target)
+
+    # replacing a member with an incompatible config splits it out
+    mc["Recall"] = Recall(num_classes=4, average="macro", top_k=2)
+    assert mc.compute_groups["Precision"] == ("Precision",)
+
+
+def test_individually_updated_member_keeps_own_state(jit_on):
+    """The shared delta merges into each member's OWN accumulator, so a
+    member also updated outside the collection stays individually correct."""
+    mc = MetricCollection([
+        Precision(num_classes=4, average="macro"),
+        Recall(num_classes=4, average="macro"),
+    ])
+    rng = np.random.RandomState(9)
+    preds, target = _multiclass_data(rng, c=4)
+    mc(preds, target)
+    preds2, target2 = _multiclass_data(rng, c=4)
+    mc["Recall"].update(preds2, target2)  # out-of-collection update
+    preds3, target3 = _multiclass_data(rng, c=4)
+    mc(preds3, target3)
+
+    want_p = Precision(num_classes=4, average="macro")
+    want_r = Recall(num_classes=4, average="macro")
+    for p, t in ((preds, target), (preds3, target3)):
+        want_p.update(p, t)
+    for p, t in ((preds, target), (preds2, target2), (preds3, target3)):
+        want_r.update(p, t)
+    np.testing.assert_array_equal(np.asarray(mc.compute()["Precision"]), np.asarray(want_p.compute()))
+    np.testing.assert_array_equal(np.asarray(mc.compute()["Recall"]), np.asarray(want_r.compute()))
+
+
+def test_sync_state_roundtrip_2device_mesh():
+    """Grouped vs ungrouped pure sync over a real 2-device mesh collective
+    program: bit-identical synced computes, with the grouped program moving
+    one state pytree per group through the coalesced buckets."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        pytest.skip(f"needs 2 devices, have {len(devices)}")
+    mesh = Mesh(np.array(devices[:2]), ("dp",))
+
+    rng = np.random.RandomState(17)
+    preds, target = _multiclass_data(rng, n=32, c=5)
+
+    results = {}
+    for label, compute_groups in (("grouped", True), ("ungrouped", False)):
+        pure = MetricCollection([
+            Accuracy(),
+            F1(num_classes=5, average="macro"),
+            Precision(num_classes=5, average="macro"),
+            Recall(num_classes=5, average="macro"),
+        ], compute_groups=compute_groups).pure()
+
+        def step(p, t, _pure=pure):
+            delta = _pure.update(_pure.init(), p, t)
+            synced = _pure.sync(delta, "dp")
+            return _pure.compute(synced)
+
+        fn = jax.jit(_shard_map(step, mesh, in_specs=(P("dp"), P("dp")), out_specs=P()))
+        results[label] = {k: np.asarray(v) for k, v in fn(preds, target).items()}
+
+    _assert_same(results["grouped"], results["ungrouped"])
+
+    # the mesh sync must equal the single-device epoch over the full batch
+    single = MetricCollection([
+        Accuracy(),
+        F1(num_classes=5, average="macro"),
+        Precision(num_classes=5, average="macro"),
+        Recall(num_classes=5, average="macro"),
+    ])
+    single.update(preds, target)
+    _assert_same(results["grouped"], {k: np.asarray(v) for k, v in single.compute().items()})

@@ -1,0 +1,43 @@
+"""The benchmark of record must keep emitting its JSON line.
+
+``python bench.py --smoke`` runs the 8-virtual-device sync benchmark for 2
+steps with no subprocess reference — cheap enough for tier-1 — and this test
+pins the schema of the printed line so the bench path cannot silently rot
+between BENCH_r* rounds (a broken bench would otherwise only surface at the
+next manual round).
+"""
+import json
+import os
+import subprocess
+import sys
+
+_BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "bench.py")
+
+
+def test_bench_smoke_json_schema():
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, _BENCH, "--smoke"],
+        capture_output=True, text=True, timeout=280, env=env,
+        cwd=os.path.dirname(_BENCH),
+    )
+    assert proc.returncode == 0, f"--smoke failed:\n{proc.stderr[-3000:]}"
+    line = proc.stdout.strip().splitlines()[-1]
+    out = json.loads(line)
+
+    # schema of record: BENCH_r* and the acceptance gate read these keys
+    assert isinstance(out["metric"], str) and "MetricCollection" in out["metric"]
+    assert out["unit"] == "ms/step"
+    assert out["smoke"] is True
+    for key in ("value", "grouped_sync8_ms", "ungrouped_sync8_ms"):
+        assert isinstance(out[key], (int, float)) and out[key] > 0, key
+    assert out["value"] == out["grouped_sync8_ms"]
+
+    # compute groups must actually deduplicate the synced state plane:
+    # Accuracy + the F1/Precision/Recall stat group -> 2+4 leaves vs 14
+    assert isinstance(out["states_synced"], int)
+    assert isinstance(out["states_synced_ungrouped"], int)
+    assert out["states_synced"] < out["states_synced_ungrouped"]
+    assert out["states_synced"] == 6
+    assert out["states_synced_ungrouped"] == 14
