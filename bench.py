@@ -26,6 +26,13 @@ also carries the compute-groups A/B ("grouped_sync8_ms" vs
 "ungrouped_sync8_ms", with "states_synced" counts) so BENCH_r* tracks the
 group/coalescing gain. ``--smoke`` runs a 2-step, no-reference version with
 the same headline schema for CI (tests/integrations/test_bench_smoke.py).
+
+``--trace OUT.json`` (composable with ``--smoke``) enables the observability
+subsystem around the A/B: the JSON line grows ``collective_calls`` /
+``sync_bytes`` (collectives staged per step program, from
+``metrics_tpu.observability.counters``, replacing ad-hoc timers for the
+per-phase story), a ``phase_ms`` span-aggregate table, and OUT.json gets a
+Chrome-trace/Perfetto file of the bench phases (load at ui.perfetto.dev).
 """
 import json
 import os
@@ -65,12 +72,9 @@ def _collection_ours(compute_groups: bool = True):
 
 def _shard_map(fn, mesh, in_specs, out_specs):
     """jax.shard_map on current jax; the experimental module on older jax."""
-    import jax
+    from metrics_tpu.utils.compat import shard_map
 
-    sm = getattr(jax, "shard_map", None)
-    if sm is None:
-        from jax.experimental.shard_map import shard_map as sm
-    return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    return shard_map(fn, mesh, in_specs, out_specs)
 
 
 def _build_sync8_runner(compute_groups: bool):
@@ -128,29 +132,89 @@ def bench_ours_sync8(compute_groups: bool = True, steps: int = N_STEPS, warmup: 
     return run(steps), states_synced
 
 
-def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3) -> dict:
+def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trace_path=None) -> dict:
     """Compute-groups on/off A/B over the same 8-device mesh program.
 
     The two variants are timed in INTERLEAVED rounds and reported as the
     best-of — a monotonic load drift would otherwise bias whichever variant
     ran second (the A/B is a difference of two absolute measurements).
+
+    With ``trace_path`` set, the observability subsystem is enabled around
+    the whole A/B: the per-variant collective counters are snapshotted over
+    the compiling first call (staged collectives per step program — the
+    honest per-step collective cost), the bench phases are spanned, and a
+    Perfetto-loadable Chrome trace is written to ``trace_path``. The result
+    then carries ``collective_calls`` / ``sync_bytes`` (grouped program) and
+    a ``phase_ms`` table from the span aggregates.
     """
-    run_grouped, states_grouped = _build_sync8_runner(True)
-    run_ungrouped, states_ungrouped = _build_sync8_runner(False)
-    run_grouped(warmup)
-    run_ungrouped(warmup)
+    obs = None
+    if trace_path is not None:
+        from metrics_tpu import observability as obs_mod
+
+        obs = obs_mod
+        obs.enable()
+        obs.reset()
+
+    def build(compute_groups: bool, label: str):
+        if obs is None:
+            run, states = _build_sync8_runner(compute_groups)
+            run(warmup)
+            return run, states, None
+        with obs.span(f"bench.build_{label}"):
+            run, states = _build_sync8_runner(compute_groups)
+        obs.COUNTERS.reset()
+        with obs.span(f"bench.compile_{label}"):
+            run(1)  # first call traces+compiles: counters now hold the program's collectives
+        counters = obs.counters_snapshot()
+        with obs.span(f"bench.warmup_{label}"):
+            run(max(warmup - 1, 1))
+        return run, states, counters
+
+    run_grouped, states_grouped, grouped_counters = build(True, "grouped")
+    run_ungrouped, states_ungrouped, ungrouped_counters = build(False, "ungrouped")
     grouped_times, ungrouped_times = [], []
     for _ in range(repeats):
-        grouped_times.append(run_grouped(steps))
-        ungrouped_times.append(run_ungrouped(steps))
+        with (obs.span("bench.timed_grouped") if obs else _null_cm()):
+            grouped_times.append(run_grouped(steps))
+        with (obs.span("bench.timed_ungrouped") if obs else _null_cm()):
+            ungrouped_times.append(run_ungrouped(steps))
     grouped_ms = min(grouped_times)
     ungrouped_ms = min(ungrouped_times)
-    return {
+    out = {
         "grouped_sync8_ms": grouped_ms,
         "ungrouped_sync8_ms": ungrouped_ms,
         "states_synced": states_grouped,
         "states_synced_ungrouped": states_ungrouped,
     }
+    if obs is not None:
+        out["collective_calls"] = grouped_counters["collective_calls"]
+        out["sync_bytes"] = grouped_counters["sync_bytes"]
+        out["collective_calls_ungrouped"] = ungrouped_counters["collective_calls"]
+        out["sync_bytes_ungrouped"] = ungrouped_counters["sync_bytes"]
+        out["counters"] = grouped_counters
+        out["phase_ms"] = {
+            name: round(row["total_ms"], 3) for name, row in sorted(obs.summarize().items())
+        }
+        out["trace_file"] = trace_path
+        obs.write_chrome_trace(trace_path)
+        obs.disable()
+    return out
+
+
+def _null_cm():
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
+def _trace_arg(argv) -> "str | None":
+    """Value of ``--trace OUT.json`` anywhere on the command line, else None."""
+    if "--trace" in argv:
+        i = argv.index("--trace")
+        if i + 1 >= len(argv):
+            raise SystemExit("--trace requires an output path")
+        return argv[i + 1]
+    return None
 
 
 def _ref_sync8_worker(rank: int, world_size: int, steps: int, out_q) -> None:
@@ -331,14 +395,28 @@ def _metric_description() -> str:
     )
 
 
+# extra keys _sync8_ab emits when tracing; the parent copies them verbatim
+# from the child's JSON (full mode) or the in-process dict (smoke mode)
+_TRACE_KEYS = (
+    "collective_calls",
+    "sync_bytes",
+    "collective_calls_ungrouped",
+    "sync_bytes_ungrouped",
+    "counters",
+    "phase_ms",
+    "trace_file",
+)
+
+
 def main() -> None:
+    trace_path = _trace_arg(sys.argv)
     if len(sys.argv) > 1 and sys.argv[1] == "--sync8":
         # child process: CPU platform must be forced before backend init
         os.environ["XLA_FLAGS"] = (
             os.environ.get("XLA_FLAGS", "")
             + f" --xla_force_host_platform_device_count={N_DEVICES}"
         ).strip()
-        print(json.dumps(_sync8_ab()))
+        print(json.dumps(_sync8_ab(trace_path=trace_path)))
         return
 
     if len(sys.argv) > 1 and sys.argv[1] == "--smoke":
@@ -350,27 +428,28 @@ def main() -> None:
             os.environ.get("XLA_FLAGS", "")
             + f" --xla_force_host_platform_device_count={N_DEVICES}"
         ).strip()
-        ab = _sync8_ab(steps=2, warmup=1)
-        print(
-            json.dumps(
-                {
-                    "metric": _metric_description(),
-                    "value": round(ab["grouped_sync8_ms"], 4),
-                    "unit": "ms/step",
-                    "grouped_sync8_ms": round(ab["grouped_sync8_ms"], 4),
-                    "ungrouped_sync8_ms": round(ab["ungrouped_sync8_ms"], 4),
-                    "states_synced": ab["states_synced"],
-                    "states_synced_ungrouped": ab["states_synced_ungrouped"],
-                    "smoke": True,
-                }
-            )
-        )
+        ab = _sync8_ab(steps=2, warmup=1, trace_path=trace_path)
+        out = {
+            "metric": _metric_description(),
+            "value": round(ab["grouped_sync8_ms"], 4),
+            "unit": "ms/step",
+            "grouped_sync8_ms": round(ab["grouped_sync8_ms"], 4),
+            "ungrouped_sync8_ms": round(ab["ungrouped_sync8_ms"], 4),
+            "states_synced": ab["states_synced"],
+            "states_synced_ungrouped": ab["states_synced_ungrouped"],
+            "smoke": True,
+        }
+        out.update({k: ab[k] for k in _TRACE_KEYS if k in ab})
+        print(json.dumps(out))
         return
 
     here = os.path.dirname(os.path.abspath(__file__))
 
+    child_argv = [sys.executable, os.path.abspath(__file__), "--sync8"]
+    if trace_path is not None:
+        child_argv += ["--trace", trace_path]
     child = subprocess.run(
-        [sys.executable, os.path.abspath(__file__), "--sync8"],
+        child_argv,
         capture_output=True, text=True, timeout=600,
         env={**os.environ, "PYTHONPATH": here},
     )
@@ -400,26 +479,24 @@ def main() -> None:
         ours_fused_ms = ref_eager_ms = fused_vs_ref = float("nan")
         marginal_at_floor = False
 
-    print(
-        json.dumps(
-            {
-                "metric": _metric_description(),
-                "value": round(ours_sync8_ms, 4),
-                "unit": "ms/step",
-                "vs_baseline": round(vs_baseline, 3),
-                "reference_sync8_ms": round(ref_sync8_ms, 4),
-                "grouped_sync8_ms": round(ab["grouped_sync8_ms"], 4),
-                "ungrouped_sync8_ms": round(ab["ungrouped_sync8_ms"], 4),
-                "states_synced": ab["states_synced"],
-                "states_synced_ungrouped": ab["states_synced_ungrouped"],
-                "singlechip_fused_update_ms": round(ours_fused_ms, 4),
-                "singlechip_reference_eager_update_ms": round(ref_eager_ms, 4),
-                "singlechip_vs_reference": round(fused_vs_ref, 3),
-                "singlechip_marginal_at_floor": marginal_at_floor,
-                "smoke": False,
-            }
-        )
-    )
+    out = {
+        "metric": _metric_description(),
+        "value": round(ours_sync8_ms, 4),
+        "unit": "ms/step",
+        "vs_baseline": round(vs_baseline, 3),
+        "reference_sync8_ms": round(ref_sync8_ms, 4),
+        "grouped_sync8_ms": round(ab["grouped_sync8_ms"], 4),
+        "ungrouped_sync8_ms": round(ab["ungrouped_sync8_ms"], 4),
+        "states_synced": ab["states_synced"],
+        "states_synced_ungrouped": ab["states_synced_ungrouped"],
+        "singlechip_fused_update_ms": round(ours_fused_ms, 4),
+        "singlechip_reference_eager_update_ms": round(ref_eager_ms, 4),
+        "singlechip_vs_reference": round(fused_vs_ref, 3),
+        "singlechip_marginal_at_floor": marginal_at_floor,
+        "smoke": False,
+    }
+    out.update({k: ab[k] for k in _TRACE_KEYS if k in ab})
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -12,6 +12,7 @@ _logger.addHandler(logging.StreamHandler())
 _logger.setLevel(logging.INFO)
 
 from metrics_tpu.info import __version__  # noqa: E402
+from metrics_tpu import observability  # noqa: E402  (span tracing + collective accounting)
 from metrics_tpu.core.collections import MetricCollection  # noqa: E402
 from metrics_tpu.core.metric import CompositionalMetric, Metric, PureMetric, set_default_jit  # noqa: E402
 from metrics_tpu.utils.debug import enable_sync_count_check  # noqa: E402

@@ -94,11 +94,22 @@ class AUROC(Metric):
         return auroc_applicable(self) is not None
 
     def compute(self) -> Array:
+        from metrics_tpu.observability.trace import TRACE, span
         from metrics_tpu.parallel.sharded_dispatch import auroc_sharded
 
         sharded = auroc_sharded(self)  # row-sharded epoch states: exact ring
         if sharded is not None:
             return sharded
+        # the gather path materializes the epoch on every device — the span
+        # makes that O(dataset) cost visible next to the sharded launches
+        if TRACE.enabled:
+            with span("auroc.gather_compute", {"rows": len(self.preds) if isinstance(self.preds, list) else -1}):
+                preds = as_values(self.preds)
+                target = as_values(self.target)
+                return _auroc_compute(
+                    preds, target, self.mode, self.num_classes, self.pos_label,
+                    self.average, self.max_fpr,
+                )
         preds = as_values(self.preds)
         target = as_values(self.target)
         return _auroc_compute(

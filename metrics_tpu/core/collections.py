@@ -12,6 +12,9 @@ from copy import deepcopy
 from typing import Any, Dict, List, Optional, Tuple, Union
 
 from metrics_tpu.core.metric import Metric, PureMetric
+from metrics_tpu.observability.counters import record_cache, record_states_synced
+from metrics_tpu.observability.trace import TRACE, span as _span
+from metrics_tpu.parallel.buffer import PaddedBuffer
 
 # process-wide fused-step sharing for config-identical collections (same
 # shape as the per-metric _JITTED_STEP_CACHE): a fresh collection per eval
@@ -21,6 +24,26 @@ import threading as _threading
 _COL_STEP_CACHE: Dict[Any, Any] = {}
 _COL_STEP_CACHE_MAX = 64
 _COL_STEP_CACHE_LOCK = _threading.Lock()
+
+
+def _state_write_ids(metric: Metric) -> tuple:
+    """Identity fingerprint of a metric's current state arrays.
+
+    Any state write replaces the bound arrays (jax arrays are immutable, and
+    every setter rebinds the attribute), so comparing these ids between two
+    points in time detects intervening writes without reading a single device
+    value. Same convention as ``Metric.__hash__``.
+    """
+    ids = []
+    for name in metric._defaults:
+        value = getattr(metric, name)
+        if isinstance(value, list):
+            ids.append(tuple(id(v) for v in value))
+        elif isinstance(value, PaddedBuffer):
+            ids.append((id(value.data), id(value.count)))
+        else:
+            ids.append(id(value))
+    return tuple(ids)
 
 
 def _col_cache_key(collection: "MetricCollection", kind: str) -> Optional[Tuple[Any, list]]:
@@ -89,6 +112,7 @@ class MetricCollection(OrderedDict):
             raise ValueError("Unknown input to MetricCollection.")
 
         self.prefix = self._check_prefix_arg(prefix)
+        self._lockstep_init()
 
     def __setitem__(self, key: str, value: Metric) -> None:
         # generation guards the fused-step cache against id() reuse: a freed
@@ -96,10 +120,59 @@ class MetricCollection(OrderedDict):
         # the (key, id) membership tuple compare equal across a swap
         self.__dict__["_col_generation"] = self.__dict__.get("_col_generation", 0) + 1
         super().__setitem__(key, value)
+        ids = self.__dict__.get("_lockstep_ids")
+        if ids is not None:
+            # a member that accumulated before joining cannot be assumed in
+            # lockstep with its group until the next collection-level reset
+            if value._count_bound > 0:
+                self.__dict__.setdefault("_lockstep_diverged", set()).add(key)
+            ids[key] = _state_write_ids(value)
 
     def __delitem__(self, key: str) -> None:
         self.__dict__["_col_generation"] = self.__dict__.get("_col_generation", 0) + 1
         super().__delitem__(key)
+        ids = self.__dict__.get("_lockstep_ids")
+        if ids is not None:
+            ids.pop(key, None)
+            self.__dict__.get("_lockstep_diverged", set()).discard(key)
+
+    # ------------------------------------------------------ lockstep tracking
+    # The host-plane analogue of the pure plane's one-state-per-group dedup
+    # needs a guarantee the pure plane gets by construction: that every group
+    # member holds the SAME state values. The collection tracks it host-side,
+    # with zero device work: after every collection-level state write it
+    # records the identity of each member's state arrays; any op that later
+    # finds a member's arrays swapped out from under it (an out-of-collection
+    # ``update``/``forward``/``load_state_dict``) marks that member DIVERGED,
+    # permanently until the next collection-level ``reset``. Only never-
+    # diverged members share their group's single host gather in ``compute``.
+    # Tracking is armed only when a host sync is possible at construction
+    # (multi-process, or a member with a custom ``dist_sync_fn``) so the
+    # single-process hot path pays one attribute check per op.
+    def _lockstep_init(self) -> None:
+        import jax
+
+        active = jax.process_count() > 1 or any(m.dist_sync_fn is not None for m in self.values())
+        if not active:
+            self.__dict__["_lockstep_ids"] = None
+            self.__dict__["_lockstep_diverged"] = set()
+            return
+        self.__dict__["_lockstep_diverged"] = {k for k, m in self.items() if m._count_bound > 0}
+        self.__dict__["_lockstep_ids"] = {k: _state_write_ids(m) for k, m in self.items()}
+
+    def _lockstep_check(self) -> None:
+        """Mark members whose states were written outside the collection."""
+        ids = self.__dict__.get("_lockstep_ids")
+        if ids is None:
+            return
+        diverged = self.__dict__.setdefault("_lockstep_diverged", set())
+        for k, m in self.items():
+            if ids.get(k) != _state_write_ids(m):
+                diverged.add(k)
+
+    def _lockstep_record(self) -> None:
+        if self.__dict__.get("_lockstep_ids") is not None:
+            self.__dict__["_lockstep_ids"] = {k: _state_write_ids(m) for k, m in self.items()}
 
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """Call forward on every metric; kwargs are filtered per metric signature.
@@ -109,10 +182,12 @@ class MetricCollection(OrderedDict):
         every update, accumulator merge, and batch value in a single
         dispatch (the reference pays N forwards; a naive port would pay N
         dispatches)."""
+        self._lockstep_check()
         fused = self._forward_fused_collection(*args, **kwargs)
-        if fused is not None:
-            return fused
-        return {self._set_prefix(k): m(*args, **m._filter_kwargs(**kwargs)) for k, m in self.items()}
+        if fused is None:
+            fused = {self._set_prefix(k): m(*args, **m._filter_kwargs(**kwargs)) for k, m in self.items()}
+        self._lockstep_record()
+        return fused
 
     def _collection_fusable(self) -> bool:
         return all(
@@ -152,6 +227,7 @@ class MetricCollection(OrderedDict):
         """
         self._refresh_col_cache()
         groups = self.__dict__.get("_col_groups")
+        record_cache("group", groups is not None)
         if groups is None:
             groups = {}
             if getattr(self, "_enable_compute_groups", True):
@@ -187,7 +263,11 @@ class MetricCollection(OrderedDict):
             self.__dict__["_col_step"] = step
         states = {k: m._current_state() for k, m in self.items()}
         try:
-            new_states, values = step(states, *args, **kwargs)
+            if TRACE.enabled:
+                with _span("collection.fused_step", {"members": len(self)}):
+                    new_states, values = step(states, *args, **kwargs)
+            else:
+                new_states, values = step(states, *args, **kwargs)
         except Metric._TRACER_ERRORS:
             # some update/compute needs concrete values: per-metric forwards
             # handle their own fallbacks from here on. The verdict stays
@@ -216,6 +296,7 @@ class MetricCollection(OrderedDict):
         key, pins = keyed
         with _COL_STEP_CACHE_LOCK:
             hit = _COL_STEP_CACHE.get(key)
+            record_cache("step", hit is not None)
             if hit is None:
                 from metrics_tpu.core.metric import _bounded_insert
 
@@ -276,6 +357,7 @@ class MetricCollection(OrderedDict):
         """
         import jax
 
+        self._lockstep_check()
         self._refresh_col_cache()
         step = self.__dict__.get("_col_batched_step")
         if step is None and not (
@@ -291,7 +373,11 @@ class MetricCollection(OrderedDict):
         if step is not None:
             states = {k: m._current_state() for k, m in self.items()}
             try:
-                new_states, values, epochs = step(states, *args, **kwargs)
+                if TRACE.enabled:
+                    with _span("collection.forward_batched", {"members": len(self)}):
+                        new_states, values, epochs = step(states, *args, **kwargs)
+                else:
+                    new_states, values, epochs = step(states, *args, **kwargs)
             except Metric._TRACER_ERRORS:
                 # batched-path verdict only (and instance-local, see above):
                 # the fused per-step program is a DIFFERENT trace and may
@@ -305,11 +391,14 @@ class MetricCollection(OrderedDict):
                     m._set_state(new_states[k])
                     m._forward_cache = jax.tree_util.tree_map(lambda v: v[-1], values[k])
                     m._computed = epochs[k] if seed_epoch and m.dist_sync_fn is None else None
+                self._lockstep_record()
                 return {self._set_prefix(k): values[k] for k in self.keys()}
-        return {
+        out = {
             self._set_prefix(k): m.forward_batched(*args, **m._filter_kwargs(**kwargs))
             for k, m in self.items()
         }
+        self._lockstep_record()
+        return out
 
     def _build_collection_batched_step(self):
         import threading
@@ -357,15 +446,99 @@ class MetricCollection(OrderedDict):
         return jax.jit(step, donate_argnums=donate)
 
     def update(self, *args: Any, **kwargs: Any) -> None:
+        self._lockstep_check()
         for _, m in self.items():
             m.update(*args, **m._filter_kwargs(**kwargs))
+        self._lockstep_record()
 
     def compute(self) -> Dict[str, Any]:
-        return {self._set_prefix(k): m.compute() for k, m in self.items()}
+        if TRACE.enabled:
+            with _span("collection.compute", {"members": len(self)}):
+                return self._compute_all()
+        return self._compute_all()
+
+    def _compute_all(self) -> Dict[str, Any]:
+        shared = self._grouped_host_sync()
+        return {
+            self._set_prefix(k): shared[k] if shared is not None and k in shared else m.compute()
+            for k, m in self.items()
+        }
+
+    def _grouped_host_sync(self) -> Optional[Dict[str, Any]]:
+        """Group-aware host-plane sync: ONE ``process_allgather`` plane per
+        compute group instead of one per member.
+
+        Group members accrue identical states when every write went through
+        the collection (the lockstep tracking above proves it host-side), so
+        gathering each member's state separately moves the same payload over
+        DCN once per member — the host-plane analogue of the redundancy the
+        pure plane already eliminates. For every group whose members are in
+        lockstep and share the same sync configuration, the group's first
+        lockstep member is gathered once and every such member computes from
+        that single synced state; its compute cache and ``_after_compute``
+        hook behave exactly as in the individual path. Diverged members,
+        members with per-member sync config, and sharded-engine metrics fall
+        back to their own ``compute``. Returns {member name: computed value}
+        for the members handled here, or None.
+        """
+        ids = self.__dict__.get("_lockstep_ids")
+        if ids is None:
+            return None
+        import jax
+
+        from metrics_tpu.parallel.sync import host_gather
+
+        self._lockstep_check()
+        diverged = self.__dict__.get("_lockstep_diverged", set())
+        multiproc = jax.process_count() > 1
+        out: Dict[str, Any] = {}
+        for rep, members in self.compute_groups.items():
+            if len(members) < 2:
+                continue
+            rep_m = self[rep]
+            gather_fn = rep_m.dist_sync_fn
+            if gather_fn is None and multiproc:
+                gather_fn = rep_m._default_gather()
+            if gather_fn is None or rep_m._states_own_sync():
+                continue
+            share = [
+                k
+                for k in members
+                if k not in diverged
+                and self[k]._to_sync
+                and self[k]._computed is None
+                and self[k].dist_sync_fn is rep_m.dist_sync_fn
+                and self[k].process_group == rep_m.process_group
+                and not self[k]._states_own_sync()
+            ]
+            if len(share) < 2:
+                continue  # nothing saved by sharing; individual path
+            src = self[share[0]]
+            record_states_synced(len(src._defaults))
+            if TRACE.enabled:
+                with _span("collection.host_sync", {"group": rep, "shared": len(share)}):
+                    synced = host_gather(src._current_state(), src._reductions, gather_fn=gather_fn)
+            else:
+                synced = host_gather(src._current_state(), src._reductions, gather_fn=gather_fn)
+            for k in share:
+                m = self[k]
+                cache = m._current_state()
+                m._set_state(synced)
+                m._to_sync = False
+                try:
+                    out[k] = m.compute()
+                finally:
+                    m._set_state(cache)
+                    m._to_sync = True
+        return out or None
 
     def reset(self) -> None:
         for _, m in self.items():
             m.reset()
+        # a collection-level reset restores every member to defaults: group
+        # members are in lockstep again by construction
+        self.__dict__.get("_lockstep_diverged", set()).clear()
+        self._lockstep_record()
 
     def clone(self, prefix: Optional[str] = None) -> "MetricCollection":
         mc = deepcopy(self)
@@ -378,6 +551,10 @@ class MetricCollection(OrderedDict):
     _COL_CACHE_ATTRS = (
         "_col_step", "_col_batched_step", "_col_membership", "_col_fuse_failed",
         "_col_batched_failed", "_col_unfusable", "_col_groups",
+        # lockstep tracking is identity-based: array ids are meaningless on a
+        # copy, so copies re-derive it in __init__ (conservatively: members
+        # with accumulated state start diverged until the next reset)
+        "_lockstep_ids", "_lockstep_diverged",
     )
 
     def __deepcopy__(self, memo: dict) -> "MetricCollection":

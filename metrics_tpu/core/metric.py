@@ -40,8 +40,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
+from metrics_tpu.observability.counters import record_cache, record_states_synced
+from metrics_tpu.observability.trace import TRACE, span as _span
 from metrics_tpu.parallel.buffer import PaddedBuffer, buffer_append, buffer_init
-from metrics_tpu.utils import debug
+from metrics_tpu.utils import compat, debug
 from metrics_tpu.utils.data import is_concrete
 from metrics_tpu.utils.exceptions import TracingUnsupportedError
 from metrics_tpu.utils.prints import rank_zero_warn
@@ -408,12 +410,7 @@ class Metric(ABC):
     # ------------------------------------------------------------- pure core
     @staticmethod
     def _under_trace() -> bool:
-        try:
-            import jax.core as _core
-
-            return type(_core.trace_ctx.trace).__name__ != "EvalTrace"
-        except AttributeError:  # jax moved the API; be conservative
-            return False
+        return compat.under_trace()
 
     def init_state(self) -> State:
         """Fresh default state pytree.
@@ -610,6 +607,7 @@ class Metric(ABC):
         key = (key_body, with_compute)
         with _JITTED_STEP_CACHE_LOCK:
             hit = _JITTED_STEP_CACHE.get(key)
+            record_cache("step", hit is not None)
             if hit is None:
                 hit = (pins, self._build_jitted_step(with_compute, isolate=True))
                 _bounded_insert(_JITTED_STEP_CACHE, key, hit, _JITTED_STEP_CACHE_MAX)
@@ -617,6 +615,11 @@ class Metric(ABC):
 
     def forward(self, *args: Any, **kwargs: Any) -> Any:
         """Accumulate this batch and (if ``compute_on_step``) return its batch-local value."""
+        if TRACE.enabled:
+            with _span("metric.forward", {"metric": type(self).__name__}):
+                if self._fusable:
+                    return self._forward_fused(*args, **kwargs)
+                return self._forward_reference(*args, **kwargs)
         if self._fusable:
             return self._forward_fused(*args, **kwargs)
         return self._forward_reference(*args, **kwargs)
@@ -789,6 +792,7 @@ class Metric(ABC):
         key = (key_body, ("scan", with_compute))
         with _JITTED_STEP_CACHE_LOCK:
             hit = _JITTED_STEP_CACHE.get(key)
+            record_cache("step", hit is not None)
             if hit is None:
                 hit = (pins, self._build_scan_step(with_compute, isolate=True))
                 _bounded_insert(_JITTED_STEP_CACHE, key, hit, _JITTED_STEP_CACHE_MAX)
@@ -874,7 +878,12 @@ class Metric(ABC):
         """Host-plane sync: gather + stack/flatten + per-state reduction
         (reference metric.py:179-197)."""
         gather = dist_sync_fn if dist_sync_fn is not None else self._default_gather()
-        synced = host_gather(self._current_state(), self._reductions, gather_fn=gather)
+        record_states_synced(len(self._defaults))
+        if TRACE.enabled:
+            with _span("metric.sync_state", {"metric": type(self).__name__}):
+                synced = host_gather(self._current_state(), self._reductions, gather_fn=gather)
+        else:
+            synced = host_gather(self._current_state(), self._reductions, gather_fn=gather)
         self._set_state(synced)
 
     def _wrap_update(self, update: Callable) -> Callable:
@@ -882,6 +891,9 @@ class Metric(ABC):
         def wrapped_func(*args: Any, **kwargs: Any) -> Any:
             self._computed = None
             self._note_rows(args, kwargs)
+            if TRACE.enabled:
+                with _span("metric.update", {"metric": type(self).__name__}):
+                    return update(*args, **kwargs)
             return update(*args, **kwargs)
 
         return wrapped_func
@@ -957,6 +969,12 @@ class Metric(ABC):
     def _wrap_compute(self, compute: Callable) -> Callable:
         @functools.wraps(compute)
         def wrapped_func(*args: Any, **kwargs: Any) -> Any:
+            if TRACE.enabled:
+                with _span("metric.compute", {"metric": type(self).__name__}):
+                    return compute_body(*args, **kwargs)
+            return compute_body(*args, **kwargs)
+
+        def compute_body(*args: Any, **kwargs: Any) -> Any:
             if not self._in_forward:  # epoch-level compute, not the per-step batch value
                 # before the cache early-return: a forward_batched-seeded
                 # cache must not suppress the overflow warning

@@ -1,0 +1,95 @@
+"""Observability for the metric hot paths: spans, collective accounting, export.
+
+The paper's promise is metric accumulation and sync cost hidden inside the
+training step; this subsystem is how that cost is *read* instead of trusted.
+Four layers, all off by default with a zero-allocation disabled path:
+
+- :mod:`~metrics_tpu.observability.trace` — monotonic-clock span tracer
+  (context-manager + decorator API, thread-local nesting) over the host-side
+  hot paths: ``Metric.forward/update/compute``, the fused collection step,
+  the host sync plane.
+- :mod:`~metrics_tpu.observability.counters` — collective accounting: how
+  many ``psum``/``all_gather``/``process_allgather`` a sync plane issues,
+  bytes moved per collective per dtype bucket, states synced, and cache
+  traffic for the compute-group / jitted-step / sharded-launch caches.
+- :mod:`~metrics_tpu.observability.export` — ``summarize()`` aggregates,
+  JSON-lines dump, and Chrome-trace/Perfetto ``trace_events`` files.
+- :mod:`~metrics_tpu.observability.jaxprof` — projects the same phase names
+  into ``jax.named_scope`` / ``jax.profiler`` so device timelines carry
+  ``metric.update`` / ``metric.sync`` / ``collection.fused_step``.
+
+Typical use::
+
+    from metrics_tpu import observability as obs
+
+    obs.enable()
+    ...  # run the eval loop
+    print(obs.summarize())                # per-phase ms, keyed by span name
+    print(obs.counters_snapshot())        # collective calls / bytes / caches
+    obs.write_chrome_trace("trace.json")  # load in ui.perfetto.dev
+    obs.disable()
+"""
+from typing import Any, Dict
+
+from metrics_tpu.observability import counters as _counters_mod
+from metrics_tpu.observability import trace as _trace_mod
+from metrics_tpu.observability.counters import COUNTERS, CollectiveCounters
+from metrics_tpu.observability.export import (
+    chrome_trace,
+    summarize,
+    to_trace_events,
+    write_chrome_trace,
+    write_jsonl,
+)
+from metrics_tpu.observability.jaxprof import annotate, start_trace, stop_trace
+from metrics_tpu.observability.trace import SpanRecord, TRACE, records, span, traced
+
+__all__ = [
+    "COUNTERS",
+    "CollectiveCounters",
+    "SpanRecord",
+    "TRACE",
+    "annotate",
+    "chrome_trace",
+    "counters_snapshot",
+    "disable",
+    "enable",
+    "is_enabled",
+    "records",
+    "reset",
+    "span",
+    "start_trace",
+    "stop_trace",
+    "summarize",
+    "to_trace_events",
+    "traced",
+    "write_chrome_trace",
+    "write_jsonl",
+]
+
+
+def enable(spans: bool = True, counters: bool = True) -> None:
+    """Turn observability on (span recording and/or collective counting)."""
+    if spans:
+        _trace_mod.enable()
+    if counters:
+        _counters_mod.enable()
+
+
+def disable() -> None:
+    _trace_mod.disable()
+    _counters_mod.disable()
+
+
+def is_enabled() -> bool:
+    return _trace_mod.is_enabled() or _counters_mod.is_enabled()
+
+
+def reset() -> None:
+    """Drop all recorded spans and zero every counter."""
+    _trace_mod.clear()
+    _counters_mod.reset()
+
+
+def counters_snapshot(reset_after: bool = False) -> Dict[str, Any]:
+    return _counters_mod.snapshot(reset_after=reset_after)

@@ -1,0 +1,187 @@
+"""Collective accounting for the metric sync planes.
+
+What gets counted, and on which plane:
+
+- **In-jit collectives** (``psum``/``pmean``/``pmin``/``pmax``/``all_gather``/
+  ``ppermute``/``all_to_all``): the instrumented call sites
+  (``parallel/sync.py``, ``parallel/sharded_epoch.py``) run at *trace time* —
+  once per compiled program, not once per executed step. A counted collective
+  therefore means "one collective op staged into the program", which IS the
+  per-step collective cost, because the compiled program replays those ops
+  every step. A ``ppermute`` staged inside a ``fori_loop`` ring counts once
+  with its per-hop payload (the loop multiplies executions, not staged ops);
+  the ``hops`` attribution lives with the engine, not the counter.
+- **Host-plane collectives** (``process_allgather`` via
+  ``gather_all_arrays``): these run eagerly, so counts are real per-call
+  counts.
+- **Bytes** are the local payload entering each collective, bucketed per
+  (kind, dtype): ``size * itemsize`` of the (possibly traced) operand —
+  shapes are static under tracing, so the byte count is exact either way.
+- **states_synced**: state leaves entering a sync plane (the number the
+  compute-group dedup and bucket coalescing shrink).
+- **Cache traffic**: compute-group map builds, shared jitted-step lookups,
+  and sharded-launch lookups, as hit/miss pairs.
+
+Counting is off by default; the disabled path is one attribute load and a
+falsy branch per call site. All mutation happens under one lock — counter
+call sites are trace-time or epoch-level, never the per-step replay path, so
+contention is irrelevant next to correctness under concurrent retraces.
+"""
+import threading
+from typing import Any, Dict, Optional
+
+__all__ = [
+    "COUNTERS",
+    "CollectiveCounters",
+    "enable",
+    "disable",
+    "is_enabled",
+    "record_cache",
+    "record_collective",
+    "record_states_synced",
+    "reset",
+    "snapshot",
+]
+
+# collective kinds with a stable schema position in snapshots
+KINDS = (
+    "psum",
+    "pmean",
+    "pmin",
+    "pmax",
+    "all_gather",
+    "ppermute",
+    "all_to_all",
+    "process_allgather",
+)
+
+
+class CollectiveCounters:
+    """Process-wide counters; ``enabled`` is the hot-path gate."""
+
+    __slots__ = (
+        "enabled",
+        "calls_by_kind",
+        "bytes_by_kind_dtype",
+        "states_synced",
+        "group_cache_hits",
+        "group_cache_misses",
+        "step_cache_hits",
+        "step_cache_misses",
+        "launch_cache_hits",
+        "launch_cache_misses",
+        "_lock",
+    )
+
+    def __init__(self) -> None:
+        self.enabled = False
+        self._lock = threading.Lock()
+        self._zero()
+
+    def _zero(self) -> None:
+        self.calls_by_kind: Dict[str, int] = {}
+        self.bytes_by_kind_dtype: Dict[tuple, int] = {}  # (kind, dtype str) -> bytes
+        self.states_synced = 0
+        self.group_cache_hits = 0
+        self.group_cache_misses = 0
+        self.step_cache_hits = 0
+        self.step_cache_misses = 0
+        self.launch_cache_hits = 0
+        self.launch_cache_misses = 0
+
+    # ---------------------------------------------------------- recording
+    def record_collective(self, kind: str, value: Any) -> None:
+        """Count one collective of ``kind`` moving ``value`` (array or scalar).
+
+        ``value`` may be a tracer — only its static ``size``/``dtype`` are
+        read. Callers gate on ``COUNTERS.enabled`` so the disabled path never
+        reaches this method.
+        """
+        size = getattr(value, "size", None)
+        itemsize = getattr(getattr(value, "dtype", None), "itemsize", None)
+        nbytes = int(size) * int(itemsize) if size is not None and itemsize is not None else 0
+        dtype = str(getattr(value, "dtype", "other"))
+        with self._lock:
+            self.calls_by_kind[kind] = self.calls_by_kind.get(kind, 0) + 1
+            key = (kind, dtype)
+            self.bytes_by_kind_dtype[key] = self.bytes_by_kind_dtype.get(key, 0) + nbytes
+
+    def record_states_synced(self, n: int) -> None:
+        with self._lock:
+            self.states_synced += int(n)
+
+    def record_cache(self, which: str, hit: bool) -> None:
+        """``which`` in {'group', 'step', 'launch'}."""
+        attr = f"{which}_cache_{'hits' if hit else 'misses'}"
+        with self._lock:
+            setattr(self, attr, getattr(self, attr) + 1)
+
+    # ------------------------------------------------------------ reading
+    def snapshot(self) -> Dict[str, Any]:
+        """A JSON-ready copy of every counter.
+
+        ``collective_calls``/``sync_bytes`` are the totals the bench line
+        reports; the per-kind and per-(kind, dtype) breakdowns ride along for
+        the JSONL/Perfetto exports.
+        """
+        with self._lock:
+            calls = dict(self.calls_by_kind)
+            by_bucket = dict(self.bytes_by_kind_dtype)
+            return {
+                "collective_calls": sum(calls.values()),
+                "sync_bytes": sum(by_bucket.values()),
+                "calls_by_kind": {k: calls.get(k, 0) for k in KINDS if calls.get(k, 0)},
+                "bytes_by_kind_dtype": {f"{k}:{d}": b for (k, d), b in sorted(by_bucket.items())},
+                "states_synced": self.states_synced,
+                "group_cache": {"hits": self.group_cache_hits, "misses": self.group_cache_misses},
+                "step_cache": {"hits": self.step_cache_hits, "misses": self.step_cache_misses},
+                "launch_cache": {"hits": self.launch_cache_hits, "misses": self.launch_cache_misses},
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._zero()
+
+
+COUNTERS = CollectiveCounters()
+
+
+# Call-site helpers: one function call + a falsy attribute check when
+# counting is off. The instrumented sites are trace-time or epoch-level —
+# never the compiled replay path — so this is cheap even enabled.
+def record_collective(kind: str, value: Any) -> None:
+    if COUNTERS.enabled:
+        COUNTERS.record_collective(kind, value)
+
+
+def record_states_synced(n: int) -> None:
+    if COUNTERS.enabled:
+        COUNTERS.record_states_synced(n)
+
+
+def record_cache(which: str, hit: bool) -> None:
+    if COUNTERS.enabled:
+        COUNTERS.record_cache(which, hit)
+
+
+def enable() -> None:
+    COUNTERS.enabled = True
+
+
+def disable() -> None:
+    COUNTERS.enabled = False
+
+
+def is_enabled() -> bool:
+    return COUNTERS.enabled
+
+
+def reset() -> None:
+    COUNTERS.reset()
+
+
+def snapshot(reset_after: bool = False) -> Dict[str, Any]:
+    out = COUNTERS.snapshot()
+    if reset_after:
+        COUNTERS.reset()
+    return out

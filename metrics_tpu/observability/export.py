@@ -1,0 +1,151 @@
+"""Exports: span aggregates, JSON-lines, and Chrome-trace/Perfetto files.
+
+Three consumers, three formats:
+
+- ``summarize()``: an in-process aggregate table keyed by span name
+  (count / total / mean / min / max ms) — what bench.py folds into its JSON
+  line as ``phase_ms``.
+- ``write_jsonl()``: one self-describing JSON object per line (``span`` lines,
+  then ``summary`` lines, then one ``counters`` line) — grep/jq-friendly,
+  append-safe, schema pinned by tests/integrations/test_bench_smoke.py.
+- ``chrome_trace()`` / ``write_chrome_trace()``: the Chrome ``trace_events``
+  JSON-object format (https://docs.google.com/document/d/1CvAClvFfyA5R-PhYUmn5OOQtYMH4h6I0nSsKchNAySU)
+  that ``chrome://tracing`` and https://ui.perfetto.dev load directly. Spans
+  become complete (``"ph": "X"``) events on their thread's track; the
+  collective counters ride in ``otherData``.
+"""
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from metrics_tpu.observability import counters as _counters
+from metrics_tpu.observability import trace as _trace
+from metrics_tpu.observability.trace import SpanRecord
+
+__all__ = ["summarize", "to_trace_events", "chrome_trace", "write_chrome_trace", "write_jsonl"]
+
+
+def summarize(records: Optional[List[SpanRecord]] = None) -> Dict[str, Dict[str, Any]]:
+    """Aggregate spans by name: {name: {count, total_ms, mean_ms, min_ms, max_ms}}."""
+    if records is None:
+        records = _trace.records()
+    table: Dict[str, Dict[str, Any]] = {}
+    for rec in records:
+        ms = rec.duration_ms
+        row = table.get(rec.name)
+        if row is None:
+            table[rec.name] = {"count": 1, "total_ms": ms, "min_ms": ms, "max_ms": ms}
+        else:
+            row["count"] += 1
+            row["total_ms"] += ms
+            row["min_ms"] = min(row["min_ms"], ms)
+            row["max_ms"] = max(row["max_ms"], ms)
+    for row in table.values():
+        row["mean_ms"] = row["total_ms"] / row["count"]
+    return table
+
+
+def _epoch_us(ns: int) -> float:
+    """Map a perf_counter_ns stamp onto the wall-clock epoch, in microseconds."""
+    wall_ns, mono_ns = _trace.TRACE.epoch_anchor
+    return (wall_ns + (ns - mono_ns)) / 1e3
+
+
+def to_trace_events(records: Optional[List[SpanRecord]] = None) -> List[Dict[str, Any]]:
+    """Spans as Chrome ``trace_events`` complete events (``ph: 'X'``)."""
+    if records is None:
+        records = _trace.records()
+    events: List[Dict[str, Any]] = []
+    threads_seen = set()
+    for rec in records:
+        if rec.thread_id not in threads_seen:
+            threads_seen.add(rec.thread_id)
+            events.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": 0,
+                    "tid": rec.thread_id,
+                    "args": {
+                        "name": "main"
+                        if rec.thread_id == threading.main_thread().ident
+                        else f"thread-{rec.thread_id}"
+                    },
+                }
+            )
+        event: Dict[str, Any] = {
+            "name": rec.name,
+            "ph": "X",
+            "ts": _epoch_us(rec.start_ns),
+            "dur": (rec.end_ns - rec.start_ns) / 1e3,
+            "pid": 0,
+            "tid": rec.thread_id,
+        }
+        args: Dict[str, Any] = {}
+        if rec.parent is not None:
+            args["parent"] = rec.parent
+        if rec.attrs:
+            args.update(rec.attrs)
+        if args:
+            event["args"] = args
+        events.append(event)
+    return events
+
+
+def chrome_trace(
+    records: Optional[List[SpanRecord]] = None,
+    include_counters: bool = True,
+) -> Dict[str, Any]:
+    """The full Chrome-trace JSON object (Perfetto-loadable)."""
+    out: Dict[str, Any] = {
+        "traceEvents": to_trace_events(records),
+        "displayTimeUnit": "ms",
+    }
+    if include_counters:
+        out["otherData"] = _counters.snapshot()
+    return out
+
+
+def write_chrome_trace(
+    path: str,
+    records: Optional[List[SpanRecord]] = None,
+    include_counters: bool = True,
+) -> None:
+    """Write a ``.json`` trace loadable by chrome://tracing / ui.perfetto.dev."""
+    with open(path, "w") as f:
+        json.dump(chrome_trace(records, include_counters=include_counters), f)
+
+
+def write_jsonl(path: str, records: Optional[List[SpanRecord]] = None) -> None:
+    """JSON-lines dump: per-span lines, per-name summary lines, counters line.
+
+    Line schema (the ``type`` field discriminates):
+      {"type": "span", "name", "start_us", "dur_ms", "tid", "depth", "parent", "attrs"}
+      {"type": "summary", "name", "count", "total_ms", "mean_ms", "min_ms", "max_ms"}
+      {"type": "counters", "collective_calls", "sync_bytes", ...}
+    """
+    if records is None:
+        records = _trace.records()
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(
+                json.dumps(
+                    {
+                        "type": "span",
+                        "name": rec.name,
+                        "start_us": _epoch_us(rec.start_ns),
+                        "dur_ms": rec.duration_ms,
+                        "tid": rec.thread_id,
+                        "depth": rec.depth,
+                        "parent": rec.parent,
+                        "attrs": rec.attrs,
+                    }
+                )
+                + "\n"
+            )
+        for name, row in sorted(summarize(records).items()):
+            f.write(json.dumps({"type": "summary", "name": name, **row}) + "\n")
+        f.write(
+            json.dumps({"type": "counters", "exported_at": time.time(), **_counters.snapshot()}) + "\n"
+        )

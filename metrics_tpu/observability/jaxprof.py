@@ -1,0 +1,98 @@
+"""Optional ``jax.profiler`` / ``named_scope`` projection of the span names.
+
+The host-side tracer (:mod:`metrics_tpu.observability.trace`) measures wall
+time around dispatches; it cannot see inside the device timeline. This module
+projects the SAME phase names into jax's own instrumentation so a device
+profile (``jax.profiler.trace`` + TensorBoard/Perfetto) shows
+``metric.update`` / ``metric.sync`` / ``collection.fused_step`` phases:
+
+- under a jax trace, ``jax.named_scope`` names the staged ops — the phase
+  label survives into XLA metadata and shows up on the device timeline;
+- eagerly, ``jax.profiler.TraceAnnotation`` marks the host timeline of a
+  running profiler session.
+
+Both are no-ops (a shared singleton, no allocation) until observability is
+enabled, so the default path stays cold. ``annotate`` never *starts* a
+profiler session — it only labels one that the user (or ``start_trace``)
+already opened.
+"""
+from typing import Any, Optional
+
+from metrics_tpu.observability.trace import TRACE
+
+__all__ = ["annotate", "start_trace", "stop_trace"]
+
+
+class _NullAnnotation:
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullAnnotation":
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        return False
+
+
+_NULL = _NullAnnotation()
+
+
+class _Annotation:
+    """Named scope under tracing; profiler TraceAnnotation eagerly."""
+
+    __slots__ = ("name", "_cm")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._cm = None
+
+    def __enter__(self) -> "_Annotation":
+        import jax
+
+        from metrics_tpu.utils.compat import under_trace
+
+        if under_trace():
+            self._cm = jax.named_scope(self.name)
+        else:
+            self._cm = jax.profiler.TraceAnnotation(self.name)
+        self._cm.__enter__()
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        cm, self._cm = self._cm, None
+        return bool(cm.__exit__(*exc))
+
+
+def annotate(name: str):
+    """Label the enclosed work with ``name`` on the jax timeline (device ops
+    when tracing, host profiler track eagerly); no-op while observability is
+    disabled."""
+    if not TRACE.enabled:
+        return _NULL
+    return _Annotation(name)
+
+
+def start_trace(log_dir: str, host_tracer_level: Optional[int] = None) -> None:
+    """Start a ``jax.profiler`` trace session writing to ``log_dir``.
+
+    Thin convenience wrapper so bench/debug scripts need no direct profiler
+    import; view with TensorBoard's profile plugin or ui.perfetto.dev.
+    """
+    import jax
+
+    options = None
+    if host_tracer_level is not None:
+        try:
+            options = jax.profiler.ProfileOptions()
+            options.host_tracer_level = host_tracer_level
+        except AttributeError:  # older jax: no ProfileOptions
+            options = None
+    if options is not None:
+        jax.profiler.start_trace(log_dir, profiler_options=options)
+    else:
+        jax.profiler.start_trace(log_dir)
+
+
+def stop_trace() -> None:
+    import jax
+
+    jax.profiler.stop_trace()

@@ -27,7 +27,11 @@ import jax.numpy as jnp
 from jax import Array
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from metrics_tpu.observability.counters import record_cache
+from metrics_tpu.observability.jaxprof import annotate
+from metrics_tpu.observability.trace import TRACE, span as _span
 from metrics_tpu.parallel.buffer import PaddedBuffer
+from metrics_tpu.utils import compat
 from metrics_tpu.parallel.sharded_epoch import (
     sharded_auroc_matrix,
     sharded_average_precision_matrix,
@@ -123,23 +127,28 @@ def _launch(
     local = datas[0].shape[0] // n
     full_key = (key, mesh, axis, out_specs, tuple((d.shape, str(d.dtype)) for d in datas))
     fn = _LAUNCH_CACHE.get(full_key)
+    record_cache("launch", fn is not None)
     if fn is None:
         body = body_factory()
 
         def shard_fn(cnt, *blocks):
-            i = jax.lax.axis_index(axis)
-            rows = i * local + jnp.arange(local)
-            return body(blocks, rows < cnt)
+            with annotate("sharded.engine"):
+                i = jax.lax.axis_index(axis)
+                rows = i * local + jnp.arange(local)
+                return body(blocks, rows < cnt)
 
         in_specs = (P(),) + tuple(P(axis, *([None] * (d.ndim - 1))) for d in datas)
         fn = jax.jit(
-            jax.shard_map(
+            compat.shard_map(
                 shard_fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
             )
         )
         from metrics_tpu.core.metric import _bounded_insert
 
         _bounded_insert(_LAUNCH_CACHE, full_key, fn, _LAUNCH_CACHE_MAX)
+    if TRACE.enabled:
+        with _span("sharded.launch", {"key": str(key[1]) if isinstance(key, tuple) and len(key) > 1 else str(key)}):
+            return fn(count, *datas)
     return fn(count, *datas)
 
 

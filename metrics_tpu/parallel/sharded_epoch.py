@@ -36,29 +36,21 @@ epoch sharded *through* compute:
 Use inside ``shard_map`` over the data axis. All functions are jit-safe,
 static-shape, and collective-only (no host round trips).
 """
-from functools import partial
 from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import Array
 
+from metrics_tpu.observability.counters import record_collective
+from metrics_tpu.utils.compat import axis_size, ensure_varying
+
 # pad query id for regroup ghost rows; real query ids must not use it
 PAD_QUERY_ID = jnp.iinfo(jnp.int32).max
 
 
-def _ensure_varying(x: Array, axis_name: str) -> Array:
-    """Mark ``x`` varying over ``axis_name`` if it isn't already.
-
-    Constants built inside a ``shard_map`` body (None-weight fallbacks,
-    all-zero targets) are invariant-typed; feeding them into a ``ppermute``
-    ring makes the loop carry's manual-axes type flip mid-loop. ``pvary``
-    itself rejects already-varying input, hence the check.
-    """
-    vma = getattr(jax.typeof(x), "vma", frozenset())
-    if axis_name in vma:
-        return x
-    return jax.lax.pcast(x, (axis_name,), to="varying")
+# varying-manual-axes marking is jax-version dependent; see utils/compat.py
+_ensure_varying = ensure_varying
 
 
 class _SortedPack(NamedTuple):
@@ -102,7 +94,7 @@ def _ring_stats_cols(
     transfer, not C small ones); the searchsorted accumulation vmaps over the
     class axis. Returns four ``(C, m)`` arrays.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     pack = jax.vmap(_pack)(preds_cm, target_cm, weights_cm)
     perm = [(j, (j + 1) % n) for j in range(n)]
 
@@ -112,6 +104,9 @@ def _ring_stats_cols(
         acc = tuple(a + b for a, b in zip(acc, jax.vmap(_below_tie_ge)(visiting, preds_cm)))
         return acc, visiting
 
+    # one ppermute of the 3-leaf pack staged per loop body (n-1 executed hops)
+    for leaf in pack:
+        record_collective("ppermute", leaf)
     # local contribution first, then n-1 ring hops (no dead final collective)
     acc = jax.vmap(_below_tie_ge)(pack, preds_cm)
     (acc, _) = jax.lax.fori_loop(0, n - 1, body, (acc, pack))
@@ -152,9 +147,9 @@ def sharded_auroc_matrix(
     u_local = jnp.sum(wp * (wn_below + 0.5 * wn_tie), axis=-1)
     # one coalesced collective for all three reductions (collectives are
     # latency-bound at these sizes; see parallel.sync.coalesced_sync_state)
-    u, pos, neg = jax.lax.psum(
-        jnp.stack([u_local, jnp.sum(wp, axis=-1), jnp.sum(w * (1.0 - y), axis=-1)]), axis_name
-    )
+    stacked = jnp.stack([u_local, jnp.sum(wp, axis=-1), jnp.sum(w * (1.0 - y), axis=-1)])
+    record_collective("psum", stacked)
+    u, pos, neg = jax.lax.psum(stacked, axis_name)
     denom = pos * neg
     scores = jnp.where(denom == 0, jnp.nan, u / jnp.where(denom == 0, 1.0, denom))
     return (scores, pos) if with_support else scores
@@ -173,7 +168,9 @@ def sharded_average_precision_matrix(
     _, _, wp_ge, wn_ge = _ring_stats_cols(preds_cm, y, w, axis_name)
     wp = w * y
     contrib = jnp.sum(wp * wp_ge / jnp.maximum(wp_ge + wn_ge, 1e-38), axis=-1)
-    total, pos = jax.lax.psum(jnp.stack([contrib, jnp.sum(wp, axis=-1)]), axis_name)
+    stacked = jnp.stack([contrib, jnp.sum(wp, axis=-1)])
+    record_collective("psum", stacked)
+    total, pos = jax.lax.psum(stacked, axis_name)
     scores = jnp.where(pos == 0, jnp.nan, total / jnp.where(pos == 0, 1.0, pos))
     return (scores, pos) if with_support else scores
 
@@ -235,7 +232,9 @@ def sharded_clf_curve_matrix(
     p = jnp.where(w > 0, preds_cm, -jnp.inf)
     _, _, wp_ge, wn_ge = _ring_stats_cols(p, target_cm, w, axis_name)
 
-    gather = partial(jax.lax.all_gather, axis_name=axis_name, axis=1, tiled=True)
+    def gather(x):
+        record_collective("all_gather", x)
+        return jax.lax.all_gather(x, axis_name=axis_name, axis=1, tiled=True)
     neg_s, tps, fps, wv = jax.lax.sort(
         (gather(-p), gather(wp_ge), gather(wn_ge), gather(w)), num_keys=1
     )
@@ -300,19 +299,20 @@ def sharded_spearman(
     below, tie, _, _ = _ring_stats_cols(stacked, y2, w2, axis_name)
     ranks = _midrank(below, tie)
     rx, ry = ranks[0], ranks[1]
-    total = jax.lax.psum(jnp.sum(w), axis_name)
+    w_sum = jnp.sum(w)
+    record_collective("psum", w_sum)
+    total = jax.lax.psum(w_sum, axis_name)
     # scale ranks to O(1) before the moment sums: correlation is affine-
     # invariant and raw ranks would push f32 accumulations to O(N^3)
     scale = 1.0 / jnp.maximum(total, 1.0)
     rx, ry = rx * scale, ry * scale
     # all five moment reductions ride ONE coalesced collective
-    sx, sy, sxx, syy, sxy = jax.lax.psum(
-        jnp.stack([
-            jnp.sum(w * rx), jnp.sum(w * ry),
-            jnp.sum(w * rx * rx), jnp.sum(w * ry * ry), jnp.sum(w * rx * ry),
-        ]),
-        axis_name,
-    )
+    moments = jnp.stack([
+        jnp.sum(w * rx), jnp.sum(w * ry),
+        jnp.sum(w * rx * rx), jnp.sum(w * ry * ry), jnp.sum(w * rx * ry),
+    ])
+    record_collective("psum", moments)
+    sx, sy, sxx, syy, sxy = jax.lax.psum(moments, axis_name)
     cov = total * sxy - sx * sy
     var_x = total * sxx - sx * sx
     var_y = total * syy - sy * sy
@@ -339,7 +339,7 @@ def sharded_kendall(
     concatenated epoch. ``sample_weights`` is a 0/1 validity mask. ``nan``
     when either array is globally constant or the epoch is empty.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     m = preds.shape[0]
     x = preds.astype(jnp.float32)
     y = target.astype(jnp.float32)
@@ -373,6 +373,8 @@ def sharded_kendall(
         return jax.lax.fori_loop(0, n_chunks, block, acc)
 
     zeros = jnp.zeros_like(xq)  # derived from the shard: varying-axis typed
+    for leaf in (x, y, w):
+        record_collective("ppermute", leaf)
     acc = contract((x, y, w), (zeros, zeros, zeros))
     perm = [(j, (j + 1) % n) for j in range(n)]
 
@@ -385,13 +387,12 @@ def sharded_kendall(
     s_all, tx_all, ty_all = s_all[:m], tx_all[:m], ty_all[:m]
 
     # one coalesced collective for all five epoch sums
-    s, t_x, t_y, w_tot, w_sq = jax.lax.psum(
-        jnp.stack([
-            jnp.sum(w * s_all), jnp.sum(w * tx_all), jnp.sum(w * ty_all),
-            jnp.sum(w), jnp.sum(w * w),
-        ]),
-        axis_name,
-    )
+    sums = jnp.stack([
+        jnp.sum(w * s_all), jnp.sum(w * tx_all), jnp.sum(w * ty_all),
+        jnp.sum(w), jnp.sum(w * w),
+    ])
+    record_collective("psum", sums)
+    s, t_x, t_y, w_tot, w_sq = jax.lax.psum(sums, axis_name)
     s = s / 2.0
     n1 = (t_x - w_sq) / 2.0  # pairs tied in x (diagonal removed)
     n2 = (t_y - w_sq) / 2.0
@@ -420,7 +421,7 @@ def regroup_by_query(
     take no bucket slot, never count as dropped, and arrive as pad rows
     (the padded-buffer epoch-state story, ``parallel/sharded_dispatch.py``).
     """
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     rows = idx.shape[0]
     if capacity is None:
         capacity = max(2 * -(-rows // n), 1)
@@ -446,13 +447,17 @@ def regroup_by_query(
     bucket_target = scatter(target, jnp.zeros((), target.dtype)).reshape(n, capacity)
     bucket_real = scatter(jnp.ones((rows,), jnp.bool_), False).reshape(n, capacity)
 
-    ex = partial(jax.lax.all_to_all, axis_name=axis_name, split_axis=0, concat_axis=0, tiled=True)
+    def ex(x):
+        record_collective("all_to_all", x)
+        return jax.lax.all_to_all(x, axis_name=axis_name, split_axis=0, concat_axis=0, tiled=True)
     my_idx = ex(bucket_idx).reshape(-1)
     my_preds = ex(bucket_preds).reshape(-1)
     my_target = ex(bucket_target).reshape(-1)
     my_real = ex(bucket_real).reshape(-1)
 
-    dropped = jax.lax.psum(jnp.sum(jnp.maximum(counts - capacity, 0)), axis_name)
+    overflow = jnp.sum(jnp.maximum(counts - capacity, 0))
+    record_collective("psum", overflow)
+    dropped = jax.lax.psum(overflow, axis_name)
     return my_idx, my_preds, my_target, ~my_real, dropped
 
 
@@ -478,12 +483,13 @@ def sharded_retrieval_sums(
         idx, preds, target, axis_name, capacity, valid=valid
     )
     total, count, flag = metric._device_sums(g_idx, g_preds, g_target, pad=pad)
+    record_collective("psum", total)
     total = jax.lax.psum(total, axis_name)
     # count/flag coalesce into one integer collective (total keeps its own
     # float plane: folding counts into f32 would lose exactness past 2^24)
-    count, flag_sum = jax.lax.psum(
-        jnp.stack([jnp.asarray(count, jnp.int32), flag.astype(jnp.int32)]), axis_name
-    )
+    int_plane = jnp.stack([jnp.asarray(count, jnp.int32), flag.astype(jnp.int32)])
+    record_collective("psum", int_plane)
+    count, flag_sum = jax.lax.psum(int_plane, axis_name)
     flag = flag_sum > 0
     mean = jnp.where(count == 0, 0.0, total / jnp.maximum(count, 1))
     return mean, flag, dropped

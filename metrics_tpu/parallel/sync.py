@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax import Array
 
+from metrics_tpu.observability.counters import record_collective, record_states_synced
+from metrics_tpu.observability.jaxprof import annotate
 from metrics_tpu.parallel.buffer import PaddedBuffer, buffer_all_gather
 from metrics_tpu.utils.data import dim_zero_cat, dim_zero_max, dim_zero_mean, dim_zero_min, dim_zero_sum
 
@@ -149,8 +151,16 @@ def is_mergeable(fx: ReduceFx, default: Any) -> bool:
 
 
 def sync_value(fx: ReduceFx, value: Any, axis_name: str) -> Any:
-    """In-jit sync of one state value over a named mesh axis."""
+    """In-jit sync of one state value over a named mesh axis.
+
+    Collective accounting: this function runs at *trace* time, so the
+    counters record ops staged into the compiled program — which IS the
+    per-step collective cost (the program replays them every step). See
+    ``metrics_tpu.observability.counters``.
+    """
     if isinstance(value, PaddedBuffer):
+        record_collective("all_gather", value.data)
+        record_collective("all_gather", value.count)
         return buffer_all_gather(value, axis_name)
     if isinstance(value, list):
         raise TypeError(
@@ -158,13 +168,18 @@ def sync_value(fx: ReduceFx, value: Any, axis_name: str) -> Any:
             "with a `capacity` so cat-states use PaddedBuffers."
         )
     if fx == "sum":
+        record_collective("psum", value)
         return jax.lax.psum(value, axis_name)
     if fx == "mean":
+        record_collective("pmean", value)
         return jax.lax.pmean(value, axis_name)
     if fx == "min":
+        record_collective("pmin", value)
         return jax.lax.pmin(value, axis_name)
     if fx == "max":
+        record_collective("pmax", value)
         return jax.lax.pmax(value, axis_name)
+    record_collective("all_gather", value)
     gathered = jax.lax.all_gather(value, axis_name)  # (world, ...)
     if fx is None:
         return gathered
@@ -175,7 +190,9 @@ def sync_value(fx: ReduceFx, value: Any, axis_name: str) -> Any:
 
 def sync_state(state: Dict[str, Any], reductions: Dict[str, ReduceFx], axis_name: str) -> Dict[str, Any]:
     """In-jit sync of a whole state dict over a named mesh axis (pure, jit-safe)."""
-    return {name: sync_value(reductions[name], value, axis_name) for name, value in state.items()}
+    record_states_synced(len(state))
+    with annotate("metric.sync"):
+        return {name: sync_value(reductions[name], value, axis_name) for name, value in state.items()}
 
 
 def coalesced_sync_state(
@@ -194,26 +211,30 @@ def coalesced_sync_state(
     ``mean``, ``cat``, gather (``None``) and callable reductions, lists and
     :class:`PaddedBuffer` leaves keep their own per-leaf plane.
     """
-    out: Dict[Any, Any] = {}
-    buckets: Dict[tuple, list] = {}  # (op, dtype str) -> [leaf name]
-    for name, value in state.items():
-        fx = reductions[name]
-        if fx in ("sum", "min", "max") and not isinstance(value, (PaddedBuffer, list)):
-            buckets.setdefault((fx, str(value.dtype)), []).append(name)
-        else:
-            out[name] = sync_value(fx, value, axis_name)
-    ops = {"sum": jax.lax.psum, "min": jax.lax.pmin, "max": jax.lax.pmax}
-    for (op, _dtype), names in buckets.items():
-        if len(names) == 1:
-            out[names[0]] = sync_value(op, state[names[0]], axis_name)
-            continue
-        flat = jnp.concatenate([jnp.ravel(state[n]) for n in names])
-        synced = ops[op](flat, axis_name)
-        offset = 0
-        for n in names:
-            value = state[n]
-            out[n] = synced[offset: offset + value.size].reshape(value.shape)
-            offset += value.size
+    record_states_synced(len(state))
+    with annotate("metric.sync"):
+        out: Dict[Any, Any] = {}
+        buckets: Dict[tuple, list] = {}  # (op, dtype str) -> [leaf name]
+        for name, value in state.items():
+            fx = reductions[name]
+            if fx in ("sum", "min", "max") and not isinstance(value, (PaddedBuffer, list)):
+                buckets.setdefault((fx, str(value.dtype)), []).append(name)
+            else:
+                out[name] = sync_value(fx, value, axis_name)
+        ops = {"sum": jax.lax.psum, "min": jax.lax.pmin, "max": jax.lax.pmax}
+        kinds = {"sum": "psum", "min": "pmin", "max": "pmax"}
+        for (op, _dtype), names in buckets.items():
+            if len(names) == 1:
+                out[names[0]] = sync_value(op, state[names[0]], axis_name)
+                continue
+            flat = jnp.concatenate([jnp.ravel(state[n]) for n in names])
+            record_collective(kinds[op], flat)
+            synced = ops[op](flat, axis_name)
+            offset = 0
+            for n in names:
+                value = state[n]
+                out[n] = synced[offset: offset + value.size].reshape(value.shape)
+                offset += value.size
     return out
 
 
@@ -268,6 +289,8 @@ def gather_all_arrays(value: Array, group: Any = None) -> List[Array]:
         return [value]
     from jax.experimental import multihost_utils
 
+    # host-plane collectives run eagerly: this is a real per-call count
+    record_collective("process_allgather", value)
     gathered = multihost_utils.process_allgather(value, tiled=False)
     indices = range(gathered.shape[0]) if members is None else members
     return [gathered[i] for i in indices]

@@ -1,0 +1,97 @@
+"""Version-compat shims over the moving parts of the jax API surface.
+
+The TPU image ships a current jax where ``shard_map`` lives at ``jax.shard_map``
+with a ``check_vma`` argument, mesh-axis sizes come from ``jax.lax.axis_size``,
+and manual-axes varying types are managed with ``jax.typeof`` / ``jax.lax.pcast``.
+The CPU CI image pins jax 0.4.37, where none of those exist: ``shard_map`` is
+``jax.experimental.shard_map.shard_map(check_rep=...)``, the in-``shard_map``
+axis size comes from ``jax.core.axis_frame``, and there is no varying/invariant
+type system at all. Every call site in the library routes through this module
+so both images run the same code path (the approach ``bench.py`` and
+``tests/bases/test_compute_groups.py`` already used locally, centralized).
+
+Resolution happens once at import: the shims bind the right implementation for
+the running jax instead of re-probing per call (these sit on trace-time hot
+paths).
+"""
+from typing import Any, Callable
+
+import jax
+
+__all__ = ["shard_map", "axis_size", "ensure_varying", "under_trace", "HAS_VMA"]
+
+# Whether this jax has the varying-manual-axes (vma) type system for shard_map
+# bodies. Without it, every value inside shard_map is implicitly varying and
+# ``ensure_varying`` is the identity.
+HAS_VMA = hasattr(jax, "typeof") and hasattr(jax.lax, "pcast")
+
+
+if getattr(jax, "shard_map", None) is not None:
+
+    def shard_map(fn: Callable, mesh: Any, in_specs: Any, out_specs: Any, check_vma: bool = True) -> Callable:
+        """``jax.shard_map`` with the current-jax ``check_vma`` argument."""
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma)
+
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    def shard_map(fn: Callable, mesh: Any, in_specs: Any, out_specs: Any, check_vma: bool = True) -> Callable:
+        """Old-jax ``shard_map``; ``check_vma`` maps onto ``check_rep`` (the
+        replication check is the closest ancestor of the vma check — both
+        verify that ``out_specs``-replicated outputs really are invariant)."""
+        return _shard_map_old(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma)
+
+
+if hasattr(jax.lax, "axis_size"):
+
+    def axis_size(axis_name: str) -> int:
+        """Size of a named mesh axis, from inside ``shard_map``/``pmap``."""
+        return jax.lax.axis_size(axis_name)
+
+else:
+
+    def axis_size(axis_name: str) -> int:
+        # jax 0.4.37: ``jax.core.axis_frame(name)`` resolves the bound axis
+        # and returns its size directly (an int under shard_map tracing)
+        from jax.core import axis_frame
+
+        frame = axis_frame(axis_name)
+        return frame if isinstance(frame, int) else frame.size
+
+
+if HAS_VMA:
+
+    def ensure_varying(x: Any, axis_name: str) -> Any:
+        """Mark ``x`` varying over ``axis_name`` if it isn't already.
+
+        Constants built inside a ``shard_map`` body (None-weight fallbacks,
+        all-zero targets) are invariant-typed; feeding them into a ``ppermute``
+        ring makes the loop carry's manual-axes type flip mid-loop. ``pvary``
+        itself rejects already-varying input, hence the check.
+        """
+        vma = getattr(jax.typeof(x), "vma", frozenset())
+        if axis_name in vma:
+            return x
+        return jax.lax.pcast(x, (axis_name,), to="varying")
+
+else:
+
+    def ensure_varying(x: Any, axis_name: str) -> Any:
+        """No vma type system on this jax: every shard_map value is varying."""
+        return x
+
+
+def under_trace() -> bool:
+    """Whether the caller is running under a jax trace (jit/vmap/scan body)."""
+    try:
+        import jax.core as _core
+
+        return type(_core.trace_ctx.trace).__name__ != "EvalTrace"
+    except AttributeError:
+        pass
+    try:
+        from jax.core import trace_state_clean
+
+        return not trace_state_clean()
+    except ImportError:  # jax moved the API again; be conservative
+        return False

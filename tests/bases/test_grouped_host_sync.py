@@ -1,0 +1,203 @@
+"""Group-aware host-plane sync of MetricCollection.compute().
+
+On multi-host (or with a custom ``dist_sync_fn``), each member of a compute
+group used to gather its — identical — state independently. The collection
+now proves lockstep host-side (array-identity tracking, zero device work) and
+routes ONE gather per group through the group's first lockstep member, while
+members written outside the collection fall back to their own sync. The
+contract: values are bit-identical to the fully-independent path, only the
+number of gather calls shrinks.
+
+A counting fake ``dist_sync_fn`` doubles as the two-rank world: it returns
+``[x, x]``, exactly what ``gather_all_arrays`` yields on two ranks in
+lockstep, and its call count is the observable being optimized.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, F1, MetricCollection, Precision, Recall
+from metrics_tpu import observability as obs
+
+
+class _CountingGather:
+    """fn(array) -> [array, array]: a fake 2-rank world that counts calls."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def __call__(self, x):
+        self.calls += 1
+        return [x, x]
+
+
+def _collection(gather, compute_groups=True):
+    return MetricCollection(
+        [
+            Accuracy(dist_sync_fn=gather),
+            F1(num_classes=4, average="macro", dist_sync_fn=gather),
+            Precision(num_classes=4, average="macro", dist_sync_fn=gather),
+            Recall(num_classes=4, average="macro", dist_sync_fn=gather),
+        ],
+        compute_groups=compute_groups,
+    )
+
+
+def _data(rng, n=32, c=4):
+    logits = rng.rand(n, c).astype(np.float32)
+    return (
+        jnp.asarray(logits / logits.sum(-1, keepdims=True)),
+        jnp.asarray(rng.randint(0, c, n).astype(np.int32)),
+    )
+
+
+def _assert_same(a: dict, b: dict) -> None:
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]), err_msg=k)
+
+
+def test_grouped_sync_shares_one_gather_per_group():
+    rng = np.random.RandomState(11)
+    preds, target = _data(rng)
+
+    grouped_gather, ungrouped_gather = _CountingGather(), _CountingGather()
+    grouped = _collection(grouped_gather)
+    ungrouped = _collection(ungrouped_gather, compute_groups=False)
+    grouped(preds, target)
+    ungrouped(preds, target)
+
+    _assert_same(grouped.compute(), ungrouped.compute())
+
+    # ungrouped: every member gathers its own states — Accuracy (2 leaves) +
+    # 3 x StatScores (4 leaves) = 14 gather calls. Grouped: Accuracy alone
+    # (singleton group, 2) + ONE gather plane for the F1/Precision/Recall
+    # group (4) = 6 — the same 6-vs-14 dedup the pure plane reports.
+    assert ungrouped_gather.calls == 14
+    assert grouped_gather.calls == 6
+
+
+def test_grouped_sync_savings_visible_in_counters():
+    rng = np.random.RandomState(12)
+    preds, target = _data(rng)
+    gather = _CountingGather()
+    mc = _collection(gather)
+    mc(preds, target)
+
+    obs.enable()
+    obs.reset()
+    mc.compute()
+    snap = obs.counters_snapshot()
+    obs.disable()
+    # one shared plane (4 StatScores leaves) + Accuracy's own (2 leaves)
+    assert snap["states_synced"] == 6
+
+
+def test_member_updated_outside_collection_syncs_alone():
+    rng = np.random.RandomState(13)
+    preds, target = _data(rng)
+    preds2, target2 = _data(rng)
+
+    grouped_gather, ungrouped_gather = _CountingGather(), _CountingGather()
+    grouped = _collection(grouped_gather)
+    ungrouped = _collection(ungrouped_gather, compute_groups=False)
+    grouped(preds, target)
+    ungrouped(preds, target)
+    # out-of-collection write: Recall leaves lockstep with its group
+    grouped["Recall"].update(preds2, target2)
+    ungrouped["Recall"].update(preds2, target2)
+
+    _assert_same(grouped.compute(), ungrouped.compute())
+    assert ungrouped_gather.calls == 14
+    # Accuracy (2) + shared F1/Precision plane (4) + diverged Recall alone (4)
+    assert grouped_gather.calls == 10
+
+
+def test_collection_reset_restores_lockstep():
+    rng = np.random.RandomState(14)
+    preds, target = _data(rng)
+    gather = _CountingGather()
+    mc = _collection(gather)
+    mc(preds, target)
+    mc["Recall"].update(preds, target)  # diverge
+    mc.compute()
+    diverged_calls = gather.calls
+
+    mc.reset()
+    mc(preds, target)
+    gather.calls = 0
+    ungrouped = _collection(_CountingGather(), compute_groups=False)
+    ungrouped(preds, target)
+    _assert_same(mc.compute(), ungrouped.compute())
+    assert gather.calls == 6  # full sharing again after reset
+    assert diverged_calls == 10
+
+
+def test_second_compute_hits_member_caches():
+    rng = np.random.RandomState(15)
+    preds, target = _data(rng)
+    gather = _CountingGather()
+    mc = _collection(gather)
+    mc(preds, target)
+    first = mc.compute()
+    calls_after_first = gather.calls
+    _assert_same(mc.compute(), first)  # cached: no further gathers
+    assert gather.calls == calls_after_first
+
+
+def test_grouped_sync_preserves_local_state():
+    """The shared sync must restore each member's LOCAL accumulator, exactly
+    like the individual synced-compute path (reference metric.py:208-239)."""
+    rng = np.random.RandomState(16)
+    preds, target = _data(rng)
+    gather = _CountingGather()
+    mc = _collection(gather)
+    mc(preds, target)
+    synced = mc.compute()
+
+    # keep accumulating after the synced compute: the local (unsynced) state
+    # must have survived, so a fresh single-"rank" collection fed the same
+    # batches twice each (the fake gather doubles the world) agrees
+    preds2, target2 = _data(rng)
+    mc(preds2, target2)
+    twice = _collection(_CountingGather())
+    twice(preds, target)
+    twice(preds2, target2)
+    _assert_same(mc.compute(), twice.compute())
+    assert synced is not None
+
+
+def test_escape_hatch_disables_sharing():
+    rng = np.random.RandomState(17)
+    preds, target = _data(rng)
+    gather = _CountingGather()
+    mc = _collection(gather, compute_groups=False)
+    mc(preds, target)
+    mc.compute()
+    assert gather.calls == 14
+
+
+def test_clone_starts_conservative_until_reset():
+    """Lockstep is identity-based, so a clone cannot inherit it: members with
+    accumulated state start diverged (correct, just unshared) and a
+    collection-level reset re-arms full sharing."""
+    rng = np.random.RandomState(18)
+    preds, target = _data(rng)
+    gather = _CountingGather()
+    mc = _collection(gather)
+    mc(preds, target)
+
+    clone = mc.clone()
+    # deepcopy copies the gather fn too (one shared copy across members)
+    clone_gather = clone["Accuracy"].dist_sync_fn
+    assert clone_gather is not gather
+    ref = _collection(_CountingGather(), compute_groups=False)
+    ref(preds, target)
+    _assert_same(clone.compute(), ref.compute())
+    assert clone_gather.calls == 14  # conservative: no sharing on the clone
+
+    clone.reset()
+    clone(preds, target)
+    clone_gather.calls = 0
+    _assert_same(clone.compute(), ref.compute())
+    assert clone_gather.calls == 6  # reset re-armed lockstep

@@ -1,0 +1,248 @@
+"""Observability subsystem: span tracer, collective counters, exports.
+
+The contract under test, in order of importance:
+
+1. Disabled (the default) is a structural no-op: ``span()`` hands back one
+   process-wide singleton (nothing allocated per call) and nothing is
+   recorded — the hot paths stay cold.
+2. Enabled, spans nest correctly across ``forward -> update -> sync`` with
+   parent/depth attribution per thread.
+3. The Chrome-trace export emits schema-valid ``trace_events`` (what
+   chrome://tracing and ui.perfetto.dev load), and the JSONL export
+   round-trips through ``json.loads`` line by line.
+4. The collective counters agree with ground truth: ``states_synced`` equals
+   the synced leaf count that bench --smoke reports (6 for the grouped bench
+   collection), and ``sync_bytes`` equals the byte size of those leaves.
+"""
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, F1, Metric, MetricCollection, Precision, Recall
+from metrics_tpu import observability as obs
+from metrics_tpu.observability import counters as obs_counters
+from metrics_tpu.observability import trace as obs_trace
+from metrics_tpu.utils import compat
+
+
+@pytest.fixture(autouse=True)
+def _clean_observability():
+    obs.disable()
+    obs.reset()
+    yield
+    obs.disable()
+    obs.reset()
+
+
+# the bench --smoke collection shape: Accuracy + one StatScores group
+def _bench_like_collection():
+    return MetricCollection([
+        Accuracy(),
+        F1(num_classes=4, average="macro"),
+        Precision(num_classes=4, average="macro"),
+        Recall(num_classes=4, average="macro"),
+    ])
+
+
+# ------------------------------------------------------------ disabled path
+def test_disabled_span_is_a_shared_singleton():
+    # the zero-allocation contract: no per-call object while disabled
+    assert obs.span("a") is obs.span("b")
+    assert obs.span("a") is obs_trace._NULL_SPAN
+
+
+def test_disabled_records_nothing():
+    with obs.span("not-recorded"):
+        pass
+
+    @obs.traced("also-not-recorded")
+    def fn():
+        return 1
+
+    assert fn() == 1
+    assert obs.records() == []
+
+    m = Accuracy()
+    m(jnp.array([1, 0]), jnp.array([1, 1]))
+    m.compute()
+    assert obs.records() == []
+    assert obs.counters_snapshot()["collective_calls"] == 0
+
+
+def test_disabled_counters_record_nothing():
+    obs_counters.record_collective("psum", jnp.zeros((4,)))
+    obs_counters.record_states_synced(3)
+    obs_counters.record_cache("step", True)
+    snap = obs.counters_snapshot()
+    assert snap["collective_calls"] == 0
+    assert snap["states_synced"] == 0
+    assert snap["step_cache"] == {"hits": 0, "misses": 0}
+
+
+# ------------------------------------------------------------- enabled path
+def test_spans_nest_with_parent_and_depth():
+    obs.enable()
+    with obs.span("outer"):
+        with obs.span("inner", {"k": "v"}):
+            pass
+    recs = obs.records()
+    assert [r.name for r in recs] == ["outer", "inner"]  # start order
+    outer, inner = recs
+    assert inner.parent == "outer" and inner.depth == 1 and inner.attrs == {"k": "v"}
+    assert outer.parent is None and outer.depth == 0
+    assert inner.start_ns >= outer.start_ns and inner.end_ns <= outer.end_ns
+
+
+class _UnfusableMetric(Metric):
+    """Non-associative callable reduction -> the reference double-update
+    forward path, whose wrapped ``update`` runs INSIDE ``forward``."""
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx=lambda s: s[-1])
+
+    def update(self, x):
+        self.total = self.total + jnp.sum(x)
+
+    def compute(self):
+        return self.total
+
+
+def test_forward_update_sync_span_nesting():
+    calls = []
+
+    def fake_gather(x):
+        calls.append(x)
+        return [x, x]
+
+    obs.enable()
+    m = _UnfusableMetric()
+    m.dist_sync_fn = fake_gather
+    m.dist_sync_on_step = True
+    m(jnp.arange(3.0))
+
+    by_name = {r.name: r for r in obs.records()}
+    assert by_name["metric.forward"].depth == 0
+    assert by_name["metric.update"].parent == "metric.forward"
+    assert by_name["metric.compute"].parent == "metric.forward"
+    # the host-plane sync ran inside the in-forward compute
+    assert by_name["metric.sync_state"].parent == "metric.compute"
+    assert calls, "fake gather never invoked"
+    assert by_name["metric.forward"].attrs == {"metric": "_UnfusableMetric"}
+
+
+def test_traced_decorator_records_under_qualname():
+    obs.enable()
+
+    @obs.traced()
+    def my_phase():
+        return 7
+
+    assert my_phase() == 7
+    (rec,) = obs.records()
+    assert "my_phase" in rec.name
+
+
+# ----------------------------------------------------------------- exports
+def test_chrome_trace_events_schema():
+    obs.enable()
+    with obs.span("phase.a"):
+        with obs.span("phase.b"):
+            pass
+    doc = obs.chrome_trace()
+    assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
+    for event in doc["traceEvents"]:
+        assert isinstance(event["name"], str)
+        assert event["ph"] in ("X", "M")
+        assert isinstance(event["pid"], int) and isinstance(event["tid"], int)
+        if event["ph"] == "X":  # complete events: microsecond ts + dur
+            assert isinstance(event["ts"], (int, float))
+            assert isinstance(event["dur"], (int, float)) and event["dur"] >= 0
+        else:  # metadata events carry args only
+            assert "args" in event
+    # counters ride along for the Perfetto metadata pane
+    assert "collective_calls" in doc["otherData"]
+    json.dumps(doc)  # must be JSON-serializable as-is
+
+
+def test_write_chrome_trace_and_jsonl(tmp_path):
+    obs.enable()
+    with obs.span("phase.a"):
+        pass
+    trace_file = tmp_path / "trace.json"
+    jsonl_file = tmp_path / "spans.jsonl"
+    obs.write_chrome_trace(str(trace_file))
+    obs.write_jsonl(str(jsonl_file))
+
+    doc = json.loads(trace_file.read_text())
+    assert any(e.get("name") == "phase.a" for e in doc["traceEvents"])
+
+    lines = [json.loads(line) for line in jsonl_file.read_text().splitlines()]
+    kinds = {line["type"] for line in lines}
+    assert kinds == {"span", "summary", "counters"}
+    summary = [l for l in lines if l["type"] == "summary" and l["name"] == "phase.a"]
+    assert summary and summary[0]["count"] == 1
+
+
+def test_summarize_aggregates_by_name():
+    obs.enable()
+    for _ in range(3):
+        with obs.span("repeated"):
+            pass
+    table = obs.summarize()
+    row = table["repeated"]
+    assert row["count"] == 3
+    assert row["min_ms"] <= row["mean_ms"] <= row["max_ms"]
+    assert row["total_ms"] == pytest.approx(row["mean_ms"] * 3)
+
+
+# ---------------------------------------------------------------- counters
+def test_counters_match_bench_smoke_states_synced():
+    """The traced grouped sync program must account exactly the 6 state
+    leaves bench --smoke reports as ``states_synced``, with ``sync_bytes``
+    equal to their byte size (all leaves ride the coalesced sum plane)."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    obs.enable()
+    pure = _bench_like_collection().pure()
+    obs.reset()  # drop group-cache traffic from construction
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
+
+    def step(p, t):
+        delta = pure.update(pure.init(), p, t)
+        return pure.compute(pure.sync(delta, "dp"))
+
+    fn = jax.jit(compat.shard_map(step, mesh, in_specs=(P("dp"), P("dp")), out_specs=P()))
+    rng = np.random.RandomState(3)
+    logits = rng.rand(16, 4).astype(np.float32)
+    fn(jnp.asarray(logits / logits.sum(-1, keepdims=True)),
+       jnp.asarray(rng.randint(0, 4, 16).astype(np.int32)))
+
+    snap = obs.counters_snapshot()
+    leaves = jax.tree_util.tree_leaves(pure.init())
+    assert snap["states_synced"] == len(leaves) == 6
+    assert snap["sync_bytes"] == sum(l.size * l.dtype.itemsize for l in leaves)
+    assert snap["collective_calls"] >= 1
+    assert sum(snap["calls_by_kind"].values()) == snap["collective_calls"]
+    # coalescing: far fewer collectives than synced leaves
+    assert snap["collective_calls"] < len(leaves)
+
+
+def test_counters_bucket_by_dtype():
+    obs.enable()
+    obs.COUNTERS.record_collective("psum", jnp.zeros((8,), jnp.float32))
+    obs.COUNTERS.record_collective("psum", jnp.zeros((2,), jnp.int32))
+    snap = obs.counters_snapshot()
+    assert snap["bytes_by_kind_dtype"] == {"psum:float32": 32, "psum:int32": 8}
+    assert snap["collective_calls"] == 2 and snap["sync_bytes"] == 40
+
+
+def test_counters_snapshot_reset():
+    obs.enable()
+    obs.COUNTERS.record_collective("psum", jnp.zeros((2,)))
+    assert obs.counters_snapshot(reset_after=True)["collective_calls"] == 1
+    assert obs.counters_snapshot()["collective_calls"] == 0

@@ -8,6 +8,7 @@ from sklearn.metrics import average_precision_score, roc_auc_score
 
 from metrics_tpu import BinnedAUROC, BinnedAveragePrecision, BinnedPrecisionRecallCurve, BinnedROC
 from metrics_tpu.functional import binned_auroc, binned_average_precision
+from metrics_tpu.utils import compat
 
 _rng = np.random.RandomState(1234)
 N = 2048
@@ -69,7 +70,7 @@ def test_binned_sync_over_mesh(eight_devices):
         state = pure.sync(state, "dp")
         return pure.compute(state)
 
-    f = jax.shard_map(shard_fn, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P())
+    f = compat.shard_map(shard_fn, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P())
     sharded = float(f(jnp.asarray(_preds), jnp.asarray(_target)))
     single = float(binned_auroc(jnp.asarray(_preds), jnp.asarray(_target), thresholds=128))
     np.testing.assert_allclose(sharded, single, atol=1e-5)

@@ -5,6 +5,7 @@ import pytest
 
 from metrics_tpu import ExactMatch
 from metrics_tpu.functional import exact_match
+from metrics_tpu.utils import compat
 
 _rng = np.random.RandomState(17)
 
@@ -85,7 +86,7 @@ def test_exact_match_ddp_sum_states(ddp, eight_devices):
         state = pure.sync(state, "dp")
         return pure.compute(state)
 
-    fn = jax.jit(jax.shard_map(shard_fn, mesh=mesh,
+    fn = jax.jit(compat.shard_map(shard_fn, mesh=mesh,
                                in_specs=(P("dp"), P("dp")), out_specs=P()))
     got = float(fn(jnp.asarray(p), jnp.asarray(t)))
     # sample = leading index: every one of its (4, 3) positions must agree

@@ -4,7 +4,10 @@
 steps with no subprocess reference — cheap enough for tier-1 — and this test
 pins the schema of the printed line so the bench path cannot silently rot
 between BENCH_r* rounds (a broken bench would otherwise only surface at the
-next manual round).
+next manual round). The ``--trace`` variant additionally pins the
+observability fields (``collective_calls`` / ``sync_bytes`` from the
+collective counters) and that the emitted Chrome-trace file is valid JSON in
+the ``trace_events`` shape Perfetto loads.
 """
 import json
 import os
@@ -14,18 +17,19 @@ import sys
 _BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "bench.py")
 
 
-def test_bench_smoke_json_schema():
+def _run_smoke(extra_args=()):
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.run(
-        [sys.executable, _BENCH, "--smoke"],
+        [sys.executable, _BENCH, "--smoke", *extra_args],
         capture_output=True, text=True, timeout=280, env=env,
         cwd=os.path.dirname(_BENCH),
     )
     assert proc.returncode == 0, f"--smoke failed:\n{proc.stderr[-3000:]}"
-    line = proc.stdout.strip().splitlines()[-1]
-    out = json.loads(line)
+    return json.loads(proc.stdout.strip().splitlines()[-1])
 
+
+def _assert_headline_schema(out):
     # schema of record: BENCH_r* and the acceptance gate read these keys
     assert isinstance(out["metric"], str) and "MetricCollection" in out["metric"]
     assert out["unit"] == "ms/step"
@@ -41,3 +45,41 @@ def test_bench_smoke_json_schema():
     assert out["states_synced"] < out["states_synced_ungrouped"]
     assert out["states_synced"] == 6
     assert out["states_synced_ungrouped"] == 14
+
+
+def test_bench_smoke_json_schema():
+    out = _run_smoke()
+    _assert_headline_schema(out)
+    # without --trace the observability fields stay absent (off by default)
+    assert "collective_calls" not in out and "sync_bytes" not in out
+
+
+def test_bench_smoke_trace_json_schema(tmp_path):
+    trace_file = tmp_path / "bench_trace.json"
+    out = _run_smoke(("--trace", str(trace_file)))
+    _assert_headline_schema(out)
+
+    # collective accounting of the grouped step program: the 6 deduped sum
+    # leaves coalesce into ONE bucketed psum; bytes shrink vs ungrouped
+    assert isinstance(out["collective_calls"], int) and out["collective_calls"] >= 1
+    assert out["collective_calls"] <= out["states_synced"]
+    assert isinstance(out["sync_bytes"], int) and out["sync_bytes"] > 0
+    assert out["sync_bytes"] < out["sync_bytes_ungrouped"]
+    # counter totals must agree with the states_synced the bench reports
+    assert out["counters"]["states_synced"] == out["states_synced"]
+    assert out["counters"]["collective_calls"] == out["collective_calls"]
+
+    # per-phase ms come from the span aggregates, not ad-hoc timers
+    assert any(name.startswith("bench.compile") for name in out["phase_ms"])
+    assert all(ms >= 0 for ms in out["phase_ms"].values())
+
+    # the trace file is valid Chrome-trace JSON (Perfetto-loadable)
+    doc = json.loads(trace_file.read_text())
+    events = doc["traceEvents"]
+    assert isinstance(events, list) and events
+    complete = [e for e in events if e.get("ph") == "X"]
+    assert complete and all(
+        isinstance(e["name"], str) and e["dur"] >= 0 and "ts" in e for e in complete
+    )
+    assert {e["name"] for e in complete} >= {"bench.compile_grouped", "bench.timed_grouped"}
+    assert doc["otherData"]["collective_calls"] == out["collective_calls"]

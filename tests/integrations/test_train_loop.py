@@ -11,6 +11,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from metrics_tpu import Accuracy, MetricCollection, Precision
+from metrics_tpu.utils import compat
 
 
 def _make_data(n=256, d=16, c=4, seed=0):
@@ -72,7 +73,7 @@ def test_metric_collection_in_sharded_eval(eight_devices):
         state = pure.sync(state, "dp")
         return pure.compute(state)
 
-    sharded = jax.jit(jax.shard_map(eval_step, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P()))
+    sharded = jax.jit(compat.shard_map(eval_step, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P()))
     out_sharded = sharded(x, y)
 
     probs = jax.nn.softmax(x @ w)

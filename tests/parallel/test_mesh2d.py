@@ -23,6 +23,7 @@ from sklearn.metrics import precision_score as sk_precision_score
 
 from metrics_tpu import Accuracy, ConfusionMatrix, F1, MetricCollection, Precision
 from metrics_tpu.parallel import batch_sharded, class_sharded
+from metrics_tpu.utils import compat
 
 NUM_CLASSES = 8
 
@@ -111,7 +112,7 @@ def test_pure_step_2d_mesh_shard_map(mesh2d):
 
     state_spec = {k: P("mp") for k in pure.init()}
     sharded_step = jax.jit(
-        jax.shard_map(
+        compat.shard_map(
             step,
             mesh=mesh2d,
             in_specs=(P("dp"), P("dp")),

@@ -11,6 +11,18 @@ import os
 import subprocess
 import sys
 
+import jax
+import pytest
+
+# jax 0.4.x cannot run cross-process collectives on the CPU backend
+# ("Multiprocess computations aren't implemented on the CPU backend"); the
+# shard_map compat shim recovers everything else on old jax, but these two
+# tests need a jax whose CPU client speaks the distributed protocol.
+pytestmark = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="multiprocess CPU collectives unsupported on this jax",
+)
+
 _WORKER = r"""
 import json
 import os
@@ -203,6 +215,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from metrics_tpu import AUROC
 from metrics_tpu.parallel import row_sharded, sharded_auroc
+from metrics_tpu.utils import compat
 
 # a GLOBAL mesh: 8 devices spanning both processes (4 local each). The ring's
 # ppermute hops cross the process boundary — the DCN plane of a real pod.
@@ -218,7 +231,7 @@ sharding = NamedSharding(mesh, P("dp"))
 half = N // 2
 arr_s = jax.make_array_from_process_local_data(sharding, scores[rank * half:(rank + 1) * half], (N,))
 arr_l = jax.make_array_from_process_local_data(sharding, labels[rank * half:(rank + 1) * half], (N,))
-ring = jax.jit(jax.shard_map(
+ring = jax.jit(compat.shard_map(
     lambda s, t: sharded_auroc(s, t, "dp"), mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P()
 ))
 ring_auroc = float(ring(arr_s, arr_l))

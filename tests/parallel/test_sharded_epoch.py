@@ -21,6 +21,7 @@ from metrics_tpu.parallel import (
     sharded_average_precision_matrix,
     sharded_retrieval_sums,
 )
+from metrics_tpu.utils import compat
 
 N = 1024  # global epoch rows; 128 per device
 
@@ -34,7 +35,7 @@ def _shard_map(mesh, fn, n_in, out_specs=P()):
     # check_vma deliberately LEFT ON (the default): the ring/regroup
     # collectives satisfy JAX's varying-manual-axes verification
     return jax.jit(
-        jax.shard_map(fn, mesh=mesh, in_specs=(P("dp"),) * n_in, out_specs=out_specs)
+        compat.shard_map(fn, mesh=mesh, in_specs=(P("dp"),) * n_in, out_specs=out_specs)
     )
 
 

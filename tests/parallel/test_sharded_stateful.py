@@ -30,6 +30,7 @@ from metrics_tpu import (
 )
 from metrics_tpu.parallel import row_sharded
 from metrics_tpu.retrieval import RetrievalMAP, RetrievalMRR
+from metrics_tpu.utils import compat
 
 
 @pytest.fixture()
@@ -354,7 +355,7 @@ def test_sharded_rank_engine(mesh):
     w = np.ones(512, np.float32)
     w[448:] = 0.0  # ghost tail
 
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(compat.shard_map(
         lambda a, b: sharded_rank(a, "dp", b),
         mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P("dp"),
     ))

@@ -16,6 +16,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from metrics_tpu import Metric
 from metrics_tpu.parallel import PaddedBuffer, buffer_all_gather, buffer_append, buffer_init, buffer_merge
 from metrics_tpu.parallel.buffer import buffer_values
+from metrics_tpu.utils import compat
 from tests.helpers.testers import BarrierGather, DummyListMetric, DummyMetricSum, _run_in_threads
 
 
@@ -152,7 +153,7 @@ def test_sync_sum_shard_map(eight_devices):
         state = pure.sync(state, "dp")
         return pure.compute(state)
 
-    f = jax.shard_map(fn, mesh=mesh, in_specs=(P("dp"),), out_specs=P())
+    f = compat.shard_map(fn, mesh=mesh, in_specs=(P("dp"),), out_specs=P())
     out = f(jnp.arange(8, dtype=jnp.float32))
     assert float(out) == sum(range(8))
 
@@ -185,7 +186,7 @@ def test_buffer_all_gather_shard_map(eight_devices):
 
     # all_gather-derived outputs are replicated but the vma checker cannot
     # statically infer it through the compaction scatter
-    f = jax.shard_map(fn, mesh=mesh, in_specs=(P("dp"),), out_specs=(P(), P()), check_vma=False)
+    f = compat.shard_map(fn, mesh=mesh, in_specs=(P("dp"),), out_specs=(P(), P()), check_vma=False)
     data, count = f(jnp.arange(8, dtype=jnp.float32))
     assert int(count) == 8
     assert sorted(np.asarray(data[:8]).tolist()) == list(range(8))

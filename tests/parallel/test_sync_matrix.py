@@ -17,6 +17,7 @@ from sklearn.metrics import roc_auc_score as sk_roc_auc_score
 
 from metrics_tpu import ConfusionMatrix, Metric, MetricCollection, Precision, PSNR
 from metrics_tpu.functional.regression.psnr import psnr as functional_psnr
+from metrics_tpu.utils import compat
 
 
 class _EveryReduction(Metric):
@@ -53,7 +54,7 @@ def test_sync_value_all_reductions_shard_map(eight_devices):
 
     # all_gather outputs are replicated, but the static vma checker cannot
     # infer that for the None-reduction stacked state
-    f = jax.shard_map(fn, mesh=mesh, in_specs=(P("dp"),), out_specs=P(), check_vma=False)
+    f = compat.shard_map(fn, mesh=mesh, in_specs=(P("dp"),), out_specs=P(), check_vma=False)
     s, m, mn, mx, stacked = f(jnp.arange(8, dtype=jnp.float32))
     assert float(s) == 28.0  # psum
     assert float(m) == 3.5  # pmean
@@ -88,7 +89,7 @@ def test_sync_callable_reduction_shard_map(eight_devices):
         state = pure.sync(state, "dp")
         return pure.compute(state)
 
-    f = jax.shard_map(fn, mesh=mesh, in_specs=(P("dp"),), out_specs=P(), check_vma=False)
+    f = compat.shard_map(fn, mesh=mesh, in_specs=(P("dp"),), out_specs=P(), check_vma=False)
     out = f(jnp.arange(8, dtype=jnp.float32))
     assert float(out) == 7.0  # max - min over ranks
 
@@ -109,7 +110,7 @@ def test_psnr_data_range_none_sharded(eight_devices):
         state = pure.sync(state, "dp")
         return pure.compute(state)
 
-    f = jax.shard_map(fn, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P())
+    f = compat.shard_map(fn, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P())
     sharded = f(jnp.asarray(preds_np), jnp.asarray(target_np))
 
     # the min/max states initialize at 0 (reference parity), so the inferred
@@ -126,7 +127,7 @@ def test_psnr_data_range_none_sharded(eight_devices):
         state = pure.update(pure.init(), p, t)
         return pure.sync(state, "dp")
 
-    state = jax.shard_map(
+    state = compat.shard_map(
         synced_state, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P()
     )(jnp.asarray(preds_np), jnp.asarray(target_np))
     # states initialize at 0, so the tracked extrema are clamped through 0
@@ -165,7 +166,7 @@ def test_curve_metric_capacity_gather_shard_map(eight_devices):
         state = pure.sync(state, "dp")  # PaddedBuffer -> buffer_all_gather
         return state["preds"].data, state["preds"].count, state["tgt"].data, state["tgt"].count
 
-    f = jax.shard_map(
+    f = compat.shard_map(
         fn, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=(P(), P(), P(), P()),
         check_vma=False,  # gather+compaction defeats static replication inference
     )
