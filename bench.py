@@ -23,9 +23,18 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...} where
 value is our 8-device sync-in-the-loop ms/step and vs_baseline =
 reference_ms / our_ms (>1 means we are faster than the reference). The line
 also carries the compute-groups A/B ("grouped_sync8_ms" vs
-"ungrouped_sync8_ms", with "states_synced" counts) so BENCH_r* tracks the
-group/coalescing gain. ``--smoke`` runs a 2-step, no-reference version with
-the same headline schema for CI (tests/integrations/test_bench_smoke.py).
+"ungrouped_sync8_ms", with "states_synced" counts) and the gather-plane A/B
+("gather_coalesced_ms" vs "gather_per_leaf_ms": bucketed vs per-leaf
+``all_gather`` sync of a buffer-state AUROC+AveragePrecision+Spearman
+collection) so BENCH_r* tracks the group/coalescing gains. ``--smoke`` runs
+a 2-step, no-reference version with the same headline schema for CI
+(tests/integrations/test_bench_smoke.py).
+
+``--check-collectives`` is the collective regression gate: it traces each
+scenario's step program and compares the staged ``collective_calls`` /
+``sync_bytes`` against the pinned ``EXPECTED_COLLECTIVES``, exiting
+non-zero on growth (the smoke test runs it in tier-1, so a silently added
+collective fails CI even when ms noise hides it).
 
 ``--trace OUT.json`` (composable with ``--smoke``) enables the observability
 subsystem around the A/B: the JSON line grows ``collective_calls`` /
@@ -59,6 +68,9 @@ NUM_CLASSES = 32
 FEATURES = 256
 
 
+GATHER_CAPACITY = 2048  # per-device rows of each buffer (cat) state
+
+
 def _collection_ours(compute_groups: bool = True):
     from metrics_tpu import Accuracy, F1, MetricCollection, Precision, Recall
 
@@ -68,6 +80,18 @@ def _collection_ours(compute_groups: bool = True):
         Precision(num_classes=NUM_CLASSES, average="macro"),
         Recall(num_classes=NUM_CLASSES, average="macro"),
     ], compute_groups=compute_groups)
+
+
+def _collection_gather():
+    """The gather-plane collection: buffer-state (cat) metrics whose sync is
+    ``all_gather`` of PaddedBuffer epochs, not ``psum`` of reduce states."""
+    from metrics_tpu import AUROC, AveragePrecision, MetricCollection, SpearmanCorrcoef
+
+    return MetricCollection([
+        AUROC(capacity=GATHER_CAPACITY),
+        AveragePrecision(num_classes=1, capacity=GATHER_CAPACITY),
+        SpearmanCorrcoef(capacity=GATHER_CAPACITY),
+    ])
 
 
 def _shard_map(fn, mesh, in_specs, out_specs):
@@ -132,6 +156,61 @@ def bench_ours_sync8(compute_groups: bool = True, steps: int = N_STEPS, warmup: 
     return run(steps), states_synced
 
 
+def _build_gather_runner(coalesced: bool):
+    """(timed_run(steps) -> ms/step, states_synced) for one gather-plane
+    variant: 6 half-filled PaddedBuffer epoch states (AUROC + AP +
+    Spearman) synced over the 8-device mesh per step, with the bucketed
+    (``coalesced_sync_state``: one data + one counts ``all_gather`` per
+    dtype bucket) vs the per-leaf plane (2 ``all_gather`` per buffer).
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from metrics_tpu.parallel.sync import coalesced_sync_state, sync_state
+    from metrics_tpu.utils.compat import shard_map
+
+    col = _collection_gather()
+    rng = np.random.RandomState(0)
+    rows = GATHER_CAPACITY // 2  # half-filled: the sync moves capacity either way
+    preds = jnp.asarray(rng.rand(rows).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, rows).astype(np.int32))
+    col.update(preds, target)  # one eager update promotes every cat state to a buffer
+
+    state = {(k, n): v for k, m in col.items() for n, v in m._current_state().items()}
+    reductions = {key: col[key[0]]._reductions[key[1]] for key in state}
+    mesh = Mesh(np.array(jax.devices("cpu")[:N_DEVICES]), ("dp",))
+    sync = coalesced_sync_state if coalesced else sync_state
+
+    def step(s, acc):
+        synced = sync(s, reductions, "dp")
+        # fold every synced leaf into the carried scalar: the carry chains
+        # step i+1 on step i, serializing the async dispatch — unchained,
+        # XLA:CPU enqueues many concurrent runs of the collective program
+        # and the 8-device rendezvous thread pool can deadlock
+        for leaf in jax.tree_util.tree_leaves(synced):
+            acc = acc + jnp.sum(leaf.astype(jnp.float32))
+        return acc
+
+    # vma checking off: gather+compaction outputs are replicated but the
+    # varying-axis checker cannot prove it through the compaction scatter
+    sharded_step = jax.jit(
+        shard_map(step, mesh=mesh, in_specs=(P(), P()), out_specs=P(), check_vma=False)
+    )
+
+    def run(steps: int) -> float:
+        acc = jnp.zeros((), jnp.float32)
+        start = time.perf_counter()
+        for _ in range(steps):
+            acc = sharded_step(state, acc)
+        jax.block_until_ready(acc)
+        return (time.perf_counter() - start) / steps * 1e3
+
+    return run, len(state)
+
+
 def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trace_path=None) -> dict:
     """Compute-groups on/off A/B over the same 8-device mesh program.
 
@@ -155,13 +234,13 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
         obs.enable()
         obs.reset()
 
-    def build(compute_groups: bool, label: str):
+    def build(builder, variant, label):
         if obs is None:
-            run, states = _build_sync8_runner(compute_groups)
+            run, states = builder(variant)
             run(warmup)
             return run, states, None
         with obs.span(f"bench.build_{label}"):
-            run, states = _build_sync8_runner(compute_groups)
+            run, states = builder(variant)
         obs.COUNTERS.reset()
         with obs.span(f"bench.compile_{label}"):
             run(1)  # first call traces+compiles: counters now hold the program's collectives
@@ -170,8 +249,8 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
             run(max(warmup - 1, 1))
         return run, states, counters
 
-    run_grouped, states_grouped, grouped_counters = build(True, "grouped")
-    run_ungrouped, states_ungrouped, ungrouped_counters = build(False, "ungrouped")
+    run_grouped, states_grouped, grouped_counters = build(_build_sync8_runner, True, "grouped")
+    run_ungrouped, states_ungrouped, ungrouped_counters = build(_build_sync8_runner, False, "ungrouped")
     grouped_times, ungrouped_times = [], []
     for _ in range(repeats):
         with (obs.span("bench.timed_grouped") if obs else _null_cm()):
@@ -180,23 +259,45 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
             ungrouped_times.append(run_ungrouped(steps))
     grouped_ms = min(grouped_times)
     ungrouped_ms = min(ungrouped_times)
+
+    # gather-plane A/B: same interleaved best-of protocol over the
+    # buffer-state collection (coalesced bucketed all_gather vs per-leaf)
+    run_coal, states_gather, coal_counters = build(_build_gather_runner, True, "gather_coalesced")
+    run_leaf, _, leaf_counters = build(_build_gather_runner, False, "gather_per_leaf")
+    coal_times, leaf_times = [], []
+    for _ in range(repeats):
+        with (obs.span("bench.timed_gather_coalesced") if obs else _null_cm()):
+            coal_times.append(run_coal(steps))
+        with (obs.span("bench.timed_gather_per_leaf") if obs else _null_cm()):
+            leaf_times.append(run_leaf(steps))
+
     out = {
         "grouped_sync8_ms": grouped_ms,
         "ungrouped_sync8_ms": ungrouped_ms,
         "states_synced": states_grouped,
         "states_synced_ungrouped": states_ungrouped,
+        "gather_coalesced_ms": min(coal_times),
+        "gather_per_leaf_ms": min(leaf_times),
+        "gather_states_synced": states_gather,
     }
     if obs is not None:
         out["collective_calls"] = grouped_counters["collective_calls"]
         out["sync_bytes"] = grouped_counters["sync_bytes"]
         out["collective_calls_ungrouped"] = ungrouped_counters["collective_calls"]
         out["sync_bytes_ungrouped"] = ungrouped_counters["sync_bytes"]
+        out["gather_collective_calls"] = coal_counters["collective_calls"]
+        out["gather_sync_bytes"] = coal_counters["sync_bytes"]
+        out["gather_collective_calls_per_leaf"] = leaf_counters["collective_calls"]
+        out["gather_sync_bytes_per_leaf"] = leaf_counters["sync_bytes"]
         out["counters"] = grouped_counters
+        out["gather_counters"] = coal_counters
         out["phase_ms"] = {
             name: round(row["total_ms"], 3) for name, row in sorted(obs.summarize().items())
         }
         out["trace_file"] = trace_path
-        obs.write_chrome_trace(trace_path)
+        # otherData pins the headline (grouped sum-plane) program's counters,
+        # not whichever variant's compile reset the live counters last
+        obs.write_chrome_trace(trace_path, counters=grouped_counters)
         obs.disable()
     return out
 
@@ -402,14 +503,92 @@ _TRACE_KEYS = (
     "sync_bytes",
     "collective_calls_ungrouped",
     "sync_bytes_ungrouped",
+    "gather_collective_calls",
+    "gather_sync_bytes",
+    "gather_collective_calls_per_leaf",
+    "gather_sync_bytes_per_leaf",
     "counters",
+    "gather_counters",
     "phase_ms",
     "trace_file",
 )
 
 
+# ---------------------------------------------------- collective regression gate
+# Pinned per-scenario expectations for --check-collectives. The counters are
+# per compiled step program (staged collectives — exact, replayed every
+# step), so these are deterministic, not noisy ms numbers. GROWTH in either
+# number fails the gate; a shrink is an improvement — re-pin it deliberately.
+#
+# sum plane (Accuracy+F1+Precision+Recall, NUM_CLASSES=32): the grouped
+#   program psums one 520-byte int32 bucket (2 Accuracy scalars + 4 (C,)
+#   stat vectors); ungrouped still coalesces into one bucket but moves every
+#   member's copy (14 leaves, 1544 bytes).
+# gather plane (AUROC+AP+Spearman, capacity 2048): coalesced stages one
+#   data + one counts all_gather per dtype bucket (f32 + i32 -> 4 calls);
+#   per-leaf stages 2 per buffer (12). Bytes match: same payload, fewer
+#   round-trips.
+EXPECTED_COLLECTIVES = {
+    "sum_grouped": {"collective_calls": 1, "sync_bytes": 520},
+    "sum_ungrouped": {"collective_calls": 1, "sync_bytes": 1544},
+    "gather_coalesced": {"collective_calls": 4, "sync_bytes": 49176},
+    "gather_per_leaf": {"collective_calls": 12, "sync_bytes": 49176},
+}
+
+
+def check_collectives() -> int:
+    """``--check-collectives``: trace each scenario's step program and diff
+    its staged ``collective_calls``/``sync_bytes`` against the pinned
+    expectations. Returns a non-zero exit status on any growth — the CI gate
+    that catches silent collective-count regressions the ms numbers hide in
+    noise. Prints one JSON report line either way.
+    """
+    from metrics_tpu import observability as obs
+
+    builders = {
+        "sum_grouped": lambda: _build_sync8_runner(True),
+        "sum_ungrouped": lambda: _build_sync8_runner(False),
+        "gather_coalesced": lambda: _build_gather_runner(True),
+        "gather_per_leaf": lambda: _build_gather_runner(False),
+    }
+    obs.enable()
+    report, failures = {}, []
+    for name, build in builders.items():
+        run, _ = build()
+        obs.COUNTERS.reset()
+        run(1)  # first call traces+compiles: counters now hold the staged program
+        snap = obs.counters_snapshot()
+        got = {"collective_calls": snap["collective_calls"], "sync_bytes": snap["sync_bytes"]}
+        expected = EXPECTED_COLLECTIVES[name]
+        status = "ok"
+        for key, pinned in expected.items():
+            if got[key] > pinned:
+                status = "regression"
+                failures.append(f"{name}.{key}: {got[key]} > pinned {pinned}")
+            elif got[key] < pinned and status == "ok":
+                status = "improved (re-pin EXPECTED_COLLECTIVES)"
+        report[name] = {**got, "expected": expected, "status": status}
+    obs.disable()
+    print(json.dumps({
+        "check": "collectives",
+        "ok": not failures,
+        "failures": failures,
+        "scenarios": report,
+    }))
+    return 1 if failures else 0
+
+
 def main() -> None:
     trace_path = _trace_arg(sys.argv)
+    if len(sys.argv) > 1 and sys.argv[1] == "--check-collectives":
+        # collective regression gate: jax is not yet imported, so the
+        # virtual-device flag can be set in-process (same as --smoke)
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={N_DEVICES}"
+        ).strip()
+        raise SystemExit(check_collectives())
+
     if len(sys.argv) > 1 and sys.argv[1] == "--sync8":
         # child process: CPU platform must be forced before backend init
         os.environ["XLA_FLAGS"] = (
@@ -437,6 +616,9 @@ def main() -> None:
             "ungrouped_sync8_ms": round(ab["ungrouped_sync8_ms"], 4),
             "states_synced": ab["states_synced"],
             "states_synced_ungrouped": ab["states_synced_ungrouped"],
+            "gather_coalesced_ms": round(ab["gather_coalesced_ms"], 4),
+            "gather_per_leaf_ms": round(ab["gather_per_leaf_ms"], 4),
+            "gather_states_synced": ab["gather_states_synced"],
             "smoke": True,
         }
         out.update({k: ab[k] for k in _TRACE_KEYS if k in ab})
@@ -489,6 +671,9 @@ def main() -> None:
         "ungrouped_sync8_ms": round(ab["ungrouped_sync8_ms"], 4),
         "states_synced": ab["states_synced"],
         "states_synced_ungrouped": ab["states_synced_ungrouped"],
+        "gather_coalesced_ms": round(ab["gather_coalesced_ms"], 4),
+        "gather_per_leaf_ms": round(ab["gather_per_leaf_ms"], 4),
+        "gather_states_synced": ab["gather_states_synced"],
         "singlechip_fused_update_ms": round(ours_fused_ms, 4),
         "singlechip_reference_eager_update_ms": round(ref_eager_ms, 4),
         "singlechip_vs_reference": round(fused_vs_ref, 3),

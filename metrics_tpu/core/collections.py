@@ -181,13 +181,103 @@ class MetricCollection(OrderedDict):
         sync is off, the whole collection runs as ONE jitted program —
         every update, accumulator merge, and batch value in a single
         dispatch (the reference pays N forwards; a naive port would pay N
-        dispatches)."""
+        dispatches). When the fused step is unavailable (dist_sync_on_step,
+        unfingerprintable members, tracer failures), compute groups still
+        share ONE update delta per group on the eager per-member path."""
         self._lockstep_check()
         fused = self._forward_fused_collection(*args, **kwargs)
         if fused is None:
-            fused = {self._set_prefix(k): m(*args, **m._filter_kwargs(**kwargs)) for k, m in self.items()}
+            fused = self._forward_eager_grouped(*args, **kwargs)
         self._lockstep_record()
         return fused
+
+    def _eager_shared_groups(self) -> Dict[str, str]:
+        """member name -> representative, for groups that can share an eager
+        update delta: >= 2 members and delta-mergeable states (``_fusable``).
+        Singleton groups and non-mergeable members keep their own path."""
+        gm = self._group_map()
+        sizes: Dict[str, int] = {}
+        for rep in gm.values():
+            sizes[rep] = sizes.get(rep, 0) + 1
+        return {k: rep for k, rep in gm.items() if sizes[rep] > 1 and self[rep]._fusable}
+
+    def _group_delta(self, rep: str, args: tuple, kwargs: dict, use_jit: bool):
+        """ONE batch delta for a compute group, from the representative.
+
+        The jitted per-metric step is reused when available (it returns the
+        rep's merged accumulator alongside the delta, so the rep pays one
+        dispatch exactly as its own ``forward`` would); tracer failures fall
+        back to the eager pure update, permanently for that member. Returns
+        ``(delta, rep_merged_state_or_None)``.
+        """
+        rm = self[rep]
+        kw = rm._filter_kwargs(**kwargs)
+        if use_jit and rm._jittable:
+            if rm._jitted_step is None:
+                rm._jitted_step = rm._lookup_or_build_jitted_step()
+            try:
+                merged, delta = rm._jitted_step(rm._current_state(), *args, **kw)
+                return delta, merged
+            except Metric._TRACER_ERRORS:
+                rm._jit_failed = True
+        return rm._run_update_on_state(rm.init_state(), *args, **kw), None
+
+    def _forward_eager_grouped(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Per-member fallback forward with the compute-group delta SHARED.
+
+        The eager analogue of the fused collection step's grouping: the
+        group representative computes the batch delta once, every member
+        merges it into its OWN accumulator and computes its batch value
+        from the shared delta — including ``dist_sync_on_step`` members
+        (each still syncs its delta through its own compute, so per-member
+        sync semantics are unchanged) and configs whose fingerprint keeps
+        the fused step off. Mirrors ``Metric._forward_fused``'s contract
+        member by member.
+        """
+        shared = self._eager_shared_groups()
+        deltas: Dict[str, Any] = {}
+        merged_rep: Dict[str, Any] = {}
+        out: Dict[str, Any] = {}
+        for k, m in self.items():
+            rep = shared.get(k)
+            if rep is None:
+                out[self._set_prefix(k)] = m(*args, **m._filter_kwargs(**kwargs))
+                continue
+            if rep not in deltas:
+                if TRACE.enabled:
+                    with _span("collection.group_update", {"group": rep}):
+                        delta, merged = self._group_delta(rep, args, kwargs, use_jit=True)
+                else:
+                    delta, merged = self._group_delta(rep, args, kwargs, use_jit=True)
+                deltas[rep] = delta
+                if merged is not None:
+                    merged_rep[rep] = merged
+            delta = deltas[rep]
+            m._computed = None
+            m._forward_cache = None
+            m._note_rows(args, m._filter_kwargs(**kwargs))
+            if k == rep and rep in merged_rep:
+                m._set_state(merged_rep[rep])  # jitted step already merged
+            else:
+                m._set_state(m.merge_states(m._current_state(), delta))
+            value = None
+            if m.compute_on_step:
+                # the _forward_fused tail: batch value from the shared delta,
+                # with per-member dist_sync_on_step honored by its compute
+                m._to_sync = m.dist_sync_on_step
+                m._in_forward = True
+                acc = m._current_state()
+                m._set_state(delta)
+                try:
+                    m._forward_cache = m.compute()
+                finally:
+                    m._set_state(acc)
+                    m._to_sync = True
+                    m._in_forward = False
+                m._computed = None
+                value = m._forward_cache
+            out[self._set_prefix(k)] = value
+        return out
 
     def _collection_fusable(self) -> bool:
         return all(
@@ -446,9 +536,31 @@ class MetricCollection(OrderedDict):
         return jax.jit(step, donate_argnums=donate)
 
     def update(self, *args: Any, **kwargs: Any) -> None:
+        """Eager accumulate: one update PER COMPUTE GROUP, not per member.
+
+        The group representative computes the batch delta once and every
+        member merges it into its own accumulator — the eager-path analogue
+        of the fused step's shared update (``dist_sync_on_step`` and
+        unfingerprintable configs share the delta too). Singleton groups and
+        non-mergeable members run their own ``update`` unchanged.
+        """
         self._lockstep_check()
-        for _, m in self.items():
-            m.update(*args, **m._filter_kwargs(**kwargs))
+        shared = self._eager_shared_groups()
+        deltas: Dict[str, Any] = {}
+        for k, m in self.items():
+            rep = shared.get(k)
+            if rep is None:
+                m.update(*args, **m._filter_kwargs(**kwargs))
+                continue
+            if rep not in deltas:
+                if TRACE.enabled:
+                    with _span("collection.group_update", {"group": rep}):
+                        deltas[rep], _ = self._group_delta(rep, args, kwargs, use_jit=False)
+                else:
+                    deltas[rep], _ = self._group_delta(rep, args, kwargs, use_jit=False)
+            m._computed = None
+            m._note_rows(args, m._filter_kwargs(**kwargs))
+            m._set_state(m.merge_states(m._current_state(), deltas[rep]))
         self._lockstep_record()
 
     def compute(self) -> Dict[str, Any]:
@@ -633,11 +745,14 @@ class MetricCollection(OrderedDict):
         return {k: self[k].merge_states(a[k], b[k]) for k in a}
 
     def sync_state(self, state: Dict[str, Dict[str, Any]], axis_name: str) -> Dict[str, Dict[str, Any]]:
-        """In-jit sync of the joint state over a mesh axis — sum/min/max leaves
-        across ALL entries coalesce into per-dtype bucketed collectives (one
-        ``psum`` per bucket for the whole collection), instead of one
-        collective per state leaf per metric; gather/cat/mean leaves keep
-        their own plane (see ``parallel.sync.coalesced_sync_state``)."""
+        """In-jit sync of the joint state over a mesh axis — leaves across
+        ALL entries coalesce into per-dtype bucketed collectives (see
+        ``parallel.sync.coalesced_sync_state``): one ``psum``/``pmin``/
+        ``pmax`` per reduce bucket (``mean`` folds into the sum bucket), one
+        ``all_gather`` per gather bucket, and one data + one counts
+        ``all_gather`` per PaddedBuffer bucket — a buffer-state collection
+        (AUROC + AveragePrecision + Spearman) stages 2 gathers per dtype
+        instead of 2 per buffer."""
         from metrics_tpu.parallel.sync import coalesced_sync_state
 
         flat = {(k, n): v for k, s in state.items() for n, v in s.items()}

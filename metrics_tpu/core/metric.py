@@ -478,9 +478,14 @@ class Metric(ABC):
     def sync_state(self, state: State, axis_name: str) -> State:
         """In-jit cross-device sync over a named mesh axis (use inside shard_map/pmap).
 
-        Sum/min/max leaves of a common dtype sync through ONE bucketed
-        collective (``parallel.sync.coalesced_sync_state``) — a multi-state
-        metric like StatScores pays one ``psum``, not four."""
+        Leaves of a common dtype sync through bucketed collectives
+        (``parallel.sync.coalesced_sync_state``): sum/min/max leaves share
+        one ``psum``/``pmin``/``pmax`` per bucket (``mean`` folds into the
+        sum bucket as psum-then-divide), gather-semantics array leaves share
+        one ``all_gather``, and same-dtype PaddedBuffer cat-states share one
+        data + one counts ``all_gather`` per bucket — a multi-state metric
+        like StatScores pays one ``psum``, not four, and a two-buffer curve
+        metric pays 2 gathers, not 4."""
         return coalesced_sync_state(state, self._reductions, axis_name)
 
     def pure(self) -> PureMetric:

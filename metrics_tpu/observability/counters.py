@@ -43,13 +43,18 @@ __all__ = [
     "snapshot",
 ]
 
-# collective kinds with a stable schema position in snapshots
+# collective kinds with a stable schema position in snapshots.
+# "coalesced_gather" is an all_gather whose payload is a BUCKET of state
+# leaves (the coalesced gather plane in parallel/sync.py and the stacked
+# engine gathers in parallel/sharded_epoch.py) — attributed separately so
+# snapshots show how much of the gather traffic rides the bucketed plane.
 KINDS = (
     "psum",
     "pmean",
     "pmin",
     "pmax",
     "all_gather",
+    "coalesced_gather",
     "ppermute",
     "all_to_all",
     "process_allgather",

@@ -96,14 +96,20 @@ def to_trace_events(records: Optional[List[SpanRecord]] = None) -> List[Dict[str
 def chrome_trace(
     records: Optional[List[SpanRecord]] = None,
     include_counters: bool = True,
+    counters: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
-    """The full Chrome-trace JSON object (Perfetto-loadable)."""
+    """The full Chrome-trace JSON object (Perfetto-loadable).
+
+    ``counters`` overrides the live snapshot in ``otherData`` — callers that
+    reset the counters per measured phase (bench A/Bs) pass the snapshot of
+    the phase of record instead of whatever the last reset left behind.
+    """
     out: Dict[str, Any] = {
         "traceEvents": to_trace_events(records),
         "displayTimeUnit": "ms",
     }
     if include_counters:
-        out["otherData"] = _counters.snapshot()
+        out["otherData"] = _counters.snapshot() if counters is None else dict(counters)
     return out
 
 
@@ -111,10 +117,11 @@ def write_chrome_trace(
     path: str,
     records: Optional[List[SpanRecord]] = None,
     include_counters: bool = True,
+    counters: Optional[Dict[str, Any]] = None,
 ) -> None:
     """Write a ``.json`` trace loadable by chrome://tracing / ui.perfetto.dev."""
     with open(path, "w") as f:
-        json.dump(chrome_trace(records, include_counters=include_counters), f)
+        json.dump(chrome_trace(records, include_counters=include_counters, counters=counters), f)
 
 
 def write_jsonl(path: str, records: Optional[List[SpanRecord]] = None) -> None:

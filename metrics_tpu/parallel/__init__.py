@@ -2,6 +2,7 @@ from metrics_tpu.parallel.buffer import (
     PaddedBuffer,
     buffer_all_gather,
     buffer_append,
+    buffer_compact_gathered,
     buffer_init,
     buffer_mask,
     buffer_merge,
@@ -25,6 +26,7 @@ from metrics_tpu.parallel.sync import (
     gather_all_arrays,
     host_gather,
     merge_values,
+    packable_gather,
     sync_state,
     sync_value,
 )

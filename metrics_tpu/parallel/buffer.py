@@ -61,6 +61,28 @@ def buffer_merge(a: PaddedBuffer, b: PaddedBuffer) -> PaddedBuffer:
     return PaddedBuffer(data=data, count=a.count + b.count)
 
 
+def buffer_compact_gathered(data: Array, counts: Array) -> PaddedBuffer:
+    """Compact an already-gathered ``(W, cap, *item)`` stack into one buffer.
+
+    The pure (collective-free) half of :func:`buffer_all_gather`: valid rows
+    of every device block are scattered to the front in axis order via an
+    exclusive prefix sum over the (capacity-clamped) counts. The coalesced
+    gather plane (``parallel.sync.coalesced_sync_state``) runs this on views
+    sliced out of ONE bucketed ``all_gather`` payload, so compaction stays
+    per-buffer while the collective is shared.
+    """
+    world, cap = data.shape[0], data.shape[1]
+    clamped = jnp.minimum(counts, cap)
+    offsets = jnp.cumsum(clamped) - clamped  # exclusive prefix sum
+    row = jnp.arange(cap)
+    valid = row[None, :] < clamped[:, None]  # (W, cap)
+    dest = jnp.where(valid, offsets[:, None] + row[None, :], world * cap)
+    out = jnp.zeros((world * cap, *data.shape[2:]), dtype=data.dtype)
+    out = out.at[dest.reshape(-1)].set(data.reshape(world * cap, *data.shape[2:]), mode="drop")
+    # count stays the UNclamped sum so overflow is still detectable host-side
+    return PaddedBuffer(data=out, count=jnp.sum(counts))
+
+
 def buffer_all_gather(buf: PaddedBuffer, axis_name: str) -> PaddedBuffer:
     """Gather per-device buffers over a mesh axis into one compacted buffer.
 
@@ -70,15 +92,7 @@ def buffer_all_gather(buf: PaddedBuffer, axis_name: str) -> PaddedBuffer:
     """
     data = jax.lax.all_gather(buf.data, axis_name)  # (W, cap, *item)
     counts = jax.lax.all_gather(buf.count, axis_name)  # (W,)
-    world, cap = data.shape[0], data.shape[1]
-    clamped = jnp.minimum(counts, cap)
-    offsets = jnp.cumsum(clamped) - clamped  # exclusive prefix sum
-    row = jnp.arange(cap)
-    valid = row[None, :] < clamped[:, None]  # (W, cap)
-    dest = jnp.where(valid, offsets[:, None] + row[None, :], world * cap)
-    out = jnp.zeros((world * cap, *data.shape[2:]), dtype=data.dtype)
-    out = out.at[dest.reshape(-1)].set(data.reshape(world * cap, *data.shape[2:]), mode="drop")
-    return PaddedBuffer(data=out, count=jnp.sum(counts))
+    return buffer_compact_gathered(data, counts)
 
 
 def buffer_values(buf: PaddedBuffer) -> Array:

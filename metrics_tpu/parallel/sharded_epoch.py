@@ -232,11 +232,14 @@ def sharded_clf_curve_matrix(
     p = jnp.where(w > 0, preds_cm, -jnp.inf)
     _, _, wp_ge, wn_ge = _ring_stats_cols(p, target_cm, w, axis_name)
 
-    def gather(x):
-        record_collective("all_gather", x)
-        return jax.lax.all_gather(x, axis_name=axis_name, axis=1, tiled=True)
+    # the four (C, m) sort operands ride ONE coalesced all_gather: stacked to
+    # (4, C, m) and gathered tiled along the row axis — same payload bytes,
+    # one collective instead of four (small gathers are latency-bound)
+    stacked = jnp.stack([-p, wp_ge, wn_ge, w])
+    record_collective("coalesced_gather", stacked)
+    gathered = jax.lax.all_gather(stacked, axis_name=axis_name, axis=2, tiled=True)
     neg_s, tps, fps, wv = jax.lax.sort(
-        (gather(-p), gather(wp_ge), gather(wn_ge), gather(w)), num_keys=1
+        (gathered[0], gathered[1], gathered[2], gathered[3]), num_keys=1
     )
     scores = -neg_s
     run_end = jnp.concatenate(

@@ -18,6 +18,7 @@ TPU-native design — two sync planes instead of one NCCL call:
    multi-host deployments — per-leaf ``multihost_utils.process_allgather``
    (the DCN analogue of the reference's Gloo path), identity on one process.
 """
+import functools
 from typing import Any, Callable, Dict, List, Optional, Union
 
 import jax
@@ -198,34 +199,68 @@ def sync_state(state: Dict[str, Any], reductions: Dict[str, ReduceFx], axis_name
 def coalesced_sync_state(
     state: Dict[Any, Any], reductions: Dict[Any, ReduceFx], axis_name: str
 ) -> Dict[Any, Any]:
-    """In-jit sync with COALESCED collectives: one ``psum``/``pmin``/``pmax``
-    per (op, dtype) bucket instead of one per state leaf.
+    """In-jit sync with COALESCED collectives: a handful of bucketed
+    collectives instead of one (or two) per state leaf.
 
-    ``sum``-reducible array leaves of the same dtype are flattened into one
-    contiguous buffer, synced with a single collective, and sliced back to
-    their original shapes; likewise for ``min``/``max``. Element values are
-    unchanged — cross-device reduction is elementwise, so concatenation
-    cannot alter any element's result — but a collection's whole sync plane
-    collapses from one collective per leaf per metric to a handful of
-    bucketed collectives (latency-bound on ICI/DCN at small state sizes).
-    ``mean``, ``cat``, gather (``None``) and callable reductions, lists and
-    :class:`PaddedBuffer` leaves keep their own per-leaf plane.
+    Three bucket planes, all keyed by dtype:
+
+    - **Reduce plane** (``sum``/``min``/``max`` array leaves): flattened into
+      one contiguous buffer per (op, dtype) bucket, synced with a single
+      ``psum``/``pmin``/``pmax``, sliced back to the original shapes.
+      Element values are unchanged — cross-device reduction is elementwise,
+      so concatenation cannot alter any element's result. Floating ``mean``
+      leaves FOLD INTO the ``sum`` bucket (psum, then divide by the axis
+      size after slicing), eliminating the separate ``pmean`` per leaf.
+    - **Gather plane** (``cat``/``None``/callable array leaves): flattened
+      into one payload per dtype bucket, gathered with ONE ``all_gather``,
+      then sliced per leaf into the exact ``(world, *shape)`` stack the
+      per-leaf path would have produced before the leaf's own finishing step
+      (keep / dim-zero cat / callable) runs. Gather is concatenation per
+      leaf, so slicing the shared payload is semantics-preserving for every
+      reduction, callables included.
+    - **Buffer plane** (:class:`PaddedBuffer` cat-states): same-dtype
+      buffers ravel their ``(capacity, *item)`` rows into one concatenated
+      payload gathered with ONE ``all_gather``, plus ONE for the stacked
+      counts vector — 2 collectives per dtype bucket instead of 2 per
+      buffer. Each buffer's slice then runs the ordinary compaction
+      (``buffer_compact_gathered``'s prefix-sum scatter) on its view, so
+      results are bit-identical to per-buffer :func:`buffer_all_gather`.
+
+    A collection's whole sync plane collapses from one collective per leaf
+    per metric to a handful of bucketed collectives (latency-bound on
+    ICI/DCN at small state sizes). Single-member buckets delegate to the
+    per-leaf :func:`sync_value` — no flatten/slice overhead, identical
+    collective count. Eager list leaves still raise (no jit-safe sync).
     """
+    from metrics_tpu.parallel.buffer import buffer_compact_gathered
+    from metrics_tpu.utils.compat import axis_size
+
     record_states_synced(len(state))
     with annotate("metric.sync"):
         out: Dict[Any, Any] = {}
         buckets: Dict[tuple, list] = {}  # (op, dtype str) -> [leaf name]
+        gather_buckets: Dict[str, list] = {}  # dtype str -> [array leaf name]
+        buffer_buckets: Dict[str, list] = {}  # dtype str -> [buffer leaf name]
         for name, value in state.items():
             fx = reductions[name]
-            if fx in ("sum", "min", "max") and not isinstance(value, (PaddedBuffer, list)):
+            if isinstance(value, PaddedBuffer):
+                buffer_buckets.setdefault(str(value.data.dtype), []).append(name)
+            elif isinstance(value, list):
+                out[name] = sync_value(fx, value, axis_name)  # raises: not jit-safe
+            elif fx in ("sum", "min", "max"):
                 buckets.setdefault((fx, str(value.dtype)), []).append(name)
+            elif fx == "mean" and jnp.issubdtype(value.dtype, jnp.inexact):
+                # psum-then-divide == pmean elementwise; ride the sum bucket
+                buckets.setdefault(("sum", str(value.dtype)), []).append(name)
             else:
-                out[name] = sync_value(fx, value, axis_name)
+                # cat / None / callable reductions: the gather plane
+                gather_buckets.setdefault(str(value.dtype), []).append(name)
+
         ops = {"sum": jax.lax.psum, "min": jax.lax.pmin, "max": jax.lax.pmax}
         kinds = {"sum": "psum", "min": "pmin", "max": "pmax"}
         for (op, _dtype), names in buckets.items():
             if len(names) == 1:
-                out[names[0]] = sync_value(op, state[names[0]], axis_name)
+                out[names[0]] = sync_value(reductions[names[0]], state[names[0]], axis_name)
                 continue
             flat = jnp.concatenate([jnp.ravel(state[n]) for n in names])
             record_collective(kinds[op], flat)
@@ -233,8 +268,53 @@ def coalesced_sync_state(
             offset = 0
             for n in names:
                 value = state[n]
-                out[n] = synced[offset: offset + value.size].reshape(value.shape)
+                piece = synced[offset: offset + value.size].reshape(value.shape)
+                if reductions[n] == "mean":
+                    piece = piece / axis_size(axis_name)
+                out[n] = piece
                 offset += value.size
+
+        for _dtype, names in gather_buckets.items():
+            if len(names) == 1:
+                out[names[0]] = sync_value(reductions[names[0]], state[names[0]], axis_name)
+                continue
+            flat = jnp.concatenate([jnp.ravel(state[n]) for n in names])
+            record_collective("coalesced_gather", flat)
+            gathered = jax.lax.all_gather(flat, axis_name)  # (W, sum of sizes)
+            offset = 0
+            for n in names:
+                value = state[n]
+                g = gathered[:, offset: offset + value.size].reshape(
+                    (gathered.shape[0], *value.shape)
+                )
+                offset += value.size
+                fx = reductions[n]
+                if fx is None:
+                    out[n] = g
+                elif fx == "cat":
+                    out[n] = g.reshape((-1, *g.shape[2:])) if g.ndim > 1 else g.reshape(-1)
+                else:
+                    out[n] = fx(g)
+
+        for _dtype, names in buffer_buckets.items():
+            if len(names) == 1:
+                out[names[0]] = sync_value(reductions[names[0]], state[names[0]], axis_name)
+                continue
+            flat = jnp.concatenate([jnp.ravel(state[n].data) for n in names])
+            counts = jnp.stack([state[n].count for n in names])  # (n buffers,)
+            record_collective("coalesced_gather", flat)
+            record_collective("coalesced_gather", counts)
+            g_data = jax.lax.all_gather(flat, axis_name)  # (W, sum of data sizes)
+            g_counts = jax.lax.all_gather(counts, axis_name)  # (W, n buffers)
+            offset = 0
+            for i, n in enumerate(names):
+                buf = state[n]
+                size = buf.data.size
+                view = g_data[:, offset: offset + size].reshape(
+                    (g_data.shape[0], *buf.data.shape)
+                )
+                offset += size
+                out[n] = buffer_compact_gathered(view, g_counts[:, i])
     return out
 
 
@@ -296,6 +376,63 @@ def gather_all_arrays(value: Array, group: Any = None) -> List[Array]:
     return [gathered[i] for i in indices]
 
 
+def packable_gather(fn: Callable) -> Callable:
+    """Mark a custom host gather as VALUE-based, opting it into payload packing.
+
+    ``host_gather`` packs same-dtype leaves into one flat payload per gather
+    call — but that is only sound for a gather that transports exactly the
+    array it was handed (``fn(x) -> [x per rank]``), like the default
+    ``process_allgather`` plane. A custom ``dist_sync_fn`` that instead
+    treats its argument as a *reference* (e.g. a test-world gather that
+    identity-matches the array to a named state on every rank) must keep the
+    per-leaf calls, so packing is opt-in for custom functions.
+    """
+    fn._mtpu_packable = True
+    return fn
+
+
+def is_packable_gather(fn: Callable) -> bool:
+    """Whether ``host_gather`` may pack payloads through this gather."""
+    if fn is gather_all_arrays or getattr(fn, "_mtpu_packable", False):
+        return True
+    if isinstance(fn, functools.partial):
+        return is_packable_gather(fn.func)
+    return False
+
+
+def _packed_gather_units(units: List[Any], gather_fn: Callable) -> List[List[Array]]:
+    """Gather many arrays with one ``gather_fn`` call per dtype bucket.
+
+    ``units`` is a list of (possibly scalar) arrays; the result is, per
+    unit, the list of per-process arrays ``gather_fn`` would have returned
+    for it individually. Same-dtype units ravel into ONE flat payload, ride
+    ONE gather call, and are sliced back per process — the host-plane
+    analogue of the in-jit bucketed gather (each small DCN collective is
+    latency-bound, so packing trades a copy for round-trips). Single-member
+    buckets pass the original array through untouched (shape-sensitive
+    custom ``dist_sync_fn`` implementations see no change).
+    """
+    results: List[Optional[List[Array]]] = [None] * len(units)
+    buckets: Dict[str, List[int]] = {}
+    for i, arr in enumerate(units):
+        buckets.setdefault(str(arr.dtype), []).append(i)
+    for _dtype, indices in buckets.items():
+        if len(indices) == 1:
+            i = indices[0]
+            results[i] = gather_fn(units[i])
+            continue
+        flat = jnp.concatenate([jnp.ravel(units[i]) for i in indices])
+        per_process = gather_fn(flat)
+        offset = 0
+        for i in indices:
+            arr = units[i]
+            results[i] = [
+                p[offset: offset + arr.size].reshape(arr.shape) for p in per_process
+            ]
+            offset += arr.size
+    return results  # type: ignore[return-value]
+
+
 def host_gather(
     state: Dict[str, Any],
     reductions: Dict[str, ReduceFx],
@@ -303,14 +440,47 @@ def host_gather(
 ) -> Dict[str, Any]:
     """Host-plane sync of a state dict, reproducing reference ``_sync_dist``
     semantics (metric.py:179-197): gather every array, stack tensor states /
-    flatten list states, then apply the per-state reduction."""
+    flatten list states, then apply the per-state reduction.
+
+    Gather calls are PACKED when the gather is value-based (the default
+    ``process_allgather`` plane, or a custom fn marked with
+    :func:`packable_gather`): every array entering the plane — plain leaves,
+    PaddedBuffer data and counts, list elements — joins a per-dtype flat
+    payload, and each payload moves with ONE ``gather_fn`` call (one
+    ``process_allgather`` over DCN when multi-host). Values are identical to
+    the per-leaf plane: per-process slices reconstruct exactly the arrays an
+    individual gather would have returned before any reduction runs.
+    Reference-semantics custom ``dist_sync_fn``s keep one call per array.
+    """
     gather_fn = gather_fn or gather_all_arrays
+
+    # pass 1: enumerate every array that must move, in a stable order
+    units: List[Array] = []
+    slots: Dict[str, Any] = {}  # name -> unit indices, shaped per leaf kind
+    for name, value in state.items():
+        if isinstance(value, PaddedBuffer):
+            slots[name] = ("buffer", len(units), len(units) + 1)
+            units.extend([value.data, value.count])
+        elif isinstance(value, list):
+            slots[name] = ("list", list(range(len(units), len(units) + len(value))))
+            units.extend(v if hasattr(v, "dtype") else jnp.asarray(v) for v in value)
+        else:
+            slots[name] = ("array", len(units))
+            units.append(value if hasattr(value, "dtype") else jnp.asarray(value))
+
+    if is_packable_gather(gather_fn):
+        gathered_units = _packed_gather_units(units, gather_fn)
+    else:
+        gathered_units = [gather_fn(u) for u in units]
+
+    # pass 2: per-leaf reduction over the reconstructed per-process arrays
     out: Dict[str, Any] = {}
     for name, value in state.items():
         fx = reductions[name]
-        if isinstance(value, PaddedBuffer):
-            gathered = gather_fn(value.data)
-            counts = gather_fn(value.count)
+        slot = slots[name]
+        if slot[0] == "buffer":
+            gathered = gathered_units[slot[1]]
+            counts = gathered_units[slot[2]]
             for g, c in zip(gathered, counts):
                 if int(c) > g.shape[0]:
                     raise RuntimeError(
@@ -320,14 +490,14 @@ def host_gather(
             parts = [g[: int(c)] for g, c in zip(gathered, counts)]
             out[name] = dim_zero_cat(parts) if parts else value.data[:0]
             continue
-        if isinstance(value, list):
-            # gather each element; flatten in element-major order (reference metric.py:192-193)
-            gathered_elems = [gather_fn(v) for v in value]
+        if slot[0] == "list":
+            # flatten in element-major order (reference metric.py:192-193)
+            gathered_elems = [gathered_units[i] for i in slot[1]]
             flat = [g for elem in gathered_elems for g in elem]
             reduction = stacked_reduction(fx)
             out[name] = reduction(flat) if fx == "cat" else (reduction(flat) if reduction else flat)
             continue
-        gathered = gather_fn(value)
+        gathered = gathered_units[slot[1]]
         stacked = jnp.stack(gathered)
         reduction = stacked_reduction(fx)
         out[name] = reduction(stacked) if reduction is not None else stacked

@@ -15,6 +15,13 @@ from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric, _validate_k
 class RetrievalPrecision(RetrievalMetric):
     r"""Mean precision@k over queries.
 
+    Shares the ``RetrievalMetric`` flatten-append update (and so the
+    regrouped per-query plane) with the other retrieval metrics: inside a
+    ``MetricCollection``, RetrievalPrecision/Recall/MRR with matching
+    ``capacity`` form ONE compute group — one idx/preds/target append per
+    step, one state pytree on the pure/sync plane. ``k`` is compute-only
+    and deliberately absent from the group key.
+
     With ``k=None`` each query uses its own document count as k (i.e. plain
     precision of the whole ranking).
 
@@ -27,6 +34,10 @@ class RetrievalPrecision(RetrievalMetric):
         >>> float(p2(indexes, preds, target))
         0.5
     """
+
+    # the shared base update has no config deps beyond `capacity` (which the
+    # group fingerprint always includes); the empty tuple opts in to grouping
+    _GROUP_UPDATE_ATTRS = ()
 
     def __init__(
         self,

@@ -245,6 +245,99 @@ def test_individually_updated_member_keeps_own_state(jit_on):
     np.testing.assert_array_equal(np.asarray(mc.compute()["Recall"]), np.asarray(want_r.compute()))
 
 
+class _CountingSum(metrics_tpu.Metric):
+    """Groupable metric whose update bumps a class-level call counter.
+
+    ``scale`` is compute-only, so two instances with different scales still
+    share one update — the delta-sharing observable the eager-path tests pin.
+    """
+
+    calls = 0
+    _GROUP_UPDATE_ATTRS = ()
+
+    def __init__(self, scale=1.0, **kw):
+        super().__init__(**kw)
+        self.scale = scale
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, x):
+        type(self).calls += 1
+        self.total = self.total + jnp.sum(x)
+
+    def compute(self):
+        return self.total * self.scale
+
+
+def test_eager_update_shares_one_update_per_group():
+    """The non-jit ``update()`` path runs ONE update per compute group: the
+    representative's delta merges into every member's own accumulator."""
+    _CountingSum.calls = 0
+    mc = MetricCollection({"a": _CountingSum(1.0), "b": _CountingSum(2.0)})
+    x = jnp.arange(4.0)
+    mc.update(x)
+    assert _CountingSum.calls == 1
+    assert float(mc["a"].total) == 6.0 and float(mc["b"].total) == 6.0
+    out = mc.compute()
+    assert float(out["a"]) == 6.0 and float(out["b"]) == 12.0
+
+    # escape hatch restores per-member updates
+    _CountingSum.calls = 0
+    mc2 = MetricCollection({"a": _CountingSum(1.0), "b": _CountingSum(2.0)}, compute_groups=False)
+    mc2.update(x)
+    assert _CountingSum.calls == 2
+
+
+def test_eager_forward_dist_sync_on_step_shares_delta():
+    """``dist_sync_on_step`` keeps the fused collection step off, but the
+    eager fallback forward now shares the group delta too — each member
+    still syncs its batch value through its own compute (semantics
+    unchanged), and accumulators keep the LOCAL delta."""
+
+    def gather(arr, **kw):
+        return [arr, arr]  # fake 2-rank world
+
+    _CountingSum.calls = 0
+    mc = MetricCollection({
+        "a": _CountingSum(1.0, dist_sync_on_step=True, dist_sync_fn=gather),
+        "b": _CountingSum(2.0, dist_sync_on_step=True, dist_sync_fn=gather),
+    })
+    out = mc(jnp.arange(3.0))
+    assert _CountingSum.calls == 1
+    assert float(out["a"]) == 6.0 and float(out["b"]) == 12.0  # synced delta x scale
+    assert float(mc["a"].total) == 3.0  # local accumulator survives the sync
+
+    # second step accumulates on top of the first
+    out = mc(jnp.arange(3.0))
+    assert _CountingSum.calls == 2
+    assert float(mc["b"].total) == 6.0 and float(out["b"]) == 12.0
+
+
+def test_retrieval_family_forms_one_group():
+    """RetrievalPrecision/Recall/MRR share the base flatten-append update, so
+    matching-capacity instances fuse to ONE group (k and the empty-query
+    policy are compute-only); results match the ungrouped collection."""
+    from metrics_tpu import RetrievalMRR, RetrievalPrecision, RetrievalRecall
+
+    def build(**kw):
+        return [RetrievalPrecision(k=2), RetrievalRecall(k=1), RetrievalMRR()]
+
+    mc = MetricCollection(build())
+    groups = mc.compute_groups
+    assert groups["RetrievalPrecision"] == ("RetrievalPrecision", "RetrievalRecall", "RetrievalMRR")
+    assert len(mc.init_state()) == 1  # one idx/preds/target pytree per group
+
+    ungrouped = MetricCollection(build(), compute_groups=False)
+    idx = jnp.array([0, 0, 0, 1, 1, 1, 1])
+    preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+    target = jnp.array([False, False, True, False, True, False, True])
+    _assert_same(mc(idx, preds, target), ungrouped(idx, preds, target))
+    _assert_same(mc.compute(), ungrouped.compute())
+
+    # a capacity mismatch changes the state schema: never grouped
+    split = MetricCollection([RetrievalPrecision(capacity=8), RetrievalRecall()])
+    assert all(len(m) == 1 for m in split.compute_groups.values())
+
+
 def test_sync_state_roundtrip_2device_mesh():
     """Grouped vs ungrouped pure sync over a real 2-device mesh collective
     program: bit-identical synced computes, with the grouped program moving

@@ -177,6 +177,79 @@ def test_escape_hatch_disables_sharing():
     assert gather.calls == 14
 
 
+# ------------------------------------------------------- host-plane packing
+def _packing_state():
+    """A mixed state dict covering every leaf kind the packed plane moves."""
+    from metrics_tpu.parallel.buffer import buffer_append, buffer_init
+
+    buf = buffer_append(buffer_init(4, (), jnp.float32), jnp.asarray([1.0, 2.0]))
+    state = {
+        "sum_f": jnp.asarray([1.5, 2.5]),
+        "sum_i": jnp.asarray([3, 4], dtype=jnp.int32),
+        "other_i": jnp.asarray(7, dtype=jnp.int32),
+        "buf": buf,
+        "lst": [jnp.asarray([9.0]), jnp.asarray([10.0, 11.0])],
+    }
+    reductions = {"sum_f": "sum", "sum_i": "sum", "other_i": "max", "buf": None, "lst": "cat"}
+    return state, reductions
+
+
+def test_host_gather_packs_per_dtype_with_identical_values():
+    """``host_gather`` through a value-based (packable) gather moves ONE flat
+    payload per dtype — f32 (arrays + buffer data + list elements) and i32
+    (arrays + buffer count) — with results bit-identical to the per-leaf
+    plane a reference-semantics custom gather still gets."""
+    from metrics_tpu.parallel.sync import host_gather, packable_gather
+
+    state, reductions = _packing_state()
+
+    per_leaf_gather = _CountingGather()  # unmarked: keeps one call per array
+    packed_gather = packable_gather(_CountingGather())
+    per_leaf = host_gather(state, reductions, gather_fn=per_leaf_gather)
+    packed = host_gather(state, reductions, gather_fn=packed_gather)
+
+    _assert_same(
+        {k: v for k, v in per_leaf.items() if k != "lst"},
+        {k: v for k, v in packed.items() if k != "lst"},
+    )
+    # 7 arrays move either way: 4 f32 (sum_f, buf.data, 2 list elements) and
+    # 3 i32 (sum_i, other_i, buf.count) — packed: one call per dtype bucket
+    assert per_leaf_gather.calls == 7
+    assert packed_gather.calls == 2
+
+
+def test_default_process_gather_is_packable():
+    """The real multi-host plane (``gather_all_arrays``, incl. its
+    ``process_group``-scoped partial) packs; unmarked custom fns do not."""
+    import functools
+
+    from metrics_tpu.parallel.sync import gather_all_arrays, is_packable_gather
+
+    assert is_packable_gather(gather_all_arrays)
+    assert is_packable_gather(functools.partial(gather_all_arrays, group=(0,)))
+    assert not is_packable_gather(_CountingGather())
+
+
+def test_grouped_sync_with_packable_gather_packs_each_plane():
+    """Grouping and packing compose: one gather plane per compute group, one
+    CALL per dtype bucket within it — the 4-metric collection's whole host
+    sync collapses to 2 calls (Accuracy int32 bucket + StatScores int32
+    bucket), values unchanged."""
+    from metrics_tpu.parallel.sync import packable_gather
+
+    rng = np.random.RandomState(19)
+    preds, target = _data(rng)
+
+    packed_gather = packable_gather(_CountingGather())
+    mc = _collection(packed_gather)
+    ref = _collection(_CountingGather(), compute_groups=False)
+    mc(preds, target)
+    ref(preds, target)
+
+    _assert_same(mc.compute(), ref.compute())
+    assert packed_gather.calls == 2
+
+
 def test_clone_starts_conservative_until_reset():
     """Lockstep is identity-based, so a clone cannot inherit it: members with
     accumulated state start diverged (correct, just unshared) and a

@@ -46,6 +46,11 @@ def _assert_headline_schema(out):
     assert out["states_synced"] == 6
     assert out["states_synced_ungrouped"] == 14
 
+    # the gather-plane A/B (buffer-state collection) rides the same line
+    for key in ("gather_coalesced_ms", "gather_per_leaf_ms"):
+        assert isinstance(out[key], (int, float)) and out[key] > 0, key
+    assert out["gather_states_synced"] == 6  # 6 PaddedBuffer states
+
 
 def test_bench_smoke_json_schema():
     out = _run_smoke()
@@ -69,6 +74,14 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     assert out["counters"]["states_synced"] == out["states_synced"]
     assert out["counters"]["collective_calls"] == out["collective_calls"]
 
+    # the coalesced gather plane: 2 all_gathers per dtype bucket (f32 data
+    # + counts, i32 data + counts) instead of 2 per buffer — same payload
+    # bytes, a third of the staged collectives
+    assert out["gather_collective_calls"] == 4
+    assert out["gather_collective_calls_per_leaf"] == 12
+    assert out["gather_sync_bytes"] == out["gather_sync_bytes_per_leaf"]
+    assert out["gather_counters"]["calls_by_kind"]["coalesced_gather"] == 4
+
     # per-phase ms come from the span aggregates, not ad-hoc timers
     assert any(name.startswith("bench.compile") for name in out["phase_ms"])
     assert all(ms >= 0 for ms in out["phase_ms"].values())
@@ -81,5 +94,42 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     assert complete and all(
         isinstance(e["name"], str) and e["dur"] >= 0 and "ts" in e for e in complete
     )
-    assert {e["name"] for e in complete} >= {"bench.compile_grouped", "bench.timed_grouped"}
+    assert {e["name"] for e in complete} >= {
+        "bench.compile_grouped", "bench.timed_grouped",
+        "bench.compile_gather_coalesced", "bench.timed_gather_per_leaf",
+    }
     assert doc["otherData"]["collective_calls"] == out["collective_calls"]
+
+
+def test_bench_check_collectives_gate():
+    """``bench.py --check-collectives`` is the tier-1 regression gate: the
+    staged ``collective_calls``/``sync_bytes`` of every scenario must be
+    within the pinned expectations (growth exits non-zero). This catches a
+    silent collective-count regression even when the ms numbers hide it in
+    noise."""
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, _BENCH, "--check-collectives"],
+        capture_output=True, text=True, timeout=280, env=env,
+        cwd=os.path.dirname(_BENCH),
+    )
+    assert proc.returncode == 0, f"--check-collectives failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["ok"] is True and out["failures"] == []
+    scenarios = out["scenarios"]
+    assert set(scenarios) == {
+        "sum_grouped", "sum_ungrouped", "gather_coalesced", "gather_per_leaf"
+    }
+    # the headline reductions of record: one bucketed psum for the grouped
+    # sum plane; 4 staged all_gathers (2 per dtype bucket) vs 12 per-leaf
+    # for the gather plane, at identical payload bytes
+    assert scenarios["sum_grouped"]["collective_calls"] == 1
+    assert scenarios["gather_coalesced"]["collective_calls"] == 4
+    assert scenarios["gather_per_leaf"]["collective_calls"] == 12
+    assert (
+        scenarios["gather_coalesced"]["sync_bytes"]
+        == scenarios["gather_per_leaf"]["sync_bytes"]
+    )
+    for row in scenarios.values():
+        assert row["status"] != "regression"

@@ -1,0 +1,295 @@
+"""Parity matrix for the coalesced gather plane.
+
+``coalesced_sync_state`` buckets gather-semantics leaves — PaddedBuffer
+cat-states, plain ``cat``/``None``/callable array leaves — into per-dtype
+payloads that ride ONE ``all_gather`` (plus one for the stacked buffer
+counts), and folds floating ``mean`` leaves into the ``sum`` bucket. The
+contract under test: results are IDENTICAL to the per-leaf ``sync_state``
+plane on a real mesh collective program, across dtypes, mixed capacities,
+single-member buckets, overflow counts, and a 2-D mesh axis — only the
+number of staged collectives shrinks (asserted via the observability
+counters, which record at trace time).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu import observability as obs
+from metrics_tpu.parallel.buffer import PaddedBuffer, buffer_append, buffer_init
+from metrics_tpu.parallel.sync import coalesced_sync_state, sync_state
+from metrics_tpu.utils import compat
+
+
+@pytest.fixture(autouse=True)
+def _clean_observability():
+    obs.disable()
+    obs.reset()
+    yield
+    obs.disable()
+    obs.reset()
+
+
+def _run_plane(build_state, reductions, eight_devices, coalesced, mesh_axes=None, axis="dp"):
+    """Trace + run one sync plane over a real mesh; returns the synced state.
+
+    ``build_state(seed)`` constructs the per-device state from the device's
+    scalar seed (so every device holds DIFFERENT data). ``mesh_axes`` maps a
+    2-D mesh as ``((rows, cols), (name_row, name_col))``; default is the flat
+    8-device ``dp`` axis.
+    """
+    if mesh_axes is None:
+        mesh = Mesh(np.array(eight_devices), ("dp",))
+        world = 8
+    else:
+        shape, names = mesh_axes
+        mesh = Mesh(np.array(eight_devices).reshape(shape), names)
+        world = shape[names.index(axis)]
+    sync = coalesced_sync_state if coalesced else sync_state
+
+    def fn(seed):
+        return sync(build_state(seed[0]), reductions, axis)
+
+    f = jax.jit(
+        compat.shard_map(fn, mesh=mesh, in_specs=(P(axis),), out_specs=P(), check_vma=False)
+    )
+    return f(jnp.arange(world, dtype=jnp.int32))
+
+
+def _assert_state_equal(a, b):
+    assert set(a) == set(b)
+    for k in a:
+        va, vb = a[k], b[k]
+        if isinstance(va, PaddedBuffer):
+            assert isinstance(vb, PaddedBuffer), k
+            np.testing.assert_array_equal(np.asarray(va.data), np.asarray(vb.data), err_msg=k)
+            np.testing.assert_array_equal(np.asarray(va.count), np.asarray(vb.count), err_msg=k)
+        else:
+            np.testing.assert_array_equal(np.asarray(va), np.asarray(vb), err_msg=k)
+
+
+def _parity(build_state, reductions, eight_devices, **kw):
+    per_leaf = _run_plane(build_state, reductions, eight_devices, coalesced=False, **kw)
+    coalesced = _run_plane(build_state, reductions, eight_devices, coalesced=True, **kw)
+    _assert_state_equal(per_leaf, coalesced)
+    return coalesced
+
+
+# ------------------------------------------------------------- buffer plane
+def test_buffer_buckets_parity_dtypes_and_mixed_capacities(eight_devices):
+    """f32 x2 (different capacities!), i32 and bool buffers: the same-dtype
+    pair shares one data + one counts gather; results match per-buffer
+    ``buffer_all_gather`` exactly, row compaction included."""
+
+    def build(seed):
+        f = jnp.float32
+        a = buffer_append(buffer_init(4, (), f), (seed * 10 + jnp.arange(2)).astype(f))
+        b = buffer_append(buffer_init(6, (2,), f), (seed * 100 + jnp.arange(6).reshape(3, 2)).astype(f))
+        c = buffer_append(buffer_init(4, (), jnp.int32), seed * 7 + jnp.arange(3))
+        d = buffer_append(buffer_init(2, (), jnp.bool_), (seed % 2 == 0)[None])
+        return {"a": a, "b": b, "c": c, "d": d}
+
+    reductions = {"a": None, "b": None, "c": None, "d": None}
+    synced = _parity(build, reductions, eight_devices)
+    # compaction: every device's valid rows land at the front in axis order
+    assert int(synced["a"].count) == 16
+    a = np.asarray(synced["a"].data)
+    assert a[:16].tolist() == [v for s in range(8) for v in (s * 10, s * 10 + 1)]
+    assert (a[16:] == 0).all()
+    assert int(synced["b"].count) == 24
+    assert int(synced["c"].count) == 24
+
+
+def test_buffer_bucket_counts_two_collectives_per_dtype(eight_devices):
+    """The acceptance number: a multi-buffer bucket stages TWO all_gathers
+    (data + stacked counts) instead of two PER BUFFER; single-member buckets
+    delegate to the per-leaf plane untouched."""
+
+    def build(seed):
+        f = jnp.float32
+        return {
+            "p1": buffer_append(buffer_init(4, (), f), seed.astype(f)[None]),
+            "p2": buffer_append(buffer_init(4, (), f), seed.astype(f)[None] + 1),
+            "t": buffer_append(buffer_init(4, (), jnp.int32), seed[None]),
+        }
+
+    reductions = {"p1": None, "p2": None, "t": None}
+
+    obs.enable()
+    obs.reset()
+    _run_plane(build, reductions, eight_devices, coalesced=True)
+    coalesced_snap = obs.counters_snapshot(reset_after=True)
+    _run_plane(build, reductions, eight_devices, coalesced=False)
+    per_leaf_snap = obs.counters_snapshot(reset_after=True)
+    obs.disable()
+
+    # f32 bucket {p1, p2}: 1 data + 1 counts gather; i32 singleton: 2 plain
+    assert coalesced_snap["calls_by_kind"]["coalesced_gather"] == 2
+    assert coalesced_snap["calls_by_kind"]["all_gather"] == 2
+    assert coalesced_snap["collective_calls"] == 4
+    # per-leaf: 2 collectives per buffer
+    assert per_leaf_snap["calls_by_kind"]["all_gather"] == 6
+    assert "coalesced_gather" not in per_leaf_snap["calls_by_kind"]
+
+
+def test_overflow_counts_parity(eight_devices):
+    """Appends past capacity: rows are dropped on device but the count keeps
+    the true total on BOTH planes, so host-side overflow detection fires
+    identically after a coalesced sync."""
+
+    def build(seed):
+        buf = buffer_init(2, (), jnp.float32)
+        buf = buffer_append(buf, (seed * 10 + jnp.arange(3)).astype(jnp.float32))  # 3 > cap 2
+        other = buffer_append(buffer_init(2, (), jnp.float32), seed.astype(jnp.float32)[None])
+        return {"over": buf, "ok": other}
+
+    reductions = {"over": None, "ok": None}
+    synced = _parity(build, reductions, eight_devices)
+    assert int(synced["over"].count) == 24  # true appended total, > 16 = world*cap
+    assert int(synced["ok"].count) == 8
+
+
+# ------------------------------------------------------------- gather plane
+def test_array_gather_bucket_parity_none_cat_callable(eight_devices):
+    """Same-dtype ``None``/``cat``/callable leaves share one all_gather; each
+    leaf's finishing step (keep stacked / dim-zero cat / callable) sees the
+    exact ``(world, ...)`` stack the per-leaf plane would have built."""
+
+    def tail(stacked):
+        return stacked[-1]  # an arbitrary callable reduction over the stack
+
+    def build(seed):
+        f = jnp.float32
+        return {
+            "stack": (seed * jnp.ones((3,))).astype(f),
+            "cat1d": (seed + jnp.arange(2)).astype(f),
+            "cat2d": (seed * jnp.ones((2, 3))).astype(f),
+            "call": (seed * 2 * jnp.ones((4,))).astype(f),
+            "lonely": seed * jnp.ones((5,), jnp.int32),  # single-member bucket
+        }
+
+    reductions = {"stack": None, "cat1d": "cat", "cat2d": "cat", "call": tail, "lonely": "cat"}
+    synced = _parity(build, reductions, eight_devices)
+    assert synced["stack"].shape == (8, 3)
+    assert synced["cat1d"].shape == (16,)
+    assert synced["cat2d"].shape == (16, 3)  # dim-zero cat keeps trailing dims
+    assert synced["call"].shape == (4,)
+    np.testing.assert_array_equal(np.asarray(synced["call"]), 14.0 * np.ones(4))
+
+
+def test_mean_folds_into_sum_bucket(eight_devices):
+    """Floating ``mean`` leaves ride the sum bucket as psum-then-divide: one
+    ``psum`` for the whole bucket, zero ``pmean`` staged, identical values."""
+
+    def build(seed):
+        f = jnp.float32
+        return {
+            "s": seed.astype(f) * jnp.ones((3,)),
+            "m": seed.astype(f) * jnp.ones((2,)) + 1.0,
+        }
+
+    reductions = {"s": "sum", "m": "mean"}
+
+    obs.enable()
+    obs.reset()
+    coalesced = _run_plane(build, reductions, eight_devices, coalesced=True)
+    snap = obs.counters_snapshot(reset_after=True)
+    per_leaf = _run_plane(build, reductions, eight_devices, coalesced=False)
+    obs.disable()
+
+    assert snap["calls_by_kind"] == {"psum": 1}
+    np.testing.assert_allclose(np.asarray(coalesced["s"]), np.asarray(per_leaf["s"]))
+    np.testing.assert_allclose(np.asarray(coalesced["m"]), np.asarray(per_leaf["m"]))
+    np.testing.assert_allclose(np.asarray(coalesced["m"]), np.full(2, (sum(range(8)) + 8) / 8.0))
+
+
+def test_2d_mesh_axis_parity(eight_devices):
+    """Sync over ONE axis of a (4, 2) mesh: buckets gather the 4 dp shards
+    only, exactly like the per-leaf plane."""
+
+    def build(seed):
+        f = jnp.float32
+        return {
+            "p": buffer_append(buffer_init(4, (), f), (seed * 10 + jnp.arange(2)).astype(f)),
+            "q": buffer_append(buffer_init(4, (), f), (seed * 20).astype(f)[None]),
+            "arr": seed.astype(f) * jnp.ones((3,)),
+        }
+
+    reductions = {"p": None, "q": None, "arr": "sum"}
+    synced = _parity(
+        build, reductions, eight_devices, mesh_axes=((4, 2), ("dp", "mp")), axis="dp"
+    )
+    assert int(synced["p"].count) == 8  # 4 dp shards x 2 rows
+    np.testing.assert_allclose(np.asarray(synced["arr"]), np.full(3, sum(range(4))))
+
+
+# -------------------------------------------------- end-to-end compute parity
+def test_gather_collection_sync_compute_parity(eight_devices):
+    """The acceptance pin: AUROC + AveragePrecision + Spearman epochs synced
+    through the COALESCED joint plane compute IDENTICAL results to the
+    single-process epoch over all rows — while the staged program holds two
+    all_gathers per dtype bucket (4 total), not two per buffer (12)."""
+    from metrics_tpu import AUROC, AveragePrecision, MetricCollection, SpearmanCorrcoef
+
+    cap = 16
+
+    def build(capacity):
+        return MetricCollection([
+            AUROC(capacity=capacity),
+            AveragePrecision(num_classes=1, capacity=capacity),
+            SpearmanCorrcoef(capacity=capacity),
+        ])
+
+    rng = np.random.RandomState(42)
+    batches = [
+        (rng.rand(8).astype(np.float32), rng.randint(0, 2, 8).astype(np.int32))
+        for _ in range(8)
+    ]
+
+    # per-rank clones accumulate one shard each, eagerly (buffer promotion)
+    ranks = []
+    for p, t in batches:
+        c = build(cap)
+        c.update(jnp.asarray(p), jnp.asarray(t))
+        ranks.append(c)
+
+    # the oracle: one process sees the whole epoch in rank order
+    epoch = build(cap * 8)
+    for p, t in batches:
+        epoch.update(jnp.asarray(p), jnp.asarray(t))
+    expected = epoch.compute()
+
+    keys = [(k, n) for k, m in ranks[0].items() for n in m._defaults]
+    reductions = {(k, n): ranks[0][k]._reductions[n] for (k, n) in keys}
+    datas = {key: jnp.stack([getattr(r[key[0]], key[1]).data for r in ranks]) for key in keys}
+    counts = {key: jnp.stack([getattr(r[key[0]], key[1]).count for r in ranks]) for key in keys}
+    mesh = Mesh(np.array(eight_devices), ("dp",))
+
+    def fn(d, c):
+        state = {key: PaddedBuffer(d[key][0], c[key][0]) for key in d}
+        return coalesced_sync_state(state, reductions, "dp")
+
+    obs.enable()
+    obs.reset()
+    f = jax.jit(
+        compat.shard_map(fn, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P(), check_vma=False)
+    )
+    synced = f(datas, counts)
+    snap = obs.counters_snapshot()
+    obs.disable()
+
+    # <= 2 all_gathers per dtype bucket: f32 (4 buffers) + i32 (2 buffers)
+    assert snap["calls_by_kind"]["coalesced_gather"] == 4
+    assert snap["calls_by_kind"].get("all_gather", 0) == 0
+    assert snap["states_synced"] == 6
+
+    # install the synced epoch into the rank-0 collection (its eager update
+    # already fixed AUROC's data mode) and compute: bit-identical to the oracle
+    target = ranks[0]
+    for (k, n) in keys:
+        setattr(target[k], n, synced[(k, n)])
+    actual = target.compute()
+    assert set(actual) == set(expected)
+    for k in expected:
+        np.testing.assert_array_equal(np.asarray(actual[k]), np.asarray(expected[k]), err_msg=k)
