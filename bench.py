@@ -42,6 +42,20 @@ subsystem around the A/B: the JSON line grows ``collective_calls`` /
 ``metrics_tpu.observability.counters``, replacing ad-hoc timers for the
 per-phase story), a ``phase_ms`` span-aggregate table, and OUT.json gets a
 Chrome-trace/Perfetto file of the bench phases (load at ui.perfetto.dev).
+Schema v2 (``trace_schema: 2``) additionally carries: ``compile`` — XLA
+compile telemetry from ``jax.monitoring`` (event count, per-phase ms,
+persistent-cache hit/miss), with every span in OUT.json stamped
+``compiled=yes/no`` + ``compile_ms`` so first-dispatch spans stop
+conflating trace+compile with run; ``device_ms`` — a per-metric
+update/sync/compute device-time table from the fenced stateful scenario
+(``metrics_tpu.observability.devtime``); and ``phase_compile_ms`` — the
+compile share of each bench phase.
+
+``--check-trajectory`` is the bench-trajectory regression gate: it loads the
+prior ``BENCH_r*.json`` rounds and diffs the current numbers (measured via a
+smoke A/B, or injected with ``--trajectory-current FILE`` for testing)
+against them — phase-latency drift beyond pinned tolerances or ANY staged
+collective-count growth exits non-zero (``metrics_tpu.observability.regress``).
 """
 import json
 import os
@@ -231,7 +245,9 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
         from metrics_tpu import observability as obs_mod
 
         obs = obs_mod
-        obs.enable()
+        # compile_events: spans carry compiled=yes/no + compile_ms, and the
+        # JSON line gets the process compile telemetry snapshot
+        obs.enable(compile_events=True)
         obs.reset()
 
     def build(builder, variant, label):
@@ -281,6 +297,21 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
         "gather_states_synced": states_gather,
     }
     if obs is not None:
+        # the device-time scenario: drive the stateful per-metric API with
+        # per-phase fencing on, so the trace carries per-metric
+        # update/sync/compute device_ms rows (the A/B's instrumented sites
+        # only run at trace time inside the jitted step — nothing concrete
+        # to fence there)
+        from metrics_tpu.observability import devtime as devtime_mod
+
+        with obs.span("bench.devtime"):
+            devtime_mod.enable()
+            try:
+                _devtime_scenario()
+            finally:
+                devtime_mod.disable()
+
+        out["trace_schema"] = 2
         out["collective_calls"] = grouped_counters["collective_calls"]
         out["sync_bytes"] = grouped_counters["sync_bytes"]
         out["collective_calls_ungrouped"] = ungrouped_counters["collective_calls"]
@@ -291,9 +322,20 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
         out["gather_sync_bytes_per_leaf"] = leaf_counters["sync_bytes"]
         out["counters"] = grouped_counters
         out["gather_counters"] = coal_counters
+        summary = obs.summarize()
         out["phase_ms"] = {
-            name: round(row["total_ms"], 3) for name, row in sorted(obs.summarize().items())
+            name: round(row["total_ms"], 3) for name, row in sorted(summary.items())
         }
+        out["phase_compile_ms"] = {
+            name: round(row["compile_ms"], 3)
+            for name, row in sorted(summary.items())
+            if row["compile_ms"] > 0
+        }
+        out["device_ms"] = {
+            metric: {phase: round(ms, 3) for phase, ms in sorted(row.items())}
+            for metric, row in sorted(obs.device_time_table().items())
+        }
+        out["compile"] = obs.compile_snapshot()
         out["trace_file"] = trace_path
         # otherData pins the headline (grouped sum-plane) program's counters,
         # not whichever variant's compile reset the live counters last
@@ -302,20 +344,93 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
     return out
 
 
+def _devtime_scenario(steps: int = 3, rows: int = 256) -> None:
+    """Per-metric device-time attribution rows for ``--trace``.
+
+    Drives the eager stateful API (independent members, value-based host
+    gather) for a few steps with devtime fencing on: every ``metric.update``
+    / ``metric.sync_state`` / ``metric.compute`` span gets a ``device_ms``
+    attr, which ``observability.device_time_table()`` folds into the
+    per-metric update/sync/compute table the JSON line reports.
+    """
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, F1, MetricCollection, Precision, Recall
+    from metrics_tpu.parallel.sync import gather_all_arrays
+
+    collection = MetricCollection([
+        Accuracy(dist_sync_fn=gather_all_arrays),
+        F1(num_classes=NUM_CLASSES, average="macro", dist_sync_fn=gather_all_arrays),
+        Precision(num_classes=NUM_CLASSES, average="macro", dist_sync_fn=gather_all_arrays),
+        Recall(num_classes=NUM_CLASSES, average="macro", dist_sync_fn=gather_all_arrays),
+    ], compute_groups=False)
+
+    rng = np.random.RandomState(1)
+    logits = rng.rand(rows, NUM_CLASSES).astype(np.float32)
+    preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+    target = jnp.asarray(rng.randint(0, NUM_CLASSES, rows).astype(np.int32))
+    for _ in range(steps):
+        for _name, metric in collection.items():
+            metric.update(preds, target)
+    collection.compute()
+
+
 def _null_cm():
     import contextlib
 
     return contextlib.nullcontext()
 
 
-def _trace_arg(argv) -> "str | None":
-    """Value of ``--trace OUT.json`` anywhere on the command line, else None."""
-    if "--trace" in argv:
-        i = argv.index("--trace")
+def _flag_value(argv, flag: str) -> "str | None":
+    """Value following ``flag`` anywhere on the command line, else None."""
+    if flag in argv:
+        i = argv.index(flag)
         if i + 1 >= len(argv):
-            raise SystemExit("--trace requires an output path")
+            raise SystemExit(f"{flag} requires a value")
         return argv[i + 1]
     return None
+
+
+def _trace_arg(argv) -> "str | None":
+    """Value of ``--trace OUT.json`` anywhere on the command line, else None."""
+    return _flag_value(argv, "--trace")
+
+
+def check_trajectory_cli(argv) -> int:
+    """``--check-trajectory``: diff current bench numbers against the prior
+    ``BENCH_r*.json`` rounds and exit non-zero on drift beyond the pinned
+    tolerances (``metrics_tpu.observability.regress``).
+
+    Current numbers come from a 2-step smoke A/B with tracing (so the
+    staged-collective counters ride along), or from ``--trajectory-current
+    FILE`` — the injection hook the tier-1 pass/fail pair uses, which also
+    keeps the differ testable without re-measuring. ``--rounds-dir DIR``
+    overrides where the rounds live (default: the bench's own directory).
+    Prints one JSON report line either way.
+    """
+    import tempfile
+
+    from metrics_tpu.observability import regress
+
+    rounds_dir = _flag_value(argv, "--rounds-dir") or _HERE
+    current_file = _flag_value(argv, "--trajectory-current")
+    if current_file is not None:
+        with open(current_file) as f:
+            current = json.load(f)
+    else:
+        fd, tmp = tempfile.mkstemp(suffix="_trajectory_trace.json")
+        os.close(fd)
+        try:
+            current = _sync8_ab(steps=2, warmup=1, trace_path=tmp)
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+    rounds = regress.load_rounds(rounds_dir)
+    report = regress.check_trajectory(current, rounds)
+    print(json.dumps({"check": "trajectory", **report}))
+    return 0 if report["ok"] else 1
 
 
 def _ref_sync8_worker(rank: int, world_size: int, steps: int, out_q) -> None:
@@ -499,6 +614,7 @@ def _metric_description() -> str:
 # extra keys _sync8_ab emits when tracing; the parent copies them verbatim
 # from the child's JSON (full mode) or the in-process dict (smoke mode)
 _TRACE_KEYS = (
+    "trace_schema",
     "collective_calls",
     "sync_bytes",
     "collective_calls_ungrouped",
@@ -510,6 +626,9 @@ _TRACE_KEYS = (
     "counters",
     "gather_counters",
     "phase_ms",
+    "phase_compile_ms",
+    "device_ms",
+    "compile",
     "trace_file",
 )
 
@@ -524,16 +643,94 @@ _TRACE_KEYS = (
 #   program psums one 520-byte int32 bucket (2 Accuracy scalars + 4 (C,)
 #   stat vectors); ungrouped still coalesces into one bucket but moves every
 #   member's copy (14 leaves, 1544 bytes).
-# gather plane (AUROC+AP+Spearman, capacity 2048): coalesced stages one
-#   data + one counts all_gather per dtype bucket (f32 + i32 -> 4 calls);
-#   per-leaf stages 2 per buffer (12). Bytes match: same payload, fewer
-#   round-trips.
+# gather plane (AUROC+AP+Spearman, capacity 2048): coalesced stages ONE
+#   all_gather per dtype bucket (counts bitcast into the data payload:
+#   f32 + i32 -> 2 calls); per-leaf stages 2 per buffer (12). Bytes match:
+#   same payload, fewer round-trips.
+# sharded engines (row-sharded states, the ring / all_to_all programs):
+#   sharded_auroc (binary, capacity 1024) stages 3 ppermutes (the sorted
+#   pack circulating) + 1 coalesced psum; sharded_retrieval (MRR, capacity
+#   1024) stages 4 all_to_alls (idx/preds/target/real regroup) + 3 psums
+#   (overflow count, float total, int count+flag plane).
 EXPECTED_COLLECTIVES = {
     "sum_grouped": {"collective_calls": 1, "sync_bytes": 520},
     "sum_ungrouped": {"collective_calls": 1, "sync_bytes": 1544},
-    "gather_coalesced": {"collective_calls": 4, "sync_bytes": 49176},
+    "gather_coalesced": {"collective_calls": 2, "sync_bytes": 49176},
     "gather_per_leaf": {"collective_calls": 12, "sync_bytes": 49176},
+    "sharded_auroc": {"collective_calls": 4, "sync_bytes": 1548},
+    "sharded_retrieval": {"collective_calls": 7, "sync_bytes": 6672},
 }
+
+
+SHARDED_GATE_CAPACITY = 1024  # rows per sharded-engine gate scenario
+
+
+def _build_sharded_auroc_runner():
+    """(run, states) for the row-sharded binary AUROC ring-engine program.
+
+    ``run(1)`` dispatches ``compute()`` over row-sharded epoch buffers: the
+    first call traces the ring engine's ``shard_map`` program, so the
+    counters then hold its staged collectives (the sorted-pack ppermutes +
+    the coalesced stats psum).
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from metrics_tpu import AUROC
+    from metrics_tpu.parallel import row_sharded
+
+    mesh = Mesh(np.array(jax.devices("cpu")[:N_DEVICES]), ("dp",))
+    metric = AUROC(pos_label=1, capacity=SHARDED_GATE_CAPACITY)
+    metric.device_put(row_sharded(mesh, "dp"))
+    rows = SHARDED_GATE_CAPACITY // 2
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(np.round(rng.rand(rows), 2).astype(np.float32))
+    target = jnp.asarray((rng.rand(rows) > 0.5).astype(np.int32))
+    metric.update(preds, target)
+
+    def run(steps: int) -> float:
+        start = time.perf_counter()
+        for _ in range(steps):
+            metric._computed = None
+            metric.compute()
+        return (time.perf_counter() - start) / steps * 1e3
+
+    return run, len(metric._defaults)
+
+
+def _build_sharded_retrieval_runner():
+    """(run, states) for the row-sharded RetrievalMRR all_to_all program
+    (regroup-by-query exchange + the grouped engine's psums)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from metrics_tpu.parallel import row_sharded
+    from metrics_tpu.retrieval import RetrievalMRR
+
+    mesh = Mesh(np.array(jax.devices("cpu")[:N_DEVICES]), ("dp",))
+    metric = RetrievalMRR(capacity=SHARDED_GATE_CAPACITY)
+    metric.device_put(row_sharded(mesh, "dp"))
+    rows = SHARDED_GATE_CAPACITY // 2
+    rng = np.random.RandomState(0)
+    idx = jnp.asarray(rng.randint(0, 64, rows).astype(np.int32))
+    preds = jnp.asarray(rng.rand(rows).astype(np.float32))
+    target = jnp.asarray((rng.rand(rows) > 0.7).astype(np.int32))
+    metric.update(idx, preds, target)
+
+    def run(steps: int) -> float:
+        start = time.perf_counter()
+        for _ in range(steps):
+            metric._computed = None
+            metric.compute()
+        return (time.perf_counter() - start) / steps * 1e3
+
+    return run, len(metric._defaults)
 
 
 def check_collectives() -> int:
@@ -550,6 +747,8 @@ def check_collectives() -> int:
         "sum_ungrouped": lambda: _build_sync8_runner(False),
         "gather_coalesced": lambda: _build_gather_runner(True),
         "gather_per_leaf": lambda: _build_gather_runner(False),
+        "sharded_auroc": _build_sharded_auroc_runner,
+        "sharded_retrieval": _build_sharded_retrieval_runner,
     }
     obs.enable()
     report, failures = {}, []
@@ -580,6 +779,17 @@ def check_collectives() -> int:
 
 def main() -> None:
     trace_path = _trace_arg(sys.argv)
+    if len(sys.argv) > 1 and sys.argv[1] == "--check-trajectory":
+        # trajectory gate: measuring needs the virtual devices (set before
+        # jax import, same as --smoke); an injected current file does not
+        # touch jax at all
+        if _flag_value(sys.argv, "--trajectory-current") is None:
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={N_DEVICES}"
+            ).strip()
+        raise SystemExit(check_trajectory_cli(sys.argv))
+
     if len(sys.argv) > 1 and sys.argv[1] == "--check-collectives":
         # collective regression gate: jax is not yet imported, so the
         # virtual-device flag can be set in-process (same as --smoke)

@@ -13,6 +13,7 @@ from typing import Any, Dict, List, Optional, Tuple, Union
 
 from metrics_tpu.core.metric import Metric, PureMetric
 from metrics_tpu.observability.counters import record_cache, record_states_synced
+from metrics_tpu.observability.devtime import DEVTIME as _DEVTIME, fence as _fence
 from metrics_tpu.observability.trace import TRACE, span as _span
 from metrics_tpu.parallel.buffer import PaddedBuffer
 
@@ -222,6 +223,67 @@ class MetricCollection(OrderedDict):
                 rm._jit_failed = True
         return rm._run_update_on_state(rm.init_state(), *args, **kw), None
 
+    def _step_sync_shares(self, shared: Dict[str, str]) -> Dict[str, str]:
+        """member -> group representative, for ``dist_sync_on_step`` members
+        whose per-step delta gather can ride ONE host plane per group.
+
+        Group members compute their batch value from the SAME shared delta;
+        with ``dist_sync_on_step`` each member then used to host-gather that
+        identical delta through its own compute — the per-step analogue of
+        the epoch-level redundancy ``_grouped_host_sync`` eliminates.
+        Eligibility mirrors it: the member must sync through the same gather
+        configuration as the group's first eligible member (same
+        ``dist_sync_fn`` identity, same ``process_group``), with no
+        sharded-engine self-sync. Groups with < 2 eligible members keep the
+        per-member path — nothing is saved.
+        """
+        import jax
+
+        multiproc = jax.process_count() > 1
+        by_rep: Dict[str, list] = {}
+        for k, rep in shared.items():
+            m = self[k]
+            if (
+                m.dist_sync_on_step
+                and m.compute_on_step
+                and not m._states_own_sync()
+                and (m.dist_sync_fn is not None or multiproc)
+            ):
+                by_rep.setdefault(rep, []).append(k)
+        out: Dict[str, str] = {}
+        for rep, members in by_rep.items():
+            leader = self[members[0]]
+            share = [
+                k
+                for k in members
+                if self[k].dist_sync_fn is leader.dist_sync_fn
+                and self[k].process_group == leader.process_group
+            ]
+            if len(share) >= 2:
+                out.update({k: rep for k in share})
+        return out
+
+    def _synced_step_delta(
+        self, rep: str, member: str, delta: Any, cache: Dict[str, Any]
+    ) -> Any:
+        """The group's batch delta after ONE shared host-plane gather."""
+        if rep in cache:
+            return cache[rep]
+        from metrics_tpu.parallel.sync import host_gather
+
+        m = self[member]
+        gather_fn = m.dist_sync_fn if m.dist_sync_fn is not None else m._default_gather()
+        record_states_synced(len(m._reductions))
+        if TRACE.enabled:
+            with _span("collection.step_sync", {"group": rep}):
+                synced = host_gather(delta, m._reductions, gather_fn=gather_fn)
+                if _DEVTIME.enabled:
+                    _fence(synced)
+        else:
+            synced = host_gather(delta, m._reductions, gather_fn=gather_fn)
+        cache[rep] = synced
+        return synced
+
     def _forward_eager_grouped(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """Per-member fallback forward with the compute-group delta SHARED.
 
@@ -229,14 +291,17 @@ class MetricCollection(OrderedDict):
         group representative computes the batch delta once, every member
         merges it into its OWN accumulator and computes its batch value
         from the shared delta — including ``dist_sync_on_step`` members
-        (each still syncs its delta through its own compute, so per-member
-        sync semantics are unchanged) and configs whose fingerprint keeps
-        the fused step off. Mirrors ``Metric._forward_fused``'s contract
-        member by member.
+        (sync-compatible group members additionally share ONE per-step
+        delta gather, see ``_step_sync_shares``; members with per-member
+        sync config still sync through their own compute) and configs whose
+        fingerprint keeps the fused step off. Mirrors
+        ``Metric._forward_fused``'s contract member by member.
         """
         shared = self._eager_shared_groups()
+        step_shares = self._step_sync_shares(shared)
         deltas: Dict[str, Any] = {}
         merged_rep: Dict[str, Any] = {}
+        synced_deltas: Dict[str, Any] = {}
         out: Dict[str, Any] = {}
         for k, m in self.items():
             rep = shared.get(k)
@@ -247,6 +312,8 @@ class MetricCollection(OrderedDict):
                 if TRACE.enabled:
                     with _span("collection.group_update", {"group": rep}):
                         delta, merged = self._group_delta(rep, args, kwargs, use_jit=True)
+                        if _DEVTIME.enabled:
+                            _fence(delta)
                 else:
                     delta, merged = self._group_delta(rep, args, kwargs, use_jit=True)
                 deltas[rep] = delta
@@ -263,11 +330,17 @@ class MetricCollection(OrderedDict):
             value = None
             if m.compute_on_step:
                 # the _forward_fused tail: batch value from the shared delta,
-                # with per-member dist_sync_on_step honored by its compute
-                m._to_sync = m.dist_sync_on_step
+                # with per-member dist_sync_on_step honored by its compute —
+                # pre-synced ONCE per group for sync-compatible members
+                if k in step_shares:
+                    value_state = self._synced_step_delta(rep, k, delta, synced_deltas)
+                    m._to_sync = False
+                else:
+                    value_state = delta
+                    m._to_sync = m.dist_sync_on_step
                 m._in_forward = True
                 acc = m._current_state()
-                m._set_state(delta)
+                m._set_state(value_state)
                 try:
                     m._forward_cache = m.compute()
                 finally:
@@ -356,6 +429,8 @@ class MetricCollection(OrderedDict):
             if TRACE.enabled:
                 with _span("collection.fused_step", {"members": len(self)}):
                     new_states, values = step(states, *args, **kwargs)
+                    if _DEVTIME.enabled:
+                        _fence((new_states, values))
             else:
                 new_states, values = step(states, *args, **kwargs)
         except Metric._TRACER_ERRORS:
@@ -466,6 +541,8 @@ class MetricCollection(OrderedDict):
                 if TRACE.enabled:
                     with _span("collection.forward_batched", {"members": len(self)}):
                         new_states, values, epochs = step(states, *args, **kwargs)
+                        if _DEVTIME.enabled:
+                            _fence((new_states, values, epochs))
                 else:
                     new_states, values, epochs = step(states, *args, **kwargs)
             except Metric._TRACER_ERRORS:
@@ -556,6 +633,8 @@ class MetricCollection(OrderedDict):
                 if TRACE.enabled:
                     with _span("collection.group_update", {"group": rep}):
                         deltas[rep], _ = self._group_delta(rep, args, kwargs, use_jit=False)
+                        if _DEVTIME.enabled:
+                            _fence(deltas[rep])
                 else:
                     deltas[rep], _ = self._group_delta(rep, args, kwargs, use_jit=False)
             m._computed = None
@@ -566,7 +645,10 @@ class MetricCollection(OrderedDict):
     def compute(self) -> Dict[str, Any]:
         if TRACE.enabled:
             with _span("collection.compute", {"members": len(self)}):
-                return self._compute_all()
+                out = self._compute_all()
+                if _DEVTIME.enabled:
+                    _fence(out)
+                return out
         return self._compute_all()
 
     def _compute_all(self) -> Dict[str, Any]:
@@ -630,6 +712,8 @@ class MetricCollection(OrderedDict):
             if TRACE.enabled:
                 with _span("collection.host_sync", {"group": rep, "shared": len(share)}):
                     synced = host_gather(src._current_state(), src._reductions, gather_fn=gather_fn)
+                    if _DEVTIME.enabled:
+                        _fence(synced)
             else:
                 synced = host_gather(src._current_state(), src._reductions, gather_fn=gather_fn)
             for k in share:
@@ -749,10 +833,11 @@ class MetricCollection(OrderedDict):
         ALL entries coalesce into per-dtype bucketed collectives (see
         ``parallel.sync.coalesced_sync_state``): one ``psum``/``pmin``/
         ``pmax`` per reduce bucket (``mean`` folds into the sum bucket), one
-        ``all_gather`` per gather bucket, and one data + one counts
-        ``all_gather`` per PaddedBuffer bucket — a buffer-state collection
-        (AUROC + AveragePrecision + Spearman) stages 2 gathers per dtype
-        instead of 2 per buffer."""
+        ``all_gather`` per gather bucket, and ONE ``all_gather`` per
+        PaddedBuffer bucket (counts bitcast into the data payload for
+        4-byte dtypes) — a buffer-state collection (AUROC +
+        AveragePrecision + Spearman) stages 1 gather per dtype instead of
+        2 per buffer."""
         from metrics_tpu.parallel.sync import coalesced_sync_state
 
         flat = {(k, n): v for k, s in state.items() for n, v in s.items()}

@@ -41,6 +41,7 @@ import numpy as np
 from jax import Array
 
 from metrics_tpu.observability.counters import record_cache, record_states_synced
+from metrics_tpu.observability.devtime import DEVTIME as _DEVTIME, fence as _fence
 from metrics_tpu.observability.trace import TRACE, span as _span
 from metrics_tpu.parallel.buffer import PaddedBuffer, buffer_append, buffer_init
 from metrics_tpu.utils import compat, debug
@@ -482,10 +483,11 @@ class Metric(ABC):
         (``parallel.sync.coalesced_sync_state``): sum/min/max leaves share
         one ``psum``/``pmin``/``pmax`` per bucket (``mean`` folds into the
         sum bucket as psum-then-divide), gather-semantics array leaves share
-        one ``all_gather``, and same-dtype PaddedBuffer cat-states share one
-        data + one counts ``all_gather`` per bucket — a multi-state metric
-        like StatScores pays one ``psum``, not four, and a two-buffer curve
-        metric pays 2 gathers, not 4."""
+        one ``all_gather``, and same-dtype PaddedBuffer cat-states share ONE
+        ``all_gather`` per bucket (the counts vector rides inside the data
+        payload for 4-byte dtypes) — a multi-state metric like StatScores
+        pays one ``psum``, not four, and a two-buffer curve metric pays 1
+        gather, not 4."""
         return coalesced_sync_state(state, self._reductions, axis_name)
 
     def pure(self) -> PureMetric:
@@ -623,8 +625,12 @@ class Metric(ABC):
         if TRACE.enabled:
             with _span("metric.forward", {"metric": type(self).__name__}):
                 if self._fusable:
-                    return self._forward_fused(*args, **kwargs)
-                return self._forward_reference(*args, **kwargs)
+                    out = self._forward_fused(*args, **kwargs)
+                else:
+                    out = self._forward_reference(*args, **kwargs)
+                if _DEVTIME.enabled:  # phase fence: charge the device tail here
+                    _fence((out, self._current_state()))
+                return out
         if self._fusable:
             return self._forward_fused(*args, **kwargs)
         return self._forward_reference(*args, **kwargs)
@@ -887,6 +893,8 @@ class Metric(ABC):
         if TRACE.enabled:
             with _span("metric.sync_state", {"metric": type(self).__name__}):
                 synced = host_gather(self._current_state(), self._reductions, gather_fn=gather)
+                if _DEVTIME.enabled:
+                    _fence(synced)
         else:
             synced = host_gather(self._current_state(), self._reductions, gather_fn=gather)
         self._set_state(synced)
@@ -898,7 +906,10 @@ class Metric(ABC):
             self._note_rows(args, kwargs)
             if TRACE.enabled:
                 with _span("metric.update", {"metric": type(self).__name__}):
-                    return update(*args, **kwargs)
+                    out = update(*args, **kwargs)
+                    if _DEVTIME.enabled:  # phase fence on the written states
+                        _fence(self._current_state())
+                    return out
             return update(*args, **kwargs)
 
         return wrapped_func
@@ -976,7 +987,10 @@ class Metric(ABC):
         def wrapped_func(*args: Any, **kwargs: Any) -> Any:
             if TRACE.enabled:
                 with _span("metric.compute", {"metric": type(self).__name__}):
-                    return compute_body(*args, **kwargs)
+                    out = compute_body(*args, **kwargs)
+                    if _DEVTIME.enabled:
+                        _fence(out)
+                    return out
             return compute_body(*args, **kwargs)
 
         def compute_body(*args: Any, **kwargs: Any) -> Any:

@@ -17,6 +17,16 @@ Four layers, all off by default with a zero-allocation disabled path:
 - :mod:`~metrics_tpu.observability.jaxprof` — projects the same phase names
   into ``jax.named_scope`` / ``jax.profiler`` so device timelines carry
   ``metric.update`` / ``metric.sync`` / ``collection.fused_step``.
+- :mod:`~metrics_tpu.observability.compilemon` — XLA compile telemetry via
+  ``jax.monitoring``: compile counts/durations, persistent-cache hit/miss,
+  and per-span ``compiled=yes/no`` + ``compile_ms`` stamping.
+- :mod:`~metrics_tpu.observability.devtime` — per-phase device-time
+  attribution: ``block_until_ready`` fencing stamps spans with
+  ``device_ms``; ``device_time_table()`` folds them into a per-metric
+  update/sync/compute table; profiler-session traces parse back per phase.
+- :mod:`~metrics_tpu.observability.regress` — the bench-trajectory gate:
+  diff current numbers against prior ``BENCH_r*.json`` rounds, fail on
+  latency or collective-count drift (``bench.py --check-trajectory``).
 
 Typical use::
 
@@ -31,9 +41,12 @@ Typical use::
 """
 from typing import Any, Dict
 
+from metrics_tpu.observability import compilemon as _compilemon_mod
 from metrics_tpu.observability import counters as _counters_mod
+from metrics_tpu.observability import devtime as _devtime_mod
 from metrics_tpu.observability import trace as _trace_mod
 from metrics_tpu.observability.counters import COUNTERS, CollectiveCounters
+from metrics_tpu.observability.devtime import device_time_table
 from metrics_tpu.observability.export import (
     chrome_trace,
     summarize,
@@ -42,6 +55,7 @@ from metrics_tpu.observability.export import (
     write_jsonl,
 )
 from metrics_tpu.observability.jaxprof import annotate, start_trace, stop_trace
+from metrics_tpu.observability.regress import check_trajectory, load_rounds
 from metrics_tpu.observability.trace import SpanRecord, TRACE, records, span, traced
 
 __all__ = [
@@ -50,11 +64,15 @@ __all__ = [
     "SpanRecord",
     "TRACE",
     "annotate",
+    "check_trajectory",
     "chrome_trace",
+    "compile_snapshot",
     "counters_snapshot",
+    "device_time_table",
     "disable",
     "enable",
     "is_enabled",
+    "load_rounds",
     "records",
     "reset",
     "span",
@@ -68,17 +86,38 @@ __all__ = [
 ]
 
 
-def enable(spans: bool = True, counters: bool = True) -> None:
-    """Turn observability on (span recording and/or collective counting)."""
+def enable(
+    spans: bool = True,
+    counters: bool = True,
+    compile_events: bool = False,
+    device_time: bool = False,
+) -> None:
+    """Turn observability on.
+
+    ``spans``/``counters`` are the passive layers (record, never perturb).
+    ``compile_events`` additionally captures XLA compile telemetry and
+    stamps every span with ``compiled=yes/no`` + ``compile_ms``
+    (:mod:`~metrics_tpu.observability.compilemon`). ``device_time`` turns
+    on per-phase ``block_until_ready`` fencing so spans carry ``device_ms``
+    (:mod:`~metrics_tpu.observability.devtime`) — a measurement mode that
+    serializes the host/device pipeline; keep it off when timing end-to-end
+    throughput.
+    """
     if spans:
         _trace_mod.enable()
     if counters:
         _counters_mod.enable()
+    if compile_events:
+        _compilemon_mod.enable()
+    if device_time:
+        _devtime_mod.enable()
 
 
 def disable() -> None:
     _trace_mod.disable()
     _counters_mod.disable()
+    _compilemon_mod.disable()
+    _devtime_mod.disable()
 
 
 def is_enabled() -> bool:
@@ -86,10 +125,17 @@ def is_enabled() -> bool:
 
 
 def reset() -> None:
-    """Drop all recorded spans and zero every counter."""
+    """Drop all recorded spans, zero every counter and the compile totals."""
     _trace_mod.clear()
     _counters_mod.reset()
+    _compilemon_mod.reset()
 
 
 def counters_snapshot(reset_after: bool = False) -> Dict[str, Any]:
     return _counters_mod.snapshot(reset_after=reset_after)
+
+
+def compile_snapshot() -> Dict[str, Any]:
+    """XLA compile telemetry: event count, per-phase ms, persistent-cache
+    hit/miss (see :mod:`~metrics_tpu.observability.compilemon`)."""
+    return _compilemon_mod.snapshot()

@@ -1,0 +1,173 @@
+"""XLA compile telemetry via ``jax.monitoring`` events.
+
+Spans around a first (compiling) dispatch conflate trace + compile with the
+steady-state run — the ``phase_ms`` table then reports a "hot path" that is
+mostly one-time compilation. This module closes that gap with the event
+stream jax already emits:
+
+- ``/jax/core/compile/jaxpr_trace_duration`` — abstract tracing,
+- ``/jax/core/compile/jaxpr_to_mlir_module_duration`` — lowering,
+- ``/jax/core/compile/backend_compile_duration`` — the XLA backend compile
+  (fires on persistent-cache retrieval too: an executable was still built
+  for this process),
+- ``/jax/compilation_cache/cache_hits`` / ``cache_misses`` — the persistent
+  compilation cache's verdict per compile request.
+
+Two consumers:
+
+1. **Process snapshot** (:func:`snapshot`): compile event counts, per-phase
+   ms totals, and the persistent-cache hit/miss pair — what ``bench.py
+   --trace`` folds into its JSON line as ``compile``.
+2. **Span stamping**: while enabled, ``trace.COMPILE_PROBE`` points at this
+   module's per-thread accumulator; every finished span diffs it and carries
+   ``compiled=yes/no`` (did a backend compile land inside the span) plus
+   ``compile_ms`` — so first-dispatch spans stop masquerading as run time.
+
+Listener registration is once-per-process and permanent (``jax.monitoring``
+has no per-listener removal, only a global clear that would clobber other
+registrants); the listener bodies gate on ``MONITOR.enabled``, so disabled
+cost is one attribute load per *compile event* — compile events are rare by
+construction, and the per-step replay path emits none.
+"""
+import threading
+from typing import Any, Dict, Tuple
+
+from metrics_tpu.observability import trace as _trace
+
+__all__ = ["MONITOR", "enable", "disable", "is_enabled", "reset", "snapshot"]
+
+_BACKEND_COMPILE = "/jax/core/compile/backend_compile_duration"
+_JAXPR_TRACE = "/jax/core/compile/jaxpr_trace_duration"
+_LOWERING = "/jax/core/compile/jaxpr_to_mlir_module_duration"
+_CACHE_HIT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS = "/jax/compilation_cache/cache_misses"
+
+# duration event -> snapshot column
+_DURATION_COLUMNS = {
+    _JAXPR_TRACE: "trace_ms",
+    _LOWERING: "lowering_ms",
+    _BACKEND_COMPILE: "backend_compile_ms",
+}
+
+
+class _CompileMonitor:
+    """Process-wide compile accounting; ``enabled`` is the hot-path gate."""
+
+    __slots__ = (
+        "enabled",
+        "registered",
+        "compile_events",
+        "ms_totals",
+        "cache_hits",
+        "cache_misses",
+        "_lock",
+        "_tls",
+    )
+
+    def __init__(self) -> None:
+        self.enabled = False
+        self.registered = False
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._zero()
+
+    def _zero(self) -> None:
+        self.compile_events = 0
+        self.ms_totals: Dict[str, float] = {c: 0.0 for c in _DURATION_COLUMNS.values()}
+        self.cache_hits = 0
+        self.cache_misses = 0
+
+    # ------------------------------------------------------------ listeners
+    def _on_event(self, event: str, **_kw: Any) -> None:
+        if not self.enabled:
+            return
+        if event == _CACHE_HIT:
+            with self._lock:
+                self.cache_hits += 1
+        elif event == _CACHE_MISS:
+            with self._lock:
+                self.cache_misses += 1
+
+    def _on_duration(self, event: str, duration_secs: float, **_kw: Any) -> None:
+        if not self.enabled:
+            return
+        column = _DURATION_COLUMNS.get(event)
+        if column is None:
+            return
+        ms = duration_secs * 1e3
+        with self._lock:
+            self.ms_totals[column] += ms
+            if event == _BACKEND_COMPILE:
+                self.compile_events += 1
+        # per-thread accumulator for span stamping: compile phases run in the
+        # dispatching thread, so the probe diff attributes them to the span
+        # open on that thread
+        tls = self._tls
+        tls.compile_ns = getattr(tls, "compile_ns", 0) + int(duration_secs * 1e9)
+        if event == _BACKEND_COMPILE:
+            tls.compile_count = getattr(tls, "compile_count", 0) + 1
+
+    def _probe(self) -> Tuple[int, int]:
+        tls = self._tls
+        return getattr(tls, "compile_count", 0), getattr(tls, "compile_ns", 0)
+
+    def _register(self) -> None:
+        if self.registered:
+            return
+        with self._lock:
+            if self.registered:
+                return
+            import jax.monitoring as monitoring
+
+            monitoring.register_event_listener(self._on_event)
+            monitoring.register_event_duration_secs_listener(self._on_duration)
+            self.registered = True
+
+    # -------------------------------------------------------------- reading
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "compile_events": self.compile_events,
+                "backend_compile_ms": round(self.ms_totals["backend_compile_ms"], 3),
+                "trace_ms": round(self.ms_totals["trace_ms"], 3),
+                "lowering_ms": round(self.ms_totals["lowering_ms"], 3),
+                "compile_cache": {"hits": self.cache_hits, "misses": self.cache_misses},
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._zero()
+
+
+MONITOR = _CompileMonitor()
+
+
+def enable() -> None:
+    """Start capturing compile events and stamping spans with ``compiled=``.
+
+    Idempotent; the ``jax.monitoring`` listeners register once per process
+    and stay registered (gated on ``MONITOR.enabled`` thereafter).
+    """
+    MONITOR._register()
+    MONITOR.enabled = True
+    _trace.COMPILE_PROBE = MONITOR._probe
+
+
+def disable() -> None:
+    MONITOR.enabled = False
+    _trace.COMPILE_PROBE = None
+
+
+def is_enabled() -> bool:
+    return MONITOR.enabled
+
+
+def reset() -> None:
+    """Zero the process totals (per-thread span probes keep their cumulative
+    counts — spans diff them, so absolute values never matter)."""
+    MONITOR.reset()
+
+
+def snapshot() -> Dict[str, Any]:
+    """JSON-ready compile telemetry: event count, per-phase ms, cache pair."""
+    return MONITOR.snapshot()

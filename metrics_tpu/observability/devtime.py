@@ -1,0 +1,202 @@
+"""Per-phase device-time attribution for the metric hot paths.
+
+The span tracer measures host wall time around *dispatches*; on an async
+backend the device keeps executing after the span closes, so ``phase_ms``
+understates real phase cost and smears device work into whichever span
+happens to be open when someone finally blocks. This module attributes real
+device execution to the existing phase names two ways:
+
+1. **Fence mode** (:func:`enable` + the ``fence()`` hooks the instrumented
+   sites already carry): at the end of each phase — ``metric.update``,
+   ``metric.sync_state``, ``metric.compute``, ``collection.*``,
+   ``sharded.launch`` — the site hands its outputs to :func:`fence`, which
+   ``jax.block_until_ready``-s them and charges the post-dispatch wait to
+   the enclosing span as a ``device_ms`` attr. Because every phase fences,
+   the device queue is drained at each phase boundary: device work cannot
+   smear across phases, and ``device_ms`` is exactly the device tail the
+   host had to wait out after dispatch returned. Fencing serializes the
+   host/device pipeline — it is a measurement mode, off by default, a
+   single falsy attribute check when disabled, and a no-op under jax
+   tracing (a tracer cannot be blocked on).
+
+2. **Profiler mode** (:func:`from_profiler_trace`): when a
+   ``jax.profiler`` session wrote a trace dir (``obs.start_trace``), the
+   phase names that :mod:`~metrics_tpu.observability.jaxprof` projected
+   into ``named_scope`` / ``TraceAnnotation`` are parsed back out of the
+   session's Chrome/Perfetto trace files and summed per phase — real
+   device-timeline kernel time, no fencing distortion. Best-effort: absent
+   or proto-only (``.xplane.pb``) sessions yield ``{}``.
+
+:func:`device_time_table` folds the fenced spans into the per-metric,
+per-phase table ``bench.py --trace`` reports as ``device_ms``.
+"""
+import gzip
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from metrics_tpu.observability.trace import SpanRecord, current_span
+from metrics_tpu.observability import trace as _trace
+
+__all__ = [
+    "DEVTIME",
+    "PHASE_OF_SPAN",
+    "device_time_table",
+    "disable",
+    "enable",
+    "fence",
+    "from_profiler_trace",
+    "is_enabled",
+]
+
+# span name -> phase column of the device-time table. The table's schema is
+# exactly the instrumented span vocabulary — tests pin the parity so a new
+# span name cannot silently fall out of the attribution.
+PHASE_OF_SPAN: Dict[str, str] = {
+    "metric.update": "update",
+    "metric.sync_state": "sync",
+    "metric.compute": "compute",
+    "metric.forward": "forward",
+    "collection.group_update": "update",
+    "collection.fused_step": "update",
+    "collection.forward_batched": "update",
+    "collection.host_sync": "sync",
+    "collection.step_sync": "sync",
+    "collection.compute": "compute",
+    "sharded.launch": "engine",
+}
+
+
+class _DevTimeState:
+    """Process-wide fencing switch; ``enabled`` is the hot-path gate."""
+
+    __slots__ = ("enabled",)
+
+    def __init__(self) -> None:
+        self.enabled = False
+
+
+DEVTIME = _DevTimeState()
+
+
+def enable() -> None:
+    """Turn on per-phase fencing (spans gain ``device_ms``; pipeline serializes)."""
+    DEVTIME.enabled = True
+
+
+def disable() -> None:
+    DEVTIME.enabled = False
+
+
+def is_enabled() -> bool:
+    return DEVTIME.enabled
+
+
+def fence(value: Any) -> None:
+    """Block until ``value``'s arrays are device-ready; charge the wait to
+    the innermost open span as ``device_ms``.
+
+    Call at the END of a phase, inside its span, with the phase's outputs
+    (any pytree; non-array leaves pass through). No-op while disabled and
+    under jax tracing — the instrumented sites run at trace time inside
+    jitted programs, where there is nothing concrete to block on.
+    """
+    if not DEVTIME.enabled:
+        return
+    from metrics_tpu.utils import compat
+
+    if compat.under_trace():
+        return
+    import jax
+
+    start_ns = time.perf_counter_ns()
+    jax.block_until_ready(value)
+    waited_ms = (time.perf_counter_ns() - start_ns) / 1e6
+    span = current_span()
+    if span is not None:
+        span.note("device_ms", waited_ms)
+
+
+def device_time_table(
+    records: Optional[List[SpanRecord]] = None,
+) -> Dict[str, Dict[str, float]]:
+    """Fold fenced spans into ``{metric: {phase: device_ms}}``.
+
+    Rows come from spans carrying a ``device_ms`` attr (only fence mode
+    produces them); the row key is the span's ``metric`` attr (``group``
+    for collection group updates, the span name itself otherwise), the
+    column is :data:`PHASE_OF_SPAN`'s mapping of the span name.
+    """
+    if records is None:
+        records = _trace.records()
+    table: Dict[str, Dict[str, float]] = {}
+    for rec in records:
+        attrs = rec.attrs
+        if not attrs:
+            continue
+        device_ms = attrs.get("device_ms")
+        if device_ms is None:
+            continue
+        phase = PHASE_OF_SPAN.get(rec.name, rec.name)
+        label = attrs.get("metric") or attrs.get("group")
+        if label is None:
+            label = "collection" if rec.name.startswith("collection.") else rec.name
+        row = table.setdefault(str(label), {})
+        row[phase] = row.get(phase, 0.0) + device_ms
+    return table
+
+
+# ------------------------------------------------- profiler-session parsing
+def _iter_trace_files(log_dir: str):
+    """Chrome/Perfetto JSON trace files under a ``jax.profiler`` log dir."""
+    for root, _dirs, files in os.walk(log_dir):
+        for name in files:
+            if name.endswith((".trace.json", ".trace.json.gz")) or name in (
+                "perfetto_trace.json.gz",
+                "perfetto_trace.json",
+            ):
+                yield os.path.join(root, name)
+
+
+def _load_trace_events(path: str) -> List[Dict[str, Any]]:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        return doc.get("traceEvents", [])
+    return doc if isinstance(doc, list) else []
+
+
+def from_profiler_trace(log_dir: str) -> Dict[str, float]:
+    """Sum device-timeline time per projected phase name from a profiler dir.
+
+    Scans ``log_dir`` for Chrome/Perfetto JSON traces a ``jax.profiler``
+    session wrote, and totals the duration of complete events whose name
+    contains one of the :data:`PHASE_OF_SPAN` names (or the
+    ``metric.sync`` / ``sharded.engine`` scopes ``jaxprof.annotate``
+    projects into XLA metadata). Returns ``{phase name: ms}``; an absent,
+    empty, or proto-only session yields ``{}`` — callers treat the fenced
+    table as the primary source and this as corroboration.
+    """
+    known = sorted({*PHASE_OF_SPAN, "metric.sync", "sharded.engine"}, key=len, reverse=True)
+    totals: Dict[str, float] = {}
+    if not os.path.isdir(log_dir):
+        return totals
+    for path in _iter_trace_files(log_dir):
+        try:
+            events = _load_trace_events(path)
+        except (OSError, ValueError):
+            continue
+        for event in events:
+            if event.get("ph") != "X":
+                continue
+            name = event.get("name")
+            dur_us = event.get("dur")
+            if not isinstance(name, str) or not isinstance(dur_us, (int, float)):
+                continue
+            for phase in known:
+                if phase in name:
+                    totals[phase] = totals.get(phase, 0.0) + dur_us / 1e3
+                    break
+    return totals

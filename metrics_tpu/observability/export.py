@@ -27,20 +27,37 @@ __all__ = ["summarize", "to_trace_events", "chrome_trace", "write_chrome_trace",
 
 
 def summarize(records: Optional[List[SpanRecord]] = None) -> Dict[str, Dict[str, Any]]:
-    """Aggregate spans by name: {name: {count, total_ms, mean_ms, min_ms, max_ms}}."""
+    """Aggregate spans by name: {name: {count, total_ms, mean_ms, min_ms,
+    max_ms, compile_ms, device_ms}}.
+
+    ``compile_ms`` sums the XLA compile time stamped by
+    :mod:`~metrics_tpu.observability.compilemon`; ``device_ms`` sums the
+    fenced device waits stamped by
+    :mod:`~metrics_tpu.observability.devtime`. Both columns are always
+    present (0.0 when the corresponding monitor never ran) so the table
+    schema is stable; the hot path is untouched — the attrs are stamped at
+    span close only while those monitors are enabled, and this aggregation
+    runs post-hoc.
+    """
     if records is None:
         records = _trace.records()
     table: Dict[str, Dict[str, Any]] = {}
     for rec in records:
         ms = rec.duration_ms
+        attrs = rec.attrs or {}
         row = table.get(rec.name)
         if row is None:
-            table[rec.name] = {"count": 1, "total_ms": ms, "min_ms": ms, "max_ms": ms}
+            row = table[rec.name] = {
+                "count": 1, "total_ms": ms, "min_ms": ms, "max_ms": ms,
+                "compile_ms": 0.0, "device_ms": 0.0,
+            }
         else:
             row["count"] += 1
             row["total_ms"] += ms
             row["min_ms"] = min(row["min_ms"], ms)
             row["max_ms"] = max(row["max_ms"], ms)
+        row["compile_ms"] += attrs.get("compile_ms", 0.0)
+        row["device_ms"] += attrs.get("device_ms", 0.0)
     for row in table.values():
         row["mean_ms"] = row["total_ms"] / row["count"]
     return table

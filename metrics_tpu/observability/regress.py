@@ -1,0 +1,167 @@
+"""Bench-trajectory regression gate: diff the current bench numbers against
+prior ``BENCH_r*.json`` rounds and fail on drift beyond pinned tolerances.
+
+The repo's bench rounds (``BENCH_r01.json`` .. ``BENCH_rNN.json``, one per
+growth PR) were until now a log humans eyeballed; this module makes the
+trajectory a first-class regression surface with two gate classes:
+
+- **Phase latency** (``*_ms`` keys): the current value may not exceed the
+  BEST prior round's value by more than ``ms_ratio`` AND ``ms_slack_ms``
+  (both must be exceeded — sub-millisecond phases are timing noise, never
+  gated on ratio alone). Best-of-prior is the right baseline for a
+  monotonically-optimized trajectory: regressing to round-3 performance is
+  a failure even if round-1 was slower still.
+- **Collective counts / bytes** (integer keys from the staged-program
+  counters): exact, deterministic numbers — ANY growth over the most recent
+  round that carries the key fails. A shrink reports ``improved`` (re-pin
+  by letting the next BENCH round record it).
+
+Rounds predating a key (older schemas) simply don't constrain it, so the
+gate tightens as the trajectory grows instead of blocking schema evolution.
+``bench.py --check-trajectory`` wires this into CI.
+"""
+import glob
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "COUNT_KEYS",
+    "MS_KEYS",
+    "TOLERANCES",
+    "check_trajectory",
+    "load_rounds",
+]
+
+# phase-latency keys gated by ratio + absolute slack over the best prior
+# round. The headline "value" is deliberately NOT gated: its meaning changed
+# across schema generations (round 1 measured the single-chip marginal, later
+# rounds the 8-device sync step), so only the unambiguous named keys bind.
+MS_KEYS: Tuple[str, ...] = (
+    "grouped_sync8_ms",
+    "ungrouped_sync8_ms",
+    "gather_coalesced_ms",
+    "gather_per_leaf_ms",
+)
+
+# staged-collective keys gated exactly (no growth) vs the latest prior round
+COUNT_KEYS: Tuple[str, ...] = (
+    "collective_calls",
+    "sync_bytes",
+    "collective_calls_ungrouped",
+    "sync_bytes_ungrouped",
+    "gather_collective_calls",
+    "gather_sync_bytes",
+    "gather_collective_calls_per_leaf",
+    "gather_sync_bytes_per_leaf",
+    "states_synced",
+    "states_synced_ungrouped",
+    "gather_states_synced",
+)
+
+TOLERANCES: Dict[str, float] = {
+    # both thresholds must be exceeded to fail a ms key: 2.5x the best prior
+    # round AND at least 2 ms absolute — smoke-mode timings (2 steps) are
+    # noisy, staged counts are the precise gate; ms only catches blowups
+    "ms_ratio": 2.5,
+    "ms_slack_ms": 2.0,
+}
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def load_rounds(rounds_dir: str) -> List[Dict[str, Any]]:
+    """Prior bench rounds as ``[{"n": int, "parsed": {...}}, ...]``, sorted.
+
+    Each ``BENCH_r*.json`` carries the bench's printed JSON line under
+    ``parsed`` (the driver's recording format); files without a parseable
+    ``parsed`` dict are skipped, never fatal — a gate that cannot read one
+    historical round must not fail every future run.
+    """
+    rounds = []
+    for path in sorted(glob.glob(os.path.join(rounds_dir, "BENCH_r*.json"))):
+        match = _ROUND_RE.search(os.path.basename(path))
+        if not match:
+            continue
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = doc.get("parsed") if isinstance(doc, dict) else None
+        if isinstance(parsed, dict):
+            rounds.append({"n": int(match.group(1)), "parsed": parsed})
+    rounds.sort(key=lambda r: r["n"])
+    return rounds
+
+
+def _prior_values(rounds: List[Dict[str, Any]], key: str) -> List[Tuple[int, float]]:
+    out = []
+    for rnd in rounds:
+        value = rnd["parsed"].get(key)
+        if isinstance(value, (int, float)) and not isinstance(value, bool):
+            out.append((rnd["n"], float(value)))
+    return out
+
+
+def check_trajectory(
+    current: Dict[str, Any],
+    rounds: List[Dict[str, Any]],
+    tolerances: Optional[Dict[str, float]] = None,
+) -> Dict[str, Any]:
+    """Diff ``current`` bench numbers against prior rounds.
+
+    Returns ``{"ok", "failures", "checks", "rounds_compared"}``; every
+    gated key gets a row in ``checks`` with its baseline, the baseline's
+    round, and a status in ``{"ok", "improved", "regression",
+    "no-baseline", "missing"}``. Only ``"regression"`` rows land in
+    ``failures``.
+    """
+    tol = dict(TOLERANCES)
+    if tolerances:
+        tol.update(tolerances)
+    checks: Dict[str, Any] = {}
+    failures: List[str] = []
+
+    for key in MS_KEYS:
+        priors = _prior_values(rounds, key)
+        got = current.get(key)
+        if not priors or not isinstance(got, (int, float)):
+            checks[key] = {"status": "no-baseline" if not priors else "missing"}
+            continue
+        best_round, best = min(priors, key=lambda p: p[1])
+        row = {"current": got, "baseline": best, "baseline_round": best_round, "kind": "ms"}
+        if got > best * tol["ms_ratio"] and got - best > tol["ms_slack_ms"]:
+            row["status"] = "regression"
+            failures.append(
+                f"{key}: {got:.4g} ms > {tol['ms_ratio']}x best prior"
+                f" {best:.4g} ms (round {best_round})"
+            )
+        else:
+            row["status"] = "ok"
+        checks[key] = row
+
+    for key in COUNT_KEYS:
+        priors = _prior_values(rounds, key)
+        got = current.get(key)
+        if not priors or not isinstance(got, (int, float)):
+            checks[key] = {"status": "no-baseline" if not priors else "missing"}
+            continue
+        last_round, last = priors[-1]  # most recent round carrying the key
+        row = {"current": got, "baseline": last, "baseline_round": last_round, "kind": "count"}
+        if got > last:
+            row["status"] = "regression"
+            failures.append(f"{key}: {got} > pinned {last} (round {last_round})")
+        elif got < last:
+            row["status"] = "improved"
+        else:
+            row["status"] = "ok"
+        checks[key] = row
+
+    return {
+        "ok": not failures,
+        "failures": failures,
+        "checks": checks,
+        "rounds_compared": [r["n"] for r in rounds],
+    }

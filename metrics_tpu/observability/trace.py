@@ -19,7 +19,12 @@ Design constraints, in order:
 A span records host wall time. Spans around jit-compiled work measure the
 dispatch (and, on the first call, trace+compile); device execution time lives
 in the device timeline — use :mod:`metrics_tpu.observability.jaxprof` to
-project the same phase names into ``jax.profiler`` traces.
+project the same phase names into ``jax.profiler`` traces, or
+:mod:`metrics_tpu.observability.devtime` to fence phases and stamp spans with
+``device_ms``. With :mod:`metrics_tpu.observability.compilemon` enabled, every
+finished span additionally carries ``compiled=yes/no`` (did an XLA backend
+compile land inside it) and, when yes, ``compile_ms`` — splitting first-call
+trace+compile spans from steady-state dispatch spans.
 """
 import functools
 import threading
@@ -29,6 +34,7 @@ from typing import Any, Callable, Dict, List, NamedTuple, Optional
 __all__ = [
     "SpanRecord",
     "TRACE",
+    "current_span",
     "enable",
     "disable",
     "is_enabled",
@@ -116,29 +122,64 @@ class _NullSpan:
 
 _NULL_SPAN = _NullSpan()
 
+# Set by observability.compilemon while compile monitoring is on: a zero-arg
+# callable returning this thread's cumulative (backend_compile_count,
+# compile_ns). Spans snapshot it on entry and diff on exit to stamp
+# ``compiled=yes/no`` + ``compile_ms``. None keeps spans exactly as before
+# (attrs untouched), so plain tracing pays nothing for the feature.
+COMPILE_PROBE: Optional[Callable[[], tuple]] = None
+
 
 class _Span:
     """An open span; created only while tracing is enabled."""
 
-    __slots__ = ("name", "attrs", "_start_ns", "_depth", "_parent")
+    __slots__ = ("name", "attrs", "_start_ns", "_depth", "_parent", "_compile0")
 
     def __init__(self, name: str, attrs: Optional[Dict[str, Any]]) -> None:
         self.name = name
         self.attrs = attrs
 
+    def note(self, key: str, ms: float) -> None:
+        """Accumulate a float attr on this (still-open) span.
+
+        The device-time fence (:mod:`~metrics_tpu.observability.devtime`)
+        uses this to charge post-dispatch device waits to the innermost
+        enclosing phase span.
+        """
+        attrs = self.attrs
+        if attrs is None:
+            attrs = self.attrs = {}
+        attrs[key] = attrs.get(key, 0.0) + ms
+
     def __enter__(self) -> "_Span":
         stack = TRACE._thread_stack()
         self._depth = len(stack)
-        self._parent = stack[-1] if stack else None
-        stack.append(self.name)
+        self._parent = stack[-1].name if stack else None
+        stack.append(self)
+        probe = COMPILE_PROBE
+        self._compile0 = probe() if probe is not None else None
         self._start_ns = time.perf_counter_ns()
         return self
 
     def __exit__(self, *exc: Any) -> bool:
         end_ns = time.perf_counter_ns()
         stack = TRACE._thread_stack()
-        if stack and stack[-1] == self.name:
+        if stack and stack[-1] is self:
             stack.pop()
+        if self._compile0 is not None:
+            probe = COMPILE_PROBE
+            if probe is not None:
+                count0, ns0 = self._compile0
+                count1, ns1 = probe()
+                attrs = self.attrs
+                if attrs is None:
+                    attrs = self.attrs = {}
+                # "compiled" means an XLA executable was built inside this
+                # span (backend compile, persistent-cache retrieval included);
+                # compile_ms adds the trace + lowering time of the window
+                attrs.setdefault("compiled", "yes" if count1 > count0 else "no")
+                if ns1 > ns0:
+                    attrs["compile_ms"] = attrs.get("compile_ms", 0.0) + (ns1 - ns0) / 1e6
         TRACE._thread_buffer().append(
             SpanRecord(
                 self.name,
@@ -151,6 +192,12 @@ class _Span:
             )
         )
         return False
+
+
+def current_span() -> Optional[_Span]:
+    """The innermost OPEN span on this thread, or None (devtime stamps it)."""
+    stack = getattr(TRACE._tls, "stack", None)
+    return stack[-1] if stack else None
 
 
 def span(name: str, attrs: Optional[Dict[str, Any]] = None):

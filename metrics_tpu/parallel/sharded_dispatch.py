@@ -28,6 +28,7 @@ from jax import Array
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from metrics_tpu.observability.counters import record_cache
+from metrics_tpu.observability.devtime import DEVTIME as _DEVTIME, fence as _fence
 from metrics_tpu.observability.jaxprof import annotate
 from metrics_tpu.observability.trace import TRACE, span as _span
 from metrics_tpu.parallel.buffer import PaddedBuffer
@@ -148,7 +149,10 @@ def _launch(
         _bounded_insert(_LAUNCH_CACHE, full_key, fn, _LAUNCH_CACHE_MAX)
     if TRACE.enabled:
         with _span("sharded.launch", {"key": str(key[1]) if isinstance(key, tuple) and len(key) > 1 else str(key)}):
-            return fn(count, *datas)
+            out = fn(count, *datas)
+            if _DEVTIME.enabled:  # phase fence: the engine's device time lands here
+                _fence(out)
+            return out
     return fn(count, *datas)
 
 

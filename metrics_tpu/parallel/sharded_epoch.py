@@ -43,6 +43,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.observability.counters import record_collective
+from metrics_tpu.observability.jaxprof import annotate
 from metrics_tpu.utils.compat import axis_size, ensure_varying
 
 # pad query id for regroup ghost rows; real query ids must not use it
@@ -107,9 +108,12 @@ def _ring_stats_cols(
     # one ppermute of the 3-leaf pack staged per loop body (n-1 executed hops)
     for leaf in pack:
         record_collective("ppermute", leaf)
-    # local contribution first, then n-1 ring hops (no dead final collective)
-    acc = jax.vmap(_below_tie_ge)(pack, preds_cm)
-    (acc, _) = jax.lax.fori_loop(0, n - 1, body, (acc, pack))
+    # local contribution first, then n-1 ring hops (no dead final collective);
+    # the named scope labels the ring's ops on the device timeline so a
+    # profiler session attributes the hop kernels to the engine phase
+    with annotate("sharded.engine.ring"):
+        acc = jax.vmap(_below_tie_ge)(pack, preds_cm)
+        (acc, _) = jax.lax.fori_loop(0, n - 1, body, (acc, pack))
     return acc
 
 
@@ -453,10 +457,13 @@ def regroup_by_query(
     def ex(x):
         record_collective("all_to_all", x)
         return jax.lax.all_to_all(x, axis_name=axis_name, split_axis=0, concat_axis=0, tiled=True)
-    my_idx = ex(bucket_idx).reshape(-1)
-    my_preds = ex(bucket_preds).reshape(-1)
-    my_target = ex(bucket_target).reshape(-1)
-    my_real = ex(bucket_real).reshape(-1)
+    # regroup exchange labeled for the device timeline (profiler sessions
+    # attribute the all_to_all kernels to the engine phase by this scope)
+    with annotate("sharded.engine.regroup"):
+        my_idx = ex(bucket_idx).reshape(-1)
+        my_preds = ex(bucket_preds).reshape(-1)
+        my_target = ex(bucket_target).reshape(-1)
+        my_real = ex(bucket_real).reshape(-1)
 
     overflow = jnp.sum(jnp.maximum(counts - capacity, 0))
     record_collective("psum", overflow)

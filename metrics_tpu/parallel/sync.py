@@ -220,9 +220,15 @@ def coalesced_sync_state(
       reduction, callables included.
     - **Buffer plane** (:class:`PaddedBuffer` cat-states): same-dtype
       buffers ravel their ``(capacity, *item)`` rows into one concatenated
-      payload gathered with ONE ``all_gather``, plus ONE for the stacked
-      counts vector — 2 collectives per dtype bucket instead of 2 per
-      buffer. Each buffer's slice then runs the ordinary compaction
+      payload gathered with ONE ``all_gather`` — and for 4-byte bucket
+      dtypes the int32 counts vector rides INSIDE that payload (bitcast to
+      the bucket dtype, appended after the data, bitcast back after the
+      gather), so the whole bucket stages a single collective. The bitcast
+      is a pure reinterpretation and ``all_gather`` is data movement (no
+      arithmetic, no canonicalization), so counts round-trip bit-exactly.
+      Non-4-byte bucket dtypes (bool, f16, f64) keep the separate counts
+      gather — 2 collectives per bucket, still never 2 per buffer. Each
+      buffer's slice then runs the ordinary compaction
       (``buffer_compact_gathered``'s prefix-sum scatter) on its view, so
       results are bit-identical to per-buffer :func:`buffer_all_gather`.
 
@@ -302,10 +308,23 @@ def coalesced_sync_state(
                 continue
             flat = jnp.concatenate([jnp.ravel(state[n].data) for n in names])
             counts = jnp.stack([state[n].count for n in names])  # (n buffers,)
-            record_collective("coalesced_gather", flat)
-            record_collective("coalesced_gather", counts)
-            g_data = jax.lax.all_gather(flat, axis_name)  # (W, sum of data sizes)
-            g_counts = jax.lax.all_gather(counts, axis_name)  # (W, n buffers)
+            bucket_dtype = jnp.dtype(flat.dtype)
+            if bucket_dtype.itemsize == 4 and jnp.dtype(counts.dtype).itemsize == 4:
+                # counts ride the data payload: ONE all_gather per bucket
+                payload = jnp.concatenate(
+                    [flat, jax.lax.bitcast_convert_type(counts, bucket_dtype)]
+                )
+                record_collective("coalesced_gather", payload)
+                gathered = jax.lax.all_gather(payload, axis_name)
+                g_data = gathered[:, : flat.size]  # (W, sum of data sizes)
+                g_counts = jax.lax.bitcast_convert_type(
+                    gathered[:, flat.size:], counts.dtype
+                )  # (W, n buffers)
+            else:
+                record_collective("coalesced_gather", flat)
+                record_collective("coalesced_gather", counts)
+                g_data = jax.lax.all_gather(flat, axis_name)  # (W, sum of data sizes)
+                g_counts = jax.lax.all_gather(counts, axis_name)  # (W, n buffers)
             offset = 0
             for i, n in enumerate(names):
                 buf = state[n]

@@ -1,0 +1,234 @@
+"""Device-time attribution layer: compile telemetry, devtime fencing, tables.
+
+The contract under test, in order of importance:
+
+1. Compile telemetry (``observability.compilemon``) captures ``jax.monitoring``
+   compile events — a fresh jit compiles (events grow), a cache-hit replay
+   does not — and the persistent compilation cache's hit/miss verdicts are
+   counted when the cache is configured.
+2. Span stamping: with compile monitoring on, every finished span carries
+   ``compiled=yes/no``; a span enclosing a first (compiling) dispatch says
+   yes with ``compile_ms`` > 0, a steady-state span says no. With it off,
+   span attrs are untouched (the pre-existing contract).
+3. Devtime fencing (``observability.devtime``) stamps fenced phase spans
+   with ``device_ms``, folds them into the per-metric update/sync/compute
+   table, and its phase schema stays in parity with the instrumented span
+   vocabulary.
+4. ``summarize()`` carries the new ``compile_ms`` / ``device_ms`` columns,
+   and the disabled path stays a structural no-op (the singleton span).
+5. The profiler-session parser recovers per-phase device totals from a
+   Chrome/Perfetto JSON trace dir, and degrades to ``{}`` gracefully.
+"""
+import gzip
+import json
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu import Accuracy
+from metrics_tpu import observability as obs
+from metrics_tpu.observability import compilemon, devtime
+from metrics_tpu.observability import trace as obs_trace
+from metrics_tpu.parallel.sync import gather_all_arrays
+
+
+@pytest.fixture(autouse=True)
+def _clean_observability():
+    obs.disable()
+    obs.reset()
+    yield
+    obs.disable()
+    obs.reset()
+
+
+# ------------------------------------------------------------ compile events
+def test_compile_events_captured_under_jit_cache_hit_and_miss():
+    """A fresh jit trace+compile grows the compile counters; replaying the
+    compiled program (the in-memory executable cache hit) does not."""
+    compilemon.enable()
+    compilemon.reset()
+    try:
+        fn = jax.jit(lambda x: jnp.sin(x) * 3 + 1)
+        fn(jnp.ones(17)).block_until_ready()  # miss: trace + lower + compile
+        first = compilemon.snapshot()
+        assert first["compile_events"] >= 1
+        assert first["backend_compile_ms"] > 0
+        assert first["trace_ms"] > 0
+
+        fn(jnp.ones(17)).block_until_ready()  # hit: straight to the executable
+        second = compilemon.snapshot()
+        assert second["compile_events"] == first["compile_events"]
+        assert second["backend_compile_ms"] == first["backend_compile_ms"]
+    finally:
+        compilemon.disable()
+
+
+def test_persistent_cache_hit_miss_counted():
+    """With the persistent compilation cache configured, the first compile
+    records a cache miss and a post-``clear_caches`` recompile records a hit
+    (the executable comes back from disk)."""
+    saved = (
+        jax.config.jax_compilation_cache_dir,
+        jax.config.jax_persistent_cache_min_compile_time_secs,
+        jax.config.jax_persistent_cache_min_entry_size_bytes,
+    )
+    cache_dir = tempfile.mkdtemp(prefix="mtpu_compile_cache_")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    compilemon.enable()
+    compilemon.reset()
+    try:
+        jax.jit(lambda x: jnp.tan(x) * 19)(jnp.ones(29)).block_until_ready()
+        miss_snap = compilemon.snapshot()
+        assert miss_snap["compile_cache"]["misses"] >= 1
+
+        jax.clear_caches()  # drop the in-memory executables, keep the disk cache
+        jax.jit(lambda x: jnp.tan(x) * 19)(jnp.ones(29)).block_until_ready()
+        hit_snap = compilemon.snapshot()
+        assert hit_snap["compile_cache"]["hits"] >= 1
+    finally:
+        compilemon.disable()
+        jax.config.update("jax_compilation_cache_dir", saved[0])
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", saved[1])
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", saved[2])
+
+
+def test_spans_stamped_compiled_yes_no():
+    """A span around a first (compiling) dispatch carries compiled=yes +
+    compile_ms; a span around the cached replay carries compiled=no."""
+    obs.enable(compile_events=True)
+    fn = jax.jit(lambda x: jnp.exp(x) - 5)
+    with obs.span("first.dispatch"):
+        fn(jnp.ones(23)).block_until_ready()
+    with obs.span("steady.dispatch"):
+        fn(jnp.ones(23)).block_until_ready()
+    by_name = {r.name: r for r in obs.records()}
+    first = by_name["first.dispatch"].attrs
+    assert first["compiled"] == "yes"
+    assert first["compile_ms"] > 0
+    assert by_name["steady.dispatch"].attrs["compiled"] == "no"
+
+
+def test_spans_unstamped_without_compile_monitoring():
+    """Plain tracing leaves attrs exactly as passed (the PR 2 contract)."""
+    obs.enable()
+    fn = jax.jit(lambda x: jnp.log1p(x) * 7)
+    with obs.span("plain", {"k": "v"}):
+        fn(jnp.ones(19)).block_until_ready()
+    (rec,) = obs.records()
+    assert rec.attrs == {"k": "v"}
+
+
+# ------------------------------------------------------------ devtime fencing
+def _fenced_metric_scenario():
+    """One update + one synced compute with fencing on; returns the records."""
+    obs.enable(device_time=True)
+    metric = Accuracy(dist_sync_fn=gather_all_arrays)
+    metric.update(jnp.array([1, 0, 1, 1]), jnp.array([1, 1, 0, 1]))
+    metric.compute()
+    return obs.records()
+
+
+def test_fence_stamps_device_ms_on_phase_spans():
+    records = _fenced_metric_scenario()
+    stamped = {r.name for r in records if r.attrs and "device_ms" in r.attrs}
+    assert {"metric.update", "metric.sync_state", "metric.compute"} <= stamped
+    for rec in records:
+        if rec.attrs and "device_ms" in rec.attrs:
+            assert rec.attrs["device_ms"] >= 0
+            # the fenced wait is part of the span: device_ms cannot exceed
+            # the span's own wall time
+            assert rec.attrs["device_ms"] <= rec.duration_ms + 1e-6
+
+
+def test_device_time_table_per_metric_phases():
+    records = _fenced_metric_scenario()
+    table = devtime.device_time_table(records)
+    assert {"update", "sync", "compute"} <= set(table["Accuracy"])
+    assert all(ms >= 0 for ms in table["Accuracy"].values())
+    # every table column is a known phase of the span vocabulary
+    known_phases = set(devtime.PHASE_OF_SPAN.values())
+    for row in table.values():
+        assert set(row) <= known_phases
+
+
+def test_devtime_schema_parity_with_span_names():
+    """Every instrumented phase span that can fence has a table column —
+    a new span name must be added to PHASE_OF_SPAN or it silently falls
+    out of the attribution."""
+    instrumented = {
+        "metric.update",
+        "metric.sync_state",
+        "metric.compute",
+        "metric.forward",
+        "collection.group_update",
+        "collection.fused_step",
+        "collection.forward_batched",
+        "collection.host_sync",
+        "collection.step_sync",
+        "collection.compute",
+        "sharded.launch",
+    }
+    assert instrumented <= set(devtime.PHASE_OF_SPAN)
+    assert set(devtime.PHASE_OF_SPAN.values()) == {
+        "update", "sync", "compute", "forward", "engine"
+    }
+
+
+def test_fence_disabled_is_noop_and_span_singleton_preserved():
+    # fencing off: spans record but carry no device_ms
+    obs.enable()
+    metric = Accuracy()
+    metric.update(jnp.array([1, 0]), jnp.array([1, 1]))
+    assert all(not (r.attrs and "device_ms" in r.attrs) for r in obs.records())
+    obs.disable()
+    obs.reset()
+    # the zero-allocation disabled contract is untouched by the new layers
+    assert obs.span("a") is obs.span("b")
+    assert obs.span("a") is obs_trace._NULL_SPAN
+    devtime.fence(jnp.ones(3))  # disabled: no span, no error, nothing recorded
+    assert obs.records() == []
+
+
+# ------------------------------------------------------------------ summarize
+def test_summarize_gains_compile_and_device_columns():
+    records = _fenced_metric_scenario()
+    table = obs.summarize(records)
+    for row in table.values():
+        assert "compile_ms" in row and "device_ms" in row
+    assert table["metric.update"]["device_ms"] >= 0
+    # names without stamps keep zero-valued columns (stable schema)
+    obs.reset()
+    with obs.span("bare"):
+        pass
+    bare = obs.summarize()["bare"]
+    assert bare["compile_ms"] == 0.0 and bare["device_ms"] == 0.0
+
+
+# ------------------------------------------------- profiler-session parsing
+def test_from_profiler_trace_parses_chrome_json(tmp_path):
+    run_dir = tmp_path / "plugins" / "profile" / "run1"
+    run_dir.mkdir(parents=True)
+    events = {
+        "traceEvents": [
+            {"ph": "X", "name": "jit(step)/metric.sync/psum", "ts": 0, "dur": 1500.0},
+            {"ph": "X", "name": "metric.sync", "ts": 10, "dur": 500.0},
+            {"ph": "X", "name": "sharded.engine.ring/ppermute", "ts": 20, "dur": 2000.0},
+            {"ph": "X", "name": "unrelated.kernel", "ts": 30, "dur": 9000.0},
+            {"ph": "M", "name": "thread_name"},
+        ]
+    }
+    with gzip.open(run_dir / "perfetto_trace.json.gz", "wt") as f:
+        json.dump(events, f)
+    totals = devtime.from_profiler_trace(str(tmp_path))
+    assert totals["metric.sync"] == pytest.approx(2.0)  # 1500 + 500 us
+    assert totals["sharded.engine"] == pytest.approx(2.0)
+    assert "unrelated.kernel" not in totals
+
+
+def test_from_profiler_trace_missing_dir_is_empty(tmp_path):
+    assert devtime.from_profiler_trace(str(tmp_path / "nope")) == {}
+    assert devtime.from_profiler_trace(str(tmp_path)) == {}

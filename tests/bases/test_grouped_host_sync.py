@@ -177,6 +177,88 @@ def test_escape_hatch_disables_sharing():
     assert gather.calls == 14
 
 
+# ------------------------------------------------- per-step delta sync sharing
+def _on_step_collection(gather, compute_groups=True):
+    """The dist_sync_on_step shape: every member syncs its delta per forward."""
+    return MetricCollection(
+        [
+            Accuracy(dist_sync_on_step=True, dist_sync_fn=gather),
+            F1(num_classes=4, average="macro", dist_sync_on_step=True, dist_sync_fn=gather),
+            Precision(num_classes=4, average="macro", dist_sync_on_step=True, dist_sync_fn=gather),
+            Recall(num_classes=4, average="macro", dist_sync_on_step=True, dist_sync_fn=gather),
+        ],
+        compute_groups=compute_groups,
+    )
+
+
+def test_per_step_delta_sync_shares_one_gather_per_group():
+    """``dist_sync_on_step`` compute-group members share ONE delta gather per
+    step: the group's batch delta is identical by construction, so gathering
+    it through each member's compute moved the same payload N times. Values
+    must be bit-identical to the fully-independent path."""
+    rng = np.random.RandomState(21)
+    preds, target = _data(rng)
+
+    grouped_gather, ungrouped_gather = _CountingGather(), _CountingGather()
+    grouped = _on_step_collection(grouped_gather)
+    ungrouped = _on_step_collection(ungrouped_gather, compute_groups=False)
+
+    _assert_same(grouped(preds, target), ungrouped(preds, target))
+
+    # ungrouped: every member gathers its own delta — Accuracy (2 leaves) +
+    # 3 x StatScores (4 leaves) = 14 calls per step. Grouped: Accuracy's own
+    # sync (singleton group, 2) + ONE shared plane for the F1/Precision/
+    # Recall group (4) = 6 — the per-step mirror of the epoch-level sharing.
+    assert ungrouped_gather.calls == 14
+    assert grouped_gather.calls == 6
+
+    # a second step pays the same, and the epoch compute still agrees
+    _assert_same(grouped(preds, target), ungrouped(preds, target))
+    assert grouped_gather.calls == 12
+    _assert_same(grouped.compute(), ungrouped.compute())
+
+
+def test_per_step_delta_sync_savings_visible_in_counters():
+    rng = np.random.RandomState(22)
+    preds, target = _data(rng)
+    gather = _CountingGather()
+    mc = _on_step_collection(gather)
+
+    obs.enable()
+    obs.reset()
+    mc(preds, target)
+    snap = obs.counters_snapshot()
+    obs.disable()
+    # one shared delta plane (4 StatScores leaves) + Accuracy's own (2)
+    assert snap["states_synced"] == 6
+
+
+def test_per_step_delta_sync_mixed_gather_configs_stay_independent():
+    """A group member with a DIFFERENT dist_sync_fn must keep its own per-step
+    sync (sharing a plane across gather configs would change semantics)."""
+    rng = np.random.RandomState(23)
+    preds, target = _data(rng)
+    shared_gather, lone_gather = _CountingGather(), _CountingGather()
+    mc = MetricCollection(
+        [
+            F1(num_classes=4, average="macro", dist_sync_on_step=True, dist_sync_fn=shared_gather),
+            Precision(num_classes=4, average="macro", dist_sync_on_step=True, dist_sync_fn=shared_gather),
+            Recall(num_classes=4, average="macro", dist_sync_on_step=True, dist_sync_fn=lone_gather),
+        ]
+    )
+    reference = MetricCollection(
+        [
+            F1(num_classes=4, average="macro", dist_sync_on_step=True, dist_sync_fn=_CountingGather()),
+            Precision(num_classes=4, average="macro", dist_sync_on_step=True, dist_sync_fn=_CountingGather()),
+            Recall(num_classes=4, average="macro", dist_sync_on_step=True, dist_sync_fn=_CountingGather()),
+        ],
+        compute_groups=False,
+    )
+    _assert_same(mc(preds, target), reference(preds, target))
+    assert shared_gather.calls == 4  # F1 + Precision share one plane
+    assert lone_gather.calls == 4  # Recall syncs alone through its own fn
+
+
 # ------------------------------------------------------- host-plane packing
 def _packing_state():
     """A mixed state dict covering every leaf kind the packed plane moves."""

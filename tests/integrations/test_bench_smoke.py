@@ -5,9 +5,13 @@ steps with no subprocess reference — cheap enough for tier-1 — and this test
 pins the schema of the printed line so the bench path cannot silently rot
 between BENCH_r* rounds (a broken bench would otherwise only surface at the
 next manual round). The ``--trace`` variant additionally pins the
-observability fields (``collective_calls`` / ``sync_bytes`` from the
-collective counters) and that the emitted Chrome-trace file is valid JSON in
-the ``trace_events`` shape Perfetto loads.
+observability fields: schema v2 (``trace_schema``), the collective counters,
+the ``compile`` telemetry block, the per-metric ``device_ms``
+update/sync/compute table, per-span ``compiled=yes/no`` + ``compile_ms``
+attrs in the emitted Chrome-trace file, and that the file is valid JSON in
+the ``trace_events`` shape Perfetto loads. ``--check-collectives`` and
+``--check-trajectory`` are the two CI gates — both run here in tier-1, the
+trajectory gate as an injected pass/fail pair so it stays deterministic.
 """
 import json
 import os
@@ -64,6 +68,10 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     out = _run_smoke(("--trace", str(trace_file)))
     _assert_headline_schema(out)
 
+    # schema version of the --trace payload: the v2 bump added compile
+    # telemetry + the device-time table; bump this pin with the schema
+    assert out["trace_schema"] == 2
+
     # collective accounting of the grouped step program: the 6 deduped sum
     # leaves coalesce into ONE bucketed psum; bytes shrink vs ungrouped
     assert isinstance(out["collective_calls"], int) and out["collective_calls"] >= 1
@@ -74,17 +82,37 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     assert out["counters"]["states_synced"] == out["states_synced"]
     assert out["counters"]["collective_calls"] == out["collective_calls"]
 
-    # the coalesced gather plane: 2 all_gathers per dtype bucket (f32 data
-    # + counts, i32 data + counts) instead of 2 per buffer — same payload
-    # bytes, a third of the staged collectives
-    assert out["gather_collective_calls"] == 4
+    # the coalesced gather plane: ONE all_gather per dtype bucket (counts
+    # bitcast into the data payload: f32 + i32 -> 2) instead of 2 per
+    # buffer — same payload bytes, a sixth of the staged collectives
+    assert out["gather_collective_calls"] == 2
     assert out["gather_collective_calls_per_leaf"] == 12
     assert out["gather_sync_bytes"] == out["gather_sync_bytes_per_leaf"]
-    assert out["gather_counters"]["calls_by_kind"]["coalesced_gather"] == 4
+    assert out["gather_counters"]["calls_by_kind"]["coalesced_gather"] == 2
 
     # per-phase ms come from the span aggregates, not ad-hoc timers
     assert any(name.startswith("bench.compile") for name in out["phase_ms"])
     assert all(ms >= 0 for ms in out["phase_ms"].values())
+
+    # compile telemetry (jax.monitoring): the A/B builds compiled at least
+    # one program, and the compile phases carry nonzero backend time
+    compile_info = out["compile"]
+    assert compile_info["compile_events"] >= 1
+    assert compile_info["backend_compile_ms"] > 0
+    assert set(compile_info["compile_cache"]) == {"hits", "misses"}
+    # the span aggregates attribute compile time to the bench.compile_*
+    # phases (first-dispatch spans no longer conflate compile with run)
+    assert any(
+        name.startswith(("bench.build", "bench.compile"))
+        for name in out["phase_compile_ms"]
+    )
+
+    # the per-metric device-time table: every bench-collection member gets
+    # update/sync/compute rows from the fenced stateful scenario
+    device_ms = out["device_ms"]
+    for member in ("Accuracy", "F1", "Precision", "Recall"):
+        assert {"update", "sync", "compute"} <= set(device_ms[member]), member
+        assert all(ms >= 0 for ms in device_ms[member].values())
 
     # the trace file is valid Chrome-trace JSON (Perfetto-loadable)
     doc = json.loads(trace_file.read_text())
@@ -97,8 +125,28 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     assert {e["name"] for e in complete} >= {
         "bench.compile_grouped", "bench.timed_grouped",
         "bench.compile_gather_coalesced", "bench.timed_gather_per_leaf",
+        "bench.devtime",
     }
     assert doc["otherData"]["collective_calls"] == out["collective_calls"]
+
+    # per-span compile disambiguation: every complete event is stamped
+    # compiled=yes/no; the compile phases say yes with compile_ms, the
+    # steady-state timed phases say no
+    by_name = {e["name"]: e for e in complete}
+    for e in complete:
+        assert e.get("args", {}).get("compiled") in ("yes", "no"), e["name"]
+    compile_grouped = by_name["bench.compile_grouped"]["args"]
+    assert compile_grouped["compiled"] == "yes"
+    assert compile_grouped["compile_ms"] > 0
+    assert by_name["bench.timed_grouped"]["args"]["compiled"] == "no"
+
+    # the fenced scenario's spans carry device_ms on the metric phases
+    fenced = [
+        e for e in complete
+        if e["name"] in ("metric.update", "metric.sync_state", "metric.compute")
+        and "device_ms" in e.get("args", {})
+    ]
+    assert fenced and all(e["args"]["device_ms"] >= 0 for e in fenced)
 
 
 def test_bench_check_collectives_gate():
@@ -119,17 +167,88 @@ def test_bench_check_collectives_gate():
     assert out["ok"] is True and out["failures"] == []
     scenarios = out["scenarios"]
     assert set(scenarios) == {
-        "sum_grouped", "sum_ungrouped", "gather_coalesced", "gather_per_leaf"
+        "sum_grouped", "sum_ungrouped", "gather_coalesced", "gather_per_leaf",
+        "sharded_auroc", "sharded_retrieval",
     }
     # the headline reductions of record: one bucketed psum for the grouped
-    # sum plane; 4 staged all_gathers (2 per dtype bucket) vs 12 per-leaf
-    # for the gather plane, at identical payload bytes
+    # sum plane; 2 staged all_gathers (1 per dtype bucket, counts riding
+    # the data payload) vs 12 per-leaf for the gather plane, at identical
+    # payload bytes
     assert scenarios["sum_grouped"]["collective_calls"] == 1
-    assert scenarios["gather_coalesced"]["collective_calls"] == 4
+    assert scenarios["gather_coalesced"]["collective_calls"] == 2
     assert scenarios["gather_per_leaf"]["collective_calls"] == 12
     assert (
         scenarios["gather_coalesced"]["sync_bytes"]
         == scenarios["gather_per_leaf"]["sync_bytes"]
     )
+    # the sharded engines are pinned like the sync planes: the AUROC ring
+    # stages 3 ppermutes + 1 coalesced psum; the retrieval regroup stages
+    # 4 all_to_alls + 3 psums
+    assert scenarios["sharded_auroc"]["collective_calls"] == 4
+    assert scenarios["sharded_retrieval"]["collective_calls"] == 7
     for row in scenarios.values():
         assert row["status"] != "regression"
+
+
+def _run_trajectory(tmp_path, current, rounds):
+    rounds_dir = tmp_path / "rounds"
+    rounds_dir.mkdir(exist_ok=True)
+    for n, parsed in rounds.items():
+        (rounds_dir / f"BENCH_r{n:02d}.json").write_text(
+            json.dumps({"n": n, "parsed": parsed})
+        )
+    current_file = tmp_path / "current.json"
+    current_file.write_text(json.dumps(current))
+    proc = subprocess.run(
+        [
+            sys.executable, _BENCH, "--check-trajectory",
+            "--rounds-dir", str(rounds_dir),
+            "--trajectory-current", str(current_file),
+        ],
+        capture_output=True, text=True, timeout=120,
+    )
+    return proc.returncode, json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+_TRAJECTORY_BASE = {
+    "grouped_sync8_ms": 5.0,
+    "ungrouped_sync8_ms": 6.0,
+    "gather_coalesced_ms": 7.0,
+    "gather_per_leaf_ms": 9.0,
+    "collective_calls": 1,
+    "sync_bytes": 520,
+    "gather_collective_calls": 2,
+    "gather_sync_bytes": 49176,
+    "states_synced": 6,
+}
+
+
+def test_bench_check_trajectory_gate_passes_within_tolerance(tmp_path):
+    """``bench.py --check-trajectory`` diffs the current numbers against the
+    prior BENCH rounds: matching numbers (and a mild latency wobble under
+    the pinned ratio) pass, and rounds missing a key don't constrain it."""
+    current = dict(_TRAJECTORY_BASE, grouped_sync8_ms=5.6)  # within 2.5x
+    rc, out = _run_trajectory(tmp_path, current, {6: _TRAJECTORY_BASE})
+    assert rc == 0, out
+    assert out["ok"] is True and out["failures"] == []
+    assert out["checks"]["grouped_sync8_ms"]["status"] == "ok"
+    assert out["checks"]["collective_calls"]["status"] == "ok"
+    assert out["rounds_compared"] == [6]
+
+
+def test_bench_check_trajectory_gate_fails_on_injected_regression(tmp_path):
+    """The fail half of the pair: an injected phase-latency blowup AND a
+    collective-count growth must each land in failures, exit non-zero."""
+    bad = dict(_TRAJECTORY_BASE, grouped_sync8_ms=50.0, collective_calls=3)
+    rc, out = _run_trajectory(tmp_path, bad, {5: _TRAJECTORY_BASE, 6: _TRAJECTORY_BASE})
+    assert rc == 1
+    assert out["ok"] is False
+    assert any("grouped_sync8_ms" in f for f in out["failures"])
+    assert any("collective_calls" in f for f in out["failures"])
+    assert out["checks"]["grouped_sync8_ms"]["status"] == "regression"
+    assert out["checks"]["collective_calls"]["status"] == "regression"
+    # an improvement is never a failure — it reports as such for re-pinning
+    improved = dict(_TRAJECTORY_BASE, collective_calls=0)
+    rc, out = _run_trajectory(tmp_path, improved, {6: _TRAJECTORY_BASE})
+    assert rc == 0
+    assert out["checks"]["collective_calls"]["status"] == "improved"

@@ -2,8 +2,9 @@
 
 ``coalesced_sync_state`` buckets gather-semantics leaves — PaddedBuffer
 cat-states, plain ``cat``/``None``/callable array leaves — into per-dtype
-payloads that ride ONE ``all_gather`` (plus one for the stacked buffer
-counts), and folds floating ``mean`` leaves into the ``sum`` bucket. The
+payloads that ride ONE ``all_gather`` (the stacked buffer counts bitcast
+into the data payload for 4-byte dtypes; non-4-byte buckets keep a second
+counts gather), and folds floating ``mean`` leaves into the ``sum`` bucket. The
 contract under test: results are IDENTICAL to the per-leaf ``sync_state``
 plane on a real mesh collective program, across dtypes, mixed capacities,
 single-member buckets, overflow counts, and a 2-D mesh axis — only the
@@ -101,10 +102,11 @@ def test_buffer_buckets_parity_dtypes_and_mixed_capacities(eight_devices):
     assert int(synced["c"].count) == 24
 
 
-def test_buffer_bucket_counts_two_collectives_per_dtype(eight_devices):
-    """The acceptance number: a multi-buffer bucket stages TWO all_gathers
-    (data + stacked counts) instead of two PER BUFFER; single-member buckets
-    delegate to the per-leaf plane untouched."""
+def test_buffer_bucket_counts_one_collective_per_dtype(eight_devices):
+    """The acceptance number: a multi-buffer 4-byte-dtype bucket stages ONE
+    all_gather (the stacked counts vector rides inside the data payload,
+    bitcast to the bucket dtype) instead of two per buffer; single-member
+    buckets delegate to the per-leaf plane untouched."""
 
     def build(seed):
         f = jnp.float32
@@ -124,13 +126,15 @@ def test_buffer_bucket_counts_two_collectives_per_dtype(eight_devices):
     per_leaf_snap = obs.counters_snapshot(reset_after=True)
     obs.disable()
 
-    # f32 bucket {p1, p2}: 1 data + 1 counts gather; i32 singleton: 2 plain
-    assert coalesced_snap["calls_by_kind"]["coalesced_gather"] == 2
+    # f32 bucket {p1, p2}: 1 combined data+counts gather; i32 singleton: 2 plain
+    assert coalesced_snap["calls_by_kind"]["coalesced_gather"] == 1
     assert coalesced_snap["calls_by_kind"]["all_gather"] == 2
-    assert coalesced_snap["collective_calls"] == 4
+    assert coalesced_snap["collective_calls"] == 3
     # per-leaf: 2 collectives per buffer
     assert per_leaf_snap["calls_by_kind"]["all_gather"] == 6
     assert "coalesced_gather" not in per_leaf_snap["calls_by_kind"]
+    # same payload either way: carrying counts in-payload moves identical bytes
+    assert coalesced_snap["sync_bytes"] == per_leaf_snap["sync_bytes"]
 
 
 def test_overflow_counts_parity(eight_devices):
@@ -279,8 +283,9 @@ def test_gather_collection_sync_compute_parity(eight_devices):
     snap = obs.counters_snapshot()
     obs.disable()
 
-    # <= 2 all_gathers per dtype bucket: f32 (4 buffers) + i32 (2 buffers)
-    assert snap["calls_by_kind"]["coalesced_gather"] == 4
+    # ONE all_gather per dtype bucket (counts ride the data payload):
+    # f32 (4 buffers) + i32 (2 buffers) -> 2 staged collectives
+    assert snap["calls_by_kind"]["coalesced_gather"] == 2
     assert snap["calls_by_kind"].get("all_gather", 0) == 0
     assert snap["states_synced"] == 6
 
