@@ -23,33 +23,43 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...} where
 value is our 8-device sync-in-the-loop ms/step and vs_baseline =
 reference_ms / our_ms (>1 means we are faster than the reference). The line
 also carries the compute-groups A/B ("grouped_sync8_ms" vs
-"ungrouped_sync8_ms", with "states_synced" counts) and the gather-plane A/B
+"ungrouped_sync8_ms", with "states_synced" counts), the gather-plane A/B
 ("gather_coalesced_ms" vs "gather_per_leaf_ms": bucketed vs per-leaf
 ``all_gather`` sync of a buffer-state AUROC+AveragePrecision+Spearman
-collection) so BENCH_r* tracks the group/coalescing gains. ``--smoke`` runs
-a 2-step, no-reference version with the same headline schema for CI
-(tests/integrations/test_bench_smoke.py).
+collection), and the hierarchical A/B ("gather_hier_ms" vs
+"gather_flat2d_ms": the same collection on the (4,2) ici x dcn test mesh,
+two-stage hierarchical plane vs flat world axis, with the per-crossing
+"hier_dcn_bytes"/"flat2d_world_bytes" traffic split) so BENCH_r* tracks the
+group/coalescing/hierarchy gains. The staged collective-count keys
+("collective_calls", "sync_bytes", ...) ride the DEFAULT line — counting
+happens at trace time and costs nothing per step — so ``--check-trajectory``
+binds on every new round. ``--smoke`` runs a 2-step, no-reference version
+with the same headline schema for CI (tests/integrations/test_bench_smoke.py).
 
 ``--check-collectives`` is the collective regression gate: it traces each
 scenario's step program and compares the staged ``collective_calls`` /
-``sync_bytes`` against the pinned ``EXPECTED_COLLECTIVES``, exiting
-non-zero on growth (the smoke test runs it in tier-1, so a silently added
-collective fails CI even when ms noise hides it).
+``sync_bytes`` — plus the per-crossing ``ici``/``dcn``/``world`` calls and
+ring-traffic bytes for the hierarchical scenarios — against the pinned
+``EXPECTED_COLLECTIVES``, exiting non-zero on growth, and enforces the
+hierarchy gate of record: the hierarchical gather plane's DCN-crossing
+bytes strictly below the flat plane's world-axis bytes (the smoke test
+runs it in tier-1, so a silently added or reflattened collective fails CI
+even when ms noise hides it).
 
 ``--trace OUT.json`` (composable with ``--smoke``) enables the observability
-subsystem around the A/B: the JSON line grows ``collective_calls`` /
-``sync_bytes`` (collectives staged per step program, from
-``metrics_tpu.observability.counters``, replacing ad-hoc timers for the
-per-phase story), a ``phase_ms`` span-aggregate table, and OUT.json gets a
-Chrome-trace/Perfetto file of the bench phases (load at ui.perfetto.dev).
-Schema v2 (``trace_schema: 2``) additionally carries: ``compile`` — XLA
-compile telemetry from ``jax.monitoring`` (event count, per-phase ms,
-persistent-cache hit/miss), with every span in OUT.json stamped
-``compiled=yes/no`` + ``compile_ms`` so first-dispatch spans stop
-conflating trace+compile with run; ``device_ms`` — a per-metric
-update/sync/compute device-time table from the fenced stateful scenario
-(``metrics_tpu.observability.devtime``); and ``phase_compile_ms`` — the
-compile share of each bench phase.
+subsystem around the A/B: the JSON line grows a ``phase_ms`` span-aggregate
+table, and OUT.json gets a Chrome-trace/Perfetto file of the bench phases
+(load at ui.perfetto.dev). Schema v3 (``trace_schema: 3``: the collective
+counts moved to the default line, the hierarchical A/B and per-crossing
+counters joined) additionally carries: ``compile`` — XLA compile telemetry
+from ``jax.monitoring`` (event count, per-phase ms, persistent-cache
+hit/miss), with every span in OUT.json stamped ``compiled=yes/no`` +
+``compile_ms`` so first-dispatch spans stop conflating trace+compile with
+run; ``device_ms`` — a per-metric update/sync/compute device-time table
+from the fenced stateful scenario (``metrics_tpu.observability.devtime``);
+``phase_compile_ms`` — the compile share of each bench phase; and the full
+``counters``/``gather_counters``/``hier_counters`` snapshots (per-kind,
+per-dtype, per-crossing).
 
 ``--check-trajectory`` is the bench-trajectory regression gate: it loads the
 prior ``BENCH_r*.json`` rounds and diffs the current numbers (measured via a
@@ -83,6 +93,7 @@ FEATURES = 256
 
 
 GATHER_CAPACITY = 2048  # per-device rows of each buffer (cat) state
+HIER_SLICES = 2  # the (4,2) test mesh: 2 virtual "slices" x 4 ici devices
 
 
 def _collection_ours(compute_groups: bool = True):
@@ -225,6 +236,62 @@ def _build_gather_runner(coalesced: bool):
     return run, len(state)
 
 
+def _build_hier_gather_runner(hierarchical: bool):
+    """(timed_run(steps) -> ms/step, states_synced) for the hierarchical
+    A/B: the same 6-buffer gather collection synced over the (4,2)
+    ``ici`` x ``dcn`` test mesh (2 virtual slices x 4 devices), either with
+    the two-stage hierarchical plane (one DCN exchange of per-slice
+    payloads, then intra-slice replication) or the flat plane spanning the
+    whole ``("dcn", "ici")`` world axis. Values are bit-identical; the
+    staged DCN-crossing traffic is what shrinks (``bytes_by_crossing``).
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from metrics_tpu.parallel.placement import MeshHierarchy
+    from metrics_tpu.parallel.sync import coalesced_sync_state
+    from metrics_tpu.utils.compat import shard_map
+
+    col = _collection_gather()
+    rng = np.random.RandomState(0)
+    rows = GATHER_CAPACITY // 2
+    preds = jnp.asarray(rng.rand(rows).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, rows).astype(np.int32))
+    col.update(preds, target)
+
+    state = {(k, n): v for k, m in col.items() for n, v in m._current_state().items()}
+    reductions = {key: col[key[0]]._reductions[key[1]] for key in state}
+    mesh = Mesh(
+        np.array(jax.devices("cpu")[:N_DEVICES]).reshape(HIER_SLICES, N_DEVICES // HIER_SLICES),
+        ("dcn", "ici"),
+    )
+    axis = MeshHierarchy(ici_axis="ici", dcn_axis="dcn") if hierarchical else ("dcn", "ici")
+
+    def step(s, acc):
+        synced = coalesced_sync_state(s, reductions, axis)
+        # carry chains step i+1 on step i (see _build_gather_runner)
+        for leaf in jax.tree_util.tree_leaves(synced):
+            acc = acc + jnp.sum(leaf.astype(jnp.float32))
+        return acc
+
+    sharded_step = jax.jit(
+        shard_map(step, mesh=mesh, in_specs=(P(), P()), out_specs=P(), check_vma=False)
+    )
+
+    def run(steps: int) -> float:
+        acc = jnp.zeros((), jnp.float32)
+        start = time.perf_counter()
+        for _ in range(steps):
+            acc = sharded_step(state, acc)
+        jax.block_until_ready(acc)
+        return (time.perf_counter() - start) / steps * 1e3
+
+    return run, len(state)
+
+
 def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trace_path=None) -> dict:
     """Compute-groups on/off A/B over the same 8-device mesh program.
 
@@ -240,6 +307,8 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
     then carries ``collective_calls`` / ``sync_bytes`` (grouped program) and
     a ``phase_ms`` table from the span aggregates.
     """
+    from metrics_tpu.observability import counters as _ctr
+
     obs = None
     if trace_path is not None:
         from metrics_tpu import observability as obs_mod
@@ -251,17 +320,24 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
         obs.reset()
 
     def build(builder, variant, label):
-        if obs is None:
+        """Build + compile one A/B variant; ALWAYS snapshot the staged
+        collective counters over the compiling first call (cheap: counting
+        happens at trace time), so the default JSON line carries the
+        trace-schema keys and --check-trajectory binds on every new
+        BENCH_r* round. Spans only when tracing."""
+        with (obs.span(f"bench.build_{label}") if obs else _null_cm()):
             run, states = builder(variant)
-            run(warmup)
-            return run, states, None
-        with obs.span(f"bench.build_{label}"):
-            run, states = builder(variant)
-        obs.COUNTERS.reset()
-        with obs.span(f"bench.compile_{label}"):
-            run(1)  # first call traces+compiles: counters now hold the program's collectives
-        counters = obs.counters_snapshot()
-        with obs.span(f"bench.warmup_{label}"):
+        _ctr.COUNTERS.reset()
+        was_enabled = _ctr.is_enabled()
+        _ctr.enable()
+        try:
+            with (obs.span(f"bench.compile_{label}") if obs else _null_cm()):
+                run(1)  # first call traces+compiles: counters now hold the program's collectives
+            counters = _ctr.snapshot()
+        finally:
+            if not was_enabled:
+                _ctr.disable()
+        with (obs.span(f"bench.warmup_{label}") if obs else _null_cm()):
             run(max(warmup - 1, 1))
         return run, states, counters
 
@@ -287,6 +363,17 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
         with (obs.span("bench.timed_gather_per_leaf") if obs else _null_cm()):
             leaf_times.append(run_leaf(steps))
 
+    # hierarchical A/B: the same gather collection on the (4,2) ici x dcn
+    # mesh — two-stage hierarchical plane vs the flat world-axis plane
+    run_hier, _, hier_counters = build(_build_hier_gather_runner, True, "gather_hier")
+    run_flat2d, _, flat2d_counters = build(_build_hier_gather_runner, False, "gather_flat2d")
+    hier_times, flat2d_times = [], []
+    for _ in range(repeats):
+        with (obs.span("bench.timed_gather_hier") if obs else _null_cm()):
+            hier_times.append(run_hier(steps))
+        with (obs.span("bench.timed_gather_flat2d") if obs else _null_cm()):
+            flat2d_times.append(run_flat2d(steps))
+
     out = {
         "grouped_sync8_ms": grouped_ms,
         "ungrouped_sync8_ms": ungrouped_ms,
@@ -295,6 +382,27 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
         "gather_coalesced_ms": min(coal_times),
         "gather_per_leaf_ms": min(leaf_times),
         "gather_states_synced": states_gather,
+        "gather_hier_ms": min(hier_times),
+        "gather_flat2d_ms": min(flat2d_times),
+        # staged-collective keys ride the DEFAULT line (trace-schema keys:
+        # --check-trajectory binds on every new BENCH_r* round)
+        "collective_calls": grouped_counters["collective_calls"],
+        "sync_bytes": grouped_counters["sync_bytes"],
+        "collective_calls_ungrouped": ungrouped_counters["collective_calls"],
+        "sync_bytes_ungrouped": ungrouped_counters["sync_bytes"],
+        "gather_collective_calls": coal_counters["collective_calls"],
+        "gather_sync_bytes": coal_counters["sync_bytes"],
+        "gather_collective_calls_per_leaf": leaf_counters["collective_calls"],
+        "gather_sync_bytes_per_leaf": leaf_counters["sync_bytes"],
+        # the hierarchical plane's per-crossing structure: DCN traffic is
+        # the headline (strictly below the flat plane's world traffic)
+        "hier_collective_calls": hier_counters["collective_calls"],
+        "hier_sync_bytes": hier_counters["sync_bytes"],
+        "hier_dcn_calls": hier_counters["calls_by_crossing"].get("dcn", 0),
+        "hier_dcn_bytes": hier_counters["bytes_by_crossing"].get("dcn", 0),
+        "hier_ici_bytes": hier_counters["bytes_by_crossing"].get("ici", 0),
+        "flat2d_collective_calls": flat2d_counters["collective_calls"],
+        "flat2d_world_bytes": flat2d_counters["bytes_by_crossing"].get("world", 0),
     }
     if obs is not None:
         # the device-time scenario: drive the stateful per-metric API with
@@ -311,17 +419,12 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
             finally:
                 devtime_mod.disable()
 
-        out["trace_schema"] = 2
-        out["collective_calls"] = grouped_counters["collective_calls"]
-        out["sync_bytes"] = grouped_counters["sync_bytes"]
-        out["collective_calls_ungrouped"] = ungrouped_counters["collective_calls"]
-        out["sync_bytes_ungrouped"] = ungrouped_counters["sync_bytes"]
-        out["gather_collective_calls"] = coal_counters["collective_calls"]
-        out["gather_sync_bytes"] = coal_counters["sync_bytes"]
-        out["gather_collective_calls_per_leaf"] = leaf_counters["collective_calls"]
-        out["gather_sync_bytes_per_leaf"] = leaf_counters["sync_bytes"]
+        # v3: the collective-count keys moved to the DEFAULT line (above) and
+        # the hierarchical A/B + per-crossing counters joined the schema
+        out["trace_schema"] = 3
         out["counters"] = grouped_counters
         out["gather_counters"] = coal_counters
+        out["hier_counters"] = hier_counters
         summary = obs.summarize()
         out["phase_ms"] = {
             name: round(row["total_ms"], 3) for name, row in sorted(summary.items())
@@ -611,8 +714,9 @@ def _metric_description() -> str:
     )
 
 
-# extra keys _sync8_ab emits when tracing; the parent copies them verbatim
-# from the child's JSON (full mode) or the in-process dict (smoke mode)
+# extra keys _sync8_ab emits (collective counts always; span/compile tables
+# when tracing); the parent copies them verbatim from the child's JSON (full
+# mode) or the in-process dict (smoke mode)
 _TRACE_KEYS = (
     "trace_schema",
     "collective_calls",
@@ -623,8 +727,16 @@ _TRACE_KEYS = (
     "gather_sync_bytes",
     "gather_collective_calls_per_leaf",
     "gather_sync_bytes_per_leaf",
+    "hier_collective_calls",
+    "hier_sync_bytes",
+    "hier_dcn_calls",
+    "hier_dcn_bytes",
+    "hier_ici_bytes",
+    "flat2d_collective_calls",
+    "flat2d_world_bytes",
     "counters",
     "gather_counters",
+    "hier_counters",
     "phase_ms",
     "phase_compile_ms",
     "device_ms",
@@ -652,39 +764,79 @@ _TRACE_KEYS = (
 #   pack circulating) + 1 coalesced psum; sharded_retrieval (MRR, capacity
 #   1024) stages 4 all_to_alls (idx/preds/target/real regroup) + 3 psums
 #   (overflow count, float total, int count+flag plane).
+# hierarchical scenarios additionally pin the per-crossing structure on the
+# (4,2) ici x dcn test mesh (S=2 slices x L=4 devices). Crossing BYTES are
+# ring traffic (payload x (participants - 1), see observability.counters):
+# the flat planes burn W-1 = 7 DCN-crossing hops per payload byte, the
+# two-stage planes S-1 = 1 — the structural win --check-collectives pins.
 EXPECTED_COLLECTIVES = {
     "sum_grouped": {"collective_calls": 1, "sync_bytes": 520},
     "sum_ungrouped": {"collective_calls": 1, "sync_bytes": 1544},
     "gather_coalesced": {"collective_calls": 2, "sync_bytes": 49176},
     "gather_per_leaf": {"collective_calls": 12, "sync_bytes": 49176},
+    "gather_hier": {
+        "collective_calls": 4, "sync_bytes": 147528,
+        "dcn_calls": 2, "dcn_bytes": 49176, "ici_calls": 2, "ici_bytes": 295056,
+    },
+    "gather_flat2d": {
+        "collective_calls": 2, "sync_bytes": 49176,
+        "dcn_bytes": 0, "world_bytes": 344232,
+    },
     "sharded_auroc": {"collective_calls": 4, "sync_bytes": 1548},
+    "sharded_auroc_hier": {
+        "collective_calls": 8, "sync_bytes": 4632,
+        "dcn_calls": 4, "dcn_bytes": 1548, "ici_calls": 4, "ici_bytes": 9252,
+    },
     "sharded_retrieval": {"collective_calls": 7, "sync_bytes": 6672},
+    "sharded_retrieval_hier": {
+        "collective_calls": 14, "sync_bytes": 13344,
+        "dcn_calls": 7, "dcn_bytes": 6672, "ici_calls": 7, "ici_bytes": 20016,
+    },
 }
 
 
 SHARDED_GATE_CAPACITY = 1024  # rows per sharded-engine gate scenario
 
 
-def _build_sharded_auroc_runner():
+def _sharded_gate_mesh(hierarchical: bool):
+    """(mesh, axis) for the sharded-engine gate scenarios: the flat 8-device
+    ``dp`` axis, or the (4,2) 2-level mesh with its hierarchy."""
+    import jax
+    from jax.sharding import Mesh
+
+    from metrics_tpu.parallel.placement import MeshHierarchy
+
+    if hierarchical:
+        mesh = Mesh(
+            np.array(jax.devices("cpu")[:N_DEVICES]).reshape(
+                HIER_SLICES, N_DEVICES // HIER_SLICES
+            ),
+            ("dcn", "ici"),
+        )
+        return mesh, MeshHierarchy(ici_axis="ici", dcn_axis="dcn")
+    return Mesh(np.array(jax.devices("cpu")[:N_DEVICES]), ("dp",)), "dp"
+
+
+def _build_sharded_auroc_runner(hierarchical: bool = False):
     """(run, states) for the row-sharded binary AUROC ring-engine program.
 
     ``run(1)`` dispatches ``compute()`` over row-sharded epoch buffers: the
     first call traces the ring engine's ``shard_map`` program, so the
     counters then hold its staged collectives (the sorted-pack ppermutes +
-    the coalesced stats psum).
+    the coalesced stats psum; hierarchically: one dcn pack exchange + the
+    ici-only ring + the two-stage psum).
     """
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
-    from jax.sharding import Mesh
 
     from metrics_tpu import AUROC
     from metrics_tpu.parallel import row_sharded
 
-    mesh = Mesh(np.array(jax.devices("cpu")[:N_DEVICES]), ("dp",))
+    mesh, axis = _sharded_gate_mesh(hierarchical)
     metric = AUROC(pos_label=1, capacity=SHARDED_GATE_CAPACITY)
-    metric.device_put(row_sharded(mesh, "dp"))
+    metric.device_put(row_sharded(mesh, axis))
     rows = SHARDED_GATE_CAPACITY // 2
     rng = np.random.RandomState(0)
     preds = jnp.asarray(np.round(rng.rand(rows), 2).astype(np.float32))
@@ -701,21 +853,21 @@ def _build_sharded_auroc_runner():
     return run, len(metric._defaults)
 
 
-def _build_sharded_retrieval_runner():
+def _build_sharded_retrieval_runner(hierarchical: bool = False):
     """(run, states) for the row-sharded RetrievalMRR all_to_all program
-    (regroup-by-query exchange + the grouped engine's psums)."""
+    (regroup-by-query exchange + the grouped engine's psums; hierarchically:
+    the two-stage slice-then-device routing)."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
-    from jax.sharding import Mesh
 
     from metrics_tpu.parallel import row_sharded
     from metrics_tpu.retrieval import RetrievalMRR
 
-    mesh = Mesh(np.array(jax.devices("cpu")[:N_DEVICES]), ("dp",))
+    mesh, axis = _sharded_gate_mesh(hierarchical)
     metric = RetrievalMRR(capacity=SHARDED_GATE_CAPACITY)
-    metric.device_put(row_sharded(mesh, "dp"))
+    metric.device_put(row_sharded(mesh, axis))
     rows = SHARDED_GATE_CAPACITY // 2
     rng = np.random.RandomState(0)
     idx = jnp.asarray(rng.randint(0, 64, rows).astype(np.int32))
@@ -735,10 +887,16 @@ def _build_sharded_retrieval_runner():
 
 def check_collectives() -> int:
     """``--check-collectives``: trace each scenario's step program and diff
-    its staged ``collective_calls``/``sync_bytes`` against the pinned
-    expectations. Returns a non-zero exit status on any growth — the CI gate
-    that catches silent collective-count regressions the ms numbers hide in
-    noise. Prints one JSON report line either way.
+    its staged ``collective_calls``/``sync_bytes`` — and, for the
+    hierarchical scenarios, the per-crossing ``ici``/``dcn``/``world``
+    calls and ring-traffic bytes — against the pinned expectations. Returns
+    a non-zero exit status on any growth — the CI gate that catches silent
+    collective-count regressions the ms numbers hide in noise. The
+    cross-scenario HIERARCHY GATE additionally requires the hierarchical
+    gather plane's DCN-crossing bytes to stay strictly below the flat
+    plane's world-axis bytes (a future change that reflattens a
+    DCN-crossing collective fails here even if its own pins still hold).
+    Prints one JSON report line either way.
     """
     from metrics_tpu import observability as obs
 
@@ -747,8 +905,12 @@ def check_collectives() -> int:
         "sum_ungrouped": lambda: _build_sync8_runner(False),
         "gather_coalesced": lambda: _build_gather_runner(True),
         "gather_per_leaf": lambda: _build_gather_runner(False),
-        "sharded_auroc": _build_sharded_auroc_runner,
-        "sharded_retrieval": _build_sharded_retrieval_runner,
+        "gather_hier": lambda: _build_hier_gather_runner(True),
+        "gather_flat2d": lambda: _build_hier_gather_runner(False),
+        "sharded_auroc": lambda: _build_sharded_auroc_runner(False),
+        "sharded_auroc_hier": lambda: _build_sharded_auroc_runner(True),
+        "sharded_retrieval": lambda: _build_sharded_retrieval_runner(False),
+        "sharded_retrieval_hier": lambda: _build_sharded_retrieval_runner(True),
     }
     obs.enable()
     report, failures = {}, []
@@ -757,7 +919,15 @@ def check_collectives() -> int:
         obs.COUNTERS.reset()
         run(1)  # first call traces+compiles: counters now hold the staged program
         snap = obs.counters_snapshot()
-        got = {"collective_calls": snap["collective_calls"], "sync_bytes": snap["sync_bytes"]}
+        got = {
+            "collective_calls": snap["collective_calls"],
+            "sync_bytes": snap["sync_bytes"],
+            "ici_calls": snap["calls_by_crossing"].get("ici", 0),
+            "ici_bytes": snap["bytes_by_crossing"].get("ici", 0),
+            "dcn_calls": snap["calls_by_crossing"].get("dcn", 0),
+            "dcn_bytes": snap["bytes_by_crossing"].get("dcn", 0),
+            "world_bytes": snap["bytes_by_crossing"].get("world", 0),
+        }
         expected = EXPECTED_COLLECTIVES[name]
         status = "ok"
         for key, pinned in expected.items():
@@ -766,12 +936,27 @@ def check_collectives() -> int:
                 failures.append(f"{name}.{key}: {got[key]} > pinned {pinned}")
             elif got[key] < pinned and status == "ok":
                 status = "improved (re-pin EXPECTED_COLLECTIVES)"
-        report[name] = {**got, "expected": expected, "status": status}
+        keep = set(expected) | {"collective_calls", "sync_bytes"}
+        report[name] = {**{k: v for k, v in got.items() if k in keep},
+                        "expected": expected, "status": status}
     obs.disable()
+
+    # the hierarchy gate of record: staged DCN traffic of the hierarchical
+    # gather plane strictly below the flat plane's world-axis traffic
+    hier_dcn = report["gather_hier"]["dcn_bytes"]
+    flat_world = report["gather_flat2d"]["world_bytes"]
+    hier_gate = {"hier_dcn_bytes": hier_dcn, "flat2d_world_bytes": flat_world,
+                 "ok": hier_dcn < flat_world}
+    if not hier_gate["ok"]:
+        failures.append(
+            f"hierarchy gate: gather_hier dcn bytes {hier_dcn} not strictly below"
+            f" gather_flat2d world bytes {flat_world}"
+        )
     print(json.dumps({
         "check": "collectives",
         "ok": not failures,
         "failures": failures,
+        "hier_gate": hier_gate,
         "scenarios": report,
     }))
     return 1 if failures else 0
@@ -829,6 +1014,8 @@ def main() -> None:
             "gather_coalesced_ms": round(ab["gather_coalesced_ms"], 4),
             "gather_per_leaf_ms": round(ab["gather_per_leaf_ms"], 4),
             "gather_states_synced": ab["gather_states_synced"],
+            "gather_hier_ms": round(ab["gather_hier_ms"], 4),
+            "gather_flat2d_ms": round(ab["gather_flat2d_ms"], 4),
             "smoke": True,
         }
         out.update({k: ab[k] for k in _TRACE_KEYS if k in ab})
@@ -884,6 +1071,8 @@ def main() -> None:
         "gather_coalesced_ms": round(ab["gather_coalesced_ms"], 4),
         "gather_per_leaf_ms": round(ab["gather_per_leaf_ms"], 4),
         "gather_states_synced": ab["gather_states_synced"],
+        "gather_hier_ms": round(ab["gather_hier_ms"], 4),
+        "gather_flat2d_ms": round(ab["gather_flat2d_ms"], 4),
         "singlechip_fused_update_ms": round(ours_fused_ms, 4),
         "singlechip_reference_eager_update_ms": round(ref_eager_ms, 4),
         "singlechip_vs_reference": round(fused_vs_ref, 3),

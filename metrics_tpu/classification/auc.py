@@ -6,7 +6,7 @@ from jax import Array
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.functional.classification.auc import _auc_compute, _auc_update
 from metrics_tpu.parallel.buffer import as_values
-from metrics_tpu.utils.prints import rank_zero_warn
+from metrics_tpu.utils.prints import rank_zero_warn, rank_zero_warn_once
 
 
 class AUC(Metric):
@@ -32,7 +32,7 @@ class AUC(Metric):
         self.add_state("x", default=[], dist_reduce_fx=None)
         self.add_state("y", default=[], dist_reduce_fx=None)
 
-        rank_zero_warn(
+        rank_zero_warn_once(
             "Metric `AUC` will save all targets and predictions in buffer."
             " For large datasets this may lead to large memory footprint."
         )

@@ -6,7 +6,7 @@ from jax import Array
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.functional.classification.auroc import _auroc_compute, _auroc_update
 from metrics_tpu.parallel.buffer import as_values
-from metrics_tpu.utils.prints import rank_zero_warn
+from metrics_tpu.utils.prints import rank_zero_warn, rank_zero_warn_once
 
 
 class AUROC(Metric):
@@ -70,7 +70,7 @@ class AUROC(Metric):
         self.add_state("preds", default=[], dist_reduce_fx=None)
         self.add_state("target", default=[], dist_reduce_fx=None)
 
-        rank_zero_warn(
+        rank_zero_warn_once(
             "Metric `AUROC` will save all targets and predictions in buffer."
             " For large datasets this may lead to large memory footprint."
         )

@@ -10,7 +10,7 @@ from metrics_tpu.functional.classification.average_precision import (
     _average_precision_update,
 )
 from metrics_tpu.parallel.buffer import as_values
-from metrics_tpu.utils.prints import rank_zero_warn
+from metrics_tpu.utils.prints import rank_zero_warn, rank_zero_warn_once
 
 
 class AveragePrecision(Metric):
@@ -55,7 +55,7 @@ class AveragePrecision(Metric):
         self.add_state("preds", default=[], dist_reduce_fx=None)
         self.add_state("target", default=[], dist_reduce_fx=None)
 
-        rank_zero_warn(
+        rank_zero_warn_once(
             "Metric `AveragePrecision` will save all targets and predictions in buffer."
             " For large datasets this may lead to large memory footprint."
         )

@@ -6,7 +6,7 @@ from jax import Array
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.functional.classification.roc import _roc_compute, _roc_update
 from metrics_tpu.parallel.buffer import as_values
-from metrics_tpu.utils.prints import rank_zero_warn
+from metrics_tpu.utils.prints import rank_zero_warn, rank_zero_warn_once
 
 
 class ROC(Metric):
@@ -61,7 +61,7 @@ class ROC(Metric):
         self.add_state("preds", default=[], dist_reduce_fx=None)
         self.add_state("target", default=[], dist_reduce_fx=None)
 
-        rank_zero_warn(
+        rank_zero_warn_once(
             "Metric `ROC` will save all targets and predictions in buffer."
             " For large datasets this may lead to large memory footprint."
         )

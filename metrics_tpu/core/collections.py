@@ -782,6 +782,87 @@ class MetricCollection(OrderedDict):
         for _, m in self.items():
             m.persistent(mode)
 
+    # ----------------------------------------------------------- checkpoint
+    # Group-aware shard merging: compute-group members accrue identical
+    # states when every write went through the collection, so persisting
+    # each member's copy writes the same arrays once per member. state_dict
+    # writes ONE copy per group plus a membership manifest and fans back out
+    # on load. Sharing is decided by VALUE at checkpoint time (host-side
+    # numpy equality, epoch-rate cost) — never assumed from the group
+    # structure alone, so out-of-collection writes can't corrupt a restore.
+    _GROUP_MANIFEST_KEY = "_compute_group_manifest"
+
+    @staticmethod
+    def _entries_equal(a: Any, b: Any) -> bool:
+        import numpy as np
+        if type(a) is not type(b):
+            return False
+        if isinstance(a, dict):  # PaddedBuffer entries: {"data", "count"}
+            return set(a) == set(b) and all(
+                MetricCollection._entries_equal(a[k], b[k]) for k in a
+            )
+        if isinstance(a, list):
+            return len(a) == len(b) and all(
+                np.array_equal(x, y) for x, y in zip(a, b)
+            )
+        return np.array_equal(a, b)
+
+    def _states_match(self, rep: Metric, member: Metric) -> bool:
+        """Whether two members' persisted entries are value-identical."""
+        a, b = rep.state_dict(), member.state_dict()
+        return set(a) == set(b) and all(self._entries_equal(a[k], b[k]) for k in a)
+
+    def state_dict(self, destination: Optional[dict] = None, prefix: str = "") -> dict:
+        """Persistent states of every member, with compute-group shards
+        MERGED: one full copy per group (the representative's), a
+        ``{member: representative}`` manifest for the rest, and each shared
+        member's host metadata (``_count_bound``) kept per member. Members
+        whose values diverged from their representative (out-of-collection
+        writes) keep their own full entry. Orbax/pickle-friendly numpy,
+        like ``Metric.state_dict``.
+        """
+        destination = {} if destination is None else destination
+        import numpy as np
+
+        gm = self._group_map()
+        manifest: Dict[str, str] = {}
+        for name, m in self.items():
+            rep = gm[name]
+            if rep != name and self._states_match(self[rep], m):
+                manifest[name] = rep
+                # host-side overflow bound is per-member metadata: it rides
+                # outside the shared entry so a restore keeps warning
+                destination[f"{prefix}{name}._count_bound"] = np.asarray(
+                    m._count_bound, dtype=np.int64
+                )
+            else:
+                m.state_dict(destination, prefix=f"{prefix}{name}.")
+        destination[prefix + self._GROUP_MANIFEST_KEY] = dict(manifest)
+        return destination
+
+    def load_state_dict(self, state_dict: dict, prefix: str = "") -> None:
+        """Load a (possibly group-merged) collection checkpoint: manifest
+        members fan out from their representative's single copy; everyone
+        else loads their own entry. Old per-member checkpoints (no
+        manifest) load unchanged."""
+        manifest = state_dict.get(prefix + self._GROUP_MANIFEST_KEY, {})
+        diverged = self.__dict__.get("_lockstep_diverged", set())
+        for name, m in self.items():
+            src = manifest.get(name, name)
+            m.load_state_dict(state_dict, prefix=f"{prefix}{src}.")
+            if src != name:
+                key = f"{prefix}{name}._count_bound"
+                if key in state_dict:
+                    m._count_bound = int(state_dict[key])
+                # fanned-out members hold the representative's exact values:
+                # back in lockstep with their group
+                diverged.discard(name)
+            elif name in self._group_map() and self._group_map()[name] != name:
+                # a grouped member restored from its OWN entry diverged at
+                # save time; stay conservative until the next reset
+                diverged.add(name)
+        self._lockstep_record()
+
     def _set_prefix(self, k: str) -> str:
         return k if self.prefix is None else self.prefix + k
 
@@ -828,7 +909,7 @@ class MetricCollection(OrderedDict):
     def merge_states(self, a: Dict[str, Dict[str, Any]], b: Dict[str, Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
         return {k: self[k].merge_states(a[k], b[k]) for k in a}
 
-    def sync_state(self, state: Dict[str, Dict[str, Any]], axis_name: str) -> Dict[str, Dict[str, Any]]:
+    def sync_state(self, state: Dict[str, Dict[str, Any]], axis_name: Any) -> Dict[str, Dict[str, Any]]:
         """In-jit sync of the joint state over a mesh axis — leaves across
         ALL entries coalesce into per-dtype bucketed collectives (see
         ``parallel.sync.coalesced_sync_state``): one ``psum``/``pmin``/
@@ -837,7 +918,9 @@ class MetricCollection(OrderedDict):
         PaddedBuffer bucket (counts bitcast into the data payload for
         4-byte dtypes) — a buffer-state collection (AUROC +
         AveragePrecision + Spearman) stages 1 gather per dtype instead of
-        2 per buffer."""
+        2 per buffer. Pass a ``parallel.placement.MeshHierarchy`` as
+        ``axis_name`` on a 2-level (ici x dcn) mesh to stage every bucket
+        hierarchically (only per-slice payloads cross DCN)."""
         from metrics_tpu.parallel.sync import coalesced_sync_state
 
         flat = {(k, n): v for k, s in state.items() for n, v in s.items()}

@@ -476,7 +476,7 @@ class Metric(ABC):
         """Pairwise-associative merge (powers fused forward, tree-reduction, shard merging)."""
         return {name: merge_values(self._reductions[name], a[name], b[name]) for name in self._defaults}
 
-    def sync_state(self, state: State, axis_name: str) -> State:
+    def sync_state(self, state: State, axis_name: Any) -> State:
         """In-jit cross-device sync over a named mesh axis (use inside shard_map/pmap).
 
         Leaves of a common dtype sync through bucketed collectives
@@ -487,7 +487,12 @@ class Metric(ABC):
         ``all_gather`` per bucket (the counts vector rides inside the data
         payload for 4-byte dtypes) — a multi-state metric like StatScores
         pays one ``psum``, not four, and a two-buffer curve metric pays 1
-        gather, not 4."""
+        gather, not 4.
+
+        ``axis_name`` may also be a tuple of axes (the flat world span of a
+        2-level mesh) or a ``parallel.placement.MeshHierarchy`` — buckets
+        then stage HIERARCHICALLY, ici-first reduce / dcn-first gather, so
+        only per-slice payloads cross the slow interconnect."""
         return coalesced_sync_state(state, self._reductions, axis_name)
 
     def pure(self) -> PureMetric:

@@ -17,6 +17,19 @@ What gets counted, and on which plane:
 - **Bytes** are the local payload entering each collective, bucketed per
   (kind, dtype): ``size * itemsize`` of the (possibly traced) operand —
   shapes are static under tracing, so the byte count is exact either way.
+- **Crossing axis** (``ici``/``dcn``/``world``): which interconnect level a
+  collective spans. The hierarchical sync plane (``parallel/sync.py``) tags
+  its intra-slice stage ``ici`` and its cross-slice stage ``dcn``; flat
+  collectives over an undescribed axis stay ``world`` (on a multi-slice
+  topology a world-axis collective crosses DCN). Per-crossing BYTES are
+  ring-traffic, not payload: ``payload x (axis participants - 1)`` — the
+  per-device lower bound on bytes moved over that interconnect by a
+  ring/pairwise schedule (an all_gather/psum over n devices moves each
+  payload n-1 hops). This is the number the hierarchical plane shrinks:
+  a flat world gather on an (ici x dcn) = (L x S) mesh costs
+  ``p*(L*S-1)`` over the slow link's level, the two-stage plane only
+  ``p*(S-1)`` — so ``bytes_by_crossing`` is the regression surface
+  ``bench.py --check-collectives`` pins per axis.
 - **states_synced**: state leaves entering a sync plane (the number the
   compute-group dedup and bucket coalescing shrink).
 - **Cache traffic**: compute-group map builds, shared jitted-step lookups,
@@ -68,6 +81,8 @@ class CollectiveCounters:
         "enabled",
         "calls_by_kind",
         "bytes_by_kind_dtype",
+        "calls_by_crossing",
+        "bytes_by_crossing",
         "states_synced",
         "group_cache_hits",
         "group_cache_misses",
@@ -86,6 +101,8 @@ class CollectiveCounters:
     def _zero(self) -> None:
         self.calls_by_kind: Dict[str, int] = {}
         self.bytes_by_kind_dtype: Dict[tuple, int] = {}  # (kind, dtype str) -> bytes
+        self.calls_by_crossing: Dict[str, int] = {}  # 'ici' | 'dcn' | 'world' -> calls
+        self.bytes_by_crossing: Dict[str, int] = {}  # crossing -> ring traffic bytes
         self.states_synced = 0
         self.group_cache_hits = 0
         self.group_cache_misses = 0
@@ -95,21 +112,30 @@ class CollectiveCounters:
         self.launch_cache_misses = 0
 
     # ---------------------------------------------------------- recording
-    def record_collective(self, kind: str, value: Any) -> None:
+    def record_collective(
+        self, kind: str, value: Any, crossing: str = "world", fanout: Optional[int] = None
+    ) -> None:
         """Count one collective of ``kind`` moving ``value`` (array or scalar).
 
         ``value`` may be a tracer — only its static ``size``/``dtype`` are
-        read. Callers gate on ``COUNTERS.enabled`` so the disabled path never
-        reaches this method.
+        read. ``crossing`` names the interconnect level the collective spans
+        (``ici``/``dcn``/``world``); ``fanout`` is the participant count of
+        the axis it runs over, turning the payload into per-crossing ring
+        traffic ``payload * (fanout - 1)`` (unknown fanout counts the plain
+        payload). Callers gate on ``COUNTERS.enabled`` so the disabled path
+        never reaches this method.
         """
         size = getattr(value, "size", None)
         itemsize = getattr(getattr(value, "dtype", None), "itemsize", None)
         nbytes = int(size) * int(itemsize) if size is not None and itemsize is not None else 0
         dtype = str(getattr(value, "dtype", "other"))
+        traffic = nbytes * max(int(fanout) - 1, 1) if fanout else nbytes
         with self._lock:
             self.calls_by_kind[kind] = self.calls_by_kind.get(kind, 0) + 1
             key = (kind, dtype)
             self.bytes_by_kind_dtype[key] = self.bytes_by_kind_dtype.get(key, 0) + nbytes
+            self.calls_by_crossing[crossing] = self.calls_by_crossing.get(crossing, 0) + 1
+            self.bytes_by_crossing[crossing] = self.bytes_by_crossing.get(crossing, 0) + traffic
 
     def record_states_synced(self, n: int) -> None:
         with self._lock:
@@ -137,6 +163,8 @@ class CollectiveCounters:
                 "sync_bytes": sum(by_bucket.values()),
                 "calls_by_kind": {k: calls.get(k, 0) for k in KINDS if calls.get(k, 0)},
                 "bytes_by_kind_dtype": {f"{k}:{d}": b for (k, d), b in sorted(by_bucket.items())},
+                "calls_by_crossing": dict(sorted(self.calls_by_crossing.items())),
+                "bytes_by_crossing": dict(sorted(self.bytes_by_crossing.items())),
                 "states_synced": self.states_synced,
                 "group_cache": {"hits": self.group_cache_hits, "misses": self.group_cache_misses},
                 "step_cache": {"hits": self.step_cache_hits, "misses": self.step_cache_misses},
@@ -154,9 +182,11 @@ COUNTERS = CollectiveCounters()
 # Call-site helpers: one function call + a falsy attribute check when
 # counting is off. The instrumented sites are trace-time or epoch-level —
 # never the compiled replay path — so this is cheap even enabled.
-def record_collective(kind: str, value: Any) -> None:
+def record_collective(
+    kind: str, value: Any, crossing: str = "world", fanout: Optional[int] = None
+) -> None:
     if COUNTERS.enabled:
-        COUNTERS.record_collective(kind, value)
+        COUNTERS.record_collective(kind, value, crossing=crossing, fanout=fanout)
 
 
 def record_states_synced(n: int) -> None:

@@ -8,7 +8,16 @@ from metrics_tpu.parallel.buffer import (
     buffer_merge,
     buffer_values,
 )
-from metrics_tpu.parallel.placement import batch_sharded, class_sharded, row_sharded
+from metrics_tpu.parallel.placement import (
+    HostHierarchy,
+    MeshHierarchy,
+    batch_sharded,
+    class_sharded,
+    hierarchical_mesh,
+    host_hierarchy,
+    mesh_hierarchy,
+    row_sharded,
+)
 from metrics_tpu.parallel.sharded_epoch import (
     regroup_by_query,
     sharded_auroc,
@@ -27,6 +36,7 @@ from metrics_tpu.parallel.sync import (
     host_gather,
     merge_values,
     packable_gather,
+    slice_leader_gather,
     sync_state,
     sync_value,
 )

@@ -1,4 +1,6 @@
-"""Per-state mesh placement policies for 2-D (data x model) deployments.
+"""Per-state mesh placement policies for 2-D (data x model) deployments,
+plus the 2-LEVEL (ICI x DCN) topology descriptors the hierarchical sync
+plane runs on.
 
 The deployment story the north star asks for: per-class metric states live
 *sharded* over a model axis of the device mesh while every step's update syncs
@@ -8,12 +10,154 @@ per-class compute over the model axis and inserts the cross-``dp`` reduction
 automatically (the scaling-book recipe: annotate shardings, let XLA place the
 collectives; no reference counterpart — reference sync is a flat NCCL
 all-gather per state, torchmetrics/utilities/distributed.py:91-118).
-"""
-from typing import Any, Callable, Collection, Optional
 
+Multi-slice topologies add a second level: devices within a slice talk over
+ICI (fast), slices talk over DCN (slow). :class:`MeshHierarchy` names the two
+mesh axes so the sync planes (``parallel/sync.py``) and the sharded engines
+(``parallel/sharded_epoch.py``) can stage collectives hierarchically — reduce
+over ICI first, cross DCN only with the per-slice result (Horovod's
+hierarchical allreduce, Sergeev & Del Balso 2018; GSPMD nested meshes, Xu et
+al. 2021). :class:`HostHierarchy` is the host-plane analogue: which process
+belongs to which slice, and who the slice leader is.
+"""
+from typing import Any, Callable, Collection, NamedTuple, Optional, Sequence, Tuple, Union
+
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from metrics_tpu.parallel.buffer import PaddedBuffer
+
+
+class MeshHierarchy(NamedTuple):
+    """Names of the two levels of a 2-level device mesh.
+
+    ``ici_axis`` is the intra-slice (fast interconnect) mesh axis;
+    ``dcn_axis`` the cross-slice (slow interconnect) axis. The convention
+    everywhere in this library: the DCN axis is the OUTER mesh dimension
+    (``Mesh`` shape ``(n_slices, devices_per_slice)``, axes ``(dcn, ici)``),
+    so world order is slice-major and a ``PartitionSpec`` row-sharding over
+    ``(dcn_axis, ici_axis)`` lays rows out in the same order a flat
+    world-axis sharding over the identically-ordered device list would.
+    """
+
+    ici_axis: str = "ici"
+    dcn_axis: str = "dcn"
+
+    @property
+    def axes(self) -> Tuple[str, str]:
+        """Mesh axes in partition-spec (outer-first) order: ``(dcn, ici)``."""
+        return (self.dcn_axis, self.ici_axis)
+
+
+def mesh_hierarchy(mesh: Mesh, ici_axis: str = "ici", dcn_axis: str = "dcn") -> MeshHierarchy:
+    """An explicitly-constructed :class:`MeshHierarchy` over an existing mesh
+    (the route the (4,2)-virtual-CPU test mesh takes). Validates both axes."""
+    for axis in (ici_axis, dcn_axis):
+        if axis not in mesh.shape:
+            raise ValueError(
+                f"mesh_hierarchy: axis {axis!r} is not an axis of the mesh {dict(mesh.shape)}"
+            )
+    if ici_axis == dcn_axis:
+        raise ValueError("mesh_hierarchy: ici_axis and dcn_axis must name distinct mesh axes")
+    return MeshHierarchy(ici_axis=ici_axis, dcn_axis=dcn_axis)
+
+
+def _slice_id_of(device: Any) -> int:
+    """The slice a device belongs to: TPU slices report ``slice_index``;
+    single-slice backends (CPU/GPU, single-host TPU) group by process."""
+    sid = getattr(device, "slice_index", None)
+    if sid is not None:
+        return int(sid)
+    return int(getattr(device, "process_index", 0))
+
+
+def hierarchical_mesh(
+    devices: Optional[Sequence[Any]] = None,
+    slices: Optional[int] = None,
+    ici_axis: str = "ici",
+    dcn_axis: str = "dcn",
+) -> Tuple[Mesh, MeshHierarchy]:
+    """Build the 2-level ``(dcn, ici)`` mesh for the running topology.
+
+    On multi-slice TPU the grouping comes from ``device.slice_index``;
+    elsewhere devices group by process (each host = one "slice" of the DCN
+    level). ``slices`` overrides the grouping with an explicit count — the
+    route the virtual-CPU test mesh takes (e.g. 8 devices, ``slices=2`` ->
+    a (2, 4) mesh: 2 slices x 4 "ICI" devices). Slices must be equal-sized
+    (loud error otherwise: a ragged mesh cannot host uniform collectives).
+    """
+    import jax
+
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if slices is None:
+        ids = [_slice_id_of(d) for d in devices]
+        order = sorted(set(ids))
+        groups = [[d for d, i in zip(devices, ids) if i == sid] for sid in order]
+    else:
+        if slices <= 0 or len(devices) % slices:
+            raise ValueError(
+                f"hierarchical_mesh: {len(devices)} devices do not split into {slices} equal slices"
+            )
+        per = len(devices) // slices
+        groups = [devices[s * per: (s + 1) * per] for s in range(slices)]
+    per_slice = len(groups[0])
+    if any(len(g) != per_slice for g in groups):
+        raise ValueError(
+            f"hierarchical_mesh: ragged slices {[len(g) for g in groups]}; the 2-level mesh"
+            " needs every slice to hold the same device count"
+        )
+    grid = np.empty((len(groups), per_slice), dtype=object)
+    for i, group in enumerate(groups):
+        for j, device in enumerate(group):
+            grid[i, j] = device
+    return Mesh(grid, (dcn_axis, ici_axis)), MeshHierarchy(ici_axis=ici_axis, dcn_axis=dcn_axis)
+
+
+class HostHierarchy(NamedTuple):
+    """Host-plane slice membership: ``slice_of_process[p]`` is the slice id
+    of process ``p``. The slice LEADER is the lowest process index in each
+    slice — the one process per slice that (logically) joins the packed
+    cross-slice ``process_allgather`` in slice-leader gathers."""
+
+    slice_of_process: Tuple[int, ...]
+
+    @property
+    def n_slices(self) -> int:
+        return len(set(self.slice_of_process))
+
+    @property
+    def leaders(self) -> Tuple[int, ...]:
+        """One process per slice (the lowest index), in slice order."""
+        first: dict = {}
+        for p, s in enumerate(self.slice_of_process):
+            first.setdefault(s, p)
+        return tuple(first[s] for s in sorted(first))
+
+    def is_leader(self, process_index: int) -> bool:
+        return process_index in self.leaders
+
+
+def host_hierarchy(slices: Optional[Sequence[int]] = None) -> HostHierarchy:
+    """The running job's :class:`HostHierarchy`.
+
+    Derived from each process's devices (``slice_index`` on multi-slice TPU,
+    one slice per process elsewhere — the degenerate single-slice shape on a
+    single host). ``slices`` constructs it explicitly: a sequence mapping
+    process index -> slice id (the test route).
+    """
+    import jax
+
+    if slices is not None:
+        mapping = tuple(int(s) for s in slices)
+        if len(mapping) != jax.process_count():
+            raise ValueError(
+                f"host_hierarchy: got {len(mapping)} slice ids for {jax.process_count()} processes"
+            )
+        return HostHierarchy(mapping)
+    of_process = {}
+    for d in jax.devices():
+        of_process.setdefault(int(getattr(d, "process_index", 0)), _slice_id_of(d))
+    return HostHierarchy(tuple(of_process[p] for p in sorted(of_process)))
 
 
 def class_sharded(
@@ -53,7 +197,9 @@ def class_sharded(
 
 
 def row_sharded(
-    mesh: Mesh, axis: str = "dp", names: Optional[Collection[str]] = None
+    mesh: Mesh,
+    axis: Union[str, Tuple[str, ...], MeshHierarchy] = "dp",
+    names: Optional[Collection[str]] = None,
 ) -> Callable[[str, Any], Any]:
     """Placement callable for ``Metric.device_put``: keep cat-state
     (PaddedBuffer) epoch rows SHARDED over mesh axis ``axis`` — the front
@@ -73,6 +219,11 @@ def row_sharded(
     Non-buffer states (scalars, counters) replicate. Pass ``names`` to
     restrict which cat states shard.
 
+    ``axis`` may also be a :class:`MeshHierarchy` (or the equivalent
+    ``(dcn_axis, ici_axis)`` tuple) over a 2-level mesh: rows shard over
+    BOTH levels in slice-major order, and ``compute()`` dispatches the
+    HIERARCHICAL sharded engines (ICI-local rings, one DCN exchange).
+
     Example::
 
         mesh = Mesh(np.array(jax.devices()), ("dp",))
@@ -82,7 +233,15 @@ def row_sharded(
             auroc.update(preds, target)   # rows appended sharded
         auroc.compute()                   # exact ring, O(capacity/n)/device
     """
-    axis_size = mesh.shape[axis]
+    if isinstance(axis, MeshHierarchy):
+        axis = axis.axes
+    if isinstance(axis, (tuple, list)):
+        axis = tuple(axis)
+        axis_size = 1
+        for a in axis:
+            axis_size *= mesh.shape[a]
+    else:
+        axis_size = mesh.shape[axis]
 
     def resolve(name: str, value: Any) -> Any:
         if isinstance(value, PaddedBuffer) and (names is None or name in names):

@@ -32,6 +32,7 @@ from metrics_tpu.observability.devtime import DEVTIME as _DEVTIME, fence as _fen
 from metrics_tpu.observability.jaxprof import annotate
 from metrics_tpu.observability.trace import TRACE, span as _span
 from metrics_tpu.parallel.buffer import PaddedBuffer
+from metrics_tpu.parallel.placement import MeshHierarchy
 from metrics_tpu.utils import compat
 from metrics_tpu.parallel.sharded_epoch import (
     sharded_auroc_matrix,
@@ -48,9 +49,28 @@ _LAUNCH_CACHE: Dict[Any, Callable] = {}
 _LAUNCH_CACHE_MAX = 64
 
 
-def epoch_shard_info_of_state(value: Any) -> Optional[Tuple[Mesh, str]]:
+def _world_of(mesh: Mesh, axis: Any) -> int:
+    """Device count an engine axis spans on ``mesh``."""
+    if isinstance(axis, MeshHierarchy):
+        return mesh.shape[axis.dcn_axis] * mesh.shape[axis.ici_axis]
+    return mesh.shape[axis]
+
+
+def _spec_entry(axis: Any) -> Any:
+    """The ``PartitionSpec`` leading entry for an engine axis."""
+    return axis.axes if isinstance(axis, MeshHierarchy) else axis
+
+
+def epoch_shard_info_of_state(value: Any) -> Optional[Tuple[Mesh, Any]]:
     """(mesh, axis) when ``value`` is a PaddedBuffer whose rows are sharded
-    over exactly one mesh axis (trailing dims replicated), else None."""
+    over exactly one mesh axis — or one 2-LEVEL axis pair — else None.
+
+    A two-name leading spec entry ``P((a, b), ...)`` is read as a 2-level
+    hierarchy with ``a`` the outer cross-slice (dcn) axis and ``b`` the
+    intra-slice (ici) axis — the ``parallel.placement`` slice-major
+    convention — and the returned axis is the :class:`MeshHierarchy`, so
+    ``compute()`` dispatches the hierarchical engines.
+    """
     if not isinstance(value, PaddedBuffer):
         return None
     sharding = getattr(value.data, "sharding", None)
@@ -61,13 +81,17 @@ def epoch_shard_info_of_state(value: Any) -> Optional[Tuple[Mesh, str]]:
         return None
     axis = spec[0]
     if isinstance(axis, (tuple, list)):
-        if len(axis) != 1:
+        if len(axis) == 1:
+            axis = axis[0]
+        elif len(axis) == 2:
+            axis = MeshHierarchy(dcn_axis=axis[0], ici_axis=axis[1])
+        else:
             return None
-        axis = axis[0]
     if any(s is not None for s in spec[1:]):
         return None
     mesh = sharding.mesh
-    if mesh.shape[axis] <= 1 or value.data.shape[0] % mesh.shape[axis]:
+    world = _world_of(mesh, axis)
+    if world <= 1 or value.data.shape[0] % world:
         return None
     # the dispatch (and the host-sync suppression keyed off it) is only sound
     # when the mesh's collectives span EVERY process — a local-devices-only
@@ -124,7 +148,7 @@ def _launch(
     axis, shapes) so repeated epochs and config-identical instances pay one
     trace.
     """
-    n = mesh.shape[axis]
+    n = _world_of(mesh, axis)
     local = datas[0].shape[0] // n
     full_key = (key, mesh, axis, out_specs, tuple((d.shape, str(d.dtype)) for d in datas))
     fn = _LAUNCH_CACHE.get(full_key)
@@ -134,11 +158,19 @@ def _launch(
 
         def shard_fn(cnt, *blocks):
             with annotate("sharded.engine"):
-                i = jax.lax.axis_index(axis)
+                if isinstance(axis, MeshHierarchy):
+                    # slice-major world index: P((dcn, ici)) row blocks are
+                    # laid out dcn-major, matching this linearization
+                    i = jax.lax.axis_index(axis.dcn_axis) * mesh.shape[
+                        axis.ici_axis
+                    ] + jax.lax.axis_index(axis.ici_axis)
+                else:
+                    i = jax.lax.axis_index(axis)
                 rows = i * local + jnp.arange(local)
                 return body(blocks, rows < cnt)
 
-        in_specs = (P(),) + tuple(P(axis, *([None] * (d.ndim - 1))) for d in datas)
+        entry = _spec_entry(axis)
+        in_specs = (P(),) + tuple(P(entry, *([None] * (d.ndim - 1))) for d in datas)
         fn = jax.jit(
             compat.shard_map(
                 shard_fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
@@ -554,7 +586,7 @@ def retrieval_sharded(metric: Any) -> Optional[Array]:
     if bucket_capacity is None:
         # 4x the balanced per-destination load: headroom for skewed query-id
         # distributions while keeping the regrouped block O(local rows)
-        n = mesh.shape[axis]
+        n = _world_of(mesh, axis)
         local = metric.idx.data.shape[0] // n
         bucket_capacity = max(4 * -(-local // n), 8)
 

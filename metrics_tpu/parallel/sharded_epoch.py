@@ -35,8 +35,18 @@ epoch sharded *through* compute:
 
 Use inside ``shard_map`` over the data axis. All functions are jit-safe,
 static-shape, and collective-only (no host round trips).
+
+Every engine's ``axis_name`` may also be a
+:class:`~metrics_tpu.parallel.placement.MeshHierarchy` over a 2-level
+(ici x dcn) mesh. The rings then stay ICI-LOCAL with a single DCN exchange:
+each device's sorted pack (or raw rows, for Kendall) crosses DCN exactly
+once via one ``all_gather`` over the dcn axis, and the ring circulates the
+cross-slice stack over the ici axis only — per-payload DCN traffic drops
+from W-1 ring hops to S-1. The retrieval regroup becomes two staged
+``all_to_all``s (slice routing over dcn, then device routing over ici), and
+scalar reductions psum ici-first.
 """
-from typing import NamedTuple, Optional, Tuple
+from typing import Any, NamedTuple, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -44,14 +54,44 @@ from jax import Array
 
 from metrics_tpu.observability.counters import record_collective
 from metrics_tpu.observability.jaxprof import annotate
+from metrics_tpu.parallel.placement import MeshHierarchy
 from metrics_tpu.utils.compat import axis_size, ensure_varying
 
 # pad query id for regroup ghost rows; real query ids must not use it
 PAD_QUERY_ID = jnp.iinfo(jnp.int32).max
 
+# an engine axis: one named mesh axis, or the 2-level hierarchy
+EngineAxis = Union[str, MeshHierarchy]
+
 
 # varying-manual-axes marking is jax-version dependent; see utils/compat.py
 _ensure_varying = ensure_varying
+
+
+def _varying(x: Any, axis: EngineAxis) -> Any:
+    """``ensure_varying`` over one axis or both levels of a hierarchy."""
+    if isinstance(axis, MeshHierarchy):
+        return ensure_varying(ensure_varying(x, axis.ici_axis), axis.dcn_axis)
+    return ensure_varying(x, axis)
+
+
+def _axis_world(axis: EngineAxis) -> int:
+    """World size the engine spans (trace-time)."""
+    if isinstance(axis, MeshHierarchy):
+        return axis_size(axis.ici_axis) * axis_size(axis.dcn_axis)
+    return axis_size(axis)
+
+
+def _engine_psum(x: Array, axis: EngineAxis) -> Array:
+    """``psum`` over the engine axis — ici-first under a hierarchy, so only
+    the per-slice partial sums cross DCN."""
+    if isinstance(axis, MeshHierarchy):
+        record_collective("psum", x, crossing="ici", fanout=axis_size(axis.ici_axis))
+        x = jax.lax.psum(x, axis.ici_axis)
+        record_collective("psum", x, crossing="dcn", fanout=axis_size(axis.dcn_axis))
+        return jax.lax.psum(x, axis.dcn_axis)
+    record_collective("psum", x, fanout=_axis_world(axis))
+    return jax.lax.psum(x, axis)
 
 
 class _SortedPack(NamedTuple):
@@ -87,14 +127,17 @@ def _below_tie_ge(pack: _SortedPack, q: Array) -> Tuple[Array, Array, Array, Arr
 
 
 def _ring_stats_cols(
-    preds_cm: Array, target_cm: Array, weights_cm: Array, axis_name: str
+    preds_cm: Array, target_cm: Array, weights_cm: Array, axis_name: EngineAxis
 ) -> Tuple[Array, Array, Array, Array]:
     """Per-class ring statistics for ``(C, m)`` column-major shards.
 
     One ``ppermute`` of the STACKED pack per hop (a single (C, m)-sized ICI
     transfer, not C small ones); the searchsorted accumulation vmaps over the
-    class axis. Returns four ``(C, m)`` arrays.
+    class axis. Returns four ``(C, m)`` arrays. A :class:`MeshHierarchy`
+    axis runs the hierarchical variant below instead.
     """
+    if isinstance(axis_name, MeshHierarchy):
+        return _ring_stats_cols_hier(preds_cm, target_cm, weights_cm, axis_name)
     n = axis_size(axis_name)
     pack = jax.vmap(_pack)(preds_cm, target_cm, weights_cm)
     perm = [(j, (j + 1) % n) for j in range(n)]
@@ -107,13 +150,55 @@ def _ring_stats_cols(
 
     # one ppermute of the 3-leaf pack staged per loop body (n-1 executed hops)
     for leaf in pack:
-        record_collective("ppermute", leaf)
+        record_collective("ppermute", leaf, fanout=n)
     # local contribution first, then n-1 ring hops (no dead final collective);
     # the named scope labels the ring's ops on the device timeline so a
     # profiler session attributes the hop kernels to the engine phase
     with annotate("sharded.engine.ring"):
         acc = jax.vmap(_below_tie_ge)(pack, preds_cm)
         (acc, _) = jax.lax.fori_loop(0, n - 1, body, (acc, pack))
+    return acc
+
+
+def _ring_stats_cols_hier(
+    preds_cm: Array, target_cm: Array, weights_cm: Array, h: MeshHierarchy
+) -> Tuple[Array, Array, Array, Array]:
+    """The ICI-local ring with a single DCN exchange.
+
+    Each device's sorted pack crosses DCN exactly ONCE: one ``all_gather``
+    over the dcn axis stacks the same-ici-position packs of every slice,
+    and the ring then circulates that ``(S, C, m)`` stack over the ici axis
+    only (L-1 hops). Every device still accumulates against all S*L packs
+    exactly once, so results are bit-identical to the flat ring — only the
+    crossing structure changes (DCN: one p-sized exchange instead of
+    carrying the pack across slice boundaries on W-1 hops).
+    """
+    L = axis_size(h.ici_axis)
+    S = axis_size(h.dcn_axis)
+    pack = jax.vmap(_pack)(preds_cm, target_cm, weights_cm)
+    for leaf in pack:
+        record_collective("all_gather", leaf, crossing="dcn", fanout=S)
+    packs = _SortedPack(*(jax.lax.all_gather(leaf, h.dcn_axis) for leaf in pack))  # (S, C, m)
+
+    def acc_stack(stack: _SortedPack) -> Tuple[Array, ...]:
+        # sum the per-slice-pack statistics: vmap over the S stacked packs,
+        # the inner per-class vmap matching the flat ring's accumulation
+        outs = jax.vmap(lambda pk: jax.vmap(_below_tie_ge)(pk, preds_cm))(stack)
+        return tuple(jnp.sum(o, axis=0) for o in outs)
+
+    perm = [(j, (j + 1) % L) for j in range(L)]
+
+    def body(_, carry):
+        acc, visiting = carry
+        visiting = jax.lax.ppermute(visiting, h.ici_axis, perm)
+        acc = tuple(a + b for a, b in zip(acc, acc_stack(visiting)))
+        return acc, visiting
+
+    for leaf in packs:
+        record_collective("ppermute", leaf, crossing="ici", fanout=L)
+    with annotate("sharded.engine.ring"):
+        acc = acc_stack(packs)
+        (acc, _) = jax.lax.fori_loop(0, L - 1, body, (acc, packs))
     return acc
 
 
@@ -152,8 +237,7 @@ def sharded_auroc_matrix(
     # one coalesced collective for all three reductions (collectives are
     # latency-bound at these sizes; see parallel.sync.coalesced_sync_state)
     stacked = jnp.stack([u_local, jnp.sum(wp, axis=-1), jnp.sum(w * (1.0 - y), axis=-1)])
-    record_collective("psum", stacked)
-    u, pos, neg = jax.lax.psum(stacked, axis_name)
+    u, pos, neg = _engine_psum(stacked, axis_name)
     denom = pos * neg
     scores = jnp.where(denom == 0, jnp.nan, u / jnp.where(denom == 0, 1.0, denom))
     return (scores, pos) if with_support else scores
@@ -173,8 +257,7 @@ def sharded_average_precision_matrix(
     wp = w * y
     contrib = jnp.sum(wp * wp_ge / jnp.maximum(wp_ge + wn_ge, 1e-38), axis=-1)
     stacked = jnp.stack([contrib, jnp.sum(wp, axis=-1)])
-    record_collective("psum", stacked)
-    total, pos = jax.lax.psum(stacked, axis_name)
+    total, pos = _engine_psum(stacked, axis_name)
     scores = jnp.where(pos == 0, jnp.nan, total / jnp.where(pos == 0, 1.0, pos))
     return (scores, pos) if with_support else scores
 
@@ -232,16 +315,35 @@ def sharded_clf_curve_matrix(
     """
     from metrics_tpu.functional.classification.curve_static import _compact
 
-    w = _ensure_varying(weights_cm, axis_name)
+    w = _varying(weights_cm, axis_name)
     p = jnp.where(w > 0, preds_cm, -jnp.inf)
     _, _, wp_ge, wn_ge = _ring_stats_cols(p, target_cm, w, axis_name)
 
     # the four (C, m) sort operands ride ONE coalesced all_gather: stacked to
     # (4, C, m) and gathered tiled along the row axis — same payload bytes,
-    # one collective instead of four (small gathers are latency-bound)
+    # one collective instead of four (small gathers are latency-bound). Under
+    # a hierarchy the gather is two-staged (dcn exchange of the local rows,
+    # ici replication of the cross-slice tile); the key-sort below makes the
+    # concatenation order immaterial.
     stacked = jnp.stack([-p, wp_ge, wn_ge, w])
-    record_collective("coalesced_gather", stacked)
-    gathered = jax.lax.all_gather(stacked, axis_name=axis_name, axis=2, tiled=True)
+    if isinstance(axis_name, MeshHierarchy):
+        record_collective(
+            "coalesced_gather", stacked, crossing="dcn",
+            fanout=axis_size(axis_name.dcn_axis),
+        )
+        gathered = jax.lax.all_gather(
+            stacked, axis_name=axis_name.dcn_axis, axis=2, tiled=True
+        )
+        record_collective(
+            "coalesced_gather", gathered, crossing="ici",
+            fanout=axis_size(axis_name.ici_axis),
+        )
+        gathered = jax.lax.all_gather(
+            gathered, axis_name=axis_name.ici_axis, axis=2, tiled=True
+        )
+    else:
+        record_collective("coalesced_gather", stacked, fanout=_axis_world(axis_name))
+        gathered = jax.lax.all_gather(stacked, axis_name=axis_name, axis=2, tiled=True)
     neg_s, tps, fps, wv = jax.lax.sort(
         (gathered[0], gathered[1], gathered[2], gathered[3]), num_keys=1
     )
@@ -272,8 +374,8 @@ def sharded_rank(
     by the caller); the same sorted-pack ring as AUROC, one extra use.
     """
     w = jnp.ones_like(scores, jnp.float32) if sample_weights is None else sample_weights
-    w = _ensure_varying(w, axis_name)
-    y = _ensure_varying(jnp.zeros_like(scores, jnp.float32), axis_name)
+    w = _varying(w, axis_name)
+    y = _varying(jnp.zeros_like(scores, jnp.float32), axis_name)
     below, tie, _, _ = _ring_stats_cols(scores[None, :], y[None, :], w[None, :], axis_name)
     return _midrank(below[0], tie[0])
 
@@ -297,18 +399,17 @@ def sharded_spearman(
     empty epoch, the scipy convention.
     """
     w = jnp.ones_like(preds, jnp.float32) if sample_weights is None else sample_weights
-    w = _ensure_varying(w, axis_name)
+    w = _varying(w, axis_name)
     # one stacked (2, m) ring for both arrays: a single ppermute payload per
     # hop instead of two back-to-back rings (ring latency dominates at scale)
     stacked = jnp.stack([preds.astype(jnp.float32), target.astype(jnp.float32)])
-    y2 = _ensure_varying(jnp.zeros_like(stacked), axis_name)
+    y2 = _varying(jnp.zeros_like(stacked), axis_name)
     w2 = jnp.broadcast_to(w, stacked.shape)
     below, tie, _, _ = _ring_stats_cols(stacked, y2, w2, axis_name)
     ranks = _midrank(below, tie)
     rx, ry = ranks[0], ranks[1]
     w_sum = jnp.sum(w)
-    record_collective("psum", w_sum)
-    total = jax.lax.psum(w_sum, axis_name)
+    total = _engine_psum(w_sum, axis_name)
     # scale ranks to O(1) before the moment sums: correlation is affine-
     # invariant and raw ranks would push f32 accumulations to O(N^3)
     scale = 1.0 / jnp.maximum(total, 1.0)
@@ -318,8 +419,7 @@ def sharded_spearman(
         jnp.sum(w * rx), jnp.sum(w * ry),
         jnp.sum(w * rx * rx), jnp.sum(w * ry * ry), jnp.sum(w * rx * ry),
     ])
-    record_collective("psum", moments)
-    sx, sy, sxx, syy, sxy = jax.lax.psum(moments, axis_name)
+    sx, sy, sxx, syy, sxy = _engine_psum(moments, axis_name)
     cov = total * sxy - sx * sy
     var_x = total * sxx - sx * sx
     var_y = total * syy - sy * sy
@@ -346,12 +446,14 @@ def sharded_kendall(
     concatenated epoch. ``sample_weights`` is a 0/1 validity mask. ``nan``
     when either array is globally constant or the epoch is empty.
     """
-    n = axis_size(axis_name)
+    hier = isinstance(axis_name, MeshHierarchy)
+    ring_axis = axis_name.ici_axis if hier else axis_name
+    n = axis_size(ring_axis)
     m = preds.shape[0]
     x = preds.astype(jnp.float32)
     y = target.astype(jnp.float32)
     w = jnp.ones((m,), jnp.float32) if sample_weights is None else sample_weights.astype(jnp.float32)
-    w = _ensure_varying(w, axis_name)
+    w = _varying(w, axis_name)
 
     chunk = min(chunk, m)
     n_chunks = -(-m // chunk)
@@ -380,17 +482,30 @@ def sharded_kendall(
         return jax.lax.fori_loop(0, n_chunks, block, acc)
 
     zeros = jnp.zeros_like(xq)  # derived from the shard: varying-axis typed
-    for leaf in (x, y, w):
-        record_collective("ppermute", leaf)
-    acc = contract((x, y, w), (zeros, zeros, zeros))
+    if hier:
+        # the single DCN exchange: each device's raw rows cross DCN once,
+        # and the quadratic contraction rides the ICI-only ring with the
+        # (S*m,)-row cross-slice stack as the visiting payload
+        S = axis_size(axis_name.dcn_axis)
+
+        def dgather(v: Array) -> Array:
+            record_collective("all_gather", v, crossing="dcn", fanout=S)
+            return jax.lax.all_gather(v, axis_name.dcn_axis).reshape(-1)
+
+        visiting0 = (dgather(x), dgather(y), dgather(w))
+    else:
+        visiting0 = (x, y, w)
+    for leaf in visiting0:
+        record_collective("ppermute", leaf, crossing="ici" if hier else "world", fanout=n)
+    acc = contract(visiting0, (zeros, zeros, zeros))
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     def hop(_, carry):
         acc, visiting = carry
-        visiting = jax.lax.ppermute(visiting, axis_name, perm)
+        visiting = jax.lax.ppermute(visiting, ring_axis, perm)
         return contract(visiting, acc), visiting
 
-    (s_all, tx_all, ty_all), _ = jax.lax.fori_loop(0, n - 1, hop, (acc, (x, y, w)))
+    (s_all, tx_all, ty_all), _ = jax.lax.fori_loop(0, n - 1, hop, (acc, visiting0))
     s_all, tx_all, ty_all = s_all[:m], tx_all[:m], ty_all[:m]
 
     # one coalesced collective for all five epoch sums
@@ -398,8 +513,7 @@ def sharded_kendall(
         jnp.sum(w * s_all), jnp.sum(w * tx_all), jnp.sum(w * ty_all),
         jnp.sum(w), jnp.sum(w * w),
     ])
-    record_collective("psum", sums)
-    s, t_x, t_y, w_tot, w_sq = jax.lax.psum(sums, axis_name)
+    s, t_x, t_y, w_tot, w_sq = _engine_psum(sums, axis_name)
     s = s / 2.0
     n1 = (t_x - w_sq) / 2.0  # pairs tied in x (diagonal removed)
     n2 = (t_y - w_sq) / 2.0
@@ -408,11 +522,57 @@ def sharded_kendall(
     return jnp.where(denom > 0, s / jnp.where(denom > 0, denom, 1.0), jnp.nan)
 
 
+def _route_rows(
+    dest: Array,
+    payload: Tuple[Tuple[Array, Any], ...],
+    n: int,
+    capacity: int,
+    axis_name: str,
+    crossing: str = "world",
+) -> Tuple[Tuple[Array, ...], Array, Array]:
+    """One static-shape routing stage: bucket rows by ``dest`` in ``[0, n)``
+    (``>= n`` marks ghost rows that take no slot) and exchange the buckets
+    over ``axis_name`` with one ``all_to_all`` per payload array.
+
+    ``payload`` is ``((values, fill), ...)``; returns the routed arrays
+    (each ``(n * capacity,)``), the routed real-row mask, and the LOCAL
+    overflow count (rows past their destination bucket's capacity).
+    """
+    rows = dest.shape[0]
+    order = jnp.argsort(dest, stable=True)
+    sorted_dest = dest[order]
+    counts = jax.ops.segment_sum(jnp.ones((rows,), jnp.int32), sorted_dest, n + 1)[:n]
+    starts = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]])
+    slot = jnp.arange(rows, dtype=jnp.int32) - starts[jnp.minimum(sorted_dest, n - 1)]
+
+    in_range = (slot < capacity) & (sorted_dest < n)
+    flat = jnp.where(in_range, sorted_dest * capacity + slot, n * capacity)  # OOB -> drop
+
+    def scatter(values: Array, fill) -> Array:
+        out = jnp.full((n * capacity,), fill, dtype=values.dtype)
+        return out.at[flat].set(values[order], mode="drop")
+
+    def ex(x):
+        record_collective("all_to_all", x, crossing=crossing, fanout=n)
+        return jax.lax.all_to_all(x, axis_name=axis_name, split_axis=0, concat_axis=0, tiled=True)
+
+    # regroup exchange labeled for the device timeline (profiler sessions
+    # attribute the all_to_all kernels to the engine phase by this scope)
+    with annotate("sharded.engine.regroup"):
+        routed = tuple(
+            ex(scatter(values, fill).reshape(n, capacity)).reshape(-1)
+            for values, fill in payload
+        )
+        real = ex(scatter(jnp.ones((rows,), jnp.bool_), False).reshape(n, capacity)).reshape(-1)
+    overflow = jnp.sum(jnp.maximum(counts - capacity, 0))
+    return routed, real, overflow
+
+
 def regroup_by_query(
     idx: Array,
     preds: Array,
     target: Array,
-    axis_name: str,
+    axis_name: EngineAxis,
     capacity: Optional[int] = None,
     valid: Optional[Array] = None,
 ) -> Tuple[Array, Array, Array, Array, Array]:
@@ -427,7 +587,14 @@ def regroup_by_query(
     distributions. ``valid`` (bool, per row) excludes rows entirely: they
     take no bucket slot, never count as dropped, and arrive as pad rows
     (the padded-buffer epoch-state story, ``parallel/sharded_dispatch.py``).
+
+    A :class:`MeshHierarchy` axis routes in TWO stages — to the destination
+    slice over dcn, then to the destination device over ici — so each row
+    crosses DCN at most once and the second exchange stays intra-slice
+    (``_regroup_by_query_hier``).
     """
+    if isinstance(axis_name, MeshHierarchy):
+        return _regroup_by_query_hier(idx, preds, target, axis_name, capacity, valid)
     n = axis_size(axis_name)
     rows = idx.shape[0]
     if capacity is None:
@@ -436,39 +603,80 @@ def regroup_by_query(
     dest = idx % n  # floor-mod: negative ids still land in [0, n)
     if valid is not None:
         dest = jnp.where(valid, dest, n)  # ghost bucket: sorts last, never scatters
-    order = jnp.argsort(dest, stable=True)
-    sorted_dest = dest[order]
-    counts = jax.ops.segment_sum(jnp.ones((rows,), jnp.int32), sorted_dest, n + 1)[:n]
-    starts = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]])
-    slot = jnp.arange(rows, dtype=jnp.int32) - starts[jnp.minimum(sorted_dest, n - 1)]
-
-    in_range = (slot < capacity) & (sorted_dest < n)
-    flat = jnp.where(in_range, sorted_dest * capacity + slot, n * capacity)  # OOB -> drop
-
-    def scatter(values: Array, fill) -> Array:
-        out = jnp.full((n * capacity,), fill, dtype=values.dtype)
-        return out.at[flat].set(values[order], mode="drop")
-
-    bucket_idx = scatter(idx, PAD_QUERY_ID).reshape(n, capacity)
-    bucket_preds = scatter(preds, jnp.float32(-jnp.inf)).reshape(n, capacity)
-    bucket_target = scatter(target, jnp.zeros((), target.dtype)).reshape(n, capacity)
-    bucket_real = scatter(jnp.ones((rows,), jnp.bool_), False).reshape(n, capacity)
-
-    def ex(x):
-        record_collective("all_to_all", x)
-        return jax.lax.all_to_all(x, axis_name=axis_name, split_axis=0, concat_axis=0, tiled=True)
-    # regroup exchange labeled for the device timeline (profiler sessions
-    # attribute the all_to_all kernels to the engine phase by this scope)
-    with annotate("sharded.engine.regroup"):
-        my_idx = ex(bucket_idx).reshape(-1)
-        my_preds = ex(bucket_preds).reshape(-1)
-        my_target = ex(bucket_target).reshape(-1)
-        my_real = ex(bucket_real).reshape(-1)
-
-    overflow = jnp.sum(jnp.maximum(counts - capacity, 0))
-    record_collective("psum", overflow)
-    dropped = jax.lax.psum(overflow, axis_name)
+    (my_idx, my_preds, my_target), my_real, overflow = _route_rows(
+        dest,
+        (
+            (idx, PAD_QUERY_ID),
+            (preds, jnp.float32(-jnp.inf)),
+            (target, jnp.zeros((), target.dtype)),
+        ),
+        n,
+        capacity,
+        axis_name,
+    )
+    dropped = _engine_psum(overflow, axis_name)
     return my_idx, my_preds, my_target, ~my_real, dropped
+
+
+def _regroup_by_query_hier(
+    idx: Array,
+    preds: Array,
+    target: Array,
+    h: MeshHierarchy,
+    capacity: Optional[int],
+    valid: Optional[Array],
+) -> Tuple[Array, Array, Array, Array, Array]:
+    """The two-stage regroup: rows cross DCN once, then settle intra-slice.
+
+    Query ``q``'s home is world device ``q mod W`` = (slice ``(q mod W) //
+    L``, device ``(q mod W) mod L``) — the SAME assignment the flat regroup
+    makes over slice-major device order. Stage 1 ``all_to_all``s rows to
+    their home slice over dcn; stage 2 settles them on the home device over
+    ici. Overflow is counted at BOTH stages and summed into ``dropped``.
+    With ``capacity`` given, stage-1 buckets get ``capacity * L`` slots (a
+    slice absorbs L devices' quota) and stage-2 headroom scales to match;
+    defaults give 2x-average headroom per stage.
+    """
+    L = axis_size(h.ici_axis)
+    S = axis_size(h.dcn_axis)
+    W = S * L
+    rows = idx.shape[0]
+
+    cap1 = max(2 * -(-rows // S), 1) if capacity is None else max(capacity * L, 1)
+    w_dest = idx % W  # floor-mod: world home, slice-major device order
+    s_dest = w_dest // L
+    if valid is not None:
+        s_dest = jnp.where(valid, s_dest, S)
+    (i1, p1, t1), real1, over1 = _route_rows(
+        s_dest,
+        (
+            (idx, PAD_QUERY_ID),
+            (preds, jnp.float32(-jnp.inf)),
+            (target, jnp.zeros((), target.dtype)),
+        ),
+        S,
+        cap1,
+        h.dcn_axis,
+        crossing="dcn",
+    )
+
+    m2 = i1.shape[0]  # S * cap1 rows, now on the home slice
+    cap2 = max(2 * -(-m2 // L), 1) if capacity is None else max(S * capacity, 1)
+    d_dest = jnp.where(real1, (i1 % W) % L, L)
+    (i2, p2, t2), real2, over2 = _route_rows(
+        d_dest,
+        (
+            (i1, PAD_QUERY_ID),
+            (p1, jnp.float32(-jnp.inf)),
+            (t1, jnp.zeros((), t1.dtype)),
+        ),
+        L,
+        cap2,
+        h.ici_axis,
+        crossing="ici",
+    )
+    dropped = _engine_psum(over1 + over2, h)
+    return i2, p2, t2, ~real2, dropped
 
 
 def sharded_retrieval_sums(
@@ -493,13 +701,11 @@ def sharded_retrieval_sums(
         idx, preds, target, axis_name, capacity, valid=valid
     )
     total, count, flag = metric._device_sums(g_idx, g_preds, g_target, pad=pad)
-    record_collective("psum", total)
-    total = jax.lax.psum(total, axis_name)
+    total = _engine_psum(total, axis_name)
     # count/flag coalesce into one integer collective (total keeps its own
     # float plane: folding counts into f32 would lose exactness past 2^24)
     int_plane = jnp.stack([jnp.asarray(count, jnp.int32), flag.astype(jnp.int32)])
-    record_collective("psum", int_plane)
-    count, flag_sum = jax.lax.psum(int_plane, axis_name)
+    count, flag_sum = _engine_psum(int_plane, axis_name)
     flag = flag_sum > 0
     mean = jnp.where(count == 0, 0.0, total / jnp.maximum(count, 1))
     return mean, flag, dropped

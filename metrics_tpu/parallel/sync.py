@@ -17,6 +17,19 @@ TPU-native design — two sync planes instead of one NCCL call:
 2. **Host plane** (``host_gather``): for eval loops driven outside jit on
    multi-host deployments — per-leaf ``multihost_utils.process_allgather``
    (the DCN analogue of the reference's Gloo path), identity on one process.
+
+Both planes are TOPOLOGY-AWARE: pass a :class:`~metrics_tpu.parallel.placement.
+MeshHierarchy` (``hierarchy=``, or directly as the axis argument) and every
+staged collective splits into two stages — reductions run over the fast
+intra-slice ``ici`` axis first and only the per-slice result crosses the slow
+``dcn`` axis; gathers exchange each device's payload across slices FIRST
+(payload ``p`` over the S-sized dcn axis — the slice-leader exchange
+load-balanced over the slice's devices) and then replicate the cross-slice
+stacks intra-slice. DCN ring traffic per payload byte drops from ``W-1``
+hops (flat world axis, W = S*L) to ``S-1``. A single-slice hierarchy
+(dcn axis size 1) collapses to the flat plane over the ici axis — same
+collective count, same program. The host plane's analogue is
+:func:`slice_leader_gather`.
 """
 import functools
 from typing import Any, Callable, Dict, List, Optional, Union
@@ -28,6 +41,7 @@ from jax import Array
 from metrics_tpu.observability.counters import record_collective, record_states_synced
 from metrics_tpu.observability.jaxprof import annotate
 from metrics_tpu.parallel.buffer import PaddedBuffer, buffer_all_gather
+from metrics_tpu.parallel.placement import HostHierarchy, MeshHierarchy
 from metrics_tpu.utils.data import dim_zero_cat, dim_zero_max, dim_zero_mean, dim_zero_min, dim_zero_sum
 
 # A reduction spec as accepted by ``Metric.add_state`` (reference metric.py:88-148),
@@ -151,36 +165,123 @@ def is_mergeable(fx: ReduceFx, default: Any) -> bool:
     return fx in ("sum", "min", "max") or is_associative(fx)
 
 
-def sync_value(fx: ReduceFx, value: Any, axis_name: str) -> Any:
+# ------------------------------------------------------ hierarchy plumbing
+def _fanout(axis_name: Any) -> Optional[int]:
+    """Trace-time participant count of a (possibly tuple) named axis, or
+    None outside an axis binding — counters then fall back to payload bytes."""
+    from metrics_tpu.utils.compat import axis_size
+
+    try:
+        return int(axis_size(axis_name))
+    except Exception:
+        return None
+
+
+def _rec(kind: str, value: Any, axis_name: Any, crossing: str) -> None:
+    record_collective(kind, value, crossing=crossing, fanout=_fanout(axis_name))
+
+
+def _resolve_hierarchy(axis_name: Any, hierarchy: Optional[MeshHierarchy]):
+    """(axis_name, hierarchy, crossing) with the degenerate cases folded.
+
+    A :class:`MeshHierarchy` passed AS the axis is hoisted to ``hierarchy``;
+    a single-slice hierarchy (dcn axis size 1 at trace time) collapses to
+    the FLAT plane over the ici axis — identical program and collective
+    count, attributed to the ``ici`` crossing.
+    """
+    if hierarchy is None and isinstance(axis_name, MeshHierarchy):
+        hierarchy = axis_name
+    if hierarchy is None:
+        return axis_name, None, "world"
+    dcn = _fanout(hierarchy.dcn_axis)
+    if dcn is not None and dcn == 1:
+        return hierarchy.ici_axis, None, "ici"
+    return axis_name, hierarchy, None
+
+
+def _hier_reduce(kind: str, op: Callable, value: Any, h: MeshHierarchy) -> Any:
+    """Two-stage reduction: the fast ici axis first, so only the per-slice
+    reduced value crosses dcn."""
+    _rec(kind, value, h.ici_axis, "ici")
+    local = op(value, h.ici_axis)
+    _rec(kind, local, h.dcn_axis, "dcn")
+    return op(local, h.dcn_axis)
+
+
+def _hier_gather_stack(value: Array, h: MeshHierarchy, kind: str = "all_gather") -> Array:
+    """``(world, *shape)`` stack in slice-major world order via two stages.
+
+    The DCN stage runs FIRST with the unexpanded payload: each device
+    exchanges its own rows with its same-position peers across slices —
+    the slice's payload crosses DCN exactly once, sharded over the slice's
+    devices instead of funneled through one leader (same DCN bytes as a
+    leader exchange, no leader bottleneck). The ICI stage then replicates
+    the cross-slice stacks within each slice. Equivalent to a flat
+    world-axis ``all_gather`` over slice-major device order.
+    """
+    _rec(kind, value, h.dcn_axis, "dcn")
+    g1 = jax.lax.all_gather(value, h.dcn_axis)  # (S, ...)
+    _rec(kind, g1, h.ici_axis, "ici")
+    g2 = jax.lax.all_gather(g1, h.ici_axis)  # (L, S, ...)
+    g = jnp.swapaxes(g2, 0, 1)  # (S, L, ...): slice-major world order
+    return g.reshape((-1, *g.shape[2:]))
+
+
+def _hier_buffer_all_gather(buf: PaddedBuffer, h: MeshHierarchy) -> PaddedBuffer:
+    """Hierarchical :func:`buffer_all_gather`: two-stage data + counts
+    gathers, then the ordinary per-buffer compaction."""
+    from metrics_tpu.parallel.buffer import buffer_compact_gathered
+
+    data = _hier_gather_stack(buf.data, h)  # (W, cap, *item)
+    counts = _hier_gather_stack(buf.count, h)  # (W,)
+    return buffer_compact_gathered(data, counts)
+
+
+def sync_value(
+    fx: ReduceFx,
+    value: Any,
+    axis_name: Any,
+    hierarchy: Optional[MeshHierarchy] = None,
+    _crossing: Optional[str] = None,
+) -> Any:
     """In-jit sync of one state value over a named mesh axis.
+
+    ``axis_name`` may be a single axis, a tuple of axes (the flat world
+    span of a 2-level mesh), or a :class:`MeshHierarchy`; ``hierarchy=``
+    stages every collective as ici-then-dcn (see the module docstring).
 
     Collective accounting: this function runs at *trace* time, so the
     counters record ops staged into the compiled program — which IS the
     per-step collective cost (the program replays them every step). See
     ``metrics_tpu.observability.counters``.
     """
-    if isinstance(value, PaddedBuffer):
-        record_collective("all_gather", value.data)
-        record_collective("all_gather", value.count)
-        return buffer_all_gather(value, axis_name)
+    axis_name, hierarchy, crossing = _resolve_hierarchy(axis_name, hierarchy)
+    crossing = _crossing or crossing  # a caller that already resolved a
+    # degenerate hierarchy passes its crossing down (ici, not world)
     if isinstance(value, list):
         raise TypeError(
             "Eager list states cannot be synced inside jit; construct the metric "
             "with a `capacity` so cat-states use PaddedBuffers."
         )
+    if hierarchy is not None:
+        return _sync_value_hier(fx, value, hierarchy)
+    if isinstance(value, PaddedBuffer):
+        _rec("all_gather", value.data, axis_name, crossing)
+        _rec("all_gather", value.count, axis_name, crossing)
+        return buffer_all_gather(value, axis_name)
     if fx == "sum":
-        record_collective("psum", value)
+        _rec("psum", value, axis_name, crossing)
         return jax.lax.psum(value, axis_name)
     if fx == "mean":
-        record_collective("pmean", value)
+        _rec("pmean", value, axis_name, crossing)
         return jax.lax.pmean(value, axis_name)
     if fx == "min":
-        record_collective("pmin", value)
+        _rec("pmin", value, axis_name, crossing)
         return jax.lax.pmin(value, axis_name)
     if fx == "max":
-        record_collective("pmax", value)
+        _rec("pmax", value, axis_name, crossing)
         return jax.lax.pmax(value, axis_name)
-    record_collective("all_gather", value)
+    _rec("all_gather", value, axis_name, crossing)
     gathered = jax.lax.all_gather(value, axis_name)  # (world, ...)
     if fx is None:
         return gathered
@@ -189,15 +290,48 @@ def sync_value(fx: ReduceFx, value: Any, axis_name: str) -> Any:
     return fx(gathered)
 
 
-def sync_state(state: Dict[str, Any], reductions: Dict[str, ReduceFx], axis_name: str) -> Dict[str, Any]:
+def _sync_value_hier(fx: ReduceFx, value: Any, h: MeshHierarchy) -> Any:
+    """The two-stage per-leaf plane (multi-slice hierarchy already proven)."""
+    if isinstance(value, PaddedBuffer):
+        return _hier_buffer_all_gather(value, h)
+    if fx == "sum":
+        return _hier_reduce("psum", jax.lax.psum, value, h)
+    if fx == "mean":
+        # pmean nests cleanly: slices are equal-sized, so the mean of
+        # per-slice means IS the world mean
+        return _hier_reduce("pmean", jax.lax.pmean, value, h)
+    if fx == "min":
+        return _hier_reduce("pmin", jax.lax.pmin, value, h)
+    if fx == "max":
+        return _hier_reduce("pmax", jax.lax.pmax, value, h)
+    gathered = _hier_gather_stack(value, h)  # (world, ...) slice-major
+    if fx is None:
+        return gathered
+    if fx == "cat":
+        return gathered.reshape((-1, *gathered.shape[2:])) if gathered.ndim > 1 else gathered.reshape(-1)
+    return fx(gathered)
+
+
+def sync_state(
+    state: Dict[str, Any],
+    reductions: Dict[str, ReduceFx],
+    axis_name: Any,
+    hierarchy: Optional[MeshHierarchy] = None,
+) -> Dict[str, Any]:
     """In-jit sync of a whole state dict over a named mesh axis (pure, jit-safe)."""
     record_states_synced(len(state))
     with annotate("metric.sync"):
-        return {name: sync_value(reductions[name], value, axis_name) for name, value in state.items()}
+        return {
+            name: sync_value(reductions[name], value, axis_name, hierarchy)
+            for name, value in state.items()
+        }
 
 
 def coalesced_sync_state(
-    state: Dict[Any, Any], reductions: Dict[Any, ReduceFx], axis_name: str
+    state: Dict[Any, Any],
+    reductions: Dict[Any, ReduceFx],
+    axis_name: Any,
+    hierarchy: Optional[MeshHierarchy] = None,
 ) -> Dict[Any, Any]:
     """In-jit sync with COALESCED collectives: a handful of bucketed
     collectives instead of one (or two) per state leaf.
@@ -237,9 +371,43 @@ def coalesced_sync_state(
     ICI/DCN at small state sizes). Single-member buckets delegate to the
     per-leaf :func:`sync_value` — no flatten/slice overhead, identical
     collective count. Eager list leaves still raise (no jit-safe sync).
+
+    With ``hierarchy=`` (or a :class:`MeshHierarchy` as ``axis_name``) every
+    bucketed collective stages HIERARCHICALLY: reduce buckets psum/pmin/pmax
+    over the ici axis first and cross dcn only with the reduced bucket;
+    gather/buffer buckets exchange the bucket payload across slices first
+    (payload ``p`` over the S-sized dcn axis) and replicate intra-slice —
+    per-leaf values are bit-identical to the flat plane, only the DCN
+    traffic shrinks (see ``observability.counters`` ``bytes_by_crossing``).
     """
     from metrics_tpu.parallel.buffer import buffer_compact_gathered
     from metrics_tpu.utils.compat import axis_size
+
+    axis_name, hierarchy, crossing = _resolve_hierarchy(axis_name, hierarchy)
+
+    if hierarchy is None:
+
+        def creduce(kind: str, op: Callable, flat: Array) -> Array:
+            _rec(kind, flat, axis_name, crossing)
+            return op(flat, axis_name)
+
+        def cgather(flat: Array) -> Array:
+            _rec("coalesced_gather", flat, axis_name, crossing)
+            return jax.lax.all_gather(flat, axis_name)
+
+        def world_size() -> int:
+            return axis_size(axis_name)
+
+    else:
+
+        def creduce(kind: str, op: Callable, flat: Array) -> Array:
+            return _hier_reduce(kind, op, flat, hierarchy)
+
+        def cgather(flat: Array) -> Array:
+            return _hier_gather_stack(flat, hierarchy, kind="coalesced_gather")
+
+        def world_size() -> int:
+            return axis_size(hierarchy.ici_axis) * axis_size(hierarchy.dcn_axis)
 
     record_states_synced(len(state))
     with annotate("metric.sync"):
@@ -252,7 +420,7 @@ def coalesced_sync_state(
             if isinstance(value, PaddedBuffer):
                 buffer_buckets.setdefault(str(value.data.dtype), []).append(name)
             elif isinstance(value, list):
-                out[name] = sync_value(fx, value, axis_name)  # raises: not jit-safe
+                out[name] = sync_value(fx, value, axis_name, hierarchy, _crossing=crossing)  # raises: not jit-safe
             elif fx in ("sum", "min", "max"):
                 buckets.setdefault((fx, str(value.dtype)), []).append(name)
             elif fx == "mean" and jnp.issubdtype(value.dtype, jnp.inexact):
@@ -266,27 +434,25 @@ def coalesced_sync_state(
         kinds = {"sum": "psum", "min": "pmin", "max": "pmax"}
         for (op, _dtype), names in buckets.items():
             if len(names) == 1:
-                out[names[0]] = sync_value(reductions[names[0]], state[names[0]], axis_name)
+                out[names[0]] = sync_value(reductions[names[0]], state[names[0]], axis_name, hierarchy, _crossing=crossing)
                 continue
             flat = jnp.concatenate([jnp.ravel(state[n]) for n in names])
-            record_collective(kinds[op], flat)
-            synced = ops[op](flat, axis_name)
+            synced = creduce(kinds[op], ops[op], flat)
             offset = 0
             for n in names:
                 value = state[n]
                 piece = synced[offset: offset + value.size].reshape(value.shape)
                 if reductions[n] == "mean":
-                    piece = piece / axis_size(axis_name)
+                    piece = piece / world_size()
                 out[n] = piece
                 offset += value.size
 
         for _dtype, names in gather_buckets.items():
             if len(names) == 1:
-                out[names[0]] = sync_value(reductions[names[0]], state[names[0]], axis_name)
+                out[names[0]] = sync_value(reductions[names[0]], state[names[0]], axis_name, hierarchy, _crossing=crossing)
                 continue
             flat = jnp.concatenate([jnp.ravel(state[n]) for n in names])
-            record_collective("coalesced_gather", flat)
-            gathered = jax.lax.all_gather(flat, axis_name)  # (W, sum of sizes)
+            gathered = cgather(flat)  # (W, sum of sizes)
             offset = 0
             for n in names:
                 value = state[n]
@@ -304,7 +470,7 @@ def coalesced_sync_state(
 
         for _dtype, names in buffer_buckets.items():
             if len(names) == 1:
-                out[names[0]] = sync_value(reductions[names[0]], state[names[0]], axis_name)
+                out[names[0]] = sync_value(reductions[names[0]], state[names[0]], axis_name, hierarchy, _crossing=crossing)
                 continue
             flat = jnp.concatenate([jnp.ravel(state[n].data) for n in names])
             counts = jnp.stack([state[n].count for n in names])  # (n buffers,)
@@ -314,17 +480,14 @@ def coalesced_sync_state(
                 payload = jnp.concatenate(
                     [flat, jax.lax.bitcast_convert_type(counts, bucket_dtype)]
                 )
-                record_collective("coalesced_gather", payload)
-                gathered = jax.lax.all_gather(payload, axis_name)
+                gathered = cgather(payload)
                 g_data = gathered[:, : flat.size]  # (W, sum of data sizes)
                 g_counts = jax.lax.bitcast_convert_type(
                     gathered[:, flat.size:], counts.dtype
                 )  # (W, n buffers)
             else:
-                record_collective("coalesced_gather", flat)
-                record_collective("coalesced_gather", counts)
-                g_data = jax.lax.all_gather(flat, axis_name)  # (W, sum of data sizes)
-                g_counts = jax.lax.all_gather(counts, axis_name)  # (W, n buffers)
+                g_data = cgather(flat)  # (W, sum of data sizes)
+                g_counts = cgather(counts)  # (W, n buffers)
             offset = 0
             for i, n in enumerate(names):
                 buf = state[n]
@@ -388,11 +551,50 @@ def gather_all_arrays(value: Array, group: Any = None) -> List[Array]:
         return [value]
     from jax.experimental import multihost_utils
 
-    # host-plane collectives run eagerly: this is a real per-call count
-    record_collective("process_allgather", value)
+    # host-plane collectives run eagerly (a real per-call count) and cross
+    # DCN by definition: multi-host payloads move over the data-center link
+    record_collective("process_allgather", value, crossing="dcn", fanout=jax.process_count())
     gathered = multihost_utils.process_allgather(value, tiled=False)
     indices = range(gathered.shape[0]) if members is None else members
     return [gathered[i] for i in indices]
+
+
+def slice_leader_gather(hierarchy: HostHierarchy) -> Callable:
+    """A packable host gather that moves ONE copy per slice over DCN.
+
+    For states REPLICATED within a slice — the invariant after an in-jit
+    ici-axis sync, or any replicated eval state — the flat host plane
+    gathers every process's identical copy: the same payload crosses DCN
+    once per process. This gather returns one array per slice (the slice
+    leader's copy, in slice order), so the downstream reduction spans
+    slices exactly once and the DCN exchange is attributed at slice fanout,
+    not world fanout. Every process still enters the ONE world collective
+    (no sub-communicator, no deadlock — the ``gather_all_arrays`` group
+    convention) and redistributes by keeping the leader rows, so all
+    processes of a slice see the identical result.
+
+    The caller owns the replication invariant: states that DIVERGE within a
+    slice must use the flat plane (summing leader copies would drop the
+    non-leaders' contributions).
+    """
+    if not isinstance(hierarchy, HostHierarchy):
+        raise TypeError(
+            f"slice_leader_gather needs a HostHierarchy (process -> slice map), got {hierarchy!r}"
+        )
+
+    @packable_gather
+    def leader_gather(value: Array) -> List[Array]:
+        if jax.process_count() == 1 or hierarchy.n_slices <= 1:
+            return [value]  # degenerate: one slice IS the flat single gather
+        from jax.experimental import multihost_utils
+
+        record_collective(
+            "process_allgather", value, crossing="dcn", fanout=hierarchy.n_slices
+        )
+        gathered = multihost_utils.process_allgather(value, tiled=False)
+        return [gathered[p] for p in hierarchy.leaders]
+
+    return leader_gather
 
 
 def packable_gather(fn: Callable) -> Callable:
@@ -456,6 +658,7 @@ def host_gather(
     state: Dict[str, Any],
     reductions: Dict[str, ReduceFx],
     gather_fn: Optional[Callable] = None,
+    slice_leaders: Optional[HostHierarchy] = None,
 ) -> Dict[str, Any]:
     """Host-plane sync of a state dict, reproducing reference ``_sync_dist``
     semantics (metric.py:179-197): gather every array, stack tensor states /
@@ -470,7 +673,14 @@ def host_gather(
     the per-leaf plane: per-process slices reconstruct exactly the arrays an
     individual gather would have returned before any reduction runs.
     Reference-semantics custom ``dist_sync_fn``s keep one call per array.
+
+    ``slice_leaders`` is the SLICE-LEADER mode: with a
+    :class:`HostHierarchy` (and no explicit ``gather_fn``) the packed
+    payloads move through :func:`slice_leader_gather` — one copy per slice
+    instead of one per process, for states replicated within a slice.
     """
+    if gather_fn is None and slice_leaders is not None:
+        gather_fn = slice_leader_gather(slice_leaders)
     gather_fn = gather_fn or gather_all_arrays
 
     # pass 1: enumerate every array that must move, in a stable order

@@ -26,7 +26,7 @@ from metrics_tpu.functional.regression.ssim import (
     _ssim_map,
     _ssim_update,
 )
-from metrics_tpu.utils.prints import rank_zero_warn
+from metrics_tpu.utils.prints import rank_zero_warn, rank_zero_warn_once
 
 
 class SSIM(Metric):
@@ -91,7 +91,7 @@ class SSIM(Metric):
             # streaming exists for; the shared overflow probe warns before that
             self.add_state("total", default=np.zeros((), dtype=accum_int_dtype()), dist_reduce_fx="sum")
         else:
-            rank_zero_warn(
+            rank_zero_warn_once(
                 "Metric `SSIM` will save all targets and"
                 " predictions in buffer. For large datasets this may lead"
                 " to large memory footprint."

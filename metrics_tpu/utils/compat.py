@@ -44,19 +44,29 @@ else:
 
 if hasattr(jax.lax, "axis_size"):
 
-    def axis_size(axis_name: str) -> int:
-        """Size of a named mesh axis, from inside ``shard_map``/``pmap``."""
+    def _one_axis_size(axis_name: str) -> int:
         return jax.lax.axis_size(axis_name)
 
 else:
 
-    def axis_size(axis_name: str) -> int:
+    def _one_axis_size(axis_name: str) -> int:
         # jax 0.4.37: ``jax.core.axis_frame(name)`` resolves the bound axis
         # and returns its size directly (an int under shard_map tracing)
         from jax.core import axis_frame
 
         frame = axis_frame(axis_name)
         return frame if isinstance(frame, int) else frame.size
+
+
+def axis_size(axis_name: Any) -> int:
+    """Size of a named mesh axis (or product over a tuple of axes — the
+    flat world span of a 2-level mesh), from inside ``shard_map``/``pmap``."""
+    if isinstance(axis_name, (tuple, list)):
+        size = 1
+        for a in axis_name:
+            size *= _one_axis_size(a)
+        return size
+    return _one_axis_size(axis_name)
 
 
 if HAS_VMA:

@@ -63,3 +63,25 @@ def _debug(*args: Any, **kwargs: Any) -> None:
 rank_zero_debug = rank_zero_only(_debug)
 rank_zero_info = rank_zero_only(_info)
 rank_zero_warn = rank_zero_only(_warn)
+
+
+# messages already emitted through rank_zero_warn_once (process lifetime)
+_WARN_ONCE_SEEN: set = set()
+
+
+def rank_zero_warn_once(message: str, *args: Any, **kwargs: Any) -> None:
+    """``rank_zero_warn`` deduplicated by message text for the process
+    lifetime.
+
+    For advisory notices that are a property of a CONFIGURATION, not of an
+    instance — e.g. the curve metrics' "will save all targets and
+    predictions in buffer" capacity note, which otherwise fires once per
+    metric per run in a multi-metric bench tail. Python's own warning
+    registry dedups per call site, not per message, so six metric classes
+    each warn separately without this guard. Tests can clear
+    ``_WARN_ONCE_SEEN`` to re-arm.
+    """
+    if message in _WARN_ONCE_SEEN:
+        return
+    _WARN_ONCE_SEEN.add(message)
+    rank_zero_warn(message, *args, **kwargs)

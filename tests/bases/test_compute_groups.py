@@ -380,3 +380,88 @@ def test_sync_state_roundtrip_2device_mesh():
     ])
     single.update(preds, target)
     _assert_same(results["grouped"], {k: np.asarray(v) for k, v in single.compute().items()})
+
+
+# ------------------------------------------------- group-merged checkpoints
+def _ckpt_collection():
+    return MetricCollection([
+        Accuracy(),
+        F1(num_classes=4, average="macro"),
+        Precision(num_classes=4, average="macro"),
+        Recall(num_classes=4, average="macro"),
+    ])
+
+
+def _ckpt_batch(seed=0, rows=32):
+    rng = np.random.RandomState(seed)
+    preds = rng.rand(rows, 4).astype(np.float32)
+    preds = preds / preds.sum(-1, keepdims=True)
+    return jnp.asarray(preds), jnp.asarray(rng.randint(0, 4, rows).astype(np.int32))
+
+
+def test_state_dict_merges_group_shards_and_roundtrips():
+    """Group-aware checkpoint merging: ONE state copy per compute group plus
+    a membership manifest; a fresh collection loads it and computes
+    bit-identically. Per-member host metadata (_count_bound) persists."""
+    col = _ckpt_collection()
+    preds, target = _ckpt_batch()
+    col.update(preds, target)
+    col.persistent(True)
+    sd = col.state_dict()
+
+    # one full copy for the group representative, manifest for the rest
+    assert sd["_compute_group_manifest"] == {"Precision": "F1", "Recall": "F1"}
+    assert "F1.tp" in sd and "Accuracy.correct" in sd
+    assert not any(k.startswith(("Precision.", "Recall.")) and not k.endswith("_count_bound") for k in sd)
+    # per-member host metadata still rides along
+    assert int(sd["Recall._count_bound"]) == 32
+
+    # orbax/pickle-friendly round trip into a FRESH collection
+    restored = pickle.loads(pickle.dumps(sd))
+    fresh = _ckpt_collection()
+    fresh.persistent(True)
+    fresh.load_state_dict(restored)
+    a, b = col.compute(), fresh.compute()
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]), err_msg=k)
+    assert fresh["Recall"]._count_bound == 32
+
+
+def test_state_dict_keeps_diverged_member_entry():
+    """A member written OUTSIDE the collection diverges by value: it keeps
+    its own full checkpoint entry (sharing is value-checked at save time,
+    never assumed from the group structure), and restores exactly."""
+    col = _ckpt_collection()
+    preds, target = _ckpt_batch()
+    col.update(preds, target)
+    col["Precision"].update(preds, target)  # out-of-collection write
+    col.persistent(True)
+    sd = col.state_dict()
+    assert sd["_compute_group_manifest"] == {"Recall": "F1"}
+    assert "Precision.tp" in sd
+
+    fresh = _ckpt_collection()
+    fresh.persistent(True)
+    fresh.load_state_dict(sd)
+    a, b = col.compute(), fresh.compute()
+    for k in a:
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]), err_msg=k)
+
+
+def test_state_dict_plain_per_member_checkpoint_loads():
+    """Back-compat: a checkpoint without a manifest (old per-member format)
+    loads member by member unchanged."""
+    col = _ckpt_collection()
+    preds, target = _ckpt_batch(seed=5)
+    col.update(preds, target)
+    col.persistent(True)
+    sd = {}
+    for name, m in col.items():  # the pre-merge format
+        m.state_dict(sd, prefix=f"{name}.")
+    fresh = _ckpt_collection()
+    fresh.persistent(True)
+    fresh.load_state_dict(sd)
+    a, b = col.compute(), fresh.compute()
+    for k in a:
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]), err_msg=k)

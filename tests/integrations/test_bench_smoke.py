@@ -55,12 +55,33 @@ def _assert_headline_schema(out):
         assert isinstance(out[key], (int, float)) and out[key] > 0, key
     assert out["gather_states_synced"] == 6  # 6 PaddedBuffer states
 
+    # the hierarchical A/B on the (4,2) ici x dcn mesh rides the same line
+    for key in ("gather_hier_ms", "gather_flat2d_ms"):
+        assert isinstance(out[key], (int, float)) and out[key] > 0, key
+
+    # the staged collective-count keys ride the DEFAULT line (trace-schema
+    # keys: --check-trajectory binds on every new BENCH_r* round)
+    assert out["collective_calls"] == 1 and out["sync_bytes"] == 520
+    assert out["sync_bytes"] < out["sync_bytes_ungrouped"]
+    assert out["gather_collective_calls"] == 2
+    assert out["gather_collective_calls_per_leaf"] == 12
+    assert out["gather_sync_bytes"] == out["gather_sync_bytes_per_leaf"]
+    # the hierarchy headline: two-stage plane (2 calls per bucket), DCN
+    # ring traffic strictly below the flat plane's world traffic
+    assert out["hier_collective_calls"] == 2 * out["flat2d_collective_calls"]
+    assert out["hier_dcn_calls"] == out["flat2d_collective_calls"]
+    assert out["hier_dcn_bytes"] < out["flat2d_world_bytes"]
+    assert out["hier_dcn_bytes"] == out["gather_sync_bytes"]  # S-1 = 1 hop
+
 
 def test_bench_smoke_json_schema():
     out = _run_smoke()
     _assert_headline_schema(out)
-    # without --trace the observability fields stay absent (off by default)
-    assert "collective_calls" not in out and "sync_bytes" not in out
+    # the span/compile observability fields stay absent without --trace
+    # (collective COUNTS are on the default line — trace-time counting is
+    # free — but spans, compile telemetry, and the trace file are not)
+    for key in ("trace_schema", "phase_ms", "compile", "device_ms", "trace_file", "counters"):
+        assert key not in out, key
 
 
 def test_bench_smoke_trace_json_schema(tmp_path):
@@ -68,27 +89,18 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     out = _run_smoke(("--trace", str(trace_file)))
     _assert_headline_schema(out)
 
-    # schema version of the --trace payload: the v2 bump added compile
-    # telemetry + the device-time table; bump this pin with the schema
-    assert out["trace_schema"] == 2
+    # schema version of the --trace payload: v3 moved the collective counts
+    # to the default line and added the hierarchical A/B + per-crossing
+    # counters; bump this pin with the schema
+    assert out["trace_schema"] == 3
 
-    # collective accounting of the grouped step program: the 6 deduped sum
-    # leaves coalesce into ONE bucketed psum; bytes shrink vs ungrouped
-    assert isinstance(out["collective_calls"], int) and out["collective_calls"] >= 1
-    assert out["collective_calls"] <= out["states_synced"]
-    assert isinstance(out["sync_bytes"], int) and out["sync_bytes"] > 0
-    assert out["sync_bytes"] < out["sync_bytes_ungrouped"]
     # counter totals must agree with the states_synced the bench reports
     assert out["counters"]["states_synced"] == out["states_synced"]
     assert out["counters"]["collective_calls"] == out["collective_calls"]
-
-    # the coalesced gather plane: ONE all_gather per dtype bucket (counts
-    # bitcast into the data payload: f32 + i32 -> 2) instead of 2 per
-    # buffer — same payload bytes, a sixth of the staged collectives
-    assert out["gather_collective_calls"] == 2
-    assert out["gather_collective_calls_per_leaf"] == 12
-    assert out["gather_sync_bytes"] == out["gather_sync_bytes_per_leaf"]
     assert out["gather_counters"]["calls_by_kind"]["coalesced_gather"] == 2
+    # the hierarchical program's full snapshot: per-crossing split included
+    assert out["hier_counters"]["calls_by_crossing"] == {"dcn": 2, "ici": 2}
+    assert out["hier_counters"]["bytes_by_crossing"]["dcn"] == out["hier_dcn_bytes"]
 
     # per-phase ms come from the span aggregates, not ad-hoc timers
     assert any(name.startswith("bench.compile") for name in out["phase_ms"])
@@ -168,7 +180,9 @@ def test_bench_check_collectives_gate():
     scenarios = out["scenarios"]
     assert set(scenarios) == {
         "sum_grouped", "sum_ungrouped", "gather_coalesced", "gather_per_leaf",
-        "sharded_auroc", "sharded_retrieval",
+        "gather_hier", "gather_flat2d",
+        "sharded_auroc", "sharded_auroc_hier",
+        "sharded_retrieval", "sharded_retrieval_hier",
     }
     # the headline reductions of record: one bucketed psum for the grouped
     # sum plane; 2 staged all_gathers (1 per dtype bucket, counts riding
@@ -186,6 +200,19 @@ def test_bench_check_collectives_gate():
     # 4 all_to_alls + 3 psums
     assert scenarios["sharded_auroc"]["collective_calls"] == 4
     assert scenarios["sharded_retrieval"]["collective_calls"] == 7
+    # the hierarchical scenarios pin the per-crossing structure: every
+    # staged collective splits into an ici stage and a dcn stage, and the
+    # DCN-crossing ring traffic is S-1 = 1 hop per payload byte where the
+    # flat plane pays W-1 = 7
+    assert scenarios["gather_hier"]["dcn_calls"] == 2
+    assert scenarios["gather_hier"]["dcn_bytes"] == scenarios["gather_coalesced"]["sync_bytes"]
+    assert scenarios["gather_flat2d"]["world_bytes"] == 7 * scenarios["gather_flat2d"]["sync_bytes"]
+    assert scenarios["sharded_auroc_hier"]["dcn_bytes"] == scenarios["sharded_auroc"]["sync_bytes"]
+    assert scenarios["sharded_retrieval_hier"]["dcn_calls"] == 7
+    # the hierarchy gate of record: reflattening a DCN-crossing collective
+    # (dcn bytes >= flat world bytes) fails the gate
+    assert out["hier_gate"]["ok"] is True
+    assert out["hier_gate"]["hier_dcn_bytes"] < out["hier_gate"]["flat2d_world_bytes"]
     for row in scenarios.values():
         assert row["status"] != "regression"
 

@@ -1,0 +1,415 @@
+"""Parity matrix for the hierarchical (ICI -> DCN) two-stage sync plane.
+
+The contract under test: with a :class:`MeshHierarchy` over the (4,2)
+``ici`` x ``dcn`` virtual test mesh (2 slices x 4 devices), every sync plane
+— the coalesced buckets, the per-leaf plane, and the sharded engines — is
+BIT-IDENTICAL to the flat world-axis plane and to a single-process epoch;
+only the staged crossing structure changes (the DCN-crossing ring traffic
+drops from W-1 hops per payload byte to S-1, asserted via the per-crossing
+counters). A single-slice hierarchy must collapse to the flat plane: same
+program, same collective count.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu import observability as obs
+from metrics_tpu.parallel import (
+    HostHierarchy,
+    MeshHierarchy,
+    hierarchical_mesh,
+    host_hierarchy,
+    mesh_hierarchy,
+    row_sharded,
+    slice_leader_gather,
+)
+from metrics_tpu.parallel.buffer import PaddedBuffer, buffer_append, buffer_init
+from metrics_tpu.parallel.sync import coalesced_sync_state, host_gather, sync_state
+from metrics_tpu.utils import compat
+
+SLICES = 2  # the dcn axis of the (4,2) test mesh
+
+
+@pytest.fixture(autouse=True)
+def _clean_observability():
+    obs.disable()
+    obs.reset()
+    yield
+    obs.disable()
+    obs.reset()
+
+
+def _hier_mesh(eight_devices, slices=SLICES):
+    return hierarchical_mesh(eight_devices, slices=slices)
+
+
+def _run_flat(build_state, reductions, eight_devices, coalesced=True):
+    """The flat oracle: world axis over the same device order."""
+    mesh = Mesh(np.array(eight_devices), ("dp",))
+    sync = coalesced_sync_state if coalesced else sync_state
+
+    def fn(seed):
+        return sync(build_state(seed[0]), reductions, "dp")
+
+    f = jax.jit(
+        compat.shard_map(fn, mesh=mesh, in_specs=(P("dp"),), out_specs=P(), check_vma=False)
+    )
+    return f(jnp.arange(8, dtype=jnp.int32))
+
+
+def _run_hier(build_state, reductions, eight_devices, coalesced=True, slices=SLICES, as_axis=False):
+    """The hierarchical plane on the (dcn, ici) reshape of the SAME devices
+    (slice-major world order == the flat mesh's device order)."""
+    mesh, h = _hier_mesh(eight_devices, slices)
+    world = 8
+    sync = coalesced_sync_state if coalesced else sync_state
+
+    def fn(seed):
+        if as_axis:  # the hierarchy IS the axis argument
+            return sync(build_state(seed[0]), reductions, h)
+        return sync(build_state(seed[0]), reductions, h.axes, hierarchy=h)
+
+    f = jax.jit(
+        compat.shard_map(
+            fn, mesh=mesh, in_specs=(P(h.axes),), out_specs=P(), check_vma=False
+        )
+    )
+    return f(jnp.arange(world, dtype=jnp.int32))
+
+
+def _assert_state_equal(a, b):
+    assert set(a) == set(b)
+    for k in a:
+        va, vb = a[k], b[k]
+        if isinstance(va, PaddedBuffer):
+            assert isinstance(vb, PaddedBuffer), k
+            np.testing.assert_array_equal(np.asarray(va.data), np.asarray(vb.data), err_msg=k)
+            np.testing.assert_array_equal(np.asarray(va.count), np.asarray(vb.count), err_msg=k)
+        else:
+            np.testing.assert_array_equal(np.asarray(va), np.asarray(vb), err_msg=k)
+
+
+# --------------------------------------------------------------- mesh types
+def test_hierarchical_mesh_explicit_slices(eight_devices):
+    mesh, h = hierarchical_mesh(eight_devices, slices=2)
+    assert dict(mesh.shape) == {"dcn": 2, "ici": 4}
+    assert h == MeshHierarchy(ici_axis="ici", dcn_axis="dcn")
+    assert h.axes == ("dcn", "ici")
+    # slice-major device order == the flat device list
+    assert list(mesh.devices.flat) == list(eight_devices)
+
+
+def test_hierarchical_mesh_ragged_raises(eight_devices):
+    with pytest.raises(ValueError, match="equal slices"):
+        hierarchical_mesh(eight_devices[:6], slices=4)
+
+
+def test_mesh_hierarchy_validates_axes(eight_devices):
+    mesh, _ = hierarchical_mesh(eight_devices, slices=2)
+    assert mesh_hierarchy(mesh) == MeshHierarchy("ici", "dcn")
+    with pytest.raises(ValueError, match="not an axis"):
+        mesh_hierarchy(mesh, ici_axis="nope")
+    with pytest.raises(ValueError, match="distinct"):
+        mesh_hierarchy(mesh, ici_axis="ici", dcn_axis="ici")
+
+
+def test_host_hierarchy_explicit_and_leaders():
+    h = HostHierarchy(slice_of_process=(0, 0, 1, 1))
+    assert h.n_slices == 2
+    assert h.leaders == (0, 2)
+    assert h.is_leader(0) and h.is_leader(2) and not h.is_leader(1)
+    # derived: single process -> one slice
+    derived = host_hierarchy()
+    assert derived.n_slices == 1 and derived.leaders == (0,)
+    with pytest.raises(ValueError, match="slice ids"):
+        host_hierarchy(slices=(0, 1))  # 2 ids for 1 process
+
+
+# ------------------------------------------------------ sync plane parity
+def _mixed_state(seed):
+    f = jnp.float32
+    return {
+        # f32 buffer bucket with MIXED capacities + an i32 and a bool buffer
+        "bf1": buffer_append(buffer_init(4, (), f), (seed * 10 + jnp.arange(2)).astype(f)),
+        "bf2": buffer_append(buffer_init(6, (2,), f), (seed * 100 + jnp.arange(6).reshape(3, 2)).astype(f)),
+        "bi": buffer_append(buffer_init(4, (), jnp.int32), seed * 7 + jnp.arange(3)),
+        "bb": buffer_append(buffer_init(2, (), jnp.bool_), (seed % 2 == 0)[None]),
+        # reduce buckets: sum/min/max/mean across two dtypes
+        "s": seed.astype(f) * jnp.ones((3,)),
+        "m": seed.astype(f) * jnp.ones((2,)) + 1.0,
+        "mn": (seed + 3).astype(f)[None],
+        "mx": seed.astype(jnp.int32) * jnp.ones((2,), jnp.int32),
+        # gather bucket: cat / None / callable
+        "cat": (seed + jnp.arange(2)).astype(f),
+        "stack": (seed * jnp.ones((3,))).astype(f),
+        "lonely": seed * jnp.ones((5,), jnp.int32),
+    }
+
+
+_MIXED_REDUCTIONS = {
+    "bf1": None, "bf2": None, "bi": None, "bb": None,
+    "s": "sum", "m": "mean", "mn": "min", "mx": "max",
+    "cat": "cat", "stack": None, "lonely": "cat",
+}
+
+
+@pytest.mark.parametrize("coalesced", [True, False], ids=["coalesced", "per-leaf"])
+def test_hierarchical_parity_mixed_buckets(eight_devices, coalesced):
+    """Across dtype buckets, PaddedBuffers with mixed capacities, reduce and
+    gather planes: the two-stage hierarchical sync is bit-identical to the
+    flat world-axis plane (coalesced AND per-leaf variants)."""
+    flat = _run_flat(_mixed_state, _MIXED_REDUCTIONS, eight_devices, coalesced=coalesced)
+    hier = _run_hier(_mixed_state, _MIXED_REDUCTIONS, eight_devices, coalesced=coalesced)
+    _assert_state_equal(flat, hier)
+    # hierarchy passed AS the axis argument is the same plane
+    as_axis = _run_hier(
+        _mixed_state, _MIXED_REDUCTIONS, eight_devices, coalesced=coalesced, as_axis=True
+    )
+    _assert_state_equal(flat, as_axis)
+
+
+def test_hierarchical_crossing_split_and_dcn_win(eight_devices):
+    """The acceptance structure: the hierarchical plane stages 2 collectives
+    per bucket attributed ici/dcn, and its DCN ring traffic is strictly
+    below the flat plane's world traffic (S-1 = 1 hop vs W-1 = 7)."""
+    obs.enable()
+    obs.reset()
+    _run_flat(_mixed_state, _MIXED_REDUCTIONS, eight_devices)
+    flat_snap = obs.counters_snapshot(reset_after=True)
+    _run_hier(_mixed_state, _MIXED_REDUCTIONS, eight_devices)
+    hier_snap = obs.counters_snapshot(reset_after=True)
+    obs.disable()
+
+    # flat: everything is a world-crossing call
+    assert set(flat_snap["calls_by_crossing"]) == {"world"}
+    # hierarchical: every staged collective carries an ici or dcn attribution
+    assert set(hier_snap["calls_by_crossing"]) == {"ici", "dcn"}
+    assert hier_snap["calls_by_crossing"]["ici"] == hier_snap["calls_by_crossing"]["dcn"]
+    # two stages per bucket: exactly twice the flat plane's staged calls
+    assert hier_snap["collective_calls"] == 2 * flat_snap["collective_calls"]
+    # the headline: DCN traffic strictly below the flat world traffic
+    assert hier_snap["bytes_by_crossing"]["dcn"] < flat_snap["bytes_by_crossing"]["world"]
+    # ring-traffic model: world = payload x 7, dcn = payload x 1
+    assert flat_snap["bytes_by_crossing"]["world"] == 7 * flat_snap["sync_bytes"]
+    assert hier_snap["bytes_by_crossing"]["dcn"] == flat_snap["sync_bytes"]
+
+
+def test_single_slice_hierarchy_noops_to_flat_plane(eight_devices):
+    """Degenerate hierarchy (dcn size 1): the plane must collapse to the
+    flat program — same collective COUNT, ici-attributed, identical values."""
+    obs.enable()
+    obs.reset()
+    flat = _run_flat(_mixed_state, _MIXED_REDUCTIONS, eight_devices)
+    flat_snap = obs.counters_snapshot(reset_after=True)
+    degen = _run_hier(_mixed_state, _MIXED_REDUCTIONS, eight_devices, slices=1)
+    degen_snap = obs.counters_snapshot(reset_after=True)
+    obs.disable()
+    _assert_state_equal(flat, degen)
+    assert degen_snap["collective_calls"] == flat_snap["collective_calls"]
+    assert degen_snap["calls_by_kind"] == flat_snap["calls_by_kind"]
+    assert set(degen_snap["calls_by_crossing"]) == {"ici"}
+
+
+# ------------------------------------------- end-to-end compute parity
+def test_hier_collection_sync_compute_parity(eight_devices):
+    """The acceptance pin: AUROC + AveragePrecision + Spearman epochs synced
+    through the HIERARCHICAL joint plane compute bit-identically to the
+    flat-synced collection AND to the single-process epoch over all rows."""
+    from metrics_tpu import AUROC, AveragePrecision, MetricCollection, SpearmanCorrcoef
+
+    cap = 16
+
+    def build(capacity):
+        return MetricCollection([
+            AUROC(capacity=capacity),
+            AveragePrecision(num_classes=1, capacity=capacity),
+            SpearmanCorrcoef(capacity=capacity),
+        ])
+
+    rng = np.random.RandomState(7)
+    batches = [
+        (rng.rand(8).astype(np.float32), rng.randint(0, 2, 8).astype(np.int32))
+        for _ in range(8)
+    ]
+    ranks = []
+    for p, t in batches:
+        c = build(cap)
+        c.update(jnp.asarray(p), jnp.asarray(t))
+        ranks.append(c)
+    epoch = build(cap * 8)
+    for p, t in batches:
+        epoch.update(jnp.asarray(p), jnp.asarray(t))
+    expected = epoch.compute()
+
+    keys = [(k, n) for k, m in ranks[0].items() for n in m._defaults]
+    reductions = {(k, n): ranks[0][k]._reductions[n] for (k, n) in keys}
+    datas = {key: jnp.stack([getattr(r[key[0]], key[1]).data for r in ranks]) for key in keys}
+    counts = {key: jnp.stack([getattr(r[key[0]], key[1]).count for r in ranks]) for key in keys}
+    mesh, h = _hier_mesh(eight_devices)
+
+    def fn(d, c):
+        state = {key: PaddedBuffer(d[key][0], c[key][0]) for key in d}
+        return coalesced_sync_state(state, reductions, h)
+
+    obs.enable()
+    obs.reset()
+    f = jax.jit(
+        compat.shard_map(
+            fn, mesh=mesh, in_specs=(P(h.axes), P(h.axes)), out_specs=P(), check_vma=False
+        )
+    )
+    synced = f(datas, counts)
+    snap = obs.counters_snapshot()
+    obs.disable()
+
+    # two staged gathers per dtype bucket (dcn exchange + ici replication)
+    assert snap["calls_by_kind"]["coalesced_gather"] == 4
+    assert snap["calls_by_crossing"] == {"dcn": 2, "ici": 2}
+
+    # flat-synced oracle over the same per-rank shards
+    flat_mesh = Mesh(np.array(eight_devices), ("dp",))
+
+    def fn_flat(d, c):
+        state = {key: PaddedBuffer(d[key][0], c[key][0]) for key in d}
+        return coalesced_sync_state(state, reductions, "dp")
+
+    flat = jax.jit(
+        compat.shard_map(fn_flat, mesh=flat_mesh, in_specs=(P("dp"), P("dp")), out_specs=P(), check_vma=False)
+    )(datas, counts)
+    for key in keys:
+        np.testing.assert_array_equal(
+            np.asarray(flat[key].data), np.asarray(synced[key].data), err_msg=str(key)
+        )
+
+    # install the hier-synced epoch into the rank-0 collection: compute()
+    # must equal the single-process oracle bit-exactly
+    target = ranks[0]
+    for (k, n) in keys:
+        setattr(target[k], n, synced[(k, n)])
+    actual = target.compute()
+    assert set(actual) == set(expected)
+    for k in expected:
+        np.testing.assert_array_equal(np.asarray(actual[k]), np.asarray(expected[k]), err_msg=k)
+
+
+# --------------------------------------------------- hierarchical engines
+def test_hier_sharded_engines_match_oracle(eight_devices):
+    """Row-sharded epoch states over the 2-level mesh dispatch the
+    hierarchical engines (ICI-local rings, one DCN exchange; two-stage
+    retrieval regroup) and match the single-device oracle exactly."""
+    from metrics_tpu import AUROC, SpearmanCorrcoef
+    from metrics_tpu.retrieval import RetrievalMRR
+
+    mesh, h = _hier_mesh(eight_devices)
+    rng = np.random.RandomState(3)
+    rows = 256
+    preds = jnp.asarray(np.round(rng.rand(rows), 2).astype(np.float32))
+    target = jnp.asarray((rng.rand(rows) > 0.5).astype(np.int32))
+
+    obs.enable()
+    obs.reset()
+    metric = AUROC(pos_label=1, capacity=512)
+    metric.device_put(row_sharded(mesh, h))
+    metric.update(preds, target)
+    got = np.asarray(metric.compute())
+    snap = obs.counters_snapshot(reset_after=True)
+    obs.disable()
+    oracle = AUROC(pos_label=1, capacity=512)
+    oracle.update(preds, target)
+    np.testing.assert_allclose(got, np.asarray(oracle.compute()), rtol=1e-6)
+    # the engine's staged structure: one dcn pack exchange (3 leaves) + the
+    # ici ring ppermutes (3 leaves) + the two-stage psum
+    assert snap["calls_by_kind"] == {"psum": 2, "all_gather": 3, "ppermute": 3}
+    assert snap["calls_by_crossing"] == {"dcn": 4, "ici": 4}
+
+    sp = SpearmanCorrcoef(capacity=512)
+    sp.device_put(row_sharded(mesh, h))
+    p2 = jnp.asarray(rng.rand(rows).astype(np.float32))
+    t2 = jnp.asarray(rng.rand(rows).astype(np.float32))
+    sp.update(p2, t2)
+    sp_oracle = SpearmanCorrcoef(capacity=512)
+    sp_oracle.update(p2, t2)
+    np.testing.assert_allclose(
+        np.asarray(sp.compute()), np.asarray(sp_oracle.compute()), rtol=1e-5
+    )
+
+    mrr = RetrievalMRR(capacity=512)
+    mrr.device_put(row_sharded(mesh, h))
+    idx = jnp.asarray(rng.randint(0, 64, rows).astype(np.int32))
+    p3 = jnp.asarray(rng.rand(rows).astype(np.float32))
+    t3 = jnp.asarray((rng.rand(rows) > 0.7).astype(np.int32))
+    mrr.update(idx, p3, t3)
+    mrr_oracle = RetrievalMRR(capacity=512)
+    mrr_oracle.update(idx, p3, t3)
+    np.testing.assert_allclose(
+        np.asarray(mrr.compute()), np.asarray(mrr_oracle.compute()), rtol=1e-6
+    )
+
+
+def test_hier_sharded_kendall_and_curves_match_oracle(eight_devices):
+    """Kendall's quadratic ring and the clf-curve vector engine (ROC) under
+    the hierarchy: exact vs the single-device gather path."""
+    from metrics_tpu import ROC
+    from metrics_tpu.regression import KendallRankCorrCoef
+
+    mesh, h = _hier_mesh(eight_devices)
+    rng = np.random.RandomState(11)
+    rows = 128
+    p = jnp.asarray(np.round(rng.rand(rows), 2).astype(np.float32))
+    t = jnp.asarray(np.round(rng.rand(rows), 2).astype(np.float32))
+
+    kt = KendallRankCorrCoef(capacity=256)
+    kt.device_put(row_sharded(mesh, h))
+    kt.update(p, t)
+    kt_oracle = KendallRankCorrCoef(capacity=256)
+    kt_oracle.update(p, t)
+    np.testing.assert_allclose(
+        np.asarray(kt.compute()), np.asarray(kt_oracle.compute()), rtol=1e-5
+    )
+
+    y = jnp.asarray((rng.rand(rows) > 0.5).astype(np.int32))
+    roc = ROC(pos_label=1, capacity=256)
+    roc.device_put(row_sharded(mesh, h))
+    roc.update(p, y)
+    roc_oracle = ROC(pos_label=1, capacity=256)
+    roc_oracle.update(p, y)
+    for got, exp in zip(roc.compute(), roc_oracle.compute()):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(exp))
+
+
+# ------------------------------------------------------------- host plane
+def test_slice_leader_gather_degenerate_and_packing():
+    """Single-process/single-slice: the leader gather is the identity list,
+    host_gather(slice_leaders=...) matches the flat host plane, and the
+    gather is packable (payloads bucket per dtype)."""
+    h = host_hierarchy()
+    fn = slice_leader_gather(h)
+    out = fn(jnp.arange(3.0))
+    assert isinstance(out, list) and len(out) == 1
+    np.testing.assert_array_equal(np.asarray(out[0]), np.arange(3.0))
+
+    state = {
+        "s": jnp.ones((3,), jnp.float32),
+        "c": buffer_append(buffer_init(4, (), jnp.float32), jnp.arange(2.0)),
+    }
+    reductions = {"s": "sum", "c": None}
+    flat = host_gather(state, reductions)
+    leader = host_gather(state, reductions, slice_leaders=h)
+    np.testing.assert_array_equal(np.asarray(flat["s"]), np.asarray(leader["s"]))
+    np.testing.assert_array_equal(np.asarray(flat["c"]), np.asarray(leader["c"]))
+    with pytest.raises(TypeError, match="HostHierarchy"):
+        slice_leader_gather("dcn")
+
+
+def test_row_sharded_accepts_hierarchy(eight_devices):
+    """row_sharded with a MeshHierarchy shards rows over BOTH levels
+    (slice-major) and validates divisibility against the world size."""
+    mesh, h = _hier_mesh(eight_devices)
+    resolve = row_sharded(mesh, h)
+    buf = buffer_init(16, (), jnp.float32)
+    sharding = resolve("x", buf)
+    assert tuple(sharding.data.spec)[0] == ("dcn", "ici")
+    with pytest.raises(ValueError, match="divisible"):
+        row_sharded(mesh, h)("x", buffer_init(12, (), jnp.float32))
