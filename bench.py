@@ -404,6 +404,10 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
         "flat2d_collective_calls": flat2d_counters["collective_calls"],
         "flat2d_world_bytes": flat2d_counters["bytes_by_crossing"].get("world", 0),
     }
+    # fault counters ride the default line, pinned at ZERO: a clean bench run
+    # that retries, degrades, or quarantines anything is a regression
+    # (--check-trajectory binds these on every new BENCH_r* round)
+    out.update({k: v for k, v in grouped_counters.get("faults", {}).items()})
     if obs is not None:
         # the device-time scenario: drive the stateful per-metric API with
         # per-phase fencing on, so the trace carries per-metric
@@ -719,6 +723,10 @@ def _metric_description() -> str:
 # mode) or the in-process dict (smoke mode)
 _TRACE_KEYS = (
     "trace_schema",
+    "sync_retries",
+    "sync_deadline_exceeded",
+    "degraded_computes",
+    "quarantined_updates",
     "collective_calls",
     "sync_bytes",
     "collective_calls_ungrouped",
@@ -962,6 +970,145 @@ def check_collectives() -> int:
     return 1 if failures else 0
 
 
+# ------------------------------------------------------- fault-tolerance gate
+# --check-faults drives the sync8 collection's HOST sync plane (per-step
+# dist_sync_on_step forwards + the epoch compute) under a seeded chaos
+# schedule and pins the fault-tolerance contract:
+#   clean     — a guarded run with no injector reports ZERO fault counters
+#   recovered — transient drop + stall + corrupted-payload faults, all inside
+#               the retry budget: the final epoch values are BIT-EXACT vs the
+#               clean run and nothing degraded
+#   degraded  — a persistent drop exhausts the budget under policy 'degrade':
+#               the run completes within the deadline budget (no hang), the
+#               sync span is stamped degraded=yes, degraded_computes > 0
+FAULT_STEPS = 4
+FAULT_DEADLINE_S = 0.3
+FAULT_RETRIES = 2
+FAULT_BACKOFF_S = 0.02
+
+
+def _fault_collection():
+    from metrics_tpu import Accuracy, F1, MetricCollection, Precision, Recall
+    from metrics_tpu.parallel.sync import gather_all_arrays
+
+    kw = dict(dist_sync_on_step=True, dist_sync_fn=gather_all_arrays)
+    return MetricCollection([
+        Accuracy(**kw),
+        F1(num_classes=NUM_CLASSES, average="macro", **kw),
+        Precision(num_classes=NUM_CLASSES, average="macro", **kw),
+        Recall(num_classes=NUM_CLASSES, average="macro", **kw),
+    ])
+
+
+def _fault_epoch(schedule, guard, trace: bool = False):
+    """Drive FAULT_STEPS dist_sync_on_step forwards + the epoch compute under
+    ``schedule``/``guard``; returns (epoch values as numpy, counters
+    snapshot, elapsed seconds, degraded-span count)."""
+    import contextlib
+
+    import jax.numpy as jnp
+
+    from metrics_tpu import observability as obs
+    from metrics_tpu.parallel import faults
+    from metrics_tpu.parallel.sync import set_sync_guard
+
+    rng = np.random.RandomState(7)
+    logits = rng.rand(256, NUM_CLASSES).astype(np.float32)
+    preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+    target = jnp.asarray(rng.randint(0, NUM_CLASSES, 256).astype(np.int32))
+
+    obs.reset()
+    if trace:
+        obs.enable()
+    old_guard = set_sync_guard(guard)
+    injector = faults.ChaosInjector(schedule, seed=0) if schedule else contextlib.nullcontext()
+    try:
+        with injector:
+            collection = _fault_collection()
+            start = time.perf_counter()
+            for _ in range(FAULT_STEPS):
+                collection(preds, target)
+            values = {k: np.asarray(v) for k, v in collection.compute().items()}
+            elapsed = time.perf_counter() - start
+    finally:
+        set_sync_guard(old_guard)
+    counters = obs.counters_snapshot()
+    degraded_spans = 0
+    if trace:
+        degraded_spans = sum(
+            1 for rec in obs.records() if (rec.attrs or {}).get("degraded") == "yes"
+        )
+        obs.disable()
+    return values, counters, elapsed, degraded_spans
+
+
+def check_faults() -> int:
+    """``--check-faults``: the fault-tolerance regression gate (see the
+    schedule comment above). Prints one JSON report line; non-zero exit on
+    any broken contract."""
+    from metrics_tpu.parallel.faults import FaultSpec
+    from metrics_tpu.parallel.sync import SyncGuard
+
+    failures = []
+    guard = SyncGuard(
+        deadline_s=FAULT_DEADLINE_S, max_retries=FAULT_RETRIES,
+        backoff_s=FAULT_BACKOFF_S, policy="raise", check_finite=True,
+    )
+
+    clean_values, clean_counters, _, _ = _fault_epoch(schedule=None, guard=guard)
+    if any(clean_counters["faults"].values()):
+        failures.append(f"clean run reported nonzero fault counters: {clean_counters['faults']}")
+
+    recovered_schedule = [
+        FaultSpec(kind="drop", call=0, times=1),
+        FaultSpec(kind="stall", call=2, times=1, duration_s=2 * FAULT_DEADLINE_S),
+        FaultSpec(kind="corrupt", call=4, times=1),
+    ]
+    rec_values, rec_counters, _, _ = _fault_epoch(schedule=recovered_schedule, guard=guard)
+    if set(rec_values) != set(clean_values) or any(
+        not np.array_equal(rec_values[k], clean_values[k]) for k in clean_values
+    ):
+        failures.append("retry-recovered run is not bit-exact vs the fault-free run")
+    if rec_counters["faults"]["sync_retries"] < 3:
+        failures.append(
+            f"recovered run retried {rec_counters['faults']['sync_retries']} times; expected >= 3"
+        )
+    if rec_counters["faults"]["degraded_computes"] != 0:
+        failures.append("recovered run degraded; every fault was inside the retry budget")
+
+    degrade_guard = guard._replace(policy="degrade", max_retries=1, check_finite=False)
+    # generous no-hang budget: every guarded call could at worst burn the full
+    # deadline per attempt plus backoffs; a blocking-collective hang would
+    # blow far past it
+    budget_s = 30.0
+    deg_schedule = [FaultSpec(kind="drop", call=1, times=10_000)]
+    deg_values, deg_counters, deg_elapsed, deg_spans = _fault_epoch(
+        schedule=deg_schedule, guard=degrade_guard, trace=True
+    )
+    if deg_elapsed > budget_s:
+        failures.append(f"degrade run took {deg_elapsed:.1f}s > {budget_s}s budget (hang?)")
+    if deg_counters["faults"]["degraded_computes"] < 1:
+        failures.append("degrade run never flagged degraded_computes")
+    if deg_spans < 1:
+        failures.append("no sync span was stamped degraded=yes")
+    del deg_values  # single-process local-only state == the world state
+
+    print(json.dumps({
+        "check": "faults",
+        "ok": not failures,
+        "failures": failures,
+        "clean": {"faults": clean_counters["faults"]},
+        "recovered": {"faults": rec_counters["faults"]},
+        "degraded": {
+            "faults": deg_counters["faults"],
+            "elapsed_s": round(deg_elapsed, 3),
+            "budget_s": budget_s,
+            "degraded_spans": deg_spans,
+        },
+    }))
+    return 1 if failures else 0
+
+
 def main() -> None:
     trace_path = _trace_arg(sys.argv)
     if len(sys.argv) > 1 and sys.argv[1] == "--check-trajectory":
@@ -974,6 +1121,12 @@ def main() -> None:
                 + f" --xla_force_host_platform_device_count={N_DEVICES}"
             ).strip()
         raise SystemExit(check_trajectory_cli(sys.argv))
+
+    if len(sys.argv) > 1 and sys.argv[1] == "--check-faults":
+        # fault-tolerance gate: host-plane only (no virtual devices needed);
+        # jax not yet imported, so the platform pin lands in-process
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        raise SystemExit(check_faults())
 
     if len(sys.argv) > 1 and sys.argv[1] == "--check-collectives":
         # collective regression gate: jax is not yet imported, so the

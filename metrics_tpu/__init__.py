@@ -14,7 +14,15 @@ _logger.setLevel(logging.INFO)
 from metrics_tpu.info import __version__  # noqa: E402
 from metrics_tpu import observability  # noqa: E402  (span tracing + collective accounting)
 from metrics_tpu.core.collections import MetricCollection  # noqa: E402
-from metrics_tpu.core.metric import CompositionalMetric, Metric, PureMetric, set_default_jit  # noqa: E402
+from metrics_tpu.core.metric import (  # noqa: E402
+    CompositionalMetric,
+    Metric,
+    PureMetric,
+    nonfinite_count,
+    saturated_count,
+    set_default_jit,
+    state_integrity_counts,
+)
 from metrics_tpu.utils.debug import enable_sync_count_check  # noqa: E402
 from metrics_tpu.utils.profiling import profile_metric, time_fn  # noqa: E402
 from metrics_tpu.classification import (  # noqa: E402

@@ -553,8 +553,10 @@ class MetricCollection(OrderedDict):
                 self.__dict__["_col_batched_step"] = None
             else:
                 seed_epoch = jax.process_count() == 1
+                steps = args[0].shape[0] if args else next(iter(kwargs.values())).shape[0]
                 for k, m in self.items():
-                    m._note_rows(args, m._filter_kwargs(**kwargs))
+                    m._note_rows(args, m._filter_kwargs(**kwargs))  # watermark +1 ...
+                    m._epoch_watermark += steps - 1  # ... for a stack of steps
                     m._set_state(new_states[k])
                     m._forward_cache = jax.tree_util.tree_map(lambda v: v[-1], values[k])
                     m._computed = epochs[k] if seed_epoch and m.dist_sync_fn is None else None
@@ -641,6 +643,26 @@ class MetricCollection(OrderedDict):
             m._note_rows(args, m._filter_kwargs(**kwargs))
             m._set_state(m.merge_states(m._current_state(), deltas[rep]))
         self._lockstep_record()
+
+    # -------------------------------------------------- preemption-safe resume
+    @property
+    def epoch_watermark(self) -> int:
+        """The collection's resume point: the MINIMUM member watermark (a
+        step counts as applied only once every member holds it). Members
+        advance in lockstep through collection-level updates, so the min is
+        normally also the max; after a restore from a consistent checkpoint
+        they are equal by construction."""
+        return min((m._epoch_watermark for m in self.values()), default=0)
+
+    def guarded_update(self, step_index: int, *args: Any, **kwargs: Any) -> bool:
+        """Idempotent collection update (see ``Metric.guarded_update``):
+        applies the batch to every member only if ``step_index`` is not
+        already below the collection watermark — replaying the step that was
+        in flight at a preemption is a no-op after restore."""
+        if step_index < self.epoch_watermark:
+            return False
+        self.update(*args, **kwargs)
+        return True
 
     def compute(self) -> Dict[str, Any]:
         if TRACE.enabled:
@@ -830,10 +852,15 @@ class MetricCollection(OrderedDict):
             rep = gm[name]
             if rep != name and self._states_match(self[rep], m):
                 manifest[name] = rep
-                # host-side overflow bound is per-member metadata: it rides
-                # outside the shared entry so a restore keeps warning
+                # host-side metadata is per-member: the overflow bound rides
+                # outside the shared entry so a restore keeps warning, and
+                # the epoch watermark so a restored member replays
+                # idempotently (guarded_update)
                 destination[f"{prefix}{name}._count_bound"] = np.asarray(
                     m._count_bound, dtype=np.int64
+                )
+                destination[f"{prefix}{name}._epoch_watermark"] = np.asarray(
+                    m._epoch_watermark, dtype=np.int64
                 )
             else:
                 m.state_dict(destination, prefix=f"{prefix}{name}.")
@@ -854,6 +881,9 @@ class MetricCollection(OrderedDict):
                 key = f"{prefix}{name}._count_bound"
                 if key in state_dict:
                     m._count_bound = int(state_dict[key])
+                wm_key = f"{prefix}{name}._epoch_watermark"
+                if wm_key in state_dict:
+                    m._epoch_watermark = int(state_dict[wm_key])
                 # fanned-out members hold the representative's exact values:
                 # back in lockstep with their group
                 diverged.discard(name)

@@ -40,13 +40,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
-from metrics_tpu.observability.counters import record_cache, record_states_synced
+from metrics_tpu.observability.counters import record_cache, record_fault, record_states_synced
 from metrics_tpu.observability.devtime import DEVTIME as _DEVTIME, fence as _fence
 from metrics_tpu.observability.trace import TRACE, span as _span
 from metrics_tpu.parallel.buffer import PaddedBuffer, buffer_append, buffer_init
 from metrics_tpu.utils import compat, debug
 from metrics_tpu.utils.data import is_concrete
-from metrics_tpu.utils.exceptions import TracingUnsupportedError
+from metrics_tpu.utils.exceptions import StateCorruptionError, TracingUnsupportedError
 from metrics_tpu.utils.prints import rank_zero_warn
 from metrics_tpu.parallel.sync import (
     ReduceFx,
@@ -76,6 +76,51 @@ def set_default_jit(value: Optional[bool]) -> Optional[bool]:
     old = _DEFAULT_JIT
     _DEFAULT_JIT = value
     return old
+
+
+# -------------------------------------------------- state-integrity scanning
+# Jittable pure scans over a state pytree: usable inside jit/shard_map (the
+# pure API / in-jit sync plane) AND by the stateful check_finite policies
+# below (which read the scalars back host-side at eager call boundaries).
+CHECK_FINITE_POLICIES = (None, "warn", "raise", "quarantine")
+
+
+def nonfinite_count(state: "State") -> Array:
+    """Number of non-finite (NaN/Inf) elements across all float leaves of a
+    state pytree (int32 scalar; jittable — NaN poisoning propagates through
+    psum/all_gather identically on the flat and hierarchical sync planes, so
+    this scan works before or after either)."""
+    total = jnp.zeros((), dtype=jnp.int32)
+    for leaf in jax.tree_util.tree_leaves(state):
+        if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact):
+            total = total + jnp.sum(~jnp.isfinite(leaf)).astype(jnp.int32)
+    return total
+
+
+def saturated_count(state: "State") -> Array:
+    """Number of integer elements within a safety margin of their dtype's
+    range (int32 scalar; jittable).
+
+    A saturated count state is pre-wraparound corruption: one more epoch of
+    updates flips it negative with no error anywhere. The margin is
+    ``iinfo.max // 2048`` (for int32: ~2^20 — several large batches of
+    headroom, far above any legitimate stat count that close to 2^31).
+    """
+    total = jnp.zeros((), dtype=jnp.int32)
+    for leaf in jax.tree_util.tree_leaves(state):
+        arr = jnp.asarray(leaf)
+        if jnp.issubdtype(arr.dtype, jnp.integer):
+            info = jnp.iinfo(arr.dtype)
+            margin = max(info.max // 2048, 1)
+            hit = (arr >= info.max - margin) | (arr <= info.min + margin)
+            total = total + jnp.sum(hit).astype(jnp.int32)
+    return total
+
+
+def state_integrity_counts(state: "State") -> tuple:
+    """(nonfinite, saturated) element counts — the jittable integrity scan
+    behind the ``check_finite`` policies."""
+    return nonfinite_count(state), saturated_count(state)
 
 
 # ------------------------------------------------------- jitted-step sharing
@@ -112,6 +157,7 @@ _NON_TRACE_ATTRS = frozenset({
     "_computed", "_forward_cache", "_jitted_step", "_jitted_step_fc",
     "_jitted_scan", "_scan_failed",
     "_jit_failed", "_fc_failed", "_compute_jit_failed", "_count_bound", "_overflow_warned",
+    "_epoch_watermark", "check_finite",
     "_default_keys",
     "_to_sync", "_in_forward", "_sync_count", "dist_sync_fn",
     "_placement", "_state_dtype", "compute_on_step", "dist_sync_on_step",
@@ -255,6 +301,18 @@ class Metric(ABC):
             when all states are fixed-shape arrays/buffers and falls back to
             eager on metrics that need data-dependent Python (e.g. class-count
             inference from values).
+        check_finite: opt-in state-integrity guard (``None`` = off). After
+            every eager update/forward and after each host-plane sync the
+            state pytree is scanned for non-finite floats and saturated
+            integer counts (:func:`state_integrity_counts` — the scan itself
+            is jittable; the policy check reads one scalar back). Policies:
+            ``'warn'`` warns, ``'raise'`` throws a typed
+            ``StateCorruptionError``, ``'quarantine'`` discards the poisoned
+            batch delta (the accumulator reverts to its pre-update value and
+            ``quarantined_updates`` bumps) or, on sync, keeps the local state
+            instead of a poisoned gathered one. Subclasses don't forward the
+            kwarg — set the ``metric.check_finite`` attribute after
+            construction for library metrics.
     """
 
     def __init__(
@@ -265,6 +323,7 @@ class Metric(ABC):
         dist_sync_fn: Optional[Callable] = None,
         capacity: Optional[int] = None,
         jit: Optional[bool] = None,
+        check_finite: Optional[str] = None,
     ):
         self.dist_sync_on_step = dist_sync_on_step
         self.compute_on_step = compute_on_step
@@ -274,9 +333,18 @@ class Metric(ABC):
         self.dist_sync_fn = dist_sync_fn
         self.capacity = capacity
         self._jit = jit if jit is not None else _DEFAULT_JIT
+        if check_finite not in CHECK_FINITE_POLICIES:
+            raise ValueError(
+                f"`check_finite` must be one of {CHECK_FINITE_POLICIES}, got {check_finite!r}"
+            )
+        self.check_finite = check_finite
         self._to_sync = True
         self._in_forward = False
         self._sync_count = 0
+        # epoch watermark: batches folded into the accumulator this epoch.
+        # Persisted by state_dict so a preempted-and-restored loop can replay
+        # its last step idempotently (guarded_update).
+        self._epoch_watermark = 0
 
         self._update_signature = inspect.signature(self.update)
         self._update_impl = self.update  # unwrapped bound method (pure w.r.t. registered states)
@@ -652,6 +720,7 @@ class Metric(ABC):
         self._computed = None
         self._forward_cache = None
         self._note_rows(args, kwargs)
+        revert_to = self._pre_update_snapshot()
         delta = None
         value = self._NO_VALUE
         if self._jittable:
@@ -689,6 +758,7 @@ class Metric(ABC):
         if delta is None:
             delta = self._run_update_on_state(self.init_state(), *args, **kwargs)
             self._set_state(self.merge_states(self._current_state(), delta))
+        self._guard_state_integrity("forward", revert_to)
 
         if not self.compute_on_step:
             return None
@@ -720,6 +790,7 @@ class Metric(ABC):
             self._in_forward = True
             cache = self._current_state()
             bound = self._count_bound
+            watermark = self._epoch_watermark
             self.reset()
             try:
                 self.update(*args, **kwargs)
@@ -727,6 +798,7 @@ class Metric(ABC):
             finally:
                 self._set_state(cache)
                 self._count_bound = bound  # the temp reset must not lose the epoch bound
+                self._epoch_watermark = watermark  # nor the replay watermark
                 self._to_sync = True
                 self._in_forward = False
             self._computed = None
@@ -851,7 +923,9 @@ class Metric(ABC):
                 self._scan_failed = True
                 self._jitted_scan = None
             else:
-                self._note_rows(args, {})
+                self._note_rows(args, {})  # advances the watermark by 1 ...
+                # ... and the scan folded a whole stack of steps
+                self._epoch_watermark += args[0].shape[0] - 1
                 self._set_state(new_acc)
                 if with_compute:
                     self._forward_cache = jax.tree_util.tree_map(lambda v: v[-1], values)
@@ -873,6 +947,32 @@ class Metric(ABC):
             return None
         return jax.tree_util.tree_map(lambda *vs: jnp.stack(vs), *values)
 
+    # -------------------------------------------------- preemption-safe resume
+    @property
+    def epoch_watermark(self) -> int:
+        """Number of batches folded into the accumulator this epoch — i.e.
+        the next step index this metric expects. Persisted by ``state_dict``
+        and restored by ``load_state_dict``, so a loop restarted from a
+        mid-epoch checkpoint knows exactly which steps are already in."""
+        return self._epoch_watermark
+
+    def guarded_update(self, step_index: int, *args: Any, **kwargs: Any) -> bool:
+        """Idempotent update: apply the batch only if ``step_index`` is not
+        already folded into the state.
+
+        The preemption-safe accumulation API: drive the epoch with 0-based
+        step indices (``guarded_update(i, preds, target)``) and, after a
+        kill/restore, simply replay from anywhere at or before the
+        checkpoint — steps below the restored :attr:`epoch_watermark` are
+        no-ops (returns ``False``), so re-running the step that was in
+        flight at preemption cannot double-count. Returns ``True`` when the
+        batch was applied.
+        """
+        if step_index < self._epoch_watermark:
+            return False
+        self.update(*args, **kwargs)
+        return True
+
     # ------------------------------------------------------------------ sync
     def _default_gather(self) -> Callable:
         """World gather, scoped to ``process_group`` when one was given
@@ -892,32 +992,94 @@ class Metric(ABC):
 
     def _sync_dist(self, dist_sync_fn: Optional[Callable] = None) -> None:
         """Host-plane sync: gather + stack/flatten + per-state reduction
-        (reference metric.py:179-197)."""
+        (reference metric.py:179-197). Runs under the active ``SyncGuard``
+        (deadlines/retry/degrade — see ``parallel.sync``); the
+        ``check_finite`` policy then vets the gathered state (``quarantine``
+        keeps the LOCAL state when the synced one is poisoned)."""
         gather = dist_sync_fn if dist_sync_fn is not None else self._default_gather()
         record_states_synced(len(self._defaults))
+        local = self._current_state() if self.check_finite == "quarantine" else None
         if TRACE.enabled:
             with _span("metric.sync_state", {"metric": type(self).__name__}):
                 synced = host_gather(self._current_state(), self._reductions, gather_fn=gather)
                 if _DEVTIME.enabled:
                     _fence(synced)
+                self._set_state(synced)
+                self._guard_state_integrity("sync", local)
         else:
             synced = host_gather(self._current_state(), self._reductions, gather_fn=gather)
-        self._set_state(synced)
+            self._set_state(synced)
+            self._guard_state_integrity("sync", local)
 
     def _wrap_update(self, update: Callable) -> Callable:
         @functools.wraps(update)
         def wrapped_func(*args: Any, **kwargs: Any) -> Any:
             self._computed = None
             self._note_rows(args, kwargs)
+            revert_to = self._pre_update_snapshot()
             if TRACE.enabled:
                 with _span("metric.update", {"metric": type(self).__name__}):
                     out = update(*args, **kwargs)
                     if _DEVTIME.enabled:  # phase fence on the written states
                         _fence(self._current_state())
+                    self._guard_state_integrity("update", revert_to)
                     return out
-            return update(*args, **kwargs)
+            out = update(*args, **kwargs)
+            self._guard_state_integrity("update", revert_to)
+            return out
 
         return wrapped_func
+
+    # -------------------------------------------------- state-integrity guard
+    def _pre_update_snapshot(self) -> Optional[State]:
+        """Pre-update state refs, captured only under the quarantine policy
+        (jax arrays are immutable, so holding the refs is free)."""
+        if self.check_finite == "quarantine" and not self._under_trace():
+            return self._current_state()
+        return None
+
+    def _guard_state_integrity(self, where: str, revert_to: Optional[State] = None) -> None:
+        """Apply the ``check_finite`` policy to the CURRENT state.
+
+        Host-side and eager-only: under tracing the scan would need a
+        readback that cannot happen (use the pure :func:`nonfinite_count` /
+        :func:`saturated_count` inside jit instead). Policies: ``warn``
+        warns; ``raise`` throws ``StateCorruptionError``;
+        ``quarantine`` restores ``revert_to`` (the pre-update accumulator —
+        dropping the poisoned batch) when one was captured, else warns.
+        """
+        policy = self.check_finite
+        if not policy or self._under_trace():
+            return
+        state = self._current_state()
+        if any(isinstance(v, list) for v in state.values()):
+            # eager list states: scan the concrete elements, not the pytree
+            state = {
+                k: (
+                    jnp.concatenate([jnp.ravel(jnp.asarray(e)) for e in v]) if v else jnp.zeros((0,))
+                )
+                if isinstance(v, list)
+                else v
+                for k, v in state.items()
+            }
+        nonfinite, saturated = state_integrity_counts(state)
+        nonfinite, saturated = int(nonfinite), int(saturated)
+        if not nonfinite and not saturated:
+            return
+        detail = (
+            f"{self.__class__.__name__} state failed the integrity scan after {where}: "
+            f"{nonfinite} non-finite float element(s), {saturated} near-saturated integer "
+            "count(s)."
+        )
+        if policy == "raise":
+            raise StateCorruptionError(detail)
+        if policy == "quarantine" and revert_to is not None:
+            self._set_state(revert_to)
+            self._computed = None
+            record_fault("quarantined_updates")
+            rank_zero_warn(detail + " The batch delta was quarantined (accumulator unchanged).", UserWarning)
+            return
+        rank_zero_warn(detail, UserWarning)
 
     # warn at half the int32 range: headroom for a few more epochs of updates
     _OVERFLOW_WARN_THRESHOLD = 2**30
@@ -950,6 +1112,11 @@ class Metric(ABC):
         sizes = [s for s in sizes if isinstance(s, int)]
         if sizes:
             self._count_bound += min(sizes)
+        # every accumulation path notes its rows exactly once per logical
+        # step (the reference-path value recomputation runs _in_forward), so
+        # this is also where the epoch watermark advances
+        if not self._in_forward:
+            self._epoch_watermark += 1
 
     def _after_compute(self, result: Any) -> None:
         """Hook run by the wrapped ``compute`` after the sync cache/restore.
@@ -1060,6 +1227,7 @@ class Metric(ABC):
         self._computed = None
         self._count_bound = 0
         self._overflow_warned = False
+        self._epoch_watermark = 0
         state = self.init_state()
         self._set_state(state)
         if self._state_dtype is not None:
@@ -1083,6 +1251,8 @@ class Metric(ABC):
         self.__dict__.setdefault("_scan_failed", False)
         self.__dict__.setdefault("_count_bound", 0)
         self.__dict__.setdefault("_overflow_warned", False)
+        self.__dict__.setdefault("_epoch_watermark", 0)
+        self.__dict__.setdefault("check_finite", None)
         self._update_impl = self.__class__.update.__get__(self)
         self._compute_impl = self.__class__.compute.__get__(self)
         self.update = self._wrap_update(self._update_impl)
@@ -1178,6 +1348,9 @@ class Metric(ABC):
         # restored metric would never warn (the bound is host metadata, not
         # a device state)
         destination[prefix + "_count_bound"] = np.asarray(self._count_bound, dtype=np.int64)
+        # the epoch watermark rides every checkpoint: restore + replay of the
+        # in-flight step must be a no-op (guarded_update)
+        destination[prefix + "_epoch_watermark"] = np.asarray(self._epoch_watermark, dtype=np.int64)
         return destination
 
     def load_state_dict(self, state_dict: dict, prefix: str = "") -> None:
@@ -1192,6 +1365,8 @@ class Metric(ABC):
                     setattr(self, key, jnp.asarray(value))
         if prefix + "_count_bound" in state_dict:
             self._count_bound = int(state_dict[prefix + "_count_bound"])
+        if prefix + "_epoch_watermark" in state_dict:
+            self._epoch_watermark = int(state_dict[prefix + "_epoch_watermark"])
 
     def state_pytree(self) -> State:
         """All current states as a pytree (for orbax checkpointing of the full metric)."""

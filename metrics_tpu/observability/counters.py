@@ -34,6 +34,18 @@ What gets counted, and on which plane:
   compute-group dedup and bucket coalescing shrink).
 - **Cache traffic**: compute-group map builds, shared jitted-step lookups,
   and sharded-launch lookups, as hit/miss pairs.
+- **Fault counters** (``sync_retries`` / ``sync_deadline_exceeded`` /
+  ``degraded_computes`` / ``quarantined_updates``): the fault-tolerance
+  layer's evidence trail (``parallel.sync`` deadlines, ``parallel.faults``
+  chaos injection, the ``check_finite`` quarantine policy). Unlike every
+  other counter these record even while counting is DISABLED: faults are
+  rare, operationally important, and must not vanish because observability
+  happened to be off. Expected zero on clean runs — ``bench.py
+  --check-trajectory`` pins them at zero on every round.
+- **gather_skips**: host-plane syncs that skipped the collective entirely
+  because the state pytree was empty/all-``None`` (a zero-payload gather is
+  a pure liability: one more rendezvous every rank must enter). A health
+  counter, not a fault — nonzero on clean runs is fine.
 
 Counting is off by default; the disabled path is one attribute load and a
 falsy branch per call site. All mutation happens under one lock — counter
@@ -46,11 +58,14 @@ from typing import Any, Dict, Optional
 __all__ = [
     "COUNTERS",
     "CollectiveCounters",
+    "FAULT_KINDS",
     "enable",
     "disable",
     "is_enabled",
     "record_cache",
     "record_collective",
+    "record_fault",
+    "record_gather_skip",
     "record_states_synced",
     "reset",
     "snapshot",
@@ -73,6 +88,16 @@ KINDS = (
     "process_allgather",
 )
 
+# fault-counter kinds with a stable schema position in snapshots; every
+# snapshot carries all of them (zeros included) so consumers — the bench
+# line, --check-trajectory — can bind on them unconditionally.
+FAULT_KINDS = (
+    "sync_retries",  # guarded gather attempts re-issued after a transient failure
+    "sync_deadline_exceeded",  # retry budgets exhausted (either policy)
+    "degraded_computes",  # host-plane syncs that fell back to local-only state
+    "quarantined_updates",  # batch deltas discarded by check_finite='quarantine'
+)
+
 
 class CollectiveCounters:
     """Process-wide counters; ``enabled`` is the hot-path gate."""
@@ -90,6 +115,8 @@ class CollectiveCounters:
         "step_cache_misses",
         "launch_cache_hits",
         "launch_cache_misses",
+        "faults",
+        "gather_skips",
         "_lock",
     )
 
@@ -110,6 +137,8 @@ class CollectiveCounters:
         self.step_cache_misses = 0
         self.launch_cache_hits = 0
         self.launch_cache_misses = 0
+        self.faults: Dict[str, int] = {k: 0 for k in FAULT_KINDS}
+        self.gather_skips = 0
 
     # ---------------------------------------------------------- recording
     def record_collective(
@@ -147,6 +176,18 @@ class CollectiveCounters:
         with self._lock:
             setattr(self, attr, getattr(self, attr) + 1)
 
+    def record_fault(self, kind: str, n: int = 1) -> None:
+        """``kind`` must be in :data:`FAULT_KINDS` (typo'd fault evidence is
+        worse than none — fail loudly)."""
+        if kind not in self.faults:
+            raise ValueError(f"unknown fault kind {kind!r}; expected one of {FAULT_KINDS}")
+        with self._lock:
+            self.faults[kind] += int(n)
+
+    def record_gather_skip(self) -> None:
+        with self._lock:
+            self.gather_skips += 1
+
     # ------------------------------------------------------------ reading
     def snapshot(self) -> Dict[str, Any]:
         """A JSON-ready copy of every counter.
@@ -166,6 +207,8 @@ class CollectiveCounters:
                 "calls_by_crossing": dict(sorted(self.calls_by_crossing.items())),
                 "bytes_by_crossing": dict(sorted(self.bytes_by_crossing.items())),
                 "states_synced": self.states_synced,
+                "faults": dict(self.faults),
+                "gather_skips": self.gather_skips,
                 "group_cache": {"hits": self.group_cache_hits, "misses": self.group_cache_misses},
                 "step_cache": {"hits": self.step_cache_hits, "misses": self.step_cache_misses},
                 "launch_cache": {"hits": self.launch_cache_hits, "misses": self.launch_cache_misses},
@@ -197,6 +240,17 @@ def record_states_synced(n: int) -> None:
 def record_cache(which: str, hit: bool) -> None:
     if COUNTERS.enabled:
         COUNTERS.record_cache(which, hit)
+
+
+# Fault evidence records UNCONDITIONALLY (no enabled gate): faults are rare
+# (never the hot path) and losing the trail because observability was off
+# would defeat the point. ``reset()`` still zeroes them.
+def record_fault(kind: str, n: int = 1) -> None:
+    COUNTERS.record_fault(kind, n)
+
+
+def record_gather_skip() -> None:
+    COUNTERS.record_gather_skip()
 
 
 def enable() -> None:

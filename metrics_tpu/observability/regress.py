@@ -15,6 +15,12 @@ trajectory a first-class regression surface with two gate classes:
   counters): exact, deterministic numbers — ANY growth over the most recent
   round that carries the key fails. A shrink reports ``improved`` (re-pin
   by letting the next BENCH round record it).
+- **Fault counters** (``sync_retries`` / ``sync_deadline_exceeded`` /
+  ``degraded_computes`` / ``quarantined_updates``): pinned at EXACTLY ZERO
+  whenever the current line carries them — a clean bench run that retried,
+  degraded, or quarantined anything is a fault-tolerance regression
+  regardless of what prior rounds recorded. These bind on every new
+  ``BENCH_r*`` round since the keys joined the default line.
 
 Rounds predating a key (older schemas) simply don't constrain it, so the
 gate tightens as the trajectory grows instead of blocking schema evolution.
@@ -28,6 +34,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = [
     "COUNT_KEYS",
+    "FAULT_KEYS",
     "MS_KEYS",
     "TOLERANCES",
     "check_trajectory",
@@ -67,6 +74,15 @@ COUNT_KEYS: Tuple[str, ...] = (
     "states_synced",
     "states_synced_ungrouped",
     "gather_states_synced",
+)
+
+# fault counters: bound at exactly zero whenever the current line carries
+# them (no baseline needed — zero IS the contract on a clean run)
+FAULT_KEYS: Tuple[str, ...] = (
+    "sync_retries",
+    "sync_deadline_exceeded",
+    "degraded_computes",
+    "quarantined_updates",
 )
 
 TOLERANCES: Dict[str, float] = {
@@ -167,6 +183,19 @@ def check_trajectory(
             failures.append(f"{key}: {got} > pinned {last} (round {last_round})")
         elif got < last:
             row["status"] = "improved"
+        else:
+            row["status"] = "ok"
+        checks[key] = row
+
+    for key in FAULT_KEYS:
+        got = current.get(key)
+        if not isinstance(got, (int, float)) or isinstance(got, bool):
+            checks[key] = {"status": "missing"}
+            continue
+        row = {"current": got, "baseline": 0, "kind": "fault"}
+        if got != 0:
+            row["status"] = "regression"
+            failures.append(f"{key}: {got} != 0 (fault counters must be zero on a clean bench run)")
         else:
             row["status"] = "ok"
         checks[key] = row

@@ -7,6 +7,15 @@ from metrics_tpu.parallel.buffer import (
     buffer_mask,
     buffer_merge,
     buffer_values,
+    handle_overflow,
+    overflow_policy,
+    set_overflow_policy,
+)
+from metrics_tpu.parallel.faults import (
+    ChaosInjector,
+    FaultSpec,
+    chaos,
+    corrupt_pytree,
 )
 from metrics_tpu.parallel.placement import (
     HostHierarchy,
@@ -31,11 +40,14 @@ from metrics_tpu.parallel.sharded_epoch import (
     sharded_spearman,
 )
 from metrics_tpu.parallel.sync import (
+    SyncGuard,
     coalesced_sync_state,
+    current_sync_guard,
     gather_all_arrays,
     host_gather,
     merge_values,
     packable_gather,
+    set_sync_guard,
     slice_leader_gather,
     sync_state,
     sync_value,

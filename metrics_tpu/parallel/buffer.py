@@ -9,14 +9,68 @@ scalar ``count`` — a pytree that can live inside ``jit``/``scan``/``shard_map`
 be donated, and be all-gathered over a mesh axis with one collective.
 
 Overflow policy: ``count`` keeps the true number of appended rows; rows beyond
-``capacity`` are dropped on device. Host-side consumers (``values``) raise if
-``count > capacity`` so silent truncation can't corrupt a metric.
+``capacity`` are dropped on device. What happens when a host-side consumer
+observes ``count > capacity`` is an EXPLICIT policy (:func:`handle_overflow`):
+
+- ``"error"`` (default): raise a typed
+  :class:`~metrics_tpu.utils.exceptions.BufferOverflowError` — silent
+  truncation can't corrupt a metric.
+- ``"warn_drop"``: warn once (per message, process lifetime) and keep the
+  capacity-truncated rows — the degraded-but-alive mode for serving loops
+  where a partial curve beats a crashed epoch.
+
+The process-wide default is set with :func:`set_overflow_policy`; call sites
+(``buffer_values``, the host sync plane in ``parallel/sync.py``) accept a
+per-call override.
 """
-from typing import NamedTuple, Sequence, Tuple, Union
+from typing import NamedTuple, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 from jax import Array
+
+from metrics_tpu.utils.exceptions import BufferOverflowError
+
+OVERFLOW_POLICIES = ("error", "warn_drop")
+
+_OVERFLOW_POLICY = "error"
+
+
+def set_overflow_policy(policy: str) -> str:
+    """Set the process-wide PaddedBuffer overflow policy; returns the old one."""
+    global _OVERFLOW_POLICY
+    if policy not in OVERFLOW_POLICIES:
+        raise ValueError(f"overflow policy must be one of {OVERFLOW_POLICIES}, got {policy!r}")
+    old = _OVERFLOW_POLICY
+    _OVERFLOW_POLICY = policy
+    return old
+
+
+def overflow_policy() -> str:
+    return _OVERFLOW_POLICY
+
+
+def handle_overflow(name: str, count: int, capacity: int, policy: Optional[str] = None) -> None:
+    """Apply the overflow policy to one observed ``(count, capacity)`` pair.
+
+    No-op when ``count <= capacity``. ``policy=None`` uses the process-wide
+    default. ``name`` labels the offending state in the error/warning.
+    """
+    if count <= capacity:
+        return
+    policy = policy if policy is not None else _OVERFLOW_POLICY
+    if policy not in OVERFLOW_POLICIES:
+        raise ValueError(f"overflow policy must be one of {OVERFLOW_POLICIES}, got {policy!r}")
+    message = (
+        f"PaddedBuffer state '{name}' overflowed: {count} rows appended into capacity "
+        f"{capacity}; rows beyond capacity were dropped on device. Increase the metric's "
+        "`capacity` argument."
+    )
+    if policy == "error":
+        raise BufferOverflowError(message)
+    from metrics_tpu.utils.prints import rank_zero_warn_once
+
+    rank_zero_warn_once(message, UserWarning)
 
 
 class PaddedBuffer(NamedTuple):
@@ -95,15 +149,16 @@ def buffer_all_gather(buf: PaddedBuffer, axis_name: str) -> PaddedBuffer:
     return buffer_compact_gathered(data, counts)
 
 
-def buffer_values(buf: PaddedBuffer) -> Array:
-    """Host-side: the valid rows as a dense array. Raises on overflow."""
+def buffer_values(buf: PaddedBuffer, overflow: Optional[str] = None) -> Array:
+    """Host-side: the valid rows as a dense array.
+
+    Overflow (``count > capacity``) goes through :func:`handle_overflow`:
+    policy ``error`` raises ``BufferOverflowError``, ``warn_drop`` warns once
+    and returns the capacity-truncated rows.
+    """
     count = int(buf.count)
-    if count > buf.capacity:
-        raise RuntimeError(
-            f"PaddedBuffer overflow: {count} rows appended into capacity {buf.capacity}. "
-            "Increase the metric's `capacity` argument."
-        )
-    return buf.data[:count]
+    handle_overflow("<buffer>", count, buf.capacity, policy=overflow)
+    return buf.data[: min(count, buf.capacity)]
 
 
 def buffer_mask(buf: PaddedBuffer) -> Array:

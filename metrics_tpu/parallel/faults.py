@@ -1,0 +1,258 @@
+"""Chaos harness: seeded, scenario-addressable fault injection for the sync
+planes.
+
+The fault-tolerance layer (deadlines/retry/degrade in ``parallel.sync``,
+integrity guards in ``core.metric``) is only trustworthy if its behavior under
+faults is *tested*, and real faults — preempted hosts, stalled DCN exchanges,
+NaN-poisoned batches — don't reproduce on demand. This module makes them
+reproduce: a :class:`ChaosInjector` holds a seeded schedule of
+:class:`FaultSpec` s and installs itself as the host-plane fault hook in
+``parallel.sync``; every guarded gather call then consults it. Four fault
+kinds:
+
+- ``stall``: the gather call sleeps ``duration_s`` before proceeding — the
+  deadline machinery must detect it (the stall burns one attempt; a retry
+  after the stall is consumed succeeds).
+- ``drop``: the gather raises :class:`~metrics_tpu.utils.exceptions.
+  InjectedFaultError` (a rank dropped out of / never reached the collective).
+  Retryable; ``times`` controls how many consecutive attempts fail.
+- ``corrupt``: the gathered payload comes back NaN-poisoned — detectable by
+  the guard's ``check_finite`` scan (which retries) or by a metric's
+  ``check_finite`` policy downstream.
+- ``preempt``: raises :class:`~metrics_tpu.utils.exceptions.PreemptionError`
+  — the SIGTERM-mid-epoch analogue. Never retried; the caller is expected to
+  checkpoint/restore and replay through the epoch watermark
+  (``Metric.guarded_update``).
+
+Faults are *scenario-addressable*: a spec pins the exact gather call index it
+fires on (``call=``, counted per site from injector install), or fires
+probabilistically (``rate=``) from the injector's seeded RNG — both
+deterministic for a given (schedule, seed), which is what lets
+``bench.py --check-faults`` assert bit-exact recovery.
+
+The in-jit plane stages XLA collectives at trace time, so runtime injection
+is impossible there; :func:`corrupt_pytree` poisons a state pytree *before*
+it enters ``sync_state``/``coalesced_sync_state`` instead — NaN propagates
+through psum/all_gather identically on the flat and hierarchical planes, and
+the jittable ``core.metric.nonfinite_count`` scan detects it after.
+
+Usage (tests, bench)::
+
+    from metrics_tpu.parallel import faults
+
+    schedule = [
+        faults.FaultSpec(kind="drop", call=1, times=2),
+        faults.FaultSpec(kind="stall", call=3, duration_s=0.5),
+    ]
+    with faults.ChaosInjector(schedule, seed=0) as inj:
+        ...  # drive the eval loop; host gathers 1 and 3 get faulted
+    assert inj.injected["drop"] == 2
+"""
+import random
+import threading
+import time
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from metrics_tpu.utils.exceptions import InjectedFaultError, PreemptionError
+
+__all__ = [
+    "ChaosInjector",
+    "FaultSpec",
+    "chaos",
+    "corrupt_pytree",
+    "current_injector",
+]
+
+FAULT_KINDS = ("stall", "drop", "corrupt", "preempt")
+
+
+class FaultSpec(NamedTuple):
+    """One addressable fault in a chaos schedule.
+
+    ``call`` pins the site-relative gather-call index the fault fires on
+    (``None`` = fire probabilistically at ``rate`` per call, from the
+    injector's seeded RNG). ``times`` is how many consecutive *attempts* of
+    that call are affected — the lever that distinguishes a transient fault
+    (``times <= max_retries``, recovered) from a persistent one
+    (``times`` large, exhausting the budget into raise/degrade).
+    """
+
+    kind: str  # 'stall' | 'drop' | 'corrupt' | 'preempt'
+    call: Optional[int] = None
+    times: int = 1
+    duration_s: float = 0.0  # stall length
+    rate: float = 0.0  # per-call probability when call is None
+    site: str = "host_gather"
+
+
+class ChaosInjector:
+    """Seeded fault injector; install as the sync-plane hook via ``with`` (or
+    ``install()``/``uninstall()``).
+
+    Thread-safe: guarded gather attempts may run on deadline worker threads.
+    ``calls`` counts gather calls seen per site; ``injected`` counts fired
+    faults per kind — both are the assertion surface for chaos tests.
+    """
+
+    def __init__(self, schedule: Sequence[FaultSpec], seed: int = 0):
+        for spec in schedule:
+            if spec.kind not in FAULT_KINDS:
+                raise ValueError(f"unknown fault kind {spec.kind!r}; expected one of {FAULT_KINDS}")
+            if spec.call is None and spec.rate <= 0.0 and spec.kind != "preempt":
+                raise ValueError(f"spec {spec!r} is unaddressed: set call= or rate>0")
+        self.schedule: List[FaultSpec] = list(schedule)
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.calls: Dict[str, int] = {}
+        self.injected: Dict[str, int] = {k: 0 for k in FAULT_KINDS}
+        # rate-based firing is decided ONCE per (spec, call) — a retry of the
+        # same call must see the same verdict, or rate faults would be
+        # unrecoverable noise instead of deterministic scenarios
+        self._rate_verdicts: Dict[tuple, bool] = {}
+
+    # ------------------------------------------------------------- matching
+    def _matches(self, spec: FaultSpec, site: str, idx: int) -> bool:
+        if spec.site != site:
+            return False
+        if spec.call is not None:
+            return spec.call == idx
+        key = (id(spec), site, idx)
+        verdict = self._rate_verdicts.get(key)
+        if verdict is None:
+            verdict = self._rate_verdicts[key] = self._rng.random() < spec.rate
+        return verdict
+
+    def _fire(self, spec: FaultSpec) -> None:
+        self.injected[spec.kind] += 1
+
+    # ------------------------------------------------------- hook interface
+    def note_call(self, site: str) -> int:
+        """Assign the next site-relative call index (sync.py calls this once
+        per logical gather call, before any attempt)."""
+        with self._lock:
+            idx = self.calls.get(site, 0)
+            self.calls[site] = idx + 1
+        return idx
+
+    def before_call(self, site: str, idx: int, attempt: int) -> None:
+        """Runs before attempt ``attempt`` of gather call ``idx`` at ``site``.
+
+        May sleep (stall), raise ``InjectedFaultError`` (drop), or raise
+        ``PreemptionError`` (preempt). Called from the guarded gather path —
+        possibly on a deadline worker thread.
+        """
+        with self._lock:
+            for spec in self.schedule:
+                if not self._matches(spec, site, idx) or attempt >= spec.times:
+                    continue
+                if spec.kind == "preempt":
+                    self._fire(spec)
+                    raise PreemptionError(
+                        f"injected preemption at {site} call {idx} (attempt {attempt})"
+                    )
+                if spec.kind == "drop":
+                    self._fire(spec)
+                    raise InjectedFaultError(
+                        f"injected dropped participation at {site} call {idx} (attempt {attempt})"
+                    )
+                if spec.kind == "stall":
+                    self._fire(spec)
+                    duration = spec.duration_s
+                    break
+            else:
+                return
+        time.sleep(duration)  # outside the lock: a stall must not block peers
+
+    def after_call(self, site: str, idx: int, attempt: int, result: Any) -> Any:
+        """Runs on the gathered result; may corrupt payloads (NaN-poison)."""
+        with self._lock:
+            corrupt = any(
+                spec.kind == "corrupt" and self._matches(spec, site, idx) and attempt < spec.times
+                for spec in self.schedule
+            )
+            if corrupt:
+                self.injected["corrupt"] += 1
+        if not corrupt:
+            return result
+        return [_poison(arr) for arr in result]
+
+    # ----------------------------------------------------------- lifecycle
+    def install(self) -> "ChaosInjector":
+        from metrics_tpu.parallel import sync as _sync
+
+        if _sync._FAULT_HOOK is not None and _sync._FAULT_HOOK is not self:
+            raise RuntimeError("another ChaosInjector is already installed")
+        _sync._FAULT_HOOK = self
+        return self
+
+    def uninstall(self) -> None:
+        from metrics_tpu.parallel import sync as _sync
+
+        if _sync._FAULT_HOOK is self:
+            _sync._FAULT_HOOK = None
+
+    def __enter__(self) -> "ChaosInjector":
+        return self.install()
+
+    def __exit__(self, *exc: Any) -> bool:
+        self.uninstall()
+        return False
+
+
+def _poison(arr: Any) -> Any:
+    """Corrupt one gathered payload: floats are NaN-filled, integers are
+    filled with their dtype max (saturated garbage — the int analogue of
+    NaN, and exactly what the guard's integrity scan flags). Other dtypes
+    (bool) pass through."""
+    import jax.numpy as jnp
+
+    a = np.asarray(arr)
+    if np.issubdtype(a.dtype, np.floating):
+        return jnp.full(a.shape, np.nan, dtype=a.dtype)
+    if np.issubdtype(a.dtype, np.integer):
+        return jnp.full(a.shape, np.iinfo(a.dtype).max, dtype=a.dtype)
+    return arr
+
+
+def current_injector() -> Optional[ChaosInjector]:
+    """The installed injector, if any (sync.py consults this indirectly)."""
+    from metrics_tpu.parallel import sync as _sync
+
+    hook = _sync._FAULT_HOOK
+    return hook if isinstance(hook, ChaosInjector) else None
+
+
+def chaos(*specs: FaultSpec, seed: int = 0) -> ChaosInjector:
+    """Sugar: ``with chaos(FaultSpec(...), FaultSpec(...)) as inj: ...``."""
+    return ChaosInjector(specs, seed=seed)
+
+
+def corrupt_pytree(state: Any, seed: int = 0, fraction: float = 1.0) -> Any:
+    """NaN-poison float leaves of a state pytree (the in-jit plane's fault
+    model: staged collectives can't be intercepted at runtime, so the payload
+    is corrupted BEFORE it enters ``sync_state``; psum/all_gather then
+    propagate the NaN on flat and hierarchical planes alike).
+
+    ``fraction`` poisons that share of each float leaf's elements (the
+    leading elements — deterministic for a given pytree); ``seed`` is kept
+    in the signature for schedule bookkeeping parity with the injector.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    del seed  # deterministic either way; kept for API symmetry
+
+    def poison(leaf: Any) -> Any:
+        arr = jnp.asarray(leaf) if hasattr(leaf, "dtype") else None
+        if arr is None or not jnp.issubdtype(arr.dtype, jnp.floating):
+            return leaf
+        if fraction >= 1.0 or arr.size == 0:
+            return jnp.full(arr.shape, jnp.nan, dtype=arr.dtype)
+        flat = jnp.ravel(arr)
+        n = max(1, int(flat.size * fraction))
+        return flat.at[:n].set(jnp.nan).reshape(arr.shape)
+
+    return jax.tree_util.tree_map(poison, state)

@@ -32,17 +32,26 @@ collective count, same program. The host plane's analogue is
 :func:`slice_leader_gather`.
 """
 import functools
-from typing import Any, Callable, Dict, List, Optional, Union
+import threading
+import time
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import Array
 
-from metrics_tpu.observability.counters import record_collective, record_states_synced
+from metrics_tpu.observability.counters import (
+    record_collective,
+    record_fault,
+    record_gather_skip,
+    record_states_synced,
+)
 from metrics_tpu.observability.jaxprof import annotate
-from metrics_tpu.parallel.buffer import PaddedBuffer, buffer_all_gather
+from metrics_tpu.parallel.buffer import PaddedBuffer, buffer_all_gather, handle_overflow
 from metrics_tpu.parallel.placement import HostHierarchy, MeshHierarchy
 from metrics_tpu.utils.data import dim_zero_cat, dim_zero_max, dim_zero_mean, dim_zero_min, dim_zero_sum
+from metrics_tpu.utils.exceptions import InjectedFaultError, StateCorruptionError, SyncTimeoutError
 
 # A reduction spec as accepted by ``Metric.add_state`` (reference metric.py:88-148),
 # extended with 'min'/'max' (the reference passes torch.min/torch.max callables
@@ -500,6 +509,206 @@ def coalesced_sync_state(
     return out
 
 
+# ------------------------------------------------- host-plane fault tolerance
+class SyncGuard(NamedTuple):
+    """Deadline/retry/degrade policy for the host sync plane.
+
+    Applied per gather CALL by :func:`host_gather` (and everything routed
+    through it: the packed plane, slice-leader mode, the collection's grouped
+    host sync). The default guard — no deadline, no finite-checking — keeps
+    the exact pre-guard fast path: zero wrapping, zero threads.
+
+    - ``deadline_s``: bound on how long one gather attempt may be *waited on*
+      (the attempt itself keeps running on a daemon worker — a stalled
+      collective cannot be cancelled, only abandoned — so the rank still
+      ENTERS the collective and peers' rendezvous completes).
+    - ``max_retries`` / ``backoff_s``: transient failures (injected drops,
+      deadline expiries, detected payload corruption) are retried up to
+      ``max_retries`` times with exponential backoff
+      (``backoff_s * 2**attempt``).
+    - ``policy``: on exhaustion, ``"raise"`` throws a typed
+      :class:`~metrics_tpu.utils.exceptions.SyncTimeoutError`; ``"degrade"``
+      falls back to LOCAL-ONLY state for the rest of this sync plane — the
+      enclosing span is stamped ``degraded=yes`` and ``degraded_computes``
+      bumps. A degrading rank still issues (fire-and-forget) every remaining
+      collective it would have entered, preserving world-collective entry
+      order so it never deadlocks the others.
+    - ``check_finite``: scan gathered payloads and treat non-finite values
+      that were NOT in the local payload as transient corruption (retry).
+    """
+
+    deadline_s: Optional[float] = None
+    max_retries: int = 2
+    backoff_s: float = 0.05
+    policy: str = "raise"  # 'raise' | 'degrade'
+    check_finite: bool = False
+
+
+_SYNC_GUARD = SyncGuard()
+
+# host-plane fault hook (a parallel.faults.ChaosInjector when installed);
+# consulted only on the guarded path
+_FAULT_HOOK: Optional[Any] = None
+
+
+def set_sync_guard(guard: Optional[SyncGuard]) -> SyncGuard:
+    """Set the process-wide default :class:`SyncGuard`; returns the old one
+    (``None`` restores the trivial default)."""
+    global _SYNC_GUARD
+    old = _SYNC_GUARD
+    guard = guard if guard is not None else SyncGuard()
+    if guard.policy not in ("raise", "degrade"):
+        raise ValueError(f"SyncGuard.policy must be 'raise' or 'degrade', got {guard.policy!r}")
+    _SYNC_GUARD = guard
+    return old
+
+
+def current_sync_guard() -> SyncGuard:
+    return _SYNC_GUARD
+
+
+class _DeadlineExceeded(Exception):
+    """Internal: one gather attempt exceeded ``deadline_s`` (retryable)."""
+
+
+def _attempt_with_deadline(call: Callable[[], Any], deadline_s: float) -> Any:
+    """Run ``call`` on a daemon worker, waiting at most ``deadline_s``.
+
+    On expiry the WAIT is abandoned, not the call: a collective cannot be
+    cancelled once entered, and abandoning the entry would strand the peers'
+    rendezvous. The daemon flag keeps an injected infinite stall from
+    blocking process exit.
+    """
+    box: Dict[str, Any] = {}
+    done = threading.Event()
+
+    def work() -> None:
+        try:
+            box["result"] = call()
+        except BaseException as err:  # noqa: BLE001 - transported to the waiter
+            box["error"] = err
+        finally:
+            done.set()
+
+    worker = threading.Thread(target=work, daemon=True, name="mtpu-sync-guard")
+    worker.start()
+    if not done.wait(deadline_s):
+        raise _DeadlineExceeded(f"gather attempt exceeded its {deadline_s}s deadline")
+    if "error" in box:
+        raise box["error"]
+    return box["result"]
+
+
+def _fire_and_forget(call: Callable[[], Any]) -> None:
+    """Issue a collective without waiting on it (the degraded rank's
+    entry-order obligation)."""
+    threading.Thread(target=lambda: _swallow(call), daemon=True, name="mtpu-sync-degraded").start()
+
+
+def _swallow(call: Callable[[], Any]) -> None:
+    try:
+        call()
+    except BaseException:  # noqa: BLE001 - the result is abandoned by design
+        pass
+
+
+def _payload_suspect(arr: "np.ndarray") -> bool:
+    """Corruption signature of one payload array: non-finite floats, or
+    integers within the saturation margin of their dtype range (the int
+    analogue of NaN — see ``core.metric.saturated_count``)."""
+    if np.issubdtype(arr.dtype, np.floating):
+        return not np.isfinite(arr).all()
+    if np.issubdtype(arr.dtype, np.integer):
+        info = np.iinfo(arr.dtype)
+        margin = max(info.max // 2048, 1)
+        return bool(((arr >= info.max - margin) | (arr <= info.min + margin)).any())
+    return False
+
+
+def _payload_corrupted(local: Any, gathered: List[Any]) -> bool:
+    """Corruption signatures in the gathered payload that the LOCAL payload
+    did not carry (a genuinely-NaN or genuinely-saturated state must not
+    retry forever)."""
+    if _payload_suspect(np.asarray(local)):
+        return False
+    return any(_payload_suspect(np.asarray(part)) for part in gathered)
+
+
+def _guard_gather_fn(gather_fn: Callable, guard: SyncGuard, plane: Dict[str, Any]) -> Callable:
+    """Wrap one gather fn with the deadline/retry/degrade machinery.
+
+    ``plane`` is the per-``host_gather`` shared state: the site-relative call
+    counter (fault addressing), the degraded latch, and the installed fault
+    hook. The wrapper transports exactly ``gather_fn(value) -> [per-rank]``,
+    so it rides the packed and per-leaf paths unchanged.
+    """
+
+    def guarded(value: Any) -> List[Any]:
+        hook = plane["hook"]
+        site = plane["site"]
+        idx = hook.note_call(site) if hook is not None else plane["calls"]
+        plane["calls"] += 1
+
+        def attempt_call(attempt: int) -> List[Any]:
+            if hook is not None:
+                hook.before_call(site, idx, attempt)
+            result = gather_fn(value)
+            if hook is not None:
+                result = hook.after_call(site, idx, attempt, result)
+            return result
+
+        if plane["degraded"]:
+            # entry order preserved: the degraded rank still ISSUES every
+            # collective it would have entered, so peers' rendezvous
+            # completes; it just never waits on the result again
+            _fire_and_forget(lambda: attempt_call(0))
+            return [value]
+
+        attempt = 0
+        while True:
+            try:
+                if guard.deadline_s is not None:
+                    result = _attempt_with_deadline(lambda a=attempt: attempt_call(a), guard.deadline_s)
+                else:
+                    result = attempt_call(attempt)
+                if guard.check_finite and _payload_corrupted(value, result):
+                    raise StateCorruptionError(
+                        f"non-finite values appeared in gathered sync payload (call {idx})"
+                    )
+                return result
+            except (InjectedFaultError, _DeadlineExceeded, StateCorruptionError) as err:
+                attempt += 1
+                record_fault("sync_retries")
+                if attempt <= guard.max_retries:
+                    time.sleep(guard.backoff_s * (2 ** (attempt - 1)))
+                    continue
+                record_fault("sync_deadline_exceeded")
+                if guard.policy == "degrade":
+                    plane["degraded"] = True
+                    return [value]
+                if isinstance(err, StateCorruptionError):
+                    raise
+                raise SyncTimeoutError(
+                    f"host-plane gather call {idx} failed after {guard.max_retries} retries"
+                    f" (deadline {guard.deadline_s}s, policy 'raise'): {err}"
+                ) from err
+
+    return guarded
+
+
+def _stamp_degraded_span() -> None:
+    """Mark the innermost open span ``degraded=yes`` (the sync span in
+    ``Metric._sync_dist`` / the collection's host-sync span)."""
+    from metrics_tpu.observability.trace import current_span
+
+    span = current_span()
+    if span is None:
+        return
+    if span.attrs is None:
+        span.attrs = {}
+    span.attrs["degraded"] = "yes"
+
+
 def canonicalize_group(group: Any) -> Optional[tuple]:
     """Validate a ``process_group`` (reference metric.py:66,185 semantics).
 
@@ -659,6 +868,8 @@ def host_gather(
     reductions: Dict[str, ReduceFx],
     gather_fn: Optional[Callable] = None,
     slice_leaders: Optional[HostHierarchy] = None,
+    guard: Optional[SyncGuard] = None,
+    overflow: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Host-plane sync of a state dict, reproducing reference ``_sync_dist``
     semantics (metric.py:179-197): gather every array, stack tensor states /
@@ -678,16 +889,36 @@ def host_gather(
     :class:`HostHierarchy` (and no explicit ``gather_fn``) the packed
     payloads move through :func:`slice_leader_gather` — one copy per slice
     instead of one per process, for states replicated within a slice.
+
+    FAULT TOLERANCE: every gather call runs under the active
+    :class:`SyncGuard` (``guard=`` or the :func:`set_sync_guard` default) —
+    per-call deadlines, bounded retry with exponential backoff, and on
+    exhaustion either a typed ``SyncTimeoutError`` (policy ``raise``) or a
+    LOCAL-ONLY fallback (policy ``degrade``: the enclosing span is stamped
+    ``degraded=yes``, ``degraded_computes`` bumps, and remaining collectives
+    are still issued fire-and-forget so entry order — and therefore the
+    other ranks — is preserved). The trivial default guard takes the
+    unwrapped fast path. A state pytree that is empty (or all-``None``)
+    skips the collective entirely: a zero-payload gather still costs every
+    rank a rendezvous (``gather_skips`` counts the savings).
+
+    ``overflow`` is the PaddedBuffer overflow policy for gathered counts
+    (``error``/``warn_drop``; default: the process-wide
+    ``parallel.buffer.set_overflow_policy`` setting).
     """
     if gather_fn is None and slice_leaders is not None:
         gather_fn = slice_leader_gather(slice_leaders)
     gather_fn = gather_fn or gather_all_arrays
 
-    # pass 1: enumerate every array that must move, in a stable order
+    # pass 1: enumerate every array that must move, in a stable order.
+    # None leaves (un-promoted optional states) carry no payload and pass
+    # through untouched.
     units: List[Array] = []
     slots: Dict[str, Any] = {}  # name -> unit indices, shaped per leaf kind
     for name, value in state.items():
-        if isinstance(value, PaddedBuffer):
+        if value is None:
+            slots[name] = ("none",)
+        elif isinstance(value, PaddedBuffer):
             slots[name] = ("buffer", len(units), len(units) + 1)
             units.extend([value.data, value.count])
         elif isinstance(value, list):
@@ -697,25 +928,42 @@ def host_gather(
             slots[name] = ("array", len(units))
             units.append(value if hasattr(value, "dtype") else jnp.asarray(value))
 
+    if not units:
+        # nothing to move: skip the collective entirely instead of staging a
+        # zero-payload gather every rank must rendezvous for
+        record_gather_skip()
+        return dict(state)
+
+    guard = guard if guard is not None else _SYNC_GUARD
+    hook = _FAULT_HOOK
+    guard_active = hook is not None or guard.deadline_s is not None or guard.check_finite
+    plane = {"calls": 0, "degraded": False, "site": "host_gather", "hook": hook}
+    plane_fn = _guard_gather_fn(gather_fn, guard, plane) if guard_active else gather_fn
+
+    # packability is a property of the ORIGINAL gather fn; the guard wrapper
+    # transports values unchanged, so it inherits the verdict
     if is_packable_gather(gather_fn):
-        gathered_units = _packed_gather_units(units, gather_fn)
+        gathered_units = _packed_gather_units(units, plane_fn)
     else:
-        gathered_units = [gather_fn(u) for u in units]
+        gathered_units = [plane_fn(u) for u in units]
+
+    if plane["degraded"]:
+        record_fault("degraded_computes")
+        _stamp_degraded_span()
 
     # pass 2: per-leaf reduction over the reconstructed per-process arrays
     out: Dict[str, Any] = {}
     for name, value in state.items():
         fx = reductions[name]
         slot = slots[name]
+        if slot[0] == "none":
+            out[name] = None
+            continue
         if slot[0] == "buffer":
             gathered = gathered_units[slot[1]]
             counts = gathered_units[slot[2]]
             for g, c in zip(gathered, counts):
-                if int(c) > g.shape[0]:
-                    raise RuntimeError(
-                        f"PaddedBuffer state '{name}' overflowed on some rank: {int(c)} rows "
-                        f"appended into capacity {g.shape[0]}. Increase the metric's `capacity`."
-                    )
+                handle_overflow(name, int(c), g.shape[0], policy=overflow)
             parts = [g[: int(c)] for g, c in zip(gathered, counts)]
             out[name] = dim_zero_cat(parts) if parts else value.data[:0]
             continue

@@ -1,4 +1,22 @@
-"""Exception types for metrics_tpu."""
+"""Typed exception hierarchy for metrics_tpu.
+
+Every error the library raises deliberately derives from
+:class:`MetricsTPUError`, so callers can catch "anything metrics_tpu decided
+to fail on" with one except clause while still matching specific failure
+classes. Exceptions that replaced ad-hoc ``RuntimeError``/``TimeoutError``
+raises keep those builtins as secondary bases, so pre-existing callers (and
+tests) that matched the builtin keep working.
+"""
+
+__all__ = [
+    "BufferOverflowError",
+    "InjectedFaultError",
+    "MetricsTPUError",
+    "PreemptionError",
+    "StateCorruptionError",
+    "SyncTimeoutError",
+    "TracingUnsupportedError",
+]
 
 
 class MetricsTPUError(Exception):
@@ -7,3 +25,33 @@ class MetricsTPUError(Exception):
 
 class TracingUnsupportedError(MetricsTPUError):
     """Raised when a value-dependent operation is attempted under jit tracing."""
+
+
+class SyncTimeoutError(MetricsTPUError, TimeoutError):
+    """A host-plane sync call exhausted its deadline/retry budget under the
+    ``raise`` policy (see ``parallel.sync.SyncGuard``). The ``degrade``
+    policy falls back to local-only state instead of raising this."""
+
+
+class StateCorruptionError(MetricsTPUError):
+    """A metric state (or a gathered sync payload) failed an integrity scan:
+    non-finite values where none entered, or a saturated integer count."""
+
+
+class BufferOverflowError(MetricsTPUError, RuntimeError):
+    """More rows were appended into a ``PaddedBuffer`` than its capacity holds
+    (the overflowed rows were dropped on device). Raised by the ``error``
+    overflow policy; the ``warn_drop`` policy warns once and keeps the
+    capacity-truncated rows (see ``parallel.buffer.set_overflow_policy``)."""
+
+
+class PreemptionError(MetricsTPUError):
+    """The process is being preempted mid-epoch (SIGTERM analogue; in tests,
+    injected by the chaos harness). Never retried by the sync guard —
+    callers checkpoint and re-raise/exit. Resume via the epoch watermark
+    (``Metric.guarded_update``)."""
+
+
+class InjectedFaultError(MetricsTPUError):
+    """A transient fault injected by ``parallel.faults`` (simulating dropped
+    participation or a failed collective). Retryable by the sync guard."""

@@ -412,9 +412,13 @@ def test_state_dict_merges_group_shards_and_roundtrips():
     # one full copy for the group representative, manifest for the rest
     assert sd["_compute_group_manifest"] == {"Precision": "F1", "Recall": "F1"}
     assert "F1.tp" in sd and "Accuracy.correct" in sd
-    assert not any(k.startswith(("Precision.", "Recall.")) and not k.endswith("_count_bound") for k in sd)
+    _META_SUFFIXES = ("_count_bound", "_epoch_watermark")  # per-member host metadata
+    assert not any(
+        k.startswith(("Precision.", "Recall.")) and not k.endswith(_META_SUFFIXES) for k in sd
+    )
     # per-member host metadata still rides along
     assert int(sd["Recall._count_bound"]) == 32
+    assert int(sd["Recall._epoch_watermark"]) == 1
 
     # orbax/pickle-friendly round trip into a FRESH collection
     restored = pickle.loads(pickle.dumps(sd))
@@ -465,3 +469,90 @@ def test_state_dict_plain_per_member_checkpoint_loads():
     a, b = col.compute(), fresh.compute()
     for k in a:
         np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]), err_msg=k)
+
+
+# ------------------------------------------- checkpoint after preemption
+def _epoch_batches(n=4, rows=32):
+    return [_ckpt_batch(seed=100 + i, rows=rows) for i in range(n)]
+
+
+def test_checkpoint_after_preemption_replay_is_idempotent():
+    """The kill/restore round-trip of a group-merged checkpoint mid-epoch:
+    a collection checkpointed after step 1 is killed during step 2, a fresh
+    collection restores it (watermark honored, group manifest fanned out),
+    and a naive full replay of the epoch applies ONLY the lost steps —
+    the final computes are bit-identical to an uninterrupted epoch."""
+    batches = _epoch_batches()
+
+    reference = _ckpt_collection()
+    reference.persistent(True)
+    for i, (p, t) in enumerate(batches):
+        assert reference.guarded_update(i, p, t)
+    ref = reference.compute()
+
+    victim = _ckpt_collection()
+    victim.persistent(True)
+    victim.guarded_update(0, *batches[0])
+    victim.guarded_update(1, *batches[1])
+    checkpoint = pickle.loads(pickle.dumps(victim.state_dict()))
+    # step 2 lands in memory only — the "kill" below loses it, which is
+    # exactly the state a preempted loop restores from
+    victim.guarded_update(2, *batches[2])
+    del victim
+
+    fresh = _ckpt_collection()
+    fresh.persistent(True)
+    fresh.load_state_dict(checkpoint)
+    # the checkpoint is still group-merged: one full copy per compute group
+    assert checkpoint["_compute_group_manifest"] == {"Precision": "F1", "Recall": "F1"}
+    # watermark honored across the restore: 2 steps are already in
+    assert fresh.epoch_watermark == 2
+    applied = [fresh.guarded_update(i, p, t) for i, (p, t) in enumerate(batches)]
+    assert applied == [False, False, True, True]
+    _assert_same(
+        {k: np.asarray(v) for k, v in ref.items()},
+        {k: np.asarray(v) for k, v in fresh.compute().items()},
+    )
+
+
+def test_replaying_the_last_checkpointed_step_is_a_noop():
+    """The acceptance shape of preemption-safe resume: after restore, the
+    step that was in flight at the kill is replayed — and must change
+    NOTHING (state arrays bit-identical, guarded_update returns False)."""
+    batches = _epoch_batches(2)
+    col = _ckpt_collection()
+    col.persistent(True)
+    col.guarded_update(0, *batches[0])
+    col.guarded_update(1, *batches[1])
+    checkpoint = col.state_dict()
+
+    fresh = _ckpt_collection()
+    fresh.persistent(True)
+    fresh.load_state_dict(checkpoint)
+    before = {k: m._current_state() for k, m in fresh.items()}
+    assert fresh.guarded_update(1, *batches[1]) is False  # the in-flight step
+    after = {k: m._current_state() for k, m in fresh.items()}
+    for name in before:
+        for state_key in before[name]:
+            np.testing.assert_array_equal(
+                np.asarray(before[name][state_key]),
+                np.asarray(after[name][state_key]),
+                err_msg=f"{name}.{state_key}",
+            )
+    assert fresh.epoch_watermark == 2
+
+
+def test_watermark_survives_member_level_roundtrip():
+    """Metric-level checkpoints carry the watermark too (the collection path
+    fans it out per member; the plain path reads it directly)."""
+    m = Accuracy()
+    m.persistent(True)
+    p, t = _ckpt_batch(seed=9)
+    m.guarded_update(0, jnp.argmax(p, axis=-1) == t, (jnp.argmax(p, axis=-1) == t).astype(jnp.int32))
+    sd = m.state_dict()
+    assert int(sd["_epoch_watermark"]) == 1
+    fresh = Accuracy()
+    fresh.persistent(True)
+    fresh.load_state_dict(sd)
+    assert fresh.epoch_watermark == 1
+    assert fresh.guarded_update(0, p, t) is False

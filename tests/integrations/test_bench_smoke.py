@@ -73,6 +73,11 @@ def _assert_headline_schema(out):
     assert out["hier_dcn_bytes"] < out["flat2d_world_bytes"]
     assert out["hier_dcn_bytes"] == out["gather_sync_bytes"]  # S-1 = 1 hop
 
+    # fault counters ride the default line and are ZERO on a clean bench run
+    # (--check-trajectory pins them at zero on every new BENCH_r* round)
+    for key in ("sync_retries", "sync_deadline_exceeded", "degraded_computes", "quarantined_updates"):
+        assert out[key] == 0, key
+
 
 def test_bench_smoke_json_schema():
     out = _run_smoke()
@@ -217,6 +222,31 @@ def test_bench_check_collectives_gate():
         assert row["status"] != "regression"
 
 
+def test_bench_check_faults_gate():
+    """``bench.py --check-faults`` is the fault-tolerance gate: under a
+    seeded stall+drop+corruption schedule on the sync8 collection's host
+    plane, the retry-recovered run must be bit-exact vs the fault-free run,
+    the degrade-policy run must complete within its budget (no hang) with a
+    ``degraded=yes``-stamped sync span and nonzero ``degraded_computes``,
+    and a clean guarded run must report zero fault counters."""
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, _BENCH, "--check-faults"],
+        capture_output=True, text=True, timeout=280, env=env,
+        cwd=os.path.dirname(_BENCH),
+    )
+    assert proc.returncode == 0, f"--check-faults failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["ok"] is True and out["failures"] == []
+    assert all(v == 0 for v in out["clean"]["faults"].values())
+    assert out["recovered"]["faults"]["sync_retries"] >= 3
+    assert out["recovered"]["faults"]["degraded_computes"] == 0
+    assert out["degraded"]["faults"]["degraded_computes"] >= 1
+    assert out["degraded"]["degraded_spans"] >= 1
+    assert out["degraded"]["elapsed_s"] < out["degraded"]["budget_s"]
+
+
 def _run_trajectory(tmp_path, current, rounds):
     rounds_dir = tmp_path / "rounds"
     rounds_dir.mkdir(exist_ok=True)
@@ -279,3 +309,25 @@ def test_bench_check_trajectory_gate_fails_on_injected_regression(tmp_path):
     rc, out = _run_trajectory(tmp_path, improved, {6: _TRAJECTORY_BASE})
     assert rc == 0
     assert out["checks"]["collective_calls"]["status"] == "improved"
+
+
+def test_bench_check_trajectory_pins_fault_counters_at_zero(tmp_path):
+    """Fault counters bind at EXACTLY zero whenever the current line carries
+    them — no prior round needed (zero is the contract, not a baseline) —
+    and a nonzero value fails even if a prior round also recorded one."""
+    clean = dict(_TRAJECTORY_BASE, sync_retries=0, sync_deadline_exceeded=0,
+                 degraded_computes=0, quarantined_updates=0)
+    rc, out = _run_trajectory(tmp_path, clean, {6: _TRAJECTORY_BASE})
+    assert rc == 0, out
+    assert out["checks"]["sync_retries"] == {"current": 0, "baseline": 0, "kind": "fault", "status": "ok"}
+
+    dirty = dict(clean, degraded_computes=2)
+    rc, out = _run_trajectory(tmp_path, dirty, {6: clean})
+    assert rc == 1
+    assert any("degraded_computes" in f for f in out["failures"])
+    assert out["checks"]["degraded_computes"]["status"] == "regression"
+
+    # rounds predating the keys: current lines without them aren't constrained
+    rc, out = _run_trajectory(tmp_path, _TRAJECTORY_BASE, {6: _TRAJECTORY_BASE})
+    assert rc == 0
+    assert out["checks"]["sync_retries"]["status"] == "missing"
