@@ -94,6 +94,11 @@ FEATURES = 256
 
 GATHER_CAPACITY = 2048  # per-device rows of each buffer (cat) state
 HIER_SLICES = 2  # the (4,2) test mesh: 2 virtual "slices" x 4 ici devices
+# sketch A/B grid sizes: the curve sketch (AUROC+AP share ONE compute-group
+# histogram) plus the Spearman rank sketch must together stay well under 10%
+# of the buffer plane's payload — the acceptance gate --check-collectives pins
+SKETCH_CURVE_BINS = 256  # (2, 256) int32 histogram = 2 KB
+SKETCH_RANK_BINS = 16  # (16, 16) int32 joint histogram = 1 KB
 
 
 def _collection_ours(compute_groups: bool = True):
@@ -116,6 +121,22 @@ def _collection_gather():
         AUROC(capacity=GATHER_CAPACITY),
         AveragePrecision(num_classes=1, capacity=GATHER_CAPACITY),
         SpearmanCorrcoef(capacity=GATHER_CAPACITY),
+    ])
+
+
+def _collection_sketch():
+    """The sketch-mode twin of ``_collection_gather``: the same AUROC + AP +
+    Spearman members with ``approx="sketch"`` states instead of
+    capacity-2048 buffers. AUROC and AveragePrecision share one compute
+    group (identical sketch_curve_update plane), so the synced state is ONE
+    (2, 256) histogram plus Spearman's (16, 16) joint — ~3 KB of psum-reduced
+    payload against the buffer plane's ~48 KB of gathered payload."""
+    from metrics_tpu import AUROC, AveragePrecision, MetricCollection, SpearmanCorrcoef
+
+    return MetricCollection([
+        AUROC(approx="sketch", num_bins=SKETCH_CURVE_BINS),
+        AveragePrecision(approx="sketch", num_bins=SKETCH_CURVE_BINS),
+        SpearmanCorrcoef(approx="sketch", num_bins=SKETCH_RANK_BINS),
     ])
 
 
@@ -292,6 +313,71 @@ def _build_hier_gather_runner(hierarchical: bool):
     return run, len(state)
 
 
+def _build_sketch_sync_runner(hierarchical: bool = True):
+    """(timed_run(steps) -> ms/step, states_synced) for the SKETCH sync
+    scenario: the ``_collection_sketch`` states (one compute-group histogram
+    for AUROC+AP, one rank joint for Spearman) synced per step with
+    ``coalesced_sync_state`` on the same (4,2) ici x dcn mesh the
+    hierarchical gather A/B uses. The sketch leaves fold into ONE int32 sum
+    bucket, so the staged program is psum-only — zero all_gathers — and the
+    payload is traffic-independent (~3 KB vs the buffer plane's ~48 KB).
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from metrics_tpu.parallel.placement import MeshHierarchy
+    from metrics_tpu.parallel.sync import coalesced_sync_state
+    from metrics_tpu.utils.compat import shard_map
+
+    col = _collection_sketch()
+    rng = np.random.RandomState(0)
+    rows = GATHER_CAPACITY // 2  # the same per-step traffic as the gather A/B
+    preds = jnp.asarray(rng.rand(rows).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, rows).astype(np.int32))
+    col.update(preds, target)
+
+    # one state entry per compute group (AUROC+AP share their histogram),
+    # exactly what the collection's pure sync plane would move
+    gm = col._group_map()
+    state = {
+        (k, n): v for k, m in col.items() if gm[k] == k for n, v in m._current_state().items()
+    }
+    reductions = {key: col[key[0]]._reductions[key[1]] for key in state}
+    if hierarchical:
+        mesh = Mesh(
+            np.array(jax.devices("cpu")[:N_DEVICES]).reshape(HIER_SLICES, N_DEVICES // HIER_SLICES),
+            ("dcn", "ici"),
+        )
+        axis = MeshHierarchy(ici_axis="ici", dcn_axis="dcn")
+    else:
+        mesh = Mesh(np.array(jax.devices("cpu")[:N_DEVICES]), ("dp",))
+        axis = "dp"
+
+    def step(s, acc):
+        synced = coalesced_sync_state(s, reductions, axis)
+        # carry chains step i+1 on step i (see _build_gather_runner)
+        for leaf in jax.tree_util.tree_leaves(synced):
+            acc = acc + jnp.sum(leaf.astype(jnp.float32))
+        return acc
+
+    sharded_step = jax.jit(
+        shard_map(step, mesh=mesh, in_specs=(P(), P()), out_specs=P(), check_vma=False)
+    )
+
+    def run(steps: int) -> float:
+        acc = jnp.zeros((), jnp.float32)
+        start = time.perf_counter()
+        for _ in range(steps):
+            acc = sharded_step(state, acc)
+        jax.block_until_ready(acc)
+        return (time.perf_counter() - start) / steps * 1e3
+
+    return run, len(state)
+
+
 def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trace_path=None) -> dict:
     """Compute-groups on/off A/B over the same 8-device mesh program.
 
@@ -374,6 +460,17 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
         with (obs.span("bench.timed_gather_flat2d") if obs else _null_cm()):
             flat2d_times.append(run_flat2d(steps))
 
+    # sketch A/B: the sketch-mode twin of the gather collection on the SAME
+    # (4,2) mesh — constant-memory histogram states, psum-only sync; the
+    # headline is sketch_sync_ms vs gather_hier_ms and the ~16x payload drop
+    run_sketch, states_sketch, sketch_counters = build(
+        _build_sketch_sync_runner, True, "sketch_sync"
+    )
+    sketch_times = []
+    for _ in range(repeats):
+        with (obs.span("bench.timed_sketch_sync") if obs else _null_cm()):
+            sketch_times.append(run_sketch(steps))
+
     out = {
         "grouped_sync8_ms": grouped_ms,
         "ungrouped_sync8_ms": ungrouped_ms,
@@ -403,6 +500,17 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
         "hier_ici_bytes": hier_counters["bytes_by_crossing"].get("ici", 0),
         "flat2d_collective_calls": flat2d_counters["collective_calls"],
         "flat2d_world_bytes": flat2d_counters["bytes_by_crossing"].get("world", 0),
+        # the sketch plane: psum-only (zero staged gathers), traffic-
+        # independent payload — the memory/bandwidth headline of record
+        "sketch_sync_ms": min(sketch_times),
+        "sketch_states_synced": states_sketch,
+        "sketch_collective_calls": sketch_counters["collective_calls"],
+        "sketch_sync_bytes": sketch_counters["sync_bytes"],
+        "sketch_dcn_bytes": sketch_counters["bytes_by_crossing"].get("dcn", 0),
+        "sketch_gather_calls": sum(
+            sketch_counters["calls_by_kind"].get(k, 0)
+            for k in ("all_gather", "coalesced_gather", "process_allgather")
+        ),
     }
     # fault counters ride the default line, pinned at ZERO: a clean bench run
     # that retries, degrades, or quarantines anything is a regression
@@ -423,12 +531,14 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
             finally:
                 devtime_mod.disable()
 
-        # v3: the collective-count keys moved to the DEFAULT line (above) and
-        # the hierarchical A/B + per-crossing counters joined the schema
-        out["trace_schema"] = 3
+        # v4: the sketch A/B joined (psum-only sketch plane keys on the
+        # default line, full sketch counters here); v3 moved the collective
+        # counts to the default line and added the hierarchical A/B
+        out["trace_schema"] = 4
         out["counters"] = grouped_counters
         out["gather_counters"] = coal_counters
         out["hier_counters"] = hier_counters
+        out["sketch_counters"] = sketch_counters
         summary = obs.summarize()
         out["phase_ms"] = {
             name: round(row["total_ms"], 3) for name, row in sorted(summary.items())
@@ -742,9 +852,16 @@ _TRACE_KEYS = (
     "hier_ici_bytes",
     "flat2d_collective_calls",
     "flat2d_world_bytes",
+    "sketch_sync_ms",
+    "sketch_states_synced",
+    "sketch_collective_calls",
+    "sketch_sync_bytes",
+    "sketch_dcn_bytes",
+    "sketch_gather_calls",
     "counters",
     "gather_counters",
     "hier_counters",
+    "sketch_counters",
     "phase_ms",
     "phase_compile_ms",
     "device_ms",
@@ -772,12 +889,23 @@ _TRACE_KEYS = (
 #   pack circulating) + 1 coalesced psum; sharded_retrieval (MRR, capacity
 #   1024) stages 4 all_to_alls (idx/preds/target/real regroup) + 3 psums
 #   (overflow count, float total, int count+flag plane).
+# sketch plane (AUROC+AP+Spearman with approx="sketch" on the same (4,2)
+#   mesh): the sketch leaves fold into ONE int32 sum bucket — the staged
+#   program is PSUM-ONLY ("gather_calls" pinned at ZERO: all_gather +
+#   coalesced_gather + process_allgather) with a two-stage hierarchical psum
+#   (1 ici + 1 dcn call) over the 3 KB group-deduped payload (AUROC+AP share
+#   one compute-group histogram). The cross-scenario SKETCH GATE below
+#   additionally requires this payload under 10% of the buffer plane's.
 # hierarchical scenarios additionally pin the per-crossing structure on the
 # (4,2) ici x dcn test mesh (S=2 slices x L=4 devices). Crossing BYTES are
 # ring traffic (payload x (participants - 1), see observability.counters):
 # the flat planes burn W-1 = 7 DCN-crossing hops per payload byte, the
 # two-stage planes S-1 = 1 — the structural win --check-collectives pins.
 EXPECTED_COLLECTIVES = {
+    "sketch_sync": {
+        "collective_calls": 2, "sync_bytes": 6144, "gather_calls": 0,
+        "dcn_calls": 1, "dcn_bytes": 3072, "ici_calls": 1, "ici_bytes": 9216,
+    },
     "sum_grouped": {"collective_calls": 1, "sync_bytes": 520},
     "sum_ungrouped": {"collective_calls": 1, "sync_bytes": 1544},
     "gather_coalesced": {"collective_calls": 2, "sync_bytes": 49176},
@@ -903,12 +1031,16 @@ def check_collectives() -> int:
     cross-scenario HIERARCHY GATE additionally requires the hierarchical
     gather plane's DCN-crossing bytes to stay strictly below the flat
     plane's world-axis bytes (a future change that reflattens a
-    DCN-crossing collective fails here even if its own pins still hold).
+    DCN-crossing collective fails here even if its own pins still hold),
+    and the SKETCH GATE requires the sketch-mode twin of the gather
+    collection to stay PSUM-ONLY (zero staged gathers of any kind) with
+    sync bytes under 10% of the buffer plane's on the same (4,2) mesh.
     Prints one JSON report line either way.
     """
     from metrics_tpu import observability as obs
 
     builders = {
+        "sketch_sync": lambda: _build_sketch_sync_runner(True),
         "sum_grouped": lambda: _build_sync8_runner(True),
         "sum_ungrouped": lambda: _build_sync8_runner(False),
         "gather_coalesced": lambda: _build_gather_runner(True),
@@ -935,6 +1067,11 @@ def check_collectives() -> int:
             "dcn_calls": snap["calls_by_crossing"].get("dcn", 0),
             "dcn_bytes": snap["bytes_by_crossing"].get("dcn", 0),
             "world_bytes": snap["bytes_by_crossing"].get("world", 0),
+            # staged gathers of ANY kind — the psum-only pin of the sketch plane
+            "gather_calls": sum(
+                snap["calls_by_kind"].get(k, 0)
+                for k in ("all_gather", "coalesced_gather", "process_allgather")
+            ),
         }
         expected = EXPECTED_COLLECTIVES[name]
         status = "ok"
@@ -960,11 +1097,36 @@ def check_collectives() -> int:
             f"hierarchy gate: gather_hier dcn bytes {hier_dcn} not strictly below"
             f" gather_flat2d world bytes {flat_world}"
         )
+
+    # the sketch gate of record: the sketch-mode twin of the gather
+    # collection must be psum-only (zero staged gathers) AND move under 10%
+    # of the buffer plane's bytes on the same (4,2) mesh — the acceptance
+    # criterion that makes the O(samples)->O(bins) conversion a gated number
+    sketch_bytes = report["sketch_sync"]["sync_bytes"]
+    buffer_bytes = report["gather_hier"]["sync_bytes"]
+    sketch_gathers = report["sketch_sync"]["gather_calls"]
+    sketch_gate = {
+        "sketch_sync_bytes": sketch_bytes,
+        "buffer_hier_bytes": buffer_bytes,
+        "sketch_gather_calls": sketch_gathers,
+        "ok": sketch_gathers == 0 and sketch_bytes * 10 < buffer_bytes,
+    }
+    if sketch_gathers != 0:
+        failures.append(
+            f"sketch gate: sketch_sync staged {sketch_gathers} gather collectives"
+            " (the sketch plane must be psum-only)"
+        )
+    if sketch_bytes * 10 >= buffer_bytes:
+        failures.append(
+            f"sketch gate: sketch sync bytes {sketch_bytes} not under 10% of the"
+            f" buffer plane's {buffer_bytes} on the same mesh"
+        )
     print(json.dumps({
         "check": "collectives",
         "ok": not failures,
         "failures": failures,
         "hier_gate": hier_gate,
+        "sketch_gate": sketch_gate,
         "scenarios": report,
     }))
     return 1 if failures else 0

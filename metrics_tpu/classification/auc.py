@@ -33,8 +33,11 @@ class AUC(Metric):
         self.add_state("y", default=[], dist_reduce_fx=None)
 
         rank_zero_warn_once(
-            "Metric `AUC` will save all targets and predictions in buffer."
-            " For large datasets this may lead to large memory footprint."
+            "Metric `AUC` stores every (x, y) point in an O(samples) buffer"
+            " state, so memory and sync traffic grow with the dataset. For"
+            " score curves, prefer the constant-memory sketch/binned modes of"
+            " the curve metrics (`AUROC(approx=\"sketch\")`, `BinnedAUROC`),"
+            " which integrate on a fixed grid and sync with one psum."
         )
 
     def update(self, x: Array, y: Array) -> None:

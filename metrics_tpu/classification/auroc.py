@@ -1,11 +1,20 @@
 """AUROC module (reference torchmetrics/classification/auroc.py:25, cat-states :142-143)."""
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Tuple
 
+import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.functional.classification.auroc import _auroc_compute, _auroc_update
 from metrics_tpu.parallel.buffer import as_values
+from metrics_tpu.parallel.sketch import (
+    HistogramSketch,
+    auroc_from_histogram,
+    canonicalize_approx,
+    curve_sketch_group_key,
+    curve_sketch_spec,
+    sketch_curve_update,
+)
 from metrics_tpu.utils.prints import rank_zero_warn, rank_zero_warn_once
 
 
@@ -19,6 +28,17 @@ class AUROC(Metric):
     O(capacity/n) per-device memory, through this same interface. (The
     raw in-``shard_map`` form remains available as
     ``metrics_tpu.parallel.sharded_auroc``.)
+
+    Or drop the O(samples) state entirely: ``approx="sketch"`` replaces the
+    prediction buffers with a constant-memory :class:`~metrics_tpu.parallel.
+    sketch.HistogramSketch` of ``num_bins`` score bins per class over
+    ``sketch_range`` — ``update`` is one jittable scatter-add, ``sync`` is
+    one ``psum`` riding the coalesced sum buckets (zero gathers, bit-exact
+    merge), and ``compute`` derives the AUROC from the sketched ROC with
+    error bounded by the in-bin collision mass
+    (:func:`~metrics_tpu.parallel.sketch.auroc_error_bound`). Multiclass /
+    multilabel sketch mode needs ``num_classes`` at construction;
+    ``max_fpr`` needs the exact mode.
 
     Example (binary):
         >>> import jax.numpy as jnp
@@ -41,6 +61,9 @@ class AUROC(Metric):
         dist_sync_fn: Optional[Callable] = None,
         capacity: Optional[int] = None,
         jit: Optional[bool] = None,
+        approx: Optional[str] = None,
+        num_bins: int = 2048,
+        sketch_range: Tuple[float, float] = (0.0, 1.0),
     ):
         super().__init__(
             compute_on_step=compute_on_step,
@@ -55,6 +78,9 @@ class AUROC(Metric):
         self.pos_label = pos_label
         self.average = average
         self.max_fpr = max_fpr
+        self.approx = canonicalize_approx(approx)
+        self.num_bins = num_bins
+        self.sketch_range = tuple(sketch_range)
 
         allowed_average = (None, "macro", "weighted", "micro")
         if self.average not in allowed_average:
@@ -67,15 +93,36 @@ class AUROC(Metric):
                 raise ValueError(f"`max_fpr` should be a float in range (0, 1], got: {max_fpr}")
 
         self.mode = None
+        if self.approx == "sketch":
+            if self.max_fpr is not None:
+                raise ValueError(
+                    "`max_fpr` (partial AUC) is not supported with approx='sketch';"
+                    " use the exact buffer mode."
+                )
+            self.add_state(
+                "hist",
+                default=curve_sketch_spec(num_bins, num_classes, *self.sketch_range),
+                dist_reduce_fx="sum",
+            )
+            return
         self.add_state("preds", default=[], dist_reduce_fx=None)
         self.add_state("target", default=[], dist_reduce_fx=None)
 
         rank_zero_warn_once(
-            "Metric `AUROC` will save all targets and predictions in buffer."
-            " For large datasets this may lead to large memory footprint."
+            "Metric `AUROC` stores every prediction and target in an O(samples)"
+            " buffer state, so memory and sync traffic grow with the dataset."
+            " Construct with `approx=\"sketch\"` for a constant-memory histogram"
+            " sketch that syncs with one psum, or use the fixed-grid"
+            " `BinnedAUROC`; exact buffers remain the default."
         )
 
     def update(self, preds: Array, target: Array) -> None:
+        if self.approx == "sketch":
+            pos_label = 1 if self.pos_label is None else self.pos_label
+            self.hist = HistogramSketch(
+                sketch_curve_update(self.hist.counts, preds, target, *self.sketch_range, pos_label)
+            )
+            return
         preds, target, mode = _auroc_update(preds, target)
 
         self._append("preds", preds)
@@ -88,7 +135,31 @@ class AUROC(Metric):
             )
         self.mode = mode
 
+    def _group_fingerprint(self) -> Optional[Any]:
+        # sketch-mode curve metrics share ONE update plane (the scatter-add of
+        # sketch_curve_update) across AUROC/ROC/PR-curve/AveragePrecision —
+        # equal sketch config means one compute-group delta serves them all
+        if self.approx == "sketch":
+            return curve_sketch_group_key(self)
+        return super()._group_fingerprint()
+
+    def _sketch_compute(self) -> Array:
+        counts = self.hist.counts
+        if counts.ndim == 2:
+            return auroc_from_histogram(counts)
+        if self.average == "micro":
+            return auroc_from_histogram(jnp.sum(counts, axis=0))
+        per_class = auroc_from_histogram(counts)  # (C,)
+        if self.average == "macro":
+            return jnp.mean(per_class)
+        if self.average == "weighted":
+            support = jnp.sum(counts[:, 0, :], axis=-1).astype(jnp.float32)
+            return jnp.sum(per_class * support / jnp.maximum(jnp.sum(support), 1.0))
+        return per_class
+
     def _states_own_sync(self) -> bool:
+        if self.approx == "sketch":
+            return False  # sketch sync IS the psum plane; nothing to suppress
         from metrics_tpu.parallel.sharded_dispatch import auroc_applicable
 
         return auroc_applicable(self) is not None
@@ -97,6 +168,8 @@ class AUROC(Metric):
         from metrics_tpu.observability.trace import TRACE, span
         from metrics_tpu.parallel.sharded_dispatch import auroc_sharded
 
+        if self.approx == "sketch":
+            return self._sketch_compute()
         sharded = auroc_sharded(self)  # row-sharded epoch states: exact ring
         if sharded is not None:
             return sharded

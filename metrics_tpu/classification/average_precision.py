@@ -1,6 +1,6 @@
 """AveragePrecision module (reference torchmetrics/classification/average_precision.py:27,
 cat-states :93-94)."""
-from typing import Any, Callable, List, Optional, Union
+from typing import Any, Callable, List, Optional, Tuple, Union
 
 from jax import Array
 
@@ -10,6 +10,14 @@ from metrics_tpu.functional.classification.average_precision import (
     _average_precision_update,
 )
 from metrics_tpu.parallel.buffer import as_values
+from metrics_tpu.parallel.sketch import (
+    HistogramSketch,
+    average_precision_from_histogram,
+    canonicalize_approx,
+    curve_sketch_group_key,
+    curve_sketch_spec,
+    sketch_curve_update,
+)
 from metrics_tpu.utils.prints import rank_zero_warn, rank_zero_warn_once
 
 
@@ -39,6 +47,9 @@ class AveragePrecision(Metric):
         dist_sync_fn: Optional[Callable] = None,
         capacity: Optional[int] = None,
         jit: Optional[bool] = None,
+        approx: Optional[str] = None,
+        num_bins: int = 2048,
+        sketch_range: Tuple[float, float] = (0.0, 1.0),
     ):
         super().__init__(
             compute_on_step=compute_on_step,
@@ -51,16 +62,37 @@ class AveragePrecision(Metric):
 
         self.num_classes = num_classes
         self.pos_label = pos_label
+        self.approx = canonicalize_approx(approx)
+        self.num_bins = num_bins
+        self.sketch_range = tuple(sketch_range)
 
+        if self.approx == "sketch":
+            # constant-memory mode: AP from the step integral over the
+            # sketched PR curve, psum-synced HistogramSketch state
+            self.add_state(
+                "hist",
+                default=curve_sketch_spec(num_bins, num_classes, *self.sketch_range),
+                dist_reduce_fx="sum",
+            )
+            return
         self.add_state("preds", default=[], dist_reduce_fx=None)
         self.add_state("target", default=[], dist_reduce_fx=None)
 
         rank_zero_warn_once(
-            "Metric `AveragePrecision` will save all targets and predictions in buffer."
-            " For large datasets this may lead to large memory footprint."
+            "Metric `AveragePrecision` stores every prediction and target in an"
+            " O(samples) buffer state, so memory and sync traffic grow with the"
+            " dataset. Construct with `approx=\"sketch\"` for a constant-memory"
+            " histogram sketch that syncs with one psum, or use"
+            " `BinnedAveragePrecision`; exact buffers remain the default."
         )
 
     def update(self, preds: Array, target: Array) -> None:
+        if self.approx == "sketch":
+            pos_label = 1 if self.pos_label is None else self.pos_label
+            self.hist = HistogramSketch(
+                sketch_curve_update(self.hist.counts, preds, target, *self.sketch_range, pos_label)
+            )
+            return
         preds, target, num_classes, pos_label = _average_precision_update(
             preds, target, self.num_classes, self.pos_label
         )
@@ -69,7 +101,14 @@ class AveragePrecision(Metric):
         self.num_classes = num_classes
         self.pos_label = pos_label
 
+    def _group_fingerprint(self) -> Optional[Any]:
+        if self.approx == "sketch":
+            return curve_sketch_group_key(self)  # shared curve-family update
+        return super()._group_fingerprint()
+
     def _states_own_sync(self) -> bool:
+        if self.approx == "sketch":
+            return False
         from metrics_tpu.parallel.sharded_dispatch import average_precision_applicable
 
         return average_precision_applicable(self) is not None
@@ -77,6 +116,8 @@ class AveragePrecision(Metric):
     def compute(self) -> Union[List[Array], Array]:
         from metrics_tpu.parallel.sharded_dispatch import average_precision_sharded
 
+        if self.approx == "sketch":
+            return average_precision_from_histogram(self.hist.counts)
         sharded = average_precision_sharded(self)  # row-sharded epoch states
         if sharded is not None:
             return sharded

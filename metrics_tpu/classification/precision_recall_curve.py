@@ -2,6 +2,7 @@
 cat-states :104-105)."""
 from typing import Any, Callable, List, Optional, Tuple, Union
 
+import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.core.metric import Metric
@@ -10,6 +11,15 @@ from metrics_tpu.functional.classification.precision_recall_curve import (
     _precision_recall_curve_update,
 )
 from metrics_tpu.parallel.buffer import as_values
+from metrics_tpu.parallel.sketch import (
+    HistogramSketch,
+    canonicalize_approx,
+    curve_sketch_group_key,
+    curve_sketch_spec,
+    precision_recall_from_histogram,
+    sketch_curve_update,
+    sketch_thresholds,
+)
 from metrics_tpu.utils.prints import rank_zero_warn, rank_zero_warn_once
 
 
@@ -52,6 +62,9 @@ class PrecisionRecallCurve(Metric):
         dist_sync_fn: Optional[Callable] = None,
         capacity: Optional[int] = None,
         jit: Optional[bool] = None,
+        approx: Optional[str] = None,
+        num_bins: int = 2048,
+        sketch_range: Tuple[float, float] = (0.0, 1.0),
     ):
         super().__init__(
             compute_on_step=compute_on_step,
@@ -64,16 +77,37 @@ class PrecisionRecallCurve(Metric):
 
         self.num_classes = num_classes
         self.pos_label = pos_label
+        self.approx = canonicalize_approx(approx)
+        self.num_bins = num_bins
+        self.sketch_range = tuple(sketch_range)
 
+        if self.approx == "sketch":
+            # constant-memory mode: the PR curve is evaluated on the num_bins
+            # bin-edge threshold grid from a psum-synced HistogramSketch
+            self.add_state(
+                "hist",
+                default=curve_sketch_spec(num_bins, num_classes, *self.sketch_range),
+                dist_reduce_fx="sum",
+            )
+            return
         self.add_state("preds", default=[], dist_reduce_fx=None)
         self.add_state("target", default=[], dist_reduce_fx=None)
 
         rank_zero_warn_once(
-            "Metric `PrecisionRecallCurve` will save all targets and predictions in buffer."
-            " For large datasets this may lead to large memory footprint."
+            "Metric `PrecisionRecallCurve` stores every prediction and target in"
+            " an O(samples) buffer state, so memory and sync traffic grow with"
+            " the dataset. Construct with `approx=\"sketch\"` for a"
+            " constant-memory fixed-grid curve (one psum to sync), or use"
+            " `BinnedPrecisionRecallCurve`; exact buffers remain the default."
         )
 
     def update(self, preds: Array, target: Array) -> None:
+        if self.approx == "sketch":
+            pos_label = 1 if self.pos_label is None else self.pos_label
+            self.hist = HistogramSketch(
+                sketch_curve_update(self.hist.counts, preds, target, *self.sketch_range, pos_label)
+            )
+            return
         preds, target, num_classes, pos_label = _precision_recall_curve_update(
             preds, target, self.num_classes, self.pos_label
         )
@@ -82,7 +116,14 @@ class PrecisionRecallCurve(Metric):
         self.num_classes = num_classes
         self.pos_label = pos_label
 
+    def _group_fingerprint(self) -> Optional[Any]:
+        if self.approx == "sketch":
+            return curve_sketch_group_key(self)  # shared curve-family update
+        return super()._group_fingerprint()
+
     def _states_own_sync(self) -> bool:
+        if self.approx == "sketch":
+            return False
         from metrics_tpu.parallel.sharded_dispatch import curve_applicable
 
         return curve_applicable(self) is not None
@@ -96,6 +137,9 @@ class PrecisionRecallCurve(Metric):
     ]:
         from metrics_tpu.classification._padded_curves import padded_curve_compute
 
+        if self.approx == "sketch":
+            precision, recall = precision_recall_from_histogram(self.hist.counts)
+            return precision, recall, jnp.asarray(sketch_thresholds(self.num_bins, *self.sketch_range))
         padded = padded_curve_compute(self, "prc")  # capacity-backed: static shapes
         if padded is not None:
             return padded

@@ -40,10 +40,18 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
-from metrics_tpu.observability.counters import record_cache, record_fault, record_states_synced
+from metrics_tpu.observability.counters import (
+    COUNTERS as _COUNTERS,
+    record_cache,
+    record_fault,
+    record_state_bytes,
+    record_states_synced,
+    state_nbytes,
+)
 from metrics_tpu.observability.devtime import DEVTIME as _DEVTIME, fence as _fence
 from metrics_tpu.observability.trace import TRACE, span as _span
 from metrics_tpu.parallel.buffer import PaddedBuffer, buffer_append, buffer_init
+from metrics_tpu.parallel.sketch import SketchSpec, is_sketch, sketch_init
 from metrics_tpu.utils import compat, debug
 from metrics_tpu.utils.data import is_concrete
 from metrics_tpu.utils.exceptions import StateCorruptionError, TracingUnsupportedError
@@ -252,6 +260,8 @@ def _fingerprint_value(v: Any, pins: list) -> Any:
         return ("dict", tuple((k, _fingerprint_value(x, pins)) for k, x in sorted(v.items())))
     if isinstance(v, _BufferSpec):
         return ("bufspec", v.capacity, v.item_shape, str(v.dtype))
+    if isinstance(v, SketchSpec):
+        return ("sketchspec", v.kind, v.shape, str(jnp.dtype(v.dtype)), v.lo, v.hi)
     if callable(v) or isinstance(v, type):
         pins.append(v)  # the cache entry pins this object -> id stays live
         return ("fn", id(v))
@@ -387,7 +397,25 @@ class Metric(ABC):
         callables for PSNR), and list states may declare ``item_shape`` /
         ``item_dtype`` so that, when the metric was built with a ``capacity``,
         they become jit-safe PaddedBuffers.
+
+        ``default`` may also be a :class:`~metrics_tpu.parallel.sketch.
+        SketchSpec` — the MERGEABLE SKETCH state kind (fixed-grid
+        histogram/rank sketches): the state materializes as a zero-count
+        ``HistogramSketch``/``RankSketch``, its shape is traffic-independent,
+        merge is bit-exact integer addition, and sync rides the existing
+        per-dtype sum-psum buckets (``dist_reduce_fx`` must be ``"sum"``).
         """
+        if isinstance(default, SketchSpec):
+            if dist_reduce_fx != "sum":
+                raise ValueError(
+                    f"sketch states are sum-mergeable by construction; declare them with"
+                    f" dist_reduce_fx='sum' (got {dist_reduce_fx!r})"
+                )
+            self._defaults[name] = default
+            self._persistent[name] = persistent
+            self._reductions[name] = "sum"
+            setattr(self, name, sketch_init(default))
+            return
         is_list = isinstance(default, list) and len(default) == 0
         is_arraylike = isinstance(default, (int, float, np.ndarray, jnp.ndarray, Array)) and not isinstance(
             default, bool
@@ -414,6 +442,8 @@ class Metric(ABC):
     def _materialize_default(spec: Any, key: Any = None) -> Any:
         if isinstance(spec, _BufferSpec):
             return buffer_init(spec.capacity, spec.item_shape, spec.dtype)
+        if isinstance(spec, SketchSpec):
+            return sketch_init(spec)
         if isinstance(spec, list):
             return []
         # identical templates share one transferred device constant, and each
@@ -506,6 +536,8 @@ class Metric(ABC):
     def _materialize_default_traced(spec: Any) -> Any:
         if isinstance(spec, _BufferSpec):
             return buffer_init(spec.capacity, spec.item_shape, spec.dtype)
+        if isinstance(spec, SketchSpec):
+            return sketch_init(spec)  # zeros: stage as compile-time constants
         if isinstance(spec, list):
             return []
         return jnp.asarray(spec)  # numpy spec -> host-backed staged constant
@@ -1000,16 +1032,18 @@ class Metric(ABC):
         record_states_synced(len(self._defaults))
         local = self._current_state() if self.check_finite == "quarantine" else None
         if TRACE.enabled:
-            with _span("metric.sync_state", {"metric": type(self).__name__}):
+            with _span("metric.sync_state", {"metric": type(self).__name__}) as sp:
                 synced = host_gather(self._current_state(), self._reductions, gather_fn=gather)
                 if _DEVTIME.enabled:
                     _fence(synced)
                 self._set_state(synced)
                 self._guard_state_integrity("sync", local)
+                self._note_state_bytes(sp)
         else:
             synced = host_gather(self._current_state(), self._reductions, gather_fn=gather)
             self._set_state(synced)
             self._guard_state_integrity("sync", local)
+            self._note_state_bytes()
 
     def _wrap_update(self, update: Callable) -> Callable:
         @functools.wraps(update)
@@ -1018,17 +1052,35 @@ class Metric(ABC):
             self._note_rows(args, kwargs)
             revert_to = self._pre_update_snapshot()
             if TRACE.enabled:
-                with _span("metric.update", {"metric": type(self).__name__}):
+                with _span("metric.update", {"metric": type(self).__name__}) as sp:
                     out = update(*args, **kwargs)
                     if _DEVTIME.enabled:  # phase fence on the written states
                         _fence(self._current_state())
                     self._guard_state_integrity("update", revert_to)
+                    self._note_state_bytes(sp)
                     return out
             out = update(*args, **kwargs)
             self._guard_state_integrity("update", revert_to)
+            self._note_state_bytes()
             return out
 
         return wrapped_func
+
+    def _note_state_bytes(self, span: Any = None) -> None:
+        """Record this metric's current state footprint.
+
+        Feeds the per-metric ``state_bytes`` gauge in every counters snapshot
+        (how the sketch-vs-buffer memory win becomes a measured number, not a
+        claim) and stamps the enclosing update/sync span so
+        ``export.summarize()`` can surface a per-phase ``state_bytes``
+        column. Disabled observability pays one attribute check.
+        """
+        if not _COUNTERS.enabled and span is None:
+            return
+        nbytes = state_nbytes(self._current_state())
+        record_state_bytes(type(self).__name__, nbytes)
+        if span is not None and getattr(span, "attrs", None) is not None:
+            span.attrs["state_bytes"] = nbytes
 
     # -------------------------------------------------- state-integrity guard
     def _pre_update_snapshot(self) -> Optional[State]:
@@ -1270,7 +1322,7 @@ class Metric(ABC):
         for k, v in self.__dict__.items():
             if k in skip:
                 continue
-            if isinstance(v, (jnp.ndarray, Array)) or isinstance(v, PaddedBuffer):
+            if isinstance(v, (jnp.ndarray, Array)) or isinstance(v, PaddedBuffer) or is_sketch(v):
                 if k in self._defaults:
                     # registered states are DONATED by the fused jitted step on
                     # TPU: clone and original must not alias the same buffer,
@@ -1323,6 +1375,10 @@ class Metric(ABC):
                 setattr(self, name, [_cast(v) for v in value])
             elif isinstance(value, PaddedBuffer):
                 setattr(self, name, PaddedBuffer(_cast(value.data), value.count))
+            elif is_sketch(value):
+                # sketch counts are integer by construction; _cast is a no-op
+                # unless a float-count sketch was declared explicitly
+                setattr(self, name, type(value)(_cast(value.counts)))
             else:
                 setattr(self, name, _cast(value))
         return self
@@ -1342,6 +1398,8 @@ class Metric(ABC):
                     destination[prefix + key] = [np.asarray(v) for v in value]
                 elif isinstance(value, PaddedBuffer):
                     destination[prefix + key] = {"data": np.asarray(value.data), "count": np.asarray(value.count)}
+                elif is_sketch(value):
+                    destination[prefix + key] = {"sketch_counts": np.asarray(value.counts)}
                 else:
                     destination[prefix + key] = np.asarray(value)
         # the host-side overflow bound must survive checkpoint/resume, or a
@@ -1359,6 +1417,16 @@ class Metric(ABC):
                 value = state_dict[prefix + key]
                 if isinstance(value, dict) and set(value) == {"data", "count"}:
                     setattr(self, key, PaddedBuffer(jnp.asarray(value["data"]), jnp.asarray(value["count"])))
+                elif isinstance(value, dict) and set(value) == {"sketch_counts"}:
+                    spec = self._defaults[key]
+                    kind = type(getattr(self, key)) if is_sketch(getattr(self, key, None)) else None
+                    if kind is None and isinstance(spec, SketchSpec):
+                        kind = type(sketch_init(spec))
+                    if kind is None:
+                        raise ValueError(
+                            f"checkpoint entry '{key}' holds sketch counts but the state is not a sketch"
+                        )
+                    setattr(self, key, kind(jnp.asarray(value["sketch_counts"])))
                 elif isinstance(value, list):
                     setattr(self, key, [jnp.asarray(v) for v in value])
                 else:

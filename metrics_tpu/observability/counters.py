@@ -46,6 +46,13 @@ What gets counted, and on which plane:
   because the state pytree was empty/all-``None`` (a zero-payload gather is
   a pure liability: one more rendezvous every rank must enter). A health
   counter, not a fault — nonzero on clean runs is fine.
+- **state_bytes**: a per-metric GAUGE of the current state footprint
+  (``{metric class name: bytes}``), refreshed after every eager
+  update/sync while counting is enabled. This is how the sketch-vs-buffer
+  memory story is a measured number: an ``AUROC(capacity=2**20)`` gauge
+  grows with traffic, an ``AUROC(approx="sketch")`` gauge is a constant
+  ``2 * num_bins * 4`` bytes forever. Present in every snapshot;
+  ``export.summarize()`` surfaces the same number as a per-span column.
 
 Counting is off by default; the disabled path is one attribute load and a
 falsy branch per call site. All mutation happens under one lock — counter
@@ -66,9 +73,11 @@ __all__ = [
     "record_collective",
     "record_fault",
     "record_gather_skip",
+    "record_state_bytes",
     "record_states_synced",
     "reset",
     "snapshot",
+    "state_nbytes",
 ]
 
 # collective kinds with a stable schema position in snapshots.
@@ -117,6 +126,7 @@ class CollectiveCounters:
         "launch_cache_misses",
         "faults",
         "gather_skips",
+        "state_bytes",
         "_lock",
     )
 
@@ -139,6 +149,7 @@ class CollectiveCounters:
         self.launch_cache_misses = 0
         self.faults: Dict[str, int] = {k: 0 for k in FAULT_KINDS}
         self.gather_skips = 0
+        self.state_bytes: Dict[str, int] = {}  # metric class name -> latest bytes
 
     # ---------------------------------------------------------- recording
     def record_collective(
@@ -188,6 +199,12 @@ class CollectiveCounters:
         with self._lock:
             self.gather_skips += 1
 
+    def record_state_bytes(self, metric: str, nbytes: int) -> None:
+        """Refresh the per-metric state-footprint gauge (latest value wins —
+        a gauge, not an accumulator: the number IS the current footprint)."""
+        with self._lock:
+            self.state_bytes[metric] = int(nbytes)
+
     # ------------------------------------------------------------ reading
     def snapshot(self) -> Dict[str, Any]:
         """A JSON-ready copy of every counter.
@@ -209,6 +226,7 @@ class CollectiveCounters:
                 "states_synced": self.states_synced,
                 "faults": dict(self.faults),
                 "gather_skips": self.gather_skips,
+                "state_bytes": dict(sorted(self.state_bytes.items())),
                 "group_cache": {"hits": self.group_cache_hits, "misses": self.group_cache_misses},
                 "step_cache": {"hits": self.step_cache_hits, "misses": self.step_cache_misses},
                 "launch_cache": {"hits": self.launch_cache_hits, "misses": self.launch_cache_misses},
@@ -251,6 +269,31 @@ def record_fault(kind: str, n: int = 1) -> None:
 
 def record_gather_skip() -> None:
     COUNTERS.record_gather_skip()
+
+
+def record_state_bytes(metric: str, nbytes: int) -> None:
+    if COUNTERS.enabled:
+        COUNTERS.record_state_bytes(metric, nbytes)
+
+
+def state_nbytes(state: Any) -> int:
+    """Host-side byte footprint of one state pytree (no device work: shapes
+    and dtypes are static metadata).
+
+    Counts every array leaf — plain arrays, PaddedBuffer data+count, sketch
+    counts, eager list elements — as ``size * itemsize``. This is the number
+    behind the per-metric ``state_bytes`` gauge: for buffer-backed curve
+    metrics it is O(capacity); for sketch states it is a constant.
+    """
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(state):
+        size = getattr(leaf, "size", None)
+        itemsize = getattr(getattr(leaf, "dtype", None), "itemsize", None)
+        if size is not None and itemsize is not None:
+            total += int(size) * int(itemsize)
+    return total
 
 
 def enable() -> None:

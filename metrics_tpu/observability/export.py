@@ -28,16 +28,19 @@ __all__ = ["summarize", "to_trace_events", "chrome_trace", "write_chrome_trace",
 
 def summarize(records: Optional[List[SpanRecord]] = None) -> Dict[str, Dict[str, Any]]:
     """Aggregate spans by name: {name: {count, total_ms, mean_ms, min_ms,
-    max_ms, compile_ms, device_ms}}.
+    max_ms, compile_ms, device_ms, state_bytes}}.
 
     ``compile_ms`` sums the XLA compile time stamped by
     :mod:`~metrics_tpu.observability.compilemon`; ``device_ms`` sums the
     fenced device waits stamped by
-    :mod:`~metrics_tpu.observability.devtime`. Both columns are always
-    present (0.0 when the corresponding monitor never ran) so the table
-    schema is stable; the hot path is untouched — the attrs are stamped at
-    span close only while those monitors are enabled, and this aggregation
-    runs post-hoc.
+    :mod:`~metrics_tpu.observability.devtime`; ``state_bytes`` is the
+    LARGEST per-metric state footprint stamped on the span's update/sync
+    records (a gauge, so max — not sum — is the meaningful aggregate; the
+    per-metric breakdown lives in the counters snapshot). All columns are
+    always present (0 when the corresponding monitor never ran) so the
+    table schema is stable; the hot path is untouched — the attrs are
+    stamped at span close only while those monitors are enabled, and this
+    aggregation runs post-hoc.
     """
     if records is None:
         records = _trace.records()
@@ -49,7 +52,7 @@ def summarize(records: Optional[List[SpanRecord]] = None) -> Dict[str, Dict[str,
         if row is None:
             row = table[rec.name] = {
                 "count": 1, "total_ms": ms, "min_ms": ms, "max_ms": ms,
-                "compile_ms": 0.0, "device_ms": 0.0,
+                "compile_ms": 0.0, "device_ms": 0.0, "state_bytes": 0,
             }
         else:
             row["count"] += 1
@@ -58,6 +61,7 @@ def summarize(records: Optional[List[SpanRecord]] = None) -> Dict[str, Dict[str,
             row["max_ms"] = max(row["max_ms"], ms)
         row["compile_ms"] += attrs.get("compile_ms", 0.0)
         row["device_ms"] += attrs.get("device_ms", 0.0)
+        row["state_bytes"] = max(row["state_bytes"], attrs.get("state_bytes", 0))
     for row in table.values():
         row["mean_ms"] = row["total_ms"] / row["count"]
     return table

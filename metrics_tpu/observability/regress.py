@@ -52,6 +52,7 @@ MS_KEYS: Tuple[str, ...] = (
     "gather_per_leaf_ms",
     "gather_hier_ms",
     "gather_flat2d_ms",
+    "sketch_sync_ms",
 )
 
 # staged-collective keys gated exactly (no growth) vs the latest prior round
@@ -74,6 +75,13 @@ COUNT_KEYS: Tuple[str, ...] = (
     "states_synced",
     "states_synced_ungrouped",
     "gather_states_synced",
+    # the sketch plane: psum-only, traffic-independent payload — any growth
+    # in its staged counts/bytes is a regression of the constant-memory story
+    "sketch_collective_calls",
+    "sketch_sync_bytes",
+    "sketch_dcn_bytes",
+    "sketch_gather_calls",
+    "sketch_states_synced",
 )
 
 # fault counters: bound at exactly zero whenever the current line carries

@@ -222,7 +222,9 @@ def auroc_applicable(metric: Any) -> Optional[_CurvePlan]:
     """
     from metrics_tpu.utils.enums import AverageMethod, DataType
 
-    info = _shared_info(metric.preds, metric.target)
+    # sketch-mode metrics have no buffer states at all (getattr: the
+    # predicate must answer "not applicable", not AttributeError)
+    info = _shared_info(getattr(metric, "preds", None), getattr(metric, "target", None))
     if info is None or metric.mode is None:
         return None
     if metric.max_fpr is not None and metric.max_fpr != 1:
@@ -244,7 +246,7 @@ def average_precision_applicable(metric: Any) -> Optional[_CurvePlan]:
     Binary, multiclass one-vs-rest, AND the multilabel layout (per-column
     step integrals against positives == 1) — the reference's full AP surface
     (``functional/classification/average_precision.py``)."""
-    info = _shared_info(metric.preds, metric.target)
+    info = _shared_info(getattr(metric, "preds", None), getattr(metric, "target", None))
     if info is None or metric.num_classes is None:
         return None
     if metric.num_classes == 1:
@@ -447,7 +449,7 @@ def _average(scores: Array, support: Array, average: Any) -> Any:
 def curve_applicable(metric: Any) -> Optional[Tuple[Mesh, str]]:
     """(mesh, axis) when ``ROC`` / ``PrecisionRecallCurve`` compute their
     padded curve VECTORS over row-sharded states, else None."""
-    return _shared_info(metric.preds, metric.target)
+    return _shared_info(getattr(metric, "preds", None), getattr(metric, "target", None))
 
 
 def curve_sharded(metric: Any, kind: str) -> Optional[tuple]:
@@ -516,7 +518,7 @@ def curve_sharded(metric: Any, kind: str) -> Optional[tuple]:
 def rank_corr_applicable(metric: Any) -> Optional[Tuple[Mesh, str]]:
     """(mesh, axis) when a rank-correlation metric (Spearman / Kendall)
     will compute over its row-sharded cat-states, else None."""
-    return _shared_info(metric.preds_all, metric.target_all)
+    return _shared_info(getattr(metric, "preds_all", None), getattr(metric, "target_all", None))
 
 
 def _rank_corr_sharded(metric: Any, kind: str) -> Optional[Array]:

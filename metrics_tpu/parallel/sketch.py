@@ -1,0 +1,469 @@
+"""Mergeable sketch states: constant-memory curve/rank metrics.
+
+The curve/rank metric families (AUROC, ROC, PrecisionRecallCurve,
+AveragePrecision, Spearman, Kendall) are the library's last O(samples)
+states: every prediction lands in a ``PaddedBuffer`` cat-state, so state
+memory and sync traffic grow with traffic — the hierarchical gather
+collection moves ~49 KB of DCN payload per sync where a few-KB sketch would
+do, and at millions-of-users scale an O(samples) state is a non-starter.
+
+This module provides the fix as a first-class *mergeable sketch* state kind
+next to :class:`~metrics_tpu.parallel.buffer.PaddedBuffer`, specialized from
+the streaming-summary literature (Karnin–Lang–Liberty quantile sketches,
+Ben-Haim & Tom-Tov streaming parallel histograms) to FIXED-GRID histograms so
+that every operation stays XLA-native:
+
+- :class:`HistogramSketch` — per-class score histograms conditioned on the
+  target, counts of shape ``(2, B)`` (binary: row 0 positives, row 1
+  negatives) or ``(C, 2, B)``. Thresholded TP/FP/TN/FN at the ``B`` bin-edge
+  thresholds are EXACT for the binned data (a suffix cumsum), so ROC / PR /
+  AUROC / AP derive at ``compute()`` with error bounded by the in-bin
+  collision mass (see :func:`auroc_error_bound`).
+- :class:`RankSketch` — a 2-D joint histogram over per-variable quantile
+  grids. Spearman is the binned-rank (midrank) Pearson correlation over the
+  joint counts — exactly scipy's tie-averaged Spearman for the binned data —
+  and Kendall's tau-b comes from the joint concordance contraction (2-D
+  suffix sums) with tie terms from the marginals.
+
+Why fixed-grid instead of adaptive KLL: ``update`` stays a jittable
+scatter-add (one fused op inside the training step), ``merge`` is elementwise
+integer addition — associative, commutative, and BIT-EXACT, so a ``psum`` of
+per-device sketches equals the single-process sketch — and ``sync`` rides
+the existing per-dtype sum-psum buckets of
+:func:`~metrics_tpu.parallel.sync.coalesced_sync_state` with ZERO new
+collective kinds. State size is traffic-independent: a 2048-bin binary curve
+sketch is 16 KB forever, reduced (not gathered) across the mesh.
+
+The metric modules expose this via ``approx="sketch"`` / ``num_bins=``
+constructor arguments (exact buffers stay the default); see
+``docs/collection_performance.md`` for the state-size table and the error
+bounds of record.
+"""
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+__all__ = [
+    "HistogramSketch",
+    "RankSketch",
+    "SketchSpec",
+    "auroc_error_bound",
+    "auroc_from_histogram",
+    "average_precision_from_histogram",
+    "curve_counts_from_histogram",
+    "curve_sketch_group_key",
+    "curve_sketch_spec",
+    "is_sketch",
+    "kendall_from_joint",
+    "precision_recall_from_histogram",
+    "rank_sketch_group_key",
+    "rank_sketch_spec",
+    "rank_to_bin",
+    "roc_from_histogram",
+    "score_to_bin",
+    "sketch_curve_update",
+    "sketch_init",
+    "sketch_merge",
+    "sketch_nbytes",
+    "sketch_rank_update",
+    "sketch_thresholds",
+    "spearman_from_joint",
+]
+
+
+class HistogramSketch(NamedTuple):
+    """Fixed-grid score histogram conditioned on the target.
+
+    ``counts``: ``(2, B)`` integer bin counts for binary input (row 0 =
+    positives, row 1 = negatives) or ``(C, 2, B)`` per class. A pytree of one
+    integer leaf: jit/scan/donation-safe, ``dist_reduce_fx="sum"`` semantics
+    (merge = elementwise add, sync = one psum, both bit-exact).
+    """
+
+    counts: Array
+
+
+class RankSketch(NamedTuple):
+    """2-D joint histogram over (preds-bin, target-bin) quantile grids.
+
+    ``counts``: ``(B, B)`` integer counts. Same mergeable-sum contract as
+    :class:`HistogramSketch`; Spearman and Kendall derive from it at
+    ``compute()`` (midrank Pearson / tau-b concordance).
+    """
+
+    counts: Array
+
+
+_SKETCH_TYPES = (HistogramSketch, RankSketch)
+_KINDS = {"hist": HistogramSketch, "rank": RankSketch}
+
+
+def is_sketch(value: Any) -> bool:
+    """Whether ``value`` is a sketch state (the kind test the state model,
+    sync planes, and checkpoint paths branch on — the sketch analogue of
+    ``isinstance(v, PaddedBuffer)``)."""
+    return isinstance(value, _SKETCH_TYPES)
+
+
+class SketchSpec(NamedTuple):
+    """Host-side sketch state declaration (what ``Metric.add_state`` records
+    in ``self._defaults``, the sketch analogue of ``_BufferSpec``).
+
+    ``kind``: ``"hist"`` (:class:`HistogramSketch`) or ``"rank"``
+    (:class:`RankSketch`). ``shape``/``dtype``: the counts array.
+    ``lo``/``hi``: the value range of the linear bin grid; ``None``/``None``
+    (rank sketches only) selects the range-free soft-sign squash grid of
+    :func:`rank_to_bin`. The spec is pure config — materialization is
+    :func:`sketch_init` — and it is fingerprintable, so config-identical
+    sketch metrics share compiled steps and compute groups.
+    """
+
+    kind: str
+    shape: Tuple[int, ...]
+    dtype: Any
+    lo: Optional[float]
+    hi: Optional[float]
+
+
+def sketch_init(spec: SketchSpec):
+    """Fresh zero-count sketch for ``spec`` (jit-safe: zeros stage as
+    compile-time constants under tracing)."""
+    return _KINDS[spec.kind](jnp.zeros(spec.shape, dtype=spec.dtype))
+
+
+def sketch_merge(a, b):
+    """Pairwise sketch merge: elementwise integer addition — associative,
+    commutative, bit-exact (the property the psum-mergeability gate pins)."""
+    if type(a) is not type(b):
+        raise TypeError(f"cannot merge sketch kinds {type(a).__name__} and {type(b).__name__}")
+    return type(a)(a.counts + b.counts)
+
+
+def sketch_nbytes(value) -> int:
+    """State bytes of one sketch (traffic-independent by construction)."""
+    return int(value.counts.size) * int(jnp.dtype(value.counts.dtype).itemsize)
+
+
+def _accum_dtype():
+    from metrics_tpu.utils.data import accum_int_dtype
+
+    return accum_int_dtype()
+
+
+# ------------------------------------------------------------------- binning
+def score_to_bin(x: Array, num_bins: int, lo: float, hi: float) -> Array:
+    """Linear bin index of ``x`` on the ``[lo, hi)`` grid, clipped into the
+    end bins (out-of-range scores merge into bin 0 / bin B-1 — part of the
+    documented approximation, not an error)."""
+    scaled = (x - lo) * (num_bins / (hi - lo))
+    return jnp.clip(jnp.floor(scaled), 0, num_bins - 1).astype(jnp.int32)
+
+
+def rank_to_bin(x: Array, num_bins: int, lo: Optional[float], hi: Optional[float]) -> Array:
+    """Bin index for rank sketches.
+
+    With an explicit ``(lo, hi)`` this is the linear grid. With
+    ``lo is None`` the value is first squashed through the strictly
+    increasing soft-sign map ``s(x) = 1/2 + x / (2 (1 + |x|))`` into
+    ``(0, 1)`` and binned there — a fixed quantile-style grid that needs no
+    range configuration. Rank statistics are invariant under any strictly
+    increasing transform, and exact ties stay exact ties through it, so the
+    squash changes only which values COLLIDE in a bin, never their order.
+    """
+    if lo is None:
+        s = 0.5 + 0.5 * x / (1.0 + jnp.abs(x))
+        return score_to_bin(s, num_bins, 0.0, 1.0)
+    return score_to_bin(x, num_bins, lo, hi)
+
+
+def sketch_thresholds(num_bins: int, lo: float, hi: float) -> np.ndarray:
+    """The ``B`` bin lower edges — the threshold grid curve sketches report.
+
+    Host-side numpy on purpose (threshold grids are metric config; under jit
+    they stage as constants), matching
+    ``functional.classification.binned_curves.default_thresholds``.
+    """
+    return (lo + np.arange(num_bins, dtype=np.float64) * ((hi - lo) / num_bins)).astype(np.float32)
+
+
+# ------------------------------------------------------------------- updates
+def sketch_curve_update(
+    counts: Array,
+    preds: Array,
+    target: Array,
+    lo: float,
+    hi: float,
+    pos_label: int,
+) -> Array:
+    """Scatter one batch into per-class positive/negative score histograms.
+
+    The SHARED update plane of every curve metric's sketch mode — AUROC,
+    ROC, PrecisionRecallCurve and AveragePrecision instances with equal
+    sketch config all run exactly this function, which is what lets a
+    ``MetricCollection`` fuse them into ONE compute group (one scatter-add
+    update, one synced state for the whole curve family).
+
+    Layouts (shapes are static, so the branch resolves at trace time):
+
+    - binary: ``preds (N,)``, ``target (N,)`` — ``counts (2, B)``; positives
+      are ``target == pos_label``.
+    - multiclass: ``preds (N, C)``, ``target (N,)`` int labels — ``counts
+      (C, 2, B)``, one-vs-rest per class.
+    - multilabel: ``preds (N, C)``, ``target (N, C)`` — ``counts (C, 2, B)``,
+      positives are ``target == pos_label`` per column.
+
+    Pure and jittable: one clip-floor binning plus one scatter-add, no
+    data-dependent shapes, no host sync.
+    """
+    num_bins = counts.shape[-1]
+    if preds.ndim == 1:
+        if counts.ndim != 2:
+            raise ValueError(
+                f"sketch expects per-class input (N, {counts.shape[0]}); got 1-D predictions."
+                " Construct the metric without num_classes for binary sketch mode."
+            )
+        b = score_to_bin(preds, num_bins, lo, hi)
+        row = jnp.where(target == pos_label, 0, 1)
+        return counts.at[row, b].add(1)
+    if preds.ndim != 2 or counts.ndim != 3 or preds.shape[1] != counts.shape[0]:
+        raise ValueError(
+            f"sketch/state layout mismatch: preds {preds.shape} vs counts {counts.shape}."
+            " Multiclass/multilabel sketch mode needs num_classes at construction."
+        )
+    num_classes = preds.shape[1]
+    b = score_to_bin(preds, num_bins, lo, hi)  # (N, C)
+    if target.ndim == 1:
+        pos = target[:, None] == jnp.arange(num_classes)[None, :]
+    else:
+        pos = target == pos_label
+    cls = jnp.broadcast_to(jnp.arange(num_classes)[None, :], b.shape)
+    row = jnp.where(pos, 0, 1)
+    return counts.at[cls, row, b].add(1)
+
+
+def sketch_rank_update(
+    counts: Array,
+    preds: Array,
+    target: Array,
+    lo: Optional[float],
+    hi: Optional[float],
+) -> Array:
+    """Scatter one batch of (preds, target) pairs into the 2-D joint
+    histogram — the shared update plane of Spearman's and Kendall's sketch
+    mode (equal-config instances form one compute group). Jittable."""
+    bi = rank_to_bin(preds, counts.shape[0], lo, hi)
+    bj = rank_to_bin(target, counts.shape[1], lo, hi)
+    return counts.at[bi, bj].add(1)
+
+
+# ---------------------------------------------------------------- curve math
+def curve_counts_from_histogram(counts: Array) -> Tuple[Array, Array, Array, Array]:
+    """Thresholded ``(tp, fp, tn, fn)`` float32 counts at the ``B`` bin-edge
+    thresholds, from ``(..., 2, B)`` histogram counts.
+
+    ``score >= thr[t]`` is EXACTLY ``bin(score) >= t`` for in-range scores
+    (the grid's defining property), so these counts are exact for the binned
+    data — the suffix cumsum is the whole derivation. Shapes: ``(..., B)``.
+    """
+    h = counts.astype(jnp.float32)
+    pos = h[..., 0, :]
+    neg = h[..., 1, :]
+    # suffix (reverse) cumulative sums: samples at or above each bin edge
+    tp = jnp.flip(jnp.cumsum(jnp.flip(pos, -1), -1), -1)
+    fp = jnp.flip(jnp.cumsum(jnp.flip(neg, -1), -1), -1)
+    fn = jnp.sum(pos, -1, keepdims=True) - tp
+    tn = jnp.sum(neg, -1, keepdims=True) - fp
+    return tp, fp, tn, fn
+
+
+def roc_from_histogram(counts: Array) -> Tuple[Array, Array]:
+    """(fpr, tpr) on the ascending bin-edge threshold grid (binned-curve
+    conventions, matching ``classification.binned.BinnedROC``)."""
+    tp, fp, tn, fn = curve_counts_from_histogram(counts)
+    tpr = tp / jnp.maximum(tp + fn, 1.0)
+    fpr = fp / jnp.maximum(fp + tn, 1.0)
+    return fpr, tpr
+
+
+def auroc_from_histogram(counts: Array) -> Array:
+    """AUROC via the trapezoidal rule over the sketched ROC.
+
+    The grid points lie exactly ON the empirical ROC curve (the thresholded
+    counts are exact for binned data), so the only error is the within-bin
+    interpolation — see :func:`auroc_error_bound` for the certificate.
+    """
+    fpr, tpr = roc_from_histogram(counts)
+    return -jnp.trapezoid(tpr, fpr, axis=-1)
+
+
+def auroc_error_bound(counts: Array) -> Array:
+    """Data-dependent certificate: ``|sketch AUROC - exact AUROC| <= bound``.
+
+    The exact AUROC is ``P(s+ > s-) + P(s+ = s-) / 2`` over positive/negative
+    score pairs. The sketch resolves every cross pair whose scores fall in
+    DIFFERENT bins exactly, and the trapezoid assigns exactly half credit to
+    each same-bin cross pair — so the error is at most half the in-bin
+    collision mass::
+
+        bound = sum_b pos_b * neg_b / (2 * P * N)
+
+    Computable from the sketch itself (this function), shrinking as the grid
+    refines or the score distribution spreads; ties that share a bin with no
+    other value contribute ZERO error (half credit is the exact tie value).
+    """
+    h = counts.astype(jnp.float32)
+    pos = h[..., 0, :]
+    neg = h[..., 1, :]
+    p_total = jnp.maximum(jnp.sum(pos, -1), 1.0)
+    n_total = jnp.maximum(jnp.sum(neg, -1), 1.0)
+    return jnp.sum(pos * neg, -1) / (2.0 * p_total * n_total)
+
+
+def precision_recall_from_histogram(counts: Array) -> Tuple[Array, Array]:
+    """(precision, recall) on the ascending bin-edge threshold grid
+    (``BinnedPrecisionRecallCurve`` conventions: 0 where undefined)."""
+    tp, fp, tn, fn = curve_counts_from_histogram(counts)
+    denom_p = tp + fp
+    denom_r = tp + fn
+    precision = jnp.where(denom_p == 0, 0.0, tp / jnp.where(denom_p == 0, 1.0, denom_p))
+    recall = jnp.where(denom_r == 0, 0.0, tp / jnp.where(denom_r == 0, 1.0, denom_r))
+    return precision, recall
+
+
+def average_precision_from_histogram(counts: Array) -> Array:
+    """Average precision as the step integral over the sketched PR curve
+    (descending recall, ``BinnedAveragePrecision`` conventions)."""
+    precision, recall = precision_recall_from_histogram(counts)
+    return -jnp.sum((recall[..., 1:] - recall[..., :-1]) * precision[..., :-1], axis=-1)
+
+
+# ----------------------------------------------------------------- rank math
+def _midranks(marginal: Array) -> Array:
+    """1-based average (mid) ranks of each bin's occupants from a marginal
+    histogram: a bin of ``m`` tied values occupying ranks ``c+1 .. c+m`` gets
+    rank ``c + (m + 1) / 2`` — scipy's tie-averaged ranking, per bin."""
+    cum = jnp.cumsum(marginal)
+    return cum - marginal / 2.0 + 0.5
+
+
+def spearman_from_joint(counts: Array) -> Array:
+    """Spearman rank correlation from the 2-D joint histogram.
+
+    Binned-rank correlation: each variable's bins get tie-averaged midranks
+    from its marginal, and the statistic is the ``counts``-weighted Pearson
+    correlation of those ranks — EXACTLY scipy's tie-averaged Spearman for
+    the binned data (data whose distinct values map 1:1 onto bins loses
+    nothing; otherwise the error is the in-bin collision mass). ``nan`` on
+    degenerate input (constant ranks, empty sketch) — the scipy convention
+    the exact kernel also follows.
+    """
+    h = counts.astype(jnp.float32)
+    n = jnp.sum(h)
+    p = jnp.sum(h, axis=1)
+    t = jnp.sum(h, axis=0)
+    r = _midranks(p) - (n + 1.0) / 2.0  # centered: mean rank is (N+1)/2
+    s = _midranks(t) - (n + 1.0) / 2.0
+    cov = jnp.sum(h * r[:, None] * s[None, :])
+    var_x = jnp.sum(p * r * r)
+    var_y = jnp.sum(t * s * s)
+    denom = jnp.sqrt(jnp.maximum(var_x, 0.0) * jnp.maximum(var_y, 0.0))
+    return jnp.where(denom == 0, jnp.nan, cov / jnp.where(denom == 0, 1.0, denom))
+
+
+def kendall_from_joint(counts: Array) -> Array:
+    """Kendall's tau-b from the 2-D joint histogram.
+
+    Concordant/discordant pair totals come from 2-D suffix contractions over
+    the joint counts (pairs in distinct bins resolve exactly; same-bin pairs
+    are ties by construction), tie corrections from the marginals — exactly
+    ``scipy.stats.kendalltau`` (tau-b) for the binned data. ``nan`` on
+    degenerate input, matching the exact kernel.
+    """
+    h = counts.astype(jnp.float32)
+    n = jnp.sum(h)
+    # inclusive 2-D suffix sums, then shift by one for the strict quadrant
+    suf = jnp.flip(jnp.cumsum(jnp.cumsum(jnp.flip(h, (0, 1)), axis=0), axis=1), (0, 1))
+    s_gt = jnp.zeros_like(h).at[:-1, :-1].set(suf[1:, 1:])  # i' > i and j' > j
+    # discordant quadrant: i' > i, j' < j (exclusive suffix over rows, then
+    # exclusive prefix over columns)
+    row_suf = jnp.zeros_like(h).at[:-1, :].set(
+        jnp.flip(jnp.cumsum(jnp.flip(h, 0), axis=0), 0)[1:, :]
+    )
+    s_lt = jnp.zeros_like(h).at[:, 1:].set(jnp.cumsum(row_suf, axis=1)[:, :-1])
+    concordant = jnp.sum(h * s_gt)
+    discordant = jnp.sum(h * s_lt)
+    p = jnp.sum(h, axis=1)
+    t = jnp.sum(h, axis=0)
+    n0 = n * (n - 1.0) / 2.0
+    n1 = jnp.sum(p * (p - 1.0)) / 2.0
+    n2 = jnp.sum(t * (t - 1.0)) / 2.0
+    denom = jnp.sqrt(jnp.maximum(n0 - n1, 0.0) * jnp.maximum(n0 - n2, 0.0))
+    return jnp.where(denom > 0, (concordant - discordant) / jnp.where(denom > 0, denom, 1.0), jnp.nan)
+
+
+# ----------------------------------------------------- metric-side plumbing
+def curve_sketch_spec(
+    num_bins: int,
+    num_classes: Optional[int],
+    lo: float,
+    hi: float,
+    dtype: Any = None,
+) -> SketchSpec:
+    """The :class:`SketchSpec` a curve metric registers for ``approx="sketch"``."""
+    if not isinstance(num_bins, int) or num_bins < 2:
+        raise ValueError(f"`num_bins` must be an int >= 2, got {num_bins!r}")
+    if not (hi > lo):
+        raise ValueError(f"sketch range must satisfy lo < hi, got ({lo}, {hi})")
+    shape = (2, num_bins) if num_classes in (None, 1) else (num_classes, 2, num_bins)
+    return SketchSpec("hist", shape, dtype or _accum_dtype(), float(lo), float(hi))
+
+
+def rank_sketch_spec(
+    num_bins: int,
+    lo: Optional[float],
+    hi: Optional[float],
+    dtype: Any = None,
+) -> SketchSpec:
+    """The :class:`SketchSpec` a rank metric registers for ``approx="sketch"``
+    (``lo=None`` selects the range-free soft-sign grid)."""
+    if not isinstance(num_bins, int) or num_bins < 2:
+        raise ValueError(f"`num_bins` must be an int >= 2, got {num_bins!r}")
+    if (lo is None) != (hi is None):
+        raise ValueError("sketch_range must be None or a (lo, hi) pair")
+    if lo is not None and not (hi > lo):
+        raise ValueError(f"sketch range must satisfy lo < hi, got ({lo}, {hi})")
+    return SketchSpec(
+        "rank", (num_bins, num_bins), dtype or _accum_dtype(),
+        None if lo is None else float(lo), None if hi is None else float(hi),
+    )
+
+
+def canonicalize_approx(approx: Optional[str]) -> Optional[str]:
+    """Validate an ``approx=`` constructor argument (None = exact buffers)."""
+    if approx not in (None, "sketch"):
+        raise ValueError(f"`approx` must be None or 'sketch', got {approx!r}")
+    return approx
+
+
+def curve_sketch_group_key(metric: Any) -> tuple:
+    """Compute-group fingerprint of a curve metric's sketch update plane.
+
+    Any two curve-family instances (across AUROC / ROC /
+    PrecisionRecallCurve / AveragePrecision) with equal keys run the
+    IDENTICAL :func:`sketch_curve_update` over the identical ``hist`` state
+    schema, so inside a ``MetricCollection`` one scatter-add delta serves
+    them all; each member keeps its own ``compute``.
+    """
+    spec = metric._defaults["hist"]
+    pos_label = metric.pos_label if getattr(metric, "pos_label", None) is not None else 1
+    return ("sketch_curve", spec.shape, str(jnp.dtype(spec.dtype)), spec.lo, spec.hi, int(pos_label))
+
+
+def rank_sketch_group_key(metric: Any) -> tuple:
+    """Compute-group fingerprint of a rank metric's sketch update plane
+    (shared across Spearman / Kendall instances with equal config)."""
+    spec = metric._defaults["joint"]
+    return ("sketch_rank", spec.shape, str(jnp.dtype(spec.dtype)), spec.lo, spec.hi)

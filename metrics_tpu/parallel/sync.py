@@ -50,6 +50,7 @@ from metrics_tpu.observability.counters import (
 from metrics_tpu.observability.jaxprof import annotate
 from metrics_tpu.parallel.buffer import PaddedBuffer, buffer_all_gather, handle_overflow
 from metrics_tpu.parallel.placement import HostHierarchy, MeshHierarchy
+from metrics_tpu.parallel.sketch import is_sketch, sketch_merge
 from metrics_tpu.utils.data import dim_zero_cat, dim_zero_max, dim_zero_mean, dim_zero_min, dim_zero_sum
 from metrics_tpu.utils.exceptions import InjectedFaultError, StateCorruptionError, SyncTimeoutError
 
@@ -122,6 +123,9 @@ def merge_values(fx: ReduceFx, acc: Any, delta: Any) -> Any:
         from metrics_tpu.parallel.buffer import buffer_merge
 
         return buffer_merge(acc, delta)
+    if is_sketch(acc):
+        # elementwise integer addition: associative, commutative, bit-exact
+        return sketch_merge(acc, delta)
     if isinstance(acc, list):
         if isinstance(delta, PaddedBuffer):
             # the delta update lazily promoted this cat state to a buffer
@@ -149,6 +153,9 @@ def merge_values_stacked(fx: ReduceFx, acc: Any, stacked: Any) -> Any:
     ONE reduction op (the batched-forward plane: per-step deltas come from a
     ``vmap``-ed update, and the whole stack folds at once — no serial scan,
     which pays per-iteration overhead on remote-attached devices)."""
+    if is_sketch(acc):
+        # stacked sketch deltas: counts carry a leading (steps,) axis
+        return type(acc)(acc.counts + jnp.sum(stacked.counts, axis=0))
     if fx == "sum":
         return acc + jnp.sum(stacked, axis=0)
     if fx == "min":
@@ -162,14 +169,22 @@ def merge_values_stacked(fx: ReduceFx, acc: Any, stacked: Any) -> Any:
 
 def is_stack_mergeable(fx: ReduceFx, default: Any) -> bool:
     """Whether a state supports the one-op stacked merge (no lists/buffers)."""
+    from metrics_tpu.parallel.sketch import SketchSpec
+
     if isinstance(default, (list, PaddedBuffer)):
         return False
+    if is_sketch(default) or isinstance(default, SketchSpec):
+        return True  # one stacked-sum fold of the counts
     return fx in ("sum", "min", "max") or is_associative(fx)
 
 
 def is_mergeable(fx: ReduceFx, default: Any) -> bool:
     """Whether a state with this reduction supports pairwise merge (fused forward)."""
+    from metrics_tpu.parallel.sketch import SketchSpec
+
     if isinstance(default, (list, PaddedBuffer)) or fx == "cat":
+        return True
+    if is_sketch(default) or isinstance(default, SketchSpec):
         return True
     return fx in ("sum", "min", "max") or is_associative(fx)
 
@@ -274,6 +289,10 @@ def sync_value(
         )
     if hierarchy is not None:
         return _sync_value_hier(fx, value, hierarchy)
+    if is_sketch(value):
+        # the sketch contract: one psum of the counts, bit-exact merge
+        _rec("psum", value.counts, axis_name, crossing)
+        return type(value)(jax.lax.psum(value.counts, axis_name))
     if isinstance(value, PaddedBuffer):
         _rec("all_gather", value.data, axis_name, crossing)
         _rec("all_gather", value.count, axis_name, crossing)
@@ -301,6 +320,9 @@ def sync_value(
 
 def _sync_value_hier(fx: ReduceFx, value: Any, h: MeshHierarchy) -> Any:
     """The two-stage per-leaf plane (multi-slice hierarchy already proven)."""
+    if is_sketch(value):
+        # integer psum is exactly associative: ici-first staging is bit-exact
+        return type(value)(_hier_reduce("psum", jax.lax.psum, value.counts, h))
     if isinstance(value, PaddedBuffer):
         return _hier_buffer_all_gather(value, h)
     if fx == "sum":
@@ -361,6 +383,12 @@ def coalesced_sync_state(
       (keep / dim-zero cat / callable) runs. Gather is concatenation per
       leaf, so slicing the shared payload is semantics-preserving for every
       reduction, callables included.
+    - **Sketch leaves** (:class:`~metrics_tpu.parallel.sketch.
+      HistogramSketch` / ``RankSketch``) FOLD INTO the ``sum`` reduce bucket
+      of their counts dtype — zero new collective kinds: a sketch-state
+      collection syncs with the same single bucketed ``psum`` a StatScores
+      collection uses, and integer addition is exactly associative, so the
+      bucketed (and hierarchical ici-first) staging is bit-exact.
     - **Buffer plane** (:class:`PaddedBuffer` cat-states): same-dtype
       buffers ravel their ``(capacity, *item)`` rows into one concatenated
       payload gathered with ONE ``all_gather`` — and for 4-byte bucket
@@ -428,6 +456,10 @@ def coalesced_sync_state(
             fx = reductions[name]
             if isinstance(value, PaddedBuffer):
                 buffer_buckets.setdefault(str(value.data.dtype), []).append(name)
+            elif is_sketch(value):
+                # sketch counts ride the sum bucket of their dtype: zero new
+                # collective kinds, one shared psum with every other sum leaf
+                buckets.setdefault(("sum", str(value.counts.dtype)), []).append(name)
             elif isinstance(value, list):
                 out[name] = sync_value(fx, value, axis_name, hierarchy, _crossing=crossing)  # raises: not jit-safe
             elif fx in ("sum", "min", "max"):
@@ -441,20 +473,24 @@ def coalesced_sync_state(
 
         ops = {"sum": jax.lax.psum, "min": jax.lax.pmin, "max": jax.lax.pmax}
         kinds = {"sum": "psum", "min": "pmin", "max": "pmax"}
+        def _payload(v):
+            return v.counts if is_sketch(v) else v
+
         for (op, _dtype), names in buckets.items():
             if len(names) == 1:
                 out[names[0]] = sync_value(reductions[names[0]], state[names[0]], axis_name, hierarchy, _crossing=crossing)
                 continue
-            flat = jnp.concatenate([jnp.ravel(state[n]) for n in names])
+            flat = jnp.concatenate([jnp.ravel(_payload(state[n])) for n in names])
             synced = creduce(kinds[op], ops[op], flat)
             offset = 0
             for n in names:
                 value = state[n]
-                piece = synced[offset: offset + value.size].reshape(value.shape)
+                arr = _payload(value)
+                piece = synced[offset: offset + arr.size].reshape(arr.shape)
                 if reductions[n] == "mean":
                     piece = piece / world_size()
-                out[n] = piece
-                offset += value.size
+                out[n] = type(value)(piece) if is_sketch(value) else piece
+                offset += arr.size
 
         for _dtype, names in gather_buckets.items():
             if len(names) == 1:
@@ -918,6 +954,11 @@ def host_gather(
     for name, value in state.items():
         if value is None:
             slots[name] = ("none",)
+        elif is_sketch(value):
+            # one counts payload; the post-gather reduction is a sum of the
+            # per-process counts (the host-plane analogue of the psum)
+            slots[name] = ("sketch", len(units))
+            units.append(value.counts)
         elif isinstance(value, PaddedBuffer):
             slots[name] = ("buffer", len(units), len(units) + 1)
             units.extend([value.data, value.count])
@@ -958,6 +999,10 @@ def host_gather(
         slot = slots[name]
         if slot[0] == "none":
             out[name] = None
+            continue
+        if slot[0] == "sketch":
+            gathered = gathered_units[slot[1]]
+            out[name] = type(value)(jnp.sum(jnp.stack(gathered), axis=0))
             continue
         if slot[0] == "buffer":
             gathered = gathered_units[slot[1]]

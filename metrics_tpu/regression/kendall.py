@@ -11,7 +11,7 @@ same contraction ring-attention style (``sharded_epoch.py::sharded_kendall``)
 with the quadratic cost split evenly across devices and O(capacity / n)
 per-device memory.
 """
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -20,7 +20,16 @@ from jax import Array
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.functional.regression.kendall import _kendall_kernel, _warn_if_quadratic
 from metrics_tpu.parallel.buffer import as_values
+from metrics_tpu.parallel.sketch import (
+    RankSketch,
+    canonicalize_approx,
+    kendall_from_joint,
+    rank_sketch_group_key,
+    rank_sketch_spec,
+    sketch_rank_update,
+)
 from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.prints import rank_zero_warn_once
 
 _kendall_jitted = jax.jit(_kendall_kernel)
 
@@ -32,6 +41,14 @@ class KendallRankCorrCoef(Metric):
     so pair it with ``capacity`` and keep the accumulated epoch below ~100k
     samples (the functional kernel warns beyond that); 1M rows would be
     ~10^12 pairwise ops.
+
+    ``approx="sketch"`` sidesteps both the O(samples) state AND the O(N^2)
+    pairwise contraction: tau-b derives from a ``num_bins × num_bins``
+    :class:`~metrics_tpu.parallel.sketch.RankSketch` joint histogram
+    (concordance via 2-D suffix sums — O(num_bins^2), traffic-independent;
+    same-bin pairs count as ties), the same sketch — and therefore the same
+    compute group — as sketch-mode :class:`~metrics_tpu.regression.spearman.
+    SpearmanCorrcoef`.
 
     Example:
         >>> import jax.numpy as jnp
@@ -49,6 +66,9 @@ class KendallRankCorrCoef(Metric):
         process_group: Optional[Any] = None,
         dist_sync_fn: Optional[Callable] = None,
         capacity: Optional[int] = None,
+        approx: Optional[str] = None,
+        num_bins: int = 512,
+        sketch_range: Optional[Tuple[float, float]] = None,
     ):
         super().__init__(
             compute_on_step=compute_on_step,
@@ -57,17 +77,48 @@ class KendallRankCorrCoef(Metric):
             dist_sync_fn=dist_sync_fn,
             capacity=capacity,
         )
+        self.approx = canonicalize_approx(approx)
+        self.num_bins = num_bins
+        self.sketch_range = None if sketch_range is None else tuple(sketch_range)
+        if self.sketch_range is not None and len(self.sketch_range) != 2:
+            raise ValueError(f"`sketch_range` must be None or a (lo, hi) pair, got {sketch_range!r}")
+        if self.approx == "sketch":
+            lo, hi = self.sketch_range if self.sketch_range is not None else (None, None)
+            self.add_state("joint", default=rank_sketch_spec(num_bins, lo, hi), dist_reduce_fx="sum")
+            return
         self.add_state("preds_all", default=[], dist_reduce_fx=None, item_shape=())
         self.add_state("target_all", default=[], dist_reduce_fx=None, item_shape=())
+        rank_zero_warn_once(
+            "Metric `KendallRankCorrCoef` stores every prediction and target in"
+            " an O(samples) buffer state and computes an O(N^2) pairwise"
+            " contraction at epoch end. Construct with `approx=\"sketch\"` for"
+            " a constant-memory joint-histogram rank sketch (psum-synced,"
+            " O(num_bins^2) compute); exact buffers remain the default."
+        )
 
     def update(self, preds: Array, target: Array) -> None:
         _check_same_shape(preds, target)
         if preds.ndim != 1:
             raise ValueError("Expected both `preds` and `target` to be 1D arrays of scalar scores")
+        if self.approx == "sketch":
+            lo, hi = self.sketch_range if self.sketch_range is not None else (None, None)
+            self.joint = RankSketch(
+                sketch_rank_update(self.joint.counts, jnp.asarray(preds), jnp.asarray(target), lo, hi)
+            )
+            return
         self._append("preds_all", jnp.asarray(preds, dtype=jnp.float32))
         self._append("target_all", jnp.asarray(target, dtype=jnp.float32))
 
+    def _group_fingerprint(self) -> Optional[Any]:
+        # the same joint-histogram update plane as sketch-mode Spearman:
+        # equal sketch config -> one shared compute-group delta
+        if self.approx == "sketch":
+            return rank_sketch_group_key(self)
+        return super()._group_fingerprint()
+
     def _states_own_sync(self) -> bool:
+        if self.approx == "sketch":
+            return False  # sketch sync IS the psum plane
         from metrics_tpu.parallel.sharded_dispatch import rank_corr_applicable
 
         return rank_corr_applicable(self) is not None
@@ -75,6 +126,8 @@ class KendallRankCorrCoef(Metric):
     def compute(self) -> Array:
         from metrics_tpu.parallel.sharded_dispatch import kendall_sharded
 
+        if self.approx == "sketch":
+            return kendall_from_joint(self.joint.counts)
         sharded = kendall_sharded(self)  # row-sharded epoch states: split O(N^2) ring
         if sharded is not None:
             return sharded

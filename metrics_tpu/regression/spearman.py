@@ -10,7 +10,7 @@ At pod scale, keep the epoch sharded instead of gathered: construct with
 (``parallel/sharded_epoch.py::sharded_spearman``) with O(capacity / n)
 per-device memory and no epoch materialization.
 """
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -19,11 +19,30 @@ from jax import Array
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.functional.regression.spearman import _spearman_jitted, _spearman_kernel
 from metrics_tpu.parallel.buffer import as_values
+from metrics_tpu.parallel.sketch import (
+    RankSketch,
+    canonicalize_approx,
+    rank_sketch_group_key,
+    rank_sketch_spec,
+    sketch_rank_update,
+    spearman_from_joint,
+)
 from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.prints import rank_zero_warn_once
 
 
 class SpearmanCorrcoef(Metric):
     r"""Accumulated Spearman rank correlation.
+
+    ``approx="sketch"`` drops the O(samples) buffers for a constant-memory
+    :class:`~metrics_tpu.parallel.sketch.RankSketch` — a ``num_bins ×
+    num_bins`` joint histogram over per-variable grids (``sketch_range=
+    (lo, hi)`` for a linear grid; the default ``None`` bins through a
+    range-free monotone squash, which rank statistics are invariant to).
+    ``compute`` is then the binned-rank (midrank) correlation: exactly
+    scipy's tie-averaged Spearman for the binned data, approaching the
+    unbinned value as the grid refines. ``update`` is one scatter-add and
+    ``sync`` one psum (bit-exact mergeable across devices/processes).
 
     Example:
         >>> import jax.numpy as jnp
@@ -41,6 +60,9 @@ class SpearmanCorrcoef(Metric):
         process_group: Optional[Any] = None,
         dist_sync_fn: Optional[Callable] = None,
         capacity: Optional[int] = None,
+        approx: Optional[str] = None,
+        num_bins: int = 512,
+        sketch_range: Optional[Tuple[float, float]] = None,
     ):
         super().__init__(
             compute_on_step=compute_on_step,
@@ -49,17 +71,49 @@ class SpearmanCorrcoef(Metric):
             dist_sync_fn=dist_sync_fn,
             capacity=capacity,
         )
+        self.approx = canonicalize_approx(approx)
+        self.num_bins = num_bins
+        self.sketch_range = None if sketch_range is None else tuple(sketch_range)
+        if self.sketch_range is not None and len(self.sketch_range) != 2:
+            raise ValueError(f"`sketch_range` must be None or a (lo, hi) pair, got {sketch_range!r}")
+        if self.approx == "sketch":
+            lo, hi = self.sketch_range if self.sketch_range is not None else (None, None)
+            self.add_state("joint", default=rank_sketch_spec(num_bins, lo, hi), dist_reduce_fx="sum")
+            return
         self.add_state("preds_all", default=[], dist_reduce_fx=None, item_shape=())
         self.add_state("target_all", default=[], dist_reduce_fx=None, item_shape=())
+        rank_zero_warn_once(
+            "Metric `SpearmanCorrcoef` stores every prediction and target in an"
+            " O(samples) buffer state (ranks are global over the epoch), so"
+            " memory and sync traffic grow with the dataset. Construct with"
+            " `approx=\"sketch\"` for a constant-memory joint-histogram rank"
+            " sketch that syncs with one psum; exact buffers remain the"
+            " default."
+        )
 
     def update(self, preds: Array, target: Array) -> None:
         _check_same_shape(preds, target)
         if preds.ndim != 1:
             raise ValueError("Expected both `preds` and `target` to be 1D arrays of scalar predictions")
+        if self.approx == "sketch":
+            lo, hi = self.sketch_range if self.sketch_range is not None else (None, None)
+            self.joint = RankSketch(
+                sketch_rank_update(self.joint.counts, jnp.asarray(preds), jnp.asarray(target), lo, hi)
+            )
+            return
         self._append("preds_all", jnp.asarray(preds, dtype=jnp.float32))
         self._append("target_all", jnp.asarray(target, dtype=jnp.float32))
 
+    def _group_fingerprint(self) -> Optional[Any]:
+        # sketch-mode rank metrics (Spearman/Kendall) share ONE joint-histogram
+        # update plane: equal sketch config -> one compute-group delta
+        if self.approx == "sketch":
+            return rank_sketch_group_key(self)
+        return super()._group_fingerprint()
+
     def _states_own_sync(self) -> bool:
+        if self.approx == "sketch":
+            return False  # sketch sync IS the psum plane
         from metrics_tpu.parallel.sharded_dispatch import rank_corr_applicable
 
         return rank_corr_applicable(self) is not None
@@ -67,6 +121,8 @@ class SpearmanCorrcoef(Metric):
     def compute(self) -> Array:
         from metrics_tpu.parallel.sharded_dispatch import spearman_sharded
 
+        if self.approx == "sketch":
+            return spearman_from_joint(self.joint.counts)
         sharded = spearman_sharded(self)  # row-sharded epoch states: exact ring
         if sharded is not None:
             return sharded

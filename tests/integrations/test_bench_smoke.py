@@ -73,6 +73,15 @@ def _assert_headline_schema(out):
     assert out["hier_dcn_bytes"] < out["flat2d_world_bytes"]
     assert out["hier_dcn_bytes"] == out["gather_sync_bytes"]  # S-1 = 1 hop
 
+    # the sketch A/B rides the same line: the sketch-mode twin of the gather
+    # collection syncs PSUM-ONLY (zero staged gathers) over a traffic-
+    # independent payload an order of magnitude under the buffer plane's
+    assert isinstance(out["sketch_sync_ms"], (int, float)) and out["sketch_sync_ms"] > 0
+    assert out["sketch_states_synced"] == 2  # AUROC+AP share one group histogram
+    assert out["sketch_collective_calls"] == 2  # two-stage (ici + dcn) psum
+    assert out["sketch_gather_calls"] == 0  # psum-only: the sketch contract
+    assert out["sketch_sync_bytes"] * 10 < out["hier_sync_bytes"]
+
     # fault counters ride the default line and are ZERO on a clean bench run
     # (--check-trajectory pins them at zero on every new BENCH_r* round)
     for key in ("sync_retries", "sync_deadline_exceeded", "degraded_computes", "quarantined_updates"):
@@ -94,10 +103,17 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     out = _run_smoke(("--trace", str(trace_file)))
     _assert_headline_schema(out)
 
-    # schema version of the --trace payload: v3 moved the collective counts
-    # to the default line and added the hierarchical A/B + per-crossing
-    # counters; bump this pin with the schema
-    assert out["trace_schema"] == 3
+    # schema version of the --trace payload: v4 added the sketch A/B (psum-
+    # only sketch plane keys on the default line, full sketch counters
+    # here); v3 moved the collective counts to the default line and added
+    # the hierarchical A/B + per-crossing counters; bump this pin with the
+    # schema
+    assert out["trace_schema"] == 4
+    # the sketch program's full snapshot: psum-only, no gather kinds staged
+    sketch_kinds = out["sketch_counters"]["calls_by_kind"]
+    assert sketch_kinds.get("psum", 0) == 2
+    for kind in ("all_gather", "coalesced_gather", "process_allgather"):
+        assert sketch_kinds.get(kind, 0) == 0, kind
 
     # counter totals must agree with the states_synced the bench reports
     assert out["counters"]["states_synced"] == out["states_synced"]
@@ -184,6 +200,7 @@ def test_bench_check_collectives_gate():
     assert out["ok"] is True and out["failures"] == []
     scenarios = out["scenarios"]
     assert set(scenarios) == {
+        "sketch_sync",
         "sum_grouped", "sum_ungrouped", "gather_coalesced", "gather_per_leaf",
         "gather_hier", "gather_flat2d",
         "sharded_auroc", "sharded_auroc_hier",
@@ -218,6 +235,13 @@ def test_bench_check_collectives_gate():
     # (dcn bytes >= flat world bytes) fails the gate
     assert out["hier_gate"]["ok"] is True
     assert out["hier_gate"]["hier_dcn_bytes"] < out["hier_gate"]["flat2d_world_bytes"]
+    # the sketch gate of record: the sketch plane is psum-only (zero staged
+    # gathers of any kind) and moves under 10% of the buffer plane's bytes
+    # on the same (4,2) mesh — the acceptance criterion of the constant-
+    # memory conversion
+    assert out["sketch_gate"]["ok"] is True
+    assert scenarios["sketch_sync"]["gather_calls"] == 0
+    assert scenarios["sketch_sync"]["sync_bytes"] * 10 < scenarios["gather_hier"]["sync_bytes"]
     for row in scenarios.values():
         assert row["status"] != "regression"
 

@@ -1,0 +1,617 @@
+"""Error-bound and mergeability suite for the mergeable sketch state kind.
+
+The contract under test (``metrics_tpu/parallel/sketch.py``):
+
+- **Accuracy**: sketch-mode compute tracks the exact-buffer compute within
+  the documented bounds on ADVERSARIAL score distributions — ties, one-sided
+  (all scores in one sliver of the range), heavy-tailed (mass clipped into
+  the end bins), well-separated classes. For AUROC the bound is the
+  data-dependent certificate :func:`auroc_error_bound` (half the in-bin
+  collision mass); for the rank sketches the documented envelope is
+  ``~2/num_bins`` (Spearman) / ``~4/num_bins`` (Kendall) on continuous data,
+  and EXACT (scipy tie conventions included) whenever distinct values map
+  1:1 onto bins.
+- **Mergeability**: sketch merge is elementwise integer addition, so a
+  ``psum`` of per-device sketches over a REAL mesh collective equals the
+  single-process sketch BIT-EXACTLY — flat 8-device axis and the (4,2)
+  hierarchical ici×dcn two-stage plane alike — and the staged program is
+  psum-only (zero gathers of any kind, pinned via the counters).
+- **Plumbing**: dtype matrix, compute-group fusion across the curve/rank
+  families, the per-metric ``state_bytes`` gauge, checkpoint round-trips,
+  and constructor validation.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu import observability as obs
+from metrics_tpu.classification.auroc import AUROC
+from metrics_tpu.classification.average_precision import AveragePrecision
+from metrics_tpu.classification.precision_recall_curve import PrecisionRecallCurve
+from metrics_tpu.classification.roc import ROC
+from metrics_tpu.core.collections import MetricCollection
+from metrics_tpu.parallel.placement import MeshHierarchy
+from metrics_tpu.parallel.sketch import (
+    HistogramSketch,
+    RankSketch,
+    auroc_error_bound,
+    curve_counts_from_histogram,
+    curve_sketch_spec,
+    is_sketch,
+    rank_sketch_spec,
+    sketch_curve_update,
+    sketch_init,
+    sketch_merge,
+    sketch_nbytes,
+    sketch_rank_update,
+    sketch_thresholds,
+)
+from metrics_tpu.parallel.sync import coalesced_sync_state, sync_value
+from metrics_tpu.regression.kendall import KendallRankCorrCoef
+from metrics_tpu.regression.spearman import SpearmanCorrcoef
+from metrics_tpu.utils import compat
+
+
+@pytest.fixture(autouse=True)
+def _clean_observability():
+    obs.disable()
+    obs.reset()
+    yield
+    obs.disable()
+    obs.reset()
+
+
+# ------------------------------------------------- adversarial distributions
+N = 3000
+
+
+def _scores(kind: str, rng: np.random.RandomState) -> np.ndarray:
+    """Adversarial score distributions, all valid probabilities so the
+    EXACT metric (which validates preds in [0, 1]) accepts them too."""
+    if kind == "uniform":
+        return rng.rand(N)
+    if kind == "ties":  # five distinct values: massive in-bin collision mass
+        return rng.choice([0.1, 0.2, 0.3, 0.5, 0.9], N)
+    if kind == "one_sided":  # the whole epoch inside one 2% sliver
+        return 0.49 + 0.02 * rng.rand(N)
+    if kind == "heavy_tailed":  # sigmoid-squashed Cauchy: mass at both ends
+        return 1.0 / (1.0 + np.exp(-rng.standard_cauchy(N)))
+    if kind == "separated":  # near-perfect classifier: mass in the end bins
+        return np.clip(0.5 + 0.45 * rng.randn(N) * 0.1 + 0.3 * np.sign(rng.randn(N)), 0, 1)
+    raise AssertionError(kind)
+
+
+CURVE_DISTS = ("uniform", "ties", "one_sided", "heavy_tailed", "separated")
+
+
+def _rank_pair(kind: str, rng: np.random.RandomState):
+    if kind == "gauss":
+        x = rng.randn(N)
+        y = 0.7 * x + 0.7 * rng.randn(N)
+    elif kind == "cauchy":  # heavy-tailed: the range-free squash grid's case
+        x = rng.standard_cauchy(N)
+        y = x + np.abs(rng.standard_cauchy(N))
+    elif kind == "anti":  # strong negative monotone association
+        x = rng.rand(N)
+        y = -(x ** 3) + 0.1 * rng.rand(N)
+    else:
+        raise AssertionError(kind)
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+# ------------------------------------------------------------- error bounds
+@pytest.mark.parametrize("dist", CURVE_DISTS)
+@pytest.mark.parametrize("bins", [64, 2048])
+def test_auroc_within_certificate(dist, bins):
+    """|sketch AUROC - exact AUROC| <= auroc_error_bound(sketch), the
+    data-dependent certificate computable from the sketch alone — on every
+    adversarial distribution and at both ends of the grid-size range."""
+    rng = np.random.RandomState(7)
+    preds = jnp.asarray(_scores(dist, rng).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, N).astype(np.int32))
+    exact, sketch = AUROC(), AUROC(approx="sketch", num_bins=bins)
+    exact.update(preds, target)
+    sketch.update(preds, target)
+    err = abs(float(exact.compute()) - float(sketch.compute()))
+    bound = float(auroc_error_bound(sketch.hist.counts))
+    assert err <= bound + 1e-6, f"{dist}/{bins}: err {err} > certificate {bound}"
+
+
+@pytest.mark.parametrize("dist", CURVE_DISTS)
+@pytest.mark.parametrize("bins,tol", [(64, 0.05), (2048, 0.03)])
+def test_average_precision_tracks_exact(dist, bins, tol):
+    """AP has no half-credit symmetry, so its error is a small multiple of
+    the in-bin collision mass rather than AUROC's exact certificate — the
+    documented envelope: under 0.05 at 64 bins, under 0.03 at 2048 even when
+    saturated tails pile ties into the end bins."""
+    rng = np.random.RandomState(7)
+    preds = jnp.asarray(_scores(dist, rng).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, N).astype(np.int32))
+    exact, sketch = AveragePrecision(), AveragePrecision(approx="sketch", num_bins=bins)
+    exact.update(preds, target)
+    sketch.update(preds, target)
+    assert abs(float(exact.compute()) - float(sketch.compute())) <= tol
+
+
+def test_thresholded_counts_exact_for_binned_data():
+    """The defining grid property: for scores ON the bin grid, the sketch's
+    thresholded (tp, fp, tn, fn) match a brute-force threshold sweep exactly
+    — the suffix-cumsum derivation introduces no error of its own."""
+    rng = np.random.RandomState(0)
+    bins = 16
+    thresholds = sketch_thresholds(bins, 0.0, 1.0)
+    scores = thresholds[rng.randint(0, bins, 400)]  # every score a bin edge
+    target = rng.randint(0, 2, 400)
+    counts = sketch_curve_update(
+        sketch_init(curve_sketch_spec(bins, None, 0.0, 1.0)).counts,
+        jnp.asarray(scores), jnp.asarray(target), 0.0, 1.0, 1,
+    )
+    tp, fp, tn, fn = (np.asarray(v) for v in curve_counts_from_histogram(counts))
+    for t, thr in enumerate(thresholds):
+        keep = scores >= thr
+        assert tp[t] == np.sum(keep & (target == 1)), t
+        assert fp[t] == np.sum(keep & (target == 0)), t
+        assert fn[t] == np.sum(~keep & (target == 1)), t
+        assert tn[t] == np.sum(~keep & (target == 0)), t
+
+
+@pytest.mark.parametrize("dist", ("gauss", "cauchy", "anti"))
+@pytest.mark.parametrize("bins", [128, 512])
+def test_rank_sketch_error_envelope(dist, bins):
+    """Spearman within ~2/num_bins and Kendall within ~4/num_bins of the
+    exact-buffer compute on continuous data, including heavy-tailed input
+    through the range-free squash grid."""
+    rng = np.random.RandomState(3)
+    x, y = _rank_pair(dist, rng)
+    xs, ys = jnp.asarray(x), jnp.asarray(y)
+    for cls, envelope in ((SpearmanCorrcoef, 2.0 / bins), (KendallRankCorrCoef, 4.0 / bins)):
+        exact, sketch = cls(), cls(approx="sketch", num_bins=bins)
+        exact.update(xs, ys)
+        sketch.update(xs, ys)
+        err = abs(float(exact.compute()) - float(sketch.compute()))
+        assert err <= envelope, f"{cls.__name__}/{dist}/{bins}: {err} > {envelope}"
+
+
+def test_rank_sketch_exact_on_bin_aligned_data():
+    """Data whose distinct values map 1:1 onto bins loses NOTHING: binned
+    midranks equal scipy's tie-averaged ranks and the binned concordance
+    equals the pairwise contraction — sketch == exact to float tolerance,
+    ties included."""
+    rng = np.random.RandomState(11)
+    x = rng.randint(0, 64, N).astype(np.float32)  # heavy ties: ~47 per value
+    y = (x + rng.randint(0, 32, N)) % 64
+    xs, ys = jnp.asarray(x), jnp.asarray(y.astype(np.float32))
+    for cls in (SpearmanCorrcoef, KendallRankCorrCoef):
+        exact = cls()
+        sketch = cls(approx="sketch", num_bins=64, sketch_range=(0.0, 64.0))
+        exact.update(xs, ys)
+        sketch.update(xs, ys)
+        assert abs(float(exact.compute()) - float(sketch.compute())) < 1e-5, cls.__name__
+
+
+def test_rank_sketch_degenerate_input_is_nan():
+    """Constant input (zero rank variance) follows the scipy convention the
+    exact kernel also uses: nan, not a crash or a fabricated value."""
+    m = SpearmanCorrcoef(approx="sketch", num_bins=32)
+    m.update(jnp.full((64,), 3.0), jnp.full((64,), 7.0))
+    assert np.isnan(float(m.compute()))
+
+
+def test_roc_and_prc_curves_on_threshold_grid():
+    """Sketch-mode ROC / PrecisionRecallCurve return (vals, vals, thresholds)
+    on the ascending bin-edge grid with the binned-curve conventions:
+    monotone-in-threshold counts, 0-where-undefined precision."""
+    rng = np.random.RandomState(5)
+    preds = jnp.asarray(rng.rand(500).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, 500).astype(np.int32))
+    roc = ROC(approx="sketch", num_bins=64)
+    roc.update(preds, target)
+    fpr, tpr, thr = roc.compute()
+    assert fpr.shape == tpr.shape == thr.shape == (64,)
+    assert np.all(np.diff(np.asarray(thr)) > 0)  # ascending threshold grid
+    assert np.all(np.diff(np.asarray(tpr)) <= 1e-7)  # tpr falls as thr rises
+    prc = PrecisionRecallCurve(approx="sketch", num_bins=64)
+    prc.update(preds, target)
+    precision, recall, thr2 = prc.compute()
+    np.testing.assert_allclose(np.asarray(thr2), np.asarray(thr))
+    assert np.all(np.asarray(precision) >= 0) and np.all(np.asarray(recall) <= 1)
+
+
+def test_multiclass_curve_sketch_tracks_exact():
+    """(C, 2, B) one-vs-rest layout: per-class AND macro sketch AUROC track
+    the exact multiclass compute within the per-class certificates."""
+    rng = np.random.RandomState(9)
+    logits = rng.randn(2000, 3).astype(np.float32)
+    probs = np.exp(logits) / np.exp(logits).sum(axis=1, keepdims=True)
+    target = rng.randint(0, 3, 2000).astype(np.int32)
+    exact = AUROC(num_classes=3, average="macro")
+    sketch = AUROC(num_classes=3, average="macro", approx="sketch", num_bins=2048)
+    exact.update(jnp.asarray(probs), jnp.asarray(target))
+    sketch.update(jnp.asarray(probs), jnp.asarray(target))
+    bound = float(jnp.max(auroc_error_bound(sketch.hist.counts)))
+    assert abs(float(exact.compute()) - float(sketch.compute())) <= bound + 1e-6
+    per_class = AUROC(num_classes=3, average=None, approx="sketch", num_bins=256)
+    per_class.update(jnp.asarray(probs), jnp.asarray(target))
+    assert per_class.compute().shape == (3,)
+
+
+# ------------------------------------------------------------- dtype matrix
+@pytest.mark.parametrize("preds_dtype", [jnp.float32, jnp.float16])
+@pytest.mark.parametrize("target_dtype", [jnp.int32, bool])
+def test_curve_sketch_input_dtype_matrix(preds_dtype, target_dtype):
+    rng = np.random.RandomState(2)
+    base = rng.rand(512).astype(np.float32)
+    labels = rng.randint(0, 2, 512)
+    m = AUROC(approx="sketch", num_bins=128)
+    m.update(jnp.asarray(base, dtype=preds_dtype), jnp.asarray(labels, dtype=target_dtype))
+    assert m.hist.counts.dtype == jnp.int32  # accumulates in the accum dtype
+    assert int(jnp.sum(m.hist.counts)) == 512
+    assert np.isfinite(float(m.compute()))
+
+
+@pytest.mark.parametrize("counts_dtype", [jnp.int32, jnp.float32])
+def test_sketch_counts_dtype_override(counts_dtype):
+    """An explicit counts dtype flows through spec -> init -> update -> merge
+    (a float-count sketch rides the f32 sum bucket instead of the i32 one)."""
+    spec = curve_sketch_spec(32, None, 0.0, 1.0, dtype=counts_dtype)
+    sk = sketch_init(spec)
+    assert sk.counts.dtype == counts_dtype and sk.counts.shape == (2, 32)
+    rng = np.random.RandomState(4)
+    counts = sketch_curve_update(
+        sk.counts, jnp.asarray(rng.rand(100).astype(np.float32)),
+        jnp.asarray(rng.randint(0, 2, 100).astype(np.int32)), 0.0, 1.0, 1,
+    )
+    merged = sketch_merge(HistogramSketch(counts), HistogramSketch(counts))
+    assert merged.counts.dtype == counts_dtype
+    assert int(jnp.sum(merged.counts)) == 200
+
+
+def test_sketch_merge_kind_mismatch_raises():
+    a = sketch_init(curve_sketch_spec(8, None, 0.0, 1.0))
+    b = sketch_init(rank_sketch_spec(8, None, None))
+    with pytest.raises(TypeError, match="cannot merge sketch kinds"):
+        sketch_merge(a, b)
+
+
+def test_sketch_nbytes_traffic_independent():
+    spec = curve_sketch_spec(2048, None, 0.0, 1.0)
+    sk = sketch_init(spec)
+    before = sketch_nbytes(sk)
+    assert before == 2 * 2048 * 4
+    counts = sk.counts
+    for _ in range(3):  # 3 epochs of traffic: footprint unchanged
+        counts = sketch_curve_update(
+            counts, jnp.linspace(0, 1, 4096), jnp.ones((4096,), jnp.int32), 0.0, 1.0, 1
+        )
+    assert sketch_nbytes(HistogramSketch(counts)) == before
+
+
+# --------------------------------------------------------- psum mergeability
+def test_psum_merge_bit_exact_flat(eight_devices):
+    """The acceptance property: a real staged psum of 8 per-device sketches
+    equals the single-process sketch over the concatenated data BIT-EXACTLY
+    (integer addition is exactly associative — no tolerance needed)."""
+    rng = np.random.RandomState(0)
+    scores = rng.rand(8, 256).astype(np.float32)
+    target = rng.randint(0, 2, (8, 256)).astype(np.int32)
+    spec = curve_sketch_spec(128, None, 0.0, 1.0)
+
+    mesh = Mesh(np.array(eight_devices), ("dp",))
+
+    def fn(s, t):
+        local = sketch_curve_update(sketch_init(spec).counts, s[0], t[0], 0.0, 1.0, 1)
+        return sync_value("sum", HistogramSketch(local), "dp").counts
+
+    f = jax.jit(compat.shard_map(
+        fn, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P(), check_vma=False
+    ))
+    synced = f(jnp.asarray(scores), jnp.asarray(target))
+
+    single = sketch_curve_update(
+        sketch_init(spec).counts,
+        jnp.asarray(scores.reshape(-1)), jnp.asarray(target.reshape(-1)), 0.0, 1.0, 1,
+    )
+    np.testing.assert_array_equal(np.asarray(synced), np.asarray(single))
+
+
+def test_host_merge_fold_matches_single_process():
+    """The host-plane analogue: folding per-shard sketches with sketch_merge
+    (any association order) equals the single big sketch bit-exactly."""
+    rng = np.random.RandomState(1)
+    x = rng.randn(1024).astype(np.float32)
+    y = (x + rng.randn(1024)).astype(np.float32)
+    spec = rank_sketch_spec(64, None, None)
+    shards = [
+        RankSketch(sketch_rank_update(
+            sketch_init(spec).counts, jnp.asarray(x[i::4]), jnp.asarray(y[i::4]), None, None
+        ))
+        for i in range(4)
+    ]
+    left = shards[0]
+    for s in shards[1:]:
+        left = sketch_merge(left, s)
+    right = sketch_merge(sketch_merge(shards[2], shards[3]), sketch_merge(shards[0], shards[1]))
+    single = sketch_rank_update(sketch_init(spec).counts, jnp.asarray(x), jnp.asarray(y), None, None)
+    np.testing.assert_array_equal(np.asarray(left.counts), np.asarray(single))
+    np.testing.assert_array_equal(np.asarray(right.counts), np.asarray(single))
+
+
+@pytest.mark.parametrize("hierarchical", [False, True], ids=["flat", "hier42"])
+def test_coalesced_sync_psum_only_and_parity(eight_devices, hierarchical):
+    """The full sync-plane contract on a real mesh program: sketch leaves
+    fold into the existing sum buckets, the staged program is PSUM-ONLY
+    (zero gathers of any kind), and the (4,2) hierarchical two-stage plane
+    is bit-identical to the flat plane AND to the single-process sketch."""
+    rng = np.random.RandomState(6)
+    scores = rng.rand(8, 128).astype(np.float32)
+    target = rng.randint(0, 2, (8, 128)).astype(np.int32)
+    hist_spec = curve_sketch_spec(64, None, 0.0, 1.0)
+    joint_spec = rank_sketch_spec(16, 0.0, 1.0)
+    reductions = {"hist": "sum", "joint": "sum"}
+
+    if hierarchical:
+        mesh = Mesh(np.array(eight_devices).reshape(2, 4), ("dcn", "ici"))
+        axis = MeshHierarchy(ici_axis="ici", dcn_axis="dcn")
+        specs = P(("dcn", "ici"))
+    else:
+        mesh = Mesh(np.array(eight_devices), ("dp",))
+        axis = "dp"
+        specs = P("dp")
+
+    def fn(s, t):
+        state = {
+            "hist": HistogramSketch(
+                sketch_curve_update(sketch_init(hist_spec).counts, s[0], t[0], 0.0, 1.0, 1)
+            ),
+            "joint": RankSketch(
+                sketch_rank_update(sketch_init(joint_spec).counts, s[0], t[0].astype(jnp.float32), 0.0, 1.0)
+            ),
+        }
+        synced = coalesced_sync_state(state, reductions, axis)
+        return synced["hist"].counts, synced["joint"].counts
+
+    obs.enable()
+    obs.reset()
+    f = jax.jit(compat.shard_map(
+        fn, mesh=mesh, in_specs=(specs, specs), out_specs=(P(), P()), check_vma=False
+    ))
+    hist, joint = f(jnp.asarray(scores), jnp.asarray(target))
+    snap = obs.counters_snapshot()
+    obs.disable()
+
+    # psum-only: the two sketch leaves share ONE int32 sum bucket; the
+    # hierarchical plane stages it in two (ici, then dcn) calls
+    assert snap["calls_by_kind"].get("psum", 0) == (2 if hierarchical else 1)
+    for kind in ("all_gather", "coalesced_gather", "process_allgather", "ppermute"):
+        assert snap["calls_by_kind"].get(kind, 0) == 0, kind
+
+    flat_scores = jnp.asarray(scores.reshape(-1))
+    flat_target = jnp.asarray(target.reshape(-1))
+    single_hist = sketch_curve_update(
+        sketch_init(hist_spec).counts, flat_scores, flat_target, 0.0, 1.0, 1
+    )
+    single_joint = sketch_rank_update(
+        sketch_init(joint_spec).counts, flat_scores, flat_target.astype(jnp.float32), 0.0, 1.0
+    )
+    np.testing.assert_array_equal(np.asarray(hist), np.asarray(single_hist))
+    np.testing.assert_array_equal(np.asarray(joint), np.asarray(single_joint))
+
+
+def test_hier_and_flat_synced_compute_match_single_process(eight_devices):
+    """End to end through the METRIC layer: a sketch-mode AUROC whose state
+    was psum-synced over the (4,2) hierarchy computes the same value as the
+    flat-synced AND the unsharded single-process metric (bit-exact states
+    make this an equality, not a tolerance)."""
+    rng = np.random.RandomState(8)
+    scores = rng.rand(8, 200).astype(np.float32)
+    target = rng.randint(0, 2, (8, 200)).astype(np.int32)
+
+    def synced_counts(hierarchical):
+        spec = curve_sketch_spec(256, None, 0.0, 1.0)
+        if hierarchical:
+            mesh = Mesh(np.array(eight_devices).reshape(2, 4), ("dcn", "ici"))
+            axis, specs = MeshHierarchy("ici", "dcn"), P(("dcn", "ici"))
+        else:
+            mesh = Mesh(np.array(eight_devices), ("dp",)),
+            mesh, axis, specs = Mesh(np.array(eight_devices), ("dp",)), "dp", P("dp")
+
+        def fn(s, t):
+            local = sketch_curve_update(sketch_init(spec).counts, s[0], t[0], 0.0, 1.0, 1)
+            return sync_value("sum", HistogramSketch(local), axis).counts
+
+        f = jax.jit(compat.shard_map(
+            fn, mesh=mesh, in_specs=(specs, specs), out_specs=P(), check_vma=False
+        ))
+        return f(jnp.asarray(scores), jnp.asarray(target))
+
+    single = AUROC(approx="sketch", num_bins=256)
+    single.update(jnp.asarray(scores.reshape(-1)), jnp.asarray(target.reshape(-1)))
+    expected = float(single.compute())
+
+    for hierarchical in (False, True):
+        m = AUROC(approx="sketch", num_bins=256)
+        m.hist = HistogramSketch(synced_counts(hierarchical))
+        assert float(m.compute()) == expected
+
+
+# ------------------------------------------------------ collection plumbing
+def test_curve_family_forms_one_compute_group():
+    """AUROC / ROC / PrecisionRecallCurve / AveragePrecision with equal
+    sketch config share ONE scatter-add update plane: the collection fuses
+    them into a single compute group (one synced histogram serves all four),
+    and every member still computes its own value."""
+    col = MetricCollection([
+        AUROC(approx="sketch", num_bins=64),
+        AveragePrecision(approx="sketch", num_bins=64),
+        ROC(approx="sketch", num_bins=64),
+        PrecisionRecallCurve(approx="sketch", num_bins=64),
+    ])
+    gm = col._group_map()
+    assert len(set(gm.values())) == 1, gm  # one group for the whole family
+    rng = np.random.RandomState(12)
+    preds = jnp.asarray(rng.rand(400).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, 400).astype(np.int32))
+    col.update(preds, target)
+    out = col.compute()
+    ref = AUROC(approx="sketch", num_bins=64)
+    ref.update(preds, target)
+    np.testing.assert_allclose(np.asarray(out["AUROC"]), np.asarray(ref.compute()))
+    assert out["ROC"][0].shape == (64,)
+
+    # different config must NOT fuse (the fingerprint is the sketch spec)
+    col2 = MetricCollection([
+        AUROC(approx="sketch", num_bins=64),
+        AveragePrecision(approx="sketch", num_bins=128),
+    ])
+    assert len(set(col2._group_map().values())) == 2
+
+
+def test_rank_family_forms_one_compute_group():
+    col = MetricCollection([
+        SpearmanCorrcoef(approx="sketch", num_bins=32),
+        KendallRankCorrCoef(approx="sketch", num_bins=32),
+    ])
+    assert len(set(col._group_map().values())) == 1
+    rng = np.random.RandomState(13)
+    x = jnp.asarray(rng.randn(300).astype(np.float32))
+    y = jnp.asarray(rng.randn(300).astype(np.float32))
+    col.update(x, y)
+    out = col.compute()
+    ref = KendallRankCorrCoef(approx="sketch", num_bins=32)
+    ref.update(x, y)
+    np.testing.assert_allclose(
+        np.asarray(out["KendallRankCorrCoef"]), np.asarray(ref.compute())
+    )
+
+
+def test_state_bytes_gauge_constant_for_sketch_growing_for_buffer():
+    """The satellite of record: the per-metric ``state_bytes`` gauge in the
+    counters snapshot measures the sketch-vs-buffer memory win. A buffer
+    metric's footprint grows with traffic; a sketch metric's is a constant
+    ``2 * num_bins * itemsize`` forever."""
+    rng = np.random.RandomState(14)
+    preds = jnp.asarray(rng.rand(256).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, 256).astype(np.int32))
+
+    obs.enable()
+    obs.reset()
+    sketch = AUROC(approx="sketch", num_bins=128)
+    sketch.update(preds, target)
+    first = obs.counters_snapshot()["state_bytes"]["AUROC"]
+    assert first == 2 * 128 * 4
+    for _ in range(3):
+        sketch.update(preds, target)
+    assert obs.counters_snapshot()["state_bytes"]["AUROC"] == first  # constant
+
+    obs.reset()
+    buffered = AUROC()
+    buffered.update(preds, target)
+    b1 = obs.counters_snapshot()["state_bytes"]["AUROC"]
+    buffered.update(preds, target)
+    b2 = obs.counters_snapshot()["state_bytes"]["AUROC"]
+    assert b2 > b1 > first  # O(samples): grows every update
+    obs.disable()
+
+    # the gauge is present (possibly empty) in EVERY snapshot — schema pin
+    obs.reset()
+    assert obs.counters_snapshot()["state_bytes"] == {}
+
+
+def test_summarize_surfaces_state_bytes_column():
+    rng = np.random.RandomState(15)
+    obs.enable()
+    obs.reset()
+    m = AUROC(approx="sketch", num_bins=64)
+    m.update(jnp.asarray(rng.rand(64).astype(np.float32)),
+             jnp.asarray(rng.randint(0, 2, 64).astype(np.int32)))
+    table = obs.summarize()
+    obs.disable()
+    assert table["metric.update"]["state_bytes"] == 2 * 64 * 4
+    # the column is schema-stable: rows without the attr carry 0
+    assert all("state_bytes" in row for row in table.values())
+
+
+def test_checkpoint_roundtrip_and_reset():
+    rng = np.random.RandomState(16)
+    preds = jnp.asarray(rng.rand(128).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, 128).astype(np.int32))
+    m = AUROC(approx="sketch", num_bins=32)
+    m.update(preds, target)
+    m.persistent(True)
+    saved = m.state_dict()
+    assert set(saved["hist"]) == {"sketch_counts"}
+
+    fresh = AUROC(approx="sketch", num_bins=32)
+    fresh.persistent(True)
+    fresh.load_state_dict(saved)
+    assert is_sketch(fresh.hist)
+    np.testing.assert_array_equal(np.asarray(fresh.hist.counts), np.asarray(m.hist.counts))
+    assert float(fresh.compute()) == float(m.compute())
+
+    m.reset()
+    assert int(jnp.sum(m.hist.counts)) == 0 and is_sketch(m.hist)
+
+
+def test_update_stays_jittable_under_scan():
+    """The hot-path property: sketch_curve_update composes under jit + scan
+    (static shapes, no host sync) and the scan-folded result equals the
+    sequential fold."""
+    rng = np.random.RandomState(17)
+    batches = jnp.asarray(rng.rand(5, 64).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, 2, (5, 64)).astype(np.int32))
+    spec = curve_sketch_spec(32, None, 0.0, 1.0)
+
+    @jax.jit
+    def epoch(bs, ls):
+        def step(counts, xs):
+            return sketch_curve_update(counts, xs[0], xs[1], 0.0, 1.0, 1), None
+        return jax.lax.scan(step, sketch_init(spec).counts, (bs, ls))[0]
+
+    scanned = epoch(batches, labels)
+    seq = sketch_init(spec).counts
+    for i in range(5):
+        seq = sketch_curve_update(seq, batches[i], labels[i], 0.0, 1.0, 1)
+    np.testing.assert_array_equal(np.asarray(scanned), np.asarray(seq))
+
+
+# ---------------------------------------------------------------- validation
+def test_constructor_validation():
+    with pytest.raises(ValueError, match="`approx` must be"):
+        AUROC(approx="histogram")
+    with pytest.raises(ValueError, match="num_bins"):
+        AUROC(approx="sketch", num_bins=1)
+    with pytest.raises(ValueError, match="max_fpr"):
+        AUROC(approx="sketch", max_fpr=0.5)
+    with pytest.raises(ValueError, match="lo < hi"):
+        ROC(approx="sketch", sketch_range=(1.0, 0.0))
+    with pytest.raises(ValueError, match="sketch_range"):
+        SpearmanCorrcoef(approx="sketch", sketch_range=(0.0,))
+
+
+def test_sketch_layout_mismatch_raises():
+    m = AUROC(approx="sketch", num_bins=16)  # binary layout: (2, B)
+    with pytest.raises(ValueError, match="num_classes"):
+        m.update(jnp.zeros((8, 3)), jnp.zeros((8,), jnp.int32))
+    mc = AUROC(approx="sketch", num_bins=16, num_classes=3)
+    with pytest.raises(ValueError, match="binary sketch mode"):
+        mc.update(jnp.zeros((8,)), jnp.zeros((8,), jnp.int32))
+
+
+def test_add_state_rejects_non_sum_sketch():
+    from metrics_tpu.core.metric import Metric
+
+    class Bad(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("s", default=curve_sketch_spec(8, None, 0.0, 1.0), dist_reduce_fx="cat")
+
+        def update(self):  # pragma: no cover
+            pass
+
+        def compute(self):  # pragma: no cover
+            return None
+
+    with pytest.raises(ValueError, match="sum-mergeable"):
+        Bad()
