@@ -82,8 +82,9 @@ class PrecisionRecallCurve(Metric):
         self.sketch_range = tuple(sketch_range)
 
         if self.approx == "sketch":
-            # constant-memory mode: the PR curve is evaluated on the num_bins
-            # bin-edge threshold grid from a psum-synced HistogramSketch
+            # constant-memory mode: the PR curve is evaluated on the
+            # num_bins + 1 threshold grid (bin edges + the (precision=1,
+            # recall=0) terminal anchor) from a psum-synced HistogramSketch
             self.add_state(
                 "hist",
                 default=curve_sketch_spec(num_bins, num_classes, *self.sketch_range),

@@ -75,8 +75,9 @@ class ROC(Metric):
         self.sketch_range = tuple(sketch_range)
 
         if self.approx == "sketch":
-            # constant-memory mode: the ROC is evaluated on the num_bins
-            # bin-edge threshold grid from a psum-synced HistogramSketch
+            # constant-memory mode: the ROC is evaluated on the num_bins + 1
+            # threshold grid (bin edges + the (0, 0) terminal anchor) from a
+            # psum-synced HistogramSketch
             self.add_state(
                 "hist",
                 default=curve_sketch_spec(num_bins, num_classes, *self.sketch_range),

@@ -15,10 +15,11 @@ that every operation stays XLA-native:
 
 - :class:`HistogramSketch` — per-class score histograms conditioned on the
   target, counts of shape ``(2, B)`` (binary: row 0 positives, row 1
-  negatives) or ``(C, 2, B)``. Thresholded TP/FP/TN/FN at the ``B`` bin-edge
-  thresholds are EXACT for the binned data (a suffix cumsum), so ROC / PR /
-  AUROC / AP derive at ``compute()`` with error bounded by the in-bin
-  collision mass (see :func:`auroc_error_bound`).
+  negatives) or ``(C, 2, B)``. Thresholded TP/FP/TN/FN on the ``B + 1``
+  threshold grid (the ``B`` bin lower edges plus a terminal all-rejecting
+  threshold above the top bin) are EXACT for the binned data (a suffix
+  cumsum), so ROC / PR / AUROC / AP derive at ``compute()`` with error
+  bounded by the in-bin collision mass (see :func:`auroc_error_bound`).
 - :class:`RankSketch` — a 2-D joint histogram over per-variable quantile
   grids. Spearman is the binned-rank (midrank) Pearson correlation over the
   joint counts — exactly scipy's tie-averaged Spearman for the binned data —
@@ -156,8 +157,13 @@ def _accum_dtype():
 # ------------------------------------------------------------------- binning
 def score_to_bin(x: Array, num_bins: int, lo: float, hi: float) -> Array:
     """Linear bin index of ``x`` on the ``[lo, hi)`` grid, clipped into the
-    end bins (out-of-range scores merge into bin 0 / bin B-1 — part of the
-    documented approximation, not an error)."""
+    end bins (out-of-range scores — ``±inf`` included — merge into bin 0 /
+    bin B-1: part of the documented approximation, not an error).
+
+    ``NaN`` has no defined bin (``astype(int32)`` of NaN is undefined in
+    XLA): callers must mask NaN before binning, as the sketch update planes
+    do (NaN samples are dropped via a zero scatter increment).
+    """
     scaled = (x - lo) * (num_bins / (hi - lo))
     return jnp.clip(jnp.floor(scaled), 0, num_bins - 1).astype(jnp.int32)
 
@@ -172,21 +178,26 @@ def rank_to_bin(x: Array, num_bins: int, lo: Optional[float], hi: Optional[float
     range configuration. Rank statistics are invariant under any strictly
     increasing transform, and exact ties stay exact ties through it, so the
     squash changes only which values COLLIDE in a bin, never their order.
+    ``±inf`` takes the squash's sign limit (bin 0 / bin B-1) rather than the
+    undefined ``inf/inf`` path; NaN must be masked by the caller (see
+    :func:`score_to_bin`).
     """
     if lo is None:
-        s = 0.5 + 0.5 * x / (1.0 + jnp.abs(x))
-        return score_to_bin(s, num_bins, 0.0, 1.0)
+        t = jnp.where(jnp.isinf(x), jnp.sign(x), x / (1.0 + jnp.abs(x)))
+        return score_to_bin(0.5 + 0.5 * t, num_bins, 0.0, 1.0)
     return score_to_bin(x, num_bins, lo, hi)
 
 
 def sketch_thresholds(num_bins: int, lo: float, hi: float) -> np.ndarray:
-    """The ``B`` bin lower edges — the threshold grid curve sketches report.
+    """The ``B + 1`` thresholds curve sketches report: the ``B`` bin lower
+    edges plus ``hi``, the virtual terminal threshold above the top bin where
+    every sample is rejected — the curve's zero-count (0, 0) anchor.
 
     Host-side numpy on purpose (threshold grids are metric config; under jit
     they stage as constants), matching
     ``functional.classification.binned_curves.default_thresholds``.
     """
-    return (lo + np.arange(num_bins, dtype=np.float64) * ((hi - lo) / num_bins)).astype(np.float32)
+    return (lo + np.arange(num_bins + 1, dtype=np.float64) * ((hi - lo) / num_bins)).astype(np.float32)
 
 
 # ------------------------------------------------------------------- updates
@@ -216,7 +227,11 @@ def sketch_curve_update(
       positives are ``target == pos_label`` per column.
 
     Pure and jittable: one clip-floor binning plus one scatter-add, no
-    data-dependent shapes, no host sync.
+    data-dependent shapes, no host sync. NaN predictions are DROPPED (zero
+    scatter increment) rather than scattered into an undefined bin — the
+    sketch-mode analogue of buffer mode preserving NaN for the
+    ``check_finite`` policies to catch; ``±inf`` clips into the end bins
+    like any out-of-range score.
     """
     num_bins = counts.shape[-1]
     if preds.ndim == 1:
@@ -225,23 +240,25 @@ def sketch_curve_update(
                 f"sketch expects per-class input (N, {counts.shape[0]}); got 1-D predictions."
                 " Construct the metric without num_classes for binary sketch mode."
             )
-        b = score_to_bin(preds, num_bins, lo, hi)
+        nan = jnp.isnan(preds)
+        b = score_to_bin(jnp.where(nan, lo, preds), num_bins, lo, hi)
         row = jnp.where(target == pos_label, 0, 1)
-        return counts.at[row, b].add(1)
+        return counts.at[row, b].add((~nan).astype(counts.dtype))
     if preds.ndim != 2 or counts.ndim != 3 or preds.shape[1] != counts.shape[0]:
         raise ValueError(
             f"sketch/state layout mismatch: preds {preds.shape} vs counts {counts.shape}."
             " Multiclass/multilabel sketch mode needs num_classes at construction."
         )
     num_classes = preds.shape[1]
-    b = score_to_bin(preds, num_bins, lo, hi)  # (N, C)
+    nan = jnp.isnan(preds)
+    b = score_to_bin(jnp.where(nan, lo, preds), num_bins, lo, hi)  # (N, C)
     if target.ndim == 1:
         pos = target[:, None] == jnp.arange(num_classes)[None, :]
     else:
         pos = target == pos_label
     cls = jnp.broadcast_to(jnp.arange(num_classes)[None, :], b.shape)
     row = jnp.where(pos, 0, 1)
-    return counts.at[cls, row, b].add(1)
+    return counts.at[cls, row, b].add((~nan).astype(counts.dtype))
 
 
 def sketch_rank_update(
@@ -253,35 +270,48 @@ def sketch_rank_update(
 ) -> Array:
     """Scatter one batch of (preds, target) pairs into the 2-D joint
     histogram — the shared update plane of Spearman's and Kendall's sketch
-    mode (equal-config instances form one compute group). Jittable."""
-    bi = rank_to_bin(preds, counts.shape[0], lo, hi)
-    bj = rank_to_bin(target, counts.shape[1], lo, hi)
-    return counts.at[bi, bj].add(1)
+    mode (equal-config instances form one compute group). Jittable. Pairs
+    with a NaN on either side are dropped (zero scatter increment) instead
+    of corrupting an undefined bin; ``±inf`` lands in the end bins."""
+    nan = jnp.isnan(preds) | jnp.isnan(target)
+    bi = rank_to_bin(jnp.where(nan, 0.0, preds), counts.shape[0], lo, hi)
+    bj = rank_to_bin(jnp.where(nan, 0.0, target), counts.shape[1], lo, hi)
+    return counts.at[bi, bj].add((~nan).astype(counts.dtype))
 
 
 # ---------------------------------------------------------------- curve math
 def curve_counts_from_histogram(counts: Array) -> Tuple[Array, Array, Array, Array]:
-    """Thresholded ``(tp, fp, tn, fn)`` float32 counts at the ``B`` bin-edge
-    thresholds, from ``(..., 2, B)`` histogram counts.
+    """Thresholded ``(tp, fp, tn, fn)`` float32 counts on the ``B + 1``
+    threshold grid of :func:`sketch_thresholds` — the ``B`` bin lower edges
+    plus the virtual terminal threshold above the top bin — from
+    ``(..., 2, B)`` histogram counts.
 
     ``score >= thr[t]`` is EXACTLY ``bin(score) >= t`` for in-range scores
     (the grid's defining property), so these counts are exact for the binned
-    data — the suffix cumsum is the whole derivation. Shapes: ``(..., B)``.
+    data — the suffix cumsum is the whole derivation. The terminal column
+    rejects everything (``tp = fp = 0``): it anchors the derived ROC/PR
+    curves at (0, 0) so top-bin samples — saturated sigmoids, out-of-range
+    scores clipped into bin B-1 — keep their final trapezoid/step segment,
+    the half-credit property :func:`auroc_error_bound`'s certificate relies
+    on. Shapes: ``(..., B + 1)``.
     """
     h = counts.astype(jnp.float32)
     pos = h[..., 0, :]
     neg = h[..., 1, :]
-    # suffix (reverse) cumulative sums: samples at or above each bin edge
-    tp = jnp.flip(jnp.cumsum(jnp.flip(pos, -1), -1), -1)
-    fp = jnp.flip(jnp.cumsum(jnp.flip(neg, -1), -1), -1)
+    # suffix (reverse) cumulative sums: samples at or above each bin edge,
+    # plus a trailing zero column for the above-the-top terminal threshold
+    zero = jnp.zeros_like(pos[..., :1])
+    tp = jnp.concatenate([jnp.flip(jnp.cumsum(jnp.flip(pos, -1), -1), -1), zero], -1)
+    fp = jnp.concatenate([jnp.flip(jnp.cumsum(jnp.flip(neg, -1), -1), -1), zero], -1)
     fn = jnp.sum(pos, -1, keepdims=True) - tp
     tn = jnp.sum(neg, -1, keepdims=True) - fp
     return tp, fp, tn, fn
 
 
 def roc_from_histogram(counts: Array) -> Tuple[Array, Array]:
-    """(fpr, tpr) on the ascending bin-edge threshold grid (binned-curve
-    conventions, matching ``classification.binned.BinnedROC``)."""
+    """(fpr, tpr) on the ascending ``B + 1`` threshold grid (binned-curve
+    conventions, matching ``classification.binned.BinnedROC``), ending at
+    the (0, 0) terminal point."""
     tp, fp, tn, fn = curve_counts_from_histogram(counts)
     tpr = tp / jnp.maximum(tp + fn, 1.0)
     fpr = fp / jnp.maximum(fp + tn, 1.0)
@@ -323,19 +353,26 @@ def auroc_error_bound(counts: Array) -> Array:
 
 
 def precision_recall_from_histogram(counts: Array) -> Tuple[Array, Array]:
-    """(precision, recall) on the ascending bin-edge threshold grid
-    (``BinnedPrecisionRecallCurve`` conventions: 0 where undefined)."""
+    """(precision, recall) on the ascending ``B + 1`` threshold grid
+    (``BinnedPrecisionRecallCurve`` conventions: 0 where undefined), except
+    the terminal zero-count point takes the exact module's
+    ``(precision=1, recall=0)`` endpoint convention — the curve ends at the
+    same anchor whether computed from buffers or from the sketch."""
     tp, fp, tn, fn = curve_counts_from_histogram(counts)
     denom_p = tp + fp
     denom_r = tp + fn
     precision = jnp.where(denom_p == 0, 0.0, tp / jnp.where(denom_p == 0, 1.0, denom_p))
+    precision = precision.at[..., -1].set(1.0)
     recall = jnp.where(denom_r == 0, 0.0, tp / jnp.where(denom_r == 0, 1.0, denom_r))
     return precision, recall
 
 
 def average_precision_from_histogram(counts: Array) -> Array:
     """Average precision as the step integral over the sketched PR curve
-    (descending recall, ``BinnedAveragePrecision`` conventions)."""
+    (descending recall, ``BinnedAveragePrecision`` conventions). The
+    terminal (recall=0) grid point supplies the final recall-drop step, so
+    positives saturated into the top bin contribute their
+    ``precision * recall`` mass instead of silently vanishing."""
     precision, recall = precision_recall_from_histogram(counts)
     return -jnp.sum((recall[..., 1:] - recall[..., :-1]) * precision[..., :-1], axis=-1)
 

@@ -157,6 +157,56 @@ def test_thresholded_counts_exact_for_binned_data():
         assert tn[t] == np.sum(~keep & (target == 0)), t
 
 
+def test_saturated_top_bin_keeps_terminal_segment():
+    """Regression (REVIEW): scores saturated into the TOP bin must keep
+    their final curve segment via the (0, 0) terminal anchor. One positive
+    above one negative, both in bin B-1: the trapezoid's last segment gives
+    the half-credit the certificate's proof relies on — AUROC 0.5 with
+    certificate 0.5 against the exact 1.0, not 0.0 with a violated bound."""
+    preds = jnp.asarray(np.array([0.9999, 0.9998], np.float32))
+    target = jnp.asarray(np.array([1, 0], np.int32))
+    m = AUROC(approx="sketch", num_bins=2048)
+    m.update(preds, target)
+    sketched = float(m.compute())
+    bound = float(auroc_error_bound(m.hist.counts))
+    assert sketched == pytest.approx(0.5)
+    assert abs(1.0 - sketched) <= bound + 1e-6
+
+
+def test_all_positives_saturated_ap_is_exact():
+    """Regression (REVIEW): with every positive at 1.0 (top bin) the final
+    recall-drop step must survive — AP is the top-bin precision, not 0."""
+    preds = jnp.asarray(np.array([1.0, 1.0, 1.0, 0.2, 0.3], np.float32))
+    target = jnp.asarray(np.array([1, 1, 1, 0, 0], np.int32))
+    m = AveragePrecision(approx="sketch", num_bins=2048)
+    m.update(preds, target)
+    assert float(m.compute()) == pytest.approx(1.0)
+    exact = AveragePrecision()
+    exact.update(preds, target)
+    assert float(m.compute()) == pytest.approx(float(exact.compute()))
+
+
+def test_nan_scores_dropped_not_scattered():
+    """Regression (REVIEW): NaN predictions must not scatter into an
+    arbitrary bin (astype(int32) of NaN is undefined in XLA) — they drop out
+    of the sketch entirely, curve and rank planes alike, and ±inf clips into
+    the end bins like any out-of-range score."""
+    preds = jnp.asarray(np.array([0.2, np.nan, 0.8, np.inf, -np.inf], np.float32))
+    target = jnp.asarray(np.array([1, 1, 0, 1, 0], np.int32))
+    m = AUROC(approx="sketch", num_bins=16)
+    m.update(preds, target)
+    counts = np.asarray(m.hist.counts)
+    assert counts.sum() == 4  # the NaN sample is gone, nothing corrupted
+    assert counts[0, -1] == 1 and counts[1, 0] == 1  # ±inf in the end bins
+
+    r = SpearmanCorrcoef(approx="sketch", num_bins=16)
+    r.update(
+        jnp.asarray(np.array([0.1, np.nan, 0.5, 0.9], np.float32)),
+        jnp.asarray(np.array([0.2, 0.3, np.nan, 0.8], np.float32)),
+    )
+    assert int(np.asarray(r.joint.counts).sum()) == 2  # both NaN pairs dropped
+
+
 @pytest.mark.parametrize("dist", ("gauss", "cauchy", "anti"))
 @pytest.mark.parametrize("bins", [128, 512])
 def test_rank_sketch_error_envelope(dist, bins):
@@ -201,22 +251,26 @@ def test_rank_sketch_degenerate_input_is_nan():
 
 def test_roc_and_prc_curves_on_threshold_grid():
     """Sketch-mode ROC / PrecisionRecallCurve return (vals, vals, thresholds)
-    on the ascending bin-edge grid with the binned-curve conventions:
-    monotone-in-threshold counts, 0-where-undefined precision."""
+    on the ascending B + 1 grid (bin edges + terminal anchor) with the
+    binned-curve conventions: monotone-in-threshold counts,
+    0-where-undefined precision, and the curves END at their terminal
+    points — ROC at (0, 0), PR at (precision=1, recall=0)."""
     rng = np.random.RandomState(5)
     preds = jnp.asarray(rng.rand(500).astype(np.float32))
     target = jnp.asarray(rng.randint(0, 2, 500).astype(np.int32))
     roc = ROC(approx="sketch", num_bins=64)
     roc.update(preds, target)
     fpr, tpr, thr = roc.compute()
-    assert fpr.shape == tpr.shape == thr.shape == (64,)
+    assert fpr.shape == tpr.shape == thr.shape == (65,)
     assert np.all(np.diff(np.asarray(thr)) > 0)  # ascending threshold grid
     assert np.all(np.diff(np.asarray(tpr)) <= 1e-7)  # tpr falls as thr rises
+    assert float(fpr[-1]) == 0.0 and float(tpr[-1]) == 0.0  # (0, 0) anchor
     prc = PrecisionRecallCurve(approx="sketch", num_bins=64)
     prc.update(preds, target)
     precision, recall, thr2 = prc.compute()
     np.testing.assert_allclose(np.asarray(thr2), np.asarray(thr))
     assert np.all(np.asarray(precision) >= 0) and np.all(np.asarray(recall) <= 1)
+    assert float(precision[-1]) == 1.0 and float(recall[-1]) == 0.0  # endpoint
 
 
 def test_multiclass_curve_sketch_tracks_exact():
@@ -458,7 +512,7 @@ def test_curve_family_forms_one_compute_group():
     ref = AUROC(approx="sketch", num_bins=64)
     ref.update(preds, target)
     np.testing.assert_allclose(np.asarray(out["AUROC"]), np.asarray(ref.compute()))
-    assert out["ROC"][0].shape == (64,)
+    assert out["ROC"][0].shape == (65,)  # B + 1 grid points incl. terminal
 
     # different config must NOT fuse (the fingerprint is the sketch spec)
     col2 = MetricCollection([
