@@ -30,7 +30,12 @@ collection), and the hierarchical A/B ("gather_hier_ms" vs
 "gather_flat2d_ms": the same collection on the (4,2) ici x dcn test mesh,
 two-stage hierarchical plane vs flat world axis, with the per-crossing
 "hier_dcn_bytes"/"flat2d_world_bytes" traffic split) so BENCH_r* tracks the
-group/coalescing/hierarchy gains. The staged collective-count keys
+group/coalescing/hierarchy gains. The keyed-slab scenario
+("keyed_sync_ms"/"keyed_collective_calls"/"keyed_sync_bytes":
+Keyed(AUROC(approx="sketch"), num_slots=10,000) on the same (4,2) mesh)
+rides the default line too, with the cross-scenario keyed gate pinning that
+K=10,000 segments sync with the identical staged-collective count and kinds
+as the unkeyed metric (psum-only, zero gathers). The staged collective-count keys
 ("collective_calls", "sync_bytes", ...) ride the DEFAULT line — counting
 happens at trace time and costs nothing per step — so ``--check-trajectory``
 binds on every new round. ``--smoke`` runs a 2-step, no-reference version
@@ -99,6 +104,12 @@ HIER_SLICES = 2  # the (4,2) test mesh: 2 virtual "slices" x 4 ici devices
 # of the buffer plane's payload — the acceptance gate --check-collectives pins
 SKETCH_CURVE_BINS = 256  # (2, 256) int32 histogram = 2 KB
 SKETCH_RANK_BINS = 16  # (16, 16) int32 joint histogram = 1 KB
+# keyed-slab scenario: ONE sketch AUROC x 10,000 segments. The slab is a
+# (K, 2, KEYED_BINS) histogram plus a (K,) row-count slab, and the pinned
+# property is that the STAGED COLLECTIVE COUNT is identical to the unkeyed
+# metric's — segments scale the payload, never the program.
+KEYED_SLOTS = 10_000
+KEYED_BINS = 16
 
 
 def _collection_ours(compute_groups: bool = True):
@@ -378,6 +389,70 @@ def _build_sketch_sync_runner(hierarchical: bool = True):
     return run, len(state)
 
 
+def _build_keyed_sync_runner(num_slots: "int | None" = KEYED_SLOTS):
+    """(timed_run(steps) -> ms/step, states_synced) for the KEYED multi-
+    tenant scenario: ``Keyed(AUROC(approx="sketch"), num_slots=K)`` — one
+    metric x K segments as a leading state axis — synced per step with
+    ``coalesced_sync_state`` on the (4,2) ici x dcn mesh. The slab leaves
+    (a (K, 2, B) histogram slab + the (K,) row-count slab) fold into ONE
+    int32 sum bucket, so the staged program is the same two-stage psum the
+    unkeyed sketch metric stages: collective counts are K-INDEPENDENT
+    (``num_slots=None`` builds the unkeyed twin the cross-scenario keyed
+    gate compares against).
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from metrics_tpu import AUROC, Keyed
+    from metrics_tpu.parallel.placement import MeshHierarchy
+    from metrics_tpu.parallel.sync import coalesced_sync_state
+    from metrics_tpu.utils.compat import shard_map
+
+    inner = AUROC(approx="sketch", num_bins=KEYED_BINS)
+    metric = inner if num_slots is None else Keyed(inner, num_slots=num_slots)
+    rng = np.random.RandomState(0)
+    rows = GATHER_CAPACITY // 2  # same per-step traffic shape as the sketch A/B
+    preds = jnp.asarray(rng.rand(rows).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, rows).astype(np.int32))
+    if num_slots is None:
+        metric.update(preds, target)
+    else:
+        slots = jnp.asarray(rng.randint(0, num_slots, rows).astype(np.int32))
+        metric.update(preds, target, slot=slots)
+
+    state = metric._current_state()
+    reductions = metric._reductions
+    mesh = Mesh(
+        np.array(jax.devices("cpu")[:N_DEVICES]).reshape(HIER_SLICES, N_DEVICES // HIER_SLICES),
+        ("dcn", "ici"),
+    )
+    axis = MeshHierarchy(ici_axis="ici", dcn_axis="dcn")
+
+    def step(s, acc):
+        synced = coalesced_sync_state(s, reductions, axis)
+        # carry chains step i+1 on step i (see _build_gather_runner)
+        for leaf in jax.tree_util.tree_leaves(synced):
+            acc = acc + jnp.sum(leaf.astype(jnp.float32))
+        return acc
+
+    sharded_step = jax.jit(
+        shard_map(step, mesh=mesh, in_specs=(P(), P()), out_specs=P(), check_vma=False)
+    )
+
+    def run(steps: int) -> float:
+        acc = jnp.zeros((), jnp.float32)
+        start = time.perf_counter()
+        for _ in range(steps):
+            acc = sharded_step(state, acc)
+        jax.block_until_ready(acc)
+        return (time.perf_counter() - start) / steps * 1e3
+
+    return run, len(state)
+
+
 def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trace_path=None) -> dict:
     """Compute-groups on/off A/B over the same 8-device mesh program.
 
@@ -471,6 +546,19 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
         with (obs.span("bench.timed_sketch_sync") if obs else _null_cm()):
             sketch_times.append(run_sketch(steps))
 
+    # keyed A/B: Keyed(AUROC sketch) x 10,000 segments vs the unkeyed metric
+    # on the same (4,2) mesh — the headline is that the STAGED COLLECTIVE
+    # COUNT does not move with K (the unkeyed twin is traced for its
+    # counters only; timing one side is enough for the ms trajectory)
+    run_keyed, states_keyed, keyed_counters = build(
+        _build_keyed_sync_runner, KEYED_SLOTS, "keyed_sync"
+    )
+    _, _, keyed_unkeyed_counters = build(_build_keyed_sync_runner, None, "keyed_unkeyed")
+    keyed_times = []
+    for _ in range(repeats):
+        with (obs.span("bench.timed_keyed_sync") if obs else _null_cm()):
+            keyed_times.append(run_keyed(steps))
+
     out = {
         "grouped_sync8_ms": grouped_ms,
         "ungrouped_sync8_ms": ungrouped_ms,
@@ -511,6 +599,17 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
             sketch_counters["calls_by_kind"].get(k, 0)
             for k in ("all_gather", "coalesced_gather", "process_allgather")
         ),
+        # the keyed slab plane: K=10,000 segments sync with the SAME staged
+        # program shape as the unkeyed metric (psum-only, count pinned equal)
+        "keyed_sync_ms": min(keyed_times),
+        "keyed_states_synced": states_keyed,
+        "keyed_collective_calls": keyed_counters["collective_calls"],
+        "keyed_sync_bytes": keyed_counters["sync_bytes"],
+        "keyed_gather_calls": sum(
+            keyed_counters["calls_by_kind"].get(k, 0)
+            for k in ("all_gather", "coalesced_gather", "process_allgather")
+        ),
+        "keyed_unkeyed_collective_calls": keyed_unkeyed_counters["collective_calls"],
     }
     # fault counters ride the default line, pinned at ZERO: a clean bench run
     # that retries, degrades, or quarantines anything is a regression
@@ -531,14 +630,16 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
             finally:
                 devtime_mod.disable()
 
-        # v4: the sketch A/B joined (psum-only sketch plane keys on the
-        # default line, full sketch counters here); v3 moved the collective
-        # counts to the default line and added the hierarchical A/B
-        out["trace_schema"] = 4
+        # v5: the keyed slab A/B joined (K-independent staged-collective keys
+        # on the default line, full keyed counters here); v4 added the sketch
+        # A/B; v3 moved the collective counts to the default line and added
+        # the hierarchical A/B
+        out["trace_schema"] = 5
         out["counters"] = grouped_counters
         out["gather_counters"] = coal_counters
         out["hier_counters"] = hier_counters
         out["sketch_counters"] = sketch_counters
+        out["keyed_counters"] = keyed_counters
         summary = obs.summarize()
         out["phase_ms"] = {
             name: round(row["total_ms"], 3) for name, row in sorted(summary.items())
@@ -858,10 +959,17 @@ _TRACE_KEYS = (
     "sketch_sync_bytes",
     "sketch_dcn_bytes",
     "sketch_gather_calls",
+    "keyed_sync_ms",
+    "keyed_states_synced",
+    "keyed_collective_calls",
+    "keyed_sync_bytes",
+    "keyed_gather_calls",
+    "keyed_unkeyed_collective_calls",
     "counters",
     "gather_counters",
     "hier_counters",
     "sketch_counters",
+    "keyed_counters",
     "phase_ms",
     "phase_compile_ms",
     "device_ms",
@@ -901,10 +1009,26 @@ _TRACE_KEYS = (
 # ring traffic (payload x (participants - 1), see observability.counters):
 # the flat planes burn W-1 = 7 DCN-crossing hops per payload byte, the
 # two-stage planes S-1 = 1 — the structural win --check-collectives pins.
+# keyed plane (Keyed(AUROC sketch, K=10,000) vs the unkeyed metric on the
+#   same (4,2) mesh): the (K, 2, 16) histogram slab + the (K,) row-count
+#   slab fold into ONE int32 sum bucket — the staged program is the SAME
+#   two-stage psum (1 ici + 1 dcn call) the unkeyed metric stages; only the
+#   payload scales with K ((10000*2*16 + 10000) * 4 bytes per stage). The
+#   cross-scenario KEYED GATE below pins the K-independence: equal staged
+#   collective counts and kinds at K=10,000 and K=1 (psum-only, zero
+#   gathers).
 EXPECTED_COLLECTIVES = {
     "sketch_sync": {
         "collective_calls": 2, "sync_bytes": 6144, "gather_calls": 0,
         "dcn_calls": 1, "dcn_bytes": 3072, "ici_calls": 1, "ici_bytes": 9216,
+    },
+    "keyed_sync": {
+        "collective_calls": 2, "sync_bytes": 2640000, "gather_calls": 0,
+        "dcn_calls": 1, "dcn_bytes": 1320000, "ici_calls": 1, "ici_bytes": 3960000,
+    },
+    "keyed_unkeyed": {
+        "collective_calls": 2, "sync_bytes": 256, "gather_calls": 0,
+        "dcn_calls": 1, "dcn_bytes": 128, "ici_calls": 1, "ici_bytes": 384,
     },
     "sum_grouped": {"collective_calls": 1, "sync_bytes": 520},
     "sum_ungrouped": {"collective_calls": 1, "sync_bytes": 1544},
@@ -1041,6 +1165,8 @@ def check_collectives() -> int:
 
     builders = {
         "sketch_sync": lambda: _build_sketch_sync_runner(True),
+        "keyed_sync": lambda: _build_keyed_sync_runner(KEYED_SLOTS),
+        "keyed_unkeyed": lambda: _build_keyed_sync_runner(None),
         "sum_grouped": lambda: _build_sync8_runner(True),
         "sum_ungrouped": lambda: _build_sync8_runner(False),
         "gather_coalesced": lambda: _build_gather_runner(True),
@@ -1121,12 +1247,40 @@ def check_collectives() -> int:
             f"sketch gate: sketch sync bytes {sketch_bytes} not under 10% of the"
             f" buffer plane's {buffer_bytes} on the same mesh"
         )
+
+    # the keyed gate of record: K=10,000 segments sync with the IDENTICAL
+    # staged-collective count and kinds as the unkeyed metric (psum-only,
+    # zero gathers of any kind) — segments are a leading state axis, never
+    # extra collectives, which is the whole point of the slab design
+    keyed_calls = report["keyed_sync"]["collective_calls"]
+    unkeyed_calls = report["keyed_unkeyed"]["collective_calls"]
+    keyed_gathers = report["keyed_sync"]["gather_calls"]
+    keyed_gate = {
+        "keyed_collective_calls": keyed_calls,
+        "unkeyed_collective_calls": unkeyed_calls,
+        "keyed_gather_calls": keyed_gathers,
+        "num_slots": KEYED_SLOTS,
+        "ok": keyed_calls == unkeyed_calls and keyed_gathers == 0
+        and report["keyed_unkeyed"]["gather_calls"] == 0,
+    }
+    if keyed_calls != unkeyed_calls:
+        failures.append(
+            f"keyed gate: K={KEYED_SLOTS} staged {keyed_calls} collectives vs the"
+            f" unkeyed metric's {unkeyed_calls} — collective counts must be"
+            " K-independent"
+        )
+    if keyed_gathers != 0:
+        failures.append(
+            f"keyed gate: keyed_sync staged {keyed_gathers} gather collectives"
+            " (the slab plane must be psum-only)"
+        )
     print(json.dumps({
         "check": "collectives",
         "ok": not failures,
         "failures": failures,
         "hier_gate": hier_gate,
         "sketch_gate": sketch_gate,
+        "keyed_gate": keyed_gate,
         "scenarios": report,
     }))
     return 1 if failures else 0

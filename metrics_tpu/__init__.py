@@ -120,6 +120,7 @@ from metrics_tpu.clustering import (  # noqa: E402
 from metrics_tpu.wrappers import (  # noqa: E402
     BootStrapper,
     ClasswiseWrapper,
+    Keyed,
     MetricTracker,
     MinMaxMetric,
     MultioutputWrapper,

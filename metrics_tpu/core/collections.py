@@ -948,7 +948,10 @@ class MetricCollection(OrderedDict):
         PaddedBuffer bucket (counts bitcast into the data payload for
         4-byte dtypes) — a buffer-state collection (AUROC +
         AveragePrecision + Spearman) stages 1 gather per dtype instead of
-        2 per buffer. Pass a ``parallel.placement.MeshHierarchy`` as
+        2 per buffer. Sketch states and keyed ``(K, *shape)`` slab states
+        (``wrappers/keyed.py``) are ordinary reduce-bucket leaves here, so
+        a 10,000-segment member adds payload to an existing bucket, never a
+        collective. Pass a ``parallel.placement.MeshHierarchy`` as
         ``axis_name`` on a 2-level (ici x dcn) mesh to stage every bucket
         hierarchically (only per-slice payloads cross DCN)."""
         from metrics_tpu.parallel.sync import coalesced_sync_state

@@ -52,6 +52,7 @@ from metrics_tpu.observability.devtime import DEVTIME as _DEVTIME, fence as _fen
 from metrics_tpu.observability.trace import TRACE, span as _span
 from metrics_tpu.parallel.buffer import PaddedBuffer, buffer_append, buffer_init
 from metrics_tpu.parallel.sketch import SketchSpec, is_sketch, sketch_init
+from metrics_tpu.parallel.slab import SlabSpec, slab_init, slab_sync_reduce
 from metrics_tpu.utils import compat, debug
 from metrics_tpu.utils.data import is_concrete
 from metrics_tpu.utils.exceptions import StateCorruptionError, TracingUnsupportedError
@@ -165,6 +166,7 @@ _NON_TRACE_ATTRS = frozenset({
     "_computed", "_forward_cache", "_jitted_step", "_jitted_step_fc",
     "_jitted_scan", "_scan_failed",
     "_jit_failed", "_fc_failed", "_compute_jit_failed", "_count_bound", "_overflow_warned",
+    "_metric_label",
     "_epoch_watermark", "check_finite",
     "_default_keys",
     "_to_sync", "_in_forward", "_sync_count", "dist_sync_fn",
@@ -262,6 +264,14 @@ def _fingerprint_value(v: Any, pins: list) -> Any:
         return ("bufspec", v.capacity, v.item_shape, str(v.dtype))
     if isinstance(v, SketchSpec):
         return ("sketchspec", v.kind, v.shape, str(jnp.dtype(v.dtype)), v.lo, v.hi)
+    if isinstance(v, SlabSpec):
+        # slab shapes are first-class fingerprint material: two slab metrics
+        # share a compiled step / compute-group key only on equal (kind, K,
+        # row schema, reduce, fill template)
+        return (
+            "slabspec", v.kind, v.num_slots, v.item_shape, str(jnp.dtype(v.dtype)),
+            v.reduce, v.fill,
+        )
     if callable(v) or isinstance(v, type):
         pins.append(v)  # the cache entry pins this object -> id stays live
         return ("fn", id(v))
@@ -404,7 +414,29 @@ class Metric(ABC):
         ``HistogramSketch``/``RankSketch``, its shape is traffic-independent,
         merge is bit-exact integer addition, and sync rides the existing
         per-dtype sum-psum buckets (``dist_reduce_fx`` must be ``"sum"``).
+
+        Or a :class:`~metrics_tpu.parallel.slab.SlabSpec` — the KEYED SLAB
+        state kind (one row per segment slot, see ``wrappers/keyed.py``):
+        the state materializes as a ``(K, *item_shape)`` array (or a sketch
+        whose counts grow the leading K axis), and ``dist_reduce_fx`` must be
+        the spec's sync reduction (``slab_sync_reduce``: ``sum`` for
+        sum/mean/sketch slabs, ``min``/``max`` pass through) so merge and
+        sync ride the existing reduce buckets — one psum moves all K
+        segments.
         """
+        if isinstance(default, SlabSpec):
+            expected = slab_sync_reduce(default.reduce)
+            if dist_reduce_fx != expected:
+                raise ValueError(
+                    f"a {default.reduce!r}-kind slab state syncs through the"
+                    f" {expected!r} bucket plane; declare it with"
+                    f" dist_reduce_fx={expected!r} (got {dist_reduce_fx!r})"
+                )
+            self._defaults[name] = default
+            self._persistent[name] = persistent
+            self._reductions[name] = expected
+            setattr(self, name, slab_init(default))
+            return
         if isinstance(default, SketchSpec):
             if dist_reduce_fx != "sum":
                 raise ValueError(
@@ -444,6 +476,8 @@ class Metric(ABC):
             return buffer_init(spec.capacity, spec.item_shape, spec.dtype)
         if isinstance(spec, SketchSpec):
             return sketch_init(spec)
+        if isinstance(spec, SlabSpec):
+            return slab_init(spec)
         if isinstance(spec, list):
             return []
         # identical templates share one transferred device constant, and each
@@ -538,6 +572,8 @@ class Metric(ABC):
             return buffer_init(spec.capacity, spec.item_shape, spec.dtype)
         if isinstance(spec, SketchSpec):
             return sketch_init(spec)  # zeros: stage as compile-time constants
+        if isinstance(spec, SlabSpec):
+            return slab_init(spec)  # zeros / host-template broadcasts: staged
         if isinstance(spec, list):
             return []
         return jnp.asarray(spec)  # numpy spec -> host-backed staged constant
@@ -677,6 +713,19 @@ class Metric(ABC):
     # must stay OFF this list — that is the whole point of grouping.
     _GROUP_UPDATE_ATTRS: Optional[tuple] = None
 
+    # The EXCLUSION form of the same opt-in: a class (or shared base, e.g.
+    # ``RetrievalMetric``) declares the attrs that are COMPUTE-ONLY, and the
+    # update-relevant config is derived as every fingerprintable instance
+    # attr EXCEPT those, the registered states, and the non-trace bookkeeping
+    # attrs. This is the safer default for metric families sharing one base
+    # update: a subclass that adds update-relevant config is automatically
+    # included in the key (conservatively splitting groups), and only a
+    # deliberately-listed compute-only attr (``k``, a policy flag) is
+    # excluded — new metrics opt out declaratively instead of re-declaring
+    # ``_GROUP_UPDATE_ATTRS = ()`` per class. ``_GROUP_UPDATE_ATTRS`` wins
+    # when both are set.
+    _GROUP_COMPUTE_ONLY_ATTRS: Optional[tuple] = None
+
     def _group_fingerprint(self) -> Optional[Any]:
         """Hashable identity of this metric's update+state plane, or None.
 
@@ -686,10 +735,17 @@ class Metric(ABC):
         ``MetricCollection`` one shared update delta serves them all, and
         each member only needs its own ``compute``. ``F1``, ``Precision``,
         ``Recall`` and ``Specificity`` with matching config all reduce to
-        one ``StatScores`` group this way.
+        one ``StatScores`` group this way; the whole retrieval family
+        reduces to one flatten-append group via the exclusion declaration.
+
+        The state schema covers every declared kind — array templates,
+        buffer specs, sketch specs, and slab specs (``SlabSpec``: slot
+        count, row shape, per-slot reduce), so keyed slab states group
+        soundly out of the box.
         """
         attrs = type(self)._GROUP_UPDATE_ATTRS
-        if attrs is None:
+        excluded = type(self)._GROUP_COMPUTE_ONLY_ATTRS
+        if attrs is None and excluded is None:
             return None
         update_fn = next(
             (vars(klass)["update"] for klass in type(self).__mro__ if "update" in vars(klass)), None
@@ -698,10 +754,19 @@ class Metric(ABC):
             return None
         pins: list = []  # keys are compared between live siblings only; no pinning needed
         try:
-            config = tuple(
-                (a, _fingerprint_value(getattr(self, a, None), pins))
-                for a in (*attrs, "capacity")
-            )
+            if attrs is not None:
+                config = tuple(
+                    (a, _fingerprint_value(getattr(self, a, None), pins))
+                    for a in (*attrs, "capacity")
+                )
+            else:
+                config = tuple(
+                    (k, _fingerprint_value(v, pins))
+                    for k, v in sorted(vars(self).items())
+                    if k not in _NON_TRACE_ATTRS
+                    and k not in self._defaults
+                    and k not in excluded
+                )
             schema = tuple(
                 (name, _fingerprint_value(self._defaults[name], pins),
                  _fingerprint_value(self._reductions[name], pins))
@@ -1078,11 +1143,23 @@ class Metric(ABC):
         if not _COUNTERS.enabled and span is None:
             return
         nbytes = state_nbytes(self._current_state())
-        record_state_bytes(type(self).__name__, nbytes)
+        # wrappers override the label to keep gauges attributable (e.g.
+        # ``Keyed(AUROC)`` rather than a bare ``Keyed`` for every inner kind)
+        record_state_bytes(getattr(self, "_metric_label", type(self).__name__), nbytes)
         if span is not None and getattr(span, "attrs", None) is not None:
             span.attrs["state_bytes"] = nbytes
 
     # -------------------------------------------------- state-integrity guard
+    def _integrity_state(self) -> State:
+        """The state view the ``check_finite`` scan runs over.
+
+        Default: the current state verbatim. States whose legitimate resting
+        values would false-positive the scan override this — e.g. ``Keyed``
+        masks never-touched slab slots, whose min/max identity fills sit at
+        the dtype extremes the saturation scan watches for.
+        """
+        return self._current_state()
+
     def _pre_update_snapshot(self) -> Optional[State]:
         """Pre-update state refs, captured only under the quarantine policy
         (jax arrays are immutable, so holding the refs is free)."""
@@ -1103,7 +1180,7 @@ class Metric(ABC):
         policy = self.check_finite
         if not policy or self._under_trace():
             return
-        state = self._current_state()
+        state = self._integrity_state()
         if any(isinstance(v, list) for v in state.values()):
             # eager list states: scan the concrete elements, not the pytree
             state = {
@@ -1420,8 +1497,11 @@ class Metric(ABC):
                 elif isinstance(value, dict) and set(value) == {"sketch_counts"}:
                     spec = self._defaults[key]
                     kind = type(getattr(self, key)) if is_sketch(getattr(self, key, None)) else None
-                    if kind is None and isinstance(spec, SketchSpec):
-                        kind = type(sketch_init(spec))
+                    if kind is None and isinstance(spec, (SketchSpec, SlabSpec)):
+                        materialized = (
+                            sketch_init(spec) if isinstance(spec, SketchSpec) else slab_init(spec)
+                        )
+                        kind = type(materialized) if is_sketch(materialized) else None
                     if kind is None:
                         raise ValueError(
                             f"checkpoint entry '{key}' holds sketch counts but the state is not a sketch"

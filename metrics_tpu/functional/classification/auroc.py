@@ -18,7 +18,7 @@ from metrics_tpu.functional.classification.curve_static import binary_auroc_stat
 from metrics_tpu.utils.checks import _input_format_classification, defer_or_run_value_check, deferred_value_checks
 from metrics_tpu.utils.data import in_tracing_context
 from metrics_tpu.utils.enums import AverageMethod, DataType
-from metrics_tpu.utils.prints import rank_zero_warn
+from metrics_tpu.utils.prints import rank_zero_warn, rank_zero_warn_once
 
 
 def _check_pos_neg_eager(y: Array) -> None:
@@ -85,7 +85,7 @@ def _binary_setup(preds: Array, target: Array, pos_label, validate: bool):
     """The shared binary preamble: pos_label default (+warn), (rows, 1)
     squeeze, 0/1 target, eager reference value checks."""
     if pos_label is None:
-        rank_zero_warn("`pos_label` automatically set 1.")
+        rank_zero_warn_once("`pos_label` automatically set 1.")
         pos_label = 1
     p = preds[:, 0] if preds.ndim > target.ndim else preds
     y = (target == pos_label).astype(jnp.int32)
@@ -150,7 +150,7 @@ def _auroc_compute(
                 auc_scores = _auroc_class_scores(preds, target, "multilabel", 1, sample_weights, validate)
             else:
                 if pos_label is not None:
-                    rank_zero_warn(
+                    rank_zero_warn_once(
                         "Argument `pos_label` should be `None` when running"
                         f" multiclass AUROC. Got {pos_label}"
                     )

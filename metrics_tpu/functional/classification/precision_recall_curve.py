@@ -18,7 +18,7 @@ from typing import List, Optional, Sequence, Tuple, Union
 import jax.numpy as jnp
 from jax import Array
 
-from metrics_tpu.utils.prints import rank_zero_warn
+from metrics_tpu.utils.prints import rank_zero_warn, rank_zero_warn_once
 
 
 def _binary_clf_curve(
@@ -77,7 +77,7 @@ def _precision_recall_curve_update(
 
     if preds.ndim == target.ndim:
         if pos_label is None:
-            rank_zero_warn("`pos_label` automatically set 1.")
+            rank_zero_warn_once("`pos_label` automatically set 1.")
             pos_label = 1
         if num_classes is not None and num_classes != 1:
             # multilabel problem
@@ -98,7 +98,7 @@ def _precision_recall_curve_update(
     if preds.ndim == target.ndim + 1:
         # multi class problem
         if pos_label is not None:
-            rank_zero_warn(
+            rank_zero_warn_once(
                 "Argument `pos_label` should be `None` when running"
                 f" multiclass precision recall curve. Got {pos_label}"
             )

@@ -10,7 +10,7 @@ import numpy as np
 from jax import Array
 
 from metrics_tpu.utils.data import upcast_accum
-from metrics_tpu.utils.prints import rank_zero_warn
+from metrics_tpu.utils.prints import rank_zero_warn_once
 from metrics_tpu.utils.reductions import reduce
 
 
@@ -67,7 +67,7 @@ def psnr(
         2.5527
     """
     if dim is None and reduction != "elementwise_mean":
-        rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
+        rank_zero_warn_once(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
 
     if data_range is None:
         if dim is not None:

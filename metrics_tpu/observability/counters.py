@@ -51,8 +51,18 @@ What gets counted, and on which plane:
   update/sync while counting is enabled. This is how the sketch-vs-buffer
   memory story is a measured number: an ``AUROC(capacity=2**20)`` gauge
   grows with traffic, an ``AUROC(approx="sketch")`` gauge is a constant
-  ``2 * num_bins * 4`` bytes forever. Present in every snapshot;
-  ``export.summarize()`` surfaces the same number as a per-span column.
+  ``2 * num_bins * 4`` bytes forever. Keyed slab wrappers report under a
+  ``Keyed(<inner>)`` label so per-slab footprints stay attributable.
+  Present in every snapshot; ``export.summarize()`` surfaces the same
+  number as a per-span column.
+- **slab_slots**: per-slab slot GAUGES for the keyed multi-tenant wrappers
+  (``wrappers/keyed.py``): ``{label: {"slots": K, "occupied": n,
+  "evictions": e}}``. Occupancy says how much of the provisioned K is
+  live; the eviction count is the signal that an LRU-mapped key space is
+  thrashing its slot table (raise ``num_slots``). Refreshed after every
+  eager keyed update while counting is enabled; the non-LRU path derives
+  occupancy from the slot ids (a readback), so it too only pays while
+  counting is on.
 
 Counting is off by default; the disabled path is one attribute load and a
 falsy branch per call site. All mutation happens under one lock — counter
@@ -73,6 +83,7 @@ __all__ = [
     "record_collective",
     "record_fault",
     "record_gather_skip",
+    "record_slab_slots",
     "record_state_bytes",
     "record_states_synced",
     "reset",
@@ -127,6 +138,7 @@ class CollectiveCounters:
         "faults",
         "gather_skips",
         "state_bytes",
+        "slab_slots",
         "_lock",
     )
 
@@ -150,6 +162,7 @@ class CollectiveCounters:
         self.faults: Dict[str, int] = {k: 0 for k in FAULT_KINDS}
         self.gather_skips = 0
         self.state_bytes: Dict[str, int] = {}  # metric class name -> latest bytes
+        self.slab_slots: Dict[str, Dict[str, int]] = {}  # keyed-slab label -> gauges
 
     # ---------------------------------------------------------- recording
     def record_collective(
@@ -205,6 +218,16 @@ class CollectiveCounters:
         with self._lock:
             self.state_bytes[metric] = int(nbytes)
 
+    def record_slab_slots(self, label: str, slots: int, occupied: int, evictions: int) -> None:
+        """Refresh one keyed slab's slot gauges (latest value wins; the
+        eviction count is the LRU table's lifetime total, itself a gauge)."""
+        with self._lock:
+            self.slab_slots[label] = {
+                "slots": int(slots),
+                "occupied": int(occupied),
+                "evictions": int(evictions),
+            }
+
     # ------------------------------------------------------------ reading
     def snapshot(self) -> Dict[str, Any]:
         """A JSON-ready copy of every counter.
@@ -227,6 +250,7 @@ class CollectiveCounters:
                 "faults": dict(self.faults),
                 "gather_skips": self.gather_skips,
                 "state_bytes": dict(sorted(self.state_bytes.items())),
+                "slab_slots": {k: dict(v) for k, v in sorted(self.slab_slots.items())},
                 "group_cache": {"hits": self.group_cache_hits, "misses": self.group_cache_misses},
                 "step_cache": {"hits": self.step_cache_hits, "misses": self.step_cache_misses},
                 "launch_cache": {"hits": self.launch_cache_hits, "misses": self.launch_cache_misses},
@@ -274,6 +298,11 @@ def record_gather_skip() -> None:
 def record_state_bytes(metric: str, nbytes: int) -> None:
     if COUNTERS.enabled:
         COUNTERS.record_state_bytes(metric, nbytes)
+
+
+def record_slab_slots(label: str, slots: int, occupied: int, evictions: int) -> None:
+    if COUNTERS.enabled:
+        COUNTERS.record_slab_slots(label, slots, occupied, evictions)
 
 
 def state_nbytes(state: Any) -> int:

@@ -53,6 +53,7 @@ MS_KEYS: Tuple[str, ...] = (
     "gather_hier_ms",
     "gather_flat2d_ms",
     "sketch_sync_ms",
+    "keyed_sync_ms",
 )
 
 # staged-collective keys gated exactly (no growth) vs the latest prior round
@@ -82,6 +83,14 @@ COUNT_KEYS: Tuple[str, ...] = (
     "sketch_dcn_bytes",
     "sketch_gather_calls",
     "sketch_states_synced",
+    # the keyed slab plane: staged counts must stay K-independent (equal to
+    # the unkeyed metric's) and psum-only; any growth is a regression of the
+    # segments-as-a-state-axis story
+    "keyed_collective_calls",
+    "keyed_sync_bytes",
+    "keyed_gather_calls",
+    "keyed_states_synced",
+    "keyed_unkeyed_collective_calls",
 )
 
 # fault counters: bound at exactly zero whenever the current line carries

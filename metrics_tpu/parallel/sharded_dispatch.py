@@ -203,9 +203,9 @@ def _warn_gather_fallback(metric: Any, reason: str, *states: Any) -> None:
     the O(dataset)-per-device behavior the placement opted out of."""
     if _shared_info(*states) is None:
         return
-    from metrics_tpu.utils.prints import rank_zero_warn
+    from metrics_tpu.utils.prints import rank_zero_warn_once
 
-    rank_zero_warn(
+    rank_zero_warn_once(
         f"{type(metric).__name__}: row-sharded epoch states fall back to the"
         f" gathered compute path ({reason}); every device will materialize the"
         " full epoch."
@@ -295,9 +295,9 @@ def _default_pos_label(metric: Any) -> int:
     """The gather path's binary pos_label defaulting (warn + 1)."""
     pos_label = metric.pos_label
     if pos_label is None:
-        from metrics_tpu.utils.prints import rank_zero_warn
+        from metrics_tpu.utils.prints import rank_zero_warn_once
 
-        rank_zero_warn("`pos_label` automatically set 1.")
+        rank_zero_warn_once("`pos_label` automatically set 1.")
         pos_label = 1
     return pos_label
 
@@ -346,7 +346,7 @@ def auroc_sharded(metric: Any) -> Optional[Array]:
     Degenerate classes yield ``nan`` (the static-kernel convention; the
     eager value checks cannot run inside the collective program)."""
     from metrics_tpu.utils.enums import AverageMethod, DataType
-    from metrics_tpu.utils.prints import rank_zero_warn
+    from metrics_tpu.utils.prints import rank_zero_warn_once
 
     plan = auroc_applicable(metric)
     if plan is None:
@@ -394,7 +394,7 @@ def auroc_sharded(metric: Any) -> Optional[Array]:
 
     columns = "multilabel" if metric.mode == DataType.MULTILABEL else "labels"
     if columns == "labels" and metric.pos_label is not None:
-        rank_zero_warn(
+        rank_zero_warn_once(
             "Argument `pos_label` should be `None` when running"
             f" multiclass AUROC. Got {metric.pos_label}"
         )

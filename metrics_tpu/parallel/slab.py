@@ -1,0 +1,293 @@
+"""Keyed multi-tenant metric slabs: one metric x thousands of segments.
+
+Production serving rarely wants one global number — it wants AUROC per model
+version x cohort x language x A/B arm. The wrapper-level answer
+(``wrappers/classwise.py``, ``wrappers/multioutput.py``) clones whole
+``Metric`` modules per segment, which multiplies compiled steps, state
+pytrees, and sync collectives by K. This module provides the state-level
+answer: segments become a LEADING STATE AXIS.
+
+A *slab* is a ``(K, *inner_shape)`` state — one row per segment slot — whose
+per-slot semantics are the inner metric's ordinary reduce kind:
+
+- ``sum``/``mean``-kind rows accumulate by addition (``mean`` is stored
+  sum-backed and divided by the per-slot sample count at compute time);
+- ``min``/``max`` rows accumulate by elementwise min/max;
+- sketch states (:class:`~metrics_tpu.parallel.sketch.HistogramSketch` /
+  ``RankSketch``) keep their own type with a leading ``(K, ...)`` counts
+  axis, so PR 7's constant-memory curve/rank metrics become per-segment for
+  free.
+
+``update(..., slot=segment_ids)`` is ONE ``segment_sum``-style scatter of the
+inner metric's per-sample deltas (:func:`slab_scatter`), ``compute()`` vmaps
+the inner finisher over the slab, and — the point of the design — sync rides
+the existing per-dtype coalesced buckets of
+:func:`~metrics_tpu.parallel.sync.coalesced_sync_state` UNCHANGED: a slab is
+a plain array (or sketch) leaf with a ``sum``/``min``/``max`` reduction, so
+one bucketed ``psum`` moves all K segments, flat and hierarchical, with zero
+new collective kinds. Collective counts are K-independent by construction
+(``bench.py --check-collectives`` pins it).
+
+:class:`SlabSpec` is the host-side state declaration ``Metric.add_state``
+materializes (the slab analogue of ``_BufferSpec``/``SketchSpec``);
+:class:`LRUSlotTable` maps open-ended key spaces (user ids, experiment arms)
+onto the fixed K slots with least-recently-used eviction. The user-facing
+wrapper is :class:`metrics_tpu.wrappers.keyed.Keyed`.
+"""
+from collections import OrderedDict
+from typing import Any, Hashable, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.parallel.sketch import HistogramSketch, RankSketch
+
+__all__ = [
+    "LRUSlotTable",
+    "SLAB_REDUCES",
+    "SlabSpec",
+    "is_slab_spec",
+    "make_slab_spec",
+    "slab_init",
+    "slab_merge",
+    "slab_rows_spec",
+    "slab_scatter",
+    "slab_sync_reduce",
+]
+
+# per-slot reduce kinds a slab row supports. "mean" is SUM-BACKED: the slab
+# stores the running sum of per-sample deltas and the finisher divides by the
+# per-slot row count — which is what lets a mean-kind slab merge by addition
+# and sync through the same bucketed psum as every sum leaf.
+SLAB_REDUCES = ("sum", "mean", "min", "max")
+
+_SKETCH_KINDS = {"hist": HistogramSketch, "rank": RankSketch}
+
+
+class SlabSpec(NamedTuple):
+    """Host-side slab state declaration (recorded in ``Metric._defaults``).
+
+    ``kind``: ``"array"`` for a plain ``(K, *item_shape)`` slab, or
+    ``"hist"``/``"rank"`` for a sketch slab (counts grow the leading K axis).
+    ``reduce`` is the PER-SLOT reduce kind (one of :data:`SLAB_REDUCES`;
+    sketches are always ``"sum"``). ``fill`` is the inner metric's per-slot
+    default template (host numpy), broadcast to every row at init — for
+    ``min``/``max`` rows this preserves the inner default's clamping
+    semantics exactly (min/max are idempotent, so re-including the default
+    per batch changes nothing); ``sum``/``mean`` rows require a zero
+    template (a nonzero additive default would be re-added once per SAMPLE
+    instead of once per batch). Pure config: materialization is
+    :func:`slab_init`, and the spec is fingerprintable so slab metrics can
+    share compiled steps and compute-group keys.
+    """
+
+    kind: str
+    num_slots: int
+    item_shape: Tuple[int, ...]
+    dtype: Any
+    reduce: str
+    fill: Optional[bytes] = None  # raveled template bytes (hashable; None = zeros)
+
+    @property
+    def row_shape(self) -> Tuple[int, ...]:
+        return (self.num_slots, *self.item_shape)
+
+    def fill_template(self) -> np.ndarray:
+        """The per-slot init template as host numpy."""
+        if self.fill is None:
+            return np.zeros(self.item_shape, dtype=np.dtype(self.dtype))
+        return np.frombuffer(self.fill, dtype=np.dtype(self.dtype)).reshape(self.item_shape)
+
+
+def is_slab_spec(value: Any) -> bool:
+    return isinstance(value, SlabSpec)
+
+
+def make_slab_spec(
+    num_slots: int,
+    template: np.ndarray,
+    reduce: str,
+    kind: str = "array",
+) -> SlabSpec:
+    """Validate and build one :class:`SlabSpec` from the inner state's host
+    template. Sum/mean templates must be zero (see the class docstring)."""
+    if kind not in ("array", "hist", "rank"):
+        raise ValueError(f"slab kind must be 'array', 'hist' or 'rank', got {kind!r}")
+    if reduce not in SLAB_REDUCES:
+        raise ValueError(f"slab reduce must be one of {SLAB_REDUCES}, got {reduce!r}")
+    if not isinstance(num_slots, int) or num_slots < 1:
+        raise ValueError(f"`num_slots` must be a positive int, got {num_slots!r}")
+    template = np.asarray(template)
+    fill: Optional[bytes] = None
+    if reduce in ("sum", "mean") or kind in ("hist", "rank"):
+        if np.any(template != 0):
+            raise ValueError(
+                f"a {reduce!r}-kind slab needs a zero default template (the per-sample"
+                " scatter would re-add a nonzero default once per sample); got a"
+                " nonzero template"
+            )
+    elif np.any(template != 0):
+        fill = template.tobytes()
+    return SlabSpec(kind, num_slots, tuple(template.shape), template.dtype, reduce, fill)
+
+
+def slab_rows_spec(num_slots: int, dtype: Any = None) -> SlabSpec:
+    """The per-slot sample-count slab every ``Keyed`` wrapper carries: a
+    ``(K,)`` sum slab backing occupancy masks (empty-slot policy) and the
+    sum-backed mean division."""
+    if dtype is None:
+        from metrics_tpu.utils.data import accum_int_dtype
+
+        dtype = accum_int_dtype()
+    return SlabSpec("array", num_slots, (), np.dtype(dtype), "sum", None)
+
+
+def slab_init(spec: SlabSpec):
+    """Fresh slab for ``spec`` (jit-safe: zeros and host-template broadcasts
+    stage as compile-time constants under tracing)."""
+    if spec.kind in _SKETCH_KINDS:
+        return _SKETCH_KINDS[spec.kind](jnp.zeros(spec.row_shape, dtype=spec.dtype))
+    if spec.fill is None:
+        return jnp.zeros(spec.row_shape, dtype=spec.dtype)
+    template = jnp.asarray(spec.fill_template())
+    return jnp.broadcast_to(template[None], spec.row_shape) + jnp.zeros((), dtype=spec.dtype)
+
+
+def slab_scatter(reduce: str, deltas: Array, slot_ids: Array, num_slots: int) -> Array:
+    """``(N, *s)`` per-sample deltas -> ``(K, *s)`` per-slot reduction: the
+    one-scatter update plane of every slab state.
+
+    ``sum``/``mean`` rows scatter-add (``jax.ops.segment_sum``); ``min``/
+    ``max`` rows scatter-min/max, whose empty segments come back as the
+    reduce identity (+-inf / iinfo extremes) and therefore vanish in the
+    merge with the accumulator. Out-of-range slot ids (negative or >= K) are
+    DROPPED — XLA scatter out-of-bounds semantics, documented and tested, so
+    a bad segment id can never corrupt another segment's row.
+    """
+    if reduce in ("sum", "mean"):
+        return jax.ops.segment_sum(deltas, slot_ids, num_segments=num_slots)
+    if reduce == "min":
+        return jax.ops.segment_min(deltas, slot_ids, num_segments=num_slots)
+    if reduce == "max":
+        return jax.ops.segment_max(deltas, slot_ids, num_segments=num_slots)
+    raise ValueError(f"slab reduce must be one of {SLAB_REDUCES}, got {reduce!r}")
+
+
+def slab_merge(reduce: str, acc: Array, delta: Array) -> Array:
+    """Pairwise slab merge under the per-slot reduce kind (mean is
+    sum-backed, so it adds). Identity rows from :func:`slab_scatter`'s empty
+    segments are absorbed: ``min(acc, +inf) == acc``."""
+    if reduce in ("sum", "mean"):
+        return acc + delta
+    if reduce == "min":
+        return jnp.minimum(acc, delta)
+    if reduce == "max":
+        return jnp.maximum(acc, delta)
+    raise ValueError(f"slab reduce must be one of {SLAB_REDUCES}, got {reduce!r}")
+
+
+def slab_sync_reduce(reduce: str) -> str:
+    """The ``dist_reduce_fx`` a slab state registers: mean folds into sum
+    (sum-backed), everything else passes through — which is exactly why slab
+    leaves ride the existing psum/pmin/pmax buckets with zero new collective
+    kinds."""
+    return "sum" if reduce in ("sum", "mean") else reduce
+
+
+class LRUSlotTable:
+    """Host-side key -> slot map for open-ended segment spaces.
+
+    Maps arbitrary hashable segment keys (user cohorts, experiment arms,
+    model-version strings) onto the fixed ``num_slots`` slab rows. When the
+    table is full, the least-recently-used key is evicted and its slot is
+    recycled; the caller must reset the recycled rows (``Keyed`` does) and
+    the lifetime ``evictions`` counter feeds the observability gauge.
+    Resolution is eager host work by construction — the whole point of the
+    table is data-dependent key management jit cannot express; the scatter
+    that CONSUMES the resolved int ids stays jittable.
+    """
+
+    def __init__(self, num_slots: int):
+        if not isinstance(num_slots, int) or num_slots < 1:
+            raise ValueError(f"`num_slots` must be a positive int, got {num_slots!r}")
+        self.num_slots = num_slots
+        self._map: "OrderedDict[Hashable, int]" = OrderedDict()  # LRU -> MRU
+        self._free: List[int] = list(range(num_slots - 1, -1, -1))  # pop() ascends
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def __contains__(self, key: Hashable) -> bool:
+        return key in self._map
+
+    def keys(self) -> Tuple[Hashable, ...]:
+        """Current keys, least- to most-recently-used."""
+        return tuple(self._map)
+
+    def slot_of(self, key: Hashable) -> int:
+        """Resolve one key WITHOUT touching recency (reads are not uses)."""
+        if key not in self._map:
+            raise KeyError(
+                f"segment key {key!r} is not resident (evicted or never seen); "
+                f"{len(self._map)}/{self.num_slots} slots occupied"
+            )
+        return self._map[key]
+
+    def resolve(self, keys: Sequence[Hashable]) -> Tuple[np.ndarray, List[int]]:
+        """Map a batch of keys to slot ids, evicting LRU keys as needed.
+
+        Returns ``(slot_ids int32 (N,), evicted_slots)`` — the caller resets
+        the evicted slots' slab rows BEFORE scattering. A batch that needs
+        more distinct slots than the table holds would have to recycle a slot
+        already written by this same batch (silent cross-segment corruption),
+        so it raises instead.
+        """
+        slots = np.empty(len(keys), dtype=np.int32)
+        assigned_this_batch: set = set()
+        evicted: List[int] = []
+        for i, key in enumerate(keys):
+            slot = self._map.pop(key, None)  # pop + reinsert = touch (MRU)
+            if slot is None:
+                if self._free:
+                    slot = self._free.pop()
+                else:
+                    old_key, slot = next(iter(self._map.items()))
+                    if old_key in assigned_this_batch:
+                        raise ValueError(
+                            f"one batch touches more than num_slots={self.num_slots}"
+                            " distinct segment keys; evicting a key written by this"
+                            " same batch would corrupt its rows. Raise num_slots or"
+                            " split the batch."
+                        )
+                    del self._map[old_key]
+                    evicted.append(slot)
+                    self.evictions += 1
+            self._map[key] = slot
+            assigned_this_batch.add(key)
+            slots[i] = slot
+        return slots, evicted
+
+    def state(self) -> dict:
+        """Checkpointable view: keys in LRU order + their slots + evictions."""
+        return {
+            "keys": list(self._map.keys()),
+            "slots": np.asarray(list(self._map.values()), dtype=np.int64),
+            "evictions": np.asarray(self.evictions, dtype=np.int64),
+        }
+
+    def load_state(self, state: dict) -> None:
+        self._map = OrderedDict(
+            (key, int(slot)) for key, slot in zip(state["keys"], np.asarray(state["slots"]))
+        )
+        used = set(self._map.values())
+        self._free = [s for s in range(self.num_slots - 1, -1, -1) if s not in used]
+        self.evictions = int(state["evictions"])
+
+    def reset(self) -> None:
+        """Forget every key (the epoch-reset path). The lifetime eviction
+        count is deliberately kept — it is a process gauge, not epoch state."""
+        self._map.clear()
+        self._free = list(range(self.num_slots - 1, -1, -1))

@@ -170,22 +170,30 @@ def merge_values_stacked(fx: ReduceFx, acc: Any, stacked: Any) -> Any:
 def is_stack_mergeable(fx: ReduceFx, default: Any) -> bool:
     """Whether a state supports the one-op stacked merge (no lists/buffers)."""
     from metrics_tpu.parallel.sketch import SketchSpec
+    from metrics_tpu.parallel.slab import SlabSpec
 
     if isinstance(default, (list, PaddedBuffer)):
         return False
     if is_sketch(default) or isinstance(default, SketchSpec):
         return True  # one stacked-sum fold of the counts
+    if isinstance(default, SlabSpec):
+        # slab rows register sum/min/max sync reductions, all of which have
+        # one-op stacked folds over the (steps, K, ...) axis
+        return True
     return fx in ("sum", "min", "max") or is_associative(fx)
 
 
 def is_mergeable(fx: ReduceFx, default: Any) -> bool:
     """Whether a state with this reduction supports pairwise merge (fused forward)."""
     from metrics_tpu.parallel.sketch import SketchSpec
+    from metrics_tpu.parallel.slab import SlabSpec
 
     if isinstance(default, (list, PaddedBuffer)) or fx == "cat":
         return True
     if is_sketch(default) or isinstance(default, SketchSpec):
         return True
+    if isinstance(default, SlabSpec):
+        return True  # per-slot sum/min/max rows merge elementwise
     return fx in ("sum", "min", "max") or is_associative(fx)
 
 
@@ -389,6 +397,12 @@ def coalesced_sync_state(
       collection syncs with the same single bucketed ``psum`` a StatScores
       collection uses, and integer addition is exactly associative, so the
       bucketed (and hierarchical ici-first) staging is bit-exact.
+    - **Keyed slab leaves** (``parallel/slab.py``: ``(K, *shape)`` segment
+      slabs registered with ``sum``/``min``/``max`` reductions, sketch slabs
+      with a leading K axis) need NO arm of their own — they are exactly the
+      array/sketch leaves above, so one bucketed ``psum``/``pmin``/``pmax``
+      moves all K segments and the staged collective count is K-independent
+      (the property ``bench.py --check-collectives`` pins at K=10 000).
     - **Buffer plane** (:class:`PaddedBuffer` cat-states): same-dtype
       buffers ravel their ``(capacity, *item)`` rows into one concatenated
       payload gathered with ONE ``all_gather`` — and for 4-byte bucket

@@ -16,7 +16,7 @@ from jax import Array
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.functional.regression.psnr import _psnr_compute, _psnr_update
 from metrics_tpu.utils.data import accum_int_dtype, dim_zero_cat
-from metrics_tpu.utils.prints import rank_zero_warn
+from metrics_tpu.utils.prints import rank_zero_warn_once
 
 
 class PSNR(Metric):
@@ -48,7 +48,7 @@ class PSNR(Metric):
         )
 
         if dim is None and reduction != "elementwise_mean":
-            rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
+            rank_zero_warn_once(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
 
         if dim is None:
             self.add_state("sum_squared_error", default=np.zeros(()), dist_reduce_fx="sum")

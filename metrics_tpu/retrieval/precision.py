@@ -35,10 +35,6 @@ class RetrievalPrecision(RetrievalMetric):
         0.5
     """
 
-    # the shared base update has no config deps beyond `capacity` (which the
-    # group fingerprint always includes); the empty tuple opts in to grouping
-    _GROUP_UPDATE_ATTRS = ()
-
     def __init__(
         self,
         query_without_relevant_docs: str = "skip",

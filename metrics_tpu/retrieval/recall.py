@@ -25,10 +25,6 @@ class RetrievalRecall(RetrievalMetric):
         0.5
     """
 
-    # shares the RetrievalMetric append update: groups with RetrievalPrecision/
-    # RetrievalMRR in a collection (k is compute-only, absent from the key)
-    _GROUP_UPDATE_ATTRS = ()
-
     def __init__(
         self,
         query_without_relevant_docs: str = "skip",

@@ -23,9 +23,5 @@ class RetrievalMRR(RetrievalMetric):
         0.75
     """
 
-    # shares the RetrievalMetric append update: groups with RetrievalPrecision/
-    # RetrievalRecall in a collection (no update-relevant config of its own)
-    _GROUP_UPDATE_ATTRS = ()
-
     def _grouped_metric(self, dense_idx: Array, preds: Array, target: Array, num_queries: int, valid=None) -> Array:
         return grouped_reciprocal_rank(dense_idx, preds, target, num_queries)

@@ -93,6 +93,22 @@ class RetrievalMetric(Metric, ABC):
         self.add_state("preds", default=[], dist_reduce_fx=None)
         self.add_state("target", default=[], dist_reduce_fx=None)
 
+    # The whole retrieval family shares this base flatten-append update, and
+    # every family-specific knob is COMPUTE-only — so inside a
+    # ``MetricCollection`` any retrieval members with matching ``capacity``
+    # form ONE compute group (one idx/preds/target append per step, one
+    # state pytree on the pure/sync plane). Declared via the exclusion form
+    # (``Metric._GROUP_COMPUTE_ONLY_ATTRS``): a subclass that adds
+    # update-relevant config is automatically included in the group key and
+    # conservatively splits off, while a new compute-only knob just extends
+    # this tuple instead of re-declaring ``_GROUP_UPDATE_ATTRS = ()``.
+    _GROUP_COMPUTE_ONLY_ATTRS = (
+        "k",
+        "query_without_relevant_docs",
+        "exclude",
+        "regroup_capacity",
+    )
+
     def update(self, idx: Array, preds: Array, target: Array) -> None:
         if not (idx.shape == target.shape == preds.shape):
             raise ValueError("`idx`, `preds` and `target` must be of the same shape")

@@ -1,13 +1,19 @@
-"""Metric wrappers: BootStrapper, ClasswiseWrapper, MinMaxMetric,
+"""Metric wrappers: BootStrapper, ClasswiseWrapper, Keyed, MinMaxMetric,
 MetricTracker, MultioutputWrapper, Running.
 
 Extension family beyond the reference snapshot (later torchmetrics ships
-these under ``wrappers/``)."""
+these under ``wrappers/``). ``Keyed`` is the multi-tenant slab wrapper: one
+metric x thousands of segments as a leading state axis, where the cloning
+wrappers (Classwise/Multioutput) fan out whole modules."""
 from metrics_tpu.wrappers.bootstrapper import BootStrapper
 from metrics_tpu.wrappers.classwise import ClasswiseWrapper
+from metrics_tpu.wrappers.keyed import Keyed
 from metrics_tpu.wrappers.minmax import MinMaxMetric
 from metrics_tpu.wrappers.multioutput import MultioutputWrapper
 from metrics_tpu.wrappers.running import Running
 from metrics_tpu.wrappers.tracker import MetricTracker
 
-__all__ = ["BootStrapper", "ClasswiseWrapper", "MinMaxMetric", "MetricTracker", "MultioutputWrapper", "Running"]
+__all__ = [
+    "BootStrapper", "ClasswiseWrapper", "Keyed", "MinMaxMetric", "MetricTracker",
+    "MultioutputWrapper", "Running",
+]

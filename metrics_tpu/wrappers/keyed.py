@@ -1,0 +1,346 @@
+"""Keyed: one metric x thousands of segments, zero new collectives.
+
+``Keyed(metric, num_slots)`` turns any per-sample-decomposable metric into a
+multi-tenant slab metric: every registered state of the inner metric becomes
+a ``(K, *shape)`` slab (one row per segment slot, see
+``metrics_tpu/parallel/slab.py``), ``update(..., slot=segment_ids)`` routes
+each sample's contribution to its segment's row with ONE
+``segment_sum``-style scatter, ``compute()`` vmaps the inner finisher over
+the slab and returns all K values at once, and sync rides the existing
+per-dtype coalesced ``psum``/``pmin``/``pmax`` buckets unchanged — the
+staged collective count is identical at K=1 and K=10 000.
+
+Contrast with the module-cloning wrappers (``ClasswiseWrapper``,
+``MultioutputWrapper``): those multiply compiled steps, state pytrees and
+sync calls by K; ``Keyed`` multiplies only the state's leading axis.
+
+Contract on the inner metric: every state must be a fixed-shape array with a
+``sum``/``mean``/``min``/``max`` reduction or a sketch state
+(``approx="sketch"`` curve/rank metrics) — list/buffer cat-states have no
+per-slot slab form (use ``approx="sketch"`` instead) — and the inner
+``update`` must be per-sample decomposable: updating with a batch must equal
+merging per-sample updates (the n -> 1 limit of the pairwise-merge property
+the fused forward already assumes). Sum/mean state defaults must be zero.
+"""
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.core.metric import Metric, State
+from metrics_tpu.observability.counters import COUNTERS as _COUNTERS, record_slab_slots
+from metrics_tpu.parallel.buffer import PaddedBuffer
+from metrics_tpu.parallel.sketch import SketchSpec, is_sketch, sketch_init
+from metrics_tpu.parallel.slab import (
+    LRUSlotTable,
+    SlabSpec,
+    make_slab_spec,
+    slab_init,
+    slab_merge,
+    slab_rows_spec,
+    slab_scatter,
+    slab_sync_reduce,
+)
+from metrics_tpu.utils.exceptions import TracingUnsupportedError
+
+# the per-slot sample-count state every Keyed wrapper carries: occupancy
+# masks (empty-slot policy), the sum-backed mean division, and the gauges
+_ROWS_STATE = "keyed_rows"
+
+_EMPTY_POLICIES = ("nan", "zero")
+
+
+class Keyed(Metric):
+    r"""Per-segment fan-out of ``metric`` over ``num_slots`` slab rows.
+
+    Args:
+        metric: the inner metric. Its states become ``(K, *shape)`` slabs;
+            its ``update``/``compute`` are reused as the per-sample delta
+            and the per-slot finisher — the instance itself never
+            accumulates.
+        num_slots: K, the number of segment rows.
+        lru: accept arbitrary hashable segment KEYS in ``update(...,
+            slot=keys)`` and map them onto the K rows with an
+            :class:`~metrics_tpu.parallel.slab.LRUSlotTable` (least-recently-
+            used eviction; evicted rows reset, the eviction count feeds the
+            ``slab_slots`` observability gauge). Key resolution is host-side
+            by construction, so LRU mode runs the eager update path; with
+            ``lru=False`` (default) ``slot`` is an int array of slot ids in
+            ``[0, K)`` and the whole update is one jittable scatter.
+            Out-of-range ids are dropped, never misrouted.
+        empty: what ``compute()`` reports for never-updated slots —
+            ``"nan"`` (default; non-float results fall back to 0) or
+            ``"zero"``.
+
+    ``compute()`` returns the inner result with a leading ``(K,)`` axis;
+    ``compute(slot=k)`` reads one segment (in LRU mode ``k`` is the segment
+    KEY). Sync (``dist_sync_on_step``, host plane, in-jit ``sync_state``)
+    rides the base machinery: slab leaves are ordinary sum/min/max array (or
+    sketch) leaves, so the whole wrapper syncs through the same coalesced
+    buckets as the unkeyed metric — one psum for all K segments.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> acc = Keyed(Accuracy(), num_slots=3)
+        >>> preds = jnp.array([0.9, 0.8, 0.3, 0.1])
+        >>> target = jnp.array([1, 0, 0, 0])
+        >>> acc.update(preds, target, slot=jnp.array([0, 1, 1, 0]))
+        >>> [round(float(v), 2) for v in acc.compute()[:2]]
+        [1.0, 0.5]
+    """
+
+    def __init__(
+        self,
+        metric: Metric,
+        num_slots: int,
+        lru: bool = False,
+        empty: str = "nan",
+        compute_on_step: Optional[bool] = None,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+        jit: Optional[bool] = None,
+    ):
+        if not isinstance(metric, Metric):
+            raise ValueError(f"`metric` must be a Metric, got {type(metric).__name__}")
+        if empty not in _EMPTY_POLICIES:
+            raise ValueError(f"`empty` must be one of {_EMPTY_POLICIES}, got {empty!r}")
+        super().__init__(
+            compute_on_step=metric.compute_on_step if compute_on_step is None else compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+            # LRU key resolution is host-side: the fused jitted step can
+            # never trace it, so don't build one per instance
+            jit=False if lru else jit,
+        )
+        self.metric = metric
+        self.num_slots = int(num_slots)
+        self.lru = bool(lru)
+        self.empty = empty
+        self._metric_label = f"Keyed({type(metric).__name__})"
+        self._slots = LRUSlotTable(self.num_slots) if lru else None
+        self._occupied_host: set = set()  # gauge bookkeeping, not state
+
+        # every inner state becomes a (K, *shape) slab state of this wrapper
+        if not metric._defaults:
+            raise ValueError("the inner metric declares no states; nothing to key")
+        if _ROWS_STATE in metric._defaults:
+            raise ValueError(f"the inner metric already has a state named {_ROWS_STATE!r}")
+        self._slab_reduce: Dict[str, str] = {}
+        for name, spec in metric._defaults.items():
+            slab = self._slab_spec_for(name, spec, metric._reductions[name])
+            self._slab_reduce[name] = slab.reduce
+            self.add_state(name, default=slab, dist_reduce_fx=slab_sync_reduce(slab.reduce),
+                           persistent=True)
+        self.add_state(_ROWS_STATE, default=slab_rows_spec(self.num_slots),
+                       dist_reduce_fx="sum", persistent=True)
+
+    def _slab_spec_for(self, name: str, spec: Any, fx: Any) -> SlabSpec:
+        """The ``SlabSpec`` one inner state maps onto, or a loud rejection."""
+        if isinstance(spec, SketchSpec):
+            kind = spec.kind  # "hist" | "rank": counts grow a leading K axis
+            return make_slab_spec(self.num_slots, np.zeros(spec.shape, np.dtype(spec.dtype)),
+                                  "sum", kind=kind)
+        if isinstance(spec, (list, PaddedBuffer)) or fx == "cat" or fx is None:
+            raise ValueError(
+                f"state {name!r} of {type(self.metric).__name__} is a cat/list/buffer"
+                " state with no per-slot slab form; Keyed supports fixed-shape"
+                " sum/mean/min/max states and sketch states (curve/rank metrics:"
+                " construct the inner metric with approx='sketch')"
+            )
+        if isinstance(spec, (SlabSpec,)) or not isinstance(spec, np.ndarray):
+            raise ValueError(
+                f"state {name!r} has an unsupported default kind for Keyed:"
+                f" {type(spec).__name__}"
+            )
+        if not (isinstance(fx, str) and fx in ("sum", "mean", "min", "max")):
+            raise ValueError(
+                f"state {name!r} uses dist_reduce_fx={fx!r}; Keyed supports"
+                " 'sum'/'mean'/'min'/'max' array states and sketch states"
+            )
+        return make_slab_spec(self.num_slots, spec, fx)
+
+    # ---------------------------------------------------------------- update
+    def update(self, *args: Any, slot: Any = None, **kwargs: Any) -> None:
+        """Scatter one batch into the segment slabs.
+
+        ``slot`` (required, keyword-only) is one segment id per sample: an
+        int array in ``[0, num_slots)``, or — with ``lru=True`` — a sequence
+        of arbitrary hashable segment keys. All positional/keyword data
+        arguments must share the leading sample axis with ``slot``.
+        """
+        if slot is None:
+            raise ValueError("Keyed.update requires `slot=` (one segment id per sample)")
+        slot_ids = self._resolve_slot_ids(slot)
+        data = (*args, *kwargs.values())
+        if not data:
+            raise ValueError("Keyed.update needs at least one data argument")
+        kw_keys = tuple(kwargs)
+        n_args = len(args)
+
+        def one(*sample):
+            batch = tuple(a[None] for a in sample)  # per-sample size-1 batches
+            return self.metric.update_state(
+                self.metric.init_state(), *batch[:n_args], **dict(zip(kw_keys, batch[n_args:]))
+            )
+
+        deltas = jax.vmap(one)(*data)  # {name: (N, *shape) / sketch with (N, ...) counts}
+        for name in self.metric._defaults:
+            reduce = self._slab_reduce[name]
+            current = getattr(self, name)
+            leaf = deltas[name]
+            if is_sketch(current):
+                scattered = slab_scatter("sum", leaf.counts, slot_ids, self.num_slots)
+                setattr(self, name, type(current)(current.counts + scattered))
+            else:
+                scattered = slab_scatter(reduce, leaf, slot_ids, self.num_slots)
+                setattr(self, name, slab_merge(reduce, current, scattered))
+        rows = getattr(self, _ROWS_STATE)
+        ones = jnp.ones(slot_ids.shape, dtype=rows.dtype)
+        setattr(self, _ROWS_STATE, rows + slab_scatter("sum", ones, slot_ids, self.num_slots))
+        self._note_slab_gauges(slot_ids)
+
+    def _resolve_slot_ids(self, slot: Any) -> Array:
+        if self.lru:
+            if self._under_trace():
+                raise TracingUnsupportedError(
+                    "Keyed(lru=True) resolves segment keys host-side and cannot run"
+                    " under jit tracing; drive it eagerly, or use lru=False with"
+                    " integer slot ids."
+                )
+            keys = list(np.asarray(slot).reshape(-1)) if isinstance(
+                slot, (np.ndarray, jnp.ndarray, Array)
+            ) else list(slot)
+            slot_ids, evicted = self._slots.resolve(keys)
+            if evicted:
+                self._reset_slots(evicted)
+            return jnp.asarray(slot_ids)
+        return jnp.asarray(slot, dtype=jnp.int32).reshape(-1)
+
+    def _reset_slots(self, slots) -> None:
+        """Return recycled rows to their per-slot defaults (eviction path)."""
+        idx = jnp.asarray(np.asarray(slots, dtype=np.int32))
+        for name, spec in self._defaults.items():
+            value = getattr(self, name)
+            fresh = slab_init(spec)
+            if is_sketch(value):
+                setattr(self, name, type(value)(value.counts.at[idx].set(fresh.counts[idx])))
+            else:
+                setattr(self, name, value.at[idx].set(fresh[idx]))
+        self._occupied_host.difference_update(int(s) for s in np.asarray(slots))
+
+    def _note_slab_gauges(self, slot_ids: Array) -> None:
+        """Feed the slot occupancy/eviction gauges (observability only —
+        reading the slot ids back is a device readback, so the non-LRU path
+        pays it only while counting is enabled, and never under tracing)."""
+        if self._under_trace():
+            return
+        if self.lru:
+            occupied = len(self._slots)
+            evictions = self._slots.evictions
+        elif _COUNTERS.enabled:
+            self._occupied_host.update(
+                int(s) for s in np.unique(np.asarray(slot_ids)) if 0 <= int(s) < self.num_slots
+            )
+            occupied = len(self._occupied_host)
+            evictions = 0
+        else:
+            return
+        record_slab_slots(self._metric_label, self.num_slots, occupied, evictions)
+
+    # --------------------------------------------------------------- compute
+    def compute(self) -> Any:
+        """All K per-segment values: the inner finisher vmapped over the slab
+        (empty slots per the ``empty`` policy). The public wrapped form also
+        accepts ``compute(slot=k)`` for a single-segment read."""
+        state = self._current_state()
+        rows = state.pop(_ROWS_STATE)
+        inner_state: State = {}
+        for name, value in state.items():
+            if self._slab_reduce[name] == "mean":
+                # sum-backed mean: divide by the per-slot sample count
+                denom = jnp.maximum(rows, 1).astype(value.dtype).reshape(
+                    (self.num_slots,) + (1,) * (value.ndim - 1)
+                )
+                value = value / denom
+            inner_state[name] = value
+        results = jax.vmap(self.metric.compute_from_state)(inner_state)
+        occupied = rows > 0
+
+        def mask(r: Array) -> Array:
+            r = jnp.asarray(r)
+            occ = occupied.reshape((self.num_slots,) + (1,) * (r.ndim - 1))
+            if self.empty == "nan" and jnp.issubdtype(r.dtype, jnp.inexact):
+                return jnp.where(occ, r, jnp.nan)
+            return jnp.where(occ, r, jnp.zeros((), dtype=r.dtype))
+
+        return jax.tree_util.tree_map(mask, results)
+
+    def _wrap_compute(self, compute: Callable) -> Callable:
+        """The base wrapper (sync + cache) plus the ``slot=`` read form.
+
+        The cache always holds the FULL (K, ...) results — a slot read
+        slices the cached vector, so ``compute(slot=2)`` can never poison a
+        later full ``compute()``.
+        """
+        wrapped = super()._wrap_compute(compute)
+
+        def with_slot(slot: Any = None) -> Any:
+            out = wrapped()
+            if slot is None:
+                return out
+            if self.lru:
+                slot = self._slots.slot_of(slot)
+            return jax.tree_util.tree_map(lambda v: v[slot], out)
+
+        return with_slot
+
+    # ------------------------------------------------------- integrity guard
+    def _integrity_state(self) -> State:
+        """Mask never-touched slots before the ``check_finite`` scan: min/max
+        identity fills sit at the dtype extremes (finfo/iinfo max) that the
+        saturation scan would otherwise flag as pre-wraparound corruption."""
+        state = self._current_state()
+        rows = state[_ROWS_STATE]
+        occupied = np.asarray(rows) > 0
+        out: State = {}
+        for name, value in state.items():
+            reduce = self._slab_reduce.get(name)
+            if reduce in ("min", "max") and not is_sketch(value):
+                occ = jnp.asarray(occupied).reshape(
+                    (self.num_slots,) + (1,) * (value.ndim - 1)
+                )
+                value = jnp.where(occ, value, jnp.zeros((), dtype=value.dtype))
+            out[name] = value
+        return out
+
+    # ------------------------------------------------------------- lifecycle
+    def reset(self) -> None:
+        super().reset()
+        if self._slots is not None:
+            self._slots.reset()
+        self._occupied_host = set()
+
+    _SLOT_TABLE_KEY = "_keyed_slot_table"
+
+    def state_dict(self, destination: Optional[dict] = None, prefix: str = "") -> dict:
+        """Slab states persist through the base path (plain arrays/sketches);
+        the LRU key->slot map rides along so a restored metric resolves the
+        same keys to the same rows."""
+        destination = super().state_dict(destination, prefix=prefix)
+        if self._slots is not None:
+            destination[prefix + self._SLOT_TABLE_KEY] = self._slots.state()
+        return destination
+
+    def load_state_dict(self, state_dict: dict, prefix: str = "") -> None:
+        super().load_state_dict(state_dict, prefix=prefix)
+        key = prefix + self._SLOT_TABLE_KEY
+        if self._slots is not None and key in state_dict:
+            self._slots.load_state(state_dict[key])
+
+    def __repr__(self) -> str:
+        return f"Keyed({self.metric!r}, num_slots={self.num_slots}, lru={self.lru})"

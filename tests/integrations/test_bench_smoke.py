@@ -82,6 +82,16 @@ def _assert_headline_schema(out):
     assert out["sketch_gather_calls"] == 0  # psum-only: the sketch contract
     assert out["sketch_sync_bytes"] * 10 < out["hier_sync_bytes"]
 
+    # the keyed slab A/B rides the same line: Keyed(AUROC sketch) x 10,000
+    # segments stages the SAME collective count and kinds as the unkeyed
+    # metric — psum-only, K-independent program, only the payload scales
+    assert isinstance(out["keyed_sync_ms"], (int, float)) and out["keyed_sync_ms"] > 0
+    assert out["keyed_states_synced"] == 2  # the histogram slab + the row-count slab
+    assert out["keyed_collective_calls"] == 2  # two-stage (ici + dcn) psum
+    assert out["keyed_collective_calls"] == out["keyed_unkeyed_collective_calls"]
+    assert out["keyed_gather_calls"] == 0  # psum-only: the slab contract
+    assert out["keyed_sync_bytes"] == 2640000  # (10000*2*16 + 10000) * 4 * 2 stages
+
     # fault counters ride the default line and are ZERO on a clean bench run
     # (--check-trajectory pins them at zero on every new BENCH_r* round)
     for key in ("sync_retries", "sync_deadline_exceeded", "degraded_computes", "quarantined_updates"):
@@ -103,17 +113,23 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     out = _run_smoke(("--trace", str(trace_file)))
     _assert_headline_schema(out)
 
-    # schema version of the --trace payload: v4 added the sketch A/B (psum-
-    # only sketch plane keys on the default line, full sketch counters
-    # here); v3 moved the collective counts to the default line and added
-    # the hierarchical A/B + per-crossing counters; bump this pin with the
-    # schema
-    assert out["trace_schema"] == 4
+    # schema version of the --trace payload: v5 added the keyed slab A/B
+    # (K-independent staged-collective keys on the default line, full keyed
+    # counters here); v4 added the sketch A/B; v3 moved the collective
+    # counts to the default line and added the hierarchical A/B +
+    # per-crossing counters; bump this pin with the schema
+    assert out["trace_schema"] == 5
     # the sketch program's full snapshot: psum-only, no gather kinds staged
     sketch_kinds = out["sketch_counters"]["calls_by_kind"]
     assert sketch_kinds.get("psum", 0) == 2
     for kind in ("all_gather", "coalesced_gather", "process_allgather"):
         assert sketch_kinds.get(kind, 0) == 0, kind
+    # the keyed slab program: the same psum-only shape at K=10,000
+    keyed_kinds = out["keyed_counters"]["calls_by_kind"]
+    assert keyed_kinds.get("psum", 0) == 2
+    for kind in ("all_gather", "coalesced_gather", "process_allgather"):
+        assert keyed_kinds.get(kind, 0) == 0, kind
+    assert out["keyed_counters"]["bytes_by_crossing"]["dcn"] == out["keyed_sync_bytes"] // 2
 
     # counter totals must agree with the states_synced the bench reports
     assert out["counters"]["states_synced"] == out["states_synced"]
@@ -200,7 +216,7 @@ def test_bench_check_collectives_gate():
     assert out["ok"] is True and out["failures"] == []
     scenarios = out["scenarios"]
     assert set(scenarios) == {
-        "sketch_sync",
+        "sketch_sync", "keyed_sync", "keyed_unkeyed",
         "sum_grouped", "sum_ungrouped", "gather_coalesced", "gather_per_leaf",
         "gather_hier", "gather_flat2d",
         "sharded_auroc", "sharded_auroc_hier",
@@ -242,6 +258,15 @@ def test_bench_check_collectives_gate():
     assert out["sketch_gate"]["ok"] is True
     assert scenarios["sketch_sync"]["gather_calls"] == 0
     assert scenarios["sketch_sync"]["sync_bytes"] * 10 < scenarios["gather_hier"]["sync_bytes"]
+    # the keyed gate of record: K=10,000 segments stage the identical
+    # collective count as the unkeyed metric, psum-only — collective counts
+    # are K-independent (the slab contract)
+    assert out["keyed_gate"]["ok"] is True
+    assert (
+        scenarios["keyed_sync"]["collective_calls"]
+        == scenarios["keyed_unkeyed"]["collective_calls"]
+    )
+    assert scenarios["keyed_sync"]["gather_calls"] == 0
     for row in scenarios.values():
         assert row["status"] != "regression"
 

@@ -451,3 +451,87 @@ def test_injector_rejects_bad_specs():
     with pytest.raises(RuntimeError, match="already installed"):
         with faults.chaos(faults.FaultSpec(kind="drop", call=0)):
             faults.ChaosInjector([faults.FaultSpec(kind="drop", call=0)]).install()
+
+
+# ------------------------------------------------------- keyed slab states
+def test_keyed_slab_quarantine_drops_only_the_poisoned_step():
+    """The integrity guard covers slab states: a NaN-poisoned keyed update is
+    quarantined as ONE step (the accumulator — every segment row — reverts to
+    its pre-step value), previously accumulated segments survive, and the
+    counter bumps."""
+    from metrics_tpu import Keyed
+    from metrics_tpu.regression import MeanSquaredError
+
+    keyed = Keyed(MeanSquaredError(), num_slots=3)
+    keyed.check_finite = "quarantine"
+    clean_preds = jnp.asarray([1.0, 2.0, 5.0])
+    clean_target = jnp.asarray([1.0, 1.0, 1.0])
+    slots = jnp.asarray([0, 1, 1])
+    keyed.update(clean_preds, clean_target, slot=slots)
+    before = np.asarray(keyed.compute())
+
+    with pytest.warns(UserWarning, match="quarantined"):
+        keyed.update(
+            jnp.asarray([np.nan, 3.0, 3.0]), clean_target, slot=jnp.asarray([2, 0, 1])
+        )
+    after = np.asarray(keyed.compute())
+    # the whole poisoned step is gone: segment 0/1 keep their clean values,
+    # segment 2 (only ever touched by the poisoned step) is still empty
+    np.testing.assert_array_equal(after[:2], before[:2])
+    assert np.isnan(after[2]) and np.isnan(before[2])
+    assert _faults()["quarantined_updates"] >= 1
+
+
+def test_keyed_slab_quarantine_watermark_replay_is_idempotent():
+    """A checkpoint taken after a quarantined step restores with the
+    watermark PAST that step — replaying the clean and the quarantined step
+    indices are both no-ops, so resume cannot double-count any segment."""
+    from metrics_tpu import Keyed
+    from metrics_tpu.regression import MeanSquaredError
+
+    keyed = Keyed(MeanSquaredError(), num_slots=2)
+    keyed.check_finite = "quarantine"
+    preds, target = jnp.asarray([2.0, 4.0]), jnp.asarray([0.0, 0.0])
+    slots = jnp.asarray([0, 1])
+    assert keyed.guarded_update(0, preds, target, slot=slots) is True
+    with pytest.warns(UserWarning, match="quarantined"):
+        # the poisoned step still consumes its step index (the delta is
+        # dropped, the epoch position is not)
+        keyed.guarded_update(1, jnp.asarray([np.nan, 1.0]), target, slot=slots)
+    saved = keyed.state_dict()
+
+    restored = Keyed(MeanSquaredError(), num_slots=2)
+    restored.check_finite = "quarantine"
+    restored.load_state_dict(saved)
+    assert restored.epoch_watermark == 2
+    assert restored.guarded_update(0, preds, target, slot=slots) is False
+    assert restored.guarded_update(1, preds, target, slot=slots) is False
+    np.testing.assert_array_equal(np.asarray(restored.compute()), np.asarray(keyed.compute()))
+
+
+def test_keyed_min_slab_identity_fills_pass_the_integrity_scan():
+    """Empty min/max slab rows legitimately rest at the dtype extremes (the
+    inner default, e.g. +inf for a min state); the Keyed integrity view masks
+    never-touched slots so ``check_finite`` does not false-positive on them —
+    while a genuinely poisoned update is still caught."""
+    from metrics_tpu import Keyed
+    from metrics_tpu.core.metric import Metric
+
+    class _Low(Metric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("low", default=np.asarray(np.inf, np.float32), dist_reduce_fx="min")
+
+        def update(self, values):
+            self.low = jnp.minimum(self.low, jnp.min(values))
+
+        def compute(self):
+            return self.low
+
+    keyed = Keyed(_Low(), num_slots=4)
+    keyed.check_finite = "warn"
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any integrity warning fails the test
+        keyed.update(jnp.asarray([1.0, 2.0]), slot=jnp.asarray([0, 1]))
+    with pytest.warns(UserWarning, match="integrity scan"):
+        keyed.update(jnp.asarray([np.nan]), slot=jnp.asarray([2]))

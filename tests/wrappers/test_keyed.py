@@ -1,0 +1,514 @@
+"""Keyed multi-tenant metric slabs: correctness matrix.
+
+The contract under test (wrappers/keyed.py + parallel/slab.py):
+
+- ``Keyed(metric, K)`` is BIT-EXACT vs K independent clones of the inner
+  metric, each fed its own segment's samples — across sum/mean/min/max array
+  states and sketch states, over multiple update steps;
+- empty slots follow the ``empty=`` policy (NaN vs zero), out-of-range slot
+  ids are dropped (never misrouted), and LRU mode evicts in
+  least-recently-used order with reset rows and a counted eviction;
+- checkpoints round-trip (slab states + the LRU key table + the epoch
+  watermark), and ``guarded_update`` replay stays idempotent;
+- on a real (4,2) mesh the flat AND hierarchical synced compute equals the
+  single-process epoch with a PSUM-ONLY staged program — one bucketed
+  collective for all K segments;
+- compute-group fingerprints understand slab shapes (equal slab specs
+  group, differing slot counts split).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+import metrics_tpu.observability as obs
+from metrics_tpu import AUROC, Accuracy, Keyed, MetricCollection
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.parallel.placement import MeshHierarchy
+from metrics_tpu.parallel.slab import LRUSlotTable, SlabSpec, make_slab_spec, slab_init
+from metrics_tpu.utils import compat
+
+
+# --------------------------------------------------------------- toy metrics
+# One tiny metric per reduce kind: per-sample decomposable by construction,
+# integer-valued float inputs keep float sums order-independent (bit-exact).
+class _Sum(Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", default=np.zeros((), np.float32), dist_reduce_fx="sum")
+
+    def update(self, values):
+        self.total = self.total + jnp.sum(values)
+
+    def compute(self):
+        return self.total
+
+
+class _Min(Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("low", default=np.asarray(np.inf, np.float32), dist_reduce_fx="min")
+
+    def update(self, values):
+        self.low = jnp.minimum(self.low, jnp.min(values))
+
+    def compute(self):
+        return self.low
+
+
+class _Max(Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("high", default=np.asarray(-np.inf, np.float32), dist_reduce_fx="max")
+
+    def update(self, values):
+        self.high = jnp.maximum(self.high, jnp.max(values))
+
+    def compute(self):
+        return self.high
+
+
+class _Mean(Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("avg", default=np.zeros((), np.float32), dist_reduce_fx="mean")
+
+    def update(self, values):
+        self.avg = jnp.mean(values)
+
+    def compute(self):
+        return self.avg
+
+
+def _data(seed, n, k):
+    rng = np.random.RandomState(seed)
+    values = rng.randint(-50, 50, n).astype(np.float32)  # integer-valued: exact sums
+    slots = rng.randint(0, k, n).astype(np.int32)
+    return values, slots
+
+
+# ------------------------------------------------------------ clone parity
+@pytest.mark.parametrize("cls,reduce", [(_Sum, "sum"), (_Min, "min"), (_Max, "max")])
+def test_reduce_kind_parity_vs_clones(cls, reduce):
+    """Keyed == K independent clones, bit-exact, across 3 update steps."""
+    K = 6
+    keyed = Keyed(cls(), num_slots=K)
+    clones = [cls() for _ in range(K)]
+    for step in range(3):
+        values, slots = _data(step, 64, K)
+        keyed.update(jnp.asarray(values), slot=jnp.asarray(slots))
+        for k in range(K):
+            rows = values[slots == k]
+            if rows.size:
+                clones[k].update(jnp.asarray(rows))
+    out = np.asarray(keyed.compute())
+    for k in range(K):
+        np.testing.assert_array_equal(out[k], np.asarray(clones[k].compute()))
+
+
+def test_mean_kind_is_per_slot_mean_over_all_samples():
+    """Sum-backed mean: the slab reports each slot's mean over EVERY sample
+    routed to it, across update steps (exact for integer-valued floats)."""
+    K = 4
+    keyed = Keyed(_Mean(), num_slots=K)
+    all_values, all_slots = [], []
+    for step in range(3):
+        values, slots = _data(10 + step, 40, K)
+        keyed.update(jnp.asarray(values), slot=jnp.asarray(slots))
+        all_values.append(values)
+        all_slots.append(slots)
+    values = np.concatenate(all_values)
+    slots = np.concatenate(all_slots)
+    out = np.asarray(keyed.compute())
+    for k in range(K):
+        np.testing.assert_allclose(out[k], values[slots == k].mean(), rtol=0, atol=0)
+
+
+def test_accuracy_parity_vs_clones():
+    """A real library metric (sum-kind count states) through the same gate."""
+    K = 5
+    rng = np.random.RandomState(3)
+    keyed = Keyed(Accuracy(), num_slots=K)
+    clones = [Accuracy() for _ in range(K)]
+    for step in range(2):
+        preds = rng.rand(48).astype(np.float32)
+        target = rng.randint(0, 2, 48)
+        slots = rng.randint(0, K, 48)
+        keyed.update(jnp.asarray(preds), jnp.asarray(target), slot=jnp.asarray(slots))
+        for k in range(K):
+            m = slots == k
+            if m.any():
+                clones[k].update(jnp.asarray(preds[m]), jnp.asarray(target[m]))
+    out = np.asarray(keyed.compute())
+    ref = np.asarray([np.asarray(c.compute()) for c in clones])
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_sketch_state_parity_vs_clones_bit_exact():
+    """Keyed(AUROC(approx='sketch'), K) scatters into a (K, 2, B) histogram
+    slab; integer counts make the parity vs K clones bit-exact for ANY
+    scores."""
+    K = 8
+    rng = np.random.RandomState(4)
+    keyed = Keyed(AUROC(approx="sketch", num_bins=64), num_slots=K)
+    clones = [AUROC(approx="sketch", num_bins=64) for _ in range(K)]
+    for step in range(3):
+        preds = rng.rand(96).astype(np.float32)
+        target = rng.randint(0, 2, 96)
+        slots = rng.randint(0, K, 96)
+        keyed.update(jnp.asarray(preds), jnp.asarray(target), slot=jnp.asarray(slots))
+        for k in range(K):
+            m = slots == k
+            if m.any():
+                clones[k].update(jnp.asarray(preds[m]), jnp.asarray(target[m]))
+    # the slab rows ARE the clones' histograms
+    slab_counts = np.asarray(keyed.hist.counts)
+    for k in range(K):
+        np.testing.assert_array_equal(slab_counts[k], np.asarray(clones[k].hist.counts))
+    np.testing.assert_array_equal(
+        np.asarray(keyed.compute()), np.asarray([np.asarray(c.compute()) for c in clones])
+    )
+
+
+def test_fused_jit_forward_matches_eager():
+    """The jittable scatter path (jit=True fused step) accumulates and
+    reports batch values identically to the eager path."""
+    K = 4
+    rng = np.random.RandomState(5)
+    preds = jnp.asarray(rng.rand(32).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, 32))
+    slots = jnp.asarray(rng.randint(0, K, 32))
+    jitted = Keyed(Accuracy(), num_slots=K, jit=True)
+    eager = Keyed(Accuracy(), num_slots=K, jit=False)
+    v_jit = jitted(preds, target, slot=slots)
+    v_eager = eager(preds, target, slot=slots)
+    assert not jitted._jit_failed
+    np.testing.assert_array_equal(np.asarray(v_jit), np.asarray(v_eager))
+    np.testing.assert_array_equal(np.asarray(jitted.compute()), np.asarray(eager.compute()))
+
+
+# ----------------------------------------------------- empty / out-of-range
+def test_empty_slot_policies():
+    values = jnp.asarray(np.asarray([1.0, 2.0], np.float32))
+    nan_policy = Keyed(_Sum(), num_slots=3, empty="nan")
+    nan_policy.update(values, slot=jnp.asarray([0, 0]))
+    out = np.asarray(nan_policy.compute())
+    assert out[0] == 3.0 and np.isnan(out[1]) and np.isnan(out[2])
+
+    zero_policy = Keyed(_Sum(), num_slots=3, empty="zero")
+    zero_policy.update(values, slot=jnp.asarray([0, 0]))
+    np.testing.assert_array_equal(np.asarray(zero_policy.compute()), [3.0, 0.0, 0.0])
+
+    with pytest.raises(ValueError, match="`empty`"):
+        Keyed(_Sum(), num_slots=3, empty="skip")
+
+
+def test_out_of_range_slot_ids_are_dropped():
+    """Ids outside [0, K) vanish (XLA scatter drop semantics) — they never
+    land in another segment's row, and the dropped rows count nowhere."""
+    keyed = Keyed(_Sum(), num_slots=2)
+    keyed.update(
+        jnp.asarray(np.asarray([1.0, 10.0, 100.0, 1000.0], np.float32)),
+        slot=jnp.asarray([0, 7, -3, 1]),
+    )
+    out = np.asarray(keyed.compute())
+    np.testing.assert_array_equal(out, [1.0, 1000.0])
+    np.testing.assert_array_equal(np.asarray(keyed.keyed_rows), [1, 1])
+
+
+# ------------------------------------------------------------------ LRU mode
+def test_lru_eviction_order_and_reset():
+    """Least-recently-USED goes first (touching refreshes recency), the
+    recycled row restarts from the default, and evictions are counted."""
+    keyed = Keyed(_Sum(), num_slots=2, lru=True)
+    keyed.update(jnp.asarray(np.float32([1.0, 2.0])), slot=["a", "b"])
+    keyed.update(jnp.asarray(np.float32([3.0])), slot=["a"])  # touch a: b is now LRU
+    keyed.update(jnp.asarray(np.float32([5.0])), slot=["c"])  # evicts b, not a
+    table = keyed._slots
+    assert table.evictions == 1
+    assert set(table.keys()) == {"a", "c"}
+    assert float(keyed.compute(slot="a")) == 4.0
+    assert float(keyed.compute(slot="c")) == 5.0  # b's old 2.0 was reset away
+    with pytest.raises(KeyError, match="evicted or never seen"):
+        keyed.compute(slot="b")
+    # the evicted key can return; it restarts clean on a recycled row
+    keyed.update(jnp.asarray(np.float32([7.0])), slot=["b"])
+    assert float(keyed.compute(slot="b")) == 7.0
+    assert table.evictions == 2
+
+
+def test_lru_batch_wider_than_table_raises():
+    keyed = Keyed(_Sum(), num_slots=2, lru=True)
+    with pytest.raises(ValueError, match="more than num_slots"):
+        keyed.update(jnp.asarray(np.float32([1.0, 2.0, 3.0])), slot=["a", "b", "c"])
+
+
+def test_lru_rejects_jit_tracing():
+    keyed = Keyed(_Sum(), num_slots=2, lru=True)
+
+    def step(values):
+        keyed.update(values, slot=["a"])
+
+    from metrics_tpu.utils.exceptions import TracingUnsupportedError
+
+    with pytest.raises(TracingUnsupportedError, match="lru"):
+        jax.jit(step)(jnp.ones((1,), jnp.float32))
+
+
+# --------------------------------------------------------------- lifecycle
+def test_checkpoint_roundtrip_with_lru_table_and_watermark():
+    keyed = Keyed(AUROC(approx="sketch", num_bins=32), num_slots=3, lru=True)
+    rng = np.random.RandomState(6)
+    for step in range(2):
+        preds = jnp.asarray(rng.rand(16).astype(np.float32))
+        target = jnp.asarray(rng.randint(0, 2, 16))
+        keyed.update(preds, target, slot=["us", "eu"] * 8)
+    saved = keyed.state_dict()
+
+    restored = Keyed(AUROC(approx="sketch", num_bins=32), num_slots=3, lru=True)
+    restored.load_state_dict(saved)
+    assert restored.epoch_watermark == keyed.epoch_watermark == 2
+    assert restored._slots.keys() == keyed._slots.keys()
+    np.testing.assert_array_equal(
+        np.asarray(restored.compute(slot="eu")), np.asarray(keyed.compute(slot="eu"))
+    )
+    np.testing.assert_array_equal(np.asarray(restored.compute()), np.asarray(keyed.compute()))
+
+
+def test_guarded_update_replay_is_idempotent():
+    """The preemption contract: replaying a step at or below the restored
+    watermark is a no-op, so a Keyed epoch resumed mid-flight cannot
+    double-count any segment."""
+    keyed = Keyed(_Sum(), num_slots=2)
+    values = jnp.asarray(np.float32([1.0, 2.0]))
+    slots = jnp.asarray([0, 1])
+    assert keyed.guarded_update(0, values, slot=slots) is True
+    assert keyed.guarded_update(1, values, slot=slots) is True
+    saved = keyed.state_dict()
+
+    restored = Keyed(_Sum(), num_slots=2)
+    restored.load_state_dict(saved)
+    assert restored.epoch_watermark == 2
+    assert restored.guarded_update(1, values, slot=slots) is False  # replayed step: no-op
+    assert restored.guarded_update(2, values, slot=slots) is True
+    # 3 applied steps (0, 1, 2) — the replayed step 1 added nothing
+    np.testing.assert_array_equal(np.asarray(restored.compute()), [3.0, 6.0])
+
+
+def test_reset_clears_slabs_and_lru_keys():
+    keyed = Keyed(_Sum(), num_slots=2, lru=True)
+    keyed.update(jnp.asarray(np.float32([1.0])), slot=["a"])
+    keyed.reset()
+    assert len(keyed._slots) == 0
+    assert np.isnan(np.asarray(keyed.compute())).all()
+
+
+def test_compute_slot_read_never_poisons_the_cache():
+    keyed = Keyed(_Sum(), num_slots=3)
+    keyed.update(jnp.asarray(np.float32([1.0, 2.0, 3.0])), slot=jnp.asarray([0, 1, 2]))
+    assert float(keyed.compute(slot=1)) == 2.0
+    # the cached value is the FULL slab result, not the slice
+    np.testing.assert_array_equal(np.asarray(keyed.compute()), [1.0, 2.0, 3.0])
+    assert float(keyed.compute(slot=2)) == 3.0
+
+
+def test_clone_is_independent():
+    keyed = Keyed(_Sum(), num_slots=2)
+    keyed.update(jnp.asarray(np.float32([1.0])), slot=jnp.asarray([0]))
+    twin = keyed.clone()
+    twin.update(jnp.asarray(np.float32([10.0])), slot=jnp.asarray([0]))
+    assert float(keyed.compute(slot=0)) == 1.0
+    assert float(twin.compute(slot=0)) == 11.0
+
+
+# -------------------------------------------------------------- validation
+def test_rejects_buffer_and_cat_state_inners():
+    with pytest.raises(ValueError, match="no per-slot slab form"):
+        Keyed(AUROC(), num_slots=4)  # exact AUROC: list cat-states
+    with pytest.raises(ValueError, match="no per-slot slab form"):
+        Keyed(AUROC(capacity=64), num_slots=4)  # PaddedBuffer cat-states
+
+
+def test_update_requires_slot_and_data():
+    keyed = Keyed(_Sum(), num_slots=2)
+    with pytest.raises(ValueError, match="slot"):
+        keyed.update(jnp.ones((2,), jnp.float32))
+    with pytest.raises(ValueError, match="data argument"):
+        keyed.update(slot=jnp.asarray([0, 1]))
+
+
+def test_slab_spec_rejects_nonzero_sum_template():
+    with pytest.raises(ValueError, match="zero default template"):
+        make_slab_spec(4, np.ones((2,), np.float32), "sum")
+
+
+def test_lru_table_free_list_and_contains():
+    table = LRUSlotTable(3)
+    ids, evicted = table.resolve(["x", "y", "x"])
+    assert evicted == [] and len(table) == 2
+    assert list(ids) == [0, 1, 0]
+    assert "x" in table and "z" not in table
+
+
+# --------------------------------------------------- mesh sync (flat + hier)
+@pytest.mark.parametrize("hierarchical", [False, True], ids=["flat", "hier42"])
+def test_mesh_synced_compute_matches_single_process(eight_devices, hierarchical):
+    """The acceptance property on a REAL staged program: 8 device shards
+    update their local slabs, one coalesced sync moves all K segments, and
+    the synced compute equals the single-process epoch bit-exactly — with a
+    PSUM-ONLY program (zero staged gathers of any kind), flat and (4,2)
+    hierarchical."""
+    K = 16
+    keyed = Keyed(AUROC(approx="sketch", num_bins=32), num_slots=K)
+    rng = np.random.RandomState(7)
+    preds = rng.rand(8, 64).astype(np.float32)
+    target = rng.randint(0, 2, (8, 64)).astype(np.int32)
+    slots = rng.randint(0, K, (8, 64)).astype(np.int32)
+
+    if hierarchical:
+        mesh = Mesh(np.array(eight_devices).reshape(2, 4), ("dcn", "ici"))
+        axis, specs = MeshHierarchy(ici_axis="ici", dcn_axis="dcn"), P(("dcn", "ici"))
+    else:
+        mesh = Mesh(np.array(eight_devices), ("dp",))
+        axis, specs = "dp", P("dp")
+
+    def fn(p, t, s):
+        local = keyed.update_state(keyed.init_state(), p[0], t[0], slot=s[0])
+        synced = keyed.sync_state(local, axis)
+        return synced
+
+    obs.enable()
+    obs.COUNTERS.reset()
+    f = jax.jit(compat.shard_map(
+        fn, mesh=mesh, in_specs=(specs, specs, specs),
+        out_specs=jax.tree_util.tree_map(lambda _: P(), keyed.init_state()),
+        check_vma=False,
+    ))
+    synced = f(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(slots))
+    snap = obs.counters_snapshot()
+    obs.disable()
+
+    # psum-only: the histogram slab + row-count slab share ONE int32 bucket
+    assert snap["calls_by_kind"].get("psum", 0) == (2 if hierarchical else 1)
+    for kind in ("all_gather", "coalesced_gather", "process_allgather", "ppermute"):
+        assert snap["calls_by_kind"].get(kind, 0) == 0, kind
+
+    single = Keyed(AUROC(approx="sketch", num_bins=32), num_slots=K)
+    single.update(
+        jnp.asarray(preds.reshape(-1)), jnp.asarray(target.reshape(-1)),
+        slot=jnp.asarray(slots.reshape(-1)),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(synced["hist"].counts), np.asarray(single.hist.counts)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(synced["keyed_rows"]), np.asarray(single.keyed_rows)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(single.compute_from_state(synced)), np.asarray(single.compute())
+    )
+
+
+# ----------------------------------------------------------- observability
+def test_slab_gauges_and_state_bytes_label():
+    obs.enable()
+    obs.COUNTERS.reset()
+    try:
+        keyed = Keyed(_Sum(), num_slots=8, lru=True)
+        keyed.update(jnp.asarray(np.float32([1.0, 2.0])), slot=["a", "b"])
+        snap = obs.counters_snapshot()
+    finally:
+        obs.disable()
+    gauges = snap["slab_slots"]["Keyed(_Sum)"]
+    assert gauges == {"slots": 8, "occupied": 2, "evictions": 0}
+    # the state-bytes gauge stays attributable to the inner metric
+    assert snap["state_bytes"]["Keyed(_Sum)"] > 0
+
+
+def test_non_lru_occupancy_gauge_when_counting():
+    obs.enable()
+    obs.COUNTERS.reset()
+    try:
+        keyed = Keyed(_Sum(), num_slots=4)
+        keyed.update(jnp.asarray(np.float32([1.0, 2.0, 3.0])), slot=jnp.asarray([0, 0, 3]))
+        snap = obs.counters_snapshot()
+    finally:
+        obs.disable()
+    assert snap["slab_slots"]["Keyed(_Sum)"]["occupied"] == 2
+
+
+# ------------------------------------------------ compute-group fingerprints
+class _SlabStat(Metric):
+    """A metric declaring a slab state directly: the fingerprint surface the
+    compute-group machinery must understand (slab shapes split groups)."""
+
+    _GROUP_UPDATE_ATTRS = ("num_slots",)
+
+    def __init__(self, num_slots, **kw):
+        super().__init__(**kw)
+        self.num_slots = num_slots
+        self.add_state(
+            "slab",
+            default=make_slab_spec(num_slots, np.zeros((3,), np.float32), "sum"),
+            dist_reduce_fx="sum",
+        )
+
+    def update(self, values, slot):
+        import jax as _jax
+
+        self.slab = self.slab + _jax.ops.segment_sum(values, slot, self.num_slots)
+
+    def compute(self):
+        return jnp.sum(self.slab, axis=-1)
+
+
+def test_group_fingerprints_learn_slab_shapes():
+    col = MetricCollection({"a": _SlabStat(4), "b": _SlabStat(4), "c": _SlabStat(8)})
+    groups = col.compute_groups
+    assert groups["a"] == ("a", "b")  # equal slab specs fuse
+    assert groups["c"] == ("c",)  # a different slot count splits
+
+
+def test_slab_spec_materializes_through_add_state():
+    m = _SlabStat(4)
+    assert isinstance(m._defaults["slab"], SlabSpec)
+    assert m.slab.shape == (4, 3)
+    np.testing.assert_array_equal(np.asarray(m.slab), np.zeros((4, 3), np.float32))
+    fresh = slab_init(m._defaults["slab"])
+    assert fresh.shape == (4, 3)
+    with pytest.raises(ValueError, match="dist_reduce_fx"):
+
+        class _Bad(Metric):
+            def __init__(self):
+                super().__init__()
+                self.add_state(
+                    "slab",
+                    default=make_slab_spec(2, np.zeros((), np.float32), "min"),
+                    dist_reduce_fx="sum",
+                )
+
+            def update(self):
+                pass
+
+            def compute(self):
+                return None
+
+        _Bad()
+
+
+def test_retrieval_family_still_groups_after_exclusion_refactor():
+    """The _GROUP_UPDATE_ATTRS=() per-class overrides were replaced by the
+    base-level _GROUP_COMPUTE_ONLY_ATTRS exclusion; the family must still
+    fuse into one flatten-append group, k and policy staying compute-only."""
+    from metrics_tpu import RetrievalMRR, RetrievalPrecision, RetrievalRecall
+
+    col = MetricCollection([RetrievalPrecision(k=2), RetrievalRecall(k=1), RetrievalMRR()])
+    groups = col.compute_groups
+    assert groups["RetrievalPrecision"] == (
+        "RetrievalPrecision", "RetrievalRecall", "RetrievalMRR"
+    )
+    # update-relevant config still splits: capacity changes the state schema
+    split = MetricCollection([RetrievalPrecision(capacity=8), RetrievalRecall()])
+    assert len(split.compute_groups) == 2
