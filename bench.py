@@ -110,6 +110,13 @@ SKETCH_RANK_BINS = 16  # (16, 16) int32 joint histogram = 1 KB
 # metric's — segments scale the payload, never the program.
 KEYED_SLOTS = 10_000
 KEYED_BINS = 16
+# windowed serving scenario: the same sketch AUROC as a 4-slot tumbling ring
+# (wrappers/windowed.py). The pinned property mirrors the keyed gate:
+# windows are a leading STATE axis, so the staged collective count is
+# identical to the unwindowed metric's (psum-only) — window roll is a slot
+# rotation, never a new collective.
+SERVICE_WINDOWS = 4
+SERVICE_WINDOW_S = 60.0
 
 
 def _collection_ours(compute_groups: bool = True):
@@ -453,6 +460,78 @@ def _build_keyed_sync_runner(num_slots: "int | None" = KEYED_SLOTS):
     return run, len(state)
 
 
+def _build_windowed_sync_runner(windowed: bool = True):
+    """(timed_run(steps) -> ms/step, states_synced) for the WINDOWED serving
+    scenario: ``Windowed(AUROC(approx="sketch"), window_s, num_windows=4)``
+    — tumbling windows as ring slots on the state's leading axis — synced
+    per step with ``coalesced_sync_state`` on the (4,2) ici x dcn mesh. The
+    window slabs (a (W, 2, B) histogram slab + the (W,) row-count slab) fold
+    into ONE int32 sum bucket, so the staged program is the same two-stage
+    psum the unwindowed sketch metric stages: collective counts are
+    WINDOW-COUNT-INDEPENDENT (``windowed=False`` builds the unwindowed twin
+    the ``--check-service`` parity gate compares against).
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from metrics_tpu import AUROC, Windowed
+    from metrics_tpu.parallel.placement import MeshHierarchy
+    from metrics_tpu.parallel.sync import coalesced_sync_state
+    from metrics_tpu.utils.compat import shard_map
+
+    inner = AUROC(approx="sketch", num_bins=KEYED_BINS)
+    if windowed:
+        metric = Windowed(
+            inner, window_s=SERVICE_WINDOW_S, num_windows=SERVICE_WINDOWS,
+            allowed_lateness_s=(SERVICE_WINDOWS - 1) * SERVICE_WINDOW_S,
+        )
+    else:
+        metric = inner
+    rng = np.random.RandomState(0)
+    rows = GATHER_CAPACITY // 2  # same per-step traffic shape as the sketch A/B
+    preds = jnp.asarray(rng.rand(rows).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, rows).astype(np.int32))
+    if windowed:
+        # events spread over the still-open horizon: windows 1..3 of the
+        # 4-slot ring, none late enough to drop
+        times = rng.uniform(SERVICE_WINDOW_S, SERVICE_WINDOWS * SERVICE_WINDOW_S, rows)
+        metric.update(preds, target, event_time=times)
+    else:
+        metric.update(preds, target)
+
+    state = metric._current_state()
+    reductions = metric._reductions
+    mesh = Mesh(
+        np.array(jax.devices("cpu")[:N_DEVICES]).reshape(HIER_SLICES, N_DEVICES // HIER_SLICES),
+        ("dcn", "ici"),
+    )
+    axis = MeshHierarchy(ici_axis="ici", dcn_axis="dcn")
+
+    def step(s, acc):
+        synced = coalesced_sync_state(s, reductions, axis)
+        # carry chains step i+1 on step i (see _build_gather_runner)
+        for leaf in jax.tree_util.tree_leaves(synced):
+            acc = acc + jnp.sum(leaf.astype(jnp.float32))
+        return acc
+
+    sharded_step = jax.jit(
+        shard_map(step, mesh=mesh, in_specs=(P(), P()), out_specs=P(), check_vma=False)
+    )
+
+    def run(steps: int) -> float:
+        acc = jnp.zeros((), jnp.float32)
+        start = time.perf_counter()
+        for _ in range(steps):
+            acc = sharded_step(state, acc)
+        jax.block_until_ready(acc)
+        return (time.perf_counter() - start) / steps * 1e3
+
+    return run, len(state)
+
+
 def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trace_path=None) -> dict:
     """Compute-groups on/off A/B over the same 8-device mesh program.
 
@@ -559,6 +638,21 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
         with (obs.span("bench.timed_keyed_sync") if obs else _null_cm()):
             keyed_times.append(run_keyed(steps))
 
+    # windowed serving A/B: Windowed(AUROC sketch) x 4 window slots vs the
+    # unwindowed metric on the same (4,2) mesh — like the keyed gate, the
+    # headline is that the STAGED COLLECTIVE COUNT does not move with the
+    # window count (the unwindowed twin is traced for its counters only)
+    run_service, states_service, service_counters = build(
+        _build_windowed_sync_runner, True, "service_windowed"
+    )
+    _, _, service_unwindowed_counters = build(
+        _build_windowed_sync_runner, False, "service_unwindowed"
+    )
+    service_times = []
+    for _ in range(repeats):
+        with (obs.span("bench.timed_service_windowed") if obs else _null_cm()):
+            service_times.append(run_service(steps))
+
     out = {
         "grouped_sync8_ms": grouped_ms,
         "ungrouped_sync8_ms": ungrouped_ms,
@@ -610,6 +704,20 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
             for k in ("all_gather", "coalesced_gather", "process_allgather")
         ),
         "keyed_unkeyed_collective_calls": keyed_unkeyed_counters["collective_calls"],
+        # the windowed serving plane: window slots are a leading state axis,
+        # so the staged program matches the unwindowed metric's (psum-only)
+        "service_sync_ms": min(service_times),
+        "service_states_synced": states_service,
+        "service_collective_calls": service_counters["collective_calls"],
+        "service_sync_bytes": service_counters["sync_bytes"],
+        "service_gather_calls": sum(
+            service_counters["calls_by_kind"].get(k, 0)
+            for k in ("all_gather", "coalesced_gather", "process_allgather")
+        ),
+        "service_unwindowed_collective_calls": service_unwindowed_counters["collective_calls"],
+        # slab drop evidence rides the default line pinned at ZERO (in-window
+        # traffic never drops; the --check-service chaos soak pins nonzero)
+        "slab_dropped_samples": service_counters.get("slab_dropped_samples", 0),
     }
     # fault counters ride the default line, pinned at ZERO: a clean bench run
     # that retries, degrades, or quarantines anything is a regression
@@ -630,16 +738,18 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
             finally:
                 devtime_mod.disable()
 
-        # v5: the keyed slab A/B joined (K-independent staged-collective keys
-        # on the default line, full keyed counters here); v4 added the sketch
-        # A/B; v3 moved the collective counts to the default line and added
-        # the hierarchical A/B
-        out["trace_schema"] = 5
+        # v6: the windowed serving A/B joined (window-count-independent
+        # staged-collective keys + slab_dropped_samples on the default line,
+        # full service counters here); v5 added the keyed slab A/B; v4 the
+        # sketch A/B; v3 moved the collective counts to the default line and
+        # added the hierarchical A/B
+        out["trace_schema"] = 6
         out["counters"] = grouped_counters
         out["gather_counters"] = coal_counters
         out["hier_counters"] = hier_counters
         out["sketch_counters"] = sketch_counters
         out["keyed_counters"] = keyed_counters
+        out["service_counters"] = service_counters
         summary = obs.summarize()
         out["phase_ms"] = {
             name: round(row["total_ms"], 3) for name, row in sorted(summary.items())
@@ -965,11 +1075,19 @@ _TRACE_KEYS = (
     "keyed_sync_bytes",
     "keyed_gather_calls",
     "keyed_unkeyed_collective_calls",
+    "service_sync_ms",
+    "service_states_synced",
+    "service_collective_calls",
+    "service_sync_bytes",
+    "service_gather_calls",
+    "service_unwindowed_collective_calls",
+    "slab_dropped_samples",
     "counters",
     "gather_counters",
     "hier_counters",
     "sketch_counters",
     "keyed_counters",
+    "service_counters",
     "phase_ms",
     "phase_compile_ms",
     "device_ms",
@@ -1425,6 +1543,328 @@ def check_faults() -> int:
     return 1 if failures else 0
 
 
+# ------------------------------------------------------- serving-runtime gate
+# --check-service soaks the windowed serving loop (wrappers/windowed.py +
+# serving/service.py) end to end and pins the serving contract:
+#   parity — the windowed metric's staged sync program is IDENTICAL to the
+#            unwindowed metric's (psum-only; windows are a state axis,
+#            never extra collectives)
+#   clean  — a seeded event stream (in-order + late-within-lateness events)
+#            through a real MetricService is BIT-EXACT vs a single-process
+#            oracle: every published window, the merged sliding view, the
+#            per-window sample counts (zero misrouted), the drop count, and
+#            zero fault counters
+#   chaos  — a seeded late-burst + ingest-stall + mid-window-preempt +
+#            persistent-sync-drop schedule: the soak completes within the
+#            deadline budget (degrade, never stall), every publish is
+#            stamped degraded, degraded_computes and slab_dropped_samples
+#            match their pins exactly, the preempted service resumes from
+#            its snapshot with idempotent replay, and the values are still
+#            bit-exact vs the oracle
+SERVICE_SOAK_WINDOW_S = 10.0
+SERVICE_SOAK_WINDOWS = 4
+SERVICE_SOAK_LATENESS_S = 10.0
+SERVICE_SOAK_BATCHES = 16
+SERVICE_SOAK_BATCH = 32
+SERVICE_SOAK_BUDGET_S = 60.0
+SERVICE_LATE_SKEW_S = 25.0  # the late-burst shift (beyond allowed lateness)
+SERVICE_LATE_CALLS = (2, 3)  # ingest calls the burst hits
+SERVICE_PREEMPT_CALL = 8  # mid-window kill point
+
+
+def _service_stream(seed: int = 0):
+    """The seeded soak stream: SERVICE_SOAK_BATCHES batches whose event
+    times mostly advance (5 s per batch) with ~15% late-within-lateness
+    stragglers. Returns [(times float64 (B,), preds f32, target i32), ...]."""
+    rng = np.random.RandomState(seed)
+    batches = []
+    for i in range(SERVICE_SOAK_BATCHES):
+        preds = rng.rand(SERVICE_SOAK_BATCH).astype(np.float32)
+        target = (rng.rand(SERVICE_SOAK_BATCH) > 0.5).astype(np.int32)
+        times = i * 5.0 + rng.uniform(0.0, 5.0, SERVICE_SOAK_BATCH)
+        late = rng.rand(SERVICE_SOAK_BATCH) < 0.15
+        times = np.where(late, times - rng.uniform(0.0, 8.0, SERVICE_SOAK_BATCH), times)
+        batches.append((times, preds, target))
+    return batches
+
+
+def _service_oracle(batches, shifts=None):
+    """Single-process oracle: replay the stream's routing arithmetic in
+    plain numpy (running-max watermark; accept iff the event's window is
+    still open), then compute every window's value with a FRESH unwindowed
+    metric over exactly its accepted events. ``shifts`` maps batch index ->
+    event-time shift (the chaos schedule's late bursts, which the gate can
+    reconstruct because the schedule is call-pinned)."""
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy
+
+    window_s, num_windows = SERVICE_SOAK_WINDOW_S, SERVICE_SOAK_WINDOWS
+    lateness = SERVICE_SOAK_LATENESS_S
+    wm = None
+    events = {}  # window -> [(pred, target), ...]
+    dropped = 0
+    for i, (times, preds, target) in enumerate(batches):
+        t = np.asarray(times, dtype=np.float64) + (shifts or {}).get(i, 0.0)
+        wm = float(t.max()) if wm is None else max(wm, float(t.max()))
+        head = int(np.floor(wm / window_s))
+        w = np.floor_divide(t, window_s).astype(np.int64)
+        ok = ((w + 1) * window_s + lateness > wm) & (w > head - num_windows)
+        dropped += int((~ok).sum())
+        for j in np.nonzero(ok)[0]:
+            events.setdefault(int(w[j]), []).append((preds[j], target[j]))
+    origin = min(events) if events else head
+    published = list(range(origin, head + 1))
+    resident = [w for w in published if w > head - num_windows]
+
+    def value(windows):
+        pairs = [p for w in windows for p in events.get(w, [])]
+        if not pairs:
+            return np.asarray(np.nan, dtype=np.float32)
+        metric = Accuracy()
+        metric.update(
+            jnp.asarray(np.array([p for p, _ in pairs], dtype=np.float32)),
+            jnp.asarray(np.array([t for _, t in pairs], dtype=np.int32)),
+        )
+        return np.asarray(metric.compute())
+
+    return {
+        "published": published,
+        "resident": resident,
+        "values": {w: value([w]) for w in published},
+        "merged": value(resident),
+        "counts": {w: len(events.get(w, [])) for w in resident},
+        "dropped": dropped,
+        "head": head,
+    }
+
+
+def _drive_service(batches, schedule, guard):
+    """Run the stream through a real MetricService (background worker,
+    bounded queue) under ``schedule``; on a mid-window preempt, snapshot,
+    build a FRESH service, restore, and replay from two steps BEFORE the
+    snapshot point (exercising guarded_update idempotence). Returns the
+    soak evidence for the gate's pins."""
+    import contextlib
+
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, MetricService, Windowed
+    from metrics_tpu.parallel import faults
+    from metrics_tpu.parallel.sync import gather_all_arrays
+    from metrics_tpu.serving.service import ServiceStoppedError
+    from metrics_tpu.utils.exceptions import PreemptionError
+
+    def build():
+        metric = Windowed(
+            Accuracy(), window_s=SERVICE_SOAK_WINDOW_S, num_windows=SERVICE_SOAK_WINDOWS,
+            allowed_lateness_s=SERVICE_SOAK_LATENESS_S, dist_sync_fn=gather_all_arrays,
+        )
+        return MetricService(metric, queue_size=8, shed_policy="block", guard=guard)
+
+    injector = faults.ChaosInjector(schedule, seed=0) if schedule else contextlib.nullcontext()
+    publications = []
+    preempted = False
+    start = time.perf_counter()
+    with injector:
+        service = build()
+        for i, (times, preds, target) in enumerate(batches):
+            try:
+                service.submit(jnp.asarray(preds), jnp.asarray(target), event_time=times, seq=i)
+            except ServiceStoppedError:
+                preempted = True
+                break
+        if not preempted:
+            try:
+                service.flush(SERVICE_SOAK_BUDGET_S)
+            except PreemptionError:
+                preempted = True
+        if preempted:
+            service._worker.join(timeout=10)
+            snapshot = service.snapshot()
+            publications += service.publications
+            replacement = build()
+            replacement.restore(snapshot)
+            for i in range(max(0, snapshot["processed"] - 2), len(batches)):
+                times, preds, target = batches[i]
+                replacement.submit(
+                    jnp.asarray(preds), jnp.asarray(target), event_time=times, seq=i
+                )
+            service = replacement
+        merged = np.asarray(service.finalize(SERVICE_SOAK_BUDGET_S))
+        publications += service.publications
+        service.stop(SERVICE_SOAK_BUDGET_S)
+    return {
+        "service": service,
+        "publications": publications,
+        "merged": merged,
+        "elapsed_s": time.perf_counter() - start,
+        "preempted": preempted,
+        "injected": dict(injector.injected) if schedule else {},
+    }
+
+
+def _check_service_soak(result, oracle, failures, label):
+    """Shared clean/chaos assertions: publication coverage + bit-exactness,
+    merged value, per-window counts (zero misrouted), drop count."""
+    pubs = {p["window"]: p for p in result["publications"]}
+    if sorted(pubs) != oracle["published"]:
+        failures.append(
+            f"{label}: published windows {sorted(pubs)} != oracle {oracle['published']}"
+        )
+    if len(result["publications"]) != len(pubs):
+        failures.append(f"{label}: a window was published more than once")
+    for w, expected in oracle["values"].items():
+        got = pubs.get(w, {}).get("value")
+        if got is None or not np.array_equal(got, expected, equal_nan=True):
+            failures.append(f"{label}: window {w} value {got} != oracle {expected}")
+    if not np.array_equal(result["merged"], oracle["merged"], equal_nan=True):
+        failures.append(
+            f"{label}: merged value {result['merged']} != oracle {oracle['merged']}"
+        )
+    metric = result["service"].metric
+    rows = np.asarray(metric._current_state()["windowed_rows"])
+    for w, count in oracle["counts"].items():
+        got = int(rows[w % SERVICE_SOAK_WINDOWS])
+        if got != count:
+            failures.append(
+                f"{label}: window {w} holds {got} samples, oracle routed {count}"
+                " (misrouted or lost samples)"
+            )
+    if metric.dropped_samples != oracle["dropped"]:
+        failures.append(
+            f"{label}: metric dropped {metric.dropped_samples} samples,"
+            f" oracle dropped {oracle['dropped']}"
+        )
+    if result["elapsed_s"] > SERVICE_SOAK_BUDGET_S:
+        failures.append(
+            f"{label}: soak took {result['elapsed_s']:.1f}s > {SERVICE_SOAK_BUDGET_S}s budget (hang?)"
+        )
+
+
+def check_service() -> int:
+    """``--check-service``: the serving-runtime regression gate (see the
+    block comment above). Prints one JSON report line; non-zero exit on any
+    broken contract."""
+    from metrics_tpu import observability as obs
+    from metrics_tpu.parallel.faults import FaultSpec
+    from metrics_tpu.parallel.sync import SyncGuard
+    from metrics_tpu.serving.service import INGEST_SITE
+
+    failures = []
+
+    # -- parity: the windowed sync program == the unwindowed program --------
+    obs.enable()
+    parity = {}
+    for name, windowed in (("windowed", True), ("unwindowed", False)):
+        run, _ = _build_windowed_sync_runner(windowed)
+        obs.COUNTERS.reset()
+        run(1)  # first call traces+compiles: counters hold the staged program
+        snap = obs.counters_snapshot()
+        parity[name] = {
+            "collective_calls": snap["collective_calls"],
+            "sync_bytes": snap["sync_bytes"],
+            "gather_calls": sum(
+                snap["calls_by_kind"].get(k, 0)
+                for k in ("all_gather", "coalesced_gather", "process_allgather")
+            ),
+            "calls_by_kind": snap["calls_by_kind"],
+        }
+    obs.disable()
+    if parity["windowed"]["collective_calls"] != parity["unwindowed"]["collective_calls"]:
+        failures.append(
+            f"parity: windowed metric staged {parity['windowed']['collective_calls']}"
+            f" collectives vs the unwindowed metric's"
+            f" {parity['unwindowed']['collective_calls']} — window slots must be a"
+            " state axis, never extra collectives"
+        )
+    if parity["windowed"]["gather_calls"] != 0:
+        failures.append(
+            f"parity: windowed sync staged {parity['windowed']['gather_calls']} gather"
+            " collectives (the window plane must be psum-only)"
+        )
+
+    batches = _service_stream()
+    guard = SyncGuard(deadline_s=2.0, max_retries=1, backoff_s=0.02, policy="degrade")
+
+    # -- clean soak: bit-exact vs the oracle, zero faults -------------------
+    obs.reset()
+    clean = _drive_service(batches, schedule=None, guard=guard)
+    clean_counters = obs.counters_snapshot()
+    _check_service_soak(clean, _service_oracle(batches), failures, "clean")
+    if any(clean_counters["faults"].values()):
+        failures.append(f"clean soak reported nonzero fault counters: {clean_counters['faults']}")
+    if clean.get("preempted"):
+        failures.append("clean soak preempted without a schedule")
+    if clean["service"].shed_events:
+        failures.append(f"clean soak shed {clean['service'].shed_events} batches under backpressure")
+
+    # -- chaos soak: late burst + ingest stall + mid-window preempt +
+    #    persistent sync drop (every publish degrades, nothing stalls) ------
+    schedule = [
+        FaultSpec(kind="late_burst", call=SERVICE_LATE_CALLS[0],
+                  times=len(SERVICE_LATE_CALLS), skew_s=SERVICE_LATE_SKEW_S, site=INGEST_SITE),
+        FaultSpec(kind="ingest_stall", call=5, times=1, duration_s=0.2, site=INGEST_SITE),
+        FaultSpec(kind="preempt", call=SERVICE_PREEMPT_CALL, times=1, site=INGEST_SITE),
+        # rate=1.0 fires on EVERY gather call (deterministically): the
+        # persistent-drop peer no sync can reach — every publish must
+        # degrade to local-only state instead of stalling the stream
+        FaultSpec(kind="drop", rate=1.0, times=100_000, site="host_gather"),
+    ]
+    shifts = {c: -SERVICE_LATE_SKEW_S for c in SERVICE_LATE_CALLS}
+    obs.reset()
+    chaos = _drive_service(batches, schedule=schedule, guard=guard)
+    chaos_counters = obs.counters_snapshot()
+    chaos_oracle = _service_oracle(batches, shifts=shifts)
+    _check_service_soak(chaos, chaos_oracle, failures, "chaos")
+    if not chaos["preempted"]:
+        failures.append("chaos soak never hit the mid-window preempt")
+    n_pubs = len(chaos["publications"])
+    if not all(p["degraded"] for p in chaos["publications"]):
+        failures.append("chaos soak published un-degraded values under a persistent sync drop")
+    # every publish syncs exactly once and finalize syncs exactly once: the
+    # degraded_computes pin is structural, not a lower bound
+    expected_degraded = n_pubs + 1
+    if chaos_counters["faults"]["degraded_computes"] != expected_degraded:
+        failures.append(
+            f"chaos soak degraded_computes ="
+            f" {chaos_counters['faults']['degraded_computes']}, pinned"
+            f" {expected_degraded} (one per publish + the finalize read)"
+        )
+    if chaos_counters["slab_dropped_samples"] != chaos_oracle["dropped"]:
+        failures.append(
+            f"chaos soak slab_dropped_samples ="
+            f" {chaos_counters['slab_dropped_samples']}, pinned"
+            f" {chaos_oracle['dropped']} (the late burst's too-late events)"
+        )
+    if chaos_oracle["dropped"] == 0:
+        failures.append("chaos late burst dropped nothing; the schedule lost its teeth")
+
+    print(json.dumps({
+        "check": "service",
+        "ok": not failures,
+        "failures": failures,
+        "parity": parity,
+        "clean": {
+            "published": sorted(p["window"] for p in clean["publications"]),
+            "dropped": clean["service"].metric.dropped_samples,
+            "elapsed_s": round(clean["elapsed_s"], 3),
+            "faults": clean_counters["faults"],
+        },
+        "chaos": {
+            "published": sorted(p["window"] for p in chaos["publications"]),
+            "dropped": chaos["service"].metric.dropped_samples,
+            "elapsed_s": round(chaos["elapsed_s"], 3),
+            "budget_s": SERVICE_SOAK_BUDGET_S,
+            "faults": chaos_counters["faults"],
+            "slab_dropped_samples": chaos_counters["slab_dropped_samples"],
+            "injected": chaos["injected"],
+            "preempted": chaos["preempted"],
+        },
+    }))
+    return 1 if failures else 0
+
+
 def main() -> None:
     trace_path = _trace_arg(sys.argv)
     if len(sys.argv) > 1 and sys.argv[1] == "--check-trajectory":
@@ -1443,6 +1883,16 @@ def main() -> None:
         # jax not yet imported, so the platform pin lands in-process
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         raise SystemExit(check_faults())
+
+    if len(sys.argv) > 1 and sys.argv[1] == "--check-service":
+        # serving-runtime gate: the soaks are host-plane, but the parity
+        # scenarios trace the (4,2) mesh — virtual devices needed (jax not
+        # yet imported, so the flag lands in-process)
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={N_DEVICES}"
+        ).strip()
+        raise SystemExit(check_service())
 
     if len(sys.argv) > 1 and sys.argv[1] == "--check-collectives":
         # collective regression gate: jax is not yet imported, so the

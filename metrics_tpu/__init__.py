@@ -125,5 +125,7 @@ from metrics_tpu.wrappers import (  # noqa: E402
     MinMaxMetric,
     MultioutputWrapper,
     Running,
+    Windowed,
 )
+from metrics_tpu.serving import MetricService  # noqa: E402
 from metrics_tpu import functional  # noqa: E402

@@ -1,12 +1,42 @@
-"""Shared base for sum/count streaming metrics.
+"""Streaming-plane core: sum/count metric base + the windowed-runtime math.
 
-Many metrics reduce to "sum of per-sample statistics divided by a count":
-two states, both plain ``"sum"`` reductions — O(1) memory, one fused psum to
-sync, counts in the package integer accumulator dtype (float32 counts stop
-incrementing at 2^24; int states get the overflow warning and widen to int64
-under ``jax_enable_x64``).
+Two things live here:
+
+1. :class:`SumCountMetric` — the shared base for metrics that reduce to
+   "sum of per-sample statistics divided by a count": two states, both plain
+   ``"sum"`` reductions — O(1) memory, one fused psum to sync, counts in the
+   package integer accumulator dtype (float32 counts stop incrementing at
+   2^24; int states get the overflow warning and widen to int64 under
+   ``jax_enable_x64``).
+
+2. The **windowed serving-plane math**: :class:`WindowSpec` (tumbling
+   windows of ``window_s`` seconds over a ring of ``num_windows`` slots,
+   with an ``allowed_lateness_s`` grace), :func:`route_events` (the
+   watermark-advancing event router every ``Windowed.update`` call runs),
+   and :func:`decay_scale` (the exponential time-decay accumulator's per-
+   batch scale). These are pure host-side numpy functions — the routing
+   decision is data-dependent host work by construction (the same argument
+   as the LRU slot table in ``parallel/slab.py``), while the scatter that
+   CONSUMES the resolved slot ids stays an XLA ``segment_sum``.
+
+Routing contract (what makes the windowed plane testable): for one batch,
+the watermark first advances to ``max(old watermark, max(event_time))``;
+an event is then accepted iff its WINDOW is still open — ``(window + 1) *
+window_s + allowed_lateness_s > watermark`` (a window stays open for
+``allowed_lateness_s`` past its end; head-window events are never late).
+Accepted events route to ``window % num_windows`` (the head window scatters
+normally, late-but-within-lateness events land in their still-open prior
+slot); rejected events get slot ``-1`` — DROPPED by the slab scatter's XLA
+out-of-bounds semantics, never misrouted — and are counted
+(``slab_dropped_samples``). Because a verdict depends only on the event's
+window and the running watermark maximum, shuffling a stream whose every
+event stays within the allowed lateness of the stream maximum changes
+neither verdicts nor slot ids, and the scatter-adds commute: in-order and
+shuffled streams produce bit-exact window slabs
+(``tests/wrappers/test_windowed.py`` pins it).
 """
-from typing import Any, Callable, Optional, Tuple
+import math
+from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -14,6 +44,15 @@ from jax import Array
 
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.utils.data import accum_int_dtype
+
+__all__ = [
+    "RouteResult",
+    "SumCountMetric",
+    "WindowSpec",
+    "decay_scale",
+    "route_events",
+    "window_index",
+]
 
 
 class SumCountMetric(Metric):
@@ -53,3 +92,130 @@ class SumCountMetric(Metric):
 
     def compute(self) -> Array:
         return self._finalize(self.total / jnp.maximum(self.count, 1).astype(jnp.float32))
+
+
+# --------------------------------------------------- windowed serving plane
+class WindowSpec(NamedTuple):
+    """Tumbling-window layout of the windowed serving plane.
+
+    ``window_s`` seconds per window over a ring of ``num_windows`` slots
+    (window ``w`` covers ``[w*window_s, (w+1)*window_s)`` and lives in slot
+    ``w % num_windows``); ``allowed_lateness_s`` is how far behind the
+    watermark an event may arrive and still be routed to its (still-open)
+    window. Lateness is capped at ``(num_windows - 1) * window_s`` — beyond
+    that a within-lateness event's slot could already be recycled, which
+    would misroute it into a newer window (the one failure mode the plane
+    promises never happens).
+    """
+
+    window_s: float
+    num_windows: int
+    allowed_lateness_s: float = 0.0
+
+    def validate(self) -> "WindowSpec":
+        if not (isinstance(self.window_s, (int, float)) and self.window_s > 0):
+            raise ValueError(f"`window_s` must be a positive number, got {self.window_s!r}")
+        if not (isinstance(self.num_windows, int) and self.num_windows >= 1):
+            raise ValueError(f"`num_windows` must be a positive int, got {self.num_windows!r}")
+        if not (isinstance(self.allowed_lateness_s, (int, float)) and self.allowed_lateness_s >= 0):
+            raise ValueError(
+                f"`allowed_lateness_s` must be >= 0, got {self.allowed_lateness_s!r}"
+            )
+        if self.allowed_lateness_s > (self.num_windows - 1) * self.window_s:
+            raise ValueError(
+                f"allowed_lateness_s={self.allowed_lateness_s} exceeds the ring's"
+                f" still-open horizon ({self.num_windows - 1} x window_s ="
+                f" {(self.num_windows - 1) * self.window_s}s); a within-lateness event"
+                " could land in a recycled slot. Raise num_windows or shrink the"
+                " lateness."
+            )
+        return self
+
+
+def window_index(event_times: Any, window_s: float) -> np.ndarray:
+    """Window index of each event time: ``floor(t / window_s)`` (int64)."""
+    t = np.asarray(event_times, dtype=np.float64)
+    return np.floor_divide(t, float(window_s)).astype(np.int64)
+
+
+class RouteResult(NamedTuple):
+    """One batch's routing verdict (see the module docstring contract).
+
+    ``slot_ids``: int32 per-sample slot, ``-1`` for dropped (too-late)
+    events — the slab scatter drops them by XLA out-of-bounds semantics.
+    ``watermark``/``head``: the advanced stream position AFTER this batch.
+    ``opened``: window indices newly opened by this batch, oldest first —
+    their ring slots hold expired windows and must be reset BEFORE the
+    scatter. ``n_dropped``/``n_late``: dropped vs accepted-but-late counts.
+    ``min_window``: the oldest window this batch accepted an event into
+    (``None`` if every event dropped) — the wrapper's stream-origin
+    bookkeeping, so windows before the first event are never reported as
+    resident.
+    """
+
+    slot_ids: np.ndarray
+    watermark: float
+    head: int
+    opened: Tuple[int, ...]
+    n_dropped: int
+    n_late: int
+    min_window: Optional[int]
+
+
+def route_events(
+    event_times: Any,
+    watermark: Optional[float],
+    head: Optional[int],
+    spec: WindowSpec,
+) -> RouteResult:
+    """Route one batch of event times through the advancing watermark.
+
+    ``watermark``/``head`` are the stream position before the batch
+    (``None`` on the very first batch). Pure host numpy — deterministic,
+    thread-free, and independently recomputable (the service gate's oracle
+    replays the same arithmetic from the raw stream).
+    """
+    t = np.asarray(event_times, dtype=np.float64).reshape(-1)
+    if t.size == 0:
+        return RouteResult(
+            np.empty((0,), dtype=np.int32),
+            -math.inf if watermark is None else watermark,
+            -1 if head is None else head,
+            (),
+            0,
+            0,
+            None,
+        )
+    if not np.isfinite(t).all():
+        raise ValueError("event_time must be finite (got NaN/inf timestamps)")
+    new_wm = float(t.max()) if watermark is None else max(float(watermark), float(t.max()))
+    new_head = int(math.floor(new_wm / spec.window_s))
+    w = window_index(t, spec.window_s)
+    # an event is accepted iff its window is still open: a window stays open
+    # for allowed_lateness_s past its end, and the head window can never be
+    # late. The validated lateness cap makes an open window's slot resident
+    # by construction; keep the residency guard so a hand-built spec can
+    # never scatter into a recycled slot.
+    accepted = (w + 1) * spec.window_s + spec.allowed_lateness_s > new_wm
+    accepted &= w > new_head - spec.num_windows
+    slot_ids = np.where(accepted, w % spec.num_windows, -1).astype(np.int32)
+    n_dropped = int((~accepted).sum())
+    n_late = int((accepted & (w < new_head)).sum())
+    min_window = int(w[accepted].min()) if accepted.any() else None
+    if head is None or head < new_head - spec.num_windows:
+        # first batch, or a jump past the whole ring: every slot the new
+        # horizon can see starts fresh
+        opened = tuple(range(new_head - spec.num_windows + 1, new_head + 1))
+    else:
+        opened = tuple(range(head + 1, new_head + 1))
+    return RouteResult(slot_ids, new_wm, new_head, opened, n_dropped, n_late, min_window)
+
+
+def decay_scale(dt_s: Any, half_life_s: float) -> Any:
+    """Exponential time-decay factor ``0.5 ** (dt / half_life)``.
+
+    The decay accumulator's two uses: scaling the whole accumulator forward
+    by the watermark advance, and weighting each sample's delta by its age
+    relative to the new watermark (``dt = watermark - event_time``).
+    """
+    return 0.5 ** (np.asarray(dt_s, dtype=np.float64) / float(half_life_s))

@@ -46,6 +46,25 @@ What gets counted, and on which plane:
   because the state pytree was empty/all-``None`` (a zero-payload gather is
   a pure liability: one more rendezvous every rank must enter). A health
   counter, not a fault — nonzero on clean runs is fine.
+- **slab_dropped_samples**: samples whose slot id fell outside a slab's
+  ``[0, K)`` range and were therefore DROPPED by the scatter's XLA
+  out-of-bounds semantics (``parallel/slab.py``) — bad segment ids in
+  ``Keyed``, and the windowed plane's too-late events (``wrappers/
+  windowed.py`` routes them to slot ``-1`` by design). Like the fault
+  counters, this records even while counting is DISABLED: a silently
+  vanishing sample is operationally important evidence, and the drop is
+  decided host-side on the eager path so counting it costs one readback
+  that path already pays. Pinned at zero on the clean bench trajectory
+  (``--check-trajectory``); nonzero is EXPECTED under late-event chaos
+  (the ``--check-service`` gate pins the exact count).
+- **service_health**: per-service health gauges for the serving runtime
+  (``serving/service.py``): ``{label: {"state": healthy|degraded|shedding,
+  "shed_events": n, "published": m, "queue_depth": d}}``. ``state`` is the
+  supervised loop's current verdict (last publish degraded -> degraded;
+  ingress shed since last publish -> shedding), refreshed on every
+  processed batch and every publish. Recorded unconditionally (a gauge
+  write is one dict store; health must not vanish because observability
+  was off).
 - **state_bytes**: a per-metric GAUGE of the current state footprint
   (``{metric class name: bytes}``), refreshed after every eager
   update/sync while counting is enabled. This is how the sketch-vs-buffer
@@ -83,6 +102,8 @@ __all__ = [
     "record_collective",
     "record_fault",
     "record_gather_skip",
+    "record_service_health",
+    "record_slab_dropped",
     "record_slab_slots",
     "record_state_bytes",
     "record_states_synced",
@@ -137,8 +158,10 @@ class CollectiveCounters:
         "launch_cache_misses",
         "faults",
         "gather_skips",
+        "slab_dropped_samples",
         "state_bytes",
         "slab_slots",
+        "service_health",
         "_lock",
     )
 
@@ -161,8 +184,10 @@ class CollectiveCounters:
         self.launch_cache_misses = 0
         self.faults: Dict[str, int] = {k: 0 for k in FAULT_KINDS}
         self.gather_skips = 0
+        self.slab_dropped_samples = 0  # out-of-range slot ids dropped by slab scatters
         self.state_bytes: Dict[str, int] = {}  # metric class name -> latest bytes
         self.slab_slots: Dict[str, Dict[str, int]] = {}  # keyed-slab label -> gauges
+        self.service_health: Dict[str, Dict[str, Any]] = {}  # service label -> health gauges
 
     # ---------------------------------------------------------- recording
     def record_collective(
@@ -212,6 +237,26 @@ class CollectiveCounters:
         with self._lock:
             self.gather_skips += 1
 
+    def record_slab_dropped(self, n: int = 1) -> None:
+        """Count samples dropped by a slab scatter's out-of-range slot ids
+        (negative n is a bug at the call site — fail loudly)."""
+        if n < 0:
+            raise ValueError(f"dropped-sample count must be >= 0, got {n}")
+        with self._lock:
+            self.slab_dropped_samples += int(n)
+
+    def record_service_health(
+        self, label: str, state: str, shed_events: int, published: int, queue_depth: int
+    ) -> None:
+        """Refresh one serving loop's health gauges (latest value wins)."""
+        with self._lock:
+            self.service_health[label] = {
+                "state": str(state),
+                "shed_events": int(shed_events),
+                "published": int(published),
+                "queue_depth": int(queue_depth),
+            }
+
     def record_state_bytes(self, metric: str, nbytes: int) -> None:
         """Refresh the per-metric state-footprint gauge (latest value wins —
         a gauge, not an accumulator: the number IS the current footprint)."""
@@ -249,8 +294,10 @@ class CollectiveCounters:
                 "states_synced": self.states_synced,
                 "faults": dict(self.faults),
                 "gather_skips": self.gather_skips,
+                "slab_dropped_samples": self.slab_dropped_samples,
                 "state_bytes": dict(sorted(self.state_bytes.items())),
                 "slab_slots": {k: dict(v) for k, v in sorted(self.slab_slots.items())},
+                "service_health": {k: dict(v) for k, v in sorted(self.service_health.items())},
                 "group_cache": {"hits": self.group_cache_hits, "misses": self.group_cache_misses},
                 "step_cache": {"hits": self.step_cache_hits, "misses": self.step_cache_misses},
                 "launch_cache": {"hits": self.launch_cache_hits, "misses": self.launch_cache_misses},
@@ -293,6 +340,21 @@ def record_fault(kind: str, n: int = 1) -> None:
 
 def record_gather_skip() -> None:
     COUNTERS.record_gather_skip()
+
+
+# Dropped-sample evidence records UNCONDITIONALLY, same argument as the
+# fault counters: a sample that silently vanished from a slab must leave a
+# trail even when observability is off.
+def record_slab_dropped(n: int = 1) -> None:
+    COUNTERS.record_slab_dropped(n)
+
+
+# Service health is a gauge refresh (one dict store) and operationally
+# important — recorded unconditionally like the fault counters.
+def record_service_health(
+    label: str, state: str, shed_events: int = 0, published: int = 0, queue_depth: int = 0
+) -> None:
+    COUNTERS.record_service_health(label, state, shed_events, published, queue_depth)
 
 
 def record_state_bytes(metric: str, nbytes: int) -> None:

@@ -54,6 +54,7 @@ MS_KEYS: Tuple[str, ...] = (
     "gather_flat2d_ms",
     "sketch_sync_ms",
     "keyed_sync_ms",
+    "service_sync_ms",
 )
 
 # staged-collective keys gated exactly (no growth) vs the latest prior round
@@ -91,15 +92,27 @@ COUNT_KEYS: Tuple[str, ...] = (
     "keyed_gather_calls",
     "keyed_states_synced",
     "keyed_unkeyed_collective_calls",
+    # the windowed serving plane: staged counts must stay window-count-
+    # independent (equal to the unwindowed metric's) and psum-only; any
+    # growth is a regression of the windows-as-a-state-axis story
+    "service_collective_calls",
+    "service_sync_bytes",
+    "service_gather_calls",
+    "service_states_synced",
+    "service_unwindowed_collective_calls",
 )
 
 # fault counters: bound at exactly zero whenever the current line carries
-# them (no baseline needed — zero IS the contract on a clean run)
+# them (no baseline needed — zero IS the contract on a clean run).
+# slab_dropped_samples rides here too: the bench scenarios route only
+# in-range slot ids / in-window events, so a clean line that dropped a
+# sample means a slab scatter silently lost data.
 FAULT_KEYS: Tuple[str, ...] = (
     "sync_retries",
     "sync_deadline_exceeded",
     "degraded_computes",
     "quarantined_updates",
+    "slab_dropped_samples",
 )
 
 TOLERANCES: Dict[str, float] = {

@@ -22,7 +22,26 @@ kinds:
 - ``preempt``: raises :class:`~metrics_tpu.utils.exceptions.PreemptionError`
   — the SIGTERM-mid-epoch analogue. Never retried; the caller is expected to
   checkpoint/restore and replay through the epoch watermark
-  (``Metric.guarded_update``).
+  (``Metric.guarded_update``). Addressed at a ``site="service.ingest"`` it is
+  the MID-WINDOW preempt of the serving runtime: the ``MetricService`` worker
+  dies between two batches of an open window and must resume from its last
+  snapshot with idempotent replay.
+
+Three further kinds target the SERVING PLANE (``serving/service.py``). They
+are consumed through :meth:`ChaosInjector.ingest_faults` — the service asks
+the injector what fires on each ingest call and applies the semantics itself
+(the injector never touches event payloads it has not been handed):
+
+- ``ingest_stall``: the service's ingest path sleeps ``duration_s`` before
+  processing the batch — the lever that backs up the bounded ingress queue
+  into the shed policy (``drop_oldest`` counts ``shed_events``, ``block``
+  exerts backpressure on the producer).
+- ``clock_skew``: the batch's event times shift by ``skew_s`` seconds (a
+  producer with a skewed clock; positive skew jumps the watermark forward,
+  making honest followers late).
+- ``late_burst``: the batch's event times shift by ``-skew_s`` — a delivery
+  burst of OLD events, exercising the late-routing and (beyond the allowed
+  lateness) the drop-and-count path (``slab_dropped_samples``).
 
 Faults are *scenario-addressable*: a spec pins the exact gather call index it
 fires on (``call=``, counted per site from injector install), or fires
@@ -65,7 +84,12 @@ __all__ = [
     "current_injector",
 ]
 
-FAULT_KINDS = ("stall", "drop", "corrupt", "preempt")
+FAULT_KINDS = ("stall", "drop", "corrupt", "preempt",
+               "ingest_stall", "clock_skew", "late_burst")
+
+# the kinds ingest_faults() surfaces to the serving loop (preempt doubles as
+# the mid-window kill when addressed at a service site)
+SERVICE_FAULT_KINDS = ("ingest_stall", "clock_skew", "late_burst", "preempt")
 
 
 class FaultSpec(NamedTuple):
@@ -79,12 +103,13 @@ class FaultSpec(NamedTuple):
     (``times`` large, exhausting the budget into raise/degrade).
     """
 
-    kind: str  # 'stall' | 'drop' | 'corrupt' | 'preempt'
+    kind: str  # one of FAULT_KINDS
     call: Optional[int] = None
     times: int = 1
-    duration_s: float = 0.0  # stall length
+    duration_s: float = 0.0  # stall / ingest_stall length
     rate: float = 0.0  # per-call probability when call is None
     site: str = "host_gather"
+    skew_s: float = 0.0  # clock_skew shift (late_burst shifts by -skew_s)
 
 
 class ChaosInjector:
@@ -128,6 +153,16 @@ class ChaosInjector:
     def _fire(self, spec: FaultSpec) -> None:
         self.injected[spec.kind] += 1
 
+    def verdict(self, spec: FaultSpec, site: str, idx: int) -> bool:
+        """Whether ``spec`` fires on call ``idx`` at ``site`` — thread-safe,
+        and STABLE: rate-based verdicts are decided once per (spec, call)
+        from the seeded RNG and cached, so every thread (the service's
+        background worker, deadline workers, the main thread) observing the
+        same call sees the same answer. The determinism audit in
+        ``tests/parallel/test_faults.py`` pins this."""
+        with self._lock:
+            return self._matches(spec, site, idx)
+
     # ------------------------------------------------------- hook interface
     def note_call(self, site: str) -> int:
         """Assign the next site-relative call index (sync.py calls this once
@@ -165,6 +200,30 @@ class ChaosInjector:
             else:
                 return
         time.sleep(duration)  # outside the lock: a stall must not block peers
+
+    def ingest_faults(self, site: str, idx: int) -> List[FaultSpec]:
+        """The service-plane specs firing on ingest call ``idx`` at ``site``
+        (kinds in :data:`SERVICE_FAULT_KINDS`; the serving loop applies the
+        semantics — sleep, time shift, preemption — itself).
+
+        Unlike the gather hook there are no retries at ingest, so ``times``
+        here means CONSECUTIVE CALLS: a call-pinned spec fires on calls
+        ``call .. call + times - 1``. Thread-safe and seeded like the gather
+        path; fired kinds count into ``injected``.
+        """
+        fired: List[FaultSpec] = []
+        with self._lock:
+            for spec in self.schedule:
+                if spec.kind not in SERVICE_FAULT_KINDS or spec.site != site:
+                    continue
+                if spec.call is not None:
+                    if not (spec.call <= idx < spec.call + spec.times):
+                        continue
+                elif not self._matches(spec, site, idx):
+                    continue
+                self._fire(spec)
+                fired.append(spec)
+        return fired
 
     def after_call(self, site: str, idx: int, attempt: int, result: Any) -> Any:
         """Runs on the gathered result; may corrupt payloads (NaN-poison)."""

@@ -48,6 +48,7 @@ __all__ = [
     "LRUSlotTable",
     "SLAB_REDUCES",
     "SlabSpec",
+    "dropped_slot_count",
     "is_slab_spec",
     "make_slab_spec",
     "slab_init",
@@ -173,6 +174,24 @@ def slab_scatter(reduce: str, deltas: Array, slot_ids: Array, num_slots: int) ->
     if reduce == "max":
         return jax.ops.segment_max(deltas, slot_ids, num_segments=num_slots)
     raise ValueError(f"slab reduce must be one of {SLAB_REDUCES}, got {reduce!r}")
+
+
+def dropped_slot_count(slot_ids: Any, num_slots: int) -> int:
+    """How many of ``slot_ids`` fall outside ``[0, num_slots)`` — the samples
+    :func:`slab_scatter` silently DROPS by XLA out-of-bounds semantics.
+
+    Host-side by design (one readback of the small id vector on the eager
+    path; never call under tracing): the drop itself is a device-side
+    non-event, so the evidence has to come from the ids. Call sites feed
+    ``observability.counters.record_slab_dropped`` — which, like the fault
+    counters, records even with observability off — so a vanished sample
+    always leaves a trail. The windowed plane's too-late events reuse this
+    path deliberately (slot ``-1`` = drop-and-count, never misroute).
+    """
+    ids = np.asarray(slot_ids).reshape(-1)
+    if ids.size == 0:
+        return 0
+    return int(((ids < 0) | (ids >= num_slots)).sum())
 
 
 def slab_merge(reduce: str, acc: Array, delta: Array) -> Array:

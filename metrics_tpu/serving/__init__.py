@@ -1,0 +1,11 @@
+"""Serving runtime: the supervised streaming loop around the window plane.
+
+``MetricService`` owns update -> window-roll -> guarded sync -> publish for
+a :class:`~metrics_tpu.wrappers.windowed.Windowed` metric: a bounded ingress
+queue with a shed policy, per-window sync deadlines that degrade instead of
+stalling the stream, crash-safe snapshot/restore riding the epoch watermark,
+and health gauges. See ``docs/streaming.md``.
+"""
+from metrics_tpu.serving.service import HEALTH_STATES, MetricService, ServiceStoppedError
+
+__all__ = ["HEALTH_STATES", "MetricService", "ServiceStoppedError"]

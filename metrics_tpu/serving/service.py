@@ -1,0 +1,471 @@
+"""MetricService: the fault-hardened serving loop over the window plane.
+
+A deployed metric system is not an epoch loop — it is a process watching an
+unbounded stream, and everything that can go wrong eventually does: events
+arrive late, the producer outruns the consumer, a sync rendezvous stalls, the
+host is preempted mid-window. ``MetricService`` packages the answers this
+library already has into one supervised loop:
+
+- **Bounded ingress + shed policy.** ``submit()`` feeds a bounded queue; a
+  background worker drains it. When the queue is full, ``shed_policy=
+  "block"`` exerts backpressure on the producer and ``"drop_oldest"`` sheds
+  the oldest queued batch with a counter (``shed_events``) — the stream
+  keeps moving either way, and shedding flips the health gauge to
+  ``shedding``.
+- **Watermark-aware windowing.** The worker drives
+  :class:`~metrics_tpu.wrappers.windowed.Windowed` (``update(...,
+  event_time=)``): in-window events scatter into the head slot, late events
+  within the allowed lateness reach their still-open window, too-late events
+  are dropped and counted — never misrouted.
+- **Per-window deadline, degrade over stall.** As the watermark closes a
+  window (no event within the allowed lateness can still reach it), the
+  service publishes it. The merged sliding view syncs under the service's
+  :class:`~metrics_tpu.parallel.sync.SyncGuard`; a window whose sync cannot
+  complete inside the deadline budget degrades to LOCAL-ONLY state and
+  publishes with ``degraded=True`` — the stream never stalls on a sick
+  peer (``degraded_computes`` bumps, health flips to ``degraded``).
+- **Crash-safe snapshot/restore.** Every publish refreshes
+  :attr:`last_snapshot` (the metric's ``state_dict`` — slabs, watermark,
+  head window, drop counters, epoch watermark — plus the service's ingest
+  bookkeeping). After a preemption (a chaos-injected ``preempt`` at the
+  ingest site, or a real SIGTERM), build a fresh service, ``restore()`` the
+  snapshot, and replay the stream from ``snapshot["processed"]`` onward
+  (or from anywhere at-or-before it, passing the original ``seq=`` ids):
+  replayed steps below the epoch watermark are no-ops, so the batch in
+  flight at the kill can never double-count.
+- **Chaos-soaked.** The worker consults the installed
+  :class:`~metrics_tpu.parallel.faults.ChaosInjector` on every ingest call
+  (site ``service.ingest``): ``ingest_stall`` sleeps the worker (backing the
+  queue up into the shed policy), ``clock_skew``/``late_burst`` shift the
+  batch's event times, ``preempt`` kills the worker mid-window.
+  ``bench.py --check-service`` soaks the whole loop under a seeded schedule
+  and pins bit-exactness, drop counts, and zero misrouting.
+
+Everything is host-plane supervision; the device-side cost is unchanged —
+one scatter per update, and sync rides the same coalesced psum buckets as
+the unwindowed metric.
+"""
+import math
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.observability.counters import COUNTERS as _COUNTERS, record_service_health
+from metrics_tpu.parallel.sync import SyncGuard, set_sync_guard
+from metrics_tpu.utils.exceptions import MetricsTPUError, PreemptionError
+from metrics_tpu.wrappers.windowed import Windowed
+
+__all__ = ["HEALTH_STATES", "MetricService", "ServiceStoppedError"]
+
+HEALTH_STATES = ("healthy", "degraded", "shedding")
+
+_SHED_POLICIES = ("block", "drop_oldest")
+
+# the injector site the ingest path consults (FaultSpec(site=...))
+INGEST_SITE = "service.ingest"
+
+
+class ServiceStoppedError(MetricsTPUError, RuntimeError):
+    """The service's worker is not accepting events (stopped, preempted, or
+    failed). ``MetricService.error`` holds the cause when there is one."""
+
+
+class MetricService:
+    """Supervised update -> window-roll -> guarded-sync -> publish loop.
+
+    Args:
+        metric: the :class:`Windowed` metric the loop drives (the ring form;
+            pair with :class:`~metrics_tpu.wrappers.keyed.Keyed` inside for
+            per-cohort windows).
+        queue_size: ingress queue bound (batches, not samples).
+        shed_policy: ``"block"`` (producer backpressure) or ``"drop_oldest"``
+            (shed the oldest queued batch, count it).
+        guard: the :class:`SyncGuard` every publish-time sync runs under.
+            Default: degrade-over-stall with a 5 s per-call deadline — a
+            serving loop must publish late rather than never.
+        publish_fn: optional callback receiving each publication record.
+        label: gauge label (default ``MetricService(<inner>)``).
+
+    The worker thread starts immediately; use as a context manager or call
+    :meth:`stop`. ``submit`` raises :class:`ServiceStoppedError` once the
+    worker is no longer accepting (stopped/preempted/failed).
+    """
+
+    def __init__(
+        self,
+        metric: Windowed,
+        queue_size: int = 1024,
+        shed_policy: str = "block",
+        guard: Optional[SyncGuard] = None,
+        publish_fn: Optional[Callable[[Dict[str, Any]], None]] = None,
+        label: Optional[str] = None,
+        poll_interval_s: float = 0.02,
+    ):
+        if not isinstance(metric, Windowed):
+            raise ValueError(
+                f"`metric` must be a Windowed metric (the service's loop is the"
+                f" window plane's supervisor), got {type(metric).__name__}"
+            )
+        if metric.decay:
+            raise ValueError(
+                "the decay accumulator has no window roll to supervise; give the"
+                " service a windowed ring (Windowed(..., window_s=))"
+            )
+        if shed_policy not in _SHED_POLICIES:
+            raise ValueError(f"`shed_policy` must be one of {_SHED_POLICIES}, got {shed_policy!r}")
+        if not (isinstance(queue_size, int) and queue_size >= 1):
+            raise ValueError(f"`queue_size` must be a positive int, got {queue_size!r}")
+        self.metric = metric
+        self.shed_policy = shed_policy
+        self.guard = guard if guard is not None else SyncGuard(
+            deadline_s=5.0, max_retries=2, backoff_s=0.05, policy="degrade"
+        )
+        if self.guard.policy not in ("raise", "degrade"):
+            raise ValueError(f"guard.policy must be 'raise' or 'degrade', got {self.guard.policy!r}")
+        self.publish_fn = publish_fn
+        self.label = label or f"MetricService({type(metric.metric).__name__})"
+        self.poll_interval_s = float(poll_interval_s)
+
+        self._queue: "queue.Queue" = queue.Queue(maxsize=queue_size)
+        self._seq = 0  # next auto-assigned submission seq
+        self._processed = 0  # items fully applied (or idempotently skipped)
+        self._ingest_idx = 0  # fault-addressable ingest call counter
+        self._published_through: Optional[int] = None  # highest window published
+        self.publications: List[Dict[str, Any]] = []
+        self.shed_events = 0
+        self._shed_since_publish = 0
+        self._last_publish_degraded = False
+        self.last_snapshot: Optional[Dict[str, Any]] = None
+        self._error: Optional[BaseException] = None
+
+        self._proc_lock = threading.RLock()  # one item / one snapshot at a time
+        self._submit_lock = threading.Lock()  # seq assignment + shed atomicity
+        self._stop = threading.Event()
+        self._state = "running"
+        self._worker = threading.Thread(
+            target=self._run, daemon=True, name=f"mtpu-service-{id(self):x}"
+        )
+        self._worker.start()
+        self._note_health()
+
+    # ------------------------------------------------------------- ingress
+    @property
+    def state(self) -> str:
+        """``running`` / ``stopped`` / ``preempted`` / ``failed``."""
+        return self._state
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        """What killed the worker, when ``state`` is preempted/failed."""
+        return self._error
+
+    @property
+    def health(self) -> str:
+        """``healthy`` / ``degraded`` / ``shedding`` (the gauge value)."""
+        if self._shed_since_publish:
+            return "shedding"
+        if self._last_publish_degraded:
+            return "degraded"
+        return "healthy"
+
+    @property
+    def processed(self) -> int:
+        """Batches fully applied (or idempotently skipped on replay)."""
+        return self._processed
+
+    def submit(self, *args: Any, event_time: Any = None, seq: Optional[int] = None,
+               **kwargs: Any) -> int:
+        """Enqueue one batch; returns its replay sequence id.
+
+        ``event_time`` is forwarded to ``Windowed.update``. ``seq`` is the
+        idempotent-replay id — auto-assigned in submission order normally;
+        pass the ORIGINAL ids when replaying a stream into a restored
+        service (steps below the restored epoch watermark no-op).
+
+        With the queue full, ``block`` waits (producer backpressure) and
+        ``drop_oldest`` shed the oldest queued batch first (counted; health
+        flips to ``shedding`` until the next publish).
+        """
+        if event_time is None:
+            raise ValueError("MetricService.submit requires `event_time=`")
+        if self._state != "running":
+            raise ServiceStoppedError(
+                f"service is {self._state}; not accepting events"
+                + (f" (cause: {self._error!r})" if self._error else "")
+            )
+        times = np.asarray(event_time, dtype=np.float64)
+        with self._submit_lock:
+            if seq is None:
+                seq = self._seq
+            self._seq = max(self._seq, seq + 1)
+            item = (seq, args, times, kwargs)
+            if self.shed_policy == "block":
+                # backpressure with a live-worker check: blocking forever on
+                # a dead worker would hang the producer
+                while True:
+                    try:
+                        self._queue.put(item, timeout=self.poll_interval_s)
+                        break
+                    except queue.Full:
+                        if self._state != "running":
+                            raise ServiceStoppedError(
+                                f"service is {self._state} with a full queue;"
+                                " event not accepted"
+                            ) from None
+            else:
+                while True:
+                    try:
+                        self._queue.put_nowait(item)
+                        break
+                    except queue.Full:
+                        try:
+                            self._queue.get_nowait()
+                            self._queue.task_done()
+                        except queue.Empty:
+                            continue
+                        self.shed_events += 1
+                        self._shed_since_publish += 1
+                        self._note_health()
+        return seq
+
+    # ------------------------------------------------------------ the loop
+    def _run(self) -> None:
+        while True:
+            try:
+                item = self._queue.get(timeout=self.poll_interval_s)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            try:
+                with self._proc_lock:
+                    self._process(*item)
+            except PreemptionError as err:
+                self._error = err
+                self._state = "preempted"
+                return
+            except BaseException as err:  # noqa: BLE001 - the supervisor records, not hides
+                self._error = err
+                self._state = "failed"
+                return
+            finally:
+                self._queue.task_done()
+
+    def _process(self, seq: int, args: tuple, times: np.ndarray, kwargs: dict) -> None:
+        from metrics_tpu.parallel import faults
+
+        injector = faults.current_injector()
+        idx = self._ingest_idx
+        self._ingest_idx += 1
+        if injector is not None:
+            for spec in injector.ingest_faults(INGEST_SITE, idx):
+                if spec.kind == "ingest_stall":
+                    time.sleep(spec.duration_s)
+                elif spec.kind == "clock_skew":
+                    times = times + spec.skew_s
+                elif spec.kind == "late_burst":
+                    times = times - spec.skew_s
+                elif spec.kind == "preempt":
+                    raise PreemptionError(
+                        f"injected service preemption at ingest call {idx} (seq {seq})"
+                    )
+        self.metric.guarded_update(seq, *args, event_time=times, **kwargs)
+        self._processed += 1
+        self._publish_closed()
+        self._note_health()
+
+    def _closed_through(self) -> Optional[int]:
+        """Highest window index no future event can reach: ``w`` is closed
+        once ``(w + 1) * window_s + allowed_lateness_s <= watermark``."""
+        wm = self.metric.watermark
+        if wm is None:
+            return None
+        m = self.metric
+        return int(math.floor((wm - m.allowed_lateness_s) / m.window_s)) - 1
+
+    def _publish_closed(self, force_through: Optional[int] = None) -> None:
+        closed = self._closed_through() if force_through is None else force_through
+        if closed is None:
+            return
+        for window in self.metric.resident_windows():
+            if window > closed:
+                break
+            if self._published_through is not None and window <= self._published_through:
+                continue
+            self._publish(window)
+
+    def _publish(self, window: int) -> None:
+        """Publish one closed window: the guarded merged view + the window's
+        own value, stamped ``degraded=`` when the sync fell back to
+        local-only state, then refresh the crash snapshot."""
+        before = _COUNTERS.faults["degraded_computes"]
+        old_guard = set_sync_guard(self.guard)
+        try:
+            self.metric._computed = None  # publish-time values, not a stale cache
+            merged = self.metric.compute()
+        finally:
+            set_sync_guard(old_guard)
+        degraded = _COUNTERS.faults["degraded_computes"] > before
+        value = self.metric.compute_window(window)
+        record = {
+            "window": window,
+            "window_start_s": window * self.metric.window_s,
+            "value": _host(value),
+            "merged": _host(merged),
+            "degraded": degraded,
+            "watermark": self.metric.watermark,
+            "dropped_samples": self.metric.dropped_samples,
+            "shed_events": self.shed_events,
+        }
+        self.publications.append(record)
+        self._published_through = window
+        self._last_publish_degraded = degraded
+        self._shed_since_publish = 0
+        self.last_snapshot = self._snapshot_locked()
+        if self.publish_fn is not None:
+            self.publish_fn(record)
+        self._note_health()
+
+    def _note_health(self) -> None:
+        record_service_health(
+            self.label, self.health, self.shed_events, len(self.publications),
+            self._queue.qsize(),
+        )
+
+    # ---------------------------------------------------------- lifecycle
+    def flush(self, timeout_s: float = 30.0) -> None:
+        """Block until every submitted batch has been processed.
+
+        Raises the worker's error if it died (preempted/failed) with work
+        still queued, and ``TimeoutError`` past ``timeout_s``.
+        """
+        deadline = time.monotonic() + timeout_s
+        while True:
+            # a dead worker first: an empty queue after a preempt means the
+            # in-flight batch was dropped, not drained
+            if self._state in ("preempted", "failed"):
+                raise self._error
+            if self._queue.unfinished_tasks == 0:
+                return
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"service did not drain within {timeout_s}s"
+                    f" (queue depth {self._queue.qsize()})"
+                )
+            time.sleep(self.poll_interval_s / 2)
+
+    def finalize(self, timeout_s: float = 30.0) -> Any:
+        """Drain, force-publish every still-open resident window, and return
+        the merged sliding value. The end-of-stream flush: open windows are
+        published as they stand (stamped like any other publish)."""
+        self.flush(timeout_s)
+        with self._proc_lock:
+            head = self.metric.head_window
+            if head is not None:
+                self._publish_closed(force_through=head)
+            # the final merged read is always FRESH (never the last
+            # publish's cache) and syncs under the SERVICE guard: a sick
+            # peer at end-of-stream degrades the value, never wedges the
+            # shutdown — so an end-to-end run costs exactly one sync per
+            # publish plus this one (the --check-service pin)
+            self.metric._computed = None
+            old_guard = set_sync_guard(self.guard)
+            try:
+                return self.metric.compute()
+            finally:
+                set_sync_guard(old_guard)
+
+    def stop(self, timeout_s: float = 30.0) -> None:
+        """Drain and stop the worker (idempotent; safe after a preempt)."""
+        if self._state == "running":
+            try:
+                self.flush(timeout_s)
+            finally:
+                self._stop.set()
+                self._worker.join(timeout=timeout_s)
+                if self._state == "running":
+                    self._state = "stopped"
+        else:
+            self._stop.set()
+            self._worker.join(timeout=timeout_s)
+
+    def __enter__(self) -> "MetricService":
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        self.stop()
+        return False
+
+    # --------------------------------------------------- snapshot / restore
+    def snapshot(self) -> Dict[str, Any]:
+        """Crash-safe checkpoint: the metric's ``state_dict`` (slabs,
+        watermark, head, epoch watermark) plus the service bookkeeping.
+        Pauses processing for the copy; also refreshed automatically at
+        every publish (:attr:`last_snapshot`)."""
+        with self._proc_lock:
+            snap = self._snapshot_locked()
+        self.last_snapshot = snap
+        return snap
+
+    def _snapshot_locked(self) -> Dict[str, Any]:
+        return {
+            "metric": self.metric.state_dict(),
+            "processed": self._processed,
+            "ingest_idx": self._ingest_idx,
+            "published_through": self._published_through,
+            "shed_events": self.shed_events,
+            "publications": len(self.publications),
+        }
+
+    def restore(self, snapshot: Dict[str, Any]) -> None:
+        """Load a :meth:`snapshot` into this service (typically a fresh
+        instance after a preemption) and resume accepting events.
+
+        Replay the stream from ``snapshot["processed"]`` onward — or from
+        any earlier point with the original ``seq=`` ids — and the epoch
+        watermark makes already-folded steps no-ops: the batch in flight at
+        the kill cannot double-count.
+        """
+        with self._proc_lock:
+            # stale queued items from a killed run are part of the lost
+            # in-flight window — the caller replays them by seq
+            while True:
+                try:
+                    self._queue.get_nowait()
+                    self._queue.task_done()
+                except queue.Empty:
+                    break
+            self.metric.load_state_dict(snapshot["metric"])
+            self._processed = int(snapshot["processed"])
+            self._seq = self._processed
+            self._ingest_idx = int(snapshot["ingest_idx"])
+            self._published_through = snapshot["published_through"]
+            self.shed_events = int(snapshot["shed_events"])
+            self._shed_since_publish = 0
+            self._error = None
+            if not self._worker.is_alive() and not self._stop.is_set():
+                self._worker = threading.Thread(
+                    target=self._run, daemon=True, name=f"mtpu-service-{id(self):x}"
+                )
+                self._state = "running"
+                self._worker.start()
+            elif self._worker.is_alive():
+                self._state = "running"
+        self._note_health()
+
+    def __repr__(self) -> str:
+        return (
+            f"MetricService({self.metric!r}, state={self._state!r},"
+            f" health={self.health!r}, processed={self._processed})"
+        )
+
+
+def _host(tree: Any) -> Any:
+    """Publication records hold host numpy, not device arrays."""
+    import jax
+
+    return jax.tree_util.tree_map(np.asarray, tree)

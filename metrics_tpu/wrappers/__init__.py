@@ -1,5 +1,5 @@
 """Metric wrappers: BootStrapper, ClasswiseWrapper, Keyed, MinMaxMetric,
-MetricTracker, MultioutputWrapper, Running.
+MetricTracker, MultioutputWrapper, Running, Windowed.
 
 Extension family beyond the reference snapshot (later torchmetrics ships
 these under ``wrappers/``). ``Keyed`` is the multi-tenant slab wrapper: one
@@ -12,8 +12,9 @@ from metrics_tpu.wrappers.minmax import MinMaxMetric
 from metrics_tpu.wrappers.multioutput import MultioutputWrapper
 from metrics_tpu.wrappers.running import Running
 from metrics_tpu.wrappers.tracker import MetricTracker
+from metrics_tpu.wrappers.windowed import Windowed
 
 __all__ = [
     "BootStrapper", "ClasswiseWrapper", "Keyed", "MinMaxMetric", "MetricTracker",
-    "MultioutputWrapper", "Running",
+    "MultioutputWrapper", "Running", "Windowed",
 ]

@@ -30,12 +30,17 @@ import numpy as np
 from jax import Array
 
 from metrics_tpu.core.metric import Metric, State
-from metrics_tpu.observability.counters import COUNTERS as _COUNTERS, record_slab_slots
+from metrics_tpu.observability.counters import (
+    COUNTERS as _COUNTERS,
+    record_slab_dropped,
+    record_slab_slots,
+)
 from metrics_tpu.parallel.buffer import PaddedBuffer
 from metrics_tpu.parallel.sketch import SketchSpec, is_sketch, sketch_init
 from metrics_tpu.parallel.slab import (
     LRUSlotTable,
     SlabSpec,
+    dropped_slot_count,
     make_slab_spec,
     slab_init,
     slab_merge,
@@ -176,6 +181,13 @@ class Keyed(Metric):
         if slot is None:
             raise ValueError("Keyed.update requires `slot=` (one segment id per sample)")
         slot_ids = self._resolve_slot_ids(slot)
+        if not self._under_trace():
+            # out-of-range ids are DROPPED by the scatter with no device-side
+            # trace; count them host-side (records even with observability
+            # off, like the fault counters). LRU mode cannot produce one.
+            dropped = 0 if self.lru else dropped_slot_count(slot_ids, self.num_slots)
+            if dropped:
+                record_slab_dropped(dropped)
         data = (*args, *kwargs.values())
         if not data:
             raise ValueError("Keyed.update needs at least one data argument")

@@ -2,7 +2,12 @@
 torchmetrics ``wrappers/running.py``)."""
 from typing import Any, List, Optional
 
+import jax.numpy as jnp
+import numpy as np
+
 from metrics_tpu.core.metric import Metric
+from metrics_tpu.parallel.buffer import PaddedBuffer
+from metrics_tpu.parallel.sketch import is_sketch
 
 
 class Running(Metric):
@@ -62,3 +67,58 @@ class Running(Metric):
     def reset(self) -> None:
         super().reset()
         self._deltas = []
+
+    # ------------------------------------------------------------ checkpoint
+    # The window IS the state: ``_deltas`` holds one state pytree per
+    # retained step. The base ``state_dict`` only serializes REGISTERED
+    # states, and this wrapper registers none — without the override below a
+    # restored ``Running`` silently computed over an empty window (the data
+    # loss the round-trip test in tests/bases/test_wrappers.py pins).
+    _DELTAS_KEY = "_running_deltas"
+
+    def state_dict(self, destination: Optional[dict] = None, prefix: str = "") -> dict:
+        """The retained window deltas as host numpy, plus the base entries
+        (including the epoch watermark, so a restored ``Running`` replays
+        its in-flight step idempotently via ``guarded_update``)."""
+        destination = super().state_dict(destination, prefix=prefix)
+        destination[prefix + self._DELTAS_KEY] = [
+            {name: _encode_leaf(value) for name, value in delta.items()}
+            for delta in self._deltas
+        ]
+        return destination
+
+    def load_state_dict(self, state_dict: dict, prefix: str = "") -> None:
+        super().load_state_dict(state_dict, prefix=prefix)
+        key = prefix + self._DELTAS_KEY
+        if key not in state_dict:
+            return  # pre-fix checkpoint: nothing to restore (window was lost at save)
+        template = self._pure.init()
+        self._deltas = [
+            {name: _decode_leaf(entry[name], template.get(name)) for name in entry}
+            for entry in state_dict[key]
+        ][-self.window:]
+
+
+def _encode_leaf(value: Any) -> Any:
+    """One delta state leaf as checkpoint-friendly host data (mirrors the
+    base ``state_dict`` leaf conventions)."""
+    if isinstance(value, PaddedBuffer):
+        return {"data": np.asarray(value.data), "count": np.asarray(value.count)}
+    if is_sketch(value):
+        return {"sketch_counts": np.asarray(value.counts)}
+    if isinstance(value, list):
+        return [np.asarray(v) for v in value]
+    return np.asarray(value)
+
+
+def _decode_leaf(value: Any, template: Any) -> Any:
+    if isinstance(value, dict) and set(value) == {"data", "count"}:
+        return PaddedBuffer(jnp.asarray(value["data"]), jnp.asarray(value["count"]))
+    if isinstance(value, dict) and set(value) == {"sketch_counts"}:
+        kind = type(template) if is_sketch(template) else None
+        if kind is None:
+            raise ValueError("checkpoint delta holds sketch counts but the state is not a sketch")
+        return kind(jnp.asarray(value["sketch_counts"]))
+    if isinstance(value, list):
+        return [jnp.asarray(v) for v in value]
+    return jnp.asarray(value)

@@ -1,0 +1,558 @@
+"""Windowed: the serving runtime's window plane — "AUROC over the last 5
+minutes" as a slot rotation, not a copy.
+
+``Windowed(metric, window_s, num_windows)`` turns any per-sample-decomposable
+metric into a tumbling-window ring: every registered state of the inner
+metric becomes a ``(W, *shape)`` slab (one row per window slot, reusing
+``parallel/slab.py`` with WINDOW-INDEX slots instead of segment slots), and
+``update(..., event_time=)`` routes each sample to its window by timestamp
+through an advancing watermark (``core/streaming.route_events``):
+
+- in-window events scatter normally into the head slot;
+- late-but-within-``allowed_lateness_s`` events route to their still-open
+  prior slot;
+- too-late events are DROPPED AND COUNTED (slot ``-1`` -> the slab scatter's
+  XLA out-of-bounds drop + ``slab_dropped_samples``), never misrouted.
+
+A window roll is a SLOT ROTATION: when the watermark opens window ``w``, the
+ring slot ``w % W`` (which held the expired window ``w - W``) is reset in
+place — no state copies, no shape changes — and sync still rides the
+existing coalesced ``psum``/``pmin``/``pmax`` buckets of
+``coalesced_sync_state`` with zero new collective kinds: the staged
+collective count is identical to the unwindowed metric's (``bench.py
+--check-service`` pins it).
+
+``compute()`` merges all resident slots — the sliding view over the last
+``W x window_s`` seconds; ``compute_window(w)`` reads one resident window
+(the per-window publish the serving loop emits as windows close).
+
+With ``decay_half_life_s=`` instead of ``window_s=``, the wrapper is an
+EXPONENTIAL TIME-DECAY accumulator for ``sum``/``mean``-kind states: one
+slot, where the accumulator scales by ``0.5 ** (dt / half_life)`` as the
+watermark advances and each sample's delta is weighted by its age —
+``compute()`` is then the exponentially-weighted value (for sum-backed
+means: the EW mean). Integer sum states are promoted to float32 slabs so
+the decay is representable.
+
+Like ``Keyed(lru=True)``, the routing decision is host-side by construction
+(data-dependent watermark bookkeeping jit cannot express), so ``Windowed``
+runs the eager update path and raises ``TracingUnsupportedError`` under
+tracing; the scatter that consumes the resolved slot ids is still one XLA
+``segment_sum`` per state. The contract on the inner metric is the ``Keyed``
+contract: fixed-shape sum/mean/min/max states or sketch states, per-sample-
+decomposable update (cat/buffer states have no slab form — use
+``approx="sketch"``).
+"""
+import math
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.core.metric import Metric, State
+from metrics_tpu.core.streaming import WindowSpec, decay_scale, route_events
+from metrics_tpu.observability.counters import record_slab_dropped
+from metrics_tpu.parallel.buffer import PaddedBuffer
+from metrics_tpu.parallel.sketch import SketchSpec, is_sketch
+from metrics_tpu.parallel.slab import (
+    SlabSpec,
+    make_slab_spec,
+    slab_init,
+    slab_merge,
+    slab_rows_spec,
+    slab_scatter,
+    slab_sync_reduce,
+)
+from metrics_tpu.utils.exceptions import TracingUnsupportedError
+
+# the per-slot sample-count slab every Windowed wrapper carries: occupancy
+# masks (empty-slot policy), the sum-backed mean division, and — in decay
+# mode — the exponentially-decayed effective sample count
+_ROWS_STATE = "windowed_rows"
+
+_EMPTY_POLICIES = ("nan", "zero")
+
+
+class Windowed(Metric):
+    r"""Tumbling-window (or time-decay) view of ``metric`` over event time.
+
+    Args:
+        metric: the inner metric. Its states become ``(W, *shape)`` window
+            slabs; its ``update``/``compute`` are reused as the per-sample
+            delta and the per-window finisher — the instance itself never
+            accumulates.
+        window_s: tumbling-window length in seconds (event-time). Mutually
+            exclusive with ``decay_half_life_s``.
+        num_windows: W, the ring size — how many consecutive windows stay
+            resident (``compute()`` spans all of them; a window expires, and
+            its slot is recycled, W windows after it opens).
+        allowed_lateness_s: how far behind the watermark an event may arrive
+            and still be routed to its (still-open) window. Capped at
+            ``(W - 1) * window_s`` so a within-lateness slot can never have
+            been recycled. Events later than this are dropped and counted
+            (``slab_dropped_samples`` + :attr:`dropped_samples`). Default
+            0 for the ring, unbounded for decay mode.
+        decay_half_life_s: exponential time-decay half-life. The accumulator
+            becomes a single decayed slab (``sum``/``mean``-kind inner
+            states only); mutually exclusive with ``window_s``.
+        empty: what ``compute()`` reports when no samples are resident —
+            ``"nan"`` (default; non-float results fall back to 0) or
+            ``"zero"``.
+
+    ``update(*data, event_time=t)`` takes per-sample event timestamps
+    (seconds; an ``(N,)`` array, or a scalar stamping the whole batch).
+    The watermark is the max event time seen; it never goes backwards.
+    Cross-process sync rides the base machinery unchanged (slab leaves are
+    ordinary sum/min/max array or sketch leaves); the watermark itself is
+    host metadata — ranks of a distributed stream are expected to observe
+    the same event-time clock.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> acc = Windowed(Accuracy(), window_s=60.0, num_windows=2)
+        >>> preds = jnp.array([0.9, 0.2, 0.8])
+        >>> target = jnp.array([1, 0, 0])
+        >>> acc.update(preds, target, event_time=jnp.array([3.0, 65.0, 70.0]))
+        >>> float(acc.compute())  # both windows resident: 2/3 correct
+        0.6666666865348816
+    """
+
+    def __init__(
+        self,
+        metric: Metric,
+        window_s: Optional[float] = None,
+        num_windows: int = 4,
+        allowed_lateness_s: Optional[float] = None,
+        decay_half_life_s: Optional[float] = None,
+        empty: str = "nan",
+        compute_on_step: Optional[bool] = None,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        if not isinstance(metric, Metric):
+            raise ValueError(f"`metric` must be a Metric, got {type(metric).__name__}")
+        if (window_s is None) == (decay_half_life_s is None):
+            raise ValueError(
+                "set exactly one of `window_s` (tumbling ring) or"
+                " `decay_half_life_s` (exponential time-decay accumulator)"
+            )
+        if empty not in _EMPTY_POLICIES:
+            raise ValueError(f"`empty` must be one of {_EMPTY_POLICIES}, got {empty!r}")
+        super().__init__(
+            compute_on_step=metric.compute_on_step if compute_on_step is None else compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+            # event routing is host-side watermark bookkeeping: the fused
+            # jitted step can never trace it, so don't build one
+            jit=False,
+        )
+        self.metric = metric
+        self.decay = decay_half_life_s is not None
+        if self.decay:
+            if not (isinstance(decay_half_life_s, (int, float)) and decay_half_life_s > 0):
+                raise ValueError(
+                    f"`decay_half_life_s` must be a positive number, got {decay_half_life_s!r}"
+                )
+            self.decay_half_life_s = float(decay_half_life_s)
+            self.num_windows = 1
+            self.allowed_lateness_s = (
+                math.inf if allowed_lateness_s is None else float(allowed_lateness_s)
+            )
+            self._spec = None
+        else:
+            self.decay_half_life_s = None
+            self.num_windows = int(num_windows)
+            self.allowed_lateness_s = 0.0 if allowed_lateness_s is None else float(allowed_lateness_s)
+            self._spec = WindowSpec(
+                float(window_s), self.num_windows, self.allowed_lateness_s
+            ).validate()
+        self.window_s = None if self.decay else float(window_s)
+        self.empty = empty
+        self._metric_label = f"Windowed({type(metric).__name__})"
+
+        # stream position (host metadata, checkpointed): None until the
+        # first event arrives
+        self._watermark: Optional[float] = None
+        self._head: Optional[int] = None
+        self._origin: Optional[int] = None  # oldest window ever accepted into
+        self._dropped = 0  # lifetime too-late drops (mirrors slab_dropped_samples)
+        self._late = 0  # lifetime accepted-but-late routings
+
+        if not metric._defaults:
+            raise ValueError("the inner metric declares no states; nothing to window")
+        if _ROWS_STATE in metric._defaults:
+            raise ValueError(f"the inner metric already has a state named {_ROWS_STATE!r}")
+        self._slab_reduce: Dict[str, str] = {}
+        for name, spec in metric._defaults.items():
+            slab = self._slab_spec_for(name, spec, metric._reductions[name])
+            self._slab_reduce[name] = slab.reduce
+            self.add_state(name, default=slab, dist_reduce_fx=slab_sync_reduce(slab.reduce),
+                           persistent=True)
+        rows_dtype = np.float32 if self.decay else None  # decayed effective counts
+        self.add_state(_ROWS_STATE, default=slab_rows_spec(self.num_windows, dtype=rows_dtype),
+                       dist_reduce_fx="sum", persistent=True)
+
+    def _slab_spec_for(self, name: str, spec: Any, fx: Any) -> SlabSpec:
+        """The ``SlabSpec`` one inner state maps onto, or a loud rejection."""
+        if isinstance(spec, SketchSpec):
+            if self.decay:
+                raise ValueError(
+                    f"state {name!r} is a sketch state; integer sketch counts have no"
+                    " exponential-decay form — use the windowed ring (window_s=) for"
+                    " sketch metrics"
+                )
+            return make_slab_spec(self.num_windows, np.zeros(spec.shape, np.dtype(spec.dtype)),
+                                  "sum", kind=spec.kind)
+        if isinstance(spec, (list, PaddedBuffer)) or fx == "cat" or fx is None:
+            raise ValueError(
+                f"state {name!r} of {type(self.metric).__name__} is a cat/list/buffer"
+                " state with no per-window slab form; Windowed supports fixed-shape"
+                " sum/mean/min/max states and sketch states (curve/rank metrics:"
+                " construct the inner metric with approx='sketch')"
+            )
+        if isinstance(spec, SlabSpec):
+            # a nested slab — the inner metric is a Keyed wrapper: windows
+            # wrap the segment axis, so the state becomes (W, K, *item) and
+            # "AUROC over the last 5 minutes, per cohort" is one state.
+            # Scatter/merge use the slab's SYNC reduction (sum-backed means
+            # stay sums; Keyed's own finisher divides by its rows slab).
+            if self.decay:
+                raise ValueError(
+                    f"state {name!r} is a segment slab; the decay accumulator"
+                    " does not nest over Keyed (its sum-backed mean division"
+                    " clamps at 1 sample) — use the windowed ring"
+                )
+            if spec.kind in ("hist", "rank"):
+                return make_slab_spec(
+                    self.num_windows, np.zeros(spec.row_shape, np.dtype(spec.dtype)),
+                    "sum", kind=spec.kind,
+                )
+            if spec.fill is not None:
+                template = np.broadcast_to(
+                    spec.fill_template()[None], spec.row_shape
+                ).copy()
+            else:
+                template = np.zeros(spec.row_shape, np.dtype(spec.dtype))
+            return make_slab_spec(self.num_windows, template, slab_sync_reduce(spec.reduce))
+        if not isinstance(spec, np.ndarray):
+            raise ValueError(
+                f"state {name!r} has an unsupported default kind for Windowed:"
+                f" {type(spec).__name__}"
+            )
+        if not (isinstance(fx, str) and fx in ("sum", "mean", "min", "max")):
+            raise ValueError(
+                f"state {name!r} uses dist_reduce_fx={fx!r}; Windowed supports"
+                " 'sum'/'mean'/'min'/'max' array states and sketch states"
+            )
+        # canonicalize wide host templates to the dtype the inner metric
+        # actually materializes under jax defaults (float64 numpy zeros ->
+        # float32 device state) so the slab matches the unwindowed state
+        canonical = jax.dtypes.canonicalize_dtype(spec.dtype)
+        if canonical != spec.dtype:
+            spec = spec.astype(canonical)
+        if self.decay:
+            if fx not in ("sum", "mean"):
+                raise ValueError(
+                    f"state {name!r} uses dist_reduce_fx={fx!r}; the exponential-decay"
+                    " accumulator only applies to 'sum'/'mean'-kind states (min/max"
+                    " have no decayed form) — use the windowed ring instead"
+                )
+            if np.issubdtype(spec.dtype, np.integer) or np.issubdtype(spec.dtype, np.bool_):
+                # decayed accumulation needs a representable fraction
+                spec = spec.astype(np.float32)
+        return make_slab_spec(self.num_windows, spec, fx)
+
+    # ------------------------------------------------------- stream position
+    @property
+    def watermark(self) -> Optional[float]:
+        """Max event time observed (``None`` before the first event)."""
+        return self._watermark
+
+    @property
+    def head_window(self) -> Optional[int]:
+        """Index of the newest open window (``None`` before the first event;
+        always ``None`` in decay mode, which has no windows)."""
+        return None if self.decay else self._head
+
+    @property
+    def dropped_samples(self) -> int:
+        """Lifetime count of too-late events dropped (never misrouted)."""
+        return self._dropped
+
+    @property
+    def late_samples(self) -> int:
+        """Lifetime count of accepted events routed to a non-head window."""
+        return self._late
+
+    def resident_windows(self) -> tuple:
+        """Window indices currently resident in the ring, oldest first.
+        Starts at the stream origin: windows before the first accepted event
+        never existed and are not reported (or publishable)."""
+        if self.decay or self._head is None or self._origin is None:
+            return ()
+        lo = max(self._origin, self._head - self.num_windows + 1)
+        return tuple(range(lo, self._head + 1))
+
+    # ---------------------------------------------------------------- update
+    def update(self, *args: Any, event_time: Any = None, **kwargs: Any) -> None:
+        """Route one batch into the window slabs by event time.
+
+        ``event_time`` (required, keyword-only) is one timestamp per sample
+        (seconds; scalar = whole batch at one instant). All positional/
+        keyword data arguments must share the leading sample axis.
+        """
+        if event_time is None:
+            raise ValueError("Windowed.update requires `event_time=` (one timestamp per sample)")
+        if self._under_trace():
+            raise TracingUnsupportedError(
+                "Windowed resolves event-time routing host-side (watermark"
+                " advance, window roll) and cannot run under jit tracing;"
+                " drive it eagerly — the per-state scatter is still one XLA"
+                " segment_sum."
+            )
+        data = (*args, *kwargs.values())
+        if not data:
+            raise ValueError("Windowed.update needs at least one data argument")
+        first = data[0]
+        n = int(first.shape[0]) if getattr(first, "ndim", 0) else 1
+        times = np.asarray(event_time, dtype=np.float64).reshape(-1)
+        if times.size == 1 and n > 1:
+            times = np.full(n, times[0])
+        if times.size != n:
+            raise ValueError(
+                f"event_time has {times.size} entries but the batch has {n} samples"
+            )
+        if self.decay:
+            slot_ids, weights = self._route_decay(times)
+        else:
+            route = route_events(times, self._watermark, self._head, self._spec)
+            if route.opened and self._head is not None:
+                # the roll: recycled slots held now-expired windows
+                self._reset_slots(sorted({w % self.num_windows for w in route.opened}))
+            self._watermark, self._head = route.watermark, route.head
+            if route.min_window is not None:
+                self._origin = (
+                    route.min_window
+                    if self._origin is None
+                    else min(self._origin, route.min_window)
+                )
+            self._late += route.n_late
+            if route.n_dropped:
+                self._dropped += route.n_dropped
+                record_slab_dropped(route.n_dropped)
+            slot_ids, weights = jnp.asarray(route.slot_ids), None
+
+        kw_keys = tuple(kwargs)
+        n_args = len(args)
+
+        def one(*sample):
+            batch = tuple(a[None] for a in sample)  # per-sample size-1 batches
+            return self.metric.update_state(
+                self.metric.init_state(), *batch[:n_args], **dict(zip(kw_keys, batch[n_args:]))
+            )
+
+        deltas = jax.vmap(one)(*data)  # {name: (N, *shape) / sketch with (N, ...) counts}
+        for name in self.metric._defaults:
+            reduce = self._slab_reduce[name]
+            current = getattr(self, name)
+            leaf = deltas[name]
+            if is_sketch(current):
+                scattered = slab_scatter("sum", leaf.counts, slot_ids, self.num_windows)
+                setattr(self, name, type(current)(current.counts + scattered))
+            else:
+                payload = leaf
+                if weights is not None:
+                    payload = payload.astype(current.dtype) * weights.reshape(
+                        (-1,) + (1,) * (payload.ndim - 1)
+                    )
+                scattered = slab_scatter(reduce, payload, slot_ids, self.num_windows)
+                acc = current if weights is None else current * self._decay_step_scale
+                setattr(self, name, slab_merge(reduce, acc, scattered))
+        rows = getattr(self, _ROWS_STATE)
+        ones = jnp.ones(slot_ids.shape, dtype=rows.dtype) if weights is None else weights
+        acc_rows = rows if weights is None else rows * self._decay_step_scale
+        setattr(self, _ROWS_STATE, acc_rows + slab_scatter("sum", ones, slot_ids, self.num_windows))
+
+    def _route_decay(self, times: np.ndarray):
+        """(slot_ids, per-sample weights) for the decay accumulator, and
+        stash the accumulator's forward scale for this batch."""
+        new_wm = float(times.max()) if self._watermark is None else max(
+            self._watermark, float(times.max())
+        )
+        accepted = times >= new_wm - self.allowed_lateness_s
+        dropped = int((~accepted).sum())
+        if dropped:
+            self._dropped += dropped
+            record_slab_dropped(dropped)
+        self._decay_step_scale = (
+            1.0
+            if self._watermark is None
+            else float(decay_scale(new_wm - self._watermark, self.decay_half_life_s))
+        )
+        weights = np.where(
+            accepted, decay_scale(new_wm - times, self.decay_half_life_s), 0.0
+        ).astype(np.float32)
+        slot_ids = np.where(accepted, 0, -1).astype(np.int32)
+        self._watermark = new_wm
+        return jnp.asarray(slot_ids), jnp.asarray(weights)
+
+    def _reset_slots(self, slots) -> None:
+        """Return recycled ring slots to their per-slot defaults (the roll)."""
+        idx = jnp.asarray(np.asarray(slots, dtype=np.int32))
+        for name, spec in self._defaults.items():
+            value = getattr(self, name)
+            fresh = slab_init(spec)
+            if is_sketch(value):
+                setattr(self, name, type(value)(value.counts.at[idx].set(fresh.counts[idx])))
+            else:
+                setattr(self, name, value.at[idx].set(fresh[idx]))
+
+    # --------------------------------------------------------------- compute
+    def compute(self) -> Any:
+        """The merged view over every resident window — the sliding value
+        over the last ``W x window_s`` seconds (decay mode: the
+        exponentially-weighted value)."""
+        state = self._current_state()
+        rows = state.pop(_ROWS_STATE)
+        inner_state: State = {}
+        for name, value in state.items():
+            reduce = self._slab_reduce[name]
+            if is_sketch(value):
+                merged = type(value)(jnp.sum(value.counts, axis=0))
+            elif reduce in ("sum", "mean"):
+                merged = jnp.sum(value, axis=0)
+            elif reduce == "min":
+                merged = jnp.min(value, axis=0)
+            else:
+                merged = jnp.max(value, axis=0)
+            if reduce == "mean":
+                merged = merged / self._mean_denom(jnp.sum(rows), merged.dtype)
+            inner_state[name] = merged
+        result = self.metric.compute_from_state(inner_state)
+        return self._mask_empty(result, jnp.sum(rows) > 0)
+
+    def compute_window(self, window: int) -> Any:
+        """One resident window's value (the per-window publish read).
+
+        ``window`` is the ABSOLUTE window index (``floor(t / window_s)``);
+        it must still be resident in the ring — expired or never-opened
+        windows raise. Reads local state directly (no sync, no compute
+        cache): the serving loop syncs once per roll via the ordinary
+        ``compute()``/host plane and then reads windows off the slab.
+        """
+        if self.decay:
+            raise ValueError("the decay accumulator has no windows; use compute()")
+        if window not in self.resident_windows():
+            raise KeyError(
+                f"window {window} is not resident (resident: {self.resident_windows()});"
+                " it expired from the ring or has not opened yet"
+            )
+        slot = window % self.num_windows
+        state = self._current_state()
+        rows = state.pop(_ROWS_STATE)
+        inner_state: State = {}
+        for name, value in state.items():
+            row = type(value)(value.counts[slot]) if is_sketch(value) else value[slot]
+            if self._slab_reduce[name] == "mean":
+                row = row / self._mean_denom(rows[slot], row.dtype)
+            inner_state[name] = row
+        result = self.metric.compute_from_state(inner_state)
+        return self._mask_empty(result, rows[slot] > 0)
+
+    @staticmethod
+    def _mean_denom(rows: Array, dtype: Any) -> Array:
+        """Sum-backed mean divisor: the (possibly decayed) sample count,
+        floored away from zero so empty slots divide by 1 (masked after)."""
+        rows = rows.astype(dtype)
+        return jnp.where(rows > 0, rows, jnp.ones((), dtype=dtype))
+
+    def _mask_empty(self, result: Any, occupied: Array) -> Any:
+        def mask(r: Array) -> Array:
+            r = jnp.asarray(r)
+            if self.empty == "nan" and jnp.issubdtype(r.dtype, jnp.inexact):
+                return jnp.where(occupied, r, jnp.nan)
+            return jnp.where(occupied, r, jnp.zeros((), dtype=r.dtype))
+
+        return jax.tree_util.tree_map(mask, result)
+
+    # ------------------------------------------------------- integrity guard
+    def _integrity_state(self) -> State:
+        """Mask never-touched slots before the ``check_finite`` scan: min/max
+        identity fills sit at the dtype extremes the saturation scan would
+        otherwise flag as pre-wraparound corruption."""
+        state = self._current_state()
+        rows = state[_ROWS_STATE]
+        occupied = np.asarray(rows) > 0
+        out: State = {}
+        for name, value in state.items():
+            reduce = self._slab_reduce.get(name)
+            if reduce in ("min", "max") and not is_sketch(value):
+                occ = jnp.asarray(occupied).reshape(
+                    (self.num_windows,) + (1,) * (value.ndim - 1)
+                )
+                value = jnp.where(occ, value, jnp.zeros((), dtype=value.dtype))
+            out[name] = value
+        return out
+
+    # ------------------------------------------------------------- lifecycle
+    def reset(self) -> None:
+        super().reset()
+        self._watermark = None
+        self._head = None
+        self._origin = None
+        self._dropped = 0
+        self._late = 0
+
+    _STREAM_KEYS = ("_windowed_watermark", "_windowed_head", "_windowed_dropped", "_windowed_late")
+
+    def state_dict(self, destination: Optional[dict] = None, prefix: str = "") -> dict:
+        """Window slabs persist through the base path (plain arrays/
+        sketches); the host-side stream position — watermark, head window,
+        drop/late counters — rides along so a restored runtime resumes
+        MID-WINDOW with the same routing verdicts (and ``guarded_update``
+        replay of the in-flight step stays a no-op via the base epoch
+        watermark entry)."""
+        destination = super().state_dict(destination, prefix=prefix)
+        destination[prefix + "_windowed_watermark"] = np.asarray(
+            np.nan if self._watermark is None else self._watermark, dtype=np.float64
+        )
+        destination[prefix + "_windowed_head"] = np.asarray(
+            0 if self._head is None else self._head, dtype=np.int64
+        )
+        destination[prefix + "_windowed_origin"] = np.asarray(
+            0 if self._origin is None else self._origin, dtype=np.int64
+        )
+        destination[prefix + "_windowed_dropped"] = np.asarray(self._dropped, dtype=np.int64)
+        destination[prefix + "_windowed_late"] = np.asarray(self._late, dtype=np.int64)
+        return destination
+
+    def load_state_dict(self, state_dict: dict, prefix: str = "") -> None:
+        super().load_state_dict(state_dict, prefix=prefix)
+        key = prefix + "_windowed_watermark"
+        if key in state_dict:
+            wm = float(np.asarray(state_dict[key]))
+            self._watermark = None if math.isnan(wm) else wm
+            head = int(np.asarray(state_dict[prefix + "_windowed_head"]))
+            self._head = None if self._watermark is None or self.decay else head
+            origin_key = prefix + "_windowed_origin"
+            if origin_key in state_dict:
+                origin = int(np.asarray(state_dict[origin_key]))
+                self._origin = None if self._head is None else origin
+            self._dropped = int(np.asarray(state_dict[prefix + "_windowed_dropped"]))
+            self._late = int(np.asarray(state_dict[prefix + "_windowed_late"]))
+
+    def __repr__(self) -> str:
+        if self.decay:
+            return (
+                f"Windowed({self.metric!r}, decay_half_life_s={self.decay_half_life_s})"
+            )
+        return (
+            f"Windowed({self.metric!r}, window_s={self.window_s},"
+            f" num_windows={self.num_windows},"
+            f" allowed_lateness_s={self.allowed_lateness_s})"
+        )

@@ -402,6 +402,49 @@ def test_running_window_matches_fresh_metric():
         np.testing.assert_allclose(float(step), float(single), atol=1e-6)
 
 
+def test_running_checkpoint_round_trip_restores_the_window():
+    """The pre-fix data loss: ``Running`` keeps its window in ``_deltas``,
+    which the base ``state_dict`` never serialized — a restored ``Running``
+    silently computed over an EMPTY window. The round-trip must restore the
+    window exactly (deltas + the epoch watermark for idempotent replay)."""
+    from metrics_tpu import MeanSquaredError, Running
+
+    running = Running(MeanSquaredError(), window=2)
+    for step in range(4):
+        running(jnp.asarray([float(step)]), jnp.asarray([0.0]))
+    saved = running.state_dict()
+    # the window must actually be IN the checkpoint, not just in memory
+    assert len(saved["_running_deltas"]) == 2
+    assert all(isinstance(v, np.ndarray) for d in saved["_running_deltas"] for v in d.values())
+
+    restored = Running(MeanSquaredError(), window=2)
+    restored.load_state_dict(saved)
+    assert len(restored._deltas) == 2
+    np.testing.assert_allclose(float(restored.compute()), 6.5, atol=1e-6)  # (2^2+3^2)/2
+    np.testing.assert_allclose(float(restored.compute()), float(running.compute()), atol=1e-6)
+
+    # the watermark entry rides along: replaying the last folded step no-ops
+    assert restored.epoch_watermark == running.epoch_watermark == 4
+    assert restored.guarded_update(3, jnp.asarray([3.0]), jnp.asarray([0.0])) is False
+    assert restored.guarded_update(4, jnp.asarray([4.0]), jnp.asarray([0.0])) is True
+    np.testing.assert_allclose(float(restored.compute()), (3.0**2 + 4.0**2) / 2, atol=1e-6)
+
+    # a restored window keeps sliding correctly and respects `window`
+    wide = Running(MeanSquaredError(), window=3)
+    for step in range(3):
+        wide(jnp.asarray([float(step)]), jnp.asarray([0.0]))
+    narrow = Running(MeanSquaredError(), window=2)
+    narrow.load_state_dict(wide.state_dict())  # extra deltas truncate to the window
+    assert len(narrow._deltas) == 2
+    np.testing.assert_allclose(float(narrow.compute()), (1.0 + 4.0) / 2, atol=1e-6)
+
+    # pre-fix checkpoints (no deltas entry) still load, window empty
+    legacy = {k: v for k, v in saved.items() if k != "_running_deltas"}
+    old = Running(MeanSquaredError(), window=2)
+    old.load_state_dict(legacy)
+    assert old._deltas == []
+
+
 def test_running_reset_and_validation():
     from metrics_tpu import MeanSquaredError, Running
 

@@ -92,9 +92,22 @@ def _assert_headline_schema(out):
     assert out["keyed_gather_calls"] == 0  # psum-only: the slab contract
     assert out["keyed_sync_bytes"] == 2640000  # (10000*2*16 + 10000) * 4 * 2 stages
 
+    # the windowed serving A/B rides the same line: Windowed(AUROC sketch)
+    # x 4 window slots stages the SAME collective count and kinds as the
+    # unwindowed metric — windows are a state axis, window roll is a slot
+    # rotation, and the program is psum-only
+    assert isinstance(out["service_sync_ms"], (int, float)) and out["service_sync_ms"] > 0
+    assert out["service_states_synced"] == 2  # the histogram slab + the row-count slab
+    assert out["service_collective_calls"] == 2  # two-stage (ici + dcn) psum
+    assert out["service_collective_calls"] == out["service_unwindowed_collective_calls"]
+    assert out["service_gather_calls"] == 0  # psum-only: the window-slab contract
+    assert out["service_sync_bytes"] == 1056  # (4*2*16 + 4) * 4 bytes * 2 stages
+
     # fault counters ride the default line and are ZERO on a clean bench run
-    # (--check-trajectory pins them at zero on every new BENCH_r* round)
-    for key in ("sync_retries", "sync_deadline_exceeded", "degraded_computes", "quarantined_updates"):
+    # (--check-trajectory pins them at zero on every new BENCH_r* round);
+    # slab_dropped_samples joins them — in-window bench traffic never drops
+    for key in ("sync_retries", "sync_deadline_exceeded", "degraded_computes", "quarantined_updates",
+                "slab_dropped_samples"):
         assert out[key] == 0, key
 
 
@@ -113,12 +126,13 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     out = _run_smoke(("--trace", str(trace_file)))
     _assert_headline_schema(out)
 
-    # schema version of the --trace payload: v5 added the keyed slab A/B
-    # (K-independent staged-collective keys on the default line, full keyed
-    # counters here); v4 added the sketch A/B; v3 moved the collective
-    # counts to the default line and added the hierarchical A/B +
-    # per-crossing counters; bump this pin with the schema
-    assert out["trace_schema"] == 5
+    # schema version of the --trace payload: v6 added the windowed serving
+    # A/B (window-count-independent staged-collective keys +
+    # slab_dropped_samples on the default line, full service counters
+    # here); v5 added the keyed slab A/B; v4 the sketch A/B; v3 moved the
+    # collective counts to the default line and added the hierarchical A/B
+    # + per-crossing counters; bump this pin with the schema
+    assert out["trace_schema"] == 6
     # the sketch program's full snapshot: psum-only, no gather kinds staged
     sketch_kinds = out["sketch_counters"]["calls_by_kind"]
     assert sketch_kinds.get("psum", 0) == 2
@@ -130,6 +144,12 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     for kind in ("all_gather", "coalesced_gather", "process_allgather"):
         assert keyed_kinds.get(kind, 0) == 0, kind
     assert out["keyed_counters"]["bytes_by_crossing"]["dcn"] == out["keyed_sync_bytes"] // 2
+    # the windowed serving program: the same psum-only shape at W=4 slots
+    service_kinds = out["service_counters"]["calls_by_kind"]
+    assert service_kinds.get("psum", 0) == 2
+    for kind in ("all_gather", "coalesced_gather", "process_allgather"):
+        assert service_kinds.get(kind, 0) == 0, kind
+    assert out["service_counters"]["bytes_by_crossing"]["dcn"] == out["service_sync_bytes"] // 2
 
     # counter totals must agree with the states_synced the bench reports
     assert out["counters"]["states_synced"] == out["states_synced"]
@@ -294,6 +314,47 @@ def test_bench_check_faults_gate():
     assert out["degraded"]["faults"]["degraded_computes"] >= 1
     assert out["degraded"]["degraded_spans"] >= 1
     assert out["degraded"]["elapsed_s"] < out["degraded"]["budget_s"]
+
+
+def test_bench_check_service_gate():
+    """``bench.py --check-service`` is the serving-runtime gate: the
+    windowed metric's staged sync program must be identical to the
+    unwindowed metric's (psum-only parity), the clean MetricService soak
+    must be bit-exact vs the single-process oracle (published windows,
+    merged view, per-window sample counts — zero misrouted — and the drop
+    count), and the seeded chaos soak (late burst + ingest stall +
+    mid-window preempt + persistent sync drop) must complete within its
+    budget with every publish degraded, ``degraded_computes`` and
+    ``slab_dropped_samples`` matching their pins, and a snapshot-restored
+    service replaying idempotently."""
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, _BENCH, "--check-service"],
+        capture_output=True, text=True, timeout=280, env=env,
+        cwd=os.path.dirname(_BENCH),
+    )
+    assert proc.returncode == 0, f"--check-service failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["ok"] is True and out["failures"] == []
+    # parity: windows are a state axis — identical staged count, psum-only
+    assert (
+        out["parity"]["windowed"]["collective_calls"]
+        == out["parity"]["unwindowed"]["collective_calls"]
+    )
+    assert out["parity"]["windowed"]["gather_calls"] == 0
+    # clean soak: no faults, no drops, windows published in order
+    assert all(v == 0 for v in out["clean"]["faults"].values())
+    assert out["clean"]["dropped"] == 0
+    assert out["clean"]["published"] == sorted(out["clean"]["published"])
+    # chaos soak: survived the schedule inside the budget, with the pins
+    assert out["chaos"]["preempted"] is True
+    assert out["chaos"]["elapsed_s"] < out["chaos"]["budget_s"]
+    assert out["chaos"]["faults"]["degraded_computes"] >= 1
+    assert out["chaos"]["slab_dropped_samples"] > 0
+    assert out["chaos"]["injected"]["late_burst"] >= 1
+    assert out["chaos"]["injected"]["ingest_stall"] >= 1
+    assert out["chaos"]["injected"]["preempt"] == 1
 
 
 def _run_trajectory(tmp_path, current, rounds):

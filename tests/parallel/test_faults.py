@@ -535,3 +535,82 @@ def test_keyed_min_slab_identity_fills_pass_the_integrity_scan():
         keyed.update(jnp.asarray([1.0, 2.0]), slot=jnp.asarray([0, 1]))
     with pytest.warns(UserWarning, match="integrity scan"):
         keyed.update(jnp.asarray([np.nan]), slot=jnp.asarray([2]))
+
+
+# ------------------------------------------- service-plane fault kinds (PR 9)
+def test_service_fault_kinds_validate_and_need_addressing():
+    """The serving kinds join FAULT_KINDS with the same loud validation: an
+    unaddressed spec (no call, no rate) raises at construction."""
+    assert set(faults.SERVICE_FAULT_KINDS) <= set(faults.FAULT_KINDS) | {"preempt"}
+    faults.ChaosInjector([faults.FaultSpec(kind="late_burst", call=1, skew_s=5.0)])
+    with pytest.raises(ValueError, match="unaddressed"):
+        faults.ChaosInjector([faults.FaultSpec(kind="clock_skew", skew_s=5.0)])
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        faults.ChaosInjector([faults.FaultSpec(kind="gc_pause", call=0)])
+
+
+def test_ingest_faults_consecutive_call_semantics_and_counts():
+    """At the ingest site there are no retries, so ``times`` means
+    CONSECUTIVE CALLS: a call-pinned spec fires on calls [call, call+times);
+    gather-plane kinds never leak into the ingest surface."""
+    schedule = [
+        faults.FaultSpec(kind="ingest_stall", call=2, times=3, duration_s=0.0,
+                         site="service.ingest"),
+        faults.FaultSpec(kind="drop", call=2, times=3, site="service.ingest"),
+    ]
+    inj = faults.ChaosInjector(schedule, seed=0)
+    fired = {idx: [s.kind for s in inj.ingest_faults("service.ingest", idx)]
+             for idx in range(7)}
+    assert fired == {0: [], 1: [], 2: ["ingest_stall"], 3: ["ingest_stall"],
+                     4: ["ingest_stall"], 5: [], 6: []}
+    assert inj.injected["ingest_stall"] == 3
+    assert inj.injected["drop"] == 0  # a gather kind is not a service fault
+    # wrong site: nothing fires
+    assert inj.ingest_faults("host_gather", 2) == []
+
+
+def test_rate_verdicts_stable_across_threads():
+    """The determinism audit for the service's background thread: a
+    rate-based verdict is decided once per (spec, call) from the seeded RNG
+    and must come back IDENTICAL no matter which thread asks, or how many
+    times — and two injectors with the same seed agree call for call."""
+    spec = faults.FaultSpec(kind="drop", rate=0.5, site="host_gather")
+    inj = faults.ChaosInjector([spec], seed=123)
+    calls = list(range(64))
+    results: "dict[int, list]" = {}
+    errors: list = []
+
+    def probe(worker: int) -> None:
+        try:
+            results[worker] = [inj.verdict(spec, "host_gather", idx) for idx in calls]
+        except BaseException as err:  # noqa: BLE001
+            errors.append(err)
+
+    threads = [threading.Thread(target=probe, args=(w,)) for w in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    assert not errors
+    baseline = results[0]
+    assert all(results[w] == baseline for w in results)
+    assert any(baseline) and not all(baseline)  # a 0.5 rate actually mixes
+
+    # seeded reproducibility: a sequentially-probed twin sees the same
+    # verdict sequence (thread scheduling cannot perturb the schedule)
+    spec2 = faults.FaultSpec(kind="drop", rate=0.5, site="host_gather")
+    twin = faults.ChaosInjector([spec2], seed=123)
+    assert [twin.verdict(spec2, "host_gather", idx) for idx in calls] == baseline
+
+
+def test_ingest_rate_faults_are_deterministic_per_call():
+    """Rate-addressed service faults reuse the cached per-(spec, call)
+    verdicts: asking twice about the same ingest call double-fires nothing
+    and never flips the answer."""
+    spec = faults.FaultSpec(kind="late_burst", rate=1.0, skew_s=9.0, site="service.ingest")
+    inj = faults.ChaosInjector([spec], seed=7)
+    first = inj.ingest_faults("service.ingest", 0)
+    assert [s.kind for s in first] == ["late_burst"] and first[0].skew_s == 9.0
+    again = inj.ingest_faults("service.ingest", 0)
+    assert [s.kind for s in again] == ["late_burst"]
+    assert inj.injected["late_burst"] == 2  # each consultation is a firing

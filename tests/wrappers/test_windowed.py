@@ -1,0 +1,460 @@
+"""Windowed serving plane: watermark/lateness/roll correctness matrix.
+
+The contract under test (wrappers/windowed.py + core/streaming.py):
+
+- watermark semantics: an in-order stream and any shuffle of it whose events
+  stay within the allowed lateness produce BIT-EXACT window slabs (verdicts
+  depend only on each event's window and the running max — scatter-adds
+  commute);
+- too-late events are DROPPED AND COUNTED (instance counter + the
+  process-wide ``slab_dropped_samples`` evidence trail), never misrouted:
+  every resident window's sample count matches an independent router;
+- window roll parity: one batch per window makes ``Windowed(window_s=1,
+  num_windows=k)`` the event-time twin of ``Running(window=k)``;
+- preempt-mid-window resume: ``state_dict`` carries slabs + watermark +
+  head + origin + drop counters, and ``guarded_update`` replay of the
+  in-flight step is a no-op;
+- the decay accumulator is the closed-form exponentially-weighted value;
+- on a real (4,2) mesh the synced compute is psum-only and equals the
+  single-process stream.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+import metrics_tpu.observability as obs
+from metrics_tpu import AUROC, Accuracy, MeanSquaredError, Running, Windowed
+from metrics_tpu.core.streaming import RouteResult, WindowSpec, route_events
+from metrics_tpu.parallel.placement import MeshHierarchy
+from metrics_tpu.utils import compat
+from metrics_tpu.utils.exceptions import TracingUnsupportedError
+
+
+def _stream(n=96, seed=0, horizon=60.0):
+    rng = np.random.RandomState(seed)
+    preds = rng.rand(n).astype(np.float32)
+    target = rng.randint(0, 2, n).astype(np.int32)
+    times = np.sort(rng.uniform(0.0, horizon, n))
+    return times, preds, target
+
+
+def _ring(**kw):
+    args = dict(window_s=20.0, num_windows=4, allowed_lateness_s=60.0)
+    args.update(kw)
+    return Windowed(Accuracy(), **args)
+
+
+# ------------------------------------------------------------ routing core
+def test_route_events_window_open_rule():
+    spec = WindowSpec(10.0, 4, 10.0)
+    # watermark 35: window 0 closed (10+10 <= 35), window 1 open until 30... no:
+    # (1+1)*10+10 = 30 <= 35 -> closed too; windows 2,3 open
+    r = route_events([5.0, 15.0, 25.0, 35.0], None, None, spec)
+    assert r.watermark == 35.0 and r.head == 3
+    assert list(r.slot_ids) == [-1, -1, 2, 3]
+    assert r.n_dropped == 2 and r.n_late == 1
+    assert r.min_window == 2
+    assert isinstance(r, RouteResult)
+
+
+def test_route_events_head_window_never_late():
+    # zero lateness: the head window's own events always land
+    spec = WindowSpec(10.0, 2, 0.0)
+    r = route_events([11.0, 14.0, 19.9], None, None, spec)
+    assert list(r.slot_ids) == [1, 1, 1] and r.n_dropped == 0
+
+
+def test_route_events_watermark_monotonic_and_opened():
+    spec = WindowSpec(10.0, 3, 0.0)
+    r1 = route_events([12.0], None, None, spec)
+    r2 = route_events([45.0], r1.watermark, r1.head, spec)
+    assert r2.opened == (2, 3, 4) and r2.head == 4
+    r3 = route_events([30.0], r2.watermark, r2.head, spec)  # late, window closed
+    assert r3.watermark == 45.0 and list(r3.slot_ids) == [-1]
+
+
+def test_window_spec_validation():
+    with pytest.raises(ValueError, match="window_s"):
+        WindowSpec(0.0, 4).validate()
+    with pytest.raises(ValueError, match="num_windows"):
+        WindowSpec(10.0, 0).validate()
+    with pytest.raises(ValueError, match="still-open horizon"):
+        WindowSpec(10.0, 2, 10.1).validate()
+    with pytest.raises(ValueError, match="finite"):
+        route_events([np.nan], None, None, WindowSpec(10.0, 2))
+
+
+# -------------------------------------------------- watermark property matrix
+def test_in_order_equals_shuffled_within_lateness_bit_exact():
+    """The headline watermark property: shuffling a stream whose events all
+    stay within the allowed lateness of the stream maximum changes nothing —
+    slabs, rows, watermark, drop count are bit-exact."""
+    times, preds, target = _stream()
+    rng = np.random.RandomState(1)
+
+    def run(order):
+        m = _ring()
+        for i in order:
+            m.update(jnp.asarray(preds[i:i + 1]), jnp.asarray(target[i:i + 1]),
+                     event_time=times[i:i + 1])
+        return m
+
+    a = run(range(len(times)))
+    b = run(rng.permutation(len(times)))
+    for name in a._defaults:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, name)), np.asarray(getattr(b, name)), err_msg=name
+        )
+    assert a.watermark == b.watermark and a.head_window == b.head_window
+    assert a.dropped_samples == b.dropped_samples == 0
+    np.testing.assert_array_equal(np.asarray(a.compute()), np.asarray(b.compute()))
+
+
+def test_too_late_events_dropped_counted_never_misrouted():
+    m = Windowed(Accuracy(), window_s=10.0, num_windows=3, allowed_lateness_s=5.0)
+    before = obs.COUNTERS.slab_dropped_samples
+    m.update(jnp.asarray(np.float32([0.9, 0.9])), jnp.asarray(np.int32([1, 1])),
+             event_time=np.array([21.0, 25.0]))
+    # watermark 25: window 0 closed at 15, window 1 open until 25 -> an event
+    # at 8.0 is too late, an event at 12.0 is NOT ((1+1)*10+5 = 25 > 25 is
+    # false -> window 1 closed exactly at 25: also dropped)
+    m.update(jnp.asarray(np.float32([0.9, 0.9])), jnp.asarray(np.int32([1, 1])),
+             event_time=np.array([8.0, 12.0]))
+    assert m.dropped_samples == 2
+    assert obs.COUNTERS.slab_dropped_samples - before == 2  # records with obs off
+    # nothing was misrouted: both accepted events sit in window 2 alone
+    rows = np.asarray(m._current_state()["windowed_rows"])
+    assert rows[2 % 3] == 2 and rows.sum() == 2
+    assert m.late_samples == 0
+
+
+def test_rows_match_independent_router_across_rolls():
+    """Zero misrouted, long stream: every resident window's row count equals
+    a plain-numpy reimplementation of the routing rule."""
+    rng = np.random.RandomState(3)
+    m = Windowed(Accuracy(), window_s=10.0, num_windows=3, allowed_lateness_s=10.0)
+    wm = None
+    expected = {}
+    dropped = 0
+    for i in range(12):
+        times = i * 6.0 + rng.uniform(-12.0, 6.0, 8)
+        preds = rng.rand(8).astype(np.float32)
+        target = rng.randint(0, 2, 8).astype(np.int32)
+        m.update(jnp.asarray(preds), jnp.asarray(target), event_time=times)
+        wm = times.max() if wm is None else max(wm, times.max())
+        head = int(np.floor(wm / 10.0))
+        w = np.floor_divide(times, 10.0).astype(int)
+        ok = ((w + 1) * 10.0 + 10.0 > wm) & (w > head - 3)
+        dropped += int((~ok).sum())
+        for wi in w[ok]:
+            expected[int(wi)] = expected.get(int(wi), 0) + 1
+    rows = np.asarray(m._current_state()["windowed_rows"])
+    for w in m.resident_windows():
+        assert rows[w % 3] == expected.get(w, 0), w
+    assert m.dropped_samples == dropped
+
+
+def test_window_roll_parity_vs_running():
+    """One batch per window == Running's last-k-updates view: the slot
+    rotation is the event-time form of Running's delta window."""
+    rng = np.random.RandomState(5)
+    k = 3
+    windowed = Windowed(Accuracy(), window_s=1.0, num_windows=k)
+    running = Running(Accuracy(), window=k)
+    for step in range(8):
+        preds = jnp.asarray(rng.rand(16).astype(np.float32))
+        target = jnp.asarray(rng.randint(0, 2, 16).astype(np.int32))
+        windowed.update(preds, target, event_time=step + 0.5)
+        running.update(preds, target)
+        np.testing.assert_array_equal(
+            np.asarray(windowed.compute()), np.asarray(running.compute()),
+            err_msg=f"step {step}",
+        )
+        windowed._computed = None
+
+
+def test_compute_window_and_merged_match_fresh_metrics():
+    times, preds, target = _stream(seed=7)
+    m = _ring()
+    m.update(jnp.asarray(preds), jnp.asarray(target), event_time=times)
+    w_idx = np.floor_divide(times, 20.0).astype(int)
+    for w in m.resident_windows():
+        sel = w_idx == w
+        if not sel.any():
+            assert np.isnan(float(m.compute_window(w)))
+            continue
+        fresh = Accuracy()
+        fresh.update(jnp.asarray(preds[sel]), jnp.asarray(target[sel]))
+        np.testing.assert_array_equal(
+            np.asarray(m.compute_window(w)), np.asarray(fresh.compute()), err_msg=str(w)
+        )
+    fresh = Accuracy()
+    fresh.update(jnp.asarray(preds), jnp.asarray(target))
+    np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(fresh.compute()))
+    with pytest.raises(KeyError, match="not resident"):
+        m.compute_window(99)
+
+
+def test_sketch_inner_per_window_parity():
+    """Sketch states window for free: per-window AUROC histograms equal the
+    fresh metric's over exactly that window's events."""
+    times, preds, target = _stream(seed=11)
+    m = Windowed(AUROC(approx="sketch", num_bins=64), window_s=20.0, num_windows=4,
+                 allowed_lateness_s=60.0)
+    m.update(jnp.asarray(preds), jnp.asarray(target), event_time=times)
+    w_idx = np.floor_divide(times, 20.0).astype(int)
+    for w in m.resident_windows():
+        sel = w_idx == w
+        if not sel.any():
+            continue
+        fresh = AUROC(approx="sketch", num_bins=64)
+        fresh.update(jnp.asarray(preds[sel]), jnp.asarray(target[sel]))
+        np.testing.assert_allclose(
+            np.asarray(m.compute_window(w)), np.asarray(fresh.compute()),
+            rtol=1e-6, err_msg=str(w),
+        )
+
+
+def test_windowed_keyed_composition_per_cohort_windows():
+    """The headline serving scenario composes: ``Windowed(Keyed(...))`` —
+    windows wrap the segment axis ((W, K, ...) states), the merged view
+    equals the unwindowed Keyed metric when every window is resident, and a
+    per-window read equals a fresh Keyed over exactly that window's events."""
+    from metrics_tpu import Keyed
+
+    rng = np.random.RandomState(23)
+    scores = rng.rand(300).astype(np.float32)
+    labels = rng.randint(0, 2, 300).astype(np.int32)
+    slots = rng.randint(0, 3, 300).astype(np.int32)
+    times = rng.uniform(0, 120.0, 300)
+
+    ck = Windowed(Keyed(AUROC(approx="sketch", num_bins=64), num_slots=3),
+                  window_s=60.0, num_windows=2, allowed_lateness_s=60.0)
+    ck.update(jnp.asarray(scores), jnp.asarray(labels), slot=jnp.asarray(slots),
+              event_time=times)
+
+    alone = Keyed(AUROC(approx="sketch", num_bins=64), num_slots=3)
+    alone.update(jnp.asarray(scores), jnp.asarray(labels), slot=jnp.asarray(slots))
+    np.testing.assert_array_equal(np.asarray(ck.compute()), np.asarray(alone.compute()))
+
+    sel = np.floor_divide(times, 60.0).astype(int) == 0
+    fresh = Keyed(AUROC(approx="sketch", num_bins=64), num_slots=3)
+    fresh.update(jnp.asarray(scores[sel]), jnp.asarray(labels[sel]),
+                 slot=jnp.asarray(slots[sel]))
+    np.testing.assert_array_equal(
+        np.asarray(ck.compute_window(0)), np.asarray(fresh.compute())
+    )
+    # a roll recycles the nested slab in place
+    ck.update(jnp.asarray(scores[:4]), jnp.asarray(labels[:4]),
+              slot=jnp.asarray(slots[:4]), event_time=np.full(4, 200.0))
+    assert ck.resident_windows() == (2, 3)
+    # decay mode rejects nesting loudly (its mean division clamps at 1)
+    with pytest.raises(ValueError, match="segment slab"):
+        Windowed(Keyed(Accuracy(), num_slots=2), decay_half_life_s=5.0)
+
+
+# --------------------------------------------------- preemption-safe resume
+def test_checkpoint_round_trip_restores_stream_position():
+    times, preds, target = _stream(seed=13)
+    m = _ring()
+    m.update(jnp.asarray(preds), jnp.asarray(target), event_time=times)
+    m.update(jnp.asarray(preds[:4]), jnp.asarray(target[:4]),
+             event_time=np.full(4, -100.0))  # too late: bump the drop counter
+    sd = m.state_dict()
+    restored = _ring()
+    restored.load_state_dict(sd)
+    assert restored.watermark == m.watermark
+    assert restored.head_window == m.head_window
+    assert restored.resident_windows() == m.resident_windows()
+    assert restored.dropped_samples == m.dropped_samples
+    assert restored.epoch_watermark == m.epoch_watermark
+    for name in m._defaults:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(restored, name)), np.asarray(getattr(m, name)), err_msg=name
+        )
+    np.testing.assert_array_equal(np.asarray(restored.compute()), np.asarray(m.compute()))
+
+
+def test_preempt_mid_window_resume_idempotent_via_guarded_update():
+    """The serving resume story at the metric level: checkpoint mid-window,
+    'die', restore, and replay from BEFORE the checkpoint — already-folded
+    steps no-op, the stream completes identically to the uninterrupted run."""
+    rng = np.random.RandomState(17)
+    batches = []
+    for i in range(8):
+        batches.append((
+            i * 5.0 + rng.uniform(0, 5.0, 8),
+            rng.rand(8).astype(np.float32),
+            rng.randint(0, 2, 8).astype(np.int32),
+        ))
+
+    straight = Windowed(Accuracy(), window_s=10.0, num_windows=3, allowed_lateness_s=10.0)
+    for i, (t, p, y) in enumerate(batches):
+        assert straight.guarded_update(i, jnp.asarray(p), jnp.asarray(y), event_time=t)
+
+    interrupted = Windowed(Accuracy(), window_s=10.0, num_windows=3, allowed_lateness_s=10.0)
+    for i, (t, p, y) in enumerate(batches[:5]):
+        interrupted.guarded_update(i, jnp.asarray(p), jnp.asarray(y), event_time=t)
+    snapshot = interrupted.state_dict()  # mid-window checkpoint, then "preempt"
+
+    resumed = Windowed(Accuracy(), window_s=10.0, num_windows=3, allowed_lateness_s=10.0)
+    resumed.load_state_dict(snapshot)
+    for i, (t, p, y) in enumerate(batches[3:], start=3):  # replay overlaps 3..4
+        applied = resumed.guarded_update(i, jnp.asarray(p), jnp.asarray(y), event_time=t)
+        assert applied == (i >= 5), i  # below-watermark steps are no-ops
+    for name in straight._defaults:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(resumed, name)), np.asarray(getattr(straight, name)),
+            err_msg=name,
+        )
+    assert resumed.dropped_samples == straight.dropped_samples
+
+
+# ------------------------------------------------------------- decay mode
+def test_decay_accumulator_matches_closed_form():
+    m = Windowed(MeanSquaredError(), decay_half_life_s=10.0)
+    samples = [(0.0, 4.0), (10.0, 1.0), (20.0, 9.0)]  # (time, squared error)
+    for t, sq in samples:
+        m.update(jnp.asarray(np.float32([np.sqrt(sq)])), jnp.asarray(np.float32([0.0])),
+                 event_time=t)
+    weights = [0.5 ** ((20.0 - t) / 10.0) for t, _ in samples]
+    expected = sum(w * sq for w, (_, sq) in zip(weights, samples)) / sum(weights)
+    np.testing.assert_allclose(float(m.compute()), expected, rtol=1e-6)
+    assert m.watermark == 20.0 and m.head_window is None
+
+
+def test_decay_drops_beyond_lateness_and_rejects_bad_states():
+    m = Windowed(MeanSquaredError(), decay_half_life_s=10.0, allowed_lateness_s=5.0)
+    m.update(jnp.asarray(np.float32([1.0])), jnp.asarray(np.float32([0.0])), event_time=100.0)
+    m.update(jnp.asarray(np.float32([9.0])), jnp.asarray(np.float32([0.0])), event_time=10.0)
+    assert m.dropped_samples == 1
+    np.testing.assert_allclose(float(m.compute()), 1.0, rtol=1e-6)
+    with pytest.raises(ValueError, match="sketch"):
+        Windowed(AUROC(approx="sketch"), decay_half_life_s=5.0)
+    with pytest.raises(ValueError, match="no windows"):
+        m.compute_window(0)
+
+
+# ------------------------------------------------------------- validation
+def test_constructor_validation():
+    with pytest.raises(ValueError, match="exactly one of"):
+        Windowed(Accuracy())
+    with pytest.raises(ValueError, match="exactly one of"):
+        Windowed(Accuracy(), window_s=10.0, decay_half_life_s=5.0)
+    with pytest.raises(ValueError, match="still-open horizon"):
+        Windowed(Accuracy(), window_s=10.0, num_windows=2, allowed_lateness_s=60.0)
+    with pytest.raises(ValueError, match="cat/list/buffer"):
+        Windowed(AUROC(), window_s=10.0)  # buffer-state curve metric
+    with pytest.raises(ValueError, match="must be a Metric"):
+        Windowed(object(), window_s=10.0)
+    with pytest.raises(ValueError, match="empty"):
+        Windowed(Accuracy(), window_s=10.0, empty="drop")
+
+
+def test_update_requires_event_time_and_matching_sizes():
+    m = _ring()
+    with pytest.raises(ValueError, match="event_time"):
+        m.update(jnp.asarray(np.float32([0.5])), jnp.asarray(np.int32([1])))
+    with pytest.raises(ValueError, match="entries"):
+        m.update(jnp.asarray(np.float32([0.5, 0.5])), jnp.asarray(np.int32([1, 1])),
+                 event_time=np.array([1.0, 2.0, 3.0]))
+    # scalar event_time stamps the whole batch
+    m.update(jnp.asarray(np.float32([0.9, 0.2])), jnp.asarray(np.int32([1, 1])),
+             event_time=5.0)
+    assert int(np.asarray(m._current_state()["windowed_rows"]).sum()) == 2
+
+
+def test_update_under_trace_raises():
+    m = _ring()
+
+    def step(p, t):
+        m.update(p, t, event_time=1.0)
+        return p
+
+    with pytest.raises(TracingUnsupportedError):
+        jax.jit(step)(jnp.asarray(np.float32([0.5])), jnp.asarray(np.int32([1])))
+
+
+def test_empty_policy_nan_vs_zero():
+    nan_m = _ring()
+    zero_m = Windowed(Accuracy(), window_s=20.0, num_windows=4, empty="zero")
+    assert np.isnan(float(nan_m.compute()))
+    assert float(zero_m.compute()) == 0.0
+
+
+def test_reset_clears_stream_position():
+    m = _ring()
+    m.update(jnp.asarray(np.float32([0.9])), jnp.asarray(np.int32([1])), event_time=50.0)
+    m.reset()
+    assert m.watermark is None and m.head_window is None
+    assert m.resident_windows() == () and m.dropped_samples == 0
+    assert np.isnan(float(m.compute()))
+
+
+# --------------------------------------------------- mesh sync (flat + hier)
+@pytest.mark.parametrize("hierarchical", [False, True], ids=["flat", "hier42"])
+def test_mesh_synced_compute_matches_single_process(eight_devices, hierarchical):
+    """The serving acceptance property on a REAL staged program: 8 device
+    shards hold window slabs, one coalesced sync moves every window, and the
+    synced compute equals the single-process stream bit-exactly — with a
+    PSUM-ONLY program (windows are a state axis, never extra collectives)."""
+    m = Windowed(AUROC(approx="sketch", num_bins=32), window_s=20.0, num_windows=4,
+                 allowed_lateness_s=60.0)
+    rng = np.random.RandomState(7)
+    preds = rng.rand(8, 64).astype(np.float32)
+    target = rng.randint(0, 2, (8, 64)).astype(np.int32)
+    times = rng.uniform(0.0, 80.0, (8, 64))
+
+    # stage the per-shard slabs EAGERLY (the router is host-side), then sync
+    # the stacked states in one staged program — the serving deployment
+    # shape: local windowed updates, one collective per publish
+    shards = []
+    for r in range(8):
+        shard = Windowed(AUROC(approx="sketch", num_bins=32), window_s=20.0, num_windows=4,
+                         allowed_lateness_s=60.0)
+        shard.update(jnp.asarray(preds[r]), jnp.asarray(target[r]), event_time=times[r])
+        shards.append(shard._current_state())
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *shards)
+
+    if hierarchical:
+        mesh = Mesh(np.array(eight_devices).reshape(2, 4), ("dcn", "ici"))
+        axis, specs = MeshHierarchy(ici_axis="ici", dcn_axis="dcn"), P(("dcn", "ici"))
+    else:
+        mesh = Mesh(np.array(eight_devices), ("dp",))
+        axis, specs = "dp", P("dp")
+
+    def fn(state):
+        local = jax.tree_util.tree_map(lambda x: x[0], state)
+        return m.sync_state(local, axis)
+
+    obs.enable()
+    obs.COUNTERS.reset()
+    f = jax.jit(compat.shard_map(
+        fn, mesh=mesh, in_specs=(specs,),
+        out_specs=jax.tree_util.tree_map(lambda _: P(), m.init_state()),
+        check_vma=False,
+    ))
+    synced = f(stacked)
+    snap = obs.counters_snapshot()
+    obs.disable()
+
+    # psum-only: the histogram slab + row-count slab share ONE int32 bucket
+    assert snap["calls_by_kind"].get("psum", 0) == (2 if hierarchical else 1)
+    for kind in ("all_gather", "coalesced_gather", "process_allgather", "ppermute"):
+        assert snap["calls_by_kind"].get(kind, 0) == 0, kind
+
+    single = Windowed(AUROC(approx="sketch", num_bins=32), window_s=20.0, num_windows=4,
+                      allowed_lateness_s=60.0)
+    single.update(
+        jnp.asarray(preds.reshape(-1)), jnp.asarray(target.reshape(-1)),
+        event_time=times.reshape(-1),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(synced["hist"].counts), np.asarray(single.hist.counts)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(synced["windowed_rows"]), np.asarray(single.windowed_rows)
+    )
