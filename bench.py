@@ -532,6 +532,146 @@ def _build_windowed_sync_runner(windowed: bool = True):
     return run, len(state)
 
 
+def _build_async_sync8_runner(deferred: bool):
+    """(timed_run(steps) -> ms/step, states_synced) for the DEFERRED-SYNC A/B
+    on the sync8 collection: the per-step program split into one update
+    dispatch (per-shard group deltas, stacked over the mesh axis) plus one
+    staged-sync dispatch (``coalesced_sync_state`` — the identical bucketed
+    psum the in-loop plane stages). Both variants dispatch the SAME two
+    programs per step; only the fence moves. The fenced variant
+    (``deferred=False``) blocks on each step's sync before the next step —
+    the synchronous plane's critical path. The deferred variant dispatches
+    through ``parallel.deferred.deferred_sync_state`` and fences the
+    PREVIOUS step's :class:`SyncHandle` (the ``sync_lag=1`` read), so the
+    collective's device time overlaps the next step's update. After each
+    ``run(steps)`` call, ``run.last_wait_ms`` holds the total time the host
+    spent blocked on fences — the overlap evidence ``--check-async``
+    reports next to the ms A/B.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from metrics_tpu.parallel.deferred import DeferredSyncPlane
+    from metrics_tpu.parallel.sync import coalesced_sync_state
+    from metrics_tpu.utils.compat import shard_map
+
+    col = _collection_ours(True)
+    pure = col.pure()
+    mesh = Mesh(np.array(jax.devices("cpu")[:N_DEVICES]), ("dp",))
+    init = pure.init()
+    reductions = {(k, n): col[k]._reductions[n] for k, s in init.items() for n in s}
+
+    def upd(preds, target):
+        delta = pure.update(pure.init(), preds, target)
+        flat = {(k, n): v for k, s in delta.items() for n, v in s.items()}
+        return jax.tree_util.tree_map(lambda x: x[None], flat)
+
+    update_prog = jax.jit(
+        shard_map(upd, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P("dp"))
+    )
+
+    def syncb(flat):
+        per = {k: v[0] for k, v in flat.items()}
+        return coalesced_sync_state(per, reductions, "dp")
+
+    # vma checking off: psum outputs are replicated but the checker cannot
+    # always prove it through the bucket slicing (same as the gather runners)
+    sync_prog = jax.jit(
+        shard_map(syncb, mesh=mesh, in_specs=(P("dp"),), out_specs=P(), check_vma=False)
+    )
+
+    rng = np.random.RandomState(0)
+    batch = BATCH_PER_DEVICE * N_DEVICES
+    logits = rng.rand(batch, NUM_CLASSES).astype(np.float32)
+    preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+    target = jnp.asarray(rng.randint(0, NUM_CLASSES, batch).astype(np.int32))
+
+    if deferred:
+        # the hot-loop form: the plane resolves its compiled program once
+        # (tracing here, so the staged-collective capture sees it) and each
+        # step pays one unfenced dispatch + one handle
+        template = update_prog(preds, target)
+        plane = DeferredSyncPlane(reductions, "dp", mesh, template)
+
+        def run(steps: int) -> float:
+            handle = None
+            wait = 0.0
+            start = time.perf_counter()
+            for _ in range(steps):
+                nxt = plane.dispatch(update_prog(preds, target))
+                if handle is not None:
+                    w0 = time.perf_counter()
+                    handle.result()
+                    wait += time.perf_counter() - w0
+                handle = nxt
+            w0 = time.perf_counter()
+            handle.result()
+            wait += time.perf_counter() - w0
+            run.last_wait_ms = wait * 1e3
+            return (time.perf_counter() - start) / steps * 1e3
+
+    else:
+
+        def run(steps: int) -> float:
+            wait = 0.0
+            start = time.perf_counter()
+            for _ in range(steps):
+                synced = sync_prog(update_prog(preds, target))
+                w0 = time.perf_counter()
+                jax.block_until_ready(synced)
+                wait += time.perf_counter() - w0
+            run.last_wait_ms = wait * 1e3
+            return (time.perf_counter() - start) / steps * 1e3
+
+    run.last_wait_ms = 0.0
+    return run, len(reductions)
+
+
+# serving ingest throughput: the traffic-generator scenario. Event times
+# advance ~2.5 s per batch over 10 s windows, so the measured loop includes
+# real window closes (and their deferred publishes) — ingest throughput of
+# the SERVING loop, not of a bare update.
+SERVICE_INGEST_BATCHES = 24
+SERVICE_INGEST_BATCH = 64
+SERVICE_INGEST_WARMUP = 4
+
+
+def _bench_service_ingest(batches: int = SERVICE_INGEST_BATCHES) -> float:
+    """Sustained batches/sec through a real ``MetricService`` ingest loop
+    (bounded queue, background worker, watermark routing, deferred window
+    publishes) — the serving-throughput number ``service_sync_ms`` never
+    measured: that key times the sync *program*, this one times the loop."""
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, MetricService, Windowed
+    from metrics_tpu.parallel.sync import gather_all_arrays
+
+    metric = Windowed(
+        Accuracy(), window_s=10.0, num_windows=4, allowed_lateness_s=10.0,
+        dist_sync_fn=gather_all_arrays,
+    )
+    rng = np.random.RandomState(3)
+    data = []
+    for i in range(batches + SERVICE_INGEST_WARMUP):
+        preds = jnp.asarray(rng.rand(SERVICE_INGEST_BATCH).astype(np.float32))
+        target = jnp.asarray((rng.rand(SERVICE_INGEST_BATCH) > 0.5).astype(np.int32))
+        times = i * 2.5 + rng.uniform(0.0, 2.5, SERVICE_INGEST_BATCH)
+        data.append((preds, target, times))
+    with MetricService(metric, queue_size=batches + SERVICE_INGEST_WARMUP) as svc:
+        for preds, target, times in data[:SERVICE_INGEST_WARMUP]:
+            svc.submit(preds, target, event_time=times)  # compile the scatter path
+        svc.flush()
+        start = time.perf_counter()
+        for preds, target, times in data[SERVICE_INGEST_WARMUP:]:
+            svc.submit(preds, target, event_time=times)
+        svc.flush()
+        elapsed = time.perf_counter() - start
+    return batches / max(elapsed, 1e-9)
+
+
 def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trace_path=None) -> dict:
     """Compute-groups on/off A/B over the same 8-device mesh program.
 
@@ -653,6 +793,28 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
         with (obs.span("bench.timed_service_windowed") if obs else _null_cm()):
             service_times.append(run_service(steps))
 
+    # deferred-sync A/B: the same sync8 staged program dispatched FENCED each
+    # step (the synchronous plane's critical path) vs deferred one step
+    # (sync_lag=1 read through parallel.deferred) — identical collectives,
+    # only the fence moves; the ms gap is the overlap the deferred plane buys
+    run_async, states_async, async_counters = build(
+        _build_async_sync8_runner, True, "async_sync8"
+    )
+    run_fenced, _, async_fenced_counters = build(
+        _build_async_sync8_runner, False, "fenced_sync8"
+    )
+    async_times, fenced_times = [], []
+    for _ in range(repeats):
+        with (obs.span("bench.timed_async_sync8") if obs else _null_cm()):
+            async_times.append(run_async(steps))
+        with (obs.span("bench.timed_fenced_sync8") if obs else _null_cm()):
+            fenced_times.append(run_fenced(steps))
+
+    # the traffic-generator scenario: sustained batches/sec through a real
+    # MetricService ingest loop (deferred window publishes included)
+    with (obs.span("bench.service_ingest") if obs else _null_cm()):
+        ingest_steps_per_s = _bench_service_ingest()
+
     out = {
         "grouped_sync8_ms": grouped_ms,
         "ungrouped_sync8_ms": ungrouped_ms,
@@ -715,6 +877,21 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
             for k in ("all_gather", "coalesced_gather", "process_allgather")
         ),
         "service_unwindowed_collective_calls": service_unwindowed_counters["collective_calls"],
+        # the deferred sync plane: identical staged program as the fenced
+        # synchronous twin (count pinned equal, psum-only), with the ms gap
+        # showing the overlap; --check-trajectory binds on all of these
+        "async_sync8_ms": min(async_times),
+        "fenced_sync8_ms": min(fenced_times),
+        "async_states_synced": states_async,
+        "async_collective_calls": async_counters["collective_calls"],
+        "async_sync_bytes": async_counters["sync_bytes"],
+        "async_gather_calls": sum(
+            async_counters["calls_by_kind"].get(k, 0)
+            for k in ("all_gather", "coalesced_gather", "process_allgather")
+        ),
+        "async_fenced_collective_calls": async_fenced_counters["collective_calls"],
+        # serving ingest throughput (batches/sec through a real service loop)
+        "service_ingest_steps_per_s": round(ingest_steps_per_s, 3),
         # slab drop evidence rides the default line pinned at ZERO (in-window
         # traffic never drops; the --check-service chaos soak pins nonzero)
         "slab_dropped_samples": service_counters.get("slab_dropped_samples", 0),
@@ -738,18 +915,20 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
             finally:
                 devtime_mod.disable()
 
-        # v6: the windowed serving A/B joined (window-count-independent
-        # staged-collective keys + slab_dropped_samples on the default line,
-        # full service counters here); v5 added the keyed slab A/B; v4 the
-        # sketch A/B; v3 moved the collective counts to the default line and
-        # added the hierarchical A/B
-        out["trace_schema"] = 6
+        # v7: the deferred-sync A/B joined (async_* staged-count keys +
+        # fenced twin + service_ingest_steps_per_s on the default line, full
+        # async counters here — incl. the deferred dispatch/fence/completion
+        # block); v6 added the windowed serving A/B; v5 the keyed slab A/B;
+        # v4 the sketch A/B; v3 moved the collective counts to the default
+        # line and added the hierarchical A/B
+        out["trace_schema"] = 7
         out["counters"] = grouped_counters
         out["gather_counters"] = coal_counters
         out["hier_counters"] = hier_counters
         out["sketch_counters"] = sketch_counters
         out["keyed_counters"] = keyed_counters
         out["service_counters"] = service_counters
+        out["async_counters"] = async_counters
         summary = obs.summarize()
         out["phase_ms"] = {
             name: round(row["total_ms"], 3) for name, row in sorted(summary.items())
@@ -1081,6 +1260,14 @@ _TRACE_KEYS = (
     "service_sync_bytes",
     "service_gather_calls",
     "service_unwindowed_collective_calls",
+    "async_sync8_ms",
+    "fenced_sync8_ms",
+    "async_states_synced",
+    "async_collective_calls",
+    "async_sync_bytes",
+    "async_gather_calls",
+    "async_fenced_collective_calls",
+    "service_ingest_steps_per_s",
     "slab_dropped_samples",
     "counters",
     "gather_counters",
@@ -1088,6 +1275,7 @@ _TRACE_KEYS = (
     "sketch_counters",
     "keyed_counters",
     "service_counters",
+    "async_counters",
     "phase_ms",
     "phase_compile_ms",
     "device_ms",
@@ -1543,6 +1731,236 @@ def check_faults() -> int:
     return 1 if failures else 0
 
 
+# -------------------------------------------------------- deferred-sync gate
+# --check-async pins the deferred-sync contract on the sync8 scenario:
+#   parity  — the deferred plane's staged collective COUNT and KINDS are
+#             IDENTICAL to the synchronous plane's (it dispatches the same
+#             coalesced_sync_state program; zero new collective kinds)
+#   lag     — Metric sync_lag=1 forward values are BIT-EXACT the synchronous
+#             plane's previous-step values (step 0 reads the documented
+#             local warm-up view); the epoch compute matches exactly
+#   overlap — the sync8 collection's dist_sync_on_step forward loop under a
+#             SIMULATED-DCN gather: the sync_lag=1 plane's step ms must come
+#             in strictly below the synchronous plane's. The gather sleeps
+#             ASYNC_DCN_SLEEP_S inside the call — exactly where a multi-host
+#             process_allgather would block the caller — because this image
+#             is single-host (often single-core): a real DCN rendezvous wait
+#             does not exist here, and device-plane concurrency cannot be
+#             measured on one core (an executing psum IS the core's work;
+#             only a *waiting* gather yields it). The deferred plane's win is
+#             hiding exactly that wait behind the next step's update, which
+#             the sleep reproduces faithfully. The device plane's fence-wait
+#             split (async fences wait less host time than the synchronous
+#             block) rides along as supporting evidence.
+ASYNC_GATE_STEPS = 60
+ASYNC_GATE_REPEATS = 4
+ASYNC_LAG_BATCHES = 6
+ASYNC_DCN_SLEEP_S = 0.002  # simulated per-gather-call DCN rendezvous wait
+ASYNC_FWD_STEPS = 10
+ASYNC_FWD_ROWS = 1024
+
+
+def _build_async_forward_runner(sync_lag: int):
+    """(timed_run(steps) -> ms/step) for the dist_sync_on_step forward A/B:
+    the sync8 collection driven through real per-step forwards with a
+    simulated-DCN host gather as every member's ``dist_sync_fn``.
+
+    ``compute_groups=False`` keeps the two variants structurally identical —
+    four per-member gather planes per step either way (grouped ``sync_lag=0``
+    members would share step gathers, which lag members by design do not).
+    With ``sync_lag=1`` each forward dispatches its plane on the background
+    executor and reads the previous step's view; the synchronous variant
+    blocks the step on all four gathers.
+    """
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, F1, MetricCollection, Precision, Recall
+    from metrics_tpu.parallel.sync import packable_gather
+
+    @packable_gather
+    def dcn_gather(value):
+        time.sleep(ASYNC_DCN_SLEEP_S)  # the rendezvous wait a real DCN pays
+        return [value]
+
+    kw = dict(dist_sync_on_step=True, dist_sync_fn=dcn_gather)
+    col = MetricCollection([
+        Accuracy(**kw),
+        F1(num_classes=NUM_CLASSES, average="macro", **kw),
+        Precision(num_classes=NUM_CLASSES, average="macro", **kw),
+        Recall(num_classes=NUM_CLASSES, average="macro", **kw),
+    ], compute_groups=False)
+    for m in col.values():
+        m.sync_lag = sync_lag
+
+    rng = np.random.RandomState(0)
+    logits = rng.rand(ASYNC_FWD_ROWS, NUM_CLASSES).astype(np.float32)
+    preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+    target = jnp.asarray(rng.randint(0, NUM_CLASSES, ASYNC_FWD_ROWS).astype(np.int32))
+
+    def run(steps: int) -> float:
+        start = time.perf_counter()
+        for _ in range(steps):
+            col(preds, target)
+        # the lag variant's last planes are still in flight: fencing them
+        # keeps the measured window honest (it owns all the work it queued)
+        for m in col.values():
+            handle = m._deferred_handle
+            if handle is not None:
+                handle.result()
+                m._deferred_handle = None
+        return (time.perf_counter() - start) / steps * 1e3
+
+    return run
+
+
+def check_async() -> int:
+    """``--check-async``: the deferred-sync regression gate (see the block
+    comment above). Prints one JSON report line; non-zero exit on any broken
+    contract."""
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, observability as obs
+    from metrics_tpu.parallel.sync import gather_all_arrays
+
+    failures = []
+
+    # -- parity: identical staged collective count/kinds, zero new kinds ----
+    obs.enable()
+    run_fenced, _ = _build_async_sync8_runner(False)
+    obs.COUNTERS.reset()
+    run_fenced(1)  # first call traces+compiles: counters hold the staged program
+    snap_sync = obs.counters_snapshot()
+    run_async, _ = _build_async_sync8_runner(True)
+    obs.COUNTERS.reset()
+    run_async(1)
+    snap_async = obs.counters_snapshot()
+    obs.disable()
+    parity = {
+        "sync_calls_by_kind": snap_sync["calls_by_kind"],
+        "async_calls_by_kind": snap_async["calls_by_kind"],
+        "sync_bytes": snap_sync["sync_bytes"],
+        "async_bytes": snap_async["sync_bytes"],
+        "async_deferred": snap_async["deferred"],
+    }
+    if snap_async["calls_by_kind"] != snap_sync["calls_by_kind"]:
+        failures.append(
+            f"parity: deferred plane staged {snap_async['calls_by_kind']} vs the"
+            f" synchronous plane's {snap_sync['calls_by_kind']} — the deferred"
+            " dispatch must stage the identical collective count and kinds"
+        )
+    if snap_async["sync_bytes"] != snap_sync["sync_bytes"]:
+        failures.append(
+            f"parity: deferred plane moved {snap_async['sync_bytes']} bytes vs"
+            f" {snap_sync['sync_bytes']} — same program, same payload"
+        )
+    if snap_async["deferred"]["dispatched"] != snap_async["deferred"]["fenced"]:
+        failures.append(
+            f"parity: {snap_async['deferred']['dispatched']} dispatches vs"
+            f" {snap_async['deferred']['fenced']} fences — the A/B leaked a handle"
+        )
+
+    # -- lag: sync_lag=1 reads are the previous step's synchronous values ---
+    rng = np.random.RandomState(11)
+    batches = []
+    for _ in range(ASYNC_LAG_BATCHES):
+        preds = jnp.asarray(rng.rand(128).astype(np.float32))
+        target = jnp.asarray((rng.rand(128) > 0.5).astype(np.int32))
+        batches.append((preds, target))
+    sync_m = Accuracy(dist_sync_on_step=True, dist_sync_fn=gather_all_arrays)
+    lag_m = Accuracy(dist_sync_on_step=True, dist_sync_fn=gather_all_arrays)
+    lag_m.sync_lag = 1
+    sync_vals = [np.asarray(sync_m(*b)) for b in batches]
+    lag_vals = [np.asarray(lag_m(*b)) for b in batches]
+    for i in range(1, ASYNC_LAG_BATCHES):
+        if not np.array_equal(lag_vals[i], sync_vals[i - 1]):
+            failures.append(
+                f"lag: sync_lag=1 step {i} value {lag_vals[i]} != synchronous"
+                f" step {i - 1} value {sync_vals[i - 1]} (the 1-step-lag contract)"
+            )
+    if not np.array_equal(lag_vals[0], sync_vals[0]):
+        # single-process: the warm-up step's local delta IS the synced delta
+        failures.append(
+            f"lag: warm-up step value {lag_vals[0]} != the local batch value"
+            f" {sync_vals[0]}"
+        )
+    sync_epoch = np.asarray(sync_m.compute())
+    lag_epoch = np.asarray(lag_m.compute())
+    if not np.array_equal(lag_epoch, sync_epoch):
+        failures.append(
+            f"lag: epoch compute {lag_epoch} != synchronous {sync_epoch} — the"
+            " accumulated state must not lag, only the per-step read"
+        )
+
+    # -- overlap: the dist_sync_on_step forward loop under simulated DCN ----
+    run_lag = _build_async_forward_runner(1)
+    run_sync_fwd = _build_async_forward_runner(0)
+    run_lag(2)  # warm both paths past compile noise
+    run_sync_fwd(2)
+    lag_times, sync_fwd_times = [], []
+    for r in range(ASYNC_GATE_REPEATS):
+        # alternate the pair order: the A/B is a difference of two absolute
+        # measurements, and a monotonic load drift would otherwise bias
+        # whichever variant consistently ran second
+        order = (True, False) if r % 2 == 0 else (False, True)
+        for is_lag in order:
+            if is_lag:
+                lag_times.append(run_lag(ASYNC_FWD_STEPS))
+            else:
+                sync_fwd_times.append(run_sync_fwd(ASYNC_FWD_STEPS))
+    async_ms, fenced_ms = min(lag_times), min(sync_fwd_times)
+
+    # device-plane fence-wait split: the deferred fence waits strictly less
+    # host time than the synchronous block (the hidden wait IS the overlap)
+    run_async(ASYNC_GATE_STEPS)  # warm past compile noise
+    run_fenced(ASYNC_GATE_STEPS)
+    device_async_times, device_fenced_times = [], []
+    async_waits, fenced_waits = [], []
+    for _ in range(3):
+        device_async_times.append(run_async(ASYNC_GATE_STEPS))
+        async_waits.append(run_async.last_wait_ms / ASYNC_GATE_STEPS)
+        device_fenced_times.append(run_fenced(ASYNC_GATE_STEPS))
+        fenced_waits.append(run_fenced.last_wait_ms / ASYNC_GATE_STEPS)
+    device_async_ms, device_fenced_ms = min(device_async_times), min(device_fenced_times)
+    async_wait, fenced_wait = min(async_waits), min(fenced_waits)
+
+    overlap = {
+        "async_step_ms": round(async_ms, 4),
+        "sync_step_ms": round(fenced_ms, 4),
+        "simulated_dcn_ms": ASYNC_DCN_SLEEP_S * 1e3,
+        "steps": ASYNC_FWD_STEPS,
+        "device_async_ms": round(device_async_ms, 4),
+        "device_fenced_ms": round(device_fenced_ms, 4),
+        "async_fence_wait_ms": round(async_wait, 4),
+        "fenced_block_ms": round(fenced_wait, 4),
+    }
+    if not async_ms < fenced_ms:
+        failures.append(
+            f"overlap: sync_lag=1 step {async_ms:.4g} ms not strictly below the"
+            f" synchronous step {fenced_ms:.4g} ms — the deferred plane is not"
+            " hiding the gather wait behind the next step's update"
+        )
+    if not async_wait < fenced_wait:
+        failures.append(
+            f"overlap: deferred fences waited {async_wait:.4g} ms/step vs the"
+            f" synchronous block's {fenced_wait:.4g} — the device dispatch is not"
+            " running ahead of its fence"
+        )
+
+    print(json.dumps({
+        "check": "async",
+        "ok": not failures,
+        "failures": failures,
+        "parity": parity,
+        "lag": {
+            "sync_vals": [float(v) for v in sync_vals],
+            "lag_vals": [float(v) for v in lag_vals],
+            "epoch": float(sync_epoch),
+        },
+        "overlap": overlap,
+    }))
+    return 1 if failures else 0
+
+
 # ------------------------------------------------------- serving-runtime gate
 # --check-service soaks the windowed serving loop (wrappers/windowed.py +
 # serving/service.py) end to end and pins the serving contract:
@@ -1883,6 +2301,15 @@ def main() -> None:
         # jax not yet imported, so the platform pin lands in-process
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         raise SystemExit(check_faults())
+
+    if len(sys.argv) > 1 and sys.argv[1] == "--check-async":
+        # deferred-sync gate: the A/B traces the 8-virtual-device sync8
+        # programs (jax not yet imported, so the flag lands in-process)
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={N_DEVICES}"
+        ).strip()
+        raise SystemExit(check_async())
 
     if len(sys.argv) > 1 and sys.argv[1] == "--check-service":
         # serving-runtime gate: the soaks are host-plane, but the parity

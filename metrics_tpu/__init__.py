@@ -23,6 +23,7 @@ from metrics_tpu.core.metric import (  # noqa: E402
     set_default_jit,
     state_integrity_counts,
 )
+from metrics_tpu.parallel.deferred import SyncHandle  # noqa: E402  (deferred sync plane)
 from metrics_tpu.utils.debug import enable_sync_count_check  # noqa: E402
 from metrics_tpu.utils.profiling import profile_metric, time_fn  # noqa: E402
 from metrics_tpu.classification import (  # noqa: E402

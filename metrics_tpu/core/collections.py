@@ -235,7 +235,10 @@ class MetricCollection(OrderedDict):
         configuration as the group's first eligible member (same
         ``dist_sync_fn`` identity, same ``process_group``), with no
         sharded-engine self-sync. Groups with < 2 eligible members keep the
-        per-member path — nothing is saved.
+        per-member path — nothing is saved. ``sync_lag=1`` members are
+        excluded: their per-step gather is a DEFERRED dispatch whose handle
+        lives on the member (``Metric._deferred_handle``) — they defer
+        through their own compute path instead of the shared eager gather.
         """
         import jax
 
@@ -246,6 +249,7 @@ class MetricCollection(OrderedDict):
             if (
                 m.dist_sync_on_step
                 and m.compute_on_step
+                and not getattr(m, "sync_lag", 0)
                 and not m._states_own_sync()
                 and (m.dist_sync_fn is not None or multiproc)
             ):
@@ -939,7 +943,13 @@ class MetricCollection(OrderedDict):
     def merge_states(self, a: Dict[str, Dict[str, Any]], b: Dict[str, Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
         return {k: self[k].merge_states(a[k], b[k]) for k in a}
 
-    def sync_state(self, state: Dict[str, Dict[str, Any]], axis_name: Any) -> Dict[str, Dict[str, Any]]:
+    def sync_state(
+        self,
+        state: Dict[str, Dict[str, Any]],
+        axis_name: Any,
+        deferred: bool = False,
+        mesh: Any = None,
+    ) -> Dict[str, Dict[str, Any]]:
         """In-jit sync of the joint state over a mesh axis — leaves across
         ALL entries coalesce into per-dtype bucketed collectives (see
         ``parallel.sync.coalesced_sync_state``): one ``psum``/``pmin``/
@@ -953,11 +963,31 @@ class MetricCollection(OrderedDict):
         a 10,000-segment member adds payload to an existing bucket, never a
         collective. Pass a ``parallel.placement.MeshHierarchy`` as
         ``axis_name`` on a 2-level (ici x dcn) mesh to stage every bucket
-        hierarchically (only per-slice payloads cross DCN)."""
+        hierarchically (only per-slice payloads cross DCN).
+
+        ``deferred=True`` is the FUTURE-RETURNING form (eager callers only;
+        same contract as ``Metric.sync_state``): the joint state — every
+        leaf stacked over the mesh axis on its leading dimension — is
+        snapshotted and the compiled bucketed sync is dispatched WITHOUT
+        fencing; the returned :class:`~metrics_tpu.parallel.deferred.
+        SyncHandle` resolves to the same nested ``{member: {state: value}}``
+        dict the synchronous call returns, staging the IDENTICAL
+        collectives."""
         from metrics_tpu.parallel.sync import coalesced_sync_state
 
         flat = {(k, n): v for k, s in state.items() for n, v in s.items()}
         reductions = {(k, n): self[k]._reductions[n] for k, s in state.items() for n in s}
+        if deferred:
+            from metrics_tpu.parallel.deferred import deferred_sync_state
+
+            structure = {k: tuple(s) for k, s in state.items()}
+            return deferred_sync_state(
+                flat, reductions, axis_name, mesh=mesh,
+                watermark=self.epoch_watermark,
+                finish=lambda synced: {
+                    k: {n: synced[(k, n)] for n in names} for k, names in structure.items()
+                },
+            )
         synced = coalesced_sync_state(flat, reductions, axis_name)
         return {k: {n: synced[(k, n)] for n in s} for k, s in state.items()}
 

@@ -171,7 +171,7 @@ _NON_TRACE_ATTRS = frozenset({
     "_default_keys",
     "_to_sync", "_in_forward", "_sync_count", "dist_sync_fn",
     "_placement", "_state_dtype", "compute_on_step", "dist_sync_on_step",
-    "process_group",
+    "process_group", "sync_lag", "_deferred_handle",
 })
 
 
@@ -333,6 +333,22 @@ class Metric(ABC):
             instead of a poisoned gathered one. Subclasses don't forward the
             kwarg — set the ``metric.check_finite`` attribute after
             construction for library metrics.
+        sync_lag: opt-in DEFERRED per-step sync for ``dist_sync_on_step``
+            consumers (``0`` = synchronous, the default; ``1`` = deferred).
+            With ``sync_lag=1`` every ``forward`` snapshots its batch delta
+            (the double buffer — jax arrays are immutable, so the snapshot is
+            free) and dispatches the host gather on the BACKGROUND host plane
+            (``parallel/deferred.py``); the step's returned value is computed
+            from the PREVIOUS step's merged view, which finished gathering
+            while this step's update ran. Values are bit-exact vs the
+            synchronous plane modulo the documented one-step lag: step ``i``
+            (``i >= 1``) returns exactly what the synchronous plane returned
+            at step ``i - 1``; step 0 returns the local (unsynced) batch
+            value as warm-up. Epoch-level ``compute()`` stays synchronous —
+            it first drains any in-flight handle so gather entry order is
+            preserved across ranks. Subclasses don't forward the kwarg — set
+            the ``metric.sync_lag`` attribute after construction for library
+            metrics (same convention as ``check_finite``).
     """
 
     def __init__(
@@ -344,6 +360,7 @@ class Metric(ABC):
         capacity: Optional[int] = None,
         jit: Optional[bool] = None,
         check_finite: Optional[str] = None,
+        sync_lag: int = 0,
     ):
         self.dist_sync_on_step = dist_sync_on_step
         self.compute_on_step = compute_on_step
@@ -358,6 +375,18 @@ class Metric(ABC):
                 f"`check_finite` must be one of {CHECK_FINITE_POLICIES}, got {check_finite!r}"
             )
         self.check_finite = check_finite
+        if sync_lag not in (0, 1):
+            raise ValueError(
+                f"`sync_lag` must be 0 or 1 (the deferred plane reads at most one"
+                f" step behind), got {sync_lag!r}"
+            )
+        if sync_lag and not dist_sync_on_step:
+            raise ValueError(
+                "`sync_lag=1` defers the per-step sync inside `forward`; it requires"
+                " `dist_sync_on_step=True`"
+            )
+        self.sync_lag = int(sync_lag)
+        self._deferred_handle = None  # in-flight SyncHandle (sync_lag=1)
         self._to_sync = True
         self._in_forward = False
         self._sync_count = 0
@@ -612,7 +641,9 @@ class Metric(ABC):
         """Pairwise-associative merge (powers fused forward, tree-reduction, shard merging)."""
         return {name: merge_values(self._reductions[name], a[name], b[name]) for name in self._defaults}
 
-    def sync_state(self, state: State, axis_name: Any) -> State:
+    def sync_state(
+        self, state: State, axis_name: Any, deferred: bool = False, mesh: Any = None
+    ) -> State:
         """In-jit cross-device sync over a named mesh axis (use inside shard_map/pmap).
 
         Leaves of a common dtype sync through bucketed collectives
@@ -628,7 +659,33 @@ class Metric(ABC):
         ``axis_name`` may also be a tuple of axes (the flat world span of a
         2-level mesh) or a ``parallel.placement.MeshHierarchy`` — buckets
         then stage HIERARCHICALLY, ici-first reduce / dcn-first gather, so
-        only per-slice payloads cross the slow interconnect."""
+        only per-slice payloads cross the slow interconnect.
+
+        ``deferred=True`` is the FUTURE-RETURNING form (eager callers only):
+        the state pytree — leaves stacked over the mesh axis on their leading
+        dimension, i.e. the output of a ``shard_map(update,
+        out_specs=P(axis))`` delta program — is snapshotted into the double
+        buffer and the compiled sync program (the IDENTICAL staged
+        collectives) is dispatched WITHOUT fencing; the returned
+        :class:`~metrics_tpu.parallel.deferred.SyncHandle` fences on
+        ``result()``, so XLA overlaps the collective with whatever the host
+        dispatches next. ``mesh`` defaults to the leaves' sharding mesh.
+        Raises ``TracingUnsupportedError`` under a trace (a host-side future
+        cannot exist inside jit — use the synchronous plane there)."""
+        if deferred:
+            if self._under_trace():
+                raise TracingUnsupportedError(
+                    f"{type(self).__name__}.sync_state(deferred=True) dispatches a"
+                    " compiled sync program and returns a host-side SyncHandle,"
+                    " which cannot exist under tracing; inside jit use the"
+                    " synchronous plane (deferred=False)"
+                )
+            from metrics_tpu.parallel.deferred import deferred_sync_state
+
+            return deferred_sync_state(
+                state, self._reductions, axis_name, mesh=mesh,
+                watermark=self._epoch_watermark,
+            )
         return coalesced_sync_state(state, self._reductions, axis_name)
 
     def pure(self) -> PureMetric:
@@ -888,7 +945,11 @@ class Metric(ABC):
             cache = self._current_state()
             bound = self._count_bound
             watermark = self._epoch_watermark
+            handle = self._deferred_handle
             self.reset()
+            # the temp reset must not drop an in-flight deferred handle: the
+            # lagged compute below reads (and replaces) it
+            self._deferred_handle = handle
             try:
                 self.update(*args, **kwargs)
                 self._forward_cache = self.compute()
@@ -1315,19 +1376,50 @@ class Metric(ABC):
             synced = False
             cache = {}
             if self._to_sync and dist_sync_fn is not None:
-                if debug.sync_count_check_enabled():
-                    counts = [int(c) for c in dist_sync_fn(jnp.asarray(self._sync_count, dtype=jnp.int32))]
-                    if len(set(counts)) > 1:
-                        raise RuntimeError(
-                            f"{self.__class__.__name__}: processes disagree on the synced-compute"
-                            f" sequence number ({counts}). Some rank called a synced compute() a"
-                            " different number of times — this pairs collectives wrongly and"
-                            " eventually deadlocks."
-                        )
-                self._sync_count += 1
-                cache = self._current_state()
-                self._sync_dist(dist_sync_fn)
-                synced = True
+                if self.sync_lag and self._in_forward:
+                    # the DEFERRED per-step plane (sync_lag=1): snapshot this
+                    # step's delta into the double buffer, dispatch its gather
+                    # on the background host plane, and read the PREVIOUS
+                    # step's merged view — which finished gathering while this
+                    # step's update ran. The debug sync-count probe is skipped
+                    # here: its own eager gather would jump the entry-order
+                    # queue the background executor preserves.
+                    from metrics_tpu.parallel.deferred import deferred_host_gather
+
+                    prev = self._deferred_handle
+                    self._deferred_handle = deferred_host_gather(
+                        self._current_state(), self._reductions,
+                        gather_fn=dist_sync_fn, watermark=self._epoch_watermark,
+                    )
+                    self._sync_count += 1
+                    if prev is not None:
+                        cache = self._current_state()
+                        local = cache if self.check_finite == "quarantine" else None
+                        self._set_state(prev.result())
+                        self._guard_state_integrity("sync", local)
+                        self._note_state_bytes()
+                        synced = True
+                    # warm-up (no previous view): the state stays the local
+                    # delta — step 0's value is the documented unsynced read
+                else:
+                    if self._deferred_handle is not None:
+                        # entry order: a synchronous sync must not overtake the
+                        # in-flight deferred gather on any rank
+                        self._deferred_handle.result()
+                        self._deferred_handle = None
+                    if debug.sync_count_check_enabled():
+                        counts = [int(c) for c in dist_sync_fn(jnp.asarray(self._sync_count, dtype=jnp.int32))]
+                        if len(set(counts)) > 1:
+                            raise RuntimeError(
+                                f"{self.__class__.__name__}: processes disagree on the synced-compute"
+                                f" sequence number ({counts}). Some rank called a synced compute() a"
+                                " different number of times — this pairs collectives wrongly and"
+                                " eventually deadlocks."
+                            )
+                    self._sync_count += 1
+                    cache = self._current_state()
+                    self._sync_dist(dist_sync_fn)
+                    synced = True
 
             self._computed = compute(*args, **kwargs)
             if synced:
@@ -1357,6 +1449,9 @@ class Metric(ABC):
         self._count_bound = 0
         self._overflow_warned = False
         self._epoch_watermark = 0
+        # an in-flight deferred gather still completes on the background
+        # plane (entry order), but a reset metric never reads its view
+        self._deferred_handle = None
         state = self.init_state()
         self._set_state(state)
         if self._state_dtype is not None:
@@ -1368,8 +1463,10 @@ class Metric(ABC):
         return deepcopy(self)
 
     def __getstate__(self) -> dict:
+        # _deferred_handle is a live future (threads, device buffers): it
+        # never travels — a copy/restore starts with no in-flight sync
         skip = ("update", "compute", "_update_impl", "_compute_impl", "_jitted_step", "_jitted_step_fc",
-                "_jitted_scan")
+                "_jitted_scan", "_deferred_handle")
         return {k: v for k, v in self.__dict__.items() if k not in skip}
 
     def __setstate__(self, state: dict) -> None:
@@ -1382,6 +1479,8 @@ class Metric(ABC):
         self.__dict__.setdefault("_overflow_warned", False)
         self.__dict__.setdefault("_epoch_watermark", 0)
         self.__dict__.setdefault("check_finite", None)
+        self.__dict__.setdefault("sync_lag", 0)
+        self.__dict__["_deferred_handle"] = None
         self._update_impl = self.__class__.update.__get__(self)
         self._compute_impl = self.__class__.compute.__get__(self)
         self.update = self._wrap_update(self._update_impl)
@@ -1395,7 +1494,7 @@ class Metric(ABC):
         new = cls.__new__(cls)
         memo[id(self)] = new
         skip = ("update", "compute", "_update_impl", "_compute_impl", "_jitted_step", "_jitted_step_fc",
-                "_jitted_scan")
+                "_jitted_scan", "_deferred_handle")
         for k, v in self.__dict__.items():
             if k in skip:
                 continue
@@ -1416,6 +1515,7 @@ class Metric(ABC):
         new._jitted_step = None
         new._jitted_step_fc = None
         new._jitted_scan = None
+        new.__dict__["_deferred_handle"] = None
         return new
 
     # ------------------------------------------------------- device / shards

@@ -74,6 +74,15 @@ What gets counted, and on which plane:
   ``Keyed(<inner>)`` label so per-slab footprints stay attributable.
   Present in every snapshot; ``export.summarize()`` surfaces the same
   number as a per-span column.
+- **deferred**: the deferred sync plane's dispatch/fence/completion counts
+  (``parallel/deferred.py``): ``dispatched`` syncs handed a ``SyncHandle``
+  (device program dispatched unfenced, or host gather queued on the
+  background executor), ``fenced`` handles resolved by ``result()``, and
+  ``completed`` syncs whose work actually finished (the background task
+  returned / the device fence cleared). ``dispatched - completed`` at
+  snapshot time is the in-flight depth; a ``dispatched`` that never
+  ``fenced`` is a leaked handle (the collective still ran — entry order —
+  but nobody read the merged view). Present in every snapshot.
 - **slab_slots**: per-slab slot GAUGES for the keyed multi-tenant wrappers
   (``wrappers/keyed.py``): ``{label: {"slots": K, "occupied": n,
   "evictions": e}}``. Occupancy says how much of the provisioned K is
@@ -94,12 +103,14 @@ from typing import Any, Dict, Optional
 __all__ = [
     "COUNTERS",
     "CollectiveCounters",
+    "DEFERRED_KINDS",
     "FAULT_KINDS",
     "enable",
     "disable",
     "is_enabled",
     "record_cache",
     "record_collective",
+    "record_deferred",
     "record_fault",
     "record_gather_skip",
     "record_service_health",
@@ -139,6 +150,15 @@ FAULT_KINDS = (
     "quarantined_updates",  # batch deltas discarded by check_finite='quarantine'
 )
 
+# deferred-plane lifecycle counters (parallel/deferred.py); every snapshot
+# carries all three so consumers — bench.py --check-async, the async_counters
+# trace block — can bind on them unconditionally.
+DEFERRED_KINDS = (
+    "dispatched",  # SyncHandles issued (unfenced device dispatch / queued host gather)
+    "fenced",  # handles resolved by result()
+    "completed",  # syncs whose work finished (background task returned / fence cleared)
+)
+
 
 class CollectiveCounters:
     """Process-wide counters; ``enabled`` is the hot-path gate."""
@@ -157,6 +177,7 @@ class CollectiveCounters:
         "launch_cache_hits",
         "launch_cache_misses",
         "faults",
+        "deferred",
         "gather_skips",
         "slab_dropped_samples",
         "state_bytes",
@@ -183,6 +204,7 @@ class CollectiveCounters:
         self.launch_cache_hits = 0
         self.launch_cache_misses = 0
         self.faults: Dict[str, int] = {k: 0 for k in FAULT_KINDS}
+        self.deferred: Dict[str, int] = {k: 0 for k in DEFERRED_KINDS}
         self.gather_skips = 0
         self.slab_dropped_samples = 0  # out-of-range slot ids dropped by slab scatters
         self.state_bytes: Dict[str, int] = {}  # metric class name -> latest bytes
@@ -232,6 +254,13 @@ class CollectiveCounters:
             raise ValueError(f"unknown fault kind {kind!r}; expected one of {FAULT_KINDS}")
         with self._lock:
             self.faults[kind] += int(n)
+
+    def record_deferred(self, kind: str, n: int = 1) -> None:
+        """``kind`` must be in :data:`DEFERRED_KINDS` (fail loudly on typos)."""
+        if kind not in self.deferred:
+            raise ValueError(f"unknown deferred kind {kind!r}; expected one of {DEFERRED_KINDS}")
+        with self._lock:
+            self.deferred[kind] += int(n)
 
     def record_gather_skip(self) -> None:
         with self._lock:
@@ -293,6 +322,7 @@ class CollectiveCounters:
                 "bytes_by_crossing": dict(sorted(self.bytes_by_crossing.items())),
                 "states_synced": self.states_synced,
                 "faults": dict(self.faults),
+                "deferred": dict(self.deferred),
                 "gather_skips": self.gather_skips,
                 "slab_dropped_samples": self.slab_dropped_samples,
                 "state_bytes": dict(sorted(self.state_bytes.items())),
@@ -340,6 +370,15 @@ def record_fault(kind: str, n: int = 1) -> None:
 
 def record_gather_skip() -> None:
     COUNTERS.record_gather_skip()
+
+
+# Deferred-plane lifecycle is ordinary (enabled-gated) accounting: unlike the
+# fault counters it is high-volume on a deferring hot loop (one dispatch +
+# one fence per step), and losing it while observability is off loses
+# telemetry, not evidence.
+def record_deferred(kind: str, n: int = 1) -> None:
+    if COUNTERS.enabled:
+        COUNTERS.record_deferred(kind, n)
 
 
 # Dropped-sample evidence records UNCONDITIONALLY, same argument as the

@@ -55,6 +55,11 @@ MS_KEYS: Tuple[str, ...] = (
     "sketch_sync_ms",
     "keyed_sync_ms",
     "service_sync_ms",
+    # the deferred-sync A/B: both variants gate so a regression in either
+    # the overlapped path or its fenced twin is caught (their ORDERING —
+    # async strictly below fenced — is bench.py --check-async's pin)
+    "async_sync8_ms",
+    "fenced_sync8_ms",
 )
 
 # staged-collective keys gated exactly (no growth) vs the latest prior round
@@ -100,6 +105,15 @@ COUNT_KEYS: Tuple[str, ...] = (
     "service_gather_calls",
     "service_states_synced",
     "service_unwindowed_collective_calls",
+    # the deferred sync plane: the async dispatch must stage the identical
+    # program as the fenced synchronous twin (psum-only on the sync8
+    # collection); any growth is a regression of the only-the-fence-moves
+    # contract
+    "async_collective_calls",
+    "async_sync_bytes",
+    "async_gather_calls",
+    "async_states_synced",
+    "async_fenced_collective_calls",
 )
 
 # fault counters: bound at exactly zero whenever the current line carries

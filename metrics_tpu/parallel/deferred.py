@@ -1,0 +1,406 @@
+"""Deferred sync plane: double-buffered state snapshots, future-returning
+collectives, and a background host plane.
+
+Every sync plane in this library was, until now, ON the critical path: the
+in-jit collectives (``sync_state``/``coalesced_sync_state``) ride the step
+program and the devtime fencing waits on them, and the packed
+``process_allgather`` host plane blocks the calling thread until the DCN
+rendezvous completes. ``BENCH_r05`` makes the cost concrete: the 8-device
+``dist_sync_on_step`` collection step is ~4.67 ms of which sync dominates,
+against a 0.02 ms fused update. This module moves sync OFF the critical path
+the way training stacks overlap gradient all-reduce with backprop:
+
+- **Double-buffered snapshots.** A deferred sync SNAPSHOTS the state pytree
+  at dispatch time. jax arrays are immutable, so holding the refs IS the
+  double buffer: buffer A (the snapshot) is what the collective moves, while
+  the live metric keeps accumulating into buffer B — no copy, no torn reads.
+- **Future-returning collectives.** :func:`deferred_sync_state` dispatches
+  the compiled sync program (the IDENTICAL ``coalesced_sync_state`` staging
+  as the synchronous plane — same collective count, same kinds; the
+  ``bench.py --check-async`` gate pins it) WITHOUT fencing and returns a
+  :class:`SyncHandle`. jax dispatch is asynchronous, so XLA overlaps the
+  collective's device time with whatever the host dispatches next —
+  typically the next step's updates. ``SyncHandle.result()`` fences and
+  returns the merged state.
+- **Background host plane.** :func:`deferred_host_gather` runs the packed
+  ``process_allgather`` plane on a dedicated SINGLE-WORKER executor under
+  the caller's :class:`~metrics_tpu.parallel.sync.SyncGuard` — deadline /
+  retry / degrade semantics are exactly the synchronous plane's (the task
+  calls :func:`~metrics_tpu.parallel.sync.host_gather` verbatim, chaos
+  injection included). One worker means deferred gathers execute in
+  SUBMISSION order: a deferring rank enters its collectives in exactly the
+  order the synchronous plane would have, so entry-order — and therefore
+  the peers' rendezvous pairing — is preserved and a deferring rank can
+  never deadlock the others. A degrade-policy exhaustion latches to
+  local-only state inside the background task (the step never stalls);
+  a raise-policy exhaustion surfaces as ``SyncTimeoutError`` from
+  ``result()``.
+- **Epoch watermark.** Every handle carries the dispatching metric's epoch
+  watermark, so a consumer of the lagged view knows exactly which step's
+  merge it is reading (``dist_sync_on_step`` consumers with ``sync_lag=1``
+  read the previous step's view — see ``core.metric.Metric``).
+
+Observability: dispatch / fence / completion are span-stamped
+(``deferred.dispatch`` / ``deferred.fence`` / ``deferred.complete``) and
+counted (the ``deferred`` gauge block in every counters snapshot), so the
+overlap is a measured number — the fence span's wait is what the overlap
+saved, and ``bench.py --check-async`` reports it next to the synchronous
+plane's blocking wait.
+"""
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+from metrics_tpu.observability.counters import record_deferred
+from metrics_tpu.observability.trace import TRACE, span as _span
+from metrics_tpu.parallel.placement import MeshHierarchy
+from metrics_tpu.parallel.sync import (
+    ReduceFx,
+    SyncGuard,
+    coalesced_sync_state,
+    current_sync_guard,
+    host_gather,
+)
+from metrics_tpu.utils.exceptions import TracingUnsupportedError
+
+__all__ = [
+    "DeferredSyncPlane",
+    "SyncHandle",
+    "deferred_host_gather",
+    "deferred_sync_state",
+    "drain_host_plane",
+    "host_plane_submit",
+]
+
+
+# ------------------------------------------------------ background host plane
+class _HostPlane:
+    """The executor the deferred host plane runs on.
+
+    SINGLE worker by construction — not an optimization knob: collectives
+    pair across ranks by entry order, so deferred gathers must execute in
+    submission order or a deferring rank would mismatch its peers'
+    rendezvous. The worker is created lazily (importing this module costs
+    no thread) and marked daemon via the pool's default so an in-flight
+    deadline-abandoned gather cannot block process exit.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._pool: Optional[ThreadPoolExecutor] = None
+
+    def submit(self, fn: Callable, *args: Any):
+        with self._lock:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="mtpu-deferred-host"
+                )
+            return self._pool.submit(fn, *args)
+
+    def drain(self) -> None:
+        """Wait for every queued task (a barrier, not a shutdown)."""
+        with self._lock:
+            pool = self._pool
+        if pool is None:
+            return
+        pool.submit(lambda: None).result()
+
+
+_HOST_PLANE = _HostPlane()
+
+
+def host_plane_submit(fn: Callable, *args: Any):
+    """Submit work to the deferred host plane (single worker, FIFO).
+
+    The serving runtime routes its deferred publish stage through this so
+    publish-time guarded syncs share the entry-order domain with every other
+    deferred gather in the process.
+    """
+    return _HOST_PLANE.submit(fn, *args)
+
+
+def drain_host_plane() -> None:
+    """Barrier: block until every task submitted so far has finished."""
+    _HOST_PLANE.drain()
+
+
+# ---------------------------------------------------------------- the future
+class SyncHandle:
+    """Future for a deferred sync: fence/join on demand, read once, cached.
+
+    Two backings share the interface:
+
+    - **device** (:func:`deferred_sync_state`): the staged collective is
+      already dispatched; ``result()`` is a ``block_until_ready`` fence over
+      the output arrays (``timeout`` is ignored — a dispatched XLA program
+      cannot be abandoned mid-flight).
+    - **host** (:func:`deferred_host_gather`): the packed gather runs on the
+      background executor; ``result(timeout)`` joins the task. Guard-policy
+      ``raise`` exhaustion re-raises here (``SyncTimeoutError``); policy
+      ``degrade`` returns the local-only snapshot — the handle resolves
+      either way, the step never stalls.
+
+    ``result()`` is idempotent: the first call fences and caches, later
+    calls return the cached state (or re-raise the cached error).
+    ``watermark`` is the dispatching metric's epoch watermark at snapshot
+    time — which step's merged view this handle resolves to.
+    """
+
+    __slots__ = ("_kind", "_payload", "_finish", "_resolved", "_result", "_error",
+                 "_lock", "watermark", "label")
+
+    def __init__(
+        self,
+        kind: str,
+        payload: Any,
+        finish: Optional[Callable[[Any], Any]] = None,
+        watermark: Optional[int] = None,
+        label: str = "sync",
+    ) -> None:
+        if kind not in ("device", "host", "ready"):
+            raise ValueError(f"unknown SyncHandle kind {kind!r}")
+        self._kind = kind
+        self._payload = payload
+        self._finish = finish
+        self._resolved = kind == "ready"
+        self._result = payload if kind == "ready" else None
+        self._error: Optional[BaseException] = None
+        self._lock = threading.Lock()
+        self.watermark = watermark
+        self.label = label
+
+    def done(self) -> bool:
+        """Whether ``result()`` would return without waiting."""
+        if self._resolved:
+            return True
+        if self._kind == "host":
+            return self._payload.done()
+        try:  # jax.Array.is_ready on current jax; conservative False without it
+            return all(
+                leaf.is_ready()
+                for leaf in jax.tree_util.tree_leaves(self._payload)
+                if hasattr(leaf, "is_ready")
+            )
+        except Exception:  # noqa: BLE001 - readiness is advisory, never fatal
+            return False
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        """Fence/join and return the synced state (cached after the first call)."""
+        with self._lock:
+            if self._resolved:
+                if self._error is not None:
+                    raise self._error
+                return self._result
+            attrs = {"plane": self._kind, "label": self.label} if TRACE.enabled else None
+            try:
+                with _span("deferred.fence", attrs):
+                    if self._kind == "host":
+                        out = self._payload.result(timeout)
+                    else:
+                        jax.block_until_ready(self._payload)
+                        out = self._payload
+                        record_deferred("completed")  # device completion == fence
+                if self._finish is not None:
+                    out = self._finish(out)
+            except BaseException as err:
+                self._error = err
+                self._resolved = True
+                self._payload = self._finish = None
+                record_deferred("fenced")
+                raise
+            self._result = out
+            self._resolved = True
+            self._payload = self._finish = None
+            record_deferred("fenced")
+            return out
+
+
+# --------------------------------------------------- the deferred host plane
+def deferred_host_gather(
+    state: Dict[str, Any],
+    reductions: Dict[str, ReduceFx],
+    gather_fn: Optional[Callable] = None,
+    guard: Optional[SyncGuard] = None,
+    watermark: Optional[int] = None,
+    label: str = "host_gather",
+) -> SyncHandle:
+    """Run the host sync plane in the background; returns a :class:`SyncHandle`.
+
+    Snapshots ``state`` at call time (the double buffer — the caller may keep
+    accumulating immediately) and submits ``host_gather(snapshot, ...)`` to
+    the single-worker host plane under ``guard`` (default: the process-wide
+    :func:`~metrics_tpu.parallel.sync.current_sync_guard`, CAPTURED NOW so a
+    later guard change cannot retroactively alter an in-flight sync). The
+    task is the synchronous plane verbatim — deadline/retry/degrade,
+    check_finite vetting, chaos injection at site ``host_gather``, packed
+    payloads — only the thread it blocks changes.
+    """
+    snapshot = dict(state)  # immutable leaves: holding the refs IS buffer A
+    guard = guard if guard is not None else current_sync_guard()
+
+    def task() -> Dict[str, Any]:
+        attrs = {"plane": label} if TRACE.enabled else None
+        with _span("deferred.complete", attrs):
+            out = host_gather(snapshot, reductions, gather_fn=gather_fn, guard=guard)
+        record_deferred("completed")
+        return out
+
+    attrs = {"plane": label} if TRACE.enabled else None
+    with _span("deferred.dispatch", attrs):
+        future = _HOST_PLANE.submit(task)
+    record_deferred("dispatched")
+    return SyncHandle("host", future, watermark=watermark, label=label)
+
+
+# ------------------------------------------------- the deferred in-jit plane
+# compiled sync programs keyed by (mesh, axis, state schema): a fresh handle
+# per step replays the compiled program, never retraces. Entries pin the
+# callable reductions whose id() appears in the key.
+_PROGRAM_CACHE: Dict[Any, Any] = {}
+_PROGRAM_CACHE_MAX = 64
+_PROGRAM_LOCK = threading.Lock()
+
+
+def _fx_key(fx: ReduceFx, pins: list) -> Any:
+    if fx is None or isinstance(fx, str):
+        return fx
+    pins.append(fx)  # the cache entry keeps the id alive
+    return ("fn", id(fx))
+
+
+def _axis_spec(axis_name: Any) -> tuple:
+    """The mesh axes the leading (world) dimension shards over."""
+    if isinstance(axis_name, MeshHierarchy):
+        # slice-major world order: dcn-major, ici-minor — the same convention
+        # as _hier_gather_stack, so per-device rows land on their own device
+        return (axis_name.dcn_axis, axis_name.ici_axis)
+    if isinstance(axis_name, tuple):
+        return axis_name
+    return (axis_name,)
+
+
+def _sync_program(mesh: Any, axis_name: Any, reductions: Dict[Any, ReduceFx], state: Dict[Any, Any]):
+    from jax.sharding import PartitionSpec as P
+
+    from metrics_tpu.utils.compat import shard_map
+
+    pins: list = []
+    schema = tuple(
+        (name, tuple(v.shape), str(v.dtype), _fx_key(reductions[name], pins))
+        for name, v in state.items()
+    )
+    key = (mesh, _axis_spec(axis_name), schema)
+    with _PROGRAM_LOCK:
+        hit = _PROGRAM_CACHE.get(key)
+    if hit is not None:
+        return hit[1]
+
+    in_spec = P(_axis_spec(axis_name))
+    fixed = dict(reductions)
+
+    def body(stacked: Dict[Any, Any]) -> Dict[Any, Any]:
+        # each device holds one row of the world-stacked snapshot; strip it
+        # and run the SAME bucketed staging as the synchronous plane
+        local = {name: v[0] for name, v in stacked.items()}
+        return coalesced_sync_state(local, fixed, axis_name)
+
+    # vma checking off: psum/gather outputs are replicated but the checker
+    # cannot always prove it through the bucket slicing (same as bench.py)
+    prog = jax.jit(
+        shard_map(body, mesh, in_specs=(in_spec,), out_specs=P(), check_vma=False)
+    )
+    with _PROGRAM_LOCK:
+        if len(_PROGRAM_CACHE) >= _PROGRAM_CACHE_MAX:
+            _PROGRAM_CACHE.pop(next(iter(_PROGRAM_CACHE)), None)
+        _PROGRAM_CACHE[key] = (pins, prog)
+    return prog
+
+
+class DeferredSyncPlane:
+    """A precompiled deferred in-jit sync: resolve the program ONCE, then
+    ``dispatch(state)`` per step with no per-call key building.
+
+    The hot-loop form of :func:`deferred_sync_state`: a training loop builds
+    the plane once (from a template state with the loop's schema) and pays
+    only the compiled-program dispatch plus a handle allocation per step —
+    the per-call overhead a future must not reintroduce on the path it
+    exists to shorten. ``dispatch`` states the identical collectives as the
+    synchronous plane for every call (it replays the one compiled program).
+    """
+
+    __slots__ = ("_prog", "_finish")
+
+    def __init__(
+        self,
+        reductions: Dict[Any, ReduceFx],
+        axis_name: Any,
+        mesh: Any,
+        template_state: Dict[Any, Any],
+        finish: Optional[Callable[[Any], Any]] = None,
+    ) -> None:
+        self._prog = _sync_program(mesh, axis_name, reductions, template_state)
+        self._finish = finish
+
+    def dispatch(self, state: Dict[Any, Any], watermark: Optional[int] = None) -> SyncHandle:
+        values = self._prog(state)  # async dispatch: no fence, no readback
+        record_deferred("dispatched")
+        return SyncHandle(
+            "device", values, finish=self._finish, watermark=watermark, label="sync_state"
+        )
+
+
+def deferred_sync_state(
+    state: Dict[Any, Any],
+    reductions: Dict[Any, ReduceFx],
+    axis_name: Any,
+    mesh: Any = None,
+    watermark: Optional[int] = None,
+    finish: Optional[Callable[[Any], Any]] = None,
+) -> SyncHandle:
+    """Dispatch the in-jit sync plane WITHOUT fencing; returns a handle.
+
+    ``state`` leaves carry the mesh axis as their LEADING dimension — one
+    row per device, i.e. the output of a ``shard_map(update,
+    out_specs=P(axis))`` delta program (for a :class:`MeshHierarchy` axis
+    the rows are in slice-major world order, the library's convention).
+    The compiled program strips the row and runs ``coalesced_sync_state``
+    over ``axis_name`` — the IDENTICAL staged collectives (count and kinds)
+    as the synchronous plane, because it IS the synchronous plane's staging;
+    only the fence moves. jax dispatch is asynchronous, so the collective's
+    device time overlaps whatever the host dispatches next.
+
+    ``mesh`` defaults to the first leaf's ``NamedSharding`` mesh; pass it
+    explicitly for host-built arrays. Must be called eagerly — under a
+    trace there is no host-side future to return
+    (``TracingUnsupportedError``).
+    """
+    from metrics_tpu.utils import compat
+
+    if compat.under_trace():
+        raise TracingUnsupportedError(
+            "deferred_sync_state dispatches a compiled sync program and returns a"
+            " host-side SyncHandle, which cannot exist under tracing; inside jit"
+            " use the synchronous in-trace plane (coalesced_sync_state)"
+        )
+    if not state:
+        return SyncHandle("ready", dict(state), watermark=watermark, label="sync_state")
+    if mesh is None:
+        for leaf in jax.tree_util.tree_leaves(state):
+            mesh = getattr(getattr(leaf, "sharding", None), "mesh", None)
+            if mesh is not None and getattr(mesh, "axis_names", None):
+                break
+        if mesh is None or not getattr(mesh, "axis_names", None):
+            raise ValueError(
+                "deferred_sync_state could not infer the mesh from the state's"
+                " sharding; pass mesh= explicitly"
+            )
+    prog = _sync_program(mesh, axis_name, reductions, state)
+    attrs = {"plane": "sync_state"} if TRACE.enabled else None
+    with _span("deferred.dispatch", attrs):
+        values = prog(dict(state))  # async dispatch: no fence, no readback
+    record_deferred("dispatched")
+    return SyncHandle(
+        "device", values, finish=finish, watermark=watermark, label="sync_state"
+    )

@@ -18,6 +18,16 @@ TPU-native design — two sync planes instead of one NCCL call:
    multi-host deployments — per-leaf ``multihost_utils.process_allgather``
    (the DCN analogue of the reference's Gloo path), identity on one process.
 
+3. **Deferred plane** (``metrics_tpu.parallel.deferred``): the
+   future-returning form of both planes above. ``deferred_sync_state`` /
+   ``DeferredSyncPlane`` dispatch the in-jit staging WITHOUT fencing (the
+   identical ``coalesced_sync_state`` program — only the fence moves) and
+   ``deferred_host_gather`` runs :func:`host_gather` verbatim — the active
+   :class:`SyncGuard`, the chaos hook, payload packing, everything below —
+   on a single-worker background executor, so deferred gathers keep the
+   submission order this module's collectives pair by. ``Metric.sync_state
+   (..., deferred=True)`` and ``Metric.sync_lag = 1`` are the bound forms.
+
 Both planes are TOPOLOGY-AWARE: pass a :class:`~metrics_tpu.parallel.placement.
 MeshHierarchy` (``hierarchy=``, or directly as the axis argument) and every
 staged collective splits into two stages — reductions run over the fast

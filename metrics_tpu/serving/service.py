@@ -24,6 +24,22 @@ library already has into one supervised loop:
   complete inside the deadline budget degrades to LOCAL-ONLY state and
   publishes with ``degraded=True`` — the stream never stalls on a sick
   peer (``degraded_computes`` bumps, health flips to ``degraded``).
+- **Deferred publish stage.** The guarded sync is the slow half of a
+  publish; by default (``deferred_publish=True``) it runs OFF the ingest
+  path: as the watermark closes a window the worker snapshots the metric's
+  state (the double buffer — the close-point values, exactly what the
+  synchronous stage would have read) and dispatches the guarded sync +
+  record build onto the background host plane
+  (``parallel/deferred.py``, single worker: publishes complete in window
+  order), then goes straight back to draining the queue — window publish
+  OVERLAPS ingest. ``flush``/``snapshot``/``finalize``/``stop`` drain the
+  publish pipeline, so every barrier the synchronous stage implied still
+  holds, and the published values are bit-identical
+  (``bench.py --check-service`` soaks the deferred stage).
+- **Per-window publish spans.** With tracing enabled every publish emits a
+  ``service.publish`` span stamped ``window=``, ``degraded=`` and the
+  ingress ``queue_depth`` at dispatch — the Perfetto view of the serving
+  loop's cadence.
 - **Crash-safe snapshot/restore.** Every publish refreshes
   :attr:`last_snapshot` (the metric's ``state_dict`` — slabs, watermark,
   head window, drop counters, epoch watermark — plus the service's ingest
@@ -49,12 +65,15 @@ import math
 import queue
 import threading
 import time
+from copy import deepcopy
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.observability.counters import COUNTERS as _COUNTERS, record_service_health
+from metrics_tpu.observability.trace import TRACE as _TRACE, span as _span
+from metrics_tpu.parallel.deferred import host_plane_submit
 from metrics_tpu.parallel.sync import SyncGuard, set_sync_guard
 from metrics_tpu.utils.exceptions import MetricsTPUError, PreemptionError
 from metrics_tpu.wrappers.windowed import Windowed
@@ -89,6 +108,10 @@ class MetricService:
             serving loop must publish late rather than never.
         publish_fn: optional callback receiving each publication record.
         label: gauge label (default ``MetricService(<inner>)``).
+        deferred_publish: run the guarded-sync half of every publish on the
+            background host plane (default True) so window publish overlaps
+            ingest; ``False`` restores the fully synchronous publish stage
+            (the worker blocks on each window's sync before the next batch).
 
     The worker thread starts immediately; use as a context manager or call
     :meth:`stop`. ``submit`` raises :class:`ServiceStoppedError` once the
@@ -104,6 +127,7 @@ class MetricService:
         publish_fn: Optional[Callable[[Dict[str, Any]], None]] = None,
         label: Optional[str] = None,
         poll_interval_s: float = 0.02,
+        deferred_publish: bool = True,
     ):
         if not isinstance(metric, Windowed):
             raise ValueError(
@@ -129,6 +153,13 @@ class MetricService:
         self.publish_fn = publish_fn
         self.label = label or f"MetricService({type(metric.metric).__name__})"
         self.poll_interval_s = float(poll_interval_s)
+        self.deferred_publish = bool(deferred_publish)
+        # the deferred stage's double buffer: a detached twin whose states
+        # are loaded from each publish's close-point snapshot, so the
+        # background sync never races the live metric's ingest
+        self._shadow: Optional[Windowed] = None
+        self._pub_lock = threading.RLock()  # publications / last_snapshot / health latches
+        self._pending_publishes: List[Any] = []  # futures of in-flight deferred publishes
 
         self._queue: "queue.Queue" = queue.Queue(maxsize=queue_size)
         self._seq = 0  # next auto-assigned submission seq
@@ -301,34 +332,116 @@ class MetricService:
     def _publish(self, window: int) -> None:
         """Publish one closed window: the guarded merged view + the window's
         own value, stamped ``degraded=`` when the sync fell back to
-        local-only state, then refresh the crash snapshot."""
-        before = _COUNTERS.faults["degraded_computes"]
-        old_guard = set_sync_guard(self.guard)
-        try:
-            self.metric._computed = None  # publish-time values, not a stale cache
-            merged = self.metric.compute()
-        finally:
-            set_sync_guard(old_guard)
-        degraded = _COUNTERS.faults["degraded_computes"] > before
-        value = self.metric.compute_window(window)
-        record = {
-            "window": window,
-            "window_start_s": window * self.metric.window_s,
-            "value": _host(value),
-            "merged": _host(merged),
-            "degraded": degraded,
+        local-only state, then refresh the crash snapshot.
+
+        With ``deferred_publish`` the guarded sync runs on the background
+        host plane over the close-point state snapshot (the double buffer:
+        ``state_dict`` copies the values the synchronous stage would have
+        read); the worker returns to ingest immediately and the record lands
+        — in window order, the plane is single-worker — when the background
+        sync completes.
+        """
+        self._published_through = window
+        book = self._publish_book()
+        if not self.deferred_publish:
+            self._publish_record(self.metric, window, book)
+            return
+        snap = self.metric.state_dict()
+        if self._shadow is None:
+            self._shadow = deepcopy(self.metric)
+        with self._pub_lock:
+            self._pending_publishes.append(
+                host_plane_submit(self._deferred_publish_task, snap, window, book)
+            )
+
+    def _publish_book(self) -> Dict[str, Any]:
+        """Close-point bookkeeping, captured on the worker thread so the
+        (possibly deferred) record reports the values at the window close."""
+        return {
             "watermark": self.metric.watermark,
             "dropped_samples": self.metric.dropped_samples,
             "shed_events": self.shed_events,
+            "queue_depth": self._queue.qsize(),
+            "processed": self._processed,
+            "ingest_idx": self._ingest_idx,
         }
-        self.publications.append(record)
-        self._published_through = window
-        self._last_publish_degraded = degraded
-        self._shed_since_publish = 0
-        self.last_snapshot = self._snapshot_locked()
-        if self.publish_fn is not None:
-            self.publish_fn(record)
-        self._note_health()
+
+    def _deferred_publish_task(self, snap: Dict[str, Any], window: int, book: Dict[str, Any]) -> None:
+        self._shadow.load_state_dict(snap)
+        self._publish_record(self._shadow, window, book, snap=snap)
+
+    def _publish_record(
+        self, metric: Windowed, window: int, book: Dict[str, Any],
+        snap: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """The publish body both stages share: guarded sync + record build.
+
+        Emits one ``service.publish`` span per window (when tracing) stamped
+        ``window=``, ``degraded=``, and the ingress ``queue_depth`` at the
+        window close — the per-window Perfetto view of the publish loop.
+        """
+        attrs = None
+        if _TRACE.enabled:
+            attrs = {
+                "window": window,
+                "queue_depth": book["queue_depth"],
+                "deferred": "yes" if snap is not None else "no",
+            }
+        with _span("service.publish", attrs):
+            before = _COUNTERS.faults["degraded_computes"]
+            old_guard = set_sync_guard(self.guard)
+            try:
+                metric._computed = None  # publish-time values, not a stale cache
+                merged = metric.compute()
+            finally:
+                set_sync_guard(old_guard)
+            degraded = _COUNTERS.faults["degraded_computes"] > before
+            value = metric.compute_window(window)
+            if attrs is not None:
+                attrs["degraded"] = "yes" if degraded else "no"
+            record = {
+                "window": window,
+                "window_start_s": window * self.metric.window_s,
+                "value": _host(value),
+                "merged": _host(merged),
+                "degraded": degraded,
+                "watermark": book["watermark"],
+                "dropped_samples": book["dropped_samples"],
+                "shed_events": book["shed_events"],
+            }
+            with self._pub_lock:
+                self.publications.append(record)
+                self._last_publish_degraded = degraded
+                self._shed_since_publish = 0
+                self.last_snapshot = {
+                    "metric": snap if snap is not None else self.metric.state_dict(),
+                    "processed": book["processed"],
+                    "ingest_idx": book["ingest_idx"],
+                    "published_through": window,
+                    "shed_events": book["shed_events"],
+                    "publications": len(self.publications),
+                }
+            if self.publish_fn is not None:
+                self.publish_fn(record)
+            self._note_health()
+
+    def _drain_publishes(self, timeout_s: float) -> None:
+        """Barrier over the deferred publish pipeline (no-op when empty).
+
+        A publish task that raised (guard policy ``raise`` exhausting its
+        budget) re-raises here — the barrier is where deferred failures
+        surface, exactly where the synchronous stage would have thrown.
+        """
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._pub_lock:
+                if not self._pending_publishes:
+                    return
+                fut = self._pending_publishes[0]
+            fut.result(max(deadline - time.monotonic(), 0.001))
+            with self._pub_lock:
+                if self._pending_publishes and self._pending_publishes[0] is fut:
+                    self._pending_publishes.pop(0)
 
     def _note_health(self) -> None:
         record_service_health(
@@ -338,7 +451,8 @@ class MetricService:
 
     # ---------------------------------------------------------- lifecycle
     def flush(self, timeout_s: float = 30.0) -> None:
-        """Block until every submitted batch has been processed.
+        """Block until every submitted batch has been processed AND every
+        dispatched (deferred) publish has landed.
 
         Raises the worker's error if it died (preempted/failed) with work
         still queued, and ``TimeoutError`` past ``timeout_s``.
@@ -350,13 +464,16 @@ class MetricService:
             if self._state in ("preempted", "failed"):
                 raise self._error
             if self._queue.unfinished_tasks == 0:
-                return
+                break
             if time.monotonic() > deadline:
                 raise TimeoutError(
                     f"service did not drain within {timeout_s}s"
                     f" (queue depth {self._queue.qsize()})"
                 )
             time.sleep(self.poll_interval_s / 2)
+        # the publish pipeline is part of the barrier: a flushed service has
+        # published every window its ingested events closed
+        self._drain_publishes(max(deadline - time.monotonic(), 0.001))
 
     def finalize(self, timeout_s: float = 30.0) -> Any:
         """Drain, force-publish every still-open resident window, and return
@@ -367,6 +484,7 @@ class MetricService:
             head = self.metric.head_window
             if head is not None:
                 self._publish_closed(force_through=head)
+                self._drain_publishes(timeout_s)
             # the final merged read is always FRESH (never the last
             # publish's cache) and syncs under the SERVICE guard: a sick
             # peer at end-of-stream degrades the value, never wedges the
@@ -392,6 +510,10 @@ class MetricService:
         else:
             self._stop.set()
             self._worker.join(timeout=timeout_s)
+            try:
+                self._drain_publishes(timeout_s)
+            except BaseException:  # noqa: BLE001 - surfaced by flush/snapshot on live paths
+                pass
 
     def __enter__(self) -> "MetricService":
         return self
@@ -407,6 +529,10 @@ class MetricService:
         Pauses processing for the copy; also refreshed automatically at
         every publish (:attr:`last_snapshot`)."""
         with self._proc_lock:
+            # in-flight deferred publishes are part of the state being
+            # checkpointed: land them first so the publication list and
+            # published_through are consistent with the metric snapshot
+            self._drain_publishes(30.0)
             snap = self._snapshot_locked()
         self.last_snapshot = snap
         return snap
@@ -430,6 +556,7 @@ class MetricService:
         watermark makes already-folded steps no-ops: the batch in flight at
         the kill cannot double-count.
         """
+        self._drain_publishes(30.0)  # stale deferred publishes land first
         with self._proc_lock:
             # stale queued items from a killed run are part of the lost
             # in-flight window — the caller replays them by seq

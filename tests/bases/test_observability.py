@@ -246,3 +246,95 @@ def test_counters_snapshot_reset():
     obs.COUNTERS.record_collective("psum", jnp.zeros((2,)))
     assert obs.counters_snapshot(reset_after=True)["collective_calls"] == 1
     assert obs.counters_snapshot()["collective_calls"] == 0
+
+
+# ---------------------------------------------- thread-safety under the
+# background host plane: counters recorded from executor threads (the
+# deferred sync plane, the service's deferred publish stage) must neither
+# race nor drop increments, and span buffers must stay per-thread coherent.
+_STRESS_THREADS = 8
+_STRESS_ITERS = 200
+
+
+def test_counters_and_spans_are_exact_under_8_thread_stress():
+    obs.enable()
+    obs.reset()
+    obs_trace.clear()
+    barrier = __import__("threading").Barrier(_STRESS_THREADS)
+    errors = []
+
+    def worker(tid):
+        try:
+            barrier.wait(timeout=10)
+            for i in range(_STRESS_ITERS):
+                obs_counters.record_collective("psum", np.zeros((4,), np.float32))
+                obs_counters.record_fault("sync_retries")
+                obs_counters.record_deferred("dispatched")
+                obs_counters.record_deferred("completed")
+                obs_counters.record_state_bytes(f"Stress{tid}", i)
+                obs_counters.record_states_synced(1)
+                with obs_trace.span("stress.phase", {"tid": tid}):
+                    pass
+        except BaseException as err:  # noqa: BLE001 - surfaced on the main thread
+            errors.append(err)
+
+    threads = [
+        __import__("threading").Thread(target=worker, args=(t,), daemon=True)
+        for t in range(_STRESS_THREADS)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive(), "stress worker wedged"
+    assert not errors, errors
+    total = _STRESS_THREADS * _STRESS_ITERS
+    snap = obs.counters_snapshot()
+    # EXACT totals: a single dropped or double-counted increment fails
+    assert snap["calls_by_kind"]["psum"] == total
+    assert snap["sync_bytes"] == total * 16
+    assert snap["faults"]["sync_retries"] == total
+    assert snap["deferred"]["dispatched"] == total
+    assert snap["deferred"]["completed"] == total
+    assert snap["states_synced"] == total
+    # gauges: one entry per thread, last write wins with the final value
+    assert all(snap["state_bytes"][f"Stress{t}"] == _STRESS_ITERS - 1 for t in range(_STRESS_THREADS))
+    # spans: every thread's buffer merged, none torn
+    recs = [r for r in obs.records() if r.name == "stress.phase"]
+    assert len(recs) == total
+    assert {r.attrs["tid"] for r in recs} == set(range(_STRESS_THREADS))
+    obs.disable()
+
+
+def test_snapshot_is_consistent_while_writers_run():
+    """Concurrent ``snapshot()`` during mutation must never throw (dict-size-
+    changed races) and every observed fault total must be monotonic."""
+    obs.enable()
+    obs.reset()
+    stop = __import__("threading").Event()
+    errors = []
+
+    def writer():
+        try:
+            while not stop.is_set():
+                obs_counters.record_collective("all_gather", np.zeros((2,), np.int32))
+                obs_counters.record_fault("sync_retries")
+                obs_counters.record_deferred("fenced")
+        except BaseException as err:  # noqa: BLE001
+            errors.append(err)
+
+    threads = [__import__("threading").Thread(target=writer, daemon=True) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        last = -1
+        for _ in range(200):
+            snap = obs.counters_snapshot()
+            assert snap["faults"]["sync_retries"] >= last
+            last = snap["faults"]["sync_retries"]
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+    assert not errors, errors
+    obs.disable()

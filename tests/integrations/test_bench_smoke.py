@@ -103,6 +103,24 @@ def _assert_headline_schema(out):
     assert out["service_gather_calls"] == 0  # psum-only: the window-slab contract
     assert out["service_sync_bytes"] == 1056  # (4*2*16 + 4) * 4 bytes * 2 stages
 
+    # the deferred-sync A/B rides the same line: the async plane dispatches
+    # the IDENTICAL staged program as its fenced synchronous twin (psum-only,
+    # count pinned equal) — only the fence moves; the ordering of the two ms
+    # numbers is --check-async's pin, not the smoke schema's (2 timed steps
+    # are noise)
+    for key in ("async_sync8_ms", "fenced_sync8_ms"):
+        assert isinstance(out[key], (int, float)) and out[key] > 0, key
+    assert out["async_states_synced"] == 6  # the grouped sync8 state plane
+    assert out["async_collective_calls"] == 1  # one bucketed psum
+    assert out["async_collective_calls"] == out["async_fenced_collective_calls"]
+    assert out["async_sync_bytes"] == 520  # the grouped sum bucket
+    assert out["async_gather_calls"] == 0  # psum-only: same program, deferred fence
+
+    # the traffic-generator scenario: sustained batches/sec through a real
+    # MetricService ingest loop (deferred window publishes included)
+    assert isinstance(out["service_ingest_steps_per_s"], (int, float))
+    assert out["service_ingest_steps_per_s"] > 0
+
     # fault counters ride the default line and are ZERO on a clean bench run
     # (--check-trajectory pins them at zero on every new BENCH_r* round);
     # slab_dropped_samples joins them — in-window bench traffic never drops
@@ -126,13 +144,14 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     out = _run_smoke(("--trace", str(trace_file)))
     _assert_headline_schema(out)
 
-    # schema version of the --trace payload: v6 added the windowed serving
-    # A/B (window-count-independent staged-collective keys +
-    # slab_dropped_samples on the default line, full service counters
-    # here); v5 added the keyed slab A/B; v4 the sketch A/B; v3 moved the
-    # collective counts to the default line and added the hierarchical A/B
-    # + per-crossing counters; bump this pin with the schema
-    assert out["trace_schema"] == 6
+    # schema version of the --trace payload: v7 added the deferred-sync A/B
+    # (async_* staged-count keys + the fenced twin +
+    # service_ingest_steps_per_s on the default line, full async counters
+    # here incl. the deferred dispatch/fence/completion block); v6 added the
+    # windowed serving A/B; v5 the keyed slab A/B; v4 the sketch A/B; v3
+    # moved the collective counts to the default line and added the
+    # hierarchical A/B + per-crossing counters; bump this pin with the schema
+    assert out["trace_schema"] == 7
     # the sketch program's full snapshot: psum-only, no gather kinds staged
     sketch_kinds = out["sketch_counters"]["calls_by_kind"]
     assert sketch_kinds.get("psum", 0) == 2
@@ -150,6 +169,15 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     for kind in ("all_gather", "coalesced_gather", "process_allgather"):
         assert service_kinds.get(kind, 0) == 0, kind
     assert out["service_counters"]["bytes_by_crossing"]["dcn"] == out["service_sync_bytes"] // 2
+    # the deferred program: the identical psum-only shape as the fenced twin,
+    # and exactly one dispatch/fence/completion from the compiling first step
+    async_kinds = out["async_counters"]["calls_by_kind"]
+    assert async_kinds.get("psum", 0) == 1
+    for kind in ("all_gather", "coalesced_gather", "process_allgather"):
+        assert async_kinds.get(kind, 0) == 0, kind
+    assert out["async_counters"]["deferred"] == {
+        "dispatched": 1, "fenced": 1, "completed": 1,
+    }
 
     # counter totals must agree with the states_synced the bench reports
     assert out["counters"]["states_synced"] == out["states_synced"]
@@ -314,6 +342,39 @@ def test_bench_check_faults_gate():
     assert out["degraded"]["faults"]["degraded_computes"] >= 1
     assert out["degraded"]["degraded_spans"] >= 1
     assert out["degraded"]["elapsed_s"] < out["degraded"]["budget_s"]
+
+
+def test_bench_check_async_gate():
+    """``bench.py --check-async`` is the deferred-sync gate: the deferred
+    plane must stage the IDENTICAL collective count and kinds as the
+    synchronous plane (zero new kinds — it dispatches the same
+    ``coalesced_sync_state`` program), ``sync_lag=1`` forward values must be
+    bit-exact the synchronous plane's previous-step values with an exact
+    epoch compute, and the async step ms must come in strictly below the
+    fenced synchronous step ms on the sync8 scenario (the overlap the
+    deferred dispatch exists to buy)."""
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, _BENCH, "--check-async"],
+        capture_output=True, text=True, timeout=280, env=env,
+        cwd=os.path.dirname(_BENCH),
+    )
+    assert proc.returncode == 0, f"--check-async failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["ok"] is True and out["failures"] == []
+    # parity: same collective kinds and counts, same payload bytes
+    assert out["parity"]["async_calls_by_kind"] == out["parity"]["sync_calls_by_kind"]
+    assert out["parity"]["async_bytes"] == out["parity"]["sync_bytes"]
+    # the compiling first step dispatched and fenced exactly one handle
+    assert out["parity"]["async_deferred"]["dispatched"] == out["parity"]["async_deferred"]["fenced"]
+    # lag: the reported per-step series IS the synchronous series shifted by 1
+    assert out["lag"]["lag_vals"][1:] == out["lag"]["sync_vals"][:-1]
+    # overlap: the sync_lag=1 forward loop beats the synchronous plane under
+    # the simulated-DCN gather, and on the device plane the deferred fence
+    # waits less host time than the synchronous block (the hidden wait)
+    assert out["overlap"]["async_step_ms"] < out["overlap"]["sync_step_ms"]
+    assert out["overlap"]["async_fence_wait_ms"] < out["overlap"]["fenced_block_ms"]
 
 
 def test_bench_check_service_gate():

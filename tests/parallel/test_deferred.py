@@ -1,0 +1,475 @@
+"""Deferred sync plane: double-buffered handles, background host plane,
+sync_lag reads, and chaos through the executor.
+
+The deferred plane's contract has four legs, each pinned here:
+
+1. **Same values, same program.** A deferred sync resolves to bit-exactly
+   what the synchronous plane returns, staging the IDENTICAL collectives
+   (count and kinds) — only the fence moves.
+2. **Entry order.** Deferred gathers execute in submission order on the
+   single-worker host plane, so a deferring rank can never mismatch its
+   peers' rendezvous pairing.
+3. **Lagged reads.** ``sync_lag=1`` forwards return the synchronous plane's
+   previous-step values (step 0 reads the documented local warm-up view);
+   the accumulator and the epoch compute never lag.
+4. **Failure modes.** Chaos through the background executor behaves exactly
+   like the synchronous guard: transient faults retry to a bit-exact
+   result, a degrade-policy exhaustion latches to local-only state WITHOUT
+   stalling the step, a raise-policy exhaustion surfaces as
+   ``SyncTimeoutError`` from ``result()`` — and snapshot/restore with an
+   in-flight handle is safe.
+"""
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu import Accuracy, MetricCollection, observability as obs
+from metrics_tpu.observability import counters as obs_counters
+from metrics_tpu.observability import trace as obs_trace
+from metrics_tpu.parallel import faults
+from metrics_tpu.parallel.deferred import (
+    DeferredSyncPlane,
+    SyncHandle,
+    deferred_host_gather,
+    deferred_sync_state,
+)
+from metrics_tpu.parallel.placement import MeshHierarchy
+from metrics_tpu.parallel.sync import (
+    SyncGuard,
+    coalesced_sync_state,
+    gather_all_arrays,
+)
+from metrics_tpu.utils.compat import shard_map
+from metrics_tpu.utils.exceptions import SyncTimeoutError, TracingUnsupportedError
+
+_TIMEOUT_S = 30.0
+
+
+@pytest.fixture(autouse=True)
+def _drain_background_plane():
+    """Every test leaves the background host plane EMPTY: an unfenced
+    handle's task completing during a later test would leak its fault
+    counters (recorded unconditionally) into that test's assertions."""
+    from metrics_tpu.parallel.deferred import drain_host_plane
+
+    yield
+    drain_host_plane()
+
+
+def _within(fn, timeout_s: float = _TIMEOUT_S):
+    """Enforced deadline: a deferred-plane scenario that exceeds it has
+    stalled the step — the exact failure the plane exists to prevent."""
+    box = {}
+    done = threading.Event()
+
+    def target():
+        try:
+            box["value"] = fn()
+        except BaseException as err:  # noqa: BLE001 - re-raised on the test thread
+            box["error"] = err
+        finally:
+            done.set()
+
+    threading.Thread(target=target, daemon=True).start()
+    assert done.wait(timeout_s), f"scenario did not finish within {timeout_s}s (stalled)"
+    if "error" in box:
+        raise box["error"]
+    return box.get("value")
+
+
+def _batches(n, rows=32, seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        (
+            jnp.asarray(rng.rand(rows).astype(np.float32)),
+            jnp.asarray((rng.rand(rows) > 0.5).astype(np.int32)),
+        )
+        for _ in range(n)
+    ]
+
+
+# --------------------------------------------------------- host-plane handles
+def test_deferred_host_gather_matches_synchronous():
+    m = Accuracy()
+    m.update(*_batches(1)[0])
+    state = m._current_state()
+    handle = deferred_host_gather(state, m._reductions, gather_fn=gather_all_arrays)
+    out = _within(handle.result)
+    # single process: the gathered-and-reduced state IS the local state
+    for name, value in state.items():
+        assert np.array_equal(np.asarray(out[name]), np.asarray(value)), name
+    assert handle.done()
+
+
+def test_sync_handle_result_is_idempotent_and_double_buffered():
+    m = Accuracy()
+    m.update(*_batches(1)[0])
+    snapshot = m._current_state()
+    handle = deferred_host_gather(snapshot, m._reductions, gather_fn=gather_all_arrays)
+    # the live metric keeps accumulating into buffer B while A is in flight
+    m.update(*_batches(1, seed=7)[0])
+    first = _within(handle.result)
+    second = handle.result()
+    assert first is second  # cached, not re-gathered
+    # the handle resolved the SNAPSHOT, not the advanced live state
+    assert np.array_equal(np.asarray(first["total"]), np.asarray(snapshot["total"]))
+    assert int(m.total) == 2 * int(first["total"])
+
+
+def test_deferred_gathers_execute_in_submission_order():
+    order = []
+
+    def slow_gather(value):
+        order.append("a")
+        time.sleep(0.15)
+        return [value]
+
+    def fast_gather(value):
+        order.append("b")
+        return [value]
+
+    m = Accuracy()
+    m.update(*_batches(1)[0])
+    state = m._current_state()
+    h_slow = deferred_host_gather(state, m._reductions, gather_fn=slow_gather)
+    h_fast = deferred_host_gather(state, m._reductions, gather_fn=fast_gather)
+    # resolving the SECOND handle first must still wait behind the first:
+    # the single-worker plane preserves collective entry order
+    _within(h_fast.result)
+    assert h_slow.done()
+    _within(h_slow.result)
+    # per-leaf calls (custom fns are not packable): 2 leaves each, a's first
+    assert order == ["a", "a", "b", "b"]
+
+
+def test_deferred_handle_carries_watermark():
+    m = Accuracy()
+    m.update(*_batches(1)[0])
+    handle = deferred_host_gather(
+        m._current_state(), m._reductions, gather_fn=gather_all_arrays,
+        watermark=m.epoch_watermark,
+    )
+    assert handle.watermark == 1
+    _within(handle.result)
+
+
+# ------------------------------------------------------------- sync_lag reads
+def test_sync_lag_forward_reads_previous_step():
+    batches = _batches(5, seed=3)
+    sync_m = Accuracy(dist_sync_on_step=True, dist_sync_fn=gather_all_arrays)
+    lag_m = Accuracy(dist_sync_on_step=True, dist_sync_fn=gather_all_arrays)
+    lag_m.sync_lag = 1
+    sync_vals = [np.asarray(sync_m(*b)) for b in batches]
+    lag_vals = [np.asarray(lag_m(*b)) for b in batches]
+    for i in range(1, len(batches)):
+        assert np.array_equal(lag_vals[i], sync_vals[i - 1]), i
+    # warm-up: single-process local delta IS the synced delta
+    assert np.array_equal(lag_vals[0], sync_vals[0])
+
+
+def test_sync_lag_epoch_compute_drains_and_matches():
+    batches = _batches(4, seed=5)
+    sync_m = Accuracy(dist_sync_on_step=True, dist_sync_fn=gather_all_arrays)
+    lag_m = Accuracy(dist_sync_on_step=True, dist_sync_fn=gather_all_arrays)
+    lag_m.sync_lag = 1
+    for b in batches:
+        sync_m(*b)
+        lag_m(*b)
+    assert lag_m._deferred_handle is not None  # the last step's gather in flight
+    # the accumulated state never lags: epoch compute is exact, and the
+    # synchronous epoch sync drained the in-flight handle first
+    assert np.array_equal(np.asarray(_within(lag_m.compute)), np.asarray(sync_m.compute()))
+    assert lag_m._deferred_handle is None
+
+
+def test_sync_lag_snapshot_restore_with_inflight_handle():
+    batches = _batches(3, seed=9)
+    m = Accuracy(dist_sync_on_step=True, dist_sync_fn=gather_all_arrays)
+    m.sync_lag = 1
+    m.persistent(True)
+    for b in batches:
+        m(*b)
+    handle = m._deferred_handle
+    assert handle is not None
+    snap = m.state_dict()  # checkpoint with the gather still in flight
+    fresh = Accuracy(dist_sync_on_step=True, dist_sync_fn=gather_all_arrays)
+    fresh.sync_lag = 1
+    fresh.load_state_dict(snap)
+    assert fresh._deferred_handle is None  # handles never travel
+    assert fresh.epoch_watermark == m.epoch_watermark
+    assert np.array_equal(np.asarray(_within(fresh.compute)), np.asarray(_within(m.compute)))
+    _within(handle.result)  # the in-flight gather still completes (entry order)
+
+
+def test_sync_lag_reset_and_clone_drop_handles():
+    m = Accuracy(dist_sync_on_step=True, dist_sync_fn=gather_all_arrays)
+    m.sync_lag = 1
+    m(*_batches(1)[0])
+    assert m._deferred_handle is not None
+    twin = m.clone()
+    assert twin._deferred_handle is None  # live futures never deepcopy
+    m.reset()
+    assert m._deferred_handle is None
+
+
+def test_sync_lag_validation():
+    from metrics_tpu import Metric
+
+    class _Toy(Metric):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.add_state("n", default=np.zeros(()), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.n = self.n + jnp.sum(x)
+
+        def compute(self):
+            return self.n
+
+    with pytest.raises(ValueError, match="sync_lag"):
+        _Toy(sync_lag=2)  # out-of-range lag
+    with pytest.raises(ValueError, match="dist_sync_on_step"):
+        _Toy(sync_lag=1)  # lag without per-step sync
+    _Toy(sync_lag=1, dist_sync_on_step=True)  # the valid opt-in
+
+
+def test_sync_lag_members_excluded_from_shared_step_gather():
+    # a collection mixing lag and no-lag members: the sync_lag member defers
+    # through its own compute path, never the shared eager step gather
+    a = Accuracy(dist_sync_on_step=True, dist_sync_fn=gather_all_arrays)
+    b = Accuracy(threshold=0.5, dist_sync_on_step=True, dist_sync_fn=gather_all_arrays)
+    b.sync_lag = 1
+    col = MetricCollection({"a": a, "b": b})
+    assert col._step_sync_shares(col._eager_shared_groups()).get("b") is None
+    batches = _batches(3, seed=13)
+    vals = [col(*bt) for bt in batches]
+    # member b lags its own series by one step; member a stays synchronous
+    for i in range(1, 3):
+        assert np.array_equal(np.asarray(vals[i]["b"]), np.asarray(vals[i - 1]["a"]))
+        assert np.array_equal(np.asarray(vals[i]["a"]), np.asarray(vals[i]["a"]))
+    _within(col.compute)
+
+
+# ------------------------------------------------- deferred in-jit sync plane
+def _stacked_state():
+    rng = np.random.RandomState(2)
+    return {
+        "s": jnp.asarray(rng.randint(0, 100, (8, 3)).astype(np.int32)),
+        "mx": jnp.asarray(rng.rand(8, 2).astype(np.float32)),
+        "mn": jnp.asarray(rng.rand(8).astype(np.float32)),
+        "mean": jnp.asarray(rng.rand(8, 4).astype(np.float32)),
+    }
+
+
+_STACKED_REDUCTIONS = {"s": "sum", "mx": "max", "mn": "min", "mean": "mean"}
+
+
+def _expected_stacked(state):
+    return {
+        "s": np.asarray(state["s"]).sum(0),
+        "mx": np.asarray(state["mx"]).max(0),
+        "mn": np.asarray(state["mn"]).min(0),
+        "mean": np.asarray(state["mean"]).mean(0, dtype=np.float32),
+    }
+
+
+@pytest.mark.parametrize("hierarchical", [False, True])
+def test_deferred_sync_state_matches_synchronous(eight_devices, hierarchical):
+    state = _stacked_state()
+    if hierarchical:
+        mesh = Mesh(np.array(eight_devices).reshape(2, 4), ("dcn", "ici"))
+        axis = MeshHierarchy(ici_axis="ici", dcn_axis="dcn")
+        spec = P(("dcn", "ici"))
+    else:
+        mesh = Mesh(np.array(eight_devices), ("dp",))
+        axis = "dp"
+        spec = P("dp")
+
+    def body(stacked):
+        local = {k: v[0] for k, v in stacked.items()}
+        return coalesced_sync_state(local, _STACKED_REDUCTIONS, axis)
+
+    sync_prog = jax.jit(
+        shard_map(body, mesh=mesh, in_specs=(spec,), out_specs=P(), check_vma=False)
+    )
+    obs.enable()
+    obs_counters.COUNTERS.reset()
+    sync_out = jax.block_until_ready(sync_prog(state))
+    snap_sync = obs_counters.snapshot(reset_after=True)
+    handle = deferred_sync_state(state, _STACKED_REDUCTIONS, axis, mesh=mesh)
+    deferred_out = _within(handle.result)
+    snap_async = obs_counters.snapshot()
+    obs.disable()
+    expected = _expected_stacked(state)
+    for name in state:
+        assert np.allclose(np.asarray(deferred_out[name]), expected[name], atol=1e-6), name
+        assert np.array_equal(np.asarray(deferred_out[name]), np.asarray(sync_out[name])), name
+    # the deferred dispatch staged the IDENTICAL program: count and kinds
+    assert snap_async["calls_by_kind"] == snap_sync["calls_by_kind"]
+    assert snap_async["sync_bytes"] == snap_sync["sync_bytes"]
+    assert snap_async["deferred"]["dispatched"] == 1
+    assert snap_async["deferred"]["fenced"] == 1
+
+
+def test_deferred_sync_plane_replays_one_program(eight_devices):
+    mesh = Mesh(np.array(eight_devices), ("dp",))
+    state = _stacked_state()
+    plane = DeferredSyncPlane(_STACKED_REDUCTIONS, "dp", mesh, state)
+    first = _within(plane.dispatch(state).result)
+    obs.enable()
+    obs_counters.COUNTERS.reset()
+    second = _within(plane.dispatch(state).result)
+    snap = obs_counters.snapshot()
+    obs.disable()
+    # the second dispatch replays the compiled program: zero NEW staged
+    # collectives (counting happens at trace time only)
+    assert snap["collective_calls"] == 0
+    assert snap["deferred"] == {"dispatched": 1, "fenced": 1, "completed": 1}
+    for name in state:
+        assert np.array_equal(np.asarray(first[name]), np.asarray(second[name])), name
+
+
+def test_metric_sync_state_deferred_under_trace_raises(eight_devices):
+    m = Accuracy()
+    m.update(*_batches(1)[0])
+
+    def traced(state):
+        return m.sync_state(state, "dp", deferred=True)
+
+    with pytest.raises(TracingUnsupportedError, match="SyncHandle"):
+        jax.jit(traced)(m._current_state())
+
+
+def test_collection_sync_state_deferred_resolves_nested(eight_devices):
+    mesh = Mesh(np.array(eight_devices), ("dp",))
+    col = MetricCollection({"acc": Accuracy()})
+    state = {
+        "acc": {
+            "correct": jnp.arange(8, dtype=jnp.int32),
+            "total": jnp.full((8,), 10, dtype=jnp.int32),
+        }
+    }
+    handle = col.sync_state(state, "dp", deferred=True, mesh=mesh)
+    assert isinstance(handle, SyncHandle)
+    out = _within(handle.result)
+    assert set(out) == {"acc"}
+    assert int(out["acc"]["correct"]) == 28
+    assert int(out["acc"]["total"]) == 80
+
+
+def test_deferred_dispatch_and_fence_emit_spans():
+    m = Accuracy()
+    m.update(*_batches(1)[0])
+    obs.enable()
+    obs_trace.clear()
+    handle = deferred_host_gather(m._current_state(), m._reductions, gather_fn=gather_all_arrays)
+    _within(handle.result)
+    names = [rec.name for rec in obs.records()]
+    obs.disable()
+    assert "deferred.dispatch" in names
+    assert "deferred.fence" in names
+    assert "deferred.complete" in names
+
+
+# --------------------------------------------------- chaos through the plane
+@pytest.mark.chaos
+def test_deferred_chaos_transient_drop_retries_bit_exact():
+    m = Accuracy()
+    m.update(*_batches(1)[0])
+    state = m._current_state()
+    guard = SyncGuard(deadline_s=2.0, max_retries=2, backoff_s=0.01)
+    before = obs_counters.COUNTERS.faults["sync_retries"]
+    with faults.ChaosInjector([faults.FaultSpec(kind="drop", call=0, times=1)], seed=0):
+        handle = deferred_host_gather(
+            state, m._reductions, gather_fn=gather_all_arrays, guard=guard
+        )
+        out = _within(handle.result)
+    for name, value in state.items():
+        assert np.array_equal(np.asarray(out[name]), np.asarray(value)), name
+    assert obs_counters.COUNTERS.faults["sync_retries"] > before
+
+
+@pytest.mark.chaos
+def test_deferred_chaos_stall_consumes_deadline_then_recovers():
+    m = Accuracy()
+    m.update(*_batches(1)[0])
+    state = m._current_state()
+    guard = SyncGuard(deadline_s=0.2, max_retries=2, backoff_s=0.01)
+    with faults.ChaosInjector(
+        [faults.FaultSpec(kind="stall", call=0, times=1, duration_s=0.5)], seed=0
+    ):
+        handle = deferred_host_gather(
+            state, m._reductions, gather_fn=gather_all_arrays, guard=guard
+        )
+        out = _within(handle.result)
+    assert np.array_equal(np.asarray(out["total"]), np.asarray(state["total"]))
+
+
+@pytest.mark.chaos
+def test_deferred_chaos_persistent_drop_degrades_without_stalling():
+    m = Accuracy()
+    m.update(*_batches(1)[0])
+    state = m._current_state()
+    guard = SyncGuard(deadline_s=0.5, max_retries=1, backoff_s=0.01, policy="degrade")
+    before = obs_counters.COUNTERS.faults["degraded_computes"]
+    with faults.ChaosInjector(
+        [faults.FaultSpec(kind="drop", rate=1.0, times=100_000)], seed=0
+    ):
+        handle = deferred_host_gather(
+            state, m._reductions, gather_fn=gather_all_arrays, guard=guard
+        )
+        out = _within(handle.result, timeout_s=10.0)  # degrade latches, never hangs
+    # local-only fallback: the snapshot values come back verbatim
+    for name, value in state.items():
+        assert np.array_equal(np.asarray(out[name]), np.asarray(value)), name
+    assert obs_counters.COUNTERS.faults["degraded_computes"] > before
+
+
+@pytest.mark.chaos
+def test_deferred_chaos_raise_policy_surfaces_from_result():
+    m = Accuracy()
+    m.update(*_batches(1)[0])
+    guard = SyncGuard(deadline_s=0.5, max_retries=1, backoff_s=0.01, policy="raise")
+    with faults.ChaosInjector(
+        [faults.FaultSpec(kind="drop", rate=1.0, times=100_000)], seed=0
+    ):
+        handle = deferred_host_gather(
+            m._current_state(), m._reductions, gather_fn=gather_all_arrays, guard=guard
+        )
+        with pytest.raises(SyncTimeoutError):
+            _within(handle.result, timeout_s=10.0)
+    with pytest.raises(SyncTimeoutError):
+        handle.result()  # the cached error re-raises; never half-resolved
+
+
+@pytest.mark.chaos
+def test_sync_lag_under_persistent_drop_latches_degrade_without_stall():
+    batches = _batches(4, seed=21)
+    m = Accuracy(dist_sync_on_step=True, dist_sync_fn=gather_all_arrays)
+    m.sync_lag = 1
+    guard = SyncGuard(deadline_s=0.3, max_retries=1, backoff_s=0.01, policy="degrade")
+    from metrics_tpu.parallel.sync import set_sync_guard
+
+    old = set_sync_guard(guard)
+    try:
+        with faults.ChaosInjector(
+            [faults.FaultSpec(kind="drop", rate=1.0, times=100_000)], seed=0
+        ):
+            start = time.perf_counter()
+            vals = _within(lambda: [np.asarray(m(*b)) for b in batches], timeout_s=20.0)
+            elapsed = time.perf_counter() - start
+            # resolve the last step's in-flight handle INSIDE the injector
+            # scope: its degraded completion must not leak into later tests
+            _within(m._deferred_handle.result, timeout_s=10.0)
+    finally:
+        set_sync_guard(old)
+    # degraded gathers return the local snapshot: the lagged read is the
+    # previous step's LOCAL value, and the stream advanced without stalling
+    assert elapsed < 15.0
+    assert len(vals) == len(batches)
+    assert obs_counters.COUNTERS.faults["degraded_computes"] > 0

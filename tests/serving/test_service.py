@@ -120,9 +120,16 @@ def test_drop_oldest_sheds_with_counter_and_health():
         while svc._queue.qsize() > 0 and time.monotonic() < deadline:
             time.sleep(0.005)
         with svc._proc_lock:
-            # worker is idle or blocked; fill the 2-deep queue + 2 overflow
-            _feed(svc, batches[1:])
-            assert svc.shed_events >= 2
+            # hand the worker ONE batch to hold in hand (it blocks on the
+            # lock we own), so the 2-deep queue is deterministically ours —
+            # the worker cannot steal an item mid-feed
+            _feed(svc, batches[1:2])
+            deadline = time.monotonic() + 5.0
+            while svc._queue.qsize() > 0 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            # fill the 2-deep queue + 1 overflow: a deterministic shed
+            _feed(svc, batches[2:])
+            assert svc.shed_events >= 1
             assert svc.health == "shedding"
         svc.flush()
         assert svc.processed + svc.shed_events == len(batches)
@@ -307,3 +314,91 @@ def test_soak_long():
     """The full soak (excluded from tier-1 by the slow marker; select with
     ``-m soak``)."""
     _soak(120)
+
+
+# ------------------------------------------------- deferred publish stage
+def test_deferred_publish_matches_synchronous_stage():
+    """The deferred stage snapshots the close-point state, so every published
+    record — values, merged view, watermark, drop counts — is bit-identical
+    to the synchronous stage's over the same stream."""
+    batches = _batches(12, seed=4)
+    runs = {}
+    for deferred in (False, True):
+        svc = MetricService(
+            _metric(dist_sync_fn=gather_all_arrays), deferred_publish=deferred
+        )
+        with svc:
+            _feed(svc, batches)
+            svc.flush()
+            merged = np.asarray(svc.finalize())
+        runs[deferred] = (svc.publications, merged)
+    sync_pubs, sync_merged = runs[False]
+    defer_pubs, defer_merged = runs[True]
+    assert [p["window"] for p in defer_pubs] == [p["window"] for p in sync_pubs]
+    for a, b in zip(defer_pubs, sync_pubs):
+        for key in ("value", "merged", "degraded", "watermark", "dropped_samples"):
+            assert np.array_equal(np.asarray(a[key]), np.asarray(b[key]), equal_nan=False), key
+    assert np.array_equal(defer_merged, sync_merged)
+
+
+def test_flush_is_a_barrier_over_the_publish_pipeline():
+    """After ``flush`` every window the ingested events closed has a landed
+    publication — the deferred stage must not leave records in flight."""
+    batches = _batches(10, seed=5)
+    with MetricService(_metric(dist_sync_fn=gather_all_arrays)) as svc:
+        _feed(svc, batches)
+        svc.flush()
+        windows = [p["window"] for p in svc.publications]
+        assert windows == sorted(windows)
+        assert len(windows) >= 2  # the stream closed several windows
+        assert svc.last_snapshot is not None
+        assert svc.last_snapshot["published_through"] == windows[-1]
+        svc.finalize()
+
+
+def test_publish_emits_per_window_spans():
+    """Every publish emits one ``service.publish`` span stamped window=,
+    degraded=, queue_depth, and deferred= (the per-window Perfetto view)."""
+    batches = _batches(10, seed=6)
+    obs.enable()
+    try:
+        import metrics_tpu.observability.trace as obs_trace
+
+        obs_trace.clear()
+        with MetricService(_metric(dist_sync_fn=gather_all_arrays)) as svc:
+            _feed(svc, batches)
+            svc.flush()
+            svc.finalize()
+            published = [p["window"] for p in svc.publications]
+        spans = [r for r in obs.records() if r.name == "service.publish"]
+    finally:
+        obs.disable()
+    assert len(spans) == len(published)
+    assert [s.attrs["window"] for s in spans] == published
+    for s in spans:
+        assert s.attrs["degraded"] in ("yes", "no")
+        assert s.attrs["deferred"] == "yes"
+        assert isinstance(s.attrs["queue_depth"], int)
+
+
+def test_deferred_publish_degrades_and_stamps_span_under_drop():
+    batches = _batches(8, seed=7)
+    guard = SyncGuard(deadline_s=0.5, max_retries=1, backoff_s=0.01, policy="degrade")
+    obs.enable()
+    try:
+        import metrics_tpu.observability.trace as obs_trace
+
+        obs_trace.clear()
+        with faults.ChaosInjector(
+            [faults.FaultSpec(kind="drop", rate=1.0, times=100_000)], seed=0
+        ):
+            with MetricService(_metric(dist_sync_fn=gather_all_arrays), guard=guard) as svc:
+                _feed(svc, batches)
+                svc.flush()
+                svc.finalize()
+                assert svc.publications and all(p["degraded"] for p in svc.publications)
+                assert svc.health == "degraded"
+        spans = [r for r in obs.records() if r.name == "service.publish"]
+    finally:
+        obs.disable()
+    assert spans and all(s.attrs["degraded"] == "yes" for s in spans)
