@@ -12,7 +12,11 @@ from copy import deepcopy
 from typing import Any, Dict, List, Optional, Tuple, Union
 
 from metrics_tpu.core.metric import Metric, PureMetric
-from metrics_tpu.observability.counters import record_cache, record_states_synced
+from metrics_tpu.observability.counters import (
+    record_cache,
+    record_deferred_depth,
+    record_states_synced,
+)
 from metrics_tpu.observability.devtime import DEVTIME as _DEVTIME, fence as _fence
 from metrics_tpu.observability.trace import TRACE, span as _span
 from metrics_tpu.parallel.buffer import PaddedBuffer
@@ -235,10 +239,11 @@ class MetricCollection(OrderedDict):
         configuration as the group's first eligible member (same
         ``dist_sync_fn`` identity, same ``process_group``), with no
         sharded-engine self-sync. Groups with < 2 eligible members keep the
-        per-member path — nothing is saved. ``sync_lag=1`` members are
-        excluded: their per-step gather is a DEFERRED dispatch whose handle
-        lives on the member (``Metric._deferred_handle``) — they defer
-        through their own compute path instead of the shared eager gather.
+        per-member path — nothing is saved. ``sync_lag >= 1`` members are
+        excluded: their per-step gathers are DEFERRED dispatches whose
+        handles live on the member's lag-k ring (``Metric._handle_ring``) —
+        they defer through their own compute path instead of the shared
+        eager gather.
         """
         import jax
 
@@ -684,7 +689,13 @@ class MetricCollection(OrderedDict):
             for k, m in self.items()
         }
 
-    def _grouped_host_sync(self) -> Optional[Dict[str, Any]]:
+    # Epoch-gather deferral: the shared per-group gathers dispatch through
+    # ``deferred_host_gather`` so a collection's epoch compute OVERLAPS the
+    # gathers of groups it has not read yet (attribute convention, like
+    # ``Metric.sync_lag``: flip to False for the fully synchronous plane).
+    deferred_epoch_sync: bool = True
+
+    def _grouped_host_sync(self, deferred: Optional[bool] = None) -> Optional[Dict[str, Any]]:
         """Group-aware host-plane sync: ONE ``process_allgather`` plane per
         compute group instead of one per member.
 
@@ -700,6 +711,19 @@ class MetricCollection(OrderedDict):
         members with per-member sync config, and sharded-engine metrics fall
         back to their own ``compute``. Returns {member name: computed value}
         for the members handled here, or None.
+
+        DEFERRED form (default — :attr:`deferred_epoch_sync`): every group's
+        gather is submitted up front through
+        :func:`~metrics_tpu.parallel.deferred.deferred_host_gather` (the
+        single-worker host plane runs them in submission order, so the
+        collective entry order — and every peer's rendezvous pairing — is
+        IDENTICAL to the synchronous plane's), then the handles resolve in
+        that same order: while group ``i``'s members compute from their
+        resolved view, group ``i+1``'s gather is already moving on the
+        background plane. Same gathers, same guard, same chaos sites, same
+        per-call collective counts — only the epoch's critical path shrinks.
+        Per-member syncs for members NOT handled here still run after every
+        handle has resolved, exactly where the synchronous plane ran them.
         """
         ids = self.__dict__.get("_lockstep_ids")
         if ids is None:
@@ -711,7 +735,7 @@ class MetricCollection(OrderedDict):
         self._lockstep_check()
         diverged = self.__dict__.get("_lockstep_diverged", set())
         multiproc = jax.process_count() > 1
-        out: Dict[str, Any] = {}
+        plans = []  # (rep, share member names, gather source metric, gather_fn)
         for rep, members in self.compute_groups.items():
             if len(members) < 2:
                 continue
@@ -733,15 +757,49 @@ class MetricCollection(OrderedDict):
             ]
             if len(share) < 2:
                 continue  # nothing saved by sharing; individual path
-            src = self[share[0]]
-            record_states_synced(len(src._defaults))
-            if TRACE.enabled:
-                with _span("collection.host_sync", {"group": rep, "shared": len(share)}):
-                    synced = host_gather(src._current_state(), src._reductions, gather_fn=gather_fn)
-                    if _DEVTIME.enabled:
-                        _fence(synced)
+            plans.append((rep, share, self[share[0]], gather_fn))
+        if not plans:
+            return None
+
+        deferred = self.deferred_epoch_sync if deferred is None else deferred
+        handles = None
+        if deferred:
+            from metrics_tpu.parallel.deferred import deferred_host_gather
+
+            # phase 1: dispatch EVERY group's gather (entry order == the
+            # synchronous plane's group order); phase 2 below resolves them
+            # in the same order, overlapping each resolve's member computes
+            # with the still-in-flight gathers behind it
+            handles = []
+            for rep, share, src, gather_fn in plans:
+                record_states_synced(len(src._defaults))
+                handles.append(deferred_host_gather(
+                    src._current_state(), src._reductions, gather_fn=gather_fn,
+                    label="epoch_gather",
+                    attrs={"group": rep} if TRACE.enabled else None,
+                ))
+            record_deferred_depth(f"{type(self).__name__}.epoch", len(handles))
+
+        out: Dict[str, Any] = {}
+        for i, (rep, share, src, gather_fn) in enumerate(plans):
+            if handles is not None:
+                if TRACE.enabled:
+                    attrs = {"group": rep, "shared": len(share), "deferred": "yes"}
+                    with _span("collection.host_sync", attrs):
+                        synced = handles[i].result()
+                        if _DEVTIME.enabled:
+                            _fence(synced)
+                else:
+                    synced = handles[i].result()
             else:
-                synced = host_gather(src._current_state(), src._reductions, gather_fn=gather_fn)
+                record_states_synced(len(src._defaults))
+                if TRACE.enabled:
+                    with _span("collection.host_sync", {"group": rep, "shared": len(share)}):
+                        synced = host_gather(src._current_state(), src._reductions, gather_fn=gather_fn)
+                        if _DEVTIME.enabled:
+                            _fence(synced)
+                else:
+                    synced = host_gather(src._current_state(), src._reductions, gather_fn=gather_fn)
             for k in share:
                 m = self[k]
                 cache = m._current_state()
@@ -752,6 +810,8 @@ class MetricCollection(OrderedDict):
                 finally:
                     m._set_state(cache)
                     m._to_sync = True
+        if handles is not None:
+            record_deferred_depth(f"{type(self).__name__}.epoch", 0)
         return out or None
 
     def reset(self) -> None:

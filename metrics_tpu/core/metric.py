@@ -31,7 +31,9 @@ TPU-native redesign (not a port):
 import functools
 import inspect
 import threading
+import time
 from abc import ABC, abstractmethod
+from collections import deque
 from copy import deepcopy
 from typing import Any, Callable, Dict, NamedTuple, Optional, Union
 
@@ -43,6 +45,7 @@ from jax import Array
 from metrics_tpu.observability.counters import (
     COUNTERS as _COUNTERS,
     record_cache,
+    record_deferred_depth,
     record_fault,
     record_state_bytes,
     record_states_synced,
@@ -171,7 +174,7 @@ _NON_TRACE_ATTRS = frozenset({
     "_default_keys",
     "_to_sync", "_in_forward", "_sync_count", "dist_sync_fn",
     "_placement", "_state_dtype", "compute_on_step", "dist_sync_on_step",
-    "process_group", "sync_lag", "_deferred_handle",
+    "process_group", "sync_lag", "_handle_ring", "_lag_controller",
 })
 
 
@@ -282,6 +285,34 @@ def _fingerprint_value(v: Any, pins: list) -> Any:
     return ("obj", type(v).__name__, v)
 
 
+def _validate_sync_lag(value: Any, dist_sync_on_step: bool) -> Any:
+    """Canonicalize a ``sync_lag`` setting: an int in ``[0, MAX_SYNC_LAG]``
+    or the literal ``"auto"``. Raises on anything else, loudly — a silently
+    clamped lag would change the documented staleness contract."""
+    from metrics_tpu.parallel.deferred import MAX_SYNC_LAG
+
+    if value == "auto":
+        lag: Any = "auto"
+    else:
+        if isinstance(value, bool) or not isinstance(value, int):
+            raise ValueError(
+                f"`sync_lag` must be an int in [0, {MAX_SYNC_LAG}] or 'auto', got {value!r}"
+            )
+        if not 0 <= value <= MAX_SYNC_LAG:
+            raise ValueError(
+                f"`sync_lag` must be in [0, {MAX_SYNC_LAG}] (the handle-ring depth is"
+                f" bounded so the rendezvous pool and the background host plane never"
+                f" wedge) or 'auto', got {value!r}"
+            )
+        lag = int(value)
+    if lag and not dist_sync_on_step:
+        raise ValueError(
+            f"`sync_lag={lag!r}` defers the per-step sync inside `forward`; it"
+            " requires `dist_sync_on_step=True`"
+        )
+    return lag
+
+
 class _BufferSpec(NamedTuple):
     capacity: int
     item_shape: tuple
@@ -334,21 +365,31 @@ class Metric(ABC):
             kwarg — set the ``metric.check_finite`` attribute after
             construction for library metrics.
         sync_lag: opt-in DEFERRED per-step sync for ``dist_sync_on_step``
-            consumers (``0`` = synchronous, the default; ``1`` = deferred).
-            With ``sync_lag=1`` every ``forward`` snapshots its batch delta
-            (the double buffer — jax arrays are immutable, so the snapshot is
-            free) and dispatches the host gather on the BACKGROUND host plane
-            (``parallel/deferred.py``); the step's returned value is computed
-            from the PREVIOUS step's merged view, which finished gathering
-            while this step's update ran. Values are bit-exact vs the
-            synchronous plane modulo the documented one-step lag: step ``i``
-            (``i >= 1``) returns exactly what the synchronous plane returned
-            at step ``i - 1``; step 0 returns the local (unsynced) batch
-            value as warm-up. Epoch-level ``compute()`` stays synchronous —
-            it first drains any in-flight handle so gather entry order is
-            preserved across ranks. Subclasses don't forward the kwarg — set
-            the ``metric.sync_lag`` attribute after construction for library
-            metrics (same convention as ``check_finite``).
+            consumers (``0`` = synchronous, the default; ``k`` in
+            ``[1, MAX_SYNC_LAG]`` = a ring of k in-flight deferred gathers;
+            ``"auto"`` = adaptive). With ``sync_lag=k`` every ``forward``
+            snapshots its batch delta (the double buffer — jax arrays are
+            immutable, so the snapshot is free), dispatches its host gather
+            on the BACKGROUND host plane (``parallel/deferred.py``), and
+            pushes the handle onto a bounded ring; once the ring holds more
+            than k handles the OLDEST resolves and the step's returned value
+            is computed from ITS merged view — which finished gathering
+            while the last k steps' updates ran. Values are bit-exact vs the
+            synchronous plane modulo the documented k-step lag: step ``i``
+            (``i >= k``) returns exactly what the synchronous plane returned
+            at step ``i - k``; steps ``0..k-1`` return the local (unsynced)
+            batch value as warm-up. Epoch-level ``compute()`` stays
+            synchronous — it first drains the whole ring in entry order so
+            gather pairing is preserved across ranks, then syncs the
+            accumulator fresh (the accumulated state never lags, only the
+            per-step read). ``reset``/``clone``/``state_dict`` never carry
+            handles. ``sync_lag="auto"`` wires in a
+            :class:`~metrics_tpu.parallel.deferred.LagController`: lag 0
+            (fully synchronous, zero staleness) while the measured blocking
+            wait says the collective is effectively free, deeper toward the
+            cap when the (DCN) gather is slow. Subclasses don't forward the
+            kwarg — set the ``metric.sync_lag`` attribute after construction
+            for library metrics (same convention as ``check_finite``).
     """
 
     def __init__(
@@ -375,18 +416,10 @@ class Metric(ABC):
                 f"`check_finite` must be one of {CHECK_FINITE_POLICIES}, got {check_finite!r}"
             )
         self.check_finite = check_finite
-        if sync_lag not in (0, 1):
-            raise ValueError(
-                f"`sync_lag` must be 0 or 1 (the deferred plane reads at most one"
-                f" step behind), got {sync_lag!r}"
-            )
-        if sync_lag and not dist_sync_on_step:
-            raise ValueError(
-                "`sync_lag=1` defers the per-step sync inside `forward`; it requires"
-                " `dist_sync_on_step=True`"
-            )
-        self.sync_lag = int(sync_lag)
-        self._deferred_handle = None  # in-flight SyncHandle (sync_lag=1)
+        self.sync_lag = _validate_sync_lag(sync_lag, dist_sync_on_step)
+        # the lag-k ring: in-flight SyncHandles, oldest first (sync_lag >= 1)
+        self._handle_ring: deque = deque()
+        self._lag_controller = None  # LagController, built lazily (sync_lag="auto")
         self._to_sync = True
         self._in_forward = False
         self._sync_count = 0
@@ -945,11 +978,11 @@ class Metric(ABC):
             cache = self._current_state()
             bound = self._count_bound
             watermark = self._epoch_watermark
-            handle = self._deferred_handle
+            ring = self._handle_ring
             self.reset()
-            # the temp reset must not drop an in-flight deferred handle: the
-            # lagged compute below reads (and replaces) it
-            self._deferred_handle = handle
+            # the temp reset must not drop the in-flight lag-k ring: the
+            # lagged compute below reads (and extends) it
+            self._handle_ring = ring
             try:
                 self.update(*args, **kwargs)
                 self._forward_cache = self.compute()
@@ -1148,28 +1181,64 @@ class Metric(ABC):
         gather path with sync silently disabled."""
         return False
 
-    def _sync_dist(self, dist_sync_fn: Optional[Callable] = None) -> None:
+    def _sync_dist(
+        self, dist_sync_fn: Optional[Callable] = None,
+        timer: Optional[Callable[[float], None]] = None,
+    ) -> None:
         """Host-plane sync: gather + stack/flatten + per-state reduction
         (reference metric.py:179-197). Runs under the active ``SyncGuard``
         (deadlines/retry/degrade — see ``parallel.sync``); the
         ``check_finite`` policy then vets the gathered state (``quarantine``
-        keeps the LOCAL state when the synced one is poisoned)."""
+        keeps the LOCAL state when the synced one is poisoned). ``timer``
+        receives the gather's blocking milliseconds (the adaptive lag
+        controller's lag-0 probe — see ``parallel.sync.host_gather``)."""
         gather = dist_sync_fn if dist_sync_fn is not None else self._default_gather()
         record_states_synced(len(self._defaults))
         local = self._current_state() if self.check_finite == "quarantine" else None
         if TRACE.enabled:
             with _span("metric.sync_state", {"metric": type(self).__name__}) as sp:
-                synced = host_gather(self._current_state(), self._reductions, gather_fn=gather)
+                synced = host_gather(
+                    self._current_state(), self._reductions, gather_fn=gather, timer=timer
+                )
                 if _DEVTIME.enabled:
                     _fence(synced)
                 self._set_state(synced)
                 self._guard_state_integrity("sync", local)
                 self._note_state_bytes(sp)
         else:
-            synced = host_gather(self._current_state(), self._reductions, gather_fn=gather)
+            synced = host_gather(
+                self._current_state(), self._reductions, gather_fn=gather, timer=timer
+            )
             self._set_state(synced)
             self._guard_state_integrity("sync", local)
             self._note_state_bytes()
+
+    # ------------------------------------------------- the lag-k handle ring
+    def _resolve_sync_lag(self) -> int:
+        """The effective ring depth this step: the static ``sync_lag``, or
+        the adaptive controller's current verdict for ``sync_lag="auto"``
+        (the controller is built on first use and fed the measured blocking
+        waits — lag-0 steps feed the synchronous gather's wall time, lag-k
+        steps the oldest handle's fence wait)."""
+        lag = self.sync_lag
+        if lag == "auto":
+            ctrl = self._lag_controller
+            if ctrl is None:
+                from metrics_tpu.parallel.deferred import LagController
+
+                self._lag_controller = ctrl = LagController()
+            return ctrl.lag
+        # attribute-set path (library metrics): validate as loudly as __init__
+        return _validate_sync_lag(lag, self.dist_sync_on_step) if lag else 0
+
+    def _drain_handle_ring(self) -> None:
+        """Resolve every in-flight deferred handle in entry order and drop
+        the views (the accumulated state never lags; the epoch-level sync
+        that follows is fresh). Guard-policy ``raise`` exhaustion surfaces
+        here — exactly where the synchronous plane would have thrown."""
+        ring = self._handle_ring
+        while ring:
+            ring.popleft().result()
 
     def _wrap_update(self, update: Callable) -> Callable:
         @functools.wraps(update)
@@ -1376,37 +1445,65 @@ class Metric(ABC):
             synced = False
             cache = {}
             if self._to_sync and dist_sync_fn is not None:
-                if self.sync_lag and self._in_forward:
-                    # the DEFERRED per-step plane (sync_lag=1): snapshot this
-                    # step's delta into the double buffer, dispatch its gather
-                    # on the background host plane, and read the PREVIOUS
-                    # step's merged view — which finished gathering while this
-                    # step's update ran. The debug sync-count probe is skipped
-                    # here: its own eager gather would jump the entry-order
-                    # queue the background executor preserves.
+                lag = (
+                    self._resolve_sync_lag()
+                    if self.sync_lag and self._in_forward
+                    else 0
+                )
+                if lag:
+                    # the DEFERRED per-step plane (sync_lag=k): snapshot this
+                    # step's delta into the double buffer, dispatch its
+                    # gather on the background host plane, push the handle
+                    # onto the lag-k ring, and — once the ring overflows its
+                    # depth — read the OLDEST handle's merged view, which
+                    # finished gathering while the last k steps' updates ran.
+                    # The debug sync-count probe is skipped here: its own
+                    # eager gather would jump the entry-order queue the
+                    # background executor preserves.
                     from metrics_tpu.parallel.deferred import deferred_host_gather
 
-                    prev = self._deferred_handle
-                    self._deferred_handle = deferred_host_gather(
+                    ring = self._handle_ring
+                    attrs = None
+                    if TRACE.enabled:
+                        attrs = {"lag_controller": lag}
+                    ring.append(deferred_host_gather(
                         self._current_state(), self._reductions,
                         gather_fn=dist_sync_fn, watermark=self._epoch_watermark,
-                    )
+                        attrs=attrs,
+                    ))
                     self._sync_count += 1
-                    if prev is not None:
+                    view = None
+                    # overflow: resolve oldest handles until the ring is back
+                    # at its depth (one pop per step in steady state; several
+                    # when the lag just shallowed). The NEWEST resolved view
+                    # is the step's read — the freshest k-lagged merge.
+                    while len(ring) > lag:
+                        oldest = ring.popleft()
+                        t0 = time.perf_counter()
+                        view = oldest.result()
+                        if self._lag_controller is not None and self.sync_lag == "auto":
+                            self._lag_controller.observe(
+                                (time.perf_counter() - t0) * 1e3
+                            )
+                    record_deferred_depth(
+                        getattr(self, "_metric_label", type(self).__name__), len(ring)
+                    )
+                    if view is not None:
                         cache = self._current_state()
                         local = cache if self.check_finite == "quarantine" else None
-                        self._set_state(prev.result())
+                        self._set_state(view)
                         self._guard_state_integrity("sync", local)
                         self._note_state_bytes()
                         synced = True
-                    # warm-up (no previous view): the state stays the local
-                    # delta — step 0's value is the documented unsynced read
+                    # warm-up (ring not yet at depth): the state stays the
+                    # local delta — steps 0..k-1 read the documented unsynced
+                    # view
                 else:
-                    if self._deferred_handle is not None:
-                        # entry order: a synchronous sync must not overtake the
-                        # in-flight deferred gather on any rank
-                        self._deferred_handle.result()
-                        self._deferred_handle = None
+                    if self._handle_ring:
+                        # entry order: a synchronous sync must not overtake
+                        # in-flight deferred gathers on any rank — drain the
+                        # whole ring, oldest first
+                        self._drain_handle_ring()
                     if debug.sync_count_check_enabled():
                         counts = [int(c) for c in dist_sync_fn(jnp.asarray(self._sync_count, dtype=jnp.int32))]
                         if len(set(counts)) > 1:
@@ -1418,7 +1515,13 @@ class Metric(ABC):
                             )
                     self._sync_count += 1
                     cache = self._current_state()
-                    self._sync_dist(dist_sync_fn)
+                    if self._lag_controller is not None and self.sync_lag == "auto" and self._in_forward:
+                        # the controller's lag-0 probe: feed it the blocking
+                        # wait this synchronous gather cost the step — the
+                        # wait a deeper ring would have hidden
+                        self._sync_dist(dist_sync_fn, timer=self._lag_controller.observe)
+                    else:
+                        self._sync_dist(dist_sync_fn)
                     synced = True
 
             self._computed = compute(*args, **kwargs)
@@ -1449,9 +1552,9 @@ class Metric(ABC):
         self._count_bound = 0
         self._overflow_warned = False
         self._epoch_watermark = 0
-        # an in-flight deferred gather still completes on the background
-        # plane (entry order), but a reset metric never reads its view
-        self._deferred_handle = None
+        # in-flight deferred gathers still complete on the background plane
+        # (entry order), but a reset metric never reads their views
+        self._handle_ring = deque()
         state = self.init_state()
         self._set_state(state)
         if self._state_dtype is not None:
@@ -1463,10 +1566,11 @@ class Metric(ABC):
         return deepcopy(self)
 
     def __getstate__(self) -> dict:
-        # _deferred_handle is a live future (threads, device buffers): it
-        # never travels — a copy/restore starts with no in-flight sync
+        # _handle_ring holds live futures (threads, device buffers): they
+        # never travel — a copy/restore starts with no in-flight sync. The
+        # lag controller's measurements are machine-local, so it stays too.
         skip = ("update", "compute", "_update_impl", "_compute_impl", "_jitted_step", "_jitted_step_fc",
-                "_jitted_scan", "_deferred_handle")
+                "_jitted_scan", "_handle_ring", "_lag_controller")
         return {k: v for k, v in self.__dict__.items() if k not in skip}
 
     def __setstate__(self, state: dict) -> None:
@@ -1480,7 +1584,12 @@ class Metric(ABC):
         self.__dict__.setdefault("_epoch_watermark", 0)
         self.__dict__.setdefault("check_finite", None)
         self.__dict__.setdefault("sync_lag", 0)
-        self.__dict__["_deferred_handle"] = None
+        # handles never travel: drop ANY lag-k ring a foreign __dict__ sneaked
+        # in (and the legacy single-handle slot from pre-ring pickles) — a
+        # restored metric starts with no in-flight sync and a fresh controller
+        self.__dict__["_handle_ring"] = deque()
+        self.__dict__["_lag_controller"] = None
+        self.__dict__.pop("_deferred_handle", None)
         self._update_impl = self.__class__.update.__get__(self)
         self._compute_impl = self.__class__.compute.__get__(self)
         self.update = self._wrap_update(self._update_impl)
@@ -1494,7 +1603,7 @@ class Metric(ABC):
         new = cls.__new__(cls)
         memo[id(self)] = new
         skip = ("update", "compute", "_update_impl", "_compute_impl", "_jitted_step", "_jitted_step_fc",
-                "_jitted_scan", "_deferred_handle")
+                "_jitted_scan", "_handle_ring", "_lag_controller")
         for k, v in self.__dict__.items():
             if k in skip:
                 continue
@@ -1515,7 +1624,8 @@ class Metric(ABC):
         new._jitted_step = None
         new._jitted_step_fc = None
         new._jitted_scan = None
-        new.__dict__["_deferred_handle"] = None
+        new.__dict__["_handle_ring"] = deque()
+        new.__dict__["_lag_controller"] = None
         return new
 
     # ------------------------------------------------------- device / shards
@@ -1766,7 +1876,10 @@ class CompositionalMetric(Metric):
         self.metric_a = jnp.asarray(metric_a) if isinstance(metric_a, (jnp.ndarray, np.ndarray)) else metric_a
         self.metric_b = jnp.asarray(metric_b) if isinstance(metric_b, (jnp.ndarray, np.ndarray)) else metric_b
 
-    def _sync_dist(self, dist_sync_fn: Optional[Callable] = None) -> None:
+    def _sync_dist(
+        self, dist_sync_fn: Optional[Callable] = None,
+        timer: Optional[Callable[[float], None]] = None,
+    ) -> None:
         # syncing is done by the child metrics themselves (reference metric.py:489-491)
         pass
 

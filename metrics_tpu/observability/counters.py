@@ -83,6 +83,14 @@ What gets counted, and on which plane:
   snapshot time is the in-flight depth; a ``dispatched`` that never
   ``fenced`` is a leaked handle (the collective still ran — entry order —
   but nobody read the merged view). Present in every snapshot.
+- **deferred_depth**: per-label GAUGES of in-flight deferred handles
+  (``{label: {"current": n, "max": m}}``): ``current`` is the depth after
+  the most recent recording at that label, ``max`` the high-water mark
+  since the last reset. The lag-k ring records under the metric class name,
+  the deferred epoch gather under ``<Collection>.epoch``, and the serving
+  publish pipeline under the service label — so a snapshot shows exactly
+  how deep every deferred pipeline actually ran (vs the ``sync_lag`` cap it
+  was allowed). Present in every snapshot.
 - **slab_slots**: per-slab slot GAUGES for the keyed multi-tenant wrappers
   (``wrappers/keyed.py``): ``{label: {"slots": K, "occupied": n,
   "evictions": e}}``. Occupancy says how much of the provisioned K is
@@ -111,6 +119,7 @@ __all__ = [
     "record_cache",
     "record_collective",
     "record_deferred",
+    "record_deferred_depth",
     "record_fault",
     "record_gather_skip",
     "record_service_health",
@@ -178,6 +187,7 @@ class CollectiveCounters:
         "launch_cache_misses",
         "faults",
         "deferred",
+        "deferred_depth",
         "gather_skips",
         "slab_dropped_samples",
         "state_bytes",
@@ -205,6 +215,7 @@ class CollectiveCounters:
         self.launch_cache_misses = 0
         self.faults: Dict[str, int] = {k: 0 for k in FAULT_KINDS}
         self.deferred: Dict[str, int] = {k: 0 for k in DEFERRED_KINDS}
+        self.deferred_depth: Dict[str, Dict[str, int]] = {}  # label -> {"current", "max"}
         self.gather_skips = 0
         self.slab_dropped_samples = 0  # out-of-range slot ids dropped by slab scatters
         self.state_bytes: Dict[str, int] = {}  # metric class name -> latest bytes
@@ -261,6 +272,16 @@ class CollectiveCounters:
             raise ValueError(f"unknown deferred kind {kind!r}; expected one of {DEFERRED_KINDS}")
         with self._lock:
             self.deferred[kind] += int(n)
+
+    def record_deferred_depth(self, label: str, current: int) -> None:
+        """Refresh one deferred pipeline's depth gauge (latest ``current``
+        wins; ``max`` is the high-water mark since the last reset)."""
+        if current < 0:
+            raise ValueError(f"deferred depth must be >= 0, got {current}")
+        with self._lock:
+            prev = self.deferred_depth.get(label)
+            peak = max(int(current), prev["max"]) if prev else int(current)
+            self.deferred_depth[label] = {"current": int(current), "max": peak}
 
     def record_gather_skip(self) -> None:
         with self._lock:
@@ -323,6 +344,7 @@ class CollectiveCounters:
                 "states_synced": self.states_synced,
                 "faults": dict(self.faults),
                 "deferred": dict(self.deferred),
+                "deferred_depth": {k: dict(v) for k, v in sorted(self.deferred_depth.items())},
                 "gather_skips": self.gather_skips,
                 "slab_dropped_samples": self.slab_dropped_samples,
                 "state_bytes": dict(sorted(self.state_bytes.items())),
@@ -379,6 +401,13 @@ def record_gather_skip() -> None:
 def record_deferred(kind: str, n: int = 1) -> None:
     if COUNTERS.enabled:
         COUNTERS.record_deferred(kind, n)
+
+
+# Depth gauges are telemetry like the lifecycle counters (high-volume on a
+# deferring hot loop), so they share the enabled gate.
+def record_deferred_depth(label: str, current: int) -> None:
+    if COUNTERS.enabled:
+        COUNTERS.record_deferred_depth(label, current)
 
 
 # Dropped-sample evidence records UNCONDITIONALLY, same argument as the

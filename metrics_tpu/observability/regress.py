@@ -60,6 +60,11 @@ MS_KEYS: Tuple[str, ...] = (
     # async strictly below fenced — is bench.py --check-async's pin)
     "async_sync8_ms",
     "fenced_sync8_ms",
+    # the lag-k ring at depths 2 and 3: deeper rings replay the same
+    # compiled program, so their step ms must track the depth-1 plane's
+    # (monotonicity across depths is --check-async's pin, not this gate's)
+    "async_lag2_ms",
+    "async_lag3_ms",
 )
 
 # staged-collective keys gated exactly (no growth) vs the latest prior round
@@ -114,6 +119,14 @@ COUNT_KEYS: Tuple[str, ...] = (
     "async_gather_calls",
     "async_states_synced",
     "async_fenced_collective_calls",
+    # the lag-k ring: a depth-3 ring must stage the IDENTICAL program as the
+    # depth-1 plane (depth is in-flight handles, never extra collectives),
+    # and the deferred epoch gather must issue exactly the synchronous
+    # grouped plane's per-group gather-call count
+    "async_lag_collective_calls",
+    "async_lag_sync_bytes",
+    "async_lag_epoch_gather_calls",
+    "async_lag_epoch_sync_gather_calls",
 )
 
 # fault counters: bound at exactly zero whenever the current line carries

@@ -37,8 +37,13 @@ the way training stacks overlap gradient all-reduce with backprop:
   ``result()``.
 - **Epoch watermark.** Every handle carries the dispatching metric's epoch
   watermark, so a consumer of the lagged view knows exactly which step's
-  merge it is reading (``dist_sync_on_step`` consumers with ``sync_lag=1``
-  read the previous step's view — see ``core.metric.Metric``).
+  merge it is reading (``dist_sync_on_step`` consumers with ``sync_lag=k``
+  read the view from k steps back through a bounded handle ring — see
+  ``core.metric.Metric``; :data:`MAX_SYNC_LAG` caps the ring).
+- **Adaptive lag.** :class:`LagController` closes the loop between the
+  measured fence-wait split and the ring depth: lag 0 when the collective
+  is effectively free, deeper toward the cap when the (DCN) gather is slow.
+  ``Metric(sync_lag="auto")`` wires it in.
 
 Observability: dispatch / fence / completion are span-stamped
 (``deferred.dispatch`` / ``deferred.fence`` / ``deferred.complete``) and
@@ -47,6 +52,7 @@ overlap is a measured number — the fence span's wait is what the overlap
 saved, and ``bench.py --check-async`` reports it next to the synchronous
 plane's blocking wait.
 """
+import atexit
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, Optional
@@ -67,12 +73,23 @@ from metrics_tpu.utils.exceptions import TracingUnsupportedError
 
 __all__ = [
     "DeferredSyncPlane",
+    "LagController",
+    "MAX_SYNC_LAG",
     "SyncHandle",
+    "clear_program_cache",
     "deferred_host_gather",
     "deferred_sync_state",
     "drain_host_plane",
     "host_plane_submit",
 ]
+
+# The lag-k handle ring's hard depth cap. Each in-flight handle pins a
+# snapshot (device buffers) and one queued task on the single-worker host
+# plane; on the in-jit plane each unfenced dispatch additionally holds an
+# XLA:CPU rendezvous slot. A bounded ring keeps both pools finite no matter
+# what lag a controller or caller asks for — a runaway depth would wedge the
+# rendezvous pool (device) or grow the host queue without bound (host).
+MAX_SYNC_LAG = 8
 
 
 # ------------------------------------------------------ background host plane
@@ -107,8 +124,26 @@ class _HostPlane:
             return
         pool.submit(lambda: None).result()
 
+    def shutdown(self) -> None:
+        """Run every queued task to completion, then join the worker.
+
+        Registered with ``atexit`` so interpreter teardown cannot leak the
+        daemon worker mid-task: tasks queued at exit (a deep publish
+        pipeline, an unfenced lag-k ring) finish before the join instead of
+        being killed wherever the daemon thread happened to be. Idempotent,
+        and a later ``submit`` lazily builds a fresh pool — shutdown is a
+        drain point, not a poison pill.
+        """
+        with self._lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=True)
+
 
 _HOST_PLANE = _HostPlane()
+# interpreter teardown drains the plane instead of abandoning the daemon
+# worker with tasks still queued (see _HostPlane.shutdown)
+atexit.register(_HOST_PLANE.shutdown)
 
 
 def host_plane_submit(fn: Callable, *args: Any):
@@ -124,6 +159,90 @@ def host_plane_submit(fn: Callable, *args: Any):
 def drain_host_plane() -> None:
     """Barrier: block until every task submitted so far has finished."""
     _HOST_PLANE.drain()
+
+
+# ------------------------------------------------------ adaptive lag control
+class LagController:
+    """Feedback loop choosing a deferred-sync depth from the measured
+    fence-wait split.
+
+    The split ``bench.py --check-async`` reports (``async_fence_wait_ms`` vs
+    ``fenced_block_ms``) is exactly the signal a lag choice needs: how long
+    the caller actually BLOCKED on sync this step. The controller keeps an
+    EWMA of that blocking wait and turns it into a ring depth:
+
+    - **wait above ``free_ms``** — the gather is slower than the work the
+      current depth overlaps it with: DEEPEN one step toward ``max_lag``
+      (at lag 0 the observation is the synchronous plane's full blocking
+      gather; at lag k it is the oldest handle's fence wait).
+    - **wait at/below ``free_ms``** — the collective is effectively free at
+      this depth. After ``calm_steps`` consecutive calm observations the
+      depth SHALLOWS one step (hysteresis: a single fast gather must not
+      collapse a ring that a slow DCN will refill next step).
+
+    A metric opts in with ``sync_lag="auto"`` (``core.metric.Metric``): lag
+    0 — the synchronous plane, zero staleness — when sync is free, deeper
+    rings only when the (DCN) gather is actually slow. ``observe`` is the
+    whole feedback interface; ``lag`` is the current verdict.
+    """
+
+    def __init__(
+        self,
+        max_lag: int = MAX_SYNC_LAG,
+        free_ms: float = 1.0,
+        alpha: float = 0.5,
+        calm_steps: int = 16,
+    ) -> None:
+        if not (isinstance(max_lag, int) and 0 < max_lag <= MAX_SYNC_LAG):
+            raise ValueError(
+                f"`max_lag` must be an int in [1, {MAX_SYNC_LAG}] (the ring depth"
+                f" cap bounds the rendezvous pool), got {max_lag!r}"
+            )
+        if not free_ms > 0:
+            raise ValueError(f"`free_ms` must be > 0, got {free_ms!r}")
+        if not 0 < alpha <= 1:
+            raise ValueError(f"`alpha` must be in (0, 1], got {alpha!r}")
+        if not calm_steps >= 1:
+            raise ValueError(f"`calm_steps` must be >= 1, got {calm_steps!r}")
+        self.max_lag = max_lag
+        self.free_ms = float(free_ms)
+        self.alpha = float(alpha)
+        self.calm_steps = int(calm_steps)
+        self.lag = 0
+        self.wait_ms = 0.0  # EWMA of the measured blocking wait
+        self._calm = 0
+        self._observed = 0
+
+    def observe(self, wait_ms: float) -> int:
+        """Feed one measured blocking wait (ms); returns the updated lag.
+
+        At lag 0 callers feed the synchronous gather's wall time (the
+        ``fenced_block_ms`` analogue); at lag k the oldest handle's fence
+        wait (``async_fence_wait_ms``). Same unit, same meaning: host time
+        sync stole from the step.
+        """
+        wait_ms = float(wait_ms)
+        self._observed += 1
+        if self._observed == 1:
+            self.wait_ms = wait_ms
+        else:
+            self.wait_ms = self.alpha * wait_ms + (1.0 - self.alpha) * self.wait_ms
+        if self.wait_ms > self.free_ms:
+            self._calm = 0
+            if self.lag < self.max_lag:
+                self.lag += 1
+        else:
+            self._calm += 1
+            if self._calm >= self.calm_steps and self.lag > 0:
+                self.lag -= 1
+                self._calm = 0
+        return self.lag
+
+    def __repr__(self) -> str:
+        return (
+            f"LagController(lag={self.lag}, wait_ms={self.wait_ms:.3f},"
+            f" max_lag={self.max_lag}, free_ms={self.free_ms})"
+        )
 
 
 # ---------------------------------------------------------------- the future
@@ -225,6 +344,7 @@ def deferred_host_gather(
     guard: Optional[SyncGuard] = None,
     watermark: Optional[int] = None,
     label: str = "host_gather",
+    attrs: Optional[Dict[str, Any]] = None,
 ) -> SyncHandle:
     """Run the host sync plane in the background; returns a :class:`SyncHandle`.
 
@@ -236,19 +356,28 @@ def deferred_host_gather(
     task is the synchronous plane verbatim — deadline/retry/degrade,
     check_finite vetting, chaos injection at site ``host_gather``, packed
     payloads — only the thread it blocks changes.
+
+    ``attrs`` are extra span attributes stamped onto the ``deferred.dispatch``
+    span (the lag-k metric plane stamps its chosen depth here as
+    ``lag_controller``, so a trace shows WHY each dispatch happened at the
+    depth it did).
     """
     snapshot = dict(state)  # immutable leaves: holding the refs IS buffer A
     guard = guard if guard is not None else current_sync_guard()
 
     def task() -> Dict[str, Any]:
-        attrs = {"plane": label} if TRACE.enabled else None
-        with _span("deferred.complete", attrs):
+        task_attrs = {"plane": label} if TRACE.enabled else None
+        with _span("deferred.complete", task_attrs):
             out = host_gather(snapshot, reductions, gather_fn=gather_fn, guard=guard)
         record_deferred("completed")
         return out
 
-    attrs = {"plane": label} if TRACE.enabled else None
-    with _span("deferred.dispatch", attrs):
+    span_attrs = None
+    if TRACE.enabled:
+        span_attrs = {"plane": label}
+        if attrs:
+            span_attrs.update(attrs)
+    with _span("deferred.dispatch", span_attrs):
         future = _HOST_PLANE.submit(task)
     record_deferred("dispatched")
     return SyncHandle("host", future, watermark=watermark, label=label)
@@ -261,6 +390,19 @@ def deferred_host_gather(
 _PROGRAM_CACHE: Dict[Any, Any] = {}
 _PROGRAM_CACHE_MAX = 64
 _PROGRAM_LOCK = threading.Lock()
+
+
+def clear_program_cache() -> None:
+    """Drop every cached deferred sync program (forces a retrace).
+
+    The cache is keyed by (mesh, axis, state schema), so two planes over the
+    same schema share one compiled program — which also means the second
+    plane stages ZERO new collectives. A staged-collective capture that
+    wants to re-count the program (``bench.py``'s lag-depth counters, tests)
+    clears first.
+    """
+    with _PROGRAM_LOCK:
+        _PROGRAM_CACHE.clear()
 
 
 def _fx_key(fx: ReduceFx, pins: list) -> Any:

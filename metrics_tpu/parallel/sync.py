@@ -930,6 +930,7 @@ def host_gather(
     slice_leaders: Optional[HostHierarchy] = None,
     guard: Optional[SyncGuard] = None,
     overflow: Optional[str] = None,
+    timer: Optional[Callable[[float], None]] = None,
 ) -> Dict[str, Any]:
     """Host-plane sync of a state dict, reproducing reference ``_sync_dist``
     semantics (metric.py:179-197): gather every array, stack tensor states /
@@ -965,6 +966,13 @@ def host_gather(
     ``overflow`` is the PaddedBuffer overflow policy for gathered counts
     (``error``/``warn_drop``; default: the process-wide
     ``parallel.buffer.set_overflow_policy`` setting).
+
+    ``timer`` receives the wall milliseconds the gather calls themselves
+    blocked this thread (guard retries/backoff included, the pre/post
+    reduction arithmetic excluded) — the ``fenced_block_ms`` measurement at
+    its source. The adaptive lag controller
+    (:class:`~metrics_tpu.parallel.deferred.LagController`) feeds on it to
+    decide whether the synchronous plane is effectively free.
     """
     if gather_fn is None and slice_leaders is not None:
         gather_fn = slice_leader_gather(slice_leaders)
@@ -1007,10 +1015,13 @@ def host_gather(
 
     # packability is a property of the ORIGINAL gather fn; the guard wrapper
     # transports values unchanged, so it inherits the verdict
+    t0 = time.perf_counter() if timer is not None else 0.0
     if is_packable_gather(gather_fn):
         gathered_units = _packed_gather_units(units, plane_fn)
     else:
         gathered_units = [plane_fn(u) for u in units]
+    if timer is not None:
+        timer((time.perf_counter() - t0) * 1e3)
 
     if plane["degraded"]:
         record_fault("degraded_computes")

@@ -71,7 +71,11 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from metrics_tpu.core.metric import Metric
-from metrics_tpu.observability.counters import COUNTERS as _COUNTERS, record_service_health
+from metrics_tpu.observability.counters import (
+    COUNTERS as _COUNTERS,
+    record_deferred_depth,
+    record_service_health,
+)
 from metrics_tpu.observability.trace import TRACE as _TRACE, span as _span
 from metrics_tpu.parallel.deferred import host_plane_submit
 from metrics_tpu.parallel.sync import SyncGuard, set_sync_guard
@@ -353,6 +357,11 @@ class MetricService:
             self._pending_publishes.append(
                 host_plane_submit(self._deferred_publish_task, snap, window, book)
             )
+            depth = len(self._pending_publishes)
+        # the publish pipeline's depth gauge: how many window publishes are
+        # in flight behind ingest right now (and, via the counters' high-water
+        # mark, how deep the pipeline ever ran)
+        record_deferred_depth(self.label, depth)
 
     def _publish_book(self) -> Dict[str, Any]:
         """Close-point bookkeeping, captured on the worker thread so the
@@ -436,12 +445,14 @@ class MetricService:
         while True:
             with self._pub_lock:
                 if not self._pending_publishes:
+                    record_deferred_depth(self.label, 0)
                     return
                 fut = self._pending_publishes[0]
             fut.result(max(deadline - time.monotonic(), 0.001))
             with self._pub_lock:
                 if self._pending_publishes and self._pending_publishes[0] is fut:
                     self._pending_publishes.pop(0)
+                record_deferred_depth(self.label, len(self._pending_publishes))
 
     def _note_health(self) -> None:
         record_service_health(

@@ -332,6 +332,104 @@ def test_grouped_sync_with_packable_gather_packs_each_plane():
     assert packed_gather.calls == 2
 
 
+# ------------------------------------------------- deferred epoch gather
+def _two_group_collection(gather):
+    """Two compute groups (2x Accuracy, 2x F1) sharing one counted gather."""
+    return MetricCollection(
+        {
+            "acc_a": Accuracy(dist_sync_fn=gather),
+            "acc_b": Accuracy(dist_sync_fn=gather),
+            "f1_a": F1(num_classes=4, average="macro", dist_sync_fn=gather),
+            "f1_b": F1(num_classes=4, average="macro", dist_sync_fn=gather),
+        }
+    )
+
+
+def test_epoch_sync_deferred_matches_synchronous_with_same_calls():
+    """The DEFERRED ``_grouped_host_sync`` form (the default) publishes
+    bit-exactly the synchronous form's values with the identical per-group
+    gather-call count — only the epoch's critical path moves."""
+    rng = np.random.RandomState(31)
+    preds, target = _data(rng)
+
+    deferred_gather, sync_gather = _CountingGather(), _CountingGather()
+    col_def = _two_group_collection(deferred_gather)
+    col_sync = _two_group_collection(sync_gather)
+    col_sync.deferred_epoch_sync = False
+    col_def(preds, target)
+    col_sync(preds, target)
+
+    _assert_same(col_def.compute(), col_sync.compute())
+    # one gather plane per group either way: Accuracy group (2 leaves) +
+    # F1 group (4 leaves) = 6 calls — deferral moves the fence, not a call
+    assert deferred_gather.calls == sync_gather.calls == 6
+
+
+def test_epoch_sync_dispatches_every_group_before_first_resolve():
+    """The overlap evidence: BOTH groups' gathers are in flight before the
+    first group's members compute — the ``deferred_depth`` high-water mark
+    for the collection's epoch pipeline equals the group count, and the
+    pipeline is empty again when ``compute`` returns."""
+    rng = np.random.RandomState(32)
+    preds, target = _data(rng)
+    col = _two_group_collection(_CountingGather())
+    col(preds, target)
+
+    obs.enable()
+    obs.reset()
+    col.compute()
+    snap = obs.counters_snapshot()
+    obs.disable()
+    depth = snap["deferred_depth"]["MetricCollection.epoch"]
+    assert depth["max"] == 2  # both group gathers dispatched before any read
+    assert depth["current"] == 0  # every handle resolved before returning
+
+
+def test_epoch_sync_deferred_flag_restores_synchronous_plane():
+    """``deferred_epoch_sync=False`` is the escape hatch: no handles, no
+    background dispatch — the epoch gathers run on the calling thread."""
+    rng = np.random.RandomState(33)
+    preds, target = _data(rng)
+    col = _two_group_collection(_CountingGather())
+    col.deferred_epoch_sync = False
+    col(preds, target)
+
+    obs.enable()
+    obs.reset()
+    col.compute()
+    snap = obs.counters_snapshot()
+    obs.disable()
+    assert "MetricCollection.epoch" not in snap["deferred_depth"]
+    assert snap["deferred"]["dispatched"] == 0
+
+
+@pytest.mark.chaos
+def test_epoch_sync_deferred_degrades_without_stalling():
+    """Chaos through the deferred epoch plane: a persistent drop under a
+    degrade guard latches every group to local-only state — the epoch
+    compute finishes (bounded, never wedged) with the unsynced values."""
+    from metrics_tpu.parallel import faults
+    from metrics_tpu.parallel.sync import SyncGuard, gather_all_arrays, set_sync_guard
+
+    rng = np.random.RandomState(34)
+    preds, target = _data(rng)
+    col = _two_group_collection(gather_all_arrays)
+    col(preds, target)
+    local = _two_group_collection(None)  # no gather: pure local values
+    local(preds, target)
+
+    guard = SyncGuard(deadline_s=0.3, max_retries=1, backoff_s=0.01, policy="degrade")
+    old = set_sync_guard(guard)
+    try:
+        with faults.ChaosInjector(
+            [faults.FaultSpec(kind="drop", rate=1.0, times=100_000)], seed=0
+        ):
+            values = col.compute()
+    finally:
+        set_sync_guard(old)
+    _assert_same(values, local.compute())
+
+
 def test_clone_starts_conservative_until_reset():
     """Lockstep is identity-based, so a clone cannot inherit it: members with
     accumulated state start diverged (correct, just unshared) and a

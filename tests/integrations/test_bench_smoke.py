@@ -116,6 +116,17 @@ def _assert_headline_schema(out):
     assert out["async_sync_bytes"] == 520  # the grouped sum bucket
     assert out["async_gather_calls"] == 0  # psum-only: same program, deferred fence
 
+    # the lag-k ring rides the line too: deeper rings replay the IDENTICAL
+    # staged program (depth is in-flight handles, never extra collectives),
+    # and the deferred epoch gather issues exactly the synchronous grouped
+    # plane's per-group call count (2 groups -> 2 packed gather calls)
+    for key in ("async_lag2_ms", "async_lag3_ms"):
+        assert isinstance(out[key], (int, float)) and out[key] > 0, key
+    assert out["async_lag_collective_calls"] == out["async_collective_calls"]
+    assert out["async_lag_sync_bytes"] == out["async_sync_bytes"]
+    assert out["async_lag_epoch_gather_calls"] == 2
+    assert out["async_lag_epoch_gather_calls"] == out["async_lag_epoch_sync_gather_calls"]
+
     # the traffic-generator scenario: sustained batches/sec through a real
     # MetricService ingest loop (deferred window publishes included)
     assert isinstance(out["service_ingest_steps_per_s"], (int, float))
@@ -144,14 +155,16 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     out = _run_smoke(("--trace", str(trace_file)))
     _assert_headline_schema(out)
 
-    # schema version of the --trace payload: v7 added the deferred-sync A/B
-    # (async_* staged-count keys + the fenced twin +
-    # service_ingest_steps_per_s on the default line, full async counters
-    # here incl. the deferred dispatch/fence/completion block); v6 added the
-    # windowed serving A/B; v5 the keyed slab A/B; v4 the sketch A/B; v3
-    # moved the collective counts to the default line and added the
+    # schema version of the --trace payload: v8 added the lag-k pipelined
+    # plane (async_lag2/3_ms ring-depth keys, async_lag_* staged-count pins,
+    # and the deferred-epoch-gather call-count pair on the default line); v7
+    # added the deferred-sync A/B (async_* staged-count keys + the fenced
+    # twin + service_ingest_steps_per_s on the default line, full async
+    # counters here incl. the deferred dispatch/fence/completion block); v6
+    # added the windowed serving A/B; v5 the keyed slab A/B; v4 the sketch
+    # A/B; v3 moved the collective counts to the default line and added the
     # hierarchical A/B + per-crossing counters; bump this pin with the schema
-    assert out["trace_schema"] == 7
+    assert out["trace_schema"] == 8
     # the sketch program's full snapshot: psum-only, no gather kinds staged
     sketch_kinds = out["sketch_counters"]["calls_by_kind"]
     assert sketch_kinds.get("psum", 0) == 2
@@ -348,11 +361,15 @@ def test_bench_check_async_gate():
     """``bench.py --check-async`` is the deferred-sync gate: the deferred
     plane must stage the IDENTICAL collective count and kinds as the
     synchronous plane (zero new kinds — it dispatches the same
-    ``coalesced_sync_state`` program), ``sync_lag=1`` forward values must be
-    bit-exact the synchronous plane's previous-step values with an exact
-    epoch compute, and the async step ms must come in strictly below the
-    fenced synchronous step ms on the sync8 scenario (the overlap the
-    deferred dispatch exists to buy)."""
+    ``coalesced_sync_state`` program), ``sync_lag=k`` forward values must be
+    bit-exact the synchronous plane's k-steps-back values for k in {1,2,3}
+    with an exact epoch compute, wall time must be monotone non-increasing
+    in lag depth under the bursty simulated-DCN gather, ``sync_lag="auto"``
+    must pick lag 0 on the free collective and lag >= 1 under the slow one,
+    the deferred epoch gather must match the synchronous grouped plane
+    bit-exactly at the identical gather-call count, and the async step ms
+    must come in strictly below the fenced synchronous step ms on the sync8
+    scenario (the overlap the deferred dispatch exists to buy)."""
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.run(
@@ -368,8 +385,19 @@ def test_bench_check_async_gate():
     assert out["parity"]["async_bytes"] == out["parity"]["sync_bytes"]
     # the compiling first step dispatched and fenced exactly one handle
     assert out["parity"]["async_deferred"]["dispatched"] == out["parity"]["async_deferred"]["fenced"]
-    # lag: the reported per-step series IS the synchronous series shifted by 1
-    assert out["lag"]["lag_vals"][1:] == out["lag"]["sync_vals"][:-1]
+    # lag: every reported per-step series IS the synchronous series shifted
+    # by its ring depth (warm-up steps read the local == synced delta)
+    for k_str, series in out["lag"]["lag_vals"].items():
+        k = int(k_str)
+        assert series[k:] == out["lag"]["sync_vals"][:-k], k_str
+    # monotone: deeper rings never slower under the bursty DCN simulation
+    sweep = out["lag_sweep"]["ms_by_lag"]
+    assert sweep["3"] <= sweep["2"] <= sweep["1"]
+    # auto: free collective -> lag 0; slow gather -> lag >= 1
+    assert out["auto"]["free_lag"] == 0
+    assert out["auto"]["slow_lag"] >= 1
+    # epoch: the deferred grouped gather costs exactly the synchronous count
+    assert out["epoch_gather"]["deferred_calls"] == out["epoch_gather"]["sync_calls"]
     # overlap: the sync_lag=1 forward loop beats the synchronous plane under
     # the simulated-DCN gather, and on the device plane the deferred fence
     # waits less host time than the synchronous block (the hidden wait)

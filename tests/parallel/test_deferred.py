@@ -180,11 +180,11 @@ def test_sync_lag_epoch_compute_drains_and_matches():
     for b in batches:
         sync_m(*b)
         lag_m(*b)
-    assert lag_m._deferred_handle is not None  # the last step's gather in flight
+    assert len(lag_m._handle_ring) == 1  # the last step's gather in flight
     # the accumulated state never lags: epoch compute is exact, and the
-    # synchronous epoch sync drained the in-flight handle first
+    # synchronous epoch sync drained the in-flight ring first
     assert np.array_equal(np.asarray(_within(lag_m.compute)), np.asarray(sync_m.compute()))
-    assert lag_m._deferred_handle is None
+    assert not lag_m._handle_ring
 
 
 def test_sync_lag_snapshot_restore_with_inflight_handle():
@@ -194,13 +194,13 @@ def test_sync_lag_snapshot_restore_with_inflight_handle():
     m.persistent(True)
     for b in batches:
         m(*b)
-    handle = m._deferred_handle
+    handle = m._handle_ring[0]
     assert handle is not None
     snap = m.state_dict()  # checkpoint with the gather still in flight
     fresh = Accuracy(dist_sync_on_step=True, dist_sync_fn=gather_all_arrays)
     fresh.sync_lag = 1
     fresh.load_state_dict(snap)
-    assert fresh._deferred_handle is None  # handles never travel
+    assert not fresh._handle_ring  # handles never travel
     assert fresh.epoch_watermark == m.epoch_watermark
     assert np.array_equal(np.asarray(_within(fresh.compute)), np.asarray(_within(m.compute)))
     _within(handle.result)  # the in-flight gather still completes (entry order)
@@ -210,11 +210,11 @@ def test_sync_lag_reset_and_clone_drop_handles():
     m = Accuracy(dist_sync_on_step=True, dist_sync_fn=gather_all_arrays)
     m.sync_lag = 1
     m(*_batches(1)[0])
-    assert m._deferred_handle is not None
+    assert len(m._handle_ring) == 1
     twin = m.clone()
-    assert twin._deferred_handle is None  # live futures never deepcopy
+    assert not twin._handle_ring  # live futures never deepcopy
     m.reset()
-    assert m._deferred_handle is None
+    assert not m._handle_ring
 
 
 def test_sync_lag_validation():
@@ -231,11 +231,26 @@ def test_sync_lag_validation():
         def compute(self):
             return self.n
 
+    from metrics_tpu.parallel.deferred import MAX_SYNC_LAG
+
     with pytest.raises(ValueError, match="sync_lag"):
-        _Toy(sync_lag=2)  # out-of-range lag
+        _Toy(sync_lag=MAX_SYNC_LAG + 1)  # beyond the bounded ring's cap
+    with pytest.raises(ValueError, match="sync_lag"):
+        _Toy(sync_lag=-1)
+    with pytest.raises(ValueError, match="sync_lag"):
+        _Toy(sync_lag=1.5)  # only ints and "auto"
     with pytest.raises(ValueError, match="dist_sync_on_step"):
         _Toy(sync_lag=1)  # lag without per-step sync
-    _Toy(sync_lag=1, dist_sync_on_step=True)  # the valid opt-in
+    with pytest.raises(ValueError, match="dist_sync_on_step"):
+        _Toy(sync_lag="auto")  # auto is a deferral mode too
+    _Toy(sync_lag=1, dist_sync_on_step=True)  # the valid opt-ins
+    _Toy(sync_lag=MAX_SYNC_LAG, dist_sync_on_step=True)
+    _Toy(sync_lag="auto", dist_sync_on_step=True)
+    # the attribute-set convention validates at first use, equally loudly
+    bad = _Toy(dist_sync_on_step=True, dist_sync_fn=gather_all_arrays)
+    bad.sync_lag = MAX_SYNC_LAG + 1
+    with pytest.raises(ValueError, match="sync_lag"):
+        bad(_batches(1)[0][0])
 
 
 def test_sync_lag_members_excluded_from_shared_step_gather():
@@ -253,6 +268,234 @@ def test_sync_lag_members_excluded_from_shared_step_gather():
         assert np.array_equal(np.asarray(vals[i]["b"]), np.asarray(vals[i - 1]["a"]))
         assert np.array_equal(np.asarray(vals[i]["a"]), np.asarray(vals[i]["a"]))
     _within(col.compute)
+
+
+# ------------------------------------------------------------ lag-k ring reads
+@pytest.mark.parametrize("k", [2, 3])
+def test_sync_lag_k_forward_reads_k_steps_back(k):
+    """The lag-k contract: step i (i >= k) returns BIT-EXACTLY what the
+    synchronous plane returned at step i - k; warm-up steps read the local
+    delta (== the synced delta on one process)."""
+    batches = _batches(k + 4, seed=40 + k)
+    sync_m = Accuracy(dist_sync_on_step=True, dist_sync_fn=gather_all_arrays)
+    lag_m = Accuracy(dist_sync_on_step=True, dist_sync_fn=gather_all_arrays)
+    lag_m.sync_lag = k
+    sync_vals = [np.asarray(sync_m(*b)) for b in batches]
+    lag_vals = [np.asarray(lag_m(*b)) for b in batches]
+    for i in range(len(batches)):
+        expect = sync_vals[i - k] if i >= k else sync_vals[i]
+        assert np.array_equal(lag_vals[i], expect), (k, i)
+    assert len(lag_m._handle_ring) == k
+    # the epoch compute never lags and drains the whole ring
+    assert np.array_equal(np.asarray(_within(lag_m.compute)), np.asarray(sync_m.compute()))
+    assert not lag_m._handle_ring
+
+
+def test_sync_lag_ring_holds_watermarks_in_entry_order():
+    """The ring is oldest-first: handle watermarks are strictly increasing,
+    and the epoch drain resolves them in exactly that order."""
+    batches = _batches(5, seed=44)
+    m = Accuracy(dist_sync_on_step=True, dist_sync_fn=gather_all_arrays)
+    m.sync_lag = 3
+    for b in batches:
+        m(*b)
+    marks = [h.watermark for h in m._handle_ring]
+    assert marks == sorted(marks) and len(set(marks)) == len(marks) == 3
+    _within(m.compute)
+    assert not m._handle_ring
+
+
+def test_sync_lag_ring_overflow_resolves_oldest():
+    """Shrinking the lag mid-stream overflows the ring: the NEXT forward
+    resolves every handle beyond the new depth, oldest first, and reads the
+    freshest resolved view (the new-depth-lagged synchronous value)."""
+    batches = _batches(6, seed=45)
+    sync_m = Accuracy(dist_sync_on_step=True, dist_sync_fn=gather_all_arrays)
+    sync_vals = [np.asarray(sync_m(*b)) for b in batches]
+    m = Accuracy(dist_sync_on_step=True, dist_sync_fn=gather_all_arrays)
+    m.sync_lag = 3
+    for b in batches[:4]:
+        m(*b)
+    assert len(m._handle_ring) == 3
+    m.sync_lag = 1  # shrink: the depth-3 ring is now two handles too deep
+    val = np.asarray(_within(lambda: m(*batches[4])))
+    # three pops (ring 4 -> 1): the newest resolved view is step 3's gather,
+    # i.e. the synchronous plane's step-3 value — the documented 1-step lag
+    assert len(m._handle_ring) == 1
+    assert np.array_equal(val, sync_vals[3])
+    # and the stream keeps moving at the new depth
+    assert np.array_equal(np.asarray(_within(lambda: m(*batches[5]))), sync_vals[4])
+    assert np.array_equal(np.asarray(_within(m.compute)), np.asarray(sync_m.compute()))
+
+
+def test_sync_lag_pickle_and_deepcopy_round_trip_with_inflight_handles():
+    """The satellite contract: a pickle/deepcopy taken WITH handles in
+    flight never carries them — the restored metric starts with an empty
+    ring and a fresh controller, and its epoch compute matches exactly."""
+    import pickle
+    from copy import deepcopy as _deepcopy
+
+    batches = _batches(5, seed=46)
+    m = Accuracy(dist_sync_on_step=True, dist_sync_fn=gather_all_arrays)
+    m.sync_lag = 2
+    m.persistent(True)
+    for b in batches:
+        m(*b)
+    assert len(m._handle_ring) == 2  # in flight at copy time
+
+    twin = _deepcopy(m)
+    assert not twin._handle_ring and twin._lag_controller is None
+    back = pickle.loads(pickle.dumps(m))
+    assert not back._handle_ring and back._lag_controller is None
+    expected = np.asarray(_within(m.compute))
+    assert np.array_equal(np.asarray(_within(twin.compute)), expected)
+    assert np.array_equal(np.asarray(_within(back.compute)), expected)
+
+
+def test_setstate_drops_any_smuggled_handle_ring():
+    """``__setstate__`` must also drop a lag-k ring (and the legacy
+    single-handle slot) that a foreign ``__dict__`` carried in."""
+    from collections import deque
+
+    m = Accuracy(dist_sync_on_step=True, dist_sync_fn=gather_all_arrays)
+    m.sync_lag = 2
+    state = m.__getstate__()
+    state["_handle_ring"] = deque([object(), object()])
+    state["_deferred_handle"] = object()
+    state["_lag_controller"] = object()
+    fresh = Accuracy.__new__(Accuracy)
+    fresh.__setstate__(state)
+    assert isinstance(fresh._handle_ring, deque) and not fresh._handle_ring
+    assert fresh._lag_controller is None
+    assert "_deferred_handle" not in fresh.__dict__
+
+
+def test_sync_lag_ring_depth_gauge():
+    """Every deferring forward refreshes the per-label ``deferred_depth``
+    gauge: current == the ring's steady depth, max == its high-water mark."""
+    batches = _batches(5, seed=47)
+    m = Accuracy(dist_sync_on_step=True, dist_sync_fn=gather_all_arrays)
+    m.sync_lag = 2
+    obs.enable()
+    obs_counters.COUNTERS.reset()
+    for b in batches:
+        m(*b)
+    snap = obs_counters.snapshot()
+    obs.disable()
+    assert snap["deferred_depth"]["Accuracy"] == {"current": 2, "max": 2}
+    _within(m.compute)
+
+
+# ------------------------------------------------------- the adaptive lag loop
+def test_lag_controller_deepens_and_shallows_with_hysteresis():
+    from metrics_tpu.parallel.deferred import LagController, MAX_SYNC_LAG
+
+    c = LagController(max_lag=3, free_ms=1.0, alpha=1.0, calm_steps=2)
+    assert c.lag == 0
+    assert c.observe(5.0) == 1  # blocking wait: deepen
+    assert c.observe(5.0) == 2
+    assert c.observe(5.0) == 3
+    assert c.observe(5.0) == 3  # capped at max_lag
+    assert c.observe(0.1) == 3  # one calm step: hysteresis holds the depth
+    assert c.observe(0.1) == 2  # calm streak reached: shallow one level
+    assert c.observe(0.1) == 2
+    assert c.observe(0.1) == 1
+
+    with pytest.raises(ValueError, match="max_lag"):
+        LagController(max_lag=MAX_SYNC_LAG + 1)
+    with pytest.raises(ValueError, match="max_lag"):
+        LagController(max_lag=0)
+    with pytest.raises(ValueError, match="free_ms"):
+        LagController(free_ms=0.0)
+
+
+def test_sync_lag_auto_stays_synchronous_on_free_gather():
+    """``sync_lag="auto"`` over a fast gather keeps lag 0: bit-exact
+    synchronous values, an empty ring, zero staleness."""
+    batches = _batches(6, seed=48)
+    sync_m = Accuracy(dist_sync_on_step=True, dist_sync_fn=gather_all_arrays)
+    auto_m = Accuracy(dist_sync_on_step=True, dist_sync_fn=gather_all_arrays)
+    auto_m.sync_lag = "auto"
+    for b in batches:
+        assert np.array_equal(np.asarray(auto_m(*b)), np.asarray(sync_m(*b)))
+    assert auto_m._lag_controller is not None
+    assert auto_m._lag_controller.lag == 0
+    assert not auto_m._handle_ring
+
+
+def test_sync_lag_auto_deepens_under_slow_gather():
+    """``sync_lag="auto"`` over a slow (simulated-DCN) gather deepens the
+    ring: the controller's verdict goes >= 1 and forwards start deferring."""
+    from metrics_tpu.parallel.sync import packable_gather
+
+    @packable_gather
+    def slow_gather(value):
+        time.sleep(0.005)
+        return [value]
+
+    batches = _batches(6, seed=49)
+    m = Accuracy(dist_sync_on_step=True, dist_sync_fn=slow_gather)
+    m.sync_lag = "auto"
+    _within(lambda: [m(*b) for b in batches], timeout_s=20.0)
+    assert m._lag_controller.lag >= 1
+    assert len(m._handle_ring) >= 1
+    _within(m._drain_handle_ring, timeout_s=10.0)
+
+
+# ------------------------------------------------ host-plane shutdown / atexit
+def test_host_plane_shutdown_joins_queued_tasks_then_recovers():
+    """The deterministic-shutdown contract: ``shutdown()`` (the atexit hook)
+    runs every queued task to completion and joins the worker — no daemon
+    thread abandoned mid-task — and a later submit lazily rebuilds the pool."""
+    from metrics_tpu.parallel import deferred as dmod
+
+    done = []
+
+    def slow_task():
+        time.sleep(0.05)
+        done.append(1)
+
+    dmod.host_plane_submit(slow_task)
+    dmod.host_plane_submit(slow_task)
+    dmod._HOST_PLANE.shutdown()
+    assert done == [1, 1]  # both queued tasks ran before the join
+    dmod._HOST_PLANE.shutdown()  # idempotent
+    dmod.drain_host_plane()  # no pool: an immediate no-op
+    fut = dmod.host_plane_submit(lambda: 42)  # shutdown is not a poison pill
+    assert fut.result(timeout=5.0) == 42
+
+
+# --------------------------------------------- chaos through the depth-3 ring
+@pytest.mark.chaos
+def test_chaos_matrix_through_depth3_ring_without_deadlock():
+    """The chaos matrix (transient drop + stall + corrupt) through a depth-3
+    ring: the stream advances every step (bounded by the deadline guard,
+    never wedged), the epoch drain completes, and the retry evidence lands."""
+    batches = _batches(8, seed=50)
+    m = Accuracy(dist_sync_on_step=True, dist_sync_fn=gather_all_arrays)
+    m.sync_lag = 3
+    guard = SyncGuard(deadline_s=0.5, max_retries=2, backoff_s=0.01, policy="degrade")
+    from metrics_tpu.parallel.sync import set_sync_guard
+
+    before = obs_counters.COUNTERS.faults["sync_retries"]
+    old = set_sync_guard(guard)
+    try:
+        with faults.ChaosInjector(
+            [
+                faults.FaultSpec(kind="drop", call=1, times=1),
+                faults.FaultSpec(kind="stall", call=3, times=1, duration_s=0.2),
+                faults.FaultSpec(kind="corrupt", call=5, times=1),
+            ],
+            seed=0,
+        ):
+            vals = _within(lambda: [np.asarray(m(*b)) for b in batches], timeout_s=25.0)
+            # drain INSIDE the injector scope so degraded/retried completions
+            # cannot leak fault counters into later tests
+            _within(m._drain_handle_ring, timeout_s=10.0)
+    finally:
+        set_sync_guard(old)
+    assert len(vals) == len(batches)  # every step returned: no deadlock
+    assert obs_counters.COUNTERS.faults["sync_retries"] > before
 
 
 # ------------------------------------------------- deferred in-jit sync plane
@@ -463,9 +706,9 @@ def test_sync_lag_under_persistent_drop_latches_degrade_without_stall():
             start = time.perf_counter()
             vals = _within(lambda: [np.asarray(m(*b)) for b in batches], timeout_s=20.0)
             elapsed = time.perf_counter() - start
-            # resolve the last step's in-flight handle INSIDE the injector
+            # resolve the last step's in-flight ring INSIDE the injector
             # scope: its degraded completion must not leak into later tests
-            _within(m._deferred_handle.result, timeout_s=10.0)
+            _within(m._drain_handle_ring, timeout_s=10.0)
     finally:
         set_sync_guard(old)
     # degraded gathers return the local snapshot: the lagged read is the

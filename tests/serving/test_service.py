@@ -356,6 +356,59 @@ def test_flush_is_a_barrier_over_the_publish_pipeline():
         svc.finalize()
 
 
+def test_publish_pipeline_depth_gauge():
+    """The service's deferred publish pipeline reports its depth through the
+    per-label ``deferred_depth`` gauge: a slow publish-time sync backs the
+    pipeline up (max >= 1) and a flushed service reads depth 0."""
+    from metrics_tpu.parallel.sync import packable_gather
+
+    @packable_gather
+    def slow_gather(value):
+        time.sleep(0.05)
+        return [value]
+
+    batches = _batches(10, seed=8)
+    obs.enable()
+    obs.reset()
+    try:
+        with MetricService(_metric(dist_sync_fn=slow_gather), label="svc-depth") as svc:
+            _feed(svc, batches)
+            svc.flush()
+        snap = obs.counters_snapshot()
+    finally:
+        obs.disable()
+    depth = snap["deferred_depth"]["svc-depth"]
+    assert depth["max"] >= 1  # the pipeline actually ran deep
+    assert depth["current"] == 0  # and the flush barrier drained it
+
+
+def test_stop_with_deep_publish_pipeline_leaves_no_pending_handles():
+    """The deterministic-shutdown satellite: stopping a service whose
+    publish pipeline is several windows deep drains every in-flight publish
+    — no pending handles, every closed window published, background plane
+    empty."""
+    from metrics_tpu.parallel.deferred import drain_host_plane
+    from metrics_tpu.parallel.sync import packable_gather
+
+    @packable_gather
+    def slow_gather(value):
+        time.sleep(0.05)
+        return [value]
+
+    batches = _batches(12, seed=9)
+    svc = MetricService(_metric(dist_sync_fn=slow_gather))
+    _feed(svc, batches)
+    svc.stop()
+    assert svc._pending_publishes == []  # no pending handles after stop
+    windows = [p["window"] for p in svc.publications]
+    assert windows == sorted(windows) and len(windows) >= 2
+    assert svc.last_snapshot is not None
+    assert svc.last_snapshot["published_through"] == windows[-1]
+    start = time.perf_counter()
+    drain_host_plane()  # the plane itself is idle too
+    assert time.perf_counter() - start < 1.0
+
+
 def test_publish_emits_per_window_spans():
     """Every publish emits one ``service.publish`` span stamped window=,
     degraded=, queue_depth, and deferred= (the per-window Perfetto view)."""
