@@ -51,6 +51,12 @@ bytes strictly below the flat plane's world-axis bytes (the smoke test
 runs it in tier-1, so a silently added or reflattened collective fails CI
 even when ms noise hides it).
 
+``--check-fleet`` is the sharded-serving gate: the ``MetricFleet`` merged
+output must be bit-exact vs a single-process oracle at shard counts
+{1, 2, 8}, 8-shard ingest throughput must reach 4x the 1-shard loop over
+the simulated per-batch serving work, and a seeded shard-kill chaos soak
+must recover with zero lost windows and no double-published merged window.
+
 ``--trace OUT.json`` (composable with ``--smoke``) enables the observability
 subsystem around the A/B: the JSON line grows a ``phase_ms`` span-aggregate
 table, and OUT.json gets a Chrome-trace/Perfetto file of the bench phases
@@ -117,6 +123,28 @@ KEYED_BINS = 16
 # rotation, never a new collective.
 SERVICE_WINDOWS = 4
 SERVICE_WINDOW_S = 60.0
+# sharded serving fleet scenario (serving/fleet.py): N MetricService ingest
+# shards behind the stable-hash router, merged by pure state addition as
+# windows close. The pinned properties: merged output BIT-EXACT vs a
+# single-process oracle at every shard count, and ingest throughput scaling
+# near-linearly with shard count. The CI host is a single core, so the
+# per-batch serving work the shards overlap is SIMULATED with a seeded
+# ingest_stall at the fleet.shard chaos site (same convention as
+# --check-async's simulated-DCN gather: sleeps overlap perfectly, so the
+# measured ratio isolates the fleet's routing/queueing scalability).
+FLEET_SHARDS = 8
+FLEET_WINDOW_S = 10.0
+FLEET_WINDOWS = 4
+FLEET_LATENESS_S = 20.0
+FLEET_EXACT_BATCHES = 24  # the bit-exact merge stream (per shard count)
+FLEET_EXACT_BATCH = 16
+FLEET_SCALE_BATCHES = 48  # the scaling stream (1 vs 8 shards)
+FLEET_SCALE_BATCH = 8
+FLEET_WORK_S = 0.15  # simulated per-batch serving work (the overlap target)
+FLEET_SCALING_MIN_X = 4.0  # the gate: 8-shard >= 4x 1-shard throughput
+FLEET_KILL_SHARDS = 4  # chaos soak topology
+FLEET_KILL_CALL = 4  # the killed shard's ingest call (past its first publish)
+FLEET_SOAK_BUDGET_S = 120.0
 
 
 def _collection_ours(compute_groups: bool = True):
@@ -847,6 +875,20 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
     with (obs.span("bench.service_ingest") if obs else _null_cm()):
         ingest_steps_per_s = _bench_service_ingest()
 
+    # the sharded fleet: ingest throughput at 1 vs 8 shards under the
+    # simulated per-batch serving work (the scaling headline --check-fleet
+    # gates at >= 4x), plus the merge tier's deterministic window counts
+    # over the exact stream (lost windows pinned at zero)
+    with (obs.span("bench.fleet_ingest") if obs else _null_cm()):
+        fleet_sps_1 = _bench_fleet_ingest(1)
+        fleet_sps_8 = _bench_fleet_ingest(FLEET_SHARDS)
+    with (obs.span("bench.fleet_merge") if obs else _null_cm()):
+        fleet_batches = _fleet_stream(FLEET_EXACT_BATCHES, FLEET_EXACT_BATCH)
+        fleet_run = _drive_fleet(fleet_batches, FLEET_SHARDS)
+        fleet_oracle = _fleet_oracle(fleet_batches)
+        fleet_merged = len({r["window"] for r in fleet_run["records"]})
+        fleet_lost = len(fleet_oracle["published"]) - fleet_merged
+
     out = {
         "grouped_sync8_ms": grouped_ms,
         "ungrouped_sync8_ms": ungrouped_ms,
@@ -934,6 +976,15 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
         "async_lag_epoch_sync_gather_calls": epoch_calls_sync,
         # serving ingest throughput (batches/sec through a real service loop)
         "service_ingest_steps_per_s": round(ingest_steps_per_s, 3),
+        # the sharded fleet's scaling pair + merge-tier counts: throughput
+        # keys are rate-gated by --check-trajectory (may not collapse),
+        # window counts are exact pins, lost windows bind at ZERO
+        "fleet_ingest_steps_per_s": round(fleet_sps_8, 3),
+        "fleet_ingest_steps_per_s_1shard": round(fleet_sps_1, 3),
+        "fleet_scaling_x": round(fleet_sps_8 / max(fleet_sps_1, 1e-9), 3),
+        "fleet_shards_merged_windows": fleet_merged,
+        "fleet_shards_published_windows": fleet_run["published"],
+        "fleet_lost_windows": fleet_lost,
         # slab drop evidence rides the default line pinned at ZERO (in-window
         # traffic never drops; the --check-service chaos soak pins nonzero)
         "slab_dropped_samples": service_counters.get("slab_dropped_samples", 0),
@@ -957,8 +1008,11 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
             finally:
                 devtime_mod.disable()
 
-        # v8: the lag-k pipelined plane joined (async_lag2/3_ms ring-depth
-        # keys, async_lag_* staged-count pins, and the deferred-epoch-gather
+        # v9: the sharded fleet joined (fleet_ingest_steps_per_s at 1/8
+        # shards + fleet_scaling_x + the merge tier's window counts with
+        # fleet_lost_windows pinned at zero on the default line); v8 added
+        # the lag-k pipelined plane (async_lag2/3_ms ring-depth keys,
+        # async_lag_* staged-count pins, and the deferred-epoch-gather
         # call-count pair on the default line); v7 added the deferred-sync
         # A/B (async_* staged-count keys + fenced twin +
         # service_ingest_steps_per_s on the default line, full async
@@ -966,7 +1020,7 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
         # block); v6 added the windowed serving A/B; v5 the keyed slab A/B;
         # v4 the sketch A/B; v3 moved the collective counts to the default
         # line and added the hierarchical A/B
-        out["trace_schema"] = 8
+        out["trace_schema"] = 9
         out["counters"] = grouped_counters
         out["gather_counters"] = coal_counters
         out["hier_counters"] = hier_counters
@@ -1319,6 +1373,12 @@ _TRACE_KEYS = (
     "async_lag_epoch_gather_calls",
     "async_lag_epoch_sync_gather_calls",
     "service_ingest_steps_per_s",
+    "fleet_ingest_steps_per_s",
+    "fleet_ingest_steps_per_s_1shard",
+    "fleet_scaling_x",
+    "fleet_shards_merged_windows",
+    "fleet_shards_published_windows",
+    "fleet_lost_windows",
     "slab_dropped_samples",
     "counters",
     "gather_counters",
@@ -2557,6 +2617,334 @@ def check_service() -> int:
     return 1 if failures else 0
 
 
+# --check-fleet soaks the sharded serving fleet (serving/fleet.py) end to
+# end and pins the scale-out contract:
+#   exact   — the merged fleet output (per-window records, sample counts,
+#             the global sliding view) is BIT-EXACT vs a single-process
+#             oracle at shard counts {1, 2, 8}: hash partitioning + merge by
+#             pure state addition loses nothing and double-counts nothing
+#   scaling — ingest throughput over the simulated per-batch serving work
+#             is near-linear in shard count (8-shard >= 4x 1-shard on the
+#             CI host; sleeps overlap perfectly, so the ratio isolates the
+#             fleet's routing/queueing path)
+#   chaos   — a seeded FaultSpec(site="fleet.shard", shard=i) schedule
+#             stalls one shard and KILLS another mid-stream; recover_shard
+#             (snapshot/restore + replay-log overlap replay through
+#             guarded_update) brings it back with ZERO lost windows, no
+#             double-published merged window, and values still bit-exact
+
+
+def _fleet_factory():
+    from metrics_tpu import Accuracy, Windowed
+    from metrics_tpu.parallel.sync import gather_all_arrays
+
+    return Windowed(
+        Accuracy(), window_s=FLEET_WINDOW_S, num_windows=FLEET_WINDOWS,
+        allowed_lateness_s=FLEET_LATENESS_S, dist_sync_fn=gather_all_arrays,
+    )
+
+
+def _fleet_tenants(per_shard: int, shards: int = FLEET_SHARDS):
+    """A deterministic tenant population balanced across the stable hash's
+    ``shards`` buckets (exactly ``per_shard`` keys per bucket) — the many-
+    tenant limit where hash partitioning balances load, without multinomial
+    wobble at bench-sized populations."""
+    from metrics_tpu.serving import shard_for_key
+
+    keys, buckets = [], {s: 0 for s in range(shards)}
+    candidate = 0
+    while any(count < per_shard for count in buckets.values()):
+        key = f"tenant-{candidate}"
+        candidate += 1
+        bucket = shard_for_key(key, shards)
+        if buckets[bucket] < per_shard:
+            buckets[bucket] += 1
+            keys.append(key)
+    return keys
+
+
+def _fleet_stream(n_batches: int, batch: int, seed: int = 0, step_s: float = 2.5,
+                  straggle_s: float = 8.0):
+    """The seeded fleet soak stream: tenant-keyed batches whose event times
+    advance ``step_s`` per batch with ~15% late-within-lateness stragglers
+    (never beyond ``FLEET_LATENESS_S``, so no routing verdict depends on
+    which shard's watermark judged it — the bit-exactness precondition).
+    Returns ``[(key, times, preds, target), ...]``."""
+    rng = np.random.RandomState(seed)
+    keys = _fleet_tenants(max(n_batches // FLEET_SHARDS, 1))
+    out = []
+    for i in range(n_batches):
+        times = i * step_s + rng.uniform(0.0, step_s, batch)
+        if straggle_s > 0:
+            late = rng.rand(batch) < 0.15
+            times = np.where(late, times - rng.uniform(0.0, straggle_s, batch), times)
+        out.append((
+            keys[i % len(keys)], times,
+            rng.rand(batch).astype(np.float32),
+            (rng.rand(batch) > 0.5).astype(np.int32),
+        ))
+    return out
+
+
+def _fleet_oracle(batches):
+    """Single-process oracle over the fleet stream: global-watermark routing
+    in plain numpy, every window's value from a FRESH unwindowed metric over
+    exactly its events (keys ignored — partitioning must not change any
+    value)."""
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy
+
+    window_s, num_windows, lateness = FLEET_WINDOW_S, FLEET_WINDOWS, FLEET_LATENESS_S
+    wm, events, dropped = None, {}, 0
+    for _key, times, preds, target in batches:
+        t = np.asarray(times, dtype=np.float64)
+        wm = float(t.max()) if wm is None else max(wm, float(t.max()))
+        head = int(np.floor(wm / window_s))
+        w = np.floor_divide(t, window_s).astype(np.int64)
+        ok = ((w + 1) * window_s + lateness > wm) & (w > head - num_windows)
+        dropped += int((~ok).sum())
+        for j in np.nonzero(ok)[0]:
+            events.setdefault(int(w[j]), []).append((preds[j], target[j]))
+    origin = min(events)
+    published = list(range(origin, head + 1))
+    resident = [w for w in published if w > head - num_windows]
+
+    def value(windows):
+        pairs = [p for w in windows for p in events.get(w, [])]
+        if not pairs:
+            return np.asarray(np.nan, dtype=np.float32)
+        metric = Accuracy()
+        metric.update(
+            jnp.asarray(np.array([p for p, _ in pairs], dtype=np.float32)),
+            jnp.asarray(np.array([t for _, t in pairs], dtype=np.int32)),
+        )
+        return np.asarray(metric.compute())
+
+    return {
+        "published": published,
+        "values": {w: value([w]) for w in published},
+        "merged": value(resident),
+        "counts": {w: len(events.get(w, [])) for w in published},
+        "dropped": dropped,
+    }
+
+
+def _drive_fleet(batches, num_shards: int, schedule=None, recover: bool = False):
+    """Run the stream through a real ``MetricFleet`` (N background workers,
+    the stable-hash router, the merge tier) under ``schedule``; with
+    ``recover``, bring killed shards back via ``recover_shard`` (fresh
+    snapshot + replay-log overlap replay) and keep going. Returns the soak
+    evidence for the gate's pins."""
+    import contextlib
+
+    import jax.numpy as jnp
+
+    from metrics_tpu import MetricFleet
+    from metrics_tpu.parallel import faults
+    from metrics_tpu.serving import ShardStoppedError
+
+    injector = faults.ChaosInjector(schedule, seed=0) if schedule else contextlib.nullcontext()
+    recoveries = 0
+    start = time.perf_counter()
+    with injector:
+        fleet = MetricFleet(_fleet_factory, num_shards=num_shards, queue_size=64)
+        with fleet:
+            for key, times, preds, target in batches:
+                try:
+                    fleet.submit(key, jnp.asarray(preds), jnp.asarray(target), event_time=times)
+                except ShardStoppedError as err:
+                    if not recover:
+                        raise
+                    # the failed submission is already in the replay log:
+                    # recovery delivers it — no re-submit
+                    fleet.recover_shard(err.shard)
+                    recoveries += 1
+            try:
+                fleet.flush(FLEET_SOAK_BUDGET_S)
+            except Exception:
+                if not recover:
+                    raise
+                # a kill after the last submission to that shard surfaces at
+                # the flush barrier — recover and drain again
+                for index, service in enumerate(fleet.shards):
+                    if service.state != "running":
+                        fleet.recover_shard(index)
+                        recoveries += 1
+                fleet.flush(FLEET_SOAK_BUDGET_S)
+            merged = np.asarray(fleet.finalize(FLEET_SOAK_BUDGET_S))
+            records = list(fleet.merged_records)
+            replayed = sum(s.replayed_steps for s in fleet.shards)
+            published = sum(len(s.publications) for s in fleet.shards)
+            dropped = sum(s.metric.dropped_samples for s in fleet.shards)
+    return {
+        "records": records,
+        "merged": merged,
+        "elapsed_s": time.perf_counter() - start,
+        "replayed": replayed,
+        "recoveries": recoveries,
+        "published": published,
+        "dropped": dropped,
+        "injected": dict(injector.injected) if schedule else {},
+    }
+
+
+def _bench_fleet_ingest(num_shards: int, batches=None) -> float:
+    """Sustained batches/sec through a real ``MetricFleet`` ingest loop at
+    ``num_shards`` shards, under the simulated per-batch serving work
+    (``FLEET_WORK_S`` ingest_stall at the fleet.shard site, every call).
+    Sleeps overlap across shard workers, so this measures how well the
+    fleet's routing/queueing path actually parallelizes — the
+    ``fleet_ingest_steps_per_s`` scaling headline."""
+    import jax.numpy as jnp
+
+    from metrics_tpu import MetricFleet
+    from metrics_tpu.parallel import faults
+    from metrics_tpu.serving.fleet import FLEET_SITE
+
+    if batches is None:
+        batches = _fleet_stream(FLEET_SCALE_BATCHES, FLEET_SCALE_BATCH, seed=1,
+                                step_s=1.5, straggle_s=0.0)
+    batches = [
+        (key, times, jnp.asarray(preds), jnp.asarray(target))
+        for key, times, preds, target in batches
+    ]
+    # warm the eager scatter path's compile cache outside the timed loop
+    warm = _fleet_factory()
+    warm.update(batches[0][2], batches[0][3], event_time=batches[0][1])
+    schedule = [faults.FaultSpec(kind="ingest_stall", rate=1.0,
+                                 duration_s=FLEET_WORK_S, site=FLEET_SITE)]
+    with faults.ChaosInjector(schedule, seed=0):
+        with MetricFleet(_fleet_factory, num_shards=num_shards,
+                         queue_size=len(batches)) as fleet:
+            start = time.perf_counter()
+            for key, times, preds, target in batches:
+                fleet.submit(key, preds, target, event_time=times)
+            fleet.flush(FLEET_SOAK_BUDGET_S)
+            elapsed = time.perf_counter() - start
+    return len(batches) / max(elapsed, 1e-9)
+
+
+def _check_fleet_exact(result, oracle, failures, label):
+    """Shared merged-output assertions: window coverage, exactly-once in
+    order, bit-exact values, per-window sample counts (zero lost, zero
+    misrouted, zero double-counted), the global merged view."""
+    windows = [r["window"] for r in result["records"]]
+    if windows != sorted(set(windows)):
+        failures.append(f"{label}: merged windows {windows} out of order or duplicated")
+    if sorted(set(windows)) != oracle["published"]:
+        failures.append(
+            f"{label}: merged windows {sorted(set(windows))} != oracle {oracle['published']}"
+            " (lost windows)"
+        )
+    for record in result["records"]:
+        expected = oracle["values"].get(record["window"])
+        if expected is None or not np.array_equal(record["value"], expected, equal_nan=True):
+            failures.append(
+                f"{label}: window {record['window']} merged value {record['value']}"
+                f" != oracle {expected}"
+            )
+        count = oracle["counts"].get(record["window"])
+        if count is not None and record["rows"] != count:
+            failures.append(
+                f"{label}: window {record['window']} merged {record['rows']} samples,"
+                f" oracle routed {count} (misrouted, lost or double-counted)"
+            )
+    if not np.array_equal(result["merged"], oracle["merged"], equal_nan=True):
+        failures.append(
+            f"{label}: merged sliding view {result['merged']} != oracle {oracle['merged']}"
+        )
+    if result["dropped"] != oracle["dropped"]:
+        failures.append(
+            f"{label}: shards dropped {result['dropped']} events, oracle {oracle['dropped']}"
+        )
+    if result["elapsed_s"] > FLEET_SOAK_BUDGET_S:
+        failures.append(
+            f"{label}: soak took {result['elapsed_s']:.1f}s > {FLEET_SOAK_BUDGET_S}s budget (hang?)"
+        )
+
+
+def check_fleet() -> int:
+    """``--check-fleet``: the sharded-serving regression gate (see the block
+    comment above). Prints one JSON report line; non-zero exit on any broken
+    contract."""
+    from metrics_tpu.parallel.faults import FaultSpec
+    from metrics_tpu.serving import shard_for_key
+    from metrics_tpu.serving.fleet import FLEET_SITE
+
+    failures = []
+
+    # -- exact: merged output bit-exact vs the oracle at {1, 2, 8} shards --
+    batches = _fleet_stream(FLEET_EXACT_BATCHES, FLEET_EXACT_BATCH)
+    oracle = _fleet_oracle(batches)
+    exact = {}
+    for num_shards in (1, 2, FLEET_SHARDS):
+        result = _drive_fleet(batches, num_shards)
+        _check_fleet_exact(result, oracle, failures, f"exact[{num_shards}]")
+        exact[str(num_shards)] = {
+            "merged_windows": len(result["records"]),
+            "shard_publishes": result["published"],
+            "elapsed_s": round(result["elapsed_s"], 3),
+        }
+
+    # -- scaling: 8-shard ingest throughput >= 4x 1-shard ------------------
+    sps_1 = _bench_fleet_ingest(1)
+    sps_8 = _bench_fleet_ingest(FLEET_SHARDS)
+    scaling_x = sps_8 / max(sps_1, 1e-9)
+    if scaling_x < FLEET_SCALING_MIN_X:
+        failures.append(
+            f"scaling: 8-shard ingest {sps_8:.1f}/s is only {scaling_x:.2f}x the"
+            f" 1-shard {sps_1:.1f}/s (gate: >= {FLEET_SCALING_MIN_X}x) — something"
+            " global serializes the shard workers"
+        )
+
+    # -- chaos: stall one shard, KILL another mid-stream, recover ----------
+    kill_shard = shard_for_key(batches[2][0], FLEET_KILL_SHARDS)
+    stall_shard = (kill_shard + 1) % FLEET_KILL_SHARDS
+    schedule = [
+        FaultSpec(kind="preempt", call=FLEET_KILL_CALL, times=1,
+                  site=FLEET_SITE, shard=kill_shard),
+        FaultSpec(kind="ingest_stall", call=1, times=2, duration_s=0.1,
+                  site=FLEET_SITE, shard=stall_shard),
+    ]
+    chaos = _drive_fleet(batches, FLEET_KILL_SHARDS, schedule=schedule, recover=True)
+    _check_fleet_exact(chaos, oracle, failures, "chaos")
+    if chaos["injected"].get("preempt") != 1:
+        failures.append(f"chaos: expected exactly one shard kill, injected {chaos['injected']}")
+    if chaos["injected"].get("ingest_stall", 0) < 1:
+        failures.append("chaos: the shard stall never fired")
+    if chaos["recoveries"] < 1:
+        failures.append("chaos: the killed shard was never recovered")
+    if chaos["replayed"] < 1:
+        failures.append(
+            "chaos: the overlap replay never hit the epoch watermark — replay"
+            " idempotence went unexercised"
+        )
+
+    print(json.dumps({
+        "check": "fleet",
+        "ok": not failures,
+        "failures": failures,
+        "exact": exact,
+        "scaling": {
+            "steps_per_s_1shard": round(sps_1, 3),
+            "steps_per_s_8shard": round(sps_8, 3),
+            "x": round(scaling_x, 3),
+            "min_x": FLEET_SCALING_MIN_X,
+            "work_s": FLEET_WORK_S,
+        },
+        "chaos": {
+            "merged_windows": len(chaos["records"]),
+            "recoveries": chaos["recoveries"],
+            "replayed": chaos["replayed"],
+            "injected": chaos["injected"],
+            "elapsed_s": round(chaos["elapsed_s"], 3),
+            "budget_s": FLEET_SOAK_BUDGET_S,
+        },
+    }))
+    return 1 if failures else 0
+
+
 def main() -> None:
     trace_path = _trace_arg(sys.argv)
     if len(sys.argv) > 1 and sys.argv[1] == "--check-trajectory":
@@ -2584,6 +2972,12 @@ def main() -> None:
             + f" --xla_force_host_platform_device_count={N_DEVICES}"
         ).strip()
         raise SystemExit(check_async())
+
+    if len(sys.argv) > 1 and sys.argv[1] == "--check-fleet":
+        # sharded-fleet gate: pure host-plane (threads + queues + numpy);
+        # jax not yet imported, so the platform pin lands in-process
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        raise SystemExit(check_fleet())
 
     if len(sys.argv) > 1 and sys.argv[1] == "--check-service":
         # serving-runtime gate: the soaks are host-plane, but the parity

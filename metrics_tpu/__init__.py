@@ -128,5 +128,5 @@ from metrics_tpu.wrappers import (  # noqa: E402
     Running,
     Windowed,
 )
-from metrics_tpu.serving import MetricService  # noqa: E402
+from metrics_tpu.serving import MetricFleet, MetricService  # noqa: E402
 from metrics_tpu import functional  # noqa: E402

@@ -91,6 +91,16 @@ What gets counted, and on which plane:
   publish pipeline under the service label — so a snapshot shows exactly
   how deep every deferred pipeline actually ran (vs the ``sync_lag`` cap it
   was allowed). Present in every snapshot.
+- **fleet_shards**: per-fleet, per-shard GAUGES for the sharded serving
+  runtime (``serving/fleet.py``): ``{fleet label: {shard index: {"health":
+  healthy|degraded|shedding, "queue_depth": d, "occupied": resident windows
+  holding samples, "published": windows that shard published, "replayed":
+  idempotently skipped replay steps}}}``. One snapshot shows the whole
+  fleet's shape at a glance — which shard is hot (queue depth), which shard
+  degraded, and how much failover replay actually no-op'd. Refreshed on
+  every shard publish and every shard recovery while counting is enabled
+  (the occupancy read is a device readback, so it only pays while counting
+  is on); present in every snapshot.
 - **slab_slots**: per-slab slot GAUGES for the keyed multi-tenant wrappers
   (``wrappers/keyed.py``): ``{label: {"slots": K, "occupied": n,
   "evictions": e}}``. Occupancy says how much of the provisioned K is
@@ -121,6 +131,7 @@ __all__ = [
     "record_deferred",
     "record_deferred_depth",
     "record_fault",
+    "record_fleet_shards",
     "record_gather_skip",
     "record_service_health",
     "record_slab_dropped",
@@ -188,6 +199,7 @@ class CollectiveCounters:
         "faults",
         "deferred",
         "deferred_depth",
+        "fleet_shards",
         "gather_skips",
         "slab_dropped_samples",
         "state_bytes",
@@ -218,6 +230,7 @@ class CollectiveCounters:
         self.deferred_depth: Dict[str, Dict[str, int]] = {}  # label -> {"current", "max"}
         self.gather_skips = 0
         self.slab_dropped_samples = 0  # out-of-range slot ids dropped by slab scatters
+        self.fleet_shards: Dict[str, Dict[str, Dict[str, Any]]] = {}  # fleet label -> shard gauges
         self.state_bytes: Dict[str, int] = {}  # metric class name -> latest bytes
         self.slab_slots: Dict[str, Dict[str, int]] = {}  # keyed-slab label -> gauges
         self.service_health: Dict[str, Dict[str, Any]] = {}  # service label -> health gauges
@@ -307,6 +320,13 @@ class CollectiveCounters:
                 "queue_depth": int(queue_depth),
             }
 
+    def record_fleet_shards(self, label: str, shards: Dict[str, Dict[str, Any]]) -> None:
+        """Refresh one serving fleet's per-shard gauges (latest value wins;
+        ``shards`` maps shard index -> {"health", "queue_depth", "occupied",
+        "published", "replayed"})."""
+        with self._lock:
+            self.fleet_shards[label] = {str(k): dict(v) for k, v in shards.items()}
+
     def record_state_bytes(self, metric: str, nbytes: int) -> None:
         """Refresh the per-metric state-footprint gauge (latest value wins —
         a gauge, not an accumulator: the number IS the current footprint)."""
@@ -348,6 +368,10 @@ class CollectiveCounters:
                 "gather_skips": self.gather_skips,
                 "slab_dropped_samples": self.slab_dropped_samples,
                 "state_bytes": dict(sorted(self.state_bytes.items())),
+                "fleet_shards": {
+                    k: {s_: dict(g) for s_, g in sorted(v.items())}
+                    for k, v in sorted(self.fleet_shards.items())
+                },
                 "slab_slots": {k: dict(v) for k, v in sorted(self.slab_slots.items())},
                 "service_health": {k: dict(v) for k, v in sorted(self.service_health.items())},
                 "group_cache": {"hits": self.group_cache_hits, "misses": self.group_cache_misses},
@@ -428,6 +452,13 @@ def record_service_health(
 def record_state_bytes(metric: str, nbytes: int) -> None:
     if COUNTERS.enabled:
         COUNTERS.record_state_bytes(metric, nbytes)
+
+
+# Fleet shard gauges are telemetry like slab_slots (the occupancy read is a
+# device readback), so they share the enabled gate.
+def record_fleet_shards(label: str, shards: Dict[str, Dict[str, Any]]) -> None:
+    if COUNTERS.enabled:
+        COUNTERS.record_fleet_shards(label, shards)
 
 
 def record_slab_slots(label: str, slots: int, occupied: int, evictions: int) -> None:

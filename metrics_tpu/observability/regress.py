@@ -15,6 +15,12 @@ trajectory a first-class regression surface with two gate classes:
   counters): exact, deterministic numbers — ANY growth over the most recent
   round that carries the key fails. A shrink reports ``improved`` (re-pin
   by letting the next BENCH round record it).
+- **Throughput rates** (``*_steps_per_s`` keys): higher is better, and
+  smoke-mode loops are noisy, so the gate is a collapse detector rather
+  than a precision pin: the current value may not fall below the BEST
+  prior round's value divided by ``rate_ratio``. A fleet whose 8-shard
+  ingest throughput quietly drops to a third of its recorded best has
+  serialized something; ordinary wobble passes.
 - **Fault counters** (``sync_retries`` / ``sync_deadline_exceeded`` /
   ``degraded_computes`` / ``quarantined_updates``): pinned at EXACTLY ZERO
   whenever the current line carries them — a clean bench run that retried,
@@ -36,6 +42,7 @@ __all__ = [
     "COUNT_KEYS",
     "FAULT_KEYS",
     "MS_KEYS",
+    "RATE_KEYS",
     "TOLERANCES",
     "check_trajectory",
     "load_rounds",
@@ -127,6 +134,19 @@ COUNT_KEYS: Tuple[str, ...] = (
     "async_lag_sync_bytes",
     "async_lag_epoch_gather_calls",
     "async_lag_epoch_sync_gather_calls",
+    # the sharded fleet's merge tier: the exact-stream window counts are
+    # deterministic (routing + watermark arithmetic, no timing); growth in
+    # either means the scenario changed — re-pin deliberately
+    "fleet_shards_merged_windows",
+    "fleet_shards_published_windows",
+)
+
+# throughput keys (batches/sec through real serving loops): gated as
+# collapse detectors — current may not fall below best prior / rate_ratio
+RATE_KEYS: Tuple[str, ...] = (
+    "service_ingest_steps_per_s",
+    "fleet_ingest_steps_per_s",
+    "fleet_ingest_steps_per_s_1shard",
 )
 
 # fault counters: bound at exactly zero whenever the current line carries
@@ -140,6 +160,8 @@ FAULT_KEYS: Tuple[str, ...] = (
     "degraded_computes",
     "quarantined_updates",
     "slab_dropped_samples",
+    # the fleet merge tier may never lose a window on the clean bench stream
+    "fleet_lost_windows",
 )
 
 TOLERANCES: Dict[str, float] = {
@@ -151,6 +173,9 @@ TOLERANCES: Dict[str, float] = {
     # sub-millisecond wobble.)
     "ms_ratio": 2.0,
     "ms_slack_ms": 2.0,
+    # throughput keys fail only on a collapse below best prior / rate_ratio:
+    # smoke throughput wobbles, a 3x drop is structural
+    "rate_ratio": 3.0,
 }
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
@@ -222,6 +247,24 @@ def check_trajectory(
             failures.append(
                 f"{key}: {got:.4g} ms > {tol['ms_ratio']}x best prior"
                 f" {best:.4g} ms (round {best_round})"
+            )
+        else:
+            row["status"] = "ok"
+        checks[key] = row
+
+    for key in RATE_KEYS:
+        priors = _prior_values(rounds, key)
+        got = current.get(key)
+        if not priors or not isinstance(got, (int, float)):
+            checks[key] = {"status": "no-baseline" if not priors else "missing"}
+            continue
+        best_round, best = max(priors, key=lambda p: p[1])
+        row = {"current": got, "baseline": best, "baseline_round": best_round, "kind": "rate"}
+        if got < best / tol["rate_ratio"]:
+            row["status"] = "regression"
+            failures.append(
+                f"{key}: {got:.4g}/s collapsed below best prior"
+                f" {best:.4g}/s (round {best_round}) / {tol['rate_ratio']}"
             )
         else:
             row["status"] = "ok"

@@ -43,6 +43,17 @@ the injector what fires on each ingest call and applies the semantics itself
   burst of OLD events, exercising the late-routing and (beyond the allowed
   lateness) the drop-and-count path (``slab_dropped_samples``).
 
+The serving FLEET (``serving/fleet.py``) consults the same ingest hook at the
+``"fleet.shard"`` site: every shard of a :class:`~metrics_tpu.serving.fleet.
+MetricFleet` reports its shard index alongside its per-shard ingest call
+index, and a spec's ``shard=`` field addresses one specific shard (``None``
+matches every shard). ``FaultSpec(kind="preempt", site="fleet.shard",
+shard=2, call=5)`` therefore kills exactly shard 2's ingest worker on ITS
+fifth call — the seeded mid-stream shard kill the fleet failover soak
+(``bench.py --check-fleet``) recovers from — and ``kind="ingest_stall"``
+with ``rate=1.0`` stalls every shard's worker per batch (the fleet scaling
+scenario's simulated per-batch serving work).
+
 Faults are *scenario-addressable*: a spec pins the exact gather call index it
 fires on (``call=``, counted per site from injector install), or fires
 probabilistically (``rate=``) from the injector's seeded RNG — both
@@ -110,6 +121,7 @@ class FaultSpec(NamedTuple):
     rate: float = 0.0  # per-call probability when call is None
     site: str = "host_gather"
     skew_s: float = 0.0  # clock_skew shift (late_burst shifts by -skew_s)
+    shard: Optional[int] = None  # fleet shard index (None = every shard)
 
 
 class ChaosInjector:
@@ -127,6 +139,8 @@ class ChaosInjector:
                 raise ValueError(f"unknown fault kind {spec.kind!r}; expected one of {FAULT_KINDS}")
             if spec.call is None and spec.rate <= 0.0 and spec.kind != "preempt":
                 raise ValueError(f"spec {spec!r} is unaddressed: set call= or rate>0")
+            if spec.shard is not None and not (isinstance(spec.shard, int) and spec.shard >= 0):
+                raise ValueError(f"spec {spec!r}: shard= must be a non-negative int or None")
         self.schedule: List[FaultSpec] = list(schedule)
         self.seed = seed
         self._rng = random.Random(seed)
@@ -139,12 +153,15 @@ class ChaosInjector:
         self._rate_verdicts: Dict[tuple, bool] = {}
 
     # ------------------------------------------------------------- matching
-    def _matches(self, spec: FaultSpec, site: str, idx: int) -> bool:
+    def _matches(self, spec: FaultSpec, site: str, idx: int, shard: Optional[int] = None) -> bool:
         if spec.site != site:
             return False
         if spec.call is not None:
             return spec.call == idx
-        key = (id(spec), site, idx)
+        # the verdict key carries the caller's shard so two fleet shards at
+        # the same per-shard call index draw independent (but each stable)
+        # verdicts; non-fleet callers pass shard=None and keep the old key
+        key = (id(spec), site, idx, shard)
         verdict = self._rate_verdicts.get(key)
         if verdict is None:
             verdict = self._rate_verdicts[key] = self._rng.random() < spec.rate
@@ -201,25 +218,31 @@ class ChaosInjector:
                 return
         time.sleep(duration)  # outside the lock: a stall must not block peers
 
-    def ingest_faults(self, site: str, idx: int) -> List[FaultSpec]:
+    def ingest_faults(self, site: str, idx: int, shard: Optional[int] = None) -> List[FaultSpec]:
         """The service-plane specs firing on ingest call ``idx`` at ``site``
         (kinds in :data:`SERVICE_FAULT_KINDS`; the serving loop applies the
         semantics — sleep, time shift, preemption — itself).
 
         Unlike the gather hook there are no retries at ingest, so ``times``
         here means CONSECUTIVE CALLS: a call-pinned spec fires on calls
-        ``call .. call + times - 1``. Thread-safe and seeded like the gather
-        path; fired kinds count into ``injected``.
+        ``call .. call + times - 1``. ``shard`` is the caller's fleet shard
+        index (the ``MetricFleet`` shards report theirs; a spec with
+        ``shard=`` set fires only on that shard — ``idx`` is then that
+        shard's OWN ingest call counter, so a kill is addressable to "shard
+        2's fifth batch"). Thread-safe and seeded like the gather path;
+        fired kinds count into ``injected``.
         """
         fired: List[FaultSpec] = []
         with self._lock:
             for spec in self.schedule:
                 if spec.kind not in SERVICE_FAULT_KINDS or spec.site != site:
                     continue
+                if spec.shard is not None and spec.shard != shard:
+                    continue
                 if spec.call is not None:
                     if not (spec.call <= idx < spec.call + spec.times):
                         continue
-                elif not self._matches(spec, site, idx):
+                elif not self._matches(spec, site, idx, shard):
                     continue
                 self._fire(spec)
                 fired.append(spec)

@@ -4,8 +4,27 @@
 a :class:`~metrics_tpu.wrappers.windowed.Windowed` metric: a bounded ingress
 queue with a shed policy, per-window sync deadlines that degrade instead of
 stalling the stream, crash-safe snapshot/restore riding the epoch watermark,
-and health gauges. See ``docs/streaming.md``.
+and health gauges. ``MetricFleet`` scales it horizontally: N hash-partitioned
+``MetricService`` ingest shards (stable FNV-1a routing) plus a merge tier
+that folds shard partials into the global view by pure state addition as
+windows close, with seeded shard-kill failover. See ``docs/streaming.md``.
 """
+from metrics_tpu.serving.fleet import (
+    FLEET_SITE,
+    MetricFleet,
+    ShardStoppedError,
+    shard_for_key,
+    stable_key_hash,
+)
 from metrics_tpu.serving.service import HEALTH_STATES, MetricService, ServiceStoppedError
 
-__all__ = ["HEALTH_STATES", "MetricService", "ServiceStoppedError"]
+__all__ = [
+    "FLEET_SITE",
+    "HEALTH_STATES",
+    "MetricFleet",
+    "MetricService",
+    "ServiceStoppedError",
+    "ShardStoppedError",
+    "shard_for_key",
+    "stable_key_hash",
+]

@@ -1,0 +1,465 @@
+"""MetricFleet: the horizontally-sharded serving runtime — N ingest shards,
+one merge tier, near-linear throughput.
+
+One :class:`~metrics_tpu.serving.service.MetricService` is a single ingest
+thread draining one bounded queue: fine for one model replica, a bottleneck
+for millions of users. ``MetricFleet`` composes the primitives the library
+already has into a horizontally scaled topology with ZERO new collective
+kinds:
+
+- **Hash-partitioned ingest shards.** ``submit(key, *data, event_time=)``
+  routes every tenant-keyed batch to shard ``stable_key_hash(key) % N`` —
+  a documented 64-bit FNV-1a over the key's canonical bytes
+  (:func:`stable_key_hash`), NOT Python's salted ``hash()``, so routing is
+  identical across process restarts, interpreter versions and
+  shard-count-preserving restores. Each shard is a full ``MetricService``
+  (bounded queue, watermark routing, publish-on-window-close, crash
+  snapshotting) over its OWN ``Windowed``/``Keyed`` state built from the
+  fleet's ``metric_factory``.
+- **Per-shard backpressure, isolated.** Every shard owns its queue, so a
+  hot shard exerts backpressure (or sheds, per ``shed_policy``) on ITS
+  producers only — the other shards' workers keep draining. Throughput
+  scales with shard count because nothing global serializes the ingest
+  path (``bench.py --check-fleet`` gates 8-shard >= 4x 1-shard on the CI
+  host).
+- **The merge tier.** Shard states are mergeable by construction (sum/min/
+  max array leaves, sketch histograms, slab rows — PR 7/8's invariant), so
+  the aggregator never re-sees a sample: each shard's publish stage hands
+  the fleet its closed window's RAW state rows
+  (:meth:`~metrics_tpu.wrappers.windowed.Windowed.window_partial`, via the
+  service's ``partial_publish_fn`` tap), and the fleet merges them by pure
+  state addition (:meth:`~metrics_tpu.wrappers.windowed.Windowed.
+  value_from_partials`). Publish-on-window-close generalizes to: once EVERY
+  shard has closed window ``w`` (its own watermark passed ``w`` — the
+  fleet-level min-watermark rule), the merger emits ONE merged record for
+  ``w`` — exactly once, in window order — bit-exact vs a single process
+  that accumulated all the traffic. Because each shard's publish stage
+  rides the deferred host plane (``parallel/deferred.py``, the service
+  default), partials arrive — and merge — on the background worker: the
+  merge tier overlaps ingest.
+- **Shard failover, zero lost windows.** Kill a shard mid-stream (a real
+  SIGTERM, or the seeded ``FaultSpec(site="fleet.shard", shard=i,
+  kind="preempt")`` chaos kill) and :meth:`recover_shard` rebuilds it:
+  restore a snapshot (fresh from the dead worker's state, or the persisted
+  publish-time ``last_snapshot`` after a whole-process death), then replay
+  the fleet's per-shard replay log with the ORIGINAL ``seq=`` ids — steps
+  below the restored epoch watermark no-op (``guarded_update``), so the
+  overlap replays idempotently and no window is lost or double-merged. The
+  ``fleet_shards`` gauge reports how many replayed steps actually
+  no-op'd.
+
+The device-side story is unchanged: windows and segments stay state AXES,
+sync stays the coalesced psum buckets, and the fleet itself is pure
+host-plane supervision — threads, queues and numpy, no new collectives.
+
+Example::
+
+    fleet = MetricFleet(
+        lambda: Windowed(Accuracy(), window_s=60.0, num_windows=4),
+        num_shards=8,
+    )
+    fleet.submit("tenant-42", preds, target, event_time=times)
+    ...
+    merged = fleet.finalize()     # fleet.merged_records: one per window
+"""
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from metrics_tpu.observability.counters import (
+    COUNTERS as _COUNTERS,
+    record_fleet_shards,
+)
+from metrics_tpu.parallel.sync import SyncGuard
+from metrics_tpu.serving.service import MetricService, ServiceStoppedError
+from metrics_tpu.wrappers.windowed import _ROWS_STATE, Windowed
+
+__all__ = [
+    "FLEET_SITE",
+    "MetricFleet",
+    "ShardStoppedError",
+    "shard_for_key",
+    "stable_key_hash",
+]
+
+# the chaos-injector site fleet shards consult (FaultSpec(site=..., shard=i))
+FLEET_SITE = "fleet.shard"
+
+# 64-bit FNV-1a: the routing hash of record. Chosen because it is trivially
+# re-implementable in any producer language (offset basis + xor/multiply per
+# byte), has no process-lifetime salt (unlike Python's str hash), and its
+# low bits are well-mixed enough for `% num_shards` partitioning.
+_FNV64_OFFSET = 0xCBF29CE484222325
+_FNV64_PRIME = 0x100000001B3
+_FNV64_MASK = 0xFFFFFFFFFFFFFFFF
+
+
+def stable_key_hash(key: Any) -> int:
+    """The fleet's stable routing hash: 64-bit FNV-1a over the key's
+    canonical bytes.
+
+    Canonical form (type-tagged so ``1`` and ``"1"`` cannot collide by
+    construction): ``b"s:" + utf-8`` for str, ``b"b:" + bytes`` for bytes,
+    ``b"i:" + decimal`` for ints (numpy integers included). Any other key
+    type is rejected loudly — a repr-based fallback would silently change
+    routing across library versions, and routing MUST survive restarts
+    (``shard_for_key(key, n)`` is the partition contract producers and
+    restored fleets both rely on).
+    """
+    if isinstance(key, bytes):
+        data = b"b:" + key
+    elif isinstance(key, str):
+        data = b"s:" + key.encode("utf-8")
+    elif isinstance(key, (int, np.integer)) and not isinstance(key, bool):
+        data = b"i:" + str(int(key)).encode("ascii")
+    else:
+        raise TypeError(
+            f"fleet keys must be str, bytes or int (stable canonical bytes);"
+            f" got {type(key).__name__}"
+        )
+    h = _FNV64_OFFSET
+    for byte in data:
+        h = ((h ^ byte) * _FNV64_PRIME) & _FNV64_MASK
+    return h
+
+
+def shard_for_key(key: Any, num_shards: int) -> int:
+    """``stable_key_hash(key) % num_shards`` — the routing contract."""
+    if not (isinstance(num_shards, int) and num_shards >= 1):
+        raise ValueError(f"num_shards must be a positive int, got {num_shards!r}")
+    return stable_key_hash(key) % num_shards
+
+
+class ShardStoppedError(ServiceStoppedError):
+    """A fleet shard's worker is not accepting events. Carries ``shard``
+    (the index) so the producer can :meth:`MetricFleet.recover_shard` it —
+    the failed submission is already in the replay log, so recovery
+    delivers it (do not re-submit)."""
+
+    def __init__(self, shard: int, message: str):
+        super().__init__(message)
+        self.shard = shard
+
+
+class MetricFleet:
+    """N hash-partitioned ``MetricService`` ingest shards + a merge tier.
+
+    Args:
+        metric_factory: zero-arg callable building one shard's ``Windowed``
+            metric (the ring form — each call must return a fresh,
+            identically-configured instance; one extra instance becomes the
+            merge tier's finisher template).
+        num_shards: N, the ingest shard count. Routing is
+            ``stable_key_hash(key) % N`` — changing N repartitions (windows
+            in flight at a resize are not migrated; drain with
+            :meth:`finalize` first).
+        queue_size / shed_policy / guard / deferred_publish /
+            poll_interval_s: per-shard ``MetricService`` configuration
+            (every shard gets the same).
+        merged_publish_fn: optional callback receiving each MERGED window
+            record as the merge tier emits it.
+        name: the fleet's gauge label (shards are labeled
+            ``<name>/shard<i>``); auto-indexed when omitted.
+        replay_log: per-shard bound on the failover replay ring — the last
+            ``replay_log`` submissions per shard are kept for
+            :meth:`recover_shard`'s overlap replay. Must comfortably exceed
+            the shard's queue depth plus the publish cadence (snapshots
+            refresh every publish, so the overlap is short).
+
+    ``submit(key, *data, event_time=)`` is the producer API; the merged
+    stream lands in :attr:`merged_records` (and ``merged_publish_fn``).
+    Use as a context manager, or call :meth:`stop`.
+    """
+
+    _ids = itertools.count()
+
+    def __init__(
+        self,
+        metric_factory: Callable[[], Windowed],
+        num_shards: int,
+        queue_size: int = 64,
+        shed_policy: str = "block",
+        guard: Optional[SyncGuard] = None,
+        merged_publish_fn: Optional[Callable[[Dict[str, Any]], None]] = None,
+        name: Optional[str] = None,
+        replay_log: int = 512,
+        deferred_publish: bool = True,
+        poll_interval_s: float = 0.02,
+    ):
+        if not callable(metric_factory):
+            raise ValueError("`metric_factory` must be a zero-arg callable building a Windowed metric")
+        if not (isinstance(num_shards, int) and num_shards >= 1):
+            raise ValueError(f"`num_shards` must be a positive int, got {num_shards!r}")
+        if not (isinstance(replay_log, int) and replay_log >= 1):
+            raise ValueError(f"`replay_log` must be a positive int, got {replay_log!r}")
+        template = metric_factory()
+        if not isinstance(template, Windowed) or template.decay:
+            raise ValueError(
+                "`metric_factory` must build Windowed ring metrics (the fleet"
+                " merges per-window partials; the decay accumulator has none)"
+            )
+        self._factory = metric_factory
+        self._template = template  # the merge tier's finisher (never updated)
+        self.num_shards = num_shards
+        self.num_windows = template.num_windows
+        self.window_s = template.window_s
+        self.label = name or f"MetricFleet({type(template.metric).__name__})#{next(MetricFleet._ids)}"
+        self._shard_kw = dict(
+            queue_size=queue_size, shed_policy=shed_policy, guard=guard,
+            deferred_publish=deferred_publish, poll_interval_s=poll_interval_s,
+        )
+
+        self._lock = threading.RLock()
+        self.merged_publish_fn = merged_publish_fn
+        self.merged_records: List[Dict[str, Any]] = []
+        self._partials: Dict[int, Dict[int, Dict[str, Any]]] = {}  # window -> shard -> partial
+        self._pub_degraded: Dict[int, bool] = {}  # window -> any contributing shard degraded
+        self._closed_through: List[Optional[int]] = [None] * num_shards
+        self._merged_through: Optional[int] = None
+        self._seqs = [0] * num_shards  # next auto-assigned per-shard seq
+        self._replay: List[deque] = [deque(maxlen=replay_log) for _ in range(num_shards)]
+        self._recoveries = 0
+        self._shards: List[MetricService] = [self._build_shard(i) for i in range(num_shards)]
+
+    def _build_shard(self, index: int) -> MetricService:
+        return MetricService(
+            self._factory(),
+            name=f"{self.label}/shard{index}",
+            partial_publish_fn=(
+                lambda record, partial, _shard=index: self._on_shard_publish(_shard, record, partial)
+            ),
+            fault_site=FLEET_SITE,
+            fault_shard=index,
+            **self._shard_kw,
+        )
+
+    # ------------------------------------------------------------- routing
+    @property
+    def shards(self) -> tuple:
+        """The live per-shard services (read-only view; replaced on
+        :meth:`recover_shard`)."""
+        return tuple(self._shards)
+
+    def shard_of(self, key: Any) -> int:
+        """Where ``key``'s traffic routes — the stable partition contract."""
+        return shard_for_key(key, self.num_shards)
+
+    def submit(
+        self, key: Any, *args: Any, event_time: Any = None,
+        seq: Optional[int] = None, **kwargs: Any,
+    ) -> tuple:
+        """Route one tenant-keyed batch to its shard; returns
+        ``(shard, seq)`` — the replay address.
+
+        ``seq`` is the shard-local idempotent-replay id (auto-assigned in
+        per-shard submission order; pass the original on replay). The
+        submission is logged in the shard's replay ring BEFORE it enters
+        the queue, so a batch in flight at a shard kill is replayable. A
+        dead shard raises :class:`ShardStoppedError` (carrying ``.shard``)
+        — :meth:`recover_shard` it and move on: the FAILED submission is
+        already logged, so the recovery replay delivers it (re-submitting
+        would assign a new seq and double-count). The other shards are
+        unaffected (per-shard queues, per-shard backpressure).
+        """
+        shard = shard_for_key(key, self.num_shards)
+        with self._lock:
+            if seq is None:
+                seq = self._seqs[shard]
+            self._seqs[shard] = max(self._seqs[shard], seq + 1)
+            self._replay[shard].append((seq, args, event_time, kwargs))
+            service = self._shards[shard]
+        try:
+            service.submit(*args, event_time=event_time, seq=seq, **kwargs)
+        except ServiceStoppedError as err:
+            raise ShardStoppedError(
+                shard,
+                f"fleet shard {shard} is {service.state}; recover_shard({shard})"
+                " replays this submission from the log — do not re-submit it",
+            ) from err
+        return shard, seq
+
+    # ---------------------------------------------------------- merge tier
+    def _on_shard_publish(self, shard: int, record: Dict[str, Any], partial: Dict[str, Any]) -> None:
+        """The per-shard publish tap (runs on the shard's publish stage —
+        the background host plane by default, so merging overlaps ingest):
+        bank the partial, advance the shard's closed-through watermark, and
+        emit every window ALL shards have now closed."""
+        window = int(record["window"])
+        with self._lock:
+            self._partials.setdefault(window, {})[shard] = partial
+            self._pub_degraded[window] = self._pub_degraded.get(window, False) or bool(
+                record["degraded"]
+            )
+            current = self._closed_through[shard]
+            self._closed_through[shard] = window if current is None else max(current, window)
+            self._emit_ready_locked()
+        self._note_gauges()
+
+    def _emit_ready_locked(self, force: bool = False) -> None:
+        """Emit merged records in window order, exactly once. The frontier is
+        the fleet-level min-watermark rule: window ``w`` merges once every
+        shard's publish stream has closed it (a shard that published past
+        ``w`` without publishing ``w`` had no resident samples there — its
+        contribution is the empty partial). ``force`` (finalize) emits
+        through the highest window any shard published."""
+        if not self._partials:
+            return
+        if force:
+            frontier = max(self._partials)
+        else:
+            closed = self._closed_through
+            if any(c is None for c in closed):
+                return  # a shard has yet to close its first window
+            frontier = min(c for c in closed)
+        for window in sorted(self._partials):
+            if self._merged_through is not None and window <= self._merged_through:
+                continue
+            if window > frontier:
+                break
+            all_closed = all(
+                c is not None and c >= window for c in self._closed_through
+            )
+            self._emit_locked(window, forced=not all_closed)
+
+    def _emit_locked(self, window: int, forced: bool) -> None:
+        partials = self._partials.get(window, {})
+        value = self._template.value_from_partials(list(partials.values()))
+        rows = sum(float(np.asarray(p["rows"])) for p in partials.values())
+        record = {
+            "fleet": self.label,
+            "window": window,
+            "window_start_s": window * self.window_s,
+            "value": np.asarray(value),
+            "rows": rows,
+            "shards": sorted(partials),
+            "degraded": self._pub_degraded.get(window, False),
+            "forced": forced,
+        }
+        self.merged_records.append(record)
+        self._merged_through = window
+        # partials older than the ring can never be resident again — prune
+        # so an unbounded stream holds at most ~W windows of partials
+        for old in [w for w in self._partials if w <= window - self.num_windows]:
+            self._partials.pop(old, None)
+            self._pub_degraded.pop(old, None)
+        if self.merged_publish_fn is not None:
+            self.merged_publish_fn(record)
+
+    def merged_compute(self) -> Any:
+        """The GLOBAL sliding view: every globally-resident window's
+        partials, across all shards, merged by pure state addition and
+        finished once — the fleet analogue of ``Windowed.compute()``."""
+        with self._lock:
+            heads = [s.metric.head_window for s in self._shards if s.metric.head_window is not None]
+            if not heads:
+                return self._template.value_from_partials([])
+            head = max(heads)
+            partials = [
+                p
+                for window, by_shard in self._partials.items()
+                if window > head - self.num_windows
+                for p in by_shard.values()
+            ]
+            return self._template.value_from_partials(partials)
+
+    # ------------------------------------------------------------ failover
+    def recover_shard(self, shard: int, snapshot: Optional[Dict[str, Any]] = None,
+                      timeout_s: float = 30.0) -> MetricService:
+        """Rebuild a dead (or sick) shard and replay the overlap.
+
+        Builds a fresh ``MetricService`` from the factory, restores
+        ``snapshot`` (default: a FRESH snapshot of the dead shard — every
+        batch it applied before dying, with its ingest bookkeeping past the
+        kill point; fall back to an explicit ``snapshot=`` when the process
+        itself died and only a persisted ``last_snapshot`` survives), then
+        replays the fleet's per-shard replay log with the ORIGINAL seq ids —
+        steps at or below the restored epoch watermark no-op
+        (``guarded_update``), so the overlap is idempotent: no sample
+        double-counts, no window double-publishes, and every window the kill
+        interrupted is recovered (zero lost windows — the ``--check-fleet``
+        chaos soak's pin). Returns the replacement.
+        """
+        if not (0 <= shard < self.num_shards):
+            raise ValueError(f"shard must be in [0, {self.num_shards}), got {shard}")
+        with self._lock:
+            dead = self._shards[shard]
+        dead.stop(timeout_s)
+        snap = snapshot if snapshot is not None else dead.snapshot()
+        replacement = self._build_shard(shard)
+        if snap is not None:
+            replacement.restore(snap)
+        with self._lock:
+            self._shards[shard] = replacement
+            self._recoveries += 1
+            log = list(self._replay[shard])
+        for seq, args, event_time, kwargs in log:
+            replacement.submit(*args, event_time=event_time, seq=seq, **kwargs)
+        replacement.flush(timeout_s)
+        self._note_gauges()
+        return replacement
+
+    # ----------------------------------------------------------- lifecycle
+    def flush(self, timeout_s: float = 30.0) -> None:
+        """Barrier: every shard drained (ingest queue empty, deferred
+        publishes landed — so every partial those batches closed has reached
+        the merge tier). A dead shard raises its stored error."""
+        deadline = time.monotonic() + timeout_s
+        for service in list(self._shards):
+            service.flush(max(deadline - time.monotonic(), 0.001))
+
+    def finalize(self, timeout_s: float = 30.0) -> Any:
+        """Drain every shard, force-publish their still-open windows, emit
+        the remaining merged windows (stamped ``forced=True`` where a lagging
+        shard's watermark never closed them), and return the global merged
+        sliding view."""
+        deadline = time.monotonic() + timeout_s
+        for service in list(self._shards):
+            service.finalize(max(deadline - time.monotonic(), 0.001))
+        with self._lock:
+            self._emit_ready_locked(force=True)
+        self._note_gauges()
+        return self.merged_compute()
+
+    def stop(self, timeout_s: float = 30.0) -> None:
+        """Stop every shard (idempotent, best effort on dead shards)."""
+        deadline = time.monotonic() + timeout_s
+        for service in list(self._shards):
+            service.stop(max(deadline - time.monotonic(), 0.001))
+
+    def __enter__(self) -> "MetricFleet":
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        self.stop()
+        return False
+
+    # --------------------------------------------------------------- gauges
+    def _note_gauges(self) -> None:
+        """Refresh the ``fleet_shards`` gauge ({shard: health, queue depth,
+        occupied window slots, published windows, replayed steps}). Shares
+        ``slab_slots``'s enabled gate: the occupancy read is a readback."""
+        if not _COUNTERS.enabled:
+            return
+        shards: Dict[str, Dict[str, Any]] = {}
+        with self._lock:
+            services = list(self._shards)
+        for index, service in enumerate(services):
+            rows = np.asarray(service.metric._current_state()[_ROWS_STATE])
+            shards[str(index)] = {
+                "health": service.health,
+                "queue_depth": service._queue.qsize(),
+                "occupied": int((rows > 0).sum()),
+                "published": len(service.publications),
+                "replayed": service.replayed_steps,
+            }
+        record_fleet_shards(self.label, shards)
+
+    def __repr__(self) -> str:
+        return (
+            f"MetricFleet({type(self._template.metric).__name__},"
+            f" num_shards={self.num_shards}, merged={len(self.merged_records)})"
+        )

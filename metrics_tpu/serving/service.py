@@ -61,6 +61,7 @@ Everything is host-plane supervision; the device-side cost is unchanged —
 one scatter per update, and sync rides the same coalesced psum buckets as
 the unwindowed metric.
 """
+import itertools
 import math
 import queue
 import threading
@@ -111,16 +112,36 @@ class MetricService:
             Default: degrade-over-stall with a 5 s per-call deadline — a
             serving loop must publish late rather than never.
         publish_fn: optional callback receiving each publication record.
-        label: gauge label (default ``MetricService(<inner>)``).
+        partial_publish_fn: optional callback receiving ``(record,
+            partial)`` per publish, where ``partial`` is the closed window's
+            mergeable state (:meth:`Windowed.window_partial`, captured at the
+            close point — on the deferred stage, from the close-point
+            snapshot). This is the fleet merge tier's tap
+            (``serving/fleet.py``): N ingest shards hand their window
+            partials to an aggregator that merges them by pure state
+            addition. Only computed when the hook is set.
+        name: gauge/span label. Every ``service_health`` and
+            ``deferred_depth`` entry — and the ``service.publish`` span —
+            is keyed by it, so two services in one process MUST NOT share
+            one; unnamed services get an auto-indexed
+            ``MetricService(<inner>)#<k>`` label (``label=`` is an accepted
+            alias).
         deferred_publish: run the guarded-sync half of every publish on the
             background host plane (default True) so window publish overlaps
             ingest; ``False`` restores the fully synchronous publish stage
             (the worker blocks on each window's sync before the next batch).
+        fault_site / fault_shard: the chaos-injector site this service's
+            ingest path consults (default ``service.ingest``) and the shard
+            index it reports there — the fleet runs its shards at site
+            ``fleet.shard`` with their shard index so a ``FaultSpec`` can
+            kill/stall one specific shard.
 
     The worker thread starts immediately; use as a context manager or call
     :meth:`stop`. ``submit`` raises :class:`ServiceStoppedError` once the
     worker is no longer accepting (stopped/preempted/failed).
     """
+
+    _ids = itertools.count()  # the auto-indexed default-label sequence
 
     def __init__(
         self,
@@ -129,9 +150,13 @@ class MetricService:
         shed_policy: str = "block",
         guard: Optional[SyncGuard] = None,
         publish_fn: Optional[Callable[[Dict[str, Any]], None]] = None,
+        partial_publish_fn: Optional[Callable[[Dict[str, Any], Dict[str, Any]], None]] = None,
         label: Optional[str] = None,
+        name: Optional[str] = None,
         poll_interval_s: float = 0.02,
         deferred_publish: bool = True,
+        fault_site: str = INGEST_SITE,
+        fault_shard: Optional[int] = None,
     ):
         if not isinstance(metric, Windowed):
             raise ValueError(
@@ -155,7 +180,14 @@ class MetricService:
         if self.guard.policy not in ("raise", "degrade"):
             raise ValueError(f"guard.policy must be 'raise' or 'degrade', got {self.guard.policy!r}")
         self.publish_fn = publish_fn
-        self.label = label or f"MetricService({type(metric.metric).__name__})"
+        self.partial_publish_fn = partial_publish_fn
+        # auto-indexed default: N unnamed services in one process must not
+        # overwrite each other's service_health / deferred_depth entries
+        self.label = name or label or (
+            f"MetricService({type(metric.metric).__name__})#{next(MetricService._ids)}"
+        )
+        self.fault_site = str(fault_site)
+        self.fault_shard = fault_shard
         self.poll_interval_s = float(poll_interval_s)
         self.deferred_publish = bool(deferred_publish)
         # the deferred stage's double buffer: a detached twin whose states
@@ -172,6 +204,7 @@ class MetricService:
         self._published_through: Optional[int] = None  # highest window published
         self.publications: List[Dict[str, Any]] = []
         self.shed_events = 0
+        self._replayed = 0  # guarded_update no-ops (idempotent replay skips)
         self._shed_since_publish = 0
         self._last_publish_degraded = False
         self.last_snapshot: Optional[Dict[str, Any]] = None
@@ -211,6 +244,12 @@ class MetricService:
     def processed(self) -> int:
         """Batches fully applied (or idempotently skipped on replay)."""
         return self._processed
+
+    @property
+    def replayed_steps(self) -> int:
+        """Batches the epoch watermark skipped as already-folded replays —
+        the idempotence evidence after a restore-and-replay failover."""
+        return self._replayed
 
     def submit(self, *args: Any, event_time: Any = None, seq: Optional[int] = None,
                **kwargs: Any) -> int:
@@ -297,7 +336,7 @@ class MetricService:
         idx = self._ingest_idx
         self._ingest_idx += 1
         if injector is not None:
-            for spec in injector.ingest_faults(INGEST_SITE, idx):
+            for spec in injector.ingest_faults(self.fault_site, idx, shard=self.fault_shard):
                 if spec.kind == "ingest_stall":
                     time.sleep(spec.duration_s)
                 elif spec.kind == "clock_skew":
@@ -308,10 +347,40 @@ class MetricService:
                     raise PreemptionError(
                         f"injected service preemption at ingest call {idx} (seq {seq})"
                     )
-        self.metric.guarded_update(seq, *args, event_time=times, **kwargs)
+        self._publish_expiring(times)
+        if not self.metric.guarded_update(seq, *args, event_time=times, **kwargs):
+            self._replayed += 1
         self._processed += 1
         self._publish_closed()
         self._note_health()
+
+    def _publish_expiring(self, times: np.ndarray) -> None:
+        """Publish — BEFORE the batch applies — every resident window the
+        batch's watermark advance will expire from the ring.
+
+        A sparse stream (one fleet shard sees 1/N of the traffic) can jump
+        the watermark several windows in one batch; the window roll then
+        recycles slots whose windows were never published, silently losing
+        them. Those windows' contents are final here: a window the new
+        watermark expires (``w <= new_head - W``) cannot receive an event
+        from this very batch, because the allowed lateness is capped at
+        ``(W - 1) * window_s`` — such an event would be beyond it and
+        dropped. So publishing pre-update is bit-exact, and no closed window
+        is ever lost to a watermark jump.
+        """
+        wm = self.metric.watermark
+        peak = float(times.max()) if times.size else None
+        if peak is None:
+            return
+        new_wm = peak if wm is None else max(wm, peak)
+        m = self.metric
+        expire_below = int(math.floor(new_wm / m.window_s)) - m.num_windows + 1
+        for window in m.resident_windows():
+            if window >= expire_below:
+                break
+            if self._published_through is not None and window <= self._published_through:
+                continue
+            self._publish(window)
 
     def _closed_through(self) -> Optional[int]:
         """Highest window index no future event can reach: ``w`` is closed
@@ -392,6 +461,7 @@ class MetricService:
         attrs = None
         if _TRACE.enabled:
             attrs = {
+                "service": self.label,
                 "window": window,
                 "queue_depth": book["queue_depth"],
                 "deferred": "yes" if snap is not None else "no",
@@ -406,9 +476,14 @@ class MetricService:
                 set_sync_guard(old_guard)
             degraded = _COUNTERS.faults["degraded_computes"] > before
             value = metric.compute_window(window)
+            partial = (
+                metric.window_partial(window)
+                if self.partial_publish_fn is not None else None
+            )
             if attrs is not None:
                 attrs["degraded"] = "yes" if degraded else "no"
             record = {
+                "service": self.label,
                 "window": window,
                 "window_start_s": window * self.metric.window_s,
                 "value": _host(value),
@@ -432,6 +507,8 @@ class MetricService:
                 }
             if self.publish_fn is not None:
                 self.publish_fn(record)
+            if self.partial_publish_fn is not None:
+                self.partial_publish_fn(record, partial)
             self._note_health()
 
     def _drain_publishes(self, timeout_s: float) -> None:

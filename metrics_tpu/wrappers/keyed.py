@@ -271,6 +271,12 @@ class Keyed(Metric):
         accepts ``compute(slot=k)`` for a single-segment read."""
         state = self._current_state()
         rows = state.pop(_ROWS_STATE)
+        return self._finish_slab(state, rows)
+
+    def _finish_slab(self, state: State, rows: Array) -> Any:
+        """The shared per-slot finisher: sum-backed mean division, vmapped
+        inner compute, empty-slot masking (``compute`` over the live slab and
+        :meth:`value_from_partials` over a merged one)."""
         inner_state: State = {}
         for name, value in state.items():
             if self._slab_reduce[name] == "mean":
@@ -291,6 +297,64 @@ class Keyed(Metric):
             return jnp.where(occ, r, jnp.zeros((), dtype=r.dtype))
 
         return jax.tree_util.tree_map(mask, results)
+
+    # -------------------------------------------------- mergeable partials
+    def mergeable_partial(self) -> Dict[str, Any]:
+        """The full slab state as a host-transferable, mergeable partial:
+        ``{"rows", "state"}`` with every leaf in RAW (sum-backed) form.
+
+        Partials from N ingest shards — each shard accumulating a disjoint
+        (or overlapping: merge is pure addition / min / max per the slot's
+        reduce kind) share of the traffic over the SAME slot layout — merge
+        through :meth:`value_from_partials` into the global per-segment
+        values, bit-exact vs one process accumulating everything. LRU mode
+        is excluded: two shards' key->slot maps need not agree, so their
+        slabs are not row-aligned (use ``lru=False`` with stable slot ids —
+        e.g. the fleet's stable key hash — for mergeable deployments).
+        """
+        if self.lru:
+            raise ValueError(
+                "Keyed(lru=True) slabs are not mergeable across processes: each"
+                " LRU table maps keys to rows independently, so two slabs'"
+                " rows need not describe the same segment — use lru=False"
+                " with stable slot ids"
+            )
+        state = self._current_state()
+        rows = state.pop(_ROWS_STATE)
+        out: Dict[str, Any] = {}
+        for name, value in state.items():
+            if is_sketch(value):
+                out[name] = type(value)(np.asarray(value.counts))
+            else:
+                out[name] = np.asarray(value)
+        return {"rows": np.asarray(rows), "state": out}
+
+    def value_from_partials(self, partials) -> Any:
+        """All K per-segment values over merged partials (pure state
+        addition per the reduce kind, then the ordinary finisher) — the
+        aggregation-tier read for a sharded keyed deployment."""
+        acc: State = {}
+        rows = jnp.zeros((self.num_slots,), jnp.float32)
+        for partial in partials:
+            rows = rows + jnp.asarray(partial["rows"], jnp.float32)
+            for name, leaf in partial["state"].items():
+                reduce = self._slab_reduce[name]
+                if name not in acc:
+                    acc[name] = (
+                        type(leaf)(jnp.asarray(leaf.counts)) if is_sketch(leaf)
+                        else jnp.asarray(leaf)
+                    )
+                elif is_sketch(leaf):
+                    acc[name] = type(leaf)(acc[name].counts + jnp.asarray(leaf.counts))
+                else:
+                    acc[name] = slab_merge(reduce, acc[name], jnp.asarray(leaf))
+        if not acc:  # no partials: every slot empty
+            state = {
+                name: slab_init(spec)
+                for name, spec in self._defaults.items() if name != _ROWS_STATE
+            }
+            return self._finish_slab(state, rows)
+        return self._finish_slab(acc, rows)
 
     def _wrap_compute(self, compute: Callable) -> Callable:
         """The base wrapper (sync + cache) plus the ``slot=`` read form.

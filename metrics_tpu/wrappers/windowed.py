@@ -464,6 +464,92 @@ class Windowed(Metric):
         result = self.metric.compute_from_state(inner_state)
         return self._mask_empty(result, rows[slot] > 0)
 
+    # -------------------------------------------------- mergeable partials
+    def window_partial(self, window: int) -> Dict[str, Any]:
+        """One resident window's RAW state rows as a host-transferable,
+        mergeable partial: ``{"window", "rows", "state"}``.
+
+        This is the fleet merge tier's unit of exchange
+        (``serving/fleet.py``): every leaf is the slot's untouched
+        accumulator — sum-backed means stay SUMS, sketch leaves keep their
+        integer counts — so partials from N ingest shards merge by the
+        slot's own reduce kind (:meth:`value_from_partials`) and the merged
+        value is bit-exact the value one process accumulating all the
+        samples would compute. Leaves are host numpy (a partial is meant to
+        cross a process/queue boundary, not to stay a device reference).
+        """
+        if self.decay:
+            raise ValueError("the decay accumulator has no windows; partials are per-window")
+        if window not in self.resident_windows():
+            raise KeyError(
+                f"window {window} is not resident (resident: {self.resident_windows()});"
+                " it expired from the ring or has not opened yet"
+            )
+        slot = window % self.num_windows
+        state = self._current_state()
+        rows = state.pop(_ROWS_STATE)
+        out: Dict[str, Any] = {}
+        for name, value in state.items():
+            if is_sketch(value):
+                out[name] = type(value)(np.asarray(value.counts[slot]))
+            else:
+                out[name] = np.asarray(value[slot])
+        return {"window": int(window), "rows": np.asarray(rows[slot]), "state": out}
+
+    def _empty_partial(self) -> Dict[str, Any]:
+        """The identity partial (a shard that saw no samples): per-slot
+        defaults, zero rows — merging it in changes nothing."""
+        state: Dict[str, Any] = {}
+        for name, spec in self._defaults.items():
+            if name == _ROWS_STATE:
+                continue
+            fresh = slab_init(spec)
+            state[name] = (
+                type(fresh)(np.asarray(fresh.counts[0])) if is_sketch(fresh)
+                else np.asarray(fresh[0])
+            )
+        return {"window": -1, "rows": np.zeros((), np.float32), "state": state}
+
+    def merge_partials(self, partials) -> tuple:
+        """Merge :meth:`window_partial` outputs by pure state addition (sum/
+        mean leaves and sketch counts add; min/min, max/max) — returns the
+        ``(inner_state, rows)`` pair still in RAW (sum-backed) form. The
+        partials need not come from the same window: merging one window's
+        partials across shards gives that window's global state, merging
+        every resident window's partials gives the sliding view's."""
+        if not partials:
+            partials = [self._empty_partial()]
+        acc: State = {}
+        rows = jnp.zeros((), jnp.float32)
+        for partial in partials:
+            rows = rows + jnp.asarray(partial["rows"], jnp.float32)
+            for name, leaf in partial["state"].items():
+                reduce = self._slab_reduce[name]
+                if name not in acc:
+                    acc[name] = (
+                        type(leaf)(jnp.asarray(leaf.counts)) if is_sketch(leaf)
+                        else jnp.asarray(leaf)
+                    )
+                elif is_sketch(leaf):
+                    acc[name] = type(leaf)(acc[name].counts + jnp.asarray(leaf.counts))
+                else:
+                    acc[name] = slab_merge(reduce, acc[name], jnp.asarray(leaf))
+        return acc, rows
+
+    def value_from_partials(self, partials) -> Any:
+        """The finished inner value over merged partials: merge, divide the
+        sum-backed means by the merged sample count, run the inner finisher,
+        and apply the ``empty`` policy when no samples are resident — the
+        merge tier's read, bit-exact vs a single accumulating process."""
+        merged, rows = self.merge_partials(partials)
+        inner_state: State = {}
+        for name, value in merged.items():
+            if self._slab_reduce[name] == "mean" and not is_sketch(value):
+                value = value / self._mean_denom(rows, value.dtype)
+            inner_state[name] = value
+        result = self.metric.compute_from_state(inner_state)
+        return self._mask_empty(result, rows > 0)
+
     @staticmethod
     def _mean_denom(rows: Array, dtype: Any) -> Array:
         """Sum-backed mean divisor: the (possibly decayed) sample count,

@@ -132,6 +132,21 @@ def _assert_headline_schema(out):
     assert isinstance(out["service_ingest_steps_per_s"], (int, float))
     assert out["service_ingest_steps_per_s"] > 0
 
+    # the sharded fleet scenario: the 1-vs-8-shard ingest throughput pair
+    # over the simulated per-batch serving work (--check-fleet gates the
+    # ratio at >= 4x; here only sanity + the merge tier's exact counts —
+    # the 8-shard number must at least beat the 1-shard loop even under
+    # smoke noise)
+    for key in ("fleet_ingest_steps_per_s", "fleet_ingest_steps_per_s_1shard"):
+        assert isinstance(out[key], (int, float)) and out[key] > 0, key
+    assert out["fleet_ingest_steps_per_s"] > out["fleet_ingest_steps_per_s_1shard"]
+    assert out["fleet_scaling_x"] > 1.0
+    # deterministic merge-tier counts over the seeded exact stream: 7 merged
+    # windows from 8 shards' 41 per-shard publishes, zero lost
+    assert out["fleet_shards_merged_windows"] == 7
+    assert out["fleet_shards_published_windows"] == 41
+    assert out["fleet_lost_windows"] == 0
+
     # fault counters ride the default line and are ZERO on a clean bench run
     # (--check-trajectory pins them at zero on every new BENCH_r* round);
     # slab_dropped_samples joins them — in-window bench traffic never drops
@@ -155,16 +170,19 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     out = _run_smoke(("--trace", str(trace_file)))
     _assert_headline_schema(out)
 
-    # schema version of the --trace payload: v8 added the lag-k pipelined
-    # plane (async_lag2/3_ms ring-depth keys, async_lag_* staged-count pins,
-    # and the deferred-epoch-gather call-count pair on the default line); v7
-    # added the deferred-sync A/B (async_* staged-count keys + the fenced
-    # twin + service_ingest_steps_per_s on the default line, full async
-    # counters here incl. the deferred dispatch/fence/completion block); v6
-    # added the windowed serving A/B; v5 the keyed slab A/B; v4 the sketch
-    # A/B; v3 moved the collective counts to the default line and added the
+    # schema version of the --trace payload: v9 added the sharded fleet
+    # (fleet_ingest_steps_per_s at 1/8 shards + fleet_scaling_x + the merge
+    # tier's window counts with fleet_lost_windows pinned at zero); v8 added
+    # the lag-k pipelined plane (async_lag2/3_ms ring-depth keys,
+    # async_lag_* staged-count pins, and the deferred-epoch-gather
+    # call-count pair on the default line); v7 added the deferred-sync A/B
+    # (async_* staged-count keys + the fenced twin +
+    # service_ingest_steps_per_s on the default line, full async counters
+    # here incl. the deferred dispatch/fence/completion block); v6 added the
+    # windowed serving A/B; v5 the keyed slab A/B; v4 the sketch A/B; v3
+    # moved the collective counts to the default line and added the
     # hierarchical A/B + per-crossing counters; bump this pin with the schema
-    assert out["trace_schema"] == 8
+    assert out["trace_schema"] == 9
     # the sketch program's full snapshot: psum-only, no gather kinds staged
     sketch_kinds = out["sketch_counters"]["calls_by_kind"]
     assert sketch_kinds.get("psum", 0) == 2
@@ -446,6 +464,39 @@ def test_bench_check_service_gate():
     assert out["chaos"]["injected"]["preempt"] == 1
 
 
+def test_bench_check_fleet_gate():
+    """``bench.py --check-fleet`` is the sharded-serving gate: the merged
+    fleet output must be bit-exact vs the single-process oracle at shard
+    counts {1, 2, 8} (windows exactly once, in order, sample counts
+    conserved), 8-shard ingest throughput must reach 4x the 1-shard loop
+    over the simulated per-batch serving work, and the seeded chaos soak
+    (stall one shard, kill another mid-stream) must recover via
+    snapshot/restore + replay-log overlap replay with zero lost windows and
+    no double-published merged window."""
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, _BENCH, "--check-fleet"],
+        capture_output=True, text=True, timeout=280, env=env,
+        cwd=os.path.dirname(_BENCH),
+    )
+    assert proc.returncode == 0, f"--check-fleet failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["ok"] is True and out["failures"] == []
+    # exact: every shard count merged the same 7 oracle windows
+    assert set(out["exact"]) == {"1", "2", "8"}
+    assert len({row["merged_windows"] for row in out["exact"].values()}) == 1
+    # more shards -> more per-shard publishes, same merged stream
+    assert out["exact"]["8"]["shard_publishes"] > out["exact"]["1"]["shard_publishes"]
+    # scaling: the near-linear headline, gated at >= 4x
+    assert out["scaling"]["x"] >= out["scaling"]["min_x"] == 4.0
+    # chaos: exactly one kill, recovered, idempotent replay exercised
+    assert out["chaos"]["injected"]["preempt"] == 1
+    assert out["chaos"]["recoveries"] >= 1
+    assert out["chaos"]["replayed"] >= 1
+    assert out["chaos"]["elapsed_s"] < out["chaos"]["budget_s"]
+
+
 def _run_trajectory(tmp_path, current, rounds):
     rounds_dir = tmp_path / "rounds"
     rounds_dir.mkdir(exist_ok=True)
@@ -508,6 +559,29 @@ def test_bench_check_trajectory_gate_fails_on_injected_regression(tmp_path):
     rc, out = _run_trajectory(tmp_path, improved, {6: _TRAJECTORY_BASE})
     assert rc == 0
     assert out["checks"]["collective_calls"]["status"] == "improved"
+
+
+def test_bench_check_trajectory_gates_rate_keys_as_collapse_detectors(tmp_path):
+    """Throughput keys (``*_steps_per_s``) gate as collapse detectors: a
+    value below best-prior / 3 fails, ordinary wobble (and improvement)
+    passes, and fleet_lost_windows binds at zero like the fault keys."""
+    base = dict(_TRAJECTORY_BASE, fleet_ingest_steps_per_s=36.0,
+                fleet_lost_windows=0)
+    wobbly = dict(base, fleet_ingest_steps_per_s=20.0)  # above 36/3
+    rc, out = _run_trajectory(tmp_path, wobbly, {11: base})
+    assert rc == 0, out
+    assert out["checks"]["fleet_ingest_steps_per_s"]["status"] == "ok"
+
+    collapsed = dict(base, fleet_ingest_steps_per_s=5.0)  # below 36/3
+    rc, out = _run_trajectory(tmp_path, collapsed, {11: base})
+    assert rc == 1
+    assert any("fleet_ingest_steps_per_s" in f for f in out["failures"])
+    assert out["checks"]["fleet_ingest_steps_per_s"]["status"] == "regression"
+
+    lossy = dict(base, fleet_lost_windows=1)
+    rc, out = _run_trajectory(tmp_path, lossy, {11: base})
+    assert rc == 1
+    assert any("fleet_lost_windows" in f for f in out["failures"])
 
 
 def test_bench_check_trajectory_pins_fault_counters_at_zero(tmp_path):

@@ -569,6 +569,54 @@ def test_ingest_faults_consecutive_call_semantics_and_counts():
     assert inj.ingest_faults("host_gather", 2) == []
 
 
+def test_fleet_shard_addressing():
+    """The fleet site (PR 12): ``shard=`` pins a spec to one shard's ingest
+    stream — ``idx`` is then that shard's OWN call counter — and
+    ``shard=None`` matches every shard. Validation is loud."""
+    schedule = [
+        faults.FaultSpec(kind="preempt", call=3, times=1, site="fleet.shard", shard=2),
+        faults.FaultSpec(kind="ingest_stall", call=1, times=1, duration_s=0.0,
+                         site="fleet.shard"),  # shard=None: every shard
+    ]
+    inj = faults.ChaosInjector(schedule, seed=0)
+    # the kill fires only for shard 2, only on its call 3
+    assert [s.kind for s in inj.ingest_faults("fleet.shard", 3, shard=2)] == ["preempt"]
+    assert inj.ingest_faults("fleet.shard", 3, shard=1) == []
+    assert inj.ingest_faults("fleet.shard", 2, shard=2) == []
+    # the wildcard stall fires on every shard's call 1
+    for shard in (0, 1, 2, 5):
+        assert [s.kind for s in inj.ingest_faults("fleet.shard", 1, shard=shard)] == [
+            "ingest_stall"
+        ]
+    assert inj.injected["preempt"] == 1
+    assert inj.injected["ingest_stall"] == 4
+    with pytest.raises(ValueError, match="shard="):
+        faults.ChaosInjector([faults.FaultSpec(kind="preempt", call=0, shard=-1)])
+    with pytest.raises(ValueError, match="shard="):
+        faults.ChaosInjector([faults.FaultSpec(kind="preempt", call=0, shard=1.5)])
+
+
+def test_fleet_shard_rate_verdicts_independent_per_shard():
+    """Rate-based wildcard specs at the fleet site draw per-(spec, call,
+    shard) verdicts: stable on re-ask, but two shards at the same call index
+    are independent draws (one seeded schedule, no cross-shard lockstep)."""
+    spec = faults.FaultSpec(kind="ingest_stall", rate=0.5, duration_s=0.0,
+                            site="fleet.shard")
+    inj = faults.ChaosInjector([spec], seed=3)
+    verdicts = {
+        (shard, idx): bool(inj.ingest_faults("fleet.shard", idx, shard=shard))
+        for shard in range(4) for idx in range(16)
+    }
+    again = {
+        (shard, idx): bool(inj.ingest_faults("fleet.shard", idx, shard=shard))
+        for shard in range(4) for idx in range(16)
+    }
+    assert verdicts == again  # stable per (spec, call, shard)
+    per_shard = [[verdicts[(s, i)] for i in range(16)] for s in range(4)]
+    assert any(row != per_shard[0] for row in per_shard[1:])  # not lockstep
+    assert any(any(row) for row in per_shard) and not all(all(row) for row in per_shard)
+
+
 def test_rate_verdicts_stable_across_threads():
     """The determinism audit for the service's background thread: a
     rate-based verdict is decided once per (spec, call) from the seeded RNG

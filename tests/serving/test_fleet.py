@@ -1,0 +1,361 @@
+"""MetricFleet: the sharded serving runtime's contract.
+
+What must hold (serving/fleet.py):
+
+- routing: ``stable_key_hash`` is a process-restart-stable FNV-1a (pinned
+  against precomputed values, NOT against another in-process call — that
+  would pass even with a salted hash), ``shard_for_key`` partitions with it,
+  and non-canonical key types are rejected loudly;
+- merge tier: merged records cover every oracle window exactly once, in
+  window order, bit-exact vs a single-process oracle at several shard
+  counts, with per-window sample counts conserved (zero lost, zero
+  misrouted, zero double-counted);
+- failover: a chaos ``preempt`` addressed at ``site="fleet.shard",
+  shard=i`` kills exactly that shard; ``recover_shard`` (snapshot/restore +
+  replay-log overlap replay through ``guarded_update``) brings it back with
+  no double-published merged window and values still bit-exact — at the
+  FLEET level, extending the single-service replay tests in
+  ``tests/serving/test_service.py``;
+- isolation: a hot shard's shedding/backpressure does not stall the other
+  shards;
+- gauges: ``fleet_shards`` rides the counters snapshot with per-shard
+  health/queue/occupancy/published/replayed entries.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import metrics_tpu.observability as obs
+from metrics_tpu import Accuracy, MetricFleet, Windowed
+from metrics_tpu.parallel import faults
+from metrics_tpu.parallel.sync import gather_all_arrays
+from metrics_tpu.serving import ShardStoppedError, shard_for_key, stable_key_hash
+from metrics_tpu.serving.fleet import FLEET_SITE
+
+W, NW, LATE = 10.0, 4, 20.0
+
+
+def _factory():
+    return Windowed(Accuracy(), window_s=W, num_windows=NW, allowed_lateness_s=LATE,
+                    dist_sync_fn=gather_all_arrays)
+
+
+def _balanced_keys(per_shard, shards):
+    keys, buckets = [], {s: 0 for s in range(shards)}
+    j = 0
+    while any(v < per_shard for v in buckets.values()):
+        k = f"tenant-{j}"
+        j += 1
+        s = shard_for_key(k, shards)
+        if buckets[s] < per_shard:
+            buckets[s] += 1
+            keys.append(k)
+    return keys
+
+
+def _stream(n=20, size=12, seed=0, shards=4):
+    rng = np.random.RandomState(seed)
+    keys = _balanced_keys(max(n // shards, 1), shards)
+    out = []
+    for i in range(n):
+        t = i * 2.5 + rng.uniform(0, 2.5, size)
+        late = rng.rand(size) < 0.2
+        t = np.where(late, t - rng.uniform(0, 8.0, size), t)
+        out.append((keys[i % len(keys)], t,
+                    rng.rand(size).astype(np.float32),
+                    rng.randint(0, 2, size).astype(np.int32)))
+    return out
+
+
+def _oracle(batches):
+    """Global-watermark routing + fresh-metric window values (keys ignored:
+    partitioning must never change a value)."""
+    wm, events = None, {}
+    for _key, t, p, y in batches:
+        wm = float(t.max()) if wm is None else max(wm, float(t.max()))
+        head = int(np.floor(wm / W))
+        w = np.floor_divide(t, W).astype(np.int64)
+        ok = ((w + 1) * W + LATE > wm) & (w > head - NW)
+        assert ok.all(), "test streams must not drop (shard watermarks lag the global)"
+        for j in range(len(t)):
+            events.setdefault(int(w[j]), []).append((p[j], y[j]))
+    origin = min(events)
+    published = list(range(origin, head + 1))
+    resident = [w for w in published if w > head - NW]
+
+    def value(ws):
+        pairs = [x for w in ws for x in events.get(w, [])]
+        if not pairs:
+            return np.asarray(np.nan, np.float32)
+        m = Accuracy()
+        m.update(jnp.asarray(np.array([a for a, _ in pairs], np.float32)),
+                 jnp.asarray(np.array([b for _, b in pairs], np.int32)))
+        return np.asarray(m.compute())
+
+    return {"published": published, "values": {w: value([w]) for w in published},
+            "merged": value(resident), "counts": {w: len(events.get(w, [])) for w in published}}
+
+
+def _feed(fleet, batches):
+    for key, t, p, y in batches:
+        fleet.submit(key, jnp.asarray(p), jnp.asarray(y), event_time=t)
+
+
+def _assert_matches_oracle(records, merged, oracle):
+    windows = [r["window"] for r in records]
+    assert windows == sorted(set(windows)), "merged records out of order or duplicated"
+    assert sorted(set(windows)) == oracle["published"], "lost (or invented) windows"
+    for r in records:
+        np.testing.assert_array_equal(r["value"], oracle["values"][r["window"]],
+                                      err_msg=f"window {r['window']}")
+        assert r["rows"] == oracle["counts"][r["window"]], (
+            f"window {r['window']}: merged {r['rows']} samples,"
+            f" oracle routed {oracle['counts'][r['window']]}"
+        )
+    np.testing.assert_array_equal(merged, oracle["merged"])
+
+
+# ------------------------------------------------------------------ routing
+def test_stable_key_hash_is_pinned_across_processes():
+    # pinned FNV-1a values: a restarted process (or another language's
+    # implementation of the documented hash) MUST reproduce these exactly —
+    # comparing two in-process calls would not catch a salted hash
+    assert stable_key_hash("tenant-0") == 0x1CE48A04A2FF1955
+    assert stable_key_hash("tenant-1") == 0x1CE48904A2FF17A2
+    assert stable_key_hash(b"tenant-0") == 0x3D82925F040C1B10
+    assert stable_key_hash(0) == 0x2B0A3B192B55573E
+    assert stable_key_hash(12345) == 0xDBD8F4A96E701FD1
+
+
+def test_shard_for_key_is_the_mod_partition_and_type_tagged():
+    for key in ("t", b"t", 7, np.int64(7)):
+        assert shard_for_key(key, 8) == stable_key_hash(key) % 8
+    assert stable_key_hash(1) != stable_key_hash("1")  # type-tagged canonical bytes
+    assert stable_key_hash(7) == stable_key_hash(np.int64(7))
+    with pytest.raises(TypeError, match="str, bytes or int"):
+        stable_key_hash(1.5)
+    with pytest.raises(TypeError, match="str, bytes or int"):
+        stable_key_hash(("a", 1))
+    with pytest.raises(ValueError, match="num_shards"):
+        shard_for_key("t", 0)
+
+
+def test_router_deterministic_across_fleet_restarts():
+    """The same keys route to the same shards in a freshly built fleet (the
+    restart story: no per-process salt anywhere in the path)."""
+    keys = [f"user-{i}" for i in range(64)]
+    with MetricFleet(_factory, num_shards=4) as a:
+        route_a = {k: a.shard_of(k) for k in keys}
+    with MetricFleet(_factory, num_shards=4) as b:
+        route_b = {k: b.shard_of(k) for k in keys}
+    assert route_a == route_b
+    assert set(route_a.values()) == {0, 1, 2, 3}  # 64 keys spread over all shards
+
+
+def test_constructor_validation():
+    with pytest.raises(ValueError, match="num_shards"):
+        MetricFleet(_factory, num_shards=0)
+    with pytest.raises(ValueError, match="callable"):
+        MetricFleet("nope", num_shards=2)
+    with pytest.raises(ValueError, match="Windowed"):
+        MetricFleet(lambda: Accuracy(), num_shards=2)
+    with pytest.raises(ValueError, match="Windowed"):
+        MetricFleet(lambda: Windowed(Accuracy(), decay_half_life_s=5.0), num_shards=2)
+    with pytest.raises(ValueError, match="replay_log"):
+        MetricFleet(_factory, num_shards=2, replay_log=0)
+
+
+# --------------------------------------------------------------- merge tier
+@pytest.mark.parametrize("num_shards", [1, 2, 4])
+def test_merged_output_bit_exact_vs_single_process_oracle(num_shards):
+    batches = _stream()
+    oracle = _oracle(batches)
+    with MetricFleet(_factory, num_shards=num_shards) as fleet:
+        _feed(fleet, batches)
+        merged = np.asarray(fleet.finalize())
+        records = list(fleet.merged_records)
+    _assert_matches_oracle(records, merged, oracle)
+
+
+def test_merge_overlaps_ingest_and_emits_in_window_order():
+    """Merged records arrive through merged_publish_fn in window order, and
+    early windows are already merged before the stream ends (the merge tier
+    runs on the shards' publish stages, not at finalize)."""
+    batches = _stream(n=24)
+    seen = []
+    with MetricFleet(_factory, num_shards=2, merged_publish_fn=lambda r: seen.append(r)) as fleet:
+        _feed(fleet, batches)
+        fleet.flush()
+        mid_stream = len(seen)
+        fleet.finalize()
+    assert mid_stream >= 1, "nothing merged before finalize"
+    windows = [r["window"] for r in seen]
+    assert windows == sorted(windows) and len(set(windows)) == len(windows)
+    assert seen[0]["forced"] is False  # closed by every shard, not forced
+
+
+def test_empty_shards_merge_as_identity():
+    """Fewer tenants than shards: traffic-less shards contribute nothing and
+    block nothing at finalize."""
+    batches = [(f"solo-{i % 2}", np.asarray([5.0 * i + 1.0]),
+                np.float32([0.9]), np.int32([1])) for i in range(8)]
+    oracle = _oracle(batches)
+    with MetricFleet(_factory, num_shards=8) as fleet:
+        _feed(fleet, batches)
+        merged = np.asarray(fleet.finalize())
+        records = list(fleet.merged_records)
+    _assert_matches_oracle(records, merged, oracle)
+
+
+def test_windowed_keyed_composition_partials_merge():
+    """Windowed(Keyed(...)) shards merge per-window per-segment slabs — the
+    'per-tenant-cohort AUROC over the last N windows' fleet story."""
+    from metrics_tpu import Keyed
+
+    def factory():
+        return Windowed(Keyed(Accuracy(), num_slots=3), window_s=W, num_windows=NW,
+                        allowed_lateness_s=LATE, dist_sync_fn=gather_all_arrays)
+
+    rng = np.random.RandomState(7)
+    oracle = factory()
+    shards = [factory(), factory()]
+    for i in range(6):
+        t = np.full(6, i * 5.0 + 1.0)
+        p = rng.rand(6).astype(np.float32)
+        y = rng.randint(0, 2, 6).astype(np.int32)
+        slots = rng.randint(0, 3, 6).astype(np.int32)
+        shards[i % 2].update(jnp.asarray(p), jnp.asarray(y), event_time=t, slot=jnp.asarray(slots))
+        oracle.update(jnp.asarray(p), jnp.asarray(y), event_time=t, slot=jnp.asarray(slots))
+    template = factory()
+    for w in oracle.resident_windows():
+        parts = [m.window_partial(w) for m in shards if w in m.resident_windows()]
+        np.testing.assert_array_equal(
+            np.asarray(template.value_from_partials(parts)),
+            np.asarray(oracle.compute_window(w)), err_msg=f"window {w}",
+        )
+
+
+# ----------------------------------------------------------------- failover
+def test_shard_kill_recover_replay_is_idempotent_at_fleet_level():
+    """Kill one shard mid-stream (seeded, shard-addressed), recover it, and
+    the merged stream is exactly the uninterrupted oracle's: no lost window,
+    no double-published merged window, watermark replay no-ops the overlap."""
+    batches = _stream(n=24)
+    oracle = _oracle(batches)
+    kill = shard_for_key(batches[2][0], 4)
+    schedule = [faults.FaultSpec(kind="preempt", call=4, times=1,
+                                 site=FLEET_SITE, shard=kill)]
+    with faults.ChaosInjector(schedule, seed=0) as inj:
+        with MetricFleet(_factory, num_shards=4) as fleet:
+            recovered = 0
+            for key, t, p, y in batches:
+                try:
+                    fleet.submit(key, jnp.asarray(p), jnp.asarray(y), event_time=t)
+                except ShardStoppedError as err:
+                    assert err.shard == kill
+                    fleet.recover_shard(err.shard)
+                    recovered += 1
+            try:
+                fleet.flush()
+            except Exception:
+                for i, svc in enumerate(fleet.shards):
+                    if svc.state != "running":
+                        fleet.recover_shard(i)
+                        recovered += 1
+                fleet.flush()
+            merged = np.asarray(fleet.finalize())
+            records = list(fleet.merged_records)
+            replayed = sum(s.replayed_steps for s in fleet.shards)
+    assert inj.injected["preempt"] == 1
+    assert recovered == 1
+    assert replayed >= 1, "the overlap replay never exercised guarded_update idempotence"
+    _assert_matches_oracle(records, merged, oracle)
+
+
+def test_recover_shard_routing_survives_restore():
+    """A recovered shard still owns exactly its key partition — restores are
+    shard-count-preserving, so the stable hash keeps routing identical."""
+    batches = _stream(n=16)
+    with MetricFleet(_factory, num_shards=4) as fleet:
+        before = {key: fleet.shard_of(key) for key, *_ in batches}
+        _feed(fleet, batches[:8])
+        fleet.flush()
+        victim = before[batches[0][0]]
+        fleet.recover_shard(victim)
+        after = {key: fleet.shard_of(key) for key, *_ in batches}
+        assert before == after
+        _feed(fleet, batches[8:])
+        merged = np.asarray(fleet.finalize())
+        records = list(fleet.merged_records)
+    _assert_matches_oracle(records, merged, _oracle(batches))
+
+
+def test_recover_shard_validation():
+    with MetricFleet(_factory, num_shards=2) as fleet:
+        with pytest.raises(ValueError, match="shard must be"):
+            fleet.recover_shard(5)
+
+
+# ---------------------------------------------------------------- isolation
+def test_hot_shard_sheds_without_stalling_the_others():
+    """drop_oldest on a stalled hot shard sheds ITS batches only; the other
+    shards' streams flow and the merge tier still emits (forced at finalize
+    where the hot shard's data went missing)."""
+    keys = _balanced_keys(1, 2)  # one tenant per shard
+    hot, cold = keys[0], keys[1]
+    hot_shard = shard_for_key(hot, 2)
+    schedule = [faults.FaultSpec(kind="ingest_stall", rate=1.0, duration_s=0.2,
+                                 site=FLEET_SITE, shard=hot_shard)]
+    rng = np.random.RandomState(3)
+    with faults.ChaosInjector(schedule, seed=0):
+        with MetricFleet(_factory, num_shards=2, queue_size=2,
+                         shed_policy="drop_oldest") as fleet:
+            for i in range(8):
+                t = np.full(4, i * 2.0 + 0.5)
+                p = rng.rand(4).astype(np.float32)
+                y = rng.randint(0, 2, 4).astype(np.int32)
+                for key in (hot, cold):
+                    fleet.submit(key, jnp.asarray(p), jnp.asarray(y), event_time=t)
+                # pace the producer so the COLD worker keeps up; the hot
+                # worker (0.2 s/batch stall) still falls behind and sheds
+                time.sleep(0.05)
+            fleet.flush(60)
+            shed = [s.shed_events for s in fleet.shards]
+            processed = [s.processed for s in fleet.shards]
+    assert shed[hot_shard] >= 1, "the hot shard never shed under the stall"
+    other = 1 - hot_shard
+    assert shed[other] == 0
+    assert processed[other] == 8, "the cold shard was stalled by the hot one"
+
+
+# ------------------------------------------------------------------- gauges
+def test_fleet_shards_gauge_in_snapshot():
+    batches = _stream(n=12)
+    obs.enable()
+    obs.reset()
+    try:
+        with MetricFleet(_factory, num_shards=3, name="fleet-gauge-test") as fleet:
+            _feed(fleet, batches)
+            fleet.finalize()
+        snap = obs.counters_snapshot()
+    finally:
+        obs.disable()
+    gauges = snap["fleet_shards"]["fleet-gauge-test"]
+    assert set(gauges) == {"0", "1", "2"}
+    for row in gauges.values():
+        assert set(row) == {"health", "queue_depth", "occupied", "published", "replayed"}
+        assert row["health"] in ("healthy", "degraded", "shedding")
+    assert sum(row["published"] for row in gauges.values()) >= 3
+    # shard services report under fleet-scoped labels in service_health
+    shard_labels = [k for k in snap["service_health"] if k.startswith("fleet-gauge-test/shard")]
+    assert len(shard_labels) == 3
+
+
+def test_fleet_requires_tenant_key_types():
+    with MetricFleet(_factory, num_shards=2) as fleet:
+        with pytest.raises(TypeError, match="str, bytes or int"):
+            fleet.submit(3.14, jnp.asarray(np.float32([0.5])),
+                         jnp.asarray(np.int32([1])), event_time=np.array([1.0]))

@@ -316,6 +316,85 @@ def test_soak_long():
     _soak(120)
 
 
+# ---------------------------------------------------- gauge-label identity
+def test_unnamed_services_get_distinct_auto_indexed_labels():
+    """Two services over the same inner metric must not overwrite each
+    other's gauges: unnamed instances auto-index their label, and both
+    service_health and the publish pipeline's deferred_depth key on it."""
+    a = MetricService(_metric())
+    b = MetricService(_metric())
+    try:
+        assert a.label != b.label
+        assert a.label.startswith("MetricService(Accuracy)#")
+        snap = obs.counters_snapshot()
+        assert a.label in snap["service_health"]
+        assert b.label in snap["service_health"]
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_named_services_thread_label_through_both_gauges():
+    obs.enable()
+    obs.reset()
+    try:
+        with MetricService(_metric(dist_sync_fn=gather_all_arrays), name="svc-A") as a, \
+                MetricService(_metric(dist_sync_fn=gather_all_arrays), name="svc-B") as b:
+            for svc in (a, b):
+                _feed(svc, _batches(8))
+                svc.flush()
+        snap = obs.counters_snapshot()
+    finally:
+        obs.disable()
+    # per-service health entries, no collision
+    assert snap["service_health"]["svc-A"]["published"] >= 1
+    assert snap["service_health"]["svc-B"]["published"] >= 1
+    # per-service publish-pipeline depth gauges, no collision
+    assert "svc-A" in snap["deferred_depth"]
+    assert "svc-B" in snap["deferred_depth"]
+
+
+def test_replayed_steps_counts_watermark_noops():
+    batches = _batches(6)
+    svc = MetricService(_metric())
+    _feed(svc, batches)
+    svc.flush()
+    snapshot = svc.snapshot()
+    restored = MetricService(_metric())
+    restored.restore(snapshot)
+    _feed(restored, batches)  # full replay: every step below the watermark
+    restored.flush()
+    assert restored.replayed_steps == len(batches)
+    restored.stop()
+    svc.stop()
+
+
+def test_watermark_jump_publishes_expiring_windows_before_the_roll():
+    """A sparse stream can jump the watermark several windows in one batch
+    (a fleet shard sees 1/N of the traffic): windows the jump expires from
+    the ring must be published BEFORE their slots recycle — never silently
+    lost."""
+    svc = MetricService(_metric())
+    rng = np.random.RandomState(11)
+    # windows 0 and 1 get events, then the stream jumps to window ~8: both
+    # early windows leave the 4-slot ring in one roll
+    for base in (2.0, 12.0):
+        svc.submit(jnp.asarray(rng.rand(4).astype(np.float32)),
+                   jnp.asarray(rng.randint(0, 2, 4).astype(np.int32)),
+                   event_time=np.full(4, base))
+    svc.flush()
+    assert [p["window"] for p in svc.publications] == []  # nothing closed yet
+    svc.submit(jnp.asarray(rng.rand(4).astype(np.float32)),
+               jnp.asarray(rng.randint(0, 2, 4).astype(np.int32)),
+               event_time=np.full(4, 85.0))
+    svc.flush()
+    published = [p["window"] for p in svc.publications]
+    assert published[:2] == [0, 1], f"expiring windows lost to the jump: {published}"
+    for p in svc.publications[:2]:
+        assert not np.isnan(float(np.asarray(p["value"])))
+    svc.stop()
+
+
 # ------------------------------------------------- deferred publish stage
 def test_deferred_publish_matches_synchronous_stage():
     """The deferred stage snapshots the close-point state, so every published
@@ -429,6 +508,7 @@ def test_publish_emits_per_window_spans():
     assert len(spans) == len(published)
     assert [s.attrs["window"] for s in spans] == published
     for s in spans:
+        assert s.attrs["service"] == svc.label  # label threads into the span
         assert s.attrs["degraded"] in ("yes", "no")
         assert s.attrs["deferred"] == "yes"
         assert isinstance(s.attrs["queue_depth"], int)
