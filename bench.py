@@ -116,6 +116,30 @@ SKETCH_RANK_BINS = 16  # (16, 16) int32 joint histogram = 1 KB
 # metric's — segments scale the payload, never the program.
 KEYED_SLOTS = 10_000
 KEYED_BINS = 16
+# heavy-hitter scenario (wrappers/heavy_hitters.py): the same sketch AUROC
+# behind the two-tier open-world wrapper — 256 exact hot slab rows over a
+# (4, 1024)-cell count-min tail — fed keys drawn from a 1,000,000-key space.
+# The pinned property extends the keyed gate to UNBOUNDED cardinality: both
+# tiers are sum leaves, so the staged program is the identical two-stage
+# psum the unkeyed metric stages (psum-only, zero gathers) and total state
+# bytes are constant in the live-key count. The eager half of the gate pins
+# mass conservation (hot + tail totals bit-exact vs an unkeyed oracle
+# through promotion/demotion churn) and the (e/width)*N tail certificate on
+# a seeded Zipfian stream.
+HH_HOT_SLOTS = 256
+HH_TAIL_DEPTH = 4
+HH_TAIL_WIDTH = 1024
+HH_KEY_SPACE = 1_000_000
+HH_KEY_SPACE_SMALL = 10_000
+HH_GATE_SLOTS = 64  # the eager gate/ingest streams use a smaller hot tier
+# the gate stream's tail is DEEPER than the sync scenario's: the gate
+# demands EVERY tail query within the certificate, and the per-query failure
+# probability is e^-depth (1.8% at depth 4 — too loose over ~500 queries;
+# 0.03% at depth 8 holds with margin on the seeded stream). The (e/width)*N
+# bound itself is depth-independent.
+HH_GATE_TAIL_DEPTH = 8
+HH_GATE_BATCHES = 40
+HH_GATE_BATCH = 64
 # windowed serving scenario: the same sketch AUROC as a 4-slot tumbling ring
 # (wrappers/windowed.py). The pinned property mirrors the keyed gate:
 # windows are a leading STATE axis, so the staged collective count is
@@ -488,6 +512,110 @@ def _build_keyed_sync_runner(num_slots: "int | None" = KEYED_SLOTS):
     return run, len(state)
 
 
+def _build_hh_sync_runner():
+    """(timed_run(steps) -> ms/step, states_synced) for the HEAVY-HITTER
+    open-world scenario: ``HeavyHitters(AUROC(approx="sketch"), 256 hot
+    slots, (4, 1024) tail)`` fed keys from a 1M-key space, synced per step
+    with ``coalesced_sync_state`` on the (4,2) ici x dcn mesh. The hot slab
+    pair ((K, 2, B) histogram + (K,) rows) and the tail pair ((D, W, 2, B)
+    count-min + (D, W) rows) all fold into ONE int32 sum bucket, so the
+    staged program is the same two-stage psum the unkeyed sketch metric
+    stages (the ``keyed_unkeyed`` twin): collective counts — and state
+    bytes — are independent of the simulated key count.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from metrics_tpu import AUROC, HeavyHitters
+    from metrics_tpu.parallel.placement import MeshHierarchy
+    from metrics_tpu.parallel.sync import coalesced_sync_state
+    from metrics_tpu.utils.compat import shard_map
+
+    metric = HeavyHitters(
+        AUROC(approx="sketch", num_bins=KEYED_BINS),
+        num_hot_slots=HH_HOT_SLOTS, tail=(HH_TAIL_DEPTH, HH_TAIL_WIDTH),
+    )
+    rng = np.random.RandomState(0)
+    rows = GATHER_CAPACITY // 2  # same per-step traffic shape as the sketch A/B
+    preds = jnp.asarray(rng.rand(rows).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, rows).astype(np.int32))
+    keys = [int(k) for k in rng.randint(0, HH_KEY_SPACE, rows)]
+    metric.update(preds, target, key=keys)
+
+    state = metric._current_state()
+    reductions = metric._reductions
+    mesh = Mesh(
+        np.array(jax.devices("cpu")[:N_DEVICES]).reshape(HIER_SLICES, N_DEVICES // HIER_SLICES),
+        ("dcn", "ici"),
+    )
+    axis = MeshHierarchy(ici_axis="ici", dcn_axis="dcn")
+
+    def step(s, acc):
+        synced = coalesced_sync_state(s, reductions, axis)
+        # carry chains step i+1 on step i (see _build_gather_runner)
+        for leaf in jax.tree_util.tree_leaves(synced):
+            acc = acc + jnp.sum(leaf.astype(jnp.float32))
+        return acc
+
+    sharded_step = jax.jit(
+        shard_map(step, mesh=mesh, in_specs=(P(), P()), out_specs=P(), check_vma=False)
+    )
+
+    def run(steps: int) -> float:
+        acc = jnp.zeros((), jnp.float32)
+        start = time.perf_counter()
+        for _ in range(steps):
+            acc = sharded_step(state, acc)
+        jax.block_until_ready(acc)
+        return (time.perf_counter() - start) / steps * 1e3
+
+    return run, len(state)
+
+
+def _hh_stream(key_space: int, batches: int, batch: int, seed: int = 11):
+    """The seeded Zipfian key stream the heavy-hitter gate and ingest
+    scenarios share: heavy keys concentrate (and promote), the long tail
+    exercises the count-min tier."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(seed)
+    for _ in range(batches):
+        keys = [int(k) for k in rng.zipf(1.3, batch) % key_space]
+        preds = jnp.asarray(rng.rand(batch).astype(np.float32))
+        target = jnp.asarray(rng.randint(0, 2, batch).astype(np.int32))
+        yield keys, preds, target
+
+
+HH_INGEST_BATCHES = 16
+HH_INGEST_WARMUP = 4
+
+
+def _bench_hh_ingest(key_space: int):
+    """(batches/sec, metric) through a real ``HeavyHitters`` ingest loop —
+    host-side space-saving routing, hot scatters, tail folds, promotion
+    churn included. Measured at a 10k AND a 1M key space: the loop's work
+    is constant in the key-space size (the table is O(hot), the tail is
+    O(depth x width)), so steps/s must stay FLAT as keys grow — the number
+    that makes "open-world cardinality" a measured claim, not a design
+    note."""
+    from metrics_tpu import Accuracy, HeavyHitters
+
+    hh = HeavyHitters(Accuracy(), num_hot_slots=HH_GATE_SLOTS,
+                      tail=(HH_GATE_TAIL_DEPTH, HH_TAIL_WIDTH))
+    stream = list(_hh_stream(key_space, HH_INGEST_BATCHES + HH_INGEST_WARMUP,
+                             HH_GATE_BATCH, seed=13))
+    for keys, preds, target in stream[:HH_INGEST_WARMUP]:
+        hh.update(preds, target, key=keys)  # compile the scatter/fold paths
+    start = time.perf_counter()
+    for keys, preds, target in stream[HH_INGEST_WARMUP:]:
+        hh.update(preds, target, key=keys)
+    elapsed = time.perf_counter() - start
+    return HH_INGEST_BATCHES / max(elapsed, 1e-9), hh
+
+
 def _build_windowed_sync_runner(windowed: bool = True):
     """(timed_run(steps) -> ms/step, states_synced) for the WINDOWED serving
     scenario: ``Windowed(AUROC(approx="sketch"), window_s, num_windows=4)``
@@ -810,6 +938,19 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
         with (obs.span("bench.timed_keyed_sync") if obs else _null_cm()):
             keyed_times.append(run_keyed(steps))
 
+    # heavy-hitter A/B: HeavyHitters(AUROC sketch) over a 1M-key space vs
+    # the same unkeyed twin — the open-world extension of the keyed gate:
+    # the staged count must not move with the SIMULATED key count, and the
+    # ingest loop must stay flat as the key space grows 10k -> 1M
+    run_hh, states_hh, hh_counters = build(lambda _v: _build_hh_sync_runner(), None, "hh_sync")
+    hh_times = []
+    for _ in range(repeats):
+        with (obs.span("bench.timed_hh_sync") if obs else _null_cm()):
+            hh_times.append(run_hh(steps))
+    with (obs.span("bench.hh_ingest") if obs else _null_cm()):
+        hh_sps_small, _ = _bench_hh_ingest(HH_KEY_SPACE_SMALL)
+        hh_sps_big, hh_big = _bench_hh_ingest(HH_KEY_SPACE)
+
     # windowed serving A/B: Windowed(AUROC sketch) x 4 window slots vs the
     # unwindowed metric on the same (4,2) mesh — like the keyed gate, the
     # headline is that the STAGED COLLECTIVE COUNT does not move with the
@@ -940,6 +1081,23 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
             for k in ("all_gather", "coalesced_gather", "process_allgather")
         ),
         "keyed_unkeyed_collective_calls": keyed_unkeyed_counters["collective_calls"],
+        # the heavy-hitter plane: open-world keys over the same staged
+        # program shape as the unkeyed metric (psum-only, count pinned
+        # equal, state bytes constant in the live-key count), with the
+        # ingest pair pinning steps/s FLAT as the key space grows 100x and
+        # the tail's (e/width)*N certificate on the default line
+        "hh_sync_ms": min(hh_times),
+        "hh_states_synced": states_hh,
+        "hh_collective_calls": hh_counters["collective_calls"],
+        "hh_sync_bytes": hh_counters["sync_bytes"],
+        "hh_gather_calls": sum(
+            hh_counters["calls_by_kind"].get(k, 0)
+            for k in ("all_gather", "coalesced_gather", "process_allgather")
+        ),
+        "hh_unkeyed_collective_calls": keyed_unkeyed_counters["collective_calls"],
+        "hh_ingest_steps_per_s": round(hh_sps_big, 3),
+        "hh_ingest_steps_per_s_10k": round(hh_sps_small, 3),
+        "hh_tail_overcount_bound": round(hh_big.tail_overcount_bound(), 4),
         # the windowed serving plane: window slots are a leading state axis,
         # so the staged program matches the unwindowed metric's (psum-only)
         "service_sync_ms": min(service_times),
@@ -1008,6 +1166,9 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
             finally:
                 devtime_mod.disable()
 
+        # v10: the heavy-hitter open-world plane joined (hh_* staged-count
+        # keys pinned to the unkeyed twin, the 10k/1M ingest flatness pair,
+        # and the tail's (e/width)*N certificate on the default line);
         # v9: the sharded fleet joined (fleet_ingest_steps_per_s at 1/8
         # shards + fleet_scaling_x + the merge tier's window counts with
         # fleet_lost_windows pinned at zero on the default line); v8 added
@@ -1020,12 +1181,13 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
         # block); v6 added the windowed serving A/B; v5 the keyed slab A/B;
         # v4 the sketch A/B; v3 moved the collective counts to the default
         # line and added the hierarchical A/B
-        out["trace_schema"] = 9
+        out["trace_schema"] = 10
         out["counters"] = grouped_counters
         out["gather_counters"] = coal_counters
         out["hier_counters"] = hier_counters
         out["sketch_counters"] = sketch_counters
         out["keyed_counters"] = keyed_counters
+        out["hh_counters"] = hh_counters
         out["service_counters"] = service_counters
         out["async_counters"] = async_counters
         summary = obs.summarize()
@@ -1353,6 +1515,15 @@ _TRACE_KEYS = (
     "keyed_sync_bytes",
     "keyed_gather_calls",
     "keyed_unkeyed_collective_calls",
+    "hh_sync_ms",
+    "hh_states_synced",
+    "hh_collective_calls",
+    "hh_sync_bytes",
+    "hh_gather_calls",
+    "hh_unkeyed_collective_calls",
+    "hh_ingest_steps_per_s",
+    "hh_ingest_steps_per_s_10k",
+    "hh_tail_overcount_bound",
     "service_sync_ms",
     "service_states_synced",
     "service_collective_calls",
@@ -1385,6 +1556,7 @@ _TRACE_KEYS = (
     "hier_counters",
     "sketch_counters",
     "keyed_counters",
+    "hh_counters",
     "service_counters",
     "async_counters",
     "phase_ms",
@@ -1446,6 +1618,18 @@ EXPECTED_COLLECTIVES = {
     "keyed_unkeyed": {
         "collective_calls": 2, "sync_bytes": 256, "gather_calls": 0,
         "dcn_calls": 1, "dcn_bytes": 128, "ici_calls": 1, "ici_bytes": 384,
+    },
+    # heavy-hitter plane (HeavyHitters(AUROC sketch, 256 hot slots,
+    # (4, 1024) tail) over a 1M-key space on the same (4,2) mesh): the hot
+    # slab pair + the count-min tail pair fold into ONE int32 sum bucket —
+    # the SAME two-stage psum program as keyed_unkeyed; the payload is
+    # (256*32 + 256 + 4*1024*32 + 4*1024) * 4 = 574,464 bytes per stage,
+    # constant in the live-key count. The cross-scenario HH GATE below pins
+    # the open-world contract (staged parity, mass conservation, the tail
+    # certificate, constant state bytes).
+    "hh_sync": {
+        "collective_calls": 2, "sync_bytes": 1148928, "gather_calls": 0,
+        "dcn_calls": 1, "dcn_bytes": 574464, "ici_calls": 1, "ici_bytes": 1723392,
     },
     "sum_grouped": {"collective_calls": 1, "sync_bytes": 520},
     "sum_ungrouped": {"collective_calls": 1, "sync_bytes": 1544},
@@ -1584,6 +1768,7 @@ def check_collectives() -> int:
         "sketch_sync": lambda: _build_sketch_sync_runner(True),
         "keyed_sync": lambda: _build_keyed_sync_runner(KEYED_SLOTS),
         "keyed_unkeyed": lambda: _build_keyed_sync_runner(None),
+        "hh_sync": _build_hh_sync_runner,
         "sum_grouped": lambda: _build_sync8_runner(True),
         "sum_ungrouped": lambda: _build_sync8_runner(False),
         "gather_coalesced": lambda: _build_gather_runner(True),
@@ -1691,6 +1876,60 @@ def check_collectives() -> int:
             f"keyed gate: keyed_sync staged {keyed_gathers} gather collectives"
             " (the slab plane must be psum-only)"
         )
+
+    # the heavy-hitter gate of record: the OPEN-WORLD extension of the keyed
+    # gate. Staged half: a 1M-key-space HeavyHitters stages the IDENTICAL
+    # collective count and kinds as the unkeyed metric (psum-only, zero
+    # gathers). Eager half (seeded Zipfian streams, deterministic):
+    # promotion/demotion round-trips conserve mass bit-exactly vs an unkeyed
+    # oracle, every tail query's true value lies within the reported
+    # (e/width)*N certificate, and total state bytes are IDENTICAL whether
+    # the stream drew from 10k or 1M keys.
+    hh_eager = _hh_eager_gate()
+    hh_calls = report["hh_sync"]["collective_calls"]
+    hh_gathers = report["hh_sync"]["gather_calls"]
+    hh_gate = {
+        "hh_collective_calls": hh_calls,
+        "unkeyed_collective_calls": unkeyed_calls,
+        "hh_gather_calls": hh_gathers,
+        "simulated_key_space": HH_KEY_SPACE,
+        **hh_eager,
+        "ok": (
+            hh_calls == unkeyed_calls and hh_gathers == 0
+            and hh_eager["mass_conserved"] and hh_eager["cert_violations"] == 0
+            and hh_eager["state_bytes_10k"] == hh_eager["state_bytes_1m"]
+        ),
+    }
+    if hh_calls != unkeyed_calls:
+        failures.append(
+            f"hh gate: a {HH_KEY_SPACE}-key-space HeavyHitters staged {hh_calls}"
+            f" collectives vs the unkeyed metric's {unkeyed_calls} — collective"
+            " counts must be key-count-independent"
+        )
+    if hh_gathers != 0:
+        failures.append(
+            f"hh gate: hh_sync staged {hh_gathers} gather collectives (both tiers"
+            " must be psum-only)"
+        )
+    if not hh_eager["mass_conserved"]:
+        failures.append(
+            "hh gate: hot + tail totals diverged from the unkeyed oracle —"
+            " promotion/demotion must conserve mass bit-exactly"
+        )
+    if hh_eager["cert_violations"]:
+        failures.append(
+            f"hh gate: {hh_eager['cert_violations']}/{hh_eager['cert_checked']}"
+            f" tail queries exceeded the (e/width)*N certificate"
+            f" ({hh_eager['tail_overcount_bound']})"
+        )
+    if hh_eager["state_bytes_10k"] != hh_eager["state_bytes_1m"]:
+        failures.append(
+            f"hh gate: state bytes moved with the key space"
+            f" ({hh_eager['state_bytes_10k']} at 10k vs"
+            f" {hh_eager['state_bytes_1m']} at 1M) — must be constant in the"
+            " live-key count"
+        )
+
     print(json.dumps({
         "check": "collectives",
         "ok": not failures,
@@ -1698,9 +1937,74 @@ def check_collectives() -> int:
         "hier_gate": hier_gate,
         "sketch_gate": sketch_gate,
         "keyed_gate": keyed_gate,
+        "hh_gate": hh_gate,
         "scenarios": report,
     }))
     return 1 if failures else 0
+
+
+def _hh_eager_gate() -> dict:
+    """The eager half of the heavy-hitter gate: drive seeded Zipfian streams
+    (10k- and 1M-key spaces) through ``HeavyHitters(Accuracy)`` next to an
+    unkeyed oracle and measure mass conservation, the tail certificate, and
+    state-byte constancy. Deterministic: host arithmetic over integer
+    states, no timing."""
+    from metrics_tpu import Accuracy, HeavyHitters
+    from metrics_tpu.observability.counters import state_nbytes
+
+    def run(key_space):
+        hh = HeavyHitters(Accuracy(), num_hot_slots=HH_GATE_SLOTS,
+                          tail=(HH_GATE_TAIL_DEPTH, HH_TAIL_WIDTH))
+        oracle = Accuracy()
+        true_counts = {}
+        for keys, preds, target in _hh_stream(key_space, HH_GATE_BATCHES, HH_GATE_BATCH):
+            hh.update(preds, target, key=keys)
+            oracle.update(preds, target)
+            for k in keys:
+                true_counts[k] = true_counts.get(k, 0) + 1
+        return hh, oracle, true_counts
+
+    hh_small, _, _ = run(HH_KEY_SPACE_SMALL)
+    hh_big, oracle, true_counts = run(HH_KEY_SPACE)
+
+    # mass conservation: hot + tail totals bit-exact vs the unkeyed oracle
+    # (every tail row carries the full tail mass, so row 0's sum IS it)
+    total_samples = HH_GATE_BATCHES * HH_GATE_BATCH
+    mass_conserved = (
+        int(np.asarray(hh_big.hh_rows).sum()) + hh_big.tail_mass() == total_samples
+    )
+    for name in ("correct", "total"):
+        hot = int(np.asarray(getattr(hh_big, name)).sum())
+        tail = int(np.asarray(getattr(hh_big, name + "_tail").counts[0]).sum())
+        mass_conserved = mass_conserved and hot + tail == int(np.asarray(getattr(oracle, name)))
+
+    # the certificate: every currently-tail key's true count is covered by
+    # its (overcounting) estimate within (e/width) * N. The device tail
+    # rows and the table's host mirror must agree bit-exactly (the mirror
+    # is how promotion decisions stay readback-free), which also lets the
+    # sweep run in host numpy.
+    mirror_ok = np.array_equal(
+        np.asarray(getattr(hh_big, "hh_tail_rows").counts), hh_big._table._mirror
+    )
+    bound = hh_big.tail_overcount_bound()
+    cert_checked = cert_violations = 0
+    for key, true in true_counts.items():
+        if key in hh_big._table:
+            continue
+        estimate = hh_big._table.tail_estimate(key)
+        cert_checked += 1
+        if not (true <= estimate <= true + bound):
+            cert_violations += 1
+    return {
+        "mass_conserved": bool(mass_conserved and mirror_ok),
+        "demotions": hh_big._table.demotions,
+        "cert_checked": cert_checked,
+        "cert_violations": cert_violations,
+        "tail_overcount_bound": round(bound, 4),
+        "tail_mass": hh_big.tail_mass(),
+        "state_bytes_10k": state_nbytes(hh_small._current_state()),
+        "state_bytes_1m": state_nbytes(hh_big._current_state()),
+    }
 
 
 # ------------------------------------------------------- fault-tolerance gate

@@ -121,6 +121,7 @@ from metrics_tpu.clustering import (  # noqa: E402
 from metrics_tpu.wrappers import (  # noqa: E402
     BootStrapper,
     ClasswiseWrapper,
+    HeavyHitters,
     Keyed,
     MetricTracker,
     MinMaxMetric,
@@ -128,5 +129,5 @@ from metrics_tpu.wrappers import (  # noqa: E402
     Running,
     Windowed,
 )
-from metrics_tpu.serving import MetricFleet, MetricService  # noqa: E402
+from metrics_tpu.serving import HeavyHitterFleet, MetricFleet, MetricService  # noqa: E402
 from metrics_tpu import functional  # noqa: E402

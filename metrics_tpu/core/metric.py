@@ -54,6 +54,7 @@ from metrics_tpu.observability.counters import (
 from metrics_tpu.observability.devtime import DEVTIME as _DEVTIME, fence as _fence
 from metrics_tpu.observability.trace import TRACE, span as _span
 from metrics_tpu.parallel.buffer import PaddedBuffer, buffer_append, buffer_init
+from metrics_tpu.parallel.cms import CMSSpec, cms_init
 from metrics_tpu.parallel.sketch import SketchSpec, is_sketch, sketch_init
 from metrics_tpu.parallel.slab import SlabSpec, slab_init, slab_sync_reduce
 from metrics_tpu.utils import compat, debug
@@ -259,6 +260,14 @@ def _fingerprint_value(v: Any, pins: list) -> Any:
     if isinstance(v, (np.ndarray, jnp.ndarray, Array)):
         arr = np.asarray(v)
         return ("arr", arr.shape, str(arr.dtype), arr.tobytes())
+    if isinstance(v, CMSSpec):
+        # before the generic tuple arm: the seed is first-class fingerprint
+        # material (it parameterizes the bucket family, so two CMS states
+        # merge soundly only on equal seeds) and the stable "cmsspec" tag
+        # keeps the key independent of the NamedTuple's field order
+        return (
+            "cmsspec", v.depth, v.width, v.item_shape, str(jnp.dtype(v.dtype)), v.seed,
+        )
     if isinstance(v, (list, tuple)):
         return (type(v).__name__, tuple(_fingerprint_value(x, pins) for x in v))
     if isinstance(v, dict):
@@ -477,6 +486,13 @@ class Metric(ABC):
         merge is bit-exact integer addition, and sync rides the existing
         per-dtype sum-psum buckets (``dist_reduce_fx`` must be ``"sum"``).
 
+        Or a :class:`~metrics_tpu.parallel.cms.CMSSpec` — the COUNT-MIN TAIL
+        state kind (``wrappers/heavy_hitters.py``): a ``(depth, width,
+        *item_shape)`` accumulator that folds an UNBOUNDED key space into
+        constant memory with a certified overcount read. Sum-mergeable by
+        construction like sketches (``dist_reduce_fx`` must be ``"sum"``),
+        so sync rides the existing per-dtype sum-psum buckets.
+
         Or a :class:`~metrics_tpu.parallel.slab.SlabSpec` — the KEYED SLAB
         state kind (one row per segment slot, see ``wrappers/keyed.py``):
         the state materializes as a ``(K, *item_shape)`` array (or a sketch
@@ -510,6 +526,20 @@ class Metric(ABC):
             self._reductions[name] = "sum"
             setattr(self, name, sketch_init(default))
             return
+        if isinstance(default, CMSSpec):
+            # the COUNT-MIN TAIL state kind (parallel/cms.py): a (depth,
+            # width, *item) accumulator folding an unbounded key space into
+            # constant memory. Sum-mergeable by construction, like sketches.
+            if dist_reduce_fx != "sum":
+                raise ValueError(
+                    f"count-min states are sum-mergeable by construction; declare them"
+                    f" with dist_reduce_fx='sum' (got {dist_reduce_fx!r})"
+                )
+            self._defaults[name] = default
+            self._persistent[name] = persistent
+            self._reductions[name] = "sum"
+            setattr(self, name, cms_init(default))
+            return
         is_list = isinstance(default, list) and len(default) == 0
         is_arraylike = isinstance(default, (int, float, np.ndarray, jnp.ndarray, Array)) and not isinstance(
             default, bool
@@ -538,6 +568,8 @@ class Metric(ABC):
             return buffer_init(spec.capacity, spec.item_shape, spec.dtype)
         if isinstance(spec, SketchSpec):
             return sketch_init(spec)
+        if isinstance(spec, CMSSpec):
+            return cms_init(spec)
         if isinstance(spec, SlabSpec):
             return slab_init(spec)
         if isinstance(spec, list):
@@ -634,6 +666,8 @@ class Metric(ABC):
             return buffer_init(spec.capacity, spec.item_shape, spec.dtype)
         if isinstance(spec, SketchSpec):
             return sketch_init(spec)  # zeros: stage as compile-time constants
+        if isinstance(spec, CMSSpec):
+            return cms_init(spec)  # zeros: staged like sketch counts
         if isinstance(spec, SlabSpec):
             return slab_init(spec)  # zeros / host-template broadcasts: staged
         if isinstance(spec, list):
@@ -1707,9 +1741,11 @@ class Metric(ABC):
                 elif isinstance(value, dict) and set(value) == {"sketch_counts"}:
                     spec = self._defaults[key]
                     kind = type(getattr(self, key)) if is_sketch(getattr(self, key, None)) else None
-                    if kind is None and isinstance(spec, (SketchSpec, SlabSpec)):
+                    if kind is None and isinstance(spec, (SketchSpec, SlabSpec, CMSSpec)):
                         materialized = (
-                            sketch_init(spec) if isinstance(spec, SketchSpec) else slab_init(spec)
+                            sketch_init(spec) if isinstance(spec, SketchSpec)
+                            else cms_init(spec) if isinstance(spec, CMSSpec)
+                            else slab_init(spec)
                         )
                         kind = type(materialized) if is_sketch(materialized) else None
                     if kind is None:

@@ -101,6 +101,23 @@ What gets counted, and on which plane:
   every shard publish and every shard recovery while counting is enabled
   (the occupancy read is a device readback, so it only pays while counting
   is on); present in every snapshot.
+- **evicted_mass_dropped**: samples whose accumulated history was DESTROYED
+  by a ``Keyed(lru=True)`` eviction — the recycled slot's row count at the
+  moment it was zeroed (``wrappers/keyed.py``). Like the fault counters this
+  records even while counting is DISABLED: before it existed the loss was
+  invisible in every gauge (``slab_slots.evictions`` counts evictions, not
+  the mass they threw away). ``HeavyHitters`` is the lossless alternative —
+  its demotions FOLD the row into the count-min tail instead, and this
+  counter stays zero.
+- **heavy_hitters**: per-wrapper GAUGES for the two-tier open-world wrappers
+  (``wrappers/heavy_hitters.py``): ``{label: {"hot_slots": K,
+  "hot_occupied": n, "promotions": p, "demotions": d, "tail_mass": N,
+  "tail_bound": e/width * N}}``. Promotion/demotion counts say how hard the
+  space-saving table is churning (a high demotion rate means the hot set is
+  undersized for the traffic's skew); ``tail_mass``/``tail_bound`` surface
+  the tail's current size and its certified per-query overcount. Refreshed
+  after every eager update while counting is enabled — the numbers come
+  from the table's host bookkeeping and mirror, zero device readbacks.
 - **slab_slots**: per-slab slot GAUGES for the keyed multi-tenant wrappers
   (``wrappers/keyed.py``): ``{label: {"slots": K, "occupied": n,
   "evictions": e}}``. Occupancy says how much of the provisioned K is
@@ -130,9 +147,11 @@ __all__ = [
     "record_collective",
     "record_deferred",
     "record_deferred_depth",
+    "record_evicted_mass",
     "record_fault",
     "record_fleet_shards",
     "record_gather_skip",
+    "record_heavy_hitters",
     "record_service_health",
     "record_slab_dropped",
     "record_slab_slots",
@@ -202,8 +221,10 @@ class CollectiveCounters:
         "fleet_shards",
         "gather_skips",
         "slab_dropped_samples",
+        "evicted_mass_dropped",
         "state_bytes",
         "slab_slots",
+        "heavy_hitters",
         "service_health",
         "_lock",
     )
@@ -230,9 +251,11 @@ class CollectiveCounters:
         self.deferred_depth: Dict[str, Dict[str, int]] = {}  # label -> {"current", "max"}
         self.gather_skips = 0
         self.slab_dropped_samples = 0  # out-of-range slot ids dropped by slab scatters
+        self.evicted_mass_dropped = 0  # samples whose history LRU eviction destroyed
         self.fleet_shards: Dict[str, Dict[str, Dict[str, Any]]] = {}  # fleet label -> shard gauges
         self.state_bytes: Dict[str, int] = {}  # metric class name -> latest bytes
         self.slab_slots: Dict[str, Dict[str, int]] = {}  # keyed-slab label -> gauges
+        self.heavy_hitters: Dict[str, Dict[str, Any]] = {}  # hh-wrapper label -> gauges
         self.service_health: Dict[str, Dict[str, Any]] = {}  # service label -> health gauges
 
     # ---------------------------------------------------------- recording
@@ -308,6 +331,30 @@ class CollectiveCounters:
         with self._lock:
             self.slab_dropped_samples += int(n)
 
+    def record_evicted_mass(self, n: int) -> None:
+        """Count samples whose history an LRU slot eviction destroyed
+        (negative n is a bug at the call site — fail loudly)."""
+        if n < 0:
+            raise ValueError(f"evicted-mass count must be >= 0, got {n}")
+        with self._lock:
+            self.evicted_mass_dropped += int(n)
+
+    def record_heavy_hitters(
+        self, label: str, hot_slots: int, hot_occupied: int, promotions: int,
+        demotions: int, tail_mass: int, tail_bound: float,
+    ) -> None:
+        """Refresh one heavy-hitter wrapper's tier gauges (latest value wins;
+        promotion/demotion counts are the table's lifetime totals)."""
+        with self._lock:
+            self.heavy_hitters[label] = {
+                "hot_slots": int(hot_slots),
+                "hot_occupied": int(hot_occupied),
+                "promotions": int(promotions),
+                "demotions": int(demotions),
+                "tail_mass": int(tail_mass),
+                "tail_bound": float(tail_bound),
+            }
+
     def record_service_health(
         self, label: str, state: str, shed_events: int, published: int, queue_depth: int
     ) -> None:
@@ -367,12 +414,14 @@ class CollectiveCounters:
                 "deferred_depth": {k: dict(v) for k, v in sorted(self.deferred_depth.items())},
                 "gather_skips": self.gather_skips,
                 "slab_dropped_samples": self.slab_dropped_samples,
+                "evicted_mass_dropped": self.evicted_mass_dropped,
                 "state_bytes": dict(sorted(self.state_bytes.items())),
                 "fleet_shards": {
                     k: {s_: dict(g) for s_, g in sorted(v.items())}
                     for k, v in sorted(self.fleet_shards.items())
                 },
                 "slab_slots": {k: dict(v) for k, v in sorted(self.slab_slots.items())},
+                "heavy_hitters": {k: dict(v) for k, v in sorted(self.heavy_hitters.items())},
                 "service_health": {k: dict(v) for k, v in sorted(self.service_health.items())},
                 "group_cache": {"hits": self.group_cache_hits, "misses": self.group_cache_misses},
                 "step_cache": {"hits": self.step_cache_hits, "misses": self.step_cache_misses},
@@ -439,6 +488,25 @@ def record_deferred_depth(label: str, current: int) -> None:
 # trail even when observability is off.
 def record_slab_dropped(n: int = 1) -> None:
     COUNTERS.record_slab_dropped(n)
+
+
+# Destroyed-history evidence records UNCONDITIONALLY, same argument as the
+# fault counters and slab drops: an evicted tenant's vanished accumulator
+# must leave a trail even when observability is off.
+def record_evicted_mass(n: int) -> None:
+    COUNTERS.record_evicted_mass(n)
+
+
+# Heavy-hitter tier gauges are telemetry (refreshed per eager update from
+# host bookkeeping), so they share the enabled gate like slab_slots.
+def record_heavy_hitters(
+    label: str, hot_slots: int, hot_occupied: int, promotions: int,
+    demotions: int, tail_mass: int, tail_bound: float,
+) -> None:
+    if COUNTERS.enabled:
+        COUNTERS.record_heavy_hitters(
+            label, hot_slots, hot_occupied, promotions, demotions, tail_mass, tail_bound
+        )
 
 
 # Service health is a gauge refresh (one dict store) and operationally

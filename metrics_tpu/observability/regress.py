@@ -61,6 +61,7 @@ MS_KEYS: Tuple[str, ...] = (
     "gather_flat2d_ms",
     "sketch_sync_ms",
     "keyed_sync_ms",
+    "hh_sync_ms",
     "service_sync_ms",
     # the deferred-sync A/B: both variants gate so a regression in either
     # the overlapped path or its fenced twin is caught (their ORDERING —
@@ -109,6 +110,16 @@ COUNT_KEYS: Tuple[str, ...] = (
     "keyed_gather_calls",
     "keyed_states_synced",
     "keyed_unkeyed_collective_calls",
+    # the heavy-hitter plane: staged counts must stay independent of the
+    # simulated key count (equal to the unkeyed metric's) and psum-only,
+    # and the tail's (e/width)*N certificate may never GROW on the seeded
+    # gate stream — a wider bound means the tail got less exact
+    "hh_collective_calls",
+    "hh_sync_bytes",
+    "hh_gather_calls",
+    "hh_states_synced",
+    "hh_unkeyed_collective_calls",
+    "hh_tail_overcount_bound",
     # the windowed serving plane: staged counts must stay window-count-
     # independent (equal to the unwindowed metric's) and psum-only; any
     # growth is a regression of the windows-as-a-state-axis story
@@ -147,6 +158,12 @@ RATE_KEYS: Tuple[str, ...] = (
     "service_ingest_steps_per_s",
     "fleet_ingest_steps_per_s",
     "fleet_ingest_steps_per_s_1shard",
+    # the heavy-hitter ingest pair: the open-world loop's throughput must
+    # not collapse at EITHER key-space size (their equality — flatness in
+    # the key count — is the hh scenario's headline, gated as a pairwise
+    # collapse detector here)
+    "hh_ingest_steps_per_s",
+    "hh_ingest_steps_per_s_10k",
 )
 
 # fault counters: bound at exactly zero whenever the current line carries

@@ -1,0 +1,291 @@
+"""Count-Min sketch states: the constant-memory TAIL of an open-world key
+space.
+
+``Keyed`` (PR 8) made segments a leading state axis — but a slab still has a
+fixed ``num_slots``, and "millions of users" means millions of keys: sizing
+K for the worst case wastes slab memory and scatter width on the 99% of keys
+that are cold, and LRU eviction silently destroys an evicted tenant's
+history. The classical answer (Cormode & Muthukrishnan, "An Improved Data
+Stream Summary: The Count-Min Sketch and its Applications") is a
+``(depth, width)`` counter array updated through ``depth`` pairwise-
+independent hash rows: every key folds into ``depth`` cells, a query reads
+the MIN over its rows, and the estimate is always an OVERCOUNT bounded by
+``(e / width) * N`` with probability ``1 - e^-depth`` — constant memory in
+the live-key count, with a data-dependent certificate in the spirit of
+``sketch.auroc_error_bound``.
+
+This module provides the CMS as a first-class mergeable state kind next to
+:class:`~metrics_tpu.parallel.sketch.HistogramSketch`:
+
+- :class:`CountMinSketch` — one integer (or float, for sum-backed means)
+  leaf of shape ``(depth, width, *item_shape)``. ``item_shape = ()`` is the
+  classical counter sketch; a non-empty item shape makes every cell a full
+  per-key STATE accumulator (e.g. a ``(2, B)`` histogram per cell), so a
+  whole metric state folds into the tail, not just a count.
+- ``merge`` is elementwise addition — associative, commutative, BIT-exact —
+  so a ``psum`` of per-device sketches equals the single-process sketch and
+  sync rides the existing per-dtype sum buckets of
+  ``parallel.sync.coalesced_sync_state`` with ZERO new collective kinds.
+- Row buckets derive from :func:`stable_key_hash` (the fleet's documented
+  64-bit FNV-1a, which lives here so the sketch and the router share one
+  hash of record) through a seeded multiply-shift family
+  (:func:`cms_buckets`): deterministic across processes and restarts, so
+  two shards' sketches describe the same cells and merge soundly.
+
+The soundness contract every consumer relies on: per-sample deltas folded
+into the tail must be NON-NEGATIVE (sample counts, histogram increments,
+non-negative sums), so every cell is ``true + collisions >= true`` and the
+min-row read is a certified overcount. The user-facing wrapper is
+:class:`metrics_tpu.wrappers.heavy_hitters.HeavyHitters`.
+"""
+import math
+from typing import Any, NamedTuple, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+__all__ = [
+    "CMSSpec",
+    "CMSTail",
+    "CountMinSketch",
+    "cms_buckets",
+    "cms_error_bound",
+    "cms_init",
+    "cms_merge",
+    "cms_nbytes",
+    "cms_row_state",
+    "cms_scatter",
+    "cms_total",
+    "is_cms",
+    "is_cms_spec",
+    "make_cms_spec",
+    "stable_key_hash",
+    "stable_key_hashes",
+]
+
+# 64-bit FNV-1a: the key hash of record, shared by the fleet router
+# (serving/fleet.py re-exports it) and the CMS bucket family below. Chosen
+# because it is trivially re-implementable in any producer language (offset
+# basis + xor/multiply per byte), has no process-lifetime salt (unlike
+# Python's str hash), and its low bits are well-mixed enough for
+# `% num_shards` partitioning.
+_FNV64_OFFSET = 0xCBF29CE484222325
+_FNV64_PRIME = 0x100000001B3
+_FNV64_MASK = 0xFFFFFFFFFFFFFFFF
+
+
+def stable_key_hash(key: Any) -> int:
+    """The stable 64-bit key hash of record: FNV-1a over the key's canonical
+    bytes.
+
+    Canonical form (type-tagged so ``1`` and ``"1"`` cannot collide by
+    construction): ``b"s:" + utf-8`` for str, ``b"b:" + bytes`` for bytes,
+    ``b"i:" + decimal`` for ints (numpy integers included). Any other key
+    type is rejected loudly — a repr-based fallback would silently change
+    routing across library versions, and both consumers (the fleet's
+    ``shard_for_key`` partition contract and the CMS row buckets) MUST
+    survive restarts.
+    """
+    if isinstance(key, bytes):
+        data = b"b:" + key
+    elif isinstance(key, str):
+        data = b"s:" + key.encode("utf-8")
+    elif isinstance(key, (int, np.integer)) and not isinstance(key, bool):
+        data = b"i:" + str(int(key)).encode("ascii")
+    else:
+        raise TypeError(
+            f"keys must be str, bytes or int (stable canonical bytes);"
+            f" got {type(key).__name__}"
+        )
+    h = _FNV64_OFFSET
+    for byte in data:
+        h = ((h ^ byte) * _FNV64_PRIME) & _FNV64_MASK
+    return h
+
+
+def stable_key_hashes(keys) -> np.ndarray:
+    """Vectorized :func:`stable_key_hash`: one ``uint64`` per key."""
+    return np.array([stable_key_hash(k) for k in keys], dtype=np.uint64)
+
+
+class CountMinSketch(NamedTuple):
+    """Count-Min sketch state: one ``(depth, width, *item_shape)`` leaf.
+
+    ``counts[d, w]`` accumulates the state deltas of every key whose row-``d``
+    bucket is ``w``. A pytree of one array leaf: jit/scan/donation-safe,
+    ``dist_reduce_fx="sum"`` semantics (merge = elementwise add, sync = one
+    psum, both bit-exact). Registered in the sketch state family
+    (``sketch.is_sketch``), so the sync planes, slab scatters, checkpoint
+    paths and wrappers handle it through the counts-based arms they already
+    have.
+    """
+
+    counts: Array
+
+
+def is_cms(value: Any) -> bool:
+    return isinstance(value, CountMinSketch)
+
+
+class CMSSpec(NamedTuple):
+    """Host-side CMS state declaration (what ``Metric.add_state`` records in
+    ``self._defaults`` — the CMS analogue of ``SketchSpec``).
+
+    ``depth``/``width``: the hash-row grid. ``item_shape``/``dtype``: the
+    per-cell accumulator. ``seed`` parameterizes the multiply-shift bucket
+    family (:func:`cms_buckets`) and is part of the spec so two
+    config-identical metrics hash keys to the SAME cells (merge soundness)
+    and share compiled steps / compute-group keys (the spec is
+    fingerprintable).
+    """
+
+    depth: int
+    width: int
+    item_shape: Tuple[int, ...]
+    dtype: Any
+    seed: int
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return (self.depth, self.width, *self.item_shape)
+
+
+def is_cms_spec(value: Any) -> bool:
+    return isinstance(value, CMSSpec)
+
+
+class CMSTail(NamedTuple):
+    """User-facing tail configuration for ``HeavyHitters(..., tail=...)``:
+    the ``(depth, width)`` grid plus the bucket-family seed. The defaults
+    (4 rows x 4096 buckets) certify overcounts at ``e/4096 ~ 0.07%`` of the
+    tail mass with probability ``1 - e^-4 ~ 0.98`` per query."""
+
+    depth: int = 4
+    width: int = 4096
+    seed: int = 29
+
+    def validate(self) -> "CMSTail":
+        if not (isinstance(self.depth, int) and self.depth >= 1):
+            raise ValueError(f"CMS depth must be a positive int, got {self.depth!r}")
+        if not (isinstance(self.width, int) and self.width >= 2):
+            raise ValueError(f"CMS width must be an int >= 2, got {self.width!r}")
+        if not isinstance(self.seed, int):
+            raise ValueError(f"CMS seed must be an int, got {self.seed!r}")
+        return self
+
+
+def make_cms_spec(tail: Union["CMSTail", Tuple[int, int], int],
+                  item_shape: Tuple[int, ...], dtype: Any) -> CMSSpec:
+    """Normalize a ``tail=`` argument (a :class:`CMSTail`, a ``(depth,
+    width)`` pair, or a bare width) into one :class:`CMSSpec`."""
+    if isinstance(tail, CMSTail):
+        cfg = tail
+    elif isinstance(tail, int):
+        cfg = CMSTail(width=tail)
+    elif isinstance(tail, tuple) and len(tail) == 2:
+        cfg = CMSTail(depth=tail[0], width=tail[1])
+    else:
+        raise ValueError(
+            f"`tail` must be a CMSTail, a (depth, width) pair, or a width int;"
+            f" got {tail!r}"
+        )
+    cfg.validate()
+    return CMSSpec(cfg.depth, cfg.width, tuple(item_shape), dtype, cfg.seed)
+
+
+def cms_init(spec: CMSSpec) -> CountMinSketch:
+    """Fresh zero-count CMS for ``spec`` (jit-safe: zeros stage as
+    compile-time constants under tracing)."""
+    return CountMinSketch(jnp.zeros(spec.shape, dtype=spec.dtype))
+
+
+def cms_merge(a: CountMinSketch, b: CountMinSketch) -> CountMinSketch:
+    """Pairwise CMS merge: elementwise addition — associative, commutative,
+    bit-exact (the psum-mergeability property)."""
+    return CountMinSketch(a.counts + b.counts)
+
+
+def cms_nbytes(value: CountMinSketch) -> int:
+    """State bytes of one CMS (constant in the live-key count — the point)."""
+    return int(value.counts.size) * int(jnp.dtype(value.counts.dtype).itemsize)
+
+
+def _bucket_params(depth: int, seed: int) -> Tuple[np.ndarray, np.ndarray]:
+    """The seeded multiply-shift family's per-row ``(a, b)`` parameters:
+    ``depth`` odd 64-bit multipliers plus additive offsets. Deterministic in
+    ``seed`` — two processes with the same spec hash keys identically."""
+    rng = np.random.RandomState(seed)
+    halves = rng.randint(0, 2**32, size=(2, depth, 2)).astype(np.uint64)
+    a = (halves[0, :, 0] << np.uint64(32)) | halves[0, :, 1] | np.uint64(1)  # odd
+    b = (halves[1, :, 0] << np.uint64(32)) | halves[1, :, 1]
+    return a, b
+
+
+def cms_buckets(hashes: np.ndarray, depth: int, width: int, seed: int) -> np.ndarray:
+    """``(N, depth)`` int32 row buckets for ``(N,)`` uint64 key hashes.
+
+    Per row ``d``: ``((a_d * h + b_d) mod 2^64) >> 32 mod width`` — the
+    multiply-shift universal family over the :func:`stable_key_hash` values,
+    seeded per spec. Host numpy by design (bucket resolution happens on the
+    eager, host-routed update path next to the key table); uint64 arithmetic
+    wraps mod 2^64, which is exactly the family's definition. Uniformity of
+    both the router and this family is pinned by a seeded chi-square test
+    (``tests/parallel/test_cms.py``).
+    """
+    a, b = _bucket_params(depth, seed)
+    h = np.asarray(hashes, dtype=np.uint64).reshape(-1, 1)  # (N, 1)
+    mixed = (a[None, :] * h + b[None, :]) >> np.uint64(32)
+    return (mixed % np.uint64(width)).astype(np.int32)
+
+
+def cms_scatter(counts: Array, buckets: Array, deltas: Array) -> Array:
+    """Fold ``(N, *item)`` per-sample deltas into ``(depth, width, *item)``
+    counts at each sample's per-row buckets — the one-scatter update plane
+    of every CMS state (each sample lands in ALL ``depth`` rows).
+
+    ``buckets`` is ``(N, depth)`` int32; out-of-range buckets (the hot-tier
+    sentinel ``width``) are DROPPED by scatter semantics, never misrouted —
+    the same contract as ``slab_scatter``. Pure and jittable.
+    """
+    depth = counts.shape[0]
+    n = deltas.shape[0]
+    rows = jnp.broadcast_to(jnp.arange(depth, dtype=jnp.int32)[None, :], (n, depth))
+    vals = jnp.broadcast_to(
+        jnp.expand_dims(deltas, 1), (n, depth, *deltas.shape[1:])
+    ).astype(counts.dtype)
+    return counts.at[rows, buckets].add(vals, mode="drop")
+
+
+def cms_total(row_counts: Array) -> Array:
+    """Total mass inserted into a counter CMS (``item_shape = ()``): every
+    sample increments every row once, so any single row's sum IS the total —
+    exact integer arithmetic, no division."""
+    return jnp.sum(row_counts[0])
+
+
+def cms_row_state(counts: Array, buckets_one: Array) -> Array:
+    """One key's ``(depth, *item)`` per-row cell contents (``buckets_one`` is
+    its ``(depth,)`` bucket vector). The min/argmin over the leading row axis
+    is the caller's query policy: the classical count query takes the min;
+    a multi-leaf STATE query picks one argmin row (by the count sketch) so
+    every leaf reads the SAME row and stays internally consistent."""
+    rows = jnp.arange(counts.shape[0])
+    return counts[rows, buckets_one]
+
+
+def cms_error_bound(row_counts: Array) -> Array:
+    """Data-dependent overcount certificate of a counter CMS.
+
+    Any query's estimate is ``true + collisions`` with ``collisions >= 0``
+    (non-negative deltas), and ``collisions <= (e / width) * N`` with
+    probability ``>= 1 - e^-depth`` per query (Markov over each row, min
+    over independent rows) — the classical Count-Min guarantee, surfaced
+    from the sketch itself like ``sketch.auroc_error_bound``: ``N`` is the
+    current total tail mass, so the bound tightens when traffic concentrates
+    in the exact hot tier and is computable at serving time with no oracle.
+    """
+    width = row_counts.shape[1]
+    # weak-typed float multiply: promotes to the default float dtype without
+    # requesting x64 (the bound is a certificate, not an accumulator)
+    return cms_total(row_counts) * (math.e / width)

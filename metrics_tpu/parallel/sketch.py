@@ -47,6 +47,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
+from metrics_tpu.parallel.cms import CountMinSketch
+
 __all__ = [
     "HistogramSketch",
     "RankSketch",
@@ -98,14 +100,20 @@ class RankSketch(NamedTuple):
     counts: Array
 
 
-_SKETCH_TYPES = (HistogramSketch, RankSketch)
-_KINDS = {"hist": HistogramSketch, "rank": RankSketch}
+# CountMinSketch (parallel/cms.py) joins the family: it is one more
+# counts-backed mergeable-sum state, so every counts-based arm — the sync
+# bucket planes, slab scatters, checkpoint round-trips, wrapper merges —
+# handles it through the same ``is_sketch`` branch as the histogram kinds.
+_SKETCH_TYPES = (HistogramSketch, RankSketch, CountMinSketch)
+_KINDS = {"hist": HistogramSketch, "rank": RankSketch, "cms": CountMinSketch}
 
 
 def is_sketch(value: Any) -> bool:
     """Whether ``value`` is a sketch state (the kind test the state model,
     sync planes, and checkpoint paths branch on — the sketch analogue of
-    ``isinstance(v, PaddedBuffer)``)."""
+    ``isinstance(v, PaddedBuffer)``). Count-Min tail sketches
+    (``parallel/cms.py``) are members: one integer counts leaf, merge =
+    add, sync = the sum bucket."""
     return isinstance(value, _SKETCH_TYPES)
 
 

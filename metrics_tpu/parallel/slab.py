@@ -42,7 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
-from metrics_tpu.parallel.sketch import HistogramSketch, RankSketch
+from metrics_tpu.parallel.sketch import HistogramSketch, RankSketch, is_sketch
 
 __all__ = [
     "LRUSlotTable",
@@ -56,6 +56,7 @@ __all__ = [
     "slab_rows_spec",
     "slab_scatter",
     "slab_sync_reduce",
+    "slab_take_rows",
 ]
 
 # per-slot reduce kinds a slab row supports. "mean" is SUM-BACKED: the slab
@@ -192,6 +193,22 @@ def dropped_slot_count(slot_ids: Any, num_slots: int) -> int:
     if ids.size == 0:
         return 0
     return int(((ids < 0) | (ids >= num_slots)).sum())
+
+
+def slab_take_rows(value: Any, slots: Any) -> Any:
+    """The stacked ``(len(slots), *item)`` row payloads of the given slots —
+    sketch-aware (sketch slabs return their raw counts rows).
+
+    This is the DEMOTION FOLD's read: ``HeavyHitters`` extracts a demoted
+    key's exact slab rows with it and scatters them into the count-min tail
+    BEFORE the slot is reset, so eviction conserves mass instead of
+    destroying history (contrast ``Keyed``'s LRU eviction, which zeroes the
+    recycled row and can only count what it lost).
+    """
+    idx = jnp.asarray(np.asarray(slots, dtype=np.int32))
+    if is_sketch(value):
+        return value.counts[idx]
+    return value[idx]
 
 
 def slab_merge(reduce: str, acc: Array, delta: Array) -> Array:

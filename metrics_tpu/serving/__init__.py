@@ -11,6 +11,7 @@ windows close, with seeded shard-kill failover. See ``docs/streaming.md``.
 """
 from metrics_tpu.serving.fleet import (
     FLEET_SITE,
+    HeavyHitterFleet,
     MetricFleet,
     ShardStoppedError,
     shard_for_key,
@@ -21,6 +22,7 @@ from metrics_tpu.serving.service import HEALTH_STATES, MetricService, ServiceSto
 __all__ = [
     "FLEET_SITE",
     "HEALTH_STATES",
+    "HeavyHitterFleet",
     "MetricFleet",
     "MetricService",
     "ServiceStoppedError",

@@ -74,12 +74,15 @@ from metrics_tpu.observability.counters import (
     COUNTERS as _COUNTERS,
     record_fleet_shards,
 )
+from metrics_tpu.parallel.cms import stable_key_hash
 from metrics_tpu.parallel.sync import SyncGuard
 from metrics_tpu.serving.service import MetricService, ServiceStoppedError
+from metrics_tpu.wrappers.heavy_hitters import HeavyHitters
 from metrics_tpu.wrappers.windowed import _ROWS_STATE, Windowed
 
 __all__ = [
     "FLEET_SITE",
+    "HeavyHitterFleet",
     "MetricFleet",
     "ShardStoppedError",
     "shard_for_key",
@@ -89,42 +92,11 @@ __all__ = [
 # the chaos-injector site fleet shards consult (FaultSpec(site=..., shard=i))
 FLEET_SITE = "fleet.shard"
 
-# 64-bit FNV-1a: the routing hash of record. Chosen because it is trivially
-# re-implementable in any producer language (offset basis + xor/multiply per
-# byte), has no process-lifetime salt (unlike Python's str hash), and its
-# low bits are well-mixed enough for `% num_shards` partitioning.
-_FNV64_OFFSET = 0xCBF29CE484222325
-_FNV64_PRIME = 0x100000001B3
-_FNV64_MASK = 0xFFFFFFFFFFFFFFFF
-
-
-def stable_key_hash(key: Any) -> int:
-    """The fleet's stable routing hash: 64-bit FNV-1a over the key's
-    canonical bytes.
-
-    Canonical form (type-tagged so ``1`` and ``"1"`` cannot collide by
-    construction): ``b"s:" + utf-8`` for str, ``b"b:" + bytes`` for bytes,
-    ``b"i:" + decimal`` for ints (numpy integers included). Any other key
-    type is rejected loudly — a repr-based fallback would silently change
-    routing across library versions, and routing MUST survive restarts
-    (``shard_for_key(key, n)`` is the partition contract producers and
-    restored fleets both rely on).
-    """
-    if isinstance(key, bytes):
-        data = b"b:" + key
-    elif isinstance(key, str):
-        data = b"s:" + key.encode("utf-8")
-    elif isinstance(key, (int, np.integer)) and not isinstance(key, bool):
-        data = b"i:" + str(int(key)).encode("ascii")
-    else:
-        raise TypeError(
-            f"fleet keys must be str, bytes or int (stable canonical bytes);"
-            f" got {type(key).__name__}"
-        )
-    h = _FNV64_OFFSET
-    for byte in data:
-        h = ((h ^ byte) * _FNV64_PRIME) & _FNV64_MASK
-    return h
+# The routing hash of record lives in ``parallel/cms.py`` since the count-min
+# tail derives its row buckets from the SAME 64-bit FNV-1a (one hash of
+# record for the router and the sketch family); re-exported here unchanged —
+# ``shard_for_key(key, n)`` is still the partition contract producers and
+# restored fleets rely on, pinned against precomputed values in tests.
 
 
 def shard_for_key(key: Any, num_shards: int) -> int:
@@ -463,3 +435,99 @@ class MetricFleet:
             f"MetricFleet({type(self._template.metric).__name__},"
             f" num_shards={self.num_shards}, merged={len(self.merged_records)})"
         )
+
+
+class HeavyHitterFleet:
+    """N hash-partitioned ``HeavyHitters`` ingest shards — open-world
+    multi-tenant serving with NO pre-sized key space.
+
+    The ``MetricFleet``/``Windowed(Keyed)`` topology still pre-sizes every
+    shard's segment table and expects producers to resolve keys to slot ids.
+    This fleet routes UNBOUNDED keys: ``submit(keys, *data)`` partitions the
+    batch by ``stable_key_hash(key) % N`` (the same router, so each key
+    lives on exactly ONE shard) and each shard's
+    :class:`~metrics_tpu.wrappers.heavy_hitters.HeavyHitters` keeps its own
+    exact hot slab + count-min tail — per-shard state is constant in the
+    live-key count, and shard hot sets are DISJOINT by construction, so the
+    global top-K is a pure merge-and-sort of per-shard records with no
+    double counting and no cross-shard slot alignment problem (the reason
+    ``Keyed(lru=True)`` slabs are not fleet-mergeable).
+
+    Args:
+        metric_factory: zero-arg callable building one shard's
+            ``HeavyHitters`` (each call a fresh, identically-configured
+            instance).
+        num_shards: N. Routing is the stable partition contract
+            (``shard_for_key``), identical across restarts.
+
+    Deliberately synchronous: the threaded ingest/backpressure story lives
+    in ``MetricService``/``MetricFleet``; this class is the ROUTING +
+    MERGE-TIER shape for the open-world key space.
+    """
+
+    def __init__(self, metric_factory: Callable[[], HeavyHitters], num_shards: int):
+        if not callable(metric_factory):
+            raise ValueError(
+                "`metric_factory` must be a zero-arg callable building a HeavyHitters"
+            )
+        if not (isinstance(num_shards, int) and num_shards >= 1):
+            raise ValueError(f"`num_shards` must be a positive int, got {num_shards!r}")
+        self.num_shards = num_shards
+        self.shards: List[HeavyHitters] = []
+        for _ in range(num_shards):
+            shard = metric_factory()
+            if not isinstance(shard, HeavyHitters):
+                raise ValueError(
+                    "`metric_factory` must build HeavyHitters instances,"
+                    f" got {type(shard).__name__}"
+                )
+            self.shards.append(shard)
+
+    def shard_of(self, key: Any) -> int:
+        """Where ``key``'s traffic routes — the stable partition contract."""
+        return shard_for_key(key, self.num_shards)
+
+    def submit(self, keys, *args: Any, **kwargs: Any) -> None:
+        """Partition one keyed batch across the shards and update each
+        shard's two-tier state with its rows (one ``HeavyHitters.update``
+        per non-empty shard)."""
+        keys = list(keys)
+        by_shard: Dict[int, List[int]] = {}
+        for i, key in enumerate(keys):
+            by_shard.setdefault(shard_for_key(key, self.num_shards), []).append(i)
+        for shard, rows in sorted(by_shard.items()):
+            idx = np.asarray(rows, dtype=np.int32)
+            self.shards[shard].update(
+                *(a[idx] for a in args),
+                key=[keys[i] for i in rows],
+                **{k: v[idx] for k, v in kwargs.items()},
+            )
+
+    def compute(self, key: Any) -> Any:
+        """One key's value from its home shard (exact if hot there,
+        certified tail estimate otherwise)."""
+        return self.shards[self.shard_of(key)].compute(key=key)
+
+    def compute_heavy_hitters(self, k: Optional[int] = None) -> List[Dict[str, Any]]:
+        """The GLOBAL top-K, heaviest first: per-shard records merged and
+        re-sorted — sound because the router makes shard hot sets disjoint
+        (every record additionally carries its ``shard``)."""
+        records: List[Dict[str, Any]] = []
+        for index, shard in enumerate(self.shards):
+            for record in shard.compute_heavy_hitters():
+                records.append({**record, "shard": index})
+        records.sort(key=lambda r: (-r["count"], str(r["key"])))
+        return records[:k] if k is not None else records
+
+    def tail_mass(self) -> int:
+        """Total tail-resident samples across the fleet."""
+        return sum(shard.tail_mass() for shard in self.shards)
+
+    def tail_overcount_bound(self) -> float:
+        """The fleet-level certificate: a key's estimate comes from its home
+        shard alone, so the worst shard's ``(e/width) * N_shard`` bounds any
+        single query's overcount."""
+        return max(shard.tail_overcount_bound() for shard in self.shards)
+
+    def __repr__(self) -> str:
+        return f"HeavyHitterFleet(num_shards={self.num_shards})"

@@ -7,6 +7,7 @@ metric x thousands of segments as a leading state axis, where the cloning
 wrappers (Classwise/Multioutput) fan out whole modules."""
 from metrics_tpu.wrappers.bootstrapper import BootStrapper
 from metrics_tpu.wrappers.classwise import ClasswiseWrapper
+from metrics_tpu.wrappers.heavy_hitters import HeavyHitters, SpaceSavingTable
 from metrics_tpu.wrappers.keyed import Keyed
 from metrics_tpu.wrappers.minmax import MinMaxMetric
 from metrics_tpu.wrappers.multioutput import MultioutputWrapper
@@ -15,6 +16,6 @@ from metrics_tpu.wrappers.tracker import MetricTracker
 from metrics_tpu.wrappers.windowed import Windowed
 
 __all__ = [
-    "BootStrapper", "ClasswiseWrapper", "Keyed", "MinMaxMetric", "MetricTracker",
-    "MultioutputWrapper", "Running", "Windowed",
+    "BootStrapper", "ClasswiseWrapper", "HeavyHitters", "Keyed", "MinMaxMetric",
+    "MetricTracker", "MultioutputWrapper", "Running", "SpaceSavingTable", "Windowed",
 ]

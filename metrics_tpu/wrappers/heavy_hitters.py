@@ -1,0 +1,702 @@
+"""HeavyHitters: open-world key cardinality — exact hot slab, certified
+count-min tail, no key ever loses mass.
+
+``Keyed(metric, num_slots)`` caps the segment space at ``num_slots``: LRU
+eviction destroys an evicted tenant's history, and sizing K for the worst
+case wastes slab memory on the 99% of keys that are cold. ``HeavyHitters``
+is the two-tier answer from the streaming-frequency literature — exact
+Space-Saving-style counters for the hot set (Metwally et al., "Efficient
+Computation of Frequent and Top-k Elements in Data Streams") over a
+Count-Min sketch tail (Cormode & Muthukrishnan) — specialized so both tiers
+are ordinary mergeable states:
+
+- **Hot tier**: the top-K keys own exact ``(K, *shape)`` slab rows through
+  the existing :class:`~metrics_tpu.parallel.slab.SlabSpec` machinery —
+  bit-exact per-key values, one scatter per update, one leading state axis.
+- **Tail tier**: every other key folds its per-sample state delta into a
+  :class:`~metrics_tpu.parallel.cms.CountMinSketch` per inner leaf —
+  ``(depth, width, *shape)``, constant memory in the LIVE KEY COUNT, reads
+  certified as overcounts by at most ``(e/width) * N`` samples with
+  probability ``1 - e^-depth`` (:func:`~metrics_tpu.parallel.cms.
+  cms_error_bound`).
+- **Promotion/demotion**: a host-side Space-Saving table
+  (:class:`SpaceSavingTable`, the open-world analogue of ``LRUSlotTable``)
+  migrates keys as traffic shifts — a tail key whose estimated count
+  overtakes the coldest hot key's takes its slot, and the demoted key's
+  slab rows are FOLDED into the tail (``slab_take_rows`` + ``cms_scatter``)
+  before the slot resets: demotion conserves mass instead of destroying
+  history, so hot + tail totals are bit-exact the whole stream's.
+
+Both tiers are sum-reduced integer/float leaves, so sync rides the existing
+coalesced ``psum`` buckets of ``coalesced_sync_state`` UNCHANGED: the staged
+collective count is identical to the unkeyed metric's at ANY simulated key
+count (``bench.py --check-collectives`` pins it at K=1,000,000), and state
+bytes are constant in the live-key count by construction.
+
+Like ``Keyed(lru=True)``, key resolution is host-side by construction (the
+whole point of the table is data-dependent key management jit cannot
+express), so updates run the eager path; every scatter that consumes the
+resolved routing is still one XLA op. The contract on the inner metric is
+the ``Keyed`` contract narrowed to the tail's soundness requirement:
+fixed-shape ``sum``/``mean`` states or sketch states with NON-NEGATIVE
+per-sample deltas (counts, histogram increments) — ``min``/``max`` states
+have no certified tail form (use ``Keyed`` for those), and cat/buffer
+states have no slab form (use ``approx="sketch"``).
+"""
+import math
+from typing import Any, Callable, Dict, Hashable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.core.metric import Metric, State
+from metrics_tpu.observability.counters import (
+    COUNTERS as _COUNTERS,
+    record_heavy_hitters,
+)
+from metrics_tpu.parallel.buffer import PaddedBuffer
+from metrics_tpu.parallel.cms import (
+    CMSSpec,
+    CMSTail,
+    CountMinSketch,
+    cms_buckets,
+    cms_error_bound,
+    cms_row_state,
+    cms_scatter,
+    cms_total,
+    make_cms_spec,
+    stable_key_hashes,
+)
+from metrics_tpu.parallel.sketch import SketchSpec, is_sketch, sketch_init
+from metrics_tpu.parallel.slab import (
+    SlabSpec,
+    make_slab_spec,
+    slab_init,
+    slab_merge,
+    slab_rows_spec,
+    slab_scatter,
+    slab_take_rows,
+)
+from metrics_tpu.utils.data import accum_int_dtype
+from metrics_tpu.utils.exceptions import TracingUnsupportedError
+
+__all__ = ["HeavyHitters", "SpaceSavingTable"]
+
+# the hot tier's per-slot sample-count slab and the tail tier's sample-count
+# sketch: occupancy masks, sum-backed mean division, and the certificate's N
+_ROWS_STATE = "hh_rows"
+_TAIL_ROWS_STATE = "hh_tail_rows"
+_TAIL_SUFFIX = "_tail"
+
+_EMPTY_POLICIES = ("nan", "zero")
+
+
+class SpaceSavingTable:
+    """Host-side Space-Saving key -> slot table over an OPEN key space.
+
+    Maps the estimated-heaviest ``num_slots`` keys onto exact slab rows and
+    routes everyone else to the count-min tail. Per hot key it tracks
+    ``hot`` (exact samples scattered into the key's slab row since
+    admission — always equal to the device rows slab, zero readbacks) and
+    ``credit`` (the key's tail-count estimate at admission — Space-Saving's
+    carried overestimate; that mass physically STAYS in the tail, so credit
+    is bookkeeping, never double-counted). The Space-Saving count of a hot
+    key is ``hot + credit``; a non-resident key whose estimate exceeds the
+    minimum hot count takes that key's slot, and the demoted key's exact
+    ``hot`` mass is folded back into the tail (the caller folds the device
+    rows; the table mirrors the counts).
+
+    The table also keeps a HOST MIRROR of the tail's sample-count sketch
+    (same buckets, same increments as the device ``hh_tail_rows`` state):
+    promotion decisions and gauges read it with zero device readbacks. The
+    mirror is process-local advisory state — the device CMS remains the
+    synced state of record — and it rides checkpoints so a restored table
+    resumes with the same promotion behavior.
+
+    Resolution is eager host work by construction (data-dependent key
+    management jit cannot express); the scatters that CONSUME the resolved
+    slot ids and buckets stay jittable.
+    """
+
+    def __init__(self, num_slots: int, depth: int, width: int, seed: int):
+        if not isinstance(num_slots, int) or num_slots < 1:
+            raise ValueError(f"`num_slots` must be a positive int, got {num_slots!r}")
+        self.num_slots = num_slots
+        self.depth, self.width, self.seed = depth, width, seed
+        self._map: Dict[Hashable, int] = {}
+        self._free: List[int] = list(range(num_slots - 1, -1, -1))  # pop() ascends
+        self._hot: Dict[Hashable, int] = {}
+        self._credit: Dict[Hashable, int] = {}
+        self._residue: Dict[Hashable, bool] = {}
+        self._mirror = np.zeros((depth, width), dtype=np.int64)
+        self.promotions = 0
+        self.demotions = 0
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def __contains__(self, key: Hashable) -> bool:
+        return key in self._map
+
+    def keys(self) -> Tuple[Hashable, ...]:
+        """Current hot keys (insertion order — ranking is by count, not order)."""
+        return tuple(self._map)
+
+    def slot_of(self, key: Hashable) -> int:
+        if key not in self._map:
+            raise KeyError(
+                f"key {key!r} is not hot-resident; {len(self._map)}/{self.num_slots}"
+                " slots occupied (tail keys read through tail_estimate)"
+            )
+        return self._map[key]
+
+    def count_of(self, key: Hashable) -> int:
+        """The Space-Saving count: exact hot samples + admission credit."""
+        return self._hot[key] + self._credit[key]
+
+    def hot_samples_of(self, key: Hashable) -> int:
+        return self._hot[key]
+
+    def is_exact(self, key: Hashable) -> bool:
+        """Whether the key's slab row holds its WHOLE history: admitted with
+        zero estimated tail mass and never demoted since."""
+        return not self._residue[key]
+
+    def buckets_for(self, keys: Sequence[Hashable]) -> np.ndarray:
+        """``(N, depth)`` tail buckets for a batch of keys (the seeded
+        multiply-shift family over ``stable_key_hash``)."""
+        return cms_buckets(stable_key_hashes(keys), self.depth, self.width, self.seed)
+
+    def tail_estimate(self, key: Hashable) -> int:
+        """Mirror count-min read: certified overcount of the key's tail mass."""
+        buckets = self.buckets_for([key])[0]
+        return int(self._mirror[np.arange(self.depth), buckets].min())
+
+    def tail_mass(self) -> int:
+        """Total tail samples (every insert lands in every row — row 0's sum)."""
+        return int(self._mirror[0].sum())
+
+    def resolve(self, keys: Sequence[Hashable]) -> Tuple[np.ndarray, List[Tuple[Hashable, int]]]:
+        """Route one batch: ``(slot_ids int32 (N,), demoted)``.
+
+        ``slot_ids[i]`` is the sample's hot slot, or ``-1`` for the tail.
+        ``demoted`` lists ``(key, slot)`` pairs whose slab rows the caller
+        must FOLD into the tail (``HeavyHitters`` does, before resetting the
+        slots and scattering the batch). Decisions are per DISTINCT key in
+        first-appearance order, and a key already routed (or admitted) this
+        batch is never a demotion victim — the fold always reads pre-batch
+        rows, so no same-batch sample can be split across tiers.
+        """
+        distinct: Dict[Hashable, int] = {}
+        for key in keys:
+            distinct[key] = distinct.get(key, 0) + 1
+
+        decisions: Dict[Hashable, int] = {}
+        touched: set = set()
+        demoted: List[Tuple[Hashable, int]] = []
+        for key, cnt in distinct.items():
+            if key in self._map:
+                slot = self._map[key]
+                touched.add(key)
+            elif self._free:
+                slot = self._free.pop()
+                self._admit(key, slot)
+                touched.add(key)
+            else:
+                est = self.tail_estimate(key) + cnt
+                victim, victim_count = None, None
+                for k in self._map:
+                    if k in touched:
+                        continue
+                    c = self._hot[k] + self._credit[k]
+                    if victim_count is None or c < victim_count:
+                        victim, victim_count = k, c
+                if victim is not None and est > victim_count:
+                    slot = self._demote(victim)
+                    demoted.append((victim, slot))
+                    self._admit(key, slot)
+                    touched.add(key)
+                else:
+                    slot = -1  # tail-routed: constant memory, certified read
+            decisions[key] = slot
+
+        slot_ids = np.empty(len(keys), dtype=np.int32)
+        for i, key in enumerate(keys):
+            slot_ids[i] = decisions[key]
+        for key, cnt in distinct.items():
+            if decisions[key] >= 0:
+                self._hot[key] += cnt
+            else:
+                buckets = self.buckets_for([key])[0]
+                self._mirror[np.arange(self.depth), buckets] += cnt
+        return slot_ids, demoted
+
+    def _admit(self, key: Hashable, slot: int) -> None:
+        credit = self.tail_estimate(key)
+        self._map[key] = slot
+        self._hot[key] = 0
+        self._credit[key] = credit
+        # nonzero credit = the key has tail residue: its pre-promotion mass
+        # stays in the tail, so the slab row is exact-since-promotion only
+        self._residue[key] = credit > 0
+        self.promotions += 1
+
+    def _demote(self, key: Hashable) -> int:
+        slot = self._map.pop(key)
+        # the key's exact hot mass returns to the tail (the caller folds the
+        # device rows; this mirrors the sample counts) — no mass destroyed
+        buckets = self.buckets_for([key])[0]
+        self._mirror[np.arange(self.depth), buckets] += self._hot.pop(key)
+        self._credit.pop(key)
+        self._residue.pop(key)
+        self.demotions += 1
+        return slot
+
+    def state(self) -> dict:
+        """Checkpointable view (keys + per-key bookkeeping + the mirror)."""
+        keys = list(self._map)
+        return {
+            "keys": keys,
+            "slots": np.asarray([self._map[k] for k in keys], dtype=np.int64),
+            "hot": np.asarray([self._hot[k] for k in keys], dtype=np.int64),
+            "credit": np.asarray([self._credit[k] for k in keys], dtype=np.int64),
+            "residue": np.asarray([self._residue[k] for k in keys], dtype=np.bool_),
+            "mirror": self._mirror.copy(),
+            "promotions": np.asarray(self.promotions, dtype=np.int64),
+            "demotions": np.asarray(self.demotions, dtype=np.int64),
+        }
+
+    def load_state(self, state: dict) -> None:
+        keys = list(state["keys"])
+        slots = np.asarray(state["slots"])
+        self._map = {k: int(s) for k, s in zip(keys, slots)}
+        self._hot = {k: int(v) for k, v in zip(keys, np.asarray(state["hot"]))}
+        self._credit = {k: int(v) for k, v in zip(keys, np.asarray(state["credit"]))}
+        self._residue = {k: bool(v) for k, v in zip(keys, np.asarray(state["residue"]))}
+        used = set(self._map.values())
+        self._free = [s for s in range(self.num_slots - 1, -1, -1) if s not in used]
+        self._mirror = np.asarray(state["mirror"], dtype=np.int64).copy()
+        self.promotions = int(state["promotions"])
+        self.demotions = int(state["demotions"])
+
+    def reset(self) -> None:
+        """Forget every key and the mirror (the epoch-reset path). Lifetime
+        promotion/demotion counts are process gauges and survive, like the
+        LRU table's eviction count."""
+        self._map.clear()
+        self._hot.clear()
+        self._credit.clear()
+        self._residue.clear()
+        self._free = list(range(self.num_slots - 1, -1, -1))
+        self._mirror[:] = 0
+
+
+class HeavyHitters(Metric):
+    r"""Two-tier open-world fan-out of ``metric``: exact top-K slab rows
+    over a certified count-min tail.
+
+    Args:
+        metric: the inner metric. Its states become ``(K, *shape)`` hot
+            slabs PLUS ``(depth, width, *shape)`` count-min tails; its
+            ``update``/``compute`` are reused as the per-sample delta and
+            the per-slot finisher — the instance itself never accumulates.
+            States must be ``sum``/``mean`` arrays or sketch states with
+            non-negative per-sample deltas (the tail's certified-overcount
+            contract); ``min``/``max`` states are rejected (use ``Keyed``)
+            and cat/buffer states are rejected (use ``approx="sketch"``).
+        num_hot_slots: K, the exact hot rows.
+        tail: the count-min grid — a :class:`~metrics_tpu.parallel.cms.
+            CMSTail`, a ``(depth, width)`` pair, or a bare width int.
+        empty: what reads report when nothing is resident — ``"nan"``
+            (default; non-float results fall back to 0) or ``"zero"``.
+
+    ``update(*data, key=keys)`` takes one hashable key per sample (str /
+    bytes / int — the ``stable_key_hash`` canonical types). ``compute()``
+    returns the hot tier's ``(K,)`` values; ``compute(key=k)`` reads one
+    key from whichever tier holds it (hot: exact slab row; tail: certified
+    overcount estimate — see :meth:`tail_estimate` for the certificate);
+    :meth:`compute_heavy_hitters` returns the current top-K with their
+    guarantee flags. Sync rides the base machinery: both tiers are
+    sum-reduced leaves, so the wrapper syncs through the same coalesced
+    psum buckets as the unkeyed metric — the staged collective count is
+    identical at ANY key-space size, and no key ever loses mass (demotion
+    folds, never destroys).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> hh = HeavyHitters(Accuracy(), num_hot_slots=2, tail=(4, 64))
+        >>> preds = jnp.array([0.9, 0.8, 0.3, 0.1])
+        >>> target = jnp.array([1, 0, 0, 0])
+        >>> hh.update(preds, target, key=["a", "b", "b", "a"])
+        >>> [r["key"] for r in hh.compute_heavy_hitters()]
+        ['a', 'b']
+    """
+
+    def __init__(
+        self,
+        metric: Metric,
+        num_hot_slots: int,
+        tail: Any = CMSTail(),
+        empty: str = "nan",
+        compute_on_step: Optional[bool] = None,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        if not isinstance(metric, Metric):
+            raise ValueError(f"`metric` must be a Metric, got {type(metric).__name__}")
+        if empty not in _EMPTY_POLICIES:
+            raise ValueError(f"`empty` must be one of {_EMPTY_POLICIES}, got {empty!r}")
+        super().__init__(
+            compute_on_step=metric.compute_on_step if compute_on_step is None else compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+            # key resolution is host-side by construction: the fused jitted
+            # step can never trace the space-saving table
+            jit=False,
+        )
+        self.metric = metric
+        self.num_hot_slots = int(num_hot_slots)
+        rows_spec = make_cms_spec(tail, (), np.dtype(accum_int_dtype()))
+        self.tail = CMSTail(rows_spec.depth, rows_spec.width, rows_spec.seed)
+        self.empty = empty
+        self._metric_label = f"HeavyHitters({type(metric).__name__})"
+
+        if not metric._defaults:
+            raise ValueError("the inner metric declares no states; nothing to key")
+        reserved = {_ROWS_STATE, _TAIL_ROWS_STATE}
+        reserved |= {name + _TAIL_SUFFIX for name in metric._defaults}
+        if reserved & set(metric._defaults):
+            raise ValueError(
+                f"the inner metric's state names collide with the wrapper's"
+                f" ({sorted(reserved & set(metric._defaults))})"
+            )
+        self._slab_reduce: Dict[str, str] = {}
+        for name, spec in metric._defaults.items():
+            slab = self._slab_spec_for(name, spec, metric._reductions[name])
+            self._slab_reduce[name] = slab.reduce
+            self.add_state(name, default=slab, dist_reduce_fx="sum", persistent=True)
+            self.add_state(
+                name + _TAIL_SUFFIX,
+                default=CMSSpec(self.tail.depth, self.tail.width, slab.item_shape,
+                                slab.dtype, self.tail.seed),
+                dist_reduce_fx="sum", persistent=True,
+            )
+        self.add_state(_ROWS_STATE, default=slab_rows_spec(self.num_hot_slots),
+                       dist_reduce_fx="sum", persistent=True)
+        self.add_state(_TAIL_ROWS_STATE, default=rows_spec, dist_reduce_fx="sum",
+                       persistent=True)
+        self._table = SpaceSavingTable(
+            self.num_hot_slots, self.tail.depth, self.tail.width, self.tail.seed
+        )
+
+    def _slab_spec_for(self, name: str, spec: Any, fx: Any) -> SlabSpec:
+        """The hot-tier ``SlabSpec`` one inner state maps onto, or a loud
+        rejection. Narrower than ``Keyed``: the tail's certified-overcount
+        read needs non-negative additive deltas, so only sum/mean/sketch."""
+        if isinstance(spec, SketchSpec):
+            return make_slab_spec(self.num_hot_slots, np.zeros(spec.shape, np.dtype(spec.dtype)),
+                                  "sum", kind=spec.kind)
+        if isinstance(spec, (list, PaddedBuffer)) or fx == "cat" or fx is None:
+            raise ValueError(
+                f"state {name!r} of {type(self.metric).__name__} is a cat/list/buffer"
+                " state with no slab/tail form; HeavyHitters supports fixed-shape"
+                " sum/mean states and sketch states (curve/rank metrics: construct"
+                " the inner metric with approx='sketch')"
+            )
+        if isinstance(spec, (SlabSpec, CMSSpec)) or not isinstance(spec, np.ndarray):
+            raise ValueError(
+                f"state {name!r} has an unsupported default kind for HeavyHitters:"
+                f" {type(spec).__name__}"
+            )
+        if not (isinstance(fx, str) and fx in ("sum", "mean")):
+            raise ValueError(
+                f"state {name!r} uses dist_reduce_fx={fx!r}; the count-min tail"
+                " certifies overcounts only for additive non-negative states, so"
+                " HeavyHitters supports 'sum'/'mean' array states and sketch states"
+                " (min/max segment states: use Keyed, whose slots are exact)"
+            )
+        canonical = jax.dtypes.canonicalize_dtype(spec.dtype)
+        if canonical != spec.dtype:
+            spec = spec.astype(canonical)
+        return make_slab_spec(self.num_hot_slots, spec, fx)
+
+    # ---------------------------------------------------------------- update
+    def update(self, *args: Any, key: Any = None, **kwargs: Any) -> None:
+        """Route one batch across the tiers.
+
+        ``key`` (required, keyword-only) is one hashable segment key per
+        sample (str/bytes/int — the ``stable_key_hash`` canonical types);
+        all positional/keyword data arguments must share the leading sample
+        axis with it. Hot keys scatter into their exact slab rows, tail keys
+        fold into the count-min tail, and a tail key whose estimated count
+        overtakes the coldest hot key's is promoted in place (the demoted
+        key's rows fold into the tail first — mass is conserved).
+        """
+        if key is None:
+            raise ValueError("HeavyHitters.update requires `key=` (one key per sample)")
+        if self._under_trace():
+            raise TracingUnsupportedError(
+                "HeavyHitters resolves keys through a host-side space-saving table"
+                " and cannot run under jit tracing; drive it eagerly — every"
+                " scatter consuming the resolved routing is still one XLA op."
+            )
+        keys = (
+            [k.item() for k in np.asarray(key).reshape(-1)]
+            if isinstance(key, (np.ndarray, jnp.ndarray, Array))
+            else list(key)
+        )
+        data = (*args, *kwargs.values())
+        if not data:
+            raise ValueError("HeavyHitters.update needs at least one data argument")
+        if not keys:
+            return
+
+        slot_ids_np, demoted = self._table.resolve(keys)
+        if demoted:
+            self._fold_demoted(demoted)
+        slot_ids = jnp.asarray(slot_ids_np)
+        # per-sample tail buckets; hot samples get the out-of-range sentinel
+        # (width) so the tail scatter DROPS them — mirror of the hot scatter
+        # dropping the tail samples' slot -1
+        buckets_np = self._table.buckets_for(keys)
+        buckets = jnp.asarray(
+            np.where(slot_ids_np[:, None] >= 0, self.tail.width, buckets_np)
+        )
+
+        kw_keys = tuple(kwargs)
+        n_args = len(args)
+
+        def one(*sample):
+            batch = tuple(a[None] for a in sample)  # per-sample size-1 batches
+            return self.metric.update_state(
+                self.metric.init_state(), *batch[:n_args], **dict(zip(kw_keys, batch[n_args:]))
+            )
+
+        deltas = jax.vmap(one)(*data)  # {name: (N, *shape) / sketch with (N, ...) counts}
+        for name in self.metric._defaults:
+            reduce = self._slab_reduce[name]
+            current = getattr(self, name)
+            leaf = deltas[name]
+            payload = leaf.counts if is_sketch(leaf) else leaf
+            scattered = slab_scatter("sum", payload, slot_ids, self.num_hot_slots)
+            if is_sketch(current):
+                setattr(self, name, type(current)(current.counts + scattered))
+            else:
+                setattr(self, name, slab_merge(reduce, current, scattered))
+            tail = getattr(self, name + _TAIL_SUFFIX)
+            setattr(self, name + _TAIL_SUFFIX,
+                    CountMinSketch(cms_scatter(tail.counts, buckets, payload)))
+        rows = getattr(self, _ROWS_STATE)
+        ones = jnp.ones(slot_ids.shape, dtype=rows.dtype)
+        setattr(self, _ROWS_STATE,
+                rows + slab_scatter("sum", ones, slot_ids, self.num_hot_slots))
+        tail_rows = getattr(self, _TAIL_ROWS_STATE)
+        setattr(self, _TAIL_ROWS_STATE,
+                CountMinSketch(cms_scatter(tail_rows.counts, buckets, ones)))
+        self._note_hh_gauges()
+
+    def _fold_demoted(self, demoted: List[Tuple[Hashable, int]]) -> None:
+        """Fold demoted keys' exact slab rows into the tail, then reset their
+        slots — the mass-conserving eviction (``Keyed``'s LRU zeroes here)."""
+        keys = [k for k, _ in demoted]
+        slots = [s for _, s in demoted]
+        buckets = jnp.asarray(self._table.buckets_for(keys))  # (M, depth)
+        for name in self.metric._defaults:
+            value = getattr(self, name)
+            payload = slab_take_rows(value, slots)  # (M, *item), pre-batch rows
+            tail = getattr(self, name + _TAIL_SUFFIX)
+            setattr(self, name + _TAIL_SUFFIX,
+                    CountMinSketch(cms_scatter(tail.counts, buckets, payload)))
+        rows = getattr(self, _ROWS_STATE)
+        tail_rows = getattr(self, _TAIL_ROWS_STATE)
+        setattr(self, _TAIL_ROWS_STATE, CountMinSketch(
+            cms_scatter(tail_rows.counts, buckets, slab_take_rows(rows, slots))
+        ))
+        # reset the recycled rows (hot states + the rows slab only; the tail
+        # states just RECEIVED the folded mass)
+        idx = jnp.asarray(np.asarray(slots, dtype=np.int32))
+        for name in (*self.metric._defaults, _ROWS_STATE):
+            value = getattr(self, name)
+            fresh = slab_init(self._defaults[name])
+            if is_sketch(value):
+                setattr(self, name, type(value)(value.counts.at[idx].set(fresh.counts[idx])))
+            else:
+                setattr(self, name, value.at[idx].set(fresh[idx]))
+
+    def _note_hh_gauges(self) -> None:
+        """Feed the heavy-hitter gauges (zero readbacks: occupancy and
+        promotion counts are table bookkeeping, tail mass and the certificate
+        come from the host mirror)."""
+        if not _COUNTERS.enabled:
+            return
+        mass = self._table.tail_mass()
+        record_heavy_hitters(
+            self._metric_label,
+            hot_slots=self.num_hot_slots,
+            hot_occupied=len(self._table),
+            promotions=self._table.promotions,
+            demotions=self._table.demotions,
+            tail_mass=mass,
+            tail_bound=math.e / self.tail.width * mass,
+        )
+
+    # --------------------------------------------------------------- compute
+    def compute(self) -> Any:
+        """The hot tier's K per-segment values: the inner finisher vmapped
+        over the hot slab (empty slots per the ``empty`` policy). The public
+        wrapped form also accepts ``compute(key=k)`` for a single-key read
+        from whichever tier holds the key."""
+        state = self._current_state()
+        rows = state[_ROWS_STATE]
+        hot = {name: state[name] for name in self.metric._defaults}
+        return self._finish_hot(hot, rows)
+
+    def _finish_hot(self, state: State, rows: Array) -> Any:
+        inner_state: State = {}
+        for name, value in state.items():
+            if self._slab_reduce[name] == "mean":
+                denom = jnp.maximum(rows, 1).astype(value.dtype).reshape(
+                    (self.num_hot_slots,) + (1,) * (value.ndim - 1)
+                )
+                value = value / denom
+            inner_state[name] = value
+        results = jax.vmap(self.metric.compute_from_state)(inner_state)
+        occupied = rows > 0
+
+        def mask(r: Array) -> Array:
+            r = jnp.asarray(r)
+            occ = occupied.reshape((self.num_hot_slots,) + (1,) * (r.ndim - 1))
+            if self.empty == "nan" and jnp.issubdtype(r.dtype, jnp.inexact):
+                return jnp.where(occ, r, jnp.nan)
+            return jnp.where(occ, r, jnp.zeros((), dtype=r.dtype))
+
+        return jax.tree_util.tree_map(mask, results)
+
+    def _wrap_compute(self, compute: Callable) -> Callable:
+        """The base wrapper (sync + cache) plus the ``key=`` read form: hot
+        keys slice the cached (K, ...) results, tail keys read the certified
+        count-min estimate (local state — the tail read is the serving-time
+        point query, not an epoch sync)."""
+        wrapped = super()._wrap_compute(compute)
+
+        def with_key(key: Any = None) -> Any:
+            out = wrapped()
+            if key is None:
+                return out
+            if key in self._table:
+                slot = self._table.slot_of(key)
+                return jax.tree_util.tree_map(lambda v: v[slot], out)
+            return self.tail_estimate(key)["value"]
+
+        return with_key
+
+    def tail_estimate(self, key: Hashable) -> Dict[str, Any]:
+        """Certified tail read of one key: ``{"value", "count", "bound",
+        "exact": False}``.
+
+        ``count`` is the count-min sample estimate (always >= the true
+        count); every state leaf is read from the SAME argmin row so the
+        estimate is an internally consistent state; ``bound`` is the
+        ``(e/width) * N`` overcount certificate (samples, probability
+        ``1 - e^-depth`` — :func:`~metrics_tpu.parallel.cms.
+        cms_error_bound`). Reads local state by design, like
+        ``Windowed.compute_window``: point queries must not force a sync.
+        """
+        buckets = jnp.asarray(self._table.buckets_for([key])[0])  # (depth,)
+        tail_rows = getattr(self, _TAIL_ROWS_STATE).counts
+        per_row = cms_row_state(tail_rows, buckets)  # (depth,)
+        row = int(jnp.argmin(per_row))
+        count = int(per_row[row])
+        bound = float(cms_error_bound(tail_rows))
+        inner_state: State = {}
+        for name, spec in self.metric._defaults.items():
+            tail = getattr(self, name + _TAIL_SUFFIX).counts
+            leaf = cms_row_state(tail, buckets)[row]
+            if self._slab_reduce[name] == "mean":
+                leaf = leaf / jnp.maximum(
+                    jnp.asarray(count, dtype=leaf.dtype), jnp.ones((), dtype=leaf.dtype)
+                )
+            if isinstance(spec, SketchSpec):
+                leaf = type(sketch_init(spec))(leaf)
+            inner_state[name] = leaf
+        result = self.metric.compute_from_state(inner_state)
+
+        def mask(r: Array) -> Array:
+            r = jnp.asarray(r)
+            if count > 0:
+                return r
+            if self.empty == "nan" and jnp.issubdtype(r.dtype, jnp.inexact):
+                return jnp.full_like(r, jnp.nan)
+            return jnp.zeros_like(r)
+
+        value = jax.tree_util.tree_map(mask, result)
+        return {"value": value, "count": count, "bound": bound, "exact": False}
+
+    def compute_heavy_hitters(self, k: Optional[int] = None) -> List[Dict[str, Any]]:
+        """The current top-K, heaviest first: ``[{"key", "slot", "count",
+        "samples", "exact", "value"}, ...]``.
+
+        ``count`` is the Space-Saving count (exact hot samples + the
+        admission credit carried from the tail); ``samples`` the exact hot
+        samples; ``exact`` the guarantee flag — True iff the key's slab row
+        holds its whole history (admitted with zero tail estimate, never
+        demoted since), else the value is exact-since-promotion with the
+        remainder certified in the tail. ``value`` slices the ordinary
+        (synced, cached) ``compute()`` results.
+        """
+        values = self.compute()
+        records = []
+        for key in self._table.keys():
+            slot = self._table.slot_of(key)
+            records.append({
+                "key": key,
+                "slot": slot,
+                "count": self._table.count_of(key),
+                "samples": self._table.hot_samples_of(key),
+                "exact": self._table.is_exact(key),
+                "value": jax.tree_util.tree_map(lambda v: v[slot], values),
+            })
+        records.sort(key=lambda r: (-r["count"], str(r["key"])))
+        return records[:k] if k is not None else records
+
+    def tail_mass(self) -> int:
+        """Total samples resident in the tail (device state of record)."""
+        return int(cms_total(getattr(self, _TAIL_ROWS_STATE).counts))
+
+    def tail_overcount_bound(self) -> float:
+        """The tail's current ``(e/width) * N`` certificate, in samples."""
+        return float(cms_error_bound(getattr(self, _TAIL_ROWS_STATE).counts))
+
+    # ------------------------------------------------------------- lifecycle
+    def reset(self) -> None:
+        super().reset()
+        self._table.reset()
+
+    _TABLE_KEY = "_hh_table"
+
+    def state_dict(self, destination: Optional[dict] = None, prefix: str = "") -> dict:
+        """Slab and tail states persist through the base path (plain arrays /
+        counts sketches); the space-saving table — key map, counts, credit,
+        residue flags, the host mirror — rides along so a restored metric
+        resolves the same keys to the same rows with the same promotion
+        behavior."""
+        destination = super().state_dict(destination, prefix=prefix)
+        destination[prefix + self._TABLE_KEY] = self._table.state()
+        return destination
+
+    def load_state_dict(self, state_dict: dict, prefix: str = "") -> None:
+        super().load_state_dict(state_dict, prefix=prefix)
+        key = prefix + self._TABLE_KEY
+        if key in state_dict:
+            self._table.load_state(state_dict[key])
+
+    def __repr__(self) -> str:
+        return (
+            f"HeavyHitters({self.metric!r}, num_hot_slots={self.num_hot_slots},"
+            f" tail={self.tail})"
+        )

@@ -32,6 +32,7 @@ from jax import Array
 from metrics_tpu.core.metric import Metric, State
 from metrics_tpu.observability.counters import (
     COUNTERS as _COUNTERS,
+    record_evicted_mass,
     record_slab_dropped,
     record_slab_slots,
 )
@@ -49,6 +50,7 @@ from metrics_tpu.parallel.slab import (
     slab_sync_reduce,
 )
 from metrics_tpu.utils.exceptions import TracingUnsupportedError
+from metrics_tpu.utils.prints import rank_zero_warn_once
 
 # the per-slot sample-count state every Keyed wrapper carries: occupancy
 # masks (empty-slot policy), the sum-backed mean division, and the gauges
@@ -229,6 +231,21 @@ class Keyed(Metric):
             ) else list(slot)
             slot_ids, evicted = self._slots.resolve(keys)
             if evicted:
+                # LRU eviction DESTROYS the recycled rows' history: count the
+                # mass it is about to zero (evidence, recorded even with
+                # observability off — before this counter the loss was
+                # invisible in every gauge) and name the lossless alternative
+                mass = int(np.asarray(getattr(self, _ROWS_STATE))[np.asarray(evicted)].sum())
+                if mass:
+                    record_evicted_mass(mass)
+                    rank_zero_warn_once(
+                        "Keyed(lru=True) evicted a resident segment and zeroed its"
+                        " accumulated history (evicted_mass_dropped counts the lost"
+                        " samples). If tenants must never lose mass, use"
+                        " HeavyHitters(metric, num_hot_slots, tail=...): demotion"
+                        " folds the evicted row into a count-min tail instead of"
+                        " destroying it."
+                    )
                 self._reset_slots(evicted)
             return jnp.asarray(slot_ids)
         return jnp.asarray(slot, dtype=jnp.int32).reshape(-1)

@@ -54,10 +54,12 @@ from jax import Array
 from metrics_tpu.core.metric import Metric, State
 from metrics_tpu.core.streaming import WindowSpec, decay_scale, route_events
 from metrics_tpu.observability.counters import record_slab_dropped
+from metrics_tpu.wrappers.keyed import Keyed
 from metrics_tpu.parallel.buffer import PaddedBuffer
 from metrics_tpu.parallel.sketch import SketchSpec, is_sketch
 from metrics_tpu.parallel.slab import (
     SlabSpec,
+    dropped_slot_count,
     make_slab_spec,
     slab_init,
     slab_merge,
@@ -327,6 +329,16 @@ class Windowed(Metric):
             raise ValueError(
                 f"event_time has {times.size} entries but the batch has {n} samples"
             )
+        if isinstance(self.metric, Keyed) and not self.metric.lru and "slot" in kwargs:
+            # the nested Windowed(Keyed) plane: out-of-range segment ids are
+            # dropped by the INNER slab scatter inside the vmapped delta —
+            # a device-side non-event the eager Keyed path would have
+            # counted. Count it here, from the host-routed update, so fleet
+            # shards surface misrouted-sample drops uniformly with the
+            # too-late drops below.
+            misrouted = dropped_slot_count(kwargs["slot"], self.metric.num_slots)
+            if misrouted:
+                record_slab_dropped(misrouted)
         if self.decay:
             slot_ids, weights = self._route_decay(times)
         else:

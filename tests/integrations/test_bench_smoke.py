@@ -92,6 +92,27 @@ def _assert_headline_schema(out):
     assert out["keyed_gather_calls"] == 0  # psum-only: the slab contract
     assert out["keyed_sync_bytes"] == 2640000  # (10000*2*16 + 10000) * 4 * 2 stages
 
+    # the heavy-hitter A/B rides the same line: HeavyHitters(AUROC sketch)
+    # over a 1,000,000-key space stages the SAME collective count and kinds
+    # as the unkeyed metric — both tiers (exact hot slab + count-min tail)
+    # are sum leaves in one psum bucket, and state bytes are constant in
+    # the live-key count ((256*2*16 + 256 + 4*1024*2*16 + 4*1024) * 4 * 2)
+    assert isinstance(out["hh_sync_ms"], (int, float)) and out["hh_sync_ms"] > 0
+    assert out["hh_states_synced"] == 4  # hot slab+rows, tail cms+rows
+    assert out["hh_collective_calls"] == 2  # two-stage (ici + dcn) psum
+    assert out["hh_collective_calls"] == out["hh_unkeyed_collective_calls"]
+    assert out["hh_gather_calls"] == 0  # psum-only: both tiers
+    assert out["hh_sync_bytes"] == 1148928
+    # the open-world ingest pair: throughput through the space-saving loop
+    # must not collapse as the key space grows 10k -> 1M (the flatness
+    # headline; smoke timings are noisy, so only a collapse gate here)
+    for key in ("hh_ingest_steps_per_s", "hh_ingest_steps_per_s_10k"):
+        assert isinstance(out[key], (int, float)) and out[key] > 0, key
+    assert out["hh_ingest_steps_per_s"] > 0.3 * out["hh_ingest_steps_per_s_10k"]
+    # the tail's (e/width)*N certificate is on the line, deterministic for
+    # the seeded ingest stream
+    assert out["hh_tail_overcount_bound"] > 0
+
     # the windowed serving A/B rides the same line: Windowed(AUROC sketch)
     # x 4 window slots stages the SAME collective count and kinds as the
     # unwindowed metric — windows are a state axis, window roll is a slot
@@ -170,7 +191,10 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     out = _run_smoke(("--trace", str(trace_file)))
     _assert_headline_schema(out)
 
-    # schema version of the --trace payload: v9 added the sharded fleet
+    # schema version of the --trace payload: v10 added the heavy-hitter
+    # open-world plane (hh_* staged-count keys pinned to the unkeyed twin,
+    # the 10k/1M ingest flatness pair, and the tail certificate on the
+    # default line); v9 added the sharded fleet
     # (fleet_ingest_steps_per_s at 1/8 shards + fleet_scaling_x + the merge
     # tier's window counts with fleet_lost_windows pinned at zero); v8 added
     # the lag-k pipelined plane (async_lag2/3_ms ring-depth keys,
@@ -182,7 +206,7 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     # windowed serving A/B; v5 the keyed slab A/B; v4 the sketch A/B; v3
     # moved the collective counts to the default line and added the
     # hierarchical A/B + per-crossing counters; bump this pin with the schema
-    assert out["trace_schema"] == 9
+    assert out["trace_schema"] == 10
     # the sketch program's full snapshot: psum-only, no gather kinds staged
     sketch_kinds = out["sketch_counters"]["calls_by_kind"]
     assert sketch_kinds.get("psum", 0) == 2
@@ -194,6 +218,12 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     for kind in ("all_gather", "coalesced_gather", "process_allgather"):
         assert keyed_kinds.get(kind, 0) == 0, kind
     assert out["keyed_counters"]["bytes_by_crossing"]["dcn"] == out["keyed_sync_bytes"] // 2
+    # the heavy-hitter program: the same psum-only shape over a 1M key space
+    hh_kinds = out["hh_counters"]["calls_by_kind"]
+    assert hh_kinds.get("psum", 0) == 2
+    for kind in ("all_gather", "coalesced_gather", "process_allgather"):
+        assert hh_kinds.get(kind, 0) == 0, kind
+    assert out["hh_counters"]["bytes_by_crossing"]["dcn"] == out["hh_sync_bytes"] // 2
     # the windowed serving program: the same psum-only shape at W=4 slots
     service_kinds = out["service_counters"]["calls_by_kind"]
     assert service_kinds.get("psum", 0) == 2
@@ -295,7 +325,7 @@ def test_bench_check_collectives_gate():
     assert out["ok"] is True and out["failures"] == []
     scenarios = out["scenarios"]
     assert set(scenarios) == {
-        "sketch_sync", "keyed_sync", "keyed_unkeyed",
+        "sketch_sync", "keyed_sync", "keyed_unkeyed", "hh_sync",
         "sum_grouped", "sum_ungrouped", "gather_coalesced", "gather_per_leaf",
         "gather_hier", "gather_flat2d",
         "sharded_auroc", "sharded_auroc_hier",
@@ -346,6 +376,21 @@ def test_bench_check_collectives_gate():
         == scenarios["keyed_unkeyed"]["collective_calls"]
     )
     assert scenarios["keyed_sync"]["gather_calls"] == 0
+    # the heavy-hitter gate of record: the OPEN-WORLD contract — a 1M-key-
+    # space HeavyHitters stages the identical psum-only program as the
+    # unkeyed metric, promotion/demotion conserves mass bit-exactly vs the
+    # oracle, every tail query on the seeded Zipfian stream lies within the
+    # reported (e/width)*N certificate, and state bytes are IDENTICAL at
+    # 10k and 1M live keys
+    hh_gate = out["hh_gate"]
+    assert hh_gate["ok"] is True
+    assert hh_gate["hh_collective_calls"] == hh_gate["unkeyed_collective_calls"]
+    assert hh_gate["hh_gather_calls"] == 0
+    assert hh_gate["simulated_key_space"] == 1_000_000
+    assert hh_gate["mass_conserved"] is True
+    assert hh_gate["demotions"] > 0  # the stream actually churned the tiers
+    assert hh_gate["cert_violations"] == 0 and hh_gate["cert_checked"] > 100
+    assert hh_gate["state_bytes_10k"] == hh_gate["state_bytes_1m"]
     for row in scenarios.values():
         assert row["status"] != "regression"
 

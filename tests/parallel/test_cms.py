@@ -1,0 +1,213 @@
+"""Count-Min sketch state kind + the hash family of record.
+
+The contract under test (parallel/cms.py):
+
+- ``stable_key_hash`` (now living here, re-exported by serving) partitions
+  uniformly across shard buckets, and the multiply-shift row family derived
+  from it (``cms_buckets``) is uniform per row — both pinned by seeded
+  chi-square tests so the router and the sketch hash family cannot regress
+  silently;
+- ``cms_scatter``/``cms_row_state`` are exact for collision-free keys,
+  always OVERCOUNT (never undercount) under collisions, drop the hot-tier
+  sentinel bucket, and merge by bit-exact addition (psum mergeability);
+- ``CMSSpec`` is a first-class state kind: ``add_state`` materializes it,
+  checkpoints round-trip through the counts entry, fingerprints include the
+  seed, and sync folds the counts leaf into the existing sum buckets.
+"""
+import numpy as np
+import pytest
+from scipy import stats
+
+import jax.numpy as jnp
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.parallel.cms import (
+    CMSSpec,
+    CMSTail,
+    CountMinSketch,
+    cms_buckets,
+    cms_error_bound,
+    cms_init,
+    cms_merge,
+    cms_nbytes,
+    cms_row_state,
+    cms_scatter,
+    cms_total,
+    is_cms,
+    make_cms_spec,
+    stable_key_hash,
+    stable_key_hashes,
+)
+from metrics_tpu.parallel.sketch import is_sketch
+from metrics_tpu.parallel.sync import is_mergeable, is_stack_mergeable
+from metrics_tpu.serving import stable_key_hash as served_hash
+
+
+# ------------------------------------------------- hash distribution quality
+def _chi2_stat(observed: np.ndarray, expected: float) -> float:
+    return float(((observed - expected) ** 2 / expected).sum())
+
+
+def test_stable_key_hash_uniform_over_shard_buckets():
+    """The router's chi-square pin: 20k realistic keys over 16 shard buckets
+    must look uniform at the 99.9% level (seeded, deterministic — a changed
+    hash that skews routing fails here before it skews a fleet)."""
+    num_shards = 16
+    keys = [f"user-{i}" for i in range(10_000)] + list(range(10_000))
+    counts = np.zeros(num_shards, dtype=np.int64)
+    for key in keys:
+        counts[stable_key_hash(key) % num_shards] += 1
+    critical = stats.chi2.ppf(0.999, df=num_shards - 1)
+    assert _chi2_stat(counts, len(keys) / num_shards) < critical
+
+
+def test_cms_row_hashes_uniform_per_row_and_rows_disagree():
+    """The sketch family's chi-square pin: every row's bucket distribution
+    over 20k keys is uniform at the 99.9% level, and distinct rows assign
+    different buckets (pairwise-independent-style disagreement — identical
+    rows would collapse depth to 1 and void the ``1 - e^-depth`` bound)."""
+    depth, width = 4, 64
+    hashes = stable_key_hashes([f"tenant/{i}" for i in range(20_000)])
+    buckets = cms_buckets(hashes, depth, width, seed=29)
+    assert buckets.shape == (20_000, depth)
+    critical = stats.chi2.ppf(0.999, df=width - 1)
+    for d in range(depth):
+        counts = np.bincount(buckets[:, d], minlength=width)
+        assert _chi2_stat(counts, len(hashes) / width) < critical, f"row {d} skewed"
+    # rows must disagree on almost all keys (P[collide] ~ 1/width per pair)
+    for a in range(depth):
+        for b in range(a + 1, depth):
+            agree = float((buckets[:, a] == buckets[:, b]).mean())
+            assert agree < 0.05, (a, b, agree)
+
+
+def test_buckets_deterministic_in_seed_and_reexported_hash():
+    hashes = stable_key_hashes(["a", b"a", 1, "1"])
+    assert len(set(hashes.tolist())) == 4  # type-tagged: no cross-type collisions
+    assert served_hash("a") == stable_key_hash("a")  # one hash of record
+    b1 = cms_buckets(hashes, 4, 128, seed=7)
+    b2 = cms_buckets(hashes, 4, 128, seed=7)
+    b3 = cms_buckets(hashes, 4, 128, seed=8)
+    np.testing.assert_array_equal(b1, b2)
+    assert (b1 != b3).any()
+    with pytest.raises(TypeError):
+        stable_key_hash(1.5)
+
+
+# ------------------------------------------------------------ sketch algebra
+def test_scatter_query_exact_without_collisions_and_overcount_with():
+    spec = CMSSpec(4, 64, (), np.int32, seed=3)
+    sketch = cms_init(spec)
+    assert is_cms(sketch) and is_sketch(sketch)
+    keys = ["a", "b", "c"]
+    true = {"a": 5, "b": 2, "c": 9}
+    buckets = cms_buckets(stable_key_hashes(keys), 4, 64, 3)
+    per_key = jnp.asarray(buckets)
+    for i, key in enumerate(keys):
+        deltas = jnp.ones((true[key],), jnp.int32)
+        reps = jnp.broadcast_to(per_key[i][None], (true[key], 4))
+        sketch = CountMinSketch(cms_scatter(sketch.counts, reps, deltas))
+    assert int(cms_total(sketch.counts)) == 16
+    for i, key in enumerate(keys):
+        est = int(jnp.min(cms_row_state(sketch.counts, per_key[i])))
+        assert est >= true[key]  # NEVER an undercount
+    # with width 64 and 3 keys, collisions are absent for this seed: exact
+    for i, key in enumerate(keys):
+        assert int(jnp.min(cms_row_state(sketch.counts, per_key[i]))) == true[key]
+    bound = float(cms_error_bound(sketch.counts))
+    assert bound == pytest.approx(np.e / 64 * 16)
+
+
+def test_scatter_drops_sentinel_buckets():
+    """The hot-tier sentinel (bucket == width) must be DROPPED, mirroring the
+    slab scatter's out-of-range contract — never wrapped or clipped."""
+    sketch = cms_init(CMSSpec(2, 8, (), np.int32, seed=0))
+    buckets = jnp.asarray(np.array([[8, 8], [1, 2]], dtype=np.int32))
+    out = cms_scatter(sketch.counts, buckets, jnp.ones((2,), jnp.int32))
+    assert int(out.sum()) == 2  # only the in-range sample landed (both rows)
+    assert int(out[0, 1]) == 1 and int(out[1, 2]) == 1
+
+
+def test_merge_is_bitexact_addition_and_item_cells():
+    spec = CMSSpec(3, 16, (2,), np.int32, seed=5)
+    a, b = cms_init(spec), cms_init(spec)
+    buckets = jnp.asarray(cms_buckets(stable_key_hashes(["x", "y"]), 3, 16, 5))
+    da = jnp.asarray(np.array([[1, 2], [3, 4]], np.int32))
+    db = jnp.asarray(np.array([[5, 6], [7, 8]], np.int32))
+    a = CountMinSketch(cms_scatter(a.counts, buckets, da))
+    b = CountMinSketch(cms_scatter(b.counts, buckets, db))
+    merged = cms_merge(a, b)
+    both = CountMinSketch(cms_scatter(cms_scatter(cms_init(spec).counts, buckets, da), buckets, db))
+    np.testing.assert_array_equal(np.asarray(merged.counts), np.asarray(both.counts))
+    assert cms_nbytes(merged) == 3 * 16 * 2 * 4
+
+
+def test_make_cms_spec_forms_and_validation():
+    assert make_cms_spec(CMSTail(2, 32, 1), (), np.int32).shape == (2, 32)
+    assert make_cms_spec((3, 64), (5,), np.int32).shape == (3, 64, 5)
+    assert make_cms_spec(128, (), np.int32).width == 128
+    with pytest.raises(ValueError):
+        make_cms_spec((0, 64), (), np.int32)
+    with pytest.raises(ValueError):
+        make_cms_spec("wide", (), np.int32)
+    with pytest.raises(ValueError):
+        CMSTail(width=1).validate()
+
+
+# ------------------------------------------------------- state-kind plumbing
+class _CMSMetric(Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("tail", default=CMSSpec(2, 16, (), np.int32, seed=1),
+                       dist_reduce_fx="sum", persistent=True)
+
+    def update(self, buckets, values):
+        self.tail = CountMinSketch(cms_scatter(self.tail.counts, buckets, values))
+
+    def compute(self):
+        return cms_total(self.tail.counts)
+
+
+def test_add_state_materializes_and_requires_sum():
+    metric = _CMSMetric()
+    assert is_cms(metric.tail) and metric.tail.counts.shape == (2, 16)
+    assert is_mergeable("sum", metric._defaults["tail"])
+    assert is_stack_mergeable("sum", metric._defaults["tail"])
+
+    class Bad(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("t", default=CMSSpec(2, 16, (), np.int32, seed=1),
+                           dist_reduce_fx="max")
+
+        def update(self):
+            pass
+
+        def compute(self):
+            return 0
+
+    with pytest.raises(ValueError, match="sum-mergeable"):
+        Bad()
+
+
+def test_checkpoint_roundtrip_and_reset():
+    metric = _CMSMetric()
+    buckets = jnp.asarray(cms_buckets(stable_key_hashes(["k"]), 2, 16, 1))
+    metric.update(jnp.broadcast_to(buckets, (3, 2)), jnp.ones((3,), jnp.int32))
+    assert int(metric.compute()) == 3
+    state = metric.state_dict()
+    assert set(state["tail"]) == {"sketch_counts"}  # the counts-entry family
+    fresh = _CMSMetric()
+    fresh.load_state_dict(state)
+    assert is_cms(fresh.tail)
+    np.testing.assert_array_equal(np.asarray(fresh.tail.counts), np.asarray(metric.tail.counts))
+    fresh.reset()
+    assert int(jnp.sum(fresh.tail.counts)) == 0
+
+
+def test_fingerprint_includes_seed():
+    from metrics_tpu.core.metric import _fingerprint_value
+
+    a = _fingerprint_value(CMSSpec(2, 16, (), np.int32, seed=1), [])
+    b = _fingerprint_value(CMSSpec(2, 16, (), np.int32, seed=2), [])
+    assert a != b and a[0] == "cmsspec"

@@ -359,3 +359,55 @@ def test_fleet_requires_tenant_key_types():
         with pytest.raises(TypeError, match="str, bytes or int"):
             fleet.submit(3.14, jnp.asarray(np.float32([0.5])),
                          jnp.asarray(np.int32([1])), event_time=np.array([1.0]))
+
+
+# ------------------------------------------------------- heavy-hitter fleet
+def test_heavy_hitter_fleet_routes_and_merges_global_topk():
+    """``HeavyHitterFleet`` serves an UNBOUNDED key space with no pre-sized
+    slot table: keys partition by the stable router (disjoint per-shard hot
+    sets), per-shard state is constant in the live-key count, and the global
+    top-K is the pure merge of per-shard records — counts exact for hot keys
+    with no tail residue."""
+    from metrics_tpu import HeavyHitterFleet, HeavyHitters
+
+    fleet = HeavyHitterFleet(
+        lambda: HeavyHitters(Accuracy(), num_hot_slots=8, tail=(4, 512)),
+        num_shards=4,
+    )
+    rng = np.random.RandomState(5)
+    true_counts: dict = {}
+    for _ in range(20):
+        keys = [int(k) for k in rng.zipf(1.4, 32) % 10_000]
+        preds = jnp.asarray(rng.rand(32).astype(np.float32))
+        target = jnp.asarray(rng.randint(0, 2, 32).astype(np.int32))
+        fleet.submit(keys, preds, target)
+        for k in keys:
+            true_counts[k] = true_counts.get(k, 0) + 1
+    records = fleet.compute_heavy_hitters(k=5)
+    assert len(records) == 5
+    counts = [r["count"] for r in records]
+    assert counts == sorted(counts, reverse=True)
+    for record in records:
+        assert record["shard"] == fleet.shard_of(record["key"])
+        if record["exact"]:
+            assert record["count"] == true_counts[record["key"]]
+    # every key reads from its home shard, certified when tail-resident
+    tail_key = next(k for k in true_counts if all(k not in s._table for s in fleet.shards))
+    home = fleet.shards[fleet.shard_of(tail_key)]
+    est = home.tail_estimate(tail_key)
+    assert true_counts[tail_key] <= est["count"] <= true_counts[tail_key] + est["bound"]
+    assert fleet.tail_overcount_bound() >= est["bound"] - 1e-9
+    assert fleet.tail_mass() == sum(s.tail_mass() for s in fleet.shards)
+    value = fleet.compute(tail_key)
+    np.testing.assert_array_equal(np.asarray(value), np.asarray(est["value"]))
+
+
+def test_heavy_hitter_fleet_validation():
+    from metrics_tpu import HeavyHitterFleet, HeavyHitters
+
+    with pytest.raises(ValueError, match="zero-arg callable"):
+        HeavyHitterFleet("nope", 2)
+    with pytest.raises(ValueError, match="num_shards"):
+        HeavyHitterFleet(lambda: HeavyHitters(Accuracy(), 2), 0)
+    with pytest.raises(ValueError, match="HeavyHitters"):
+        HeavyHitterFleet(lambda: Accuracy(), 2)

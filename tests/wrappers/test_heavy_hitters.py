@@ -1,0 +1,441 @@
+"""HeavyHitters: open-world two-tier correctness matrix.
+
+The contract under test (wrappers/heavy_hitters.py + parallel/cms.py):
+
+- hot keys (admitted with no tail residue) are BIT-EXACT vs independent
+  clones of the inner metric — sum/mean array states and sketch states;
+- the tail NEVER undercounts, every tail query on the seeded Zipfian stream
+  lies within the reported ``(e/width) * N`` certificate, and promotion/
+  demotion round-trips are MASS-CONSERVING: hot + tail totals stay bit-exact
+  the whole stream's (the property ``Keyed``'s LRU eviction destroys);
+- ``compute(key=)`` reads either tier, ``compute_heavy_hitters()`` ranks by
+  the space-saving count with honest ``exact`` flags;
+- checkpoints round-trip (slabs + tails + the space-saving table + mirror);
+- on a real (4,2) mesh the hierarchical synced compute equals the single
+  process with a PSUM-ONLY staged program identical to the unkeyed metric's;
+- the rejection matrix is loud (min/max, cat/buffer, missing key, tracing).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+import metrics_tpu.observability as obs
+from metrics_tpu import AUROC, Accuracy, HeavyHitters, Keyed
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.parallel.cms import CMSTail
+from metrics_tpu.parallel.placement import MeshHierarchy
+from metrics_tpu.utils import compat
+from metrics_tpu.utils.exceptions import TracingUnsupportedError
+from metrics_tpu.wrappers.heavy_hitters import SpaceSavingTable
+
+
+class _Sum(Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", default=np.zeros((), np.float32), dist_reduce_fx="sum")
+
+    def update(self, values):
+        self.total = self.total + jnp.sum(values)
+
+    def compute(self):
+        return self.total
+
+
+class _Mean(Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("value", default=np.zeros((), np.float32), dist_reduce_fx="mean")
+
+    def update(self, values):
+        self.value = self.value + jnp.sum(values)  # sum-backed under the wrapper
+
+    def compute(self):
+        return self.value
+
+
+class _Max(Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("high", default=np.asarray(-np.inf, np.float32), dist_reduce_fx="max")
+
+    def update(self, values):
+        self.high = jnp.maximum(self.high, jnp.max(values))
+
+    def compute(self):
+        return self.high
+
+
+# --------------------------------------------------------------- hot parity
+def test_hot_keys_bit_exact_vs_clones():
+    hh = HeavyHitters(Accuracy(), num_hot_slots=3, tail=(4, 64))
+    clones = {k: Accuracy() for k in ("a", "b", "c")}
+    rng = np.random.RandomState(0)
+    for _ in range(4):
+        preds = jnp.asarray(rng.rand(9).astype(np.float32))
+        target = jnp.asarray(rng.randint(0, 2, 9).astype(np.int32))
+        keys = ["a", "b", "c"] * 3
+        hh.update(preds, target, key=keys)
+        for key, clone in clones.items():
+            idx = np.asarray([i for i, k in enumerate(keys) if k == key])
+            clone.update(preds[idx], target[idx])
+    for record in hh.compute_heavy_hitters():
+        assert record["exact"] is True
+        np.testing.assert_array_equal(
+            np.asarray(record["value"]), np.asarray(clones[record["key"]].compute())
+        )
+        np.testing.assert_array_equal(
+            np.asarray(hh.compute(key=record["key"])), np.asarray(record["value"])
+        )
+
+
+def test_mean_kind_divides_by_per_key_count_in_both_tiers():
+    hh = HeavyHitters(_Mean(), num_hot_slots=1, tail=(4, 64))
+    hh.update(jnp.asarray([2.0, 4.0, 6.0]), key=["hot", "hot", "hot"])
+    hh.update(jnp.asarray([10.0]), key=["hot"])
+    assert float(hh.compute(key="hot")) == pytest.approx(22.0 / 4)
+    hh.update(jnp.asarray([3.0, 5.0]), key=["tail-key", "tail-key"])
+    est = hh.tail_estimate("tail-key")
+    assert est["count"] == 2
+    assert float(est["value"]) == pytest.approx(4.0)
+
+
+def test_sketch_inner_hot_parity_bit_exact():
+    hh = HeavyHitters(AUROC(approx="sketch", num_bins=32), num_hot_slots=2, tail=(4, 64))
+    clones = {k: AUROC(approx="sketch", num_bins=32) for k in ("x", "y")}
+    rng = np.random.RandomState(1)
+    for _ in range(3):
+        preds = jnp.asarray(rng.rand(8).astype(np.float32))
+        target = jnp.asarray(rng.randint(0, 2, 8).astype(np.int32))
+        keys = ["x", "y"] * 4
+        hh.update(preds, target, key=keys)
+        for key, clone in clones.items():
+            idx = np.asarray([i for i, k in enumerate(keys) if k == key])
+            clone.update(preds[idx], target[idx])
+    for record in hh.compute_heavy_hitters():
+        np.testing.assert_array_equal(
+            np.asarray(record["value"]), np.asarray(clones[record["key"]].compute())
+        )
+
+
+# --------------------------------------------- promotion / demotion / mass
+def _zipf_stream(batches=30, batch=32, space=5_000, seed=7):
+    rng = np.random.RandomState(seed)
+    for _ in range(batches):
+        keys = [int(k) for k in rng.zipf(1.5, batch) % space]
+        preds = jnp.asarray(rng.rand(batch).astype(np.float32))
+        target = jnp.asarray(rng.randint(0, 2, batch).astype(np.int32))
+        yield keys, preds, target
+
+
+def test_mass_conservation_bit_exact_through_churn():
+    """Hot + tail totals equal an unkeyed oracle's state bit-exactly after
+    heavy promotion/demotion churn — demotion FOLDS, never destroys."""
+    hh = HeavyHitters(Accuracy(), num_hot_slots=8, tail=(4, 256))
+    oracle = Accuracy()
+    total = 0
+    for keys, preds, target in _zipf_stream():
+        hh.update(preds, target, key=keys)
+        oracle.update(preds, target)
+        total += len(keys)
+    assert hh._table.demotions > 0  # the stream actually churned
+    hot_rows = int(np.asarray(hh.hh_rows).sum())
+    assert hot_rows + hh.tail_mass() == total
+    for name in ("correct", "total"):
+        hot = int(np.asarray(getattr(hh, name)).sum())
+        # every tail row carries the full tail mass: row 0's total IS it
+        tail = int(np.asarray(getattr(hh, name + "_tail").counts[0]).sum())
+        assert hot + tail == int(np.asarray(getattr(oracle, name))), name
+
+
+def test_tail_never_undercounts_and_respects_certificate():
+    hh = HeavyHitters(Accuracy(), num_hot_slots=8, tail=(4, 1024))
+    true_counts: dict = {}
+    for keys, preds, target in _zipf_stream(space=2_000):
+        hh.update(preds, target, key=keys)
+        for k in keys:
+            true_counts[k] = true_counts.get(k, 0) + 1
+    bound = hh.tail_overcount_bound()
+    assert bound > 0
+    checked = 0
+    for key, true in true_counts.items():
+        if key in hh._table:
+            continue  # hot keys read the exact tier
+        est = hh.tail_estimate(key)
+        assert est["count"] >= true, key  # never an undercount
+        assert est["count"] - true <= bound, key  # within the certificate
+        assert est["bound"] == pytest.approx(bound)
+        checked += 1
+    assert checked > 50  # the stream actually exercised the tail
+
+
+def test_promotion_takes_coldest_slot_and_flags_residue():
+    hh = HeavyHitters(_Sum(), num_hot_slots=2, tail=(4, 64))
+    hh.update(jnp.asarray([1.0] * 5 + [1.0] * 2), key=["a"] * 5 + ["b"] * 2)
+    # "c" arrives heavier than b's count: promotes into b's slot
+    hh.update(jnp.asarray([1.0] * 4), key=["c"] * 4)
+    keys = {r["key"]: r for r in hh.compute_heavy_hitters()}
+    assert set(keys) == {"a", "c"}
+    assert keys["a"]["exact"] is True and keys["a"]["count"] == 5
+    assert keys["c"]["count"] == 4
+    # b's 2 samples were folded, not destroyed: its tail estimate covers them
+    est = hh.tail_estimate("b")
+    assert est["count"] >= 2
+    assert float(est["value"]) >= 2.0  # the folded sum came along
+    # a cold repeat of "b" stays in the tail (2+1 <= a's count), no demotion of a
+    hh.update(jnp.asarray([1.0]), key=["b"])
+    assert "b" not in hh._table
+    assert keys["a"]["count"] == 5
+
+
+def test_heavy_hitters_ranking_and_k_limit():
+    hh = HeavyHitters(_Sum(), num_hot_slots=4, tail=(2, 32))
+    hh.update(jnp.ones((6,), jnp.float32), key=["a", "a", "a", "b", "b", "c"])
+    records = hh.compute_heavy_hitters()
+    assert [r["key"] for r in records] == ["a", "b", "c"]
+    assert [r["count"] for r in records] == [3, 2, 1]
+    assert [r["key"] for r in hh.compute_heavy_hitters(k=2)] == ["a", "b"]
+
+
+# --------------------------------------------------------------- tier reads
+def test_empty_policies_and_unknown_key():
+    hh = HeavyHitters(Accuracy(), num_hot_slots=2, tail=(2, 32))
+    values = hh.compute()
+    assert np.isnan(np.asarray(values)).all()
+    est = hh.tail_estimate("never-seen")
+    assert est["count"] == 0 and np.isnan(np.asarray(est["value"])).all()
+    zero = HeavyHitters(Accuracy(), num_hot_slots=2, tail=(2, 32), empty="zero")
+    assert float(zero.tail_estimate("never-seen")["value"]) == 0.0
+
+
+def test_compute_key_read_never_poisons_the_cache():
+    hh = HeavyHitters(_Sum(), num_hot_slots=2, tail=(2, 32))
+    hh.update(jnp.asarray([1.0, 2.0]), key=["a", "b"])
+    assert float(hh.compute(key="a")) == 1.0
+    full = np.asarray(hh.compute())
+    assert full.shape == (2,)
+    assert set(full.tolist()) == {1.0, 2.0}
+
+
+# ------------------------------------------------------------ rejections etc
+def test_rejections_are_loud():
+    with pytest.raises(ValueError, match="min/max"):
+        HeavyHitters(_Max(), num_hot_slots=2)
+    with pytest.raises(ValueError, match="cat/list/buffer"):
+        HeavyHitters(AUROC(), num_hot_slots=2)  # buffer-state inner
+    with pytest.raises(ValueError, match="tail"):
+        HeavyHitters(_Sum(), num_hot_slots=2, tail="wide")
+    with pytest.raises(ValueError, match="empty"):
+        HeavyHitters(_Sum(), num_hot_slots=2, empty="drop")
+    hh = HeavyHitters(_Sum(), num_hot_slots=2)
+    with pytest.raises(ValueError, match="key="):
+        hh.update(jnp.ones((2,)))
+    with pytest.raises(ValueError, match="data argument"):
+        hh.update(key=["a"])
+    with pytest.raises(KeyError):
+        hh._table.slot_of("missing")
+
+
+def test_rejects_jit_tracing():
+    hh = HeavyHitters(_Sum(), num_hot_slots=2)
+
+    def step(values):
+        hh.update(values, key=["a", "b"])
+        return values
+
+    with pytest.raises(TracingUnsupportedError):
+        jax.jit(step)(jnp.ones((2,), jnp.float32))
+
+
+# ----------------------------------------------------------------- lifecycle
+def test_checkpoint_roundtrip_with_table_and_mirror():
+    hh = HeavyHitters(Accuracy(), num_hot_slots=4, tail=(4, 128))
+    for keys, preds, target in _zipf_stream(batches=8, space=200):
+        hh.update(preds, target, key=keys)
+    state = hh.state_dict()
+    fresh = HeavyHitters(Accuracy(), num_hot_slots=4, tail=(4, 128))
+    fresh.load_state_dict(state)
+    assert fresh._table.keys() == hh._table.keys()
+    assert fresh._table.promotions == hh._table.promotions
+    np.testing.assert_array_equal(fresh._table._mirror, hh._table._mirror)
+    original = {r["key"]: np.asarray(r["value"]) for r in hh.compute_heavy_hitters()}
+    restored = {r["key"]: np.asarray(r["value"]) for r in fresh.compute_heavy_hitters()}
+    assert original.keys() == restored.keys()
+    for key in original:
+        np.testing.assert_array_equal(original[key], restored[key])
+    # a tail key reads identically through the restored mirror + tails
+    tail_keys = [k for k in range(200) if k not in hh._table]
+    assert tail_keys
+    before, after = hh.tail_estimate(tail_keys[0]), fresh.tail_estimate(tail_keys[0])
+    assert after["count"] == before["count"]
+    assert after["bound"] == pytest.approx(before["bound"])
+    np.testing.assert_array_equal(np.asarray(after["value"]), np.asarray(before["value"]))
+
+
+def test_reset_clears_tiers_and_table():
+    hh = HeavyHitters(_Sum(), num_hot_slots=2, tail=(2, 32))
+    hh.update(jnp.ones((3,), jnp.float32), key=["a", "b", "c"])
+    hh.reset()
+    assert len(hh._table) == 0
+    assert hh.tail_mass() == 0
+    assert int(np.asarray(hh.hh_rows).sum()) == 0
+    assert np.isnan(np.asarray(hh.compute())).all()
+
+
+def test_gauges_record_tiers():
+    obs.reset()
+    obs.enable()
+    try:
+        hh = HeavyHitters(_Sum(), num_hot_slots=2, tail=(2, 32))
+        hh.update(jnp.ones((4,), jnp.float32), key=["a", "b", "c", "c"])
+        snap = obs.counters_snapshot()
+    finally:
+        obs.disable()
+        obs.reset()
+    gauge = snap["heavy_hitters"]["HeavyHitters(_Sum)"]
+    assert gauge["hot_slots"] == 2 and gauge["hot_occupied"] == 2
+    assert gauge["promotions"] == 2
+    assert gauge["tail_mass"] == 2  # c's two samples
+    assert gauge["tail_bound"] == pytest.approx(np.e / 32 * 2)
+
+
+# --------------------------------------------------- mesh sync (flat + hier)
+@pytest.mark.parametrize("hierarchical", [False, True])
+def test_mesh_synced_compute_matches_single_process(eight_devices, hierarchical):
+    """The psum-only contract on a real mesh: per-device heavy-hitter states
+    synced through ``coalesced_sync_state`` equal the single process that saw
+    all the traffic, and the staged program stages ZERO gathers."""
+    from metrics_tpu.parallel.sync import coalesced_sync_state
+
+    rng = np.random.RandomState(3)
+    shards = []
+    single = HeavyHitters(Accuracy(), num_hot_slots=4, tail=(4, 128))
+    # identical heavy warm-up on every shard AND (x8) on the single process:
+    # keys 0..3 admit in the same order everywhere with counts no stream key
+    # can overtake, so key -> slot layouts stay row-aligned with zero churn
+    # (cross-device hot slabs merge soundly only under a shared layout; the
+    # tail cells are globally addressed and merge soundly regardless)
+    warm_keys = [k for k in range(4) for _ in range(40)]
+    warm_p = jnp.zeros((len(warm_keys),), jnp.float32)
+    warm_t = jnp.zeros((len(warm_keys),), jnp.int32)
+    all_preds, all_target, all_keys = [], [], []
+    for _ in range(8):
+        preds = rng.rand(16).astype(np.float32)
+        target = rng.randint(0, 2, 16).astype(np.int32)
+        keys = [int(k) for k in rng.randint(0, 8, 16)]  # 4..7 stay tail
+        shard = HeavyHitters(Accuracy(), num_hot_slots=4, tail=(4, 128))
+        shard.update(warm_p, warm_t, key=warm_keys)
+        shards.append(shard)
+        all_preds.append(preds)
+        all_target.append(target)
+        all_keys.extend(keys)
+        shard.update(jnp.asarray(preds), jnp.asarray(target), key=keys)
+        assert shard._table.demotions == 0  # layout stayed aligned
+    for _ in range(8):
+        single.update(warm_p, warm_t, key=warm_keys)
+    single.update(
+        jnp.asarray(np.concatenate(all_preds)),
+        jnp.asarray(np.concatenate(all_target)),
+        key=all_keys,
+    )
+    assert single._table.demotions == 0
+
+    if hierarchical:
+        mesh = Mesh(np.array(eight_devices).reshape(2, 4), ("dcn", "ici"))
+        axis = MeshHierarchy(ici_axis="ici", dcn_axis="dcn")
+    else:
+        mesh = Mesh(np.array(eight_devices), ("dp",))
+        axis = "dp"
+    reductions = shards[0]._reductions
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *[s._current_state() for s in shards])
+
+    def fn(state):
+        per = jax.tree_util.tree_map(lambda x: x[0], state)
+        return coalesced_sync_state(per, reductions, axis)
+
+    specs = jax.tree_util.tree_map(
+        lambda _: P(("dcn", "ici")) if hierarchical else P("dp"), stacked
+    )
+    synced = jax.jit(compat.shard_map(
+        fn, mesh=mesh, in_specs=(specs,),
+        out_specs=jax.tree_util.tree_map(lambda _: P(), stacked), check_vma=False,
+    ))(stacked)
+
+    obs.reset()
+    obs.enable()
+    try:
+        jax.jit(compat.shard_map(
+            fn, mesh=mesh, in_specs=(specs,),
+            out_specs=jax.tree_util.tree_map(lambda _: P(), stacked), check_vma=False,
+        )).lower(stacked)  # fresh trace under counting
+    finally:
+        snap = obs.counters_snapshot()
+        obs.disable()
+        obs.reset()
+    gathers = sum(
+        snap["calls_by_kind"].get(k, 0)
+        for k in ("all_gather", "coalesced_gather", "process_allgather")
+    )
+    assert gathers == 0 and snap["calls_by_kind"].get("psum", 0) >= 1
+
+    reader = shards[0]
+    reader._set_state(synced)
+    # hot-tier VALUES merge bit-exactly (the host table's counts stay
+    # shard-local bookkeeping, so only key sets and values are compared)
+    merged = {r["key"]: np.asarray(r["value"]) for r in reader.compute_heavy_hitters()}
+    expected = {r["key"]: np.asarray(r["value"]) for r in single.compute_heavy_hitters()}
+    assert set(merged) == set(expected) == {0, 1, 2, 3}
+    for key in expected:
+        np.testing.assert_array_equal(merged[key], expected[key])
+    # and the synced TAIL reads match the single process exactly: tail cells
+    # are globally addressed, psum of per-device sketches == one process
+    for key in (4, 5, 6, 7):
+        got = reader.tail_estimate(key)
+        want = single.tail_estimate(key)
+        assert got["count"] == want["count"]
+        np.testing.assert_array_equal(np.asarray(got["value"]), np.asarray(want["value"]))
+
+
+# ------------------------------------------------------- space-saving table
+def test_space_saving_table_unit():
+    table = SpaceSavingTable(2, depth=2, width=32, seed=1)
+    ids, demoted = table.resolve(["a", "a", "b"])
+    assert demoted == [] and len(set(ids.tolist())) == 2
+    assert table.count_of("a") == 2 and table.is_exact("a")
+    # c (1) does not beat b (1): tail-routed
+    ids, demoted = table.resolve(["c"])
+    assert ids.tolist() == [-1] and not demoted
+    assert table.tail_estimate("c") == 1 and table.tail_mass() == 1
+    # now c (1 tail + 2 batch = 3) beats b (1): demote b, admit c with credit
+    ids, demoted = table.resolve(["c", "c"])
+    assert len(demoted) == 1 and demoted[0][0] == "b"
+    assert "c" in table and not table.is_exact("c")  # carries tail residue
+    assert table.count_of("c") == 3  # credit 1 + 2 hot samples
+    assert table.tail_estimate("b") >= 1  # b's fold landed in the mirror
+    with pytest.raises(ValueError):
+        SpaceSavingTable(0, 2, 32, 1)
+    state = table.state()
+    fresh = SpaceSavingTable(2, depth=2, width=32, seed=1)
+    fresh.load_state(state)
+    assert fresh.keys() == table.keys() and fresh.count_of("c") == 3
+    table.reset()
+    assert len(table) == 0 and table.tail_mass() == 0
+    assert table.promotions > 0  # lifetime gauges survive reset
+
+
+def test_keyed_vs_heavy_hitters_is_the_point():
+    """The headline contrast: the same churny stream through Keyed(lru=True)
+    LOSES the evicted tenant's history (and now counts it), while
+    HeavyHitters conserves every sample."""
+    stream = list(_zipf_stream(batches=16, batch=6, space=400, seed=9))
+    total = sum(len(k) for k, _, _ in stream)
+    keyed = Keyed(Accuracy(), num_slots=6, lru=True)
+    hh = HeavyHitters(Accuracy(), num_hot_slots=6, tail=(4, 256))
+    for keys, preds, target in stream:
+        keyed.update(preds, target, slot=keys)
+        hh.update(preds, target, key=keys)
+    keyed_rows = int(np.asarray(getattr(keyed, "keyed_rows")).sum())
+    assert keyed_rows < total  # evictions zeroed history
+    assert int(np.asarray(hh.hh_rows).sum()) + hh.tail_mass() == total

@@ -512,3 +512,31 @@ def test_retrieval_family_still_groups_after_exclusion_refactor():
     # update-relevant config still splits: capacity changes the state schema
     split = MetricCollection([RetrievalPrecision(capacity=8), RetrievalRecall()])
     assert len(split.compute_groups) == 2
+
+
+def test_lru_eviction_counts_destroyed_mass_and_warns_once():
+    """The data-loss satellite: an eviction that zeroes a resident row must
+    bump ``evicted_mass_dropped`` by the row's sample count (recorded even
+    with observability OFF, like the fault counters) and warn ONCE naming
+    HeavyHitters as the lossless alternative."""
+    import warnings
+
+    from metrics_tpu.utils import prints
+
+    obs.reset()
+    prints._WARN_ONCE_SEEN.clear()
+    try:
+        keyed = Keyed(_Sum(), num_slots=2, lru=True)
+        keyed.update(jnp.asarray(np.float32([1.0, 2.0, 3.0])), slot=["a", "b", "b"])
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            keyed.update(jnp.asarray(np.float32([5.0])), slot=["c"])  # evicts a (1 sample)
+            keyed.update(jnp.asarray(np.float32([6.0])), slot=["d"])  # evicts b (2 samples)
+        snap = obs.counters_snapshot()
+        assert snap["evicted_mass_dropped"] == 3  # 1 (a) + 2 (b) samples destroyed
+        hh_warnings = [w for w in caught if "HeavyHitters" in str(w.message)]
+        assert len(hh_warnings) == 1  # deduped: once per process, not per eviction
+        assert "evicted_mass_dropped" in str(hh_warnings[0].message)
+    finally:
+        obs.reset()
+        prints._WARN_ONCE_SEEN.clear()

@@ -458,3 +458,29 @@ def test_mesh_synced_compute_matches_single_process(eight_devices, hierarchical)
     np.testing.assert_array_equal(
         np.asarray(synced["windowed_rows"]), np.asarray(single.windowed_rows)
     )
+
+
+def test_windowed_keyed_misrouted_slot_ids_are_counted():
+    """The drop-accounting satellite: out-of-range segment ids inside a
+    ``Windowed(Keyed)`` update are dropped by the INNER slab scatter (a
+    device-side non-event the eager Keyed path would have counted) — the
+    host-routed update must record them in ``slab_dropped_samples`` so fleet
+    shards surface misrouted-sample drops uniformly with too-late drops."""
+    from metrics_tpu import Keyed
+
+    obs.reset()
+    try:
+        wk = Windowed(Keyed(Accuracy(), num_slots=2), window_s=10.0, num_windows=2)
+        preds = jnp.asarray(np.float32([0.9, 0.8, 0.2]))
+        target = jnp.asarray(np.int32([1, 0, 0]))
+        wk.update(preds, target, event_time=np.array([1.0, 2.0, 3.0]),
+                  slot=jnp.asarray(np.int32([0, 5, -1])))  # 2 of 3 misrouted
+        snap = obs.counters_snapshot()
+        assert snap["slab_dropped_samples"] == 2
+        # the samples are gone from the inner slabs but window rows still
+        # counted the batch — the drop is only visible through the counter,
+        # which is exactly why it must be recorded
+        assert float(jnp.sum(wk.windowed_rows)) == 3.0
+        assert wk.dropped_samples == 0  # late-event accounting stays separate
+    finally:
+        obs.reset()
