@@ -57,6 +57,17 @@ output must be bit-exact vs a single-process oracle at shard counts
 the simulated per-batch serving work, and a seeded shard-kill chaos soak
 must recover with zero lost windows and no double-published merged window.
 
+``--check-watermark`` is the rank-coherent streaming gate: a windowed
+metric under a cross-rank ``WatermarkAgreement`` must stage the identical
+in-jit sync program as the unwindowed metric (the min-exchange is
+host-plane only), no window may publish before every participating rank's
+watermark passes it (one seeded +30s-skewed rank and one late-burst rank on
+the virtual mesh, merged values bit-exact vs a union-stream oracle), a
+rate=1.0 stalled rank must be excluded after the agreement deadline
+(``wm_stragglers`` > 0, publishes stamped degraded, no peer deadlock), and
+sliding windows (``slide_s < window_s``) must be bit-exact vs independent
+per-slot oracles.
+
 ``--trace OUT.json`` (composable with ``--smoke``) enables the observability
 subsystem around the A/B: the JSON line grows a ``phase_ms`` span-aggregate
 table, and OUT.json gets a Chrome-trace/Perfetto file of the bench phases
@@ -79,6 +90,7 @@ against them — phase-latency drift beyond pinned tolerances or ANY staged
 collective-count growth exits non-zero (``metrics_tpu.observability.regress``).
 """
 import json
+import math
 import os
 import subprocess
 import sys
@@ -169,6 +181,36 @@ FLEET_SCALING_MIN_X = 4.0  # the gate: 8-shard >= 4x 1-shard throughput
 FLEET_KILL_SHARDS = 4  # chaos soak topology
 FLEET_KILL_CALL = 4  # the killed shard's ingest call (past its first publish)
 FLEET_SOAK_BUDGET_S = 120.0
+# watermark-agreement scenario/gate (core/streaming.WatermarkAgreement +
+# bench.py --check-watermark): N virtual ranks of the mesh share one agreed
+# (global-min) clock; windows close, publish and recycle only when the
+# AGREED watermark passes. The ring is sized for the seeded +30s skew: the
+# skewed rank's local head runs (skew + window + lateness) / window_s = 5
+# windows ahead of the agreed close frontier, so W = 8 keeps every
+# agreement-open window resident (no expiry-forced early publish).
+WM_RANKS = 4
+WM_WINDOW_S = 10.0
+WM_WINDOWS = 8
+WM_LATENESS_S = 10.0
+WM_SKEW_S = 30.0  # the seeded skewed rank's clock shift (+3 windows)
+WM_SKEW_RANK = 1
+WM_LATE_RANK = 2
+WM_LATE_CALL = 3  # the late-burst batch on the late rank (its OWN call index)
+WM_LATE_SKEW_S = 8.0  # within lateness: routed late, never dropped
+WM_BATCHES = 12  # lockstep rounds (one batch per rank per round)
+WM_BATCH = 16
+WM_BUDGET_S = 60.0
+WM_STALL_DEADLINE_S = 0.75  # the stall tier's agreement deadline
+# sliding-window scenario: windows start every SLIDE_S seconds and span
+# SLIDE_WINDOW_S, so each event scatters into SLIDE_WINDOW_S/SLIDE_S = 3
+# overlapping ring slots; published windows are pinned bit-exact vs
+# independent per-slot oracles. Lateness cap: W*slide - window = 6s.
+SLIDE_WINDOW_S = 6.0
+SLIDE_S = 2.0
+SLIDE_WINDOWS = 6
+SLIDE_LATENESS_S = 4.0
+SLIDE_BATCHES = 10
+SLIDE_BATCH = 8
 
 
 def _collection_ours(compute_groups: bool = True):
@@ -616,7 +658,7 @@ def _bench_hh_ingest(key_space: int):
     return HH_INGEST_BATCHES / max(elapsed, 1e-9), hh
 
 
-def _build_windowed_sync_runner(windowed: bool = True):
+def _build_windowed_sync_runner(windowed: bool = True, with_agreement: bool = False):
     """(timed_run(steps) -> ms/step, states_synced) for the WINDOWED serving
     scenario: ``Windowed(AUROC(approx="sketch"), window_s, num_windows=4)``
     — tumbling windows as ring slots on the state's leading axis — synced
@@ -644,6 +686,14 @@ def _build_windowed_sync_runner(windowed: bool = True):
             inner, window_s=SERVICE_WINDOW_S, num_windows=SERVICE_WINDOWS,
             allowed_lateness_s=(SERVICE_WINDOWS - 1) * SERVICE_WINDOW_S,
         )
+        if with_agreement:
+            # the --check-watermark parity tier: a metric UNDER a watermark
+            # agreement must stage the identical in-jit sync program — the
+            # exchange is host-plane only, never a staged collective
+            from metrics_tpu import WatermarkAgreement
+
+            agreement = WatermarkAgreement(deadline_s=3600.0, label="bench/wm_parity")
+            metric.attach_agreement(agreement, rank=0)
     else:
         metric = inner
     rng = np.random.RandomState(0)
@@ -655,6 +705,12 @@ def _build_windowed_sync_runner(windowed: bool = True):
         # 4-slot ring, none late enough to drop
         times = rng.uniform(SERVICE_WINDOW_S, SERVICE_WINDOWS * SERVICE_WINDOW_S, rows)
         metric.update(preds, target, event_time=times)
+        if with_agreement:
+            # one exchange round rides the host plane before the staged
+            # capture: the counters prove it stages nothing
+            handle = metric.agreement.exchange()
+            if handle is not None:
+                handle.result(10.0)
     else:
         metric.update(preds, target)
 
@@ -830,6 +886,66 @@ def _bench_service_ingest(batches: int = SERVICE_INGEST_BATCHES) -> float:
         svc.flush()
         elapsed = time.perf_counter() - start
     return batches / max(elapsed, 1e-9)
+
+
+def _bench_watermark_scenario():
+    """The watermark-agreement numbers of the default line.
+
+    ``wm_agreement_ms``: one agreement round — both virtual ranks report
+    (through a real ``Windowed.update``) and one explicit min-exchange rides
+    the background host plane to resolution — averaged over the warmed loop.
+    ``wm_exchange_calls``: exchanges the loop dispatched (deterministic: one
+    explicit round per iteration; the cadence auto-dispatch is disabled so
+    the count is pure arithmetic). ``slide_windows_published``: sliding
+    windows published over the seeded sliding-service stream (pure routing
+    arithmetic — the same stream the ``--check-watermark`` sliding tier pins
+    bit-exact). ``wm_stragglers`` rides along from the process counter: both
+    ranks stay healthy, so the clean line pins it at zero.
+    """
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, WatermarkAgreement, Windowed
+    from metrics_tpu.observability import counters as _ctr
+
+    agreement = WatermarkAgreement(
+        deadline_s=3600.0, exchange_every_s=3600.0, label="bench/wm"
+    )
+    ranks = [
+        Windowed(
+            Accuracy(), window_s=WM_WINDOW_S, num_windows=WM_WINDOWS,
+            allowed_lateness_s=WM_LATENESS_S, agreement=agreement, rank=i,
+        )
+        for i in range(2)
+    ]
+    preds = jnp.asarray(np.array([0.9, 0.1], np.float32))
+    target = jnp.asarray(np.array([1, 0], np.int32))
+
+    def round_(r: int) -> None:
+        for i, metric in enumerate(ranks):
+            metric.update(preds, target, event_time=[r * 5.0 + i])
+        handle = agreement.exchange()
+        if handle is not None:
+            handle.result(10.0)
+
+    warm, rounds = 3, 20
+    for r in range(warm):
+        round_(r)
+    was_enabled = _ctr.is_enabled()
+    _ctr.enable()
+    before = _ctr.COUNTERS.wm_exchange_calls
+    try:
+        start = time.perf_counter()
+        for r in range(warm, warm + rounds):
+            round_(r)
+        wm_ms = (time.perf_counter() - start) / rounds * 1e3
+        exchange_calls = _ctr.COUNTERS.wm_exchange_calls - before
+    finally:
+        if not was_enabled:
+            _ctr.disable()
+
+    slide_pubs, _merged, slide_service = _drive_slide(_slide_stream())
+    del slide_service
+    return wm_ms, exchange_calls, len(slide_pubs), _ctr.COUNTERS.wm_stragglers
 
 
 def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trace_path=None) -> dict:
@@ -1030,6 +1146,15 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
         fleet_merged = len({r["window"] for r in fleet_run["records"]})
         fleet_lost = len(fleet_oracle["published"]) - fleet_merged
 
+    # the watermark-agreement plane: one report + min-exchange round through
+    # the background host plane (wm_agreement_ms / wm_exchange_calls), the
+    # seeded sliding-service publish count, and the straggler counter pinned
+    # zero on the clean line
+    with (obs.span("bench.watermark") if obs else _null_cm()):
+        wm_ms, wm_exchange_calls, slide_published, wm_stragglers = (
+            _bench_watermark_scenario()
+        )
+
     out = {
         "grouped_sync8_ms": grouped_ms,
         "ungrouped_sync8_ms": ungrouped_ms,
@@ -1143,6 +1268,13 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
         "fleet_shards_merged_windows": fleet_merged,
         "fleet_shards_published_windows": fleet_run["published"],
         "fleet_lost_windows": fleet_lost,
+        # the watermark-agreement plane: one agreement round's wall cost, the
+        # deterministic exchange count, the sliding-service publish count,
+        # and the straggler counter (zero on a healthy clean line)
+        "wm_agreement_ms": round(wm_ms, 4),
+        "wm_exchange_calls": wm_exchange_calls,
+        "wm_stragglers": wm_stragglers,
+        "slide_windows_published": slide_published,
         # slab drop evidence rides the default line pinned at ZERO (in-window
         # traffic never drops; the --check-service chaos soak pins nonzero)
         "slab_dropped_samples": service_counters.get("slab_dropped_samples", 0),
@@ -1166,6 +1298,10 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
             finally:
                 devtime_mod.disable()
 
+        # v11: the rank-coherent streaming plane joined (wm_agreement_ms /
+        # wm_exchange_calls / wm_stragglers — zero-pinned on the clean
+        # trajectory — and the sliding-window publish count on the default
+        # line, gated by --check-watermark);
         # v10: the heavy-hitter open-world plane joined (hh_* staged-count
         # keys pinned to the unkeyed twin, the 10k/1M ingest flatness pair,
         # and the tail's (e/width)*N certificate on the default line);
@@ -1181,7 +1317,7 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
         # block); v6 added the windowed serving A/B; v5 the keyed slab A/B;
         # v4 the sketch A/B; v3 moved the collective counts to the default
         # line and added the hierarchical A/B
-        out["trace_schema"] = 10
+        out["trace_schema"] = 11
         out["counters"] = grouped_counters
         out["gather_counters"] = coal_counters
         out["hier_counters"] = hier_counters
@@ -1550,6 +1686,10 @@ _TRACE_KEYS = (
     "fleet_shards_merged_windows",
     "fleet_shards_published_windows",
     "fleet_lost_windows",
+    "wm_agreement_ms",
+    "wm_exchange_calls",
+    "wm_stragglers",
+    "slide_windows_published",
     "slab_dropped_samples",
     "counters",
     "gather_counters",
@@ -3249,6 +3389,519 @@ def check_fleet() -> int:
     return 1 if failures else 0
 
 
+# --check-watermark pins the rank-coherent streaming contract (cross-rank
+# watermark agreement + skew-tolerant closing + sliding windows):
+#   parity   — a windowed metric UNDER a WatermarkAgreement stages the
+#              IDENTICAL in-jit sync program as the unwindowed metric (the
+#              exchange is host-plane only: zero staged collectives, zero
+#              gathers, pinned by counters)
+#   coherent — WM_RANKS rank services share one agreement; a seeded
+#              +WM_SKEW_S clock_skew on one rank and a late burst on
+#              another: NO window publishes before every participating
+#              rank's watermark passes it (checked each lockstep round
+#              against the reported local watermarks), the skewed rank's
+#              local clock provably ran ahead of the agreed frontier, and
+#              all published windows + merged views are BIT-EXACT vs a
+#              single-process oracle over the union stream (zero lost, zero
+#              double-published, zero drops — late-within-lateness events
+#              route, "late" means the same thing on every rank)
+#   stall    — one rank stalls at rate=1.0: closing proceeds once the
+#              agreement deadline excludes it (wm_stragglers > 0), the
+#              publishes stamp degraded=True, and no peer deadlocks
+#              (finalize completes inside the budget)
+#   sliding  — slide_s < window_s: every published sliding window is
+#              bit-exact vs an independent per-slot oracle over exactly the
+#              events its [w*slide, w*slide + window) span covers
+
+
+def _wm_rank_stream(seed: int = 0):
+    """The coherence soak's lockstep stream: WM_BATCHES rounds, one batch
+    per rank per round, event times advancing ~half a window per round with
+    jitter. Returns ``rounds[r][rank] = (times, preds, target)``."""
+    rng = np.random.RandomState(seed)
+    rounds = []
+    for r in range(WM_BATCHES):
+        per_rank = []
+        for _rank in range(WM_RANKS):
+            times = r * 5.0 + rng.uniform(0.0, 5.0, WM_BATCH)
+            preds = rng.rand(WM_BATCH).astype(np.float32)
+            target = (rng.rand(WM_BATCH) > 0.5).astype(np.int32)
+            per_rank.append((times, preds, target))
+        rounds.append(per_rank)
+    return rounds
+
+
+def _wm_shifts():
+    """Per-(round, rank) event-time shifts the chaos schedule applies — the
+    oracle reconstructs them because the schedule is call/rate pinned."""
+    shifts = {}
+    for r in range(WM_BATCHES):
+        shifts[(r, WM_SKEW_RANK)] = WM_SKEW_S  # rate=1.0: every batch
+    shifts[(WM_LATE_CALL, WM_LATE_RANK)] = -WM_LATE_SKEW_S
+    return shifts
+
+
+def _wm_oracle(rounds, shifts):
+    """Single-process oracle over the UNION of all ranks' (shifted) streams.
+
+    Under the AGREED clock the seeded stream is constructed to never drop:
+    the agreed (min-rank) watermark trails every rank's newest events, and
+    the late burst stays within the lateness of the minimum clock at its
+    round — so the oracle is pure membership, window ``w`` holding every
+    (shifted) event with ``floor(t / window_s) == w``. (This is exactly the
+    coherence claim: judged by the agreed clock, a skewed peer cannot make
+    an honest rank's in-time events "late". The LOCAL-clock replay of the
+    same stream drops hundreds of them — the contrast the gate exists for.)
+    """
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy
+
+    events, head = {}, None
+    for r, per_rank in enumerate(rounds):
+        for rank, (times, preds, target) in enumerate(per_rank):
+            t = np.asarray(times, dtype=np.float64) + shifts.get((r, rank), 0.0)
+            w = np.floor_divide(t, WM_WINDOW_S).astype(np.int64)
+            for j in range(t.size):
+                events.setdefault(int(w[j]), []).append((preds[j], target[j]))
+            hi = int(np.floor(float(t.max()) / WM_WINDOW_S))
+            head = hi if head is None else max(head, hi)
+    published = list(range(min(events), head + 1))
+
+    def value(windows):
+        pairs = [p for w in windows for p in events.get(w, [])]
+        if not pairs:
+            return np.asarray(np.nan, dtype=np.float32)
+        metric = Accuracy()
+        metric.update(
+            jnp.asarray(np.array([p for p, _ in pairs], dtype=np.float32)),
+            jnp.asarray(np.array([t for _, t in pairs], dtype=np.int32)),
+        )
+        return np.asarray(metric.compute())
+
+    return {
+        "published": published,
+        "values": {w: value([w]) for w in published},
+        "counts": {w: len(events.get(w, [])) for w in published},
+        "head": head,
+    }
+
+
+def _wm_build_ranks(n_ranks: int, deadline_s: float, guard):
+    """N rank MetricServices over one shared WatermarkAgreement (rank i is
+    fault-addressable via FaultSpec(rank=i)). Returns (agreement, services,
+    partials) where partials[window][rank] collects each rank's published
+    window partial for the merge check."""
+    import threading
+
+    from metrics_tpu import Accuracy, MetricService, WatermarkAgreement, Windowed
+    from metrics_tpu.parallel.sync import gather_all_arrays
+
+    agreement = WatermarkAgreement(deadline_s=deadline_s, label="gate/wm")
+    partials: dict = {}
+    lock = threading.Lock()
+    services = []
+    for rank in range(n_ranks):
+        metric = Windowed(
+            Accuracy(), window_s=WM_WINDOW_S, num_windows=WM_WINDOWS,
+            allowed_lateness_s=WM_LATENESS_S, dist_sync_fn=gather_all_arrays,
+            agreement=agreement, rank=rank,
+        )
+
+        def tap(record, partial, _rank=rank):
+            with lock:
+                partials.setdefault(int(record["window"]), {})[_rank] = partial
+
+        services.append(MetricService(
+            metric, queue_size=16, guard=guard, fault_rank=rank,
+            partial_publish_fn=tap,
+        ))
+    return agreement, services, partials
+
+
+def _wm_drive_coherent(failures):
+    """The coherence soak: lockstep rounds through the rank services under
+    the seeded skew + late-burst schedule, with the publish-ordering pin
+    checked against the reported local watermarks after every round."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.parallel import faults
+    from metrics_tpu.parallel.sync import SyncGuard
+
+    rounds = _wm_rank_stream()
+    shifts = _wm_shifts()
+    guard = SyncGuard(deadline_s=2.0, max_retries=1, backoff_s=0.02, policy="degrade")
+    schedule = [
+        faults.FaultSpec(kind="clock_skew", rank=WM_SKEW_RANK, rate=1.0,
+                         times=10**6, skew_s=WM_SKEW_S, site="service.ingest"),
+        faults.FaultSpec(kind="late_burst", rank=WM_LATE_RANK, call=WM_LATE_CALL,
+                         times=1, skew_s=WM_LATE_SKEW_S, site="service.ingest"),
+    ]
+    start = time.perf_counter()
+    skew_ran_ahead = False
+    with faults.ChaosInjector(schedule, seed=0) as injector:
+        agreement, services, partials = _wm_build_ranks(WM_RANKS, 3600.0, guard)
+        for r in range(WM_BATCHES):
+            for rank, (times, preds, target) in enumerate(rounds[r]):
+                services[rank].submit(
+                    jnp.asarray(preds), jnp.asarray(target), event_time=times, seq=r
+                )
+            for service in services:
+                service.flush(WM_BUDGET_S)
+            # the ordering pin: every window ANY rank has published so far
+            # must already be closed by EVERY rank's reported watermark —
+            # min local wm is monotone, so a premature publish (a window
+            # ahead of the agreed frontier, e.g. closed by the skewed
+            # rank's local clock alone) stays visible at this check
+            local_wms = [
+                wm for wm in agreement.local_watermarks().values() if wm is not None
+            ]
+            min_wm = min(local_wms) if len(local_wms) == WM_RANKS else None
+            for service in services:
+                for pub in service.publications:
+                    w = pub["window"]
+                    if min_wm is None or (
+                        (w + 1) * WM_WINDOW_S + WM_LATENESS_S > min_wm
+                    ):
+                        failures.append(
+                            f"coherent: round {r} rank {service.label} published"
+                            f" window {w} before every rank's watermark passed it"
+                            f" (min local wm {min_wm})"
+                        )
+            # structural evidence the agreement actually withheld something:
+            # the skewed rank's LOCAL clock closes windows its peers still
+            # feed; under agreement its publish frontier must trail it
+            skew_wm = agreement.local_watermarks().get(WM_SKEW_RANK)
+            if min_wm is not None and skew_wm is not None and skew_wm > min_wm:
+                local_closed = int(math.floor((skew_wm - WM_LATENESS_S) / WM_WINDOW_S)) - 1
+                agreed_closed = int(math.floor((min_wm - WM_LATENESS_S) / WM_WINDOW_S)) - 1
+                if local_closed > agreed_closed:
+                    published = [p["window"] for p in services[WM_SKEW_RANK].publications]
+                    if all(w <= agreed_closed for w in published):
+                        skew_ran_ahead = True
+        merged_views = {}
+        for rank, service in enumerate(services):
+            merged_views[rank] = np.asarray(service.finalize(WM_BUDGET_S))
+        for service in services:
+            service.stop(WM_BUDGET_S)
+        injected = dict(injector.injected)
+    if not skew_ran_ahead:
+        failures.append(
+            "coherent: the skewed rank's local clock never ran ahead of the agreed"
+            " frontier — the skew schedule lost its teeth"
+        )
+    return {
+        "services": services,
+        "partials": partials,
+        "merged_views": merged_views,
+        "injected": injected,
+        "elapsed_s": time.perf_counter() - start,
+        "shifts": shifts,
+        "rounds": rounds,
+    }
+
+
+def _wm_check_coherent(result, failures):
+    """Bit-exactness of the coherent soak vs the union-stream oracle: every
+    oracle window merged from the rank partials exactly once, per-window
+    sample counts conserved, zero drops, zero double publishes."""
+    from metrics_tpu import Accuracy, Windowed
+    from metrics_tpu.parallel.sync import gather_all_arrays
+
+    oracle = _wm_oracle(result["rounds"], result["shifts"])
+    template = Windowed(
+        Accuracy(), window_s=WM_WINDOW_S, num_windows=WM_WINDOWS,
+        allowed_lateness_s=WM_LATENESS_S, dist_sync_fn=gather_all_arrays,
+    )
+    partials = result["partials"]
+    merged_windows = sorted(partials)
+    if merged_windows != oracle["published"]:
+        failures.append(
+            f"coherent: published windows {merged_windows} != oracle"
+            f" {oracle['published']} (lost or phantom windows)"
+        )
+    for service in result["services"]:
+        windows = [p["window"] for p in service.publications]
+        if len(windows) != len(set(windows)):
+            failures.append(f"coherent: {service.label} double-published a window")
+        if service.metric.dropped_samples:
+            failures.append(
+                f"coherent: {service.label} dropped"
+                f" {service.metric.dropped_samples} events — under the agreed"
+                " clock the seeded stream never exceeds the lateness"
+            )
+    for w in oracle["published"]:
+        by_rank = partials.get(w, {})
+        got = np.asarray(template.value_from_partials(list(by_rank.values())))
+        expected = oracle["values"][w]
+        if not np.array_equal(got, expected, equal_nan=True):
+            failures.append(
+                f"coherent: window {w} merged value {got} != oracle {expected}"
+            )
+        rows = sum(float(np.asarray(p["rows"])) for p in by_rank.values())
+        if int(rows) != oracle["counts"][w]:
+            failures.append(
+                f"coherent: window {w} holds {int(rows)} samples across ranks,"
+                f" oracle routed {oracle['counts'][w]} (lost or double-counted)"
+            )
+    if result["elapsed_s"] > WM_BUDGET_S:
+        failures.append(
+            f"coherent: soak took {result['elapsed_s']:.1f}s > {WM_BUDGET_S}s budget"
+        )
+    if result["injected"].get("clock_skew", 0) < WM_BATCHES:
+        failures.append(
+            f"coherent: clock_skew fired {result['injected'].get('clock_skew', 0)}"
+            f" times, expected every one of rank {WM_SKEW_RANK}'s {WM_BATCHES} batches"
+        )
+    if result["injected"].get("late_burst", 0) != 1:
+        failures.append("coherent: the late burst never fired")
+    return oracle
+
+
+def _wm_drive_stall(failures):
+    """The stall tier: one rank stalls at rate=1.0, the agreement deadline
+    excludes it, closing proceeds degraded on the survivors — nothing
+    deadlocks."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.observability import counters as _ctr
+    from metrics_tpu.parallel import faults
+    from metrics_tpu.parallel.sync import SyncGuard
+
+    guard = SyncGuard(deadline_s=1.5, max_retries=1, backoff_s=0.02, policy="degrade")
+    stall_rank = 2
+    schedule = [
+        faults.FaultSpec(kind="ingest_stall", rank=stall_rank, rate=1.0,
+                         times=10**6, duration_s=2.5, site="service.ingest"),
+    ]
+    stragglers_before = _ctr.COUNTERS.wm_stragglers
+    start = time.perf_counter()
+    rng = np.random.RandomState(7)
+    with faults.ChaosInjector(schedule, seed=0):
+        agreement, services, _partials = _wm_build_ranks(3, WM_STALL_DEADLINE_S, guard)
+        # the stalled rank gets ONE batch (its worker then sleeps through the
+        # deadline holding its watermark still); the healthy ranks keep
+        # streaming past it
+        services[stall_rank].submit(
+            jnp.asarray(rng.rand(4).astype(np.float32)),
+            jnp.asarray((rng.rand(4) > 0.5).astype(np.int32)),
+            event_time=rng.uniform(0.0, 5.0, 4), seq=0,
+        )
+        for r in range(6):
+            for rank in (0, 1):
+                services[rank].submit(
+                    jnp.asarray(rng.rand(8).astype(np.float32)),
+                    jnp.asarray((rng.rand(8) > 0.5).astype(np.int32)),
+                    event_time=r * 10.0 + rng.uniform(0.0, 10.0, 8), seq=r,
+                )
+            for rank in (0, 1):
+                services[rank].flush(WM_BUDGET_S)
+            time.sleep(0.25)
+        for rank in (0, 1):
+            services[rank].finalize(WM_BUDGET_S)
+        published = {
+            rank: [(p["window"], p["degraded"]) for p in services[rank].publications]
+            for rank in (0, 1)
+        }
+        # the stalled worker drains its sleep before stop so teardown is clean
+        services[stall_rank].stop(WM_BUDGET_S)
+        for rank in (0, 1):
+            services[rank].stop(WM_BUDGET_S)
+    elapsed = time.perf_counter() - start
+    stragglers = _ctr.COUNTERS.wm_stragglers - stragglers_before
+    healthy_published = [w for rank in (0, 1) for (w, _d) in published[rank]]
+    degraded_published = [d for rank in (0, 1) for (_w, d) in published[rank]]
+    if stragglers < 1:
+        failures.append("stall: the stalled rank was never excluded (wm_stragglers == 0)")
+    if not healthy_published:
+        failures.append("stall: no window ever closed — the stalled rank wedged its peers")
+    if not any(degraded_published):
+        failures.append(
+            "stall: publishes made while a straggler was excluded never stamped"
+            " degraded=True"
+        )
+    if elapsed > WM_BUDGET_S:
+        failures.append(f"stall: tier took {elapsed:.1f}s > {WM_BUDGET_S}s budget (deadlock?)")
+    return {
+        "published": published,
+        "stragglers": stragglers,
+        "excluded": [repr(r) for r in agreement.excluded()],
+        "elapsed_s": elapsed,
+    }
+
+
+def _slide_stream(seed: int = 3):
+    """The sliding tier's seeded stream: event times advance one stride per
+    batch with jitter and ~15% within-lateness stragglers."""
+    rng = np.random.RandomState(seed)
+    batches = []
+    for i in range(SLIDE_BATCHES):
+        times = i * SLIDE_S + rng.uniform(0.0, SLIDE_S, SLIDE_BATCH)
+        late = rng.rand(SLIDE_BATCH) < 0.15
+        times = np.where(late, np.maximum(times - 3.0, 0.0), times)
+        preds = rng.rand(SLIDE_BATCH).astype(np.float32)
+        target = (rng.rand(SLIDE_BATCH) > 0.5).astype(np.int32)
+        batches.append((times, preds, target))
+    return batches
+
+
+def _drive_slide(batches, guard=None):
+    """Run the sliding stream through a real MetricService over
+    ``Windowed(slide_s=...)``; returns (publications, merged, service)."""
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, MetricService, Windowed
+    from metrics_tpu.parallel.sync import SyncGuard, gather_all_arrays
+
+    metric = Windowed(
+        Accuracy(), window_s=SLIDE_WINDOW_S, num_windows=SLIDE_WINDOWS,
+        allowed_lateness_s=SLIDE_LATENESS_S, slide_s=SLIDE_S,
+        dist_sync_fn=gather_all_arrays,
+    )
+    guard = guard or SyncGuard(deadline_s=2.0, max_retries=1, policy="degrade")
+    service = MetricService(metric, queue_size=16, guard=guard)
+    for i, (times, preds, target) in enumerate(batches):
+        service.submit(jnp.asarray(preds), jnp.asarray(target), event_time=times, seq=i)
+    merged = np.asarray(service.finalize(WM_BUDGET_S))
+    publications = list(service.publications)
+    service.stop(WM_BUDGET_S)
+    return publications, merged, service
+
+
+def _check_slide(publications, failures):
+    """Every published sliding window bit-exact vs an independent per-slot
+    oracle: a fresh unwindowed metric over exactly the events whose time
+    falls in the window's [w*slide, w*slide + window) span. Sound because
+    the seeded stream's stragglers stay within the lateness of every
+    covering window (no routing verdict depends on arrival order)."""
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy
+
+    batches = _slide_stream()
+    events = [
+        (t, p, y)
+        for times, preds, target in batches
+        for t, p, y in zip(np.asarray(times, np.float64), preds, target)
+    ]
+    by_window = {}
+    for w in {p["window"] for p in publications}:
+        lo = w * SLIDE_S
+        pairs = [(p, y) for (t, p, y) in events if lo <= t < lo + SLIDE_WINDOW_S]
+        by_window[w] = pairs
+    if len(publications) != len({p["window"] for p in publications}):
+        failures.append("sliding: a window was published more than once")
+    for pub in publications:
+        w = pub["window"]
+        pairs = by_window.get(w, [])
+        if not pairs:
+            failures.append(f"sliding: published window {w} covers no oracle events")
+            continue
+        metric = Accuracy()
+        metric.update(
+            jnp.asarray(np.array([p for p, _ in pairs], dtype=np.float32)),
+            jnp.asarray(np.array([y for _, y in pairs], dtype=np.int32)),
+        )
+        expected = np.asarray(metric.compute())
+        if not np.array_equal(pub["value"], expected, equal_nan=True):
+            failures.append(
+                f"sliding: window {w} value {pub['value']} != per-slot oracle"
+                f" {expected}"
+            )
+
+
+def check_watermark() -> int:
+    """``--check-watermark``: the rank-coherent streaming gate (see the
+    block comment above). Prints one JSON report line; non-zero exit on any
+    broken contract."""
+    from metrics_tpu import observability as obs
+
+    failures = []
+
+    # -- parity: agreement adds ZERO staged collectives --------------------
+    obs.enable()
+    parity = {}
+    for name, kwargs in (
+        ("agreed", dict(windowed=True, with_agreement=True)),
+        ("windowed", dict(windowed=True)),
+        ("unwindowed", dict(windowed=False)),
+    ):
+        run, _ = _build_windowed_sync_runner(**kwargs)
+        # the agreed build's exchange round lands during build (before the
+        # staged capture): read its count before resetting for the capture
+        exchanged = obs.counters_snapshot()["wm_exchange_calls"]
+        obs.COUNTERS.reset()
+        run(1)  # first call traces+compiles: counters hold the staged program
+        snap = obs.counters_snapshot()
+        parity[name] = {
+            "collective_calls": snap["collective_calls"],
+            "sync_bytes": snap["sync_bytes"],
+            "gather_calls": sum(
+                snap["calls_by_kind"].get(k, 0)
+                for k in ("all_gather", "coalesced_gather", "process_allgather")
+            ),
+            "wm_exchange_calls": exchanged + snap["wm_exchange_calls"],
+        }
+    obs.disable()
+    if parity["agreed"]["collective_calls"] != parity["unwindowed"]["collective_calls"]:
+        failures.append(
+            f"parity: the agreed metric staged {parity['agreed']['collective_calls']}"
+            f" collectives vs the unwindowed {parity['unwindowed']['collective_calls']}"
+            " — the watermark exchange must never enter the sync program"
+        )
+    if parity["agreed"]["gather_calls"] != 0:
+        failures.append(
+            f"parity: the agreed metric staged {parity['agreed']['gather_calls']}"
+            " gather collectives (the exchange must be host-plane only)"
+        )
+    if parity["agreed"]["wm_exchange_calls"] < 1:
+        failures.append("parity: the watermark exchange never actually ran")
+
+    # -- coherent: skew + late burst, publish ordering + bit-exactness ------
+    obs.reset()
+    coherent = _wm_drive_coherent(failures)
+    oracle = _wm_check_coherent(coherent, failures)
+
+    # -- stall: deadline exclusion unblocks closing, degraded, no deadlock --
+    stall = _wm_drive_stall(failures)
+
+    # -- sliding: bit-exact vs independent per-slot oracles -----------------
+    slide_pubs, _slide_merged, slide_service = _drive_slide(_slide_stream())
+    _check_slide(slide_pubs, failures)
+    if slide_service.metric.dropped_samples:
+        failures.append(
+            f"sliding: {slide_service.metric.dropped_samples} events dropped —"
+            " the seeded stragglers must stay within the lateness"
+        )
+
+    print(json.dumps({
+        "check": "watermark",
+        "ok": not failures,
+        "failures": failures,
+        "parity": parity,
+        "coherent": {
+            "published": oracle["published"],
+            "ranks": WM_RANKS,
+            "skew_s": WM_SKEW_S,
+            "injected": coherent["injected"],
+            "elapsed_s": round(coherent["elapsed_s"], 3),
+        },
+        "stall": {
+            "stragglers": stall["stragglers"],
+            "excluded": stall["excluded"],
+            "published": {str(k): v for k, v in stall["published"].items()},
+            "elapsed_s": round(stall["elapsed_s"], 3),
+            "budget_s": WM_BUDGET_S,
+        },
+        "sliding": {
+            "published": sorted(p["window"] for p in slide_pubs),
+            "windows_published": len(slide_pubs),
+            "overlap": int(round(SLIDE_WINDOW_S / SLIDE_S)),
+        },
+    }))
+    return 1 if failures else 0
+
+
 def main() -> None:
     trace_path = _trace_arg(sys.argv)
     if len(sys.argv) > 1 and sys.argv[1] == "--check-trajectory":
@@ -3282,6 +3935,16 @@ def main() -> None:
         # jax not yet imported, so the platform pin lands in-process
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         raise SystemExit(check_fleet())
+
+    if len(sys.argv) > 1 and sys.argv[1] == "--check-watermark":
+        # rank-coherent streaming gate: the soaks are host-plane, but the
+        # parity tier traces the (4,2) mesh — virtual devices needed (jax
+        # not yet imported, so the flag lands in-process)
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={N_DEVICES}"
+        ).strip()
+        raise SystemExit(check_watermark())
 
     if len(sys.argv) > 1 and sys.argv[1] == "--check-service":
         # serving-runtime gate: the soaks are host-plane, but the parity

@@ -130,4 +130,5 @@ from metrics_tpu.wrappers import (  # noqa: E402
     Windowed,
 )
 from metrics_tpu.serving import HeavyHitterFleet, MetricFleet, MetricService  # noqa: E402
+from metrics_tpu.core.streaming import WatermarkAgreement  # noqa: E402
 from metrics_tpu import functional  # noqa: E402

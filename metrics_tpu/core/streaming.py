@@ -21,7 +21,7 @@ Two things live here:
 
 Routing contract (what makes the windowed plane testable): for one batch,
 the watermark first advances to ``max(old watermark, max(event_time))``;
-an event is then accepted iff its WINDOW is still open — ``(window + 1) *
+an event is then accepted iff its WINDOW is still open — ``window_start +
 window_s + allowed_lateness_s > watermark`` (a window stays open for
 ``allowed_lateness_s`` past its end; head-window events are never late).
 Accepted events route to ``window % num_windows`` (the head window scatters
@@ -34,20 +34,53 @@ event stays within the allowed lateness of the stream maximum changes
 neither verdicts nor slot ids, and the scatter-adds commute: in-order and
 shuffled streams produce bit-exact window slabs
 (``tests/wrappers/test_windowed.py`` pins it).
+
+Two generalizations of that contract live here too:
+
+- **Sliding windows** (``WindowSpec(slide_s=...)`` with ``slide_s <
+  window_s``): windows start every ``slide_s`` seconds and span ``window_s``
+  — window ``w`` covers ``[w*slide_s, w*slide_s + window_s)`` — so each
+  event belongs to ``window_s / slide_s`` consecutive windows and
+  :func:`route_events` emits that many slot rows per batch (the newest
+  covering window in ``slot_ids``, the older coverings in
+  ``overlap_slots``). Each row is judged by the SAME open rule, so a
+  partially-late event still lands in every covering window that is open.
+  Tumbling windows are the ``slide_s == window_s`` special case (one row).
+- **The agreed clock** (``route_events(..., agreed=)``): on a multi-rank
+  stream each rank's local running max is only ITS view of event time — a
+  skewed producer can run 30 s ahead of honest peers. Passing the agreed
+  (global-min, :class:`WatermarkAgreement`) watermark makes the open/late
+  verdict a pure function of ``(window, agreed watermark)``: "late" means
+  the same thing on every rank, a fast rank cannot close a window its peers
+  still feed, and a slow rank's events are judged by the clock the fleet
+  actually agreed on. The LOCAL watermark still advances (it is the rank's
+  contribution to the next agreement round) and still drives ring-slot
+  residency — an event whose window is open by the agreed clock but whose
+  slot the local ring already recycled is dropped-and-counted, never
+  misrouted (size the ring for the tolerated skew).
 """
+import itertools
 import math
-from typing import Any, Callable, NamedTuple, Optional, Tuple
+import threading
+import time
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
 from metrics_tpu.core.metric import Metric
+from metrics_tpu.observability.counters import (
+    record_watermark_agreement,
+    record_wm_exchange,
+    record_wm_straggler,
+)
 from metrics_tpu.utils.data import accum_int_dtype
 
 __all__ = [
     "RouteResult",
     "SumCountMetric",
+    "WatermarkAgreement",
     "WindowSpec",
     "decay_scale",
     "route_events",
@@ -96,21 +129,42 @@ class SumCountMetric(Metric):
 
 # --------------------------------------------------- windowed serving plane
 class WindowSpec(NamedTuple):
-    """Tumbling-window layout of the windowed serving plane.
+    """Tumbling- or sliding-window layout of the windowed serving plane.
 
-    ``window_s`` seconds per window over a ring of ``num_windows`` slots
-    (window ``w`` covers ``[w*window_s, (w+1)*window_s)`` and lives in slot
-    ``w % num_windows``); ``allowed_lateness_s`` is how far behind the
-    watermark an event may arrive and still be routed to its (still-open)
-    window. Lateness is capped at ``(num_windows - 1) * window_s`` — beyond
-    that a within-lateness event's slot could already be recycled, which
-    would misroute it into a newer window (the one failure mode the plane
-    promises never happens).
+    ``window_s`` seconds per window over a ring of ``num_windows`` slots;
+    window ``w`` covers ``[w*stride, w*stride + window_s)`` and lives in slot
+    ``w % num_windows``, where the stride is ``slide_s`` when set (SLIDING
+    windows: a new window opens every ``slide_s`` seconds, each event covers
+    ``window_s / slide_s`` consecutive windows) and ``window_s`` otherwise
+    (tumbling: disjoint windows, one covering window per event).
+    ``allowed_lateness_s`` is how far behind the watermark an event may
+    arrive and still be routed to its (still-open) window. Lateness is
+    capped at ``num_windows * stride - window_s`` (for tumbling windows:
+    ``(num_windows - 1) * window_s``) — beyond that a within-lateness
+    event's slot could already be recycled, which would misroute it into a
+    newer window (the one failure mode the plane promises never happens).
     """
 
     window_s: float
     num_windows: int
     allowed_lateness_s: float = 0.0
+    slide_s: Optional[float] = None
+
+    @property
+    def stride(self) -> float:
+        """Seconds between consecutive window starts (= ``window_s`` for
+        tumbling windows)."""
+        return float(self.window_s if self.slide_s is None else self.slide_s)
+
+    @property
+    def overlap(self) -> int:
+        """How many consecutive windows cover one event
+        (``window_s / stride``; 1 for tumbling windows)."""
+        return int(round(float(self.window_s) / self.stride))
+
+    def window_start(self, window: int) -> float:
+        """Event-time start of window ``window`` (``window * stride``)."""
+        return window * self.stride
 
     def validate(self) -> "WindowSpec":
         if not (isinstance(self.window_s, (int, float)) and self.window_s > 0):
@@ -121,11 +175,31 @@ class WindowSpec(NamedTuple):
             raise ValueError(
                 f"`allowed_lateness_s` must be >= 0, got {self.allowed_lateness_s!r}"
             )
-        if self.allowed_lateness_s > (self.num_windows - 1) * self.window_s:
+        if self.slide_s is not None:
+            if not (isinstance(self.slide_s, (int, float)) and 0 < self.slide_s <= self.window_s):
+                raise ValueError(
+                    f"`slide_s` must be a positive number <= window_s ({self.window_s}),"
+                    f" got {self.slide_s!r}"
+                )
+            ratio = float(self.window_s) / float(self.slide_s)
+            if abs(ratio - round(ratio)) > 1e-9:
+                raise ValueError(
+                    f"`window_s` ({self.window_s}) must be an integer multiple of"
+                    f" `slide_s` ({self.slide_s}) so each event covers a whole number"
+                    " of windows"
+                )
+            if self.num_windows < self.overlap:
+                raise ValueError(
+                    f"num_windows={self.num_windows} is smaller than the overlap"
+                    f" factor window_s/slide_s={self.overlap}; one event's covering"
+                    " windows would collide in the ring"
+                )
+        horizon = self.num_windows * self.stride - float(self.window_s)
+        if self.allowed_lateness_s > horizon:
             raise ValueError(
                 f"allowed_lateness_s={self.allowed_lateness_s} exceeds the ring's"
-                f" still-open horizon ({self.num_windows - 1} x window_s ="
-                f" {(self.num_windows - 1) * self.window_s}s); a within-lateness event"
+                f" still-open horizon (num_windows x stride - window_s ="
+                f" {horizon}s); a within-lateness event"
                 " could land in a recycled slot. Raise num_windows or shrink the"
                 " lateness."
             )
@@ -141,16 +215,20 @@ def window_index(event_times: Any, window_s: float) -> np.ndarray:
 class RouteResult(NamedTuple):
     """One batch's routing verdict (see the module docstring contract).
 
-    ``slot_ids``: int32 per-sample slot, ``-1`` for dropped (too-late)
-    events — the slab scatter drops them by XLA out-of-bounds semantics.
-    ``watermark``/``head``: the advanced stream position AFTER this batch.
+    ``slot_ids``: int32 per-sample slot for the NEWEST covering window,
+    ``-1`` for dropped events — the slab scatter drops them by XLA
+    out-of-bounds semantics. ``watermark``/``head``: the advanced LOCAL
+    stream position AFTER this batch (the head is in stride units).
     ``opened``: window indices newly opened by this batch, oldest first —
     their ring slots hold expired windows and must be reset BEFORE the
-    scatter. ``n_dropped``/``n_late``: dropped vs accepted-but-late counts.
-    ``min_window``: the oldest window this batch accepted an event into
-    (``None`` if every event dropped) — the wrapper's stream-origin
-    bookkeeping, so windows before the first event are never reported as
-    resident.
+    scatter. ``n_dropped``/``n_late``: fully-dropped events (no covering
+    window accepted) vs accepted-but-late events. ``min_window``: the oldest
+    window this batch accepted an event into (``None`` if every event
+    dropped) — the wrapper's stream-origin bookkeeping, so windows before
+    the first event are never reported as resident. ``overlap_slots``: for
+    sliding windows, one additional int32 slot row per OLDER covering window
+    (``overlap - 1`` rows, each judged independently by the open rule);
+    empty for tumbling windows.
     """
 
     slot_ids: np.ndarray
@@ -160,6 +238,7 @@ class RouteResult(NamedTuple):
     n_dropped: int
     n_late: int
     min_window: Optional[int]
+    overlap_slots: Tuple[np.ndarray, ...] = ()
 
 
 def route_events(
@@ -167,14 +246,24 @@ def route_events(
     watermark: Optional[float],
     head: Optional[int],
     spec: WindowSpec,
+    agreed: Optional[float] = None,
 ) -> RouteResult:
     """Route one batch of event times through the advancing watermark.
 
-    ``watermark``/``head`` are the stream position before the batch
-    (``None`` on the very first batch). Pure host numpy — deterministic,
-    thread-free, and independently recomputable (the service gate's oracle
-    replays the same arithmetic from the raw stream).
+    ``watermark``/``head`` are the LOCAL stream position before the batch
+    (``None`` on the very first batch). ``agreed`` is the cross-rank agreed
+    (global-min) watermark when a :class:`WatermarkAgreement` governs the
+    stream: open/late verdicts are then judged against IT instead of the
+    local running max — "late" means the same thing on every rank, and a
+    rank whose local clock runs ahead cannot close a window its peers still
+    feed. The local watermark (and the ring head it implies) still advances
+    as before: it is this rank's contribution to the next agreement round,
+    and ring-slot residency must follow the events this rank actually holds.
+    Pure host numpy — deterministic, thread-free, and independently
+    recomputable (the service gates' oracles replay the same arithmetic from
+    the raw stream).
     """
+    stride = spec.stride
     t = np.asarray(event_times, dtype=np.float64).reshape(-1)
     if t.size == 0:
         return RouteResult(
@@ -189,26 +278,50 @@ def route_events(
     if not np.isfinite(t).all():
         raise ValueError("event_time must be finite (got NaN/inf timestamps)")
     new_wm = float(t.max()) if watermark is None else max(float(watermark), float(t.max()))
-    new_head = int(math.floor(new_wm / spec.window_s))
-    w = window_index(t, spec.window_s)
-    # an event is accepted iff its window is still open: a window stays open
-    # for allowed_lateness_s past its end, and the head window can never be
-    # late. The validated lateness cap makes an open window's slot resident
-    # by construction; keep the residency guard so a hand-built spec can
-    # never scatter into a recycled slot.
-    accepted = (w + 1) * spec.window_s + spec.allowed_lateness_s > new_wm
-    accepted &= w > new_head - spec.num_windows
+    # the judging clock: the agreed watermark when one governs the stream
+    # (verdicts are a pure function of (window, agreed)), the local running
+    # max otherwise
+    judge_wm = new_wm if agreed is None else float(agreed)
+    new_head = int(math.floor(new_wm / stride))
+    w = window_index(t, stride)  # the NEWEST window covering each event
+
+    def verdict(cover: np.ndarray) -> np.ndarray:
+        # a covering window is accepted iff it is still open — it stays open
+        # for allowed_lateness_s past its end, judged by the agreed clock
+        # when there is one — AND its ring slot is still resident. The
+        # validated lateness cap makes an open window's slot resident by
+        # construction on a single clock; with an agreed clock behind the
+        # local head, an open window can have fallen off the local ring —
+        # the residency guard then drops (and counts) instead of misrouting.
+        open_ = cover * stride + spec.window_s + spec.allowed_lateness_s > judge_wm
+        return open_ & (cover > new_head - spec.num_windows)
+
+    accepted = verdict(w)
     slot_ids = np.where(accepted, w % spec.num_windows, -1).astype(np.int32)
-    n_dropped = int((~accepted).sum())
+    any_accepted = accepted
+    min_w = w[accepted].min() if accepted.any() else None
+    overlap_rows = []
+    for j in range(1, spec.overlap):
+        cover = w - j
+        ok = verdict(cover)
+        overlap_rows.append(np.where(ok, cover % spec.num_windows, -1).astype(np.int32))
+        any_accepted = any_accepted | ok
+        if ok.any():
+            older = cover[ok].min()
+            min_w = older if min_w is None else min(min_w, older)
+    n_dropped = int((~any_accepted).sum())
     n_late = int((accepted & (w < new_head)).sum())
-    min_window = int(w[accepted].min()) if accepted.any() else None
+    min_window = None if min_w is None else int(min_w)
     if head is None or head < new_head - spec.num_windows:
         # first batch, or a jump past the whole ring: every slot the new
         # horizon can see starts fresh
         opened = tuple(range(new_head - spec.num_windows + 1, new_head + 1))
     else:
         opened = tuple(range(head + 1, new_head + 1))
-    return RouteResult(slot_ids, new_wm, new_head, opened, n_dropped, n_late, min_window)
+    return RouteResult(
+        slot_ids, new_wm, new_head, opened, n_dropped, n_late, min_window,
+        tuple(overlap_rows),
+    )
 
 
 def decay_scale(dt_s: Any, half_life_s: float) -> Any:
@@ -219,3 +332,285 @@ def decay_scale(dt_s: Any, half_life_s: float) -> Any:
     relative to the new watermark (``dt = watermark - event_time``).
     """
     return 0.5 ** (np.asarray(dt_s, dtype=np.float64) / float(half_life_s))
+
+
+# ------------------------------------------------ cross-rank watermark plane
+class WatermarkAgreement:
+    """Cross-rank low-watermark agreement: the Dataflow-style fix for skewed
+    and stalled event clocks on a multi-rank stream.
+
+    Each rank of a distributed stream reports its LOCAL running-max
+    watermark (:meth:`report`); the AGREED watermark (:meth:`agreed`) is the
+    minimum over every participating rank — so a window closes, publishes,
+    or recycles only once *every* rank's clock has passed it, and a skewed
+    rank can no longer close a window its peers still feed. The agreed value
+    is monotone non-decreasing by construction (a restored or lagging report
+    can never regress it).
+
+    **Transport.** Within one process the registry IS the agreement — every
+    ``report`` is a dict store, and ``agreed()`` is a min over the registry
+    (deterministic, lock-cheap). Across processes the registry's local min
+    rides the packed host plane: :meth:`exchange` dispatches ONE min-gather
+    of a single float64 through the deferred executor
+    (:func:`~metrics_tpu.parallel.deferred.deferred_host_gather` — the
+    submission-ordered background worker, so agreement overlaps ingest and
+    costs the step nothing), and the fold lands on the worker via the
+    gather's ``finish`` hook. The exchange is HOST-PLANE ONLY: it stages
+    zero in-jit collectives, which ``bench.py --check-watermark`` pins by
+    counters. Cadence: every ``exchange_every_s`` seconds of wall clock
+    (0 = every report), with at most one exchange in flight.
+
+    **Stragglers.** Agreement must never deadlock the fleet: a rank whose
+    watermark stops advancing for ``deadline_s`` wall-clock seconds is
+    EXCLUDED from the min (policy ``"degrade"``, the default) — the
+    process-wide ``wm_stragglers`` counter bumps once per exclusion episode,
+    :attr:`degraded` latches True so affected publishes can stamp
+    ``degraded=True``, and window closing proceeds on the surviving ranks'
+    clocks. A rank that reports an ADVANCING watermark again rejoins
+    automatically (its fresh value re-enters the min — which cannot regress
+    the agreed high-water). Policy ``"raise"`` throws
+    :class:`~metrics_tpu.utils.exceptions.SyncTimeoutError` from
+    ``agreed()`` instead, for callers that prefer failing loudly over
+    publishing degraded values.
+
+    Args:
+        deadline_s: how long a rank's watermark may stall before exclusion
+            (``None`` disables exclusion — a stalled rank then holds the
+            agreed clock forever; only safe when something else bounds it).
+        policy: ``"degrade"`` (exclude + count + latch) or ``"raise"``.
+        exchange_every_s: minimum wall-clock spacing between cross-process
+            exchange rounds (0 dispatches one per report, subject to the
+            single-in-flight guard).
+        guard: the :class:`~metrics_tpu.parallel.sync.SyncGuard` the
+            exchange gather runs under (default: the process-wide guard at
+            dispatch time). A dead/stalling exchange degrades to the local
+            registry's min — agreement never wedges on its own transport.
+        label: gauge label (``watermark_agreement`` in counters snapshots);
+            auto-indexed when omitted.
+    """
+
+    _ids = itertools.count()
+
+    def __init__(
+        self,
+        deadline_s: Optional[float] = 30.0,
+        policy: str = "degrade",
+        exchange_every_s: float = 0.0,
+        guard: Optional[Any] = None,
+        label: Optional[str] = None,
+    ) -> None:
+        if deadline_s is not None and not (
+            isinstance(deadline_s, (int, float)) and deadline_s > 0
+        ):
+            raise ValueError(f"`deadline_s` must be a positive number or None, got {deadline_s!r}")
+        if policy not in ("degrade", "raise"):
+            raise ValueError(f"`policy` must be 'degrade' or 'raise', got {policy!r}")
+        if not (isinstance(exchange_every_s, (int, float)) and exchange_every_s >= 0):
+            raise ValueError(f"`exchange_every_s` must be >= 0, got {exchange_every_s!r}")
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
+        self.policy = policy
+        self.exchange_every_s = float(exchange_every_s)
+        self.guard = guard
+        self.label = label or f"WatermarkAgreement#{next(WatermarkAgreement._ids)}"
+        self._lock = threading.RLock()
+        # rank -> {"wm": float|None, "stamp": monotonic seconds of last ADVANCE}
+        self._ranks: Dict[Any, Dict[str, Any]] = {}
+        self._excluded: set = set()
+        self._agreed: Optional[float] = None  # monotone high-water of the min
+        self._remote: Optional[float] = None  # last exchanged cross-process min
+        self._inflight: Optional[Any] = None  # at most one exchange in flight
+        self._last_exchange = time.monotonic()  # cadence counts from construction
+        self.stragglers = 0  # lifetime exclusion episodes
+        self.exchanges = 0  # lifetime exchange rounds dispatched
+
+    # ------------------------------------------------------------ reporting
+    def register(self, rank: Any) -> None:
+        """Declare a participant before its first report. A registered rank
+        with no watermark yet HOLDS the agreement open (``agreed()`` stays at
+        its last value) until it reports or stalls past the deadline — the
+        "window held open by a peer that has not spoken yet" case."""
+        with self._lock:
+            self._ranks.setdefault(
+                rank, {"wm": None, "stamp": time.monotonic()}
+            )
+
+    def report(self, rank: Any, watermark: float) -> None:
+        """Fold one rank's local running-max watermark into the registry
+        (monotone per rank: a lower report is a no-op, never a regression)
+        and dispatch an exchange round if the cadence is due."""
+        wm = float(watermark)
+        with self._lock:
+            entry = self._ranks.setdefault(rank, {"wm": None, "stamp": time.monotonic()})
+            if entry["wm"] is None or wm > entry["wm"]:
+                entry["wm"] = wm
+                entry["stamp"] = time.monotonic()
+        self._maybe_exchange()
+
+    def ranks(self) -> Tuple[Any, ...]:
+        with self._lock:
+            return tuple(self._ranks)
+
+    def local_watermarks(self) -> Dict[Any, Optional[float]]:
+        """Every participant's last reported local watermark (the gate's
+        publish-ordering assertions read this)."""
+        with self._lock:
+            return {rank: entry["wm"] for rank, entry in self._ranks.items()}
+
+    # ------------------------------------------------------------ agreement
+    def agreed(self) -> Optional[float]:
+        """The agreed (global-min) watermark: min over every included rank's
+        report, folded with the last cross-process exchange, monotone
+        non-decreasing. ``None`` until a first agreement forms (no rank has
+        reported yet, or a registered rank is still silent within its
+        deadline)."""
+        with self._lock:
+            candidate = self._included_min_locked()
+            if candidate is not None:
+                if self._remote is not None:
+                    candidate = min(candidate, self._remote)
+                if self._agreed is None or candidate > self._agreed:
+                    self._agreed = candidate
+            return self._agreed
+
+    def _included_min_locked(self) -> Optional[float]:
+        """Min over non-straggling ranks, running the exclusion scan (the
+        deadline judgment) as a side effect. ``None`` when no agreement can
+        form yet."""
+        now = time.monotonic()
+        values = []
+        pending = False
+        for rank, entry in self._ranks.items():
+            stale = (
+                self.deadline_s is not None
+                and now - entry["stamp"] > self.deadline_s
+            )
+            if stale:
+                if self.policy == "raise":
+                    from metrics_tpu.utils.exceptions import SyncTimeoutError
+
+                    raise SyncTimeoutError(
+                        f"watermark agreement {self.label!r}: rank {rank!r} stalled"
+                        f" past deadline_s={self.deadline_s} (policy='raise')"
+                    )
+                if rank not in self._excluded:
+                    self._excluded.add(rank)
+                    self.stragglers += 1
+                    record_wm_straggler()
+                    self._note_gauge_locked()
+                continue
+            if rank in self._excluded:
+                # a fresh advance within the deadline: the straggler rejoins
+                self._excluded.discard(rank)
+                self._note_gauge_locked()
+            if entry["wm"] is None:
+                pending = True
+                continue
+            values.append(entry["wm"])
+        if pending or not values:
+            return None
+        return min(values)
+
+    @property
+    def degraded(self) -> bool:
+        """True while any participant is excluded as a straggler — publishes
+        judged by a clock a rank no longer feeds should say so."""
+        with self._lock:
+            return bool(self._excluded)
+
+    def excluded(self) -> Tuple[Any, ...]:
+        """The currently-excluded (straggling) ranks."""
+        with self._lock:
+            return tuple(sorted(self._excluded, key=repr))
+
+    # -------------------------------------------------------------- exchange
+    def exchange(self) -> Optional[Any]:
+        """Dispatch one cross-process min-exchange round onto the background
+        host plane; returns the :class:`SyncHandle` (or ``None`` when a
+        round is already in flight or no local min exists yet). The fold
+        lands on the worker — nobody needs to fence the handle for the
+        agreement to advance."""
+        with self._lock:
+            if self._inflight is not None and not self._inflight.done():
+                return None
+            local_min = self._included_min_locked()
+            if local_min is None:
+                return None
+            self.exchanges += 1
+            self._last_exchange = time.monotonic()
+            self._note_gauge_locked()
+        from metrics_tpu.parallel.deferred import deferred_host_gather
+
+        record_wm_exchange()
+        handle = deferred_host_gather(
+            {"wm": np.asarray(local_min, dtype=np.float64)},
+            {"wm": "min"},
+            guard=self.guard,
+            label="wm_exchange",
+            finish=self._fold_exchange,
+        )
+        with self._lock:
+            self._inflight = handle
+        return handle
+
+    def _fold_exchange(self, result: Dict[str, Any]) -> Dict[str, Any]:
+        """The exchange's ``finish`` hook (runs on the host-plane worker):
+        fold the gathered cross-process min into the registry. On a single
+        process the gather is the identity and the fold is skipped — the
+        registry already IS the world, and folding a stale echo of our own
+        min would only lag the (deterministic) agreed clock."""
+        import jax
+
+        if jax.process_count() > 1:
+            with self._lock:
+                self._remote = float(np.asarray(result["wm"]))
+        return result
+
+    def _maybe_exchange(self) -> None:
+        if self.exchange_every_s > 0:
+            with self._lock:
+                if time.monotonic() - self._last_exchange < self.exchange_every_s:
+                    return
+        self.exchange()
+
+    def drain(self, timeout_s: float = 30.0) -> None:
+        """Bounded barrier over the in-flight exchange (shutdown must never
+        hang on a dead exchange: a failed resolve degrades to the local
+        registry, which is exactly what the guard's degrade policy means)."""
+        with self._lock:
+            handle = self._inflight
+        if handle is None:
+            return
+        try:
+            handle.result(timeout_s)
+        except BaseException:  # noqa: BLE001 - degrade to the local registry
+            pass
+
+    # ------------------------------------------------------------ lifecycle
+    def __deepcopy__(self, memo: dict) -> "WatermarkAgreement":
+        # the agreement IS the process-wide clock registry: a deep-copied
+        # participant (the service's shadow twin, a cloned rank) must keep
+        # talking to the SAME registry, not a frozen private copy — and the
+        # live lock/in-flight handle could not travel anyway
+        return self
+
+    def __reduce__(self):
+        raise TypeError(
+            "WatermarkAgreement is a live process-wide registry (locks, an"
+            " in-flight exchange) and cannot be pickled; checkpoint the"
+            " participating metrics (their state_dict carries the agreed"
+            " high-water) and re-attach on restore"
+        )
+
+    # --------------------------------------------------------------- gauges
+    def _note_gauge_locked(self) -> None:
+        record_watermark_agreement(
+            self.label, self._agreed, len(self._ranks), self._excluded, self.exchanges
+        )
+
+    def __repr__(self) -> str:
+        with self._lock:
+            return (
+                f"WatermarkAgreement(label={self.label!r}, ranks={len(self._ranks)},"
+                f" agreed={self._agreed}, excluded={sorted(map(repr, self._excluded))},"
+                f" exchanges={self.exchanges})"
+            )

@@ -118,6 +118,28 @@ What gets counted, and on which plane:
   the tail's current size and its certified per-query overcount. Refreshed
   after every eager update while counting is enabled — the numbers come
   from the table's host bookkeeping and mirror, zero device readbacks.
+- **wm_stragglers**: ranks EXCLUDED from the cross-rank watermark agreement
+  (``core/streaming.py``'s :class:`WatermarkAgreement`): a participant whose
+  watermark stalled past the agreement's ``deadline_s`` was dropped from the
+  global min so window closing could proceed (affected publishes stamp
+  ``degraded=True``). One bump per exclusion EPISODE — a rank that rejoins
+  and stalls again counts twice. Like the fault counters this records even
+  while counting is DISABLED: an excluded rank's events are being judged by
+  a clock it no longer feeds, which is operationally important evidence.
+  Pinned at zero on the clean bench trajectory (``--check-trajectory``);
+  nonzero is EXPECTED under the ``--check-watermark`` stall tier.
+- **wm_exchange_calls**: watermark-agreement exchange rounds dispatched onto
+  the background host plane (``WatermarkAgreement.exchange`` — one packed
+  min-gather per round, host-plane only: the exchange stages ZERO in-jit
+  collectives, which the ``--check-watermark`` gate pins). Telemetry like
+  the deferred lifecycle counters, so it shares the enabled gate.
+- **watermark_agreement**: per-agreement GAUGES
+  (``{label: {"agreed": float|None, "ranks": n, "excluded": [rank, ...],
+  "exchanges": e}}``): the agreed (global-min) watermark, how many ranks
+  participate, which are currently excluded as stragglers, and how many
+  exchange rounds have run. Refreshed on every exchange dispatch and every
+  exclusion/rejoin transition while counting is enabled; present in every
+  snapshot.
 - **slab_slots**: per-slab slot GAUGES for the keyed multi-tenant wrappers
   (``wrappers/keyed.py``): ``{label: {"slots": K, "occupied": n,
   "evictions": e}}``. Occupancy says how much of the provisioned K is
@@ -157,6 +179,9 @@ __all__ = [
     "record_slab_slots",
     "record_state_bytes",
     "record_states_synced",
+    "record_watermark_agreement",
+    "record_wm_exchange",
+    "record_wm_straggler",
     "reset",
     "snapshot",
     "state_nbytes",
@@ -222,6 +247,9 @@ class CollectiveCounters:
         "gather_skips",
         "slab_dropped_samples",
         "evicted_mass_dropped",
+        "wm_stragglers",
+        "wm_exchange_calls",
+        "watermark_agreement",
         "state_bytes",
         "slab_slots",
         "heavy_hitters",
@@ -252,6 +280,9 @@ class CollectiveCounters:
         self.gather_skips = 0
         self.slab_dropped_samples = 0  # out-of-range slot ids dropped by slab scatters
         self.evicted_mass_dropped = 0  # samples whose history LRU eviction destroyed
+        self.wm_stragglers = 0  # ranks excluded from the watermark agreement
+        self.wm_exchange_calls = 0  # watermark min-exchange rounds dispatched
+        self.watermark_agreement: Dict[str, Dict[str, Any]] = {}  # agreement label -> gauges
         self.fleet_shards: Dict[str, Dict[str, Dict[str, Any]]] = {}  # fleet label -> shard gauges
         self.state_bytes: Dict[str, int] = {}  # metric class name -> latest bytes
         self.slab_slots: Dict[str, Dict[str, int]] = {}  # keyed-slab label -> gauges
@@ -339,6 +370,31 @@ class CollectiveCounters:
         with self._lock:
             self.evicted_mass_dropped += int(n)
 
+    def record_wm_straggler(self, n: int = 1) -> None:
+        """Count watermark-agreement exclusion episodes (negative n is a bug
+        at the call site — fail loudly)."""
+        if n < 0:
+            raise ValueError(f"straggler count must be >= 0, got {n}")
+        with self._lock:
+            self.wm_stragglers += int(n)
+
+    def record_wm_exchange(self, n: int = 1) -> None:
+        """Count watermark min-exchange rounds dispatched."""
+        with self._lock:
+            self.wm_exchange_calls += int(n)
+
+    def record_watermark_agreement(
+        self, label: str, agreed: Any, ranks: int, excluded: Any, exchanges: int
+    ) -> None:
+        """Refresh one watermark agreement's gauges (latest value wins)."""
+        with self._lock:
+            self.watermark_agreement[label] = {
+                "agreed": None if agreed is None else float(agreed),
+                "ranks": int(ranks),
+                "excluded": sorted(str(r) for r in excluded),
+                "exchanges": int(exchanges),
+            }
+
     def record_heavy_hitters(
         self, label: str, hot_slots: int, hot_occupied: int, promotions: int,
         demotions: int, tail_mass: int, tail_bound: float,
@@ -415,6 +471,11 @@ class CollectiveCounters:
                 "gather_skips": self.gather_skips,
                 "slab_dropped_samples": self.slab_dropped_samples,
                 "evicted_mass_dropped": self.evicted_mass_dropped,
+                "wm_stragglers": self.wm_stragglers,
+                "wm_exchange_calls": self.wm_exchange_calls,
+                "watermark_agreement": {
+                    k: dict(v) for k, v in sorted(self.watermark_agreement.items())
+                },
                 "state_bytes": dict(sorted(self.state_bytes.items())),
                 "fleet_shards": {
                     k: {s_: dict(g) for s_, g in sorted(v.items())}
@@ -495,6 +556,29 @@ def record_slab_dropped(n: int = 1) -> None:
 # must leave a trail even when observability is off.
 def record_evicted_mass(n: int) -> None:
     COUNTERS.record_evicted_mass(n)
+
+
+# Straggler-exclusion evidence records UNCONDITIONALLY, same argument as the
+# fault counters: a rank dropped from the agreed clock must leave a trail
+# even when observability is off.
+def record_wm_straggler(n: int = 1) -> None:
+    COUNTERS.record_wm_straggler(n)
+
+
+# Exchange rounds are telemetry like the deferred lifecycle counters (one
+# per agreement cadence tick), so they share the enabled gate.
+def record_wm_exchange(n: int = 1) -> None:
+    if COUNTERS.enabled:
+        COUNTERS.record_wm_exchange(n)
+
+
+# Agreement gauges are telemetry refreshed from host bookkeeping, so they
+# share the enabled gate like slab_slots / fleet_shards.
+def record_watermark_agreement(
+    label: str, agreed: Any, ranks: int, excluded: Any, exchanges: int
+) -> None:
+    if COUNTERS.enabled:
+        COUNTERS.record_watermark_agreement(label, agreed, ranks, excluded, exchanges)
 
 
 # Heavy-hitter tier gauges are telemetry (refreshed per eager update from

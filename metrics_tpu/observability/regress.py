@@ -73,6 +73,10 @@ MS_KEYS: Tuple[str, ...] = (
     # (monotonicity across depths is --check-async's pin, not this gate's)
     "async_lag2_ms",
     "async_lag3_ms",
+    # one watermark-agreement round (report + min-exchange through the
+    # background host plane + fold): the cross-rank clock must stay cheap
+    # enough to ride every ingest cadence tick
+    "wm_agreement_ms",
 )
 
 # staged-collective keys gated exactly (no growth) vs the latest prior round
@@ -150,6 +154,13 @@ COUNT_KEYS: Tuple[str, ...] = (
     # either means the scenario changed — re-pin deliberately
     "fleet_shards_merged_windows",
     "fleet_shards_published_windows",
+    # the watermark-agreement plane: exchange rounds on the seeded scenario
+    # are deterministic (one per report cadence tick, the in-flight guard
+    # collapses none on the synchronous drive), and the sliding-window
+    # publish count over the seeded stream is pure routing arithmetic —
+    # growth in either means the scenario changed, re-pin deliberately
+    "wm_exchange_calls",
+    "slide_windows_published",
 )
 
 # throughput keys (batches/sec through real serving loops): gated as
@@ -179,6 +190,9 @@ FAULT_KEYS: Tuple[str, ...] = (
     "slab_dropped_samples",
     # the fleet merge tier may never lose a window on the clean bench stream
     "fleet_lost_windows",
+    # the clean bench trajectory never excludes a rank from the agreed
+    # watermark: a straggler exclusion on healthy ranks is a clock regression
+    "wm_stragglers",
 )
 
 TOLERANCES: Dict[str, float] = {

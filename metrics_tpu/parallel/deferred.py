@@ -345,6 +345,7 @@ def deferred_host_gather(
     watermark: Optional[int] = None,
     label: str = "host_gather",
     attrs: Optional[Dict[str, Any]] = None,
+    finish: Optional[Callable[[Dict[str, Any]], Any]] = None,
 ) -> SyncHandle:
     """Run the host sync plane in the background; returns a :class:`SyncHandle`.
 
@@ -361,14 +362,22 @@ def deferred_host_gather(
     span (the lag-k metric plane stamps its chosen depth here as
     ``lag_controller``, so a trace shows WHY each dispatch happened at the
     depth it did).
+
+    ``finish`` runs on the gathered result ON THE WORKER, not at ``result()``
+    time — a consumer that only needs the side effect (the watermark
+    agreement folding an exchanged min into its registry) observes it as soon
+    as the gather lands, even if nobody ever fences the handle. A ``finish``
+    that raises surfaces from ``result()`` like any task failure.
     """
     snapshot = dict(state)  # immutable leaves: holding the refs IS buffer A
     guard = guard if guard is not None else current_sync_guard()
 
-    def task() -> Dict[str, Any]:
+    def task() -> Any:
         task_attrs = {"plane": label} if TRACE.enabled else None
         with _span("deferred.complete", task_attrs):
             out = host_gather(snapshot, reductions, gather_fn=gather_fn, guard=guard)
+            if finish is not None:
+                out = finish(out)
         record_deferred("completed")
         return out
 

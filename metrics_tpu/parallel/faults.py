@@ -54,6 +54,19 @@ fifth call — the seeded mid-stream shard kill the fleet failover soak
 with ``rate=1.0`` stalls every shard's worker per batch (the fleet scaling
 scenario's simulated per-batch serving work).
 
+MULTI-RANK streams address the same way through ``rank=``: a caller driving
+one rank of a virtual mesh (a ``MetricService`` built with ``fault_rank=i``,
+or any consumer passing ``rank=`` to :meth:`ChaosInjector.ingest_faults`)
+reports its rank index, and a spec with ``rank=`` set fires only on that
+rank — ``FaultSpec(kind="clock_skew", rank=1, rate=1.0, skew_s=30.0)`` skews
+exactly rank 1's producer clock, ``kind="ingest_stall"`` with ``rank=3``
+stalls exactly rank 3's ingest. This is the lever the watermark-agreement
+gate (``bench.py --check-watermark``) uses to skew or stall ONE rank of the
+(4,2) virtual mesh while its peers stay honest. ``rank=`` and ``shard=``
+compose (both must match when both are set); rate verdicts are cached per
+(spec, site, call, shard, rank), so two ranks at the same call index draw
+independent — but each seed-stable — verdicts.
+
 Faults are *scenario-addressable*: a spec pins the exact gather call index it
 fires on (``call=``, counted per site from injector install), or fires
 probabilistically (``rate=``) from the injector's seeded RNG — both
@@ -122,6 +135,7 @@ class FaultSpec(NamedTuple):
     site: str = "host_gather"
     skew_s: float = 0.0  # clock_skew shift (late_burst shifts by -skew_s)
     shard: Optional[int] = None  # fleet shard index (None = every shard)
+    rank: Optional[int] = None  # mesh/stream rank index (None = every rank)
 
 
 class ChaosInjector:
@@ -141,6 +155,8 @@ class ChaosInjector:
                 raise ValueError(f"spec {spec!r} is unaddressed: set call= or rate>0")
             if spec.shard is not None and not (isinstance(spec.shard, int) and spec.shard >= 0):
                 raise ValueError(f"spec {spec!r}: shard= must be a non-negative int or None")
+            if spec.rank is not None and not (isinstance(spec.rank, int) and spec.rank >= 0):
+                raise ValueError(f"spec {spec!r}: rank= must be a non-negative int or None")
         self.schedule: List[FaultSpec] = list(schedule)
         self.seed = seed
         self._rng = random.Random(seed)
@@ -153,15 +169,19 @@ class ChaosInjector:
         self._rate_verdicts: Dict[tuple, bool] = {}
 
     # ------------------------------------------------------------- matching
-    def _matches(self, spec: FaultSpec, site: str, idx: int, shard: Optional[int] = None) -> bool:
+    def _matches(
+        self, spec: FaultSpec, site: str, idx: int,
+        shard: Optional[int] = None, rank: Optional[int] = None,
+    ) -> bool:
         if spec.site != site:
             return False
         if spec.call is not None:
             return spec.call == idx
-        # the verdict key carries the caller's shard so two fleet shards at
-        # the same per-shard call index draw independent (but each stable)
-        # verdicts; non-fleet callers pass shard=None and keep the old key
-        key = (id(spec), site, idx, shard)
+        # the verdict key carries the caller's shard AND rank so two fleet
+        # shards (or two mesh ranks) at the same per-caller call index draw
+        # independent (but each stable) verdicts; callers without the
+        # dimension pass None and keep one shared key
+        key = (id(spec), site, idx, shard, rank)
         verdict = self._rate_verdicts.get(key)
         if verdict is None:
             verdict = self._rate_verdicts[key] = self._rng.random() < spec.rate
@@ -218,7 +238,9 @@ class ChaosInjector:
                 return
         time.sleep(duration)  # outside the lock: a stall must not block peers
 
-    def ingest_faults(self, site: str, idx: int, shard: Optional[int] = None) -> List[FaultSpec]:
+    def ingest_faults(
+        self, site: str, idx: int, shard: Optional[int] = None, rank: Optional[int] = None,
+    ) -> List[FaultSpec]:
         """The service-plane specs firing on ingest call ``idx`` at ``site``
         (kinds in :data:`SERVICE_FAULT_KINDS`; the serving loop applies the
         semantics — sleep, time shift, preemption — itself).
@@ -229,8 +251,12 @@ class ChaosInjector:
         index (the ``MetricFleet`` shards report theirs; a spec with
         ``shard=`` set fires only on that shard — ``idx`` is then that
         shard's OWN ingest call counter, so a kill is addressable to "shard
-        2's fifth batch"). Thread-safe and seeded like the gather path;
-        fired kinds count into ``injected``.
+        2's fifth batch"). ``rank`` is the caller's mesh/stream rank the same
+        way (a ``MetricService(fault_rank=i)`` reports it): a spec with
+        ``rank=`` set fires only on that rank, so a ``clock_skew`` or
+        ``ingest_stall`` is addressable to exactly one rank of a virtual
+        mesh. Thread-safe and seeded like the gather path; fired kinds count
+        into ``injected``.
         """
         fired: List[FaultSpec] = []
         with self._lock:
@@ -239,10 +265,12 @@ class ChaosInjector:
                     continue
                 if spec.shard is not None and spec.shard != shard:
                     continue
+                if spec.rank is not None and spec.rank != rank:
+                    continue
                 if spec.call is not None:
                     if not (spec.call <= idx < spec.call + spec.times):
                         continue
-                elif not self._matches(spec, site, idx, shard):
+                elif not self._matches(spec, site, idx, shard, rank):
                     continue
                 self._fire(spec)
                 fired.append(spec)

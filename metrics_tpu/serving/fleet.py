@@ -66,10 +66,11 @@ import itertools
 import threading
 import time
 from collections import deque
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Union
 
 import numpy as np
 
+from metrics_tpu.core.streaming import WatermarkAgreement
 from metrics_tpu.observability.counters import (
     COUNTERS as _COUNTERS,
     record_fleet_shards,
@@ -141,6 +142,17 @@ class MetricFleet:
             :meth:`recover_shard`'s overlap replay. Must comfortably exceed
             the shard's queue depth plus the publish cadence (snapshots
             refresh every publish, so the overlap is short).
+        agreement: rank-coherent closing for the shard clocks. ``True``
+            builds a :class:`~metrics_tpu.core.streaming.WatermarkAgreement`
+            over the shards (deadline from ``guard.deadline_s``, policy
+            ``degrade``), or pass a configured instance; ``None`` (default)
+            keeps per-shard local clocks. With an agreement every shard's
+            ``Windowed`` joins as rank ``i``: a skewed shard cannot close —
+            or publish partials for — a window its peers still feed, and a
+            STALLED shard is excluded from the min after the deadline
+            (``wm_stragglers`` bumps, merged records stamp
+            ``degraded=True``) so the merge frontier keeps moving instead of
+            waiting on it forever.
 
     ``submit(key, *data, event_time=)`` is the producer API; the merged
     stream lands in :attr:`merged_records` (and ``merged_publish_fn``).
@@ -161,6 +173,7 @@ class MetricFleet:
         replay_log: int = 512,
         deferred_publish: bool = True,
         poll_interval_s: float = 0.02,
+        agreement: Union[None, bool, WatermarkAgreement] = None,
     ):
         if not callable(metric_factory):
             raise ValueError("`metric_factory` must be a zero-arg callable building a Windowed metric")
@@ -184,6 +197,17 @@ class MetricFleet:
             queue_size=queue_size, shed_policy=shed_policy, guard=guard,
             deferred_publish=deferred_publish, poll_interval_s=poll_interval_s,
         )
+        if agreement is True:
+            deadline = guard.deadline_s if guard is not None and guard.deadline_s else 30.0
+            agreement = WatermarkAgreement(
+                deadline_s=deadline, policy="degrade", label=f"{self.label}/wm"
+            )
+        elif not (agreement is None or isinstance(agreement, WatermarkAgreement)):
+            raise ValueError(
+                "`agreement` must be None, True, or a WatermarkAgreement,"
+                f" got {agreement!r}"
+            )
+        self.agreement: Optional[WatermarkAgreement] = agreement or None
 
         self._lock = threading.RLock()
         self.merged_publish_fn = merged_publish_fn
@@ -198,8 +222,14 @@ class MetricFleet:
         self._shards: List[MetricService] = [self._build_shard(i) for i in range(num_shards)]
 
     def _build_shard(self, index: int) -> MetricService:
+        metric = self._factory()
+        if self.agreement is not None:
+            # the shard joins the fleet clock as rank=index; a RECOVERED
+            # shard re-attaches here under the same rank, and its restored
+            # report is monotone — replay can never regress the agreed min
+            metric.attach_agreement(self.agreement, rank=index)
         return MetricService(
-            self._factory(),
+            metric,
             name=f"{self.label}/shard{index}",
             partial_publish_fn=(
                 lambda record, partial, _shard=index: self._on_shard_publish(_shard, record, partial)
@@ -276,17 +306,25 @@ class MetricFleet:
         the fleet-level min-watermark rule: window ``w`` merges once every
         shard's publish stream has closed it (a shard that published past
         ``w`` without publishing ``w`` had no resident samples there — its
-        contribution is the empty partial). ``force`` (finalize) emits
-        through the highest window any shard published."""
+        contribution is the empty partial). With a fleet
+        :class:`WatermarkAgreement`, shards IT has excluded as stragglers do
+        not hold the frontier — the merge proceeds on the surviving shards'
+        clocks with the record stamped ``degraded=True`` (the agreement's
+        deadline already bumped ``wm_stragglers``), so one stalled shard can
+        never deadlock the merge tier. ``force`` (finalize) emits through
+        the highest window any shard published."""
         if not self._partials:
             return
+        excluded = self._excluded_shards()
         if force:
             frontier = max(self._partials)
         else:
-            closed = self._closed_through
-            if any(c is None for c in closed):
-                return  # a shard has yet to close its first window
-            frontier = min(c for c in closed)
+            closed = [
+                c for i, c in enumerate(self._closed_through) if i not in excluded
+            ]
+            if not closed or any(c is None for c in closed):
+                return  # a participating shard has yet to close its first window
+            frontier = min(closed)
         for window in sorted(self._partials):
             if self._merged_through is not None and window <= self._merged_through:
                 continue
@@ -295,20 +333,32 @@ class MetricFleet:
             all_closed = all(
                 c is not None and c >= window for c in self._closed_through
             )
-            self._emit_locked(window, forced=not all_closed)
+            self._emit_locked(window, forced=not all_closed, degraded=bool(excluded))
 
-    def _emit_locked(self, window: int, forced: bool) -> None:
+    def _excluded_shards(self) -> frozenset:
+        """Shard indices the fleet agreement currently excludes (always empty
+        without one). Reading ``agreed()`` first runs the straggler scan, so
+        a shard that crossed its deadline since the last publish event is
+        excluded HERE — the merge frontier re-evaluates on every emit."""
+        if self.agreement is None:
+            return frozenset()
+        self.agreement.agreed()
+        return frozenset(
+            r for r in self.agreement.excluded() if isinstance(r, int)
+        )
+
+    def _emit_locked(self, window: int, forced: bool, degraded: bool = False) -> None:
         partials = self._partials.get(window, {})
         value = self._template.value_from_partials(list(partials.values()))
         rows = sum(float(np.asarray(p["rows"])) for p in partials.values())
         record = {
             "fleet": self.label,
             "window": window,
-            "window_start_s": window * self.window_s,
+            "window_start_s": self._template.window_start(window),
             "value": np.asarray(value),
             "rows": rows,
             "shards": sorted(partials),
-            "degraded": self._pub_degraded.get(window, False),
+            "degraded": degraded or self._pub_degraded.get(window, False),
             "forced": forced,
         }
         self.merged_records.append(record)

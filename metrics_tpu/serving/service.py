@@ -130,11 +130,14 @@ class MetricService:
             background host plane (default True) so window publish overlaps
             ingest; ``False`` restores the fully synchronous publish stage
             (the worker blocks on each window's sync before the next batch).
-        fault_site / fault_shard: the chaos-injector site this service's
-            ingest path consults (default ``service.ingest``) and the shard
-            index it reports there — the fleet runs its shards at site
+        fault_site / fault_shard / fault_rank: the chaos-injector site this
+            service's ingest path consults (default ``service.ingest``), the
+            shard index it reports there — the fleet runs its shards at site
             ``fleet.shard`` with their shard index so a ``FaultSpec`` can
-            kill/stall one specific shard.
+            kill/stall one specific shard — and the mesh/stream RANK it
+            reports, so a ``FaultSpec(rank=i)`` can skew or stall exactly
+            one rank of a multi-rank stream (the ``--check-watermark``
+            gate's lever).
 
     The worker thread starts immediately; use as a context manager or call
     :meth:`stop`. ``submit`` raises :class:`ServiceStoppedError` once the
@@ -157,6 +160,7 @@ class MetricService:
         deferred_publish: bool = True,
         fault_site: str = INGEST_SITE,
         fault_shard: Optional[int] = None,
+        fault_rank: Optional[int] = None,
     ):
         if not isinstance(metric, Windowed):
             raise ValueError(
@@ -188,6 +192,8 @@ class MetricService:
         )
         self.fault_site = str(fault_site)
         self.fault_shard = fault_shard
+        self.fault_rank = fault_rank
+        self._wm_force_degraded = False  # finalize timed out waiting for agreement
         self.poll_interval_s = float(poll_interval_s)
         self.deferred_publish = bool(deferred_publish)
         # the deferred stage's double buffer: a detached twin whose states
@@ -336,7 +342,9 @@ class MetricService:
         idx = self._ingest_idx
         self._ingest_idx += 1
         if injector is not None:
-            for spec in injector.ingest_faults(self.fault_site, idx, shard=self.fault_shard):
+            for spec in injector.ingest_faults(
+                self.fault_site, idx, shard=self.fault_shard, rank=self.fault_rank
+            ):
                 if spec.kind == "ingest_stall":
                     time.sleep(spec.duration_s)
                 elif spec.kind == "clock_skew":
@@ -374,7 +382,7 @@ class MetricService:
             return
         new_wm = peak if wm is None else max(wm, peak)
         m = self.metric
-        expire_below = int(math.floor(new_wm / m.window_s)) - m.num_windows + 1
+        expire_below = int(math.floor(new_wm / m.window_stride)) - m.num_windows + 1
         for window in m.resident_windows():
             if window >= expire_below:
                 break
@@ -384,12 +392,17 @@ class MetricService:
 
     def _closed_through(self) -> Optional[int]:
         """Highest window index no future event can reach: ``w`` is closed
-        once ``(w + 1) * window_s + allowed_lateness_s <= watermark``."""
-        wm = self.metric.watermark
+        once ``w * stride + window_s + allowed_lateness_s <= watermark`` —
+        judged by the metric's CLOSE clock, which is the cross-rank AGREED
+        watermark when a :class:`WatermarkAgreement` governs the stream
+        (``None`` until the agreement forms: a window never closes before
+        every participating rank's clock has passed it) and the local
+        running max otherwise."""
+        wm = self.metric.close_watermark
         if wm is None:
             return None
         m = self.metric
-        return int(math.floor((wm - m.allowed_lateness_s) / m.window_s)) - 1
+        return int(math.floor((wm - m.allowed_lateness_s - m.window_s) / m.window_stride))
 
     def _publish_closed(self, force_through: Optional[int] = None) -> None:
         closed = self._closed_through() if force_through is None else force_through
@@ -434,9 +447,17 @@ class MetricService:
 
     def _publish_book(self) -> Dict[str, Any]:
         """Close-point bookkeeping, captured on the worker thread so the
-        (possibly deferred) record reports the values at the window close."""
+        (possibly deferred) record reports the values at the window close.
+
+        ``wm_degraded`` is the agreed-clock degrade stamp: True when the
+        governing agreement is currently excluding a straggler (the close
+        verdict came from a partial clock) or when finalize's bounded
+        agreement wait timed out — either way the publish must say so.
+        """
         return {
             "watermark": self.metric.watermark,
+            "agreed_watermark": getattr(self.metric, "agreed_watermark", None),
+            "wm_degraded": self._wm_force_degraded or self.metric.agreement_degraded,
             "dropped_samples": self.metric.dropped_samples,
             "shed_events": self.shed_events,
             "queue_depth": self._queue.qsize(),
@@ -474,7 +495,9 @@ class MetricService:
                 merged = metric.compute()
             finally:
                 set_sync_guard(old_guard)
-            degraded = _COUNTERS.faults["degraded_computes"] > before
+            degraded = _COUNTERS.faults["degraded_computes"] > before or bool(
+                book.get("wm_degraded")
+            )
             value = metric.compute_window(window)
             partial = (
                 metric.window_partial(window)
@@ -485,11 +508,12 @@ class MetricService:
             record = {
                 "service": self.label,
                 "window": window,
-                "window_start_s": window * self.metric.window_s,
+                "window_start_s": self.metric.window_start(window),
                 "value": _host(value),
                 "merged": _host(merged),
                 "degraded": degraded,
                 "watermark": book["watermark"],
+                "agreed_watermark": book.get("agreed_watermark"),
                 "dropped_samples": book["dropped_samples"],
                 "shed_events": book["shed_events"],
             }
@@ -563,16 +587,51 @@ class MetricService:
         # published every window its ingested events closed
         self._drain_publishes(max(deadline - time.monotonic(), 0.001))
 
+    def _await_agreement(self, through: int, timeout_s: float) -> bool:
+        """Bounded wait for the agreed clock to close every window up to
+        ``through`` (no-op without an agreement). Polling ``_closed_through``
+        drives the agreement's straggler scan, so a stalled peer is excluded
+        — and the wait unblocks — once ITS deadline expires. Returns False
+        on timeout: the caller publishes from the local clock and stamps
+        ``degraded=True`` instead of hanging shutdown forever."""
+        if self.metric.agreement is None:
+            return True
+        deadline = time.monotonic() + max(timeout_s, 0.001)
+        while True:
+            closed = self._closed_through()
+            if closed is not None and closed >= through:
+                return True
+            if time.monotonic() > deadline:
+                return False
+            time.sleep(self.poll_interval_s / 2)
+
     def finalize(self, timeout_s: float = 30.0) -> Any:
         """Drain, force-publish every still-open resident window, and return
         the merged sliding value. The end-of-stream flush: open windows are
-        published as they stand (stamped like any other publish)."""
+        published as they stand (stamped like any other publish).
+
+        The force-publish runs UNDER THE GUARD DEADLINE: with a watermark
+        agreement governing the stream, finalize first waits — bounded by
+        ``guard.deadline_s`` (never past ``timeout_s``) — for the agreed
+        clock to close the resident windows, so a healthy shutdown publishes
+        agreement-ordered records; when a stalled peer (or a dead exchange)
+        keeps the agreement behind, the wait times out, the remaining
+        windows publish from LOCAL state with ``degraded=True``, and
+        shutdown completes anyway — a sick peer can degrade the last
+        publishes, never hang them.
+        """
         self.flush(timeout_s)
         with self._proc_lock:
             head = self.metric.head_window
             if head is not None:
-                self._publish_closed(force_through=head)
-                self._drain_publishes(timeout_s)
+                wait_s = min(timeout_s, self.guard.deadline_s or timeout_s)
+                if not self._await_agreement(head, wait_s):
+                    self._wm_force_degraded = True
+                try:
+                    self._publish_closed(force_through=head)
+                    self._drain_publishes(timeout_s)
+                finally:
+                    self._wm_force_degraded = False
             # the final merged read is always FRESH (never the last
             # publish's cache) and syncs under the SERVICE guard: a sick
             # peer at end-of-stream degrades the value, never wedges the

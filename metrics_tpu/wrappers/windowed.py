@@ -43,6 +43,7 @@ contract: fixed-shape sum/mean/min/max states or sketch states, per-sample-
 decomposable update (cat/buffer states have no slab form — use
 ``approx="sketch"``).
 """
+import itertools
 import math
 from typing import Any, Callable, Dict, Optional
 
@@ -52,7 +53,12 @@ import numpy as np
 from jax import Array
 
 from metrics_tpu.core.metric import Metric, State
-from metrics_tpu.core.streaming import WindowSpec, decay_scale, route_events
+from metrics_tpu.core.streaming import (
+    WatermarkAgreement,
+    WindowSpec,
+    decay_scale,
+    route_events,
+)
 from metrics_tpu.observability.counters import record_slab_dropped
 from metrics_tpu.wrappers.keyed import Keyed
 from metrics_tpu.parallel.buffer import PaddedBuffer
@@ -102,6 +108,18 @@ class Windowed(Metric):
         empty: what ``compute()`` reports when no samples are resident —
             ``"nan"`` (default; non-float results fall back to 0) or
             ``"zero"``.
+        slide_s: SLIDING windows — a new window opens every ``slide_s``
+            seconds, each spanning ``window_s`` (must divide it evenly), so
+            every event scatters into ``window_s/slide_s`` overlapping ring
+            slots. ``compute()`` then returns the head window (the sliding
+            view of the last ``window_s`` seconds); per-window reads and
+            publishes are per sliding window. Lateness is capped at
+            ``num_windows*slide_s - window_s``.
+        agreement / rank: join a cross-rank
+            :class:`~metrics_tpu.core.streaming.WatermarkAgreement` as
+            participant ``rank`` (see :meth:`attach_agreement`) — windows
+            then open/close/judge lateness by the AGREED (global-min)
+            watermark instead of this rank's local clock.
 
     ``update(*data, event_time=t)`` takes per-sample event timestamps
     (seconds; an ``(N,)`` array, or a scalar stamping the whole batch).
@@ -134,6 +152,9 @@ class Windowed(Metric):
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
         dist_sync_fn: Optional[Callable] = None,
+        slide_s: Optional[float] = None,
+        agreement: Optional[WatermarkAgreement] = None,
+        rank: Optional[Any] = None,
     ):
         if not isinstance(metric, Metric):
             raise ValueError(f"`metric` must be a Metric, got {type(metric).__name__}")
@@ -160,6 +181,11 @@ class Windowed(Metric):
                 raise ValueError(
                     f"`decay_half_life_s` must be a positive number, got {decay_half_life_s!r}"
                 )
+            if slide_s is not None:
+                raise ValueError(
+                    "`slide_s` slides the window ring; the decay accumulator has no"
+                    " windows — use the windowed ring (window_s=)"
+                )
             self.decay_half_life_s = float(decay_half_life_s)
             self.num_windows = 1
             self.allowed_lateness_s = (
@@ -171,9 +197,11 @@ class Windowed(Metric):
             self.num_windows = int(num_windows)
             self.allowed_lateness_s = 0.0 if allowed_lateness_s is None else float(allowed_lateness_s)
             self._spec = WindowSpec(
-                float(window_s), self.num_windows, self.allowed_lateness_s
+                float(window_s), self.num_windows, self.allowed_lateness_s,
+                None if slide_s is None else float(slide_s),
             ).validate()
         self.window_s = None if self.decay else float(window_s)
+        self.slide_s = None if self.decay else (None if slide_s is None else float(slide_s))
         self.empty = empty
         self._metric_label = f"Windowed({type(metric).__name__})"
 
@@ -184,6 +212,15 @@ class Windowed(Metric):
         self._origin: Optional[int] = None  # oldest window ever accepted into
         self._dropped = 0  # lifetime too-late drops (mirrors slab_dropped_samples)
         self._late = 0  # lifetime accepted-but-late routings
+
+        # the cross-rank agreed clock (attach_agreement): None = local clock
+        self._agreement: Optional[WatermarkAgreement] = None
+        self._rank: Optional[Any] = None
+        self._agreed_seen: Optional[float] = None  # monotone view of agreed()
+        if agreement is not None:
+            self.attach_agreement(agreement, rank=rank)
+        elif rank is not None:
+            raise ValueError("`rank` has no meaning without `agreement`")
 
         if not metric._defaults:
             raise ValueError("the inner metric declares no states; nothing to window")
@@ -300,6 +337,98 @@ class Windowed(Metric):
         lo = max(self._origin, self._head - self.num_windows + 1)
         return tuple(range(lo, self._head + 1))
 
+    @property
+    def window_stride(self) -> Optional[float]:
+        """Seconds between consecutive window starts (``slide_s`` for
+        sliding windows, ``window_s`` for tumbling; ``None`` in decay
+        mode)."""
+        return None if self.decay else self._spec.stride
+
+    def window_start(self, window: int) -> float:
+        """Event-time start of window ``window`` (``window * stride``)."""
+        if self.decay:
+            raise ValueError("the decay accumulator has no windows")
+        return self._spec.window_start(window)
+
+    # ------------------------------------------------------ the agreed clock
+    _rank_ids = itertools.count()
+
+    def attach_agreement(
+        self, agreement: WatermarkAgreement, rank: Optional[Any] = None
+    ) -> "Windowed":
+        """Join a cross-rank :class:`WatermarkAgreement` as participant
+        ``rank``.
+
+        From then on every update reports this rank's local running-max
+        watermark to the agreement, and routing verdicts — plus window
+        closing wherever this metric serves (``MetricService`` /
+        ``MetricFleet``) — are judged against the AGREED (global-min)
+        watermark instead of the local clock: a skewed rank cannot close a
+        window its peers still feed, and "late" means the same thing on
+        every rank. Until a first agreement forms (a registered peer has not
+        reported yet) the rank routes by its local clock, exactly the
+        pre-agreement behavior. Attribute-set convention like
+        ``check_finite``/``sync_lag``: callable post-construction, also
+        reachable via ``Windowed(..., agreement=, rank=)``.
+        """
+        if not isinstance(agreement, WatermarkAgreement):
+            raise ValueError(
+                f"`agreement` must be a WatermarkAgreement, got {type(agreement).__name__}"
+            )
+        if self.decay:
+            raise ValueError(
+                "the decay accumulator has no windows to close; watermark"
+                " agreement applies to the windowed ring (window_s=)"
+            )
+        self._agreement = agreement
+        self._rank = rank if rank is not None else f"rank{next(Windowed._rank_ids)}"
+        agreement.register(self._rank)
+        if self._watermark is not None:
+            agreement.report(self._rank, self._watermark)
+        self._refresh_agreed()
+        return self
+
+    @property
+    def agreement(self) -> Optional[WatermarkAgreement]:
+        return self._agreement
+
+    @property
+    def rank(self) -> Optional[Any]:
+        """This metric's participant id in the attached agreement."""
+        return self._rank
+
+    def _refresh_agreed(self) -> Optional[float]:
+        """This rank's monotone view of the agreed watermark (an agreement
+        whose membership momentarily dips to ``None`` — a recovering peer
+        re-registering — must never regress verdicts already made)."""
+        if self._agreement is None:
+            return None
+        agreed = self._agreement.agreed()
+        if agreed is not None and (self._agreed_seen is None or agreed > self._agreed_seen):
+            self._agreed_seen = agreed
+        return self._agreed_seen
+
+    @property
+    def agreed_watermark(self) -> Optional[float]:
+        """The agreed (global-min) watermark as this rank last saw it
+        (``None`` without an agreement, or before one forms)."""
+        return self._refresh_agreed()
+
+    @property
+    def close_watermark(self) -> Optional[float]:
+        """The clock windows CLOSE by: the agreed watermark when an
+        agreement governs this stream (``None`` until it forms — nothing
+        closes before the fleet agrees), the local watermark otherwise."""
+        if self._agreement is None:
+            return self._watermark
+        return self._refresh_agreed()
+
+    @property
+    def agreement_degraded(self) -> bool:
+        """True while the attached agreement is excluding a straggler —
+        the stamp publishes carry while the agreed clock is partial."""
+        return self._agreement is not None and self._agreement.degraded
+
     # ---------------------------------------------------------------- update
     def update(self, *args: Any, event_time: Any = None, **kwargs: Any) -> None:
         """Route one batch into the window slabs by event time.
@@ -341,8 +470,22 @@ class Windowed(Metric):
                 record_slab_dropped(misrouted)
         if self.decay:
             slot_ids, weights = self._route_decay(times)
+            overlap_rows = ()
         else:
-            route = route_events(times, self._watermark, self._head, self._spec)
+            agreed = None
+            if self._agreement is not None and times.size:
+                # report BEFORE judging: this batch's peak is this rank's
+                # contribution to the very agreement round that judges it
+                peak = float(times.max())
+                candidate = peak if self._watermark is None else max(self._watermark, peak)
+                self._agreement.report(self._rank, candidate)
+                agreed = self._refresh_agreed()
+                if agreed is None:
+                    # no agreement yet (a registered peer is still silent):
+                    # the close clock is None — no window has closed — so no
+                    # event can be late either; only ring residency drops
+                    agreed = -math.inf
+            route = route_events(times, self._watermark, self._head, self._spec, agreed=agreed)
             if route.opened and self._head is not None:
                 # the roll: recycled slots held now-expired windows
                 self._reset_slots(sorted({w % self.num_windows for w in route.opened}))
@@ -358,6 +501,7 @@ class Windowed(Metric):
                 self._dropped += route.n_dropped
                 record_slab_dropped(route.n_dropped)
             slot_ids, weights = jnp.asarray(route.slot_ids), None
+            overlap_rows = tuple(jnp.asarray(r) for r in route.overlap_slots)
 
         kw_keys = tuple(kwargs)
         n_args = len(args)
@@ -369,12 +513,24 @@ class Windowed(Metric):
             )
 
         deltas = jax.vmap(one)(*data)  # {name: (N, *shape) / sketch with (N, ...) counts}
+
+        def scatter_rows(reduce: str, payload: Array) -> Array:
+            # sliding windows: the SAME per-sample delta scatters once per
+            # covering window (slot_ids = the newest covering row, then the
+            # overlap rows); tumbling windows have no extra rows
+            out = slab_scatter(reduce, payload, slot_ids, self.num_windows)
+            for row in overlap_rows:
+                out = slab_merge(
+                    reduce, out, slab_scatter(reduce, payload, row, self.num_windows)
+                )
+            return out
+
         for name in self.metric._defaults:
             reduce = self._slab_reduce[name]
             current = getattr(self, name)
             leaf = deltas[name]
             if is_sketch(current):
-                scattered = slab_scatter("sum", leaf.counts, slot_ids, self.num_windows)
+                scattered = scatter_rows("sum", leaf.counts)
                 setattr(self, name, type(current)(current.counts + scattered))
             else:
                 payload = leaf
@@ -382,13 +538,13 @@ class Windowed(Metric):
                     payload = payload.astype(current.dtype) * weights.reshape(
                         (-1,) + (1,) * (payload.ndim - 1)
                     )
-                scattered = slab_scatter(reduce, payload, slot_ids, self.num_windows)
+                scattered = scatter_rows(reduce, payload)
                 acc = current if weights is None else current * self._decay_step_scale
                 setattr(self, name, slab_merge(reduce, acc, scattered))
         rows = getattr(self, _ROWS_STATE)
         ones = jnp.ones(slot_ids.shape, dtype=rows.dtype) if weights is None else weights
         acc_rows = rows if weights is None else rows * self._decay_step_scale
-        setattr(self, _ROWS_STATE, acc_rows + slab_scatter("sum", ones, slot_ids, self.num_windows))
+        setattr(self, _ROWS_STATE, acc_rows + scatter_rows("sum", ones))
 
     def _route_decay(self, times: np.ndarray):
         """(slot_ids, per-sample weights) for the decay accumulator, and
@@ -428,7 +584,17 @@ class Windowed(Metric):
     def compute(self) -> Any:
         """The merged view over every resident window — the sliding value
         over the last ``W x window_s`` seconds (decay mode: the
-        exponentially-weighted value)."""
+        exponentially-weighted value).
+
+        With ``slide_s`` set the resident windows OVERLAP (each event lives
+        in ``window_s/slide_s`` of them), so a sum over slots would
+        multi-count; the head window already IS the sliding view of the last
+        ``window_s`` seconds, and ``compute()`` returns it.
+        """
+        if self.slide_s is not None:
+            resident = self.resident_windows()
+            if resident:
+                return self.compute_window(resident[-1])
         state = self._current_state()
         rows = state.pop(_ROWS_STATE)
         inner_state: State = {}
@@ -451,11 +617,13 @@ class Windowed(Metric):
     def compute_window(self, window: int) -> Any:
         """One resident window's value (the per-window publish read).
 
-        ``window`` is the ABSOLUTE window index (``floor(t / window_s)``);
-        it must still be resident in the ring — expired or never-opened
-        windows raise. Reads local state directly (no sync, no compute
-        cache): the serving loop syncs once per roll via the ordinary
-        ``compute()``/host plane and then reads windows off the slab.
+        ``window`` is the ABSOLUTE window index (``floor(t / stride)`` of
+        its newest event — the stride is ``slide_s`` for sliding windows,
+        ``window_s`` for tumbling); it must still be resident in the ring —
+        expired or never-opened windows raise. Reads local state directly
+        (no sync, no compute cache): the serving loop syncs once per roll
+        via the ordinary ``compute()``/host plane and then reads windows off
+        the slab.
         """
         if self.decay:
             raise ValueError("the decay accumulator has no windows; use compute()")
@@ -605,6 +773,7 @@ class Windowed(Metric):
         self._origin = None
         self._dropped = 0
         self._late = 0
+        self._agreed_seen = None
 
     _STREAM_KEYS = ("_windowed_watermark", "_windowed_head", "_windowed_dropped", "_windowed_late")
 
@@ -627,6 +796,12 @@ class Windowed(Metric):
         )
         destination[prefix + "_windowed_dropped"] = np.asarray(self._dropped, dtype=np.int64)
         destination[prefix + "_windowed_late"] = np.asarray(self._late, dtype=np.int64)
+        # the agreed clock as this rank last saw it: a restored rank resumes
+        # judging from AT LEAST this point, so a closed window can never
+        # reopen and the global watermark can never regress through replay
+        destination[prefix + "_windowed_agreed"] = np.asarray(
+            np.nan if self._agreed_seen is None else self._agreed_seen, dtype=np.float64
+        )
         return destination
 
     def load_state_dict(self, state_dict: dict, prefix: str = "") -> None:
@@ -643,14 +818,45 @@ class Windowed(Metric):
                 self._origin = None if self._head is None else origin
             self._dropped = int(np.asarray(state_dict[prefix + "_windowed_dropped"]))
             self._late = int(np.asarray(state_dict[prefix + "_windowed_late"]))
+            agreed_key = prefix + "_windowed_agreed"
+            if agreed_key in state_dict:
+                loaded = float(np.asarray(state_dict[agreed_key]))
+                if not math.isnan(loaded) and (
+                    self._agreed_seen is None or loaded > self._agreed_seen
+                ):
+                    self._agreed_seen = loaded
+            if self._agreement is not None and self._watermark is not None:
+                # the restored rank rejoins the agreement at its checkpointed
+                # clock: the report is monotone per rank, so replaying an old
+                # checkpoint into a live agreement can never pull the global
+                # min backwards
+                self._agreement.report(self._rank, self._watermark)
+                self._refresh_agreed()
+
+    def __getstate__(self) -> dict:
+        # the agreement is a live process-wide registry (locks, an in-flight
+        # exchange) that never pickles; a restored metric re-attaches via
+        # attach_agreement — the checkpointed agreed high-water rides
+        # state_dict, so the rejoin can never regress verdicts
+        state = super().__getstate__()
+        state.pop("_agreement", None)
+        return state
+
+    def __setstate__(self, state: dict) -> None:
+        super().__setstate__(state)
+        self.__dict__.setdefault("_agreement", None)
+        self.__dict__.setdefault("_rank", None)
+        self.__dict__.setdefault("_agreed_seen", None)
+        self.__dict__.setdefault("slide_s", None)
 
     def __repr__(self) -> str:
         if self.decay:
             return (
                 f"Windowed({self.metric!r}, decay_half_life_s={self.decay_half_life_s})"
             )
+        slide = "" if self.slide_s is None else f" slide_s={self.slide_s},"
         return (
-            f"Windowed({self.metric!r}, window_s={self.window_s},"
+            f"Windowed({self.metric!r}, window_s={self.window_s},{slide}"
             f" num_windows={self.num_windows},"
             f" allowed_lateness_s={self.allowed_lateness_s})"
         )

@@ -168,11 +168,21 @@ def _assert_headline_schema(out):
     assert out["fleet_shards_published_windows"] == 41
     assert out["fleet_lost_windows"] == 0
 
+    # the watermark-agreement scenario: one report + min-exchange round per
+    # timed iteration through the background host plane (the exchange count
+    # is deterministic — one explicit round each), and the sliding-window
+    # publish count over the seeded stream is pure routing arithmetic
+    assert isinstance(out["wm_agreement_ms"], (int, float)) and out["wm_agreement_ms"] > 0
+    assert out["wm_exchange_calls"] == 20
+    assert out["slide_windows_published"] == 12
+
     # fault counters ride the default line and are ZERO on a clean bench run
     # (--check-trajectory pins them at zero on every new BENCH_r* round);
-    # slab_dropped_samples joins them — in-window bench traffic never drops
+    # slab_dropped_samples joins them — in-window bench traffic never drops —
+    # and wm_stragglers: healthy bench ranks are never excluded from the
+    # agreed watermark
     for key in ("sync_retries", "sync_deadline_exceeded", "degraded_computes", "quarantined_updates",
-                "slab_dropped_samples"):
+                "slab_dropped_samples", "wm_stragglers"):
         assert out[key] == 0, key
 
 
@@ -191,7 +201,10 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     out = _run_smoke(("--trace", str(trace_file)))
     _assert_headline_schema(out)
 
-    # schema version of the --trace payload: v10 added the heavy-hitter
+    # schema version of the --trace payload: v11 added the rank-coherent
+    # streaming plane (wm_agreement_ms / wm_exchange_calls / wm_stragglers
+    # zero-pinned + slide_windows_published on the default line, gated by
+    # --check-watermark); v10 added the heavy-hitter
     # open-world plane (hh_* staged-count keys pinned to the unkeyed twin,
     # the 10k/1M ingest flatness pair, and the tail certificate on the
     # default line); v9 added the sharded fleet
@@ -206,7 +219,7 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     # windowed serving A/B; v5 the keyed slab A/B; v4 the sketch A/B; v3
     # moved the collective counts to the default line and added the
     # hierarchical A/B + per-crossing counters; bump this pin with the schema
-    assert out["trace_schema"] == 10
+    assert out["trace_schema"] == 11
     # the sketch program's full snapshot: psum-only, no gather kinds staged
     sketch_kinds = out["sketch_counters"]["calls_by_kind"]
     assert sketch_kinds.get("psum", 0) == 2
@@ -542,6 +555,50 @@ def test_bench_check_fleet_gate():
     assert out["chaos"]["elapsed_s"] < out["chaos"]["budget_s"]
 
 
+def test_bench_check_watermark_gate():
+    """``bench.py --check-watermark`` is the rank-coherent streaming gate:
+    a windowed metric under a WatermarkAgreement must stage the identical
+    in-jit sync program as the unwindowed metric (the exchange is host-plane
+    only — zero staged collectives, zero gathers), the coherence soak (one
+    +30s clock-skewed rank + one late-burst rank on the virtual mesh) must
+    publish NO window before every participating rank's watermark passes it
+    with all merged values bit-exact vs the union-stream oracle (zero lost,
+    zero double-published, zero drops), the stall tier (rate=1.0 stalled
+    rank) must proceed past the agreement deadline with ``wm_stragglers > 0``
+    and degraded publishes while no peer deadlocks, and sliding windows must
+    be bit-exact vs independent per-slot oracles."""
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, _BENCH, "--check-watermark"],
+        capture_output=True, text=True, timeout=280, env=env,
+        cwd=os.path.dirname(_BENCH),
+    )
+    assert proc.returncode == 0, f"--check-watermark failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["ok"] is True and out["failures"] == []
+    # parity: the agreement adds ZERO staged collectives — the exchange is
+    # host-plane only, and it actually ran
+    assert (
+        out["parity"]["agreed"]["collective_calls"]
+        == out["parity"]["unwindowed"]["collective_calls"]
+    )
+    assert out["parity"]["agreed"]["gather_calls"] == 0
+    assert out["parity"]["agreed"]["wm_exchange_calls"] >= 1
+    # coherent: the skew actually fired on every one of the skewed rank's
+    # batches, and the late burst on its pinned call
+    assert out["coherent"]["injected"]["clock_skew"] >= 12
+    assert out["coherent"]["injected"]["late_burst"] == 1
+    assert out["coherent"]["published"] == sorted(out["coherent"]["published"])
+    # stall: exclusion proceeded (wm_stragglers), publishes degraded, fast
+    assert out["stall"]["stragglers"] >= 1
+    assert any(d for pubs in out["stall"]["published"].values() for _w, d in pubs)
+    assert out["stall"]["elapsed_s"] < out["stall"]["budget_s"]
+    # sliding: every event covers window_s/slide_s = 3 overlapping windows
+    assert out["sliding"]["overlap"] == 3
+    assert out["sliding"]["windows_published"] == 12
+
+
 def _run_trajectory(tmp_path, current, rounds):
     rounds_dir = tmp_path / "rounds"
     rounds_dir.mkdir(exist_ok=True)
@@ -644,6 +701,13 @@ def test_bench_check_trajectory_pins_fault_counters_at_zero(tmp_path):
     assert rc == 1
     assert any("degraded_computes" in f for f in out["failures"])
     assert out["checks"]["degraded_computes"]["status"] == "regression"
+
+    # wm_stragglers binds the same way: a clean bench line that excluded a
+    # rank from the agreed watermark is a clock regression
+    wm_dirty = dict(clean, wm_stragglers=1)
+    rc, out = _run_trajectory(tmp_path, wm_dirty, {6: clean})
+    assert rc == 1
+    assert any("wm_stragglers" in f for f in out["failures"])
 
     # rounds predating the keys: current lines without them aren't constrained
     rc, out = _run_trajectory(tmp_path, _TRAJECTORY_BASE, {6: _TRAJECTORY_BASE})

@@ -411,3 +411,70 @@ def test_heavy_hitter_fleet_validation():
         HeavyHitterFleet(lambda: HeavyHitters(Accuracy(), 2), 0)
     with pytest.raises(ValueError, match="HeavyHitters"):
         HeavyHitterFleet(lambda: Accuracy(), 2)
+
+
+def test_fleet_agreement_excludes_stalled_shard_and_merges_degraded():
+    """The fleet clock: with ``agreement=True`` every shard joins one
+    WatermarkAgreement as rank i. A shard that stops reporting is excluded
+    after the deadline (``wm_stragglers`` bumps) and the merge frontier
+    proceeds on the survivors — stamped ``degraded=True`` — instead of
+    waiting on it forever."""
+    import metrics_tpu.observability as obs
+    from metrics_tpu.parallel.sync import SyncGuard
+    from metrics_tpu.serving import shard_for_key
+
+    guard = SyncGuard(deadline_s=0.6, max_retries=1, backoff_s=0.02, policy="degrade")
+    before = obs.COUNTERS.wm_stragglers
+    fleet = MetricFleet(_factory, num_shards=2, guard=guard, agreement=True)
+    try:
+        assert fleet.agreement is not None
+        assert all(s.metric.agreement is fleet.agreement for s in fleet.shards)
+        keys = {shard_for_key(f"t{i}", 2): f"t{i}" for i in range(16)}
+        live, dead = keys[0], keys[1]
+        preds = jnp.asarray(np.float32([0.9, 0.8]))
+        target = jnp.asarray(np.int32([1, 1]))
+        # the dead shard speaks once, then goes silent; the live shard
+        # keeps streaming past the agreement deadline
+        fleet.submit(dead, preds, target, event_time=np.array([1.0, 2.0]))
+        for r in range(8):
+            fleet.submit(live, preds, target,
+                         event_time=np.array([r * 10.0 + 3.0, r * 10.0 + 7.0]))
+            fleet.flush(10)
+            time.sleep(0.12)
+        assert fleet.merged_records, "the stalled shard wedged the merge tier"
+        assert all(r["degraded"] for r in fleet.merged_records)
+        assert obs.COUNTERS.wm_stragglers - before >= 1
+    finally:
+        fleet.stop(10)
+
+
+def test_fleet_agreement_gates_merge_on_slowest_shard():
+    """Before the deadline, the agreed clock holds the merge frontier at the
+    slowest healthy shard — a fast shard's publishes bank partials but no
+    merged record jumps ahead of the agreed watermark."""
+    from metrics_tpu.serving import shard_for_key
+
+    fleet = MetricFleet(_factory, num_shards=2, agreement=True)
+    try:
+        keys = {shard_for_key(f"t{i}", 2): f"t{i}" for i in range(16)}
+        fast, slow = keys[0], keys[1]
+        preds = jnp.asarray(np.float32([0.9, 0.8]))
+        target = jnp.asarray(np.int32([1, 1]))
+        fleet.submit(slow, preds, target, event_time=np.array([1.0, 4.0]))
+        fleet.submit(fast, preds, target, event_time=np.array([2.0, 15.0]))
+        fleet.submit(fast, preds, target, event_time=np.array([92.0, 95.0]))
+        fleet.flush(10)
+        # the fast shard's local clock passed window 0's close long ago (its
+        # ring pressure even banked window 0's partial), but the agreed
+        # clock (min with the slow shard's 4.0) holds every MERGED record
+        assert fleet.merged_records == []
+        fleet.submit(slow, preds, target, event_time=np.array([90.0, 96.0]))
+        fleet.flush(10)
+        merged = [r["window"] for r in fleet.merged_records]
+        assert merged and merged == sorted(merged)
+        assert 0 in merged  # both shards' window-0 partials folded
+        by_window = {r["window"]: r for r in fleet.merged_records}
+        assert float(by_window[0]["rows"]) == 3.0  # t=1, t=4, t=2 across shards
+        assert all(not r["degraded"] for r in fleet.merged_records)
+    finally:
+        fleet.stop(10)

@@ -535,3 +535,111 @@ def test_deferred_publish_degrades_and_stamps_span_under_drop():
     finally:
         obs.disable()
     assert spans and all(s.attrs["degraded"] == "yes" for s in spans)
+
+
+# --------------------------------------------------- cross-rank agreed clock
+def _agreed_pair(deadline_s=30.0, guard_deadline_s=1.0, lateness=10.0):
+    from metrics_tpu import WatermarkAgreement
+    from metrics_tpu.parallel.sync import SyncGuard, gather_all_arrays
+
+    agreement = WatermarkAgreement(deadline_s=deadline_s)
+    guard = SyncGuard(deadline_s=guard_deadline_s, max_retries=1,
+                      backoff_s=0.02, policy="degrade")
+
+    def build(rank):
+        metric = Windowed(
+            Accuracy(), window_s=10.0, num_windows=8, allowed_lateness_s=lateness,
+            dist_sync_fn=gather_all_arrays, agreement=agreement, rank=rank,
+        )
+        return MetricService(metric, queue_size=8, guard=guard, fault_rank=rank)
+
+    return agreement, build
+
+
+def test_publish_gates_on_agreed_watermark():
+    """No window publishes before every participating rank's watermark
+    passes it: a rank far ahead publishes nothing while its peer lags, then
+    everything the agreed clock closed once the peer catches up."""
+    agreement, build = _agreed_pair()
+    fast, slow = build(0), build(1)
+    try:
+        preds = jnp.asarray(np.float32([0.9, 0.8]))
+        target = jnp.asarray(np.int32([1, 1]))
+        fast.submit(preds, target, event_time=np.array([5.0, 55.0]), seq=0)
+        fast.flush(10)
+        # the fast rank's LOCAL clock closed windows 0..2, but the peer has
+        # not spoken: the agreement holds every window open (and the t=5.0
+        # event ROUTES into window 0 — nothing is late before agreement)
+        assert fast.publications == []
+        assert np.asarray(fast.metric._current_state()["windowed_rows"])[0] == 1.0
+        slow.submit(preds, target, event_time=np.array([3.0, 52.0]), seq=0)
+        slow.flush(10)
+        fast.submit(preds, target, event_time=np.array([56.0, 57.0]), seq=1)
+        fast.flush(10)
+        # agreed = min(57, 52) = 52: windows with end + lateness <= 52 are
+        # closed -> windows 0..3; the fast rank's resident ring starts at
+        # its origin 0, so it publishes 0..3 (1 and 2 as empty windows)
+        assert agreement.agreed() == 52.0
+        assert [p["window"] for p in fast.publications] == [0, 1, 2, 3]
+        assert fast.publications[0]["degraded"] is False
+        assert fast.publications[0]["agreed_watermark"] == 52.0
+        assert float(fast.publications[0]["value"]) == 1.0
+    finally:
+        fast.stop(10)
+        slow.stop(10)
+
+
+def test_finalize_under_guard_deadline_with_stalled_peer():
+    """The shutdown satellite: a stalled peer (or a dead watermark exchange)
+    must not hang finalize/stop — the force-publish waits at most the guard
+    deadline, then degrades to local-only publish with degraded=True (and
+    the agreement's own deadline marks the straggler)."""
+    import metrics_tpu.observability as obs
+
+    agreement, build = _agreed_pair(deadline_s=0.6, guard_deadline_s=0.8)
+    agreement.register("stalled-peer")  # a rank that never reports
+    service = build(0)
+    before = obs.COUNTERS.wm_stragglers
+    try:
+        service.submit(jnp.asarray(np.float32([0.9, 0.8])), jnp.asarray(np.int32([1, 1])),
+                       event_time=np.array([5.0, 25.0]), seq=0)
+        start = time.monotonic()
+        merged = service.finalize(10.0)
+        elapsed = time.monotonic() - start
+        # bounded: the wait is the guard deadline, not the 10s budget (and
+        # certainly not forever — the pre-fix failure mode)
+        assert elapsed < 5.0
+        assert [p["window"] for p in service.publications] == [0, 1, 2]
+        assert all(p["degraded"] for p in service.publications)
+        assert float(merged) == 1.0
+        assert obs.COUNTERS.wm_stragglers - before >= 1
+        assert "stalled-peer" in [str(r) for r in agreement.excluded()]
+    finally:
+        service.stop(10)
+
+
+def test_clock_skew_addressable_per_rank():
+    """FaultSpec(rank=) addresses one rank of a multi-rank stream: only the
+    skewed rank's event times shift."""
+    from metrics_tpu.parallel import faults
+
+    agreement, build = _agreed_pair()
+    schedule = [
+        faults.FaultSpec(kind="clock_skew", rank=1, rate=1.0, times=10**6,
+                         skew_s=30.0, site="service.ingest"),
+    ]
+    with faults.ChaosInjector(schedule, seed=0) as injector:
+        honest, skewed = build(0), build(1)
+        try:
+            preds = jnp.asarray(np.float32([0.9]))
+            target = jnp.asarray(np.int32([1]))
+            honest.submit(preds, target, event_time=np.array([5.0]), seq=0)
+            skewed.submit(preds, target, event_time=np.array([5.0]), seq=0)
+            honest.flush(10)
+            skewed.flush(10)
+            assert honest.metric.watermark == 5.0
+            assert skewed.metric.watermark == 35.0
+            assert injector.injected["clock_skew"] == 1
+        finally:
+            honest.stop(10)
+            skewed.stop(10)

@@ -484,3 +484,208 @@ def test_windowed_keyed_misrouted_slot_ids_are_counted():
         assert wk.dropped_samples == 0  # late-event accounting stays separate
     finally:
         obs.reset()
+
+
+# --------------------------------------------------------- sliding windows
+def test_sliding_route_overlap_rows():
+    """slide_s < window_s: each event's NEWEST covering window rides
+    slot_ids, the older coverings ride overlap_slots, and every row is
+    judged independently by the open rule."""
+    spec = WindowSpec(6.0, 6, 0.0, 2.0)
+    assert spec.stride == 2.0 and spec.overlap == 3
+    r = route_events([7.0], None, None, spec)
+    # t=7 covers windows 3 ([6,12)), 2 ([4,10)), 1 ([2,8))
+    assert list(r.slot_ids) == [3]
+    assert [list(row) for row in r.overlap_slots] == [[2], [1]]
+    assert r.min_window == 1 and r.head == 3
+    # a late event whose older covering windows already closed still lands
+    # in every covering window that is open: wm=13, window 3 open until
+    # 6+6=12 <= 13 -> closed; windows 4,5 open
+    r2 = route_events([11.0], r.watermark, r.head, spec)
+    r3 = route_events([11.0], 13.0, 6, spec)
+    assert list(r3.slot_ids) == [5 % 6]
+    assert [list(row) for row in r3.overlap_slots] == [[4], [-1]]
+    assert r3.n_dropped == 0
+    del r2
+
+
+def test_sliding_spec_validation():
+    with pytest.raises(ValueError, match="slide_s"):
+        WindowSpec(6.0, 6, 0.0, 7.0).validate()  # slide > window
+    with pytest.raises(ValueError, match="integer multiple"):
+        WindowSpec(6.0, 6, 0.0, 2.5).validate()
+    with pytest.raises(ValueError, match="collide in the ring"):
+        WindowSpec(6.0, 2, 0.0, 2.0).validate()  # W < overlap
+    with pytest.raises(ValueError, match="still-open horizon"):
+        # cap: W*slide - window = 12 - 6 = 6
+        WindowSpec(6.0, 6, 6.5, 2.0).validate()
+    with pytest.raises(ValueError, match="decay accumulator"):
+        Windowed(Accuracy(), decay_half_life_s=5.0, slide_s=2.0)
+
+
+def test_sliding_windows_bitexact_vs_per_window_oracles():
+    """Every resident sliding window's value equals a fresh unwindowed
+    metric over exactly the events in its [w*slide, w*slide + window)
+    span — each event counted once per covering window, never more."""
+    rng = np.random.RandomState(5)
+    n = 64
+    times = np.sort(rng.uniform(0.0, 16.0, n))
+    preds = rng.rand(n).astype(np.float32)
+    target = rng.randint(0, 2, n).astype(np.int32)
+    # ring sized for the full span INCLUDING the pre-origin coverings: the
+    # stream's covering windows run -2..7 (10 distinct windows), so W=12
+    # keeps them all resident for the conservation check
+    m = Windowed(Accuracy(), window_s=6.0, num_windows=12, slide_s=2.0,
+                 allowed_lateness_s=12.0)
+    for i in range(0, n, 8):
+        m.update(jnp.asarray(preds[i:i + 8]), jnp.asarray(target[i:i + 8]),
+                 event_time=times[i:i + 8])
+    assert m.dropped_samples == 0
+    for w in m.resident_windows():
+        lo = m.window_start(w)
+        mask = (times >= lo) & (times < lo + 6.0)
+        if not mask.any():
+            continue
+        fresh = Accuracy()
+        fresh.update(jnp.asarray(preds[mask]), jnp.asarray(target[mask]))
+        np.testing.assert_array_equal(
+            np.asarray(m.compute_window(w)), np.asarray(fresh.compute()),
+            err_msg=f"window {w}",
+        )
+    # rows conservation: every event lives in exactly overlap=3 windows
+    # (minus coverings before the stream origin, which were open — negative
+    # windows are real windows here)
+    rows = np.asarray(m._current_state()["windowed_rows"])
+    assert rows.sum() == n * 3
+
+
+def test_sliding_compute_is_head_window():
+    """Overlapping slots must not be summed (an event lives in several);
+    compute() is the head window — the sliding view of the last window_s."""
+    m = Windowed(Accuracy(), window_s=4.0, num_windows=4, slide_s=2.0)
+    m.update(jnp.asarray(np.float32([0.9, 0.1])), jnp.asarray(np.int32([1, 1])),
+             event_time=np.array([1.0, 5.0]))
+    # head = floor(5/2) = 2, spanning [4, 8): only the t=5 event (pred 0.1
+    # vs target 1 -> wrong -> accuracy 0)
+    assert m.head_window == 2
+    np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(m.compute_window(2)))
+    assert float(m.compute_window(2)) == 0.0
+
+
+# ------------------------------------------------- cross-rank agreed clock
+def _two_ranks(**kw):
+    from metrics_tpu import WatermarkAgreement
+
+    args = dict(window_s=10.0, num_windows=4, allowed_lateness_s=10.0)
+    args.update(kw)
+    ag = WatermarkAgreement(deadline_s=30.0)
+    a = Windowed(Accuracy(), **args, agreement=ag, rank=0)
+    b = Windowed(Accuracy(), **args, agreement=ag, rank=1)
+    return ag, a, b
+
+
+def test_agreed_clock_keeps_peer_fed_windows_open():
+    """The coherence headline: a rank whose LOCAL clock ran ahead judges
+    lateness by the AGREED (global-min) clock, so an event its local clock
+    would have dropped still routes — 'late' means the same on every rank.
+    (The ring is sized for the skew: window 0 must still be RESIDENT on the
+    fast rank — an agreement-open window that fell off the local ring is
+    dropped-and-counted, never misrouted.)"""
+    ag, fast, slow = _two_ranks(num_windows=8)
+    fast.update(jnp.asarray(np.float32([0.9])), jnp.asarray(np.int32([1])),
+                event_time=[45.0])
+    slow.update(jnp.asarray(np.float32([0.8])), jnp.asarray(np.int32([1])),
+                event_time=[12.0])
+    assert ag.agreed() == 12.0
+    # window 0 by the fast rank's local clock: 10+10 <= 45 -> closed; by the
+    # agreed clock: 20 > 12 -> open. The event must route, not drop.
+    fast.update(jnp.asarray(np.float32([0.7])), jnp.asarray(np.int32([1])),
+                event_time=[5.0])
+    assert fast.dropped_samples == 0
+    rows = np.asarray(fast._current_state()["windowed_rows"])
+    assert rows[0] == 1.0
+    # without an agreement the same stream drops it
+    lone = _ring(window_s=10.0, num_windows=8, allowed_lateness_s=10.0)
+    lone.update(jnp.asarray(np.float32([0.9])), jnp.asarray(np.int32([1])),
+                event_time=[45.0])
+    lone.update(jnp.asarray(np.float32([0.7])), jnp.asarray(np.int32([1])),
+                event_time=[5.0])
+    assert lone.dropped_samples == 1
+
+
+def test_close_watermark_is_agreed_and_monotone():
+    ag, a, b = _two_ranks()
+    assert a.close_watermark is None  # no agreement formed yet
+    a.update(jnp.asarray(np.float32([0.9])), jnp.asarray(np.int32([1])),
+             event_time=[30.0])
+    assert a.close_watermark is None  # b registered, silent: still held open
+    b.update(jnp.asarray(np.float32([0.9])), jnp.asarray(np.int32([1])),
+             event_time=[8.0])
+    assert a.close_watermark == 8.0 and b.close_watermark == 8.0
+    b.update(jnp.asarray(np.float32([0.9])), jnp.asarray(np.int32([1])),
+             event_time=[25.0])
+    assert a.close_watermark == 25.0
+    # a lone metric's close clock stays its local watermark
+    lone = _ring()
+    lone.update(jnp.asarray(np.float32([0.9])), jnp.asarray(np.int32([1])),
+                event_time=[7.0])
+    assert lone.close_watermark == 7.0
+
+
+def test_agreement_snapshot_restore_round_trip():
+    """The restore satellite: a restored rank rejoins carrying the AGREED
+    watermark — it must not regress the global min, must not reopen a
+    window the agreed clock closed, and replay through guarded_update must
+    not double-count."""
+    from metrics_tpu import WatermarkAgreement
+
+    ag, a, b = _two_ranks()
+    preds = jnp.asarray(np.float32([0.9, 0.2]))
+    target = jnp.asarray(np.int32([1, 0]))
+    a.guarded_update(0, preds, target, event_time=np.array([12.0, 15.0]))
+    b.guarded_update(0, preds, target, event_time=np.array([33.0, 35.0]))
+    agreed_before = ag.agreed()
+    assert agreed_before == 15.0
+    snap = a.state_dict()
+
+    # the restored rank joins a FRESH agreement (the registry never pickles)
+    ag2 = WatermarkAgreement(deadline_s=30.0)
+    restored = Windowed(Accuracy(), window_s=10.0, num_windows=4,
+                        allowed_lateness_s=10.0, agreement=ag2, rank=0)
+    peer = Windowed(Accuracy(), window_s=10.0, num_windows=4,
+                    allowed_lateness_s=10.0, agreement=ag2, rank=1)
+    peer.update(preds, target, event_time=np.array([33.0, 35.0]))
+    restored.load_state_dict(snap)
+    # the restore reported the checkpointed local watermark: the agreement
+    # re-forms at the same global min, never lower
+    assert ag2.agreed() == 15.0
+    assert restored.agreed_watermark == 15.0
+    assert restored.close_watermark == 15.0
+    # replaying the in-flight step is a no-op (no double count)...
+    assert restored.guarded_update(0, preds, target,
+                                   event_time=np.array([12.0, 15.0])) is False
+    rows = np.asarray(restored._current_state()["windowed_rows"])
+    assert rows.sum() == 2.0
+    # ...and a fresh step advances normally
+    assert restored.guarded_update(1, preds, target,
+                                   event_time=np.array([18.0, 21.0])) is True
+    assert ag2.agreed() == 21.0
+
+
+def test_agreement_deepcopy_shares_pickle_drops():
+    """A deep-copied participant (the service's shadow twin) keeps talking
+    to the SAME registry; a pickled one drops it and re-attaches."""
+    import pickle
+    from copy import deepcopy
+
+    ag, a, _b = _two_ranks()
+    twin = deepcopy(a)
+    assert twin.agreement is ag
+    a.update(jnp.asarray(np.float32([0.9])), jnp.asarray(np.int32([1])),
+             event_time=[9.0])
+    blob = pickle.dumps(a)
+    revived = pickle.loads(blob)
+    assert revived.agreement is None
+    assert revived.watermark == 9.0
+    with pytest.raises(TypeError, match="cannot be pickled"):
+        pickle.dumps(ag)
