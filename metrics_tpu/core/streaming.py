@@ -222,7 +222,10 @@ class RouteResult(NamedTuple):
     ``opened``: window indices newly opened by this batch, oldest first —
     their ring slots hold expired windows and must be reset BEFORE the
     scatter. ``n_dropped``/``n_late``: fully-dropped events (no covering
-    window accepted) vs accepted-but-late events. ``min_window``: the oldest
+    window accepted) vs accepted-but-late ROUTINGS — (event, window) pairs
+    whose window span had already ended by the judging clock (the agreed
+    watermark when one governs the stream), summed across the newest and
+    overlap rows. ``min_window``: the oldest
     window this batch accepted an event into (``None`` if every event
     dropped) — the wrapper's stream-origin bookkeeping, so windows before
     the first event are never reported as resident. ``overlap_slots``: for
@@ -296,21 +299,30 @@ def route_events(
         open_ = cover * stride + spec.window_s + spec.allowed_lateness_s > judge_wm
         return open_ & (cover > new_head - spec.num_windows)
 
+    def late(cover: np.ndarray, ok: np.ndarray) -> int:
+        # a late routing: an accepted (event, window) pair whose window span
+        # had already ended by the JUDGING clock — the same clock the open
+        # verdict used, so "late" means the same thing on every rank under
+        # an agreement (and nothing is late before one forms: pre-agreement
+        # judge_wm is -inf, no span has ended yet)
+        return int((ok & (cover * stride + spec.window_s <= judge_wm)).sum())
+
     accepted = verdict(w)
     slot_ids = np.where(accepted, w % spec.num_windows, -1).astype(np.int32)
     any_accepted = accepted
     min_w = w[accepted].min() if accepted.any() else None
+    n_late = late(w, accepted)
     overlap_rows = []
     for j in range(1, spec.overlap):
         cover = w - j
         ok = verdict(cover)
         overlap_rows.append(np.where(ok, cover % spec.num_windows, -1).astype(np.int32))
         any_accepted = any_accepted | ok
+        n_late += late(cover, ok)
         if ok.any():
             older = cover[ok].min()
             min_w = older if min_w is None else min(min_w, older)
     n_dropped = int((~any_accepted).sum())
-    n_late = int((accepted & (w < new_head)).sum())
     min_window = None if min_w is None else int(min_w)
     if head is None or head < new_head - spec.num_windows:
         # first batch, or a jump past the whole ring: every slot the new
@@ -368,7 +380,10 @@ class WatermarkAgreement:
     ``degraded=True``, and window closing proceeds on the surviving ranks'
     clocks. A rank that reports an ADVANCING watermark again rejoins
     automatically (its fresh value re-enters the min — which cannot regress
-    the agreed high-water). Policy ``"raise"`` throws
+    the agreed high-water), and so does a rank that RE-REGISTERS — a
+    recovered participant re-attaching under its old rank rejoins even
+    though its restored report equals the pre-crash value. Policy
+    ``"raise"`` throws
     :class:`~metrics_tpu.utils.exceptions.SyncTimeoutError` from
     ``agreed()`` instead, for callers that prefer failing loudly over
     publishing degraded values.
@@ -428,11 +443,24 @@ class WatermarkAgreement:
         """Declare a participant before its first report. A registered rank
         with no watermark yet HOLDS the agreement open (``agreed()`` stays at
         its last value) until it reports or stalls past the deadline — the
-        "window held open by a peer that has not spoken yet" case."""
+        "window held open by a peer that has not spoken yet" case.
+
+        Re-registering an EXISTING rank (a recovered shard re-attaching
+        under its old rank) is a liveness signal: the deadline stamp
+        refreshes and any straggler exclusion lifts immediately. The
+        restored report typically EQUALS the pre-crash watermark —
+        ``report`` alone would not treat it as an advance, and the
+        recovered-and-healthy rank would otherwise stay excluded until a
+        strictly newer event arrives (forever, on an ended stream)."""
         with self._lock:
-            self._ranks.setdefault(
-                rank, {"wm": None, "stamp": time.monotonic()}
-            )
+            entry = self._ranks.get(rank)
+            if entry is None:
+                self._ranks[rank] = {"wm": None, "stamp": time.monotonic()}
+                return
+            entry["stamp"] = time.monotonic()
+            if rank in self._excluded:
+                self._excluded.discard(rank)
+                self._note_gauge_locked()
 
     def report(self, rank: Any, watermark: float) -> None:
         """Fold one rank's local running-max watermark into the registry

@@ -225,8 +225,12 @@ class MetricFleet:
         metric = self._factory()
         if self.agreement is not None:
             # the shard joins the fleet clock as rank=index; a RECOVERED
-            # shard re-attaches here under the same rank, and its restored
-            # report is monotone — replay can never regress the agreed min
+            # shard re-attaches here under the same rank — re-registration
+            # is a liveness signal (the stamp refreshes and any straggler
+            # exclusion lifts, since the restored report EQUALS the
+            # pre-crash watermark and would not count as an advance) — and
+            # its restored report is monotone, so replay can never regress
+            # the agreed min
             metric.attach_agreement(self.agreement, rank=index)
         return MetricService(
             metric,
@@ -308,11 +312,14 @@ class MetricFleet:
         ``w`` without publishing ``w`` had no resident samples there — its
         contribution is the empty partial). With a fleet
         :class:`WatermarkAgreement`, shards IT has excluded as stragglers do
-        not hold the frontier — the merge proceeds on the surviving shards'
-        clocks with the record stamped ``degraded=True`` (the agreement's
-        deadline already bumped ``wm_stragglers``), so one stalled shard can
-        never deadlock the merge tier. ``force`` (finalize) emits through
-        the highest window any shard published."""
+        not hold the frontier: a window the excluded shard never closed
+        merges on the surviving shards' clocks stamped ``degraded=True``
+        (the agreement's deadline already bumped ``wm_stragglers``), so one
+        stalled shard can never deadlock the merge tier — while a window
+        EVERY shard (the straggler included, before it stalled) fully closed
+        is coherent and merges undegraded even if it happens to flush during
+        the exclusion episode. ``force`` (finalize) emits through the
+        highest window any shard published."""
         if not self._partials:
             return
         excluded = self._excluded_shards()
@@ -333,7 +340,10 @@ class MetricFleet:
             all_closed = all(
                 c is not None and c >= window for c in self._closed_through
             )
-            self._emit_locked(window, forced=not all_closed, degraded=bool(excluded))
+            self._emit_locked(
+                window, forced=not all_closed,
+                degraded=bool(excluded) and not all_closed,
+            )
 
     def _excluded_shards(self) -> frozenset:
         """Shard indices the fleet agreement currently excludes (always empty
@@ -439,6 +449,11 @@ class MetricFleet:
         shard's watermark never closed them), and return the global merged
         sliding view."""
         deadline = time.monotonic() + timeout_s
+        # drain every shard BEFORE any shard finalizes: each shard's final
+        # watermark is then already reported to the fleet agreement, so a
+        # shard's bounded agreement wait resolves against the true final min
+        # instead of burning the shared budget while its peers still ingest
+        self.flush(timeout_s)
         for service in list(self._shards):
             service.finalize(max(deadline - time.monotonic(), 0.001))
         with self._lock:

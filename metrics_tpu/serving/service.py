@@ -587,19 +587,28 @@ class MetricService:
         # published every window its ingested events closed
         self._drain_publishes(max(deadline - time.monotonic(), 0.001))
 
-    def _await_agreement(self, through: int, timeout_s: float) -> bool:
-        """Bounded wait for the agreed clock to close every window up to
-        ``through`` (no-op without an agreement). Polling ``_closed_through``
-        drives the agreement's straggler scan, so a stalled peer is excluded
-        — and the wait unblocks — once ITS deadline expires. Returns False
-        on timeout: the caller publishes from the local clock and stamps
+    def _await_agreement(self, timeout_s: float) -> bool:
+        """Bounded wait for the agreed clock to catch this rank's LOCAL
+        watermark (no-op without an agreement). Once ``agreed >= watermark``
+        every window the local clock considers closed is closed by the
+        agreement too, so the force-publish below is agreement-ordered.
+        (Waiting for the agreed clock to close the HEAD window can never
+        succeed: the agreed min includes this rank's own watermark, which is
+        inside the head window by definition — still-open windows are what
+        finalize force-publishes.) Polling the agreed clock drives the
+        agreement's straggler scan, so a stalled peer is excluded — and the
+        wait unblocks — once ITS deadline expires. Returns False on timeout:
+        the caller publishes from the local clock and stamps
         ``degraded=True`` instead of hanging shutdown forever."""
         if self.metric.agreement is None:
             return True
+        target = self.metric.watermark
+        if target is None:
+            return True
         deadline = time.monotonic() + max(timeout_s, 0.001)
         while True:
-            closed = self._closed_through()
-            if closed is not None and closed >= through:
+            agreed = self.metric.agreed_watermark  # runs the straggler scan
+            if agreed is not None and agreed >= target:
                 return True
             if time.monotonic() > deadline:
                 return False
@@ -613,11 +622,12 @@ class MetricService:
         The force-publish runs UNDER THE GUARD DEADLINE: with a watermark
         agreement governing the stream, finalize first waits — bounded by
         ``guard.deadline_s`` (never past ``timeout_s``) — for the agreed
-        clock to close the resident windows, so a healthy shutdown publishes
-        agreement-ordered records; when a stalled peer (or a dead exchange)
-        keeps the agreement behind, the wait times out, the remaining
-        windows publish from LOCAL state with ``degraded=True``, and
-        shutdown completes anyway — a sick peer can degrade the last
+        clock to catch this rank's local watermark (its peers' final reports
+        landing, or a straggler's exclusion), so a healthy shutdown
+        publishes agreement-ordered records; when a stalled peer (or a dead
+        exchange) keeps the agreement behind, the wait times out, the
+        remaining windows publish from LOCAL state with ``degraded=True``,
+        and shutdown completes anyway — a sick peer can degrade the last
         publishes, never hang them.
         """
         self.flush(timeout_s)
@@ -625,7 +635,7 @@ class MetricService:
             head = self.metric.head_window
             if head is not None:
                 wait_s = min(timeout_s, self.guard.deadline_s or timeout_s)
-                if not self._await_agreement(head, wait_s):
+                if not self._await_agreement(wait_s):
                     self._wm_force_degraded = True
                 try:
                     self._publish_closed(force_through=head)

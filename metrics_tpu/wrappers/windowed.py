@@ -111,9 +111,11 @@ class Windowed(Metric):
         slide_s: SLIDING windows — a new window opens every ``slide_s``
             seconds, each spanning ``window_s`` (must divide it evenly), so
             every event scatters into ``window_s/slide_s`` overlapping ring
-            slots. ``compute()`` then returns the head window (the sliding
-            view of the last ``window_s`` seconds); per-window reads and
-            publishes are per sliding window. Lateness is capped at
+            slots. ``compute()`` then returns the newest FULL-span window
+            (``head - overlap + 1`` — the trailing ``window_s`` view; the
+            head window has only accumulated the newest ``slide_s``
+            seconds); per-window reads and publishes are per sliding
+            window. Lateness is capped at
             ``num_windows*slide_s - window_s``.
         agreement / rank: join a cross-rank
             :class:`~metrics_tpu.core.streaming.WatermarkAgreement` as
@@ -588,13 +590,18 @@ class Windowed(Metric):
 
         With ``slide_s`` set the resident windows OVERLAP (each event lives
         in ``window_s/slide_s`` of them), so a sum over slots would
-        multi-count; the head window already IS the sliding view of the last
-        ``window_s`` seconds, and ``compute()`` returns it.
+        multi-count; ``compute()`` instead returns the newest window whose
+        FULL ``window_s`` span has opened — window ``head - overlap + 1``,
+        spanning the ``window_s`` seconds ending at ``(head+1)*slide_s``,
+        the trailing sliding view. (The head window itself extends past the
+        watermark: it has only accumulated the newest ``slide_s`` seconds
+        and reads near-empty right after a slide boundary.)
         """
         if self.slide_s is not None:
             resident = self.resident_windows()
             if resident:
-                return self.compute_window(resident[-1])
+                view = max(self._head - self._spec.overlap + 1, resident[0])
+                return self.compute_window(view)
         state = self._current_state()
         rows = state.pop(_ROWS_STATE)
         inner_state: State = {}

@@ -448,6 +448,57 @@ def test_fleet_agreement_excludes_stalled_shard_and_merges_degraded():
         fleet.stop(10)
 
 
+def test_fleet_exclusion_stamps_only_windows_the_straggler_never_closed():
+    """The degraded stamp is per-window, from the verdict actually used: a
+    window EVERY shard fully closed before one stalled is coherent and
+    merges undegraded even while the exclusion episode is live; only the
+    windows the straggler never closed merge degraded on the survivors'
+    clocks."""
+    from metrics_tpu.parallel.sync import SyncGuard
+    from metrics_tpu.serving import shard_for_key
+
+    guard = SyncGuard(deadline_s=0.6, max_retries=1, backoff_s=0.02, policy="degrade")
+    fleet = MetricFleet(_factory, num_shards=2, guard=guard, agreement=True)
+    try:
+        keys = {shard_for_key(f"t{i}", 2): f"t{i}" for i in range(16)}
+        live, dying = keys[0], keys[1]
+        preds = jnp.asarray(np.float32([0.9, 0.8]))
+        target = jnp.asarray(np.int32([1, 1]))
+        # phase 1 — both shards healthy: window-0 events land while every
+        # clock is still inside window 0, then both clocks advance past its
+        # close point (0 + W + LATE = 30) while staying < 40 so window 0 is
+        # still RESIDENT in the 4-slot ring; the flush between rounds
+        # barriers the reports, and the third round lets whichever shard
+        # evaluated first re-evaluate the close and publish too
+        fleet.submit(dying, preds, target, event_time=np.array([2.0, 5.0]))
+        fleet.submit(live, preds, target, event_time=np.array([1.0, 6.0]))
+        fleet.flush(10)
+        fleet.submit(dying, preds, target, event_time=np.array([31.0, 33.0]))
+        fleet.submit(live, preds, target, event_time=np.array([32.0, 35.0]))
+        fleet.flush(10)
+        fleet.submit(dying, preds, target, event_time=np.array([34.0, 36.0]))
+        fleet.submit(live, preds, target, event_time=np.array([36.0, 38.0]))
+        fleet.flush(10)
+        by_window = {r["window"]: r for r in fleet.merged_records}
+        assert 0 in by_window and by_window[0]["degraded"] is False
+        # phase 2 — the dying shard goes silent; the live shard streams
+        # past the deadline, the agreement excludes the straggler, and the
+        # frontier proceeds degraded on the survivor's clock
+        for r in range(8):
+            fleet.submit(live, preds, target,
+                         event_time=np.array([50.0 + r * 10.0, 55.0 + r * 10.0]))
+            fleet.flush(10)
+            time.sleep(0.12)
+        later = [r for r in fleet.merged_records if r["window"] >= 1]
+        assert later, "the stalled shard wedged the merge frontier"
+        assert all(r["degraded"] for r in later)
+        # the already-coherent window 0 record was emitted before the stall
+        # and stays undegraded
+        assert {r["window"]: r for r in fleet.merged_records}[0]["degraded"] is False
+    finally:
+        fleet.stop(10)
+
+
 def test_fleet_agreement_gates_merge_on_slowest_shard():
     """Before the deadline, the agreed clock holds the merge frontier at the
     slowest healthy shard — a fast shard's publishes bank partials but no

@@ -618,6 +618,40 @@ def test_finalize_under_guard_deadline_with_stalled_peer():
         service.stop(10)
 
 
+def test_finalize_healthy_agreement_publishes_undegraded():
+    """A HEALTHY shutdown under an agreement: once every rank's final
+    report is in, finalize's bounded wait succeeds (the agreed clock
+    catches each rank's local watermark — it can never close the HEAD
+    window, which is exactly what finalize force-publishes) — records
+    publish agreement-ordered with NO degraded stamp and no guard-deadline
+    burn."""
+    agreement, build = _agreed_pair(guard_deadline_s=5.0)
+    a, b = build(0), build(1)
+    preds = jnp.asarray(np.float32([0.9, 0.8]))
+    target = jnp.asarray(np.int32([1, 1]))
+    try:
+        a.submit(preds, target, event_time=np.array([5.0, 55.0]), seq=0)
+        b.submit(preds, target, event_time=np.array([3.0, 55.0]), seq=0)
+        a.flush(10)
+        b.flush(10)
+        start = time.monotonic()
+        a.finalize(10.0)
+        b.finalize(10.0)
+        elapsed = time.monotonic() - start
+        # the pre-fix failure: waiting for the agreed clock to CLOSE the
+        # head window can never succeed, so every healthy finalize burned
+        # the whole guard deadline and stamped all force-publishes degraded
+        assert elapsed < 4.0
+        assert a.publications and b.publications
+        assert not any(p["degraded"] for p in a.publications + b.publications)
+        assert [p["window"] for p in a.publications] == sorted(
+            {p["window"] for p in a.publications}
+        )
+    finally:
+        a.stop(10)
+        b.stop(10)
+
+
 def test_clock_skew_addressable_per_rank():
     """FaultSpec(rank=) addresses one rank of a multi-rank stream: only the
     skewed rank's event times shift."""

@@ -559,17 +559,30 @@ def test_sliding_windows_bitexact_vs_per_window_oracles():
     assert rows.sum() == n * 3
 
 
-def test_sliding_compute_is_head_window():
+def test_sliding_compute_is_trailing_full_span_window():
     """Overlapping slots must not be summed (an event lives in several);
-    compute() is the head window — the sliding view of the last window_s."""
+    compute() is the newest FULL-span window, head - overlap + 1 — the
+    trailing window_s view ending at (head+1)*slide. The head window itself
+    spans past the watermark and has only accumulated the newest slide_s
+    seconds (near-empty right after a slide boundary)."""
     m = Windowed(Accuracy(), window_s=4.0, num_windows=4, slide_s=2.0)
     m.update(jnp.asarray(np.float32([0.9, 0.1])), jnp.asarray(np.int32([1, 1])),
              event_time=np.array([1.0, 5.0]))
-    # head = floor(5/2) = 2, spanning [4, 8): only the t=5 event (pred 0.1
-    # vs target 1 -> wrong -> accuracy 0)
+    # head = floor(5/2) = 2; the trailing full-span view is window
+    # 2 - 2 + 1 = 1, spanning [2, 6): only the t=5 event (pred 0.1 vs
+    # target 1 -> wrong -> accuracy 0)
     assert m.head_window == 2
+    np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(m.compute_window(1)))
+    assert float(m.compute_window(1)) == 0.0
+    # advance so head and trailing view DIFFER in value: head 3 spans
+    # [6, 10) (only t=6.9, correct -> 1.0) while the trailing view 2 spans
+    # [4, 8) (t=5 wrong + t=6.9 correct -> 0.5) — the last window_s seconds
+    m.update(jnp.asarray(np.float32([0.9])), jnp.asarray(np.int32([1])),
+             event_time=np.array([6.9]))
+    assert m.head_window == 3
+    assert float(m.compute_window(3)) == 1.0
+    assert float(np.asarray(m.compute())) == 0.5
     np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(m.compute_window(2)))
-    assert float(m.compute_window(2)) == 0.0
 
 
 # ------------------------------------------------- cross-rank agreed clock
@@ -689,3 +702,62 @@ def test_agreement_deepcopy_shares_pickle_drops():
     assert revived.watermark == 9.0
     with pytest.raises(TypeError, match="cannot be pickled"):
         pickle.dumps(ag)
+
+
+def test_late_verdicts_judged_by_the_agreed_clock():
+    """'Late' is a pure function of (window, judging clock): an event the
+    LOCAL head left behind is not late while the agreed clock still sits
+    inside its window — and nothing can be late before an agreement forms
+    (judge clock -inf)."""
+    import math
+
+    spec = WindowSpec(10.0, 8, 10.0)
+    r = route_events([45.0], None, None, spec)
+    assert r.head == 4
+    # agreed clock at 8: window 0's span ([0, 10)) has not ended yet ->
+    # accepted and NOT late (the local head alone would call it late)
+    r2 = route_events([5.0], r.watermark, r.head, spec, agreed=8.0)
+    assert list(r2.slot_ids) == [0] and r2.n_late == 0
+    # agreed clock at 12: window 0 ended (10 <= 12) but is within the
+    # lateness -> accepted AND late, on every rank that sees agreed=12
+    r3 = route_events([5.0], r.watermark, r.head, spec, agreed=12.0)
+    assert list(r3.slot_ids) == [0] and r3.n_late == 1
+    # pre-agreement: no window has closed yet, so nothing can be late
+    r4 = route_events([5.0], r.watermark, r.head, spec, agreed=-math.inf)
+    assert list(r4.slot_ids) == [0] and r4.n_late == 0
+
+
+def test_reregistration_lifts_straggler_exclusion():
+    """The recovered-shard rejoin: a rank excluded as a straggler whose
+    restored report EQUALS its pre-crash watermark (replay advances
+    nothing) rejoins by RE-REGISTERING under its old rank — without it the
+    recovered-and-healthy rank would stay excluded until a strictly newer
+    event arrived, forever on an ended stream."""
+    import time as _time
+
+    from metrics_tpu import WatermarkAgreement
+
+    ag = WatermarkAgreement(deadline_s=0.2)
+    ag.register(0)
+    ag.register(1)
+    ag.report(0, 50.0)
+    ag.report(1, 10.0)
+    assert ag.agreed() == 10.0
+    _time.sleep(0.3)
+    ag.report(0, 55.0)  # rank 0 stays live; rank 1 crosses its deadline
+    assert ag.agreed() == 55.0
+    assert ag.excluded() == (1,) and ag.degraded
+    assert ag.stragglers == 1
+    # an equal-value report alone is NOT an advance and must not rejoin a
+    # rank whose clock genuinely stalled (that would re-wedge the frontier)
+    ag.report(1, 10.0)
+    assert ag.excluded() == (1,)
+    # ...but re-registration (recover_shard -> attach_agreement) is a
+    # liveness signal: the exclusion lifts and the stamp refreshes, while
+    # the agreed high-water never regresses below 55
+    ag.register(1)
+    assert ag.excluded() == ()
+    assert not ag.degraded
+    assert ag.agreed() == 55.0
+    ag.report(1, 10.0)  # still within the refreshed deadline: included
+    assert ag.excluded() == ()
