@@ -68,6 +68,15 @@ rate=1.0 stalled rank must be excluded after the agreement deadline
 sliding windows (``slide_s < window_s``) must be bit-exact vs independent
 per-slot oracles.
 
+``--check-quantile`` is the quantile-sketch gate: every quantile estimate on
+seeded Zipfian/Cauchy/lognormal streams must land within the ``alpha``
+relative-error certificate (overflow-bucket hits flagged ``inf``), the
+(4,2)-mesh psum merge of per-device sketches must equal the single-process
+sketch bit-exactly, ``Keyed(Quantile)`` / ``Windowed(Keyed(Quantile))`` must
+stage the identical collective program as the unkeyed scalar metric
+(psum-only, zero gathers), and qsketch state bytes must stay constant over
+the stream while the capacity-buffer twin grows.
+
 ``--trace OUT.json`` (composable with ``--smoke``) enables the observability
 subsystem around the A/B: the JSON line grows a ``phase_ms`` span-aggregate
 table, and OUT.json gets a Chrome-trace/Perfetto file of the bench phases
@@ -211,6 +220,21 @@ SLIDE_WINDOWS = 6
 SLIDE_LATENESS_S = 4.0
 SLIDE_BATCHES = 10
 SLIDE_BATCH = 8
+# quantile-sketch scenario/gate (parallel/qsketch.py + bench.py
+# --check-quantile): Keyed(Quantile(q=0.99)) x QSK_SLOTS tenants — the
+# per-tenant p99 state — synced on the (4,2) mesh. The grid below is the
+# bench-sized twin of the defaults: alpha=0.05 over 6 decades gives
+# B = 2*139 + 3 = 281 buckets, so the keyed slab pair is
+# (QSK_SLOTS * 281 + QSK_SLOTS) int32 cells. Pinned properties: staged
+# collective count identical to the unkeyed scalar Quantile (psum-only,
+# zero gathers), every estimate within the alpha certificate on the seeded
+# Zipfian/Cauchy/lognormal gate streams, (4,2) psum merge bit-exact vs
+# single-process, and state bytes FLAT while a capacity-buffer twin grows.
+QSK_ALPHA = 0.05
+QSK_LO = 1e-3
+QSK_HI = 1e3
+QSK_SLOTS = 256
+QSK_GATE_N = 20_000  # samples per seeded gate stream
 
 
 def _collection_ours(compute_groups: bool = True):
@@ -552,6 +576,88 @@ def _build_keyed_sync_runner(num_slots: "int | None" = KEYED_SLOTS):
         return (time.perf_counter() - start) / steps * 1e3
 
     return run, len(state)
+
+
+def _build_qsketch_sync_runner(num_slots: "int | None" = QSK_SLOTS):
+    """(timed_run(steps) -> ms/step, states_synced) for the QUANTILE-SKETCH
+    scenario: ``Keyed(Quantile(q=0.99), num_slots=K)`` — the per-tenant p99
+    state — synced per step with ``coalesced_sync_state`` on the (4,2)
+    ici x dcn mesh. The slab leaves (a (K, B) log-bucketed counts slab + the
+    (K,) row-count slab) fold into ONE int32 sum bucket, so the staged
+    program is the same two-stage psum the unkeyed scalar Quantile stages:
+    collective counts are K-INDEPENDENT (``num_slots=None`` builds the
+    unkeyed twin the parity pin compares against).
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from metrics_tpu import Keyed, Quantile
+    from metrics_tpu.parallel.placement import MeshHierarchy
+    from metrics_tpu.parallel.sync import coalesced_sync_state
+    from metrics_tpu.utils.compat import shard_map
+
+    inner = Quantile(q=0.99, alpha=QSK_ALPHA, min_value=QSK_LO, max_value=QSK_HI)
+    metric = inner if num_slots is None else Keyed(inner, num_slots=num_slots)
+    rng = np.random.RandomState(0)
+    rows = GATHER_CAPACITY // 2  # same per-step traffic shape as the sketch A/B
+    values = jnp.asarray(rng.lognormal(0.0, 1.5, rows).astype(np.float32))
+    if num_slots is None:
+        metric.update(values)
+    else:
+        slots = jnp.asarray(rng.randint(0, num_slots, rows).astype(np.int32))
+        metric.update(values, slot=slots)
+
+    state = metric._current_state()
+    reductions = metric._reductions
+    mesh = Mesh(
+        np.array(jax.devices("cpu")[:N_DEVICES]).reshape(HIER_SLICES, N_DEVICES // HIER_SLICES),
+        ("dcn", "ici"),
+    )
+    axis = MeshHierarchy(ici_axis="ici", dcn_axis="dcn")
+
+    def step(s, acc):
+        synced = coalesced_sync_state(s, reductions, axis)
+        for leaf in jax.tree_util.tree_leaves(synced):
+            acc = acc + jnp.sum(leaf.astype(jnp.float32))
+        return acc
+
+    sharded_step = jax.jit(
+        shard_map(step, mesh=mesh, in_specs=(P(), P()), out_specs=P(), check_vma=False)
+    )
+
+    def run(steps: int) -> float:
+        acc = jnp.zeros((), jnp.float32)
+        start = time.perf_counter()
+        for _ in range(steps):
+            acc = sharded_step(state, acc)
+        jax.block_until_ready(acc)
+        return (time.perf_counter() - start) / steps * 1e3
+
+    return run, len(state)
+
+
+def _qsketch_state_bytes() -> int:
+    """The keyed per-tenant p99 metric's state bytes — deterministic and
+    traffic-independent by construction ((K*B + K) int32 cells); the
+    default line carries it so --check-trajectory pins any growth."""
+    import jax.numpy as jnp
+
+    from metrics_tpu import Keyed, Quantile
+    from metrics_tpu.observability.counters import state_nbytes
+
+    metric = Keyed(
+        Quantile(q=0.99, alpha=QSK_ALPHA, min_value=QSK_LO, max_value=QSK_HI),
+        num_slots=QSK_SLOTS,
+    )
+    rng = np.random.RandomState(1)
+    metric.update(
+        jnp.asarray(rng.lognormal(0.0, 1.0, 64).astype(np.float32)),
+        slot=jnp.asarray(rng.randint(0, QSK_SLOTS, 64).astype(np.int32)),
+    )
+    return int(state_nbytes(metric._current_state()))
 
 
 def _build_hh_sync_runner():
@@ -1067,6 +1173,21 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
         hh_sps_small, _ = _bench_hh_ingest(HH_KEY_SPACE_SMALL)
         hh_sps_big, hh_big = _bench_hh_ingest(HH_KEY_SPACE)
 
+    # quantile-sketch A/B: Keyed(Quantile(q=0.99)) x 256 tenants vs the
+    # unkeyed scalar Quantile on the same (4,2) mesh — the per-tenant p99
+    # plane; the headline is the keyed/unkeyed staged-count parity and the
+    # deterministic, traffic-independent state-byte pin
+    run_qsk, states_qsk, qsk_counters = build(
+        _build_qsketch_sync_runner, QSK_SLOTS, "qsketch_sync"
+    )
+    _, _, qsk_unkeyed_counters = build(_build_qsketch_sync_runner, None, "qsketch_unkeyed")
+    qsk_times = []
+    for _ in range(repeats):
+        with (obs.span("bench.timed_qsketch_sync") if obs else _null_cm()):
+            qsk_times.append(run_qsk(steps))
+    with (obs.span("bench.qsketch_state_bytes") if obs else _null_cm()):
+        qsk_state_bytes = _qsketch_state_bytes()
+
     # windowed serving A/B: Windowed(AUROC sketch) x 4 window slots vs the
     # unwindowed metric on the same (4,2) mesh — like the keyed gate, the
     # headline is that the STAGED COLLECTIVE COUNT does not move with the
@@ -1223,6 +1344,20 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
         "hh_ingest_steps_per_s": round(hh_sps_big, 3),
         "hh_ingest_steps_per_s_10k": round(hh_sps_small, 3),
         "hh_tail_overcount_bound": round(hh_big.tail_overcount_bound(), 4),
+        # the quantile-sketch plane: per-tenant p99 slots are a state axis —
+        # the staged collective count equals the unkeyed scalar Quantile's
+        # (psum-only, zero gathers) and state bytes are deterministic and
+        # traffic-independent ((K*B + K) int32 cells, pinned exactly)
+        "qsketch_sync_ms": min(qsk_times),
+        "qsketch_states_synced": states_qsk,
+        "qsketch_collective_calls": qsk_counters["collective_calls"],
+        "qsketch_sync_bytes": qsk_counters["sync_bytes"],
+        "qsketch_gather_calls": sum(
+            qsk_counters["calls_by_kind"].get(k, 0)
+            for k in ("all_gather", "coalesced_gather", "process_allgather")
+        ),
+        "qsketch_unkeyed_collective_calls": qsk_unkeyed_counters["collective_calls"],
+        "qsketch_state_bytes": qsk_state_bytes,
         # the windowed serving plane: window slots are a leading state axis,
         # so the staged program matches the unwindowed metric's (psum-only)
         "service_sync_ms": min(service_times),
@@ -1298,6 +1433,10 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
             finally:
                 devtime_mod.disable()
 
+        # v12: the quantile-sketch plane joined (qsketch_* staged-count keys
+        # pinned to the unkeyed scalar twin, the deterministic
+        # qsketch_state_bytes pin, and qsketch_sync_ms on the default line,
+        # gated by --check-quantile);
         # v11: the rank-coherent streaming plane joined (wm_agreement_ms /
         # wm_exchange_calls / wm_stragglers — zero-pinned on the clean
         # trajectory — and the sliding-window publish count on the default
@@ -1317,13 +1456,14 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
         # block); v6 added the windowed serving A/B; v5 the keyed slab A/B;
         # v4 the sketch A/B; v3 moved the collective counts to the default
         # line and added the hierarchical A/B
-        out["trace_schema"] = 11
+        out["trace_schema"] = 12
         out["counters"] = grouped_counters
         out["gather_counters"] = coal_counters
         out["hier_counters"] = hier_counters
         out["sketch_counters"] = sketch_counters
         out["keyed_counters"] = keyed_counters
         out["hh_counters"] = hh_counters
+        out["qsketch_counters"] = qsk_counters
         out["service_counters"] = service_counters
         out["async_counters"] = async_counters
         summary = obs.summarize()
@@ -1660,6 +1800,13 @@ _TRACE_KEYS = (
     "hh_ingest_steps_per_s",
     "hh_ingest_steps_per_s_10k",
     "hh_tail_overcount_bound",
+    "qsketch_sync_ms",
+    "qsketch_states_synced",
+    "qsketch_collective_calls",
+    "qsketch_sync_bytes",
+    "qsketch_gather_calls",
+    "qsketch_unkeyed_collective_calls",
+    "qsketch_state_bytes",
     "service_sync_ms",
     "service_states_synced",
     "service_collective_calls",
@@ -1697,6 +1844,7 @@ _TRACE_KEYS = (
     "sketch_counters",
     "keyed_counters",
     "hh_counters",
+    "qsketch_counters",
     "service_counters",
     "async_counters",
     "phase_ms",
@@ -3902,6 +4050,249 @@ def check_watermark() -> int:
     return 1 if failures else 0
 
 
+# --check-quantile pins the quantile-sketch contract (parallel/qsketch.py +
+# the Quantile/Percentile/MedianAbsoluteError family):
+#   certificate — every quantile estimate on seeded heavy-tailed/adversarial
+#                 streams (Zipfian, Cauchy, lognormal) lands within the
+#                 alpha relative-error certificate (|est - true| <=
+#                 alpha*|true| + min_value against the selected order
+#                 statistic), with quantile_error_bound reporting alpha
+#   merge       — a real (4,2)-mesh two-stage psum of 8 per-device sketches
+#                 equals the single-process sketch BIT-EXACTLY
+#   parity      — Keyed(Quantile) x QSK_SLOTS and Windowed(Keyed(Quantile))
+#                 stage the IDENTICAL collective count and kinds (psum-only,
+#                 zero gathers) as the unkeyed scalar Quantile
+#   memory      — qsketch state bytes are CONSTANT over the stream while the
+#                 capacity-buffer twin's state grows with every batch
+
+
+def _qsk_gate_streams():
+    """The seeded gate streams: heavy-tailed positive (Zipfian discrete,
+    lognormal) and signed heavy-tailed (Cauchy)."""
+    rng = np.random.RandomState(42)
+    return {
+        "zipfian": rng.zipf(1.5, QSK_GATE_N).astype(np.float64),
+        "cauchy": rng.standard_cauchy(QSK_GATE_N),
+        "lognormal": rng.lognormal(1.0, 2.0, QSK_GATE_N),
+    }
+
+
+def _qsk_check_certificate(failures: list) -> dict:
+    import jax.numpy as jnp
+
+    from metrics_tpu import Quantile
+
+    report = {}
+    qs = (0.5, 0.9, 0.99, 0.999)
+    for name, stream in _qsk_gate_streams().items():
+        m = Quantile(q=list(qs), alpha=QSK_ALPHA, min_value=QSK_LO, max_value=QSK_HI)
+        m.update(jnp.asarray(stream.astype(np.float32)))
+        est = np.asarray(m.compute(), dtype=np.float64)
+        bound = np.asarray(m.error_bound(), dtype=np.float64)
+        s = np.sort(stream)
+        rows = {}
+        for q, e, b in zip(qs, est, bound):
+            r = q * (len(s) - 1)
+            bracket = (s[int(np.floor(r))], s[int(np.ceil(r))])
+            ok = any(
+                abs(e - t) <= QSK_ALPHA * abs(t) + QSK_LO + 3 * QSK_ALPHA**2 * abs(t)
+                for t in bracket
+            )
+            if np.isfinite(b) and abs(b - QSK_ALPHA) > 1e-6:
+                failures.append(
+                    f"certificate: {name} q={q} reported bound {b} != alpha {QSK_ALPHA}"
+                )
+            if np.isfinite(b) and not ok:
+                failures.append(
+                    f"certificate: {name} q={q} estimate {e} outside the alpha"
+                    f" certificate of order stats {bracket}"
+                )
+            rows[str(q)] = {"estimate": float(e), "bound": round(float(b), 6),
+                            "order_stat": float(bracket[0])}
+        report[name] = rows
+    return report
+
+
+def _qsk_check_merge(failures: list) -> dict:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from metrics_tpu.parallel.placement import MeshHierarchy
+    from metrics_tpu.parallel.qsketch import (
+        QuantileSketch, qsketch_init, qsketch_update, quantile_sketch_spec,
+    )
+    from metrics_tpu.parallel.sync import sync_value
+    from metrics_tpu.utils.compat import shard_map
+
+    rng = np.random.RandomState(7)
+    values = rng.lognormal(0.0, 2.0, (N_DEVICES, 512)).astype(np.float32)
+    spec = quantile_sketch_spec(QSK_ALPHA, QSK_LO, QSK_HI)
+    mesh = Mesh(
+        np.array(jax.devices("cpu")[:N_DEVICES]).reshape(HIER_SLICES, N_DEVICES // HIER_SLICES),
+        ("dcn", "ici"),
+    )
+    axis = MeshHierarchy(ici_axis="ici", dcn_axis="dcn")
+
+    def fn(v):
+        local = qsketch_update(qsketch_init(spec).counts, v[0], QSK_ALPHA, QSK_LO, QSK_HI)
+        return sync_value("sum", QuantileSketch(local), axis).counts
+
+    synced = jax.jit(shard_map(
+        fn, mesh=mesh, in_specs=(P(("dcn", "ici")),), out_specs=P(), check_vma=False
+    ))(jnp.asarray(values))
+    single = qsketch_update(
+        qsketch_init(spec).counts, jnp.asarray(values.reshape(-1)), QSK_ALPHA, QSK_LO, QSK_HI
+    )
+    bit_exact = bool(jnp.array_equal(synced, single))
+    if not bit_exact:
+        failures.append("merge: (4,2)-mesh psum of per-device sketches != single-process sketch")
+    return {"bit_exact": bit_exact, "total": int(np.asarray(single).sum())}
+
+
+def _qsk_staged_counts(build_metric) -> dict:
+    """Staged collective counters of one metric's coalesced sync program on
+    the (4,2) mesh (trace-time counting over the compiling call)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from metrics_tpu import observability as obs
+    from metrics_tpu.parallel.placement import MeshHierarchy
+    from metrics_tpu.parallel.sync import coalesced_sync_state
+    from metrics_tpu.utils.compat import shard_map
+
+    metric = build_metric()
+    state = metric._current_state()
+    reductions = {k: metric._reductions[k] for k in state}
+    mesh = Mesh(
+        np.array(jax.devices("cpu")[:N_DEVICES]).reshape(HIER_SLICES, N_DEVICES // HIER_SLICES),
+        ("dcn", "ici"),
+    )
+    axis = MeshHierarchy(ici_axis="ici", dcn_axis="dcn")
+
+    def fn(v):
+        del v
+        synced = coalesced_sync_state(state, reductions, axis)
+        return jax.tree_util.tree_leaves(synced)[0]
+
+    probe = jnp.zeros((N_DEVICES,), jnp.float32)
+    obs.enable()
+    obs.reset()
+    jax.jit(shard_map(
+        fn, mesh=mesh, in_specs=(P(("dcn", "ici")),), out_specs=P(), check_vma=False
+    )).lower(probe).compile()
+    snap = obs.counters_snapshot()
+    obs.disable()
+    return {
+        "collective_calls": snap["collective_calls"],
+        "psum_calls": snap["calls_by_kind"].get("psum", 0),
+        "gather_calls": sum(
+            snap["calls_by_kind"].get(k, 0)
+            for k in ("all_gather", "coalesced_gather", "process_allgather", "ppermute")
+        ),
+    }
+
+
+def _qsk_check_parity(failures: list) -> dict:
+    import jax.numpy as jnp
+
+    from metrics_tpu import Keyed, Quantile, Windowed
+
+    rng = np.random.RandomState(9)
+    values = jnp.asarray(rng.lognormal(0.0, 1.0, 128).astype(np.float32))
+    slots = jnp.asarray(rng.randint(0, 32, 128).astype(np.int32))
+    times = np.sort(rng.uniform(0.0, 30.0, 128))
+
+    def unkeyed():
+        m = Quantile(q=0.99, alpha=QSK_ALPHA, min_value=QSK_LO, max_value=QSK_HI)
+        m.update(values)
+        return m
+
+    def keyed():
+        m = Keyed(Quantile(q=0.99, alpha=QSK_ALPHA, min_value=QSK_LO, max_value=QSK_HI),
+                  num_slots=32)
+        m.update(values, slot=slots)
+        return m
+
+    def windowed_keyed():
+        m = Windowed(
+            Keyed(Quantile(q=0.99, alpha=QSK_ALPHA, min_value=QSK_LO, max_value=QSK_HI),
+                  num_slots=32),
+            window_s=10.0, num_windows=4,
+        )
+        m.update(values, slot=slots, event_time=times)
+        return m
+
+    report = {
+        "unkeyed": _qsk_staged_counts(unkeyed),
+        "keyed": _qsk_staged_counts(keyed),
+        "windowed_keyed": _qsk_staged_counts(windowed_keyed),
+    }
+    base = report["unkeyed"]
+    for name in ("keyed", "windowed_keyed"):
+        if report[name]["collective_calls"] != base["collective_calls"]:
+            failures.append(
+                f"parity: {name} staged {report[name]['collective_calls']} collectives"
+                f" vs the unkeyed scalar metric's {base['collective_calls']}"
+            )
+        if report[name]["gather_calls"] != 0:
+            failures.append(f"parity: {name} staged gather collectives (must be psum-only)")
+    if base["psum_calls"] == 0:
+        failures.append("parity: the unkeyed program staged no psum at all")
+    return report
+
+
+def _qsk_check_memory(failures: list) -> dict:
+    import jax.numpy as jnp
+
+    from metrics_tpu import Quantile, SpearmanCorrcoef
+    from metrics_tpu.observability.counters import state_nbytes
+
+    rng = np.random.RandomState(11)
+    q = Quantile(q=0.99, alpha=QSK_ALPHA, min_value=QSK_LO, max_value=QSK_HI)
+    twin = SpearmanCorrcoef()  # the O(samples) capacity-buffer twin
+    q_sizes, twin_sizes = [], []
+    for _ in range(6):
+        batch = rng.lognormal(0.0, 1.0, 1024).astype(np.float32)
+        q.update(jnp.asarray(batch))
+        twin.update(jnp.asarray(batch), jnp.asarray(batch * 2.0))
+        q_sizes.append(int(state_nbytes(q._current_state())))
+        twin_sizes.append(int(state_nbytes(twin._current_state())))
+    if len(set(q_sizes)) != 1:
+        failures.append(f"memory: qsketch state bytes moved with traffic: {q_sizes}")
+    if not twin_sizes[-1] > twin_sizes[0]:
+        failures.append("memory: the buffer twin did not grow (scenario broken)")
+    return {"qsketch_bytes": q_sizes[0], "buffer_twin_bytes": twin_sizes}
+
+
+def check_quantile() -> int:
+    """``--check-quantile``: the quantile-sketch regression gate (see the
+    block comment above). Prints one JSON line; exit 0 iff every tier holds.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    failures: list = []
+    certificate = _qsk_check_certificate(failures)
+    merge = _qsk_check_merge(failures)
+    parity = _qsk_check_parity(failures)
+    memory = _qsk_check_memory(failures)
+
+    print(json.dumps({
+        "check": "quantile",
+        "ok": not failures,
+        "failures": failures,
+        "alpha": QSK_ALPHA,
+        "certificate": certificate,
+        "merge": merge,
+        "parity": parity,
+        "memory": memory,
+    }))
+    return 1 if failures else 0
+
+
 def main() -> None:
     trace_path = _trace_arg(sys.argv)
     if len(sys.argv) > 1 and sys.argv[1] == "--check-trajectory":
@@ -3955,6 +4346,16 @@ def main() -> None:
             + f" --xla_force_host_platform_device_count={N_DEVICES}"
         ).strip()
         raise SystemExit(check_service())
+
+    if len(sys.argv) > 1 and sys.argv[1] == "--check-quantile":
+        # quantile-sketch gate: the certificate/memory tiers are host-plane,
+        # but the merge/parity tiers trace the (4,2) mesh — virtual devices
+        # needed (jax not yet imported, so the flag lands in-process)
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={N_DEVICES}"
+        ).strip()
+        raise SystemExit(check_quantile())
 
     if len(sys.argv) > 1 and sys.argv[1] == "--check-collectives":
         # collective regression gate: jax is not yet imported, so the

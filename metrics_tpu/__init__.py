@@ -73,9 +73,12 @@ from metrics_tpu.regression import (  # noqa: E402
     MeanAbsolutePercentageError,
     MeanSquaredError,
     MeanSquaredLogError,
+    MedianAbsoluteError,
     MinkowskiDistance,
     MultiScaleSSIM,
     PearsonCorrcoef,
+    Percentile,
+    Quantile,
     R2Score,
     SpearmanCorrcoef,
     TotalVariation,

@@ -35,9 +35,11 @@ class AUC(Metric):
         rank_zero_warn_once(
             "Metric `AUC` stores every (x, y) point in an O(samples) buffer"
             " state, so memory and sync traffic grow with the dataset. For"
-            " score curves, prefer the constant-memory sketch/binned modes of"
-            " the curve metrics (`AUROC(approx=\"sketch\")`, `BinnedAUROC`),"
-            " which integrate on a fixed grid and sync with one psum."
+            " score curves, prefer the constant-memory sketch modes of the"
+            " curve metrics — `AUROC(approx=\"qsketch\")` is the RANGE-FREE"
+            " fix (auto-ranged log-bucketed grid, no sketch_range assumption"
+            " on raw scores); `AUROC(approx=\"sketch\")` / `BinnedAUROC`"
+            " integrate on a fixed grid — all syncing with one psum."
         )
 
     def update(self, x: Array, y: Array) -> None:

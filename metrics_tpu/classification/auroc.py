@@ -7,8 +7,16 @@ from jax import Array
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.functional.classification.auroc import _auroc_compute, _auroc_update
 from metrics_tpu.parallel.buffer import as_values
+from metrics_tpu.parallel.qsketch import (
+    QSKETCH_CURVE_ALPHA,
+    QuantileSketch,
+    qsketch_curve_group_key,
+    qsketch_curve_spec,
+    qsketch_curve_update,
+)
 from metrics_tpu.parallel.sketch import (
     HistogramSketch,
+    auroc_error_bound,
     auroc_from_histogram,
     canonicalize_approx,
     curve_sketch_group_key,
@@ -40,6 +48,15 @@ class AUROC(Metric):
     multilabel sketch mode needs ``num_classes`` at construction;
     ``max_fpr`` needs the exact mode.
 
+    ``approx="qsketch"`` is the AUTO-RANGED variant: scores bin on the
+    log-bucketed relative-accuracy grid of
+    :mod:`~metrics_tpu.parallel.qsketch` (``alpha``; ``num_bins`` /
+    ``sketch_range`` do not apply) — raw logits, un-sigmoided scores and
+    drifting calibration outputs keep per-decade resolution with NO
+    ``sketch_range=(0, 1)`` assumption. The thresholded-count derivation
+    only ever needed a monotone grid, so the same curve math, the same
+    one-psum sync and the same :meth:`error_bound` certificate apply.
+
     Example (binary):
         >>> import jax.numpy as jnp
         >>> preds = jnp.array([0.13, 0.26, 0.08, 0.19, 0.34])
@@ -64,6 +81,7 @@ class AUROC(Metric):
         approx: Optional[str] = None,
         num_bins: int = 2048,
         sketch_range: Tuple[float, float] = (0.0, 1.0),
+        alpha: float = QSKETCH_CURVE_ALPHA,
     ):
         super().__init__(
             compute_on_step=compute_on_step,
@@ -78,9 +96,10 @@ class AUROC(Metric):
         self.pos_label = pos_label
         self.average = average
         self.max_fpr = max_fpr
-        self.approx = canonicalize_approx(approx)
+        self.approx = canonicalize_approx(approx, allowed=("sketch", "qsketch"))
         self.num_bins = num_bins
         self.sketch_range = tuple(sketch_range)
+        self.alpha = float(alpha)
 
         allowed_average = (None, "macro", "weighted", "micro")
         if self.average not in allowed_average:
@@ -93,17 +112,24 @@ class AUROC(Metric):
                 raise ValueError(f"`max_fpr` should be a float in range (0, 1], got: {max_fpr}")
 
         self.mode = None
-        if self.approx == "sketch":
+        if self.approx in ("sketch", "qsketch"):
             if self.max_fpr is not None:
                 raise ValueError(
-                    "`max_fpr` (partial AUC) is not supported with approx='sketch';"
+                    f"`max_fpr` (partial AUC) is not supported with approx={self.approx!r};"
                     " use the exact buffer mode."
                 )
-            self.add_state(
-                "hist",
-                default=curve_sketch_spec(num_bins, num_classes, *self.sketch_range),
-                dist_reduce_fx="sum",
-            )
+            if self.approx == "qsketch":
+                self.add_state(
+                    "hist",
+                    default=qsketch_curve_spec(self.alpha, num_classes),
+                    dist_reduce_fx="sum",
+                )
+            else:
+                self.add_state(
+                    "hist",
+                    default=curve_sketch_spec(num_bins, num_classes, *self.sketch_range),
+                    dist_reduce_fx="sum",
+                )
             return
         self.add_state("preds", default=[], dist_reduce_fx=None)
         self.add_state("target", default=[], dist_reduce_fx=None)
@@ -111,12 +137,24 @@ class AUROC(Metric):
         rank_zero_warn_once(
             "Metric `AUROC` stores every prediction and target in an O(samples)"
             " buffer state, so memory and sync traffic grow with the dataset."
-            " Construct with `approx=\"sketch\"` for a constant-memory histogram"
-            " sketch that syncs with one psum, or use the fixed-grid"
-            " `BinnedAUROC`; exact buffers remain the default."
+            " Construct with `approx=\"qsketch\"` for a constant-memory"
+            " AUTO-RANGED histogram sketch (no sketch_range assumption on raw"
+            " logits) that syncs with one psum, `approx=\"sketch\"` for the"
+            " fixed-grid variant, or use `BinnedAUROC`; exact buffers remain"
+            " the default."
         )
 
     def update(self, preds: Array, target: Array) -> None:
+        if self.approx == "qsketch":
+            pos_label = 1 if self.pos_label is None else self.pos_label
+            spec = self._defaults["hist"]
+            self.hist = QuantileSketch(
+                qsketch_curve_update(
+                    self.hist.counts, preds, target,
+                    spec.alpha, spec.min_value, spec.max_value, pos_label,
+                )
+            )
+            return
         if self.approx == "sketch":
             pos_label = 1 if self.pos_label is None else self.pos_label
             self.hist = HistogramSketch(
@@ -137,8 +175,10 @@ class AUROC(Metric):
 
     def _group_fingerprint(self) -> Optional[Any]:
         # sketch-mode curve metrics share ONE update plane (the scatter-add of
-        # sketch_curve_update) across AUROC/ROC/PR-curve/AveragePrecision —
+        # sketch_curve_update / qsketch_curve_update) across the curve family —
         # equal sketch config means one compute-group delta serves them all
+        if self.approx == "qsketch":
+            return qsketch_curve_group_key(self)
         if self.approx == "sketch":
             return curve_sketch_group_key(self)
         return super()._group_fingerprint()
@@ -158,17 +198,27 @@ class AUROC(Metric):
         return per_class
 
     def _states_own_sync(self) -> bool:
-        if self.approx == "sketch":
+        if self.approx in ("sketch", "qsketch"):
             return False  # sketch sync IS the psum plane; nothing to suppress
         from metrics_tpu.parallel.sharded_dispatch import auroc_applicable
 
         return auroc_applicable(self) is not None
 
+    def error_bound(self) -> Array:
+        """Data-dependent certificate of the sketch modes:
+        ``|sketch AUROC - exact AUROC| <= bound``, half the in-bin collision
+        mass (``sketch.auroc_error_bound``) — grid-agnostic, so it covers
+        both the fixed ``sketch_range`` grid and the auto-ranged qsketch
+        grid. Per-class for multiclass/multilabel layouts."""
+        if self.approx not in ("sketch", "qsketch"):
+            raise ValueError("error_bound() needs approx='sketch' or 'qsketch'")
+        return auroc_error_bound(self.hist.counts)
+
     def compute(self) -> Array:
         from metrics_tpu.observability.trace import TRACE, span
         from metrics_tpu.parallel.sharded_dispatch import auroc_sharded
 
-        if self.approx == "sketch":
+        if self.approx in ("sketch", "qsketch"):
             return self._sketch_compute()
         sharded = auroc_sharded(self)  # row-sharded epoch states: exact ring
         if sharded is not None:

@@ -10,10 +10,18 @@ from metrics_tpu.functional.classification.average_precision import (
     _average_precision_update,
 )
 from metrics_tpu.parallel.buffer import as_values
+from metrics_tpu.parallel.qsketch import (
+    QSKETCH_CURVE_ALPHA,
+    QuantileSketch,
+    qsketch_curve_group_key,
+    qsketch_curve_spec,
+    qsketch_curve_update,
+)
 from metrics_tpu.parallel.sketch import (
     HistogramSketch,
     average_precision_from_histogram,
     canonicalize_approx,
+    curve_collision_bound,
     curve_sketch_group_key,
     curve_sketch_spec,
     sketch_curve_update,
@@ -50,6 +58,7 @@ class AveragePrecision(Metric):
         approx: Optional[str] = None,
         num_bins: int = 2048,
         sketch_range: Tuple[float, float] = (0.0, 1.0),
+        alpha: float = QSKETCH_CURVE_ALPHA,
     ):
         super().__init__(
             compute_on_step=compute_on_step,
@@ -62,10 +71,21 @@ class AveragePrecision(Metric):
 
         self.num_classes = num_classes
         self.pos_label = pos_label
-        self.approx = canonicalize_approx(approx)
+        self.approx = canonicalize_approx(approx, allowed=("sketch", "qsketch"))
         self.num_bins = num_bins
         self.sketch_range = tuple(sketch_range)
+        self.alpha = float(alpha)
 
+        if self.approx == "qsketch":
+            # constant-memory AUTO-RANGED mode: scores bin on the log-bucketed
+            # relative-accuracy grid — no sketch_range=(0, 1) assumption on
+            # un-sigmoided scores; same step-integral AP over the counts
+            self.add_state(
+                "hist",
+                default=qsketch_curve_spec(self.alpha, num_classes),
+                dist_reduce_fx="sum",
+            )
+            return
         if self.approx == "sketch":
             # constant-memory mode: AP from the step integral over the
             # sketched PR curve, psum-synced HistogramSketch state
@@ -81,12 +101,24 @@ class AveragePrecision(Metric):
         rank_zero_warn_once(
             "Metric `AveragePrecision` stores every prediction and target in an"
             " O(samples) buffer state, so memory and sync traffic grow with the"
-            " dataset. Construct with `approx=\"sketch\"` for a constant-memory"
-            " histogram sketch that syncs with one psum, or use"
-            " `BinnedAveragePrecision`; exact buffers remain the default."
+            " dataset. Construct with `approx=\"qsketch\"` for a constant-memory"
+            " AUTO-RANGED histogram sketch (no sketch_range assumption on raw"
+            " scores) that syncs with one psum, `approx=\"sketch\"` for the"
+            " fixed-grid variant, or use `BinnedAveragePrecision`; exact"
+            " buffers remain the default."
         )
 
     def update(self, preds: Array, target: Array) -> None:
+        if self.approx == "qsketch":
+            pos_label = 1 if self.pos_label is None else self.pos_label
+            spec = self._defaults["hist"]
+            self.hist = QuantileSketch(
+                qsketch_curve_update(
+                    self.hist.counts, preds, target,
+                    spec.alpha, spec.min_value, spec.max_value, pos_label,
+                )
+            )
+            return
         if self.approx == "sketch":
             pos_label = 1 if self.pos_label is None else self.pos_label
             self.hist = HistogramSketch(
@@ -102,21 +134,32 @@ class AveragePrecision(Metric):
         self.pos_label = pos_label
 
     def _group_fingerprint(self) -> Optional[Any]:
+        if self.approx == "qsketch":
+            return qsketch_curve_group_key(self)  # shared curve-family update
         if self.approx == "sketch":
             return curve_sketch_group_key(self)  # shared curve-family update
         return super()._group_fingerprint()
 
     def _states_own_sync(self) -> bool:
-        if self.approx == "sketch":
+        if self.approx in ("sketch", "qsketch"):
             return False
         from metrics_tpu.parallel.sharded_dispatch import average_precision_applicable
 
         return average_precision_applicable(self) is not None
 
+    def collision_bound(self) -> Array:
+        """Data-dependent resolution certificate of the sketch modes: the
+        unresolved positive/negative cross-pair fraction
+        (``sketch.curve_collision_bound``) driving the step integral's
+        deviation — grid-agnostic (fixed grid and qsketch alike)."""
+        if self.approx not in ("sketch", "qsketch"):
+            raise ValueError("collision_bound() needs approx='sketch' or 'qsketch'")
+        return curve_collision_bound(self.hist.counts)
+
     def compute(self) -> Union[List[Array], Array]:
         from metrics_tpu.parallel.sharded_dispatch import average_precision_sharded
 
-        if self.approx == "sketch":
+        if self.approx in ("sketch", "qsketch"):
             return average_precision_from_histogram(self.hist.counts)
         sharded = average_precision_sharded(self)  # row-sharded epoch states
         if sharded is not None:

@@ -99,7 +99,10 @@ class PrecisionRecallCurve(Metric):
             " an O(samples) buffer state, so memory and sync traffic grow with"
             " the dataset. Construct with `approx=\"sketch\"` for a"
             " constant-memory fixed-grid curve (one psum to sync), or use"
-            " `BinnedPrecisionRecallCurve`; exact buffers remain the default."
+            " `BinnedPrecisionRecallCurve`; for the scalar summary on raw"
+            " un-sigmoided scores, `AveragePrecision(approx=\"qsketch\")` is"
+            " the RANGE-FREE fix (auto-ranged log-bucketed grid). Exact"
+            " buffers remain the default."
         )
 
     def update(self, preds: Array, target: Array) -> None:

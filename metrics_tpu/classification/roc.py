@@ -91,8 +91,11 @@ class ROC(Metric):
             "Metric `ROC` stores every prediction and target in an O(samples)"
             " buffer state, so memory and sync traffic grow with the dataset."
             " Construct with `approx=\"sketch\"` for a constant-memory"
-            " fixed-grid curve (one psum to sync), or use `BinnedROC`; exact"
-            " buffers remain the default."
+            " fixed-grid curve (one psum to sync), or use `BinnedROC`; for the"
+            " scalar area on raw un-sigmoided scores, `AUROC(approx="
+            "\"qsketch\")` is the RANGE-FREE fix (auto-ranged log-bucketed"
+            " grid, no sketch_range assumption). Exact buffers remain the"
+            " default."
         )
 
     def update(self, preds: Array, target: Array) -> None:

@@ -55,6 +55,7 @@ from metrics_tpu.observability.devtime import DEVTIME as _DEVTIME, fence as _fen
 from metrics_tpu.observability.trace import TRACE, span as _span
 from metrics_tpu.parallel.buffer import PaddedBuffer, buffer_append, buffer_init
 from metrics_tpu.parallel.cms import CMSSpec, cms_init
+from metrics_tpu.parallel.qsketch import QSketchSpec, qsketch_init
 from metrics_tpu.parallel.sketch import SketchSpec, is_sketch, sketch_init
 from metrics_tpu.parallel.slab import SlabSpec, slab_init, slab_sync_reduce
 from metrics_tpu.utils import compat, debug
@@ -89,6 +90,32 @@ def set_default_jit(value: Optional[bool]) -> Optional[bool]:
     old = _DEFAULT_JIT
     _DEFAULT_JIT = value
     return old
+
+
+# The first-class state-spec registry of record: every mergeable state
+# declaration kind (sketch histogram / count-min tail / quantile sketch /
+# keyed slab) maps to its materializer HERE, so add_state, both materialize
+# paths, and the checkpoint-restore fallback branch on one table instead of
+# each growing a per-kind isinstance chain with every new state kind.
+_SPEC_MATERIALIZERS = {
+    SketchSpec: sketch_init,
+    CMSSpec: cms_init,
+    QSketchSpec: qsketch_init,
+    SlabSpec: slab_init,
+}
+
+# the spec kinds whose states are sum-mergeable BY CONSTRUCTION (merge =
+# elementwise add, sync = the existing psum buckets): add_state requires
+# dist_reduce_fx='sum' for these. Slabs are excluded — their reduction is
+# the spec's own slab_sync_reduce.
+_SUM_MERGEABLE_SPECS = (SketchSpec, CMSSpec, QSketchSpec)
+
+
+def materialize_state_spec(spec: Any) -> Any:
+    """Materialize a registered first-class state spec, or ``None`` when
+    ``spec`` is not one (callers fall through to their array/list arms)."""
+    init = _SPEC_MATERIALIZERS.get(type(spec))
+    return None if init is None else init(spec)
 
 
 # -------------------------------------------------- state-integrity scanning
@@ -267,6 +294,16 @@ def _fingerprint_value(v: Any, pins: list) -> Any:
         # keeps the key independent of the NamedTuple's field order
         return (
             "cmsspec", v.depth, v.width, v.item_shape, str(jnp.dtype(v.dtype)), v.seed,
+        )
+    if isinstance(v, QSketchSpec):
+        # before the generic tuple arm, like CMSSpec: the grid parameters
+        # are first-class fingerprint material (two qsketch states merge
+        # soundly only on the identical (alpha, min_value, max_value)
+        # bucket map) and the stable tag keeps the key independent of the
+        # NamedTuple's field order
+        return (
+            "qsketchspec", v.kind, v.shape, str(jnp.dtype(v.dtype)),
+            v.alpha, v.min_value, v.max_value,
         )
     if isinstance(v, (list, tuple)):
         return (type(v).__name__, tuple(_fingerprint_value(x, pins) for x in v))
@@ -493,6 +530,14 @@ class Metric(ABC):
         construction like sketches (``dist_reduce_fx`` must be ``"sum"``),
         so sync rides the existing per-dtype sum-psum buckets.
 
+        Or a :class:`~metrics_tpu.parallel.qsketch.QSketchSpec` — the
+        MERGEABLE QUANTILE SKETCH state kind (log-bucketed, relative-
+        accuracy ``alpha`` DDSketch-style grid with a zero bucket and
+        signed overflow end buckets): the state materializes as a
+        zero-count ``QuantileSketch``, its shape is traffic-independent,
+        and it follows the same sum-mergeable contract as sketches
+        (``dist_reduce_fx`` must be ``"sum"``).
+
         Or a :class:`~metrics_tpu.parallel.slab.SlabSpec` — the KEYED SLAB
         state kind (one row per segment slot, see ``wrappers/keyed.py``):
         the state materializes as a ``(K, *item_shape)`` array (or a sketch
@@ -515,30 +560,20 @@ class Metric(ABC):
             self._reductions[name] = expected
             setattr(self, name, slab_init(default))
             return
-        if isinstance(default, SketchSpec):
+        if isinstance(default, _SUM_MERGEABLE_SPECS):
+            # the sketch-family state kinds (fixed-grid histogram/rank
+            # sketches, count-min tails, log-bucketed quantile sketches):
+            # one registry arm — merge is elementwise add, sync rides the
+            # existing per-dtype sum-psum buckets.
             if dist_reduce_fx != "sum":
                 raise ValueError(
-                    f"sketch states are sum-mergeable by construction; declare them with"
-                    f" dist_reduce_fx='sum' (got {dist_reduce_fx!r})"
+                    f"{type(default).__name__} states are sum-mergeable by construction;"
+                    f" declare them with dist_reduce_fx='sum' (got {dist_reduce_fx!r})"
                 )
             self._defaults[name] = default
             self._persistent[name] = persistent
             self._reductions[name] = "sum"
-            setattr(self, name, sketch_init(default))
-            return
-        if isinstance(default, CMSSpec):
-            # the COUNT-MIN TAIL state kind (parallel/cms.py): a (depth,
-            # width, *item) accumulator folding an unbounded key space into
-            # constant memory. Sum-mergeable by construction, like sketches.
-            if dist_reduce_fx != "sum":
-                raise ValueError(
-                    f"count-min states are sum-mergeable by construction; declare them"
-                    f" with dist_reduce_fx='sum' (got {dist_reduce_fx!r})"
-                )
-            self._defaults[name] = default
-            self._persistent[name] = persistent
-            self._reductions[name] = "sum"
-            setattr(self, name, cms_init(default))
+            setattr(self, name, materialize_state_spec(default))
             return
         is_list = isinstance(default, list) and len(default) == 0
         is_arraylike = isinstance(default, (int, float, np.ndarray, jnp.ndarray, Array)) and not isinstance(
@@ -566,12 +601,9 @@ class Metric(ABC):
     def _materialize_default(spec: Any, key: Any = None) -> Any:
         if isinstance(spec, _BufferSpec):
             return buffer_init(spec.capacity, spec.item_shape, spec.dtype)
-        if isinstance(spec, SketchSpec):
-            return sketch_init(spec)
-        if isinstance(spec, CMSSpec):
-            return cms_init(spec)
-        if isinstance(spec, SlabSpec):
-            return slab_init(spec)
+        materialized = materialize_state_spec(spec)
+        if materialized is not None:
+            return materialized
         if isinstance(spec, list):
             return []
         # identical templates share one transferred device constant, and each
@@ -664,12 +696,11 @@ class Metric(ABC):
     def _materialize_default_traced(spec: Any) -> Any:
         if isinstance(spec, _BufferSpec):
             return buffer_init(spec.capacity, spec.item_shape, spec.dtype)
-        if isinstance(spec, SketchSpec):
-            return sketch_init(spec)  # zeros: stage as compile-time constants
-        if isinstance(spec, CMSSpec):
-            return cms_init(spec)  # zeros: staged like sketch counts
-        if isinstance(spec, SlabSpec):
-            return slab_init(spec)  # zeros / host-template broadcasts: staged
+        # registry kinds materialize zeros / host-template broadcasts, which
+        # stage as compile-time constants under tracing
+        materialized = materialize_state_spec(spec)
+        if materialized is not None:
+            return materialized
         if isinstance(spec, list):
             return []
         return jnp.asarray(spec)  # numpy spec -> host-backed staged constant
@@ -1739,14 +1770,15 @@ class Metric(ABC):
                 if isinstance(value, dict) and set(value) == {"data", "count"}:
                     setattr(self, key, PaddedBuffer(jnp.asarray(value["data"]), jnp.asarray(value["count"])))
                 elif isinstance(value, dict) and set(value) == {"sketch_counts"}:
+                    # the sketch-kind resolution of record: the live state's
+                    # type wins; otherwise the spec registry materializes the
+                    # declared kind (histogram/rank/CMS/quantile — and slab
+                    # forms thereof) so old checkpoints restore unchanged
+                    # without a per-kind fallback chain here.
                     spec = self._defaults[key]
                     kind = type(getattr(self, key)) if is_sketch(getattr(self, key, None)) else None
-                    if kind is None and isinstance(spec, (SketchSpec, SlabSpec, CMSSpec)):
-                        materialized = (
-                            sketch_init(spec) if isinstance(spec, SketchSpec)
-                            else cms_init(spec) if isinstance(spec, CMSSpec)
-                            else slab_init(spec)
-                        )
+                    if kind is None:
+                        materialized = materialize_state_spec(spec)
                         kind = type(materialized) if is_sketch(materialized) else None
                     if kind is None:
                         raise ValueError(

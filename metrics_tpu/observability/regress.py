@@ -62,6 +62,7 @@ MS_KEYS: Tuple[str, ...] = (
     "sketch_sync_ms",
     "keyed_sync_ms",
     "hh_sync_ms",
+    "qsketch_sync_ms",
     "service_sync_ms",
     # the deferred-sync A/B: both variants gate so a regression in either
     # the overlapped path or its fenced twin is caught (their ORDERING —
@@ -124,6 +125,16 @@ COUNT_KEYS: Tuple[str, ...] = (
     "hh_states_synced",
     "hh_unkeyed_collective_calls",
     "hh_tail_overcount_bound",
+    # the quantile-sketch plane: the per-tenant p99 slab must stay
+    # K-independent (staged count equal to the unkeyed scalar Quantile's),
+    # psum-only, with DETERMINISTIC state bytes ((K*B + K) int32 cells) —
+    # any byte growth means the grid or slab layout silently changed
+    "qsketch_collective_calls",
+    "qsketch_sync_bytes",
+    "qsketch_gather_calls",
+    "qsketch_states_synced",
+    "qsketch_unkeyed_collective_calls",
+    "qsketch_state_bytes",
     # the windowed serving plane: staged counts must stay window-count-
     # independent (equal to the unwindowed metric's) and psum-only; any
     # growth is a regression of the windows-as-a-state-axis story

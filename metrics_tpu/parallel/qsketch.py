@@ -1,0 +1,502 @@
+"""Mergeable quantile sketches: the latency/distribution state kind.
+
+The sketch family so far answers curve questions (``HistogramSketch``), rank
+questions (``RankSketch``) and open-world key questions (``CountMinSketch``)
+— but nothing in the library can answer the canonical production-serving
+question "what is the p99 latency?", because ``HistogramSketch``'s fixed
+``sketch_range`` linear grid cannot hold unbounded, heavy-tailed values
+(request latency, token counts, scores drifting over time) without either
+clipping the tail into an end bin or wasting the whole grid on it.
+
+The streaming literature's answer (Masson, Rim & Lee, "DDSketch: a fast and
+fully-mergeable quantile sketch with relative-error guarantees", VLDB 2019;
+Karnin, Lang & Liberty's KLL for the comparison point) is a LOG-BUCKETED
+histogram: bucket ``j`` covers ``[min_value * gamma^j, min_value *
+gamma^(j+1))`` with ``gamma = (1 + alpha) / (1 - alpha)``, so reporting the
+bucket's multiplicative midpoint answers any quantile within RELATIVE error
+``alpha`` — at any scale, with no range tuning beyond the representable
+magnitude span. This module specializes that design to the library's
+mergeable-state contract (the same move ``sketch.py`` made for KLL):
+
+- :class:`QuantileSketch` — ONE integer counts leaf over the fixed grid
+  below. ``update`` is a jittable scatter-add, ``merge`` is elementwise
+  integer addition (associative, commutative, BIT-exact — a ``psum`` of
+  per-device sketches equals the single-process sketch), and ``sync`` rides
+  the existing per-dtype sum buckets of ``coalesced_sync_state`` with ZERO
+  new collective kinds. State size is traffic-independent: the default
+  ``alpha=0.01`` grid over 18 decades is ~16 KB forever.
+- :class:`QSketchSpec` — the host-side state declaration (the fourth
+  first-class state kind next to ``_BufferSpec`` / ``SketchSpec`` /
+  ``SlabSpec`` / ``CMSSpec``), fingerprintable so config-identical qsketch
+  metrics share compiled steps and compute groups.
+
+Grid layout (``m`` log buckets per sign, ``B = 2 m + 3`` total)::
+
+    index 0            : negative overflow   (x <= -min_value * gamma^m)
+    index 1 .. m       : negative log buckets (ascending in x)
+    index m + 1        : zero bucket          (|x| < min_value)
+    index m + 2 .. 2m+1: positive log buckets
+    index 2 m + 2      : positive overflow    (x >= min_value * gamma^m)
+
+The index map is STRICTLY MONOTONE in the value — which is why the same
+grid doubles as a range-free binning for the target-conditioned curve
+histograms (auto-ranged sketch AUROC / AveragePrecision: no more
+``sketch_range=(0, 1)`` assumption on un-sigmoided scores) and for the 2-D
+joint rank histograms (range-free Spearman/Kendall, retiring the soft-sign
+squash-grid compromise): the curve/rank math in ``sketch.py`` only needs a
+monotone grid, never a linear one.
+
+NaN/±inf follow PR 7's convention exactly: NaN samples are DROPPED via a
+masked (zero-increment) scatter — ``astype(int32)`` of NaN is undefined in
+XLA — and ``±inf`` clips into the signed overflow end buckets, where the
+certificate reports the estimate as uncertified (``inf`` bound).
+
+Certificate of record (:func:`quantile_error_bound`): any quantile whose
+selected bucket is a log or zero bucket satisfies
+``|estimate - true| <= alpha * |true| + min_value`` — the ``alpha`` term is
+the log-bucket guarantee, the additive ``min_value`` covers the zero bucket
+(values below the smallest resolvable magnitude report exactly 0.0). Mass
+resolved from an overflow bucket is flagged ``inf`` (out of the certified
+span), data-dependently, in the spirit of ``sketch.auroc_error_bound``.
+"""
+import math
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+__all__ = [
+    "QSKETCH_ALPHA",
+    "QSKETCH_CURVE_ALPHA",
+    "QSKETCH_CURVE_RANGE",
+    "QSKETCH_MAX_VALUE",
+    "QSKETCH_MIN_VALUE",
+    "QSKETCH_RANK_ALPHA",
+    "QSKETCH_RANK_RANGE",
+    "QSketchSpec",
+    "QuantileSketch",
+    "is_qsketch",
+    "is_qsketch_spec",
+    "qsketch_bucket",
+    "qsketch_bucket_values",
+    "qsketch_curve_group_key",
+    "qsketch_curve_spec",
+    "qsketch_curve_update",
+    "qsketch_init",
+    "qsketch_merge",
+    "qsketch_nbytes",
+    "qsketch_num_buckets",
+    "qsketch_rank_group_key",
+    "qsketch_rank_spec",
+    "qsketch_rank_update",
+    "qsketch_update",
+    "qsketch_value_group_key",
+    "quantile_error_bound",
+    "quantile_from_counts",
+    "quantile_sketch_spec",
+]
+
+# defaults of record. The plain quantile grid spans 18 decades (nanoseconds
+# to ~30 years if the unit is seconds) at 1% relative accuracy — ~16 KB of
+# int32 counts. The curve grid narrows to 12 decades (scores/logits); the
+# rank grid trades accuracy for the JOINT histogram's quadratic footprint
+# (alpha=0.1 -> a 279x279 joint, ~311 KB — rank statistics only consume the
+# ORDER of the grid, so coarse alpha costs collision mass, not correctness).
+QSKETCH_ALPHA = 0.01
+QSKETCH_MIN_VALUE = 1e-9
+QSKETCH_MAX_VALUE = 1e9
+QSKETCH_CURVE_ALPHA = 0.01
+QSKETCH_CURVE_RANGE = (1e-6, 1e6)
+QSKETCH_RANK_ALPHA = 0.1
+QSKETCH_RANK_RANGE = (1e-6, 1e6)
+
+# a rank spec's joint histogram is (B, B): cap B so a typo'd alpha cannot
+# silently request a multi-GB state (279^2 at the default, ~4096^2 = 64 MB
+# at the cap)
+_MAX_RANK_GRID = 4096
+
+
+class QuantileSketch(NamedTuple):
+    """Log-bucketed quantile sketch state: one ``(..., B)`` integer counts
+    leaf over the module's fixed relative-accuracy grid.
+
+    A pytree of one integer leaf: jit/scan/donation-safe,
+    ``dist_reduce_fx="sum"`` semantics (merge = elementwise add, sync = one
+    psum, both bit-exact). Registered in the sketch state family
+    (``sketch.is_sketch``), so the sync planes, slab scatters, checkpoint
+    paths and wrappers handle it through the counts-based arms they already
+    have. Layouts: ``(B,)`` for a plain value sketch, ``(2, B)`` /
+    ``(C, 2, B)`` for target-conditioned curve histograms on the qsketch
+    grid, ``(B, B)`` for the joint rank histogram.
+    """
+
+    counts: Array
+
+
+def is_qsketch(value: Any) -> bool:
+    return isinstance(value, QuantileSketch)
+
+
+class QSketchSpec(NamedTuple):
+    """Host-side quantile-sketch state declaration (what ``Metric.add_state``
+    records in ``self._defaults`` — the qsketch analogue of ``SketchSpec``).
+
+    ``kind``: ``"q"`` (plain ``(B,)`` value sketch), ``"hist"``
+    (target-conditioned ``(..., 2, B)`` curve layout on the qsketch grid) or
+    ``"rank"`` (``(B, B)`` joint). ``alpha`` is the relative accuracy;
+    ``min_value``/``max_value`` bound the representable magnitude span (the
+    grid is log-spaced between them, with a zero bucket below and signed
+    overflow buckets beyond). Pure config — materialization is
+    :func:`qsketch_init` — and fingerprintable, so config-identical qsketch
+    metrics share compiled steps and compute groups.
+    """
+
+    kind: str
+    shape: Tuple[int, ...]
+    dtype: Any
+    alpha: float
+    min_value: float
+    max_value: float
+
+
+def is_qsketch_spec(value: Any) -> bool:
+    return isinstance(value, QSketchSpec)
+
+
+def qsketch_init(spec: QSketchSpec) -> QuantileSketch:
+    """Fresh zero-count qsketch for ``spec`` (jit-safe: zeros stage as
+    compile-time constants under tracing)."""
+    return QuantileSketch(jnp.zeros(spec.shape, dtype=spec.dtype))
+
+
+def qsketch_merge(a: QuantileSketch, b: QuantileSketch) -> QuantileSketch:
+    """Pairwise merge: elementwise integer addition — associative,
+    commutative, bit-exact (the psum-mergeability property)."""
+    return QuantileSketch(a.counts + b.counts)
+
+
+def qsketch_nbytes(value: QuantileSketch) -> int:
+    """State bytes of one qsketch (traffic-independent by construction)."""
+    return int(value.counts.size) * int(jnp.dtype(value.counts.dtype).itemsize)
+
+
+def _accum_dtype():
+    from metrics_tpu.utils.data import accum_int_dtype
+
+    return accum_int_dtype()
+
+
+# ---------------------------------------------------------------- the grid
+def _validate_grid(alpha: float, min_value: float, max_value: float) -> None:
+    if not (isinstance(alpha, float) and 0.0 < alpha < 1.0):
+        raise ValueError(f"`alpha` must be a float in (0, 1), got {alpha!r}")
+    if not (0.0 < min_value < max_value):
+        raise ValueError(
+            f"qsketch magnitude span must satisfy 0 < min_value < max_value,"
+            f" got ({min_value!r}, {max_value!r})"
+        )
+
+
+def _grid_params(alpha: float, min_value: float, max_value: float) -> Tuple[int, float]:
+    """``(m, gamma)``: log buckets per sign and the bucket growth factor."""
+    _validate_grid(alpha, min_value, max_value)
+    gamma = (1.0 + alpha) / (1.0 - alpha)
+    m = int(math.ceil(math.log(max_value / min_value) / math.log(gamma)))
+    return max(m, 1), gamma
+
+
+def qsketch_num_buckets(alpha: float, min_value: float, max_value: float) -> int:
+    """Total grid size ``B = 2 m + 3``: ``m`` log buckets per sign plus the
+    zero bucket and the two signed overflow end buckets."""
+    m, _ = _grid_params(alpha, min_value, max_value)
+    return 2 * m + 3
+
+
+def qsketch_bucket(x: Array, alpha: float, min_value: float, max_value: float) -> Array:
+    """Strictly monotone bucket index of ``x`` on the qsketch grid.
+
+    ``±inf`` lands in the signed overflow end buckets (documented
+    out-of-span behavior, certificate-flagged); exact zeros and values below
+    ``min_value`` in magnitude land in the zero bucket. ``NaN`` has no
+    defined bucket (``astype(int32)`` of NaN is undefined in XLA): callers
+    must mask NaN before binning, as every qsketch update plane does (NaN
+    samples are dropped via a zero scatter increment) — the same contract as
+    ``sketch.score_to_bin``.
+    """
+    m, gamma = _grid_params(alpha, min_value, max_value)
+    ln_gamma = math.log(gamma)
+    top = min_value * gamma**m  # first non-representable magnitude
+    xf = jnp.asarray(x, jnp.float32)
+    mag = jnp.abs(xf)
+    # clip BEFORE the int cast: log(inf)=inf must resolve through the float
+    # clip, never through an undefined float->int conversion
+    j = jnp.clip(
+        jnp.floor(jnp.log(jnp.maximum(mag, min_value) / min_value) / ln_gamma), 0, m - 1
+    ).astype(jnp.int32)
+    idx = jnp.where(xf > 0, m + 2 + j, m - j)
+    idx = jnp.where(mag < min_value, m + 1, idx)
+    idx = jnp.where((mag >= top) & (xf > 0), 2 * m + 2, idx)
+    idx = jnp.where((mag >= top) & (xf < 0), 0, idx)
+    return idx.astype(jnp.int32)
+
+
+def qsketch_bucket_values(alpha: float, min_value: float, max_value: float) -> np.ndarray:
+    """The ``(B,)`` representative value per bucket — the multiplicative
+    midpoint ``2 gamma / (gamma + 1)`` of each log bucket's span, which is
+    what makes any in-bucket value's estimate land within relative error
+    ``alpha`` (at both bucket edges the error is exactly
+    ``(gamma - 1) / (gamma + 1) = alpha``). The zero bucket reports exactly
+    ``0.0``; the overflow buckets report ``±top_edge * gamma`` — one bucket
+    beyond the certified span, flagged by :func:`quantile_error_bound`.
+
+    Host-side numpy on purpose (grids are metric config; under jit they
+    stage as constants), matching ``sketch.sketch_thresholds``.
+    """
+    m, gamma = _grid_params(alpha, min_value, max_value)
+    rep = min_value * gamma ** np.arange(m, dtype=np.float64) * (2.0 * gamma / (gamma + 1.0))
+    vals = np.zeros(2 * m + 3, dtype=np.float64)
+    vals[m + 2 : 2 * m + 2] = rep
+    vals[1 : m + 1] = -rep[::-1]  # vals[m - j] == -rep[j]: monotone ascending
+    top = min_value * gamma**m
+    vals[0] = -top * gamma
+    vals[2 * m + 2] = top * gamma
+    return vals
+
+
+# ------------------------------------------------------------------- updates
+def qsketch_update(
+    counts: Array, values: Array, alpha: float, min_value: float, max_value: float
+) -> Array:
+    """Scatter one batch of raw values into a ``(B,)`` quantile sketch — the
+    shared update plane of the ``Quantile``/``Percentile`` family (equal
+    grid config -> one compute-group delta serves every requested quantile).
+
+    Pure and jittable: one log binning plus one scatter-add. NaN values are
+    DROPPED (zero scatter increment); ``±inf`` clips into the signed
+    overflow buckets — PR 7's sketch convention, verbatim.
+    """
+    x = jnp.asarray(values).reshape(-1)
+    nan = jnp.isnan(x)
+    b = qsketch_bucket(jnp.where(nan, 0.0, x), alpha, min_value, max_value)
+    return counts.at[b].add((~nan).astype(counts.dtype))
+
+
+def qsketch_curve_update(
+    counts: Array,
+    preds: Array,
+    target: Array,
+    alpha: float,
+    min_value: float,
+    max_value: float,
+    pos_label: int,
+) -> Array:
+    """Scatter one batch into per-class positive/negative score histograms
+    on the AUTO-RANGED qsketch grid — the ``approx="qsketch"`` twin of
+    ``sketch.sketch_curve_update`` (same layouts: binary ``(2, B)``,
+    multiclass/multilabel ``(C, 2, B)``), shared across AUROC /
+    AveragePrecision instances with equal config.
+
+    The qsketch grid is strictly monotone in the score, which is all the
+    thresholded-count derivation (``sketch.curve_counts_from_histogram``)
+    ever needed — so raw logits, un-sigmoided scores and heavy-tailed
+    calibration outputs bin losslessly-ordered with NO ``sketch_range``
+    assumption. NaN predictions are dropped via the masked scatter; ``±inf``
+    clips into the signed overflow buckets (which the suffix cumsum treats
+    as the extreme thresholds, exactly like any end bin).
+    """
+    num_bins = counts.shape[-1]
+    del num_bins  # layout is carried by the spec; shapes checked below
+    if preds.ndim == 1:
+        if counts.ndim != 2:
+            raise ValueError(
+                f"qsketch expects per-class input (N, {counts.shape[0]}); got 1-D"
+                " predictions. Construct the metric without num_classes for binary"
+                " qsketch mode."
+            )
+        nan = jnp.isnan(preds)
+        b = qsketch_bucket(jnp.where(nan, 0.0, preds), alpha, min_value, max_value)
+        row = jnp.where(target == pos_label, 0, 1)
+        return counts.at[row, b].add((~nan).astype(counts.dtype))
+    if preds.ndim != 2 or counts.ndim != 3 or preds.shape[1] != counts.shape[0]:
+        raise ValueError(
+            f"qsketch/state layout mismatch: preds {preds.shape} vs counts"
+            f" {counts.shape}. Multiclass/multilabel qsketch mode needs num_classes"
+            " at construction."
+        )
+    num_classes = preds.shape[1]
+    nan = jnp.isnan(preds)
+    b = qsketch_bucket(jnp.where(nan, 0.0, preds), alpha, min_value, max_value)  # (N, C)
+    if target.ndim == 1:
+        pos = target[:, None] == jnp.arange(num_classes)[None, :]
+    else:
+        pos = target == pos_label
+    cls = jnp.broadcast_to(jnp.arange(num_classes)[None, :], b.shape)
+    row = jnp.where(pos, 0, 1)
+    return counts.at[cls, row, b].add((~nan).astype(counts.dtype))
+
+
+def qsketch_rank_update(
+    counts: Array,
+    preds: Array,
+    target: Array,
+    alpha: float,
+    min_value: float,
+    max_value: float,
+) -> Array:
+    """Scatter one batch of (preds, target) pairs into the 2-D joint
+    histogram on the qsketch grid — the RANGE-FREE ``approx="qsketch"`` twin
+    of ``sketch.sketch_rank_update`` (Spearman/Kendall share it; rank
+    statistics are invariant under the grid's strictly increasing index
+    map, so the log binning changes only which values COLLIDE in a bucket,
+    never their order). Pairs with a NaN on either side are dropped via the
+    masked scatter; ``±inf`` lands in the signed overflow buckets (end bins
+    of the order)."""
+    nan = jnp.isnan(preds) | jnp.isnan(target)
+    bi = qsketch_bucket(jnp.where(nan, 0.0, preds), alpha, min_value, max_value)
+    bj = qsketch_bucket(jnp.where(nan, 0.0, target), alpha, min_value, max_value)
+    return counts.at[bi, bj].add((~nan).astype(counts.dtype))
+
+
+# ------------------------------------------------------------------- queries
+def _rank_select(counts: Array, q: Array) -> Tuple[Array, Array]:
+    """``(idx, n)``: the bucket each quantile's rank resolves to (DDSketch
+    convention — the first bucket whose cumulative count exceeds
+    ``q * (n - 1)``) and the total count."""
+    c = counts.astype(jnp.float32)
+    n = jnp.sum(c)
+    cum = jnp.cumsum(c)
+    target = jnp.asarray(q, jnp.float32) * jnp.maximum(n - 1.0, 0.0)
+    idx = jnp.clip(
+        jnp.searchsorted(cum, target, side="right"), 0, counts.shape[-1] - 1
+    )
+    return idx, n
+
+
+def quantile_from_counts(
+    counts: Array, q: Any, alpha: float, min_value: float, max_value: float
+) -> Array:
+    """Quantile estimates from a ``(B,)`` qsketch: the selected bucket's
+    representative value, within relative error ``alpha`` (plus the
+    ``min_value`` zero-bucket slack) for any rank resolving inside the
+    certified span — see :func:`quantile_error_bound`.
+
+    ``q`` may be a scalar or a vector (one read answers all of p50/p95/p99
+    from the same counts). Jittable and vmap-safe (``Keyed`` vmaps it over
+    the slot axis); ``nan`` on an empty sketch, matching the buffer-backed
+    kernels' degenerate-input convention.
+    """
+    values = jnp.asarray(qsketch_bucket_values(alpha, min_value, max_value), jnp.float32)
+    qa = jnp.atleast_1d(jnp.asarray(q, jnp.float32))
+    idx, n = _rank_select(counts, qa)
+    out = jnp.where(n > 0, values[idx], jnp.nan)
+    return out if np.ndim(q) else out[0]
+
+
+def quantile_error_bound(
+    counts: Array, q: Any, alpha: float, min_value: float, max_value: float
+) -> Array:
+    """Data-dependent certificate for :func:`quantile_from_counts`:
+    per-quantile relative-error bound ``alpha`` whenever the selected rank
+    resolves in a log or zero bucket (the estimate then satisfies
+    ``|estimate - true| <= alpha * |true| + min_value``, the additive term
+    covering sub-``min_value`` magnitudes reported as 0.0), and ``inf``
+    when it resolves in a signed overflow bucket — mass beyond
+    ``max_value`` is counted and ordered but not certified, the qsketch
+    analogue of ``sketch.auroc_error_bound``'s collision-mass certificate.
+    ``nan`` on an empty sketch."""
+    m, _ = _grid_params(alpha, min_value, max_value)
+    qa = jnp.atleast_1d(jnp.asarray(q, jnp.float32))
+    idx, n = _rank_select(counts, qa)
+    bound = jnp.where((idx == 0) | (idx == 2 * m + 2), jnp.inf, alpha)
+    out = jnp.where(n > 0, bound, jnp.nan)
+    return out if np.ndim(q) else out[0]
+
+
+# ----------------------------------------------------- metric-side plumbing
+def quantile_sketch_spec(
+    alpha: float = QSKETCH_ALPHA,
+    min_value: float = QSKETCH_MIN_VALUE,
+    max_value: float = QSKETCH_MAX_VALUE,
+    dtype: Any = None,
+) -> QSketchSpec:
+    """The :class:`QSketchSpec` a value-distribution metric registers
+    (``Quantile``/``Percentile``/``MedianAbsoluteError``)."""
+    shape = (qsketch_num_buckets(alpha, min_value, max_value),)
+    return QSketchSpec(
+        "q", shape, dtype or _accum_dtype(), float(alpha), float(min_value), float(max_value)
+    )
+
+
+def qsketch_curve_spec(
+    alpha: float = QSKETCH_CURVE_ALPHA,
+    num_classes: Optional[int] = None,
+    min_value: float = QSKETCH_CURVE_RANGE[0],
+    max_value: float = QSKETCH_CURVE_RANGE[1],
+    dtype: Any = None,
+) -> QSketchSpec:
+    """The :class:`QSketchSpec` a curve metric registers for
+    ``approx="qsketch"`` (auto-ranged AUROC / AveragePrecision)."""
+    num_buckets = qsketch_num_buckets(alpha, min_value, max_value)
+    shape = (
+        (2, num_buckets) if num_classes in (None, 1) else (num_classes, 2, num_buckets)
+    )
+    return QSketchSpec(
+        "hist", shape, dtype or _accum_dtype(), float(alpha), float(min_value), float(max_value)
+    )
+
+
+def qsketch_rank_spec(
+    alpha: float = QSKETCH_RANK_ALPHA,
+    min_value: float = QSKETCH_RANK_RANGE[0],
+    max_value: float = QSKETCH_RANK_RANGE[1],
+    dtype: Any = None,
+) -> QSketchSpec:
+    """The :class:`QSketchSpec` a rank metric registers for
+    ``approx="qsketch"`` (range-free Spearman/Kendall)."""
+    num_buckets = qsketch_num_buckets(alpha, min_value, max_value)
+    if num_buckets > _MAX_RANK_GRID:
+        raise ValueError(
+            f"a rank qsketch keeps a (B, B) joint histogram; alpha={alpha!r} over"
+            f" ({min_value!r}, {max_value!r}) needs B={num_buckets} > {_MAX_RANK_GRID}."
+            " Rank statistics only consume the grid's ORDER — use a coarser alpha"
+            " (the default 0.1 gives B=279) or a narrower magnitude span."
+        )
+    return QSketchSpec(
+        "rank",
+        (num_buckets, num_buckets),
+        dtype or _accum_dtype(),
+        float(alpha),
+        float(min_value),
+        float(max_value),
+    )
+
+
+def _spec_key(tag: str, spec: QSketchSpec) -> tuple:
+    return (
+        tag, spec.kind, spec.shape, str(jnp.dtype(spec.dtype)),
+        spec.alpha, spec.min_value, spec.max_value,
+    )
+
+
+def qsketch_value_group_key(metric: Any, state: str = "qsketch") -> tuple:
+    """Compute-group fingerprint of a value-sketch metric's update plane:
+    any two ``Quantile``/``Percentile`` instances with equal grid config run
+    the identical :func:`qsketch_update` scatter — the requested ``q`` is
+    compute-only, so ONE synced sketch serves p50, p95 and p99 members of a
+    collection."""
+    return _spec_key("qsketch_q", metric._defaults[state])
+
+
+def qsketch_curve_group_key(metric: Any) -> tuple:
+    """Compute-group fingerprint of a curve metric's qsketch update plane
+    (shared across AUROC / AveragePrecision instances with equal config)."""
+    spec = metric._defaults["hist"]
+    pos_label = metric.pos_label if getattr(metric, "pos_label", None) is not None else 1
+    return _spec_key("qsketch_curve", spec) + (int(pos_label),)
+
+
+def qsketch_rank_group_key(metric: Any) -> tuple:
+    """Compute-group fingerprint of a rank metric's qsketch update plane
+    (shared across Spearman / Kendall instances with equal config)."""
+    return _spec_key("qsketch_rank", metric._defaults["joint"])

@@ -48,6 +48,7 @@ import numpy as np
 from jax import Array
 
 from metrics_tpu.parallel.cms import CountMinSketch
+from metrics_tpu.parallel.qsketch import QuantileSketch
 
 __all__ = [
     "HistogramSketch",
@@ -56,12 +57,14 @@ __all__ = [
     "auroc_error_bound",
     "auroc_from_histogram",
     "average_precision_from_histogram",
+    "curve_collision_bound",
     "curve_counts_from_histogram",
     "curve_sketch_group_key",
     "curve_sketch_spec",
     "is_sketch",
     "kendall_from_joint",
     "precision_recall_from_histogram",
+    "rank_collision_bound",
     "rank_sketch_group_key",
     "rank_sketch_spec",
     "rank_to_bin",
@@ -100,12 +103,14 @@ class RankSketch(NamedTuple):
     counts: Array
 
 
-# CountMinSketch (parallel/cms.py) joins the family: it is one more
-# counts-backed mergeable-sum state, so every counts-based arm — the sync
-# bucket planes, slab scatters, checkpoint round-trips, wrapper merges —
-# handles it through the same ``is_sketch`` branch as the histogram kinds.
-_SKETCH_TYPES = (HistogramSketch, RankSketch, CountMinSketch)
-_KINDS = {"hist": HistogramSketch, "rank": RankSketch, "cms": CountMinSketch}
+# CountMinSketch (parallel/cms.py) and QuantileSketch (parallel/qsketch.py)
+# join the family: each is one more counts-backed mergeable-sum state, so
+# every counts-based arm — the sync bucket planes, slab scatters, checkpoint
+# round-trips, wrapper merges — handles them through the same ``is_sketch``
+# branch as the histogram kinds.
+_SKETCH_TYPES = (HistogramSketch, RankSketch, CountMinSketch, QuantileSketch)
+_KINDS = {"hist": HistogramSketch, "rank": RankSketch, "cms": CountMinSketch,
+          "qsketch": QuantileSketch}
 
 
 def is_sketch(value: Any) -> bool:
@@ -360,6 +365,36 @@ def auroc_error_bound(counts: Array) -> Array:
     return jnp.sum(pos * neg, -1) / (2.0 * p_total * n_total)
 
 
+def curve_collision_bound(counts: Array) -> Array:
+    """Data-dependent resolution certificate of a curve histogram: the
+    fraction of positive/negative cross pairs COLLIDING in one score bucket
+    (``sum_b pos_b * neg_b / (P * N)``) — the mass whose order the grid
+    cannot resolve, and exactly twice :func:`auroc_error_bound` (which
+    charges half credit per collision). The quantity the AveragePrecision
+    sketch modes report as their certificate: the step integral's deviation
+    is driven by, and vanishes with, this collision mass. Works on any
+    monotone grid — the linear ``sketch_range`` grid and the log-bucketed
+    qsketch grid alike."""
+    return 2.0 * auroc_error_bound(counts)
+
+
+def rank_collision_bound(counts: Array) -> Array:
+    """Data-dependent resolution certificate of a 2-D joint rank histogram:
+    the fraction of sample pairs colliding in one grid bucket on either
+    variable (``sum_i p_i (p_i - 1) / (n (n - 1))`` per marginal, summed).
+    Colliding pairs are the ONLY pairs whose order the binned-rank
+    statistics resolve as ties instead of exactly — true ties contribute
+    zero error (tie-averaging is exact for them) — so the sketch
+    Spearman/Kendall deviation is driven by, and vanishes with, this mass.
+    Grid-agnostic like :func:`curve_collision_bound`."""
+    h = counts.astype(jnp.float32)
+    n = jnp.sum(h)
+    p = jnp.sum(h, axis=1)
+    t = jnp.sum(h, axis=0)
+    pairs = jnp.maximum(n * (n - 1.0), 1.0)
+    return (jnp.sum(p * (p - 1.0)) + jnp.sum(t * (t - 1.0))) / pairs
+
+
 def precision_recall_from_histogram(counts: Array) -> Tuple[Array, Array]:
     """(precision, recall) on the ascending ``B + 1`` threshold grid
     (``BinnedPrecisionRecallCurve`` conventions: 0 where undefined), except
@@ -486,10 +521,16 @@ def rank_sketch_spec(
     )
 
 
-def canonicalize_approx(approx: Optional[str]) -> Optional[str]:
-    """Validate an ``approx=`` constructor argument (None = exact buffers)."""
-    if approx not in (None, "sketch"):
-        raise ValueError(f"`approx` must be None or 'sketch', got {approx!r}")
+def canonicalize_approx(
+    approx: Optional[str], allowed: Tuple[str, ...] = ("sketch",)
+) -> Optional[str]:
+    """Validate an ``approx=`` constructor argument (None = exact buffers).
+    Metrics that also support the log-bucketed quantile-sketch grid pass
+    ``allowed=("sketch", "qsketch")``."""
+    if approx is not None and approx not in allowed:
+        raise ValueError(
+            f"`approx` must be None or one of {allowed}, got {approx!r}"
+        )
     return approx
 
 

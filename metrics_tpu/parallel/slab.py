@@ -42,11 +42,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
+from metrics_tpu.parallel.qsketch import QuantileSketch
 from metrics_tpu.parallel.sketch import HistogramSketch, RankSketch, is_sketch
 
 __all__ = [
     "LRUSlotTable",
     "SLAB_REDUCES",
+    "SLAB_SKETCH_KINDS",
     "SlabSpec",
     "dropped_slot_count",
     "is_slab_spec",
@@ -65,7 +67,11 @@ __all__ = [
 # and sync through the same bucketed psum as every sum leaf.
 SLAB_REDUCES = ("sum", "mean", "min", "max")
 
-_SKETCH_KINDS = {"hist": HistogramSketch, "rank": RankSketch}
+# sketch slab kinds: the slab keeps the sketch TYPE with a leading (K, ...)
+# counts axis. "qsketch" rows are log-bucketed quantile sketches — what
+# Keyed(Quantile(q=0.99)) turns per-tenant latency into.
+_SKETCH_KINDS = {"hist": HistogramSketch, "rank": RankSketch, "qsketch": QuantileSketch}
+SLAB_SKETCH_KINDS = tuple(_SKETCH_KINDS)
 
 
 class SlabSpec(NamedTuple):
@@ -115,15 +121,17 @@ def make_slab_spec(
 ) -> SlabSpec:
     """Validate and build one :class:`SlabSpec` from the inner state's host
     template. Sum/mean templates must be zero (see the class docstring)."""
-    if kind not in ("array", "hist", "rank"):
-        raise ValueError(f"slab kind must be 'array', 'hist' or 'rank', got {kind!r}")
+    if kind != "array" and kind not in _SKETCH_KINDS:
+        raise ValueError(
+            f"slab kind must be 'array' or one of {SLAB_SKETCH_KINDS}, got {kind!r}"
+        )
     if reduce not in SLAB_REDUCES:
         raise ValueError(f"slab reduce must be one of {SLAB_REDUCES}, got {reduce!r}")
     if not isinstance(num_slots, int) or num_slots < 1:
         raise ValueError(f"`num_slots` must be a positive int, got {num_slots!r}")
     template = np.asarray(template)
     fill: Optional[bytes] = None
-    if reduce in ("sum", "mean") or kind in ("hist", "rank"):
+    if reduce in ("sum", "mean") or kind in _SKETCH_KINDS:
         if np.any(template != 0):
             raise ValueError(
                 f"a {reduce!r}-kind slab needs a zero default template (the per-sample"

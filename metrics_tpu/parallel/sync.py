@@ -180,12 +180,13 @@ def merge_values_stacked(fx: ReduceFx, acc: Any, stacked: Any) -> Any:
 def is_stack_mergeable(fx: ReduceFx, default: Any) -> bool:
     """Whether a state supports the one-op stacked merge (no lists/buffers)."""
     from metrics_tpu.parallel.cms import CMSSpec
+    from metrics_tpu.parallel.qsketch import QSketchSpec
     from metrics_tpu.parallel.sketch import SketchSpec
     from metrics_tpu.parallel.slab import SlabSpec
 
     if isinstance(default, (list, PaddedBuffer)):
         return False
-    if is_sketch(default) or isinstance(default, (SketchSpec, CMSSpec)):
+    if is_sketch(default) or isinstance(default, (SketchSpec, CMSSpec, QSketchSpec)):
         return True  # one stacked-sum fold of the counts
     if isinstance(default, SlabSpec):
         # slab rows register sum/min/max sync reductions, all of which have
@@ -197,13 +198,15 @@ def is_stack_mergeable(fx: ReduceFx, default: Any) -> bool:
 def is_mergeable(fx: ReduceFx, default: Any) -> bool:
     """Whether a state with this reduction supports pairwise merge (fused forward)."""
     from metrics_tpu.parallel.cms import CMSSpec
+    from metrics_tpu.parallel.qsketch import QSketchSpec
     from metrics_tpu.parallel.sketch import SketchSpec
     from metrics_tpu.parallel.slab import SlabSpec
 
     if isinstance(default, (list, PaddedBuffer)) or fx == "cat":
         return True
-    if is_sketch(default) or isinstance(default, (SketchSpec, CMSSpec)):
-        # count-min tails are one more counts leaf: merge = elementwise add
+    if is_sketch(default) or isinstance(default, (SketchSpec, CMSSpec, QSketchSpec)):
+        # count-min tails and quantile sketches are one more counts leaf:
+        # merge = elementwise add
         return True
     if isinstance(default, SlabSpec):
         return True  # per-slot sum/min/max rows merge elementwise

@@ -2,6 +2,8 @@ from metrics_tpu.regression.cosine_similarity import CosineSimilarity
 from metrics_tpu.regression.explained_variance import ExplainedVariance
 from metrics_tpu.regression.kl_divergence import KLDivergence
 from metrics_tpu.regression.mean_absolute_error import MeanAbsoluteError
+from metrics_tpu.regression.median_absolute_error import MedianAbsoluteError
+from metrics_tpu.regression.quantile import Percentile, Quantile
 from metrics_tpu.regression.mean_squared_error import MeanSquaredError
 from metrics_tpu.regression.mean_squared_log_error import MeanSquaredLogError
 from metrics_tpu.regression.pearson import PearsonCorrcoef

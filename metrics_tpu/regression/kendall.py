@@ -20,10 +20,18 @@ from jax import Array
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.functional.regression.kendall import _kendall_kernel, _warn_if_quadratic
 from metrics_tpu.parallel.buffer import as_values
+from metrics_tpu.parallel.qsketch import (
+    QSKETCH_RANK_ALPHA,
+    QuantileSketch,
+    qsketch_rank_group_key,
+    qsketch_rank_spec,
+    qsketch_rank_update,
+)
 from metrics_tpu.parallel.sketch import (
     RankSketch,
     canonicalize_approx,
     kendall_from_joint,
+    rank_collision_bound,
     rank_sketch_group_key,
     rank_sketch_spec,
     sketch_rank_update,
@@ -50,6 +58,12 @@ class KendallRankCorrCoef(Metric):
     compute group — as sketch-mode :class:`~metrics_tpu.regression.spearman.
     SpearmanCorrcoef`.
 
+    ``approx="qsketch"`` bins the same joint histogram on the RANGE-FREE
+    log-bucketed relative-accuracy grid (``alpha``; ``sketch_range`` must
+    stay ``None``), keeping per-decade resolution on heavy-tailed values
+    where the soft-sign squash collapses toward its end bins; shared with
+    qsketch-mode Spearman, with :meth:`collision_bound` as the certificate.
+
     Example:
         >>> import jax.numpy as jnp
         >>> preds = jnp.array([1.0, 2.0, 3.0, 4.0])
@@ -69,6 +83,7 @@ class KendallRankCorrCoef(Metric):
         approx: Optional[str] = None,
         num_bins: int = 512,
         sketch_range: Optional[Tuple[float, float]] = None,
+        alpha: float = QSKETCH_RANK_ALPHA,
     ):
         super().__init__(
             compute_on_step=compute_on_step,
@@ -77,11 +92,21 @@ class KendallRankCorrCoef(Metric):
             dist_sync_fn=dist_sync_fn,
             capacity=capacity,
         )
-        self.approx = canonicalize_approx(approx)
+        self.approx = canonicalize_approx(approx, allowed=("sketch", "qsketch"))
         self.num_bins = num_bins
         self.sketch_range = None if sketch_range is None else tuple(sketch_range)
+        self.alpha = float(alpha)
         if self.sketch_range is not None and len(self.sketch_range) != 2:
             raise ValueError(f"`sketch_range` must be None or a (lo, hi) pair, got {sketch_range!r}")
+        if self.approx == "qsketch":
+            if self.sketch_range is not None:
+                raise ValueError(
+                    "approx='qsketch' is range-free by construction (the log-bucketed"
+                    " grid has no (lo, hi)); drop `sketch_range`, or use"
+                    " approx='sketch' for the fixed linear grid"
+                )
+            self.add_state("joint", default=qsketch_rank_spec(self.alpha), dist_reduce_fx="sum")
+            return
         if self.approx == "sketch":
             lo, hi = self.sketch_range if self.sketch_range is not None else (None, None)
             self.add_state("joint", default=rank_sketch_spec(num_bins, lo, hi), dist_reduce_fx="sum")
@@ -91,15 +116,26 @@ class KendallRankCorrCoef(Metric):
         rank_zero_warn_once(
             "Metric `KendallRankCorrCoef` stores every prediction and target in"
             " an O(samples) buffer state and computes an O(N^2) pairwise"
-            " contraction at epoch end. Construct with `approx=\"sketch\"` for"
-            " a constant-memory joint-histogram rank sketch (psum-synced,"
-            " O(num_bins^2) compute); exact buffers remain the default."
+            " contraction at epoch end. Construct with `approx=\"qsketch\"` for"
+            " a constant-memory RANGE-FREE joint rank sketch on the log-bucketed"
+            " relative-accuracy grid, or `approx=\"sketch\"` for the fixed-grid"
+            " variant (both psum-synced, O(bins^2) compute); exact buffers"
+            " remain the default."
         )
 
     def update(self, preds: Array, target: Array) -> None:
         _check_same_shape(preds, target)
         if preds.ndim != 1:
             raise ValueError("Expected both `preds` and `target` to be 1D arrays of scalar scores")
+        if self.approx == "qsketch":
+            spec = self._defaults["joint"]
+            self.joint = QuantileSketch(
+                qsketch_rank_update(
+                    self.joint.counts, jnp.asarray(preds), jnp.asarray(target),
+                    spec.alpha, spec.min_value, spec.max_value,
+                )
+            )
+            return
         if self.approx == "sketch":
             lo, hi = self.sketch_range if self.sketch_range is not None else (None, None)
             self.joint = RankSketch(
@@ -112,21 +148,31 @@ class KendallRankCorrCoef(Metric):
     def _group_fingerprint(self) -> Optional[Any]:
         # the same joint-histogram update plane as sketch-mode Spearman:
         # equal sketch config -> one shared compute-group delta
+        if self.approx == "qsketch":
+            return qsketch_rank_group_key(self)
         if self.approx == "sketch":
             return rank_sketch_group_key(self)
         return super()._group_fingerprint()
 
     def _states_own_sync(self) -> bool:
-        if self.approx == "sketch":
+        if self.approx in ("sketch", "qsketch"):
             return False  # sketch sync IS the psum plane
         from metrics_tpu.parallel.sharded_dispatch import rank_corr_applicable
 
         return rank_corr_applicable(self) is not None
 
+    def collision_bound(self) -> Array:
+        """Data-dependent resolution certificate of the sketch modes: the
+        colliding-pair fraction the binned statistic resolves as ties
+        (see ``sketch.rank_collision_bound``)."""
+        if self.approx not in ("sketch", "qsketch"):
+            raise ValueError("collision_bound() needs approx='sketch' or 'qsketch'")
+        return rank_collision_bound(self.joint.counts)
+
     def compute(self) -> Array:
         from metrics_tpu.parallel.sharded_dispatch import kendall_sharded
 
-        if self.approx == "sketch":
+        if self.approx in ("sketch", "qsketch"):
             return kendall_from_joint(self.joint.counts)
         sharded = kendall_sharded(self)  # row-sharded epoch states: split O(N^2) ring
         if sharded is not None:

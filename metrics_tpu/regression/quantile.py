@@ -1,0 +1,171 @@
+"""Quantile / Percentile modules: the latency/distribution metric family.
+
+The canonical production-serving question — "what is the p99 latency?" — has
+no answer in moment-style regression metrics, and an exact answer needs the
+whole sample. These metrics keep a constant-memory
+:class:`~metrics_tpu.parallel.qsketch.QuantileSketch` instead (log-bucketed,
+relative-accuracy ``alpha``): ``update`` is one jittable scatter-add,
+``sync`` is one psum riding the coalesced sum buckets (bit-exact mergeable
+across devices, processes, windows, and fleet shards), and ``compute``
+answers ANY quantile within relative error ``alpha`` with a data-dependent
+certificate (:meth:`Quantile.error_bound`).
+
+Composition is the point: ``Keyed(Quantile(q=0.99), K)`` is per-tenant p99,
+``Windowed(Keyed(Quantile(q=0.99), K), window_s=60)`` is per-tenant sliding
+p99 — the canonical dashboard metric — and both sync with the IDENTICAL
+staged collective program as the unkeyed scalar metric (the sketch is one
+sum leaf; slots/windows are leading state axes). See
+``docs/streaming.md`` for the recipe of record.
+"""
+from typing import Any, Callable, Optional, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.parallel.qsketch import (
+    QSKETCH_ALPHA,
+    QSKETCH_MAX_VALUE,
+    QSKETCH_MIN_VALUE,
+    QuantileSketch,
+    qsketch_update,
+    qsketch_value_group_key,
+    quantile_error_bound,
+    quantile_from_counts,
+    quantile_sketch_spec,
+)
+
+__all__ = ["Percentile", "Quantile"]
+
+
+def _canonical_q(q: Union[float, Sequence[float]]) -> Union[float, tuple]:
+    """Validate and canonicalize ``q`` to a float or tuple of floats (both
+    hashable: the requested quantiles are ordinary fingerprintable config)."""
+    if np.ndim(q) == 0:
+        qf = float(q)
+        if not 0.0 <= qf <= 1.0:
+            raise ValueError(f"`q` must be in [0, 1], got {q!r}")
+        return qf
+    qs = tuple(float(v) for v in np.asarray(q).reshape(-1))
+    if not qs:
+        raise ValueError("`q` must name at least one quantile")
+    if any(not 0.0 <= v <= 1.0 for v in qs):
+        raise ValueError(f"every `q` must be in [0, 1], got {q!r}")
+    return qs
+
+
+class Quantile(Metric):
+    r"""Accumulated quantile(s) of a value stream, to relative accuracy
+    ``alpha``.
+
+    Args:
+        q: the quantile(s) to report — a float in ``[0, 1]`` (scalar
+            ``compute()``) or a sequence (vector ``compute()``, one synced
+            sketch answering all of them). ``q`` is COMPUTE-ONLY config:
+            ``Quantile(q=0.5)``, ``Quantile(q=0.99)`` and
+            ``Percentile(95)`` instances with equal grid config share one
+            compute-group update plane inside a ``MetricCollection``.
+        alpha: relative accuracy of the log-bucketed grid (DDSketch-style).
+        min_value / max_value: the certified magnitude span. Values below
+            ``min_value`` in magnitude report exactly ``0.0`` (absolute
+            error under ``min_value``); values beyond ``max_value`` land in
+            the signed overflow buckets, counted and ordered but flagged
+            uncertified by :meth:`error_bound`.
+
+    NaN values are DROPPED (masked scatter, PR 7's sketch convention);
+    ``±inf`` clips into the signed overflow buckets. ``compute()`` is
+    ``nan`` on an empty sketch.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> latency = Quantile(q=0.99)
+        >>> latency.update(jnp.asarray([0.12, 0.31, 0.09, 4.2]))
+        >>> float(latency.compute())  # doctest: +SKIP
+        4.2
+    """
+
+    def __init__(
+        self,
+        q: Union[float, Sequence[float]] = 0.5,
+        alpha: float = QSKETCH_ALPHA,
+        min_value: float = QSKETCH_MIN_VALUE,
+        max_value: float = QSKETCH_MAX_VALUE,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+        jit: Optional[bool] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+            jit=jit,
+        )
+        self.q = _canonical_q(q)
+        spec = quantile_sketch_spec(alpha, min_value, max_value)
+        self.alpha = spec.alpha
+        self.min_value = spec.min_value
+        self.max_value = spec.max_value
+        self.add_state("qsketch", default=spec, dist_reduce_fx="sum")
+
+    def update(self, values: Array) -> None:
+        """Fold one batch of raw values into the sketch (any shape; raveled)."""
+        self.qsketch = QuantileSketch(
+            qsketch_update(
+                self.qsketch.counts, jnp.asarray(values),
+                self.alpha, self.min_value, self.max_value,
+            )
+        )
+
+    def _group_fingerprint(self) -> Optional[Any]:
+        # the requested q is compute-only: equal-grid Quantile/Percentile
+        # instances share ONE scatter-add update plane and one synced sketch
+        return qsketch_value_group_key(self)
+
+    def compute(self) -> Array:
+        return quantile_from_counts(
+            self.qsketch.counts, self.q, self.alpha, self.min_value, self.max_value
+        )
+
+    def error_bound(self) -> Array:
+        """Data-dependent certificate for the current :meth:`compute` value:
+        per-quantile relative bound ``alpha`` (``|estimate - true| <=
+        alpha * |true| + min_value``) wherever the rank resolves inside the
+        certified span, ``inf`` where it resolves in an overflow bucket."""
+        return quantile_error_bound(
+            self.qsketch.counts, self.q, self.alpha, self.min_value, self.max_value
+        )
+
+    def __repr__(self) -> str:
+        return f"{self.__class__.__name__}(q={self.q!r}, alpha={self.alpha!r})"
+
+
+class Percentile(Quantile):
+    """:class:`Quantile` addressed on the 0–100 percentile scale:
+    ``Percentile(99)`` is ``Quantile(q=0.99)`` (same state, same compute
+    group, same certificate)."""
+
+    def __init__(
+        self,
+        p: Union[float, Sequence[float]] = 50.0,
+        alpha: float = QSKETCH_ALPHA,
+        min_value: float = QSKETCH_MIN_VALUE,
+        max_value: float = QSKETCH_MAX_VALUE,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+        jit: Optional[bool] = None,
+    ):
+        if np.ndim(p) == 0:
+            q: Union[float, tuple] = float(p) / 100.0
+        else:
+            q = tuple(float(v) / 100.0 for v in np.asarray(p).reshape(-1))
+        super().__init__(
+            q=q, alpha=alpha, min_value=min_value, max_value=max_value,
+            compute_on_step=compute_on_step, dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group, dist_sync_fn=dist_sync_fn, jit=jit,
+        )

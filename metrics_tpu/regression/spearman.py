@@ -19,9 +19,17 @@ from jax import Array
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.functional.regression.spearman import _spearman_jitted, _spearman_kernel
 from metrics_tpu.parallel.buffer import as_values
+from metrics_tpu.parallel.qsketch import (
+    QSKETCH_RANK_ALPHA,
+    QuantileSketch,
+    qsketch_rank_group_key,
+    qsketch_rank_spec,
+    qsketch_rank_update,
+)
 from metrics_tpu.parallel.sketch import (
     RankSketch,
     canonicalize_approx,
+    rank_collision_bound,
     rank_sketch_group_key,
     rank_sketch_spec,
     sketch_rank_update,
@@ -44,6 +52,15 @@ class SpearmanCorrcoef(Metric):
     unbinned value as the grid refines. ``update`` is one scatter-add and
     ``sync`` one psum (bit-exact mergeable across devices/processes).
 
+    ``approx="qsketch"`` bins the joint histogram on the log-bucketed
+    relative-accuracy grid of :mod:`~metrics_tpu.parallel.qsketch` instead
+    (``alpha`` sets the grid; ``sketch_range`` must stay ``None``): a
+    RANGE-FREE grid with real resolution at every magnitude — heavy-tailed
+    and drifting value distributions keep per-decade bucket density where
+    the soft-sign squash collapses them toward its end bins. Same one-psum
+    sync contract; :meth:`collision_bound` reports the data-dependent
+    resolution certificate.
+
     Example:
         >>> import jax.numpy as jnp
         >>> target = jnp.array([3.0, -0.5, 2.0, 1.0])
@@ -63,6 +80,7 @@ class SpearmanCorrcoef(Metric):
         approx: Optional[str] = None,
         num_bins: int = 512,
         sketch_range: Optional[Tuple[float, float]] = None,
+        alpha: float = QSKETCH_RANK_ALPHA,
     ):
         super().__init__(
             compute_on_step=compute_on_step,
@@ -71,11 +89,21 @@ class SpearmanCorrcoef(Metric):
             dist_sync_fn=dist_sync_fn,
             capacity=capacity,
         )
-        self.approx = canonicalize_approx(approx)
+        self.approx = canonicalize_approx(approx, allowed=("sketch", "qsketch"))
         self.num_bins = num_bins
         self.sketch_range = None if sketch_range is None else tuple(sketch_range)
+        self.alpha = float(alpha)
         if self.sketch_range is not None and len(self.sketch_range) != 2:
             raise ValueError(f"`sketch_range` must be None or a (lo, hi) pair, got {sketch_range!r}")
+        if self.approx == "qsketch":
+            if self.sketch_range is not None:
+                raise ValueError(
+                    "approx='qsketch' is range-free by construction (the log-bucketed"
+                    " grid has no (lo, hi)); drop `sketch_range`, or use"
+                    " approx='sketch' for the fixed linear grid"
+                )
+            self.add_state("joint", default=qsketch_rank_spec(self.alpha), dist_reduce_fx="sum")
+            return
         if self.approx == "sketch":
             lo, hi = self.sketch_range if self.sketch_range is not None else (None, None)
             self.add_state("joint", default=rank_sketch_spec(num_bins, lo, hi), dist_reduce_fx="sum")
@@ -86,15 +114,25 @@ class SpearmanCorrcoef(Metric):
             "Metric `SpearmanCorrcoef` stores every prediction and target in an"
             " O(samples) buffer state (ranks are global over the epoch), so"
             " memory and sync traffic grow with the dataset. Construct with"
-            " `approx=\"sketch\"` for a constant-memory joint-histogram rank"
-            " sketch that syncs with one psum; exact buffers remain the"
-            " default."
+            " `approx=\"qsketch\"` for a constant-memory RANGE-FREE joint rank"
+            " sketch on the log-bucketed relative-accuracy grid, or"
+            " `approx=\"sketch\"` for the fixed-grid variant — both sync with"
+            " one psum; exact buffers remain the default."
         )
 
     def update(self, preds: Array, target: Array) -> None:
         _check_same_shape(preds, target)
         if preds.ndim != 1:
             raise ValueError("Expected both `preds` and `target` to be 1D arrays of scalar predictions")
+        if self.approx == "qsketch":
+            spec = self._defaults["joint"]
+            self.joint = QuantileSketch(
+                qsketch_rank_update(
+                    self.joint.counts, jnp.asarray(preds), jnp.asarray(target),
+                    spec.alpha, spec.min_value, spec.max_value,
+                )
+            )
+            return
         if self.approx == "sketch":
             lo, hi = self.sketch_range if self.sketch_range is not None else (None, None)
             self.joint = RankSketch(
@@ -107,21 +145,34 @@ class SpearmanCorrcoef(Metric):
     def _group_fingerprint(self) -> Optional[Any]:
         # sketch-mode rank metrics (Spearman/Kendall) share ONE joint-histogram
         # update plane: equal sketch config -> one compute-group delta
+        if self.approx == "qsketch":
+            return qsketch_rank_group_key(self)
         if self.approx == "sketch":
             return rank_sketch_group_key(self)
         return super()._group_fingerprint()
 
     def _states_own_sync(self) -> bool:
-        if self.approx == "sketch":
+        if self.approx in ("sketch", "qsketch"):
             return False  # sketch sync IS the psum plane
         from metrics_tpu.parallel.sharded_dispatch import rank_corr_applicable
 
         return rank_corr_applicable(self) is not None
 
+    def collision_bound(self) -> Array:
+        """Data-dependent resolution certificate of the sketch modes: the
+        fraction of pairs colliding in one grid bucket on either variable —
+        the only pairs the binned-rank statistic resolves as ties instead
+        of exactly (see ``sketch.rank_collision_bound``)."""
+        if self.approx not in ("sketch", "qsketch"):
+            raise ValueError("collision_bound() needs approx='sketch' or 'qsketch'")
+        return rank_collision_bound(self.joint.counts)
+
     def compute(self) -> Array:
         from metrics_tpu.parallel.sharded_dispatch import spearman_sharded
 
-        if self.approx == "sketch":
+        if self.approx in ("sketch", "qsketch"):
+            # both grids are strictly monotone: the binned-rank (midrank)
+            # correlation over the joint counts is the statistic either way
             return spearman_from_joint(self.joint.counts)
         sharded = spearman_sharded(self)  # row-sharded epoch states: exact ring
         if sharded is not None:

@@ -69,6 +69,7 @@ from metrics_tpu.parallel.cms import (
     make_cms_spec,
     stable_key_hashes,
 )
+from metrics_tpu.parallel.qsketch import QSketchSpec, QuantileSketch
 from metrics_tpu.parallel.sketch import SketchSpec, is_sketch, sketch_init
 from metrics_tpu.parallel.slab import (
     SlabSpec,
@@ -398,9 +399,14 @@ class HeavyHitters(Metric):
         """The hot-tier ``SlabSpec`` one inner state maps onto, or a loud
         rejection. Narrower than ``Keyed``: the tail's certified-overcount
         read needs non-negative additive deltas, so only sum/mean/sketch."""
-        if isinstance(spec, SketchSpec):
+        if isinstance(spec, (SketchSpec, QSketchSpec)):
+            # quantile sketches qualify for the tail too: their deltas are
+            # non-negative bucket counts, so the CMS overcount certificate
+            # holds per cell (per-key tail quantiles stay an overcount-
+            # bounded histogram read)
+            kind = "qsketch" if isinstance(spec, QSketchSpec) else spec.kind
             return make_slab_spec(self.num_hot_slots, np.zeros(spec.shape, np.dtype(spec.dtype)),
-                                  "sum", kind=spec.kind)
+                                  "sum", kind=kind)
         if isinstance(spec, (list, PaddedBuffer)) or fx == "cat" or fx is None:
             raise ValueError(
                 f"state {name!r} of {type(self.metric).__name__} is a cat/list/buffer"
@@ -623,6 +629,8 @@ class HeavyHitters(Metric):
                 )
             if isinstance(spec, SketchSpec):
                 leaf = type(sketch_init(spec))(leaf)
+            elif isinstance(spec, QSketchSpec):
+                leaf = QuantileSketch(leaf)
             inner_state[name] = leaf
         result = self.metric.compute_from_state(inner_state)
 

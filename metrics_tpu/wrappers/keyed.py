@@ -37,6 +37,7 @@ from metrics_tpu.observability.counters import (
     record_slab_slots,
 )
 from metrics_tpu.parallel.buffer import PaddedBuffer
+from metrics_tpu.parallel.qsketch import QSketchSpec
 from metrics_tpu.parallel.sketch import SketchSpec, is_sketch, sketch_init
 from metrics_tpu.parallel.slab import (
     LRUSlotTable,
@@ -152,6 +153,12 @@ class Keyed(Metric):
             kind = spec.kind  # "hist" | "rank": counts grow a leading K axis
             return make_slab_spec(self.num_slots, np.zeros(spec.shape, np.dtype(spec.dtype)),
                                   "sum", kind=kind)
+        if isinstance(spec, QSketchSpec):
+            # quantile sketches slab like any sketch: the counts grow a
+            # leading K axis and every row stays a QuantileSketch — this is
+            # the per-tenant p99 state (Keyed(Quantile(q=0.99), K))
+            return make_slab_spec(self.num_slots, np.zeros(spec.shape, np.dtype(spec.dtype)),
+                                  "sum", kind="qsketch")
         if isinstance(spec, (list, PaddedBuffer)) or fx == "cat" or fx is None:
             raise ValueError(
                 f"state {name!r} of {type(self.metric).__name__} is a cat/list/buffer"

@@ -62,8 +62,10 @@ from metrics_tpu.core.streaming import (
 from metrics_tpu.observability.counters import record_slab_dropped
 from metrics_tpu.wrappers.keyed import Keyed
 from metrics_tpu.parallel.buffer import PaddedBuffer
+from metrics_tpu.parallel.qsketch import QSketchSpec
 from metrics_tpu.parallel.sketch import SketchSpec, is_sketch
 from metrics_tpu.parallel.slab import (
+    SLAB_SKETCH_KINDS,
     SlabSpec,
     dropped_slot_count,
     make_slab_spec,
@@ -240,15 +242,16 @@ class Windowed(Metric):
 
     def _slab_spec_for(self, name: str, spec: Any, fx: Any) -> SlabSpec:
         """The ``SlabSpec`` one inner state maps onto, or a loud rejection."""
-        if isinstance(spec, SketchSpec):
+        if isinstance(spec, (SketchSpec, QSketchSpec)):
             if self.decay:
                 raise ValueError(
                     f"state {name!r} is a sketch state; integer sketch counts have no"
                     " exponential-decay form — use the windowed ring (window_s=) for"
                     " sketch metrics"
                 )
+            kind = "qsketch" if isinstance(spec, QSketchSpec) else spec.kind
             return make_slab_spec(self.num_windows, np.zeros(spec.shape, np.dtype(spec.dtype)),
-                                  "sum", kind=spec.kind)
+                                  "sum", kind=kind)
         if isinstance(spec, (list, PaddedBuffer)) or fx == "cat" or fx is None:
             raise ValueError(
                 f"state {name!r} of {type(self.metric).__name__} is a cat/list/buffer"
@@ -268,7 +271,7 @@ class Windowed(Metric):
                     " does not nest over Keyed (its sum-backed mean division"
                     " clamps at 1 sample) — use the windowed ring"
                 )
-            if spec.kind in ("hist", "rank"):
+            if spec.kind in SLAB_SKETCH_KINDS:
                 return make_slab_spec(
                     self.num_windows, np.zeros(spec.row_shape, np.dtype(spec.dtype)),
                     "sum", kind=spec.kind,

@@ -135,3 +135,26 @@ def test_multilabel_pos_label_is_per_column_one():
     for pos_label in (0, 1, None):
         got = float(auroc(jnp.asarray(preds), jnp.asarray(target), num_classes=4, average="macro", pos_label=pos_label))
         assert abs(got - want) < 1e-6, (pos_label, got, want)
+
+
+def test_auroc_qsketch_auto_ranged_on_raw_logits():
+    """approx='qsketch': AUROC from un-sigmoided logits with NO
+    sketch_range assumption — the auto-ranged log-bucketed grid keeps the
+    order of scores far outside (0, 1), and the half-collision-mass
+    certificate bounds the deviation from sklearn."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    logits = (rng.randn(8000) * 4.0).astype(np.float32)  # raw, outside (0,1)
+    y = (rng.rand(8000) < 1.0 / (1.0 + np.exp(-logits))).astype(np.int32)
+    m = AUROC(approx="qsketch")
+    m.update(jnp.asarray(logits), jnp.asarray(y))
+    exact = sk_roc_auc_score(y, logits)
+    bound = float(m.error_bound())
+    assert abs(float(m.compute()) - exact) <= bound + 1e-3
+    assert 0.0 <= bound < 0.05
+
+
+def test_auroc_qsketch_rejects_max_fpr():
+    with pytest.raises(ValueError, match="max_fpr"):
+        AUROC(approx="qsketch", max_fpr=0.5)

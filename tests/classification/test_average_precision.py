@@ -91,3 +91,20 @@ def test_average_precision_score(scores, target, expected_score):
 
     result = average_precision(jnp.asarray(scores, dtype=jnp.float32), jnp.asarray(target))
     assert np.isclose(float(result), expected_score)
+
+
+def test_average_precision_qsketch_auto_ranged_on_raw_scores():
+    """approx='qsketch': AP from raw un-sigmoided scores — no
+    sketch_range=(0, 1) assumption — with the collision-mass certificate
+    as the data-dependent resolution limit."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(1)
+    scores = (rng.randn(8000) * 5.0).astype(np.float32)
+    y = (rng.rand(8000) < 1.0 / (1.0 + np.exp(-scores))).astype(np.int32)
+    m = AveragePrecision(approx="qsketch")
+    m.update(jnp.asarray(scores), jnp.asarray(y))
+    exact = sk_average_precision_score(y, scores)
+    collision = float(m.collision_bound())
+    assert abs(float(m.compute()) - exact) <= collision + 5e-3
+    assert 0.0 <= collision < 0.05

@@ -113,6 +113,19 @@ def _assert_headline_schema(out):
     # the seeded ingest stream
     assert out["hh_tail_overcount_bound"] > 0
 
+    # the quantile-sketch A/B rides the same line: Keyed(Quantile(q=0.99))
+    # x 256 tenants — the per-tenant p99 plane — stages the SAME collective
+    # count and kinds as the unkeyed scalar Quantile (psum-only), and state
+    # bytes are DETERMINISTIC and traffic-independent:
+    # (256 slots * 281 log buckets + 256 rows) * 4 bytes
+    assert isinstance(out["qsketch_sync_ms"], (int, float)) and out["qsketch_sync_ms"] > 0
+    assert out["qsketch_states_synced"] == 2  # the counts slab + the row-count slab
+    assert out["qsketch_collective_calls"] == 2  # two-stage (ici + dcn) psum
+    assert out["qsketch_collective_calls"] == out["qsketch_unkeyed_collective_calls"]
+    assert out["qsketch_gather_calls"] == 0  # psum-only: the sketch contract
+    assert out["qsketch_sync_bytes"] == 577536  # (256*281 + 256) * 4 * 2 stages
+    assert out["qsketch_state_bytes"] == 288768  # (256*281 + 256) * 4 bytes
+
     # the windowed serving A/B rides the same line: Windowed(AUROC sketch)
     # x 4 window slots stages the SAME collective count and kinds as the
     # unwindowed metric — windows are a state axis, window roll is a slot
@@ -201,7 +214,10 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     out = _run_smoke(("--trace", str(trace_file)))
     _assert_headline_schema(out)
 
-    # schema version of the --trace payload: v11 added the rank-coherent
+    # schema version of the --trace payload: v12 added the quantile-sketch
+    # plane (qsketch_* staged-count keys pinned to the unkeyed scalar twin +
+    # the deterministic qsketch_state_bytes pin, gated by --check-quantile);
+    # v11 added the rank-coherent
     # streaming plane (wm_agreement_ms / wm_exchange_calls / wm_stragglers
     # zero-pinned + slide_windows_published on the default line, gated by
     # --check-watermark); v10 added the heavy-hitter
@@ -219,7 +235,7 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     # windowed serving A/B; v5 the keyed slab A/B; v4 the sketch A/B; v3
     # moved the collective counts to the default line and added the
     # hierarchical A/B + per-crossing counters; bump this pin with the schema
-    assert out["trace_schema"] == 11
+    assert out["trace_schema"] == 12
     # the sketch program's full snapshot: psum-only, no gather kinds staged
     sketch_kinds = out["sketch_counters"]["calls_by_kind"]
     assert sketch_kinds.get("psum", 0) == 2
@@ -237,6 +253,12 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     for kind in ("all_gather", "coalesced_gather", "process_allgather"):
         assert hh_kinds.get(kind, 0) == 0, kind
     assert out["hh_counters"]["bytes_by_crossing"]["dcn"] == out["hh_sync_bytes"] // 2
+    # the quantile-sketch program: the same psum-only shape at K=256 tenants
+    qsk_kinds = out["qsketch_counters"]["calls_by_kind"]
+    assert qsk_kinds.get("psum", 0) == 2
+    for kind in ("all_gather", "coalesced_gather", "process_allgather"):
+        assert qsk_kinds.get(kind, 0) == 0, kind
+    assert out["qsketch_counters"]["bytes_by_crossing"]["dcn"] == out["qsketch_sync_bytes"] // 2
     # the windowed serving program: the same psum-only shape at W=4 slots
     service_kinds = out["service_counters"]["calls_by_kind"]
     assert service_kinds.get("psum", 0) == 2
@@ -597,6 +619,50 @@ def test_bench_check_watermark_gate():
     # sliding: every event covers window_s/slide_s = 3 overlapping windows
     assert out["sliding"]["overlap"] == 3
     assert out["sliding"]["windows_published"] == 12
+
+
+def test_bench_check_quantile_gate():
+    """``bench.py --check-quantile`` is the quantile-sketch gate: every
+    quantile estimate on the seeded Zipfian/Cauchy/lognormal streams must
+    land within the alpha relative-error certificate (overflow-bucket hits
+    flagged ``inf``, never silently certified), the (4,2)-mesh psum merge
+    must be bit-exact vs the single-process sketch, Keyed(Quantile) and
+    Windowed(Keyed(Quantile)) must stage the identical collective count as
+    the unkeyed scalar metric (psum-only, zero gathers), and qsketch state
+    bytes must stay constant over the stream while the capacity-buffer twin
+    grows."""
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, _BENCH, "--check-quantile"],
+        capture_output=True, text=True, timeout=280, env=env,
+        cwd=os.path.dirname(_BENCH),
+    )
+    assert proc.returncode == 0, f"--check-quantile failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["ok"] is True and out["failures"] == []
+    # certificate: all three seeded streams reported, every finite bound
+    # equals alpha (the zipf tail quantiles exceed max_value and flag inf)
+    assert set(out["certificate"]) == {"zipfian", "cauchy", "lognormal"}
+    for rows in out["certificate"].values():
+        for row in rows.values():
+            assert row["bound"] == out["alpha"] or row["bound"] == float("inf")
+    # the zipf p999 order stat is far beyond max_value: the certificate must
+    # FLAG it rather than certify it
+    assert out["certificate"]["zipfian"]["0.999"]["bound"] == float("inf")
+    # merge: bit-exact with nothing dropped (the gate stream is NaN-free)
+    assert out["merge"]["bit_exact"] is True
+    assert out["merge"]["total"] == 8 * 512
+    # parity: K slots and W x K windows never change the staged program
+    assert (
+        out["parity"]["unkeyed"]["collective_calls"]
+        == out["parity"]["keyed"]["collective_calls"]
+        == out["parity"]["windowed_keyed"]["collective_calls"]
+    )
+    assert all(tier["gather_calls"] == 0 for tier in out["parity"].values())
+    # memory: flat sketch, growing buffer twin
+    assert out["memory"]["qsketch_bytes"] > 0
+    assert out["memory"]["buffer_twin_bytes"][-1] > out["memory"]["buffer_twin_bytes"][0]
 
 
 def _run_trajectory(tmp_path, current, rounds):

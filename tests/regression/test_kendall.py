@@ -54,3 +54,17 @@ def test_kendall_degenerate():
 def test_kendall_validation():
     with pytest.raises(ValueError, match="1D"):
         kendall_rank_corrcoef(jnp.zeros((3, 2)), jnp.zeros((3, 2)))
+
+
+def test_kendall_qsketch_range_free_tracks_scipy():
+    """approx='qsketch': tau-b from the range-free log-bucketed joint grid
+    tracks scipy on heavy-tailed data, error driven by the collision mass."""
+    rng = np.random.RandomState(1)
+    x = rng.lognormal(0.0, 2.0, 3000).astype(np.float32)
+    y = (x * np.exp(rng.randn(3000) * 0.8)).astype(np.float32)
+    m = KendallRankCorrCoef(approx="qsketch")
+    m.update(jnp.asarray(x), jnp.asarray(y))
+    exact = float(_sk_kendall(x, y))
+    collision = float(m.collision_bound())
+    assert abs(float(m.compute()) - exact) <= 4.0 * collision + 0.02
+    assert 0.0 <= collision < 0.5

@@ -1,0 +1,161 @@
+"""Quantile / Percentile / MedianAbsoluteError metric-layer suite.
+
+The sketch machinery itself is pinned in ``tests/parallel/test_qsketch.py``;
+this suite covers the METRIC contract: accuracy within the certificate
+against numpy oracles on heavy-tailed streams, vector-``q`` reads, the
+dist-synced compute, forward/compute_on_step behavior, reset, and repr.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import MedianAbsoluteError, MetricCollection, Percentile, Quantile
+from metrics_tpu.parallel.sync import gather_all_arrays
+
+ALPHA, LO, HI = 0.01, 1e-9, 1e9
+
+
+def _assert_within_certificate(est, true, alpha=ALPHA, lo=LO):
+    """``true`` is a value or an (order-stat) bracket of candidate values:
+    the sketch certifies against the ORDER STATISTIC its rank selects, so
+    where adjacent order stats straddle numpy's interpolated quantile the
+    bracket is the honest oracle."""
+    est = float(est)
+    candidates = np.atleast_1d(np.asarray(true, dtype=np.float64))
+    ok = [
+        abs(est - t) <= alpha * abs(t) + lo + 3 * alpha * alpha * abs(t)
+        for t in candidates
+    ]
+    assert any(ok), (est, candidates)
+
+
+def _order_stat_bracket(x, q):
+    s = np.sort(np.asarray(x, dtype=np.float64))
+    r = q * (len(s) - 1)
+    return s[int(np.floor(r))], s[int(np.ceil(r))]
+
+
+@pytest.mark.parametrize("dist", ("lognormal", "exponential", "uniform", "discrete"))
+def test_quantile_tracks_numpy(dist):
+    rng = np.random.RandomState(0)
+    x = {
+        "lognormal": lambda: rng.lognormal(1.0, 2.0, 30000),
+        "exponential": lambda: rng.exponential(50.0, 30000),
+        "uniform": lambda: rng.uniform(0.1, 10.0, 30000),
+        "discrete": lambda: rng.zipf(1.7, 30000).astype(np.float64),
+    }[dist]()
+    for q in (0.5, 0.9, 0.99):
+        m = Quantile(q=q)
+        m.update(jnp.asarray(x.astype(np.float32)))
+        _assert_within_certificate(m.compute(), np.quantile(x, q))
+        assert float(m.error_bound()) == pytest.approx(ALPHA)
+
+
+def test_vector_q_one_sketch_many_quantiles():
+    rng = np.random.RandomState(1)
+    x = rng.lognormal(0, 1.5, 20000)
+    m = Quantile(q=[0.5, 0.9, 0.99])
+    m.update(jnp.asarray(x.astype(np.float32)))
+    est = np.asarray(m.compute())
+    assert est.shape == (3,)
+    for e, q in zip(est, (0.5, 0.9, 0.99)):
+        _assert_within_certificate(e, np.quantile(x, q))
+    assert np.asarray(m.error_bound()).shape == (3,)
+
+
+def test_percentile_is_quantile_on_the_100_scale():
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.lognormal(0, 1, 5000).astype(np.float32))
+    p = Percentile(99.0)
+    q = Quantile(q=0.99)
+    p.update(x)
+    q.update(x)
+    assert float(p.compute()) == float(q.compute())
+    np.testing.assert_array_equal(np.asarray(p.qsketch.counts), np.asarray(q.qsketch.counts))
+    pv = Percentile([50.0, 95.0])
+    pv.update(x)
+    assert np.asarray(pv.compute()).shape == (2,)
+
+
+def test_median_absolute_error_tracks_numpy():
+    rng = np.random.RandomState(3)
+    preds = rng.randn(20000) * 10.0
+    target = preds + rng.standard_cauchy(20000)  # heavy-tailed residuals
+    m = MedianAbsoluteError()
+    m.update(jnp.asarray(preds.astype(np.float32)), jnp.asarray(target.astype(np.float32)))
+    _assert_within_certificate(m.compute(), np.median(np.abs(preds - target)))
+    assert float(m.error_bound()) == pytest.approx(ALPHA)
+
+
+def test_median_absolute_error_shape_check():
+    m = MedianAbsoluteError()
+    with pytest.raises(Exception):
+        m.update(jnp.ones((3,)), jnp.ones((4,)))
+
+
+def test_negative_values_and_signs():
+    rng = np.random.RandomState(4)
+    x = rng.standard_cauchy(30000)  # both signs, huge tails
+    for q in (0.1, 0.5, 0.9):
+        m = Quantile(q=q)
+        m.update(jnp.asarray(x.astype(np.float32)))
+        # near the Cauchy median the order-stat spacing exceeds alpha*|v|,
+        # so certify against the selected order statistic's bracket
+        _assert_within_certificate(m.compute(), _order_stat_bracket(x, q))
+
+
+def test_empty_compute_is_nan_and_reset():
+    m = Quantile(q=0.9)
+    assert np.isnan(float(m.compute()))
+    m.update(jnp.asarray([1.0, 2.0, 3.0]))
+    assert not np.isnan(float(m.compute()))
+    m.reset()
+    assert np.isnan(float(m.compute()))
+    assert int(np.asarray(m.qsketch.counts).sum()) == 0
+
+
+def test_forward_returns_batch_value_and_accumulates():
+    rng = np.random.RandomState(5)
+    a = rng.lognormal(0, 1, 1000).astype(np.float32)
+    b = rng.lognormal(0, 1, 1000).astype(np.float32)
+    m = Quantile(q=0.5)
+    batch_val = m(jnp.asarray(a))
+    _assert_within_certificate(batch_val, np.quantile(a, 0.5))
+    m(jnp.asarray(b))
+    _assert_within_certificate(m.compute(), np.quantile(np.concatenate([a, b]), 0.5))
+
+
+def test_dist_synced_compute_matches_single_process():
+    """The host sync plane (gather_all_arrays single-process identity) keeps
+    the sketch intact; a merged two-metric fold equals the union stream."""
+    rng = np.random.RandomState(6)
+    x = rng.lognormal(0, 2, 4000).astype(np.float32)
+    m1 = Quantile(q=0.99, dist_sync_fn=gather_all_arrays)
+    m1.update(jnp.asarray(x[:2000]))
+    m2 = Quantile(q=0.99)
+    m2.update(jnp.asarray(x[2000:]))
+    merged = m1.merge_states(m1._current_state(), m2._current_state())
+    single = Quantile(q=0.99)
+    single.update(jnp.asarray(x))
+    np.testing.assert_array_equal(
+        np.asarray(merged["qsketch"].counts), np.asarray(single.qsketch.counts)
+    )
+    assert float(m1.compute_from_state(merged)) == float(single.compute())
+
+
+def test_collection_shares_one_update_plane():
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.lognormal(0, 1, 3000).astype(np.float32))
+    col = MetricCollection({"p50": Quantile(q=0.5), "p99": Quantile(q=0.99)})
+    col.update(x)
+    out = {k: float(v) for k, v in col.compute().items()}
+    solo50, solo99 = Quantile(q=0.5), Quantile(q=0.99)
+    solo50.update(x)
+    solo99.update(x)
+    assert out["p50"] == float(solo50.compute())
+    assert out["p99"] == float(solo99.compute())
+
+
+def test_repr_names_q_and_alpha():
+    assert "0.99" in repr(Quantile(q=0.99))
+    assert "alpha" in repr(Percentile(95.0))

@@ -66,3 +66,29 @@ def test_spearman_errors():
         SpearmanCorrcoef().update(jnp.zeros((4, 2)), jnp.zeros((4, 2)))
     # constant input: zero rank variance -> nan (scipy convention)
     assert np.isnan(float(spearman_corrcoef(jnp.ones(6), jnp.arange(6.0))))
+
+
+def test_spearman_qsketch_range_free_tracks_scipy():
+    """approx='qsketch': the RANGE-FREE log-bucketed joint grid tracks scipy
+    on heavy-tailed data with no sketch_range configuration, and exposes the
+    collision-mass certificate."""
+    rng = np.random.RandomState(0)
+    x = rng.lognormal(0.0, 2.5, 6000).astype(np.float32)  # 10+ decades
+    y = (x * np.exp(rng.randn(6000) * 0.5)).astype(np.float32)
+    m = SpearmanCorrcoef(approx="qsketch")
+    m.update(jnp.asarray(x), jnp.asarray(y))
+    exact = _sk_spearman(x[None], y[None])
+    collision = float(m.collision_bound())
+    assert abs(float(m.compute()) - exact) <= 3.0 * collision + 0.02
+    assert 0.0 <= collision < 0.5
+
+
+def test_spearman_qsketch_shares_group_with_kendall():
+    from metrics_tpu import MetricCollection
+    from metrics_tpu.regression.kendall import KendallRankCorrCoef
+
+    col = MetricCollection([
+        SpearmanCorrcoef(approx="qsketch"),
+        KendallRankCorrCoef(approx="qsketch"),
+    ])
+    assert len(set(col._group_map().values())) == 1
