@@ -46,6 +46,18 @@ What gets counted, and on which plane:
   because the state pytree was empty/all-``None`` (a zero-payload gather is
   a pure liability: one more rendezvous every rank must enter). A health
   counter, not a fault — nonzero on clean runs is fine.
+- **sparse**: the sparse delta-sync plane's round ledger
+  (``parallel/sparse.py``): ``syncs`` rounds run, ``rows`` cumulative union
+  rows exchanged (the number whose ratio to ``syncs * K`` is the measured
+  sparsity), ``fallbacks`` rounds whose union overflowed ``sparse_capacity=``
+  and re-ran on the dense coalesced plane (correctness never depends on the
+  sparsity estimate — the fallback IS the proof), and ``skips`` empty-union
+  rounds that skipped the row exchange entirely (each also bumps
+  ``gather_skips``). Recorded even while counting is DISABLED, the
+  fault-counter argument: a fallback is evidence the capacity estimate
+  broke, and rounds are epoch-level, never the compiled replay path.
+  ``sparse_fallbacks`` is pinned at zero on the clean bench trajectory
+  (``--check-trajectory``).
 - **slab_dropped_samples**: samples whose slot id fell outside a slab's
   ``[0, K)`` range and were therefore DROPPED by the scatter's XLA
   out-of-bounds semantics (``parallel/slab.py``) — bad segment ids in
@@ -162,6 +174,7 @@ __all__ = [
     "CollectiveCounters",
     "DEFERRED_KINDS",
     "FAULT_KINDS",
+    "SPARSE_KINDS",
     "enable",
     "disable",
     "is_enabled",
@@ -177,6 +190,9 @@ __all__ = [
     "record_service_health",
     "record_slab_dropped",
     "record_slab_slots",
+    "record_sparse_fallback",
+    "record_sparse_round",
+    "record_sparse_skip",
     "record_state_bytes",
     "record_states_synced",
     "record_watermark_agreement",
@@ -223,6 +239,16 @@ DEFERRED_KINDS = (
     "completed",  # syncs whose work finished (background task returned / fence cleared)
 )
 
+# sparse delta-sync round ledger (parallel/sparse.py); every snapshot carries
+# all four (zeros included) so consumers — the bench line, --check-trajectory's
+# sparse_fallbacks zero-pin — can bind on them unconditionally.
+SPARSE_KINDS = (
+    "syncs",  # sparse rounds run (every mode: exchange, fallback, skip)
+    "rows",  # cumulative union rows exchanged (the measured sparsity numerator)
+    "fallbacks",  # rounds whose union overflowed capacity -> dense plane re-run
+    "skips",  # empty-union rounds that skipped the row exchange entirely
+)
+
 
 class CollectiveCounters:
     """Process-wide counters; ``enabled`` is the hot-path gate."""
@@ -245,6 +271,7 @@ class CollectiveCounters:
         "deferred_depth",
         "fleet_shards",
         "gather_skips",
+        "sparse",
         "slab_dropped_samples",
         "evicted_mass_dropped",
         "wm_stragglers",
@@ -278,6 +305,7 @@ class CollectiveCounters:
         self.deferred: Dict[str, int] = {k: 0 for k in DEFERRED_KINDS}
         self.deferred_depth: Dict[str, Dict[str, int]] = {}  # label -> {"current", "max"}
         self.gather_skips = 0
+        self.sparse: Dict[str, int] = {k: 0 for k in SPARSE_KINDS}  # sparse-plane round ledger
         self.slab_dropped_samples = 0  # out-of-range slot ids dropped by slab scatters
         self.evicted_mass_dropped = 0  # samples whose history LRU eviction destroyed
         self.wm_stragglers = 0  # ranks excluded from the watermark agreement
@@ -353,6 +381,29 @@ class CollectiveCounters:
     def record_gather_skip(self) -> None:
         with self._lock:
             self.gather_skips += 1
+
+    def record_sparse_round(self, rows: int) -> None:
+        """Count one sparse delta-sync round and the union rows it exchanged
+        (``rows`` is the union size — 0 on a skip, the actual union on a
+        fallback; negative is a bug at the call site — fail loudly)."""
+        if rows < 0:
+            raise ValueError(f"sparse union row count must be >= 0, got {rows}")
+        with self._lock:
+            self.sparse["syncs"] += 1
+            self.sparse["rows"] += int(rows)
+
+    def record_sparse_fallback(self) -> None:
+        """Count one sparse round whose union overflowed the fixed capacity
+        and re-ran on the dense coalesced plane."""
+        with self._lock:
+            self.sparse["fallbacks"] += 1
+
+    def record_sparse_skip(self) -> None:
+        """Count one empty-union sparse round that skipped the row exchange
+        (call sites also bump ``gather_skips`` — the skip IS a skipped
+        gather)."""
+        with self._lock:
+            self.sparse["skips"] += 1
 
     def record_slab_dropped(self, n: int = 1) -> None:
         """Count samples dropped by a slab scatter's out-of-range slot ids
@@ -469,6 +520,7 @@ class CollectiveCounters:
                 "deferred": dict(self.deferred),
                 "deferred_depth": {k: dict(v) for k, v in sorted(self.deferred_depth.items())},
                 "gather_skips": self.gather_skips,
+                "sparse": dict(self.sparse),
                 "slab_dropped_samples": self.slab_dropped_samples,
                 "evicted_mass_dropped": self.evicted_mass_dropped,
                 "wm_stragglers": self.wm_stragglers,
@@ -542,6 +594,23 @@ def record_deferred(kind: str, n: int = 1) -> None:
 def record_deferred_depth(label: str, current: int) -> None:
     if COUNTERS.enabled:
         COUNTERS.record_deferred_depth(label, current)
+
+
+# The sparse round ledger records UNCONDITIONALLY, same argument as the
+# fault counters: a dense fallback is evidence the capacity estimate broke,
+# and rounds are epoch-level (one host round-trip each), never the compiled
+# replay path — the syncs/rows/skips context rides along so the ledger is
+# interpretable without the enabled gate.
+def record_sparse_round(rows: int) -> None:
+    COUNTERS.record_sparse_round(rows)
+
+
+def record_sparse_fallback() -> None:
+    COUNTERS.record_sparse_fallback()
+
+
+def record_sparse_skip() -> None:
+    COUNTERS.record_sparse_skip()
 
 
 # Dropped-sample evidence records UNCONDITIONALLY, same argument as the

@@ -61,6 +61,7 @@ MS_KEYS: Tuple[str, ...] = (
     "gather_flat2d_ms",
     "sketch_sync_ms",
     "keyed_sync_ms",
+    "sparse_sync_ms",
     "hh_sync_ms",
     "qsketch_sync_ms",
     "service_sync_ms",
@@ -115,6 +116,13 @@ COUNT_KEYS: Tuple[str, ...] = (
     "keyed_gather_calls",
     "keyed_states_synced",
     "keyed_unkeyed_collective_calls",
+    # the sparse delta-sync plane: staged bytes follow the touched-row
+    # count, not the table size — any growth in its counts or bytes is a
+    # regression of the bytes-proportional-to-touched-rows story
+    "sparse_collective_calls",
+    "sparse_sync_bytes",
+    "sparse_gather_calls",
+    "sparse_states_synced",
     # the heavy-hitter plane: staged counts must stay independent of the
     # simulated key count (equal to the unkeyed metric's) and psum-only,
     # and the tail's (e/width)*N certificate may never GROW on the seeded
@@ -199,6 +207,10 @@ FAULT_KEYS: Tuple[str, ...] = (
     "degraded_computes",
     "quarantined_updates",
     "slab_dropped_samples",
+    # the clean bench sparse stream touches <= sparse_capacity rows per
+    # step, so a fallback to the dense plane means the sparse estimate or
+    # the capacity plumbing silently broke
+    "sparse_fallbacks",
     # the fleet merge tier may never lose a window on the clean bench stream
     "fleet_lost_windows",
     # the clean bench trajectory never excludes a rank from the agreed

@@ -78,6 +78,7 @@ __all__ = [
     "SyncHandle",
     "clear_program_cache",
     "deferred_host_gather",
+    "deferred_sparse_sync",
     "deferred_sync_state",
     "drain_host_plane",
     "host_plane_submit",
@@ -384,6 +385,48 @@ def deferred_host_gather(
     span_attrs = None
     if TRACE.enabled:
         span_attrs = {"plane": label}
+        if attrs:
+            span_attrs.update(attrs)
+    with _span("deferred.dispatch", span_attrs):
+        future = _HOST_PLANE.submit(task)
+    record_deferred("dispatched")
+    return SyncHandle("host", future, watermark=watermark, label=label)
+
+
+def deferred_sparse_sync(
+    plane: Any,
+    state: Dict[str, Any],
+    touched: Any = None,
+    watermark: Optional[int] = None,
+    label: str = "sparse_sync",
+    attrs: Optional[Dict[str, Any]] = None,
+) -> SyncHandle:
+    """Run one sparse delta-sync round in the background; returns a
+    :class:`SyncHandle`.
+
+    ``plane`` is a :class:`~metrics_tpu.parallel.sparse.SparseSyncPlane`;
+    the task is ``plane.sync(snapshot, touched)`` VERBATIM — bitmap psum,
+    host union readback, fixed-capacity row exchange or dense fallback,
+    guard retries, chaos at site ``sparse_sync``, the round ledger — on the
+    single-worker host plane, so deferred sparse rounds share the
+    submission-order domain with every other deferred gather (a sparse
+    round cannot ride the unfenced device-dispatch plane: the union
+    readback between its two programs is host control flow by design).
+    Snapshots ``state`` at call time — immutable leaves, so holding the
+    refs IS the double buffer and the caller keeps accumulating.
+    """
+    snapshot = dict(state)
+
+    def task() -> Any:
+        task_attrs = {"plane": label} if TRACE.enabled else None
+        with _span("deferred.complete", task_attrs):
+            out = plane.sync(snapshot, touched)
+        record_deferred("completed")
+        return out
+
+    span_attrs = None
+    if TRACE.enabled:
+        span_attrs = {"plane": label, "capacity": plane.capacity}
         if attrs:
             span_attrs.update(attrs)
     with _span("deferred.dispatch", span_attrs):

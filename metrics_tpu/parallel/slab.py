@@ -59,6 +59,7 @@ __all__ = [
     "slab_scatter",
     "slab_sync_reduce",
     "slab_take_rows",
+    "slab_touched_mask",
 ]
 
 # per-slot reduce kinds a slab row supports. "mean" is SUM-BACKED: the slab
@@ -183,6 +184,22 @@ def slab_scatter(reduce: str, deltas: Array, slot_ids: Array, num_slots: int) ->
     if reduce == "max":
         return jax.ops.segment_max(deltas, slot_ids, num_segments=num_slots)
     raise ValueError(f"slab reduce must be one of {SLAB_REDUCES}, got {reduce!r}")
+
+
+def slab_touched_mask(slot_ids: Array, num_slots: int) -> Array:
+    """``(K,)`` bool mask of the slab rows a batch's scatter touched.
+
+    The per-step touched-row bitmap of the sparse delta-sync plane
+    (:class:`~metrics_tpu.parallel.sparse.SparseSyncPlane`): the rows slab
+    already knows which slot ids a batch wrote, so the mask is one more
+    ``segment_sum`` over the same ids. Out-of-range ids are dropped by the
+    same XLA scatter semantics as :func:`slab_scatter` — a dropped sample
+    never marks a row touched, matching the row it never wrote. Jit-safe;
+    masks from several updates in a round combine with ``|``.
+    """
+    ids = jnp.ravel(slot_ids)
+    ones = jnp.ones(ids.shape, dtype=jnp.int32)
+    return jax.ops.segment_sum(ones, ids, num_segments=num_slots) > 0
 
 
 def dropped_slot_count(slot_ids: Any, num_slots: int) -> int:

@@ -229,16 +229,36 @@ def _rec(kind: str, value: Any, axis_name: Any, crossing: str) -> None:
     record_collective(kind, value, crossing=crossing, fanout=_fanout(axis_name))
 
 
-def _resolve_hierarchy(axis_name: Any, hierarchy: Optional[MeshHierarchy]):
+def _resolve_hierarchy(axis_name: Any, hierarchy: Union[MeshHierarchy, bool, None]):
     """(axis_name, hierarchy, crossing) with the degenerate cases folded.
 
     A :class:`MeshHierarchy` passed AS the axis is hoisted to ``hierarchy``;
     a single-slice hierarchy (dcn axis size 1 at trace time) collapses to
     the FLAT plane over the ici axis — identical program and collective
     count, attributed to the ``ici`` crossing.
+
+    AUTO-DERIVATION: ``hierarchy=None`` with a 2-tuple axis named exactly
+    ``(dcn, ici)`` — the span a 2-level multi-slice mesh exposes — derives
+    the :class:`MeshHierarchy` itself, so ici-first/DCN-last two-stage
+    staging is the multi-slice DEFAULT instead of an explicit kwarg (and
+    planes built on this resolver, the sparse delta plane included, inherit
+    it for free). ``hierarchy=False`` is the opt-out sentinel: force the
+    FLAT plane over whatever span the axis names (one world-crossing
+    collective), never deriving.
     """
+    if hierarchy is False:
+        if isinstance(axis_name, MeshHierarchy):
+            axis_name = (axis_name.dcn_axis, axis_name.ici_axis)
+        return axis_name, None, "world"
     if hierarchy is None and isinstance(axis_name, MeshHierarchy):
         hierarchy = axis_name
+    if (
+        hierarchy is None
+        and isinstance(axis_name, tuple)
+        and len(axis_name) == 2
+        and set(axis_name) == {"dcn", "ici"}
+    ):
+        hierarchy = MeshHierarchy(ici_axis="ici", dcn_axis="dcn")
     if hierarchy is None:
         return axis_name, None, "world"
     dcn = _fanout(hierarchy.dcn_axis)
@@ -289,7 +309,7 @@ def sync_value(
     fx: ReduceFx,
     value: Any,
     axis_name: Any,
-    hierarchy: Optional[MeshHierarchy] = None,
+    hierarchy: Union[MeshHierarchy, bool, None] = None,
     _crossing: Optional[str] = None,
 ) -> Any:
     """In-jit sync of one state value over a named mesh axis.
@@ -297,6 +317,9 @@ def sync_value(
     ``axis_name`` may be a single axis, a tuple of axes (the flat world
     span of a 2-level mesh), or a :class:`MeshHierarchy`; ``hierarchy=``
     stages every collective as ici-then-dcn (see the module docstring).
+    With ``hierarchy=None`` a ``("dcn", "ici")`` tuple axis AUTO-DERIVES
+    the two-stage hierarchy (the multi-slice default); pass
+    ``hierarchy=False`` to force the flat plane over that span.
 
     Collective accounting: this function runs at *trace* time, so the
     counters record ops staged into the compiled program — which IS the
@@ -371,9 +394,12 @@ def sync_state(
     state: Dict[str, Any],
     reductions: Dict[str, ReduceFx],
     axis_name: Any,
-    hierarchy: Optional[MeshHierarchy] = None,
+    hierarchy: Union[MeshHierarchy, bool, None] = None,
 ) -> Dict[str, Any]:
-    """In-jit sync of a whole state dict over a named mesh axis (pure, jit-safe)."""
+    """In-jit sync of a whole state dict over a named mesh axis (pure,
+    jit-safe). ``hierarchy=`` follows :func:`sync_value`'s auto-derivation:
+    a ``("dcn", "ici")`` tuple axis stages two-level by default,
+    ``hierarchy=False`` forces the flat plane."""
     record_states_synced(len(state))
     with annotate("metric.sync"):
         return {
@@ -386,10 +412,13 @@ def coalesced_sync_state(
     state: Dict[Any, Any],
     reductions: Dict[Any, ReduceFx],
     axis_name: Any,
-    hierarchy: Optional[MeshHierarchy] = None,
+    hierarchy: Union[MeshHierarchy, bool, None] = None,
 ) -> Dict[Any, Any]:
     """In-jit sync with COALESCED collectives: a handful of bucketed
-    collectives instead of one (or two) per state leaf.
+    collectives instead of one (or two) per state leaf. ``hierarchy=``
+    follows :func:`sync_value`'s auto-derivation: a ``("dcn", "ici")``
+    tuple axis stages two-level by default, ``hierarchy=False`` forces the
+    flat plane.
 
     Three bucket planes, all keyed by dtype:
 

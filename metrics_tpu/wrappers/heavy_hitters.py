@@ -680,6 +680,31 @@ class HeavyHitters(Metric):
         """The tail's current ``(e/width) * N`` certificate, in samples."""
         return float(cms_error_bound(getattr(self, _TAIL_ROWS_STATE).counts))
 
+    # ---------------------------------------------------- sparse delta sync
+    def sparse_plane(self, axis_name: Any, mesh: Any = None, *,
+                     capacity: int = 64, **kwargs: Any) -> Any:
+        """A :class:`~metrics_tpu.parallel.sparse.SparseSyncPlane` over the
+        two-tier state: the hot ``(K, *item)`` slabs (plus ``hh_rows``) ride
+        the sparse row exchange, while the constant-size count-min tails
+        (``*_tail`` and ``hh_tail_rows``) are DENSE residuals whose int32
+        deltas ride the bitmap psum payload — per-round bytes stay
+        proportional to the touched hot rows plus the fixed tail footprint,
+        with zero extra collectives for the tails. All HH states are
+        sum-reduced, so the whole split is delta-exact. Build the plane
+        while the metric is RESET (see the plane's docstring).
+        """
+        from metrics_tpu.parallel.sparse import SparseSyncPlane
+
+        state = self._current_state()
+        rows = tuple(
+            n for n in state
+            if not (n.endswith(_TAIL_SUFFIX) or n == _TAIL_ROWS_STATE)
+        )
+        return SparseSyncPlane(
+            state, dict(self._reductions), self.num_hot_slots, axis_name,
+            mesh, capacity=capacity, row_leaves=rows, **kwargs,
+        )
+
     # ------------------------------------------------------------- lifecycle
     def reset(self) -> None:
         super().reset()

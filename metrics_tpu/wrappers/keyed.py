@@ -418,6 +418,29 @@ class Keyed(Metric):
             out[name] = value
         return out
 
+    # ---------------------------------------------------- sparse delta sync
+    def sparse_plane(self, axis_name: Any, mesh: Any = None, *,
+                     capacity: int = 64, **kwargs: Any) -> Any:
+        """A :class:`~metrics_tpu.parallel.sparse.SparseSyncPlane` over this
+        wrapper's full slab state: cross-rank sync whose collective bytes
+        scale with the rows a round actually TOUCHED, not with K.
+
+        Every ``Keyed`` leaf is a ``(K, *item)`` slab (the rows slab
+        included), so all of them ride the sparse row exchange; the merged
+        view a round returns feeds :meth:`_finish_slab` exactly like a dense
+        ``coalesced_sync_state`` result. Build the plane while the metric is
+        RESET (the plane seeds its merged view from the construction state —
+        see the plane's docstring), and pass
+        :func:`~metrics_tpu.parallel.slab.slab_touched_mask` over a step's
+        slot ids as the ``touched=`` hint to skip the full-slab compare.
+        """
+        from metrics_tpu.parallel.sparse import SparseSyncPlane
+
+        return SparseSyncPlane(
+            self._current_state(), dict(self._reductions), self.num_slots,
+            axis_name, mesh, capacity=capacity, **kwargs,
+        )
+
     # ------------------------------------------------------------- lifecycle
     def reset(self) -> None:
         super().reset()

@@ -775,6 +775,29 @@ class Windowed(Metric):
             out[name] = value
         return out
 
+    # ---------------------------------------------------- sparse delta sync
+    def sparse_plane(self, axis_name: Any, mesh: Any = None, *,
+                     capacity: Optional[int] = None, **kwargs: Any) -> Any:
+        """A :class:`~metrics_tpu.parallel.sparse.SparseSyncPlane` over the
+        window ring: every leaf is a ``(num_windows, ...)`` slab, so a round
+        exchanges only the windows a step actually wrote — typically the
+        head window (and a late-routed neighbour), not the whole ring. The
+        default capacity is the full ring (``num_windows`` is small; the
+        win here is skipping the per-window payloads, which for a nested
+        ``Keyed`` inner are ``(W, K, *item)``-sized). Decay mode's float32
+        rows delta-add exactly while the effective counts are integer-valued
+        floats; ring mode is integer-exact throughout. Build the plane
+        while the metric is RESET (see the plane's docstring).
+        """
+        from metrics_tpu.parallel.sparse import SparseSyncPlane
+
+        if capacity is None:
+            capacity = self.num_windows
+        return SparseSyncPlane(
+            self._current_state(), dict(self._reductions), self.num_windows,
+            axis_name, mesh, capacity=capacity, **kwargs,
+        )
+
     # ------------------------------------------------------------- lifecycle
     def reset(self) -> None:
         super().reset()

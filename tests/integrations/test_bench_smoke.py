@@ -92,6 +92,17 @@ def _assert_headline_schema(out):
     assert out["keyed_gather_calls"] == 0  # psum-only: the slab contract
     assert out["keyed_sync_bytes"] == 2640000  # (10000*2*16 + 10000) * 4 * 2 stages
 
+    # the sparse delta-sync A/B rides the same line: the same Keyed slab,
+    # but each step touches only 64 of the 10,000 rows and syncs through
+    # SparseSyncPlane (bitmap psum + fixed-capacity union gather) — staged
+    # bytes follow the TOUCHED-ROW count, not the table size
+    assert isinstance(out["sparse_sync_ms"], (int, float)) and out["sparse_sync_ms"] > 0
+    assert out["sparse_states_synced"] == 2  # the histogram slab + the row-count slab
+    assert out["sparse_collective_calls"] == 4  # two-stage bitmap psum + union gather
+    assert out["sparse_gather_calls"] == 2  # ONE union gather, staged ici + dcn
+    assert out["sparse_sync_bytes"] == 36112  # bitmap words + 64-row payload, 2 stages
+    assert out["sparse_sync_bytes"] * 10 < out["keyed_sync_bytes"]  # the sparse headline
+
     # the heavy-hitter A/B rides the same line: HeavyHitters(AUROC sketch)
     # over a 1,000,000-key space stages the SAME collective count and kinds
     # as the unkeyed metric — both tiers (exact hot slab + count-min tail)
@@ -193,9 +204,11 @@ def _assert_headline_schema(out):
     # (--check-trajectory pins them at zero on every new BENCH_r* round);
     # slab_dropped_samples joins them — in-window bench traffic never drops —
     # and wm_stragglers: healthy bench ranks are never excluded from the
-    # agreed watermark
+    # agreed watermark; sparse_fallbacks joins them — the bench sparse
+    # stream never exceeds sparse_capacity, so a dense fallback on the
+    # clean line means the sparsity plumbing silently broke
     for key in ("sync_retries", "sync_deadline_exceeded", "degraded_computes", "quarantined_updates",
-                "slab_dropped_samples", "wm_stragglers"):
+                "slab_dropped_samples", "wm_stragglers", "sparse_fallbacks"):
         assert out[key] == 0, key
 
 
@@ -214,7 +227,11 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     out = _run_smoke(("--trace", str(trace_file)))
     _assert_headline_schema(out)
 
-    # schema version of the --trace payload: v12 added the quantile-sketch
+    # schema version of the --trace payload: v13 added the sparse delta-sync
+    # plane (sparse_* staged keys with sync bytes pinned under a tenth of
+    # the dense keyed plane's and collective counts constant in K,
+    # sparse_fallbacks zero-pinned on the default line, gated by
+    # --check-collectives' sparse gate); v12 added the quantile-sketch
     # plane (qsketch_* staged-count keys pinned to the unkeyed scalar twin +
     # the deterministic qsketch_state_bytes pin, gated by --check-quantile);
     # v11 added the rank-coherent
@@ -235,7 +252,7 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     # windowed serving A/B; v5 the keyed slab A/B; v4 the sketch A/B; v3
     # moved the collective counts to the default line and added the
     # hierarchical A/B + per-crossing counters; bump this pin with the schema
-    assert out["trace_schema"] == 12
+    assert out["trace_schema"] == 13
     # the sketch program's full snapshot: psum-only, no gather kinds staged
     sketch_kinds = out["sketch_counters"]["calls_by_kind"]
     assert sketch_kinds.get("psum", 0) == 2
@@ -247,6 +264,18 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     for kind in ("all_gather", "coalesced_gather", "process_allgather"):
         assert keyed_kinds.get(kind, 0) == 0, kind
     assert out["keyed_counters"]["bytes_by_crossing"]["dcn"] == out["keyed_sync_bytes"] // 2
+    # the sparse program pair: one two-stage bitmap psum + one two-stage
+    # fixed-capacity union gather, and the round ledger recorded exactly the
+    # compiling round — one sync of 64 union rows, zero fallbacks or skips
+    sparse_kinds = out["sparse_counters"]["calls_by_kind"]
+    assert sparse_kinds.get("psum", 0) == 2
+    assert sum(
+        sparse_kinds.get(k, 0)
+        for k in ("all_gather", "coalesced_gather", "process_allgather")
+    ) == 2
+    assert out["sparse_counters"]["sparse"] == {
+        "syncs": 1, "rows": 64, "fallbacks": 0, "skips": 0,
+    }
     # the heavy-hitter program: the same psum-only shape over a 1M key space
     hh_kinds = out["hh_counters"]["calls_by_kind"]
     assert hh_kinds.get("psum", 0) == 2
@@ -360,7 +389,8 @@ def test_bench_check_collectives_gate():
     assert out["ok"] is True and out["failures"] == []
     scenarios = out["scenarios"]
     assert set(scenarios) == {
-        "sketch_sync", "keyed_sync", "keyed_unkeyed", "hh_sync",
+        "sketch_sync", "keyed_sync", "keyed_unkeyed",
+        "sparse_sync", "sparse_sync_flat", "hh_sync",
         "sum_grouped", "sum_ungrouped", "gather_coalesced", "gather_per_leaf",
         "gather_hier", "gather_flat2d",
         "sharded_auroc", "sharded_auroc_hier",
@@ -426,6 +456,22 @@ def test_bench_check_collectives_gate():
     assert hh_gate["demotions"] > 0  # the stream actually churned the tiers
     assert hh_gate["cert_violations"] == 0 and hh_gate["cert_checked"] > 100
     assert hh_gate["state_bytes_10k"] == hh_gate["state_bytes_1m"]
+    # the sparse gate of record: staged bytes proportional to the touched
+    # rows — under 10% of the dense keyed plane's on the same mesh at the
+    # same K — with a K-independent staged collective count, merges
+    # bit-exact vs the dense coalesced plane on both the flat and (4,2)
+    # hierarchical meshes, the capacity-overflow round falling back to the
+    # dense plane bit-exactly AND counted, and the empty-touch round
+    # skipping the row exchange entirely
+    sparse_gate = out["sparse_gate"]
+    assert sparse_gate["ok"] is True
+    assert sparse_gate["sparse_sync_bytes"] * 10 < sparse_gate["dense_keyed_bytes"]
+    assert sparse_gate["sparse_collective_calls"] == sparse_gate["small_k_collective_calls"]
+    assert sparse_gate["bit_exact_flat"] is True
+    assert sparse_gate["bit_exact_hier"] is True
+    assert sparse_gate["fallback_bit_exact"] is True and sparse_gate["fallbacks"] > 0
+    assert sparse_gate["skips"] > 0 and sparse_gate["gather_skips"] > 0
+    assert scenarios["sparse_sync"]["sync_bytes"] * 10 < scenarios["keyed_sync"]["sync_bytes"]
     for row in scenarios.values():
         assert row["status"] != "regression"
 

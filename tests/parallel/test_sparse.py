@@ -1,0 +1,495 @@
+"""Sparse delta-sync plane: bit-exactness, capacity fallback, chaos matrix.
+
+The plane's contract is the invariant ``merged == dense_sync(current)``:
+whatever the dense coalesced plane would produce from the ranks' current
+states, the sparse round — touched-row bitmap psum, fixed-capacity union
+gather, scatter-add fold — must reproduce BIT-EXACTLY, while staging bytes
+proportional to the touched rows. Every parity test here compares against a
+real ``coalesced_sync_state`` program on the same mesh. The chaos scenarios
+(site ``sparse_sync``) run under an enforced timeout: a fault may cost a
+retry, never a hang and never a wrong merged view.
+"""
+import threading
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from metrics_tpu import AUROC, Accuracy, HeavyHitters, Keyed, Windowed
+from metrics_tpu.observability import counters as obs_counters
+from metrics_tpu.parallel import faults
+from metrics_tpu.parallel.placement import MeshHierarchy
+from metrics_tpu.parallel.slab import slab_touched_mask
+from metrics_tpu.parallel.sparse import (
+    SparseSyncPlane,
+    _payload_of,
+    pack_touched,
+    touched_lane_bits,
+    unpack_touched_counts,
+)
+from metrics_tpu.parallel.sync import SyncGuard, coalesced_sync_state
+from metrics_tpu.utils import compat
+from metrics_tpu.utils.exceptions import SyncTimeoutError
+
+_TIMEOUT_S = 30.0
+N = 32  # slab rows
+CAP = 8
+I32 = jnp.iinfo(jnp.int32)
+
+
+def _within(fn, timeout_s: float = _TIMEOUT_S):
+    """Run ``fn`` under an enforced deadline — a wedged sparse round fails
+    loudly instead of hanging CI (the daemon worker is abandoned)."""
+    box = {}
+    done = threading.Event()
+
+    def target():
+        try:
+            box["value"] = fn()
+        except BaseException as err:  # noqa: BLE001 - re-raised on the test thread
+            box["error"] = err
+        finally:
+            done.set()
+
+    worker = threading.Thread(target=target, daemon=True)
+    worker.start()
+    assert done.wait(timeout_s), f"scenario deadlocked: exceeded the {timeout_s}s timeout"
+    if "error" in box:
+        raise box["error"]
+    return box.get("value")
+
+
+@pytest.fixture(autouse=True)
+def _clean_counters():
+    obs_counters.reset()
+    yield
+    obs_counters.reset()
+
+
+def _mesh(eight_devices, hierarchical):
+    if hierarchical:
+        return Mesh(np.array(eight_devices).reshape(2, 4), ("dcn", "ici"))
+    return Mesh(np.array(eight_devices), ("dp",))
+
+
+def _axis(hierarchical):
+    return ("dcn", "ici") if hierarchical else "dp"
+
+
+REDUCTIONS = {"hits": "sum", "lo": "min", "hi": "max", "tail": "sum"}
+
+
+def _reset_state():
+    """A hand-built slab state covering every row fold kind plus a dense
+    residual, at its reset fill (the plane's valid construction seed)."""
+    return {
+        "hits": jnp.zeros((N, 3), jnp.int32),
+        "lo": jnp.full((N,), I32.max, jnp.int32),
+        "hi": jnp.full((N,), I32.min, jnp.int32),
+        "tail": jnp.zeros((2, 5), jnp.int32),
+    }
+
+
+def _touch(state, rows, salt=1):
+    """Touch ``rows`` of every row leaf (and bump the dense residual)."""
+    out = dict(state)
+    idx = jnp.asarray(rows, jnp.int32)
+    out["hits"] = out["hits"].at[idx].add(salt + idx[:, None] * 3)
+    out["lo"] = out["lo"].at[idx].min(salt * 10 + idx)
+    out["hi"] = out["hi"].at[idx].max(salt * 10 + idx)
+    out["tail"] = out["tail"] + salt
+    return out
+
+
+def _dense_fn(mesh, axis, reductions):
+    def body(state):
+        return coalesced_sync_state(state, reductions, axis)
+
+    return jax.jit(
+        compat.shard_map(body, mesh=mesh, in_specs=(P(),), out_specs=P(), check_vma=False)
+    )
+
+
+def _assert_state_equal(a, b):
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(
+            np.asarray(_payload_of(a[k])), np.asarray(_payload_of(b[k])), err_msg=k
+        )
+
+
+def _plane(eight_devices, hierarchical, **kw):
+    state = _reset_state()
+    mesh = _mesh(eight_devices, hierarchical)
+    kw.setdefault("capacity", CAP)
+    return SparseSyncPlane(state, REDUCTIONS, N, _axis(hierarchical), mesh, **kw), state, mesh
+
+
+# ----------------------------------------------------------- bitmap packing
+def test_touched_lane_bits_bound_world():
+    # psum ADDS per-row flags, so a lane must hold the world's full count
+    for world in (1, 2, 3, 4, 7, 8, 15, 16, 255, 256):
+        bits = touched_lane_bits(world)
+        assert bits in (1, 2, 4, 8, 16, 32)
+        assert world < 2 ** bits
+    assert touched_lane_bits(8) == 4
+
+
+def test_pack_unpack_roundtrip_and_lane_addition():
+    rng = np.random.RandomState(3)
+    world = 8
+    m1 = rng.rand(77) < 0.3
+    m2 = rng.rand(77) < 0.3
+    w1 = np.asarray(pack_touched(jnp.asarray(m1), world))
+    w2 = np.asarray(pack_touched(jnp.asarray(m2), world))
+    np.testing.assert_array_equal(unpack_touched_counts(w1, 77, world), m1.astype(np.int64))
+    # lane addition never carries across rows: the psum of per-rank bitmaps
+    # unpacks to the exact per-row touch COUNT
+    np.testing.assert_array_equal(
+        unpack_touched_counts(w1 + w2, 77, world), (m1.astype(np.int64) + m2)
+    )
+
+
+def test_slab_touched_mask_drops_out_of_range():
+    ids = jnp.asarray([3, 3, 7, N + 5, N * 4], jnp.int32)
+    mask = np.asarray(slab_touched_mask(ids, N))
+    assert mask.dtype == np.bool_ and mask.shape == (N,)
+    assert set(np.flatnonzero(mask)) == {3, 7}
+
+
+# ------------------------------------------------------------- parity suite
+@pytest.mark.parametrize("hierarchical", [False, True], ids=["flat", "hier"])
+def test_sparse_rounds_bit_exact_vs_dense(eight_devices, hierarchical):
+    plane, state, mesh = _plane(eight_devices, hierarchical)
+    dense = _dense_fn(mesh, _axis(hierarchical), REDUCTIONS)
+
+    current = _touch(state, [3, 17, 31], salt=1)
+    merged = plane.sync(current)
+    _assert_state_equal(merged, dense(current))
+    assert (plane.rounds, plane.fallbacks, plane.skips) == (1, 0, 0)
+
+    # incremental second round, overlapping + fresh rows: the invariant
+    # merged == dense_sync(current) must survive the baseline rebind
+    current2 = _touch(current, [0, 17], salt=5)
+    merged2 = plane.sync(current2)
+    _assert_state_equal(merged2, dense(current2))
+    _assert_state_equal(plane.merged, merged2)
+    assert plane.rounds == 2 and plane.fallbacks == 0
+
+
+def test_touched_hint_matches_unhinted(eight_devices):
+    rows = [1, 9, 30]
+    plane_a, state, mesh = _plane(eight_devices, False)
+    plane_b, _, _ = _plane(eight_devices, False)
+    current = _touch(state, rows)
+    hinted = plane_a.sync(current, touched=slab_touched_mask(jnp.asarray(rows, jnp.int32), N))
+    unhinted = plane_b.sync(current)
+    _assert_state_equal(hinted, unhinted)
+
+
+def test_empty_touch_skips_row_exchange(eight_devices):
+    plane, state, _ = _plane(eight_devices, False)
+    before = obs_counters.snapshot()
+    merged = plane.sync(dict(state))
+    after = obs_counters.snapshot()
+    _assert_state_equal(merged, state)
+    assert plane.skips == 1
+    assert after["sparse"]["skips"] - before["sparse"]["skips"] == 1
+    assert after["gather_skips"] - before["gather_skips"] == 1
+
+
+def test_overflow_falls_back_dense_bit_exact(eight_devices):
+    plane, state, mesh = _plane(eight_devices, False, capacity=4)
+    dense = _dense_fn(mesh, "dp", REDUCTIONS)
+    current = _touch(state, list(range(0, 20, 2)), salt=2)  # 10 rows > capacity 4
+    before = obs_counters.snapshot()
+    merged = plane.sync(current)
+    after = obs_counters.snapshot()
+    _assert_state_equal(merged, dense(current))
+    assert plane.fallbacks == 1
+    assert after["sparse"]["fallbacks"] - before["sparse"]["fallbacks"] == 1
+
+
+def test_fallback_warn_once_names_capacity(eight_devices):
+    from metrics_tpu.utils import prints
+
+    plane, state, _ = _plane(
+        eight_devices, False, capacity=4, fallback_warn_rounds=2, fallback_warn_fraction=0.4
+    )
+    prints._WARN_ONCE_SEEN.clear()
+    wide1 = _touch(state, list(range(10)), salt=1)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # round 1 of 2 must stay silent
+        plane.sync(wide1)
+    wide2 = _touch(wide1, list(range(10, 20)), salt=2)
+    with pytest.warns(UserWarning, match=r"sparse_capacity=4"):
+        plane.sync(wide2)
+    assert plane.fallbacks == 2
+    # warn-ONCE: a third fallback round stays silent
+    wide3 = _touch(wide2, list(range(20, 30)), salt=3)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        plane.sync(wide3)
+
+
+def test_stacked_per_rank_unions(eight_devices):
+    """``stacked=True``: each rank contributes ITS OWN rows — the union is
+    genuinely cross-rank (the replicated convention can't distinguish a
+    local miss from a union miss)."""
+    mesh = _mesh(eight_devices, False)
+    stacked_reset = {k: jnp.broadcast_to(v, (8,) + v.shape) for k, v in _reset_state().items()}
+    plane = SparseSyncPlane(
+        stacked_reset, REDUCTIONS, N, "dp", mesh, capacity=16, stacked=True
+    )
+    plane.rebase(stacked_reset, merged=_reset_state())
+
+    # rank r touches rows {2r, 2r+1} — 16 distinct rows across the world
+    current = {k: np.array(v) for k, v in stacked_reset.items()}
+    for r in range(8):
+        for row in (2 * r, 2 * r + 1):
+            current["hits"][r, row] += r + 1
+            current["lo"][r, row] = min(current["lo"][r, row], 100 + row)
+            current["hi"][r, row] = max(current["hi"][r, row], 100 + row)
+        current["tail"][r] += 1
+    current = {k: jnp.asarray(v) for k, v in current.items()}
+
+    def body(state):
+        local = {k: v[0] for k, v in state.items()}
+        return coalesced_sync_state(local, REDUCTIONS, "dp")
+
+    dense = jax.jit(
+        compat.shard_map(body, mesh=mesh, in_specs=(P("dp"),), out_specs=P(), check_vma=False)
+    )
+    merged = plane.sync(current)
+    _assert_state_equal(merged, dense(current))
+    assert plane.fallbacks == 0
+
+
+# ------------------------------------------------------------- chaos matrix
+@pytest.mark.chaos
+def test_chaos_drop_retries_bit_exact(eight_devices):
+    plane, state, mesh = _plane(
+        eight_devices, False, guard=SyncGuard(max_retries=2, backoff_s=0.0)
+    )
+    dense = _dense_fn(mesh, "dp", REDUCTIONS)
+    current = _touch(state, [4, 8, 15])
+    with faults.chaos(
+        faults.FaultSpec(kind="drop", call=0, times=2, site="sparse_sync")
+    ) as inj:
+        merged = _within(lambda: plane.sync(current))
+    assert inj.injected["drop"] == 2
+    _assert_state_equal(merged, dense(current))
+    assert obs_counters.snapshot()["faults"].get("sync_retries", 0) == 2
+
+
+@pytest.mark.chaos
+def test_chaos_stall_deadline_retries_bit_exact(eight_devices):
+    plane, state, mesh = _plane(
+        eight_devices,
+        False,
+        guard=SyncGuard(deadline_s=0.25, max_retries=2, backoff_s=0.0),
+    )
+    dense = _dense_fn(mesh, "dp", REDUCTIONS)
+    current = _touch(state, [2, 29])
+    plane.sync(current)  # compile OUTSIDE the stall so the deadline bounds the round, not XLA
+    current2 = _touch(current, [2, 29], salt=7)
+    # the injector numbers site calls from ITS OWN install: the round above
+    # predates it, so the stall pins call 0
+    with faults.chaos(
+        faults.FaultSpec(kind="stall", call=0, times=1, duration_s=1.2, site="sparse_sync")
+    ):
+        merged = _within(lambda: plane.sync(current2))
+    _assert_state_equal(merged, dense(current2))
+    assert obs_counters.snapshot()["faults"].get("sync_retries", 0) >= 1
+
+
+@pytest.mark.chaos
+def test_chaos_corrupt_retries_bit_exact(eight_devices):
+    plane, state, mesh = _plane(
+        eight_devices,
+        False,
+        guard=SyncGuard(max_retries=2, backoff_s=0.0, check_finite=True),
+    )
+    dense = _dense_fn(mesh, "dp", REDUCTIONS)
+    current = _touch(state, [11, 12])
+    with faults.chaos(
+        faults.FaultSpec(kind="corrupt", call=0, times=1, site="sparse_sync")
+    ) as inj:
+        merged = _within(lambda: plane.sync(current))
+    assert inj.injected["corrupt"] == 1
+    _assert_state_equal(merged, dense(current))
+    assert obs_counters.snapshot()["faults"].get("sync_retries", 0) == 1
+
+
+@pytest.mark.chaos
+def test_chaos_exhaustion_degrade_then_recover(eight_devices):
+    plane, state, mesh = _plane(
+        eight_devices,
+        False,
+        guard=SyncGuard(max_retries=1, backoff_s=0.0, policy="degrade"),
+    )
+    dense = _dense_fn(mesh, "dp", REDUCTIONS)
+    current = _touch(state, [6, 21])
+    with faults.chaos(
+        faults.FaultSpec(kind="drop", call=0, times=5, site="sparse_sync")
+    ):
+        local = _within(lambda: plane.sync(current))
+    # degraded round: local-only view, NOTHING committed
+    _assert_state_equal(local, current)
+    fc = obs_counters.snapshot()["faults"]
+    assert fc.get("degraded_computes", 0) == 1
+    assert fc.get("sync_deadline_exceeded", 0) == 1
+    # baseline/merged were untouched, so a clean round re-offers the deltas
+    merged = _within(lambda: plane.sync(current))
+    _assert_state_equal(merged, dense(current))
+
+
+@pytest.mark.chaos
+def test_chaos_exhaustion_raise(eight_devices):
+    plane, state, _ = _plane(
+        eight_devices,
+        False,
+        guard=SyncGuard(max_retries=1, backoff_s=0.0, policy="raise"),
+    )
+    current = _touch(state, [5])
+    with faults.chaos(
+        faults.FaultSpec(kind="drop", call=0, times=5, site="sparse_sync")
+    ):
+        with pytest.raises(SyncTimeoutError):
+            _within(lambda: plane.sync(current))
+
+
+# -------------------------------------------------------- hierarchy routing
+def test_auto_hierarchy_stages_ici_and_dcn(eight_devices):
+    obs_counters.enable()
+    plane, state, _ = _plane(eight_devices, True)
+    plane.sync(_touch(state, [7, 23]))
+    crossings = obs_counters.snapshot()["calls_by_crossing"]
+    # the ("dcn", "ici") tuple axis auto-derives the two-stage plane: every
+    # staged collective is attributed to a REAL crossing, never "world"
+    assert crossings.get("ici", 0) > 0 and crossings.get("dcn", 0) > 0
+    assert crossings.get("world", 0) == 0
+
+
+def test_hierarchy_false_pins_flat_world_crossing(eight_devices):
+    obs_counters.enable()
+    mesh = _mesh(eight_devices, True)
+    plane = SparseSyncPlane(
+        _reset_state(), REDUCTIONS, N, ("dcn", "ici"), mesh, capacity=CAP, hierarchy=False
+    )
+    merged = plane.sync(_touch(_reset_state(), [7, 23]))
+    crossings = obs_counters.snapshot()["calls_by_crossing"]
+    assert crossings.get("world", 0) > 0
+    assert crossings.get("ici", 0) == 0 and crossings.get("dcn", 0) == 0
+    # flat and auto-derived two-stage fold to the SAME merged view
+    obs_counters.disable()
+    two_stage = SparseSyncPlane(
+        _reset_state(), REDUCTIONS, N, MeshHierarchy(ici_axis="ici", dcn_axis="dcn"),
+        mesh, capacity=CAP,
+    )
+    _assert_state_equal(merged, two_stage.sync(_touch(_reset_state(), [7, 23])))
+
+
+# ----------------------------------------------------------- wrapper planes
+def test_keyed_sparse_plane_bit_exact(eight_devices):
+    mesh = _mesh(eight_devices, False)
+    metric = Keyed(AUROC(approx="sketch", num_bins=8), num_slots=64)
+    plane = metric.sparse_plane("dp", mesh, capacity=16)
+    rng = np.random.RandomState(0)
+    slots = jnp.asarray(rng.choice(64, 12, replace=False)[rng.randint(0, 12, 40)], jnp.int32)
+    metric.update(
+        jnp.asarray(rng.rand(40).astype(np.float32)),
+        jnp.asarray((rng.rand(40) > 0.5).astype(np.int32)),
+        slot=slots,
+    )
+    current = metric._current_state()
+    dense = _dense_fn(mesh, "dp", dict(metric._reductions))
+    merged = plane.sync(current, touched=slab_touched_mask(slots, 64))
+    _assert_state_equal(merged, dense(current))
+    assert plane.fallbacks == 0
+
+
+def test_heavy_hitters_sparse_plane_routes_tail_dense(eight_devices):
+    mesh = _mesh(eight_devices, False)
+    metric = HeavyHitters(Accuracy(), num_hot_slots=8)
+    plane = metric.sparse_plane("dp", mesh, capacity=8)
+    rng = np.random.RandomState(1)
+    keys = [f"seg{i % 20}" for i in range(60)]
+    metric.update(
+        jnp.asarray(rng.rand(60).astype(np.float32)),
+        jnp.asarray(rng.randint(0, 2, 60).astype(np.int32)),
+        key=keys,
+    )
+    # the count-min tail is NOT row-shaped: it must delta-sync as a dense
+    # residual on the bitmap payload, not ride the row exchange
+    assert plane._dense_names
+    current = metric._current_state()
+    dense = _dense_fn(mesh, "dp", dict(metric._reductions))
+    _assert_state_equal(plane.sync(current), dense(current))
+
+
+def test_windowed_sparse_plane_bit_exact(eight_devices):
+    mesh = _mesh(eight_devices, False)
+    metric = Windowed(Accuracy(), window_s=10.0, num_windows=4)
+    plane = metric.sparse_plane("dp", mesh)
+    assert plane.capacity == 4  # defaults to the window count: never overflows
+    rng = np.random.RandomState(2)
+    metric.update(
+        jnp.asarray(rng.rand(30).astype(np.float32)),
+        jnp.asarray(rng.randint(0, 2, 30).astype(np.int32)),
+        event_time=jnp.asarray(rng.uniform(0.0, 25.0, 30).astype(np.float32)),
+    )
+    current = metric._current_state()
+    dense = _dense_fn(mesh, "dp", dict(metric._reductions))
+    _assert_state_equal(plane.sync(current), dense(current))
+
+
+# ------------------------------------------------------------ deferred hook
+def test_sync_deferred_resolves_merged_view(eight_devices):
+    plane, state, mesh = _plane(eight_devices, False)
+    dense = _dense_fn(mesh, "dp", REDUCTIONS)
+    current = _touch(state, [13, 14])
+    handle = plane.sync_deferred(current, watermark=7)
+    assert handle.label == "sparse_sync" and handle.watermark == 7
+    merged = _within(handle.result)
+    _assert_state_equal(merged, dense(current))
+    _assert_state_equal(plane.merged, merged)
+
+
+# ------------------------------------------------------------- construction
+def test_constructor_validation(eight_devices):
+    mesh = _mesh(eight_devices, False)
+    state = _reset_state()
+    with pytest.raises(ValueError, match="num_rows"):
+        SparseSyncPlane(state, REDUCTIONS, 0, "dp", mesh)
+    with pytest.raises(ValueError, match="sparse_capacity"):
+        SparseSyncPlane(state, REDUCTIONS, N, "dp", mesh, capacity=0)
+    with pytest.raises(ValueError, match="at least one state leaf"):
+        SparseSyncPlane({}, {}, N, "dp", mesh)
+    with pytest.raises(ValueError, match="row slab"):
+        SparseSyncPlane(
+            {"tail": state["tail"]}, {"tail": "sum"}, N, "dp", mesh
+        )
+    with pytest.raises(ValueError, match="slab reductions"):
+        SparseSyncPlane(state, {**REDUCTIONS, "hits": "mean"}, N, "dp", mesh)
+    with pytest.raises(ValueError, match="residual"):
+        SparseSyncPlane(
+            state, {**REDUCTIONS, "tail": "min"}, N, "dp", mesh,
+            row_leaves=("hits", "lo", "hi"),
+        )
+    with pytest.raises(ValueError, match="mesh"):
+        SparseSyncPlane(state, REDUCTIONS, N, "dp", None)
+
+
+def test_counters_sparse_ledger_shape():
+    obs_counters.reset()
+    obs_counters.COUNTERS.record_sparse_round(5)
+    obs_counters.COUNTERS.record_sparse_fallback()
+    obs_counters.COUNTERS.record_sparse_skip()
+    assert obs_counters.snapshot()["sparse"] == {
+        "syncs": 1, "rows": 5, "fallbacks": 1, "skips": 1,
+    }
