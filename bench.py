@@ -248,6 +248,33 @@ QSK_LO = 1e-3
 QSK_HI = 1e3
 QSK_SLOTS = 256
 QSK_GATE_N = 20_000  # samples per seeded gate stream
+# tiered-retention scenario/gate (serving/retention.py + bench.py
+# --check-retention): closed windows published by a real MetricService are
+# banked in a RetentionStore and rolled up a resolution ladder by pure
+# state addition. The gate drives ALL FOUR mergeable state kinds (array
+# sums via Accuracy, histogram sketch via AUROC(approx="sketch"), quantile
+# sketch via Quantile, count-min via a bench-local CMS vehicle) plus the
+# nested Windowed(Keyed(...)) per-tenant plane through the store, tees the
+# raw published partials, and pins every query — at the native mixed
+# resolution and every legal coarse grid — BIT-exact against a flat
+# recompute (value_from_partials over the union of raw partials), plus the
+# memory-flat property: resident bytes bounded by the ladder shape, not by
+# stream length. The stream below spans RET_BATCHES * RET_STEP_S = 240 s =
+# 24 ten-second windows over the (4, 4, 8)-capacity ladder, so both
+# roll-up rungs are exercised (the coarsest holds one merged bucket).
+RET_WINDOW_S = 10.0
+RET_WINDOWS = 4
+RET_LADDER = ((RET_WINDOW_S, 4), (4 * RET_WINDOW_S, 4), (16 * RET_WINDOW_S, 8))
+RET_BATCHES = 96
+RET_BATCH = 8
+RET_STEP_S = 2.5
+RET_SPAN_S = RET_BATCHES * RET_STEP_S  # 240 s = 24 windows
+RET_TENANTS = 8
+RET_CMS_DEPTH = 4
+RET_CMS_WIDTH = 64
+RET_CMS_SEED = 7
+RET_CMS_KEYS = 64  # distinct keys folded into the gate's count-min tail
+RETENTION_READ_REPEATS = 12  # best-of repeats for the default-line read key
 
 
 def _collection_ours(compute_groups: bool = True):
@@ -1068,6 +1095,49 @@ def _bench_service_ingest(batches: int = SERVICE_INGEST_BATCHES) -> float:
     return batches / max(elapsed, 1e-9)
 
 
+def _bench_retention_read():
+    """The tiered-retention read plane's default-line numbers.
+
+    ``retention_query_ms``: one full-range native query (every retained
+    bucket finished through ``value_from_partials``) against a store banked
+    from a real ``MetricService`` stream — best-of over warmed repeats (the
+    read path's cost, which no ingest key measures). The other three ride
+    along from the store's gauges and are EXACT pins: the seeded stream
+    publishes a deterministic window count, the ladder compacts it with a
+    deterministic roll-up count, and resident bytes are bounded by the
+    ladder shape (flat by design — growth means retention started leaking).
+    """
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, MetricService, RetentionStore, Windowed
+
+    metric = Windowed(
+        Accuracy(), window_s=RET_WINDOW_S, num_windows=RET_WINDOWS,
+        allowed_lateness_s=0.0,
+    )
+    rng = np.random.RandomState(23)
+    with MetricService(metric, name="bench/retention",
+                       deferred_publish=False) as svc:
+        store = RetentionStore(ladder=RET_LADDER,
+                               name="bench/retention-store").attach(svc)
+        for i in range(RET_BATCHES):
+            svc.submit(
+                jnp.asarray(rng.rand(RET_BATCH).astype(np.float32)),
+                jnp.asarray(rng.randint(0, 2, RET_BATCH).astype(np.int32)),
+                event_time=np.full(RET_BATCH, i * RET_STEP_S),
+            )
+        svc.finalize()
+    span = (0.0, RET_SPAN_S)
+    store.query(time_range=span)  # compile the finisher off the clock
+    times = []
+    for _ in range(RETENTION_READ_REPEATS):
+        t0 = time.perf_counter()
+        store.query(time_range=span)
+        times.append((time.perf_counter() - t0) * 1e3)
+    return (min(times), store.windows_banked, store.rollups,
+            int(store.resident_bytes()))
+
+
 def _bench_watermark_scenario():
     """The watermark-agreement numbers of the default line.
 
@@ -1340,6 +1410,12 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
     with (obs.span("bench.service_ingest") if obs else _null_cm()):
         ingest_steps_per_s = _bench_service_ingest()
 
+    # the tiered-retention read plane: a full-range query against the banked
+    # ladder (ms) plus the store's deterministic roll-up/residency pins
+    with (obs.span("bench.retention_read") if obs else _null_cm()):
+        (retention_query_ms, retention_banked, retention_rollups,
+         retention_resident) = _bench_retention_read()
+
     # the sharded fleet: ingest throughput at 1 vs 8 shards under the
     # simulated per-batch serving work (the scaling headline --check-fleet
     # gates at >= 4x), plus the merge tier's deterministic window counts
@@ -1496,6 +1572,15 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
         "async_lag_epoch_sync_gather_calls": epoch_calls_sync,
         # serving ingest throughput (batches/sec through a real service loop)
         "service_ingest_steps_per_s": round(ingest_steps_per_s, 3),
+        # the tiered-retention read plane: the query path's full-range
+        # native read against the banked ladder rides the line in ms, and
+        # the store's gauge counts are EXACT pins on the seeded stream —
+        # banked windows and roll-ups are routing arithmetic, resident
+        # bytes are bounded by the ladder shape (growth means a leak)
+        "retention_query_ms": round(retention_query_ms, 4),
+        "retention_windows_banked": retention_banked,
+        "retention_rollups": retention_rollups,
+        "retention_resident_bytes": retention_resident,
         # the sharded fleet's scaling pair + merge-tier counts: throughput
         # keys are rate-gated by --check-trajectory (may not collapse),
         # window counts are exact pins, lost windows bind at ZERO
@@ -1535,6 +1620,10 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
             finally:
                 devtime_mod.disable()
 
+        # v14: the tiered retention plane joined (retention_query_ms — the
+        # banked ladder's full-range read — plus the deterministic
+        # windows-banked / roll-up / resident-bytes pins on the default
+        # line, gated by --check-retention's four-kind bit-exact sweep);
         # v13: the sparse delta-sync plane joined (sparse_* staged keys with
         # sync bytes pinned under a tenth of the dense keyed plane's and
         # collective counts constant in K, sparse_fallbacks zero-pinned on
@@ -1562,7 +1651,7 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
         # block); v6 added the windowed serving A/B; v5 the keyed slab A/B;
         # v4 the sketch A/B; v3 moved the collective counts to the default
         # line and added the hierarchical A/B
-        out["trace_schema"] = 13
+        out["trace_schema"] = 14
         out["counters"] = grouped_counters
         out["gather_counters"] = coal_counters
         out["hier_counters"] = hier_counters
@@ -1940,6 +2029,10 @@ _TRACE_KEYS = (
     "async_lag_epoch_gather_calls",
     "async_lag_epoch_sync_gather_calls",
     "service_ingest_steps_per_s",
+    "retention_query_ms",
+    "retention_windows_banked",
+    "retention_rollups",
+    "retention_resident_bytes",
     "fleet_ingest_steps_per_s",
     "fleet_ingest_steps_per_s_1shard",
     "fleet_scaling_x",
@@ -4599,6 +4692,286 @@ def check_quantile() -> int:
     return 1 if failures else 0
 
 
+# ------------------------------------------------------ tiered-retention gate
+def _ret_cms_metric_cls():
+    """The gate's count-min vehicle: no library metric carries a bare
+    counter CMS, so the fourth state kind gets a bench-local one. Row
+    buckets are resolved HOST-side (``cms_buckets`` over the stable key
+    hashes) and fed as a data argument, so the per-sample update stays pure
+    under ``Windowed``'s vmapped delta path — the documented contract of
+    the windowed count-min slab."""
+    from metrics_tpu.core.metric import Metric
+    from metrics_tpu.parallel.cms import CMSSpec, CountMinSketch, cms_scatter, cms_total
+
+    class BenchCMSTotal(Metric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state(
+                "tail",
+                default=CMSSpec(RET_CMS_DEPTH, RET_CMS_WIDTH, (), np.int32,
+                                seed=RET_CMS_SEED),
+                dist_reduce_fx="sum", persistent=True,
+            )
+
+        def update(self, buckets, deltas):
+            self.tail = CountMinSketch(cms_scatter(self.tail.counts, buckets, deltas))
+
+        def compute(self):
+            return cms_total(self.tail.counts)
+
+    return BenchCMSTotal
+
+
+def _ret_vehicles():
+    """(name, template factory, submit fn) per mergeable state kind — the
+    four kinds of the paper's state algebra plus the nested per-tenant
+    plane. Every submit drives the SAME seeded event-time grid, one batch
+    per RET_STEP_S tick."""
+    import jax.numpy as jnp
+
+    from metrics_tpu import AUROC, Accuracy, Keyed, Quantile, Windowed
+    from metrics_tpu.parallel.cms import cms_buckets, stable_key_hashes
+
+    def windowed(inner):
+        return Windowed(inner, window_s=RET_WINDOW_S, num_windows=RET_WINDOWS,
+                        allowed_lateness_s=0.0)
+
+    def times(i):
+        return np.full(RET_BATCH, i * RET_STEP_S)
+
+    def classifier_submit(svc, rng, i):
+        svc.submit(jnp.asarray(rng.rand(RET_BATCH).astype(np.float32)),
+                   jnp.asarray(rng.randint(0, 2, RET_BATCH).astype(np.int32)),
+                   event_time=times(i))
+
+    def keyed_submit(svc, rng, i):
+        svc.submit(jnp.asarray(rng.rand(RET_BATCH).astype(np.float32)),
+                   jnp.asarray(rng.randint(0, 2, RET_BATCH).astype(np.int32)),
+                   slot=jnp.asarray(rng.randint(0, RET_TENANTS, RET_BATCH).astype(np.int32)),
+                   event_time=times(i))
+
+    def quantile_submit(svc, rng, i):
+        svc.submit(jnp.asarray(rng.lognormal(0.0, 1.0, RET_BATCH).astype(np.float32)),
+                   event_time=times(i))
+
+    def cms_submit(svc, rng, i):
+        keys = [f"user-{k}" for k in rng.randint(0, RET_CMS_KEYS, RET_BATCH)]
+        buckets = jnp.asarray(cms_buckets(
+            stable_key_hashes(keys), RET_CMS_DEPTH, RET_CMS_WIDTH, RET_CMS_SEED))
+        svc.submit(buckets, jnp.ones((RET_BATCH,), jnp.int32), event_time=times(i))
+
+    cms_cls = _ret_cms_metric_cls()
+    return (
+        ("array", lambda: windowed(Accuracy()), classifier_submit),
+        ("hist_sketch",
+         lambda: windowed(AUROC(approx="sketch", num_bins=64)), classifier_submit),
+        ("qsketch",
+         lambda: windowed(Quantile(q=0.99, alpha=QSK_ALPHA,
+                                   min_value=QSK_LO, max_value=QSK_HI)),
+         quantile_submit),
+        ("cms", lambda: windowed(cms_cls()), cms_submit),
+        ("keyed",
+         lambda: windowed(Keyed(Accuracy(), num_slots=RET_TENANTS)), keyed_submit),
+    )
+
+
+def _ret_drive(factory, submit, label, batches=RET_BATCHES, ladder=RET_LADDER):
+    """One seeded service stream into an attached store, with the raw
+    published partials teed for the flat-recompute oracle."""
+    from metrics_tpu import MetricService, RetentionStore
+
+    raw = []
+    with MetricService(factory(), name=label, deferred_publish=False) as svc:
+        svc.partial_publish_fn = lambda record, partial: raw.append(partial)
+        store = RetentionStore(ladder=ladder, name=f"{label}-store").attach(svc)
+        rng = np.random.RandomState(17)
+        for i in range(batches):
+            submit(svc, rng, i)
+        svc.finalize()
+    return store, raw
+
+
+def _ret_flat(factory, raw, start_s, seconds):
+    """The oracle: finish the union of raw published partials covering one
+    output bucket through a FRESH template — no store, no roll-up."""
+    group = [p for p in raw
+             if start_s <= p["window_start_s"] < start_s + seconds]
+    return np.asarray(factory().value_from_partials(group)), len(group)
+
+
+def _ret_check_exactness(failures: list) -> dict:
+    """Every query — native mixed resolution and every legal coarse grid —
+    must be BIT-exact vs the flat recompute, for all four state kinds and
+    the nested keyed plane; a grid finer than a rolled-up bucket must raise
+    rather than approximate."""
+    report = {}
+    total_windows = int(math.ceil(RET_SPAN_S / RET_WINDOW_S))
+    # the full-range grids every retained bucket nests inside (the ladder's
+    # overflow cell rolled up into one [0, 40) coarse bucket, so 4x the
+    # window stride is the finest legal full-range grid)
+    resolutions = [4 * RET_WINDOW_S, 8 * RET_WINDOW_S,
+                   16 * RET_WINDOW_S, 24 * RET_WINDOW_S]
+    for name, factory, submit in _ret_vehicles():
+        store, raw = _ret_drive(factory, submit, f"gate/retention-{name}")
+        vehicle = {"published": len(raw), "points": {}}
+        sweeps = [("native", None, (0.0, RET_SPAN_S)),
+                  ("raw_tail", RET_WINDOW_S,
+                   (RET_SPAN_S - 4 * RET_WINDOW_S, RET_SPAN_S))]
+        sweeps += [(f"{int(r)}s", r, (0.0, RET_SPAN_S)) for r in resolutions]
+        for sweep, res, span in sweeps:
+            points = store.query(metric=store.labels[0], time_range=span,
+                                 resolution_s=res)
+            if not points:
+                failures.append(f"{name}/{sweep}: query returned no points")
+                continue
+            windows = 0
+            for point in points:
+                flat, n_raw = _ret_flat(factory, raw,
+                                        point["start_s"], point["seconds"])
+                windows += point["windows"]
+                if point["windows"] != n_raw:
+                    failures.append(
+                        f"{name}/{sweep}: point at {point['start_s']}s merged"
+                        f" {point['windows']} windows but {n_raw} raw partials"
+                        " cover its span"
+                    )
+                if not np.array_equal(point["value"], flat, equal_nan=True):
+                    failures.append(
+                        f"{name}/{sweep}: point at {point['start_s']}s is not"
+                        " bit-exact vs the flat recompute of its raw partials"
+                    )
+                if name == "keyed":
+                    for tenant in (0, RET_TENANTS - 1):
+                        sliced = store.query(metric=store.labels[0],
+                                             tenant=tenant, time_range=span,
+                                             resolution_s=res)
+                        got = next(p["value"] for p in sliced
+                                   if p["start_s"] == point["start_s"])
+                        if not np.array_equal(got, point["value"][tenant],
+                                              equal_nan=True):
+                            failures.append(
+                                f"{name}/{sweep}: tenant {tenant} slice"
+                                " diverged from the full slab's row"
+                            )
+            expect = (total_windows if span == (0.0, RET_SPAN_S)
+                      else int((span[1] - span[0]) / RET_WINDOW_S))
+            if windows != expect:
+                failures.append(
+                    f"{name}/{sweep}: points cover {windows} windows,"
+                    f" expected {expect}"
+                )
+            vehicle["points"][sweep] = len(points)
+        # the negative space: a grid finer than a rolled-up bucket must
+        # refuse loudly (merged state never splits), not interpolate
+        for res in (RET_WINDOW_S, 2 * RET_WINDOW_S):
+            try:
+                store.query(metric=store.labels[0],
+                            time_range=(0.0, RET_SPAN_S), resolution_s=res)
+                failures.append(
+                    f"{name}: resolution {res}s should have raised (it"
+                    " splits a rolled-up bucket) but returned points"
+                )
+            except ValueError:
+                pass
+        report[name] = vehicle
+    return report
+
+
+def _ret_check_memory(failures: list) -> dict:
+    """Resident bytes must be bounded by the ladder shape, NOT by stream
+    length: a 3x-longer stream through a saturated (evicting) ladder banks
+    3x the windows in the SAME footprint."""
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, Windowed
+
+    def factory():
+        return Windowed(Accuracy(), window_s=RET_WINDOW_S,
+                        num_windows=RET_WINDOWS, allowed_lateness_s=0.0)
+
+    def submit(svc, rng, i):
+        svc.submit(jnp.asarray(rng.rand(RET_BATCH).astype(np.float32)),
+                   jnp.asarray(rng.randint(0, 2, RET_BATCH).astype(np.int32)),
+                   event_time=np.full(RET_BATCH, i * RET_WINDOW_S))
+
+    ladder = ((RET_WINDOW_S, 4), (4 * RET_WINDOW_S, 4), (16 * RET_WINDOW_S, 4))
+    short, _ = _ret_drive(factory, submit, "gate/retention-mem-1x",
+                          batches=96, ladder=ladder)
+    long, _ = _ret_drive(factory, submit, "gate/retention-mem-3x",
+                         batches=288, ladder=ladder)
+    report = {
+        "resident_bytes_1x": int(short.resident_bytes()),
+        "resident_bytes_3x": int(long.resident_bytes()),
+        "banked_1x": short.windows_banked, "banked_3x": long.windows_banked,
+        "evicted_1x": short.evicted_buckets, "evicted_3x": long.evicted_buckets,
+    }
+    if long.resident_bytes() != short.resident_bytes():
+        failures.append(
+            f"memory: resident bytes moved with stream length"
+            f" ({report['resident_bytes_1x']} -> {report['resident_bytes_3x']})"
+        )
+    if long.windows_banked != 3 * short.windows_banked:
+        failures.append("memory: the 3x stream did not bank 3x the windows")
+    if not (short.evicted_buckets > 0 and
+            long.evicted_buckets > short.evicted_buckets):
+        failures.append("memory: the ladder never saturated (scenario broken)")
+    return report
+
+
+def _ret_check_exposition(failures: list) -> dict:
+    """The scrape surface must stay well-formed: one terminal ``# EOF`` and
+    the retained stream's latest value present (the strict line-level format
+    contract is tier-1's ``test_openmetrics.py``; this is the smoke seam)."""
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, Windowed
+    from metrics_tpu.serving import render
+
+    def factory():
+        return Windowed(Accuracy(), window_s=RET_WINDOW_S,
+                        num_windows=RET_WINDOWS, allowed_lateness_s=0.0)
+
+    def submit(svc, rng, i):
+        svc.submit(jnp.asarray(rng.rand(RET_BATCH).astype(np.float32)),
+                   jnp.asarray(rng.randint(0, 2, RET_BATCH).astype(np.int32)),
+                   event_time=np.full(RET_BATCH, i * RET_STEP_S))
+
+    store, _ = _ret_drive(factory, submit, "gate/retention-scrape", batches=16)
+    text = render([store])
+    if not text.endswith("# EOF\n"):
+        failures.append("exposition: rendering does not terminate with '# EOF\\n'")
+    if text.count("# EOF") != 1:
+        failures.append("exposition: '# EOF' must appear exactly once")
+    if "metrics_tpu_retained_latest{" not in text:
+        failures.append("exposition: the retained stream's latest value is missing")
+    return {"bytes": len(text), "lines": text.count("\n")}
+
+
+def check_retention() -> int:
+    """``--check-retention``: the tiered-retention regression gate (see the
+    RET_* block comment). Prints one JSON line; exit 0 iff every tier holds.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    failures: list = []
+    exact = _ret_check_exactness(failures)
+    memory = _ret_check_memory(failures)
+    exposition = _ret_check_exposition(failures)
+
+    print(json.dumps({
+        "check": "retention",
+        "ok": not failures,
+        "failures": failures,
+        "windows": int(math.ceil(RET_SPAN_S / RET_WINDOW_S)),
+        "exact": exact,
+        "memory": memory,
+        "exposition": exposition,
+    }))
+    return 1 if failures else 0
+
+
 def main() -> None:
     trace_path = _trace_arg(sys.argv)
     if len(sys.argv) > 1 and sys.argv[1] == "--check-trajectory":
@@ -4662,6 +5035,13 @@ def main() -> None:
             + f" --xla_force_host_platform_device_count={N_DEVICES}"
         ).strip()
         raise SystemExit(check_quantile())
+
+    if len(sys.argv) > 1 and sys.argv[1] == "--check-retention":
+        # tiered-retention gate: host-plane banking/roll-up/query over
+        # eagerly-driven services (jax not yet imported, so the platform
+        # pin lands in-process; no virtual devices needed)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        raise SystemExit(check_retention())
 
     if len(sys.argv) > 1 and sys.argv[1] == "--check-collectives":
         # collective regression gate: jax is not yet imported, so the

@@ -132,6 +132,12 @@ from metrics_tpu.wrappers import (  # noqa: E402
     Running,
     Windowed,
 )
-from metrics_tpu.serving import HeavyHitterFleet, MetricFleet, MetricService  # noqa: E402
+from metrics_tpu.serving import (  # noqa: E402
+    ExpositionServer,
+    HeavyHitterFleet,
+    MetricFleet,
+    MetricService,
+    RetentionStore,
+)
 from metrics_tpu.core.streaming import WatermarkAgreement  # noqa: E402
 from metrics_tpu import functional  # noqa: E402

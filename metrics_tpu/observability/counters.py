@@ -77,6 +77,15 @@ What gets counted, and on which plane:
   processed batch and every publish. Recorded unconditionally (a gauge
   write is one dict store; health must not vanish because observability
   was off).
+- **retention**: per-store GAUGES for the tiered retention tier
+  (``serving/retention.py``): ``{store label: {"windows_banked": lifetime
+  raw windows banked, "rollups": lifetime roll-up merges performed,
+  "resident_bytes": CURRENT banked-state footprint, "queries": lifetime
+  query-plane reads}}``. ``resident_bytes`` is the number the retention
+  memory model stands on — bounded by the resolution ladder's shape, flat
+  as the stream grows (``bench.py --check-retention`` pins it). Refreshed
+  on every bank/roll-up/query while counting is enabled; present in every
+  snapshot.
 - **state_bytes**: a per-metric GAUGE of the current state footprint
   (``{metric class name: bytes}``), refreshed after every eager
   update/sync while counting is enabled. This is how the sketch-vs-buffer
@@ -187,6 +196,7 @@ __all__ = [
     "record_fleet_shards",
     "record_gather_skip",
     "record_heavy_hitters",
+    "record_retention",
     "record_service_health",
     "record_slab_dropped",
     "record_slab_slots",
@@ -281,6 +291,7 @@ class CollectiveCounters:
         "slab_slots",
         "heavy_hitters",
         "service_health",
+        "retention",
         "_lock",
     )
 
@@ -316,6 +327,7 @@ class CollectiveCounters:
         self.slab_slots: Dict[str, Dict[str, int]] = {}  # keyed-slab label -> gauges
         self.heavy_hitters: Dict[str, Dict[str, Any]] = {}  # hh-wrapper label -> gauges
         self.service_health: Dict[str, Dict[str, Any]] = {}  # service label -> health gauges
+        self.retention: Dict[str, Dict[str, int]] = {}  # retention-store label -> gauges
 
     # ---------------------------------------------------------- recording
     def record_collective(
@@ -474,6 +486,24 @@ class CollectiveCounters:
                 "queue_depth": int(queue_depth),
             }
 
+    def record_retention(
+        self, label: str, windows_banked: int, rollups: int, resident_bytes: int,
+        queries: int,
+    ) -> None:
+        """Refresh one retention store's gauges (latest value wins):
+        ``windows_banked``/``rollups``/``queries`` are the store's lifetime
+        totals (themselves gauges, like the LRU eviction count);
+        ``resident_bytes`` is the CURRENT banked-state footprint — the
+        number whose flatness under an unbounded stream is the retention
+        tier's memory claim (``bench.py --check-retention`` pins it)."""
+        with self._lock:
+            self.retention[label] = {
+                "windows_banked": int(windows_banked),
+                "rollups": int(rollups),
+                "resident_bytes": int(resident_bytes),
+                "queries": int(queries),
+            }
+
     def record_fleet_shards(self, label: str, shards: Dict[str, Dict[str, Any]]) -> None:
         """Refresh one serving fleet's per-shard gauges (latest value wins;
         ``shards`` maps shard index -> {"health", "queue_depth", "occupied",
@@ -536,6 +566,7 @@ class CollectiveCounters:
                 "slab_slots": {k: dict(v) for k, v in sorted(self.slab_slots.items())},
                 "heavy_hitters": {k: dict(v) for k, v in sorted(self.heavy_hitters.items())},
                 "service_health": {k: dict(v) for k, v in sorted(self.service_health.items())},
+                "retention": {k: dict(v) for k, v in sorted(self.retention.items())},
                 "group_cache": {"hits": self.group_cache_hits, "misses": self.group_cache_misses},
                 "step_cache": {"hits": self.step_cache_hits, "misses": self.step_cache_misses},
                 "launch_cache": {"hits": self.launch_cache_hits, "misses": self.launch_cache_misses},
@@ -680,6 +711,16 @@ def record_state_bytes(metric: str, nbytes: int) -> None:
 def record_fleet_shards(label: str, shards: Dict[str, Dict[str, Any]]) -> None:
     if COUNTERS.enabled:
         COUNTERS.record_fleet_shards(label, shards)
+
+
+# Retention gauges are telemetry refreshed from host bookkeeping (the
+# resident-bytes walk touches every banked leaf's metadata), so they share
+# the enabled gate like fleet_shards / slab_slots.
+def record_retention(
+    label: str, windows_banked: int, rollups: int, resident_bytes: int, queries: int
+) -> None:
+    if COUNTERS.enabled:
+        COUNTERS.record_retention(label, windows_banked, rollups, resident_bytes, queries)
 
 
 def record_slab_slots(label: str, slots: int, occupied: int, evictions: int) -> None:

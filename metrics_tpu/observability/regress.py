@@ -79,6 +79,10 @@ MS_KEYS: Tuple[str, ...] = (
     # background host plane + fold): the cross-rank clock must stay cheap
     # enough to ride every ingest cadence tick
     "wm_agreement_ms",
+    # one full-range native query against the banked retention ladder
+    # (every retained bucket finished through value_from_partials): the
+    # read path must stay cheap enough to serve scrapes inline
+    "retention_query_ms",
 )
 
 # staged-collective keys gated exactly (no growth) vs the latest prior round
@@ -180,6 +184,14 @@ COUNT_KEYS: Tuple[str, ...] = (
     # growth in either means the scenario changed, re-pin deliberately
     "wm_exchange_calls",
     "slide_windows_published",
+    # the tiered retention store: the seeded stream's banked-window and
+    # roll-up counts are routing arithmetic (deterministic), and resident
+    # bytes are bounded by the ladder shape — growth in the counts means
+    # the scenario changed (re-pin deliberately), growth in the bytes
+    # means retention started leaking state
+    "retention_windows_banked",
+    "retention_rollups",
+    "retention_resident_bytes",
 )
 
 # throughput keys (batches/sec through real serving loops): gated as

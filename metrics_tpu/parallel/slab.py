@@ -42,14 +42,17 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
+from metrics_tpu.parallel.cms import CountMinSketch
 from metrics_tpu.parallel.qsketch import QuantileSketch
 from metrics_tpu.parallel.sketch import HistogramSketch, RankSketch, is_sketch
 
 __all__ = [
     "LRUSlotTable",
+    "PARTIAL_SCHEMA_VERSION",
     "SLAB_REDUCES",
     "SLAB_SKETCH_KINDS",
     "SlabSpec",
+    "check_partial_version",
     "dropped_slot_count",
     "is_slab_spec",
     "make_slab_spec",
@@ -62,6 +65,40 @@ __all__ = [
     "slab_touched_mask",
 ]
 
+# The mergeable-partial WIRE FORMAT version. Stamped into every partial the
+# wrappers emit (``Windowed.window_partial``, ``Keyed.mergeable_partial``)
+# and VALIDATED at every ingest point (``merge_partials``,
+# ``value_from_partials``, the retention store's bank) — partials outlive
+# the process that produced them (fleet queues, retention tiers), so a
+# silent format drift must fail loudly, not merge garbage. Bump it whenever
+# a partial's keys or leaf layout change meaning.
+PARTIAL_SCHEMA_VERSION = 1
+
+
+def check_partial_version(partial: Any) -> Any:
+    """Validate one mergeable partial's wire-format version, loudly.
+
+    Every ingest point that banks or merges partials produced elsewhere (the
+    fleet merge tier, the retention store, ``merge_partials``/
+    ``value_from_partials``) runs this first: a partial without a ``version``
+    stamp, or with a stamp from another schema generation, must fail HERE —
+    silently merging a drifted layout would corrupt every downstream
+    roll-up. Returns the partial unchanged so call sites can chain it.
+    """
+    if not isinstance(partial, dict) or "state" not in partial or "rows" not in partial:
+        raise ValueError(
+            "not a mergeable partial (expected a dict with 'version', 'rows'"
+            f" and 'state' keys): {type(partial).__name__}"
+        )
+    version = partial.get("version")
+    if version != PARTIAL_SCHEMA_VERSION:
+        raise ValueError(
+            f"mergeable-partial schema version mismatch: got {version!r},"
+            f" this library speaks version {PARTIAL_SCHEMA_VERSION} —"
+            " refusing to merge a drifted wire format"
+        )
+    return partial
+
 # per-slot reduce kinds a slab row supports. "mean" is SUM-BACKED: the slab
 # stores the running sum of per-sample deltas and the finisher divides by the
 # per-slot row count — which is what lets a mean-kind slab merge by addition
@@ -70,8 +107,16 @@ SLAB_REDUCES = ("sum", "mean", "min", "max")
 
 # sketch slab kinds: the slab keeps the sketch TYPE with a leading (K, ...)
 # counts axis. "qsketch" rows are log-bucketed quantile sketches — what
-# Keyed(Quantile(q=0.99)) turns per-tenant latency into.
-_SKETCH_KINDS = {"hist": HistogramSketch, "rank": RankSketch, "qsketch": QuantileSketch}
+# Keyed(Quantile(q=0.99)) turns per-tenant latency into. "cms" rows are
+# count-min grids (one (depth, width, *item) counts leaf per slot) — the
+# windowed form of the constant-memory tail, merge = elementwise add like
+# every other sketch kind.
+_SKETCH_KINDS = {
+    "hist": HistogramSketch,
+    "rank": RankSketch,
+    "qsketch": QuantileSketch,
+    "cms": CountMinSketch,
+}
 SLAB_SKETCH_KINDS = tuple(_SKETCH_KINDS)
 
 

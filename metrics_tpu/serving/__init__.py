@@ -7,7 +7,12 @@ stalling the stream, crash-safe snapshot/restore riding the epoch watermark,
 and health gauges. ``MetricFleet`` scales it horizontally: N hash-partitioned
 ``MetricService`` ingest shards (stable FNV-1a routing) plus a merge tier
 that folds shard partials into the global view by pure state addition as
-windows close, with seeded shard-kill failover. See ``docs/streaming.md``.
+windows close, with seeded shard-kill failover. Downstream of publish,
+``RetentionStore`` banks closed windows' mergeable partials on a resolution
+ladder (lossless roll-up: merge is associative, so coarser buckets stay
+bit-exact) and serves them back through a query plane;
+``ExpositionServer``/``render`` expose the latest resolved values and the
+observability gauges as strict OpenMetrics text. See ``docs/streaming.md``.
 """
 from metrics_tpu.serving.fleet import (
     FLEET_SITE,
@@ -17,16 +22,23 @@ from metrics_tpu.serving.fleet import (
     shard_for_key,
     stable_key_hash,
 )
+from metrics_tpu.serving.openmetrics import CONTENT_TYPE, ExpositionServer, render
+from metrics_tpu.serving.retention import RetentionRung, RetentionStore
 from metrics_tpu.serving.service import HEALTH_STATES, MetricService, ServiceStoppedError
 
 __all__ = [
+    "CONTENT_TYPE",
     "FLEET_SITE",
     "HEALTH_STATES",
+    "ExpositionServer",
     "HeavyHitterFleet",
     "MetricFleet",
     "MetricService",
+    "RetentionRung",
+    "RetentionStore",
     "ServiceStoppedError",
     "ShardStoppedError",
+    "render",
     "shard_for_key",
     "stable_key_hash",
 ]

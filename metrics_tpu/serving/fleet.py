@@ -76,6 +76,8 @@ from metrics_tpu.observability.counters import (
     record_fleet_shards,
 )
 from metrics_tpu.parallel.cms import stable_key_hash
+from metrics_tpu.parallel.sketch import is_sketch
+from metrics_tpu.parallel.slab import PARTIAL_SCHEMA_VERSION
 from metrics_tpu.parallel.sync import SyncGuard
 from metrics_tpu.serving.service import MetricService, ServiceStoppedError
 from metrics_tpu.wrappers.heavy_hitters import HeavyHitters
@@ -174,6 +176,9 @@ class MetricFleet:
         deferred_publish: bool = True,
         poll_interval_s: float = 0.02,
         agreement: Union[None, bool, WatermarkAgreement] = None,
+        merged_partial_publish_fn: Optional[
+            Callable[[Dict[str, Any], Dict[str, Any]], None]
+        ] = None,
     ):
         if not callable(metric_factory):
             raise ValueError("`metric_factory` must be a zero-arg callable building a Windowed metric")
@@ -211,6 +216,13 @@ class MetricFleet:
 
         self._lock = threading.RLock()
         self.merged_publish_fn = merged_publish_fn
+        # the retention tier's tap: receives each merged record together
+        # with the window's MERGED mergeable partial (the union of every
+        # shard's raw rows, still in sum-backed form — one bankable unit per
+        # window). Read at emit time, so attaching post-construction
+        # (RetentionStore.attach) works; the merged partial is only built
+        # when the hook is set.
+        self.merged_partial_publish_fn = merged_partial_publish_fn
         self.merged_records: List[Dict[str, Any]] = []
         self._partials: Dict[int, Dict[int, Dict[str, Any]]] = {}  # window -> shard -> partial
         self._pub_degraded: Dict[int, bool] = {}  # window -> any contributing shard degraded
@@ -361,6 +373,12 @@ class MetricFleet:
         partials = self._partials.get(window, {})
         value = self._template.value_from_partials(list(partials.values()))
         rows = sum(float(np.asarray(p["rows"])) for p in partials.values())
+        # final: no shard's contribution was flush-truncated AND no shard's
+        # watermark was overridden to force this emit — a merged window is
+        # only as complete as its least-complete partial
+        final = not forced and all(
+            bool(p.get("final", True)) for p in partials.values()
+        )
         record = {
             "fleet": self.label,
             "window": window,
@@ -370,9 +388,14 @@ class MetricFleet:
             "shards": sorted(partials),
             "degraded": degraded or self._pub_degraded.get(window, False),
             "forced": forced,
+            "final": final,
         }
         self.merged_records.append(record)
         self._merged_through = window
+        if self.merged_partial_publish_fn is not None:
+            self.merged_partial_publish_fn(
+                record, self._merged_partial(window, list(partials.values()), final)
+            )
         # partials older than the ring can never be resident again — prune
         # so an unbounded stream holds at most ~W windows of partials
         for old in [w for w in self._partials if w <= window - self.num_windows]:
@@ -380,6 +403,26 @@ class MetricFleet:
             self._pub_degraded.pop(old, None)
         if self.merged_publish_fn is not None:
             self.merged_publish_fn(record)
+
+    def _merged_partial(
+        self, window: int, partials: List[Dict[str, Any]], final: bool
+    ) -> Dict[str, Any]:
+        """The window's shard partials merged into ONE bankable partial —
+        the retention tier's unit (raw sum-backed leaves, host numpy), so a
+        fleet of N shards banks one partial per window, not N."""
+        inner, rows = self._template.merge_partials(partials)
+        state = {
+            name: type(v)(np.asarray(v.counts)) if is_sketch(v) else np.asarray(v)
+            for name, v in inner.items()
+        }
+        return {
+            "version": PARTIAL_SCHEMA_VERSION,
+            "window": int(window),
+            "window_start_s": self._template.window_start(window),
+            "rows": np.asarray(rows),
+            "state": state,
+            "final": bool(final),
+        }
 
     def merged_compute(self) -> Any:
         """The GLOBAL sliding view: every globally-resident window's

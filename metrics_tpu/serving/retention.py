@@ -1,0 +1,519 @@
+"""Tiered retention + the query plane: closed windows that outlive the ring.
+
+The serving stack publishes a window exactly once as the watermark closes it
+— and then the ring slot is recycled: the value survives only as long as
+whoever caught the ``publish_fn`` callback kept it. Production monitoring is
+the opposite shape: WRITE once, READ many, over hours of history. This
+module is the read side, built from nothing but the library's one algebraic
+fact — every state kind (sum/mean/min/max arrays, histogram/rank sketches,
+count-min grids, quantile sketches) merges by a pure, associative,
+commutative fold — so closed windows can roll up LOSSLESSLY into coarser
+time grids at constant memory:
+
+- **Banking.** :class:`RetentionStore` attaches to a
+  :class:`~metrics_tpu.serving.service.MetricService` (wrapping its
+  ``partial_publish_fn`` tap) or a
+  :class:`~metrics_tpu.serving.fleet.MetricFleet` (the merge tier's
+  ``merged_partial_publish_fn`` tap) and banks each closed window's RAW
+  mergeable partial (:meth:`~metrics_tpu.wrappers.windowed.Windowed.
+  window_partial` — sum-backed leaves, host numpy, wire-format versioned).
+  Nothing is finished at write time: a banked window is still algebra.
+- **The resolution ladder.** Buckets live on a configurable ladder of
+  (seconds, capacity) rungs — e.g. 12 x 5 s -> 60 x 1 min -> 24 x 1 hr.
+  When a rung overflows its capacity, its oldest bucket MERGES (pure state
+  addition) into the covering bucket of the next-coarser rung; the last
+  rung evicts (counted). Because merge is associative and commutative, a
+  rolled-up bucket is BIT-EXACT the state a flat recompute over the union
+  of its raw partials would build — roll-up loses resolution, never
+  information (``bench.py --check-retention`` pins this for all four state
+  kinds). Resident bytes are bounded by the ladder shape — sum over rungs
+  of ``capacity x state_bytes`` — not by stream length.
+- **The query plane.** :meth:`RetentionStore.query` selects the banked
+  buckets overlapping a time range, groups them onto the requested output
+  resolution, merges each group, and ONLY THEN finishes through the inner
+  metric's ``value_from_partials`` — a 1-hour AUROC is computed from the
+  merged hour sketch, not an average of 720 window AUROCs. Per-tenant
+  streams (``Windowed(Keyed(...))``) slice the finished slab by tenant
+  slot. A requested resolution must nest the retained buckets (you cannot
+  split a merged bucket back apart — resolution coarser than retained is
+  free, finer raises loudly).
+- **final=.** ``MetricService.finalize()`` force-publishes still-open
+  windows; their partials arrive stamped ``final=False`` and every bucket
+  (and query point) they touch reports ``final=False`` — the read side can
+  always tell a complete window from a flush-truncated one.
+- **Consistency.** One lock covers bank, roll-up and query, and a roll-up
+  builds its merged bucket COMPLETELY before installing it — a reader can
+  observe the ladder before or after a roll-up, never a half-merged bucket
+  (and because roll-up is lossless, both observations finish to the same
+  values).
+
+The scrape surface over this store — and over the observability gauges —
+is ``serving/openmetrics.py``. Gauges: the ``retention`` block of every
+counters snapshot (``windows_banked`` / ``rollups`` / ``resident_bytes`` /
+``queries``), enabled-gated like ``fleet_shards``.
+"""
+import itertools
+import math
+import threading
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from metrics_tpu.observability.counters import record_retention, state_nbytes
+from metrics_tpu.parallel.sketch import is_sketch
+from metrics_tpu.parallel.slab import PARTIAL_SCHEMA_VERSION, check_partial_version
+from metrics_tpu.wrappers.keyed import Keyed
+from metrics_tpu.wrappers.windowed import Windowed
+
+__all__ = ["DEFAULT_LADDER_SHAPE", "RetentionRung", "RetentionStore"]
+
+# the default ladder SHAPE, in window strides: (multiple of the previous
+# rung's width, capacity). ``RetentionStore(ladder=None)`` scales it by the
+# attached stream's stride — 16 raw windows, then 16 4-window buckets, then
+# 16 16-window buckets: ~4.3 hours of 60 s windows in 48 buckets.
+DEFAULT_LADDER_SHAPE = ((1, 16), (4, 16), (16, 16))
+
+
+class RetentionRung(NamedTuple):
+    """One rung of the resolution ladder: buckets ``seconds`` wide, at most
+    ``capacity`` of them resident before the oldest rolls up (or, on the
+    last rung, evicts)."""
+
+    seconds: float
+    capacity: int
+
+
+def _normalize_ladder(ladder: Sequence[Tuple[float, int]]) -> Tuple[RetentionRung, ...]:
+    rungs = []
+    for entry in ladder:
+        seconds, capacity = entry
+        if not (isinstance(capacity, int) and capacity >= 1):
+            raise ValueError(f"rung capacity must be a positive int, got {capacity!r}")
+        seconds = float(seconds)
+        if not (seconds > 0):
+            raise ValueError(f"rung seconds must be > 0, got {seconds!r}")
+        rungs.append(RetentionRung(seconds, capacity))
+    if not rungs:
+        raise ValueError("the resolution ladder needs at least one rung")
+    for prev, nxt in zip(rungs, rungs[1:]):
+        ratio = nxt.seconds / prev.seconds
+        if abs(ratio - round(ratio)) > 1e-9 or round(ratio) < 2:
+            raise ValueError(
+                "each rung's bucket width must be an integer multiple (>= 2x) of"
+                f" the previous rung's; got {prev.seconds}s -> {nxt.seconds}s"
+            )
+    return tuple(rungs)
+
+
+class _RetainedStream:
+    """One attached publish stream's banked state: the finisher template
+    plus one ``{bucket index: bucket}`` dict per ladder rung. A bucket IS a
+    mergeable partial (``{"version", "rows", "state"}``) carrying retention
+    metadata on top (``start_s``/``seconds``/``windows``/``final``)."""
+
+    __slots__ = ("label", "template", "ladder", "rungs", "evicted_buckets")
+
+    def __init__(self, label: str, template: Windowed, ladder: Tuple[RetentionRung, ...]):
+        stride = template.window_stride
+        if abs(ladder[0].seconds - stride) > 1e-9:
+            raise ValueError(
+                f"the ladder's base rung is the raw window grid: rung 0 must be"
+                f" {stride}s wide (the stream's window stride), got"
+                f" {ladder[0].seconds}s"
+            )
+        self.label = label
+        self.template = template
+        self.ladder = ladder
+        self.rungs: Tuple[Dict[int, Dict[str, Any]], ...] = tuple({} for _ in ladder)
+        self.evicted_buckets = 0
+
+    def resident_bytes(self) -> int:
+        total = 0
+        for rung in self.rungs:
+            for bucket in rung.values():
+                total += state_nbytes(bucket["state"]) + state_nbytes(bucket["rows"])
+        return total
+
+
+class RetentionStore:
+    """Banked closed windows on a resolution ladder + the query plane.
+
+    Args:
+        ladder: the resolution ladder, a sequence of ``(seconds, capacity)``
+            rungs, finest first. Rung 0 must match the attached stream's
+            window stride (it banks raw partials); each coarser rung's width
+            must be an integer multiple of the previous. ``None`` scales
+            :data:`DEFAULT_LADDER_SHAPE` by the stream's stride at attach
+            time.
+        name: the store's gauge label (auto-indexed when omitted).
+
+    One store can retain several publish streams (attach a service and a
+    fleet side by side); queries address them by label, or omit ``metric=``
+    when exactly one stream is attached. All banking, roll-up and reading
+    happens under one lock — reads never observe a half-merged bucket.
+
+    Example::
+
+        store = RetentionStore(ladder=((5.0, 12), (60.0, 60), (3600.0, 24)))
+        store.attach(service)          # wraps the partial-publish tap
+        ...                            # stream runs; windows bank and roll up
+        points = store.query(time_range=(0.0, 3600.0), resolution_s=60.0)
+    """
+
+    _ids = itertools.count()
+
+    def __init__(
+        self,
+        ladder: Optional[Sequence[Tuple[float, int]]] = None,
+        name: Optional[str] = None,
+    ):
+        self._ladder_cfg = None if ladder is None else _normalize_ladder(ladder)
+        self.label = name or f"RetentionStore#{next(RetentionStore._ids)}"
+        self._lock = threading.RLock()
+        self._streams: Dict[str, _RetainedStream] = {}
+        self.windows_banked = 0  # lifetime raw window partials banked
+        self.rollups = 0  # lifetime roll-up merges performed
+        self.queries = 0  # lifetime query-plane reads
+
+    # ------------------------------------------------------------ attaching
+    def attach(self, source: Any) -> "RetentionStore":
+        """Subscribe to a publish stream's closed-window partials.
+
+        A :class:`MetricService` attaches through its ``partial_publish_fn``
+        tap, a :class:`MetricFleet` through the merge tier's
+        ``merged_partial_publish_fn`` (one MERGED partial per window — N
+        shards bank one bucket, not N). Either tap COMPOSES with a callback
+        already installed there (the fleet's own shard taps are untouched:
+        they live one level down, on the shard services). Returns ``self``
+        so construction chains.
+        """
+        from metrics_tpu.serving.fleet import MetricFleet
+        from metrics_tpu.serving.service import MetricService
+
+        if isinstance(source, MetricFleet):
+            label = source.label
+            self._register(label, source._template)
+            prev = source.merged_partial_publish_fn
+
+            def fleet_tap(record: Dict[str, Any], partial: Dict[str, Any]) -> None:
+                if prev is not None:
+                    prev(record, partial)
+                self.ingest(label, partial)
+
+            source.merged_partial_publish_fn = fleet_tap
+        elif isinstance(source, MetricService):
+            label = source.label
+            self._register(label, source.metric)
+            prev = source.partial_publish_fn
+
+            def service_tap(record: Dict[str, Any], partial: Dict[str, Any]) -> None:
+                if prev is not None:
+                    prev(record, partial)
+                self.ingest(label, partial)
+
+            source.partial_publish_fn = service_tap
+        else:
+            raise ValueError(
+                "RetentionStore.attach takes a MetricService or a MetricFleet,"
+                f" got {type(source).__name__}"
+            )
+        return self
+
+    def _register(self, label: str, template: Windowed) -> _RetainedStream:
+        if not isinstance(template, Windowed) or template.decay:
+            raise ValueError(
+                "retention banks per-window partials; the stream's metric must"
+                " be a Windowed ring"
+            )
+        ladder = self._ladder_cfg
+        if ladder is None:
+            stride = template.window_stride
+            ladder = _normalize_ladder(
+                [(stride * mult, cap) for mult, cap in DEFAULT_LADDER_SHAPE]
+            )
+        with self._lock:
+            if label in self._streams:
+                raise ValueError(
+                    f"a stream labeled {label!r} is already retained by this store"
+                )
+            stream = _RetainedStream(label, template, ladder)
+            self._streams[label] = stream
+            return stream
+
+    @property
+    def labels(self) -> tuple:
+        """The attached stream labels, sorted."""
+        with self._lock:
+            return tuple(sorted(self._streams))
+
+    # -------------------------------------------------------------- banking
+    def ingest(self, label: str, partial: Dict[str, Any]) -> None:
+        """Bank one published window partial (the tap target; callable
+        directly when partials cross a real process boundary). Validates the
+        wire-format version loudly, then banks at rung 0 and compacts the
+        ladder. A re-published window (failover replay) REPLACES its bucket
+        — publishes are idempotent per (stream, window), never additive."""
+        check_partial_version(partial)
+        window = int(partial["window"])
+        with self._lock:
+            stream = self._streams.get(label)
+            if stream is None:
+                raise KeyError(
+                    f"no retained stream labeled {label!r} (attached:"
+                    f" {sorted(self._streams)})"
+                )
+            stride = stream.ladder[0].seconds
+            start_s = float(partial.get("window_start_s", window * stride))
+            bucket = {
+                "version": PARTIAL_SCHEMA_VERSION,
+                "window": window,
+                "rows": np.asarray(partial["rows"]),
+                "state": dict(partial["state"]),
+                # the TRUE covered span [start_s, end_s): buckets report
+                # exactly what they merged, not their rung's nominal grid
+                # cell — a half-filled coarse bucket never claims windows
+                # that still live one rung finer
+                "start_s": start_s,
+                "end_s": start_s + stride,
+                "windows": 1,
+                "final": bool(partial.get("final", True)),
+            }
+            stream.rungs[0][window] = bucket
+            self.windows_banked += 1
+            self._compact_locked(stream)
+            self._note_gauges_locked()
+
+    def _compact_locked(self, stream: _RetainedStream) -> None:
+        """Enforce every rung's capacity, oldest-first: overflowing buckets
+        merge into the covering bucket one rung coarser (pure state
+        addition — lossless by associativity), the last rung evicts. Coarse
+        rungs key buckets by GRID CELL (``floor(start / rung seconds)``)
+        while each bucket keeps its true covered span — rung widths are
+        integer multiples, so a finer bucket always lands entirely inside
+        one coarser cell. The merged bucket is built completely before it
+        is installed."""
+        for i, rung_cfg in enumerate(stream.ladder):
+            buckets = stream.rungs[i]
+            while len(buckets) > rung_cfg.capacity:
+                oldest = buckets.pop(min(buckets))
+                if i + 1 < len(stream.ladder):
+                    coarser = stream.ladder[i + 1]
+                    target = int(math.floor(oldest["start_s"] / coarser.seconds + 1e-9))
+                    existing = stream.rungs[i + 1].get(target)
+                    merged = (
+                        oldest if existing is None
+                        else self._merge_buckets(stream, existing, oldest)
+                    )
+                    stream.rungs[i + 1][target] = merged
+                    self.rollups += 1
+                else:
+                    stream.evicted_buckets += 1
+
+    @staticmethod
+    def _merge_buckets(
+        stream: _RetainedStream, a: Dict[str, Any], b: Dict[str, Any]
+    ) -> Dict[str, Any]:
+        inner, rows = stream.template.merge_partials([a, b])
+        state = {
+            name: type(v)(np.asarray(v.counts)) if is_sketch(v) else np.asarray(v)
+            for name, v in inner.items()
+        }
+        return {
+            "version": PARTIAL_SCHEMA_VERSION,
+            "window": min(int(a["window"]), int(b["window"])),
+            "rows": np.asarray(rows),
+            "state": state,
+            "start_s": min(a["start_s"], b["start_s"]),
+            "end_s": max(a["end_s"], b["end_s"]),
+            "windows": int(a["windows"]) + int(b["windows"]),
+            "final": bool(a["final"]) and bool(b["final"]),
+        }
+
+    # -------------------------------------------------------------- reading
+    def _resolve_stream(self, metric: Optional[str]) -> _RetainedStream:
+        if metric is None:
+            if len(self._streams) != 1:
+                raise ValueError(
+                    "metric= is required when the store retains"
+                    f" {len(self._streams)} streams (attached:"
+                    f" {sorted(self._streams)})"
+                )
+            return next(iter(self._streams.values()))
+        stream = self._streams.get(metric)
+        if stream is None:
+            raise KeyError(
+                f"no retained stream labeled {metric!r} (attached:"
+                f" {sorted(self._streams)})"
+            )
+        return stream
+
+    @staticmethod
+    def _slice_tenant(stream: _RetainedStream, value: Any, tenant: Optional[int]) -> Any:
+        if tenant is None:
+            return value
+        inner = stream.template.metric
+        if not isinstance(inner, Keyed):
+            raise ValueError(
+                f"stream {stream.label!r} has no tenant axis (its inner metric"
+                f" is {type(inner).__name__}, not Keyed)"
+            )
+        if inner.lru:
+            raise ValueError(
+                "per-tenant retention reads need stable slot ids"
+                " (Keyed(lru=False)); an LRU slab's rows are not addressable"
+                " across windows"
+            )
+        slot = int(tenant)
+        if not (0 <= slot < inner.num_slots):
+            raise KeyError(
+                f"tenant slot {slot} is out of range [0, {inner.num_slots})"
+            )
+        import jax
+
+        return jax.tree_util.tree_map(lambda v: np.asarray(v)[slot], value)
+
+    def query(
+        self,
+        metric: Optional[str] = None,
+        tenant: Optional[int] = None,
+        time_range: Optional[Tuple[float, float]] = None,
+        resolution_s: Optional[float] = None,
+    ) -> List[Dict[str, Any]]:
+        """Finished values over banked windows, bucketed onto an output grid.
+
+        Args:
+            metric: the attached stream's label (omit when exactly one
+                stream is retained).
+            tenant: for ``Windowed(Keyed(...))`` streams, the tenant SLOT to
+                slice the finished per-segment values by (stable slot ids —
+                the fleet routing contract). ``None`` returns the full
+                finished value (the whole slab for keyed streams).
+            time_range: ``(start_s, end_s)`` in event-time seconds,
+                half-open — buckets overlapping ``[start, end)`` are read.
+            resolution_s: the output grid in seconds. Every retained bucket
+                in range must NEST inside one output bucket (merged buckets
+                cannot be split): resolution coarser than the retained
+                grid merges further — still bit-exact — while resolution
+                finer than a retained (rolled-up) bucket raises.
+                ``None`` returns each retained bucket as its own point
+                (the native mixed-resolution view).
+
+        Returns a list of points, oldest first: ``{"start_s", "seconds",
+        "value", "windows", "rows", "final"}``. An empty range (or a range
+        the store retains nothing of) returns ``[]``. Values are finished
+        through the inner metric's ``value_from_partials`` — merged state
+        first, finisher once — so every point equals the flat recompute
+        over the union of its raw published partials, bit-exact.
+        """
+        if time_range is None:
+            raise ValueError("query needs time_range=(start_s, end_s)")
+        start_s, end_s = (float(time_range[0]), float(time_range[1]))
+        if not (end_s >= start_s):
+            raise ValueError(f"time_range end {end_s} precedes start {start_s}")
+        with self._lock:
+            stream = self._resolve_stream(metric)
+            self.queries += 1
+            selected = [
+                bucket
+                for rung in stream.rungs
+                for bucket in rung.values()
+                if bucket["start_s"] < end_s and bucket["end_s"] > start_s
+            ] if end_s > start_s else []  # [t, t) is empty, not a point read
+            points: List[Dict[str, Any]] = []
+            if selected:
+                groups: Dict[float, List[Dict[str, Any]]] = {}
+                if resolution_s is None:
+                    for bucket in selected:
+                        groups.setdefault(bucket["start_s"], []).append(bucket)
+                    widths = {
+                        b["start_s"]: b["end_s"] - b["start_s"] for b in selected
+                    }
+                else:
+                    res = float(resolution_s)
+                    if not res > 0:
+                        raise ValueError(f"resolution_s must be > 0, got {res!r}")
+                    widths = {}
+                    for bucket in selected:
+                        lo = math.floor(bucket["start_s"] / res + 1e-9)
+                        hi = math.ceil(bucket["end_s"] / res - 1e-9)
+                        if hi - lo != 1:
+                            raise ValueError(
+                                f"resolution {res}s cannot split the retained"
+                                f" bucket covering [{bucket['start_s']}s,"
+                                f" {bucket['end_s']}s) — rolled-up state"
+                                " only merges coarser, never finer"
+                            )
+                        key = lo * res
+                        groups.setdefault(key, []).append(bucket)
+                        widths[key] = res
+                for key in sorted(groups):
+                    group = groups[key]
+                    value = stream.template.value_from_partials(group)
+                    value = self._slice_tenant(stream, value, tenant)
+                    points.append({
+                        "start_s": key,
+                        "seconds": widths[key],
+                        "value": np.asarray(value),
+                        "windows": sum(int(b["windows"]) for b in group),
+                        "rows": float(np.asarray(sum(float(np.asarray(b["rows"]).sum()) for b in group))),
+                        "final": all(bool(b["final"]) for b in group),
+                    })
+            self._note_gauges_locked()
+            return points
+
+    def latest(
+        self, metric: Optional[str] = None, tenant: Optional[int] = None
+    ) -> Optional[Dict[str, Any]]:
+        """The newest retained bucket's finished value (the scrape read the
+        OpenMetrics endpoint renders), or ``None`` before anything banked."""
+        with self._lock:
+            stream = self._resolve_stream(metric)
+            newest: Optional[Dict[str, Any]] = None
+            for rung in stream.rungs:
+                for bucket in rung.values():
+                    if newest is None or bucket["start_s"] > newest["start_s"]:
+                        newest = bucket
+            if newest is None:
+                return None
+            self.queries += 1
+            value = stream.template.value_from_partials([newest])
+            value = self._slice_tenant(stream, value, tenant)
+            point = {
+                "start_s": newest["start_s"],
+                "seconds": newest["end_s"] - newest["start_s"],
+                "value": np.asarray(value),
+                "windows": int(newest["windows"]),
+                "final": bool(newest["final"]),
+            }
+            self._note_gauges_locked()
+            return point
+
+    # ---------------------------------------------------------------- gauges
+    def resident_bytes(self, metric: Optional[str] = None) -> int:
+        """Current banked-state footprint in bytes (one stream, or the whole
+        store) — bounded by the ladder shape, NOT by stream length: the
+        retention memory claim ``--check-retention`` pins."""
+        with self._lock:
+            if metric is not None:
+                return self._resolve_stream(metric).resident_bytes()
+            return sum(s.resident_bytes() for s in self._streams.values())
+
+    @property
+    def evicted_buckets(self) -> int:
+        """Buckets aged past the last rung and dropped (counted, never
+        silent)."""
+        with self._lock:
+            return sum(s.evicted_buckets for s in self._streams.values())
+
+    def _note_gauges_locked(self) -> None:
+        resident = sum(s.resident_bytes() for s in self._streams.values())
+        record_retention(
+            self.label, self.windows_banked, self.rollups, resident, self.queries
+        )
+
+    def __repr__(self) -> str:
+        with self._lock:
+            return (
+                f"RetentionStore({self.label!r}, streams={sorted(self._streams)},"
+                f" banked={self.windows_banked}, rollups={self.rollups})"
+            )

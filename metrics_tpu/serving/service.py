@@ -388,7 +388,9 @@ class MetricService:
                 break
             if self._published_through is not None and window <= self._published_through:
                 continue
-            self._publish(window)
+            # an expiring window's contents are final: an event that could
+            # still reach it would be beyond the lateness cap and dropped
+            self._publish(window, final=True)
 
     def _closed_through(self) -> Optional[int]:
         """Highest window index no future event can reach: ``w`` is closed
@@ -405,7 +407,8 @@ class MetricService:
         return int(math.floor((wm - m.allowed_lateness_s - m.window_s) / m.window_stride))
 
     def _publish_closed(self, force_through: Optional[int] = None) -> None:
-        closed = self._closed_through() if force_through is None else force_through
+        closed_by_clock = self._closed_through()
+        closed = closed_by_clock if force_through is None else force_through
         if closed is None:
             return
         for window in self.metric.resident_windows():
@@ -413,12 +416,20 @@ class MetricService:
                 break
             if self._published_through is not None and window <= self._published_through:
                 continue
-            self._publish(window)
+            # ``final=`` distinguishes a window the close clock genuinely
+            # passed (no future event can reach it — its contents are the
+            # whole truth) from one finalize() force-published while still
+            # open (flush-truncated: the record says what was seen, not what
+            # the window would have been). The retention tier rolls the two
+            # up differently.
+            final = closed_by_clock is not None and window <= closed_by_clock
+            self._publish(window, final=final)
 
-    def _publish(self, window: int) -> None:
+    def _publish(self, window: int, final: bool = True) -> None:
         """Publish one closed window: the guarded merged view + the window's
         own value, stamped ``degraded=`` when the sync fell back to
-        local-only state, then refresh the crash snapshot.
+        local-only state and ``final=`` per the close-clock verdict above,
+        then refresh the crash snapshot.
 
         With ``deferred_publish`` the guarded sync runs on the background
         host plane over the close-point state snapshot (the double buffer:
@@ -429,6 +440,7 @@ class MetricService:
         """
         self._published_through = window
         book = self._publish_book()
+        book["final"] = bool(final)
         if not self.deferred_publish:
             self._publish_record(self.metric, window, book)
             return
@@ -503,6 +515,12 @@ class MetricService:
                 metric.window_partial(window)
                 if self.partial_publish_fn is not None else None
             )
+            final = bool(book.get("final", True))
+            if partial is not None:
+                # the partial carries the verdict too: retention banks
+                # partials, not records, and must know a flush-truncated
+                # window from a complete one
+                partial["final"] = final
             if attrs is not None:
                 attrs["degraded"] = "yes" if degraded else "no"
             record = {
@@ -512,6 +530,7 @@ class MetricService:
                 "value": _host(value),
                 "merged": _host(merged),
                 "degraded": degraded,
+                "final": final,
                 "watermark": book["watermark"],
                 "agreed_watermark": book.get("agreed_watermark"),
                 "dropped_samples": book["dropped_samples"],

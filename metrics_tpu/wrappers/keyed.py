@@ -41,7 +41,9 @@ from metrics_tpu.parallel.qsketch import QSketchSpec
 from metrics_tpu.parallel.sketch import SketchSpec, is_sketch, sketch_init
 from metrics_tpu.parallel.slab import (
     LRUSlotTable,
+    PARTIAL_SCHEMA_VERSION,
     SlabSpec,
+    check_partial_version,
     dropped_slot_count,
     make_slab_spec,
     slab_init,
@@ -325,7 +327,9 @@ class Keyed(Metric):
     # -------------------------------------------------- mergeable partials
     def mergeable_partial(self) -> Dict[str, Any]:
         """The full slab state as a host-transferable, mergeable partial:
-        ``{"rows", "state"}`` with every leaf in RAW (sum-backed) form.
+        ``{"version", "rows", "state"}`` with every leaf in RAW (sum-backed)
+        form (``version`` is the wire-format stamp every ingest point
+        validates — see ``parallel.slab.PARTIAL_SCHEMA_VERSION``).
 
         Partials from N ingest shards — each shard accumulating a disjoint
         (or overlapping: merge is pure addition / min / max per the slot's
@@ -351,15 +355,19 @@ class Keyed(Metric):
                 out[name] = type(value)(np.asarray(value.counts))
             else:
                 out[name] = np.asarray(value)
-        return {"rows": np.asarray(rows), "state": out}
+        return {"version": PARTIAL_SCHEMA_VERSION, "rows": np.asarray(rows), "state": out}
 
     def value_from_partials(self, partials) -> Any:
         """All K per-segment values over merged partials (pure state
         addition per the reduce kind, then the ordinary finisher) — the
-        aggregation-tier read for a sharded keyed deployment."""
+        aggregation-tier read for a sharded keyed deployment. Every
+        partial's wire-format version is validated first (the
+        ``Windowed.check_partial_version`` contract: drifted layouts fail
+        loudly, they never merge)."""
         acc: State = {}
         rows = jnp.zeros((self.num_slots,), jnp.float32)
         for partial in partials:
+            check_partial_version(partial)
             rows = rows + jnp.asarray(partial["rows"], jnp.float32)
             for name, leaf in partial["state"].items():
                 reduce = self._slab_reduce[name]

@@ -62,10 +62,13 @@ from metrics_tpu.core.streaming import (
 from metrics_tpu.observability.counters import record_slab_dropped
 from metrics_tpu.wrappers.keyed import Keyed
 from metrics_tpu.parallel.buffer import PaddedBuffer
+from metrics_tpu.parallel.cms import CMSSpec
 from metrics_tpu.parallel.qsketch import QSketchSpec
 from metrics_tpu.parallel.sketch import SketchSpec, is_sketch
 from metrics_tpu.parallel.slab import (
+    PARTIAL_SCHEMA_VERSION,
     SLAB_SKETCH_KINDS,
+    check_partial_version,
     SlabSpec,
     dropped_slot_count,
     make_slab_spec,
@@ -242,14 +245,22 @@ class Windowed(Metric):
 
     def _slab_spec_for(self, name: str, spec: Any, fx: Any) -> SlabSpec:
         """The ``SlabSpec`` one inner state maps onto, or a loud rejection."""
-        if isinstance(spec, (SketchSpec, QSketchSpec)):
+        if isinstance(spec, (SketchSpec, QSketchSpec, CMSSpec)):
             if self.decay:
                 raise ValueError(
                     f"state {name!r} is a sketch state; integer sketch counts have no"
                     " exponential-decay form — use the windowed ring (window_s=) for"
                     " sketch metrics"
                 )
-            kind = "qsketch" if isinstance(spec, QSketchSpec) else spec.kind
+            if isinstance(spec, CMSSpec):
+                # windowed count-min: counts grow a leading W axis like every
+                # other sketch kind (merge = add), so the constant-memory tail
+                # gets per-window form — the inner update must resolve its
+                # row buckets host-side (cms_buckets) and feed them as data,
+                # since the vmapped per-sample delta path stays jit-pure
+                kind = "cms"
+            else:
+                kind = "qsketch" if isinstance(spec, QSketchSpec) else spec.kind
             return make_slab_spec(self.num_windows, np.zeros(spec.shape, np.dtype(spec.dtype)),
                                   "sum", kind=kind)
         if isinstance(spec, (list, PaddedBuffer)) or fx == "cat" or fx is None:
@@ -657,16 +668,21 @@ class Windowed(Metric):
     # -------------------------------------------------- mergeable partials
     def window_partial(self, window: int) -> Dict[str, Any]:
         """One resident window's RAW state rows as a host-transferable,
-        mergeable partial: ``{"window", "rows", "state"}``.
+        mergeable partial: ``{"version", "window", "window_start_s", "rows",
+        "state"}``.
 
         This is the fleet merge tier's unit of exchange
-        (``serving/fleet.py``): every leaf is the slot's untouched
+        (``serving/fleet.py``) and the retention tier's banked record
+        (``serving/retention.py``): every leaf is the slot's untouched
         accumulator — sum-backed means stay SUMS, sketch leaves keep their
         integer counts — so partials from N ingest shards merge by the
         slot's own reduce kind (:meth:`value_from_partials`) and the merged
         value is bit-exact the value one process accumulating all the
         samples would compute. Leaves are host numpy (a partial is meant to
         cross a process/queue boundary, not to stay a device reference).
+        ``version`` is :data:`~metrics_tpu.parallel.slab.
+        PARTIAL_SCHEMA_VERSION`, validated at every ingest point — partials
+        outlive the producing process, so format drift fails loudly.
         """
         if self.decay:
             raise ValueError("the decay accumulator has no windows; partials are per-window")
@@ -684,7 +700,13 @@ class Windowed(Metric):
                 out[name] = type(value)(np.asarray(value.counts[slot]))
             else:
                 out[name] = np.asarray(value[slot])
-        return {"window": int(window), "rows": np.asarray(rows[slot]), "state": out}
+        return {
+            "version": PARTIAL_SCHEMA_VERSION,
+            "window": int(window),
+            "window_start_s": self.window_start(window),
+            "rows": np.asarray(rows[slot]),
+            "state": out,
+        }
 
     def _empty_partial(self) -> Dict[str, Any]:
         """The identity partial (a shard that saw no samples): per-slot
@@ -698,7 +720,16 @@ class Windowed(Metric):
                 type(fresh)(np.asarray(fresh.counts[0])) if is_sketch(fresh)
                 else np.asarray(fresh[0])
             )
-        return {"window": -1, "rows": np.zeros((), np.float32), "state": state}
+        return {
+            "version": PARTIAL_SCHEMA_VERSION,
+            "window": -1,
+            "rows": np.zeros((), np.float32),
+            "state": state,
+        }
+
+    # the wire-format validator, surfaced here for API discoverability (the
+    # partial producers and the version constant live in parallel/slab.py)
+    check_partial_version = staticmethod(check_partial_version)
 
     def merge_partials(self, partials) -> tuple:
         """Merge :meth:`window_partial` outputs by pure state addition (sum/
@@ -706,12 +737,15 @@ class Windowed(Metric):
         ``(inner_state, rows)`` pair still in RAW (sum-backed) form. The
         partials need not come from the same window: merging one window's
         partials across shards gives that window's global state, merging
-        every resident window's partials gives the sliding view's."""
+        every resident window's partials gives the sliding view's. Every
+        partial's wire-format version is validated first
+        (:meth:`check_partial_version`)."""
         if not partials:
             partials = [self._empty_partial()]
         acc: State = {}
         rows = jnp.zeros((), jnp.float32)
         for partial in partials:
+            self.check_partial_version(partial)
             rows = rows + jnp.asarray(partial["rows"], jnp.float32)
             for name, leaf in partial["state"].items():
                 reduce = self._slab_reduce[name]

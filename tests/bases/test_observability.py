@@ -338,3 +338,36 @@ def test_snapshot_is_consistent_while_writers_run():
             t.join(timeout=10)
     assert not errors, errors
     obs.disable()
+
+
+# ------------------------------------------------------------ retention gauges
+def test_retention_gauges_schema_in_every_snapshot():
+    """The retention block is part of the stable snapshot schema: present
+    (empty) with counting off or idle, enabled-gated like ``fleet_shards``,
+    and each entry carries exactly the four documented keys."""
+    # schema key exists even before anything records
+    assert obs.counters_snapshot()["retention"] == {}
+
+    # disabled: the module helper is a no-op (telemetry gate)
+    obs_counters.record_retention("idle-store", 1, 2, 3, 4)
+    assert obs.counters_snapshot()["retention"] == {}
+
+    obs.enable()
+    obs_counters.record_retention("store-a", 10, 3, 4096, 7)
+    obs_counters.record_retention("store-b", 1, 0, 128, 0)
+    obs_counters.record_retention("store-a", 11, 4, 4032, 8)  # latest wins
+    snap = obs.counters_snapshot()
+    assert sorted(snap["retention"]) == ["store-a", "store-b"]
+    for entry in snap["retention"].values():
+        assert sorted(entry) == [
+            "queries", "resident_bytes", "rollups", "windows_banked",
+        ]
+        assert all(isinstance(v, int) for v in entry.values())
+    assert snap["retention"]["store-a"] == {
+        "windows_banked": 11, "rollups": 4, "resident_bytes": 4032, "queries": 8,
+    }
+    # snapshots are copies: mutating one must not leak into the counters
+    snap["retention"]["store-a"]["queries"] = 999
+    assert obs.counters_snapshot()["retention"]["store-a"]["queries"] == 8
+    # the block is JSON-ready like the rest of the snapshot
+    json.dumps(snap["retention"])

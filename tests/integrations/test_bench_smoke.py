@@ -177,6 +177,17 @@ def _assert_headline_schema(out):
     assert isinstance(out["service_ingest_steps_per_s"], (int, float))
     assert out["service_ingest_steps_per_s"] > 0
 
+    # the tiered-retention read plane: the full-range query rides the line
+    # in ms, and the store's gauge counts are EXACT pins on the seeded
+    # 240 s stream — 24 published windows down the (4, 4, 8) ladder is
+    # deterministic routing arithmetic, and resident bytes are bounded by
+    # the ladder shape (the memory-flat headline --check-retention gates)
+    assert isinstance(out["retention_query_ms"], (int, float))
+    assert out["retention_query_ms"] > 0
+    assert out["retention_windows_banked"] == 24
+    assert out["retention_rollups"] == 21
+    assert out["retention_resident_bytes"] == 108
+
     # the sharded fleet scenario: the 1-vs-8-shard ingest throughput pair
     # over the simulated per-batch serving work (--check-fleet gates the
     # ratio at >= 4x; here only sanity + the merge tier's exact counts —
@@ -227,7 +238,11 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     out = _run_smoke(("--trace", str(trace_file)))
     _assert_headline_schema(out)
 
-    # schema version of the --trace payload: v13 added the sparse delta-sync
+    # schema version of the --trace payload: v14 added the tiered retention
+    # plane (retention_query_ms — the banked ladder's full-range read —
+    # plus the deterministic windows-banked/roll-up/resident-bytes pins on
+    # the default line, gated by --check-retention's four-kind bit-exact
+    # sweep); v13 added the sparse delta-sync
     # plane (sparse_* staged keys with sync bytes pinned under a tenth of
     # the dense keyed plane's and collective counts constant in K,
     # sparse_fallbacks zero-pinned on the default line, gated by
@@ -252,7 +267,7 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     # windowed serving A/B; v5 the keyed slab A/B; v4 the sketch A/B; v3
     # moved the collective counts to the default line and added the
     # hierarchical A/B + per-crossing counters; bump this pin with the schema
-    assert out["trace_schema"] == 13
+    assert out["trace_schema"] == 14
     # the sketch program's full snapshot: psum-only, no gather kinds staged
     sketch_kinds = out["sketch_counters"]["calls_by_kind"]
     assert sketch_kinds.get("psum", 0) == 2
@@ -709,6 +724,42 @@ def test_bench_check_quantile_gate():
     # memory: flat sketch, growing buffer twin
     assert out["memory"]["qsketch_bytes"] > 0
     assert out["memory"]["buffer_twin_bytes"][-1] > out["memory"]["buffer_twin_bytes"][0]
+
+
+def test_bench_check_retention_gate():
+    """``bench.py --check-retention`` is the tiered-retention gate: every
+    query against the banked roll-up ladder — at the native mixed
+    resolution and every legal coarse grid — must be bit-exact vs a flat
+    recompute over the raw published partials, for ALL FOUR mergeable state
+    kinds (array, histogram sketch, quantile sketch, count-min) plus the
+    nested Windowed(Keyed(...)) per-tenant plane; a grid finer than a
+    rolled-up bucket must raise; resident bytes must stay flat as the
+    stream grows 3x through a saturated ladder; and the OpenMetrics
+    rendering must stay well-formed."""
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, _BENCH, "--check-retention"],
+        capture_output=True, text=True, timeout=280, env=env,
+        cwd=os.path.dirname(_BENCH),
+    )
+    assert proc.returncode == 0, f"--check-retention failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["ok"] is True and out["failures"] == []
+    # all five vehicles ran the full sweep over the 24-window stream
+    assert set(out["exact"]) == {"array", "hist_sketch", "qsketch", "cms", "keyed"}
+    for vehicle in out["exact"].values():
+        assert vehicle["published"] == out["windows"] == 24
+        # 4 raw windows + 4 forty-second cells + 1 coarse bucket natively;
+        # one point once the grid spans the whole retained range
+        assert vehicle["points"]["native"] == 9
+        assert vehicle["points"]["raw_tail"] == 4
+        assert vehicle["points"]["240s"] == 1
+    # the memory-flat headline: 3x the stream, the same resident bytes
+    assert out["memory"]["resident_bytes_3x"] == out["memory"]["resident_bytes_1x"]
+    assert out["memory"]["banked_3x"] == 3 * out["memory"]["banked_1x"]
+    assert out["memory"]["evicted_3x"] > out["memory"]["evicted_1x"] > 0
+    assert out["exposition"]["bytes"] > 0
 
 
 def _run_trajectory(tmp_path, current, rounds):

@@ -1,0 +1,238 @@
+"""OpenMetrics exposition: the scrape surface's format contract.
+
+What must hold (serving/openmetrics.py):
+
+- STRICT exposition format: every rendering parses under an unforgiving
+  line-level validator — ``# TYPE``/``# HELP`` metadata once per family and
+  before its samples, sample names matching their family (counter samples
+  suffixed ``_total``), legal metric/label names, escaped label values,
+  float syntax, one ``# EOF`` terminator at the very end;
+- content: the existing observability gauges (``service_health``,
+  ``fleet_shards``, ``slab_slots``, fault counters, retention gauges) and
+  each retained stream's latest resolved value are all present;
+- keyed streams fan out one ``tenant``-labeled sample per slot;
+- the stdlib HTTP endpoint serves the same text with the OpenMetrics
+  content type on an ephemeral port.
+"""
+import re
+import urllib.request
+
+import numpy as np
+import pytest
+
+import metrics_tpu.observability as obs
+from metrics_tpu import (
+    Accuracy,
+    Keyed,
+    MetricFleet,
+    MetricService,
+    RetentionStore,
+    Windowed,
+)
+from metrics_tpu.serving import CONTENT_TYPE, ExpositionServer, render
+
+W = 10.0
+
+_NAME = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*$")
+_VALUE = re.compile(r"(?:[+-]?(?:\d+(?:\.\d+)?(?:e[+-]?\d+)?)|NaN|[+-]Inf)$")
+# one sample line: name{labels} value   (no timestamps/exemplars emitted)
+_SAMPLE = re.compile(r"([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})? (\S+)$")
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_ESCAPE = re.compile(r"\\(.)")
+
+
+def _unescape(value):
+    def one(m):
+        c = m.group(1)
+        assert c in ('"', "\\", "n"), f"illegal escape \\{c}"
+        return "\n" if c == "n" else c
+
+    return _ESCAPE.sub(one, value)
+
+
+def _parse_strict(text):
+    """A deliberately unforgiving OpenMetrics parser: returns
+    {family: {"type", "help", "samples": [(name, labels-dict, value)]}} or
+    fails the test at the first violation."""
+    assert text.endswith("# EOF\n"), "exposition must terminate with '# EOF\\n'"
+    lines = text.split("\n")
+    assert lines[-1] == "" and lines[-2] == "# EOF"
+    assert "# EOF" not in lines[:-2], "EOF must appear exactly once, at the end"
+    families = {}
+    current = None
+    for line in lines[:-2]:
+        assert line == line.strip() and line, f"no padding or blank lines: {line!r}"
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, kind = rest.rsplit(" ", 1)
+            assert _NAME.match(name), name
+            assert kind in ("gauge", "counter", "histogram", "summary",
+                            "info", "stateset", "unknown")
+            assert name not in families, f"duplicate TYPE for {name}"
+            families[name] = {"type": kind, "help": None, "samples": []}
+            current = name
+        elif line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, help_text = rest.split(" ", 1)
+            assert name == current, "HELP must follow its family's TYPE"
+            assert families[name]["help"] is None, f"duplicate HELP for {name}"
+            families[name]["help"] = help_text
+        else:
+            assert not line.startswith("#"), f"unknown comment line: {line!r}"
+            m = _SAMPLE.match(line)
+            assert m, f"unparseable sample line: {line!r}"
+            name, _, label_body, value = m.groups()
+            assert current is not None, "sample before any family metadata"
+            if families[current]["type"] == "counter":
+                assert name == current + "_total", (
+                    f"counter sample {name!r} must be {current}_total"
+                )
+            else:
+                assert name == current, (
+                    f"sample {name!r} outside its family {current!r}"
+                )
+            labels = {}
+            if label_body is not None:
+                stripped = _LABEL.sub("", label_body)
+                assert set(stripped) <= {","}, (
+                    f"malformed label body: {label_body!r}"
+                )
+                for lname, lvalue in _LABEL.findall(label_body):
+                    assert _LABEL_NAME.match(lname), lname
+                    assert lname not in labels, f"duplicate label {lname}"
+                    labels[lname] = _unescape(lvalue)
+            assert _VALUE.match(value), f"bad sample value: {value!r}"
+            families[current]["samples"].append((name, labels, value))
+    return families
+
+
+def _sample_map(family):
+    return {tuple(sorted(labels.items())): value
+            for _, labels, value in family["samples"]}
+
+
+@pytest.fixture()
+def counters():
+    obs.reset()
+    obs.enable()
+    yield
+    obs.reset()
+
+
+def _run_service(name, n_batches=16, inner=None, **kw):
+    args = dict(window_s=W, num_windows=4, allowed_lateness_s=0.0)
+    args.update(kw)
+    svc = MetricService(Windowed(inner if inner is not None else Accuracy(), **args),
+                        name=name, deferred_publish=False)
+    store = RetentionStore(name=f"{name}-store").attach(svc)
+    rng = np.random.RandomState(0)
+    for i in range(n_batches):
+        kwargs = {}
+        if inner is not None:
+            kwargs["slot"] = rng.randint(0, inner.num_slots, 8).astype(np.int32)
+        svc.submit(rng.rand(8).astype(np.float32),
+                   rng.randint(0, 2, 8).astype(np.int32),
+                   event_time=np.full(8, i * 5.0), **kwargs)
+    svc.finalize()
+    svc.stop()
+    return store
+
+
+def test_rendering_is_strict_openmetrics_with_all_gauge_families(counters):
+    store = _run_service('svc "quoted"\nnewlined\\slashed')
+    families = _parse_strict(render([store]))
+
+    # the observability gauges are all families, present even when empty
+    for name in ("metrics_tpu_service_health", "metrics_tpu_service_published",
+                 "metrics_tpu_service_shed_events", "metrics_tpu_service_queue_depth",
+                 "metrics_tpu_fleet_shard_health", "metrics_tpu_fleet_shard_queue_depth",
+                 "metrics_tpu_slab_slots", "metrics_tpu_fault",
+                 "metrics_tpu_retention_windows_banked", "metrics_tpu_retention_rollups",
+                 "metrics_tpu_retention_resident_bytes", "metrics_tpu_retention_queries",
+                 "metrics_tpu_retained_latest", "metrics_tpu_retained_latest_final"):
+        assert name in families, name
+        assert families[name]["help"], f"{name} needs HELP text"
+
+    # label escaping round-trips the hostile service name
+    health = families["metrics_tpu_service_health"]
+    (_, labels, value), = health["samples"]
+    assert labels["service"] == 'svc "quoted"\nnewlined\\slashed'
+    assert labels["state"] == "healthy" and value == "1"
+
+    # faults render as counters with _total samples
+    fault_kinds = {labels["kind"] for _, labels, _ in
+                   families["metrics_tpu_fault"]["samples"]}
+    assert {"sync_retries", "sync_deadline_exceeded",
+            "degraded_computes", "quarantined_updates"} <= fault_kinds
+
+    # retention gauges agree with the store
+    banked = _sample_map(families["metrics_tpu_retention_windows_banked"])
+    assert banked[(("store", store.label),)] == str(store.windows_banked)
+
+    # the latest resolved value rides along with its provenance twins
+    latest = families["metrics_tpu_retained_latest"]["samples"]
+    assert len(latest) == 1
+    point = store.latest()
+    assert latest[0][2] == ("NaN" if np.isnan(point["value"])
+                            else repr(float(point["value"])))
+    finals = _sample_map(families["metrics_tpu_retained_latest_final"])
+    assert set(finals.values()) <= {"0", "1"}
+
+
+def test_keyed_stream_fans_out_tenant_samples(counters):
+    K = 3
+    store = _run_service("svc-keyed-om", inner=Keyed(Accuracy(), num_slots=K))
+    families = _parse_strict(render([store]))
+    samples = families["metrics_tpu_retained_latest"]["samples"]
+    assert len(samples) == K
+    tenants = {labels["tenant"] for _, labels, _ in samples}
+    assert tenants == {str(i) for i in range(K)}
+    point = store.latest()
+    for _, labels, value in samples:
+        expect = float(point["value"][int(labels["tenant"])])
+        assert value == ("NaN" if np.isnan(expect) else repr(expect))
+
+
+def test_fleet_gauges_render_per_shard(counters):
+    def factory():
+        return Windowed(Accuracy(), window_s=W, num_windows=4,
+                        allowed_lateness_s=20.0)
+
+    with MetricFleet(factory, num_shards=2, name="fleet-om") as fleet:
+        rng = np.random.RandomState(1)
+        for i in range(12):
+            fleet.submit(f"tenant-{i % 5}", rng.rand(8).astype(np.float32),
+                         rng.randint(0, 2, 8).astype(np.int32),
+                         event_time=i * 2.5 + rng.uniform(0, 2.5, 8))
+        fleet.finalize()
+        families = _parse_strict(render())
+    shard_health = families["metrics_tpu_fleet_shard_health"]["samples"]
+    where = {(labels["fleet"], labels["shard"]) for _, labels, _ in shard_health}
+    assert where == {("fleet-om", "0"), ("fleet-om", "1")}
+    depth = families["metrics_tpu_fleet_shard_queue_depth"]["samples"]
+    assert len(depth) == 2
+
+
+def test_http_endpoint_serves_the_exposition(counters):
+    store = _run_service("svc-http")
+    with ExpositionServer([store]) as server:
+        assert server.port > 0
+        with urllib.request.urlopen(server.url, timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"] == CONTENT_TYPE
+            body = resp.read().decode("utf-8")
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/nope", timeout=10)
+    families = _parse_strict(body)
+    assert "metrics_tpu_retained_latest" in families
+    # scrape-visible and render-visible views agree
+    assert _parse_strict(render([store])).keys() == families.keys()
+
+
+def test_render_accepts_an_explicit_snapshot(counters):
+    snap = obs.counters_snapshot()
+    families = _parse_strict(render(snapshot=snap))
+    assert families["metrics_tpu_fault"]["type"] == "counter"
+    assert families["metrics_tpu_retained_latest"]["samples"] == []

@@ -1,0 +1,374 @@
+"""RetentionStore: the tiered retention + query plane contract.
+
+What must hold (serving/retention.py):
+
+- lossless roll-up: a query point at ANY legal resolution is BIT-EXACT the
+  value a flat recompute over the union of the raw published partials in
+  its span produces — roll-up is pure state addition and merge is
+  associative/commutative (the four-state-kind sweep lives in
+  ``bench.py --check-retention``; these tests pin the store mechanics);
+- bounded memory: resident bytes saturate at the ladder shape — a 3x longer
+  stream retains EXACTLY the same bytes, with the overflow counted in
+  ``evicted_buckets``, never silent;
+- final= provenance: windows force-published by ``finalize()`` before the
+  close clock passed them carry ``final=False`` through partials, buckets
+  and query points — the read side can always tell complete from
+  flush-truncated;
+- the query plane's edges: empty ranges, ranges straddling a roll-up
+  boundary, never-updated tenants, output grids coarser than the coarsest
+  rung, and a query racing an in-flight roll-up (readers never observe a
+  half-merged bucket);
+- ingest hygiene: wire-format version validated loudly, unknown streams
+  rejected, a re-published window REPLACES its bucket (publishes are
+  idempotent per window, never additive);
+- attach: composes with an already-installed partial tap; a fleet banks ONE
+  merged partial per window (not one per shard).
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import metrics_tpu.observability as obs
+from metrics_tpu import (
+    Accuracy,
+    Keyed,
+    MeanSquaredError,
+    MetricFleet,
+    MetricService,
+    RetentionStore,
+    Windowed,
+)
+from metrics_tpu.parallel.slab import PARTIAL_SCHEMA_VERSION
+
+W = 10.0
+
+
+def _metric(inner=None, **kw):
+    args = dict(window_s=W, num_windows=4, allowed_lateness_s=0.0)
+    args.update(kw)
+    return Windowed(inner if inner is not None else Accuracy(), **args)
+
+
+def _drive(svc, n_batches=48, size=8, seed=0, step=2.5, tee=None):
+    """Feed a random binary stream; optionally tee raw partials for flat
+    recomputes (the tee wraps the tap AFTER attach so it sees every partial
+    the store ingests, without double-ingesting)."""
+    if tee is not None:
+        inner_tap = svc.partial_publish_fn
+
+        def teed(record, partial):
+            tee.append(partial)
+            if inner_tap is not None:
+                inner_tap(record, partial)
+
+        svc.partial_publish_fn = teed
+    rng = np.random.RandomState(seed)
+    t = 0.0
+    for _ in range(n_batches):
+        svc.submit(rng.rand(size).astype(np.float32),
+                   rng.randint(0, 2, size).astype(np.int32),
+                   event_time=np.full(size, t))
+        t += step
+    return t
+
+
+def _flat(template_factory, raw, start_s, seconds):
+    group = [p for p in raw if start_s <= p["window_start_s"] < start_s + seconds]
+    return np.asarray(template_factory().value_from_partials(group))
+
+
+# ------------------------------------------------------------ lossless read
+def test_query_bitexact_vs_flat_recompute_across_resolutions():
+    raw = []
+    svc = MetricService(_metric(), name="svc-exact", deferred_publish=False)
+    store = RetentionStore(ladder=((W, 4), (4 * W, 4), (16 * W, 4)), name="exact").attach(svc)
+    end = _drive(svc, n_batches=120, tee=raw)
+    svc.finalize()
+    svc.stop()
+    assert raw and store.windows_banked == len(raw)
+    assert store.rollups > 0  # the ladder actually rolled
+
+    # the coarsest retained grid, a coarser-than-coarsest grid, and the
+    # native mixed-resolution view: every point equals the flat recompute
+    for resolution in (16 * W, 32 * W, None):
+        points = store.query(time_range=(0.0, end), resolution_s=resolution)
+        assert points == sorted(points, key=lambda p: p["start_s"])
+        assert sum(p["windows"] for p in points) == len(raw)
+        for p in points:
+            expect = _flat(_metric, raw, p["start_s"], p["seconds"])
+            assert np.array_equal(expect, p["value"], equal_nan=True)
+
+    # a rolled-up span cannot be read finer than it was merged
+    with pytest.raises(ValueError, match="cannot split"):
+        store.query(time_range=(0.0, end), resolution_s=W)
+    # ...but the still-raw tail can
+    tail = store.query(time_range=(end - 2 * W, end), resolution_s=W)
+    assert tail and all(p["seconds"] == W for p in tail)
+    for p in tail:
+        assert np.array_equal(_flat(_metric, raw, p["start_s"], W), p["value"],
+                              equal_nan=True)
+
+
+def test_ladder_validation():
+    with pytest.raises(ValueError, match="at least one rung"):
+        RetentionStore(ladder=())
+    with pytest.raises(ValueError, match="capacity"):
+        RetentionStore(ladder=((W, 0),))
+    with pytest.raises(ValueError, match="integer multiple"):
+        RetentionStore(ladder=((W, 4), (2.5 * W, 4)))
+    with pytest.raises(ValueError, match="integer multiple"):
+        RetentionStore(ladder=((W, 4), (W, 4)))  # 1x is not a coarsening
+    svc = MetricService(_metric(), name="svc-ladder", deferred_publish=False)
+    try:
+        with pytest.raises(ValueError, match="window stride"):
+            RetentionStore(ladder=((W / 2, 4),)).attach(svc)
+    finally:
+        svc.stop()
+
+
+def test_memory_flat_as_stream_grows():
+    def run(tag, n_batches):
+        svc = MetricService(_metric(), name=f"svc-mem-{tag}", deferred_publish=False)
+        store = RetentionStore(ladder=((W, 3), (4 * W, 3), (16 * W, 2)),
+                               name=f"mem-{tag}").attach(svc)
+        _drive(svc, n_batches=n_batches, seed=1)
+        svc.finalize()
+        svc.stop()
+        return store
+
+    short, long = run("1x", 160), run("3x", 480)
+    assert long.windows_banked == 3 * short.windows_banked
+    assert long.resident_bytes() == short.resident_bytes()  # ladder-bounded
+    assert long.evicted_buckets > short.evicted_buckets  # overflow is counted
+
+
+def test_finalize_truncated_windows_are_not_final():
+    svc = MetricService(_metric(), name="svc-final", deferred_publish=False)
+    store = RetentionStore(name="final").attach(svc)
+    records = []
+    svc.publish_fn = records.append
+    end = _drive(svc, n_batches=9, step=5.0)  # watermark 40: windows 0-3 closed
+    svc.finalize()  # window 4 is still open -> force-published, truncated
+    svc.stop()
+    points = store.query(time_range=(0.0, end + W), resolution_s=W)
+    assert [p["final"] for p in points] == [True] * (len(points) - 1) + [False]
+    by_window = {r["window"]: r["final"] for r in records}
+    assert by_window[len(points) - 1] is False
+    assert all(by_window[w] for w in range(len(points) - 1))
+    # the truncation survives a coarse read: any span touching the open
+    # window reports final=False
+    coarse = store.query(time_range=(0.0, end + W), resolution_s=16 * W)
+    assert coarse[-1]["final"] is False
+
+
+# -------------------------------------------------------------- tenant axis
+def test_keyed_per_tenant_query():
+    K = 4
+    svc = MetricService(_metric(inner=Keyed(Accuracy(), num_slots=K)),
+                        name="svc-keyed", deferred_publish=False)
+    store = RetentionStore(name="keyed").attach(svc)
+    rng = np.random.RandomState(2)
+    t = 0.0
+    for _ in range(24):
+        svc.submit(rng.rand(8).astype(np.float32),
+                   rng.randint(0, 2, 8).astype(np.int32),
+                   event_time=np.full(8, t),
+                   slot=rng.randint(0, K - 1, 8).astype(np.int32))  # slot K-1 never fed
+        t += 5.0
+    svc.finalize()
+    svc.stop()
+
+    whole = store.query(time_range=(0.0, t), resolution_s=16 * W)
+    for slot in range(K - 1):
+        sliced = store.query(time_range=(0.0, t), tenant=slot, resolution_s=16 * W)
+        assert len(sliced) == len(whole)
+        for p_whole, p_slot in zip(whole, sliced):
+            assert np.array_equal(p_whole["value"][slot], p_slot["value"],
+                                  equal_nan=True)
+    # a tenant that exists but never updated resolves to the empty policy
+    ghost = store.query(time_range=(0.0, t), tenant=K - 1, resolution_s=16 * W)
+    assert all(np.isnan(p["value"]) for p in ghost)
+    with pytest.raises(KeyError, match="out of range"):
+        store.query(time_range=(0.0, t), tenant=K, resolution_s=16 * W)
+
+    flat_svc = MetricService(_metric(), name="svc-flat", deferred_publish=False)
+    flat_store = RetentionStore(name="flat").attach(flat_svc)
+    _drive(flat_svc, n_batches=4)
+    flat_svc.finalize()
+    flat_svc.stop()
+    with pytest.raises(ValueError, match="no tenant axis"):
+        flat_store.query(time_range=(0.0, 100.0), tenant=0)
+
+
+# ------------------------------------------------------------- query edges
+def test_query_edges_empty_range_and_straddling_rollup_boundary():
+    raw = []
+    svc = MetricService(_metric(), name="svc-edges", deferred_publish=False)
+    store = RetentionStore(ladder=((W, 4), (4 * W, 8)), name="edges").attach(svc)
+    end = _drive(svc, n_batches=80, tee=raw)
+    svc.finalize()
+    svc.stop()
+
+    assert store.query(time_range=(end + 1e6, end + 2e6)) == []  # never banked
+    assert store.query(time_range=(5.0, 5.0)) == []  # zero-width
+    with pytest.raises(ValueError, match="precedes"):
+        store.query(time_range=(10.0, 0.0))
+    with pytest.raises(ValueError, match="time_range"):
+        store.query()
+
+    # a range straddling the rolled-up/raw boundary: old spans come back at
+    # the rolled 4W width, the recent tail at raw W width — and every point
+    # still equals the flat recompute
+    native = store.query(time_range=(0.0, end))
+    widths = {p["seconds"] for p in native}
+    assert widths == {W, 4 * W}
+    for p in native:
+        assert np.array_equal(_flat(_metric, raw, p["start_s"], p["seconds"]),
+                              p["value"], equal_nan=True)
+
+    # coarser than the coarsest rung just merges further
+    one = store.query(time_range=(0.0, end), resolution_s=1024 * W)
+    assert len(one) == 1 and one[0]["windows"] == len(raw)
+    assert np.array_equal(_flat(_metric, raw, 0.0, 1024 * W), one[0]["value"],
+                          equal_nan=True)
+
+
+def test_query_racing_inflight_rollup_never_observes_half_merged_buckets():
+    """One sample per window with value w (target 0, MSE) -> a bucket whose
+    first window is ``lo`` and which merged ``n`` consecutive windows MUST
+    read ``mean(lo^2 .. (lo+n-1)^2)``. A torn roll-up (bucket visible
+    missing a constituent, or a constituent double-counted) breaks that
+    identity by whole units. The queue drains on the service worker thread
+    while this thread hammers the query plane."""
+    svc = MetricService(_metric(window_s=1.0, inner=MeanSquaredError(),
+                                allowed_lateness_s=0.0, num_windows=2),
+                        name="svc-race", deferred_publish=False, queue_size=512)
+    store = RetentionStore(ladder=((1.0, 4), (4.0, 4), (16.0, 16)), name="race").attach(svc)
+    n_windows = 160
+    errors = []
+
+    def reader():
+        try:
+            for _ in range(400):
+                for p in store.query(time_range=(0.0, float(n_windows))):
+                    lo = int(round(p["start_s"]))
+                    n = int(p["windows"])
+                    got = float(p["value"])
+                    want = float(np.mean(np.arange(lo, lo + n, dtype=np.float64) ** 2))
+                    if not np.isclose(got, want, rtol=1e-5):
+                        errors.append((p["start_s"], p["seconds"], n, got, want))
+        except Exception as exc:  # noqa: BLE001 - surfaced on the main thread
+            errors.append(exc)
+
+    threads = [threading.Thread(target=reader) for _ in range(2)]
+    for th in threads:
+        th.start()
+    for w in range(n_windows):
+        svc.submit(np.float32([w]), np.float32([0.0]),
+                   event_time=np.float64([w + 0.5]))
+    svc.finalize()
+    for th in threads:
+        th.join()
+    svc.stop()
+    assert not errors, errors[:5]
+    final = store.query(time_range=(0.0, float(n_windows)))
+    assert sum(p["windows"] for p in final) == n_windows
+
+
+# ------------------------------------------------------------------ ingest
+def test_ingest_validates_version_and_stream_and_replaces_republished():
+    svc = MetricService(_metric(), name="svc-ingest", deferred_publish=False)
+    raw = []
+    store = RetentionStore(name="ingest").attach(svc)
+    _drive(svc, n_batches=8, tee=raw)
+    svc.finalize()
+    svc.stop()
+    assert raw and all(p["version"] == PARTIAL_SCHEMA_VERSION for p in raw)
+
+    with pytest.raises(ValueError, match="version mismatch"):
+        store.ingest("svc-ingest", dict(raw[0], version=99))
+    with pytest.raises(ValueError, match="version mismatch"):
+        store.ingest("svc-ingest", {k: v for k, v in raw[0].items() if k != "version"})
+    with pytest.raises(KeyError, match="no retained stream"):
+        store.ingest("never-attached", raw[0])
+
+    # a replayed publish of the same window replaces, never double-counts
+    before = store.query(time_range=(0.0, 1e4))
+    store.ingest("svc-ingest", raw[0])
+    after = store.query(time_range=(0.0, 1e4))
+    assert sum(p["windows"] for p in before) == sum(p["windows"] for p in after)
+    for a, b in zip(before, after):
+        assert np.array_equal(a["value"], b["value"], equal_nan=True)
+
+
+def test_attach_composes_and_rejects_bad_sources():
+    seen = []
+    svc = MetricService(_metric(), name="svc-compose", deferred_publish=False,
+                        partial_publish_fn=lambda r, p: seen.append(p["window"]))
+    store = RetentionStore(name="compose").attach(svc)
+    _drive(svc, n_batches=12)
+    svc.finalize()
+    svc.stop()
+    assert seen and store.windows_banked == len(seen)  # both taps saw every window
+
+    with pytest.raises(ValueError, match="MetricService or a MetricFleet"):
+        RetentionStore().attach(_metric())
+    svc2 = MetricService(_metric(), name="svc-compose", deferred_publish=False)
+    try:
+        with pytest.raises(ValueError, match="already retained"):
+            store.attach(svc2)  # same label, same store
+    finally:
+        svc2.stop()
+    with pytest.raises(ValueError, match="metric= is required"):
+        RetentionStore().query(time_range=(0.0, 1.0))
+
+
+# ------------------------------------------------------------------- fleet
+def test_fleet_attach_banks_one_merged_partial_per_window():
+    def factory():
+        return _metric(allowed_lateness_s=20.0)
+
+    with MetricFleet(factory, num_shards=3, name="fleet-ret") as fleet:
+        store = RetentionStore(name="fleet-store").attach(fleet)
+        rng = np.random.RandomState(3)
+        raw = []
+        for i in range(30):
+            raw.append((f"tenant-{i % 7}", i * 2.5 + rng.uniform(0, 2.5, 8),
+                        rng.rand(8).astype(np.float32),
+                        rng.randint(0, 2, 8).astype(np.int32)))
+        for key, t, p, y in raw:
+            fleet.submit(key, p, y, event_time=t)
+        fleet.finalize()
+        records = list(fleet.merged_records)
+
+    # one bucket per merged window, values matching the merged records
+    points = store.query(time_range=(0.0, 1e4), resolution_s=W)
+    assert [p["start_s"] for p in points] == [r["window"] * W for r in records]
+    for p, r in zip(points, records):
+        assert np.array_equal(p["value"], np.asarray(r["value"]), equal_nan=True)
+        assert p["final"] == r["final"]
+
+
+def test_retention_gauges_ride_the_counters_snapshot():
+    obs.reset()
+    obs.enable()
+    try:
+        svc = MetricService(_metric(), name="svc-gauge", deferred_publish=False)
+        store = RetentionStore(ladder=((W, 2), (4 * W, 2)), name="gauge-store").attach(svc)
+        _drive(svc, n_batches=40, seed=4)
+        svc.finalize()
+        svc.stop()
+        store.query(time_range=(0.0, 1e4))
+        snap = obs.counters_snapshot()
+        entry = snap["retention"]["gauge-store"]
+        assert entry == {
+            "windows_banked": store.windows_banked,
+            "rollups": store.rollups,
+            "resident_bytes": store.resident_bytes(),
+            "queries": store.queries,
+        }
+        assert entry["windows_banked"] > 0 and entry["rollups"] > 0
+        assert entry["queries"] >= 1 and entry["resident_bytes"] > 0
+    finally:
+        obs.reset()

@@ -761,3 +761,52 @@ def test_reregistration_lifts_straggler_exclusion():
     assert ag.agreed() == 55.0
     ag.report(1, 10.0)  # still within the refreshed deadline: included
     assert ag.excluded() == ()
+
+
+# ------------------------------------------------- partial wire format (v1)
+def test_partial_wire_format_version_round_trips():
+    """The versioned wire format satellite: every mergeable partial leaves
+    the producer stamped with ``PARTIAL_SCHEMA_VERSION``, round-trips
+    through ``merge_partials``/``value_from_partials`` unchanged, and a
+    drifted or missing version is refused LOUDLY on the consumer side —
+    never silently merged into a live aggregate."""
+    from metrics_tpu import Keyed
+    from metrics_tpu.parallel.slab import PARTIAL_SCHEMA_VERSION, check_partial_version
+
+    times, preds, target = _stream(n=32, horizon=9.0)
+    shards = [Windowed(Accuracy(), window_s=10.0, num_windows=3) for _ in range(2)]
+    for m in shards:
+        m.update(preds, target, event_time=times)
+    partials = [m.window_partial(0) for m in shards]
+    for p in partials:
+        assert p["version"] == PARTIAL_SCHEMA_VERSION
+        assert check_partial_version(p) is p  # validation is pass-through
+    union = Windowed(Accuracy(), window_s=10.0, num_windows=3)
+    merged = np.asarray(union.value_from_partials(partials))
+    whole = Windowed(Accuracy(), window_s=10.0, num_windows=3)
+    whole.update(preds, target, event_time=times)
+    np.testing.assert_array_equal(merged, np.asarray(whole.compute()))
+    # the keyed (cross-rank delta) partial speaks the same versioned format
+    keyed = Keyed(Accuracy(), num_slots=4)
+    keyed.update(preds, target, slot=jnp.asarray(np.int32(np.arange(32) % 4)))
+    kp = keyed.mergeable_partial()
+    assert kp["version"] == PARTIAL_SCHEMA_VERSION
+    np.testing.assert_array_equal(
+        np.asarray(keyed.compute()),
+        np.asarray(Keyed(Accuracy(), num_slots=4).value_from_partials([kp])),
+    )
+
+    # drifted producers are refused at every consumer
+    drifted = dict(partials[0], version=PARTIAL_SCHEMA_VERSION + 1)
+    unstamped = {k: v for k, v in partials[0].items() if k != "version"}
+    for bad in (drifted, unstamped):
+        with pytest.raises(ValueError, match="version mismatch"):
+            check_partial_version(bad)
+        with pytest.raises(ValueError, match="version mismatch"):
+            union.merge_partials([partials[1], bad])
+    with pytest.raises(ValueError, match="version mismatch"):
+        Keyed(Accuracy(), num_slots=4).value_from_partials(
+            [dict(kp, version="v0")]
+        )
+    with pytest.raises(ValueError, match="not a mergeable partial"):
+        check_partial_version("partial")
