@@ -275,6 +275,70 @@ RET_CMS_WIDTH = 64
 RET_CMS_SEED = 7
 RET_CMS_KEYS = 64  # distinct keys folded into the gate's count-min tail
 RETENTION_READ_REPEATS = 12  # best-of repeats for the default-line read key
+# megafusion mixed-collection scenario (--check-collectives megafusion
+# gate): every mergeable state kind behind ONE MetricCollection — array
+# sums (classification counts + float error sums), pmin/pmax riders (PSNR
+# with a tracked data range), histogram + rank sketches, a count-min tail
+# (HeavyHitters), and quantile sketches — synced through the PACKED reduce
+# plane: all sum buckets fold into ONE variadic psum per crossing (4-byte
+# integer dtypes bitcast into a shared int32 lane, float dtypes as sibling
+# operands of the same call), with one pmin + one pmax riding for the
+# dtypes that need them. The pinned property: the staged collective count
+# is IDENTICAL at 6 and 14 members — membership grows the payload, never
+# the program.
+MIXED_MEMBERS = 6
+MIXED_MEMBERS_WIDE = 14
+
+
+def _serialize_cpu_dispatch():
+    """Keep at most ONE XLA:CPU execution in flight.
+
+    XLA:CPU's async dispatch enqueues consecutive executions of the timed
+    step; runs whose collectives depend only on the (constant) state input
+    — the gather planes — are not serialized by the carried-accumulator
+    chain (see _build_gather_runner), so on a low-core host two concurrent
+    runs' 8-participant rendezvous race for the same thread pool and can
+    starve each other (observed as a permanent hang on a 1-core CI host:
+    7 ranks parked in the AllGather rendezvous, the 8th never dispatched).
+    Disabling async dispatch makes every run() loop effectively
+    block_until_ready per step without touching the runners; it is a no-op
+    for what is measured (the loops already time wall-clock over a final
+    block). The flag is read when the CPU client is CREATED, so this must
+    run before anything initializes the backend — which is also why there
+    is no platform check here (``jax.default_backend()`` would itself
+    create the client); the flag only shapes the CPU client and is inert
+    for TPU measurement.
+    """
+    import jax
+
+    jax.config.update("jax_cpu_enable_async_dispatch", False)
+
+
+_FENCE_PER_STEP = None  # resolved on first _step_fence call (backend query)
+
+
+def _step_fence(x):
+    """Block on a sharded step's result before dispatching the next (CPU).
+
+    ``jax_cpu_enable_async_dispatch=False`` only covers NON-parallel
+    computations — the 8-virtual-device sharded programs the timed loops
+    dispatch still overlap, and two in-flight executions' collective
+    rendezvous can starve each other on a low-core host (see
+    _serialize_cpu_dispatch). Fencing each step keeps exactly one sharded
+    execution in flight; on a 1-core host cross-step pipelining was never
+    real concurrency, so the per-step cost measured is unchanged. On real
+    hardware this is identity — the device pipeline stays intact.
+    """
+    global _FENCE_PER_STEP
+    if _FENCE_PER_STEP is None:
+        import jax
+
+        _FENCE_PER_STEP = jax.default_backend() == "cpu"
+    if _FENCE_PER_STEP:
+        import jax
+
+        jax.block_until_ready(x)
+    return x
 
 
 def _collection_ours(compute_groups: bool = True):
@@ -364,6 +428,7 @@ def _build_sync8_runner(compute_groups: bool):
         start = time.perf_counter()
         for _ in range(steps):
             state, out = sharded_step(state, preds, target)
+            _step_fence(out)
         jax.block_until_ready(out)
         return (time.perf_counter() - start) / steps * 1e3
 
@@ -409,9 +474,10 @@ def _build_gather_runner(coalesced: bool):
     def step(s, acc):
         synced = sync(s, reductions, "dp")
         # fold every synced leaf into the carried scalar: the carry chains
-        # step i+1 on step i, serializing the async dispatch — unchained,
-        # XLA:CPU enqueues many concurrent runs of the collective program
-        # and the 8-device rendezvous thread pool can deadlock
+        # step i+1's RESULT on step i — but the gathers themselves depend
+        # only on `state`, so async dispatch can still launch them
+        # concurrently; _step_fence in the run() loop closes that hole on
+        # low-core CPU hosts (see _serialize_cpu_dispatch)
         for leaf in jax.tree_util.tree_leaves(synced):
             acc = acc + jnp.sum(leaf.astype(jnp.float32))
         return acc
@@ -426,7 +492,7 @@ def _build_gather_runner(coalesced: bool):
         acc = jnp.zeros((), jnp.float32)
         start = time.perf_counter()
         for _ in range(steps):
-            acc = sharded_step(state, acc)
+            acc = _step_fence(sharded_step(state, acc))
         jax.block_until_ready(acc)
         return (time.perf_counter() - start) / steps * 1e3
 
@@ -485,7 +551,7 @@ def _build_hier_gather_runner(hierarchical: bool):
         acc = jnp.zeros((), jnp.float32)
         start = time.perf_counter()
         for _ in range(steps):
-            acc = sharded_step(state, acc)
+            acc = _step_fence(sharded_step(state, acc))
         jax.block_until_ready(acc)
         return (time.perf_counter() - start) / steps * 1e3
 
@@ -550,7 +616,7 @@ def _build_sketch_sync_runner(hierarchical: bool = True):
         acc = jnp.zeros((), jnp.float32)
         start = time.perf_counter()
         for _ in range(steps):
-            acc = sharded_step(state, acc)
+            acc = _step_fence(sharded_step(state, acc))
         jax.block_until_ready(acc)
         return (time.perf_counter() - start) / steps * 1e3
 
@@ -614,7 +680,7 @@ def _build_keyed_sync_runner(num_slots: "int | None" = KEYED_SLOTS):
         acc = jnp.zeros((), jnp.float32)
         start = time.perf_counter()
         for _ in range(steps):
-            acc = sharded_step(state, acc)
+            acc = _step_fence(sharded_step(state, acc))
         jax.block_until_ready(acc)
         return (time.perf_counter() - start) / steps * 1e3
 
@@ -733,7 +799,7 @@ def _build_qsketch_sync_runner(num_slots: "int | None" = QSK_SLOTS):
         acc = jnp.zeros((), jnp.float32)
         start = time.perf_counter()
         for _ in range(steps):
-            acc = sharded_step(state, acc)
+            acc = _step_fence(sharded_step(state, acc))
         jax.block_until_ready(acc)
         return (time.perf_counter() - start) / steps * 1e3
 
@@ -817,7 +883,7 @@ def _build_hh_sync_runner():
         acc = jnp.zeros((), jnp.float32)
         start = time.perf_counter()
         for _ in range(steps):
-            acc = sharded_step(state, acc)
+            acc = _step_fence(sharded_step(state, acc))
         jax.block_until_ready(acc)
         return (time.perf_counter() - start) / steps * 1e3
 
@@ -836,6 +902,221 @@ def _hh_stream(key_space: int, batches: int, batch: int, seed: int = 11):
         preds = jnp.asarray(rng.rand(batch).astype(np.float32))
         target = jnp.asarray(rng.randint(0, 2, batch).astype(np.int32))
         yield keys, preds, target
+
+
+def _collection_mixed(members: int = MIXED_MEMBERS):
+    """The MIXED gate collection: all four mergeable state kinds behind one
+    ``MetricCollection``. Binary classification counts (int32 sums), float
+    error sums (MSE/PSNR — and MAE at 14 members), the PSNR tracked data
+    range (the pmin/pmax riders), curve/rank histogram sketches, a
+    HeavyHitters count-min tail, and per-step quantile sketches.
+    ``members=14`` widens every family without adding a dtype bucket, which
+    is exactly what the megafusion gate pins: the packed reduce plane's
+    staged collective count must not move between the two sizes."""
+    from metrics_tpu import (
+        AUROC, Accuracy, F1, HeavyHitters, MeanAbsoluteError,
+        MeanSquaredError, MetricCollection, PSNR, Precision, Quantile,
+        Recall, SpearmanCorrcoef, Specificity,
+    )
+
+    cols = {
+        "acc": Accuracy(),
+        "mse": MeanSquaredError(),
+        "psnr": PSNR(),
+        "auroc": AUROC(approx="sketch", num_bins=KEYED_BINS),
+        "p99": Quantile(q=0.99, alpha=QSK_ALPHA, min_value=QSK_LO, max_value=QSK_HI),
+        "hh": HeavyHitters(
+            AUROC(approx="sketch", num_bins=KEYED_BINS),
+            num_hot_slots=HH_GATE_SLOTS, tail=(HH_TAIL_DEPTH, HH_TAIL_WIDTH),
+        ),
+    }
+    if members > MIXED_MEMBERS:
+        cols.update({
+            "prec": Precision(),
+            "rec": Recall(),
+            "f1": F1(),
+            "spec": Specificity(),
+            "mae": MeanAbsoluteError(),
+            "spear": SpearmanCorrcoef(approx="sketch", num_bins=SKETCH_RANK_BINS),
+            "p50": Quantile(q=0.5, alpha=QSK_ALPHA, min_value=QSK_LO, max_value=QSK_HI),
+            "psnr2": PSNR(),
+        })
+    assert len(cols) == members, (len(cols), members)
+    return MetricCollection(cols)
+
+
+def _mixed_update(col) -> None:
+    """Drive one seeded batch through every member of the mixed collection
+    EAGERLY (HeavyHitters' space-saving table is host-side, so the batch
+    cannot run under jit — same constraint as ``_build_hh_sync_runner``);
+    the sync plane is then traced over the members' ``_current_state``."""
+    import jax.numpy as jnp
+
+    from metrics_tpu import HeavyHitters, Quantile
+    from metrics_tpu.regression import MeanAbsoluteError, MeanSquaredError, PSNR
+
+    rng = np.random.RandomState(0)
+    rows = GATHER_CAPACITY // 2  # same per-step traffic shape as the sketch A/B
+    probs = jnp.asarray(rng.rand(rows).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, rows).astype(np.int32))
+    values = jnp.asarray(rng.lognormal(0.0, 1.5, rows).astype(np.float32))
+    keys = [int(k) for k in rng.randint(0, HH_KEY_SPACE, rows)]
+    for m in col.values():
+        if isinstance(m, HeavyHitters):
+            m.update(probs, target, key=keys)
+        elif isinstance(m, Quantile):
+            m.update(values)
+        elif isinstance(m, (MeanAbsoluteError, MeanSquaredError, PSNR)):
+            m.update(probs, target.astype(jnp.float32))
+        else:
+            m.update(probs, target)
+
+
+def _build_mixed_sync_runner(members: int = MIXED_MEMBERS, hierarchical: bool = True):
+    """(timed_run(steps) -> ms/step, states_synced) for the MEGAFUSION mixed
+    scenario: the whole mixed collection's joint state synced per step with
+    ``MetricCollection.sync_state`` on the (4,2) ici x dcn mesh (or the
+    flat ``dp`` axis). Every sum leaf — int32 classification counts, f32
+    error sums, histogram/rank/quantile sketch counts, the HeavyHitters
+    hot slab + count-min tail — folds into ONE packed psum per crossing
+    (int dtypes bitcast into the int32 lane, floats as sibling operands of
+    the same call), with one pmin + one pmax riding for PSNR's tracked
+    data range: 3 staged calls flat, 6 hierarchical, at EITHER size."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from metrics_tpu.parallel.placement import MeshHierarchy
+    from metrics_tpu.utils.compat import shard_map
+
+    col = _collection_mixed(members)
+    _mixed_update(col)
+    state = {k: m._current_state() for k, m in col.items()}
+    if hierarchical:
+        mesh = Mesh(
+            np.array(jax.devices("cpu")[:N_DEVICES]).reshape(HIER_SLICES, N_DEVICES // HIER_SLICES),
+            ("dcn", "ici"),
+        )
+        axis = MeshHierarchy(ici_axis="ici", dcn_axis="dcn")
+    else:
+        mesh = Mesh(np.array(jax.devices("cpu")[:N_DEVICES]), ("dp",))
+        axis = "dp"
+
+    def step(s, acc):
+        synced = col.sync_state(s, axis)
+        # carry chains step i+1 on step i (see _build_gather_runner)
+        for leaf in jax.tree_util.tree_leaves(synced):
+            acc = acc + jnp.sum(leaf.astype(jnp.float32))
+        return acc
+
+    sharded_step = jax.jit(
+        shard_map(step, mesh=mesh, in_specs=(P(), P()), out_specs=P(), check_vma=False)
+    )
+
+    def run(steps: int) -> float:
+        acc = jnp.zeros((), jnp.float32)
+        start = time.perf_counter()
+        for _ in range(steps):
+            acc = _step_fence(sharded_step(state, acc))
+        jax.block_until_ready(acc)
+        return (time.perf_counter() - start) / steps * 1e3
+
+    return run, sum(len(s) for s in state.values())
+
+
+def _mixed_sync_parity_failures() -> list:
+    """The megafusion gate's bit-exactness half: the packed
+    one-psum-per-crossing plane must reproduce the per-leaf ``sync_value``
+    reference EXACTLY for every state leaf of the 14-member mixed
+    collection — all four mergeable state kinds, int and float dtypes,
+    min/max riders included — on BOTH the flat axis and the (4,2)
+    ici x dcn hierarchy. Returns failure strings (empty on parity)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from metrics_tpu.parallel.placement import MeshHierarchy
+    from metrics_tpu.parallel.sync import sync_value
+    from metrics_tpu.utils.compat import shard_map
+
+    col = _collection_mixed(MIXED_MEMBERS_WIDE)
+    _mixed_update(col)
+    state = {k: m._current_state() for k, m in col.items()}
+    reductions = {k: m._reductions for k, m in col.items()}
+    failures = []
+    for arm in ("flat", "hier"):
+        if arm == "hier":
+            mesh = Mesh(
+                np.array(jax.devices("cpu")[:N_DEVICES]).reshape(HIER_SLICES, N_DEVICES // HIER_SLICES),
+                ("dcn", "ici"),
+            )
+            axis = MeshHierarchy(ici_axis="ici", dcn_axis="dcn")
+        else:
+            mesh = Mesh(np.array(jax.devices("cpu")[:N_DEVICES]), ("dp",))
+            axis = "dp"
+
+        def packed(s):
+            return col.sync_state(s, axis)
+
+        def per_leaf(s):
+            return {
+                k: {n: sync_value(reductions[k][n], v, axis) for n, v in s[k].items()}
+                for k in s
+            }
+
+        run_packed = jax.jit(
+            shard_map(packed, mesh=mesh, in_specs=(P(),), out_specs=P(), check_vma=False)
+        )
+        run_ref = jax.jit(
+            shard_map(per_leaf, mesh=mesh, in_specs=(P(),), out_specs=P(), check_vma=False)
+        )
+        got = jax.tree_util.tree_leaves(run_packed(state))
+        want = jax.tree_util.tree_leaves(run_ref(state))
+        bad = sum(
+            not np.array_equal(np.asarray(g), np.asarray(w))
+            for g, w in zip(got, want)
+        )
+        if len(got) != len(want) or bad:
+            failures.append(
+                f"megafusion gate: packed psum diverged from the per-leaf sync"
+                f" reference on the {arm} mesh ({bad}/{len(want)} leaves)"
+                " — the packed plane must be bit-exact"
+            )
+    return failures
+
+
+def _bench_fused_forward(steps: int = N_STEPS, warmup: int = WARMUP) -> float:
+    """ms/step of the MEGAFUSED whole-collection forward: the sync8
+    collection driven through the host API, where ONE jitted program per
+    (membership, generation) runs every compute-group update together —
+    input canonicalization shared across groups, state slabs donated back
+    to XLA. The first call builds + caches the collection step; a dead
+    ``_col_step`` afterwards means the fused path silently fell back and
+    the key would lie, so that raises instead."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    col = _collection_ours(True)
+    rng = np.random.RandomState(0)
+    rows = BATCH_PER_DEVICE * N_DEVICES
+    preds = jnp.asarray(rng.rand(rows, NUM_CLASSES).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, NUM_CLASSES, rows).astype(np.int32))
+    out = col(preds, target)  # compiles + caches the collection-fused step
+    if col._col_step is None:
+        raise RuntimeError("megafused collection step did not build")
+    for _ in range(warmup):
+        out = col(preds, target)
+    jax.block_until_ready(jax.tree_util.tree_leaves(out))
+    start = time.perf_counter()
+    for _ in range(steps):
+        out = col(preds, target)
+    jax.block_until_ready(jax.tree_util.tree_leaves(out))
+    return (time.perf_counter() - start) / steps * 1e3
 
 
 HH_INGEST_BATCHES = 16
@@ -944,7 +1225,7 @@ def _build_windowed_sync_runner(windowed: bool = True, with_agreement: bool = Fa
         acc = jnp.zeros((), jnp.float32)
         start = time.perf_counter()
         for _ in range(steps):
-            acc = sharded_step(state, acc)
+            acc = _step_fence(sharded_step(state, acc))
         jax.block_until_ready(acc)
         return (time.perf_counter() - start) / steps * 1e3
 
@@ -1213,6 +1494,7 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
     then carries ``collective_calls`` / ``sync_bytes`` (grouped program) and
     a ``phase_ms`` table from the span aggregates.
     """
+    _serialize_cpu_dispatch()
     from metrics_tpu.observability import counters as _ctr
 
     obs = None
@@ -1344,6 +1626,26 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
             qsk_times.append(run_qsk(steps))
     with (obs.span("bench.qsketch_state_bytes") if obs else _null_cm()):
         qsk_state_bytes = _qsketch_state_bytes()
+
+    # megafusion A/B: (a) the whole-collection FUSED FORWARD — one jitted
+    # program per host-API step, state slabs donated (fused_step_ms); (b)
+    # the MIXED collection sync — every mergeable state kind in one
+    # collection on the (4,2) mesh, synced through the packed
+    # one-psum-per-crossing reduce plane; the headline is the staged
+    # collective count pinned EQUAL at 6 and 14 members (the 14-member
+    # twin is traced for its counters only)
+    with (obs.span("bench.fused_forward") if obs else _null_cm()):
+        fused_step_ms = _bench_fused_forward(steps=steps, warmup=warmup)
+    run_mixed, states_mixed, mixed_counters = build(
+        _build_mixed_sync_runner, MIXED_MEMBERS, "mixed6_sync"
+    )
+    _, _, mixed_wide_counters = build(
+        _build_mixed_sync_runner, MIXED_MEMBERS_WIDE, "mixed14_sync"
+    )
+    mixed_times = []
+    for _ in range(repeats):
+        with (obs.span("bench.timed_mixed_sync") if obs else _null_cm()):
+            mixed_times.append(run_mixed(steps))
 
     # windowed serving A/B: Windowed(AUROC sketch) x 4 window slots vs the
     # unwindowed metric on the same (4,2) mesh — like the keyed gate, the
@@ -1536,6 +1838,17 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
         ),
         "qsketch_unkeyed_collective_calls": qsk_unkeyed_counters["collective_calls"],
         "qsketch_state_bytes": qsk_state_bytes,
+        # the megafusion plane: ONE staged program per host-API collection
+        # step (fused_step_ms — canonicalization shared across groups,
+        # state slabs donated) and the mixed-collection packed sync whose
+        # staged count must not move with membership (one packed psum per
+        # crossing + the pmin/pmax riders; 14 members, same program)
+        "fused_step_ms": fused_step_ms,
+        "mixed_sync_ms": min(mixed_times),
+        "mixed_states_synced": states_mixed,
+        "fused_collective_calls": mixed_counters["collective_calls"],
+        "fused_sync_bytes": mixed_counters["sync_bytes"],
+        "fused_collective_calls_14": mixed_wide_counters["collective_calls"],
         # the windowed serving plane: window slots are a leading state axis,
         # so the staged program matches the unwindowed metric's (psum-only)
         "service_sync_ms": min(service_times),
@@ -1620,6 +1933,12 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
             finally:
                 devtime_mod.disable()
 
+        # v15: the megafusion plane joined (fused_step_ms — the whole-
+        # collection single-program forward with donated state slabs —
+        # plus the mixed-collection packed-psum sync keys
+        # fused_collective_calls / fused_sync_bytes with the 14-member
+        # count pinned equal, gated by --check-collectives' megafusion
+        # gate's bit-exact packed-vs-per-leaf parity);
         # v14: the tiered retention plane joined (retention_query_ms — the
         # banked ladder's full-range read — plus the deterministic
         # windows-banked / roll-up / resident-bytes pins on the default
@@ -1651,7 +1970,7 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
         # block); v6 added the windowed serving A/B; v5 the keyed slab A/B;
         # v4 the sketch A/B; v3 moved the collective counts to the default
         # line and added the hierarchical A/B
-        out["trace_schema"] = 14
+        out["trace_schema"] = 15
         out["counters"] = grouped_counters
         out["gather_counters"] = coal_counters
         out["hier_counters"] = hier_counters
@@ -1660,6 +1979,7 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
         out["sparse_counters"] = sparse_counters
         out["hh_counters"] = hh_counters
         out["qsketch_counters"] = qsk_counters
+        out["mixed_counters"] = mixed_counters
         out["service_counters"] = service_counters
         out["async_counters"] = async_counters
         summary = obs.summarize()
@@ -2009,6 +2329,12 @@ _TRACE_KEYS = (
     "qsketch_gather_calls",
     "qsketch_unkeyed_collective_calls",
     "qsketch_state_bytes",
+    "fused_step_ms",
+    "mixed_sync_ms",
+    "mixed_states_synced",
+    "fused_collective_calls",
+    "fused_sync_bytes",
+    "fused_collective_calls_14",
     "service_sync_ms",
     "service_states_synced",
     "service_collective_calls",
@@ -2052,6 +2378,7 @@ _TRACE_KEYS = (
     "sparse_counters",
     "hh_counters",
     "qsketch_counters",
+    "mixed_counters",
     "service_counters",
     "async_counters",
     "phase_ms",
@@ -2145,6 +2472,27 @@ EXPECTED_COLLECTIVES = {
     },
     "sum_grouped": {"collective_calls": 1, "sync_bytes": 520},
     "sum_ungrouped": {"collective_calls": 1, "sync_bytes": 1544},
+    # megafusion mixed plane (all four mergeable state kinds in ONE
+    # MetricCollection on the (4,2) mesh): every sum bucket — int32
+    # classification counts and sketch/count-min/quantile cells bitcast
+    # into one int32 lane, f32 error sums as sibling operands of the SAME
+    # call — folds into ONE packed psum per crossing (psum_calls: 1 ici +
+    # 1 dcn), with one pmin + one pmax riding for PSNR's tracked data
+    # range: 6 staged calls hierarchically. The 14-member twin pins the
+    # membership-independence: IDENTICAL counts, only the payload moves
+    # (+0.4% — the HeavyHitters tail dominates both). The cross-scenario
+    # MEGAFUSION GATE below additionally requires packed-vs-per-leaf
+    # bit-exactness on both meshes.
+    "mixed6_sync": {
+        "collective_calls": 6, "sync_bytes": 1100808, "gather_calls": 0,
+        "psum_calls": 2,
+        "dcn_calls": 3, "dcn_bytes": 550404, "ici_calls": 3, "ici_bytes": 1651212,
+    },
+    "mixed14_sync": {
+        "collective_calls": 6, "sync_bytes": 1105280, "gather_calls": 0,
+        "psum_calls": 2,
+        "dcn_calls": 3, "dcn_bytes": 552640, "ici_calls": 3, "ici_bytes": 1657920,
+    },
     "gather_coalesced": {"collective_calls": 2, "sync_bytes": 49176},
     "gather_per_leaf": {"collective_calls": 12, "sync_bytes": 49176},
     "gather_hier": {
@@ -2274,6 +2622,7 @@ def check_collectives() -> int:
     sync bytes under 10% of the buffer plane's on the same (4,2) mesh.
     Prints one JSON report line either way.
     """
+    _serialize_cpu_dispatch()
     from metrics_tpu import observability as obs
 
     builders = {
@@ -2285,6 +2634,8 @@ def check_collectives() -> int:
         "hh_sync": _build_hh_sync_runner,
         "sum_grouped": lambda: _build_sync8_runner(True),
         "sum_ungrouped": lambda: _build_sync8_runner(False),
+        "mixed6_sync": lambda: _build_mixed_sync_runner(MIXED_MEMBERS),
+        "mixed14_sync": lambda: _build_mixed_sync_runner(MIXED_MEMBERS_WIDE),
         "gather_coalesced": lambda: _build_gather_runner(True),
         "gather_per_leaf": lambda: _build_gather_runner(False),
         "gather_hier": lambda: _build_hier_gather_runner(True),
@@ -2314,6 +2665,9 @@ def check_collectives() -> int:
                 snap["calls_by_kind"].get(k, 0)
                 for k in ("all_gather", "coalesced_gather", "process_allgather")
             ),
+            # staged sum-plane dispatches — the megafusion pin of ONE
+            # packed psum per crossing
+            "psum_calls": snap["calls_by_kind"].get("psum", 0),
         }
         expected = EXPECTED_COLLECTIVES[name]
         status = "ok"
@@ -2515,6 +2869,47 @@ def check_collectives() -> int:
             " gather skip — an empty union must skip the row exchange"
         )
 
+    # the megafusion gate of record: the packed reduce plane. Staged half:
+    # the mixed collection (all four mergeable state kinds behind one
+    # MetricCollection) must stage ONE packed psum per crossing (1 ici +
+    # 1 dcn on the (4,2) mesh — int dtypes bitcast into the shared int32
+    # lane, floats as sibling operands of the SAME call) with the total
+    # staged count IDENTICAL at 6 and 14 members — membership grows the
+    # payload, never the program. Bit-exact half: the packed plane's
+    # synced leaves must equal the per-leaf sync_value reference EXACTLY
+    # on both the flat and hierarchical meshes (14-member collection —
+    # int sums, float sums, min/max riders, every sketch kind).
+    mixed_parity = _mixed_sync_parity_failures()
+    m6_calls = report["mixed6_sync"]["collective_calls"]
+    m14_calls = report["mixed14_sync"]["collective_calls"]
+    m6_psums = report["mixed6_sync"]["psum_calls"]
+    m14_psums = report["mixed14_sync"]["psum_calls"]
+    megafusion_gate = {
+        "mixed6_collective_calls": m6_calls,
+        "mixed14_collective_calls": m14_calls,
+        "mixed6_psum_calls": m6_psums,
+        "mixed14_psum_calls": m14_psums,
+        "crossings": 2,
+        "parity_ok": not mixed_parity,
+        "ok": (
+            m6_calls == m14_calls and m6_psums == 2 and m14_psums == 2
+            and not mixed_parity
+        ),
+    }
+    if m6_calls != m14_calls:
+        failures.append(
+            f"megafusion gate: 6 members staged {m6_calls} collectives vs"
+            f" {m14_calls} at 14 members — the staged count must be"
+            " membership-independent"
+        )
+    if m6_psums != 2 or m14_psums != 2:
+        failures.append(
+            f"megafusion gate: the mixed sum plane staged {m6_psums} (6-member)"
+            f" / {m14_psums} (14-member) psums over 2 crossings — must be ONE"
+            " packed psum per crossing"
+        )
+    failures.extend(mixed_parity)
+
     print(json.dumps({
         "check": "collectives",
         "ok": not failures,
@@ -2524,6 +2919,7 @@ def check_collectives() -> int:
         "keyed_gate": keyed_gate,
         "hh_gate": hh_gate,
         "sparse_gate": sparse_gate,
+        "megafusion_gate": megafusion_gate,
         "scenarios": report,
     }))
     return 1 if failures else 0
@@ -5097,7 +5493,9 @@ def main() -> None:
         child_argv += ["--trace", trace_path]
     child = subprocess.run(
         child_argv,
-        capture_output=True, text=True, timeout=600,
+        # the full A/B now carries the mixed-collection megafusion scenarios
+        # on top of the gather planes; give it headroom beyond 600s
+        capture_output=True, text=True, timeout=1200,
         env={**os.environ, "PYTHONPATH": here},
     )
     if child.returncode != 0 or not child.stdout.strip():

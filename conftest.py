@@ -20,6 +20,13 @@ if _platform == "cpu":
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # CI hosts pin this suite to one core, where the XLA:CPU async dispatch
+    # pool buys no overlap but adds a thread handoff to every tiny eager op —
+    # and lets two 8-participant sharded executions interleave, which can
+    # starve the collective rendezvous (permanent stall). Inline dispatch is
+    # both faster and safer here. Must be set before the CPU client is
+    # created; real-hardware runs skip this branch entirely.
+    jax.config.update("jax_cpu_enable_async_dispatch", False)
 
 # Persistent XLA compilation cache: the suite is compile-bound (CPU: ~45% of a
 # family's wall-clock is recompiles of shapes unchanged across runs; real

@@ -20,6 +20,8 @@ from metrics_tpu.observability.counters import (
 from metrics_tpu.observability.devtime import DEVTIME as _DEVTIME, fence as _fence
 from metrics_tpu.observability.trace import TRACE, span as _span
 from metrics_tpu.parallel.buffer import PaddedBuffer
+from metrics_tpu.utils.checks import shared_input_format
+from metrics_tpu.utils.prints import rank_zero_warn_once
 
 # process-wide fused-step sharing for config-identical collections (same
 # shape as the per-metric _JITTED_STEP_CACHE): a fresh collection per eval
@@ -49,6 +51,28 @@ def _state_write_ids(metric: Metric) -> tuple:
         else:
             ids.append(id(value))
     return tuple(ids)
+
+
+def _dedupe_donated_buffers(states: Dict[str, Any]) -> Dict[str, Any]:
+    """Defensive copies for repeated buffers in a to-be-donated state tree.
+
+    The fused collection step DONATES its state argument so XLA updates the
+    slabs in place — and XLA rejects the same buffer donated twice. Members
+    normally own distinct arrays, but ``load_state_dict``/manual state wiring
+    can alias one buffer across members (or across two states of one member);
+    second and later occurrences get a copy so donation stays legal.
+    """
+    import jax
+
+    seen: set = set()
+
+    def uniq(leaf: Any) -> Any:
+        if id(leaf) in seen:
+            return leaf.copy() if hasattr(leaf, "copy") else leaf
+        seen.add(id(leaf))
+        return leaf
+
+    return jax.tree_util.tree_map(uniq, states)
 
 
 def _col_cache_key(collection: "MetricCollection", kind: str) -> Optional[Tuple[Any, list]]:
@@ -306,6 +330,10 @@ class MetricCollection(OrderedDict):
         fingerprint keeps the fused step off. Mirrors
         ``Metric._forward_fused``'s contract member by member.
         """
+        with shared_input_format():
+            return self._forward_eager_body(*args, **kwargs)
+
+    def _forward_eager_body(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         shared = self._eager_shared_groups()
         step_shares = self._step_sync_shares(shared)
         deltas: Dict[str, Any] = {}
@@ -367,9 +395,26 @@ class MetricCollection(OrderedDict):
             and m._jittable
             and m.compute_on_step
             and not m.dist_sync_on_step
+            and m.dist_sync_fn is None  # custom host gather: per-member path
             and m._config_fingerprint() is not None  # update/compute write states only
             for m in self.values()
         )
+
+    def _warn_unfused(self) -> None:
+        """Name every member (and the attribute) that keeps fusion off.
+
+        Emitted once per message for the process lifetime — the point is a
+        single actionable pointer at the config that broke fingerprinting,
+        not a per-step nag."""
+        for k, m in self.items():
+            reason = m._unfusable_reason()
+            if reason is not None:
+                rank_zero_warn_once(
+                    f"MetricCollection member {k!r} ({type(m).__name__}) is excluded "
+                    f"from the fused collection step by {reason}; the collection "
+                    "falls back to the per-group eager path. Fix the member's "
+                    "config to restore single-dispatch forwards."
+                )
 
     def _refresh_col_cache(self) -> None:
         # cheap per-forward staleness key: child identity, not just names —
@@ -430,10 +475,13 @@ class MetricCollection(OrderedDict):
             # steady-state forwards (fused or not) never re-run it
             if not self._collection_fusable():
                 self.__dict__["_col_unfusable"] = True
+                self._warn_unfused()
                 return None
             step = self._lookup_or_build_col_step("fused", self._build_collection_step)
             self.__dict__["_col_step"] = step
-        states = {k: m._current_state() for k, m in self.items()}
+        # the step donates its state argument: deduplicate aliased buffers
+        # so XLA never sees one buffer donated twice
+        states = _dedupe_donated_buffers({k: m._current_state() for k, m in self.items()})
         try:
             if TRACE.enabled:
                 with _span("collection.fused_step", {"members": len(self)}):
@@ -470,7 +518,7 @@ class MetricCollection(OrderedDict):
         key, pins = keyed
         with _COL_STEP_CACHE_LOCK:
             hit = _COL_STEP_CACHE.get(key)
-            record_cache("step", hit is not None)
+            record_cache("fused_step", hit is not None)
             if hit is None:
                 from metrics_tpu.core.metric import _bounded_insert
 
@@ -489,29 +537,35 @@ class MetricCollection(OrderedDict):
         for c in carriers.values():
             c.reset()
         group_of = dict(self._group_map())
-        donate = (0,) if jax.default_backend() == "tpu" else ()
         lock = threading.Lock()
 
         def step(states, *args, **kwargs):
             # one update per compute group; the shared delta merges into each
             # member's OWN accumulator (members stay individually correct even
             # if one was also updated outside the collection) and each member
-            # computes its batch value from the shared delta
+            # computes its batch value from the shared delta. The
+            # shared_input_format window memoizes input canonicalization, so
+            # groups with equivalent (preds, target) handling reuse ONE
+            # canonicalized pair instead of re-running the format pass each.
             deltas: Dict[str, Any] = {}
             new_states, values = {}, {}
-            for k, c in carriers.items():
-                rep = group_of[k]
-                if rep not in deltas:
-                    rc = carriers[rep]
-                    kw = rc._filter_kwargs(**kwargs)
+            with shared_input_format():
+                for k, c in carriers.items():
+                    rep = group_of[k]
+                    if rep not in deltas:
+                        rc = carriers[rep]
+                        kw = rc._filter_kwargs(**kwargs)
+                        with lock:
+                            deltas[rep] = rc._run_update_on_state(rc.init_state(), *args, **kw)
+                    new_states[k] = c.merge_states(states[k], deltas[rep])
                     with lock:
-                        deltas[rep] = rc._run_update_on_state(rc.init_state(), *args, **kw)
-                new_states[k] = c.merge_states(states[k], deltas[rep])
-                with lock:
-                    values[k] = c.compute_from_state(deltas[rep])
+                        values[k] = c.compute_from_state(deltas[rep])
             return new_states, values
 
-        return jax.jit(step, donate_argnums=donate)
+        # states donate unconditionally (not just on TPU): the whole point of
+        # the megafused step is in-place slab updates, and the caller dedupes
+        # aliased buffers + rebinds every member attr right after the call
+        return jax.jit(step, donate_argnums=(0,))
 
     def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         return self.forward(*args, **kwargs)
@@ -990,8 +1044,11 @@ class MetricCollection(OrderedDict):
     def update_state(self, state: Dict[str, Dict[str, Any]], *args: Any, **kwargs: Any) -> Dict[str, Dict[str, Any]]:
         """Pure joint update: one call updates every state entry — jit this once
         so the whole collection's update fuses into a single XLA computation
-        (with compute groups, one update per group)."""
-        return {k: self[k].update_state(state[k], *args, **self[k]._filter_kwargs(**kwargs)) for k in state}
+        (with compute groups, one update per group). Input canonicalization is
+        memoized across entries (``shared_input_format``), so distinct groups
+        over the same ``(preds, target)`` pair run the format pass ONCE."""
+        with shared_input_format():
+            return {k: self[k].update_state(state[k], *args, **self[k]._filter_kwargs(**kwargs)) for k in state}
 
     def compute_from_state(self, state: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
         gm = self._group_map()

@@ -859,6 +859,39 @@ class Metric(ABC):
             return None
         return ((type(self), items), pins)
 
+    def _unfusable_reason(self) -> Optional[str]:
+        """Why this metric cannot join a collection-level fused step, or None.
+
+        Mirrors ``MetricCollection``'s fusability predicate and, when the
+        config fingerprint is what failed, retries it attribute by attribute
+        to NAME the offending attr — so the fallback warning tells users what
+        to fix instead of silently eating the per-group path.
+        """
+        if not self._fusable:
+            return "a state reduction that is not in-jit mergeable"
+        if not self._jittable:
+            return "jit disabled (`jit=False`, a failed trace, or eager list state)"
+        if not self.compute_on_step:
+            return "compute_on_step=False"
+        if self.dist_sync_on_step:
+            return "dist_sync_on_step=True"
+        if self.dist_sync_fn is not None:
+            return "a custom `dist_sync_fn`"
+        writes = _traced_attr_writes(type(self))
+        if writes is None:
+            return "update() attribute writes that cannot be statically resolved"
+        if not writes <= set(self._defaults):
+            extra = ", ".join(sorted(writes - set(self._defaults)))
+            return f"update() writing non-state attribute(s) {extra}"
+        for k, v in sorted(vars(self).items()):
+            if k in _NON_TRACE_ATTRS or k in self._defaults:
+                continue
+            try:
+                _fingerprint_value(v, [])
+            except _Unfingerprintable:
+                return f"unfingerprintable config attribute {k!r} ({type(v).__name__})"
+        return None
+
     # Attr names (beyond base ``capacity``) that feed ``update``; a subclass
     # declares them to opt its instances into MetricCollection compute groups.
     # None (the default) means "never grouped": without the declaration the

@@ -276,6 +276,8 @@ class CollectiveCounters:
         "step_cache_misses",
         "launch_cache_hits",
         "launch_cache_misses",
+        "fused_step_cache_hits",
+        "fused_step_cache_misses",
         "faults",
         "deferred",
         "deferred_depth",
@@ -312,6 +314,8 @@ class CollectiveCounters:
         self.step_cache_misses = 0
         self.launch_cache_hits = 0
         self.launch_cache_misses = 0
+        self.fused_step_cache_hits = 0
+        self.fused_step_cache_misses = 0
         self.faults: Dict[str, int] = {k: 0 for k in FAULT_KINDS}
         self.deferred: Dict[str, int] = {k: 0 for k in DEFERRED_KINDS}
         self.deferred_depth: Dict[str, Dict[str, int]] = {}  # label -> {"current", "max"}
@@ -342,11 +346,24 @@ class CollectiveCounters:
         traffic ``payload * (fanout - 1)`` (unknown fanout counts the plain
         payload). Callers gate on ``COUNTERS.enabled`` so the disabled path
         never reaches this method.
+
+        ``value`` may also be a tuple/list of arrays: one staged dispatch
+        (a variadic collective) moving the summed payload, bucketed under
+        the dtype label ``"packed"``.
         """
-        size = getattr(value, "size", None)
-        itemsize = getattr(getattr(value, "dtype", None), "itemsize", None)
-        nbytes = int(size) * int(itemsize) if size is not None and itemsize is not None else 0
-        dtype = str(getattr(value, "dtype", "other"))
+        if isinstance(value, (tuple, list)):
+            nbytes = 0
+            for v in value:
+                size = getattr(v, "size", None)
+                itemsize = getattr(getattr(v, "dtype", None), "itemsize", None)
+                if size is not None and itemsize is not None:
+                    nbytes += int(size) * int(itemsize)
+            dtype = "packed"
+        else:
+            size = getattr(value, "size", None)
+            itemsize = getattr(getattr(value, "dtype", None), "itemsize", None)
+            nbytes = int(size) * int(itemsize) if size is not None and itemsize is not None else 0
+            dtype = str(getattr(value, "dtype", "other"))
         traffic = nbytes * max(int(fanout) - 1, 1) if fanout else nbytes
         with self._lock:
             self.calls_by_kind[kind] = self.calls_by_kind.get(kind, 0) + 1
@@ -360,7 +377,7 @@ class CollectiveCounters:
             self.states_synced += int(n)
 
     def record_cache(self, which: str, hit: bool) -> None:
-        """``which`` in {'group', 'step', 'launch'}."""
+        """``which`` in {'group', 'step', 'launch', 'fused_step'}."""
         attr = f"{which}_cache_{'hits' if hit else 'misses'}"
         with self._lock:
             setattr(self, attr, getattr(self, attr) + 1)
@@ -570,6 +587,10 @@ class CollectiveCounters:
                 "group_cache": {"hits": self.group_cache_hits, "misses": self.group_cache_misses},
                 "step_cache": {"hits": self.step_cache_hits, "misses": self.step_cache_misses},
                 "launch_cache": {"hits": self.launch_cache_hits, "misses": self.launch_cache_misses},
+                "fused_step_cache": {
+                    "hits": self.fused_step_cache_hits,
+                    "misses": self.fused_step_cache_misses,
+                },
             }
 
     def reset(self) -> None:

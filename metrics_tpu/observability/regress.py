@@ -61,6 +61,12 @@ MS_KEYS: Tuple[str, ...] = (
     "gather_flat2d_ms",
     "sketch_sync_ms",
     "keyed_sync_ms",
+    # the megafused whole-collection forward: ONE staged program per
+    # host-API step with donated state slabs — its step ms is the
+    # single-dispatch headline; the mixed packed-sync plane rides next
+    # to it so a packing regression shows up in ms too
+    "fused_step_ms",
+    "mixed_sync_ms",
     "sparse_sync_ms",
     "hh_sync_ms",
     "qsketch_sync_ms",
@@ -120,6 +126,15 @@ COUNT_KEYS: Tuple[str, ...] = (
     "keyed_gather_calls",
     "keyed_states_synced",
     "keyed_unkeyed_collective_calls",
+    # the megafusion mixed plane: ONE packed psum per crossing with the
+    # pmin/pmax riders — the staged count is pinned IDENTICAL at 6 and 14
+    # members (fused_collective_calls == fused_collective_calls_14), so
+    # any growth in either count or the packed bytes is a regression of
+    # the membership-independent-program story
+    "fused_collective_calls",
+    "fused_sync_bytes",
+    "fused_collective_calls_14",
+    "mixed_states_synced",
     # the sparse delta-sync plane: staged bytes follow the touched-row
     # count, not the table size — any growth in its counts or bytes is a
     # regression of the bytes-proportional-to-touched-rows story

@@ -445,16 +445,22 @@ _PROGRAM_LOCK = threading.Lock()
 
 
 def clear_program_cache() -> None:
-    """Drop every cached deferred sync program (forces a retrace).
+    """Drop every cached compiled program (forces a retrace): the deferred
+    sync programs here AND the collection-level fused-step cache.
 
-    The cache is keyed by (mesh, axis, state schema), so two planes over the
-    same schema share one compiled program — which also means the second
+    The sync cache is keyed by (mesh, axis, state schema), so two planes over
+    the same schema share one compiled program — which also means the second
     plane stages ZERO new collectives. A staged-collective capture that
     wants to re-count the program (``bench.py``'s lag-depth counters, tests)
-    clears first.
+    clears first; dropping the fused-step cache alongside keeps one clear
+    call sufficient for collection-level captures too.
     """
     with _PROGRAM_LOCK:
         _PROGRAM_CACHE.clear()
+    from metrics_tpu.core.collections import _COL_STEP_CACHE, _COL_STEP_CACHE_LOCK
+
+    with _COL_STEP_CACHE_LOCK:
+        _COL_STEP_CACHE.clear()
 
 
 def _fx_key(fx: ReduceFx, pins: list) -> Any:

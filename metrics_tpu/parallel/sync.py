@@ -422,13 +422,23 @@ def coalesced_sync_state(
 
     Three bucket planes, all keyed by dtype:
 
-    - **Reduce plane** (``sum``/``min``/``max`` array leaves): flattened into
-      one contiguous buffer per (op, dtype) bucket, synced with a single
-      ``psum``/``pmin``/``pmax``, sliced back to the original shapes.
-      Element values are unchanged — cross-device reduction is elementwise,
-      so concatenation cannot alter any element's result. Floating ``mean``
-      leaves FOLD INTO the ``sum`` bucket (psum, then divide by the axis
-      size after slicing), eliminating the separate ``pmean`` per leaf.
+    - **Reduce plane** (``sum``/``min``/``max`` array leaves): every ``sum``
+      bucket folds into ONE byte-packed ``psum`` per crossing — 4-byte
+      integer dtypes bitcast into a single concatenated int32 lane (the
+      buffer plane's PR 4 counts trick, applied to the reduce plane; the
+      reinterpretation is lossless and two's-complement addition is
+      width-exact for signed and unsigned alike), float and odd-width
+      dtypes riding as sibling operands of the same variadic call — so the
+      staged dispatch count is independent of how many dtypes the
+      collection mixes. ``pmin``/``pmax`` buckets ride as separate ops only
+      for the dtypes that need them. Element values are unchanged —
+      cross-device reduction is elementwise, so concatenation cannot alter
+      any element's result. Floating ``mean`` leaves FOLD INTO the packed
+      ``sum`` payload (psum, then divide by the axis size after slicing),
+      eliminating the separate ``pmean`` per leaf. (JAX lowers a variadic
+      ``psum`` to one all-reduce per operand dtype; XLA's all-reduce
+      combiner re-merges them on real backends — the counters pin the
+      library-level staged dispatch, one per crossing.)
     - **Gather plane** (``cat``/``None``/callable array leaves): flattened
       into one payload per dtype bucket, gathered with ONE ``all_gather``,
       then sliced per leaf into the exact ``(world, *shape)`` stack the
@@ -530,17 +540,12 @@ def coalesced_sync_state(
                 # cat / None / callable reductions: the gather plane
                 gather_buckets.setdefault(str(value.dtype), []).append(name)
 
-        ops = {"sum": jax.lax.psum, "min": jax.lax.pmin, "max": jax.lax.pmax}
-        kinds = {"sum": "psum", "min": "pmin", "max": "pmax"}
+        ops = {"min": jax.lax.pmin, "max": jax.lax.pmax}
+        kinds = {"min": "pmin", "max": "pmax"}
         def _payload(v):
             return v.counts if is_sketch(v) else v
 
-        for (op, _dtype), names in buckets.items():
-            if len(names) == 1:
-                out[names[0]] = sync_value(reductions[names[0]], state[names[0]], axis_name, hierarchy, _crossing=crossing)
-                continue
-            flat = jnp.concatenate([jnp.ravel(_payload(state[n])) for n in names])
-            synced = creduce(kinds[op], ops[op], flat)
+        def _unpack_sum(synced: Array, names: list) -> None:
             offset = 0
             for n in names:
                 value = state[n]
@@ -550,6 +555,73 @@ def coalesced_sync_state(
                     piece = piece / world_size()
                 out[n] = type(value)(piece) if is_sketch(value) else piece
                 offset += arr.size
+
+        # -- sum plane: ONE packed psum per crossing. Every sum bucket folds
+        # into a single variadic ``psum`` call: 4-byte integer dtypes bitcast
+        # into one concatenated int32 lane (reinterpretation is lossless and
+        # two's-complement addition is width-exact for signed and unsigned
+        # alike, so the packed add is bit-exact), while float and odd-width
+        # dtypes ride as sibling operands of the SAME staged call. The
+        # counters record one staged dispatch per crossing with the summed
+        # payload (dtype label ``packed`` when more than one operand rides).
+        sum_items = [(d, names) for (op, d), names in buckets.items() if op == "sum"]
+        if sum(len(names) for _, names in sum_items) == 1:
+            n = sum_items[0][1][0]
+            out[n] = sync_value(reductions[n], state[n], axis_name, hierarchy, _crossing=crossing)
+        elif sum_items:
+            i32 = jnp.dtype(jnp.int32)
+            lane_parts: list = []   # i32-bitcast segments, in concat order
+            lane_layout: list = []  # (names, orig dtype, segment size) per part
+            native_ops: list = []   # one flat operand per unpackable dtype
+            native_layout: list = []
+            for d, names in sum_items:
+                dt = jnp.dtype(d)
+                flat = jnp.concatenate([jnp.ravel(_payload(state[n])) for n in names])
+                if dt.itemsize == 4 and jnp.issubdtype(dt, jnp.integer):
+                    lane_parts.append(
+                        flat if dt == i32 else jax.lax.bitcast_convert_type(flat, i32)
+                    )
+                    lane_layout.append((names, dt, flat.size))
+                else:
+                    native_ops.append(flat)
+                    native_layout.append(names)
+            operands: list = []
+            if lane_parts:
+                operands.append(
+                    jnp.concatenate(lane_parts) if len(lane_parts) > 1 else lane_parts[0]
+                )
+            operands.extend(native_ops)
+            payload = tuple(operands) if len(operands) > 1 else operands[0]
+            synced = creduce("psum", jax.lax.psum, payload)
+            synced = synced if isinstance(synced, tuple) else (synced,)
+            next_op = 0
+            if lane_parts:
+                lane, next_op = synced[0], 1
+                lane_off = 0
+                for names, dt, size in lane_layout:
+                    seg = lane[lane_off: lane_off + size]
+                    lane_off += size
+                    _unpack_sum(
+                        seg if dt == i32 else jax.lax.bitcast_convert_type(seg, dt), names
+                    )
+            for names, arr in zip(native_layout, synced[next_op:]):
+                _unpack_sum(arr, names)
+
+        # -- min/max riders: one pmin/pmax per (op, dtype) bucket that needs it
+        for (op, _dtype), names in buckets.items():
+            if op == "sum":
+                continue
+            if len(names) == 1:
+                out[names[0]] = sync_value(reductions[names[0]], state[names[0]], axis_name, hierarchy, _crossing=crossing)
+                continue
+            flat = jnp.concatenate([jnp.ravel(state[n]) for n in names])
+            synced = creduce(kinds[op], ops[op], flat)
+            offset = 0
+            for n in names:
+                value = state[n]
+                piece = synced[offset: offset + value.size].reshape(value.shape)
+                out[n] = piece
+                offset += value.size
 
         for _dtype, names in gather_buckets.items():
             if len(names) == 1:

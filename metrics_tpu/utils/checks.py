@@ -200,6 +200,53 @@ def defer_or_run_value_check(finalize) -> None:
         pending.append(finalize)
 
 
+# ---------------------------------------------- shared canonicalization memo
+# A MetricCollection step canonicalizes the SAME (preds, target) pair once per
+# compute group — e.g. sync8's Accuracy group and StatScores group each run
+# ``_input_format_classification`` over the full batch. Inside a
+# ``shared_input_format()`` window the first call's result is memoized by
+# argument identity and equivalent-config key, so every further group reuses
+# the one canonicalized pair. Keys use ``id()`` of the arrays: concrete arrays
+# and jit tracers alike are stable for the window's lifetime (the window is
+# one step call / one trace), and a miss only costs the redundant work we do
+# today. Thread-local, nestable, and never active unless a collection opens
+# the window.
+_CANON_MEMO = threading.local()
+
+
+@contextmanager
+def shared_input_format():
+    """Open a memoization window for :func:`_input_format_classification`."""
+    prev = getattr(_CANON_MEMO, "table", None)
+    _CANON_MEMO.table = {}
+    try:
+        yield
+    finally:
+        _CANON_MEMO.table = prev
+
+
+def _canon_memo_key(
+    preds: Array,
+    target: Array,
+    threshold: float,
+    top_k: Optional[int],
+    num_classes: Optional[int],
+    is_multiclass: Optional[bool],
+    validate: bool,
+) -> tuple:
+    # float multiclass inputs resolve num_classes to the C dim regardless of
+    # whether the caller passed it — fold None and the matching explicit value
+    # into one key so e.g. Accuracy(num_classes=None) shares with
+    # StatScores(num_classes=C)
+    effective = num_classes
+    if preds.ndim == target.ndim + 1 and num_classes in (None, preds.shape[1]):
+        effective = preds.shape[1]
+    return (
+        id(preds), id(target), float(threshold), top_k, effective,
+        is_multiclass, bool(validate),
+    )
+
+
 def _validate_static(
     case: DataType,
     implied_classes: int,
@@ -315,8 +362,27 @@ def _input_format_classification(
     Behavioral contract identical to reference checks.py:306-445 (see its
     docstring for the full taxonomy). Jit-safe whenever ``num_classes`` is
     given or implied by a ``C`` dim; value validation auto-skips under tracing.
+
+    Inside a :func:`shared_input_format` window (opened by
+    ``MetricCollection`` around one step) the result is memoized by argument
+    identity, so a collection canonicalizes each batch ONCE across all its
+    compute groups.
     """
-    preds, target = _squeeze_excess_dims(jnp.asarray(preds), jnp.asarray(target))
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    table = getattr(_CANON_MEMO, "table", None)
+    key = None
+    if table is not None:
+        key = _canon_memo_key(
+            preds, target, threshold, top_k, num_classes, is_multiclass, validate
+        )
+        hit = table.get(key)
+        if hit is not None:
+            return hit[2]
+        # pin the key arrays in the table entry: ``id()`` stays unique for
+        # the window's lifetime, so a freed array (or tracer) can never be
+        # recycled into a colliding key
+        memo_pin = (preds, target)
+    preds, target = _squeeze_excess_dims(preds, target)
 
     # accumulate/compare in fp32 (reference upcasts fp16, checks.py:402-403; we also upcast bf16);
     # probability-sum validation tolerance scales with the *original* precision
@@ -372,7 +438,10 @@ def _input_format_classification(
     if preds.ndim > 2 and preds.shape[-1] == 1:
         preds, target = preds.squeeze(-1), target.squeeze(-1)
 
-    return preds.astype(jnp.int32), target.astype(jnp.int32), case
+    result = preds.astype(jnp.int32), target.astype(jnp.int32), case
+    if table is not None:
+        table[key] = (*memo_pin, result)
+    return result
 
 
 def _input_format_classification_one_hot(

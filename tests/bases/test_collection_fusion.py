@@ -1,0 +1,227 @@
+"""The megafused collection step's contracts beyond value parity.
+
+Value parity (fused forward == per-metric path), membership invalidation,
+and same-key replacement live in ``test_collections.py``. This file pins
+the contracts the megafusion PR added around the fused step:
+
+- the step DONATES its state argument (slab updates in place) and the
+  donation is real — compile metadata aliases inputs to outputs and the
+  donated buffers are consumed by a direct step call;
+- a trace-time failure happens BEFORE execution, so the eager fallback
+  always finds the members' (would-be donated) state buffers alive;
+- ``_dedupe_donated_buffers`` keeps donation legal when members alias one
+  buffer (XLA rejects a buffer donated twice);
+- members excluded from fusion are named ONCE via ``rank_zero_warn_once``,
+  message naming the member key and the offending attribute;
+- ``clear_program_cache()`` drops the shared fused-step cache, and lookups
+  account under the ``fused_step_cache`` hit/miss block in snapshots;
+- the ``shared_input_format`` window memoizes input canonicalization by
+  argument identity, folding the implied-num_classes key.
+"""
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu
+from metrics_tpu import Accuracy, MetricCollection, Precision
+from metrics_tpu.core.collections import _COL_STEP_CACHE, _dedupe_donated_buffers
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.observability import counters as obs_counters
+from metrics_tpu.parallel.deferred import clear_program_cache
+from metrics_tpu.utils import prints
+from metrics_tpu.utils.checks import _input_format_classification, shared_input_format
+
+
+@pytest.fixture
+def jit_on():
+    old = metrics_tpu.set_default_jit(True)
+    try:
+        yield
+    finally:
+        metrics_tpu.set_default_jit(old)
+
+
+def _probs_target(rows=32, classes=5, seed=0):
+    rng = np.random.RandomState(seed)
+    logits = rng.rand(rows, classes).astype(np.float32)
+    probs = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+    target = jnp.asarray(rng.randint(0, classes, rows))
+    return probs, target
+
+
+def _fused_collection():
+    return MetricCollection(
+        {"acc": Accuracy(), "prec": Precision(num_classes=5, average="macro")}
+    )
+
+
+# ------------------------------------------------------------------ donation
+def test_fused_step_donates_state_slabs(jit_on):
+    """The compiled step aliases its state inputs to outputs, and a direct
+    call consumes the donated buffers — the forward path must therefore
+    rebind every member to the returned slabs (which it does: members stay
+    usable across steps)."""
+    probs, target = _probs_target()
+    col = _fused_collection()
+    col(probs, target)
+    step = col.__dict__.get("_col_step")
+    assert step is not None
+
+    states = _dedupe_donated_buffers({k: m._current_state() for k, m in col.items()})
+    compiled = step.lower(states, probs, target).compile()
+    assert "input_output_alias" in compiled.as_text()
+
+    # a direct call consumes its (copied — the snapshot above aliases the
+    # members' live buffers) state argument
+    copies = jax.tree_util.tree_map(lambda x: x.copy(), states)
+    step(copies, probs, target)
+    donated = jax.tree_util.tree_leaves(copies)
+    assert donated and all(leaf.is_deleted() for leaf in donated)
+    # the members' own buffers were untouched: the collection keeps working
+    for leaf in jax.tree_util.tree_leaves(states):
+        assert not leaf.is_deleted()
+    col(probs, target)
+    assert float(col.compute()["acc"]) >= 0.0
+
+
+class _ConcreteUpdate(Metric):
+    """Fusable by every static gate, but update() needs concrete values —
+    the fused trace fails at trace time, AFTER the build but BEFORE any
+    buffer is consumed."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, preds, target):
+        self.total = self.total + float(jnp.sum(target))
+
+    def compute(self):
+        return self.total
+
+
+def test_eager_fallback_after_trace_failure_keeps_states_alive(jit_on):
+    """A trace-time failure must leave every member's (would-be donated)
+    state buffer alive for the eager fallback, and the fallback result must
+    be correct."""
+    probs, target = _probs_target()
+    col = MetricCollection({"acc": Accuracy(), "concrete": _ConcreteUpdate()})
+    before = {k: m._current_state() for k, m in col.items()}
+    out = col(probs, target)
+    assert col.__dict__.get("_col_fuse_failed") is True
+    assert col.__dict__.get("_col_step") is None
+    for leaf in jax.tree_util.tree_leaves(before):
+        assert not leaf.is_deleted()
+    assert float(out["concrete"]) == float(jnp.sum(target))
+    # accumulators advanced through the fallback, not left at init
+    assert float(col.compute()["concrete"]) == float(jnp.sum(target))
+    want = float(Accuracy()(probs, target))
+    np.testing.assert_allclose(float(out["acc"]), want, atol=1e-6)
+
+
+def test_dedupe_donated_buffers_copies_aliases():
+    a = jnp.arange(4.0)
+    b = jnp.ones(3)
+    states = {"m1": {"x": a, "y": b}, "m2": {"x": a}}  # m2.x aliases m1.x
+    deduped = _dedupe_donated_buffers(states)
+    assert deduped["m1"]["x"] is a
+    assert deduped["m1"]["y"] is b
+    assert deduped["m2"]["x"] is not a
+    np.testing.assert_array_equal(np.asarray(deduped["m2"]["x"]), np.asarray(a))
+    leaves = jax.tree_util.tree_leaves(deduped)
+    assert len({id(l) for l in leaves}) == len(leaves)
+
+
+def test_aliased_member_states_survive_fused_forward(jit_on):
+    """Manual state wiring that aliases one buffer across members must not
+    poison donation (XLA rejects a twice-donated buffer)."""
+    probs, target = _probs_target()
+    col = MetricCollection({"a": Accuracy(), "b": Accuracy()})
+    col(probs, target)  # build + first fused step
+    # alias b's states onto a's buffers, as load_state_dict-style wiring can
+    col["b"]._set_state(dict(col["a"]._current_state()))
+    out = col(probs, target)
+    np.testing.assert_allclose(float(out["a"]), float(out["b"]), atol=1e-6)
+    np.testing.assert_allclose(
+        float(col.compute()["a"]), float(col.compute()["b"]), atol=1e-6
+    )
+
+
+# ----------------------------------------------------------------- warn once
+def test_unfused_member_warns_once_naming_member_and_attribute(jit_on):
+    probs, target = _probs_target()
+    col = MetricCollection({"good": Accuracy(), "bad": Accuracy(dist_sync_on_step=True)})
+    prints._WARN_ONCE_SEEN.clear()
+    with pytest.warns(UserWarning, match=r"'bad'.*dist_sync_on_step=True") as rec:
+        col(probs, target)
+    excluded = [w for w in rec if "excluded from the fused collection step" in str(w.message)]
+    assert len(excluded) == 1  # only the offending member is named
+    assert col.__dict__.get("_col_unfusable") is True
+
+    # once per process: the second forward (and a fresh identical collection)
+    # stays quiet
+    with warnings.catch_warnings(record=True) as again:
+        warnings.simplefilter("always")
+        col(probs, target)
+        MetricCollection({"good": Accuracy(), "bad": Accuracy(dist_sync_on_step=True)})(
+            probs, target
+        )
+    assert not [w for w in again if "excluded from the fused" in str(w.message)]
+
+
+# -------------------------------------------------------------- cache plane
+def test_clear_program_cache_drops_fused_step_cache(jit_on):
+    probs, target = _probs_target()
+    clear_program_cache()
+    _fused_collection()(probs, target)
+    assert len(_COL_STEP_CACHE) == 1
+    clear_program_cache()
+    assert len(_COL_STEP_CACHE) == 0
+
+
+def test_fused_step_cache_hit_miss_counters(jit_on):
+    """Config-identical collections share ONE compiled step; the lookup
+    accounts under the snapshot's ``fused_step_cache`` block."""
+    probs, target = _probs_target()
+    clear_program_cache()
+    obs_counters.reset()
+    obs_counters.enable()
+    try:
+        _fused_collection()(probs, target)  # miss: builds and caches
+        _fused_collection()(probs, target)  # hit: replays the shared step
+        snap = obs_counters.snapshot()
+    finally:
+        obs_counters.disable()
+    assert snap["fused_step_cache"] == {"hits": 1, "misses": 1}
+
+
+# ------------------------------------------------------- canonicalization memo
+def test_shared_input_format_memoizes_by_identity():
+    probs, target = _probs_target()
+    with shared_input_format():
+        first = _input_format_classification(probs, target)
+        second = _input_format_classification(probs, target)
+        assert first[0] is second[0] and first[1] is second[1]
+        # implied num_classes folds into the same key as the explicit value
+        explicit = _input_format_classification(probs, target, num_classes=5)
+        assert explicit[0] is first[0]
+        # different arguments do NOT collide
+        other = _input_format_classification(probs, target, top_k=2)
+        assert other[0] is not first[0]
+    # outside any window nothing is memoized
+    a = _input_format_classification(probs, target)
+    b = _input_format_classification(probs, target)
+    assert a[0] is not b[0]
+    np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
+
+
+def test_shared_input_format_windows_do_not_leak():
+    probs, target = _probs_target()
+    with shared_input_format():
+        first = _input_format_classification(probs, target)
+    with shared_input_format():
+        second = _input_format_classification(probs, target)
+    assert first[0] is not second[0]

@@ -137,6 +137,19 @@ def _assert_headline_schema(out):
     assert out["qsketch_sync_bytes"] == 577536  # (256*281 + 256) * 4 * 2 stages
     assert out["qsketch_state_bytes"] == 288768  # (256*281 + 256) * 4 bytes
 
+    # the megafusion plane rides the same line: (a) the whole-collection
+    # fused forward — ONE jitted program per host-API step with donated
+    # state slabs; (b) the mixed collection (all four mergeable state
+    # kinds) synced through the packed one-psum-per-crossing plane, with
+    # the staged count pinned IDENTICAL at 6 and 14 members (3 buckets x
+    # 2 crossings: the packed psum + the pmin/pmax riders)
+    for key in ("fused_step_ms", "mixed_sync_ms"):
+        assert isinstance(out[key], (int, float)) and out[key] > 0, key
+    assert out["mixed_states_synced"] == 14  # the 6-member joint state plane
+    assert out["fused_collective_calls"] == 6  # (1 psum + pmin + pmax) x 2 stages
+    assert out["fused_collective_calls"] == out["fused_collective_calls_14"]
+    assert out["fused_sync_bytes"] == 1100808  # int32 lane + f32 siblings + riders
+
     # the windowed serving A/B rides the same line: Windowed(AUROC sketch)
     # x 4 window slots stages the SAME collective count and kinds as the
     # unwindowed metric — windows are a state axis, window roll is a slot
@@ -238,7 +251,12 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     out = _run_smoke(("--trace", str(trace_file)))
     _assert_headline_schema(out)
 
-    # schema version of the --trace payload: v14 added the tiered retention
+    # schema version of the --trace payload: v15 added the megafusion
+    # plane (fused_step_ms — the whole-collection single-program forward
+    # with donated state slabs — plus the mixed packed-psum sync keys
+    # fused_collective_calls / fused_sync_bytes with the 14-member count
+    # pinned equal, gated by --check-collectives' megafusion gate);
+    # v14 added the tiered retention
     # plane (retention_query_ms — the banked ladder's full-range read —
     # plus the deterministic windows-banked/roll-up/resident-bytes pins on
     # the default line, gated by --check-retention's four-kind bit-exact
@@ -267,7 +285,7 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     # windowed serving A/B; v5 the keyed slab A/B; v4 the sketch A/B; v3
     # moved the collective counts to the default line and added the
     # hierarchical A/B + per-crossing counters; bump this pin with the schema
-    assert out["trace_schema"] == 14
+    assert out["trace_schema"] == 15
     # the sketch program's full snapshot: psum-only, no gather kinds staged
     sketch_kinds = out["sketch_counters"]["calls_by_kind"]
     assert sketch_kinds.get("psum", 0) == 2
@@ -303,6 +321,16 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     for kind in ("all_gather", "coalesced_gather", "process_allgather"):
         assert qsk_kinds.get(kind, 0) == 0, kind
     assert out["qsketch_counters"]["bytes_by_crossing"]["dcn"] == out["qsketch_sync_bytes"] // 2
+    # the mixed megafusion program: ONE packed psum per crossing (the
+    # multi-dtype payload records under the "packed" label) plus the
+    # pmin/pmax riders — zero gathers of any kind
+    mixed_kinds = out["mixed_counters"]["calls_by_kind"]
+    assert mixed_kinds.get("psum", 0) == 2
+    assert mixed_kinds.get("pmin", 0) == 2
+    assert mixed_kinds.get("pmax", 0) == 2
+    for kind in ("all_gather", "coalesced_gather", "process_allgather"):
+        assert mixed_kinds.get(kind, 0) == 0, kind
+    assert "psum:packed" in out["mixed_counters"]["bytes_by_kind_dtype"]
     # the windowed serving program: the same psum-only shape at W=4 slots
     service_kinds = out["service_counters"]["calls_by_kind"]
     assert service_kinds.get("psum", 0) == 2
@@ -408,6 +436,7 @@ def test_bench_check_collectives_gate():
         "sparse_sync", "sparse_sync_flat", "hh_sync",
         "sum_grouped", "sum_ungrouped", "gather_coalesced", "gather_per_leaf",
         "gather_hier", "gather_flat2d",
+        "mixed6_sync", "mixed14_sync",
         "sharded_auroc", "sharded_auroc_hier",
         "sharded_retrieval", "sharded_retrieval_hier",
     }
@@ -487,6 +516,19 @@ def test_bench_check_collectives_gate():
     assert sparse_gate["fallback_bit_exact"] is True and sparse_gate["fallbacks"] > 0
     assert sparse_gate["skips"] > 0 and sparse_gate["gather_skips"] > 0
     assert scenarios["sparse_sync"]["sync_bytes"] * 10 < scenarios["keyed_sync"]["sync_bytes"]
+    # the megafusion gate of record: the mixed collection — every mergeable
+    # state kind behind one MetricCollection — stages ONE packed psum per
+    # crossing (2 on the (4,2) mesh) and the SAME staged collective count
+    # at 6 and 14 members (membership grows the payload, never the
+    # program), with the packed plane bit-exact vs the per-leaf reference
+    mega = out["megafusion_gate"]
+    assert mega["ok"] is True
+    assert mega["mixed6_psum_calls"] == mega["crossings"] == 2
+    assert mega["mixed14_psum_calls"] == 2
+    assert mega["mixed6_collective_calls"] == mega["mixed14_collective_calls"]
+    assert mega["parity_ok"] is True
+    assert scenarios["mixed6_sync"]["gather_calls"] == 0
+    assert scenarios["mixed14_sync"]["gather_calls"] == 0
     for row in scenarios.values():
         assert row["status"] != "regression"
 

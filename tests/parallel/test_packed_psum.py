@@ -1,0 +1,259 @@
+"""Bit-exact parity of the PACKED reduce plane (one psum per crossing).
+
+``coalesced_sync_state`` folds every ``sum`` bucket into ONE variadic
+``psum`` per crossing: 4-byte integer dtypes bitcast into a single
+concatenated int32 lane (lossless reinterpretation; two's-complement
+addition is width-exact for signed and unsigned alike), float and
+odd-width dtypes riding as sibling operands of the same call, with
+``pmin``/``pmax`` buckets staged separately only for the dtypes that need
+them. This suite pins the packed plane bit-exact against the per-leaf
+``sync_value`` reference for every dtype family and all four mergeable
+state kinds — plain arrays, histogram/rank sketches, the count-min tail,
+and quantile sketches — on both the flat axis and the ``("dcn", "ici")``
+hierarchy, pins the staged-dispatch accounting (one packed psum; bare
+dtype labels when a single payload needs no packing), and runs the
+SyncGuard chaos matrix: the in-jit packed plane never routes through the
+guarded host gather, so a deadline/degrade/check_finite guard — even with
+a chaos injector armed — must leave the packed results bit-identical and
+the fault counters untouched.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu import (
+    AUROC,
+    Accuracy,
+    HeavyHitters,
+    MeanSquaredError,
+    PSNR,
+    Quantile,
+    SpearmanCorrcoef,
+)
+from metrics_tpu.observability import counters as obs_counters
+from metrics_tpu.parallel import faults
+from metrics_tpu.parallel.placement import MeshHierarchy
+from metrics_tpu.parallel.sync import (
+    SyncGuard,
+    coalesced_sync_state,
+    set_sync_guard,
+    sync_value,
+)
+from metrics_tpu.utils import compat
+
+
+def _mesh_axis(eight_devices, hierarchical):
+    if hierarchical:
+        mesh = Mesh(np.array(eight_devices).reshape(2, 4), ("dcn", "ici"))
+        return mesh, MeshHierarchy(ici_axis="ici", dcn_axis="dcn")
+    return Mesh(np.array(eight_devices), ("dp",)), "dp"
+
+
+def _multi_dtype_state():
+    """Every reduce-plane dtype family in one state dict: two int32 sums
+    (lane members), a uint32 and an int16 sum (bitcast lane vs odd-width
+    sibling), f32 sums + a folded f32 mean, and the pmin/pmax riders.
+    Values near the dtype extremes so a packing bug cannot cancel out."""
+    state = {
+        "i32_a": jnp.asarray([3, -7, 2**30], dtype=jnp.int32),
+        "i32_b": jnp.asarray(11, dtype=jnp.int32),
+        "u32": jnp.asarray([1, 2**31 + 5], dtype=jnp.uint32),
+        "i16": jnp.asarray([100, -200], dtype=jnp.int16),
+        "f32_a": jnp.asarray([0.5, -1.25], dtype=jnp.float32),
+        "f32_mean": jnp.asarray(6.0, dtype=jnp.float32),
+        "f32_min": jnp.asarray(2.5, dtype=jnp.float32),
+        "f32_max": jnp.asarray(-3.5, dtype=jnp.float32),
+    }
+    reductions = {
+        "i32_a": "sum", "i32_b": "sum", "u32": "sum", "i16": "sum",
+        "f32_a": "sum", "f32_mean": "mean", "f32_min": "min", "f32_max": "max",
+    }
+    return state, reductions
+
+
+def _perturb(state, rank):
+    """Give each rank a distinct state so the reduction actually mixes
+    payloads (a broadcast state would hide slicing/offset bugs)."""
+    r = rank.astype(jnp.int32)
+    return {
+        name: type(v)(v.counts + r.astype(v.counts.dtype))
+        if hasattr(v, "counts") and not isinstance(v, jnp.ndarray)
+        else v + r.astype(v.dtype)
+        for name, v in state.items()
+    }
+
+
+def _run_both(state, reductions, mesh, axis):
+    """(packed, per_leaf) synced states over the mesh, per-rank perturbed."""
+
+    def packed(s, r):
+        return coalesced_sync_state(_perturb(s, r[0]), reductions, axis)
+
+    def per_leaf(s, r):
+        s = _perturb(s, r[0])
+        return {n: sync_value(reductions[n], v, axis) for n, v in s.items()}
+
+    ranks = jnp.arange(8, dtype=jnp.int32)
+    kw = dict(mesh=mesh, in_specs=(P(), P(mesh.axis_names[0]) if len(mesh.axis_names) == 1 else P(("dcn", "ici"))), out_specs=P(), check_vma=False)
+    got = jax.jit(compat.shard_map(packed, **kw))(state, ranks)
+    want = jax.jit(compat.shard_map(per_leaf, **kw))(state, ranks)
+    return got, want
+
+
+def _assert_tree_bit_exact(got, want):
+    got_leaves = jax.tree_util.tree_leaves(got)
+    want_leaves = jax.tree_util.tree_leaves(want)
+    assert len(got_leaves) == len(want_leaves)
+    for g, w in zip(got_leaves, want_leaves):
+        assert g.dtype == w.dtype
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+@pytest.mark.parametrize("hierarchical", [False, True], ids=["flat", "hier"])
+def test_packed_sum_plane_all_dtypes_bit_exact(eight_devices, hierarchical):
+    """int32 lane (signed + unsigned bitcast), int16 + f32 siblings, folded
+    mean, and the pmin/pmax riders — all bit-exact vs per-leaf sync."""
+    mesh, axis = _mesh_axis(eight_devices, hierarchical)
+    state, reductions = _multi_dtype_state()
+    got, want = _run_both(state, reductions, mesh, axis)
+    _assert_tree_bit_exact(got, want)
+
+
+@pytest.mark.parametrize("hierarchical", [False, True], ids=["flat", "hier"])
+def test_packed_all_state_kinds_bit_exact(eight_devices, hierarchical):
+    """All four mergeable state kinds from REAL metrics — classification
+    count arrays, curve + rank histogram sketches, the HeavyHitters hot
+    slab and count-min tail, quantile sketches — plus PSNR's float sums
+    and tracked-range riders, packed vs per-leaf, bit-exact."""
+    rng = np.random.RandomState(0)
+    rows = 64
+    probs = jnp.asarray(rng.rand(rows).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, rows).astype(np.int32))
+    values = jnp.asarray(rng.lognormal(0.0, 1.0, rows).astype(np.float32))
+    members = {
+        "acc": Accuracy(),
+        "mse": MeanSquaredError(),
+        "psnr": PSNR(),
+        "auroc": AUROC(approx="sketch", num_bins=16),
+        "spear": SpearmanCorrcoef(approx="sketch", num_bins=8),
+        "p99": Quantile(q=0.99, alpha=0.05, min_value=1e-2, max_value=1e2),
+        "hh": HeavyHitters(
+            AUROC(approx="sketch", num_bins=16), num_hot_slots=8, tail=(2, 32)
+        ),
+    }
+    for name, m in members.items():
+        if name == "hh":
+            m.update(probs, target, key=[int(k) for k in rng.randint(0, 10_000, rows)])
+        elif name == "p99":
+            m.update(values)
+        elif name in ("mse", "psnr"):
+            m.update(probs, target.astype(jnp.float32))
+        else:
+            m.update(probs, target)
+    state = {
+        (name, n): v
+        for name, m in members.items()
+        for n, v in m._current_state().items()
+    }
+    reductions = {
+        (name, n): members[name]._reductions[n] for name, n in state
+    }
+
+    mesh, axis = _mesh_axis(eight_devices, hierarchical)
+    got, want = _run_both(state, reductions, mesh, axis)
+    _assert_tree_bit_exact(got, want)
+
+
+def test_packed_counts_one_psum_per_crossing(eight_devices):
+    """Staged accounting: the whole multi-dtype sum plane is ONE psum on
+    the flat axis (plus the pmin/pmax riders) and one per crossing on the
+    hierarchy, recorded under the 'packed' dtype label with the byte total
+    of every operand."""
+    state, reductions = _multi_dtype_state()
+    for hierarchical, psums in ((False, 1), (True, 2)):
+        mesh, axis = _mesh_axis(eight_devices, hierarchical)
+
+        def packed(s):
+            return coalesced_sync_state(s, reductions, axis)
+
+        f = jax.jit(
+            compat.shard_map(packed, mesh=mesh, in_specs=(P(),), out_specs=P(), check_vma=False)
+        )
+        obs_counters.reset()
+        obs_counters.enable()
+        try:
+            f(state)
+            snap = obs_counters.snapshot()
+        finally:
+            obs_counters.disable()
+        kinds = snap["calls_by_kind"]
+        assert kinds.get("psum", 0) == psums
+        assert kinds.get("pmin", 0) == psums
+        assert kinds.get("pmax", 0) == psums
+        assert "psum:packed" in snap["bytes_by_kind_dtype"]
+        # packed payload bytes: 3*4 + 4 + 2*4 + 2*2 + 2*4 + 4 = 40 per stage
+        assert snap["bytes_by_kind_dtype"]["psum:packed"] == 40 * psums
+
+
+def test_packed_single_bucket_keeps_bare_dtype_label(eight_devices):
+    """An all-int32 sum plane needs no packing: the payload stays a bare
+    array recorded under its own dtype label ('packed' never appears), so
+    every pre-existing all-int32 collective pin is untouched."""
+    mesh, axis = _mesh_axis(eight_devices, False)
+    state = {
+        "a": jnp.asarray([1, 2], dtype=jnp.int32),
+        "b": jnp.asarray(3, dtype=jnp.int32),
+    }
+    reductions = {"a": "sum", "b": "sum"}
+
+    def packed(s):
+        return coalesced_sync_state(s, reductions, axis)
+
+    f = jax.jit(compat.shard_map(packed, mesh=mesh, in_specs=(P(),), out_specs=P(), check_vma=False))
+    obs_counters.reset()
+    obs_counters.enable()
+    try:
+        f(state)
+        snap = obs_counters.snapshot()
+    finally:
+        obs_counters.disable()
+    assert snap["calls_by_kind"].get("psum", 0) == 1
+    assert "psum:int32" in snap["bytes_by_kind_dtype"]
+    assert "psum:packed" not in snap["bytes_by_kind_dtype"]
+
+
+_GUARDS = {
+    "deadline_retry": SyncGuard(deadline_s=5.0, max_retries=3, backoff_s=0.01),
+    "degrade": SyncGuard(deadline_s=5.0, policy="degrade"),
+    "check_finite": SyncGuard(check_finite=True),
+}
+
+
+@pytest.mark.parametrize("guard_name", sorted(_GUARDS))
+@pytest.mark.parametrize("hierarchical", [False, True], ids=["flat", "hier"])
+def test_packed_parity_under_sync_guard_chaos(eight_devices, hierarchical, guard_name):
+    """The SyncGuard chaos matrix: guards (and armed chaos) police the HOST
+    gather plane only — the in-jit packed psum never routes through them,
+    so under every guard policy, with a stall+drop injector armed, the
+    packed plane stays bit-exact vs per-leaf and no fault counter moves."""
+    mesh, axis = _mesh_axis(eight_devices, hierarchical)
+    state, reductions = _multi_dtype_state()
+    old = set_sync_guard(_GUARDS[guard_name])
+    inj = faults.ChaosInjector(
+        [
+            faults.FaultSpec(kind="stall", call=0, duration_s=60.0),
+            faults.FaultSpec(kind="drop", call=1),
+        ],
+        seed=0,
+    ).install()
+    obs_counters.reset()
+    try:
+        got, want = _run_both(state, reductions, mesh, axis)
+        faults_snap = obs_counters.snapshot()["faults"]
+    finally:
+        inj.uninstall()
+        set_sync_guard(old)
+    _assert_tree_bit_exact(got, want)
+    assert all(v == 0 for v in faults_snap.values()), faults_snap
