@@ -24,6 +24,15 @@ Four layers, all off by default with a zero-allocation disabled path:
   attribution: ``block_until_ready`` fencing stamps spans with
   ``device_ms``; ``device_time_table()`` folds them into a per-metric
   update/sync/compute table; profiler-session traces parse back per phase.
+- :mod:`~metrics_tpu.observability.lifecycle` — the pipeline health plane's
+  window-lifecycle stage ledger (``first_event`` ... ``published`` /
+  ``merged`` / ``banked``, monotonic clock) plus flow ids joining ingest to
+  publish across threads; feeds the ``lifecycle`` / ``watermark_lag`` /
+  ``publish_staleness`` / ``selfmeter`` gauge blocks.
+- :mod:`~metrics_tpu.observability.selfmeter` — stage latencies folded into
+  host-side DDSketch-grid :class:`~metrics_tpu.observability.selfmeter.
+  LatencyMeter` sketches: constant bytes, certified p50/p95/p99, mergeable
+  across fleet shards by pure count addition.
 - :mod:`~metrics_tpu.observability.regress` — the bench-trajectory gate:
   diff current numbers against prior ``BENCH_r*.json`` rounds, fail on
   latency or collective-count drift (``bench.py --check-trajectory``).
@@ -44,9 +53,12 @@ from typing import Any, Dict
 from metrics_tpu.observability import compilemon as _compilemon_mod
 from metrics_tpu.observability import counters as _counters_mod
 from metrics_tpu.observability import devtime as _devtime_mod
+from metrics_tpu.observability import lifecycle as _lifecycle_mod
 from metrics_tpu.observability import trace as _trace_mod
 from metrics_tpu.observability.counters import COUNTERS, CollectiveCounters
 from metrics_tpu.observability.devtime import device_time_table
+from metrics_tpu.observability.lifecycle import LEDGER, STAGES, next_flow_id
+from metrics_tpu.observability.selfmeter import SELFMETER, LatencyMeter, merge_meters
 from metrics_tpu.observability.export import (
     chrome_trace,
     summarize,
@@ -61,6 +73,10 @@ from metrics_tpu.observability.trace import SpanRecord, TRACE, records, span, tr
 __all__ = [
     "COUNTERS",
     "CollectiveCounters",
+    "LEDGER",
+    "LatencyMeter",
+    "SELFMETER",
+    "STAGES",
     "SpanRecord",
     "TRACE",
     "annotate",
@@ -73,6 +89,8 @@ __all__ = [
     "enable",
     "is_enabled",
     "load_rounds",
+    "merge_meters",
+    "next_flow_id",
     "records",
     "reset",
     "span",
@@ -106,7 +124,11 @@ def enable(
     if spans:
         _trace_mod.enable()
     if counters:
+        # the lifecycle ledger rides the counters gate: its whole output
+        # surface (lifecycle/watermark_lag/publish_staleness/selfmeter) is
+        # counters gauge blocks
         _counters_mod.enable()
+        _lifecycle_mod.enable()
     if compile_events:
         _compilemon_mod.enable()
     if device_time:
@@ -116,6 +138,7 @@ def enable(
 def disable() -> None:
     _trace_mod.disable()
     _counters_mod.disable()
+    _lifecycle_mod.disable()
     _compilemon_mod.disable()
     _devtime_mod.disable()
 
@@ -125,9 +148,11 @@ def is_enabled() -> bool:
 
 
 def reset() -> None:
-    """Drop all recorded spans, zero every counter and the compile totals."""
+    """Drop all recorded spans, zero every counter and the compile totals,
+    and clear the lifecycle ledger + self-meter sketches."""
     _trace_mod.clear()
     _counters_mod.reset()
+    _lifecycle_mod.clear()
     _compilemon_mod.reset()
 
 

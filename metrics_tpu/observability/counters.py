@@ -169,6 +169,31 @@ What gets counted, and on which plane:
   eager keyed update while counting is enabled; the non-LRU path derives
   occupancy from the slot ids (a readback), so it too only pays while
   counting is on.
+- **lifecycle**: per-label window-lifecycle GAUGES fed by the stage ledger
+  (``observability/lifecycle.py``): ``{label: {"windows_stamped": windows
+  published with a COMPLETE core ledger, "open_windows": ledger entries not
+  yet published, "e2e_ms": the last publish's close -> publish latency}}``.
+  Refreshed as each ``published`` stamp lands while counting is enabled;
+  present in every snapshot.
+- **watermark_lag**: per-label freshness GAUGES from the publish path
+  (``serving/service.py``): ``{label: {"lag_s": host wall-clock now minus
+  the close clock (the AGREED watermark when an agreement governs the
+  stream, the local watermark otherwise), "degraded": the publish's
+  degraded verdict}}``. Only meaningful when event times are wall-clock
+  seconds — which is exactly the production-serving shape. Refreshed on
+  every publish while counting is enabled; present in every snapshot.
+- **publish_staleness**: per-label ``{"staleness_s": seconds since the
+  label last published}`` — DERIVED at snapshot time from the lifecycle
+  ledger's monotonic publish stamp, so staleness keeps aging between
+  publishes (a stalled pipeline's staleness grows without anyone writing a
+  gauge). Present in every snapshot.
+- **selfmeter**: per-(label, stage) latency-sketch summaries
+  (``observability/selfmeter.py``): ``{label: {stage: {"count", "sum_ms",
+  "p50_ms", "p95_ms", "p99_ms", "error_bound"}}}`` — the certified
+  quantile reads of the pipeline's own stage latencies, refreshed as each
+  window's ``published``/``merged``/``banked`` stamp folds into the
+  meters. Present in every snapshot; the raw mergeable counts live in the
+  ``SELFMETER`` registry (the fleet ``health_report`` fold reads those).
 
 Counting is off by default; the disabled path is one attribute load and a
 falsy branch per call site. All mutation happens under one lock — counter
@@ -176,6 +201,7 @@ call sites are trace-time or epoch-level, never the per-step replay path, so
 contention is irrelevant next to correctness under concurrent retraces.
 """
 import threading
+import time
 from typing import Any, Dict, Optional
 
 __all__ = [
@@ -196,7 +222,10 @@ __all__ = [
     "record_fleet_shards",
     "record_gather_skip",
     "record_heavy_hitters",
+    "record_lifecycle",
+    "record_publish_stamp",
     "record_retention",
+    "record_selfmeter",
     "record_service_health",
     "record_slab_dropped",
     "record_slab_slots",
@@ -206,6 +235,7 @@ __all__ = [
     "record_state_bytes",
     "record_states_synced",
     "record_watermark_agreement",
+    "record_watermark_lag",
     "record_wm_exchange",
     "record_wm_straggler",
     "reset",
@@ -294,6 +324,10 @@ class CollectiveCounters:
         "heavy_hitters",
         "service_health",
         "retention",
+        "lifecycle",
+        "watermark_lag",
+        "publish_stamp_ns",
+        "selfmeter",
         "_lock",
     )
 
@@ -332,6 +366,10 @@ class CollectiveCounters:
         self.heavy_hitters: Dict[str, Dict[str, Any]] = {}  # hh-wrapper label -> gauges
         self.service_health: Dict[str, Dict[str, Any]] = {}  # service label -> health gauges
         self.retention: Dict[str, Dict[str, int]] = {}  # retention-store label -> gauges
+        self.lifecycle: Dict[str, Dict[str, Any]] = {}  # label -> window-ledger gauges
+        self.watermark_lag: Dict[str, Dict[str, Any]] = {}  # label -> {"lag_s", "degraded"}
+        self.publish_stamp_ns: Dict[str, int] = {}  # label -> last publish (perf_counter_ns)
+        self.selfmeter: Dict[str, Dict[str, Dict[str, float]]] = {}  # label -> stage -> summary
 
     # ---------------------------------------------------------- recording
     def record_collective(
@@ -521,6 +559,43 @@ class CollectiveCounters:
                 "queries": int(queries),
             }
 
+    def record_lifecycle(
+        self, label: str, windows_stamped: int, open_windows: int, e2e_ms: float
+    ) -> None:
+        """Refresh one label's window-lifecycle gauges (latest value wins)."""
+        if windows_stamped < 0 or open_windows < 0:
+            raise ValueError(
+                f"lifecycle window counts must be >= 0, got"
+                f" ({windows_stamped}, {open_windows})"
+            )
+        with self._lock:
+            self.lifecycle[label] = {
+                "windows_stamped": int(windows_stamped),
+                "open_windows": int(open_windows),
+                "e2e_ms": float(e2e_ms),
+            }
+
+    def record_watermark_lag(self, label: str, lag_s: float, degraded: bool) -> None:
+        """Refresh one label's watermark-lag gauge (latest value wins; lag
+        may be negative when the clock producing event times runs ahead of
+        this host's — surface it rather than clamp it)."""
+        with self._lock:
+            self.watermark_lag[label] = {"lag_s": float(lag_s), "degraded": bool(degraded)}
+
+    def record_publish_stamp(self, label: str, ns: int) -> None:
+        """Refresh one label's last-publish stamp (``perf_counter_ns``);
+        snapshots derive ``publish_staleness`` from it so the gauge keeps
+        aging between publishes."""
+        with self._lock:
+            self.publish_stamp_ns[label] = int(ns)
+
+    def record_selfmeter(self, label: str, stage: str, summary: Dict[str, float]) -> None:
+        """Refresh one (label, stage) latency-sketch summary (latest wins;
+        the summary is the meter's certified quantile read, already built by
+        the self-meter registry)."""
+        with self._lock:
+            self.selfmeter.setdefault(label, {})[stage] = dict(summary)
+
     def record_fleet_shards(self, label: str, shards: Dict[str, Dict[str, Any]]) -> None:
         """Refresh one serving fleet's per-shard gauges (latest value wins;
         ``shards`` maps shard index -> {"health", "queue_depth", "occupied",
@@ -552,6 +627,7 @@ class CollectiveCounters:
         reports; the per-kind and per-(kind, dtype) breakdowns ride along for
         the JSONL/Perfetto exports.
         """
+        now_ns = time.perf_counter_ns()  # staleness ages on the stamp clock
         with self._lock:
             calls = dict(self.calls_by_kind)
             by_bucket = dict(self.bytes_by_kind_dtype)
@@ -584,6 +660,16 @@ class CollectiveCounters:
                 "heavy_hitters": {k: dict(v) for k, v in sorted(self.heavy_hitters.items())},
                 "service_health": {k: dict(v) for k, v in sorted(self.service_health.items())},
                 "retention": {k: dict(v) for k, v in sorted(self.retention.items())},
+                "lifecycle": {k: dict(v) for k, v in sorted(self.lifecycle.items())},
+                "watermark_lag": {k: dict(v) for k, v in sorted(self.watermark_lag.items())},
+                "publish_staleness": {
+                    k: {"staleness_s": max(now_ns - ns, 0) / 1e9}
+                    for k, ns in sorted(self.publish_stamp_ns.items())
+                },
+                "selfmeter": {
+                    k: {s_: dict(row) for s_, row in sorted(v.items())}
+                    for k, v in sorted(self.selfmeter.items())
+                },
                 "group_cache": {"hits": self.group_cache_hits, "misses": self.group_cache_misses},
                 "step_cache": {"hits": self.step_cache_hits, "misses": self.step_cache_misses},
                 "launch_cache": {"hits": self.launch_cache_hits, "misses": self.launch_cache_misses},
@@ -732,6 +818,29 @@ def record_state_bytes(metric: str, nbytes: int) -> None:
 def record_fleet_shards(label: str, shards: Dict[str, Dict[str, Any]]) -> None:
     if COUNTERS.enabled:
         COUNTERS.record_fleet_shards(label, shards)
+
+
+# The pipeline-health plane (lifecycle / watermark lag / publish stamps /
+# self-meter summaries) is telemetry fed per publish from host bookkeeping,
+# so all four share the enabled gate like fleet_shards / slab_slots.
+def record_lifecycle(label: str, windows_stamped: int, open_windows: int, e2e_ms: float) -> None:
+    if COUNTERS.enabled:
+        COUNTERS.record_lifecycle(label, windows_stamped, open_windows, e2e_ms)
+
+
+def record_watermark_lag(label: str, lag_s: float, degraded: bool) -> None:
+    if COUNTERS.enabled:
+        COUNTERS.record_watermark_lag(label, lag_s, degraded)
+
+
+def record_publish_stamp(label: str, ns: int) -> None:
+    if COUNTERS.enabled:
+        COUNTERS.record_publish_stamp(label, ns)
+
+
+def record_selfmeter(label: str, stage: str, summary: Dict[str, float]) -> None:
+    if COUNTERS.enabled:
+        COUNTERS.record_selfmeter(label, stage, summary)
 
 
 # Retention gauges are telemetry refreshed from host bookkeeping (the

@@ -12,7 +12,12 @@ Three consumers, three formats:
   JSON-object format (https://docs.google.com/document/d/1CvAClvFfyA5R-PhYUmn5OOQtYMH4h6I0nSsKchNAySU)
   that ``chrome://tracing`` and https://ui.perfetto.dev load directly. Spans
   become complete (``"ph": "X"``) events on their thread's track; the
-  collective counters ride in ``otherData``.
+  collective counters ride in ``otherData``. Spans stamped a ``flow`` attr
+  (the publish path's window flow id — see
+  :mod:`~metrics_tpu.observability.lifecycle`) additionally emit Chrome flow
+  events (``ph: "s"/"t"/"f"``), so Perfetto draws ingest -> publish -> merge
+  arrows ACROSS threads — causality the thread-local parent links cannot
+  express once the deferred host plane or the merge tier takes over.
 """
 import json
 import threading
@@ -28,7 +33,7 @@ __all__ = ["summarize", "to_trace_events", "chrome_trace", "write_chrome_trace",
 
 def summarize(records: Optional[List[SpanRecord]] = None) -> Dict[str, Dict[str, Any]]:
     """Aggregate spans by name: {name: {count, total_ms, mean_ms, min_ms,
-    max_ms, compile_ms, device_ms, state_bytes}}.
+    max_ms, compile_ms, device_ms, state_bytes, e2e_ms, flow_id}}.
 
     ``compile_ms`` sums the XLA compile time stamped by
     :mod:`~metrics_tpu.observability.compilemon`; ``device_ms`` sums the
@@ -36,7 +41,10 @@ def summarize(records: Optional[List[SpanRecord]] = None) -> Dict[str, Dict[str,
     :mod:`~metrics_tpu.observability.devtime`; ``state_bytes`` is the
     LARGEST per-metric state footprint stamped on the span's update/sync
     records (a gauge, so max — not sum — is the meaningful aggregate; the
-    per-metric breakdown lives in the counters snapshot). All columns are
+    per-metric breakdown lives in the counters snapshot). ``e2e_ms`` is the
+    worst end-to-end close -> publish latency stamped by the lifecycle
+    ledger on ``service.publish`` spans, and ``flow_id`` the highest flow id
+    seen — both max-aggregated gauges like ``state_bytes``. All columns are
     always present (0 when the corresponding monitor never ran) so the
     table schema is stable; the hot path is untouched — the attrs are
     stamped at span close only while those monitors are enabled, and this
@@ -53,6 +61,7 @@ def summarize(records: Optional[List[SpanRecord]] = None) -> Dict[str, Dict[str,
             row = table[rec.name] = {
                 "count": 1, "total_ms": ms, "min_ms": ms, "max_ms": ms,
                 "compile_ms": 0.0, "device_ms": 0.0, "state_bytes": 0,
+                "e2e_ms": 0.0, "flow_id": 0,
             }
         else:
             row["count"] += 1
@@ -62,6 +71,13 @@ def summarize(records: Optional[List[SpanRecord]] = None) -> Dict[str, Dict[str,
         row["compile_ms"] += attrs.get("compile_ms", 0.0)
         row["device_ms"] += attrs.get("device_ms", 0.0)
         row["state_bytes"] = max(row["state_bytes"], attrs.get("state_bytes", 0))
+        row["e2e_ms"] = max(row["e2e_ms"], float(attrs.get("e2e_ms", 0.0)))
+        flow = attrs.get("flow")
+        if flow is not None:
+            # merge-tier spans carry the LIST of contributing shard flows
+            fids = flow if isinstance(flow, (list, tuple)) else (flow,)
+            if fids:
+                row["flow_id"] = max(row["flow_id"], max(int(f) for f in fids))
     for row in table.values():
         row["mean_ms"] = row["total_ms"] / row["count"]
     return table
@@ -73,8 +89,47 @@ def _epoch_us(ns: int) -> float:
     return (wall_ns + (ns - mono_ns)) / 1e3
 
 
+def _flow_events(records: List[SpanRecord]) -> List[Dict[str, Any]]:
+    """Chrome flow events joining spans that share a ``flow`` attr.
+
+    Each flow id emits a start (``ph: "s"``) on its earliest span, steps
+    (``"t"``) on the middle ones and a finish (``"f"``, binding point
+    ``"e"`` = enclosing slice) on the latest — Perfetto then draws the
+    arrow chain across thread tracks. A merge-tier span whose ``flow`` is a
+    LIST joins every contributing shard's flow. Flows seen on only one span
+    are skipped: an arrow needs two ends.
+    """
+    by_flow: Dict[int, List[SpanRecord]] = {}
+    for rec in records:
+        flow = (rec.attrs or {}).get("flow")
+        if flow is None:
+            continue
+        for fid in flow if isinstance(flow, (list, tuple)) else (flow,):
+            by_flow.setdefault(int(fid), []).append(rec)
+    events: List[Dict[str, Any]] = []
+    for fid in sorted(by_flow):
+        chain = sorted(by_flow[fid], key=lambda r: r.start_ns)
+        if len(chain) < 2:
+            continue
+        for pos, rec in enumerate(chain):
+            event: Dict[str, Any] = {
+                "name": "publish_flow",
+                "cat": "metrics_tpu.flow",
+                "id": fid,
+                "ph": "s" if pos == 0 else ("f" if pos == len(chain) - 1 else "t"),
+                "ts": _epoch_us(rec.start_ns),
+                "pid": 0,
+                "tid": rec.thread_id,
+            }
+            if event["ph"] == "f":
+                event["bp"] = "e"
+            events.append(event)
+    return events
+
+
 def to_trace_events(records: Optional[List[SpanRecord]] = None) -> List[Dict[str, Any]]:
-    """Spans as Chrome ``trace_events`` complete events (``ph: 'X'``)."""
+    """Spans as Chrome ``trace_events`` complete events (``ph: 'X'``), plus
+    flow events (``'s'/'t'/'f'``) for spans stamped a ``flow`` attr."""
     if records is None:
         records = _trace.records()
     events: List[Dict[str, Any]] = []
@@ -111,6 +166,7 @@ def to_trace_events(records: Optional[List[SpanRecord]] = None) -> List[Dict[str
         if args:
             event["args"] = args
         events.append(event)
+    events.extend(_flow_events(records))
     return events
 
 

@@ -89,6 +89,11 @@ MS_KEYS: Tuple[str, ...] = (
     # (every retained bucket finished through value_from_partials): the
     # read path must stay cheap enough to serve scrapes inline
     "retention_query_ms",
+    # the pipeline health plane: worst close -> publish latency and the
+    # self-metered e2e p99 over the seeded wall-clock soak — growth means
+    # the publish stage (or the health plane's own bookkeeping) got slower
+    "publish_lag_ms",
+    "selfmeter_p99_ms",
 )
 
 # staged-collective keys gated exactly (no growth) vs the latest prior round
@@ -207,6 +212,10 @@ COUNT_KEYS: Tuple[str, ...] = (
     "retention_windows_banked",
     "retention_rollups",
     "retention_resident_bytes",
+    # the window-lifecycle ledger: every window the seeded health soak
+    # publishes must carry a complete core stage ledger — a drop means a
+    # publish path stopped stamping (an observability coverage regression)
+    "lifecycle_windows_stamped",
 )
 
 # throughput keys (batches/sec through real serving loops): gated as

@@ -75,6 +75,9 @@ from metrics_tpu.observability.counters import (
     COUNTERS as _COUNTERS,
     record_fleet_shards,
 )
+from metrics_tpu.observability.lifecycle import LEDGER as _LEDGER
+from metrics_tpu.observability.selfmeter import SELFMETER, merge_meters
+from metrics_tpu.observability.trace import TRACE as _TRACE, span as _span
 from metrics_tpu.parallel.cms import stable_key_hash
 from metrics_tpu.parallel.sketch import is_sketch
 from metrics_tpu.parallel.slab import PARTIAL_SCHEMA_VERSION
@@ -226,6 +229,8 @@ class MetricFleet:
         self.merged_records: List[Dict[str, Any]] = []
         self._partials: Dict[int, Dict[int, Dict[str, Any]]] = {}  # window -> shard -> partial
         self._pub_degraded: Dict[int, bool] = {}  # window -> any contributing shard degraded
+        self._flows: Dict[int, List[int]] = {}  # window -> contributing shard flow ids
+        self._last_merge_ns: Optional[int] = None  # perf_counter_ns of the last merged emit
         self._closed_through: List[Optional[int]] = [None] * num_shards
         self._merged_through: Optional[int] = None
         self._seqs = [0] * num_shards  # next auto-assigned per-shard seq
@@ -309,6 +314,9 @@ class MetricFleet:
         window = int(record["window"])
         with self._lock:
             self._partials.setdefault(window, {})[shard] = partial
+            fid = record.get("flow")
+            if fid is not None:
+                self._flows.setdefault(window, []).append(int(fid))
             self._pub_degraded[window] = self._pub_degraded.get(window, False) or bool(
                 record["degraded"]
             )
@@ -371,38 +379,62 @@ class MetricFleet:
 
     def _emit_locked(self, window: int, forced: bool, degraded: bool = False) -> None:
         partials = self._partials.get(window, {})
-        value = self._template.value_from_partials(list(partials.values()))
-        rows = sum(float(np.asarray(p["rows"])) for p in partials.values())
-        # final: no shard's contribution was flush-truncated AND no shard's
-        # watermark was overridden to force this emit — a merged window is
-        # only as complete as its least-complete partial
-        final = not forced and all(
-            bool(p.get("final", True)) for p in partials.values()
-        )
-        record = {
-            "fleet": self.label,
-            "window": window,
-            "window_start_s": self._template.window_start(window),
-            "value": np.asarray(value),
-            "rows": rows,
-            "shards": sorted(partials),
-            "degraded": degraded or self._pub_degraded.get(window, False),
-            "forced": forced,
-            "final": final,
-        }
-        self.merged_records.append(record)
-        self._merged_through = window
-        if self.merged_partial_publish_fn is not None:
-            self.merged_partial_publish_fn(
-                record, self._merged_partial(window, list(partials.values()), final)
+        # the contributing shard flows: the merged record carries the list so
+        # export.to_trace_events can join every shard's publish arc into the
+        # merge span's flow arrows
+        flows = sorted(set(self._flows.pop(window, [])))
+        attrs = None
+        if _TRACE.enabled:
+            attrs = {"fleet": self.label, "window": window}
+            if flows:
+                attrs["flow"] = flows
+        with _span("fleet.merge", attrs):
+            value = self._template.value_from_partials(list(partials.values()))
+            rows = sum(float(np.asarray(p["rows"])) for p in partials.values())
+            # final: no shard's contribution was flush-truncated AND no shard's
+            # watermark was overridden to force this emit — a merged window is
+            # only as complete as its least-complete partial
+            final = not forced and all(
+                bool(p.get("final", True)) for p in partials.values()
             )
-        # partials older than the ring can never be resident again — prune
-        # so an unbounded stream holds at most ~W windows of partials
-        for old in [w for w in self._partials if w <= window - self.num_windows]:
-            self._partials.pop(old, None)
-            self._pub_degraded.pop(old, None)
-        if self.merged_publish_fn is not None:
-            self.merged_publish_fn(record)
+            record = {
+                "fleet": self.label,
+                "window": window,
+                "window_start_s": self._template.window_start(window),
+                "value": np.asarray(value),
+                "rows": rows,
+                "shards": sorted(partials),
+                "degraded": degraded or self._pub_degraded.get(window, False),
+                "forced": forced,
+                "final": final,
+                "flow": flows,
+            }
+            self.merged_records.append(record)
+            self._merged_through = window
+            self._last_merge_ns = time.perf_counter_ns()
+            if _LEDGER.enabled:
+                # the merge verdict lands on every contributing shard's
+                # ledger — merge latency is a per-shard-window span — and on
+                # the fleet's own ledger, so a fleet-attached retention
+                # store's ``banked`` stamp has a base to meter against
+                for shard in record["shards"]:
+                    _LEDGER.stamp(
+                        f"{self.label}/shard{shard}", window, "merged",
+                        ns=self._last_merge_ns,
+                    )
+                _LEDGER.stamp(self.label, window, "merged", ns=self._last_merge_ns)
+            if self.merged_partial_publish_fn is not None:
+                self.merged_partial_publish_fn(
+                    record, self._merged_partial(window, list(partials.values()), final)
+                )
+            # partials older than the ring can never be resident again — prune
+            # so an unbounded stream holds at most ~W windows of partials
+            for old in [w for w in self._partials if w <= window - self.num_windows]:
+                self._partials.pop(old, None)
+                self._pub_degraded.pop(old, None)
+                self._flows.pop(old, None)
+            if self.merged_publish_fn is not None:
+                self.merged_publish_fn(record)
 
     def _merged_partial(
         self, window: int, partials: List[Dict[str, Any]], final: bool
@@ -516,6 +548,54 @@ class MetricFleet:
     def __exit__(self, *exc: Any) -> bool:
         self.stop()
         return False
+
+    # --------------------------------------------------------------- health
+    def health_report(self) -> Dict[str, Any]:
+        """One fleet-wide latency/freshness/degraded view.
+
+        Folds every shard's self-meter sketches per stage by pure state
+        addition (``merge_meters`` — the same merge the metric partials use,
+        so the fleet-wide p50/p95/p99 carry the per-shard certificate
+        unchanged) and reports, per shard, the service health gauge plus
+        whether its last publish was degraded. ``staleness_s`` is the wall
+        time since the merge tier last emitted (``nan`` before the first
+        emit). Meters only populate while the lifecycle ledger is enabled
+        (``observability.enable()``); ``latency`` is empty otherwise.
+        """
+        with self._lock:
+            services = list(self._shards)
+            merged_through = self._merged_through
+            last_merge_ns = self._last_merge_ns
+        shard_meters = [SELFMETER.meters(s.label) for s in services]
+        stages = sorted({stage for meters in shard_meters for stage in meters})
+        latency: Dict[str, Dict[str, float]] = {}
+        for stage in stages:
+            fold = merge_meters(m[stage] for m in shard_meters if stage in m)
+            if fold is not None:
+                latency[stage] = fold.summary()
+        shards: Dict[str, Dict[str, Any]] = {}
+        degraded: List[int] = []
+        for index, service in enumerate(services):
+            last_degraded = bool(service._last_publish_degraded)
+            shards[str(index)] = {
+                "health": service.health,
+                "published": len(service.publications),
+                "degraded": last_degraded,
+            }
+            if last_degraded or service.health in ("degraded", "dead"):
+                degraded.append(index)
+        staleness_s = (
+            (time.perf_counter_ns() - last_merge_ns) / 1e9
+            if last_merge_ns is not None else float("nan")
+        )
+        return {
+            "fleet": self.label,
+            "shards": shards,
+            "degraded_shards": degraded,
+            "merged_through": merged_through,
+            "latency": latency,
+            "staleness_s": staleness_s,
+        }
 
     # --------------------------------------------------------------- gauges
     def _note_gauges(self) -> None:

@@ -9,6 +9,11 @@ OpenMetrics / Prometheus text rendering of
   ``service_health``, ``fleet_shards``, ``slab_slots``, ``retention`` — as
   gauge families, and the ``faults`` block as proper counters
   (``..._total``);
+- the pipeline health plane (``observability/lifecycle.py``): watermark lag
+  and publish staleness as gauges, the lifecycle stamped/open-window
+  gauges, and the self-metered stage latencies as a summary family
+  (``metrics_tpu_stage_latency_ms`` — ``quantile=``-labeled p50/p95/p99
+  samples plus ``_count``/``_sum``, per (service, stage));
 - each attached :class:`~metrics_tpu.serving.retention.RetentionStore`
   stream's LATEST resolved value (``store.latest()`` — finished through the
   inner metric, per-tenant slabs fanned out under a ``tenant`` label).
@@ -176,6 +181,54 @@ def render(
         for key, family in retention_gauges.items():
             family.add([("store", label)], entry[key])
 
+    wm_lag = _Family(
+        f"{_PREFIX}_watermark_lag_seconds", "gauge",
+        "Host wall time minus the agreed event-time watermark at the last"
+        " publish — freshness of the close frontier.",
+    )
+    wm_lag_degraded = _Family(
+        f"{_PREFIX}_watermark_lag_degraded", "gauge",
+        "1 when the last publish behind this lag reading was degraded.",
+    )
+    for label, entry in snapshot.get("watermark_lag", {}).items():
+        wm_lag.add([("service", label)], entry["lag_s"])
+        wm_lag_degraded.add([("service", label)], 1 if entry["degraded"] else 0)
+
+    staleness = _Family(
+        f"{_PREFIX}_publish_staleness_seconds", "gauge",
+        "Seconds since the service last published a window (ages between"
+        " publishes; derived at snapshot time).",
+    )
+    for label, entry in snapshot.get("publish_staleness", {}).items():
+        staleness.add([("service", label)], entry["staleness_s"])
+
+    lifecycle_gauges = {
+        key: _Family(
+            f"{_PREFIX}_lifecycle_{key}", "gauge",
+            f"Window-lifecycle ledger {key.replace('_', ' ')} gauge.",
+        )
+        for key in ("windows_stamped", "open_windows")
+    }
+    for label, entry in snapshot.get("lifecycle", {}).items():
+        for key, family in lifecycle_gauges.items():
+            family.add([("service", label)], entry[key])
+
+    stage_latency = _Family(
+        f"{_PREFIX}_stage_latency_ms", "summary",
+        "Self-metered pipeline stage latency: certified quantile sketch"
+        " reads per (service, stage).",
+    )
+    for label, stages in snapshot.get("selfmeter", {}).items():
+        for stage, summary in stages.items():
+            where = [("service", label), ("stage", stage)]
+            for q in ("0.5", "0.95", "0.99"):
+                value = summary.get(f"p{int(float(q) * 100)}_ms")
+                if value is None or math.isnan(float(value)):
+                    continue
+                stage_latency.add([*where, ("quantile", q)], value)
+            stage_latency.add(where, summary["count"], suffix="_count")
+            stage_latency.add(where, summary["sum_ms"], suffix="_sum")
+
     latest = _Family(
         f"{_PREFIX}_retained_latest", "gauge",
         "Newest retained bucket's finished value per stream (keyed streams"
@@ -212,6 +265,9 @@ def render(
         *slab_gauges.values(),
         faults,
         *retention_gauges.values(),
+        wm_lag, wm_lag_degraded, staleness,
+        *lifecycle_gauges.values(),
+        stage_latency,
         latest, latest_start, latest_final,
     ]
     lines: List[str] = []

@@ -60,6 +60,7 @@ from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
 import numpy as np
 
 from metrics_tpu.observability.counters import record_retention, state_nbytes
+from metrics_tpu.observability.lifecycle import stamp as _lifecycle_stamp
 from metrics_tpu.parallel.sketch import is_sketch
 from metrics_tpu.parallel.slab import PARTIAL_SCHEMA_VERSION, check_partial_version
 from metrics_tpu.wrappers.keyed import Keyed
@@ -282,6 +283,9 @@ class RetentionStore:
             self.windows_banked += 1
             self._compact_locked(stream)
             self._note_gauges_locked()
+        # after releasing the store lock: the ledger takes its own lock and
+        # must never nest inside this one
+        _lifecycle_stamp(label, window, "banked")
 
     def _compact_locked(self, stream: _RetainedStream) -> None:
         """Enforce every rung's capacity, oldest-first: overflowing buckets

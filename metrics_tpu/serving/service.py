@@ -76,7 +76,9 @@ from metrics_tpu.observability.counters import (
     COUNTERS as _COUNTERS,
     record_deferred_depth,
     record_service_health,
+    record_watermark_lag,
 )
+from metrics_tpu.observability.lifecycle import LEDGER as _LEDGER, next_flow_id
 from metrics_tpu.observability.trace import TRACE as _TRACE, span as _span
 from metrics_tpu.parallel.deferred import host_plane_submit
 from metrics_tpu.parallel.sync import SyncGuard, set_sync_guard
@@ -190,6 +192,11 @@ class MetricService:
         self.label = name or label or (
             f"MetricService({type(metric.metric).__name__})#{next(MetricService._ids)}"
         )
+        # the window plane stamps its lifecycle ledger under this label
+        # (first_event/last_event as batches route; the shadow twin below is
+        # a deepcopy, so it inherits the label — but it never routes events,
+        # so the ingest stamps stay single-writer on the worker thread)
+        metric.lifecycle_label = self.label
         self.fault_site = str(fault_site)
         self.fault_shard = fault_shard
         self.fault_rank = fault_rank
@@ -439,19 +446,37 @@ class MetricService:
         sync completes.
         """
         self._published_through = window
+        fid = None
+        if _LEDGER.enabled:
+            # the close verdict lands here (worker thread); the flow id born
+            # with it travels inside the book through the deferred host
+            # plane, so the publish span, the publication record, and the
+            # merge tier all carry the same causal id
+            _LEDGER.stamp(self.label, window, "closed")
+            fid = next_flow_id()
         book = self._publish_book()
         book["final"] = bool(final)
+        book["flow"] = fid
         if not self.deferred_publish:
             self._publish_record(self.metric, window, book)
             return
-        snap = self.metric.state_dict()
-        if self._shadow is None:
-            self._shadow = deepcopy(self.metric)
-        with self._pub_lock:
-            self._pending_publishes.append(
-                host_plane_submit(self._deferred_publish_task, snap, window, book)
-            )
-            depth = len(self._pending_publishes)
+        attrs = None
+        if _TRACE.enabled:
+            attrs = {"service": self.label, "window": window}
+            if fid is not None:
+                attrs["flow"] = fid
+        # the dispatch span is the flow's ingest-side anchor: it runs on the
+        # worker thread, so Perfetto's flow arrow crosses from here to the
+        # host-plane service.publish span
+        with _span("service.publish_dispatch", attrs):
+            snap = self.metric.state_dict()
+            if self._shadow is None:
+                self._shadow = deepcopy(self.metric)
+            with self._pub_lock:
+                self._pending_publishes.append(
+                    host_plane_submit(self._deferred_publish_task, snap, window, book)
+                )
+                depth = len(self._pending_publishes)
         # the publish pipeline's depth gauge: how many window publishes are
         # in flight behind ingest right now (and, via the counters' high-water
         # mark, how deep the pipeline ever ran)
@@ -491,6 +516,7 @@ class MetricService:
         ``window=``, ``degraded=``, and the ingress ``queue_depth`` at the
         window close — the per-window Perfetto view of the publish loop.
         """
+        fid = book.get("flow")
         attrs = None
         if _TRACE.enabled:
             attrs = {
@@ -499,7 +525,11 @@ class MetricService:
                 "queue_depth": book["queue_depth"],
                 "deferred": "yes" if snap is not None else "no",
             }
+            if fid is not None:
+                attrs["flow"] = fid
         with _span("service.publish", attrs):
+            if _LEDGER.enabled:
+                _LEDGER.stamp(self.label, window, "sync_started")
             before = _COUNTERS.faults["degraded_computes"]
             old_guard = set_sync_guard(self.guard)
             try:
@@ -507,6 +537,8 @@ class MetricService:
                 merged = metric.compute()
             finally:
                 set_sync_guard(old_guard)
+            if _LEDGER.enabled:
+                _LEDGER.stamp(self.label, window, "sync_done")
             degraded = _COUNTERS.faults["degraded_computes"] > before or bool(
                 book.get("wm_degraded")
             )
@@ -531,6 +563,7 @@ class MetricService:
                 "merged": _host(merged),
                 "degraded": degraded,
                 "final": final,
+                "flow": fid,
                 "watermark": book["watermark"],
                 "agreed_watermark": book.get("agreed_watermark"),
                 "dropped_samples": book["dropped_samples"],
@@ -548,6 +581,19 @@ class MetricService:
                     "shed_events": book["shed_events"],
                     "publications": len(self.publications),
                 }
+            if _LEDGER.enabled:
+                _LEDGER.stamp(self.label, window, "published")
+                # watermark lag compares the agreed event-time frontier to
+                # wall time at the moment the publish lands
+                wm = book.get("agreed_watermark")
+                if wm is None:
+                    wm = book.get("watermark")
+                if wm is not None:
+                    record_watermark_lag(self.label, time.time() - float(wm), degraded)
+                if attrs is not None:
+                    e2e = _LEDGER.latencies(self.label, window).get("e2e")
+                    if e2e is not None:
+                        attrs["e2e_ms"] = e2e
             if self.publish_fn is not None:
                 self.publish_fn(record)
             if self.partial_publish_fn is not None:

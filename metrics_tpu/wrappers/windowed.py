@@ -45,6 +45,7 @@ decomposable update (cat/buffer states have no slab form — use
 """
 import itertools
 import math
+import time
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -58,8 +59,10 @@ from metrics_tpu.core.streaming import (
     WindowSpec,
     decay_scale,
     route_events,
+    window_index,
 )
 from metrics_tpu.observability.counters import record_slab_dropped
+from metrics_tpu.observability.lifecycle import LEDGER as _LEDGER
 from metrics_tpu.wrappers.keyed import Keyed
 from metrics_tpu.parallel.buffer import PaddedBuffer
 from metrics_tpu.parallel.cms import CMSSpec
@@ -211,6 +214,10 @@ class Windowed(Metric):
         self.slide_s = None if self.decay else (None if slide_s is None else float(slide_s))
         self.empty = empty
         self._metric_label = f"Windowed({type(metric).__name__})"
+        # the lifecycle ledger's stamp key: set by the owning MetricService
+        # (its label) so per-window stage stamps attribute to the serving
+        # loop; None (the default) keeps the ledger out of standalone use
+        self.lifecycle_label: Optional[str] = None
 
         # stream position (host metadata, checkpointed): None until the
         # first event arrives
@@ -516,6 +523,31 @@ class Windowed(Metric):
             if route.n_dropped:
                 self._dropped += route.n_dropped
                 record_slab_dropped(route.n_dropped)
+            if _LEDGER.enabled and self.lifecycle_label is not None:
+                # lifecycle open/ingest stamps: every window this batch's
+                # ACCEPTED samples touched gets first_event (first wins) and
+                # last_event (last wins). Host arithmetic over data the
+                # router already produced — no device work, no extra reads.
+                accepted = np.asarray(route.slot_ids) >= 0
+                touched = set()
+                if accepted.any():
+                    touched.update(
+                        int(w)
+                        for w in np.unique(window_index(times[accepted], self._spec.stride))
+                    )
+                for j, row in enumerate(route.overlap_slots):
+                    covered = np.asarray(row) >= 0
+                    if covered.any():
+                        touched.update(
+                            int(w) - (j + 1)
+                            for w in np.unique(
+                                window_index(times[covered], self._spec.stride)
+                            )
+                        )
+                now_ns = time.perf_counter_ns()
+                for w in sorted(touched):
+                    _LEDGER.stamp(self.lifecycle_label, w, "first_event", ns=now_ns)
+                    _LEDGER.stamp(self.lifecycle_label, w, "last_event", ns=now_ns)
             slot_ids, weights = jnp.asarray(route.slot_ids), None
             overlap_rows = tuple(jnp.asarray(r) for r in route.overlap_slots)
 

@@ -156,16 +156,69 @@ def test_chrome_trace_events_schema():
     assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
     for event in doc["traceEvents"]:
         assert isinstance(event["name"], str)
-        assert event["ph"] in ("X", "M")
+        assert event["ph"] in ("X", "M", "s", "t", "f")
         assert isinstance(event["pid"], int) and isinstance(event["tid"], int)
         if event["ph"] == "X":  # complete events: microsecond ts + dur
             assert isinstance(event["ts"], (int, float))
             assert isinstance(event["dur"], (int, float)) and event["dur"] >= 0
-        else:  # metadata events carry args only
+        elif event["ph"] == "M":  # metadata events carry args only
             assert "args" in event
+        else:  # flow events: an id joins the arrow chain, ts places it
+            assert isinstance(event["id"], int)
+            assert isinstance(event["ts"], (int, float))
     # counters ride along for the Perfetto metadata pane
     assert "collective_calls" in doc["otherData"]
     json.dumps(doc)  # must be JSON-serializable as-is
+
+
+def test_chrome_trace_flow_events_join_publish_spans():
+    obs.enable()
+    with obs.span("service.publish_dispatch", {"flow": 7}):
+        pass
+    with obs.span("service.publish", {"flow": 7}):
+        pass
+    with obs.span("fleet.merge", {"flow": [7, 9]}):  # merge joins a LIST
+        pass
+    with obs.span("shard.publish", {"flow": 9}):
+        pass
+    with obs.span("singleton", {"flow": 11}):  # an arrow needs two ends
+        pass
+    events = [e for e in obs.chrome_trace()["traceEvents"]
+              if e.get("cat") == "metrics_tpu.flow"]
+    assert events and all(e["name"] == "publish_flow" for e in events)
+    by_id = {}
+    for e in events:
+        by_id.setdefault(e["id"], []).append(e["ph"])
+    # flow 7 threads three spans: start -> step -> finish, in start order
+    assert by_id[7] == ["s", "t", "f"]
+    # flow 9 appears on two spans (the merge's list + the shard publish)
+    assert by_id[9] == ["s", "f"]
+    assert 11 not in by_id
+    # finish events bind to the enclosing slice so Perfetto anchors the head
+    assert all(e["bp"] == "e" for e in events if e["ph"] == "f")
+    json.dumps(events)
+
+
+def test_summarize_e2e_and_flow_columns_are_schema_stable():
+    obs.enable()
+    with obs.span("plain"):
+        pass
+    with obs.span("service.publish", {"flow": 3, "e2e_ms": 12.5}):
+        pass
+    with obs.span("service.publish", {"flow": 2, "e2e_ms": 4.0}):
+        pass
+    with obs.span("fleet.merge", {"flow": [3, 4]}):
+        pass
+    table = obs.summarize()
+    # the columns are schema-stable: present on every row, zero when the
+    # lifecycle ledger never stamped the span
+    for row in table.values():
+        assert "e2e_ms" in row and "flow_id" in row
+    assert table["plain"]["e2e_ms"] == 0.0 and table["plain"]["flow_id"] == 0
+    # gauges aggregate by max: the worst e2e, the newest flow
+    assert table["service.publish"]["e2e_ms"] == 12.5
+    assert table["service.publish"]["flow_id"] == 3
+    assert table["fleet.merge"]["flow_id"] == 4  # list flows max out too
 
 
 def test_write_chrome_trace_and_jsonl(tmp_path):
@@ -371,3 +424,130 @@ def test_retention_gauges_schema_in_every_snapshot():
     assert obs.counters_snapshot()["retention"]["store-a"]["queries"] == 8
     # the block is JSON-ready like the rest of the snapshot
     json.dumps(snap["retention"])
+
+
+# ------------------------------------------------------- pipeline health
+def test_snapshot_schema_lint_across_consumers():
+    """Every gauge/counter block in the snapshot schema must be visible to
+    its consumers: present (empty) in a DISABLED snapshot so they can bind
+    unconditionally, rendered as an OpenMetrics family where the scrape
+    surface exposes it, and gated in regress.py's key lists where the bench
+    trajectory pins it."""
+    from metrics_tpu.observability import regress
+    from metrics_tpu.serving import render
+
+    snap = obs.counters_snapshot()  # counting is off (autouse fixture)
+    # the per-label gauge blocks: schema keys exist before anything records
+    for block in ("service_health", "fleet_shards", "slab_slots", "retention",
+                  "lifecycle", "watermark_lag", "publish_staleness",
+                  "selfmeter", "deferred_depth", "watermark_agreement",
+                  "heavy_hitters", "state_bytes"):
+        assert block in snap and snap[block] == {}, block
+    # every block the exposition surfaces renders its family even when empty
+    text = render(snapshot=snap)
+    for block, family in (
+        ("service_health", "metrics_tpu_service_health"),
+        ("fleet_shards", "metrics_tpu_fleet_shard_health"),
+        ("slab_slots", "metrics_tpu_slab_slots"),
+        ("faults", "metrics_tpu_fault"),
+        ("retention", "metrics_tpu_retention_windows_banked"),
+        ("lifecycle", "metrics_tpu_lifecycle_windows_stamped"),
+        ("lifecycle", "metrics_tpu_lifecycle_open_windows"),
+        ("watermark_lag", "metrics_tpu_watermark_lag_seconds"),
+        ("watermark_lag", "metrics_tpu_watermark_lag_degraded"),
+        ("publish_staleness", "metrics_tpu_publish_staleness_seconds"),
+        ("selfmeter", "metrics_tpu_stage_latency_ms"),
+    ):
+        assert block in snap, block
+        assert f"# TYPE {family} " in text, family
+    # the health plane's bench-line keys are trajectory-gated in regress.py
+    assert "publish_lag_ms" in regress.MS_KEYS
+    assert "selfmeter_p99_ms" in regress.MS_KEYS
+    assert "lifecycle_windows_stamped" in regress.COUNT_KEYS
+
+
+def test_lifecycle_ledger_stamps_and_derives_gauges():
+    from metrics_tpu.observability import lifecycle
+
+    obs.enable()
+    ms = 1_000_000  # ns per ms, for readable synthetic stamps
+    # window 1 opens first (still unpublished when window 0's gauges derive);
+    # last_event is last-wins by definition
+    lifecycle.stamp("svc-ledger", 1, "last_event", ns=7 * ms)
+    lifecycle.stamp("svc-ledger", 1, "last_event", ns=8 * ms)
+    assert lifecycle.LEDGER.entry("svc-ledger", 1)["last_event"] == 8 * ms
+    for stage, ns in (("first_event", 1 * ms), ("last_event", 2 * ms),
+                      ("closed", 3 * ms), ("sync_started", 4 * ms),
+                      ("sync_done", 5 * ms), ("published", 9 * ms)):
+        lifecycle.stamp("svc-ledger", 0, stage, ns=ns)
+    lat = lifecycle.LEDGER.latencies("svc-ledger", 0)
+    assert lat["e2e"] == pytest.approx(6.0)  # closed -> published, in ms
+    assert lat["sync"] == pytest.approx(1.0)
+    assert lat["ingest"] == pytest.approx(1.0)
+    # every other stage is first-wins (an idempotent replay or a duplicate
+    # close cannot rewrite history)
+    lifecycle.stamp("svc-ledger", 0, "closed", ns=50 * ms)
+    assert lifecycle.LEDGER.entry("svc-ledger", 0)["closed"] == 3 * ms
+    # the published stamp derived the gauge blocks and the self-meters
+    snap = obs.counters_snapshot()
+    assert snap["lifecycle"]["svc-ledger"] == {
+        "windows_stamped": 1, "open_windows": 1,
+        "e2e_ms": pytest.approx(6.0),
+    }
+    assert snap["selfmeter"]["svc-ledger"]["e2e"]["count"] == 1
+    assert snap["selfmeter"]["svc-ledger"]["e2e"]["sum_ms"] == pytest.approx(6.0)
+    assert "svc-ledger" in snap["publish_staleness"]
+    assert snap["publish_staleness"]["svc-ledger"]["staleness_s"] >= 0.0
+
+
+def test_lifecycle_ledger_is_bounded_fifo():
+    from metrics_tpu.observability import lifecycle
+
+    obs.enable()
+    for w in range(lifecycle.LEDGER_CAP + 64):
+        lifecycle.LEDGER.stamp("svc-cap", w, "closed", ns=w + 1)
+    entries = lifecycle.LEDGER.ledgers("svc-cap")
+    assert len(entries) == lifecycle.LEDGER_CAP  # constant footprint
+    assert 0 not in entries and lifecycle.LEDGER_CAP + 63 in entries  # FIFO
+
+
+def test_latency_meter_certificate_and_merge():
+    from metrics_tpu.observability.selfmeter import LatencyMeter, merge_meters
+
+    rng = np.random.RandomState(3)
+    vals = rng.lognormal(1.0, 1.5, 4000)
+    a, b = LatencyMeter(), LatencyMeter()
+    for v in vals[:2000]:
+        a.observe(float(v))
+    for v in vals[2000:]:
+        b.observe(float(v))
+    fold = merge_meters([a, b])
+    assert fold.count == 4000
+    # the certificate vs the exact stream, at the sketch's own rank rule
+    sv = np.sort(vals)
+    cum = np.arange(1, len(sv) + 1)
+    for q in (0.5, 0.95, 0.99):
+        est = fold.quantile(q)
+        idx = int(np.clip(np.searchsorted(cum, q * (len(sv) - 1), side="right"),
+                          0, len(sv) - 1))
+        true = float(sv[idx])
+        assert abs(est - true) <= fold.alpha * abs(true) + fold.min_value + 1e-9
+        assert fold.error_bound(q) == fold.alpha
+    # merging shards == observing the union stream (pure state addition)
+    union = LatencyMeter()
+    for v in vals:
+        union.observe(float(v))
+    assert np.array_equal(fold.counts, union.counts)
+    assert fold.total_ms == pytest.approx(union.total_ms)
+    # the edges: empty -> nan, sub-min -> zero bucket, overflow -> inf bound
+    empty = LatencyMeter()
+    assert np.isnan(empty.quantile(0.5)) and np.isnan(empty.error_bound(0.5))
+    tiny = LatencyMeter()
+    tiny.observe(1e-6)
+    assert abs(tiny.quantile(0.5)) <= tiny.min_value
+    huge = LatencyMeter()
+    huge.observe(1e9)
+    assert huge.error_bound(0.5) == float("inf")
+    # cross-grid merges fail loudly rather than corrupt both certificates
+    with pytest.raises(ValueError):
+        LatencyMeter().merge_(LatencyMeter(alpha=0.05))

@@ -224,6 +224,15 @@ def _assert_headline_schema(out):
     assert out["wm_exchange_calls"] == 20
     assert out["slide_windows_published"] == 12
 
+    # the pipeline-health plane: the deterministic lifecycle soak (16
+    # synthetic-event-time batches, 2 per 10 s window) publishes 8 windows
+    # and every one must carry a COMPLETE core stage ledger — an exact pin;
+    # a drop means a publish path stopped stamping. The ledger-derived
+    # latency headlines ride along in ms (monotonic-clock stage spans)
+    for key in ("publish_lag_ms", "selfmeter_p99_ms"):
+        assert isinstance(out[key], (int, float)) and out[key] > 0, key
+    assert out["lifecycle_windows_stamped"] == 8
+
     # fault counters ride the default line and are ZERO on a clean bench run
     # (--check-trajectory pins them at zero on every new BENCH_r* round);
     # slab_dropped_samples joins them — in-window bench traffic never drops —
@@ -251,7 +260,12 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     out = _run_smoke(("--trace", str(trace_file)))
     _assert_headline_schema(out)
 
-    # schema version of the --trace payload: v15 added the megafusion
+    # schema version of the --trace payload: v16 added the pipeline-health
+    # plane (publish_lag_ms / selfmeter_p99_ms — the lifecycle ledger's
+    # worst close -> publish span and the self-meter sketch's certified e2e
+    # p99 — plus the exact lifecycle_windows_stamped pin on the default
+    # line, gated by --check-health's ledger/certificate/stall/fleet
+    # tiers); v15 added the megafusion
     # plane (fused_step_ms — the whole-collection single-program forward
     # with donated state slabs — plus the mixed packed-psum sync keys
     # fused_collective_calls / fused_sync_bytes with the 14-member count
@@ -285,7 +299,7 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     # windowed serving A/B; v5 the keyed slab A/B; v4 the sketch A/B; v3
     # moved the collective counts to the default line and added the
     # hierarchical A/B + per-crossing counters; bump this pin with the schema
-    assert out["trace_schema"] == 15
+    assert out["trace_schema"] == 16
     # the sketch program's full snapshot: psum-only, no gather kinds staged
     sketch_kinds = out["sketch_counters"]["calls_by_kind"]
     assert sketch_kinds.get("psum", 0) == 2
@@ -678,6 +692,41 @@ def test_bench_check_fleet_gate():
     assert out["chaos"]["recoveries"] >= 1
     assert out["chaos"]["replayed"] >= 1
     assert out["chaos"]["elapsed_s"] < out["chaos"]["budget_s"]
+
+
+def test_bench_check_health_gate():
+    """``bench.py --check-health`` is the pipeline-health gate: every window
+    a clean wall-clock service soak publishes must carry a complete monotone
+    core stage ledger with a distinct flow id, the self-meter's e2e
+    p50/p95/p99 must sit inside the DDSketch certificate of the exact
+    rank-selected latencies the same ledgers recorded, watermark lag must
+    stay bounded on the clean stream and spike-then-recover under a seeded
+    mid-stream ingest stall, and a 4-shard fleet's ``health_report()``
+    latency table must equal the manual ``merge_meters`` fold of the
+    per-shard sketches, with merge/bank stamps on the right ledgers and the
+    new health families in the exposition."""
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, _BENCH, "--check-health"],
+        capture_output=True, text=True, timeout=280, env=env,
+        cwd=os.path.dirname(_BENCH),
+    )
+    assert proc.returncode == 0, f"--check-health failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["ok"] is True and out["failures"] == []
+    # clean: windows published, lag bounded, the certificate quantiles rode
+    assert out["clean"]["published"] >= 3
+    assert 0 <= out["clean"]["max_lag_s"] < 5.0
+    assert set(out["clean"]["quantiles"]) == {"0.5", "0.95", "0.99"}
+    # stall: the gauge saw the backlog, then the stream drained
+    assert out["stall"]["max_lag_s"] >= 0.4
+    assert out["stall"]["final_lag_s"] < 0.8
+    # fleet: the merge tier metered its own latency into the fold
+    assert out["fleet"]["merged_windows"] == 8
+    assert "merge" in out["fleet"]["latency_stages"]
+    assert "e2e" in out["fleet"]["latency_stages"]
+    assert out["fleet"]["degraded_shards"] == []
 
 
 def test_bench_check_watermark_gate():

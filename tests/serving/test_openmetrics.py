@@ -5,16 +5,21 @@ What must hold (serving/openmetrics.py):
 - STRICT exposition format: every rendering parses under an unforgiving
   line-level validator — ``# TYPE``/``# HELP`` metadata once per family and
   before its samples, sample names matching their family (counter samples
-  suffixed ``_total``), legal metric/label names, escaped label values,
-  float syntax, one ``# EOF`` terminator at the very end;
+  suffixed ``_total``; summary samples the bare name with a ``quantile``
+  label, or ``_count``/``_sum``), legal metric/label names, escaped label
+  values, float syntax, one ``# EOF`` terminator at the very end;
 - content: the existing observability gauges (``service_health``,
-  ``fleet_shards``, ``slab_slots``, fault counters, retention gauges) and
-  each retained stream's latest resolved value are all present;
+  ``fleet_shards``, ``slab_slots``, fault counters, retention gauges), the
+  pipeline-health families (watermark lag / publish staleness / lifecycle
+  gauges + the ``stage_latency_ms`` summary), and each retained stream's
+  latest resolved value are all present;
 - keyed streams fan out one ``tenant``-labeled sample per slot;
 - the stdlib HTTP endpoint serves the same text with the OpenMetrics
-  content type on an ephemeral port.
+  content type on an ephemeral port, and survives concurrent scrapes
+  racing the write path.
 """
 import re
+import threading
 import urllib.request
 
 import numpy as np
@@ -88,6 +93,11 @@ def _parse_strict(text):
                 assert name == current + "_total", (
                     f"counter sample {name!r} must be {current}_total"
                 )
+            elif families[current]["type"] == "summary":
+                assert name in (current, current + "_count", current + "_sum"), (
+                    f"summary sample {name!r} must be {current}"
+                    f"{{quantile=...}}, {current}_count or {current}_sum"
+                )
             else:
                 assert name == current, (
                     f"sample {name!r} outside its family {current!r}"
@@ -102,6 +112,10 @@ def _parse_strict(text):
                     assert _LABEL_NAME.match(lname), lname
                     assert lname not in labels, f"duplicate label {lname}"
                     labels[lname] = _unescape(lvalue)
+            if families[current]["type"] == "summary" and name == current:
+                assert "quantile" in labels, (
+                    f"bare summary sample {name!r} needs a quantile label"
+                )
             assert _VALUE.match(value), f"bad sample value: {value!r}"
             families[current]["samples"].append((name, labels, value))
     return families
@@ -214,6 +228,47 @@ def test_fleet_gauges_render_per_shard(counters):
     assert len(depth) == 2
 
 
+def test_health_families_render_under_the_strict_validator(counters):
+    label = "svc-health-om"
+    store = _run_service(label)
+    families = _parse_strict(render([store]))
+
+    for name in ("metrics_tpu_watermark_lag_seconds",
+                 "metrics_tpu_watermark_lag_degraded",
+                 "metrics_tpu_publish_staleness_seconds",
+                 "metrics_tpu_lifecycle_windows_stamped",
+                 "metrics_tpu_lifecycle_open_windows",
+                 "metrics_tpu_stage_latency_ms"):
+        assert name in families, name
+        assert families[name]["help"], f"{name} needs HELP text"
+
+    # the deterministic stream publishes 8 windows, every one fully stamped
+    stamped = _sample_map(families["metrics_tpu_lifecycle_windows_stamped"])
+    assert stamped[(("service", label),)] == "8"
+    lag = _sample_map(families["metrics_tpu_watermark_lag_seconds"])
+    assert (("service", label),) in lag
+    degraded = _sample_map(families["metrics_tpu_watermark_lag_degraded"])
+    assert degraded[(("service", label),)] == "0"
+    staleness = _sample_map(families["metrics_tpu_publish_staleness_seconds"])
+    assert float(staleness[(("service", label),)]) >= 0.0
+
+    # the summary family: quantile-labeled samples plus _count/_sum per
+    # (service, stage) — the validator already enforced the sample grammar
+    summary = families["metrics_tpu_stage_latency_ms"]
+    assert summary["type"] == "summary"
+    quantiles = [(l, v) for n, l, v in summary["samples"]
+                 if n == "metrics_tpu_stage_latency_ms"]
+    assert quantiles and {l["quantile"] for l, _ in quantiles} <= {"0.5", "0.95", "0.99"}
+    counts = {(l["service"], l["stage"]): v for n, l, v in summary["samples"]
+              if n.endswith("_count")}
+    sums = {(l["service"], l["stage"]): v for n, l, v in summary["samples"]
+            if n.endswith("_sum")}
+    assert set(counts) == set(sums)
+    stages = {stage for service, stage in counts if service == label}
+    assert {"ingest", "close", "dispatch", "sync", "publish", "e2e"} <= stages
+    assert counts[(label, "e2e")] == "8"  # one sample per published window
+
+
 def test_http_endpoint_serves_the_exposition(counters):
     store = _run_service("svc-http")
     with ExpositionServer([store]) as server:
@@ -229,6 +284,42 @@ def test_http_endpoint_serves_the_exposition(counters):
     assert "metrics_tpu_retained_latest" in families
     # scrape-visible and render-visible views agree
     assert _parse_strict(render([store])).keys() == families.keys()
+
+
+def test_exposition_server_survives_concurrent_scrapes(counters):
+    """Many scrapers hammering the endpoint while a service is actively
+    publishing: every body must still parse under the strict validator, and
+    the family schema must be identical across all of them (samples may
+    differ — the write path races the reads — but families never flicker)."""
+    store = _run_service("svc-scrape-many")
+    bodies: list = []
+    errors: list = []
+
+    def scrape(server_url):
+        try:
+            for _ in range(5):
+                with urllib.request.urlopen(server_url, timeout=10) as resp:
+                    assert resp.status == 200
+                    bodies.append(resp.read().decode("utf-8"))
+        except Exception as exc:  # surfaced after join; threads can't fail tests
+            errors.append(exc)
+
+    with ExpositionServer([store]) as server:
+        threads = [threading.Thread(target=scrape, args=(server.url,))
+                   for _ in range(6)]
+        for t in threads:
+            t.start()
+        # race the write path: a second service stamps ledgers / meters /
+        # gauges in its worker thread while the scrapers read snapshots
+        _run_service("svc-scrape-writer", n_batches=8)
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads), "scraper thread hung"
+
+    assert errors == []
+    assert len(bodies) == 30
+    keysets = {frozenset(_parse_strict(body).keys()) for body in bodies}
+    assert len(keysets) == 1, "family schema flickered across scrapes"
 
 
 def test_render_accepts_an_explicit_snapshot(counters):
