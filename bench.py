@@ -1393,6 +1393,104 @@ def _bench_service_ingest(batches: int = SERVICE_INGEST_BATCHES) -> float:
     return batches / max(elapsed, 1e-9)
 
 
+# The ingest fast path's A/B: the SAME pre-staged bursty stream through a
+# coalescing service (the worker drains the backlog and applies each
+# contiguous publish-free run as ONE routed update) vs the one-batch twin
+# (coalesce_max_batches=1). The whole stream is submitted back-to-back so
+# the queue genuinely backs up — the scenario where per-submission dispatch
+# overhead dominates and coalescing pays. The window is far longer than the
+# stream so no window closes inside the timed region: the A/B isolates the
+# drain/dispatch plane (publish costs are identical constants on both sides
+# and window-close behavior is --check-ingest's parity tier, not a timing
+# headline).
+INGEST_COALESCE_BATCHES = 160
+INGEST_COALESCE_BATCH = 32
+INGEST_COALESCE_WARMUP = 8
+INGEST_COALESCE_MAX = 16
+INGEST_COALESCE_WINDOW_S = 600.0
+
+
+def _bench_ingest_coalesce() -> dict:
+    """The ingest fast path's default-line numbers.
+
+    ``ingest_coalesced_steps_per_s``: batches/sec through the coalescing
+    drain loop on the bursty stream (rate-gated by --check-trajectory).
+    ``ingest_coalesce_factor``: batches applied per worker drain cycle —
+    the samples-not-submissions headline (1.0 means coalescing never
+    engaged). ``ingest_program_cache_misses``: bucketed routing programs
+    compiled over the soak — steady-state misses pin to the distinct
+    (bucket, structure) count, so growth means the cache key churns and
+    every drain recompiles. Bit-exactness of the coalesced path is
+    --check-ingest's pin; this helper only times it."""
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, MetricService, Windowed
+    from metrics_tpu.observability.counters import COUNTERS
+
+    rng = np.random.RandomState(7)
+    total = INGEST_COALESCE_BATCHES + INGEST_COALESCE_WARMUP
+    data = []
+    for i in range(total):
+        preds = jnp.asarray(rng.rand(INGEST_COALESCE_BATCH).astype(np.float32))
+        target = jnp.asarray((rng.rand(INGEST_COALESCE_BATCH) > 0.5).astype(np.int32))
+        times = i * 0.5 + rng.uniform(0.0, 0.5, INGEST_COALESCE_BATCH)
+        data.append((preds, target, times))
+
+    def run(max_batches: int) -> float:
+        metric = Windowed(
+            Accuracy(), window_s=INGEST_COALESCE_WINDOW_S, num_windows=4,
+            allowed_lateness_s=INGEST_COALESCE_WINDOW_S,
+        )
+        # pre-warm every bucket the drain loop can form (spans are whole
+        # batches, so sample counts are batch * 2^k): the compiles land
+        # here, in the pre-stream era, and the timed region measures
+        # dispatch — the same discipline every other bench scenario keeps
+        warm_rng = np.random.RandomState(17)
+        n = INGEST_COALESCE_BATCH
+        while n <= INGEST_COALESCE_BATCH * max_batches:
+            metric.update(
+                jnp.asarray(warm_rng.rand(n).astype(np.float32)),
+                jnp.asarray((warm_rng.rand(n) > 0.5).astype(np.int32)),
+                event_time=warm_rng.uniform(0.0, 0.4, n),
+            )
+            n *= 2
+        with MetricService(
+            metric, queue_size=total, coalesce_max_batches=max_batches,
+            poll_interval_s=0.002,
+        ) as svc:
+            for preds, target, times in data[:INGEST_COALESCE_WARMUP]:
+                svc.submit(preds, target, event_time=times)  # warm the drain loop
+            svc.flush()
+            start = time.perf_counter()
+            for preds, target, times in data[INGEST_COALESCE_WARMUP:]:
+                svc.submit(preds, target, event_time=times)
+            svc.flush()
+            elapsed = time.perf_counter() - start
+            drains, processed = svc.drains, svc.processed
+        return INGEST_COALESCE_BATCHES / max(elapsed, 1e-9), drains, processed
+
+    was_enabled = COUNTERS.enabled
+    COUNTERS.enabled = True
+    hits0 = COUNTERS.ingest_program_cache_hits
+    miss0 = COUNTERS.ingest_program_cache_misses
+    try:
+        coal_sps, coal_drains, coal_processed = run(INGEST_COALESCE_MAX)
+        hits = COUNTERS.ingest_program_cache_hits - hits0
+        misses = COUNTERS.ingest_program_cache_misses - miss0
+        uncoal_sps, _, _ = run(1)
+    finally:
+        COUNTERS.enabled = was_enabled
+    return {
+        "coalesced_steps_per_s": coal_sps,
+        "uncoalesced_steps_per_s": uncoal_sps,
+        "coalesce_factor": coal_processed / max(coal_drains, 1),
+        "drains": coal_drains,
+        "processed": coal_processed,
+        "program_cache_hits": hits,
+        "program_cache_misses": misses,
+    }
+
+
 def _bench_retention_read():
     """The tiered-retention read plane's default-line numbers.
 
@@ -1787,6 +1885,13 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
     with (obs.span("bench.service_ingest") if obs else _null_cm()):
         ingest_steps_per_s = _bench_service_ingest()
 
+    # the ingest fast path A/B: the identical bursty stream through the
+    # coalescing drain loop vs the one-batch twin — throughput, the
+    # batches-per-drain factor, and the bucketed routing-program compile
+    # count (bit-exactness is --check-ingest's pin)
+    with (obs.span("bench.ingest_coalesce") if obs else _null_cm()):
+        ingest_coalesce = _bench_ingest_coalesce()
+
     # the tiered-retention read plane: a full-range query against the banked
     # ladder (ms) plus the store's deterministic roll-up/residency pins
     with (obs.span("bench.retention_read") if obs else _null_cm()):
@@ -1966,6 +2071,14 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
         "async_lag_epoch_sync_gather_calls": epoch_calls_sync,
         # serving ingest throughput (batches/sec through a real service loop)
         "service_ingest_steps_per_s": round(ingest_steps_per_s, 3),
+        # the ingest fast path: coalesced drain throughput on the bursty
+        # stream (rate-gated), the batches-per-drain factor (1.0 means the
+        # drain loop stopped coalescing), and the bucketed routing-program
+        # compile count — an exact pin on the seeded soak (growth means the
+        # program-cache key churns and steady state recompiles)
+        "ingest_coalesced_steps_per_s": round(ingest_coalesce["coalesced_steps_per_s"], 3),
+        "ingest_coalesce_factor": round(ingest_coalesce["coalesce_factor"], 3),
+        "ingest_program_cache_misses": ingest_coalesce["program_cache_misses"],
         # the tiered-retention read plane: the query path's full-range
         # native read against the banked ladder rides the line in ms, and
         # the store's gauge counts are EXACT pins on the seeded stream —
@@ -2022,6 +2135,11 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
             finally:
                 devtime_mod.disable()
 
+        # v17: the ingest fast path joined (ingest_coalesced_steps_per_s /
+        # ingest_coalesce_factor — the queue-drain coalescing A/B on the
+        # bursty producer stream — plus the bucketed routing-program
+        # compile pin ingest_program_cache_misses and the ingest_counters
+        # block, gated by --check-ingest's parity/throughput/chaos tiers);
         # v16: the pipeline health plane joined (publish_lag_ms /
         # selfmeter_p99_ms — the lifecycle ledger's worst close -> publish
         # e2e and the self-meter sketch's certified p99 over the seeded
@@ -2064,7 +2182,7 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
         # block); v6 added the windowed serving A/B; v5 the keyed slab A/B;
         # v4 the sketch A/B; v3 moved the collective counts to the default
         # line and added the hierarchical A/B
-        out["trace_schema"] = 16
+        out["trace_schema"] = 17
         out["counters"] = grouped_counters
         out["gather_counters"] = coal_counters
         out["hier_counters"] = hier_counters
@@ -2076,6 +2194,7 @@ def _sync8_ab(steps: int = N_STEPS, warmup: int = WARMUP, repeats: int = 3, trac
         out["mixed_counters"] = mixed_counters
         out["service_counters"] = service_counters
         out["async_counters"] = async_counters
+        out["ingest_counters"] = ingest_coalesce
         summary = obs.summarize()
         out["phase_ms"] = {
             name: round(row["total_ms"], 3) for name, row in sorted(summary.items())
@@ -2449,6 +2568,9 @@ _TRACE_KEYS = (
     "async_lag_epoch_gather_calls",
     "async_lag_epoch_sync_gather_calls",
     "service_ingest_steps_per_s",
+    "ingest_coalesced_steps_per_s",
+    "ingest_coalesce_factor",
+    "ingest_program_cache_misses",
     "retention_query_ms",
     "retention_windows_banked",
     "retention_rollups",
@@ -2478,6 +2600,7 @@ _TRACE_KEYS = (
     "mixed_counters",
     "service_counters",
     "async_counters",
+    "ingest_counters",
     "phase_ms",
     "phase_compile_ms",
     "device_ms",
@@ -4096,6 +4219,268 @@ def check_service() -> int:
             "slab_dropped_samples": chaos_counters["slab_dropped_samples"],
             "injected": chaos["injected"],
             "preempted": chaos["preempted"],
+        },
+    }))
+    return 1 if failures else 0
+
+
+# --check-ingest pins the ingest fast path (queue-drain coalescing + the
+# bucketed compiled routing plane) behind three tiers:
+#   parity     — a seeded 200-batch stream with ~15% late-within-lateness
+#                stragglers, driven through a coalescing service and the
+#                one-batch twin: EVERY published record (window, start,
+#                value, merged view, degraded/final flags, drop count) is
+#                bit-exact, drop and replay counts match, the final merged
+#                view matches, and the bucketed program cache stops missing
+#                after the steady-state segment (zero recompiles: a second
+#                identically-shaped stream segment may not grow the miss
+#                count)
+#   throughput — the bursty-producer A/B (_bench_ingest_coalesce): the
+#                coalescing drain loop must clear >= 2x the one-batch
+#                twin's batches/sec, and the batches-per-drain factor must
+#                show coalescing actually engaged
+#   chaos      — a call-pinned mid-span preempt: worker dies between spans,
+#                post-mortem snapshot, fresh service, restore, replay from
+#                two steps BEFORE the snapshot point with the original seq
+#                ids — zero lost windows, zero double publishes, values
+#                still bit-exact vs the uncoalesced twin under the same
+#                schedule, and guarded_update's span watermark skips the
+#                already-folded replays on both sides
+#
+# Exactness caveat: the tiers accumulate integer-valued counts (Accuracy's
+# correct/total), where float addition is exact — so coalesced
+# segment-sums match sequential scatters BIT-exactly. Metrics whose
+# accumulators are arbitrary floats may reassociate within a span (same
+# caveat as any batched reduction); docs/streaming.md spells this out.
+
+INGEST_PARITY_BATCHES = 200
+INGEST_PARITY_BATCH = 32
+INGEST_PARITY_WINDOW_S = 5.0
+INGEST_PARITY_LATENESS_S = 10.0
+INGEST_PARITY_WINDOWS = 4
+INGEST_CHAOS_BATCHES = 120
+INGEST_CHAOS_BATCH = 16
+INGEST_CHAOS_PREEMPT_CALL = 37
+INGEST_GATE_BUDGET_S = 120.0
+
+
+def _ingest_stream(batches: int, batch: int, seed: int = 11):
+    """Seeded gate stream: event times advance ~1 s per batch with ~15%
+    late-within-lateness stragglers (so spans carry genuinely out-of-order
+    events and window closes split them) and ~3% BEYOND-lateness events (so
+    the per-event prefix judge must produce the exact same drop verdicts as
+    the sequential plane). Returns [(times, preds, target)]."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(batches):
+        preds = rng.rand(batch).astype(np.float32)
+        target = (rng.rand(batch) > 0.5).astype(np.int32)
+        times = i * 1.0 + rng.uniform(0.0, 1.0, batch)
+        late = rng.rand(batch) < 0.15
+        times = np.where(late, times - rng.uniform(0.0, 8.0, batch), times)
+        too_late = rng.rand(batch) < 0.03
+        times = np.where(too_late, times - rng.uniform(20.0, 30.0, batch), times)
+        out.append((times, preds, target))
+    return out
+
+
+def _drive_ingest(batches, coalesce, schedule=None, extra=None):
+    """Drive the stream through a MetricService with coalescing on
+    (max_batches=8) or off (=1); synchronous publishes so the record order
+    is deterministic. Under a preempt ``schedule``, runs the post-mortem
+    failover protocol (worker join -> snapshot -> fresh service -> restore
+    -> replay from processed-2 with the ORIGINAL seq ids). ``extra`` is a
+    second identically-shaped stream segment submitted after the cache-miss
+    checkpoint — the steady-state recompile probe."""
+    import contextlib
+
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, MetricService, Windowed
+    from metrics_tpu.parallel import faults
+    from metrics_tpu.serving.service import ServiceStoppedError
+    from metrics_tpu.utils.exceptions import PreemptionError
+
+    def build():
+        metric = Windowed(
+            Accuracy(), window_s=INGEST_PARITY_WINDOW_S,
+            num_windows=INGEST_PARITY_WINDOWS,
+            allowed_lateness_s=INGEST_PARITY_LATENESS_S,
+        )
+        return MetricService(
+            metric, queue_size=len(batches) + len(extra or ()),
+            coalesce_max_batches=(8 if coalesce else 1),
+            deferred_publish=False,
+        )
+
+    injector = faults.ChaosInjector(schedule, seed=0) if schedule else contextlib.nullcontext()
+    publications = []
+    preempted = False
+    with injector:
+        service = build()
+        for i, (times, preds, target) in enumerate(batches):
+            try:
+                service.submit(jnp.asarray(preds), jnp.asarray(target),
+                               event_time=times, seq=i)
+            except ServiceStoppedError:
+                preempted = True
+                break
+        if not preempted:
+            try:
+                service.flush(INGEST_GATE_BUDGET_S)
+            except PreemptionError:
+                preempted = True
+        if preempted:
+            service._worker.join(timeout=10)
+            snapshot = service.snapshot()  # post-mortem: past the preempt call
+            publications += service.publications
+            replacement = build()
+            replacement.restore(snapshot)
+            for i in range(max(0, snapshot["processed"] - 2), len(batches)):
+                times, preds, target = batches[i]
+                replacement.submit(jnp.asarray(preds), jnp.asarray(target),
+                                   event_time=times, seq=i)
+            service = replacement
+        service.flush(INGEST_GATE_BUDGET_S)
+        misses_mark = len(service.metric._ingest_programs)
+        for i, (times, preds, target) in enumerate(extra or ()):
+            service.submit(jnp.asarray(preds), jnp.asarray(target),
+                           event_time=times, seq=len(batches) + i)
+        merged = np.asarray(service.finalize(INGEST_GATE_BUDGET_S))
+        publications += service.publications
+        misses_end = len(service.metric._ingest_programs)
+        out = {
+            "publications": publications,
+            "merged": merged,
+            "dropped": service.metric.dropped_samples,
+            "processed": service.processed,
+            "replayed": service.replayed_steps,
+            "drains": service.drains,
+            "coalesced_batches": service.coalesced_batches,
+            "misses_mark": misses_mark,
+            "misses_end": misses_end,
+            "preempted": preempted,
+        }
+        service.stop(INGEST_GATE_BUDGET_S)
+    return out
+
+
+def _check_ingest_parity(on, off, failures, label):
+    """Record-by-record bit-exactness of the coalescing service vs the
+    one-batch twin, plus the drop/replay/merged pins."""
+    if len(on["publications"]) != len(off["publications"]):
+        failures.append(
+            f"{label}: coalescing published {len(on['publications'])} records,"
+            f" the one-batch twin {len(off['publications'])}"
+        )
+    for a, b in zip(on["publications"], off["publications"]):
+        for key in ("window", "window_start_s", "degraded", "final", "dropped_samples"):
+            if a.get(key) != b.get(key):
+                failures.append(
+                    f"{label}: record for window {a.get('window')} differs on"
+                    f" {key!r}: {a.get(key)!r} != {b.get(key)!r}"
+                )
+        for key in ("value", "merged"):
+            if not np.array_equal(np.asarray(a[key]), np.asarray(b[key]), equal_nan=True):
+                failures.append(
+                    f"{label}: window {a['window']} {key} {a[key]} !="
+                    f" twin's {b[key]} (coalescing changed published bits)"
+                )
+    windows = [p["window"] for p in on["publications"] if p["final"]]
+    if len(windows) != len(set(windows)):
+        failures.append(f"{label}: coalescing double-published a window: {sorted(windows)}")
+    if not np.array_equal(on["merged"], off["merged"], equal_nan=True):
+        failures.append(
+            f"{label}: final merged view {on['merged']} != twin's {off['merged']}"
+        )
+    if on["dropped"] != off["dropped"]:
+        failures.append(
+            f"{label}: coalescing dropped {on['dropped']} samples, twin {off['dropped']}"
+        )
+    if on["replayed"] != off["replayed"]:
+        failures.append(
+            f"{label}: coalescing replayed {on['replayed']} steps, twin {off['replayed']}"
+        )
+
+
+def check_ingest() -> int:
+    """``--check-ingest``: the ingest fast-path regression gate (see the
+    block comment above). Prints one JSON report line; non-zero exit on any
+    broken contract."""
+    from metrics_tpu.parallel.faults import FaultSpec
+    from metrics_tpu.serving.service import INGEST_SITE
+
+    failures = []
+
+    # -- parity: coalescing on vs off, bit-exact records + recompile pin ----
+    stream = _ingest_stream(INGEST_PARITY_BATCHES, INGEST_PARITY_BATCH)
+    tail = _ingest_stream(40, INGEST_PARITY_BATCH, seed=13)
+    base = INGEST_PARITY_BATCHES * 1.0
+    tail = [(t + base, p, y) for (t, p, y) in tail]  # keep event time advancing
+    on = _drive_ingest(stream, coalesce=True, extra=tail)
+    off = _drive_ingest(stream, coalesce=False, extra=tail)
+    _check_ingest_parity(on, off, failures, "parity")
+    if on["coalesced_batches"] == 0:
+        failures.append("parity: coalescing never engaged (0 coalesced batches)")
+    if on["dropped"] == 0:
+        failures.append(
+            "parity: the beyond-lateness stragglers dropped nothing; the"
+            " stream lost its teeth"
+        )
+    if on["misses_end"] != on["misses_mark"]:
+        failures.append(
+            f"parity: steady-state recompiles — the bucketed program cache grew"
+            f" from {on['misses_mark']} to {on['misses_end']} entries over an"
+            " identically-shaped stream segment"
+        )
+
+    # -- throughput: the bursty A/B must clear 2x -------------------------
+    bench = _bench_ingest_coalesce()
+    if bench["coalesced_steps_per_s"] < 2.0 * bench["uncoalesced_steps_per_s"]:
+        failures.append(
+            f"throughput: coalesced {bench['coalesced_steps_per_s']:.1f} steps/s"
+            f" < 2x the one-batch twin's {bench['uncoalesced_steps_per_s']:.1f}"
+        )
+    if bench["coalesce_factor"] < 2.0:
+        failures.append(
+            f"throughput: coalesce factor {bench['coalesce_factor']:.2f} < 2"
+            " (the drain loop stopped batching the backlog)"
+        )
+
+    # -- chaos: mid-span preempt + post-mortem failover -------------------
+    chaos_stream = _ingest_stream(INGEST_CHAOS_BATCHES, INGEST_CHAOS_BATCH, seed=23)
+    schedule = [FaultSpec(kind="preempt", call=INGEST_CHAOS_PREEMPT_CALL, times=1,
+                          site=INGEST_SITE)]
+    chaos_on = _drive_ingest(chaos_stream, coalesce=True, schedule=schedule)
+    chaos_off = _drive_ingest(chaos_stream, coalesce=False, schedule=schedule)
+    _check_ingest_parity(chaos_on, chaos_off, failures, "chaos")
+    if not chaos_on["preempted"] or not chaos_off["preempted"]:
+        failures.append("chaos: the call-pinned preempt never fired")
+    if chaos_on["replayed"] == 0:
+        failures.append(
+            "chaos: replay-from-before-the-snapshot folded zero already-applied"
+            " steps (the idempotence path went untested)"
+        )
+
+    print(json.dumps({
+        "check": "ingest",
+        "ok": not failures,
+        "failures": failures,
+        "parity": {
+            "records": len(on["publications"]),
+            "drains": on["drains"],
+            "coalesced_batches": on["coalesced_batches"],
+            "dropped": on["dropped"],
+            "program_cache_entries": on["misses_end"],
+        },
+        "throughput": {k: (round(v, 3) if isinstance(v, float) else v)
+                       for k, v in bench.items()},
+        "chaos": {
+            "records": len(chaos_on["publications"]),
+            "replayed_on": chaos_on["replayed"],
+            "replayed_off": chaos_off["replayed"],
+            "coalesced_batches": chaos_on["coalesced_batches"],
+            "preempted": chaos_on["preempted"],
         },
     }))
     return 1 if failures else 0
@@ -5802,6 +6187,13 @@ def main() -> None:
         # in-process (no virtual devices needed)
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         raise SystemExit(check_health())
+
+    if len(sys.argv) > 1 and sys.argv[1] == "--check-ingest":
+        # ingest fast-path gate: host-plane serving soaks (threads + queues
+        # + numpy routing) against the eagerly-compiled bucketed scatter;
+        # jax not yet imported, so the platform pin lands in-process
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        raise SystemExit(check_ingest())
 
     if len(sys.argv) > 1 and sys.argv[1] == "--check-collectives":
         # collective regression gate: jax is not yet imported, so the

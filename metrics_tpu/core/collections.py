@@ -562,10 +562,15 @@ class MetricCollection(OrderedDict):
                         values[k] = c.compute_from_state(deltas[rep])
             return new_states, values
 
-        # states donate unconditionally (not just on TPU): the whole point of
-        # the megafused step is in-place slab updates, and the caller dedupes
-        # aliased buffers + rebinds every member attr right after the call
-        return jax.jit(step, donate_argnums=(0,))
+        # states donate off CPU: in-place slab updates are the point of the
+        # megafused step, and the caller dedupes aliased buffers + rebinds
+        # every member attr right after the call. XLA:CPU executables
+        # DESERIALIZED from the persistent compilation cache mishandle
+        # input-output aliasing (state reads flakily see freed memory), so on
+        # CPU the step keeps the copy — same gate as the routed-scatter and
+        # bootstrap steps.
+        donate = (0,) if jax.default_backend() != "cpu" else ()
+        return jax.jit(step, donate_argnums=donate)
 
     def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         return self.forward(*args, **kwargs)

@@ -1245,7 +1245,9 @@ class Metric(ABC):
         mid-epoch checkpoint knows exactly which steps are already in."""
         return self._epoch_watermark
 
-    def guarded_update(self, step_index: int, *args: Any, **kwargs: Any) -> bool:
+    def guarded_update(
+        self, step_index: int, *args: Any, span_end: Optional[int] = None, **kwargs: Any
+    ) -> bool:
         """Idempotent update: apply the batch only if ``step_index`` is not
         already folded into the state.
 
@@ -1256,10 +1258,36 @@ class Metric(ABC):
         no-ops (returns ``False``), so re-running the step that was in
         flight at preemption cannot double-count. Returns ``True`` when the
         batch was applied.
+
+        ``span_end`` is the coalesced-ingest form: the one ``update`` call
+        carries the folded concatenation of sequential steps ``step_index ..
+        span_end`` (inclusive), and on success the epoch watermark advances
+        past ``span_end`` — replaying the whole span later no-ops exactly
+        like replaying a single step. Span replay is ALL-OR-NOTHING: a span
+        entirely below the watermark no-ops (returns ``False``), a span
+        STRADDLING it (``step_index < epoch_watermark <= span_end``) raises
+        ``ValueError`` — the caller must split at the watermark and re-fold
+        only the unapplied suffix (the service's coalescer does; the
+        partial-span pin in ``tests/serving/test_ingest_coalesce.py`` holds
+        it to that).
         """
+        if span_end is None:
+            if step_index < self._epoch_watermark:
+                return False
+            self.update(*args, **kwargs)
+            return True
+        if span_end < step_index:
+            raise ValueError(f"span_end {span_end} < step_index {step_index}")
+        if span_end < self._epoch_watermark:
+            return False  # the whole span is already folded in — no-op replay
         if step_index < self._epoch_watermark:
-            return False
-        self.update(*args, **kwargs)
+            raise ValueError(
+                f"span [{step_index}, {span_end}] straddles the epoch watermark "
+                f"{self._epoch_watermark}: split at the watermark and re-fold "
+                "only the unapplied suffix"
+            )
+        self.update(*args, **kwargs)  # advances the watermark by one step...
+        self._epoch_watermark += span_end - step_index  # ...plus the span's rest
         return True
 
     # ------------------------------------------------------------------ sync

@@ -250,6 +250,7 @@ def route_events(
     head: Optional[int],
     spec: WindowSpec,
     agreed: Optional[float] = None,
+    judge_prefix: Optional[Any] = None,
 ) -> RouteResult:
     """Route one batch of event times through the advancing watermark.
 
@@ -265,9 +266,28 @@ def route_events(
     Pure host numpy — deterministic, thread-free, and independently
     recomputable (the service gates' oracles replay the same arithmetic from
     the raw stream).
+
+    ``judge_prefix`` is the coalesced-ingest form: a ``(N,)`` float64 array
+    of PER-EVENT judging watermarks — for a concatenation of k sequential
+    batches, every event of batch i carries the running max the sequential
+    plane would have judged batch i by (``max(watermark, t_1.max(), ...,
+    t_i.max())``, batch-granular and non-decreasing). Open/late verdicts are
+    then judged per event against that prefix clock instead of one scalar,
+    which makes routing the concatenation bit-exact vs routing the k batches
+    one at a time — PROVIDED the concatenation does not advance the ring
+    head or the close horizon mid-span (the service's coalescer splits spans
+    at exactly those boundaries; residency is judged against the final head,
+    which equals every per-batch head within such a span). Mutually
+    exclusive with ``agreed``: under an agreed clock every batch is judged
+    by the same scalar and coalescing's prefix form is a no-op.
     """
     stride = spec.stride
     t = np.asarray(event_times, dtype=np.float64).reshape(-1)
+    if agreed is not None and judge_prefix is not None:
+        raise ValueError(
+            "judge_prefix and agreed are mutually exclusive: an agreed clock "
+            "judges every event by the same scalar"
+        )
     if t.size == 0:
         return RouteResult(
             np.empty((0,), dtype=np.int32),
@@ -284,7 +304,21 @@ def route_events(
     # the judging clock: the agreed watermark when one governs the stream
     # (verdicts are a pure function of (window, agreed)), the local running
     # max otherwise
-    judge_wm = new_wm if agreed is None else float(agreed)
+    judge_wm: Any = new_wm if agreed is None else float(agreed)
+    if judge_prefix is not None:
+        jp = np.asarray(judge_prefix, dtype=np.float64).reshape(-1)
+        if jp.shape != t.shape:
+            raise ValueError(
+                f"judge_prefix must match event_times: {jp.shape} vs {t.shape}"
+            )
+        if jp.size and (np.diff(jp) < 0).any():
+            raise ValueError("judge_prefix must be non-decreasing (a running max)")
+        if float(jp[-1]) != new_wm:
+            raise ValueError(
+                f"judge_prefix must end at the batch watermark: {float(jp[-1])}"
+                f" != {new_wm}"
+            )
+        judge_wm = jp
     new_head = int(math.floor(new_wm / stride))
     w = window_index(t, stride)  # the NEWEST window covering each event
 

@@ -308,6 +308,8 @@ class CollectiveCounters:
         "launch_cache_misses",
         "fused_step_cache_hits",
         "fused_step_cache_misses",
+        "ingest_program_cache_hits",
+        "ingest_program_cache_misses",
         "faults",
         "deferred",
         "deferred_depth",
@@ -350,6 +352,8 @@ class CollectiveCounters:
         self.launch_cache_misses = 0
         self.fused_step_cache_hits = 0
         self.fused_step_cache_misses = 0
+        self.ingest_program_cache_hits = 0
+        self.ingest_program_cache_misses = 0
         self.faults: Dict[str, int] = {k: 0 for k in FAULT_KINDS}
         self.deferred: Dict[str, int] = {k: 0 for k in DEFERRED_KINDS}
         self.deferred_depth: Dict[str, Dict[str, int]] = {}  # label -> {"current", "max"}
@@ -415,7 +419,7 @@ class CollectiveCounters:
             self.states_synced += int(n)
 
     def record_cache(self, which: str, hit: bool) -> None:
-        """``which`` in {'group', 'step', 'launch', 'fused_step'}."""
+        """``which`` in {'group', 'step', 'launch', 'fused_step', 'ingest_program'}."""
         attr = f"{which}_cache_{'hits' if hit else 'misses'}"
         with self._lock:
             setattr(self, attr, getattr(self, attr) + 1)
@@ -676,6 +680,10 @@ class CollectiveCounters:
                 "fused_step_cache": {
                     "hits": self.fused_step_cache_hits,
                     "misses": self.fused_step_cache_misses,
+                },
+                "ingest_program_cache": {
+                    "hits": self.ingest_program_cache_hits,
+                    "misses": self.ingest_program_cache_misses,
                 },
             }
 

@@ -216,12 +216,22 @@ COUNT_KEYS: Tuple[str, ...] = (
     # publishes must carry a complete core stage ledger — a drop means a
     # publish path stopped stamping (an observability coverage regression)
     "lifecycle_windows_stamped",
+    # the ingest fast path's bucketed routing programs: the seeded coalesce
+    # soak compiles one program per (sample bucket, tree structure) and the
+    # bucket set is fixed by the scenario — growth means the program-cache
+    # key churns and steady-state ingest recompiles
+    "ingest_program_cache_misses",
 )
 
 # throughput keys (batches/sec through real serving loops): gated as
 # collapse detectors — current may not fall below best prior / rate_ratio
 RATE_KEYS: Tuple[str, ...] = (
     "service_ingest_steps_per_s",
+    # the coalescing drain loop's throughput on the bursty stream, plus the
+    # batches-per-drain factor (dimensionless but rate-shaped: a collapse
+    # toward 1.0 means the drain loop stopped batching the backlog)
+    "ingest_coalesced_steps_per_s",
+    "ingest_coalesce_factor",
     "fleet_ingest_steps_per_s",
     "fleet_ingest_steps_per_s_1shard",
     # the heavy-hitter ingest pair: the open-world loop's throughput must

@@ -61,6 +61,7 @@ __all__ = [
     "is_cms_spec",
     "make_cms_spec",
     "stable_key_hash",
+    "stable_key_hash_array",
     "stable_key_hashes",
 ]
 
@@ -107,6 +108,64 @@ def stable_key_hash(key: Any) -> int:
 def stable_key_hashes(keys) -> np.ndarray:
     """Vectorized :func:`stable_key_hash`: one ``uint64`` per key."""
     return np.array([stable_key_hash(k) for k in keys], dtype=np.uint64)
+
+
+def stable_key_hash_array(keys: Any) -> np.ndarray:
+    """:func:`stable_key_hash` over a whole numpy key array in one
+    vectorized pass — BIT-EQUAL to the scalar hash of every element
+    (``tests/parallel/test_cms.py`` pins the equality on a fixed corpus).
+
+    The trick: prepend the canonical type tag with ``np.char`` ops (so
+    ``1`` and ``"1"`` still cannot collide), view the tagged fixed-width
+    ``'S'`` array as an ``(N, itemsize)`` byte matrix, and fold FNV-1a one
+    BYTE POSITION at a time across all N keys — ``itemsize`` numpy passes
+    instead of N Python loops, with ``uint64`` arithmetic wrapping mod
+    2**64 exactly like the scalar hash's explicit mask (the same wrap
+    contract :func:`cms_buckets` documents). Rows shorter than the widest
+    key stop folding at their own length, so padding bytes never enter the
+    hash; interior NUL bytes DO fold (they are real key bytes — ``'S'``
+    storage only strips trailing NULs, which the scalar hash of the same
+    array element never sees either).
+
+    Integer (signed/unsigned), bytes (``'S'``) and str (``'U'``) dtypes
+    vectorize; object arrays and lists fall back to the scalar loop so
+    mixed-type key batches keep working. Bool and float keys are rejected
+    exactly like the scalar hash.
+    """
+    arr = np.asarray(keys)
+    if arr.ndim != 1:
+        arr = arr.reshape(-1)
+    if arr.size == 0:
+        return np.empty((0,), dtype=np.uint64)
+    kind = arr.dtype.kind
+    if kind == "O":
+        return stable_key_hashes(arr)
+    if kind in "iu":
+        tagged = np.char.add(b"i:", arr.astype("S"))
+    elif kind == "S":
+        tagged = np.char.add(b"b:", arr)
+    elif kind == "U":
+        tagged = np.char.add(b"s:", np.char.encode(arr, "utf-8"))
+    else:
+        raise TypeError(
+            f"keys must be str, bytes or int (stable canonical bytes);"
+            f" got array dtype {arr.dtype}"
+        )
+    tagged = np.ascontiguousarray(tagged)
+    width = tagged.dtype.itemsize
+    flat = tagged.view(np.uint8).reshape(tagged.size, width)
+    nonzero = flat != 0
+    # per-row byte length: index of the last nonzero byte + 1 (the 2-byte
+    # type tag is always nonzero, so every row has at least length 2)
+    lengths = width - np.argmax(nonzero[:, ::-1], axis=1)
+    h = np.full(tagged.size, _FNV64_OFFSET, dtype=np.uint64)
+    prime = np.uint64(_FNV64_PRIME)
+    for pos in range(width):
+        live = pos < lengths
+        if not live.any():
+            break
+        h = np.where(live, (h ^ flat[:, pos].astype(np.uint64)) * prime, h)
+    return h
 
 
 class CountMinSketch(NamedTuple):

@@ -276,6 +276,34 @@ class ChaosInjector:
                 fired.append(spec)
         return fired
 
+    def ingest_addressed(
+        self, site: str, idx: int, shard: Optional[int] = None, rank: Optional[int] = None,
+    ) -> bool:
+        """Pure preview of :meth:`ingest_faults`: would ANY service-plane
+        spec fire on ingest call ``idx``? Fires nothing — rate-based
+        verdicts are decided (and cached) exactly like the firing call, so
+        the answer a later ``ingest_faults`` at the same ``idx`` sees is the
+        one previewed here. The service's queue-drain coalescer uses this to
+        END a span before a fault-addressed batch without consuming the
+        fault: the addressed batch then goes through the ordinary firing
+        path alone, and existing chaos schedules keep their per-submission
+        meaning under coalescing.
+        """
+        with self._lock:
+            for spec in self.schedule:
+                if spec.kind not in SERVICE_FAULT_KINDS or spec.site != site:
+                    continue
+                if spec.shard is not None and spec.shard != shard:
+                    continue
+                if spec.rank is not None and spec.rank != rank:
+                    continue
+                if spec.call is not None:
+                    if spec.call <= idx < spec.call + spec.times:
+                        return True
+                elif self._matches(spec, site, idx, shard, rank):
+                    return True
+        return False
+
     def after_call(self, site: str, idx: int, attempt: int, result: Any) -> Any:
         """Runs on the gathered result; may corrupt payloads (NaN-poison)."""
         with self._lock:

@@ -34,6 +34,7 @@ materializes (the slab analogue of ``_BufferSpec``/``SketchSpec``);
 onto the fixed K slots with least-recently-used eviction. The user-facing
 wrapper is :class:`metrics_tpu.wrappers.keyed.Keyed`.
 """
+import threading
 from collections import OrderedDict
 from typing import Any, Hashable, List, NamedTuple, Optional, Sequence, Tuple
 
@@ -42,6 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
+from metrics_tpu.observability.counters import record_cache
 from metrics_tpu.parallel.cms import CountMinSketch
 from metrics_tpu.parallel.qsketch import QuantileSketch
 from metrics_tpu.parallel.sketch import HistogramSketch, RankSketch, is_sketch
@@ -51,11 +53,16 @@ __all__ = [
     "PARTIAL_SCHEMA_VERSION",
     "SLAB_REDUCES",
     "SLAB_SKETCH_KINDS",
+    "SlabProgramCache",
     "SlabSpec",
+    "bucket_size",
     "check_partial_version",
     "dropped_slot_count",
     "is_slab_spec",
     "make_slab_spec",
+    "pad_samples",
+    "pad_slot_ids",
+    "shared_ingest_program",
     "slab_init",
     "slab_merge",
     "slab_rows_spec",
@@ -397,3 +404,139 @@ class LRUSlotTable:
         count is deliberately kept — it is a process gauge, not epoch state."""
         self._map.clear()
         self._free = list(range(self.num_slots - 1, -1, -1))
+
+
+# ---------------------------------------------------------------------------
+# Bucketed compiled routing: the ingest fast path's shape-stability plane.
+#
+# Queue-drain coalescing (``serving/service.py``) produces VARIABLE sample
+# counts — one drain might fold 3 batches of 32, the next 7 of 64 — and a
+# jitted scatter program keyed on the exact sample count would retrace on
+# every new size. The fix is the classic bucketing trick: pad the sample axis
+# up to the next power of two and compile ONE program per (bucket, tree
+# structure). Padded rows carry slot id ``-1``, which XLA scatter DROPS by
+# out-of-bounds semantics (`slab_scatter`), so padding is arithmetic-free:
+# the dropped rows never touch a slab row and the per-slot sums are
+# bit-identical to the unpadded eager scatter.
+# ---------------------------------------------------------------------------
+
+
+def bucket_size(n: int, minimum: int = 8) -> int:
+    """The padded sample count for a batch of ``n``: the next power of two,
+    floored at ``minimum`` so tiny drains share one program instead of
+    compiling 1/2/4-sample variants."""
+    if n < 1:
+        raise ValueError(f"bucket_size needs a positive sample count, got {n}")
+    size = minimum
+    while size < n:
+        size *= 2
+    return size
+
+
+def pad_samples(arr: Any, bucket: int) -> np.ndarray:
+    """Zero-pad ``arr``'s leading (sample) axis up to ``bucket`` rows.
+
+    The pad VALUE is irrelevant by construction — padded rows scatter to
+    slot ``-1`` and are dropped before they meet a slab row — zeros merely
+    keep the pad cheap and dtype-exact. The pad runs in HOST numpy on
+    purpose: eager ``jnp`` pads would compile a tiny XLA program per
+    DISTINCT unpadded ``n`` (exactly the shape churn bucketing exists to
+    kill); a numpy operand crosses to the device once, at the compiled
+    program's boundary, where only the bucket shape is visible.
+    """
+    a = np.asarray(arr)
+    n = a.shape[0]
+    if n == bucket:
+        return a
+    out = np.zeros((bucket,) + a.shape[1:], dtype=a.dtype)
+    out[:n] = a
+    return out
+
+
+def pad_slot_ids(slot_ids: Any, bucket: int) -> np.ndarray:
+    """Pad a host-side ``(n,)`` slot-id vector to ``(bucket,)`` with the
+    dropped sentinel ``-1`` — the rows XLA scatter ignores."""
+    ids = np.asarray(slot_ids, dtype=np.int32).reshape(-1)
+    if ids.shape[0] == bucket:
+        return ids
+    out = np.full(bucket, -1, dtype=np.int32)
+    out[: ids.shape[0]] = ids
+    return out
+
+
+# Process-wide jit-callable sharing for config-identical wrappers (the
+# collection analogue is ``_COL_STEP_CACHE``): an 8-shard fleet builds 8
+# config-identical Windowed metrics, and without sharing each shard worker
+# re-traces and re-compiles the same routed-scatter program INSIDE its
+# ingest loop — the XLA compile lock then serializes the shards (the exact
+# "something global serializes the shard workers" the fleet scaling gate
+# watches for). The registry shares the jit CALLABLE, so jax's own
+# signature cache makes every (bucket, dtypes) compile happen once per
+# process; per-instance ``SlabProgramCache`` hit/miss accounting is
+# unchanged. Entries keep their key's ``pins`` alive so id()-based key
+# material is never recycled while the entry lives.
+_SHARED_INGEST_PROGRAMS: dict = {}
+_SHARED_INGEST_PROGRAMS_MAX = 128
+_SHARED_INGEST_PROGRAMS_LOCK = threading.Lock()
+
+
+def shared_ingest_program(key: Hashable, pins: list, build) -> Any:
+    """The process-wide jit callable for ``key``, building on first touch.
+
+    ``pins`` are the objects whose ``id()`` appears in ``key`` (the inner
+    metric's config fingerprint pins); the entry holds them so the key stays
+    valid. Insertion is bounded: oldest entries fall off at the cap."""
+    with _SHARED_INGEST_PROGRAMS_LOCK:
+        entry = _SHARED_INGEST_PROGRAMS.get(key)
+        if entry is None:
+            entry = (pins, build())
+            while len(_SHARED_INGEST_PROGRAMS) >= _SHARED_INGEST_PROGRAMS_MAX:
+                _SHARED_INGEST_PROGRAMS.pop(next(iter(_SHARED_INGEST_PROGRAMS)))
+            _SHARED_INGEST_PROGRAMS[key] = entry
+        return entry[1]
+
+
+class SlabProgramCache:
+    """Per-wrapper cache of compiled routed-scatter programs, keyed on
+    (bucket, tree structure).
+
+    Steady state is a handful of entries — one per occupied sample bucket —
+    and the pinned invariant (``bench.py --check-ingest``) is that misses
+    stop growing once the buckets are warm. Hits and misses feed the
+    ``ingest_program_cache`` counter block via
+    :func:`~metrics_tpu.observability.counters.record_cache`.
+
+    Compiled programs hold donated device buffers and jit callables, which
+    are neither deep-copyable nor picklable — and wrapper metrics DO get
+    deep-copied (``MetricCollection``, checkpoint round-trips). The cache
+    therefore deliberately copies/pickles as EMPTY: a restored metric simply
+    recompiles on first touch, which is correct (the programs are pure
+    derived state) and cheap (one trace per bucket).
+    """
+
+    def __init__(self) -> None:
+        self._programs: dict = {}
+
+    def __len__(self) -> int:
+        return len(self._programs)
+
+    def get(self, key: Hashable, build) -> Any:
+        """The cached program for ``key``, building (and counting a miss)
+        on first touch."""
+        program = self._programs.get(key)
+        if program is not None:
+            record_cache("ingest_program", hit=True)
+            return program
+        record_cache("ingest_program", hit=False)
+        program = build()
+        self._programs[key] = program
+        return program
+
+    def clear(self) -> None:
+        self._programs.clear()
+
+    def __deepcopy__(self, memo: dict) -> "SlabProgramCache":
+        return SlabProgramCache()
+
+    def __reduce__(self):
+        return (SlabProgramCache, ())

@@ -20,6 +20,7 @@ from metrics_tpu.serving.fleet import (
     MetricFleet,
     ShardStoppedError,
     shard_for_key,
+    shards_for_keys,
     stable_key_hash,
 )
 from metrics_tpu.serving.openmetrics import CONTENT_TYPE, ExpositionServer, render
@@ -40,5 +41,6 @@ __all__ = [
     "ShardStoppedError",
     "render",
     "shard_for_key",
+    "shards_for_keys",
     "stable_key_hash",
 ]

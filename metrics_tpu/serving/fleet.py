@@ -78,7 +78,7 @@ from metrics_tpu.observability.counters import (
 from metrics_tpu.observability.lifecycle import LEDGER as _LEDGER
 from metrics_tpu.observability.selfmeter import SELFMETER, merge_meters
 from metrics_tpu.observability.trace import TRACE as _TRACE, span as _span
-from metrics_tpu.parallel.cms import stable_key_hash
+from metrics_tpu.parallel.cms import stable_key_hash, stable_key_hash_array
 from metrics_tpu.parallel.sketch import is_sketch
 from metrics_tpu.parallel.slab import PARTIAL_SCHEMA_VERSION
 from metrics_tpu.parallel.sync import SyncGuard
@@ -92,6 +92,7 @@ __all__ = [
     "MetricFleet",
     "ShardStoppedError",
     "shard_for_key",
+    "shards_for_keys",
     "stable_key_hash",
 ]
 
@@ -110,6 +111,17 @@ def shard_for_key(key: Any, num_shards: int) -> int:
     if not (isinstance(num_shards, int) and num_shards >= 1):
         raise ValueError(f"num_shards must be a positive int, got {num_shards!r}")
     return stable_key_hash(key) % num_shards
+
+
+def shards_for_keys(keys: Any, num_shards: int) -> np.ndarray:
+    """Vectorized :func:`shard_for_key` over a whole key batch: one
+    ``int64`` shard index per key, via the one-pass FNV-1a array hash and a
+    single ``% num_shards`` — IDENTICAL assignments to the scalar router on
+    every key (``stable_key_hash_array`` is pinned bit-equal to
+    ``stable_key_hash``, and the tests pin this wrapper too)."""
+    if not (isinstance(num_shards, int) and num_shards >= 1):
+        raise ValueError(f"num_shards must be a positive int, got {num_shards!r}")
+    return (stable_key_hash_array(keys) % np.uint64(num_shards)).astype(np.int64)
 
 
 class ShardStoppedError(ServiceStoppedError):
@@ -678,16 +690,26 @@ class HeavyHitterFleet:
     def submit(self, keys, *args: Any, **kwargs: Any) -> None:
         """Partition one keyed batch across the shards and update each
         shard's two-tier state with its rows (one ``HeavyHitters.update``
-        per non-empty shard)."""
+        per non-empty shard).
+
+        Routing is one vectorized pass — :func:`shards_for_keys` hashes the
+        whole batch and one stable ``np.argsort`` splits it into contiguous
+        per-shard runs — instead of a per-key Python loop. Assignments are
+        identical to the scalar router (the hash is pinned bit-equal), the
+        stable sort preserves within-shard submission order, and shards are
+        visited in ascending index order, so the update sequence each shard
+        observes is exactly the loop's."""
         keys = list(keys)
-        by_shard: Dict[int, List[int]] = {}
-        for i, key in enumerate(keys):
-            by_shard.setdefault(shard_for_key(key, self.num_shards), []).append(i)
-        for shard, rows in sorted(by_shard.items()):
-            idx = np.asarray(rows, dtype=np.int32)
-            self.shards[shard].update(
+        if not keys:
+            return
+        shards = shards_for_keys(keys, self.num_shards)
+        order = np.argsort(shards, kind="stable")
+        split_at = np.nonzero(np.diff(shards[order]))[0] + 1
+        for rows in np.split(order, split_at):
+            idx = rows.astype(np.int32)
+            self.shards[int(shards[rows[0]])].update(
                 *(a[idx] for a in args),
-                key=[keys[i] for i in rows],
+                key=[keys[int(i)] for i in rows],
                 **{k: v[idx] for k, v in kwargs.items()},
             )
 

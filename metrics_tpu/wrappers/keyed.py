@@ -22,6 +22,7 @@ per-slot slab form (use ``approx="sketch"`` instead) — and the inner
 merging per-sample updates (the n -> 1 limit of the pairwise-merge property
 the fused forward already assumes). Sum/mean state defaults must be zero.
 """
+from copy import deepcopy
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -42,10 +43,15 @@ from metrics_tpu.parallel.sketch import SketchSpec, is_sketch, sketch_init
 from metrics_tpu.parallel.slab import (
     LRUSlotTable,
     PARTIAL_SCHEMA_VERSION,
+    SlabProgramCache,
     SlabSpec,
+    bucket_size,
     check_partial_version,
     dropped_slot_count,
     make_slab_spec,
+    pad_samples,
+    pad_slot_ids,
+    shared_ingest_program,
     slab_init,
     slab_merge,
     slab_rows_spec,
@@ -134,6 +140,9 @@ class Keyed(Metric):
         self._metric_label = f"Keyed({type(metric).__name__})"
         self._slots = LRUSlotTable(self.num_slots) if lru else None
         self._occupied_host: set = set()  # gauge bookkeeping, not state
+        # compiled routed-scatter programs, one per (sample bucket, tree
+        # structure): the eager-path retrace guard (deep-copies/pickles empty)
+        self._ingest_programs = SlabProgramCache()
 
         # every inner state becomes a (K, *shape) slab state of this wrapper
         if not metric._defaults:
@@ -202,6 +211,18 @@ class Keyed(Metric):
         data = (*args, *kwargs.values())
         if not data:
             raise ValueError("Keyed.update needs at least one data argument")
+        if (
+            not self._under_trace()
+            and int(slot_ids.shape[0]) > 0
+            and all(getattr(a, "ndim", 0) for a in data)
+        ):
+            # the bucketed compiled path (eager updates only — megafusion
+            # traces this whole method, where shapes are already static):
+            # pad to a power-of-two sample bucket (padded rows -> slot -1 ->
+            # XLA scatter drop) and run ONE cached jitted scatter program.
+            self._scatter_bucketed(args, kwargs, np.asarray(slot_ids))
+            self._note_slab_gauges(slot_ids)
+            return
         kw_keys = tuple(kwargs)
         n_args = len(args)
 
@@ -226,6 +247,89 @@ class Keyed(Metric):
         ones = jnp.ones(slot_ids.shape, dtype=rows.dtype)
         setattr(self, _ROWS_STATE, rows + slab_scatter("sum", ones, slot_ids, self.num_slots))
         self._note_slab_gauges(slot_ids)
+
+    def _scatter_bucketed(self, args: tuple, kwargs: Dict[str, Any], slot_ids: np.ndarray) -> None:
+        """Scatter one batch through the cached compiled program for its
+        (sample bucket, tree structure); padded rows carry slot ``-1`` and
+        are dropped by XLA scatter, so the result is bit-identical to the
+        unpadded eager scatter."""
+        data = (*args, *kwargs.values())
+        bucket = bucket_size(int(slot_ids.shape[0]))
+        # host numpy until the compiled call's boundary — eager jnp
+        # pads/converts would compile per DISTINCT unpadded n, the exact
+        # shape churn the bucket absorbs
+        padded = tuple(pad_samples(a, bucket) for a in data)
+        ids = pad_slot_ids(slot_ids, bucket)
+        key = (
+            bucket,
+            len(args),
+            tuple(kwargs),
+            tuple((a.dtype.name, a.shape[1:]) for a in padded),
+        )
+        program = self._ingest_programs.get(
+            key, lambda: self._build_ingest_program(len(args), tuple(kwargs))
+        )
+        slabs = {name: getattr(self, name) for name in self.metric._defaults}
+        new_slabs, new_rows = program(slabs, getattr(self, _ROWS_STATE), ids, padded)
+        for name, value in new_slabs.items():
+            setattr(self, name, value)
+        setattr(self, _ROWS_STATE, new_rows)
+
+    def _build_ingest_program(self, n_args: int, kw_keys: tuple):
+        """Compile the scatter program for one tree structure: vmapped
+        per-sample inner delta + one segment scatter per state + the slab
+        merges, as ONE jitted call with donated slab buffers (off CPU).
+
+        Config-identical wrappers share ONE jit callable process-wide via
+        :func:`~metrics_tpu.parallel.slab.shared_ingest_program`, so a fresh
+        instance (fleet shard, A/B twin) replays compiled signatures instead
+        of re-tracing them; the shared closure captures a detached reset
+        carrier, never the live inner."""
+        num_slots = self.num_slots
+        reduces = dict(self._slab_reduce)
+
+        def build(metric):
+            def one(*sample):
+                batch = tuple(a[None] for a in sample)  # per-sample size-1 batches
+                return metric.update_state(
+                    metric.init_state(), *batch[:n_args], **dict(zip(kw_keys, batch[n_args:]))
+                )
+
+            def program(slabs, rows, slot_ids, data):
+                deltas = jax.vmap(one)(*data)
+                out_slabs = {}
+                for name, current in slabs.items():
+                    reduce = reduces[name]
+                    leaf = deltas[name]
+                    if is_sketch(current):
+                        out_slabs[name] = type(current)(
+                            current.counts + slab_scatter("sum", leaf.counts, slot_ids, num_slots)
+                        )
+                    else:
+                        out_slabs[name] = slab_merge(
+                            reduce, current, slab_scatter(reduce, leaf, slot_ids, num_slots)
+                        )
+                ones = jnp.ones(slot_ids.shape, dtype=rows.dtype)
+                return out_slabs, rows + slab_scatter("sum", ones, slot_ids, num_slots)
+
+            donate = (0, 1) if jax.default_backend() != "cpu" else ()
+            return jax.jit(program, donate_argnums=donate)
+
+        fp = self.metric._config_fingerprint()
+        if fp is None:
+            return build(self.metric)  # unfingerprintable config: private program
+        key_body, pins = fp
+
+        def detached():
+            carrier = deepcopy(self.metric)
+            carrier.reset()
+            return build(carrier)
+
+        key = (
+            "keyed", key_body, num_slots,
+            tuple(sorted(reduces.items())), n_args, kw_keys,
+        )
+        return shared_ingest_program(key, pins, detached)
 
     def _resolve_slot_ids(self, slot: Any) -> Array:
         if self.lru:

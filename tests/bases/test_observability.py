@@ -464,6 +464,13 @@ def test_snapshot_schema_lint_across_consumers():
     assert "publish_lag_ms" in regress.MS_KEYS
     assert "selfmeter_p99_ms" in regress.MS_KEYS
     assert "lifecycle_windows_stamped" in regress.COUNT_KEYS
+    # the ingest fast path: the bucketed routing-program compile cache is
+    # present (zeroed) in a disabled snapshot so bench/gate consumers can
+    # diff it unconditionally, and its bench-line keys are trajectory-gated
+    assert snap["ingest_program_cache"] == {"hits": 0, "misses": 0}
+    assert "ingest_coalesced_steps_per_s" in regress.RATE_KEYS
+    assert "ingest_coalesce_factor" in regress.RATE_KEYS
+    assert "ingest_program_cache_misses" in regress.COUNT_KEYS
 
 
 def test_lifecycle_ledger_stamps_and_derives_gauges():

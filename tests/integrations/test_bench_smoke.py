@@ -190,6 +190,17 @@ def _assert_headline_schema(out):
     assert isinstance(out["service_ingest_steps_per_s"], (int, float))
     assert out["service_ingest_steps_per_s"] > 0
 
+    # the ingest fast path A/B: coalesced drain throughput on the bursty
+    # producer, the batches-per-drain factor (>= 1 by construction; the
+    # >= 2x pins live in --check-ingest, not here — smoke timing is noise),
+    # and the bucketed routing-program compile pin: the prewarmed bucket
+    # ladder 32..512 is EXACTLY five programs, and the timed stream must
+    # ride them without a single steady-state recompile
+    assert isinstance(out["ingest_coalesced_steps_per_s"], (int, float))
+    assert out["ingest_coalesced_steps_per_s"] > 0
+    assert out["ingest_coalesce_factor"] >= 1.0
+    assert out["ingest_program_cache_misses"] == 5
+
     # the tiered-retention read plane: the full-range query rides the line
     # in ms, and the store's gauge counts are EXACT pins on the seeded
     # 240 s stream — 24 published windows down the (4, 4, 8) ladder is
@@ -260,7 +271,12 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     out = _run_smoke(("--trace", str(trace_file)))
     _assert_headline_schema(out)
 
-    # schema version of the --trace payload: v16 added the pipeline-health
+    # schema version of the --trace payload: v17 added the ingest fast
+    # path (ingest_coalesced_steps_per_s / ingest_coalesce_factor — the
+    # queue-drain coalescing A/B on the bursty producer — plus the bucketed
+    # routing-program compile pin ingest_program_cache_misses on the default
+    # line and the ingest_counters block here, gated by --check-ingest's
+    # parity/throughput/chaos tiers); v16 added the pipeline-health
     # plane (publish_lag_ms / selfmeter_p99_ms — the lifecycle ledger's
     # worst close -> publish span and the self-meter sketch's certified e2e
     # p99 — plus the exact lifecycle_windows_stamped pin on the default
@@ -299,7 +315,7 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     # windowed serving A/B; v5 the keyed slab A/B; v4 the sketch A/B; v3
     # moved the collective counts to the default line and added the
     # hierarchical A/B + per-crossing counters; bump this pin with the schema
-    assert out["trace_schema"] == 16
+    assert out["trace_schema"] == 17
     # the sketch program's full snapshot: psum-only, no gather kinds staged
     sketch_kinds = out["sketch_counters"]["calls_by_kind"]
     assert sketch_kinds.get("psum", 0) == 2
@@ -360,6 +376,15 @@ def test_bench_smoke_trace_json_schema(tmp_path):
     assert out["async_counters"]["deferred"] == {
         "dispatched": 1, "fenced": 1, "completed": 1,
     }
+    # the ingest coalescing block: the A/B's raw numbers ride the trace
+    # payload — the timed stream is 168 batches, the bucket ladder is five
+    # compiled programs, and every timed drain hits the cache
+    ingest = out["ingest_counters"]
+    assert ingest["processed"] == 168
+    assert ingest["drains"] >= 1
+    assert ingest["coalesce_factor"] >= 1.0
+    assert ingest["program_cache_misses"] == 5
+    assert ingest["program_cache_hits"] >= 1
 
     # counter totals must agree with the states_synced the bench reports
     assert out["counters"]["states_synced"] == out["states_synced"]
@@ -659,6 +684,41 @@ def test_bench_check_service_gate():
     assert out["chaos"]["injected"]["late_burst"] >= 1
     assert out["chaos"]["injected"]["ingest_stall"] >= 1
     assert out["chaos"]["injected"]["preempt"] == 1
+
+
+def test_bench_check_ingest_gate():
+    """``bench.py --check-ingest`` is the ingest fast-path gate: the
+    coalescing drain loop must publish a bit-identical record stream to the
+    one-batch-per-drain twin over a bursty late/straggler mix (same windows,
+    values, merged view, drop count — beyond-lateness drops included), the
+    steady state must run on the prewarmed bucketed routing programs with
+    ZERO further compiles, the throughput tier must show the coalesced loop
+    >= 2x the uncoalesced twin with a batches-per-drain factor >= 2, and the
+    chaos tier (mid-stream preempt + snapshot/restore + seq-guarded replay)
+    must converge to the identical publication stream on both planes."""
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, _BENCH, "--check-ingest"],
+        capture_output=True, text=True, timeout=280, env=env,
+        cwd=os.path.dirname(_BENCH),
+    )
+    assert proc.returncode == 0, f"--check-ingest failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["ok"] is True and out["failures"] == []
+    # parity: the coalescing plane really coalesced, really dropped the
+    # beyond-lateness stragglers, and never recompiled in steady state
+    assert out["parity"]["coalesced_batches"] > 0
+    assert out["parity"]["dropped"] > 0
+    assert out["parity"]["records"] > 0
+    # throughput: the A/B's gate pins (>= 2x, factor >= 2) held
+    assert out["throughput"]["coalesced_steps_per_s"] >= 2 * out["throughput"]["uncoalesced_steps_per_s"]
+    assert out["throughput"]["coalesce_factor"] >= 2.0
+    assert out["throughput"]["program_cache_misses"] == 5
+    # chaos: both planes preempted and the coalescing side replayed
+    assert out["chaos"]["preempted"] is True
+    assert out["chaos"]["replayed_on"] >= 1
+    assert out["chaos"]["records"] > 0
 
 
 def test_bench_check_fleet_gate():

@@ -36,6 +36,7 @@ from metrics_tpu.parallel.cms import (
     is_cms,
     make_cms_spec,
     stable_key_hash,
+    stable_key_hash_array,
     stable_key_hashes,
 )
 from metrics_tpu.parallel.sketch import is_sketch
@@ -92,6 +93,70 @@ def test_buckets_deterministic_in_seed_and_reexported_hash():
     assert (b1 != b3).any()
     with pytest.raises(TypeError):
         stable_key_hash(1.5)
+
+
+def test_stable_key_hash_array_bit_equals_the_scalar_hash():
+    """The vectorized FNV-1a is BIT-EQUAL to the scalar hash of record on a
+    fixed diverse corpus — per element, per dtype family. The array hash is
+    what the fleet router and the CMS ingest path run in production; one
+    differing bit would silently reroute keys across a restart, so the pin
+    compares against ``stable_key_hash`` itself (which test_fleet pins
+    against precomputed constants — the equality is transitive).
+
+    Corpus notes: rows shorter than the widest key must stop folding at
+    their own length (padding never hashes); interior NUL bytes are real key
+    bytes and must fold; ``'S'``/``'U'`` storage strips trailing NULs, so
+    the scalar twin hashes the ARRAY ELEMENT (what storage kept), keeping
+    both sides on the same canonical bytes."""
+    corpora = (
+        # 'U': unicode, empty, interior NUL, and a width-40 row next to 1-char rows
+        np.array(["", "a", "1", "tenant/0", "пример-ключа", "雪字キー",
+                  "a\x00b", "x" * 40]),
+        # 'S': raw bytes incl. interior NUL and high bytes
+        np.array([b"", b"a", b"tenant-0", b"a\x00b", b"\xff\xfe\x01"], dtype="S"),
+        # signed/unsigned extremes across widths
+        np.array([0, 1, -1, 7, 12345, -(2**62), 2**63 - 1], dtype=np.int64),
+        np.array([0, 1, 2**63, 2**64 - 1], dtype=np.uint64),
+        np.array([-128, -1, 0, 127], dtype=np.int8),
+        # object arrays take the scalar fallback (mixed-type key batches)
+        np.array(["a", b"a", 1, "1"], dtype=object),
+    )
+    for arr in corpora:
+        got = stable_key_hash_array(arr)
+        assert got.dtype == np.uint64
+        expect = np.array([stable_key_hash(k) for k in arr], dtype=np.uint64)
+        np.testing.assert_array_equal(got, expect, err_msg=str(arr.dtype))
+        np.testing.assert_array_equal(got, stable_key_hashes(arr))
+    # the precomputed FNV-1a corpus (test_fleet pins the scalar hash to the
+    # same constants): the array hash must reproduce the exact values, not
+    # merely agree with whatever the in-process scalar computes
+    np.testing.assert_array_equal(
+        stable_key_hash_array(np.array(["tenant-1"])),
+        np.array([0x1CE48904A2FF17A2], dtype=np.uint64),
+    )
+    np.testing.assert_array_equal(
+        stable_key_hash_array(np.array([b"tenant-0"], dtype="S")),
+        np.array([0x3D82925F040C1B10], dtype=np.uint64),
+    )
+    np.testing.assert_array_equal(
+        stable_key_hash_array(np.array([0, 12345], dtype=np.int64)),
+        np.array([0x2B0A3B192B55573E, 0xDBD8F4A96E701FD1], dtype=np.uint64),
+    )
+    # shape discipline: lists hash like their array form, N-d flattens,
+    # empty stays empty — and non-canonical dtypes are rejected like the
+    # scalar hash rejects non-canonical keys
+    np.testing.assert_array_equal(
+        stable_key_hash_array(["u", "v"]), stable_key_hashes(["u", "v"])
+    )
+    grid = np.arange(6, dtype=np.int32).reshape(2, 3)
+    np.testing.assert_array_equal(
+        stable_key_hash_array(grid), stable_key_hashes(grid.reshape(-1))
+    )
+    assert stable_key_hash_array(np.array([], dtype=np.int64)).shape == (0,)
+    with pytest.raises(TypeError, match="str, bytes or int"):
+        stable_key_hash_array(np.array([1.5, 2.5]))
+    with pytest.raises(TypeError, match="str, bytes or int"):
+        stable_key_hash_array(np.array([True, False]))
 
 
 # ------------------------------------------------------------ sketch algebra

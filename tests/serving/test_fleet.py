@@ -32,7 +32,9 @@ import metrics_tpu.observability as obs
 from metrics_tpu import Accuracy, MetricFleet, Windowed
 from metrics_tpu.parallel import faults
 from metrics_tpu.parallel.sync import gather_all_arrays
-from metrics_tpu.serving import ShardStoppedError, shard_for_key, stable_key_hash
+from metrics_tpu.serving import (
+    ShardStoppedError, shard_for_key, shards_for_keys, stable_key_hash,
+)
 from metrics_tpu.serving.fleet import FLEET_SITE
 
 W, NW, LATE = 10.0, 4, 20.0
@@ -141,6 +143,32 @@ def test_shard_for_key_is_the_mod_partition_and_type_tagged():
         stable_key_hash(("a", 1))
     with pytest.raises(ValueError, match="num_shards"):
         shard_for_key("t", 0)
+
+
+def test_shards_for_keys_matches_the_scalar_router_exactly():
+    """The vectorized router is the SAME partition contract: one FNV-1a
+    array pass + one ``% num_shards`` must assign every key the identical
+    shard as ``shard_for_key`` — across str/bytes/int key batches, mixed
+    object arrays, and every shard count a fleet would use. A single
+    disagreement would misroute a tenant on the next restart."""
+    str_keys = np.array([f"tenant-{i}" for i in range(257)] + ["", "雪", "a\x00b"])
+    byte_keys = np.array([b"tenant-0", b"", b"a\x00b", b"\xff\xfe\x01"], dtype="S")
+    int_keys = np.array([0, 1, -1, 12345, -(2**62), 2**62], dtype=np.int64)
+    mixed = np.array(["a", b"a", 1, "1"], dtype=object)
+    for keys in (str_keys, byte_keys, int_keys, mixed):
+        for n in (1, 2, 7, 8, 64):
+            got = shards_for_keys(keys, n)
+            assert got.dtype == np.int64
+            expect = [shard_for_key(k, n) for k in keys]
+            np.testing.assert_array_equal(got, np.array(expect, dtype=np.int64))
+    # plain python lists route identically to their array form
+    np.testing.assert_array_equal(
+        shards_for_keys(["u-1", "u-2"], 8),
+        [shard_for_key("u-1", 8), shard_for_key("u-2", 8)],
+    )
+    assert shards_for_keys(np.array([], dtype=np.int64), 4).shape == (0,)
+    with pytest.raises(ValueError, match="num_shards"):
+        shards_for_keys(["t"], 0)
 
 
 def test_router_deterministic_across_fleet_restarts():

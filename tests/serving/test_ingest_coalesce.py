@@ -1,0 +1,370 @@
+"""Queue-drain coalescing: the ingest fast path's bit-exactness contract.
+
+What must hold (serving/service.py + core/streaming.py + core/metric.py +
+wrappers/windowed.py):
+
+- equivalence: a MetricService with coalescing ON publishes a record stream
+  BIT-IDENTICAL to the one-batch-per-drain twin over a randomized bursty
+  stream (shuffled-within-lateness event times, beyond-lateness drops,
+  variable batch sizes) — tumbling, sliding, and Windowed(Keyed(...))
+  shapes alike. Coalescing is a dispatch optimization, never a semantic;
+- judge_prefix: routing a concatenation of k batches under the per-event
+  prefix running-max watermark yields the verdicts the sequential plane
+  produced — including events a FINAL-max judge would have dropped — and
+  the malformed-prefix forms are rejected loudly;
+- guarded spans: ``guarded_update(a, ..., span_end=b)`` folds the seq range
+  ``[a, b]`` all-or-nothing — whole-span replays no-op, straddling spans
+  raise (the caller must split at the watermark), inverted spans raise;
+- span formation: a drain coalesces exactly the contiguous same-structure
+  publish-free runs (seq gaps split spans; replays no-op and count), and
+  the bucketed routing-program cache compiles once per occupied sample
+  bucket — steady state never retraces.
+"""
+import copy
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import metrics_tpu.observability as obs
+from metrics_tpu import Accuracy, Keyed, MetricService, Windowed
+from metrics_tpu.core.streaming import WindowSpec, route_events
+from metrics_tpu.observability.counters import COUNTERS
+
+
+# ------------------------------------------------------------ stream makers
+def _bursty_batches(n=90, seed=3, keyed=False):
+    """A randomized stream: variable batch sizes, event times shuffled
+    within the lateness horizon, and a sprinkle of beyond-lateness
+    stragglers that MUST be dropped identically by both planes."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        size = int(rng.randint(1, 49))
+        base = i * 2.0
+        times = np.maximum(base + rng.uniform(-9.0, 1.0, size), 0.0)
+        if i % 23 == 11:  # a too-late straggler: beyond every open window
+            times[0] = max(base - 40.0, 0.0)
+        kwargs = {}
+        if keyed:
+            kwargs["slot"] = rng.randint(0, 8, size)
+        out.append((
+            times.astype(np.float64),
+            rng.rand(size).astype(np.float32),
+            rng.randint(0, 2, size).astype(np.int32),
+            kwargs,
+        ))
+    return out
+
+
+def _drive(metric, batches, coalesce):
+    """Feed the whole stream through a MetricService with the worker stalled
+    during submission (so the backlog exists and the coalescing drain has
+    something to coalesce), then flush + finalize."""
+    svc = MetricService(
+        metric,
+        queue_size=len(batches) + 4,
+        coalesce_max_batches=(8 if coalesce else 1),
+    )
+    try:
+        with svc._proc_lock:
+            for i, (t, p, y, kw) in enumerate(batches):
+                svc.submit(jnp.asarray(p), jnp.asarray(y), event_time=t, seq=i, **kw)
+        svc.flush()
+        merged = svc.finalize()
+        return {
+            "publications": list(svc.publications),
+            "merged": np.asarray(merged),
+            "coalesced_batches": svc.coalesced_batches,
+            "processed": svc.processed,
+            "drains": svc.drains,
+            "watermark": svc.metric.watermark,
+            "head": svc.metric.head_window,
+        }
+    finally:
+        svc.stop()
+
+
+def _assert_same_publications(on, off):
+    assert len(on["publications"]) == len(off["publications"])
+    for rec_on, rec_off in zip(on["publications"], off["publications"]):
+        assert set(rec_on) == set(rec_off)
+        for field in rec_on:
+            if field == "service":
+                continue  # the label carries the instance counter, not data
+            a, b = rec_on[field], rec_off[field]
+            if isinstance(a, (np.ndarray, jnp.ndarray)) or isinstance(b, (np.ndarray, jnp.ndarray)):
+                assert np.array_equal(np.asarray(a), np.asarray(b), equal_nan=True), field
+            else:
+                assert a == b, field
+    assert np.array_equal(on["merged"], off["merged"], equal_nan=True)
+    assert on["watermark"] == off["watermark"]
+    assert on["head"] == off["head"]
+    assert on["processed"] == off["processed"]
+
+
+# ------------------------------------------------------------- equivalence
+@pytest.mark.parametrize(
+    "shape", ["tumbling", "sliding", "keyed"],
+)
+def test_coalesced_service_is_bit_exact_vs_one_batch_oracle(shape):
+    """The tentpole property: coalescing changes drain counts, never a
+    single published bit. Runs the identical randomized bursty stream
+    through a coalescing service and its one-batch-per-drain twin and
+    demands field-for-field equal publications, merged view, drop counts,
+    and stream position — for a tumbling ring, a sliding ring (overlap
+    routing included), and a Windowed(Keyed(...)) slab (kwarg
+    concatenation included)."""
+    def build():
+        if shape == "sliding":
+            return Windowed(Accuracy(), window_s=10.0, num_windows=8,
+                            allowed_lateness_s=10.0, slide_s=5.0)
+        if shape == "keyed":
+            return Windowed(Keyed(Accuracy(), num_slots=8), window_s=10.0,
+                            num_windows=4, allowed_lateness_s=10.0)
+        return Windowed(Accuracy(), window_s=10.0, num_windows=4,
+                        allowed_lateness_s=10.0)
+
+    batches = _bursty_batches(keyed=(shape == "keyed"))
+    on = _drive(build(), batches, coalesce=True)
+    off = _drive(build(), batches, coalesce=False)
+    # the oracle really was sequential; the fast path really coalesced
+    assert off["coalesced_batches"] == 0
+    assert on["coalesced_batches"] > 0
+    assert on["drains"] < off["drains"]
+    # the stream really closed windows mid-flight (publish-split coverage)
+    assert len(on["publications"]) > 2
+    _assert_same_publications(on, off)
+
+
+# ------------------------------------------------------------ judge_prefix
+def test_judge_prefix_routes_the_concatenation_like_the_sequential_plane():
+    """The routing algebra under the per-event prefix clock: concatenating
+    k batches and judging each event by its own batch's running max yields
+    the EXACT sequential verdicts — including an old event the
+    concatenation's FINAL max would have dropped (the case the prefix form
+    exists for)."""
+    spec = WindowSpec(10.0, 8, 10.0, None)
+    # batch 2 carries t=1.0: judged at its own wm 19.5 it is accepted-late
+    # (window [0,10) stays open until 30); judged at the span's final wm
+    # 29.0 it would be dropped. The prefix must preserve the acceptance.
+    batches = [
+        np.array([12.0, 15.5, 3.0]),
+        np.array([19.5, 1.0]),
+        np.array([29.0, 22.0, 11.0]),
+    ]
+    wm, head = None, None
+    seq_slots, seq_late, seq_dropped = [], 0, 0
+    prefix = []
+    for t in batches:
+        route = route_events(t, wm, head, spec)
+        seq_slots.append(route.slot_ids)
+        seq_late += route.n_late
+        seq_dropped += route.n_dropped
+        wm, head = route.watermark, route.head
+        prefix.append(np.full(t.shape, wm))
+    cat = np.concatenate(batches)
+    judge = np.concatenate(prefix)
+    routed = route_events(cat, None, None, spec, judge_prefix=judge)
+    np.testing.assert_array_equal(routed.slot_ids, np.concatenate(seq_slots))
+    assert routed.n_late == seq_late
+    assert routed.n_dropped == seq_dropped
+    assert routed.watermark == wm and routed.head == head
+    # the prefix is load-bearing: the scalar final-max judge disagrees
+    scalar = route_events(cat, None, None, spec)
+    assert scalar.n_dropped > seq_dropped
+    # sliding overlap rows route identically under the prefix too
+    slide = WindowSpec(10.0, 16, 10.0, 2.5)
+    wm2 = head2 = None
+    rows, prefix2 = [], []
+    for t in batches:
+        route = route_events(t, wm2, head2, slide)
+        rows.append(np.stack([route.slot_ids, *route.overlap_slots]))
+        wm2, head2 = route.watermark, route.head
+        prefix2.append(np.full(t.shape, wm2))
+    routed2 = route_events(cat, None, None, slide, judge_prefix=np.concatenate(prefix2))
+    np.testing.assert_array_equal(
+        np.stack([routed2.slot_ids, *routed2.overlap_slots]),
+        np.concatenate(rows, axis=1),
+    )
+
+
+def test_judge_prefix_malformed_forms_are_rejected():
+    spec = WindowSpec(10.0, 4, 10.0, None)
+    t = np.array([5.0, 7.0])
+    with pytest.raises(ValueError, match="must match event_times"):
+        route_events(t, None, None, spec, judge_prefix=np.array([7.0]))
+    with pytest.raises(ValueError, match="non-decreasing"):
+        route_events(t, None, None, spec, judge_prefix=np.array([7.0, 5.0]))
+    with pytest.raises(ValueError, match="end at the batch watermark"):
+        route_events(t, None, None, spec, judge_prefix=np.array([5.0, 6.0]))
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        route_events(t, None, None, spec, agreed=3.0,
+                     judge_prefix=np.array([5.0, 7.0]))
+    decay = Windowed(Accuracy(), decay_half_life_s=5.0)
+    with pytest.raises(ValueError, match="decay"):
+        decay.update(jnp.asarray(np.float32([0.9])), jnp.asarray(np.int32([1])),
+                     event_time=np.array([1.0]), judge_prefix=np.array([1.0]))
+
+
+# ---------------------------------------------------------- guarded spans
+def test_guarded_update_span_is_all_or_nothing():
+    p = jnp.asarray(np.float32([0.9, 0.1, 0.8, 0.3]))
+    y = jnp.asarray(np.int32([1, 0, 1, 1]))
+    m = Accuracy()
+    with pytest.raises(ValueError, match="span_end"):
+        m.guarded_update(5, p, y, span_end=4)
+    # fold steps [0, 3] as one update: the watermark lands past the span
+    assert m.guarded_update(0, p, y, span_end=3) is True
+    assert m.epoch_watermark == 4
+    before = np.asarray(m.compute())
+    # a whole-span replay no-ops (any sub-span of the folded range too)
+    assert m.guarded_update(0, p, y, span_end=3) is False
+    assert m.guarded_update(1, p, y, span_end=2) is False
+    np.testing.assert_array_equal(np.asarray(m.compute()), before)
+    # a straddling span must be split by the caller, not half-applied
+    with pytest.raises(ValueError, match="straddles"):
+        m.guarded_update(2, p, y, span_end=5)
+    np.testing.assert_array_equal(np.asarray(m.compute()), before)
+    # the stream resumes at the watermark; a width-1 span is legal
+    assert m.guarded_update(4, p, y, span_end=4) is True
+    assert m.epoch_watermark == 5
+
+
+# ---------------------------------------------------------- span formation
+def _items(seqs, size=16, t0=0.0, seed=5):
+    rng = np.random.RandomState(seed)
+    out = []
+    for j, seq in enumerate(seqs):
+        times = t0 + j * 0.5 + rng.uniform(0.0, 0.4, size)
+        out.append((
+            seq,
+            (jnp.asarray(rng.rand(size).astype(np.float32)),
+             jnp.asarray(rng.randint(0, 2, size).astype(np.int32))),
+            times.astype(np.float64),
+            {},
+        ))
+    return out
+
+
+def _wide_metric():
+    # window longer than the stream: every drain is publish-free, so span
+    # formation is decided by seq contiguity/structure alone
+    return Windowed(Accuracy(), window_s=600.0, num_windows=4,
+                    allowed_lateness_s=600.0)
+
+
+def _slab_arrays(m):
+    out = {name: np.asarray(getattr(m, name)) for name in m.metric._defaults}
+    out["windowed_rows"] = np.asarray(getattr(m, "windowed_rows"))
+    return out
+
+
+def test_drain_coalesces_contiguous_runs_and_splits_on_seq_gaps():
+    """Deterministic span formation, driven through ``_process_drain``
+    directly (the worker loop's apply path): a contiguous backlog coalesces
+    up to ``coalesce_max_batches``, a seq gap splits the span, and the
+    folded state is bit-identical to the sequential twin's."""
+    svc = MetricService(_wide_metric(), coalesce_max_batches=8)
+    try:
+        items = _items(range(9))
+        with svc._proc_lock:
+            svc._process_drain(items)
+        # one drain: an 8-batch span + the 9th batch alone
+        assert svc.drains == 1
+        assert svc.processed == 9
+        assert svc.coalesced_batches == 8
+        twin = _wide_metric()
+        for _, (p, y), t, _kw in items:
+            twin.update(p, y, event_time=t)
+        got, want = _slab_arrays(svc.metric), _slab_arrays(twin)
+        for name in want:
+            np.testing.assert_array_equal(got[name], want[name], err_msg=name)
+        assert svc.metric.epoch_watermark == twin.epoch_watermark == 9
+    finally:
+        svc.stop()
+
+    svc2 = MetricService(_wide_metric(), coalesce_max_batches=8)
+    try:
+        gapped = _items([0, 1, 5, 6])
+        with svc2._proc_lock:
+            svc2._process_drain(gapped)
+        # the gap splits the backlog into two 2-batch spans, one drain
+        assert svc2.drains == 1
+        assert svc2.processed == 4
+        assert svc2.coalesced_batches == 4
+    finally:
+        svc2.stop()
+
+
+def test_replayed_drain_no_ops_per_batch():
+    """Replaying an already-folded backlog (the restore path's overlap) must
+    no-op batch by batch: counted as replays, zero state movement."""
+    svc = MetricService(_wide_metric(), coalesce_max_batches=8)
+    try:
+        items = _items(range(4))
+        with svc._proc_lock:
+            svc._process_drain(items)
+        before = _slab_arrays(svc.metric)
+        assert svc.replayed_steps == 0
+        with svc._proc_lock:
+            svc._process_drain(items)
+        assert svc.replayed_steps == 4
+        assert svc.processed == 8
+        after = _slab_arrays(svc.metric)
+        for name in before:
+            np.testing.assert_array_equal(after[name], before[name], err_msg=name)
+    finally:
+        svc.stop()
+
+
+# ------------------------------------------------- bucketed program cache
+def test_ingest_program_cache_compiles_once_per_bucket():
+    """The retrace guard: every update pads to a power-of-two sample bucket
+    and reuses ONE compiled routed-scatter program per (bucket, structure) —
+    distinct batch sizes within a bucket are cache hits, a new bucket is
+    exactly one miss, and copies start with an empty cache (programs are
+    derived state, never checkpointed)."""
+    obs.enable()
+    try:
+        metric = _wide_metric()
+        rng = np.random.RandomState(9)
+
+        def feed(size, t0):
+            metric.update(
+                jnp.asarray(rng.rand(size).astype(np.float32)),
+                jnp.asarray(rng.randint(0, 2, size).astype(np.int32)),
+                event_time=t0 + rng.uniform(0.0, 0.4, size),
+            )
+
+        h0, m0 = COUNTERS.ingest_program_cache_hits, COUNTERS.ingest_program_cache_misses
+        feed(17, 0.0)
+        feed(25, 1.0)
+        feed(32, 2.0)  # all three pad into the 32-sample bucket
+        assert len(metric._ingest_programs) == 1
+        assert COUNTERS.ingest_program_cache_misses - m0 == 1
+        assert COUNTERS.ingest_program_cache_hits - h0 == 2
+        feed(40, 3.0)  # a second bucket: exactly one more program
+        assert len(metric._ingest_programs) == 2
+        assert COUNTERS.ingest_program_cache_misses - m0 == 2
+        # padded rows never pollute the slabs: the fold equals the twin's
+        twin = _wide_metric()
+        rng2 = np.random.RandomState(9)
+        for size, t0 in ((17, 0.0), (25, 1.0), (32, 2.0), (40, 3.0)):
+            twin.update(
+                jnp.asarray(rng2.rand(size).astype(np.float32)),
+                jnp.asarray(rng2.randint(0, 2, size).astype(np.int32)),
+                event_time=t0 + rng2.uniform(0.0, 0.4, size),
+            )
+        got, want = _slab_arrays(metric), _slab_arrays(twin)
+        for name in want:
+            np.testing.assert_array_equal(got[name], want[name], err_msg=name)
+        # deep copies (snapshot/restore, fleet shard clones) drop the cache
+        clone = copy.deepcopy(metric)
+        assert len(clone._ingest_programs) == 0
+        for name, arr in _slab_arrays(clone).items():
+            np.testing.assert_array_equal(arr, got[name], err_msg=name)
+    finally:
+        obs.disable()
+        obs.reset()
